@@ -1,0 +1,23 @@
+"""corda_tpu: a TPU-native distributed-ledger framework.
+
+A ground-up rebuild of the capabilities of Corda (reference: Kerwong/corda
+0.14-SNAPSHOT) designed for TPU hardware: JAX/XLA/Pallas batch crypto kernels
+on the verification hot path, asyncio flows instead of Quasar fibers, a
+deterministic canonical serialization instead of Kryo, and jax.sharding
+meshes instead of an Artemis broker for intra-pod batch distribution.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  corda_tpu.core      -- L0 stable API: contracts, transactions, crypto, flows-as-API
+  corda_tpu.ops       -- TPU batch kernels (sha256/sha512/ed25519/secp256)
+  corda_tpu.parallel  -- device-mesh sharding of verification batches
+  corda_tpu.verifier  -- L3 out-of-process verification worker + batching seam
+  corda_tpu.node      -- L2 node runtime (state machine, messaging, persistence)
+  corda_tpu.notary    -- uniqueness consensus (simple / validating / raft)
+  corda_tpu.rpc       -- RPC server/client with streaming feeds
+  corda_tpu.finance   -- L6 domain contracts (Cash, CommercialPaper, Obligation)
+  corda_tpu.testing   -- MockNetwork, ledger DSL, driver
+  corda_tpu.loadtest  -- load-test harness producing BASELINE metrics
+"""
+
+__version__ = "0.1.0"
+platform_version = 1

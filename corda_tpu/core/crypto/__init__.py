@@ -1,0 +1,61 @@
+"""corda_tpu.core.crypto: crypto value types, scheme registry, host sign/verify.
+
+The TPU batch-verification kernels live in corda_tpu.ops; this package is the
+scalar host path and the semantic definition the kernels are tested against.
+"""
+from .composite import CompositeKey, CompositeSignaturesWithKeys, NodeAndWeight
+from .crypto import (
+    CryptoError,
+    SignatureError,
+    UnsupportedSchemeError,
+    derive_keypair,
+    derive_keypair_from_entropy,
+    do_sign,
+    do_verify,
+    entropy_to_keypair,
+    find_signature_scheme,
+    generate_keypair,
+    is_operational,
+    is_supported,
+    is_valid,
+    public_key_on_curve,
+)
+from .keys import KeyPair, PublicKey, SchemePrivateKey, SchemePublicKey
+from .merkle import MerkleTree, MerkleTreeError, PartialMerkleTree
+from .schemes import (
+    COMPOSITE_KEY,
+    DEFAULT_SIGNATURE_SCHEME,
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+    RSA_SHA256,
+    SPHINCS256_SHA256,
+    SUPPORTED_SIGNATURE_SCHEMES,
+    SignatureScheme,
+)
+from .secure_hash import SecureHash, random_63_bit_value, secure_random_bytes
+from .signing import (
+    DigitalSignature,
+    DigitalSignatureWithKey,
+    MetaData,
+    SignatureType,
+    SignedData,
+    TransactionSignature,
+    sign_bytes,
+)
+
+__all__ = [
+    "CompositeKey", "CompositeSignaturesWithKeys", "NodeAndWeight",
+    "CryptoError", "SignatureError", "UnsupportedSchemeError",
+    "derive_keypair", "derive_keypair_from_entropy", "do_sign", "do_verify",
+    "entropy_to_keypair", "find_signature_scheme", "generate_keypair",
+    "is_operational", "is_supported", "is_valid", "public_key_on_curve",
+    "KeyPair", "PublicKey", "SchemePrivateKey", "SchemePublicKey",
+    "MerkleTree", "MerkleTreeError", "PartialMerkleTree",
+    "COMPOSITE_KEY", "DEFAULT_SIGNATURE_SCHEME", "ECDSA_SECP256K1_SHA256",
+    "ECDSA_SECP256R1_SHA256", "EDDSA_ED25519_SHA512", "RSA_SHA256",
+    "SPHINCS256_SHA256", "SUPPORTED_SIGNATURE_SCHEMES", "SignatureScheme",
+    "SecureHash", "random_63_bit_value", "secure_random_bytes",
+    "DigitalSignature", "DigitalSignatureWithKey", "MetaData", "SignatureType",
+    "SignedData", "TransactionSignature", "sign_bytes",
+]

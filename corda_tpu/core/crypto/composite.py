@@ -1,0 +1,232 @@
+"""CompositeKey: threshold multi-signature key trees.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/crypto/composite/
+CompositeKey.kt` (weighted children, nested trees, `isFulfilledBy` threshold
+evaluation, duplicate/weight validation) and `CompositeSignature.kt` /
+`CompositeSignaturesWithKeys.kt`. Where the reference plugs into the JCA via a
+custom provider (`CordaSecurityProvider.kt`), here CompositeKey is simply a
+PublicKey subtype understood by `crypto.is_valid` and (for batch evaluation)
+by the verifier's bitmask combiner: the TPU kernel verifies leaf signatures as
+a flat batch and the threshold logic folds the resulting pass/fail bitmask up
+the tree on the host (pure integer logic, negligible cost).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import FrozenSet, Iterable, List, Sequence, Tuple
+
+from .keys import PublicKey, SchemePublicKey
+from .schemes import COMPOSITE_KEY, SCHEMES_BY_ID, SUPPORTED_SIGNATURE_SCHEMES
+
+_LEAF_TAG = 1
+_NODE_TAG = 2
+
+
+@dataclass(frozen=True)
+class NodeAndWeight:
+    node: PublicKey
+    weight: int
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError("weights must be positive")
+
+
+class CompositeKey(PublicKey):
+    """An immutable weighted-threshold tree over leaf public keys."""
+
+    def __init__(self, threshold: int, children: Sequence[NodeAndWeight]):
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        if not children:
+            raise ValueError("composite key must have children")
+        total = sum(c.weight for c in children)
+        if threshold > total:
+            raise ValueError(
+                f"threshold {threshold} exceeds sum of weights {total}"
+            )
+        # deterministic ordering for a canonical encoding
+        self.threshold = threshold
+        self.children: Tuple[NodeAndWeight, ...] = tuple(
+            sorted(children, key=lambda c: (_encode_node(c.node), c.weight))
+        )
+        self.scheme_code_name = COMPOSITE_KEY.scheme_code_name
+        self._check_validity()
+        self.encoded = _encode_node(self)
+
+    # -- validation (reference CompositeKey.checkValidity) -------------------
+    def _check_validity(self):
+        seen: set = set()
+        self._check_duplicates(seen)
+
+    def _check_duplicates(self, seen: set):
+        for c in self.children:
+            if isinstance(c.node, CompositeKey):
+                c.node._check_duplicates(seen)
+            else:
+                if c.node in seen:
+                    raise ValueError("duplicate leaf keys in composite key tree")
+                seen.add(c.node)
+
+    # -- evaluation ----------------------------------------------------------
+    @property
+    def keys(self) -> FrozenSet[PublicKey]:
+        out: set = set()
+        for c in self.children:
+            out |= c.node.keys
+        return frozenset(out)
+
+    def is_fulfilled_by(self, keys: Iterable[PublicKey]) -> bool:
+        ks = set(keys)
+        return self._fulfilled(ks)
+
+    def _fulfilled(self, ks: set) -> bool:
+        total = 0
+        for c in self.children:
+            if isinstance(c.node, CompositeKey):
+                if c.node._fulfilled(ks):
+                    total += c.weight
+            elif c.node in ks:
+                total += c.weight
+            if total >= self.threshold:
+                return True
+        return False
+
+    def verify_composite(self, sigs: "CompositeSignaturesWithKeys", content: bytes) -> bool:
+        """Check enough leaf signatures are present AND each one is valid."""
+        from . import crypto
+
+        valid_keys = set()
+        for pub, sig in sigs.sigs:
+            if crypto.is_valid(pub, sig, content):
+                valid_keys.add(pub)
+            else:
+                return False  # any invalid constituent fails the whole composite
+        return self.is_fulfilled_by(valid_keys)
+
+    # -- identity ------------------------------------------------------------
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CompositeKey) and self.encoded == other.encoded
+
+    def __hash__(self) -> int:
+        return hash(self.encoded)
+
+    def __repr__(self) -> str:
+        return f"CompositeKey(threshold={self.threshold}, children={len(self.children)})"
+
+    # -- builder (reference CompositeKey.Builder) ----------------------------
+    class Builder:
+        def __init__(self):
+            self._children: List[NodeAndWeight] = []
+
+        def add_key(self, key: PublicKey, weight: int = 1) -> "CompositeKey.Builder":
+            self._children.append(NodeAndWeight(key, weight))
+            return self
+
+        def add_keys(self, *keys: PublicKey) -> "CompositeKey.Builder":
+            for k in keys:
+                self.add_key(k)
+            return self
+
+        def build(self, threshold: int | None = None) -> PublicKey:
+            n = len(self._children)
+            if n == 0:
+                raise ValueError("cannot build composite key with zero children")
+            th = threshold if threshold is not None else sum(c.weight for c in self._children)
+            # single-child with full threshold collapses to the child itself
+            if n == 1 and th == self._children[0].weight:
+                return self._children[0].node
+            return CompositeKey(th, self._children)
+
+
+# --- canonical binary encoding of key trees ---------------------------------
+
+def _encode_node(key: PublicKey) -> bytes:
+    if isinstance(key, CompositeKey):
+        out = [struct.pack(">BII", _NODE_TAG, key.threshold, len(key.children))]
+        for c in key.children:
+            child = _encode_node(c.node)
+            out.append(struct.pack(">I", c.weight))
+            out.append(struct.pack(">I", len(child)))
+            out.append(child)
+        return b"".join(out)
+    scheme = SUPPORTED_SIGNATURE_SCHEMES[key.scheme_code_name]
+    return struct.pack(">BBI", _LEAF_TAG, scheme.scheme_number_id, len(key.encoded)) + key.encoded
+
+
+def _decode_node(data: bytes, offset: int = 0) -> Tuple[PublicKey, int]:
+    tag = data[offset]
+    if tag == _LEAF_TAG:
+        _, scheme_id, ln = struct.unpack_from(">BBI", data, offset)
+        offset += 6
+        if offset + ln > len(data):
+            raise ValueError("composite key leaf length exceeds buffer")
+        enc = data[offset : offset + ln]
+        if scheme_id not in SCHEMES_BY_ID:
+            raise ValueError(f"unknown scheme id {scheme_id} in composite key")
+        scheme = SCHEMES_BY_ID[scheme_id]
+        return SchemePublicKey(scheme.scheme_code_name, enc), offset + ln
+    if tag == _NODE_TAG:
+        _, threshold, n = struct.unpack_from(">BII", data, offset)
+        offset += 9
+        children = []
+        for _ in range(n):
+            (weight,) = struct.unpack_from(">I", data, offset)
+            offset += 4
+            (ln,) = struct.unpack_from(">I", data, offset)
+            offset += 4
+            child, consumed = _decode_node(data, offset)
+            if consumed != offset + ln:
+                raise ValueError("composite key child length mismatch")
+            offset = consumed
+            children.append(NodeAndWeight(child, weight))
+        return CompositeKey(threshold, children), offset
+    raise ValueError(f"bad composite key tag {tag}")
+
+
+def decode_composite_key(data: bytes) -> PublicKey:
+    key, consumed = _decode_node(data)
+    if consumed != len(data):
+        raise ValueError("trailing bytes in composite key encoding")
+    return key
+
+
+@dataclass(frozen=True)
+class CompositeSignaturesWithKeys:
+    """An aggregate of leaf (key, signature) pairs satisfying a CompositeKey.
+
+    Parity: reference `composite/CompositeSignaturesWithKeys.kt`.
+    """
+
+    sigs: Tuple[Tuple[PublicKey, bytes], ...] = field(default_factory=tuple)
+
+    def serialize(self) -> bytes:
+        out = [struct.pack(">I", len(self.sigs))]
+        for pub, sig in self.sigs:
+            enc = _encode_node(pub)
+            out.append(struct.pack(">I", len(enc)))
+            out.append(enc)
+            out.append(struct.pack(">I", len(sig)))
+            out.append(sig)
+        return b"".join(out)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "CompositeSignaturesWithKeys":
+        (n,) = struct.unpack_from(">I", data, 0)
+        offset = 4
+        sigs = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from(">I", data, offset)
+            offset += 4
+            pub, consumed = _decode_node(data, offset)
+            if consumed != offset + ln:
+                raise ValueError("composite signature key length mismatch")
+            offset = consumed
+            (sl,) = struct.unpack_from(">I", data, offset)
+            offset += 4
+            if offset + sl > len(data):
+                raise ValueError("composite signature length exceeds buffer")
+            sigs.append((pub, data[offset : offset + sl]))
+            offset += sl
+        return CompositeSignaturesWithKeys(tuple(sigs))

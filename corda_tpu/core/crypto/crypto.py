@@ -1,0 +1,265 @@
+"""Crypto: the central sign/verify/keygen/derive hub (host path).
+
+Parity: reference `core/src/main/kotlin/net/corda/core/crypto/Crypto.kt`
+(`doSign` :394-401, `doVerify` :473-483, `isValid` :535-541,
+`findSignatureScheme` :250-253, `deriveKeyPairFromEntropy` :718-739,
+`publicKeyOnCurve` :859-871). The reference delegates per-scheme math to
+BouncyCastle / i2p-EdDSA via the JCA; here the host path delegates to the
+`cryptography` package (OpenSSL) plus pure-Python math for derivation, and the
+*batch* path lives in corda_tpu.ops (JAX/TPU kernels) behind the
+verifier seam -- this module is the scalar fallback and correctness oracle.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+from typing import Iterable, Tuple
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
+
+from . import ed25519_math, secp_math
+from .keys import KeyPair, PublicKey, SchemePrivateKey, SchemePublicKey
+from .schemes import (
+    COMPOSITE_KEY,
+    DEFAULT_SIGNATURE_SCHEME,
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+    RSA_SHA256,
+    SCHEMES_BY_ID,
+    SPHINCS256_SHA256,
+    SUPPORTED_SIGNATURE_SCHEMES,
+    SignatureScheme,
+)
+
+_EC_CURVES = {
+    ECDSA_SECP256K1_SHA256.scheme_code_name: (ec.SECP256K1(), secp_math.SECP256K1),
+    ECDSA_SECP256R1_SHA256.scheme_code_name: (ec.SECP256R1(), secp_math.SECP256R1),
+}
+
+
+class CryptoError(Exception):
+    pass
+
+
+class SignatureError(CryptoError):
+    """Raised by do_verify on an invalid signature (reference: SignatureException)."""
+
+
+class UnsupportedSchemeError(CryptoError):
+    pass
+
+
+def find_signature_scheme(key_or_name) -> SignatureScheme:
+    """Resolve a SignatureScheme from a code name, numeric id, or key object."""
+    if isinstance(key_or_name, SignatureScheme):
+        return key_or_name
+    if isinstance(key_or_name, int):
+        try:
+            return SCHEMES_BY_ID[key_or_name]
+        except KeyError:
+            raise UnsupportedSchemeError(f"unknown scheme id {key_or_name}")
+    if isinstance(key_or_name, str):
+        try:
+            return SUPPORTED_SIGNATURE_SCHEMES[key_or_name]
+        except KeyError:
+            raise UnsupportedSchemeError(f"unknown scheme {key_or_name}")
+    name = getattr(key_or_name, "scheme_code_name", None)
+    if name is not None:
+        return find_signature_scheme(name)
+    raise UnsupportedSchemeError(f"cannot resolve scheme from {key_or_name!r}")
+
+
+# Schemes in the registry whose algorithm implementation has not landed yet.
+UNIMPLEMENTED_SCHEMES = frozenset({SPHINCS256_SHA256.scheme_code_name})
+
+
+def is_supported(scheme: SignatureScheme) -> bool:
+    """Registry membership (metadata-recognized). Use is_operational to check
+    whether sign/verify/keygen actually work for the scheme."""
+    return scheme.scheme_code_name in SUPPORTED_SIGNATURE_SCHEMES
+
+
+def is_operational(scheme: SignatureScheme) -> bool:
+    return is_supported(scheme) and scheme.scheme_code_name not in UNIMPLEMENTED_SCHEMES
+
+
+# --- key generation ---------------------------------------------------------
+
+def generate_keypair(scheme: SignatureScheme = DEFAULT_SIGNATURE_SCHEME) -> KeyPair:
+    name = scheme.scheme_code_name
+    if name == EDDSA_ED25519_SHA512.scheme_code_name:
+        seed = os.urandom(32)
+        return _ed25519_keypair_from_seed(seed)
+    if name in _EC_CURVES:
+        curve = _EC_CURVES[name][1]
+        d = (int.from_bytes(os.urandom(40), "big") % (curve.n - 1)) + 1
+        return _ec_keypair_from_scalar(name, d)
+    if name == RSA_SHA256.scheme_code_name:
+        priv = rsa.generate_private_key(public_exponent=65537, key_size=3072)
+        return _rsa_keypair(priv)
+    if name == SPHINCS256_SHA256.scheme_code_name:
+        from . import sphincs
+
+        return sphincs.generate_keypair()
+    raise UnsupportedSchemeError(f"cannot generate keys for {name}")
+
+
+def _ed25519_keypair_from_seed(seed: bytes) -> KeyPair:
+    name = EDDSA_ED25519_SHA512.scheme_code_name
+    pub = ed25519.Ed25519PrivateKey.from_private_bytes(seed).public_key()
+    pub_raw = pub.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return KeyPair(SchemePublicKey(name, pub_raw), SchemePrivateKey(name, seed))
+
+
+def _ec_keypair_from_scalar(name: str, d: int) -> KeyPair:
+    jca_curve, _ = _EC_CURVES[name]
+    priv = ec.derive_private_key(d, jca_curve)
+    pub_raw = priv.public_key().public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+    return KeyPair(
+        SchemePublicKey(name, pub_raw),
+        SchemePrivateKey(name, d.to_bytes(32, "big")),
+    )
+
+
+def _rsa_keypair(priv) -> KeyPair:
+    name = RSA_SHA256.scheme_code_name
+    pub_der = priv.public_key().public_bytes(
+        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    priv_der = priv.private_bytes(
+        serialization.Encoding.DER,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    return KeyPair(SchemePublicKey(name, pub_der), SchemePrivateKey(name, priv_der))
+
+
+# --- deterministic derivation (reference Crypto.kt:628-753) -----------------
+
+def derive_keypair_from_entropy(
+    scheme: SignatureScheme, entropy: int | bytes
+) -> KeyPair:
+    """Deterministic keypair from entropy (EdDSA + ECDSA only, like the reference).
+
+    KDF: HMAC-SHA512(key=entropy, msg=scheme code name), then clamp/reduce.
+    """
+    if isinstance(entropy, int):
+        entropy = entropy.to_bytes((entropy.bit_length() + 7) // 8 or 1, "big", signed=False)
+    material = hmac_mod.new(entropy, scheme.scheme_code_name.encode(), hashlib.sha512).digest()
+    name = scheme.scheme_code_name
+    if name == EDDSA_ED25519_SHA512.scheme_code_name:
+        return _ed25519_keypair_from_seed(material[:32])
+    if name in _EC_CURVES:
+        curve = _EC_CURVES[name][1]
+        d = (int.from_bytes(material, "big") % (curve.n - 1)) + 1
+        return _ec_keypair_from_scalar(name, d)
+    raise UnsupportedSchemeError(f"deterministic derivation unsupported for {name}")
+
+
+def derive_keypair(private: SchemePrivateKey, seed: bytes) -> KeyPair:
+    """Derive a child keypair from a parent private key + seed (HKDF-style,
+    reference Crypto.kt deriveKeyPair)."""
+    scheme = find_signature_scheme(private.scheme_code_name)
+    return derive_keypair_from_entropy(scheme, private.encoded + seed)
+
+
+# --- sign / verify ----------------------------------------------------------
+
+def do_sign(private: SchemePrivateKey, clear_data: bytes) -> bytes:
+    if len(clear_data) == 0:
+        raise CryptoError("signing of an empty array is not permitted")
+    name = private.scheme_code_name
+    if name == EDDSA_ED25519_SHA512.scheme_code_name:
+        return ed25519.Ed25519PrivateKey.from_private_bytes(private.encoded).sign(clear_data)
+    if name in _EC_CURVES:
+        jca_curve, _ = _EC_CURVES[name]
+        d = int.from_bytes(private.encoded, "big")
+        return ec.derive_private_key(d, jca_curve).sign(clear_data, ec.ECDSA(hashes.SHA256()))
+    if name == RSA_SHA256.scheme_code_name:
+        priv = serialization.load_der_private_key(private.encoded, password=None)
+        return priv.sign(clear_data, padding.PKCS1v15(), hashes.SHA256())
+    if name == SPHINCS256_SHA256.scheme_code_name:
+        from . import sphincs
+
+        return sphincs.sign(private, clear_data)
+    raise UnsupportedSchemeError(f"cannot sign with {name}")
+
+
+def do_verify(public: PublicKey, signature: bytes, clear_data: bytes) -> bool:
+    """Verify and THROW SignatureError if invalid (reference Crypto.doVerify)."""
+    if len(signature) == 0:
+        raise CryptoError("verification of an empty signature is not permitted")
+    if len(clear_data) == 0:
+        raise CryptoError("verification of an empty payload is not permitted")
+    if not is_valid(public, signature, clear_data):
+        raise SignatureError(
+            f"signature verification failed for scheme {public.scheme_code_name}"
+        )
+    return True
+
+
+def is_valid(public: PublicKey, signature: bytes, clear_data: bytes) -> bool:
+    """Boolean verify, never throws on bad signature (reference Crypto.isValid)."""
+    import struct as _struct
+
+    name = public.scheme_code_name
+    try:
+        if name == EDDSA_ED25519_SHA512.scheme_code_name:
+            ed25519.Ed25519PublicKey.from_public_bytes(public.encoded).verify(
+                signature, clear_data
+            )
+            return True
+        if name in _EC_CURVES:
+            jca_curve, _ = _EC_CURVES[name]
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(jca_curve, public.encoded)
+            pub.verify(signature, clear_data, ec.ECDSA(hashes.SHA256()))
+            return True
+        if name == RSA_SHA256.scheme_code_name:
+            pub = serialization.load_der_public_key(public.encoded)
+            pub.verify(signature, clear_data, padding.PKCS1v15(), hashes.SHA256())
+            return True
+        if name == SPHINCS256_SHA256.scheme_code_name:
+            from . import sphincs
+
+            return sphincs.verify(public, signature, clear_data)
+        if name == COMPOSITE_KEY.scheme_code_name:
+            from .composite import CompositeKey, CompositeSignaturesWithKeys
+
+            if not isinstance(public, CompositeKey):
+                return False  # scheme tag lies about the key's structure
+            sigs = CompositeSignaturesWithKeys.deserialize(signature)
+            return public.verify_composite(sigs, clear_data)
+    except (InvalidSignature, ValueError, AssertionError, IndexError, _struct.error):
+        return False
+    raise UnsupportedSchemeError(f"cannot verify with {name}")
+
+
+# --- validation helpers -----------------------------------------------------
+
+def public_key_on_curve(public: PublicKey) -> bool:
+    """Point-validation (reference Crypto.publicKeyOnCurve Crypto.kt:859-871)."""
+    name = public.scheme_code_name
+    if name == EDDSA_ED25519_SHA512.scheme_code_name:
+        pt = ed25519_math.point_decompress(public.encoded)
+        return pt is not None and ed25519_math.is_on_curve(pt)
+    if name in _EC_CURVES:
+        _, curve = _EC_CURVES[name]
+        try:
+            pt = curve.decode_point(public.encoded)
+        except ValueError:
+            return False
+        return pt is not None and curve.contains(pt)
+    return True  # not a curve-based key
+
+
+def entropy_to_keypair(entropy: int) -> KeyPair:
+    """Fixed-entropy test identities (reference TestConstants.entropyToKeyPair)."""
+    return derive_keypair_from_entropy(EDDSA_ED25519_SHA512, entropy)

@@ -1,0 +1,186 @@
+"""Pure-Python ed25519 (RFC 8032) field/point math.
+
+Roles:
+  * host-side correctness oracle for the JAX/TPU batch kernel (corda_tpu.ops.ed25519),
+  * deterministic key derivation from entropy (reference parity:
+    `core/.../crypto/Crypto.kt:718-739` deriveKeyPairFromEntropy),
+  * point decompression / limb packing that prepares batches for the TPU kernel
+    (decompression is cheap and data-dependent; the double-scalar-mul is the
+    FLOP-heavy uniform part that belongs on the accelerator).
+
+Parity: the reference binds ed25519 to net.i2p.crypto.eddsa
+(`core/src/main/kotlin/net/corda/core/crypto/Crypto.kt:119-132`).
+Implemented here from the public RFC 8032 specification.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+# --- field -----------------------------------------------------------------
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P  # Edwards curve constant
+SQRT_M1 = pow(2, (P - 1) // 4, P)          # sqrt(-1) mod p
+
+
+def inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+# --- points: extended homogeneous coordinates (X, Y, Z, T), x=X/Z y=Y/Z xy=T/Z
+Point = Tuple[int, int, int, int]
+
+IDENTITY: Point = (0, 1, 1, 0)
+
+# Base point
+_By = 4 * inv(5) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * inv(D * y * y + 1) % P
+    if x2 == 0:
+        if sign:
+            return None
+        return 0
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return x
+
+
+_Bx = _recover_x(_By, 0)
+BASE: Point = (_Bx, _By, 1, _Bx * _By % P)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * T1 * T2 * D % P
+    Dd = 2 * Z1 * Z2 % P
+    E, F, G, H = B - A, Dd - C, Dd + C, B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_double(p: Point) -> Point:
+    # dedicated doubling (hisil et al. formula); same result as point_add(p, p)
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = (A + B) % P
+    E = (H - (X1 + Y1) * (X1 + Y1)) % P
+    G = (A - B) % P
+    F = (C + G) % P
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def scalar_mult(s: int, p: Point) -> Point:
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p)
+        p = point_double(p)
+        s >>= 1
+    return q
+
+
+def point_equal(p: Point, q: Point) -> bool:
+    # x1/z1 == x2/z2  and  y1/z1 == y2/z2
+    return (p[0] * q[2] - q[0] * p[2]) % P == 0 and (p[1] * q[2] - q[1] * p[2]) % P == 0
+
+
+def point_compress(p: Point) -> bytes:
+    zinv = inv(p[2])
+    x = p[0] * zinv % P
+    y = p[1] * zinv % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def point_decompress(s: bytes) -> Point | None:
+    if len(s) != 32:
+        return None
+    y = int.from_bytes(s, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def is_on_curve(p: Point) -> bool:
+    X, Y, Z, T = p
+    # -x^2 + y^2 = z^2 + d*t^2  with  x*y = z*t
+    return (
+        (-X * X + Y * Y - Z * Z - D * T * T) % P == 0
+        and (X * Y - Z * T) % P == 0
+    )
+
+
+# --- EdDSA sign/verify (RFC 8032 Ed25519, SHA-512) -------------------------
+
+def _sha512_int(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little")
+
+
+def secret_expand(seed: bytes) -> Tuple[int, bytes]:
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    a, _ = secret_expand(seed)
+    return point_compress(scalar_mult(a, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(seed)
+    A = point_compress(scalar_mult(a, BASE))
+    r = _sha512_int(prefix, msg) % L
+    Rp = scalar_mult(r, BASE)
+    Rs = point_compress(Rp)
+    h = _sha512_int(Rs, A, msg) % L
+    s = (r + h * a) % L
+    return Rs + s.to_bytes(32, "little")
+
+
+def verify(public: bytes, msg: bytes, signature: bytes) -> bool:
+    if len(public) != 32 or len(signature) != 64:
+        return False
+    A = point_decompress(public)
+    if A is None:
+        return False
+    Rs = signature[:32]
+    Rp = point_decompress(Rs)
+    if Rp is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= L:
+        return False
+    h = _sha512_int(Rs, public, msg) % L
+    # [s]B == R + [h]A   (unbatched cofactorless check, matching i2p/ref10)
+    sB = scalar_mult(s, BASE)
+    hA = scalar_mult(h, A)
+    return point_equal(sB, point_add(Rp, hA))
+
+
+def to_affine(p: Point) -> Tuple[int, int]:
+    zinv = inv(p[2])
+    return p[0] * zinv % P, p[1] * zinv % P

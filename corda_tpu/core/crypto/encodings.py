@@ -1,0 +1,58 @@
+"""base58 / base64 / hex encoding helpers.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/crypto/EncodingUtils.kt`.
+"""
+from __future__ import annotations
+
+import base64
+
+_B58_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_B58_INDEX = {c: i for i, c in enumerate(_B58_ALPHABET)}
+
+
+def to_base58(data: bytes) -> str:
+    n = int.from_bytes(data, "big")
+    out = []
+    while n > 0:
+        n, r = divmod(n, 58)
+        out.append(_B58_ALPHABET[r])
+    # preserve leading zero bytes
+    pad = 0
+    for b in data:
+        if b == 0:
+            pad += 1
+        else:
+            break
+    return "1" * pad + "".join(reversed(out))
+
+
+def from_base58(s: str) -> bytes:
+    n = 0
+    for c in s:
+        if c not in _B58_INDEX:
+            raise ValueError(f"invalid base58 character {c!r}")
+        n = n * 58 + _B58_INDEX[c]
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big") if n else b""
+    pad = 0
+    for c in s:
+        if c == "1":
+            pad += 1
+        else:
+            break
+    return b"\x00" * pad + raw
+
+
+def to_base64(data: bytes) -> str:
+    return base64.b64encode(data).decode("ascii")
+
+
+def from_base64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def to_hex(data: bytes) -> str:
+    return data.hex().upper()
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s)

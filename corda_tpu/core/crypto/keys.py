@@ -1,0 +1,65 @@
+"""Key model: scheme-tagged public/private keys with canonical encodings.
+
+Unlike the reference, which leans on JCA `PublicKey`/`PrivateKey` objects and
+X.509/PKCS#8 DER (`core/.../crypto/Crypto.kt:253-392`), keys here are small
+immutable value objects carrying (scheme code name, canonical raw encoding).
+Canonical encodings are chosen for batch-kernel friendliness:
+
+  EDDSA_ED25519_SHA512 : 32-byte RFC 8032 compressed point / 32-byte seed
+  ECDSA_SECP256K1/R1   : 33-byte SEC1 compressed point / 32-byte BE scalar
+  RSA_SHA256           : DER SubjectPublicKeyInfo / PKCS#8 DER
+  SPHINCS-256_SHA512   : scheme-defined (see sphincs.py)
+  COMPOSITE            : canonical serialization of the key tree (composite.py)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import FrozenSet, Iterable, NamedTuple
+
+
+class PublicKey:
+    """Base public-key type. Leaf keys are SchemePublicKey; CompositeKey nests."""
+
+    scheme_code_name: str
+    encoded: bytes
+
+    # -- composite-aware helpers (reference CryptoUtils.kt:78-110) ----------
+    @property
+    def keys(self) -> FrozenSet["PublicKey"]:
+        """The set of leaf keys underlying this key (singleton for leaves)."""
+        return frozenset([self])
+
+    def is_fulfilled_by(self, keys: Iterable["PublicKey"]) -> bool:
+        ks = set(keys)
+        return self in ks
+
+    def contains_any(self, other_keys: Iterable["PublicKey"]) -> bool:
+        return not self.keys.isdisjoint(set(other_keys))
+
+    def to_base58_string(self) -> str:
+        from .encodings import to_base58
+
+        return to_base58(self.encoded)
+
+
+@dataclass(frozen=True)
+class SchemePublicKey(PublicKey):
+    scheme_code_name: str
+    encoded: bytes
+
+    def __repr__(self) -> str:
+        return f"{self.scheme_code_name}:{self.encoded.hex()[:16]}"
+
+
+@dataclass(frozen=True)
+class SchemePrivateKey:
+    scheme_code_name: str
+    encoded: bytes
+
+    def __repr__(self) -> str:  # never print private material
+        return f"<private {self.scheme_code_name}>"
+
+
+class KeyPair(NamedTuple):
+    public: PublicKey
+    private: SchemePrivateKey

@@ -1,0 +1,151 @@
+"""Merkle trees and partial Merkle proofs.
+
+Parity: reference `core/.../crypto/MerkleTree.kt:27-68` (bottom-up SHA-256 tree,
+leaf list zero-padded to a power of two) and `PartialMerkleTree.kt:44-157`
+(tear-off proofs for FilteredTransaction).
+
+The host implementation here is the semantic definition; batched SHA-256 tree
+construction for large component sets runs on TPU via corda_tpu.ops.sha256.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Union
+
+from .secure_hash import SecureHash, ZERO_HASH
+
+
+class MerkleTreeError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class MerkleTree:
+    hash: SecureHash
+    left: "MerkleTree | None" = None
+    right: "MerkleTree | None" = None
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left is None and self.right is None
+
+    @staticmethod
+    def get_merkle_tree(all_leaves_hashes: Sequence[SecureHash]) -> "MerkleTree":
+        if not all_leaves_hashes:
+            raise MerkleTreeError("cannot build a Merkle tree with no leaves")
+        leaves = _pad_to_power_of_two(list(all_leaves_hashes))
+        level = [MerkleTree(h) for h in leaves]
+        while len(level) > 1:
+            nxt = []
+            for i in range(0, len(level), 2):
+                l, r = level[i], level[i + 1]
+                nxt.append(MerkleTree(l.hash.hash_concat(r.hash), l, r))
+            level = nxt
+        return level[0]
+
+
+def _pad_to_power_of_two(leaves: List[SecureHash]) -> List[SecureHash]:
+    n = 1
+    while n < len(leaves):
+        n *= 2
+    return leaves + [ZERO_HASH] * (n - len(leaves))
+
+
+# --- partial tree -----------------------------------------------------------
+
+@dataclass(frozen=True)
+class PartialLeaf:
+    """Included leaf whose hash the verifier recomputes from revealed data."""
+    hash: SecureHash
+
+
+@dataclass(frozen=True)
+class HiddenLeaf:
+    """A pruned subtree, represented only by its hash.
+
+    leaf_span records how many original leaves the collapsed subtree covers so
+    that leaf_index can map included leaves back to their true positions.
+    """
+    hash: SecureHash
+    leaf_span: int = 1
+
+
+@dataclass(frozen=True)
+class PartialNode:
+    left: "PartialTreeNode"
+    right: "PartialTreeNode"
+
+
+PartialTreeNode = Union[PartialLeaf, HiddenLeaf, PartialNode]
+
+
+@dataclass(frozen=True)
+class PartialMerkleTree:
+    root: PartialTreeNode
+
+    @staticmethod
+    def build(merkle_root: MerkleTree, included_hashes: Sequence[SecureHash]) -> "PartialMerkleTree":
+        included = set(included_hashes)
+        used: set = set()
+        tree = _build_partial(merkle_root, included, used)
+        missing = included - used
+        if missing:
+            raise MerkleTreeError(f"hashes not found in tree: {missing}")
+        return PartialMerkleTree(tree)
+
+    def verify(self, expected_root: SecureHash, hashes_to_check: Sequence[SecureHash]) -> bool:
+        found: List[SecureHash] = []
+        root_hash = _root_and_collect(self.root, found)
+        if root_hash != expected_root:
+            return False
+        return sorted(h.bytes for h in found) == sorted(h.bytes for h in hashes_to_check)
+
+    def leaf_index(self, leaf_hash: SecureHash) -> int:
+        """Position of an included leaf in the original tree (left-to-right)."""
+        idx = _leaf_index(self.root, leaf_hash, 0)
+        if idx is None:
+            raise MerkleTreeError("leaf not included in partial tree")
+        return idx
+
+
+def _build_partial(node: MerkleTree, included: set, used: set) -> PartialTreeNode:
+    if node.is_leaf:
+        if node.hash in included:
+            used.add(node.hash)
+            return PartialLeaf(node.hash)
+        return HiddenLeaf(node.hash)
+    left = _build_partial(node.left, included, used)
+    right = _build_partial(node.right, included, used)
+    if isinstance(left, HiddenLeaf) and isinstance(right, HiddenLeaf):
+        return HiddenLeaf(node.hash, left.leaf_span + right.leaf_span)
+    return PartialNode(left, right)
+
+
+def _root_and_collect(node: PartialTreeNode, found: List[SecureHash]) -> SecureHash:
+    if isinstance(node, PartialLeaf):
+        found.append(node.hash)
+        return node.hash
+    if isinstance(node, HiddenLeaf):
+        return node.hash
+    return _root_and_collect(node.left, found).hash_concat(
+        _root_and_collect(node.right, found)
+    )
+
+
+def _leaf_count(node: PartialTreeNode) -> int:
+    if isinstance(node, PartialLeaf):
+        return 1
+    if isinstance(node, HiddenLeaf):
+        return node.leaf_span
+    return _leaf_count(node.left) + _leaf_count(node.right)
+
+
+def _leaf_index(node: PartialTreeNode, target: SecureHash, base: int):
+    if isinstance(node, PartialLeaf):
+        return base if node.hash == target else None
+    if isinstance(node, HiddenLeaf):
+        return None
+    left_idx = _leaf_index(node.left, target, base)
+    if left_idx is not None:
+        return left_idx
+    return _leaf_index(node.right, target, base + _leaf_count(node.left))

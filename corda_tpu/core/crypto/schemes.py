@@ -1,0 +1,66 @@
+"""SignatureScheme descriptors and the supported-scheme registry.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/crypto/SignatureScheme.kt`
+and the registry in `Crypto.kt:176-183`. Scheme numeric IDs and code names are
+kept identical so serialized metadata stays interoperable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class SignatureScheme:
+    scheme_number_id: int
+    scheme_code_name: str
+    algorithm_name: str
+    desc: str
+    key_size: int | None
+
+
+RSA_SHA256 = SignatureScheme(
+    1, "RSA_SHA256", "RSA",
+    "RSA_SHA256 signature scheme using SHA256 as hash algorithm.", 3072,
+)
+ECDSA_SECP256K1_SHA256 = SignatureScheme(
+    2, "ECDSA_SECP256K1_SHA256", "ECDSA",
+    "ECDSA signature scheme using the secp256k1 Koblitz curve.", 256,
+)
+ECDSA_SECP256R1_SHA256 = SignatureScheme(
+    3, "ECDSA_SECP256R1_SHA256", "ECDSA",
+    "ECDSA signature scheme using the secp256r1 (NIST P-256) curve.", 256,
+)
+EDDSA_ED25519_SHA512 = SignatureScheme(
+    4, "EDDSA_ED25519_SHA512", "EdDSA",
+    "EdDSA signature scheme using the ed25519 twisted Edwards curve.", 256,
+)
+SPHINCS256_SHA256 = SignatureScheme(
+    5, "SPHINCS-256_SHA512", "SPHINCS256",
+    "SPHINCS-256 hash-based signature scheme. It provides 128bit security "
+    "against post-quantum attackers at the cost of larger key sizes and loss "
+    "of compatibility.", 256,
+)
+COMPOSITE_KEY = SignatureScheme(
+    6, "COMPOSITE", "COMPOSITE",
+    "Composite keys composed from multiple signature schemes, to enable a "
+    "flexible fusion of different signature schemes.", None,
+)
+
+SUPPORTED_SIGNATURE_SCHEMES: Dict[str, SignatureScheme] = {
+    s.scheme_code_name: s
+    for s in (
+        RSA_SHA256,
+        ECDSA_SECP256K1_SHA256,
+        ECDSA_SECP256R1_SHA256,
+        EDDSA_ED25519_SHA512,
+        SPHINCS256_SHA256,
+        COMPOSITE_KEY,
+    )
+}
+
+SCHEMES_BY_ID: Dict[int, SignatureScheme] = {
+    s.scheme_number_id: s for s in SUPPORTED_SIGNATURE_SCHEMES.values()
+}
+
+DEFAULT_SIGNATURE_SCHEME = EDDSA_ED25519_SHA512

@@ -1,0 +1,233 @@
+"""Pure-Python short-Weierstrass curve math: secp256k1 and secp256r1 ECDSA.
+
+Roles: host-side oracle for the JAX batch kernels (corda_tpu.ops.secp256),
+deterministic key derivation, and point decompression for kernel prep.
+
+Parity: the reference binds ECDSA to BouncyCastle
+(`core/src/main/kotlin/net/corda/core/crypto/Crypto.kt:91-117`); signatures are
+ASN.1 DER (r, s) as produced by the JCA. Implemented from the public SEC 2 /
+FIPS 186-4 specifications (RFC 6979 deterministic nonces for signing).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+Affine = Optional[Tuple[int, int]]  # None = point at infinity
+
+
+@dataclass(frozen=True)
+class Curve:
+    name: str
+    p: int   # field prime
+    a: int
+    b: int
+    gx: int
+    gy: int
+    n: int   # group order
+    h: int   # cofactor
+
+    def contains(self, pt: Affine) -> bool:
+        if pt is None:
+            return True
+        x, y = pt
+        return (y * y - (x * x * x + self.a * x + self.b)) % self.p == 0
+
+    # -- group law (affine; fine for an oracle) -----------------------------
+    def add(self, p1: Affine, p2: Affine) -> Affine:
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        x1, y1 = p1
+        x2, y2 = p2
+        if x1 == x2 and (y1 + y2) % self.p == 0:
+            return None
+        if p1 == p2:
+            lam = (3 * x1 * x1 + self.a) * pow(2 * y1, self.p - 2, self.p) % self.p
+        else:
+            lam = (y2 - y1) * pow(x2 - x1, self.p - 2, self.p) % self.p
+        x3 = (lam * lam - x1 - x2) % self.p
+        y3 = (lam * (x1 - x3) - y1) % self.p
+        return (x3, y3)
+
+    def mul(self, k: int, pt: Affine) -> Affine:
+        acc: Affine = None
+        while k > 0:
+            if k & 1:
+                acc = self.add(acc, pt)
+            pt = self.add(pt, pt)
+            k >>= 1
+        return acc
+
+    @property
+    def g(self) -> Affine:
+        return (self.gx, self.gy)
+
+    # -- encoding -----------------------------------------------------------
+    def encode_point(self, pt: Affine, compressed: bool = True) -> bytes:
+        if pt is None:
+            return b"\x00"
+        x, y = pt
+        if compressed:
+            return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+        return b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+    def decode_point(self, data: bytes) -> Affine:
+        if data == b"\x00":
+            return None
+        if data[0] == 4:
+            x = int.from_bytes(data[1:33], "big")
+            y = int.from_bytes(data[33:65], "big")
+            pt = (x, y)
+            if not self.contains(pt):
+                raise ValueError("point not on curve")
+            return pt
+        if data[0] in (2, 3):
+            x = int.from_bytes(data[1:33], "big")
+            if x >= self.p:
+                raise ValueError("x out of range")
+            rhs = (x * x * x + self.a * x + self.b) % self.p
+            y = self.sqrt(rhs)
+            if y is None:
+                raise ValueError("not a quadratic residue")
+            if (y & 1) != (data[0] & 1):
+                y = self.p - y
+            return (x, y)
+        raise ValueError("bad point encoding")
+
+    def sqrt(self, v: int) -> Optional[int]:
+        # both secp256k1 and secp256r1 have p % 4 == 3
+        r = pow(v, (self.p + 1) // 4, self.p)
+        if r * r % self.p != v % self.p:
+            return None
+        return r
+
+
+SECP256K1 = Curve(
+    name="secp256k1",
+    p=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F,
+    a=0,
+    b=7,
+    gx=0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    gy=0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+    n=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141,
+    h=1,
+)
+
+SECP256R1 = Curve(
+    name="secp256r1",
+    p=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF,
+    a=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFC,
+    b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+    gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+    gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+    n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551,
+    h=1,
+)
+
+
+# --- ECDSA ------------------------------------------------------------------
+
+def _bits2int(data: bytes, n: int) -> int:
+    v = int.from_bytes(data, "big")
+    excess = len(data) * 8 - n.bit_length()
+    if excess > 0:
+        v >>= excess
+    return v
+
+
+def rfc6979_nonce(curve: Curve, priv: int, digest: bytes) -> int:
+    """Deterministic nonce per RFC 6979 (HMAC-SHA256)."""
+    qlen_bytes = (curve.n.bit_length() + 7) // 8
+    h1 = _bits2int(digest, curve.n) % curve.n
+    x_b = priv.to_bytes(qlen_bytes, "big")
+    h1_b = h1.to_bytes(qlen_bytes, "big")
+    V = b"\x01" * 32
+    K = b"\x00" * 32
+    K = hmac.new(K, V + b"\x00" + x_b + h1_b, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x01" + x_b + h1_b, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    while True:
+        T = b""
+        while len(T) < qlen_bytes:
+            V = hmac.new(K, V, hashlib.sha256).digest()
+            T += V
+        k = _bits2int(T[:qlen_bytes], curve.n)
+        if 1 <= k < curve.n:
+            return k
+        K = hmac.new(K, V + b"\x00", hashlib.sha256).digest()
+        V = hmac.new(K, V, hashlib.sha256).digest()
+
+
+def ecdsa_sign(curve: Curve, priv: int, msg: bytes) -> Tuple[int, int]:
+    digest = hashlib.sha256(msg).digest()
+    z = _bits2int(digest, curve.n)
+    while True:
+        k = rfc6979_nonce(curve, priv, digest)
+        pt = curve.mul(k, curve.g)
+        r = pt[0] % curve.n
+        if r == 0:
+            continue
+        s = (z + r * priv) * pow(k, curve.n - 2, curve.n) % curve.n
+        if s == 0:
+            continue
+        # low-s normalisation (matches BouncyCastle/ canonical signatures)
+        if s > curve.n // 2:
+            s = curve.n - s
+        return (r, s)
+
+
+def ecdsa_verify(curve: Curve, pub: Affine, msg: bytes, r: int, s: int) -> bool:
+    if pub is None or not curve.contains(pub):
+        return False
+    if not (1 <= r < curve.n and 1 <= s < curve.n):
+        return False
+    digest = hashlib.sha256(msg).digest()
+    z = _bits2int(digest, curve.n)
+    w = pow(s, curve.n - 2, curve.n)
+    u1 = z * w % curve.n
+    u2 = r * w % curve.n
+    pt = curve.add(curve.mul(u1, curve.g), curve.mul(u2, pub))
+    if pt is None:
+        return False
+    return pt[0] % curve.n == r
+
+
+# --- DER (r,s) encoding, as emitted by JCA/BouncyCastle ---------------------
+
+def der_encode_sig(r: int, s: int) -> bytes:
+    def _int(v: int) -> bytes:
+        b = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+        if b[0] & 0x80:
+            b = b"\x00" + b
+        return b"\x02" + bytes([len(b)]) + b
+
+    body = _int(r) + _int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def der_decode_sig(data: bytes) -> Tuple[int, int]:
+    if len(data) < 8 or data[0] != 0x30:
+        raise ValueError("bad DER signature")
+    if data[1] != len(data) - 2:
+        raise ValueError("bad DER length")
+    i = 2
+
+    def _int() -> int:
+        nonlocal i
+        if data[i] != 0x02:
+            raise ValueError("expected DER INTEGER")
+        ln = data[i + 1]
+        v = int.from_bytes(data[i + 2 : i + 2 + ln], "big")
+        i += 2 + ln
+        return v
+
+    r = _int()
+    s = _int()
+    if i != len(data):
+        raise ValueError("trailing DER bytes")
+    return r, s

@@ -1,0 +1,81 @@
+"""SecureHash: content-addressing value type.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/crypto/SecureHash.kt:14-49`
+(SHA-256 value type with `sha256`, `hashConcat`, `zeroHash`, `randomSHA256`).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, order=True)
+class SecureHash:
+    """An immutable 32-byte SHA-256 digest identifying some content."""
+
+    bytes: bytes
+
+    SIZE = 32
+
+    def __post_init__(self):
+        if len(self.bytes) != self.SIZE:
+            raise ValueError(f"SecureHash must be {self.SIZE} bytes, got {len(self.bytes)}")
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def sha256(data: bytes) -> "SecureHash":
+        return SecureHash(hashlib.sha256(data).digest())
+
+    @staticmethod
+    def sha256_twice(data: bytes) -> "SecureHash":
+        return SecureHash.sha256(hashlib.sha256(data).digest())
+
+    @staticmethod
+    def parse(hex_str: str) -> "SecureHash":
+        return SecureHash(bytes.fromhex(hex_str))
+
+    @staticmethod
+    def random_sha256() -> "SecureHash":
+        return SecureHash.sha256(os.urandom(32))
+
+    @staticmethod
+    def zero_hash() -> "SecureHash":
+        return SecureHash(b"\x00" * SecureHash.SIZE)
+
+    @staticmethod
+    def all_ones_hash() -> "SecureHash":
+        return SecureHash(b"\xff" * SecureHash.SIZE)
+
+    # -- operations ---------------------------------------------------------
+    def hash_concat(self, other: "SecureHash") -> "SecureHash":
+        """Digest of the concatenation of two hashes (Merkle node combiner)."""
+        return SecureHash.sha256(self.bytes + other.bytes)
+
+    def re_hash(self) -> "SecureHash":
+        return SecureHash.sha256(self.bytes)
+
+    def prefix_chars(self, count: int = 6) -> str:
+        return self.bytes.hex().upper()[:count]
+
+    def __str__(self) -> str:
+        return self.bytes.hex().upper()
+
+    def __repr__(self) -> str:
+        return f"SecureHash({self})"
+
+
+ZERO_HASH = SecureHash.zero_hash()
+ALL_ONES_HASH = SecureHash.all_ones_hash()
+
+
+def secure_random_bytes(n: int) -> bytes:
+    return os.urandom(n)
+
+
+def random_63_bit_value() -> int:
+    """A random positive 63-bit integer (reference CryptoUtils.random63BitValue)."""
+    while True:
+        v = int.from_bytes(os.urandom(8), "big") & 0x7FFF_FFFF_FFFF_FFFF
+        if v != 0:
+            return v

@@ -1,0 +1,126 @@
+"""Signature value types: DigitalSignature, TransactionSignature, MetaData, SignedData.
+
+Parity: reference `core/.../crypto/DigitalSignature.kt:14-47`,
+`MetaData.kt:30-71`, `TransactionSignature.kt:10-21`, `SignedData.kt:16-42`.
+"""
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+from typing import Optional, Set
+
+from . import crypto
+from .composite import _encode_node, decode_composite_key
+from .keys import PublicKey, SchemePrivateKey
+from .secure_hash import SecureHash
+
+
+@dataclass(frozen=True)
+class DigitalSignature:
+    """Raw signature bytes."""
+
+    bytes: bytes
+
+
+@dataclass(frozen=True)
+class DigitalSignatureWithKey(DigitalSignature):
+    """Signature bytes plus the signer's public key.
+
+    Reference `DigitalSignature.WithKey` -- the element type of
+    `SignedTransaction.sigs`, and the unit of work for the TPU batch verifier.
+    """
+
+    by: PublicKey
+
+    def verify(self, content: bytes) -> bool:
+        """Verify or raise (reference WithKey.verify -> Crypto.doVerify)."""
+        return crypto.do_verify(self.by, self.bytes, content)
+
+    def is_valid(self, content: bytes) -> bool:
+        return crypto.is_valid(self.by, self.bytes, content)
+
+    def with_without_key(self) -> DigitalSignature:
+        return DigitalSignature(self.bytes)
+
+
+def sign_bytes(private: SchemePrivateKey, public: PublicKey, content: bytes) -> DigitalSignatureWithKey:
+    return DigitalSignatureWithKey(crypto.do_sign(private, content), public)
+
+
+class SignatureType(enum.IntEnum):
+    FULL = 0
+    PARTIAL = 1
+    BLIND = 2
+
+
+@dataclass(frozen=True)
+class MetaData:
+    """Attached signature metadata, the actual signed payload for
+    metadata-carrying signatures (reference MetaData.kt:30-71)."""
+
+    scheme_code_name: str
+    version_id: str
+    signature_type: SignatureType
+    timestamp: Optional[int]          # unix nanos, None if absent
+    visible_inputs: Optional[bytes]   # bitset over inputs visible to signer
+    signed_inputs: Optional[bytes]    # bitset over inputs signed (PARTIAL)
+    merkle_root: bytes
+    public_key: PublicKey
+
+    def bytes(self) -> bytes:
+        """Canonical byte form over which the signature is computed."""
+
+        def _opt(b: Optional[bytes]) -> bytes:
+            if b is None:
+                return struct.pack(">i", -1)
+            return struct.pack(">i", len(b)) + b
+
+        name = self.scheme_code_name.encode()
+        ver = self.version_id.encode()
+        key_enc = _encode_node(self.public_key)
+        return b"".join(
+            [
+                struct.pack(">I", len(name)), name,
+                struct.pack(">I", len(ver)), ver,
+                struct.pack(">B", int(self.signature_type)),
+                struct.pack(">q", -1 if self.timestamp is None else self.timestamp),
+                _opt(self.visible_inputs),
+                _opt(self.signed_inputs),
+                struct.pack(">I", len(self.merkle_root)), self.merkle_root,
+                struct.pack(">I", len(key_enc)), key_enc,
+            ]
+        )
+
+
+@dataclass(frozen=True)
+class TransactionSignature(DigitalSignature):
+    """Signature over a MetaData blob (reference TransactionSignature.kt)."""
+
+    meta_data: MetaData
+
+    def verify(self) -> bool:
+        return crypto.do_verify(self.meta_data.public_key, self.bytes, self.meta_data.bytes())
+
+    def is_valid(self) -> bool:
+        return crypto.is_valid(self.meta_data.public_key, self.bytes, self.meta_data.bytes())
+
+
+class SignedData:
+    """A serialized payload plus a signature over it; `verified()` checks the
+    signature then deserializes (reference SignedData.kt:16-42)."""
+
+    def __init__(self, raw: bytes, sig: DigitalSignatureWithKey):
+        self.raw = raw
+        self.sig = sig
+
+    def verified(self):
+        self.sig.verify(self.raw)
+        from ..serialization.codec import deserialize
+
+        data = deserialize(self.raw)
+        self.verify_data(data)
+        return data
+
+    def verify_data(self, data) -> None:
+        """Hook for subclasses: extra semantic checks (e.g. signer authority)."""

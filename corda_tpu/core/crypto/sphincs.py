@@ -1,0 +1,29 @@
+"""SPHINCS-256 hash-based post-quantum signatures (scheme id 5).
+
+Parity target: reference binds SPHINCS-256 to BouncyCastle PQC
+(`core/.../crypto/Crypto.kt:134-151`, scheme "SPHINCS-256_SHA512").
+
+STATUS: registry entry is live (id/code name preserved for metadata compat)
+but the algorithm implementation is scheduled for a later milestone -- a
+faithful SPHINCS-256 (WOTS+ hypertree over HORST few-time signatures) is
+pure host-side code with no TPU interaction and does not gate any other
+component. Until then all entry points raise UnsupportedSchemeError.
+"""
+from __future__ import annotations
+
+from .crypto import UnsupportedSchemeError
+from .keys import KeyPair, PublicKey, SchemePrivateKey
+
+_MSG = "SPHINCS-256 implementation lands in a later milestone (see module docstring)"
+
+
+def generate_keypair() -> KeyPair:
+    raise UnsupportedSchemeError(_MSG)
+
+
+def sign(private: SchemePrivateKey, data: bytes) -> bytes:
+    raise UnsupportedSchemeError(_MSG)
+
+
+def verify(public: PublicKey, signature: bytes, data: bytes) -> bool:
+    raise UnsupportedSchemeError(_MSG)

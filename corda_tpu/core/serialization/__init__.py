@@ -1,0 +1,28 @@
+"""corda_tpu.core.serialization: one deterministic, schema'd wire format.
+
+The reference carries two serialization stacks -- prototype-grade Kryo
+(`core/.../serialization/Kryo.kt`, explicitly insecure/slow) and an incubating
+AMQP scheme (`core/.../serialization/amqp/`). This framework has exactly one:
+a canonical tagged binary format with a whitelist-based type registry
+(reference parity: `@CordaSerializable` / `CordaClassResolver.kt` whitelist
+enforcement). Canonical means byte-identical across processes and platforms,
+because transaction ids are Merkle roots over serialized components.
+"""
+from .codec import (
+    SerializationError,
+    corda_serializable,
+    deserialize,
+    register_adapter,
+    serialize,
+)
+from .context import SerializationContext, UseCase
+
+__all__ = [
+    "SerializationError",
+    "corda_serializable",
+    "deserialize",
+    "register_adapter",
+    "serialize",
+    "SerializationContext",
+    "UseCase",
+]

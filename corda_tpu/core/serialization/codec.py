@@ -1,0 +1,375 @@
+"""Canonical tagged binary codec with a whitelisted type registry.
+
+Design requirements (why not msgpack/pickle):
+  * DETERMINISTIC: map keys and object fields are emitted in sorted order,
+    integers have a single encoding, no implementation-defined float quirks.
+    Transaction ids are Merkle roots over these bytes (reference parity:
+    `WireTransaction.kt:39,104`), so byte-stability is a consensus property.
+  * WHITELISTED: only registered types deserialize (reference parity:
+    `CordaClassResolver.kt` whitelist enforcement; `Kryo.kt:45-74` documents
+    why open deserialization is an RCE hole).
+  * SELF-DESCRIBING: objects carry their type name, so external processes (the
+    verifier sidecar, RPC clients) can decode without a schema side-channel.
+
+Wire grammar (all varints are unsigned LEB128; ints are zigzag-LEB128):
+  value := NULL | TRUE | FALSE
+         | INT <zigzag varint>
+         | BYTES <len> <raw>
+         | STR <len> <utf8>
+         | LIST <count> value*
+         | MAP <count> (value value)*     # keys sorted by encoded bytes
+         | OBJ <typename: len utf8> <field count> (fieldname value)*  # sorted
+         | F64 <8 bytes big-endian IEEE754>  # NaN/-0.0 rejected
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Callable, Dict, Tuple, Type
+
+_NULL, _TRUE, _FALSE, _INT, _BYTES, _STR, _LIST, _MAP, _OBJ, _F64 = range(10)
+
+_MAGIC = b"CT\x01"  # corda_tpu serialization, format version 1
+
+# Maximum container nesting; bounds stack depth against hostile wire data.
+_MAX_DEPTH = 100
+
+
+class SerializationError(Exception):
+    pass
+
+
+# --- type registry ----------------------------------------------------------
+
+# type -> (type_name, to_dict, from_dict)
+_BY_TYPE: Dict[Type, Tuple[str, Callable[[Any], dict], Callable[[dict], Any]]] = {}
+_BY_NAME: Dict[str, Tuple[Type, Callable[[Any], dict], Callable[[dict], Any]]] = {}
+
+
+def register_adapter(
+    cls: Type,
+    type_name: str,
+    to_dict: Callable[[Any], dict],
+    from_dict: Callable[[dict], Any],
+) -> None:
+    """Register a custom (non-dataclass) type with explicit converters."""
+    if type_name in _BY_NAME and _BY_NAME[type_name][0] is not cls:
+        raise SerializationError(f"type name {type_name!r} already registered")
+    _BY_TYPE[cls] = (type_name, to_dict, from_dict)
+    _BY_NAME[type_name] = (cls, to_dict, from_dict)
+
+
+def corda_serializable(cls=None, *, name: str | None = None):
+    """Class decorator whitelisting a dataclass for serialization.
+
+    Parity: reference `@CordaSerializable` annotation. Fields are taken from
+    the dataclass definition; the wire type name defaults to the qualified
+    class name (module-independent simple path keeps refactors cheap).
+    """
+
+    def wrap(c):
+        if not dataclasses.is_dataclass(c):
+            raise SerializationError(f"{c} must be a dataclass to be @corda_serializable")
+        type_name = name or c.__qualname__
+        field_names = [f.name for f in dataclasses.fields(c)]
+
+        def to_dict(obj):
+            return {fn: getattr(obj, fn) for fn in field_names}
+
+        def from_dict(d):
+            return c(**d)
+
+        register_adapter(c, type_name, to_dict, from_dict)
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+# --- varint helpers ---------------------------------------------------------
+
+def _write_uvarint(out: bytearray, v: int) -> None:
+    if v < 0:
+        raise SerializationError("uvarint cannot encode negatives")
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(data):
+            raise SerializationError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 640:
+            raise SerializationError("varint too long")
+
+
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> (v.bit_length() + 1)) if v < 0 else v << 1
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+# --- encode -----------------------------------------------------------------
+
+def _encode(out: bytearray, value: Any, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise SerializationError(f"nesting deeper than {_MAX_DEPTH}")
+    if value is None:
+        out.append(_NULL)
+    elif value is True:
+        out.append(_TRUE)
+    elif value is False:
+        out.append(_FALSE)
+    elif isinstance(value, int):
+        out.append(_INT)
+        _write_uvarint(out, _zigzag(value))
+    elif isinstance(value, (bytes, bytearray, memoryview)):
+        out.append(_BYTES)
+        raw = bytes(value)
+        _write_uvarint(out, len(raw))
+        out.extend(raw)
+    elif isinstance(value, str):
+        out.append(_STR)
+        raw = value.encode("utf-8")
+        _write_uvarint(out, len(raw))
+        out.extend(raw)
+    elif isinstance(value, float):
+        if value != value or (value == 0.0 and str(value)[0] == "-"):
+            raise SerializationError("NaN and -0.0 are not canonical")
+        out.append(_F64)
+        out.extend(struct.pack(">d", value))
+    elif isinstance(value, (list, tuple)):
+        out.append(_LIST)
+        _write_uvarint(out, len(value))
+        for item in value:
+            _encode(out, item, depth + 1)
+    elif isinstance(value, (dict,)):
+        out.append(_MAP)
+        _write_uvarint(out, len(value))
+        encoded_pairs = []
+        for k, v in value.items():
+            kb = bytearray()
+            _encode(kb, k, depth + 1)
+            vb = bytearray()
+            _encode(vb, v, depth + 1)
+            encoded_pairs.append((bytes(kb), bytes(vb)))
+        for kb, vb in sorted(encoded_pairs):
+            out.extend(kb)
+            out.extend(vb)
+    elif isinstance(value, (set, frozenset)):
+        # canonical set = sorted LIST (decodes as list; registered wrappers
+        # that need set semantics convert in from_dict)
+        items = []
+        for item in value:
+            ib = bytearray()
+            _encode(ib, item, depth + 1)
+            items.append(bytes(ib))
+        out.append(_LIST)
+        _write_uvarint(out, len(items))
+        for ib in sorted(items):
+            out.extend(ib)
+    else:
+        entry = _lookup_type(type(value))
+        if entry is None:
+            raise SerializationError(
+                f"type {type(value).__qualname__} is not @corda_serializable/registered"
+            )
+        type_name, to_dict, _ = entry
+        fields = to_dict(value)
+        out.append(_OBJ)
+        name_raw = type_name.encode("utf-8")
+        _write_uvarint(out, len(name_raw))
+        out.extend(name_raw)
+        _write_uvarint(out, len(fields))
+        for fn in sorted(fields):
+            fn_raw = fn.encode("utf-8")
+            _write_uvarint(out, len(fn_raw))
+            out.extend(fn_raw)
+            _encode(out, fields[fn], depth + 1)
+
+
+def _lookup_type(cls: Type):
+    entry = _BY_TYPE.get(cls)
+    if entry is not None:
+        return entry
+    # walk the MRO so subclasses of registered types serialize as the base
+    for base in cls.__mro__[1:]:
+        entry = _BY_TYPE.get(base)
+        if entry is not None:
+            return entry
+    return None
+
+
+# --- decode -----------------------------------------------------------------
+
+def _decode(data: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise SerializationError(f"nesting deeper than {_MAX_DEPTH}")
+    if pos >= len(data):
+        raise SerializationError("truncated value")
+    tag = data[pos]
+    pos += 1
+    if tag == _NULL:
+        return None, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _INT:
+        v, pos = _read_uvarint(data, pos)
+        return _unzigzag(v), pos
+    if tag == _BYTES:
+        ln, pos = _read_uvarint(data, pos)
+        if pos + ln > len(data):
+            raise SerializationError("truncated bytes")
+        return data[pos : pos + ln], pos + ln
+    if tag == _STR:
+        ln, pos = _read_uvarint(data, pos)
+        if pos + ln > len(data):
+            raise SerializationError("truncated string")
+        return data[pos : pos + ln].decode("utf-8"), pos + ln
+    if tag == _F64:
+        if pos + 8 > len(data):
+            raise SerializationError("truncated float")
+        return struct.unpack_from(">d", data, pos)[0], pos + 8
+    if tag == _LIST:
+        n, pos = _read_uvarint(data, pos)
+        out = []
+        for _ in range(n):
+            item, pos = _decode(data, pos, depth + 1)
+            out.append(item)
+        return out, pos
+    if tag == _MAP:
+        n, pos = _read_uvarint(data, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _decode(data, pos, depth + 1)
+            v, pos = _decode(data, pos, depth + 1)
+            if isinstance(k, list):
+                k = tuple(k)
+            d[k] = v
+        return d, pos
+    if tag == _OBJ:
+        ln, pos = _read_uvarint(data, pos)
+        type_name = data[pos : pos + ln].decode("utf-8")
+        pos += ln
+        entry = _BY_NAME.get(type_name)
+        if entry is None:
+            raise SerializationError(f"type {type_name!r} not in deserialization whitelist")
+        _, _, from_dict = entry
+        n, pos = _read_uvarint(data, pos)
+        fields = {}
+        for _ in range(n):
+            fl, pos = _read_uvarint(data, pos)
+            fn = data[pos : pos + fl].decode("utf-8")
+            pos += fl
+            fields[fn], pos = _decode(data, pos, depth + 1)
+        try:
+            return from_dict(fields), pos
+        except TypeError as e:
+            raise SerializationError(f"cannot construct {type_name}: {e}") from e
+    raise SerializationError(f"unknown tag {tag}")
+
+
+# --- public api -------------------------------------------------------------
+
+def serialize(value: Any) -> bytes:
+    out = bytearray(_MAGIC)
+    _encode(out, value)
+    return bytes(out)
+
+
+def deserialize(data: bytes) -> Any:
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise SerializationError("bad magic / unsupported format version")
+    value, pos = _decode(data, len(_MAGIC))
+    if pos != len(data):
+        raise SerializationError(f"{len(data) - pos} trailing bytes")
+    return value
+
+
+# --- built-in adapters for core crypto types --------------------------------
+
+def _register_core_types() -> None:
+    from ..crypto.composite import CompositeKey, decode_composite_key
+    from ..crypto.keys import SchemePrivateKey, SchemePublicKey
+    from ..crypto.secure_hash import SecureHash
+    from ..crypto.signing import (
+        DigitalSignature,
+        DigitalSignatureWithKey,
+        MetaData,
+        SignatureType,
+        TransactionSignature,
+    )
+
+    register_adapter(
+        SecureHash, "SecureHash",
+        lambda h: {"bytes": h.bytes},
+        lambda d: SecureHash(d["bytes"]),
+    )
+    register_adapter(
+        SchemePublicKey, "PublicKey",
+        lambda k: {"scheme": k.scheme_code_name, "encoded": k.encoded},
+        lambda d: SchemePublicKey(d["scheme"], d["encoded"]),
+    )
+    register_adapter(
+        CompositeKey, "CompositeKey",
+        lambda k: {"encoded": k.encoded},
+        lambda d: decode_composite_key(d["encoded"]),
+    )
+    register_adapter(
+        SchemePrivateKey, "PrivateKey",  # checkpoint-context only in practice
+        lambda k: {"scheme": k.scheme_code_name, "encoded": k.encoded},
+        lambda d: SchemePrivateKey(d["scheme"], d["encoded"]),
+    )
+    register_adapter(
+        SignatureType, "SignatureType",
+        lambda s: {"v": int(s)},
+        lambda d: SignatureType(d["v"]),
+    )
+    register_adapter(
+        DigitalSignatureWithKey, "DigitalSignature.WithKey",
+        lambda s: {"bytes": s.bytes, "by": s.by},
+        lambda d: DigitalSignatureWithKey(d["bytes"], d["by"]),
+    )
+    register_adapter(
+        MetaData, "MetaData",
+        lambda m: {
+            "scheme": m.scheme_code_name, "version": m.version_id,
+            "sig_type": m.signature_type, "ts": m.timestamp,
+            "visible": m.visible_inputs, "signed": m.signed_inputs,
+            "root": m.merkle_root, "key": m.public_key,
+        },
+        lambda d: MetaData(
+            d["scheme"], d["version"], d["sig_type"], d["ts"],
+            d["visible"], d["signed"], d["root"], d["key"],
+        ),
+    )
+    register_adapter(
+        TransactionSignature, "TransactionSignature",
+        lambda s: {"bytes": s.bytes, "meta": s.meta_data},
+        lambda d: TransactionSignature(d["bytes"], d["meta"]),
+    )
+    register_adapter(
+        DigitalSignature, "DigitalSignature",
+        lambda s: {"bytes": s.bytes},
+        lambda d: DigitalSignature(d["bytes"]),
+    )
+
+
+_register_core_types()

@@ -1,0 +1,33 @@
+"""Serialization use-case contexts.
+
+Parity: reference `node-api/.../serialization/SerializationScheme.kt:21-220`
+distinguishes P2P / RPCServer / RPCClient / Storage / Checkpoint contexts.
+Here a context only carries the use case and an optional whitelist-relaxation
+flag for checkpoints (which may contain framework-internal types).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class UseCase(enum.Enum):
+    P2P = "p2p"
+    RPC_SERVER = "rpc_server"
+    RPC_CLIENT = "rpc_client"
+    STORAGE = "storage"
+    CHECKPOINT = "checkpoint"
+
+
+@dataclass(frozen=True)
+class SerializationContext:
+    use_case: UseCase = UseCase.P2P
+
+    @property
+    def allow_internal_types(self) -> bool:
+        return self.use_case is UseCase.CHECKPOINT
+
+
+P2P_CONTEXT = SerializationContext(UseCase.P2P)
+STORAGE_CONTEXT = SerializationContext(UseCase.STORAGE)
+CHECKPOINT_CONTEXT = SerializationContext(UseCase.CHECKPOINT)

@@ -1,0 +1,18 @@
+"""Test configuration: force the JAX CPU backend with a virtual 8-device mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/collective tests
+run against 8 virtual CPU devices (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). Must run before any
+`import jax` anywhere in the test session.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,111 @@
+"""CompositeKey tests, mirroring reference CompositeKeyTests.kt."""
+import pytest
+
+from corda_tpu.core import crypto as c
+from corda_tpu.core.crypto.composite import (
+    CompositeKey,
+    CompositeSignaturesWithKeys,
+    NodeAndWeight,
+    decode_composite_key,
+)
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [c.derive_keypair_from_entropy(c.EDDSA_ED25519_SHA512, 1000 + i) for i in range(5)]
+
+
+def test_threshold_evaluation(keys):
+    a, b, x = keys[0].public, keys[1].public, keys[2].public
+    two_of_three = CompositeKey.Builder().add_keys(a, b, x).build(threshold=2)
+    assert not two_of_three.is_fulfilled_by([a])
+    assert two_of_three.is_fulfilled_by([a, b])
+    assert two_of_three.is_fulfilled_by([a, x])
+    assert two_of_three.is_fulfilled_by([a, b, x])
+    assert not two_of_three.is_fulfilled_by([keys[3].public, keys[4].public])
+
+
+def test_weighted_threshold(keys):
+    a, b, x = keys[0].public, keys[1].public, keys[2].public
+    # a alone (weight 2) meets threshold; b+x (1+1) also meets it
+    k = (
+        CompositeKey.Builder()
+        .add_key(a, weight=2)
+        .add_key(b, weight=1)
+        .add_key(x, weight=1)
+        .build(threshold=2)
+    )
+    assert k.is_fulfilled_by([a])
+    assert k.is_fulfilled_by([b, x])
+    assert not k.is_fulfilled_by([b])
+
+
+def test_nested_trees(keys):
+    a, b, x, y = (k.public for k in keys[:4])
+    inner = CompositeKey.Builder().add_keys(x, y).build(threshold=1)
+    outer = CompositeKey.Builder().add_key(a).add_key(inner).build(threshold=2)
+    assert outer.is_fulfilled_by([a, x])
+    assert outer.is_fulfilled_by([a, y])
+    assert not outer.is_fulfilled_by([a])
+    assert not outer.is_fulfilled_by([x, y])
+    assert outer.keys == {a, x, y}
+
+
+def test_single_key_collapses(keys):
+    a = keys[0].public
+    assert CompositeKey.Builder().add_key(a).build() is a
+
+
+def test_validation_rules(keys):
+    a, b = keys[0].public, keys[1].public
+    with pytest.raises(ValueError):
+        CompositeKey.Builder().build()
+    with pytest.raises(ValueError):
+        CompositeKey.Builder().add_keys(a, b).build(threshold=3)  # > total weight
+    with pytest.raises(ValueError):
+        CompositeKey.Builder().add_keys(a, b).build(threshold=0)
+    with pytest.raises(ValueError):
+        CompositeKey.Builder().add_key(a, weight=-1).build()
+    with pytest.raises(ValueError):
+        CompositeKey.Builder().add_keys(a, a).build(threshold=1)  # duplicate leaf
+
+
+def test_encoding_roundtrip(keys):
+    a, b, x = (k.public for k in keys[:3])
+    inner = CompositeKey.Builder().add_keys(b, x).build(threshold=1)
+    k = CompositeKey.Builder().add_key(a, weight=3).add_key(inner, weight=2).build(threshold=4)
+    decoded = decode_composite_key(k.encoded)
+    assert decoded == k
+    assert decoded.threshold == 4
+    assert decoded.is_fulfilled_by([a, b])
+
+
+def test_composite_signature_verification(keys):
+    a_kp, b_kp, x_kp = keys[:3]
+    k = CompositeKey.Builder().add_keys(a_kp.public, b_kp.public, x_kp.public).build(threshold=2)
+    msg = b"multi-sig payload"
+    sigs = CompositeSignaturesWithKeys(
+        (
+            (a_kp.public, c.do_sign(a_kp.private, msg)),
+            (b_kp.public, c.do_sign(b_kp.private, msg)),
+        )
+    )
+    assert c.is_valid(k, sigs.serialize(), msg)
+    # one sig only: threshold not met
+    one = CompositeSignaturesWithKeys(((a_kp.public, c.do_sign(a_kp.private, msg)),))
+    assert not c.is_valid(k, one.serialize(), msg)
+    # a corrupted constituent signature fails the whole composite
+    bad = CompositeSignaturesWithKeys(
+        (
+            (a_kp.public, c.do_sign(a_kp.private, msg)),
+            (b_kp.public, b"\x00" * 64),
+        )
+    )
+    assert not c.is_valid(k, bad.serialize(), msg)
+
+
+def test_is_fulfilled_by_on_plain_key(keys):
+    a, b = keys[0].public, keys[1].public
+    assert a.is_fulfilled_by([a, b])
+    assert not a.is_fulfilled_by([b])
+    assert a.keys == {a}

@@ -1,0 +1,79 @@
+"""Merkle tree + partial Merkle proof tests (reference PartialMerkleTreeTest.kt)."""
+import pytest
+
+from corda_tpu.core.crypto.merkle import (
+    MerkleTree,
+    MerkleTreeError,
+    PartialMerkleTree,
+)
+from corda_tpu.core.crypto.secure_hash import SecureHash, ZERO_HASH
+
+
+def _leaves(n):
+    return [SecureHash.sha256(bytes([i]) * 4) for i in range(n)]
+
+
+def test_single_leaf():
+    ls = _leaves(1)
+    t = MerkleTree.get_merkle_tree(ls)
+    assert t.hash == ls[0]
+
+
+def test_power_of_two_padding():
+    ls = _leaves(3)
+    t = MerkleTree.get_merkle_tree(ls)
+    # 3 leaves pad to 4 with zero hash
+    expected = ls[0].hash_concat(ls[1]).hash_concat(ls[2].hash_concat(ZERO_HASH))
+    assert t.hash == expected
+
+
+def test_empty_rejected():
+    with pytest.raises(MerkleTreeError):
+        MerkleTree.get_merkle_tree([])
+
+
+def test_deterministic():
+    ls = _leaves(7)
+    assert MerkleTree.get_merkle_tree(ls).hash == MerkleTree.get_merkle_tree(ls).hash
+    swapped = ls[:5] + [ls[6], ls[5]]
+    assert MerkleTree.get_merkle_tree(swapped).hash != MerkleTree.get_merkle_tree(ls).hash
+
+
+@pytest.mark.parametrize("n,included", [(8, [0, 3, 7]), (5, [1]), (1, [0]), (16, list(range(16)))])
+def test_partial_tree_verifies(n, included):
+    ls = _leaves(n)
+    tree = MerkleTree.get_merkle_tree(ls)
+    inc = [ls[i] for i in included]
+    pmt = PartialMerkleTree.build(tree, inc)
+    assert pmt.verify(tree.hash, inc)
+
+
+def test_partial_tree_wrong_root_fails():
+    ls = _leaves(8)
+    tree = MerkleTree.get_merkle_tree(ls)
+    pmt = PartialMerkleTree.build(tree, [ls[2]])
+    assert not pmt.verify(SecureHash.random_sha256(), [ls[2]])
+
+
+def test_partial_tree_wrong_leaves_fail():
+    ls = _leaves(8)
+    tree = MerkleTree.get_merkle_tree(ls)
+    pmt = PartialMerkleTree.build(tree, [ls[2]])
+    assert not pmt.verify(tree.hash, [ls[3]])
+    assert not pmt.verify(tree.hash, [ls[2], ls[3]])
+
+
+def test_partial_tree_unknown_leaf_rejected():
+    ls = _leaves(8)
+    tree = MerkleTree.get_merkle_tree(ls)
+    with pytest.raises(MerkleTreeError):
+        PartialMerkleTree.build(tree, [SecureHash.random_sha256()])
+
+
+def test_secure_hash_basics():
+    h = SecureHash.sha256(b"abc")
+    assert h == SecureHash.parse(str(h))
+    assert len(h.bytes) == 32
+    assert h.hash_concat(h) == SecureHash.sha256(h.bytes + h.bytes)
+    with pytest.raises(ValueError):
+        SecureHash(b"short")

@@ -1,0 +1,142 @@
+"""Codec tests: determinism, round-trips, whitelist enforcement.
+
+Mirrors the role of reference `core/src/test/.../serialization/` round-trip
+suites, adapted to the single canonical format.
+"""
+from dataclasses import dataclass
+
+import pytest
+
+from corda_tpu.core import crypto as c
+from corda_tpu.core.serialization import (
+    SerializationError,
+    corda_serializable,
+    deserialize,
+    serialize,
+)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class Payment:
+    amount: int
+    currency: str
+    memo: bytes
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None, True, False, 0, 1, -1, 2**70, -(2**70),
+        b"", b"\x00\xff", "", "hello é世界",
+        [1, [2, 3], "x"], {"b": 1, "a": 2}, {1: "one", (1, 2): "tup"},
+        3.14159, [None, True, {"k": b"v"}],
+    ],
+)
+def test_primitive_roundtrip(value):
+    assert deserialize(serialize(value)) == value
+
+
+def test_tuple_decodes_as_list():
+    assert deserialize(serialize((1, 2))) == [1, 2]
+
+
+def test_map_key_order_is_canonical():
+    a = serialize({"x": 1, "y": 2, "z": 3})
+    b = serialize({"z": 3, "y": 2, "x": 1})
+    assert a == b
+
+
+def test_set_is_canonical():
+    assert serialize({3, 1, 2}) == serialize({2, 3, 1})
+    assert sorted(deserialize(serialize({3, 1, 2}))) == [1, 2, 3]
+
+
+def test_registered_dataclass_roundtrip():
+    p = Payment(100, "USD", b"invoice-42")
+    out = deserialize(serialize(p))
+    assert out == p
+    assert isinstance(out, Payment)
+
+
+def test_object_field_order_is_canonical():
+    # same object serialized twice is byte-identical
+    p = Payment(1, "GBP", b"")
+    assert serialize(p) == serialize(p)
+
+
+def test_unregistered_type_rejected():
+    class Rogue:
+        pass
+
+    with pytest.raises(SerializationError):
+        serialize(Rogue())
+
+
+def test_unknown_type_name_rejected_on_decode():
+    raw = bytearray(serialize(Payment(1, "EUR", b"")))
+    # corrupt the embedded type name
+    idx = bytes(raw).find(b"Payment")
+    raw[idx : idx + 7] = b"Evil!!!"
+    with pytest.raises(SerializationError):
+        deserialize(bytes(raw))
+
+
+def test_truncation_and_trailing_rejected():
+    raw = serialize([1, 2, 3])
+    with pytest.raises(SerializationError):
+        deserialize(raw[:-1])
+    with pytest.raises(SerializationError):
+        deserialize(raw + b"\x00")
+    with pytest.raises(SerializationError):
+        deserialize(b"XX" + raw)
+
+
+def test_nan_rejected():
+    with pytest.raises(SerializationError):
+        serialize(float("nan"))
+
+
+def test_crypto_types_roundtrip():
+    kp = c.generate_keypair()
+    h = c.SecureHash.sha256(b"tx")
+    sig = c.sign_bytes(kp.private, kp.public, h.bytes)
+    out = deserialize(serialize({"id": h, "sig": sig, "key": kp.public}))
+    assert out["id"] == h
+    assert out["key"] == kp.public
+    assert out["sig"].verify(h.bytes)
+
+
+def test_composite_key_roundtrip():
+    kps = [c.derive_keypair_from_entropy(c.EDDSA_ED25519_SHA512, 7000 + i) for i in range(3)]
+    ck = c.CompositeKey.Builder().add_keys(*[k.public for k in kps]).build(threshold=2)
+    out = deserialize(serialize(ck))
+    assert out == ck
+    assert out.is_fulfilled_by([kps[0].public, kps[2].public])
+
+
+def test_signed_data_verified():
+    kp = c.generate_keypair()
+    payload = serialize({"role": "notary", "seq": 1})
+    sd = c.SignedData(payload, c.sign_bytes(kp.private, kp.public, payload))
+    assert sd.verified() == {"role": "notary", "seq": 1}
+    # tampered payload fails signature check
+    bad = c.SignedData(payload + b" ", sd.sig)
+    with pytest.raises(c.SignatureError):
+        bad.verified()
+
+
+def test_leaf_index_with_collapsed_subtrees():
+    from corda_tpu.core.crypto.merkle import MerkleTree, PartialMerkleTree
+    from corda_tpu.core.crypto.secure_hash import SecureHash
+
+    ls = [SecureHash.sha256(bytes([i])) for i in range(8)]
+    tree = MerkleTree.get_merkle_tree(ls)
+    pmt = PartialMerkleTree.build(tree, [ls[7]])
+    assert pmt.leaf_index(ls[7]) == 7
+    pmt2 = PartialMerkleTree.build(tree, [ls[0], ls[7]])
+    assert pmt2.leaf_index(ls[0]) == 0
+    assert pmt2.leaf_index(ls[7]) == 7
+    pmt3 = PartialMerkleTree.build(tree, [ls[3], ls[5]])
+    assert pmt3.leaf_index(ls[3]) == 3
+    assert pmt3.leaf_index(ls[5]) == 5
