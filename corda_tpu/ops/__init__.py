@@ -1,0 +1,16 @@
+"""corda_tpu.ops: batched JAX/TPU kernels.
+
+The accelerator half of the crypto stack. Host reference implementations and
+scalar fallbacks live in corda_tpu.core.crypto; everything here is batch-first
+and jit/vmap/shard_map-friendly (static shapes, batch-uniform control flow,
+validity carried as bitmasks).
+"""
+from .ed25519_batch import verify_batch as ed25519_verify_batch
+from .ed25519_batch import verify_kernel as ed25519_verify_kernel
+from .ed25519_batch import prepare_batch as ed25519_prepare_batch
+
+__all__ = [
+    "ed25519_verify_batch",
+    "ed25519_verify_kernel",
+    "ed25519_prepare_batch",
+]

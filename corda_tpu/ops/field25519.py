@@ -1,0 +1,202 @@
+"""Batched GF(2^255-19) field arithmetic for the TPU ed25519 kernel.
+
+TPU-first design notes (rather than a port of the reference's JVM crypto,
+`core/src/main/kotlin/net/corda/core/crypto/Crypto.kt:119-132` which binds
+ed25519 to the i2p JCA provider):
+
+  * Representation: 16 little-endian radix-2^16 limbs held in uint32, batch
+    dims leading, limb dim last -> every op is a (B,)-wide vector op on the
+    TPU VPU; vmap/shard_map over the batch gives lane parallelism.
+  * Why radix 2^16: a 16x16-bit limb product fits *exactly* in uint32, and its
+    hi halfword shifts cleanly by exactly one limb position, so schoolbook
+    multiplication needs one uint32 multiply per limb pair and no int64
+    emulation (XLA lowers int64 on TPU to slow s32 pairs).
+  * Why 16 limbs: 16*16 = 256 bits aligns the reduction boundary at 2^256,
+    where 2^256 = 38 mod p -- the fold multiplier is tiny (fits any limb
+    bound comfortably).
+  * All control flow is batch-uniform: invalid inputs flow through as data
+    and are reported in a validity bitmask, never via branches.
+
+Overflow analysis (the invariants each helper maintains) is documented
+inline; "strict" means every limb < 2^16.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+P_INT = 2**255 - 19
+L_INT = 2**252 + 27742317777372353535851937790883648493
+D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
+SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+
+NLIMB = 16
+MASK16 = jnp.uint32(0xFFFF)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int -> (16,) uint32 strict limbs (host-side, for constants)."""
+    if not 0 <= x < 2**256:
+        raise ValueError("out of range")
+    return np.array([(x >> (16 * k)) & 0xFFFF for k in range(NLIMB)], np.uint32)
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(limbs[..., k]) << (16 * k) for k in range(NLIMB))
+
+
+def bytes_to_limbs(le_bytes: np.ndarray) -> np.ndarray:
+    """(..., 32) uint8 little-endian byte strings -> (..., 16) uint32 limbs."""
+    v = np.asarray(le_bytes, dtype=np.uint32)
+    return v[..., 0::2] | (v[..., 1::2] << 8)
+
+
+P_LIMBS = int_to_limbs(P_INT)
+_P_I32 = P_LIMBS.astype(np.int32)
+_TWOP_I32 = int_to_limbs(2 * P_INT).astype(np.int32)
+D_LIMBS = int_to_limbs(D_INT)
+D2_LIMBS = int_to_limbs(2 * D_INT % P_INT)
+SQRT_M1_LIMBS = int_to_limbs(SQRT_M1_INT)
+ONE_LIMBS = int_to_limbs(1)
+ZERO_LIMBS = int_to_limbs(0)
+
+
+def const(limbs: np.ndarray, batch_shape=()) -> jnp.ndarray:
+    """Broadcast a (16,) constant to (batch..., 16)."""
+    return jnp.broadcast_to(jnp.asarray(limbs, jnp.uint32), (*batch_shape, NLIMB))
+
+
+# --- carries / reduction -----------------------------------------------------
+
+def _carry_u(c):
+    """Full sequential carry chain. Input limbs < 2^27 (so limb + carry < 2^28
+    fits uint32); returns (strict limbs, carry_out < 2^12)."""
+    outs = []
+    carry = jnp.zeros_like(c[..., 0])
+    for k in range(NLIMB):
+        v = c[..., k] + carry
+        outs.append(v & MASK16)
+        carry = v >> 16
+    return jnp.stack(outs, axis=-1), carry
+
+
+def _fold_tail(r, cout):
+    """Fold a carry-out at 2^256 back via *38, renormalize to strict limbs.
+
+    Preconditions: r strict, cout < 2^12, and value(r) + 2^256*cout came from a
+    quantity < 2^268 -- which makes the second chain's carry-out c2 in {0,1}
+    and, when c2 == 1, leaves limb1 <= 3 so the final mini-carry cannot
+    overflow limb1 past 2^16.
+    """
+    r = r.at[..., 0].add(cout * jnp.uint32(38))
+    r, c2 = _carry_u(r)
+    r = r.at[..., 0].add(c2 * jnp.uint32(38))
+    v0 = r[..., 0]
+    r = r.at[..., 0].set(v0 & MASK16)
+    r = r.at[..., 1].add(v0 >> 16)
+    return r
+
+
+def add(a, b):
+    """a + b mod-ish (strict limbs, value < 2^256, congruent mod p)."""
+    return _fold_tail(*_carry_u(a + b))  # limb sums < 2^17
+
+
+def sub(a, b):
+    """a - b mod p via a + 2p - b with a signed borrow chain."""
+    ai = a.astype(jnp.int32)
+    bi = b.astype(jnp.int32)
+    outs = []
+    carry = jnp.zeros_like(ai[..., 0])
+    for k in range(NLIMB):
+        v = ai[..., k] + jnp.int32(int(_TWOP_I32[k])) - bi[..., k] + carry
+        outs.append((v & 0xFFFF).astype(jnp.uint32))
+        carry = v >> 16  # arithmetic shift keeps borrow semantics
+    r = jnp.stack(outs, axis=-1)
+    return _fold_tail(r, carry.astype(jnp.uint32))  # carry-out in {0, 1}
+
+
+def neg(a):
+    return sub(const(ZERO_LIMBS, a.shape[:-1]), a)
+
+
+def mul(a, b):
+    """Schoolbook product with lo/hi halfword split.
+
+    Each pairwise product fits uint32 exactly; its hi halfword lands exactly
+    one limb up (radix 2^16). Coefficient sums <= 32 terms * 2^16 < 2^21; the
+    2^256 fold multiplies the high half by 38 -> < 2^27, within _carry_u's
+    input bound.
+    """
+    acc = jnp.zeros((*jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), 2 * NLIMB), jnp.uint32)
+    for i in range(NLIMB):
+        p = a[..., i : i + 1] * b
+        acc = acc.at[..., i : i + NLIMB].add(p & MASK16)
+        acc = acc.at[..., i + 1 : i + NLIMB + 1].add(p >> 16)
+    folded = acc[..., :NLIMB] + jnp.uint32(38) * acc[..., NLIMB:]
+    return _fold_tail(*_carry_u(folded))
+
+
+def square(a):
+    return mul(a, a)
+
+
+def pow_const(x, exponent: int):
+    """x ** exponent for a compile-time-constant exponent.
+
+    Left-to-right square-and-multiply under lax.fori_loop with the exponent's
+    bits as a constant array: small traced graph (2 field muls per step), no
+    data-dependent control flow.
+    """
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], jnp.uint32
+    )
+    one = const(ONE_LIMBS, x.shape[:-1])
+
+    def body(i, acc):
+        acc = square(acc)
+        with_mul = mul(acc, x)
+        return jnp.where(bits[i] == 1, with_mul, acc)
+
+    return lax.fori_loop(0, nbits, body, one)
+
+
+# --- canonicalization / comparisons -----------------------------------------
+
+def _cond_sub_p(a):
+    """(a - p if a >= p else a, a >= p mask)."""
+    ai = a.astype(jnp.int32)
+    outs = []
+    carry = jnp.zeros_like(ai[..., 0])
+    for k in range(NLIMB):
+        v = ai[..., k] - jnp.int32(int(_P_I32[k])) + carry
+        outs.append((v & 0xFFFF).astype(jnp.uint32))
+        carry = v >> 16
+    t = jnp.stack(outs, axis=-1)
+    geq = carry == 0
+    return jnp.where(geq[..., None], t, a), geq
+
+
+def canonical(a):
+    """Fully reduced representative in [0, p). Strict input < 2^256 needs at
+    most two conditional subtractions (2^256 - 2p = 38)."""
+    r, _ = _cond_sub_p(a)
+    r, _ = _cond_sub_p(r)
+    return r
+
+
+def lt_p(a):
+    """a < p elementwise over the batch (for canonical-encoding checks)."""
+    _, geq = _cond_sub_p(a)
+    return ~geq
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def eq(a, b):
+    return is_zero(sub(a, b))

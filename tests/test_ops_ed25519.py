@@ -1,0 +1,145 @@
+"""TPU/JAX batched ed25519 kernel vs the host oracle.
+
+Test layer parity: reference `core/src/test/kotlin/net/corda/core/crypto/
+CryptoUtilsTest.kt` (per-scheme sign/verify vectors) applied to the batch
+path; elementwise agreement with ed25519_math.verify is the invariant.
+"""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from corda_tpu.core.crypto import ed25519_math
+from corda_tpu.ops import field25519 as F
+from corda_tpu.ops import ed25519_batch
+
+
+def _keypair(seed: bytes):
+    return ed25519_math.public_from_seed(seed), seed
+
+
+def _sign(seed: bytes, msg: bytes) -> bytes:
+    return ed25519_math.sign(seed, msg)
+
+
+class TestField:
+    def test_mul_matches_bigint(self):
+        rng = np.random.default_rng(0)
+        xs = [int.from_bytes(rng.bytes(32), "little") % 2**256 for _ in range(32)]
+        ys = [int.from_bytes(rng.bytes(32), "little") % 2**256 for _ in range(32)]
+        a = np.stack([F.int_to_limbs(x) for x in xs])
+        b = np.stack([F.int_to_limbs(y) for y in ys])
+        got = np.asarray(F.canonical(F.mul(a, b)))
+        for i in range(32):
+            assert F.limbs_to_int(got[i]) == xs[i] * ys[i] % F.P_INT
+
+    def test_add_sub_roundtrip(self):
+        rng = np.random.default_rng(1)
+        xs = [int.from_bytes(rng.bytes(32), "little") for _ in range(16)]
+        ys = [int.from_bytes(rng.bytes(32), "little") for _ in range(16)]
+        a = np.stack([F.int_to_limbs(x) for x in xs])
+        b = np.stack([F.int_to_limbs(y) for y in ys])
+        s = np.asarray(F.canonical(F.add(a, b)))
+        d = np.asarray(F.canonical(F.sub(a, b)))
+        for i in range(16):
+            assert F.limbs_to_int(s[i]) == (xs[i] + ys[i]) % F.P_INT
+            assert F.limbs_to_int(d[i]) == (xs[i] - ys[i]) % F.P_INT
+
+    def test_edge_values(self):
+        edges = [0, 1, 19, F.P_INT - 1, F.P_INT, F.P_INT + 1, 2**256 - 1, 2**255 - 1]
+        a = np.stack([F.int_to_limbs(x) for x in edges])
+        sq = np.asarray(F.canonical(F.mul(a, a)))
+        for i, x in enumerate(edges):
+            assert F.limbs_to_int(sq[i]) == x * x % F.P_INT
+        assert list(np.asarray(F.lt_p(a))) == [x < F.P_INT for x in edges]
+
+    def test_pow_const(self):
+        x = 123456789
+        a = F.int_to_limbs(x)[None, :]
+        e = (F.P_INT - 5) // 8
+        got = F.limbs_to_int(np.asarray(F.canonical(F.pow_const(a, e)))[0])
+        assert got == pow(x, e, F.P_INT)
+
+
+class TestBatchVerify:
+    def test_valid_batch(self):
+        msgs = [f"message {i}".encode() for i in range(20)]
+        pubs, sigs = [], []
+        for i, m in enumerate(msgs):
+            pub, seed = _keypair(hashlib.sha256(f"k{i}".encode()).digest())
+            pubs.append(pub)
+            sigs.append(_sign(seed, m))
+        mask = ed25519_batch.verify_batch(pubs, sigs, msgs)
+        assert mask.all()
+
+    def test_tampered_rejected(self):
+        pub, seed = _keypair(os.urandom(32))
+        msg = b"pay 100 to alice"
+        sig = _sign(seed, msg)
+        bad_sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+        bad_msg = b"pay 999 to mallory"
+        other_pub, _ = _keypair(os.urandom(32))
+        mask = ed25519_batch.verify_batch(
+            [pub, pub, pub, other_pub],
+            [sig, bad_sig, sig, sig],
+            [msg, msg, bad_msg, msg],
+        )
+        assert list(mask) == [True, False, False, False]
+
+    def test_malformed_inputs(self):
+        pub, seed = _keypair(os.urandom(32))
+        msg = b"m"
+        sig = _sign(seed, msg)
+        # s >= L is non-canonical and must be rejected
+        s_big = (F.L_INT + 5).to_bytes(32, "little")
+        sig_bad_s = sig[:32] + s_big
+        # y >= p is a non-canonical point encoding
+        bad_y = (F.P_INT + 1).to_bytes(32, "little")
+        mask = ed25519_batch.verify_batch(
+            [pub, pub, bad_y, pub, b"\x01" * 7],
+            [sig, sig_bad_s, sig, b"\x00" * 9, sig],
+            [msg] * 5,
+        )
+        assert list(mask) == [True, False, False, False, False]
+
+    def test_non_point_pubkey(self):
+        pub, seed = _keypair(os.urandom(32))
+        msg = b"hello"
+        sig = _sign(seed, msg)
+        # find a y that is not on the curve
+        y = 2
+        while ed25519_math.point_decompress(
+            int(y).to_bytes(32, "little")
+        ) is not None:
+            y += 1
+        not_a_point = int(y).to_bytes(32, "little")
+        mask = ed25519_batch.verify_batch(
+            [not_a_point, pub], [sig, sig], [msg, msg]
+        )
+        assert list(mask) == [False, True]
+
+    def test_agrees_with_host_oracle_fuzz(self):
+        rng = np.random.default_rng(42)
+        pubs, sigs, msgs, expect = [], [], [], []
+        for i in range(48):
+            seed = rng.bytes(32)
+            pub, _ = _keypair(seed)
+            msg = rng.bytes(rng.integers(1, 200))
+            sig = _sign(seed, msg)
+            kind = i % 4
+            if kind == 1:
+                sig = bytes([sig[0] ^ 0xFF]) + sig[1:]
+            elif kind == 2:
+                msg = msg + b"!"
+            elif kind == 3:
+                pub = rng.bytes(32)  # random 32 bytes: usually not a valid key
+            pubs.append(pub)
+            sigs.append(sig)
+            msgs.append(msg)
+            expect.append(ed25519_math.verify(pub, msg, sig))
+        mask = ed25519_batch.verify_batch(pubs, sigs, msgs)
+        assert list(mask) == expect
+
+    def test_empty_batch(self):
+        assert ed25519_batch.verify_batch([], [], []).shape == (0,)
