@@ -1,0 +1,32 @@
+"""Contract & state model (reference `core/.../contracts/`)."""
+from .amount import Amount, Issued, display_token_size
+from .structures import (
+    Attachment,
+    AuthenticatedObject,
+    Command,
+    CommandData,
+    Contract,
+    ContractState,
+    LinearState,
+    OwnableState,
+    SchedulableState,
+    ScheduledActivity,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    UniqueIdentifier,
+    contract,
+    resolve_contract,
+)
+
+__all__ = [
+    "Amount", "Issued", "display_token_size",
+    "Attachment", "AuthenticatedObject", "Command", "CommandData", "Contract",
+    "ContractState", "LinearState", "OwnableState", "SchedulableState",
+    "ScheduledActivity", "StateAndRef", "StateRef", "TimeWindow",
+    "TransactionState", "TransactionVerificationError", "TypeOnlyCommandData",
+    "UniqueIdentifier", "contract", "resolve_contract",
+]

@@ -1,0 +1,114 @@
+"""Amount and issued-token primitives.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/contracts/Amount.kt`
+(`Amount<T>` integer-quantity money math that refuses mixed-token arithmetic
+and overflow/negative quantities) and `Structures.kt` `Issued<T>`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Generic, Iterable, TypeVar
+
+from ..identity import PartyAndReference
+from ..serialization.codec import register_adapter
+
+T = TypeVar("T")
+
+# display token sizes: minor-unit exponent per ISO currency (default 2)
+_EXPONENTS = {"JPY": 0, "KWD": 3, "BHD": 3, "XBT": 8}
+
+
+def display_token_size(token) -> int:
+    """10^-exponent of the token's minor unit (e.g. 100 cents per USD)."""
+    code = token if isinstance(token, str) else getattr(token, "product", None)
+    return 10 ** _EXPONENTS.get(code, 2) if isinstance(code, str) else 100
+
+
+@dataclass(frozen=True)
+class Issued(Generic[T]):
+    """A product with its issuer attached: `Issued(issuer_ref, "USD")`
+    (reference Structures.kt Issued)."""
+
+    issuer: PartyAndReference
+    product: T
+
+    def __repr__(self) -> str:
+        return f"{self.product} issued by {self.issuer}"
+
+
+@dataclass(frozen=True)
+class Amount(Generic[T]):
+    """Integer quantity of a token in its minor unit (reference Amount.kt)."""
+
+    quantity: int
+    token: T
+
+    def __post_init__(self):
+        if self.quantity < 0:
+            raise ValueError("amount quantity cannot be negative")
+
+    @staticmethod
+    def from_decimal(value, token) -> "Amount":
+        return Amount(round(value * display_token_size(token)), token)
+
+    def to_decimal(self):
+        return self.quantity / display_token_size(self.token)
+
+    def _check(self, other: "Amount[T]"):
+        if other.token != self.token:
+            raise ValueError(f"token mismatch: {self.token} vs {other.token}")
+
+    def __add__(self, other: "Amount[T]") -> "Amount[T]":
+        self._check(other)
+        return Amount(self.quantity + other.quantity, self.token)
+
+    def __sub__(self, other: "Amount[T]") -> "Amount[T]":
+        self._check(other)
+        return Amount(self.quantity - other.quantity, self.token)
+
+    def __mul__(self, k: int) -> "Amount[T]":
+        return Amount(self.quantity * k, self.token)
+
+    def __lt__(self, other: "Amount[T]") -> bool:
+        self._check(other)
+        return self.quantity < other.quantity
+
+    def __le__(self, other: "Amount[T]") -> bool:
+        self._check(other)
+        return self.quantity <= other.quantity
+
+    @staticmethod
+    def sum_or_none(amounts: Iterable["Amount[T]"]):
+        amounts = list(amounts)
+        if not amounts:
+            return None
+        total = amounts[0]
+        for a in amounts[1:]:
+            total = total + a
+        return total
+
+    @staticmethod
+    def sum_or_zero(amounts: Iterable["Amount[T]"], token: T) -> "Amount[T]":
+        return Amount.sum_or_none(amounts) or Amount(0, token)
+
+    @staticmethod
+    def sum_or_throw(amounts: Iterable["Amount[T]"]) -> "Amount[T]":
+        total = Amount.sum_or_none(amounts)
+        if total is None:
+            raise ValueError("empty amount list")
+        return total
+
+    def __repr__(self) -> str:
+        return f"{self.to_decimal():.2f} {self.token}"
+
+
+register_adapter(
+    Issued, "Issued",
+    lambda i: {"issuer": i.issuer, "product": i.product},
+    lambda d: Issued(d["issuer"], d["product"]),
+)
+register_adapter(
+    Amount, "Amount",
+    lambda a: {"quantity": a.quantity, "token": a.token},
+    lambda d: Amount(d["quantity"], d["token"]),
+)

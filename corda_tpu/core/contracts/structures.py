@@ -1,0 +1,292 @@
+"""Contract & state model.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/contracts/Structures.kt`
+(ContractState :38, TransactionState :99, OwnableState :151, LinearState :194,
+SchedulableState :229, StateRef :251, StateAndRef :259, Command :288,
+Contract.verify :340, Attachment :387) and `TimeWindow.kt`.
+
+Contracts are pure verification functions over a LedgerTransaction; they are
+identified on the wire by a registered contract name so the out-of-process /
+TPU verifier can resolve the verify logic without Python pickling.
+"""
+from __future__ import annotations
+
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Dict, FrozenSet, List, Optional, Sequence, Type
+
+from ..crypto.keys import PublicKey
+from ..crypto.secure_hash import SecureHash
+from ..identity import AbstractParty, Party
+from ..serialization.codec import register_adapter
+
+if TYPE_CHECKING:
+    from ..transactions.ledger import LedgerTransaction
+
+
+class TransactionVerificationError(Exception):
+    """A transaction failed contract/structural verification (reference
+    `TransactionVerificationException`)."""
+
+    def __init__(self, tx_id, message: str):
+        super().__init__(f"{message} (tx {tx_id})")
+        self.tx_id = tx_id
+
+
+# --- contracts ---------------------------------------------------------------
+
+_CONTRACT_REGISTRY: Dict[str, Type["Contract"]] = {}
+
+
+def contract(cls=None, *, name: str | None = None):
+    """Register a Contract class under a stable wire name.
+
+    The TPU-native analogue of the reference's attachment-classloader contract
+    resolution (`AttachmentsClassLoader.kt`): LedgerTransactions reference
+    contracts by name; the verifier process resolves them from this registry.
+    """
+
+    def wrap(c):
+        wire_name = name or c.__qualname__
+        if wire_name in _CONTRACT_REGISTRY and _CONTRACT_REGISTRY[wire_name] is not c:
+            raise ValueError(f"contract name {wire_name!r} already registered")
+        _CONTRACT_REGISTRY[wire_name] = c
+        c.contract_name = wire_name
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def resolve_contract(name: str) -> "Contract":
+    try:
+        return _CONTRACT_REGISTRY[name]()
+    except KeyError:
+        raise TransactionVerificationError(None, f"unknown contract {name!r}")
+
+
+class Contract:
+    """Verification logic for states (reference Structures.kt:340). Implement
+    verify(); raise TransactionVerificationError (or any exception) to reject."""
+
+    contract_name: str = ""
+
+    def verify(self, tx: "LedgerTransaction") -> None:
+        raise NotImplementedError
+
+    @property
+    def legal_contract_reference(self) -> SecureHash:
+        return SecureHash.sha256(self.contract_name.encode())
+
+
+class ContractState:
+    """A fact on the ledger. Subclasses are dataclasses with a `participants`
+    property and a `contract_name` class attribute naming their contract."""
+
+    contract_name: str = ""
+
+    @property
+    def participants(self) -> List[AbstractParty]:
+        raise NotImplementedError
+
+    @property
+    def contract(self) -> Contract:
+        return resolve_contract(self.contract_name)
+
+
+class OwnableState(ContractState):
+    owner: AbstractParty
+
+    def with_new_owner(self, new_owner: AbstractParty) -> "OwnableState":
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class UniqueIdentifier:
+    """external_id + uuid pair identifying a LinearState chain
+    (reference `UniqueIdentifier.kt`)."""
+
+    external_id: Optional[str] = None
+    uuid: bytes = field(default_factory=lambda: uuid_mod.uuid4().bytes)
+
+    def __str__(self) -> str:
+        u = uuid_mod.UUID(bytes=self.uuid)
+        return f"{self.external_id}_{u}" if self.external_id else str(u)
+
+
+class LinearState(ContractState):
+    """A state evolving through a chain of transactions, identified by
+    linear_id across versions (reference Structures.kt:194)."""
+
+    linear_id: UniqueIdentifier
+
+
+@dataclass(frozen=True)
+class ScheduledActivity:
+    """What to run when a SchedulableState's time arrives: a flow name +
+    args (the FlowLogicRef equivalent) and the scheduled unix-nanos time."""
+
+    flow_name: str
+    flow_args: tuple
+    scheduled_at: int
+
+
+class SchedulableState(ContractState):
+    def next_scheduled_activity(self, this_state_ref: "StateRef") -> Optional[ScheduledActivity]:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class StateRef:
+    """(txhash, output index) pointer to a state (reference Structures.kt:251)."""
+
+    txhash: SecureHash
+    index: int
+
+    def __repr__(self) -> str:
+        return f"{self.txhash}({self.index})"
+
+
+@dataclass(frozen=True)
+class TransactionState:
+    """A ContractState plus ledger metadata (reference Structures.kt:99)."""
+
+    data: ContractState
+    notary: Party
+    encumbrance: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class StateAndRef:
+    state: TransactionState
+    ref: StateRef
+
+
+@dataclass(frozen=True)
+class Command:
+    """Command data + required signing keys (reference Structures.kt:288)."""
+
+    value: "CommandData"
+    signers: tuple  # tuple[PublicKey, ...]
+
+    def __post_init__(self):
+        if not self.signers:
+            raise ValueError("command must have at least one signer")
+
+
+class CommandData:
+    """Marker base for command payloads (dataclasses, registered for wire)."""
+
+
+@dataclass(frozen=True)
+class TypeOnlyCommandData(CommandData):
+    """Command whose identity is its type alone (e.g. Move, Exit)."""
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+
+@dataclass(frozen=True)
+class AuthenticatedObject:
+    """A command with its signer metadata resolved to parties
+    (reference Structures.kt AuthenticatedObject)."""
+
+    signers: tuple  # keys
+    signing_parties: tuple  # resolved parties, possibly empty
+    value: CommandData
+
+
+@dataclass(frozen=True)
+class TimeWindow:
+    """[from_time, until_time) in unix nanoseconds; either bound optional
+    (reference `core/.../contracts/TimeWindow.kt`)."""
+
+    from_time: Optional[int] = None
+    until_time: Optional[int] = None
+
+    def __post_init__(self):
+        if self.from_time is None and self.until_time is None:
+            raise ValueError("a time window needs at least one bound")
+        if (
+            self.from_time is not None
+            and self.until_time is not None
+            and self.until_time < self.from_time
+        ):
+            raise ValueError("until_time < from_time")
+
+    @staticmethod
+    def between(from_time: int, until_time: int) -> "TimeWindow":
+        return TimeWindow(from_time, until_time)
+
+    @staticmethod
+    def from_only(from_time: int) -> "TimeWindow":
+        return TimeWindow(from_time, None)
+
+    @staticmethod
+    def until_only(until_time: int) -> "TimeWindow":
+        return TimeWindow(None, until_time)
+
+    @staticmethod
+    def with_tolerance(instant: int, tolerance_nanos: int) -> "TimeWindow":
+        return TimeWindow(instant - tolerance_nanos, instant + tolerance_nanos)
+
+    @property
+    def midpoint(self) -> Optional[int]:
+        if self.from_time is None or self.until_time is None:
+            return None
+        return (self.from_time + self.until_time) // 2
+
+    def contains(self, instant: int) -> bool:
+        if self.from_time is not None and instant < self.from_time:
+            return False
+        if self.until_time is not None and instant >= self.until_time:
+            return False
+        return True
+
+
+class Attachment:
+    """Content-addressed binary attachment (reference Structures.kt:387)."""
+
+    def __init__(self, attachment_id: SecureHash, data: bytes):
+        self.id = attachment_id
+        self.data = data
+
+    @staticmethod
+    def of(data: bytes) -> "Attachment":
+        return Attachment(SecureHash.sha256(data), data)
+
+
+# --- wire registration -------------------------------------------------------
+
+register_adapter(
+    UniqueIdentifier, "UniqueIdentifier",
+    lambda u: {"external_id": u.external_id, "uuid": u.uuid},
+    lambda d: UniqueIdentifier(d["external_id"], d["uuid"]),
+)
+register_adapter(
+    StateRef, "StateRef",
+    lambda r: {"txhash": r.txhash, "index": r.index},
+    lambda d: StateRef(d["txhash"], d["index"]),
+)
+register_adapter(
+    TransactionState, "TransactionState",
+    lambda s: {"data": s.data, "notary": s.notary, "encumbrance": s.encumbrance},
+    lambda d: TransactionState(d["data"], d["notary"], d["encumbrance"]),
+)
+register_adapter(
+    StateAndRef, "StateAndRef",
+    lambda s: {"state": s.state, "ref": s.ref},
+    lambda d: StateAndRef(d["state"], d["ref"]),
+)
+register_adapter(
+    Command, "Command",
+    lambda c: {"value": c.value, "signers": list(c.signers)},
+    lambda d: Command(d["value"], tuple(d["signers"])),
+)
+register_adapter(
+    TimeWindow, "TimeWindow",
+    lambda t: {"from_time": t.from_time, "until_time": t.until_time},
+    lambda d: TimeWindow(d["from_time"], d["until_time"]),
+)

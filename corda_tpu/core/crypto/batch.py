@@ -1,0 +1,64 @@
+"""Scheme-dispatching batch signature verification.
+
+The batch-first replacement for the reference's one-at-a-time loop
+(`core/.../transactions/TransactionWithSignatures.kt:58-62` ->
+`Crypto.kt:535-541`). Signatures are bucketed by scheme: ed25519 goes to the
+JAX/TPU kernel (corda_tpu.ops.ed25519_batch); schemes without a device kernel
+yet fall back to the host path (`crypto.is_valid`). Results come back as a
+positionally-aligned bool list, so callers keep exact per-signature
+accept/reject semantics.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from . import crypto
+from .keys import PublicKey
+from .schemes import EDDSA_ED25519_SHA512
+
+# Flip to False to force the host path (e.g. for differential testing).
+USE_DEVICE_KERNELS = True
+
+# Below this many ed25519 signatures the host path (OpenSSL via cryptography)
+# beats device dispatch+compile amortization on small batches.
+MIN_DEVICE_BATCH = 32
+
+
+def verify_batch(
+    items: Sequence[Tuple[PublicKey, bytes, bytes]],
+) -> List[bool]:
+    """items: (public_key, signature_bytes, content) triples -> bool per item."""
+    n = len(items)
+    results: List[bool] = [False] * n
+    ed_idx: List[int] = []
+    for i, (key, sig, content) in enumerate(items):
+        if (
+            USE_DEVICE_KERNELS
+            and key.scheme_code_name == EDDSA_ED25519_SHA512.scheme_code_name
+            and not _is_composite(key)
+        ):
+            ed_idx.append(i)
+        else:
+            results[i] = crypto.is_valid(key, sig, content)
+
+    if len(ed_idx) >= MIN_DEVICE_BATCH:
+        from ... import ops
+
+        mask = ops.ed25519_verify_batch(
+            [items[i][0].encoded for i in ed_idx],
+            [items[i][1] for i in ed_idx],
+            [items[i][2] for i in ed_idx],
+        )
+        for j, i in enumerate(ed_idx):
+            results[i] = bool(mask[j])
+    else:
+        for i in ed_idx:
+            key, sig, content = items[i]
+            results[i] = crypto.is_valid(key, sig, content)
+    return results
+
+
+def _is_composite(key: PublicKey) -> bool:
+    from .composite import CompositeKey
+
+    return isinstance(key, CompositeKey)

@@ -1,0 +1,69 @@
+"""Party identity model.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/identity/`
+(`AbstractParty`, `Party`, `AnonymousParty`) — a party is a (X.500-ish name,
+owning key) pair; anonymous parties carry only the key. Names here are plain
+strings of "O=...,L=...,C=..." form rather than JCA X500Name objects.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .crypto.keys import PublicKey
+from .serialization.codec import register_adapter
+
+
+class AbstractParty:
+    owning_key: PublicKey
+
+    def ref(self, *ref_bytes: int) -> "PartyAndReference":
+        return PartyAndReference(self, bytes(ref_bytes))
+
+
+@dataclass(frozen=True)
+class Party(AbstractParty):
+    name: str
+    owning_key: PublicKey
+
+    def anonymise(self) -> "AnonymousParty":
+        return AnonymousParty(self.owning_key)
+
+    def __repr__(self) -> str:
+        return f"Party({self.name})"
+
+
+@dataclass(frozen=True)
+class AnonymousParty(AbstractParty):
+    owning_key: PublicKey
+
+    def __repr__(self) -> str:
+        return f"AnonymousParty({self.owning_key!r})"
+
+
+@dataclass(frozen=True)
+class PartyAndReference:
+    """Reference to something being stored or issued by a party, e.g. an
+    issuer reference (reference `Structures.kt` PartyAndReference)."""
+
+    party: AbstractParty
+    reference: bytes
+
+    def __repr__(self) -> str:
+        return f"{self.party}{self.reference.hex()}"
+
+
+register_adapter(
+    Party, "Party",
+    lambda p: {"name": p.name, "key": p.owning_key},
+    lambda d: Party(d["name"], d["key"]),
+)
+register_adapter(
+    AnonymousParty, "AnonymousParty",
+    lambda p: {"key": p.owning_key},
+    lambda d: AnonymousParty(d["key"]),
+)
+register_adapter(
+    PartyAndReference, "PartyAndReference",
+    lambda p: {"party": p.party, "ref": p.reference},
+    lambda d: PartyAndReference(d["party"], d["ref"]),
+)

@@ -1,0 +1,22 @@
+"""Transaction model (reference `core/.../transactions/`)."""
+from .builder import TransactionBuilder
+from .filtered import (
+    FilteredComponent,
+    FilteredTransaction,
+    FilteredTransactionVerificationError,
+)
+from .ledger import InOutGroup, LedgerTransaction
+from .signed import (
+    SignatureError,
+    SignaturesMissingError,
+    SignedTransaction,
+    TransactionWithSignatures,
+)
+from .wire import ComponentGroup, WireTransaction
+
+__all__ = [
+    "ComponentGroup", "FilteredComponent", "FilteredTransaction",
+    "FilteredTransactionVerificationError", "InOutGroup", "LedgerTransaction",
+    "SignatureError", "SignaturesMissingError", "SignedTransaction",
+    "TransactionBuilder", "TransactionWithSignatures", "WireTransaction",
+]

@@ -1,0 +1,128 @@
+"""TransactionBuilder: mutable transaction assembly + signing.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/transactions/
+TransactionBuilder.kt` (signWith, toWireTransaction, toSignedTransaction).
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Union
+
+from ..contracts.structures import (
+    Attachment,
+    Command,
+    CommandData,
+    ContractState,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+)
+from ..crypto import crypto
+from ..crypto.keys import KeyPair, PublicKey
+from ..crypto.secure_hash import SecureHash
+from ..crypto.signing import DigitalSignatureWithKey
+from ..identity import Party
+from .signed import SignedTransaction
+from .wire import WireTransaction
+
+
+class TransactionBuilder:
+    def __init__(self, notary: Optional[Party] = None):
+        self.notary = notary
+        self._inputs: List[StateRef] = []
+        self._outputs: List[TransactionState] = []
+        self._commands: List[Command] = []
+        self._attachments: List[SecureHash] = []
+        self._time_window: Optional[TimeWindow] = None
+        self._privacy_salt: bytes = os.urandom(32)
+        self._signers: List[KeyPair] = []
+
+    # -- assembly -----------------------------------------------------------
+
+    def add_input_state(self, state_and_ref: StateAndRef) -> "TransactionBuilder":
+        notary = state_and_ref.state.notary
+        if self.notary is None:
+            self.notary = notary
+        elif notary != self.notary:
+            raise ValueError(
+                f"input state requires notary {notary}, builder has {self.notary}"
+            )
+        self._inputs.append(state_and_ref.ref)
+        return self
+
+    def add_output_state(
+        self,
+        state: Union[TransactionState, ContractState],
+        notary: Optional[Party] = None,
+        encumbrance: Optional[int] = None,
+    ) -> "TransactionBuilder":
+        if isinstance(state, TransactionState):
+            self._outputs.append(state)
+        else:
+            n = notary or self.notary
+            if n is None:
+                raise ValueError("no notary for output state")
+            self._outputs.append(TransactionState(state, n, encumbrance))
+        return self
+
+    def add_command(
+        self, data: CommandData, *signers: PublicKey
+    ) -> "TransactionBuilder":
+        self._commands.append(Command(data, tuple(signers)))
+        return self
+
+    def add_attachment(self, attachment_id: SecureHash) -> "TransactionBuilder":
+        self._attachments.append(attachment_id)
+        return self
+
+    def set_time_window(self, time_window: TimeWindow) -> "TransactionBuilder":
+        self._time_window = time_window
+        return self
+
+    def with_items(self, *items) -> "TransactionBuilder":
+        for item in items:
+            if isinstance(item, StateAndRef):
+                self.add_input_state(item)
+            elif isinstance(item, (TransactionState, ContractState)):
+                self.add_output_state(item)
+            elif isinstance(item, Command):
+                self._commands.append(item)
+            elif isinstance(item, SecureHash):
+                self.add_attachment(item)
+            elif isinstance(item, TimeWindow):
+                self.set_time_window(item)
+            else:
+                raise ValueError(f"cannot add {item!r} to a transaction")
+        return self
+
+    # -- output -------------------------------------------------------------
+
+    def to_wire_transaction(self) -> WireTransaction:
+        return WireTransaction(
+            inputs=tuple(self._inputs),
+            outputs=tuple(self._outputs),
+            commands=tuple(self._commands),
+            attachments=tuple(self._attachments),
+            notary=self.notary,
+            time_window=self._time_window,
+            privacy_salt=self._privacy_salt,
+        )
+
+    def sign_with(self, key_pair: KeyPair) -> "TransactionBuilder":
+        self._signers.append(key_pair)
+        return self
+
+    def to_signed_transaction(
+        self, check_sufficient_signatures: bool = True
+    ) -> SignedTransaction:
+        wtx = self.to_wire_transaction()
+        content = wtx.id.bytes
+        sigs = [
+            DigitalSignatureWithKey(crypto.do_sign(kp.private, content), kp.public)
+            for kp in self._signers
+        ]
+        stx = SignedTransaction.of(wtx, sigs)
+        if check_sufficient_signatures:
+            stx.verify_required_signatures()
+        return stx

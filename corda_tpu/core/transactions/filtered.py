@@ -1,0 +1,152 @@
+"""FilteredTransaction: Merkle tear-offs for non-validating notaries/oracles.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/transactions/
+MerkleTransaction.kt:1-179` — `FilteredLeaves` + `PartialMerkleTree`;
+`verify()` recomputes leaf hashes from the revealed components + nonces and
+checks them against the partial tree and the expected root (= tx id).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from ..contracts.structures import Command, StateRef, TimeWindow, TransactionState
+from ..crypto.merkle import MerkleTree, PartialMerkleTree
+from ..crypto.secure_hash import SecureHash
+from ..identity import Party
+from ..serialization.codec import register_adapter, serialize
+from .wire import ComponentGroup, WireTransaction, component_leaf_hash
+
+
+class FilteredTransactionVerificationError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class FilteredComponent:
+    """A revealed component with its group/index position and leaf nonce."""
+
+    group: int
+    index: int
+    component: object
+    nonce: SecureHash
+
+
+@dataclass(frozen=True)
+class FilteredTransaction:
+    id: SecureHash
+    filtered_components: Tuple[FilteredComponent, ...]
+    partial_tree: PartialMerkleTree
+
+    @staticmethod
+    def build(
+        wtx: WireTransaction, filter_fn: Callable[[object], bool]
+    ) -> "FilteredTransaction":
+        """Reveal components matching filter_fn; prune the rest to hashes."""
+        from .wire import component_nonce
+
+        included: List[FilteredComponent] = []
+        included_hashes: List[SecureHash] = []
+        for group, idx, comp in wtx.available_components():
+            if filter_fn(comp):
+                nonce = component_nonce(wtx.privacy_salt, group, idx)
+                included.append(FilteredComponent(group, idx, comp, nonce))
+                included_hashes.append(
+                    component_leaf_hash(nonce, group, idx, serialize(comp))
+                )
+        tree = wtx.merkle_tree
+        partial = PartialMerkleTree.build(tree, included_hashes)
+        return FilteredTransaction(tree.hash, tuple(included), partial)
+
+    def verify(self) -> None:
+        """Recompute each revealed leaf hash and prove inclusion under id.
+
+        The leaf preimage binds (group, index), so a component relabelled to a
+        different position/group hashes to a value absent from the tree."""
+        hashes = [
+            component_leaf_hash(fc.nonce, fc.group, fc.index, serialize(fc.component))
+            for fc in self.filtered_components
+        ]
+        if len(set(hashes)) != len(hashes):
+            raise FilteredTransactionVerificationError("duplicate components")
+        if not self.partial_tree.verify(self.id, hashes):
+            raise FilteredTransactionVerificationError(
+                f"partial Merkle tree verification failed for {self.id}"
+            )
+
+    def check_with_fun(self, checking_fun: Callable[[object], bool]) -> bool:
+        """True if there is at least one component and every revealed component
+        satisfies checking_fun (reference FilteredTransaction.checkWithFun)."""
+        components = [fc.component for fc in self.filtered_components]
+        return bool(components) and all(checking_fun(c) for c in components)
+
+    # -- typed accessors ----------------------------------------------------
+
+    def _of_group(self, group: int) -> List:
+        return [
+            fc.component for fc in self.filtered_components if fc.group == group
+        ]
+
+    @property
+    def inputs(self) -> List[StateRef]:
+        return self._of_group(ComponentGroup.INPUTS)
+
+    @property
+    def outputs(self) -> List[TransactionState]:
+        return self._of_group(ComponentGroup.OUTPUTS)
+
+    @property
+    def commands(self) -> List[Command]:
+        return self._of_group(ComponentGroup.COMMANDS)
+
+    @property
+    def attachments(self) -> List[SecureHash]:
+        return self._of_group(ComponentGroup.ATTACHMENTS)
+
+    @property
+    def notary(self) -> Optional[Party]:
+        n = self._of_group(ComponentGroup.NOTARY)
+        return n[0] if n else None
+
+    @property
+    def time_window(self) -> Optional[TimeWindow]:
+        t = self._of_group(ComponentGroup.TIMEWINDOW)
+        return t[0] if t else None
+
+
+def _encode_partial(node) -> dict:
+    from ..crypto.merkle import HiddenLeaf, PartialLeaf, PartialNode
+
+    if isinstance(node, PartialLeaf):
+        return {"kind": 0, "hash": node.hash}
+    if isinstance(node, HiddenLeaf):
+        return {"kind": 1, "hash": node.hash, "span": node.leaf_span}
+    return {"kind": 2, "left": _encode_partial(node.left), "right": _encode_partial(node.right)}
+
+
+def _decode_partial(d):
+    from ..crypto.merkle import HiddenLeaf, PartialLeaf, PartialNode
+
+    if d["kind"] == 0:
+        return PartialLeaf(d["hash"])
+    if d["kind"] == 1:
+        return HiddenLeaf(d["hash"], d["span"])
+    return PartialNode(_decode_partial(d["left"]), _decode_partial(d["right"]))
+
+
+register_adapter(
+    FilteredComponent, "FilteredComponent",
+    lambda f: {"group": f.group, "index": f.index, "component": f.component, "nonce": f.nonce},
+    lambda d: FilteredComponent(d["group"], d["index"], d["component"], d["nonce"]),
+)
+register_adapter(
+    FilteredTransaction, "FilteredTransaction",
+    lambda f: {
+        "id": f.id,
+        "components": list(f.filtered_components),
+        "tree": _encode_partial(f.partial_tree.root),
+    },
+    lambda d: FilteredTransaction(
+        d["id"], tuple(d["components"]), PartialMerkleTree(_decode_partial(d["tree"]))
+    ),
+)

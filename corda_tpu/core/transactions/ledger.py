@@ -1,0 +1,169 @@
+"""LedgerTransaction: a fully-resolved transaction ready for verification.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/transactions/
+LedgerTransaction.kt` — verify() runs every in/out contract (:63-79), plus
+notary-consistency and encumbrance checks (:88-125). Serializable so it can be
+shipped to the out-of-process / TPU verifier (:22-25).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, TypeVar
+
+from ..contracts.structures import (
+    Attachment,
+    AuthenticatedObject,
+    StateAndRef,
+    TimeWindow,
+    TransactionState,
+    TransactionVerificationError,
+    resolve_contract,
+)
+from ..crypto.secure_hash import SecureHash
+from ..identity import Party
+from ..serialization.codec import register_adapter
+
+S = TypeVar("S")
+K = TypeVar("K")
+
+
+@dataclass(frozen=True)
+class InOutGroup:
+    """States grouped by a key, for per-group contract verification
+    (reference LedgerTransaction.InOutGroup / groupStates)."""
+
+    inputs: tuple
+    outputs: tuple
+    grouping_key: object
+
+
+@dataclass(frozen=True)
+class LedgerTransaction:
+    inputs: Tuple[StateAndRef, ...]
+    outputs: Tuple[TransactionState, ...]
+    commands: Tuple[AuthenticatedObject, ...]
+    attachments: Tuple[Attachment, ...]
+    id: SecureHash
+    notary: Optional[Party]
+    time_window: Optional[TimeWindow]
+
+    # -- verification (contract half; signatures live on SignedTransaction) --
+
+    def verify(self) -> None:
+        """Structural checks then every distinct contract's verify()."""
+        self._check_no_notary_change()
+        self._check_encumbrances_protected()
+        contracts = {}
+        for ts in [s.state for s in self.inputs] + list(self.outputs):
+            contracts[ts.data.contract_name] = True
+        for name in contracts:
+            contract = resolve_contract(name)
+            try:
+                contract.verify(self)
+            except TransactionVerificationError:
+                raise
+            except Exception as e:
+                raise TransactionVerificationError(
+                    self.id, f"contract {name} rejected: {e}"
+                ) from e
+
+    def _check_no_notary_change(self) -> None:
+        if self.notary is None:
+            if self.inputs:
+                raise TransactionVerificationError(
+                    self.id, "transaction with input states must have a notary"
+                )
+            return
+        for s in self.inputs:
+            if s.state.notary != self.notary:
+                raise TransactionVerificationError(
+                    self.id,
+                    "input state notary differs from transaction notary; "
+                    "use a notary-change transaction",
+                )
+
+    def _check_encumbrances_protected(self) -> None:
+        # every encumbrance pointer must reference an output of this tx, and
+        # an encumbered input must have its encumbrance consumed alongside it
+        n_out = len(self.outputs)
+        for i, out in enumerate(self.outputs):
+            if out.encumbrance is not None:
+                if out.encumbrance == i or not (0 <= out.encumbrance < n_out):
+                    raise TransactionVerificationError(
+                        self.id, f"output {i} has invalid encumbrance {out.encumbrance}"
+                    )
+        consumed = {s.ref for s in self.inputs}
+        for s in self.inputs:
+            if s.state.encumbrance is not None:
+                from ..contracts.structures import StateRef
+
+                enc_ref = StateRef(s.ref.txhash, s.state.encumbrance)
+                if enc_ref not in consumed:
+                    raise TransactionVerificationError(
+                        self.id,
+                        f"encumbered input {s.ref} consumed without its "
+                        f"encumbrance {enc_ref}",
+                    )
+
+    # -- convenience accessors (reference LedgerTransaction helpers) --------
+
+    @property
+    def input_states(self) -> List:
+        return [s.state.data for s in self.inputs]
+
+    @property
+    def output_states(self) -> List:
+        return [s.data for s in self.outputs]
+
+    def inputs_of_type(self, cls) -> List:
+        return [s for s in self.input_states if isinstance(s, cls)]
+
+    def outputs_of_type(self, cls) -> List:
+        return [s for s in self.output_states if isinstance(s, cls)]
+
+    def commands_of_type(self, cls) -> List[AuthenticatedObject]:
+        return [c for c in self.commands if isinstance(c.value, cls)]
+
+    def group_states(
+        self, cls, key_fn: Callable[[object], K]
+    ) -> List[InOutGroup]:
+        """Group in/out states of a type by a key (reference groupStates) —
+        the backbone of fungible-asset contract verification."""
+        groups: Dict[object, Tuple[list, list]] = {}
+        for s in self.inputs_of_type(cls):
+            groups.setdefault(key_fn(s), ([], []))[0].append(s)
+        for s in self.outputs_of_type(cls):
+            groups.setdefault(key_fn(s), ([], []))[1].append(s)
+        return [
+            InOutGroup(tuple(ins), tuple(outs), k)
+            for k, (ins, outs) in groups.items()
+        ]
+
+
+register_adapter(
+    InOutGroup, "InOutGroup",
+    lambda g: {"inputs": list(g.inputs), "outputs": list(g.outputs), "key": g.grouping_key},
+    lambda d: InOutGroup(tuple(d["inputs"]), tuple(d["outputs"]), d["key"]),
+)
+register_adapter(
+    Attachment, "Attachment",
+    lambda a: {"id": a.id, "data": a.data},
+    lambda d: Attachment(d["id"], d["data"]),
+)
+register_adapter(
+    AuthenticatedObject, "AuthenticatedObject",
+    lambda a: {"signers": list(a.signers), "parties": list(a.signing_parties), "value": a.value},
+    lambda d: AuthenticatedObject(tuple(d["signers"]), tuple(d["parties"]), d["value"]),
+)
+register_adapter(
+    LedgerTransaction, "LedgerTransaction",
+    lambda t: {
+        "inputs": list(t.inputs), "outputs": list(t.outputs),
+        "commands": list(t.commands), "attachments": list(t.attachments),
+        "id": t.id, "notary": t.notary, "time_window": t.time_window,
+    },
+    lambda d: LedgerTransaction(
+        tuple(d["inputs"]), tuple(d["outputs"]), tuple(d["commands"]),
+        tuple(d["attachments"]), d["id"], d["notary"], d["time_window"],
+    ),
+)

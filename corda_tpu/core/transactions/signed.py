@@ -1,0 +1,167 @@
+"""SignedTransaction and signature-set verification.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/transactions/
+SignedTransaction.kt` (:78-98 withAdditionalSignature, :143-149 verify) and
+`TransactionWithSignatures.kt` (:26,41-47 verifyRequiredSignatures /
+verifySignaturesExcept, :58-62 checkSignaturesAreValid, :72-78 missing-key
+detection via isFulfilledBy).
+
+TPU-first: checkSignaturesAreValid is *batch-first* — the reference's hot
+per-signature loop is replaced with one call into the scheme-bucketed batch
+verifier (core.crypto.batch -> ops.ed25519_batch on device).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+from typing import FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from ..crypto import batch as crypto_batch
+from ..crypto.crypto import SignatureError
+from ..crypto.keys import PublicKey
+from ..crypto.secure_hash import SecureHash
+from ..crypto.signing import DigitalSignatureWithKey
+from ..serialization.codec import deserialize, register_adapter, serialize
+from .wire import WireTransaction
+
+
+class SignaturesMissingError(SignatureError):
+    def __init__(self, missing: FrozenSet[PublicKey], descriptions: List[str], tx_id):
+        self.missing = missing
+        self.descriptions = descriptions
+        self.tx_id = tx_id
+        super().__init__(
+            f"missing signatures on {tx_id} for: "
+            + ", ".join(descriptions or [repr(k) for k in missing])
+        )
+
+
+class TransactionWithSignatures:
+    """Mixin: signature-set verification over a Merkle-identified payload."""
+
+    id: SecureHash
+    sigs: Tuple[DigitalSignatureWithKey, ...]
+
+    @property
+    def required_signing_keys(self) -> frozenset:
+        raise NotImplementedError
+
+    def get_key_descriptions(self, keys: Set[PublicKey]) -> List[str]:
+        return [repr(k) for k in keys]
+
+    def verify_required_signatures(self) -> None:
+        self.verify_signatures_except()
+
+    def verify_signatures_except(self, *allowed_to_be_missing: PublicKey) -> None:
+        """Check every attached signature cryptographically, then check the
+        required-keys set is fulfilled modulo allowed_to_be_missing."""
+        self.check_signatures_are_valid()
+        needed = self._missing_signatures()
+        missing = needed - set(allowed_to_be_missing)
+        if missing:
+            raise SignaturesMissingError(
+                frozenset(missing), self.get_key_descriptions(missing), self.id
+            )
+
+    def check_signatures_are_valid(self) -> None:
+        """Batch cryptographic check of all attached signatures over id.bytes
+        (replaces the reference's per-sig loop TransactionWithSignatures.kt:58-62)."""
+        if not self.sigs:
+            return
+        content = self.id.bytes
+        results = crypto_batch.verify_batch(
+            [(sig.by, sig.bytes, content) for sig in self.sigs]
+        )
+        bad = [i for i, ok in enumerate(results) if not ok]
+        if bad:
+            raise SignatureError(
+                f"invalid signature(s) at positions {bad} on {self.id}"
+            )
+
+    def _missing_signatures(self) -> Set[PublicKey]:
+        # The signed set is exactly the keys that produced valid signatures —
+        # never expanded to composite leaves, or an attacker could wrap a
+        # victim's key in a 1-of-2 CompositeKey and "sign for" it. A required
+        # CompositeKey is fulfilled when its threshold is met by keys in this
+        # set (reference TransactionWithSignatures.kt:72-78).
+        signed = {sig.by for sig in self.sigs}
+        return {
+            k
+            for k in self.required_signing_keys
+            if not k.is_fulfilled_by(signed)
+        }
+
+
+@dataclass(frozen=True)
+class SignedTransaction(TransactionWithSignatures):
+    """Serialized WireTransaction bytes + signatures over its id."""
+
+    tx_bits: bytes
+    sigs: Tuple[DigitalSignatureWithKey, ...]
+
+    def __post_init__(self):
+        if not self.sigs:
+            raise ValueError("tried to make a SignedTransaction without signatures")
+
+    @staticmethod
+    def of(tx: WireTransaction, sigs: Iterable[DigitalSignatureWithKey]) -> "SignedTransaction":
+        return SignedTransaction(serialize(tx), tuple(sigs))
+
+    @cached_property
+    def tx(self) -> WireTransaction:
+        # cached: tx_bits is immutable, and verification touches .tx / .id
+        # several times (each access would otherwise re-deserialize and
+        # rebuild the Merkle tree)
+        return deserialize(self.tx_bits)
+
+    @property
+    def id(self) -> SecureHash:
+        return self.tx.id
+
+    @property
+    def required_signing_keys(self) -> frozenset:
+        return self.tx.required_signing_keys
+
+    @property
+    def notary(self):
+        return self.tx.notary
+
+    @property
+    def inputs(self):
+        return self.tx.inputs
+
+    def with_additional_signature(self, sig: DigitalSignatureWithKey) -> "SignedTransaction":
+        return SignedTransaction(self.tx_bits, self.sigs + (sig,))
+
+    def with_additional_signatures(
+        self, sigs: Iterable[DigitalSignatureWithKey]
+    ) -> "SignedTransaction":
+        return SignedTransaction(self.tx_bits, self.sigs + tuple(sigs))
+
+    def __add__(self, sig: DigitalSignatureWithKey) -> "SignedTransaction":
+        return self.with_additional_signature(sig)
+
+    def verify(self, services, check_sufficient_signatures: bool = True) -> None:
+        """Full verification: signatures, then resolution + contract verify
+        through the (possibly async/batched) TransactionVerifierService
+        (reference SignedTransaction.kt:143-149)."""
+        if check_sufficient_signatures:
+            self.verify_required_signatures()
+        else:
+            self.check_signatures_are_valid()
+        ltx = self.tx.to_ledger_transaction(
+            resolve_state=services.load_state,
+            resolve_attachment=services.open_attachment,
+            resolve_party=getattr(services, "party_from_key", lambda key: None),
+        )
+        services.transaction_verifier_service.verify_sync(ltx)
+
+    def __repr__(self) -> str:
+        return f"SignedTransaction({self.id}, {len(self.sigs)} sigs)"
+
+
+register_adapter(
+    SignedTransaction, "SignedTransaction",
+    lambda t: {"tx_bits": t.tx_bits, "sigs": list(t.sigs)},
+    lambda d: SignedTransaction(d["tx_bits"], tuple(d["sigs"])),
+)

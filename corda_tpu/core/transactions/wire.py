@@ -1,0 +1,196 @@
+"""WireTransaction: the serialized, Merkle-tree-identified transaction.
+
+Parity: reference `core/src/main/kotlin/net/corda/core/transactions/
+WireTransaction.kt` — id = Merkle root over component leaf hashes (:39,104),
+per-leaf nonces derived from a privacy salt (:97-166), requiredSigningKeys
+(:42-50), toLedgerTransaction resolution (:60-92).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import struct
+from dataclasses import dataclass, field
+from functools import cached_property
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..contracts.structures import (
+    Attachment,
+    AuthenticatedObject,
+    Command,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+    TransactionVerificationError,
+)
+from ..crypto.merkle import MerkleTree
+from ..crypto.secure_hash import SecureHash
+from ..identity import Party
+from ..serialization.codec import register_adapter, serialize
+
+
+class ComponentGroup(enum.IntEnum):
+    """Merkle leaf ordering (reference ComponentGroupEnum)."""
+
+    INPUTS = 0
+    OUTPUTS = 1
+    COMMANDS = 2
+    ATTACHMENTS = 3
+    NOTARY = 4
+    TIMEWINDOW = 5
+
+
+def component_nonce(privacy_salt: bytes, group: int, index: int) -> SecureHash:
+    """Deterministic per-leaf nonce (reference WireTransaction.kt:97-166):
+    prevents brute-forcing hidden components of a FilteredTransaction."""
+    return SecureHash.sha256(privacy_salt + struct.pack(">II", group, index))
+
+
+def component_leaf_hash(
+    nonce: SecureHash, group: int, index: int, component_bytes: bytes
+) -> SecureHash:
+    """Leaf preimage binds the component's (group, index) position so a
+    FilteredTransaction prover cannot relabel a genuine leaf as a different
+    group or index (the verifier has no privacy salt to recheck the nonce)."""
+    return SecureHash.sha256(
+        nonce.bytes + struct.pack(">II", group, index) + component_bytes
+    )
+
+
+@dataclass(frozen=True)
+class WireTransaction:
+    inputs: Tuple[StateRef, ...] = ()
+    outputs: Tuple[TransactionState, ...] = ()
+    commands: Tuple[Command, ...] = ()
+    attachments: Tuple[SecureHash, ...] = ()
+    notary: Optional[Party] = None
+    time_window: Optional[TimeWindow] = None
+    privacy_salt: bytes = field(default_factory=lambda: os.urandom(32))
+
+    def __post_init__(self):
+        if len(self.privacy_salt) != 32:
+            raise ValueError("privacy salt must be 32 bytes")
+        if not (self.inputs or self.outputs or self.commands):
+            raise ValueError("transaction must have inputs, outputs or commands")
+        if self.time_window is not None and self.notary is None:
+            raise ValueError("transactions with a time window must have a notary")
+
+    # -- components & id ----------------------------------------------------
+
+    def available_components(self) -> List[Tuple[int, int, object]]:
+        """(group, index, component) triples in canonical Merkle-leaf order."""
+        out: List[Tuple[int, int, object]] = []
+        for idx, c in enumerate(self.inputs):
+            out.append((ComponentGroup.INPUTS, idx, c))
+        for idx, c in enumerate(self.outputs):
+            out.append((ComponentGroup.OUTPUTS, idx, c))
+        for idx, c in enumerate(self.commands):
+            out.append((ComponentGroup.COMMANDS, idx, c))
+        for idx, c in enumerate(self.attachments):
+            out.append((ComponentGroup.ATTACHMENTS, idx, c))
+        if self.notary is not None:
+            out.append((ComponentGroup.NOTARY, 0, self.notary))
+        if self.time_window is not None:
+            out.append((ComponentGroup.TIMEWINDOW, 0, self.time_window))
+        return out
+
+    def component_hashes(self) -> List[SecureHash]:
+        return [
+            component_leaf_hash(
+                component_nonce(self.privacy_salt, group, idx), group, idx, serialize(c)
+            )
+            for group, idx, c in self.available_components()
+        ]
+
+    @cached_property
+    def merkle_tree(self) -> MerkleTree:
+        # cached: the dataclass is frozen/content-addressed and id is hot
+        return MerkleTree.get_merkle_tree(self.component_hashes())
+
+    @property
+    def id(self) -> SecureHash:
+        return self.merkle_tree.hash
+
+    # -- signing keys -------------------------------------------------------
+
+    @property
+    def required_signing_keys(self) -> frozenset:
+        """Command signers, plus the notary when its signature is semantically
+        required (consuming inputs or attesting a time window) — reference
+        WireTransaction.kt:42-50."""
+        keys = {k for cmd in self.commands for k in cmd.signers}
+        if self.notary is not None and (self.inputs or self.time_window):
+            keys.add(self.notary.owning_key)
+        return frozenset(keys)
+
+    # -- resolution ---------------------------------------------------------
+
+    def to_ledger_transaction(
+        self,
+        resolve_state: Callable[[StateRef], TransactionState],
+        resolve_attachment: Callable[[SecureHash], Attachment],
+        resolve_party: Callable[[object], Optional[Party]] = lambda key: None,
+    ) -> "LedgerTransaction":
+        """Resolve refs into a verifiable LedgerTransaction (reference
+        WireTransaction.toLedgerTransaction :60-92)."""
+        from .ledger import LedgerTransaction
+
+        resolved_inputs = tuple(
+            StateAndRef(resolve_state(ref), ref) for ref in self.inputs
+        )
+        resolved_attachments = tuple(
+            resolve_attachment(h) for h in self.attachments
+        )
+        auth_commands = tuple(
+            AuthenticatedObject(
+                signers=cmd.signers,
+                signing_parties=tuple(
+                    p for p in (resolve_party(k) for k in cmd.signers) if p is not None
+                ),
+                value=cmd.value,
+            )
+            for cmd in self.commands
+        )
+        return LedgerTransaction(
+            inputs=resolved_inputs,
+            outputs=self.outputs,
+            commands=auth_commands,
+            attachments=resolved_attachments,
+            id=self.id,
+            notary=self.notary,
+            time_window=self.time_window,
+        )
+
+    # -- tear-offs ----------------------------------------------------------
+
+    def build_filtered_transaction(self, filter_fn: Callable[[object], bool]):
+        """Merkle tear-off revealing only components matching filter_fn
+        (reference buildFilteredTransaction / filterWithFun :97-166)."""
+        from .filtered import FilteredTransaction
+
+        return FilteredTransaction.build(self, filter_fn)
+
+    def out_ref(self, index: int) -> StateAndRef:
+        return StateAndRef(self.outputs[index], StateRef(self.id, index))
+
+    def __repr__(self) -> str:
+        return f"WireTransaction({self.id})"
+
+
+register_adapter(
+    WireTransaction, "WireTransaction",
+    lambda t: {
+        "inputs": list(t.inputs),
+        "outputs": list(t.outputs),
+        "commands": list(t.commands),
+        "attachments": list(t.attachments),
+        "notary": t.notary,
+        "time_window": t.time_window,
+        "privacy_salt": t.privacy_salt,
+    },
+    lambda d: WireTransaction(
+        tuple(d["inputs"]), tuple(d["outputs"]), tuple(d["commands"]),
+        tuple(d["attachments"]), d["notary"], d["time_window"], d["privacy_salt"],
+    ),
+)

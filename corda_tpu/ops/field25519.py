@@ -105,7 +105,14 @@ def add(a, b):
 
 
 def sub(a, b):
-    """a - b mod p via a + 2p - b with a signed borrow chain."""
+    """a - b mod p via a + 2p - b with a signed borrow chain.
+
+    Strict inputs only bound a, b < 2^256, so a + 2p - b lies in (-38, 2^257):
+    the final carry is -1, 0 or 1. The -1 (negative) case means the masked
+    limbs hold a + 2p - b + 2^256, a value in (2^256-38, 2^256) which is
+    congruent to (a - b) + 38 mod p — and whose limb0 >= 0xFFDB, so
+    subtracting the 38 back off cannot borrow.
+    """
     ai = a.astype(jnp.int32)
     bi = b.astype(jnp.int32)
     outs = []
@@ -115,7 +122,10 @@ def sub(a, b):
         outs.append((v & 0xFFFF).astype(jnp.uint32))
         carry = v >> 16  # arithmetic shift keeps borrow semantics
     r = jnp.stack(outs, axis=-1)
-    return _fold_tail(r, carry.astype(jnp.uint32))  # carry-out in {0, 1}
+    negative = carry < 0
+    pos = _fold_tail(r, jnp.maximum(carry, 0).astype(jnp.uint32))
+    neg = r.at[..., 0].add(jnp.uint32(0) - jnp.uint32(38))  # limb0 >= 0xFFDB
+    return jnp.where(negative[..., None], neg, pos)
 
 
 def neg(a):

@@ -54,6 +54,23 @@ class TestField:
             assert F.limbs_to_int(sq[i]) == x * x % F.P_INT
         assert list(np.asarray(F.lt_p(a))) == [x < F.P_INT for x in edges]
 
+    def test_sub_underflow_edge(self):
+        # b > a + 2p drives the borrow chain negative; the result must stay
+        # congruent mod p (regression: negative carry cast to huge uint32)
+        cases = [
+            (0, 2**256 - 2),
+            (0, 2**256 - 1),
+            (5, 2**256 - 10),
+            (36, 2**256 - 1),
+            (2**256 - 1, 1),
+            (0, 0),
+        ]
+        a = np.stack([F.int_to_limbs(x) for x, _ in cases])
+        b = np.stack([F.int_to_limbs(y) for _, y in cases])
+        d = np.asarray(F.canonical(F.sub(a, b)))
+        for i, (x, y) in enumerate(cases):
+            assert F.limbs_to_int(d[i]) == (x - y) % F.P_INT, cases[i]
+
     def test_pow_const(self):
         x = 123456789
         a = F.int_to_limbs(x)[None, :]

@@ -1,0 +1,330 @@
+"""Transaction model tests.
+
+Layer parity: reference `core/src/test/kotlin/net/corda/core/transactions/`
+(WireTransaction/SignedTransaction tests) + `PartialMerkleTreeTest.kt`'s
+FilteredTransaction cases + TransactionSignature batch-check semantics.
+"""
+from dataclasses import dataclass
+from typing import List
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    Amount,
+    Command,
+    Contract,
+    ContractState,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.crypto.composite import CompositeKey
+from corda_tpu.core.crypto.signing import DigitalSignatureWithKey
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization.codec import corda_serializable, deserialize, serialize
+from corda_tpu.core.transactions import (
+    FilteredTransaction,
+    FilteredTransactionVerificationError,
+    SignaturesMissingError,
+    SignedTransaction,
+    TransactionBuilder,
+    WireTransaction,
+)
+from corda_tpu.core.transactions.signed import SignatureError
+
+ALICE_KP = crypto.entropy_to_keypair(70)
+BOB_KP = crypto.entropy_to_keypair(71)
+NOTARY_KP = crypto.entropy_to_keypair(72)
+ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)
+BOB = Party("O=Bob,L=New York,C=US", BOB_KP.public)
+NOTARY = Party("O=Notary,L=Zurich,C=CH", NOTARY_KP.public)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class DummyState(ContractState):
+    magic: int = 42
+    contract_name = "DummyContract"
+
+    @property
+    def participants(self) -> List:
+        return []
+
+
+@contract(name="DummyContract")
+class DummyContract(Contract):
+    def verify(self, tx) -> None:
+        for s in tx.outputs_of_type(DummyState):
+            if s.magic != 42:
+                raise TransactionVerificationError(tx.id, "bad magic")
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class DummyCommand(TypeOnlyCommandData):
+    pass
+
+
+def _issue_builder():
+    b = TransactionBuilder(notary=NOTARY)
+    b.add_output_state(DummyState())
+    b.add_command(DummyCommand(), ALICE_KP.public)
+    return b
+
+
+class TestWireTransaction:
+    def test_id_is_merkle_root_and_stable(self):
+        wtx = _issue_builder().to_wire_transaction()
+        assert wtx.id == wtx.merkle_tree.hash
+        # deserialized copy has the same id (byte-stable components)
+        wtx2 = deserialize(serialize(wtx))
+        assert wtx2.id == wtx.id
+
+    def test_id_changes_with_content(self):
+        b = _issue_builder()
+        wtx1 = b.to_wire_transaction()
+        b.add_output_state(DummyState())
+        assert b.to_wire_transaction().id != wtx1.id
+
+    def test_required_signing_keys(self):
+        wtx = _issue_builder().to_wire_transaction()
+        # issue tx: no inputs, no time window -> notary key not required
+        assert wtx.required_signing_keys == frozenset({ALICE_KP.public})
+        b = _issue_builder()
+        b.set_time_window(TimeWindow.from_only(10))
+        assert NOTARY_KP.public in b.to_wire_transaction().required_signing_keys
+
+    def test_empty_transaction_rejected(self):
+        with pytest.raises(ValueError):
+            WireTransaction(notary=NOTARY)
+
+    def test_time_window_requires_notary(self):
+        with pytest.raises(ValueError):
+            WireTransaction(
+                outputs=(TransactionState(DummyState(), NOTARY),),
+                time_window=TimeWindow.from_only(1),
+                notary=None,
+            )
+
+
+class TestSignedTransaction:
+    def test_sign_and_verify(self):
+        stx = _issue_builder().sign_with(ALICE_KP).to_signed_transaction()
+        stx.verify_required_signatures()
+
+    def test_missing_signature_detected(self):
+        stx = _issue_builder().sign_with(BOB_KP).to_signed_transaction(
+            check_sufficient_signatures=False
+        )
+        with pytest.raises(SignaturesMissingError) as e:
+            stx.verify_required_signatures()
+        assert ALICE_KP.public in e.value.missing
+
+    def test_verify_signatures_except(self):
+        stx = _issue_builder().sign_with(BOB_KP).to_signed_transaction(
+            check_sufficient_signatures=False
+        )
+        stx.verify_signatures_except(ALICE_KP.public)
+
+    def test_tampered_signature_rejected(self):
+        stx = _issue_builder().sign_with(ALICE_KP).to_signed_transaction()
+        good = stx.sigs[0]
+        bad = DigitalSignatureWithKey(
+            good.bytes[:-1] + bytes([good.bytes[-1] ^ 1]), good.by
+        )
+        tampered = SignedTransaction(stx.tx_bits, (bad,))
+        with pytest.raises(SignatureError):
+            tampered.verify_required_signatures()
+
+    def test_wrong_key_signature_rejected(self):
+        stx = _issue_builder().sign_with(ALICE_KP).to_signed_transaction()
+        forged = DigitalSignatureWithKey(stx.sigs[0].bytes, BOB_KP.public)
+        with pytest.raises(SignatureError):
+            SignedTransaction(stx.tx_bits, (forged,)).verify_required_signatures()
+
+    def test_composite_key_threshold_fulfilment(self):
+        composite = CompositeKey.Builder().add_keys(
+            ALICE_KP.public, BOB_KP.public
+        ).build(threshold=1)
+        b = TransactionBuilder(notary=NOTARY)
+        b.add_output_state(DummyState())
+        b.add_command(DummyCommand(), composite)
+        stx = b.sign_with(ALICE_KP).to_signed_transaction(
+            check_sufficient_signatures=False
+        )
+        # 1-of-2 composite requirement satisfied by Alice's leaf signature
+        stx.verify_required_signatures()
+
+    def test_composite_wrapping_cannot_impersonate_leaf_signer(self):
+        # Attack: Bob wraps Alice's required key in a 1-of-2 composite he can
+        # satisfy alone, then signs with the composite. Alice's required
+        # signature must still be reported missing.
+        from corda_tpu.core.crypto.composite import CompositeSignaturesWithKeys
+
+        composite = CompositeKey.Builder().add_keys(
+            BOB_KP.public, ALICE_KP.public
+        ).build(threshold=1)
+        stx = _issue_builder().sign_with(BOB_KP).to_signed_transaction(
+            check_sufficient_signatures=False
+        )
+        leaf_sig = crypto.do_sign(BOB_KP.private, stx.id.bytes)
+        comp_sig = DigitalSignatureWithKey(
+            CompositeSignaturesWithKeys(((BOB_KP.public, leaf_sig),)).serialize(),
+            composite,
+        )
+        attacked = SignedTransaction(stx.tx_bits, (comp_sig,))
+        with pytest.raises(SignaturesMissingError) as e:
+            attacked.verify_required_signatures()
+        assert ALICE_KP.public in e.value.missing
+
+    def test_with_additional_signature(self):
+        stx = _issue_builder().sign_with(BOB_KP).to_signed_transaction(
+            check_sufficient_signatures=False
+        )
+        sig = DigitalSignatureWithKey(
+            crypto.do_sign(ALICE_KP.private, stx.id.bytes), ALICE_KP.public
+        )
+        (stx + sig).verify_required_signatures()
+
+    def test_serialization_roundtrip(self):
+        stx = _issue_builder().sign_with(ALICE_KP).to_signed_transaction()
+        stx2 = deserialize(serialize(stx))
+        assert stx2.id == stx.id
+        stx2.verify_required_signatures()
+
+
+class TestLedgerTransaction:
+    def _ledger_tx(self, wtx: WireTransaction, input_states=None):
+        input_states = input_states or {}
+        return wtx.to_ledger_transaction(
+            resolve_state=lambda ref: input_states[ref],
+            resolve_attachment=lambda h: (_ for _ in ()).throw(KeyError(h)),
+        )
+
+    def test_contract_verify_passes(self):
+        ltx = self._ledger_tx(_issue_builder().to_wire_transaction())
+        ltx.verify()
+
+    def test_contract_verify_rejects(self):
+        b = TransactionBuilder(notary=NOTARY)
+        b.add_output_state(DummyState(magic=13))
+        b.add_command(DummyCommand(), ALICE_KP.public)
+        ltx = self._ledger_tx(b.to_wire_transaction())
+        with pytest.raises(TransactionVerificationError):
+            ltx.verify()
+
+    def test_notary_consistency(self):
+        issue = _issue_builder().to_wire_transaction()
+        ref = StateRef(issue.id, 0)
+        other_notary = Party("O=Other,L=Paris,C=FR", crypto.entropy_to_keypair(99).public)
+        b = TransactionBuilder(notary=other_notary)
+        b._inputs.append(ref)  # bypass builder's own notary check
+        b.add_output_state(DummyState())
+        b.add_command(DummyCommand(), ALICE_KP.public)
+        ltx = self._ledger_tx(
+            b.to_wire_transaction(), {ref: TransactionState(DummyState(), NOTARY)}
+        )
+        with pytest.raises(TransactionVerificationError, match="notary"):
+            ltx.verify()
+
+    def test_group_states(self):
+        b = TransactionBuilder(notary=NOTARY)
+        b.add_output_state(DummyState(magic=42))
+        b.add_output_state(DummyState(magic=42))
+        b.add_command(DummyCommand(), ALICE_KP.public)
+        ltx = self._ledger_tx(b.to_wire_transaction())
+        groups = ltx.group_states(DummyState, lambda s: s.magic)
+        assert len(groups) == 1 and len(groups[0].outputs) == 2
+
+
+class TestFilteredTransaction:
+    def _wtx(self):
+        b = _issue_builder()
+        b.set_time_window(TimeWindow.between(100, 200))
+        return b.to_wire_transaction()
+
+    def test_build_and_verify(self):
+        wtx = self._wtx()
+        ftx = wtx.build_filtered_transaction(
+            lambda c: isinstance(c, (TimeWindow, Command))
+        )
+        assert ftx.id == wtx.id
+        ftx.verify()
+        assert ftx.time_window == wtx.time_window
+        assert len(ftx.commands) == 1
+        assert ftx.outputs == []  # hidden
+
+    def test_tampered_component_rejected(self):
+        wtx = self._wtx()
+        ftx = wtx.build_filtered_transaction(lambda c: isinstance(c, TimeWindow))
+        from corda_tpu.core.transactions.filtered import FilteredComponent
+
+        fake = FilteredComponent(
+            ftx.filtered_components[0].group,
+            ftx.filtered_components[0].index,
+            TimeWindow.between(1, 2),  # altered content
+            ftx.filtered_components[0].nonce,
+        )
+        tampered = FilteredTransaction(ftx.id, (fake,), ftx.partial_tree)
+        with pytest.raises(FilteredTransactionVerificationError):
+            tampered.verify()
+
+    def test_relabelled_position_rejected(self):
+        # A genuine leaf presented under a different (group, index) must fail:
+        # the leaf preimage binds the position.
+        b = _issue_builder()
+        b.add_output_state(DummyState(magic=42))
+        wtx = b.to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(
+            lambda c: isinstance(c, TransactionState)
+        )
+        from corda_tpu.core.transactions.filtered import FilteredComponent
+
+        fc0, fc1 = ftx.filtered_components
+        swapped = (
+            FilteredComponent(fc0.group, fc1.index, fc0.component, fc0.nonce),
+            FilteredComponent(fc1.group, fc0.index, fc1.component, fc1.nonce),
+        )
+        tampered = FilteredTransaction(ftx.id, swapped, ftx.partial_tree)
+        with pytest.raises(FilteredTransactionVerificationError):
+            tampered.verify()
+
+    def test_roundtrip(self):
+        wtx = self._wtx()
+        ftx = wtx.build_filtered_transaction(lambda c: True)
+        ftx2 = deserialize(serialize(ftx))
+        ftx2.verify()
+        assert ftx2.id == wtx.id
+
+    def test_check_with_fun(self):
+        wtx = self._wtx()
+        ftx = wtx.build_filtered_transaction(lambda c: isinstance(c, TimeWindow))
+        assert ftx.check_with_fun(lambda c: isinstance(c, TimeWindow))
+        assert not ftx.check_with_fun(lambda c: False)
+
+
+class TestAmountAndTimeWindow:
+    def test_amount_math(self):
+        a = Amount(100, "USD")
+        b = Amount(50, "USD")
+        assert (a + b).quantity == 150
+        assert (a - b).quantity == 50
+        with pytest.raises(ValueError):
+            a + Amount(1, "GBP")
+        with pytest.raises(ValueError):
+            Amount(-1, "USD")
+
+    def test_time_window(self):
+        tw = TimeWindow.between(100, 200)
+        assert tw.contains(100) and tw.contains(199)
+        assert not tw.contains(200) and not tw.contains(99)
+        assert tw.midpoint == 150
+        with pytest.raises(ValueError):
+            TimeWindow(None, None)
+        with pytest.raises(ValueError):
+            TimeWindow.between(200, 100)
