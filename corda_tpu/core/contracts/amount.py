@@ -48,8 +48,23 @@ class Amount(Generic[T]):
             raise ValueError("amount quantity cannot be negative")
 
     @staticmethod
-    def from_decimal(value, token) -> "Amount":
-        return Amount(round(value * display_token_size(token)), token)
+    def from_decimal(value, token, rounding: str | None = None) -> "Amount":
+        """Convert a decimal value to minor units. Lossy conversions raise
+        unless an explicit rounding mode ("floor" or "round") is given —
+        money must not silently vanish (reference Amount.fromDecimal)."""
+        from decimal import Decimal
+
+        exact = Decimal(str(value)) * display_token_size(token)
+        if exact == exact.to_integral_value():
+            return Amount(int(exact), token)
+        if rounding == "floor":
+            return Amount(int(exact.to_integral_value(rounding="ROUND_FLOOR")), token)
+        if rounding == "round":
+            return Amount(int(exact.to_integral_value(rounding="ROUND_HALF_UP")), token)
+        raise ValueError(
+            f"{value} is not an exact multiple of {token}'s minor unit; "
+            "pass rounding='floor' or 'round' to allow loss"
+        )
 
     def to_decimal(self):
         return self.quantity / display_token_size(self.token)
@@ -99,7 +114,9 @@ class Amount(Generic[T]):
         return total
 
     def __repr__(self) -> str:
-        return f"{self.to_decimal():.2f} {self.token}"
+        size = display_token_size(self.token)
+        digits = len(str(size)) - 1  # 1 -> 0dp, 100 -> 2dp, 1000 -> 3dp
+        return f"{self.quantity / size:.{digits}f} {self.token}"
 
 
 register_adapter(

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import uuid as uuid_mod
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Dict, FrozenSet, List, Optional, Sequence, Type
+from typing import TYPE_CHECKING, Dict, List, Optional, Type
 
 from ..crypto.keys import PublicKey
 from ..crypto.secure_hash import SecureHash

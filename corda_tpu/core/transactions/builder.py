@@ -6,10 +6,9 @@ TransactionBuilder.kt` (signWith, toWireTransaction, toSignedTransaction).
 from __future__ import annotations
 
 import os
-from typing import Iterable, List, Optional, Union
+from typing import List, Optional, Union
 
 from ..contracts.structures import (
-    Attachment,
     Command,
     CommandData,
     ContractState,
@@ -58,6 +57,11 @@ class TransactionBuilder:
         encumbrance: Optional[int] = None,
     ) -> "TransactionBuilder":
         if isinstance(state, TransactionState):
+            if notary is not None or encumbrance is not None:
+                raise ValueError(
+                    "notary/encumbrance args conflict with an explicit "
+                    "TransactionState; set them on the TransactionState itself"
+                )
             self._outputs.append(state)
         else:
             n = notary or self.notary

@@ -14,6 +14,7 @@ from ..contracts.structures import (
     Attachment,
     AuthenticatedObject,
     StateAndRef,
+    StateRef,
     TimeWindow,
     TransactionState,
     TransactionVerificationError,
@@ -51,6 +52,7 @@ class LedgerTransaction:
 
     def verify(self) -> None:
         """Structural checks then every distinct contract's verify()."""
+        self._check_no_duplicate_inputs()
         self._check_no_notary_change()
         self._check_encumbrances_protected()
         contracts = {}
@@ -66,6 +68,13 @@ class LedgerTransaction:
                 raise TransactionVerificationError(
                     self.id, f"contract {name} rejected: {e}"
                 ) from e
+
+    def _check_no_duplicate_inputs(self) -> None:
+        refs = [s.ref for s in self.inputs]
+        if len(set(refs)) != len(refs):
+            raise TransactionVerificationError(
+                self.id, "duplicate input states detected"
+            )
 
     def _check_no_notary_change(self) -> None:
         if self.notary is None:
@@ -95,8 +104,6 @@ class LedgerTransaction:
         consumed = {s.ref for s in self.inputs}
         for s in self.inputs:
             if s.state.encumbrance is not None:
-                from ..contracts.structures import StateRef
-
                 enc_ref = StateRef(s.ref.txhash, s.state.encumbrance)
                 if enc_ref not in consumed:
                     raise TransactionVerificationError(

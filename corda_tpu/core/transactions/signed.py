@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import cached_property
-from typing import FrozenSet, Iterable, List, Optional, Set, Tuple
+from typing import FrozenSet, Iterable, List, Set, Tuple
 
 from ..crypto import batch as crypto_batch
 from ..crypto.crypto import SignatureError

@@ -12,7 +12,7 @@ import os
 import struct
 from dataclasses import dataclass, field
 from functools import cached_property
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from ..contracts.structures import (
     Attachment,
@@ -22,7 +22,6 @@ from ..contracts.structures import (
     StateRef,
     TimeWindow,
     TransactionState,
-    TransactionVerificationError,
 )
 from ..crypto.merkle import MerkleTree
 from ..crypto.secure_hash import SecureHash
@@ -75,6 +74,10 @@ class WireTransaction:
             raise ValueError("transaction must have inputs, outputs or commands")
         if self.time_window is not None and self.notary is None:
             raise ValueError("transactions with a time window must have a notary")
+        if len(set(self.inputs)) != len(self.inputs):
+            # double-counting one state would let fungible contracts see 2x
+            # input value (reference BaseTransaction.kt:35-37)
+            raise ValueError("duplicate input states detected")
 
     # -- components & id ----------------------------------------------------
 

@@ -2,17 +2,24 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding/collective tests
 run against 8 virtual CPU devices (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip). Must run before any
-`import jax` anywhere in the test session.
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+The axon sitecustomize imports jax at interpreter startup and latches
+JAX_PLATFORMS to "axon,cpu", so env vars alone cannot move the suite off the
+real TPU tunnel: we must call jax.config.update after import. XLA_FLAGS still
+takes effect as long as it is set before the first CPU backend initialization.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

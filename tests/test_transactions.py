@@ -232,6 +232,17 @@ class TestLedgerTransaction:
         with pytest.raises(TransactionVerificationError, match="notary"):
             ltx.verify()
 
+    def test_duplicate_inputs_rejected(self):
+        issue = _issue_builder().to_wire_transaction()
+        ref = StateRef(issue.id, 0)
+        with pytest.raises(ValueError, match="duplicate"):
+            WireTransaction(
+                inputs=(ref, ref),
+                outputs=(TransactionState(DummyState(), NOTARY),),
+                commands=(Command(DummyCommand(), (ALICE_KP.public,)),),
+                notary=NOTARY,
+            )
+
     def test_group_states(self):
         b = TransactionBuilder(notary=NOTARY)
         b.add_output_state(DummyState(magic=42))
@@ -318,6 +329,15 @@ class TestAmountAndTimeWindow:
             a + Amount(1, "GBP")
         with pytest.raises(ValueError):
             Amount(-1, "USD")
+
+    def test_amount_from_decimal(self):
+        assert Amount.from_decimal(1.25, "USD").quantity == 125
+        with pytest.raises(ValueError, match="minor unit"):
+            Amount.from_decimal(1.005, "USD")  # half a cent: lossy
+        assert Amount.from_decimal(1.005, "USD", rounding="floor").quantity == 100
+        assert Amount.from_decimal(1.005, "USD", rounding="round").quantity == 101
+        assert repr(Amount(1, "JPY")) == "1 JPY"
+        assert repr(Amount(1, "BHD")) == "0.001 BHD"
 
     def test_time_window(self):
         tw = TimeWindow.between(100, 200)
