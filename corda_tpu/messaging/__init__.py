@@ -1,0 +1,26 @@
+"""corda_tpu.messaging: the distributed communication backend.
+
+The reference uses one substrate — an embedded Apache Artemis broker — for
+P2P, RPC, and verifier fan-out (reference `ArtemisMessagingServer.kt`,
+`RPCApi.kt`, `VerifierApi.kt`).  This package is the TPU-native equivalent:
+an in-process broker with Artemis queue semantics (named queues, competing
+consumers, acknowledgement, redelivery on consumer death, durable journal)
+used for node-local fan-out (verifier workers) and RPC, plus a deterministic
+in-memory network for MockNetwork-style multi-node tests.  Device-side batch
+distribution does NOT go through here — that rides ICI via jax.shard_map
+collectives (corda_tpu.parallel).
+"""
+from .broker import (
+    Broker,
+    BrokerError,
+    Consumer,
+    Message,
+    QueueClosedError,
+    QueueExistsError,
+    UnknownQueueError,
+)
+
+__all__ = [
+    "Broker", "BrokerError", "Consumer", "Message",
+    "QueueClosedError", "QueueExistsError", "UnknownQueueError",
+]

@@ -1,0 +1,17 @@
+"""corda_tpu.parallel: device-mesh distribution of verification batches.
+
+The reference scales verification by adding competing-consumer worker
+processes on an Artemis queue (SURVEY.md section 2.10 item 2).  On TPU the
+same axis is widened twice: vmap across a batch on one chip
+(corda_tpu.ops), and shard_map across a jax.sharding.Mesh so a 10k-100k
+signature burst rides ICI collectives across every chip in the slice.
+DCN-side elasticity (worker processes) stays on the broker; ICI-side
+data parallelism lives here.
+"""
+from .mesh import (
+    DistributedVerifier,
+    data_mesh,
+    shard_verify_ed25519,
+)
+
+__all__ = ["DistributedVerifier", "data_mesh", "shard_verify_ed25519"]

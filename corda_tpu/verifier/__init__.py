@@ -1,0 +1,37 @@
+"""corda_tpu.verifier: the out-of-process / batched verification subsystem.
+
+This is the north-star seam (SURVEY.md section 2.7): the reference provides
+a pluggable `TransactionVerifierService` and an Artemis queue protocol
+(`VerifierApi.kt`) feeding external verifier workers.  Here the same
+topology feeds a batching buffer that accumulates signature checks across
+transactions and dispatches them to the TPU kernels in corda_tpu.ops —
+widening the reference's per-signature loop into device-wide batches.
+"""
+from .api import (
+    VERIFICATION_REQUESTS_QUEUE_NAME,
+    VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX,
+    SignatureBatchRequest,
+    SignatureBatchResponse,
+    VerificationRequest,
+    VerificationResponse,
+)
+from .batcher import SignatureBatcher
+from .service import (
+    InMemoryTransactionVerifierService,
+    OutOfProcessTransactionVerifierService,
+    TransactionVerifierService,
+    VerificationError,
+)
+from .worker import VerifierWorker
+
+__all__ = [
+    "VERIFICATION_REQUESTS_QUEUE_NAME",
+    "VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX",
+    "SignatureBatchRequest", "SignatureBatchResponse",
+    "VerificationRequest", "VerificationResponse",
+    "SignatureBatcher",
+    "InMemoryTransactionVerifierService",
+    "OutOfProcessTransactionVerifierService",
+    "TransactionVerifierService", "VerificationError",
+    "VerifierWorker",
+]

@@ -1,0 +1,86 @@
+"""Verifier wire protocol (reference `node-api/.../VerifierApi.kt:11-58`).
+
+Queue-name contract kept identical to the reference so the topology reads
+the same: one shared request queue with competing consumers, one response
+queue per requesting node.
+
+Two request kinds (the reference has only the first; the second is the
+north-star extension that moves the signature hot loop onto this seam):
+  * `VerificationRequest`  — a resolved LedgerTransaction; worker runs
+    contract verification and replies error-or-None.
+  * `SignatureBatchRequest` — (key, signature, content) triples from any
+    number of transactions; worker batches them onto the TPU kernels and
+    replies with a validity bitmask.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..core.crypto.keys import PublicKey
+from ..core.serialization.codec import register_adapter
+from ..core.transactions.ledger import LedgerTransaction
+
+VERIFICATION_REQUESTS_QUEUE_NAME = "verifier.requests"
+VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX = "verifier.responses."
+
+
+@dataclass(frozen=True)
+class VerificationRequest:
+    verification_id: int
+    transaction: LedgerTransaction
+    response_address: str
+
+
+@dataclass(frozen=True)
+class VerificationResponse:
+    verification_id: int
+    error: Optional[str]  # None = verified OK
+
+
+@dataclass(frozen=True)
+class SignatureBatchRequest:
+    verification_id: int
+    items: Tuple[Tuple[PublicKey, bytes, bytes], ...]  # (key, sig, content)
+    response_address: str
+
+
+@dataclass(frozen=True)
+class SignatureBatchResponse:
+    verification_id: int
+    valid: Tuple[bool, ...]  # positionally aligned with request items
+    error: Optional[str] = None  # worker-side failure (not a bad signature)
+
+
+register_adapter(
+    VerificationRequest, "VerificationRequest",
+    lambda r: {
+        "id": r.verification_id, "tx": r.transaction,
+        "reply": r.response_address,
+    },
+    lambda d: VerificationRequest(d["id"], d["tx"], d["reply"]),
+)
+register_adapter(
+    VerificationResponse, "VerificationResponse",
+    lambda r: {"id": r.verification_id, "error": r.error},
+    lambda d: VerificationResponse(d["id"], d["error"]),
+)
+register_adapter(
+    SignatureBatchRequest, "SignatureBatchRequest",
+    lambda r: {
+        "id": r.verification_id,
+        "items": [list(t) for t in r.items],
+        "reply": r.response_address,
+    },
+    lambda d: SignatureBatchRequest(
+        d["id"], tuple(tuple(t) for t in d["items"]), d["reply"]
+    ),
+)
+register_adapter(
+    SignatureBatchResponse, "SignatureBatchResponse",
+    lambda r: {
+        "id": r.verification_id, "valid": [bool(v) for v in r.valid],
+        "error": r.error,
+    },
+    lambda d: SignatureBatchResponse(d["id"], tuple(d["valid"]), d["error"]),
+)

@@ -1,0 +1,94 @@
+"""Cross-transaction signature batching buffer.
+
+The reference verifies signatures one at a time inside each transaction
+(`TransactionWithSignatures.kt:58-62`).  The TPU design inverts this:
+callers submit signature-check items from ANY number of transactions and
+get futures back; the batcher accumulates items and flushes them through
+`core.crypto.batch.verify_batch` (which buckets by scheme and runs the
+device kernels) when either
+  * the buffer reaches `max_batch` items, or
+  * `linger_ms` elapses after the first pending item (latency bound), or
+  * a caller forces `flush()`.
+
+Padding to the next power of two happens inside the device kernel wrapper
+(`ops.ed25519_batch.prepare_batch(pad_to=...)`), so XLA sees a small fixed
+set of shapes and recompiles rarely.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Sequence, Tuple
+
+from ..core.crypto import batch as crypto_batch
+from ..core.crypto.keys import PublicKey
+
+Item = Tuple[PublicKey, bytes, bytes]  # (key, signature, content)
+
+
+class SignatureBatcher:
+    """Thread-safe accumulate-and-flush buffer over the batch verify path."""
+
+    def __init__(self, max_batch: int = 4096, linger_ms: float = 2.0):
+        self.max_batch = max_batch
+        self.linger_ms = linger_ms
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[Item, Future]] = []
+        self._timer: threading.Timer | None = None
+        self._closed = False
+        # telemetry
+        self.flushes = 0
+        self.items_verified = 0
+        self.largest_batch = 0
+
+    def submit(self, item: Item) -> Future:
+        """Queue one signature check; resolves to bool."""
+        return self.submit_many([item])[0]
+
+    def submit_many(self, items: Sequence[Item]) -> List[Future]:
+        futures = [Future() for _ in items]
+        run_now = False
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._pending.extend(zip(items, futures))
+            if len(self._pending) >= self.max_batch:
+                run_now = True
+            elif self._timer is None:
+                self._timer = threading.Timer(
+                    self.linger_ms / 1000.0, self.flush
+                )
+                self._timer.daemon = True
+                self._timer.start()
+        if run_now:
+            self.flush()
+        return futures
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            batch, self._pending = self._pending, []
+        if not batch:
+            return
+        items = [it for it, _ in batch]
+        try:
+            results = crypto_batch.verify_batch(items)
+        except Exception as exc:  # propagate to every waiter
+            for _, fut in batch:
+                fut.set_exception(exc)
+            return
+        self.flushes += 1
+        self.items_verified += len(batch)
+        self.largest_batch = max(self.largest_batch, len(batch))
+        for (_, fut), ok in zip(batch, results):
+            fut.set_result(bool(ok))
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            self._closed = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

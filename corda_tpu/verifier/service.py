@@ -1,0 +1,195 @@
+"""TransactionVerifierService SPI and its two implementations.
+
+Reference parity:
+  * SPI `verify(ltx) -> Future` — `core/.../TransactionVerifierService.kt:9-15`
+  * `InMemoryTransactionVerifierService` — fixed worker pool
+    (`InMemoryTransactionVerifierService.kt:10-18`)
+  * `OutOfProcessTransactionVerifierService` — nonce-keyed futures over the
+    broker queues, with Duration/Success/Failure/InFlight metrics
+    (`OutOfProcessTransactionVerifierService.kt:33-71`)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from ..core.crypto.secure_hash import random_63_bit_value
+from ..core.serialization.codec import deserialize, serialize
+from ..core.transactions.ledger import LedgerTransaction
+from ..messaging import Broker
+from .api import (
+    VERIFICATION_REQUESTS_QUEUE_NAME,
+    VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX,
+    SignatureBatchRequest,
+    SignatureBatchResponse,
+    VerificationRequest,
+    VerificationResponse,
+)
+from .batcher import Item, SignatureBatcher
+
+
+class VerificationError(Exception):
+    """A transaction failed verification on the verifier side."""
+
+
+class TransactionVerifierService:
+    """SPI: async contract verification plus (TPU extension) batched
+    signature verification."""
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        raise NotImplementedError
+
+    def verify_sync(self, ltx: LedgerTransaction) -> None:
+        exc = self.verify(ltx).result()
+        if exc is not None:
+            raise exc
+
+    def verify_signatures(self, items: Sequence[Item]) -> List[Future]:
+        """Offload signature checks; each future resolves to bool."""
+        raise NotImplementedError
+
+
+class InMemoryTransactionVerifierService(TransactionVerifierService):
+    """Worker pool in the node process; signature checks go through a local
+    SignatureBatcher so device batching still happens."""
+
+    def __init__(self, worker_count: int = 4, batcher: Optional[SignatureBatcher] = None):
+        self._pool = ThreadPoolExecutor(
+            max_workers=worker_count, thread_name_prefix="verifier"
+        )
+        self._batcher = batcher or SignatureBatcher()
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        def run():
+            try:
+                ltx.verify()
+                return None
+            except Exception as exc:
+                return VerificationError(str(exc))
+
+        return self._pool.submit(run)
+
+    def verify_signatures(self, items: Sequence[Item]) -> List[Future]:
+        return self._batcher.submit_many(items)
+
+    def stop(self) -> None:
+        self._batcher.close()
+        self._pool.shutdown(wait=False)
+
+
+class _Metrics:
+    def __init__(self):
+        self.success = 0
+        self.failure = 0
+        self.in_flight = 0
+        self.durations: List[float] = []
+
+
+class OutOfProcessTransactionVerifierService(TransactionVerifierService):
+    """Fans verification out over the broker to external verifier workers.
+
+    A nonce keys each request to its future; a consumer thread on this
+    node's private response queue completes them.  Competing consumers on
+    the shared request queue give worker elasticity for free.
+    """
+
+    def __init__(self, broker: Broker, node_name: str):
+        self._broker = broker
+        self._response_queue = (
+            VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX + node_name
+        )
+        broker.create_queue(VERIFICATION_REQUESTS_QUEUE_NAME)
+        broker.create_queue(self._response_queue)
+        self._pending: Dict[int, Future] = {}
+        self._started: Dict[int, float] = {}
+        self._sig_pending: Dict[int, List[Future]] = {}
+        self._lock = threading.Lock()
+        self.metrics = _Metrics()
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(self._response_queue)
+        self._thread = threading.Thread(
+            target=self._consume_responses, name=f"verifier-responses-{node_name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- request side ------------------------------------------------------
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        nonce = random_63_bit_value()
+        fut: Future = Future()
+        with self._lock:
+            self._pending[nonce] = fut
+            self._started[nonce] = time.monotonic()
+            self.metrics.in_flight += 1
+        req = VerificationRequest(nonce, ltx, self._response_queue)
+        self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
+        return fut
+
+    def verify_signatures(self, items: Sequence[Item]) -> List[Future]:
+        nonce = random_63_bit_value()
+        futures = [Future() for _ in items]
+        with self._lock:
+            self._sig_pending[nonce] = futures
+        req = SignatureBatchRequest(nonce, tuple(items), self._response_queue)
+        self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
+        return futures
+
+    def worker_count(self) -> int:
+        return self._broker.consumer_count(VERIFICATION_REQUESTS_QUEUE_NAME)
+
+    # -- response side -----------------------------------------------------
+
+    def _consume_responses(self) -> None:
+        while not self._stop.is_set():
+            msg = self._consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            try:
+                resp = deserialize(msg.payload)
+                if isinstance(resp, VerificationResponse):
+                    self._complete_tx(resp)
+                elif isinstance(resp, SignatureBatchResponse):
+                    self._complete_sigs(resp)
+            except Exception:
+                # A malformed response must not kill the completer thread —
+                # that would strand every pending future forever.
+                pass
+            self._consumer.ack(msg)
+
+    def _complete_tx(self, resp: VerificationResponse) -> None:
+        with self._lock:
+            fut = self._pending.pop(resp.verification_id, None)
+            t0 = self._started.pop(resp.verification_id, None)
+            if fut is None:
+                return
+            self.metrics.in_flight -= 1
+            if t0 is not None:
+                self.metrics.durations.append(time.monotonic() - t0)
+            if resp.error is None:
+                self.metrics.success += 1
+            else:
+                self.metrics.failure += 1
+        fut.set_result(
+            None if resp.error is None else VerificationError(resp.error)
+        )
+
+    def _complete_sigs(self, resp: SignatureBatchResponse) -> None:
+        with self._lock:
+            futures = self._sig_pending.pop(resp.verification_id, None)
+        if futures is None:
+            return
+        if resp.error is not None or len(resp.valid) != len(futures):
+            exc = VerificationError(resp.error or "verdict count mismatch")
+            for fut in futures:
+                fut.set_exception(exc)
+            return
+        for fut, ok in zip(futures, resp.valid):
+            fut.set_result(bool(ok))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        self._thread.join(timeout=2)

@@ -243,6 +243,25 @@ class TestLedgerTransaction:
                 notary=NOTARY,
             )
 
+    def test_ledger_transaction_duplicate_inputs_rejected(self):
+        issue = _issue_builder().to_wire_transaction()
+        snr = StateAndRef(
+            TransactionState(DummyState(), NOTARY), StateRef(issue.id, 0)
+        )
+        from corda_tpu.core.transactions import LedgerTransaction
+
+        ltx = LedgerTransaction(
+            inputs=(snr, snr),
+            outputs=(),
+            commands=(),
+            attachments=(),
+            id=issue.id,
+            notary=NOTARY,
+            time_window=None,
+        )
+        with pytest.raises(TransactionVerificationError, match="[Dd]uplicate"):
+            ltx.verify()
+
     def test_group_states(self):
         b = TransactionBuilder(notary=NOTARY)
         b.add_output_state(DummyState(magic=42))

@@ -1,0 +1,198 @@
+"""Verifier subsystem tests.
+
+Mirrors the reference's `VerifierTests.kt:36-101` (single worker, N workers,
+kill-one-mid-run redistribution, invalid-transaction rejection) plus the
+TPU-specific signature batching seam.
+"""
+import time
+from dataclasses import dataclass
+from typing import List
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    Contract,
+    ContractState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization.codec import corda_serializable
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.messaging import Broker
+from corda_tpu.verifier import (
+    InMemoryTransactionVerifierService,
+    OutOfProcessTransactionVerifierService,
+    SignatureBatcher,
+    VerificationError,
+    VerifierWorker,
+)
+
+ALICE_KP = crypto.entropy_to_keypair(80)
+NOTARY_KP = crypto.entropy_to_keypair(81)
+ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)
+NOTARY = Party("O=Notary,L=Zurich,C=CH", NOTARY_KP.public)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class VState(ContractState):
+    magic: int = 7
+    contract_name = "VContract"
+
+    @property
+    def participants(self) -> List:
+        return []
+
+
+@contract(name="VContract")
+class VContract(Contract):
+    def verify(self, tx) -> None:
+        for s in tx.outputs_of_type(VState):
+            if s.magic != 7:
+                raise TransactionVerificationError(tx.id, "bad magic")
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class VCommand(TypeOnlyCommandData):
+    pass
+
+
+def _ltx(magic: int = 7):
+    b = TransactionBuilder(notary=NOTARY)
+    b.add_output_state(VState(magic=magic))
+    b.add_command(VCommand(), ALICE_KP.public)
+    wtx = b.to_wire_transaction()
+    return wtx.to_ledger_transaction(
+        resolve_state=lambda ref: (_ for _ in ()).throw(AssertionError),
+        resolve_attachment=lambda h: (_ for _ in ()).throw(AssertionError),
+    )
+
+
+class TestSignatureBatcher:
+    def _items(self, n, entropy0=100):
+        items = []
+        for i in range(n):
+            kp = crypto.entropy_to_keypair(entropy0 + i)
+            content = b"msg-%d" % i
+            sig = crypto.do_sign(kp.private, content)
+            items.append((kp.public, sig, content))
+        return items
+
+    def test_batch_resolves_futures(self):
+        batcher = SignatureBatcher(max_batch=8, linger_ms=10_000)
+        futures = batcher.submit_many(self._items(8))  # hits max_batch
+        assert all(f.result(timeout=5) for f in futures)
+        assert batcher.flushes == 1
+        assert batcher.items_verified == 8
+
+    def test_linger_flush(self):
+        batcher = SignatureBatcher(max_batch=1000, linger_ms=30)
+        fut = batcher.submit(self._items(1, entropy0=200)[0])
+        assert fut.result(timeout=5) is True
+
+    def test_bad_signature_isolated(self):
+        items = self._items(4, entropy0=300)
+        key, sig, content = items[2]
+        items[2] = (key, sig, b"tampered")
+        batcher = SignatureBatcher(max_batch=4, linger_ms=10_000)
+        futures = batcher.submit_many(items)
+        results = [f.result(timeout=5) for f in futures]
+        assert results == [True, True, False, True]
+
+    def test_cross_transaction_accumulation(self):
+        batcher = SignatureBatcher(max_batch=6, linger_ms=10_000)
+        f1 = batcher.submit_many(self._items(3, entropy0=400))
+        f2 = batcher.submit_many(self._items(3, entropy0=500))
+        assert all(f.result(timeout=5) for f in f1 + f2)
+        assert batcher.flushes == 1  # one device dispatch for both txs
+
+
+class TestInMemoryService:
+    def test_valid_transaction(self):
+        svc = InMemoryTransactionVerifierService()
+        assert svc.verify(_ltx()).result(timeout=5) is None
+        svc.stop()
+
+    def test_invalid_transaction(self):
+        svc = InMemoryTransactionVerifierService()
+        err = svc.verify(_ltx(magic=8)).result(timeout=5)
+        assert isinstance(err, VerificationError)
+        with pytest.raises(VerificationError):
+            svc.verify_sync(_ltx(magic=8))
+        svc.stop()
+
+
+class TestOutOfProcessService:
+    def test_single_worker(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(broker, "nodeA")
+        worker = VerifierWorker(broker).start()
+        assert svc.verify(_ltx()).result(timeout=5) is None
+        err = svc.verify(_ltx(magic=9)).result(timeout=5)
+        assert isinstance(err, VerificationError)
+        assert svc.metrics.success == 1
+        assert svc.metrics.failure == 1
+        assert svc.metrics.in_flight == 0
+        worker.stop()
+        svc.stop()
+
+    def test_four_workers_share_load(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(broker, "nodeA")
+        workers = [
+            VerifierWorker(broker, name=f"verifier-{i}").start()
+            for i in range(4)
+        ]
+        futures = [svc.verify(_ltx()) for _ in range(40)]
+        assert all(f.result(timeout=10) is None for f in futures)
+        assert sum(w.verified_count for w in workers) == 40
+        # elasticity actually spread the work
+        assert sum(1 for w in workers if w.verified_count > 0) >= 2
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+    def test_worker_death_redistributes(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(broker, "nodeA")
+        w1 = VerifierWorker(broker, name="doomed")
+        # w1 never starts its thread: it holds a consumer but does no work,
+        # simulating a worker that died after receiving nothing.
+        futures = [svc.verify(_ltx()) for _ in range(10)]
+        time.sleep(0.1)
+        w2 = VerifierWorker(broker, name="survivor").start()
+        w1.stop(graceful=False)  # crash: unacked work redelivered
+        assert all(f.result(timeout=10) is None for f in futures)
+        assert w2.verified_count == 10
+        w2.stop()
+        svc.stop()
+
+    def test_signature_batch_offload(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(broker, "nodeA")
+        worker = VerifierWorker(broker).start()
+        items = []
+        for i in range(6):
+            kp = crypto.entropy_to_keypair(600 + i)
+            content = b"content-%d" % i
+            items.append((kp.public, crypto.do_sign(kp.private, content), content))
+        key, sig, _ = items[3]
+        items[3] = (key, sig, b"forged")
+        futures = svc.verify_signatures(items)
+        results = [f.result(timeout=10) for f in futures]
+        assert results == [True, True, True, False, True, True]
+        worker.stop()
+        svc.stop()
+
+    def test_worker_count_visible(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(broker, "nodeA")
+        assert svc.worker_count() == 0  # reference warns on zero verifiers
+        w = VerifierWorker(broker).start()
+        assert svc.worker_count() == 1
+        w.stop()
+        svc.stop()
