@@ -94,6 +94,12 @@ class ContractState:
 
 
 class OwnableState(ContractState):
+    def move_command(self) -> "CommandData":
+        """The command that authorises transferring this state to a new
+        owner — used by generic trade flows (TwoPartyTradeFlow) to build
+        move transactions without knowing the concrete contract."""
+        raise NotImplementedError
+
     owner: AbstractParty
 
     def with_new_owner(self, new_owner: AbstractParty) -> "OwnableState":

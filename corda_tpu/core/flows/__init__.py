@@ -1,0 +1,48 @@
+"""corda_tpu.core.flows: the checkpointable multi-party protocol API.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/flows/` (FlowLogic,
+annotations, FlowException).  The TPU-native redesign replaces Quasar
+bytecode-instrumented fibers with plain Python generators: a flow's `call()`
+is a generator that yields FlowIORequest objects (Send/Receive/...) and is
+driven by the node's StateMachineManager, which checkpoints the flow as
+(class, args, io-result log) and restores it by deterministic replay —
+no stack serialization, no agent (SURVEY.md section 7 item 4).
+"""
+from .api import (
+    FlowException,
+    FlowLogic,
+    ProgressTracker,
+    Receive,
+    Send,
+    SendAndReceive,
+    WaitForLedgerCommit,
+    flow_registry,
+    get_initiated_by,
+    initiated_by,
+    initiating_flow,
+    schedulable_flow,
+    startable_by_rpc,
+)
+from .library import (
+    BroadcastTransactionFlow,
+    CollectSignaturesFlow,
+    DataNotFoundError,
+    FetchAttachmentsFlow,
+    FetchDataError,
+    FetchTransactionsFlow,
+    FinalityFlow,
+    NotifyTransactionHandler,
+    ResolveTransactionsFlow,
+    SignTransactionFlow,
+)
+
+__all__ = [
+    "FlowException", "FlowLogic", "ProgressTracker",
+    "Receive", "Send", "SendAndReceive", "WaitForLedgerCommit",
+    "flow_registry", "get_initiated_by", "initiated_by", "initiating_flow",
+    "schedulable_flow", "startable_by_rpc",
+    "BroadcastTransactionFlow", "CollectSignaturesFlow", "DataNotFoundError",
+    "FetchAttachmentsFlow", "FetchDataError", "FetchTransactionsFlow",
+    "FinalityFlow", "NotifyTransactionHandler", "ResolveTransactionsFlow",
+    "SignTransactionFlow",
+]

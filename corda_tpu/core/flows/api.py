@@ -1,0 +1,294 @@
+"""FlowLogic API: generator-based checkpointable protocols.
+
+A flow author writes (reference `FlowLogic.kt:38-264` for the surface):
+
+    @initiating_flow
+    @startable_by_rpc
+    class Ping(FlowLogic):
+        def __init__(self, party):
+            self.party = party
+
+        def call(self):
+            answer = yield self.send_and_receive(self.party, b"ping", bytes)
+            return answer
+
+    @initiated_by(Ping)
+    class Pong(FlowLogic):
+        def __init__(self, counterparty):
+            self.counterparty = counterparty
+
+        def call(self):
+            msg = yield self.receive(self.counterparty, bytes)
+            yield self.send(self.counterparty, b"pong")
+
+Every suspension point is an explicit `yield` of a FlowIORequest; the result
+of the suspension is the value the yield evaluates to.  `sub_flow` composes
+with `yield from`.  Determinism rule (documented, like the reference's
+@Suspendable contract): `call()` must be deterministic given its constructor
+args and the sequence of IO results — that is what makes replay-restore
+(the checkpoint model) sound.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Type
+
+from ..identity import Party
+
+
+_exception_registry: Dict[str, type] = {}
+
+
+class FlowException(Exception):
+    """An exception that propagates across the wire to the counterparty
+    session (reference `core/.../flows/FlowException.kt`).  Subclasses are
+    auto-registered so the receiving side can rethrow the same type."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        _exception_registry[cls.__name__] = cls
+
+
+def encode_flow_exception(exc: FlowException) -> str:
+    return f"{type(exc).__name__}|{exc}"
+
+
+def rebuild_flow_exception(text: str) -> FlowException:
+    """Best-effort reconstruction of a propagated FlowException."""
+    name, _, msg = text.partition("|")
+    cls = _exception_registry.get(name)
+    if cls is not None:
+        try:
+            exc = cls(msg)
+            # Some subclasses decorate the message in __init__; keep the
+            # original wire text when they do.
+            return exc
+        except Exception:
+            pass
+    return FlowException(text)
+
+
+# ---------------------------------------------------------------------------
+# IO requests — the explicit suspension points
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Send:
+    party: Party
+    payload: Any
+    # flow_name of the (sub)flow that issued the request; sessions are keyed
+    # by (party, owner) so @initiating_flow sub-flows get their own session
+    owner_name: str = ""
+
+
+@dataclass(frozen=True)
+class Receive:
+    party: Party
+    expected_type: type = object
+    owner_name: str = ""
+
+
+@dataclass(frozen=True)
+class SendAndReceive:
+    party: Party
+    payload: Any
+    expected_type: type = object
+    retry_on_failover: bool = False  # sendAndReceiveWithRetry (FlowLogic.kt:107)
+    owner_name: str = ""
+
+
+@dataclass(frozen=True)
+class WaitForLedgerCommit:
+    tx_id: Any  # SecureHash
+
+
+# ---------------------------------------------------------------------------
+# Registries + annotations
+# ---------------------------------------------------------------------------
+
+flow_registry: Dict[str, Type["FlowLogic"]] = {}
+_initiated_by: Dict[str, Type["FlowLogic"]] = {}
+
+
+def _register(cls: Type["FlowLogic"]) -> None:
+    flow_registry[cls.flow_name()] = cls
+
+
+def initiating_flow(cls=None, *, version: int = 1):
+    """Marks a flow that opens new sessions (reference `@InitiatingFlow`)."""
+    def wrap(c):
+        c._initiating = True
+        c._flow_version = version
+        _register(c)
+        return c
+    return wrap(cls) if cls is not None else wrap
+
+
+def initiated_by(initiator: Type["FlowLogic"]):
+    """Registers the responder spawned when `initiator`'s SessionInit arrives
+    (reference `@InitiatedBy`)."""
+    def wrap(c):
+        c._initiated_by = initiator
+        _register(c)
+        _initiated_by[initiator.flow_name()] = c
+        return c
+    return wrap
+
+
+def startable_by_rpc(cls):
+    cls._startable_by_rpc = True
+    _register(cls)
+    return cls
+
+
+def schedulable_flow(cls):
+    cls._schedulable = True
+    _register(cls)
+    return cls
+
+
+def get_initiated_by(initiator_name: str) -> Optional[Type["FlowLogic"]]:
+    return _initiated_by.get(initiator_name)
+
+
+# ---------------------------------------------------------------------------
+# ProgressTracker
+# ---------------------------------------------------------------------------
+
+class ProgressTracker:
+    """Hierarchical step tree streamed to observers (reference
+    `core/.../utilities/ProgressTracker.kt`)."""
+
+    @dataclass(frozen=True)
+    class Step:
+        label: str
+
+    def __init__(self, *steps: "ProgressTracker.Step"):
+        self.steps = list(steps)
+        self.current_step: Optional[ProgressTracker.Step] = None
+        self._observers: List = []
+        self._children: Dict[ProgressTracker.Step, ProgressTracker] = {}
+
+    def set_child_tracker(self, step: "ProgressTracker.Step", child: "ProgressTracker"):
+        self._children[step] = child
+        for obs in self._observers:
+            child.subscribe(obs)
+
+    def subscribe(self, observer) -> None:
+        self._observers.append(observer)
+        for child in self._children.values():
+            child.subscribe(observer)
+
+    @property
+    def current_step_index(self) -> int:
+        if self.current_step is None:
+            return -1
+        return self.steps.index(self.current_step)
+
+    def set_current_step(self, step: "ProgressTracker.Step") -> None:
+        if step not in self.steps:
+            raise ValueError(f"unknown step {step}")
+        self.current_step = step
+        for obs in self._observers:
+            obs(step.label)
+
+
+# ---------------------------------------------------------------------------
+# FlowLogic
+# ---------------------------------------------------------------------------
+
+class FlowLogic:
+    """Base class of a checkpointable protocol.
+
+    Subclasses implement `call()` as a generator (it must `yield` at least
+    once or simply `return`; plain-return flows are handled too).  The
+    driving state machine injects `state_machine` (node-side services
+    accessor) before the first step.
+    """
+
+    _initiating = False
+    _startable_by_rpc = False
+    _schedulable = False
+    progress_tracker: Optional[ProgressTracker] = None
+
+    # injected by the node's state machine before the first step
+    state_machine = None
+
+    @classmethod
+    def flow_name(cls) -> str:
+        return f"{cls.__module__}.{cls.__qualname__}"
+
+    # -- suspension-point constructors (user code yields these) -------------
+
+    def send(self, party: Party, payload: Any) -> Send:
+        return Send(party, payload, owner_name=self.flow_name())
+
+    def receive(self, party: Party, expected_type: type = object) -> Receive:
+        return Receive(party, expected_type, owner_name=self.flow_name())
+
+    def send_and_receive(
+        self, party: Party, payload: Any, expected_type: type = object
+    ) -> SendAndReceive:
+        return SendAndReceive(
+            party, payload, expected_type, owner_name=self.flow_name()
+        )
+
+    def send_and_receive_with_retry(
+        self, party: Party, payload: Any, expected_type: type = object
+    ) -> SendAndReceive:
+        return SendAndReceive(
+            party, payload, expected_type, retry_on_failover=True,
+            owner_name=self.flow_name(),
+        )
+
+    def wait_for_ledger_commit(self, tx_id) -> WaitForLedgerCommit:
+        return WaitForLedgerCommit(tx_id)
+
+    def sub_flow(self, flow: "FlowLogic"):
+        """Run a child flow inline, sharing this flow's state machine.
+
+        Usage: `result = yield from self.sub_flow(OtherFlow(...))`.
+        If the child has its own ProgressTracker it is attached under the
+        parent's current step.
+        """
+        flow.state_machine = self.state_machine
+        if (
+            self.progress_tracker is not None
+            and flow.progress_tracker is not None
+            and self.progress_tracker.current_step is not None
+        ):
+            self.progress_tracker.set_child_tracker(
+                self.progress_tracker.current_step, flow.progress_tracker
+            )
+        result = yield from _as_generator(flow)
+        return result
+
+    @property
+    def service_hub(self):
+        """The node's services (reference FlowLogic.serviceHub)."""
+        return self.state_machine.service_hub
+
+    @property
+    def our_identity(self) -> Party:
+        return self.state_machine.our_identity
+
+    def call(self):
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return type(self).__name__
+
+
+def _as_generator(flow: FlowLogic):
+    """Invoke flow.call(), normalising plain-return flows to generators."""
+    import inspect
+
+    result = flow.call()
+    if inspect.isgenerator(result):
+        return result
+
+    def _wrap():
+        return result
+        yield  # pragma: no cover — makes this a generator
+
+    return _wrap()

@@ -1,0 +1,387 @@
+"""Core library flows (reference `core/src/main/kotlin/net/corda/core/flows/`).
+
+  * FetchTransactionsFlow / FetchAttachmentsFlow + handlers — FetchDataFlow.kt
+  * ResolveTransactionsFlow — dependency-graph download + topological order
+    (`ResolveTransactionsFlow.kt`, breadth-limited)
+  * BroadcastTransactionFlow + handler — BroadcastTransactionFlow.kt
+  * FinalityFlow — notarise + record + broadcast (`FinalityFlow.kt:36-78`)
+  * CollectSignaturesFlow / SignTransactionFlow — CollectSignaturesFlow.kt
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..contracts.structures import Attachment
+from ..crypto.secure_hash import SecureHash
+from ..identity import Party
+from ..serialization.codec import register_adapter
+from ..transactions.signed import SignedTransaction
+from .api import FlowException, FlowLogic, initiated_by, initiating_flow
+
+
+# ---------------------------------------------------------------------------
+# Data-fetch protocol
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FetchRequest:
+    hashes: Tuple[SecureHash, ...]
+
+
+@dataclass(frozen=True)
+class FetchResponse:
+    items: Tuple  # SignedTransaction or bytes (attachment contents), or None
+
+
+register_adapter(
+    FetchRequest, "FetchRequest",
+    lambda r: {"hashes": list(r.hashes)},
+    lambda d: FetchRequest(tuple(d["hashes"])),
+)
+register_adapter(
+    FetchResponse, "FetchResponse",
+    lambda r: {"items": list(r.items)},
+    lambda d: FetchResponse(tuple(d["items"])),
+)
+
+
+class DataNotFoundError(FlowException):
+    def __init__(self, missing):
+        super().__init__(f"counterparty could not provide: {missing}")
+        self.missing = missing
+
+
+@initiating_flow
+class FetchTransactionsFlow(FlowLogic):
+    """Fetch SignedTransactions by hash from a peer; local storage is
+    checked first (reference FetchDataFlow caching behavior)."""
+
+    def __init__(self, hashes: Iterable[SecureHash], other_party: Party):
+        self.hashes = tuple(hashes)
+        self.other_party = other_party
+
+    def call(self):
+        storage = self.service_hub.validated_transactions
+        from_disk, to_fetch = [], []
+        for h in self.hashes:
+            stx = storage.get(h)
+            (from_disk if stx is not None else to_fetch).append((h, stx))
+        downloaded = []
+        if to_fetch:
+            req = FetchRequest(tuple(h for h, _ in to_fetch))
+            resp = yield self.send_and_receive(
+                self.other_party, req, FetchResponse
+            )
+            if len(resp.items) != len(req.hashes):
+                raise FetchDataError("response length mismatch")
+            for h, stx in zip(req.hashes, resp.items):
+                if stx is None:
+                    raise DataNotFoundError(h)
+                if stx.id != h:
+                    raise FetchDataError(
+                        f"downloaded transaction hashes to {stx.id}, wanted {h}"
+                    )
+                downloaded.append(stx)
+        return [stx for _, stx in from_disk if stx is not None] + downloaded
+
+
+class FetchDataError(FlowException):
+    pass
+
+
+@initiated_by(FetchTransactionsFlow)
+class FetchTransactionsHandler(FlowLogic):
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        req = yield self.receive(self.counterparty, FetchRequest)
+        storage = self.service_hub.validated_transactions
+        items = tuple(storage.get(h) for h in req.hashes)
+        yield self.send(self.counterparty, FetchResponse(items))
+
+
+@initiating_flow
+class FetchAttachmentsFlow(FlowLogic):
+    def __init__(self, hashes: Iterable[SecureHash], other_party: Party):
+        self.hashes = tuple(hashes)
+        self.other_party = other_party
+
+    def call(self):
+        att_storage = self.service_hub.attachments
+        to_fetch = [h for h in self.hashes if not att_storage.has_attachment(h)]
+        if to_fetch:
+            resp = yield self.send_and_receive(
+                self.other_party, FetchRequest(tuple(to_fetch)), FetchResponse
+            )
+            if len(resp.items) != len(to_fetch):
+                raise FetchDataError("response length mismatch")
+            for h, data in zip(to_fetch, resp.items):
+                if data is None:
+                    raise DataNotFoundError(h)
+                got = att_storage.import_attachment(data)
+                if got != h:
+                    raise FetchDataError(f"attachment hashed to {got}, wanted {h}")
+        return [att_storage.open_attachment(h) for h in self.hashes]
+
+
+@initiated_by(FetchAttachmentsFlow)
+class FetchAttachmentsHandler(FlowLogic):
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        req = yield self.receive(self.counterparty, FetchRequest)
+        atts = []
+        for h in req.hashes:
+            att = self.service_hub.attachments.open_attachment(h)
+            atts.append(att.data if att is not None else None)
+        yield self.send(self.counterparty, FetchResponse(tuple(atts)))
+
+
+# ---------------------------------------------------------------------------
+# ResolveTransactionsFlow
+# ---------------------------------------------------------------------------
+
+class ExcessivelyLargeTransactionGraphError(FlowException):
+    pass
+
+
+@initiating_flow
+class ResolveTransactionsFlow(FlowLogic):
+    """Download and commit the dependency chain of a transaction
+    (reference ResolveTransactionsFlow.kt: BFS with a transaction-count
+    bound, then verify/record in topological order)."""
+
+    MAX_TRANSACTIONS = 5000
+
+    def __init__(self, stx_or_hashes, other_party: Party):
+        if isinstance(stx_or_hashes, SignedTransaction):
+            self.stx: Optional[SignedTransaction] = stx_or_hashes
+            self.hashes: Tuple[SecureHash, ...] = ()
+        else:
+            self.stx = None
+            self.hashes = tuple(stx_or_hashes)
+        self.other_party = other_party
+
+    def call(self):
+        start_hashes = (
+            tuple({inp.txhash for inp in self.stx.tx.inputs})
+            if self.stx is not None
+            else self.hashes
+        )
+        storage = self.service_hub.validated_transactions
+        fetched: dict = {}
+        frontier: List[SecureHash] = [
+            h for h in start_hashes if storage.get(h) is None
+        ]
+        while frontier:
+            if len(fetched) > self.MAX_TRANSACTIONS:
+                raise ExcessivelyLargeTransactionGraphError(
+                    f"dependency graph exceeded {self.MAX_TRANSACTIONS}"
+                )
+            batch = [h for h in frontier if h not in fetched]
+            frontier = []
+            if not batch:
+                break
+            stxs = yield from self.sub_flow(
+                FetchTransactionsFlow(tuple(batch), self.other_party)
+            )
+            for stx in stxs:
+                if stx.id in fetched:
+                    continue
+                fetched[stx.id] = stx
+                for inp in stx.tx.inputs:
+                    if inp.txhash not in fetched and storage.get(inp.txhash) is None:
+                        frontier.append(inp.txhash)
+        # Topological order: dependencies before dependents.
+        ordered = _topological_sort(fetched)
+        for stx in ordered:
+            # Fetch attachments referenced by the dependency if missing.
+            missing_atts = [
+                h for h in stx.tx.attachments
+                if not self.service_hub.attachments.has_attachment(h)
+            ]
+            if missing_atts:
+                yield from self.sub_flow(
+                    FetchAttachmentsFlow(tuple(missing_atts), self.other_party)
+                )
+            stx.verify(self.service_hub)
+            self.service_hub.record_transactions([stx])
+        return ordered
+
+
+def _topological_sort(by_id: dict) -> List[SignedTransaction]:
+    ordered: List[SignedTransaction] = []
+    visited: Set = set()
+
+    def visit(stx):
+        if stx.id in visited:
+            return
+        visited.add(stx.id)
+        for inp in stx.tx.inputs:
+            dep = by_id.get(inp.txhash)
+            if dep is not None:
+                visit(dep)
+        ordered.append(stx)
+
+    for stx in by_id.values():
+        visit(stx)
+    return ordered
+
+
+@initiated_by(ResolveTransactionsFlow)
+class ResolveTransactionsHandler(FlowLogic):
+    """Counterparty side of resolution: serve fetch requests until the
+    initiator's ResolveTransactionsFlow is done.  The initiator's sub-flows
+    (FetchTransactionsFlow) open their own sessions, so this responder only
+    exists when ResolveTransactionsFlow itself initiates — which it does
+    not; kept for registry completeness and session compat."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Broadcast + Finality
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class BroadcastTransactionFlow(FlowLogic):
+    """Send a notarised transaction to recipients for recording
+    (reference BroadcastTransactionFlow.kt)."""
+
+    def __init__(self, stx: SignedTransaction, recipients: Iterable[Party]):
+        self.stx = stx
+        self.recipients = tuple(recipients)
+
+    def call(self):
+        for party in self.recipients:
+            yield self.send(party, self.stx)
+
+
+@initiated_by(BroadcastTransactionFlow)
+class NotifyTransactionHandler(FlowLogic):
+    """Receive a broadcast transaction: resolve its chain from the sender,
+    verify and record (reference NotifyTransactionHandler in
+    AbstractNode.installCoreFlows)."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        stx = yield self.receive(self.counterparty, SignedTransaction)
+        yield from self.sub_flow(ResolveTransactionsFlow(stx, self.counterparty))
+        stx.verify(self.service_hub)
+        self.service_hub.record_transactions([stx])
+
+
+class FinalityFlow(FlowLogic):
+    """Notarise (if needed), record locally, broadcast to participants
+    (reference FinalityFlow.kt:36-78).  Not @initiating_flow itself: its
+    sub-flows open the sessions."""
+
+    def __init__(self, stx: SignedTransaction, extra_recipients: Iterable[Party] = ()):
+        self.stx = stx
+        self.extra_recipients = tuple(extra_recipients)
+
+    def call(self):
+        stx = self.stx
+        # Local verification before asking anyone else to trust it.
+        if stx.notary is not None:
+            stx.verify_signatures_except(stx.notary.owning_key)
+        else:
+            stx.verify_required_signatures()
+        needs_notary = bool(stx.tx.inputs) or stx.tx.time_window is not None
+        if needs_notary and stx.notary is not None:
+            notary_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+            stx = stx.with_additional_signatures(notary_sigs)
+        stx.verify_required_signatures()
+        self.service_hub.record_transactions([stx])
+        recipients = set(self.extra_recipients)
+        for ts in stx.tx.outputs:
+            for p in ts.data.participants:
+                resolved = self.service_hub.identity_service.party_from_anonymous(p)
+                if resolved is not None:
+                    recipients.add(resolved)
+        recipients.discard(self.service_hub.my_info)
+        if recipients:
+            yield from self.sub_flow(
+                BroadcastTransactionFlow(stx, sorted(recipients, key=lambda p: p.name))
+            )
+        return stx
+
+
+def NotaryClientFlowRef(stx):
+    """Late import to avoid core->node cycle at module load."""
+    from ...node.notary import NotaryClientFlow
+
+    return NotaryClientFlow(stx)
+
+
+# ---------------------------------------------------------------------------
+# CollectSignaturesFlow / SignTransactionFlow
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class CollectSignaturesFlow(FlowLogic):
+    """Gather signatures from every required signer except ourselves and the
+    notary (reference CollectSignaturesFlow.kt)."""
+
+    def __init__(self, partially_signed: SignedTransaction):
+        self.partially_signed = partially_signed
+
+    def call(self):
+        stx = self.partially_signed
+        hub = self.service_hub
+        my_keys = hub.key_management_service.keys
+        notary_key = (
+            stx.notary.owning_key.encoded if stx.notary is not None else None
+        )
+        missing = []
+        for key in stx.tx.required_signing_keys:
+            if key.encoded == notary_key or key.encoded in my_keys:
+                continue
+            missing.append(key)
+        for key in missing:
+            party = hub.identity_service.party_from_key(key)
+            if party is None:
+                raise FlowException(f"no identity known for required signer {key}")
+            sig = yield self.send_and_receive(party, stx)
+            stx = stx.with_additional_signature(sig)
+        if stx.notary is not None:
+            stx.verify_signatures_except(stx.notary.owning_key)
+        else:
+            stx.verify_required_signatures()
+        return stx
+
+
+class SignTransactionFlow(FlowLogic):
+    """Abstract responder: receive a proposed stx, run `check_transaction`,
+    sign and return (reference SignTransactionFlow).  Subclass and register
+    with @initiated_by(CollectSignaturesFlow)."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def check_transaction(self, stx: SignedTransaction) -> None:
+        """Override: raise FlowException to refuse signing."""
+
+    def call(self):
+        stx = yield self.receive(self.counterparty, SignedTransaction)
+        stx.check_signatures_are_valid()
+        self.check_transaction(stx)
+        hub = self.service_hub
+        my_keys = hub.key_management_service.keys
+        to_sign = [
+            k for k in stx.tx.required_signing_keys if k.encoded in my_keys
+        ]
+        if not to_sign:
+            raise FlowException("transaction does not require our signature")
+        sig = hub.key_management_service.sign(stx.id.bytes, to_sign[0])
+        yield self.send(self.counterparty, sig)
+        return None

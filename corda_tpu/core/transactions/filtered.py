@@ -83,8 +83,14 @@ class FilteredTransaction:
     # -- typed accessors ----------------------------------------------------
 
     def _of_group(self, group: int) -> List:
+        """Revealed components of one group, ordered by leaf index (a
+        deserialized tear-off may carry components out of order)."""
         return [
-            fc.component for fc in self.filtered_components if fc.group == group
+            fc.component
+            for fc in sorted(
+                (fc for fc in self.filtered_components if fc.group == group),
+                key=lambda fc: fc.index,
+            )
         ]
 
     @property

@@ -1,0 +1,27 @@
+"""corda_tpu.finance: the domain layer (reference `finance/`, 7.2k LoC).
+
+Fungible assets (Cash), CommercialPaper, Obligation, plus the cash flows
+(issue/payment/exit) and the two-party trade flow (delivery-vs-payment).
+"""
+from .cash import Cash, CashCommand, CashState, issued_by
+from .commercial_paper import CommercialPaper, CommercialPaperState, CPCommand
+from .flows import (
+    BuyerFlow,
+    CashExitFlow,
+    CashIssueFlow,
+    CashPaymentFlow,
+    InsufficientBalanceError,
+    SellerFlow,
+    SellerTradeInfo,
+    generate_spend,
+)
+from .obligation import Obligation, ObligationCommand, ObligationState
+
+__all__ = [
+    "Cash", "CashCommand", "CashState", "issued_by",
+    "CommercialPaper", "CommercialPaperState", "CPCommand",
+    "BuyerFlow", "CashExitFlow", "CashIssueFlow", "CashPaymentFlow",
+    "InsufficientBalanceError", "SellerFlow", "SellerTradeInfo",
+    "generate_spend",
+    "Obligation", "ObligationCommand", "ObligationState",
+]

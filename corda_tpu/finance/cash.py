@@ -1,0 +1,149 @@
+"""Cash: the fungible-asset contract (reference
+`finance/src/main/kotlin/net/corda/contracts/asset/Cash.kt`).
+
+States carry `Amount[Issued[currency]]`; commands are Issue / Move / Exit.
+Verification groups states by issuer+currency (reference
+`groupStates { it.amount.token }`) and checks conservation per group:
+  * Issue: outputs - inputs == issued amount, signed by the issuer
+  * Move : inputs == outputs, signed by every input owner
+  * Exit : inputs - outputs == exited amount, signed by issuer + owners
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..core.contracts import (
+    Amount,
+    Contract,
+    ContractState,
+    Issued,
+    OwnableState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from ..core.identity import AbstractParty, Party, PartyAndReference
+from ..core.serialization.codec import corda_serializable
+
+
+class CashCommand:
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Issue(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Move(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Exit:
+        amount: Amount
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class CashState(OwnableState):
+    """Amount of issued currency owned by a party (reference Cash.State)."""
+
+    amount: Amount = None  # Amount[Issued[str]]
+    owner: AbstractParty = None
+    contract_name = "corda_tpu.finance.Cash"
+
+    @property
+    def participants(self) -> List[AbstractParty]:
+        return [self.owner]
+
+    def with_new_owner(self, new_owner: AbstractParty) -> "CashState":
+        return CashState(amount=self.amount, owner=new_owner)
+
+    def move_command(self):
+        return CashCommand.Move()
+
+    @property
+    def issuer(self) -> PartyAndReference:
+        return self.amount.token.issuer
+
+    @property
+    def currency(self) -> str:
+        return self.amount.token.product
+
+
+@contract(name="corda_tpu.finance.Cash")
+class Cash(Contract):
+    def verify(self, tx) -> None:
+        groups = tx.group_states(CashState, lambda s: s.amount.token)
+        commands = [
+            c for c in tx.commands
+            if isinstance(c.value, (CashCommand.Issue, CashCommand.Move,
+                                    CashCommand.Exit))
+        ]
+        if not commands:
+            raise TransactionVerificationError(tx.id, "no cash command")
+        for group in groups:
+            token = group.grouping_key
+            input_sum = Amount.sum_or_zero(
+                (s.amount for s in group.inputs), token
+            )
+            output_sum = Amount.sum_or_zero(
+                (s.amount for s in group.outputs), token
+            )
+            matched = False
+            for cmd in commands:
+                if isinstance(cmd.value, CashCommand.Issue):
+                    if output_sum <= input_sum:
+                        continue
+                    issuer_key = token.issuer.party.owning_key
+                    if issuer_key not in cmd.signers:
+                        raise TransactionVerificationError(
+                            tx.id, "issue must be signed by the issuer"
+                        )
+                    matched = True
+                elif isinstance(cmd.value, CashCommand.Move):
+                    if input_sum.quantity == 0:
+                        continue
+                    if output_sum != input_sum:
+                        raise TransactionVerificationError(
+                            tx.id,
+                            f"cash not conserved for {token}: "
+                            f"in {input_sum} out {output_sum}",
+                        )
+                    owner_keys = {
+                        s.owner.owning_key.encoded for s in group.inputs
+                    }
+                    signer_keys = {
+                        k.encoded for cmd2 in commands for k in cmd2.signers
+                    }
+                    if not owner_keys <= signer_keys:
+                        raise TransactionVerificationError(
+                            tx.id, "move must be signed by all input owners"
+                        )
+                    matched = True
+                elif isinstance(cmd.value, CashCommand.Exit):
+                    exited = cmd.value.amount
+                    if exited.token != token:
+                        continue
+                    if input_sum != output_sum + exited:
+                        raise TransactionVerificationError(
+                            tx.id,
+                            f"exit amount mismatch: in {input_sum}, "
+                            f"out {output_sum}, exited {exited}",
+                        )
+                    issuer_key = token.issuer.party.owning_key
+                    if issuer_key not in cmd.signers:
+                        raise TransactionVerificationError(
+                            tx.id, "exit must be signed by the issuer"
+                        )
+                    matched = True
+            if not matched:
+                raise TransactionVerificationError(
+                    tx.id, f"no cash command matched group {token}"
+                )
+
+
+def issued_by(amount: Amount, issuer: PartyAndReference) -> Amount:
+    """USD 100 `issued_by` bank.ref(1) -> Amount[Issued[str]]."""
+    return Amount(amount.quantity, Issued(issuer, amount.token))

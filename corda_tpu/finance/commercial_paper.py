@@ -1,0 +1,149 @@
+"""CommercialPaper: issue/move/redeem a debt instrument (reference
+`finance/src/main/kotlin/net/corda/contracts/CommercialPaper.kt`).
+
+The state promises `face_value` to its owner at `maturity_date`; redemption
+must move matching cash to the paper's current owner at/after maturity.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..core.contracts import (
+    Amount,
+    Contract,
+    OwnableState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from ..core.identity import AbstractParty, PartyAndReference
+from ..core.serialization.codec import corda_serializable
+from .cash import CashState
+
+
+class CPCommand:
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Issue(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Move(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Redeem(TypeOnlyCommandData):
+        pass
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class CommercialPaperState(OwnableState):
+    issuance: PartyAndReference = None
+    owner: AbstractParty = None
+    face_value: Amount = None  # Amount[Issued[str]]
+    maturity_date: int = 0  # epoch nanos, same clock domain as TimeWindow
+
+    contract_name = "corda_tpu.finance.CommercialPaper"
+
+    @property
+    def participants(self) -> List[AbstractParty]:
+        return [self.owner]
+
+    def with_new_owner(self, new_owner: AbstractParty) -> "CommercialPaperState":
+        return CommercialPaperState(
+            issuance=self.issuance, owner=new_owner,
+            face_value=self.face_value, maturity_date=self.maturity_date,
+        )
+
+    def move_command(self):
+        return CPCommand.Move()
+
+
+@contract(name="corda_tpu.finance.CommercialPaper")
+class CommercialPaper(Contract):
+    def verify(self, tx) -> None:
+        groups = tx.group_states(
+            CommercialPaperState, lambda s: (s.issuance, s.face_value, s.maturity_date)
+        )
+        commands = tx.commands_of_type(
+            (CPCommand.Issue, CPCommand.Move, CPCommand.Redeem)
+        )
+        if not commands:
+            raise TransactionVerificationError(tx.id, "no commercial-paper command")
+        time_window = tx.time_window
+        for group in groups:
+            for cmd in commands:
+                if isinstance(cmd.value, CPCommand.Issue):
+                    if group.inputs:
+                        raise TransactionVerificationError(
+                            tx.id, "issue must not consume paper"
+                        )
+                    if len(group.outputs) != 1:
+                        raise TransactionVerificationError(
+                            tx.id, "issue must create exactly one paper"
+                        )
+                    paper = group.outputs[0]
+                    if paper.issuance.party.owning_key not in cmd.signers:
+                        raise TransactionVerificationError(
+                            tx.id, "issue must be signed by the issuer"
+                        )
+                    if time_window is None:
+                        raise TransactionVerificationError(
+                            tx.id, "issue must have a time window"
+                        )
+                    if time_window.until_time is not None and (
+                        paper.maturity_date <= time_window.until_time
+                    ):
+                        raise TransactionVerificationError(
+                            tx.id, "maturity date is not in the future"
+                        )
+                elif isinstance(cmd.value, CPCommand.Move):
+                    if len(group.inputs) != 1 or len(group.outputs) != 1:
+                        raise TransactionVerificationError(
+                            tx.id, "move must be 1 paper in, 1 paper out"
+                        )
+                    inp, out = group.inputs[0], group.outputs[0]
+                    if inp.owner.owning_key not in cmd.signers:
+                        raise TransactionVerificationError(
+                            tx.id, "move must be signed by the current owner"
+                        )
+                    if (
+                        out.issuance != inp.issuance
+                        or out.face_value != inp.face_value
+                        or out.maturity_date != inp.maturity_date
+                    ):
+                        raise TransactionVerificationError(
+                            tx.id, "move must only change the owner"
+                        )
+                elif isinstance(cmd.value, CPCommand.Redeem):
+                    if len(group.inputs) != 1 or group.outputs:
+                        raise TransactionVerificationError(
+                            tx.id, "redeem consumes the paper with no paper out"
+                        )
+                    paper = group.inputs[0]
+                    if time_window is None or time_window.from_time is None:
+                        raise TransactionVerificationError(
+                            tx.id, "redeem must have a time window"
+                        )
+                    if time_window.from_time < paper.maturity_date:
+                        raise TransactionVerificationError(
+                            tx.id, "paper has not matured yet"
+                        )
+                    received = Amount.sum_or_none(
+                        s.amount for s in tx.outputs_of_type(CashState)
+                        if s.owner == paper.owner
+                    )
+                    if received is None or received != paper.face_value:
+                        raise TransactionVerificationError(
+                            tx.id,
+                            f"redemption must pay the face value "
+                            f"{paper.face_value} to the owner",
+                        )
+                    if paper.owner.owning_key not in cmd.signers:
+                        raise TransactionVerificationError(
+                            tx.id, "redeem must be signed by the owner"
+                        )

@@ -1,0 +1,276 @@
+"""Cash + trade flows (reference `finance/src/main/kotlin/net/corda/flows/`:
+CashIssueFlow, CashPaymentFlow, CashExitFlow, TwoPartyTradeFlow).
+"""
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..core.contracts import Amount, Command, StateAndRef, TransactionState
+from ..core.flows import (
+    FinalityFlow,
+    FlowException,
+    FlowLogic,
+    ResolveTransactionsFlow,
+    initiated_by,
+    initiating_flow,
+)
+from ..core.identity import Party, PartyAndReference
+from ..core.serialization.codec import register_adapter
+from ..core.transactions import TransactionBuilder
+from ..core.transactions.signed import SignedTransaction
+from .cash import CashCommand, CashState, issued_by
+
+
+class InsufficientBalanceError(FlowException):
+    def __init__(self, missing: Amount):
+        super().__init__(f"insufficient balance, missing {missing}")
+        self.missing = missing
+
+
+# ---------------------------------------------------------------------------
+# Coin selection + spend generation (reference Cash.generateSpend + vault
+# coin selection with soft locks, NodeVaultService.kt:321-349)
+# ---------------------------------------------------------------------------
+
+def generate_spend(
+    service_hub,
+    builder: TransactionBuilder,
+    amount: Amount,  # Amount[Issued[str]] — the exact token to spend
+    to_party: Party,
+    lock_id: Optional[str] = None,
+) -> Tuple[TransactionBuilder, List]:
+    """Select our unconsumed cash of `amount.token`, add inputs + payment +
+    change outputs and a Move command.  Selected states are soft-locked
+    under lock_id so concurrent flows cannot double-select."""
+    vault = service_hub.vault_service
+    lock_id = lock_id or str(uuid.uuid4())
+    candidates = [
+        sr for sr in vault.unlocked_unconsumed_states(
+            CashState.contract_name, lock_id=lock_id
+        )
+        if sr.state.data.amount.token == amount.token
+    ]
+    selected, gathered = [], 0
+    for sr in candidates:
+        if gathered >= amount.quantity:
+            break
+        selected.append(sr)
+        gathered += sr.state.data.amount.quantity
+    if gathered < amount.quantity:
+        raise InsufficientBalanceError(
+            Amount(amount.quantity - gathered, amount.token)
+        )
+    vault.soft_lock_reserve(lock_id, [sr.ref for sr in selected])
+    me = service_hub.my_info
+    for sr in selected:
+        builder.add_input_state(sr)
+    builder.add_output_state(CashState(amount=amount, owner=to_party))
+    change = gathered - amount.quantity
+    if change > 0:
+        builder.add_output_state(
+            CashState(amount=Amount(change, amount.token), owner=me)
+        )
+    signer_keys = {sr.state.data.owner.owning_key for sr in selected}
+    builder.add_command(CashCommand.Move(), *signer_keys)
+    return builder, selected
+
+
+# ---------------------------------------------------------------------------
+# Cash flows
+# ---------------------------------------------------------------------------
+
+class CashIssueFlow(FlowLogic):
+    """Issue cash on the ledger to a recipient (reference CashIssueFlow).
+    We are the issuer; no notarisation needed (no inputs)."""
+
+    def __init__(self, amount: Amount, issuer_ref: bytes, recipient: Party,
+                 notary: Party):
+        self.amount = amount
+        self.issuer_ref = issuer_ref
+        self.recipient = recipient
+        self.notary = notary
+
+    def call(self):
+        me = self.service_hub.my_info
+        issued_amount = issued_by(self.amount, me.ref(*self.issuer_ref))
+        builder = TransactionBuilder(notary=self.notary)
+        builder.add_output_state(
+            CashState(amount=issued_amount, owner=self.recipient)
+        )
+        builder.add_command(CashCommand.Issue(), me.owning_key)
+        stx = self.service_hub.sign_initial_transaction(builder)
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+class CashPaymentFlow(FlowLogic):
+    """Pay issued cash to a recipient (reference CashPaymentFlow)."""
+
+    def __init__(self, amount: Amount, recipient: Party, notary: Party):
+        self.amount = amount  # Amount[Issued[str]]
+        self.recipient = recipient
+        self.notary = notary
+
+    def call(self):
+        builder = TransactionBuilder(notary=self.notary)
+        lock_id = str(uuid.uuid4())
+        try:
+            generate_spend(
+                self.service_hub, builder, self.amount, self.recipient, lock_id
+            )
+            stx = self.service_hub.sign_initial_transaction(builder)
+            result = yield from self.sub_flow(FinalityFlow(stx))
+        except Exception:
+            self.service_hub.vault_service.soft_lock_release(lock_id)
+            raise
+        return result
+
+
+class CashExitFlow(FlowLogic):
+    """Remove our issued cash from the ledger (reference CashExitFlow)."""
+
+    def __init__(self, amount: Amount, notary: Party):
+        self.amount = amount  # Amount[Issued[str]] where we are the issuer
+        self.notary = notary
+
+    def call(self):
+        hub = self.service_hub
+        me = hub.my_info
+        vault = hub.vault_service
+        lock_id = str(uuid.uuid4())
+        candidates = [
+            sr for sr in vault.unlocked_unconsumed_states(
+                CashState.contract_name, lock_id=lock_id
+            )
+            if sr.state.data.amount.token == self.amount.token
+            and sr.state.data.owner == me
+        ]
+        selected, gathered = [], 0
+        for sr in candidates:
+            if gathered >= self.amount.quantity:
+                break
+            selected.append(sr)
+            gathered += sr.state.data.amount.quantity
+        if gathered < self.amount.quantity:
+            raise InsufficientBalanceError(
+                Amount(self.amount.quantity - gathered, self.amount.token)
+            )
+        vault.soft_lock_reserve(lock_id, [sr.ref for sr in selected])
+        try:
+            builder = TransactionBuilder(notary=self.notary)
+            for sr in selected:
+                builder.add_input_state(sr)
+            change = gathered - self.amount.quantity
+            if change > 0:
+                builder.add_output_state(
+                    CashState(amount=Amount(change, self.amount.token), owner=me)
+                )
+            builder.add_command(
+                CashCommand.Exit(self.amount), me.owning_key
+            )
+            stx = hub.sign_initial_transaction(builder)
+            result = yield from self.sub_flow(FinalityFlow(stx))
+        except Exception:
+            vault.soft_lock_release(lock_id)
+            raise
+        return result
+
+
+# ---------------------------------------------------------------------------
+# Two-party trade (delivery vs payment, reference TwoPartyTradeFlow.kt)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SellerTradeInfo:
+    asset: StateAndRef
+    price: Amount  # Amount[Issued[str]] the buyer must pay
+    seller: Party
+
+
+register_adapter(
+    SellerTradeInfo, "SellerTradeInfo",
+    lambda i: {"asset": i.asset, "price": i.price, "seller": i.seller},
+    lambda d: SellerTradeInfo(d["asset"], d["price"], d["seller"]),
+)
+
+
+@initiating_flow
+class SellerFlow(FlowLogic):
+    """Offer an OwnableState for a cash price.  The buyer assembles the DvP
+    transaction; we check it pays us and sign + finalise."""
+
+    def __init__(self, buyer: Party, asset: StateAndRef, price: Amount,
+                 notary: Party):
+        self.buyer = buyer
+        self.asset = asset
+        self.price = price
+        self.notary = notary
+
+    def call(self):
+        me = self.service_hub.my_info
+        info = SellerTradeInfo(self.asset, self.price, me)
+        proposal = yield self.send_and_receive(
+            self.buyer, info, SignedTransaction
+        )
+        wtx = proposal.tx
+        # The proposal must consume our asset and pay us the price.
+        if self.asset.ref not in wtx.inputs:
+            raise FlowException("proposal does not consume the offered asset")
+        paid = Amount.sum_or_none(
+            ts.data.amount for ts in wtx.outputs
+            if isinstance(ts.data, CashState)
+            and ts.data.owner == me
+            and ts.data.amount.token == self.price.token
+        )
+        if paid is None or paid < self.price:
+            raise FlowException(f"proposal pays {paid}, price is {self.price}")
+        # Pull the proposal's dependency chain (the buyer's cash history)
+        # from the buyer so we — and the notary resolving from us — can
+        # verify it (reference TwoPartyTradeFlow ResolveTransactionsFlow).
+        yield from self.sub_flow(ResolveTransactionsFlow(proposal, self.buyer))
+        # The buyer must have signed already; we add ours and finalise.
+        proposal.check_signatures_are_valid()
+        stx = self.service_hub.add_signature(proposal)
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+@initiated_by(SellerFlow)
+class BuyerFlow(FlowLogic):
+    """Receive the offer, verify the asset's provenance, build + sign the
+    DvP transaction, send it back, and wait for the notarised result."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        info = yield self.receive(self.counterparty, SellerTradeInfo)
+        # Pull and verify the asset's back-chain before paying for it.
+        yield from self.sub_flow(
+            ResolveTransactionsFlow([info.asset.ref.txhash], self.counterparty)
+        )
+        me = self.service_hub.my_info
+        notary = info.asset.state.notary
+        builder = TransactionBuilder(notary=notary)
+        lock_id = str(uuid.uuid4())
+        try:
+            generate_spend(
+                self.service_hub, builder, info.price, info.seller, lock_id
+            )
+            builder.add_input_state(info.asset)
+            builder.add_output_state(
+                info.asset.state.data.with_new_owner(me)
+            )
+            builder.add_command(
+                info.asset.state.data.move_command(),
+                info.asset.state.data.owner.owning_key,
+            )
+            stx = self.service_hub.sign_initial_transaction(builder)
+            yield self.send(self.counterparty, stx)
+            final = yield self.wait_for_ledger_commit(stx.id)
+        except Exception:
+            self.service_hub.vault_service.soft_lock_release(lock_id)
+            raise
+        return final

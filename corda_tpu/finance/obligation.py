@@ -1,0 +1,152 @@
+"""Obligation: a bilateral IOU netting contract (reference
+`finance/src/main/kotlin/net/corda/contracts/asset/Obligation.kt`, reduced
+to the core lifecycle: Issue / Move / Settle / Net).
+
+An ObligationState says `obligor` owes `amount` to `beneficiary`.  Settle
+consumes obligations by paying cash to the beneficiary; Net cancels
+offsetting obligations between the same pair.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..core.contracts import (
+    Amount,
+    Contract,
+    ContractState,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from ..core.identity import AbstractParty
+from ..core.serialization.codec import corda_serializable
+from .cash import CashState
+
+
+class ObligationCommand:
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Issue(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Move(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Settle(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Net(TypeOnlyCommandData):
+        pass
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class ObligationState(ContractState):
+    obligor: AbstractParty = None
+    beneficiary: AbstractParty = None
+    amount: Amount = None  # Amount[Issued[str]]
+
+    contract_name = "corda_tpu.finance.Obligation"
+
+    @property
+    def participants(self) -> List[AbstractParty]:
+        return [self.obligor, self.beneficiary]
+
+
+@contract(name="corda_tpu.finance.Obligation")
+class Obligation(Contract):
+    def verify(self, tx) -> None:
+        commands = tx.commands_of_type(
+            (ObligationCommand.Issue, ObligationCommand.Move,
+             ObligationCommand.Settle, ObligationCommand.Net)
+        )
+        if not commands:
+            raise TransactionVerificationError(tx.id, "no obligation command")
+        cmd = commands[0].value
+        signers = {
+            k.encoded for c in commands for k in c.signers
+        }
+        ins = tx.inputs_of_type(ObligationState)
+        outs = tx.outputs_of_type(ObligationState)
+        if isinstance(cmd, ObligationCommand.Issue):
+            if len(outs) <= len(ins):
+                raise TransactionVerificationError(
+                    tx.id, "issue must create obligations"
+                )
+            for ob in outs:
+                if ob.obligor.owning_key.encoded not in signers:
+                    raise TransactionVerificationError(
+                        tx.id, "obligor must sign the issue"
+                    )
+        elif isinstance(cmd, ObligationCommand.Move):
+            in_total = _totals(ins)
+            out_total = _totals(outs)
+            if in_total != out_total:
+                raise TransactionVerificationError(
+                    tx.id, "move must conserve obligation totals per obligor"
+                )
+            for ob in ins:
+                if ob.beneficiary.owning_key.encoded not in signers:
+                    raise TransactionVerificationError(
+                        tx.id, "beneficiary must sign a move"
+                    )
+        elif isinstance(cmd, ObligationCommand.Settle):
+            if outs:
+                raise TransactionVerificationError(
+                    tx.id, "settle must consume obligations entirely"
+                )
+            for ob in ins:
+                paid = Amount.sum_or_none(
+                    s.amount for s in tx.outputs_of_type(CashState)
+                    if s.owner == ob.beneficiary and s.amount.token == ob.amount.token
+                )
+                if paid is None or paid < ob.amount:
+                    raise TransactionVerificationError(
+                        tx.id,
+                        f"settlement must pay {ob.amount} to {ob.beneficiary}",
+                    )
+                if ob.obligor.owning_key.encoded not in signers:
+                    raise TransactionVerificationError(
+                        tx.id, "obligor must sign the settlement"
+                    )
+        elif isinstance(cmd, ObligationCommand.Net):
+            # Bilateral netting: totals per (obligor, beneficiary, token) must
+            # cancel to the pairwise difference.
+            if _net_positions(ins) != _net_positions(outs):
+                raise TransactionVerificationError(
+                    tx.id, "netting must preserve net positions"
+                )
+            parties = {ob.obligor for ob in ins} | {ob.beneficiary for ob in ins}
+            for p in parties:
+                if p.owning_key.encoded not in signers:
+                    raise TransactionVerificationError(
+                        tx.id, "all involved parties must sign a netting"
+                    )
+
+
+def _totals(obligations) -> dict:
+    totals: dict = {}
+    for ob in obligations:
+        key = (ob.obligor, ob.amount.token)
+        totals[key] = totals.get(key, 0) + ob.amount.quantity
+    return totals
+
+
+def _net_positions(obligations) -> dict:
+    """Signed pairwise positions, canonical party order."""
+    net: dict = {}
+    for ob in obligations:
+        a, b = sorted(
+            [ob.obligor, ob.beneficiary], key=lambda p: p.owning_key.encoded
+        )
+        sign = 1 if ob.obligor == a else -1
+        key = (a, b, ob.amount.token)
+        net[key] = net.get(key, 0) + sign * ob.amount.quantity
+    return {k: v for k, v in net.items() if v != 0}

@@ -24,6 +24,7 @@ import os
 import struct
 import threading
 import time
+import urllib.parse
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
@@ -74,9 +75,9 @@ class _Journal:
     replay.  The C++ journal (corda_tpu.native) writes the identical format.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, truncate: bool = False):
         self._path = path
-        self._fh = open(path, "ab")
+        self._fh = open(path, "wb" if truncate else "ab")
 
     def append_enqueue(self, msg: Message) -> None:
         hdr_blob = _encode_headers(msg.headers)
@@ -118,11 +119,12 @@ class _Journal:
                 (hlen,) = struct.unpack_from(">I", body, 36)
                 headers = _decode_headers(body[40:40 + hlen])
                 payload = body[40 + hlen:]
+                if mid not in pending:
+                    order.append(mid)
                 pending[mid] = Message(
                     payload=payload, headers=headers, message_id=mid,
                     delivery_count=2,  # redelivery after restart
                 )
-                order.append(mid)
             elif rec_type == _REC_ACK:
                 pending.pop(body.decode("ascii"), None)
         return [pending[m] for m in order if m in pending]
@@ -245,21 +247,23 @@ class Broker:
             os.makedirs(journal_dir, exist_ok=True)
             for fname in sorted(os.listdir(journal_dir)):
                 if fname.endswith(".journal"):
-                    qname = fname[: -len(".journal")]
+                    qname = urllib.parse.unquote(fname[: -len(".journal")])
                     self._recover_queue(qname)
 
     def _journal_path(self, queue_name: str) -> str:
         assert self._journal_dir is not None
-        safe = queue_name.replace("/", "_")
+        # Reversible, collision-free filename encoding ('/' and friends).
+        safe = urllib.parse.quote(queue_name, safe="")
         return os.path.join(self._journal_dir, f"{safe}.journal")
 
     def _recover_queue(self, name: str) -> None:
         path = self._journal_path(name)
         pending = _Journal.replay(path)
-        # Compact crash-safely: write the pending set to a tmp file, then
-        # atomically rename over the journal. A crash at any point leaves
-        # either the old full journal or the complete compacted one.
-        tmp = _Journal(path + ".tmp")
+        # Compact crash-safely: write the pending set to a tmp file (truncated
+        # in case a previous compaction crashed mid-write), then atomically
+        # rename over the journal. A crash at any point leaves either the old
+        # full journal or the complete compacted one.
+        tmp = _Journal(path + ".tmp", truncate=True)
         for msg in pending:
             tmp.append_enqueue(msg)
         tmp.close()

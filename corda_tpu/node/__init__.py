@@ -1,0 +1,6 @@
+"""corda_tpu.node: the node runtime (reference `node/`, 26.5k LoC Kotlin).
+
+Services, state machine (flow scheduler + checkpoints), messaging, storage,
+notaries.  The compute-heavy paths (signature batches) dispatch to
+corda_tpu.ops / corda_tpu.parallel; everything here is orchestration.
+"""

@@ -1,0 +1,231 @@
+"""Node persistence: a single embedded SQLite store.
+
+The reference stacks four JVM ORMs over H2 (SURVEY.md section 2.9); here one
+sqlite3 database holds every node-side table.  Each storage service owns its
+tables and goes through `NodeDatabase`, which serializes access with a lock
+(the node's logical server thread + background threads share it safely).
+
+Reference seams:
+  * CheckpointStorage   — `node/.../api/CheckpointStorage.kt:33`,
+                          `DBCheckpointStorage.kt:18-60`
+  * TransactionStorage  — `node/.../persistence/DBTransactionStorage.kt`
+  * AttachmentStorage   — `node/.../persistence/NodeAttachmentService.kt`
+  * generic KV map      — `node/.../utilities/JDBCHashMap.kt` (508 LoC of
+                          blob-map plumbing the TPU build gets from sqlite)
+"""
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..core.crypto.secure_hash import SecureHash
+from ..core.serialization.codec import deserialize, serialize
+
+
+class NodeDatabase:
+    """Shared sqlite connection. path=':memory:' for tests/MockNetwork."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self.lock = threading.RLock()
+
+    def execute(self, sql: str, params: Tuple = ()) -> sqlite3.Cursor:
+        with self.lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur
+
+    def executemany(self, sql: str, rows) -> None:
+        with self.lock:
+            self._conn.executemany(sql, rows)
+            self._conn.commit()
+
+    def query(self, sql: str, params: Tuple = ()) -> List[Tuple]:
+        with self.lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def transaction(self):
+        """Context manager: BEGIN ... COMMIT/ROLLBACK under the lock."""
+        return _Tx(self)
+
+    def close(self) -> None:
+        with self.lock:
+            self._conn.close()
+
+
+class _Tx:
+    def __init__(self, db: NodeDatabase):
+        self.db = db
+
+    def __enter__(self):
+        self.db.lock.acquire()
+        return self.db._conn
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if exc_type is None:
+                self.db._conn.commit()
+            else:
+                self.db._conn.rollback()
+        finally:
+            self.db.lock.release()
+        return False
+
+
+class CheckpointStorage:
+    """flow_id -> checkpoint blob (replay state, not a serialized stack)."""
+
+    def __init__(self, db: NodeDatabase):
+        self.db = db
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS checkpoints "
+            "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
+        )
+
+    def put(self, flow_id: str, blob: bytes) -> None:
+        self.db.execute(
+            "INSERT INTO checkpoints(flow_id, blob) VALUES(?, ?) "
+            "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
+            (flow_id, blob),
+        )
+
+    def remove(self, flow_id: str) -> None:
+        self.db.execute("DELETE FROM checkpoints WHERE flow_id = ?", (flow_id,))
+
+    def all_checkpoints(self) -> List[Tuple[str, bytes]]:
+        return [
+            (row[0], row[1])
+            for row in self.db.query("SELECT flow_id, blob FROM checkpoints")
+        ]
+
+    def count(self) -> int:
+        return self.db.query("SELECT COUNT(*) FROM checkpoints")[0][0]
+
+
+class TransactionStorage:
+    """Validated SignedTransactions by id, with a commit-observer feed
+    (reference DBTransactionStorage + Rx updates)."""
+
+    def __init__(self, db: NodeDatabase):
+        self.db = db
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS transactions "
+            "(tx_id BLOB PRIMARY KEY, blob BLOB NOT NULL)"
+        )
+        self._observers: List[Callable] = []
+
+    def add(self, stx) -> bool:
+        """Record; returns False if already present. Fires observers on new."""
+        with self.db.lock:
+            existing = self.db.query(
+                "SELECT 1 FROM transactions WHERE tx_id = ?", (stx.id.bytes,)
+            )
+            if existing:
+                return False
+            self.db.execute(
+                "INSERT INTO transactions(tx_id, blob) VALUES(?, ?)",
+                (stx.id.bytes, serialize(stx)),
+            )
+        for obs in list(self._observers):
+            obs(stx)
+        return True
+
+    def get(self, tx_id: SecureHash):
+        rows = self.db.query(
+            "SELECT blob FROM transactions WHERE tx_id = ?", (tx_id.bytes,)
+        )
+        return deserialize(rows[0][0]) if rows else None
+
+    def track(self, observer: Callable) -> None:
+        self._observers.append(observer)
+
+    def count(self) -> int:
+        return self.db.query("SELECT COUNT(*) FROM transactions")[0][0]
+
+
+class AttachmentStorage:
+    """Content-addressed attachment store with hash verification on read
+    (reference NodeAttachmentService: hash check catches disk corruption)."""
+
+    def __init__(self, db: NodeDatabase):
+        self.db = db
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS attachments "
+            "(att_id BLOB PRIMARY KEY, data BLOB NOT NULL)"
+        )
+
+    def import_attachment(self, data: bytes) -> SecureHash:
+        att_id = SecureHash.sha256(data)
+        self.db.execute(
+            "INSERT OR IGNORE INTO attachments(att_id, data) VALUES(?, ?)",
+            (att_id.bytes, data),
+        )
+        return att_id
+
+    def open_attachment(self, att_id: SecureHash):
+        from ..core.contracts.structures import Attachment
+
+        rows = self.db.query(
+            "SELECT data FROM attachments WHERE att_id = ?", (att_id.bytes,)
+        )
+        if not rows:
+            return None
+        data = rows[0][0]
+        if SecureHash.sha256(data) != att_id:
+            raise IOError(f"attachment {att_id} corrupted on disk")
+        return Attachment(att_id, data)
+
+    def has_attachment(self, att_id: SecureHash) -> bool:
+        return bool(
+            self.db.query(
+                "SELECT 1 FROM attachments WHERE att_id = ?", (att_id.bytes,)
+            )
+        )
+
+
+class KVStore:
+    """Generic named blob map (the JDBCHashMap replacement)."""
+
+    def __init__(self, db: NodeDatabase, name: str):
+        assert name.isidentifier()
+        self.db = db
+        self.table = f"kv_{name}"
+        db.execute(
+            f"CREATE TABLE IF NOT EXISTS {self.table} "
+            "(k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+        )
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.db.execute(
+            f"INSERT INTO {self.table}(k, v) VALUES(?, ?) "
+            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+            (key, value),
+        )
+
+    def put_if_absent(self, key: bytes, value: bytes) -> bool:
+        """Atomic insert-if-absent; returns True if inserted."""
+        with self.db.lock:
+            cur = self.db.execute(
+                f"INSERT OR IGNORE INTO {self.table}(k, v) VALUES(?, ?)",
+                (key, value),
+            )
+            return cur.rowcount == 1
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        rows = self.db.query(
+            f"SELECT v FROM {self.table} WHERE k = ?", (key,)
+        )
+        return rows[0][0] if rows else None
+
+    def delete(self, key: bytes) -> None:
+        self.db.execute(f"DELETE FROM {self.table} WHERE k = ?", (key,))
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        return iter(self.db.query(f"SELECT k, v FROM {self.table}"))
+
+    def __len__(self) -> int:
+        return self.db.query(f"SELECT COUNT(*) FROM {self.table}")[0][0]

@@ -1,0 +1,174 @@
+"""Node-to-node transports.
+
+`InMemoryMessagingNetwork` is the deterministic multi-node-in-one-process
+transport (reference `test-utils/.../InMemoryMessagingNetwork.kt:47-144`):
+messages queue globally and are delivered only when pumped, so MockNetwork
+tests are fully deterministic; an optional latency/drop injector reorders
+the world for failure testing.  `BrokerMessagingService` adapts the durable
+broker (corda_tpu.messaging) to the same interface for single-node +
+verifier topologies.
+
+Interface (NodeMessagingClient equivalent, reference `Messaging.kt`):
+    send(peer: Party, topic: str, payload: bytes)
+    add_handler(topic, fn(sender: Party, payload: bytes))
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..core.identity import Party
+
+
+@dataclass(frozen=True)
+class _InFlight:
+    sender: Party
+    recipient: str  # party name
+    topic: str
+    payload: bytes
+
+
+class InMemoryMessagingNetwork:
+    """Deterministic pumped network of named endpoints."""
+
+    def __init__(self):
+        self._queue: Deque[_InFlight] = deque()
+        self._endpoints: Dict[str, "InMemoryMessaging"] = {}
+        self._lock = threading.Lock()
+        self.sent_count = 0
+        self.delivered_count = 0
+        # Hook: fn(msg) -> bool keep (False drops the message); used for
+        # fault injection in tests.
+        self.filter: Optional[Callable[[_InFlight], bool]] = None
+
+    def create_endpoint(self, me: Party) -> "InMemoryMessaging":
+        ep = InMemoryMessaging(self, me)
+        with self._lock:
+            self._endpoints[me.name] = ep
+        return ep
+
+    def remove_endpoint(self, name: str) -> None:
+        with self._lock:
+            self._endpoints.pop(name, None)
+
+    def _enqueue(self, msg: _InFlight) -> None:
+        with self._lock:
+            self._queue.append(msg)
+            self.sent_count += 1
+
+    def pump(self) -> bool:
+        """Deliver exactly one queued message. Returns False when idle."""
+        with self._lock:
+            if not self._queue:
+                return False
+            msg = self._queue.popleft()
+            if self.filter is not None and not self.filter(msg):
+                return True  # dropped by the injector; work was done
+            ep = self._endpoints.get(msg.recipient)
+        if ep is not None:
+            ep._deliver(msg.sender, msg.topic, msg.payload)
+        with self._lock:
+            self.delivered_count += 1
+        return True
+
+    def run(self, max_messages: int = 100_000) -> int:
+        """Pump until quiescent (reference runNetwork). Returns deliveries."""
+        n = 0
+        while self.pump():
+            n += 1
+            if n > max_messages:
+                raise RuntimeError("network did not quiesce (message storm?)")
+        return n
+
+
+class InMemoryMessaging:
+    """One node's endpoint on the in-memory network."""
+
+    def __init__(self, network: InMemoryMessagingNetwork, me: Party):
+        self.network = network
+        self.me = me
+        self._handlers: Dict[str, List[Callable]] = {}
+        self.running = True
+
+    def send(self, peer: Party, topic: str, payload: bytes) -> None:
+        self.network._enqueue(
+            _InFlight(self.me, peer.name, topic, payload)
+        )
+
+    def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
+        self._handlers.setdefault(topic, []).append(fn)
+
+    def _deliver(self, sender: Party, topic: str, payload: bytes) -> None:
+        if not self.running:
+            return
+        for fn in self._handlers.get(topic, []):
+            fn(sender, payload)
+
+    def stop(self) -> None:
+        self.running = False
+        self.network.remove_endpoint(self.me.name)
+
+
+class BrokerMessagingService:
+    """Same interface over the durable Broker: each node gets a queue
+    `p2p.inbound.{name}`; a consumer thread dispatches to topic handlers.
+    Used for single-process durable deployments and the verifier topology."""
+
+    def __init__(self, broker, me: Party):
+        from ..core.serialization.codec import deserialize, serialize
+
+        self._serialize = serialize
+        self._deserialize = deserialize
+        self.broker = broker
+        self.me = me
+        self.queue_name = f"p2p.inbound.{me.name}"
+        broker.create_queue(self.queue_name, durable=broker._journal_dir is not None)
+        self._handlers: Dict[str, List[Callable]] = {}
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(self.queue_name)
+        self._thread = threading.Thread(
+            target=self._consume, name=f"p2p-{me.name}", daemon=True
+        )
+        self._thread.start()
+
+    def send(self, peer: Party, topic: str, payload: bytes) -> None:
+        self.broker.send(
+            f"p2p.inbound.{peer.name}",
+            payload,
+            headers={"topic": topic, "sender": self.me.name,
+                     "sender_key": self.me.owning_key.encoded.hex()},
+        )
+
+    def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
+        self._handlers.setdefault(topic, []).append(fn)
+
+    def _consume(self) -> None:
+        from ..core.crypto.keys import SchemePublicKey
+
+        while not self._stop.is_set():
+            msg = self._consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            topic = msg.headers.get("topic", "")
+            sender = Party(
+                msg.headers.get("sender", "?"),
+                SchemePublicKey(
+                    "EDDSA_ED25519_SHA512",
+                    bytes.fromhex(msg.headers.get("sender_key", "")),
+                )
+                if msg.headers.get("sender_key")
+                else None,
+            )
+            for fn in self._handlers.get(topic, []):
+                try:
+                    fn(sender, msg.payload)
+                except Exception:
+                    pass  # handler errors must not kill the pump
+            self._consumer.ack(msg)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        self._thread.join(timeout=2)

@@ -1,0 +1,125 @@
+"""Node wiring (reference `AbstractNode.kt:160-221` start sequence).
+
+`AbstractNode` assembles: database → verifier service → ServiceHub → SMM →
+notary service (if configured) → messaging handlers → checkpoint restore.
+Transport and DB location come from `NodeConfiguration`, so the same class
+backs MockNetwork test nodes (in-memory DB + pumped network) and standalone
+nodes (file DB + broker transport).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+from ..core.crypto import crypto
+from ..core.crypto.keys import KeyPair
+from ..core.identity import Party
+from ..verifier.batcher import SignatureBatcher
+from ..verifier.service import (
+    InMemoryTransactionVerifierService,
+    OutOfProcessTransactionVerifierService,
+)
+from .database import CheckpointStorage, NodeDatabase
+from .services import NetworkMapCache, ServiceHub
+from .statemachine import StateMachineManager
+
+
+@dataclass
+class NodeConfiguration:
+    """Reference `FullNodeConfiguration` / `reference.conf` defaults."""
+    my_legal_name: str
+    db_path: str = ":memory:"
+    verifier_type: str = "InMemory"  # InMemory | OutOfProcess
+    notary_type: Optional[str] = None  # None | simple | validating
+    # entropy for the deterministic dev identity key (None -> random)
+    identity_entropy: Optional[int] = None
+    advertised_services: List[str] = field(default_factory=list)
+
+
+class AbstractNode:
+    """A node: services + state machine + messaging, one legal identity."""
+
+    def __init__(self, config: NodeConfiguration, messaging_factory, broker=None):
+        """messaging_factory(me: Party) -> MessagingService."""
+        self.config = config
+        if config.identity_entropy is not None:
+            self._identity_key = crypto.entropy_to_keypair(config.identity_entropy)
+        else:
+            self._identity_key = crypto.generate_keypair()
+        self.info = Party(config.my_legal_name, self._identity_key.public)
+        self.database = NodeDatabase(config.db_path)
+        self.checkpoint_storage = CheckpointStorage(self.database)
+        self._broker = broker
+        self.network = messaging_factory(self.info)
+        verifier = self._make_transaction_verifier_service()
+        self.services = ServiceHub(
+            self.info, self.database, verifier, self._identity_key
+        )
+        self.smm = StateMachineManager(
+            self.services, self.network, self.checkpoint_storage, self.info
+        )
+        self.services._smm = self.smm
+        from .scheduler import SchedulerService
+
+        self.scheduler = SchedulerService(self.database, self.services, self.smm)
+        self.services.scheduler = self.scheduler
+        self.notary_service = None
+        if config.notary_type is not None:
+            self._make_notary_service()
+        self.started = False
+
+    # -- assembly ------------------------------------------------------------
+
+    def _make_transaction_verifier_service(self):
+        if self.config.verifier_type == "OutOfProcess":
+            if self._broker is None:
+                raise ValueError("OutOfProcess verifier requires a broker")
+            return OutOfProcessTransactionVerifierService(
+                self._broker, self.config.my_legal_name
+            )
+        return InMemoryTransactionVerifierService(batcher=SignatureBatcher())
+
+    def _make_notary_service(self):
+        from .notary import SimpleNotaryService, ValidatingNotaryService
+
+        if self.config.notary_type == "validating":
+            self.notary_service = ValidatingNotaryService(self.services, self.info)
+            if NetworkMapCache.VALIDATING_NOTARY_SERVICE not in self.config.advertised_services:
+                self.config.advertised_services.append(
+                    NetworkMapCache.VALIDATING_NOTARY_SERVICE
+                )
+        else:
+            self.notary_service = SimpleNotaryService(self.services, self.info)
+        self.services.notary_service = self.notary_service
+        if NetworkMapCache.NOTARY_SERVICE not in self.config.advertised_services:
+            self.config.advertised_services.append(NetworkMapCache.NOTARY_SERVICE)
+
+    def start(self) -> "AbstractNode":
+        """Install core flows, register self in the network map, restore
+        checkpoints (reference AbstractNode.start + smm.start)."""
+        from ..core import flows as _core_flows  # noqa: F401 — registers core flows
+        from . import notary as _notary  # noqa: F401 — registers notary responders
+
+        self.services.network_map_cache.add_node(
+            self.info, self.config.advertised_services
+        )
+        self.smm.start()
+        self.started = True
+        return self
+
+    def stop(self) -> None:
+        if hasattr(self.network, "stop"):
+            self.network.stop()
+        svc = self.services.transaction_verifier_service
+        if hasattr(svc, "stop"):
+            svc.stop()
+        self.database.close()
+
+    # -- conveniences --------------------------------------------------------
+
+    def start_flow(self, flow, *args_for_restore, **kw):
+        return self.smm.start_flow(flow, *args_for_restore, **kw)
+
+    def register_peer(self, peer_info: Party, advertised: Iterable[str] = ()) -> None:
+        self.services.network_map_cache.add_node(peer_info, advertised)
+        self.services.identity_service.register_identity(peer_info)

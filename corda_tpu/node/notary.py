@@ -1,0 +1,315 @@
+"""Notary services: uniqueness consensus over consumed input states.
+
+Reference (SURVEY.md section 2.6):
+  * `NotaryService` base + helpers — `core/.../node/services/NotaryService.kt`
+  * `NotaryFlow.Client` / `.Service`  — `core/.../flows/NotaryFlow.kt`
+  * `SimpleNotaryService` — `node/.../transactions/SimpleNotaryService.kt`
+  * `ValidatingNotaryService/Flow` — the path that drives batch verification
+  * `PersistentUniquenessProvider` — RDBMS commit log with conflict
+    detection (`PersistentUniquenessProvider.kt:62-92`)
+
+Batch-first TPU design note: `UniquenessProvider.commit` takes the whole
+input set in one call (all-or-nothing), and the validating path funnels
+signature checks through the node's TransactionVerifierService / batcher
+rather than per-signature host crypto.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core.contracts.structures import StateRef, TimeWindow
+from ..core.flows.api import FlowException, FlowLogic, initiated_by, initiating_flow
+from ..core.identity import Party
+from ..core.serialization.codec import deserialize, register_adapter, serialize
+from ..core.transactions.filtered import FilteredTransaction
+from ..core.transactions.signed import SignedTransaction
+from .database import KVStore, NodeDatabase
+
+
+# ---------------------------------------------------------------------------
+# Errors (reference NotaryError sealed class, NotaryFlow.kt:140-152)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Conflict:
+    tx_id: object
+    consumed: Dict[str, object]  # state-ref repr -> consuming tx id
+
+
+class NotaryException(FlowException):
+    def __init__(self, error):
+        super().__init__(f"notary error: {error}")
+        self.error = error
+
+
+class UniquenessException(Exception):
+    def __init__(self, conflict: Conflict):
+        super().__init__(f"input state conflict: {conflict}")
+        self.conflict = conflict
+
+
+# ---------------------------------------------------------------------------
+# Uniqueness providers
+# ---------------------------------------------------------------------------
+
+class UniquenessProvider:
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        raise NotImplementedError
+
+
+class PersistentUniquenessProvider(UniquenessProvider):
+    """Single-node commit log in the node DB. All-or-nothing batch commit
+    with conflict reporting (reference PersistentUniquenessProvider)."""
+
+    def __init__(self, db: NodeDatabase):
+        self._map = KVStore(db, "uniqueness")
+        self._db = db
+
+    @staticmethod
+    def _key(ref: StateRef) -> bytes:
+        return ref.txhash.bytes + ref.index.to_bytes(4, "big")
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        with self._db.lock:
+            conflicts: Dict[str, object] = {}
+            for ref in states:
+                existing = self._map.get(self._key(ref))
+                if existing is not None:
+                    consuming = deserialize(existing)
+                    if consuming["tx_id"] != tx_id:
+                        conflicts[repr(ref)] = consuming["tx_id"]
+            if conflicts:
+                raise UniquenessException(Conflict(tx_id, conflicts))
+            blob = serialize({"tx_id": tx_id, "by": requesting_party.name})
+            for ref in states:
+                self._map.put(self._key(ref), blob)
+
+
+class RaftUniquenessProvider(UniquenessProvider):
+    """Replicated commit log over the framework's own Raft (reference
+    `RaftUniquenessProvider.kt:71-156` which delegates to Copycat).
+
+    The state machine is a persisted map StateRef-key -> consuming tx; a
+    `putall` command checks-and-inserts the whole input set atomically and
+    deterministically on every replica.  Only the leader accepts commits;
+    notary cluster clients fail over between members
+    (send_and_receive_with_retry, reference FlowLogic.kt:98-110).
+    """
+
+    def __init__(self, raft_node, db: NodeDatabase):
+        self.raft = raft_node
+        self._map = KVStore(db, "raft_uniqueness")
+
+    def apply(self, command: dict):
+        """State-machine apply (runs on every replica, in log order)."""
+        if command.get("kind") != "putall":
+            return None
+        conflicts = {}
+        for key_hex, consuming_blob in command["entries"].items():
+            existing = self._map.get(bytes.fromhex(key_hex))
+            if existing is not None:
+                mine = deserialize(consuming_blob)["tx_id"]
+                theirs = deserialize(existing)["tx_id"]
+                if mine != theirs:
+                    conflicts[key_hex] = theirs
+        if not conflicts:
+            for key_hex, consuming_blob in command["entries"].items():
+                self._map.put(bytes.fromhex(key_hex), consuming_blob)
+        return {"conflicts": {k: v for k, v in conflicts.items()}}
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        blob = serialize({"tx_id": tx_id, "by": requesting_party.name})
+        entries = {
+            PersistentUniquenessProvider._key(ref).hex(): blob for ref in states
+        }
+        fut = self.raft.submit({"kind": "putall", "entries": entries})
+        result = fut.result(timeout=30)
+        if result["conflicts"]:
+            by_key = {
+                PersistentUniquenessProvider._key(ref).hex(): ref
+                for ref in states
+            }
+            raise UniquenessException(Conflict(
+                tx_id,
+                {
+                    repr(by_key[k]): v
+                    for k, v in result["conflicts"].items()
+                    if k in by_key
+                },
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Notary services
+# ---------------------------------------------------------------------------
+
+class NotaryService:
+    """Base notary (reference TrustedAuthorityNotaryService)."""
+
+    validating = False
+
+    def __init__(self, services, identity: Party,
+                 uniqueness_provider: Optional[UniquenessProvider] = None):
+        self.services = services
+        self.identity = identity
+        self.uniqueness_provider = (
+            uniqueness_provider or PersistentUniquenessProvider(services.db)
+        )
+
+    def validate_time_window(self, time_window: Optional[TimeWindow]) -> None:
+        if time_window is None:
+            return
+        now = int(self.services.clock() * 1_000_000_000)
+        if not time_window.contains(now):
+            raise NotaryException("time-window invalid")
+
+    def commit_input_states(self, inputs: List[StateRef], tx_id) -> None:
+        try:
+            self.uniqueness_provider.commit(inputs, tx_id, self.identity)
+        except UniquenessException as e:
+            raise NotaryException(e.conflict)
+
+    def sign(self, tx_id) -> object:
+        return self.services.key_management_service.sign(
+            tx_id.bytes, self.identity.owning_key
+        )
+
+
+class SimpleNotaryService(NotaryService):
+    """Non-validating single-node notary (reference SimpleNotaryService)."""
+    validating = False
+
+
+class ValidatingNotaryService(NotaryService):
+    """Fully validates transactions before committing: resolves the chain,
+    checks signatures (batched) and runs contracts via the node's
+    TransactionVerifierService (reference ValidatingNotaryService/Flow —
+    the batch-scale verification path)."""
+    validating = True
+
+
+# ---------------------------------------------------------------------------
+# Flows
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class NotarisationPayload:
+    """What the client sends: full stx to validating notaries, tear-off to
+    non-validating ones (reference NotaryFlow.Client:66-74)."""
+    signed_transaction: Optional[SignedTransaction]
+    filtered_transaction: Optional[FilteredTransaction]
+
+
+@dataclass(frozen=True)
+class NotarisationResponse:
+    signatures: Tuple  # DigitalSignatureWithKey over the tx id
+
+
+register_adapter(
+    NotarisationPayload, "NotarisationPayload",
+    lambda p: {"stx": p.signed_transaction, "ftx": p.filtered_transaction},
+    lambda d: NotarisationPayload(d["stx"], d["ftx"]),
+)
+register_adapter(
+    NotarisationResponse, "NotarisationResponse",
+    lambda r: {"sigs": list(r.signatures)},
+    lambda d: NotarisationResponse(tuple(d["sigs"])),
+)
+
+
+@initiating_flow
+class NotaryClientFlow(FlowLogic):
+    """Client side (reference NotaryFlow.Client, NotaryFlow.kt:33-95)."""
+
+    def __init__(self, stx: SignedTransaction, notary_validating: Optional[bool] = None):
+        self.stx = stx
+        # None -> ask the network map (single-notary networks); explicit for
+        # multi-notary setups.
+        self.notary_validating = notary_validating
+
+    def call(self):
+        stx = self.stx
+        notary = stx.notary
+        if notary is None:
+            raise FlowException("transaction has no notary set")
+        if stx.inputs:
+            # All non-notary signatures must already be present and valid.
+            stx.verify_signatures_except(notary.owning_key)
+        validating = self.notary_validating
+        if validating is None:
+            validating = self.service_hub.network_map_cache.is_validating_notary(
+                notary
+            )
+        if validating:
+            payload = NotarisationPayload(stx, None)
+        else:
+            wtx = stx.tx
+            ftx = wtx.build_filtered_transaction(lambda obj: True)
+            payload = NotarisationPayload(None, ftx)
+        response = yield self.send_and_receive_with_retry(
+            notary, payload, NotarisationResponse
+        )
+        sigs = list(response.signatures)
+        if not sigs:
+            raise NotaryException("notary returned no signatures")
+        for sig in sigs:
+            if not notary.owning_key.is_fulfilled_by({sig.by}):
+                raise NotaryException(
+                    f"signature from {sig.by} is not the notary's"
+                )
+            if not sig.is_valid(stx.id.bytes):
+                raise NotaryException("invalid notary signature")
+        return sigs
+
+
+@initiated_by(NotaryClientFlow)
+class NotaryServiceFlow(FlowLogic):
+    """Server side template (reference NotaryFlow.Service:106-129)."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        service: NotaryService = getattr(self.service_hub, "notary_service", None)
+        if service is None:
+            raise FlowException("this node is not a notary")
+        payload = yield self.receive(self.counterparty, NotarisationPayload)
+        tx_id, inputs, time_window = yield from self._receive_and_verify(
+            service, payload
+        )
+        service.validate_time_window(time_window)
+        service.commit_input_states(inputs, tx_id)
+        sig = service.sign(tx_id)
+        yield self.send(self.counterparty, NotarisationResponse((sig,)))
+
+    def _receive_and_verify(self, service: NotaryService, payload):
+        if service.validating:
+            stx = payload.signed_transaction
+            if stx is None:
+                raise NotaryException(
+                    "validating notary requires the full transaction"
+                )
+            notary_key = stx.notary.owning_key if stx.notary else None
+            # Signature hot loop -> batched check (TransactionWithSignatures
+            # batch path), then chain resolution + contract verification.
+            stx.verify_signatures_except(notary_key)
+            resolved = yield from self.sub_flow(
+                ResolveTransactionsFlow(stx, self.counterparty)
+            )
+            try:
+                stx.verify(self.service_hub, check_sufficient_signatures=False)
+            except Exception as exc:
+                raise NotaryException(f"transaction invalid: {exc}")
+            wtx = stx.tx
+            return stx.id, list(wtx.inputs), wtx.time_window
+        ftx = payload.filtered_transaction
+        if ftx is None:
+            raise NotaryException("non-validating notary requires a tear-off")
+        ftx.verify()  # Merkle proof against the root = tx id
+        return ftx.id, list(ftx.inputs), ftx.time_window
+
+
+# Imported lazily to avoid a cycle at module load; ResolveTransactionsFlow
+# lives with the other core library flows.
+from ..core.flows.library import ResolveTransactionsFlow  # noqa: E402

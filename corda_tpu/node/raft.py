@@ -1,0 +1,340 @@
+"""Raft consensus for the distributed notary commit log.
+
+Reference: `node/.../transactions/RaftUniquenessProvider.kt` delegates to
+the Copycat library (CopycatServer + DistributedImmutableMap state machine,
+`RaftUniquenessProvider.kt:71-156`).  The TPU build implements Raft itself
+over the framework's messaging layer — leader election with randomized
+timeouts, log replication via AppendEntries, quorum commit — applying
+`PutAll` commands to a persisted uniqueness map (the DistributedImmutableMap
+equivalent, `DistributedImmutableMap.kt:23-120`).
+
+Determinism: the node is driven externally — `tick(now)` advances election/
+heartbeat timers and `on_message` handles peer traffic — so tests step a
+cluster through elections, partitions, and leader kills without real time.
+
+Scope: leadership, replication, commit, and term safety are implemented;
+log compaction/snapshotting is not (the uniqueness log is append-only and
+bounded by ledger growth, matching the reference's usage pattern).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.serialization.codec import deserialize, serialize
+from .database import KVStore, NodeDatabase
+
+RAFT_TOPIC = "platform.raft"
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict  # {"kind": "putall", "entries": {...}, "request_id": str}
+
+
+class RaftNode:
+    """One Raft replica.
+
+    transport: send(peer_id: str, payload: bytes); incoming messages are fed
+    to `on_message(sender_id, payload)` by the owner.
+    apply_fn(command) -> result: applied exactly once per committed entry,
+    in log order, on every replica.
+    """
+
+    # Timeouts in abstract "time units" — callers pass a consistent now().
+    ELECTION_TIMEOUT = (10, 20)  # randomized range
+    HEARTBEAT_INTERVAL = 3
+
+    def __init__(
+        self,
+        node_id: str,
+        peer_ids: List[str],
+        transport: Callable[[str, bytes], None],
+        apply_fn: Callable[[dict], object],
+        db: Optional[NodeDatabase] = None,
+        seed: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.peer_ids = [p for p in peer_ids if p != node_id]
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self._rand = random.Random(seed if seed is not None else node_id)
+        self._lock = threading.RLock()
+        # persistent state
+        self._meta = KVStore(db, "raft_meta") if db is not None else None
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[LogEntry] = []
+        if self._meta is not None:
+            self._load_persistent()
+        # volatile state
+        self.role = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = -1
+        self.last_applied = -1
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._votes: set = set()
+        self._last_heard = 0.0
+        self._last_heartbeat = 0.0
+        self._election_deadline = 0.0
+        self._now = 0.0
+        # request_id -> future (leader only)
+        self._pending: Dict[str, Future] = {}
+        self._reset_election_deadline()
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load_persistent(self) -> None:
+        term = self._meta.get(b"term")
+        if term is not None:
+            self.current_term = deserialize(term)
+        vote = self._meta.get(b"voted_for")
+        if vote is not None:
+            self.voted_for = deserialize(vote)
+        log = self._meta.get(b"log")
+        if log is not None:
+            self.log = [LogEntry(t, c) for t, c in deserialize(log)]
+
+    def _persist(self) -> None:
+        if self._meta is None:
+            return
+        self._meta.put(b"term", serialize(self.current_term))
+        self._meta.put(b"voted_for", serialize(self.voted_for))
+        self._meta.put(
+            b"log", serialize([[e.term, e.command] for e in self.log])
+        )
+
+    # -- public API ----------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def submit(self, command: dict) -> Future:
+        """Leader: append + replicate; resolves with apply result when the
+        entry commits.  Non-leader: fails fast with the leader hint."""
+        fut: Future = Future()
+        with self._lock:
+            if self.role != LEADER:
+                fut.set_exception(NotLeaderError(self.leader_id))
+                return fut
+            request_id = command.get("request_id") or f"{self.node_id}:{len(self.log)}:{self.current_term}"
+            command = dict(command, request_id=request_id)
+            self.log.append(LogEntry(self.current_term, command))
+            self._persist()
+            self._pending[request_id] = fut
+            # Single-node cluster commits immediately.
+            self._advance_commit()
+            for peer in self.peer_ids:
+                self._send_append(peer)
+        return fut
+
+    def tick(self, now: float) -> None:
+        """Advance timers: follower/candidate election timeout, leader
+        heartbeats."""
+        with self._lock:
+            self._now = now
+            if self.role == LEADER:
+                if now - self._last_heartbeat >= self.HEARTBEAT_INTERVAL:
+                    self._last_heartbeat = now
+                    for peer in self.peer_ids:
+                        self._send_append(peer)
+            else:
+                if now >= self._election_deadline:
+                    self._start_election()
+
+    def on_message(self, sender_id: str, payload: bytes) -> None:
+        msg = deserialize(payload)
+        with self._lock:
+            kind = msg["kind"]
+            if msg["term"] > self.current_term:
+                self._become_follower(msg["term"])
+            if kind == "request_vote":
+                self._on_request_vote(sender_id, msg)
+            elif kind == "vote":
+                self._on_vote(sender_id, msg)
+            elif kind == "append":
+                self._on_append(sender_id, msg)
+            elif kind == "append_reply":
+                self._on_append_reply(sender_id, msg)
+
+    # -- elections -----------------------------------------------------------
+
+    def _reset_election_deadline(self) -> None:
+        lo, hi = self.ELECTION_TIMEOUT
+        self._election_deadline = self._now + self._rand.uniform(lo, hi)
+
+    def _become_follower(self, term: int) -> None:
+        self.current_term = term
+        self.role = FOLLOWER
+        self.voted_for = None
+        self._votes.clear()
+        self._fail_pending(NotLeaderError(None))
+        self._persist()
+        self._reset_election_deadline()
+
+    def _start_election(self) -> None:
+        self.role = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.node_id
+        self._votes = {self.node_id}
+        self.leader_id = None
+        self._persist()
+        self._reset_election_deadline()
+        last_term = self.log[-1].term if self.log else -1
+        for peer in self.peer_ids:
+            self._send(peer, {
+                "kind": "request_vote", "term": self.current_term,
+                "last_log_index": len(self.log) - 1,
+                "last_log_term": last_term,
+            })
+        self._maybe_win()
+
+    def _on_request_vote(self, sender_id: str, msg: dict) -> None:
+        grant = False
+        if msg["term"] >= self.current_term and self.voted_for in (None, sender_id):
+            my_last_term = self.log[-1].term if self.log else -1
+            up_to_date = (
+                msg["last_log_term"] > my_last_term
+                or (
+                    msg["last_log_term"] == my_last_term
+                    and msg["last_log_index"] >= len(self.log) - 1
+                )
+            )
+            if up_to_date:
+                grant = True
+                self.voted_for = sender_id
+                self._persist()
+                self._reset_election_deadline()
+        self._send(sender_id, {
+            "kind": "vote", "term": self.current_term, "granted": grant,
+        })
+
+    def _on_vote(self, sender_id: str, msg: dict) -> None:
+        if self.role != CANDIDATE or msg["term"] != self.current_term:
+            return
+        if msg["granted"]:
+            self._votes.add(sender_id)
+            self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        if self.role == CANDIDATE and len(self._votes) >= quorum:
+            self.role = LEADER
+            self.leader_id = self.node_id
+            self.next_index = {p: len(self.log) for p in self.peer_ids}
+            self.match_index = {p: -1 for p in self.peer_ids}
+            self._last_heartbeat = self._now
+            for peer in self.peer_ids:
+                self._send_append(peer)
+
+    # -- replication ---------------------------------------------------------
+
+    def _send_append(self, peer: str) -> None:
+        ni = self.next_index.get(peer, len(self.log))
+        prev_index = ni - 1
+        prev_term = self.log[prev_index].term if prev_index >= 0 else -1
+        entries = [[e.term, e.command] for e in self.log[ni:]]
+        self._send(peer, {
+            "kind": "append", "term": self.current_term,
+            "prev_index": prev_index, "prev_term": prev_term,
+            "entries": entries, "commit_index": self.commit_index,
+        })
+
+    def _on_append(self, sender_id: str, msg: dict) -> None:
+        if msg["term"] < self.current_term:
+            self._send(sender_id, {
+                "kind": "append_reply", "term": self.current_term,
+                "ok": False, "match_index": -1,
+            })
+            return
+        self.role = FOLLOWER
+        self.leader_id = sender_id
+        self._reset_election_deadline()
+        prev_index = msg["prev_index"]
+        if prev_index >= 0 and (
+            prev_index >= len(self.log)
+            or self.log[prev_index].term != msg["prev_term"]
+        ):
+            self._send(sender_id, {
+                "kind": "append_reply", "term": self.current_term,
+                "ok": False, "match_index": -1,
+            })
+            return
+        # Truncate conflicts, append new entries.
+        idx = prev_index + 1
+        for term, command in msg["entries"]:
+            if idx < len(self.log):
+                if self.log[idx].term != term:
+                    del self.log[idx:]
+                    self.log.append(LogEntry(term, command))
+            else:
+                self.log.append(LogEntry(term, command))
+            idx += 1
+        self._persist()
+        if msg["commit_index"] > self.commit_index:
+            self.commit_index = min(msg["commit_index"], len(self.log) - 1)
+            self._apply_committed()
+        self._send(sender_id, {
+            "kind": "append_reply", "term": self.current_term,
+            "ok": True, "match_index": len(self.log) - 1,
+        })
+
+    def _on_append_reply(self, sender_id: str, msg: dict) -> None:
+        if self.role != LEADER or msg["term"] != self.current_term:
+            return
+        if msg["ok"]:
+            self.match_index[sender_id] = msg["match_index"]
+            self.next_index[sender_id] = msg["match_index"] + 1
+            self._advance_commit()
+        else:
+            self.next_index[sender_id] = max(0, self.next_index.get(sender_id, 1) - 1)
+            self._send_append(sender_id)
+
+    def _advance_commit(self) -> None:
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        for n in range(len(self.log) - 1, self.commit_index, -1):
+            if self.log[n].term != self.current_term:
+                continue
+            count = 1 + sum(
+                1 for p in self.peer_ids if self.match_index.get(p, -1) >= n
+            )
+            if count >= quorum:
+                self.commit_index = n
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied]
+            result = self.apply_fn(entry.command)
+            request_id = entry.command.get("request_id")
+            fut = self._pending.pop(request_id, None) if request_id else None
+            if fut is not None and not fut.done():
+                fut.set_result(result)
+
+    def _fail_pending(self, exc: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    def _send(self, peer: str, msg: dict) -> None:
+        try:
+            self.transport(peer, serialize(msg))
+        except Exception:
+            pass  # unreachable peer: Raft tolerates message loss
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(f"not the leader (hint: {leader_hint})")
+        self.leader_hint = leader_hint

@@ -1,0 +1,85 @@
+"""NodeSchedulerService: run flows when SchedulableStates come due
+(reference `node/.../services/events/NodeSchedulerService.kt:38-218` +
+`ScheduledActivityObserver.kt`).
+
+The vault feed drives the schedule: every relevant SchedulableState output
+registers its next activity; consuming the state unregisters it.  The
+schedule persists in the node DB so a restarted node resumes its timers.
+`wake()` fires everything due — called by the node's timer thread in real
+deployments and directly by deterministic tests (TestClock pattern).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..core.contracts.structures import SchedulableState, StateRef
+from ..core.flows.api import flow_registry
+from ..core.serialization.codec import deserialize, serialize
+from .database import KVStore, NodeDatabase
+
+
+class SchedulerService:
+    def __init__(self, db: NodeDatabase, services, smm):
+        self._store = KVStore(db, "scheduled_states")
+        self._services = services
+        self._smm = smm
+        self._lock = threading.Lock()
+        services.vault_service.track(self._on_vault_update)
+
+    @staticmethod
+    def _key(ref: StateRef) -> bytes:
+        return ref.txhash.bytes + ref.index.to_bytes(4, "big")
+
+    def _on_vault_update(self, produced, consumed) -> None:
+        for ref in consumed:
+            self._store.delete(self._key(ref))
+        for sr in produced:
+            state = sr.state.data
+            if not isinstance(state, SchedulableState):
+                continue
+            activity = state.next_scheduled_activity(sr.ref)
+            if activity is None:
+                continue
+            self._store.put(
+                self._key(sr.ref),
+                serialize({
+                    "flow_name": activity.flow_name,
+                    "flow_args": list(activity.flow_args),
+                    "at": activity.scheduled_at,
+                    "ref": sr.ref,
+                }),
+            )
+
+    def scheduled_count(self) -> int:
+        return len(self._store)
+
+    def next_scheduled_time(self) -> Optional[int]:
+        times = [deserialize(v)["at"] for _, v in self._store.items()]
+        return min(times) if times else None
+
+    def wake(self, now: Optional[int] = None) -> List[str]:
+        """Start every due activity; returns started flow ids.  `now` is
+        unix nanos (defaults to the service-hub clock)."""
+        if now is None:
+            now = int(self._services.clock() * 1_000_000_000)
+        started = []
+        with self._lock:
+            due: List[Tuple[bytes, dict]] = []
+            for k, v in list(self._store.items()):
+                entry = deserialize(v)
+                if entry["at"] <= now:
+                    due.append((k, entry))
+            for k, entry in due:
+                # Remove first: if the flow crashes we do not re-fire forever
+                # (the reference relies on the flow consuming the state).
+                self._store.delete(k)
+            for _, entry in due:
+                cls = flow_registry.get(entry["flow_name"])
+                if cls is None:
+                    continue
+                args = tuple(entry["flow_args"])
+                flow = cls(*args)
+                handle = self._smm.start_flow(flow, *args)
+                started.append(handle.flow_id)
+        return started

@@ -1,0 +1,410 @@
+"""Node services + ServiceHub (reference `ServiceHub` /
+`ServiceHubInternalImpl`, `AbstractNode.kt:770-822`).
+
+Each service mirrors a reference component (pointers inline); the hub wires
+them together and is what flows reach via `self.service_hub`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core.contracts.structures import (
+    Attachment,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+)
+from ..core.crypto import crypto
+from ..core.crypto.keys import KeyPair, PublicKey
+from ..core.crypto.secure_hash import SecureHash
+from ..core.identity import AnonymousParty, Party
+from ..core.serialization.codec import deserialize, serialize
+from .database import (
+    AttachmentStorage,
+    CheckpointStorage,
+    KVStore,
+    NodeDatabase,
+    TransactionStorage,
+)
+
+
+class IdentityService:
+    """Party <-> key registry (reference InMemoryIdentityService,
+    `node/.../services/identity/InMemoryIdentityService.kt`)."""
+
+    def __init__(self):
+        self._by_key: Dict[bytes, Party] = {}
+        self._by_name: Dict[str, Party] = {}
+        self._lock = threading.Lock()
+
+    def register_identity(self, party: Party) -> None:
+        with self._lock:
+            self._by_key[party.owning_key.encoded] = party
+            self._by_name[party.name] = party
+
+    def party_from_key(self, key: PublicKey) -> Optional[Party]:
+        return self._by_key.get(key.encoded)
+
+    def party_from_name(self, name: str) -> Optional[Party]:
+        return self._by_name.get(name)
+
+    def party_from_anonymous(self, party) -> Optional[Party]:
+        if isinstance(party, Party):
+            return party
+        if isinstance(party, AnonymousParty):
+            return self.party_from_key(party.owning_key)
+        return None
+
+    def all_identities(self) -> List[Party]:
+        return list(self._by_name.values())
+
+
+class KeyManagementService:
+    """The node's signing keys (reference PersistentKeyManagementService).
+    Keys persist in the DB so a restarted node keeps its identities."""
+
+    def __init__(self, db: NodeDatabase, initial_keys: Iterable[KeyPair] = ()):
+        self._store = KVStore(db, "node_keys")
+        self._keys: Dict[bytes, KeyPair] = {}
+        for row_k, row_v in self._store.items():
+            kp = deserialize(row_v)
+            self._keys[row_k] = KeyPair(kp["public"], kp["private"])
+        for kp in initial_keys:
+            self._add(kp)
+
+    def _add(self, kp: KeyPair) -> None:
+        if kp.public.encoded not in self._keys:
+            self._keys[kp.public.encoded] = kp
+            self._store.put(
+                kp.public.encoded,
+                serialize({"public": kp.public, "private": kp.private}),
+            )
+
+    def fresh_key(self) -> PublicKey:
+        kp = crypto.generate_keypair()
+        self._add(kp)
+        return kp.public
+
+    @property
+    def keys(self) -> Set[bytes]:
+        return set(self._keys)
+
+    def sign(self, content: bytes, public_key: PublicKey):
+        from ..core.crypto.signing import DigitalSignatureWithKey
+
+        kp = self._keys.get(public_key.encoded)
+        if kp is None:
+            raise KeyError(f"no private key for {public_key}")
+        return DigitalSignatureWithKey(
+            crypto.do_sign(kp.private, content), kp.public
+        )
+
+
+class NetworkMapCache:
+    """Peer directory (reference InMemoryNetworkMapCache,
+    `node/.../services/network/`). Nodes + advertised services."""
+
+    NOTARY_SERVICE = "corda.notary"
+    VALIDATING_NOTARY_SERVICE = "corda.notary.validating"
+
+    def __init__(self):
+        self._nodes: Dict[str, Party] = {}
+        self._services: Dict[str, List[Party]] = {}
+        self._node_services: Dict[str, Set[str]] = {}
+        self._lock = threading.Lock()
+
+    def add_node(self, party: Party, advertised_services: Iterable[str] = ()) -> None:
+        with self._lock:
+            self._nodes[party.name] = party
+            node_svcs = self._node_services.setdefault(party.name, set())
+            for svc in advertised_services:
+                node_svcs.add(svc)
+                parties = self._services.setdefault(svc, [])
+                if party not in parties:
+                    parties.append(party)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            party = self._nodes.pop(name, None)
+            self._node_services.pop(name, None)
+            if party is not None:
+                for parties in self._services.values():
+                    if party in parties:
+                        parties.remove(party)
+
+    def is_validating_notary(self, party: Party) -> bool:
+        return self.VALIDATING_NOTARY_SERVICE in self._node_services.get(
+            party.name, set()
+        )
+
+    def get_node(self, name: str) -> Optional[Party]:
+        return self._nodes.get(name)
+
+    @property
+    def notary_identities(self) -> List[Party]:
+        return list(self._services.get(self.NOTARY_SERVICE, []))
+
+    def get_notary(self, name: Optional[str] = None) -> Optional[Party]:
+        notaries = self.notary_identities
+        if name is not None:
+            return next((n for n in notaries if n.name == name), None)
+        return notaries[0] if notaries else None
+
+    @property
+    def all_nodes(self) -> List[Party]:
+        return list(self._nodes.values())
+
+
+class VaultService:
+    """Unconsumed-state tracker with soft-locking (reference
+    NodeVaultService, `node/.../services/vault/NodeVaultService.kt` —
+    notifyAll :194, soft locks :321-349). Query DSL lives in
+    corda_tpu.node.vault_query (widened in a later slice)."""
+
+    def __init__(self, db: NodeDatabase, is_relevant: Callable):
+        self.db = db
+        self._is_relevant = is_relevant
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_states ("
+            " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
+            " state_blob BLOB NOT NULL, contract_name TEXT NOT NULL,"
+            " consumed INTEGER NOT NULL DEFAULT 0,"
+            " lock_id TEXT,"
+            " PRIMARY KEY (tx_id, output_index))"
+        )
+        self._observers: List[Callable] = []
+
+    # -- updates from committed transactions --------------------------------
+
+    def notify_all(self, txs) -> None:
+        """Ingest committed transactions: consume inputs, add relevant
+        outputs (reference notifyAll)."""
+        produced, consumed = [], []
+        with self.db.lock:
+            for stx in txs:
+                wtx = stx.tx
+                for ref in wtx.inputs:
+                    self.db.execute(
+                        "UPDATE vault_states SET consumed = 1 "
+                        "WHERE tx_id = ? AND output_index = ?",
+                        (ref.txhash.bytes, ref.index),
+                    )
+                    consumed.append(ref)
+                for idx, ts in enumerate(wtx.outputs):
+                    if not self._is_relevant(ts.data):
+                        continue
+                    ref = StateRef(wtx.id, idx)
+                    self.db.execute(
+                        "INSERT OR IGNORE INTO vault_states"
+                        "(tx_id, output_index, state_blob, contract_name)"
+                        " VALUES(?, ?, ?, ?)",
+                        (
+                            ref.txhash.bytes, ref.index, serialize(ts),
+                            ts.data.contract_name,
+                        ),
+                    )
+                    produced.append(StateAndRef(ts, ref))
+        if produced or consumed:
+            for obs in list(self._observers):
+                obs(produced, consumed)
+
+    def track(self, observer: Callable) -> None:
+        """observer(produced: [StateAndRef], consumed: [StateRef])."""
+        self._observers.append(observer)
+
+    # -- queries -------------------------------------------------------------
+
+    def unconsumed_states(
+        self, contract_name: Optional[str] = None, state_type: Optional[type] = None,
+    ) -> List[StateAndRef]:
+        sql = (
+            "SELECT tx_id, output_index, state_blob FROM vault_states "
+            "WHERE consumed = 0"
+        )
+        params: Tuple = ()
+        if contract_name is not None:
+            sql += " AND contract_name = ?"
+            params = (contract_name,)
+        out = []
+        for tx_id, idx, blob in self.db.query(sql, params):
+            ts = deserialize(blob)
+            if state_type is not None and not isinstance(ts.data, state_type):
+                continue
+            out.append(StateAndRef(ts, StateRef(SecureHash(tx_id), idx)))
+        return out
+
+    def load_state(self, ref: StateRef) -> Optional[TransactionState]:
+        rows = self.db.query(
+            "SELECT state_blob FROM vault_states "
+            "WHERE tx_id = ? AND output_index = ?",
+            (ref.txhash.bytes, ref.index),
+        )
+        return deserialize(rows[0][0]) if rows else None
+
+    # -- soft locking (in-flight spend reservation) --------------------------
+
+    def soft_lock_reserve(self, lock_id: str, refs: List[StateRef]) -> None:
+        with self.db.lock:
+            for ref in refs:
+                rows = self.db.query(
+                    "SELECT lock_id FROM vault_states "
+                    "WHERE tx_id = ? AND output_index = ? AND consumed = 0",
+                    (ref.txhash.bytes, ref.index),
+                )
+                if not rows:
+                    raise StatesNotAvailableError(f"{ref} not unconsumed")
+                if rows[0][0] is not None and rows[0][0] != lock_id:
+                    raise StatesNotAvailableError(f"{ref} locked by {rows[0][0]}")
+            for ref in refs:
+                self.db.execute(
+                    "UPDATE vault_states SET lock_id = ? "
+                    "WHERE tx_id = ? AND output_index = ?",
+                    (lock_id, ref.txhash.bytes, ref.index),
+                )
+
+    def soft_lock_release(self, lock_id: str, refs: Optional[List[StateRef]] = None) -> None:
+        with self.db.lock:
+            if refs is None:
+                self.db.execute(
+                    "UPDATE vault_states SET lock_id = NULL WHERE lock_id = ?",
+                    (lock_id,),
+                )
+            else:
+                for ref in refs:
+                    self.db.execute(
+                        "UPDATE vault_states SET lock_id = NULL "
+                        "WHERE tx_id = ? AND output_index = ? AND lock_id = ?",
+                        (ref.txhash.bytes, ref.index, lock_id),
+                    )
+
+    def unlocked_unconsumed_states(
+        self, contract_name: Optional[str] = None, lock_id: Optional[str] = None,
+    ) -> List[StateAndRef]:
+        """States available for spending: unconsumed and not soft-locked by
+        another flow."""
+        sql = (
+            "SELECT tx_id, output_index, state_blob, lock_id FROM vault_states"
+            " WHERE consumed = 0"
+        )
+        params: Tuple = ()
+        if contract_name is not None:
+            sql += " AND contract_name = ?"
+            params = (contract_name,)
+        out = []
+        for tx_id, idx, blob, lid in self.db.query(sql, params):
+            if lid is not None and lid != lock_id:
+                continue
+            out.append(
+                StateAndRef(deserialize(blob), StateRef(SecureHash(tx_id), idx))
+            )
+        return out
+
+
+class StatesNotAvailableError(Exception):
+    pass
+
+
+class ServiceHub:
+    """Everything a flow or service can reach (reference ServiceHub /
+    ServiceHubInternal)."""
+
+    def __init__(
+        self,
+        my_info: Party,
+        db: NodeDatabase,
+        transaction_verifier_service,
+        legal_identity_key: KeyPair,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        import time as _time
+
+        self.my_info = my_info
+        self.db = db
+        self.identity_service = IdentityService()
+        self.key_management_service = KeyManagementService(
+            db, initial_keys=[legal_identity_key]
+        )
+        self.validated_transactions = TransactionStorage(db)
+        self.attachments = AttachmentStorage(db)
+        self.network_map_cache = NetworkMapCache()
+        self.transaction_verifier_service = transaction_verifier_service
+        self.vault_service = VaultService(db, self._is_relevant)
+        self.clock = clock or _time.time
+        self.identity_service.register_identity(my_info)
+        self._smm = None  # wired by the node after SMM construction
+
+    # -- resolution callbacks used by SignedTransaction.verify --------------
+
+    def load_state(self, ref: StateRef) -> TransactionState:
+        stx = self.validated_transactions.get(ref.txhash)
+        if stx is None:
+            raise TransactionResolutionError(ref.txhash)
+        wtx = stx.tx
+        if ref.index >= len(wtx.outputs):
+            raise TransactionResolutionError(ref.txhash)
+        return wtx.outputs[ref.index]
+
+    def open_attachment(self, att_id: SecureHash) -> Attachment:
+        att = self.attachments.open_attachment(att_id)
+        if att is None:
+            raise AttachmentResolutionError(att_id)
+        return att
+
+    def party_from_key(self, key: PublicKey) -> Optional[Party]:
+        return self.identity_service.party_from_key(key)
+
+    # -- ledger writes -------------------------------------------------------
+
+    def record_transactions(self, txs) -> None:
+        """Persist validated transactions, update the vault, wake ledger
+        waiters (reference AbstractNode.recordTransactions :817-821)."""
+        txs = list(txs)
+        recorded = [stx for stx in txs if self.validated_transactions.add(stx)]
+        if recorded:
+            self.vault_service.notify_all(recorded)
+            if self._smm is not None:
+                for stx in recorded:
+                    self._smm.notify_transaction_committed(stx)
+
+    def _is_relevant(self, state) -> bool:
+        """A state is ours if any participant key is one of our keys
+        (reference isRelevant logic in NodeVaultService)."""
+        my_keys = self.key_management_service.keys
+        for p in state.participants:
+            key = getattr(p, "owning_key", None)
+            if key is not None and key.encoded in my_keys:
+                return True
+        return False
+
+    def sign_initial_transaction(self, builder, public_key: Optional[PublicKey] = None):
+        """Build the WireTransaction and attach our signature over its id
+        (reference ServiceHub.signInitialTransaction)."""
+        from ..core.transactions.signed import SignedTransaction
+
+        wtx = builder.to_wire_transaction()
+        key = public_key or self.my_info.owning_key
+        sig = self.key_management_service.sign(wtx.id.bytes, key)
+        return SignedTransaction.of(wtx, [sig])
+
+    def add_signature(self, stx, public_key: Optional[PublicKey] = None):
+        key = public_key or self.my_info.owning_key
+        sig = self.key_management_service.sign(stx.id.bytes, key)
+        return stx.with_additional_signature(sig)
+
+    # -- flow start (wired post-SMM) ----------------------------------------
+
+    def start_flow(self, flow, *args_for_restore, **kw):
+        return self._smm.start_flow(flow, *args_for_restore, **kw)
+
+
+class TransactionResolutionError(Exception):
+    def __init__(self, tx_id):
+        super().__init__(f"transaction {tx_id} not found in storage")
+        self.tx_id = tx_id
+
+
+class AttachmentResolutionError(Exception):
+    def __init__(self, att_id):
+        super().__init__(f"attachment {att_id} not found in storage")
+        self.att_id = att_id

@@ -1,0 +1,131 @@
+"""Flow session wire protocol + per-session state.
+
+Reference: `node/.../services/statemachine/SessionMessage.kt` — SessionInit /
+SessionConfirm / SessionReject / SessionData / SessionEnd, with the
+Initiating→Initiated handshake (`FlowSessionState.kt`).
+
+Additions for the replay-checkpoint model (no Quasar stack serialization):
+every data message carries a per-direction sequence number, so re-sends
+after a crash-restore are idempotent — the receiving side drops seqs it has
+already consumed.  SessionInit is deduplicated by initiator session id.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core.identity import Party
+from ..core.serialization.codec import register_adapter
+
+SESSION_TOPIC = "platform.session"
+
+
+@dataclass(frozen=True)
+class SessionInit:
+    initiator_session_id: str
+    flow_name: str
+    flow_version: int
+    first_payload: Optional[bytes]  # pre-serialized, seq 0 if present
+
+
+@dataclass(frozen=True)
+class SessionConfirm:
+    initiator_session_id: str
+    initiated_session_id: str
+
+
+@dataclass(frozen=True)
+class SessionReject:
+    initiator_session_id: str
+    error: str
+
+
+@dataclass(frozen=True)
+class SessionData:
+    recipient_session_id: str
+    seq: int
+    payload: bytes  # pre-serialized
+
+
+@dataclass(frozen=True)
+class SessionEnd:
+    recipient_session_id: str
+    error: Optional[str]  # FlowException message propagated to the peer
+
+
+for cls, name, fields in [
+    (SessionInit, "SessionInit",
+     ["initiator_session_id", "flow_name", "flow_version", "first_payload"]),
+    (SessionConfirm, "SessionConfirm",
+     ["initiator_session_id", "initiated_session_id"]),
+    (SessionReject, "SessionReject", ["initiator_session_id", "error"]),
+    (SessionData, "SessionData", ["recipient_session_id", "seq", "payload"]),
+    (SessionEnd, "SessionEnd", ["recipient_session_id", "error"]),
+]:
+    register_adapter(
+        cls, name,
+        (lambda fs: lambda m: {f: getattr(m, f) for f in fs})(fields),
+        (lambda c, fs: lambda d: c(**{f: d[f] for f in fs}))(cls, fields),
+    )
+
+
+class SessionState(enum.Enum):
+    INITIATING = "initiating"  # init sent, awaiting confirm
+    INITIATED = "initiated"
+    ENDED = "ended"
+
+
+@dataclass
+class FlowSession:
+    """One side of a peer-to-peer session within a flow."""
+    local_id: str
+    peer: Party
+    state: SessionState
+    peer_id: Optional[str] = None
+    send_seq: int = 0
+    recv_seq: int = 0  # next expected incoming seq
+    # incoming data buffered out-of-order or before the flow asks
+    inbox: Dict[int, bytes] = field(default_factory=dict)
+    # outgoing data buffered while INITIATING (flushed on confirm)
+    outbox: List[bytes] = field(default_factory=list)
+    # the payload that rode the SessionInit (seq 0), kept for init re-sends
+    init_payload: Optional[bytes] = None
+    # True on the responder side (used to rebuild init-dedup after restore)
+    is_initiated_side: bool = False
+    # set when the peer ended the session (error message or "" for clean end)
+    end_error: Optional[str] = None
+    ended_by_peer: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "local_id": self.local_id,
+            "peer": self.peer,
+            "state": self.state.value,
+            "peer_id": self.peer_id,
+            "send_seq": self.send_seq,
+            "recv_seq": self.recv_seq,
+            "inbox": {str(k): v for k, v in self.inbox.items()},
+            "outbox": list(self.outbox),
+            "init_payload": self.init_payload,
+            "is_initiated_side": self.is_initiated_side,
+            "end_error": self.end_error,
+            "ended_by_peer": self.ended_by_peer,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "FlowSession":
+        return FlowSession(
+            local_id=d["local_id"],
+            peer=d["peer"],
+            state=SessionState(d["state"]),
+            peer_id=d["peer_id"],
+            send_seq=d["send_seq"],
+            recv_seq=d["recv_seq"],
+            inbox={int(k): v for k, v in d["inbox"].items()},
+            outbox=list(d["outbox"]),
+            init_payload=d["init_payload"],
+            is_initiated_side=d["is_initiated_side"],
+            end_error=d["end_error"],
+            ended_by_peer=d["ended_by_peer"],
+        )

@@ -1,0 +1,631 @@
+"""StateMachineManager: flow scheduling, checkpointing, session management.
+
+Reference: `node/.../services/statemachine/StateMachineManager.kt` (590 LoC)
++ `FlowStateMachineImpl.kt`.  The Quasar fiber model (serialize the actual
+call stack on every suspend) is replaced by **deterministic replay**: a
+checkpoint is (flow class, constructor args, ordered log of IO results,
+session states).  Restore re-runs the flow generator from the top, feeding
+recorded results for already-completed suspensions — sends are suppressed
+during replay and the session sequence counters persisted in the checkpoint
+make post-restore re-sends idempotent (receivers drop already-seen seqs).
+This gives the same exactly-once-ish semantics as the reference's
+checkpoint + message-dedup machinery with zero bytecode instrumentation.
+
+Sessions are keyed by (counterparty, initiating flow class) exactly like the
+reference's `openSessions` map keyed on (Party, sessionFlow)
+(`FlowStateMachineImpl.kt` getSession), so an @initiating_flow sub-flow
+opens its own session while plain sub-flows share their parent's.
+"""
+from __future__ import annotations
+
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.flows.api import (
+    FlowException,
+    FlowLogic,
+    Receive,
+    Send,
+    SendAndReceive,
+    WaitForLedgerCommit,
+    _as_generator,
+    encode_flow_exception,
+    flow_registry,
+    get_initiated_by,
+    rebuild_flow_exception,
+)
+from ..core.identity import Party
+from ..core.serialization.codec import deserialize, serialize
+from .session import (
+    SESSION_TOPIC,
+    FlowSession,
+    SessionConfirm,
+    SessionData,
+    SessionEnd,
+    SessionInit,
+    SessionReject,
+    SessionState,
+)
+
+
+class FlowSessionException(FlowException):
+    """The counterparty session ended or rejected while we needed data."""
+
+
+@dataclass
+class FlowHandle:
+    flow_id: str
+    result: Future
+
+
+class _Suspended(Exception):
+    """Internal marker: the fiber parked; unwind out of the advance loop."""
+
+
+class FlowStateMachine:
+    """One running (or restored) flow."""
+
+    def __init__(
+        self,
+        flow_id: str,
+        flow: FlowLogic,
+        smm: "StateMachineManager",
+        args: Tuple = (),
+        kwargs: Optional[dict] = None,
+        is_responder: bool = False,
+        io_log: Optional[List[bytes]] = None,
+        sessions: Optional[Dict[str, FlowSession]] = None,
+        session_keys: Optional[Dict[str, str]] = None,
+        session_owner_flows: Optional[Dict[str, str]] = None,
+    ):
+        self.flow_id = flow_id
+        self.flow = flow
+        self.smm = smm
+        self.args = args
+        self.kwargs = kwargs or {}
+        self.is_responder = is_responder
+        self.result: Future = Future()
+        # replay state: everything before _replay_limit is history to feed
+        # back; entries appended after construction are live recordings.
+        self.io_log: List[bytes] = io_log or []
+        self.replay_pos = 0
+        self._replay_limit = len(self.io_log)
+        # sessions
+        self.sessions: Dict[str, FlowSession] = sessions or {}
+        self.session_keys: Dict[str, str] = session_keys or {}  # key -> local_id
+        self.session_owner_flows: Dict[str, str] = session_owner_flows or {}
+        # parking
+        self.waiting_session: Optional[str] = None
+        self.waiting_expected_type: type = object
+        self.waiting_tx: Optional[Any] = None
+        self.done = False
+        self._gen = None
+        self._session_counter = len(self.sessions)
+
+    # -- service access used by FlowLogic -----------------------------------
+
+    @property
+    def service_hub(self):
+        return self.smm.service_hub
+
+    @property
+    def our_identity(self) -> Party:
+        return self.smm.our_identity
+
+    @property
+    def replaying(self) -> bool:
+        return self.replay_pos < self._replay_limit
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.flow.state_machine = self
+        self._gen = _as_generator(self.flow)
+        self._run(feed=None, first=True)
+
+    def _run(self, feed=None, first=False, throw: Optional[BaseException] = None):
+        """Drive the generator until it completes or parks."""
+        try:
+            while True:
+                try:
+                    if throw is not None:
+                        exc, throw = throw, None
+                        req = self._gen.throw(exc)
+                    elif first:
+                        first = False
+                        req = self._gen.send(None)
+                    else:
+                        req = self._gen.send(feed)
+                        feed = None
+                except StopIteration as stop:
+                    self._complete(stop.value)
+                    return
+                except _Suspended:
+                    raise
+                except BaseException as exc:
+                    self._fail(exc)
+                    return
+                try:
+                    feed = self._handle_request(req)
+                except _Suspended:
+                    raise
+                except BaseException as exc:
+                    # IO-layer errors (ended session, bad type, non-initiating
+                    # flow) are thrown INTO the generator so user flows can
+                    # catch them like the reference's FlowException model.
+                    throw = exc
+        except _Suspended:
+            return
+
+    def _handle_request(self, req):
+        if isinstance(req, Send):
+            self._io_send(req.party, req.payload, req.owner_name)
+            return None
+        if isinstance(req, SendAndReceive):
+            if not self.replaying:
+                self._io_send(req.party, req.payload, req.owner_name)
+            return self._io_receive(req.party, req.expected_type, req.owner_name)
+        if isinstance(req, Receive):
+            # An initiating receive must still open the session.
+            if not self.replaying:
+                self._session_for(req.party, req.owner_name, first_payload=None)
+            return self._io_receive(req.party, req.expected_type, req.owner_name)
+        if isinstance(req, WaitForLedgerCommit):
+            return self._io_wait_ledger(req.tx_id)
+        raise TypeError(f"flow yielded a non-FlowIORequest: {req!r}")
+
+    # -- IO implementation --------------------------------------------------
+
+    def _session_key(self, party: Party, owner_name: str) -> str:
+        return f"{party.name}|{owner_name}"
+
+    def _session_for(
+        self, party: Party, owner_name: str, first_payload: Optional[bytes],
+        create: bool = True,
+    ) -> FlowSession:
+        key = self._session_key(party, owner_name)
+        local_id = self.session_keys.get(key)
+        if local_id is not None:
+            return self.sessions[local_id]
+        if not create:
+            raise FlowSessionException(f"no session with {party.name}")
+        flow_cls = flow_registry.get(owner_name)
+        if flow_cls is None or not getattr(flow_cls, "_initiating", False):
+            raise FlowException(
+                f"{owner_name} is not an @initiating_flow but tried to open "
+                f"a session with {party.name}"
+            )
+        local_id = f"{self.flow_id}:{self._session_counter}"
+        self._session_counter += 1
+        sess = FlowSession(
+            local_id=local_id, peer=party, state=SessionState.INITIATING,
+        )
+        if first_payload is not None:
+            sess.send_seq = 1  # payload rides the init as seq 0
+            sess.init_payload = first_payload
+        self.sessions[local_id] = sess
+        self.session_keys[key] = local_id
+        self.session_owner_flows[local_id] = owner_name
+        self.smm._register_session(local_id, self)
+        self.smm._send_session_message(
+            party,
+            SessionInit(
+                initiator_session_id=local_id,
+                flow_name=owner_name,
+                flow_version=getattr(flow_cls, "_flow_version", 1),
+                first_payload=first_payload,
+            ),
+        )
+        return sess
+
+    def _io_send(self, party: Party, payload: Any, owner_name: str) -> None:
+        if self.replaying:
+            return  # already sent before the checkpoint we restored from
+        blob = serialize(payload)
+        key = self._session_key(party, owner_name)
+        if key not in self.session_keys:
+            self._session_for(party, owner_name, first_payload=blob)
+            return
+        sess = self.sessions[self.session_keys[key]]
+        if sess.state is SessionState.INITIATING:
+            sess.outbox.append(blob)
+            sess.send_seq += 1
+        elif sess.state is SessionState.INITIATED:
+            self.smm._send_session_message(
+                party, SessionData(sess.peer_id, sess.send_seq, blob)
+            )
+            sess.send_seq += 1
+        else:
+            raise FlowSessionException(
+                f"session with {party.name} has ended"
+                + (f": {sess.end_error}" if sess.end_error else "")
+            )
+
+    def _io_receive(self, party: Party, expected_type: type, owner_name: str):
+        if self.replaying:
+            blob = self.io_log[self.replay_pos]
+            self.replay_pos += 1
+            return deserialize(blob)
+        sess = self._session_for(party, owner_name, first_payload=None)
+        if sess.recv_seq in sess.inbox:
+            blob = sess.inbox.pop(sess.recv_seq)
+            sess.recv_seq += 1
+            value = deserialize(blob)
+            self._check_type(value, expected_type, party)
+            self.io_log.append(blob)
+            self._checkpoint()
+            return value
+        if sess.ended_by_peer:
+            raise self._peer_end_exception(sess)
+        # park
+        self.waiting_session = sess.local_id
+        self.waiting_expected_type = expected_type
+        self._checkpoint()
+        raise _Suspended()
+
+    def _io_wait_ledger(self, tx_id):
+        if self.replaying:
+            blob = self.io_log[self.replay_pos]
+            self.replay_pos += 1
+            return deserialize(blob)
+        stx = self.smm.service_hub.validated_transactions.get(tx_id)
+        if stx is not None:
+            blob = serialize(stx)
+            self.io_log.append(blob)
+            self._checkpoint()
+            return stx
+        self.waiting_tx = tx_id
+        self.smm._register_ledger_waiter(tx_id, self)
+        self._checkpoint()
+        raise _Suspended()
+
+    def _check_type(self, value, expected_type: type, party: Party) -> None:
+        if expected_type is not object and not isinstance(value, expected_type):
+            raise FlowException(
+                f"received {type(value).__name__} from {party.name}, "
+                f"expected {expected_type.__name__}"
+            )
+
+    # -- resume paths (called by SMM) ---------------------------------------
+
+    def deliver_data(self, sess: FlowSession) -> None:
+        """Called when new data arrived for a session; resumes if parked on it."""
+        if self.done or self.waiting_session != sess.local_id:
+            return
+        if sess.recv_seq not in sess.inbox:
+            return
+        blob = sess.inbox.pop(sess.recv_seq)
+        sess.recv_seq += 1
+        self.waiting_session = None
+        try:
+            value = deserialize(blob)
+            self._check_type(value, self.waiting_expected_type, sess.peer)
+        except BaseException as exc:
+            self._run(throw=exc)
+            return
+        self.io_log.append(blob)
+        self._checkpoint()
+        self._run(feed=value)
+
+    def deliver_session_end(self, sess: FlowSession) -> None:
+        if self.done or self.waiting_session != sess.local_id:
+            return
+        # If buffered data can still satisfy the receive, let it.
+        if sess.recv_seq in sess.inbox:
+            self.deliver_data(sess)
+            return
+        self.waiting_session = None
+        self._run(throw=self._peer_end_exception(sess))
+
+    def _peer_end_exception(self, sess: FlowSession) -> FlowException:
+        """A propagated FlowException is rethrown as its original type; a
+        clean-but-premature end becomes a FlowSessionException."""
+        if sess.end_error and "|" in sess.end_error:
+            return rebuild_flow_exception(sess.end_error)
+        return FlowSessionException(
+            f"session with {sess.peer.name} ended before data arrived"
+            + (f": {sess.end_error}" if sess.end_error else "")
+        )
+
+    def deliver_ledger_commit(self, stx) -> None:
+        if self.done or self.waiting_tx is None:
+            return
+        self.waiting_tx = None
+        blob = serialize(stx)
+        self.io_log.append(blob)
+        self._checkpoint()
+        self._run(feed=stx)
+
+    # -- completion ---------------------------------------------------------
+
+    def _end_sessions(self, error: Optional[str]) -> None:
+        for sess in self.sessions.values():
+            if sess.state is SessionState.INITIATED and not sess.ended_by_peer:
+                self.smm._send_session_message(
+                    sess.peer, SessionEnd(sess.peer_id, error)
+                )
+            sess.state = SessionState.ENDED
+
+    def _complete(self, value) -> None:
+        self.done = True
+        self._end_sessions(None)
+        self.smm._flow_finished(self)
+        self.result.set_result(value)
+
+    def _fail(self, exc: BaseException) -> None:
+        self.done = True
+        # Only FlowExceptions propagate their type+message to peers (reference
+        # FlowException model); anything else is an opaque counter-flow error.
+        msg = (
+            encode_flow_exception(exc)
+            if isinstance(exc, FlowException)
+            else "counter-flow error"
+        )
+        self._end_sessions(msg)
+        self.smm._flow_finished(self)
+        self.result.set_exception(exc)
+
+    # -- checkpointing ------------------------------------------------------
+
+    def _checkpoint(self) -> None:
+        blob = serialize(
+            {
+                "flow_id": self.flow_id,
+                "flow_name": self.flow.flow_name(),
+                "args": list(self.args),
+                "kwargs": dict(self.kwargs),
+                "is_responder": self.is_responder,
+                "io_log": list(self.io_log),
+                "sessions": [s.to_dict() for s in self.sessions.values()],
+                "session_keys": dict(self.session_keys),
+                "session_owner_flows": dict(self.session_owner_flows),
+            }
+        )
+        self.smm.checkpoint_storage.put(self.flow_id, blob)
+        self.smm.checkpoints_written += 1
+
+
+class StateMachineManager:
+    """Flow scheduler: starts flows, restores them from checkpoints, routes
+    session messages (reference `StateMachineManager.kt`)."""
+
+    def __init__(self, service_hub, messaging, checkpoint_storage, our_identity: Party):
+        self.service_hub = service_hub
+        self.messaging = messaging
+        self.checkpoint_storage = checkpoint_storage
+        self.our_identity = our_identity
+        self.flows: Dict[str, FlowStateMachine] = {}
+        self._sessions: Dict[str, FlowStateMachine] = {}  # local session id -> fsm
+        self._initiated_dedup: Dict[Tuple[str, str], str] = {}  # (peer, init_id) -> local id
+        self._ledger_waiters: Dict[Any, List[FlowStateMachine]] = {}
+        self.checkpoints_written = 0
+        self._changes: List[Callable] = []  # observers: fn(event, fsm)
+        # Node-local responder registrations override the global registry
+        # (reference: registerInitiatedFlows is per-node, AbstractNode.kt:291)
+        self._initiated_overrides: Dict[str, type] = {}
+        messaging.add_handler(SESSION_TOPIC, self._on_session_message)
+
+    # -- public API ---------------------------------------------------------
+
+    def start_flow(self, flow: FlowLogic, *args_for_restore, **kw) -> FlowHandle:
+        """Run a new top-level flow.  For checkpoint-restorability pass the
+        flow's constructor args via args_for_restore (they must be
+        codec-serializable); flows started without them still run but
+        restore will fail loudly."""
+        flow_id = str(uuid.uuid4())
+        fsm = FlowStateMachine(
+            flow_id, flow, self, args=tuple(args_for_restore), kwargs=kw
+        )
+        self.flows[flow_id] = fsm
+        self._notify("started", fsm)
+        fsm.start()
+        return FlowHandle(flow_id, fsm.result)
+
+    def start(self) -> None:
+        """Restore checkpointed flows and resume them (reference
+        restoreFibersFromCheckpoints, `StateMachineManager.kt:227-241`)."""
+        for flow_id, blob in self.checkpoint_storage.all_checkpoints():
+            self._restore(flow_id, blob)
+
+    @property
+    def in_flight_count(self) -> int:
+        return sum(1 for f in self.flows.values() if not f.done)
+
+    def track(self, observer: Callable) -> None:
+        """observer(event: str, fsm) on started/finished."""
+        self._changes.append(observer)
+
+    def register_initiated_flow(self, initiator_cls, responder_cls) -> None:
+        """Node-local responder for an initiating flow (overrides the global
+        @initiated_by registration for this node only)."""
+        self._initiated_overrides[initiator_cls.flow_name()] = responder_cls
+
+    # -- restore ------------------------------------------------------------
+
+    def _restore(self, flow_id: str, blob: bytes) -> None:
+        state = deserialize(blob)
+        flow_cls = flow_registry.get(state["flow_name"])
+        if flow_cls is None:
+            raise FlowException(
+                f"checkpoint for unknown flow {state['flow_name']}"
+            )
+        flow = flow_cls(*state["args"], **state["kwargs"])
+        sessions = {
+            d["local_id"]: FlowSession.from_dict(d) for d in state["sessions"]
+        }
+        fsm = FlowStateMachine(
+            flow_id, flow, self,
+            args=tuple(state["args"]), kwargs=state["kwargs"],
+            is_responder=state["is_responder"],
+            io_log=list(state["io_log"]),
+            sessions=sessions,
+            session_keys=dict(state["session_keys"]),
+            session_owner_flows=dict(state["session_owner_flows"]),
+        )
+        self.flows[flow_id] = fsm
+        for local_id, sess in sessions.items():
+            self._register_session(local_id, fsm)
+            if sess.is_initiated_side and sess.peer_id is not None:
+                # Rebuild init-dedup so a re-delivered SessionInit does not
+                # spawn a duplicate responder after restart.
+                self._initiated_dedup[(sess.peer.name, sess.peer_id)] = local_id
+            if sess.state is SessionState.INITIATING:
+                # Re-announce: the pre-crash init may have been lost.  The
+                # responder dedups by initiator session id; the init payload
+                # (seq 0) rides again from its persisted copy.
+                owner = fsm.session_owner_flows[local_id]
+                owner_cls = flow_registry.get(owner)
+                self.messaging.send(
+                    sess.peer, SESSION_TOPIC,
+                    serialize(SessionInit(
+                        initiator_session_id=local_id,
+                        flow_name=owner,
+                        flow_version=getattr(owner_cls, "_flow_version", 1),
+                        first_payload=sess.init_payload,
+                    )),
+                )
+        self._notify("restored", fsm)
+        fsm.start()
+
+    # -- session message routing --------------------------------------------
+
+    def _on_session_message(self, sender: Party, payload: bytes) -> None:
+        msg = deserialize(payload)
+        if isinstance(msg, SessionInit):
+            self._on_init(sender, msg)
+        elif isinstance(msg, SessionConfirm):
+            self._on_confirm(sender, msg)
+        elif isinstance(msg, SessionReject):
+            self._on_reject(sender, msg)
+        elif isinstance(msg, SessionData):
+            self._on_data(sender, msg)
+        elif isinstance(msg, SessionEnd):
+            self._on_end(sender, msg)
+
+    def _on_init(self, sender: Party, msg: SessionInit) -> None:
+        dedup_key = (sender.name, msg.initiator_session_id)
+        if dedup_key in self._initiated_dedup:
+            local_id = self._initiated_dedup[dedup_key]
+            self._send_session_message(
+                sender, SessionConfirm(msg.initiator_session_id, local_id)
+            )
+            return
+        responder_cls = self._initiated_overrides.get(
+            msg.flow_name
+        ) or get_initiated_by(msg.flow_name)
+        if responder_cls is None:
+            self._send_session_message(
+                sender,
+                SessionReject(
+                    msg.initiator_session_id,
+                    f"no flow registered to respond to {msg.flow_name}",
+                ),
+            )
+            return
+        flow = responder_cls(sender)
+        flow_id = str(uuid.uuid4())
+        fsm = FlowStateMachine(
+            flow_id, flow, self, args=(sender,), is_responder=True
+        )
+        local_id = f"{flow_id}:0"
+        fsm._session_counter = 1
+        sess = FlowSession(
+            local_id=local_id, peer=sender, state=SessionState.INITIATED,
+            peer_id=msg.initiator_session_id, is_initiated_side=True,
+        )
+        if msg.first_payload is not None:
+            sess.inbox[0] = msg.first_payload
+        fsm.sessions[local_id] = sess
+        key = fsm._session_key(sender, responder_cls.flow_name())
+        fsm.session_keys[key] = local_id
+        fsm.session_owner_flows[local_id] = responder_cls.flow_name()
+        self.flows[flow_id] = fsm
+        self._register_session(local_id, fsm)
+        self._initiated_dedup[dedup_key] = local_id
+        self._send_session_message(
+            sender, SessionConfirm(msg.initiator_session_id, local_id)
+        )
+        self._notify("started", fsm)
+        fsm.start()
+
+    def _on_confirm(self, sender: Party, msg: SessionConfirm) -> None:
+        fsm = self._sessions.get(msg.initiator_session_id)
+        if fsm is None:
+            return
+        sess = fsm.sessions.get(msg.initiator_session_id)
+        if sess is None or sess.state is not SessionState.INITIATING:
+            return  # duplicate confirm
+        sess.state = SessionState.INITIATED
+        sess.peer_id = msg.initiated_session_id
+        # Flush sends buffered while the handshake was in flight.  seq 0 may
+        # have ridden the init itself (send_seq started at 1).
+        start_seq = sess.send_seq - len(sess.outbox)
+        for i, blob in enumerate(sess.outbox):
+            self._send_session_message(
+                sess.peer, SessionData(sess.peer_id, start_seq + i, blob)
+            )
+        # Keep outbox[0] around only while INITIATING for init re-sends; once
+        # confirmed, the data is delivered and the buffer can go.
+        sess.outbox.clear()
+        fsm._checkpoint()
+
+    def _on_reject(self, sender: Party, msg: SessionReject) -> None:
+        fsm = self._sessions.get(msg.initiator_session_id)
+        if fsm is None:
+            return
+        sess = fsm.sessions.get(msg.initiator_session_id)
+        if sess is None:
+            return
+        sess.state = SessionState.ENDED
+        sess.ended_by_peer = True
+        sess.end_error = msg.error
+        fsm.deliver_session_end(sess)
+
+    def _on_data(self, sender: Party, msg: SessionData) -> None:
+        fsm = self._sessions.get(msg.recipient_session_id)
+        if fsm is None:
+            return
+        sess = fsm.sessions.get(msg.recipient_session_id)
+        if sess is None:
+            return
+        if msg.seq < sess.recv_seq or msg.seq in sess.inbox:
+            return  # duplicate (re-send after restore)
+        sess.inbox[msg.seq] = msg.payload
+        fsm.deliver_data(sess)
+
+    def _on_end(self, sender: Party, msg: SessionEnd) -> None:
+        fsm = self._sessions.get(msg.recipient_session_id)
+        if fsm is None:
+            return
+        sess = fsm.sessions.get(msg.recipient_session_id)
+        if sess is None:
+            return
+        sess.ended_by_peer = True
+        sess.end_error = msg.error
+        fsm.deliver_session_end(sess)
+
+    # -- internals ----------------------------------------------------------
+
+    def _register_session(self, local_id: str, fsm: FlowStateMachine) -> None:
+        self._sessions[local_id] = fsm
+
+    def _register_ledger_waiter(self, tx_id, fsm: FlowStateMachine) -> None:
+        self._ledger_waiters.setdefault(tx_id, []).append(fsm)
+
+    def notify_transaction_committed(self, stx) -> None:
+        """Called by the service hub after recordTransactions."""
+        for fsm in self._ledger_waiters.pop(stx.id, []):
+            fsm.deliver_ledger_commit(stx)
+
+    def _send_session_message(self, party: Party, msg) -> None:
+        self.messaging.send(party, SESSION_TOPIC, serialize(msg))
+
+    def _flow_finished(self, fsm: FlowStateMachine) -> None:
+        self.checkpoint_storage.remove(fsm.flow_id)
+        self._notify("finished", fsm)
+
+    def _notify(self, event: str, fsm: FlowStateMachine) -> None:
+        for obs in self._changes:
+            obs(event, fsm)

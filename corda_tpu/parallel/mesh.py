@@ -40,36 +40,33 @@ def _bucket_per_device(per_device: int) -> int:
     return max(8, 1 << math.ceil(math.log2(max(per_device, 1))))
 
 
-def shard_verify_ed25519(
-    mesh,
-    public_keys: Sequence[bytes],
-    signatures: Sequence[bytes],
-    messages: Sequence[bytes],
-) -> np.ndarray:
-    """Verify a batch sharded across `mesh`; returns bool[n] host array.
+# jit cache: one compiled sharded step per mesh (jax.jit's own cache is
+# keyed on function identity, so the closure must be built once per mesh —
+# rebuilding it per call would force a full retrace + XLA compile per batch).
+_SHARDED_STEP_CACHE: dict = {}
 
-    The verdict mask comes back per-shard (P("data")); the psum'd global
-    count stays on device as a cheap all-reduce the caller can block on.
-    """
+# Field layout of a prepared batch (matches ops.ed25519_batch.prepare_batch).
+_ARG_NAMES = ("y_a", "sign_a", "y_r", "sign_r", "s_words", "h_words", "s_ok")
+
+
+def _sharded_step(mesh):
     import jax
     import jax.numpy as jnp
     from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from ..ops import ed25519_batch
 
-    n = len(public_keys)
-    n_dev = mesh.devices.size
+    key = (id(mesh), mesh.axis_names)
+    cached = _SHARDED_STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
-    per_device = _bucket_per_device(_round_up(max(n, 1), n_dev) // n_dev)
-    padded = per_device * n_dev
-
-    kwargs, _ = ed25519_batch.prepare_batch(
-        public_keys, signatures, messages, pad_to=padded
+    # y_a, y_r, s_words, h_words are 2-D [batch, limbs]; the rest 1-D.
+    specs = (
+        P(axis, None), P(axis), P(axis, None), P(axis),
+        P(axis, None), P(axis, None), P(axis),
     )
-    names = ("y_a", "sign_a", "y_r", "sign_r", "s_words", "h_words", "s_ok")
-    args = tuple(kwargs[k] for k in names)
-    specs = tuple(P(axis, None) if a.ndim == 2 else P(axis) for a in args)
 
     def step(y_a, sign_a, y_r, sign_r, s_words, h_words, s_ok):
         mask = ed25519_batch.verify_kernel(
@@ -88,6 +85,38 @@ def shard_verify_ed25519(
             check_vma=False,
         )
     )
+    _SHARDED_STEP_CACHE[key] = (fn, specs)
+    return fn, specs
+
+
+def shard_verify_ed25519(
+    mesh,
+    public_keys: Sequence[bytes],
+    signatures: Sequence[bytes],
+    messages: Sequence[bytes],
+) -> np.ndarray:
+    """Verify a batch sharded across `mesh`; returns bool[n] host array.
+
+    The verdict mask comes back per-shard (P("data")); the psum'd global
+    count stays on device as a cheap all-reduce the caller can block on.
+    The compiled executable is cached per (mesh, padded shape) — repeated
+    bursts pay zero compilation.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..ops import ed25519_batch
+
+    n = len(public_keys)
+    n_dev = mesh.devices.size
+    per_device = _bucket_per_device(_round_up(max(n, 1), n_dev) // n_dev)
+    padded = per_device * n_dev
+
+    kwargs, _ = ed25519_batch.prepare_batch(
+        public_keys, signatures, messages, pad_to=padded
+    )
+    args = tuple(kwargs[k] for k in _ARG_NAMES)
+    fn, specs = _sharded_step(mesh)
     device_args = tuple(
         jax.device_put(a, NamedSharding(mesh, s)) for a, s in zip(args, specs)
     )

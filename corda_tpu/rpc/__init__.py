@@ -1,0 +1,15 @@
+"""corda_tpu.rpc: the RPC subsystem (reference `RPCApi.kt` protocol,
+`RPCServer.kt`, `client/rpc/CordaRPCClient.kt`).
+
+Request/reply over broker queues with observable streaming: server-side
+subscriptions forward events as Observation messages demuxed by the client
+proxy into client-side Observables.
+"""
+from .client import CordaRPCClient, RPCException, RPCPermissionError
+from .ops import CordaRPCOps
+from .server import RPCServer, RPCUser
+
+__all__ = [
+    "CordaRPCClient", "CordaRPCOps", "RPCException", "RPCPermissionError",
+    "RPCServer", "RPCUser",
+]

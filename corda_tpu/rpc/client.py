@@ -1,0 +1,157 @@
+"""RPC client proxy (reference `client/rpc/.../CordaRPCClient.kt:40-80` +
+`RPCClientProxyHandler`).
+
+    client = CordaRPCClient(broker)
+    conn = client.start("admin", "admin")
+    proxy = conn.proxy              # duck-typed CordaRPCOps
+    flow_id = proxy.start_flow_dynamic("CashIssueFlow", ...)
+    feed = proxy.vault_track()      # DataFeed with a live client Observable
+    conn.close()
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict
+
+from ..core.serialization.codec import deserialize, serialize
+from ..messaging import Broker
+from ..utils.observable import DataFeed, Observable
+from .server import RPC_SERVER_QUEUE
+
+
+class RPCException(Exception):
+    pass
+
+
+class RPCPermissionError(RPCException):
+    pass
+
+
+class _Proxy:
+    def __init__(self, connection: "CordaRPCConnection"):
+        self._connection = connection
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args):
+            return self._connection._call(name, args)
+
+        return call
+
+
+class CordaRPCConnection:
+    def __init__(self, client: "CordaRPCClient", session: str):
+        self._client = client
+        self.session = session
+        self.proxy = _Proxy(self)
+
+    def _call(self, method: str, args) -> Any:
+        reply = self._client._request({
+            "kind": "call",
+            "id": str(uuid.uuid4()),
+            "session": self.session,
+            "method": method,
+            "args": list(args),
+        })
+        return self._client._unmarshal(reply)
+
+    def close(self) -> None:
+        self._client._send({
+            "kind": "logout", "session": self.session,
+            "id": str(uuid.uuid4()),
+        })
+
+
+class CordaRPCClient:
+    def __init__(self, broker: Broker, timeout: float = 10.0):
+        self.broker = broker
+        self.timeout = timeout
+        self._reply_queue = f"rpc.client.{uuid.uuid4()}"
+        broker.create_queue(self._reply_queue)
+        self._pending: Dict[str, Future] = {}
+        self._observables: Dict[str, Observable] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(self._reply_queue)
+        self._thread = threading.Thread(
+            target=self._consume, name="rpc-client", daemon=True
+        )
+        self._thread.start()
+
+    # -- public --------------------------------------------------------------
+
+    def start(self, username: str, password: str) -> CordaRPCConnection:
+        reply = self._request({
+            "kind": "login", "id": str(uuid.uuid4()),
+            "user": username, "password": password,
+        })
+        return CordaRPCConnection(self, reply)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        self._thread.join(timeout=2)
+        with self._lock:
+            for obs in self._observables.values():
+                obs.on_completed()
+            self._observables.clear()
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, request: dict) -> None:
+        request["reply_to"] = self._reply_queue
+        self.broker.send(RPC_SERVER_QUEUE, serialize(request))
+
+    def _request(self, request: dict) -> Any:
+        fut: Future = Future()
+        with self._lock:
+            self._pending[request["id"]] = fut
+        self._send(request)
+        reply = fut.result(timeout=self.timeout)
+        if "error" in reply:
+            err = reply["error"]
+            if isinstance(err, str) and err.startswith("PERMISSION:"):
+                raise RPCPermissionError(err[len("PERMISSION:"):])
+            raise RPCException(err)
+        return reply.get("ok")
+
+    def _consume(self) -> None:
+        while not self._stop.is_set():
+            msg = self._consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            try:
+                payload = deserialize(msg.payload)
+                kind = payload.get("kind")
+                if kind == "reply":
+                    with self._lock:
+                        fut = self._pending.pop(payload["id"], None)
+                    if fut is not None:
+                        fut.set_result(payload)
+                elif kind == "observation":
+                    with self._lock:
+                        obs = self._observables.get(payload["obs_id"])
+                    if obs is not None:
+                        obs.on_next(payload["value"])
+            except Exception:
+                pass
+            self._consumer.ack(msg)
+
+    def _client_observable(self, obs_id: str) -> Observable:
+        obs = Observable()
+        with self._lock:
+            self._observables[obs_id] = obs
+        return obs
+
+    def _unmarshal(self, value):
+        if isinstance(value, dict) and value.get("__datafeed__"):
+            return DataFeed(
+                value["snapshot"], self._client_observable(value["obs"])
+            )
+        if isinstance(value, dict) and "__observable__" in value:
+            return self._client_observable(value["__observable__"])
+        return value

@@ -1,0 +1,129 @@
+"""CordaRPCOps: the node's RPC surface (reference
+`core/src/main/kotlin/net/corda/core/messaging/CordaRPCOps.kt:61-259`).
+
+Implemented directly over the ServiceHub + StateMachineManager (reference
+`CordaRPCOpsImpl.kt`).  Feed-returning methods produce DataFeed(snapshot,
+Observable); the RPC server streams the observable side to clients.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..core.flows.api import flow_registry
+from ..core.crypto.secure_hash import SecureHash
+from ..core.serialization.codec import register_adapter
+from ..utils.observable import DataFeed, Observable
+
+
+@dataclass(frozen=True)
+class StateMachineInfo:
+    flow_id: str
+    flow_name: str
+    done: bool
+
+
+register_adapter(
+    StateMachineInfo, "StateMachineInfo",
+    lambda i: {"id": i.flow_id, "name": i.flow_name, "done": i.done},
+    lambda d: StateMachineInfo(d["id"], d["name"], d["done"]),
+)
+
+
+class CordaRPCOps:
+    """One instance per node; the RPC server dispatches into this."""
+
+    def __init__(self, services, smm):
+        self._services = services
+        self._smm = smm
+        self._state_machine_updates = Observable()
+        self._tx_updates = Observable()
+        self._vault_updates = Observable()
+        smm.track(self._on_smm_event)
+        services.validated_transactions.track(self._tx_updates.on_next)
+        services.vault_service.track(
+            lambda produced, consumed: self._vault_updates.on_next(
+                {"produced": produced, "consumed": consumed}
+            )
+        )
+
+    def _on_smm_event(self, event: str, fsm) -> None:
+        self._state_machine_updates.on_next(
+            StateMachineInfo(fsm.flow_id, fsm.flow.flow_name(), fsm.done)
+        )
+
+    # -- flows ---------------------------------------------------------------
+
+    def start_flow_dynamic(self, flow_name: str, *args, **kwargs):
+        """Start a registered @startable_by_rpc flow by name; returns the
+        flow id (result retrieved via flow_result / state machine feed)."""
+        cls = flow_registry.get(flow_name) or next(
+            (c for n, c in flow_registry.items()
+             if n.rsplit(".", 1)[-1] == flow_name),
+            None,
+        )
+        if cls is None:
+            raise ValueError(f"unknown flow {flow_name}")
+        if not getattr(cls, "_startable_by_rpc", False):
+            raise PermissionError(f"{flow_name} is not @startable_by_rpc")
+        flow = cls(*args, **kwargs)
+        handle = self._smm.start_flow(flow, *args, **kwargs)
+        return handle.flow_id
+
+    def flow_result(self, flow_id: str, timeout: Optional[float] = None):
+        fsm = self._smm.flows.get(flow_id)
+        if fsm is None:
+            raise ValueError(f"unknown flow id {flow_id}")
+        return fsm.result.result(timeout=timeout)
+
+    def state_machines_feed(self) -> DataFeed:
+        snapshot = [
+            StateMachineInfo(f.flow_id, f.flow.flow_name(), f.done)
+            for f in self._smm.flows.values()
+            if not f.done
+        ]
+        return DataFeed(snapshot, self._state_machine_updates)
+
+    # -- ledger --------------------------------------------------------------
+
+    def verified_transactions_feed(self) -> DataFeed:
+        return DataFeed([], self._tx_updates)
+
+    def vault_query(self, contract_name: Optional[str] = None) -> List:
+        return self._services.vault_service.unconsumed_states(contract_name)
+
+    def vault_track(self, contract_name: Optional[str] = None) -> DataFeed:
+        return DataFeed(self.vault_query(contract_name), self._vault_updates)
+
+    # -- attachments ---------------------------------------------------------
+
+    def upload_attachment(self, data: bytes) -> SecureHash:
+        return self._services.attachments.import_attachment(data)
+
+    def open_attachment(self, att_id: SecureHash) -> Optional[bytes]:
+        att = self._services.attachments.open_attachment(att_id)
+        return att.data if att is not None else None
+
+    def attachment_exists(self, att_id: SecureHash) -> bool:
+        return self._services.attachments.has_attachment(att_id)
+
+    # -- network / identity --------------------------------------------------
+
+    def network_map_snapshot(self) -> List:
+        return self._services.network_map_cache.all_nodes
+
+    def notary_identities(self) -> List:
+        return self._services.network_map_cache.notary_identities
+
+    def node_info(self):
+        return self._services.my_info
+
+    def party_from_key(self, key):
+        return self._services.identity_service.party_from_key(key)
+
+    def party_from_name(self, name: str):
+        return self._services.identity_service.party_from_name(name)
+
+    def current_node_time(self) -> float:
+        return self._services.clock()

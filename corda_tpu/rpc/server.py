@@ -1,0 +1,184 @@
+"""RPC server over broker queues (reference `RPCServer.kt` + the protocol
+spec in `node-api/.../RPCApi.kt:23-59`).
+
+Protocol:
+  * client -> RPC_SERVER_QUEUE: {"kind": "login", ...} or
+    {"kind": "call", "id", "session", "method", "args", "reply_to"}
+  * server -> client reply queue: {"kind": "reply", "id", "ok"/"error", ...}
+    Observable-valued results are replaced with {"__observable__": obs_id}
+    and subsequent {"kind": "observation", "obs_id", "value"} messages —
+    the server keeps the subscription until the client unsubscribes or
+    disconnects (reference server-side observable GC, RPCServer.kt:253-254).
+
+Permissions (reference RPC users in node.conf): a user has a set like
+{"ALL"} or {"StartFlow.corda_tpu.finance.flows.CashIssueFlow", "vault_query"}.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from ..core.serialization.codec import deserialize, serialize
+from ..messaging import Broker
+from ..utils.observable import DataFeed, Observable, Subscription
+
+RPC_SERVER_QUEUE = "rpc.server.requests"
+
+
+@dataclass
+class RPCUser:
+    username: str
+    password: str
+    permissions: Set[str] = field(default_factory=lambda: {"ALL"})
+
+
+class RPCServer:
+    def __init__(self, broker: Broker, ops, users: Optional[list] = None):
+        self.broker = broker
+        self.ops = ops
+        self.users: Dict[str, RPCUser] = {
+            u.username: u for u in (users or [RPCUser("admin", "admin")])
+        }
+        self._sessions: Dict[str, RPCUser] = {}
+        self._subscriptions: Dict[str, Subscription] = {}
+        broker.create_queue(RPC_SERVER_QUEUE)
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(RPC_SERVER_QUEUE)
+        self._thread = threading.Thread(
+            target=self._serve, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    # -- main loop -----------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            msg = self._consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            try:
+                request = deserialize(msg.payload)
+                self._handle(request)
+            except Exception:
+                pass  # a bad request must not kill the server loop
+            self._consumer.ack(msg)
+
+    def _reply(self, reply_to: str, payload: dict) -> None:
+        try:
+            self.broker.send(reply_to, serialize(payload))
+        except Exception:
+            pass  # client is gone
+
+    def _handle(self, request: dict) -> None:
+        kind = request.get("kind")
+        if kind == "login":
+            self._handle_login(request)
+        elif kind == "call":
+            self._handle_call(request)
+        elif kind == "unsubscribe":
+            sub = self._subscriptions.pop(request["obs_id"], None)
+            if sub is not None:
+                sub.unsubscribe()
+        elif kind == "logout":
+            self._sessions.pop(request.get("session", ""), None)
+            # Drop this session's subscriptions (observable GC on disconnect).
+            prefix = request.get("session", "") + "/"
+            for obs_id in [k for k in self._subscriptions if k.startswith(prefix)]:
+                self._subscriptions.pop(obs_id).unsubscribe()
+
+    def _handle_login(self, request: dict) -> None:
+        user = self.users.get(request.get("user", ""))
+        if user is None or user.password != request.get("password"):
+            self._reply(request["reply_to"], {
+                "kind": "reply", "id": request["id"],
+                "error": "invalid credentials",
+            })
+            return
+        session = str(uuid.uuid4())
+        self._sessions[session] = user
+        self._reply(request["reply_to"], {
+            "kind": "reply", "id": request["id"], "ok": session,
+        })
+
+    def _permitted(self, user: RPCUser, method: str, args: tuple) -> bool:
+        if "ALL" in user.permissions:
+            return True
+        if method == "start_flow_dynamic":
+            flow_name = args[0] if args else ""
+            return (
+                f"StartFlow.{flow_name}" in user.permissions
+                or any(p.endswith("." + flow_name) for p in user.permissions
+                       if p.startswith("StartFlow."))
+            )
+        return method in user.permissions
+
+    def _handle_call(self, request: dict) -> None:
+        reply_to = request["reply_to"]
+        req_id = request["id"]
+        user = self._sessions.get(request.get("session", ""))
+        if user is None:
+            self._reply(reply_to, {
+                "kind": "reply", "id": req_id, "error": "not logged in",
+            })
+            return
+        method_name = request["method"]
+        if method_name.startswith("_") or not hasattr(self.ops, method_name):
+            self._reply(reply_to, {
+                "kind": "reply", "id": req_id,
+                "error": f"unknown method {method_name}",
+            })
+            return
+        args = tuple(request.get("args", []))
+        if not self._permitted(user, method_name, args):
+            self._reply(reply_to, {
+                "kind": "reply", "id": req_id,
+                "error": f"PERMISSION:{method_name} not permitted for {user.username}",
+            })
+            return
+        try:
+            result = getattr(self.ops, method_name)(*args)
+        except Exception as exc:
+            self._reply(reply_to, {
+                "kind": "reply", "id": req_id, "error": str(exc),
+            })
+            return
+        self._reply(reply_to, {
+            "kind": "reply", "id": req_id,
+            "ok": self._marshal(result, request.get("session", ""), reply_to),
+        })
+
+    # -- observable marshalling ----------------------------------------------
+
+    def _marshal(self, value, session: str, reply_to: str):
+        if isinstance(value, DataFeed):
+            return {
+                "__datafeed__": True,
+                "snapshot": value.snapshot,
+                "obs": self._register_observable(value.updates, session, reply_to),
+            }
+        if isinstance(value, Observable):
+            return {"__observable__": self._register_observable(value, session, reply_to)}
+        return value
+
+    def _register_observable(
+        self, obs: Observable, session: str, reply_to: str
+    ) -> str:
+        obs_id = f"{session}/{uuid.uuid4()}"
+
+        def forward(value):
+            self._reply(reply_to, {
+                "kind": "observation", "obs_id": obs_id, "value": value,
+            })
+
+        self._subscriptions[obs_id] = obs.subscribe(forward)
+        return obs_id
+
+    def stop(self) -> None:
+        self._stop.set()
+        for sub in self._subscriptions.values():
+            sub.unsubscribe()
+        self._subscriptions.clear()
+        self._consumer.close()
+        self._thread.join(timeout=2)

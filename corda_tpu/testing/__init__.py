@@ -1,0 +1,4 @@
+"""corda_tpu.testing: test infrastructure (reference `test-utils/`)."""
+from .mocknetwork import MockNetwork, MockNode
+
+__all__ = ["MockNetwork", "MockNode"]

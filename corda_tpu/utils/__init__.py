@@ -1,0 +1,4 @@
+"""corda_tpu.utils: small shared utilities."""
+from .observable import DataFeed, Observable, Subscription
+
+__all__ = ["DataFeed", "Observable", "Subscription"]

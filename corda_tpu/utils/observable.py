@@ -1,0 +1,100 @@
+"""Minimal push-stream primitive (the RxJava-1 replacement, SURVEY §2.9).
+
+Thread-safe; completion/error are terminal.  `DataFeed` pairs a snapshot
+with the stream of subsequent updates (reference `DataFeed` in
+`CordaRPCOps.kt`).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Subscription:
+    def __init__(self, observable: "Observable", fn: Callable):
+        self._observable = observable
+        self._fn = fn
+        self.active = True
+
+    def unsubscribe(self) -> None:
+        self.active = False
+        self._observable._remove(self)
+
+
+class Observable(Generic[T]):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: List[Subscription] = []
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def subscribe(
+        self,
+        on_next: Callable[[T], None],
+        on_error: Optional[Callable[[BaseException], None]] = None,
+        on_completed: Optional[Callable[[], None]] = None,
+    ) -> Subscription:
+        sub = Subscription(self, on_next)
+        sub._on_error = on_error
+        sub._on_completed = on_completed
+        with self._lock:
+            if self._done:
+                sub.active = False
+            else:
+                self._subs.append(sub)
+        if not sub.active:
+            if self._error is not None and on_error is not None:
+                on_error(self._error)
+            elif self._error is None and on_completed is not None:
+                on_completed()
+        return sub
+
+    def on_next(self, value: T) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            if sub.active:
+                sub._fn(value)
+
+    def on_completed(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            subs, self._subs = self._subs, []
+        for sub in subs:
+            sub.active = False
+            if getattr(sub, "_on_completed", None):
+                sub._on_completed()
+
+    def on_error(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            self._error = exc
+            subs, self._subs = self._subs, []
+        for sub in subs:
+            sub.active = False
+            if getattr(sub, "_on_error", None):
+                sub._on_error(exc)
+
+    def _remove(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    @property
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+
+@dataclass
+class DataFeed(Generic[T]):
+    """snapshot + updates (reference CordaRPCOps DataFeed)."""
+    snapshot: Any
+    updates: Observable

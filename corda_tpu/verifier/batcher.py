@@ -86,9 +86,12 @@ class SignatureBatcher:
             fut.set_result(bool(ok))
 
     def close(self) -> None:
-        self.flush()
+        # Refuse new work first, then drain: a submit racing with close
+        # either lands before the final flush or fails with "closed" —
+        # never a silently-stranded future.
         with self._lock:
             self._closed = True
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
+        self.flush()

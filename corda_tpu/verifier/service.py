@@ -153,11 +153,11 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
                     self._complete_tx(resp)
                 elif isinstance(resp, SignatureBatchResponse):
                     self._complete_sigs(resp)
+                self._consumer.ack(msg)
             except Exception:
-                # A malformed response must not kill the completer thread —
-                # that would strand every pending future forever.
+                # A malformed response — or an ack racing stop()'s consumer
+                # close — must not kill the completer thread.
                 pass
-            self._consumer.ack(msg)
 
     def _complete_tx(self, resp: VerificationResponse) -> None:
         with self._lock:

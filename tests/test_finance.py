@@ -1,0 +1,253 @@
+"""Finance layer tests (reference `finance/src/test/.../CashTests.kt`,
+`CommercialPaperTests.kt`, `TwoPartyTradeFlowTests.kt`).
+"""
+import pytest
+
+from corda_tpu.core.contracts import Amount, Issued, StateAndRef, StateRef, TimeWindow
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.identity import Party
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.finance import (
+    Cash,
+    CashCommand,
+    CashExitFlow,
+    CashIssueFlow,
+    CashPaymentFlow,
+    CashState,
+    InsufficientBalanceError,
+    SellerFlow,
+    issued_by,
+)
+from corda_tpu.finance.commercial_paper import CommercialPaperState, CPCommand
+from corda_tpu.testing import MockNetwork
+
+USD = "USD"
+
+
+def _amount(n, token=USD):
+    return Amount(n, token)
+
+
+class TestCashContract:
+    def setup_method(self):
+        self.bank_kp = crypto.entropy_to_keypair(500)
+        self.alice_kp = crypto.entropy_to_keypair(501)
+        self.notary_kp = crypto.entropy_to_keypair(502)
+        self.bank = Party("O=Bank,L=London,C=GB", self.bank_kp.public)
+        self.alice = Party("O=Alice,L=London,C=GB", self.alice_kp.public)
+        self.notary = Party("O=Notary,L=Zurich,C=CH", self.notary_kp.public)
+        self.token = Issued(self.bank.ref(1), USD)
+
+    def _ltx(self, builder, input_states=None):
+        wtx = builder.to_wire_transaction()
+        resolved = dict(input_states or {})
+        return wtx.to_ledger_transaction(
+            resolve_state=lambda ref: resolved[ref],
+            resolve_attachment=lambda h: None,
+        )
+
+    def test_issue_ok(self):
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(
+            CashState(amount=Amount(100, self.token), owner=self.alice)
+        )
+        b.add_command(CashCommand.Issue(), self.bank.owning_key)
+        self._ltx(b).verify()
+
+    def test_issue_not_signed_by_issuer_rejected(self):
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(
+            CashState(amount=Amount(100, self.token), owner=self.alice)
+        )
+        b.add_command(CashCommand.Issue(), self.alice.owning_key)
+        with pytest.raises(Exception, match="signed by the issuer"):
+            self._ltx(b).verify()
+
+    def _issued_input(self, quantity, owner):
+        issue_b = TransactionBuilder(notary=self.notary)
+        issue_b.add_output_state(
+            CashState(amount=Amount(quantity, self.token), owner=owner)
+        )
+        issue_b.add_command(CashCommand.Issue(), self.bank.owning_key)
+        issue_wtx = issue_b.to_wire_transaction()
+        ref = StateRef(issue_wtx.id, 0)
+        return ref, issue_wtx.outputs[0]
+
+    def test_move_conserved_ok(self):
+        ref, ts = self._issued_input(100, self.alice)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(StateAndRef(ts, ref))
+        b.add_output_state(
+            CashState(amount=Amount(100, self.token), owner=self.bank)
+        )
+        b.add_command(CashCommand.Move(), self.alice.owning_key)
+        self._ltx(b, {ref: ts}).verify()
+
+    def test_move_not_conserved_rejected(self):
+        ref, ts = self._issued_input(100, self.alice)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(StateAndRef(ts, ref))
+        b.add_output_state(
+            CashState(amount=Amount(90, self.token), owner=self.bank)
+        )
+        b.add_command(CashCommand.Move(), self.alice.owning_key)
+        with pytest.raises(Exception, match="not conserved"):
+            self._ltx(b, {ref: ts}).verify()
+
+    def test_move_missing_owner_signature_rejected(self):
+        ref, ts = self._issued_input(100, self.alice)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(StateAndRef(ts, ref))
+        b.add_output_state(
+            CashState(amount=Amount(100, self.token), owner=self.bank)
+        )
+        b.add_command(CashCommand.Move(), self.bank.owning_key)
+        with pytest.raises(Exception, match="signed by all input owners"):
+            self._ltx(b, {ref: ts}).verify()
+
+    def test_exit_ok(self):
+        ref, ts = self._issued_input(100, self.alice)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(StateAndRef(ts, ref))
+        b.add_output_state(
+            CashState(amount=Amount(60, self.token), owner=self.alice)
+        )
+        b.add_command(
+            CashCommand.Exit(Amount(40, self.token)),
+            self.bank.owning_key, self.alice.owning_key,
+        )
+        self._ltx(b, {ref: ts}).verify()
+
+
+class TestCashFlows:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.bank = self.net.create_node("O=Bank,L=London,C=GB")
+        self.alice = self.net.create_node("O=Alice,L=London,C=GB")
+        self.bob = self.net.create_node("O=Bob,L=New York,C=US")
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _balance(self, node):
+        return sum(
+            sr.state.data.amount.quantity
+            for sr in node.services.vault_service.unconsumed_states(
+                CashState.contract_name
+            )
+        )
+
+    def _issue_to(self, node, quantity):
+        flow = CashIssueFlow(
+            _amount(quantity), b"\x01", node.info, self.notary.info
+        )
+        h = self.bank.start_flow(flow)
+        self.net.run_network()
+        return h.result.result(timeout=1)
+
+    def test_issue_and_pay(self):
+        self._issue_to(self.alice, 1000)
+        assert self._balance(self.alice) == 1000
+        assert self._balance(self.bank) == 0
+
+        token = Issued(self.bank.info.ref(1), USD)
+        h = self.alice.start_flow(
+            CashPaymentFlow(Amount(300, token), self.bob.info, self.notary.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=1)
+        assert self._balance(self.alice) == 700  # change came back
+        assert self._balance(self.bob) == 300
+
+    def test_payment_insufficient_balance(self):
+        self._issue_to(self.alice, 100)
+        token = Issued(self.bank.info.ref(1), USD)
+        h = self.alice.start_flow(
+            CashPaymentFlow(Amount(500, token), self.bob.info, self.notary.info)
+        )
+        self.net.run_network()
+        with pytest.raises(InsufficientBalanceError):
+            h.result.result(timeout=1)
+        # soft locks were released on failure
+        assert self._balance(self.alice) == 100
+        h2 = self.alice.start_flow(
+            CashPaymentFlow(Amount(50, token), self.bob.info, self.notary.info)
+        )
+        self.net.run_network()
+        h2.result.result(timeout=1)
+        assert self._balance(self.bob) == 50
+
+    def test_exit(self):
+        self._issue_to(self.bank, 500)
+        token = Issued(self.bank.info.ref(1), USD)
+        h = self.bank.start_flow(CashExitFlow(Amount(200, token), self.notary.info))
+        self.net.run_network()
+        h.result.result(timeout=1)
+        assert self._balance(self.bank) == 300
+
+
+class TestTwoPartyTrade:
+    def test_dvp_paper_for_cash(self):
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        bank = net.create_node("O=Bank,L=London,C=GB")
+        seller = net.create_node("O=Seller,L=London,C=GB")
+        buyer = net.create_node("O=Buyer,L=New York,C=US")
+
+        # Buyer gets 1000 issued USD.
+        h = bank.start_flow(
+            CashIssueFlow(_amount(1000), b"\x01", buyer.info, notary.info)
+        )
+        net.run_network()
+        h.result.result(timeout=1)
+
+        # Seller self-issues commercial paper (time-windowed issue).
+        now = int(seller.services.clock() * 1_000_000_000)
+        token = Issued(bank.info.ref(1), USD)
+        paper = CommercialPaperState(
+            issuance=seller.info.ref(2),
+            owner=seller.info,
+            face_value=Amount(900, token),
+            maturity_date=now + int(30 * 86400 * 1e9),
+        )
+        b = TransactionBuilder(notary=notary.info)
+        b.add_output_state(paper)
+        b.add_command(CPCommand.Issue(), seller.info.owning_key)
+        b.set_time_window(TimeWindow.with_tolerance(now, int(120 * 1e9)))
+        issue_stx = seller.services.sign_initial_transaction(b)
+        from corda_tpu.core.flows import FinalityFlow
+
+        h2 = seller.start_flow(FinalityFlow(issue_stx), issue_stx)
+        net.run_network()
+        h2.result.result(timeout=1)
+
+        # Trade: paper for 800 USD.
+        asset = issue_stx.tx.out_ref(0)
+        h3 = seller.start_flow(
+            SellerFlow(buyer.info, asset, Amount(800, token), notary.info),
+            buyer.info,
+        )
+        net.run_network()
+        h3.result.result(timeout=5)
+
+        seller_cash = sum(
+            sr.state.data.amount.quantity
+            for sr in seller.services.vault_service.unconsumed_states(
+                CashState.contract_name
+            )
+        )
+        buyer_cash = sum(
+            sr.state.data.amount.quantity
+            for sr in buyer.services.vault_service.unconsumed_states(
+                CashState.contract_name
+            )
+        )
+        buyer_paper = buyer.services.vault_service.unconsumed_states(
+            CommercialPaperState.contract_name
+        )
+        assert seller_cash == 800
+        assert buyer_cash == 200
+        assert len(buyer_paper) == 1
+        assert buyer_paper[0].state.data.owner == buyer.info
+        net.stop_nodes()

@@ -1,0 +1,207 @@
+"""Raft consensus tests: election, replication, conflict detection,
+leader failover, partition catch-up (reference coverage parity:
+`RaftValidatingNotaryServiceTests.kt` + DistributedImmutableMap tests).
+Fully deterministic: ticks + manual message pumping, no wall-clock."""
+from collections import deque
+
+import pytest
+
+from corda_tpu.core.contracts import StateRef
+from corda_tpu.core.crypto import SecureHash, crypto
+from corda_tpu.core.identity import Party
+from corda_tpu.node.database import NodeDatabase
+from corda_tpu.node.notary import RaftUniquenessProvider, UniquenessException
+from corda_tpu.node.raft import LEADER, NotLeaderError, RaftNode
+
+
+class Cluster:
+    """N RaftNodes over a deterministic in-memory transport."""
+
+    def __init__(self, n=3, with_db=False, apply_fn=None):
+        self.queue = deque()  # (src, dst, payload)
+        self.partitioned = set()  # node ids cut off from the world
+        self.nodes = {}
+        self.applied = {i: [] for i in range(n)}
+        ids = [f"n{i}" for i in range(n)]
+        for i, node_id in enumerate(ids):
+            db = NodeDatabase(":memory:") if with_db else None
+
+            def make_apply(idx):
+                def apply(cmd):
+                    self.applied[idx].append(cmd)
+                    return {"conflicts": {}}
+                return apply
+
+            def make_transport(src):
+                def transport(dst, payload):
+                    self.queue.append((src, dst, payload))
+                return transport
+
+            self.nodes[node_id] = RaftNode(
+                node_id, ids, make_transport(node_id),
+                apply_fn(i) if apply_fn else make_apply(i),
+                db=db, seed=i,
+            )
+
+    def pump(self, max_rounds=200):
+        rounds = 0
+        while self.queue and rounds < max_rounds:
+            src, dst, payload = self.queue.popleft()
+            if src in self.partitioned or dst in self.partitioned:
+                continue
+            self.nodes[dst].on_message(src, payload)
+            rounds += 1
+
+    def tick_all(self, now):
+        for node_id, node in self.nodes.items():
+            if node_id not in self.partitioned:
+                node.tick(now)
+        self.pump()
+
+    def elect(self, start=0.0):
+        """Advance time until someone wins an election."""
+        t = start
+        for _ in range(100):
+            t += 5
+            self.tick_all(t)
+            leaders = [n for n in self.nodes.values()
+                       if n.is_leader and n.node_id not in self.partitioned]
+            if leaders:
+                return leaders[0], t
+        raise AssertionError("no leader elected")
+
+
+class TestRaft:
+    def test_leader_election(self):
+        c = Cluster(3)
+        leader, _ = c.elect()
+        followers = [n for n in c.nodes.values() if n is not leader]
+        assert all(f.leader_id == leader.node_id for f in followers)
+
+    def test_replication_and_apply_on_all(self):
+        c = Cluster(3)
+        leader, t = c.elect()
+        fut = leader.submit({"kind": "putall", "entries": {"aa": b"x"}})
+        c.pump()
+        assert fut.result(timeout=0) == {"conflicts": {}}
+        # Followers learn the commit index on the next heartbeat.
+        for _ in range(3):
+            t += 5
+            c.tick_all(t)
+        applied_counts = [len(v) for v in c.applied.values()]
+        assert applied_counts == [1, 1, 1]
+
+    def test_submit_to_follower_fails_fast(self):
+        c = Cluster(3)
+        leader, _ = c.elect()
+        follower = next(n for n in c.nodes.values() if n is not leader)
+        fut = follower.submit({"kind": "putall", "entries": {}})
+        with pytest.raises(NotLeaderError) as err:
+            fut.result(timeout=0)
+        assert err.value.leader_hint == leader.node_id
+
+    def test_leader_failover(self):
+        c = Cluster(3)
+        leader, t = c.elect()
+        fut = leader.submit({"kind": "putall", "entries": {"k1": b"1"}})
+        c.pump()
+        fut.result(timeout=0)
+
+        c.partitioned.add(leader.node_id)  # kill the leader
+        new_leader, t = c.elect(start=t)
+        assert new_leader.node_id != leader.node_id
+        fut2 = new_leader.submit({"kind": "putall", "entries": {"k2": b"2"}})
+        c.pump()
+        assert fut2.result(timeout=0) == {"conflicts": {}}
+
+        # Old leader rejoins and catches up.
+        c.partitioned.discard(leader.node_id)
+        for _ in range(10):
+            t += 5
+            c.tick_all(t)
+        old = c.nodes[leader.node_id]
+        assert not old.is_leader
+        assert old.last_applied == new_leader.last_applied
+
+    def test_log_survives_restart_with_db(self):
+        c = Cluster(3, with_db=True)
+        leader, _ = c.elect()
+        fut = leader.submit({"kind": "putall", "entries": {"p": b"q"}})
+        c.pump()
+        fut.result(timeout=0)
+        assert len(leader.log) == 1
+        # New node instance from the same DB sees the persisted log/term.
+        reloaded = RaftNode(
+            leader.node_id, list(c.nodes), lambda d, p: None,
+            lambda cmd: None, db=leader._meta.db, seed=99,
+        )
+        assert len(reloaded.log) == 1
+        assert reloaded.current_term == leader.current_term
+
+
+class TestRaftUniquenessProvider:
+    def _provider_cluster(self):
+        dbs = [NodeDatabase(":memory:") for _ in range(3)]
+        providers = {}
+        c = Cluster(3, apply_fn=lambda i: lambda cmd: providers[f"n{i}"].apply(cmd))
+        for i, (node_id, node) in enumerate(c.nodes.items()):
+            providers[node_id] = RaftUniquenessProvider(node, dbs[i])
+        return c, providers
+
+    def test_commit_and_conflict(self):
+        c, providers = self._provider_cluster()
+        leader, _ = c.elect()
+        provider = providers[leader.node_id]
+        party = Party(
+            "O=Notary,L=Zurich,C=CH", crypto.entropy_to_keypair(1).public
+        )
+        tx1 = SecureHash.sha256(b"tx1")
+        tx2 = SecureHash.sha256(b"tx2")
+        ref = StateRef(SecureHash.sha256(b"issue"), 0)
+
+        import threading
+        done = []
+        thread = threading.Thread(
+            target=lambda: done.append(provider.commit([ref], tx1, party))
+        )
+        thread.start()
+        for _ in range(50):
+            c.pump()
+            if done:
+                break
+            import time
+            time.sleep(0.01)
+        thread.join(timeout=5)
+        assert done  # committed
+
+        # Same ref, same tx -> idempotent re-commit succeeds.
+        t2 = threading.Thread(
+            target=lambda: done.append(provider.commit([ref], tx1, party))
+        )
+        t2.start()
+        for _ in range(50):
+            c.pump()
+            if len(done) > 1:
+                break
+            import time
+            time.sleep(0.01)
+        t2.join(timeout=5)
+        assert len(done) == 2
+
+        # Different tx consuming the same ref -> conflict.
+        errs = []
+        def try_conflict():
+            try:
+                provider.commit([ref], tx2, party)
+            except UniquenessException as e:
+                errs.append(e)
+        t3 = threading.Thread(target=try_conflict)
+        t3.start()
+        for _ in range(50):
+            c.pump()
+            if errs:
+                break
+            import time
+            time.sleep(0.01)
+        t3.join(timeout=5)
+        assert errs and errs[0].conflict.consumed

@@ -1,0 +1,101 @@
+"""RPC layer tests (reference `client/rpc` round-trip + observable tests,
+RPCServer permission checks)."""
+import time
+
+import pytest
+
+from corda_tpu.core.contracts import Amount
+from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+from corda_tpu.messaging import Broker
+from corda_tpu.rpc import (
+    CordaRPCClient,
+    CordaRPCOps,
+    RPCException,
+    RPCPermissionError,
+    RPCServer,
+    RPCUser,
+)
+from corda_tpu.testing import MockNetwork
+
+
+@startable_by_rpc
+class AddFlow(FlowLogic):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def call(self):
+        return self.a + self.b
+        yield  # pragma: no cover
+
+
+class TestRPC:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.node = self.net.create_node("O=RpcNode,L=London,C=GB")
+        self.broker = Broker()
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+        self.server = RPCServer(
+            self.broker, self.ops,
+            users=[
+                RPCUser("admin", "secret"),
+                RPCUser("limited", "pw", {"node_info", "vault_query"}),
+            ],
+        )
+        self.client = CordaRPCClient(self.broker)
+
+    def teardown_method(self):
+        self.client.close()
+        self.server.stop()
+        self.net.stop_nodes()
+
+    def test_login_and_node_info(self):
+        conn = self.client.start("admin", "secret")
+        info = conn.proxy.node_info()
+        assert info == self.node.info
+        assert conn.proxy.party_from_name("O=RpcNode,L=London,C=GB") == self.node.info
+        conn.close()
+
+    def test_bad_credentials(self):
+        with pytest.raises(RPCException, match="invalid credentials"):
+            self.client.start("admin", "wrong")
+
+    def test_start_flow_and_result(self):
+        conn = self.client.start("admin", "secret")
+        flow_id = conn.proxy.start_flow_dynamic("AddFlow", 20, 22)
+        assert self.ops.flow_result(flow_id, timeout=5) == 42
+        conn.close()
+
+    def test_permissions(self):
+        conn = self.client.start("limited", "pw")
+        assert conn.proxy.node_info() == self.node.info
+        with pytest.raises(RPCPermissionError):
+            conn.proxy.start_flow_dynamic("AddFlow", 1, 2)
+        with pytest.raises(RPCPermissionError):
+            conn.proxy.network_map_snapshot()
+        conn.close()
+
+    def test_state_machine_feed_streams(self):
+        conn = self.client.start("admin", "secret")
+        feed = conn.proxy.state_machines_feed()
+        events = []
+        feed.updates.subscribe(events.append)
+        conn.proxy.start_flow_dynamic("AddFlow", 1, 2)
+        deadline = time.time() + 5
+        while len(events) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert any(e.done for e in events)       # finished event arrived
+        assert any(not e.done for e in events)   # started event arrived
+        conn.close()
+
+    def test_attachments_roundtrip(self):
+        conn = self.client.start("admin", "secret")
+        att_id = conn.proxy.upload_attachment(b"jar bytes here")
+        assert conn.proxy.attachment_exists(att_id)
+        assert conn.proxy.open_attachment(att_id) == b"jar bytes here"
+        conn.close()
+
+    def test_unknown_method(self):
+        conn = self.client.start("admin", "secret")
+        with pytest.raises(RPCException, match="unknown method"):
+            conn.proxy.does_not_exist()
+        conn.close()
