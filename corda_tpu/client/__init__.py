@@ -1,0 +1,5 @@
+"""corda_tpu.client: client-side libraries (reference `client/*`).
+
+  * rpc    — corda_tpu.rpc.CordaRPCClient (lives in the rpc package)
+  * jackson — JSON mapping for core types + string flow-start parsing
+"""

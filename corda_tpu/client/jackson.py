@@ -1,0 +1,202 @@
+"""JSON support for core types (reference
+`client/jackson/src/main/kotlin/net/corda/jackson/JacksonSupport.kt` +
+`StringToMethodCallParser` used by the shell and webserver).
+
+`to_json` / `from_json` round-trip the common API types;
+`parse_flow_start` parses shell-style invocations like
+    "CashIssueFlow amount: 100 USD, recipient: O=Alice,L=London,C=GB"
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Dict, Optional
+
+from ..core.contracts.amount import Amount, Issued
+from ..core.contracts.structures import StateAndRef, StateRef, TransactionState
+from ..core.crypto.keys import SchemePublicKey
+from ..core.crypto.secure_hash import SecureHash
+from ..core.identity import AnonymousParty, Party, PartyAndReference
+
+
+def _encode(value: Any) -> Any:
+    if isinstance(value, SecureHash):
+        return {"_type": "SecureHash", "value": value.bytes.hex().upper()}
+    if isinstance(value, Party):
+        return {
+            "_type": "Party", "name": value.name,
+            "key": value.owning_key.encoded.hex(),
+            "scheme": value.owning_key.scheme_code_name,
+        }
+    if isinstance(value, AnonymousParty):
+        return {
+            "_type": "AnonymousParty",
+            "key": value.owning_key.encoded.hex(),
+            "scheme": value.owning_key.scheme_code_name,
+        }
+    if isinstance(value, SchemePublicKey):
+        return {
+            "_type": "PublicKey", "key": value.encoded.hex(),
+            "scheme": value.scheme_code_name,
+        }
+    if isinstance(value, PartyAndReference):
+        return {
+            "_type": "PartyAndReference",
+            "party": _encode(value.party),
+            "reference": value.reference.hex(),
+        }
+    if isinstance(value, Issued):
+        return {
+            "_type": "Issued", "issuer": _encode(value.issuer),
+            "product": _encode(value.product),
+        }
+    if isinstance(value, Amount):
+        return {
+            "_type": "Amount", "quantity": value.quantity,
+            "token": _encode(value.token),
+        }
+    if isinstance(value, StateRef):
+        return {
+            "_type": "StateRef", "txhash": value.txhash.bytes.hex().upper(),
+            "index": value.index,
+        }
+    if isinstance(value, StateAndRef):
+        return {
+            "_type": "StateAndRef", "ref": _encode(value.ref),
+            "state": _encode(value.state),
+        }
+    if isinstance(value, TransactionState):
+        return {
+            "_type": "TransactionState",
+            "data": _encode_state_data(value.data),
+            "notary": _encode(value.notary),
+        }
+    if isinstance(value, bytes):
+        return {"_type": "bytes", "value": value.hex()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _encode(v) for k, v in value.items()}
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return _encode_state_data(value)
+
+
+def _encode_state_data(state) -> Any:
+    import dataclasses
+
+    if dataclasses.is_dataclass(state):
+        return {
+            "_type": type(state).__name__,
+            **{
+                f.name: _encode(getattr(state, f.name))
+                for f in dataclasses.fields(state)
+            },
+        }
+    return repr(state)
+
+
+_DECODERS: Dict[str, Callable[[dict], Any]] = {
+    "SecureHash": lambda d: SecureHash(bytes.fromhex(d["value"])),
+    "Party": lambda d: Party(
+        d["name"], SchemePublicKey(d["scheme"], bytes.fromhex(d["key"]))
+    ),
+    "AnonymousParty": lambda d: AnonymousParty(
+        SchemePublicKey(d["scheme"], bytes.fromhex(d["key"]))
+    ),
+    "PublicKey": lambda d: SchemePublicKey(
+        d["scheme"], bytes.fromhex(d["key"])
+    ),
+    "PartyAndReference": lambda d: PartyAndReference(
+        from_json_value(d["party"]), bytes.fromhex(d["reference"])
+    ),
+    "Issued": lambda d: Issued(
+        from_json_value(d["issuer"]), from_json_value(d["product"])
+    ),
+    "Amount": lambda d: Amount(d["quantity"], from_json_value(d["token"])),
+    "StateRef": lambda d: StateRef(
+        SecureHash(bytes.fromhex(d["txhash"])), d["index"]
+    ),
+    "bytes": lambda d: bytes.fromhex(d["value"]),
+}
+
+
+def from_json_value(value: Any) -> Any:
+    if isinstance(value, dict):
+        t = value.get("_type")
+        if t in _DECODERS:
+            return _DECODERS[t](value)
+        return {k: from_json_value(v) for k, v in value.items() if k != "_type"}
+    if isinstance(value, list):
+        return [from_json_value(v) for v in value]
+    return value
+
+
+def to_json(value: Any, indent: Optional[int] = None) -> str:
+    return json.dumps(_encode(value), indent=indent)
+
+
+def from_json(text: str) -> Any:
+    return from_json_value(json.loads(text))
+
+
+# ---------------------------------------------------------------------------
+# Shell-style flow start parsing (StringToMethodCallParser equivalent)
+# ---------------------------------------------------------------------------
+
+_AMOUNT_RE = re.compile(r"^(\d+(?:\.\d+)?)\s+([A-Z]{3})$")
+
+
+def parse_argument(text: str, identity_lookup: Optional[Callable] = None) -> Any:
+    """Parse one shell argument: '100 USD' -> Amount, 'O=..' -> Party (via
+    identity_lookup), int/float/str otherwise."""
+    text = text.strip()
+    m = _AMOUNT_RE.match(text)
+    if m:
+        number, currency = m.groups()
+        return Amount.from_decimal(float(number), currency)
+    if text.startswith("O=") and identity_lookup is not None:
+        party = identity_lookup(text)
+        if party is None:
+            raise ValueError(f"unknown party {text!r}")
+        return party
+    if re.fullmatch(r"-?\d+", text):
+        return int(text)
+    if re.fullmatch(r"-?\d+\.\d+", text):
+        return float(text)
+    if text.lower() in ("true", "false"):
+        return text.lower() == "true"
+    return text
+
+
+def parse_flow_start(
+    text: str, identity_lookup: Optional[Callable] = None
+):
+    """'FlowName key: value, key: value' -> (flow_name, kwargs);
+    'FlowName v1, v2' -> (flow_name, [args])."""
+    text = text.strip()
+    if " " not in text:
+        return text, []
+    flow_name, rest = text.split(" ", 1)
+    if ":" in rest:
+        kwargs = {}
+        for part in _split_top_level(rest):
+            key, _, value = part.partition(":")
+            kwargs[key.strip()] = parse_argument(value, identity_lookup)
+        return flow_name, kwargs
+    return flow_name, [
+        parse_argument(p, identity_lookup) for p in _split_top_level(rest)
+    ]
+
+
+def _split_top_level(text: str):
+    """Split on commas that are not inside an X.500 name (O=..,L=..,C=..):
+    a chunk like 'L=London' (key=value, no colon) continues the previous
+    argument rather than starting a new one."""
+    merged: list = []
+    for chunk in text.split(","):
+        if merged and re.match(r"^\s*[A-Z]{1,2}=[^:]*$", chunk) and "=" in merged[-1]:
+            merged[-1] += "," + chunk
+        else:
+            merged.append(chunk)
+    return [p for p in merged if p.strip()]

@@ -1,0 +1,123 @@
+"""Clauses: composable contract-verification units (reference
+`core/src/main/kotlin/net/corda/core/contracts/clauses/` — Clause,
+AnyOf/AllOf/FirstOf composition, GroupClauseVerifier).
+
+A Clause matches on required commands and verifies one aspect of a
+transaction; compositions express contract logic as a tree.  `verify_clause`
+is the entry point contracts call from `Contract.verify`.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set, Type
+
+from .structures import AuthenticatedObject, TransactionVerificationError
+
+
+class Clause:
+    """One verification unit.
+
+    required_commands: command types that must ALL be present among the
+    matched commands for this clause to trigger (empty = always triggers).
+    """
+
+    required_commands: tuple = ()
+
+    def matches(self, commands: List[AuthenticatedObject]) -> bool:
+        present = {type(c.value) for c in commands}
+        return all(rc in present for rc in self.required_commands)
+
+    def verify(self, tx, inputs, outputs, commands, grouping_key) -> Set:
+        """Verify; returns the set of command VALUES matched/consumed."""
+        raise NotImplementedError
+
+    def get_execution_path(self, commands) -> List["Clause"]:
+        return [self]
+
+
+class CompositeClause(Clause):
+    def __init__(self, *clauses: Clause):
+        self.clauses = list(clauses)
+
+
+class AllOf(CompositeClause):
+    """Every child must match and verify (reference AllOf)."""
+
+    def matches(self, commands) -> bool:
+        return all(c.matches(commands) for c in self.clauses)
+
+    def verify(self, tx, inputs, outputs, commands, grouping_key) -> Set:
+        matched: Set = set()
+        for clause in self.clauses:
+            if not clause.matches(commands):
+                raise TransactionVerificationError(
+                    getattr(tx, "id", None),
+                    f"required clause {type(clause).__name__} did not match",
+                )
+            matched |= clause.verify(tx, inputs, outputs, commands, grouping_key)
+        return matched
+
+
+class AnyOf(CompositeClause):
+    """One or more children must match; all that match are verified
+    (reference AnyOf/AnyComposition)."""
+
+    def verify(self, tx, inputs, outputs, commands, grouping_key) -> Set:
+        matched: Set = set()
+        matched_any = False
+        for clause in self.clauses:
+            if clause.matches(commands):
+                matched |= clause.verify(tx, inputs, outputs, commands, grouping_key)
+                matched_any = True
+        if not matched_any:
+            raise TransactionVerificationError(
+                getattr(tx, "id", None), "no clause matched the commands"
+            )
+        return matched
+
+
+class FirstOf(CompositeClause):
+    """The first matching child verifies; error if none match
+    (reference FirstOf/FirstComposition)."""
+
+    def verify(self, tx, inputs, outputs, commands, grouping_key) -> Set:
+        for clause in self.clauses:
+            if clause.matches(commands):
+                return clause.verify(tx, inputs, outputs, commands, grouping_key)
+        raise TransactionVerificationError(
+            getattr(tx, "id", None), "no clause matched the commands"
+        )
+
+
+class GroupClauseVerifier(Clause):
+    """Applies a clause tree to each state group independently (reference
+    GroupClauseVerifier): subclass provides group_states(tx)."""
+
+    def __init__(self, clause: Clause):
+        self.clause = clause
+
+    def group_states(self, tx):
+        raise NotImplementedError
+
+    def verify(self, tx, inputs, outputs, commands, grouping_key) -> Set:
+        matched: Set = set()
+        for group in self.group_states(tx):
+            matched |= self.clause.verify(
+                tx, list(group.inputs), list(group.outputs), commands,
+                group.grouping_key,
+            )
+        return matched
+
+
+def verify_clause(tx, clause: Clause, commands: List[AuthenticatedObject]) -> None:
+    """Run a clause tree over a LedgerTransaction; every command the
+    contract declares must be matched by some clause (reference
+    verifyClause: unmatched commands are an error)."""
+    matched = clause.verify(
+        tx, tx.input_states, tx.output_states, commands, None
+    )
+    unmatched = [c.value for c in commands if c.value not in matched]
+    if unmatched:
+        raise TransactionVerificationError(
+            getattr(tx, "id", None),
+            f"commands not matched by any clause: {unmatched}",
+        )

@@ -102,6 +102,18 @@ class WaitForLedgerCommit:
     tx_id: Any  # SecureHash
 
 
+@dataclass(frozen=True)
+class RecordValue:
+    """Run `compute()` once and record its (codec-serializable) result in
+    the checkpoint IO log.  On replay-restore the recorded value is fed back
+    WITHOUT re-running compute — this is how flows capture nondeterministic
+    work (vault coin selection, random salts, fresh keys, clock reads) so
+    the deterministic-replay contract holds.  Usage:
+        stx = yield self.record(lambda: build_spend_tx(...))
+    """
+    compute: Any  # Callable[[], value]
+
+
 # ---------------------------------------------------------------------------
 # Registries + annotations
 # ---------------------------------------------------------------------------
@@ -213,24 +225,33 @@ class FlowLogic:
 
     # injected by the node's state machine before the first step
     state_machine = None
+    # per-run ordinal: 0 for the top-level flow, unique per sub_flow call.
+    # Sessions are keyed on (party, flow class, ordinal) so each sub-flow
+    # INSTANCE gets its own session, like the reference's openSessions keyed
+    # on (Party, sessionFlow instance). Deterministic across replay because
+    # sub_flow calls re-execute in the same order.
+    _ordinal = 0
 
     @classmethod
     def flow_name(cls) -> str:
         return f"{cls.__module__}.{cls.__qualname__}"
 
+    def session_owner_name(self) -> str:
+        return f"{self.flow_name()}#{self._ordinal}"
+
     # -- suspension-point constructors (user code yields these) -------------
 
     def send(self, party: Party, payload: Any) -> Send:
-        return Send(party, payload, owner_name=self.flow_name())
+        return Send(party, payload, owner_name=self.session_owner_name())
 
     def receive(self, party: Party, expected_type: type = object) -> Receive:
-        return Receive(party, expected_type, owner_name=self.flow_name())
+        return Receive(party, expected_type, owner_name=self.session_owner_name())
 
     def send_and_receive(
         self, party: Party, payload: Any, expected_type: type = object
     ) -> SendAndReceive:
         return SendAndReceive(
-            party, payload, expected_type, owner_name=self.flow_name()
+            party, payload, expected_type, owner_name=self.session_owner_name()
         )
 
     def send_and_receive_with_retry(
@@ -238,11 +259,22 @@ class FlowLogic:
     ) -> SendAndReceive:
         return SendAndReceive(
             party, payload, expected_type, retry_on_failover=True,
-            owner_name=self.flow_name(),
+            owner_name=self.session_owner_name(),
         )
 
     def wait_for_ledger_commit(self, tx_id) -> WaitForLedgerCommit:
         return WaitForLedgerCommit(tx_id)
+
+    def record(self, compute) -> RecordValue:
+        """Capture a nondeterministic computation into the checkpoint log;
+        see RecordValue."""
+        return RecordValue(compute)
+
+    @property
+    def flow_id(self) -> str:
+        """Stable unique id of this flow run — deterministic across
+        checkpoint restores (use it for soft-lock ids etc.)."""
+        return self.state_machine.flow_id
 
     def sub_flow(self, flow: "FlowLogic"):
         """Run a child flow inline, sharing this flow's state machine.
@@ -252,6 +284,7 @@ class FlowLogic:
         parent's current step.
         """
         flow.state_machine = self.state_machine
+        flow._ordinal = self.state_machine.next_subflow_ordinal()
         if (
             self.progress_tracker is not None
             and flow.progress_tracker is not None

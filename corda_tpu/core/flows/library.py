@@ -276,6 +276,14 @@ class NotifyTransactionHandler(FlowLogic):
     def call(self):
         stx = yield self.receive(self.counterparty, SignedTransaction)
         yield from self.sub_flow(ResolveTransactionsFlow(stx, self.counterparty))
+        missing_atts = [
+            h for h in stx.tx.attachments
+            if not self.service_hub.attachments.has_attachment(h)
+        ]
+        if missing_atts:
+            yield from self.sub_flow(
+                FetchAttachmentsFlow(tuple(missing_atts), self.counterparty)
+            )
         stx.verify(self.service_hub)
         self.service_hub.record_transactions([stx])
 

@@ -42,13 +42,15 @@ class FilteredTransaction:
     def build(
         wtx: WireTransaction, filter_fn: Callable[[object], bool]
     ) -> "FilteredTransaction":
-        """Reveal components matching filter_fn; prune the rest to hashes."""
-        from .wire import component_nonce
+        """Reveal components matching filter_fn; prune the rest to hashes.
+        The GROUP_SIZES leaf is always revealed so verifiers can check
+        group completeness (see ComponentGroup.GROUP_SIZES)."""
+        from .wire import ComponentGroup, component_nonce
 
         included: List[FilteredComponent] = []
         included_hashes: List[SecureHash] = []
         for group, idx, comp in wtx.available_components():
-            if filter_fn(comp):
+            if group == ComponentGroup.GROUP_SIZES or filter_fn(comp):
                 nonce = component_nonce(wtx.privacy_salt, group, idx)
                 included.append(FilteredComponent(group, idx, comp, nonce))
                 included_hashes.append(
@@ -76,8 +78,14 @@ class FilteredTransaction:
 
     def check_with_fun(self, checking_fun: Callable[[object], bool]) -> bool:
         """True if there is at least one component and every revealed component
-        satisfies checking_fun (reference FilteredTransaction.checkWithFun)."""
-        components = [fc.component for fc in self.filtered_components]
+        satisfies checking_fun (reference FilteredTransaction.checkWithFun).
+        The always-revealed GROUP_SIZES meta leaf is not a user component."""
+        from .wire import ComponentGroup
+
+        components = [
+            fc.component for fc in self.filtered_components
+            if fc.group != ComponentGroup.GROUP_SIZES
+        ]
         return bool(components) and all(checking_fun(c) for c in components)
 
     # -- typed accessors ----------------------------------------------------
@@ -118,6 +126,37 @@ class FilteredTransaction:
     def time_window(self) -> Optional[TimeWindow]:
         t = self._of_group(ComponentGroup.TIMEWINDOW)
         return t[0] if t else None
+
+    @property
+    def group_sizes(self) -> List[int]:
+        """The always-revealed per-group counts; raises if the builder
+        omitted them (a tear-off without them proves nothing about
+        completeness and must be rejected)."""
+        g = self._of_group(ComponentGroup.GROUP_SIZES)
+        if not g:
+            raise FilteredTransactionVerificationError(
+                "tear-off is missing the group-sizes leaf"
+            )
+        return list(g[0])
+
+    def check_all_inputs_revealed(self) -> None:
+        """Every input, the notary, and any time window must be revealed —
+        what a non-validating notary needs before committing (prevents a
+        hidden-input tear-off obtaining a signed double spend)."""
+        sizes = self.group_sizes
+        if len(self.inputs) != sizes[ComponentGroup.INPUTS]:
+            raise FilteredTransactionVerificationError(
+                f"tear-off reveals {len(self.inputs)} of "
+                f"{sizes[ComponentGroup.INPUTS]} inputs"
+            )
+        if sizes[ComponentGroup.NOTARY] and self.notary is None:
+            raise FilteredTransactionVerificationError(
+                "tear-off hides the notary"
+            )
+        if sizes[ComponentGroup.TIMEWINDOW] and self.time_window is None:
+            raise FilteredTransactionVerificationError(
+                "tear-off hides the time window"
+            )
 
 
 def _encode_partial(node) -> dict:

@@ -38,6 +38,11 @@ class ComponentGroup(enum.IntEnum):
     ATTACHMENTS = 3
     NOTARY = 4
     TIMEWINDOW = 5
+    # Always-revealed per-group component counts. A FilteredTransaction
+    # proves leaf INCLUSION only; without the counts a tear-off could hide
+    # inputs from a non-validating notary (signed double-spend). The counts
+    # leaf makes group completeness checkable from the tear-off alone.
+    GROUP_SIZES = 6
 
 
 def component_nonce(privacy_salt: bytes, group: int, index: int) -> SecureHash:
@@ -96,7 +101,17 @@ class WireTransaction:
             out.append((ComponentGroup.NOTARY, 0, self.notary))
         if self.time_window is not None:
             out.append((ComponentGroup.TIMEWINDOW, 0, self.time_window))
+        out.append((ComponentGroup.GROUP_SIZES, 0, self.group_sizes))
         return out
+
+    @property
+    def group_sizes(self) -> List[int]:
+        return [
+            len(self.inputs), len(self.outputs), len(self.commands),
+            len(self.attachments),
+            1 if self.notary is not None else 0,
+            1 if self.time_window is not None else 0,
+        ]
 
     def component_hashes(self) -> List[SecureHash]:
         return [

@@ -92,7 +92,7 @@ class CashIssueFlow(FlowLogic):
         self.recipient = recipient
         self.notary = notary
 
-    def call(self):
+    def _build(self):
         me = self.service_hub.my_info
         issued_amount = issued_by(self.amount, me.ref(*self.issuer_ref))
         builder = TransactionBuilder(notary=self.notary)
@@ -100,7 +100,12 @@ class CashIssueFlow(FlowLogic):
             CashState(amount=issued_amount, owner=self.recipient)
         )
         builder.add_command(CashCommand.Issue(), me.owning_key)
-        stx = self.service_hub.sign_initial_transaction(builder)
+        return self.service_hub.sign_initial_transaction(builder)
+
+    def call(self):
+        # record(): the privacy salt makes tx building nondeterministic, so
+        # the built stx is captured in the checkpoint log for replay.
+        stx = yield self.record(self._build)
         result = yield from self.sub_flow(FinalityFlow(stx))
         return result
 
@@ -113,14 +118,20 @@ class CashPaymentFlow(FlowLogic):
         self.recipient = recipient
         self.notary = notary
 
-    def call(self):
+    def _build(self, lock_id):
         builder = TransactionBuilder(notary=self.notary)
-        lock_id = str(uuid.uuid4())
+        generate_spend(
+            self.service_hub, builder, self.amount, self.recipient, lock_id
+        )
+        return self.service_hub.sign_initial_transaction(builder)
+
+    def call(self):
+        # Coin selection + salt are nondeterministic: captured via record()
+        # so a restored flow resumes with the SAME transaction. The lock id
+        # is the flow id, stable across restores.
+        lock_id = self.flow_id
         try:
-            generate_spend(
-                self.service_hub, builder, self.amount, self.recipient, lock_id
-            )
-            stx = self.service_hub.sign_initial_transaction(builder)
+            stx = yield self.record(lambda: self._build(lock_id))
             result = yield from self.sub_flow(FinalityFlow(stx))
         except Exception:
             self.service_hub.vault_service.soft_lock_release(lock_id)
@@ -135,11 +146,10 @@ class CashExitFlow(FlowLogic):
         self.amount = amount  # Amount[Issued[str]] where we are the issuer
         self.notary = notary
 
-    def call(self):
+    def _build(self, lock_id):
         hub = self.service_hub
         me = hub.my_info
         vault = hub.vault_service
-        lock_id = str(uuid.uuid4())
         candidates = [
             sr for sr in vault.unlocked_unconsumed_states(
                 CashState.contract_name, lock_id=lock_id
@@ -158,22 +168,26 @@ class CashExitFlow(FlowLogic):
                 Amount(self.amount.quantity - gathered, self.amount.token)
             )
         vault.soft_lock_reserve(lock_id, [sr.ref for sr in selected])
-        try:
-            builder = TransactionBuilder(notary=self.notary)
-            for sr in selected:
-                builder.add_input_state(sr)
-            change = gathered - self.amount.quantity
-            if change > 0:
-                builder.add_output_state(
-                    CashState(amount=Amount(change, self.amount.token), owner=me)
-                )
-            builder.add_command(
-                CashCommand.Exit(self.amount), me.owning_key
+        builder = TransactionBuilder(notary=self.notary)
+        for sr in selected:
+            builder.add_input_state(sr)
+        change = gathered - self.amount.quantity
+        if change > 0:
+            builder.add_output_state(
+                CashState(amount=Amount(change, self.amount.token), owner=me)
             )
-            stx = hub.sign_initial_transaction(builder)
+        builder.add_command(
+            CashCommand.Exit(self.amount), me.owning_key
+        )
+        return hub.sign_initial_transaction(builder)
+
+    def call(self):
+        lock_id = self.flow_id
+        try:
+            stx = yield self.record(lambda: self._build(lock_id))
             result = yield from self.sub_flow(FinalityFlow(stx))
         except Exception:
-            vault.soft_lock_release(lock_id)
+            self.service_hub.vault_service.soft_lock_release(lock_id)
             raise
         return result
 
@@ -251,26 +265,26 @@ class BuyerFlow(FlowLogic):
         yield from self.sub_flow(
             ResolveTransactionsFlow([info.asset.ref.txhash], self.counterparty)
         )
-        me = self.service_hub.my_info
-        notary = info.asset.state.notary
-        builder = TransactionBuilder(notary=notary)
-        lock_id = str(uuid.uuid4())
+        lock_id = self.flow_id
         try:
-            generate_spend(
-                self.service_hub, builder, info.price, info.seller, lock_id
-            )
-            builder.add_input_state(info.asset)
-            builder.add_output_state(
-                info.asset.state.data.with_new_owner(me)
-            )
-            builder.add_command(
-                info.asset.state.data.move_command(),
-                info.asset.state.data.owner.owning_key,
-            )
-            stx = self.service_hub.sign_initial_transaction(builder)
+            stx = yield self.record(lambda: self._build_proposal(info, lock_id))
             yield self.send(self.counterparty, stx)
             final = yield self.wait_for_ledger_commit(stx.id)
         except Exception:
             self.service_hub.vault_service.soft_lock_release(lock_id)
             raise
         return final
+
+    def _build_proposal(self, info, lock_id):
+        me = self.service_hub.my_info
+        builder = TransactionBuilder(notary=info.asset.state.notary)
+        generate_spend(
+            self.service_hub, builder, info.price, info.seller, lock_id
+        )
+        builder.add_input_state(info.asset)
+        builder.add_output_state(info.asset.state.data.with_new_owner(me))
+        builder.add_command(
+            info.asset.state.data.move_command(),
+            info.asset.state.data.owner.owning_key,
+        )
+        return self.service_hub.sign_initial_transaction(builder)

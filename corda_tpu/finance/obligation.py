@@ -102,19 +102,26 @@ class Obligation(Contract):
                 raise TransactionVerificationError(
                     tx.id, "settle must consume obligations entirely"
                 )
+            # Aggregate per (beneficiary, token): one cash output must not
+            # satisfy several obligations at once.
+            owed: dict = {}
             for ob in ins:
-                paid = Amount.sum_or_none(
-                    s.amount for s in tx.outputs_of_type(CashState)
-                    if s.owner == ob.beneficiary and s.amount.token == ob.amount.token
-                )
-                if paid is None or paid < ob.amount:
-                    raise TransactionVerificationError(
-                        tx.id,
-                        f"settlement must pay {ob.amount} to {ob.beneficiary}",
-                    )
+                key = (ob.beneficiary, ob.amount.token)
+                owed[key] = owed.get(key, 0) + ob.amount.quantity
                 if ob.obligor.owning_key.encoded not in signers:
                     raise TransactionVerificationError(
                         tx.id, "obligor must sign the settlement"
+                    )
+            for (beneficiary, token), total in owed.items():
+                paid = sum(
+                    s.amount.quantity for s in tx.outputs_of_type(CashState)
+                    if s.owner == beneficiary and s.amount.token == token
+                )
+                if paid < total:
+                    raise TransactionVerificationError(
+                        tx.id,
+                        f"settlement must pay {total} of {token} to "
+                        f"{beneficiary}, only {paid} paid",
                     )
         elif isinstance(cmd, ObligationCommand.Net):
             # Bilateral netting: totals per (obligor, beneficiary, token) must

@@ -1,0 +1,17 @@
+"""corda_tpu.loadtest: load-test harness (reference `tools/loadtest/`).
+
+Structure parity with `LoadTest.kt:40-47`: a LoadTest is
+(generate, interpret, execute, gatherRemoteState) over an abstract state S
+and command C, driven at a configurable rate with Disruption fault
+injection (`Disruption.kt:17-90`).  The TPU build drives in-process nodes
+(MockNetwork) or RPC connections instead of SSH'd JVMs.
+"""
+from .harness import LoadTest, LoadTestResult, Nodes, run_load_tests
+from .disruption import Disruption, kill_flow_storm, node_restart, clock_skew
+from .tests import NotaryLoadTest, SelfIssueLoadTest, StabilityLoadTest
+
+__all__ = [
+    "LoadTest", "LoadTestResult", "Nodes", "run_load_tests",
+    "Disruption", "kill_flow_storm", "node_restart", "clock_skew",
+    "NotaryLoadTest", "SelfIssueLoadTest", "StabilityLoadTest",
+]

@@ -1,0 +1,92 @@
+"""Disruptions: fault injection during load tests (reference
+`tools/loadtest/src/main/kotlin/net/corda/loadtest/Disruption.kt:17-90` —
+hang via SIGSTOP, restart, kill, deleteDb, CPU strain).
+
+In-process equivalents: drop a node's messages (partition), restart a node
+from its DB, skew its clock.  Each Disruption fires probabilistically per
+iteration and can heal itself.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Optional
+
+
+class Disruption:
+    def __init__(self, name: str, fire: Callable, heal: Optional[Callable] = None,
+                 probability: float = 0.2, heal_after: int = 2):
+        self.name = name
+        self._fire = fire
+        self._heal = heal
+        self.probability = probability
+        self.heal_after = heal_after
+        self._fired_at: Optional[int] = None
+
+    def maybe_fire(self, rng: random.Random, nodes, iteration: int) -> None:
+        if self._fired_at is None and rng.random() < self.probability:
+            self._fire(rng, nodes)
+            self._fired_at = iteration
+
+    def maybe_heal(self, rng: random.Random, nodes, iteration: int) -> None:
+        if (
+            self._fired_at is not None
+            and self._heal is not None
+            and iteration - self._fired_at >= self.heal_after
+        ):
+            self._heal(rng, nodes)
+            self._fired_at = None
+
+
+def node_restart(pick=lambda rng, nodes: rng.choice(nodes.nodes)) -> Disruption:
+    """Stop a (non-notary) node's endpoint and bring it back: in-flight
+    messages to it are dropped, flows restore from checkpoints (the
+    'restart' disruption, Disruption.kt nodeRestart)."""
+    state = {}
+
+    def fire(rng, nodes):
+        node = pick(rng, nodes)
+        state["node"] = node
+        node.network.running = False
+
+    def heal(rng, nodes):
+        node = state.pop("node", None)
+        if node is not None:
+            node.network.running = True
+            node.smm.start()  # restore checkpoints
+
+    return Disruption("node-restart", fire, heal)
+
+
+def kill_flow_storm(probability: float = 0.1) -> Disruption:
+    """Drop a burst of in-flight messages (the 'hang' analogue)."""
+
+    def fire(rng, nodes):
+        net = nodes.network.messaging_network
+        dropped = 0
+        with net._lock:
+            n = len(net._queue)
+            keep = [m for m in net._queue if rng.random() > 0.3]
+            dropped = n - len(keep)
+            net._queue.clear()
+            net._queue.extend(keep)
+        return dropped
+
+    return Disruption("message-drop", fire, probability=probability)
+
+
+def clock_skew(delta_s: float = 3600.0) -> Disruption:
+    """Skew a node's clock forward (time-window failures downstream)."""
+    state = {}
+
+    def fire(rng, nodes):
+        node = rng.choice(nodes.nodes)
+        original = node.services.clock
+        state["node"], state["clock"] = node, original
+        node.services.clock = lambda: original() + delta_s
+
+    def heal(rng, nodes):
+        node = state.pop("node", None)
+        if node is not None:
+            node.services.clock = state.pop("clock")
+
+    return Disruption("clock-skew", fire, heal)

@@ -1,0 +1,124 @@
+"""LoadTest core (reference `tools/loadtest/.../LoadTest.kt`).
+
+A LoadTest[S, C]:
+  * generate(state, parallelism) -> Generator of command batches
+  * interpret(state, command) -> next predicted state
+  * execute(nodes, command) -> run it against the system
+  * gather(nodes) -> observed state
+After the run, predicted and observed state are compared — divergence is a
+consistency failure (the CrossCash invariant check pattern).
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..testing.generator import Generator
+
+
+@dataclass
+class Nodes:
+    """The system under test: in-process MockNetwork nodes."""
+    network: Any  # MockNetwork
+    notary: Any
+    nodes: List[Any]
+
+    def pump(self) -> None:
+        self.network.run_network()
+
+
+@dataclass
+class LoadTestResult:
+    name: str
+    commands_executed: int
+    duration_s: float
+    errors: List[str]
+    consistent: bool
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def commands_per_sec(self) -> float:
+        return self.commands_executed / self.duration_s if self.duration_s else 0.0
+
+
+class LoadTest:
+    """Subclass and implement the four hooks (reference LoadTest.kt)."""
+
+    name = "load-test"
+
+    def setup(self, nodes: Nodes) -> Any:
+        """Initial predicted state."""
+        raise NotImplementedError
+
+    def generate(self, state: Any, parallelism: int) -> Generator:
+        raise NotImplementedError
+
+    def interpret(self, state: Any, command: Any) -> Any:
+        raise NotImplementedError
+
+    def execute(self, nodes: Nodes, command: Any) -> None:
+        raise NotImplementedError
+
+    def gather(self, nodes: Nodes) -> Any:
+        raise NotImplementedError
+
+    def compare(self, predicted: Any, observed: Any) -> bool:
+        return predicted == observed
+
+    # -- driver --------------------------------------------------------------
+
+    def run(
+        self,
+        nodes: Nodes,
+        iterations: int = 20,
+        parallelism: int = 10,
+        seed: int = 0,
+        disruptions: Optional[list] = None,
+        gather_frequency: int = 5,
+    ) -> LoadTestResult:
+        rng = random.Random(seed)
+        state = self.setup(nodes)
+        errors: List[str] = []
+        executed = 0
+        consistent = True
+        t0 = time.perf_counter()
+        for i in range(iterations):
+            batch = self.generate(state, parallelism).generate(rng)
+            for disruption in disruptions or []:
+                disruption.maybe_fire(rng, nodes, i)
+            for command in batch:
+                try:
+                    self.execute(nodes, command)
+                    state = self.interpret(state, command)
+                    executed += 1
+                except Exception as exc:
+                    errors.append(f"iter {i}: {exc}")
+            nodes.pump()
+            for disruption in disruptions or []:
+                disruption.maybe_heal(rng, nodes, i)
+            if (i + 1) % gather_frequency == 0:
+                observed = self.gather(nodes)
+                if not self.compare(state, observed):
+                    consistent = False
+                    errors.append(
+                        f"iter {i}: divergence predicted={state!r} "
+                        f"observed={observed!r}"
+                    )
+        duration = time.perf_counter() - t0
+        observed = self.gather(nodes)
+        if not self.compare(state, observed):
+            consistent = False
+            errors.append(
+                f"final divergence predicted={state!r} observed={observed!r}"
+            )
+        return LoadTestResult(
+            self.name, executed, duration, errors, consistent
+        )
+
+
+def run_load_tests(
+    tests: List[LoadTest], nodes: Nodes, **kwargs
+) -> List[LoadTestResult]:
+    return [t.run(nodes, **kwargs) for t in tests]

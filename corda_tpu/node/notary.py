@@ -297,6 +297,14 @@ class NotaryServiceFlow(FlowLogic):
             resolved = yield from self.sub_flow(
                 ResolveTransactionsFlow(stx, self.counterparty)
             )
+            missing_atts = [
+                h for h in stx.tx.attachments
+                if not self.service_hub.attachments.has_attachment(h)
+            ]
+            if missing_atts:
+                yield from self.sub_flow(
+                    FetchAttachmentsFlow(tuple(missing_atts), self.counterparty)
+                )
             try:
                 stx.verify(self.service_hub, check_sufficient_signatures=False)
             except Exception as exc:
@@ -307,9 +315,15 @@ class NotaryServiceFlow(FlowLogic):
         if ftx is None:
             raise NotaryException("non-validating notary requires a tear-off")
         ftx.verify()  # Merkle proof against the root = tx id
+        # Completeness: a tear-off hiding inputs must not obtain a signature
+        # (it would leave the hidden inputs spendable again).
+        ftx.check_all_inputs_revealed()
         return ftx.id, list(ftx.inputs), ftx.time_window
 
 
-# Imported lazily to avoid a cycle at module load; ResolveTransactionsFlow
-# lives with the other core library flows.
-from ..core.flows.library import ResolveTransactionsFlow  # noqa: E402
+# Imported lazily to avoid a cycle at module load; these flows live with
+# the other core library flows.
+from ..core.flows.library import (  # noqa: E402
+    FetchAttachmentsFlow,
+    ResolveTransactionsFlow,
+)

@@ -66,8 +66,10 @@ class RaftNode:
         self.apply_fn = apply_fn
         self._rand = random.Random(seed if seed is not None else node_id)
         self._lock = threading.RLock()
-        # persistent state
+        # persistent state: meta (term/vote) + one KV row per log entry, so
+        # heartbeats cost nothing and appends are O(1), not O(log).
         self._meta = KVStore(db, "raft_meta") if db is not None else None
+        self._log_store = KVStore(db, "raft_log") if db is not None else None
         self.current_term = 0
         self.voted_for: Optional[str] = None
         self.log: List[LogEntry] = []
@@ -91,6 +93,10 @@ class RaftNode:
 
     # -- persistence ---------------------------------------------------------
 
+    @staticmethod
+    def _log_key(index: int) -> bytes:
+        return index.to_bytes(8, "big")
+
     def _load_persistent(self) -> None:
         term = self._meta.get(b"term")
         if term is not None:
@@ -98,18 +104,31 @@ class RaftNode:
         vote = self._meta.get(b"voted_for")
         if vote is not None:
             self.voted_for = deserialize(vote)
-        log = self._meta.get(b"log")
-        if log is not None:
-            self.log = [LogEntry(t, c) for t, c in deserialize(log)]
+        rows = sorted(self._log_store.items(), key=lambda kv: kv[0])
+        self.log = [
+            LogEntry(*deserialize(v)) for _, v in rows
+        ]
 
-    def _persist(self) -> None:
+    def _persist_meta(self) -> None:
         if self._meta is None:
             return
         self._meta.put(b"term", serialize(self.current_term))
         self._meta.put(b"voted_for", serialize(self.voted_for))
-        self._meta.put(
-            b"log", serialize([[e.term, e.command] for e in self.log])
-        )
+
+    def _persist_log_from(self, start: int) -> None:
+        """Write log rows [start:); callers handle truncation separately."""
+        if self._log_store is None:
+            return
+        for i in range(start, len(self.log)):
+            e = self.log[i]
+            self._log_store.put(self._log_key(i), serialize([e.term, e.command]))
+
+    def _persist_log_truncate(self, from_index: int) -> None:
+        if self._log_store is None:
+            return
+        for k, _ in list(self._log_store.items()):
+            if int.from_bytes(k, "big") >= from_index:
+                self._log_store.delete(k)
 
     # -- public API ----------------------------------------------------------
 
@@ -128,7 +147,7 @@ class RaftNode:
             request_id = command.get("request_id") or f"{self.node_id}:{len(self.log)}:{self.current_term}"
             command = dict(command, request_id=request_id)
             self.log.append(LogEntry(self.current_term, command))
-            self._persist()
+            self._persist_log_from(len(self.log) - 1)
             self._pending[request_id] = fut
             # Single-node cluster commits immediately.
             self._advance_commit()
@@ -177,7 +196,7 @@ class RaftNode:
         self.voted_for = None
         self._votes.clear()
         self._fail_pending(NotLeaderError(None))
-        self._persist()
+        self._persist_meta()
         self._reset_election_deadline()
 
     def _start_election(self) -> None:
@@ -186,7 +205,7 @@ class RaftNode:
         self.voted_for = self.node_id
         self._votes = {self.node_id}
         self.leader_id = None
-        self._persist()
+        self._persist_meta()
         self._reset_election_deadline()
         last_term = self.log[-1].term if self.log else -1
         for peer in self.peer_ids:
@@ -211,7 +230,7 @@ class RaftNode:
             if up_to_date:
                 grant = True
                 self.voted_for = sender_id
-                self._persist()
+                self._persist_meta()
                 self._reset_election_deadline()
         self._send(sender_id, {
             "kind": "vote", "term": self.current_term, "granted": grant,
@@ -270,21 +289,34 @@ class RaftNode:
             return
         # Truncate conflicts, append new entries.
         idx = prev_index + 1
+        first_change: Optional[int] = None
+        truncated = False
         for term, command in msg["entries"]:
             if idx < len(self.log):
                 if self.log[idx].term != term:
                     del self.log[idx:]
                     self.log.append(LogEntry(term, command))
+                    truncated = True
+                    if first_change is None:
+                        first_change = idx
             else:
                 self.log.append(LogEntry(term, command))
+                if first_change is None:
+                    first_change = idx
             idx += 1
-        self._persist()
+        if first_change is not None:
+            if truncated:
+                self._persist_log_truncate(first_change)
+            self._persist_log_from(first_change)
         if msg["commit_index"] > self.commit_index:
             self.commit_index = min(msg["commit_index"], len(self.log) - 1)
             self._apply_committed()
+        # match up to what THIS append covered — not our whole log, which may
+        # carry an uncommitted tail from a deposed leader beyond the new
+        # leader's log (overstating would crash the leader's next send).
         self._send(sender_id, {
             "kind": "append_reply", "term": self.current_term,
-            "ok": True, "match_index": len(self.log) - 1,
+            "ok": True, "match_index": prev_index + len(msg["entries"]),
         })
 
     def _on_append_reply(self, sender_id: str, msg: dict) -> None:

@@ -1,0 +1,93 @@
+"""Interactive node shell (reference `node/.../shell/InteractiveShell.kt` —
+CRaSH replaced by the stdlib cmd module).
+
+Commands:
+    flow start <FlowName> [key: value, ...]
+    flow list
+    flow watch
+    run <rpc_method> [args...]
+    vault [contract]
+    network
+    bye
+"""
+from __future__ import annotations
+
+import cmd
+import shlex
+import sys
+from typing import Optional
+
+from ..client.jackson import parse_flow_start, to_json
+from ..core.flows.api import flow_registry
+
+
+class InteractiveShell(cmd.Cmd):
+    intro = "corda_tpu shell. Type help or ? to list commands."
+    prompt = ">>> "
+
+    def __init__(self, ops, stdout=None, pump=None):
+        super().__init__(stdout=stdout or sys.stdout)
+        self.ops = ops
+        self._pump = pump  # MockNetwork pump for in-process demos
+
+    def _println(self, text: str) -> None:
+        self.stdout.write(text + "\n")
+
+    # -- commands ------------------------------------------------------------
+
+    def do_flow(self, line: str) -> None:
+        """flow start <FlowName> [args] | flow list | flow watch"""
+        sub, _, rest = line.partition(" ")
+        if sub == "list":
+            for name, cls in sorted(flow_registry.items()):
+                if getattr(cls, "_startable_by_rpc", False):
+                    self._println(name)
+        elif sub == "start":
+            try:
+                flow_name, args = parse_flow_start(
+                    rest, identity_lookup=self.ops.party_from_name
+                )
+                if isinstance(args, dict):
+                    flow_id = self.ops.start_flow_dynamic(flow_name, **args)
+                else:
+                    flow_id = self.ops.start_flow_dynamic(flow_name, *args)
+                if self._pump is not None:
+                    self._pump()
+                result = self.ops.flow_result(flow_id, timeout=30)
+                self._println(f"flow {flow_id} returned: {result!r}")
+            except Exception as exc:
+                self._println(f"error: {exc}")
+        elif sub == "watch":
+            feed = self.ops.state_machines_feed()
+            for info in feed.snapshot:
+                self._println(f"{info.flow_id} {info.flow_name} running")
+        else:
+            self._println("usage: flow start|list|watch")
+
+    def do_run(self, line: str) -> None:
+        """run <rpc_method> [simple args...]"""
+        parts = shlex.split(line)
+        if not parts:
+            self._println("usage: run <method> [args]")
+            return
+        method, args = parts[0], parts[1:]
+        try:
+            result = getattr(self.ops, method)(*args)
+            self._println(to_json(result, indent=2))
+        except Exception as exc:
+            self._println(f"error: {exc}")
+
+    def do_vault(self, line: str) -> None:
+        """vault [contract_name]"""
+        states = self.ops.vault_query(line.strip() or None)
+        self._println(to_json(states, indent=2))
+
+    def do_network(self, line: str) -> None:
+        """network — show the network map"""
+        self._println(to_json(self.ops.network_map_snapshot(), indent=2))
+
+    def do_bye(self, line: str) -> bool:
+        """bye — exit the shell"""
+        return True
+
+    do_EOF = do_bye

@@ -27,6 +27,7 @@ from ..core.flows.api import (
     FlowException,
     FlowLogic,
     Receive,
+    RecordValue,
     Send,
     SendAndReceive,
     WaitForLedgerCommit,
@@ -103,6 +104,13 @@ class FlowStateMachine:
         self.done = False
         self._gen = None
         self._session_counter = len(self.sessions)
+        # sub_flow instance ordinals: reset at construction so replay hands
+        # out the same sequence (sub_flow calls re-execute in order).
+        self._subflow_counter = 0
+
+    def next_subflow_ordinal(self) -> int:
+        self._subflow_counter += 1
+        return self._subflow_counter
 
     # -- service access used by FlowLogic -----------------------------------
 
@@ -174,7 +182,19 @@ class FlowStateMachine:
             return self._io_receive(req.party, req.expected_type, req.owner_name)
         if isinstance(req, WaitForLedgerCommit):
             return self._io_wait_ledger(req.tx_id)
+        if isinstance(req, RecordValue):
+            return self._io_record(req)
         raise TypeError(f"flow yielded a non-FlowIORequest: {req!r}")
+
+    def _io_record(self, req: RecordValue):
+        if self.replaying:
+            blob = self.io_log[self.replay_pos]
+            self.replay_pos += 1
+            return deserialize(blob)
+        value = req.compute()
+        self.io_log.append(serialize(value))
+        self._checkpoint()
+        return value
 
     # -- IO implementation --------------------------------------------------
 
@@ -188,14 +208,29 @@ class FlowStateMachine:
         key = self._session_key(party, owner_name)
         local_id = self.session_keys.get(key)
         if local_id is not None:
-            return self.sessions[local_id]
+            sess = self.sessions[local_id]
+            drained = sess.recv_seq not in sess.inbox
+            dead = sess.state is SessionState.ENDED or (
+                sess.ended_by_peer and drained
+            )
+            if not dead:
+                return sess
+            if sess.end_error:
+                # The peer errored; reusing the channel is a flow error the
+                # author can catch, not a silent new exchange.
+                raise self._peer_end_exception(sess)
+            # Clean end: the previous exchange with this (party, flow class)
+            # completed. Retire the key so a NEW sub_flow instance opens a
+            # fresh session (reference keys sessions per sub-flow instance).
+            del self.session_keys[key]
         if not create:
             raise FlowSessionException(f"no session with {party.name}")
-        flow_cls = flow_registry.get(owner_name)
+        registered_name = owner_name.split("#", 1)[0]
+        flow_cls = flow_registry.get(registered_name)
         if flow_cls is None or not getattr(flow_cls, "_initiating", False):
             raise FlowException(
-                f"{owner_name} is not an @initiating_flow but tried to open "
-                f"a session with {party.name}"
+                f"{registered_name} is not an @initiating_flow but tried to "
+                f"open a session with {party.name}"
             )
         local_id = f"{self.flow_id}:{self._session_counter}"
         self._session_counter += 1
@@ -213,7 +248,7 @@ class FlowStateMachine:
             party,
             SessionInit(
                 initiator_session_id=local_id,
-                flow_name=owner_name,
+                flow_name=registered_name,
                 flow_version=getattr(flow_cls, "_flow_version", 1),
                 first_payload=first_payload,
             ),
@@ -225,10 +260,10 @@ class FlowStateMachine:
             return  # already sent before the checkpoint we restored from
         blob = serialize(payload)
         key = self._session_key(party, owner_name)
-        if key not in self.session_keys:
-            self._session_for(party, owner_name, first_payload=blob)
-            return
-        sess = self.sessions[self.session_keys[key]]
+        before = self.session_keys.get(key)
+        sess = self._session_for(party, owner_name, first_payload=blob)
+        if self.session_keys.get(key) != before:
+            return  # fresh session: the payload rode the SessionInit
         if sess.state is SessionState.INITIATING:
             sess.outbox.append(blob)
             sess.send_seq += 1
@@ -475,7 +510,7 @@ class StateMachineManager:
                 # Re-announce: the pre-crash init may have been lost.  The
                 # responder dedups by initiator session id; the init payload
                 # (seq 0) rides again from its persisted copy.
-                owner = fsm.session_owner_flows[local_id]
+                owner = fsm.session_owner_flows[local_id].split("#", 1)[0]
                 owner_cls = flow_registry.get(owner)
                 self.messaging.send(
                     sess.peer, SESSION_TOPIC,
@@ -538,9 +573,9 @@ class StateMachineManager:
         if msg.first_payload is not None:
             sess.inbox[0] = msg.first_payload
         fsm.sessions[local_id] = sess
-        key = fsm._session_key(sender, responder_cls.flow_name())
+        key = fsm._session_key(sender, flow.session_owner_name())
         fsm.session_keys[key] = local_id
-        fsm.session_owner_flows[local_id] = responder_cls.flow_name()
+        fsm.session_owner_flows[local_id] = flow.session_owner_name()
         self.flows[flow_id] = fsm
         self._register_session(local_id, fsm)
         self._initiated_dedup[dedup_key] = local_id
@@ -604,6 +639,8 @@ class StateMachineManager:
             return
         sess.ended_by_peer = True
         sess.end_error = msg.error
+        if sess.recv_seq not in sess.inbox:
+            sess.state = SessionState.ENDED
         fsm.deliver_session_end(sess)
 
     # -- internals ----------------------------------------------------------

@@ -1,0 +1,8 @@
+"""corda_tpu.samples: runnable demos (reference `samples/`).
+
+Each module has a `main()` and runs as `python -m corda_tpu.samples.<name>`:
+  * trader_demo      — DvP: bank issues cash, buyer buys commercial paper
+  * notary_demo      — N transactions notarised incl. a double-spend rejection
+  * bank_of_corda    — issuer node servicing cash-issue requests
+  * attachment_demo  — send a transaction with an attachment, fetch it back
+"""

@@ -1,0 +1,107 @@
+"""Bank-of-Corda demo (reference `samples/bank-of-corda/`): an issuer node
+services cash-issue requests from other parties via an issuer flow pair."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.contracts import Amount
+from ..core.flows import FlowException, FlowLogic, initiated_by, initiating_flow
+from ..core.identity import Party
+from ..core.serialization.codec import register_adapter
+from ..finance import CashIssueFlow, CashState
+from ..testing import MockNetwork
+
+
+@dataclass(frozen=True)
+class IssueRequest:
+    amount: Amount
+    issuer_ref: bytes
+
+
+register_adapter(
+    IssueRequest, "IssueRequest",
+    lambda r: {"amount": r.amount, "ref": r.issuer_ref},
+    lambda d: IssueRequest(d["amount"], d["ref"]),
+)
+
+
+@initiating_flow
+class IssuanceRequester(FlowLogic):
+    """Ask the bank to issue cash to us (reference IssuerFlow.IssuanceRequester)."""
+
+    def __init__(self, bank: Party, amount: Amount, issuer_ref: bytes = b"\x01"):
+        self.bank = bank
+        self.amount = amount
+        self.issuer_ref = issuer_ref
+
+    def call(self):
+        confirmation = yield self.send_and_receive(
+            self.bank, IssueRequest(self.amount, self.issuer_ref), bytes
+        )
+        if confirmation != b"issued":
+            raise FlowException(f"bank refused: {confirmation!r}")
+        return confirmation
+
+
+@initiated_by(IssuanceRequester)
+class IssuerFlow(FlowLogic):
+    """Bank side: validate and run the actual CashIssueFlow (reference
+    IssuerFlow.Issuer)."""
+
+    MAX_ISSUE = 1_000_000_00
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        request = yield self.receive(self.counterparty, IssueRequest)
+        if request.amount.quantity > self.MAX_ISSUE:
+            raise FlowException("issuance cap exceeded")
+        notary = self.service_hub.network_map_cache.get_notary()
+        result = yield from self.sub_flow(
+            CashIssueFlow(
+                request.amount, request.issuer_ref, self.counterparty, notary
+            )
+        )
+        yield self.send(self.counterparty, b"issued")
+        return result
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    net = MockNetwork()
+    net.create_notary_node(validating=True)
+    bank = net.create_node("O=BankOfCorda,L=London,C=GB")
+    alice = net.create_node("O=BigCorporation,L=New York,C=US")
+
+    log("requesting $1,000 issuance from the bank...")
+    h = alice.start_flow(
+        IssuanceRequester(bank.info, Amount(1_000_00, "USD")), bank.info
+    )
+    net.run_network()
+    h.result.result(timeout=10)
+    states = alice.services.vault_service.unconsumed_states(
+        CashState.contract_name
+    )
+    total = sum(sr.state.data.amount.quantity for sr in states)
+    log(f"alice now holds {total} cents of issued USD")
+
+    log("requesting an over-cap issuance (should be refused)...")
+    h2 = alice.start_flow(
+        IssuanceRequester(bank.info, Amount(9_999_999_00, "USD")), bank.info
+    )
+    net.run_network()
+    refused = False
+    try:
+        h2.result.result(timeout=10)
+    except FlowException:
+        refused = True
+    log(f"over-cap refused: {refused}")
+
+    net.stop_nodes()
+    assert total == 1_000_00 and refused
+    return {"issued": total, "over_cap_refused": refused}
+
+
+if __name__ == "__main__":
+    main()

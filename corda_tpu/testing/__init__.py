@@ -1,4 +1,14 @@
 """corda_tpu.testing: test infrastructure (reference `test-utils/`)."""
+from .expect import ExpectRecorder
+from .generated_ledger import GeneratedLedger, generate_ledger, ledger_generator
+from .generator import Generator
+from .ledger_dsl import LedgerDSL, TransactionDSL, ledger
 from .mocknetwork import MockNetwork, MockNode
 
-__all__ = ["MockNetwork", "MockNode"]
+__all__ = [
+    "ExpectRecorder",
+    "GeneratedLedger", "generate_ledger", "ledger_generator",
+    "Generator",
+    "LedgerDSL", "TransactionDSL", "ledger",
+    "MockNetwork", "MockNode",
+]

@@ -1,0 +1,63 @@
+"""Expect DSL: assert on event streams (reference
+`test-utils/src/main/kotlin/net/corda/testing/Expect.kt`).
+
+    events = ExpectRecorder(observable)
+    ... drive the system ...
+    events.expect(lambda e: e.done, "a finished event")
+    events.expect_sequence(pred1, pred2)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class ExpectRecorder:
+    def __init__(self, observable=None):
+        self.events: List = []
+        self._lock = threading.Lock()
+        if observable is not None:
+            observable.subscribe(self.record)
+
+    def record(self, event) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def expect(
+        self, predicate: Callable, description: str = "event",
+        timeout: float = 5.0,
+    ):
+        """Wait until some recorded event satisfies predicate; return it."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                for e in self.events:
+                    if predicate(e):
+                        return e
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    seen = list(self.events)
+                raise AssertionError(
+                    f"expected {description}; saw {len(seen)} events: {seen!r}"
+                )
+            time.sleep(0.01)
+
+    def expect_sequence(self, *predicates: Callable, timeout: float = 5.0):
+        """The predicates must match a subsequence of events, in order."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                events = list(self.events)
+            i = 0
+            for e in events:
+                if i < len(predicates) and predicates[i](e):
+                    i += 1
+            if i == len(predicates):
+                return
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"matched {i}/{len(predicates)} expected events; "
+                    f"saw: {events!r}"
+                )
+            time.sleep(0.01)

@@ -1,0 +1,89 @@
+"""Generator: monadic random-data generator (reference
+`client/mock/src/main/kotlin/net/corda/client/mock/Generator.kt` — the
+property-style generator used by verifier tests and loadtest).
+
+    g = Generator.int_range(0, 10).bind(
+            lambda n: Generator.list_of(Generator.choice("abc"), n))
+    value = g.generate(random.Random(42))
+"""
+from __future__ import annotations
+
+import random
+import string
+from typing import Callable, Generic, List, Sequence, TypeVar
+
+A = TypeVar("A")
+B = TypeVar("B")
+
+
+class Generator(Generic[A]):
+    def __init__(self, fn: Callable[[random.Random], A]):
+        self._fn = fn
+
+    def generate(self, rng: random.Random) -> A:
+        return self._fn(rng)
+
+    # -- monad ---------------------------------------------------------------
+
+    @staticmethod
+    def pure(value: A) -> "Generator[A]":
+        return Generator(lambda rng: value)
+
+    def map(self, f: Callable[[A], B]) -> "Generator[B]":
+        return Generator(lambda rng: f(self._fn(rng)))
+
+    def bind(self, f: Callable[[A], "Generator[B]"]) -> "Generator[B]":
+        return Generator(lambda rng: f(self._fn(rng)).generate(rng))
+
+    @staticmethod
+    def sequence(gens: Sequence["Generator"]) -> "Generator[list]":
+        return Generator(lambda rng: [g.generate(rng) for g in gens])
+
+    @staticmethod
+    def zip2(ga: "Generator[A]", gb: "Generator[B]") -> "Generator[tuple]":
+        return Generator(lambda rng: (ga.generate(rng), gb.generate(rng)))
+
+    # -- primitives ----------------------------------------------------------
+
+    @staticmethod
+    def int_range(lo: int, hi: int) -> "Generator[int]":
+        return Generator(lambda rng: rng.randint(lo, hi))
+
+    @staticmethod
+    def choice(options: Sequence[A]) -> "Generator[A]":
+        return Generator(lambda rng: rng.choice(list(options)))
+
+    @staticmethod
+    def frequency(weighted: Sequence[tuple]) -> "Generator[A]":
+        """[(weight, generator)] — pick by weight, then generate."""
+        gens = [g for _, g in weighted]
+        weights = [w for w, _ in weighted]
+        return Generator(
+            lambda rng: rng.choices(gens, weights=weights, k=1)[0].generate(rng)
+        )
+
+    @staticmethod
+    def list_of(gen: "Generator[A]", size: int) -> "Generator[List[A]]":
+        return Generator(lambda rng: [gen.generate(rng) for _ in range(size)])
+
+    @staticmethod
+    def sized_list_of(gen: "Generator[A]", lo: int, hi: int) -> "Generator[List[A]]":
+        return Generator(
+            lambda rng: [gen.generate(rng) for _ in range(rng.randint(lo, hi))]
+        )
+
+    @staticmethod
+    def bytes_of(size: int) -> "Generator[bytes]":
+        return Generator(lambda rng: rng.randbytes(size))
+
+    @staticmethod
+    def string(size: int = 8) -> "Generator[str]":
+        return Generator(
+            lambda rng: "".join(
+                rng.choice(string.ascii_letters) for _ in range(size)
+            )
+        )
+
+    @staticmethod
+    def pick_n(options: Sequence[A], n: int) -> "Generator[List[A]]":
+        return Generator(lambda rng: rng.sample(list(options), n))

@@ -1,0 +1,163 @@
+"""Ledger/transaction test DSL (reference `test-utils/.../TestDSL.kt` +
+`LedgerDSLInterpreter.kt`: the `ledger { transaction { ... verifies() } }`
+pattern every reference contract test uses).
+
+    with ledger(notary=NOTARY) as l:
+        with l.transaction() as tx:
+            tx.output("out1", CashState(...))
+            tx.command(bank_key, CashCommand.Issue())
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("out1")
+            tx.output("out2", CashState(...))
+            tx.command(alice_key, CashCommand.Move())
+            tx.fails_with("not conserved")
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.contracts.structures import (
+    Attachment,
+    Command,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+)
+from ..core.crypto.secure_hash import SecureHash
+from ..core.identity import Party
+from ..core.transactions.builder import TransactionBuilder
+from ..core.transactions.wire import WireTransaction
+
+
+class DSLError(AssertionError):
+    pass
+
+
+class LedgerDSL:
+    """Holds labelled outputs across transactions."""
+
+    def __init__(self, notary: Party):
+        self.notary = notary
+        self._labelled: Dict[str, StateAndRef] = {}
+        self._transactions: List[WireTransaction] = []
+        self._attachments: Dict[SecureHash, Attachment] = {}
+
+    def transaction(self, label: Optional[str] = None) -> "TransactionDSL":
+        return TransactionDSL(self, label)
+
+    def attachment(self, data: bytes) -> SecureHash:
+        att = Attachment.of(data)
+        self._attachments[att.id] = att
+        return att.id
+
+    def retrieve_output(self, label: str) -> StateAndRef:
+        if label not in self._labelled:
+            raise DSLError(f"no output labelled {label!r}")
+        return self._labelled[label]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _resolve(self, ref: StateRef) -> TransactionState:
+        for wtx in self._transactions:
+            if wtx.id == ref.txhash:
+                return wtx.outputs[ref.index]
+        raise DSLError(f"cannot resolve {ref}")
+
+
+class TransactionDSL:
+    def __init__(self, ledger_dsl: LedgerDSL, label: Optional[str]):
+        self.ledger = ledger_dsl
+        self.label = label
+        self._builder = TransactionBuilder(notary=ledger_dsl.notary)
+        self._output_labels: List[Optional[str]] = []
+        self._verified = False
+
+    # -- building ------------------------------------------------------------
+
+    def input(self, label_or_state_and_ref) -> "TransactionDSL":
+        if isinstance(label_or_state_and_ref, str):
+            snr = self.ledger.retrieve_output(label_or_state_and_ref)
+        else:
+            snr = label_or_state_and_ref
+        self._builder.add_input_state(snr)
+        return self
+
+    def output(self, label=None, state=None, notary=None) -> "TransactionDSL":
+        if state is None:  # allow output(state) positional style
+            label, state = None, label
+        self._builder.add_output_state(state, notary=notary)
+        self._output_labels.append(label)
+        return self
+
+    def command(self, *keys_then_value) -> "TransactionDSL":
+        *keys, value = keys_then_value
+        self._builder.add_command(value, *keys)
+        return self
+
+    def attachment(self, att_id: SecureHash) -> "TransactionDSL":
+        self._builder.add_attachment(att_id)
+        return self
+
+    def time_window(self, tw: TimeWindow) -> "TransactionDSL":
+        self._builder.set_time_window(tw)
+        return self
+
+    # -- assertions ----------------------------------------------------------
+
+    def _to_ledger_transaction(self):
+        wtx = self._builder.to_wire_transaction()
+        return wtx, wtx.to_ledger_transaction(
+            resolve_state=self.ledger._resolve,
+            resolve_attachment=lambda h: self.ledger._attachments[h],
+        )
+
+    def verifies(self) -> "TransactionDSL":
+        wtx, ltx = self._to_ledger_transaction()
+        ltx.verify()
+        self._commit(wtx)
+        return self
+
+    def fails(self) -> "TransactionDSL":
+        _, ltx = self._to_ledger_transaction()
+        try:
+            ltx.verify()
+        except Exception:
+            return self
+        raise DSLError("expected verification to fail, but it passed")
+
+    def fails_with(self, substring: str) -> "TransactionDSL":
+        _, ltx = self._to_ledger_transaction()
+        try:
+            ltx.verify()
+        except Exception as exc:
+            if substring.lower() not in str(exc).lower():
+                raise DSLError(
+                    f"expected failure containing {substring!r}, got: {exc}"
+                )
+            return self
+        raise DSLError("expected verification to fail, but it passed")
+
+    def _commit(self, wtx: WireTransaction) -> None:
+        if self._verified:
+            return
+        self._verified = True
+        self.ledger._transactions.append(wtx)
+        for idx, label in enumerate(self._output_labels):
+            if label is not None:
+                self.ledger._labelled[label] = wtx.out_ref(idx)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def ledger(notary: Party) -> LedgerDSL:
+    return LedgerDSL(notary)

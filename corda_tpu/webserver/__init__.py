@@ -1,0 +1,6 @@
+"""corda_tpu.webserver: HTTP/REST API server over RPC (reference
+`webserver/` — the standalone Jetty/Jersey server that talks RPC to a
+node)."""
+from .server import WebServer
+
+__all__ = ["WebServer"]

@@ -1,0 +1,121 @@
+"""HTTP API server bridging REST to the node's RPC surface (reference
+`webserver/src/main/kotlin/net/corda/webserver/` — Jetty/Jersey replaced by
+the stdlib http.server on a background thread).
+
+Endpoints (reference servlet/resource parity):
+  GET  /api/status                       -> "started"
+  GET  /api/info                         -> node identity
+  GET  /api/network                      -> network map snapshot
+  GET  /api/notaries                     -> notary identities
+  GET  /api/vault[?contract=...]         -> unconsumed states
+  GET  /api/attachments/{hash}           -> attachment bytes
+  POST /api/attachments                  -> upload, returns hash
+  POST /api/flows/{flow_name}            -> start flow (JSON args), returns id
+  GET  /api/flows/{flow_id}              -> flow result (blocks briefly)
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..client.jackson import from_json_value, to_json
+from ..core.crypto.secure_hash import SecureHash
+
+
+class WebServer:
+    def __init__(self, ops, host: str = "127.0.0.1", port: int = 0):
+        """ops: a CordaRPCOps (direct or via RPC client proxy)."""
+        self.ops = ops
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, value):
+                self._send(code, to_json(value).encode())
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as exc:
+                    self._json(500, {"error": str(exc)})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except Exception as exc:
+                    self._json(500, {"error": str(exc)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="webserver", daemon=True
+        )
+        self._thread.start()
+
+    # -- routing -------------------------------------------------------------
+
+    def _get(self, req) -> None:
+        path, _, query = req.path.partition("?")
+        params = dict(
+            p.split("=", 1) for p in query.split("&") if "=" in p
+        )
+        if path == "/api/status":
+            req._send(200, b"started", "text/plain")
+        elif path == "/api/info":
+            req._json(200, self.ops.node_info())
+        elif path == "/api/network":
+            req._json(200, self.ops.network_map_snapshot())
+        elif path == "/api/notaries":
+            req._json(200, self.ops.notary_identities())
+        elif path == "/api/vault":
+            req._json(200, self.ops.vault_query(params.get("contract")))
+        elif m := re.fullmatch(r"/api/attachments/([0-9A-Fa-f]{64})", path):
+            att_id = SecureHash(bytes.fromhex(m.group(1)))
+            data = self.ops.open_attachment(att_id)
+            if data is None:
+                req._json(404, {"error": "no such attachment"})
+            else:
+                req._send(200, data, "application/octet-stream")
+        elif m := re.fullmatch(r"/api/flows/([0-9a-f-]{36})", path):
+            try:
+                result = self.ops.flow_result(m.group(1), timeout=10)
+                req._json(200, {"result": result})
+            except Exception as exc:
+                req._json(500, {"error": str(exc)})
+        else:
+            req._json(404, {"error": f"no route {path}"})
+
+    def _post(self, req) -> None:
+        length = int(req.headers.get("Content-Length", 0))
+        body = req.rfile.read(length) if length else b""
+        path = req.path
+        if path == "/api/attachments":
+            att_id = self.ops.upload_attachment(body)
+            req._json(200, {"id": att_id})
+        elif m := re.fullmatch(r"/api/flows/([A-Za-z0-9_.]+)", path):
+            args = from_json_value(json.loads(body.decode() or "[]"))
+            if isinstance(args, dict):
+                flow_id = self.ops.start_flow_dynamic(m.group(1), **args)
+            else:
+                flow_id = self.ops.start_flow_dynamic(m.group(1), *args)
+            req._json(200, {"flow_id": flow_id})
+        else:
+            req._json(404, {"error": f"no route {path}"})
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)

@@ -251,3 +251,59 @@ class TestTwoPartyTrade:
         assert len(buyer_paper) == 1
         assert buyer_paper[0].state.data.owner == buyer.info
         net.stop_nodes()
+
+
+class TestObligation:
+    def setup_method(self):
+        self.o_kp = crypto.entropy_to_keypair(520)
+        self.b_kp = crypto.entropy_to_keypair(521)
+        self.n_kp = crypto.entropy_to_keypair(522)
+        self.obligor = Party("O=Obligor,L=London,C=GB", self.o_kp.public)
+        self.beneficiary = Party("O=Beneficiary,L=Paris,C=FR", self.b_kp.public)
+        self.notary = Party("O=Notary,L=Zurich,C=CH", self.n_kp.public)
+        self.token = Issued(self.obligor.ref(1), "USD")
+
+    def _settle_ltx(self, n_obligations, cash_paid):
+        from corda_tpu.core.contracts import StateRef, StateAndRef, TransactionState
+        from corda_tpu.finance.obligation import ObligationCommand, ObligationState
+        from corda_tpu.core.crypto import SecureHash
+
+        b = TransactionBuilder(notary=self.notary)
+        resolved = {}
+        for i in range(n_obligations):
+            ob = ObligationState(
+                obligor=self.obligor, beneficiary=self.beneficiary,
+                amount=Amount(100, self.token),
+            )
+            ts = TransactionState(ob, self.notary)
+            ref = StateRef(SecureHash.sha256(b"ob%d" % i), 0)
+            resolved[ref] = ts
+            b.add_input_state(StateAndRef(ts, ref))
+        if cash_paid:
+            cash_ts = TransactionState(
+                CashState(amount=Amount(cash_paid, self.token),
+                          owner=self.obligor),
+                self.notary,
+            )
+            cash_ref = StateRef(SecureHash.sha256(b"cash"), 0)
+            resolved[cash_ref] = cash_ts
+            b.add_input_state(StateAndRef(cash_ts, cash_ref))
+            b.add_output_state(
+                CashState(amount=Amount(cash_paid, self.token),
+                          owner=self.beneficiary)
+            )
+            b.add_command(CashCommand.Move(), self.obligor.owning_key)
+        b.add_command(ObligationCommand.Settle(), self.obligor.owning_key)
+        wtx = b.to_wire_transaction()
+        return wtx.to_ledger_transaction(
+            resolve_state=lambda r: resolved[r],
+            resolve_attachment=lambda h: None,
+        )
+
+    def test_settle_full_payment_ok(self):
+        self._settle_ltx(2, cash_paid=200).verify()
+
+    def test_settle_underpayment_rejected(self):
+        # Regression: one 100-cash output must not settle two 100-obligations.
+        with pytest.raises(Exception, match="settlement must pay"):
+            self._settle_ltx(2, cash_paid=100).verify()

@@ -374,3 +374,95 @@ class TestNotaryAndFinality:
         net2.run_network()
         h2.result.result(timeout=1)  # tear-off notarisation succeeded
         net2.stop_nodes()
+
+
+class TestMultiHopResolution:
+    """Regression: a dependency chain needing multiple fetch rounds must not
+    reuse the completed fetch session (session-per-exchange semantics)."""
+
+    def test_three_hop_chain_reaches_third_party(self):
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        alice = net.create_node("O=Alice,L=London,C=GB")
+        bob = net.create_node("O=Bob,L=New York,C=US")
+        charlie = net.create_node("O=Charlie,L=Paris,C=FR")
+
+        def issue(node):
+            b = TransactionBuilder(notary=notary.info)
+            b.add_output_state(OwnedState(owner=node.info, value=7))
+            b.add_command(MoveCmd(), node.info.owning_key)
+            return node.services.sign_initial_transaction(b)
+
+        def move(node, ref, to):
+            b = TransactionBuilder(notary=notary.info)
+            b.add_input_state(ref)
+            b.add_output_state(OwnedState(owner=to.info, value=7))
+            b.add_command(MoveCmd(), node.info.owning_key)
+            return node.services.sign_initial_transaction(b)
+
+        stx0 = issue(alice)
+        h0 = alice.start_flow(FinalityFlow(stx0), stx0)
+        net.run_network()
+        h0.result.result(timeout=1)
+
+        stx1 = move(alice, stx0.tx.out_ref(0), bob)
+        h1 = alice.start_flow(FinalityFlow(stx1), stx1)
+        net.run_network()
+        h1.result.result(timeout=1)
+
+        # Bob moves to Charlie: Charlie must resolve a 2-deep chain from Bob
+        # (two FetchTransactionsFlow rounds over two distinct sessions).
+        stx2 = move(bob, stx1.tx.out_ref(0), charlie)
+        h2 = bob.start_flow(FinalityFlow(stx2), stx2)
+        net.run_network()
+        h2.result.result(timeout=1)
+
+        assert charlie.services.validated_transactions.get(stx2.id) is not None
+        assert charlie.services.validated_transactions.get(stx1.id) is not None
+        assert charlie.services.validated_transactions.get(stx0.id) is not None
+        states = charlie.services.vault_service.unconsumed_states("OwnedContract")
+        assert len(states) == 1 and states[0].state.data.owner == charlie.info
+        net.stop_nodes()
+
+
+class TestTearOffCompleteness:
+    """Regression: a tear-off hiding inputs must not obtain a notary
+    signature (hidden inputs would stay spendable: signed double spend)."""
+
+    def test_hidden_input_tear_off_rejected(self):
+        from corda_tpu.core.contracts import StateRef, TransactionState
+        from corda_tpu.core.transactions.filtered import (
+            FilteredTransaction,
+            FilteredTransactionVerificationError,
+        )
+
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=False)
+        alice = net.create_node("O=Alice,L=London,C=GB")
+
+        b = TransactionBuilder(notary=notary.info)
+        b.add_output_state(OwnedState(owner=alice.info, value=1))
+        b.add_output_state(OwnedState(owner=alice.info, value=2))
+        b.add_command(MoveCmd(), alice.info.owning_key)
+        issue = alice.services.sign_initial_transaction(b)
+        h = alice.start_flow(FinalityFlow(issue), issue)
+        net.run_network()
+        h.result.result(timeout=1)
+
+        b2 = TransactionBuilder(notary=notary.info)
+        b2.add_input_state(issue.tx.out_ref(0))
+        b2.add_input_state(issue.tx.out_ref(1))
+        b2.add_output_state(OwnedState(owner=alice.info, value=3))
+        b2.add_command(MoveCmd(), alice.info.owning_key)
+        spend = alice.services.sign_initial_transaction(b2)
+
+        # Malicious tear-off: hide the second input.
+        hidden_ref = issue.tx.out_ref(1).ref
+        ftx = FilteredTransaction.build(
+            spend.tx,
+            lambda c: not (isinstance(c, StateRef) and c == hidden_ref),
+        )
+        ftx.verify()  # Merkle proof still holds (inclusion only)...
+        with pytest.raises(FilteredTransactionVerificationError, match="reveals 1 of 2"):
+            ftx.check_all_inputs_revealed()  # ...but completeness fails
+        net.stop_nodes()

@@ -1,0 +1,154 @@
+"""Samples + loadtest + webserver + shell + jackson tests."""
+import io
+import json
+import random
+import urllib.request
+
+import pytest
+
+from corda_tpu.client.jackson import (
+    from_json,
+    parse_flow_start,
+    to_json,
+)
+from corda_tpu.core.contracts import Amount, Issued
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.identity import Party
+from corda_tpu.loadtest import (
+    NotaryLoadTest,
+    Nodes,
+    SelfIssueLoadTest,
+    StabilityLoadTest,
+    kill_flow_storm,
+)
+from corda_tpu.rpc.ops import CordaRPCOps
+from corda_tpu.samples import attachment_demo, bank_of_corda, notary_demo, trader_demo
+from corda_tpu.testing import MockNetwork
+
+
+class TestSamples:
+    def test_trader_demo(self):
+        result = trader_demo.main(verbose=False)
+        assert result["buyer_paper"] == 1
+
+    def test_notary_demo(self):
+        result = notary_demo.main(n_transactions=3, verbose=False)
+        assert result["notarised"] == 3
+        assert result["double_spend_rejected"]
+
+    def test_bank_of_corda(self):
+        result = bank_of_corda.main(verbose=False)
+        assert result["issued"] == 1_000_00
+
+    def test_attachment_demo(self):
+        result = attachment_demo.main(verbose=False)
+        assert result["received"]
+
+
+class TestLoadtest:
+    def _nodes(self, n=3):
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        parties = [
+            net.create_node(f"O=Load{i},L=City{i},C=GB") for i in range(n)
+        ]
+        return Nodes(network=net, notary=notary, nodes=parties)
+
+    def test_self_issue_consistency(self):
+        nodes = self._nodes()
+        result = SelfIssueLoadTest().run(nodes, iterations=10, parallelism=6)
+        assert result.consistent, result.errors
+        assert result.commands_executed > 0
+        nodes.network.stop_nodes()
+
+    def test_notary_throughput(self):
+        nodes = self._nodes()
+        result = NotaryLoadTest().run(nodes, iterations=5, parallelism=4)
+        assert not result.errors, result.errors
+        assert result.commands_per_sec > 0
+        nodes.network.stop_nodes()
+
+    def test_stability_under_message_drop(self):
+        nodes = self._nodes()
+        result = StabilityLoadTest().run(
+            nodes, iterations=10, parallelism=4,
+            disruptions=[kill_flow_storm(probability=0.3)],
+        )
+        assert result.consistent, result.errors
+        nodes.network.stop_nodes()
+
+
+class TestJackson:
+    def test_roundtrip_party_amount(self):
+        kp = crypto.entropy_to_keypair(800)
+        party = Party("O=X,L=Y,C=GB", kp.public)
+        amount = Amount(100, Issued(party.ref(1), "USD"))
+        text = to_json({"party": party, "amount": amount})
+        decoded = from_json(text)
+        assert decoded["party"] == party
+        assert decoded["amount"] == amount
+
+    def test_parse_flow_start_kwargs(self):
+        kp = crypto.entropy_to_keypair(801)
+        alice = Party("O=Alice,L=London,C=GB", kp.public)
+        name, kwargs = parse_flow_start(
+            "CashIssueFlow amount: 100 USD, recipient: O=Alice,L=London,C=GB",
+            identity_lookup=lambda n: alice if n == alice.name else None,
+        )
+        assert name == "CashIssueFlow"
+        assert kwargs["amount"].quantity == 100_00  # cents
+        assert kwargs["recipient"] == alice
+
+    def test_parse_flow_start_positional(self):
+        name, args = parse_flow_start("SomeFlow 42, hello, 2.5")
+        assert name == "SomeFlow"
+        assert args == [42, "hello", 2.5]
+
+
+class TestWebServer:
+    def test_endpoints(self):
+        from corda_tpu.webserver import WebServer
+
+        net = MockNetwork()
+        node = net.create_node("O=Web,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        server = WebServer(ops)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            assert urllib.request.urlopen(f"{base}/api/status").read() == b"started"
+            info = json.loads(urllib.request.urlopen(f"{base}/api/info").read())
+            assert info["name"] == "O=Web,L=London,C=GB"
+            # attachment upload + download
+            req = urllib.request.Request(
+                f"{base}/api/attachments", data=b"some jar", method="POST"
+            )
+            att = json.loads(urllib.request.urlopen(req).read())
+            att_hash = att["id"]["value"]
+            got = urllib.request.urlopen(
+                f"{base}/api/attachments/{att_hash}"
+            ).read()
+            assert got == b"some jar"
+            # vault is empty
+            vault = json.loads(urllib.request.urlopen(f"{base}/api/vault").read())
+            assert vault == []
+        finally:
+            server.stop()
+            net.stop_nodes()
+
+
+class TestShell:
+    def test_shell_commands(self):
+        from corda_tpu.node.shell import InteractiveShell
+
+        net = MockNetwork()
+        net.create_notary_node(validating=True)
+        node = net.create_node("O=ShellNode,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        out = io.StringIO()
+        shell = InteractiveShell(ops, stdout=out, pump=net.run_network)
+        shell.onecmd("network")
+        assert "ShellNode" in out.getvalue()
+        shell.onecmd("flow list")
+        shell.onecmd("vault")
+        assert shell.onecmd("bye") is True
+        net.stop_nodes()

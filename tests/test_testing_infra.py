@@ -1,0 +1,192 @@
+"""Test-infrastructure tests: ledger DSL, Generator monad, Expect recorder,
+GeneratedLedger property data, clauses framework.
+(Reference coverage: TestDSL usage in CashTests, Generator.kt,
+Expect.kt, GeneratedLedger.kt.)
+"""
+import random
+
+import pytest
+
+from corda_tpu.core.contracts import Amount, Issued, TransactionVerificationError
+from corda_tpu.core.contracts.clauses import (
+    AllOf,
+    AnyOf,
+    Clause,
+    FirstOf,
+    verify_clause,
+)
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.identity import Party
+from corda_tpu.finance.cash import CashCommand, CashState
+from corda_tpu.testing import (
+    ExpectRecorder,
+    Generator,
+    generate_ledger,
+    ledger,
+)
+from corda_tpu.utils.observable import Observable
+
+BANK_KP = crypto.entropy_to_keypair(700)
+ALICE_KP = crypto.entropy_to_keypair(701)
+NOTARY_KP = crypto.entropy_to_keypair(702)
+BANK = Party("O=Bank,L=London,C=GB", BANK_KP.public)
+ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)
+NOTARY = Party("O=Notary,L=Zurich,C=CH", NOTARY_KP.public)
+TOKEN = Issued(BANK.ref(1), "USD")
+
+
+class TestLedgerDSL:
+    def test_issue_then_move(self):
+        with ledger(notary=NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output("alice cash", CashState(
+                    amount=Amount(100, TOKEN), owner=ALICE))
+                tx.command(BANK.owning_key, CashCommand.Issue())
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("alice cash")
+                tx.output(state=CashState(amount=Amount(100, TOKEN), owner=BANK))
+                tx.command(ALICE.owning_key, CashCommand.Move())
+                tx.verifies()
+
+    def test_fails_with(self):
+        with ledger(notary=NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output("c", CashState(amount=Amount(100, TOKEN), owner=ALICE))
+                tx.command(BANK.owning_key, CashCommand.Issue())
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("c")
+                tx.output(state=CashState(amount=Amount(90, TOKEN), owner=BANK))
+                tx.command(ALICE.owning_key, CashCommand.Move())
+                tx.fails_with("not conserved")
+
+    def test_fails_with_wrong_substring_raises(self):
+        with ledger(notary=NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output("c", CashState(amount=Amount(100, TOKEN), owner=ALICE))
+                tx.command(ALICE.owning_key, CashCommand.Issue())  # wrong signer
+                with pytest.raises(AssertionError):
+                    tx.fails_with("completely unrelated message")
+
+
+class TestGenerator:
+    def test_monad_laws_smoke(self):
+        rng = random.Random(1)
+        g = Generator.int_range(1, 6).bind(
+            lambda n: Generator.list_of(Generator.choice("xyz"), n)
+        )
+        value = g.generate(rng)
+        assert 1 <= len(value) <= 6
+        assert set(value) <= set("xyz")
+
+    def test_deterministic_given_seed(self):
+        g = Generator.sized_list_of(Generator.int_range(0, 100), 5, 10)
+        assert g.generate(random.Random(7)) == g.generate(random.Random(7))
+
+    def test_frequency(self):
+        g = Generator.frequency([(9, Generator.pure("a")), (1, Generator.pure("b"))])
+        values = [g.generate(random.Random(i)) for i in range(50)]
+        assert values.count("a") > values.count("b")
+
+
+class TestExpect:
+    def test_expect_event(self):
+        obs = Observable()
+        rec = ExpectRecorder(obs)
+        obs.on_next({"n": 1})
+        obs.on_next({"n": 2})
+        assert rec.expect(lambda e: e["n"] == 2, timeout=1) == {"n": 2}
+
+    def test_expect_sequence(self):
+        obs = Observable()
+        rec = ExpectRecorder(obs)
+        for n in [1, 2, 3]:
+            obs.on_next(n)
+        rec.expect_sequence(lambda e: e == 1, lambda e: e == 3, timeout=1)
+
+    def test_expect_timeout(self):
+        rec = ExpectRecorder()
+        with pytest.raises(AssertionError, match="expected"):
+            rec.expect(lambda e: True, timeout=0.05)
+
+
+class TestGeneratedLedger:
+    def test_all_generated_transactions_verify(self):
+        gl = generate_ledger(random.Random(3), n_parties=3, n_transactions=30)
+        assert len(gl.transactions) == 30
+        for stx in gl.transactions:
+            ltx = stx.tx.to_ledger_transaction(
+                resolve_state=gl.resolve_state,
+                resolve_attachment=lambda h: None,
+            )
+            ltx.verify()  # contracts hold
+            stx.verify_required_signatures()  # signatures hold
+
+    def test_property_forged_signature_detected(self):
+        gl = generate_ledger(random.Random(4), n_transactions=10)
+        stx = gl.transactions[0]
+        from corda_tpu.core.crypto.signing import DigitalSignatureWithKey
+        from corda_tpu.core.transactions.signed import SignedTransaction
+
+        bad_sig = DigitalSignatureWithKey(
+            bytes(64), stx.sigs[0].by
+        )
+        forged = SignedTransaction(stx.tx_bits, (bad_sig,) + stx.sigs[1:])
+        with pytest.raises(Exception):
+            forged.verify_required_signatures()
+
+
+class TestClauses:
+    class IssueClause(Clause):
+        required_commands = (CashCommand.Issue,)
+
+        def verify(self, tx, inputs, outputs, commands, grouping_key):
+            if inputs:
+                raise TransactionVerificationError(None, "issue with inputs")
+            return {c.value for c in commands
+                    if isinstance(c.value, CashCommand.Issue)}
+
+    class MoveClause(Clause):
+        required_commands = (CashCommand.Move,)
+
+        def verify(self, tx, inputs, outputs, commands, grouping_key):
+            return {c.value for c in commands
+                    if isinstance(c.value, CashCommand.Move)}
+
+    def _fake_tx(self, commands, inputs=()):
+        from corda_tpu.core.contracts.structures import AuthenticatedObject
+
+        class FakeTx:
+            id = None
+            input_states = list(inputs)
+            output_states = []
+
+        FakeTx.commands = [
+            AuthenticatedObject(signers=(), signing_parties=(), value=c)
+            for c in commands
+        ]
+        return FakeTx()
+
+    def test_first_of_picks_first_match(self):
+        tx = self._fake_tx([CashCommand.Issue()])
+        clause = FirstOf(self.IssueClause(), self.MoveClause())
+        verify_clause(tx, clause, tx.commands)
+
+    def test_any_of_requires_a_match(self):
+        tx = self._fake_tx([CashCommand.Exit(Amount(1, TOKEN))])
+        clause = AnyOf(self.IssueClause(), self.MoveClause())
+        with pytest.raises(TransactionVerificationError, match="no clause"):
+            verify_clause(tx, clause, tx.commands)
+
+    def test_all_of_fails_if_one_missing(self):
+        tx = self._fake_tx([CashCommand.Issue()])
+        clause = AllOf(self.IssueClause(), self.MoveClause())
+        with pytest.raises(TransactionVerificationError, match="did not match"):
+            verify_clause(tx, clause, tx.commands)
+
+    def test_unmatched_command_rejected(self):
+        tx = self._fake_tx([CashCommand.Issue(), CashCommand.Move()])
+        clause = FirstOf(self.IssueClause(), self.MoveClause())
+        with pytest.raises(TransactionVerificationError, match="not matched"):
+            verify_clause(tx, clause, tx.commands)

@@ -314,8 +314,12 @@ class TestFilteredTransaction:
             lambda c: isinstance(c, TransactionState)
         )
         from corda_tpu.core.transactions.filtered import FilteredComponent
+        from corda_tpu.core.transactions.wire import ComponentGroup
 
-        fc0, fc1 = ftx.filtered_components
+        fc0, fc1 = [
+            fc for fc in ftx.filtered_components
+            if fc.group != ComponentGroup.GROUP_SIZES
+        ]
         swapped = (
             FilteredComponent(fc0.group, fc1.index, fc0.component, fc0.nonce),
             FilteredComponent(fc1.group, fc0.index, fc1.component, fc1.nonce),
