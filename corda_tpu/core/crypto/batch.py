@@ -14,47 +14,65 @@ from typing import List, Sequence, Tuple
 
 from . import crypto
 from .keys import PublicKey
-from .schemes import EDDSA_ED25519_SHA512
+from .schemes import (
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+)
 
 # Flip to False to force the host path (e.g. for differential testing).
 USE_DEVICE_KERNELS = True
 
-# Below this many ed25519 signatures the host path (OpenSSL via cryptography)
-# beats device dispatch+compile amortization on small batches.
+# Below this many signatures of one scheme the host path (OpenSSL via
+# cryptography) beats device dispatch+compile amortization.
 MIN_DEVICE_BATCH = 32
+
+# scheme code name -> ecdsa_batch curve name
+_ECDSA_CURVES = {
+    ECDSA_SECP256K1_SHA256.scheme_code_name: "secp256k1",
+    ECDSA_SECP256R1_SHA256.scheme_code_name: "secp256r1",
+}
 
 
 def verify_batch(
     items: Sequence[Tuple[PublicKey, bytes, bytes]],
 ) -> List[bool]:
-    """items: (public_key, signature_bytes, content) triples -> bool per item."""
+    """items: (public_key, signature_bytes, content) triples -> bool per item.
+
+    Buckets by scheme (the mixed-scheme dispatch, BASELINE.md): ed25519 and
+    both ECDSA curves go to their device kernels when the bucket is large
+    enough; everything else (RSA, composite, small buckets) stays host-side.
+    """
     n = len(items)
     results: List[bool] = [False] * n
-    ed_idx: List[int] = []
+    buckets: dict = {}  # kernel key -> [indices]
     for i, (key, sig, content) in enumerate(items):
-        if (
-            USE_DEVICE_KERNELS
-            and key.scheme_code_name == EDDSA_ED25519_SHA512.scheme_code_name
-            and not _is_composite(key)
+        name = key.scheme_code_name
+        if USE_DEVICE_KERNELS and not _is_composite(key) and (
+            name == EDDSA_ED25519_SHA512.scheme_code_name
+            or name in _ECDSA_CURVES
         ):
-            ed_idx.append(i)
+            buckets.setdefault(name, []).append(i)
         else:
             results[i] = crypto.is_valid(key, sig, content)
 
-    if len(ed_idx) >= MIN_DEVICE_BATCH:
+    for name, idx in buckets.items():
+        if len(idx) < MIN_DEVICE_BATCH:
+            for i in idx:
+                key, sig, content = items[i]
+                results[i] = crypto.is_valid(key, sig, content)
+            continue
         from ... import ops
 
-        mask = ops.ed25519_verify_batch(
-            [items[i][0].encoded for i in ed_idx],
-            [items[i][1] for i in ed_idx],
-            [items[i][2] for i in ed_idx],
-        )
-        for j, i in enumerate(ed_idx):
+        pubs = [items[i][0].encoded for i in idx]
+        sigs = [items[i][1] for i in idx]
+        msgs = [items[i][2] for i in idx]
+        if name == EDDSA_ED25519_SHA512.scheme_code_name:
+            mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
+        else:
+            mask = ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
+        for j, i in enumerate(idx):
             results[i] = bool(mask[j])
-    else:
-        for i in ed_idx:
-            key, sig, content = items[i]
-            results[i] = crypto.is_valid(key, sig, content)
     return results
 
 

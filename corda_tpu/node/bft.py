@@ -1,0 +1,344 @@
+"""BFT state-machine replication for the notary commit log.
+
+Reference: `node/.../services/transactions/BFTSMaRt.kt` wraps the
+BFT-SMaRt library (ServiceProxy.invokeOrdered + DefaultRecoverable
+replicas, `BFTSMaRt.kt:79-276`).  The TPU build implements the PBFT core
+itself: 3f+1 replicas, pre-prepare/prepare/commit phases, 2f+1 quorums,
+view change on primary timeout.  The replicated operation is the same
+`putall` uniqueness command the Raft provider applies, and the client
+accepts a result once f+1 replicas return identical signed verdicts
+(reference: response extractor aggregating >= requiredReplies signatures).
+
+Same determinism contract as raft.py: `tick(now)` drives timeouts,
+`on_message` handles peer traffic; tests step the cluster explicitly.
+
+Scope: normal-case consensus + view change with prepared-certificate
+carry-over; checkpoint/garbage-collection of the PBFT log is not
+implemented (the log is bounded by ledger growth, like the Raft provider).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..core.serialization.codec import deserialize, serialize
+
+BFT_TOPIC = "platform.bft"
+
+
+def _digest(request: dict) -> bytes:
+    return hashlib.sha256(serialize(request)).digest()
+
+
+class BFTReplica:
+    """One PBFT replica.
+
+    transport: send(peer_id, payload); apply_fn(command) -> result applied
+    exactly once per committed sequence number, in order, on every replica.
+    reply_fn(client_id, request_id, result) delivers the signed verdict
+    back to the requesting client.
+    """
+
+    VIEW_TIMEOUT = 30.0
+
+    def __init__(
+        self,
+        replica_id: int,
+        n_replicas: int,
+        transport: Callable[[int, bytes], None],
+        apply_fn: Callable[[dict], object],
+        reply_fn: Callable[[str, str, object], None],
+    ):
+        assert n_replicas >= 4, "BFT needs n >= 3f+1 with f >= 1"
+        self.id = replica_id
+        self.n = n_replicas
+        self.f = (n_replicas - 1) // 3
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.reply_fn = reply_fn
+        self.view = 0
+        self.next_seq = 0  # primary's sequence counter
+        self.last_executed = -1
+        # seq -> state
+        self.requests: Dict[bytes, dict] = {}  # digest -> request
+        self.pre_prepares: Dict[int, bytes] = {}  # seq -> digest
+        # votes keyed (view, seq, digest): PBFT quorums are per-view
+        self.prepares: Dict[Tuple[int, int, bytes], Set[int]] = {}
+        self.commits: Dict[Tuple[int, int, bytes], Set[int]] = {}
+        # carried-over prepared claims during view change: (seq, digest) -> voters
+        self._vc_prepared_claims: Dict[Tuple[int, bytes], Set[int]] = {}
+        self.committed: Dict[int, bytes] = {}  # seq -> digest (quorum reached)
+        self.executed: Set[int] = set()
+        # view change
+        self.view_change_votes: Dict[int, Set[int]] = {}  # new view -> voters
+        self._pending_since: Optional[float] = None
+        self._now = 0.0
+
+    # -- identity helpers ----------------------------------------------------
+
+    @property
+    def primary(self) -> int:
+        return self.view % self.n
+
+    @property
+    def is_primary(self) -> bool:
+        return self.id == self.primary
+
+    def _broadcast(self, msg: dict) -> None:
+        payload = serialize(msg)
+        for peer in range(self.n):
+            if peer != self.id:
+                try:
+                    self.transport(peer, payload)
+                except Exception:
+                    pass
+
+    # -- client request entry ------------------------------------------------
+
+    def on_request(self, request: dict) -> None:
+        """A client request arrived (any replica can receive it; non-primary
+        forwards to the primary and starts its complaint timer)."""
+        d = _digest(request)
+        self.requests[d] = request
+        if self.is_primary:
+            if d in self.pre_prepares.values():
+                return  # duplicate
+            seq = self.next_seq
+            self.next_seq += 1
+            self.pre_prepares[seq] = d
+            self._broadcast({
+                "kind": "pre_prepare", "view": self.view, "seq": seq,
+                "digest": d, "request": request,
+            })
+            self._record_prepare(seq, d, self.id)
+        else:
+            try:
+                self.transport(self.primary, serialize({
+                    "kind": "forward", "request": request,
+                }))
+            except Exception:
+                pass
+            if self._pending_since is None:
+                self._pending_since = self._now
+
+    # -- message handling ----------------------------------------------------
+
+    def on_message(self, sender: int, payload: bytes) -> None:
+        msg = deserialize(payload)
+        kind = msg["kind"]
+        if kind == "forward":
+            self.on_request(msg["request"])
+        elif kind == "pre_prepare":
+            self._on_pre_prepare(sender, msg)
+        elif kind == "prepare":
+            if msg["view"] == self.view and self._seq_in_window(msg["seq"]):
+                self._record_prepare(msg["seq"], msg["digest"], sender)
+        elif kind == "commit":
+            if msg["view"] == self.view and self._seq_in_window(msg["seq"]):
+                self._record_commit(msg["seq"], msg["digest"], sender)
+        elif kind == "view_change":
+            self._on_view_change(sender, msg)
+        elif kind == "new_view":
+            self._on_new_view(sender, msg)
+
+    # Bound on how far ahead of execution the log may run: caps state growth
+    # against a faulty peer spraying arbitrary (seq, digest) votes.
+    MAX_INFLIGHT = 10_000
+
+    def _seq_in_window(self, seq: int) -> bool:
+        return self.last_executed < seq <= self.last_executed + self.MAX_INFLIGHT or seq <= self.last_executed
+
+    def _on_pre_prepare(self, sender: int, msg: dict) -> None:
+        if msg["view"] != self.view or sender != self.primary:
+            return
+        seq, d = msg["seq"], msg["digest"]
+        if not self._seq_in_window(seq):
+            return
+        if seq in self.pre_prepares and self.pre_prepares[seq] != d:
+            return  # equivocation: ignore (view change will handle)
+        self.pre_prepares[seq] = d
+        self.requests[d] = msg["request"]
+        self._pending_since = None  # primary is alive
+        self._broadcast({
+            "kind": "prepare", "view": self.view, "seq": seq, "digest": d,
+        })
+        self._record_prepare(seq, d, sender)
+        self._record_prepare(seq, d, self.id)
+
+    def _record_prepare(self, seq: int, d: bytes, voter: int) -> None:
+        votes = self.prepares.setdefault((self.view, seq, d), set())
+        if voter in votes:
+            return
+        votes.add(voter)
+        # prepared: pre-prepare + 2f prepares (incl. our own vote counting)
+        if len(votes) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d:
+            ckey = (self.view, seq, d)
+            if self.id not in self.commits.get(ckey, set()):
+                self._broadcast({
+                    "kind": "commit", "view": self.view, "seq": seq,
+                    "digest": d,
+                })
+                self._record_commit(seq, d, self.id)
+
+    def _record_commit(self, seq: int, d: bytes, voter: int) -> None:
+        votes = self.commits.setdefault((self.view, seq, d), set())
+        if voter in votes:
+            return
+        votes.add(voter)
+        if len(votes) >= 2 * self.f + 1:
+            self.committed[seq] = d
+            self._execute_ready()
+
+    def _execute_ready(self) -> None:
+        while self.last_executed + 1 in self.committed:
+            seq = self.last_executed + 1
+            d = self.committed[seq]
+            request = self.requests.get(d)
+            if request is None:
+                return  # wait for the request body
+            self.last_executed = seq
+            if seq not in self.executed:
+                self.executed.add(seq)
+                result = self.apply_fn(request["command"])
+                self.reply_fn(
+                    request["client_id"], request["request_id"], result
+                )
+
+    # -- view change ---------------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        self._now = now
+        if (
+            self._pending_since is not None
+            and now - self._pending_since >= self.VIEW_TIMEOUT
+        ):
+            self._pending_since = None
+            self._start_view_change(self.view + 1)
+
+    def _start_view_change(self, new_view: int) -> None:
+        votes = self.view_change_votes.setdefault(new_view, set())
+        votes.add(self.id)
+        self._broadcast({
+            "kind": "view_change", "new_view": new_view,
+            # prepared claims: (seq, digest, request) we locally prepared.
+            # Receivers only honor a claim corroborated by f+1 distinct
+            # replicas (at least one honest), so a single Byzantine replica
+            # cannot inject commands. (Production hardening: signed
+            # prepared certificates per PBFT.)
+            "prepared": [
+                [seq, d, self.requests.get(d)]
+                for (view, seq, d), v in self.prepares.items()
+                if len(v) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d
+            ],
+        })
+        # our own claims count toward the f+1 corroboration
+        for (view, seq, d), v in self.prepares.items():
+            if len(v) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d:
+                self._vc_prepared_claims.setdefault((seq, d), set()).add(self.id)
+        self._maybe_enter_view(new_view)
+
+    def _on_view_change(self, sender: int, msg: dict) -> None:
+        new_view = msg["new_view"]
+        if new_view <= self.view:
+            return
+        votes = self.view_change_votes.setdefault(new_view, set())
+        votes.add(sender)
+        for seq, d, request in msg["prepared"]:
+            if request is None or _digest(request) != d:
+                continue  # malformed claim
+            claims = self._vc_prepared_claims.setdefault((seq, d), set())
+            claims.add(sender)
+            # carry over only once f+1 replicas (>= one honest) corroborate
+            if len(claims) >= self.f + 1:
+                self.requests[d] = request
+                self.pre_prepares.setdefault(seq, d)
+        # join the view change once f+1 replicas demand it
+        if self.id not in votes and len(votes) >= self.f + 1:
+            self._start_view_change(new_view)
+        self._maybe_enter_view(new_view)
+
+    def _maybe_enter_view(self, new_view: int) -> None:
+        votes = self.view_change_votes.get(new_view, set())
+        if len(votes) >= 2 * self.f + 1 and new_view > self.view:
+            self.view = new_view
+            self._pending_since = None
+            if self.is_primary:
+                self.next_seq = max(self.pre_prepares, default=self.last_executed) + 1
+                # re-propose carried-over uncommitted work, then fresh queue
+                self._broadcast({"kind": "new_view", "view": self.view})
+                for seq, d in sorted(self.pre_prepares.items()):
+                    if seq > self.last_executed and d in self.requests:
+                        self._broadcast({
+                            "kind": "pre_prepare", "view": self.view,
+                            "seq": seq, "digest": d,
+                            "request": self.requests[d],
+                        })
+                        self._record_prepare(seq, d, self.id)
+                # pending client requests that never got a seq
+                for d, request in list(self.requests.items()):
+                    if d not in self.pre_prepares.values():
+                        self.on_request(request)
+
+    def _on_new_view(self, sender: int, msg: dict) -> None:
+        if msg["view"] > self.view and sender == msg["view"] % self.n:
+            self.view = msg["view"]
+            self._pending_since = None
+
+
+class BFTClient:
+    """Client proxy: broadcast the command to every replica, accept the
+    result once f+1 identical replies arrive (reference BFTSMaRt.Client
+    response extractor)."""
+
+    def __init__(self, client_id: str, n_replicas: int,
+                 send_to_replica: Callable[[int, dict], None]):
+        self.client_id = client_id
+        self.n = n_replicas
+        self.f = (n_replicas - 1) // 3
+        self._send = send_to_replica
+        self._pending: Dict[str, Future] = {}
+        self._replies: Dict[str, List[object]] = {}
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def submit(self, command: dict) -> Future:
+        with self._lock:
+            self._counter += 1
+            request_id = f"{self.client_id}:{self._counter}"
+            fut: Future = Future()
+            self._pending[request_id] = fut
+            self._replies[request_id] = []
+        fut.request_id = request_id  # lets callers forget() on timeout
+        request = {
+            "client_id": self.client_id, "request_id": request_id,
+            "command": command,
+        }
+        for r in range(self.n):
+            try:
+                self._send(r, request)
+            except Exception:
+                pass
+        return fut
+
+    def forget(self, request_id: str) -> None:
+        """Drop a timed-out request so late replies cannot leak memory."""
+        with self._lock:
+            self._pending.pop(request_id, None)
+            self._replies.pop(request_id, None)
+
+    def on_reply(self, request_id: str, result: object) -> None:
+        with self._lock:
+            fut = self._pending.get(request_id)
+            if fut is None or fut.done():
+                return
+            replies = self._replies[request_id]
+            replies.append(result)
+            blob = serialize(result)
+            matching = sum(1 for r in replies if serialize(r) == blob)
+            if matching >= self.f + 1:
+                self._pending.pop(request_id)
+                self._replies.pop(request_id)
+                fut.set_result(result)

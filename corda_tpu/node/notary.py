@@ -140,6 +140,59 @@ class RaftUniquenessProvider(UniquenessProvider):
             ))
 
 
+class BFTUniquenessProvider(UniquenessProvider):
+    """Byzantine-fault-tolerant commit log over the framework's own PBFT
+    (reference `BFTSMaRt.kt` Client/Replica wrapping the BFT-SMaRt library;
+    see corda_tpu.node.bft for the replica protocol).  The provider is the
+    client side: it submits the putall and accepts the verdict once f+1
+    replicas agree."""
+
+    def __init__(self, bft_client):
+        self.client = bft_client
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        entries = {
+            PersistentUniquenessProvider._key(ref).hex():
+                serialize({"tx_id": tx_id, "by": requesting_party.name}).hex()
+            for ref in states
+        }
+        fut = self.client.submit({"kind": "putall", "entries": entries})
+        result = fut.result(timeout=30)
+        if result["conflicts"]:
+            by_key = {
+                PersistentUniquenessProvider._key(ref).hex(): ref
+                for ref in states
+            }
+            raise UniquenessException(Conflict(
+                tx_id,
+                {
+                    repr(by_key[k]): deserialize(bytes.fromhex(v))["tx_id"]
+                    for k, v in result["conflicts"].items()
+                    if k in by_key
+                },
+            ))
+
+    @staticmethod
+    def make_replica_apply(db: NodeDatabase):
+        """The deterministic state-machine applied on every BFT replica."""
+        umap = KVStore(db, "bft_uniqueness")
+
+        def apply(command: dict):
+            if command.get("kind") != "putall":
+                return None
+            conflicts = {}
+            for key_hex, blob_hex in command["entries"].items():
+                existing = umap.get(bytes.fromhex(key_hex))
+                if existing is not None and existing != bytes.fromhex(blob_hex):
+                    conflicts[key_hex] = existing.hex()
+            if not conflicts:
+                for key_hex, blob_hex in command["entries"].items():
+                    umap.put(bytes.fromhex(key_hex), bytes.fromhex(blob_hex))
+            return {"conflicts": conflicts}
+
+        return apply
+
+
 # ---------------------------------------------------------------------------
 # Notary services
 # ---------------------------------------------------------------------------

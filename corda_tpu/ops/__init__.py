@@ -5,11 +5,15 @@ scalar fallbacks live in corda_tpu.core.crypto; everything here is batch-first
 and jit/vmap/shard_map-friendly (static shapes, batch-uniform control flow,
 validity carried as bitmasks).
 """
+from .ecdsa_batch import prepare_batch as ecdsa_prepare_batch
+from .ecdsa_batch import verify_batch as ecdsa_verify_batch
 from .ed25519_batch import verify_batch as ed25519_verify_batch
 from .ed25519_batch import verify_kernel as ed25519_verify_kernel
 from .ed25519_batch import prepare_batch as ed25519_prepare_batch
 
 __all__ = [
+    "ecdsa_prepare_batch",
+    "ecdsa_verify_batch",
     "ed25519_verify_batch",
     "ed25519_verify_kernel",
     "ed25519_prepare_batch",

@@ -215,6 +215,12 @@ def prepare_batch(
     h_words = np.zeros((size, 8), np.uint32)
     s_ok = np.zeros(size, bool)
 
+    # The SHA-512 prehash of every well-formed row goes through the native
+    # batch hasher (corda_tpu.native) in one call; falls back to hashlib.
+    from .. import native
+
+    good_rows: list = []
+    preimages: list = []
     for i in range(n):
         pub, sig, msg = public_keys[i], signatures[i], messages[i]
         if len(pub) != 32 or len(sig) != 64:
@@ -229,14 +235,14 @@ def prepare_batch(
         y_a[i] = F.int_to_limbs(ya & ((1 << 255) - 1))
         y_r[i] = F.int_to_limbs(yr & ((1 << 255) - 1))
         s_words[i] = _scalar_to_words(s_int)
-        h = (
-            int.from_bytes(
-                hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
-            )
-            % F.L_INT
-        )
-        h_words[i] = _scalar_to_words(h)
+        good_rows.append(i)
+        preimages.append(sig[:32] + pub + msg)
         s_ok[i] = True
+    if good_rows:
+        digests = native.sha512_many(preimages)
+        for i, digest in zip(good_rows, digests):
+            h = int.from_bytes(digest, "little") % F.L_INT
+            h_words[i] = _scalar_to_words(h)
 
     kwargs = dict(
         y_a=jnp.asarray(y_a),

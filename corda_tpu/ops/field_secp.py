@@ -1,0 +1,197 @@
+"""Batched prime-field arithmetic for the secp256k1/r1 ECDSA kernels.
+
+Unlike GF(2^255-19) (field25519.py) whose 2^256 overflow folds via the tiny
+constant 38, the secp primes need a generic reduction — so this module is a
+**Montgomery-domain** field over 16 little-endian radix-2^16 uint32 limbs,
+parameterized by the prime.  One implementation serves both curves
+(reference binds each to BouncyCastle, `Crypto.kt:91-118`; here both share
+one batched CIOS multiplier).
+
+Design notes (same TPU-first rules as field25519):
+  * CIOS Montgomery multiply, word size 2^16: every inner step is
+    t[j] + a_i*b[j] + carry with all three terms bounded so the sum is
+    <= 2^32 - 1 — exact uint32, no int64 emulation.
+  * Batch dims leading, limb dim last; loops are Python-unrolled (traced
+    once inside the caller's lax.fori_loop over scalar bits).
+  * Values are kept canonical (< p) in Montgomery form between ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMB = 16
+MASK16 = jnp.uint32(0xFFFF)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    if not 0 <= x < 2**256:
+        raise ValueError("out of range")
+    return np.array([(x >> (16 * k)) & 0xFFFF for k in range(NLIMB)], np.uint32)
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(limbs[..., k]) << (16 * k) for k in range(NLIMB))
+
+
+class MontField:
+    """Montgomery field mod a 256-bit prime, radix-2^16 CIOS."""
+
+    def __init__(self, p: int):
+        self.p_int = p
+        self.p_limbs = int_to_limbs(p)
+        self._p_i32 = self.p_limbs.astype(np.int32)
+        # -p^-1 mod 2^16 (the CIOS m-multiplier)
+        self.n0p = (-pow(p, -1, 1 << 16)) & 0xFFFF
+        self.r_int = (1 << 256) % p
+        self.r2_int = (self.r_int * self.r_int) % p
+        self.r2_limbs = int_to_limbs(self.r2_int)
+        self.one_mont = int_to_limbs(self.r_int)  # 1 in Montgomery form
+        self.zero = int_to_limbs(0)
+
+    # -- host-side helpers ---------------------------------------------------
+
+    def to_mont_int(self, x: int) -> np.ndarray:
+        """Host conversion: x -> limbs of x*R mod p (for batch prep)."""
+        return int_to_limbs((x * self.r_int) % self.p_int)
+
+    def from_mont_limbs(self, limbs: np.ndarray) -> int:
+        return (limbs_to_int(limbs) * pow(self.r_int, -1, self.p_int)) % self.p_int
+
+    def const(self, limbs: np.ndarray, batch_shape=()) -> jnp.ndarray:
+        return jnp.broadcast_to(
+            jnp.asarray(limbs, jnp.uint32), (*batch_shape, NLIMB)
+        )
+
+    # -- device ops ----------------------------------------------------------
+
+    def _cond_sub_p(self, a, force=None):
+        """a - p where (a >= p or force); batch-uniform."""
+        ai = a.astype(jnp.int32)
+        outs = []
+        carry = jnp.zeros_like(ai[..., 0])
+        for k in range(NLIMB):
+            v = ai[..., k] - jnp.int32(int(self._p_i32[k])) + carry
+            outs.append((v & 0xFFFF).astype(jnp.uint32))
+            carry = v >> 16
+        t = jnp.stack(outs, axis=-1)
+        geq = carry == 0
+        take = geq if force is None else (geq | force)
+        return jnp.where(take[..., None], t, a)
+
+    def add(self, a, b):
+        """(a + b) mod p for canonical inputs (sum < 2p: one cond-subtract,
+        with the 2^256 carry bit forcing it)."""
+        s = a + b  # limb sums < 2^17
+        outs = []
+        carry = jnp.zeros_like(s[..., 0])
+        for k in range(NLIMB):
+            v = s[..., k] + carry
+            outs.append(v & MASK16)
+            carry = v >> 16
+        r = jnp.stack(outs, axis=-1)
+        return self._cond_sub_p(r, force=carry > 0)
+
+    def sub(self, a, b):
+        """(a - b) mod p for canonical inputs: a - b + (p if borrow)."""
+        ai = a.astype(jnp.int32)
+        bi = b.astype(jnp.int32)
+        outs = []
+        carry = jnp.zeros_like(ai[..., 0])
+        for k in range(NLIMB):
+            v = ai[..., k] - bi[..., k] + carry
+            outs.append((v & 0xFFFF).astype(jnp.uint32))
+            carry = v >> 16
+        t = jnp.stack(outs, axis=-1)
+        borrowed = carry < 0
+        # add p back where we borrowed
+        outs2 = []
+        carry2 = jnp.zeros_like(t[..., 0])
+        for k in range(NLIMB):
+            v = t[..., k] + jnp.uint32(int(self.p_limbs[k])) + carry2
+            outs2.append(v & MASK16)
+            carry2 = v >> 16
+        t2 = jnp.stack(outs2, axis=-1)
+        return jnp.where(borrowed[..., None], t2, t)
+
+    def mul(self, a, b):
+        """Montgomery product a*b*R^-1 mod p (SOS with delayed carries).
+
+        Shallow structure for fast XLA compiles: a 32-limb schoolbook
+        product with lo/hi halfword split (accumulated sums < 2^21, depth
+        16), then 16 reduction steps each adding m_i*p as one 16-wide
+        vector MAC — only a single scalar carry is chained between steps
+        (depth ~4 per step), not a full 16-limb chain.
+
+        Bounds: acc limbs < 2^21 (product) + 2^21 (reduction adds) < 2^22;
+        the chained carry c < 2^17 (inductively: ti < 2^22 + 2^17 < 2^23,
+        ti + lo0 < 2^24, so c <= (2^16-1) + 2^8 < 2^17).  Final value
+        < 2p, so one (possibly forced) subtraction of p canonicalizes.
+        """
+        batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+        acc = jnp.zeros((*batch, 2 * NLIMB), jnp.uint32)
+        for i in range(NLIMB):
+            prod = a[..., i : i + 1] * b
+            acc = acc.at[..., i : i + NLIMB].add(prod & MASK16)
+            acc = acc.at[..., i + 1 : i + NLIMB + 1].add(prod >> 16)
+        n0p = jnp.uint32(self.n0p)
+        p_vec = jnp.asarray(self.p_limbs, jnp.uint32)
+        c = jnp.zeros(batch, jnp.uint32)
+        for i in range(NLIMB):
+            ti = acc[..., i] + c
+            m = (ti * n0p) & MASK16
+            mp = m[..., None] * p_vec
+            lo = mp & MASK16
+            hi = mp >> 16
+            # position i is consumed: (ti + lo0) ≡ 0 mod 2^16 by choice of m
+            c = hi[..., 0] + ((ti + lo[..., 0]) >> 16)
+            acc = acc.at[..., i + 1 : i + NLIMB].add(lo[..., 1:])
+            acc = acc.at[..., i + 2 : i + NLIMB + 1].add(hi[..., 1:])
+        r = acc[..., NLIMB:]
+        r = r.at[..., 0].add(c)
+        outs = []
+        carry = jnp.zeros_like(r[..., 0])
+        for k in range(NLIMB):
+            v = r[..., k] + carry
+            outs.append(v & MASK16)
+            carry = v >> 16
+        r = jnp.stack(outs, axis=-1)
+        return self._cond_sub_p(r, force=carry > 0)
+
+    def square(self, a):
+        return self.mul(a, a)
+
+    def pow_const(self, x, exponent: int):
+        """x^exponent (Montgomery domain) for a compile-time exponent."""
+        nbits = exponent.bit_length()
+        bits = jnp.asarray(
+            [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+            jnp.uint32,
+        )
+        acc0 = self.const(self.one_mont, x.shape[:-1])
+
+        def body(i, acc):
+            acc = self.square(acc)
+            return jnp.where(bits[i] == 1, self.mul(acc, x), acc)
+
+        return lax.fori_loop(0, nbits, body, acc0)
+
+    def inv(self, x):
+        """x^-1 via Fermat (x^(p-2)); 0 -> 0."""
+        return self.pow_const(x, self.p_int - 2)
+
+    def is_zero(self, a):
+        return jnp.all(a == 0, axis=-1)
+
+    def eq(self, a, b):
+        return jnp.all(a == b, axis=-1)
+
+
+# The two curve fields (SEC2 primes).
+P_K1 = 2**256 - 2**32 - 977
+P_R1 = 2**256 - 2**224 + 2**192 + 2**96 - 1
+
+FIELD_K1 = MontField(P_K1)
+FIELD_R1 = MontField(P_R1)

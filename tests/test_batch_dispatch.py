@@ -1,0 +1,65 @@
+"""Mixed-scheme batch dispatch (BASELINE.md 'mixed-scheme batch' config):
+verify_batch buckets by scheme and returns positionally-correct verdicts
+regardless of which bucket (device kernel or host) handled each item."""
+import pytest
+
+from corda_tpu.core.crypto import (
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+    RSA_SHA256,
+    crypto,
+)
+from corda_tpu.core.crypto import batch as crypto_batch
+
+
+def _items(schemes, tamper_idx=()):
+    items = []
+    for i, scheme in enumerate(schemes):
+        kp = crypto.generate_keypair(scheme)
+        content = b"mixed %d" % i
+        sig = crypto.do_sign(kp.private, content)
+        if i in tamper_idx:
+            content = b"tampered %d" % i
+        items.append((kp.public, sig, content))
+    return items
+
+
+def test_mixed_scheme_host_path():
+    schemes = [
+        EDDSA_ED25519_SHA512, ECDSA_SECP256K1_SHA256,
+        ECDSA_SECP256R1_SHA256, RSA_SHA256, EDDSA_ED25519_SHA512,
+    ]
+    items = _items(schemes, tamper_idx={1, 4})
+    out = crypto_batch.verify_batch(items)
+    assert out == [True, False, True, True, False]
+
+
+def test_ed25519_bucket_hits_device_kernel(monkeypatch):
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
+    calls = {}
+    from corda_tpu import ops
+
+    real = ops.ed25519_verify_batch
+
+    def spy(*a, **k):
+        calls["hit"] = True
+        return real(*a, **k)
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", spy)
+    items = _items([EDDSA_ED25519_SHA512] * 5, tamper_idx={2})
+    out = crypto_batch.verify_batch(items)
+    assert out == [True, True, False, True, True]
+    assert calls.get("hit")
+
+
+def test_small_buckets_stay_on_host(monkeypatch):
+    from corda_tpu import ops
+
+    def boom(*a, **k):
+        raise AssertionError("device kernel must not run for tiny buckets")
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", boom)
+    monkeypatch.setattr(ops, "ecdsa_verify_batch", boom)
+    items = _items([EDDSA_ED25519_SHA512, ECDSA_SECP256K1_SHA256])
+    assert crypto_batch.verify_batch(items) == [True, True]

@@ -1,0 +1,133 @@
+"""PBFT notary consensus tests (coverage parity with the reference's
+BFTNotaryServiceTests): normal-case commit, replica-down progress,
+uniqueness conflicts, duplicate-request dedup, primary-failure view change.
+Deterministic pumping, no wall clock."""
+from collections import deque
+
+import pytest
+
+from corda_tpu.node.bft import BFTClient, BFTReplica
+
+
+class BFTCluster:
+    def __init__(self, n=4):
+        self.queue = deque()
+        self.partitioned = set()
+        self.n = n
+        self.applied = {i: [] for i in range(n)}
+        self.uniqueness = {i: {} for i in range(n)}
+        self.replicas = []
+        self.client = BFTClient("client-0", n, self._client_send)
+
+        def make_apply(idx):
+            def apply(command):
+                self.applied[idx].append(command)
+                conflicts = {}
+                umap = self.uniqueness[idx]
+                for key, txid in command["entries"].items():
+                    if key in umap and umap[key] != txid:
+                        conflicts[key] = umap[key]
+                if not conflicts:
+                    umap.update(command["entries"])
+                return {"conflicts": conflicts}
+            return apply
+
+        def make_transport(src):
+            def transport(dst, payload):
+                self.queue.append(("replica", src, dst, payload))
+            return transport
+
+        def make_reply(idx):
+            def reply(client_id, request_id, result):
+                self.queue.append(("reply", idx, request_id, result))
+            return reply
+
+        for i in range(n):
+            self.replicas.append(
+                BFTReplica(i, n, make_transport(i), make_apply(i), make_reply(i))
+            )
+
+    def _client_send(self, replica_id, request):
+        self.queue.append(("request", None, replica_id, request))
+
+    def pump(self, max_rounds=5000):
+        rounds = 0
+        while self.queue and rounds < max_rounds:
+            item = self.queue.popleft()
+            rounds += 1
+            kind = item[0]
+            if kind == "replica":
+                _, src, dst, payload = item
+                if src in self.partitioned or dst in self.partitioned:
+                    continue
+                self.replicas[dst].on_message(src, payload)
+            elif kind == "request":
+                _, _, dst, request = item
+                if dst in self.partitioned:
+                    continue
+                self.replicas[dst].on_request(request)
+            elif kind == "reply":
+                _, idx, request_id, result = item
+                if idx in self.partitioned:
+                    continue
+                self.client.on_reply(request_id, result)
+
+    def tick_all(self, now):
+        for i, r in enumerate(self.replicas):
+            if i not in self.partitioned:
+                r.tick(now)
+        self.pump()
+
+
+class TestBFT:
+    def test_normal_commit(self):
+        c = BFTCluster(4)
+        fut = c.client.submit({"entries": {"s1": "tx1"}})
+        c.pump()
+        assert fut.result(timeout=0) == {"conflicts": {}}
+        # every replica executed it exactly once
+        assert all(len(c.applied[i]) == 1 for i in range(4))
+
+    def test_conflict_detected_consistently(self):
+        c = BFTCluster(4)
+        f1 = c.client.submit({"entries": {"s1": "tx1"}})
+        c.pump()
+        f1.result(timeout=0)
+        f2 = c.client.submit({"entries": {"s1": "tx2"}})
+        c.pump()
+        assert f2.result(timeout=0) == {"conflicts": {"s1": "tx1"}}
+        # idempotent re-commit of the original is clean
+        f3 = c.client.submit({"entries": {"s1": "tx1"}, "nonce": 1})
+        c.pump()
+        assert f3.result(timeout=0) == {"conflicts": {}}
+
+    def test_progress_with_one_replica_down(self):
+        c = BFTCluster(4)
+        c.partitioned.add(3)  # f = 1 tolerated
+        fut = c.client.submit({"entries": {"k": "t"}})
+        c.pump()
+        assert fut.result(timeout=0) == {"conflicts": {}}
+
+    def test_no_progress_with_two_down_f1(self):
+        c = BFTCluster(4)
+        c.partitioned.update({2, 3})
+        fut = c.client.submit({"entries": {"k": "t"}})
+        c.pump()
+        assert not fut.done()
+
+    def test_primary_failure_view_change(self):
+        c = BFTCluster(4)
+        c.partitioned.add(0)  # primary of view 0 is dead
+        fut = c.client.submit({"entries": {"k": "t"}})
+        c.pump()
+        assert not fut.done()
+        # non-primaries time out waiting for the primary and change view
+        t = 0.0
+        for _ in range(12):
+            t += 10.0
+            c.tick_all(t)
+            if fut.done():
+                break
+        assert fut.result(timeout=0) == {"conflicts": {}}
+        live_views = {r.view for i, r in enumerate(c.replicas) if i != 0}
+        assert live_views == {1}

@@ -1,0 +1,79 @@
+"""ECDSA batch kernel tests (differential vs the host oracle).
+
+Small batches (pad 8) so each curve's 256-bit ladder compiles once; the
+compile dominates runtime on the CPU CI backend.
+"""
+import numpy as np
+import pytest
+
+from corda_tpu.core.crypto import (
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    crypto,
+)
+from corda_tpu.core.crypto.secp_math import SECP256K1, der_encode_sig, ecdsa_sign
+from corda_tpu.ops import ecdsa_batch
+
+CURVES = [
+    (ECDSA_SECP256K1_SHA256, "secp256k1"),
+    (ECDSA_SECP256R1_SHA256, "secp256r1"),
+]
+
+
+@pytest.mark.parametrize("scheme,cname", CURVES)
+def test_valid_and_forged_batch(scheme, cname):
+    pubs, sigs, msgs = [], [], []
+    for i in range(8):
+        kp = crypto.generate_keypair(scheme)
+        m = b"ecdsa message %d" % i
+        pubs.append(kp.public.encoded)
+        sigs.append(crypto.do_sign(kp.private, m))
+        msgs.append(m)
+    msgs[2] = b"forged content"       # digest mismatch
+    sigs[5] = sigs[4]                 # signature for another key/message
+    out = ecdsa_batch.verify_batch(cname, pubs, sigs, msgs)
+    expected = [True, True, False, True, True, False, True, True]
+    assert out == expected
+    # differential: host oracle agrees on every row
+    from corda_tpu.core.crypto.keys import SchemePublicKey
+
+    host = [
+        crypto.is_valid(
+            SchemePublicKey(scheme.scheme_code_name, pubs[i]), sigs[i], msgs[i]
+        )
+        for i in range(8)
+    ]
+    assert host == expected
+
+
+def test_malformed_rows_are_false_not_errors():
+    kp = crypto.generate_keypair(ECDSA_SECP256K1_SHA256)
+    m = b"x"
+    good = (kp.public.encoded, crypto.do_sign(kp.private, m), m)
+    rows = [
+        good,
+        (b"\x02" + b"\xff" * 32, good[1], m),   # x not on curve
+        (good[0], b"\x30\x02\x01\x01", m),      # truncated DER
+        (good[0], der_encode_sig(0, 5), m),     # r = 0
+        (good[0], der_encode_sig(SECP256K1.n, 5), m),  # r = n
+    ]
+    out = ecdsa_batch.verify_batch(
+        "secp256k1",
+        [r[0] for r in rows], [r[1] for r in rows], [r[2] for r in rows],
+    )
+    assert out == [True, False, False, False, False]
+
+
+def test_high_s_and_rfc6979_vectors():
+    # deterministic signing: same (key, msg) -> same sig; kernel verifies it
+    curve = SECP256K1
+    priv = 0xC9AFA9D845BA75166B5C215767B1D6934E50C3DB36E89B127B8A622B120F6721
+    pub = curve.mul(priv, curve.g)
+    msg = b"sample"
+    r, s = ecdsa_sign(curve, priv, msg)
+    der = der_encode_sig(r, s)
+    out = ecdsa_batch.verify_batch(
+        "secp256k1",
+        [curve.encode_point(pub)] * 2, [der, der], [msg, b"not sample"],
+    )
+    assert out == [True, False]
