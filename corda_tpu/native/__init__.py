@@ -6,7 +6,8 @@ prehash) and the broker's durable journal — mirroring where the reference
 relies on JVM-native machinery (JDK MessageDigest intrinsics, Artemis's
 journal).
 
-Compiled on first import with g++ into build/ (cached by source mtime);
+Compiled on first import with g++ into build/ (staleness keyed on a
+SHA-256 of the sources — git checkouts don't preserve mtimes);
 everything degrades gracefully to pure-Python fallbacks when no compiler
 is available (`available()` reports which backend is active).
 """
@@ -34,16 +35,33 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         os.path.join(_SRC, "journal.cpp"),
     ]
     so_path = os.path.join(_BUILD, "corda_native.so")
+    stamp_path = so_path + ".srchash"
     try:
         os.makedirs(_BUILD, exist_ok=True)
-        src_mtime = max(os.path.getmtime(s) for s in sources)
-        if not os.path.exists(so_path) or os.path.getmtime(so_path) < src_mtime:
+        # Staleness by source hash, not mtime: git checkout does not
+        # preserve mtimes, so a stale binary could otherwise survive a
+        # fresh clone.  (The build/ dir is gitignored; the .so is never
+        # shipped, always compiled from source on first use.)
+        import hashlib
+
+        h = hashlib.sha256()
+        for s in sources:
+            with open(s, "rb") as fh:
+                h.update(fh.read())
+        src_hash = h.hexdigest()
+        stamp = None
+        if os.path.exists(stamp_path):
+            with open(stamp_path) as fh:
+                stamp = fh.read().strip()
+        if not os.path.exists(so_path) or stamp != src_hash:
             cmd = [
                 "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                 "-o", so_path + ".tmp", *sources,
             ]
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(so_path + ".tmp", so_path)
+            with open(stamp_path, "w") as fh:
+                fh.write(src_hash)
         lib = ctypes.CDLL(so_path)
         lib.sha256_batch.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),

@@ -33,6 +33,21 @@ def _digest(request: dict) -> bytes:
     return hashlib.sha256(serialize(request)).digest()
 
 
+def dev_signing_seed(replica_id: int) -> bytes:
+    """Deterministic DEV-ONLY replica signing seed.
+
+    Lets tests and single-operator clusters skip key distribution; any
+    party can derive these, so production clusters MUST pass their own
+    `signing_seed` + `replica_pubs` to BFTReplica.
+    """
+    return hashlib.sha256(b"corda-tpu-bft-dev-key:%d" % replica_id).digest()
+
+
+def _prepare_statement(view: int, seq: int, digest: bytes) -> bytes:
+    """Canonical byte statement a prepare signature covers."""
+    return b"bft-prepare\x00" + serialize({"v": view, "s": seq, "d": digest})
+
+
 class BFTReplica:
     """One PBFT replica.
 
@@ -51,14 +66,26 @@ class BFTReplica:
         transport: Callable[[int, bytes], None],
         apply_fn: Callable[[dict], object],
         reply_fn: Callable[[str, str, object], None],
+        signing_seed: Optional[bytes] = None,
+        replica_pubs: Optional[Dict[int, bytes]] = None,
     ):
         assert n_replicas >= 4, "BFT needs n >= 3f+1 with f >= 1"
+        from ..core.crypto import ed25519_math
+
         self.id = replica_id
         self.n = n_replicas
         self.f = (n_replicas - 1) // 3
         self.transport = transport
         self.apply_fn = apply_fn
         self.reply_fn = reply_fn
+        # Replica signing identity: prepare votes are ed25519-signed so a
+        # view-change message can carry a self-certifying prepared
+        # certificate (2f+1 verifiable prepare signatures) per PBFT.
+        self._signing_seed = signing_seed or dev_signing_seed(replica_id)
+        self.replica_pubs = replica_pubs or {
+            i: ed25519_math.public_from_seed(dev_signing_seed(i))
+            for i in range(n_replicas)
+        }
         self.view = 0
         self.next_seq = 0  # primary's sequence counter
         self.last_executed = -1
@@ -68,8 +95,9 @@ class BFTReplica:
         # votes keyed (view, seq, digest): PBFT quorums are per-view
         self.prepares: Dict[Tuple[int, int, bytes], Set[int]] = {}
         self.commits: Dict[Tuple[int, int, bytes], Set[int]] = {}
-        # carried-over prepared claims during view change: (seq, digest) -> voters
-        self._vc_prepared_claims: Dict[Tuple[int, bytes], Set[int]] = {}
+        # signed prepare votes backing the prepared certificates:
+        # (view, seq, digest) -> {voter: signature}
+        self.prepare_sigs: Dict[Tuple[int, int, bytes], Dict[int, bytes]] = {}
         self.committed: Dict[int, bytes] = {}  # seq -> digest (quorum reached)
         self.executed: Set[int] = set()
         # view change
@@ -96,6 +124,30 @@ class BFTReplica:
                 except Exception:
                     pass
 
+    # -- prepare-vote signatures ---------------------------------------------
+
+    def _sign_prepare(self, view: int, seq: int, d: bytes) -> bytes:
+        from ..core.crypto import ed25519_math
+
+        return ed25519_math.sign(
+            self._signing_seed, _prepare_statement(view, seq, d)
+        )
+
+    def _verify_prepare_sig(
+        self, voter: int, view: int, seq: int, d: bytes, sig: object
+    ) -> bool:
+        from ..core.crypto import ed25519_math
+
+        pub = self.replica_pubs.get(voter)
+        if pub is None or not isinstance(sig, (bytes, bytearray)):
+            return False
+        try:
+            return ed25519_math.verify(
+                pub, _prepare_statement(view, seq, d), bytes(sig)
+            )
+        except Exception:
+            return False
+
     # -- client request entry ------------------------------------------------
 
     def on_request(self, request: dict) -> None:
@@ -109,11 +161,12 @@ class BFTReplica:
             seq = self.next_seq
             self.next_seq += 1
             self.pre_prepares[seq] = d
+            psig = self._sign_prepare(self.view, seq, d)
             self._broadcast({
                 "kind": "pre_prepare", "view": self.view, "seq": seq,
-                "digest": d, "request": request,
+                "digest": d, "request": request, "psig": psig,
             })
-            self._record_prepare(seq, d, self.id)
+            self._record_prepare(seq, d, self.id, psig)
         else:
             try:
                 self.transport(self.primary, serialize({
@@ -134,8 +187,17 @@ class BFTReplica:
         elif kind == "pre_prepare":
             self._on_pre_prepare(sender, msg)
         elif kind == "prepare":
-            if msg["view"] == self.view and self._seq_in_window(msg["seq"]):
-                self._record_prepare(msg["seq"], msg["digest"], sender)
+            if (
+                msg["view"] == self.view
+                and self._seq_in_window(msg["seq"])
+                and self._verify_prepare_sig(
+                    sender, msg["view"], msg["seq"], msg["digest"],
+                    msg.get("psig"),
+                )
+            ):
+                self._record_prepare(
+                    msg["seq"], msg["digest"], sender, msg["psig"]
+                )
         elif kind == "commit":
             if msg["view"] == self.view and self._seq_in_window(msg["seq"]):
                 self._record_commit(msg["seq"], msg["digest"], sender)
@@ -159,20 +221,27 @@ class BFTReplica:
             return
         if seq in self.pre_prepares and self.pre_prepares[seq] != d:
             return  # equivocation: ignore (view change will handle)
+        if not self._verify_prepare_sig(
+            sender, msg["view"], seq, d, msg.get("psig")
+        ):
+            return  # unsigned/forged pre-prepare
         self.pre_prepares[seq] = d
         self.requests[d] = msg["request"]
         self._pending_since = None  # primary is alive
+        own = self._sign_prepare(self.view, seq, d)
         self._broadcast({
             "kind": "prepare", "view": self.view, "seq": seq, "digest": d,
+            "psig": own,
         })
-        self._record_prepare(seq, d, sender)
-        self._record_prepare(seq, d, self.id)
+        self._record_prepare(seq, d, sender, msg["psig"])
+        self._record_prepare(seq, d, self.id, own)
 
-    def _record_prepare(self, seq: int, d: bytes, voter: int) -> None:
+    def _record_prepare(self, seq: int, d: bytes, voter: int, sig: bytes) -> None:
         votes = self.prepares.setdefault((self.view, seq, d), set())
         if voter in votes:
             return
         votes.add(voter)
+        self.prepare_sigs.setdefault((self.view, seq, d), {})[voter] = sig
         # prepared: pre-prepare + 2f prepares (incl. our own vote counting)
         if len(votes) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d:
             ckey = (self.view, seq, d)
@@ -218,26 +287,29 @@ class BFTReplica:
             self._pending_since = None
             self._start_view_change(self.view + 1)
 
+    def _prepared_certificates(self) -> List[list]:
+        """Self-certifying prepared entries: [seq, digest, request,
+        prepared_view, [[voter, sig], ...]] with >= 2f+1 verifiable prepare
+        signatures each — a single view-change message proves preparedness
+        (PBFT's P set), so a committed request can never be dropped just
+        because few members of the new-view quorum saw it prepare."""
+        out = []
+        for (view, seq, d), voters in self.prepares.items():
+            if len(voters) < 2 * self.f + 1 or self.pre_prepares.get(seq) != d:
+                continue
+            sigs = self.prepare_sigs.get((view, seq, d), {})
+            cert = [[v, sigs[v]] for v in sorted(sigs)][: 2 * self.f + 1]
+            if len(cert) >= 2 * self.f + 1 and d in self.requests:
+                out.append([seq, d, self.requests[d], view, cert])
+        return out
+
     def _start_view_change(self, new_view: int) -> None:
         votes = self.view_change_votes.setdefault(new_view, set())
         votes.add(self.id)
         self._broadcast({
             "kind": "view_change", "new_view": new_view,
-            # prepared claims: (seq, digest, request) we locally prepared.
-            # Receivers only honor a claim corroborated by f+1 distinct
-            # replicas (at least one honest), so a single Byzantine replica
-            # cannot inject commands. (Production hardening: signed
-            # prepared certificates per PBFT.)
-            "prepared": [
-                [seq, d, self.requests.get(d)]
-                for (view, seq, d), v in self.prepares.items()
-                if len(v) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d
-            ],
+            "prepared": self._prepared_certificates(),
         })
-        # our own claims count toward the f+1 corroboration
-        for (view, seq, d), v in self.prepares.items():
-            if len(v) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d:
-                self._vc_prepared_claims.setdefault((seq, d), set()).add(self.id)
         self._maybe_enter_view(new_view)
 
     def _on_view_change(self, sender: int, msg: dict) -> None:
@@ -246,13 +318,23 @@ class BFTReplica:
             return
         votes = self.view_change_votes.setdefault(new_view, set())
         votes.add(sender)
-        for seq, d, request in msg["prepared"]:
-            if request is None or _digest(request) != d:
+        for claim in msg["prepared"]:
+            # the whole claim is attacker-controlled: any shape error in the
+            # tuple OR the certificate entries must not crash the replica
+            try:
+                seq, d, request, prep_view, cert = claim
+                if request is None or _digest(request) != d:
+                    continue
+                # verify the prepared certificate: 2f+1 distinct replicas'
+                # signatures over the prepare statement (prep_view, seq, d)
+                valid_voters = {
+                    voter
+                    for voter, sig in cert
+                    if self._verify_prepare_sig(voter, prep_view, seq, d, sig)
+                }
+            except (TypeError, ValueError):
                 continue  # malformed claim
-            claims = self._vc_prepared_claims.setdefault((seq, d), set())
-            claims.add(sender)
-            # carry over only once f+1 replicas (>= one honest) corroborate
-            if len(claims) >= self.f + 1:
+            if len(valid_voters) >= 2 * self.f + 1:
                 self.requests[d] = request
                 self.pre_prepares.setdefault(seq, d)
         # join the view change once f+1 replicas demand it
@@ -271,12 +353,13 @@ class BFTReplica:
                 self._broadcast({"kind": "new_view", "view": self.view})
                 for seq, d in sorted(self.pre_prepares.items()):
                     if seq > self.last_executed and d in self.requests:
+                        psig = self._sign_prepare(self.view, seq, d)
                         self._broadcast({
                             "kind": "pre_prepare", "view": self.view,
                             "seq": seq, "digest": d,
-                            "request": self.requests[d],
+                            "request": self.requests[d], "psig": psig,
                         })
-                        self._record_prepare(seq, d, self.id)
+                        self._record_prepare(seq, d, self.id, psig)
                 # pending client requests that never got a seq
                 for d, request in list(self.requests.items()):
                     if d not in self.pre_prepares.values():
@@ -290,8 +373,11 @@ class BFTReplica:
 
 class BFTClient:
     """Client proxy: broadcast the command to every replica, accept the
-    result once f+1 identical replies arrive (reference BFTSMaRt.Client
-    response extractor)."""
+    result once f+1 DISTINCT replicas return identical replies (reference
+    BFTSMaRt.Client response extractor aggregating >= requiredReplies).
+    Deduplication by replica identity is what makes the quorum Byzantine-
+    safe: one faulty replica repeating a fabricated verdict f+1 times must
+    not be able to forge a result."""
 
     def __init__(self, client_id: str, n_replicas: int,
                  send_to_replica: Callable[[int, dict], None]):
@@ -300,7 +386,8 @@ class BFTClient:
         self.f = (n_replicas - 1) // 3
         self._send = send_to_replica
         self._pending: Dict[str, Future] = {}
-        self._replies: Dict[str, List[object]] = {}
+        # request_id -> {replica_id: result}: one vote per replica
+        self._replies: Dict[str, Dict[int, object]] = {}
         self._counter = 0
         self._lock = threading.Lock()
 
@@ -310,7 +397,7 @@ class BFTClient:
             request_id = f"{self.client_id}:{self._counter}"
             fut: Future = Future()
             self._pending[request_id] = fut
-            self._replies[request_id] = []
+            self._replies[request_id] = {}
         fut.request_id = request_id  # lets callers forget() on timeout
         request = {
             "client_id": self.client_id, "request_id": request_id,
@@ -329,15 +416,19 @@ class BFTClient:
             self._pending.pop(request_id, None)
             self._replies.pop(request_id, None)
 
-    def on_reply(self, request_id: str, result: object) -> None:
+    def on_reply(self, replica_id: int, request_id: str, result: object) -> None:
         with self._lock:
             fut = self._pending.get(request_id)
             if fut is None or fut.done():
                 return
             replies = self._replies[request_id]
-            replies.append(result)
+            if not (isinstance(replica_id, int) and 0 <= replica_id < self.n):
+                return  # fabricated ids must not mint extra quorum votes
+            if replica_id in replies:
+                return  # one vote per replica: repeats can't inflate quorum
+            replies[replica_id] = result
             blob = serialize(result)
-            matching = sum(1 for r in replies if serialize(r) == blob)
+            matching = sum(1 for r in replies.values() if serialize(r) == blob)
             if matching >= self.f + 1:
                 self._pending.pop(request_id)
                 self._replies.pop(request_id)

@@ -271,6 +271,14 @@ register_adapter(
 )
 
 
+def notary_tearoff_filter(obj: object) -> bool:
+    """What a non-validating notary may see: inputs (StateRef), the time
+    window, and the notary identity (Party).  Outputs, commands and
+    attachments stay pruned — that privacy is the point of the tear-off
+    (reference NotaryFlow.Client, NotaryFlow.kt:66-74)."""
+    return isinstance(obj, (StateRef, TimeWindow, Party))
+
+
 @initiating_flow
 class NotaryClientFlow(FlowLogic):
     """Client side (reference NotaryFlow.Client, NotaryFlow.kt:33-95)."""
@@ -297,8 +305,14 @@ class NotaryClientFlow(FlowLogic):
         if validating:
             payload = NotarisationPayload(stx, None)
         else:
+            # Reveal only what a non-validating notary needs: inputs
+            # (StateRef), the time window, and the notary identity (Party).
+            # Outputs/commands/attachments stay pruned to hashes — the
+            # privacy point of the tear-off (reference NotaryFlow.Client).
+            # check_all_inputs_revealed + the GROUP_SIZES leaf give the
+            # notary completeness without a full reveal.
             wtx = stx.tx
-            ftx = wtx.build_filtered_transaction(lambda obj: True)
+            ftx = wtx.build_filtered_transaction(notary_tearoff_filter)
             payload = NotarisationPayload(None, ftx)
         response = yield self.send_and_receive_with_retry(
             notary, payload, NotarisationResponse

@@ -57,7 +57,15 @@ def _sharded_step(mesh):
 
     from ..ops import ed25519_batch
 
-    key = (id(mesh), mesh.axis_names)
+    # Content-based key: id(mesh) could be reused by a new mesh after the
+    # old one is garbage-collected, resurrecting a closure over dead
+    # devices.  Device objects are per-backend singletons, so two meshes
+    # with the same (platform, device-id) layout share one executable.
+    key = (
+        tuple((d.platform, d.id) for d in mesh.devices.flat),
+        mesh.devices.shape,
+        mesh.axis_names,
+    )
     cached = _SHARDED_STEP_CACHE.get(key)
     if cached is not None:
         return cached

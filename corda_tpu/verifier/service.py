@@ -80,11 +80,19 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
 
 
 class _Metrics:
+    """Counters plus a bounded reservoir of recent durations: the loadtest
+    firehose would grow an unbounded list without limit (a slow leak under
+    sustained load), and percentile reporting only needs a recent window."""
+
+    MAX_DURATIONS = 4096
+
     def __init__(self):
+        from collections import deque
+
         self.success = 0
         self.failure = 0
         self.in_flight = 0
-        self.durations: List[float] = []
+        self.durations: "deque[float]" = deque(maxlen=self.MAX_DURATIONS)
 
 
 class OutOfProcessTransactionVerifierService(TransactionVerifierService):

@@ -70,7 +70,7 @@ class BFTCluster:
                 _, idx, request_id, result = item
                 if idx in self.partitioned:
                     continue
-                self.client.on_reply(request_id, result)
+                self.client.on_reply(idx, request_id, result)
 
     def tick_all(self, now):
         for i, r in enumerate(self.replicas):
@@ -114,6 +114,61 @@ class TestBFT:
         fut = c.client.submit({"entries": {"k": "t"}})
         c.pump()
         assert not fut.done()
+
+    def test_repeated_reply_from_one_replica_cannot_forge_quorum(self):
+        # A single Byzantine replica repeating a fabricated verdict f+1
+        # times must not resolve the future (advisor finding, round 1).
+        c = BFTCluster(4)
+        fut = c.client.submit({"entries": {"s1": "tx1"}})
+        request_id = fut.request_id
+        forged = {"conflicts": {"forged": "yes"}}
+        for _ in range(3):
+            c.client.on_reply(3, request_id, forged)
+        assert not fut.done()
+        # and the honest quorum still wins
+        c.pump()
+        assert fut.result(timeout=0) == {"conflicts": {}}
+
+    def test_view_change_claim_without_certificate_rejected(self):
+        # A prepared claim must carry 2f+1 verifiable prepare signatures;
+        # an uncertified (or self-signed-only) claim is ignored.
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.bft import _digest
+
+        c = BFTCluster(4)
+        evil_request = {
+            "client_id": "client-0", "request_id": "client-0:999",
+            "command": {"entries": {"stolen": "tx-evil"}},
+        }
+        d = _digest(evil_request)
+        evil_sig = c.replicas[3]._sign_prepare(0, 0, d)
+        msg = {
+            "kind": "view_change", "new_view": 1,
+            "prepared": [[0, d, evil_request, 0, [[3, evil_sig]]]],
+        }
+        c.replicas[1].on_message(3, serialize(msg))
+        assert d not in c.replicas[1].requests
+        assert c.replicas[1].pre_prepares.get(0) != d
+
+    def test_view_change_certificate_carries_prepared_request(self):
+        # A claim backed by a genuine 2f+1 certificate IS honored from a
+        # single message (PBFT P-set semantics).
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.bft import _digest
+
+        c = BFTCluster(4)
+        fut = c.client.submit({"entries": {"s1": "tx1"}})
+        c.pump()
+        fut.result(timeout=0)
+        # replica 0 prepared seq 0 in view 0: reuse its real certificate
+        certs = c.replicas[0]._prepared_certificates()
+        assert certs, "replica 0 should hold a prepared certificate"
+        fresh = BFTCluster(4)  # a replica with no history
+        msg = {"kind": "view_change", "new_view": 1, "prepared": certs}
+        fresh.replicas[1].on_message(3, serialize(msg))
+        seq, d = certs[0][0], certs[0][1]
+        assert fresh.replicas[1].pre_prepares.get(seq) == d
+        assert d in fresh.replicas[1].requests
 
     def test_primary_failure_view_change(self):
         c = BFTCluster(4)

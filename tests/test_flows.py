@@ -373,6 +373,18 @@ class TestNotaryAndFinality:
         h2 = alice.start_flow(FinalityFlow(move), move)
         net2.run_network()
         h2.result.result(timeout=1)  # tear-off notarisation succeeded
+
+        # Privacy regression (advisor, round 1): the client tear-off must
+        # hide outputs/commands from the notary while revealing all inputs,
+        # the time window, and the notary identity.
+        from corda_tpu.node.notary import notary_tearoff_filter
+
+        ftx = move.tx.build_filtered_transaction(notary_tearoff_filter)
+        ftx.verify()
+        ftx.check_all_inputs_revealed()
+        assert ftx.inputs == list(move.tx.inputs)
+        assert ftx.outputs == []
+        assert ftx.commands == []
         net2.stop_nodes()
 
 
