@@ -1,54 +1,63 @@
 """Headline benchmark: batched ed25519 signature verification throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline target (BASELINE.json): >= 1,000,000 sig-verifies/sec on a v5e-4,
 i.e. 250k/sec/chip; vs_baseline is measured-chip-rate / 250_000.
 
-Runs on whatever backend JAX selects (the driver provides one real TPU chip;
-falls back to CPU in dev environments).
+Measures END-TO-END throughput — byte parsing + SHA-512 prehash on the
+host AND the device kernel — through the production `verify_batch`
+pipeline (chunked host/device overlap), not the kernel alone (round-1
+bench measured only the kernel; VERDICT round 1 called that out).
+
+Runs on whatever backend JAX selects (the driver provides one real TPU
+chip; the Pallas ladder kernel is used there, the portable XLA kernel
+elsewhere).
 """
 import json
 import time
 
 import numpy as np
 
-BATCH = 16384
+BATCH = 131072  # two pipeline chunks
 PER_CHIP_BASELINE = 250_000.0  # 1M/s on 4 chips
 
 
 def main() -> None:
     import jax
 
+    import corda_tpu  # noqa: F401  (enables the persistent compile cache)
     from corda_tpu.core.crypto import ed25519_math
     from corda_tpu.ops import ed25519_batch
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = BATCH if on_tpu else 4096  # CPU fallback kernel is ~100x slower
 
     rng = np.random.default_rng(7)
     n_keys = 256  # realistic notary batch: many txs from few parties
     seeds = [rng.bytes(32) for _ in range(n_keys)]
     pubs_pool = [ed25519_math.public_from_seed(s) for s in seeds]
-    pubs, sigs, msgs = [], [], []
-    for i in range(BATCH):
-        k = i % n_keys
+    sig_pool = []
+    msg_pool = []
+    for k in range(n_keys):
         msg = rng.bytes(64)
-        pubs.append(pubs_pool[k])
-        sigs.append(ed25519_math.sign(seeds[k], msg))
-        msgs.append(msg)
+        sig_pool.append(ed25519_math.sign(seeds[k], msg))
+        msg_pool.append(msg)
+    pubs = [pubs_pool[i % n_keys] for i in range(batch)]
+    sigs = [sig_pool[i % n_keys] for i in range(batch)]
+    msgs = [msg_pool[i % n_keys] for i in range(batch)]
 
-    kwargs, n = ed25519_batch.prepare_batch(pubs, sigs, msgs, pad_to=BATCH)
-
-    # warm-up: compile + one execution
-    mask = ed25519_batch.verify_kernel(**kwargs)
-    mask.block_until_ready()
+    # warm-up: compile + one full pipeline execution
+    mask = ed25519_batch.verify_batch(pubs, sigs, msgs)
     assert bool(np.asarray(mask).all()), "benchmark batch failed to verify"
 
     reps = 3
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        ed25519_batch.verify_kernel(**kwargs).block_until_ready()
+        ed25519_batch.verify_batch(pubs, sigs, msgs)
         best = min(best, time.perf_counter() - t0)
 
-    rate = BATCH / best
+    rate = batch / best
     print(
         json.dumps(
             {
@@ -56,8 +65,9 @@ def main() -> None:
                 "value": round(rate, 1),
                 "unit": "sigs/s",
                 "vs_baseline": round(rate / PER_CHIP_BASELINE, 4),
-                "batch": BATCH,
+                "batch": batch,
                 "backend": jax.devices()[0].platform,
+                "end_to_end": True,
             }
         )
     )

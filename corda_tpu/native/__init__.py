@@ -68,6 +68,10 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64, ctypes.c_char_p,
         ]
         lib.sha512_batch.argtypes = lib.sha256_batch.argtypes
+        lib.sha512_mod_l_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32),
+        ]
         lib.sha256_pair_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
         ]
@@ -108,13 +112,9 @@ def available() -> bool:
 # Batched hashing
 # ---------------------------------------------------------------------------
 
-def _hash_batch(messages: List[bytes], fn_name: str, digest_size: int) -> List[bytes]:
-    lib = _get_lib()
-    if lib is None:
-        import hashlib
-
-        algo = hashlib.sha256 if digest_size == 32 else hashlib.sha512
-        return [algo(m).digest() for m in messages]
+def _marshal(messages: List[bytes]):
+    """Concatenate messages and build the (n+1)-entry offsets array the
+    native batch entry points consume."""
     n = len(messages)
     data = b"".join(messages)
     offsets = (ctypes.c_uint64 * (n + 1))()
@@ -123,6 +123,18 @@ def _hash_batch(messages: List[bytes], fn_name: str, digest_size: int) -> List[b
         offsets[i] = pos
         pos += len(m)
     offsets[n] = pos
+    return data, offsets
+
+
+def _hash_batch(messages: List[bytes], fn_name: str, digest_size: int) -> List[bytes]:
+    lib = _get_lib()
+    if lib is None:
+        import hashlib
+
+        algo = hashlib.sha256 if digest_size == 32 else hashlib.sha512
+        return [algo(m).digest() for m in messages]
+    n = len(messages)
+    data, offsets = _marshal(messages)
     out = ctypes.create_string_buffer(digest_size * n)
     getattr(lib, fn_name)(data, offsets, n, out)
     raw = out.raw
@@ -135,6 +147,35 @@ def sha256_many(messages: List[bytes]) -> List[bytes]:
 
 def sha512_many(messages: List[bytes]) -> List[bytes]:
     return _hash_batch(messages, "sha512_batch", 64)
+
+
+_ED25519_L = 2**252 + 27742317777372353535851937790883648493
+
+
+def sha512_mod_l_many(messages: List[bytes]):
+    """Fused ed25519 prehash: SHA-512 of each message reduced exactly mod
+    the group order L, returned as an (n, 8) uint32 little-endian-word
+    array.  One native pass replaces the per-row Python bigint reduction
+    that bottlenecked host-side batch preparation."""
+    import numpy as np
+
+    n = len(messages)
+    lib = _get_lib()
+    if lib is None:
+        import hashlib
+
+        out = np.empty((n, 8), np.uint32)
+        for i, m in enumerate(messages):
+            h = int.from_bytes(hashlib.sha512(m).digest(), "little") % _ED25519_L
+            out[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint32)
+        return out
+    data, offsets = _marshal(messages)
+    out = np.empty((n, 8), np.uint32)
+    lib.sha512_mod_l_batch(
+        data, offsets, n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out
 
 
 def sha256_pairs(nodes: bytes) -> bytes:

@@ -162,6 +162,127 @@ void sha512_one(const uint8_t* msg, uint64_t len, uint8_t* out) {
 
 }  // namespace
 
+
+// ---------------------------------------------------------------------------
+// Fused ed25519 prehash: h = SHA-512(R || A || M) mod L, written as 8
+// little-endian uint32 words per row.  Moves the per-row Python bigint
+// reduction (the round-2 host-prep bottleneck, ~1.3 us/row) into one C
+// pass (~0.1 us/row).  L = 2^252 + C252 (group order).
+// ---------------------------------------------------------------------------
+
+typedef unsigned __int128 u128;
+
+// L in 64-bit little-endian limbs and C252 = L - 2^252 (125 bits).
+static const uint64_t L_LIMBS[4] = {
+    0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL, 0ULL, 0x1000000000000000ULL,
+};
+static const uint64_t C_LIMBS[2] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL};
+
+// r (5 limbs, < 2^320) -> congruent value < 2^255 (4 limbs), via
+// 2^252 == -C252 (mod L): r = lo252 + (K*L - hi*C252) with
+// K = (hi >> 127) + 1 (so K*L >= hi*C252 because C252 < 2^125).
+static void fold320(const uint64_t v[5], uint64_t out[4]) {
+    // hi = v >> 252 (< 2^68), lo = low 252 bits
+    uint64_t hi0 = (v[3] >> 60) | (v[4] << 4);
+    uint64_t hi1 = v[4] >> 60;
+    uint64_t lo[4] = {v[0], v[1], v[2], v[3] & 0x0FFFFFFFFFFFFFFFULL};
+    // t = hi * C252 (<= 2^193, 4 limbs)
+    uint64_t t[4] = {0, 0, 0, 0};
+    u128 acc = 0;
+    for (int k = 0; k < 4; k++) {
+        acc += (u128)hi0 * (k < 2 ? C_LIMBS[k] : 0);
+        if (k >= 1 && k - 1 < 2) acc += (u128)hi1 * C_LIMBS[k - 1];
+        t[k] = (uint64_t)acc;
+        acc >>= 64;
+    }
+    // K = (hi >> 127) + 1 ; hi < 2^68 so hi >> 127 == 0 unless hi1 >= 2^63
+    uint64_t K = (hi1 >> 63) + 1;
+    // u = K*L - t  (>= 0, < 2*L)
+    uint64_t kl[5] = {0, 0, 0, 0, 0};
+    acc = 0;
+    for (int k = 0; k < 4; k++) {
+        acc += (u128)K * L_LIMBS[k];
+        kl[k] = (uint64_t)acc;
+        acc >>= 64;
+    }
+    kl[4] = (uint64_t)acc;
+    uint64_t u[5];
+    u128 borrow = 0;
+    for (int k = 0; k < 5; k++) {
+        u128 d = (u128)kl[k] - (k < 4 ? t[k] : 0) - borrow;
+        u[k] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    // out = lo + u (< 2^252 + 2^253 < 2^255)
+    u128 carry = 0;
+    for (int k = 0; k < 4; k++) {
+        carry += (u128)lo[k] + u[k];
+        out[k] = (uint64_t)carry;
+        carry >>= 64;
+    }
+}
+
+// r (4 limbs, < 2^255) -> exact r mod L.
+static void mod_l_final(uint64_t r[4]) {
+    // q = r >> 252 (<= 7); r -= q*L; fix up by +/- L.
+    uint64_t q = r[3] >> 60;
+    u128 borrow = 0;
+    uint64_t ql[4];
+    u128 acc = 0;
+    for (int k = 0; k < 4; k++) {
+        acc += (u128)q * L_LIMBS[k];
+        ql[k] = (uint64_t)acc;
+        acc >>= 64;
+    }
+    uint64_t s[4];
+    borrow = 0;
+    for (int k = 0; k < 4; k++) {
+        u128 d = (u128)r[k] - ql[k] - borrow;
+        s[k] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {  // underflow: add L back once (deficit < L)
+        u128 carry = 0;
+        for (int k = 0; k < 4; k++) {
+            carry += (u128)s[k] + L_LIMBS[k];
+            s[k] = (uint64_t)carry;
+            carry >>= 64;
+        }
+    } else {
+        // possibly still >= L (at most once)
+        uint64_t t2[4];
+        u128 b2 = 0;
+        for (int k = 0; k < 4; k++) {
+            u128 d = (u128)s[k] - L_LIMBS[k] - b2;
+            t2[k] = (uint64_t)d;
+            b2 = (d >> 64) ? 1 : 0;
+        }
+        if (!b2) for (int k = 0; k < 4; k++) s[k] = t2[k];
+    }
+    for (int k = 0; k < 4; k++) r[k] = s[k];
+}
+
+static void digest_mod_l(const uint8_t digest[64], uint32_t out_words[8]) {
+    // load digest as 8 little-endian u64 words, Horner from the top:
+    // r = ((...((w7)*2^64 + w6)...)*2^64 + w0) mod-ish L
+    uint64_t w[8];
+    for (int i = 0; i < 8; i++) {
+        uint64_t v = 0;
+        for (int b = 7; b >= 0; b--) v = (v << 8) | digest[8 * i + b];
+        w[i] = v;
+    }
+    uint64_t r[4] = {w[7], 0, 0, 0};
+    for (int i = 6; i >= 0; i--) {
+        uint64_t v[5] = {w[i], r[0], r[1], r[2], r[3]};  // r*2^64 + w[i]
+        fold320(v, r);
+    }
+    mod_l_final(r);
+    for (int k = 0; k < 4; k++) {
+        out_words[2 * k] = (uint32_t)r[k];
+        out_words[2 * k + 1] = (uint32_t)(r[k] >> 32);
+    }
+}
+
 extern "C" {
 
 void sha256_batch(const uint8_t* data, const uint64_t* offsets,
@@ -177,6 +298,15 @@ void sha512_batch(const uint8_t* data, const uint64_t* offsets,
 }
 
 // Merkle level: hash pairs of 32-byte nodes (sha256(l||r)) -> 32-byte out.
+void sha512_mod_l_batch(const uint8_t* data, const uint64_t* offsets,
+                        uint64_t n, uint32_t* out_words) {
+    for (uint64_t i = 0; i < n; i++) {
+        uint8_t digest[64];
+        sha512_one(data + offsets[i], offsets[i+1] - offsets[i], digest);
+        digest_mod_l(digest, out_words + 8 * i);
+    }
+}
+
 void sha256_pair_batch(const uint8_t* nodes, uint64_t n_pairs, uint8_t* out) {
     for (uint64_t i = 0; i < n_pairs; i++)
         sha256_one(nodes + 64*i, 64, out + 32*i);

@@ -93,3 +93,27 @@ class TestNativeJournal:
         assert len(msgs) == 1
         assert msgs[0].payload == b"payload"
         assert msgs[0].message_id == mid
+
+
+def test_sha512_mod_l_matches_bigint():
+    """Fused prehash: SHA-512 reduced exactly mod the ed25519 group order.
+
+    The C reduction (Horner + 2^252 == -c fold, native/src/sha2_batch.cpp)
+    must agree with Python bigint arithmetic on every row — this is
+    consensus-critical (reference parity: i2p sc_reduce semantics used by
+    Crypto.isValid, Crypto.kt:535-541)."""
+    import hashlib
+
+    import numpy as np
+
+    from corda_tpu import native
+
+    L = 2**252 + 27742317777372353535851937790883648493
+    rng = np.random.default_rng(11)
+    msgs = [rng.bytes(int(rng.integers(0, 300))) for _ in range(512)]
+    msgs += [b"", b"\x00" * 128, b"\xff" * 127]
+    out = native.sha512_mod_l_many(msgs)
+    for i, m in enumerate(msgs):
+        h = int.from_bytes(hashlib.sha512(m).digest(), "little") % L
+        expect = np.frombuffer(h.to_bytes(32, "little"), np.uint32)
+        assert (out[i] == expect).all(), i
