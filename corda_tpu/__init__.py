@@ -21,32 +21,3 @@ Layer map (mirrors reference SURVEY.md section 1):
 
 __version__ = "0.1.0"
 platform_version = 1
-
-
-def _enable_compilation_cache() -> None:
-    """Point JAX at a persistent on-disk compilation cache.
-
-    The batch-crypto kernels are expensive to compile (~30 s for the Pallas
-    ladder, minutes for the XLA fallback shapes); caching them across
-    processes keeps test runs and fresh bench/driver invocations fast.
-    Honours an explicit JAX_COMPILATION_CACHE_DIR from the environment.
-    """
-    import os
-
-    try:
-        import jax
-
-        if jax.config.jax_compilation_cache_dir is None:
-            cache_dir = os.environ.get(
-                "JAX_COMPILATION_CACHE_DIR",
-                os.path.join(
-                    os.path.dirname(os.path.dirname(__file__)), ".jax_cache"
-                ),
-            )
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # pragma: no cover - jax absent or too old
-        pass
-
-
-_enable_compilation_cache()

@@ -15,6 +15,7 @@ from ..core.flows import (
     ResolveTransactionsFlow,
     initiated_by,
     initiating_flow,
+    startable_by_rpc,
 )
 from ..core.identity import Party, PartyAndReference
 from ..core.serialization.codec import register_adapter
@@ -81,6 +82,7 @@ def generate_spend(
 # Cash flows
 # ---------------------------------------------------------------------------
 
+@startable_by_rpc
 class CashIssueFlow(FlowLogic):
     """Issue cash on the ledger to a recipient (reference CashIssueFlow).
     We are the issuer; no notarisation needed (no inputs)."""
@@ -110,6 +112,7 @@ class CashIssueFlow(FlowLogic):
         return result
 
 
+@startable_by_rpc
 class CashPaymentFlow(FlowLogic):
     """Pay issued cash to a recipient (reference CashPaymentFlow)."""
 
@@ -139,6 +142,7 @@ class CashPaymentFlow(FlowLogic):
         return result
 
 
+@startable_by_rpc
 class CashExitFlow(FlowLogic):
     """Remove our issued cash from the ledger (reference CashExitFlow)."""
 

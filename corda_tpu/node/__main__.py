@@ -1,0 +1,101 @@
+"""Standalone node process: `python -m corda_tpu.node CONFIG_DIR`.
+
+Reference parity: the production `Node` + `NodeStartup` CLI entry
+(`node/src/main/kotlin/net/corda/node/internal/NodeStartup.kt`,
+`Node.kt:131-160` — embedded Artemis broker + messaging client + RPC).
+The node process hosts:
+
+  * the durable broker (journal under the base directory) behind a TCP
+    `BrokerServer` — the in-process-Artemis analogue; verifier workers and
+    RPC clients connect to this port from other processes;
+  * the node runtime itself (services, state machine, scheduler, optional
+    notary) wired per `AbstractNode`;
+  * the RPC server, served over broker queues so remote clients reach it
+    through the same socket.
+
+On startup the chosen broker port is written to `<base>/broker.port` (the
+driver DSL reads it; mirrors the reference driver's port allocation
+handshake) and a `ready` line is printed.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="corda_tpu.node")
+    ap.add_argument("config_dir", help="directory with node.conf")
+    ap.add_argument("--jax-platform", dest="jax_platform")
+    args = ap.parse_args(argv)
+
+    from .config import load_config
+
+    overrides = {}
+    if args.jax_platform:
+        overrides["jax_platform"] = args.jax_platform
+    cfg = load_config(args.config_dir, overrides)
+
+    if cfg.jax_platform:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", cfg.jax_platform)
+
+    import importlib
+
+    for mod in cfg.cordapps:  # CorDapp scan (AbstractNode.kt:291-315)
+        importlib.import_module(mod)
+
+    from ..messaging import Broker
+    from ..messaging.net import BrokerServer
+    from ..rpc.ops import CordaRPCOps
+    from ..rpc.server import RPCServer, RPCUser
+    from .network import BrokerMessagingService
+    from .node import AbstractNode
+
+    broker = Broker(journal_dir=cfg.journal_dir)
+    server = BrokerServer(broker, host=cfg.broker_host, port=cfg.broker_port)
+    server.start()
+
+    node = AbstractNode(
+        cfg.node,
+        messaging_factory=lambda me: BrokerMessagingService(broker, me),
+        broker=broker,
+    )
+    users = [
+        RPCUser(u["username"], u["password"], set(u.get("permissions", ["ALL"])))
+        for u in cfg.rpc_users
+    ] or None
+    rpc = RPCServer(broker, CordaRPCOps(node.services, node.smm), users=users)
+    node.start()
+    # The port file doubles as the readiness signal (written only once RPC
+    # and the state machine are serving), so external tooling can poll it.
+    with open(os.path.join(cfg.base_directory, "broker.port"), "w") as fh:
+        fh.write(str(server.port))
+    print(
+        f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}",
+        flush=True,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        rpc.stop()
+        node.stop()
+        server.stop()
+        broker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

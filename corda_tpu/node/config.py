@@ -1,0 +1,88 @@
+"""Node file configuration: defaults overlaid by node.conf.
+
+Reference parity: typesafe-config `reference.conf` defaults overlaid by the
+node's `node.conf`, bound to `FullNodeConfiguration`
+(`node/src/main/resources/reference.conf`, `services/config/
+NodeConfiguration.kt:21-98`).  The file format here is JSON (one parser in
+the stdlib beats a HOCON re-implementation); the overlay semantics are the
+same: every key is optional, defaults below are the reference.conf
+analogue.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .node import NodeConfiguration
+
+#: reference.conf analogue (reference `reference.conf:1-21`, incl.
+#: `verifierType = InMemory`).
+DEFAULTS = {
+    "my_legal_name": "Anonymous Node",
+    "base_directory": ".",
+    "db_file": "node.db",          # relative to base_directory
+    "journal_dir": "journal",      # relative; broker durability
+    "verifier_type": "InMemory",   # InMemory | OutOfProcess
+    "notary_type": None,            # None | simple | validating
+    "identity_entropy": None,
+    "broker_host": "127.0.0.1",
+    "broker_port": 0,               # 0 = pick a free port, written to port file
+    "rpc_users": [],                # [{"username","password","permissions":[...]}]
+    "jax_platform": None,
+    "network_map": None,            # "HOST:PORT" of the directory node, or None
+    # CorDapp scan analogue (reference AbstractNode.scanCordapps /
+    # installCordaServices, AbstractNode.kt:291-315): python modules to
+    # import at startup so their @startable_by_rpc / @initiated_by flows
+    # register.
+    "cordapps": ["corda_tpu.finance.flows"],
+}
+
+
+@dataclass
+class FullNodeConfiguration:
+    """Everything a standalone node process needs (node + transport)."""
+
+    node: NodeConfiguration
+    base_directory: str
+    journal_dir: str
+    broker_host: str
+    broker_port: int
+    rpc_users: List[dict] = field(default_factory=list)
+    jax_platform: Optional[str] = None
+    network_map: Optional[str] = None
+    cordapps: List[str] = field(default_factory=list)
+
+
+def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeConfiguration:
+    """DEFAULTS <- node.conf <- overrides, then resolve paths."""
+    cfg = dict(DEFAULTS)
+    path = os.path.join(config_dir, "node.conf")
+    if os.path.exists(path):
+        with open(path) as fh:
+            cfg.update(json.load(fh))
+    cfg.update(overrides or {})
+
+    base = os.path.abspath(
+        os.path.join(config_dir, cfg.get("base_directory", "."))
+    )
+    os.makedirs(base, exist_ok=True)
+    node_cfg = NodeConfiguration(
+        my_legal_name=cfg["my_legal_name"],
+        db_path=os.path.join(base, cfg["db_file"]),
+        verifier_type=cfg["verifier_type"],
+        notary_type=cfg["notary_type"],
+        identity_entropy=cfg["identity_entropy"],
+    )
+    return FullNodeConfiguration(
+        node=node_cfg,
+        base_directory=base,
+        journal_dir=os.path.join(base, cfg["journal_dir"]),
+        broker_host=cfg["broker_host"],
+        broker_port=int(cfg["broker_port"]),
+        rpc_users=list(cfg["rpc_users"]),
+        jax_platform=cfg["jax_platform"],
+        network_map=cfg.get("network_map"),
+        cordapps=list(cfg["cordapps"]),
+    )

@@ -196,16 +196,7 @@ def _bucket(n: int) -> int:
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
 
 
-def _scalar_to_words(x: int) -> np.ndarray:
-    return np.frombuffer(x.to_bytes(32, "little"), np.uint32).copy()
-
-
 _L_WORDS = np.frombuffer(F.L_INT.to_bytes(32, "little"), np.uint32)
-
-
-def _halfword_limbs(byte_mat: np.ndarray) -> np.ndarray:
-    """(g, 32) uint8 little-endian -> (g, 16) uint32 radix-2^16 limbs."""
-    return F.bytes_to_limbs(byte_mat)
 
 
 def prepare_batch(
@@ -244,8 +235,8 @@ def prepare_batch(
         sig_mat = np.frombuffer(
             b"".join(signatures[i] for i in good), np.uint8
         ).reshape(-1, 64)
-        a_limbs = _halfword_limbs(pub_mat)
-        r_limbs = _halfword_limbs(sig_mat[:, :32])
+        a_limbs = F.bytes_to_limbs(pub_mat)
+        r_limbs = F.bytes_to_limbs(sig_mat[:, :32])
         sign_a[gi] = a_limbs[:, 15] >> 15
         sign_r[gi] = r_limbs[:, 15] >> 15
         a_limbs[:, 15] &= 0x7FFF

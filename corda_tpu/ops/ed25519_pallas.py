@@ -30,8 +30,6 @@ ops/ed25519_batch.py; this module is TPU-only.
 """
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -468,7 +466,7 @@ def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref, ok_ref,
     )
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok):
     """Transposed inputs: y_*_t (16, B), sign_* (1, B), s_t/h_t (8, B),
     s_ok (1, B) uint32. B must be a multiple of BLK. Returns (1, B) uint32

@@ -137,8 +137,19 @@ class CordaRPCClient:
                         obs = self._observables.get(payload["obs_id"])
                     if obs is not None:
                         obs.on_next(payload["value"])
-            except Exception:
-                pass
+            except Exception as exc:
+                # Most often: the reply contains CorDapp types this client
+                # process never imported (the reference requires CorDapp
+                # JARs on the RPC client classpath; here: import the
+                # CorDapp python modules). A silent drop looks like a hung
+                # server, so say why.
+                import sys as _sys
+
+                print(
+                    f"corda_tpu.rpc: dropping undecodable message: {exc} "
+                    "(is the CorDapp module imported in this process?)",
+                    file=_sys.stderr,
+                )
             self._consumer.ack(msg)
 
     def _client_observable(self, obs_id: str) -> Observable:

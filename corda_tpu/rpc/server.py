@@ -66,8 +66,26 @@ class RPCServer:
             self._consumer.ack(msg)
 
     def _reply(self, reply_to: str, payload: dict) -> None:
+        # Serialize and send are distinct failure classes: a result that
+        # cannot be marshalled must surface to the caller as an error reply
+        # (a silent drop looks like a hung server to the client); only a
+        # send failure means the client is gone.
         try:
-            self.broker.send(reply_to, serialize(payload))
+            blob = serialize(payload)
+        except Exception as exc:
+            if "ok" not in payload:
+                return  # the error reply itself is unserializable; give up
+            fallback = {
+                "kind": payload.get("kind", "reply"),
+                "id": payload.get("id"),
+                "error": f"result not serializable: {exc}",
+            }
+            try:
+                blob = serialize(fallback)
+            except Exception:
+                return
+        try:
+            self.broker.send(reply_to, blob)
         except Exception:
             pass  # client is gone
 
@@ -141,7 +159,8 @@ class RPCServer:
             result = getattr(self.ops, method_name)(*args)
         except Exception as exc:
             self._reply(reply_to, {
-                "kind": "reply", "id": req_id, "error": str(exc),
+                "kind": "reply", "id": req_id,
+                "error": f"{type(exc).__name__}: {exc}",
             })
             return
         self._reply(reply_to, {

@@ -1,0 +1,296 @@
+"""Driver DSL: spawn real node/verifier OS processes for integration tests.
+
+Reference parity: `test-utils/src/main/kotlin/net/corda/testing/driver/
+Driver.kt:94-141, 252-263` (out-of-process node startup, port allocation,
+RPC connection, shutdown management) and `smoke-test-utils/.../
+NodeProcess.kt:1-159` (launch the packaged node as a black box, RPC in).
+The verifier flavour mirrors `verifier/src/integration-test/.../
+VerifierDriver.kt` — a bare broker host plus N external verifier
+processes.
+
+Usage:
+
+    with driver() as d:
+        broker = d.start_broker()                    # in-driver broker + TCP server
+        v = d.start_verifier(broker.address)          # real subprocess
+        node = d.start_node({"my_legal_name": "Bank A"})
+        rpc = node.rpc()                              # CordaRPCClient over TCP
+        ...
+        v.kill()                                      # SIGKILL: redelivery proof
+
+Subprocesses default to the CPU JAX backend (tests must not depend on TPU
+hardware); pass jax_platform=None to inherit the environment's backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..messaging import Broker
+from ..messaging.net import BrokerServer, RemoteBroker
+
+
+class DriverError(Exception):
+    pass
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(predicate, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise DriverError(f"timed out waiting for {what}")
+
+
+def _try_connect(host: str, port: int) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=0.25):
+            return True
+    except OSError:
+        return False
+
+
+@dataclass
+class BrokerHandle:
+    broker: Broker
+    server: BrokerServer
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    def remote(self) -> RemoteBroker:
+        return RemoteBroker(self.server.host, self.server.port)
+
+
+class ProcessHandle:
+    """A spawned subprocess with log capture and crash-style termination."""
+
+    def __init__(self, proc: subprocess.Popen, log_path: str, name: str):
+        self.proc = proc
+        self.log_path = log_path
+        self.name = name
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — simulates a crash (no graceful ack/close)."""
+        if self.alive():
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def terminate(self, timeout: float = 10) -> int:
+        if self.alive():
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+        return self.proc.returncode
+
+    def log(self) -> str:
+        try:
+            with open(self.log_path) as fh:
+                return fh.read()
+        except OSError:
+            return ""
+
+
+class NodeHandle(ProcessHandle):
+    def __init__(self, proc, log_path, name, base_dir, host, cordapps=()):
+        super().__init__(proc, log_path, name)
+        self.base_dir = base_dir
+        self.host = host
+        self.cordapps = tuple(cordapps)
+        self.broker_port: Optional[int] = None
+
+    def rpc(self, timeout: float = 15.0):
+        """CordaRPCClient over the node's TCP broker.
+
+        Imports the node's CorDapp modules first so their serializable
+        types are registered in THIS process — the analogue of putting
+        CorDapp JARs on the reference RPC client's classpath."""
+        import importlib
+
+        from ..rpc.client import CordaRPCClient
+
+        for mod in self.cordapps:
+            importlib.import_module(mod)
+        return CordaRPCClient(
+            RemoteBroker(self.host, self.broker_port), timeout=timeout
+        )
+
+    def remote_broker(self) -> RemoteBroker:
+        return RemoteBroker(self.host, self.broker_port)
+
+
+class Driver:
+    def __init__(self, base_dir: str, jax_platform: Optional[str] = "cpu"):
+        self.base_dir = base_dir
+        self.jax_platform = jax_platform
+        self._brokers: List[BrokerHandle] = []
+        self._procs: List[ProcessHandle] = []
+        self._remotes: List[RemoteBroker] = []
+        self._counter = 0
+
+    # -- in-driver broker host (VerifierDriver.startVerificationRequestor) --
+
+    def start_broker(self, journal_dir: Optional[str] = None) -> BrokerHandle:
+        broker = Broker(journal_dir=journal_dir)
+        server = BrokerServer(broker, port=0).start()
+        h = BrokerHandle(broker, server)
+        self._brokers.append(h)
+        return h
+
+    # -- subprocesses --------------------------------------------------------
+
+    def _spawn(self, args: List[str], name: str, env_extra=None) -> ProcessHandle:
+        self._counter += 1
+        log_path = os.path.join(self.base_dir, f"{name}-{self._counter}.log")
+        log = open(log_path, "w")
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(env_extra or {})
+        proc = subprocess.Popen(
+            [sys.executable, *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        h = ProcessHandle(proc, log_path, name)
+        self._procs.append(h)
+        return h
+
+    def start_verifier(
+        self, broker_address: str, workers: int = 1, name: str = "verifier"
+    ) -> ProcessHandle:
+        args = [
+            "-m", "corda_tpu.verifier",
+            "--connect", broker_address,
+            "--workers", str(workers),
+            "--name", name,
+        ]
+        if self.jax_platform:
+            args += ["--jax-platform", self.jax_platform]
+        h = self._spawn(args, name)
+        host, port_s = broker_address.rsplit(":", 1)
+        _wait_for(
+            lambda: "verifier ready" in h.log() or not h.alive(),
+            timeout=120, what=f"{name} to come up",
+        )
+        if not h.alive():
+            raise DriverError(f"{name} died on startup:\n{h.log()}")
+        return h
+
+    def start_node(
+        self, conf: Dict, name: Optional[str] = None, timeout: float = 120
+    ) -> NodeHandle:
+        name = name or conf.get("my_legal_name", "node").replace(" ", "-")
+        node_dir = os.path.join(self.base_dir, name)
+        os.makedirs(node_dir, exist_ok=True)
+        with open(os.path.join(node_dir, "node.conf"), "w") as fh:
+            json.dump(conf, fh)
+        args = ["-m", "corda_tpu.node", node_dir]
+        if self.jax_platform:
+            args += ["--jax-platform", self.jax_platform]
+        self._counter += 1
+        log_path = os.path.join(self.base_dir, f"{name}.log")
+        log = open(log_path, "w")
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        from ..node.config import DEFAULTS as _NODE_DEFAULTS
+
+        h = NodeHandle(proc, log_path, name, node_dir,
+                       conf.get("broker_host", "127.0.0.1"),
+                       cordapps=conf.get("cordapps", _NODE_DEFAULTS["cordapps"]))
+        self._procs.append(h)
+        _wait_for(
+            lambda: "node ready" in h.log() or not h.alive(),
+            timeout=timeout, what=f"node {name} to come up",
+        )
+        if not h.alive():
+            raise DriverError(f"node {name} died on startup:\n{h.log()}")
+        port_file = os.path.join(node_dir, "broker.port")
+        _wait_for(lambda: os.path.exists(port_file), 10, "broker.port file")
+        with open(port_file) as fh:
+            h.broker_port = int(fh.read().strip())
+        _wait_for(
+            lambda: _try_connect(h.host, h.broker_port), 10,
+            "node broker port to accept",
+        )
+        return h
+
+    def remote(self, address: str) -> RemoteBroker:
+        host, port_s = address.rsplit(":", 1)
+        r = RemoteBroker(host, int(port_s))
+        self._remotes.append(r)
+        return r
+
+    def shutdown(self) -> None:
+        for r in self._remotes:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for p in self._procs:
+            try:
+                p.terminate(timeout=5)
+            except Exception:
+                pass
+        for b in self._brokers:
+            try:
+                b.server.stop()
+                b.broker.close()
+            except Exception:
+                pass
+
+
+class driver:
+    """Context-manager entry point (the reference `driver {}` block)."""
+
+    def __init__(self, base_dir: Optional[str] = None,
+                 jax_platform: Optional[str] = "cpu"):
+        self._base_dir = base_dir
+        self._tmp = None
+        self._jax_platform = jax_platform
+
+    def __enter__(self) -> Driver:
+        if self._base_dir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="corda-driver-")
+            self._base_dir = self._tmp.name
+        self._driver = Driver(self._base_dir, jax_platform=self._jax_platform)
+        return self._driver
+
+    def __exit__(self, *exc) -> None:
+        self._driver.shutdown()
+        if self._tmp is not None:
+            self._tmp.cleanup()

@@ -1,0 +1,99 @@
+"""Standalone verifier process: `python -m corda_tpu.verifier`.
+
+Reference parity: `verifier/src/main/kotlin/net/corda/verifier/Verifier.kt:50-90`
+(a separate JVM that connects to the node's broker over TCP, consumes
+`verifier.requests` as a competing consumer, verifies, replies) and its
+config loading (`verifier.conf` overlaying `verifier-reference.conf`,
+Verifier.kt:42-47; docs `docs/source/out-of-process-verification.rst`).
+
+Usage:
+    python -m corda_tpu.verifier --connect HOST:PORT [--name N] [--workers K]
+    python -m corda_tpu.verifier CONFIG_DIR       # reads CONFIG_DIR/verifier.conf
+
+verifier.conf is JSON overlaying these defaults (the reference-conf
+pattern):  {"connect": "127.0.0.1:10010", "name": "verifier", "workers": 1,
+"jax_platform": null}
+
+Scale-out is plain competing consumers: run N of these processes against
+one broker; kill one mid-burst and its unacked requests redeliver to the
+survivors (reference `VerifierTests.kt:73-101`).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+_DEFAULTS = {
+    "connect": "127.0.0.1:10010",
+    "name": "verifier",
+    "workers": 1,
+    "jax_platform": None,  # e.g. "cpu" to force the CPU backend
+}
+
+
+def _load_config(config_dir: str) -> dict:
+    cfg = dict(_DEFAULTS)
+    path = os.path.join(config_dir, "verifier.conf")
+    if os.path.exists(path):
+        with open(path) as fh:
+            cfg.update(json.load(fh))
+    return cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="corda_tpu.verifier")
+    ap.add_argument("config_dir", nargs="?", help="directory with verifier.conf")
+    ap.add_argument("--connect", help="broker address HOST:PORT")
+    ap.add_argument("--name")
+    ap.add_argument("--workers", type=int)
+    ap.add_argument("--jax-platform", dest="jax_platform")
+    args = ap.parse_args(argv)
+
+    cfg = _load_config(args.config_dir) if args.config_dir else dict(_DEFAULTS)
+    for key in ("connect", "name", "workers", "jax_platform"):
+        val = getattr(args, key)
+        if val is not None:
+            cfg[key] = val
+
+    if cfg["jax_platform"]:
+        # Must run before any JAX backend use (see tests/conftest.py for the
+        # same recipe; the axon sitecustomize latches JAX_PLATFORMS).
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", cfg["jax_platform"])
+
+    from ..messaging.net import RemoteBroker
+    from .worker import VerifierWorker
+
+    host, port_s = cfg["connect"].rsplit(":", 1)
+    broker = RemoteBroker(host, int(port_s))
+
+    workers = []
+    for i in range(int(cfg["workers"])):
+        w = VerifierWorker(broker, name=f"{cfg['name']}-{i}")
+        w.start()
+        workers.append(w)
+    print(f"verifier ready: {len(workers)} worker(s) on {cfg['connect']}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        for w in workers:
+            w.stop()
+        broker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Process-separation tests: the broker TCP transport, standalone verifier
+and node OS processes, and the driver DSL.
+
+Reference parity: this is the integration tier the reference runs with the
+driver DSL (`test-utils/.../driver/Driver.kt:252-263`), the verifier
+elasticity suite (`verifier/src/integration-test/.../VerifierTests.kt:
+54-101` — N workers, kill one mid-run, work redistributes) and the smoke
+tests that treat a packaged node as a black box
+(`smoke-test-utils/.../NodeProcess.kt`). Round 1 ran all of this inside
+one interpreter; these tests cross real process boundaries.
+"""
+import os
+import time
+
+import pytest
+
+from corda_tpu.core.crypto import crypto
+from corda_tpu.messaging import Broker, UnknownQueueError
+from corda_tpu.messaging.net import BrokerServer, RemoteBroker
+from corda_tpu.testing.driver import driver
+from corda_tpu.verifier import OutOfProcessTransactionVerifierService
+
+
+@pytest.fixture()
+def served_broker():
+    broker = Broker()
+    server = BrokerServer(broker, port=0).start()
+    yield broker, server
+    server.stop()
+    broker.close()
+
+
+class TestRemoteBroker:
+    def test_roundtrip_over_tcp(self, served_broker):
+        broker, server = served_broker
+        rb = RemoteBroker(server.host, server.port)
+        rb.create_queue("q1")
+        assert rb.queue_exists("q1")
+        assert "q1" in rb.queue_names()
+        mid = rb.send("q1", b"hello", headers={"topic": "t", "n": "1"})
+        assert mid
+        assert rb.message_count("q1") == 1
+        c = rb.create_consumer("q1")
+        msg = c.receive(timeout=2)
+        assert msg is not None
+        assert msg.payload == b"hello"
+        assert msg.headers["topic"] == "t"
+        assert msg.message_id == mid
+        c.ack(msg)
+        assert c.receive(timeout=0.1) is None
+        rb.close()
+
+    def test_error_propagates(self, served_broker):
+        _, server = served_broker
+        rb = RemoteBroker(server.host, server.port)
+        with pytest.raises(UnknownQueueError):
+            rb.send("nope", b"x")
+        rb.close()
+
+    def test_consumer_socket_death_redelivers(self, served_broker):
+        """A consumer whose connection dies without acking must have its
+        message redelivered to a surviving consumer (VerifierTests.kt:73-101
+        across a real socket)."""
+        broker, server = served_broker
+        rb1 = RemoteBroker(server.host, server.port)
+        rb1.create_queue("work")
+        rb1.send("work", b"job-1")
+        doomed = rb1.create_consumer("work")
+        msg = doomed.receive(timeout=2)
+        assert msg is not None and msg.delivery_count == 1
+        # Crash: close the socket without ack or polite OP_CLOSE.
+        doomed._conn.sock.close()
+
+        rb2 = RemoteBroker(server.host, server.port)
+        survivor = rb2.create_consumer("work")
+        redelivered = survivor.receive(timeout=10)
+        assert redelivered is not None
+        assert redelivered.payload == b"job-1"
+        assert redelivered.delivery_count == 2
+        survivor.ack(redelivered)
+        rb1.close()
+        rb2.close()
+
+    def test_in_process_services_work_over_tcp(self, served_broker):
+        """The out-of-process verifier service + worker pair, with BOTH ends
+        talking through RemoteBroker (same code, real socket between)."""
+        from corda_tpu.verifier import VerifierWorker
+
+        _, server = served_broker
+        svc_side = RemoteBroker(server.host, server.port)
+        worker_side = RemoteBroker(server.host, server.port)
+        svc = OutOfProcessTransactionVerifierService(svc_side, "nodeT")
+        worker = VerifierWorker(worker_side).start()
+        items = []
+        for i in range(4):
+            kp = crypto.entropy_to_keypair(900 + i)
+            content = b"c-%d" % i
+            items.append((kp.public, crypto.do_sign(kp.private, content), content))
+        key, sig, _ = items[2]
+        items[2] = (key, sig, b"forged")
+        futures = svc.verify_signatures(items)
+        assert [f.result(timeout=30) for f in futures] == [True, True, False, True]
+        worker.stop()
+        svc.stop()
+        svc_side.close()
+        worker_side.close()
+
+
+@pytest.mark.slow
+class TestStandaloneVerifier:
+    def test_elasticity_kill_one_mid_burst(self, tmp_path):
+        """Two standalone verifier processes compete on one queue; SIGKILL
+        one mid-burst; every request still gets a response (redelivery to
+        the survivor). Mirrors VerifierTests.kt:73-101 with OS processes."""
+        with driver(str(tmp_path)) as d:
+            bh = d.start_broker()
+            v1 = d.start_verifier(bh.address, name="verifier-a")
+            v2 = d.start_verifier(bh.address, name="verifier-b")
+
+            svc = OutOfProcessTransactionVerifierService(bh.remote(), "reqNode")
+            assert svc.worker_count() >= 2
+
+            kp = crypto.entropy_to_keypair(1234)
+            content = b"the-content"
+            good = (kp.public, crypto.do_sign(kp.private, content), content)
+
+            n_requests = 40
+            futures = []
+            for i in range(n_requests):
+                futures.append(svc.verify_signatures([good, good, good]))
+                if i == 5:
+                    v1.kill()  # crash, no graceful close
+            for fs in futures:
+                for f in fs:
+                    assert f.result(timeout=180) is True
+            assert not v1.alive()
+            assert v2.alive()
+            svc.stop()
+
+
+@pytest.mark.slow
+class TestStandaloneNode:
+    def test_node_process_rpc_smoke(self, tmp_path):
+        """Black-box node: spawn `python -m corda_tpu.node`, connect RPC over
+        TCP, check identity, issue cash via flow, query the vault, shut
+        down cleanly (NodeProcess.kt smoke-test shape)."""
+        with driver(str(tmp_path)) as d:
+            node = d.start_node(
+                {
+                    "my_legal_name": "O=Bank A,L=London,C=GB",
+                    "notary_type": "simple",
+                    "identity_entropy": 4242,
+                    "rpc_users": [
+                        {"username": "admin", "password": "pw",
+                         "permissions": ["ALL"]}
+                    ],
+                }
+            )
+            client = node.rpc()
+            conn = client.start("admin", "pw")
+            info = conn.proxy.node_info()
+            assert "Bank A" in str(info)
+
+            # Run a real flow through the wire: self-issue 1000 GBP, then
+            # see it in the vault (RPC -> SMM -> flow -> vault, all in the
+            # node process).
+            from corda_tpu.core.contracts import Amount
+
+            flow_id = conn.proxy.start_flow_dynamic(
+                "CashIssueFlow",
+                Amount(1000_00, "GBP"),
+                b"ref-1",
+                info,
+                info,  # the node is its own (simple) notary
+            )
+            result = conn.proxy.flow_result(flow_id, 60)
+            assert result is not None
+            states = conn.proxy.vault_query("corda_tpu.finance.Cash")
+            assert len(states) == 1
+            client.close()
+            rc = node.terminate()
+            assert rc == 0, node.log()
