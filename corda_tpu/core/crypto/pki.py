@@ -1,0 +1,393 @@
+"""X.509 identity PKI: cert hierarchy, CSRs, TLS contexts.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/crypto/
+X509Utilities.kt:28-235` (3-level hierarchy root CA -> intermediate CA ->
+client/node CA, plus TLS leaf certs; well-known aliases at :33-36) and
+`ContentSignerBuilder.kt` (signing certs with a chosen scheme).  Backed by
+the `cryptography` package the way the reference leans on BouncyCastle.
+
+Hierarchy (aliases kept from the reference):
+    CORDA_ROOT_CA          self-signed, CA:TRUE pathlen 2
+    CORDA_INTERMEDIATE_CA  signed by root, CA:TRUE pathlen 1
+    CORDA_CLIENT_CA        per-node, signed by intermediate, CA:TRUE pathlen 0
+    identity / TLS leaves  signed by the node CA
+
+Key type: ECDSA P-256 (scheme id 3 in the registry; also the TLS-friendly
+choice).  `DEV_ROOT` mirrors the reference's bundled dev-mode certificates
+(`AbstractNode.configureWithDevSSLCertificate`): a deterministic dev root
+so every dev node chains to the same trust anchor.
+
+TLS: `server_ssl_context` / `client_ssl_context` build mutually-
+authenticating contexts for the broker transport
+(corda_tpu.messaging.net `server_wrap`/`client_wrap`).
+"""
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+CORDA_ROOT_CA = "cordarootca"
+CORDA_INTERMEDIATE_CA = "cordaintermediateca"
+CORDA_CLIENT_CA = "cordaclientca"
+CORDA_TLS = "cordaclienttls"
+
+_ONE_DAY = datetime.timedelta(days=1)
+_TEN_YEARS = datetime.timedelta(days=3650)
+
+
+@dataclass
+class CertAndKey:
+    cert: x509.Certificate
+    key: ec.EllipticCurvePrivateKey
+
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+    def key_pem(self) -> bytes:
+        return self.key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+
+
+def _name(common_name: str, org: str = "corda_tpu",
+          unit: Optional[str] = None) -> x509.Name:
+    attrs = [
+        x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+    ]
+    if unit is not None:
+        # Distinguishes the node CA's DN from its TLS/identity leaves —
+        # an identical subject/issuer DN makes chain builders treat the
+        # leaf as self-signed.
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, unit))
+    return x509.Name(attrs)
+
+
+def _new_key() -> ec.EllipticCurvePrivateKey:
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _build_cert(
+    subject: x509.Name,
+    subject_key,
+    issuer: x509.Name,
+    issuer_key,
+    is_ca: bool,
+    path_len: Optional[int],
+    san_dns: Optional[List[str]] = None,
+    validity: datetime.timedelta = _TEN_YEARS,
+) -> x509.Certificate:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(issuer)
+        .public_key(subject_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _ONE_DAY)
+        .not_valid_after(now + validity)
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=path_len), critical=True
+        )
+        .add_extension(
+            x509.SubjectKeyIdentifier.from_public_key(subject_key.public_key()),
+            critical=False,
+        )
+    )
+    if san_dns:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName(d) for d in san_dns]
+                + [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+    return builder.sign(issuer_key, hashes.SHA256())
+
+
+def create_self_signed_ca(common_name: str = "Corda Node Root CA") -> CertAndKey:
+    """Root of the hierarchy (X509Utilities.createSelfSignedCACert)."""
+    key = _new_key()
+    name = _name(common_name)
+    return CertAndKey(_build_cert(name, key, name, key, True, 2), key)
+
+
+def create_intermediate_ca(
+    root: CertAndKey, common_name: str = "Corda Node Intermediate CA"
+) -> CertAndKey:
+    key = _new_key()
+    cert = _build_cert(
+        _name(common_name), key, root.cert.subject, root.key, True, 1
+    )
+    return CertAndKey(cert, key)
+
+
+def create_node_ca(intermediate: CertAndKey, legal_name: str) -> CertAndKey:
+    """Per-node CA (CORDA_CLIENT_CA; X509Utilities.createIntermediateCert)."""
+    key = _new_key()
+    cert = _build_cert(
+        _name(legal_name, unit="CORDA_CLIENT_CA"), key,
+        intermediate.cert.subject, intermediate.key, True, 0,
+    )
+    return CertAndKey(cert, key)
+
+
+def create_tls_cert(
+    node_ca: CertAndKey, legal_name: str, dns_names: Optional[List[str]] = None
+) -> CertAndKey:
+    """TLS leaf for the broker transport (X509Utilities.createServerCert)."""
+    key = _new_key()
+    cert = _build_cert(
+        _name(legal_name), key,
+        node_ca.cert.subject, node_ca.key, False, None,
+        san_dns=dns_names or ["localhost"],
+    )
+    return CertAndKey(cert, key)
+
+
+# --- CSR flow (X509Utilities.createCertificateSigningRequest) ---------------
+
+def create_csr(legal_name: str) -> Tuple[x509.CertificateSigningRequest, ec.EllipticCurvePrivateKey]:
+    key = _new_key()
+    csr = (
+        x509.CertificateSigningRequestBuilder()
+        .subject_name(_name(legal_name))
+        .sign(key, hashes.SHA256())
+    )
+    return csr, key
+
+
+def sign_csr(
+    ca: CertAndKey, csr: x509.CertificateSigningRequest, is_ca: bool = False
+) -> x509.Certificate:
+    if not csr.is_signature_valid:
+        raise ValueError("CSR signature invalid")
+    return _build_cert_from_public(csr.subject, csr.public_key(), ca, is_ca)
+
+
+def _build_cert_from_public(subject, public_key, ca: CertAndKey, is_ca: bool):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(ca.cert.subject)
+        .public_key(public_key)
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _ONE_DAY)
+        .not_valid_after(now + _TEN_YEARS)
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=0 if is_ca else None),
+            critical=True,
+        )
+    )
+    return builder.sign(ca.key, hashes.SHA256())
+
+
+# --- validation --------------------------------------------------------------
+
+def _basic_constraints(cert: x509.Certificate):
+    try:
+        return cert.extensions.get_extension_for_class(
+            x509.BasicConstraints
+        ).value
+    except x509.ExtensionNotFound:
+        return None
+
+
+def verify_chain(leaf: x509.Certificate, chain: List[x509.Certificate],
+                 root: x509.Certificate) -> bool:
+    """Cert-path validation: signature linkage, issuer/subject matching,
+    validity windows, and CA + path-length constraints on every issuer
+    (reference InMemoryIdentityService cert-path checks).  Without the CA
+    checks, any LEAF key holder could mint certificates that verify."""
+    path = [leaf] + list(chain) + [root]
+    now = datetime.datetime.now(datetime.timezone.utc)
+    for cert in path:
+        if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
+            return False
+    for depth, (child, parent) in enumerate(zip(path, path[1:])):
+        if child.issuer != parent.subject:
+            return False
+        bc = _basic_constraints(parent)
+        if bc is None or not bc.ca:
+            return False
+        # path_length bounds the number of intermediate CAs BELOW parent:
+        # at position i (0-based from the leaf side), parent has `depth`
+        # CA certs beneath it excluding the leaf.
+        if bc.path_length is not None and depth > bc.path_length:
+            return False
+        try:
+            parent.public_key().verify(
+                child.signature,
+                child.tbs_certificate_bytes,
+                ec.ECDSA(child.signature_hash_algorithm),
+            )
+        except Exception:
+            return False
+    try:
+        root.public_key().verify(
+            root.signature, root.tbs_certificate_bytes,
+            ec.ECDSA(root.signature_hash_algorithm),
+        )
+    except Exception:
+        return False
+    return True
+
+
+# --- keystore-on-disk (JKS analogue: PEM files in a directory) --------------
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write-then-rename so concurrent readers never see a torn file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, path)
+
+
+def write_cert_store(directory: str, **entries: CertAndKey) -> None:
+    os.makedirs(directory, exist_ok=True)
+    for alias, ck in entries.items():
+        _atomic_write(os.path.join(directory, f"{alias}.cert.pem"), ck.cert_pem())
+        _atomic_write(os.path.join(directory, f"{alias}.key.pem"), ck.key_pem())
+
+
+def read_cert(directory: str, alias: str) -> CertAndKey:
+    with open(os.path.join(directory, f"{alias}.cert.pem"), "rb") as fh:
+        cert = x509.load_pem_x509_certificate(fh.read())
+    with open(os.path.join(directory, f"{alias}.key.pem"), "rb") as fh:
+        key = serialization.load_pem_private_key(fh.read(), password=None)
+    return CertAndKey(cert, key)
+
+
+def dev_certificates(directory: str, legal_name: str) -> dict:
+    """Dev-mode certificates (AbstractNode.configureWithDevSSLCertificate).
+
+    Root + intermediate are SHARED per directory (generated on first use);
+    the node CA and TLS leaf are per legal name.  Pointing several nodes at
+    one certificates directory therefore gives each its own identity
+    chained to a common trust anchor — the shape the reference ships as
+    its bundled dev-mode certs."""
+    import hashlib
+
+    os.makedirs(directory, exist_ok=True)
+    # Concurrent dev nodes may race root creation on a shared directory:
+    # claim it with O_EXCL; the loser waits for the winner's atomic writes.
+    lock_path = os.path.join(directory, ".root.claim")
+    root_cert_path = os.path.join(directory, f"{CORDA_ROOT_CA}.cert.pem")
+    claimed = False
+    if not os.path.exists(root_cert_path):
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            claimed = True
+        except FileExistsError:
+            pass
+    if claimed:
+        root = create_self_signed_ca()
+        inter = create_intermediate_ca(root)
+        write_cert_store(
+            directory,
+            **{CORDA_ROOT_CA: root, CORDA_INTERMEDIATE_CA: inter},
+        )
+    else:
+        deadline = time.time() + 15
+        while not (
+            os.path.exists(root_cert_path)
+            and os.path.exists(
+                os.path.join(directory, f"{CORDA_INTERMEDIATE_CA}.key.pem")
+            )
+        ):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"waiting for shared dev root in {directory}"
+                )
+            time.sleep(0.05)
+        root = read_cert(directory, CORDA_ROOT_CA)
+        inter = read_cert(directory, CORDA_INTERMEDIATE_CA)
+    tag = hashlib.sha256(legal_name.encode()).hexdigest()[:8]
+    ca_alias = f"{tag}-{CORDA_CLIENT_CA}"
+    tls_alias = f"{tag}-{CORDA_TLS}"
+    if os.path.exists(os.path.join(directory, f"{ca_alias}.cert.pem")):
+        node_ca = read_cert(directory, ca_alias)
+        tls = read_cert(directory, tls_alias)
+    else:
+        node_ca = create_node_ca(inter, legal_name)
+        tls = create_tls_cert(node_ca, legal_name)
+        write_cert_store(directory, **{ca_alias: node_ca, tls_alias: tls})
+    return {
+        CORDA_ROOT_CA: root,
+        CORDA_INTERMEDIATE_CA: inter,
+        CORDA_CLIENT_CA: node_ca,
+        CORDA_TLS: tls,
+        "_tag": tag,
+    }
+
+
+# --- TLS contexts for the broker transport ----------------------------------
+
+def _chain_pem(tls: CertAndKey, *parents: CertAndKey) -> bytes:
+    return tls.cert_pem() + b"".join(p.cert_pem() for p in parents)
+
+
+def _write_tls_material(directory: str, entries: dict) -> Tuple[str, str, str]:
+    """(chain_file, key_file, root_file) for ssl.SSLContext consumption."""
+    tag = entries.get("_tag", "")
+    prefix = f"{tag}-" if tag else ""
+    chain_path = os.path.join(directory, f"{prefix}tls.chain.pem")
+    key_path = os.path.join(directory, f"{prefix}{CORDA_TLS}.key.pem")
+    root_path = os.path.join(directory, "trustroot.pem")
+    chain = _chain_pem(
+        entries[CORDA_TLS],
+        entries[CORDA_CLIENT_CA],
+        entries[CORDA_INTERMEDIATE_CA],
+    )
+    _atomic_write(chain_path, chain)
+    _atomic_write(root_path, entries[CORDA_ROOT_CA].cert_pem())
+    return chain_path, key_path, root_path
+
+
+def server_ssl_context(cert_dir: str, entries: dict,
+                       require_client_cert: bool = True) -> ssl.SSLContext:
+    chain, key, root = _write_tls_material(cert_dir, entries)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(chain, key)
+    ctx.load_verify_locations(root)
+    if require_client_cert:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(cert_dir: str, entries: dict,
+                       trust_root_pem: Optional[bytes] = None) -> ssl.SSLContext:
+    chain, key, root = _write_tls_material(cert_dir, entries)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(chain, key)
+    ctx.check_hostname = False  # peer auth is by chain-to-root, not hostname
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    if trust_root_pem is not None:
+        ctx.load_verify_locations(cadata=trust_root_pem.decode())
+    else:
+        ctx.load_verify_locations(root)
+    return ctx
+
+
+def server_wrap(ctx: ssl.SSLContext):
+    """Socket-wrap hook for messaging.net.BrokerServer."""
+    return lambda sock: ctx.wrap_socket(sock, server_side=True)
+
+
+def client_wrap(ctx: ssl.SSLContext):
+    """Socket-wrap hook for messaging.net.RemoteBroker."""
+    return lambda sock: ctx.wrap_socket(sock)

@@ -23,6 +23,16 @@ from .api import (
     schedulable_flow,
     startable_by_rpc,
 )
+from .statereplacement import (
+    AbstractStateReplacementAcceptor,
+    AbstractStateReplacementInstigator,
+    ContractUpgradeFlow,
+    NotaryChangeFlow,
+    Proposal,
+    StateReplacementException,
+    UpgradeCommand,
+    UpgradedContract,
+)
 from .library import (
     BroadcastTransactionFlow,
     CollectSignaturesFlow,
@@ -45,4 +55,7 @@ __all__ = [
     "FetchAttachmentsFlow", "FetchDataError", "FetchTransactionsFlow",
     "FinalityFlow", "NotifyTransactionHandler", "ResolveTransactionsFlow",
     "SignTransactionFlow",
+    "AbstractStateReplacementAcceptor", "AbstractStateReplacementInstigator",
+    "ContractUpgradeFlow", "NotaryChangeFlow", "Proposal",
+    "StateReplacementException", "UpgradeCommand", "UpgradedContract",
 ]

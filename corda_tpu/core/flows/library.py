@@ -207,9 +207,42 @@ class ResolveTransactionsFlow(FlowLogic):
                 yield from self.sub_flow(
                     FetchAttachmentsFlow(tuple(missing_atts), self.other_party)
                 )
-            stx.verify(self.service_hub)
+            verify_dependency(stx, self.service_hub)
             self.service_hub.record_transactions([stx])
         return ordered
+
+
+def verify_dependency(stx: SignedTransaction, services) -> None:
+    """Verify a downloaded dependency of either transaction kind.
+
+    Notary-change transactions have no contracts to run and their required
+    signers need input resolution (reference
+    NotaryChangeLedgerTransaction); everything else takes the regular
+    signatures + contracts path."""
+    from ..transactions.notary_change import NotaryChangeWireTransaction
+
+    wtx = stx.tx
+    if isinstance(wtx, NotaryChangeWireTransaction):
+        for ref in wtx.inputs:
+            ts = services.load_state(ref)
+            if ts.notary.owning_key.encoded != wtx.notary.owning_key.encoded:
+                raise FlowException(
+                    f"notary-change input {ref} is governed by "
+                    f"{ts.notary.name}, not {wtx.notary.name}"
+                )
+        stx.check_signatures_are_valid()
+        signed = {s.by for s in stx.sigs}
+        missing = {
+            k
+            for k in wtx.resolved_required_keys(services.load_state)
+            if not k.is_fulfilled_by(signed)
+        }
+        if missing:
+            raise FlowException(
+                f"notary-change dependency missing signatures: {missing}"
+            )
+        return
+    stx.verify(services)
 
 
 def _topological_sort(by_id: dict) -> List[SignedTransaction]:

@@ -1,0 +1,388 @@
+"""State-replacement flows: notary change + contract upgrade.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/flows/
+AbstractStateReplacementFlow.kt` (Instigator/Acceptor with a signed
+Proposal handshake and signature swap), `NotaryChangeFlow.kt` (builds a
+NotaryChangeWireTransaction) and `ContractUpgradeFlow.kt` (1-input
+1-output 1-UpgradeCommand transaction, output == upgrade(input)).
+
+Shape kept from the reference: the Instigator assembles the replacement
+transaction, sends a Proposal to every other participant, collects their
+signatures, notarises, sends the full signature set back (so acceptors can
+record), records locally and returns the replacement StateAndRef.  The
+Acceptor verifies the proposal (subclass hook), signs, and records the
+final transaction.  States are replaced one-to-one; no splitting/merging.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Tuple
+
+from ..contracts.structures import (
+    Command,
+    CommandData,
+    ContractState,
+    StateAndRef,
+    StateRef,
+)
+from ..crypto.signing import DigitalSignatureWithKey
+from ..identity import Party
+from ..serialization.codec import register_adapter
+from ..transactions.builder import TransactionBuilder
+from ..transactions.notary_change import NotaryChangeWireTransaction
+from ..transactions.signed import SignedTransaction
+from .api import FlowException, FlowLogic, initiated_by, initiating_flow
+from .library import NotaryClientFlowRef
+
+
+class StateReplacementException(FlowException):
+    pass
+
+
+@dataclass(frozen=True)
+class Proposal:
+    """The proposed modification sent to each participant (reference
+    AbstractStateReplacementFlow.Proposal)."""
+
+    state_ref: StateRef
+    modification: object   # Party (notary change) | str (upgraded contract)
+    stx: SignedTransaction
+
+
+register_adapter(
+    Proposal, "StateReplacementProposal",
+    lambda p: {"ref": p.state_ref, "mod": p.modification, "stx": p.stx},
+    lambda d: Proposal(d["ref"], d["mod"], d["stx"]),
+)
+
+
+@dataclass(frozen=True)
+class SignaturesPayload:
+    """Full signature set swapped back to acceptors."""
+
+    signatures: Tuple[DigitalSignatureWithKey, ...]
+
+
+register_adapter(
+    SignaturesPayload, "StateReplacementSignatures",
+    lambda p: {"sigs": list(p.signatures)},
+    lambda d: SignaturesPayload(tuple(d["sigs"])),
+)
+
+
+def _record_replacement(services, stx: SignedTransaction) -> None:
+    """Record a finalised replacement transaction (both tx kinds)."""
+    services.record_transactions([stx])
+
+
+class AbstractStateReplacementInstigator(FlowLogic):
+    """Instigator half (reference AbstractStateReplacementFlow.Instigator).
+
+    Subclasses implement `assemble_tx() -> (stx, participant_keys)`."""
+
+    def __init__(self, original_state: StateAndRef, modification):
+        self.original_state = original_state
+        self.modification = modification
+
+    def assemble_tx(self):
+        raise NotImplementedError
+
+    def call(self):
+        stx, participant_keys = yield self.record(self.assemble_tx)
+        hub = self.service_hub
+        my_keys = hub.key_management_service.keys
+        others: List[Party] = []
+        for key in participant_keys:
+            if key.encoded in my_keys:
+                continue
+            party = hub.identity_service.party_from_key(key)
+            if party is None:
+                raise StateReplacementException(
+                    f"participant {key} not found on the network"
+                )
+            others.append(party)
+
+        participant_sigs = []
+        proposal = Proposal(self.original_state.ref, self.modification, stx)
+        for party in others:
+            sig = yield self.send_and_receive(
+                party, proposal, DigitalSignatureWithKey
+            )
+            if not party.owning_key.is_fulfilled_by({sig.by}):
+                raise StateReplacementException(
+                    "not signed by the required participant"
+                )
+            if not sig.is_valid(stx.id.bytes):
+                raise StateReplacementException("invalid participant signature")
+            participant_sigs.append(sig)
+            stx = stx.with_additional_signature(sig)
+
+        try:
+            notary_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+        except Exception as exc:
+            raise StateReplacementException(
+                f"unable to notarise state change: {exc}"
+            )
+        final = stx.with_additional_signatures(notary_sigs)
+        for party in others:
+            yield self.send(
+                party, SignaturesPayload(tuple(participant_sigs) + tuple(notary_sigs))
+            )
+        _record_replacement(hub, final)
+        return self._replacement_output(final)
+
+    def _replacement_output(self, final: SignedTransaction) -> StateAndRef:
+        wtx = final.tx
+        if isinstance(wtx, NotaryChangeWireTransaction):
+            outputs = wtx.resolve_outputs(self.service_hub.load_state)
+            return StateAndRef(outputs[0], StateRef(final.id, 0))
+        return wtx.out_ref(0)
+
+
+class AbstractStateReplacementAcceptor(FlowLogic):
+    """Acceptor half (reference AbstractStateReplacementFlow.Acceptor).
+
+    Subclasses implement `verify_proposal(proposal)` — raise
+    StateReplacementException to refuse."""
+
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def verify_proposal(self, proposal: Proposal) -> None:
+        raise NotImplementedError
+
+    def call(self):
+        proposal = yield self.receive(self.counterparty, Proposal)
+        self.verify_proposal(proposal)
+        stx = proposal.stx
+        stx.check_signatures_are_valid()
+        hub = self.service_hub
+        wtx = stx.tx
+        if isinstance(wtx, NotaryChangeWireTransaction):
+            for ref in wtx.inputs:
+                ts = hub.load_state(ref)
+                if ts.notary.owning_key.encoded != wtx.notary.owning_key.encoded:
+                    raise StateReplacementException(
+                        f"input {ref} is governed by {ts.notary.name}, "
+                        f"not {wtx.notary.name}"
+                    )
+            required = wtx.resolved_required_keys(hub.load_state)
+        else:
+            required = wtx.required_signing_keys
+        my_keys = hub.key_management_service.keys
+        to_sign = [k for k in required if k.encoded in my_keys]
+        if not to_sign:
+            raise StateReplacementException(
+                "proposal does not require our signature"
+            )
+        sig = hub.key_management_service.sign(stx.id.bytes, to_sign[0])
+        payload = yield self.send_and_receive(
+            self.counterparty, sig, SignaturesPayload
+        )
+        final = stx.with_additional_signatures(payload.signatures)
+        if isinstance(wtx, NotaryChangeWireTransaction):
+            # Signature sufficiency needs resolution for this tx kind.
+            final.check_signatures_are_valid()
+            signed = {s.by for s in final.sigs}
+            missing = {
+                k for k in wtx.resolved_required_keys(hub.load_state)
+                if not k.is_fulfilled_by(signed)
+            }
+            if missing:
+                raise StateReplacementException(
+                    f"final transaction is missing signatures: {missing}"
+                )
+        else:
+            final.verify_required_signatures()
+        _record_replacement(hub, final)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Notary change (reference NotaryChangeFlow.kt)
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class NotaryChangeFlow(AbstractStateReplacementInstigator):
+    """Migrate a state (and its encumbrance chain) to a new notary."""
+
+    def assemble_tx(self):
+        hub = self.service_hub
+        states = [self.original_state]
+        # Resolve the encumbrance chain: all-or-nothing migration
+        # (reference NotaryChangeFlow.resolveEncumbrances). Cyclic
+        # encumbrances pass ledger validation, so terminate on revisit.
+        seen = {self.original_state.ref}
+        while states[-1].state.encumbrance is not None:
+            ref = StateRef(states[-1].ref.txhash, states[-1].state.encumbrance)
+            if ref in seen:
+                break
+            seen.add(ref)
+            states.append(StateAndRef(hub.load_state(ref), ref))
+        wtx = NotaryChangeWireTransaction(
+            tuple(s.ref for s in states),
+            self.original_state.state.notary,
+            self.modification,
+        )
+        participant_keys = set()
+        for s in states:
+            for p in s.state.data.participants:
+                key = getattr(p, "owning_key", None)
+                if key is not None:
+                    participant_keys.add(key)
+        my_keys = hub.key_management_service.keys
+        mine = [k for k in participant_keys if k.encoded in my_keys]
+        if not mine:
+            raise StateReplacementException("we are not a participant")
+        sig = hub.key_management_service.sign(wtx.id.bytes, mine[0])
+        return SignedTransaction.of(wtx, (sig,)), participant_keys
+
+
+@initiated_by(NotaryChangeFlow)
+class NotaryChangeAcceptor(AbstractStateReplacementAcceptor):
+    """Default acceptor: checks the proposal is a well-formed notary change
+    for a state we hold (reference NotaryChangeHandler via
+    installCoreFlows)."""
+
+    def verify_proposal(self, proposal: Proposal) -> None:
+        wtx = proposal.stx.tx
+        if not isinstance(wtx, NotaryChangeWireTransaction):
+            raise StateReplacementException(
+                "notary-change proposal with wrong transaction type"
+            )
+        if not isinstance(proposal.modification, Party):
+            raise StateReplacementException("modification must be a Party")
+        if wtx.new_notary != proposal.modification:
+            raise StateReplacementException(
+                "transaction new notary differs from proposed modification"
+            )
+        if proposal.state_ref not in wtx.inputs:
+            raise StateReplacementException(
+                "proposed state is not an input of the transaction"
+            )
+        # The new notary must be an advertised notary we know of.
+        cache = self.service_hub.network_map_cache
+        notaries = cache.notary_identities
+        if proposal.modification not in notaries:
+            raise StateReplacementException(
+                f"{proposal.modification.name} is not a known notary"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Contract upgrade (reference ContractUpgradeFlow.kt + UpgradedContract)
+# ---------------------------------------------------------------------------
+
+class UpgradedContract:
+    """Interface for a contract that upgrades states of a legacy contract
+    (reference Structures.kt:359-374). Register the implementing class
+    with @contract(name=...) as usual."""
+
+    legacy_contract_name: str = ""
+
+    def upgrade(self, state: ContractState) -> ContractState:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class UpgradeCommand(CommandData):
+    """Authorises a contract upgrade (reference Structures.kt:317)."""
+
+    upgraded_contract_name: str
+
+
+register_adapter(
+    UpgradeCommand, "UpgradeCommand",
+    lambda c: {"name": c.upgraded_contract_name},
+    lambda d: UpgradeCommand(d["name"]),
+)
+
+
+def verify_upgrade(input_state: ContractState, output_state: ContractState,
+                   upgraded_contract: UpgradedContract,
+                   command_signers: Iterable) -> None:
+    """The upgrade rules every party re-checks (reference
+    ContractUpgradeFlow.verify): participants all sign, input is of the
+    legacy contract, output equals upgrade(input)."""
+    signer_set = set(k.encoded for k in command_signers)
+    for p in input_state.participants:
+        key = getattr(p, "owning_key", None)
+        if key is not None and key.encoded not in signer_set:
+            raise StateReplacementException(
+                "the signing keys must include all participant keys"
+            )
+    if input_state.contract_name != upgraded_contract.legacy_contract_name:
+        raise StateReplacementException(
+            "input state does not reference the legacy contract"
+        )
+    if output_state != upgraded_contract.upgrade(input_state):
+        raise StateReplacementException(
+            "output state must be an upgraded version of the input state"
+        )
+
+
+@initiating_flow
+class ContractUpgradeFlow(AbstractStateReplacementInstigator):
+    """Upgrade a state to a new contract. `modification` is the upgraded
+    contract's registered name; the class must be an UpgradedContract."""
+
+    def assemble_tx(self):
+        from ..contracts.structures import _CONTRACT_REGISTRY
+
+        hub = self.service_hub
+        cls = _CONTRACT_REGISTRY.get(self.modification)
+        upgraded = cls() if cls is not None else None
+        if upgraded is None or not isinstance(upgraded, UpgradedContract):
+            raise StateReplacementException(
+                f"{self.modification} is not a registered UpgradedContract"
+            )
+        old = self.original_state
+        participant_keys = {
+            p.owning_key
+            for p in old.state.data.participants
+            if getattr(p, "owning_key", None) is not None
+        }
+        builder = TransactionBuilder(notary=old.state.notary)
+        builder.add_input_state(old)
+        builder.add_output_state(upgraded.upgrade(old.state.data))
+        builder.add_command(UpgradeCommand(self.modification), *participant_keys)
+        stx = hub.sign_initial_transaction(builder)
+        return stx, participant_keys
+
+
+@initiated_by(ContractUpgradeFlow)
+class ContractUpgradeAcceptor(AbstractStateReplacementAcceptor):
+    def verify_proposal(self, proposal: Proposal) -> None:
+        from ..contracts.structures import _CONTRACT_REGISTRY
+
+        if not isinstance(proposal.modification, str):
+            raise StateReplacementException("modification must be a contract name")
+        cls = _CONTRACT_REGISTRY.get(proposal.modification)
+        upgraded = cls() if cls is not None else None
+        if upgraded is None or not isinstance(upgraded, UpgradedContract):
+            raise StateReplacementException(
+                f"{proposal.modification} is not a registered UpgradedContract"
+            )
+        wtx = proposal.stx.tx
+        if len(wtx.inputs) != 1 or len(wtx.outputs) != 1:
+            raise StateReplacementException(
+                "upgrade transaction must have exactly one input and output"
+            )
+        if wtx.inputs[0] != proposal.state_ref:
+            raise StateReplacementException(
+                "proposed state is not the transaction input"
+            )
+        input_state = self.service_hub.load_state(proposal.state_ref)
+        upgrade_cmds = [
+            c for c in wtx.commands if isinstance(c.value, UpgradeCommand)
+        ]
+        if len(upgrade_cmds) != 1:
+            raise StateReplacementException(
+                "upgrade transaction must have exactly one UpgradeCommand"
+            )
+        verify_upgrade(
+            input_state.data,
+            wtx.outputs[0].data,
+            upgraded,
+            upgrade_cmds[0].signers,
+        )

@@ -1,0 +1,108 @@
+"""NotaryChangeWireTransaction: the special notary-migration transaction.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/transactions/
+NotaryChangeTransactions.kt:16-60` — a transaction carrying only input
+StateRefs, the old notary and the new notary.  It has NO stored outputs:
+the outputs are derived by resolving the inputs and swapping their notary
+(so the state data provably cannot change in flight).  Filtering/tear-offs
+do not apply; required signers are the input states' participants, which
+means signature verification needs resolution (reference
+NotaryChangeLedgerTransaction:52-90).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Tuple
+
+from ..contracts.structures import StateRef, TransactionState
+from ..crypto.secure_hash import SecureHash
+from ..identity import Party
+from ..serialization.codec import register_adapter, serialize
+
+
+@dataclass(frozen=True)
+class NotaryChangeWireTransaction:
+    inputs: Tuple[StateRef, ...]
+    notary: Party       # the current notary (commits the inputs)
+    new_notary: Party
+
+    def __post_init__(self):
+        if not self.inputs:
+            raise ValueError("a notary change transaction must have inputs")
+        if self.notary == self.new_notary:
+            raise ValueError("the old and new notaries must be different")
+
+    @property
+    def id(self) -> SecureHash:
+        # Inputs are globally unique (their originating transactions used
+        # salted nonces), so a plain hash over the canonical serialization
+        # is collision-safe here — no privacy salt needed (reference
+        # NotaryChangeTransactions.kt:33-37).
+        return SecureHash.sha256(
+            serialize(
+                {"in": list(self.inputs), "old": self.notary,
+                 "new": self.new_notary}
+            )
+        )
+
+    # Duck-typed WireTransaction surface used by SignedTransaction / the
+    # notary path. Outputs and signers need resolution — the resolver is a
+    # `load_state(StateRef) -> TransactionState` callable.
+
+    @property
+    def outputs(self):
+        raise NotImplementedError(
+            "notary-change outputs require resolution: use resolve_outputs()"
+        )
+
+    @property
+    def time_window(self):
+        return None
+
+    @property
+    def attachments(self):
+        return ()
+
+    def resolve_outputs(
+        self, load_state: Callable[[StateRef], TransactionState]
+    ) -> List[TransactionState]:
+        """Output i = input i with the notary swapped (reference
+        NotaryChangeLedgerTransaction.outputs computation)."""
+        outs = []
+        for ref in self.inputs:
+            ts = load_state(ref)
+            outs.append(
+                TransactionState(
+                    data=ts.data, notary=self.new_notary,
+                    encumbrance=ts.encumbrance,
+                )
+            )
+        return outs
+
+    def resolved_required_keys(
+        self, load_state: Callable[[StateRef], TransactionState]
+    ) -> frozenset:
+        """Participants of every input state, plus the old notary
+        (reference NotaryChangeLedgerTransaction.requiredSigningKeys)."""
+        keys = {self.notary.owning_key}
+        for ref in self.inputs:
+            ts = load_state(ref)
+            for p in ts.data.participants:
+                key = getattr(p, "owning_key", None)
+                if key is not None:
+                    keys.add(key)
+        return frozenset(keys)
+
+    @property
+    def required_signing_keys(self) -> frozenset:
+        raise NotImplementedError(
+            "notary-change signers require resolution: use "
+            "resolved_required_keys()"
+        )
+
+
+register_adapter(
+    NotaryChangeWireTransaction, "NotaryChangeWireTransaction",
+    lambda t: {"in": list(t.inputs), "old": t.notary, "new": t.new_notary},
+    lambda d: NotaryChangeWireTransaction(tuple(d["in"]), d["old"], d["new"]),
+)

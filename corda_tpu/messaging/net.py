@@ -306,9 +306,16 @@ class RemoteConsumer:
             raise QueueClosedError("remote consumer is closed")
         while True:
             timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
-            reply = self._conn.request(
-                bytes([OP_RECEIVE]) + struct.pack(">I", timeout_ms)
-            )
+            try:
+                reply = self._conn.request(
+                    bytes([OP_RECEIVE]) + struct.pack(">I", timeout_ms)
+                )
+            except (ConnectionError, OSError):
+                # Transport died (broker gone): behave like a closed queue —
+                # return None so poll loops wind down without stack spam;
+                # subsequent receives raise QueueClosedError.
+                self._closed = True
+                return None
             if reply[0] != RE_EMPTY:
                 break
             if timeout is not None:

@@ -53,19 +53,41 @@ def main(argv=None) -> int:
         importlib.import_module(mod)
 
     from ..messaging import Broker
-    from ..messaging.net import BrokerServer
+    from ..messaging.net import BrokerServer, RemoteBroker
     from ..rpc.ops import CordaRPCOps
     from ..rpc.server import RPCServer, RPCUser
     from .network import BrokerMessagingService
+    from .networkmap import BridgeManager, NetworkMapClient, NetworkMapService
     from .node import AbstractNode
 
+    # Transport security: dev-mode certificate chain + mutual TLS on the
+    # broker socket (AbstractNode.configureWithDevSSLCertificate +
+    # ArtemisTcpTransport). Peers must chain to the same trust root; point
+    # every node's "certificates_dir" at a shared directory (the driver
+    # does) or distribute the root cert.
+    server_wrap = client_wrap = None
+    if cfg.tls:
+        from ..core.crypto import pki
+
+        cert_dir = cfg.certificates_dir
+        entries = pki.dev_certificates(cert_dir, cfg.node.my_legal_name)
+        server_wrap = pki.server_wrap(pki.server_ssl_context(cert_dir, entries))
+        client_wrap = pki.client_wrap(pki.client_ssl_context(cert_dir, entries))
+
     broker = Broker(journal_dir=cfg.journal_dir)
-    server = BrokerServer(broker, host=cfg.broker_host, port=cfg.broker_port)
+    server = BrokerServer(
+        broker, host=cfg.broker_host, port=cfg.broker_port,
+        server_wrap=server_wrap,
+    )
     server.start()
 
+    bridges = BridgeManager(
+        broker,
+        remote_broker_factory=lambda h, p: RemoteBroker(h, p, client_wrap=client_wrap),
+    )
     node = AbstractNode(
         cfg.node,
-        messaging_factory=lambda me: BrokerMessagingService(broker, me),
+        messaging_factory=lambda me: BrokerMessagingService(broker, me, bridges),
         broker=broker,
     )
     users = [
@@ -73,6 +95,36 @@ def main(argv=None) -> int:
         for u in cfg.rpc_users
     ] or None
     rpc = RPCServer(broker, CordaRPCOps(node.services, node.smm), users=users)
+
+    netmap_service = None
+    if cfg.network_map_service:
+        netmap_service = NetworkMapService(broker).start()
+
+    netmap_client = None
+    if cfg.network_map or cfg.network_map_service:
+        # Register with the directory (possibly ourselves), fetch peers,
+        # subscribe to pushes (AbstractNode.kt:584-621).
+        if cfg.network_map and not cfg.network_map_service:
+            host, port_s = cfg.network_map.rsplit(":", 1)
+            map_broker = RemoteBroker(host, int(port_s), client_wrap=client_wrap)
+        else:
+            map_broker = broker
+
+        def on_entry(reg):
+            # Route first: once the peer is resolvable via the identity
+            # service, a flow may immediately send to it.
+            bridges.set_route(reg.party.name, reg.broker_address)
+            node.register_peer(reg.party, reg.advertised_services)
+
+        netmap_client = NetworkMapClient(
+            map_broker, node.info,
+            f"{cfg.broker_host}:{server.port}",
+            cfg.node.advertised_services,
+            node._identity_key.private,
+            on_entry,
+        )
+        netmap_client.register_and_fetch()
+
     node.start()
     # The port file doubles as the readiness signal (written only once RPC
     # and the state machine are serving), so external tooling can poll it.
@@ -90,6 +142,11 @@ def main(argv=None) -> int:
         while not stop.wait(0.5):
             pass
     finally:
+        if netmap_client is not None:
+            netmap_client.stop()
+        if netmap_service is not None:
+            netmap_service.stop()
+        bridges.stop()
         rpc.stop()
         node.stop()
         server.stop()

@@ -32,6 +32,9 @@ DEFAULTS = {
     "rpc_users": [],                # [{"username","password","permissions":[...]}]
     "jax_platform": None,
     "network_map": None,            # "HOST:PORT" of the directory node, or None
+    "network_map_service": False,   # True: this node IS the directory node
+    "tls": False,                   # mutual-TLS on the broker transport
+    "certificates_dir": "certificates",  # may be shared between dev nodes
     # CorDapp scan analogue (reference AbstractNode.scanCordapps /
     # installCordaServices, AbstractNode.kt:291-315): python modules to
     # import at startup so their @startable_by_rpc / @initiated_by flows
@@ -52,6 +55,9 @@ class FullNodeConfiguration:
     rpc_users: List[dict] = field(default_factory=list)
     jax_platform: Optional[str] = None
     network_map: Optional[str] = None
+    network_map_service: bool = False
+    tls: bool = False
+    certificates_dir: str = "certificates"
     cordapps: List[str] = field(default_factory=list)
 
 
@@ -84,5 +90,12 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         rpc_users=list(cfg["rpc_users"]),
         jax_platform=cfg["jax_platform"],
         network_map=cfg.get("network_map"),
+        network_map_service=bool(cfg["network_map_service"]),
+        tls=bool(cfg["tls"]),
+        certificates_dir=(
+            cfg["certificates_dir"]
+            if os.path.isabs(cfg["certificates_dir"])
+            else os.path.join(base, cfg["certificates_dir"])
+        ),
         cordapps=list(cfg["cordapps"]),
     )

@@ -116,13 +116,17 @@ class BrokerMessagingService:
     `p2p.inbound.{name}`; a consumer thread dispatches to topic handlers.
     Used for single-process durable deployments and the verifier topology."""
 
-    def __init__(self, broker, me: Party):
+    def __init__(self, broker, me: Party, bridges=None):
+        """`bridges`: optional BridgeManager — when it has a route for a
+        peer, outbound messages go to its store-and-forward queue instead
+        of a local inbound queue (cross-process P2P)."""
         from ..core.serialization.codec import deserialize, serialize
 
         self._serialize = serialize
         self._deserialize = deserialize
         self.broker = broker
         self.me = me
+        self.bridges = bridges
         self.queue_name = f"p2p.inbound.{me.name}"
         broker.create_queue(self.queue_name, durable=broker._journal_dir is not None)
         self._handlers: Dict[str, List[Callable]] = {}
@@ -131,15 +135,32 @@ class BrokerMessagingService:
         self._thread = threading.Thread(
             target=self._consume, name=f"p2p-{me.name}", daemon=True
         )
-        self._thread.start()
+        # NOT started here: the pump must only run once the node has
+        # installed its flow handlers (AbstractNode.start), otherwise a
+        # message arriving in the startup window is dispatched into a void
+        # and acked away — observed as a lost broadcast when a node
+        # restarts while peers' bridges are retrying. Inbound messages
+        # wait safely in the (durable) queue until start().
+
+    def start(self) -> None:
+        if not self._thread.is_alive():
+            self._thread.start()
 
     def send(self, peer: Party, topic: str, payload: bytes) -> None:
-        self.broker.send(
-            f"p2p.inbound.{peer.name}",
-            payload,
-            headers={"topic": topic, "sender": self.me.name,
-                     "sender_key": self.me.owning_key.encoded.hex()},
-        )
+        headers = {"topic": topic, "sender": self.me.name,
+                   "sender_key": self.me.owning_key.encoded.hex()}
+        if (
+            self.bridges is not None
+            and peer.name != self.me.name
+            and self.bridges.route_for(peer.name) is not None
+        ):
+            # Remote peer: durable outbound queue + bridge forwarder
+            # (ArtemisMessagingServer.deployBridge semantics).
+            self.broker.send(
+                self.bridges.outbound_queue(peer.name), payload, headers
+            )
+            return
+        self.broker.send(f"p2p.inbound.{peer.name}", payload, headers)
 
     def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
         self._handlers.setdefault(topic, []).append(fn)
@@ -171,4 +192,5 @@ class BrokerMessagingService:
     def stop(self) -> None:
         self._stop.set()
         self._consumer.close()
-        self._thread.join(timeout=2)
+        if self._thread.ident is not None:  # pump may never have started
+            self._thread.join(timeout=2)

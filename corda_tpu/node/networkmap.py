@@ -1,0 +1,436 @@
+"""Network map: the directory-node protocol + per-node client + P2P bridges.
+
+Reference parity:
+  * `node/src/main/kotlin/net/corda/node/services/network/
+    NetworkMapService.kt:65-71` — REGISTER / FETCH / QUERY / SUBSCRIBE /
+    PUSH topics served by a designated directory node, with **signed**
+    `NodeRegistration`s (serial-numbered ADD/REMOVE, expiry);
+  * `InMemoryNetworkMapCache` — the client-side cache each node keeps
+    (corda_tpu.node.services.NetworkMapCache);
+  * `ArtemisMessagingServer.kt:299-412` — store-and-forward **bridges**
+    deployed from network-map changes: outbound messages queue durably on
+    the local broker and a bridge forwards them to the peer's broker,
+    retrying while the peer is down.
+
+Topology here: the map service runs in a node process and serves over
+that node's TCP broker (`netmap.requests` queue).  Other nodes connect
+with a RemoteBroker, REGISTER a signed entry carrying their own broker
+address, FETCH the current map, and SUBSCRIBE for pushes.  The
+registration signature is checked against the party key inside the entry
+(a malicious node cannot forge someone else's mapping).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..core.crypto import crypto
+from ..core.identity import Party
+from ..core.serialization.codec import (
+    deserialize,
+    register_adapter,
+    serialize,
+)
+
+NETWORK_MAP_QUEUE = "netmap.requests"
+
+ADD = "ADD"
+REMOVE = "REMOVE"
+
+
+@dataclass(frozen=True)
+class NodeRegistration:
+    """One signed directory entry (reference NodeRegistration)."""
+
+    party: Party
+    broker_address: str      # HOST:PORT of the node's broker
+    advertised_services: tuple
+    serial: int              # monotonically increasing per party
+    expires_at: float        # unix seconds
+    reg_type: str = ADD      # ADD | REMOVE
+
+    def signable_bytes(self) -> bytes:
+        return serialize(
+            {
+                "party": self.party,
+                "addr": self.broker_address,
+                "services": tuple(self.advertised_services),
+                "serial": self.serial,
+                "expires": self.expires_at,
+                "type": self.reg_type,
+            }
+        )
+
+
+@dataclass(frozen=True)
+class SignedRegistration:
+    registration: NodeRegistration
+    signature: bytes
+
+    def verify(self) -> bool:
+        try:
+            return crypto.is_valid(
+                self.registration.party.owning_key,
+                self.signature,
+                self.registration.signable_bytes(),
+            )
+        except Exception:
+            return False
+
+
+register_adapter(
+    NodeRegistration, "NodeRegistration",
+    lambda r: {
+        "party": r.party, "addr": r.broker_address,
+        "services": tuple(r.advertised_services), "serial": r.serial,
+        "expires": r.expires_at, "type": r.reg_type,
+    },
+    lambda d: NodeRegistration(
+        d["party"], d["addr"], tuple(d["services"]), d["serial"],
+        d["expires"], d["type"],
+    ),
+)
+register_adapter(
+    SignedRegistration, "SignedRegistration",
+    lambda r: {"reg": r.registration, "sig": r.signature},
+    lambda d: SignedRegistration(d["reg"], d["sig"]),
+)
+
+
+def sign_registration(reg: NodeRegistration, private_key) -> SignedRegistration:
+    return SignedRegistration(reg, crypto.do_sign(private_key, reg.signable_bytes()))
+
+
+class NetworkMapService:
+    """The directory service (runs in the map node's process, serves over
+    its broker).  Thread-per-service pull loop, mirroring the verifier
+    worker's shape."""
+
+    def __init__(self, broker):
+        self._broker = broker
+        broker.create_queue(NETWORK_MAP_QUEUE)
+        self._entries: Dict[str, SignedRegistration] = {}
+        self._subscribers: Dict[str, None] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(NETWORK_MAP_QUEUE)
+        self._thread = threading.Thread(
+            target=self._run, name="network-map", daemon=True
+        )
+
+    def start(self) -> "NetworkMapService":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+
+    # -- protocol ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            msg = self._consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            try:
+                request = deserialize(msg.payload)
+                self._handle(request)
+            except Exception:
+                pass  # malformed request must not kill the directory
+            self._consumer.ack(msg)
+
+    def _handle(self, request: dict) -> None:
+        kind = request.get("kind")
+        reply_to = request.get("reply_to")
+        if kind == "register":
+            signed: SignedRegistration = request["registration"]
+            ok, reason = self._process_registration(signed)
+            if reply_to:
+                self._reply(reply_to, {"kind": "register-ack", "ok": ok,
+                                       "error": reason})
+            if ok:
+                self._push({"kind": "push", "registration": signed})
+        elif kind == "fetch":
+            now = time.time()
+            with self._lock:
+                entries = [
+                    s for s in self._entries.values()
+                    if s.registration.reg_type == ADD
+                    and s.registration.expires_at > now
+                ]
+            if reply_to:
+                self._reply(reply_to, {"kind": "fetch-reply", "entries": entries})
+        elif kind == "subscribe":
+            queue = request.get("queue")
+            if queue:
+                with self._lock:
+                    self._subscribers[queue] = None
+                if reply_to:
+                    self._reply(reply_to, {"kind": "subscribe-ack", "ok": True})
+        elif kind == "query":
+            name = request.get("name")
+            with self._lock:
+                signed = self._entries.get(name)
+            if signed is not None and (
+                signed.registration.reg_type == REMOVE
+                or signed.registration.expires_at < time.time()
+            ):
+                signed = None
+            if reply_to:
+                self._reply(reply_to, {"kind": "query-reply", "entry": signed})
+
+    def _process_registration(self, signed) -> tuple:
+        if not isinstance(signed, SignedRegistration):
+            return False, "not a SignedRegistration"
+        reg = signed.registration
+        if not signed.verify():
+            return False, "bad signature"
+        if reg.expires_at < time.time():
+            return False, "expired"
+        with self._lock:
+            current = self._entries.get(reg.party.name)
+            if current is not None and current.registration.serial >= reg.serial:
+                return False, "stale serial"
+            # REMOVE entries are retained (not popped) so their serial
+            # still orders against late ADDs; fetch/query filter them out.
+            self._entries[reg.party.name] = signed
+        return True, None
+
+    def _reply(self, queue: str, payload: dict) -> None:
+        try:
+            self._broker.create_queue(queue)
+            self._broker.send(queue, serialize(payload))
+        except Exception:
+            pass
+
+    def _push(self, payload: dict) -> None:
+        blob = serialize(payload)
+        with self._lock:
+            subscribers = list(self._subscribers)
+        for queue in subscribers:
+            try:
+                self._broker.send(queue, blob)
+            except Exception:
+                with self._lock:
+                    self._subscribers.pop(queue, None)
+
+    # -- introspection -------------------------------------------------------
+
+    def entries(self) -> List[SignedRegistration]:
+        with self._lock:
+            return list(self._entries.values())
+
+
+class NetworkMapClient:
+    """Per-node client: register self, fetch the map, subscribe to pushes;
+    feeds the node's NetworkMapCache + identity service and the bridge
+    router (reference AbstractNode.registerWithNetworkMapIfConfigured,
+    `AbstractNode.kt:584-621`)."""
+
+    def __init__(self, map_broker, me: Party, my_address: str,
+                 advertised_services, identity_private_key,
+                 on_entry: Callable[[NodeRegistration], None],
+                 on_remove: Optional[Callable[[NodeRegistration], None]] = None):
+        self._broker = map_broker
+        self._me = me
+        self._my_address = my_address
+        self._advertised = tuple(advertised_services)
+        self._key = identity_private_key
+        self._on_entry = on_entry
+        self._on_remove = on_remove
+        self._serial = int(time.time() * 1000)
+        self._reply_queue = f"netmap.reply.{me.name}"
+        self._push_queue = f"netmap.push.{me.name}"
+        map_broker.create_queue(self._reply_queue)
+        map_broker.create_queue(self._push_queue)
+        self._reply_consumer = map_broker.create_consumer(self._reply_queue)
+        self._push_consumer = map_broker.create_consumer(self._push_queue)
+        self._stop = threading.Event()
+        self._push_thread = threading.Thread(
+            target=self._consume_pushes, name=f"netmap-push-{me.name}",
+            daemon=True,
+        )
+
+    # -- startup handshake ---------------------------------------------------
+
+    def register_and_fetch(self, timeout: float = 15.0) -> int:
+        """REGISTER self + SUBSCRIBE + FETCH; apply entries; returns the
+        number of peers learned. Raises on registration rejection."""
+        reg = NodeRegistration(
+            self._me, self._my_address, self._advertised,
+            serial=self._serial, expires_at=time.time() + 3600 * 24,
+        )
+        self._request(
+            {"kind": "register", "registration": sign_registration(reg, self._key),
+             "reply_to": self._reply_queue},
+        )
+        ack = self._await_reply("register-ack", timeout)
+        if not ack.get("ok"):
+            raise RuntimeError(f"network map rejected registration: {ack.get('error')}")
+        self._request({"kind": "subscribe", "queue": self._push_queue,
+                       "reply_to": self._reply_queue})
+        self._await_reply("subscribe-ack", timeout)
+        self._request({"kind": "fetch", "reply_to": self._reply_queue})
+        reply = self._await_reply("fetch-reply", timeout)
+        count = 0
+        for signed in reply.get("entries", []):
+            if self._apply(signed):
+                count += 1
+        self._push_thread.start()
+        return count
+
+    def _request(self, payload: dict) -> None:
+        self._broker.send(NETWORK_MAP_QUEUE, serialize(payload))
+
+    def _await_reply(self, kind: str, timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            msg = self._reply_consumer.receive(
+                timeout=max(0.05, deadline - time.monotonic())
+            )
+            if msg is None:
+                continue
+            self._reply_consumer.ack(msg)
+            reply = deserialize(msg.payload)
+            if reply.get("kind") == kind:
+                return reply
+        raise TimeoutError(f"no {kind} from network map")
+
+    # -- push subscription ---------------------------------------------------
+
+    def _consume_pushes(self) -> None:
+        from ..messaging import QueueClosedError
+
+        while not self._stop.is_set():
+            try:
+                msg = self._push_consumer.receive(timeout=0.2)
+            except QueueClosedError:
+                return  # map broker gone; subscription ends
+            if msg is None:
+                if getattr(self._push_consumer, "_closed", False):
+                    return
+                continue
+            try:
+                payload = deserialize(msg.payload)
+                if payload.get("kind") == "push":
+                    self._apply(payload["registration"])
+            except Exception:
+                pass
+            self._push_consumer.ack(msg)
+
+    def _apply(self, signed: SignedRegistration) -> bool:
+        if not isinstance(signed, SignedRegistration) or not signed.verify():
+            return False
+        reg = signed.registration
+        if reg.party.name == self._me.name:
+            return False
+        if reg.reg_type == REMOVE:
+            if self._on_remove is not None:
+                self._on_remove(reg)
+            return False
+        self._on_entry(reg)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._reply_consumer.close()
+        self._push_consumer.close()
+
+
+class BridgeManager:
+    """Store-and-forward bridges to peer brokers (ArtemisMessagingServer.
+    deployBridge, `ArtemisMessagingServer.kt:299-412,377-400`).
+
+    Outbound P2P messages for a remote peer are enqueued durably on the
+    LOCAL broker (`p2p.outbound.<peer>`); one forwarder thread per peer
+    drains that queue into the peer's broker over TCP, acking only after
+    the remote send succeeds — so messages survive local restarts and peer
+    downtime, with redelivery on reconnect."""
+
+    def __init__(self, local_broker, remote_broker_factory=None):
+        from ..messaging.net import RemoteBroker
+
+        self._local = local_broker
+        self._addresses: Dict[str, str] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._factory = remote_broker_factory or (
+            lambda host, port: RemoteBroker(host, port)
+        )
+
+    def set_route(self, peer_name: str, broker_address: str) -> None:
+        # Create the outbound queue BEFORE publishing the route: a sender
+        # gated on route_for() must never race the forwarder thread into
+        # an UnknownQueueError.
+        self._local.create_queue(
+            self.outbound_queue(peer_name),
+            durable=getattr(self._local, "_journal_dir", None) is not None,
+        )
+        with self._lock:
+            self._addresses[peer_name] = broker_address
+            if peer_name not in self._threads:
+                t = threading.Thread(
+                    target=self._forward, args=(peer_name,),
+                    name=f"bridge-{peer_name}", daemon=True,
+                )
+                self._threads[peer_name] = t
+                t.start()
+
+    def route_for(self, peer_name: str) -> Optional[str]:
+        with self._lock:
+            return self._addresses.get(peer_name)
+
+    def outbound_queue(self, peer_name: str) -> str:
+        return f"p2p.outbound.{peer_name}"
+
+    def _forward(self, peer_name: str) -> None:
+        queue = self.outbound_queue(peer_name)  # created by set_route
+        consumer = self._local.create_consumer(queue)
+        remote = None
+        while not self._stop.is_set():
+            msg = consumer.receive(timeout=0.2)
+            if msg is None:
+                continue
+            delivered = False
+            while not delivered and not self._stop.is_set():
+                try:
+                    if remote is None:
+                        with self._lock:
+                            addr = self._addresses[peer_name]
+                        host, port_s = addr.rsplit(":", 1)
+                        remote = self._factory(host, int(port_s))
+                    remote.send(
+                        f"p2p.inbound.{peer_name}", msg.payload, msg.headers
+                    )
+                    delivered = True
+                except Exception as exc:
+                    # Peer down: drop the connection, back off, retry —
+                    # store-and-forward semantics.
+                    import sys as _sys
+
+                    print(
+                        f"bridge {peer_name}: delivery failed ({type(exc).__name__}: {exc}); retrying",
+                        file=_sys.stderr, flush=True,
+                    )
+                    try:
+                        if remote is not None:
+                            remote.close()
+                    except Exception:
+                        pass
+                    remote = None
+                    self._stop.wait(0.5)
+            if delivered:
+                consumer.ack(msg)
+        if remote is not None:
+            try:
+                remote.close()
+            except Exception:
+                pass
+        consumer.close()
+
+    def stop(self) -> None:
+        self._stop.set()

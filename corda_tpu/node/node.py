@@ -104,6 +104,10 @@ class AbstractNode:
             self.info, self.config.advertised_services
         )
         self.smm.start()
+        if hasattr(self.network, "start"):
+            # Open the P2P pump only now that handlers are installed (a
+            # message consumed before this point would be dropped).
+            self.network.start()
         self.started = True
         return self
 

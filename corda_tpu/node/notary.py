@@ -290,11 +290,35 @@ class NotaryClientFlow(FlowLogic):
         self.notary_validating = notary_validating
 
     def call(self):
+        from ..core.transactions.notary_change import (
+            NotaryChangeWireTransaction,
+        )
+
         stx = self.stx
         notary = stx.notary
         if notary is None:
             raise FlowException("transaction has no notary set")
-        if stx.inputs:
+        is_notary_change = isinstance(stx.tx, NotaryChangeWireTransaction)
+        if is_notary_change:
+            # Required signers need input resolution; the instigator holds
+            # the states. Cryptographic validity + participant coverage
+            # minus the notary (reference: NotaryChangeLedgerTransaction
+            # signature semantics).
+            stx.check_signatures_are_valid()
+            signed = {s.by for s in stx.sigs}
+            missing = {
+                k
+                for k in stx.tx.resolved_required_keys(
+                    self.service_hub.load_state
+                )
+                if not k.is_fulfilled_by(signed)
+                and k.encoded != notary.owning_key.encoded
+            }
+            if missing:
+                raise FlowException(
+                    f"notary change is missing signatures: {missing}"
+                )
+        elif stx.inputs:
             # All non-notary signatures must already be present and valid.
             stx.verify_signatures_except(notary.owning_key)
         validating = self.notary_validating
@@ -302,7 +326,9 @@ class NotaryClientFlow(FlowLogic):
             validating = self.service_hub.network_map_cache.is_validating_notary(
                 notary
             )
-        if validating:
+        if validating or is_notary_change:
+            # Tear-offs don't apply to notary-change transactions
+            # (reference NotaryChangeTransactions.kt: filtering n/a).
             payload = NotarisationPayload(stx, None)
         else:
             # Reveal only what a non-validating notary needs: inputs
@@ -351,6 +377,13 @@ class NotaryServiceFlow(FlowLogic):
         yield self.send(self.counterparty, NotarisationResponse((sig,)))
 
     def _receive_and_verify(self, service: NotaryService, payload):
+        from ..core.transactions.notary_change import (
+            NotaryChangeWireTransaction,
+        )
+
+        stx = payload.signed_transaction
+        if stx is not None and isinstance(stx.tx, NotaryChangeWireTransaction):
+            return (yield from self._verify_notary_change(stx))
         if service.validating:
             stx = payload.signed_transaction
             if stx is None:
@@ -386,6 +419,59 @@ class NotaryServiceFlow(FlowLogic):
         # (it would leave the hidden inputs spendable again).
         ftx.check_all_inputs_revealed()
         return ftx.id, list(ftx.inputs), ftx.time_window
+
+    def _verify_notary_change(self, stx):
+        """Notary-change txs skip contract verification but the notary
+        resolves the back-chain and checks every participant signed
+        (reference: notary change handled as a first-class tx kind)."""
+        wtx = stx.tx
+        # This service must BE the old notary, or a rogue client could have
+        # a different notary commit inputs it does not govern (ledger fork).
+        me = self.service_hub.my_info
+        if wtx.notary.owning_key.encoded != me.owning_key.encoded:
+            raise NotaryException(
+                f"notary change names {wtx.notary.name}, not this notary"
+            )
+        yield from self.sub_flow(
+            ResolveTransactionsFlow(
+                [ref.txhash for ref in wtx.inputs], self.counterparty
+            )
+        )
+        try:
+            _check_notary_change_inputs(stx, self.service_hub)
+            stx.check_signatures_are_valid()
+            signed = {s.by for s in stx.sigs}
+            notary_key = wtx.notary.owning_key
+            missing = {
+                k
+                for k in wtx.resolved_required_keys(self.service_hub.load_state)
+                if not k.is_fulfilled_by(signed)
+                and k.encoded != notary_key.encoded
+            }
+            if missing:
+                raise NotaryException(
+                    f"notary change missing signatures: {missing}"
+                )
+        except NotaryException:
+            raise
+        except Exception as exc:
+            raise NotaryException(f"notary change invalid: {exc}")
+        return stx.id, list(wtx.inputs), None
+
+
+def _check_notary_change_inputs(stx, services) -> None:
+    """Every input of a notary-change tx must currently be governed by the
+    tx's old notary — the analogue of the regular path's notary-consistency
+    check (core/transactions/ledger.py); without it, inputs committed under
+    notary A could be consumed through notary B, forking the ledger."""
+    wtx = stx.tx
+    for ref in wtx.inputs:
+        ts = services.load_state(ref)
+        if ts.notary.owning_key.encoded != wtx.notary.owning_key.encoded:
+            raise NotaryException(
+                f"input {ref} is governed by {ts.notary.name}, "
+                f"not the transaction's old notary {wtx.notary.name}"
+            )
 
 
 # Imported lazily to avoid a cycle at module load; these flows live with

@@ -162,9 +162,13 @@ class VaultService:
     notifyAll :194, soft locks :321-349). Query DSL lives in
     corda_tpu.node.vault_query (widened in a later slice)."""
 
-    def __init__(self, db: NodeDatabase, is_relevant: Callable):
+    def __init__(self, db: NodeDatabase, is_relevant: Callable,
+                 resolve_state: Optional[Callable] = None):
         self.db = db
         self._is_relevant = is_relevant
+        # StateRef -> TransactionState; needed to derive notary-change
+        # outputs (wired to ServiceHub.load_state).
+        self._resolve_state = resolve_state
         db.execute(
             "CREATE TABLE IF NOT EXISTS vault_states ("
             " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
@@ -180,6 +184,10 @@ class VaultService:
     def notify_all(self, txs) -> None:
         """Ingest committed transactions: consume inputs, add relevant
         outputs (reference notifyAll)."""
+        from ..core.transactions.notary_change import (
+            NotaryChangeWireTransaction,
+        )
+
         produced, consumed = [], []
         with self.db.lock:
             for stx in txs:
@@ -191,7 +199,11 @@ class VaultService:
                         (ref.txhash.bytes, ref.index),
                     )
                     consumed.append(ref)
-                for idx, ts in enumerate(wtx.outputs):
+                if isinstance(wtx, NotaryChangeWireTransaction):
+                    outputs = wtx.resolve_outputs(self._resolve_state)
+                else:
+                    outputs = wtx.outputs
+                for idx, ts in enumerate(outputs):
                     if not self._is_relevant(ts.data):
                         continue
                     ref = StateRef(wtx.id, idx)
@@ -329,7 +341,7 @@ class ServiceHub:
         self.attachments = AttachmentStorage(db)
         self.network_map_cache = NetworkMapCache()
         self.transaction_verifier_service = transaction_verifier_service
-        self.vault_service = VaultService(db, self._is_relevant)
+        self.vault_service = VaultService(db, self._is_relevant, self.load_state)
         self.clock = clock or _time.time
         self.identity_service.register_identity(my_info)
         self._smm = None  # wired by the node after SMM construction
@@ -337,10 +349,28 @@ class ServiceHub:
     # -- resolution callbacks used by SignedTransaction.verify --------------
 
     def load_state(self, ref: StateRef) -> TransactionState:
+        from ..core.transactions.notary_change import (
+            NotaryChangeWireTransaction,
+        )
+
         stx = self.validated_transactions.get(ref.txhash)
         if stx is None:
             raise TransactionResolutionError(ref.txhash)
         wtx = stx.tx
+        if isinstance(wtx, NotaryChangeWireTransaction):
+            # Outputs are derived: input state with the notary swapped
+            # (reference NotaryChangeLedgerTransaction). Resolve just the
+            # requested index — resolving all would be quadratic over a
+            # back-chain.
+            if ref.index >= len(wtx.inputs):
+                raise TransactionResolutionError(ref.txhash)
+            inner = self.load_state(wtx.inputs[ref.index])
+            from ..core.contracts.structures import TransactionState as _TS
+
+            return _TS(
+                data=inner.data, notary=wtx.new_notary,
+                encumbrance=inner.encumbrance,
+            )
         if ref.index >= len(wtx.outputs):
             raise TransactionResolutionError(ref.txhash)
         return wtx.outputs[ref.index]

@@ -120,8 +120,13 @@ class CordaRPCClient:
         return reply.get("ok")
 
     def _consume(self) -> None:
+        from ..messaging import QueueClosedError
+
         while not self._stop.is_set():
-            msg = self._consumer.receive(timeout=0.2)
+            try:
+                msg = self._consumer.receive(timeout=0.2)
+            except QueueClosedError:
+                return  # broker/transport gone; client is shutting down
             if msg is None:
                 continue
             try:

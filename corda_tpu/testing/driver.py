@@ -118,12 +118,28 @@ class ProcessHandle:
 
 
 class NodeHandle(ProcessHandle):
-    def __init__(self, proc, log_path, name, base_dir, host, cordapps=()):
+    def __init__(self, proc, log_path, name, base_dir, host, cordapps=(),
+                 conf: Optional[Dict] = None):
         super().__init__(proc, log_path, name)
         self.base_dir = base_dir
         self.host = host
         self.cordapps = tuple(cordapps)
+        self.conf = dict(conf or {})
         self.broker_port: Optional[int] = None
+
+    def _client_wrap(self):
+        """TLS wrap for clients of this node's broker: a driver-side dev
+        identity chained to the node's trust root (shared certificates
+        directory)."""
+        if not self.conf.get("tls"):
+            return None
+        from ..core.crypto import pki
+
+        cert_dir = self.conf.get("certificates_dir")
+        if not os.path.isabs(cert_dir or ""):
+            cert_dir = os.path.join(self.base_dir, cert_dir or "certificates")
+        entries = pki.dev_certificates(cert_dir, "O=Driver,L=Test,C=GB")
+        return pki.client_wrap(pki.client_ssl_context(cert_dir, entries))
 
     def rpc(self, timeout: float = 15.0):
         """CordaRPCClient over the node's TCP broker.
@@ -138,11 +154,14 @@ class NodeHandle(ProcessHandle):
         for mod in self.cordapps:
             importlib.import_module(mod)
         return CordaRPCClient(
-            RemoteBroker(self.host, self.broker_port), timeout=timeout
+            RemoteBroker(self.host, self.broker_port,
+                         client_wrap=self._client_wrap()),
+            timeout=timeout,
         )
 
     def remote_broker(self) -> RemoteBroker:
-        return RemoteBroker(self.host, self.broker_port)
+        return RemoteBroker(self.host, self.broker_port,
+                            client_wrap=self._client_wrap())
 
 
 class Driver:
@@ -231,7 +250,8 @@ class Driver:
 
         h = NodeHandle(proc, log_path, name, node_dir,
                        conf.get("broker_host", "127.0.0.1"),
-                       cordapps=conf.get("cordapps", _NODE_DEFAULTS["cordapps"]))
+                       cordapps=conf.get("cordapps", _NODE_DEFAULTS["cordapps"]),
+                       conf=conf)
         self._procs.append(h)
         _wait_for(
             lambda: "node ready" in h.log() or not h.alive(),

@@ -1,0 +1,200 @@
+"""PKI hierarchy + network-map protocol unit tests.
+
+Reference parity targets: `X509Utilities.kt:28-235` (3-level chain, CSR),
+`NetworkMapService.kt:65-71` (signed registrations, serial ordering,
+subscription push), `ArtemisMessagingServer.kt:299-412` (bridge
+store-and-forward retry).
+"""
+import time
+
+import pytest
+
+from corda_tpu.core.crypto import crypto, pki
+from corda_tpu.core.identity import Party
+from corda_tpu.messaging import Broker
+from corda_tpu.node.networkmap import (
+    ADD,
+    BridgeManager,
+    NetworkMapClient,
+    NetworkMapService,
+    NodeRegistration,
+    SignedRegistration,
+    sign_registration,
+)
+
+ALICE_KP = crypto.entropy_to_keypair(301)
+BOB_KP = crypto.entropy_to_keypair(302)
+ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)
+BOB = Party("O=Bob,L=Paris,C=FR", BOB_KP.public)
+
+
+def _reg(party, addr="127.0.0.1:1", serial=1, expires=None):
+    return NodeRegistration(
+        party, addr, (), serial,
+        time.time() + 600 if expires is None else expires,
+    )
+
+
+class TestPKI:
+    def test_three_level_chain_and_validation(self, tmp_path):
+        entries = pki.dev_certificates(str(tmp_path), "O=Node,L=X,C=GB")
+        assert pki.verify_chain(
+            entries[pki.CORDA_TLS].cert,
+            [entries[pki.CORDA_CLIENT_CA].cert,
+             entries[pki.CORDA_INTERMEDIATE_CA].cert],
+            entries[pki.CORDA_ROOT_CA].cert,
+        )
+
+    def test_wrong_root_rejected(self, tmp_path):
+        entries = pki.dev_certificates(str(tmp_path / "a"), "O=Node,L=X,C=GB")
+        other = pki.create_self_signed_ca("Other Root")
+        assert not pki.verify_chain(
+            entries[pki.CORDA_TLS].cert,
+            [entries[pki.CORDA_CLIENT_CA].cert,
+             entries[pki.CORDA_INTERMEDIATE_CA].cert],
+            other.cert,
+        )
+
+    def test_shared_dir_shares_root_but_not_leaves(self, tmp_path):
+        e1 = pki.dev_certificates(str(tmp_path), "O=A,L=X,C=GB")
+        e2 = pki.dev_certificates(str(tmp_path), "O=B,L=Y,C=FR")
+        assert e1[pki.CORDA_ROOT_CA].cert == e2[pki.CORDA_ROOT_CA].cert
+        assert e1[pki.CORDA_TLS].cert != e2[pki.CORDA_TLS].cert
+
+    def test_csr_flow(self, tmp_path):
+        entries = pki.dev_certificates(str(tmp_path), "O=CA,L=X,C=GB")
+        csr, _key = pki.create_csr("O=Applicant,L=Z,C=DE")
+        cert = pki.sign_csr(entries[pki.CORDA_INTERMEDIATE_CA], csr, is_ca=True)
+        assert pki.verify_chain(
+            cert,
+            [entries[pki.CORDA_INTERMEDIATE_CA].cert],
+            entries[pki.CORDA_ROOT_CA].cert,
+        )
+
+
+class TestNetworkMapService:
+    def setup_method(self):
+        self.broker = Broker()
+        self.svc = NetworkMapService(self.broker).start()
+
+    def teardown_method(self):
+        self.svc.stop()
+        self.broker.close()
+
+    def _register(self, signed):
+        ok, reason = self.svc._process_registration(signed)
+        return ok, reason
+
+    def test_valid_registration_accepted(self):
+        ok, _ = self._register(sign_registration(_reg(ALICE), ALICE_KP.private))
+        assert ok
+        assert len(self.svc.entries()) == 1
+
+    def test_forged_signature_rejected(self):
+        # Bob signs a registration claiming to be Alice.
+        forged = sign_registration(_reg(ALICE), BOB_KP.private)
+        ok, reason = self._register(forged)
+        assert not ok and reason == "bad signature"
+
+    def test_stale_serial_rejected(self):
+        assert self._register(
+            sign_registration(_reg(ALICE, serial=5), ALICE_KP.private)
+        )[0]
+        ok, reason = self._register(
+            sign_registration(_reg(ALICE, addr="127.0.0.1:9", serial=4),
+                              ALICE_KP.private)
+        )
+        assert not ok and reason == "stale serial"
+
+    def test_expired_rejected(self):
+        ok, reason = self._register(
+            sign_registration(_reg(ALICE, expires=time.time() - 5),
+                              ALICE_KP.private)
+        )
+        assert not ok and reason == "expired"
+
+    def test_client_register_fetch_and_push(self):
+        learned = []
+        alice_client = NetworkMapClient(
+            self.broker, ALICE, "127.0.0.1:1", (), ALICE_KP.private,
+            on_entry=lambda reg: learned.append(reg.party.name),
+        )
+        assert alice_client.register_and_fetch() == 0  # alone so far
+        bob_learned = []
+        bob_client = NetworkMapClient(
+            self.broker, BOB, "127.0.0.1:2", ("corda.notary",), BOB_KP.private,
+            on_entry=lambda reg: bob_learned.append(reg.party.name),
+        )
+        assert bob_client.register_and_fetch() == 1  # sees alice
+        assert bob_learned == [ALICE.name]
+        # alice hears about bob via push
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not learned:
+            time.sleep(0.05)
+        assert learned == [BOB.name]
+        alice_client.stop()
+        bob_client.stop()
+
+
+class TestBridgeManager:
+    def test_store_and_forward_retry(self):
+        """Messages queue while the peer is down and deliver on recovery."""
+        from corda_tpu.messaging.net import BrokerServer, RemoteBroker
+
+        local = Broker()
+        bridges = BridgeManager(local)
+        peer_broker = Broker()
+        peer_broker.create_queue("p2p.inbound.O=Peer")
+        # route points at a port with nothing listening yet
+        import socket as _socket
+
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        bridges.set_route("O=Peer", f"127.0.0.1:{port}")
+        local.create_queue(bridges.outbound_queue("O=Peer"))
+        local.send(bridges.outbound_queue("O=Peer"), b"m1", {"topic": "t"})
+        time.sleep(0.8)  # forwarder is failing + retrying
+        server = BrokerServer(peer_broker, port=port).start()
+        try:
+            consumer = peer_broker.create_consumer("p2p.inbound.O=Peer")
+            msg = consumer.receive(timeout=10)
+            assert msg is not None and msg.payload == b"m1"
+            assert msg.headers["topic"] == "t"
+        finally:
+            bridges.stop()
+            server.stop()
+            local.close()
+            peer_broker.close()
+
+
+class TestChainConstraints:
+    def test_leaf_cannot_mint_certificates(self, tmp_path):
+        """A TLS LEAF key must not be able to issue certs that validate —
+        verify_chain enforces CA BasicConstraints on every issuer
+        (round-2 review finding)."""
+        entries = pki.dev_certificates(str(tmp_path), "O=Node,L=X,C=GB")
+        leaf = entries[pki.CORDA_TLS]
+        forged = pki._build_cert_from_public(
+            pki._name("O=Mallory,L=X,C=GB"),
+            pki._new_key().public_key(),
+            leaf,  # leaf acting as a CA
+            False,
+        )
+        assert not pki.verify_chain(
+            forged,
+            [leaf.cert, entries[pki.CORDA_CLIENT_CA].cert,
+             entries[pki.CORDA_INTERMEDIATE_CA].cert],
+            entries[pki.CORDA_ROOT_CA].cert,
+        )
+
+    def test_issuer_subject_mismatch_rejected(self, tmp_path):
+        e1 = pki.dev_certificates(str(tmp_path / "a"), "O=A,L=X,C=GB")
+        e2 = pki.dev_certificates(str(tmp_path / "b"), "O=B,L=X,C=GB")
+        # splice another tree's intermediate into the path
+        assert not pki.verify_chain(
+            e1[pki.CORDA_TLS].cert,
+            [e1[pki.CORDA_CLIENT_CA].cert, e2[pki.CORDA_INTERMEDIATE_CA].cert],
+            e2[pki.CORDA_ROOT_CA].cert,
+        )

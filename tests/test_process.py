@@ -180,3 +180,152 @@ class TestStandaloneNode:
             client.close()
             rc = node.terminate()
             assert rc == 0, node.log()
+
+
+@pytest.mark.slow
+class TestMultiNodeNetwork:
+    def test_discovery_and_cross_node_payment_tls(self, tmp_path):
+        """Three real node processes with mutual-TLS broker transports:
+        a directory node (network map + notary), Bank A and Bank B. The
+        banks discover each other THROUGH the map node (signed
+        registrations + push), then Bank A issues cash and pays Bank B —
+        flow sessions, notarisation and broadcast all cross process
+        boundaries over store-and-forward bridges.
+
+        Reference shape: NetworkMapService.kt:65-71 (protocol),
+        ArtemisMessagingServer.kt:299-412 (bridges + TLS),
+        Driver.kt multi-node integration tests."""
+        certs = str(tmp_path / "shared-certs")
+        with driver(str(tmp_path)) as d:
+            mapnode = d.start_node(
+                {
+                    "my_legal_name": "O=Notary Map,L=Zurich,C=CH",
+                    "network_map_service": True,
+                    "notary_type": "simple",
+                    "identity_entropy": 9001,
+                    "tls": True,
+                    "certificates_dir": certs,
+                },
+                name="mapnode",
+            )
+            map_addr = f"127.0.0.1:{mapnode.broker_port}"
+            common = {
+                "network_map": map_addr,
+                "tls": True,
+                "certificates_dir": certs,
+                "rpc_users": [{"username": "a", "password": "a"}],
+            }
+            bank_a = d.start_node(
+                {**common, "my_legal_name": "O=Bank A,L=London,C=GB",
+                 "identity_entropy": 9002},
+                name="bank-a",
+            )
+            bank_b = d.start_node(
+                {**common, "my_legal_name": "O=Bank B,L=Paris,C=FR",
+                 "identity_entropy": 9003},
+                name="bank-b",
+            )
+
+            import corda_tpu.finance.flows  # noqa: F401 — client-side types
+            from corda_tpu.core.contracts import Amount, Issued
+            from corda_tpu.core.identity import PartyAndReference
+
+            rpc_a = bank_a.rpc(timeout=60)
+            conn_a = rpc_a.start("a", "a")
+            rpc_b = bank_b.rpc(timeout=60)
+            conn_b = rpc_b.start("a", "a")
+
+            # Discovery: A sees B and the notary through the map.
+            me_a = conn_a.proxy.node_info()
+            notary = conn_a.proxy.party_from_name("O=Notary Map,L=Zurich,C=CH")
+            party_b = conn_a.proxy.party_from_name("O=Bank B,L=Paris,C=FR")
+            assert notary is not None, "notary not discovered via network map"
+            assert party_b is not None, "peer not discovered via network map"
+
+            # Issue to self, then pay B (sessions + notary across processes).
+            fid = conn_a.proxy.start_flow_dynamic(
+                "CashIssueFlow", Amount(100_00, "GBP"), b"issue-1", me_a, notary
+            )
+            conn_a.proxy.flow_result(fid, 120)
+            issued_token = Issued(PartyAndReference(me_a, b"issue-1"), "GBP")
+            fid = conn_a.proxy.start_flow_dynamic(
+                "CashPaymentFlow", Amount(30_00, issued_token), party_b, notary
+            )
+            conn_a.proxy.flow_result(fid, 120)
+
+            # B's vault sees the payment (broadcast crossed the bridge).
+            deadline = time.monotonic() + 60
+            states_b = []
+            while time.monotonic() < deadline:
+                states_b = conn_b.proxy.vault_query("corda_tpu.finance.Cash")
+                if states_b:
+                    break
+                time.sleep(0.5)
+            assert states_b, f"Bank B never saw the cash\n{bank_b.log()[-2000:]}"
+            rpc_a.close()
+            rpc_b.close()
+            assert bank_a.terminate() == 0
+            assert bank_b.terminate() == 0
+
+
+@pytest.mark.slow
+class TestBridgeRecovery:
+    def test_broadcast_survives_peer_restart(self, tmp_path):
+        """Kill Bank B, pay it anyway (notarisation completes without it),
+        restart B on the same port: the store-and-forward bridge delivers
+        the queued broadcast and B's vault shows the cash. Regression for
+        the startup race where the P2P pump consumed messages before flow
+        handlers were installed (messages were acked into a void)."""
+        from corda_tpu.core.contracts import Amount, Issued
+        from corda_tpu.core.identity import PartyAndReference
+        from corda_tpu.testing.driver import free_port
+
+        certs = str(tmp_path / "shared-certs")
+        with driver(str(tmp_path)) as d:
+            mapnode = d.start_node(
+                {"my_legal_name": "O=Map,L=Z,C=CH", "network_map_service": True,
+                 "notary_type": "simple", "identity_entropy": 21,
+                 "tls": True, "certificates_dir": certs},
+                name="map",
+            )
+            b_port = free_port()
+            common = {
+                "network_map": f"127.0.0.1:{mapnode.broker_port}",
+                "tls": True, "certificates_dir": certs,
+                "rpc_users": [{"username": "u", "password": "p"}],
+            }
+            bank_a = d.start_node(
+                {**common, "my_legal_name": "O=A,L=L,C=GB",
+                 "identity_entropy": 22}, name="a")
+            bank_b = d.start_node(
+                {**common, "my_legal_name": "O=B,L=P,C=FR",
+                 "identity_entropy": 23, "broker_port": b_port}, name="b")
+
+            import corda_tpu.finance.flows  # noqa: F401
+
+            conn = bank_a.rpc(timeout=60).start("u", "p")
+            me = conn.proxy.node_info()
+            notary = conn.proxy.party_from_name("O=Map,L=Z,C=CH")
+            party_b = conn.proxy.party_from_name("O=B,L=P,C=FR")
+            fid = conn.proxy.start_flow_dynamic(
+                "CashIssueFlow", Amount(9000, "GBP"), b"r1", me, notary)
+            conn.proxy.flow_result(fid, 120)
+
+            bank_b.kill()  # crash before the payment
+            token = Issued(PartyAndReference(me, b"r1"), "GBP")
+            fid = conn.proxy.start_flow_dynamic(
+                "CashPaymentFlow", Amount(4000, token), party_b, notary)
+            conn.proxy.flow_result(fid, 120)
+
+            b2 = d.start_node(
+                {**common, "my_legal_name": "O=B,L=P,C=FR",
+                 "identity_entropy": 23, "broker_port": b_port}, name="b")
+            conn_b = b2.rpc(timeout=60).start("u", "p")
+            deadline = time.monotonic() + 60
+            states = []
+            while time.monotonic() < deadline:
+                states = conn_b.proxy.vault_query("corda_tpu.finance.Cash")
+                if states:
+                    break
+                time.sleep(0.5)
+            assert states, f"B never recovered the broadcast\n{b2.log()[-1500:]}"

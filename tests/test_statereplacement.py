@@ -1,0 +1,259 @@
+"""State-replacement flow tests: notary change + contract upgrade.
+
+Reference parity: `core/src/test/kotlin/net/corda/core/flows/
+NotaryChangeTests.kt` and `ContractUpgradeFlowTest.kt` — happy path over
+MockNetwork, plus refusal cases (wrong notary, unauthorised upgrade).
+"""
+from dataclasses import dataclass
+from typing import List
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    Amount,
+    Contract,
+    ContractState,
+    StateAndRef,
+    TypeOnlyCommandData,
+    contract,
+)
+from corda_tpu.core.flows import (
+    ContractUpgradeFlow,
+    NotaryChangeFlow,
+    StateReplacementException,
+    UpgradeCommand,
+    UpgradedContract,
+)
+from corda_tpu.core.serialization.codec import corda_serializable
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.core.transactions.notary_change import (
+    NotaryChangeWireTransaction,
+)
+from corda_tpu.testing.mocknetwork import MockNetwork
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class DealStateV1(ContractState):
+    parties: tuple = ()
+    magic: int = 7
+    contract_name = "DealV1"
+
+    @property
+    def participants(self) -> List:
+        return list(self.parties)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class DealStateV2(ContractState):
+    parties: tuple = ()
+    magic: int = 7
+    version: int = 2
+    contract_name = "DealV2"
+
+    @property
+    def participants(self) -> List:
+        return list(self.parties)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class DealCommand(TypeOnlyCommandData):
+    pass
+
+
+@contract(name="DealV1")
+class DealV1(Contract):
+    def verify(self, tx) -> None:
+        # Accepts issuance and upgrade commands.
+        pass
+
+
+@contract(name="DealV2")
+class DealV2(Contract, UpgradedContract):
+    legacy_contract_name = "DealV1"
+
+    def upgrade(self, state):
+        return DealStateV2(parties=state.parties, magic=state.magic)
+
+    def verify(self, tx) -> None:
+        pass
+
+
+class _Base:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary_a = self.net.create_notary_node(
+            "O=Notary A,L=Zurich,C=CH", validating=True
+        )
+        self.notary_b = self.net.create_notary_node(
+            "O=Notary B,L=Geneva,C=CH", validating=True
+        )
+        self.alice = self.net.create_node("O=Alice,L=London,C=GB")
+        self.bob = self.net.create_node("O=Bob,L=Paris,C=FR")
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _issue_deal(self, parties, notary) -> StateAndRef:
+        """Issue a two-party DealStateV1 signed by both (so both hold it)."""
+        builder = TransactionBuilder(notary=notary.info)
+        state = DealStateV1(parties=tuple(p.info for p in parties))
+        builder.add_output_state(state)
+        builder.add_command(
+            DealCommand(), *[p.info.owning_key for p in parties]
+        )
+        stx = parties[0].services.sign_initial_transaction(builder)
+        for p in parties[1:]:
+            sig = p.services.key_management_service.sign(
+                stx.id.bytes, p.info.owning_key
+            )
+            stx = stx.with_additional_signature(sig)
+        for p in parties:
+            p.services.record_transactions([stx])
+        return stx.tx.out_ref(0)
+
+
+class TestNotaryChange(_Base):
+    def test_happy_path_two_participants(self):
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        assert original.state.notary == self.notary_a.info
+        h = self.alice.start_flow(
+            NotaryChangeFlow(original, self.notary_b.info)
+        )
+        self.net.run_network()
+        new_ref = h.result.result(timeout=5)
+        assert new_ref.state.notary == self.notary_b.info
+        assert new_ref.state.data == original.state.data
+        # Both nodes resolve the replacement state; the old one is consumed.
+        for node in (self.alice, self.bob):
+            ts = node.services.load_state(new_ref.ref)
+            assert ts.notary == self.notary_b.info
+        # The new state is usable: spend it with the NEW notary.
+        builder = TransactionBuilder(notary=self.notary_b.info)
+        builder.add_input_state(new_ref)
+        builder.add_output_state(
+            DealStateV1(parties=(self.alice.info,)), self.notary_b.info
+        )
+        builder.add_command(
+            DealCommand(), self.alice.info.owning_key, self.bob.info.owning_key
+        )
+        stx = self.alice.services.sign_initial_transaction(builder)
+        sig = self.bob.services.key_management_service.sign(
+            stx.id.bytes, self.bob.info.owning_key
+        )
+        stx = stx.with_additional_signature(sig)
+        from corda_tpu.core.flows import FinalityFlow
+
+        h2 = self.alice.start_flow(FinalityFlow(stx))
+        self.net.run_network()
+        h2.result.result(timeout=5)
+
+    def test_unknown_new_notary_refused(self):
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        # Bob refuses a change to a party that is not an advertised notary.
+        h = self.alice.start_flow(NotaryChangeFlow(original, self.bob.info))
+        self.net.run_network()
+        with pytest.raises(Exception, match="not a known notary|notaries must be different|FlowException"):
+            h.result.result(timeout=5)
+
+    def test_old_notary_consumed_inputs(self):
+        """After the change, the OLD notary must refuse a spend of the
+        original ref (double-spend protection across the migration)."""
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        h = self.alice.start_flow(
+            NotaryChangeFlow(original, self.notary_b.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=5)
+        builder = TransactionBuilder(notary=self.notary_a.info)
+        builder.add_input_state(original)
+        builder.add_output_state(
+            DealStateV1(parties=(self.alice.info,)), self.notary_a.info
+        )
+        builder.add_command(
+            DealCommand(), self.alice.info.owning_key, self.bob.info.owning_key
+        )
+        stx = self.alice.services.sign_initial_transaction(builder)
+        sig = self.bob.services.key_management_service.sign(
+            stx.id.bytes, self.bob.info.owning_key
+        )
+        stx = stx.with_additional_signature(sig)
+        from corda_tpu.core.flows import FinalityFlow
+
+        h2 = self.alice.start_flow(FinalityFlow(stx))
+        self.net.run_network()
+        with pytest.raises(Exception, match="[Cc]onflict|consumed"):
+            h2.result.result(timeout=5)
+
+    def test_transaction_type_invariants(self):
+        with pytest.raises(ValueError, match="must have inputs"):
+            NotaryChangeWireTransaction((), self.notary_a.info, self.notary_b.info)
+        original = self._issue_deal([self.alice], self.notary_a)
+        with pytest.raises(ValueError, match="must be different"):
+            NotaryChangeWireTransaction(
+                (original.ref,), self.notary_a.info, self.notary_a.info
+            )
+
+
+class TestContractUpgrade(_Base):
+    def test_happy_path(self):
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        h = self.alice.start_flow(ContractUpgradeFlow(original, "DealV2"))
+        self.net.run_network()
+        new_ref = h.result.result(timeout=5)
+        assert isinstance(new_ref.state.data, DealStateV2)
+        assert new_ref.state.data.magic == 7
+        # Both sides recorded the upgrade.
+        for node in (self.alice, self.bob):
+            ts = node.services.load_state(new_ref.ref)
+            assert ts.data.contract_name == "DealV2"
+
+    def test_unregistered_contract_refused(self):
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        h = self.alice.start_flow(ContractUpgradeFlow(original, "NoSuchContract"))
+        self.net.run_network()
+        with pytest.raises(Exception, match="not a registered UpgradedContract"):
+            h.result.result(timeout=5)
+
+    def test_upgrade_command_rules(self):
+        from corda_tpu.core.flows.statereplacement import verify_upgrade
+
+        state = DealStateV1(parties=(self.alice.info, self.bob.info))
+        upgraded = DealV2()
+        good = upgraded.upgrade(state)
+        verify_upgrade(
+            state, good, upgraded,
+            [self.alice.info.owning_key, self.bob.info.owning_key],
+        )
+        with pytest.raises(StateReplacementException, match="all participant keys"):
+            verify_upgrade(state, good, upgraded, [self.alice.info.owning_key])
+        with pytest.raises(StateReplacementException, match="upgraded version"):
+            verify_upgrade(
+                state, DealStateV2(parties=(), magic=99), upgraded,
+                [self.alice.info.owning_key, self.bob.info.owning_key],
+            )
+
+
+class TestNotaryChangeSecurity(_Base):
+    def test_wrong_old_notary_rejected(self):
+        """A notary-change tx naming notary B as the 'old' notary for
+        states actually governed by notary A must be rejected — otherwise
+        inputs committed under A could be consumed through B, forking the
+        ledger (round-2 review finding)."""
+        from corda_tpu.core.transactions.signed import SignedTransaction
+
+        original = self._issue_deal([self.alice], self.notary_a)
+        wtx = NotaryChangeWireTransaction(
+            (original.ref,), self.notary_b.info, self.notary_a.info
+        )
+        kms = self.alice.services.key_management_service
+        sig = kms.sign(wtx.id.bytes, self.alice.info.owning_key)
+        stx = SignedTransaction.of(wtx, (sig,))
+        from corda_tpu.node.notary import NotaryClientFlow
+
+        h = self.alice.start_flow(NotaryClientFlow(stx))
+        self.net.run_network()
+        with pytest.raises(Exception, match="not this notary|governed by"):
+            h.result.result(timeout=5)
