@@ -288,6 +288,15 @@ def dev_certificates(directory: str, legal_name: str) -> dict:
     root_cert_path = os.path.join(directory, f"{CORDA_ROOT_CA}.cert.pem")
     claimed = False
     if not os.path.exists(root_cert_path):
+        # A claim with no root after 60s is a crashed claimant: break it.
+        try:
+            if (
+                os.path.exists(lock_path)
+                and time.time() - os.path.getmtime(lock_path) > 60
+            ):
+                os.unlink(lock_path)
+        except OSError:
+            pass
         try:
             fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             os.close(fd)
@@ -295,12 +304,18 @@ def dev_certificates(directory: str, legal_name: str) -> dict:
         except FileExistsError:
             pass
     if claimed:
-        root = create_self_signed_ca()
-        inter = create_intermediate_ca(root)
-        write_cert_store(
-            directory,
-            **{CORDA_ROOT_CA: root, CORDA_INTERMEDIATE_CA: inter},
-        )
+        try:
+            root = create_self_signed_ca()
+            inter = create_intermediate_ca(root)
+            write_cert_store(
+                directory,
+                **{CORDA_ROOT_CA: root, CORDA_INTERMEDIATE_CA: inter},
+            )
+        finally:
+            try:
+                os.unlink(lock_path)
+            except OSError:
+                pass
     else:
         deadline = time.time() + 15
         while not (

@@ -234,7 +234,19 @@ class FlowLogic:
 
     @classmethod
     def flow_name(cls) -> str:
-        return f"{cls.__module__}.{cls.__qualname__}"
+        mod = cls.__module__
+        if mod == "__main__":
+            # `python -m pkg.mod` imports the module as __main__; normalise
+            # to the canonical name so registry lookups (scheduler
+            # activities, RPC flow starts) resolve either way.
+            import sys as _sys
+
+            spec = getattr(_sys.modules.get("__main__"), "__spec__", None)
+            if spec is not None and spec.name:
+                mod = spec.name
+                if mod.endswith(".__main__"):
+                    mod = mod[: -len(".__main__")]
+        return f"{mod}.{cls.__qualname__}"
 
     def session_owner_name(self) -> str:
         return f"{self.flow_name()}#{self._ordinal}"

@@ -223,24 +223,12 @@ def verify_dependency(stx: SignedTransaction, services) -> None:
 
     wtx = stx.tx
     if isinstance(wtx, NotaryChangeWireTransaction):
-        for ref in wtx.inputs:
-            ts = services.load_state(ref)
-            if ts.notary.owning_key.encoded != wtx.notary.owning_key.encoded:
-                raise FlowException(
-                    f"notary-change input {ref} is governed by "
-                    f"{ts.notary.name}, not {wtx.notary.name}"
-                )
         stx.check_signatures_are_valid()
-        signed = {s.by for s in stx.sigs}
-        missing = {
-            k
-            for k in wtx.resolved_required_keys(services.load_state)
-            if not k.is_fulfilled_by(signed)
-        }
-        if missing:
-            raise FlowException(
-                f"notary-change dependency missing signatures: {missing}"
-            )
+        try:
+            # A committed dependency carries the old notary's signature too.
+            wtx.check_inputs_and_signatures(stx.sigs, services.load_state)
+        except ValueError as exc:
+            raise FlowException(str(exc))
         return
     stx.verify(services)
 

@@ -167,6 +167,7 @@ class AbstractStateReplacementAcceptor(FlowLogic):
                         f"not {wtx.notary.name}"
                     )
             required = wtx.resolved_required_keys(hub.load_state)
+            # (pre-signing view: our signature is what's being requested)
         else:
             required = wtx.required_signing_keys
         my_keys = hub.key_management_service.keys
@@ -181,17 +182,11 @@ class AbstractStateReplacementAcceptor(FlowLogic):
         )
         final = stx.with_additional_signatures(payload.signatures)
         if isinstance(wtx, NotaryChangeWireTransaction):
-            # Signature sufficiency needs resolution for this tx kind.
             final.check_signatures_are_valid()
-            signed = {s.by for s in final.sigs}
-            missing = {
-                k for k in wtx.resolved_required_keys(hub.load_state)
-                if not k.is_fulfilled_by(signed)
-            }
-            if missing:
-                raise StateReplacementException(
-                    f"final transaction is missing signatures: {missing}"
-                )
+            try:
+                wtx.check_inputs_and_signatures(final.sigs, hub.load_state)
+            except ValueError as exc:
+                raise StateReplacementException(str(exc))
         else:
             final.verify_required_signatures()
         _record_replacement(hub, final)

@@ -63,21 +63,80 @@ class NotaryChangeWireTransaction:
     def attachments(self):
         return ()
 
+    def _remap_encumbrance(self, ref: StateRef, encumbrance) -> "int | None":
+        """An input's encumbrance index points into its ORIGINAL transaction;
+        the migrated output's encumbrance must point at the corresponding
+        position in THIS transaction's derived outputs (reference
+        NotaryChangeLedgerTransaction remaps via inputs.indexOf)."""
+        if encumbrance is None:
+            return None
+        target = StateRef(ref.txhash, encumbrance)
+        try:
+            return self.inputs.index(target)
+        except ValueError:
+            return None  # encumbrance not migrated alongside: link severed
+
     def resolve_outputs(
         self, load_state: Callable[[StateRef], TransactionState]
     ) -> List[TransactionState]:
-        """Output i = input i with the notary swapped (reference
-        NotaryChangeLedgerTransaction.outputs computation)."""
+        """Output i = input i with the notary swapped and the encumbrance
+        index remapped to this transaction's output positions."""
         outs = []
         for ref in self.inputs:
             ts = load_state(ref)
             outs.append(
                 TransactionState(
                     data=ts.data, notary=self.new_notary,
-                    encumbrance=ts.encumbrance,
+                    encumbrance=self._remap_encumbrance(ref, ts.encumbrance),
                 )
             )
         return outs
+
+    def resolve_output(
+        self, index: int, load_state: Callable[[StateRef], TransactionState]
+    ) -> TransactionState:
+        """Single derived output (back-chain resolution touches one index;
+        resolving all would be quadratic over a chain)."""
+        ref = self.inputs[index]
+        ts = load_state(ref)
+        return TransactionState(
+            data=ts.data, notary=self.new_notary,
+            encumbrance=self._remap_encumbrance(ref, ts.encumbrance),
+        )
+
+    def check_inputs_and_signatures(
+        self,
+        sigs,
+        load_state: Callable[[StateRef], TransactionState],
+        exclude_notary: bool = False,
+    ) -> None:
+        """The one notary-change validity check used by every verifier
+        (instigator, acceptor, notary, dependency resolver):
+          * every input must currently be governed by this tx's OLD notary
+            (otherwise inputs committed under notary A could be consumed
+            through notary B, forking the ledger);
+          * the signature set must cover every input participant (and the
+            old notary, unless exclude_notary — the pre-notarisation view).
+        Raises ValueError; callers wrap in their domain exception."""
+        for ref in self.inputs:
+            ts = load_state(ref)
+            if ts.notary.owning_key.encoded != self.notary.owning_key.encoded:
+                raise ValueError(
+                    f"input {ref} is governed by {ts.notary.name}, "
+                    f"not the transaction's old notary {self.notary.name}"
+                )
+        signed = {sig.by for sig in sigs}
+        notary_encoded = self.notary.owning_key.encoded
+        missing = {
+            k
+            for k in self.resolved_required_keys(load_state)
+            if not k.is_fulfilled_by(signed)
+            and not (exclude_notary and k.encoded == notary_encoded)
+        }
+        if missing:
+            raise ValueError(
+                f"notary change is missing signatures for: {missing}"
+            )
 
     def resolved_required_keys(
         self, load_state: Callable[[StateRef], TransactionState]

@@ -44,14 +44,30 @@ class SelfIssueLoadTest(LoadTest):
         )
 
     def gather(self, nodes: Nodes) -> Dict[str, int]:
+        # Paged criteria queries instead of a full scan: under the firehose
+        # a vault can hold far more states than fit one result set
+        # (reference: loadtest consistency via paged vaultQueryBy).
+        from ..node.vault_query import PageSpecification, VaultQueryCriteria
+
         out = {}
+        criteria = VaultQueryCriteria(
+            contract_names=(CashState.contract_name,)
+        )
         for node in nodes.nodes:
-            out[node.info.name] = sum(
-                sr.state.data.amount.quantity
-                for sr in node.services.vault_service.unconsumed_states(
-                    CashState.contract_name
+            total = 0
+            page_number = 1
+            while True:
+                page = node.services.vault_service.query(
+                    criteria,
+                    PageSpecification(page_number=page_number, page_size=500),
                 )
-            )
+                total += sum(
+                    sr.state.data.amount.quantity for sr in page.states
+                )
+                if page_number * page.page_size >= page.total_states_available:
+                    break
+                page_number += 1
+            out[node.info.name] = total
         return out
 
 

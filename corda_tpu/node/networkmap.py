@@ -242,6 +242,7 @@ class NetworkMapClient:
         self._on_entry = on_entry
         self._on_remove = on_remove
         self._serial = int(time.time() * 1000)
+        self._ttl = 24 * 3600.0  # registration lifetime (refreshed at TTL/2)
         self._reply_queue = f"netmap.reply.{me.name}"
         self._push_queue = f"netmap.push.{me.name}"
         map_broker.create_queue(self._reply_queue)
@@ -256,20 +257,20 @@ class NetworkMapClient:
 
     # -- startup handshake ---------------------------------------------------
 
-    def register_and_fetch(self, timeout: float = 15.0) -> int:
+    def register_and_fetch(self, timeout: float = 15.0,
+                           ttl: Optional[float] = None) -> int:
         """REGISTER self + SUBSCRIBE + FETCH; apply entries; returns the
-        number of peers learned. Raises on registration rejection."""
-        reg = NodeRegistration(
-            self._me, self._my_address, self._advertised,
-            serial=self._serial, expires_at=time.time() + 3600 * 24,
+        number of peers learned. Raises on registration rejection. A
+        background thread re-registers at TTL/2 so a long-running node
+        never silently expires out of the directory."""
+        if ttl is not None:
+            self._ttl = ttl
+        self._register(timeout)
+        self._refresh_thread = threading.Thread(
+            target=self._refresh_loop, name=f"netmap-refresh-{self._me.name}",
+            daemon=True,
         )
-        self._request(
-            {"kind": "register", "registration": sign_registration(reg, self._key),
-             "reply_to": self._reply_queue},
-        )
-        ack = self._await_reply("register-ack", timeout)
-        if not ack.get("ok"):
-            raise RuntimeError(f"network map rejected registration: {ack.get('error')}")
+        self._refresh_thread.start()
         self._request({"kind": "subscribe", "queue": self._push_queue,
                        "reply_to": self._reply_queue})
         self._await_reply("subscribe-ack", timeout)
@@ -281,6 +282,30 @@ class NetworkMapClient:
                 count += 1
         self._push_thread.start()
         return count
+
+    def _register(self, timeout: float) -> None:
+        self._serial += 1
+        reg = NodeRegistration(
+            self._me, self._my_address, self._advertised,
+            serial=self._serial, expires_at=time.time() + self._ttl,
+        )
+        self._request(
+            {"kind": "register",
+             "registration": sign_registration(reg, self._key),
+             "reply_to": self._reply_queue},
+        )
+        ack = self._await_reply("register-ack", timeout)
+        if not ack.get("ok"):
+            raise RuntimeError(
+                f"network map rejected registration: {ack.get('error')}"
+            )
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self._ttl / 2):
+            try:
+                self._register(timeout=15.0)
+            except Exception:
+                pass  # map temporarily unreachable; retry next period
 
     def _request(self, payload: dict) -> None:
         self._broker.send(NETWORK_MAP_QUEUE, serialize(payload))

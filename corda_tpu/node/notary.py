@@ -300,24 +300,15 @@ class NotaryClientFlow(FlowLogic):
             raise FlowException("transaction has no notary set")
         is_notary_change = isinstance(stx.tx, NotaryChangeWireTransaction)
         if is_notary_change:
-            # Required signers need input resolution; the instigator holds
-            # the states. Cryptographic validity + participant coverage
-            # minus the notary (reference: NotaryChangeLedgerTransaction
-            # signature semantics).
+            # The instigator holds the input states; full pre-notarisation
+            # check (signers resolved from input participants).
             stx.check_signatures_are_valid()
-            signed = {s.by for s in stx.sigs}
-            missing = {
-                k
-                for k in stx.tx.resolved_required_keys(
-                    self.service_hub.load_state
+            try:
+                stx.tx.check_inputs_and_signatures(
+                    stx.sigs, self.service_hub.load_state, exclude_notary=True
                 )
-                if not k.is_fulfilled_by(signed)
-                and k.encoded != notary.owning_key.encoded
-            }
-            if missing:
-                raise FlowException(
-                    f"notary change is missing signatures: {missing}"
-                )
+            except ValueError as exc:
+                raise FlowException(str(exc))
         elif stx.inputs:
             # All non-notary signatures must already be present and valid.
             stx.verify_signatures_except(notary.owning_key)
@@ -383,7 +374,7 @@ class NotaryServiceFlow(FlowLogic):
 
         stx = payload.signed_transaction
         if stx is not None and isinstance(stx.tx, NotaryChangeWireTransaction):
-            return (yield from self._verify_notary_change(stx))
+            return (yield from self._verify_notary_change(stx, service))
         if service.validating:
             stx = payload.signed_transaction
             if stx is None:
@@ -420,10 +411,14 @@ class NotaryServiceFlow(FlowLogic):
         ftx.check_all_inputs_revealed()
         return ftx.id, list(ftx.inputs), ftx.time_window
 
-    def _verify_notary_change(self, stx):
-        """Notary-change txs skip contract verification but the notary
-        resolves the back-chain and checks every participant signed
-        (reference: notary change handled as a first-class tx kind)."""
+    def _verify_notary_change(self, stx, service):
+        """Notary-change txs skip contract verification. A VALIDATING
+        notary resolves the back-chain and checks every participant
+        signed; a NON-validating notary must NOT pull the chain — that
+        would expose full historic transaction contents, the exact leak
+        the tear-off model exists to prevent — so it checks only
+        cryptographic signature validity and commits.
+        """
         wtx = stx.tx
         # This service must BE the old notary, or a rogue client could have
         # a different notary commit inputs it does not govern (ledger fork).
@@ -432,46 +427,27 @@ class NotaryServiceFlow(FlowLogic):
             raise NotaryException(
                 f"notary change names {wtx.notary.name}, not this notary"
             )
+        if not service.validating:
+            try:
+                stx.check_signatures_are_valid()
+            except Exception as exc:
+                raise NotaryException(f"notary change invalid: {exc}")
+            return stx.id, list(wtx.inputs), None
         yield from self.sub_flow(
             ResolveTransactionsFlow(
                 [ref.txhash for ref in wtx.inputs], self.counterparty
             )
         )
         try:
-            _check_notary_change_inputs(stx, self.service_hub)
             stx.check_signatures_are_valid()
-            signed = {s.by for s in stx.sigs}
-            notary_key = wtx.notary.owning_key
-            missing = {
-                k
-                for k in wtx.resolved_required_keys(self.service_hub.load_state)
-                if not k.is_fulfilled_by(signed)
-                and k.encoded != notary_key.encoded
-            }
-            if missing:
-                raise NotaryException(
-                    f"notary change missing signatures: {missing}"
-                )
+            wtx.check_inputs_and_signatures(
+                stx.sigs, self.service_hub.load_state, exclude_notary=True
+            )
         except NotaryException:
             raise
         except Exception as exc:
             raise NotaryException(f"notary change invalid: {exc}")
         return stx.id, list(wtx.inputs), None
-
-
-def _check_notary_change_inputs(stx, services) -> None:
-    """Every input of a notary-change tx must currently be governed by the
-    tx's old notary — the analogue of the regular path's notary-consistency
-    check (core/transactions/ledger.py); without it, inputs committed under
-    notary A could be consumed through notary B, forking the ledger."""
-    wtx = stx.tx
-    for ref in wtx.inputs:
-        ts = services.load_state(ref)
-        if ts.notary.owning_key.encoded != wtx.notary.owning_key.encoded:
-            raise NotaryException(
-                f"input {ref} is governed by {ts.notary.name}, "
-                f"not the transaction's old notary {wtx.notary.name}"
-            )
 
 
 # Imported lazily to avoid a cycle at module load; these flows live with

@@ -77,6 +77,13 @@ class SchedulerService:
             for _, entry in due:
                 cls = flow_registry.get(entry["flow_name"])
                 if cls is None:
+                    import sys as _sys
+
+                    print(
+                        f"scheduler: no flow registered as "
+                        f"{entry['flow_name']!r}; dropping activity",
+                        file=_sys.stderr,
+                    )
                     continue
                 args = tuple(entry["flow_args"])
                 flow = cls(*args)

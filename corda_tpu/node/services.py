@@ -7,6 +7,7 @@ them together and is what flows reach via `self.service_hub`.
 from __future__ import annotations
 
 import threading
+import time as _time_mod
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..core.contracts.structures import (
@@ -20,6 +21,7 @@ from ..core.crypto.keys import KeyPair, PublicKey
 from ..core.crypto.secure_hash import SecureHash
 from ..core.identity import AnonymousParty, Party
 from ..core.serialization.codec import deserialize, serialize
+from . import vault_query as _vault_query  # noqa: F401 — registers codec adapters
 from .database import (
     AttachmentStorage,
     CheckpointStorage,
@@ -175,7 +177,27 @@ class VaultService:
             " state_blob BLOB NOT NULL, contract_name TEXT NOT NULL,"
             " consumed INTEGER NOT NULL DEFAULT 0,"
             " lock_id TEXT,"
+            " recorded_at REAL NOT NULL DEFAULT 0,"
+            " notary_name TEXT NOT NULL DEFAULT '',"
             " PRIMARY KEY (tx_id, output_index))"
+        )
+        for alter in (
+            "ALTER TABLE vault_states ADD COLUMN recorded_at REAL NOT NULL DEFAULT 0",
+            "ALTER TABLE vault_states ADD COLUMN notary_name TEXT NOT NULL DEFAULT ''",
+        ):
+            try:
+                db.execute(alter)  # older vaults predate these columns
+            except Exception:
+                pass
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_participants ("
+            " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
+            " key_hex TEXT NOT NULL,"
+            " PRIMARY KEY (tx_id, output_index, key_hex))"
+        )
+        db.execute(
+            "CREATE INDEX IF NOT EXISTS vault_participants_key"
+            " ON vault_participants(key_hex)"
         )
         self._observers: List[Callable] = []
 
@@ -209,13 +231,23 @@ class VaultService:
                     ref = StateRef(wtx.id, idx)
                     self.db.execute(
                         "INSERT OR IGNORE INTO vault_states"
-                        "(tx_id, output_index, state_blob, contract_name)"
-                        " VALUES(?, ?, ?, ?)",
+                        "(tx_id, output_index, state_blob, contract_name,"
+                        " recorded_at, notary_name)"
+                        " VALUES(?, ?, ?, ?, ?, ?)",
                         (
                             ref.txhash.bytes, ref.index, serialize(ts),
-                            ts.data.contract_name,
+                            ts.data.contract_name, _time_mod.time(),
+                            ts.notary.name if ts.notary else "",
                         ),
                     )
+                    for p in ts.data.participants:
+                        key = getattr(p, "owning_key", None)
+                        if key is not None:
+                            self.db.execute(
+                                "INSERT OR IGNORE INTO vault_participants"
+                                "(tx_id, output_index, key_hex) VALUES(?,?,?)",
+                                (ref.txhash.bytes, ref.index, key.encoded.hex()),
+                            )
                     produced.append(StateAndRef(ts, ref))
         if produced or consumed:
             for obs in list(self._observers):
@@ -245,6 +277,58 @@ class VaultService:
                 continue
             out.append(StateAndRef(ts, StateRef(SecureHash(tx_id), idx)))
         return out
+
+    def query(self, criteria=None, paging=None, sort=None):
+        """Criteria/paging/sorting query -> Page (reference
+        HibernateVaultQueryImpl.queryBy; surface CordaRPCOps.kt:151-259).
+        The criteria tree compiles to one SQL WHERE clause."""
+        from .vault_query import (
+            Page,
+            PageSpecification,
+            Sort,
+            VaultQueryCriteria,
+        )
+
+        criteria = criteria if criteria is not None else VaultQueryCriteria()
+        paging = paging if paging is not None else PageSpecification()
+        sort = sort if sort is not None else Sort()
+        where, params = criteria.compile()
+        order = sort.sql()
+        offset = (paging.page_number - 1) * paging.page_size
+        with self.db.lock:
+            (total,) = next(
+                iter(
+                    self.db.query(
+                        f"SELECT COUNT(*) FROM vault_states WHERE {where}",
+                        tuple(params),
+                    )
+                )
+            )
+            rows = list(
+                self.db.query(
+                    "SELECT tx_id, output_index, state_blob FROM vault_states"
+                    f" WHERE {where} ORDER BY {order} LIMIT ? OFFSET ?",
+                    tuple(params) + (paging.page_size, offset),
+                )
+            )
+        states = tuple(
+            StateAndRef(deserialize(blob), StateRef(SecureHash(tx_id), idx))
+            for tx_id, idx, blob in rows
+        )
+        return Page(states, total, paging.page_number, paging.page_size)
+
+    def track_by(self, criteria=None, paging=None, sort=None):
+        """(snapshot Page, updates feed) — reference trackBy. Updates are
+        filtered to the criteria's contract names when given."""
+        page = self.query(criteria, paging, sort)
+        contracts = set(getattr(criteria, "contract_names", ()) or ())
+
+        def matches(state_and_ref):
+            if not contracts:
+                return True
+            return state_and_ref.state.data.contract_name in contracts
+
+        return page, matches
 
     def load_state(self, ref: StateRef) -> Optional[TransactionState]:
         rows = self.db.query(
@@ -358,19 +442,11 @@ class ServiceHub:
             raise TransactionResolutionError(ref.txhash)
         wtx = stx.tx
         if isinstance(wtx, NotaryChangeWireTransaction):
-            # Outputs are derived: input state with the notary swapped
-            # (reference NotaryChangeLedgerTransaction). Resolve just the
-            # requested index — resolving all would be quadratic over a
-            # back-chain.
+            # Outputs are derived: input state with the notary swapped and
+            # encumbrance remapped (reference NotaryChangeLedgerTransaction).
             if ref.index >= len(wtx.inputs):
                 raise TransactionResolutionError(ref.txhash)
-            inner = self.load_state(wtx.inputs[ref.index])
-            from ..core.contracts.structures import TransactionState as _TS
-
-            return _TS(
-                data=inner.data, notary=wtx.new_notary,
-                encumbrance=inner.encumbrance,
-            )
+            return wtx.resolve_output(ref.index, self.load_state)
         if ref.index >= len(wtx.outputs):
             raise TransactionResolutionError(ref.txhash)
         return wtx.outputs[ref.index]

@@ -1,0 +1,201 @@
+"""Vault query engine: composable criteria, paging, sorting, tracking.
+
+Reference parity: `node/src/main/kotlin/net/corda/node/services/vault/
+HibernateQueryCriteriaParser.kt` (criteria -> JPA predicates) and the
+`CordaRPCOps.kt:151-259` vault query surface (queryBy/trackBy with
+QueryCriteria + PageSpecification + Sort).  The reference compiles a
+criteria tree to Hibernate; here the same tree compiles to one SQL WHERE
+clause over the vault_states table — a single embedded store instead of
+four ORMs, per the TPU-build design.
+
+Criteria compose with `.and_(...)` / `.or_(...)` (reference
+QueryCriteria.and/or).  Results come back as a `Page` with the total
+count, mirroring the reference's Vault.Page (totalStatesAvailable).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.contracts.structures import StateAndRef, StateRef
+from ..core.serialization.codec import register_adapter
+
+DEFAULT_PAGE_SIZE = 200
+MAX_PAGE_SIZE = 10_000
+
+UNCONSUMED = "UNCONSUMED"
+CONSUMED = "CONSUMED"
+ALL = "ALL"
+
+
+class VaultQueryError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class PageSpecification:
+    """1-based page number (reference PageSpecification)."""
+
+    page_number: int = 1
+    page_size: int = DEFAULT_PAGE_SIZE
+
+    def __post_init__(self):
+        if self.page_number < 1:
+            raise VaultQueryError("page_number is 1-based")
+        if not 0 < self.page_size <= MAX_PAGE_SIZE:
+            raise VaultQueryError(f"page_size must be in 1..{MAX_PAGE_SIZE}")
+
+
+@dataclass(frozen=True)
+class Sort:
+    """Sort by a vault column (reference Sort/SortAttribute)."""
+
+    column: str = "recorded_at"   # recorded_at | contract_name | state_ref
+    descending: bool = False
+
+    _COLUMNS = {
+        "recorded_at": "recorded_at",
+        "contract_name": "contract_name",
+        "state_ref": "tx_id, output_index",
+    }
+
+    def sql(self) -> str:
+        col = self._COLUMNS.get(self.column)
+        if col is None:
+            raise VaultQueryError(f"unknown sort column {self.column!r}")
+        direction = "DESC" if self.descending else "ASC"
+        return ", ".join(f"{c.strip()} {direction}" for c in col.split(","))
+
+
+class QueryCriteria:
+    """Base: compiles to (sql_fragment, params). Compose with and_/or_."""
+
+    def compile(self) -> Tuple[str, list]:
+        raise NotImplementedError
+
+    def and_(self, other: "QueryCriteria") -> "QueryCriteria":
+        return _Compound("AND", self, other)
+
+    def or_(self, other: "QueryCriteria") -> "QueryCriteria":
+        return _Compound("OR", self, other)
+
+
+@dataclass(frozen=True)
+class _Compound(QueryCriteria):
+    op: str
+    left: QueryCriteria
+    right: QueryCriteria
+
+    def compile(self):
+        lsql, lparams = self.left.compile()
+        rsql, rparams = self.right.compile()
+        return f"({lsql} {self.op} {rsql})", lparams + rparams
+
+
+@dataclass(frozen=True)
+class VaultQueryCriteria(QueryCriteria):
+    """The general criteria (reference QueryCriteria.VaultQueryCriteria):
+    status, contract names, specific refs, notary, participants, record
+    time window, soft-lock filter."""
+
+    status: str = UNCONSUMED
+    contract_names: Tuple[str, ...] = ()
+    state_refs: Tuple[StateRef, ...] = ()
+    notary_names: Tuple[str, ...] = ()
+    participant_keys: Tuple[bytes, ...] = ()   # encoded public keys
+    recorded_after: Optional[float] = None
+    recorded_before: Optional[float] = None
+    include_soft_locked: bool = True
+
+    def compile(self):
+        clauses, params = [], []
+        if self.status == UNCONSUMED:
+            clauses.append("consumed = 0")
+        elif self.status == CONSUMED:
+            clauses.append("consumed = 1")
+        elif self.status != ALL:
+            raise VaultQueryError(f"unknown status {self.status!r}")
+        if self.contract_names:
+            marks = ",".join("?" * len(self.contract_names))
+            clauses.append(f"contract_name IN ({marks})")
+            params.extend(self.contract_names)
+        if self.state_refs:
+            ref_clause = " OR ".join(
+                "(tx_id = ? AND output_index = ?)" for _ in self.state_refs
+            )
+            clauses.append(f"({ref_clause})")
+            for ref in self.state_refs:
+                params.extend([ref.txhash.bytes, ref.index])
+        if self.notary_names:
+            marks = ",".join("?" * len(self.notary_names))
+            clauses.append(f"notary_name IN ({marks})")
+            params.extend(self.notary_names)
+        if self.participant_keys:
+            marks = ",".join("?" * len(self.participant_keys))
+            clauses.append(
+                "EXISTS (SELECT 1 FROM vault_participants p WHERE"
+                " p.tx_id = vault_states.tx_id"
+                " AND p.output_index = vault_states.output_index"
+                f" AND p.key_hex IN ({marks}))"
+            )
+            params.extend(k.hex() for k in self.participant_keys)
+        if self.recorded_after is not None:
+            clauses.append("recorded_at >= ?")
+            params.append(self.recorded_after)
+        if self.recorded_before is not None:
+            clauses.append("recorded_at <= ?")
+            params.append(self.recorded_before)
+        if not self.include_soft_locked:
+            clauses.append("lock_id IS NULL")
+        return (" AND ".join(clauses) or "1=1"), params
+
+
+@dataclass(frozen=True)
+class Page:
+    """One page of results (reference Vault.Page)."""
+
+    states: Tuple[StateAndRef, ...]
+    total_states_available: int
+    page_number: int
+    page_size: int
+
+
+register_adapter(
+    PageSpecification, "PageSpecification",
+    lambda p: {"n": p.page_number, "size": p.page_size},
+    lambda d: PageSpecification(d["n"], d["size"]),
+)
+register_adapter(
+    Sort, "VaultSort",
+    lambda s: {"col": s.column, "desc": s.descending},
+    lambda d: Sort(d["col"], d["desc"]),
+)
+register_adapter(
+    VaultQueryCriteria, "VaultQueryCriteria",
+    lambda c: {
+        "status": c.status, "contracts": list(c.contract_names),
+        "refs": list(c.state_refs), "notaries": list(c.notary_names),
+        "participants": list(c.participant_keys),
+        "after": c.recorded_after, "before": c.recorded_before,
+        "locked": c.include_soft_locked,
+    },
+    lambda d: VaultQueryCriteria(
+        d["status"], tuple(d["contracts"]), tuple(d["refs"]),
+        tuple(d["notaries"]), tuple(d["participants"]),
+        d["after"], d["before"], d["locked"],
+    ),
+)
+register_adapter(
+    _Compound, "VaultCompoundCriteria",
+    lambda c: {"op": c.op, "l": c.left, "r": c.right},
+    lambda d: _Compound(d["op"], d["l"], d["r"]),
+)
+register_adapter(
+    Page, "VaultPage",
+    lambda p: {
+        "states": list(p.states), "total": p.total_states_available,
+        "n": p.page_number, "size": p.page_size,
+    },
+    lambda d: Page(tuple(d["states"]), d["total"], d["n"], d["size"]),
+)

@@ -93,8 +93,30 @@ class CordaRPCOps:
     def vault_query(self, contract_name: Optional[str] = None) -> List:
         return self._services.vault_service.unconsumed_states(contract_name)
 
+    def vault_query_by(self, criteria=None, paging=None, sort=None):
+        """Criteria/paging/sorting vault query (reference
+        CordaRPCOps.vaultQueryBy, CordaRPCOps.kt:151-259)."""
+        return self._services.vault_service.query(criteria, paging, sort)
+
     def vault_track(self, contract_name: Optional[str] = None) -> DataFeed:
         return DataFeed(self.vault_query(contract_name), self._vault_updates)
+
+    def vault_track_by(self, criteria=None, paging=None, sort=None) -> DataFeed:
+        """Snapshot page + live updates filtered to the criteria's contract
+        names (reference vaultTrackBy)."""
+        page, matches = self._services.vault_service.track_by(
+            criteria, paging, sort
+        )
+        filtered = Observable()
+
+        def forward(update):
+            produced = [s for s in update["produced"] if matches(s)]
+            consumed = update["consumed"]
+            if produced or consumed:
+                filtered.on_next({"produced": produced, "consumed": consumed})
+
+        self._vault_updates.subscribe(forward)
+        return DataFeed(page, filtered)
 
     # -- attachments ---------------------------------------------------------
 

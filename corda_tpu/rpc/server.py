@@ -60,6 +60,17 @@ class RPCServer:
                 continue
             try:
                 request = deserialize(msg.payload)
+            except Exception as exc:
+                import sys as _sys
+
+                print(
+                    f"corda_tpu.rpc: dropping undecodable request: {exc} "
+                    "(are the request's types imported in the node process?)",
+                    file=_sys.stderr,
+                )
+                self._consumer.ack(msg)
+                continue
+            try:
                 self._handle(request)
             except Exception:
                 pass  # a bad request must not kill the server loop

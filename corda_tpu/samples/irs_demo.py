@@ -1,0 +1,436 @@
+"""IRS demo: a rate-fix oracle signing over FilteredTransaction tear-offs,
+driving an interest-rate-swap state through the scheduler.
+
+Reference parity: `samples/irs-demo/src/main/kotlin/net/corda/irs/api/
+NodeInterestRates.kt` (the Oracle: query + sign-over-filtered — the only
+reference workload exercising third-party tear-off signing end to end) and
+`samples/irs-demo/.../flows/RatesFixFlow.kt` (query -> tolerance check ->
+embed Fix command -> filtered signing round-trip), with the IRS state's
+fixing dates firing through the scheduler (`NodeSchedulerService`).
+
+Privacy property demonstrated: the oracle sees ONLY the Fix commands it
+is asked to attest (everything else in the transaction is pruned to
+Merkle hashes), yet its signature covers the whole transaction id.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import List, Optional, Tuple
+
+from ..core.contracts import (
+    Command,
+    CommandData,
+    Contract,
+    ContractState,
+    ScheduledActivity,
+    SchedulableState,
+    StateRef,
+    TransactionVerificationError,
+    TypeOnlyCommandData,
+    contract,
+)
+from ..core.crypto.signing import DigitalSignatureWithKey
+from ..core.flows import (
+    FinalityFlow,
+    FlowException,
+    FlowLogic,
+    initiated_by,
+    initiating_flow,
+    schedulable_flow,
+    startable_by_rpc,
+)
+from ..core.identity import Party
+from ..core.serialization.codec import corda_serializable, register_adapter
+from ..core.transactions import TransactionBuilder
+from ..core.transactions.filtered import FilteredTransaction
+
+
+# ---------------------------------------------------------------------------
+# Fix model (reference contracts Fix / FixOf)
+# ---------------------------------------------------------------------------
+
+@corda_serializable
+@dataclass(frozen=True)
+class FixOf:
+    """Identifies a rate: e.g. LIBOR / 2026-07-30 / 3M."""
+
+    name: str
+    for_day: str   # ISO date
+    tenor: str
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class Fix(CommandData):
+    """An observed rate embedded as a command (oracle signs over it)."""
+
+    of: FixOf
+    value: float
+
+
+class UnknownFix(FlowException):
+    def __init__(self, of: FixOf):
+        super().__init__(f"unknown fix {of}")
+
+
+class FixOutOfRange(FlowException):
+    def __init__(self, by_amount: float):
+        super().__init__(f"fix out of range by {by_amount}")
+
+
+# ---------------------------------------------------------------------------
+# The oracle service (reference NodeInterestRates.Oracle)
+# ---------------------------------------------------------------------------
+
+class RateOracle:
+    """Holds known fixes; answers queries; signs tear-offs.
+
+    sign() accepts a FilteredTransaction whose REVEALED components must all
+    be Fix commands naming this oracle as a signer and matching known
+    rates; the signature is over the Merkle root == transaction id, so it
+    commits to the whole (mostly hidden) transaction."""
+
+    def __init__(self, identity: Party, key_management):
+        self.identity = identity
+        self._kms = key_management
+        self._fixes = {}
+        self._lock = threading.Lock()
+
+    def add_fix(self, fix: Fix) -> None:
+        with self._lock:
+            self._fixes[fix.of] = fix
+
+    def query(self, queries: List[FixOf]) -> List[Fix]:
+        if not queries:
+            raise FlowException("empty fix query")
+        with self._lock:
+            out = []
+            for q in queries:
+                fix = self._fixes.get(q)
+                if fix is None:
+                    raise UnknownFix(q)
+                out.append(fix)
+            return out
+
+    def sign(self, ftx: FilteredTransaction) -> DigitalSignatureWithKey:
+        ftx.verify()  # Merkle proof against the root
+
+        def check(elem) -> bool:
+            if not isinstance(elem, Command):
+                raise FlowException(
+                    "oracle received data of different type than expected"
+                )
+            if not isinstance(elem.value, Fix):
+                raise FlowException("oracle received a non-Fix command")
+            if not any(
+                k.encoded == self.identity.owning_key.encoded
+                for k in elem.signers
+            ):
+                raise FlowException("oracle is not a signer of the command")
+            with self._lock:
+                known = self._fixes.get(elem.value.of)
+            if known is None or known != elem.value:
+                raise UnknownFix(elem.value.of)
+            return True
+
+        if not ftx.check_with_fun(check):
+            raise FlowException("nothing to attest")
+        return self._kms.sign(ftx.id.bytes, self.identity.owning_key)
+
+
+# ---------------------------------------------------------------------------
+# Oracle protocol flows (reference RatesFixFlow.FixQueryFlow/FixSignFlow)
+# ---------------------------------------------------------------------------
+
+@corda_serializable
+@dataclass(frozen=True)
+class QueryRequest:
+    queries: Tuple[FixOf, ...]
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class QueryResponse:
+    fixes: Tuple[Fix, ...]
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class SignRequest:
+    ftx: FilteredTransaction
+
+
+def _oracle_of(service_hub) -> RateOracle:
+    oracle = getattr(service_hub, "rate_oracle", None)
+    if oracle is None:
+        raise FlowException("this node does not run a rate oracle")
+    return oracle
+
+
+@initiating_flow
+class FixQueryFlow(FlowLogic):
+    def __init__(self, fix_of: FixOf, oracle: Party):
+        self.fix_of = fix_of
+        self.oracle = oracle
+
+    def call(self):
+        resp = yield self.send_and_receive(
+            self.oracle, QueryRequest((self.fix_of,)), QueryResponse
+        )
+        return resp.fixes[0]
+
+
+@initiated_by(FixQueryFlow)
+class FixQueryHandler(FlowLogic):
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        request = yield self.receive(self.counterparty, QueryRequest)
+        oracle = _oracle_of(self.service_hub)
+        fixes = oracle.query(list(request.queries))
+        yield self.send(self.counterparty, QueryResponse(tuple(fixes)))
+
+
+@initiating_flow
+class FixSignFlow(FlowLogic):
+    def __init__(self, ftx: FilteredTransaction, oracle: Party):
+        self.ftx = ftx
+        self.oracle = oracle
+
+    def call(self):
+        sig = yield self.send_and_receive(
+            self.oracle, SignRequest(self.ftx), DigitalSignatureWithKey
+        )
+        if not self.oracle.owning_key.is_fulfilled_by({sig.by}):
+            raise FlowException("signature is not the oracle's")
+        if not sig.is_valid(self.ftx.id.bytes):
+            raise FlowException("invalid oracle signature")
+        return sig
+
+
+@initiated_by(FixSignFlow)
+class FixSignHandler(FlowLogic):
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        request = yield self.receive(self.counterparty, SignRequest)
+        oracle = _oracle_of(self.service_hub)
+        yield self.send(self.counterparty, oracle.sign(request.ftx))
+
+
+class RatesFixFlow(FlowLogic):
+    """Query the oracle, check tolerance, embed the Fix command, have the
+    oracle sign a tear-off revealing ONLY the Fix commands it attests
+    (reference RatesFixFlow.call + filtering)."""
+
+    def __init__(self, builder: TransactionBuilder, oracle: Party,
+                 fix_of: FixOf, expected_rate: float, tolerance: float):
+        self.builder = builder
+        self.oracle = oracle
+        self.fix_of = fix_of
+        self.expected_rate = expected_rate
+        self.tolerance = tolerance
+
+    def filtering(self, elem) -> bool:
+        """Reveal exactly the Fix commands signed by the oracle."""
+        return (
+            isinstance(elem, Command)
+            and isinstance(elem.value, Fix)
+            and any(
+                k.encoded == self.oracle.owning_key.encoded
+                for k in elem.signers
+            )
+        )
+
+    def call(self):
+        fix = yield from self.sub_flow(FixQueryFlow(self.fix_of, self.oracle))
+        if abs(fix.value - self.expected_rate) > self.tolerance:
+            raise FixOutOfRange(abs(fix.value - self.expected_rate))
+        self.builder.add_command(fix, self.oracle.owning_key)
+        wtx = yield self.record(self.builder.to_wire_transaction)
+        ftx = wtx.build_filtered_transaction(self.filtering)
+        sig = yield from self.sub_flow(FixSignFlow(ftx, self.oracle))
+        return wtx, fix, sig
+
+
+# ---------------------------------------------------------------------------
+# A minimal IRS state: fixing dates fire through the scheduler
+# ---------------------------------------------------------------------------
+
+@corda_serializable
+@dataclass(frozen=True)
+class InterestRateSwapState(SchedulableState):
+    """Fixed-vs-floating swap caricature: each fixing replaces the floating
+    leg's rate with the oracle's fix (reference InterestRateSwap.State's
+    nextFixingOf/evaluateCalculation, radically simplified — the full
+    OpenGamma analytics are out of scope for a framework demo)."""
+
+    fixed_leg_payer: Party = None
+    floating_leg_payer: Party = None
+    notional: int = 0
+    fixed_rate: float = 0.0
+    oracle_name: str = ""
+    fix_of: FixOf = None
+    floating_rate: Optional[float] = None   # set by the fixing
+    next_fixing_at: Optional[int] = None    # unix nanos
+    contract_name = "corda_tpu.samples.IRS"
+
+    @property
+    def participants(self) -> List:
+        return [self.fixed_leg_payer, self.floating_leg_payer]
+
+    def next_scheduled_activity(self, this_state_ref: StateRef) -> Optional[ScheduledActivity]:
+        if self.next_fixing_at is None or self.floating_rate is not None:
+            return None
+        return ScheduledActivity(
+            flow_name="corda_tpu.samples.irs_demo.FixingFlow",
+            flow_args=(this_state_ref,),
+            scheduled_at=self.next_fixing_at,
+        )
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class IRSCommand(TypeOnlyCommandData):
+    kind: str = "Agree"   # Agree | Fixing
+
+
+@contract(name="corda_tpu.samples.IRS")
+class IRSContract(Contract):
+    def verify(self, tx) -> None:
+        irs_cmds = [
+            c for c in tx.commands if isinstance(c.value, IRSCommand)
+        ]
+        if not irs_cmds:
+            raise TransactionVerificationError(tx.id, "no IRS command")
+        kind = irs_cmds[0].value.kind
+        if kind == "Fixing":
+            fixes = [c for c in tx.commands if isinstance(c.value, Fix)]
+            if len(fixes) != 1:
+                raise TransactionVerificationError(
+                    tx.id, "a fixing needs exactly one Fix command"
+                )
+            outs = tx.outputs_of_type(InterestRateSwapState)
+            if len(outs) != 1 or outs[0].floating_rate != fixes[0].value.value:
+                raise TransactionVerificationError(
+                    tx.id, "output floating rate must equal the attested fix"
+                )
+
+
+@schedulable_flow
+@startable_by_rpc
+class FixingFlow(FlowLogic):
+    """Fired by the scheduler when a fixing date arrives: asks the oracle
+    for the rate, gets its tear-off signature over the final transaction,
+    finalises the fixed state (reference FixingFlow.Fixer)."""
+
+    TOLERANCE = 10.0
+
+    def __init__(self, ref: StateRef):
+        self.ref = ref
+
+    def call(self):
+        from ..core.contracts import StateAndRef
+        from ..core.transactions.signed import SignedTransaction
+
+        hub = self.service_hub
+        ts = hub.load_state(self.ref)
+        irs: InterestRateSwapState = ts.data
+        oracle = hub.identity_service.party_from_name(irs.oracle_name)
+        if oracle is None:
+            raise FlowException(f"oracle {irs.oracle_name} not known")
+
+        fix = yield from self.sub_flow(FixQueryFlow(irs.fix_of, oracle))
+        if abs(fix.value - irs.fixed_rate) > self.TOLERANCE:
+            raise FixOutOfRange(abs(fix.value - irs.fixed_rate))
+
+        builder = TransactionBuilder(notary=ts.notary)
+        builder.add_input_state(StateAndRef(ts, self.ref))
+        builder.add_output_state(
+            replace(irs, floating_rate=fix.value, next_fixing_at=None)
+        )
+        builder.add_command(
+            IRSCommand("Fixing"), irs.fixed_leg_payer.owning_key
+        )
+        builder.add_command(fix, oracle.owning_key)
+        wtx = yield self.record(builder.to_wire_transaction)
+
+        def filtering(elem) -> bool:
+            # Reveal exactly the Fix commands signed by the oracle.
+            return (
+                isinstance(elem, Command)
+                and isinstance(elem.value, Fix)
+                and any(
+                    k.encoded == oracle.owning_key.encoded
+                    for k in elem.signers
+                )
+            )
+
+        ftx = wtx.build_filtered_transaction(filtering)
+        oracle_sig = yield from self.sub_flow(FixSignFlow(ftx, oracle))
+        my_sig = hub.key_management_service.sign(
+            wtx.id.bytes, irs.fixed_leg_payer.owning_key
+        )
+        stx = SignedTransaction.of(wtx, (my_sig, oracle_sig))
+        result = yield from self.sub_flow(FinalityFlow(stx))
+        return result
+
+
+def main(verbose: bool = True) -> dict:
+    """Run the demo: two banks agree a swap, the scheduler fires the
+    fixing, the oracle attests LIBOR over a tear-off, the state updates
+    (reference irs-demo Main.kt, reduced to one fixing)."""
+    import time as _time
+
+    from ..testing.mocknetwork import MockNetwork
+
+    def log(msg):
+        if verbose:
+            print(f"[irs-demo] {msg}")
+
+    net = MockNetwork()
+    notary = net.create_notary_node(validating=True)
+    bank_a = net.create_node("O=Bank A,L=London,C=GB")
+    oracle_node = net.create_node("O=Rates Oracle,L=Zurich,C=CH")
+    oracle = RateOracle(
+        oracle_node.info, oracle_node.services.key_management_service
+    )
+    oracle_node.services.rate_oracle = oracle
+    fix_of = FixOf("LIBOR", "2026-07-30", "3M")
+    oracle.add_fix(Fix(fix_of, 3.25))
+    log("oracle knows LIBOR 3M @ 3.25")
+
+    builder = TransactionBuilder(notary=notary.info)
+    swap = InterestRateSwapState(
+        fixed_leg_payer=bank_a.info,
+        floating_leg_payer=bank_a.info,
+        notional=10_000_000,
+        fixed_rate=3.0,
+        oracle_name=oracle_node.info.name,
+        fix_of=fix_of,
+        next_fixing_at=int((_time.time() - 1) * 1_000_000_000),
+    )
+    builder.add_output_state(swap)
+    builder.add_command(IRSCommand("Agree"), bank_a.info.owning_key)
+    stx = bank_a.services.sign_initial_transaction(builder)
+    bank_a.services.record_transactions([stx])
+    log(f"swap agreed: notional {swap.notional}, fixing due")
+
+    started = bank_a.scheduler.wake()
+    net.run_network()
+    bank_a.smm.flows[started[0]].result.result(timeout=10)
+    fixed = bank_a.services.vault_service.unconsumed_states(
+        InterestRateSwapState.contract_name
+    )[0].state.data
+    log(f"fixing applied by scheduler+oracle: floating rate {fixed.floating_rate}")
+    net.stop_nodes()
+    assert fixed.floating_rate == 3.25
+    return {"floating_rate": fixed.floating_rate}
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,8 @@ Endpoints (reference servlet/resource parity):
   GET  /api/info                         -> node identity
   GET  /api/network                      -> network map snapshot
   GET  /api/notaries                     -> notary identities
-  GET  /api/vault[?contract=...]         -> unconsumed states
+  GET  /api/vault[?contract=&status=&notary=&page=&page_size=&sort=&dir=]
+                                         -> paged criteria query
   GET  /api/attachments/{hash}           -> attachment bytes
   POST /api/attachments                  -> upload, returns hash
   POST /api/flows/{flow_name}            -> start flow (JSON args), returns id
@@ -81,7 +82,39 @@ class WebServer:
         elif path == "/api/notaries":
             req._json(200, self.ops.notary_identities())
         elif path == "/api/vault":
-            req._json(200, self.ops.vault_query(params.get("contract")))
+            from ..node.vault_query import (
+                PageSpecification,
+                Sort,
+                VaultQueryCriteria,
+            )
+
+            criteria = VaultQueryCriteria(
+                status=params.get("status", "UNCONSUMED").upper(),
+                contract_names=(
+                    (params["contract"],) if params.get("contract") else ()
+                ),
+                notary_names=(
+                    (params["notary"],) if params.get("notary") else ()
+                ),
+            )
+            paging = PageSpecification(
+                page_number=int(params.get("page", 1)),
+                page_size=int(params.get("page_size", 200)),
+            )
+            sort = Sort(
+                column=params.get("sort", "recorded_at"),
+                descending=params.get("dir", "asc").lower() == "desc",
+            )
+            page = self.ops.vault_query_by(criteria, paging, sort)
+            req._json(
+                200,
+                {
+                    "total": page.total_states_available,
+                    "page": page.page_number,
+                    "page_size": page.page_size,
+                    "states": list(page.states),
+                },
+            )
         elif m := re.fullmatch(r"/api/attachments/([0-9A-Fa-f]{64})", path):
             att_id = SecureHash(bytes.fromhex(m.group(1)))
             data = self.ops.open_attachment(att_id)

@@ -1,0 +1,171 @@
+"""IRS demo / oracle tests: tear-off signing + scheduler-driven fixing.
+
+Reference parity: `samples/irs-demo/src/test/kotlin/net/corda/irs/api/
+NodeInterestRatesTest.kt` (oracle signs valid tear-offs, refuses unknown
+fixes and over-revealing/foreign tear-offs) and the scheduler firing a
+fixing (IRSSimulation shape, radically reduced).
+"""
+import time
+from dataclasses import replace
+
+import pytest
+
+from corda_tpu.core.contracts import StateAndRef
+from corda_tpu.core.flows import FlowException
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.samples.irs_demo import (
+    Fix,
+    FixingFlow,
+    FixOf,
+    FixOutOfRange,
+    InterestRateSwapState,
+    IRSCommand,
+    RateOracle,
+    RatesFixFlow,
+    UnknownFix,
+)
+from corda_tpu.testing.mocknetwork import MockNetwork
+
+LIBOR_3M = FixOf("LIBOR", "2026-07-30", "3M")
+
+
+class TestOracle:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.alice = self.net.create_node("O=Alice,L=London,C=GB")
+        self.oracle_node = self.net.create_node("O=Oracle,L=Zurich,C=CH")
+        self.oracle = RateOracle(
+            self.oracle_node.info,
+            self.oracle_node.services.key_management_service,
+        )
+        self.oracle_node.services.rate_oracle = self.oracle
+        self.oracle.add_fix(Fix(LIBOR_3M, 3.25))
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _irs_state(self, next_fixing_at=None):
+        return InterestRateSwapState(
+            fixed_leg_payer=self.alice.info,
+            floating_leg_payer=self.alice.info,
+            notional=1_000_000,
+            fixed_rate=3.0,
+            oracle_name=self.oracle_node.info.name,
+            fix_of=LIBOR_3M,
+            next_fixing_at=next_fixing_at,
+        )
+
+    def _issue_irs(self, next_fixing_at=None) -> StateAndRef:
+        b = TransactionBuilder(notary=self.notary.info)
+        b.add_output_state(self._irs_state(next_fixing_at))
+        b.add_command(IRSCommand("Agree"), self.alice.info.owning_key)
+        stx = self.alice.services.sign_initial_transaction(b)
+        self.alice.services.record_transactions([stx])
+        return stx.tx.out_ref(0)
+
+    def test_rates_fix_flow_signs_over_tearoff(self):
+        builder = TransactionBuilder(notary=self.notary.info)
+        ref = self._issue_irs()
+        builder.add_input_state(ref)
+        builder.add_output_state(
+            replace(ref.state.data, floating_rate=3.25, next_fixing_at=None)
+        )
+        builder.add_command(IRSCommand("Fixing"), self.alice.info.owning_key)
+        h = self.alice.start_flow(
+            RatesFixFlow(builder, self.oracle_node.info, LIBOR_3M, 3.0, 1.0)
+        )
+        self.net.run_network()
+        wtx, fix, sig = h.result.result(timeout=5)
+        assert fix.value == 3.25
+        assert sig.is_valid(wtx.id.bytes)  # signature covers the FULL tx id
+        assert self.oracle_node.info.owning_key.is_fulfilled_by({sig.by})
+
+    def test_fix_out_of_tolerance_rejected(self):
+        builder = TransactionBuilder(notary=self.notary.info)
+        h = self.alice.start_flow(
+            RatesFixFlow(builder, self.oracle_node.info, LIBOR_3M, 5.0, 0.1)
+        )
+        self.net.run_network()
+        with pytest.raises(FixOutOfRange):
+            h.result.result(timeout=5)
+
+    def test_unknown_fix_rejected(self):
+        builder = TransactionBuilder(notary=self.notary.info)
+        h = self.alice.start_flow(
+            RatesFixFlow(
+                builder, self.oracle_node.info,
+                FixOf("EURIBOR", "2026-07-30", "6M"), 3.0, 1.0,
+            )
+        )
+        self.net.run_network()
+        with pytest.raises(Exception, match="unknown fix"):
+            h.result.result(timeout=5)
+
+    def test_oracle_refuses_wrong_rate_command(self):
+        """A tear-off with a Fix command whose value differs from the known
+        rate must be refused (oracle attests data, not wishes)."""
+        b = TransactionBuilder(notary=self.notary.info)
+        ref = self._issue_irs()
+        b.add_input_state(ref)
+        b.add_command(
+            Fix(LIBOR_3M, 99.0), self.oracle_node.info.owning_key
+        )
+        wtx = b.to_wire_transaction()
+        from corda_tpu.core.contracts import Command
+
+        ftx = wtx.build_filtered_transaction(
+            lambda e: isinstance(e, Command) and isinstance(e.value, Fix)
+        )
+        with pytest.raises(Exception, match="unknown fix"):
+            self.oracle.sign(ftx)
+
+    def test_oracle_refuses_over_revealing_tearoff(self):
+        """Revealed non-Fix components must abort signing — the oracle only
+        attests rates, never transaction structure."""
+        b = TransactionBuilder(notary=self.notary.info)
+        ref = self._issue_irs()
+        b.add_input_state(ref)
+        b.add_command(Fix(LIBOR_3M, 3.25), self.oracle_node.info.owning_key)
+        wtx = b.to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(lambda e: True)  # reveal all
+        with pytest.raises(FlowException):
+            self.oracle.sign(ftx)
+
+    def test_privacy_of_tearoff(self):
+        """The oracle-visible tear-off contains the Fix command but NOT the
+        inputs/outputs of the transaction."""
+        from corda_tpu.core.contracts import Command
+
+        b = TransactionBuilder(notary=self.notary.info)
+        ref = self._issue_irs()
+        b.add_input_state(ref)
+        b.add_output_state(replace(ref.state.data, floating_rate=3.25))
+        b.add_command(Fix(LIBOR_3M, 3.25), self.oracle_node.info.owning_key)
+        wtx = b.to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(
+            lambda e: isinstance(e, Command) and isinstance(e.value, Fix)
+        )
+        assert ftx.inputs == []
+        assert ftx.outputs == []
+        assert len(ftx.commands) == 1
+        assert ftx.id == wtx.id
+
+    def test_scheduler_fires_fixing_flow(self):
+        """A swap with a due fixing date goes through the whole pipeline:
+        scheduler wake -> FixingFlow -> oracle query + tear-off sign ->
+        finality; the replacement state carries the attested rate."""
+        past = int((time.time() - 1) * 1_000_000_000)
+        ref = self._issue_irs(next_fixing_at=past)
+        started = self.alice.scheduler.wake()
+        assert len(started) == 1
+        self.net.run_network()
+        fsm = self.alice.smm.flows[started[0]]
+        stx = fsm.result.result(timeout=5)
+        new_states = self.alice.services.vault_service.unconsumed_states(
+            InterestRateSwapState.contract_name
+        )
+        assert len(new_states) == 1
+        fixed = new_states[0].state.data
+        assert fixed.floating_rate == 3.25
+        assert fixed.next_fixing_at is None

@@ -128,9 +128,9 @@ class TestWebServer:
                 f"{base}/api/attachments/{att_hash}"
             ).read()
             assert got == b"some jar"
-            # vault is empty
+            # vault is empty (paged shape)
             vault = json.loads(urllib.request.urlopen(f"{base}/api/vault").read())
-            assert vault == []
+            assert vault["total"] == 0 and vault["states"] == []
         finally:
             server.stop()
             net.stop_nodes()

@@ -1,0 +1,190 @@
+"""Vault query engine tests: criteria, paging, sorting, tracking.
+
+Reference parity: `node/src/test/kotlin/net/corda/node/services/vault/
+VaultQueryTests.kt` shapes — status filters, criteria composition,
+paging with total count, sorting, participant lookup.
+"""
+import time
+from dataclasses import dataclass
+from typing import List
+
+import pytest
+
+from corda_tpu.core.contracts import (
+    Contract,
+    ContractState,
+    StateAndRef,
+    TypeOnlyCommandData,
+    contract,
+)
+from corda_tpu.core.serialization.codec import corda_serializable
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.node.vault_query import (
+    ALL,
+    CONSUMED,
+    UNCONSUMED,
+    Page,
+    PageSpecification,
+    Sort,
+    VaultQueryCriteria,
+    VaultQueryError,
+)
+from corda_tpu.testing.mocknetwork import MockNetwork
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class QState(ContractState):
+    parties: tuple = ()
+    n: int = 0
+    contract_name = "QContract"
+
+    @property
+    def participants(self) -> List:
+        return list(self.parties)
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class QCommand(TypeOnlyCommandData):
+    pass
+
+
+@contract(name="QContract")
+class QContract(Contract):
+    def verify(self, tx) -> None:
+        pass
+
+
+@contract(name="QContract2")
+class QContract2(Contract):
+    def verify(self, tx) -> None:
+        pass
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class QState2(ContractState):
+    parties: tuple = ()
+    n: int = 0
+    contract_name = "QContract2"
+
+    @property
+    def participants(self) -> List:
+        return list(self.parties)
+
+
+class TestVaultQuery:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.alice = self.net.create_node("O=Alice,L=London,C=GB")
+        self.vault = self.alice.services.vault_service
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _issue(self, n, cls=QState, count=1):
+        refs = []
+        for i in range(count):
+            b = TransactionBuilder(notary=self.notary.info)
+            b.add_output_state(cls(parties=(self.alice.info,), n=n + i))
+            b.add_command(QCommand(), self.alice.info.owning_key)
+            stx = self.alice.services.sign_initial_transaction(b)
+            self.alice.services.record_transactions([stx])
+            refs.append(stx.tx.out_ref(0))
+        return refs
+
+    def _consume(self, ref: StateAndRef):
+        b = TransactionBuilder(notary=self.notary.info)
+        b.add_input_state(ref)
+        b.add_output_state(QState(parties=(self.alice.info,), n=999))
+        b.add_command(QCommand(), self.alice.info.owning_key)
+        stx = self.alice.services.sign_initial_transaction(b)
+        self.alice.services.record_transactions([stx])
+
+    def test_status_filters(self):
+        refs = self._issue(0, count=3)
+        self._consume(refs[0])
+        unconsumed = self.vault.query(VaultQueryCriteria(status=UNCONSUMED))
+        consumed = self.vault.query(VaultQueryCriteria(status=CONSUMED))
+        everything = self.vault.query(VaultQueryCriteria(status=ALL))
+        # consuming produced one new state: 3 - 1 + 1 = 3 unconsumed
+        assert unconsumed.total_states_available == 3
+        assert consumed.total_states_available == 1
+        assert everything.total_states_available == 4
+
+    def test_contract_filter_and_composition(self):
+        self._issue(0, count=2)
+        self._issue(10, cls=QState2, count=3)
+        only_q = self.vault.query(
+            VaultQueryCriteria(contract_names=("QContract",))
+        )
+        assert only_q.total_states_available == 2
+        both = self.vault.query(
+            VaultQueryCriteria(contract_names=("QContract",)).or_(
+                VaultQueryCriteria(contract_names=("QContract2",))
+            )
+        )
+        assert both.total_states_available == 5
+
+    def test_paging_with_total(self):
+        self._issue(0, count=25)
+        page1 = self.vault.query(
+            paging=PageSpecification(page_number=1, page_size=10)
+        )
+        page3 = self.vault.query(
+            paging=PageSpecification(page_number=3, page_size=10)
+        )
+        assert page1.total_states_available == 25
+        assert len(page1.states) == 10
+        assert len(page3.states) == 5
+        # no overlap between pages
+        ids1 = {s.ref for s in page1.states}
+        ids3 = {s.ref for s in page3.states}
+        assert not ids1 & ids3
+
+    def test_sorting(self):
+        self._issue(0, count=5)
+        asc = self.vault.query(sort=Sort("state_ref", descending=False))
+        desc = self.vault.query(sort=Sort("state_ref", descending=True))
+        assert [s.ref for s in asc.states] == [s.ref for s in reversed(desc.states)]
+        with pytest.raises(VaultQueryError):
+            self.vault.query(sort=Sort("evil; DROP TABLE vault_states"))
+
+    def test_participant_criteria(self):
+        self._issue(0, count=2)
+        mine = self.vault.query(
+            VaultQueryCriteria(
+                participant_keys=(self.alice.info.owning_key.encoded,)
+            )
+        )
+        assert mine.total_states_available == 2
+        nobody = self.vault.query(
+            VaultQueryCriteria(participant_keys=(b"\x01" * 32,))
+        )
+        assert nobody.total_states_available == 0
+
+    def test_time_window(self):
+        self._issue(0, count=1)
+        cutoff = time.time() + 1
+        recent = self.vault.query(
+            VaultQueryCriteria(recorded_before=cutoff)
+        )
+        assert recent.total_states_available == 1
+        future = self.vault.query(VaultQueryCriteria(recorded_after=cutoff))
+        assert future.total_states_available == 0
+
+    def test_state_ref_lookup(self):
+        refs = self._issue(0, count=3)
+        one = self.vault.query(
+            VaultQueryCriteria(state_refs=(refs[1].ref,))
+        )
+        assert one.total_states_available == 1
+        assert one.states[0].ref == refs[1].ref
+
+    def test_page_spec_validation(self):
+        with pytest.raises(VaultQueryError):
+            PageSpecification(page_number=0)
+        with pytest.raises(VaultQueryError):
+            PageSpecification(page_size=0)
