@@ -74,7 +74,8 @@ def find_signature_scheme(key_or_name) -> SignatureScheme:
 
 
 # Schemes in the registry whose algorithm implementation has not landed yet.
-UNIMPLEMENTED_SCHEMES = frozenset({SPHINCS256_SHA256.scheme_code_name})
+# (Empty since round 2: SPHINCS-256 landed as a full WOTS+/HORST hypertree.)
+UNIMPLEMENTED_SCHEMES = frozenset()
 
 
 def is_supported(scheme: SignatureScheme) -> bool:

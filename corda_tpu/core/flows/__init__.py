@@ -23,6 +23,7 @@ from .api import (
     schedulable_flow,
     startable_by_rpc,
 )
+from .confidential import TransactionKeyFlow, TransactionKeyHandler
 from .statereplacement import (
     AbstractStateReplacementAcceptor,
     AbstractStateReplacementInstigator,
@@ -58,4 +59,5 @@ __all__ = [
     "AbstractStateReplacementAcceptor", "AbstractStateReplacementInstigator",
     "ContractUpgradeFlow", "NotaryChangeFlow", "Proposal",
     "StateReplacementException", "UpgradeCommand", "UpgradedContract",
+    "TransactionKeyFlow", "TransactionKeyHandler",
 ]

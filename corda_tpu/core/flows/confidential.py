@@ -1,0 +1,54 @@
+"""Confidential identities: TransactionKeyFlow.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/flows/
+TransactionKeyFlow.kt` — both sides of a session generate FRESH keys for
+a transaction and swap them, so on-ledger states reference anonymous
+keys unlinkable (by outsiders) to legal identities; each node's identity
+service records the mapping for its counterparty.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..identity import AbstractParty, AnonymousParty, Party
+from .api import FlowLogic, initiated_by, initiating_flow
+
+
+@initiating_flow
+class TransactionKeyFlow(FlowLogic):
+    """Swap fresh confidential keys with `other_party`; returns a mapping
+    {well_known_party: AnonymousParty} covering both sides."""
+
+    def __init__(self, other_party: Party):
+        self.other_party = other_party
+
+    def call(self):
+        hub = self.service_hub
+        mine = yield self.record(
+            lambda: AnonymousParty(hub.key_management_service.fresh_key())
+        )
+        theirs = yield self.send_and_receive(
+            self.other_party, mine, AnonymousParty
+        )
+        hub.identity_service.register_anonymous_identity(
+            theirs.owning_key, self.other_party
+        )
+        return {self.other_party: theirs, hub.my_info: mine}
+
+
+@initiated_by(TransactionKeyFlow)
+class TransactionKeyHandler(FlowLogic):
+    def __init__(self, counterparty: Party):
+        self.counterparty = counterparty
+
+    def call(self):
+        hub = self.service_hub
+        theirs = yield self.receive(self.counterparty, AnonymousParty)
+        hub.identity_service.register_anonymous_identity(
+            theirs.owning_key, self.counterparty
+        )
+        mine = yield self.record(
+            lambda: AnonymousParty(hub.key_management_service.fresh_key())
+        )
+        yield self.send(self.counterparty, mine)
+        return {self.counterparty: theirs, hub.my_info: mine}

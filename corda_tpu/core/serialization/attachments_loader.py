@@ -1,0 +1,113 @@
+"""Attachment-delivered contract code: the AttachmentsClassLoader analogue.
+
+Reference parity: `core/src/main/kotlin/net/corda/core/serialization/
+AttachmentsClassLoader.kt:23-40` — contract classes are shipped inside
+attachment JARs; a dedicated classloader serves classes from the
+transaction's attachments and REJECTS overlapping file paths between
+attachments (so one attachment cannot shadow another's contract code).
+
+TPU-build shape: an attachment is a ZIP whose `*.py` entries are contract
+modules; `load_contracts_from_attachments` executes them in synthetic
+modules so their `@contract`-decorated classes land in the global contract
+registry (corda_tpu.core.contracts.structures), which LedgerTransaction
+verification resolves by name.  Protections kept from the reference:
+
+  * overlap rejection: the same entry path provided by two attachments
+    with different content is an error (`OverlappingAttachments`);
+  * idempotence: re-loading an identical attachment is a no-op;
+  * contract-name collisions with ALREADY-registered code are rejected by
+    the registry itself (same name, different class).
+
+Trust model (same as the reference's): attachment code is arbitrary code
+— the reference runs JARs on the JVM and gates trust on attachment
+signing/whitelisting, with a deterministic sandbox only in
+`experimental/`. Callers must only load attachments from trusted stores.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import sys
+import types
+import zipfile
+from typing import Dict, List, Tuple
+
+from ..contracts.structures import _CONTRACT_REGISTRY
+
+
+class AttachmentLoadError(Exception):
+    pass
+
+
+class OverlappingAttachments(AttachmentLoadError):
+    """Two attachments provide the same path with different content
+    (reference AttachmentsClassLoader overlap check)."""
+
+
+# content digests already executed (idempotence across calls). Overlap
+# rejection is scoped PER CALL (i.e. per transaction, matching the
+# reference's per-transaction classloader) — two unrelated transactions
+# may legitimately both ship a `contracts/contract.py`.
+_loaded_digests: set = set()
+
+
+def load_contracts_from_attachments(attachments) -> List[str]:
+    """Execute the contract modules in `attachments` (iterable of objects
+    with `.id` and `.data` — corda_tpu Attachment, or raw zip bytes) and
+    return the names of newly registered contracts.  Atomic: on any
+    failure the contract registry, module table and digest cache are
+    rolled back to their pre-call state."""
+    before = set(_CONTRACT_REGISTRY)
+    entries: Dict[str, Tuple[bytes, bytes]] = {}
+    for att in attachments:
+        data = att.data if hasattr(att, "data") else bytes(att)
+        try:
+            zf = zipfile.ZipFile(io.BytesIO(data))
+        except zipfile.BadZipFile as exc:
+            raise AttachmentLoadError(f"attachment is not a zip: {exc}")
+        for info in zf.infolist():
+            if not info.filename.endswith(".py"):
+                continue
+            content = zf.read(info)
+            digest = hashlib.sha256(content).digest()
+            if info.filename in entries and entries[info.filename][0] != digest:
+                raise OverlappingAttachments(
+                    f"{info.filename} provided by two attachments "
+                    "with different content"
+                )
+            entries[info.filename] = (digest, content)
+
+    new_modules: List[str] = []
+    new_digests: List[bytes] = []
+    try:
+        for path, (digest, content) in entries.items():
+            if digest in _loaded_digests:
+                continue  # identical content already executed: no-op
+            mod_name = (
+                "corda_tpu.attachments."
+                + path[:-3].replace("/", ".")
+                + "_"
+                + digest[:6].hex()
+            )
+            module = types.ModuleType(mod_name)
+            module.__file__ = f"<attachment:{path}>"
+            sys.modules[mod_name] = module
+            new_modules.append(mod_name)
+            try:
+                exec(compile(content, module.__file__, "exec"), module.__dict__)
+            except Exception as exc:
+                raise AttachmentLoadError(f"error loading {path}: {exc}")
+            _loaded_digests.add(digest)
+            new_digests.append(digest)
+    except Exception:
+        # Roll back everything this call touched: a partial load must not
+        # leave resolvable contracts whose companion code never loaded.
+        for name in reversed(new_modules):
+            sys.modules.pop(name, None)
+        for digest in new_digests:
+            _loaded_digests.discard(digest)
+        for contract_name in set(_CONTRACT_REGISTRY) - before:
+            del _CONTRACT_REGISTRY[contract_name]
+        raise
+
+    return sorted(set(_CONTRACT_REGISTRY) - before)

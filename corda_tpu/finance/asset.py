@@ -1,0 +1,131 @@
+"""OnLedgerAsset: the shared fungible-asset verification + generation core.
+
+Reference parity: `finance/src/main/kotlin/net/corda/contracts/asset/
+OnLedgerAsset.kt` — the abstract superclass Cash and CommodityContract
+share: conservation verification per issuer+product group and the
+generate_issue/generate_move/generate_exit builder helpers.  Here it is a
+set of functions parameterised by the state class and command types
+(composition over inheritance; contracts stay plain @contract classes).
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Type
+
+from ..core.contracts import Amount, TransactionVerificationError
+
+
+def verify_fungible(
+    tx,
+    state_cls: Type,
+    issue_cls: Type,
+    move_cls: Type,
+    exit_cls: Type,
+    asset_name: str,
+) -> None:
+    """Group by issued token and check conservation per group (reference
+    OnLedgerAsset.verify semantics, shared by Cash/Commodity):
+
+      Issue: outputs - inputs == issued amount, signed by the issuer
+      Move : inputs == outputs, signed by every input owner
+      Exit : inputs - outputs == exited amount, signed by the issuer
+    """
+    groups = tx.group_states(state_cls, lambda s: s.amount.token)
+    commands = [
+        c for c in tx.commands
+        if isinstance(c.value, (issue_cls, move_cls, exit_cls))
+    ]
+    if not commands:
+        raise TransactionVerificationError(tx.id, f"no {asset_name} command")
+    for group in groups:
+        token = group.grouping_key
+        input_sum = Amount.sum_or_zero((s.amount for s in group.inputs), token)
+        output_sum = Amount.sum_or_zero((s.amount for s in group.outputs), token)
+        matched = False
+        for cmd in commands:
+            if isinstance(cmd.value, issue_cls):
+                if output_sum <= input_sum:
+                    continue
+                issuer_key = token.issuer.party.owning_key
+                if issuer_key not in cmd.signers:
+                    raise TransactionVerificationError(
+                        tx.id, "issue must be signed by the issuer"
+                    )
+                matched = True
+            elif isinstance(cmd.value, move_cls):
+                if input_sum.quantity == 0:
+                    continue
+                if output_sum != input_sum:
+                    raise TransactionVerificationError(
+                        tx.id,
+                        f"{asset_name} not conserved for {token}: "
+                        f"in {input_sum} out {output_sum}",
+                    )
+                owner_keys = {s.owner.owning_key.encoded for s in group.inputs}
+                signer_keys = {
+                    k.encoded for cmd2 in commands for k in cmd2.signers
+                }
+                if not owner_keys <= signer_keys:
+                    raise TransactionVerificationError(
+                        tx.id, "move must be signed by all input owners"
+                    )
+                matched = True
+            elif isinstance(cmd.value, exit_cls):
+                exited = cmd.value.amount
+                if exited.token != token:
+                    continue
+                if input_sum != output_sum + exited:
+                    raise TransactionVerificationError(
+                        tx.id,
+                        f"exit amount mismatch: in {input_sum}, "
+                        f"out {output_sum}, exited {exited}",
+                    )
+                issuer_key = token.issuer.party.owning_key
+                if issuer_key not in cmd.signers:
+                    raise TransactionVerificationError(
+                        tx.id, "exit must be signed by the issuer"
+                    )
+                matched = True
+        if not matched:
+            raise TransactionVerificationError(
+                tx.id, f"no applicable {asset_name} command for group {token}"
+            )
+
+
+def generate_issue(builder, state, issue_command) -> None:
+    """Add an issuance of `state` to the builder (reference
+    OnLedgerAsset.generateIssue): output + Issue command by the issuer."""
+    builder.add_output_state(state)
+    builder.add_command(issue_command, state.issuer.party.owning_key)
+
+
+def generate_exit(
+    builder,
+    exit_amount: Amount,
+    assets: Iterable,
+    make_exit_command: Callable[[Amount], object],
+) -> None:
+    """Consume `assets` (StateAndRefs) and exit `exit_amount`, returning
+    change to the original owner (reference OnLedgerAsset.generateExit)."""
+    assets = list(assets)
+    if not assets:
+        raise ValueError("no assets to exit from")
+    token = exit_amount.token
+    total = 0
+    signers = [token.issuer.party.owning_key]
+    for sr in assets:
+        if sr.state.data.amount.token != token:
+            raise ValueError("asset token mismatch")
+        builder.add_input_state(sr)
+        total += sr.state.data.amount.quantity
+        signers.append(sr.state.data.owner.owning_key)
+    if total < exit_amount.quantity:
+        raise ValueError("insufficient assets to exit")
+    change = total - exit_amount.quantity
+    if change:
+        owner = assets[0].state.data.owner
+        builder.add_output_state(
+            assets[0].state.data.__class__(
+                amount=Amount(change, token), owner=owner
+            )
+        )
+    builder.add_command(make_exit_command(exit_amount), *signers)

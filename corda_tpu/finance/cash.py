@@ -75,73 +75,15 @@ class CashState(OwnableState):
 @contract(name="corda_tpu.finance.Cash")
 class Cash(Contract):
     def verify(self, tx) -> None:
-        groups = tx.group_states(CashState, lambda s: s.amount.token)
-        commands = [
-            c for c in tx.commands
-            if isinstance(c.value, (CashCommand.Issue, CashCommand.Move,
-                                    CashCommand.Exit))
-        ]
-        if not commands:
-            raise TransactionVerificationError(tx.id, "no cash command")
-        for group in groups:
-            token = group.grouping_key
-            input_sum = Amount.sum_or_zero(
-                (s.amount for s in group.inputs), token
-            )
-            output_sum = Amount.sum_or_zero(
-                (s.amount for s in group.outputs), token
-            )
-            matched = False
-            for cmd in commands:
-                if isinstance(cmd.value, CashCommand.Issue):
-                    if output_sum <= input_sum:
-                        continue
-                    issuer_key = token.issuer.party.owning_key
-                    if issuer_key not in cmd.signers:
-                        raise TransactionVerificationError(
-                            tx.id, "issue must be signed by the issuer"
-                        )
-                    matched = True
-                elif isinstance(cmd.value, CashCommand.Move):
-                    if input_sum.quantity == 0:
-                        continue
-                    if output_sum != input_sum:
-                        raise TransactionVerificationError(
-                            tx.id,
-                            f"cash not conserved for {token}: "
-                            f"in {input_sum} out {output_sum}",
-                        )
-                    owner_keys = {
-                        s.owner.owning_key.encoded for s in group.inputs
-                    }
-                    signer_keys = {
-                        k.encoded for cmd2 in commands for k in cmd2.signers
-                    }
-                    if not owner_keys <= signer_keys:
-                        raise TransactionVerificationError(
-                            tx.id, "move must be signed by all input owners"
-                        )
-                    matched = True
-                elif isinstance(cmd.value, CashCommand.Exit):
-                    exited = cmd.value.amount
-                    if exited.token != token:
-                        continue
-                    if input_sum != output_sum + exited:
-                        raise TransactionVerificationError(
-                            tx.id,
-                            f"exit amount mismatch: in {input_sum}, "
-                            f"out {output_sum}, exited {exited}",
-                        )
-                    issuer_key = token.issuer.party.owning_key
-                    if issuer_key not in cmd.signers:
-                        raise TransactionVerificationError(
-                            tx.id, "exit must be signed by the issuer"
-                        )
-                    matched = True
-            if not matched:
-                raise TransactionVerificationError(
-                    tx.id, f"no cash command matched group {token}"
-                )
+        # Conservation rules live in the shared OnLedgerAsset core
+        # (finance/asset.py), as in the reference where Cash extends
+        # OnLedgerAsset (Cash.kt / OnLedgerAsset.kt).
+        from .asset import verify_fungible
+
+        verify_fungible(
+            tx, CashState,
+            CashCommand.Issue, CashCommand.Move, CashCommand.Exit, "cash",
+        )
 
 
 def issued_by(amount: Amount, issuer: PartyAndReference) -> Amount:

@@ -1,0 +1,96 @@
+"""Commodity: fungible on-ledger commodity asset.
+
+Reference parity: `finance/src/main/kotlin/net/corda/contracts/asset/
+CommodityContract.kt` — structurally Cash with a Commodity token instead
+of a currency code; the conservation rules live in the shared
+OnLedgerAsset core (finance/asset.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..core.contracts import (
+    Amount,
+    Contract,
+    OwnableState,
+    TypeOnlyCommandData,
+    contract,
+)
+from ..core.identity import AbstractParty, PartyAndReference
+from ..core.serialization.codec import corda_serializable
+from .asset import generate_exit, generate_issue, verify_fungible
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class Commodity:
+    """A commodity code (reference Commodity: commodityCode, displayName,
+    defaultFractionDigits)."""
+
+    commodity_code: str
+    display_name: str = ""
+    default_fraction_digits: int = 0
+
+
+class CommodityCommand:
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Issue(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Move(TypeOnlyCommandData):
+        pass
+
+    @corda_serializable
+    @dataclass(frozen=True)
+    class Exit:
+        amount: Amount
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class CommodityState(OwnableState):
+    """Amount of an issued commodity owned by a party (reference
+    CommodityContract.State)."""
+
+    amount: Amount = None  # Amount[Issued[Commodity]]
+    owner: AbstractParty = None
+    contract_name = "corda_tpu.finance.Commodity"
+
+    @property
+    def participants(self) -> List[AbstractParty]:
+        return [self.owner]
+
+    def with_new_owner(self, new_owner: AbstractParty) -> "CommodityState":
+        return CommodityState(amount=self.amount, owner=new_owner)
+
+    def move_command(self):
+        return CommodityCommand.Move()
+
+    @property
+    def issuer(self) -> PartyAndReference:
+        return self.amount.token.issuer
+
+
+@contract(name="corda_tpu.finance.Commodity")
+class CommodityContract(Contract):
+    def verify(self, tx) -> None:
+        verify_fungible(
+            tx, CommodityState,
+            CommodityCommand.Issue, CommodityCommand.Move,
+            CommodityCommand.Exit, "commodity",
+        )
+
+    @staticmethod
+    def generate_issue(builder, state: CommodityState) -> None:
+        generate_issue(builder, state, CommodityCommand.Issue())
+
+    @staticmethod
+    def generate_exit(builder, exit_amount: Amount, assets) -> None:
+        generate_exit(
+            builder, exit_amount, assets,
+            lambda amt: CommodityCommand.Exit(amt),
+        )

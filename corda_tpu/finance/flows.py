@@ -18,7 +18,7 @@ from ..core.flows import (
     startable_by_rpc,
 )
 from ..core.identity import Party, PartyAndReference
-from ..core.serialization.codec import register_adapter
+from ..core.serialization.codec import corda_serializable, register_adapter
 from ..core.transactions import TransactionBuilder
 from ..core.transactions.signed import SignedTransaction
 from .cash import CashCommand, CashState, issued_by
@@ -292,3 +292,100 @@ class BuyerFlow(FlowLogic):
             info.asset.state.data.owner.owning_key,
         )
         return self.service_hub.sign_initial_transaction(builder)
+
+
+# ---------------------------------------------------------------------------
+# TwoPartyDealFlow (reference finance/.../TwoPartyDealFlow.kt)
+# ---------------------------------------------------------------------------
+
+@corda_serializable
+@dataclass(frozen=True)
+class Handshake:
+    """First message: the deal payload + the primary's signing key
+    (reference TwoPartyDealFlow.Handshake)."""
+
+    payload: object
+    public_key: object  # PublicKey
+
+
+class TwoPartyDealFlow:
+    """Bilateral deal agreement: the Primary proposes a deal payload, the
+    Secondary builds+signs the agreement transaction, the Primary
+    counter-signs after its `check_proposal` hook, the Secondary
+    finalises, and the Primary waits for the ledger commit.
+
+    The reference splits signature collection into CollectSignaturesFlow;
+    here the swap happens inside the one deal session (our flow framework
+    keys responder registration per initiating class)."""
+
+    @initiating_flow
+    class Primary(FlowLogic):
+        """Proposer (reference TwoPartyDealFlow.Primary). Subclass with
+        @initiating_flow (each concrete deal flow registers itself, as in
+        the reference) and override `check_proposal`."""
+
+        def __init__(self, other_party: Party, payload, my_key=None):
+            self.other_party = other_party
+            self.payload = payload
+            self.my_key = my_key
+
+        def check_proposal(self, stx) -> None:
+            """MUST be implemented: decide whether the counterparty-built
+            agreement is acceptable before counter-signing (the reference's
+            abstract checkProposal). A no-op default would let a malicious
+            responder assemble a transaction spending this party's states
+            and have it blindly signed."""
+            raise NotImplementedError
+
+        def call(self):
+            hub = self.service_hub
+            key = self.my_key or hub.my_info.owning_key
+            stx = yield self.send_and_receive(
+                self.other_party, Handshake(self.payload, key), object
+            )
+            stx.check_signatures_are_valid()
+            self.check_proposal(stx)
+            my_keys = hub.key_management_service.keys
+            to_sign = [
+                k for k in stx.tx.required_signing_keys
+                if k.encoded in my_keys
+            ]
+            if not to_sign:
+                raise FlowException("deal does not require our signature")
+            sig = hub.key_management_service.sign(stx.id.bytes, to_sign[0])
+            tx_id = yield self.send_and_receive(self.other_party, sig, object)
+            stx = yield self.wait_for_ledger_commit(tx_id)
+            return stx
+
+    class Secondary(FlowLogic):
+        """Acceptor (reference TwoPartyDealFlow.Secondary). Subclass and
+        implement `validate_handshake` + `assemble_shared_tx`. Register the
+        subclass with @initiated_by(YourPrimary)."""
+
+        def __init__(self, counterparty: Party):
+            self.counterparty = counterparty
+
+        def validate_handshake(self, handshake: Handshake) -> Handshake:
+            raise NotImplementedError
+
+        def assemble_shared_tx(self, handshake: Handshake):
+            """Return a TransactionBuilder for the agreement."""
+            raise NotImplementedError
+
+        def call(self):
+            hub = self.service_hub
+            handshake = yield self.receive(self.counterparty, Handshake)
+            handshake = self.validate_handshake(handshake)
+            builder = self.assemble_shared_tx(handshake)
+            stx = yield self.record(
+                lambda: hub.sign_initial_transaction(builder)
+            )
+            their_sig = yield self.send_and_receive(
+                self.counterparty, stx, object
+            )
+            if not their_sig.is_valid(stx.id.bytes):
+                raise FlowException("counterparty signature invalid")
+            stx = stx.with_additional_signature(their_sig)
+            final = yield from self.sub_flow(FinalityFlow(stx))
+            yield self.send(self.counterparty, final.id)
+            return final

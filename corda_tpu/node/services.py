@@ -51,6 +51,25 @@ class IdentityService:
     def party_from_name(self, name: str) -> Optional[Party]:
         return self._by_name.get(name)
 
+    def register_anonymous_identity(self, anonymous_key: PublicKey,
+                                    well_known: Party) -> None:
+        """Map a confidential (fresh) key to its well-known party — the
+        registry half of the confidential-identities exchange (reference
+        IdentityService.registerAnonymousIdentity).
+
+        A key already mapped to a DIFFERENT party is never rebound: a peer
+        could otherwise claim another party's well-known key as its
+        "fresh" key and poison every subsequent party_from_key resolution.
+        """
+        with self._lock:
+            current = self._by_key.get(anonymous_key.encoded)
+            if current is not None and current.name != well_known.name:
+                raise ValueError(
+                    f"key already mapped to {current.name}; refusing to "
+                    f"rebind to {well_known.name}"
+                )
+            self._by_key[anonymous_key.encoded] = well_known
+
     def party_from_anonymous(self, party) -> Optional[Party]:
         if isinstance(party, Party):
             return party

@@ -175,3 +175,56 @@ def test_encodings_roundtrip():
         assert e.from_base58(e.to_base58(data)) == data
         assert e.from_base64(e.to_base64(data)) == data
         assert e.from_hex(e.to_hex(data)) == data
+
+
+@pytest.mark.slow
+class TestSphincs256:
+    """SPHINCS-256 (scheme id 5): full WOTS+/HORST hypertree implementation
+    (reference Crypto.kt:134-151 binds BouncyCastle PQC; structure and
+    parameter set are the parity surface here)."""
+
+    def test_sign_verify_roundtrip_via_hub(self):
+        from corda_tpu.core.crypto import crypto as c
+
+        kp = c.generate_keypair(c.SUPPORTED_SIGNATURE_SCHEMES["SPHINCS-256_SHA512"])
+        sig = c.do_sign(kp.private, b"post-quantum payload")
+        assert c.do_verify(kp.public, sig, b"post-quantum payload")
+        assert c.is_valid(kp.public, sig, b"post-quantum payload")
+
+    def test_tamper_rejection_classes(self):
+        from corda_tpu.core.crypto import sphincs
+
+        kp = sphincs.generate_keypair(b"\x11" * 32)
+        msg = b"m" * 100
+        sig = sphincs.sign(kp.private, msg)
+        assert sphincs.verify(kp.public, sig, msg)
+        # wrong message
+        assert not sphincs.verify(kp.public, sig, msg + b"!")
+        # flipped bits in every structural region of the signature
+        for pos in (5, 40, 1000, 18000, 44000):
+            bad = sig[:pos] + bytes([sig[pos] ^ 1]) + sig[pos + 1:]
+            assert not sphincs.verify(kp.public, bad, msg), pos
+        # truncation / garbage
+        assert not sphincs.verify(kp.public, sig[:-1], msg)
+        assert not sphincs.verify(kp.public, b"", msg)
+        # wrong key
+        other = sphincs.generate_keypair(b"\x12" * 32)
+        assert not sphincs.verify(other.public, sig, msg)
+
+    def test_deterministic_and_distinct(self):
+        from corda_tpu.core.crypto import sphincs
+
+        kp = sphincs.generate_keypair(b"\x13" * 32)
+        s1 = sphincs.sign(kp.private, b"a")
+        s2 = sphincs.sign(kp.private, b"a")
+        s3 = sphincs.sign(kp.private, b"b")
+        assert s1 == s2          # stateless deterministic signing
+        assert s1 != s3
+        assert len(s1) == sphincs.SIGNATURE_SIZE
+
+    def test_keypair_from_fixed_seed_is_stable(self):
+        from corda_tpu.core.crypto import sphincs
+
+        a = sphincs.generate_keypair(b"\x14" * 32)
+        b = sphincs.generate_keypair(b"\x14" * 32)
+        assert a.public.encoded == b.public.encoded

@@ -5,7 +5,7 @@ import pytest
 
 from corda_tpu.core.contracts import Amount, Issued, StateAndRef, StateRef, TimeWindow
 from corda_tpu.core.crypto import crypto
-from corda_tpu.core.identity import Party
+from corda_tpu.core.identity import Party, PartyAndReference
 from corda_tpu.core.transactions import TransactionBuilder
 from corda_tpu.finance import (
     Cash,
@@ -307,3 +307,222 @@ class TestObligation:
         # Regression: one 100-cash output must not settle two 100-obligations.
         with pytest.raises(Exception, match="settlement must pay"):
             self._settle_ltx(2, cash_paid=100).verify()
+
+
+class TestCommodity:
+    def setup_method(self):
+        from corda_tpu.finance.commodity import Commodity
+
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.trader = self.net.create_node("O=Trader,L=London,C=GB")
+        self.gold = Commodity("XAU", "Gold", 3)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def test_issue_move_exit_conservation(self):
+        from corda_tpu.core.contracts import Issued
+        from corda_tpu.finance.commodity import (
+            CommodityCommand,
+            CommodityContract,
+            CommodityState,
+        )
+
+        me = self.trader.info
+        token = Issued(PartyAndReference(me, b"c1"), self.gold)
+        # issue 100 XAU
+        b = TransactionBuilder(notary=self.notary.info)
+        CommodityContract.generate_issue(
+            b, CommodityState(amount=Amount(100, token), owner=me)
+        )
+        stx = self.trader.services.sign_initial_transaction(b)
+        self.trader.services.record_transactions([stx])
+        ref = stx.tx.out_ref(0)
+        # exit 40, change 60 back
+        b2 = TransactionBuilder(notary=self.notary.info)
+        CommodityContract.generate_exit(b2, Amount(40, token), [ref])
+        stx2 = self.trader.services.sign_initial_transaction(b2)
+        ltx = stx2.tx.to_ledger_transaction(
+            resolve_state=self.trader.services.load_state,
+            resolve_attachment=self.trader.services.open_attachment,
+            resolve_party=self.trader.services.party_from_key,
+        )
+        ltx.verify()  # conservation holds
+        self.trader.services.record_transactions([stx2])
+        remaining = self.trader.services.vault_service.unconsumed_states(
+            CommodityState.contract_name
+        )
+        assert len(remaining) == 1
+        assert remaining[0].state.data.amount.quantity == 60
+
+    def test_unbalanced_move_rejected(self):
+        from corda_tpu.core.contracts import Issued, StateRef
+        from corda_tpu.core.crypto.secure_hash import SecureHash
+        from corda_tpu.finance.commodity import (
+            CommodityCommand,
+            CommodityState,
+        )
+
+        me = self.trader.info
+        token = Issued(PartyAndReference(me, b"c1"), self.gold)
+        fake_ref = StateRef(SecureHash.sha256(b"x"), 0)
+        from corda_tpu.core.contracts import TransactionState
+
+        ts = TransactionState(
+            CommodityState(amount=Amount(100, token), owner=me),
+            self.notary.info,
+        )
+        b = TransactionBuilder(notary=self.notary.info)
+        b.add_input_state(StateAndRef(ts, fake_ref))
+        b.add_output_state(
+            CommodityState(amount=Amount(90, token), owner=me)
+        )
+        b.add_command(CommodityCommand.Move(), me.owning_key)
+        wtx = b.to_wire_transaction()
+        ltx = wtx.to_ledger_transaction(
+            resolve_state=lambda r: ts,
+            resolve_attachment=None,
+            resolve_party=lambda k: None,
+        )
+        with pytest.raises(Exception, match="not conserved"):
+            ltx.verify()
+
+
+class TestTwoPartyDealFlow:
+    def test_deal_agreed_and_committed_both_sides(self):
+        from corda_tpu.core.flows import (
+            FlowException,
+            initiated_by,
+            initiating_flow,
+        )
+        from corda_tpu.finance.flows import Handshake, TwoPartyDealFlow
+
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        a = net.create_node("O=Dealer A,L=London,C=GB")
+        b = net.create_node("O=Dealer B,L=Paris,C=FR")
+
+        # Deal-specific subclasses (the reference pattern: Instigator/
+        # Acceptor specialise Primary/Secondary per deal type).
+        @initiating_flow
+        class ProposeDeal(TwoPartyDealFlow.Primary):
+            def check_proposal(self, stx):
+                if not stx.tx.outputs:
+                    raise FlowException("empty deal")
+
+        notary_info = notary.info
+
+        @initiated_by(ProposeDeal)
+        class AcceptDeal(TwoPartyDealFlow.Secondary):
+            def validate_handshake(self, handshake):
+                if handshake.payload != "interest rate swap":
+                    raise FlowException("unknown deal type")
+                return handshake
+
+            def assemble_shared_tx(self, handshake):
+                builder = TransactionBuilder(notary=notary_info)
+                builder.add_output_state(
+                    _deal_state(
+                        (self.counterparty, self.service_hub.my_info)
+                    )
+                )
+                builder.add_command(
+                    _DealCmd(), handshake.public_key,
+                    self.service_hub.my_info.owning_key,
+                )
+                return builder
+
+        h = a.start_flow(ProposeDeal(b.info, "interest rate swap"))
+        net.run_network()
+        stx = h.result.result(timeout=5)
+        # both parties recorded the deal
+        for node in (a, b):
+            assert node.services.validated_transactions.get(stx.id) is not None
+        net.stop_nodes()
+
+
+def _deal_state(parties):
+    from dataclasses import dataclass as _dc
+
+    return _TestDealState(parties=tuple(parties))
+
+
+from dataclasses import dataclass as _dataclass2  # noqa: E402
+from corda_tpu.core.contracts import Contract as _Contract  # noqa: E402
+from corda_tpu.core.contracts import ContractState as _ContractState  # noqa: E402
+from corda_tpu.core.contracts import TypeOnlyCommandData as _TOC  # noqa: E402
+from corda_tpu.core.contracts import contract as _contract  # noqa: E402
+from corda_tpu.core.serialization.codec import (  # noqa: E402
+    corda_serializable as _cs,
+)
+
+
+@_cs
+@_dataclass2(frozen=True)
+class _TestDealState(_ContractState):
+    parties: tuple = ()
+    contract_name = "TestDeal"
+
+    @property
+    def participants(self):
+        return list(self.parties)
+
+
+@_cs
+@_dataclass2(frozen=True)
+class _DealCmd(_TOC):
+    pass
+
+
+@_contract(name="TestDeal")
+class _TestDealContract(_Contract):
+    def verify(self, tx):
+        pass
+
+
+class TestConfidentialIdentities:
+    def test_transaction_key_flow_swaps_fresh_keys(self):
+        from corda_tpu.core.flows import TransactionKeyFlow
+        from corda_tpu.core.identity import AnonymousParty
+
+        net = MockNetwork()
+        a = net.create_node("O=A,L=London,C=GB")
+        b = net.create_node("O=B,L=Paris,C=FR")
+        h = a.start_flow(TransactionKeyFlow(b.info))
+        net.run_network()
+        mapping = h.result.result(timeout=5)
+        anon_b = mapping[b.info]
+        anon_a = mapping[a.info]
+        assert isinstance(anon_b, AnonymousParty)
+        # fresh keys differ from the legal identities
+        assert anon_b.owning_key.encoded != b.info.owning_key.encoded
+        assert anon_a.owning_key.encoded != a.info.owning_key.encoded
+        # each side can resolve the counterparty's anonymous key
+        assert (
+            a.services.identity_service.party_from_anonymous(anon_b) == b.info
+        )
+        assert (
+            b.services.identity_service.party_from_anonymous(anon_a) == a.info
+        )
+        # an outsider cannot (no mapping registered elsewhere)
+        c = net.create_node("O=C,L=NYC,C=US")
+        assert c.services.identity_service.party_from_anonymous(anon_b) is None
+        net.stop_nodes()
+
+    def test_identity_poisoning_refused(self):
+        """A peer claiming another party's well-known key as its 'fresh'
+        confidential key must be refused (round-2 review finding)."""
+        net = MockNetwork()
+        a = net.create_node("O=A,L=London,C=GB")
+        b = net.create_node("O=B,L=Paris,C=FR")
+        m = net.create_node("O=Mallory,L=X,C=US")
+        with pytest.raises(ValueError, match="refusing to rebind"):
+            a.services.identity_service.register_anonymous_identity(
+                b.info.owning_key, m.info
+            )
+        # resolution unchanged
+        assert a.services.identity_service.party_from_key(
+            b.info.owning_key
+        ) == b.info
+        net.stop_nodes()

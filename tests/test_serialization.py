@@ -140,3 +140,111 @@ def test_leaf_index_with_collapsed_subtrees():
     pmt3 = PartialMerkleTree.build(tree, [ls[3], ls[5]])
     assert pmt3.leaf_index(ls[3]) == 3
     assert pmt3.leaf_index(ls[5]) == 5
+
+
+class TestAttachmentContractLoading:
+    """Attachment-delivered contract code (reference
+    AttachmentsClassLoader.kt:23-40): load, resolve by name, reject
+    overlapping paths."""
+
+    CONTRACT_SRC = b"""
+from dataclasses import dataclass
+from typing import List
+
+from corda_tpu.core.contracts import Contract, ContractState, contract
+from corda_tpu.core.serialization.codec import corda_serializable
+
+
+@corda_serializable
+@dataclass(frozen=True)
+class ShippedState(ContractState):
+    n: int = 1
+    contract_name = "shipped.Demo"
+
+    @property
+    def participants(self) -> List:
+        return []
+
+
+@contract(name="shipped.Demo")
+class ShippedContract(Contract):
+    def verify(self, tx) -> None:
+        pass
+"""
+
+    @staticmethod
+    def _zip(entries):
+        import io
+        import zipfile
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for name, content in entries.items():
+                zf.writestr(name, content)
+        return buf.getvalue()
+
+    def test_load_and_resolve(self):
+        from corda_tpu.core.contracts.structures import resolve_contract
+        from corda_tpu.core.serialization.attachments_loader import (
+            load_contracts_from_attachments,
+        )
+
+        blob = self._zip({"contracts/demo.py": self.CONTRACT_SRC})
+        new = load_contracts_from_attachments([blob])
+        assert "shipped.Demo" in new
+        assert resolve_contract("shipped.Demo") is not None
+        # identical re-load is a no-op
+        assert load_contracts_from_attachments([blob]) == []
+
+    def test_overlap_rejected(self):
+        from corda_tpu.core.serialization.attachments_loader import (
+            OverlappingAttachments,
+            load_contracts_from_attachments,
+        )
+
+        a = self._zip({"contracts/overlap_case.py": b"X = 1\n"})
+        b = self._zip({"contracts/overlap_case.py": b"X = 2\n"})
+        with pytest.raises(OverlappingAttachments):
+            load_contracts_from_attachments([a, b])
+
+    def test_bad_zip_rejected(self):
+        from corda_tpu.core.serialization.attachments_loader import (
+            AttachmentLoadError,
+            load_contracts_from_attachments,
+        )
+
+        with pytest.raises(AttachmentLoadError):
+            load_contracts_from_attachments([b"not a zip"])
+
+    def test_partial_load_rolls_back(self):
+        from corda_tpu.core.contracts.structures import _CONTRACT_REGISTRY
+        from corda_tpu.core.serialization.attachments_loader import (
+            AttachmentLoadError,
+            load_contracts_from_attachments,
+        )
+
+        good = (
+            b"from corda_tpu.core.contracts import Contract, contract\n"
+            b"@contract(name='rollback.Demo')\n"
+            b"class C(Contract):\n"
+            b"    def verify(self, tx): pass\n"
+        )
+        bad = b"raise RuntimeError('boom')\n"
+        blob = self._zip({
+            "a/ok_module.py": good,
+            "b/explodes.py": bad,
+        })
+        with pytest.raises(AttachmentLoadError):
+            load_contracts_from_attachments([blob])
+        assert "rollback.Demo" not in _CONTRACT_REGISTRY
+
+    def test_same_path_different_txs_allowed(self):
+        from corda_tpu.core.serialization.attachments_loader import (
+            load_contracts_from_attachments,
+        )
+
+        a = self._zip({"contracts/contract.py": b"A1 = 1\n"})
+        b = self._zip({"contracts/contract.py": b"A2 = 2\n"})
+        # separate calls = separate transactions: both load fine
+        load_contracts_from_attachments([a])
+        load_contracts_from_attachments([b])
