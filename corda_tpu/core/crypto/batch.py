@@ -27,6 +27,30 @@ USE_DEVICE_KERNELS = True
 # cryptography) beats device dispatch+compile amortization.
 MIN_DEVICE_BATCH = 32
 
+# Device-mesh routing (SURVEY §2.10 axis 2: shard the batch across chips).
+# When a mesh is configured and an ed25519 bucket reaches MESH_MIN_BATCH,
+# verification shards across the mesh via parallel.mesh instead of the
+# single-device kernel. Opt-in: the verifier worker / node config calls
+# configure_mesh() (see corda_tpu.verifier.__main__ --mesh-devices).
+_MESH = None
+_DEFAULT_MESH_MIN_BATCH = 2048
+MESH_MIN_BATCH = _DEFAULT_MESH_MIN_BATCH
+
+
+def configure_mesh(mesh, min_batch: int | None = None) -> None:
+    """Route large ed25519 buckets through `mesh` (None disables and
+    restores the default threshold)."""
+    global _MESH, MESH_MIN_BATCH
+    _MESH = mesh
+    if min_batch is not None:
+        MESH_MIN_BATCH = min_batch
+    elif mesh is None:
+        MESH_MIN_BATCH = _DEFAULT_MESH_MIN_BATCH
+
+
+def configured_mesh():
+    return _MESH
+
 # scheme code name -> ecdsa_batch curve name
 _ECDSA_CURVES = {
     ECDSA_SECP256K1_SHA256.scheme_code_name: "secp256k1",
@@ -68,7 +92,12 @@ def verify_batch(
         sigs = [items[i][1] for i in idx]
         msgs = [items[i][2] for i in idx]
         if name == EDDSA_ED25519_SHA512.scheme_code_name:
-            mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
+            if _MESH is not None and len(idx) >= MESH_MIN_BATCH:
+                from ...parallel.mesh import shard_verify_ed25519
+
+                mask = shard_verify_ed25519(_MESH, pubs, sigs, msgs)
+            else:
+                mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
         else:
             mask = ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
         for j, i in enumerate(idx):

@@ -8,9 +8,7 @@ service records the mapping for its counterparty.
 """
 from __future__ import annotations
 
-from typing import Dict
-
-from ..identity import AbstractParty, AnonymousParty, Party
+from ..identity import AnonymousParty, Party
 from .api import FlowLogic, initiated_by, initiating_flow
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 import hashlib
 import io
 import sys
+import threading
 import types
 import zipfile
 from typing import Dict, List, Tuple
@@ -49,6 +50,10 @@ class OverlappingAttachments(AttachmentLoadError):
 # reference's per-transaction classloader) — two unrelated transactions
 # may legitimately both ship a `contracts/contract.py`.
 _loaded_digests: set = set()
+# One loader at a time: the atomic-rollback bookkeeping snapshots the
+# global contract registry, so concurrent loads from multiple verifier
+# worker threads would roll back each other's registrations.
+_load_lock = threading.Lock()
 
 
 def load_contracts_from_attachments(attachments) -> List[str]:

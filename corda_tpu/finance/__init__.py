@@ -5,8 +5,16 @@ Fungible assets (Cash), CommercialPaper, Obligation, plus the cash flows
 """
 from .cash import Cash, CashCommand, CashState, issued_by
 from .commercial_paper import CommercialPaper, CommercialPaperState, CPCommand
+from .commodity import (
+    Commodity,
+    CommodityCommand,
+    CommodityContract,
+    CommodityState,
+)
 from .flows import (
     BuyerFlow,
+    Handshake,
+    TwoPartyDealFlow,
     CashExitFlow,
     CashIssueFlow,
     CashPaymentFlow,
@@ -24,4 +32,6 @@ __all__ = [
     "InsufficientBalanceError", "SellerFlow", "SellerTradeInfo",
     "generate_spend",
     "Obligation", "ObligationCommand", "ObligationState",
+    "Commodity", "CommodityCommand", "CommodityContract", "CommodityState",
+    "Handshake", "TwoPartyDealFlow",
 ]

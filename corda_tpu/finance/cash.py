@@ -16,10 +16,8 @@ from typing import List
 from ..core.contracts import (
     Amount,
     Contract,
-    ContractState,
     Issued,
     OwnableState,
-    TransactionVerificationError,
     TypeOnlyCommandData,
     contract,
 )

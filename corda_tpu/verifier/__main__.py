@@ -32,6 +32,7 @@ _DEFAULTS = {
     "name": "verifier",
     "workers": 1,
     "jax_platform": None,  # e.g. "cpu" to force the CPU backend
+    "mesh_devices": 0,      # >0: shard big batches across this many devices
 }
 
 
@@ -51,10 +52,11 @@ def main(argv=None) -> int:
     ap.add_argument("--name")
     ap.add_argument("--workers", type=int)
     ap.add_argument("--jax-platform", dest="jax_platform")
+    ap.add_argument("--mesh-devices", dest="mesh_devices", type=int)
     args = ap.parse_args(argv)
 
     cfg = _load_config(args.config_dir) if args.config_dir else dict(_DEFAULTS)
-    for key in ("connect", "name", "workers", "jax_platform"):
+    for key in ("connect", "name", "workers", "jax_platform", "mesh_devices"):
         val = getattr(args, key)
         if val is not None:
             cfg[key] = val
@@ -68,6 +70,14 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", cfg["jax_platform"])
+
+    if int(cfg.get("mesh_devices") or 0) > 0:
+        # Shard large signature batches across a device mesh
+        # (SURVEY §2.10: pmap/shard_map across the chips of a pod slice).
+        from ..core.crypto import batch as crypto_batch
+        from ..parallel.mesh import data_mesh
+
+        crypto_batch.configure_mesh(data_mesh(int(cfg["mesh_devices"])))
 
     from ..messaging.net import RemoteBroker
     from .worker import VerifierWorker

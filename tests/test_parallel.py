@@ -1,4 +1,5 @@
 """Mesh-sharded verification tests (8 virtual CPU devices via conftest)."""
+import pytest
 import numpy as np
 
 from corda_tpu.core.crypto import ed25519_math
@@ -53,3 +54,53 @@ def test_distributed_verifier_wrapper():
     out = dv.verify_ed25519(pubs, sigs, msgs)
     assert out[5] is False
     assert all(out[:5] + out[6:])
+
+
+@pytest.mark.slow
+class TestMeshProductionPath:
+    """The mesh wired into the PRODUCTION batching path (VERDICT round-1
+    #4): configure_mesh routes large ed25519 buckets in
+    core.crypto.batch.verify_batch through parallel.mesh, which is what
+    the SignatureBatcher -> verifier service -> notary stack uses.
+
+    Firehose size: 8x256 by default (CPU virtual devices verify ~100
+    sigs/s total — the full >=100k firehose is for real chips; set
+    CORDA_TPU_FIREHOSE to run it here)."""
+
+    def test_batcher_routes_through_mesh_with_tampering(self):
+        import os
+
+        from corda_tpu.core.crypto import batch as crypto_batch
+        from corda_tpu.core.crypto import crypto
+        from corda_tpu.core.crypto.keys import SchemePublicKey
+        from corda_tpu.parallel import data_mesh
+        from corda_tpu.verifier import (
+            InMemoryTransactionVerifierService,
+            SignatureBatcher,
+        )
+
+        n = int(os.environ.get("CORDA_TPU_FIREHOSE", 8 * 256))
+        mesh = data_mesh(8)
+        crypto_batch.configure_mesh(mesh, min_batch=512)
+        try:
+            kp = crypto.entropy_to_keypair(31337)
+            content = b"notary uniqueness batch row"
+            sig = crypto.do_sign(kp.private, content)
+            items = [(kp.public, sig, content)] * n
+            # tamper known positions (first, middle, last)
+            bad_positions = {0, n // 2, n - 1}
+            items = [
+                (kp.public, sig, b"forged") if i in bad_positions else it
+                for i, it in enumerate(items)
+            ]
+            svc = InMemoryTransactionVerifierService(
+                batcher=SignatureBatcher(max_batch=n)
+            )
+            futures = svc.verify_signatures(items)
+            svc._batcher.flush()
+            results = [f.result(timeout=600) for f in futures]
+            for i, ok in enumerate(results):
+                assert ok == (i not in bad_positions), i
+            svc.stop()
+        finally:
+            crypto_batch.configure_mesh(None)
