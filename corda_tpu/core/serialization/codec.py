@@ -79,6 +79,9 @@ def corda_serializable(cls=None, *, name: str | None = None):
         def from_dict(d):
             return c(**d)
 
+        # wire fields == attribute names, so the schema-evolution layer may
+        # apply field-level add/drop rules (evolution.py)
+        from_dict.__evolvable__ = True
         register_adapter(c, type_name, to_dict, from_dict)
         return c
 
@@ -217,7 +220,13 @@ def _lookup_type(cls: Type):
 
 # --- decode -----------------------------------------------------------------
 
-def _decode(data: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
+def _decode(
+    data: bytes, pos: int, depth: int = 0, obj_hook=None
+) -> Tuple[Any, int]:
+    """obj_hook(type_name, fields) -> object, when given, replaces the strict
+    whitelist construction of OBJ values — the seam the schema-evolution
+    layer (evolution.py) plugs into. The default (None) path is the
+    consensus-critical strict behavior and must stay byte-for-byte stable."""
     if depth > _MAX_DEPTH:
         raise SerializationError(f"nesting deeper than {_MAX_DEPTH}")
     if pos >= len(data):
@@ -251,15 +260,15 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
         n, pos = _read_uvarint(data, pos)
         out = []
         for _ in range(n):
-            item, pos = _decode(data, pos, depth + 1)
+            item, pos = _decode(data, pos, depth + 1, obj_hook)
             out.append(item)
         return out, pos
     if tag == _MAP:
         n, pos = _read_uvarint(data, pos)
         d = {}
         for _ in range(n):
-            k, pos = _decode(data, pos, depth + 1)
-            v, pos = _decode(data, pos, depth + 1)
+            k, pos = _decode(data, pos, depth + 1, obj_hook)
+            v, pos = _decode(data, pos, depth + 1, obj_hook)
             if isinstance(k, list):
                 k = tuple(k)
             d[k] = v
@@ -268,19 +277,23 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
         ln, pos = _read_uvarint(data, pos)
         type_name = data[pos : pos + ln].decode("utf-8")
         pos += ln
-        entry = _BY_NAME.get(type_name)
-        if entry is None:
-            raise SerializationError(f"type {type_name!r} not in deserialization whitelist")
-        _, _, from_dict = entry
+        if obj_hook is None:
+            entry = _BY_NAME.get(type_name)
+            if entry is None:
+                raise SerializationError(
+                    f"type {type_name!r} not in deserialization whitelist"
+                )
         n, pos = _read_uvarint(data, pos)
         fields = {}
         for _ in range(n):
             fl, pos = _read_uvarint(data, pos)
             fn = data[pos : pos + fl].decode("utf-8")
             pos += fl
-            fields[fn], pos = _decode(data, pos, depth + 1)
+            fields[fn], pos = _decode(data, pos, depth + 1, obj_hook)
+        if obj_hook is not None:
+            return obj_hook(type_name, fields), pos
         try:
-            return from_dict(fields), pos
+            return entry[2](fields), pos
         except TypeError as e:
             raise SerializationError(f"cannot construct {type_name}: {e}") from e
     raise SerializationError(f"unknown tag {tag}")

@@ -21,6 +21,7 @@ from ..core.crypto.keys import KeyPair, PublicKey
 from ..core.crypto.secure_hash import SecureHash
 from ..core.identity import AnonymousParty, Party
 from ..core.serialization.codec import deserialize, serialize
+from ..utils.metrics import MonitoringService
 from . import vault_query as _vault_query  # noqa: F401 — registers codec adapters
 from .database import (
     AttachmentStorage,
@@ -436,6 +437,7 @@ class ServiceHub:
 
         self.my_info = my_info
         self.db = db
+        self.monitoring = MonitoringService()
         self.identity_service = IdentityService()
         self.key_management_service = KeyManagementService(
             db, initial_keys=[legal_identity_key]

@@ -39,6 +39,7 @@ from ..core.flows.api import (
 )
 from ..core.identity import Party
 from ..core.serialization.codec import deserialize, serialize
+from ..utils.metrics import MetricRegistry
 from .session import (
     SESSION_TOPIC,
     FlowSession,
@@ -420,6 +421,7 @@ class FlowStateMachine:
         )
         self.smm.checkpoint_storage.put(self.flow_id, blob)
         self.smm.checkpoints_written += 1
+        self.smm.metrics.meter("Flows.CheckpointingRate").mark()
 
 
 class StateMachineManager:
@@ -436,6 +438,12 @@ class StateMachineManager:
         self._initiated_dedup: Dict[Tuple[str, str], str] = {}  # (peer, init_id) -> local id
         self._ledger_waiters: Dict[Any, List[FlowStateMachine]] = {}
         self.checkpoints_written = 0
+        # Key metric names mirror the reference (StateMachineManager.kt:127-133)
+        self.metrics = (
+            getattr(getattr(service_hub, "monitoring", None), "metrics", None)
+            or MetricRegistry()
+        )
+        self.metrics.gauge("Flows.InFlight", lambda: self.in_flight_count)
         self._changes: List[Callable] = []  # observers: fn(event, fsm)
         # Node-local responder registrations override the global registry
         # (reference: registerInitiatedFlows is per-node, AbstractNode.kt:291)
@@ -471,6 +479,16 @@ class StateMachineManager:
     def track(self, observer: Callable) -> None:
         """observer(event: str, fsm) on started/finished."""
         self._changes.append(observer)
+
+    def kill_flow(self, flow_id: str) -> bool:
+        """Forcibly fail a live flow (reference CordaRPCOps.killFlow):
+        peers get a SessionEnd carrying the error, the checkpoint is
+        dropped, and the caller's future raises FlowKilledException."""
+        fsm = self.flows.get(flow_id)
+        if fsm is None or fsm.done:
+            return False
+        fsm._fail(FlowException(f"flow {flow_id} killed via RPC"))
+        return True
 
     def register_initiated_flow(self, initiator_cls, responder_cls) -> None:
         """Node-local responder for an initiating flow (overrides the global
@@ -664,5 +682,9 @@ class StateMachineManager:
         self._notify("finished", fsm)
 
     def _notify(self, event: str, fsm: FlowStateMachine) -> None:
+        if event == "started" or event == "restored":
+            self.metrics.meter("Flows.Started").mark()
+        elif event == "finished":
+            self.metrics.meter("Flows.Finished").mark()
         for obs in self._changes:
             obs(event, fsm)

@@ -149,3 +149,47 @@ class CordaRPCOps:
 
     def current_node_time(self) -> float:
         return self._services.clock()
+
+    # -- flow control ---------------------------------------------------------
+
+    def kill_flow(self, flow_id: str) -> bool:
+        """Best-effort flow termination (reference CordaRPCOps.killFlow):
+        fails the flow's future with a FlowException and drops its
+        sessions/checkpoint so no counterparty re-delivery revives it."""
+        return self._smm.kill_flow(flow_id)
+
+    # -- observability --------------------------------------------------------
+
+    def node_metrics(self) -> Dict[str, Any]:
+        """Snapshot of the node's metric registry plus the verifier
+        service's counters (reference: JMX export, `Node.kt:305-310`;
+        verifier metrics `OutOfProcessTransactionVerifierService.kt:33-45`)."""
+        out = dict(self._smm.metrics.snapshot())
+        svc = self._services.transaction_verifier_service
+        m = getattr(svc, "metrics", None)
+        if m is not None:
+            # snapshot under the service's lock: the response-consumer thread
+            # appends to the durations deque concurrently
+            lock = getattr(svc, "_lock", None)
+            if lock is not None:
+                with lock:
+                    durations = sorted(m.durations)
+                    success, failure, in_flight = m.success, m.failure, m.in_flight
+            else:
+                durations = sorted(m.durations)
+                success, failure, in_flight = m.success, m.failure, m.in_flight
+            verifier: Dict[str, Any] = {
+                "type": "verifier",
+                "success": success,
+                "failure": failure,
+                "in_flight": in_flight,
+            }
+            if durations:
+                verifier["p50"] = round(
+                    durations[len(durations) // 2], 6
+                )
+                verifier["p95"] = round(
+                    durations[min(len(durations) - 1, int(0.95 * len(durations)))], 6
+                )
+            out["Verification"] = verifier
+        return out

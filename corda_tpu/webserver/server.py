@@ -13,6 +13,7 @@ Endpoints (reference servlet/resource parity):
   POST /api/attachments                  -> upload, returns hash
   POST /api/flows/{flow_name}            -> start flow (JSON args), returns id
   GET  /api/flows/{flow_id}              -> flow result (blocks briefly)
+  GET  /api/metrics                      -> metric registry snapshot (JSON)
 """
 from __future__ import annotations
 
@@ -115,6 +116,8 @@ class WebServer:
                     "states": list(page.states),
                 },
             )
+        elif path == "/api/metrics":
+            req._json(200, self.ops.node_metrics())
         elif m := re.fullmatch(r"/api/attachments/([0-9A-Fa-f]{64})", path):
             att_id = SecureHash(bytes.fromhex(m.group(1)))
             data = self.ops.open_attachment(att_id)

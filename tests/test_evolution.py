@@ -1,0 +1,227 @@
+"""Schema-evolution serialization tests (reference AMQP evolution +
+class-carpenter suites, `core/src/test/.../serialization/`)."""
+from dataclasses import dataclass, field
+
+import pytest
+
+from corda_tpu.core.serialization import codec
+from corda_tpu.core.serialization.codec import (
+    SerializationError,
+    corda_serializable,
+    deserialize,
+    serialize,
+)
+from corda_tpu.core.serialization.evolution import (
+    deserialize_evolvable,
+    is_synthesized,
+    schema_for,
+    serialize_described,
+)
+
+
+def _swap_registration(type_name, new_cls):
+    """Point an existing wire name at a different local class (simulates a
+    receiver running another version of the type). Returns a restore fn."""
+    old_by_name = codec._BY_NAME[type_name]
+    old_cls = old_by_name[0]
+    old_by_type = codec._BY_TYPE[old_cls]
+
+    fields = [f.name for f in new_cls.__dataclass_fields__.values()]
+
+    def to_dict(obj):
+        return {fn: getattr(obj, fn) for fn in fields}
+
+    def from_dict(d):
+        return new_cls(**d)
+
+    from_dict.__evolvable__ = True  # as @corda_serializable would mark it
+    codec._BY_NAME[type_name] = (new_cls, to_dict, from_dict)
+    codec._BY_TYPE[new_cls] = (type_name, to_dict, from_dict)
+
+    def restore():
+        codec._BY_NAME[type_name] = old_by_name
+        codec._BY_TYPE[old_cls] = old_by_type
+        codec._BY_TYPE.pop(new_cls, None)
+
+    return restore
+
+
+@corda_serializable(name="evo.RoundTrip")
+@dataclass(frozen=True)
+class RoundTrip:
+    a: int
+    b: str = "x"
+
+
+class TestDescribedEnvelope:
+    def test_round_trip(self):
+        v = RoundTrip(3, "hi")
+        blob = serialize_described([v, 7, "s"])
+        assert deserialize_evolvable(blob) == [v, 7, "s"]
+
+    def test_schema_for_captures_defaults(self):
+        sch = schema_for(RoundTrip)
+        assert sch["name"] == "evo.RoundTrip"
+        assert sch["fields"] == ["a", "b"]
+        assert sch["defaults"] == {"b": "x"}
+
+    def test_standard_format_also_accepted(self):
+        v = RoundTrip(1)
+        assert deserialize_evolvable(serialize(v)) == v
+
+    def test_nested_schema_collected_from_later_instances(self):
+        @corda_serializable(name="evo.Inner")
+        @dataclass(frozen=True)
+        class Inner:
+            n: int
+
+        @corda_serializable(name="evo.Outer")
+        @dataclass(frozen=True)
+        class Outer:
+            inner: object = None
+
+        blob = serialize_described([Outer(None), Outer(Inner(5))])
+        schemas, _ = codec._decode(blob, 3)
+        assert "evo.Inner" in schemas and "evo.Outer" in schemas
+
+
+class TestEvolution:
+    def test_wire_extra_field_dropped(self):
+        """Sender newer (has field c); receiver's class lacks it."""
+
+        @corda_serializable(name="evo.Widen")
+        @dataclass(frozen=True)
+        class WidenV2:
+            a: int
+            c: int = 9
+
+        blob = serialize(WidenV2(5, 6))
+
+        @dataclass(frozen=True)
+        class WidenV1:
+            a: int
+
+        restore = _swap_registration("evo.Widen", WidenV1)
+        try:
+            got = deserialize_evolvable(blob)
+            assert got == WidenV1(5)
+            # strict path must keep rejecting it
+            with pytest.raises(SerializationError):
+                deserialize(blob)
+        finally:
+            restore()
+
+    def test_wire_missing_field_filled_from_local_default(self):
+        """Sender older; receiver's class adds a defaulted field."""
+
+        @corda_serializable(name="evo.Narrow")
+        @dataclass(frozen=True)
+        class NarrowV1:
+            a: int
+
+        blob = serialize(NarrowV1(5))
+
+        @dataclass(frozen=True)
+        class NarrowV2:
+            a: int
+            added: str = "default!"
+            lst: tuple = field(default_factory=tuple)
+
+        restore = _swap_registration("evo.Narrow", NarrowV2)
+        try:
+            got = deserialize_evolvable(blob)
+            assert got == NarrowV2(5, "default!", ())
+        finally:
+            restore()
+
+    def test_wire_missing_field_no_default_fails(self):
+        @corda_serializable(name="evo.Hard")
+        @dataclass(frozen=True)
+        class HardV1:
+            a: int
+
+        blob = serialize(HardV1(5))
+
+        @dataclass(frozen=True)
+        class HardV2:
+            a: int
+            required: int  # no default anywhere
+
+        restore = _swap_registration("evo.Hard", HardV2)
+        try:
+            with pytest.raises(SerializationError, match="no default"):
+                deserialize_evolvable(blob)
+        finally:
+            restore()
+
+
+class TestCustomAdapterTypes:
+    def test_renamed_wire_fields_decode_via_adapter(self):
+        """Custom adapters may rename wire fields; the evolvable path must
+        use their from_dict, not dataclass field-matching."""
+        from corda_tpu.rpc.ops import StateMachineInfo
+
+        v = StateMachineInfo("f1", "Flow", False)
+        assert deserialize_evolvable(serialize(v)) == v
+
+
+class TestCarpenter:
+    def test_unknown_type_synthesized(self):
+        @corda_serializable(name="evo.Foreign")
+        @dataclass(frozen=True)
+        class Foreign:
+            x: int
+            y: str
+
+        blob = serialize(Foreign(1, "two"))
+        # simulate a receiver that has never seen the type
+        del codec._BY_NAME["evo.Foreign"]
+        del codec._BY_TYPE[Foreign]
+        got = deserialize_evolvable(blob)
+        assert is_synthesized(got)
+        assert got.x == 1 and got.y == "two"
+        # carpenter registration makes it re-serializable, byte-compatibly
+        assert serialize(got) == blob
+        # and a second decode now uses the synthesized class
+        again = deserialize_evolvable(blob)
+        assert again == got
+        # but the strict (consensus) whitelist must NOT have been widened
+        with pytest.raises(SerializationError, match="whitelist"):
+            deserialize(blob)
+
+    def test_unknown_type_strict_mode_rejects(self):
+        @corda_serializable(name="evo.Foreign2")
+        @dataclass(frozen=True)
+        class Foreign2:
+            x: int
+
+        blob = serialize(Foreign2(1))
+        del codec._BY_NAME["evo.Foreign2"]
+        del codec._BY_TYPE[Foreign2]
+        with pytest.raises(SerializationError, match="whitelist"):
+            deserialize_evolvable(blob, synthesize_unknown=False)
+
+    def test_bad_field_name_rejected(self):
+        # OBJ with a non-identifier field name must not reach make_dataclass
+        out = bytearray(codec._MAGIC)
+        out.append(8)  # _OBJ
+        name = b"evo.Nasty"
+        out.append(len(name))
+        out.extend(name)
+        out.append(1)  # one field
+        fn = b"not an ident"
+        out.append(len(fn))
+        out.extend(fn)
+        out.append(0)  # NULL value
+        with pytest.raises(SerializationError, match="bad field name"):
+            deserialize_evolvable(bytes(out))
+
+
+class TestConsensusPathUnchanged:
+    def test_strict_bytes_stable(self):
+        v = RoundTrip(3, "hi")
+        blob = serialize(v)
+        assert deserialize(blob) == v
+        # described payload embeds the identical value encoding
+        described = serialize_described(v)
+        assert described.endswith(blob[len(codec._MAGIC):])
