@@ -28,6 +28,7 @@ class _InFlight:
     recipient: str  # party name
     topic: str
     payload: bytes
+    due_at: float = 0.0  # clock seconds; 0 = deliverable immediately
 
 
 class InMemoryMessagingNetwork:
@@ -42,6 +43,13 @@ class InMemoryMessagingNetwork:
         # Hook: fn(msg) -> bool keep (False drops the message); used for
         # fault injection in tests.
         self.filter: Optional[Callable[[_InFlight], bool]] = None
+        # Hook: fn(msg) called on every delivery (simulation visualisers).
+        self.observer: Optional[Callable[[_InFlight], None]] = None
+        # Latency injection (reference InMemoryMessagingNetwork
+        # LatencyCalculator, `InMemoryMessagingNetwork.kt:139-144`): with
+        # both set, a message becomes deliverable at clock()+latency(s, r).
+        self.latency: Optional[Callable[[Party, str], float]] = None
+        self.clock: Optional[Callable[[], float]] = None
 
     def create_endpoint(self, me: Party) -> "InMemoryMessaging":
         ep = InMemoryMessaging(self, me)
@@ -54,21 +62,46 @@ class InMemoryMessagingNetwork:
             self._endpoints.pop(name, None)
 
     def _enqueue(self, msg: _InFlight) -> None:
+        if self.latency is not None and self.clock is not None:
+            delay = self.latency(msg.sender, msg.recipient)
+            if delay > 0:
+                msg = _InFlight(
+                    msg.sender, msg.recipient, msg.topic, msg.payload,
+                    due_at=self.clock() + delay,
+                )
         with self._lock:
             self._queue.append(msg)
             self.sent_count += 1
 
+    def next_due(self) -> Optional[float]:
+        """Earliest due_at among undeliverable queued messages (simulation
+        drivers advance their TestClock to this when the network idles)."""
+        with self._lock:
+            future = [m.due_at for m in self._queue if m.due_at > 0]
+        return min(future) if future else None
+
     def pump(self) -> bool:
-        """Deliver exactly one queued message. Returns False when idle."""
+        """Deliver exactly one deliverable queued message. Returns False
+        when idle (messages delayed past the clock don't count as work)."""
         with self._lock:
             if not self._queue:
                 return False
-            msg = self._queue.popleft()
+            now = self.clock() if self.clock is not None else None
+            msg = None
+            for i, m in enumerate(self._queue):
+                if m.due_at == 0.0 or now is None or m.due_at <= now:
+                    msg = m
+                    del self._queue[i]
+                    break
+            if msg is None:
+                return False  # everything queued is delayed into the future
             if self.filter is not None and not self.filter(msg):
                 return True  # dropped by the injector; work was done
             ep = self._endpoints.get(msg.recipient)
         if ep is not None:
             ep._deliver(msg.sender, msg.topic, msg.payload)
+            if self.observer is not None:
+                self.observer(msg)
         with self._lock:
             self.delivered_count += 1
         return True

@@ -39,8 +39,11 @@ class NodeConfiguration:
 class AbstractNode:
     """A node: services + state machine + messaging, one legal identity."""
 
-    def __init__(self, config: NodeConfiguration, messaging_factory, broker=None):
-        """messaging_factory(me: Party) -> MessagingService."""
+    def __init__(self, config: NodeConfiguration, messaging_factory, broker=None,
+                 clock=None):
+        """messaging_factory(me: Party) -> MessagingService.  `clock` is a
+        zero-arg callable returning unix seconds (default time.time);
+        simulations pass a utils.clocks.TestClock (reference TestClock)."""
         self.config = config
         if config.identity_entropy is not None:
             self._identity_key = crypto.entropy_to_keypair(config.identity_entropy)
@@ -53,7 +56,7 @@ class AbstractNode:
         self.network = messaging_factory(self.info)
         verifier = self._make_transaction_verifier_service()
         self.services = ServiceHub(
-            self.info, self.database, verifier, self._identity_key
+            self.info, self.database, verifier, self._identity_key, clock=clock
         )
         self.smm = StateMachineManager(
             self.services, self.network, self.checkpoint_storage, self.info

@@ -340,6 +340,12 @@ class FixingFlow(FlowLogic):
         hub = self.service_hub
         ts = hub.load_state(self.ref)
         irs: InterestRateSwapState = ts.data
+        # Role split (reference TwoPartyDealFlow Fixer/Floater): the state is
+        # relevant to both legs so BOTH nodes' schedulers fire this flow;
+        # only the fixed-leg payer runs the fixing, the other side no-ops
+        # and learns the result through FinalityFlow broadcast.
+        if hub.my_info.name != irs.fixed_leg_payer.name:
+            return None
         oracle = hub.identity_service.party_from_name(irs.oracle_name)
         if oracle is None:
             raise FlowException(f"oracle {irs.oracle_name} not known")

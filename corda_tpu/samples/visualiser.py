@@ -1,0 +1,78 @@
+"""Headless network visualiser (reference `samples/network-visualiser/` —
+the JavaFX map UI is replaced by a terminal/JSONL event renderer over the
+Simulation event stream; the *simulation engine* lives in
+`corda_tpu.testing.simulation`).
+
+Run: python -m corda_tpu.samples.visualiser [--json] [--latency SECONDS]
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional, TextIO
+
+
+class ConsoleVisualiser:
+    """Renders SimulationEvents as aligned text lines or JSONL."""
+
+    def __init__(self, stream: Optional[TextIO] = None, as_json: bool = False):
+        self._stream = stream or sys.stdout
+        self._json = as_json
+        self.counts = {"message": 0, "flow": 0, "progress": 0, "clock": 0}
+
+    def attach(self, simulation) -> None:
+        simulation.events.subscribe(self.on_event)
+
+    @staticmethod
+    def _short(name: str) -> str:
+        # "O=Bank of Breakfast Tea,L=London,C=GB" -> "Bank of Breakfast Tea"
+        for part in name.split(","):
+            if part.startswith("O="):
+                return part[2:]
+        return name
+
+    def on_event(self, ev) -> None:
+        self.counts[ev.kind] = self.counts.get(ev.kind, 0) + 1
+        if self._json:
+            self._stream.write(
+                json.dumps({"kind": ev.kind, **ev.detail}) + "\n"
+            )
+            return
+        d = ev.detail
+        if ev.kind == "message":
+            line = (
+                f"  {self._short(d['from']):>24} ── {d['topic']:<18} ──▶ "
+                f"{self._short(d['to'])}  ({d['bytes']}B)"
+            )
+        elif ev.kind == "flow":
+            line = f"[flow {d['event']:<8}] {self._short(d['node'])}: {d['flow']}"
+        elif ev.kind == "progress":
+            line = f"[progress     ] {self._short(d['node'])}: {d['step']}"
+        else:  # clock
+            line = f"===== clock -> {d['now']:.0f} ====="
+        self._stream.write(line + "\n")
+
+
+def main(argv=None) -> dict:
+    from ..testing.simulation import IRSSimulation
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    latency = None
+    if "--latency" in argv:
+        secs = float(argv[argv.index("--latency") + 1])
+        latency = lambda s, r: secs  # noqa: E731
+    sim = IRSSimulation(latency_seconds=latency)
+    vis = ConsoleVisualiser(as_json=as_json)
+    vis.attach(sim)
+    try:
+        outcome = sim.run()
+    finally:
+        sim.stop()
+    summary = {**outcome, "events": dict(vis.counts)}
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

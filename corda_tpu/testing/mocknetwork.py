@@ -23,10 +23,14 @@ class MockNode(AbstractNode):
 
 
 class MockNetwork:
-    def __init__(self):
+    def __init__(self, default_clock=None):
+        """default_clock: shared zero-arg clock for all nodes (a TestClock
+        makes the whole network deterministic, reference Simulation style);
+        None -> real time per node."""
         self.messaging_network = InMemoryMessagingNetwork()
         self.nodes: List[MockNode] = []
         self._entropy = 1000
+        self.default_clock = default_clock
 
     def _next_entropy(self) -> int:
         self._entropy += 1
@@ -38,6 +42,7 @@ class MockNetwork:
         notary_type: Optional[str] = None,
         db_path: str = ":memory:",
         entropy: Optional[int] = None,
+        clock=None,
     ) -> MockNode:
         config = NodeConfiguration(
             my_legal_name=legal_name,
@@ -45,7 +50,10 @@ class MockNetwork:
             notary_type=notary_type,
             identity_entropy=entropy if entropy is not None else self._next_entropy(),
         )
-        node = MockNode(config, self.messaging_network.create_endpoint)
+        node = MockNode(
+            config, self.messaging_network.create_endpoint,
+            clock=clock or self.default_clock,
+        )
         node.start()
         # Everyone learns about everyone (the reference MockNetwork shares a
         # network map): register the new node with existing ones and vice versa.

@@ -1,0 +1,88 @@
+"""ANSI terminal renderer for ProgressTracker step trees (reference
+`node/.../utilities/ANSIProgressRenderer.kt:1-197` — the reference redraws
+via JAnsi; here plain ANSI escape codes on any TTY-ish stream, degrading to
+line-per-step output when the stream is not a terminal, like the
+reference's log-only fallback).
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, TextIO
+
+_TICK = "✓"  # ✓
+_ARROW = "▶"  # ▶
+_CSI = "\x1b["
+
+
+class ANSIProgressRenderer:
+    """Subscribes to one flow's ProgressTracker and repaints the step tree
+    in place on each change."""
+
+    def __init__(self, stream: Optional[TextIO] = None) -> None:
+        self._stream = stream or sys.stdout
+        self._tracker = None
+        self._painted_lines = 0
+        self._ansi = hasattr(self._stream, "isatty") and self._stream.isatty()
+
+    # -- wiring --------------------------------------------------------------
+
+    @property
+    def progress_tracker(self):
+        return self._tracker
+
+    @progress_tracker.setter
+    def progress_tracker(self, tracker) -> None:
+        self._tracker = tracker
+        if tracker is not None:
+            tracker.subscribe(lambda _label: self.render())
+            self.render()
+
+    # -- painting ------------------------------------------------------------
+
+    def _tree_lines(self, tracker, depth: int = 0) -> List[str]:
+        lines: List[str] = []
+        cur = tracker.current_step_index
+        for i, step in enumerate(tracker.steps):
+            if i < cur:
+                marker = _TICK
+            elif i == cur:
+                marker = _ARROW
+            else:
+                marker = " "
+            lines.append(f"{'    ' * depth}{marker} {step.label}")
+            child = tracker._children.get(step)
+            if child is not None and i <= cur:
+                lines.extend(self._tree_lines(child, depth + 1))
+        return lines
+
+    def render(self) -> None:
+        if self._tracker is None:
+            return
+        lines = self._tree_lines(self._tracker)
+        w = self._stream
+        if self._ansi:
+            if self._painted_lines:
+                w.write(f"{_CSI}{self._painted_lines}A")  # cursor up
+            for line in lines:
+                w.write(f"{_CSI}2K{line}\n")  # clear line, repaint
+            self._painted_lines = len(lines)
+        else:
+            # non-TTY fallback: log the newly-current step only
+            idx = self._tracker.current_step_index
+            if 0 <= idx < len(self._tracker.steps):
+                w.write(f"{_ARROW} {self._tracker.steps[idx].label}\n")
+        w.flush()
+
+    def done(self) -> None:
+        """Final repaint with everything ticked."""
+        if self._tracker is None or not self._ansi:
+            return
+        lines = [
+            line.replace(_ARROW, _TICK, 1) for line in self._tree_lines(self._tracker)
+        ]
+        w = self._stream
+        if self._painted_lines:
+            w.write(f"{_CSI}{self._painted_lines}A")
+        for line in lines:
+            w.write(f"{_CSI}2K{line}\n")
+        w.flush()

@@ -1,0 +1,60 @@
+"""Clock abstractions (reference `node/.../utilities/ClockUtils.kt` +
+`test-utils/.../node/TestClock.kt`).
+
+A clock here is simply a zero-arg callable returning unix seconds (float) —
+the contract `ServiceHub.clock` already uses — so production nodes pass
+`time.time` and deterministic tests/simulations pass a `TestClock` they
+advance by hand. Mutation notifies subscribers, letting the scheduler and
+simulation loops re-examine their timelines exactly like the reference's
+`MutableClock` token wake-ups.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+
+class TestClock:
+    """Manually-advanced clock for deterministic tests and simulations.
+
+    Callable (returns current unix seconds), monotone non-decreasing:
+    `advance_by` rejects negative deltas and `set_to` rejects travel into
+    the past, matching the reference TestClock's forward-only contract.
+    """
+
+    def __init__(self, start: float = 1_400_000_000.0) -> None:
+        self._now = float(start)
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[float], None]] = []
+
+    def __call__(self) -> float:
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+    def on_advance(self, fn: Callable[[float], None]) -> None:
+        """fn(new_now) after every mutation (scheduler wake-up hook)."""
+        self._listeners.append(fn)
+
+    def advance_by(self, seconds: float) -> float:
+        if seconds < 0:
+            raise ValueError("TestClock only moves forward")
+        with self._lock:
+            self._now += seconds
+            now = self._now
+        self._fire(now)
+        return now
+
+    def set_to(self, new_time: float) -> float:
+        with self._lock:
+            if new_time < self._now:
+                raise ValueError("TestClock only moves forward")
+            self._now = float(new_time)
+            now = self._now
+        self._fire(now)
+        return now
+
+    def _fire(self, now: float) -> None:
+        for fn in list(self._listeners):
+            fn(now)

@@ -1,0 +1,135 @@
+"""Simulation framework tests (reference `IRSSimulationTest.kt` +
+`Simulation.kt` TestClock/latency machinery)."""
+import io
+
+import pytest
+
+from corda_tpu.samples.visualiser import ConsoleVisualiser
+from corda_tpu.testing.simulation import IRSSimulation, Simulation
+from corda_tpu.utils.ansi_progress import ANSIProgressRenderer
+from corda_tpu.utils.clocks import TestClock
+
+
+class TestTestClock:
+    def test_advance_and_set(self):
+        c = TestClock(100.0)
+        assert c() == 100.0
+        c.advance_by(5)
+        assert c.now() == 105.0
+        c.set_to(200.0)
+        assert c() == 200.0
+
+    def test_forward_only(self):
+        c = TestClock(100.0)
+        with pytest.raises(ValueError):
+            c.advance_by(-1)
+        with pytest.raises(ValueError):
+            c.set_to(99.0)
+
+    def test_listeners_fire(self):
+        c = TestClock(0.0)
+        seen = []
+        c.on_advance(seen.append)
+        c.advance_by(3)
+        c.set_to(10)
+        assert seen == [3.0, 10.0]
+
+
+class TestIRSSimulation:
+    def test_full_scenario(self):
+        sim = IRSSimulation()
+        events = []
+        sim.events.subscribe(events.append)
+        try:
+            outcome = sim.run()
+        finally:
+            sim.stop()
+        assert outcome["floating_rate"] == IRSSimulation.ORACLE_RATE
+        # clock hopped at least to the fixing date (start + 24h)
+        assert outcome["clock"] >= 1_400_000_000.0 + 24 * 3600
+        kinds = {e.kind for e in events}
+        assert {"message", "flow", "clock"} <= kinds
+        flows = [e.detail["flow"] for e in events if e.kind == "flow"]
+        assert any("FixingFlow" in f for f in flows)
+        # the oracle's tear-off handlers ran
+        assert any("FixSignHandler" in f for f in flows)
+
+    def test_latency_delays_messages(self):
+        # 60s wire latency: nothing can settle without advancing the clock,
+        # proving delivery rides the TestClock (reference LatencyCalculator).
+        sim = IRSSimulation(latency_seconds=lambda s, r: 60.0)
+        try:
+            mn = sim.net.messaging_network
+            bank_a, bank_b = sim.banks
+            bank_a.network.send(bank_b.info, "app.ping", b"x")
+            assert mn.pump() is False  # delayed into the future
+            assert mn.next_due() == sim.clock.now() + 60.0
+            sim.clock.advance_by(61)
+            assert mn.pump() is True
+        finally:
+            sim.stop()
+
+    def test_full_scenario_with_latency(self):
+        sim = IRSSimulation(latency_seconds=lambda s, r: 5.0)
+        try:
+            outcome = sim.run()
+        finally:
+            sim.stop()
+        assert outcome["floating_rate"] == IRSSimulation.ORACLE_RATE
+
+
+class TestVisualiser:
+    def test_text_and_json_rendering(self):
+        out = io.StringIO()
+        sim = Simulation(n_banks=2)
+        vis = ConsoleVisualiser(stream=out)
+        vis.attach(sim)
+        try:
+            sim.advance(1.0)
+            bank_a, bank_b = sim.banks
+            bank_a.network.send(bank_b.info, "app.demo", b"hello")
+            sim.settle()
+        finally:
+            sim.stop()
+        text = out.getvalue()
+        assert "clock" in text
+        assert "app.demo" in text
+        assert vis.counts["message"] >= 1
+
+
+class TestANSIRenderer:
+    def test_non_tty_fallback_logs_steps(self):
+        from corda_tpu.core.flows.api import ProgressTracker
+
+        out = io.StringIO()
+        r = ANSIProgressRenderer(stream=out)
+        t = ProgressTracker(
+            ProgressTracker.Step("ONE"), ProgressTracker.Step("TWO")
+        )
+        r.progress_tracker = t
+        t.set_current_step(t.steps[0])
+        t.set_current_step(t.steps[1])
+        assert "ONE" in out.getvalue() and "TWO" in out.getvalue()
+
+    def test_tty_repaints_tree(self):
+        class FakeTTY(io.StringIO):
+            def isatty(self):
+                return True
+
+        from corda_tpu.core.flows.api import ProgressTracker
+
+        out = FakeTTY()
+        r = ANSIProgressRenderer(stream=out)
+        t = ProgressTracker(
+            ProgressTracker.Step("ONE"), ProgressTracker.Step("TWO")
+        )
+        child = ProgressTracker(ProgressTracker.Step("SUB"))
+        t.set_child_tracker(t.steps[0], child)
+        r.progress_tracker = t
+        t.set_current_step(t.steps[0])
+        child.set_current_step(child.steps[0])
+        t.set_current_step(t.steps[1])
+        r.done()
+        painted = out.getvalue()
+        assert "\x1b[" in painted  # ANSI repaint codes
+        assert "SUB" in painted and "TWO" in painted
