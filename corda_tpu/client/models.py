@@ -1,0 +1,224 @@
+"""Observable view models over the RPC surface (reference `client/jfx/` —
+`NodeMonitorModel`, `ContractStateModel`, `NetworkIdentityModel` and the
+observable-collection utilities in `client/jfx/src/main/kotlin/net/corda/
+client/jfx/utils/`). The JavaFX bindings are GUI plumbing; the *models* —
+live, self-maintaining collections derived from RPC feeds — are the
+reusable capability, so they are rebuilt here headless: any UI (TUI,
+notebook, web) can subscribe.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.observable import DataFeed, Observable, Subscription
+
+
+# --- observable combinators (reference client/jfx/utils/ObservableUtilities)
+
+def map_observable(source: Observable, fn: Callable[[Any], Any]) -> Observable:
+    out = Observable()
+    source.subscribe(
+        lambda v: out.on_next(fn(v)),
+        on_error=out.on_error,
+        on_completed=out.on_completed,
+    )
+    return out
+
+
+def filter_observable(source: Observable, pred: Callable[[Any], bool]) -> Observable:
+    out = Observable()
+    source.subscribe(
+        lambda v: out.on_next(v) if pred(v) else None,
+        on_error=out.on_error,
+        on_completed=out.on_completed,
+    )
+    return out
+
+
+class ObservableValue:
+    """Current value + change stream (reference ObservableValue bindings)."""
+
+    def __init__(self, initial: Any = None):
+        self._value = initial
+        self.updates: Observable = Observable()
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    def set(self, value: Any) -> None:
+        with self._lock:
+            self._value = value
+        self.updates.on_next(value)
+
+
+class ObservableList:
+    """Self-maintaining list fed by an update stream (reference
+    ObservableList folds in `client/jfx/utils/`). Mutations notify
+    subscribers with the whole list (small, UI-oriented)."""
+
+    def __init__(self):
+        self._items: List[Any] = []
+        self._lock = threading.Lock()
+        self.updates: Observable = Observable()
+
+    def _mutate(self, fn: Callable[[List[Any]], None]) -> None:
+        with self._lock:
+            fn(self._items)
+            snapshot = list(self._items)
+        self.updates.on_next(snapshot)
+
+    def append(self, item: Any) -> None:
+        self._mutate(lambda xs: xs.append(item))
+
+    def remove_where(self, pred: Callable[[Any], bool]) -> None:
+        def do(xs: List[Any]) -> None:
+            xs[:] = [x for x in xs if not pred(x)]
+
+        self._mutate(do)
+
+    def replace_where(self, pred: Callable[[Any], bool], item: Any) -> None:
+        def do(xs: List[Any]) -> None:
+            for i, x in enumerate(xs):
+                if pred(x):
+                    xs[i] = item
+                    return
+            xs.append(item)
+
+        self._mutate(do)
+
+    def set_all(self, items: List[Any]) -> None:
+        def do(xs: List[Any]) -> None:
+            xs[:] = list(items)
+
+        self._mutate(do)
+
+    @property
+    def items(self) -> List[Any]:
+        with self._lock:
+            return list(self._items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+# --- the models --------------------------------------------------------------
+
+class NodeMonitorModel:
+    """Aggregates every RPC feed into live collections (reference
+    `client/jfx/.../model/NodeMonitorModel.kt`): in-flight state machines,
+    verified transactions, vault updates, progress steps, network map."""
+
+    def __init__(self, ops):
+        """ops: CordaRPCOps or an RPC client proxy exposing the same feeds."""
+        self.ops = ops
+        self.state_machines = ObservableList()      # in-flight only
+        self.transactions = ObservableList()        # every verified tx
+        self.vault_updates = ObservableList()       # raw update dicts
+        self.progress_events = ObservableList()
+        self.network_identities = ObservableList()
+        self._subs: List[Subscription] = []
+
+        smm_feed: DataFeed = ops.state_machines_feed()
+        for info in smm_feed.snapshot:
+            self.state_machines.append(info)
+        self._subs.append(smm_feed.updates.subscribe(self._on_smm))
+
+        tx_feed: DataFeed = ops.verified_transactions_feed()
+        for tx in tx_feed.snapshot:
+            self.transactions.append(tx)
+        self._subs.append(tx_feed.updates.subscribe(self.transactions.append))
+
+        vault_feed: DataFeed = ops.vault_track()
+        self._subs.append(vault_feed.updates.subscribe(self.vault_updates.append))
+
+        for node in ops.network_map_snapshot():
+            self.network_identities.append(node)
+
+    def _on_smm(self, info) -> None:
+        if getattr(info, "done", False):
+            self.state_machines.remove_where(
+                lambda x: x.flow_id == info.flow_id
+            )
+        else:
+            self.state_machines.replace_where(
+                lambda x: x.flow_id == info.flow_id, info
+            )
+
+    def close(self) -> None:
+        for sub in self._subs:
+            sub.unsubscribe()
+
+
+class ContractStateModel:
+    """Cash-position model (reference `ContractStateModel.kt`): folds vault
+    updates into live balances keyed by currency."""
+
+    def __init__(self, ops):
+        from ..finance.cash import CashState
+
+        self.ops = ops
+        self._cash_cls = CashState
+        self.cash_states = ObservableList()
+        self.balances = ObservableValue({})  # currency -> minor units
+        self._refs: Dict[Any, Any] = {}  # StateRef -> StateAndRef
+        feed = ops.vault_track()
+        for sr in feed.snapshot:
+            if isinstance(sr.state.data, CashState):
+                self._refs[sr.ref] = sr
+        self._sub = feed.updates.subscribe(self._on_update)
+        self._recompute()
+
+    def _on_update(self, update: Dict) -> None:
+        changed = False
+        for sr in update.get("produced", []):
+            if isinstance(sr.state.data, self._cash_cls):
+                self._refs[sr.ref] = sr
+                changed = True
+        for ref in update.get("consumed", []):
+            if self._refs.pop(ref, None) is not None:
+                changed = True
+        if changed:
+            self._recompute()
+
+    @staticmethod
+    def _currency_of(state) -> str:
+        token = state.amount.token
+        while not isinstance(token, str):  # unwrap Issued[...[currency]]
+            token = getattr(token, "product", str(token))
+        return token
+
+    def _recompute(self) -> None:
+        totals: Dict[str, int] = defaultdict(int)
+        for sr in self._refs.values():
+            state = sr.state.data
+            totals[self._currency_of(state)] += state.amount.quantity
+        self.balances.set(dict(totals))
+        self.cash_states.set_all(list(self._refs.values()))
+
+    def close(self) -> None:
+        self._sub.unsubscribe()
+
+
+class NetworkIdentityModel:
+    """Peer directory model (reference `NetworkIdentityModel.kt`)."""
+
+    def __init__(self, ops):
+        self.ops = ops
+        self.parties = ObservableList()
+        self.notaries = ObservableList()
+        for node in ops.network_map_snapshot():
+            self.parties.append(node)
+        for notary in ops.notary_identities():
+            self.notaries.append(notary)
+
+    def lookup(self, name: str) -> Optional[Any]:
+        return next((p for p in self.parties.items if p.name == name), None)
+
+    def refresh(self) -> None:
+        self.parties.set_all(self.ops.network_map_snapshot())
+        self.notaries.set_all(self.ops.notary_identities())

@@ -1,0 +1,146 @@
+"""Observable view-model tests (reference client/jfx model tests —
+NodeMonitorModel feed aggregation, ContractStateModel cash folding)."""
+from corda_tpu.client.models import (
+    ContractStateModel,
+    NetworkIdentityModel,
+    NodeMonitorModel,
+    ObservableList,
+    ObservableValue,
+    filter_observable,
+    map_observable,
+)
+from corda_tpu.core.contracts import Amount
+from corda_tpu.core.contracts.amount import Issued
+from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+from corda_tpu.finance.flows import CashIssueFlow, CashPaymentFlow
+from corda_tpu.rpc import CordaRPCOps
+from corda_tpu.testing import MockNetwork
+from corda_tpu.utils.observable import Observable
+
+
+class TestCombinators:
+    def test_map_and_filter(self):
+        src = Observable()
+        seen = []
+        filter_observable(
+            map_observable(src, lambda x: x * 10), lambda x: x > 15
+        ).subscribe(seen.append)
+        for i in range(4):
+            src.on_next(i)
+        assert seen == [20, 30]
+
+    def test_observable_value(self):
+        v = ObservableValue(1)
+        seen = []
+        v.updates.subscribe(seen.append)
+        v.set(2)
+        assert v.value == 2 and seen == [2]
+
+    def test_observable_list_ops(self):
+        xs = ObservableList()
+        snapshots = []
+        xs.updates.subscribe(snapshots.append)
+        xs.append("a")
+        xs.append("b")
+        xs.replace_where(lambda x: x == "a", "A")
+        xs.remove_where(lambda x: x == "b")
+        assert xs.items == ["A"]
+        assert snapshots[-1] == ["A"]
+        assert len(xs) == 1
+
+
+@startable_by_rpc
+class _PingFlow(FlowLogic):
+    def call(self):
+        return "pong"
+        yield  # pragma: no cover
+
+
+class TestNodeMonitorModel:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.node = self.net.create_node("O=Monitor,L=London,C=GB")
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def test_state_machines_and_transactions_fold(self):
+        model = NodeMonitorModel(self.ops)
+        self.ops.start_flow_dynamic("_PingFlow")
+        self.net.run_network()
+        # flow finished -> removed from the in-flight collection
+        assert len(model.state_machines) == 0
+        # issue cash -> a verified transaction + vault update appear
+        usd = Amount(100_000, "USD")
+        h = self.node.start_flow(
+            CashIssueFlow(usd, b"\x01", self.node.info, self.notary.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=10)
+        assert len(model.transactions) == 1
+        assert len(model.vault_updates) == 1
+        assert any(
+            n.name == self.node.info.name
+            for n in model.network_identities.items
+        )
+        model.close()
+
+
+class TestContractStateModel:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.bank = self.net.create_node("O=BankM,L=London,C=GB")
+        self.alice = self.net.create_node("O=AliceM,L=Paris,C=FR")
+        self.ops = CordaRPCOps(self.bank.services, self.bank.smm)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _issue(self, qty: int, ccy: str = "USD"):
+        amt = Amount(qty, ccy)
+        h = self.bank.start_flow(
+            CashIssueFlow(amt, b"\x01", self.bank.info, self.notary.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=10)
+
+    def test_balances_fold_across_issues_and_payments(self):
+        model = ContractStateModel(self.ops)
+        assert model.balances.value == {}
+        self._issue(500_00, "USD")
+        self._issue(250_00, "USD")
+        self._issue(100_00, "GBP")
+        assert model.balances.value == {"USD": 750_00, "GBP": 100_00}
+        assert len(model.cash_states) == 3
+
+        # pay away 600.00 USD: consumed + change states fold through
+        pay = Amount(600_00, Issued(self.bank.info.ref(1), "USD"))
+        h = self.bank.start_flow(
+            CashPaymentFlow(pay, self.alice.info, self.notary.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=10)
+        assert model.balances.value["USD"] == 150_00
+        assert model.balances.value["GBP"] == 100_00
+        model.close()
+
+
+class TestNetworkIdentityModel:
+    def test_lookup_and_refresh(self):
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        a = net.create_node("O=IdA,L=London,C=GB")
+        ops = CordaRPCOps(a.services, a.smm)
+        model = NetworkIdentityModel(ops)
+        assert model.lookup(a.info.name) is not None
+        assert model.lookup("O=Nobody,L=X,C=YY") is None
+        assert any(
+            n.name == notary.info.name for n in model.notaries.items
+        )
+        b = net.create_node("O=IdB,L=Berlin,C=DE")
+        model.refresh()
+        assert model.lookup(b.info.name) is not None
+        net.stop_nodes()
