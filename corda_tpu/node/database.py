@@ -143,6 +143,13 @@ class TransactionStorage:
     def track(self, observer: Callable) -> None:
         self._observers.append(observer)
 
+    def all(self) -> List:
+        """Every validated transaction (feed snapshots, explorer)."""
+        return [
+            deserialize(row[0])
+            for row in self.db.query("SELECT blob FROM transactions")
+        ]
+
     def count(self) -> int:
         return self.db.query("SELECT COUNT(*) FROM transactions")[0][0]
 

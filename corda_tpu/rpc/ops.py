@@ -88,7 +88,9 @@ class CordaRPCOps:
     # -- ledger --------------------------------------------------------------
 
     def verified_transactions_feed(self) -> DataFeed:
-        return DataFeed([], self._tx_updates)
+        return DataFeed(
+            self._services.validated_transactions.all(), self._tx_updates
+        )
 
     def vault_query(self, contract_name: Optional[str] = None) -> List:
         return self._services.vault_service.unconsumed_states(contract_name)

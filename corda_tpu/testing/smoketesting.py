@@ -1,0 +1,116 @@
+"""Smoke-test utilities: launch a deployed node directory as a black box
+and RPC into it (reference `smoke-test-utils/.../NodeProcess.kt:1-159` —
+`Factory.create` writes the config, spawns the packaged JVM, polls the RPC
+port; here the "package" is `python -m corda_tpu.node` on a cordform-style
+node directory).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+
+class SmokeTestError(Exception):
+    pass
+
+
+class NodeProcess:
+    """A running black-box node. Use NodeProcess.Factory to create."""
+
+    def __init__(self, proc: subprocess.Popen, node_dir: str, log_path: str):
+        self._proc = proc
+        self.node_dir = node_dir
+        self.log_path = log_path
+        self.broker_port: Optional[int] = None
+        self._clients = []
+
+    def log(self) -> str:
+        try:
+            with open(self.log_path) as fh:
+                return fh.read()
+        except OSError:
+            return ""
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def connect(self, username: str = "admin", password: str = "admin",
+                cordapps=("corda_tpu.finance.flows",)):
+        """RPC connection to the black box (reference NodeProcess.connect)."""
+        import importlib
+
+        for mod in cordapps:
+            importlib.import_module(mod)
+        from ..messaging.net import RemoteBroker
+        from ..rpc.client import CordaRPCClient
+
+        client = CordaRPCClient(RemoteBroker("127.0.0.1", self.broker_port))
+        self._clients.append(client)
+        return client.start(username, password)
+
+    def close(self, timeout: float = 10) -> None:
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if self.alive():
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=timeout)
+
+    def __enter__(self) -> "NodeProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class Factory:
+    """Creates black-box nodes under a working directory (reference
+    `NodeProcess.Factory`)."""
+
+    def __init__(self, build_dir: str, jax_platform: Optional[str] = "cpu"):
+        self.build_dir = build_dir
+        self.jax_platform = jax_platform
+
+    def create(self, conf: Dict, timeout: float = 120) -> NodeProcess:
+        name = conf.get("my_legal_name", "node").replace(" ", "-").replace(
+            ",", "_"
+        )
+        node_dir = os.path.join(self.build_dir, name)
+        os.makedirs(node_dir, exist_ok=True)
+        with open(os.path.join(node_dir, "node.conf"), "w") as fh:
+            json.dump(conf, fh)
+        log_path = os.path.join(node_dir, "node.log")
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        args = [sys.executable, "-m", "corda_tpu.node", node_dir]
+        if self.jax_platform:
+            args += ["--jax-platform", self.jax_platform]
+        proc = subprocess.Popen(
+            args, stdout=open(log_path, "w"), stderr=subprocess.STDOUT, env=env
+        )
+        node = NodeProcess(proc, node_dir, log_path)
+        deadline = time.monotonic() + timeout
+        port_file = os.path.join(node_dir, "broker.port")
+        while time.monotonic() < deadline:
+            if not node.alive():
+                raise SmokeTestError(f"node died on startup:\n{node.log()}")
+            if os.path.exists(port_file):
+                with open(port_file) as fh:
+                    node.broker_port = int(fh.read().strip())
+                return node
+            time.sleep(0.1)
+        node.close()
+        raise SmokeTestError(f"node did not start in {timeout}s:\n{node.log()}")

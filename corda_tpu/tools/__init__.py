@@ -1,0 +1,2 @@
+"""Operator tools (reference `tools/`): explorer, demobench, cordform,
+loadtest (loadtest lives in corda_tpu.loadtest)."""

@@ -1,0 +1,133 @@
+"""Cordform / deployNodes equivalent: declarative multi-node deployment
+descriptor -> on-disk node directories with configs and run scripts
+(reference `gradle-plugins/cordformation/.../Cordform.groovy`, `Node.groovy`
+— the Gradle DSL becomes a plain data structure; the generated artifact is
+a directory tree any orchestrator (shell, systemd, k8s initContainer) can
+launch, plus a runnodes script like the reference's).
+
+Descriptor example (see samples' deploy specs):
+    {
+      "nodes": [
+        {"name": "O=Notary,L=Zurich,C=CH", "notary": "validating",
+         "network_map_service": true},
+        {"name": "O=Bank A,L=London,C=GB", "web": true},
+        {"name": "O=Bank B,L=New York,C=US",
+         "cordapps": ["corda_tpu.finance.flows"]}
+      ],
+      "tls": false
+    }
+"""
+from __future__ import annotations
+
+import json
+import os
+import stat
+from typing import Dict, List, Optional
+
+from ..testing.driver import free_port
+
+RUNNODES = """#!/bin/sh
+# Launch every deployed node (reference cordformation's runnodes script).
+# Each node logs to <node-dir>/node.log; PIDs land in <node-dir>/node.pid.
+cd "$(dirname "$0")"
+for d in */; do
+  [ -f "$d/node.conf" ] || continue
+  ( cd "$d" && exec python -m corda_tpu.node . > node.log 2>&1 & echo $! > node.pid )
+  echo "started $d (pid $(cat $d/node.pid))"
+done
+"""
+
+
+def _dir_name(legal_name: str) -> str:
+    for part in legal_name.split(","):
+        if part.startswith("O="):
+            return part[2:].strip().replace(" ", "")
+    return legal_name.replace(" ", "")
+
+
+def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
+    """Materialise the descriptor under out_dir; returns the resolved
+    per-node configs (with allocated ports and network-map wiring)."""
+    nodes = spec.get("nodes", [])
+    if not nodes:
+        raise ValueError("descriptor has no nodes")
+    os.makedirs(out_dir, exist_ok=True)
+
+    # The first node with network_map_service (or simply the first node)
+    # becomes the directory node everyone else points at.
+    map_idx = next(
+        (i for i, n in enumerate(nodes) if n.get("network_map_service")), 0
+    )
+    resolved: List[Dict] = []
+    map_address: Optional[str] = None
+    shared_certs = os.path.abspath(os.path.join(out_dir, "certificates"))
+
+    for i, n in enumerate(nodes):
+        port = n.get("broker_port") or free_port()
+        conf = {
+            "my_legal_name": n["name"],
+            "broker_host": n.get("host", "127.0.0.1"),
+            "broker_port": port,
+            "rpc_users": n.get(
+                "rpc_users",
+                [{"username": "admin", "password": "admin",
+                  "permissions": ["ALL"]}],
+            ),
+            "cordapps": n.get("cordapps", ["corda_tpu.finance.flows"]),
+        }
+        if n.get("notary"):
+            conf["notary_type"] = n["notary"]
+        if spec.get("tls"):
+            conf["tls"] = True
+            conf["certificates_dir"] = shared_certs
+        if i == map_idx:
+            conf["network_map_service"] = True
+            map_address = f"{conf['broker_host']}:{port}"
+        else:
+            conf["network_map"] = map_address
+        if n.get("jax_platform") or spec.get("jax_platform"):
+            conf["jax_platform"] = n.get("jax_platform") or spec["jax_platform"]
+        node_dir = os.path.join(out_dir, _dir_name(n["name"]))
+        os.makedirs(node_dir, exist_ok=True)
+        with open(os.path.join(node_dir, "node.conf"), "w") as fh:
+            json.dump(conf, fh, indent=2)
+        resolved.append({**conf, "dir": node_dir, "web": bool(n.get("web"))})
+
+    # Nodes registered later must still find the directory node: rewrite
+    # configs written before the map node allocated its port.
+    for conf in resolved:
+        if not conf.get("network_map_service") and conf.get("network_map") is None:
+            conf["network_map"] = map_address
+            with open(os.path.join(conf["dir"], "node.conf"), "w") as fh:
+                json.dump(
+                    {k: v for k, v in conf.items() if k not in ("dir", "web")},
+                    fh, indent=2,
+                )
+
+    script = os.path.join(out_dir, "runnodes")
+    with open(script, "w") as fh:
+        fh.write(RUNNODES)
+    os.chmod(script, os.stat(script).st_mode | stat.S_IEXEC)
+    return resolved
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.tools.cordform")
+    ap.add_argument("descriptor", help="JSON deployment descriptor")
+    ap.add_argument("out_dir")
+    args = ap.parse_args(argv)
+    with open(args.descriptor) as fh:
+        spec = json.load(fh)
+    resolved = deploy_nodes(spec, args.out_dir)
+    for conf in resolved:
+        print(f"{conf['dir']}: {conf['my_legal_name']} "
+              f"broker={conf['broker_host']}:{conf['broker_port']}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Demobench: interactive local-network launcher (reference
+`tools/demobench/` — the JavaFX desktop app that spawns node + webserver
+processes is rebuilt as a terminal tool on the driver DSL).
+
+Usage:
+  python -m corda_tpu.tools.demobench [--base-dir DIR]
+Commands:
+  add NAME [--notary] [--web]   spawn a node (first node becomes the
+                                network-map directory; later nodes join it)
+  list                          show running processes + endpoints
+  explorer NAME                 open the explorer REPL against a node
+  kill NAME                     terminate one node
+  quit                          shut everything down
+A scripted profile can be piped on stdin.
+"""
+from __future__ import annotations
+
+import shlex
+import sys
+import tempfile
+from typing import Dict, Optional
+
+from ..testing.driver import Driver, NodeHandle, free_port
+
+
+class DemoBench:
+    def __init__(self, base_dir: Optional[str] = None, out=None):
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="demobench-")
+        self.driver = Driver(self.base_dir, jax_platform="cpu")
+        self.nodes: Dict[str, NodeHandle] = {}
+        self.webs: Dict[str, object] = {}
+        self._map_address: Optional[str] = None
+        self.out = out or sys.stdout
+
+    def _p(self, text: str) -> None:
+        self.out.write(text + "\n")
+
+    # -- commands ------------------------------------------------------------
+
+    def add(self, name: str, notary: bool = False, web: bool = False) -> NodeHandle:
+        legal = name if name.startswith("O=") else f"O={name},L=Demo,C=GB"
+        conf = {
+            "my_legal_name": legal,
+            "broker_port": free_port(),
+            "rpc_users": [
+                {"username": "admin", "password": "admin", "permissions": ["ALL"]}
+            ],
+        }
+        if notary:
+            conf["notary_type"] = "validating"
+        if self._map_address is None:
+            conf["network_map_service"] = True
+        else:
+            conf["network_map"] = self._map_address
+        handle = self.driver.start_node(conf, name=name.replace(" ", "-"))
+        if self._map_address is None:
+            self._map_address = f"127.0.0.1:{handle.broker_port}"
+        self.nodes[name] = handle
+        self._p(f"node {name} up: broker 127.0.0.1:{handle.broker_port}"
+                + (" [notary]" if notary else "")
+                + (" [network-map]" if conf.get("network_map_service") else ""))
+        if web:
+            self.start_web(name)
+        return handle
+
+    def start_web(self, name: str):
+        handle = self.nodes[name]
+        web = self.driver._spawn(
+            [
+                "-m", "corda_tpu.webserver",
+                "--connect", f"127.0.0.1:{handle.broker_port}",
+                "--port", str(free_port()),
+            ],
+            name=f"web-{name}",
+        )
+        from ..testing.driver import _wait_for
+
+        _wait_for(
+            lambda: "webserver ready" in web.log() or not web.alive(),
+            timeout=60, what=f"webserver for {name}",
+        )
+        for line in web.log().splitlines():
+            if "webserver ready" in line:
+                self._p(f"  {line.strip()}")
+        self.webs[name] = web
+        return web
+
+    def list(self) -> None:
+        for name, h in self.nodes.items():
+            status = "up" if h.alive() else "DEAD"
+            self._p(f"  {name:<20} {status} broker=127.0.0.1:{h.broker_port}")
+        for name, w in self.webs.items():
+            self._p(f"  web:{name:<16} {'up' if w.alive() else 'DEAD'}")
+
+    def explorer(self, name: str) -> None:
+        from .explorer import Explorer
+
+        handle = self.nodes[name]
+        client = handle.rpc()
+        conn = client.start("admin", "admin")
+        try:
+            Explorer(conn.proxy, out=self.out).repl()
+        finally:
+            conn.close()
+            client.close()
+
+    def kill(self, name: str) -> None:
+        handle = self.nodes.pop(name, None)
+        if handle is not None:
+            handle.terminate()
+            self._p(f"{name} stopped")
+        web = self.webs.pop(name, None)
+        if web is not None:
+            web.terminate()
+
+    def shutdown(self) -> None:
+        self.driver.shutdown()
+
+    # -- repl ----------------------------------------------------------------
+
+    def repl(self, stream=None) -> None:
+        stream = stream or sys.stdin
+        interactive = stream is sys.stdin and stream.isatty()
+        if interactive:
+            self._p("demobench — add NAME [--notary] [--web] | list | "
+                    "explorer NAME | kill NAME | quit")
+        for line in stream:
+            argv = shlex.split(line)
+            if not argv:
+                continue
+            cmd, *rest = argv
+            try:
+                if cmd == "add":
+                    name = rest[0]
+                    self.add(
+                        name,
+                        notary="--notary" in rest,
+                        web="--web" in rest,
+                    )
+                elif cmd == "list":
+                    self.list()
+                elif cmd == "explorer":
+                    self.explorer(rest[0])
+                elif cmd == "kill":
+                    self.kill(rest[0])
+                elif cmd in ("quit", "exit"):
+                    break
+                else:
+                    self._p(f"unknown command {cmd!r}")
+            except Exception as exc:
+                self._p(f"error: {exc}")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.tools.demobench")
+    ap.add_argument("--base-dir")
+    args = ap.parse_args(argv)
+    bench = DemoBench(base_dir=args.base_dir)
+    try:
+        bench.repl()
+    finally:
+        bench.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Ledger explorer (reference `tools/explorer/` — the JavaFX GUI's
+capabilities as a terminal tool over RPC: node info, network map, vault
+browsing with criteria paging, cash positions, transaction feed, flow
+start/watch, attachments, metrics).
+
+Usage:
+  python -m corda_tpu.tools.explorer --connect HOST:PORT [--user U --password P] CMD ...
+  CMD: info | network | vault [CONTRACT] | balances | txs | flows |
+       start FLOW [JSON_ARGS] | metrics | attachments PUT file | attachments GET hash
+  With no CMD an interactive shell opens (same commands, plus watch/quit).
+"""
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+from ..client.jackson import to_json
+from ..client.models import ContractStateModel, NetworkIdentityModel
+
+
+def _short(name: str) -> str:
+    for part in str(name).split(","):
+        if part.startswith("O="):
+            return part[2:]
+    return str(name)
+
+
+class Explorer:
+    def __init__(self, proxy, out=None):
+        self.proxy = proxy
+        self.out = out or sys.stdout
+
+    def _p(self, text: str = "") -> None:
+        self.out.write(text + "\n")
+
+    # -- commands ------------------------------------------------------------
+
+    def info(self) -> None:
+        me = self.proxy.node_info()
+        self._p(f"identity : {me.name}")
+        self._p(f"key      : {me.owning_key.encoded.hex()[:32]}…")
+        self._p(f"time     : {self.proxy.current_node_time():.3f}")
+
+    def network(self) -> None:
+        model = NetworkIdentityModel(self.proxy)
+        self._p(f"{len(model.parties)} peers:")
+        notary_names = {n.name for n in model.notaries.items}
+        for p in model.parties.items:
+            tag = "  [notary]" if p.name in notary_names else ""
+            self._p(f"  {_short(p.name):<28} {p.name}{tag}")
+
+    def vault(self, contract: Optional[str] = None, page: int = 1) -> None:
+        from ..node.vault_query import PageSpecification, VaultQueryCriteria
+
+        criteria = VaultQueryCriteria(
+            contract_names=(contract,) if contract else ()
+        )
+        result = self.proxy.vault_query_by(
+            criteria, PageSpecification(page_number=page, page_size=25), None
+        )
+        self._p(
+            f"page {result.page_number} of {result.total_states_available} states"
+        )
+        for sr in result.states:
+            data = sr.state.data
+            self._p(f"  {sr.ref.txhash.bytes.hex()[:16]}…[{sr.ref.index}] "
+                    f"{type(data).__name__}: {data}")
+
+    def balances(self) -> None:
+        model = ContractStateModel(self.proxy)
+        if not model.balances.value:
+            self._p("no cash positions")
+        for ccy, qty in sorted(model.balances.value.items()):
+            self._p(f"  {ccy}: {qty / 100:,.2f}")
+        model.close()
+
+    def txs(self) -> None:
+        feed = self.proxy.verified_transactions_feed()
+        self._p(f"{len(feed.snapshot)} verified transactions (snapshot)")
+        for stx in feed.snapshot[-20:]:
+            self._p(f"  {stx.id.bytes.hex()[:24]}… sigs={len(stx.sigs)}")
+
+    def flows(self) -> None:
+        feed = self.proxy.state_machines_feed()
+        self._p(f"{len(feed.snapshot)} flows in flight")
+        for info in feed.snapshot:
+            self._p(f"  {info.flow_id} {info.flow_name}")
+
+    def start(self, flow_name: str, json_args: str = "[]") -> None:
+        args = json.loads(json_args)
+        if isinstance(args, dict):
+            flow_id = self.proxy.start_flow_dynamic(flow_name, **args)
+        else:
+            flow_id = self.proxy.start_flow_dynamic(flow_name, *args)
+        self._p(f"started {flow_id}")
+        try:
+            result = self.proxy.flow_result(flow_id, timeout=30)
+            self._p(f"result: {to_json(result)}")
+        except Exception as exc:
+            self._p(f"flow error: {exc}")
+
+    def metrics(self) -> None:
+        self._p(json.dumps(self.proxy.node_metrics(), indent=2, default=str))
+
+    def attachments(self, op: str, arg: str) -> None:
+        from ..core.crypto.secure_hash import SecureHash
+
+        if op.upper() == "PUT":
+            with open(arg, "rb") as fh:
+                att_id = self.proxy.upload_attachment(fh.read())
+            self._p(f"uploaded {att_id.bytes.hex()}")
+        else:
+            data = self.proxy.open_attachment(
+                SecureHash(bytes.fromhex(arg))
+            )
+            if data is None:
+                self._p("not found")
+            else:
+                sys.stdout.buffer.write(data)
+
+    # -- dispatch ------------------------------------------------------------
+
+    COMMANDS = {
+        "info", "network", "vault", "balances", "txs", "flows", "start",
+        "metrics", "attachments",
+    }
+
+    def run_command(self, argv: List[str]) -> bool:
+        if not argv:
+            return True
+        cmd, *rest = argv
+        if cmd in ("quit", "exit"):
+            return False
+        if cmd not in self.COMMANDS:
+            self._p(f"unknown command {cmd!r}; one of {sorted(self.COMMANDS)}")
+            return True
+        try:
+            getattr(self, cmd)(*rest)
+        except Exception as exc:
+            self._p(f"error: {exc}")
+        return True
+
+    def repl(self) -> None:
+        self._p("corda_tpu explorer — commands: "
+                + " ".join(sorted(self.COMMANDS)) + " quit")
+        while True:
+            try:
+                line = input("explorer> ")
+            except EOFError:
+                break
+            if not self.run_command(shlex.split(line)):
+                break
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.tools.explorer")
+    ap.add_argument("--connect", required=True, help="node broker HOST:PORT")
+    ap.add_argument("--user", default="admin")
+    ap.add_argument("--password", default="admin")
+    ap.add_argument("--cordapps", default="corda_tpu.finance.flows",
+                    help="comma-separated modules to import for codecs")
+    ap.add_argument("command", nargs="*", help="one-shot command")
+    args = ap.parse_args(argv)
+
+    import importlib
+
+    for mod in args.cordapps.split(","):
+        if mod:
+            importlib.import_module(mod)
+
+    from ..messaging.net import RemoteBroker
+    from ..rpc.client import CordaRPCClient
+
+    host, port_s = args.connect.rsplit(":", 1)
+    client = CordaRPCClient(RemoteBroker(host, int(port_s)))
+    conn = client.start(args.user, args.password)
+    try:
+        ex = Explorer(conn.proxy)
+        if args.command:
+            ex.run_command(args.command)
+        else:
+            ex.repl()
+    finally:
+        conn.close()
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Standalone webserver process: REST gateway to a remote node
+(`python -m corda_tpu.webserver --connect HOST:PORT`).
+
+Reference parity: the webserver runs as its own process talking RPC to the
+node (`webserver/src/main/kotlin/net/corda/webserver/WebServer.kt`,
+spawned separately by demobench/cordformation).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="corda_tpu.webserver")
+    ap.add_argument("--connect", required=True, help="node broker HOST:PORT")
+    ap.add_argument("--user", default="admin")
+    ap.add_argument("--password", default="admin")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--cordapps", default="corda_tpu.finance.flows")
+    args = ap.parse_args(argv)
+
+    for mod in args.cordapps.split(","):
+        if mod:
+            importlib.import_module(mod)
+
+    from ..messaging.net import RemoteBroker
+    from ..rpc.client import CordaRPCClient
+    from .server import WebServer
+
+    host, port_s = args.connect.rsplit(":", 1)
+    client = CordaRPCClient(RemoteBroker(host, int(port_s)))
+    conn = client.start(args.user, args.password)
+    web = WebServer(conn.proxy, host=args.host, port=args.port)
+    print(f"webserver ready: http://{args.host}:{web.port}/api/status", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        web.stop()
+        conn.close()
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""Tools-tier tests: explorer, demobench (scripted), cordform deployment,
+smoke-test NodeProcess (reference tools/explorer, tools/demobench,
+cordformation, smoke-test-utils)."""
+import io
+import json
+import os
+import urllib.request
+
+import pytest
+
+from corda_tpu.core.contracts import Amount
+from corda_tpu.rpc import CordaRPCOps
+from corda_tpu.testing import MockNetwork
+from corda_tpu.tools.cordform import deploy_nodes
+from corda_tpu.tools.explorer import Explorer
+
+
+class TestExplorer:
+    """Explorer over in-process ops (same surface the RPC proxy serves)."""
+
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.node = self.net.create_node("O=Exp,L=London,C=GB")
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+        self.out = io.StringIO()
+        self.ex = Explorer(self.ops, out=self.out)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _text(self) -> str:
+        return self.out.getvalue()
+
+    def test_info_network_flows(self):
+        self.ex.info()
+        assert "O=Exp,L=London,C=GB" in self._text()
+        self.ex.network()
+        assert "[notary]" in self._text()
+        self.ex.flows()
+        assert "0 flows in flight" in self._text()
+
+    def test_balances_and_vault_after_issue(self):
+        from corda_tpu.finance.flows import CashIssueFlow
+
+        h = self.node.start_flow(CashIssueFlow(
+            Amount(123_00, "USD"), b"\x01", self.node.info, self.notary.info
+        ))
+        self.net.run_network()
+        h.result.result(timeout=10)
+        self.ex.balances()
+        assert "USD: 123.00" in self._text()
+        self.ex.vault()
+        assert "CashState" in self._text()
+        self.ex.txs()
+        assert "1 verified transactions" in self._text()
+
+    def test_start_flow_and_metrics(self):
+        from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+
+        @startable_by_rpc
+        class ExpEcho(FlowLogic):
+            def __init__(self, v):
+                self.v = v
+
+            def call(self):
+                return self.v
+                yield  # pragma: no cover
+
+        # flow runs on the pumped network: pre-pump in the background is
+        # unnecessary because the flow completes without suspending
+        self.ex.start("ExpEcho", json.dumps([7]))
+        assert "result: 7" in self._text()
+        self.ex.metrics()
+        assert "Flows.Started" in self._text()
+
+    def test_unknown_command(self):
+        assert self.ex.run_command(["bogus"]) is True
+        assert "unknown command" in self._text()
+        assert self.ex.run_command(["quit"]) is False
+
+
+class TestCordform:
+    def test_deploy_nodes_layout(self, tmp_path):
+        spec = {
+            "nodes": [
+                {"name": "O=Notary,L=Zurich,C=CH", "notary": "validating",
+                 "network_map_service": True},
+                {"name": "O=Bank A,L=London,C=GB", "web": True},
+                {"name": "O=Bank B,L=New York,C=US"},
+            ]
+        }
+        resolved = deploy_nodes(spec, str(tmp_path))
+        assert len(resolved) == 3
+        assert (tmp_path / "runnodes").exists()
+        assert os.access(tmp_path / "runnodes", os.X_OK)
+        notary_conf = json.load(open(tmp_path / "Notary" / "node.conf"))
+        assert notary_conf["network_map_service"] is True
+        assert notary_conf["notary_type"] == "validating"
+        map_addr = f"127.0.0.1:{notary_conf['broker_port']}"
+        for d in ("BankA", "BankB"):
+            conf = json.load(open(tmp_path / d / "node.conf"))
+            assert conf["network_map"] == map_addr
+            assert conf["rpc_users"][0]["username"] == "admin"
+
+    def test_empty_descriptor_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            deploy_nodes({}, str(tmp_path))
+
+
+@pytest.mark.slow
+class TestSmokeAndDemobench:
+    """Real OS processes: deploy via cordform, launch as a black box via
+    NodeProcess, drive demobench scripted (reference smoke tests +
+    DemoBench's node lifecycle)."""
+
+    def test_node_process_black_box(self, tmp_path):
+        from corda_tpu.testing.driver import free_port
+        from corda_tpu.testing.smoketesting import Factory
+
+        factory = Factory(str(tmp_path))
+        conf = {
+            "my_legal_name": "O=Smoke,L=London,C=GB",
+            "broker_port": free_port(),
+            "network_map_service": True,
+            "rpc_users": [{"username": "admin", "password": "admin",
+                           "permissions": ["ALL"]}],
+        }
+        with factory.create(conf) as node:
+            assert node.alive()
+            conn = node.connect()
+            info = conn.proxy.node_info()
+            assert info.name == "O=Smoke,L=London,C=GB"
+            assert conn.proxy.network_map_snapshot()
+            conn.close()
+        assert not node.alive()
+
+    def test_demobench_scripted(self, tmp_path):
+        from corda_tpu.tools.demobench import DemoBench
+
+        out = io.StringIO()
+        bench = DemoBench(base_dir=str(tmp_path), out=out)
+        try:
+            script = io.StringIO("add Alpha --web\nlist\n")
+            bench.repl(stream=script)
+            text = out.getvalue()
+            assert "node Alpha up" in text
+            assert "webserver ready" in text
+            assert "Alpha" in bench.nodes
+            # the webserver really serves the node's RPC surface
+            url = next(
+                line.split()[-1] for line in text.splitlines()
+                if "webserver ready" in line
+            )
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.read() == b"started"
+            bench.kill("Alpha")
+            assert "Alpha stopped" in out.getvalue()
+        finally:
+            bench.shutdown()
