@@ -1,0 +1,166 @@
+"""Deterministic execution guard for untrusted contract code (reference
+`experimental/sandbox/src/main/java/net/corda/sandbox/` — the JVM
+bytecode-rewriting `RuntimeCostAccounter` + `WhitelistClassLoader` become
+(a) a static code-object scan and (b) a sys.settrace cost meter; same two
+layers, Python-native mechanisms).
+
+Why it matters: attachment-delivered contract code (serialization/
+attachments_loader.py) executes inside every verifier; a hostile contract
+must not be able to spin forever, exhaust memory, or read
+non-deterministic inputs and split consensus.
+
+Layers:
+  * `check_code(fn_or_cls)` — static: walks code objects recursively and
+    rejects references to forbidden builtins (`open`, `eval`, `exec`,
+    `__import__`, …) and forbidden module roots (`os`, `socket`, `random`,
+    `time`, `threading`, …) before anything runs (WhitelistClassLoader
+    analogue: reject at load time).
+  * `run_metered(fn, *args, budget=...)` — dynamic: executes under a trace
+    that charges 1 cost unit per line event plus an allocation surcharge
+    per call, and enforces a wall-clock ceiling (RuntimeCostAccounter
+    analogue: the reference charges per-instruction/allocation/jump).
+"""
+from __future__ import annotations
+
+import sys
+import time
+import types
+from dataclasses import dataclass
+from typing import Any, Callable, FrozenSet, Iterable, Optional
+
+FORBIDDEN_BUILTINS: FrozenSet[str] = frozenset({
+    "open", "eval", "exec", "compile", "__import__", "input", "breakpoint",
+    "globals", "vars", "memoryview", "exit", "quit",
+})
+
+#: module roots contract code must not touch (non-determinism or IO)
+FORBIDDEN_MODULES: FrozenSet[str] = frozenset({
+    "os", "sys", "io", "socket", "subprocess", "threading", "multiprocessing",
+    "random", "secrets", "time", "datetime", "uuid", "pathlib", "shutil",
+    "ctypes", "signal", "importlib", "pickle", "marshal", "urllib", "http",
+    "posixpath", "ntpath", "genericpath",  # os.path implementation modules
+})
+
+
+class SandboxViolation(Exception):
+    """Static rejection: the code references forbidden names/modules."""
+
+
+class CostLimitExceeded(Exception):
+    """Dynamic rejection: the execution budget ran out."""
+
+
+@dataclass(frozen=True)
+class Budget:
+    """Execution budget (reference RuntimeCostAccounter's per-category
+    thresholds, collapsed to line-cost + call-cost + wall clock)."""
+
+    max_cost: int = 2_000_000       # ~line events + call surcharges
+    max_seconds: float = 5.0
+    call_surcharge: int = 10
+
+
+DEFAULT_BUDGET = Budget()
+
+
+# --- static layer ------------------------------------------------------------
+
+def _iter_code(code: types.CodeType) -> Iterable[types.CodeType]:
+    yield code
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            yield from _iter_code(const)
+
+
+def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
+    """Statically vet a function or class (e.g. a Contract subclass): every
+    reachable code object must not name a forbidden builtin or import a
+    forbidden module root. Raises SandboxViolation."""
+    forbidden = FORBIDDEN_BUILTINS | frozenset(extra_forbidden)
+    codes = []
+    if isinstance(obj, type):
+        for attr in vars(obj).values():
+            fn = getattr(attr, "__func__", attr)
+            if isinstance(fn, types.FunctionType):
+                codes.append(fn.__code__)
+    elif isinstance(obj, types.FunctionType):
+        codes.append(obj.__code__)
+    elif isinstance(obj, types.MethodType):
+        codes.append(obj.__func__.__code__)
+    else:
+        raise TypeError(f"cannot vet {type(obj).__name__}")
+
+    for top in codes:
+        for code in _iter_code(top):
+            # co_freevars too: a closure variable bound to a forbidden
+            # module reaches the code without appearing in co_names
+            names = set(code.co_names) | set(code.co_freevars)
+            bad = names & forbidden
+            if bad:
+                raise SandboxViolation(
+                    f"{code.co_qualname or code.co_name} references "
+                    f"forbidden name(s) {sorted(bad)}"
+                )
+            for name in names:
+                root = name.split(".", 1)[0]
+                if root in FORBIDDEN_MODULES:
+                    raise SandboxViolation(
+                        f"{code.co_qualname or code.co_name} touches "
+                        f"forbidden module {root!r}"
+                    )
+
+
+# --- dynamic layer -----------------------------------------------------------
+
+def run_metered(
+    fn: Callable,
+    *args: Any,
+    budget: Budget = DEFAULT_BUDGET,
+    **kwargs: Any,
+):
+    """Run fn under cost accounting; raises CostLimitExceeded when the
+    budget is exhausted and SandboxViolation if execution enters a
+    forbidden module. Returns fn's result. Not reentrant per thread."""
+    state = {"cost": 0, "deadline": time.monotonic() + budget.max_seconds}
+
+    def tracer(frame, event, arg):
+        if event == "call":
+            state["cost"] += budget.call_surcharge
+            mod = frame.f_globals.get("__name__", "")
+            root = mod.split(".", 1)[0]
+            if root in FORBIDDEN_MODULES:
+                raise SandboxViolation(
+                    f"execution entered forbidden module {mod!r}"
+                )
+            return tracer
+        if event == "line":
+            state["cost"] += 1
+            if state["cost"] > budget.max_cost:
+                raise CostLimitExceeded(
+                    f"cost budget {budget.max_cost} exhausted"
+                )
+            if (state["cost"] & 0x3FF) == 0 and (
+                time.monotonic() > state["deadline"]
+            ):
+                raise CostLimitExceeded(
+                    f"wall-clock budget {budget.max_seconds}s exhausted"
+                )
+        return tracer
+
+    prev = sys.gettrace()
+    sys.settrace(tracer)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        sys.settrace(prev)
+
+
+# --- contract-verification integration ---------------------------------------
+
+def metered_contract_verify(
+    contract, ltx, budget: Optional[Budget] = None
+) -> None:
+    """Vet then run one contract's verify under the meter — the hook the
+    verifier uses for attachment-delivered (untrusted) contract classes."""
+    check_code(type(contract))
+    run_metered(contract.verify, ltx, budget=budget or DEFAULT_BUDGET)

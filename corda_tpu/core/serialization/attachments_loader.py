@@ -18,10 +18,14 @@ verification resolves by name.  Protections kept from the reference:
   * contract-name collisions with ALREADY-registered code are rejected by
     the registry itself (same name, different class).
 
-Trust model (same as the reference's): attachment code is arbitrary code
-— the reference runs JARs on the JVM and gates trust on attachment
-signing/whitelisting, with a deterministic sandbox only in
-`experimental/`. Callers must only load attachments from trusted stores.
+Trust model: unlike the reference (which gates trust on attachment
+signing and keeps its deterministic sandbox in `experimental/`), loading
+here is sandbox-integrated by default: newly registered contract classes
+are statically vetted (`core.sandbox.check_code`) at load time — the
+WhitelistClassLoader analogue — and tagged `__untrusted__`, which makes
+`LedgerTransaction.verify` run them under the dynamic cost meter
+(`core.sandbox.run_metered`). Pass vet=False to restore the reference's
+trust-the-store behavior.
 """
 from __future__ import annotations
 
@@ -56,7 +60,7 @@ _loaded_digests: set = set()
 _load_lock = threading.Lock()
 
 
-def load_contracts_from_attachments(attachments) -> List[str]:
+def load_contracts_from_attachments(attachments, vet: bool = True) -> List[str]:
     """Execute the contract modules in `attachments` (iterable of objects
     with `.id` and `.data` — corda_tpu Attachment, or raw zip bytes) and
     return the names of newly registered contracts.  Atomic: on any
@@ -104,6 +108,13 @@ def load_contracts_from_attachments(attachments) -> List[str]:
                 raise AttachmentLoadError(f"error loading {path}: {exc}")
             _loaded_digests.add(digest)
             new_digests.append(digest)
+        if vet:
+            from ..sandbox import check_code
+
+            for contract_name in set(_CONTRACT_REGISTRY) - before:
+                cls = _CONTRACT_REGISTRY[contract_name]
+                check_code(cls)  # raises SandboxViolation -> rollback below
+                cls.__untrusted__ = True  # run metered at verify time
     except Exception:
         # Roll back everything this call touched: a partial load must not
         # leave resolvable contracts whose companion code never loaded.

@@ -61,7 +61,14 @@ class LedgerTransaction:
         for name in contracts:
             contract = resolve_contract(name)
             try:
-                contract.verify(self)
+                if getattr(type(contract), "__untrusted__", False):
+                    # attachment-delivered code runs under the cost meter
+                    # (core/sandbox.py; reference experimental/sandbox)
+                    from ..sandbox import run_metered
+
+                    run_metered(contract.verify, self)
+                else:
+                    contract.verify(self)
             except TransactionVerificationError:
                 raise
             except Exception as e:

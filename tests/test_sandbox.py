@@ -1,0 +1,196 @@
+"""Contract sandbox tests (reference experimental/sandbox —
+WhitelistClassLoader static rejection + RuntimeCostAccounter metering)."""
+import io
+import zipfile
+
+import pytest
+
+from corda_tpu.core.sandbox import (
+    Budget,
+    CostLimitExceeded,
+    SandboxViolation,
+    check_code,
+    metered_contract_verify,
+    run_metered,
+)
+
+
+class TestStaticLayer:
+    def test_clean_function_passes(self):
+        def ok(tx):
+            total = sum(i for i in range(10))
+            return total and len(str(tx))
+
+        check_code(ok)
+
+    def test_open_rejected(self):
+        def evil(tx):
+            return open("/etc/passwd").read()
+
+        with pytest.raises(SandboxViolation, match="open"):
+            check_code(evil)
+
+    def test_forbidden_module_rejected(self):
+        import os
+
+        def evil(tx):
+            return os.environ
+
+        with pytest.raises(SandboxViolation, match="os"):
+            check_code(evil)
+
+    def test_eval_in_nested_code_rejected(self):
+        def outer(tx):
+            def inner():
+                return eval("1+1")
+            return inner()
+
+        with pytest.raises(SandboxViolation, match="eval"):
+            check_code(outer)
+
+    def test_class_vetting(self):
+        class CleanContract:
+            def verify(self, tx):
+                if not tx:
+                    raise ValueError("empty")
+
+        class DirtyContract:
+            def verify(self, tx):
+                exec("print(1)")
+
+        check_code(CleanContract)
+        with pytest.raises(SandboxViolation):
+            check_code(DirtyContract)
+
+    def test_real_cash_contract_passes(self):
+        from corda_tpu.finance.cash import Cash
+
+        check_code(Cash)
+
+
+class TestDynamicLayer:
+    def test_normal_execution_returns(self):
+        assert run_metered(lambda a, b: a + b, 2, 3) == 5
+
+    def test_runaway_loop_killed_by_cost(self):
+        def spin():
+            n = 0
+            while True:
+                n += 1
+
+        with pytest.raises(CostLimitExceeded, match="cost budget"):
+            run_metered(spin, budget=Budget(max_cost=50_000, max_seconds=60))
+
+    def test_wall_clock_ceiling(self):
+        def slowish():
+            n = 0
+            while True:
+                n += 1
+
+        with pytest.raises(CostLimitExceeded):
+            run_metered(
+                slowish,
+                budget=Budget(max_cost=10**12, max_seconds=0.2),
+            )
+
+    def test_forbidden_module_entry_caught(self):
+        import os.path
+
+        def sneaky():
+            # os.path.join is a Python-level function in a forbidden module
+            return os.path.join("a", "b")
+
+        with pytest.raises(SandboxViolation, match="forbidden module"):
+            run_metered(sneaky)
+
+    def test_trace_restored(self):
+        import sys
+
+        before = sys.gettrace()
+        run_metered(lambda: 1)
+        assert sys.gettrace() is before
+
+    def test_exceptions_propagate(self):
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError, match="inner"):
+            run_metered(boom)
+
+
+class TestMeteredContractVerify:
+    def test_legit_contract_verifies(self):
+        class Okay:
+            def verify(self, ltx):
+                return None
+
+        metered_contract_verify(Okay(), object())
+
+    def test_hostile_contract_rejected_statically(self):
+        class Evil:
+            def verify(self, ltx):
+                return open("x")
+
+        with pytest.raises(SandboxViolation):
+            metered_contract_verify(Evil(), object())
+
+
+def _zip_of(source: str, path: str = "contracts/evil.py") -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr(path, source)
+    return buf.getvalue()
+
+
+class TestAttachmentIntegration:
+    def test_hostile_attachment_rejected_at_load(self):
+        from corda_tpu.core.contracts.structures import _CONTRACT_REGISTRY
+        from corda_tpu.core.serialization.attachments_loader import (
+            load_contracts_from_attachments,
+        )
+
+        before = set(_CONTRACT_REGISTRY)
+        evil = _zip_of(
+            "from corda_tpu.core.contracts.structures import contract, Contract\n"
+            "@contract(name='sandbox.EvilLoad')\n"
+            "class EvilContract(Contract):\n"
+            "    def verify(self, tx):\n"
+            "        return open('/etc/passwd')\n"
+        )
+        with pytest.raises(SandboxViolation):
+            load_contracts_from_attachments([evil])
+        assert set(_CONTRACT_REGISTRY) == before  # rolled back
+
+    def test_runaway_attachment_contract_metered_at_verify(self):
+        from corda_tpu.core.contracts.structures import (
+            _CONTRACT_REGISTRY,
+            resolve_contract,
+        )
+        from corda_tpu.core.serialization.attachments_loader import (
+            load_contracts_from_attachments,
+        )
+
+        spin = _zip_of(
+            "from corda_tpu.core.contracts.structures import contract, Contract\n"
+            "@contract(name='sandbox.Spin')\n"
+            "class SpinContract(Contract):\n"
+            "    def verify(self, tx):\n"
+            "        n = 0\n"
+            "        while True:\n"
+            "            n += 1\n",
+            path="contracts/spin.py",
+        )
+        loaded = load_contracts_from_attachments([spin])
+        try:
+            assert "sandbox.Spin" in loaded
+            cls = type(resolve_contract("sandbox.Spin"))
+            assert getattr(cls, "__untrusted__", False)
+            from corda_tpu.core.sandbox import run_metered
+
+            with pytest.raises(CostLimitExceeded):
+                run_metered(
+                    resolve_contract("sandbox.Spin").verify, object(),
+                    budget=Budget(max_cost=10_000, max_seconds=30),
+                )
+        finally:
+            _CONTRACT_REGISTRY.pop("sandbox.Spin", None)
