@@ -1,0 +1,3 @@
+"""Experimental tier (reference `experimental/`): the universal contract
+DSL. The reference's other experimental piece — the deterministic sandbox —
+graduated into `corda_tpu.core.sandbox`."""

@@ -1,0 +1,318 @@
+"""Universal contract DSL: composable financial arrangements (reference
+`experimental/src/main/kotlin/net/corda/contracts/universal/` — the Kotlin
+builder DSL (`arrange { actions { ... } }`, `UniversalContract`, rollouts
+and fixings) redesigned as a frozen-dataclass expression algebra).
+
+An *arrangement* is what the parties have agreed:
+
+  Zero()                                   — nothing is owed
+  Obligation(amount, frm, to)              — frm must pay `amount` to `to`
+  All(a, b, ...)                           — every sub-arrangement holds
+  Actions(Action(name, actors, result))    — named transitions parties may
+                                             take; exercising one replaces
+                                             the arrangement with `result`
+  FloatingObligation(fix_of, scale, frm, to, currency)
+                                           — amount = oracle fix * scale,
+                                             resolved by a Fix command
+                                             (reference fixings; rides the
+                                             same Fix the irs oracle signs)
+
+`UniversalContract` verifies four commands:
+  Issue  — all obliged parties signed the genesis arrangement;
+  Do     — an offered Action was exercised by its actors, and the output
+           arrangement equals the action's result (normalized);
+  FixCmd — a FloatingObligation resolved to a concrete Obligation whose
+           amount matches the attested Fix value (tear-off-signable by the
+           rates oracle exactly like samples/irs_demo);
+  Settle — obligations paid down: the output arrangement must be the input
+           minus the settled obligations (payment itself is cash-contract
+           business; here we verify the arrangement shrinks correctly).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import FrozenSet, Tuple
+
+from ..core.contracts import Amount
+from ..core.contracts.structures import (
+    Contract,
+    ContractState,
+    TransactionVerificationError,
+    contract,
+)
+from ..core.identity import Party
+from ..core.serialization.codec import corda_serializable
+from ..samples.irs_demo import Fix, FixOf
+
+
+# --- arrangement algebra -----------------------------------------------------
+
+@corda_serializable(name="universal.Zero")
+@dataclass(frozen=True)
+class Zero:
+    pass
+
+
+@corda_serializable(name="universal.Obligation")
+@dataclass(frozen=True)
+class Obligation:
+    amount: Amount = None
+    frm: Party = None
+    to: Party = None
+
+
+@corda_serializable(name="universal.FloatingObligation")
+@dataclass(frozen=True)
+class FloatingObligation:
+    """Amount unknown until an oracle fix: quantity = fix.value * scale
+    (minor units, rounded to int)."""
+
+    fix_of: FixOf = None
+    scale: int = 0
+    frm: Party = None
+    to: Party = None
+    currency: str = ""
+
+
+@corda_serializable(name="universal.All")
+@dataclass(frozen=True)
+class All:
+    parts: Tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "parts", tuple(self.parts))
+
+
+@corda_serializable(name="universal.Action")
+@dataclass(frozen=True)
+class Action:
+    name: str = ""
+    actors: Tuple = ()      # parties who may exercise
+    result: object = None   # arrangement after exercising
+
+    def __post_init__(self):
+        object.__setattr__(self, "actors", tuple(self.actors))
+
+
+@corda_serializable(name="universal.Actions")
+@dataclass(frozen=True)
+class Actions:
+    actions: Tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "actions", tuple(self.actions))
+
+
+def all_of(*parts) -> object:
+    """Normalizing constructor: flattens nested All, drops Zero."""
+    flat = []
+    for p in parts:
+        if isinstance(p, All):
+            flat.extend(p.parts)
+        elif not isinstance(p, Zero):
+            flat.append(p)
+    if not flat:
+        return Zero()
+    if len(flat) == 1:
+        return flat[0]
+    return All(tuple(flat))
+
+
+def normalize(arr) -> object:
+    if isinstance(arr, All):
+        return all_of(*[normalize(p) for p in arr.parts])
+    return arr
+
+
+def _parts(arr) -> Tuple:
+    arr = normalize(arr)
+    if isinstance(arr, Zero):
+        return ()
+    if isinstance(arr, All):
+        return arr.parts
+    return (arr,)
+
+
+def obliged_parties(arr) -> FrozenSet[str]:
+    """Names of every party owing something (Issue must be signed by all)."""
+    out = set()
+    for p in _parts(arr):
+        if isinstance(p, (Obligation, FloatingObligation)):
+            out.add(p.frm.name)
+        elif isinstance(p, Actions):
+            for a in p.actions:
+                out |= obliged_parties(a.result)
+    return frozenset(out)
+
+
+# --- state + commands --------------------------------------------------------
+
+@corda_serializable(name="universal.State")
+@dataclass(frozen=True)
+class UniversalState(ContractState):
+    arrangement: object = None
+    parties: Tuple = ()
+    contract_name = "corda_tpu.experimental.Universal"
+
+    def __post_init__(self):
+        object.__setattr__(self, "parties", tuple(self.parties))
+
+    @property
+    def participants(self):
+        return list(self.parties)
+
+
+@corda_serializable(name="universal.Issue")
+@dataclass(frozen=True)
+class Issue:
+    pass
+
+
+@corda_serializable(name="universal.Do")
+@dataclass(frozen=True)
+class Do:
+    name: str = ""
+
+
+@corda_serializable(name="universal.Settle")
+@dataclass(frozen=True)
+class Settle:
+    pass
+
+
+# --- the contract ------------------------------------------------------------
+
+def _signers_of(cmd) -> FrozenSet[bytes]:
+    return frozenset(k.encoded for k in cmd.signers)
+
+
+@contract(name="corda_tpu.experimental.Universal")
+class UniversalContract(Contract):
+    def verify(self, tx) -> None:
+        cmds = [
+            c for c in tx.commands
+            if isinstance(c.value, (Issue, Do, Settle))
+        ]
+        if len(cmds) != 1:
+            raise TransactionVerificationError(
+                tx.id, "exactly one universal command required"
+            )
+        cmd = cmds[0]
+        ins = tx.inputs_of_type(UniversalState)
+        outs = tx.outputs_of_type(UniversalState)
+
+        if isinstance(cmd.value, Issue):
+            self._verify_issue(tx, cmd, ins, outs)
+        elif isinstance(cmd.value, Do):
+            self._verify_do(tx, cmd, ins, outs)
+        else:
+            self._verify_settle(tx, cmd, ins, outs)
+
+    # Issue: a genesis arrangement appears; everyone who may end up owing
+    # must have signed (reference UniversalContract issue rule).
+    def _verify_issue(self, tx, cmd, ins, outs) -> None:
+        if ins or len(outs) != 1:
+            raise TransactionVerificationError(
+                tx.id, "issue: no inputs and exactly one output"
+            )
+        state = outs[0]
+        signers = _signers_of(cmd)
+        signer_names = {
+            p.name for p in state.parties if p.owning_key.encoded in signers
+        }
+        missing = obliged_parties(state.arrangement) - signer_names
+        if missing:
+            raise TransactionVerificationError(
+                tx.id, f"issue not signed by obliged parties: {sorted(missing)}"
+            )
+
+    # Do: exercise an offered action.
+    def _verify_do(self, tx, cmd, ins, outs) -> None:
+        if len(ins) != 1 or len(outs) != 1:
+            raise TransactionVerificationError(
+                tx.id, "do: one input and one output"
+            )
+        arr = normalize(ins[0].arrangement)
+        name = cmd.value.name
+        offered = None
+        rest = []
+        for part in _parts(arr):
+            if isinstance(part, Actions) and offered is None:
+                match = next(
+                    (a for a in part.actions if a.name == name), None
+                )
+                if match is not None:
+                    offered = match
+                    continue
+            rest.append(part)
+        if offered is None:
+            raise TransactionVerificationError(
+                tx.id, f"action {name!r} is not offered by the arrangement"
+            )
+        signers = _signers_of(cmd)
+        missing = [
+            p.name for p in offered.actors
+            if p.owning_key.encoded not in signers
+        ]
+        if missing:
+            raise TransactionVerificationError(
+                tx.id, f"action {name!r} lacks actor signatures: {missing}"
+            )
+        # fixings attested in this tx resolve floating obligations
+        fixes = [c.value for c in tx.commands if isinstance(c.value, Fix)]
+        expected = normalize(
+            all_of(*rest, _apply_fixes(offered.result, fixes, tx))
+        )
+        if normalize(outs[0].arrangement) != expected:
+            raise TransactionVerificationError(
+                tx.id, "output arrangement is not the action's result"
+            )
+
+    # Settle: output = input minus concrete obligations (the cash movement
+    # itself is the Cash contract's concern in the same transaction).
+    def _verify_settle(self, tx, cmd, ins, outs) -> None:
+        if len(ins) != 1:
+            raise TransactionVerificationError(tx.id, "settle: one input")
+        in_parts = set(_parts(ins[0].arrangement))
+        out_arr = normalize(outs[0].arrangement) if outs else Zero()
+        out_parts = set(_parts(out_arr))
+        settled = in_parts - out_parts
+        if not settled:
+            raise TransactionVerificationError(tx.id, "settle: nothing settled")
+        if out_parts - in_parts:
+            raise TransactionVerificationError(
+                tx.id, "settle: output invents new obligations"
+            )
+        signers = _signers_of(cmd)
+        for part in settled:
+            if not isinstance(part, Obligation):
+                raise TransactionVerificationError(
+                    tx.id, "settle: only concrete obligations can settle"
+                )
+            if part.frm.owning_key.encoded not in signers:
+                raise TransactionVerificationError(
+                    tx.id, f"settle: {part.frm.name} did not sign"
+                )
+
+
+def _apply_fixes(arr, fixes, tx):
+    """Replace FloatingObligations with concrete ones per attested fixes
+    (reference fixing resolution; the Fix command is the oracle's)."""
+    parts = []
+    for part in _parts(arr):
+        if isinstance(part, FloatingObligation):
+            fix = next((f for f in fixes if f.of == part.fix_of), None)
+            if fix is None:
+                raise TransactionVerificationError(
+                    tx.id,
+                    f"floating obligation needs a Fix for {part.fix_of}",
+                )
+            qty = int(round(fix.value * part.scale))
+            parts.append(
+                Obligation(Amount(qty, part.currency), part.frm, part.to)
+            )
+        elif isinstance(part, Actions):
+            parts.append(part)  # nested fixings resolve when exercised
+        else:
+            parts.append(part)
+    return all_of(*parts)

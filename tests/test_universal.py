@@ -1,0 +1,240 @@
+"""Universal contract DSL tests (reference experimental universal-contract
+suites: Cap.kt/Swaption-style arrangements, action exercise, fixings)."""
+import pytest
+
+from corda_tpu.core.contracts import Amount, StateAndRef, StateRef, TransactionState
+from corda_tpu.core.contracts.structures import TransactionVerificationError
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization.codec import deserialize, serialize
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.experimental.universal import (
+    Action,
+    Actions,
+    All,
+    Do,
+    FloatingObligation,
+    Issue,
+    Obligation,
+    Settle,
+    UniversalState,
+    Zero,
+    all_of,
+    normalize,
+    obliged_parties,
+)
+from corda_tpu.samples.irs_demo import Fix, FixOf
+
+
+class Base:
+    def setup_method(self):
+        self.a_kp = crypto.entropy_to_keypair(700)
+        self.b_kp = crypto.entropy_to_keypair(701)
+        self.n_kp = crypto.entropy_to_keypair(702)
+        self.alice = Party("O=UAlice,L=London,C=GB", self.a_kp.public)
+        self.bob = Party("O=UBob,L=Paris,C=FR", self.b_kp.public)
+        self.notary = Party("O=UNotary,L=Zurich,C=CH", self.n_kp.public)
+
+    def _ltx(self, builder, input_states=None):
+        wtx = builder.to_wire_transaction()
+        resolved = dict(input_states or {})
+        return wtx.to_ledger_transaction(
+            resolve_state=lambda ref: resolved[ref],
+            resolve_attachment=lambda h: None,
+        )
+
+    def _fx_forward(self):
+        """EUR/USD forward: on 'execute' both legs become payable."""
+        legs = all_of(
+            Obligation(Amount(1_000_000_00, "EUR"), self.alice, self.bob),
+            Obligation(Amount(1_080_000_00, "USD"), self.bob, self.alice),
+        )
+        return UniversalState(
+            arrangement=Actions((
+                Action("execute", (self.alice, self.bob), legs),
+            )),
+            parties=(self.alice, self.bob),
+        )
+
+    def _input(self, state):
+        ref = StateRef(SecureHash.sha256(b"universal-in"), 0)
+        ts = TransactionState(data=state, notary=self.notary)
+        return ref, {ref: ts}, StateAndRef(ts, ref)
+
+
+class TestAlgebra(Base):
+    def test_all_of_normalizes(self):
+        ob = Obligation(Amount(1, "USD"), self.alice, self.bob)
+        assert all_of() == Zero()
+        assert all_of(Zero(), ob) == ob
+        nested = All((ob, All((ob, Zero()))))
+        flat = normalize(nested)
+        assert isinstance(flat, All) and len(flat.parts) == 2
+
+    def test_obliged_parties_sees_through_actions(self):
+        state = self._fx_forward()
+        assert obliged_parties(state.arrangement) == {
+            self.alice.name, self.bob.name,
+        }
+
+    def test_arrangement_round_trips_codec(self):
+        state = self._fx_forward()
+        assert deserialize(serialize(state)) == state
+
+
+class TestIssue(Base):
+    def test_issue_signed_by_both(self):
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(self._fx_forward())
+        b.add_command(Issue(), self.alice.owning_key, self.bob.owning_key)
+        self._ltx(b).verify()
+
+    def test_issue_missing_obliged_signer_rejected(self):
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(self._fx_forward())
+        b.add_command(Issue(), self.alice.owning_key)
+        with pytest.raises(TransactionVerificationError, match="obliged"):
+            self._ltx(b).verify()
+
+
+class TestDo(Base):
+    def test_execute_produces_legs(self):
+        state = self._fx_forward()
+        ref, resolved, sar = self._input(state)
+        legs = state.arrangement.actions[0].result
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=legs, parties=state.parties)
+        )
+        b.add_command(Do("execute"), self.alice.owning_key, self.bob.owning_key)
+        self._ltx(b, resolved).verify()
+
+    def test_wrong_result_rejected(self):
+        state = self._fx_forward()
+        ref, resolved, sar = self._input(state)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=Zero(), parties=state.parties)
+        )
+        b.add_command(Do("execute"), self.alice.owning_key, self.bob.owning_key)
+        with pytest.raises(TransactionVerificationError, match="not the action's result"):
+            self._ltx(b, resolved).verify()
+
+    def test_unoffered_action_rejected(self):
+        state = self._fx_forward()
+        ref, resolved, sar = self._input(state)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(state)
+        b.add_command(Do("cancel"), self.alice.owning_key, self.bob.owning_key)
+        with pytest.raises(TransactionVerificationError, match="not offered"):
+            self._ltx(b, resolved).verify()
+
+    def test_actor_signature_required(self):
+        state = self._fx_forward()
+        ref, resolved, sar = self._input(state)
+        legs = state.arrangement.actions[0].result
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=legs, parties=state.parties)
+        )
+        b.add_command(Do("execute"), self.alice.owning_key)
+        with pytest.raises(TransactionVerificationError, match="actor signatures"):
+            self._ltx(b, resolved).verify()
+
+
+class TestFixings(Base):
+    """A cap-style floating leg resolves through an oracle Fix command
+    (the same Fix type the irs-demo oracle tear-off-signs)."""
+
+    def _floating_state(self):
+        fix_of = FixOf("LIBOR", "2026-12-01", "6M")
+        floating = FloatingObligation(
+            fix_of=fix_of, scale=10_000_00, frm=self.bob, to=self.alice,
+            currency="USD",
+        )
+        return fix_of, UniversalState(
+            arrangement=Actions((
+                Action("fix", (self.alice, self.bob), floating),
+            )),
+            parties=(self.alice, self.bob),
+        )
+
+    def test_fix_resolves_floating_obligation(self):
+        fix_of, state = self._floating_state()
+        ref, resolved, sar = self._input(state)
+        expected = Obligation(
+            Amount(int(round(3.25 * 10_000_00)), "USD"), self.bob, self.alice
+        )
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=expected, parties=state.parties)
+        )
+        b.add_command(Do("fix"), self.alice.owning_key, self.bob.owning_key)
+        b.add_command(Fix(fix_of, 3.25), self.notary.owning_key)  # oracle key
+        self._ltx(b, resolved).verify()
+
+    def test_missing_fix_rejected(self):
+        fix_of, state = self._floating_state()
+        ref, resolved, sar = self._input(state)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=Zero(), parties=state.parties)
+        )
+        b.add_command(Do("fix"), self.alice.owning_key, self.bob.owning_key)
+        with pytest.raises(TransactionVerificationError, match="needs a Fix"):
+            self._ltx(b, resolved).verify()
+
+
+class TestSettle(Base):
+    def test_settle_reduces_arrangement(self):
+        legs = all_of(
+            Obligation(Amount(100, "EUR"), self.alice, self.bob),
+            Obligation(Amount(200, "USD"), self.bob, self.alice),
+        )
+        state = UniversalState(
+            arrangement=legs, parties=(self.alice, self.bob)
+        )
+        ref, resolved, sar = self._input(state)
+        remaining = Obligation(Amount(200, "USD"), self.bob, self.alice)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(
+            UniversalState(arrangement=remaining, parties=state.parties)
+        )
+        b.add_command(Settle(), self.alice.owning_key)
+        self._ltx(b, resolved).verify()
+
+    def test_settle_requires_payer_signature(self):
+        legs = Obligation(Amount(100, "EUR"), self.alice, self.bob)
+        state = UniversalState(
+            arrangement=legs, parties=(self.alice, self.bob)
+        )
+        ref, resolved, sar = self._input(state)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_command(Settle(), self.bob.owning_key)
+        with pytest.raises(TransactionVerificationError, match="did not sign"):
+            self._ltx(b, resolved).verify()
+
+    def test_settle_cannot_invent_obligations(self):
+        legs = Obligation(Amount(100, "EUR"), self.alice, self.bob)
+        state = UniversalState(
+            arrangement=legs, parties=(self.alice, self.bob)
+        )
+        ref, resolved, sar = self._input(state)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_input_state(sar)
+        b.add_output_state(UniversalState(
+            arrangement=Obligation(Amount(999, "GBP"), self.bob, self.alice),
+            parties=state.parties,
+        ))
+        b.add_command(Settle(), self.alice.owning_key)
+        with pytest.raises(TransactionVerificationError):
+            self._ltx(b, resolved).verify()
