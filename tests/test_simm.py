@@ -1,0 +1,173 @@
+"""SIMM valuation demo tests (reference simm-valuation-demo integration
+test: both nodes must agree the portfolio valuation)."""
+import numpy as np
+import pytest
+
+from corda_tpu.core.contracts.structures import TransactionVerificationError
+from corda_tpu.core.flows.library import FinalityFlow
+from corda_tpu.core.serialization.codec import deserialize, serialize
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.samples.simm_demo import (
+    DEMO_CURVE,
+    DEMO_TRADES,
+    IRSTrade,
+    PortfolioCommand,
+    PortfolioState,
+    RequestValuationFlow,
+    Valuation,
+    ValuationMismatch,
+    compute_valuation,
+    delta_ladder,
+    portfolio_pv,
+    simm_initial_margin,
+)
+from corda_tpu.testing import MockNetwork
+
+
+class TestAnalytics:
+    def test_par_swap_has_zero_pv(self):
+        # a swap struck at the par rate must be worth ~0
+        flat = tuple(0.03 for _ in DEMO_CURVE)
+        probe = IRSTrade("P", 1_000_000_00, 0.03, 5.0, True)
+        # par rate for a flat curve is near the flat rate; PV small relative
+        # to notional * duration
+        pv = portfolio_pv([probe], flat)
+        assert abs(pv) < probe.notional * 0.01
+
+    def test_payer_receiver_antisymmetry(self):
+        t = IRSTrade("X", 1_000_000_00, 0.02, 10.0, True)
+        r = IRSTrade("X", 1_000_000_00, 0.02, 10.0, False)
+        pv_pay = portfolio_pv([t], DEMO_CURVE)
+        pv_rec = portfolio_pv([r], DEMO_CURVE)
+        assert pv_pay == pytest.approx(-pv_rec, rel=1e-9)
+
+    def test_autodiff_matches_finite_difference(self):
+        """The grad delta ladder is the reference's bump-and-revalue,
+        without the bump. JAX computes in float32 by default, so the
+        central difference uses a wide bump and loose tolerance (the f32
+        noise floor on a ~1e10-minor-unit PV is ~1e3)."""
+        deltas = delta_ladder(DEMO_TRADES, DEMO_CURVE)
+        eps = 5e-4
+        for k in (1, 4, 6):
+            up = list(DEMO_CURVE)
+            up[k] += eps
+            dn = list(DEMO_CURVE)
+            dn[k] -= eps
+            fd = (
+                portfolio_pv(DEMO_TRADES, up) - portfolio_pv(DEMO_TRADES, dn)
+            ) / (2 * eps)
+            assert deltas[k] == pytest.approx(fd, rel=5e-2, abs=5e4)
+
+    def test_margin_positive_and_scales(self):
+        im1 = simm_initial_margin(DEMO_TRADES, DEMO_CURVE)
+        double = [
+            IRSTrade(t.trade_id, t.notional * 2, t.fixed_rate,
+                     t.maturity_years, t.pay_fixed)
+            for t in DEMO_TRADES
+        ]
+        im2 = simm_initial_margin(double, DEMO_CURVE)
+        assert im1 > 0
+        assert im2 == pytest.approx(2 * im1, rel=1e-6)
+
+    def test_valuation_round_trips_codec(self):
+        v = compute_valuation("P1", DEMO_TRADES[:2], DEMO_CURVE)
+        assert deserialize(serialize(v)) == v
+
+
+class TestPortfolioContract:
+    def setup_method(self):
+        from corda_tpu.core.crypto import crypto
+        from corda_tpu.core.identity import Party
+
+        self.a = Party("O=SA,L=London,C=GB", crypto.entropy_to_keypair(800).public)
+        self.b = Party("O=SB,L=Paris,C=FR", crypto.entropy_to_keypair(801).public)
+        self.n = Party("O=SN,L=Zurich,C=CH", crypto.entropy_to_keypair(802).public)
+
+    def _verify(self, builder):
+        wtx = builder.to_wire_transaction()
+        wtx.to_ledger_transaction(
+            resolve_state=lambda ref: None,
+            resolve_attachment=lambda h: None,
+        ).verify()
+
+    def test_agree_both_signed_ok(self):
+        b = TransactionBuilder(notary=self.n)
+        b.add_output_state(PortfolioState(self.a, self.b, DEMO_TRADES))
+        b.add_command(
+            PortfolioCommand("Agree"), self.a.owning_key, self.b.owning_key
+        )
+        self._verify(b)
+
+    def test_agree_one_signature_rejected(self):
+        b = TransactionBuilder(notary=self.n)
+        b.add_output_state(PortfolioState(self.a, self.b, DEMO_TRADES))
+        b.add_command(PortfolioCommand("Agree"), self.a.owning_key)
+        with pytest.raises(TransactionVerificationError, match="must sign"):
+            self._verify(b)
+
+
+class TestValuationFlows:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.a = self.net.create_node("O=SimmA,L=London,C=GB")
+        self.b = self.net.create_node("O=SimmB,L=New York,C=US")
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def _agree(self, trades=DEMO_TRADES):
+        portfolio = PortfolioState(self.a.info, self.b.info, trades)
+        builder = TransactionBuilder(notary=self.notary.info)
+        builder.add_output_state(portfolio)
+        builder.add_command(
+            PortfolioCommand("Agree"),
+            self.a.info.owning_key, self.b.info.owning_key,
+        )
+        stx = self.a.services.sign_initial_transaction(builder)
+        sig_b = self.b.services.key_management_service.sign(
+            stx.id.bytes, self.b.info.owning_key
+        )
+        stx = stx.with_additional_signature(sig_b)
+        h = self.a.start_flow(FinalityFlow(stx), stx)
+        self.net.run_network()
+        h.result.result(timeout=30)
+
+    def test_both_sides_agree(self):
+        self._agree()
+        h = self.a.start_flow(
+            RequestValuationFlow(self.b.info, "P1", DEMO_CURVE),
+            self.b.info, "P1", DEMO_CURVE,
+        )
+        self.net.run_network()
+        valuation = h.result.result(timeout=60)
+        assert isinstance(valuation, Valuation)
+        expected = compute_valuation("P1", DEMO_TRADES, DEMO_CURVE)
+        assert valuation == expected
+
+    def test_divergent_books_detected(self):
+        """The two sides hold different books -> the agreement round must
+        fail with ValuationMismatch, not silently accept."""
+
+        def record_local(node, trades):
+            state = PortfolioState(self.a.info, self.b.info, trades)
+            builder = TransactionBuilder(notary=self.notary.info)
+            builder.add_output_state(state)
+            builder.add_command(
+                PortfolioCommand("Agree"),
+                self.a.info.owning_key, self.b.info.owning_key,
+            )
+            stx = node.services.sign_initial_transaction(builder)
+            node.services.record_transactions([stx])
+
+        record_local(self.a, DEMO_TRADES)
+        record_local(
+            self.b, (IRSTrade("R", 999_000_000_00, 0.05, 30.0, True),)
+        )
+        h = self.a.start_flow(
+            RequestValuationFlow(self.b.info, "P1", DEMO_CURVE),
+            self.b.info, "P1", DEMO_CURVE,
+        )
+        self.net.run_network()
+        with pytest.raises(ValuationMismatch):
+            h.result.result(timeout=60)
