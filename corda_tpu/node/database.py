@@ -193,6 +193,24 @@ class AttachmentStorage:
             )
         )
 
+    def attachment_size(self, att_id: SecureHash):
+        rows = self.db.query(
+            "SELECT length(data) FROM attachments WHERE att_id = ?",
+            (att_id.bytes,),
+        )
+        return rows[0][0] if rows else None
+
+    def read_chunk(self, att_id: SecureHash, offset: int, length: int):
+        """Byte range without materialising the whole blob on the server —
+        sqlite substr() slices in-engine (reference: large attachments
+        stream via Artemis minLargeMessageSize, NodeMessagingClient.kt:172;
+        here the chunk RPC protocol is the streaming seam)."""
+        rows = self.db.query(
+            "SELECT substr(data, ?, ?) FROM attachments WHERE att_id = ?",
+            (offset + 1, length, att_id.bytes),  # substr is 1-based
+        )
+        return rows[0][0] if rows else None
+
 
 class KVStore:
     """Generic named blob map (the JDBCHashMap replacement)."""

@@ -17,7 +17,7 @@ from typing import Any, Dict
 
 from ..core.serialization.codec import deserialize, serialize
 from ..messaging import Broker
-from ..utils.observable import DataFeed, Observable
+from ..utils.observable import DataFeed, Observable, ReplayObservable
 from .server import RPC_SERVER_QUEUE
 
 
@@ -74,6 +74,7 @@ class CordaRPCClient:
         broker.create_queue(self._reply_queue)
         self._pending: Dict[str, Future] = {}
         self._observables: Dict[str, Observable] = {}
+        self._early_observations: Dict[str, list] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(self._reply_queue)
@@ -140,6 +141,13 @@ class CordaRPCClient:
                 elif kind == "observation":
                     with self._lock:
                         obs = self._observables.get(payload["obs_id"])
+                        if obs is None:
+                            # observation raced ahead of its reply (the
+                            # server may emit during marshal): buffer until
+                            # _client_observable registers the id
+                            self._early_observations.setdefault(
+                                payload["obs_id"], []
+                            ).append(payload["value"])
                     if obs is not None:
                         obs.on_next(payload["value"])
             except Exception as exc:
@@ -158,9 +166,15 @@ class CordaRPCClient:
             self._consumer.ack(msg)
 
     def _client_observable(self, obs_id: str) -> Observable:
-        obs = Observable()
+        # ReplayObservable: values arriving before the consumer subscribes
+        # (either buffered below or landing between unmarshal and the
+        # consumer's subscribe call) are held and flushed on subscribe
+        obs = ReplayObservable()
         with self._lock:
             self._observables[obs_id] = obs
+            early = self._early_observations.pop(obs_id, [])
+        for value in early:
+            obs.on_next(value)
         return obs
 
     def _unmarshal(self, value):
@@ -170,4 +184,6 @@ class CordaRPCClient:
             )
         if isinstance(value, dict) and "__observable__" in value:
             return self._client_observable(value["__observable__"])
+        if isinstance(value, list):
+            return [self._unmarshal(v) for v in value]
         return value

@@ -40,6 +40,7 @@ class CordaRPCOps:
         self._state_machine_updates = Observable()
         self._tx_updates = Observable()
         self._vault_updates = Observable()
+        self._uploads: Dict[str, bytearray] = {}
         smm.track(self._on_smm_event)
         services.validated_transactions.track(self._tx_updates.on_next)
         services.vault_service.track(
@@ -55,9 +56,10 @@ class CordaRPCOps:
 
     # -- flows ---------------------------------------------------------------
 
-    def start_flow_dynamic(self, flow_name: str, *args, **kwargs):
-        """Start a registered @startable_by_rpc flow by name; returns the
-        flow id (result retrieved via flow_result / state machine feed)."""
+    @staticmethod
+    def _resolve_rpc_flow(flow_name: str):
+        """Registry lookup (full name or class-name suffix) + the
+        @startable_by_rpc gate, shared by both start methods."""
         cls = flow_registry.get(flow_name) or next(
             (c for n, c in flow_registry.items()
              if n.rsplit(".", 1)[-1] == flow_name),
@@ -67,9 +69,47 @@ class CordaRPCOps:
             raise ValueError(f"unknown flow {flow_name}")
         if not getattr(cls, "_startable_by_rpc", False):
             raise PermissionError(f"{flow_name} is not @startable_by_rpc")
+        return cls
+
+    def start_flow_dynamic(self, flow_name: str, *args, **kwargs):
+        """Start a registered @startable_by_rpc flow by name; returns the
+        flow id (result retrieved via flow_result / state machine feed)."""
+        cls = self._resolve_rpc_flow(flow_name)
         flow = cls(*args, **kwargs)
         handle = self._smm.start_flow(flow, *args, **kwargs)
         return handle.flow_id
+
+    def registered_flows(self) -> List[str]:
+        """Names startable over RPC (reference CordaRPCOps.registeredFlows)."""
+        return sorted(
+            name for name, cls in flow_registry.items()
+            if getattr(cls, "_startable_by_rpc", False)
+        )
+
+    def start_tracked_flow_dynamic(self, flow_name: str, *args, **kwargs):
+        """Start a flow and stream its ProgressTracker steps (reference
+        startTrackedFlowDynamic -> FlowProgressHandle). Returns
+        (flow_id, DataFeed(steps fired so far, step updates)).
+
+        The snapshot is the LIVE fired-steps list: the RPC server
+        serializes it at marshal time, after subscribing the update
+        observable — so no step can be lost to the gap between method
+        return and subscription, though a step landing exactly in that
+        window may appear in both snapshot and stream (consumers must
+        tolerate a replayed boundary step)."""
+        cls = self._resolve_rpc_flow(flow_name)
+        flow = cls(*args, **kwargs)
+        progress = Observable()
+        fired: List[str] = []
+        tracker = getattr(flow, "progress_tracker", None)
+        if tracker is not None:
+            def on_step(label: str) -> None:
+                fired.append(label)
+                progress.on_next(label)
+
+            tracker.subscribe(on_step)
+        handle = self._smm.start_flow(flow, *args, **kwargs)
+        return handle.flow_id, DataFeed(fired, progress)
 
     def flow_result(self, flow_id: str, timeout: Optional[float] = None):
         fsm = self._smm.flows.get(flow_id)
@@ -122,7 +162,16 @@ class CordaRPCOps:
 
     # -- attachments ---------------------------------------------------------
 
+    #: per-attachment ceiling (reference Artemis MAX_FILE_SIZE)
+    MAX_ATTACHMENT_SIZE = 64 * 1024 * 1024
+    #: chunk size for the streaming protocol (reference minLargeMessageSize)
+    ATTACHMENT_CHUNK = 512 * 1024
+
     def upload_attachment(self, data: bytes) -> SecureHash:
+        if len(data) > self.MAX_ATTACHMENT_SIZE:
+            raise ValueError(
+                f"attachment exceeds {self.MAX_ATTACHMENT_SIZE} bytes"
+            )
         return self._services.attachments.import_attachment(data)
 
     def open_attachment(self, att_id: SecureHash) -> Optional[bytes]:
@@ -131,6 +180,63 @@ class CordaRPCOps:
 
     def attachment_exists(self, att_id: SecureHash) -> bool:
         return self._services.attachments.has_attachment(att_id)
+
+    # Large attachments stream in bounded chunks so neither the broker
+    # frames nor server memory hold whole blobs (the SURVEY §5
+    # "large-attachment streaming" scale axis; reference Artemis
+    # minLargeMessageSize/MAX_FILE_SIZE machinery).
+
+    def attachment_size(self, att_id: SecureHash) -> Optional[int]:
+        return self._services.attachments.attachment_size(att_id)
+
+    def attachment_chunk(
+        self, att_id: SecureHash, offset: int, length: Optional[int] = None
+    ) -> Optional[bytes]:
+        if length is None:
+            length = self.ATTACHMENT_CHUNK
+        length = min(length, self.ATTACHMENT_CHUNK)
+        if length <= 0:
+            return b""
+        return self._services.attachments.read_chunk(att_id, offset, length)
+
+    #: abandoned chunked uploads are evicted after this many seconds
+    UPLOAD_TTL = 3600.0
+    MAX_CONCURRENT_UPLOADS = 16
+
+    def _purge_uploads(self) -> None:
+        cutoff = time.monotonic() - self.UPLOAD_TTL
+        stale = [k for k, (_, t0) in self._uploads.items() if t0 < cutoff]
+        for k in stale:
+            del self._uploads[k]
+
+    def upload_attachment_begin(self) -> str:
+        import uuid
+
+        self._purge_uploads()
+        if len(self._uploads) >= self.MAX_CONCURRENT_UPLOADS:
+            raise ValueError("too many concurrent uploads")
+        upload_id = str(uuid.uuid4())  # unguessable: sessions are private
+        self._uploads[upload_id] = (bytearray(), time.monotonic())
+        return upload_id
+
+    def upload_attachment_chunk(self, upload_id: str, data: bytes) -> int:
+        entry = self._uploads.get(upload_id)
+        if entry is None:
+            raise ValueError(f"unknown upload {upload_id}")
+        buf, _ = entry
+        if len(buf) + len(data) > self.MAX_ATTACHMENT_SIZE:
+            del self._uploads[upload_id]
+            raise ValueError(
+                f"attachment exceeds {self.MAX_ATTACHMENT_SIZE} bytes"
+            )
+        buf.extend(data)
+        return len(buf)
+
+    def upload_attachment_end(self, upload_id: str) -> SecureHash:
+        entry = self._uploads.pop(upload_id, None)
+        if entry is None:
+            raise ValueError(f"unknown upload {upload_id}")
+        return self._services.attachments.import_attachment(bytes(entry[0]))
 
     # -- network / identity --------------------------------------------------
 

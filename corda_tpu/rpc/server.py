@@ -183,13 +183,22 @@ class RPCServer:
 
     def _marshal(self, value, session: str, reply_to: str):
         if isinstance(value, DataFeed):
+            # subscribe BEFORE reading the snapshot: feeds with live
+            # snapshot lists (start_tracked_flow_dynamic) rely on this
+            # order so no update falls between snapshot and subscription
+            obs_id = self._register_observable(value.updates, session, reply_to)
             return {
                 "__datafeed__": True,
-                "snapshot": value.snapshot,
-                "obs": self._register_observable(value.updates, session, reply_to),
+                "snapshot": list(value.snapshot)
+                if isinstance(value.snapshot, list) else value.snapshot,
+                "obs": obs_id,
             }
         if isinstance(value, Observable):
             return {"__observable__": self._register_observable(value, session, reply_to)}
+        if isinstance(value, (list, tuple)):
+            # feeds may ride inside composite results, e.g.
+            # start_tracked_flow_dynamic's (flow_id, progress DataFeed)
+            return [self._marshal(v, session, reply_to) for v in value]
         return value
 
     def _register_observable(

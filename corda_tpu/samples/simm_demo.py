@@ -39,7 +39,6 @@ from ..core.flows.api import (
 )
 from ..core.identity import Party
 from ..core.serialization.codec import corda_serializable
-from dataclasses import field
 
 
 # --- trade + portfolio model -------------------------------------------------
@@ -75,6 +74,7 @@ class PortfolioState(ContractState):
     party_a: Party = None
     party_b: Party = None
     trades: Tuple = ()
+    portfolio_id: str = ""  # the id valuation requests select on
     contract_name = "corda_tpu.samples.Portfolio"
 
     def __post_init__(self):
@@ -209,6 +209,21 @@ class ValuationMismatch(FlowException):
     pass
 
 
+def _portfolio_by_id(hub, portfolio_id: str):
+    """The unconsumed PortfolioState matching the requested id (both sides
+    must price the SAME book, not whichever state comes first)."""
+    states = hub.vault_service.unconsumed_states(
+        PortfolioState.contract_name
+    )
+    return next(
+        (
+            s.state.data for s in states
+            if s.state.data.portfolio_id == portfolio_id
+        ),
+        None,
+    )
+
+
 @initiating_flow
 @startable_by_rpc
 class RequestValuationFlow(FlowLogic):
@@ -221,14 +236,11 @@ class RequestValuationFlow(FlowLogic):
         self.curve = tuple(curve)
 
     def _my_valuation(self):
-        states = self.service_hub.vault_service.unconsumed_states(
-            PortfolioState.contract_name
-        )
-        portfolio = next(
-            (s.state.data for s in states), None
-        )
+        portfolio = _portfolio_by_id(self.service_hub, self.portfolio_id)
         if portfolio is None:
-            raise FlowException("no portfolio in the vault")
+            raise FlowException(
+                f"no portfolio {self.portfolio_id!r} in the vault"
+            )
         return compute_valuation(
             self.portfolio_id, portfolio.trades, self.curve
         )
@@ -256,12 +268,11 @@ class RespondValuationFlow(FlowLogic):
     def call(self):
         req = yield self.receive(self.counterparty, list)
         portfolio_id, curve = req[0], tuple(req[1])
-        states = self.service_hub.vault_service.unconsumed_states(
-            PortfolioState.contract_name
-        )
-        portfolio = next((s.state.data for s in states), None)
+        portfolio = _portfolio_by_id(self.service_hub, portfolio_id)
         if portfolio is None:
-            raise FlowException("responder has no portfolio")
+            raise FlowException(
+                f"responder has no portfolio {portfolio_id!r}"
+            )
         valuation = yield self.record(
             lambda: compute_valuation(portfolio_id, portfolio.trades, curve)
         )
@@ -303,7 +314,9 @@ def main(verbose: bool = True) -> dict:
     bank_b = net.create_node("O=Bank B,L=New York,C=US")
 
     # agree the portfolio (both sign; broadcast via finality)
-    portfolio = PortfolioState(bank_a.info, bank_b.info, DEMO_TRADES)
+    portfolio = PortfolioState(
+        bank_a.info, bank_b.info, DEMO_TRADES, "PORTFOLIO-1"
+    )
     builder = TransactionBuilder(notary=notary.info)
     builder.add_output_state(portfolio)
     builder.add_command(

@@ -93,6 +93,33 @@ class Observable(Generic[T]):
             return len(self._subs)
 
 
+class ReplayObservable(Observable):
+    """Buffers values emitted while nobody is subscribed and flushes them
+    to the first subscriber — closes the subscribe-after-emit races
+    inherent in RPC feed plumbing (values can arrive between a DataFeed's
+    construction and the consumer's subscribe call)."""
+
+    def __init__(self):
+        super().__init__()
+        self._buffer: List = []
+
+    def on_next(self, value) -> None:
+        with self._lock:
+            if not self._subs and not self._done:
+                self._buffer.append(value)
+                return
+        super().on_next(value)
+
+    def subscribe(self, on_next, on_error=None, on_completed=None) -> Subscription:
+        sub = super().subscribe(on_next, on_error, on_completed)
+        with self._lock:
+            buffered, self._buffer = self._buffer, []
+        for value in buffered:
+            if sub.active:
+                on_next(value)
+        return sub
+
+
 @dataclass
 class DataFeed(Generic[T]):
     """snapshot + updates (reference CordaRPCOps DataFeed)."""

@@ -52,7 +52,12 @@ class WebServer:
                 try:
                     outer._get(self)
                 except Exception as exc:
-                    self._json(500, {"error": str(exc)})
+                    if getattr(self, "_streaming", False):
+                        # headers already sent: a JSON 500 would corrupt
+                        # the body; drop the connection instead
+                        self.close_connection = True
+                    else:
+                        self._json(500, {"error": str(exc)})
 
             def do_POST(self):
                 try:
@@ -120,11 +125,29 @@ class WebServer:
             req._json(200, self.ops.node_metrics())
         elif m := re.fullmatch(r"/api/attachments/([0-9A-Fa-f]{64})", path):
             att_id = SecureHash(bytes.fromhex(m.group(1)))
-            data = self.ops.open_attachment(att_id)
-            if data is None:
+            size = self.ops.attachment_size(att_id)
+            if size is None:
                 req._json(404, {"error": "no such attachment"})
             else:
-                req._send(200, data, "application/octet-stream")
+                # stream in bounded chunks: neither this gateway nor the
+                # RPC frames ever hold the whole blob
+                req.send_response(200)
+                req.send_header("Content-Type", "application/octet-stream")
+                req.send_header("Content-Length", str(size))
+                req.end_headers()
+                req._streaming = True  # headers sent: no JSON error now
+                offset = 0
+                while offset < size:
+                    chunk = self.ops.attachment_chunk(att_id, offset)
+                    if not chunk:
+                        # can't honour Content-Length: kill the connection
+                        # rather than hand the client a short 200 body
+                        req.close_connection = True
+                        raise IOError(
+                            f"attachment {att_id} truncated at {offset}"
+                        )
+                    req.wfile.write(chunk)
+                    offset += len(chunk)
         elif m := re.fullmatch(r"/api/flows/([0-9a-f-]{36})", path):
             try:
                 result = self.ops.flow_result(m.group(1), timeout=10)
@@ -139,7 +162,22 @@ class WebServer:
         body = req.rfile.read(length) if length else b""
         path = req.path
         if path == "/api/attachments":
-            att_id = self.ops.upload_attachment(body)
+            # class constant, NOT getattr on self.ops: an RPC proxy
+            # fabricates a callable for any attribute name
+            from ..rpc.ops import CordaRPCOps
+
+            chunk = CordaRPCOps.ATTACHMENT_CHUNK
+            if len(body) > chunk:
+                # large upload rides the chunk protocol so no single RPC
+                # frame carries the whole blob
+                upload_id = self.ops.upload_attachment_begin()
+                for off in range(0, len(body), chunk):
+                    self.ops.upload_attachment_chunk(
+                        upload_id, body[off : off + chunk]
+                    )
+                att_id = self.ops.upload_attachment_end(upload_id)
+            else:
+                att_id = self.ops.upload_attachment(body)
             req._json(200, {"id": att_id})
         elif m := re.fullmatch(r"/api/flows/([A-Za-z0-9_.]+)", path):
             args = from_json_value(json.loads(body.decode() or "[]"))

@@ -1,0 +1,195 @@
+"""Widened RPC surface tests: registered flows, tracked flow progress,
+chunked attachment streaming (reference CordaRPCOps.kt:61-259 breadth +
+Artemis large-message streaming)."""
+import urllib.request
+
+import pytest
+
+from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+from corda_tpu.core.flows.api import ProgressTracker
+from corda_tpu.messaging import Broker
+from corda_tpu.rpc import (
+    CordaRPCClient,
+    CordaRPCOps,
+    RPCServer,
+    RPCUser,
+)
+from corda_tpu.testing import MockNetwork
+
+
+@startable_by_rpc
+class TrackedFlow(FlowLogic):
+    STEP_A = ProgressTracker.Step("FIRST")
+    STEP_B = ProgressTracker.Step("SECOND")
+
+    def __init__(self):
+        self.progress_tracker = ProgressTracker(self.STEP_A, self.STEP_B)
+
+    def call(self):
+        self.progress_tracker.set_current_step(self.STEP_A)
+        self.progress_tracker.set_current_step(self.STEP_B)
+        return "tracked-done"
+        yield  # pragma: no cover
+
+
+from corda_tpu.core.flows.api import initiated_by, initiating_flow  # noqa: E402
+
+
+@initiating_flow
+@startable_by_rpc
+class TrackedEchoFlow(FlowLogic):
+    """Suspends between steps so the second one streams asynchronously."""
+
+    STEP_A = ProgressTracker.Step("ASK")
+    STEP_B = ProgressTracker.Step("GOT")
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.progress_tracker = ProgressTracker(self.STEP_A, self.STEP_B)
+
+    def call(self):
+        self.progress_tracker.set_current_step(self.STEP_A)
+        reply = yield self.send_and_receive(self.peer, "ping", str)
+        self.progress_tracker.set_current_step(self.STEP_B)
+        return reply
+
+
+@initiated_by(TrackedEchoFlow)
+class TrackedEchoResponder(FlowLogic):
+    def __init__(self, counterparty):
+        self.counterparty = counterparty
+
+    def call(self):
+        msg = yield self.receive(self.counterparty, str)
+        yield self.send(self.counterparty, msg + "-pong")
+
+
+class TestOverRpcClient:
+    """Everything through the real client/server marshal path."""
+
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.node = self.net.create_node("O=Breadth,L=London,C=GB")
+        self.broker = Broker()
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+        self.server = RPCServer(
+            self.broker, self.ops, users=[RPCUser("admin", "secret")]
+        )
+        self.client = CordaRPCClient(self.broker)
+        self.conn = self.client.start("admin", "secret")
+        self.proxy = self.conn.proxy
+
+    def teardown_method(self):
+        self.conn.close()
+        self.client.close()
+        self.server.stop()
+        self.net.stop_nodes()
+
+    def test_registered_flows(self):
+        flows = self.proxy.registered_flows()
+        assert any(f.endswith("TrackedFlow") for f in flows)
+        assert all(isinstance(f, str) for f in flows)
+
+    def test_synchronous_steps_ride_the_snapshot(self):
+        flow_id, feed = self.proxy.start_tracked_flow_dynamic("TrackedFlow")
+        assert feed.snapshot == ["FIRST", "SECOND"]
+        assert self.proxy.flow_result(flow_id, 10) == "tracked-done"
+
+    def test_post_suspension_steps_stream(self):
+        peer = self.net.create_node("O=EchoPeer,L=Paris,C=FR")
+        self.node.register_peer(peer.info)
+        peer.register_peer(self.node.info)
+        flow_id, feed = self.proxy.start_tracked_flow_dynamic(
+            "TrackedEchoFlow", peer.info
+        )
+        steps = []
+        feed.updates.subscribe(steps.append)
+        assert feed.snapshot == ["ASK"]  # fired before suspension
+        self.net.run_network()
+        assert self.proxy.flow_result(flow_id, 10) == "ping-pong"
+        import time
+
+        deadline = time.monotonic() + 5
+        while not steps and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert steps == ["GOT"]  # streamed over the observable channel
+
+    def test_chunked_attachment_round_trip(self):
+        blob = bytes(range(256)) * 8192  # 2 MiB, > one chunk
+        upload_id = self.proxy.upload_attachment_begin()
+        chunk = 512 * 1024
+        for off in range(0, len(blob), chunk):
+            n = self.proxy.upload_attachment_chunk(
+                upload_id, blob[off : off + chunk]
+            )
+        assert n == len(blob)
+        att_id = self.proxy.upload_attachment_end(upload_id)
+        assert self.proxy.attachment_size(att_id) == len(blob)
+        out = bytearray()
+        offset = 0
+        while offset < len(blob):
+            part = self.proxy.attachment_chunk(att_id, offset)
+            assert len(part) <= CordaRPCOps.ATTACHMENT_CHUNK
+            out.extend(part)
+            offset += len(part)
+        assert bytes(out) == blob
+
+    def test_unknown_upload_rejected(self):
+        with pytest.raises(Exception, match="unknown upload"):
+            self.proxy.upload_attachment_chunk("nope", b"x")
+
+
+class TestSizeCap:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.node = self.net.create_node("O=Cap,L=London,C=GB")
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def test_oversize_single_shot_rejected(self, monkeypatch):
+        monkeypatch.setattr(CordaRPCOps, "MAX_ATTACHMENT_SIZE", 1024)
+        with pytest.raises(ValueError, match="exceeds"):
+            self.ops.upload_attachment(b"x" * 2048)
+
+    def test_oversize_chunked_rejected_and_cleaned(self, monkeypatch):
+        monkeypatch.setattr(CordaRPCOps, "MAX_ATTACHMENT_SIZE", 1024)
+        upload_id = self.ops.upload_attachment_begin()
+        self.ops.upload_attachment_chunk(upload_id, b"x" * 1000)
+        with pytest.raises(ValueError, match="exceeds"):
+            self.ops.upload_attachment_chunk(upload_id, b"x" * 1000)
+        # the aborted upload is gone
+        with pytest.raises(ValueError, match="unknown upload"):
+            self.ops.upload_attachment_end(upload_id)
+
+
+class TestWebserverStreaming:
+    def test_large_attachment_streams(self):
+        from corda_tpu.webserver import WebServer
+
+        net = MockNetwork()
+        node = net.create_node("O=Stream,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        web = WebServer(ops, port=0)
+        try:
+            blob = b"\xab" * (1_500_000)  # > 2 chunks
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{web.port}/api/attachments",
+                data=blob, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                assert resp.status == 200
+            from corda_tpu.core.crypto.secure_hash import SecureHash
+
+            att_id = SecureHash.sha256(blob)
+            assert ops.attachment_exists(att_id)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{web.port}/api/attachments/"
+                + att_id.bytes.hex(),
+                timeout=15,
+            ) as resp:
+                assert resp.read() == blob
+        finally:
+            web.stop()
+            net.stop_nodes()

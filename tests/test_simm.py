@@ -117,7 +117,7 @@ class TestValuationFlows:
         self.net.stop_nodes()
 
     def _agree(self, trades=DEMO_TRADES):
-        portfolio = PortfolioState(self.a.info, self.b.info, trades)
+        portfolio = PortfolioState(self.a.info, self.b.info, trades, "P1")
         builder = TransactionBuilder(notary=self.notary.info)
         builder.add_output_state(portfolio)
         builder.add_command(
@@ -145,12 +145,41 @@ class TestValuationFlows:
         expected = compute_valuation("P1", DEMO_TRADES, DEMO_CURVE)
         assert valuation == expected
 
+    def test_selects_portfolio_by_id_among_many(self):
+        self._agree()  # P1 = DEMO_TRADES
+        other = (IRSTrade("O", 1_000_000_00, 0.02, 2.0, True),)
+        portfolio = PortfolioState(self.a.info, self.b.info, other, "P2")
+        builder = TransactionBuilder(notary=self.notary.info)
+        builder.add_output_state(portfolio)
+        builder.add_command(
+            PortfolioCommand("Agree"),
+            self.a.info.owning_key, self.b.info.owning_key,
+        )
+        stx = self.a.services.sign_initial_transaction(builder)
+        sig_b = self.b.services.key_management_service.sign(
+            stx.id.bytes, self.b.info.owning_key
+        )
+        h = self.a.start_flow(
+            FinalityFlow(stx.with_additional_signature(sig_b)),
+            stx.with_additional_signature(sig_b),
+        )
+        self.net.run_network()
+        h.result.result(timeout=30)
+        # valuing P2 prices `other`, not whichever state is first
+        h = self.a.start_flow(
+            RequestValuationFlow(self.b.info, "P2", DEMO_CURVE),
+            self.b.info, "P2", DEMO_CURVE,
+        )
+        self.net.run_network()
+        valuation = h.result.result(timeout=60)
+        assert valuation == compute_valuation("P2", other, DEMO_CURVE)
+
     def test_divergent_books_detected(self):
         """The two sides hold different books -> the agreement round must
         fail with ValuationMismatch, not silently accept."""
 
         def record_local(node, trades):
-            state = PortfolioState(self.a.info, self.b.info, trades)
+            state = PortfolioState(self.a.info, self.b.info, trades, "P1")
             builder = TransactionBuilder(notary=self.notary.info)
             builder.add_output_state(state)
             builder.add_command(
