@@ -1,0 +1,90 @@
+"""Notarisation latency measurement (BASELINE.md: p50 notarise latency at
+an N-tx uniqueness batch; reference measurement infrastructure:
+`tools/loadtest/.../NotaryTest.kt` + `test-utils/.../performance/`).
+
+Builds a burst of pre-signed spend transactions (distinct inputs, so no
+conflicts), pushes every one through the full NotaryFlow client/service
+round — signature check, uniqueness commit, notary signature — and
+reports per-transaction latency percentiles.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from ..core.contracts import Amount
+from ..core.contracts.structures import StateAndRef
+from ..core.transactions.builder import TransactionBuilder
+from ..finance.cash import CashCommand, CashState
+from ..core.contracts.amount import Issued
+
+
+def measure_notarise_latency(
+    n_tx: int = 512, validating: bool = True, verbose: bool = False
+) -> Dict[str, float]:
+    """Returns {"p50_ms", "p95_ms", "mean_ms", "n_tx", "wall_s"}."""
+    from ..node.notary import NotaryClientFlow
+    from ..testing.mocknetwork import MockNetwork
+
+    net = MockNetwork()
+    notary = net.create_notary_node(validating=validating)
+    bank = net.create_node("O=LatencyBank,L=London,C=GB")
+    token = Issued(bank.info.ref(1), "USD")
+
+    # one issue tx with n_tx outputs -> n_tx independent spendable states
+    builder = TransactionBuilder(notary=notary.info)
+    for _ in range(n_tx):
+        builder.add_output_state(
+            CashState(amount=Amount(100, token), owner=bank.info)
+        )
+    builder.add_command(CashCommand.Issue(), bank.info.owning_key)
+    issue_stx = bank.services.sign_initial_transaction(builder)
+    bank.services.record_transactions([issue_stx])
+
+    from ..core.contracts.structures import StateRef
+
+    # pre-sign one move per output (builds excluded from the timed span)
+    moves = []
+    for i in range(n_tx):
+        ref = StateRef(issue_stx.id, i)
+        ts = bank.services.load_state(ref)
+        b = TransactionBuilder(notary=notary.info)
+        b.add_input_state(StateAndRef(ts, ref))
+        b.add_output_state(
+            CashState(amount=Amount(100, token), owner=bank.info)
+        )
+        b.add_command(CashCommand.Move(), bank.info.owning_key)
+        moves.append(bank.services.sign_initial_transaction(b))
+
+    latencies: List[float] = []
+    t_start = time.perf_counter()
+    for stx in moves:
+        t0 = time.perf_counter()
+        h = bank.start_flow(NotaryClientFlow(stx), stx)
+        net.run_network()
+        sigs = h.result.result(timeout=60)
+        latencies.append(time.perf_counter() - t0)
+        assert sigs, "notary returned no signatures"
+    wall = time.perf_counter() - t_start
+    net.stop_nodes()
+
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        return latencies[min(len(latencies) - 1, int(q * len(latencies)))]
+
+    out = {
+        "p50_ms": round(pct(0.50) * 1000, 3),
+        "p95_ms": round(pct(0.95) * 1000, 3),
+        "mean_ms": round(sum(latencies) / len(latencies) * 1000, 3),
+        "n_tx": n_tx,
+        "wall_s": round(wall, 3),
+        "notarisations_per_sec": round(n_tx / wall, 1),
+    }
+    if verbose:
+        print(out)
+    return out
+
+
+if __name__ == "__main__":
+    measure_notarise_latency(verbose=True)

@@ -1,0 +1,69 @@
+"""Distributed-service cluster identity (reference
+`node/.../utilities/ServiceIdentityGenerator.kt` + the composite service
+keys Raft/BFT notary clusters advertise).
+
+A notary cluster presents ONE identity to clients: a `CompositeKey` over
+the members' keys with a threshold (Raft: 1 — any leader's signature
+settles it; BFT: f+1 — enough distinct replicas must co-sign). Clients
+address the cluster Party and validate the returned signature set
+*collectively* against the composite key.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+from ..core.crypto.composite import CompositeKey
+from ..core.crypto.keys import PublicKey
+from ..core.identity import Party
+
+
+def generate_service_identity(
+    service_name: str,
+    member_keys: Sequence[PublicKey],
+    threshold: Optional[int] = None,
+) -> Party:
+    """Composite cluster Party over the members' keys.
+
+    threshold defaults to 1 (CFT semantics: any current leader's signature
+    is authoritative, reference RaftUniquenessProvider clusters); BFT
+    clusters pass f+1.
+    """
+    if not member_keys:
+        raise ValueError("a cluster needs at least one member")
+    threshold = 1 if threshold is None else threshold
+    if not (1 <= threshold <= len(member_keys)):
+        raise ValueError(
+            f"threshold {threshold} invalid for {len(member_keys)} members"
+        )
+    builder = CompositeKey.Builder()
+    for key in member_keys:
+        builder.add_key(key, weight=1)
+    return Party(service_name, builder.build(threshold))
+
+
+def write_service_identity(party: Party, out_dir: str) -> str:
+    """Persist the cluster identity for distribution to members/clients
+    (reference ServiceIdentityGenerator writes cluster keys to disk)."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "service-identity.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "name": party.name,
+                "composite_key": party.owning_key.encoded.hex(),
+            },
+            fh,
+        )
+    return path
+
+
+def load_service_identity(path: str) -> Party:
+    from ..core.crypto.composite import decode_composite_key
+
+    with open(path) as fh:
+        data = json.load(fh)
+    return Party(
+        data["name"], decode_composite_key(bytes.fromhex(data["composite_key"]))
+    )

@@ -338,12 +338,26 @@ class NotaryClientFlow(FlowLogic):
         if not sigs:
             raise NotaryException("notary returned no signatures")
         for sig in sigs:
-            if not notary.owning_key.is_fulfilled_by({sig.by}):
+            # every signer must belong to the notary identity (leaf of a
+            # composite cluster key, or the key itself)
+            leaf_keys = getattr(
+                notary.owning_key, "keys", frozenset({notary.owning_key})
+            )
+            if sig.by not in leaf_keys and not notary.owning_key.is_fulfilled_by(
+                {sig.by}
+            ):
                 raise NotaryException(
                     f"signature from {sig.by} is not the notary's"
                 )
             if not sig.is_valid(stx.id.bytes):
                 raise NotaryException("invalid notary signature")
+        # COLLECTIVE fulfillment: a composite cluster identity (reference
+        # distributed notary service keys) may need several distinct
+        # members' signatures to reach its threshold (BFT: f+1)
+        if not notary.owning_key.is_fulfilled_by({s.by for s in sigs}):
+            raise NotaryException(
+                "notary signatures do not fulfil the cluster identity"
+            )
         return sigs
 
 

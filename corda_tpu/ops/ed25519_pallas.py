@@ -371,8 +371,11 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
 
     The Pallas kernel backs `write_table`/`read_table`/`write_idx`/
     `read_idx` with VMEM scratch refs; the off-TPU unit test backs them
-    with plain arrays (tests/test_ops_ed25519.py), so every field/point/
-    ladder step is exercised without TPU hardware."""
+    with dict-buffered arrays + dynamic_slice reads
+    (tests/test_ops_ed25519.py), so every field/point/ladder step — under
+    the same lax.fori_loop control flow — is exercised without TPU
+    hardware. unroll_ladder=True remains for debugging with accessors that
+    need concrete indices."""
     # Decompress A and R lane-concatenated: one pow chain for both.
     pts, oks = _decompress(
         jnp.concatenate([y_a, y_r], axis=1),

@@ -1,0 +1,92 @@
+"""Cluster service identity tests (reference ServiceIdentityGenerator +
+distributed notary composite keys)."""
+import pytest
+
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.crypto.signing import sign_bytes
+from corda_tpu.node.cluster_identity import (
+    generate_service_identity,
+    load_service_identity,
+    write_service_identity,
+)
+
+
+def _members(n):
+    return [crypto.entropy_to_keypair(900 + i) for i in range(n)]
+
+
+class TestGenerator:
+    def test_composite_identity_thresholds(self):
+        kps = _members(3)
+        pub_keys = [kp.public for kp in kps]
+        cluster = generate_service_identity(
+            "O=NotaryCluster,L=Zurich,C=CH", pub_keys, threshold=2
+        )
+        # one member is not enough, two distinct members are
+        assert not cluster.owning_key.is_fulfilled_by({pub_keys[0]})
+        assert cluster.owning_key.is_fulfilled_by({pub_keys[0], pub_keys[2]})
+
+    def test_default_threshold_is_one(self):
+        kps = _members(3)
+        cluster = generate_service_identity(
+            "O=Raft,L=Z,C=CH", [kp.public for kp in kps]
+        )
+        assert cluster.owning_key.is_fulfilled_by({kps[1].public})
+
+    def test_invalid_inputs(self):
+        with pytest.raises(ValueError):
+            generate_service_identity("O=X,L=Y,C=ZZ", [])
+        kps = _members(2)
+        with pytest.raises(ValueError):
+            generate_service_identity(
+                "O=X,L=Y,C=ZZ", [k.public for k in kps], threshold=3
+            )
+
+    def test_round_trips_disk(self, tmp_path):
+        kps = _members(3)
+        cluster = generate_service_identity(
+            "O=C,L=Z,C=CH", [kp.public for kp in kps], threshold=2
+        )
+        path = write_service_identity(cluster, str(tmp_path))
+        loaded = load_service_identity(path)
+        assert loaded.name == cluster.name
+        assert loaded.owning_key.encoded == cluster.owning_key.encoded
+
+
+class TestClientValidation:
+    """NotaryClientFlow's collective-fulfillment check, unit-level."""
+
+    def _sigs(self, kps, content):
+        return [
+            sign_bytes(kp.private, kp.public, content) for kp in kps
+        ]
+
+    def test_bft_style_threshold_met(self):
+        kps = _members(4)  # f=1 cluster: threshold f+1 = 2
+        cluster = generate_service_identity(
+            "O=BFT,L=Z,C=CH", [kp.public for kp in kps], threshold=2
+        )
+        content = b"tx-id-bytes-0123456789abcdef0123"
+        sigs = self._sigs(kps[:2], content)
+        assert cluster.owning_key.is_fulfilled_by({s.by for s in sigs})
+        assert all(s.is_valid(content) for s in sigs)
+
+    def test_single_replica_cannot_fulfil_bft_identity(self):
+        kps = _members(4)
+        cluster = generate_service_identity(
+            "O=BFT,L=Z,C=CH", [kp.public for kp in kps], threshold=2
+        )
+        content = b"tx-id-bytes-0123456789abcdef0123"
+        sigs = self._sigs(kps[:1], content)
+        # even repeated signatures from ONE replica don't reach threshold
+        assert not cluster.owning_key.is_fulfilled_by(
+            {s.by for s in sigs + sigs}
+        )
+
+    def test_outsider_not_a_leaf(self):
+        kps = _members(3)
+        outsider = crypto.entropy_to_keypair(999)
+        cluster = generate_service_identity(
+            "O=C,L=Z,C=CH", [kp.public for kp in kps], threshold=1
+        )
+        assert outsider.public not in cluster.owning_key.keys

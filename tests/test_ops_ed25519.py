@@ -192,8 +192,25 @@ class TestPallasCore:
             expect.append(ed25519_math.verify(pub, msg, sig))
         kwargs, _ = ed25519_batch.prepare_batch(pubs, sigs, msgs, pad_to=width)
 
+        from jax import lax
+
+        # Array-backed accessors that support the kernel's REAL control
+        # flow (lax.fori_loop): digit rows are written with concrete
+        # indices before the ladder, so they can be stacked into one array
+        # the traced loop body dynamic-slices. This exercises the exact
+        # ladder the Pallas kernel runs while tracing its body only once
+        # (the fully-unrolled eager variant took ~3 min of dispatch).
         table = {}
-        idx = {}
+        idx_rows = {}
+        stacked = {}
+
+        def read_idx(t):
+            if "idx" not in stacked:
+                stacked["idx"] = jnp.concatenate(
+                    [idx_rows[k] for k in range(128)], axis=0
+                )
+            return lax.dynamic_slice_in_dim(stacked["idx"], t, 1, axis=0)
+
         mask = ed25519_pallas._verify_core(
             width,
             jnp.asarray(np.asarray(kwargs["y_a"]).T),
@@ -205,9 +222,8 @@ class TestPallasCore:
             jnp.asarray(np.asarray(kwargs["s_ok"])[None, :].astype(np.uint32)),
             write_table=table.__setitem__,
             read_table=table.__getitem__,
-            write_idx=idx.__setitem__,
-            read_idx=idx.__getitem__,
-            unroll_ladder=True,
+            write_idx=idx_rows.__setitem__,
+            read_idx=read_idx,
         )
         got = [bool(v) for v in np.asarray(mask)[0]]
         assert got == expect

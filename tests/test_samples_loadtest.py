@@ -152,3 +152,13 @@ class TestShell:
         shell.onecmd("vault")
         assert shell.onecmd("bye") is True
         net.stop_nodes()
+
+
+class TestNotariseLatency:
+    def test_latency_percentiles(self):
+        from corda_tpu.loadtest.latency import measure_notarise_latency
+
+        out = measure_notarise_latency(n_tx=16)
+        assert out["n_tx"] == 16
+        assert 0 < out["p50_ms"] <= out["p95_ms"]
+        assert out["notarisations_per_sec"] > 0
