@@ -195,6 +195,58 @@ def _build_cert_from_public(subject, public_key, ca: CertAndKey, is_ca: bool):
     return builder.sign(ca.key, hashes.SHA256())
 
 
+# --- identity certificates (bind a framework signing key) -------------------
+
+def create_identity_cert(node_ca: CertAndKey, legal_name: str, public_key):
+    """Certificate over a framework identity key (reference: the node CA
+    certifies the legal identity's SIGNING key, not a fresh EC key).
+
+    `public_key` is a corda_tpu SchemePublicKey; ed25519 and ECDSA keys
+    are supported (RSA/SPHINCS identities must use the CSR flow)."""
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+
+    name = public_key.scheme_code_name
+    if name == "EDDSA_ED25519_SHA512":
+        subject_key = _ed.Ed25519PublicKey.from_public_bytes(
+            public_key.encoded
+        )
+    elif name.startswith("ECDSA_SECP256"):
+        curve = ec.SECP256K1() if "K1" in name else ec.SECP256R1()
+        subject_key = ec.EllipticCurvePublicKey.from_encoded_point(
+            curve, public_key.encoded
+        )
+    else:
+        raise ValueError(f"cannot certify {name} keys directly")
+    return _build_cert_from_public(
+        _name(legal_name, unit="Identity"), subject_key, node_ca, is_ca=False
+    )
+
+
+def cert_common_name(cert: x509.Certificate) -> Optional[str]:
+    attrs = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    return attrs[0].value if attrs else None
+
+
+def cert_matches_key(cert: x509.Certificate, public_key) -> bool:
+    """Does the certificate's subject key equal this framework key?"""
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+
+    subject_key = cert.public_key()
+    if isinstance(subject_key, _ed.Ed25519PublicKey):
+        raw = subject_key.public_bytes(
+            _ser.Encoding.Raw, _ser.PublicFormat.Raw
+        )
+        return raw == public_key.encoded
+    if isinstance(subject_key, ec.EllipticCurvePublicKey):
+        # framework ECDSA keys encode as X962 compressed points
+        point = subject_key.public_bytes(
+            _ser.Encoding.X962, _ser.PublicFormat.CompressedPoint
+        )
+        return point == public_key.encoded
+    return False
+
+
 # --- validation --------------------------------------------------------------
 
 def _basic_constraints(cert: x509.Certificate):

@@ -67,3 +67,16 @@ register_adapter(
     lambda p: {"party": p.party, "ref": p.reference},
     lambda d: PartyAndReference(d["party"], d["ref"]),
 )
+
+
+@dataclass(frozen=True)
+class PartyAndCertificate:
+    """A well-known identity with its certificate path (reference
+    `PartyAndCertificate`): `certificate` binds `party.owning_key` and is
+    signed by the node CA; `cert_path` holds the intermediates up to (not
+    including) the network trust root. Validated + registered by
+    `IdentityService.verify_and_register_identity`."""
+
+    party: Party
+    certificate: object          # cryptography x509.Certificate
+    cert_path: tuple = ()        # intermediates, leaf-adjacent first

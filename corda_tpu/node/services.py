@@ -36,15 +36,60 @@ class IdentityService:
     """Party <-> key registry (reference InMemoryIdentityService,
     `node/.../services/identity/InMemoryIdentityService.kt`)."""
 
-    def __init__(self):
+    def __init__(self, trust_root=None):
+        """trust_root: an x509 root certificate. When set, identities must
+        arrive as PartyAndCertificate with a chain to this root
+        (reference InMemoryIdentityService cert-path validation); when
+        None (MockNetwork / dev), bare registration is allowed."""
         self._by_key: Dict[bytes, Party] = {}
         self._by_name: Dict[str, Party] = {}
+        self._certs: Dict[str, object] = {}  # name -> leaf certificate
+        self.trust_root = trust_root
         self._lock = threading.Lock()
 
     def register_identity(self, party: Party) -> None:
         with self._lock:
             self._by_key[party.owning_key.encoded] = party
             self._by_name[party.name] = party
+
+    def verify_and_register_identity(self, identity) -> Party:
+        """Validate a PartyAndCertificate and register it (reference
+        `verifyAndRegisterIdentity`): the chain must reach the trust
+        root, the leaf must bind the party's signing key, and the
+        certificate subject must carry the party's common name."""
+        from ..core.crypto import pki
+
+        party = identity.party
+        if self.trust_root is None:
+            raise ValueError(
+                "identity service has no trust root configured; use "
+                "register_identity in dev mode"
+            )
+        if not pki.verify_chain(
+            identity.certificate, list(identity.cert_path), self.trust_root
+        ):
+            raise ValueError(
+                f"certificate path for {party.name} does not verify to the "
+                "trust root"
+            )
+        if not pki.cert_matches_key(identity.certificate, party.owning_key):
+            raise ValueError(
+                f"certificate for {party.name} does not bind the party's "
+                "signing key"
+            )
+        cn = pki.cert_common_name(identity.certificate)
+        if cn != party.name:
+            raise ValueError(
+                f"certificate CN {cn!r} does not match party {party.name!r}"
+            )
+        with self._lock:
+            self._by_key[party.owning_key.encoded] = party
+            self._by_name[party.name] = party
+            self._certs[party.name] = identity.certificate
+        return party
+
+    def certificate_from_party(self, party: Party):
+        return self._certs.get(party.name)
 
     def party_from_key(self, key: PublicKey) -> Optional[Party]:
         return self._by_key.get(key.encoded)

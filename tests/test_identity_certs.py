@@ -1,0 +1,114 @@
+"""Identity cert-path validation tests (reference
+`InMemoryIdentityServiceTests` + X509Utilities cert hierarchy)."""
+import pytest
+
+from corda_tpu.core.crypto import crypto, pki
+from corda_tpu.core.crypto.schemes import (
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+)
+from corda_tpu.core.identity import Party, PartyAndCertificate
+from corda_tpu.node.services import IdentityService
+
+
+@pytest.fixture(scope="module")
+def hierarchy():
+    root = pki.create_self_signed_ca("Corda TPU Root CA")
+    intermediate = pki.create_intermediate_ca(root)
+    node_ca = pki.create_node_ca(intermediate, "O=CertNode,L=London,C=GB")
+    return root, intermediate, node_ca
+
+
+def _certified(node_ca, hierarchy, name="O=CertNode,L=London,C=GB",
+               scheme=EDDSA_ED25519_SHA512):
+    kp = crypto.generate_keypair(scheme)
+    party = Party(name, kp.public)
+    cert = pki.create_identity_cert(node_ca, name, kp.public)
+    root, intermediate, _ = hierarchy
+    return PartyAndCertificate(
+        party, cert, (node_ca.cert, intermediate.cert)
+    )
+
+
+class TestVerifyAndRegister:
+    def test_valid_ed25519_identity(self, hierarchy):
+        root, _, node_ca = hierarchy
+        svc = IdentityService(trust_root=root.cert)
+        identity = _certified(node_ca, hierarchy)
+        svc.verify_and_register_identity(identity)
+        assert svc.party_from_name(identity.party.name) == identity.party
+        assert svc.certificate_from_party(identity.party) is not None
+
+    def test_valid_ecdsa_identity(self, hierarchy):
+        root, _, node_ca = hierarchy
+        svc = IdentityService(trust_root=root.cert)
+        identity = _certified(
+            node_ca, hierarchy, scheme=ECDSA_SECP256R1_SHA256
+        )
+        svc.verify_and_register_identity(identity)
+        assert svc.party_from_key(identity.party.owning_key) is not None
+
+    def test_wrong_root_rejected(self, hierarchy):
+        _, _, node_ca = hierarchy
+        other_root = pki.create_self_signed_ca("Evil Root")
+        svc = IdentityService(trust_root=other_root.cert)
+        identity = _certified(node_ca, hierarchy)
+        with pytest.raises(ValueError, match="does not verify"):
+            svc.verify_and_register_identity(identity)
+
+    def test_key_substitution_rejected(self, hierarchy):
+        """A valid cert for key A must not register a party claiming key B."""
+        root, _, node_ca = hierarchy
+        svc = IdentityService(trust_root=root.cert)
+        identity = _certified(node_ca, hierarchy)
+        other = crypto.generate_keypair(EDDSA_ED25519_SHA512)
+        forged = PartyAndCertificate(
+            Party(identity.party.name, other.public),
+            identity.certificate,
+            identity.cert_path,
+        )
+        with pytest.raises(ValueError, match="bind"):
+            svc.verify_and_register_identity(forged)
+
+    def test_name_mismatch_rejected(self, hierarchy):
+        root, _, node_ca = hierarchy
+        svc = IdentityService(trust_root=root.cert)
+        identity = _certified(node_ca, hierarchy)
+        renamed = PartyAndCertificate(
+            Party("O=Somebody Else,L=Paris,C=FR", identity.party.owning_key),
+            identity.certificate,
+            identity.cert_path,
+        )
+        with pytest.raises(ValueError, match="does not match party"):
+            svc.verify_and_register_identity(renamed)
+
+    def test_no_trust_root_refuses_verified_path(self, hierarchy):
+        _, _, node_ca = hierarchy
+        svc = IdentityService()
+        identity = _certified(node_ca, hierarchy)
+        with pytest.raises(ValueError, match="no trust root"):
+            svc.verify_and_register_identity(identity)
+        # dev-mode bare registration still works
+        svc.register_identity(identity.party)
+        assert svc.party_from_name(identity.party.name) == identity.party
+
+    def test_leaf_signed_by_non_ca_rejected(self, hierarchy):
+        """A leaf cannot issue identities: chain through a leaf must fail
+        (path-length / CA constraints)."""
+        root, intermediate, node_ca = hierarchy
+        svc = IdentityService(trust_root=root.cert)
+        kp = crypto.generate_keypair(EDDSA_ED25519_SHA512)
+        # mint a fake "CA" from the identity leaf's own EC key: the TLS
+        # cert is a non-CA leaf under node_ca
+        tls = pki.create_tls_cert(node_ca, "O=CertNode,L=London,C=GB")
+        fake = pki.CertAndKey(cert=tls.cert, key=tls.key)
+        cert = pki.create_identity_cert(
+            fake, "O=Mallory,L=X,C=GB", kp.public
+        )
+        identity = PartyAndCertificate(
+            Party("O=Mallory,L=X,C=GB", kp.public),
+            cert,
+            (tls.cert, node_ca.cert, intermediate.cert),
+        )
+        with pytest.raises(ValueError, match="does not verify"):
+            svc.verify_and_register_identity(identity)
