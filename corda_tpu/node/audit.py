@@ -1,0 +1,71 @@
+"""Audit service (reference `node/.../services/api/AuditService.kt:125-133`
+— the reference defines the interface and installs a no-op
+`DummyAuditService`; here the in-memory implementation is real, bounded,
+and wired to flow lifecycle + notary commits).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class AuditEvent:
+    timestamp: float
+    principal: str        # node legal name or flow id
+    event_type: str       # e.g. "flow.started", "notary.commit"
+    context: Dict = field(default_factory=dict)
+
+
+class AuditService:
+    """Interface: implementations must be non-blocking and never raise."""
+
+    def record(self, event: AuditEvent) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def record_event(self, principal: str, event_type: str, **context) -> None:
+        self.record(
+            AuditEvent(time.time(), principal, event_type, dict(context))
+        )
+
+
+class DummyAuditService(AuditService):
+    """Drops everything (the reference default)."""
+
+    def record(self, event: AuditEvent) -> None:
+        pass
+
+
+class MemoryAuditService(AuditService):
+    """Bounded in-memory trail with filtered reads."""
+
+    def __init__(self, capacity: int = 10_000):
+        self._events: deque = deque(maxlen=capacity)
+        self._observers: List[Callable[[AuditEvent], None]] = []
+
+    def record(self, event: AuditEvent) -> None:
+        self._events.append(event)
+        for obs in list(self._observers):
+            try:
+                obs(event)
+            except Exception:
+                pass  # audit fan-out must never break the caller
+
+    def subscribe(self, observer: Callable[[AuditEvent], None]) -> None:
+        self._observers.append(observer)
+
+    def events(
+        self,
+        event_type: Optional[str] = None,
+        principal: Optional[str] = None,
+    ) -> List[AuditEvent]:
+        return [
+            e for e in self._events
+            if (event_type is None or e.event_type == event_type)
+            and (principal is None or e.principal == principal)
+        ]
+
+    def __len__(self) -> int:
+        return len(self._events)

@@ -218,10 +218,21 @@ class NotaryService:
             raise NotaryException("time-window invalid")
 
     def commit_input_states(self, inputs: List[StateRef], tx_id) -> None:
+        audit = getattr(self.services, "audit_service", None)
         try:
             self.uniqueness_provider.commit(inputs, tx_id, self.identity)
         except UniquenessException as e:
+            if audit is not None:
+                audit.record_event(
+                    self.identity.name, "notary.conflict",
+                    tx_id=tx_id.bytes.hex(), inputs=len(inputs),
+                )
             raise NotaryException(e.conflict)
+        if audit is not None:
+            audit.record_event(
+                self.identity.name, "notary.commit",
+                tx_id=tx_id.bytes.hex(), inputs=len(inputs),
+            )
 
     def sign(self, tx_id) -> object:
         return self.services.key_management_service.sign(

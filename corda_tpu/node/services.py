@@ -180,9 +180,20 @@ class NetworkMapCache:
         self._services: Dict[str, List[Party]] = {}
         self._node_services: Dict[str, Set[str]] = {}
         self._lock = threading.Lock()
+        self._observers: List[Callable] = []  # fn(change: str, party)
+
+    def track(self, observer: Callable) -> None:
+        """observer("ADDED"|"REMOVED", party) on membership changes
+        (reference MapChange feed, CordaRPCOps.networkMapFeed)."""
+        self._observers.append(observer)
+
+    def _notify(self, change: str, party: Party) -> None:
+        for obs in list(self._observers):
+            obs(change, party)
 
     def add_node(self, party: Party, advertised_services: Iterable[str] = ()) -> None:
         with self._lock:
+            is_new = party.name not in self._nodes
             self._nodes[party.name] = party
             node_svcs = self._node_services.setdefault(party.name, set())
             for svc in advertised_services:
@@ -190,6 +201,8 @@ class NetworkMapCache:
                 parties = self._services.setdefault(svc, [])
                 if party not in parties:
                     parties.append(party)
+        if is_new:
+            self._notify("ADDED", party)
 
     def remove_node(self, name: str) -> None:
         with self._lock:
@@ -199,6 +212,8 @@ class NetworkMapCache:
                 for parties in self._services.values():
                     if party in parties:
                         parties.remove(party)
+        if party is not None:
+            self._notify("REMOVED", party)
 
     def is_validating_notary(self, party: Party) -> bool:
         return self.VALIDATING_NOTARY_SERVICE in self._node_services.get(
@@ -483,6 +498,9 @@ class ServiceHub:
         self.my_info = my_info
         self.db = db
         self.monitoring = MonitoringService()
+        from .audit import MemoryAuditService
+
+        self.audit_service = MemoryAuditService()
         self.identity_service = IdentityService()
         self.key_management_service = KeyManagementService(
             db, initial_keys=[legal_identity_key]

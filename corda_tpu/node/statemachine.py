@@ -686,5 +686,11 @@ class StateMachineManager:
             self.metrics.meter("Flows.Started").mark()
         elif event == "finished":
             self.metrics.meter("Flows.Finished").mark()
+        audit = getattr(self.service_hub, "audit_service", None)
+        if audit is not None:
+            audit.record_event(
+                self.our_identity.name, f"flow.{event}",
+                flow_id=fsm.flow_id, flow=fsm.flow.flow_name(),
+            )
         for obs in self._changes:
             obs(event, fsm)

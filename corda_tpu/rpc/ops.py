@@ -243,6 +243,35 @@ class CordaRPCOps:
     def network_map_snapshot(self) -> List:
         return self._services.network_map_cache.all_nodes
 
+    def network_map_feed(self) -> DataFeed:
+        """Snapshot + membership changes (reference
+        CordaRPCOps.networkMapFeed -> MapChange stream)."""
+        updates = Observable()
+        self._services.network_map_cache.track(
+            lambda change, party: updates.on_next(
+                {"change": change, "party": party}
+            )
+        )
+        return DataFeed(self._services.network_map_cache.all_nodes, updates)
+
+    def audit_events(
+        self, event_type: Optional[str] = None,
+        principal: Optional[str] = None,
+    ) -> List:
+        """Recent audit trail entries (reference AuditService)."""
+        svc = getattr(self._services, "audit_service", None)
+        if svc is None or not hasattr(svc, "events"):
+            return []
+        return [
+            {
+                "timestamp": e.timestamp,
+                "principal": e.principal,
+                "event_type": e.event_type,
+                "context": dict(e.context),
+            }
+            for e in svc.events(event_type, principal)
+        ]
+
     def notary_identities(self) -> List:
         return self._services.network_map_cache.notary_identities
 

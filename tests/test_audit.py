@@ -1,0 +1,103 @@
+"""Audit service + network-map feed tests (reference AuditService.kt,
+CordaRPCOps.networkMapFeed)."""
+from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+from corda_tpu.node.audit import DummyAuditService, MemoryAuditService
+from corda_tpu.rpc import CordaRPCOps
+from corda_tpu.testing import MockNetwork
+
+
+@startable_by_rpc
+class _AuditedFlow(FlowLogic):
+    def call(self):
+        return "ok"
+        yield  # pragma: no cover
+
+
+class TestMemoryAuditService:
+    def test_record_and_filter(self):
+        svc = MemoryAuditService(capacity=4)
+        svc.record_event("O=A", "flow.started", flow_id="1")
+        svc.record_event("O=A", "flow.finished", flow_id="1")
+        svc.record_event("O=B", "flow.started", flow_id="2")
+        assert len(svc.events("flow.started")) == 2
+        assert len(svc.events(principal="O=B")) == 1
+        assert svc.events("flow.finished")[0].context["flow_id"] == "1"
+
+    def test_bounded(self):
+        svc = MemoryAuditService(capacity=3)
+        for i in range(10):
+            svc.record_event("O=A", "e", n=i)
+        assert len(svc) == 3
+        assert svc.events()[0].context["n"] == 7
+
+    def test_subscriber_errors_swallowed(self):
+        svc = MemoryAuditService()
+        svc.subscribe(lambda e: 1 / 0)
+        svc.record_event("O=A", "e")  # must not raise
+        assert len(svc) == 1
+
+    def test_dummy_drops(self):
+        svc = DummyAuditService()
+        svc.record_event("O=A", "e")  # no-op, no error
+
+
+class TestNodeAuditTrail:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.node = self.net.create_node("O=Audited,L=London,C=GB")
+        self.ops = CordaRPCOps(self.node.services, self.node.smm)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def test_flow_lifecycle_audited(self):
+        h = self.node.start_flow(_AuditedFlow())
+        self.net.run_network()
+        h.result.result(timeout=5)
+        trail = self.ops.audit_events("flow.started")
+        assert any(
+            e["context"]["flow"].endswith("_AuditedFlow") for e in trail
+        )
+        assert self.ops.audit_events("flow.finished")
+
+    def test_notary_commit_audited(self):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.finance.flows import CashIssueFlow, CashPaymentFlow
+
+        bank = self.node
+        h = bank.start_flow(CashIssueFlow(
+            Amount(500, "USD"), b"\x01", bank.info, self.notary.info
+        ))
+        self.net.run_network()
+        h.result.result(timeout=10)
+        token = Issued(bank.info.ref(1), "USD")
+        h = bank.start_flow(CashPaymentFlow(
+            Amount(500, token), bank.info, self.notary.info
+        ))
+        self.net.run_network()
+        h.result.result(timeout=10)
+        notary_ops = CordaRPCOps(self.notary.services, self.notary.smm)
+        commits = notary_ops.audit_events("notary.commit")
+        assert len(commits) == 1
+        assert commits[0]["context"]["inputs"] == 1
+
+
+class TestNetworkMapFeed:
+    def test_snapshot_and_changes(self):
+        net = MockNetwork()
+        a = net.create_node("O=FeedA,L=London,C=GB")
+        ops = CordaRPCOps(a.services, a.smm)
+        feed = ops.network_map_feed()
+        assert any(p.name == a.info.name for p in feed.snapshot)
+        changes = []
+        feed.updates.subscribe(changes.append)
+        b = net.create_node("O=FeedB,L=Paris,C=FR")
+        assert any(
+            c["change"] == "ADDED" and c["party"].name == b.info.name
+            for c in changes
+        )
+        a.services.network_map_cache.remove_node(b.info.name)
+        assert any(c["change"] == "REMOVED" for c in changes)
+        net.stop_nodes()
