@@ -29,7 +29,12 @@ def main() -> None:
     from corda_tpu.core.crypto import ed25519_math
     from corda_tpu.ops import ed25519_batch
 
-    on_tpu = jax.default_backend() == "tpu"
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError:
+        # accelerator tunnel down: report a CPU number rather than crash
+        jax.config.update("jax_platforms", "cpu")
+        on_tpu = False
     batch = BATCH if on_tpu else 4096  # CPU fallback kernel is ~100x slower
 
     t_start = time.perf_counter()

@@ -501,6 +501,12 @@ class ServiceHub:
         from .audit import MemoryAuditService
 
         self.audit_service = MemoryAuditService()
+        from ..utils.observable import Observable as _Observable
+
+        # flow id -> recorded tx ids (reference
+        # StateMachineRecordedTransactionMappingStorage + its RPC feed)
+        self.tx_mappings: List[Dict] = []
+        self._tx_mapping_updates = _Observable()
         self.identity_service = IdentityService()
         self.key_management_service = KeyManagementService(
             db, initial_keys=[legal_identity_key]
@@ -548,10 +554,21 @@ class ServiceHub:
 
     def record_transactions(self, txs) -> None:
         """Persist validated transactions, update the vault, wake ledger
-        waiters (reference AbstractNode.recordTransactions :817-821)."""
+        waiters (reference AbstractNode.recordTransactions :817-821).
+        When called from inside a running flow, the (flow id, tx id)
+        mapping is recorded too (reference
+        StateMachineRecordedTransactionMappingStorage)."""
+        from ..utils.flowcontext import current_flow_id
+
         txs = list(txs)
         recorded = [stx for stx in txs if self.validated_transactions.add(stx)]
         if recorded:
+            flow_id = current_flow_id()
+            if flow_id is not None:
+                for stx in recorded:
+                    mapping = {"flow_id": flow_id, "tx_id": stx.id}
+                    self.tx_mappings.append(mapping)
+                    self._tx_mapping_updates.on_next(mapping)
             self.vault_service.notify_all(recorded)
             if self._smm is not None:
                 for stx in recorded:

@@ -136,6 +136,12 @@ class FlowStateMachine:
 
     def _run(self, feed=None, first=False, throw: Optional[BaseException] = None):
         """Drive the generator until it completes or parks."""
+        from ..utils.flowcontext import running_flow
+
+        with running_flow(self.flow_id):
+            self._run_inner(feed, first, throw)
+
+    def _run_inner(self, feed, first, throw) -> None:
         try:
             while True:
                 try:

@@ -254,6 +254,14 @@ class CordaRPCOps:
         )
         return DataFeed(self._services.network_map_cache.all_nodes, updates)
 
+    def state_machine_recorded_transaction_mapping_feed(self) -> DataFeed:
+        """Which flow recorded which transaction (reference
+        stateMachineRecordedTransactionMappingFeed)."""
+        return DataFeed(
+            list(self._services.tx_mappings),
+            self._services._tx_mapping_updates,
+        )
+
     def audit_events(
         self, event_type: Optional[str] = None,
         principal: Optional[str] = None,

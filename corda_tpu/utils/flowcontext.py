@@ -1,0 +1,24 @@
+"""Thread-local current-flow context (reference: the fiber-local state the
+node uses to attribute service calls — e.g. recorded transactions — to the
+flow performing them, `StateMachineRecordedTransactionMappingStorage`)."""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+_local = threading.local()
+
+
+def current_flow_id() -> Optional[str]:
+    return getattr(_local, "flow_id", None)
+
+
+@contextmanager
+def running_flow(flow_id: str) -> Iterator[None]:
+    prev = getattr(_local, "flow_id", None)
+    _local.flow_id = flow_id
+    try:
+        yield
+    finally:
+        _local.flow_id = prev

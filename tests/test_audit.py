@@ -101,3 +101,27 @@ class TestNetworkMapFeed:
         a.services.network_map_cache.remove_node(b.info.name)
         assert any(c["change"] == "REMOVED" for c in changes)
         net.stop_nodes()
+
+
+class TestFlowTxMapping:
+    def test_mapping_recorded_for_flow_finality(self):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.finance.flows import CashIssueFlow
+
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        bank = net.create_node("O=MapBank,L=London,C=GB")
+        ops = CordaRPCOps(bank.services, bank.smm)
+        feed = ops.state_machine_recorded_transaction_mapping_feed()
+        assert feed.snapshot == []
+        live = []
+        feed.updates.subscribe(live.append)
+        h = bank.start_flow(CashIssueFlow(
+            Amount(100, "USD"), b"\x01", bank.info, notary.info
+        ))
+        net.run_network()
+        h.result.result(timeout=10)
+        assert len(live) == 1
+        assert live[0]["flow_id"] == h.flow_id
+        assert ops.state_machine_recorded_transaction_mapping_feed().snapshot
+        net.stop_nodes()
