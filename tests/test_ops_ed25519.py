@@ -227,3 +227,79 @@ class TestPallasCore:
         )
         got = [bool(v) for v in np.asarray(mask)[0]]
         assert got == expect
+
+
+class TestAdversarialVectors:
+    """Wycheproof-style edge encodings: the kernel must AGREE with the host
+    oracle on every one (consensus property — a node on the device path and
+    a node on the host path must never split)."""
+
+    # the eight small-order point encodings on edwards25519
+    SMALL_ORDER = [
+        bytes(32),                                        # y=0 variant (x=0? order 4)
+        (1).to_bytes(32, "little"),                       # identity (y=1)
+        bytes.fromhex(
+            "26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05"),
+        bytes.fromhex(
+            "c7176a703d4dd84fba3c0b760d10670f2a2053fa2c39ccc64ec7fd7792ac037a"),
+        bytes.fromhex(
+            "ecffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f"),  # y=-1
+        bytes.fromhex(
+            "edffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f"),  # y=p (non-canonical 0)
+        bytes.fromhex(
+            "eeffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f"),  # y=p+1
+        bytes.fromhex(
+            "0000000000000000000000000000000000000000000000000000000000000080"),  # y=0, sign=1
+    ]
+
+    def test_small_order_keys_agree_with_oracle(self):
+        msg = b"edge-case message"
+        seed = hashlib.sha256(b"edge").digest()
+        good_pub, good_seed = _keypair(seed)
+        good_sig = _sign(good_seed, msg)
+        pubs, sigs, msgs, expect = [], [], [], []
+        for enc in self.SMALL_ORDER:
+            # small-order / non-canonical A with an honest signature blob
+            pubs.append(enc)
+            sigs.append(good_sig)
+            msgs.append(msg)
+            expect.append(ed25519_math.verify(enc, msg, good_sig))
+            # and as the R component
+            pubs.append(good_pub)
+            sigs.append(enc + good_sig[32:])
+            msgs.append(msg)
+            expect.append(
+                ed25519_math.verify(good_pub, msg, enc + good_sig[32:])
+            )
+        mask = ed25519_batch.verify_batch(pubs, sigs, msgs)
+        assert [bool(b) for b in mask] == expect
+
+    def test_zero_scalar_and_boundary_s(self):
+        msg = b"boundary"
+        seed = hashlib.sha256(b"boundary").digest()
+        pub, sk = _keypair(seed)
+        sig = _sign(sk, msg)
+        cases = [
+            sig[:32] + bytes(32),                          # s = 0
+            sig[:32] + (F.L_INT - 1).to_bytes(32, "little"),  # s = L-1
+            sig[:32] + F.L_INT.to_bytes(32, "little"),     # s = L (reject)
+            sig[:32] + (2**256 - 1).to_bytes(32, "little"),  # max (reject)
+        ]
+        pubs = [pub] * len(cases)
+        msgs = [msg] * len(cases)
+        expect = [ed25519_math.verify(pub, msg, s) for s in cases]
+        mask = ed25519_batch.verify_batch(pubs, cases, msgs)
+        assert [bool(b) for b in mask] == expect
+        assert expect[2] is False and expect[3] is False
+
+    def test_signature_on_small_order_key_pair(self):
+        """A signature 'from' the identity key: s*B == R + h*A with A = O
+        means R must equal [s]B — craft it and confirm oracle+kernel agree
+        (cofactorless semantics accept it iff the math holds)."""
+        identity_pub = (1).to_bytes(32, "little")
+        # choose s = 0 -> [0]B = O -> R must encode the identity as well
+        sig = (1).to_bytes(32, "little") + bytes(32)
+        msg = b"forged-by-identity"
+        expect = ed25519_math.verify(identity_pub, msg, sig)
+        mask = ed25519_batch.verify_batch([identity_pub], [sig], [msg])
+        assert bool(mask[0]) == expect
