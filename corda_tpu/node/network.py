@@ -50,6 +50,14 @@ class InMemoryMessagingNetwork:
         # both set, a message becomes deliverable at clock()+latency(s, r).
         self.latency: Optional[Callable[[Party, str], float]] = None
         self.clock: Optional[Callable[[], float]] = None
+        # Distributed-service addressing (reference: Artemis distributes
+        # service-queue messages across cluster members; clients address
+        # ONE service identity and any live member serves it): service
+        # name -> member endpoint names, delivered round-robin with dead
+        # members skipped — which IS the failover sendAndReceiveWithRetry
+        # relies on (FlowLogic.kt:98-110).
+        self._service_members: Dict[str, List[str]] = {}
+        self._service_rr: Dict[str, int] = {}
 
     def create_endpoint(self, me: Party) -> "InMemoryMessaging":
         ep = InMemoryMessaging(self, me)
@@ -72,6 +80,29 @@ class InMemoryMessagingNetwork:
         with self._lock:
             self._queue.append(msg)
             self.sent_count += 1
+
+    def register_service_endpoint(self, service_name: str, member_name: str) -> None:
+        with self._lock:
+            members = self._service_members.setdefault(service_name, [])
+            if member_name not in members:
+                members.append(member_name)
+
+    def _resolve_recipient(self, name: str) -> Optional["InMemoryMessaging"]:
+        """Direct endpoint, or a live member of a service address."""
+        ep = self._endpoints.get(name)
+        if ep is not None:
+            return ep
+        members = self._service_members.get(name)
+        if not members:
+            return None
+        start = self._service_rr.get(name, 0)
+        for i in range(len(members)):
+            member = members[(start + i) % len(members)]
+            ep = self._endpoints.get(member)
+            if ep is not None:
+                self._service_rr[name] = (start + i + 1) % len(members)
+                return ep
+        return None
 
     def next_due(self) -> Optional[float]:
         """Earliest due_at among undeliverable queued messages (simulation
@@ -97,7 +128,7 @@ class InMemoryMessagingNetwork:
                 return False  # everything queued is delayed into the future
             if self.filter is not None and not self.filter(msg):
                 return True  # dropped by the injector; work was done
-            ep = self._endpoints.get(msg.recipient)
+            ep = self._resolve_recipient(msg.recipient)
         if ep is not None:
             ep._deliver(msg.sender, msg.topic, msg.payload)
             if self.observer is not None:

@@ -31,6 +31,7 @@ class MockNetwork:
         self.nodes: List[MockNode] = []
         self._entropy = 1000
         self.default_clock = default_clock
+        self._clusters: List = []  # (cluster_party, advertised_services)
 
     def _next_entropy(self) -> int:
         self._entropy += 1
@@ -60,6 +61,9 @@ class MockNetwork:
         for other in self.nodes:
             other.register_peer(node.info, node.config.advertised_services)
             node.register_peer(other.info, other.config.advertised_services)
+        for cluster, advertised in self._clusters:
+            node.services.network_map_cache.add_node(cluster, advertised)
+            node.services.identity_service.register_identity(cluster)
         self.nodes.append(node)
         return node
 
@@ -69,6 +73,65 @@ class MockNetwork:
         return self.create_node(
             legal_name, notary_type="validating" if validating else "simple"
         )
+
+    def create_notary_cluster(
+        self,
+        n_members: int = 3,
+        cluster_name: str = "O=Notary Cluster,L=Zurich,C=CH",
+        validating: bool = True,
+        threshold: int = 1,
+    ):
+        """A distributed notary presenting ONE composite identity
+        (reference: Raft/BFT notary clusters + ServiceIdentityGenerator).
+
+        Members share a uniqueness provider (the replicated-commit-log
+        abstraction; swap in RaftUniquenessProvider replicas for consensus
+        tests), register under the cluster's service address
+        (round-robin + dead-member skip = client failover), and each signs
+        with its own leaf key of the composite cluster identity.
+
+        Returns (cluster_party, [member_nodes]).
+        """
+        from ..node.cluster_identity import generate_service_identity
+        from ..node.notary import (
+            PersistentUniquenessProvider,
+            SimpleNotaryService,
+            ValidatingNotaryService,
+        )
+        from ..node.services import NetworkMapCache
+
+        members = [
+            self.create_node(
+                f"O=Notary Member {i},L=Zurich,C=CH",
+                notary_type="validating" if validating else "simple",
+            )
+            for i in range(n_members)
+        ]
+        cluster = generate_service_identity(
+            cluster_name, [m.info.owning_key for m in members], threshold
+        )
+        # own DB: the commit log must survive any single member's death
+        from ..node.database import NodeDatabase
+
+        shared_provider = PersistentUniquenessProvider(NodeDatabase(":memory:"))
+        svc_cls = ValidatingNotaryService if validating else SimpleNotaryService
+        advertised = [NetworkMapCache.NOTARY_SERVICE] + (
+            [NetworkMapCache.VALIDATING_NOTARY_SERVICE] if validating else []
+        )
+        for m in members:
+            m.notary_service = svc_cls(
+                m.services, m.info, uniqueness_provider=shared_provider
+            )
+            m.services.notary_service = m.notary_service
+            self.messaging_network.register_service_endpoint(
+                cluster.name, m.info.name
+            )
+        # every node (present and future) resolves the cluster identity
+        for node in self.nodes:
+            node.services.network_map_cache.add_node(cluster, advertised)
+            node.services.identity_service.register_identity(cluster)
+        self._clusters.append((cluster, advertised))
+        return cluster, members
 
     def run_network(self, max_messages: int = 100_000) -> int:
         """Pump messages until the network is quiescent."""
