@@ -27,6 +27,7 @@ is ~10x faster (see its docstring for the measured roofline story).
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 from typing import Sequence, Tuple
 
@@ -292,7 +293,7 @@ def verify_batch(
     return np.asarray(mask)[:n]
 
 
-_PIPE_CHUNK = 65536
+_PIPE_CHUNK = int(os.environ.get("CORDA_TPU_PIPE_CHUNK", "65536"))
 
 
 def _dispatch_pallas(kwargs):

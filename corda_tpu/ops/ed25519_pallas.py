@@ -30,6 +30,8 @@ ops/ed25519_batch.py; this module is TPU-only.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -40,7 +42,9 @@ from jax.experimental.pallas import tpu as pltpu
 from ..core.crypto import ed25519_math
 from .field25519 import P_INT, D_INT, SQRT_M1_INT
 
-BLK = 512  # signatures per grid step (lane-dim multiple of 128)
+# signatures per grid step (lane-dim multiple of 128); the env knob lets
+# tools/tune_kernel.py sweep block sizes on real hardware without edits
+BLK = int(os.environ.get("CORDA_TPU_ED25519_BLK", "512"))
 
 _MASK = np.uint32(0xFFFF)
 

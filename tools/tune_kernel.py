@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Sweep the ed25519 Pallas kernel's tuning knobs on real TPU hardware.
+
+Runs `bench.py` in a fresh subprocess per configuration (the knobs are
+read at import time) and reports each JSON line plus the best config.
+
+Usage (on a machine with the TPU tunnel up):
+    python tools/tune_kernel.py [--blks 256,512,1024] [--chunks 65536,131072]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_one(blk: int, chunk: int, timeout: float) -> dict:
+    env = dict(os.environ)
+    env["CORDA_TPU_ED25519_BLK"] = str(blk)
+    env["CORDA_TPU_PIPE_CHUNK"] = str(chunk)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"blk": blk, "chunk": chunk, "error": "timeout"}
+    line = next(
+        (ln for ln in out.stdout.splitlines() if ln.startswith("{")), None
+    )
+    if line is None:
+        return {
+            "blk": blk, "chunk": chunk,
+            "error": (out.stderr or out.stdout)[-400:],
+        }
+    rec = json.loads(line)
+    rec.update(blk=blk, chunk=chunk)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blks", default="256,512,1024")
+    ap.add_argument("--chunks", default="65536,131072")
+    ap.add_argument("--timeout", type=float, default=1800)
+    args = ap.parse_args()
+
+    results = []
+    for blk in (int(b) for b in args.blks.split(",")):
+        for chunk in (int(c) for c in args.chunks.split(",")):
+            rec = run_one(blk, chunk, args.timeout)
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+    ok = [r for r in results if "value" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["value"])
+        print(
+            f"# best: BLK={best['blk']} CHUNK={best['chunk']} "
+            f"-> {best['value']:,.0f} sigs/s (vs_baseline {best['vs_baseline']})"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
