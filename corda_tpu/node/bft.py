@@ -416,6 +416,17 @@ class BFTClient:
             self._pending.pop(request_id, None)
             self._replies.pop(request_id, None)
 
+    @staticmethod
+    def _verdict_of(result: object) -> object:
+        """The agreement-relevant part of a reply: per-replica signatures
+        (tx_sig) necessarily DIFFER across honest replicas, so they are
+        excluded from the f+1 identical-verdict comparison and aggregated
+        separately (reference BFTSMaRt response extractor: compares
+        verdicts, collects >= requiredReplies signatures)."""
+        if isinstance(result, dict) and "tx_sig" in result:
+            return {k: v for k, v in result.items() if k != "tx_sig"}
+        return result
+
     def on_reply(self, replica_id: int, request_id: str, result: object) -> None:
         with self._lock:
             fut = self._pending.get(request_id)
@@ -427,9 +438,21 @@ class BFTClient:
             if replica_id in replies:
                 return  # one vote per replica: repeats can't inflate quorum
             replies[replica_id] = result
-            blob = serialize(result)
-            matching = sum(1 for r in replies.values() if serialize(r) == blob)
-            if matching >= self.f + 1:
+            blob = serialize(self._verdict_of(result))
+            agreeing = [
+                rid for rid, r in replies.items()
+                if serialize(self._verdict_of(r)) == blob
+            ]
+            if len(agreeing) >= self.f + 1:
                 self._pending.pop(request_id)
                 self._replies.pop(request_id)
-                fut.set_result(result)
+                verdict = self._verdict_of(result)
+                sigs = [
+                    replies[rid]["tx_sig"] for rid in agreeing
+                    if isinstance(replies[rid], dict)
+                    and replies[rid].get("tx_sig") is not None
+                ]
+                if isinstance(verdict, dict) and sigs:
+                    verdict = dict(verdict)
+                    verdict["tx_sigs"] = sigs
+                fut.set_result(verdict)

@@ -149,6 +149,7 @@ class BFTUniquenessProvider(UniquenessProvider):
 
     def __init__(self, bft_client):
         self.client = bft_client
+        self._tx_sigs: Dict[bytes, list] = {}
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
         entries = {
@@ -156,8 +157,15 @@ class BFTUniquenessProvider(UniquenessProvider):
                 serialize({"tx_id": tx_id, "by": requesting_party.name}).hex()
             for ref in states
         }
-        fut = self.client.submit({"kind": "putall", "entries": entries})
+        fut = self.client.submit({
+            "kind": "putall", "entries": entries,
+            "tx_id": tx_id.bytes.hex(),
+        })
         result = fut.result(timeout=30)
+        # f+1 replica signatures over the tx id ride the agreed verdict
+        # (keyed per tx: concurrent commits must not cross wires)
+        if result.get("tx_sigs"):
+            self._tx_sigs[tx_id.bytes] = list(result["tx_sigs"])
         if result["conflicts"]:
             by_key = {
                 PersistentUniquenessProvider._key(ref).hex(): ref
@@ -173,8 +181,14 @@ class BFTUniquenessProvider(UniquenessProvider):
             ))
 
     @staticmethod
-    def make_replica_apply(db: NodeDatabase):
-        """The deterministic state-machine applied on every BFT replica."""
+    def make_replica_apply(db: NodeDatabase, sign_tx_fn=None):
+        """The deterministic state-machine applied on every BFT replica.
+
+        sign_tx_fn(tx_id_bytes) -> DigitalSignatureWithKey: when given, a
+        conflict-free commit reply carries this replica's signature over
+        the transaction id (reference BFTNonValidatingNotaryService:
+        per-replica signatures returned to the client, which aggregates
+        f+1 of them into the notary response)."""
         umap = KVStore(db, "bft_uniqueness")
 
         def apply(command: dict):
@@ -188,7 +202,12 @@ class BFTUniquenessProvider(UniquenessProvider):
             if not conflicts:
                 for key_hex, blob_hex in command["entries"].items():
                     umap.put(bytes.fromhex(key_hex), bytes.fromhex(blob_hex))
-            return {"conflicts": conflicts}
+            result = {"conflicts": conflicts}
+            if not conflicts and sign_tx_fn is not None:
+                tx_id = command.get("tx_id")
+                if tx_id is not None:
+                    result["tx_sig"] = sign_tx_fn(bytes.fromhex(tx_id))
+            return result
 
         return apply
 
@@ -238,6 +257,18 @@ class NotaryService:
         return self.services.key_management_service.sign(
             tx_id.bytes, self.identity.owning_key
         )
+
+    def sign_all(self, tx_id) -> tuple:
+        """Every notary signature for the response. For a BFT-backed
+        service the commit already produced f+1 replica signatures over
+        the tx id (enough to fulfil an f+1-threshold composite cluster
+        identity); otherwise the serving identity's own signature."""
+        replica_sigs = getattr(self.uniqueness_provider, "_tx_sigs", None)
+        if replica_sigs is not None:
+            sigs = replica_sigs.pop(tx_id.bytes, None)
+            if sigs:
+                return tuple(sigs)
+        return (self.sign(tx_id),)
 
 
 class SimpleNotaryService(NotaryService):
@@ -389,8 +420,8 @@ class NotaryServiceFlow(FlowLogic):
         )
         service.validate_time_window(time_window)
         service.commit_input_states(inputs, tx_id)
-        sig = service.sign(tx_id)
-        yield self.send(self.counterparty, NotarisationResponse((sig,)))
+        sigs = service.sign_all(tx_id)
+        yield self.send(self.counterparty, NotarisationResponse(tuple(sigs)))
 
     def _receive_and_verify(self, service: NotaryService, payload):
         from ..core.transactions.notary_change import (

@@ -133,6 +133,116 @@ class MockNetwork:
         self._clusters.append((cluster, advertised))
         return cluster, members
 
+    def create_bft_notary_cluster(
+        self,
+        n_members: int = 4,
+        cluster_name: str = "O=BFT Notary,L=Zurich,C=CH",
+    ):
+        """Byzantine notary cluster: every member runs a PBFT replica of
+        the commit log; commits carry f+1 replica signatures over the tx
+        id, which fulfil the f+1-threshold composite cluster identity the
+        client validates (reference BFTNonValidatingNotaryService +
+        BFTSMaRt response extractor).
+
+        Returns (cluster_party, [member_nodes], bft_bus).
+        """
+        from collections import deque
+
+        from ..node.bft import BFTClient, BFTReplica
+        from ..node.cluster_identity import generate_service_identity
+        from ..node.database import NodeDatabase
+        from ..node.notary import BFTUniquenessProvider, SimpleNotaryService
+        from ..node.services import NetworkMapCache
+
+        members = [
+            self.create_node(
+                f"O=BFT Member {i},L=Zurich,C=CH", notary_type="simple"
+            )
+            for i in range(n_members)
+        ]
+        f = (n_members - 1) // 3
+        cluster = generate_service_identity(
+            cluster_name, [m.info.owning_key for m in members],
+            threshold=f + 1,
+        )
+
+        class _Bus:
+            """Synchronous in-process message bus: every enqueue drains
+            unless a drain is already running (replica handlers are not
+            re-entered)."""
+
+            def __init__(self):
+                self.queue = deque()
+                self.replicas = []
+                self.client = None
+                self._draining = False
+                self.dead = set()
+
+            def drain(self):
+                if self._draining:
+                    return
+                self._draining = True
+                try:
+                    while self.queue:
+                        kind, a, b, c = self.queue.popleft()
+                        if kind == "msg" and b not in self.dead:
+                            self.replicas[b].on_message(a, c)
+                        elif kind == "req" and b not in self.dead:
+                            self.replicas[b].on_request(c)
+                        elif kind == "reply" and a not in self.dead:
+                            self.client.on_reply(a, b, c)
+                finally:
+                    self._draining = False
+
+        bus = _Bus()
+        bus.client = BFTClient("notary-cluster", n_members, lambda rid, req: (
+            bus.queue.append(("req", None, rid, req)), bus.drain()
+        ))
+
+        def make_transport(src):
+            def transport(dst, payload):
+                bus.queue.append(("msg", src, dst, payload))
+                bus.drain()
+            return transport
+
+        def make_reply(idx):
+            def reply(client_id, request_id, result):
+                bus.queue.append(("reply", idx, request_id, result))
+                bus.drain()
+            return reply
+
+        def make_sign(member):
+            def sign_tx(tx_id_bytes: bytes):
+                return member.services.key_management_service.sign(
+                    tx_id_bytes, member.info.owning_key
+                )
+            return sign_tx
+
+        for i, m in enumerate(members):
+            apply_fn = BFTUniquenessProvider.make_replica_apply(
+                NodeDatabase(":memory:"), sign_tx_fn=make_sign(m)
+            )
+            bus.replicas.append(
+                BFTReplica(
+                    i, n_members, make_transport(i), apply_fn, make_reply(i)
+                )
+            )
+        provider = BFTUniquenessProvider(bus.client)
+        advertised = [NetworkMapCache.NOTARY_SERVICE]
+        for m in members:
+            m.notary_service = SimpleNotaryService(
+                m.services, m.info, uniqueness_provider=provider
+            )
+            m.services.notary_service = m.notary_service
+            self.messaging_network.register_service_endpoint(
+                cluster.name, m.info.name
+            )
+        for node in self.nodes:
+            node.services.network_map_cache.add_node(cluster, advertised)
+            node.services.identity_service.register_identity(cluster)
+        self._clusters.append((cluster, advertised))
+        return cluster, members, bus
+
     def run_network(self, max_messages: int = 100_000) -> int:
         """Pump messages until the network is quiescent."""
         return self.messaging_network.run(max_messages)

@@ -205,3 +205,87 @@ class TestNotaryClusterIntegration:
                 h.result.result(timeout=15)
         finally:
             net.stop_nodes()
+
+
+class TestBFTNotaryCluster:
+    """The BFT cluster returns f+1 REPLICA signatures which collectively
+    fulfil the f+1-threshold composite identity (reference
+    BFTNonValidatingNotaryService + response extractor)."""
+
+    def _spend_pair(self, net, bank, cluster):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.core.contracts.structures import StateAndRef, StateRef
+        from corda_tpu.core.transactions.builder import TransactionBuilder
+        from corda_tpu.finance.cash import CashCommand, CashState
+
+        token = Issued(bank.info.ref(1), "USD")
+        builder = TransactionBuilder(notary=cluster)
+        builder.add_output_state(
+            CashState(amount=Amount(100, token), owner=bank.info)
+        )
+        builder.add_command(CashCommand.Issue(), bank.info.owning_key)
+        issue = bank.services.sign_initial_transaction(builder)
+        bank.services.record_transactions([issue])
+
+        def spend():
+            ref = StateRef(issue.id, 0)
+            ts = bank.services.load_state(ref)
+            b = TransactionBuilder(notary=cluster)
+            b.add_input_state(StateAndRef(ts, ref))
+            b.add_output_state(
+                CashState(amount=Amount(100, token), owner=bank.info)
+            )
+            b.add_command(CashCommand.Move(), bank.info.owning_key)
+            return bank.services.sign_initial_transaction(b)
+
+        return spend(), spend()
+
+    def test_bft_notarisation_aggregates_replica_signatures(self):
+        from corda_tpu.node.notary import NotaryClientFlow
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_bft_notary_cluster(n_members=4)
+        bank = net.create_node("O=BFTBank,L=London,C=GB")
+        try:
+            stx1, _ = self._spend_pair(net, bank, cluster)
+            h = bank.start_flow(
+                NotaryClientFlow(stx1, notary_validating=False), stx1
+            )
+            net.run_network()
+            sigs = h.result.result(timeout=30)
+            f = (4 - 1) // 3
+            assert len(sigs) >= f + 1
+            # distinct replica keys, all leaves of the composite identity
+            signers = {s.by.encoded for s in sigs}
+            assert len(signers) >= f + 1
+            leaf_keys = {k.encoded for k in cluster.owning_key.keys}
+            assert signers <= leaf_keys
+        finally:
+            net.stop_nodes()
+
+    def test_bft_double_spend_conflicts(self):
+        import pytest as _pytest
+
+        from corda_tpu.node.notary import NotaryClientFlow
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_bft_notary_cluster(n_members=4)
+        bank = net.create_node("O=BFTBank2,L=London,C=GB")
+        try:
+            stx1, stx2 = self._spend_pair(net, bank, cluster)
+            h = bank.start_flow(
+                NotaryClientFlow(stx1, notary_validating=False), stx1
+            )
+            net.run_network()
+            assert h.result.result(timeout=30)
+            h = bank.start_flow(
+                NotaryClientFlow(stx2, notary_validating=False), stx2
+            )
+            net.run_network()
+            with _pytest.raises(Exception, match="[Cc]onflict"):
+                h.result.result(timeout=30)
+        finally:
+            net.stop_nodes()
