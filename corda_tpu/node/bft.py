@@ -380,12 +380,22 @@ class BFTClient:
     not be able to forge a result."""
 
     def __init__(self, client_id: str, n_replicas: int,
-                 send_to_replica: Callable[[int, dict], None]):
+                 send_to_replica: Callable[[int, dict], None],
+                 reply_validator: Optional[Callable] = None):
+        """reply_validator(command, result) -> bool: when given, a reply
+        only counts toward the f+1 quorum if it validates — e.g. the BFT
+        notary requires a cryptographically-valid replica signature on
+        conflict-free verdicts, so a Byzantine replica echoing the agreed
+        verdict WITHOUT its signature cannot complete the quorum and
+        starve the client of the f+1 signatures it needs (the honest
+        >= 2f+1 majority still reaches f+1 valid replies)."""
         self.client_id = client_id
         self.n = n_replicas
         self.f = (n_replicas - 1) // 3
         self._send = send_to_replica
+        self._reply_validator = reply_validator
         self._pending: Dict[str, Future] = {}
+        self._commands: Dict[str, dict] = {}
         # request_id -> {replica_id: result}: one vote per replica
         self._replies: Dict[str, Dict[int, object]] = {}
         self._counter = 0
@@ -398,6 +408,7 @@ class BFTClient:
             fut: Future = Future()
             self._pending[request_id] = fut
             self._replies[request_id] = {}
+            self._commands[request_id] = command
         fut.request_id = request_id  # lets callers forget() on timeout
         request = {
             "client_id": self.client_id, "request_id": request_id,
@@ -415,6 +426,7 @@ class BFTClient:
         with self._lock:
             self._pending.pop(request_id, None)
             self._replies.pop(request_id, None)
+            self._commands.pop(request_id, None)
 
     @staticmethod
     def _verdict_of(result: object) -> object:
@@ -437,6 +449,11 @@ class BFTClient:
                 return  # fabricated ids must not mint extra quorum votes
             if replica_id in replies:
                 return  # one vote per replica: repeats can't inflate quorum
+            if self._reply_validator is not None and not self._reply_validator(
+                self._commands.get(request_id), result
+            ):
+                return  # invalid reply (e.g. missing/forged signature):
+                        # never counts toward quorum; honest replies will
             replies[replica_id] = result
             blob = serialize(self._verdict_of(result))
             agreeing = [
@@ -446,6 +463,7 @@ class BFTClient:
             if len(agreeing) >= self.f + 1:
                 self._pending.pop(request_id)
                 self._replies.pop(request_id)
+                self._commands.pop(request_id, None)
                 verdict = self._verdict_of(result)
                 sigs = [
                     replies[rid]["tx_sig"] for rid in agreeing

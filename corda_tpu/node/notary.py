@@ -54,7 +54,12 @@ class UniquenessException(Exception):
 # ---------------------------------------------------------------------------
 
 class UniquenessProvider:
-    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party):
+        """Consume `states` for `tx_id` or raise UniquenessException.
+
+        May return a list of notary signatures over the tx id when the
+        commit protocol itself produces them (the BFT provider returns
+        the f+1 replica signatures); None otherwise."""
         raise NotImplementedError
 
 
@@ -149,7 +154,6 @@ class BFTUniquenessProvider(UniquenessProvider):
 
     def __init__(self, bft_client):
         self.client = bft_client
-        self._tx_sigs: Dict[bytes, list] = {}
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
         entries = {
@@ -162,10 +166,6 @@ class BFTUniquenessProvider(UniquenessProvider):
             "tx_id": tx_id.bytes.hex(),
         })
         result = fut.result(timeout=30)
-        # f+1 replica signatures over the tx id ride the agreed verdict
-        # (keyed per tx: concurrent commits must not cross wires)
-        if result.get("tx_sigs"):
-            self._tx_sigs[tx_id.bytes] = list(result["tx_sigs"])
         if result["conflicts"]:
             by_key = {
                 PersistentUniquenessProvider._key(ref).hex(): ref
@@ -179,6 +179,9 @@ class BFTUniquenessProvider(UniquenessProvider):
                     if k in by_key
                 },
             ))
+        # the f+1 replica signatures over the tx id, returned per-request
+        # so concurrent notarisations of the same tx cannot cross wires
+        return list(result.get("tx_sigs") or []) or None
 
     @staticmethod
     def make_replica_apply(db: NodeDatabase, sign_tx_fn=None):
@@ -236,10 +239,14 @@ class NotaryService:
         if not time_window.contains(now):
             raise NotaryException("time-window invalid")
 
-    def commit_input_states(self, inputs: List[StateRef], tx_id) -> None:
+    def commit_input_states(self, inputs: List[StateRef], tx_id):
+        """Commit; returns the commit protocol's notary signatures when it
+        produced them (BFT: f+1 replica signatures), else None."""
         audit = getattr(self.services, "audit_service", None)
         try:
-            self.uniqueness_provider.commit(inputs, tx_id, self.identity)
+            sigs = self.uniqueness_provider.commit(
+                inputs, tx_id, self.identity
+            )
         except UniquenessException as e:
             if audit is not None:
                 audit.record_event(
@@ -252,23 +259,12 @@ class NotaryService:
                 self.identity.name, "notary.commit",
                 tx_id=tx_id.bytes.hex(), inputs=len(inputs),
             )
+        return sigs
 
     def sign(self, tx_id) -> object:
         return self.services.key_management_service.sign(
             tx_id.bytes, self.identity.owning_key
         )
-
-    def sign_all(self, tx_id) -> tuple:
-        """Every notary signature for the response. For a BFT-backed
-        service the commit already produced f+1 replica signatures over
-        the tx id (enough to fulfil an f+1-threshold composite cluster
-        identity); otherwise the serving identity's own signature."""
-        replica_sigs = getattr(self.uniqueness_provider, "_tx_sigs", None)
-        if replica_sigs is not None:
-            sigs = replica_sigs.pop(tx_id.bytes, None)
-            if sigs:
-                return tuple(sigs)
-        return (self.sign(tx_id),)
 
 
 class SimpleNotaryService(NotaryService):
@@ -419,9 +415,11 @@ class NotaryServiceFlow(FlowLogic):
             service, payload
         )
         service.validate_time_window(time_window)
-        service.commit_input_states(inputs, tx_id)
-        sigs = service.sign_all(tx_id)
-        yield self.send(self.counterparty, NotarisationResponse(tuple(sigs)))
+        commit_sigs = service.commit_input_states(inputs, tx_id)
+        # the commit protocol's own signatures (BFT: f+1 replicas) win;
+        # otherwise the serving identity signs
+        sigs = tuple(commit_sigs) if commit_sigs else (service.sign(tx_id),)
+        yield self.send(self.counterparty, NotarisationResponse(sigs))
 
     def _receive_and_verify(self, service: NotaryService, payload):
         from ..core.transactions.notary_change import (

@@ -74,6 +74,48 @@ class MockNetwork:
             legal_name, notary_type="validating" if validating else "simple"
         )
 
+    def _assemble_cluster(
+        self, n_members, cluster_name, member_prefix, validating,
+        threshold, provider_factory,
+    ):
+        """Shared cluster assembly: spawn members, mint the composite
+        identity, install per-member notary services on the given
+        uniqueness provider, register the service address (round-robin +
+        dead-member skip = client failover) and fan the identity out to
+        every present and future node."""
+        from ..node.cluster_identity import generate_service_identity
+        from ..node.notary import SimpleNotaryService, ValidatingNotaryService
+        from ..node.services import NetworkMapCache
+
+        members = [
+            self.create_node(
+                f"O={member_prefix} {i},L=Zurich,C=CH",
+                notary_type="validating" if validating else "simple",
+            )
+            for i in range(n_members)
+        ]
+        cluster = generate_service_identity(
+            cluster_name, [m.info.owning_key for m in members], threshold
+        )
+        provider = provider_factory(cluster, members)
+        svc_cls = ValidatingNotaryService if validating else SimpleNotaryService
+        advertised = [NetworkMapCache.NOTARY_SERVICE] + (
+            [NetworkMapCache.VALIDATING_NOTARY_SERVICE] if validating else []
+        )
+        for m in members:
+            m.notary_service = svc_cls(
+                m.services, m.info, uniqueness_provider=provider
+            )
+            m.services.notary_service = m.notary_service
+            self.messaging_network.register_service_endpoint(
+                cluster.name, m.info.name
+            )
+        for node in self.nodes:
+            node.services.network_map_cache.add_node(cluster, advertised)
+            node.services.identity_service.register_identity(cluster)
+        self._clusters.append((cluster, advertised))
+        return cluster, members
+
     def create_notary_cluster(
         self,
         n_members: int = 3,
@@ -85,53 +127,21 @@ class MockNetwork:
         (reference: Raft/BFT notary clusters + ServiceIdentityGenerator).
 
         Members share a uniqueness provider (the replicated-commit-log
-        abstraction; swap in RaftUniquenessProvider replicas for consensus
-        tests), register under the cluster's service address
-        (round-robin + dead-member skip = client failover), and each signs
-        with its own leaf key of the composite cluster identity.
+        abstraction; see create_bft_notary_cluster for real PBFT) and each
+        signs with its own leaf key of the composite cluster identity.
 
         Returns (cluster_party, [member_nodes]).
         """
-        from ..node.cluster_identity import generate_service_identity
-        from ..node.notary import (
-            PersistentUniquenessProvider,
-            SimpleNotaryService,
-            ValidatingNotaryService,
-        )
-        from ..node.services import NetworkMapCache
-
-        members = [
-            self.create_node(
-                f"O=Notary Member {i},L=Zurich,C=CH",
-                notary_type="validating" if validating else "simple",
-            )
-            for i in range(n_members)
-        ]
-        cluster = generate_service_identity(
-            cluster_name, [m.info.owning_key for m in members], threshold
-        )
-        # own DB: the commit log must survive any single member's death
         from ..node.database import NodeDatabase
+        from ..node.notary import PersistentUniquenessProvider
 
-        shared_provider = PersistentUniquenessProvider(NodeDatabase(":memory:"))
-        svc_cls = ValidatingNotaryService if validating else SimpleNotaryService
-        advertised = [NetworkMapCache.NOTARY_SERVICE] + (
-            [NetworkMapCache.VALIDATING_NOTARY_SERVICE] if validating else []
+        return self._assemble_cluster(
+            n_members, cluster_name, "Notary Member", validating, threshold,
+            # own DB: the commit log must survive any member's death
+            lambda cluster, members: PersistentUniquenessProvider(
+                NodeDatabase(":memory:")
+            ),
         )
-        for m in members:
-            m.notary_service = svc_cls(
-                m.services, m.info, uniqueness_provider=shared_provider
-            )
-            m.services.notary_service = m.notary_service
-            self.messaging_network.register_service_endpoint(
-                cluster.name, m.info.name
-            )
-        # every node (present and future) resolves the cluster identity
-        for node in self.nodes:
-            node.services.network_map_cache.add_node(cluster, advertised)
-            node.services.identity_service.register_identity(cluster)
-        self._clusters.append((cluster, advertised))
-        return cluster, members
 
     def create_bft_notary_cluster(
         self,
@@ -149,27 +159,13 @@ class MockNetwork:
         from collections import deque
 
         from ..node.bft import BFTClient, BFTReplica
-        from ..node.cluster_identity import generate_service_identity
         from ..node.database import NodeDatabase
-        from ..node.notary import BFTUniquenessProvider, SimpleNotaryService
-        from ..node.services import NetworkMapCache
-
-        members = [
-            self.create_node(
-                f"O=BFT Member {i},L=Zurich,C=CH", notary_type="simple"
-            )
-            for i in range(n_members)
-        ]
-        f = (n_members - 1) // 3
-        cluster = generate_service_identity(
-            cluster_name, [m.info.owning_key for m in members],
-            threshold=f + 1,
-        )
+        from ..node.notary import BFTUniquenessProvider
 
         class _Bus:
             """Synchronous in-process message bus: every enqueue drains
             unless a drain is already running (replica handlers are not
-            re-entered)."""
+            re-entered). `dead` simulates crashed/partitioned replicas."""
 
             def __init__(self):
                 self.queue = deque()
@@ -185,7 +181,7 @@ class MockNetwork:
                 try:
                     while self.queue:
                         kind, a, b, c = self.queue.popleft()
-                        if kind == "msg" and b not in self.dead:
+                        if kind == "msg" and b not in self.dead and a not in self.dead:
                             self.replicas[b].on_message(a, c)
                         elif kind == "req" and b not in self.dead:
                             self.replicas[b].on_request(c)
@@ -195,52 +191,77 @@ class MockNetwork:
                     self._draining = False
 
         bus = _Bus()
-        bus.client = BFTClient("notary-cluster", n_members, lambda rid, req: (
-            bus.queue.append(("req", None, rid, req)), bus.drain()
-        ))
 
-        def make_transport(src):
-            def transport(dst, payload):
-                bus.queue.append(("msg", src, dst, payload))
-                bus.drain()
-            return transport
+        def provider_factory(cluster, members):
+            # a reply counts toward the f+1 quorum only if conflict-laden
+            # or carrying a VALID replica signature over the tx id by a
+            # cluster leaf key — a Byzantine replica omitting/forging its
+            # signature cannot complete the quorum and starve the client
+            leaf_keys = {k.encoded for k in cluster.owning_key.keys}
 
-        def make_reply(idx):
-            def reply(client_id, request_id, result):
-                bus.queue.append(("reply", idx, request_id, result))
-                bus.drain()
-            return reply
+            def validate_reply(command, result) -> bool:
+                if not isinstance(result, dict):
+                    return True
+                if result.get("conflicts"):
+                    return True
+                tx_hex = (command or {}).get("tx_id")
+                if tx_hex is None:
+                    return True
+                sig = result.get("tx_sig")
+                if sig is None:
+                    return False
+                try:
+                    return (
+                        sig.by.encoded in leaf_keys
+                        and sig.is_valid(bytes.fromhex(tx_hex))
+                    )
+                except Exception:
+                    return False
 
-        def make_sign(member):
-            def sign_tx(tx_id_bytes: bytes):
-                return member.services.key_management_service.sign(
-                    tx_id_bytes, member.info.owning_key
+            bus.client = BFTClient(
+                "notary-cluster", len(members),
+                lambda rid, req: (
+                    bus.queue.append(("req", None, rid, req)), bus.drain()
+                ),
+                reply_validator=validate_reply,
+            )
+
+            def make_transport(src):
+                def transport(dst, payload):
+                    bus.queue.append(("msg", src, dst, payload))
+                    bus.drain()
+                return transport
+
+            def make_reply(idx):
+                def reply(client_id, request_id, result):
+                    bus.queue.append(("reply", idx, request_id, result))
+                    bus.drain()
+                return reply
+
+            def make_sign(member):
+                def sign_tx(tx_id_bytes: bytes):
+                    return member.services.key_management_service.sign(
+                        tx_id_bytes, member.info.owning_key
+                    )
+                return sign_tx
+
+            for i, m in enumerate(members):
+                apply_fn = BFTUniquenessProvider.make_replica_apply(
+                    NodeDatabase(":memory:"), sign_tx_fn=make_sign(m)
                 )
-            return sign_tx
-
-        for i, m in enumerate(members):
-            apply_fn = BFTUniquenessProvider.make_replica_apply(
-                NodeDatabase(":memory:"), sign_tx_fn=make_sign(m)
-            )
-            bus.replicas.append(
-                BFTReplica(
-                    i, n_members, make_transport(i), apply_fn, make_reply(i)
+                bus.replicas.append(
+                    BFTReplica(
+                        i, len(members), make_transport(i), apply_fn,
+                        make_reply(i),
+                    )
                 )
-            )
-        provider = BFTUniquenessProvider(bus.client)
-        advertised = [NetworkMapCache.NOTARY_SERVICE]
-        for m in members:
-            m.notary_service = SimpleNotaryService(
-                m.services, m.info, uniqueness_provider=provider
-            )
-            m.services.notary_service = m.notary_service
-            self.messaging_network.register_service_endpoint(
-                cluster.name, m.info.name
-            )
-        for node in self.nodes:
-            node.services.network_map_cache.add_node(cluster, advertised)
-            node.services.identity_service.register_identity(cluster)
-        self._clusters.append((cluster, advertised))
+            return BFTUniquenessProvider(bus.client)
+
+        f = (n_members - 1) // 3
+        cluster, members = self._assemble_cluster(
+            n_members, cluster_name, "BFT Member", validating=False,
+            threshold=f + 1, provider_factory=provider_factory,
+        )
         return cluster, members, bus
 
     def run_network(self, max_messages: int = 100_000) -> int:

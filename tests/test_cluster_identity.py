@@ -289,3 +289,62 @@ class TestBFTNotaryCluster:
                 h.result.result(timeout=30)
         finally:
             net.stop_nodes()
+
+    def test_dead_replica_does_not_block_quorum(self):
+        """n=4 tolerates f=1: with one replica dead the remaining three
+        still commit and return >= f+1 valid signatures."""
+        from corda_tpu.node.notary import NotaryClientFlow
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_bft_notary_cluster(n_members=4)
+        bank = net.create_node("O=BFTBank3,L=London,C=GB")
+        try:
+            bus.dead.add(3)  # crash a replica before any request
+            stx1, _ = self._spend_pair(net, bank, cluster)
+            h = bank.start_flow(
+                NotaryClientFlow(stx1, notary_validating=False), stx1
+            )
+            net.run_network()
+            sigs = h.result.result(timeout=30)
+            assert len({s.by.encoded for s in sigs}) >= 2  # f+1
+        finally:
+            net.stop_nodes()
+
+    def test_signature_withholding_replica_cannot_starve_quorum(self):
+        """A Byzantine replica echoing the agreed verdict WITHOUT its
+        signature must not count toward the quorum (round-2 review
+        finding): honest replicas still deliver f+1 valid signatures."""
+        from corda_tpu.node.notary import NotaryClientFlow
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_bft_notary_cluster(n_members=4)
+        bank = net.create_node("O=BFTBank4,L=London,C=GB")
+        try:
+            # replica 0 (the primary) turns Byzantine: strips its tx_sig
+            evil = bus.replicas[0]
+            original_reply = evil.reply_fn
+
+            def stripping_reply(client_id, request_id, result):
+                if isinstance(result, dict):
+                    result = {
+                        k: v for k, v in result.items() if k != "tx_sig"
+                    }
+                original_reply(client_id, request_id, result)
+
+            evil.reply_fn = stripping_reply
+            stx1, _ = self._spend_pair(net, bank, cluster)
+            h = bank.start_flow(
+                NotaryClientFlow(stx1, notary_validating=False), stx1
+            )
+            net.run_network()
+            sigs = h.result.result(timeout=30)
+            signers = {s.by.encoded for s in sigs}
+            assert len(signers) >= 2
+            # every returned signature is a valid leaf signature
+            leaf = {k.encoded for k in cluster.owning_key.keys}
+            assert signers <= leaf
+            assert all(s.is_valid(stx1.id.bytes) for s in sigs)
+        finally:
+            net.stop_nodes()
