@@ -264,6 +264,130 @@ class MockNetwork:
         )
         return cluster, members, bus
 
+    def create_raft_notary_cluster(
+        self,
+        n_members: int = 3,
+        cluster_name: str = "O=Raft Notary,L=Zurich,C=CH",
+        validating: bool = True,
+    ):
+        """Crash-fault-tolerant notary cluster: every member runs a Raft
+        replica of the commit log (reference RaftValidatingNotaryService
+        over Copycat); any member can serve — commits forward to the
+        current leader — and the cluster presents a threshold-1 composite
+        identity (any member's signature settles it, like the reference's
+        CFT semantics).
+
+        Returns (cluster_party, [member_nodes], raft_bus). The bus
+        supports `bus.kill(i)` + `bus.elect()` for leader-failover tests.
+        """
+        from collections import deque
+
+        from ..node.database import NodeDatabase
+        from ..node.notary import RaftUniquenessProvider
+        from ..node.raft import LEADER, NotLeaderError, RaftNode
+
+        class _RaftBus:
+            def __init__(self):
+                self.queue = deque()
+                self.nodes = {}        # raft id -> RaftNode
+                self.dead = set()
+                self._draining = False
+                self.now = 0.0
+
+            def send(self, src, dst, payload):
+                self.queue.append((src, dst, payload))
+                self.drain()
+
+            def drain(self):
+                if self._draining:
+                    return
+                self._draining = True
+                try:
+                    while self.queue:
+                        src, dst, payload = self.queue.popleft()
+                        if src in self.dead or dst in self.dead:
+                            continue
+                        node = self.nodes.get(dst)
+                        if node is not None:
+                            node.on_message(src, payload)
+                finally:
+                    self._draining = False
+
+            def kill(self, raft_id: str) -> None:
+                self.dead.add(raft_id)
+
+            def leader(self):
+                for rid, node in self.nodes.items():
+                    if rid not in self.dead and node.role == LEADER:
+                        return node
+                return None
+
+            def elect(self, max_ticks: int = 600):
+                """Advance virtual time until a live leader exists."""
+                for _ in range(max_ticks):
+                    ldr = self.leader()
+                    if ldr is not None:
+                        return ldr
+                    self.now += 0.05
+                    for rid, node in self.nodes.items():
+                        if rid not in self.dead:
+                            node.tick(self.now)
+                    self.drain()
+                raise RuntimeError("no raft leader elected")
+
+        bus = _RaftBus()
+
+        class _RaftClusterProvider:
+            """Commit via the current leader, retrying across elections —
+            the client-side failover the reference gets from CopycatClient."""
+
+            def __init__(self, providers):
+                self._providers = providers  # raft id -> RaftUniquenessProvider
+
+            def commit(self, states, tx_id, requesting_party):
+                last_exc = None
+                for _ in range(5):
+                    leader = bus.elect()
+                    provider = self._providers[leader.node_id]
+                    try:
+                        return provider.commit(states, tx_id, requesting_party)
+                    except NotLeaderError as exc:  # lost leadership mid-commit
+                        last_exc = exc
+                        bus.now += 1.0
+                raise last_exc
+
+        def provider_factory(cluster, members):
+            ids = [f"r{i}" for i in range(len(members))]
+            providers = {}
+
+            def make_transport(src):
+                def transport(dst, payload):
+                    bus.send(src, dst, payload)
+                return transport
+
+            def make_apply(rid):
+                def apply(cmd):
+                    return providers[rid].apply(cmd)
+                return apply
+
+            for i, rid in enumerate(ids):
+                node = RaftNode(
+                    rid, ids, make_transport(rid), make_apply(rid),
+                    db=NodeDatabase(":memory:"), seed=i,
+                )
+                bus.nodes[rid] = node
+                providers[rid] = RaftUniquenessProvider(
+                    node, NodeDatabase(":memory:")
+                )
+            bus.elect()
+            return _RaftClusterProvider(providers)
+
+        cluster, members = self._assemble_cluster(
+            n_members, cluster_name, "Raft Member", validating=validating,
+            threshold=1, provider_factory=provider_factory,
+        )
+        return cluster, members, bus
+
     def run_network(self, max_messages: int = 100_000) -> int:
         """Pump messages until the network is quiescent."""
         return self.messaging_network.run(max_messages)

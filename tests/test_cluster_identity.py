@@ -348,3 +348,58 @@ class TestBFTNotaryCluster:
             assert all(s.is_valid(stx1.id.bytes) for s in sigs)
         finally:
             net.stop_nodes()
+
+
+class TestRaftNotaryCluster:
+    """CFT cluster: commits replicate through Raft; any member serves and
+    a leader crash fails over (reference RaftValidatingNotaryService)."""
+
+    def _issue_and_pay(self, net, bank, cluster):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.finance.flows import CashIssueFlow, CashPaymentFlow
+
+        h = bank.start_flow(CashIssueFlow(
+            Amount(100, "USD"), b"\x01", bank.info, cluster
+        ))
+        net.run_network()
+        h.result.result(timeout=20)
+        token = Issued(bank.info.ref(1), "USD")
+        h = bank.start_flow(CashPaymentFlow(
+            Amount(100, token), bank.info, cluster
+        ))
+        net.run_network()
+        return h.result.result(timeout=20)
+
+    def test_raft_cluster_notarises(self):
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_raft_notary_cluster(n_members=3)
+        bank = net.create_node("O=RaftBank,L=London,C=GB")
+        try:
+            self._issue_and_pay(net, bank, cluster)
+            states = bank.services.vault_service.unconsumed_states()
+            assert states and all(
+                s.state.notary.name == cluster.name for s in states
+            )
+        finally:
+            net.stop_nodes()
+
+    def test_leader_crash_fails_over(self):
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        cluster, members, bus = net.create_raft_notary_cluster(n_members=3)
+        bank = net.create_node("O=RaftBank2,L=London,C=GB")
+        try:
+            self._issue_and_pay(net, bank, cluster)
+            leader = bus.leader()
+            bus.kill(leader.node_id)
+            # a new leader is elected and the cluster keeps notarising
+            self._issue_and_pay(net, bank, cluster)
+            new_leader = bus.leader()
+            assert new_leader is not None
+            assert new_leader.node_id != leader.node_id
+        finally:
+            net.stop_nodes()
