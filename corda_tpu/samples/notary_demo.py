@@ -1,6 +1,11 @@
-"""Notary demo (reference `samples/notary-demo/`): notarise a stream of
-transactions through a validating notary, then demonstrate double-spend
-rejection.  `--raft` exercises the Raft uniqueness provider cluster."""
+"""Notary demo (reference `samples/notary-demo/` Single/Raft/BFT
+cordforms): notarise a stream of transactions, then demonstrate
+double-spend rejection.
+
+Modes: (default) single validating notary; `--raft` a 3-member Raft
+cluster behind one composite identity with leader forwarding; `--bft` a
+4-member PBFT cluster returning f+1 replica signatures.
+"""
 from __future__ import annotations
 
 import sys
@@ -11,10 +16,22 @@ from ..node.notary import NotaryException
 from ..testing import MockNetwork
 
 
-def main(n_transactions: int = 10, verbose: bool = True) -> dict:
+def main(n_transactions: int = 10, verbose: bool = True,
+         mode: str = "single") -> dict:
     log = print if verbose else (lambda *a, **k: None)
     net = MockNetwork()
-    notary = net.create_notary_node(validating=True)
+    if mode == "raft":
+        notary_party, members, _bus = net.create_raft_notary_cluster(3)
+        notary = type("C", (), {"info": notary_party})()
+        log(f"raft notary cluster: {len(members)} members, composite "
+            f"identity {notary_party.name}")
+    elif mode == "bft":
+        notary_party, members, _bus = net.create_bft_notary_cluster(4)
+        notary = type("C", (), {"info": notary_party})()
+        log(f"bft notary cluster: {len(members)} members (f=1), f+1 "
+            f"replica signatures per commit")
+    else:
+        notary = net.create_notary_node(validating=True)
     bank = net.create_node("O=Bank,L=London,C=GB")
     alice = net.create_node("O=Alice,L=London,C=GB")
     bob = net.create_node("O=Bob,L=New York,C=US")
@@ -82,5 +99,10 @@ def main(n_transactions: int = 10, verbose: bool = True) -> dict:
 
 
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
-    main(n)
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    mode = (
+        "raft" if "--raft" in sys.argv
+        else "bft" if "--bft" in sys.argv
+        else "single"
+    )
+    main(int(args[0]) if args else 10, mode=mode)

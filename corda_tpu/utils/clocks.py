@@ -17,10 +17,14 @@ from typing import Callable, List
 class TestClock:
     """Manually-advanced clock for deterministic tests and simulations.
 
+    (Named after the reference's TestClock; not itself a test case.)
+
     Callable (returns current unix seconds), monotone non-decreasing:
     `advance_by` rejects negative deltas and `set_to` rejects travel into
     the past, matching the reference TestClock's forward-only contract.
     """
+
+    __test__ = False  # pytest: not a test case despite the name
 
     def __init__(self, start: float = 1_400_000_000.0) -> None:
         self._now = float(start)
