@@ -89,6 +89,11 @@ class Factory:
         os.makedirs(node_dir, exist_ok=True)
         with open(os.path.join(node_dir, "node.conf"), "w") as fh:
             json.dump(conf, fh)
+        return self.launch(node_dir, timeout=timeout)
+
+    def launch(self, node_dir: str, timeout: float = 120) -> NodeProcess:
+        """Boot an EXISTING node directory (e.g. one materialised by
+        tools/cordform.deploy_nodes) as a black box."""
         log_path = os.path.join(node_dir, "node.log")
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -158,3 +158,63 @@ class TestSmokeAndDemobench:
             assert "Alpha stopped" in out.getvalue()
         finally:
             bench.shutdown()
+
+
+@pytest.mark.slow
+class TestCordformDeploymentBoots:
+    """Capstone: a cordform-materialised network boots as real OS
+    processes and settles a cross-node payment (reference
+    TraderDemoTest-style integration over deployNodes output)."""
+
+    def test_deployed_network_trades(self, tmp_path):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.testing.smoketesting import Factory
+
+        spec = {
+            "nodes": [
+                {"name": "O=DeployNotary,L=Zurich,C=CH",
+                 "notary": "validating", "network_map_service": True},
+                {"name": "O=DeployBankA,L=London,C=GB"},
+                {"name": "O=DeployBankB,L=Paris,C=FR"},
+            ]
+        }
+        resolved = deploy_nodes(spec, str(tmp_path))
+        factory = Factory(str(tmp_path))
+        nodes = []
+        try:
+            # boot the directory node first so others can register
+            for conf in resolved:
+                nodes.append(factory.launch(conf["dir"]))
+            conn_a = nodes[1].connect()
+            conn_b = nodes[2].connect()
+            ops_a, ops_b = conn_a.proxy, conn_b.proxy
+            info_b = ops_b.node_info()
+            notary_party = ops_a.notary_identities()[0]
+
+            flow_id = ops_a.start_flow_dynamic(
+                "CashIssueFlow", Amount(500_00, "USD"), b"\x01",
+                ops_a.node_info(), notary_party,
+            )
+            ops_a.flow_result(flow_id, 60)
+            token = Issued(ops_a.node_info().ref(1), "USD")
+            flow_id = ops_a.start_flow_dynamic(
+                "CashPaymentFlow", Amount(500_00, token), info_b,
+                notary_party,
+            )
+            ops_a.flow_result(flow_id, 60)
+
+            deadline = 30
+            import time as _time
+
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < deadline:
+                states = ops_b.vault_query()
+                if states:
+                    break
+                _time.sleep(0.3)
+            assert states, "payment never reached bank B's vault"
+            assert states[0].state.data.amount.quantity == 500_00
+        finally:
+            for n in nodes:
+                n.close()
