@@ -352,6 +352,21 @@ class ContractUpgradeAcceptor(AbstractStateReplacementAcceptor):
 
         if not isinstance(proposal.modification, str):
             raise StateReplacementException("modification must be a contract name")
+        # explicit per-state authorisation (reference ContractUpgradeService
+        # + CordaRPCOps.authoriseContractUpgrade): being a registered
+        # upgrade is NOT consent — this node must have opted the state in
+        upgrade_svc = getattr(
+            self.service_hub, "contract_upgrade_service", None
+        )
+        authorised = (
+            upgrade_svc.authorised_upgrade(proposal.state_ref)
+            if upgrade_svc is not None else None
+        )
+        if authorised != proposal.modification:
+            raise StateReplacementException(
+                f"upgrade of {proposal.state_ref} to "
+                f"{proposal.modification} is not authorised on this node"
+            )
         cls = _CONTRACT_REGISTRY.get(proposal.modification)
         upgraded = cls() if cls is not None else None
         if upgraded is None or not isinstance(upgraded, UpgradedContract):

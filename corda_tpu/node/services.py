@@ -127,6 +127,33 @@ class IdentityService:
         return list(self._by_name.values())
 
 
+class ContractUpgradeService:
+    """Per-state upgrade authorisations (reference
+    `ContractUpgradeService` / `CordaRPCOps.authoriseContractUpgrade`):
+    a counterparty's ContractUpgradeAcceptor REFUSES to co-sign an
+    upgrade of a state unless this node explicitly authorised that
+    (state, upgraded-contract) pair first."""
+
+    def __init__(self):
+        self._authorised: Dict[Tuple[bytes, int], str] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(state_ref) -> Tuple[bytes, int]:
+        return (state_ref.txhash.bytes, state_ref.index)
+
+    def authorise(self, state_ref, upgraded_contract_name: str) -> None:
+        with self._lock:
+            self._authorised[self._key(state_ref)] = upgraded_contract_name
+
+    def deauthorise(self, state_ref) -> None:
+        with self._lock:
+            self._authorised.pop(self._key(state_ref), None)
+
+    def authorised_upgrade(self, state_ref) -> Optional[str]:
+        return self._authorised.get(self._key(state_ref))
+
+
 class KeyManagementService:
     """The node's signing keys (reference PersistentKeyManagementService).
     Keys persist in the DB so a restarted node keeps its identities."""
@@ -507,6 +534,7 @@ class ServiceHub:
         # StateMachineRecordedTransactionMappingStorage + its RPC feed)
         self.tx_mappings: List[Dict] = []
         self._tx_mapping_updates = _Observable()
+        self.contract_upgrade_service = ContractUpgradeService()
         self.identity_service = IdentityService()
         self.key_management_service = KeyManagementService(
             db, initial_keys=[legal_identity_key]

@@ -295,6 +295,18 @@ class CordaRPCOps:
     def current_node_time(self) -> float:
         return self._services.clock()
 
+    # -- contract upgrades ----------------------------------------------------
+
+    def authorise_contract_upgrade(self, state_ref, upgraded_name: str) -> None:
+        """Consent to a counterparty upgrading this state (reference
+        CordaRPCOps.authoriseContractUpgrade)."""
+        self._services.contract_upgrade_service.authorise(
+            state_ref, upgraded_name
+        )
+
+    def deauthorise_contract_upgrade(self, state_ref) -> None:
+        self._services.contract_upgrade_service.deauthorise(state_ref)
+
     # -- flow control ---------------------------------------------------------
 
     def kill_flow(self, flow_id: str) -> bool:

@@ -200,6 +200,11 @@ class TestNotaryChange(_Base):
 class TestContractUpgrade(_Base):
     def test_happy_path(self):
         original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        # the counterparty must explicitly consent (reference
+        # authoriseContractUpgrade)
+        self.bob.services.contract_upgrade_service.authorise(
+            original.ref, "DealV2"
+        )
         h = self.alice.start_flow(ContractUpgradeFlow(original, "DealV2"))
         self.net.run_network()
         new_ref = h.result.result(timeout=5)
@@ -209,6 +214,25 @@ class TestContractUpgrade(_Base):
         for node in (self.alice, self.bob):
             ts = node.services.load_state(new_ref.ref)
             assert ts.data.contract_name == "DealV2"
+
+    def test_unauthorised_upgrade_refused(self):
+        """Registration alone is not consent: without an authorisation the
+        acceptor rejects (reference ContractUpgradeService gate)."""
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        h = self.alice.start_flow(ContractUpgradeFlow(original, "DealV2"))
+        self.net.run_network()
+        with pytest.raises(Exception, match="not authorised"):
+            h.result.result(timeout=5)
+
+    def test_deauthorised_upgrade_refused(self):
+        original = self._issue_deal([self.alice, self.bob], self.notary_a)
+        svc = self.bob.services.contract_upgrade_service
+        svc.authorise(original.ref, "DealV2")
+        svc.deauthorise(original.ref)
+        h = self.alice.start_flow(ContractUpgradeFlow(original, "DealV2"))
+        self.net.run_network()
+        with pytest.raises(Exception, match="not authorised"):
+            h.result.result(timeout=5)
 
     def test_unregistered_contract_refused(self):
         original = self._issue_deal([self.alice, self.bob], self.notary_a)
