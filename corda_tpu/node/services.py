@@ -447,6 +447,31 @@ class VaultService:
 
     # -- soft locking (in-flight spend reservation) --------------------------
 
+    # -- transaction notes (reference addVaultTransactionNote /
+    # getVaultTransactionNotes, CordaRPCOps.kt) ------------------------------
+
+    def add_transaction_note(self, tx_id, note: str) -> None:
+        self.db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_tx_notes ("
+            " tx_id BLOB NOT NULL, note TEXT NOT NULL)"
+        )
+        self.db.execute(
+            "INSERT INTO vault_tx_notes(tx_id, note) VALUES(?, ?)",
+            (tx_id.bytes, note),
+        )
+
+    def get_transaction_notes(self, tx_id) -> List[str]:
+        self.db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_tx_notes ("
+            " tx_id BLOB NOT NULL, note TEXT NOT NULL)"
+        )
+        return [
+            row[0] for row in self.db.query(
+                "SELECT note FROM vault_tx_notes WHERE tx_id = ?",
+                (tx_id.bytes,),
+            )
+        ]
+
     def soft_lock_reserve(self, lock_id: str, refs: List[StateRef]) -> None:
         with self.db.lock:
             for ref in refs:

@@ -47,10 +47,22 @@ class InteractiveShell(cmd.Cmd):
                 flow_name, args = parse_flow_start(
                     rest, identity_lookup=self.ops.party_from_name
                 )
+                # tracked start: ProgressTracker steps render live in the
+                # shell (reference InteractiveShell +
+                # FlowWatchPrintingSubscriber / ANSIProgressRenderer)
                 if isinstance(args, dict):
-                    flow_id = self.ops.start_flow_dynamic(flow_name, **args)
+                    flow_id, progress = self.ops.start_tracked_flow_dynamic(
+                        flow_name, **args
+                    )
                 else:
-                    flow_id = self.ops.start_flow_dynamic(flow_name, *args)
+                    flow_id, progress = self.ops.start_tracked_flow_dynamic(
+                        flow_name, *args
+                    )
+                for label in progress.snapshot:
+                    self._println(f"  ▶ {label}")
+                progress.updates.subscribe(
+                    lambda label: self._println(f"  ▶ {label}")
+                )
                 if self._pump is not None:
                     self._pump()
                 result = self.ops.flow_result(flow_id, timeout=30)

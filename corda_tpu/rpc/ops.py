@@ -295,6 +295,14 @@ class CordaRPCOps:
     def current_node_time(self) -> float:
         return self._services.clock()
 
+    # -- vault notes ----------------------------------------------------------
+
+    def add_vault_transaction_note(self, tx_id, note: str) -> None:
+        self._services.vault_service.add_transaction_note(tx_id, note)
+
+    def get_vault_transaction_notes(self, tx_id) -> List[str]:
+        return self._services.vault_service.get_transaction_notes(tx_id)
+
     # -- contract upgrades ----------------------------------------------------
 
     def authorise_contract_upgrade(self, state_ref, upgraded_name: str) -> None:

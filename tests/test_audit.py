@@ -125,3 +125,27 @@ class TestFlowTxMapping:
         assert live[0]["flow_id"] == h.flow_id
         assert ops.state_machine_recorded_transaction_mapping_feed().snapshot
         net.stop_nodes()
+
+
+class TestVaultTransactionNotes:
+    def test_notes_round_trip(self):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.finance.flows import CashIssueFlow
+
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        bank = net.create_node("O=NoteBank,L=London,C=GB")
+        ops = CordaRPCOps(bank.services, bank.smm)
+        h = bank.start_flow(CashIssueFlow(
+            Amount(100, "USD"), b"\x01", bank.info, notary.info
+        ))
+        net.run_network()
+        h.result.result(timeout=10)
+        stx = ops.verified_transactions_feed().snapshot[0]
+        assert ops.get_vault_transaction_notes(stx.id) == []
+        ops.add_vault_transaction_note(stx.id, "month-end issuance")
+        ops.add_vault_transaction_note(stx.id, "audited")
+        assert ops.get_vault_transaction_notes(stx.id) == [
+            "month-end issuance", "audited",
+        ]
+        net.stop_nodes()
