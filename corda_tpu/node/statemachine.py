@@ -18,6 +18,7 @@ opens its own session while plain sub-flows share their parent's.
 """
 from __future__ import annotations
 
+import logging
 import uuid
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -104,6 +105,9 @@ class FlowStateMachine:
         self.waiting_tx: Optional[Any] = None
         self.done = False
         self._gen = None
+        # per-flow structured logger (reference: logger named
+        # `net.corda.flow.$id`, FlowStateMachineImpl.kt:77)
+        self.logger = logging.getLogger(f"corda_tpu.flow.{flow_id}")
         self._session_counter = len(self.sessions)
         # sub_flow instance ordinals: reset at construction so replay hands
         # out the same sequence (sub_flow calls re-execute in order).
@@ -392,12 +396,18 @@ class FlowStateMachine:
 
     def _complete(self, value) -> None:
         self.done = True
+        self.logger.info(
+            "flow %s completed", self.flow.flow_name(),
+        )
         self._end_sessions(None)
         self.smm._flow_finished(self)
         self.result.set_result(value)
 
     def _fail(self, exc: BaseException) -> None:
         self.done = True
+        self.logger.warning(
+            "flow %s failed: %s", self.flow.flow_name(), exc,
+        )
         # Only FlowExceptions propagate their type+message to peers (reference
         # FlowException model); anything else is an opaque counter-flow error.
         msg = (

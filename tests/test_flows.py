@@ -478,3 +478,30 @@ class TestTearOffCompleteness:
         with pytest.raises(FilteredTransactionVerificationError, match="reveals 1 of 2"):
             ftx.check_all_inputs_revealed()  # ...but completeness fails
         net.stop_nodes()
+
+
+class TestPerFlowLogging:
+    def test_flow_logger_named_by_id_and_records(self, caplog):
+        import logging
+
+        from corda_tpu.core.flows import FlowLogic, startable_by_rpc
+        from corda_tpu.testing import MockNetwork
+
+        @startable_by_rpc
+        class LoggedFlow(FlowLogic):
+            def call(self):
+                return 1
+                yield  # pragma: no cover
+
+        net = MockNetwork()
+        node = net.create_node("O=Logged,L=London,C=GB")
+        with caplog.at_level(logging.INFO, logger="corda_tpu.flow"):
+            h = node.start_flow(LoggedFlow())
+            net.run_network()
+            h.result.result(timeout=5)
+        records = [
+            r for r in caplog.records
+            if r.name == f"corda_tpu.flow.{h.flow_id}"
+        ]
+        assert records and "completed" in records[-1].message
+        net.stop_nodes()
