@@ -438,17 +438,27 @@ class FlowStateMachine:
         self.smm.checkpoint_storage.put(self.flow_id, blob)
         self.smm.checkpoints_written += 1
         self.smm.metrics.meter("Flows.CheckpointingRate").mark()
+        if self.smm.dev_checkpoint_check:
+            self.smm._check_checkpoint_restorable(self.flow_id, blob)
 
 
 class StateMachineManager:
     """Flow scheduler: starts flows, restores them from checkpoints, routes
     session messages (reference `StateMachineManager.kt`)."""
 
-    def __init__(self, service_hub, messaging, checkpoint_storage, our_identity: Party):
+    def __init__(self, service_hub, messaging, checkpoint_storage, our_identity: Party,
+                 dev_checkpoint_check: bool = True):
+        """dev_checkpoint_check: validate every written checkpoint is
+        restorable — deserializable, flow class registered, constructor
+        args intact — surfacing unrestorable flows at WRITE time instead
+        of at the restart that needs them (reference dev-mode checkpoint
+        deserialization checker, StateMachineManager.kt:114-115).
+        Synchronous and cheap (one decode); disable for max throughput."""
         self.service_hub = service_hub
         self.messaging = messaging
         self.checkpoint_storage = checkpoint_storage
         self.our_identity = our_identity
+        self.dev_checkpoint_check = dev_checkpoint_check
         self.flows: Dict[str, FlowStateMachine] = {}
         self._sessions: Dict[str, FlowStateMachine] = {}  # local session id -> fsm
         self._initiated_dedup: Dict[Tuple[str, str], str] = {}  # (peer, init_id) -> local id
@@ -495,6 +505,25 @@ class StateMachineManager:
     def track(self, observer: Callable) -> None:
         """observer(event: str, fsm) on started/finished."""
         self._changes.append(observer)
+
+    def _check_checkpoint_restorable(self, flow_id: str, blob: bytes) -> None:
+        """Surface restore problems at checkpoint-WRITE time: a
+        non-deserializable checkpoint is a hard error (the bytes are
+        garbage); an unregistered flow class is a loud warning (starting
+        unregistered flows is allowed — they run, but a restart could not
+        restore them; register via the node's cordapps config)."""
+        try:
+            state = deserialize(blob)
+        except Exception as exc:
+            raise FlowException(
+                f"checkpoint for {flow_id} is not restorable: {exc}"
+            ) from exc
+        if flow_registry.get(state["flow_name"]) is None:
+            logging.getLogger(f"corda_tpu.flow.{flow_id}").warning(
+                "flow %s is not in the flow registry — a restart could "
+                "not restore this checkpoint",
+                state["flow_name"],
+            )
 
     def kill_flow(self, flow_id: str) -> bool:
         """Forcibly fail a live flow (reference CordaRPCOps.killFlow):

@@ -505,3 +505,39 @@ class TestPerFlowLogging:
         ]
         assert records and "completed" in records[-1].message
         net.stop_nodes()
+
+
+class TestDevCheckpointChecker:
+    def test_unregistered_flow_warned_at_write_time(self, caplog):
+        """A flow whose class is not in the registry checkpoints fine
+        byte-wise but could never restore; dev mode logs a loud warning
+        at the first suspension instead of a silent restart failure
+        (reference dev-mode checkpoint deserializability checker)."""
+        import logging
+
+        from corda_tpu.core.flows import FlowLogic
+        from corda_tpu.core.flows.api import flow_registry, initiating_flow
+        from corda_tpu.testing import MockNetwork
+
+        from corda_tpu.core.crypto.secure_hash import SecureHash
+        from corda_tpu.core.flows.api import WaitForLedgerCommit
+
+        @initiating_flow
+        class EphemeralFlow(FlowLogic):
+            def call(self):
+                yield WaitForLedgerCommit(SecureHash.sha256(b"never"))
+
+        net = MockNetwork()
+        node = net.create_node("O=Dev,L=London,C=GB")
+        # simulate a flow registered in another process only
+        name = EphemeralFlow.flow_name()
+        del flow_registry[name]
+        try:
+            with caplog.at_level(logging.WARNING, logger="corda_tpu.flow"):
+                node.start_flow(EphemeralFlow())
+            assert any(
+                "not in the flow registry" in r.message for r in caplog.records
+            )
+        finally:
+            flow_registry[name] = EphemeralFlow
+            net.stop_nodes()
