@@ -29,12 +29,14 @@ def main() -> None:
     from corda_tpu.core.crypto import ed25519_math
     from corda_tpu.ops import ed25519_batch
 
+    tunnel_note = None
     try:
         on_tpu = jax.default_backend() == "tpu"
-    except RuntimeError:
+    except RuntimeError as exc:
         # accelerator tunnel down: report a CPU number rather than crash
         jax.config.update("jax_platforms", "cpu")
         on_tpu = False
+        tunnel_note = f"accelerator tunnel unreachable ({exc}); CPU fallback"
     batch = BATCH if on_tpu else 4096  # CPU fallback kernel is ~100x slower
 
     t_start = time.perf_counter()
@@ -87,6 +89,7 @@ def main() -> None:
                 "batch": batch,
                 "backend": jax.devices()[0].platform,
                 "end_to_end": True,
+                **({"note": tunnel_note} if tunnel_note else {}),
                 **extras,
             }
         )
