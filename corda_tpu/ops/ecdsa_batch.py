@@ -240,6 +240,41 @@ def verify_batch(
     signatures: Sequence[bytes],
     messages: Sequence[bytes],
 ) -> List[bool]:
+    if jax.default_backend() == "tpu":
+        try:
+            return _verify_batch_pallas(
+                curve_name, public_keys, signatures, messages
+            )
+        except Exception:
+            # untested-on-this-hardware Pallas path must never sink
+            # verification: fall through to the portable XLA kernel
+            pass
     kwargs, n = prepare_batch(curve_name, public_keys, signatures, messages)
     mask = np.asarray(_verify_kernel(curve_name, **kwargs))
     return [bool(b) for b in mask[:n]]
+
+
+def _verify_batch_pallas(
+    curve_name, public_keys, signatures, messages
+) -> List[bool]:
+    """TPU path: the VMEM Shamir-ladder kernel (ops/ecdsa_pallas.py)."""
+    from . import ecdsa_pallas as _pl
+
+    n = len(public_keys)
+    pad = max(
+        _pl.BLK,
+        ((n + _pl.BLK - 1) // _pl.BLK) * _pl.BLK,
+    )
+    kwargs, real = prepare_batch(
+        curve_name, public_keys, signatures, messages, pad_to=pad
+    )
+    mask = _pl.verify_kernel_pallas(
+        curve_name,
+        kwargs["qx"].T,
+        kwargs["qy"].T,
+        kwargs["u1_words"].T,
+        kwargs["u2_words"].T,
+        kwargs["r_cmp"].T,
+        kwargs["ok"][None, :].astype(jnp.uint32),
+    )
+    return [bool(b) for b in np.asarray(mask)[0, :real]]

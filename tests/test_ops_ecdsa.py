@@ -179,3 +179,76 @@ class TestWycheproofStyleVectors:
         ]
         out = self._run(rows)
         assert out[0] is True and not any(out[1:])
+
+
+class TestPallasCore:
+    """The Pallas ECDSA kernel's math core run on CPU with array-backed
+    accessors must agree with the host oracle (same pattern as
+    tests/test_ops_ed25519.py TestPallasCore)."""
+
+    @pytest.mark.parametrize("curve_name", ["secp256k1", "secp256r1"])
+    def test_verify_core_off_tpu(self, curve_name):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from corda_tpu.core.crypto import secp_math
+        from corda_tpu.ops import ecdsa_batch, ecdsa_pallas
+
+        curve = (
+            secp_math.SECP256K1 if curve_name == "secp256k1"
+            else secp_math.SECP256R1
+        )
+        width = 8
+        rng = np.random.default_rng(11)
+        pubs, sigs, msgs, expect = [], [], [], []
+        for i in range(width):
+            priv = int.from_bytes(rng.bytes(32), "big") % (curve.n - 1) + 1
+            pub = curve.mul(priv, curve.g)
+            msg = rng.bytes(40)
+            r, s = secp_math.ecdsa_sign(curve, priv, msg)
+            sig = secp_math.der_encode_sig(r, s)
+            if i == 1:
+                msg = msg + b"!"          # digest mismatch
+            elif i == 2:
+                other = curve.mul(priv + 1, curve.g)
+                pub = other               # wrong key
+            pubs.append(curve.encode_point(pub))
+            sigs.append(sig)
+            msgs.append(msg)
+            pt = curve.decode_point(pubs[-1])
+            rr, ss = secp_math.der_decode_sig(sig)
+            expect.append(
+                secp_math.ecdsa_verify(curve, pt, msg, rr, ss)
+            )
+        kwargs, _ = ecdsa_batch.prepare_batch(
+            curve_name, pubs, sigs, msgs, pad_to=width
+        )
+
+        table = {}
+        idx_rows = {}
+        stacked = {}
+
+        def read_idx(t):
+            if "idx" not in stacked:
+                stacked["idx"] = jnp.concatenate(
+                    [idx_rows[k] for k in range(128)], axis=0
+                )
+            return lax.dynamic_slice_in_dim(stacked["idx"], t, 1, axis=0)
+
+        mask = ecdsa_pallas._verify_core(
+            curve_name,
+            width,
+            jnp.asarray(np.asarray(kwargs["qx"]).T),
+            jnp.asarray(np.asarray(kwargs["qy"]).T),
+            jnp.asarray(np.asarray(kwargs["u1_words"]).T),
+            jnp.asarray(np.asarray(kwargs["u2_words"]).T),
+            jnp.asarray(np.asarray(kwargs["r_cmp"]).T),
+            jnp.asarray(np.asarray(kwargs["ok"])[None, :].astype(np.uint32)),
+            write_table=table.__setitem__,
+            read_table=table.__getitem__,
+            write_idx=idx_rows.__setitem__,
+            read_idx=read_idx,
+        )
+        got = [bool(v) for v in np.asarray(mask)[0]]
+        assert got == expect
+        assert got[0] is True and got[1] is False and got[2] is False
