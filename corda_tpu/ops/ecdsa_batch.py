@@ -234,47 +234,51 @@ def prepare_batch(
     }, n
 
 
+_pallas_failed_once = False
+
+
 def verify_batch(
     curve_name: str,
     public_keys: Sequence[bytes],
     signatures: Sequence[bytes],
     messages: Sequence[bytes],
 ) -> List[bool]:
-    if jax.default_backend() == "tpu":
-        try:
-            return _verify_batch_pallas(
-                curve_name, public_keys, signatures, messages
-            )
-        except Exception:
-            # untested-on-this-hardware Pallas path must never sink
-            # verification: fall through to the portable XLA kernel
-            pass
-    kwargs, n = prepare_batch(curve_name, public_keys, signatures, messages)
-    mask = np.asarray(_verify_kernel(curve_name, **kwargs))
-    return [bool(b) for b in mask[:n]]
-
-
-def _verify_batch_pallas(
-    curve_name, public_keys, signatures, messages
-) -> List[bool]:
-    """TPU path: the VMEM Shamir-ladder kernel (ops/ecdsa_pallas.py)."""
-    from . import ecdsa_pallas as _pl
-
+    global _pallas_failed_once
     n = len(public_keys)
-    pad = max(
-        _pl.BLK,
-        ((n + _pl.BLK - 1) // _pl.BLK) * _pl.BLK,
-    )
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from . import ecdsa_pallas as _pl
+
+        # power-of-2 bucket >= BLK: kernel shapes stay in a small fixed
+        # set (this kernel's Mosaic compile is expensive; recompiling per
+        # batch size would dominate — same invariant as ed25519's buckets)
+        pad = max(_pl.BLK, 1 << (max(n, 1) - 1).bit_length())
+    else:
+        pad = None
     kwargs, real = prepare_batch(
         curve_name, public_keys, signatures, messages, pad_to=pad
     )
-    mask = _pl.verify_kernel_pallas(
-        curve_name,
-        kwargs["qx"].T,
-        kwargs["qy"].T,
-        kwargs["u1_words"].T,
-        kwargs["u2_words"].T,
-        kwargs["r_cmp"].T,
-        kwargs["ok"][None, :].astype(jnp.uint32),
-    )
-    return [bool(b) for b in np.asarray(mask)[0, :real]]
+    if on_tpu and not _pallas_failed_once:
+        try:
+            mask = _pl.verify_kernel_pallas(
+                curve_name,
+                kwargs["qx"].T,
+                kwargs["qy"].T,
+                kwargs["u1_words"].T,
+                kwargs["u2_words"].T,
+                kwargs["r_cmp"].T,
+                kwargs["ok"][None, :].astype(jnp.uint32),
+            )
+            return [bool(b) for b in np.asarray(mask)[0, :real]]
+        except Exception:
+            # the Pallas path must never sink verification: log once and
+            # serve everything from the portable XLA kernel from here on
+            _pallas_failed_once = True
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "Pallas ECDSA kernel failed; falling back to the XLA "
+                "kernel for the rest of this process"
+            )
+    mask = np.asarray(_verify_kernel(curve_name, **kwargs))
+    return [bool(b) for b in mask[:real]]

@@ -36,32 +36,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..core.crypto import secp_math
-from .field_secp import FIELD_K1, FIELD_R1, MontField, NLIMB
+from .field_secp import MontField
+# shared row-layout helpers (incl. _cat's Mosaic drop-zero-rows rule) and
+# the layout-agnostic curve table live with their original kernels
+from .ed25519_pallas import _cat, _const_col, _limbs, _zeros
+from .ecdsa_batch import _CURVES, _double
 
 BLK = int(os.environ.get("CORDA_TPU_ECDSA_BLK", "256"))
 
 _MASK = np.uint32(0xFFFF)
-
-
-def _limbs(x: int):
-    return [(x >> (16 * k)) & 0xFFFF for k in range(16)]
-
-
-def _const_col(limbs, width):
-    return jnp.concatenate(
-        [jnp.full((1, width), np.uint32(int(v)), jnp.uint32) for v in limbs],
-        axis=0,
-    )
-
-
-def _zeros(rows, width):
-    return jnp.zeros((rows, width), jnp.uint32)
-
-
-def _cat(parts):
-    live = [p for p in parts if p.shape[0] > 0]
-    return live[0] if len(live) == 1 else jnp.concatenate(live, axis=0)
 
 
 class _RowField:
@@ -198,25 +181,10 @@ class _RowField:
         return acc == 0
 
 
-# --- Jacobian point ops (coords (16, W) Montgomery; Z == 0 <=> infinity) ----
-
-def _double(F: _RowField, a_mont, X, Y, Z):
-    XX = F.square(X)
-    YY = F.square(Y)
-    YYYY = F.square(YY)
-    ZZ = F.square(Z)
-    S = F.sub(F.square(F.add(X, YY)), F.add(XX, YYYY))
-    S = F.add(S, S)
-    M = F.add(F.add(XX, XX), XX)
-    M = F.add(M, F.mul(a_mont, F.square(ZZ)))
-    X3 = F.sub(F.square(M), F.add(S, S))
-    Y8 = F.add(YYYY, YYYY)
-    Y8 = F.add(Y8, Y8)
-    Y8 = F.add(Y8, Y8)
-    Y3 = F.sub(F.mul(M, F.sub(S, X3)), Y8)
-    Z3 = F.sub(F.square(F.add(Y, Z)), F.add(YY, ZZ))
-    return X3, Y3, Z3
-
+# --- Jacobian point ops (coords (16, W) Montgomery; Z == 0 <=> infinity).
+# _double is reused from ecdsa_batch (pure field ops, layout-agnostic);
+# _add_general is re-expressed here because its degenerate-case masks are
+# (1, W) rows in this layout, not trailing-limb-dim broadcasts.
 
 def _add_general(F: _RowField, a_mont, X1, Y1, Z1, X2, Y2, Z2):
     """add-2007-bl with degenerate cases by mask (port of
@@ -259,12 +227,6 @@ def _add_general(F: _RowField, a_mont, X1, Y1, Z1, X2, Y2, Z2):
 
 
 # --- the verification program ------------------------------------------------
-
-_CURVES = {
-    "secp256k1": (FIELD_K1, 0, secp_math.SECP256K1),
-    "secp256r1": (FIELD_R1, secp_math.SECP256R1.a, secp_math.SECP256R1),
-}
-
 
 def _verify_core(curve_name, width, qx, qy, u1_words, u2_words, r_cmp, ok_in,
                  write_table, read_table, write_idx, read_idx):
