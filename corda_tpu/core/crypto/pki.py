@@ -312,7 +312,8 @@ def write_cert_store(directory: str, **entries: CertAndKey) -> None:
     os.makedirs(directory, exist_ok=True)
     for alias, ck in entries.items():
         _atomic_write(os.path.join(directory, f"{alias}.cert.pem"), ck.cert_pem())
-        _atomic_write(os.path.join(directory, f"{alias}.key.pem"), ck.key_pem())
+        if ck.key is not None:  # cert-only entries (e.g. a downloaded chain)
+            _atomic_write(os.path.join(directory, f"{alias}.key.pem"), ck.key_pem())
 
 
 def read_cert(directory: str, alias: str) -> CertAndKey:

@@ -1,0 +1,196 @@
+"""Network registration: CSR submission to a doorman (reference
+`node/.../utilities/registration/NetworkRegistrationHelper.kt:1-150` —
+the node generates a certificate signing request, POSTs it to the
+network's doorman over HTTP, polls until the signed certificate chain
+comes back, and installs it in its certificate store).
+
+Includes a `DoormanServer` (the registration-service half: reference's
+doorman is a separate product; a functioning stdlib-HTTP one here makes
+the protocol testable end-to-end) with optional manual approval.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib import request as _urlreq
+
+from cryptography import x509
+from cryptography.hazmat.primitives import serialization
+
+from ..core.crypto import pki
+
+
+class RegistrationError(Exception):
+    pass
+
+
+# --- client side (the node's helper) -----------------------------------------
+
+class NetworkRegistrationHelper:
+    """Generate CSR -> POST /certificate -> poll GET /certificate/{id}
+    until APPROVED -> write the chain into the node's certificate store."""
+
+    def __init__(self, doorman_url: str, legal_name: str, cert_dir: str):
+        self.doorman_url = doorman_url.rstrip("/")
+        self.legal_name = legal_name
+        self.cert_dir = cert_dir
+
+    def register(self, timeout: float = 60, poll_interval: float = 0.2):
+        csr, key = pki.create_csr(self.legal_name)
+        pem = csr.public_bytes(serialization.Encoding.PEM)
+        req = _urlreq.Request(
+            f"{self.doorman_url}/certificate",
+            data=pem,
+            method="POST",
+            headers={"Content-Type": "application/x-pem-file"},
+        )
+        with _urlreq.urlopen(req, timeout=10) as resp:
+            request_id = json.loads(resp.read())["request_id"]
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with _urlreq.urlopen(
+                f"{self.doorman_url}/certificate/{request_id}", timeout=10
+            ) as resp:
+                body = json.loads(resp.read())
+            if body["status"] == "APPROVED":
+                chain = [
+                    x509.load_pem_x509_certificate(
+                        base64.b64decode(pem_b64)
+                    )
+                    for pem_b64 in body["certificates"]
+                ]
+                self._install(chain, key)
+                return chain
+            if body["status"] == "REJECTED":
+                raise RegistrationError(
+                    f"doorman rejected registration: {body.get('reason')}"
+                )
+            time.sleep(poll_interval)
+        raise RegistrationError(f"registration not approved in {timeout}s")
+
+    def _install(self, chain, key) -> None:
+        """Persist leaf + chain + key as the node's identity material
+        (reference: keystore writes at the end of registration)."""
+        entries = {}
+        names = ["identity", "intermediate", "root"]
+        for name, cert in zip(names, chain):
+            entries[name] = pki.CertAndKey(
+                cert=cert, key=key if name == "identity" else None
+            )
+        pki.write_cert_store(self.cert_dir, **entries)
+
+
+# --- server side (a working doorman) -----------------------------------------
+
+class DoormanServer:
+    """Registration service: issues node CA certs under a root/intermediate
+    it controls. auto_approve=False holds requests for .approve(id)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 auto_approve: bool = True):
+        self.root = pki.create_self_signed_ca("Doorman Root CA")
+        self.intermediate = pki.create_intermediate_ca(self.root)
+        self.auto_approve = auto_approve
+        self._requests: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, value) -> None:
+                body = json.dumps(value).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/certificate":
+                    self._json(404, {"error": "no route"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                pem = self.rfile.read(length)
+                try:
+                    request_id = outer._submit(pem)
+                except Exception as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                self._json(200, {"request_id": request_id})
+
+            def do_GET(self):
+                prefix = "/certificate/"
+                if not self.path.startswith(prefix):
+                    self._json(404, {"error": "no route"})
+                    return
+                entry = outer._requests.get(self.path[len(prefix):])
+                if entry is None:
+                    self._json(404, {"error": "unknown request"})
+                    return
+                self._json(200, outer._status_body(entry))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="doorman", daemon=True
+        )
+        self._thread.start()
+
+    # -- protocol ------------------------------------------------------------
+
+    def _submit(self, pem: bytes) -> str:
+        csr = x509.load_pem_x509_csr(pem)
+        if not csr.is_signature_valid:
+            raise RegistrationError("CSR signature invalid")
+        request_id = str(uuid.uuid4())
+        with self._lock:
+            self._requests[request_id] = {"csr": csr, "status": "PENDING",
+                                          "certs": None, "reason": None}
+        if self.auto_approve:
+            self.approve(request_id)
+        return request_id
+
+    def approve(self, request_id: str) -> None:
+        with self._lock:
+            entry = self._requests[request_id]
+            cert = pki.sign_csr(self.intermediate, entry["csr"], is_ca=True)
+            entry["certs"] = [cert, self.intermediate.cert, self.root.cert]
+            entry["status"] = "APPROVED"
+
+    def reject(self, request_id: str, reason: str = "rejected") -> None:
+        with self._lock:
+            entry = self._requests[request_id]
+            entry["status"] = "REJECTED"
+            entry["reason"] = reason
+
+    def _status_body(self, entry: dict) -> dict:
+        body = {"status": entry["status"], "reason": entry["reason"]}
+        if entry["certs"]:
+            body["certificates"] = [
+                base64.b64encode(
+                    c.public_bytes(serialization.Encoding.PEM)
+                ).decode()
+                for c in entry["certs"]
+            ]
+        return body
+
+    def pending(self):
+        with self._lock:
+            return [
+                rid for rid, e in self._requests.items()
+                if e["status"] == "PENDING"
+            ]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)

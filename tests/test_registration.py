@@ -1,0 +1,90 @@
+"""Network registration tests (reference NetworkRegistrationHelper +
+doorman protocol)."""
+import os
+
+import pytest
+
+from corda_tpu.core.crypto import pki
+from corda_tpu.node.registration import (
+    DoormanServer,
+    NetworkRegistrationHelper,
+    RegistrationError,
+)
+
+
+class TestRegistration:
+    def test_auto_approved_registration(self, tmp_path):
+        doorman = DoormanServer()
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=NewNode,L=London,C=GB", str(tmp_path)
+            )
+            chain = helper.register(timeout=20)
+            assert len(chain) == 3
+            # installed identity verifies to the doorman's root
+            leaf = pki.read_cert(str(tmp_path), "identity")
+            assert pki.verify_chain(
+                leaf.cert, [doorman.intermediate.cert], doorman.root.cert
+            )
+            # the node CA cert can issue identity certs (is_ca)
+            assert os.path.exists(tmp_path / "identity.key.pem")
+            assert os.path.exists(tmp_path / "root.cert.pem")
+        finally:
+            doorman.stop()
+
+    def test_manual_approval_flow(self, tmp_path):
+        import threading
+
+        doorman = DoormanServer(auto_approve=False)
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=WaitingNode,L=Paris,C=FR", str(tmp_path)
+            )
+            result = {}
+
+            def run():
+                result["chain"] = helper.register(timeout=30)
+
+            t = threading.Thread(target=run)
+            t.start()
+            deadline = 50
+            import time
+
+            t0 = time.monotonic()
+            while not doorman.pending() and time.monotonic() - t0 < deadline:
+                time.sleep(0.05)
+            pending = doorman.pending()
+            assert len(pending) == 1
+            doorman.approve(pending[0])
+            t.join(timeout=30)
+            assert len(result["chain"]) == 3
+        finally:
+            doorman.stop()
+
+    def test_rejection_raises(self, tmp_path):
+        import threading
+        import time
+
+        doorman = DoormanServer(auto_approve=False)
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=BadNode,L=X,C=GB", str(tmp_path)
+            )
+            err = {}
+
+            def run():
+                try:
+                    helper.register(timeout=30)
+                except RegistrationError as exc:
+                    err["exc"] = exc
+
+            t = threading.Thread(target=run)
+            t.start()
+            t0 = time.monotonic()
+            while not doorman.pending() and time.monotonic() - t0 < 50:
+                time.sleep(0.05)
+            doorman.reject(doorman.pending()[0], "compliance")
+            t.join(timeout=30)
+            assert "compliance" in str(err["exc"])
+        finally:
+            doorman.stop()
