@@ -435,11 +435,11 @@ class BridgeManager:
                 except Exception as exc:
                     # Peer down: drop the connection, back off, retry —
                     # store-and-forward semantics.
-                    import sys as _sys
+                    import logging as _logging
 
-                    print(
-                        f"bridge {peer_name}: delivery failed ({type(exc).__name__}: {exc}); retrying",
-                        file=_sys.stderr, flush=True,
+                    _logging.getLogger(__name__).warning(
+                        "bridge %s: delivery failed (%s: %s); retrying",
+                        peer_name, type(exc).__name__, exc,
                     )
                     try:
                         if remote is not None:

@@ -77,12 +77,11 @@ class SchedulerService:
             for _, entry in due:
                 cls = flow_registry.get(entry["flow_name"])
                 if cls is None:
-                    import sys as _sys
+                    import logging as _logging
 
-                    print(
-                        f"scheduler: no flow registered as "
-                        f"{entry['flow_name']!r}; dropping activity",
-                        file=_sys.stderr,
+                    _logging.getLogger(__name__).warning(
+                        "no flow registered as %r; dropping activity",
+                        entry["flow_name"],
                     )
                     continue
                 args = tuple(entry["flow_args"])

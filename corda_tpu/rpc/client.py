@@ -156,12 +156,11 @@ class CordaRPCClient:
                 # JARs on the RPC client classpath; here: import the
                 # CorDapp python modules). A silent drop looks like a hung
                 # server, so say why.
-                import sys as _sys
+                import logging as _logging
 
-                print(
-                    f"corda_tpu.rpc: dropping undecodable message: {exc} "
-                    "(is the CorDapp module imported in this process?)",
-                    file=_sys.stderr,
+                _logging.getLogger(__name__).warning(
+                    "dropping undecodable message: %s "
+                    "(is the CorDapp module imported in this process?)", exc,
                 )
             self._consumer.ack(msg)
 

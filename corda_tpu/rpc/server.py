@@ -61,12 +61,12 @@ class RPCServer:
             try:
                 request = deserialize(msg.payload)
             except Exception as exc:
-                import sys as _sys
+                import logging as _logging
 
-                print(
-                    f"corda_tpu.rpc: dropping undecodable request: {exc} "
+                _logging.getLogger(__name__).warning(
+                    "dropping undecodable request: %s "
                     "(are the request's types imported in the node process?)",
-                    file=_sys.stderr,
+                    exc,
                 )
                 self._consumer.ack(msg)
                 continue
