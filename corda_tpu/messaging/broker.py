@@ -75,9 +75,15 @@ class _Journal:
     replay.  The C++ journal (corda_tpu.native) writes the identical format.
     """
 
+    #: acks appended since the last compaction before an online compaction
+    #: triggers (reference: Artemis journal compaction — an append-only
+    #: log of a busy queue would otherwise grow without bound)
+    COMPACT_ACK_THRESHOLD = 10_000
+
     def __init__(self, path: str, truncate: bool = False):
         self._path = path
         self._fh = open(path, "wb" if truncate else "ab")
+        self.acks_since_compact = 0
 
     def append_enqueue(self, msg: Message) -> None:
         hdr_blob = _encode_headers(msg.headers)
@@ -91,10 +97,26 @@ class _Journal:
 
     def append_ack(self, message_id: str) -> None:
         self._append(_REC_ACK, message_id.encode("ascii"))
+        self.acks_since_compact += 1
 
     def _append(self, rec_type: int, body: bytes) -> None:
         self._fh.write(struct.pack(">BI", rec_type, len(body)) + body)
         self._fh.flush()
+
+    def compact(self, pending: List[Message]) -> None:
+        """Rewrite the journal as just the pending set, crash-safely
+        (tmp + atomic rename — the same trick recovery uses): a crash at
+        any point leaves either the old full journal or the compacted one.
+        Caller must hold the broker lock and pass the authoritative
+        pending set (queued + in-flight)."""
+        self._fh.close()
+        tmp = _Journal(self._path + ".tmp", truncate=True)
+        for msg in pending:
+            tmp.append_enqueue(msg)
+        tmp.close()
+        os.replace(self._path + ".tmp", self._path)
+        self._fh = open(self._path, "ab")
+        self.acks_since_compact = 0
 
     def close(self) -> None:
         self._fh.close()
@@ -160,6 +182,16 @@ class _BrokerQueue:
         self.journal = journal
         self.closed = False
 
+    def pending_messages(self) -> List[Message]:
+        """Authoritative not-yet-acked set: in-flight (delivered, unacked)
+        first — they redeliver first on restart — then queued. Caller must
+        hold the broker lock."""
+        pending: List[Message] = []
+        for consumer in self.consumers:
+            pending.extend(consumer._unacked.values())
+        pending.extend(self.messages)
+        return pending
+
 
 class Consumer:
     """A pull consumer session on one queue.
@@ -204,8 +236,11 @@ class Consumer:
                 raise BrokerError(
                     f"ack of unknown/already-acked {msg.message_id}"
                 )
-            if self._queue.journal is not None:
-                self._queue.journal.append_ack(msg.message_id)
+            journal = self._queue.journal
+            if journal is not None:
+                journal.append_ack(msg.message_id)
+                if journal.acks_since_compact >= journal.COMPACT_ACK_THRESHOLD:
+                    journal.compact(self._queue.pending_messages())
 
     def close(self) -> None:
         q = self._queue
