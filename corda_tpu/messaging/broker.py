@@ -103,20 +103,35 @@ class _Journal:
         self._fh.write(struct.pack(">BI", rec_type, len(body)) + body)
         self._fh.flush()
 
-    def compact(self, pending: List[Message]) -> None:
-        """Rewrite the journal as just the pending set, crash-safely
-        (tmp + atomic rename — the same trick recovery uses): a crash at
-        any point leaves either the old full journal or the compacted one.
+    def compact(self, pending: List[Message]) -> bool:
+        """Rewrite the journal as just the pending set, crash-safely: the
+        tmp file is fully written FIRST, then atomically renamed over the
+        journal — a crash (or a failed tmp write, e.g. disk full) at any
+        point leaves the old journal intact and the live handle open.
         Caller must hold the broker lock and pass the authoritative
-        pending set (queued + in-flight)."""
-        self._fh.close()
+        pending set (queued + in-flight). Returns False if the rewrite
+        failed (the queue keeps appending to the old journal)."""
         tmp = _Journal(self._path + ".tmp", truncate=True)
-        for msg in pending:
-            tmp.append_enqueue(msg)
-        tmp.close()
+        try:
+            for msg in pending:
+                tmp.append_enqueue(msg)
+        except Exception:
+            tmp.close()
+            try:
+                os.remove(self._path + ".tmp")
+            except OSError:
+                pass
+            # back off a full threshold before retrying, don't hot-loop
+            self.acks_since_compact = 0
+            return False
+        finally:
+            if not tmp._fh.closed:
+                tmp.close()
+        self._fh.close()
         os.replace(self._path + ".tmp", self._path)
         self._fh = open(self._path, "ab")
         self.acks_since_compact = 0
+        return True
 
     def close(self) -> None:
         self._fh.close()
@@ -240,7 +255,15 @@ class Consumer:
             if journal is not None:
                 journal.append_ack(msg.message_id)
                 if journal.acks_since_compact >= journal.COMPACT_ACK_THRESHOLD:
-                    journal.compact(self._queue.pending_messages())
+                    pending = self._queue.pending_messages()
+                    # only compact when at least half the journal's records
+                    # are dead (Artemis min-compact-percent semantics): a
+                    # large standing backlog would otherwise be rewritten
+                    # in full, under the broker lock, for ~no space gain
+                    if journal.acks_since_compact >= len(pending):
+                        journal.compact(pending)
+                    else:
+                        journal.acks_since_compact = 0  # re-arm the window
 
     def close(self) -> None:
         q = self._queue
@@ -294,16 +317,10 @@ class Broker:
     def _recover_queue(self, name: str) -> None:
         path = self._journal_path(name)
         pending = _Journal.replay(path)
-        # Compact crash-safely: write the pending set to a tmp file (truncated
-        # in case a previous compaction crashed mid-write), then atomically
-        # rename over the journal. A crash at any point leaves either the old
-        # full journal or the complete compacted one.
-        tmp = _Journal(path + ".tmp", truncate=True)
-        for msg in pending:
-            tmp.append_enqueue(msg)
-        tmp.close()
-        os.replace(path + ".tmp", path)
+        # Startup compaction via the same crash-safe rewrite the online
+        # path uses (tmp fully written, then atomic rename).
         journal = _Journal(path)
+        journal.compact(pending)
         q = _BrokerQueue(name, self, journal)
         q.messages.extend(pending)
         self._queues[name] = q

@@ -197,3 +197,26 @@ class TestJournalCompaction:
         recovered = c2.receive(timeout=1)
         assert recovered is not None and recovered.payload == b"in-flight"
         broker2.close()
+
+    def test_large_backlog_skips_futile_compaction(self, tmp_path, monkeypatch):
+        """With a standing backlog larger than the dead-record count,
+        compaction is skipped (min-compact-percent semantics) and the
+        window re-arms."""
+        from corda_tpu.messaging.broker import Broker, _Journal
+
+        monkeypatch.setattr(_Journal, "COMPACT_ACK_THRESHOLD", 5)
+        broker = Broker(journal_dir=str(tmp_path))
+        broker.create_queue("backlog", durable=True)
+        consumer = broker.create_consumer("backlog")
+        for i in range(100):  # big standing backlog
+            broker.send("backlog", f"b{i}".encode())
+        journal = broker._queues["backlog"].journal
+        for _ in range(5):  # hits the ack threshold exactly
+            consumer.ack(consumer.receive(timeout=1))
+        # 5 acks < 95 pending: futile compaction skipped, window re-armed
+        assert journal.acks_since_compact == 0
+        import os
+
+        # journal still holds every record (no rewrite happened)
+        assert os.path.getsize(broker._journal_path("backlog")) > 5000
+        broker.close()
