@@ -193,3 +193,56 @@ class TestWebserverStreaming:
         finally:
             web.stop()
             net.stop_nodes()
+
+
+class TestWebserverStreamFailure:
+    def test_mid_stream_failure_drops_connection(self):
+        """If a chunk read fails after headers are sent, the server must
+        kill the connection rather than emit a JSON 500 into the body
+        (which would corrupt the download)."""
+        import urllib.request
+
+        from corda_tpu.webserver import WebServer
+
+        net = MockNetwork()
+        node = net.create_node("O=StreamFail,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        blob = b"\xcd" * (1_200_000)
+        att_id = ops.upload_attachment(blob)
+
+        class FlakyOps:
+            """Proxy that serves one chunk then breaks."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self._served = 0
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def attachment_chunk(self, att_id, offset, length=None):
+                self._served += 1
+                if self._served > 1:
+                    raise IOError("simulated broker failure")
+                return self._inner.attachment_chunk(att_id, offset, length)
+
+        web = WebServer(FlakyOps(ops), port=0)
+        try:
+            url = (
+                f"http://127.0.0.1:{web.port}/api/attachments/"
+                + att_id.bytes.hex()
+            )
+            got = None
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    got = resp.read()
+            except Exception:
+                pass  # connection died mid-body: correct behavior
+            # if the read "succeeded" it must NOT be a corrupted short body
+            # with an embedded JSON error
+            if got is not None:
+                assert b'{"error"' not in got
+                assert len(got) < len(blob)
+        finally:
+            web.stop()
+            net.stop_nodes()
