@@ -105,6 +105,22 @@ class RaftUniquenessProvider(UniquenessProvider):
     def __init__(self, raft_node, db: NodeDatabase):
         self.raft = raft_node
         self._map = KVStore(db, "raft_uniqueness")
+        # Log compaction (reference DistributedImmutableMap's snapshottable
+        # state machine): the Raft log's applied prefix folds into a dump
+        # of the uniqueness map.
+        if getattr(raft_node, "snapshot_fn", None) is None:
+            raft_node.snapshot_fn = self.snapshot
+        if getattr(raft_node, "restore_fn", None) is None:
+            raft_node.restore_fn = self.restore
+
+    def snapshot(self) -> bytes:
+        return serialize([[bytes(k), bytes(v)] for k, v in self._map.items()])
+
+    def restore(self, data: bytes) -> None:
+        for k, _ in list(self._map.items()):
+            self._map.delete(k)
+        for k, v in deserialize(data):
+            self._map.put(bytes(k), bytes(v))
 
     def apply(self, command: dict):
         """State-machine apply (runs on every replica, in log order)."""

@@ -12,9 +12,12 @@ Determinism: the node is driven externally — `tick(now)` advances election/
 heartbeat timers and `on_message` handles peer traffic — so tests step a
 cluster through elections, partitions, and leader kills without real time.
 
-Scope: leadership, replication, commit, and term safety are implemented;
-log compaction/snapshotting is not (the uniqueness log is append-only and
-bounded by ledger growth, matching the reference's usage pattern).
+Log compaction (Raft §7): once enough entries are applied, the state
+machine snapshot (snapshot_fn/restore_fn hooks) replaces the applied log
+prefix — the log no longer grows with ledger history, matching the
+reference's log-compacting snapshottable DistributedImmutableMap
+(`DistributedImmutableMap.kt:23-120`). Followers too far behind receive
+an InstallSnapshot instead of unreachable AppendEntries.
 """
 from __future__ import annotations
 
@@ -50,6 +53,8 @@ class RaftNode:
     # Timeouts in abstract "time units" — callers pass a consistent now().
     ELECTION_TIMEOUT = (10, 20)  # randomized range
     HEARTBEAT_INTERVAL = 3
+    #: applied entries kept in the log before a snapshot truncates them
+    SNAPSHOT_THRESHOLD = 1000
 
     def __init__(
         self,
@@ -59,27 +64,37 @@ class RaftNode:
         apply_fn: Callable[[dict], object],
         db: Optional[NodeDatabase] = None,
         seed: Optional[int] = None,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
     ):
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
         self.transport = transport
         self.apply_fn = apply_fn
+        # log compaction hooks: snapshot_fn captures the state machine,
+        # restore_fn replaces it (Raft §7); without them the log is kept
+        # whole (the pre-compaction behavior)
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
         self._rand = random.Random(seed if seed is not None else node_id)
         self._lock = threading.RLock()
-        # persistent state: meta (term/vote) + one KV row per log entry, so
-        # heartbeats cost nothing and appends are O(1), not O(log).
+        # persistent state: meta (term/vote/snapshot) + one KV row per log
+        # entry, so heartbeats cost nothing and appends are O(1), not O(log).
         self._meta = KVStore(db, "raft_meta") if db is not None else None
         self._log_store = KVStore(db, "raft_log") if db is not None else None
         self.current_term = 0
         self.voted_for: Optional[str] = None
         self.log: List[LogEntry] = []
+        # last logical index/term covered by the installed snapshot
+        self.snap_index = -1
+        self.snap_term = -1
         if self._meta is not None:
             self._load_persistent()
         # volatile state
         self.role = FOLLOWER
         self.leader_id: Optional[str] = None
-        self.commit_index = -1
-        self.last_applied = -1
+        self.commit_index = self.snap_index
+        self.last_applied = self.snap_index
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self._votes: set = set()
@@ -90,6 +105,21 @@ class RaftNode:
         # request_id -> future (leader only)
         self._pending: Dict[str, Future] = {}
         self._reset_election_deadline()
+
+    # -- logical-index helpers (the log may start after a snapshot) ----------
+
+    def last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _entry(self, logical: int) -> LogEntry:
+        return self.log[logical - self.snap_index - 1]
+
+    def _term_at(self, logical: int) -> int:
+        if logical < 0:
+            return -1
+        if logical == self.snap_index:
+            return self.snap_term
+        return self._entry(logical).term
 
     # -- persistence ---------------------------------------------------------
 
@@ -104,9 +134,17 @@ class RaftNode:
         vote = self._meta.get(b"voted_for")
         if vote is not None:
             self.voted_for = deserialize(vote)
+        snap = self._meta.get(b"snapshot")
+        if snap is not None:
+            meta = deserialize(self._meta.get(b"snapshot_meta"))
+            self.snap_index, self.snap_term = meta[0], meta[1]
+            if self.restore_fn is not None:
+                self.restore_fn(bytes(snap))
         rows = sorted(self._log_store.items(), key=lambda kv: kv[0])
         self.log = [
-            LogEntry(*deserialize(v)) for _, v in rows
+            LogEntry(*deserialize(v))
+            for k, v in rows
+            if int.from_bytes(k, "big") > self.snap_index
         ]
 
     def _persist_meta(self) -> None:
@@ -115,20 +153,83 @@ class RaftNode:
         self._meta.put(b"term", serialize(self.current_term))
         self._meta.put(b"voted_for", serialize(self.voted_for))
 
-    def _persist_log_from(self, start: int) -> None:
-        """Write log rows [start:); callers handle truncation separately."""
+    def _persist_log_from(self, start_logical: int) -> None:
+        """Write log rows [start_logical:); callers handle truncation
+        separately. Row keys are LOGICAL indices."""
         if self._log_store is None:
             return
-        for i in range(start, len(self.log)):
-            e = self.log[i]
-            self._log_store.put(self._log_key(i), serialize([e.term, e.command]))
+        for logical in range(start_logical, self.last_index() + 1):
+            e = self._entry(logical)
+            self._log_store.put(
+                self._log_key(logical), serialize([e.term, e.command])
+            )
 
-    def _persist_log_truncate(self, from_index: int) -> None:
+    def _persist_log_truncate(self, from_logical: int) -> None:
         if self._log_store is None:
             return
         for k, _ in list(self._log_store.items()):
-            if int.from_bytes(k, "big") >= from_index:
+            if int.from_bytes(k, "big") >= from_logical:
                 self._log_store.delete(k)
+
+    # -- snapshotting (Raft §7) ----------------------------------------------
+
+    def _maybe_snapshot(self) -> None:
+        """Fold the applied log prefix into a state-machine snapshot once
+        it is long enough. Caller holds the lock."""
+        if self.snapshot_fn is None:
+            return
+        applied_in_log = self.last_applied - self.snap_index
+        if applied_in_log < self.SNAPSHOT_THRESHOLD:
+            return
+        self._take_snapshot(self.last_applied)
+
+    def _take_snapshot(self, upto_logical: int) -> None:
+        data = self.snapshot_fn()
+        new_term = self._term_at(upto_logical)
+        # drop entries <= upto_logical
+        self.log = self.log[upto_logical - self.snap_index:]
+        self.snap_index = upto_logical
+        self.snap_term = new_term
+        if self._meta is not None:
+            self._meta.put(b"snapshot", data)
+            self._meta.put(
+                b"snapshot_meta", serialize([self.snap_index, self.snap_term])
+            )
+            for k, _ in list(self._log_store.items()):
+                if int.from_bytes(k, "big") <= upto_logical:
+                    self._log_store.delete(k)
+
+    def _install_snapshot(self, sender_id: str, msg: dict) -> None:
+        """Follower side of InstallSnapshot."""
+        if msg["term"] < self.current_term:
+            return
+        self.role = FOLLOWER
+        self.leader_id = sender_id
+        self._reset_election_deadline()
+        idx, term = msg["snap_index"], msg["snap_term"]
+        if idx <= self.snap_index:
+            return  # stale snapshot
+        if self.restore_fn is not None:
+            self.restore_fn(bytes(msg["data"]))
+        # Raft §7: if an existing entry matches the snapshot's last entry,
+        # retain the following suffix; otherwise discard the whole log.
+        if idx <= self.last_index() and self._term_at(idx) == term:
+            self.log = self.log[idx - self.snap_index:]
+        else:
+            self.log = []
+        self.snap_index = idx
+        self.snap_term = term
+        self.commit_index = max(self.commit_index, idx)
+        self.last_applied = max(self.last_applied, idx)
+        if self._meta is not None:
+            self._meta.put(b"snapshot", bytes(msg["data"]))
+            self._meta.put(b"snapshot_meta", serialize([idx, term]))
+            self._persist_log_truncate(0)
+            self._persist_log_from(idx + 1)
+        self._send(sender_id, {
+            "kind": "append_reply", "term": self.current_term,
+            "ok": True, "match_index": idx,
+        })
 
     # -- public API ----------------------------------------------------------
 
@@ -144,10 +245,10 @@ class RaftNode:
             if self.role != LEADER:
                 fut.set_exception(NotLeaderError(self.leader_id))
                 return fut
-            request_id = command.get("request_id") or f"{self.node_id}:{len(self.log)}:{self.current_term}"
+            request_id = command.get("request_id") or f"{self.node_id}:{self.last_index() + 1}:{self.current_term}"
             command = dict(command, request_id=request_id)
             self.log.append(LogEntry(self.current_term, command))
-            self._persist_log_from(len(self.log) - 1)
+            self._persist_log_from(self.last_index())
             self._pending[request_id] = fut
             # Single-node cluster commits immediately.
             self._advance_commit()
@@ -183,6 +284,8 @@ class RaftNode:
                 self._on_append(sender_id, msg)
             elif kind == "append_reply":
                 self._on_append_reply(sender_id, msg)
+            elif kind == "install_snapshot":
+                self._install_snapshot(sender_id, msg)
 
     # -- elections -----------------------------------------------------------
 
@@ -207,24 +310,23 @@ class RaftNode:
         self.leader_id = None
         self._persist_meta()
         self._reset_election_deadline()
-        last_term = self.log[-1].term if self.log else -1
         for peer in self.peer_ids:
             self._send(peer, {
                 "kind": "request_vote", "term": self.current_term,
-                "last_log_index": len(self.log) - 1,
-                "last_log_term": last_term,
+                "last_log_index": self.last_index(),
+                "last_log_term": self._term_at(self.last_index()),
             })
         self._maybe_win()
 
     def _on_request_vote(self, sender_id: str, msg: dict) -> None:
         grant = False
         if msg["term"] >= self.current_term and self.voted_for in (None, sender_id):
-            my_last_term = self.log[-1].term if self.log else -1
+            my_last_term = self._term_at(self.last_index())
             up_to_date = (
                 msg["last_log_term"] > my_last_term
                 or (
                     msg["last_log_term"] == my_last_term
-                    and msg["last_log_index"] >= len(self.log) - 1
+                    and msg["last_log_index"] >= self.last_index()
                 )
             )
             if up_to_date:
@@ -248,7 +350,7 @@ class RaftNode:
         if self.role == CANDIDATE and len(self._votes) >= quorum:
             self.role = LEADER
             self.leader_id = self.node_id
-            self.next_index = {p: len(self.log) for p in self.peer_ids}
+            self.next_index = {p: self.last_index() + 1 for p in self.peer_ids}
             self.match_index = {p: -1 for p in self.peer_ids}
             self._last_heartbeat = self._now
             for peer in self.peer_ids:
@@ -257,10 +359,22 @@ class RaftNode:
     # -- replication ---------------------------------------------------------
 
     def _send_append(self, peer: str) -> None:
-        ni = self.next_index.get(peer, len(self.log))
+        ni = self.next_index.get(peer, self.last_index() + 1)
+        if ni <= self.snap_index:
+            # the follower needs entries already folded into the snapshot
+            if self.snapshot_fn is not None:
+                self._send(peer, {
+                    "kind": "install_snapshot", "term": self.current_term,
+                    "snap_index": self.snap_index,
+                    "snap_term": self.snap_term,
+                    "data": self.snapshot_fn(),
+                })
+            return
         prev_index = ni - 1
-        prev_term = self.log[prev_index].term if prev_index >= 0 else -1
-        entries = [[e.term, e.command] for e in self.log[ni:]]
+        prev_term = self._term_at(prev_index)
+        entries = [
+            [e.term, e.command] for e in self.log[ni - self.snap_index - 1:]
+        ]
         self._send(peer, {
             "kind": "append", "term": self.current_term,
             "prev_index": prev_index, "prev_term": prev_term,
@@ -278,23 +392,30 @@ class RaftNode:
         self.leader_id = sender_id
         self._reset_election_deadline()
         prev_index = msg["prev_index"]
+        entries = list(msg["entries"])
+        if prev_index < self.snap_index:
+            # entries overlapping our snapshot are already applied: skip
+            # them and anchor at the snapshot boundary
+            skip = self.snap_index - prev_index
+            entries = entries[skip:]
+            prev_index = self.snap_index
         if prev_index >= 0 and (
-            prev_index >= len(self.log)
-            or self.log[prev_index].term != msg["prev_term"]
+            prev_index > self.last_index()
+            or self._term_at(prev_index) != msg["prev_term"]
         ):
             self._send(sender_id, {
                 "kind": "append_reply", "term": self.current_term,
                 "ok": False, "match_index": -1,
             })
             return
-        # Truncate conflicts, append new entries.
+        # Truncate conflicts, append new entries (logical indices).
         idx = prev_index + 1
         first_change: Optional[int] = None
         truncated = False
-        for term, command in msg["entries"]:
-            if idx < len(self.log):
-                if self.log[idx].term != term:
-                    del self.log[idx:]
+        for term, command in entries:
+            if idx <= self.last_index():
+                if self._term_at(idx) != term:
+                    del self.log[idx - self.snap_index - 1:]
                     self.log.append(LogEntry(term, command))
                     truncated = True
                     if first_change is None:
@@ -309,14 +430,14 @@ class RaftNode:
                 self._persist_log_truncate(first_change)
             self._persist_log_from(first_change)
         if msg["commit_index"] > self.commit_index:
-            self.commit_index = min(msg["commit_index"], len(self.log) - 1)
+            self.commit_index = min(msg["commit_index"], self.last_index())
             self._apply_committed()
         # match up to what THIS append covered — not our whole log, which may
         # carry an uncommitted tail from a deposed leader beyond the new
         # leader's log (overstating would crash the leader's next send).
         self._send(sender_id, {
             "kind": "append_reply", "term": self.current_term,
-            "ok": True, "match_index": prev_index + len(msg["entries"]),
+            "ok": True, "match_index": prev_index + len(entries),
         })
 
     def _on_append_reply(self, sender_id: str, msg: dict) -> None:
@@ -332,8 +453,8 @@ class RaftNode:
 
     def _advance_commit(self) -> None:
         quorum = (len(self.peer_ids) + 1) // 2 + 1
-        for n in range(len(self.log) - 1, self.commit_index, -1):
-            if self.log[n].term != self.current_term:
+        for n in range(self.last_index(), self.commit_index, -1):
+            if self._term_at(n) != self.current_term:
                 continue
             count = 1 + sum(
                 1 for p in self.peer_ids if self.match_index.get(p, -1) >= n
@@ -346,12 +467,13 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            entry = self.log[self.last_applied]
+            entry = self._entry(self.last_applied)
             result = self.apply_fn(entry.command)
             request_id = entry.command.get("request_id")
             fut = self._pending.pop(request_id, None) if request_id else None
             if fut is not None and not fut.done():
                 fut.set_result(result)
+        self._maybe_snapshot()
 
     def _fail_pending(self, exc: Exception) -> None:
         for fut in self._pending.values():

@@ -205,3 +205,129 @@ class TestRaftUniquenessProvider:
             time.sleep(0.01)
         t3.join(timeout=5)
         assert errs and errs[0].conflict.consumed
+
+
+class TestSnapshotting:
+    """Raft section-7 log compaction: applied prefixes fold into state-
+    machine snapshots; lagging followers receive InstallSnapshot
+    (reference: Copycat's log-compacting snapshottable
+    DistributedImmutableMap)."""
+
+    def _snap_cluster(self, n=3, threshold=5):
+        state = {i: {} for i in range(n)}
+
+        def make_apply(idx):
+            def apply(cmd):
+                state[idx].update(cmd["entries"])
+                return {"conflicts": {}}
+            return apply
+
+        def make_snapshot(idx):
+            def snap():
+                from corda_tpu.core.serialization.codec import serialize
+                return serialize(dict(state[idx]))
+            return snap
+
+        def make_restore(idx):
+            def restore(data):
+                from corda_tpu.core.serialization.codec import deserialize
+                state[idx].clear()
+                state[idx].update(deserialize(data))
+            return restore
+
+        c = Cluster(n, apply_fn=make_apply)
+        for i, (node_id, node) in enumerate(c.nodes.items()):
+            node.SNAPSHOT_THRESHOLD = threshold
+            node.snapshot_fn = make_snapshot(i)
+            node.restore_fn = make_restore(i)
+        return c, state
+
+    def test_log_truncates_after_threshold(self):
+        c, state = self._snap_cluster(threshold=5)
+        leader, _ = c.elect()
+        for i in range(12):
+            fut = leader.submit({"entries": {f"k{i}": f"v{i}"}})
+            c.pump()
+            assert fut.result(timeout=1) == {"conflicts": {}}
+        # the leader's log folded its applied prefix into snapshots
+        assert leader.snap_index >= 5
+        assert len(leader.log) < 12
+        # logical bookkeeping intact
+        assert leader.last_index() == 11
+        assert leader.commit_index == 11
+        # state machine saw everything exactly once
+        leader_idx = list(c.nodes).index(leader.node_id)
+        assert state[leader_idx] == {f"k{i}": f"v{i}" for i in range(12)}
+
+    def test_replication_continues_across_snapshots(self):
+        c, state = self._snap_cluster(threshold=4)
+        leader, _ = c.elect()
+        for i in range(10):
+            fut = leader.submit({"entries": {f"x{i}": "1"}})
+            c.pump()
+            fut.result(timeout=1)
+        # heartbeat so followers learn the final commit index
+        c.tick_all(leader._now + 4)
+        for idx, s in state.items():
+            assert len(s) == 10, f"replica {idx} diverged: {len(s)}"
+
+    def test_lagging_follower_installs_snapshot(self):
+        c, state = self._snap_cluster(n=3, threshold=3)
+        leader, _ = c.elect()
+        # partition one follower, commit enough to snapshot past its log
+        follower_id = next(iter(set(c.nodes) - {leader.node_id}))
+        c.partitioned.add(follower_id)
+        for i in range(8):
+            fut = leader.submit({"entries": {f"p{i}": "1"}})
+            c.pump()
+            fut.result(timeout=1)
+        assert leader.snap_index >= 3
+        # heal: the follower is behind the leader's snapshot boundary
+        c.partitioned.clear()
+        for _ in range(6):
+            c.tick_all(c.nodes[leader.node_id]._now + 4)
+        follower = c.nodes[follower_id]
+        follower_idx = list(c.nodes).index(follower_id)
+        assert follower.snap_index >= 3  # InstallSnapshot arrived
+        assert state[follower_idx] == state[list(c.nodes).index(leader.node_id)]
+
+    def test_snapshot_survives_restart(self):
+        from corda_tpu.core.serialization.codec import deserialize, serialize
+        from corda_tpu.node.database import NodeDatabase
+        from corda_tpu.node.raft import RaftNode
+
+        db = NodeDatabase(":memory:")
+        state = {}
+
+        def apply(cmd):
+            state.update(cmd["entries"])
+            return {}
+
+        node = RaftNode(
+            "solo", ["solo"], lambda d, p: None, apply, db=db, seed=1,
+            snapshot_fn=lambda: serialize(dict(state)),
+            restore_fn=lambda data: (state.clear(), state.update(deserialize(data)))[0],
+        )
+        node.SNAPSHOT_THRESHOLD = 3
+        node.tick(100)  # single-node cluster elects itself
+        assert node.is_leader
+        for i in range(7):
+            fut = node.submit({"entries": {f"s{i}": "1"}})
+            fut.result(timeout=1)
+        assert node.snap_index >= 3
+        # restart from the same db: snapshot restores + tail replays
+        state2 = {}
+
+        def apply2(cmd):
+            state2.update(cmd["entries"])
+            return {}
+
+        restored_from = {}
+        node2 = RaftNode(
+            "solo", ["solo"], lambda d, p: None, apply2, db=db, seed=1,
+            snapshot_fn=lambda: serialize(dict(state2)),
+            restore_fn=lambda data: restored_from.update(deserialize(data)),
+        )
+        assert node2.snap_index == node.snap_index
+        assert restored_from  # snapshot content restored
+        assert len(node2.log) == node.last_index() - node.snap_index
