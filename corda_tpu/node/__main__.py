@@ -30,6 +30,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="corda_tpu.node")
     ap.add_argument("config_dir", help="directory with node.conf")
     ap.add_argument("--jax-platform", dest="jax_platform")
+    ap.add_argument(
+        "--initial-registration", action="store_true",
+        help="register with the doorman named by node.conf's doorman_url, "
+             "install the returned certificate chain, then exit "
+             "(reference NodeStartup --initial-registration)",
+    )
     args = ap.parse_args(argv)
 
     from .config import load_config
@@ -38,6 +44,32 @@ def main(argv=None) -> int:
     if args.jax_platform:
         overrides["jax_platform"] = args.jax_platform
     cfg = load_config(args.config_dir, overrides)
+
+    if args.initial_registration:
+        import json
+
+        conf_path = os.path.join(args.config_dir, "node.conf")
+        raw = {}
+        if os.path.exists(conf_path):
+            with open(conf_path) as fh:
+                raw = json.load(fh)
+        doorman_url = raw.get("doorman_url")
+        if not doorman_url:
+            print("error: --initial-registration requires doorman_url in node.conf",
+                  flush=True)
+            return 2
+        from .registration import NetworkRegistrationHelper
+
+        helper = NetworkRegistrationHelper(
+            doorman_url, cfg.node.my_legal_name, cfg.certificates_dir
+        )
+        chain = helper.register()
+        print(
+            f"registered {cfg.node.my_legal_name}: chain of {len(chain)} "
+            f"certificates installed in {cfg.certificates_dir}",
+            flush=True,
+        )
+        return 0
 
     if cfg.jax_platform:
         os.environ.setdefault(

@@ -88,3 +88,40 @@ class TestRegistration:
             assert "compliance" in str(err["exc"])
         finally:
             doorman.stop()
+
+
+class TestNodeCLIRegistration:
+    def test_initial_registration_flag(self, tmp_path):
+        """`python -m corda_tpu.node DIR --initial-registration` registers
+        against the doorman named in node.conf and exits (reference
+        NodeStartup --initial-registration)."""
+        import json
+        import subprocess
+        import sys
+
+        doorman = DoormanServer()
+        try:
+            node_dir = tmp_path / "regnode"
+            node_dir.mkdir()
+            (node_dir / "node.conf").write_text(json.dumps({
+                "my_legal_name": "O=CliReg,L=London,C=GB",
+                "doorman_url": doorman.url,
+            }))
+            env = dict(os.environ)
+            import corda_tpu
+
+            repo = os.path.dirname(os.path.dirname(corda_tpu.__file__))
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            out = subprocess.run(
+                [sys.executable, "-m", "corda_tpu.node", str(node_dir),
+                 "--initial-registration"],
+                capture_output=True, text=True, timeout=120, env=env,
+            )
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert "chain of 3 certificates" in out.stdout
+            leaf = pki.read_cert(str(node_dir / "certificates"), "identity")
+            assert pki.verify_chain(
+                leaf.cert, [doorman.intermediate.cert], doorman.root.cert
+            )
+        finally:
+            doorman.stop()
