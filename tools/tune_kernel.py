@@ -16,10 +16,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_one(blk: int, chunk: int, timeout: float) -> dict:
+def run_one(blk: int, chunk: int, timeout: float, ecdsa_blk: int = 0) -> dict:
     env = dict(os.environ)
     env["CORDA_TPU_ED25519_BLK"] = str(blk)
     env["CORDA_TPU_PIPE_CHUNK"] = str(chunk)
+    if ecdsa_blk:
+        env["CORDA_TPU_ECDSA_BLK"] = str(ecdsa_blk)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     try:
         out = subprocess.run(
