@@ -162,3 +162,15 @@ class TestNotariseLatency:
         assert out["n_tx"] == 16
         assert 0 < out["p50_ms"] <= out["p95_ms"]
         assert out["notarisations_per_sec"] > 0
+
+
+class TestNotaryDemoClusterModes:
+    def test_raft_mode(self):
+        result = notary_demo.main(n_transactions=2, verbose=False, mode="raft")
+        assert result["notarised"] == 2
+        assert result["double_spend_rejected"] is True
+
+    def test_bft_mode(self):
+        result = notary_demo.main(n_transactions=2, verbose=False, mode="bft")
+        assert result["notarised"] == 2
+        assert result["double_spend_rejected"] is True
