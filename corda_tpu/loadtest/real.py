@@ -1,0 +1,134 @@
+"""Load test against REAL node processes (reference `tools/loadtest/` runs
+against an SSH-managed cluster of real nodes; here the cluster is a
+cordform-deployed local network of OS processes — the same
+generate/execute/gather shape at process-separation fidelity, where
+`loadtest/harness.py` covers the in-process MockNetwork tier).
+
+Run: python -m corda_tpu.loadtest.real [--pairs 50] [--parallelism 4]
+Prints one JSON line: issue+pay pairs/sec through a real notary over TCP.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import List
+
+from ..core.contracts import Amount
+from ..core.contracts.amount import Issued
+
+
+def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False) -> dict:
+    from ..testing.smoketesting import Factory
+    from ..tools.cordform import deploy_nodes
+
+    base = tempfile.mkdtemp(prefix="loadtest-real-")
+    spec = {
+        "nodes": [
+            {"name": "O=LoadNotary,L=Zurich,C=CH", "notary": "validating",
+             "network_map_service": True},
+            {"name": "O=LoadBankA,L=London,C=GB"},
+            {"name": "O=LoadBankB,L=Paris,C=FR"},
+        ]
+    }
+    resolved = deploy_nodes(spec, base)
+    factory = Factory(base)
+    nodes: List = []
+    try:
+        for conf in resolved:
+            nodes.append(factory.launch(conf["dir"]))
+        conn_a = nodes[1].connect()
+        conn_b = nodes[2].connect()
+        ops_a, ops_b = conn_a.proxy, conn_b.proxy
+        me = ops_a.node_info()
+        info_b = ops_b.node_info()
+        notary = ops_a.notary_identities()[0]
+        token = Issued(me.ref(1), "USD")
+
+        errors: List[str] = []
+        done = [0]
+        lock = threading.Lock()
+
+        def worker(count: int) -> None:
+            # each worker needs its own RPC connection (own reply queue)
+            conn = nodes[1].connect()
+            try:
+                for _ in range(count):
+                    try:
+                        fid = conn.proxy.start_flow_dynamic(
+                            "CashIssueFlow", Amount(100, "USD"), b"\x01",
+                            me, notary,
+                        )
+                        conn.proxy.flow_result(fid, 60)
+                        fid = conn.proxy.start_flow_dynamic(
+                            "CashPaymentFlow", Amount(100, token), info_b,
+                            notary,
+                        )
+                        conn.proxy.flow_result(fid, 60)
+                        with lock:
+                            done[0] += 1
+                    except Exception as exc:  # gather, don't abort the run
+                        with lock:
+                            errors.append(f"{type(exc).__name__}: {exc}")
+            finally:
+                conn.close()
+
+        per = [pairs // parallelism] * parallelism
+        for i in range(pairs % parallelism):
+            per[i] += 1
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(n,)) for n in per if n
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        # consistency gather (reference gatherRemoteState): B's vault holds
+        # every completed payment
+        deadline = time.monotonic() + 30
+        received = 0
+        while time.monotonic() < deadline:
+            received = len(ops_b.vault_query())
+            if received >= done[0]:
+                break
+            time.sleep(0.3)
+        result = {
+            "metric": "real-process-notarised-pairs/sec",
+            "pairs": pairs,
+            "completed": done[0],
+            "received_at_counterparty": received,
+            "errors": len(errors),
+            "wall_s": round(wall, 2),
+            "pairs_per_sec": round(done[0] / wall, 2) if wall else 0.0,
+            "parallelism": parallelism,
+        }
+        if verbose and errors:
+            result["first_error"] = errors[0]
+        conn_a.close()
+        conn_b.close()
+        return result
+    finally:
+        for n in nodes:
+            n.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.real")
+    ap.add_argument("--pairs", type=int, default=50)
+    ap.add_argument("--parallelism", type=int, default=4)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.pairs, args.parallelism, verbose=True)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

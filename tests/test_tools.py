@@ -218,3 +218,15 @@ class TestCordformDeploymentBoots:
         finally:
             for n in nodes:
                 n.close()
+
+
+@pytest.mark.slow
+class TestRealProcessLoadtest:
+    def test_small_burst_consistent(self):
+        from corda_tpu.loadtest.real import run
+
+        result = run(pairs=6, parallelism=2)
+        assert result["completed"] == 6
+        assert result["errors"] == 0
+        assert result["received_at_counterparty"] >= 6
+        assert result["pairs_per_sec"] > 0
