@@ -230,3 +230,22 @@ class TestRealProcessLoadtest:
         assert result["errors"] == 0
         assert result["received_at_counterparty"] >= 6
         assert result["pairs_per_sec"] > 0
+
+
+class TestExplorerAttachments:
+    def test_put_and_exists(self, tmp_path):
+        net = MockNetwork()
+        node = net.create_node("O=ExpAtt,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        out = io.StringIO()
+        ex = Explorer(ops, out=out)
+        f = tmp_path / "doc.bin"
+        f.write_bytes(b"attachment-payload")
+        ex.attachments("PUT", str(f))
+        text = out.getvalue()
+        assert "uploaded" in text
+        att_hex = text.split()[-1]
+        from corda_tpu.core.crypto.secure_hash import SecureHash
+
+        assert ops.attachment_exists(SecureHash(bytes.fromhex(att_hex)))
+        net.stop_nodes()
