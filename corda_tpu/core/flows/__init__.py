@@ -10,6 +10,7 @@ no stack serialization, no agent (SURVEY.md section 7 item 4).
 """
 from .api import (
     FlowException,
+    FlowKilledException,
     FlowLogic,
     ProgressTracker,
     Receive,
@@ -48,7 +49,7 @@ from .library import (
 )
 
 __all__ = [
-    "FlowException", "FlowLogic", "ProgressTracker",
+    "FlowException", "FlowKilledException", "FlowLogic", "ProgressTracker",
     "Receive", "Send", "SendAndReceive", "WaitForLedgerCommit",
     "flow_registry", "get_initiated_by", "initiated_by", "initiating_flow",
     "schedulable_flow", "startable_by_rpc",

@@ -49,6 +49,12 @@ class FlowException(Exception):
         _exception_registry[cls.__name__] = cls
 
 
+class FlowKilledException(FlowException):
+    """Raised into a flow (and its caller's future) when it is forcibly
+    terminated via killFlow, so callers can tell a kill from an ordinary
+    flow failure (reference `KilledFlowException`)."""
+
+
 def encode_flow_exception(exc: FlowException) -> str:
     return f"{type(exc).__name__}|{exc}"
 

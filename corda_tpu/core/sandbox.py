@@ -1,20 +1,44 @@
-"""Deterministic execution guard for untrusted contract code (reference
+"""Best-effort determinism guard for contract code (reference
 `experimental/sandbox/src/main/java/net/corda/sandbox/` — the JVM
 bytecode-rewriting `RuntimeCostAccounter` + `WhitelistClassLoader` become
 (a) a static code-object scan and (b) a sys.settrace cost meter; same two
 layers, Python-native mechanisms).
 
-Why it matters: attachment-delivered contract code (serialization/
-attachments_loader.py) executes inside every verifier; a hostile contract
-must not be able to spin forever, exhaust memory, or read
-non-deterministic inputs and split consensus.
+TRUST MODEL — READ THIS FIRST. These guards are DEFENSE-IN-DEPTH, not a
+security boundary: CPython offers no in-process containment, and code
+that passes `check_code` still runs with full interpreter privileges.
+The PRIMARY control is the same as the reference's: only load
+attachments from trusted stores (an operator-vetted attachment
+directory, or attachments whose uploader signatures you trust). The
+static scan exists to reject *accidental* non-determinism and the
+obvious hostile patterns early, and the meter to bound runaway loops —
+neither stops a determined attacker.
+
+Known residual bypasses (kept current; add here when found):
+  * C-level calls raise no trace events, so the meter cannot see work or
+    side effects done inside extension code;
+  * memory allocation is unmetered — one line event may allocate
+    unbounded memory;
+  * attribute names reached via strings that never appear in co_names
+    (e.g. computed through data) evade the static scan; `getattr` and
+    introspection dunders are forbidden, but exhaustively enumerating
+    every reflective path in CPython is not possible.
+Operators who must run genuinely untrusted code should do so in a
+separate OS process under rlimits/seccomp, not behind this module.
+
+Why it matters anyway: attachment-delivered contract code
+(serialization/attachments_loader.py) executes inside every verifier; a
+buggy-but-honest contract must not spin forever or read
+non-deterministic inputs and split consensus. That accidental class is
+what these layers reliably catch.
 
 Layers:
   * `check_code(fn_or_cls)` — static: walks code objects recursively and
     rejects references to forbidden builtins (`open`, `eval`, `exec`,
-    `__import__`, …) and forbidden module roots (`os`, `socket`, `random`,
-    `time`, `threading`, …) before anything runs (WhitelistClassLoader
-    analogue: reject at load time).
+    `getattr`, `__import__`, …), reflective attributes (`__globals__`,
+    `__subclasses__`, …) and forbidden module roots (`os`, `socket`,
+    `random`, `time`, `threading`, `gc`, `inspect`, …) before anything
+    runs (WhitelistClassLoader analogue: reject at load time).
   * `run_metered(fn, *args, budget=...)` — dynamic: executes under a trace
     that charges 1 cost unit per line event plus an allocation surcharge
     per call, and enforces a wall-clock ceiling (RuntimeCostAccounter
@@ -31,14 +55,33 @@ from typing import Any, Callable, FrozenSet, Iterable, Optional
 FORBIDDEN_BUILTINS: FrozenSet[str] = frozenset({
     "open", "eval", "exec", "compile", "__import__", "input", "breakpoint",
     "globals", "vars", "memoryview", "exit", "quit",
+    # reflective escapes: getattr("__globals__"-style walks defeat the
+    # name scan, so dynamic attribute access is rejected wholesale
+    "getattr", "setattr", "delattr",
 })
 
-#: module roots contract code must not touch (non-determinism or IO)
+#: attribute names that walk from any object to interpreter internals
+#: (the `().__class__.__base__.__subclasses__()` → `__init__.__globals__`
+#: escape and its relatives). co_names carries LOAD_ATTR names, so the
+#: static scan sees these even without an explicit getattr call.
+FORBIDDEN_ATTRIBUTES: FrozenSet[str] = frozenset({
+    "__subclasses__", "__globals__", "__builtins__", "__bases__",
+    "__base__", "__mro__", "mro", "__code__", "__closure__", "__func__",
+    "__self__", "__dict__", "__getattribute__", "__setattr__",
+    "__delattr__", "__reduce__", "__reduce_ex__", "__loader__", "__spec__",
+    "__subclasshook__", "__init_subclass__",
+})
+
+#: module roots contract code must not touch (non-determinism, IO, or
+#: reflection that reaches both — gc.get_objects / inspect walk to
+#: arbitrary live objects, operator.attrgetter is a string getattr)
 FORBIDDEN_MODULES: FrozenSet[str] = frozenset({
     "os", "sys", "io", "socket", "subprocess", "threading", "multiprocessing",
     "random", "secrets", "time", "datetime", "uuid", "pathlib", "shutil",
     "ctypes", "signal", "importlib", "pickle", "marshal", "urllib", "http",
     "posixpath", "ntpath", "genericpath",  # os.path implementation modules
+    "builtins", "gc", "inspect", "traceback", "weakref", "operator",
+    "code", "codeop", "pdb", "resource", "select", "asyncio", "socketserver",
 })
 
 
@@ -72,11 +115,33 @@ def _iter_code(code: types.CodeType) -> Iterable[types.CodeType]:
             yield from _iter_code(const)
 
 
+#: opcodes whose name operand can resolve to a module: imports and
+#: global/name loads (module references enter a function as globals or
+#: closure cells). LOAD_ATTR/LOAD_METHOD deliberately excluded — an
+#: honest contract reading `tx.code` or calling `rows.select()` must not
+#: trip the module blocklist.
+_MODULE_POSITION_OPS = frozenset(
+    {"IMPORT_NAME", "IMPORT_FROM", "LOAD_GLOBAL", "LOAD_NAME"}
+)
+
+
+def _module_position_names(code: types.CodeType) -> set:
+    import dis
+
+    names = set(code.co_freevars)  # closure cell may carry a module
+    for ins in dis.get_instructions(code):
+        if ins.opname in _MODULE_POSITION_OPS and isinstance(ins.argval, str):
+            names.add(ins.argval)
+    return names
+
+
 def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
     """Statically vet a function or class (e.g. a Contract subclass): every
     reachable code object must not name a forbidden builtin or import a
     forbidden module root. Raises SandboxViolation."""
-    forbidden = FORBIDDEN_BUILTINS | frozenset(extra_forbidden)
+    forbidden = (
+        FORBIDDEN_BUILTINS | FORBIDDEN_ATTRIBUTES | frozenset(extra_forbidden)
+    )
     codes = []
     if isinstance(obj, type):
         for attr in vars(obj).values():
@@ -92,8 +157,8 @@ def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
 
     for top in codes:
         for code in _iter_code(top):
-            # co_freevars too: a closure variable bound to a forbidden
-            # module reaches the code without appearing in co_names
+            # builtin/attribute blocklist: every referenced name counts
+            # (co_names carries LOAD_ATTR names, co_freevars closures)
             names = set(code.co_names) | set(code.co_freevars)
             bad = names & forbidden
             if bad:
@@ -101,7 +166,10 @@ def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
                     f"{code.co_qualname or code.co_name} references "
                     f"forbidden name(s) {sorted(bad)}"
                 )
-            for name in names:
+            # module blocklist: only names in module position (imports,
+            # global/name loads, closure cells) — plain attribute access
+            # like `tx.code` must not match module 'code'
+            for name in _module_position_names(code):
                 root = name.split(".", 1)[0]
                 if root in FORBIDDEN_MODULES:
                     raise SandboxViolation(
@@ -120,7 +188,11 @@ def run_metered(
 ):
     """Run fn under cost accounting; raises CostLimitExceeded when the
     budget is exhausted and SandboxViolation if execution enters a
-    forbidden module. Returns fn's result. Not reentrant per thread."""
+    forbidden module. Returns fn's result. Not reentrant per thread.
+
+    Best-effort only (see module docstring): C-level calls raise no
+    trace events and allocations are unmetered, so this bounds honest
+    runaway loops, not hostile code."""
     state = {"cost": 0, "deadline": time.monotonic() + budget.max_seconds}
 
     def tracer(frame, event, arg):
@@ -161,6 +233,9 @@ def metered_contract_verify(
     contract, ltx, budget: Optional[Budget] = None
 ) -> None:
     """Vet then run one contract's verify under the meter — the hook the
-    verifier uses for attachment-delivered (untrusted) contract classes."""
+    verifier uses for attachment-delivered contract classes.
+
+    Defense-in-depth, not containment: the attachment must still come
+    from a trusted store (module docstring, TRUST MODEL)."""
     check_code(type(contract))
     run_metered(contract.verify, ltx, budget=budget or DEFAULT_BUDGET)

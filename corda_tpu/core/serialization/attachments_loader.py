@@ -18,14 +18,18 @@ verification resolves by name.  Protections kept from the reference:
   * contract-name collisions with ALREADY-registered code are rejected by
     the registry itself (same name, different class).
 
-Trust model: unlike the reference (which gates trust on attachment
-signing and keeps its deterministic sandbox in `experimental/`), loading
-here is sandbox-integrated by default: newly registered contract classes
-are statically vetted (`core.sandbox.check_code`) at load time — the
-WhitelistClassLoader analogue — and tagged `__untrusted__`, which makes
-`LedgerTransaction.verify` run them under the dynamic cost meter
-(`core.sandbox.run_metered`). Pass vet=False to restore the reference's
-trust-the-store behavior.
+Trust model: ONLY LOAD ATTACHMENTS FROM TRUSTED STORES. That is the
+primary control, exactly as in the reference (which gates trust on
+attachment signing): CPython offers no in-process containment, so an
+attachment from an untrusted source runs with full process privileges
+regardless of vetting. The sandbox integration layered on top is
+defense-in-depth against *accidental* non-determinism: newly registered
+contract classes are statically vetted (`core.sandbox.check_code`) at
+load time — the WhitelistClassLoader analogue — and tagged
+`__untrusted__`, which makes `LedgerTransaction.verify` run them under
+the dynamic cost meter (`core.sandbox.run_metered`). See
+`core/sandbox.py`'s TRUST MODEL note for the residual bypasses. Pass
+vet=False to skip the best-effort layer entirely.
 """
 from __future__ import annotations
 

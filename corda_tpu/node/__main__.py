@@ -61,7 +61,10 @@ def main(argv=None) -> int:
         from .registration import NetworkRegistrationHelper
 
         helper = NetworkRegistrationHelper(
-            doorman_url, cfg.node.my_legal_name, cfg.certificates_dir
+            doorman_url, cfg.node.my_legal_name, cfg.certificates_dir,
+            # pin the network trust root when node.conf provides it
+            # (SHA-256 hex of the root cert's DER); TOFU + warning otherwise
+            expected_root=raw.get("doorman_root_fingerprint"),
         )
         chain = helper.register()
         print(

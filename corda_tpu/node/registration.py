@@ -33,12 +33,24 @@ class RegistrationError(Exception):
 
 class NetworkRegistrationHelper:
     """Generate CSR -> POST /certificate -> poll GET /certificate/{id}
-    until APPROVED -> write the chain into the node's certificate store."""
+    until APPROVED -> validate the returned chain -> write it into the
+    node's certificate store.
 
-    def __init__(self, doorman_url: str, legal_name: str, cert_dir: str):
+    Trust: pass `expected_root` (the pre-provisioned network trust root,
+    as a certificate or its SHA-256 DER fingerprint hex) so a MITM or
+    rogue doorman cannot hand the node an attacker-controlled identity —
+    the reference validates the doorman's response against the local
+    network truststore the same way (NetworkRegistrationHelper.kt).
+    Without it the first response is trusted (trust-on-first-use) and a
+    warning is logged. In production `doorman_url` should be HTTPS; the
+    chain validation here is what protects enrolment when it is not."""
+
+    def __init__(self, doorman_url: str, legal_name: str, cert_dir: str,
+                 expected_root=None):
         self.doorman_url = doorman_url.rstrip("/")
         self.legal_name = legal_name
         self.cert_dir = cert_dir
+        self.expected_root = expected_root
 
     def register(self, timeout: float = 60, poll_interval: float = 0.2):
         csr, key = pki.create_csr(self.legal_name)
@@ -65,6 +77,7 @@ class NetworkRegistrationHelper:
                     )
                     for pem_b64 in body["certificates"]
                 ]
+                self._validate(chain, csr)
                 self._install(chain, key)
                 return chain
             if body["status"] == "REJECTED":
@@ -74,16 +87,72 @@ class NetworkRegistrationHelper:
             time.sleep(poll_interval)
         raise RegistrationError(f"registration not approved in {timeout}s")
 
+    def _validate(self, chain, csr) -> None:
+        """Reject a chain that (a) does not fit the leaf/intermediate/root
+        alias scheme, (b) does not bind the CSR's key, or (c) does not
+        verify up to the expected trust root."""
+        if len(chain) != 3:
+            raise RegistrationError(
+                f"doorman returned {len(chain)} certificates; expected "
+                "exactly [identity, intermediate, root]"
+            )
+        leaf, intermediate, root = chain
+        leaf_spki = leaf.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        csr_spki = csr.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        if leaf_spki != csr_spki:
+            raise RegistrationError(
+                "returned identity certificate does not bind the key this "
+                "node generated for its CSR"
+            )
+        if not pki.verify_chain(leaf, [intermediate], root):
+            raise RegistrationError(
+                "returned certificate chain fails path validation"
+            )
+        if self.expected_root is None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "no expected_root configured: trusting the doorman's root "
+                "on first use — pin the network trust root in production"
+            )
+            return
+        root_der = root.public_bytes(serialization.Encoding.DER)
+        if isinstance(self.expected_root, str):
+            import hashlib
+
+            got = hashlib.sha256(root_der).hexdigest()
+            want = self.expected_root.lower().replace(":", "")
+            if got != want:
+                raise RegistrationError(
+                    f"doorman root fingerprint {got} does not match the "
+                    f"pinned trust root {want}"
+                )
+        else:
+            want_der = self.expected_root.public_bytes(
+                serialization.Encoding.DER
+            )
+            if root_der != want_der:
+                raise RegistrationError(
+                    "doorman root certificate does not match the pinned "
+                    "network trust root"
+                )
+
     def _install(self, chain, key) -> None:
         """Persist leaf + chain + key as the node's identity material
         (reference: keystore writes at the end of registration)."""
-        entries = {}
-        names = ["identity", "intermediate", "root"]
-        for name, cert in zip(names, chain):
-            entries[name] = pki.CertAndKey(
-                cert=cert, key=key if name == "identity" else None
-            )
-        pki.write_cert_store(self.cert_dir, **entries)
+        leaf, intermediate, root = chain  # length checked in _validate
+        pki.write_cert_store(
+            self.cert_dir,
+            identity=pki.CertAndKey(cert=leaf, key=key),
+            intermediate=pki.CertAndKey(cert=intermediate, key=None),
+            root=pki.CertAndKey(cert=root, key=None),
+        )
 
 
 # --- server side (a working doorman) -----------------------------------------

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.flows.api import (
     FlowException,
+    FlowKilledException,
     FlowLogic,
     Receive,
     RecordValue,
@@ -532,7 +533,7 @@ class StateMachineManager:
         fsm = self.flows.get(flow_id)
         if fsm is None or fsm.done:
             return False
-        fsm._fail(FlowException(f"flow {flow_id} killed via RPC"))
+        fsm._fail(FlowKilledException(f"flow {flow_id} killed via RPC"))
         return True
 
     def register_initiated_flow(self, initiator_cls, responder_cls) -> None:

@@ -39,10 +39,10 @@ from jax.experimental.pallas import tpu as pltpu
 from .field_secp import MontField
 # shared row-layout helpers (incl. _cat's Mosaic drop-zero-rows rule) and
 # the layout-agnostic curve table live with their original kernels
-from .ed25519_pallas import _cat, _const_col, _limbs, _zeros
+from .ed25519_pallas import _cat, _const_col, _limbs, _validated_blk, _zeros
 from .ecdsa_batch import _CURVES, _double
 
-BLK = int(os.environ.get("CORDA_TPU_ECDSA_BLK", "256"))
+BLK = _validated_blk("CORDA_TPU_ECDSA_BLK", 256)
 
 _MASK = np.uint32(0xFFFF)
 
@@ -228,6 +228,18 @@ def _add_general(F: _RowField, a_mont, X1, Y1, Z1, X2, Y2, Z2):
 
 # --- the verification program ------------------------------------------------
 
+def shamir_digit_row(u1_words, u2_words, t: int):
+    """Table index row for ladder step t (consumed MSB-digit-first as
+    t = 127 - i): (u1 2-bit digit) + 4*(u2 2-bit digit). u*_words are
+    (8, W) uint32 little-endian scalar words. Shared with
+    tests/test_field_secp_rows.py so the digit extraction has fast
+    default-on coverage."""
+    w, r = (2 * t) // 32, (2 * t) % 32
+    return (
+        (u1_words[w : w + 1] >> r) & 3
+    ) + 4 * ((u2_words[w : w + 1] >> r) & 3)
+
+
 def _verify_core(curve_name, width, qx, qy, u1_words, u2_words, r_cmp, ok_in,
                  write_table, read_table, write_idx, read_idx):
     """u1*G + u2*Q via a joint 2-bit Shamir ladder; returns (1, W) mask.
@@ -285,12 +297,7 @@ def _verify_core(curve_name, width, qx, qy, u1_words, u2_words, r_cmp, ok_in,
         write_table(e, jnp.concatenate([X, Y, Z], axis=0))
 
     for t in range(128):
-        w, r = (2 * t) // 32, (2 * t) % 32
-        write_idx(
-            t,
-            ((u1_words[w : w + 1] >> r) & 3)
-            + 4 * ((u2_words[w : w + 1] >> r) & 3),
-        )
+        write_idx(t, shamir_digit_row(u1_words, u2_words, t))
 
     def body(i, acc):
         t = 127 - i
@@ -360,6 +367,12 @@ def verify_kernel_pallas(curve_name: str, qx_t, qy_t, u1_t, u2_t, r_t, ok):
     point, standard for r), u1_t/u2_t (8, B), ok (1, B). B must be a
     multiple of BLK. Returns (1, B) uint32 pass/fail."""
     n = qx_t.shape[1]
+    if n % BLK != 0:
+        # flooring the grid would silently skip tail lanes (real sigs
+        # would come back unverified as zeros) — refuse instead
+        raise ValueError(
+            f"batch lane count {n} is not a multiple of BLK={BLK}"
+        )
     grid = n // BLK
 
     def spec(rows):

@@ -42,9 +42,22 @@ from jax.experimental.pallas import tpu as pltpu
 from ..core.crypto import ed25519_math
 from .field25519 import P_INT, D_INT, SQRT_M1_INT
 
+def _validated_blk(env_name: str, default: int) -> int:
+    """Block-size env knobs must be powers of two (so bucketed batch pads
+    are always BLK-divisible) and lane-dim multiples of 128 (Mosaic tile
+    constraint). An arbitrary int like 384 would floor the kernel grid
+    and silently skip tail lanes — reject at import instead."""
+    value = int(os.environ.get(env_name, str(default)))
+    if value < 128 or value & (value - 1) != 0:
+        raise ValueError(
+            f"{env_name}={value}: must be a power of two >= 128"
+        )
+    return value
+
+
 # signatures per grid step (lane-dim multiple of 128); the env knob lets
 # tools/tune_kernel.py sweep block sizes on real hardware without edits
-BLK = int(os.environ.get("CORDA_TPU_ED25519_BLK", "512"))
+BLK = _validated_blk("CORDA_TPU_ED25519_BLK", 512)
 
 _MASK = np.uint32(0xFFFF)
 
@@ -479,6 +492,11 @@ def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok):
     s_ok (1, B) uint32. B must be a multiple of BLK. Returns (1, B) uint32
     pass/fail."""
     n = y_a_t.shape[1]
+    if n % BLK != 0:
+        # flooring the grid would silently skip tail lanes — refuse
+        raise ValueError(
+            f"batch lane count {n} is not a multiple of BLK={BLK}"
+        )
     grid = n // BLK
 
     def spec(rows):

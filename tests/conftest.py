@@ -32,3 +32,29 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--heavy-compile",
+        action="store_true",
+        default=False,
+        help="run the XLA-compile-dominated kernel differential tests "
+        "(several minutes each on the CPU backend, even warm — the cost "
+        "is tracing + executable deserialization, which the persistent "
+        "compile cache cannot remove)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--heavy-compile"):
+        return
+    skip = pytest.mark.skip(
+        reason="needs --heavy-compile; fast component coverage of the same "
+        "math runs by default (tests/test_field_secp_rows.py)"
+    )
+    for item in items:
+        if "heavy_compile" in item.keywords:
+            item.add_marker(skip)

@@ -132,7 +132,10 @@ class TestKillFlow:
         assert self.ops.kill_flow("nope") is False
 
     def test_kill_live_flow(self):
-        from corda_tpu.core.flows.api import FlowException, initiating_flow
+        from corda_tpu.core.flows.api import (
+            FlowKilledException,
+            initiating_flow,
+        )
 
         @initiating_flow
         class StuckFlow(FlowLogic):
@@ -153,9 +156,10 @@ class TestKillFlow:
         assert fsm.done
         try:
             handle.result.result(timeout=1)
-        except FlowException as exc:
+        except FlowKilledException as exc:
+            # a kill is distinguishable from an ordinary flow failure
             assert "killed" in str(exc)
         else:
-            raise AssertionError("expected FlowException")
+            raise AssertionError("expected FlowKilledException")
         # checkpoint dropped: nothing to restore
         assert self.ops.kill_flow(handle.flow_id) is False

@@ -14,9 +14,17 @@ from corda_tpu.core.crypto import (
 from corda_tpu.core.crypto.secp_math import SECP256K1, der_encode_sig, ecdsa_sign
 from corda_tpu.ops import ecdsa_batch
 
+# secp256k1 runs by default: its XLA-kernel compile is shared by every
+# other default test in this file, so the marginal cost is one compile.
+# secp256r1's separate multi-minute compile is opt-in (--heavy-compile);
+# its curve constants keep fast default coverage via the component
+# differentials in tests/test_field_secp_rows.py (the ladder/point code
+# between the curves is identical — only constants differ).
 CURVES = [
     (ECDSA_SECP256K1_SHA256, "secp256k1"),
-    (ECDSA_SECP256R1_SHA256, "secp256r1"),
+    pytest.param(
+        ECDSA_SECP256R1_SHA256, "secp256r1", marks=pytest.mark.heavy_compile
+    ),
 ]
 
 
@@ -186,6 +194,7 @@ class TestPallasCore:
     accessors must agree with the host oracle (same pattern as
     tests/test_ops_ed25519.py TestPallasCore)."""
 
+    @pytest.mark.heavy_compile
     @pytest.mark.parametrize("curve_name", ["secp256k1", "secp256r1"])
     def test_verify_core_off_tpu(self, curve_name):
         import jax.numpy as jnp

@@ -90,6 +90,102 @@ class TestRegistration:
             doorman.stop()
 
 
+class TestChainValidation:
+    """A MITM/rogue doorman must not be able to install an arbitrary
+    identity (ADVICE round 2: pin + verify the returned chain)."""
+
+    def test_pinned_root_accepts_genuine_doorman(self, tmp_path):
+        doorman = DoormanServer()
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=Pinned,L=London,C=GB", str(tmp_path),
+                expected_root=doorman.root.cert,
+            )
+            assert len(helper.register(timeout=20)) == 3
+        finally:
+            doorman.stop()
+
+    def test_pinned_fingerprint_accepts_genuine_doorman(self, tmp_path):
+        import hashlib
+
+        from cryptography.hazmat.primitives import serialization
+
+        doorman = DoormanServer()
+        try:
+            fp = hashlib.sha256(
+                doorman.root.cert.public_bytes(serialization.Encoding.DER)
+            ).hexdigest()
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=PinnedFp,L=London,C=GB", str(tmp_path),
+                expected_root=fp,
+            )
+            assert len(helper.register(timeout=20)) == 3
+        finally:
+            doorman.stop()
+
+    def test_pinned_root_rejects_rogue_doorman(self, tmp_path):
+        rogue = DoormanServer()  # its own self-signed root
+        expected = pki.create_self_signed_ca("Real Network Root")
+        try:
+            helper = NetworkRegistrationHelper(
+                rogue.url, "O=Victim,L=London,C=GB", str(tmp_path),
+                expected_root=expected.cert,
+            )
+            with pytest.raises(RegistrationError, match="trust root"):
+                helper.register(timeout=20)
+            assert not os.path.exists(tmp_path / "identity.cert.pem")
+        finally:
+            rogue.stop()
+
+    def test_wrong_leaf_key_rejected(self, tmp_path, monkeypatch):
+        """A doorman that re-keys the identity (returns a leaf for a key
+        the node never generated) must be rejected."""
+        doorman = DoormanServer()
+
+        real_approve = doorman.approve
+
+        def approve_with_other_key(request_id):
+            other_csr, _ = pki.create_csr("O=Victim,L=London,C=GB")
+            with doorman._lock:
+                doorman._requests[request_id]["csr"] = other_csr
+            real_approve(request_id)
+
+        doorman.approve = approve_with_other_key
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=Victim,L=London,C=GB", str(tmp_path),
+                expected_root=doorman.root.cert,
+            )
+            with pytest.raises(RegistrationError, match="CSR"):
+                helper.register(timeout=20)
+        finally:
+            doorman.stop()
+
+    def test_overlong_chain_rejected(self, tmp_path):
+        """4+ certificates must error, not silently truncate (the old
+        zip() dropped extras)."""
+        doorman = DoormanServer()
+
+        real_approve = doorman.approve
+
+        def approve_padded(request_id):
+            real_approve(request_id)
+            with doorman._lock:
+                entry = doorman._requests[request_id]
+                entry["certs"] = entry["certs"] + [doorman.root.cert]
+
+        doorman.approve = approve_padded
+        try:
+            helper = NetworkRegistrationHelper(
+                doorman.url, "O=Victim,L=London,C=GB", str(tmp_path),
+                expected_root=doorman.root.cert,
+            )
+            with pytest.raises(RegistrationError, match="expected exactly"):
+                helper.register(timeout=20)
+        finally:
+            doorman.stop()
+
+
 class TestNodeCLIRegistration:
     def test_initial_registration_flag(self, tmp_path):
         """`python -m corda_tpu.node DIR --initial-registration` registers

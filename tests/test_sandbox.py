@@ -67,6 +67,63 @@ class TestStaticLayer:
 
         check_code(Cash)
 
+    def test_subclasses_globals_walk_rejected(self):
+        """The classic object-graph escape (ADVICE round 2):
+        ().__class__.__base__.__subclasses__() reaches _wrap_close, whose
+        __init__.__globals__ is the os module's namespace. Every hop is a
+        LOAD_ATTR, so the static scan must reject it."""
+
+        def evil(tx):
+            for cls in ().__class__.__base__.__subclasses__():
+                if cls.__name__ == "_wrap_close":
+                    return cls.__init__.__globals__["system"]("id")
+
+        with pytest.raises(SandboxViolation):
+            check_code(evil)
+
+    def test_module_names_in_attribute_position_allowed(self):
+        """`tx.code` / `rows.select()` are plain attribute accesses — the
+        module blocklist must only match names in import/global position
+        (code-review round 3 false-positive fix)."""
+
+        def honest(tx):
+            if tx.code == "USD":
+                return tx.rows.select(1)
+            return None
+
+        check_code(honest)
+
+    def test_getattr_rejected(self):
+        def evil(tx):
+            return getattr(tx, "__glo" + "bals__")
+
+        with pytest.raises(SandboxViolation, match="getattr"):
+            check_code(evil)
+
+    def test_operator_attrgetter_rejected(self):
+        import operator
+
+        def evil(tx):
+            return operator.attrgetter("__globals__")(tx.verify)
+
+        with pytest.raises(SandboxViolation):
+            check_code(evil)
+
+    def test_gc_and_inspect_rejected(self):
+        import gc
+        import inspect
+
+        def evil_gc(tx):
+            return gc.get_objects()
+
+        def evil_inspect(tx):
+            return inspect.stack()
+
+        with pytest.raises(SandboxViolation):
+            check_code(evil_gc)
+        with pytest.raises(SandboxViolation):
+            check_code(evil_inspect)
+
 
 class TestDynamicLayer:
     def test_normal_execution_returns(self):
