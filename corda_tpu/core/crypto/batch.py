@@ -28,9 +28,10 @@ USE_DEVICE_KERNELS = True
 MIN_DEVICE_BATCH = 32
 
 # Device-mesh routing (SURVEY §2.10 axis 2: shard the batch across chips).
-# When a mesh is configured and an ed25519 bucket reaches MESH_MIN_BATCH,
-# verification shards across the mesh via parallel.mesh instead of the
-# single-device kernel. Opt-in: the verifier worker / node config calls
+# When a mesh is configured and a scheme bucket (ed25519 or either ECDSA
+# curve) reaches MESH_MIN_BATCH, verification shards across the mesh via
+# parallel.mesh's per-scheme kernel table instead of the single-device
+# kernel. Opt-in: the verifier worker / node config calls
 # configure_mesh() (see corda_tpu.verifier.__main__ --mesh-devices).
 _MESH = None
 _DEFAULT_MESH_MIN_BATCH = 2048
@@ -91,13 +92,18 @@ def verify_batch(
         pubs = [items[i][0].encoded for i in idx]
         sigs = [items[i][1] for i in idx]
         msgs = [items[i][2] for i in idx]
-        if name == EDDSA_ED25519_SHA512.scheme_code_name:
-            if _MESH is not None and len(idx) >= MESH_MIN_BATCH:
-                from ...parallel.mesh import shard_verify_ed25519
+        # mesh routing applies to every scheme with a device kernel —
+        # uniform scale-out, like the reference's competing consumers
+        # (VerifierTests.kt:54-71); below the threshold the single-device
+        # kernels keep dispatch overhead down
+        is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
+        if _MESH is not None and len(idx) >= MESH_MIN_BATCH:
+            from ...parallel.mesh import shard_verify
 
-                mask = shard_verify_ed25519(_MESH, pubs, sigs, msgs)
-            else:
-                mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
+            scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
+            mask = shard_verify(_MESH, scheme_kind, pubs, sigs, msgs)
+        elif is_ed:
+            mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
         else:
             mask = ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
         for j, i in enumerate(idx):

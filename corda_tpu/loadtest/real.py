@@ -20,7 +20,32 @@ from ..core.contracts import Amount
 from ..core.contracts.amount import Issued
 
 
-def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False) -> dict:
+def _hot_timers(metrics: dict, top: int = 12) -> dict:
+    """The busiest P2P.Handle.* / RPC.* timers from a node_metrics
+    snapshot: where the node's wall-clock actually goes (total =
+    count x mean), for the kernel->system chasm hunt."""
+    rows = []
+    for name, snap in metrics.items():
+        if snap.get("type") != "timer" or "count" not in snap:
+            continue
+        # exact lifetime sum (Timer.total); windowed count x mean would
+        # misrank timers whose per-event cost drifted
+        total = snap.get("total", snap["count"] * snap.get("mean", 0.0))
+        rows.append((total, name, snap))
+    rows.sort(reverse=True)
+    return {
+        name: {
+            "count": snap["count"],
+            "mean_ms": round(snap.get("mean", 0.0) * 1e3, 2),
+            "p95_ms": round(snap.get("p95", 0.0) * 1e3, 2),
+            "total_s": round(total, 2),
+        }
+        for total, name, snap in rows[:top]
+    }
+
+
+def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
+        profile: bool = False) -> dict:
     from ..testing.smoketesting import Factory
     from ..tools.cordform import deploy_nodes
 
@@ -109,6 +134,15 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False) -> dict:
         }
         if verbose and errors:
             result["first_error"] = errors[0]
+        if profile:
+            conn_n = nodes[0].connect()
+            try:
+                result["profile"] = {
+                    "bank_a": _hot_timers(ops_a.node_metrics()),
+                    "notary": _hot_timers(conn_n.proxy.node_metrics()),
+                }
+            finally:
+                conn_n.close()
         conn_a.close()
         conn_b.close()
         return result
@@ -123,8 +157,15 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.real")
     ap.add_argument("--pairs", type=int, default=50)
     ap.add_argument("--parallelism", type=int, default=4)
+    ap.add_argument(
+        "--profile", action="store_true",
+        help="attach the busiest per-topic P2P / RPC timers from bank A "
+        "and the notary to the result",
+    )
     args = ap.parse_args(argv)
-    print(json.dumps(run(args.pairs, args.parallelism, verbose=True)))
+    print(json.dumps(run(
+        args.pairs, args.parallelism, verbose=True, profile=args.profile,
+    )))
     return 0
 
 

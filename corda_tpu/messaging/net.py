@@ -53,6 +53,7 @@ OP_RECEIVE = 7
 OP_ACK = 8
 OP_CLOSE = 9
 OP_QUEUE_NAMES = 10
+OP_SEND_MANY = 11
 
 # Reply codes (server -> client).
 RE_OK = 0x80
@@ -167,6 +168,21 @@ class _ClientHandler(socketserver.BaseRequestHandler):
             payload, _ = _unpack_bytes(body, pos)
             mid = broker.send(name, payload, _decode_headers(hdr_blob))
             return bytes([RE_OK]) + _pack_str(mid)
+        if op == OP_SEND_MANY:
+            # One round trip for a whole batch: the store-and-forward
+            # bridge's throughput is bounded by round trips per message
+            # (~2-4 ms each under load, profiled round 3), so it drains
+            # its queue into one of these frames.
+            (count,) = struct.unpack_from(">I", body, 1)
+            pos = 5
+            items = []
+            for _ in range(count):
+                name, pos = _unpack_str(body, pos)
+                hdr_blob, pos = _unpack_bytes(body, pos)
+                payload, pos = _unpack_bytes(body, pos)
+                items.append((name, payload, _decode_headers(hdr_blob)))
+            broker.send_many(items)  # one lock acquisition, all-or-nothing
+            return bytes([RE_OK]) + struct.pack(">I", count)
         if op == OP_QUEUE_EXISTS:
             name, _ = _unpack_str(body, 1)
             return bytes([RE_OK, 1 if broker.queue_exists(name) else 0])
@@ -417,6 +433,20 @@ class RemoteBroker:
         )
         mid, _ = _unpack_str(reply, 1)
         return mid
+
+    def send_many(self, items) -> int:
+        """Send [(queue_name, payload, headers), ...] in ONE round trip.
+        At-least-once like send: a connection drop after the server
+        applied part of the batch and before the reply means the caller
+        retries the whole batch (receiver-side dedup absorbs replays,
+        exactly as with a lost single-send reply)."""
+        body = bytearray(bytes([OP_SEND_MANY]) + struct.pack(">I", len(items)))
+        for queue_name, payload, headers in items:
+            body += _pack_str(queue_name)
+            body += _pack_bytes(_encode_headers(dict(headers or {})))
+            body += _pack_bytes(payload)
+        reply = self._control.request(bytes(body))
+        return struct.unpack_from(">I", reply, 1)[0]
 
     def create_consumer(self, queue_name: str) -> RemoteConsumer:
         c = RemoteConsumer(self, queue_name)

@@ -80,6 +80,9 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         verifier_type=cfg["verifier_type"],
         notary_type=cfg["notary_type"],
         identity_entropy=cfg["identity_entropy"],
+        # production processes take the incremental-checkpoint fast path
+        # unless node.conf opts back into per-step validation
+        dev_checkpoint_check=bool(cfg.get("dev_checkpoint_check", False)),
     )
     return FullNodeConfiguration(
         node=node_cfg,

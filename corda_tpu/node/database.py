@@ -77,12 +77,36 @@ class _Tx:
 
 
 class CheckpointStorage:
-    """flow_id -> checkpoint blob (replay state, not a serialized stack)."""
+    """flow_id -> checkpoint (replay state, not a serialized stack).
+
+    Two write paths with one read contract:
+      * `put(flow_id, blob)` — a full serialized checkpoint dict;
+      * `put_incremental(...)` — the hot path: the flow header (identity,
+        ctor args) is written once, io-log entries append, and only the
+        small session-counter blob rewrites per step. Re-serializing the
+        entire checkpoint on EVERY suspension was O(steps^2) per flow and
+        one of the biggest CPU items in the round-3 system profile.
+    `all_checkpoints()` returns full blobs for both paths (incremental
+    rows are assembled at read time — restores are rare, steps are not).
+    """
 
     def __init__(self, db: NodeDatabase):
         self.db = db
         db.execute(
             "CREATE TABLE IF NOT EXISTS checkpoints "
+            "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
+        )
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS cp_header "
+            "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
+        )
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS cp_io "
+            "(flow_id TEXT NOT NULL, pos INTEGER NOT NULL, blob BLOB NOT NULL,"
+            " PRIMARY KEY (flow_id, pos))"
+        )
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS cp_sessions "
             "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
         )
 
@@ -93,17 +117,87 @@ class CheckpointStorage:
             (flow_id, blob),
         )
 
+    def put_incremental(
+        self,
+        flow_id: str,
+        header_blob: Optional[bytes],
+        new_io: List[Tuple[int, bytes]],
+        sessions_blob: bytes,
+    ) -> None:
+        """One atomic step-checkpoint: optional header upsert + appended
+        io entries + the session-state blob. Writing the header also
+        deletes any legacy full-blob row — the incremental rows are now
+        authoritative (all_checkpoints would otherwise prefer the stale
+        legacy blob forever)."""
+        with self.db.transaction() as tx:
+            if header_blob is not None:
+                tx.execute(
+                    "INSERT INTO cp_header(flow_id, blob) VALUES(?, ?) "
+                    "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
+                    (flow_id, header_blob),
+                )
+                tx.execute(
+                    "DELETE FROM checkpoints WHERE flow_id = ?", (flow_id,)
+                )
+            for pos, blob in new_io:
+                tx.execute(
+                    "INSERT OR REPLACE INTO cp_io(flow_id, pos, blob)"
+                    " VALUES(?, ?, ?)",
+                    (flow_id, pos, blob),
+                )
+            tx.execute(
+                "INSERT INTO cp_sessions(flow_id, blob) VALUES(?, ?) "
+                "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
+                (flow_id, sessions_blob),
+            )
+
     def remove(self, flow_id: str) -> None:
-        self.db.execute("DELETE FROM checkpoints WHERE flow_id = ?", (flow_id,))
+        with self.db.transaction() as tx:
+            for table in ("checkpoints", "cp_header", "cp_io", "cp_sessions"):
+                tx.execute(
+                    f"DELETE FROM {table} WHERE flow_id = ?", (flow_id,)
+                )
+
+    def _assemble(self, flow_id: str, header_blob: bytes) -> bytes:
+        state = deserialize(header_blob)
+        state["io_log"] = [
+            row[0]
+            for row in self.db.query(
+                "SELECT blob FROM cp_io WHERE flow_id = ? ORDER BY pos",
+                (flow_id,),
+            )
+        ]
+        rows = self.db.query(
+            "SELECT blob FROM cp_sessions WHERE flow_id = ?", (flow_id,)
+        )
+        state.update(
+            deserialize(rows[0][0])
+            if rows
+            else {"sessions": [], "session_keys": {}, "session_owner_flows": {}}
+        )
+        return serialize(state)
 
     def all_checkpoints(self) -> List[Tuple[str, bytes]]:
-        return [
+        out = [
             (row[0], row[1])
             for row in self.db.query("SELECT flow_id, blob FROM checkpoints")
         ]
+        legacy = {flow_id for flow_id, _ in out}
+        for flow_id, blob in self.db.query(
+            "SELECT flow_id, blob FROM cp_header"
+        ):
+            if flow_id not in legacy:
+                out.append((flow_id, self._assemble(flow_id, blob)))
+        return out
 
     def count(self) -> int:
-        return self.db.query("SELECT COUNT(*) FROM checkpoints")[0][0]
+        return (
+            self.db.query("SELECT COUNT(*) FROM checkpoints")[0][0]
+            + self.db.query(
+                "SELECT COUNT(*) FROM cp_header WHERE flow_id NOT IN"
+                " (SELECT flow_id FROM checkpoints)"
+            )[0][0]
+        )
 
 
 class TransactionStorage:

@@ -34,6 +34,11 @@ class NodeConfiguration:
     # entropy for the deterministic dev identity key (None -> random)
     identity_entropy: Optional[int] = None
     advertised_services: List[str] = field(default_factory=list)
+    # validate every checkpoint at write time (full re-deserialize per
+    # step — O(steps^2) per flow): on for tests/MockNetwork, off by
+    # default in the standalone production process (node.conf
+    # "dev_checkpoint_check": true re-enables)
+    dev_checkpoint_check: bool = True
 
 
 class AbstractNode:
@@ -59,9 +64,13 @@ class AbstractNode:
             self.info, self.database, verifier, self._identity_key, clock=clock
         )
         self.smm = StateMachineManager(
-            self.services, self.network, self.checkpoint_storage, self.info
+            self.services, self.network, self.checkpoint_storage, self.info,
+            dev_checkpoint_check=config.dev_checkpoint_check,
         )
         self.services._smm = self.smm
+        if hasattr(self.network, "metrics"):
+            # per-topic P2P handler timers land in the node's registry
+            self.network.metrics = self.smm.metrics
         from .scheduler import SchedulerService
 
         self.scheduler = SchedulerService(self.database, self.services, self.smm)

@@ -306,7 +306,66 @@ class VaultService:
             "CREATE INDEX IF NOT EXISTS vault_participants_key"
             " ON vault_participants(key_hex)"
         )
+        # Per-contract queryable columns, one generic EAV table instead of
+        # the reference's per-schema ORM DDL (VaultSchema/CashSchemaV1 +
+        # HibernateQueryCriteriaParser): attributes are extracted at
+        # record time (_state_attributes) and criteria compile to EXISTS
+        # subqueries (vault_query Linear/FungibleAsset/CustomAttribute).
+        # value_num has NUMERIC affinity: integer quantities stay exact
+        # 64-bit ints (a REAL column would round above 2^53 — token
+        # quantities are BIGINT-scale in the reference's CashSchemaV1)
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_attributes ("
+            " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
+            " name TEXT NOT NULL, value_text TEXT, value_num NUMERIC,"
+            " PRIMARY KEY (tx_id, output_index, name))"
+        )
+        db.execute(
+            "CREATE INDEX IF NOT EXISTS vault_attributes_text"
+            " ON vault_attributes(name, value_text)"
+        )
+        db.execute(
+            "CREATE INDEX IF NOT EXISTS vault_attributes_num"
+            " ON vault_attributes(name, value_num)"
+        )
         self._observers: List[Callable] = []
+
+    @staticmethod
+    def _state_attributes(data) -> dict:
+        """Queryable attributes of a contract state (CashSchemaV1 /
+        VaultLinearStates analogue, derived instead of declared):
+
+          * LinearState:     linear_id, external_id
+          * FungibleAsset:   quantity (numeric), issuer_name, issuer_ref,
+                             product
+          * OwnableState:    owner_key
+          * custom schemas:  a `vault_attributes()` method on the state
+                             returning {name: str|int|float} is merged in
+                             (per-contract mapped-schema analogue).
+        """
+        attrs: dict = {}
+        linear_id = getattr(data, "linear_id", None)
+        if linear_id is not None:
+            attrs["linear_id"] = str(linear_id)
+            if getattr(linear_id, "external_id", None):
+                attrs["external_id"] = linear_id.external_id
+        amount = getattr(data, "amount", None)
+        token = getattr(amount, "token", None)
+        if amount is not None and hasattr(amount, "quantity"):
+            attrs["quantity"] = amount.quantity
+            issuer = getattr(token, "issuer", None)
+            if issuer is not None:
+                attrs["issuer_name"] = issuer.party.name
+                attrs["issuer_ref"] = issuer.reference.hex()
+                attrs["product"] = str(getattr(token, "product", ""))
+        owner = getattr(data, "owner", None)
+        owner_key = getattr(owner, "owning_key", None)
+        if owner_key is not None:
+            attrs["owner_key"] = owner_key.encoded.hex()
+        custom = getattr(data, "vault_attributes", None)
+        if callable(custom):
+            attrs.update(custom())
+        return attrs
 
     # -- updates from committed transactions --------------------------------
 
@@ -355,6 +414,20 @@ class VaultService:
                                 "(tx_id, output_index, key_hex) VALUES(?,?,?)",
                                 (ref.txhash.bytes, ref.index, key.encoded.hex()),
                             )
+                    for name, value in self._state_attributes(ts.data).items():
+                        is_num = isinstance(value, (int, float)) and not (
+                            isinstance(value, bool)
+                        )
+                        self.db.execute(
+                            "INSERT OR IGNORE INTO vault_attributes"
+                            "(tx_id, output_index, name, value_text, value_num)"
+                            " VALUES(?,?,?,?,?)",
+                            (
+                                ref.txhash.bytes, ref.index, name,
+                                None if is_num else str(value),
+                                value if is_num else None,
+                            ),
+                        )
                     produced.append(StateAndRef(ts, ref))
         if produced or consumed:
             for obs in list(self._observers):

@@ -113,6 +113,13 @@ class FlowStateMachine:
         # sub_flow instance ordinals: reset at construction so replay hands
         # out the same sequence (sub_flow calls re-execute in order).
         self._subflow_counter = 0
+        # incremental-checkpoint bookkeeping. Starts at zero even for
+        # restored flows: the first incremental write backfills header +
+        # every io entry and supersedes any legacy full-blob row, so a
+        # flow that checkpointed under dev mode (or an older build) can
+        # never resurrect stale state after a mode flip.
+        self._cp_header_written = False
+        self._cp_io_written = 0
 
     def next_subflow_ordinal(self) -> int:
         self._subflow_counter += 1
@@ -422,25 +429,61 @@ class FlowStateMachine:
 
     # -- checkpointing ------------------------------------------------------
 
+    def _sessions_state(self) -> dict:
+        return {
+            "sessions": [s.to_dict() for s in self.sessions.values()],
+            "session_keys": dict(self.session_keys),
+            "session_owner_flows": dict(self.session_owner_flows),
+        }
+
     def _checkpoint(self) -> None:
-        blob = serialize(
-            {
-                "flow_id": self.flow_id,
-                "flow_name": self.flow.flow_name(),
-                "args": list(self.args),
-                "kwargs": dict(self.kwargs),
-                "is_responder": self.is_responder,
-                "io_log": list(self.io_log),
-                "sessions": [s.to_dict() for s in self.sessions.values()],
-                "session_keys": dict(self.session_keys),
-                "session_owner_flows": dict(self.session_owner_flows),
-            }
-        )
-        self.smm.checkpoint_storage.put(self.flow_id, blob)
+        storage = self.smm.checkpoint_storage
+        if self.smm.dev_checkpoint_check or not hasattr(
+            storage, "put_incremental"
+        ):
+            # dev mode re-validates the FULL blob each write, so build it;
+            # re-serializing everything per step is O(steps^2) — fine for
+            # tests, disabled on the production throughput path
+            blob = serialize(
+                {
+                    "flow_id": self.flow_id,
+                    "flow_name": self.flow.flow_name(),
+                    "args": list(self.args),
+                    "kwargs": dict(self.kwargs),
+                    "is_responder": self.is_responder,
+                    "io_log": list(self.io_log),
+                    **self._sessions_state(),
+                }
+            )
+            storage.put(self.flow_id, blob)
+            if self.smm.dev_checkpoint_check:
+                self.smm._check_checkpoint_restorable(self.flow_id, blob)
+        else:
+            header = None
+            if not self._cp_header_written:
+                header = serialize(
+                    {
+                        "flow_id": self.flow_id,
+                        "flow_name": self.flow.flow_name(),
+                        "args": list(self.args),
+                        "kwargs": dict(self.kwargs),
+                        "is_responder": self.is_responder,
+                    }
+                )
+            new_io = [
+                (i, self.io_log[i])
+                for i in range(self._cp_io_written, len(self.io_log))
+            ]
+            storage.put_incremental(
+                self.flow_id, header, new_io, serialize(self._sessions_state())
+            )
+            # bookkeeping only advances on SUCCESS: a failed write must
+            # leave the header/io entries queued for the next checkpoint
+            # (the old full-blob path self-healed the same way)
+            self._cp_header_written = True
+            self._cp_io_written = len(self.io_log)
         self.smm.checkpoints_written += 1
         self.smm.metrics.meter("Flows.CheckpointingRate").mark()
-        if self.smm.dev_checkpoint_check:
-            self.smm._check_checkpoint_restorable(self.flow_id, blob)
 
 
 class StateMachineManager:

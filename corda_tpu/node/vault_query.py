@@ -151,6 +151,135 @@ class VaultQueryCriteria(QueryCriteria):
         return (" AND ".join(clauses) or "1=1"), params
 
 
+def _status_clause(status: str) -> Tuple[str, list]:
+    if status == UNCONSUMED:
+        return "consumed = 0", []
+    if status == CONSUMED:
+        return "consumed = 1", []
+    if status == ALL:
+        return "1=1", []
+    raise VaultQueryError(f"unknown status {status!r}")
+
+
+def _attr_exists(name: str, op: str, value, numeric: bool) -> Tuple[str, list]:
+    """EXISTS subquery over vault_attributes for one attribute predicate."""
+    if op not in ("=", "<", "<=", ">", ">=", "LIKE"):
+        raise VaultQueryError(f"unsupported attribute operator {op!r}")
+    column = "value_num" if numeric else "value_text"
+    return (
+        "EXISTS (SELECT 1 FROM vault_attributes a WHERE"
+        " a.tx_id = vault_states.tx_id"
+        " AND a.output_index = vault_states.output_index"
+        f" AND a.name = ? AND a.{column} {op} ?)",
+        # ints stay ints: the column has NUMERIC affinity so 64-bit token
+        # quantities compare exactly (no 2^53 float rounding)
+        [name, value if numeric else str(value)],
+    )
+
+
+def _attr_in(name: str, values) -> Tuple[str, list]:
+    marks = ",".join("?" * len(values))
+    return (
+        "EXISTS (SELECT 1 FROM vault_attributes a WHERE"
+        " a.tx_id = vault_states.tx_id"
+        " AND a.output_index = vault_states.output_index"
+        f" AND a.name = ? AND a.value_text IN ({marks}))",
+        [name] + [str(v) for v in values],
+    )
+
+
+@dataclass(frozen=True)
+class LinearStateQueryCriteria(QueryCriteria):
+    """LinearState family (reference QueryCriteria.LinearStateQueryCriteria
+    -> HibernateQueryCriteriaParser VaultLinearStates columns): select by
+    linear id (UniqueIdentifier or its string form) and/or external id."""
+
+    linear_ids: Tuple = ()
+    external_ids: Tuple[str, ...] = ()
+    status: str = UNCONSUMED
+
+    def compile(self):
+        clauses, params = [], []
+        sql, p = _status_clause(self.status)
+        clauses.append(sql)
+        params.extend(p)
+        if self.linear_ids:
+            sql, p = _attr_in("linear_id", [str(l) for l in self.linear_ids])
+            clauses.append(sql)
+            params.extend(p)
+        if self.external_ids:
+            sql, p = _attr_in("external_id", list(self.external_ids))
+            clauses.append(sql)
+            params.extend(p)
+        return " AND ".join(clauses), params
+
+
+@dataclass(frozen=True)
+class FungibleAssetQueryCriteria(QueryCriteria):
+    """FungibleAsset family (reference
+    QueryCriteria.FungibleAssetQueryCriteria -> CashSchemaV1 columns):
+    owner keys, quantity comparison, issuer party names/refs, product."""
+
+    owner_keys: Tuple[bytes, ...] = ()     # encoded public keys
+    quantity: Optional[Tuple[str, int]] = None  # (op, value), op in = < <= > >=
+    issuer_names: Tuple[str, ...] = ()
+    issuer_refs: Tuple[bytes, ...] = ()
+    products: Tuple[str, ...] = ()
+    status: str = UNCONSUMED
+
+    def compile(self):
+        clauses, params = [], []
+        sql, p = _status_clause(self.status)
+        clauses.append(sql)
+        params.extend(p)
+        if self.owner_keys:
+            sql, p = _attr_in("owner_key", [k.hex() for k in self.owner_keys])
+            clauses.append(sql)
+            params.extend(p)
+        if self.quantity is not None:
+            op, value = self.quantity
+            sql, p = _attr_exists("quantity", op, value, numeric=True)
+            clauses.append(sql)
+            params.extend(p)
+        if self.issuer_names:
+            sql, p = _attr_in("issuer_name", list(self.issuer_names))
+            clauses.append(sql)
+            params.extend(p)
+        if self.issuer_refs:
+            sql, p = _attr_in("issuer_ref", [r.hex() for r in self.issuer_refs])
+            clauses.append(sql)
+            params.extend(p)
+        if self.products:
+            sql, p = _attr_in("product", list(self.products))
+            clauses.append(sql)
+            params.extend(p)
+        return " AND ".join(clauses), params
+
+
+@dataclass(frozen=True)
+class CustomAttributeCriteria(QueryCriteria):
+    """Custom per-contract schema criterion (reference
+    QueryCriteria.VaultCustomQueryCriteria over a MappedSchema column):
+    matches an attribute a state exposed via `vault_attributes()` —
+    `CustomAttributeCriteria("maturity", "<=", 1700000000.0)`."""
+
+    name: str = ""
+    op: str = "="
+    value: object = None
+    numeric: bool = False
+    status: str = UNCONSUMED
+
+    def compile(self):
+        clauses, params = [], []
+        sql, p = _status_clause(self.status)
+        clauses.append(sql)
+        params.extend(p)
+        sql, p = _attr_exists(self.name, self.op, self.value, self.numeric)
+        clauses.append(sql)
+        params.extend(p)
+        return " AND ".join(clauses), params
+
+
 @dataclass(frozen=True)
 class Page:
     """One page of results (reference Vault.Page)."""
@@ -190,6 +319,41 @@ register_adapter(
     _Compound, "VaultCompoundCriteria",
     lambda c: {"op": c.op, "l": c.left, "r": c.right},
     lambda d: _Compound(d["op"], d["l"], d["r"]),
+)
+register_adapter(
+    LinearStateQueryCriteria, "LinearStateQueryCriteria",
+    lambda c: {
+        "linear_ids": [str(l) for l in c.linear_ids],
+        "external_ids": list(c.external_ids), "status": c.status,
+    },
+    lambda d: LinearStateQueryCriteria(
+        tuple(d["linear_ids"]), tuple(d["external_ids"]), d["status"],
+    ),
+)
+register_adapter(
+    FungibleAssetQueryCriteria, "FungibleAssetQueryCriteria",
+    lambda c: {
+        "owners": list(c.owner_keys),
+        "quantity": list(c.quantity) if c.quantity else None,
+        "issuers": list(c.issuer_names), "refs": list(c.issuer_refs),
+        "products": list(c.products), "status": c.status,
+    },
+    lambda d: FungibleAssetQueryCriteria(
+        tuple(d["owners"]),
+        tuple(d["quantity"]) if d["quantity"] else None,
+        tuple(d["issuers"]), tuple(d["refs"]), tuple(d["products"]),
+        d["status"],
+    ),
+)
+register_adapter(
+    CustomAttributeCriteria, "CustomAttributeCriteria",
+    lambda c: {
+        "name": c.name, "op": c.op, "value": c.value,
+        "numeric": c.numeric, "status": c.status,
+    },
+    lambda d: CustomAttributeCriteria(
+        d["name"], d["op"], d["value"], d["numeric"], d["status"],
+    ),
 )
 register_adapter(
     Page, "VaultPage",

@@ -61,6 +61,10 @@ BLK = _validated_blk("CORDA_TPU_ED25519_BLK", 512)
 
 _MASK = np.uint32(0xFFFF)
 
+#: 2-bit Shamir digits per scalar: both ladder scalars are < L < 2^253,
+#: so 127 digits (bits 0..253) cover them and the top digit is skipped.
+NDIGITS = 127
+
 
 def _limbs(x: int):
     """Python-int limb list (shared radix with ops/field25519.int_to_limbs)."""
@@ -119,11 +123,65 @@ def _reduce(d):
     return jnp.concatenate(rows, axis=0)
 
 
+# Mosaic-only accumulation trim (docs/perf-roofline.md item 3): the dense
+# shifted accumulation below adds each 16-row product block into a 32-row
+# accumulator, so half of every add's rows are zeros.  The fast variants
+# add into the 16 live rows only (static-slice .at[].add), trimming
+# ~25-30% of the multiply's element-ops — but the slice+concat HLO this
+# lowers to blows XLA *CPU* compile time up ~3x (measured round 2), so it
+# is only switched on while tracing the Pallas TPU kernel.  The switch is
+# THREAD-LOCAL: a concurrent CPU-side trace on another thread must not
+# observe the TPU trace's flag (and vice versa).  Env knob
+# CORDA_TPU_FAST_MUL=0 disables for A/B runs.
+import threading as _threading
+
+_FAST_MUL_TLS = _threading.local()
+_FAST_MUL_ENABLED = os.environ.get("CORDA_TPU_FAST_MUL", "1") != "0"
+
+
+def _fast_mul_active() -> bool:
+    return getattr(_FAST_MUL_TLS, "active", False)
+
+
+def _mul_fast(a, b):
+    """_mul with live-row accumulation (differential-tested vs _mul in
+    tests/test_ops_ed25519.py; identical bounds argument)."""
+    w = a.shape[1]
+    acc = _zeros(32, w)
+    for i in range(16):
+        p = a[i : i + 1] * b
+        lo = p & _MASK
+        hi = p >> 16
+        acc = acc.at[i : i + 16].add(lo)
+        acc = acc.at[i + 1 : i + 17].add(hi)
+    d = acc[:16] + np.uint32(38) * acc[16:]
+    return _reduce(d)
+
+
+def _square_fast(a):
+    """_square with live-row accumulation (same symmetry exploitation)."""
+    w = a.shape[1]
+    acc = _zeros(32, w)
+    for i in range(16):
+        diag = a[i : i + 1] * a[i : i + 1]
+        acc = acc.at[2 * i : 2 * i + 1].add(diag & _MASK)
+        acc = acc.at[2 * i + 1 : 2 * i + 2].add(diag >> 16)
+        if i + 1 < 16:
+            p = a[i : i + 1] * a[i + 1 :]
+            rows = p.shape[0]
+            acc = acc.at[2 * i + 1 : 2 * i + 1 + rows].add((p & _MASK) * 2)
+            acc = acc.at[2 * i + 2 : 2 * i + 2 + rows].add((p >> 16) * 2)
+    d = acc[:16] + np.uint32(38) * acc[16:]
+    return _reduce(d)
+
+
 def _mul(a, b):
     """Schoolbook product via shifted accumulation; all ops dense (W lanes).
 
     Row products a_i * b fit uint32 exactly (16x16-bit limbs); coefficient
     sums <= 32 halfword terms < 2^21; the *38 fold keeps < 2^27."""
+    if _fast_mul_active():
+        return _mul_fast(a, b)
     w = a.shape[1]
     c = _zeros(32, w)
     for i in range(16):
@@ -139,6 +197,8 @@ def _mul(a, b):
 def _square(a):
     """a^2 exploiting symmetry: off-diagonal halfwords doubled (< 2^17;
     coefficient sums stay < 2^21), ~0.6x the products of _mul."""
+    if _fast_mul_active():
+        return _square_fast(a)
     w = a.shape[1]
     c = _zeros(32, w)
     for i in range(16):
@@ -422,7 +482,12 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
         write_table(e, jnp.concatenate(_to_cached(p), axis=0))
 
     # 2-bit digit rows for both scalars: idx row t = s-digit + 4*h-digit.
-    for t in range(128):
+    # Only 127 digits: both scalars are < L < 2^253 (s by the host's
+    # s_ok canonicality check — rows with s >= L are already failed by
+    # the mask, so their garbage ladder result is irrelevant — and
+    # h = SHA-512 mod L by construction), so digit t=127 (bits 254-255)
+    # is always zero and its 2 doubles + 1 add are skipped.
+    for t in range(NDIGITS):
         w, r = (2 * t) // 32, (2 * t) % 32
         write_idx(
             t,
@@ -431,7 +496,7 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
         )
 
     def body(i, q):
-        t = 127 - i
+        t = NDIGITS - 1 - i
         row = read_idx(t)  # (1, width)
         q = _pt_double(q, with_t=False)
         q = _pt_double(q)
@@ -446,10 +511,10 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
         # Off-TPU test path: python loop so array-backed accessors can use
         # concrete indices (lax.fori_loop traces its body).
         q = _identity_pt(width)
-        for i in range(128):
+        for i in range(NDIGITS):
             q = body(i, q)
     else:
-        q = lax.fori_loop(0, 128, body, _identity_pt(width))
+        q = lax.fori_loop(0, NDIGITS, body, _identity_pt(width))
 
     eq_x = _eq(q[0], _mul(r_pt[0], q[2]))
     eq_y = _eq(q[1], _mul(r_pt[1], q[2]))
@@ -470,20 +535,29 @@ def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref, ok_ref,
     def read_idx(t):
         return idx_ref[pl.ds(t, 1), :]
 
-    out_ref[:] = _verify_core(
-        BLK,
-        y_a_ref[:],
-        sign_a_ref[:],
-        y_r_ref[:],
-        sign_r_ref[:],
-        s_ref[:],
-        h_ref[:],
-        ok_ref[:],
-        write_table,
-        read_table,
-        write_idx,
-        read_idx,
-    )
+    # trace-time switch: the fast-mul variants lower well under Mosaic
+    # but blow up XLA CPU compiles, so they are enabled only while this
+    # TPU kernel body is being traced, on this thread only (module
+    # comment at _FAST_MUL_TLS)
+    prev = _fast_mul_active()
+    _FAST_MUL_TLS.active = _FAST_MUL_ENABLED
+    try:
+        out_ref[:] = _verify_core(
+            BLK,
+            y_a_ref[:],
+            sign_a_ref[:],
+            y_r_ref[:],
+            sign_r_ref[:],
+            s_ref[:],
+            h_ref[:],
+            ok_ref[:],
+            write_table,
+            read_table,
+            write_idx,
+            read_idx,
+        )
+    finally:
+        _FAST_MUL_TLS.active = prev
 
 
 @jax.jit
@@ -518,6 +592,6 @@ def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok):
         out_specs=spec(1),
         scratch_shapes=[
             pltpu.VMEM((16 * 64, BLK), jnp.uint32),  # Straus table
-            pltpu.VMEM((128, BLK), jnp.uint32),      # digit rows
+            pltpu.VMEM((NDIGITS + 1, BLK), jnp.uint32),  # digit rows
         ],
     )(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok)

@@ -16,6 +16,7 @@ Permissions (reference RPC users in node.conf): a user has a set like
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set
@@ -46,8 +47,11 @@ class RPCServer:
         broker.create_queue(RPC_SERVER_QUEUE)
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(RPC_SERVER_QUEUE)
+        from ..utils.profiling import maybe_profiled
+
         self._thread = threading.Thread(
-            target=self._serve, name="rpc-server", daemon=True
+            target=maybe_profiled(self._serve, "rpc"),
+            name="rpc-server", daemon=True,
         )
         self._thread.start()
 
@@ -166,6 +170,11 @@ class RPCServer:
                 "error": f"PERMISSION:{method_name} not permitted for {user.username}",
             })
             return
+        smm = getattr(self.ops, "_smm", None)
+        timer = (
+            smm.metrics.timer(f"RPC.{method_name}") if smm is not None else None
+        )
+        t0 = time.perf_counter()
         try:
             result = getattr(self.ops, method_name)(*args)
         except Exception as exc:
@@ -174,6 +183,9 @@ class RPCServer:
                 "error": f"{type(exc).__name__}: {exc}",
             })
             return
+        finally:
+            if timer is not None:
+                timer.update(time.perf_counter() - t0)
         self._reply(reply_to, {
             "kind": "reply", "id": req_id,
             "ok": self._marshal(result, request.get("session", ""), reply_to),

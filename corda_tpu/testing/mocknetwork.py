@@ -44,12 +44,14 @@ class MockNetwork:
         db_path: str = ":memory:",
         entropy: Optional[int] = None,
         clock=None,
+        dev_checkpoint_check: bool = True,
     ) -> MockNode:
         config = NodeConfiguration(
             my_legal_name=legal_name,
             db_path=db_path,
             notary_type=notary_type,
             identity_entropy=entropy if entropy is not None else self._next_entropy(),
+            dev_checkpoint_check=dev_checkpoint_check,
         )
         node = MockNode(
             config, self.messaging_network.create_endpoint,

@@ -52,12 +52,45 @@ class NodeProcess:
         self._clients.append(client)
         return client.start(username, password)
 
+    # -- fault injection (reference Disruption.kt:17-90 runs these over
+    # SSH against a remote cluster; here the cluster is local processes) --
+
+    def kill(self) -> None:
+        """SIGKILL — no cleanup, no flushes (the 'kill' disruption)."""
+        import signal as _signal
+
+        if self.alive():
+            self._proc.send_signal(_signal.SIGKILL)
+            self._proc.wait(timeout=10)
+
+    def suspend(self) -> None:
+        """SIGSTOP — the 'hang' disruption: the process keeps its sockets
+        but stops responding, exactly like a GC pause / hung JVM."""
+        import signal as _signal
+
+        self._proc.send_signal(_signal.SIGSTOP)
+
+    def resume(self) -> None:
+        import signal as _signal
+
+        self._proc.send_signal(_signal.SIGCONT)
+
+    def delete_message_store(self) -> None:
+        """rm -rf the broker journal (the 'deleteDb' disruption wipes the
+        reference's artemis dir). Only meaningful while stopped."""
+        import shutil
+
+        shutil.rmtree(
+            os.path.join(self.node_dir, "journal"), ignore_errors=True
+        )
+
     def close(self, timeout: float = 10) -> None:
         for c in self._clients:
             try:
                 c.close()
             except Exception:
                 pass
+        self._clients.clear()
         if self.alive():
             self._proc.terminate()
             try:
@@ -95,6 +128,11 @@ class Factory:
         """Boot an EXISTING node directory (e.g. one materialised by
         tools/cordform.deploy_nodes) as a black box."""
         log_path = os.path.join(node_dir, "node.log")
+        # a stale port file from a previous (killed) run would make the
+        # readiness poll below return before the new process binds
+        port_file_stale = os.path.join(node_dir, "broker.port")
+        if os.path.exists(port_file_stale):
+            os.unlink(port_file_stale)
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)

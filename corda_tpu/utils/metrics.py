@@ -141,6 +141,7 @@ class Timer:
     def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
         self._meter = Meter(clock)
         self._durations: deque = deque(maxlen=self.RESERVOIR)
+        self._total = 0.0  # exact lifetime sum (the reservoir is windowed)
         self._clock = clock
         self._lock = threading.Lock()
 
@@ -148,6 +149,7 @@ class Timer:
         self._meter.mark()
         with self._lock:
             self._durations.append(seconds)
+            self._total += seconds
 
     class _Ctx:
         def __init__(self, timer: "Timer") -> None:
@@ -171,8 +173,10 @@ class Timer:
     def snapshot(self) -> Dict:
         with self._lock:
             xs = sorted(self._durations)
+            total = self._total
         out = self._meter.snapshot()
         out["type"] = "timer"
+        out["total"] = round(total, 6)
         if xs:
             def pct(q: float) -> float:
                 return xs[min(len(xs) - 1, int(q * len(xs)))]

@@ -1,0 +1,70 @@
+"""Opt-in per-thread cProfile for node processes.
+
+Set CORDA_TPU_PROFILE_DUMP=<dir> before starting a node and its hot
+threads (p2p consumer, RPC server) run under cProfile; at interpreter
+exit each thread's stats dump to <dir>/<pid>-<thread>.pstats plus a
+cumulative-time text summary to <dir>/<pid>-<thread>.txt.
+
+Exists for the kernel->system throughput hunt (round-2 VERDICT weak #3):
+the seam timers (P2P.Handle.*, RPC.*) say WHICH hop is slow; this says
+WHY, function by function, inside a real OS-process deployment. Overhead
+is real (~2x on pure-Python code) — never enable in a perf measurement
+you intend to report.
+"""
+from __future__ import annotations
+
+import atexit
+import cProfile
+import io
+import os
+import pstats
+from typing import Callable, List, Tuple
+
+_DIR = os.environ.get("CORDA_TPU_PROFILE_DUMP")
+#: CPython 3.12 cProfile claims the process-wide sys.monitoring profiler
+#: slot, so only ONE thread per process can be profiled — pick it here.
+_THREAD = os.environ.get("CORDA_TPU_PROFILE_THREAD", "p2p")
+_PROFILES: List[Tuple[str, cProfile.Profile]] = []
+
+
+def maybe_profiled(fn: Callable, name: str) -> Callable:
+    """Wrap a thread target in a cProfile when dumping is enabled and
+    this is the chosen thread. A second enable() in the same process
+    raises (single sys.monitoring slot); never let that kill the thread."""
+    if not _DIR or name != _THREAD:
+        return fn
+    prof = cProfile.Profile()
+
+    def wrapper(*args, **kwargs):
+        try:
+            prof.enable()
+        except ValueError:
+            return fn(*args, **kwargs)  # slot taken: run unprofiled
+        _PROFILES.append((name, prof))
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            prof.disable()
+
+    return wrapper
+
+
+def _dump() -> None:
+    if not _DIR or not _PROFILES:
+        return
+    os.makedirs(_DIR, exist_ok=True)
+    pid = os.getpid()
+    for name, prof in _PROFILES:
+        base = os.path.join(_DIR, f"{pid}-{name}")
+        try:
+            prof.dump_stats(base + ".pstats")
+            buf = io.StringIO()
+            stats = pstats.Stats(prof, stream=buf)
+            stats.sort_stats("cumulative").print_stats(40)
+            with open(base + ".txt", "w") as fh:
+                fh.write(buf.getvalue())
+        except Exception:
+            pass  # profiling must never break shutdown
+
+
+atexit.register(_dump)

@@ -247,6 +247,79 @@ class TestCheckpointRestore:
         assert node2.checkpoint_storage.count() == 0
         node2.stop()
 
+    def test_incremental_checkpoints_survive_restart(self, tmp_path):
+        """The production fast path (dev_checkpoint_check=False) writes
+        header-once + appended io entries + a session blob instead of one
+        full blob per step; a restart must restore identically."""
+        db = str(tmp_path / "inc.db")
+        net = MockNetwork()
+        node = net.create_node(
+            "O=Inc,L=Oslo,C=NO", db_path=db, entropy=91,
+            dev_checkpoint_check=False,
+        )
+        assert node.smm.dev_checkpoint_check is False
+
+        b = TransactionBuilder(notary=node.info)
+        b.add_output_state(OwnedState(owner=node.info, value=5))
+        b.add_command(MoveCmd(), node.info.owning_key)
+        stx = node.services.sign_initial_transaction(b)
+
+        handle = node.start_flow(WaitForTxFlow(stx.id), stx.id)
+        assert not handle.result.done()
+        assert node.checkpoint_storage.count() == 1
+        # the fast path must not have written a legacy full-blob row
+        assert node.database.query("SELECT COUNT(*) FROM checkpoints")[0][0] == 0
+        assert node.database.query("SELECT COUNT(*) FROM cp_header")[0][0] == 1
+
+        node.stop()
+
+        node2 = net.create_node(
+            "O=Inc,L=Oslo,C=NO", db_path=db, entropy=91,
+            dev_checkpoint_check=False,
+        )
+        restored = [f for f in node2.smm.flows.values() if not f.done]
+        assert len(restored) == 1
+        node2.services.record_transactions([stx])
+        assert restored[0].result.result(timeout=1) == stx.id
+        assert node2.checkpoint_storage.count() == 0
+        node2.stop()
+
+    def test_incremental_supersedes_legacy_row(self):
+        """A flow that checkpointed as a full legacy blob (dev mode) and
+        then progresses incrementally must NOT resurrect the stale legacy
+        blob on restart (round-3 review finding): the first incremental
+        write backfills everything and deletes the legacy row."""
+        from corda_tpu.core.serialization.codec import deserialize, serialize
+        from corda_tpu.node.database import CheckpointStorage, NodeDatabase
+
+        db = NodeDatabase(":memory:")
+        cs = CheckpointStorage(db)
+        stale = {
+            "flow_id": "f1", "flow_name": "X", "args": [], "kwargs": {},
+            "is_responder": False, "io_log": [b"old"],
+            "sessions": [], "session_keys": {}, "session_owner_flows": {},
+        }
+        cs.put("f1", serialize(stale))
+        header = {
+            "flow_id": "f1", "flow_name": "X", "args": [], "kwargs": {},
+            "is_responder": False,
+        }
+        sessions = {
+            "sessions": [], "session_keys": {"k": "s1"},
+            "session_owner_flows": {},
+        }
+        cs.put_incremental(
+            "f1", serialize(header),
+            [(0, b"old"), (1, b"new")], serialize(sessions),
+        )
+        assert cs.count() == 1
+        blobs = dict(cs.all_checkpoints())
+        state = deserialize(blobs["f1"])
+        assert state["io_log"] == [b"old", b"new"]
+        assert state["session_keys"] == {"k": "s1"}
+        # legacy row is gone
+        assert db.query("SELECT COUNT(*) FROM checkpoints")[0][0] == 0
+
     def test_responder_restore_mid_session(self, tmp_path):
         db = str(tmp_path / "bob.db")
         net = MockNetwork()

@@ -162,6 +162,56 @@ class TestBatchVerify:
         assert ed25519_batch.verify_batch([], [], []).shape == (0,)
 
 
+class TestFastMulVariants:
+    """The Mosaic-only live-row accumulation variants must agree with the
+    dense formulations bit-for-bit (they are swapped in only while the
+    TPU kernel body is traced; docs/perf-roofline.md item 3)."""
+
+    def test_mul_and_square_fast_differential(self):
+        import jax
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+        from corda_tpu.ops.field25519 import P_INT
+
+        rng = np.random.default_rng(17)
+        vals_a = [int.from_bytes(rng.bytes(32), "little") % P_INT
+                  for _ in range(8)]
+        vals_b = [int.from_bytes(rng.bytes(32), "little") % P_INT
+                  for _ in range(8)]
+        vals_a[0], vals_b[0] = P_INT - 1, P_INT - 1  # worst-case carries
+        vals_a[1], vals_b[1] = 0, 0
+
+        def col(vals):
+            return jnp.concatenate(
+                [
+                    jnp.asarray(
+                        [[v] for v in pl_mod._limbs(x)], jnp.uint32
+                    )
+                    for x in vals
+                ],
+                axis=1,
+            )
+
+        a, b = col(vals_a), col(vals_b)
+        f = jax.jit(
+            lambda x, y: (
+                pl_mod._canonical(pl_mod._mul(x, y)),
+                pl_mod._canonical(pl_mod._mul_fast(x, y)),
+                pl_mod._canonical(pl_mod._square(x)),
+                pl_mod._canonical(pl_mod._square_fast(x)),
+            )
+        )
+        mul_ref, mul_fast, sq_ref, sq_fast = f(a, b)
+        assert np.array_equal(np.asarray(mul_ref), np.asarray(mul_fast))
+        assert np.array_equal(np.asarray(sq_ref), np.asarray(sq_fast))
+        # and against plain integer arithmetic
+        got = np.asarray(mul_fast)
+        for j, (x, y) in enumerate(zip(vals_a, vals_b)):
+            want = pl_mod._limbs((x * y) % P_INT)
+            assert [int(v) for v in got[:, j]] == want, j
+
+
 class TestPallasCore:
     def test_verify_core_off_tpu(self):
         """The Pallas kernel's math core (`ed25519_pallas._verify_core`) run
@@ -207,7 +257,8 @@ class TestPallasCore:
         def read_idx(t):
             if "idx" not in stacked:
                 stacked["idx"] = jnp.concatenate(
-                    [idx_rows[k] for k in range(128)], axis=0
+                    [idx_rows[k] for k in range(ed25519_pallas.NDIGITS)],
+                    axis=0,
                 )
             return lax.dynamic_slice_in_dim(stacked["idx"], t, 1, axis=0)
 

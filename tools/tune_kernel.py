@@ -16,10 +16,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_one(blk: int, chunk: int, timeout: float, ecdsa_blk: int = 0) -> dict:
+def run_one(blk: int, chunk: int, timeout: float, ecdsa_blk: int = 0,
+            fast_mul: bool = True) -> dict:
     env = dict(os.environ)
     env["CORDA_TPU_ED25519_BLK"] = str(blk)
     env["CORDA_TPU_PIPE_CHUNK"] = str(chunk)
+    env["CORDA_TPU_FAST_MUL"] = "1" if fast_mul else "0"
     if ecdsa_blk:
         env["CORDA_TPU_ECDSA_BLK"] = str(ecdsa_blk)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -48,19 +50,28 @@ def main() -> int:
     ap.add_argument("--blks", default="256,512,1024")
     ap.add_argument("--chunks", default="65536,131072")
     ap.add_argument("--timeout", type=float, default=1800)
+    ap.add_argument(
+        "--ab-fast-mul", action="store_true",
+        help="run each config with CORDA_TPU_FAST_MUL on AND off "
+        "(the Mosaic live-row accumulation A/B, docs/perf-roofline.md)",
+    )
     args = ap.parse_args()
 
     results = []
+    fast_opts = (True, False) if args.ab_fast_mul else (True,)
     for blk in (int(b) for b in args.blks.split(",")):
         for chunk in (int(c) for c in args.chunks.split(",")):
-            rec = run_one(blk, chunk, args.timeout)
-            print(json.dumps(rec), flush=True)
-            results.append(rec)
+            for fast in fast_opts:
+                rec = run_one(blk, chunk, args.timeout, fast_mul=fast)
+                rec["fast_mul"] = fast
+                print(json.dumps(rec), flush=True)
+                results.append(rec)
     ok = [r for r in results if "value" in r]
     if ok:
         best = max(ok, key=lambda r: r["value"])
         print(
             f"# best: BLK={best['blk']} CHUNK={best['chunk']} "
+            f"fast_mul={best['fast_mul']} "
             f"-> {best['value']:,.0f} sigs/s (vs_baseline {best['vs_baseline']})"
         )
     return 0
