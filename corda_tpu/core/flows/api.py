@@ -31,7 +31,7 @@ args and the sequence of IO results — that is what makes replay-restore
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Type
+from typing import Any, Callable, Dict, List, Optional, Type
 
 from ..identity import Party
 
@@ -106,6 +106,19 @@ class SendAndReceive:
 @dataclass(frozen=True)
 class WaitForLedgerCommit:
     tx_id: Any  # SecureHash
+
+
+@dataclass(frozen=True)
+class AwaitBlocking:
+    """Run a potentially LONG-BLOCKING `compute()` off the messaging pump:
+    the flow parks, the computation runs on the node's blocking executor,
+    and the flow resumes with the (recorded, replay-stable) result. A
+    computation given here must be idempotent — a flow restored from a
+    checkpoint taken before the result was recorded re-executes it (the
+    cluster notary's putall commit is the canonical case). On the
+    deterministic in-memory network it runs inline."""
+
+    compute: Callable = None
 
 
 @dataclass(frozen=True)
@@ -282,6 +295,12 @@ class FlowLogic:
 
     def wait_for_ledger_commit(self, tx_id) -> WaitForLedgerCommit:
         return WaitForLedgerCommit(tx_id)
+
+    def await_blocking(self, compute) -> AwaitBlocking:
+        """Park the flow while `compute()` runs off the messaging pump;
+        resume with its recorded result (see AwaitBlocking's idempotency
+        contract). Usage: `result = yield self.await_blocking(fn)`."""
+        return AwaitBlocking(compute)
 
     def record(self, compute) -> RecordValue:
         """Capture a nondeterministic computation into the checkpoint log;

@@ -45,25 +45,39 @@ def generate_spend(
     """Select our unconsumed cash of `amount.token`, add inputs + payment +
     change outputs and a Move command.  Selected states are soft-locked
     under lock_id so concurrent flows cannot double-select."""
+    import time as _time
+
+    from ..node.services import StatesNotAvailableError
+
     vault = service_hub.vault_service
     lock_id = lock_id or str(uuid.uuid4())
-    candidates = [
-        sr for sr in vault.unlocked_unconsumed_states(
-            CashState.contract_name, lock_id=lock_id
-        )
-        if sr.state.data.amount.token == amount.token
-    ]
-    selected, gathered = [], 0
-    for sr in candidates:
-        if gathered >= amount.quantity:
+    # select-then-reserve races concurrent spenders (the query and the
+    # lock are not atomic); retry with backoff like the reference's
+    # AbstractCashSelection (spendLock + retrySleep)
+    for attempt in range(5):
+        candidates = [
+            sr for sr in vault.unlocked_unconsumed_states(
+                CashState.contract_name, lock_id=lock_id
+            )
+            if sr.state.data.amount.token == amount.token
+        ]
+        selected, gathered = [], 0
+        for sr in candidates:
+            if gathered >= amount.quantity:
+                break
+            selected.append(sr)
+            gathered += sr.state.data.amount.quantity
+        if gathered < amount.quantity:
+            raise InsufficientBalanceError(
+                Amount(amount.quantity - gathered, amount.token)
+            )
+        try:
+            vault.soft_lock_reserve(lock_id, [sr.ref for sr in selected])
             break
-        selected.append(sr)
-        gathered += sr.state.data.amount.quantity
-    if gathered < amount.quantity:
-        raise InsufficientBalanceError(
-            Amount(amount.quantity - gathered, amount.token)
-        )
-    vault.soft_lock_reserve(lock_id, [sr.ref for sr in selected])
+        except StatesNotAvailableError:
+            if attempt == 4:
+                raise
+            _time.sleep(0.05 * (attempt + 1))
     me = service_hub.my_info
     for sr in selected:
         builder.add_input_state(sr)

@@ -38,6 +38,16 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    import logging
+
+    logging.basicConfig(
+        level=getattr(
+            logging, os.environ.get("CORDA_TPU_LOG", "WARNING").upper(),
+            logging.WARNING,
+        ),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
     from .config import load_config
 
     overrides = {}
@@ -133,7 +143,10 @@ def main(argv=None) -> int:
 
     netmap_service = None
     if cfg.network_map_service:
-        netmap_service = NetworkMapService(broker).start()
+        netmap_service = NetworkMapService(
+            broker,
+            persist_path=os.path.join(cfg.base_directory, "networkmap.db"),
+        ).start()
 
     netmap_client = None
     if cfg.network_map or cfg.network_map_service:
@@ -151,12 +164,18 @@ def main(argv=None) -> int:
             bridges.set_route(reg.party.name, reg.broker_address)
             node.register_peer(reg.party, reg.advertised_services)
 
+        extra_identities = []
+        if getattr(node, "cluster_party", None) is not None:
+            # notary cluster member: also register the cluster's
+            # composite identity at this member's address
+            extra_identities.append(node.cluster_registration_signer())
         netmap_client = NetworkMapClient(
             map_broker, node.info,
             f"{cfg.broker_host}:{server.port}",
             cfg.node.advertised_services,
             node._identity_key.private,
             on_entry,
+            extra_identities=extra_identities,
         )
         netmap_client.register_and_fetch()
 

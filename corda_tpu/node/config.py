@@ -83,6 +83,7 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         # production processes take the incremental-checkpoint fast path
         # unless node.conf opts back into per-step validation
         dev_checkpoint_check=bool(cfg.get("dev_checkpoint_check", False)),
+        raft_cluster=cfg.get("raft_cluster"),
     )
     return FullNodeConfiguration(
         node=node_cfg,

@@ -181,6 +181,10 @@ class BrokerMessagingService:
     `p2p.inbound.{name}`; a consumer thread dispatches to topic handlers.
     Used for single-process durable deployments and the verifier topology."""
 
+    #: tells the SMM to run flow work on an executor: flow bodies may
+    #: block (notary cluster commits) and must not wedge the pump thread
+    ASYNC_FLOW_DISPATCH = True
+
     def __init__(self, broker, me: Party, bridges=None):
         """`bridges`: optional BridgeManager — when it has a route for a
         peer, outbound messages go to its store-and-forward queue instead
@@ -201,6 +205,8 @@ class BrokerMessagingService:
         self.metrics = None
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(self.queue_name)
+        self._extra_threads: List[threading.Thread] = []
+        self._extra_consumers: List = []
         from ..utils.profiling import maybe_profiled
 
         self._thread = threading.Thread(
@@ -217,6 +223,29 @@ class BrokerMessagingService:
     def start(self) -> None:
         if not self._thread.is_alive():
             self._thread.start()
+        for t in self._extra_threads:
+            if not t.is_alive():
+                t.start()
+
+    def also_serve(self, service_name: str) -> None:
+        """Consume a SECOND inbound queue addressed to a service identity
+        (e.g. a notary cluster's composite Party): peers' bridges deliver
+        to p2p.inbound.<cluster name> on this member's broker, and those
+        messages dispatch through the same topic handlers. Call before
+        start()."""
+        queue = f"p2p.inbound.{service_name}"
+        self.broker.create_queue(
+            queue, durable=self.broker._journal_dir is not None
+        )
+        consumer = self.broker.create_consumer(queue)
+        self._extra_consumers.append(consumer)
+        thread = threading.Thread(
+            target=lambda: self._consume_from(consumer),
+            name=f"p2p-svc-{service_name}", daemon=True,
+        )
+        self._extra_threads.append(thread)
+        if self._thread.is_alive():  # started already: bring it up now
+            thread.start()
 
     def send(self, peer: Party, topic: str, payload: bytes) -> None:
         headers = {"topic": topic, "sender": self.me.name,
@@ -238,10 +267,13 @@ class BrokerMessagingService:
         self._handlers.setdefault(topic, []).append(fn)
 
     def _consume(self) -> None:
+        self._consume_from(self._consumer)
+
+    def _consume_from(self, consumer) -> None:
         from ..core.crypto.keys import SchemePublicKey
 
         while not self._stop.is_set():
-            msg = self._consumer.receive(timeout=0.2)
+            msg = consumer.receive(timeout=0.2)
             if msg is None:
                 continue
             topic = msg.headers.get("topic", "")
@@ -265,10 +297,15 @@ class BrokerMessagingService:
                 metrics.timer(f"P2P.Handle.{topic}").update(
                     time.perf_counter() - t0
                 )
-            self._consumer.ack(msg)
+            consumer.ack(msg)
 
     def stop(self) -> None:
         self._stop.set()
         self._consumer.close()
+        for c in self._extra_consumers:
+            c.close()
         if self._thread.ident is not None:  # pump may never have started
             self._thread.join(timeout=2)
+        for t in self._extra_threads:
+            if t.ident is not None:
+                t.join(timeout=2)

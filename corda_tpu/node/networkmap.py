@@ -21,6 +21,7 @@ registration signature is checked against the party key inside the entry
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -108,17 +109,45 @@ class NetworkMapService:
     its broker).  Thread-per-service pull loop, mirroring the verifier
     worker's shape."""
 
-    def __init__(self, broker):
+    def __init__(self, broker, persist_path: Optional[str] = None):
+        """persist_path: optional file the registration set survives
+        restarts in (the reference's map is a persisted service; an
+        in-memory map that forgets every peer when the directory node
+        restarts breaks routing for any node that registered before —
+        observed as a Raft term-war livelock when the map host is also a
+        cluster member that gets killed and relaunched)."""
         self._broker = broker
         broker.create_queue(NETWORK_MAP_QUEUE)
         self._entries: Dict[str, SignedRegistration] = {}
         self._subscribers: Dict[str, None] = {}
         self._lock = threading.Lock()
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            try:
+                with open(persist_path, "rb") as fh:
+                    for signed in deserialize(fh.read()):
+                        if signed.verify():
+                            self._entries[signed.registration.party.name] = signed
+            except Exception:
+                pass  # corrupt map file: start empty, re-registrations heal
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(NETWORK_MAP_QUEUE)
         self._thread = threading.Thread(
             target=self._run, name="network-map", daemon=True
         )
+
+    def _persist(self) -> None:
+        """Crash-safe rewrite (tmp + rename). Caller holds the lock."""
+        if not self._persist_path:
+            return
+        try:
+            blob = serialize(list(self._entries.values()))
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, self._persist_path)
+        except Exception:
+            pass  # persistence is best-effort; the live map still serves
 
     def start(self) -> "NetworkMapService":
         self._thread.start()
@@ -197,6 +226,7 @@ class NetworkMapService:
             # REMOVE entries are retained (not popped) so their serial
             # still orders against late ADDs; fetch/query filter them out.
             self._entries[reg.party.name] = signed
+            self._persist()
         return True, None
 
     def _reply(self, queue: str, payload: dict) -> None:
@@ -233,12 +263,20 @@ class NetworkMapClient:
     def __init__(self, map_broker, me: Party, my_address: str,
                  advertised_services, identity_private_key,
                  on_entry: Callable[[NodeRegistration], None],
-                 on_remove: Optional[Callable[[NodeRegistration], None]] = None):
+                 on_remove: Optional[Callable[[NodeRegistration], None]] = None,
+                 extra_identities=None):
+        """extra_identities: [(party, advertised_services, signer)] also
+        registered at this node's address — a notary CLUSTER member
+        advertises the cluster's composite identity this way, signing the
+        entry with its own leaf key wrapped as a threshold-satisfying
+        composite signature (reference: ServiceIdentityGenerator-produced
+        identities entering the network map)."""
         self._broker = map_broker
         self._me = me
         self._my_address = my_address
         self._advertised = tuple(advertised_services)
         self._key = identity_private_key
+        self._extra_identities = list(extra_identities or [])
         self._on_entry = on_entry
         self._on_remove = on_remove
         self._serial = int(time.time() * 1000)
@@ -299,6 +337,35 @@ class NetworkMapClient:
             raise RuntimeError(
                 f"network map rejected registration: {ack.get('error')}"
             )
+        for party, services, signer in self._extra_identities:
+            # SHARED key (e.g. a cluster identity all members register):
+            # serials must order across PROCESSES, so each registration
+            # takes a fresh wall-clock-ms serial — per-client counters
+            # seeded at different times would pin the entry to whichever
+            # member booted last and lock surviving members out of
+            # re-registering after it dies (no failover).
+            reg = NodeRegistration(
+                party, self._my_address, tuple(services),
+                serial=int(time.time() * 1000),
+                expires_at=time.time() + self._ttl,
+            )
+            self._request(
+                {"kind": "register",
+                 "registration": SignedRegistration(
+                     reg, signer(reg.signable_bytes())
+                 ),
+                 "reply_to": self._reply_queue},
+            )
+            ack = self._await_reply("register-ack", timeout)
+            if not ack.get("ok") and "stale serial" not in str(
+                ack.get("error", "")
+            ):
+                raise RuntimeError(
+                    f"network map rejected {party.name} registration: "
+                    f"{ack.get('error')}"
+                )
+            # "stale serial" = another member registered the shared
+            # identity in the same millisecond — benign; its entry serves
 
     def _refresh_loop(self) -> None:
         while not self._stop.wait(self._ttl / 2):

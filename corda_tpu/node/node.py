@@ -39,6 +39,14 @@ class NodeConfiguration:
     # default in the standalone production process (node.conf
     # "dev_checkpoint_check": true re-enables)
     dev_checkpoint_check: bool = True
+    # Raft notary cluster membership (notary_type "raft-validating" /
+    # "raft-simple"): {"name": cluster legal name, "index": my member
+    # index, "members": [{"name": legal name, "entropy": int}, ...]}.
+    # Deterministic member entropies let every member derive the full
+    # member key set and the cluster's composite identity locally
+    # (reference ServiceIdentityGenerator distributes the composite key
+    # to the member dirs at deploy time).
+    raft_cluster: Optional[dict] = None
 
 
 class AbstractNode:
@@ -94,6 +102,9 @@ class AbstractNode:
     def _make_notary_service(self):
         from .notary import SimpleNotaryService, ValidatingNotaryService
 
+        if (self.config.notary_type or "").startswith("raft"):
+            self._make_raft_notary_service()
+            return
         if self.config.notary_type == "validating":
             self.notary_service = ValidatingNotaryService(self.services, self.info)
             if NetworkMapCache.VALIDATING_NOTARY_SERVICE not in self.config.advertised_services:
@@ -105,6 +116,107 @@ class AbstractNode:
         self.services.notary_service = self.notary_service
         if NetworkMapCache.NOTARY_SERVICE not in self.config.advertised_services:
             self.config.advertised_services.append(NetworkMapCache.NOTARY_SERVICE)
+
+    def _make_raft_notary_service(self):
+        """One member of a Raft notary cluster (reference
+        RaftValidatingNotaryService over Copycat,
+        `RaftUniquenessProvider.kt:71-156`): Raft traffic rides the
+        node's own P2P messaging (RAFT_TOPIC over bridges), the
+        uniqueness log replicates through this node's database, and the
+        cluster presents a threshold-1 composite identity any member's
+        signature fulfils."""
+        from ..core.crypto import crypto as _crypto
+        from ..core.identity import Party
+        from .cluster_identity import generate_service_identity
+        from .notary import (
+            RaftUniquenessProvider,
+            SimpleNotaryService,
+            ValidatingNotaryService,
+        )
+        from .raft import RAFT_TOPIC, RaftNode
+
+        cfg = self.config.raft_cluster
+        if not cfg:
+            raise ValueError(
+                "notary_type raft-* requires a raft_cluster config block"
+            )
+        members = cfg["members"]
+        my_index = int(cfg["index"])
+        ids = [f"r{i}" for i in range(len(members))]
+        parties = [
+            Party(m["name"], _crypto.entropy_to_keypair(m["entropy"]).public)
+            for m in members
+        ]
+        self.cluster_party = generate_service_identity(
+            cfg["name"], [p.owning_key for p in parties], threshold=1
+        )
+        party_by_id = dict(zip(ids, parties))
+        id_by_name = {p.name: rid for rid, p in party_by_id.items()}
+
+        def transport(dst: str, payload: bytes) -> None:
+            try:
+                self.network.send(party_by_id[dst], RAFT_TOPIC, payload)
+            except Exception:
+                pass  # peer route not up yet: Raft tolerates loss
+
+        raft = RaftNode(
+            ids[my_index], [r for r in ids if r != ids[my_index]],
+            transport,
+            lambda cmd: self._raft_provider.apply(cmd),
+            db=self.database, seed=my_index,
+        )
+        self.raft_node = raft
+        self._raft_provider = RaftUniquenessProvider(
+            raft, self.database, forwarding_retry=True
+        )
+
+        def on_raft_message(sender, payload):
+            rid = id_by_name.get(getattr(sender, "name", None))
+            if rid is not None:
+                raft.on_message(rid, payload)
+
+        self.network.add_handler(RAFT_TOPIC, on_raft_message)
+        # messages addressed to the CLUSTER identity land here too
+        if hasattr(self.network, "also_serve"):
+            self.network.also_serve(self.cluster_party.name)
+
+        validating = self.config.notary_type == "raft-validating"
+        cls = ValidatingNotaryService if validating else SimpleNotaryService
+        self.notary_service = cls(
+            self.services, self.info, uniqueness_provider=self._raft_provider
+        )
+        self.services.notary_service = self.notary_service
+        # Notary services are advertised by the CLUSTER identity only —
+        # a member's own entry must not show up as a second notary in
+        # notary_identities().
+        self._cluster_services = [NetworkMapCache.NOTARY_SERVICE]
+        if validating:
+            self._cluster_services.insert(
+                0, NetworkMapCache.VALIDATING_NOTARY_SERVICE
+            )
+        self.services.network_map_cache.add_node(
+            self.cluster_party, list(self._cluster_services)
+        )
+        self.services.identity_service.register_identity(self.cluster_party)
+
+    def cluster_registration_signer(self):
+        """(party, advertised_services, signer) for NetworkMapClient's
+        extra_identities: the member signs cluster entries with its leaf
+        key wrapped as a threshold-satisfying composite signature."""
+        from ..core.crypto import crypto as _crypto
+        from ..core.crypto.composite import CompositeSignaturesWithKeys
+
+        def signer(data: bytes) -> bytes:
+            raw = _crypto.do_sign(self._identity_key.private, data)
+            return CompositeSignaturesWithKeys(
+                ((self.info.owning_key, raw),)
+            ).serialize()
+
+        return (
+            self.cluster_party,
+            list(self._cluster_services),
+            signer,
+        )
 
     def start(self) -> "AbstractNode":
         """Install core flows, register self in the network map, restore
@@ -120,12 +232,47 @@ class AbstractNode:
             # Open the P2P pump only now that handlers are installed (a
             # message consumed before this point would be dropped).
             self.network.start()
+        if getattr(self, "raft_node", None) is not None:
+            self._start_raft_ticker()
         self.started = True
         return self
 
+    #: Raft abstract time units per wall-clock second: the RaftNode's
+    #: ELECTION_TIMEOUT of (10, 20) units becomes 2.5-5 s and heartbeats
+    #: go every 750 ms. Deliberately conservative: heartbeats ride the
+    #: P2P bridges, whose latency spikes well past 100 ms when member
+    #: processes share cores with flow execution — an aggressive scale
+    #: (tried at 20 units/s) caused continuous leader churn under load.
+    RAFT_TIME_SCALE = 4.0
+
+    def _start_raft_ticker(self) -> None:
+        import threading as _threading
+        import time as _time
+
+        self._raft_stop = _threading.Event()
+
+        def run():
+            while not self._raft_stop.wait(0.05):
+                try:
+                    self.raft_node.tick(_time.monotonic() * self.RAFT_TIME_SCALE)
+                except Exception:
+                    pass  # a tick must never kill the ticker
+
+        self._raft_ticker = _threading.Thread(
+            target=run, name=f"raft-tick-{self.info.name}", daemon=True
+        )
+        self._raft_ticker.start()
+
     def stop(self) -> None:
+        if getattr(self, "_raft_stop", None) is not None:
+            self._raft_stop.set()
+            self._raft_ticker.join(timeout=2)
         if hasattr(self.network, "stop"):
             self.network.stop()
+        if self.smm._blocking_executor is not None:
+            self.smm._blocking_executor.shutdown(
+                wait=False, cancel_futures=True
+            )
         svc = self.services.transaction_verifier_service
         if hasattr(svc, "stop"):
             svc.stop()

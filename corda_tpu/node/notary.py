@@ -102,8 +102,13 @@ class RaftUniquenessProvider(UniquenessProvider):
     (send_and_receive_with_retry, reference FlowLogic.kt:98-110).
     """
 
-    def __init__(self, raft_node, db: NodeDatabase):
+    def __init__(self, raft_node, db: NodeDatabase,
+                 forwarding_retry: bool = False):
         self.raft = raft_node
+        # real-time clusters (OS processes) forward follower commits to
+        # the leader and retry across elections; virtual-time test buses
+        # keep the fail-fast behavior and drive retries themselves
+        self.forwarding_retry = forwarding_retry
         self._map = KVStore(db, "raft_uniqueness")
         # Log compaction (reference DistributedImmutableMap's snapshottable
         # state machine): the Raft log's applied prefix folds into a dump
@@ -140,12 +145,38 @@ class RaftUniquenessProvider(UniquenessProvider):
         return {"conflicts": {k: v for k, v in conflicts.items()}}
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        import time as _time
+        from concurrent.futures import TimeoutError as _FuturesTimeout
+
+        from .raft import NotLeaderError
+
         blob = serialize({"tx_id": tx_id, "by": requesting_party.name})
         entries = {
             PersistentUniquenessProvider._key(ref).hex(): blob for ref in states
         }
-        fut = self.raft.submit({"kind": "putall", "entries": entries})
-        result = fut.result(timeout=30)
+        command = {"kind": "putall", "entries": entries}
+        if not self.forwarding_retry:
+            result = self.raft.submit(command).result(timeout=30)
+        else:
+            # Any member accepts the commit: leaders apply locally,
+            # followers forward (raft.submit_anywhere); NotLeaderError
+            # during elections retries until the cluster converges
+            # (reference CopycatClient). putall is idempotent for the
+            # same tx_id, so a retried commit cannot double-spend itself.
+            deadline = _time.monotonic() + 30
+            while True:
+                fut = self.raft.submit_anywhere(command)
+                try:
+                    result = fut.result(timeout=5)
+                    break
+                except NotLeaderError:
+                    if _time.monotonic() > deadline:
+                        raise
+                    _time.sleep(0.2)
+                except (TimeoutError, _FuturesTimeout):
+                    # distinct classes on 3.10; aliases from 3.11 on
+                    if _time.monotonic() > deadline:
+                        raise
         if result["conflicts"]:
             by_key = {
                 PersistentUniquenessProvider._key(ref).hex(): ref
@@ -431,7 +462,12 @@ class NotaryServiceFlow(FlowLogic):
             service, payload
         )
         service.validate_time_window(time_window)
-        commit_sigs = service.commit_input_states(inputs, tx_id)
+        # off-pump: a cluster commit can block on consensus (leader
+        # election, member outage) and must not starve the messaging
+        # pump that delivers the consensus traffic itself
+        commit_sigs = yield self.await_blocking(
+            lambda: service.commit_input_states(inputs, tx_id)
+        )
         # the commit protocol's own signatures (BFT: f+1 replicas) win;
         # otherwise the serving identity signs
         sigs = tuple(commit_sigs) if commit_sigs else (service.sign(tx_id),)

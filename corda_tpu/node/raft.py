@@ -30,6 +30,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..core.serialization.codec import deserialize, serialize
 from .database import KVStore, NodeDatabase
 
+import logging as _logging
+
+logger = _logging.getLogger("corda_tpu.raft")
+
 RAFT_TOPIC = "platform.raft"
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -104,6 +108,13 @@ class RaftNode:
         self._now = 0.0
         # request_id -> future (leader only)
         self._pending: Dict[str, Future] = {}
+        # follower-forwarded client requests awaiting the leader's reply:
+        # req id -> (future, expiry monotonic time)
+        self._client_pending: Dict[str, Tuple[Future, float]] = {}
+        self._client_seq = 0
+        # prevote round state + leader-freshness for the stickiness check
+        self._prevotes: set = set()
+        self._last_leader_contact = float("-inf")
         self._reset_election_deadline()
 
     # -- logical-index helpers (the log may start after a snapshot) ----------
@@ -256,6 +267,101 @@ class RaftNode:
                 self._send_append(peer)
         return fut
 
+    def submit_anywhere(self, command: dict, timeout: float = 20.0) -> Future:
+        """Submit from ANY member: leaders apply locally, followers forward
+        the command to the current leader and resolve the returned future
+        with the leader's reply (the CopycatClient-forwarding semantics the
+        reference's notary cluster members rely on —
+        `RaftUniquenessProvider.kt:71-156`). The future fails with
+        NotLeaderError when no leader is known/reachable; callers retry."""
+        import time as _time
+
+        with self._lock:
+            # sweep forwarded requests nobody is waiting on any more: a
+            # leader that died before replying would otherwise leak one
+            # future per retry for the process lifetime
+            now = _time.monotonic()
+            for rid in [
+                r for r, (f, exp) in self._client_pending.items()
+                if f.done() or now > exp
+            ]:
+                fut_exp = self._client_pending.pop(rid)
+                if not fut_exp[0].done():
+                    fut_exp[0].set_exception(NotLeaderError(None))
+            if self.role == LEADER:
+                pass  # fall through to local submit below (re-locks)
+            else:
+                leader = self.leader_id
+                fut: Future = Future()
+                if leader is None:
+                    fut.set_exception(NotLeaderError(None))
+                    return fut
+                self._client_seq += 1
+                req_id = f"c:{self.node_id}:{self._client_seq}"
+                self._client_pending[req_id] = (fut, now + 60.0)
+                logger.debug(
+                    "%s forwarding client request %s to leader %s",
+                    self.node_id, req_id, leader,
+                )
+                self._send(leader, {
+                    "kind": "client_request",
+                    "term": self.current_term,
+                    "id": req_id,
+                    "command": command,
+                })
+                return fut
+        return self.submit(command)
+
+    def _on_client_request(self, sender_id: str, msg: dict) -> None:
+        """Leader side: run the forwarded command through the normal
+        submit path and ship the result (or NotLeaderError) back."""
+        req_id = msg["id"]
+
+        logger.debug(
+            "%s got client request %s from %s (role=%s)",
+            self.node_id, req_id, sender_id, self.role,
+        )
+
+        def reply(ok, value):
+            logger.debug(
+                "%s replying to %s for %s: ok=%s",
+                self.node_id, sender_id, req_id, ok,
+            )
+            self._send(sender_id, {
+                "kind": "client_reply",
+                "term": self.current_term,
+                "id": req_id,
+                "ok": ok,
+                "value": value,
+            })
+
+        if self.role != LEADER:
+            reply(False, self.leader_id)
+            return
+        inner = self.submit(msg["command"])
+
+        def done(f: Future):
+            try:
+                reply(True, f.result())
+            except Exception:
+                reply(False, self.leader_id)
+
+        inner.add_done_callback(done)
+
+    def _on_client_reply(self, msg: dict) -> None:
+        logger.debug(
+            "%s got client reply %s ok=%s", self.node_id, msg["id"],
+            msg.get("ok"),
+        )
+        entry = self._client_pending.pop(msg["id"], None)
+        if entry is None or entry[0].done():
+            return
+        fut = entry[0]
+        if msg["ok"]:
+            fut.set_result(msg["value"])
+        else:
+            fut.set_exception(NotLeaderError(msg["value"]))
+
     def tick(self, now: float) -> None:
         """Advance timers: follower/candidate election timeout, leader
         heartbeats."""
@@ -274,7 +380,11 @@ class RaftNode:
         msg = deserialize(payload)
         with self._lock:
             kind = msg["kind"]
-            if msg["term"] > self.current_term:
+            # prevote traffic advertises term+1 but must NOT depose anyone
+            # (that is the whole point of the prevote phase)
+            if kind not in ("prevote", "prevote_reply") and (
+                msg["term"] > self.current_term
+            ):
                 self._become_follower(msg["term"])
             if kind == "request_vote":
                 self._on_request_vote(sender_id, msg)
@@ -286,6 +396,14 @@ class RaftNode:
                 self._on_append_reply(sender_id, msg)
             elif kind == "install_snapshot":
                 self._install_snapshot(sender_id, msg)
+            elif kind == "client_request":
+                self._on_client_request(sender_id, msg)
+            elif kind == "client_reply":
+                self._on_client_reply(msg)
+            elif kind == "prevote":
+                self._on_prevote(sender_id, msg)
+            elif kind == "prevote_reply":
+                self._on_prevote_reply(sender_id, msg)
 
     # -- elections -----------------------------------------------------------
 
@@ -303,6 +421,29 @@ class RaftNode:
         self._reset_election_deadline()
 
     def _start_election(self) -> None:
+        """PreVote phase (Raft §9.6 / etcd preVote): before bumping the
+        term, ask peers whether an election COULD succeed. A rejoining
+        member whose peers still hear a live leader gets no pre-votes and
+        never inflates its term — without this, a member returning from a
+        partition/restart deposes a healthy leader in a term war (observed
+        as livelock in the OS-process cluster under load)."""
+        self._prevotes = {self.node_id}
+        self._reset_election_deadline()
+        if not self.peer_ids:
+            self._start_real_election()
+            return
+        for peer in self.peer_ids:
+            self._send(peer, {
+                "kind": "prevote", "term": self.current_term + 1,
+                "last_log_index": self.last_index(),
+                "last_log_term": self._term_at(self.last_index()),
+            })
+
+    def _start_real_election(self) -> None:
+        logger.info(
+            "%s starting election (term %d -> %d)",
+            self.node_id, self.current_term, self.current_term + 1,
+        )
         self.role = CANDIDATE
         self.current_term += 1
         self.voted_for = self.node_id
@@ -317,6 +458,46 @@ class RaftNode:
                 "last_log_term": self._term_at(self.last_index()),
             })
         self._maybe_win()
+
+    def _on_prevote(self, sender_id: str, msg: dict) -> None:
+        my_last_term = self._term_at(self.last_index())
+        up_to_date = (
+            msg["last_log_term"] > my_last_term
+            or (
+                msg["last_log_term"] == my_last_term
+                and msg["last_log_index"] >= self.last_index()
+            )
+        )
+        # refuse while a live leader is heard from: minimum election
+        # timeout since the last append (leader-stickiness check)
+        lo, _hi = self.ELECTION_TIMEOUT
+        leader_fresh = (
+            self.role == LEADER
+            or self._now - self._last_leader_contact < lo
+        )
+        grant = msg["term"] > self.current_term and up_to_date and not leader_fresh
+        self._send(sender_id, {
+            "kind": "prevote_reply", "term": self.current_term,
+            "granted": grant, "for_term": msg["term"],
+        })
+
+    def _on_prevote_reply(self, sender_id: str, msg: dict) -> None:
+        if self.role == LEADER or not msg.get("granted"):
+            return
+        if msg.get("for_term") != self.current_term + 1:
+            return  # stale grant from an abandoned prevote round
+        lo, _hi = self.ELECTION_TIMEOUT
+        if self._now - self._last_leader_contact < lo:
+            # the leader resurfaced while prevotes were in flight: abandon
+            # the round instead of deposing it (the race the prevote
+            # phase exists to close)
+            self._prevotes = set()
+            return
+        self._prevotes.add(sender_id)
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        if len(self._prevotes) >= quorum:
+            self._prevotes = set()
+            self._start_real_election()
 
     def _on_request_vote(self, sender_id: str, msg: dict) -> None:
         grant = False
@@ -348,6 +529,9 @@ class RaftNode:
     def _maybe_win(self) -> None:
         quorum = (len(self.peer_ids) + 1) // 2 + 1
         if self.role == CANDIDATE and len(self._votes) >= quorum:
+            logger.info(
+                "%s became leader (term %d)", self.node_id, self.current_term
+            )
             self.role = LEADER
             self.leader_id = self.node_id
             self.next_index = {p: self.last_index() + 1 for p in self.peer_ids}
@@ -390,6 +574,7 @@ class RaftNode:
             return
         self.role = FOLLOWER
         self.leader_id = sender_id
+        self._last_leader_contact = self._now
         self._reset_election_deadline()
         prev_index = msg["prev_index"]
         entries = list(msg["entries"])

@@ -19,12 +19,14 @@ opens its own session while plain sub-flows share their parent's.
 from __future__ import annotations
 
 import logging
+import threading
 import uuid
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.flows.api import (
+    AwaitBlocking,
     FlowException,
     FlowKilledException,
     FlowLogic,
@@ -104,6 +106,7 @@ class FlowStateMachine:
         self.waiting_session: Optional[str] = None
         self.waiting_expected_type: type = object
         self.waiting_tx: Optional[Any] = None
+        self.waiting_blocking = False  # parked on an await_blocking
         self.done = False
         self._gen = None
         # per-flow structured logger (reference: logger named
@@ -120,6 +123,16 @@ class FlowStateMachine:
         # never resurrect stale state after a mode flip.
         self._cp_header_written = False
         self._cp_io_written = 0
+        # sendAndReceiveWithRetry state: session local_id -> retry record
+        # (in-memory only; a flow restored from a checkpoint loses pending
+        # retries and surfaces the peer error instead — safe, just louder)
+        self._failover_retries: Dict[str, dict] = {}
+        # Serializes generator stepping + park/deliver decisions between
+        # the messaging pump and the blocking executor (await_blocking
+        # resumes on an executor thread; an unlocked check-then-park
+        # against deliver_data loses wakeups). RLock: deliveries cascade
+        # into _run on the same thread.
+        self._step_lock = threading.RLock()
 
     def next_subflow_ordinal(self) -> int:
         self._subflow_counter += 1
@@ -147,11 +160,15 @@ class FlowStateMachine:
         self._run(feed=None, first=True)
 
     def _run(self, feed=None, first=False, throw: Optional[BaseException] = None):
-        """Drive the generator until it completes or parks."""
+        """Drive the generator until it completes or parks. Holds the
+        step lock for the whole step so a concurrent delivery (pump
+        thread) cannot interleave with a check-then-park (executor
+        thread)."""
         from ..utils.flowcontext import running_flow
 
-        with running_flow(self.flow_id):
-            self._run_inner(feed, first, throw)
+        with self._step_lock:
+            with running_flow(self.flow_id):
+                self._run_inner(feed, first, throw)
 
     def _run_inner(self, feed, first, throw) -> None:
         try:
@@ -193,6 +210,21 @@ class FlowStateMachine:
         if isinstance(req, SendAndReceive):
             if not self.replaying:
                 self._io_send(req.party, req.payload, req.owner_name)
+                if req.retry_on_failover:
+                    # sendAndReceiveWithRetry (FlowLogic.kt:98-110): if the
+                    # peer service dies before answering, re-initiate and
+                    # resend instead of failing the flow — the client-side
+                    # failover notary clusters rely on
+                    sid = self.session_keys.get(
+                        self._session_key(req.party, req.owner_name)
+                    )
+                    if sid is not None:
+                        self._failover_retries[sid] = {
+                            "party": req.party,
+                            "payload": serialize(req.payload),
+                            "owner": req.owner_name,
+                            "attempts": 3,
+                        }
             return self._io_receive(req.party, req.expected_type, req.owner_name)
         if isinstance(req, Receive):
             # An initiating receive must still open the session.
@@ -203,7 +235,41 @@ class FlowStateMachine:
             return self._io_wait_ledger(req.tx_id)
         if isinstance(req, RecordValue):
             return self._io_record(req)
+        if isinstance(req, AwaitBlocking):
+            return self._io_await_blocking(req)
         raise TypeError(f"flow yielded a non-FlowIORequest: {req!r}")
+
+    def _io_await_blocking(self, req: AwaitBlocking):
+        if self.replaying:
+            blob = self.io_log[self.replay_pos]
+            self.replay_pos += 1
+            return deserialize(blob)
+        executor = self.smm._blocking_executor
+        if executor is None:
+            # deterministic in-memory network: run inline (tests pump
+            # synchronously; blocking the pump is harmless in-process)
+            value = req.compute()
+            self.io_log.append(serialize(value))
+            self._checkpoint()
+            return value
+
+        def work():
+            try:
+                value = req.compute()
+            except BaseException as exc:
+                self.smm._resume_from_blocking(self, error=exc)
+            else:
+                self.smm._resume_from_blocking(self, value=value)
+
+        self.waiting_blocking = True
+        self._checkpoint()
+        try:
+            executor.submit(work)
+        except RuntimeError:
+            # node stopping: leave the flow parked; the checkpoint
+            # restores and re-executes the computation after restart
+            pass
+        raise _Suspended()
 
     def _io_record(self, req: RecordValue):
         if self.replaying:
@@ -306,6 +372,7 @@ class FlowStateMachine:
         if sess.recv_seq in sess.inbox:
             blob = sess.inbox.pop(sess.recv_seq)
             sess.recv_seq += 1
+            self._failover_retries.pop(sess.local_id, None)
             value = deserialize(blob)
             self._check_type(value, expected_type, party)
             self.io_log.append(blob)
@@ -346,6 +413,10 @@ class FlowStateMachine:
 
     def deliver_data(self, sess: FlowSession) -> None:
         """Called when new data arrived for a session; resumes if parked on it."""
+        with self._step_lock:
+            self._deliver_data_locked(sess)
+
+    def _deliver_data_locked(self, sess: FlowSession) -> None:
         if self.done or self.waiting_session != sess.local_id:
             return
         if sess.recv_seq not in sess.inbox:
@@ -353,6 +424,8 @@ class FlowStateMachine:
         blob = sess.inbox.pop(sess.recv_seq)
         sess.recv_seq += 1
         self.waiting_session = None
+        # reply arrived: a later session end must not replay the request
+        self._failover_retries.pop(sess.local_id, None)
         try:
             value = deserialize(blob)
             self._check_type(value, self.waiting_expected_type, sess.peer)
@@ -364,11 +437,38 @@ class FlowStateMachine:
         self._run(feed=value)
 
     def deliver_session_end(self, sess: FlowSession) -> None:
+        with self._step_lock:
+            self._deliver_session_end_locked(sess)
+
+    def _deliver_session_end_locked(self, sess: FlowSession) -> None:
         if self.done or self.waiting_session != sess.local_id:
             return
         # If buffered data can still satisfy the receive, let it.
         if sess.recv_seq in sess.inbox:
-            self.deliver_data(sess)
+            self._deliver_data_locked(sess)
+            return
+        retry = self._failover_retries.pop(sess.local_id, None)
+        if retry is not None and retry["attempts"] > 0:
+            # retry-marked request: the counter-service died before
+            # answering — open a FRESH session resending the SAME payload
+            # (notary requests are idempotent per tx, so a commit that
+            # landed before the crash simply re-acks) and stay parked.
+            retry["attempts"] -= 1
+            self.logger.warning(
+                "session with %s ended before reply (%s); failover retry "
+                "(%d attempts left)",
+                sess.peer.name, sess.end_error, retry["attempts"],
+            )
+            key = self._session_key(retry["party"], retry["owner"])
+            if self.session_keys.get(key) == sess.local_id:
+                del self.session_keys[key]
+            new_sess = self._session_for(
+                retry["party"], retry["owner"],
+                first_payload=retry["payload"],
+            )
+            self._failover_retries[new_sess.local_id] = retry
+            self.waiting_session = new_sess.local_id
+            self._checkpoint()
             return
         self.waiting_session = None
         self._run(throw=self._peer_end_exception(sess))
@@ -384,6 +484,10 @@ class FlowStateMachine:
         )
 
     def deliver_ledger_commit(self, stx) -> None:
+        with self._step_lock:
+            self._deliver_ledger_commit_locked(stx)
+
+    def _deliver_ledger_commit_locked(self, stx) -> None:
         if self.done or self.waiting_tx is None:
             return
         self.waiting_tx = None
@@ -507,6 +611,19 @@ class StateMachineManager:
         self._sessions: Dict[str, FlowStateMachine] = {}  # local session id -> fsm
         self._initiated_dedup: Dict[Tuple[str, str], str] = {}  # (peer, init_id) -> local id
         self._ledger_waiters: Dict[Any, List[FlowStateMachine]] = {}
+        # Executor for FlowLogic.await_blocking computations (cluster
+        # notary commits etc.): a flow body blocking minutes on the P2P
+        # pump thread starves the very messages it waits for (observed as
+        # a 30 s Raft-commit livelock on OS-process notary members). The
+        # deterministic in-memory network (no ASYNC_FLOW_DISPATCH attr)
+        # runs these computations inline so tests stay pump-synchronous.
+        self._blocking_executor = None
+        if getattr(messaging, "ASYNC_FLOW_DISPATCH", False):
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._blocking_executor = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="flow-blocking"
+            )
         self.checkpoints_written = 0
         # Key metric names mirror the reference (StateMachineManager.kt:127-133)
         self.metrics = (
@@ -634,6 +751,12 @@ class StateMachineManager:
     # -- session message routing --------------------------------------------
 
     def _on_session_message(self, sender: Party, payload: bytes) -> None:
+        """Runs INLINE on the messaging pump: the broker acks a message
+        only after its handler returns, so processing must complete here
+        for at-least-once delivery (an executor hand-off acked messages
+        before flows ran — lost on crash). Long blocking work inside a
+        flow goes through `FlowLogic.await_blocking`, which parks the
+        flow and runs the work off-pump instead."""
         msg = deserialize(payload)
         if isinstance(msg, SessionInit):
             self._on_init(sender, msg)
@@ -751,6 +874,22 @@ class StateMachineManager:
         fsm.deliver_session_end(sess)
 
     # -- internals ----------------------------------------------------------
+
+    def _resume_from_blocking(self, fsm: FlowStateMachine, value=None,
+                              error=None) -> None:
+        """Continuation for FlowLogic.await_blocking: runs on the blocking
+        executor thread (not the pump); records the result for replay,
+        then steps the flow."""
+        with fsm._step_lock:
+            if fsm.done or not fsm.waiting_blocking:
+                return
+            fsm.waiting_blocking = False
+            if error is not None:
+                fsm._run(throw=error)
+                return
+            fsm.io_log.append(serialize(value))
+            fsm._checkpoint()
+            fsm._run(feed=value)
 
     def _register_session(self, local_id: str, fsm: FlowStateMachine) -> None:
         self._sessions[local_id] = fsm

@@ -38,7 +38,16 @@ class _Proxy:
             raise AttributeError(name)
 
         def call(*args):
-            return self._connection._call(name, args)
+            # blocking waits (flow_result(fid, timeout)) must outlive the
+            # transport's default reply timeout
+            timeout = None
+            if (
+                name == "flow_result"
+                and len(args) >= 2
+                and isinstance(args[1], (int, float))
+            ):
+                timeout = float(args[1]) + 5.0
+            return self._connection._call(name, args, timeout=timeout)
 
         return call
 
@@ -49,14 +58,14 @@ class CordaRPCConnection:
         self.session = session
         self.proxy = _Proxy(self)
 
-    def _call(self, method: str, args) -> Any:
+    def _call(self, method: str, args, timeout: float = None) -> Any:
         reply = self._client._request({
             "kind": "call",
             "id": str(uuid.uuid4()),
             "session": self.session,
             "method": method,
             "args": list(args),
-        })
+        }, timeout=timeout)
         return self._client._unmarshal(reply)
 
     def close(self) -> None:
@@ -107,12 +116,14 @@ class CordaRPCClient:
         request["reply_to"] = self._reply_queue
         self.broker.send(RPC_SERVER_QUEUE, serialize(request))
 
-    def _request(self, request: dict) -> Any:
+    def _request(self, request: dict, timeout: float = None) -> Any:
         fut: Future = Future()
         with self._lock:
             self._pending[request["id"]] = fut
         self._send(request)
-        reply = fut.result(timeout=self.timeout)
+        reply = fut.result(
+            timeout=self.timeout if timeout is None else timeout
+        )
         if "error" in reply:
             err = reply["error"]
             if isinstance(err, str) and err.startswith("PERMISSION:"):

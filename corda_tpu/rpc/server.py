@@ -44,9 +44,21 @@ class RPCServer:
         }
         self._sessions: Dict[str, RPCUser] = {}
         self._subscriptions: Dict[str, Subscription] = {}
+        # _handle runs on pool threads: session/subscription maps need a
+        # lock (logout's iteration vs a concurrent subscribe would raise
+        # and silently leak the session's observables)
+        self._state_lock = threading.Lock()
         broker.create_queue(RPC_SERVER_QUEUE)
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(RPC_SERVER_QUEUE)
+        # Calls run on a pool: a blocking op (flow_result waiting a minute
+        # on a stalled notary) must not wedge every other client's RPCs
+        # behind it on the single consume thread.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="rpc-worker"
+        )
         from ..utils.profiling import maybe_profiled
 
         self._thread = threading.Thread(
@@ -74,10 +86,16 @@ class RPCServer:
                 )
                 self._consumer.ack(msg)
                 continue
+            def run(req=request):
+                try:
+                    self._handle(req)
+                except Exception:
+                    pass  # a bad request must not kill the server
+
             try:
-                self._handle(request)
-            except Exception:
-                pass  # a bad request must not kill the server loop
+                self._pool.submit(run)
+            except RuntimeError:
+                pass  # pool shut down: server stopping
             self._consumer.ack(msg)
 
     def _reply(self, reply_to: str, payload: dict) -> None:
@@ -111,15 +129,25 @@ class RPCServer:
         elif kind == "call":
             self._handle_call(request)
         elif kind == "unsubscribe":
-            sub = self._subscriptions.pop(request["obs_id"], None)
+            with self._state_lock:
+                sub = self._subscriptions.pop(request["obs_id"], None)
             if sub is not None:
                 sub.unsubscribe()
         elif kind == "logout":
-            self._sessions.pop(request.get("session", ""), None)
-            # Drop this session's subscriptions (observable GC on disconnect).
-            prefix = request.get("session", "") + "/"
-            for obs_id in [k for k in self._subscriptions if k.startswith(prefix)]:
-                self._subscriptions.pop(obs_id).unsubscribe()
+            with self._state_lock:
+                self._sessions.pop(request.get("session", ""), None)
+                # Drop this session's subscriptions (observable GC on
+                # disconnect).
+                prefix = request.get("session", "") + "/"
+                dropped = [
+                    self._subscriptions.pop(obs_id)
+                    for obs_id in [
+                        k for k in self._subscriptions
+                        if k.startswith(prefix)
+                    ]
+                ]
+            for sub in dropped:
+                sub.unsubscribe()
 
     def _handle_login(self, request: dict) -> None:
         user = self.users.get(request.get("user", ""))
@@ -130,7 +158,8 @@ class RPCServer:
             })
             return
         session = str(uuid.uuid4())
-        self._sessions[session] = user
+        with self._state_lock:
+            self._sessions[session] = user
         self._reply(request["reply_to"], {
             "kind": "reply", "id": request["id"], "ok": session,
         })
@@ -223,13 +252,17 @@ class RPCServer:
                 "kind": "observation", "obs_id": obs_id, "value": value,
             })
 
-        self._subscriptions[obs_id] = obs.subscribe(forward)
+        with self._state_lock:
+            self._subscriptions[obs_id] = obs.subscribe(forward)
         return obs_id
 
     def stop(self) -> None:
         self._stop.set()
-        for sub in self._subscriptions.values():
+        with self._state_lock:
+            subs = list(self._subscriptions.values())
+            self._subscriptions.clear()
+        for sub in subs:
             sub.unsubscribe()
-        self._subscriptions.clear()
         self._consumer.close()
         self._thread.join(timeout=2)
+        self._pool.shutdown(wait=False, cancel_futures=True)

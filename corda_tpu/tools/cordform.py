@@ -19,6 +19,7 @@ Descriptor example (see samples' deploy specs):
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import stat
@@ -45,10 +46,65 @@ def _dir_name(legal_name: str) -> str:
     return legal_name.replace(" ", "")
 
 
+def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
+    """A node entry with notary "raft-validating"/"raft-simple" and
+    "cluster_size": N expands into N member nodes sharing a raft_cluster
+    block (reference: cordformation's NotaryCluster DSL +
+    ServiceIdentityGenerator run at deploy time). Member identities use
+    deterministic entropies so every member derives the same composite
+    cluster identity locally."""
+    out: List[Dict] = []
+    for n in nodes:
+        notary = n.get("notary", "")
+        if not (isinstance(notary, str) and notary.startswith("raft")):
+            out.append(n)
+            continue
+        # a raft notary ALWAYS expands (a missing/1 cluster_size becomes a
+        # single-member cluster) — passing the entry through unexpanded
+        # would materialise a node that dies at boot for want of a
+        # raft_cluster block
+        size = max(1, int(n.get("cluster_size", 1) or 1))
+        cluster_name = n["name"]
+        # default entropy base derives from the CLUSTER NAME: two clusters
+        # in one spec must not share member keypairs (identical composite
+        # identities under different names would break signature
+        # attribution)
+        default_base = 880_000 + (
+            int.from_bytes(
+                hashlib.sha256(cluster_name.encode()).digest()[:4], "big"
+            )
+            << 8
+        )
+        base_entropy = int(n.get("cluster_entropy_base", default_base))
+        members = []
+        for i in range(size):
+            parts = [p.strip() for p in cluster_name.split(",")]
+            parts = [
+                f"O={p[2:]} {i}" if p.startswith("O=") else p for p in parts
+            ]
+            members.append(
+                {"name": ",".join(parts), "entropy": base_entropy + i}
+            )
+        for i, member in enumerate(members):
+            entry = {
+                k: v for k, v in n.items()
+                if k not in ("name", "cluster_size", "cluster_entropy_base")
+            }
+            entry["name"] = member["name"]
+            entry["identity_entropy"] = member["entropy"]
+            entry["raft_cluster"] = {
+                "name": cluster_name,
+                "index": i,
+                "members": members,
+            }
+            out.append(entry)
+    return out
+
+
 def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
     """Materialise the descriptor under out_dir; returns the resolved
     per-node configs (with allocated ports and network-map wiring)."""
-    nodes = spec.get("nodes", [])
+    nodes = _expand_raft_clusters(spec.get("nodes", []))
     if not nodes:
         raise ValueError("descriptor has no nodes")
     os.makedirs(out_dir, exist_ok=True)
@@ -77,6 +133,10 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
         }
         if n.get("notary"):
             conf["notary_type"] = n["notary"]
+        if n.get("identity_entropy") is not None:
+            conf["identity_entropy"] = n["identity_entropy"]
+        if n.get("raft_cluster"):
+            conf["raft_cluster"] = n["raft_cluster"]
         if spec.get("tls"):
             conf["tls"] = True
             conf["certificates_dir"] = shared_certs

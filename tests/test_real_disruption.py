@@ -224,3 +224,83 @@ class TestRealProcessDisruptions:
         finally:
             for n in nodes:
                 n.close()
+
+
+@pytest.mark.slow
+class TestRaftNotaryClusterProcesses:
+    """A 3-member Raft VALIDATING notary cluster as real OS processes
+    (reference: the raft notary-demo cluster; Disruption.kt fired at a
+    distributed notary). Raft traffic rides the nodes' P2P bridges; the
+    cluster presents one composite identity; killing a minority member
+    mid-run must not stop notarisation or lose anything."""
+
+    def test_cluster_notarises_and_survives_member_kill(self):
+        from corda_tpu.testing.smoketesting import Factory
+        from corda_tpu.tools.cordform import deploy_nodes
+
+        base = tempfile.mkdtemp(prefix="raft-real-")
+        spec = {
+            "nodes": [
+                {"name": "O=RaftNotary,L=Zurich,C=CH",
+                 "notary": "raft-validating", "cluster_size": 3,
+                 "network_map_service": True},
+                {"name": "O=RaftBankA,L=London,C=GB"},
+                {"name": "O=RaftBankB,L=Paris,C=FR"},
+            ]
+        }
+        resolved = deploy_nodes(spec, base)
+        assert len(resolved) == 5  # 3 members + 2 banks
+        factory = Factory(base)
+        nodes = [factory.launch(conf["dir"]) for conf in resolved]
+        try:
+            conn = nodes[3].connect()
+            try:
+                me = conn.proxy.node_info()
+                notaries = conn.proxy.notary_identities()
+                # exactly ONE notary: the cluster identity, not 3 members
+                assert len(notaries) == 1, [n.name for n in notaries]
+                cluster = notaries[0]
+                assert cluster.name == "O=RaftNotary,L=Zurich,C=CH"
+            finally:
+                conn.close()
+            conn_b = nodes[4].connect()
+            try:
+                peer = conn_b.proxy.node_info()
+            finally:
+                conn_b.close()
+
+            driver = _Driver(nodes[3], cluster, me, peer).start()
+            deadline = time.monotonic() + 120
+            while len(driver.completed) < 3:
+                assert time.monotonic() < deadline, (
+                    f"cluster never notarised: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+
+            # kill a MINORITY member (not the last-registered one that
+            # holds the cluster route): quorum 2/3 survives, the serving
+            # member forwards commits to the re-elected leader
+            nodes[0].kill()
+            before = len(driver.completed)
+            deadline = time.monotonic() + 120
+            while len(driver.completed) < before + 3:
+                assert time.monotonic() < deadline, (
+                    f"no progress after member kill: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+            driver.stop()
+            _assert_no_loss_no_dup(driver, nodes[4])
+
+            # heal: the killed member restores its replicated uniqueness
+            # log (snapshot/backfill) and rejoins
+            nodes[0] = factory.launch(resolved[0]["dir"])
+            driver2 = _Driver(nodes[3], cluster, me, peer).start()
+            deadline = time.monotonic() + 120
+            while len(driver2.completed) < 2:
+                assert time.monotonic() < deadline, driver2.errors[-3:]
+                time.sleep(0.3)
+            driver2.stop()
+            _assert_no_loss_no_dup(driver2, nodes[4])
+        finally:
+            for n in nodes:
+                n.close()
