@@ -160,6 +160,9 @@ def parse_argument(text: str, identity_lookup: Optional[Callable] = None) -> Any
         if party is None:
             raise ValueError(f"unknown party {text!r}")
         return party
+    if re.fullmatch(r"0x(?:[0-9A-Fa-f]{2})+", text):
+        # hex literal -> bytes (OpaqueBytes-style args, e.g. issuer refs)
+        return bytes.fromhex(text[2:])
     if re.fullmatch(r"-?\d+", text):
         return int(text)
     if re.fullmatch(r"-?\d+\.\d+", text):

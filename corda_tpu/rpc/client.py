@@ -37,17 +37,19 @@ class _Proxy:
         if name.startswith("_"):
             raise AttributeError(name)
 
-        def call(*args):
+        def call(*args, **kwargs):
             # blocking waits (flow_result(fid, timeout)) must outlive the
-            # transport's default reply timeout
+            # transport's default reply timeout — positional or keyword
             timeout = None
-            if (
-                name == "flow_result"
-                and len(args) >= 2
-                and isinstance(args[1], (int, float))
-            ):
-                timeout = float(args[1]) + 5.0
-            return self._connection._call(name, args, timeout=timeout)
+            if name == "flow_result":
+                wait = kwargs.get("timeout")
+                if wait is None and len(args) >= 2:
+                    wait = args[1]
+                if isinstance(wait, (int, float)):
+                    timeout = float(wait) + 5.0
+            return self._connection._call(
+                name, args, kwargs=kwargs, timeout=timeout
+            )
 
         return call
 
@@ -58,14 +60,18 @@ class CordaRPCConnection:
         self.session = session
         self.proxy = _Proxy(self)
 
-    def _call(self, method: str, args, timeout: float = None) -> Any:
-        reply = self._client._request({
+    def _call(self, method: str, args, kwargs=None,
+              timeout: float = None) -> Any:
+        request = {
             "kind": "call",
             "id": str(uuid.uuid4()),
             "session": self.session,
             "method": method,
             "args": list(args),
-        }, timeout=timeout)
+        }
+        if kwargs:
+            request["kwargs"] = dict(kwargs)
+        reply = self._client._request(request, timeout=timeout)
         return self._client._unmarshal(reply)
 
     def close(self) -> None:

@@ -117,6 +117,15 @@ class CordaRPCOps:
             raise ValueError(f"unknown flow id {flow_id}")
         return fsm.result.result(timeout=timeout)
 
+    def flow_result_future(self, flow_id: str):
+        """The flow's completion Future — internal: the RPC server uses
+        a done-callback on it so long flow_result waits never occupy a
+        server worker thread (head-of-line blocking under bursts)."""
+        fsm = self._smm.flows.get(flow_id)
+        if fsm is None:
+            raise ValueError(f"unknown flow id {flow_id}")
+        return fsm.result
+
     def state_machines_feed(self) -> DataFeed:
         snapshot = [
             StateMachineInfo(f.flow_id, f.flow.flow_name(), f.done)

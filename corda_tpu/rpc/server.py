@@ -199,13 +199,22 @@ class RPCServer:
                 "error": f"PERMISSION:{method_name} not permitted for {user.username}",
             })
             return
+        kwargs = dict(request.get("kwargs") or {})
+        if method_name == "flow_result" and hasattr(
+            self.ops, "flow_result_future"
+        ):
+            # reply from the flow's own completion callback: a burst of
+            # long flow_result waits must not pin every pool worker and
+            # starve other clients (head-of-line blocking)
+            if self._handle_flow_result_async(req_id, reply_to, args, kwargs):
+                return
         smm = getattr(self.ops, "_smm", None)
         timer = (
             smm.metrics.timer(f"RPC.{method_name}") if smm is not None else None
         )
         t0 = time.perf_counter()
         try:
-            result = getattr(self.ops, method_name)(*args)
+            result = getattr(self.ops, method_name)(*args, **kwargs)
         except Exception as exc:
             self._reply(reply_to, {
                 "kind": "reply", "id": req_id,
@@ -219,6 +228,44 @@ class RPCServer:
             "kind": "reply", "id": req_id,
             "ok": self._marshal(result, request.get("session", ""), reply_to),
         })
+
+    def _handle_flow_result_async(self, req_id, reply_to, args, kwargs) -> bool:
+        """Wire flow_result onto the flow future's done-callback plus a
+        timeout timer; returns True when the reply will be sent
+        asynchronously (False = fall through to the synchronous path,
+        e.g. unknown flow id errors surface immediately)."""
+        try:
+            fut = self.ops.flow_result_future(args[0])
+        except Exception:
+            return False  # sync path raises the proper error reply
+        timeout = kwargs.get("timeout")
+        if timeout is None and len(args) >= 2:
+            timeout = args[1]
+        replied = threading.Event()
+
+        def reply_once(payload: dict) -> None:
+            if replied.is_set():
+                return
+            replied.set()
+            self._reply(reply_to, {"kind": "reply", "id": req_id, **payload})
+
+        def on_done(f):
+            timer.cancel()
+            try:
+                result = f.result()
+            except Exception as exc:
+                reply_once({"error": f"{type(exc).__name__}: {exc}"})
+                return
+            reply_once({"ok": self._marshal(result, "", reply_to)})
+
+        timer = threading.Timer(
+            float(timeout) if timeout is not None else 3600.0,
+            lambda: reply_once({"error": "TimeoutError: flow result wait"}),
+        )
+        timer.daemon = True
+        timer.start()
+        fut.add_done_callback(on_done)
+        return True
 
     # -- observable marshalling ----------------------------------------------
 

@@ -88,7 +88,12 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
         for i, member in enumerate(members):
             entry = {
                 k: v for k, v in n.items()
-                if k not in ("name", "cluster_size", "cluster_entropy_base")
+                # per-node resources must NOT be cloned across members: a
+                # pinned broker_port would collide on every member but one
+                if k not in (
+                    "name", "cluster_size", "cluster_entropy_base",
+                    "broker_port", "web",
+                )
             }
             entry["name"] = member["name"]
             entry["identity_entropy"] = member["entropy"]

@@ -1,0 +1,49 @@
+"""Webserver plugin extension point (reference
+`webserver/src/main/kotlin/net/corda/webserver/services/WebServerPluginRegistry.kt`:
+CorDapps contribute `webApis` (JAX-RS resources) and `staticServeDirs`;
+the webserver mounts them next to the built-in API).
+
+TPU-build shape: a plugin exposes
+  * `web_apis()` -> {prefix: handler} where handler(ops, method, subpath,
+    params, body) returns (status_code, jsonable) and is mounted at
+    `/api/<prefix>/...`;
+  * `static_serve_dirs()` -> {prefix: directory} served read-only at
+    `/web/<prefix>/...` (path-traversal hardened).
+
+CorDapp modules call `register_web_plugin(...)` at import time — the same
+moment their flows register — so a node's `cordapps` config lights up
+both RPC flows and web endpoints (reference: plugins discovered via
+ServiceLoader from the CorDapp jars).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+Handler = Callable[..., tuple]
+
+
+class WebServerPlugin:
+    """Subclass (or duck-type) and register; both hooks are optional."""
+
+    def web_apis(self) -> Dict[str, Handler]:
+        return {}
+
+    def static_serve_dirs(self) -> Dict[str, str]:
+        return {}
+
+
+_REGISTRY: List[WebServerPlugin] = []
+
+
+def register_web_plugin(plugin: WebServerPlugin) -> None:
+    if plugin not in _REGISTRY:
+        _REGISTRY.append(plugin)
+
+
+def registered_plugins() -> List[WebServerPlugin]:
+    return list(_REGISTRY)
+
+
+def clear_web_plugins() -> None:
+    """Test hook."""
+    _REGISTRY.clear()

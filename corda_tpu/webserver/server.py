@@ -154,8 +154,56 @@ class WebServer:
                 req._json(200, {"result": result})
             except Exception as exc:
                 req._json(500, {"error": str(exc)})
+        elif self._try_plugins(req, "GET", path, params, None):
+            pass
         else:
             req._json(404, {"error": f"no route {path}"})
+
+    def _try_plugins(self, req, method: str, path: str, params, body) -> bool:
+        """Mounted plugin APIs (/api/<prefix>/...) and static dirs
+        (/web/<prefix>/...) — the WebServerPluginRegistry extension
+        point. Returns True when a plugin handled the request."""
+        from .plugins import registered_plugins
+
+        for plugin in registered_plugins():
+            for prefix, handler in plugin.web_apis().items():
+                mount = f"/api/{prefix}"
+                if path == mount or path.startswith(mount + "/"):
+                    subpath = path[len(mount):].lstrip("/")
+                    code, value = handler(
+                        self.ops, method, subpath, params, body
+                    )
+                    req._json(code, value)
+                    return True
+            if method != "GET":
+                continue
+            for prefix, directory in plugin.static_serve_dirs().items():
+                mount = f"/web/{prefix}/"
+                if not path.startswith(mount):
+                    continue
+                import mimetypes
+                import os
+
+                root = os.path.realpath(directory)
+                target = os.path.realpath(
+                    os.path.join(root, path[len(mount):])
+                )
+                # traversal hardening: the resolved path must stay inside
+                if not (target == root or target.startswith(root + os.sep)):
+                    req._json(403, {"error": "forbidden"})
+                    return True
+                if not os.path.isfile(target):
+                    req._json(404, {"error": "no such file"})
+                    return True
+                with open(target, "rb") as fh:
+                    data = fh.read()
+                ctype = (
+                    mimetypes.guess_type(target)[0]
+                    or "application/octet-stream"
+                )
+                req._send(200, data, ctype)
+                return True
+        return False
 
     def _post(self, req) -> None:
         length = int(req.headers.get("Content-Length", 0))
@@ -186,6 +234,8 @@ class WebServer:
             else:
                 flow_id = self.ops.start_flow_dynamic(m.group(1), *args)
             req._json(200, {"flow_id": flow_id})
+        elif self._try_plugins(req, "POST", path, {}, body):
+            pass
         else:
             req._json(404, {"error": f"no route {path}"})
 

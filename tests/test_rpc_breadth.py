@@ -246,3 +246,78 @@ class TestWebserverStreamFailure:
         finally:
             web.stop()
             net.stop_nodes()
+
+
+class TestWebServerPlugins:
+    """WebServerPluginRegistry analogue: CorDapp-contributed REST routes
+    and static dirs mount next to the built-in API (reference
+    webserver/services/WebServerPluginRegistry.kt)."""
+
+    def test_plugin_api_and_static_mounts(self, tmp_path):
+        import json as _json
+
+        from corda_tpu.webserver import WebServer
+        from corda_tpu.webserver.plugins import (
+            WebServerPlugin,
+            clear_web_plugins,
+            register_web_plugin,
+        )
+
+        (tmp_path / "index.html").write_text("<h1>cordapp ui</h1>")
+
+        class DemoPlugin(WebServerPlugin):
+            def web_apis(self):
+                def rates(ops, method, subpath, params, body):
+                    if method == "POST":
+                        return 200, {"posted": body.decode()}
+                    return 200, {"pair": subpath, "rate": 1.25,
+                                 "who": ops.node_info().name}
+
+                return {"demo": rates}
+
+            def static_serve_dirs(self):
+                return {"demoui": str(tmp_path)}
+
+        clear_web_plugins()
+        register_web_plugin(DemoPlugin())
+        net = MockNetwork()
+        node = net.create_node("O=Plug,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        web = WebServer(ops, port=0)
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            with urllib.request.urlopen(f"{base}/api/demo/USDGBP",
+                                        timeout=10) as r:
+                body = _json.loads(r.read())
+            assert body["pair"] == "USDGBP" and body["rate"] == 1.25
+            assert "O=Plug" in body["who"]
+
+            req = urllib.request.Request(
+                f"{base}/api/demo", data=b"hello", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert _json.loads(r.read())["posted"] == "hello"
+
+            with urllib.request.urlopen(
+                f"{base}/web/demoui/index.html", timeout=10
+            ) as r:
+                assert b"cordapp ui" in r.read()
+                assert r.headers["Content-Type"].startswith("text/html")
+
+            # traversal must be refused
+            from urllib.error import HTTPError
+
+            with pytest.raises(HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"{base}/web/demoui/..%2f..%2fetc%2fpasswd", timeout=10
+                )
+            assert exc.value.code in (403, 404)
+
+            # unknown routes still 404
+            with pytest.raises(HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/api/nope", timeout=10)
+            assert exc.value.code == 404
+        finally:
+            web.stop()
+            net.stop_nodes()
+            clear_web_plugins()
