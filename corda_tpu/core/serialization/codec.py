@@ -299,15 +299,61 @@ def _decode(
     raise SerializationError(f"unknown tag {tag}")
 
 
+# --- native acceleration ----------------------------------------------------
+#
+# The C extension (native/src/codec_ext.c) implements the same grammar
+# byte-for-byte; primitives and containers stay in C, registered types
+# cross this boundary once each way. Consensus-critical parity is pinned
+# by the differential fuzz in tests/test_serialization.py. Set
+# CORDA_TPU_NATIVE_CODEC=0 to force the pure-Python paths.
+
+_native_codec = None
+if __import__("os").environ.get("CORDA_TPU_NATIVE_CODEC", "1") != "0":
+    try:
+        from ... import native as _native_pkg
+
+        _native_codec = _native_pkg.codec_extension()
+        if _native_codec is not None:
+            _native_codec.set_error(SerializationError)
+    except Exception:
+        _native_codec = None
+
+
+def _native_lookup(value):
+    """encode-side callback: value -> (type_name, fields dict) | None."""
+    entry = _lookup_type(type(value))
+    if entry is None:
+        return None
+    return entry[0], entry[1](value)
+
+
+def _native_construct(type_name: str, fields: dict):
+    """decode-side callback: strict whitelist construction (the obj_hook
+    seam stays on the Python decoder — evolution passes obj_hook)."""
+    entry = _BY_NAME.get(type_name)
+    if entry is None:
+        raise SerializationError(
+            f"type {type_name!r} not in deserialization whitelist"
+        )
+    try:
+        return entry[2](fields)
+    except TypeError as e:
+        raise SerializationError(f"cannot construct {type_name}: {e}") from e
+
+
 # --- public api -------------------------------------------------------------
 
 def serialize(value: Any) -> bytes:
+    if _native_codec is not None:
+        return _native_codec.encode(value, _native_lookup, _MAGIC)
     out = bytearray(_MAGIC)
     _encode(out, value)
     return bytes(out)
 
 
 def deserialize(data: bytes) -> Any:
+    if _native_codec is not None:
+        return _native_codec.decode(data, _native_construct, _MAGIC)
     if data[: len(_MAGIC)] != _MAGIC:
         raise SerializationError("bad magic / unsupported format version")
     value, pos = _decode(data, len(_MAGIC))

@@ -28,6 +28,42 @@ _lib_lock = threading.Lock()
 _load_failed = False
 
 
+def _build_if_stale(sources, so_path, cmd_prefix) -> None:
+    """Compile `sources` into so_path when missing or stale.
+
+    Staleness by source hash, not mtime: git checkout does not preserve
+    mtimes, so a stale binary could otherwise survive a fresh clone.
+    (build/ is gitignored; the .so is never shipped.)  The compile
+    target is per-PID and atomically renamed: many node processes cold-
+    starting at once (cordform networks) must not interleave writes into
+    one tmp file and install a corrupt ELF."""
+    import hashlib
+
+    stamp_path = so_path + ".srchash"
+    os.makedirs(_BUILD, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as fh:
+            h.update(fh.read())
+    src_hash = h.hexdigest()
+    stamp = None
+    if os.path.exists(stamp_path):
+        with open(stamp_path) as fh:
+            stamp = fh.read().strip()
+    if os.path.exists(so_path) and stamp == src_hash:
+        return
+    tmp = f"{so_path}.{os.getpid()}.tmp"
+    try:
+        cmd = [*cmd_prefix, "-o", tmp, *sources]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+        with open(stamp_path, "w") as fh:
+            fh.write(src_hash)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def _compile_and_load() -> Optional[ctypes.CDLL]:
     global _load_failed
     sources = [
@@ -35,33 +71,11 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         os.path.join(_SRC, "journal.cpp"),
     ]
     so_path = os.path.join(_BUILD, "corda_native.so")
-    stamp_path = so_path + ".srchash"
     try:
-        os.makedirs(_BUILD, exist_ok=True)
-        # Staleness by source hash, not mtime: git checkout does not
-        # preserve mtimes, so a stale binary could otherwise survive a
-        # fresh clone.  (The build/ dir is gitignored; the .so is never
-        # shipped, always compiled from source on first use.)
-        import hashlib
-
-        h = hashlib.sha256()
-        for s in sources:
-            with open(s, "rb") as fh:
-                h.update(fh.read())
-        src_hash = h.hexdigest()
-        stamp = None
-        if os.path.exists(stamp_path):
-            with open(stamp_path) as fh:
-                stamp = fh.read().strip()
-        if not os.path.exists(so_path) or stamp != src_hash:
-            cmd = [
-                "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                "-o", so_path + ".tmp", *sources,
-            ]
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(so_path + ".tmp", so_path)
-            with open(stamp_path, "w") as fh:
-                fh.write(src_hash)
+        _build_if_stale(
+            sources, so_path,
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"],
+        )
         lib = ctypes.CDLL(so_path)
         lib.sha256_batch.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
@@ -247,3 +261,48 @@ class NativeJournal:
             (types[i], data[starts[i]:starts[i] + lens[i]])
             for i in range(count)
         ]
+
+
+# --- native codec extension (CPython C API, separate .so) -------------------
+#
+# Unlike the ctypes library above, the codec manipulates PyObjects, so it
+# builds as a REAL extension module (needs Python.h) and is imported via
+# importlib from the build dir. Same srchash staleness, same graceful
+# degradation: codec.py falls back to the pure-Python paths when the
+# compiler or headers are missing.
+
+_codec_mod = None
+_codec_failed = False
+
+
+def _compile_and_import_codec():
+    global _codec_failed
+    import importlib.util
+    import sysconfig
+
+    src = os.path.join(_SRC, "codec_ext.c")
+    so_path = os.path.join(_BUILD, "codec_ext.so")
+    try:
+        _build_if_stale(
+            [src], so_path,
+            ["gcc", "-O2", "-shared", "-fPIC",
+             f"-I{sysconfig.get_path('include')}"],
+        )
+        spec = importlib.util.spec_from_file_location("codec_ext", so_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        _codec_failed = True
+        return None
+
+
+def codec_extension():
+    """The compiled codec module, or None (pure-Python fallback)."""
+    global _codec_mod
+    if _codec_mod is not None or _codec_failed:
+        return _codec_mod
+    with _lib_lock:
+        if _codec_mod is None and not _codec_failed:
+            _codec_mod = _compile_and_import_codec()
+    return _codec_mod

@@ -1,0 +1,684 @@
+/* corda_tpu native codec: the canonical tagged binary codec's hot path.
+ *
+ * Byte-for-byte identical to corda_tpu/core/serialization/codec.py —
+ * transaction ids are Merkle roots over these bytes, so parity is a
+ * consensus property and is pinned by differential tests
+ * (tests/test_serialization.py TestNativeCodecParity fuzz).
+ *
+ * Primitives and containers encode/decode entirely in C; registered
+ * types cross back into Python exactly once each way:
+ *   encode: lookup(value) -> (type_name: str, fields: dict) | None
+ *   decode: construct(type_name: str, fields: dict) -> object
+ * (both callables are supplied by codec.py, which owns the registry).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+enum {
+    TAG_NULL, TAG_TRUE, TAG_FALSE, TAG_INT, TAG_BYTES,
+    TAG_STR, TAG_LIST, TAG_MAP, TAG_OBJ, TAG_F64
+};
+
+#define MAX_DEPTH 100
+
+static PyObject *SerializationError; /* set from codec.py at init */
+
+/* ---------------- growable byte buffer ---------------- */
+
+typedef struct {
+    char *data;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} Buf;
+
+static int buf_init(Buf *b, Py_ssize_t cap) {
+    b->data = PyMem_Malloc(cap);
+    if (!b->data) { PyErr_NoMemory(); return -1; }
+    b->len = 0;
+    b->cap = cap;
+    return 0;
+}
+
+static void buf_free(Buf *b) { PyMem_Free(b->data); }
+
+static int buf_reserve(Buf *b, Py_ssize_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    Py_ssize_t cap = b->cap * 2;
+    while (cap < b->len + extra) cap *= 2;
+    char *p = PyMem_Realloc(b->data, cap);
+    if (!p) { PyErr_NoMemory(); return -1; }
+    b->data = p;
+    b->cap = cap;
+    return 0;
+}
+
+static int buf_put(Buf *b, const char *src, Py_ssize_t n) {
+    if (buf_reserve(b, n) < 0) return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+static int buf_byte(Buf *b, unsigned char c) {
+    return buf_put(b, (const char *)&c, 1);
+}
+
+static int buf_uvarint(Buf *b, unsigned long long v) {
+    unsigned char tmp[10];
+    int n = 0;
+    for (;;) {
+        unsigned char byte = v & 0x7F;
+        v >>= 7;
+        if (v) tmp[n++] = byte | 0x80;
+        else { tmp[n++] = byte; break; }
+    }
+    return buf_put(b, (const char *)tmp, n);
+}
+
+/* ---------------- encode ---------------- */
+
+static int encode_value(Buf *b, PyObject *value, PyObject *lookup, int depth);
+
+/* big-int slow path: emit zigzag uvarint of arbitrary-size PyLong */
+static int encode_bigint(Buf *b, PyObject *value) {
+    /* zz = v >= 0 ? 2v : -2v - 1, computed with PyLong arithmetic */
+    PyObject *zz = NULL;
+    PyObject *zero = PyLong_FromLong(0);
+    if (!zero) return -1;
+    int neg = PyObject_RichCompareBool(value, zero, Py_LT);
+    Py_DECREF(zero);
+    if (neg < 0) return -1;
+    PyObject *two = PyLong_FromLong(2);
+    if (!two) return -1;
+    PyObject *doubled = PyNumber_Multiply(value, two);
+    Py_DECREF(two);
+    if (!doubled) return -1;
+    if (neg) {
+        PyObject *minus1 = PyLong_FromLong(-1);
+        PyObject *negd = PyNumber_Negative(doubled);
+        Py_DECREF(doubled);
+        if (!minus1 || !negd) { Py_XDECREF(minus1); Py_XDECREF(negd); return -1; }
+        zz = PyNumber_Add(negd, minus1);
+        Py_DECREF(minus1);
+        Py_DECREF(negd);
+    } else {
+        zz = doubled;
+    }
+    if (!zz) return -1;
+    /* emit 7 bits at a time from the PyLong */
+    PyObject *seven = PyLong_FromLong(7);
+    PyObject *mask = PyLong_FromLong(0x7F);
+    if (!seven || !mask) { Py_XDECREF(seven); Py_XDECREF(mask); Py_DECREF(zz); return -1; }
+    int rc = 0;
+    for (;;) {
+        PyObject *low = PyNumber_And(zz, mask);
+        PyObject *rest = PyNumber_Rshift(zz, seven);
+        if (!low || !rest) { Py_XDECREF(low); Py_XDECREF(rest); rc = -1; break; }
+        long lowv = PyLong_AsLong(low);
+        Py_DECREF(low);
+        int more = PyObject_IsTrue(rest);
+        if (lowv < 0 || more < 0) { Py_DECREF(rest); rc = -1; break; }
+        if (buf_byte(b, (unsigned char)(lowv | (more ? 0x80 : 0))) < 0) {
+            Py_DECREF(rest); rc = -1; break;
+        }
+        Py_DECREF(zz);
+        zz = rest;
+        if (!more) break;
+    }
+    Py_DECREF(zz);
+    Py_DECREF(seven);
+    Py_DECREF(mask);
+    return rc;
+}
+
+static int encode_int(Buf *b, PyObject *value) {
+    if (buf_byte(b, TAG_INT) < 0) return -1;
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(value, &overflow);
+    if (!overflow && v != -1) {
+        /* zigzag in C; |2v| must fit u64: any long long does */
+        unsigned long long zz = v >= 0
+            ? ((unsigned long long)v) << 1
+            : (((unsigned long long)(-(v + 1))) << 1) + 1;
+        return buf_uvarint(b, zz);
+    }
+    if (!overflow && PyErr_Occurred()) return -1;
+    if (!overflow) { /* v == -1 genuinely */
+        return buf_uvarint(b, 1ULL);
+    }
+    return encode_bigint(b, value);
+}
+
+typedef struct {
+    char *kb; Py_ssize_t klen;
+    char *vb; Py_ssize_t vlen;
+} Pair;
+
+static int pair_cmp(const void *pa, const void *pb) {
+    const Pair *a = (const Pair *)pa, *c = (const Pair *)pb;
+    Py_ssize_t n = a->klen < c->klen ? a->klen : c->klen;
+    int r = memcmp(a->kb, c->kb, (size_t)n);
+    if (r) return r;
+    if (a->klen != c->klen) return a->klen < c->klen ? -1 : 1;
+    n = a->vlen < c->vlen ? a->vlen : c->vlen;
+    r = memcmp(a->vb, c->vb, (size_t)n);
+    if (r) return r;
+    if (a->vlen != c->vlen) return a->vlen < c->vlen ? -1 : 1;
+    return 0;
+}
+
+typedef struct { char *data; Py_ssize_t len; } Blob;
+
+static int blob_cmp(const void *pa, const void *pb) {
+    const Blob *a = (const Blob *)pa, *c = (const Blob *)pb;
+    Py_ssize_t n = a->len < c->len ? a->len : c->len;
+    int r = memcmp(a->data, c->data, (size_t)n);
+    if (r) return r;
+    if (a->len != c->len) return a->len < c->len ? -1 : 1;
+    return 0;
+}
+
+static int encode_to_blob(PyObject *value, PyObject *lookup, int depth,
+                          char **out, Py_ssize_t *outlen) {
+    Buf tmp;
+    if (buf_init(&tmp, 64) < 0) return -1;
+    if (encode_value(&tmp, value, lookup, depth) < 0) {
+        buf_free(&tmp);
+        return -1;
+    }
+    *out = tmp.data;   /* ownership moves to caller (PyMem_Free) */
+    *outlen = tmp.len;
+    return 0;
+}
+
+static int encode_value(Buf *b, PyObject *value, PyObject *lookup, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_Format(SerializationError, "nesting deeper than %d", MAX_DEPTH);
+        return -1;
+    }
+    if (value == Py_None) return buf_byte(b, TAG_NULL);
+    if (value == Py_True) return buf_byte(b, TAG_TRUE);
+    if (value == Py_False) return buf_byte(b, TAG_FALSE);
+    /* exact bool subclasses other than True/False cannot exist */
+    if (PyLong_Check(value)) return encode_int(b, value);
+    if (PyBytes_Check(value) || PyByteArray_Check(value)
+        || PyMemoryView_Check(value)) {
+        PyObject *raw = PyBytes_FromObject(value); /* bytes(value) */
+        if (!raw) return -1;
+        char *p; Py_ssize_t n;
+        PyBytes_AsStringAndSize(raw, &p, &n);
+        int rc = (buf_byte(b, TAG_BYTES) < 0 || buf_uvarint(b, (unsigned long long)n) < 0
+                  || buf_put(b, p, n) < 0) ? -1 : 0;
+        Py_DECREF(raw);
+        return rc;
+    }
+    if (PyUnicode_Check(value)) {
+        Py_ssize_t n;
+        const char *p = PyUnicode_AsUTF8AndSize(value, &n);
+        if (!p) return -1;
+        if (buf_byte(b, TAG_STR) < 0) return -1;
+        if (buf_uvarint(b, (unsigned long long)n) < 0) return -1;
+        return buf_put(b, p, n);
+    }
+    if (PyFloat_Check(value)) {
+        double d = PyFloat_AS_DOUBLE(value);
+        if (d != d || (d == 0.0 && copysign(1.0, d) < 0)) {
+            PyErr_SetString(SerializationError,
+                            "NaN and -0.0 are not canonical");
+            return -1;
+        }
+        unsigned char be[8];
+        if (PyFloat_Pack8(d, (char *)be, 0) < 0) return -1; /* 0 = big-endian */
+        if (buf_byte(b, TAG_F64) < 0) return -1;
+        return buf_put(b, (const char *)be, 8);
+    }
+    if (PyList_Check(value) || PyTuple_Check(value)) {
+        PyObject *fast = PySequence_Fast(value, "list");
+        if (!fast) return -1;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+        if (buf_byte(b, TAG_LIST) < 0 || buf_uvarint(b, (unsigned long long)n) < 0) {
+            Py_DECREF(fast);
+            return -1;
+        }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (encode_value(b, PySequence_Fast_GET_ITEM(fast, i), lookup,
+                             depth + 1) < 0) {
+                Py_DECREF(fast);
+                return -1;
+            }
+        }
+        Py_DECREF(fast);
+        return 0;
+    }
+    if (PyDict_Check(value)) {
+        Py_ssize_t n = PyDict_Size(value);
+        if (buf_byte(b, TAG_MAP) < 0 || buf_uvarint(b, (unsigned long long)n) < 0)
+            return -1;
+        Pair *pairs = PyMem_Calloc(n ? (size_t)n : 1, sizeof(Pair));
+        if (!pairs) { PyErr_NoMemory(); return -1; }
+        Py_ssize_t i = 0, pos = 0;
+        PyObject *k, *v;
+        int rc = 0;
+        while (PyDict_Next(value, &pos, &k, &v)) {
+            if (encode_to_blob(k, lookup, depth + 1, &pairs[i].kb, &pairs[i].klen) < 0
+                || encode_to_blob(v, lookup, depth + 1, &pairs[i].vb, &pairs[i].vlen) < 0) {
+                rc = -1;
+                break;
+            }
+            i++;
+        }
+        if (rc == 0) {
+            qsort(pairs, (size_t)i, sizeof(Pair), pair_cmp);
+            for (Py_ssize_t j = 0; j < i && rc == 0; j++) {
+                if (buf_put(b, pairs[j].kb, pairs[j].klen) < 0
+                    || buf_put(b, pairs[j].vb, pairs[j].vlen) < 0)
+                    rc = -1;
+            }
+        }
+        for (Py_ssize_t j = 0; j < n; j++) {
+            PyMem_Free(pairs[j].kb);   /* calloc'd: NULL-safe */
+            PyMem_Free(pairs[j].vb);
+        }
+        PyMem_Free(pairs);
+        return rc;
+    }
+    if (PySet_Check(value) || PyFrozenSet_Check(value)) {
+        Py_ssize_t n = PySet_Size(value);
+        if (buf_byte(b, TAG_LIST) < 0 || buf_uvarint(b, (unsigned long long)n) < 0)
+            return -1;
+        Blob *blobs = PyMem_Malloc(sizeof(Blob) * (n ? n : 1));
+        if (!blobs) { PyErr_NoMemory(); return -1; }
+        PyObject *it = PyObject_GetIter(value);
+        if (!it) { PyMem_Free(blobs); return -1; }
+        Py_ssize_t i = 0;
+        int rc = 0;
+        PyObject *item;
+        while ((item = PyIter_Next(it)) != NULL) {
+            rc = encode_to_blob(item, lookup, depth + 1, &blobs[i].data,
+                                &blobs[i].len);
+            Py_DECREF(item);
+            if (rc < 0) break;
+            i++;
+        }
+        Py_DECREF(it);
+        if (rc == 0 && PyErr_Occurred()) rc = -1;
+        if (rc == 0) {
+            qsort(blobs, (size_t)i, sizeof(Blob), blob_cmp);
+            for (Py_ssize_t j = 0; j < i && rc == 0; j++)
+                if (buf_put(b, blobs[j].data, blobs[j].len) < 0) rc = -1;
+        }
+        for (Py_ssize_t j = 0; j < i; j++) PyMem_Free(blobs[j].data);
+        PyMem_Free(blobs);
+        return rc;
+    }
+    /* registered type: one Python round trip for (name, fields) */
+    {
+        PyObject *res = PyObject_CallFunctionObjArgs(lookup, value, NULL);
+        if (!res) return -1;
+        if (res == Py_None) {
+            Py_DECREF(res);
+            PyErr_Format(SerializationError,
+                         "type %.200s is not @corda_serializable/registered",
+                         Py_TYPE(value)->tp_name);
+            return -1;
+        }
+        PyObject *name = PyTuple_GetItem(res, 0);   /* borrowed */
+        PyObject *fields = PyTuple_GetItem(res, 1); /* borrowed */
+        if (!name || !fields || !PyUnicode_Check(name) || !PyDict_Check(fields)) {
+            Py_DECREF(res);
+            PyErr_SetString(SerializationError, "bad lookup result");
+            return -1;
+        }
+        Py_ssize_t nlen;
+        const char *nraw = PyUnicode_AsUTF8AndSize(name, &nlen);
+        if (!nraw) { Py_DECREF(res); return -1; }
+        if (buf_byte(b, TAG_OBJ) < 0
+            || buf_uvarint(b, (unsigned long long)nlen) < 0
+            || buf_put(b, nraw, nlen) < 0
+            || buf_uvarint(b, (unsigned long long)PyDict_Size(fields)) < 0) {
+            Py_DECREF(res);
+            return -1;
+        }
+        /* field names sorted: UTF-8 memcmp == code-point order */
+        PyObject *keys = PyDict_Keys(fields);
+        if (!keys || PyList_Sort(keys) < 0) {
+            Py_XDECREF(keys);
+            Py_DECREF(res);
+            return -1;
+        }
+        int rc = 0;
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(keys) && rc == 0; i++) {
+            PyObject *fn = PyList_GET_ITEM(keys, i);
+            Py_ssize_t fl;
+            const char *fraw = PyUnicode_AsUTF8AndSize(fn, &fl);
+            if (!fraw) { rc = -1; break; }
+            PyObject *fv = PyDict_GetItem(fields, fn); /* borrowed */
+            if (!fv) { rc = -1; break; }
+            if (buf_uvarint(b, (unsigned long long)fl) < 0
+                || buf_put(b, fraw, fl) < 0
+                || encode_value(b, fv, lookup, depth + 1) < 0)
+                rc = -1;
+        }
+        Py_DECREF(keys);
+        Py_DECREF(res);
+        return rc;
+    }
+}
+
+static PyObject *py_encode(PyObject *self, PyObject *args) {
+    PyObject *value, *lookup, *magic;
+    if (!PyArg_ParseTuple(args, "OOO", &value, &lookup, &magic)) return NULL;
+    char *mp; Py_ssize_t mn;
+    if (PyBytes_AsStringAndSize(magic, &mp, &mn) < 0) return NULL;
+    Buf b;
+    if (buf_init(&b, 256) < 0) return NULL;
+    if (buf_put(&b, mp, mn) < 0 || encode_value(&b, value, lookup, 0) < 0) {
+        buf_free(&b);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.data, b.len);
+    buf_free(&b);
+    return out;
+}
+
+/* ---------------- decode ---------------- */
+
+typedef struct {
+    const unsigned char *data;
+    Py_ssize_t len;
+    Py_ssize_t pos;
+} Reader;
+
+static int rd_uvarint(Reader *r, unsigned long long *out, PyObject **big) {
+    /* returns value in *out; if the varint exceeds 63 bits, builds a
+       PyLong in *big instead (shift cap 640 mirrors the Python codec) */
+    unsigned long long result = 0;
+    int shift = 0;
+    *big = NULL;
+    for (;;) {
+        if (r->pos >= r->len) {
+            PyErr_SetString(SerializationError, "truncated varint");
+            return -1;
+        }
+        unsigned char byte = r->data[r->pos++];
+        if (shift < 56) {
+            result |= ((unsigned long long)(byte & 0x7F)) << shift;
+        } else {
+            /* promote to PyLong arithmetic */
+            if (*big == NULL) {
+                *big = PyLong_FromUnsignedLongLong(result);
+                if (!*big) return -1;
+            }
+            PyObject *part = PyLong_FromUnsignedLongLong(
+                (unsigned long long)(byte & 0x7F));
+            PyObject *sh = PyLong_FromLong(shift);
+            PyObject *shifted = (part && sh) ? PyNumber_Lshift(part, sh) : NULL;
+            Py_XDECREF(part);
+            Py_XDECREF(sh);
+            if (!shifted) { Py_CLEAR(*big); return -1; }
+            PyObject *sum = PyNumber_Or(*big, shifted);
+            Py_DECREF(shifted);
+            Py_DECREF(*big);
+            *big = sum;
+            if (!sum) return -1;
+        }
+        if (!(byte & 0x80)) break;
+        shift += 7;
+        if (shift > 640) {
+            Py_CLEAR(*big);
+            PyErr_SetString(SerializationError, "varint too long");
+            return -1;
+        }
+    }
+    *out = result;
+    return 0;
+}
+
+static int rd_len(Reader *r, Py_ssize_t *out) {
+    unsigned long long v;
+    PyObject *big;
+    if (rd_uvarint(r, &v, &big) < 0) return -1;
+    if (big) {
+        /* non-canonical zero-padded varints keep the VALUE small while
+           inflating the byte count; the Python decoder accepts them, so
+           rejecting here would split consensus between native and
+           fallback nodes — only reject when the value truly overflows */
+        Py_ssize_t sv = PyLong_AsSsize_t(big);
+        Py_DECREF(big);
+        if (sv == -1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            PyErr_SetString(SerializationError, "length varint too large");
+            return -1;
+        }
+        *out = sv;
+        return 0;
+    }
+    if (v > (unsigned long long)PY_SSIZE_T_MAX) {
+        PyErr_SetString(SerializationError, "length varint too large");
+        return -1;
+    }
+    *out = (Py_ssize_t)v;
+    return 0;
+}
+
+static PyObject *decode_value(Reader *r, PyObject *construct, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_Format(SerializationError, "nesting deeper than %d", MAX_DEPTH);
+        return NULL;
+    }
+    if (r->pos >= r->len) {
+        PyErr_SetString(SerializationError, "truncated value");
+        return NULL;
+    }
+    unsigned char tag = r->data[r->pos++];
+    switch (tag) {
+    case TAG_NULL: Py_RETURN_NONE;
+    case TAG_TRUE: Py_RETURN_TRUE;
+    case TAG_FALSE: Py_RETURN_FALSE;
+    case TAG_INT: {
+        unsigned long long v;
+        PyObject *big;
+        if (rd_uvarint(r, &v, &big) < 0) return NULL;
+        if (big) {
+            /* unzigzag with PyLong arithmetic: (v >> 1) ^ -(v & 1) */
+            PyObject *one = PyLong_FromLong(1);
+            PyObject *half = one ? PyNumber_Rshift(big, one) : NULL;
+            PyObject *lsb = one ? PyNumber_And(big, one) : NULL;
+            PyObject *neg = lsb ? PyNumber_Negative(lsb) : NULL;
+            PyObject *out = (half && neg) ? PyNumber_Xor(half, neg) : NULL;
+            Py_XDECREF(one); Py_XDECREF(half); Py_XDECREF(lsb);
+            Py_XDECREF(neg); Py_DECREF(big);
+            return out;
+        }
+        unsigned long long half = v >> 1;
+        if (v & 1) {
+            /* negative: -(half + 1) */
+            return PyLong_FromLongLong(-(long long)(half + 1));
+        }
+        return PyLong_FromUnsignedLongLong(half);
+    }
+    case TAG_BYTES: {
+        Py_ssize_t n;
+        if (rd_len(r, &n) < 0) return NULL;
+        if (r->pos + n > r->len) {
+            PyErr_SetString(SerializationError, "truncated bytes");
+            return NULL;
+        }
+        PyObject *out = PyBytes_FromStringAndSize(
+            (const char *)r->data + r->pos, n);
+        r->pos += n;
+        return out;
+    }
+    case TAG_STR: {
+        Py_ssize_t n;
+        if (rd_len(r, &n) < 0) return NULL;
+        if (r->pos + n > r->len) {
+            PyErr_SetString(SerializationError, "truncated string");
+            return NULL;
+        }
+        PyObject *out = PyUnicode_DecodeUTF8(
+            (const char *)r->data + r->pos, n, NULL);
+        r->pos += n;
+        return out;
+    }
+    case TAG_F64: {
+        if (r->pos + 8 > r->len) {
+            PyErr_SetString(SerializationError, "truncated float");
+            return NULL;
+        }
+        double d = PyFloat_Unpack8((const char *)r->data + r->pos, 0);
+        if (d == -1.0 && PyErr_Occurred()) return NULL;
+        r->pos += 8;
+        return PyFloat_FromDouble(d);
+    }
+    case TAG_LIST: {
+        Py_ssize_t n;
+        if (rd_len(r, &n) < 0) return NULL;
+        PyObject *out = PyList_New(0);
+        if (!out) return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *item = decode_value(r, construct, depth + 1);
+            if (!item || PyList_Append(out, item) < 0) {
+                Py_XDECREF(item);
+                Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(item);
+        }
+        return out;
+    }
+    case TAG_MAP: {
+        Py_ssize_t n;
+        if (rd_len(r, &n) < 0) return NULL;
+        PyObject *out = PyDict_New();
+        if (!out) return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *k = decode_value(r, construct, depth + 1);
+            if (!k) { Py_DECREF(out); return NULL; }
+            if (PyList_Check(k)) {
+                PyObject *t = PyList_AsTuple(k);
+                Py_DECREF(k);
+                if (!t) { Py_DECREF(out); return NULL; }
+                k = t;
+            }
+            PyObject *v = decode_value(r, construct, depth + 1);
+            if (!v || PyDict_SetItem(out, k, v) < 0) {
+                Py_DECREF(k);
+                Py_XDECREF(v);
+                Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v);
+        }
+        return out;
+    }
+    case TAG_OBJ: {
+        Py_ssize_t n;
+        if (rd_len(r, &n) < 0) return NULL;
+        if (r->pos + n > r->len) {
+            PyErr_SetString(SerializationError, "truncated type name");
+            return NULL;
+        }
+        PyObject *name = PyUnicode_DecodeUTF8(
+            (const char *)r->data + r->pos, n, NULL);
+        if (!name) return NULL;
+        r->pos += n;
+        Py_ssize_t fcount;
+        if (rd_len(r, &fcount) < 0) { Py_DECREF(name); return NULL; }
+        PyObject *fields = PyDict_New();
+        if (!fields) { Py_DECREF(name); return NULL; }
+        for (Py_ssize_t i = 0; i < fcount; i++) {
+            Py_ssize_t fl;
+            if (rd_len(r, &fl) < 0) goto obj_fail;
+            if (r->pos + fl > r->len) {
+                PyErr_SetString(SerializationError, "truncated field name");
+                goto obj_fail;
+            }
+            PyObject *fn = PyUnicode_DecodeUTF8(
+                (const char *)r->data + r->pos, fl, NULL);
+            if (!fn) goto obj_fail;
+            r->pos += fl;
+            PyObject *fv = decode_value(r, construct, depth + 1);
+            if (!fv || PyDict_SetItem(fields, fn, fv) < 0) {
+                Py_DECREF(fn);
+                Py_XDECREF(fv);
+                goto obj_fail;
+            }
+            Py_DECREF(fn);
+            Py_DECREF(fv);
+        }
+        {
+            PyObject *out = PyObject_CallFunctionObjArgs(
+                construct, name, fields, NULL);
+            Py_DECREF(name);
+            Py_DECREF(fields);
+            return out;
+        }
+    obj_fail:
+        Py_DECREF(name);
+        Py_DECREF(fields);
+        return NULL;
+    }
+    default:
+        PyErr_Format(SerializationError, "unknown tag %d", (int)tag);
+        return NULL;
+    }
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    PyObject *construct, *magic;
+    if (!PyArg_ParseTuple(args, "y*OO", &view, &construct, &magic)) return NULL;
+    char *mp; Py_ssize_t mn;
+    if (PyBytes_AsStringAndSize(magic, &mp, &mn) < 0) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    Reader r = { (const unsigned char *)view.buf, view.len, 0 };
+    if (r.len < mn || memcmp(r.data, mp, (size_t)mn) != 0) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(SerializationError,
+                        "bad magic / unsupported format version");
+        return NULL;
+    }
+    r.pos = mn;
+    PyObject *out = decode_value(&r, construct, 0);
+    if (out && r.pos != r.len) {
+        PyErr_Format(SerializationError, "%zd trailing bytes", r.len - r.pos);
+        Py_DECREF(out);
+        out = NULL;
+    }
+    PyBuffer_Release(&view);
+    return out;
+}
+
+static PyObject *py_set_error(PyObject *self, PyObject *args) {
+    PyObject *exc;
+    if (!PyArg_ParseTuple(args, "O", &exc)) return NULL;
+    Py_INCREF(exc);
+    Py_XDECREF(SerializationError);
+    SerializationError = exc;
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"encode", py_encode, METH_VARARGS,
+     "encode(value, lookup, magic) -> bytes"},
+    {"decode", py_decode, METH_VARARGS,
+     "decode(data, construct, magic) -> value"},
+    {"set_error", py_set_error, METH_VARARGS,
+     "install the SerializationError class raised on failures"},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "codec_ext", NULL, -1, methods
+};
+
+PyMODINIT_FUNC PyInit_codec_ext(void) {
+    SerializationError = PyExc_ValueError; /* replaced via set_error */
+    Py_INCREF(SerializationError);
+    return PyModule_Create(&moduledef);
+}

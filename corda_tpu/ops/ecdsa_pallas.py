@@ -112,15 +112,28 @@ class _RowField:
         return jnp.where(borrowed, _cat(rows2), _cat(rows))
 
     def mul(self, a, b):
-        """CIOS Montgomery product on rows (bounds: field_secp.mul)."""
+        """CIOS Montgomery product on rows (bounds: field_secp.mul).
+
+        Under the Pallas-trace fast-mul switch the shifted accumulations
+        add into the LIVE rows only (static-slice .at[].add) instead of
+        full 32-row adds half of whose rows are zeros — the same
+        Mosaic-only trim as ed25519's _mul_fast (docs/perf-roofline.md
+        item 3); differential-tested in tests/test_field_secp_rows.py."""
+        from .ed25519_pallas import _fast_mul_active
+
+        fast = _fast_mul_active()
         w = a.shape[1]
         acc = _zeros(32, w)
         for i in range(16):
             prod = a[i : i + 1] * b          # (16, W)
             lo = prod & _MASK
             hi = prod >> 16
-            acc = acc + _cat([_zeros(i, w), lo, _zeros(16 - i, w)])
-            acc = acc + _cat([_zeros(i + 1, w), hi, _zeros(15 - i, w)])
+            if fast:
+                acc = acc.at[i : i + 16].add(lo)
+                acc = acc.at[i + 1 : i + 17].add(hi)
+            else:
+                acc = acc + _cat([_zeros(i, w), lo, _zeros(16 - i, w)])
+                acc = acc + _cat([_zeros(i + 1, w), hi, _zeros(15 - i, w)])
         c = jnp.zeros((1, w), jnp.uint32)
         for i in range(16):
             ti = acc[i : i + 1] + c
@@ -134,8 +147,12 @@ class _RowField:
             c = hi_rows[0] + ((ti + lo_rows[0]) >> 16)
             add_lo = _cat(lo_rows[1:])        # positions i+1 .. i+15
             add_hi = _cat(hi_rows[1:])        # positions i+2 .. i+16
-            acc = acc + _cat([_zeros(i + 1, w), add_lo, _zeros(16 - i, w)])
-            acc = acc + _cat([_zeros(i + 2, w), add_hi, _zeros(15 - i, w)])
+            if fast:
+                acc = acc.at[i + 1 : i + 16].add(add_lo)
+                acc = acc.at[i + 2 : i + 17].add(add_hi)
+            else:
+                acc = acc + _cat([_zeros(i + 1, w), add_lo, _zeros(16 - i, w)])
+                acc = acc + _cat([_zeros(i + 2, w), add_hi, _zeros(15 - i, w)])
         r_rows = [acc[16 + k : 17 + k] for k in range(16)]
         r_rows[0] = r_rows[0] + c
         rows, carry = self._carry16(r_rows)
@@ -352,12 +369,19 @@ def _make_kernel(curve_name: str):
         def read_idx(t):
             return idx_ref[pl.ds(t, 1), :]
 
-        out_ref[:] = _verify_core(
-            curve_name,
-            BLK,
-            qx_ref[:], qy_ref[:], u1_ref[:], u2_ref[:], r_ref[:], ok_ref[:],
-            write_table, read_table, write_idx, read_idx,
-        )
+        # trace-time fast-mul switch, thread-local (see ed25519_pallas:
+        # the live-row CIOS lowers well under Mosaic but blows up XLA
+        # CPU compiles, so only the TPU kernel trace enables it)
+        from .ed25519_pallas import _FAST_MUL_ENABLED, _fast_mul_trace
+
+        with _fast_mul_trace(_FAST_MUL_ENABLED):
+            out_ref[:] = _verify_core(
+                curve_name,
+                BLK,
+                qx_ref[:], qy_ref[:], u1_ref[:], u2_ref[:], r_ref[:],
+                ok_ref[:],
+                write_table, read_table, write_idx, read_idx,
+            )
 
     return kernel
 

@@ -143,6 +143,21 @@ def _fast_mul_active() -> bool:
     return getattr(_FAST_MUL_TLS, "active", False)
 
 
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def _fast_mul_trace(enabled: bool = True):
+    """Enable the fast-mul variants for the duration of a kernel trace
+    on THIS thread (the single place the save/set/restore lives)."""
+    prev = _fast_mul_active()
+    _FAST_MUL_TLS.active = enabled
+    try:
+        yield
+    finally:
+        _FAST_MUL_TLS.active = prev
+
+
 def _mul_fast(a, b):
     """_mul with live-row accumulation (differential-tested vs _mul in
     tests/test_ops_ed25519.py; identical bounds argument)."""
@@ -539,9 +554,7 @@ def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref, ok_ref,
     # but blow up XLA CPU compiles, so they are enabled only while this
     # TPU kernel body is being traced, on this thread only (module
     # comment at _FAST_MUL_TLS)
-    prev = _fast_mul_active()
-    _FAST_MUL_TLS.active = _FAST_MUL_ENABLED
-    try:
+    with _fast_mul_trace(_FAST_MUL_ENABLED):
         out_ref[:] = _verify_core(
             BLK,
             y_a_ref[:],
@@ -556,8 +569,6 @@ def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref, ok_ref,
             write_idx,
             read_idx,
         )
-    finally:
-        _FAST_MUL_TLS.active = prev
 
 
 @jax.jit

@@ -88,6 +88,35 @@ def test_rowfield_mul_add_sub_inv(fname, field):
 
 
 @pytest.mark.parametrize("fname,field", FIELDS)
+def test_rowfield_mul_fast_differential(fname, field):
+    """The Mosaic-only live-row CIOS variant must agree with the dense
+    formulation bit-for-bit (swapped in only while the TPU kernel body
+    is traced — same switch as ed25519's _mul_fast)."""
+    from corda_tpu.ops.ed25519_pallas import _fast_mul_trace
+
+    rf = ecdsa_pallas._RowField(field)
+    rng = np.random.default_rng(23)
+    a_int = [int.from_bytes(rng.bytes(32), "big") % field.p_int
+             for _ in range(W)]
+    b_int = [int.from_bytes(rng.bytes(32), "big") % field.p_int
+             for _ in range(W)]
+    a_int[0], b_int[0] = field.p_int - 1, field.p_int - 1
+    a, b = _col_from_ints(a_int, field), _col_from_ints(b_int, field)
+
+    dense = jax.jit(rf.mul)(a, b)
+
+    def fast_mul(x, y):
+        with _fast_mul_trace():
+            return rf.mul(x, y)
+
+    fast = jax.jit(fast_mul)(a, b)
+    assert np.array_equal(np.asarray(dense), np.asarray(fast))
+    assert _ints_from_col(fast, field) == [
+        (x * y) % field.p_int for x, y in zip(a_int, b_int)
+    ]
+
+
+@pytest.mark.parametrize("fname,field", FIELDS)
 def test_rowfield_predicates(fname, field):
     rf = ecdsa_pallas._RowField(field)
     vals = [0, 1, field.p_int - 1, 7, 0, 7, 2, 3]
