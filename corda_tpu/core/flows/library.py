@@ -148,15 +148,47 @@ class ExcessivelyLargeTransactionGraphError(FlowException):
     pass
 
 
+def collect_dependencies(stx: SignedTransaction, services, limit: int = 64):
+    """The locally-stored dependency chain of `stx`, BFS order, capped.
+
+    Senders attach this to notarise requests and broadcasts so receivers
+    resolve without per-dependency fetch dialogues (the pull model's hop
+    tax); receivers verify pushed transactions exactly like fetched ones,
+    and anything beyond the cap still pulls."""
+    storage = services.validated_transactions
+    out: List[SignedTransaction] = []
+    seen: Set = set()
+    frontier = [inp.txhash for inp in stx.tx.inputs]
+    while frontier and len(out) < limit:
+        h = frontier.pop(0)
+        if h in seen:
+            continue
+        seen.add(h)
+        dep = storage.get(h)
+        if dep is None:
+            continue  # receiver will pull it from us instead
+        out.append(dep)
+        frontier.extend(inp.txhash for inp in dep.tx.inputs)
+    return tuple(out)
+
+
 @initiating_flow
 class ResolveTransactionsFlow(FlowLogic):
     """Download and commit the dependency chain of a transaction
     (reference ResolveTransactionsFlow.kt: BFS with a transaction-count
-    bound, then verify/record in topological order)."""
+    bound, then verify/record in topological order).
+
+    `pool`: sender-pushed candidate transactions (UNTRUSTED — they take
+    the same verify path as fetched ones); dependencies found there skip
+    the fetch dialogue entirely."""
 
     MAX_TRANSACTIONS = 5000
+    #: receiver-side cap on a sender-pushed pool: the 64-entry limit in
+    #: collect_dependencies binds only HONEST senders; a hostile peer's
+    #: oversized pool must not buy attacker-sized deserialize/hash work
+    MAX_POOL = 64
 
-    def __init__(self, stx_or_hashes, other_party: Party):
+    def __init__(self, stx_or_hashes, other_party: Party, pool=()):
         if isinstance(stx_or_hashes, SignedTransaction):
             self.stx: Optional[SignedTransaction] = stx_or_hashes
             self.hashes: Tuple[SecureHash, ...] = ()
@@ -164,6 +196,7 @@ class ResolveTransactionsFlow(FlowLogic):
             self.stx = None
             self.hashes = tuple(stx_or_hashes)
         self.other_party = other_party
+        self.pool = tuple(pool)[: self.MAX_POOL]
 
     def call(self):
         start_hashes = (
@@ -176,6 +209,15 @@ class ResolveTransactionsFlow(FlowLogic):
         frontier: List[SecureHash] = [
             h for h in start_hashes if storage.get(h) is None
         ]
+        # Hash the pool only when something is actually missing locally
+        # (ids are recomputed Merkle roots, so a hostile pool cannot
+        # alias a different tx under a dependency's hash; a receiver
+        # that already has the chain pays nothing for the pool).
+        pool_by_id = (
+            {t.id: t for t in self.pool if isinstance(t, SignedTransaction)}
+            if frontier
+            else {}
+        )
         while frontier:
             if len(fetched) > self.MAX_TRANSACTIONS:
                 raise ExcessivelyLargeTransactionGraphError(
@@ -185,9 +227,12 @@ class ResolveTransactionsFlow(FlowLogic):
             frontier = []
             if not batch:
                 break
-            stxs = yield from self.sub_flow(
-                FetchTransactionsFlow(tuple(batch), self.other_party)
-            )
+            stxs = [pool_by_id[h] for h in batch if h in pool_by_id]
+            missing = tuple(h for h in batch if h not in pool_by_id)
+            if missing:
+                stxs += yield from self.sub_flow(
+                    FetchTransactionsFlow(missing, self.other_party)
+                )
             for stx in stxs:
                 if stx.id in fetched:
                     continue
@@ -271,32 +316,66 @@ class ResolveTransactionsHandler(FlowLogic):
 # Broadcast + Finality
 # ---------------------------------------------------------------------------
 
+@dataclass(frozen=True)
+class TransactionDelivery:
+    """A broadcast transaction with its sender-pushed dependency chain
+    (bounded; receiver verifies everything — see collect_dependencies)."""
+
+    stx: SignedTransaction = None
+    dependencies: Tuple = ()
+
+
+register_adapter(
+    TransactionDelivery, "TransactionDelivery",
+    lambda t: {"stx": t.stx, "deps": list(t.dependencies)},
+    lambda d: TransactionDelivery(d["stx"], tuple(d.get("deps") or ())),
+)
+
+
 @initiating_flow
 class BroadcastTransactionFlow(FlowLogic):
     """Send a notarised transaction to recipients for recording
-    (reference BroadcastTransactionFlow.kt)."""
+    (reference BroadcastTransactionFlow.kt), with its dependency chain
+    piggybacked so recipients rarely open fetch dialogues back."""
 
     def __init__(self, stx: SignedTransaction, recipients: Iterable[Party]):
         self.stx = stx
         self.recipients = tuple(recipients)
 
     def call(self):
+        deps = collect_dependencies(self.stx, self.service_hub)
+        delivery = TransactionDelivery(self.stx, deps)
         for party in self.recipients:
-            yield self.send(party, self.stx)
+            yield self.send(party, delivery)
 
 
 @initiated_by(BroadcastTransactionFlow)
 class NotifyTransactionHandler(FlowLogic):
-    """Receive a broadcast transaction: resolve its chain from the sender,
-    verify and record (reference NotifyTransactionHandler in
-    AbstractNode.installCoreFlows)."""
+    """Receive a broadcast transaction: resolve its chain (sender-pushed
+    pool first, fetch dialogues for the rest), verify and record
+    (reference NotifyTransactionHandler in AbstractNode.installCoreFlows).
+    Accepts a bare SignedTransaction too (pre-piggyback senders)."""
 
     def __init__(self, counterparty: Party):
         self.counterparty = counterparty
 
     def call(self):
-        stx = yield self.receive(self.counterparty, SignedTransaction)
-        yield from self.sub_flow(ResolveTransactionsFlow(stx, self.counterparty))
+        delivery = yield self.receive(self.counterparty, object)
+        if isinstance(delivery, TransactionDelivery):
+            stx, pool = delivery.stx, delivery.dependencies
+        elif isinstance(delivery, SignedTransaction):
+            stx, pool = delivery, ()
+        else:
+            raise FlowException(
+                f"expected a transaction delivery, got {type(delivery).__name__}"
+            )
+        if not isinstance(stx, SignedTransaction):
+            # the wrapper's stx field defaults to None; a malformed
+            # delivery must reject cleanly, not TypeError mid-resolution
+            raise FlowException("transaction delivery carries no transaction")
+        yield from self.sub_flow(
+            ResolveTransactionsFlow(stx, self.counterparty, pool=pool)
+        )
         missing_atts = [
             h for h in stx.tx.attachments
             if not self.service_hub.attachments.has_attachment(h)

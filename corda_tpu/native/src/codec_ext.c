@@ -501,7 +501,7 @@ static PyObject *decode_value(Reader *r, PyObject *construct, int depth) {
     case TAG_BYTES: {
         Py_ssize_t n;
         if (rd_len(r, &n) < 0) return NULL;
-        if (r->pos + n > r->len) {
+        if (n > r->len - r->pos) {
             PyErr_SetString(SerializationError, "truncated bytes");
             return NULL;
         }
@@ -513,7 +513,7 @@ static PyObject *decode_value(Reader *r, PyObject *construct, int depth) {
     case TAG_STR: {
         Py_ssize_t n;
         if (rd_len(r, &n) < 0) return NULL;
-        if (r->pos + n > r->len) {
+        if (n > r->len - r->pos) {
             PyErr_SetString(SerializationError, "truncated string");
             return NULL;
         }
@@ -577,7 +577,7 @@ static PyObject *decode_value(Reader *r, PyObject *construct, int depth) {
     case TAG_OBJ: {
         Py_ssize_t n;
         if (rd_len(r, &n) < 0) return NULL;
-        if (r->pos + n > r->len) {
+        if (n > r->len - r->pos) {
             PyErr_SetString(SerializationError, "truncated type name");
             return NULL;
         }
@@ -592,7 +592,7 @@ static PyObject *decode_value(Reader *r, PyObject *construct, int depth) {
         for (Py_ssize_t i = 0; i < fcount; i++) {
             Py_ssize_t fl;
             if (rd_len(r, &fl) < 0) goto obj_fail;
-            if (r->pos + fl > r->len) {
+            if (fl > r->len - r->pos) {
                 PyErr_SetString(SerializationError, "truncated field name");
                 goto obj_fail;
             }

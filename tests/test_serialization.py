@@ -357,6 +357,22 @@ class TestNativeCodecParity:
         assert deserialize(padded) == b"ab"
         assert self._python_deserialize(padded) == b"ab"
 
+    def test_hostile_length_rejected(self):
+        """A 2^63-1 length varint must reject cleanly on both paths —
+        the C bounds check previously overflowed Py_ssize_t (round-3
+        review finding: remotely-triggerable OOB read)."""
+        from corda_tpu.core.serialization.codec import (
+            SerializationError,
+            deserialize,
+        )
+
+        for tag in (4, 5):  # TAG_BYTES, TAG_STR
+            hostile = b"CT\x01" + bytes([tag]) + b"\xff" * 8 + b"\x7f"
+            with pytest.raises(SerializationError):
+                deserialize(hostile)
+            with pytest.raises(SerializationError):
+                self._python_deserialize(hostile)
+
     def test_deep_nesting_capped(self):
         from corda_tpu.core.serialization.codec import (
             SerializationError,
