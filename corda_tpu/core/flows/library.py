@@ -336,7 +336,12 @@ register_adapter(
 class BroadcastTransactionFlow(FlowLogic):
     """Send a notarised transaction to recipients for recording
     (reference BroadcastTransactionFlow.kt), with its dependency chain
-    piggybacked so recipients rarely open fetch dialogues back."""
+    piggybacked so recipients rarely open fetch dialogues back.
+
+    Always sends the TransactionDelivery wrapper: every node in a
+    deployment ships this module (the wrapper registers at import), so
+    there is no old-receiver case on the wire; the handler's bare-stx
+    branch exists for checkpoints recorded before the wrapper landed."""
 
     def __init__(self, stx: SignedTransaction, recipients: Iterable[Party]):
         self.stx = stx

@@ -32,17 +32,26 @@ class NodeDatabase:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self.lock = threading.RLock()
+        # depth of open transaction() contexts on the holding thread:
+        # per-statement autocommit is suppressed inside, so a batch
+        # (e.g. record_transactions' tx + vault + attribute rows) pays
+        # ONE commit cycle instead of ~10. A rollback anywhere poisons
+        # the whole nested batch (one shared sqlite transaction).
+        self._batch_depth = 0
+        self._batch_failed = False
 
     def execute(self, sql: str, params: Tuple = ()) -> sqlite3.Cursor:
         with self.lock:
             cur = self._conn.execute(sql, params)
-            self._conn.commit()
+            if self._batch_depth == 0:
+                self._conn.commit()
             return cur
 
     def executemany(self, sql: str, rows) -> None:
         with self.lock:
             self._conn.executemany(sql, rows)
-            self._conn.commit()
+            if self._batch_depth == 0:
+                self._conn.commit()
 
     def query(self, sql: str, params: Tuple = ()) -> List[Tuple]:
         with self.lock:
@@ -58,19 +67,37 @@ class NodeDatabase:
 
 
 class _Tx:
+    """Holds the db lock for the block; per-statement autocommit is
+    suppressed inside (reentrant: only the OUTERMOST exit commits, and
+    an exception anywhere rolls the whole batch back)."""
+
     def __init__(self, db: NodeDatabase):
         self.db = db
 
     def __enter__(self):
         self.db.lock.acquire()
+        self.db._batch_depth += 1
         return self.db._conn
 
     def __exit__(self, exc_type, exc, tb):
         try:
-            if exc_type is None:
-                self.db._conn.commit()
-            else:
+            self.db._batch_depth -= 1
+            if exc_type is not None:
+                # one shared sqlite transaction: this rollback discards
+                # the OUTER levels' statements too, so poison the batch —
+                # a caller that swallows the inner exception must not get
+                # a partial commit of whatever it issues afterwards
                 self.db._conn.rollback()
+                self.db._batch_failed = True
+            elif self.db._batch_depth == 0:
+                if self.db._batch_failed:
+                    self.db._conn.rollback()
+                    raise sqlite3.OperationalError(
+                        "batch poisoned by an inner rollback"
+                    )
+                self.db._conn.commit()
+            if self.db._batch_depth == 0:
+                self.db._batch_failed = False
         finally:
             self.db.lock.release()
         return False
@@ -214,19 +241,32 @@ class TransactionStorage:
 
     def add(self, stx) -> bool:
         """Record; returns False if already present. Fires observers on new."""
-        with self.db.lock:
-            existing = self.db.query(
-                "SELECT 1 FROM transactions WHERE tx_id = ?", (stx.id.bytes,)
-            )
-            if existing:
-                return False
-            self.db.execute(
-                "INSERT INTO transactions(tx_id, blob) VALUES(?, ?)",
-                (stx.id.bytes, serialize(stx)),
-            )
-        for obs in list(self._observers):
-            obs(stx)
-        return True
+        recorded = self.add_batch([stx])
+        return bool(recorded)
+
+    def add_batch(self, txs) -> List:
+        """Insert many in ONE sqlite transaction; observers fire only
+        AFTER the batch commits (an observer announcing a row that a
+        later failure rolls back would hand subscribers a transaction
+        the database never kept)."""
+        recorded = []
+        with self.db.transaction():
+            for stx in txs:
+                existing = self.db.query(
+                    "SELECT 1 FROM transactions WHERE tx_id = ?",
+                    (stx.id.bytes,),
+                )
+                if existing:
+                    continue
+                self.db.execute(
+                    "INSERT INTO transactions(tx_id, blob) VALUES(?, ?)",
+                    (stx.id.bytes, serialize(stx)),
+                )
+                recorded.append(stx)
+        for stx in recorded:
+            for obs in list(self._observers):
+                obs(stx)
+        return recorded
 
     def get(self, tx_id: SecureHash):
         rows = self.db.query(

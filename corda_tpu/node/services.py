@@ -377,7 +377,10 @@ class VaultService:
         )
 
         produced, consumed = [], []
-        with self.db.lock:
+        # one commit for the whole ingest (consume updates + state +
+        # participant + attribute rows across all txs); observers fire
+        # after the batch commits, outside the lock
+        with self.db.transaction():
             for stx in txs:
                 wtx = stx.tx
                 for ref in wtx.inputs:
@@ -687,7 +690,10 @@ class ServiceHub:
         from ..utils.flowcontext import current_flow_id
 
         txs = list(txs)
-        recorded = [stx for stx in txs if self.validated_transactions.add(stx)]
+        # tx rows commit as ONE batch (observers fire post-commit inside
+        # add_batch); the vault ingest batches separately in notify_all —
+        # per-statement autocommit was ~10 commit cycles per transaction
+        recorded = self.validated_transactions.add_batch(txs)
         if recorded:
             flow_id = current_flow_id()
             if flow_id is not None:
