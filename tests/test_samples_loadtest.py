@@ -163,6 +163,16 @@ class TestNotariseLatency:
         assert 0 < out["p50_ms"] <= out["p95_ms"]
         assert out["notarisations_per_sec"] > 0
 
+    def test_uniqueness_batch_percentiles(self):
+        from corda_tpu.loadtest.latency import measure_uniqueness_batch
+
+        out = measure_uniqueness_batch(n_tx=64)
+        assert out["n_tx"] == 64
+        assert 0 < out["raft_p50_ms"]
+        assert 0 < out["single_p50_ms"]
+        assert out["raft_commits_s"] > 0
+        assert out["single_commits_s"] > 0
+
 
 class TestNotaryDemoClusterModes:
     def test_raft_mode(self):
