@@ -25,6 +25,18 @@ BATCH = 131072  # two pipeline chunks
 PER_CHIP_BASELINE = 250_000.0  # 1M/s on 4 chips
 
 
+# One real dispatch proves the backend works end-to-end; shared with
+# tools/hw_capture.py so bench and the capture daemon agree on liveness.
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "v = int(jax.jit(lambda x: x.sum())(jnp.arange(8, dtype=jnp.uint32))"
+    ".block_until_ready())\n"
+    "assert v == 28, v\n"
+    "print('PLATFORM=' + d[0].platform)\n"
+)
+
+
 def _probe_backend(timeout_s: int = 120) -> tuple[bool, str | None]:
     """Decide TPU vs CPU by running ONE REAL dispatch in a subprocess.
 
@@ -35,14 +47,7 @@ def _probe_backend(timeout_s: int = 120) -> tuple[bool, str | None]:
     poisoning this process's JAX state.  Retries once, then falls back to
     CPU with an honest note.
     """
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "d = jax.devices()\n"
-        "v = int(jax.jit(lambda x: x.sum())(jnp.arange(8, dtype=jnp.uint32))"
-        ".block_until_ready())\n"
-        "assert v == 28, v\n"
-        "print('PLATFORM=' + d[0].platform)\n"
-    )
+    code = PROBE_SNIPPET
     note = "no probe attempt ran"
     for attempt in (1, 2):
         try:
@@ -181,6 +186,35 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         return len(items) / (time.perf_counter() - t0)
 
     ecdsa_rate = rate_of(ecdsa_items)
+
+    # BASELINE.md multi-sig config: CompositeKey threshold trees whose
+    # constituents flatten into the device batch, tree evaluated over the
+    # returned bitmask (3 ed25519 leaves per item, threshold 2).
+    from corda_tpu.core.crypto.composite import (
+        CompositeKey,
+        CompositeSignaturesWithKeys,
+    )
+
+    comp_n = 2048 if on_tpu else 256
+    leaf_kps = [
+        crypto.generate_keypair(EDDSA_ED25519_SHA512) for _ in range(24)
+    ]
+    comp_items = []
+    for i in range(comp_n):
+        kps = [leaf_kps[(i + j) % len(leaf_kps)] for j in range(3)]
+        builder = CompositeKey.Builder()
+        for kp in kps:
+            builder.add_key(kp.public)
+        ckey = builder.build(2)
+        content = rng.bytes(40)
+        pairs = tuple(
+            (kp.public, crypto.do_sign(kp.private, content)) for kp in kps
+        )
+        comp_items.append(
+            (ckey, CompositeSignaturesWithKeys(pairs).serialize(), content)
+        )
+    composite_rate = rate_of(comp_items)
+
     mixed = []
     for i in range(max(len(ecdsa_items), len(ed_items))):
         if i < len(ed_items):
@@ -207,6 +241,8 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "uniq_single_p50_ms": uniq["single_p50_ms"],
         "uniq_single_commits_s": uniq["single_commits_s"],
         "ecdsa_p256_sigs_s": round(ecdsa_rate, 1),
+        "composite_items_s": round(composite_rate, 1),
+        "composite_batch": comp_n,
         "mixed_scheme_sigs_s": round(mixed_rate, 1),
         "mixed_batch": len(mixed),
         "p50_notarise_ms": lat["p50_ms"],

@@ -66,8 +66,57 @@ def verify_batch(
 
     Buckets by scheme (the mixed-scheme dispatch, BASELINE.md): ed25519 and
     both ECDSA curves go to their device kernels when the bucket is large
-    enough; everything else (RSA, composite, small buckets) stays host-side.
+    enough; everything else (RSA, small buckets) stays host-side.
+
+    CompositeKey items (threshold multi-sig trees) are FLATTENED: each
+    constituent (leaf key, leaf sig) pair joins the same scheme buckets as
+    plain signatures, and the threshold tree is evaluated over the
+    returned bitmask (BASELINE.md multi-sig config; semantics identical to
+    `CompositeKey.verify_composite` — every constituent must verify AND
+    the tree's weighted thresholds must be met). Nested-composite
+    constituents keep the host path.
     """
+    n = len(items)
+    results: List[bool] = [False] * n
+    flat: List[Tuple[PublicKey, bytes, bytes]] = []
+    flat_of_item: List[int | None] = []  # item idx -> flat row (1:1 items)
+    composites = []  # (item idx, CompositeKey, [flat rows], [leaf keys])
+    for i, (key, sig, content) in enumerate(items):
+        if USE_DEVICE_KERNELS and _is_composite(key):
+            from .composite import CompositeSignaturesWithKeys
+
+            try:
+                csigs = CompositeSignaturesWithKeys.deserialize(sig)
+            except Exception:
+                flat_of_item.append(None)  # malformed blob -> False
+                continue
+            rows, leaf_keys = [], []
+            for leaf_pub, leaf_sig in csigs.sigs:
+                rows.append(len(flat))
+                leaf_keys.append(leaf_pub)
+                flat.append((leaf_pub, leaf_sig, content))
+            composites.append((i, key, rows, leaf_keys))
+            flat_of_item.append(None)
+        else:
+            flat_of_item.append(len(flat))
+            flat.append((key, sig, content))
+
+    flat_results = _verify_flat(flat)
+
+    for i in range(n):
+        row = flat_of_item[i]
+        if row is not None:
+            results[i] = flat_results[row]
+    for i, ckey, rows, leaf_keys in composites:
+        ok = all(flat_results[r] for r in rows)
+        results[i] = ok and ckey.is_fulfilled_by(set(leaf_keys))
+    return results
+
+
+def _verify_flat(
+    items: Sequence[Tuple[PublicKey, bytes, bytes]],
+) -> List[bool]:
+    """Scheme-bucketed dispatch over plain (non-composite) rows."""
     n = len(items)
     results: List[bool] = [False] * n
     buckets: dict = {}  # kernel key -> [indices]

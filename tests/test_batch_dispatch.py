@@ -53,6 +53,67 @@ def test_ed25519_bucket_hits_device_kernel(monkeypatch):
     assert calls.get("hit")
 
 
+def _composite_item(n_leaves=3, threshold=2, sign_with=None, tamper=False):
+    """One (CompositeKey, serialized sigs, content) item with ed25519 leaves."""
+    from corda_tpu.core.crypto.composite import (
+        CompositeKey,
+        CompositeSignaturesWithKeys,
+    )
+
+    kps = [crypto.generate_keypair(EDDSA_ED25519_SHA512) for _ in range(n_leaves)]
+    builder = CompositeKey.Builder()
+    for kp in kps:
+        builder.add_key(kp.public)
+    ckey = builder.build(threshold)
+    content = b"composite batch content"
+    signers = kps if sign_with is None else [kps[i] for i in sign_with]
+    pairs = [(kp.public, crypto.do_sign(kp.private, content)) for kp in signers]
+    if tamper and pairs:
+        pub, _ = pairs[0]
+        pairs[0] = (pub, b"\x00" * 64)
+    return ckey, CompositeSignaturesWithKeys(tuple(pairs)).serialize(), content
+
+
+def test_composite_leaves_ride_device_bitmask(monkeypatch):
+    """BASELINE.md multi-sig config: composite constituents are flattened
+    into the scheme buckets and the threshold tree evaluates over the
+    device kernel's bitmask."""
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
+    calls = {"n": 0}
+    from corda_tpu import ops
+
+    real = ops.ed25519_verify_batch
+
+    def spy(pubs, *a, **k):
+        calls["n"] = len(pubs)
+        return real(pubs, *a, **k)
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", spy)
+    good = _composite_item(n_leaves=3, threshold=2)
+    plain = _items([EDDSA_ED25519_SHA512] * 2, tamper_idx={1})
+    out = crypto_batch.verify_batch([plain[0], good, plain[1]])
+    assert out == [True, True, False]
+    # 3 composite leaves + 2 plain sigs all rode one device bucket
+    assert calls["n"] == 5
+
+
+def test_composite_semantics_match_host_path():
+    """Flattened evaluation must agree with CompositeKey.verify_composite
+    for: all-signed, threshold-met subset, below-threshold subset, one
+    invalid constituent, malformed blob."""
+    cases = [
+        _composite_item(),                                  # all 3 sign
+        _composite_item(sign_with=[0, 2]),                  # 2 of 3: meets
+        _composite_item(sign_with=[1]),                     # 1 of 3: below
+        _composite_item(tamper=True),                       # invalid leaf
+    ]
+    items = [(k, s, c) for k, s, c in cases]
+    items.append((cases[0][0], b"not a composite blob", cases[0][2]))
+    out = crypto_batch.verify_batch(items)
+    host = [crypto.is_valid(k, s, c) for k, s, c in items]
+    assert out == host == [True, True, False, False, False]
+
+
 def test_small_buckets_stay_on_host(monkeypatch):
     from corda_tpu import ops
 

@@ -25,14 +25,8 @@ STATE = os.path.join(CAPDIR, "state.json")
 LOG = os.path.join(CAPDIR, "log.jsonl")
 STOP = os.path.join(CAPDIR, "STOP")
 
-PROBE = (
-    "import jax, jax.numpy as jnp\n"
-    "d = jax.devices()\n"
-    "v = int(jax.jit(lambda x: x.sum())(jnp.arange(8, dtype=jnp.uint32))"
-    ".block_until_ready())\n"
-    "assert v == 28, v\n"
-    "print('PLATFORM=' + d[0].platform)\n"
-)
+sys.path.insert(0, REPO)
+from bench import PROBE_SNIPPET as PROBE  # noqa: E402  (shared liveness criteria)
 
 ECDSA_SMOKE = """
 import time
@@ -100,7 +94,8 @@ def bench_step(blk, chunk, fast):
     }
 
 
-def steps():
+def steps(fail_counts=None):
+    fail_counts = fail_counts or {}
     out = [
         # The gate number first: defaults, one compile.
         bench_step(512, 65536, True),
@@ -118,13 +113,6 @@ def steps():
         bench_step(256, 65536, True),
         bench_step(1024, 65536, True),
         bench_step(512, 131072, True),
-        # ECDSA with fast-mul off, to isolate if the smoke test failed.
-        {
-            "name": "ecdsa-smoke-densemul",
-            "argv": [sys.executable, "-c", ECDSA_SMOKE],
-            "env": bench_env(CORDA_TPU_LOG="info", CORDA_TPU_FAST_MUL=0),
-            "timeout": 2400,
-        },
         # Pallas-under-shard_map lowering on a 1-device mesh.
         {
             "name": "mesh-smoke",
@@ -142,6 +130,15 @@ def steps():
             "require_tpu_line": True,
         },
     ]
+    if fail_counts.get("ecdsa-smoke"):
+        # isolate a fast-mul-specific Mosaic rejection only when the
+        # default smoke actually failed (don't spend tunnel time otherwise)
+        out.insert(3, {
+            "name": "ecdsa-smoke-densemul",
+            "argv": [sys.executable, "-c", ECDSA_SMOKE],
+            "env": bench_env(CORDA_TPU_LOG="info", CORDA_TPU_FAST_MUL=0),
+            "timeout": 2400,
+        })
     return out
 
 
@@ -161,8 +158,11 @@ def load_state():
 
 
 def save_state(st):
-    with open(STATE, "w") as f:
+    # atomic: a crash mid-write must not destroy the resume state
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(st, f, indent=1)
+    os.replace(tmp, STATE)
 
 
 def probe(timeout=60):
@@ -225,11 +225,14 @@ def main():
         if os.path.exists(STOP):
             log({"step": "daemon-stop", "reason": "STOP file"})
             return 0
-        todo = [s for s in steps()
+        todo = [s for s in steps(st["fail_counts"])
                 if s["name"] not in st["done"]
                 and st["fail_counts"].get(s["name"], 0) < 4]
         if not todo:
-            log({"step": "daemon-done", "done": st["done"]})
+            abandoned = [n for n, c in st["fail_counts"].items()
+                         if c >= 4 and n not in st["done"]]
+            log({"step": "daemon-done", "done": st["done"],
+                 "abandoned": abandoned})
             return 0
         alive, why = probe()
         if not alive:
