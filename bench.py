@@ -144,12 +144,23 @@ def main() -> None:
                 "vs_baseline": round(rate / PER_CHIP_BASELINE, 4),
                 "batch": batch,
                 "backend": jax.devices()[0].platform,
+                # a TPU number served by the XLA fallback (or with the
+                # fast-mul variants silently dropped) must be visibly
+                # tagged — hw_capture refuses to mark such runs captured
+                "pallas_fallback": ed25519_batch._pallas_failed_once,
+                "fast_mul": _fast_mul_state(),
                 "end_to_end": True,
                 **({"note": tunnel_note} if tunnel_note else {}),
                 **extras,
             }
         )
     )
+
+
+def _fast_mul_state() -> bool:
+    from corda_tpu.ops import ed25519_pallas
+
+    return ed25519_pallas._FAST_MUL_ENABLED
 
 
 def _secondary_rates(on_tpu: bool, rng) -> dict:

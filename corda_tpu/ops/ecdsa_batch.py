@@ -258,7 +258,7 @@ def verify_batch(
     kwargs, real = prepare_batch(
         curve_name, public_keys, signatures, messages, pad_to=pad
     )
-    if on_tpu and not _pallas_failed_once:
+    while on_tpu and not _pallas_failed_once:
         try:
             mask = _pl.verify_kernel_pallas(
                 curve_name,
@@ -271,12 +271,24 @@ def verify_batch(
             )
             return [bool(b) for b in np.asarray(mask)[0, :real]]
         except Exception:
-            # the Pallas path must never sink verification: log once and
-            # serve everything from the portable XLA kernel from here on
-            _pallas_failed_once = True
+            # the Pallas path must never sink verification: first drop
+            # the fast-mul variants (the one Mosaic-lowering unknown,
+            # docs/perf-roofline.md) and retry; then log once and serve
+            # everything from the portable XLA kernel from here on
             import logging
 
-            logging.getLogger(__name__).exception(
+            from . import ed25519_pallas as _ed
+
+            log = logging.getLogger(__name__)
+            if _ed._FAST_MUL_ENABLED:
+                log.exception(
+                    "Pallas ECDSA kernel failed with fast-mul on; "
+                    "retrying with the dense multiply"
+                )
+                _ed._FAST_MUL_ENABLED = False
+                continue
+            _pallas_failed_once = True
+            log.exception(
                 "Pallas ECDSA kernel failed; falling back to the XLA "
                 "kernel for the rest of this process"
             )

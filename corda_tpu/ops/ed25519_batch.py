@@ -310,22 +310,58 @@ def _dispatch_pallas(kwargs):
     )
 
 
+#: set after the Pallas kernel failed with fast-mul already off — every
+#: later batch goes straight to the portable XLA kernel (same latch as
+#: ecdsa_batch._pallas_failed_once)
+_pallas_failed_once = False
+
+
 def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
     """TPU path: chunked software pipeline — the host parses/hashes chunk
     i+1 while the device runs chunk i (JAX dispatch is async; results are
     only synchronised at the end), so end-to-end throughput approaches
-    max(host-prep rate, kernel rate) instead of their sum."""
+    max(host-prep rate, kernel rate) instead of their sum.
+
+    Degrades instead of sinking the caller (the bench gate and the
+    verifier hot path both live here): if the kernel fails to compile or
+    run with the fast-mul variants on — the one lowering question only
+    real hardware answers (docs/perf-roofline.md) — it retries with the
+    dense multiply (measured working on-chip round 2); if THAT fails,
+    it latches over to the portable XLA kernel."""
+    import logging
+
     from . import ed25519_pallas as _pl
 
+    global _pallas_failed_once
     n = len(public_keys)
-    pending = []
-    for lo in range(0, n, _PIPE_CHUNK):
-        hi = min(lo + _PIPE_CHUNK, n)
-        pad = max(_bucket(hi - lo), _pl.BLK)
-        kwargs, real = prepare_batch(
-            public_keys[lo:hi], signatures[lo:hi], messages[lo:hi], pad_to=pad
-        )
-        pending.append((_dispatch_pallas(kwargs), real))
-    return np.concatenate(
-        [np.asarray(m)[0, :real].astype(bool) for m, real in pending]
-    )
+    while not _pallas_failed_once:
+        try:
+            pending = []
+            for lo in range(0, n, _PIPE_CHUNK):
+                hi = min(lo + _PIPE_CHUNK, n)
+                pad = max(_bucket(hi - lo), _pl.BLK)
+                kwargs, real = prepare_batch(
+                    public_keys[lo:hi], signatures[lo:hi], messages[lo:hi],
+                    pad_to=pad,
+                )
+                pending.append((_dispatch_pallas(kwargs), real))
+            return np.concatenate(
+                [np.asarray(m)[0, :real].astype(bool) for m, real in pending]
+            )
+        except Exception:
+            log = logging.getLogger(__name__)
+            if _pl._FAST_MUL_ENABLED:
+                log.exception(
+                    "Pallas ed25519 kernel failed with fast-mul on; "
+                    "retrying with the dense multiply"
+                )
+                _pl._FAST_MUL_ENABLED = False
+                continue
+            _pallas_failed_once = True
+            log.exception(
+                "Pallas ed25519 kernel failed; falling back to the "
+                "portable XLA kernel for the rest of this process"
+            )
+    kwargs, real = prepare_batch(public_keys, signatures, messages)
+    mask = verify_kernel(**kwargs)
+    return np.asarray(mask)[:real]

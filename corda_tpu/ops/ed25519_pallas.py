@@ -536,46 +536,70 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
     return ((ok_in != 0) & ok_a & ok_r & eq_x & eq_y).astype(jnp.uint32)
 
 
-def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref, ok_ref,
-            out_ref, tab_ref, idx_ref):
-    def write_table(e, rows):
-        tab_ref[e * 64 : e * 64 + 64, :] = rows
+def _make_kernel(fast_mul: bool):
+    """Kernel body closure over the fast-mul choice. The choice must be a
+    compile-time parameter (it is part of the jit cache key below): if it
+    were read from the module global at trace time, flipping the global
+    after a cached compile could never reach a retry with the same shapes."""
 
-    def read_table(e):
-        return tab_ref[e * 64 : e * 64 + 64, :]
+    def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref,
+                ok_ref, out_ref, tab_ref, idx_ref):
+        def write_table(e, rows):
+            tab_ref[e * 64 : e * 64 + 64, :] = rows
 
-    def write_idx(t, row):
-        idx_ref[t : t + 1, :] = row
+        def read_table(e):
+            return tab_ref[e * 64 : e * 64 + 64, :]
 
-    def read_idx(t):
-        return idx_ref[pl.ds(t, 1), :]
+        def write_idx(t, row):
+            idx_ref[t : t + 1, :] = row
 
-    # trace-time switch: the fast-mul variants lower well under Mosaic
-    # but blow up XLA CPU compiles, so they are enabled only while this
-    # TPU kernel body is being traced, on this thread only (module
-    # comment at _FAST_MUL_TLS)
-    with _fast_mul_trace(_FAST_MUL_ENABLED):
-        out_ref[:] = _verify_core(
-            BLK,
-            y_a_ref[:],
-            sign_a_ref[:],
-            y_r_ref[:],
-            sign_r_ref[:],
-            s_ref[:],
-            h_ref[:],
-            ok_ref[:],
-            write_table,
-            read_table,
-            write_idx,
-            read_idx,
-        )
+        def read_idx(t):
+            return idx_ref[pl.ds(t, 1), :]
+
+        # trace-time switch: the fast-mul variants lower well under Mosaic
+        # but blow up XLA CPU compiles, so they are enabled only while this
+        # TPU kernel body is being traced, on this thread only (module
+        # comment at _FAST_MUL_TLS)
+        with _fast_mul_trace(fast_mul):
+            out_ref[:] = _verify_core(
+                BLK,
+                y_a_ref[:],
+                sign_a_ref[:],
+                y_r_ref[:],
+                sign_r_ref[:],
+                s_ref[:],
+                h_ref[:],
+                ok_ref[:],
+                write_table,
+                read_table,
+                write_idx,
+                read_idx,
+            )
+
+    return _kernel
 
 
-@jax.jit
-def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok):
+def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
+                         fast_mul=None):
     """Transposed inputs: y_*_t (16, B), sign_* (1, B), s_t/h_t (8, B),
     s_ok (1, B) uint32. B must be a multiple of BLK. Returns (1, B) uint32
-    pass/fail."""
+    pass/fail. `fast_mul` defaults to the module flag, resolved HERE
+    (outside jit) so a post-failure flip reaches the next call as a new
+    static argument instead of hitting the stale cached executable."""
+    if fast_mul is None:
+        fast_mul = _FAST_MUL_ENABLED
+    return _verify_kernel_pallas_jit(
+        y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
+        fast_mul=bool(fast_mul),
+    )
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("fast_mul",))
+def _verify_kernel_pallas_jit(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
+                              *, fast_mul):
     n = y_a_t.shape[1]
     if n % BLK != 0:
         # flooring the grid would silently skip tail lanes — refuse
@@ -588,7 +612,7 @@ def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok):
         return pl.BlockSpec((rows, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
-        _kernel,
+        _make_kernel(fast_mul),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(grid,),
         in_specs=[

@@ -15,13 +15,16 @@ fast default-on coverage:
     inverse points), batched across lanes so ONE compile covers all;
   * the Shamir digit/table indexing used by `_verify_core`.
 
-Compile cost is kept trivial by wrapping each component once in jit and
-batching test cases across the width-8 lane dimension.
+Cost is kept trivial by running the components EAGERLY (no jit):
+XLA-CPU's pipeline costs minutes for these unrolled graphs even on a
+warm persistent cache (measured: add+double 63s jitted/warm vs 3.9s
+eager), while eager per-op dispatch at width 8 is seconds. Production
+always runs these ops inside the jitted kernels; the differential
+targets the math, which is identical either way.
 """
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from corda_tpu.core.crypto import secp_math
@@ -69,10 +72,9 @@ def test_rowfield_mul_add_sub_inv(fname, field):
     a = _col_from_ints(a_int, field)
     b = _col_from_ints(b_int, field)
 
-    ops = jax.jit(
-        lambda x, y: (rf.mul(x, y), rf.add(x, y), rf.sub(x, y), rf.inv(x))
+    got_mul, got_add, got_sub, got_inv = (
+        rf.mul(a, b), rf.add(a, b), rf.sub(a, b), rf.inv(a)
     )
-    got_mul, got_add, got_sub, got_inv = ops(a, b)
     assert _ints_from_col(got_mul, field) == [
         (x * y) % field.p_int for x, y in zip(a_int, b_int)
     ]
@@ -103,13 +105,9 @@ def test_rowfield_mul_fast_differential(fname, field):
     a_int[0], b_int[0] = field.p_int - 1, field.p_int - 1
     a, b = _col_from_ints(a_int, field), _col_from_ints(b_int, field)
 
-    dense = jax.jit(rf.mul)(a, b)
-
-    def fast_mul(x, y):
-        with _fast_mul_trace():
-            return rf.mul(x, y)
-
-    fast = jax.jit(fast_mul)(a, b)
+    dense = rf.mul(a, b)
+    with _fast_mul_trace():
+        fast = rf.mul(a, b)
     assert np.array_equal(np.asarray(dense), np.asarray(fast))
     assert _ints_from_col(fast, field) == [
         (x * y) % field.p_int for x, y in zip(a_int, b_int)
@@ -122,8 +120,7 @@ def test_rowfield_predicates(fname, field):
     vals = [0, 1, field.p_int - 1, 7, 0, 7, 2, 3]
     a = _col_from_ints(vals, field)
     b = _col_from_ints([0, 1, 5, 7, 3, 0, 2, field.p_int - 3], field)
-    f = jax.jit(lambda x, y: (rf.is_zero(x), rf.eq(x, y)))
-    is_zero, eq = f(a, b)
+    is_zero, eq = rf.is_zero(a), rf.eq(a, b)
     assert [bool(v) for v in np.asarray(is_zero)[0]] == [
         v == 0 for v in vals
     ]
@@ -172,13 +169,8 @@ def test_row_point_ops_vs_host_oracle(cname):
     X2, Y2, Z2 = to_cols(pts2)
     a_mont = rf.mont_const(a_int % field.p_int, W)
 
-    f = jax.jit(
-        lambda *args: (
-            ecdsa_pallas._add_general(rf, a_mont, *args),
-            _double(rf, a_mont, args[0], args[1], args[2]),
-        )
-    )
-    (AX, AY, AZ), (DX, DY, DZ) = f(X1, Y1, Z1, X2, Y2, Z2)
+    AX, AY, AZ = ecdsa_pallas._add_general(rf, a_mont, X1, Y1, Z1, X2, Y2, Z2)
+    DX, DY, DZ = _double(rf, a_mont, X1, Y1, Z1)
 
     def affine(xc, yc, zc, lane):
         x = _ints_from_col(xc, field)[lane]

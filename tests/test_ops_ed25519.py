@@ -212,6 +212,83 @@ class TestFastMulVariants:
             assert [int(v) for v in got[:, j]] == want, j
 
 
+class TestPallasDegradation:
+    """A Mosaic rejection must never sink verification (or the bench
+    gate): fast-mul failure retries dense; dense failure latches over to
+    the portable XLA kernel. Simulated by a raising dispatch — the same
+    exception path a real compile error takes."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_globals(self):
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        saved_fast = pl_mod._FAST_MUL_ENABLED
+        saved_failed = ed25519_batch._pallas_failed_once
+        yield
+        pl_mod._FAST_MUL_ENABLED = saved_fast
+        ed25519_batch._pallas_failed_once = saved_failed
+
+    def _batch(self, n=6):
+        rng = np.random.default_rng(11)
+        pubs, sigs, msgs = [], [], []
+        for i in range(n):
+            seed = rng.bytes(32)
+            msg = rng.bytes(32)
+            pubs.append(ed25519_math.public_from_seed(seed))
+            sig = ed25519_math.sign(seed, msg)
+            if i == 2:
+                sig = bytes([sig[0] ^ 1]) + sig[1:]
+            sigs.append(sig)
+            msgs.append(msg)
+        expect = [
+            ed25519_math.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+        ]
+        return pubs, sigs, msgs, expect
+
+    def test_fast_failure_retries_dense_then_xla(self, monkeypatch):
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        pl_mod._FAST_MUL_ENABLED = True
+        ed25519_batch._pallas_failed_once = False
+        attempts = []
+
+        def boom(kwargs):
+            attempts.append(pl_mod._FAST_MUL_ENABLED)
+            raise RuntimeError("Mosaic lowering failed (simulated)")
+
+        monkeypatch.setattr(ed25519_batch, "_dispatch_pallas", boom)
+        pubs, sigs, msgs, expect = self._batch()
+        out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out] == expect  # served by the XLA kernel
+        assert attempts == [True, False]  # fast try, then dense try
+        assert ed25519_batch._pallas_failed_once
+        # latched: the next batch goes straight to XLA, no new attempts
+        out2 = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out2] == expect
+        assert attempts == [True, False]
+
+    def test_fast_failure_with_working_dense_stays_on_pallas(
+        self, monkeypatch
+    ):
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        pl_mod._FAST_MUL_ENABLED = True
+        ed25519_batch._pallas_failed_once = False
+
+        def flaky(kwargs):
+            if pl_mod._FAST_MUL_ENABLED:
+                raise RuntimeError("fast-mul rejected (simulated)")
+            mask = ed25519_batch.verify_kernel(**kwargs)
+            return mask[None, :]
+
+        monkeypatch.setattr(ed25519_batch, "_dispatch_pallas", flaky)
+        pubs, sigs, msgs, expect = self._batch()
+        out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out] == expect
+        assert not ed25519_batch._pallas_failed_once  # dense Pallas serves
+        assert not pl_mod._FAST_MUL_ENABLED
+
+
 class TestPallasCore:
     def test_verify_core_off_tpu(self):
         """The Pallas kernel's math core (`ed25519_pallas._verify_core`) run

@@ -45,7 +45,9 @@ assert all(out), "ECDSA verify_batch returned failures"
 assert not ecdsa_batch._pallas_failed_once, (
     "dispatch fell back to the portable XLA kernel -- the Pallas kernel "
     "did NOT run; see the 'Pallas ECDSA kernel failed' log above")
-print(f"ECDSA-SMOKE-OK wall={time.time()-t0:.1f}s")
+from corda_tpu.ops import ed25519_pallas
+print(f"ECDSA-SMOKE-OK wall={time.time()-t0:.1f}s "
+      f"fast_mul_survived={ed25519_pallas._FAST_MUL_ENABLED}")
 """
 
 MESH_SMOKE = """
@@ -94,7 +96,7 @@ def bench_step(blk, chunk, fast):
     }
 
 
-def steps(fail_counts=None):
+def steps(fail_counts=None, done=()):
     fail_counts = fail_counts or {}
     out = [
         # The gate number first: defaults, one compile.
@@ -130,7 +132,7 @@ def steps(fail_counts=None):
             "require_tpu_line": True,
         },
     ]
-    if fail_counts.get("ecdsa-smoke"):
+    if fail_counts.get("ecdsa-smoke") and "ecdsa-smoke" not in done:
         # isolate a fast-mul-specific Mosaic rejection only when the
         # default smoke actually failed (don't spend tunnel time otherwise)
         out.insert(3, {
@@ -210,9 +212,15 @@ def run_step(step):
     if out.returncode != 0 or not line:
         rec["stderr_tail"] = out.stderr[-1500:]
     if step.get("require_tpu_line"):
-        # a CPU-fallback line (or a lost/unparseable JSON line) means the
-        # run is NOT a captured-on-TPU result: leave it incomplete
-        rec["ok"] = rec["ok"] and rec.get("result", {}).get("backend") == "tpu"
+        # a CPU-fallback line, a lost/unparseable JSON line, or a TPU
+        # number silently served by the XLA fallback means the run is
+        # NOT a captured-Pallas-on-TPU result: leave it incomplete
+        res = rec.get("result", {})
+        rec["ok"] = bool(
+            rec["ok"]
+            and res.get("backend") == "tpu"
+            and not res.get("pallas_fallback", False)
+        )
     return rec
 
 
@@ -225,7 +233,7 @@ def main():
         if os.path.exists(STOP):
             log({"step": "daemon-stop", "reason": "STOP file"})
             return 0
-        todo = [s for s in steps(st["fail_counts"])
+        todo = [s for s in steps(st["fail_counts"], st["done"])
                 if s["name"] not in st["done"]
                 and st["fail_counts"].get(s["name"], 0) < 4]
         if not todo:
