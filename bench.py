@@ -148,7 +148,8 @@ def main() -> None:
                 # fast-mul variants silently dropped) must be visibly
                 # tagged — hw_capture refuses to mark such runs captured
                 "pallas_fallback": ed25519_batch._pallas_failed_once,
-                "fast_mul": _fast_mul_state(),
+                "fast_mul": _kernel_flag("_FAST_MUL_ENABLED"),
+                "radix13": _kernel_flag("_RADIX13_ENABLED"),
                 "end_to_end": True,
                 **({"note": tunnel_note} if tunnel_note else {}),
                 **extras,
@@ -157,10 +158,10 @@ def main() -> None:
     )
 
 
-def _fast_mul_state() -> bool:
+def _kernel_flag(name: str) -> bool:
     from corda_tpu.ops import ed25519_pallas
 
-    return ed25519_pallas._FAST_MUL_ENABLED
+    return getattr(ed25519_pallas, name)
 
 
 def _secondary_rates(on_tpu: bool, rng) -> dict:

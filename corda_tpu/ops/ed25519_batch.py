@@ -350,6 +350,13 @@ def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
             )
         except Exception:
             log = logging.getLogger(__name__)
+            if _pl._RADIX13_ENABLED:
+                log.exception(
+                    "Pallas ed25519 kernel failed with radix-13 limbs; "
+                    "retrying with the radix-16 field"
+                )
+                _pl._RADIX13_ENABLED = False
+                continue
             if _pl._FAST_MUL_ENABLED:
                 log.exception(
                     "Pallas ed25519 kernel failed with fast-mul on; "

@@ -73,9 +73,7 @@ def _limbs(x: int):
 
 _P_LIMBS = _limbs(P_INT)
 _TWOP_LIMBS = _limbs(2 * P_INT)
-_D_LIMBS = _limbs(D_INT)
-_D2_LIMBS = _limbs(2 * D_INT % P_INT)
-_SQRT_M1_LIMBS = _limbs(SQRT_M1_INT)
+_D2_INT = 2 * D_INT % P_INT
 
 
 def _const_col(limbs, width):
@@ -190,11 +188,245 @@ def _square_fast(a):
     return _reduce(d)
 
 
+# --- radix-2^13 field variant (20 limbs on sublanes) ------------------------
+#
+# The 16-bit-limb multiply must split every row product into lo/hi
+# halfwords immediately (products are full 32-bit), which costs 2 mask/
+# shift ops and doubles the accumulation adds. With 13-bit limbs the
+# products are 26-bit and a whole schoolbook column (<= 20 terms) sums
+# below 2^31 — no splitting at all, ONE carry normalization at the end:
+# ~26% fewer element-ops per multiply with live-row accumulation, ~33%
+# fewer in the dense form (docs/perf-roofline.md, round-3 addendum).
+#
+# Representation notes (all differential-tested against python ints):
+#   * field element = (20, W) uint32, limbs < 2^13 (tiny transient slack
+#     from _reduce13's bounded final carry is tolerated by the product
+#     bound, same trick as the 16-bit _reduce);
+#   * 20*13 = 260 bits, so values are NOT clamped near p by capacity
+#     (2^260 ~ 32p). The algebra is mod-p correct throughout; only
+#     parity/zero tests need a true canonical value, via a binary
+#     descent of conditional subtractions of 16p..p (_canonical13);
+#   * 2^260 ≡ 608 (mod p) replaces the 16-bit scheme's 2^256 ≡ 38.
+# The switch is trace-time + thread-local like fast-mul: the Pallas
+# kernel enables it per compile (static jit arg), off-TPU tests via
+# _radix13_trace. The portable XLA kernel and host prep stay 16-bit;
+# the kernel converts its (16, W) inputs on entry (_rows16_to_13).
+
+ROWS13 = 20
+_MASK13 = np.uint32(0x1FFF)
+_F13 = np.uint32(608)  # 2^260 mod p
+
+_RADIX_ENV = os.environ.get("CORDA_TPU_ED25519_RADIX", "16")
+if _RADIX_ENV not in ("13", "16"):
+    raise ValueError(
+        f"CORDA_TPU_ED25519_RADIX={_RADIX_ENV}: must be 13 or 16"
+    )
+#: default radix for the Pallas kernel (A/B knob for tools/hw_capture.py;
+#: the off-TPU XLA kernel and host prep are always radix-16)
+_RADIX13_ENABLED = _RADIX_ENV == "13"
+
+
+def _limbs13(x: int):
+    return [(x >> (13 * k)) & 0x1FFF for k in range(ROWS13)]
+
+
+_P13 = _limbs13(P_INT)
+# descending multiples of p for canonicalization from < 2^260 ~ 32p
+_CANON13_STEPS = [_limbs13(m * P_INT) for m in (16, 8, 4, 2, 1, 1)]
+
+_R13_TLS = _threading.local()
+
+
+def _r13_active() -> bool:
+    return getattr(_R13_TLS, "active", False)
+
+
+@_contextmanager
+def _radix13_trace(enabled: bool = True):
+    prev = _r13_active()
+    _R13_TLS.active = enabled
+    try:
+        yield
+    finally:
+        _R13_TLS.active = prev
+
+
+def _fe_rows() -> int:
+    """Rows of a field element under the active radix."""
+    return ROWS13 if _r13_active() else 16
+
+
+def _cur_limbs(x: int):
+    return _limbs13(x) if _r13_active() else _limbs(x)
+
+
+def _rows16_to_13(a16):
+    """(16, W) 16-bit rows -> (20, W) 13-bit rows, value-preserving
+    (static bit plumbing; each 13-bit window spans <= two 16-bit limbs)."""
+    rows = []
+    for k in range(ROWS13):
+        bit = 13 * k
+        w, off = bit // 16, bit % 16
+        v = a16[w : w + 1] >> np.uint32(off)
+        if off > 3 and w + 1 < 16:  # window crosses into the next limb
+            v = v | (a16[w + 1 : w + 2] << np.uint32(16 - off))
+        rows.append(v & _MASK13)
+    return jnp.concatenate(rows, axis=0)
+
+
+def _reduce13(d):
+    """(N, W) coefficients (each < 2^31) -> (20, W) strict-limb value
+    congruent mod p. N is 39 from a product, 20 from an add.
+
+    One full carry chain normalizes to strict digits; rows >= 20 (plus
+    the chain carry) fold back with *608; a second chain + a bounded
+    final-carry fold finish. The last fold's ripple is cut after 8 rows —
+    row 8 may keep 1-ulp slack, which the product bound absorbs
+    (20*(2^13+2)^2 < 2^32), mirroring the 16-bit _reduce's tail."""
+    n = d.shape[0]
+    out = []
+    carry = None
+    for k in range(n):
+        v = d[k : k + 1] if carry is None else d[k : k + 1] + carry
+        out.append(v & _MASK13)
+        carry = v >> 13
+    lo = out[:ROWS13]
+    his = out[ROWS13:] + [carry]
+    for k, h in enumerate(his):
+        lo[k] = lo[k] + _F13 * h
+    out2 = []
+    carry = None
+    for k in range(ROWS13):
+        v = lo[k] if carry is None else lo[k] + carry
+        out2.append(v & _MASK13)
+        carry = v >> 13
+    v0 = out2[0] + _F13 * carry
+    out2[0] = v0 & _MASK13
+    c = v0 >> 13
+    for k in range(1, 8):
+        v = out2[k] + c
+        out2[k] = v & _MASK13
+        c = v >> 13
+    out2[8] = out2[8] + c
+    return jnp.concatenate(out2, axis=0)
+
+
+def _mul13(a, b):
+    """Radix-13 schoolbook: no lo/hi splitting (products are 26-bit and
+    column sums < 20*2^26 < 2^31)."""
+    w = a.shape[1]
+    if _fast_mul_active():
+        acc = _zeros(2 * ROWS13 - 1, w)
+        for i in range(ROWS13):
+            acc = acc.at[i : i + ROWS13].add(a[i : i + 1] * b)
+    else:
+        acc = _zeros(2 * ROWS13 - 1, w)
+        for i in range(ROWS13):
+            p = a[i : i + 1] * b
+            acc = acc + _cat(
+                [_zeros(i, w), p, _zeros(ROWS13 - 1 - i, w)]
+            )
+    return _reduce13(acc)
+
+
+def _square13(a):
+    """a^2 via symmetry: cross terms doubled (column sums < 10*2^27 +
+    2^26 < 2^31)."""
+    w = a.shape[1]
+    acc = _zeros(2 * ROWS13 - 1, w)
+    if _fast_mul_active():
+        for i in range(ROWS13):
+            diag = a[i : i + 1] * a[i : i + 1]
+            acc = acc.at[2 * i : 2 * i + 1].add(diag)
+            if i + 1 < ROWS13:
+                p = a[i : i + 1] * a[i + 1 :]
+                rows = p.shape[0]
+                acc = acc.at[2 * i + 1 : 2 * i + 1 + rows].add(p + p)
+    else:
+        for i in range(ROWS13):
+            diag = a[i : i + 1] * a[i : i + 1]
+            acc = acc + _cat(
+                [_zeros(2 * i, w), diag, _zeros(2 * ROWS13 - 2 - 2 * i, w)]
+            )
+            if i + 1 < ROWS13:
+                p = a[i : i + 1] * a[i + 1 :]
+                rows = p.shape[0]
+                acc = acc + _cat(
+                    [
+                        _zeros(2 * i + 1, w),
+                        p + p,
+                        _zeros(2 * ROWS13 - 2 - 2 * i - rows, w),
+                    ]
+                )
+    return _reduce13(acc)
+
+
+def _mul_const13(a, limbs):
+    """a times compile-time 13-bit limbs (zero rows skipped)."""
+    w = a.shape[1]
+    acc = _zeros(2 * ROWS13 - 1, w)
+    for i in range(ROWS13):
+        if limbs[i] == 0:
+            continue
+        p = np.uint32(limbs[i]) * a
+        acc = acc + _cat([_zeros(i, w), p, _zeros(ROWS13 - 1 - i, w)])
+    return _reduce13(acc)
+
+
+def _sub13(a, b):
+    """a - b mod p for values < 2^260: borrow chain of a - b + 2C where
+    C = 2^260 - 608 ≡ 0 (mod p). 2C = 2^261 - 1216 has a 21st limb
+    (value 1 at position 2^260), carried implicitly: the chain's carry-out
+    plus that limb is the total digit at 2^260, which is ALWAYS >= 0
+    (a - b > -2^260 and 2C - 2^260 = 2^260 - 1216), so there is no
+    negative tail case; the digit folds via *608 (2^260 ≡ 608 mod p)."""
+    two_c = _limbs13(2 * (2**260 - 608))  # truncated to 20 limbs
+    rows = []
+    carry = None
+    for k in range(ROWS13):
+        v = (
+            a[k : k + 1].astype(jnp.int32)
+            - b[k : k + 1].astype(jnp.int32)
+            + np.int32(two_c[k])
+        )
+        if carry is not None:
+            v = v + carry
+        rows.append((v & 0x1FFF).astype(jnp.uint32))
+        carry = v >> 13
+    digit_260 = (carry + 1).astype(jnp.uint32)  # +1 = 2C's implicit top limb
+    rows[0] = rows[0] + digit_260 * _F13
+    return _reduce13(jnp.concatenate(rows, axis=0))
+
+
+def _cond_sub13(a, limbs):
+    rows = []
+    carry = None
+    for k in range(ROWS13):
+        v = a[k : k + 1].astype(jnp.int32) - np.int32(limbs[k])
+        if carry is not None:
+            v = v + carry
+        rows.append((v & 0x1FFF).astype(jnp.uint32))
+        carry = v >> 13
+    geq = carry == 0
+    return jnp.where(geq, jnp.concatenate(rows, axis=0), a), geq
+
+
+def _canonical13(a):
+    """True canonical (< p) from any strict-limb value < 2^260 ~ 32p:
+    binary descent over conditional subtractions of 16p, 8p, 4p, 2p, p, p."""
+    r = a
+    for limbs in _CANON13_STEPS:
+        r, _ = _cond_sub13(r, limbs)
+    return r
+
+
 def _mul(a, b):
     """Schoolbook product via shifted accumulation; all ops dense (W lanes).
 
     Row products a_i * b fit uint32 exactly (16x16-bit limbs); coefficient
     sums <= 32 halfword terms < 2^21; the *38 fold keeps < 2^27."""
+    if _r13_active():
+        return _mul13(a, b)
     if _fast_mul_active():
         return _mul_fast(a, b)
     w = a.shape[1]
@@ -212,6 +444,8 @@ def _mul(a, b):
 def _square(a):
     """a^2 exploiting symmetry: off-diagonal halfwords doubled (< 2^17;
     coefficient sums stay < 2^21), ~0.6x the products of _mul."""
+    if _r13_active():
+        return _square13(a)
     if _fast_mul_active():
         return _square_fast(a)
     w = a.shape[1]
@@ -237,7 +471,10 @@ def _square(a):
 
 
 def _mul_const(a, limbs):
-    """a times compile-time limbs: same structure as _mul, constant rows."""
+    """a times compile-time limbs: same structure as _mul, constant rows.
+    `limbs` must be in the ACTIVE radix (call sites use _cur_limbs)."""
+    if _r13_active():
+        return _mul_const13(a, limbs)
     w = a.shape[1]
     c = _zeros(32, w)
     for i in range(16):
@@ -253,12 +490,16 @@ def _mul_const(a, limbs):
 
 
 def _add(a, b):
+    if _r13_active():
+        return _reduce13(a + b)
     return _reduce(a + b)
 
 
 def _sub(a, b):
     """a - b via a + 2p - b with a signed borrow chain (bounds as in
     ops/fe25519.py `sub`)."""
+    if _r13_active():
+        return _sub13(a, b)
     twop = np.asarray(_TWOP_LIMBS, np.int32)
     rows = []
     carry = None
@@ -286,6 +527,8 @@ def _neg(a):
 
 
 def _cond_sub_p(a):
+    if _r13_active():
+        return _cond_sub13(a, _P13)
     rows = []
     carry = None
     for k in range(16):
@@ -299,6 +542,8 @@ def _cond_sub_p(a):
 
 
 def _canonical(a):
+    if _r13_active():
+        return _canonical13(a)
     r, _ = _cond_sub_p(a)
     r, _ = _cond_sub_p(r)
     return r
@@ -312,7 +557,7 @@ def _lt_p(a):
 def _is_zero(a):
     c = _canonical(a)
     acc = c[0:1]
-    for k in range(1, 16):
+    for k in range(1, _fe_rows()):
         acc = acc | c[k : k + 1]
     return acc == 0
 
@@ -366,7 +611,7 @@ def _pt_add(p, q):
     X2, Y2, Z2, T2 = q
     a = _mul(_sub(Y1, X1), _sub(Y2, X2))
     b = _mul(_add(Y1, X1), _add(Y2, X2))
-    c = _mul_const(_mul(T1, T2), _D2_LIMBS)
+    c = _mul_const(_mul(T1, T2), _cur_limbs(_D2_INT))
     zz = _mul(Z1, Z2)
     d = _add(zz, zz)
     e, f, g, h = _sub(b, a), _sub(d, c), _add(d, c), _add(b, a)
@@ -377,7 +622,10 @@ def _to_cached(p):
     """Extended point -> cached form (Y+X, Y-X, 2Z, 2d*T) for the ladder
     add: saves one constant mul and three add/subs per iteration."""
     X, Y, Z, T = p
-    return (_add(Y, X), _sub(Y, X), _add(Z, Z), _mul_const(T, _D2_LIMBS))
+    return (
+        _add(Y, X), _sub(Y, X), _add(Z, Z),
+        _mul_const(T, _cur_limbs(_D2_INT)),
+    )
 
 
 def _pt_add_cached(p, q_cached):
@@ -411,13 +659,13 @@ def _pt_neg(p):
 
 
 def _decompress(y, sign):
-    """(16, W) y limbs + (1, W) sign -> ((x, y, 1, xy), ok (1, W))."""
+    """y limbs (active radix) + (1, W) sign -> ((x, y, 1, xy), ok (1, W))."""
     w = y.shape[1]
-    one = _const_col(_limbs(1), w)
+    one = _const_col(_cur_limbs(1), w)
     ok_y = _lt_p(y)
     y2 = _square(y)
     u = _sub(y2, one)
-    v = _add(_mul_const(y2, _D_LIMBS), one)
+    v = _add(_mul_const(y2, _cur_limbs(D_INT)), one)
     v3 = _mul(_square(v), v)
     v7 = _mul(_square(v3), v)
     t = _pow22523(_mul(u, v7))
@@ -425,7 +673,7 @@ def _decompress(y, sign):
     vx2 = _mul(v, _square(x))
     root1 = _eq(vx2, u)
     root2 = _eq(vx2, _neg(u))
-    x = _select_fe(root1, x, _mul_const(x, _SQRT_M1_LIMBS))
+    x = _select_fe(root1, x, _mul_const(x, _cur_limbs(SQRT_M1_INT)))
     ok = ok_y & (root1 | root2)
     x_is_zero = _is_zero(x)
     ok = ok & ~(x_is_zero & (sign == 1))
@@ -438,19 +686,19 @@ def _affine_const_pt(k: int, width):
     pt = ed25519_math.scalar_mult(k, ed25519_math.BASE)
     x, y = ed25519_math.to_affine(pt)
     return (
-        _const_col(_limbs(x), width),
-        _const_col(_limbs(y), width),
-        _const_col(_limbs(1), width),
-        _const_col(_limbs(x * y % P_INT), width),
+        _const_col(_cur_limbs(x), width),
+        _const_col(_cur_limbs(y), width),
+        _const_col(_cur_limbs(1), width),
+        _const_col(_cur_limbs(x * y % P_INT), width),
     )
 
 
 def _identity_pt(width):
     return (
-        _zeros(16, width),
-        _const_col(_limbs(1), width),
-        _const_col(_limbs(1), width),
-        _zeros(16, width),
+        _zeros(_fe_rows(), width),
+        _const_col(_cur_limbs(1), width),
+        _const_col(_cur_limbs(1), width),
+        _zeros(_fe_rows(), width),
     )
 
 
@@ -468,6 +716,13 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
     the same lax.fori_loop control flow — is exercised without TPU
     hardware. unroll_ladder=True remains for debugging with accessors that
     need concrete indices."""
+    R = _fe_rows()
+    if _r13_active():
+        # host prep + the portable XLA kernel stay radix-16; convert the
+        # compressed-y inputs on entry (static bit plumbing, ~80 ops per
+        # field element vs ~4.6M for the ladder)
+        y_a = _rows16_to_13(y_a)
+        y_r = _rows16_to_13(y_r)
     # Decompress A and R lane-concatenated: one pow chain for both.
     pts, oks = _decompress(
         jnp.concatenate([y_a, y_r], axis=1),
@@ -515,11 +770,11 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
         row = read_idx(t)  # (1, width)
         q = _pt_double(q, with_t=False)
         q = _pt_double(q)
-        sel = _zeros(64, width)
+        sel = _zeros(4 * R, width)
         for e in range(16):
             m = (row == e).astype(jnp.uint32)
             sel = sel + m * read_table(e)
-        sel_c = tuple(sel[c * 16 : c * 16 + 16] for c in range(4))
+        sel_c = tuple(sel[c * R : (c + 1) * R] for c in range(4))
         return _pt_add_cached(q, sel_c)
 
     if unroll_ladder:
@@ -536,19 +791,20 @@ def _verify_core(width, y_a, sign_a, y_r, sign_r, s_words, h_words, ok_in,
     return ((ok_in != 0) & ok_a & ok_r & eq_x & eq_y).astype(jnp.uint32)
 
 
-def _make_kernel(fast_mul: bool):
-    """Kernel body closure over the fast-mul choice. The choice must be a
-    compile-time parameter (it is part of the jit cache key below): if it
-    were read from the module global at trace time, flipping the global
-    after a cached compile could never reach a retry with the same shapes."""
+def _make_kernel(fast_mul: bool, radix13: bool = False):
+    """Kernel body closure over the fast-mul and radix choices. Both must
+    be compile-time parameters (part of the jit cache key below): if they
+    were read from module globals at trace time, flipping a global after
+    a cached compile could never reach a retry with the same shapes."""
+    stride = 4 * (ROWS13 if radix13 else 16)
 
     def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_ref, h_ref,
                 ok_ref, out_ref, tab_ref, idx_ref):
         def write_table(e, rows):
-            tab_ref[e * 64 : e * 64 + 64, :] = rows
+            tab_ref[e * stride : (e + 1) * stride, :] = rows
 
         def read_table(e):
-            return tab_ref[e * 64 : e * 64 + 64, :]
+            return tab_ref[e * stride : (e + 1) * stride, :]
 
         def write_idx(t, row):
             idx_ref[t : t + 1, :] = row
@@ -560,7 +816,7 @@ def _make_kernel(fast_mul: bool):
         # but blow up XLA CPU compiles, so they are enabled only while this
         # TPU kernel body is being traced, on this thread only (module
         # comment at _FAST_MUL_TLS)
-        with _fast_mul_trace(fast_mul):
+        with _fast_mul_trace(fast_mul), _radix13_trace(radix13):
             out_ref[:] = _verify_core(
                 BLK,
                 y_a_ref[:],
@@ -580,26 +836,28 @@ def _make_kernel(fast_mul: bool):
 
 
 def verify_kernel_pallas(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
-                         fast_mul=None):
+                         fast_mul=None, radix13=None):
     """Transposed inputs: y_*_t (16, B), sign_* (1, B), s_t/h_t (8, B),
     s_ok (1, B) uint32. B must be a multiple of BLK. Returns (1, B) uint32
-    pass/fail. `fast_mul` defaults to the module flag, resolved HERE
-    (outside jit) so a post-failure flip reaches the next call as a new
-    static argument instead of hitting the stale cached executable."""
+    pass/fail. `fast_mul`/`radix13` default to the module flags, resolved
+    HERE (outside jit) so a post-failure flip reaches the next call as a
+    new static argument instead of hitting the stale cached executable."""
     if fast_mul is None:
         fast_mul = _FAST_MUL_ENABLED
+    if radix13 is None:
+        radix13 = _RADIX13_ENABLED
     return _verify_kernel_pallas_jit(
         y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
-        fast_mul=bool(fast_mul),
+        fast_mul=bool(fast_mul), radix13=bool(radix13),
     )
 
 
 from functools import partial as _partial
 
 
-@_partial(jax.jit, static_argnames=("fast_mul",))
+@_partial(jax.jit, static_argnames=("fast_mul", "radix13"))
 def _verify_kernel_pallas_jit(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
-                              *, fast_mul):
+                              *, fast_mul, radix13=False):
     n = y_a_t.shape[1]
     if n % BLK != 0:
         # flooring the grid would silently skip tail lanes — refuse
@@ -611,8 +869,9 @@ def _verify_kernel_pallas_jit(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
     def spec(rows):
         return pl.BlockSpec((rows, BLK), lambda i: (0, i), memory_space=pltpu.VMEM)
 
+    fe_rows = ROWS13 if radix13 else 16
     return pl.pallas_call(
-        _make_kernel(fast_mul),
+        _make_kernel(fast_mul, radix13),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(grid,),
         in_specs=[
@@ -626,7 +885,7 @@ def _verify_kernel_pallas_jit(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok,
         ],
         out_specs=spec(1),
         scratch_shapes=[
-            pltpu.VMEM((16 * 64, BLK), jnp.uint32),  # Straus table
+            pltpu.VMEM((16 * 4 * fe_rows, BLK), jnp.uint32),  # Straus table
             pltpu.VMEM((NDIGITS + 1, BLK), jnp.uint32),  # digit rows
         ],
     )(y_a_t, sign_a, y_r_t, sign_r, s_t, h_t, s_ok)

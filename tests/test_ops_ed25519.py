@@ -212,6 +212,122 @@ class TestFastMulVariants:
             assert [int(v) for v in got[:, j]] == want, j
 
 
+class TestRadix13Field:
+    """Per-op differentials for the radix-2^13 field vs python ints —
+    including the edge paths the ladder only hits probabilistically:
+    _sub13's zero 2^260-digit case (a << b), _canonical13 at the
+    capacity ceiling (~32p), and fast/dense bit-equality. Eager (no jit):
+    the ops are tiny at W=8."""
+
+    W = 8
+
+    @staticmethod
+    def _col13(vals):
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as m
+
+        return jnp.concatenate(
+            [
+                jnp.asarray([[v] for v in m._limbs13(x)], jnp.uint32)
+                for x in vals
+            ],
+            axis=1,
+        )
+
+    @staticmethod
+    def _ints13(col):
+        from corda_tpu.ops import ed25519_pallas as m
+
+        arr = np.asarray(col)
+        return [
+            sum(int(arr[k, j]) << (13 * k) for k in range(m.ROWS13))
+            for j in range(arr.shape[1])
+        ]
+
+    def test_ops_vs_int_oracle(self):
+        from corda_tpu.ops import ed25519_pallas as m
+        from corda_tpu.ops.field25519 import P_INT
+
+        rng = np.random.default_rng(42)
+        W = self.W
+        a_i = [int.from_bytes(rng.bytes(32), "little") % P_INT
+               for _ in range(W)]
+        b_i = [int.from_bytes(rng.bytes(32), "little") % P_INT
+               for _ in range(W)]
+        a_i[0], b_i[0] = P_INT - 1, P_INT - 1
+        a_i[1], b_i[1] = 0, 0
+        a_i[2], b_i[2] = 1, P_INT - 1
+        a_i[3], b_i[3] = 0, P_INT - 1  # a << b: digit_260 == 0 in _sub13
+        a, b = self._col13(a_i), self._col13(b_i)
+        with m._radix13_trace():
+            mul_d = m._mul(a, b)
+            with m._fast_mul_trace():
+                mul_f = m._mul(a, b)
+            sq_d = m._square(a)
+            with m._fast_mul_trace():
+                sq_f = m._square(a)
+            add, sub = m._add(a, b), m._sub(a, b)
+            can = m._canonical(mul_d)
+            neg = m._neg(a)
+        assert np.array_equal(np.asarray(mul_d), np.asarray(mul_f))
+        assert np.array_equal(np.asarray(sq_d), np.asarray(sq_f))
+        P = P_INT
+        assert [v % P for v in self._ints13(mul_d)] == [
+            (x * y) % P for x, y in zip(a_i, b_i)]
+        assert [v % P for v in self._ints13(sq_d)] == [
+            (x * x) % P for x in a_i]
+        assert [v % P for v in self._ints13(add)] == [
+            (x + y) % P for x, y in zip(a_i, b_i)]
+        assert [v % P for v in self._ints13(sub)] == [
+            (x - y) % P for x, y in zip(a_i, b_i)]
+        assert self._ints13(can) == [
+            (x * y) % P for x, y in zip(a_i, b_i)]
+        assert [v % P for v in self._ints13(neg)] == [(-x) % P for x in a_i]
+
+    def test_canonical_at_capacity_and_conversion(self):
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as m
+        from corda_tpu.ops.field25519 import P_INT
+
+        # raw rows at the capacity ceiling: value 2^260 - 1 ~ 32p
+        top = jnp.full((m.ROWS13, self.W), np.uint32(0x1FFF), jnp.uint32)
+        with m._radix13_trace():
+            can = m._canonical(top)
+            sub = m._sub(self._col13([0] * self.W), top)  # 0 - (32p-ish)
+        assert self._ints13(can) == [(2**260 - 1) % P_INT] * self.W
+        assert [v % P_INT for v in self._ints13(sub)] == [
+            (-(2**260 - 1)) % P_INT] * self.W
+        # 16->13 conversion is value-preserving
+        rng = np.random.default_rng(7)
+        vals = [int.from_bytes(rng.bytes(32), "little") % 2**255
+                for _ in range(self.W)]
+        col16 = jnp.concatenate(
+            [jnp.asarray([[v] for v in m._limbs(x)], jnp.uint32)
+             for x in vals], axis=1)
+        assert self._ints13(m._rows16_to_13(col16)) == vals
+
+    def test_chained_stress(self):
+        """Interleaved mul/sub/add/square chains keep agreeing with the
+        int oracle — the bound argument holds across compositions."""
+        from corda_tpu.ops import ed25519_pallas as m
+        from corda_tpu.ops.field25519 import P_INT
+
+        rng = np.random.default_rng(3)
+        x_i = int.from_bytes(rng.bytes(32), "little") % P_INT
+        y_i = int.from_bytes(rng.bytes(32), "little") % P_INT
+        x, y = self._col13([x_i] * self.W), self._col13([y_i] * self.W)
+        with m._radix13_trace():
+            for _ in range(8):
+                x, x_i = m._mul(x, y), (x_i * y_i) % P_INT
+                y, y_i = m._sub(y, x), (y_i - x_i) % P_INT
+                x, x_i = m._add(x, x), (2 * x_i) % P_INT
+                y, y_i = m._square(y), (y_i * y_i) % P_INT
+            assert self._ints13(m._canonical(x))[0] == x_i
+            assert self._ints13(m._canonical(y))[0] == y_i
+
+
 class TestPallasDegradation:
     """A Mosaic rejection must never sink verification (or the bench
     gate): fast-mul failure retries dense; dense failure latches over to
@@ -223,9 +339,14 @@ class TestPallasDegradation:
         from corda_tpu.ops import ed25519_pallas as pl_mod
 
         saved_fast = pl_mod._FAST_MUL_ENABLED
+        saved_r13 = pl_mod._RADIX13_ENABLED
         saved_failed = ed25519_batch._pallas_failed_once
+        # pin the chain's starting rung so the expected attempt sequence
+        # is deterministic regardless of CORDA_TPU_ED25519_RADIX in the env
+        pl_mod._RADIX13_ENABLED = False
         yield
         pl_mod._FAST_MUL_ENABLED = saved_fast
+        pl_mod._RADIX13_ENABLED = saved_r13
         ed25519_batch._pallas_failed_once = saved_failed
 
     def _batch(self, n=6):
@@ -267,6 +388,30 @@ class TestPallasDegradation:
         assert [bool(b) for b in out2] == expect
         assert attempts == [True, False]
 
+    def test_r13_failure_retries_r16_first(self, monkeypatch):
+        """The radix-13 rung sits above fast-mul in the retry ladder:
+        an r13 Mosaic failure falls back to radix-16 WITHOUT giving up
+        the fast multiply or the Pallas path."""
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        pl_mod._RADIX13_ENABLED = True
+        pl_mod._FAST_MUL_ENABLED = True
+        ed25519_batch._pallas_failed_once = False
+
+        def flaky(kwargs):
+            if pl_mod._RADIX13_ENABLED:
+                raise RuntimeError("r13 rejected (simulated)")
+            mask = ed25519_batch.verify_kernel(**kwargs)
+            return mask[None, :]
+
+        monkeypatch.setattr(ed25519_batch, "_dispatch_pallas", flaky)
+        pubs, sigs, msgs, expect = self._batch()
+        out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out] == expect
+        assert not pl_mod._RADIX13_ENABLED
+        assert pl_mod._FAST_MUL_ENABLED  # fast-mul rung untouched
+        assert not ed25519_batch._pallas_failed_once
+
     def test_fast_failure_with_working_dense_stays_on_pallas(
         self, monkeypatch
     ):
@@ -290,11 +435,15 @@ class TestPallasDegradation:
 
 
 class TestPallasCore:
-    def test_verify_core_off_tpu(self):
+    @pytest.mark.parametrize("radix13", [False, True], ids=["r16", "r13"])
+    def test_verify_core_off_tpu(self, radix13):
         """The Pallas kernel's math core (`ed25519_pallas._verify_core`) run
         on CPU with array-backed table/digit accessors must agree with the
         host oracle — so a ladder/table/decompress bug cannot hide behind
-        the TPU-only dispatch (round-2 review finding)."""
+        the TPU-only dispatch (round-2 review finding). Covers BOTH limb
+        radixes (the radix-2^13 variant is the round-3 perf lever)."""
+        import contextlib
+
         import jax.numpy as jnp
 
         from corda_tpu.ops import ed25519_batch, ed25519_pallas
@@ -339,22 +488,90 @@ class TestPallasCore:
                 )
             return lax.dynamic_slice_in_dim(stacked["idx"], t, 1, axis=0)
 
-        mask = ed25519_pallas._verify_core(
-            width,
-            jnp.asarray(np.asarray(kwargs["y_a"]).T),
-            jnp.asarray(np.asarray(kwargs["sign_a"])[None, :]),
-            jnp.asarray(np.asarray(kwargs["y_r"]).T),
-            jnp.asarray(np.asarray(kwargs["sign_r"])[None, :]),
-            jnp.asarray(np.asarray(kwargs["s_words"]).T),
-            jnp.asarray(np.asarray(kwargs["h_words"]).T),
-            jnp.asarray(np.asarray(kwargs["s_ok"])[None, :].astype(np.uint32)),
-            write_table=table.__setitem__,
-            read_table=table.__getitem__,
-            write_idx=idx_rows.__setitem__,
-            read_idx=read_idx,
+        ctx = (
+            ed25519_pallas._radix13_trace()
+            if radix13
+            else contextlib.nullcontext()
         )
+        with ctx:
+            mask = ed25519_pallas._verify_core(
+                width,
+                jnp.asarray(np.asarray(kwargs["y_a"]).T),
+                jnp.asarray(np.asarray(kwargs["sign_a"])[None, :]),
+                jnp.asarray(np.asarray(kwargs["y_r"]).T),
+                jnp.asarray(np.asarray(kwargs["sign_r"])[None, :]),
+                jnp.asarray(np.asarray(kwargs["s_words"]).T),
+                jnp.asarray(np.asarray(kwargs["h_words"]).T),
+                jnp.asarray(
+                    np.asarray(kwargs["s_ok"])[None, :].astype(np.uint32)
+                ),
+                write_table=table.__setitem__,
+                read_table=table.__getitem__,
+                write_idx=idx_rows.__setitem__,
+                read_idx=read_idx,
+            )
         got = [bool(v) for v in np.asarray(mask)[0]]
         assert got == expect
+
+    def test_r13_decompress_edges_agree_with_oracle(self):
+        """The radix-13 decompress/canonicalization must agree with the
+        oracle on the adversarial encodings (small-order points,
+        non-canonical y >= p, y=0 with sign=1) — these exercise exactly
+        the code that differs by radix (_lt_p, _canonical13, parity)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from corda_tpu.ops import ed25519_batch, ed25519_pallas
+
+        msg = b"edge-case message"
+        seed = hashlib.sha256(b"edge").digest()
+        good_pub, good_seed = _keypair(seed)
+        good_sig = _sign(good_seed, msg)
+        pubs, sigs, msgs, expect = [], [], [], []
+        for enc in TestAdversarialVectors.SMALL_ORDER:
+            pubs.append(enc)
+            sigs.append(good_sig)
+            msgs.append(msg)
+            expect.append(ed25519_math.verify(enc, msg, good_sig))
+            pubs.append(good_pub)
+            sigs.append(enc + good_sig[32:])
+            msgs.append(msg)
+            expect.append(
+                ed25519_math.verify(good_pub, msg, enc + good_sig[32:])
+            )
+        width = len(pubs)
+        kwargs, _ = ed25519_batch.prepare_batch(pubs, sigs, msgs, pad_to=width)
+
+        table = {}
+        idx_rows = {}
+        stacked = {}
+
+        def read_idx(t):
+            if "idx" not in stacked:
+                stacked["idx"] = jnp.concatenate(
+                    [idx_rows[k] for k in range(ed25519_pallas.NDIGITS)],
+                    axis=0,
+                )
+            return lax.dynamic_slice_in_dim(stacked["idx"], t, 1, axis=0)
+
+        with ed25519_pallas._radix13_trace():
+            mask = ed25519_pallas._verify_core(
+                width,
+                jnp.asarray(np.asarray(kwargs["y_a"]).T),
+                jnp.asarray(np.asarray(kwargs["sign_a"])[None, :]),
+                jnp.asarray(np.asarray(kwargs["y_r"]).T),
+                jnp.asarray(np.asarray(kwargs["sign_r"])[None, :]),
+                jnp.asarray(np.asarray(kwargs["s_words"]).T),
+                jnp.asarray(np.asarray(kwargs["h_words"]).T),
+                jnp.asarray(
+                    np.asarray(kwargs["s_ok"])[None, :].astype(np.uint32)
+                ),
+                write_table=table.__setitem__,
+                read_table=table.__getitem__,
+                write_idx=idx_rows.__setitem__,
+                read_idx=read_idx,
+            )
+        assert [bool(v) for v in np.asarray(mask)[0]] == expect
 
 
 class TestAdversarialVectors:

@@ -81,14 +81,18 @@ def bench_env(**kw):
     return env
 
 
-def bench_step(blk, chunk, fast):
+def bench_step(blk, chunk, fast, radix=16):
+    name = f"headline-blk{blk}-chunk{chunk}-fast{int(fast)}"
+    if radix != 16:
+        name += f"-r{radix}"
     return {
-        "name": f"headline-blk{blk}-chunk{chunk}-fast{int(fast)}",
+        "name": name,
         "argv": [sys.executable, os.path.join(REPO, "bench.py")],
         "env": bench_env(
             CORDA_TPU_ED25519_BLK=blk,
             CORDA_TPU_PIPE_CHUNK=chunk,
             CORDA_TPU_FAST_MUL=int(fast),
+            CORDA_TPU_ED25519_RADIX=radix,
             CORDA_TPU_BENCH_HEADLINE_ONLY=1,
         ),
         "timeout": 1500,
@@ -101,8 +105,11 @@ def steps(fail_counts=None, done=()):
     out = [
         # The gate number first: defaults, one compile.
         bench_step(512, 65536, True),
+        # The round-3 perf lever: radix-2^13 limbs (no product splitting).
+        bench_step(512, 65536, True, radix=13),
         # The open Mosaic question: live-row accumulation A/B.
         bench_step(512, 65536, False),
+        bench_step(512, 65536, False, radix=13),
         # First-ever ECDSA Pallas execution on silicon (long compile ok).
         {
             "name": "ecdsa-smoke",
@@ -212,14 +219,21 @@ def run_step(step):
     if out.returncode != 0 or not line:
         rec["stderr_tail"] = out.stderr[-1500:]
     if step.get("require_tpu_line"):
-        # a CPU-fallback line, a lost/unparseable JSON line, or a TPU
-        # number silently served by the XLA fallback means the run is
-        # NOT a captured-Pallas-on-TPU result: leave it incomplete
+        # a CPU-fallback line, a lost/unparseable JSON line, a TPU number
+        # silently served by the XLA fallback, OR a run whose kernel
+        # degraded away from the REQUESTED fast_mul/radix config (the
+        # in-process retry ladder flips those flags on Mosaic failure)
+        # is NOT a capture of this step's variant: leave it incomplete
         res = rec.get("result", {})
+        env = step.get("env", {})
+        want_fast = env.get("CORDA_TPU_FAST_MUL", "1") == "1"
+        want_r13 = env.get("CORDA_TPU_ED25519_RADIX", "16") == "13"
         rec["ok"] = bool(
             rec["ok"]
             and res.get("backend") == "tpu"
             and not res.get("pallas_fallback", False)
+            and res.get("fast_mul") == want_fast
+            and res.get("radix13") == want_r13
         )
     return rec
 
