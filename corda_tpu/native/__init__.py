@@ -192,6 +192,29 @@ def sha512_mod_l_many(messages: List[bytes]):
     return out
 
 
+def sha512_mod_l_rows(rows) -> "np.ndarray":
+    """`sha512_mod_l_many` for a (n, row_len) contiguous uint8 ndarray of
+    equal-length messages: skips the per-row bytes-object build and the
+    marshal copy (the remaining host-prep overhead once hashing itself is
+    wide — see ops/ed25519_batch.prepare_batch)."""
+    import numpy as np
+
+    rows = np.ascontiguousarray(rows, np.uint8)
+    n, row_len = rows.shape
+    lib = _get_lib()
+    if lib is None or row_len == 0:
+        return sha512_mod_l_many([rows[i].tobytes() for i in range(n)])
+    offsets = (ctypes.c_uint64 * (n + 1))(
+        *range(0, (n + 1) * row_len, row_len)
+    )
+    out = np.empty((n, 8), np.uint32)
+    lib.sha512_mod_l_batch(
+        rows.ctypes.data_as(ctypes.c_char_p), offsets, n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out
+
+
 def sha256_pairs(nodes: bytes) -> bytes:
     """Hash consecutive 64-byte pairs -> concatenated 32-byte digests
     (one Merkle tree level in a single native call)."""

@@ -14,6 +14,9 @@
 
 #include <cstdint>
 #include <cstring>
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -160,6 +163,174 @@ void sha512_one(const uint8_t* msg, uint64_t len, uint8_t* out) {
             out[8*i + j] = uint8_t(h[i] >> (56 - 8*j));
 }
 
+// ---------------------------------------------------------------------------
+// 8-way SHA-512 with AVX-512 (runtime-dispatched; scalar fallback above).
+//
+// The batch hasher's callers (ed25519/ecdsa prepare_batch, Merkle levels)
+// hash thousands of SAME-LENGTH messages per call; eight of them fit one
+// zmm lane-set (8 x 64-bit). State and message schedule live transposed —
+// w[i] holds lane j's schedule word i — so all 80 rounds are straight-line
+// vector code: ror via _mm512_ror_epi64, Ch/Maj via one ternlog each.
+// Groups of exactly 8 equal-length messages take this path; remainders and
+// ragged batches keep the scalar loop.
+// ---------------------------------------------------------------------------
+#if defined(__x86_64__)
+__attribute__((target("avx512f,avx512bw")))
+static inline __m512i bswap64x8(__m512i v) {
+    const __m512i idx = _mm512_set_epi8(
+        56,57,58,59,60,61,62,63, 48,49,50,51,52,53,54,55,
+        40,41,42,43,44,45,46,47, 32,33,34,35,36,37,38,39,
+        24,25,26,27,28,29,30,31, 16,17,18,19,20,21,22,23,
+         8, 9,10,11,12,13,14,15,  0, 1, 2, 3, 4, 5, 6, 7);
+    return _mm512_shuffle_epi8(v, idx);
+}
+
+__attribute__((target("avx512f,avx512bw")))
+static void sha512_compress_x8(__m512i h[8], const uint8_t* base,
+                               __m512i vindex) {
+    // vindex: byte offset of each lane's current block within `base`.
+    __m512i w[80];
+    for (int i = 0; i < 16; i++)
+        w[i] = bswap64x8(_mm512_i64gather_epi64(
+            _mm512_add_epi64(vindex, _mm512_set1_epi64(8 * i)),
+            (const long long*)base, 1));
+    for (int i = 16; i < 80; i++) {
+        __m512i x15 = w[i - 15], x2 = w[i - 2];
+        __m512i s0 = _mm512_xor_si512(
+            _mm512_xor_si512(_mm512_ror_epi64(x15, 1),
+                             _mm512_ror_epi64(x15, 8)),
+            _mm512_srli_epi64(x15, 7));
+        __m512i s1 = _mm512_xor_si512(
+            _mm512_xor_si512(_mm512_ror_epi64(x2, 19),
+                             _mm512_ror_epi64(x2, 61)),
+            _mm512_srli_epi64(x2, 6));
+        w[i] = _mm512_add_epi64(
+            _mm512_add_epi64(w[i - 16], s0),
+            _mm512_add_epi64(w[i - 7], s1));
+    }
+    __m512i a = h[0], b = h[1], c = h[2], d = h[3];
+    __m512i e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 80; i++) {
+        __m512i S1 = _mm512_xor_si512(
+            _mm512_xor_si512(_mm512_ror_epi64(e, 14),
+                             _mm512_ror_epi64(e, 18)),
+            _mm512_ror_epi64(e, 41));
+        // Ch(e,f,g) = (e&f)^(~e&g): ternlog truth table 0xCA
+        __m512i ch = _mm512_ternarylogic_epi64(e, f, g, 0xCA);
+        __m512i t1 = _mm512_add_epi64(
+            _mm512_add_epi64(hh, S1),
+            _mm512_add_epi64(
+                _mm512_add_epi64(ch, _mm512_set1_epi64((long long)K512[i])),
+                w[i]));
+        __m512i S0 = _mm512_xor_si512(
+            _mm512_xor_si512(_mm512_ror_epi64(a, 28),
+                             _mm512_ror_epi64(a, 34)),
+            _mm512_ror_epi64(a, 39));
+        // Maj(a,b,c) = (a&b)^(a&c)^(b&c): ternlog truth table 0xE8
+        __m512i mj = _mm512_ternarylogic_epi64(a, b, c, 0xE8);
+        __m512i t2 = _mm512_add_epi64(S0, mj);
+        hh = g; g = f; f = e; e = _mm512_add_epi64(d, t1);
+        d = c; c = b; b = a; a = _mm512_add_epi64(t1, t2);
+    }
+    h[0] = _mm512_add_epi64(h[0], a); h[1] = _mm512_add_epi64(h[1], b);
+    h[2] = _mm512_add_epi64(h[2], c); h[3] = _mm512_add_epi64(h[3], d);
+    h[4] = _mm512_add_epi64(h[4], e); h[5] = _mm512_add_epi64(h[5], f);
+    h[6] = _mm512_add_epi64(h[6], g); h[7] = _mm512_add_epi64(h[7], hh);
+}
+
+// Hash 8 messages of identical length `len` starting at data+offs[j].
+__attribute__((target("avx512f,avx512bw")))
+static void sha512_x8_same_len(const uint8_t* data, const uint64_t offs[8],
+                               uint64_t len, uint8_t* out /* 8*64 */) {
+    static const uint64_t IV[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+        0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+    __m512i h[8];
+    for (int i = 0; i < 8; i++) h[i] = _mm512_set1_epi64((long long)IV[i]);
+    __m512i vindex = _mm512_loadu_si512((const void*)offs);
+
+    uint64_t full = len / 128;
+    for (uint64_t b = 0; b < full; b++) {
+        sha512_compress_x8(h, data, vindex);
+        vindex = _mm512_add_epi64(vindex, _mm512_set1_epi64(128));
+    }
+    // shared-padding tail: every lane has the same rem/bit-count
+    uint64_t rem = len - 128 * full;
+    uint64_t tail_len = (rem + 1 + 16 <= 128) ? 128 : 256;
+    alignas(64) uint8_t tails[8][256];
+    for (int j = 0; j < 8; j++) {
+        const uint8_t* src = data + offs[j] + 128 * full;
+        memcpy(tails[j], src, rem);
+        tails[j][rem] = 0x80;
+        memset(tails[j] + rem + 1, 0, tail_len - rem - 1 - 8);
+        uint64_t bits = len * 8;
+        for (int i = 0; i < 8; i++)
+            tails[j][tail_len - 1 - i] = uint8_t(bits >> (8 * i));
+    }
+    uint64_t toffs[8];
+    for (int j = 0; j < 8; j++) toffs[j] = uint64_t(j) * 256;
+    __m512i tindex = _mm512_loadu_si512((const void*)toffs);
+    sha512_compress_x8(h, &tails[0][0], tindex);
+    if (tail_len == 256)
+        sha512_compress_x8(
+            h, &tails[0][0],
+            _mm512_add_epi64(tindex, _mm512_set1_epi64(128)));
+
+    // transpose state back out: out[j] = big-endian h-words of lane j
+    alignas(64) uint64_t st[8][8];  // st[word][lane]
+    for (int i = 0; i < 8; i++)
+        _mm512_store_si512((void*)st[i], h[i]);
+    for (int j = 0; j < 8; j++)
+        for (int i = 0; i < 8; i++) {
+            uint64_t v = st[i][j];
+            for (int k = 0; k < 8; k++)
+                out[64 * j + 8 * i + k] = uint8_t(v >> (56 - 8 * k));
+        }
+}
+
+static bool sha512_x8_available() {
+    static const bool ok =
+        __builtin_cpu_supports("avx512f") && __builtin_cpu_supports("avx512bw");
+    return ok;
+}
+#else
+static bool sha512_x8_available() { return false; }
+#endif  // __x86_64__
+
+// Batch driver: peel groups of 8 consecutive equal-length messages onto
+// the wide path, everything else onto the scalar loop.
+static void sha512_batch_dispatch(const uint8_t* data, const uint64_t* offsets,
+                                  uint64_t n, uint8_t* out /* 64*n */) {
+    uint64_t i = 0;
+#if defined(__x86_64__)
+    if (sha512_x8_available()) {
+        while (i + 8 <= n) {
+            uint64_t len = offsets[i + 1] - offsets[i];
+            bool same = true;
+            for (int j = 1; j < 8; j++)
+                if (offsets[i + j + 1] - offsets[i + j] != len) {
+                    same = false;
+                    break;
+                }
+            if (!same) {
+                sha512_one(data + offsets[i], offsets[i + 1] - offsets[i],
+                           out + 64 * i);
+                i++;
+                continue;
+            }
+            uint64_t offs[8];
+            for (int j = 0; j < 8; j++) offs[j] = offsets[i + j];
+            sha512_x8_same_len(data, offs, len, out + 64 * i);
+            i += 8;
+        }
+    }
+#endif
+    for (; i < n; i++)
+        sha512_one(data + offsets[i], offsets[i + 1] - offsets[i],
+                   out + 64 * i);
+}
+
 }  // namespace
 
 
@@ -293,17 +464,20 @@ void sha256_batch(const uint8_t* data, const uint64_t* offsets,
 
 void sha512_batch(const uint8_t* data, const uint64_t* offsets,
                   uint64_t n, uint8_t* out) {
-    for (uint64_t i = 0; i < n; i++)
-        sha512_one(data + offsets[i], offsets[i+1] - offsets[i], out + 64*i);
+    sha512_batch_dispatch(data, offsets, n, out);
 }
 
 // Merkle level: hash pairs of 32-byte nodes (sha256(l||r)) -> 32-byte out.
 void sha512_mod_l_batch(const uint8_t* data, const uint64_t* offsets,
                         uint64_t n, uint32_t* out_words) {
-    for (uint64_t i = 0; i < n; i++) {
-        uint8_t digest[64];
-        sha512_one(data + offsets[i], offsets[i+1] - offsets[i], digest);
-        digest_mod_l(digest, out_words + 8 * i);
+    // wide-hash the whole batch, then reduce each digest mod L
+    const uint64_t CHUNK = 512;
+    uint8_t digests[512 * 64];
+    for (uint64_t lo = 0; lo < n; lo += CHUNK) {
+        uint64_t hi = lo + CHUNK < n ? lo + CHUNK : n;
+        sha512_batch_dispatch(data, offsets + lo, hi - lo, digests);
+        for (uint64_t i = lo; i < hi; i++)
+            digest_mod_l(digests + 64 * (i - lo), out_words + 8 * i);
     }
 }
 

@@ -257,10 +257,26 @@ def prepare_batch(
 
         from .. import native
 
-        preimages = [
-            signatures[i][:32] + public_keys[i] + messages[i] for i in good
-        ]
-        h_words[gi] = native.sha512_mod_l_many(preimages)
+        msg_lens = {len(messages[i]) for i in good}
+        if len(msg_lens) == 1:
+            # uniform messages (the loadtest/firehose case): assemble the
+            # R||A||M preimages as ONE contiguous matrix — no per-row
+            # bytes objects, no marshal copy
+            mlen = msg_lens.pop()
+            buf = np.empty((len(good), 64 + mlen), np.uint8)
+            buf[:, :32] = sig_mat[:, :32]
+            buf[:, 32:64] = pub_mat
+            if mlen:
+                buf[:, 64:] = np.frombuffer(
+                    b"".join(messages[i] for i in good), np.uint8
+                ).reshape(-1, mlen)
+            h_words[gi] = native.sha512_mod_l_rows(buf)
+        else:
+            preimages = [
+                signatures[i][:32] + public_keys[i] + messages[i]
+                for i in good
+            ]
+            h_words[gi] = native.sha512_mod_l_many(preimages)
 
     kwargs = dict(
         y_a=jnp.asarray(y_a),
@@ -350,19 +366,24 @@ def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
             )
         except Exception:
             log = logging.getLogger(__name__)
-            if _pl._RADIX13_ENABLED:
-                log.exception(
-                    "Pallas ed25519 kernel failed with radix-13 limbs; "
-                    "retrying with the radix-16 field"
-                )
-                _pl._RADIX13_ENABLED = False
-                continue
+            # Drop fast-mul BEFORE the radix: the live-row accumulation
+            # is the documented open Mosaic question, and radix-13 dense
+            # is projected above-target while radix-16 dense is not
+            # (docs/perf-roofline.md) — so the ladder must be able to
+            # settle on r13+dense.
             if _pl._FAST_MUL_ENABLED:
                 log.exception(
                     "Pallas ed25519 kernel failed with fast-mul on; "
                     "retrying with the dense multiply"
                 )
                 _pl._FAST_MUL_ENABLED = False
+                continue
+            if _pl._RADIX13_ENABLED:
+                log.exception(
+                    "Pallas ed25519 kernel failed with radix-13 limbs "
+                    "(dense); retrying with the radix-16 field"
+                )
+                _pl._RADIX13_ENABLED = False
                 continue
             _pallas_failed_once = True
             log.exception(

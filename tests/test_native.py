@@ -29,6 +29,33 @@ def test_sha512_many_matches_hashlib():
     assert out == [hashlib.sha512(m).digest() for m in msgs]
 
 
+def test_sha512_wide_groups_match_hashlib():
+    """Groups of >= 8 equal-length messages take the AVX-512 8-way path
+    (where the CPU supports it); ragged batches and remainders take the
+    scalar loop — every combination must match hashlib."""
+    rng = os.urandom
+    # 19 same-length (2 x8 groups + 3 scalar), then ragged interleave,
+    # then tail-boundary lengths in runs of 8 (x8 with 1- and 2-block
+    # shared padding), then an empty-message run
+    msgs = [rng(128) for _ in range(19)]
+    for i in range(10):
+        msgs.append(rng(127 if i % 2 else 128))
+    for ln in (111, 112, 120, 64):
+        msgs += [rng(ln) for _ in range(8)]
+    msgs += [b""] * 8
+    out = native.sha512_many(msgs)
+    assert out == [hashlib.sha512(m).digest() for m in msgs]
+
+
+def test_sha512_mod_l_rows_matches_many():
+    import numpy as np
+
+    rows = np.frombuffer(os.urandom(24 * 128), np.uint8).reshape(24, 128)
+    got = native.sha512_mod_l_rows(rows)
+    want = native.sha512_mod_l_many([rows[i].tobytes() for i in range(24)])
+    assert np.array_equal(got, want)
+
+
 def test_sha256_pairs_matches_hashlib():
     nodes = os.urandom(64 * 9)
     out = native.sha256_pairs(nodes)

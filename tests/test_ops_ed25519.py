@@ -388,10 +388,11 @@ class TestPallasDegradation:
         assert [bool(b) for b in out2] == expect
         assert attempts == [True, False]
 
-    def test_r13_failure_retries_r16_first(self, monkeypatch):
-        """The radix-13 rung sits above fast-mul in the retry ladder:
-        an r13 Mosaic failure falls back to radix-16 WITHOUT giving up
-        the fast multiply or the Pallas path."""
+    def test_fast_failure_settles_on_r13_dense(self, monkeypatch):
+        """Fast-mul drops BEFORE the radix: when Mosaic rejects the
+        live-row accumulation (the documented open question) but takes
+        the dense r13 kernel, the ladder must settle on r13+dense (the
+        projected above-target config), not regress to radix-16."""
         from corda_tpu.ops import ed25519_pallas as pl_mod
 
         pl_mod._RADIX13_ENABLED = True
@@ -399,6 +400,33 @@ class TestPallasDegradation:
         ed25519_batch._pallas_failed_once = False
 
         def flaky(kwargs):
+            if pl_mod._FAST_MUL_ENABLED:
+                raise RuntimeError("live-row accumulation rejected (sim)")
+            mask = ed25519_batch.verify_kernel(**kwargs)
+            return mask[None, :]
+
+        monkeypatch.setattr(ed25519_batch, "_dispatch_pallas", flaky)
+        pubs, sigs, msgs, expect = self._batch()
+        out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out] == expect
+        assert pl_mod._RADIX13_ENABLED  # radix kept
+        assert not pl_mod._FAST_MUL_ENABLED
+        assert not ed25519_batch._pallas_failed_once
+
+    def test_r13_failure_falls_through_to_r16_dense(self, monkeypatch):
+        """If the kernel fails for a radix-13-specific reason, the ladder
+        walks r13+fast -> r13+dense -> r16+dense and stays on Pallas."""
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        pl_mod._RADIX13_ENABLED = True
+        pl_mod._FAST_MUL_ENABLED = True
+        ed25519_batch._pallas_failed_once = False
+        attempts = []
+
+        def flaky(kwargs):
+            attempts.append(
+                (pl_mod._RADIX13_ENABLED, pl_mod._FAST_MUL_ENABLED)
+            )
             if pl_mod._RADIX13_ENABLED:
                 raise RuntimeError("r13 rejected (simulated)")
             mask = ed25519_batch.verify_kernel(**kwargs)
@@ -408,8 +436,7 @@ class TestPallasDegradation:
         pubs, sigs, msgs, expect = self._batch()
         out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
         assert [bool(b) for b in out] == expect
-        assert not pl_mod._RADIX13_ENABLED
-        assert pl_mod._FAST_MUL_ENABLED  # fast-mul rung untouched
+        assert attempts == [(True, True), (True, False), (False, False)]
         assert not ed25519_batch._pallas_failed_once
 
     def test_fast_failure_with_working_dense_stays_on_pallas(
