@@ -204,12 +204,11 @@ def sha512_mod_l_rows(rows) -> "np.ndarray":
     lib = _get_lib()
     if lib is None or row_len == 0:
         return sha512_mod_l_many([rows[i].tobytes() for i in range(n)])
-    offsets = (ctypes.c_uint64 * (n + 1))(
-        *range(0, (n + 1) * row_len, row_len)
-    )
+    offsets = np.arange(n + 1, dtype=np.uint64) * np.uint64(row_len)
     out = np.empty((n, 8), np.uint32)
     lib.sha512_mod_l_batch(
-        rows.ctypes.data_as(ctypes.c_char_p), offsets, n,
+        rows.ctypes.data_as(ctypes.c_char_p),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
     )
     return out

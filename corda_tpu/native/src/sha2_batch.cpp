@@ -59,11 +59,15 @@ void sha256_compress(uint32_t h[8], const uint8_t* block) {
     h[0]+=a; h[1]+=b; h[2]+=c; h[3]+=d; h[4]+=e; h[5]+=f; h[6]+=g; h[7]+=hh;
 }
 
+// SHA-NI dispatch lives below (runtime CPU check); fwd-declared so the
+// one-message driver can use the fastest compress available.
+void sha256_compress_best(uint32_t h[8], const uint8_t* block);
+
 void sha256_one(const uint8_t* msg, uint64_t len, uint8_t* out) {
     uint32_t h[8] = {0x6a09e667,0xbb67ae85,0x3c6ef372,0xa54ff53a,
                      0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19};
     uint64_t full = len / 64;
-    for (uint64_t i = 0; i < full; i++) sha256_compress(h, msg + 64*i);
+    for (uint64_t i = 0; i < full; i++) sha256_compress_best(h, msg + 64*i);
     uint8_t tail[128];
     uint64_t rem = len - 64*full;
     memcpy(tail, msg + 64*full, rem);
@@ -73,8 +77,8 @@ void sha256_one(const uint8_t* msg, uint64_t len, uint8_t* out) {
     uint64_t bits = len * 8;
     for (int i = 0; i < 8; i++)
         tail[tail_len - 1 - i] = uint8_t(bits >> (8*i));
-    sha256_compress(h, tail);
-    if (tail_len == 128) sha256_compress(h, tail + 64);
+    sha256_compress_best(h, tail);
+    if (tail_len == 128) sha256_compress_best(h, tail + 64);
     for (int i = 0; i < 8; i++) {
         out[4*i]   = uint8_t(h[i] >> 24);
         out[4*i+1] = uint8_t(h[i] >> 16);
@@ -161,6 +165,96 @@ void sha512_one(const uint8_t* msg, uint64_t len, uint8_t* out) {
     for (int i = 0; i < 8; i++)
         for (int j = 0; j < 8; j++)
             out[8*i + j] = uint8_t(h[i] >> (56 - 8*j));
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 with the SHA-NI ISA extension (runtime-dispatched). One message
+// at a time but ~5x the scalar compress: the x86 `sha` extension executes
+// four rounds per sha256rnds2 pair. Used for every message when the CPU
+// has it — Merkle leaves/levels and tx ids are the hot SHA-256 callers.
+// Standard msg-schedule pattern: sha256msg1/sha256msg2 + alignr feed.
+// ---------------------------------------------------------------------------
+#if defined(__x86_64__)
+__attribute__((target("sha,sse4.1,ssse3")))
+static void sha256_compress_ni(uint32_t state[8], const uint8_t* block) {
+    const __m128i MASK = _mm_set_epi64x(
+        0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    // state: ABEF / CDGH register layout
+    __m128i tmp = _mm_loadu_si128((const __m128i*)&state[0]);   // DCBA
+    __m128i st1 = _mm_loadu_si128((const __m128i*)&state[4]);   // HGFE
+    tmp = _mm_shuffle_epi32(tmp, 0xB1);                         // CDAB
+    st1 = _mm_shuffle_epi32(st1, 0x1B);                         // EFGH
+    __m128i abef = _mm_alignr_epi8(tmp, st1, 8);                // ABEF
+    __m128i cdgh = _mm_blend_epi16(st1, tmp, 0xF0);             // CDGH
+    __m128i abef_save = abef, cdgh_save = cdgh;
+
+    __m128i msg0 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 0)), MASK);
+    __m128i msg1 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 16)), MASK);
+    __m128i msg2 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 32)), MASK);
+    __m128i msg3 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 48)), MASK);
+
+    __m128i msg;
+#define RNDS4(M, ki)                                                     \
+    msg = _mm_add_epi32(M, _mm_loadu_si128((const __m128i*)&K256[ki])); \
+    cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);                      \
+    msg = _mm_shuffle_epi32(msg, 0x0E);                                 \
+    abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+#define SCHED(M0, M1, M2, M3)                                            \
+    M0 = _mm_sha256msg1_epu32(M0, M1);                                  \
+    M0 = _mm_add_epi32(M0, _mm_alignr_epi8(M3, M2, 4));                 \
+    M0 = _mm_sha256msg2_epu32(M0, M3);
+
+    RNDS4(msg0, 0)
+    RNDS4(msg1, 4)
+    RNDS4(msg2, 8)
+    RNDS4(msg3, 12)
+    for (int r = 16; r < 64; r += 16) {
+        SCHED(msg0, msg1, msg2, msg3)
+        RNDS4(msg0, r)
+        SCHED(msg1, msg2, msg3, msg0)
+        RNDS4(msg1, r + 4)
+        SCHED(msg2, msg3, msg0, msg1)
+        RNDS4(msg2, r + 8)
+        SCHED(msg3, msg0, msg1, msg2)
+        RNDS4(msg3, r + 12)
+    }
+#undef RNDS4
+#undef SCHED
+
+    abef = _mm_add_epi32(abef, abef_save);
+    cdgh = _mm_add_epi32(cdgh, cdgh_save);
+    tmp = _mm_shuffle_epi32(abef, 0x1B);                        // FEBA
+    st1 = _mm_shuffle_epi32(cdgh, 0xB1);                        // DCHG
+    _mm_storeu_si128((__m128i*)&state[0],
+                     _mm_blend_epi16(tmp, st1, 0xF0));          // DCBA
+    _mm_storeu_si128((__m128i*)&state[4],
+                     _mm_alignr_epi8(st1, tmp, 8));             // HGFE
+}
+
+static bool sha256_ni_available() {
+    static const bool ok = __builtin_cpu_supports("sha") &&
+                           __builtin_cpu_supports("sse4.1") &&
+                           __builtin_cpu_supports("ssse3");
+    return ok;
+}
+#else
+static bool sha256_ni_available() { return false; }
+static void sha256_compress_ni(uint32_t*, const uint8_t*) {}
+#endif  // __x86_64__
+
+// Compress dispatcher used by sha256_one and the pair batch.
+void sha256_compress_best(uint32_t h[8], const uint8_t* block) {
+#if defined(__x86_64__)
+    if (sha256_ni_available()) {
+        sha256_compress_ni(h, block);
+        return;
+    }
+#endif
+    sha256_compress(h, block);
 }
 
 // ---------------------------------------------------------------------------

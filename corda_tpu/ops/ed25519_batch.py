@@ -384,6 +384,10 @@ def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
                     "(dense); retrying with the radix-16 field"
                 )
                 _pl._RADIX13_ENABLED = False
+                # the dense failure may have been r13-specific: give the
+                # round-2-validated r16+fast config its chance before
+                # settling on r16+dense
+                _pl._FAST_MUL_ENABLED = True
                 continue
             _pallas_failed_once = True
             log.exception(

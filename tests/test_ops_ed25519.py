@@ -413,9 +413,11 @@ class TestPallasDegradation:
         assert not pl_mod._FAST_MUL_ENABLED
         assert not ed25519_batch._pallas_failed_once
 
-    def test_r13_failure_falls_through_to_r16_dense(self, monkeypatch):
+    def test_r13_failure_recovers_r16_fast(self, monkeypatch):
         """If the kernel fails for a radix-13-specific reason, the ladder
-        walks r13+fast -> r13+dense -> r16+dense and stays on Pallas."""
+        walks r13+fast -> r13+dense -> r16+fast (fast-mul re-enabled when
+        the radix drops: the dense failure may have been r13-specific,
+        and r16+fast was validated round 2) and stays on Pallas."""
         from corda_tpu.ops import ed25519_pallas as pl_mod
 
         pl_mod._RADIX13_ENABLED = True
@@ -436,7 +438,8 @@ class TestPallasDegradation:
         pubs, sigs, msgs, expect = self._batch()
         out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
         assert [bool(b) for b in out] == expect
-        assert attempts == [(True, True), (True, False), (False, False)]
+        assert attempts == [(True, True), (True, False), (False, True)]
+        assert pl_mod._FAST_MUL_ENABLED  # settled on r16+fast
         assert not ed25519_batch._pallas_failed_once
 
     def test_fast_failure_with_working_dense_stays_on_pallas(
