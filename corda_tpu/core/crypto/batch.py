@@ -146,15 +146,30 @@ def _verify_flat(
         # (VerifierTests.kt:54-71); below the threshold the single-device
         # kernels keep dispatch overhead down
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
+        mask = None
         if _MESH is not None and len(idx) >= MESH_MIN_BATCH:
             from ...parallel.mesh import shard_verify
 
             scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
-            mask = shard_verify(_MESH, scheme_kind, pubs, sigs, msgs)
-        elif is_ed:
-            mask = ops.ed25519_verify_batch(pubs, sigs, msgs)
-        else:
-            mask = ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
+            try:
+                mask = shard_verify(_MESH, scheme_kind, pubs, sigs, msgs)
+            except Exception:
+                # a mesh-path failure (e.g. Pallas-under-shard_map
+                # lowering) must not sink verification: fall through to
+                # the single-device path, which has its own degradation
+                # ladder down to the portable XLA kernel
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "mesh-sharded %s verification failed; serving the "
+                    "bucket from the single-device path", scheme_kind
+                )
+        if mask is None:
+            mask = (
+                ops.ed25519_verify_batch(pubs, sigs, msgs)
+                if is_ed
+                else ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
+            )
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
     return results

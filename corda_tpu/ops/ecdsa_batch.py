@@ -236,6 +236,71 @@ def prepare_batch(
 
 _pallas_failed_once = False
 
+#: (curve_name, fast_mul) configs whose Pallas kernel passed the
+#: known-answer self-check on this backend (same defense as
+#: ed25519_batch._self_check: silent Mosaic miscompiles must degrade the
+#: retry ladder, never poison verdicts)
+_selfchecked: set = set()
+
+
+def _self_check_vectors(curve_name: str):
+    """8 deterministic known-answer rows per curve: 4 valid RFC6979
+    signatures, 4 broken in distinct ways."""
+    _F, _a, curve = _CURVES[curve_name]
+    pubs, sigs, msgs = [], [], []
+    for i in range(8):
+        priv = (
+            int.from_bytes(
+                hashlib.sha256(b"ecdsa-selfcheck-%d" % i).digest(), "big"
+            ) % (curve.n - 1) + 1
+        )
+        pub = curve.encode_point(curve.mul(priv, curve.g))
+        msg = b"ecdsa self-check %d" % i
+        r, s = secp_math.ecdsa_sign(curve, priv, msg)
+        sig = secp_math.der_encode_sig(r, s)
+        if i >= 4:
+            kind = i % 4
+            if kind == 0:
+                msg = msg + b"!"  # signature over different content
+            elif kind == 1:
+                # signature from a different key
+                r2, s2 = secp_math.ecdsa_sign(curve, priv + 1, msg)
+                sig = secp_math.der_encode_sig(r2, s2)
+            elif kind == 2:
+                sig = secp_math.der_encode_sig(s, r)  # swapped components
+            else:
+                sig = b"\x30\x00"  # malformed DER
+        pubs.append(pub)
+        sigs.append(sig)
+        msgs.append(msg)
+    return pubs, sigs, msgs, [True] * 4 + [False] * 4
+
+
+def _self_check_pallas(curve_name: str, _pl) -> None:
+    from .ed25519_pallas import _FAST_MUL_ENABLED
+
+    config = (curve_name, _FAST_MUL_ENABLED)
+    if config in _selfchecked:
+        return
+    pubs, sigs, msgs, expect = _self_check_vectors(curve_name)
+    kwargs, real = prepare_batch(curve_name, pubs, sigs, msgs, pad_to=_pl.BLK)
+    mask = _pl.verify_kernel_pallas(
+        curve_name,
+        kwargs["qx"].T,
+        kwargs["qy"].T,
+        kwargs["u1_words"].T,
+        kwargs["u2_words"].T,
+        kwargs["r_cmp"].T,
+        kwargs["ok"][None, :].astype(jnp.uint32),
+    )
+    got = [bool(b) for b in np.asarray(mask)[0, :real]]
+    if got != expect:
+        raise RuntimeError(
+            f"Pallas ECDSA kernel self-check FAILED for {config}: "
+            f"{got} != {expect}"
+        )
+    _selfchecked.add(config)
+
 
 def verify_batch(
     curve_name: str,
@@ -260,6 +325,7 @@ def verify_batch(
     )
     while on_tpu and not _pallas_failed_once:
         try:
+            _self_check_pallas(curve_name, _pl)
             mask = _pl.verify_kernel_pallas(
                 curve_name,
                 kwargs["qx"].T,

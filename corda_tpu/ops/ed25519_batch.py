@@ -331,6 +331,62 @@ def _dispatch_pallas(kwargs):
 #: ecdsa_batch._pallas_failed_once)
 _pallas_failed_once = False
 
+#: (fast_mul, radix13) configs whose kernel passed the known-answer
+#: self-check on this backend
+_selfchecked: set = set()
+
+
+def _self_check_vectors():
+    """16 deterministic known-answer rows: 8 valid signatures, 8 broken
+    in distinct ways (flipped sig bit, wrong message, junk key, bad s)."""
+    pubs, sigs, msgs = [], [], []
+    for i in range(16):
+        seed = hashlib.sha512(b"selfcheck-%d" % i).digest()[:32]
+        msg = b"self-check message %d" % i
+        pub = ed25519_math.public_from_seed(seed)
+        sig = ed25519_math.sign(seed, msg)
+        if i >= 8:
+            kind = i % 4
+            if kind == 0:
+                sig = bytes([sig[0] ^ 1]) + sig[1:]
+            elif kind == 1:
+                msg = msg + b"!"
+            elif kind == 2:
+                pub = hashlib.sha256(pub).digest()  # near-certain non-point
+            else:
+                sig = sig[:32] + b"\xff" * 32  # s >= L
+        pubs.append(pub)
+        sigs.append(sig)
+        msgs.append(msg)
+    # the host oracle is the ground truth (the junk-key row especially)
+    expect = [
+        ed25519_math.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+    ]
+    assert expect[:8] == [True] * 8 and not any(expect[8:])
+    return pubs, sigs, msgs, expect
+
+
+def _self_check(_pl) -> None:
+    """Known-answer test of the CURRENT kernel config, once per process.
+
+    A Mosaic lowering bug can manifest as silently wrong lanes rather
+    than a compile error; for an unattended bench/verifier run that must
+    degrade the retry ladder, not poison verdicts (consensus!) or crash
+    the run. Costs one extra small-shape compile per config."""
+    config = (_pl._FAST_MUL_ENABLED, _pl._RADIX13_ENABLED)
+    if config in _selfchecked:
+        return
+    pubs, sigs, msgs, expect = _self_check_vectors()
+    kwargs, real = prepare_batch(pubs, sigs, msgs, pad_to=_pl.BLK)
+    mask = np.asarray(_dispatch_pallas(kwargs))[0, :real]
+    got = [bool(b) for b in mask]
+    if got != expect:
+        raise RuntimeError(
+            f"Pallas kernel self-check FAILED for config fast_mul="
+            f"{config[0]} radix13={config[1]}: {got} != {expect}"
+        )
+    _selfchecked.add(config)
+
 
 def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
     """TPU path: chunked software pipeline — the host parses/hashes chunk
@@ -352,6 +408,7 @@ def _verify_batch_pallas(public_keys, signatures, messages) -> np.ndarray:
     n = len(public_keys)
     while not _pallas_failed_once:
         try:
+            _self_check(_pl)
             pending = []
             for lo in range(0, n, _PIPE_CHUNK):
                 hi = min(lo + _PIPE_CHUNK, n)

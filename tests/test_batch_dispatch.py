@@ -114,6 +114,26 @@ def test_composite_semantics_match_host_path():
     assert out == host == [True, True, False, False, False]
 
 
+def test_mesh_failure_falls_back_to_single_device(monkeypatch):
+    """A mesh-path failure (e.g. Pallas-under-shard_map lowering on real
+    pods) must fall through to the single-device path, not sink the
+    whole verification batch."""
+    from corda_tpu.parallel import mesh as mesh_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("mesh lowering failed (simulated)")
+
+    monkeypatch.setattr(mesh_mod, "shard_verify", boom)
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
+    items = _items([EDDSA_ED25519_SHA512] * 6, tamper_idx={3})
+    crypto_batch.configure_mesh(object(), min_batch=4)  # any truthy mesh
+    try:
+        out = crypto_batch.verify_batch(items)
+    finally:
+        crypto_batch.configure_mesh(None)
+    assert out == [True, True, True, False, True, True]
+
+
 def test_small_buckets_stay_on_host(monkeypatch):
     from corda_tpu import ops
 

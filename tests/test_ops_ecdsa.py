@@ -261,3 +261,22 @@ class TestPallasCore:
         got = [bool(v) for v in np.asarray(mask)[0]]
         assert got == expect
         assert got[0] is True and got[1] is False and got[2] is False
+
+
+def test_self_check_vectors_match_host_oracle():
+    """The ECDSA Pallas self-check's known-answer vectors must agree with
+    the host oracle (they gate the TPU kernel's verdicts at runtime)."""
+    from corda_tpu.core.crypto import secp_math
+    from corda_tpu.ops import ecdsa_batch
+
+    pubs, sigs, msgs, expect = ecdsa_batch._self_check_vectors("secp256k1")
+    _f, _a, curve = ecdsa_batch._CURVES["secp256k1"]
+    got = []
+    for p_, s_, m_ in zip(pubs, sigs, msgs):
+        try:
+            r, sv = secp_math.der_decode_sig(s_)
+            pt = curve.decode_point(p_)
+            got.append(secp_math.ecdsa_verify(curve, pt, m_, r, sv))
+        except Exception:
+            got.append(False)
+    assert got == expect == [True] * 4 + [False] * 4

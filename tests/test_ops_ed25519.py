@@ -341,13 +341,17 @@ class TestPallasDegradation:
         saved_fast = pl_mod._FAST_MUL_ENABLED
         saved_r13 = pl_mod._RADIX13_ENABLED
         saved_failed = ed25519_batch._pallas_failed_once
+        saved_checked = set(ed25519_batch._selfchecked)
         # pin the chain's starting rung so the expected attempt sequence
         # is deterministic regardless of CORDA_TPU_ED25519_RADIX in the env
         pl_mod._RADIX13_ENABLED = False
+        ed25519_batch._selfchecked.clear()
         yield
         pl_mod._FAST_MUL_ENABLED = saved_fast
         pl_mod._RADIX13_ENABLED = saved_r13
         ed25519_batch._pallas_failed_once = saved_failed
+        ed25519_batch._selfchecked.clear()
+        ed25519_batch._selfchecked.update(saved_checked)
 
     def _batch(self, n=6):
         rng = np.random.default_rng(11)
@@ -438,8 +442,37 @@ class TestPallasDegradation:
         pubs, sigs, msgs, expect = self._batch()
         out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
         assert [bool(b) for b in out] == expect
-        assert attempts == [(True, True), (True, False), (False, True)]
+        # each rung's first dispatch is the known-answer self-check; the
+        # surviving config dispatches twice (self-check, then the batch)
+        assert attempts == [
+            (True, True), (True, False), (False, True), (False, True),
+        ]
         assert pl_mod._FAST_MUL_ENABLED  # settled on r16+fast
+        assert not ed25519_batch._pallas_failed_once
+
+    def test_wrong_results_degrade_like_a_crash(self, monkeypatch):
+        """Silently WRONG kernel output (a miscompiled lowering, not an
+        exception) must be caught by the known-answer self-check and walk
+        the ladder exactly like a compile failure — wrong verdicts from
+        one config must never reach callers (consensus property)."""
+        from corda_tpu.ops import ed25519_pallas as pl_mod
+
+        pl_mod._FAST_MUL_ENABLED = True
+        ed25519_batch._pallas_failed_once = False
+
+        def miscompiled(kwargs):
+            if pl_mod._FAST_MUL_ENABLED:
+                # everything "verifies" — including the tampered rows
+                n = kwargs["y_a"].shape[0]
+                return np.ones((1, n), np.uint32)
+            mask = ed25519_batch.verify_kernel(**kwargs)
+            return mask[None, :]
+
+        monkeypatch.setattr(ed25519_batch, "_dispatch_pallas", miscompiled)
+        pubs, sigs, msgs, expect = self._batch()
+        out = ed25519_batch._verify_batch_pallas(pubs, sigs, msgs)
+        assert [bool(b) for b in out] == expect  # served by dense rung
+        assert not pl_mod._FAST_MUL_ENABLED
         assert not ed25519_batch._pallas_failed_once
 
     def test_fast_failure_with_working_dense_stays_on_pallas(
