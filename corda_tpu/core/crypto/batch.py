@@ -36,13 +36,17 @@ MIN_DEVICE_BATCH = 32
 _MESH = None
 _DEFAULT_MESH_MIN_BATCH = 2048
 MESH_MIN_BATCH = _DEFAULT_MESH_MIN_BATCH
+#: latched on the first mesh-path failure: a deterministically broken
+#: mesh lowering must cost one attempt, not one per bucket
+_mesh_failed_once = False
 
 
 def configure_mesh(mesh, min_batch: int | None = None) -> None:
     """Route large ed25519 buckets through `mesh` (None disables and
     restores the default threshold)."""
-    global _MESH, MESH_MIN_BATCH
+    global _MESH, MESH_MIN_BATCH, _mesh_failed_once
     _MESH = mesh
+    _mesh_failed_once = False  # a newly configured mesh gets a fresh try
     if min_batch is not None:
         MESH_MIN_BATCH = min_batch
     elif mesh is None:
@@ -117,6 +121,7 @@ def _verify_flat(
     items: Sequence[Tuple[PublicKey, bytes, bytes]],
 ) -> List[bool]:
     """Scheme-bucketed dispatch over plain (non-composite) rows."""
+    global _mesh_failed_once
     n = len(items)
     results: List[bool] = [False] * n
     buckets: dict = {}  # kernel key -> [indices]
@@ -147,7 +152,11 @@ def _verify_flat(
         # kernels keep dispatch overhead down
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
         mask = None
-        if _MESH is not None and len(idx) >= MESH_MIN_BATCH:
+        if (
+            _MESH is not None
+            and not _mesh_failed_once
+            and len(idx) >= MESH_MIN_BATCH
+        ):
             from ...parallel.mesh import shard_verify
 
             scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
@@ -157,12 +166,15 @@ def _verify_flat(
                 # a mesh-path failure (e.g. Pallas-under-shard_map
                 # lowering) must not sink verification: fall through to
                 # the single-device path, which has its own degradation
-                # ladder down to the portable XLA kernel
+                # ladder down to the portable XLA kernel. Latched so a
+                # deterministic failure costs one attempt, not one per
+                # bucket (configure_mesh resets the latch).
+                _mesh_failed_once = True
                 import logging
 
                 logging.getLogger(__name__).exception(
-                    "mesh-sharded %s verification failed; serving the "
-                    "bucket from the single-device path", scheme_kind
+                    "mesh-sharded %s verification failed; the mesh path "
+                    "is disabled until reconfigured", scheme_kind
                 )
         if mask is None:
             mask = (

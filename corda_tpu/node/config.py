@@ -84,6 +84,7 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         # unless node.conf opts back into per-step validation
         dev_checkpoint_check=bool(cfg.get("dev_checkpoint_check", False)),
         raft_cluster=cfg.get("raft_cluster"),
+        bft_cluster=cfg.get("bft_cluster"),
     )
     return FullNodeConfiguration(
         node=node_cfg,

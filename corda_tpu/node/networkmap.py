@@ -72,11 +72,30 @@ class SignedRegistration:
 
     def verify(self) -> bool:
         try:
-            return crypto.is_valid(
-                self.registration.party.owning_key,
-                self.signature,
-                self.registration.signable_bytes(),
+            key = self.registration.party.owning_key
+            data = self.registration.signable_bytes()
+            from ..core.crypto.composite import (
+                CompositeKey,
+                CompositeSignaturesWithKeys,
             )
+
+            if isinstance(key, CompositeKey):
+                # A cluster member registers the shared composite identity
+                # alone, and no single member can meet an f+1 threshold
+                # (BFT clusters) — directory registration instead requires
+                # at least one VALID signature by a constituent leaf key
+                # (any member can vouch for / fail over the entry, the
+                # trust model the reference gets from members registering
+                # their own NodeInfo carrying the service identity).
+                sigs = CompositeSignaturesWithKeys.deserialize(
+                    self.signature
+                )
+                leaves = key.keys
+                return bool(sigs.sigs) and all(
+                    pub in leaves and crypto.is_valid(pub, sig, data)
+                    for pub, sig in sigs.sigs
+                )
+            return crypto.is_valid(key, self.signature, data)
         except Exception:
             return False
 

@@ -47,6 +47,9 @@ class NodeConfiguration:
     # (reference ServiceIdentityGenerator distributes the composite key
     # to the member dirs at deploy time).
     raft_cluster: Optional[dict] = None
+    # PBFT notary cluster membership (notary_type "bft"): same block
+    # shape as raft_cluster; needs >= 4 members (n >= 3f+1, f >= 1).
+    bft_cluster: Optional[dict] = None
 
 
 class AbstractNode:
@@ -105,6 +108,9 @@ class AbstractNode:
         if (self.config.notary_type or "").startswith("raft"):
             self._make_raft_notary_service()
             return
+        if self.config.notary_type == "bft":
+            self._make_bft_notary_service()
+            return
         if self.config.notary_type == "validating":
             self.notary_service = ValidatingNotaryService(self.services, self.info)
             if NetworkMapCache.VALIDATING_NOTARY_SERVICE not in self.config.advertised_services:
@@ -116,6 +122,165 @@ class AbstractNode:
         self.services.notary_service = self.notary_service
         if NetworkMapCache.NOTARY_SERVICE not in self.config.advertised_services:
             self.config.advertised_services.append(NetworkMapCache.NOTARY_SERVICE)
+
+    def _make_bft_notary_service(self):
+        """One member of a PBFT notary cluster as a REAL OS process
+        (reference BFTNonValidatingNotaryService over BFT-SMaRt,
+        `BFTSMaRt.kt:79-276`, whose replicas/clients talk over their own
+        sockets; here PBFT traffic rides the node's P2P messaging —
+        BFT_TOPIC over the broker/bridges, including self-delivery
+        through the member's own inbound queue so every replica entry
+        point runs on the messaging pump thread, which is what makes the
+        single-threaded replica state machine safe).
+
+        Each member runs one replica AND one client; a commit broadcasts
+        the putall to all n replicas and accepts once f+1 DISTINCT
+        replicas return identical verdicts carrying valid signatures
+        over the tx id — those f+1 signatures fulfil the cluster's
+        f+1-threshold composite identity (validated by NotaryClientFlow
+        like any notary signature set)."""
+        import threading as _threading
+
+        from ..core.crypto import crypto as _crypto
+        from ..core.identity import Party
+        from ..core.serialization.codec import deserialize, serialize
+        from .bft import BFT_TOPIC, BFTClient, BFTReplica
+        from .cluster_identity import generate_service_identity
+        from .notary import BFTUniquenessProvider, SimpleNotaryService
+
+        cfg = self.config.bft_cluster
+        if not cfg:
+            raise ValueError("notary_type bft requires a bft_cluster block")
+        members = cfg["members"]
+        n = len(members)
+        my_index = int(cfg["index"])
+        f = (n - 1) // 3
+        parties = [
+            Party(m["name"], _crypto.entropy_to_keypair(m["entropy"]).public)
+            for m in members
+        ]
+        self.cluster_party = generate_service_identity(
+            cfg["name"], [p.owning_key for p in parties], threshold=f + 1
+        )
+        name_of = {i: p for i, p in enumerate(parties)}
+        index_of = {p.name: i for i, p in enumerate(parties)}
+        leaf_keys = {k.encoded for k in self.cluster_party.owning_key.keys}
+
+        def bft_send(dst_index: int, msg: dict) -> None:
+            try:
+                self.network.send(name_of[dst_index], BFT_TOPIC,
+                                  serialize(msg))
+            except Exception:
+                pass  # peer route not up yet: PBFT tolerates loss
+
+        def transport(dst: int, payload: bytes) -> None:
+            bft_send(dst, {"k": "m", "s": my_index, "p": payload})
+
+        def reply_fn(client_id: str, request_id: str, result) -> None:
+            dst = index_of.get(client_id)
+            if dst is not None:
+                bft_send(dst, {"k": "r", "s": my_index,
+                               "rid": request_id, "res": result})
+
+        def sign_tx(tx_id_bytes: bytes):
+            return self.services.key_management_service.sign(
+                tx_id_bytes, self.info.owning_key
+            )
+
+        # Replica prepare-vote signing identities derive from the member
+        # entropies every member already shares via the cluster block —
+        # NOT bft.py's dev_signing_seed fallback, whose keys are publicly
+        # derivable (its docstring forbids production use).
+        import hashlib as _hashlib
+
+        def _replica_seed(entropy) -> bytes:
+            return _hashlib.sha512(
+                b"corda-tpu-bft-replica:%d" % int(entropy)
+            ).digest()[:32]
+
+        from ..core.crypto import ed25519_math as _edm
+
+        replica_pubs = {
+            i: _edm.public_from_seed(_replica_seed(m["entropy"]))
+            for i, m in enumerate(members)
+        }
+        replica = BFTReplica(
+            my_index, n, transport,
+            BFTUniquenessProvider.make_replica_apply(
+                self.database, sign_tx_fn=sign_tx
+            ),
+            reply_fn,
+            signing_seed=_replica_seed(members[my_index]["entropy"]),
+            replica_pubs=replica_pubs,
+        )
+        self.bft_replica = replica
+        # the replica state machine is single-threaded by design (unlike
+        # RaftNode, which locks internally): the pump handler and the
+        # view-change ticker serialize through this lock
+        self._bft_lock = _threading.RLock()
+
+        def validate_reply(command, result) -> bool:
+            # conflict-free verdicts count toward the f+1 quorum only
+            # with a valid cluster-leaf signature over the tx id
+            if not isinstance(result, dict) or result.get("conflicts"):
+                return True
+            tx_hex = (command or {}).get("tx_id")
+            if tx_hex is None:
+                return True
+            sig = result.get("tx_sig")
+            if sig is None:
+                return False
+            try:
+                return (
+                    sig.by.encoded in leaf_keys
+                    and sig.is_valid(bytes.fromhex(tx_hex))
+                )
+            except Exception:
+                return False
+
+        client = BFTClient(
+            self.info.name, n,
+            lambda rid, req: bft_send(rid, {"k": "q", "req": req}),
+            reply_validator=validate_reply,
+        )
+        self._bft_client = client
+
+        def on_bft_message(sender, payload) -> None:
+            # The replica/reply index binds to the AUTHENTICATED channel
+            # sender, never the self-declared msg["s"]: one peer must not
+            # be able to vote as every replica (quorum dedup in
+            # BFTClient/BFTReplica counts one vote per identity).
+            sender_idx = index_of.get(getattr(sender, "name", None))
+            msg = deserialize(payload)
+            kind = msg.get("k")
+            if kind == "m":
+                if sender_idx is None or msg.get("s") != sender_idx:
+                    return
+                with self._bft_lock:
+                    replica.on_message(sender_idx, msg["p"])
+            elif kind == "q":
+                with self._bft_lock:
+                    replica.on_request(msg["req"])
+            elif kind == "r":
+                if sender_idx is None or msg.get("s") != sender_idx:
+                    return
+                client.on_reply(sender_idx, msg["rid"], msg["res"])
+
+        self.network.add_handler(BFT_TOPIC, on_bft_message)
+        if hasattr(self.network, "also_serve"):
+            self.network.also_serve(self.cluster_party.name)
+
+        # reference parity: the BFT notary is non-validating
+        self.notary_service = SimpleNotaryService(
+            self.services, self.info,
+            uniqueness_provider=BFTUniquenessProvider(client),
+        )
+        self.services.notary_service = self.notary_service
+        self._cluster_services = [NetworkMapCache.NOTARY_SERVICE]
+        self.services.network_map_cache.add_node(
+            self.cluster_party, list(self._cluster_services)
+        )
+        self.services.identity_service.register_identity(self.cluster_party)
 
     def _make_raft_notary_service(self):
         """One member of a Raft notary cluster (reference
@@ -234,6 +399,8 @@ class AbstractNode:
             self.network.start()
         if getattr(self, "raft_node", None) is not None:
             self._start_raft_ticker()
+        if getattr(self, "bft_replica", None) is not None:
+            self._start_bft_ticker()
         self.started = True
         return self
 
@@ -263,10 +430,35 @@ class AbstractNode:
         )
         self._raft_ticker.start()
 
+    def _start_bft_ticker(self) -> None:
+        """View-change timer: a dead primary must not stall the cluster.
+        Ticks serialize with the pump handler through _bft_lock (the
+        replica state machine is single-threaded by design)."""
+        import threading as _threading
+        import time as _time
+
+        self._bft_stop = _threading.Event()
+
+        def run():
+            while not self._bft_stop.wait(0.25):
+                try:
+                    with self._bft_lock:
+                        self.bft_replica.tick(_time.monotonic())
+                except Exception:
+                    pass  # a tick must never kill the ticker
+
+        self._bft_ticker = _threading.Thread(
+            target=run, name=f"bft-tick-{self.info.name}", daemon=True
+        )
+        self._bft_ticker.start()
+
     def stop(self) -> None:
         if getattr(self, "_raft_stop", None) is not None:
             self._raft_stop.set()
             self._raft_ticker.join(timeout=2)
+        if getattr(self, "_bft_stop", None) is not None:
+            self._bft_stop.set()
+            self._bft_ticker.join(timeout=2)
         if hasattr(self.network, "stop"):
             self.network.stop()
         if self.smm._blocking_executor is not None:

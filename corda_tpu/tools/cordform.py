@@ -47,23 +47,30 @@ def _dir_name(legal_name: str) -> str:
 
 
 def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
-    """A node entry with notary "raft-validating"/"raft-simple" and
-    "cluster_size": N expands into N member nodes sharing a raft_cluster
-    block (reference: cordformation's NotaryCluster DSL +
-    ServiceIdentityGenerator run at deploy time). Member identities use
-    deterministic entropies so every member derives the same composite
-    cluster identity locally."""
+    """A node entry with notary "raft-validating"/"raft-simple"/"bft" and
+    "cluster_size": N expands into N member nodes sharing a
+    raft_cluster / bft_cluster block (reference: cordformation's
+    NotaryCluster DSL + ServiceIdentityGenerator run at deploy time).
+    Member identities use deterministic entropies so every member
+    derives the same composite cluster identity locally."""
     out: List[Dict] = []
     for n in nodes:
         notary = n.get("notary", "")
-        if not (isinstance(notary, str) and notary.startswith("raft")):
+        is_bft = notary == "bft"
+        if not (isinstance(notary, str)
+                and (notary.startswith("raft") or is_bft)):
             out.append(n)
             continue
-        # a raft notary ALWAYS expands (a missing/1 cluster_size becomes a
-        # single-member cluster) — passing the entry through unexpanded
-        # would materialise a node that dies at boot for want of a
-        # raft_cluster block
+        # a cluster notary ALWAYS expands (a missing/1 cluster_size
+        # becomes a single-member raft cluster) — passing the entry
+        # through unexpanded would materialise a node that dies at boot
+        # for want of a cluster block. BFT needs n >= 3f+1 with f >= 1.
         size = max(1, int(n.get("cluster_size", 1) or 1))
+        if is_bft and size < 4:
+            raise ValueError(
+                f"bft notary {n['name']!r} needs cluster_size >= 4 "
+                f"(got {size})"
+            )
         cluster_name = n["name"]
         # default entropy base derives from the CLUSTER NAME: two clusters
         # in one spec must not share member keypairs (identical composite
@@ -97,7 +104,7 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
             }
             entry["name"] = member["name"]
             entry["identity_entropy"] = member["entropy"]
-            entry["raft_cluster"] = {
+            entry["bft_cluster" if is_bft else "raft_cluster"] = {
                 "name": cluster_name,
                 "index": i,
                 "members": members,
@@ -142,6 +149,8 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
             conf["identity_entropy"] = n["identity_entropy"]
         if n.get("raft_cluster"):
             conf["raft_cluster"] = n["raft_cluster"]
+        if n.get("bft_cluster"):
+            conf["bft_cluster"] = n["bft_cluster"]
         if spec.get("tls"):
             conf["tls"] = True
             conf["certificates_dir"] = shared_certs

@@ -227,6 +227,75 @@ class TestRealProcessDisruptions:
 
 
 @pytest.mark.slow
+class TestBFTNotaryClusterProcesses:
+    """A 4-member PBFT notary cluster as real OS processes (reference
+    BFTNotaryServiceTests: BFT-SMaRt replicas as real nodes). PBFT
+    traffic rides the nodes' P2P bridges; commits return f+1 replica
+    signatures fulfilling the f+1-threshold composite identity; killing
+    one non-primary member (f=1) mid-run must not stop notarisation."""
+
+    def test_cluster_notarises_and_survives_member_kill(self):
+        from corda_tpu.testing.smoketesting import Factory
+        from corda_tpu.tools.cordform import deploy_nodes
+
+        base = tempfile.mkdtemp(prefix="bft-real-")
+        spec = {
+            "nodes": [
+                {"name": "O=BFTNotary,L=Zurich,C=CH",
+                 "notary": "bft", "cluster_size": 4,
+                 "network_map_service": True},
+                {"name": "O=BFTBankA,L=London,C=GB"},
+                {"name": "O=BFTBankB,L=Paris,C=FR"},
+            ]
+        }
+        resolved = deploy_nodes(spec, base)
+        assert len(resolved) == 6  # 4 members + 2 banks
+        factory = Factory(base)
+        nodes = [factory.launch(conf["dir"]) for conf in resolved]
+        try:
+            conn = nodes[4].connect()
+            try:
+                me = conn.proxy.node_info()
+                notaries = conn.proxy.notary_identities()
+                # exactly ONE notary: the cluster identity, not 4 members
+                assert len(notaries) == 1, [n.name for n in notaries]
+                cluster = notaries[0]
+                assert cluster.name == "O=BFTNotary,L=Zurich,C=CH"
+            finally:
+                conn.close()
+            conn_b = nodes[5].connect()
+            try:
+                peer = conn_b.proxy.node_info()
+            finally:
+                conn_b.close()
+
+            driver = _Driver(nodes[4], cluster, me, peer).start()
+            deadline = time.monotonic() + 180
+            while len(driver.completed) < 3:
+                assert time.monotonic() < deadline, (
+                    f"cluster never notarised: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+
+            # kill member 1: not the view-0 primary (member 0) and not
+            # the member holding the cluster route (last registered), so
+            # the remaining 3 >= 2f+1 keep committing without view change
+            nodes[1].kill()
+            before = len(driver.completed)
+            deadline = time.monotonic() + 180
+            while len(driver.completed) < before + 3:
+                assert time.monotonic() < deadline, (
+                    f"no progress after member kill: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+            driver.stop()
+            _assert_no_loss_no_dup(driver, nodes[5])
+        finally:
+            for n in nodes:
+                n.close()
+
+
+@pytest.mark.slow
 class TestRaftNotaryClusterProcesses:
     """A 3-member Raft VALIDATING notary cluster as real OS processes
     (reference: the raft notary-demo cluster; Disruption.kt fired at a
