@@ -187,21 +187,35 @@ class AbstractNode:
                 tx_id_bytes, self.info.owning_key
             )
 
-        # Replica prepare-vote signing identities derive from the member
-        # entropies every member already shares via the cluster block —
-        # NOT bft.py's dev_signing_seed fallback, whose keys are publicly
-        # derivable (its docstring forbids production use).
+        # Replica prepare-vote signing identities: cordform generates a
+        # RANDOM per-member seed at deploy time, written only to that
+        # member's own config, with every member's PUBLIC key shared via
+        # the cluster block ("signing_pub" per member). Entropy-derived
+        # seeds are the dev fallback for hand-written configs — like the
+        # shared dev identity entropies themselves, they are derivable by
+        # anyone who can read the cluster block, so they authenticate
+        # members against outsiders but not against each other.
         import hashlib as _hashlib
 
-        def _replica_seed(entropy) -> bytes:
+        from ..core.crypto import ed25519_math as _edm
+
+        def _dev_seed(entropy) -> bytes:
             return _hashlib.sha512(
                 b"corda-tpu-bft-replica:%d" % int(entropy)
             ).digest()[:32]
 
-        from ..core.crypto import ed25519_math as _edm
-
+        my_seed_hex = cfg.get("signing_seed")
+        my_seed = (
+            bytes.fromhex(my_seed_hex)
+            if my_seed_hex
+            else _dev_seed(members[my_index]["entropy"])
+        )
         replica_pubs = {
-            i: _edm.public_from_seed(_replica_seed(m["entropy"]))
+            i: (
+                bytes.fromhex(m["signing_pub"])
+                if m.get("signing_pub")
+                else _edm.public_from_seed(_dev_seed(m["entropy"]))
+            )
             for i, m in enumerate(members)
         }
         replica = BFTReplica(
@@ -210,7 +224,7 @@ class AbstractNode:
                 self.database, sign_tx_fn=sign_tx
             ),
             reply_fn,
-            signing_seed=_replica_seed(members[my_index]["entropy"]),
+            signing_seed=my_seed,
             replica_pubs=replica_pubs,
         )
         self.bft_replica = replica
@@ -259,6 +273,8 @@ class AbstractNode:
                 with self._bft_lock:
                     replica.on_message(sender_idx, msg["p"])
             elif kind == "q":
+                if sender_idx is None:
+                    return  # only cluster members may inject commands
                 with self._bft_lock:
                     replica.on_request(msg["req"])
             elif kind == "r":

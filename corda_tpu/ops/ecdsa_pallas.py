@@ -162,25 +162,32 @@ class _RowField:
         return self.mul(a, a)
 
     def pow_const(self, x, exponent: int):
-        """Square-and-multiply over the exponent's bits via lax.fori_loop:
-        the body traces ONCE (a Python-unrolled chain of ~256 squares
-        would dominate kernel trace time). Bits live in a (nbits, 1)
-        column sliced with a dynamic index each iteration."""
-        nbits = exponent.bit_length()
-        bits = _cat([
-            jnp.full((1, 1), np.uint32((exponent >> (nbits - 1 - i)) & 1),
-                     jnp.uint32)
-            for i in range(nbits)
-        ])
+        """Static-exponent exponentiation, fully trace-time scheduled.
+
+        The previous form slice-indexed a bits column with the loop
+        counter — `lax.dynamic_slice` on a VALUE, which the Pallas TPU
+        lowering does not implement (caught by the jax.export TPU
+        cross-lowering gate, tests/test_ops_ecdsa.py). The exponent is a
+        compile-time int, so no dynamic anything is needed: 4-bit fixed
+        windows — a 16-entry power table (14 muls), then per window 4
+        squares + one statically-indexed multiply, zero windows skipped.
+        ~256 squares + ~80 muls for a 256-bit exponent."""
         width = x.shape[1]
-        acc0 = self.mont_const(1, width)
-
-        def body(i, acc):
-            acc = self.square(acc)
-            b = lax.dynamic_slice_in_dim(bits, i, 1, axis=0)
-            return jnp.where(b == 1, self.mul(acc, x), acc)
-
-        return lax.fori_loop(0, nbits, body, acc0)
+        table = [self.mont_const(1, width), x]
+        for _ in range(14):
+            table.append(self.mul(table[-1], x))
+        n_windows = (exponent.bit_length() + 3) // 4
+        acc = None
+        for k in range(n_windows - 1, -1, -1):
+            w = (exponent >> (4 * k)) & 0xF
+            if acc is None:
+                acc = table[w]  # top window of a positive exponent: w > 0
+                continue
+            for _ in range(4):
+                acc = self.square(acc)
+            if w:
+                acc = self.mul(acc, table[w])
+        return acc
 
     def inv(self, x):
         return self.pow_const(x, self.h.p_int - 2)

@@ -134,7 +134,12 @@ def _reduce(d):
 import threading as _threading
 
 _FAST_MUL_TLS = _threading.local()
-_FAST_MUL_ENABLED = os.environ.get("CORDA_TPU_FAST_MUL", "1") != "0"
+#: Default OFF since round 3: the jax.export TPU cross-lowering gate
+#: proved Mosaic has no scatter-add lowering, so the .at[].add variants
+#: cannot compile on current JAX (the runtime ladder would catch it, but
+#: a doomed first attempt wastes tunnel-time compiles). The knob stays
+#: for future JAX versions that implement it.
+_FAST_MUL_ENABLED = os.environ.get("CORDA_TPU_FAST_MUL", "0") != "0"
 
 
 def _fast_mul_active() -> bool:
@@ -216,13 +221,16 @@ ROWS13 = 20
 _MASK13 = np.uint32(0x1FFF)
 _F13 = np.uint32(608)  # 2^260 mod p
 
-_RADIX_ENV = os.environ.get("CORDA_TPU_ED25519_RADIX", "16")
+_RADIX_ENV = os.environ.get("CORDA_TPU_ED25519_RADIX", "13")
 if _RADIX_ENV not in ("13", "16"):
     raise ValueError(
         f"CORDA_TPU_ED25519_RADIX={_RADIX_ENV}: must be 13 or 16"
     )
 #: default radix for the Pallas kernel (A/B knob for tools/hw_capture.py;
-#: the off-TPU XLA kernel and host prep are always radix-16)
+#: the off-TPU XLA kernel and host prep are always radix-16). Radix 13
+#: became the DEFAULT in round 3: its dense kernel passes the TPU
+#: cross-lowering gate and its multiply costs ~25-30% fewer vector ops
+#: than radix-16 dense (docs/perf-roofline.md round-3 addendum).
 _RADIX13_ENABLED = _RADIX_ENV == "13"
 
 

@@ -84,14 +84,23 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
         )
         base_entropy = int(n.get("cluster_entropy_base", default_base))
         members = []
+        seeds = []
         for i in range(size):
             parts = [p.strip() for p in cluster_name.split(",")]
             parts = [
                 f"O={p[2:]} {i}" if p.startswith("O=") else p for p in parts
             ]
-            members.append(
-                {"name": ",".join(parts), "entropy": base_entropy + i}
-            )
+            member = {"name": ",".join(parts), "entropy": base_entropy + i}
+            if is_bft:
+                # per-member RANDOM replica signing key, generated at
+                # deploy time: the private seed goes ONLY into that
+                # member's own config; the cluster block shares publics
+                from ..core.crypto import ed25519_math as _edm
+
+                seed = os.urandom(32)
+                seeds.append(seed)
+                member["signing_pub"] = _edm.public_from_seed(seed).hex()
+            members.append(member)
         for i, member in enumerate(members):
             entry = {
                 k: v for k, v in n.items()
@@ -104,11 +113,14 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
             }
             entry["name"] = member["name"]
             entry["identity_entropy"] = member["entropy"]
-            entry["bft_cluster" if is_bft else "raft_cluster"] = {
+            cluster_block = {
                 "name": cluster_name,
                 "index": i,
                 "members": members,
             }
+            if is_bft:
+                cluster_block["signing_seed"] = seeds[i].hex()
+            entry["bft_cluster" if is_bft else "raft_cluster"] = cluster_block
             out.append(entry)
     return out
 

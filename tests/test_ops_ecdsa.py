@@ -280,3 +280,26 @@ def test_self_check_vectors_match_host_oracle():
         except Exception:
             got.append(False)
     assert got == expect == [True] * 4 + [False] * 4
+
+
+@pytest.mark.heavy_compile
+def test_ecdsa_kernel_lowers_for_tpu():
+    """jax.export TPU cross-lowering of the ECDSA Pallas kernel (~3 min:
+    the trace alone is large). Guards against reintroducing primitives
+    Mosaic cannot lower (dynamic_slice in pow_const was caught here)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from corda_tpu.ops import ecdsa_pallas
+
+    BLK = ecdsa_pallas.BLK
+    args = (
+        jnp.zeros((16, BLK), jnp.uint32), jnp.zeros((16, BLK), jnp.uint32),
+        jnp.zeros((8, BLK), jnp.uint32), jnp.zeros((8, BLK), jnp.uint32),
+        jnp.zeros((16, BLK), jnp.uint32), jnp.zeros((1, BLK), jnp.uint32),
+    )
+    fn = jax.jit(
+        lambda *a: ecdsa_pallas.verify_kernel_pallas("secp256k1", *a)
+    )
+    jexport.export(fn, platforms=["tpu"])(*args)

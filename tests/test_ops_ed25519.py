@@ -711,3 +711,42 @@ class TestAdversarialVectors:
         expect = ed25519_math.verify(identity_pub, msg, sig)
         mask = ed25519_batch.verify_batch([identity_pub], [sig], [msg])
         assert bool(mask[0]) == expect
+
+
+class TestMosaicLoweringGate:
+    """jax.export cross-platform lowering runs the REAL Pallas->Mosaic
+    pipeline without TPU hardware — the gate that caught scatter-add
+    (fast-mul) and dynamic_slice (ECDSA pow_const) being unimplemented
+    before they could burn a live tunnel window. The DEFAULT config must
+    always lower; the non-default radix is covered too (cheap)."""
+
+    @staticmethod
+    def _export_ed25519(fast_mul, radix13):
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jexport
+
+        from corda_tpu.ops import ed25519_pallas as m
+
+        BLK = m.BLK
+        args = (
+            jnp.zeros((16, BLK), jnp.uint32), jnp.zeros((1, BLK), jnp.uint32),
+            jnp.zeros((16, BLK), jnp.uint32), jnp.zeros((1, BLK), jnp.uint32),
+            jnp.zeros((8, BLK), jnp.uint32), jnp.zeros((8, BLK), jnp.uint32),
+            jnp.zeros((1, BLK), jnp.uint32),
+        )
+        fn = jax.jit(
+            lambda *a: m.verify_kernel_pallas(
+                *a, fast_mul=fast_mul, radix13=radix13
+            )
+        )
+        jexport.export(fn, platforms=["tpu"])(*args)
+
+    def test_default_config_lowers_for_tpu(self):
+        from corda_tpu.ops import ed25519_pallas as m
+
+        self._export_ed25519(m._FAST_MUL_ENABLED, m._RADIX13_ENABLED)
+
+    @pytest.mark.heavy_compile
+    def test_radix16_dense_lowers_for_tpu(self):
+        self._export_ed25519(False, False)
