@@ -210,6 +210,16 @@ class AbstractNode:
             if my_seed_hex
             else _dev_seed(members[my_index]["entropy"])
         )
+        my_pub_hex = members[my_index].get("signing_pub")
+        if my_pub_hex and _edm.public_from_seed(my_seed).hex() != my_pub_hex:
+            # e.g. a stale node.conf after a redeploy regenerated seeds:
+            # this replica's votes would be silently rejected by peers,
+            # degrading fault tolerance with no error anywhere — fail fast
+            raise ValueError(
+                "bft_cluster signing_seed does not match this member's "
+                "signing_pub in the members list (stale config after a "
+                "redeploy?)"
+            )
         replica_pubs = {
             i: (
                 bytes.fromhex(m["signing_pub"])

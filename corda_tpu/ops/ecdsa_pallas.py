@@ -173,6 +173,8 @@ class _RowField:
         squares + one statically-indexed multiply, zero windows skipped.
         ~256 squares + ~80 muls for a 256-bit exponent."""
         width = x.shape[1]
+        if exponent == 0:
+            return self.mont_const(1, width)
         table = [self.mont_const(1, width), x]
         for _ in range(14):
             table.append(self.mul(table[-1], x))

@@ -239,8 +239,10 @@ def _limbs13(x: int):
 
 
 _P13 = _limbs13(P_INT)
-# descending multiples of p for canonicalization from < 2^260 ~ 32p
-_CANON13_STEPS = [_limbs13(m * P_INT) for m in (16, 8, 4, 2, 1, 1)]
+# descending multiples of p for canonicalization: values carry limb
+# slack (< 2^13 + 2^11.5, see _reduce13), so magnitudes reach ~1.4*2^260
+# ~ 45p — two 16p steps cover it
+_CANON13_STEPS = [_limbs13(m * P_INT) for m in (16, 16, 8, 4, 2, 1, 1)]
 
 _R13_TLS = _threading.local()
 
@@ -282,46 +284,54 @@ def _rows16_to_13(a16):
     return jnp.concatenate(rows, axis=0)
 
 
-def _reduce13(d):
-    """(N, W) coefficients (each < 2^31) -> (20, W) strict-limb value
-    congruent mod p. N is 39 from a product, 20 from an add.
+def _carry_round13(v):
+    """One full-width carry-propagation round: every row keeps its low
+    13 bits and receives the carry of the row below. The carry out of
+    the TOP row is returned separately (callers fold it via *608).
 
-    One full carry chain normalizes to strict digits; rows >= 20 (plus
-    the chain carry) fold back with *608; a second chain + a bounded
-    final-carry fold finish. The last fold's ripple is cut after 8 rows —
-    row 8 may keep 1-ulp slack, which the product bound absorbs
-    (20*(2^13+2)^2 < 2^32), mirroring the 16-bit _reduce's tail."""
+    This replaces a sequential per-row chain (N ops of (1, W) each, 1/8
+    sublane utilization on the VPU) with ~4 dense (N, W) ops — the
+    single biggest vector-op cost in the radix-13 multiply."""
+    w = v.shape[1]
+    c = v >> 13
+    kept = v & _MASK13
+    return kept + _cat([_zeros(1, w), c[:-1]]), c[-1:]
+
+
+def _reduce13(d):
+    """(N, W) coefficients (each < 2^32) -> (20, W) value congruent
+    mod p with SLACK limbs: the steady-state bound is the fixpoint of
+    L -> 2^13 + carry-chain(20*L^2), which converges to L* ~ 10.7k; the
+    uint32 product-column requirement is 20*L^2 < 2^32 i.e. L < 14654,
+    comfortably above L* (empirically max limb ~8.3k over chained-op
+    stress, tests/test_ops_ed25519.py::TestRadix13Field). N is 39 from
+    a product, 20 from an add.
+
+    Three vectorized carry rounds with *608 folds (2^260 ≡ 608 mod p) —
+    replacing sequential per-row chains (~120 ops of (1, W) each at 1/8
+    sublane utilization) with ~12 dense (N, W) ops."""
     n = d.shape[0]
-    out = []
-    carry = None
-    for k in range(n):
-        v = d[k : k + 1] if carry is None else d[k : k + 1] + carry
-        out.append(v & _MASK13)
-        carry = v >> 13
-    lo = out[:ROWS13]
-    his = out[ROWS13:] + [carry]
-    for k, h in enumerate(his):
-        lo[k] = lo[k] + _F13 * h
-    out2 = []
-    carry = None
-    for k in range(ROWS13):
-        v = lo[k] if carry is None else lo[k] + carry
-        out2.append(v & _MASK13)
-        carry = v >> 13
-    v0 = out2[0] + _F13 * carry
-    out2[0] = v0 & _MASK13
-    c = v0 >> 13
-    for k in range(1, 8):
-        v = out2[k] + c
-        out2[k] = v & _MASK13
-        c = v >> 13
-    out2[8] = out2[8] + c
-    return jnp.concatenate(out2, axis=0)
+    w = d.shape[1]
+    va, ca = _carry_round13(d)  # (n, W) rows < 2^13 + 2^18; ca < 2^18
+    if n > ROWS13:
+        lo = va[:ROWS13]
+        hi = _cat([va[ROWS13:], ca])  # rows at 2^260.. : each < 2^18+
+        pad = ROWS13 - hi.shape[0]
+        hi_full = _cat([hi, _zeros(pad, w)]) if pad > 0 else hi[:ROWS13]
+        lo = lo + _F13 * hi_full
+    else:
+        lo = va + _F13 * _cat([ca, _zeros(ROWS13 - 1, w)])  # fold via row 0?
+    vb, cb = _carry_round13(lo)
+    vb = vb + _F13 * _cat([cb, _zeros(ROWS13 - 1, w)])
+    vc, cc = _carry_round13(vb)
+    return vc + _F13 * _cat([cc, _zeros(ROWS13 - 1, w)])
 
 
 def _mul13(a, b):
-    """Radix-13 schoolbook: no lo/hi splitting (products are 26-bit and
-    column sums < 20*2^26 < 2^31)."""
+    """Radix-13 schoolbook: no lo/hi splitting. Inputs carry slack
+    limbs (< L* ~ 11.2k, see _reduce13): products are ~27.5-bit and
+    column sums reach ~2^31.4 — within uint32, NOT within int32; the
+    fixpoint argument in _reduce13 is what keeps this safe."""
     w = a.shape[1]
     if _fast_mul_active():
         acc = _zeros(2 * ROWS13 - 1, w)
@@ -338,8 +348,9 @@ def _mul13(a, b):
 
 
 def _square13(a):
-    """a^2 via symmetry: cross terms doubled (column sums < 10*2^27 +
-    2^26 < 2^31)."""
+    """a^2 via symmetry: cross terms doubled. Slack-limb inputs give
+    column sums ~21*L*^2 < 2^31.7 — uint32-safe per _reduce13's
+    fixpoint bound."""
     w = a.shape[1]
     acc = _zeros(2 * ROWS13 - 1, w)
     if _fast_mul_active():
@@ -381,29 +392,41 @@ def _mul_const13(a, limbs):
     return _reduce13(acc)
 
 
+def _sub13_bias_rows():
+    """Per-row constants for the vectorized subtraction: the digits of
+    4C (C = 2^260 - 608 ≡ 0 mod p) with +2^14 added to EVERY row and -2
+    compensated into the next position (net value unchanged; the top
+    compensation comes out of 4C's implicit 2^262-bits digit, 3 -> 1).
+    With them, a - b + bias is NON-NEGATIVE per row for any slack-limbed
+    a, b (rows < 2^13.6): min row value = 0 - 12289 + 22144 > 0 — so a
+    single UNSIGNED carry round normalizes; no borrow can ripple."""
+    base = 4 * (2**260 - 608)
+    d = [(base >> (13 * k)) & 0x1FFF for k in range(ROWS13)]
+    rows = [d[0] + 2**14] + [d[k] + 2**14 - 2 for k in range(1, ROWS13)]
+    top = (base >> 260) - 2  # = 1
+    return rows, top
+
+
+_SUB13_ROWS, _SUB13_TOP = _sub13_bias_rows()
+
+
 def _sub13(a, b):
-    """a - b mod p for values < 2^260: borrow chain of a - b + 2C where
-    C = 2^260 - 608 ≡ 0 (mod p). 2C = 2^261 - 1216 has a 21st limb
-    (value 1 at position 2^260), carried implicitly: the chain's carry-out
-    plus that limb is the total digit at 2^260, which is ALWAYS >= 0
-    (a - b > -2^260 and 2C - 2^260 = 2^260 - 1216), so there is no
-    negative tail case; the digit folds via *608 (2^260 ≡ 608 mod p)."""
-    two_c = _limbs13(2 * (2**260 - 608))  # truncated to 20 limbs
-    rows = []
-    carry = None
-    for k in range(ROWS13):
-        v = (
-            a[k : k + 1].astype(jnp.int32)
-            - b[k : k + 1].astype(jnp.int32)
-            + np.int32(two_c[k])
-        )
-        if carry is not None:
-            v = v + carry
-        rows.append((v & 0x1FFF).astype(jnp.uint32))
-        carry = v >> 13
-    digit_260 = (carry + 1).astype(jnp.uint32)  # +1 = 2C's implicit top limb
-    rows[0] = rows[0] + digit_260 * _F13
-    return _reduce13(jnp.concatenate(rows, axis=0))
+    """a - b mod p for slack-limbed values: one dense a - b + bias (all
+    rows provably non-negative, see _sub13_bias_rows), ONE vectorized
+    carry round, and a *608 fold of the 2^260-digit (= top carry + 1).
+    Output limbs < 2^13 + 608*6 < 11.9k, inside every consumer's slack
+    budget (see _reduce13's fixpoint bound)."""
+    w = a.shape[1]
+    bias = jnp.concatenate(
+        [jnp.full((1, w), np.uint32(v), jnp.uint32) for v in _SUB13_ROWS],
+        axis=0,
+    )
+    # rows stay non-negative, so plain uint32 wrap-free arithmetic works
+    v = a + bias - b
+    vr, c_top = _carry_round13(v)
+    digit_260 = c_top + np.uint32(_SUB13_TOP)
+    row0 = vr[0:1] + digit_260 * _F13
+    return _cat([row0, vr[1:]])
 
 
 def _cond_sub13(a, limbs):
@@ -420,8 +443,10 @@ def _cond_sub13(a, limbs):
 
 
 def _canonical13(a):
-    """True canonical (< p) from any strict-limb value < 2^260 ~ 32p:
-    binary descent over conditional subtractions of 16p, 8p, 4p, 2p, p, p."""
+    """True canonical (< p) from any SLACK-limbed value (limbs < L* ~
+    11.2k, magnitudes up to ~1.4 * 2^260 ~ 45p): binary descent over
+    conditional subtractions of 16p, 16p, 8p, 4p, 2p, p, p (48p
+    coverage)."""
     r = a
     for limbs in _CANON13_STEPS:
         r, _ = _cond_sub13(r, limbs)
