@@ -211,7 +211,9 @@ class AbstractNode:
             else _dev_seed(members[my_index]["entropy"])
         )
         my_pub_hex = members[my_index].get("signing_pub")
-        if my_pub_hex and _edm.public_from_seed(my_seed).hex() != my_pub_hex:
+        if my_pub_hex and _edm.public_from_seed(my_seed) != bytes.fromhex(
+            my_pub_hex
+        ):
             # e.g. a stale node.conf after a redeploy regenerated seeds:
             # this replica's votes would be silently rejected by peers,
             # degrading fault tolerance with no error anywhere — fail fast

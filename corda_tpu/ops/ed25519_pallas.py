@@ -301,7 +301,8 @@ def _carry_round13(v):
 def _reduce13(d):
     """(N, W) coefficients (each < 2^32) -> (20, W) value congruent
     mod p with SLACK limbs: the steady-state bound is the fixpoint of
-    L -> 2^13 + carry-chain(20*L^2), which converges to L* ~ 10.7k; the
+    L -> 2^13 + carry-chain(20*L^2), bounded by the worst single-op
+    output (11840, from _sub13's 608*6 fold); the
     uint32 product-column requirement is 20*L^2 < 2^32 i.e. L < 14654,
     comfortably above L* (empirically max limb ~8.3k over chained-op
     stress, tests/test_ops_ed25519.py::TestRadix13Field). N is 39 from
@@ -312,15 +313,15 @@ def _reduce13(d):
     sublane utilization) with ~12 dense (N, W) ops."""
     n = d.shape[0]
     w = d.shape[1]
-    va, ca = _carry_round13(d)  # (n, W) rows < 2^13 + 2^18; ca < 2^18
+    assert n in (ROWS13, 2 * ROWS13 - 1), n  # the *608 fold weights assume it
+    va, ca = _carry_round13(d)  # (n, W) normalized rows; ca at 2^(13n)
     if n > ROWS13:
         lo = va[:ROWS13]
-        hi = _cat([va[ROWS13:], ca])  # rows at 2^260.. : each < 2^18+
-        pad = ROWS13 - hi.shape[0]
-        hi_full = _cat([hi, _zeros(pad, w)]) if pad > 0 else hi[:ROWS13]
-        lo = lo + _F13 * hi_full
+        hi = _cat([va[ROWS13:], ca])  # exactly 20 rows at 2^260..
+        lo = lo + _F13 * hi
     else:
-        lo = va + _F13 * _cat([ca, _zeros(ROWS13 - 1, w)])  # fold via row 0?
+        # n == ROWS13: the only out-of-range digit is ca, at 2^260
+        lo = va + _F13 * _cat([ca, _zeros(ROWS13 - 1, w)])
     vb, cb = _carry_round13(lo)
     vb = vb + _F13 * _cat([cb, _zeros(ROWS13 - 1, w)])
     vc, cc = _carry_round13(vb)
@@ -329,9 +330,10 @@ def _reduce13(d):
 
 def _mul13(a, b):
     """Radix-13 schoolbook: no lo/hi splitting. Inputs carry slack
-    limbs (< L* ~ 11.2k, see _reduce13): products are ~27.5-bit and
-    column sums reach ~2^31.4 — within uint32, NOT within int32; the
-    fixpoint argument in _reduce13 is what keeps this safe."""
+    limbs (worst case 11840 = _sub13's output bound, the single proven
+    bound all radix-13 comments share): products are ~27.5-bit and
+    column sums reach 20 * 11840^2 = 2.80e9 — within uint32, NOT within
+    int32; _reduce13's fixpoint argument keeps this stable."""
     w = a.shape[1]
     if _fast_mul_active():
         acc = _zeros(2 * ROWS13 - 1, w)
@@ -349,8 +351,8 @@ def _mul13(a, b):
 
 def _square13(a):
     """a^2 via symmetry: cross terms doubled. Slack-limb inputs give
-    column sums ~21*L*^2 < 2^31.7 — uint32-safe per _reduce13's
-    fixpoint bound."""
+    column sums <= 21 * 11840^2 = 2.94e9 (worst-case limb 11840, see
+    _mul13) — uint32-safe per _reduce13's fixpoint bound."""
     w = a.shape[1]
     acc = _zeros(2 * ROWS13 - 1, w)
     if _fast_mul_active():

@@ -17,11 +17,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_one(blk: int, chunk: int, timeout: float, ecdsa_blk: int = 0,
-            fast_mul: bool = True) -> dict:
+            radix: int = 13) -> dict:
     env = dict(os.environ)
     env["CORDA_TPU_ED25519_BLK"] = str(blk)
     env["CORDA_TPU_PIPE_CHUNK"] = str(chunk)
-    env["CORDA_TPU_FAST_MUL"] = "1" if fast_mul else "0"
+    env["CORDA_TPU_ED25519_RADIX"] = str(radix)
+    env["CORDA_TPU_FAST_MUL"] = "0"  # cannot lower on current Mosaic
     if ecdsa_blk:
         env["CORDA_TPU_ECDSA_BLK"] = str(ecdsa_blk)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -51,19 +52,20 @@ def main() -> int:
     ap.add_argument("--chunks", default="65536,131072")
     ap.add_argument("--timeout", type=float, default=1800)
     ap.add_argument(
-        "--ab-fast-mul", action="store_true",
-        help="run each config with CORDA_TPU_FAST_MUL on AND off "
-        "(the Mosaic live-row accumulation A/B, docs/perf-roofline.md)",
+        "--radixes", default="13,16",
+        help="limb radixes to A/B (13 = default dense radix-2^13 field; "
+        "16 = the round-2-measured radix-2^16 field). Fast-mul is always "
+        "off: its scatter-add cannot lower on current Mosaic "
+        "(docs/perf-roofline.md).",
     )
     args = ap.parse_args()
 
     results = []
-    fast_opts = (True, False) if args.ab_fast_mul else (True,)
     for blk in (int(b) for b in args.blks.split(",")):
         for chunk in (int(c) for c in args.chunks.split(",")):
-            for fast in fast_opts:
-                rec = run_one(blk, chunk, args.timeout, fast_mul=fast)
-                rec["fast_mul"] = fast
+            for radix in (int(r) for r in args.radixes.split(",")):
+                rec = run_one(blk, chunk, args.timeout, radix=radix)
+                rec["radix"] = radix
                 print(json.dumps(rec), flush=True)
                 results.append(rec)
     ok = [r for r in results if "value" in r]
@@ -71,7 +73,7 @@ def main() -> int:
         best = max(ok, key=lambda r: r["value"])
         print(
             f"# best: BLK={best['blk']} CHUNK={best['chunk']} "
-            f"fast_mul={best['fast_mul']} "
+            f"radix={best['radix']} "
             f"-> {best['value']:,.0f} sigs/s (vs_baseline {best['vs_baseline']})"
         )
     return 0
