@@ -239,6 +239,10 @@ class AbstractNode:
             signing_seed=my_seed,
             replica_pubs=replica_pubs,
         )
+        if cfg.get("view_timeout"):
+            # per-deployment view-change timer (tests use a short one so
+            # a primary kill fails over inside the client's wait window)
+            replica.VIEW_TIMEOUT = float(cfg["view_timeout"])
         self.bft_replica = replica
         # the replica state machine is single-threaded by design (unlike
         # RaftNode, which locks internally): the pump handler and the

@@ -120,6 +120,14 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
             }
             if is_bft:
                 cluster_block["signing_seed"] = seeds[i].hex()
+                if n.get("view_timeout") is not None:
+                    vt = float(n["view_timeout"])
+                    if vt <= 0:
+                        raise ValueError(
+                            f"bft notary {n['name']!r}: view_timeout must "
+                            f"be > 0 (got {vt})"
+                        )
+                    cluster_block["view_timeout"] = vt
             entry["bft_cluster" if is_bft else "raft_cluster"] = cluster_block
             out.append(entry)
     return out

@@ -234,48 +234,57 @@ class TestBFTNotaryClusterProcesses:
     signatures fulfilling the f+1-threshold composite identity; killing
     one non-primary member (f=1) mid-run must not stop notarisation."""
 
-    def test_cluster_notarises_and_survives_member_kill(self):
+    @staticmethod
+    def _boot_cluster(prefix, cluster_name, extra=None, warm_to=3):
+        """Deploy 4 BFT members + 2 banks, resolve identities, start a
+        driver and let it complete `warm_to` pairs. Returns
+        (factory, resolved, nodes, cluster, me, peer, driver)."""
         from corda_tpu.testing.smoketesting import Factory
         from corda_tpu.tools.cordform import deploy_nodes
 
-        base = tempfile.mkdtemp(prefix="bft-real-")
-        spec = {
-            "nodes": [
-                {"name": "O=BFTNotary,L=Zurich,C=CH",
-                 "notary": "bft", "cluster_size": 4,
-                 "network_map_service": True},
-                {"name": "O=BFTBankA,L=London,C=GB"},
-                {"name": "O=BFTBankB,L=Paris,C=FR"},
-            ]
+        base = tempfile.mkdtemp(prefix=prefix)
+        notary_entry = {
+            "name": cluster_name, "notary": "bft", "cluster_size": 4,
+            "network_map_service": True,
         }
+        notary_entry.update(extra or {})
+        spec = {"nodes": [
+            notary_entry,
+            {"name": "O=%sBankA,L=London,C=GB" % prefix.rstrip("-")},
+            {"name": "O=%sBankB,L=Paris,C=FR" % prefix.rstrip("-")},
+        ]}
         resolved = deploy_nodes(spec, base)
         assert len(resolved) == 6  # 4 members + 2 banks
         factory = Factory(base)
         nodes = [factory.launch(conf["dir"]) for conf in resolved]
+        conn = nodes[4].connect()
         try:
-            conn = nodes[4].connect()
-            try:
-                me = conn.proxy.node_info()
-                notaries = conn.proxy.notary_identities()
-                # exactly ONE notary: the cluster identity, not 4 members
-                assert len(notaries) == 1, [n.name for n in notaries]
-                cluster = notaries[0]
-                assert cluster.name == "O=BFTNotary,L=Zurich,C=CH"
-            finally:
-                conn.close()
-            conn_b = nodes[5].connect()
-            try:
-                peer = conn_b.proxy.node_info()
-            finally:
-                conn_b.close()
+            me = conn.proxy.node_info()
+            notaries = conn.proxy.notary_identities()
+            # exactly ONE notary: the cluster identity, not 4 members
+            assert len(notaries) == 1, [n.name for n in notaries]
+            cluster = notaries[0]
+            assert cluster.name == cluster_name
+        finally:
+            conn.close()
+        conn_b = nodes[5].connect()
+        try:
+            peer = conn_b.proxy.node_info()
+        finally:
+            conn_b.close()
+        driver = _Driver(nodes[4], cluster, me, peer).start()
+        deadline = time.monotonic() + 180
+        while len(driver.completed) < warm_to:
+            assert time.monotonic() < deadline, (
+                f"cluster never notarised: {driver.errors[-3:]}"
+            )
+            time.sleep(0.3)
+        return factory, resolved, nodes, cluster, me, peer, driver
 
-            driver = _Driver(nodes[4], cluster, me, peer).start()
-            deadline = time.monotonic() + 180
-            while len(driver.completed) < 3:
-                assert time.monotonic() < deadline, (
-                    f"cluster never notarised: {driver.errors[-3:]}"
-                )
-                time.sleep(0.3)
+    def test_cluster_notarises_and_survives_member_kill(self):
+        (_factory, _resolved, nodes, _cluster, _me, _peer,
+         driver) = self._boot_cluster("bft-real-", "O=BFTNotary,L=Zurich,C=CH")
+        try:
 
             # kill member 1: not the view-0 primary (member 0) and not
             # the member holding the cluster route (last registered), so
@@ -286,6 +295,34 @@ class TestBFTNotaryClusterProcesses:
             while len(driver.completed) < before + 3:
                 assert time.monotonic() < deadline, (
                     f"no progress after member kill: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+            driver.stop()
+            _assert_no_loss_no_dup(driver, nodes[5])
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_primary_kill_triggers_view_change(self):
+        """Killing the view-0 PRIMARY (member 0) forces a PBFT view
+        change: the remaining 3 >= 2f+1 replicas time out on the pending
+        request, elect view 1 (member 1 primary, carrying prepared
+        certificates), and notarisation resumes — the reference's
+        BFT-SMaRt leader-failure semantics as real OS processes."""
+        (_factory, _resolved, nodes, _cluster, _me, _peer,
+         driver) = self._boot_cluster(
+            "bft-vc-", "O=BFTVC,L=Zurich,C=CH",
+            # short view-change timer: fail over inside the client wait
+            extra={"view_timeout": 6.0}, warm_to=2,
+        )
+        try:
+            nodes[0].kill()  # the view-0 primary orders all commits
+            before = len(driver.completed)
+            deadline = time.monotonic() + 180
+            while len(driver.completed) < before + 2:
+                assert time.monotonic() < deadline, (
+                    f"no progress after PRIMARY kill (view change "
+                    f"failed): {driver.errors[-3:]}"
                 )
                 time.sleep(0.3)
             driver.stop()
