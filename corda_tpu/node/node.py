@@ -239,10 +239,17 @@ class AbstractNode:
             signing_seed=my_seed,
             replica_pubs=replica_pubs,
         )
-        if cfg.get("view_timeout"):
+        if cfg.get("view_timeout") is not None:
             # per-deployment view-change timer (tests use a short one so
             # a primary kill fails over inside the client's wait window)
-            replica.VIEW_TIMEOUT = float(cfg["view_timeout"])
+            vt = float(cfg["view_timeout"])
+            if vt <= 0:
+                # a non-positive timer would fire a view change on every
+                # tick whenever any request is pending — perpetual churn
+                raise ValueError(
+                    f"bft_cluster view_timeout must be > 0, got {vt}"
+                )
+            replica.VIEW_TIMEOUT = vt
         self.bft_replica = replica
         # the replica state machine is single-threaded by design (unlike
         # RaftNode, which locks internally): the pump handler and the

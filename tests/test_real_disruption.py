@@ -256,29 +256,36 @@ class TestBFTNotaryClusterProcesses:
         resolved = deploy_nodes(spec, base)
         assert len(resolved) == 6  # 4 members + 2 banks
         factory = Factory(base)
-        nodes = [factory.launch(conf["dir"]) for conf in resolved]
-        conn = nodes[4].connect()
+        nodes = []
         try:
-            me = conn.proxy.node_info()
-            notaries = conn.proxy.notary_identities()
-            # exactly ONE notary: the cluster identity, not 4 members
-            assert len(notaries) == 1, [n.name for n in notaries]
-            cluster = notaries[0]
-            assert cluster.name == cluster_name
-        finally:
-            conn.close()
-        conn_b = nodes[5].connect()
-        try:
-            peer = conn_b.proxy.node_info()
-        finally:
-            conn_b.close()
-        driver = _Driver(nodes[4], cluster, me, peer).start()
-        deadline = time.monotonic() + 180
-        while len(driver.completed) < warm_to:
-            assert time.monotonic() < deadline, (
-                f"cluster never notarised: {driver.errors[-3:]}"
-            )
-            time.sleep(0.3)
+            nodes = [factory.launch(conf["dir"]) for conf in resolved]
+            conn = nodes[4].connect()
+            try:
+                me = conn.proxy.node_info()
+                notaries = conn.proxy.notary_identities()
+                # exactly ONE notary: the cluster identity, not 4 members
+                assert len(notaries) == 1, [n.name for n in notaries]
+                cluster = notaries[0]
+                assert cluster.name == cluster_name
+            finally:
+                conn.close()
+            conn_b = nodes[5].connect()
+            try:
+                peer = conn_b.proxy.node_info()
+            finally:
+                conn_b.close()
+            driver = _Driver(nodes[4], cluster, me, peer).start()
+            deadline = time.monotonic() + 180
+            while len(driver.completed) < warm_to:
+                assert time.monotonic() < deadline, (
+                    f"cluster never notarised: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+        except BaseException:
+            # a failed boot/warm-up must not orphan up to 6 OS processes
+            for n in nodes:
+                n.close()
+            raise
         return factory, resolved, nodes, cluster, me, peer, driver
 
     def test_cluster_notarises_and_survives_member_kill(self):

@@ -311,3 +311,59 @@ class TestShellAgainstLiveNode:
         finally:
             for n in nodes:
                 n.close()
+
+
+class TestBFTClusterExpansion:
+    """cordform's BFT expansion: per-member RANDOM signing seeds (private
+    seed only in the member's own conf), shared publics, and seed/pub
+    consistency — the key-distribution contract _make_bft_notary_service
+    relies on."""
+
+    def test_seeds_unique_and_consistent(self, tmp_path):
+        import json
+        import os
+
+        from corda_tpu.core.crypto import ed25519_math
+        from corda_tpu.tools.cordform import deploy_nodes
+
+        resolved = deploy_nodes(
+            {"nodes": [{"name": "O=ExpBFT,L=Zurich,C=CH", "notary": "bft",
+                        "cluster_size": 4}]},
+            str(tmp_path),
+        )
+        assert len(resolved) == 4
+        seeds, pubs = [], []
+        shared_pub_lists = []
+        for i, conf_entry in enumerate(resolved):
+            conf = json.load(
+                open(os.path.join(conf_entry["dir"], "node.conf"))
+            )
+            block = conf["bft_cluster"]
+            assert block["index"] == i
+            seed = bytes.fromhex(block["signing_seed"])
+            member = block["members"][i]
+            # the private seed matches the member's shared public key
+            assert ed25519_math.public_from_seed(seed).hex() == (
+                member["signing_pub"]
+            )
+            seeds.append(seed)
+            pubs.append(member["signing_pub"])
+            shared_pub_lists.append(
+                [m["signing_pub"] for m in block["members"]]
+            )
+        # every member's conf carries the SAME public-key list
+        assert all(pl == shared_pub_lists[0] for pl in shared_pub_lists)
+        assert len(set(seeds)) == 4, "signing seeds must be random per member"
+        assert len(set(pubs)) == 4
+
+    def test_undersized_bft_cluster_rejected(self, tmp_path):
+        import pytest as _pytest
+
+        from corda_tpu.tools.cordform import deploy_nodes
+
+        with _pytest.raises(ValueError, match="cluster_size >= 4"):
+            deploy_nodes(
+                {"nodes": [{"name": "O=SmallBFT,L=X,C=GB", "notary": "bft",
+                            "cluster_size": 3}]},
+                str(tmp_path),
+            )
