@@ -510,6 +510,100 @@ class TestMultiHopResolution:
         net.stop_nodes()
 
 
+class TestDeepBackchainResolution:
+    """The framework's 'long-context' axis (SURVEY §5): transaction
+    back-chains resolved recursively. A 40-deep chain bounced between
+    two parties must resolve completely for a third party that has seen
+    NONE of it; and the BFS transaction-count bound must refuse a chain
+    that exceeds it rather than downloading unboundedly."""
+
+    def _chain(self, net, notary, alice, bob, depth):
+        b = TransactionBuilder(notary=notary.info)
+        b.add_output_state(OwnedState(owner=alice.info, value=1))
+        b.add_command(MoveCmd(), alice.info.owning_key)
+        stx = alice.services.sign_initial_transaction(b)
+        h = alice.start_flow(FinalityFlow(stx), stx)
+        net.run_network()
+        h.result.result(timeout=5)
+        owner, other = alice, bob
+        for _ in range(depth):
+            b = TransactionBuilder(notary=notary.info)
+            b.add_input_state(stx.tx.out_ref(0))
+            b.add_output_state(OwnedState(owner=other.info, value=1))
+            b.add_command(MoveCmd(), owner.info.owning_key)
+            nxt = owner.services.sign_initial_transaction(b)
+            h = owner.start_flow(FinalityFlow(nxt), nxt)
+            net.run_network()
+            h.result.result(timeout=5)
+            stx, (owner, other) = nxt, (other, owner)
+        return stx, owner
+
+    def test_forty_deep_chain_resolves_for_stranger(self):
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        alice = net.create_node("O=DeepAlice,L=London,C=GB")
+        bob = net.create_node("O=DeepBob,L=New York,C=US")
+        stx, owner = self._chain(net, notary, alice, bob, depth=40)
+
+        charlie = net.create_node("O=DeepCharlie,L=Paris,C=FR")
+        b = TransactionBuilder(notary=notary.info)
+        b.add_input_state(stx.tx.out_ref(0))
+        b.add_output_state(OwnedState(owner=charlie.info, value=1))
+        b.add_command(MoveCmd(), owner.info.owning_key)
+        final = owner.services.sign_initial_transaction(b)
+        h = owner.start_flow(FinalityFlow(final), final)
+        net.run_network()
+        h.result.result(timeout=10)
+        # the stranger holds the full 42-tx history and the live state
+        assert charlie.services.validated_transactions.get(final.id) is not None
+        assert charlie.services.validated_transactions.get(stx.id) is not None
+        states = charlie.services.vault_service.unconsumed_states("OwnedContract")
+        assert len(states) == 1 and states[0].state.data.owner == charlie.info
+        net.stop_nodes()
+
+    def test_transaction_count_bound_refuses_oversized_chain(self, monkeypatch):
+        from corda_tpu.core.flows.library import ResolveTransactionsFlow
+
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=False)
+        alice = net.create_node("O=CapAlice,L=London,C=GB")
+        bob = net.create_node("O=CapBob,L=New York,C=US")
+        stx, owner = self._chain(net, notary, alice, bob, depth=12)
+
+        monkeypatch.setattr(ResolveTransactionsFlow, "MAX_TRANSACTIONS", 6)
+        charlie = net.create_node("O=CapCharlie,L=Paris,C=FR")
+        b = TransactionBuilder(notary=notary.info)
+        b.add_input_state(stx.tx.out_ref(0))
+        b.add_output_state(OwnedState(owner=charlie.info, value=1))
+        b.add_command(MoveCmd(), owner.info.owning_key)
+        final = owner.services.sign_initial_transaction(b)
+        h = owner.start_flow(FinalityFlow(final), final)
+        import logging
+
+        # capture charlie's responder-side failure: the refusal must be
+        # SPECIFICALLY the graph-size bound, not a broken delivery (the
+        # initiator's finality deliberately survives a recipient refusing
+        # a broadcast — the tx is already notarised and recorded locally)
+        records = []
+
+        class _Trap(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        trap = _Trap()
+        logging.getLogger().addHandler(trap)
+        try:
+            net.run_network()
+        finally:
+            logging.getLogger().removeHandler(trap)
+        h.result.result(timeout=5)  # sender side completed
+        assert any("dependency graph exceeded" in m for m in records), (
+            records[-5:]
+        )
+        assert charlie.services.validated_transactions.get(final.id) is None
+        net.stop_nodes()
+
+
 class TestTearOffCompleteness:
     """Regression: a tear-off hiding inputs must not obtain a notary
     signature (hidden inputs would stay spendable: signed double spend)."""

@@ -257,8 +257,10 @@ class TestBFTNotaryClusterProcesses:
         assert len(resolved) == 6  # 4 members + 2 banks
         factory = Factory(base)
         nodes = []
+        driver = None
         try:
-            nodes = [factory.launch(conf["dir"]) for conf in resolved]
+            for conf in resolved:  # explicit loop: partial boots must be
+                nodes.append(factory.launch(conf["dir"]))  # closable below
             conn = nodes[4].connect()
             try:
                 me = conn.proxy.node_info()
@@ -283,6 +285,12 @@ class TestBFTNotaryClusterProcesses:
                 time.sleep(0.3)
         except BaseException:
             # a failed boot/warm-up must not orphan up to 6 OS processes
+            # or leave the driver thread spinning against dead nodes
+            if driver is not None:
+                try:
+                    driver.stop(timeout=5)
+                except BaseException:
+                    pass
             for n in nodes:
                 n.close()
             raise
