@@ -176,6 +176,7 @@ def main(argv=None) -> int:
             node._identity_key.private,
             on_entry,
             extra_identities=extra_identities,
+            extra_refresh_interval=cfg.cluster_route_refresh,
         )
         netmap_client.register_and_fetch()
 

@@ -34,6 +34,9 @@ DEFAULTS = {
     "network_map": None,            # "HOST:PORT" of the directory node, or None
     "network_map_service": False,   # True: this node IS the directory node
     "tls": False,                   # mutual-TLS on the broker transport
+    # cluster members re-register their SHARED identity this often (s) so
+    # the route fails over to a live member quickly (0 disables)
+    "cluster_route_refresh": 20.0,
     "certificates_dir": "certificates",  # may be shared between dev nodes
     # CorDapp scan analogue (reference AbstractNode.scanCordapps /
     # installCordaServices, AbstractNode.kt:291-315): python modules to
@@ -59,6 +62,7 @@ class FullNodeConfiguration:
     tls: bool = False
     certificates_dir: str = "certificates"
     cordapps: List[str] = field(default_factory=list)
+    cluster_route_refresh: float = 20.0
 
 
 def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeConfiguration:
@@ -103,4 +107,5 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
             else os.path.join(base, cfg["certificates_dir"])
         ),
         cordapps=list(cfg["cordapps"]),
+        cluster_route_refresh=float(cfg["cluster_route_refresh"]),
     )

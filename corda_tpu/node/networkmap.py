@@ -198,8 +198,9 @@ class NetworkMapService:
             ok, reason = self._process_registration(signed)
             if reply_to:
                 self._reply(reply_to, {"kind": "register-ack", "ok": ok,
-                                       "error": reason})
-            if ok:
+                                       "error": reason,
+                                       "req_id": request.get("req_id")})
+            if ok and reason != "unchanged":
                 self._push({"kind": "push", "registration": signed})
         elif kind == "fetch":
             now = time.time()
@@ -242,11 +243,31 @@ class NetworkMapService:
             current = self._entries.get(reg.party.name)
             if current is not None and current.registration.serial >= reg.serial:
                 return False, "stale serial"
+            if current is not None:
+                cr = current.registration
+                if (
+                    cr.reg_type == reg.reg_type
+                    and cr.broker_address == reg.broker_address
+                    and tuple(cr.advertised_services)
+                    == tuple(reg.advertised_services)
+                    and cr.expires_at - time.time() > self._ttl_slack()
+                ):
+                    # fast shared-identity refreshes re-register every few
+                    # seconds as a liveness signal; an operationally
+                    # IDENTICAL entry far from expiry needs no rewrite of
+                    # the persisted map and no push to every subscriber
+                    return True, "unchanged"
             # REMOVE entries are retained (not popped) so their serial
             # still orders against late ADDs; fetch/query filter them out.
             self._entries[reg.party.name] = signed
             self._persist()
         return True, None
+
+    @staticmethod
+    def _ttl_slack() -> float:
+        """An entry within this margin of expiry is always re-accepted
+        so refreshes can extend it."""
+        return 3600.0
 
     def _reply(self, queue: str, payload: dict) -> None:
         try:
@@ -283,13 +304,23 @@ class NetworkMapClient:
                  advertised_services, identity_private_key,
                  on_entry: Callable[[NodeRegistration], None],
                  on_remove: Optional[Callable[[NodeRegistration], None]] = None,
-                 extra_identities=None):
+                 extra_identities=None,
+                 extra_refresh_interval: float = 20.0):
         """extra_identities: [(party, advertised_services, signer)] also
         registered at this node's address — a notary CLUSTER member
         advertises the cluster's composite identity this way, signing the
         entry with its own leaf key wrapped as a threshold-satisfying
         composite signature (reference: ServiceIdentityGenerator-produced
-        identities entering the network map)."""
+        identities entering the network map).
+
+        extra_refresh_interval: SHARED identities re-register on this fast
+        cadence from EVERY member (vs the node's own TTL/2 refresh). The
+        shared entry's route points at whichever member registered last;
+        when that member dies, another live member's next re-registration
+        replaces the route within one interval and the peers' bridges
+        reconnect to it — cluster availability does not wait for the
+        12-hour TTL refresh (reference parity: service addresses reach any
+        live member)."""
         self._broker = map_broker
         self._me = me
         self._my_address = my_address
@@ -299,6 +330,7 @@ class NetworkMapClient:
         self._on_entry = on_entry
         self._on_remove = on_remove
         self._serial = int(time.time() * 1000)
+        self._req_counter = 0
         self._ttl = 24 * 3600.0  # registration lifetime (refreshed at TTL/2)
         self._reply_queue = f"netmap.reply.{me.name}"
         self._push_queue = f"netmap.push.{me.name}"
@@ -307,6 +339,9 @@ class NetworkMapClient:
         self._reply_consumer = map_broker.create_consumer(self._reply_queue)
         self._push_consumer = map_broker.create_consumer(self._push_queue)
         self._stop = threading.Event()
+        self._extra_refresh_interval = float(extra_refresh_interval)
+        # serializes reply-queue conversations across the refresh threads
+        self._reg_lock = threading.Lock()
         self._push_thread = threading.Thread(
             target=self._consume_pushes, name=f"netmap-push-{me.name}",
             daemon=True,
@@ -338,24 +373,41 @@ class NetworkMapClient:
             if self._apply(signed):
                 count += 1
         self._push_thread.start()
+        # started only now: the fast loop shares the reply queue (under
+        # _reg_lock) and must not race the unlocked startup fetch above
+        if self._extra_identities and self._extra_refresh_interval > 0:
+            self._extra_thread = threading.Thread(
+                target=self._extra_refresh_loop,
+                name=f"netmap-cluster-refresh-{self._me.name}", daemon=True,
+            )
+            self._extra_thread.start()
         return count
 
+    def _next_req_id(self) -> str:
+        self._req_counter += 1
+        return f"{self._me.name}:{self._req_counter}"
+
     def _register(self, timeout: float) -> None:
-        self._serial += 1
-        reg = NodeRegistration(
-            self._me, self._my_address, self._advertised,
-            serial=self._serial, expires_at=time.time() + self._ttl,
-        )
-        self._request(
-            {"kind": "register",
-             "registration": sign_registration(reg, self._key),
-             "reply_to": self._reply_queue},
-        )
-        ack = self._await_reply("register-ack", timeout)
-        if not ack.get("ok"):
-            raise RuntimeError(
-                f"network map rejected registration: {ack.get('error')}"
+        with self._reg_lock:
+            self._serial += 1
+            reg = NodeRegistration(
+                self._me, self._my_address, self._advertised,
+                serial=self._serial, expires_at=time.time() + self._ttl,
             )
+            req_id = self._next_req_id()
+            self._request(
+                {"kind": "register",
+                 "registration": sign_registration(reg, self._key),
+                 "reply_to": self._reply_queue, "req_id": req_id},
+            )
+            ack = self._await_reply("register-ack", timeout, req_id=req_id)
+            if not ack.get("ok"):
+                raise RuntimeError(
+                    f"network map rejected registration: {ack.get('error')}"
+                )
+        self._register_extras(timeout)
+
+    def _register_extras(self, timeout: float) -> None:
         for party, services, signer in self._extra_identities:
             # SHARED key (e.g. a cluster identity all members register):
             # serials must order across PROCESSES, so each registration
@@ -368,14 +420,16 @@ class NetworkMapClient:
                 serial=int(time.time() * 1000),
                 expires_at=time.time() + self._ttl,
             )
-            self._request(
-                {"kind": "register",
-                 "registration": SignedRegistration(
-                     reg, signer(reg.signable_bytes())
-                 ),
-                 "reply_to": self._reply_queue},
-            )
-            ack = self._await_reply("register-ack", timeout)
+            with self._reg_lock:
+                req_id = self._next_req_id()
+                self._request(
+                    {"kind": "register",
+                     "registration": SignedRegistration(
+                         reg, signer(reg.signable_bytes())
+                     ),
+                     "reply_to": self._reply_queue, "req_id": req_id},
+                )
+                ack = self._await_reply("register-ack", timeout, req_id=req_id)
             if not ack.get("ok") and "stale serial" not in str(
                 ack.get("error", "")
             ):
@@ -393,10 +447,34 @@ class NetworkMapClient:
             except Exception:
                 pass  # map temporarily unreachable; retry next period
 
+    def _extra_refresh_loop(self) -> None:
+        """Fast shared-identity refresh: keep the cluster route pointing
+        at a LIVE member (see __init__'s extra_refresh_interval note)."""
+        import logging
+
+        while not self._stop.wait(self._extra_refresh_interval):
+            try:
+                self._register_extras(timeout=10.0)
+            except RuntimeError as exc:
+                # a PERMANENT rejection (bad signature etc.) silently
+                # disables failover for this member — make it visible
+                logging.getLogger(__name__).warning(
+                    "shared-identity refresh rejected: %s", exc
+                )
+            except Exception:
+                pass  # map temporarily unreachable; retry next period
+
     def _request(self, payload: dict) -> None:
         self._broker.send(NETWORK_MAP_QUEUE, serialize(payload))
 
-    def _await_reply(self, kind: str, timeout: float) -> dict:
+    def _await_reply(self, kind: str, timeout: float,
+                     req_id: Optional[str] = None) -> dict:
+        """Wait for a matching reply; non-matching replies are discarded.
+
+        `req_id` correlates register conversations: a register-ack whose
+        req_id differs is a STALE ack from a conversation that timed out
+        earlier — without the correlation, one timeout would permanently
+        shift every later conversation onto the previous one's ack."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             msg = self._reply_consumer.receive(
@@ -406,8 +484,11 @@ class NetworkMapClient:
                 continue
             self._reply_consumer.ack(msg)
             reply = deserialize(msg.payload)
-            if reply.get("kind") == kind:
-                return reply
+            if reply.get("kind") != kind:
+                continue
+            if req_id is not None and reply.get("req_id") != req_id:
+                continue  # stale ack from a timed-out conversation
+            return reply
         raise TimeoutError(f"no {kind} from network map")
 
     # -- push subscription ---------------------------------------------------

@@ -171,6 +171,8 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
             conf["raft_cluster"] = n["raft_cluster"]
         if n.get("bft_cluster"):
             conf["bft_cluster"] = n["bft_cluster"]
+        if n.get("cluster_route_refresh") is not None:
+            conf["cluster_route_refresh"] = float(n["cluster_route_refresh"])
         if spec.get("tls"):
             conf["tls"] = True
             conf["certificates_dir"] = shared_certs

@@ -113,6 +113,37 @@ class TestNetworkMapService:
         )
         assert not ok and reason == "expired"
 
+    def test_identical_reregistration_is_unchanged_no_persist(self):
+        """Fast shared-identity refreshes re-register every few seconds as
+        a liveness signal; an operationally identical entry far from
+        expiry must be acked WITHOUT rewriting the map or re-pushing."""
+        far = time.time() + 24 * 3600  # production TTL, far from expiry
+        ok, reason = self._register(
+            sign_registration(_reg(ALICE, serial=5, expires=far),
+                              ALICE_KP.private)
+        )
+        assert ok and reason is None
+        entry_before = self.svc.entries()[0]
+        ok, reason = self._register(
+            sign_registration(_reg(ALICE, serial=6, expires=far),
+                              ALICE_KP.private)
+        )
+        assert ok and reason == "unchanged"
+        # the stored entry (incl. serial) did not churn
+        assert self.svc.entries()[0].registration.serial == (
+            entry_before.registration.serial
+        )
+        # a CHANGED address still replaces the entry
+        ok, reason = self._register(
+            sign_registration(_reg(ALICE, addr="127.0.0.1:9999", serial=7,
+                                   expires=far),
+                              ALICE_KP.private)
+        )
+        assert ok and reason is None
+        assert self.svc.entries()[0].registration.broker_address == (
+            "127.0.0.1:9999"
+        )
+
     def test_client_register_fetch_and_push(self):
         learned = []
         alice_client = NetworkMapClient(

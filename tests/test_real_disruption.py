@@ -355,6 +355,74 @@ class TestRaftNotaryClusterProcesses:
     cluster presents one composite identity; killing a minority member
     mid-run must not stop notarisation or lose anything."""
 
+    def test_route_holder_kill_fails_over(self):
+        """Killing the member whose address currently serves the CLUSTER
+        route (the last registrant) must not strand notarisation until
+        the 12h TTL refresh: every member re-registers the shared
+        identity on the fast cadence (cluster_route_refresh), so the
+        route flips to a live member within one interval and the banks'
+        bridges reconnect there with their queued requests."""
+        from corda_tpu.testing.smoketesting import Factory
+        from corda_tpu.tools.cordform import deploy_nodes
+
+        base = tempfile.mkdtemp(prefix="raft-route-")
+        spec = {
+            "nodes": [
+                {"name": "O=RouteNotary,L=Zurich,C=CH",
+                 "notary": "raft-validating", "cluster_size": 3,
+                 "cluster_route_refresh": 3.0,
+                 "network_map_service": True},
+                {"name": "O=RouteBankA,L=London,C=GB"},
+                {"name": "O=RouteBankB,L=Paris,C=FR"},
+            ]
+        }
+        resolved = deploy_nodes(spec, base)
+        factory = Factory(base)
+        nodes = []
+        driver = None
+        try:
+            for conf in resolved:  # explicit loop: partial boots close below
+                nodes.append(factory.launch(conf["dir"]))
+            conn = nodes[3].connect()
+            try:
+                me = conn.proxy.node_info()
+                cluster = conn.proxy.notary_identities()[0]
+            finally:
+                conn.close()
+            conn_b = nodes[4].connect()
+            try:
+                peer = conn_b.proxy.node_info()
+            finally:
+                conn_b.close()
+
+            driver = _Driver(nodes[3], cluster, me, peer).start()
+            deadline = time.monotonic() + 120
+            while len(driver.completed) < 2:
+                assert time.monotonic() < deadline, driver.errors[-3:]
+                time.sleep(0.3)
+
+            # member 2 registered LAST at boot, so it holds the initial
+            # cluster route (subsequent fast refreshes may move it — any
+            # single member kill must heal within ~one interval either way)
+            nodes[2].kill()
+            before = len(driver.completed)
+            deadline = time.monotonic() + 150
+            while len(driver.completed) < before + 2:
+                assert time.monotonic() < deadline, (
+                    f"route never failed over: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+            driver.stop()
+            _assert_no_loss_no_dup(driver, nodes[4])
+        finally:
+            if driver is not None and not driver._stop.is_set():
+                try:
+                    driver.stop(timeout=5)
+                except BaseException:
+                    pass
+            for n in nodes:
+                n.close()
+
     def test_cluster_notarises_and_survives_member_kill(self):
         from corda_tpu.testing.smoketesting import Factory
         from corda_tpu.tools.cordform import deploy_nodes
