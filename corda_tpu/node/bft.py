@@ -19,12 +19,15 @@ implemented (the log is bounded by ledger growth, like the Raft provider).
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..core.serialization.codec import deserialize, serialize
+
+logger = logging.getLogger(__name__)
 
 BFT_TOPIC = "platform.bft"
 
@@ -68,7 +71,16 @@ class BFTReplica:
         reply_fn: Callable[[str, str, object], None],
         signing_seed: Optional[bytes] = None,
         replica_pubs: Optional[Dict[int, bytes]] = None,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
+        meta_store=None,
     ):
+        """snapshot_fn/restore_fn: dump/load the applied state machine
+        (the uniqueness map) for catch-up state transfer; meta_store: a
+        KVStore persisting (last_executed, view) so a RESTARTED replica
+        resumes from its own durable state instead of seq 0 (reference
+        BFTSMaRt.Replica's DefaultRecoverable snapshot get/install,
+        `BFTSMaRt.kt:150-276`)."""
         assert n_replicas >= 4, "BFT needs n >= 3f+1 with f >= 1"
         from ..core.crypto import ed25519_math
 
@@ -86,9 +98,28 @@ class BFTReplica:
             i: ed25519_math.public_from_seed(dev_signing_seed(i))
             for i in range(n_replicas)
         }
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self._meta = meta_store
         self.view = 0
         self.next_seq = 0  # primary's sequence counter
         self.last_executed = -1
+        if meta_store is not None:
+            blob = meta_store.get(b"bft_meta")
+            if blob is not None:
+                meta = deserialize(blob)
+                # the umap already reflects every execution <= this seq
+                # (apply happens before the meta save; re-apply of the
+                # boundary entry is idempotent)
+                self.last_executed = int(meta["last_executed"])
+                self.view = int(meta["view"])
+                # a restarted PRIMARY must not reassign sequence numbers
+                # its peers already hold pre-prepares for (the
+                # equivocation guard would stall every request for a
+                # whole VIEW_TIMEOUT) — resume from the persisted counter
+                self.next_seq = max(
+                    int(meta.get("next_seq", 0)), self.last_executed + 1
+                )
         # seq -> state
         self.requests: Dict[bytes, dict] = {}  # digest -> request
         self.pre_prepares: Dict[int, bytes] = {}  # seq -> digest
@@ -104,6 +135,9 @@ class BFTReplica:
         self.view_change_votes: Dict[int, Set[int]] = {}  # new view -> voters
         self._pending_since: Optional[float] = None
         self._now = 0.0
+        # catch-up state transfer (see _maybe_request_state)
+        self._gap_since: Optional[float] = None
+        self._state_resps: Dict[int, tuple] = {}  # sender -> (n, digest, dump)
 
     # -- identity helpers ----------------------------------------------------
 
@@ -160,6 +194,7 @@ class BFTReplica:
                 return  # duplicate
             seq = self.next_seq
             self.next_seq += 1
+            self._save_meta()  # a restarted primary must not reuse seqs
             self.pre_prepares[seq] = d
             psig = self._sign_prepare(self.view, seq, d)
             self._broadcast({
@@ -205,6 +240,10 @@ class BFTReplica:
             self._on_view_change(sender, msg)
         elif kind == "new_view":
             self._on_new_view(sender, msg)
+        elif kind == "state_req":
+            self._on_state_req(sender, msg)
+        elif kind == "state_resp":
+            self._on_state_resp(sender, msg)
 
     # Bound on how far ahead of execution the log may run: caps state growth
     # against a faulty peer spraying arbitrary (seq, digest) votes.
@@ -272,14 +311,109 @@ class BFTReplica:
             if seq not in self.executed:
                 self.executed.add(seq)
                 result = self.apply_fn(request["command"])
+                self._save_meta()
                 self.reply_fn(
                     request["client_id"], request["request_id"], result
                 )
+
+    # -- durable meta + catch-up state transfer -------------------------------
+
+    def _save_meta(self) -> None:
+        if self._meta is not None:
+            self._meta.put(b"bft_meta", serialize({
+                "last_executed": self.last_executed, "view": self.view,
+                "next_seq": self.next_seq,
+            }))
+
+    #: a gap between last_executed and higher committed seqs that persists
+    #: this long means the missing entries committed while we were down
+    #: (consensus traffic is not re-broadcast) — fetch state from peers
+    STATE_GAP_TIMEOUT = 2.0
+
+    def _maybe_request_state(self) -> None:
+        nxt = self.last_executed + 1
+        missing_seq = (
+            any(s > nxt for s in self.committed) and nxt not in self.committed
+        )
+        # a committed next instance whose REQUEST BODY we never saw (the
+        # pre-prepare is not re-broadcast) blocks execution just as hard
+        missing_body = (
+            nxt in self.committed and self.committed[nxt] not in self.requests
+        )
+        lagging = missing_seq or missing_body
+        if not lagging:
+            self._gap_since = None
+            return
+        if self._gap_since is None:
+            self._gap_since = self._now
+            return
+        if self._now - self._gap_since < self.STATE_GAP_TIMEOUT:
+            return
+        self._gap_since = self._now  # rate-limit re-requests
+        self._state_resps.clear()
+        self._broadcast({"kind": "state_req", "have": self.last_executed})
+
+    def _on_state_req(self, sender: int, msg: dict) -> None:
+        if self.snapshot_fn is None:
+            return
+        if int(msg.get("have", -1)) >= self.last_executed:
+            return  # requester is not behind us
+        dump = self.snapshot_fn()
+        self.transport(sender, serialize({
+            "kind": "state_resp",
+            "last_executed": self.last_executed,
+            "view": self.view,
+            "digest": hashlib.sha256(dump).digest(),
+            "dump": dump,
+        }))
+
+    def _on_state_resp(self, sender: int, msg: dict) -> None:
+        """Install a peer snapshot once f+1 DISTINCT replicas agree on
+        (last_executed, digest) — at least one of them is honest, so the
+        agreed state is the real committed prefix (the Byzantine-safe
+        equivalent of BFT-SMaRt's state-transfer quorum)."""
+        if self.restore_fn is None:
+            return
+        n = int(msg["last_executed"])
+        if n <= self.last_executed:
+            return
+        dump = msg["dump"]
+        if hashlib.sha256(dump).digest() != msg["digest"]:
+            return  # dump does not match its claimed digest
+        self._state_resps[sender] = (n, msg["digest"], dump, int(msg["view"]))
+        # group by (n, digest, view): the VIEW must be part of the f+1
+        # agreement — taking it from an arbitrary responder would let one
+        # Byzantine member wedge the recovering replica on a bogus view
+        groups: Dict[tuple, list] = {}
+        for rid, (rn, rd, rdump, rview) in self._state_resps.items():
+            groups.setdefault((rn, rd, rview), []).append((rid, rdump))
+        for (rn, _rd, rview), members in groups.items():
+            if rn > self.last_executed and len(members) >= self.f + 1:
+                _rid, rdump = members[0]
+                self.restore_fn(rdump)
+                self.last_executed = rn
+                self.next_seq = max(self.next_seq, rn + 1)
+                self.view = max(self.view, rview)
+                self.executed = {s for s in self.executed if s > rn}
+                for seq in [s for s in self.committed if s <= rn]:
+                    del self.committed[seq]
+                for seq in [s for s in self.pre_prepares if s <= rn]:
+                    del self.pre_prepares[seq]
+                self._save_meta()
+                self._state_resps.clear()
+                self._gap_since = None
+                logger.info(
+                    "%s installed state snapshot up to seq %d (view %d)",
+                    self.id, rn, self.view,
+                )
+                self._execute_ready()  # buffered later seqs may now chain
+                return
 
     # -- view change ---------------------------------------------------------
 
     def tick(self, now: float) -> None:
         self._now = now
+        self._maybe_request_state()
         if (
             self._pending_since is not None
             and now - self._pending_since >= self.VIEW_TIMEOUT

@@ -138,6 +138,9 @@ class NetworkMapService:
         self._broker = broker
         broker.create_queue(NETWORK_MAP_QUEUE)
         self._entries: Dict[str, SignedRegistration] = {}
+        #: unsigned server-side liveness: when each entry's registrant
+        #: last re-attempted registration (incl. "unchanged" fast-path)
+        self._last_seen: Dict[str, float] = {}
         self._subscribers: Dict[str, None] = {}
         self._lock = threading.Lock()
         self._persist_path = persist_path
@@ -223,13 +226,22 @@ class NetworkMapService:
             name = request.get("name")
             with self._lock:
                 signed = self._entries.get(name)
+                last_seen = self._last_seen.get(name)
             if signed is not None and (
                 signed.registration.reg_type == REMOVE
                 or signed.registration.expires_at < time.time()
             ):
                 signed = None
             if reply_to:
-                self._reply(reply_to, {"kind": "query-reply", "entry": signed})
+                self._reply(reply_to, {
+                    "kind": "query-reply", "entry": signed,
+                    # server-side liveness: updated on EVERY accepted
+                    # registration attempt, including "unchanged" ones
+                    # (the signed entry's serial freezes on the unchanged
+                    # fast path, so it cannot serve as the signal)
+                    "last_seen": last_seen,
+                    "req_id": request.get("req_id"),
+                })
 
     def _process_registration(self, signed) -> tuple:
         if not isinstance(signed, SignedRegistration):
@@ -245,29 +257,31 @@ class NetworkMapService:
                 return False, "stale serial"
             if current is not None:
                 cr = current.registration
+                now = time.time()
+                # "far from expiry" must be judged against the entry's OWN
+                # lifetime: the stored expiry has to outlast the client's
+                # TTL/2 refresh cadence, or refreshes would stop extending
+                # it and the entry would race its own expiry
+                new_lifetime = reg.expires_at - now
                 if (
                     cr.reg_type == reg.reg_type
                     and cr.broker_address == reg.broker_address
                     and tuple(cr.advertised_services)
                     == tuple(reg.advertised_services)
-                    and cr.expires_at - time.time() > self._ttl_slack()
+                    and cr.expires_at - now > 0.75 * new_lifetime
                 ):
                     # fast shared-identity refreshes re-register every few
                     # seconds as a liveness signal; an operationally
                     # IDENTICAL entry far from expiry needs no rewrite of
                     # the persisted map and no push to every subscriber
+                    self._last_seen[reg.party.name] = now
                     return True, "unchanged"
             # REMOVE entries are retained (not popped) so their serial
             # still orders against late ADDs; fetch/query filter them out.
             self._entries[reg.party.name] = signed
+            self._last_seen[reg.party.name] = time.time()
             self._persist()
         return True, None
-
-    @staticmethod
-    def _ttl_slack() -> float:
-        """An entry within this margin of expiry is always re-accepted
-        so refreshes can extend it."""
-        return 3600.0
 
     def _reply(self, queue: str, payload: dict) -> None:
         try:
@@ -407,8 +421,37 @@ class NetworkMapClient:
                 )
         self._register_extras(timeout)
 
-    def _register_extras(self, timeout: float) -> None:
+    def _query_entry(self, name: str, timeout: float):
+        """(signed_entry | None, last_seen | None) for a map name."""
+        with self._reg_lock:
+            req_id = self._next_req_id()
+            self._request({"kind": "query", "name": name,
+                           "reply_to": self._reply_queue, "req_id": req_id})
+            reply = self._await_reply("query-reply", timeout, req_id=req_id)
+        return reply.get("entry"), reply.get("last_seen")
+
+    def _register_extras(self, timeout: float, force: bool = False) -> None:
         for party, services, signer in self._extra_identities:
+            if not force:
+                # holder-liveness gate: when the shared entry's current
+                # holder (another member) is actively refreshing, skip our
+                # re-registration — otherwise N members would rotate the
+                # route every interval, re-persisting and re-pushing the
+                # map in steady state for no operational change. We take
+                # over only when the holder's attempts stop (dead) or the
+                # entry is ours to extend.
+                try:
+                    entry, last_seen = self._query_entry(party.name, timeout)
+                except Exception:
+                    entry, last_seen = None, None
+                if (
+                    entry is not None
+                    and entry.registration.broker_address != self._my_address
+                    and last_seen is not None
+                    and time.time() - last_seen
+                    < 2 * self._extra_refresh_interval
+                ):
+                    continue
             # SHARED key (e.g. a cluster identity all members register):
             # serials must order across PROCESSES, so each registration
             # takes a fresh wall-clock-ms serial — per-client counters

@@ -230,14 +230,18 @@ class AbstractNode:
             )
             for i, m in enumerate(members)
         }
-        replica = BFTReplica(
-            my_index, n, transport,
-            BFTUniquenessProvider.make_replica_apply(
+        apply_fn, snapshot_fn, restore_fn, meta_store = (
+            BFTUniquenessProvider.make_replica_state(
                 self.database, sign_tx_fn=sign_tx
-            ),
-            reply_fn,
+            )
+        )
+        replica = BFTReplica(
+            my_index, n, transport, apply_fn, reply_fn,
             signing_seed=my_seed,
             replica_pubs=replica_pubs,
+            snapshot_fn=snapshot_fn,
+            restore_fn=restore_fn,
+            meta_store=meta_store,
         )
         if cfg.get("view_timeout") is not None:
             # per-deployment view-change timer (tests use a short one so

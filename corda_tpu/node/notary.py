@@ -261,6 +261,33 @@ class BFTUniquenessProvider(UniquenessProvider):
 
         return apply
 
+    @staticmethod
+    def make_replica_state(db: NodeDatabase, sign_tx_fn=None):
+        """(apply_fn, snapshot_fn, restore_fn, meta_store) over ONE durable
+        db — everything a BFTReplica needs to survive restarts and serve
+        catch-up state transfer (reference DefaultRecoverable's
+        getSnapshot/installSnapshot, `BFTSMaRt.kt:150-276`)."""
+        apply = BFTUniquenessProvider.make_replica_apply(db, sign_tx_fn)
+        umap = KVStore(db, "bft_uniqueness")  # same store apply writes
+        meta = KVStore(db, "bft_replica_meta")
+
+        def snapshot() -> bytes:
+            return serialize(
+                [[bytes(k), bytes(v)] for k, v in umap.items()]
+            )
+
+        def restore(data: bytes) -> None:
+            # atomic: a crash mid-restore must never leave the uniqueness
+            # map partially cleared (holes there would answer 'no
+            # conflict' for already-spent states — silent Byzantine)
+            with db.transaction():
+                for k, _ in list(umap.items()):
+                    umap.delete(k)
+                for k, v in deserialize(data):
+                    umap.put(bytes(k), bytes(v))
+
+        return apply, snapshot, restore, meta
+
 
 # ---------------------------------------------------------------------------
 # Notary services

@@ -248,13 +248,16 @@ class MockNetwork:
                 return sign_tx
 
             for i, m in enumerate(members):
-                apply_fn = BFTUniquenessProvider.make_replica_apply(
-                    NodeDatabase(":memory:"), sign_tx_fn=make_sign(m)
+                apply_fn, snap_fn, rest_fn, meta = (
+                    BFTUniquenessProvider.make_replica_state(
+                        NodeDatabase(":memory:"), sign_tx_fn=make_sign(m)
+                    )
                 )
                 bus.replicas.append(
                     BFTReplica(
                         i, len(members), make_transport(i), apply_fn,
-                        make_reply(i),
+                        make_reply(i), snapshot_fn=snap_fn,
+                        restore_fn=rest_fn, meta_store=meta,
                     )
                 )
             return BFTUniquenessProvider(bus.client)

@@ -6,7 +6,22 @@ from collections import deque
 
 import pytest
 
+from corda_tpu.core.serialization.codec import deserialize, serialize
 from corda_tpu.node.bft import BFTClient, BFTReplica
+
+
+class _DictMeta:
+    """KVStore-shaped adapter over a plain dict (survives replica
+    restarts the way the node's durable KVStore does)."""
+
+    def __init__(self, d):
+        self._d = d
+
+    def get(self, k):
+        return self._d.get(k)
+
+    def put(self, k, v):
+        self._d[k] = v
 
 
 class BFTCluster:
@@ -16,36 +31,48 @@ class BFTCluster:
         self.n = n
         self.applied = {i: [] for i in range(n)}
         self.uniqueness = {i: {} for i in range(n)}
+        self.meta = {i: {} for i in range(n)}  # durable replica meta
         self.replicas = []
         self.client = BFTClient("client-0", n, self._client_send)
-
-        def make_apply(idx):
-            def apply(command):
-                self.applied[idx].append(command)
-                conflicts = {}
-                umap = self.uniqueness[idx]
-                for key, txid in command["entries"].items():
-                    if key in umap and umap[key] != txid:
-                        conflicts[key] = umap[key]
-                if not conflicts:
-                    umap.update(command["entries"])
-                return {"conflicts": conflicts}
-            return apply
-
-        def make_transport(src):
-            def transport(dst, payload):
-                self.queue.append(("replica", src, dst, payload))
-            return transport
-
-        def make_reply(idx):
-            def reply(client_id, request_id, result):
-                self.queue.append(("reply", idx, request_id, result))
-            return reply
-
         for i in range(n):
-            self.replicas.append(
-                BFTReplica(i, n, make_transport(i), make_apply(i), make_reply(i))
-            )
+            self.replicas.append(self._make_replica(i))
+
+    def _make_replica(self, idx):
+        def apply(command):
+            self.applied[idx].append(command)
+            conflicts = {}
+            umap = self.uniqueness[idx]
+            for key, txid in command["entries"].items():
+                if key in umap and umap[key] != txid:
+                    conflicts[key] = umap[key]
+            if not conflicts:
+                umap.update(command["entries"])
+            return {"conflicts": conflicts}
+
+        def transport(dst, payload):
+            self.queue.append(("replica", idx, dst, payload))
+
+        def reply(client_id, request_id, result):
+            self.queue.append(("reply", idx, request_id, result))
+
+        def snapshot():
+            return serialize(dict(self.uniqueness[idx]))
+
+        def restore(data):
+            self.uniqueness[idx].clear()
+            self.uniqueness[idx].update(deserialize(data))
+
+        return BFTReplica(
+            idx, self.n, transport, apply, reply,
+            snapshot_fn=snapshot, restore_fn=restore,
+            meta_store=_DictMeta(self.meta[idx]),
+        )
+
+    def restart(self, idx):
+        """Simulate a process restart: a FRESH replica instance sharing
+        only the durable stores (uniqueness map + meta)."""
+        self.partitioned.discard(idx)
+        self.replicas[idx] = self._make_replica(idx)
 
     def _client_send(self, replica_id, request):
         self.queue.append(("request", None, replica_id, request))
@@ -186,3 +213,53 @@ class TestBFT:
         assert fut.result(timeout=0) == {"conflicts": {}}
         live_views = {r.view for i, r in enumerate(c.replicas) if i != 0}
         assert live_views == {1}
+
+
+class TestStateTransfer:
+    """Reference DefaultRecoverable snapshot get/install parity: a
+    restarted replica resumes from its durable meta AND catches up on
+    entries committed while it was down via f+1-verified state transfer
+    — so one restart does not permanently degrade the cluster to f=0."""
+
+    def test_restart_resumes_from_durable_meta(self):
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t1"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        c.restart(3)
+        # the fresh instance resumed at its own executed prefix, not -1
+        assert c.replicas[3].last_executed == 0
+        # and participates in the next round without any catch-up
+        f = c.client.submit({"entries": {"b": "t2"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        assert c.uniqueness[3] == c.uniqueness[0]
+
+    def test_restarted_replica_catches_up_missed_entries(self):
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t1"}})
+        c.pump()
+        f.result(timeout=0)
+        c.partitioned.add(3)
+        for k in range(3):  # replica 3 misses seqs 1..3
+            f = c.client.submit({"entries": {f"k{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+        c.restart(3)
+        assert c.replicas[3].last_executed == 0  # behind the cluster
+        # a new round reaches it: it commits seq 4 but cannot execute
+        # (seqs 1..3 missing) -> the gap timer fires a state_req
+        f = c.client.submit({"entries": {"z": "tz"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        c.tick_all(100.0)   # arms the gap timer
+        c.tick_all(103.0)   # past STATE_GAP_TIMEOUT: state_req + responses
+        assert c.replicas[3].last_executed == 4
+        assert c.uniqueness[3] == c.uniqueness[0]
+        # fully recovered: it is a counted member again (f=1 restored) —
+        # progress continues with a DIFFERENT member down
+        c.partitioned.add(2)
+        f = c.client.submit({"entries": {"w": "tw"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        assert c.uniqueness[3].get("w") == "tw"

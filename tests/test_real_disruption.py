@@ -297,7 +297,7 @@ class TestBFTNotaryClusterProcesses:
         return factory, resolved, nodes, cluster, me, peer, driver
 
     def test_cluster_notarises_and_survives_member_kill(self):
-        (_factory, _resolved, nodes, _cluster, _me, _peer,
+        (factory, resolved, nodes, _cluster, _me, _peer,
          driver) = self._boot_cluster("bft-real-", "O=BFTNotary,L=Zurich,C=CH")
         try:
 
@@ -310,6 +310,24 @@ class TestBFTNotaryClusterProcesses:
             while len(driver.completed) < before + 3:
                 assert time.monotonic() < deadline, (
                     f"no progress after member kill: {driver.errors[-3:]}"
+                )
+                time.sleep(0.3)
+
+            # HEAL: relaunch member 1 — it resumes from its durable meta
+            # and catches up on the entries committed while it was down
+            # via f+1-verified state transfer. Then kill a DIFFERENT
+            # member: the 2f+1 quorum now REQUIRES the restored member,
+            # so continued progress proves f=1 tolerance was restored
+            # (reference DefaultRecoverable state-transfer semantics).
+            nodes[1] = factory.launch(resolved[1]["dir"])
+            time.sleep(4)  # gap timer + state transfer
+            nodes[2].kill()
+            before = len(driver.completed)
+            deadline = time.monotonic() + 180
+            while len(driver.completed) < before + 2:
+                assert time.monotonic() < deadline, (
+                    f"no progress with the restored member required: "
+                    f"{driver.errors[-3:]}"
                 )
                 time.sleep(0.3)
             driver.stop()
