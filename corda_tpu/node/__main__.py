@@ -193,9 +193,19 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+    # Test/tooling hygiene: when the launcher (pytest, bench, driver DSL)
+    # dies without cleanup — SIGKILL, timeout — its nodes must not linger
+    # and contend with everything that runs after (a leaked notary from a
+    # killed bench run once skewed a whole test session). Opt-in: real
+    # deployments keep running when their starter exits.
+    exit_on_orphan = os.environ.get("CORDA_TPU_EXIT_ON_ORPHAN") == "1"
+    parent = os.getppid()
     try:
         while not stop.wait(0.5):
-            pass
+            if exit_on_orphan and os.getppid() != parent:
+                print("launcher died; shutting down (exit-on-orphan)",
+                      flush=True)
+                break
     finally:
         if netmap_client is not None:
             netmap_client.stop()

@@ -233,6 +233,20 @@ class BFTReplica:
                 self._record_prepare(
                     msg["seq"], msg["digest"], sender, msg["psig"]
                 )
+            elif (
+                msg["view"] > self.view
+                and self._verify_prepare_sig(
+                    sender, msg["view"], msg["seq"], msg["digest"],
+                    msg.get("psig"),
+                )
+            ):
+                # signature-verified traffic from a HIGHER view: the
+                # cluster moved on while we were down — evidence for the
+                # state-transfer trigger (we cannot join view V+1's
+                # quorums, so the normal gap detector never fires)
+                self._ahead_view_evidence = max(
+                    getattr(self, "_ahead_view_evidence", -1), msg["view"]
+                )
         elif kind == "commit":
             if msg["view"] == self.view and self._seq_in_window(msg["seq"]):
                 self._record_commit(msg["seq"], msg["digest"], sender)
@@ -310,8 +324,19 @@ class BFTReplica:
             self.last_executed = seq
             if seq not in self.executed:
                 self.executed.add(seq)
-                result = self.apply_fn(request["command"])
-                self._save_meta()
+                # apply + meta save commit as ONE sqlite cycle (the meta
+                # store exposes its db's transaction context); a crash
+                # between them is also safe — re-apply is idempotent
+                txn = getattr(
+                    getattr(self._meta, "db", None), "transaction", None
+                )
+                if txn is not None:
+                    with txn():
+                        result = self.apply_fn(request["command"])
+                        self._save_meta()
+                else:
+                    result = self.apply_fn(request["command"])
+                    self._save_meta()
                 self.reply_fn(
                     request["client_id"], request["request_id"], result
                 )
@@ -340,7 +365,14 @@ class BFTReplica:
         missing_body = (
             nxt in self.committed and self.committed[nxt] not in self.requests
         )
-        lagging = missing_seq or missing_body
+        # signature-verified prepare traffic from a view AHEAD of ours:
+        # a restart that slept through a view change can otherwise never
+        # accumulate gap evidence (every current-view message is dropped
+        # by the view guards)
+        behind_view = (
+            getattr(self, "_ahead_view_evidence", -1) > self.view
+        )
+        lagging = missing_seq or missing_body or behind_view
         if not lagging:
             self._gap_since = None
             return
@@ -358,6 +390,14 @@ class BFTReplica:
             return
         if int(msg.get("have", -1)) >= self.last_executed:
             return  # requester is not behind us
+        # a faulty peer looping state_req must not make us serialize the
+        # whole uniqueness map per message (O(ledger) amplification) —
+        # at most one snapshot per sender per gap-timeout
+        last = getattr(self, "_state_served", {}).get(sender, -1e18)
+        if self._now - last < self.STATE_GAP_TIMEOUT:
+            return
+        self._state_served = getattr(self, "_state_served", {})
+        self._state_served[sender] = self._now
         dump = self.snapshot_fn()
         self.transport(sender, serialize({
             "kind": "state_resp",
@@ -402,6 +442,7 @@ class BFTReplica:
                 self._save_meta()
                 self._state_resps.clear()
                 self._gap_since = None
+                self._ahead_view_evidence = -1
                 logger.info(
                     "%s installed state snapshot up to seq %d (view %d)",
                     self.id, rn, self.view,

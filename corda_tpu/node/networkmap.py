@@ -235,11 +235,14 @@ class NetworkMapService:
             if reply_to:
                 self._reply(reply_to, {
                     "kind": "query-reply", "entry": signed,
-                    # server-side liveness: updated on EVERY accepted
-                    # registration attempt, including "unchanged" ones
-                    # (the signed entry's serial freezes on the unchanged
-                    # fast path, so it cannot serve as the signal)
-                    "last_seen": last_seen,
+                    # server-side liveness as an AGE (seconds since the
+                    # registrant's last accepted attempt, incl.
+                    # "unchanged" ones): an age survives cross-machine
+                    # clock skew where an absolute timestamp would not
+                    "last_seen_age": (
+                        time.time() - last_seen
+                        if last_seen is not None else None
+                    ),
                     "req_id": request.get("req_id"),
                 })
 
@@ -371,7 +374,7 @@ class NetworkMapClient:
         never silently expires out of the directory."""
         if ttl is not None:
             self._ttl = ttl
-        self._register(timeout)
+        self._register(timeout, extras_force=True)
         self._refresh_thread = threading.Thread(
             target=self._refresh_loop, name=f"netmap-refresh-{self._me.name}",
             daemon=True,
@@ -401,7 +404,7 @@ class NetworkMapClient:
         self._req_counter += 1
         return f"{self._me.name}:{self._req_counter}"
 
-    def _register(self, timeout: float) -> None:
+    def _register(self, timeout: float, extras_force: bool = False) -> None:
         with self._reg_lock:
             self._serial += 1
             reg = NodeRegistration(
@@ -419,16 +422,24 @@ class NetworkMapClient:
                 raise RuntimeError(
                     f"network map rejected registration: {ack.get('error')}"
                 )
-        self._register_extras(timeout)
+        # The BOOT registration always stamps the shared entry (the
+        # holder-liveness gate applies only to periodic refreshes). This
+        # keeps the LAST-booted member as the initial route holder, which
+        # matters when an earlier member co-hosts the network map: if the
+        # gate left the route on the map host, one kill would take down
+        # both the route AND the only service able to move it (observed
+        # as a full-cluster notarisation stall). The TTL/2 refresh passes
+        # extras_force=False so it cannot steal a live holder's route.
+        self._register_extras(timeout, force=extras_force)
 
     def _query_entry(self, name: str, timeout: float):
-        """(signed_entry | None, last_seen | None) for a map name."""
+        """(signed_entry | None, last_seen_age | None) for a map name."""
         with self._reg_lock:
             req_id = self._next_req_id()
             self._request({"kind": "query", "name": name,
                            "reply_to": self._reply_queue, "req_id": req_id})
             reply = self._await_reply("query-reply", timeout, req_id=req_id)
-        return reply.get("entry"), reply.get("last_seen")
+        return reply.get("entry"), reply.get("last_seen_age")
 
     def _register_extras(self, timeout: float, force: bool = False) -> None:
         for party, services, signer in self._extra_identities:
@@ -441,15 +452,14 @@ class NetworkMapClient:
                 # over only when the holder's attempts stop (dead) or the
                 # entry is ours to extend.
                 try:
-                    entry, last_seen = self._query_entry(party.name, timeout)
+                    entry, age = self._query_entry(party.name, timeout)
                 except Exception:
-                    entry, last_seen = None, None
+                    entry, age = None, None
                 if (
                     entry is not None
                     and entry.registration.broker_address != self._my_address
-                    and last_seen is not None
-                    and time.time() - last_seen
-                    < 2 * self._extra_refresh_interval
+                    and age is not None
+                    and age < 2 * self._extra_refresh_interval
                 ):
                     continue
             # SHARED key (e.g. a cluster identity all members register):

@@ -272,9 +272,13 @@ class BFTUniquenessProvider(UniquenessProvider):
         meta = KVStore(db, "bft_replica_meta")
 
         def snapshot() -> bytes:
-            return serialize(
-                [[bytes(k), bytes(v)] for k, v in umap.items()]
-            )
+            # SORTED: the f+1 state-transfer agreement compares digests
+            # of this dump across replicas; sqlite row order without an
+            # ORDER BY is unspecified, so byte-determinism must be
+            # imposed here or honest replicas could never agree
+            return serialize(sorted(
+                [bytes(k), bytes(v)] for k, v in umap.items()
+            ))
 
         def restore(data: bytes) -> None:
             # atomic: a crash mid-restore must never leave the uniqueness

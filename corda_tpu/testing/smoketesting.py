@@ -138,6 +138,9 @@ class Factory:
             os.path.abspath(__file__)
         )))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # launcher death (SIGKILL, test timeout) must not leak node
+        # processes that contend with the rest of the session
+        env["CORDA_TPU_EXIT_ON_ORPHAN"] = "1"
         args = [sys.executable, "-m", "corda_tpu.node", node_dir]
         if self.jax_platform:
             args += ["--jax-platform", self.jax_platform]

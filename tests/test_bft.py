@@ -263,3 +263,37 @@ class TestStateTransfer:
         c.pump()
         assert f.result(timeout=0) == {"conflicts": {}}
         assert c.uniqueness[3].get("w") == "tw"
+
+    def test_restart_across_missed_view_change_recovers(self):
+        """n=7 (f=2): a replica sleeps through BOTH a view change and
+        several commits. After restart it is wedged by the view guards
+        (every current-view message is dropped, so the seq-gap detector
+        alone would never fire) — signature-verified prepare traffic from
+        the HIGHER view is the evidence that triggers state transfer,
+        whose f+1 agreement carries the view."""
+        c = BFTCluster(7)
+        f = c.client.submit({"entries": {"a": "t1"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        c.partitioned.add(6)   # replica 6 sleeps
+        c.partitioned.add(0)   # the view-0 primary dies
+        f2 = c.client.submit({"entries": {"b": "t2"}})
+        c.pump()
+        for t in (0.0, 31.0, 32.0, 33.0):
+            c.tick_all(t)
+        assert f2.result(timeout=1) == {"conflicts": {}}
+        view_now = c.replicas[1].view
+        assert view_now >= 1
+        f3 = c.client.submit({"entries": {"c": "t3"}})
+        c.pump()
+        assert f3.result(timeout=0) == {"conflicts": {}}
+        c.restart(6)
+        assert c.replicas[6].view == 0  # behind the cluster's view
+        f4 = c.client.submit({"entries": {"d": "t4"}})
+        c.pump()
+        assert f4.result(timeout=0) == {"conflicts": {}}
+        c.tick_all(100.0)
+        c.tick_all(103.0)
+        c.tick_all(106.0)
+        assert c.replicas[6].view == view_now
+        assert c.uniqueness[6] == c.uniqueness[1]

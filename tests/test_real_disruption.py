@@ -277,7 +277,7 @@ class TestBFTNotaryClusterProcesses:
             finally:
                 conn_b.close()
             driver = _Driver(nodes[4], cluster, me, peer).start()
-            deadline = time.monotonic() + 180
+            deadline = time.monotonic() + 300
             while len(driver.completed) < warm_to:
                 assert time.monotonic() < deadline, (
                     f"cluster never notarised: {driver.errors[-3:]}"
@@ -306,7 +306,7 @@ class TestBFTNotaryClusterProcesses:
             # the remaining 3 >= 2f+1 keep committing without view change
             nodes[1].kill()
             before = len(driver.completed)
-            deadline = time.monotonic() + 180
+            deadline = time.monotonic() + 300
             while len(driver.completed) < before + 3:
                 assert time.monotonic() < deadline, (
                     f"no progress after member kill: {driver.errors[-3:]}"
@@ -323,7 +323,7 @@ class TestBFTNotaryClusterProcesses:
             time.sleep(4)  # gap timer + state transfer
             nodes[2].kill()
             before = len(driver.completed)
-            deadline = time.monotonic() + 180
+            deadline = time.monotonic() + 300
             while len(driver.completed) < before + 2:
                 assert time.monotonic() < deadline, (
                     f"no progress with the restored member required: "
@@ -351,7 +351,7 @@ class TestBFTNotaryClusterProcesses:
         try:
             nodes[0].kill()  # the view-0 primary orders all commits
             before = len(driver.completed)
-            deadline = time.monotonic() + 180
+            deadline = time.monotonic() + 300
             while len(driver.completed) < before + 2:
                 assert time.monotonic() < deadline, (
                     f"no progress after PRIMARY kill (view change "
@@ -414,7 +414,7 @@ class TestRaftNotaryClusterProcesses:
                 conn_b.close()
 
             driver = _Driver(nodes[3], cluster, me, peer).start()
-            deadline = time.monotonic() + 120
+            deadline = time.monotonic() + 240
             while len(driver.completed) < 2:
                 assert time.monotonic() < deadline, driver.errors[-3:]
                 time.sleep(0.3)
@@ -424,7 +424,7 @@ class TestRaftNotaryClusterProcesses:
             # single member kill must heal within ~one interval either way)
             nodes[2].kill()
             before = len(driver.completed)
-            deadline = time.monotonic() + 150
+            deadline = time.monotonic() + 300
             while len(driver.completed) < before + 2:
                 assert time.monotonic() < deadline, (
                     f"route never failed over: {driver.errors[-3:]}"
@@ -477,7 +477,7 @@ class TestRaftNotaryClusterProcesses:
                 conn_b.close()
 
             driver = _Driver(nodes[3], cluster, me, peer).start()
-            deadline = time.monotonic() + 120
+            deadline = time.monotonic() + 240
             while len(driver.completed) < 3:
                 assert time.monotonic() < deadline, (
                     f"cluster never notarised: {driver.errors[-3:]}"
@@ -489,7 +489,7 @@ class TestRaftNotaryClusterProcesses:
             # member forwards commits to the re-elected leader
             nodes[0].kill()
             before = len(driver.completed)
-            deadline = time.monotonic() + 120
+            deadline = time.monotonic() + 240
             while len(driver.completed) < before + 3:
                 assert time.monotonic() < deadline, (
                     f"no progress after member kill: {driver.errors[-3:]}"
@@ -502,7 +502,7 @@ class TestRaftNotaryClusterProcesses:
             # log (snapshot/backfill) and rejoins
             nodes[0] = factory.launch(resolved[0]["dir"])
             driver2 = _Driver(nodes[3], cluster, me, peer).start()
-            deadline = time.monotonic() + 120
+            deadline = time.monotonic() + 240
             while len(driver2.completed) < 2:
                 assert time.monotonic() < deadline, driver2.errors[-3:]
                 time.sleep(0.3)
