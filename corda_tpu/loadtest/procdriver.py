@@ -1,0 +1,102 @@
+"""Shared load-driver + consistency helpers for REAL-process harnesses.
+
+Used by the fault-injection tests (tests/test_real_disruption.py) and
+the packaged chaos soak (corda_tpu.loadtest.chaos) — the reference
+splits the same roles between `tools/loadtest/.../LoadTest.kt`
+(generate/execute) and `gatherRemoteState` consistency checks.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core.contracts import Amount
+from ..core.contracts.amount import Issued
+
+
+class PairDriver:
+    """Issues issue+pay pairs from bank A to bank B on a thread until
+    stopped; tracks completed payment tx ids and errors."""
+
+    def __init__(self, bank_a, notary_party, me, peer):
+        self.bank_a = bank_a
+        self.notary = notary_party
+        self.me = me
+        self.peer = peer
+        self.completed = []          # payment stx ids
+        self.errors = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        conn = self.bank_a.connect()
+        token = Issued(self.me.ref(1), "USD")
+        try:
+            while not self._stop.is_set():
+                try:
+                    fid = conn.proxy.start_flow_dynamic(
+                        "CashIssueFlow", Amount(100, "USD"), b"\x01",
+                        self.me, self.notary,
+                    )
+                    conn.proxy.flow_result(fid, 90)
+                    fid = conn.proxy.start_flow_dynamic(
+                        "CashPaymentFlow", Amount(100, token), self.peer,
+                        self.notary,
+                    )
+                    stx = conn.proxy.flow_result(fid, 90)
+                    self.completed.append(stx.id)
+                except Exception as exc:
+                    self.errors.append(f"{type(exc).__name__}: {exc}")
+        finally:
+            conn.close()
+
+    def stop(self, timeout=180):
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        assert not self._thread.is_alive(), "driver wedged"
+
+
+def payment_txids(bank_b, deadline_s=60, want=None):
+    """Tx ids of cash states in B's vault, polled until `want` is a
+    subset of them or the deadline passes."""
+    conn = bank_b.connect()
+    try:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            txids = {s.ref.txhash for s in conn.proxy.vault_query()}
+            if want is None or want <= txids or time.monotonic() > deadline:
+                return txids
+            time.sleep(0.5)
+    finally:
+        conn.close()
+
+
+def assert_no_loss_no_dup(driver: PairDriver, bank_b) -> None:
+    completed = set(driver.completed)
+    assert completed, "no pairs completed — disruption swallowed the run"
+    txids = payment_txids(bank_b, want=completed)
+    missing = completed - txids
+    assert not missing, f"LOST at counterparty after heal: {missing}"
+    # vault PK is (tx_id, index) and every payment pays one 100-USD state,
+    # so duplication would surface as more cash states than payment txs
+    assert len(txids) >= len(completed)
+
+
+def resolve_identities(bank_a, bank_b):
+    """(me, notary, peer) discovered over the banks' RPC."""
+    conn = bank_a.connect()
+    try:
+        me = conn.proxy.node_info()
+        notary = conn.proxy.notary_identities()[0]
+    finally:
+        conn.close()
+    conn = bank_b.connect()
+    try:
+        peer = conn.proxy.node_info()
+    finally:
+        conn.close()
+    return me, notary, peer

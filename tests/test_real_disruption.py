@@ -12,13 +12,9 @@ Invariants checked after every heal:
   * liveness — fresh pairs complete end-to-end after the heal.
 """
 import tempfile
-import threading
 import time
 
 import pytest
-
-from corda_tpu.core.contracts import Amount
-from corda_tpu.core.contracts.amount import Issued
 
 
 def _boot(base):
@@ -39,65 +35,11 @@ def _boot(base):
     return factory, resolved, nodes
 
 
-class _Driver:
-    """Issues issue+pay pairs from bank A to bank B on a thread until
-    stopped; tracks completed payment tx ids and errors."""
-
-    def __init__(self, bank_a, notary_party, me, peer):
-        self.bank_a = bank_a
-        self.notary = notary_party
-        self.me = me
-        self.peer = peer
-        self.completed = []          # payment stx ids
-        self.errors = []
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-
-    def start(self):
-        self._thread.start()
-        return self
-
-    def _run(self):
-        conn = self.bank_a.connect()
-        token = Issued(self.me.ref(1), "USD")
-        try:
-            while not self._stop.is_set():
-                try:
-                    fid = conn.proxy.start_flow_dynamic(
-                        "CashIssueFlow", Amount(100, "USD"), b"\x01",
-                        self.me, self.notary,
-                    )
-                    conn.proxy.flow_result(fid, 90)
-                    fid = conn.proxy.start_flow_dynamic(
-                        "CashPaymentFlow", Amount(100, token), self.peer,
-                        self.notary,
-                    )
-                    stx = conn.proxy.flow_result(fid, 90)
-                    self.completed.append(stx.id)
-                except Exception as exc:
-                    self.errors.append(f"{type(exc).__name__}: {exc}")
-        finally:
-            conn.close()
-
-    def stop(self, timeout=180):
-        self._stop.set()
-        self._thread.join(timeout=timeout)
-        assert not self._thread.is_alive(), "driver wedged"
-
-
-def _b_payment_txids(bank_b, deadline_s=60, want=None):
-    """Tx ids of cash states in B's vault, polled until `want` ⊆ them or
-    the deadline passes."""
-    conn = bank_b.connect()
-    try:
-        deadline = time.monotonic() + deadline_s
-        while True:
-            txids = {s.ref.txhash for s in conn.proxy.vault_query()}
-            if want is None or want <= txids or time.monotonic() > deadline:
-                return txids
-            time.sleep(0.5)
-    finally:
-        conn.close()
+from corda_tpu.loadtest.procdriver import (  # noqa: E402
+    PairDriver as _Driver,
+    assert_no_loss_no_dup as _assert_no_loss_no_dup,
+    payment_txids as _b_payment_txids,
+)
 
 
 def _setup_identities(nodes):
@@ -511,3 +453,17 @@ class TestRaftNotaryClusterProcesses:
         finally:
             for n in nodes:
                 n.close()
+
+
+@pytest.mark.slow
+def test_chaos_harness_short_soak():
+    """The packaged chaos harness (loadtest.chaos) runs end-to-end at a
+    short duration: pairs complete, at least one disruption fires, and
+    the no-loss/no-dup invariant holds. (The reference run — 21k pairs /
+    600 s / 25 disruptions / 0 errors — is documented in its docstring;
+    CI keeps this at ~40 s.)"""
+    from corda_tpu.loadtest.chaos import run
+
+    out = run(duration=30.0, seed=11)
+    assert out["consistent"] and out["pairs"] > 0
+    assert out["disruptions"] >= 1
