@@ -53,6 +53,9 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
         # turns a short soak into a spurious "no pairs completed" failure
         deadline = time.monotonic() + 240
         while len(driver.completed) < 2:
+            assert driver._thread.is_alive(), (
+                f"driver died during warm-up: {driver.errors[-3:]}"
+            )
             assert time.monotonic() < deadline, (
                 f"warm-up stalled: {driver.errors[-3:]}"
             )
@@ -97,7 +100,13 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
                 else:
                     nodes[4].kill()
                     time.sleep(rng.uniform(0.5, 2))
-                    nodes[4] = factory.launch(resolved[4]["dir"])
+                    try:
+                        nodes[4] = factory.launch(resolved[4]["dir"])
+                    except Exception:
+                        # one retry, then FAIL the soak loudly: a dead
+                        # counterparty makes every later pair error and
+                        # the final consistency check meaningless
+                        nodes[4] = factory.launch(resolved[4]["dir"])
                 events.append(
                     (round(time.monotonic() - t0, 1), kind, idx)
                 )

@@ -33,7 +33,13 @@ class PairDriver:
         return self
 
     def _run(self):
-        conn = self.bank_a.connect()
+        try:
+            conn = self.bank_a.connect()
+        except Exception as exc:
+            # a dead thread with an empty error list turns a connect
+            # failure into an opaque warm-up stall — record it
+            self.errors.append(f"connect: {type(exc).__name__}: {exc}")
+            return
         token = Issued(self.me.ref(1), "USD")
         try:
             while not self._stop.is_set():
@@ -61,15 +67,33 @@ class PairDriver:
 
 
 def payment_txids(bank_b, deadline_s=60, want=None):
-    """Tx ids of cash states in B's vault, polled until `want` is a
-    subset of them or the deadline passes."""
+    """(tx ids, total state count) of cash states in B's vault, polled
+    until `want` is a subset of the ids or the deadline passes.
+
+    PAGED: a long soak accumulates tens of thousands of states, and an
+    unpaged vault_query would serialize them all into one RPC reply —
+    the 30-minute chaos run blew the RPC timeout at ~44k states. Pages
+    of 5,000 keep each reply bounded."""
+    from ..node.vault_query import PageSpecification
+
     conn = bank_b.connect()
     try:
         deadline = time.monotonic() + deadline_s
         while True:
-            txids = {s.ref.txhash for s in conn.proxy.vault_query()}
+            txids = set()
+            n_states = 0
+            page_number = 1
+            while True:
+                page = conn.proxy.vault_query_by(
+                    paging=PageSpecification(page_number, 5000)
+                )
+                txids.update(s.ref.txhash for s in page.states)
+                n_states += len(page.states)
+                if len(page.states) < 5000:
+                    break
+                page_number += 1
             if want is None or want <= txids or time.monotonic() > deadline:
-                return txids
+                return txids, n_states
             time.sleep(0.5)
     finally:
         conn.close()
@@ -78,12 +102,16 @@ def payment_txids(bank_b, deadline_s=60, want=None):
 def assert_no_loss_no_dup(driver: PairDriver, bank_b) -> None:
     completed = set(driver.completed)
     assert completed, "no pairs completed — disruption swallowed the run"
-    txids = payment_txids(bank_b, want=completed)
+    txids, n_states = payment_txids(bank_b, want=completed)
     missing = completed - txids
     assert not missing, f"LOST at counterparty after heal: {missing}"
-    # vault PK is (tx_id, index) and every payment pays one 100-USD state,
-    # so duplication would surface as more cash states than payment txs
-    assert len(txids) >= len(completed)
+    # no dup: every payment tx pays EXACTLY ONE state to B, so extra
+    # states under any tx id mean a replay/double-record. (A set-size
+    # comparison would be vacuous — the set dedups before counting.)
+    assert n_states == len(txids), (
+        f"DUPLICATED states at counterparty: {n_states} states across "
+        f"{len(txids)} payment txs"
+    )
 
 
 def resolve_identities(bank_a, bank_b):

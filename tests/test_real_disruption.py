@@ -39,33 +39,12 @@ from corda_tpu.loadtest.procdriver import (  # noqa: E402
     PairDriver as _Driver,
     assert_no_loss_no_dup as _assert_no_loss_no_dup,
     payment_txids as _b_payment_txids,
+    resolve_identities,
 )
 
 
 def _setup_identities(nodes):
-    conn = nodes[1].connect()
-    try:
-        me = conn.proxy.node_info()
-        notary = conn.proxy.notary_identities()[0]
-    finally:
-        conn.close()
-    conn = nodes[2].connect()
-    try:
-        peer = conn.proxy.node_info()
-    finally:
-        conn.close()
-    return me, notary, peer
-
-
-def _assert_no_loss_no_dup(driver, bank_b):
-    completed = set(driver.completed)
-    assert completed, "no pairs completed — disruption swallowed the run"
-    txids = _b_payment_txids(bank_b, want=completed)
-    missing = completed - txids
-    assert not missing, f"LOST at counterparty after heal: {missing}"
-    # vault PK is (tx_id, index) and every payment pays one 100-USD state,
-    # so duplication would surface as more cash states than payment txs
-    assert len(txids) >= len(completed)
+    return resolve_identities(nodes[1], nodes[2])
 
 
 @pytest.mark.slow
