@@ -2,14 +2,19 @@
 
 The batch-first replacement for the reference's one-at-a-time loop
 (`core/.../transactions/TransactionWithSignatures.kt:58-62` ->
-`Crypto.kt:535-541`). Signatures are bucketed by scheme: ed25519 goes to the
-JAX/TPU kernel (corda_tpu.ops.ed25519_batch); schemes without a device kernel
-yet fall back to the host path (`crypto.is_valid`). Results come back as a
-positionally-aligned bool list, so callers keep exact per-signature
-accept/reject semantics.
+`Crypto.kt:535-541`). Signatures are bucketed by scheme: ed25519 and ECDSA
+go to the JAX/TPU kernels (corda_tpu.ops) — but only when the resolved JAX
+backend is an accelerator. Dispatch is backend-aware: on a CPU-only
+deployment every bucket routes to the host OpenSSL path in a thread pool,
+which beats both the portable XLA kernel (~200x) and the reference's
+sequential BouncyCastle loop. Schemes without a device kernel always stay
+host-side. Results come back as a positionally-aligned bool list, so
+callers keep exact per-signature accept/reject semantics.
 """
 from __future__ import annotations
 
+import os
+import threading
 from typing import List, Sequence, Tuple
 
 from . import crypto
@@ -26,6 +31,92 @@ USE_DEVICE_KERNELS = True
 # Below this many signatures of one scheme the host path (OpenSSL via
 # cryptography) beats device dispatch+compile amortization.
 MIN_DEVICE_BATCH = 32
+
+# Dispatch policy (VERDICT r3 #2 — backend-aware dispatch). The XLA
+# fallback kernel on a *CPU* backend does ~90 ed25519 sigs/s while the
+# host OpenSSL path in this same package does ~20k/s/core: the device
+# kernels only ever win on a real accelerator. "auto" resolves the JAX
+# backend once (lazily, on the first large bucket) and routes buckets to
+# the host thread pool unless that backend is an accelerator; "device" /
+# "host" force one side (tests, differential runs, calibration).
+#   auto   -> accelerator backends use device kernels, CPU uses the host
+#             pool; an explicitly configured mesh counts as opt-in device
+#   device -> always use device kernels above MIN_DEVICE_BATCH
+#   host   -> never use device kernels
+DISPATCH = os.environ.get("CORDA_TPU_DISPATCH", "auto")
+_ACCEL_BACKENDS = frozenset({"tpu", "gpu", "cuda", "rocm"})
+_resolved_backend: str | None = None
+
+#: threads for the host OpenSSL path; OpenSSL verification via the
+#: `cryptography` bindings is CPU-bound C code, so a small pool scales on
+#: multi-core hosts and degrades to a plain loop on 1-core boxes
+_HOST_POOL = None
+_HOST_POOL_LOCK = threading.Lock()
+_HOST_POOL_MIN = 256  # below this a pool's overhead beats its speedup
+
+
+def _backend() -> str:
+    """The resolved JAX backend, cached for the process lifetime.
+
+    Resolution can be expensive (accelerator tunnel init) and its answer
+    cannot change within a process — JAX latches the backend on first
+    use — so one probe is both cheap and sound. If JAX is unavailable
+    the host path is the only path.
+    """
+    global _resolved_backend
+    if _resolved_backend is None:
+        try:
+            import jax
+
+            _resolved_backend = jax.default_backend()
+        except Exception:
+            _resolved_backend = "none"
+    return _resolved_backend
+
+
+def _use_device_kernels() -> bool:
+    if not USE_DEVICE_KERNELS:
+        return False
+    if DISPATCH == "device":
+        return True
+    if DISPATCH == "host":
+        return False
+    # auto: an explicitly configured (and not failed) mesh is a
+    # deliberate routing decision — honour it even on the CPU backend
+    # (that is exactly what the multichip dryrun exercises)
+    if _MESH is not None and not _mesh_failed_once:
+        return True
+    return _backend() in _ACCEL_BACKENDS
+
+
+def _host_verify_rows(items, idx, results) -> None:
+    """Verify `idx` rows of `items` on the host path, in parallel when the
+    bucket and the machine are big enough to amortise thread handoff."""
+    global _HOST_POOL
+    if len(idx) < _HOST_POOL_MIN or (os.cpu_count() or 1) < 2:
+        for i in idx:
+            key, sig, content = items[i]
+            results[i] = crypto.is_valid(key, sig, content)
+        return
+    with _HOST_POOL_LOCK:
+        # verify_batch runs concurrently (batcher linger timer + callers):
+        # unsynchronized lazy init would leak a second pool's threads
+        if _HOST_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _HOST_POOL = ThreadPoolExecutor(
+                max_workers=min(8, os.cpu_count() or 1),
+                thread_name_prefix="corda-tpu-hostverify",
+            )
+    n_workers = _HOST_POOL._max_workers
+    chunks = [idx[k::n_workers] for k in range(n_workers)]
+
+    def run(chunk):
+        for i in chunk:
+            key, sig, content = items[i]
+            results[i] = crypto.is_valid(key, sig, content)
+
+    list(_HOST_POOL.map(run, [c for c in chunks if c]))
 
 # Device-mesh routing (SURVEY §2.10 axis 2: shard the batch across chips).
 # When a mesh is configured and a scheme bucket (ed25519 or either ECDSA
@@ -124,22 +215,22 @@ def _verify_flat(
     global _mesh_failed_once
     n = len(items)
     results: List[bool] = [False] * n
+    use_device = _use_device_kernels()
     buckets: dict = {}  # kernel key -> [indices]
+    host_rows: List[int] = []
     for i, (key, sig, content) in enumerate(items):
         name = key.scheme_code_name
-        if USE_DEVICE_KERNELS and not _is_composite(key) and (
+        if use_device and not _is_composite(key) and (
             name == EDDSA_ED25519_SHA512.scheme_code_name
             or name in _ECDSA_CURVES
         ):
             buckets.setdefault(name, []).append(i)
         else:
-            results[i] = crypto.is_valid(key, sig, content)
+            host_rows.append(i)
 
     for name, idx in buckets.items():
         if len(idx) < MIN_DEVICE_BATCH:
-            for i in idx:
-                key, sig, content = items[i]
-                results[i] = crypto.is_valid(key, sig, content)
+            host_rows.extend(idx)
             continue
         from ... import ops
 
@@ -184,6 +275,8 @@ def _verify_flat(
             )
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
+
+    _host_verify_rows(items, host_rows, results)
     return results
 
 

@@ -36,6 +36,7 @@ def test_mixed_scheme_host_path():
 
 
 def test_ed25519_bucket_hits_device_kernel(monkeypatch):
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "device")
     monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
     calls = {}
     from corda_tpu import ops
@@ -78,6 +79,7 @@ def test_composite_leaves_ride_device_bitmask(monkeypatch):
     """BASELINE.md multi-sig config: composite constituents are flattened
     into the scheme buckets and the threshold tree evaluates over the
     device kernel's bitmask."""
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "device")
     monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
     calls = {"n": 0}
     from corda_tpu import ops
@@ -144,3 +146,73 @@ def test_small_buckets_stay_on_host(monkeypatch):
     monkeypatch.setattr(ops, "ecdsa_verify_batch", boom)
     items = _items([EDDSA_ED25519_SHA512, ECDSA_SECP256K1_SHA256])
     assert crypto_batch.verify_batch(items) == [True, True]
+
+
+def test_cpu_backend_routes_large_buckets_to_host(monkeypatch):
+    """The backend-aware dispatch policy (VERDICT r3 #2): on a CPU-only
+    backend even device-kernel-sized buckets must take the host OpenSSL
+    path — the portable XLA kernel is ~200x slower there."""
+    from corda_tpu import ops
+
+    def boom(*a, **k):
+        raise AssertionError(
+            "device kernel must not run when the backend resolves to CPU"
+        )
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", boom)
+    monkeypatch.setattr(ops, "ecdsa_verify_batch", boom)
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "auto")
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", "cpu")
+    items = _items(
+        [EDDSA_ED25519_SHA512] * 40 + [ECDSA_SECP256K1_SHA256] * 40,
+        tamper_idx={3, 77},
+    )
+    out = crypto_batch.verify_batch(items)
+    assert out == [i not in {3, 77} for i in range(80)]
+
+
+def test_accelerator_backend_uses_device_kernel(monkeypatch):
+    """Same policy, other side: an accelerator backend keeps the device
+    kernels for large buckets."""
+    from corda_tpu import ops
+
+    calls = {}
+    real = ops.ed25519_verify_batch
+
+    def spy(*a, **k):
+        calls["hit"] = True
+        return real(*a, **k)
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", spy)
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "auto")
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", "tpu")
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
+    items = _items([EDDSA_ED25519_SHA512] * 5, tamper_idx={2})
+    assert crypto_batch.verify_batch(items) == [True, True, False, True, True]
+    assert calls.get("hit")
+
+
+def test_dispatch_host_override(monkeypatch):
+    from corda_tpu import ops
+
+    def boom(*a, **k):
+        raise AssertionError("CORDA_TPU_DISPATCH=host must disable kernels")
+
+    monkeypatch.setattr(ops, "ed25519_verify_batch", boom)
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "host")
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", "tpu")
+    items = _items([EDDSA_ED25519_SHA512] * 40, tamper_idx={1})
+    assert crypto_batch.verify_batch(items) == [i != 1 for i in range(40)]
+
+
+def test_host_thread_pool_path(monkeypatch):
+    """The pooled host path returns positionally-correct verdicts (the
+    strided chunking must not scramble rows)."""
+    import os as _os
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "host")
+    monkeypatch.setattr(crypto_batch, "_HOST_POOL_MIN", 8)
+    monkeypatch.setattr(_os, "cpu_count", lambda: 4)
+    items = _items([EDDSA_ED25519_SHA512] * 24, tamper_idx={0, 7, 23})
+    out = crypto_batch.verify_batch(items)
+    assert out == [i not in {0, 7, 23} for i in range(24)]
