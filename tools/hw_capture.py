@@ -165,7 +165,7 @@ def save_state(st):
     os.replace(tmp, STATE)
 
 
-def probe(timeout=60):
+def probe(timeout=45):
     try:
         out = subprocess.run(
             [sys.executable, "-c", PROBE], capture_output=True, text=True,
@@ -250,7 +250,10 @@ def main():
         alive, why = probe()
         if not alive:
             log({"step": "probe", "alive": False, "why": why})
-            time.sleep(30)
+            # short sleep: a hung probe already costs 45s, and the tunnel's
+            # uptime windows have been O(minutes) — a 30s extra nap was
+            # enough to miss one (round-3 logged 440 hangs, 0 captures)
+            time.sleep(10)
             continue
         step = todo[0]
         log({"step": "probe", "alive": True, "next": step["name"]})
