@@ -12,9 +12,14 @@ accepts a result once f+1 replicas return identical signed verdicts
 Same determinism contract as raft.py: `tick(now)` drives timeouts,
 `on_message` handles peer traffic; tests step the cluster explicitly.
 
-Scope: normal-case consensus + view change with prepared-certificate
-carry-over; checkpoint/garbage-collection of the PBFT log is not
-implemented (the log is bounded by ledger growth, like the Raft provider).
+Scope: normal-case consensus, view change with prepared-certificate
+carry-over, and PBFT stable checkpoints (every CHECKPOINT_INTERVAL
+executions a replica broadcasts a signed digest of its applied state; a
+2f+1 certificate makes the checkpoint stable and truncates every log
+structure at or below it — the paper's §4.3 garbage collection, playing
+the role of BFT-SMaRt's DefaultRecoverable snapshot/log-truncation cycle,
+reference `BFTSMaRt.kt:150-276`). Replica memory is bounded by
+CHECKPOINT_INTERVAL + in-flight work instead of ledger growth.
 """
 from __future__ import annotations
 
@@ -49,6 +54,12 @@ def dev_signing_seed(replica_id: int) -> bytes:
 def _prepare_statement(view: int, seq: int, digest: bytes) -> bytes:
     """Canonical byte statement a prepare signature covers."""
     return b"bft-prepare\x00" + serialize({"v": view, "s": seq, "d": digest})
+
+
+def _checkpoint_statement(seq: int, digest: bytes) -> bytes:
+    """Canonical byte statement a checkpoint signature covers (view-free:
+    PBFT checkpoints certify executed state, not view membership)."""
+    return b"bft-checkpoint\x00" + serialize({"s": seq, "d": digest})
 
 
 class BFTReplica:
@@ -113,6 +124,7 @@ class BFTReplica:
                 # boundary entry is idempotent)
                 self.last_executed = int(meta["last_executed"])
                 self.view = int(meta["view"])
+                self.stable_seq = int(meta.get("stable_seq", -1))
                 # a restarted PRIMARY must not reassign sequence numbers
                 # its peers already hold pre-prepares for (the
                 # equivocation guard would stall every request for a
@@ -120,6 +132,14 @@ class BFTReplica:
                 self.next_seq = max(
                     int(meta.get("next_seq", 0)), self.last_executed + 1
                 )
+        # stable checkpoint: the highest seq with a 2f+1 checkpoint
+        # certificate; every log structure is truncated at/below it
+        if not hasattr(self, "stable_seq"):
+            self.stable_seq = -1
+        self.stable_digest = b""
+        self.stable_cert: Dict[int, bytes] = {}  # voter -> checkpoint sig
+        # (seq, state digest) -> {voter: signature}
+        self.checkpoint_votes: Dict[Tuple[int, bytes], Dict[int, bytes]] = {}
         # seq -> state
         self.requests: Dict[bytes, dict] = {}  # digest -> request
         self.pre_prepares: Dict[int, bytes] = {}  # seq -> digest
@@ -167,8 +187,8 @@ class BFTReplica:
             self._signing_seed, _prepare_statement(view, seq, d)
         )
 
-    def _verify_prepare_sig(
-        self, voter: int, view: int, seq: int, d: bytes, sig: object
+    def _verify_replica_sig(
+        self, voter: int, statement: bytes, sig: object
     ) -> bool:
         from ..core.crypto import ed25519_math
 
@@ -176,11 +196,16 @@ class BFTReplica:
         if pub is None or not isinstance(sig, (bytes, bytearray)):
             return False
         try:
-            return ed25519_math.verify(
-                pub, _prepare_statement(view, seq, d), bytes(sig)
-            )
+            return ed25519_math.verify(pub, statement, bytes(sig))
         except Exception:
             return False
+
+    def _verify_prepare_sig(
+        self, voter: int, view: int, seq: int, d: bytes, sig: object
+    ) -> bool:
+        return self._verify_replica_sig(
+            voter, _prepare_statement(view, seq, d), sig
+        )
 
     # -- client request entry ------------------------------------------------
 
@@ -254,6 +279,8 @@ class BFTReplica:
             self._on_view_change(sender, msg)
         elif kind == "new_view":
             self._on_new_view(sender, msg)
+        elif kind == "checkpoint":
+            self._on_checkpoint(sender, msg)
         elif kind == "state_req":
             self._on_state_req(sender, msg)
         elif kind == "state_resp":
@@ -264,7 +291,9 @@ class BFTReplica:
     MAX_INFLIGHT = 10_000
 
     def _seq_in_window(self, seq: int) -> bool:
-        return self.last_executed < seq <= self.last_executed + self.MAX_INFLIGHT or seq <= self.last_executed
+        # PBFT water marks: below the stable checkpoint the log is GONE —
+        # accepting votes there would regrow the structures GC just freed
+        return self.stable_seq < seq <= self.last_executed + self.MAX_INFLIGHT
 
     def _on_pre_prepare(self, sender: int, msg: dict) -> None:
         if msg["view"] != self.view or sender != self.primary:
@@ -340,6 +369,105 @@ class BFTReplica:
                 self.reply_fn(
                     request["client_id"], request["request_id"], result
                 )
+                if (
+                    self.snapshot_fn is not None
+                    and seq > 0
+                    and seq % self.CHECKPOINT_INTERVAL == 0
+                ):
+                    self._emit_checkpoint(seq)
+
+    # -- stable checkpoints + log GC (PBFT §4.3) ------------------------------
+
+    #: executions between checkpoint broadcasts; replica log memory is
+    #: O(CHECKPOINT_INTERVAL + in-flight), not O(ledger)
+    CHECKPOINT_INTERVAL = 128
+
+    def _emit_checkpoint(self, seq: int) -> None:
+        """Broadcast a signed digest of the applied state at `seq`.
+        snapshot_fn must be deterministic across replicas (all replicas
+        applied the same commands in the same order, so a canonical
+        serialization of the state map digests identically)."""
+        from ..core.crypto import ed25519_math
+
+        d = hashlib.sha256(self.snapshot_fn()).digest()
+        sig = ed25519_math.sign(
+            self._signing_seed, _checkpoint_statement(seq, d)
+        )
+        self._broadcast({
+            "kind": "checkpoint", "seq": seq, "digest": d, "csig": sig,
+        })
+        self._record_checkpoint(seq, d, self.id, sig)
+
+    def _verify_checkpoint_sig(
+        self, voter: int, seq: int, d: bytes, sig: object
+    ) -> bool:
+        return self._verify_replica_sig(
+            voter, _checkpoint_statement(seq, d), sig
+        )
+
+    def _on_checkpoint(self, sender: int, msg: dict) -> None:
+        seq, d = msg["seq"], msg["digest"]
+        if not isinstance(seq, int) or seq <= self.stable_seq:
+            return
+        if seq > self.last_executed + self.MAX_INFLIGHT:
+            return  # vote spray from a faulty peer: cap state growth
+        if not self._verify_checkpoint_sig(sender, seq, d, msg.get("csig")):
+            return
+        self._record_checkpoint(seq, d, sender, msg["csig"])
+
+    def _record_checkpoint(self, seq: int, d: bytes, voter: int, sig: bytes) -> None:
+        # one live vote per (voter, seq): a faulty replica validly signing
+        # unlimited DISTINCT digests for one seq must not grow the vote
+        # table per message (its newest vote simply replaces the old one)
+        for (s, dd), vv in list(self.checkpoint_votes.items()):
+            if s == seq and dd != d and voter in vv:
+                del vv[voter]
+                if not vv:
+                    del self.checkpoint_votes[(s, dd)]
+        votes = self.checkpoint_votes.setdefault((seq, d), {})
+        votes[voter] = sig
+        # 2f+1 signatures over the same (seq, state digest): at least f+1
+        # honest replicas hold this state — it can never be rolled back,
+        # so everything at or below it is garbage
+        if len(votes) >= 2 * self.f + 1 and seq > self.stable_seq:
+            self.stable_seq = seq
+            self.stable_digest = d
+            self.stable_cert = dict(votes)
+            self._gc_log(seq)
+            self._save_meta()
+            logger.debug(
+                "%s: stable checkpoint at seq %d, log truncated", self.id, seq
+            )
+            if self.stable_seq > self.last_executed:
+                # the cluster certified state BEYOND our execution, and the
+                # GC above just discarded the committed/missing-body
+                # evidence the gap detector relied on — fetch state NOW,
+                # not whenever future traffic happens to re-arm the timer
+                self._state_resps.clear()
+                self._broadcast(
+                    {"kind": "state_req", "have": self.last_executed}
+                )
+
+    def _gc_log(self, n: int) -> None:
+        """Discard every log structure at or below seq `n` (the paper's
+        garbage collection). Request bodies survive only while some live
+        pre-prepare references them or they are still unsequenced."""
+        dropped_digests = {
+            d for s, d in self.pre_prepares.items() if s <= n
+        }
+        for seq in [s for s in self.pre_prepares if s <= n]:
+            del self.pre_prepares[seq]
+        live = set(self.pre_prepares.values())
+        for d in dropped_digests - live:
+            self.requests.pop(d, None)
+        for store in (self.prepares, self.commits, self.prepare_sigs):
+            for key in [k for k in store if k[1] <= n]:
+                del store[key]
+        for seq in [s for s in self.committed if s <= n]:
+            del self.committed[seq]
+        self.executed = {s for s in self.executed if s > n}
+        for key in [k for k in self.checkpoint_votes if k[0] <= n]:
+            del self.checkpoint_votes[key]
 
     # -- durable meta + catch-up state transfer -------------------------------
 
@@ -347,7 +475,7 @@ class BFTReplica:
         if self._meta is not None:
             self._meta.put(b"bft_meta", serialize({
                 "last_executed": self.last_executed, "view": self.view,
-                "next_seq": self.next_seq,
+                "next_seq": self.next_seq, "stable_seq": self.stable_seq,
             }))
 
     #: a gap between last_executed and higher committed seqs that persists
@@ -372,7 +500,11 @@ class BFTReplica:
         behind_view = (
             getattr(self, "_ahead_view_evidence", -1) > self.view
         )
-        lagging = missing_seq or missing_body or behind_view
+        # an adopted stable checkpoint ahead of our execution is standing
+        # lag evidence (the commit evidence below it was GC'd): keep the
+        # timer armed in case the immediate state_req was lost
+        behind_ckpt = self.stable_seq > self.last_executed
+        lagging = missing_seq or missing_body or behind_view or behind_ckpt
         if not lagging:
             self._gap_since = None
             return
@@ -434,11 +566,12 @@ class BFTReplica:
                 self.last_executed = rn
                 self.next_seq = max(self.next_seq, rn + 1)
                 self.view = max(self.view, rview)
-                self.executed = {s for s in self.executed if s > rn}
-                for seq in [s for s in self.committed if s <= rn]:
-                    del self.committed[seq]
-                for seq in [s for s in self.pre_prepares if s <= rn]:
-                    del self.pre_prepares[seq]
+                # the installed snapshot is f+1-agreed: treat it as our
+                # stable checkpoint and truncate the log below it
+                self.stable_seq = max(self.stable_seq, rn)
+                self.stable_digest = _rd
+                self.stable_cert = {}
+                self._gc_log(self.stable_seq)
                 self._save_meta()
                 self._state_resps.clear()
                 self._gap_since = None

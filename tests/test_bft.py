@@ -297,3 +297,173 @@ class TestStateTransfer:
         c.tick_all(106.0)
         assert c.replicas[6].view == view_now
         assert c.uniqueness[6] == c.uniqueness[1]
+
+
+class TestCheckpointGC:
+    """PBFT §4.3 stable checkpoints + log garbage collection (r3 VERDICT
+    #4; reference BFTSMaRt.kt:150-276 DefaultRecoverable snapshot install
+    + log truncation)."""
+
+    @staticmethod
+    def _log_size(r):
+        return (
+            len(r.pre_prepares) + len(r.prepares) + len(r.commits)
+            + len(r.prepare_sigs) + len(r.committed) + len(r.executed)
+            + len(r.requests) + len(r.checkpoint_votes)
+        )
+
+    def test_log_truncates_at_stable_checkpoint(self, monkeypatch):
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 8)
+        c = BFTCluster(4)
+        for k in range(30):
+            f = c.client.submit({"entries": {f"k{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+        for r in c.replicas:
+            # seqs 0..29 executed; checkpoints fired at 8, 16, 24
+            assert r.last_executed == 29
+            assert r.stable_seq == 24
+            assert len(r.stable_cert) >= 3  # 2f+1 signatures retained
+            # every log structure lives strictly above the checkpoint
+            assert all(s > 24 for s in r.pre_prepares)
+            assert all(k[1] > 24 for k in r.prepares)
+            assert all(k[1] > 24 for k in r.commits)
+            assert all(s > 24 for s in r.committed)
+            assert all(s > 24 for s in r.executed)
+
+    def test_memory_bounded_under_sustained_load(self, monkeypatch):
+        """The r3 gap: the per-sequence message log grew without bound.
+        Under 10x CHECKPOINT_INTERVAL commands the live log must stay
+        O(interval), not O(history)."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 8)
+        c = BFTCluster(4)
+        sizes = []
+        for k in range(80):
+            f = c.client.submit({"entries": {f"m{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+            sizes.append(max(self._log_size(r) for r in c.replicas))
+        # the high-water mark over the last 40 commands must not exceed
+        # the mark after the first 20 + slack: i.e. no monotonic growth
+        assert max(sizes[40:]) <= max(sizes[:20]) + 10, sizes[::8]
+
+    def test_truncated_cluster_heals_rejoiner_via_snapshot(self, monkeypatch):
+        """A replica that slept past a GC cycle cannot replay discarded
+        log entries — it must catch up via the f+1-agreed snapshot, which
+        also becomes its own stable checkpoint."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        c.partitioned.add(3)
+        for k in range(12):  # replica 3 misses seqs 1..12, GC at 4, 8, 12
+            f = c.client.submit({"entries": {f"g{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+        assert c.replicas[0].stable_seq >= 8  # log below is GONE
+        c.restart(3)
+        f = c.client.submit({"entries": {"z": "tz"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        c.tick_all(100.0)
+        c.tick_all(103.0)
+        r3 = c.replicas[3]
+        assert r3.last_executed == 13
+        assert c.uniqueness[3] == c.uniqueness[0]
+        assert r3.stable_seq >= 8  # snapshot install IS a stable checkpoint
+        # and it is a full member again: progress with another member down
+        c.partitioned.add(1)
+        f = c.client.submit({"entries": {"w": "tw"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        assert c.uniqueness[3].get("w") == "tw"
+
+    def test_forged_checkpoint_signature_cannot_truncate(self, monkeypatch):
+        """A Byzantine replica spraying unsigned/forged checkpoint votes
+        must not advance the stable checkpoint (log truncation without a
+        real 2f+1 certificate could discard committable entries)."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 1000)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        victim = c.replicas[0]
+        before = victim.stable_seq
+        for voter in (1, 2, 3):
+            victim.on_message(voter, serialize({
+                "kind": "checkpoint", "seq": 0,
+                "digest": b"\x11" * 32, "csig": b"\x00" * 64,
+            }))
+        assert victim.stable_seq == before
+        assert victim.checkpoint_votes == {}
+
+    def test_restart_keeps_stable_seq_watermark(self, monkeypatch):
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        for k in range(6):
+            f = c.client.submit({"entries": {f"r{k}": f"t{k}"}})
+            c.pump()
+            f.result(timeout=0)
+        assert c.replicas[2].stable_seq == 4
+        c.restart(2)
+        assert c.replicas[2].stable_seq == 4  # durable via meta
+
+    def test_checkpoint_digest_spray_bounded_per_voter(self, monkeypatch):
+        """One Byzantine replica validly signing many DISTINCT digests for
+        one seq must hold at most ONE live vote there — not one table
+        entry per message (review finding: unbounded growth)."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 1000)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        victim, evil = c.replicas[0], c.replicas[3]
+        from corda_tpu.node.bft import _checkpoint_statement
+        from corda_tpu.core.crypto import ed25519_math
+
+        for k in range(50):
+            d = bytes([k]) * 32
+            sig = ed25519_math.sign(
+                evil._signing_seed, _checkpoint_statement(5, d)
+            )
+            victim.on_message(3, serialize({
+                "kind": "checkpoint", "seq": 5, "digest": d, "csig": sig,
+            }))
+        entries = [k for k in victim.checkpoint_votes if k[0] == 5]
+        assert len(entries) == 1  # only the newest vote survives
+
+    def test_checkpoint_ahead_of_execution_triggers_state_fetch(self, monkeypatch):
+        """A replica that adopts a 2f+1 checkpoint BEYOND its own
+        execution must fetch state immediately — the GC just discarded
+        the commit evidence the gap detector needed, and no further
+        client traffic may ever arrive (review finding: idle wedge)."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        # replica 3 stops seeing pre-prepare BODIES but still gets
+        # checkpoint votes: emulate by partitioning it, running past a
+        # checkpoint boundary, then delivering ONLY the checkpoint votes
+        c.partitioned.add(3)
+        for k in range(6):
+            f = c.client.submit({"entries": {f"k{k}": f"t{k}"}})
+            c.pump()
+            f.result(timeout=0)
+        assert c.replicas[0].stable_seq == 4
+        c.partitioned.discard(3)
+        r3 = c.replicas[3]
+        assert r3.last_executed == 0
+        # deliver the stable certificate votes straight to replica 3
+        for voter, sig in c.replicas[0].stable_cert.items():
+            if voter != 3:
+                r3.on_message(voter, serialize({
+                    "kind": "checkpoint", "seq": 4,
+                    "digest": c.replicas[0].stable_digest, "csig": sig,
+                }))
+        assert r3.stable_seq == 4          # adopted, ahead of execution
+        assert r3.last_executed < 4
+        c.pump()  # the IMMEDIATE state_req round trips; no tick needed
+        assert r3.last_executed >= 4
+        assert c.uniqueness[3] == c.uniqueness[0]
