@@ -56,12 +56,25 @@ class TransactionWithSignatures:
         """Check every attached signature cryptographically, then check the
         required-keys set is fulfilled modulo allowed_to_be_missing."""
         self.check_signatures_are_valid()
+        self.check_required_keys_except(*allowed_to_be_missing)
+
+    def check_required_keys_except(self, *allowed_to_be_missing: PublicKey) -> None:
+        """The fulfilment half of verify_signatures_except alone — for
+        callers that already ran the cryptographic check elsewhere (e.g.
+        the notary offloads it to the cross-transaction batcher)."""
         needed = self._missing_signatures()
         missing = needed - set(allowed_to_be_missing)
         if missing:
             raise SignaturesMissingError(
                 frozenset(missing), self.get_key_descriptions(missing), self.id
             )
+
+    def signature_check_items(self) -> List[Tuple[PublicKey, bytes, bytes]]:
+        """(key, signature, content) rows for a batch verifier — the same
+        triples check_signatures_are_valid feeds to verify_batch, exposed
+        so services can merge them into CROSS-transaction batches."""
+        content = self.id.bytes
+        return [(sig.by, sig.bytes, content) for sig in self.sigs]
 
     def check_signatures_are_valid(self) -> None:
         """Batch cryptographic check of all attached signatures over id.bytes

@@ -538,9 +538,28 @@ class NotaryServiceFlow(FlowLogic):
                     "validating notary requires the full transaction"
                 )
             notary_key = stx.notary.owning_key if stx.notary else None
-            # Signature hot loop -> batched check (TransactionWithSignatures
-            # batch path), then chain resolution + contract verification.
-            stx.verify_signatures_except(notary_key)
+            # Signature hot loop -> the node's CROSS-transaction batcher
+            # (verifier service SignatureBatcher): concurrent notarise
+            # flows accumulate into one device-worthy flush instead of
+            # each paying its own dispatch. The flow parks off-pump while
+            # the batch resolves, so other flows keep feeding the batch.
+            svc = getattr(
+                self.service_hub, "transaction_verifier_service", None
+            )
+            if svc is not None and stx.sigs:
+                futs = svc.verify_signatures(stx.signature_check_items())
+                bad = yield self.await_blocking(
+                    lambda: [
+                        i for i, f in enumerate(futs) if not f.result(120)
+                    ]
+                )
+                if bad:
+                    raise NotaryException(
+                        f"invalid signature(s) at positions {bad} on {stx.id}"
+                    )
+                stx.check_required_keys_except(notary_key)
+            else:
+                stx.verify_signatures_except(notary_key)
             resolved = yield from self.sub_flow(
                 ResolveTransactionsFlow(
                     stx, self.counterparty,
