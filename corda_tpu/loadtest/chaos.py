@@ -2,52 +2,163 @@
 `tools/loadtest/.../StabilityTest.kt` + `Disruption.kt` run against an
 SSH-managed cluster: long-running load with faults fired mid-flight).
 
-Deploys a raft-validating notary cluster + two banks as OS processes,
-drives issue+pay pairs continuously, and fires random disruptions —
-member SIGSTOP/resume, member SIGKILL + relaunch, counterparty-bank
-SIGKILL + relaunch — every 12-25 s for the requested duration. Never
-more than one cluster member is disrupted at a time (f = 1), and bank A
-is never touched (its RPC connection is the measurement instrument).
+Deploys a notary cluster (raft-validating by default; --notary bft for a
+4-replica PBFT cluster) + two banks as OS processes, drives issue+pay
+pairs continuously, and fires random disruptions — member SIGSTOP/resume,
+member SIGKILL + relaunch, counterparty-bank SIGKILL + relaunch, and
+(with --verifier-workers N) SIGKILL of one competing out-of-process
+verifier worker (reference VerifierTests.kt:73-101 elasticity, at system
+scale) — every 12-25 s for the requested duration. Never more than one
+cluster member is disrupted at a time (f = 1), and bank A is never
+touched (its RPC connection is the measurement instrument).
 
 Invariants checked at the end: every payment the client saw complete is
 on the counterparty's ledger (no loss), exactly once (no dup).
 
 Run: python -m corda_tpu.loadtest.chaos [--duration 600] [--seed 7]
+                                        [--notary raft|bft]
+                                        [--verifier-workers N]
 Reference run (round 3, 1-core box): 21,203 pairs over 600 s with 25
 disruptions, 0 driver errors, no loss, no dup.
 """
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
+import sys
 import tempfile
 import time
 from typing import List
 
 
-def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
+class _Worker:
+    """A standalone out-of-process verifier worker: competes on the
+    owning node's broker verification queue with its siblings."""
+
+    def __init__(self, base: str, broker: str, name: str):
+        self.base, self.broker, self.name = base, broker, name
+        self.log_path = os.path.join(base, f"{name}.log")
+        self.proc = None
+        self._log_fh = None
+
+    def launch(self, timeout: float = 120.0) -> "_Worker":
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["CORDA_TPU_EXIT_ON_ORPHAN"] = "1"
+        # readiness is judged on THIS launch's output only: the log file
+        # keeps the previous run's 'verifier ready' line after a relaunch
+        start = (
+            os.path.getsize(self.log_path)
+            if os.path.exists(self.log_path) else 0
+        )
+        self._close_log()
+        self._log_fh = open(self.log_path, "a")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "corda_tpu.verifier",
+             "--connect", self.broker, "--name", self.name,
+             "--jax-platform", "cpu"],
+            stdout=self._log_fh, stderr=subprocess.STDOUT,
+            env=env,
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(self.log_path) as fh:
+                    fh.seek(start)
+                    if "verifier ready" in fh.read():
+                        return self
+            except OSError:
+                pass
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"worker {self.name} died on startup")
+            time.sleep(0.3)
+        raise RuntimeError(f"worker {self.name} never became ready")
+
+    def _close_log(self) -> None:
+        if self._log_fh is not None:
+            try:
+                self._log_fh.close()
+            except OSError:
+                pass
+            self._log_fh = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        self._close_log()
+
+    def close(self) -> None:
+        try:
+            self.kill()
+        except Exception:
+            pass
+
+
+def run(
+    duration: float = 600.0,
+    seed: int = 7,
+    verbose: bool = False,
+    notary: str = "raft",
+    verifier_workers: int = 0,
+) -> dict:
     from ..testing.smoketesting import Factory
     from ..tools.cordform import deploy_nodes
     from .procdriver import PairDriver, assert_no_loss_no_dup, resolve_identities
 
     rng = random.Random(seed)
     base = tempfile.mkdtemp(prefix="chaos-")
+    if notary == "bft":
+        # 3f+1 with f=1: four PBFT replicas; the disruption rotation still
+        # touches at most one member at a time, inside the f=1 budget
+        notary_entry = {
+            "name": "O=ChaosNotary,L=Zurich,C=CH", "notary": "bft",
+            "cluster_size": 4, "cluster_route_refresh": 5.0,
+            "network_map_service": True,
+        }
+        n_members = 4
+    else:
+        notary_entry = {
+            "name": "O=ChaosNotary,L=Zurich,C=CH", "notary": "raft-validating",
+            "cluster_size": 3, "cluster_route_refresh": 5.0,
+            "network_map_service": True,
+        }
+        n_members = 3
+    bank_a = {"name": "O=ChaosA,L=London,C=GB"}
+    if verifier_workers:
+        # bank A farms transaction verification out to competing consumer
+        # workers on its broker — the reference's elasticity contract
+        bank_a["verifier_type"] = "OutOfProcess"
     spec = {"nodes": [
-        {"name": "O=ChaosNotary,L=Zurich,C=CH", "notary": "raft-validating",
-         "cluster_size": 3, "cluster_route_refresh": 5.0,
-         "network_map_service": True},
-        {"name": "O=ChaosA,L=London,C=GB"},
+        notary_entry,
+        bank_a,
         {"name": "O=ChaosB,L=Paris,C=FR"},
     ]}
     resolved = deploy_nodes(spec, base)
+    a_idx, b_idx = n_members, n_members + 1
     factory = Factory(base)
     nodes: List = []
+    workers: List[_Worker] = []
     driver = None
     try:
         for conf in resolved:
             nodes.append(factory.launch(conf["dir"]))
-        me, cluster, peer = resolve_identities(nodes[3], nodes[4])
-        driver = PairDriver(nodes[3], cluster, me, peer).start()
+        broker_a = (
+            f"{resolved[a_idx]['broker_host']}:{resolved[a_idx]['broker_port']}"
+        )
+        for w in range(verifier_workers):
+            workers.append(
+                _Worker(base, broker_a, f"chaos-worker-{w}").launch()
+            )
+        me, cluster, peer = resolve_identities(nodes[a_idx], nodes[b_idx])
+        driver = PairDriver(nodes[a_idx], cluster, me, peer).start()
         # warm-up gate: booting 5 OS processes plus the first pair is
         # slow on a loaded box; disrupting before anything completes
         # turns a short soak into a spurious "no pairs completed" failure
@@ -64,12 +175,24 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
         t_end = t0 + duration
         events = []
         degraded = set()  # members whose relaunch failed: exclude (f=1!)
+        kinds = ["suspend", "member_restart", "bankb_restart"]
+        if workers:
+            kinds.append("worker_kill")
+        worker_kills = 0
         while time.monotonic() < t_end:
             time.sleep(rng.uniform(12, 25))
-            kind = rng.choice(["suspend", "member_restart", "bankb_restart"])
+            kind = rng.choice(kinds)
             idx = None
-            if kind != "bankb_restart":
-                candidates = [i for i in (0, 1, 2) if i not in degraded]
+            if kind == "worker_kill":
+                # keep >= 1 worker alive: bank A's verification queue must
+                # always have a consumer (elasticity, not total outage)
+                alive = [w for w in workers if w.alive()]
+                if len(alive) < 2:
+                    kind = "bankb_restart"
+            if kind in ("suspend", "member_restart"):
+                candidates = [
+                    i for i in range(n_members) if i not in degraded
+                ]
                 if not candidates:
                     kind = "bankb_restart"
                 else:
@@ -97,16 +220,32 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
                                 print("member", idx, "failed to relaunch; "
                                       "excluded from rotation", flush=True)
                             continue
+                elif kind == "worker_kill":
+                    victim = rng.choice([w for w in workers if w.alive()])
+                    before = len(driver.completed)
+                    victim.kill()
+                    worker_kills += 1
+                    # redistribution evidence: pairs must keep completing
+                    # on the surviving worker(s) BEFORE the victim returns
+                    redeadline = time.monotonic() + 120
+                    while len(driver.completed) < before + 2:
+                        assert time.monotonic() < redeadline, (
+                            "no pairs completed after a worker death — "
+                            "the queue did not redistribute"
+                        )
+                        time.sleep(0.3)
+                    idx = f"worker:{victim.name}+{len(driver.completed) - before}"
+                    victim.launch()
                 else:
-                    nodes[4].kill()
+                    nodes[b_idx].kill()
                     time.sleep(rng.uniform(0.5, 2))
                     try:
-                        nodes[4] = factory.launch(resolved[4]["dir"])
+                        nodes[b_idx] = factory.launch(resolved[b_idx]["dir"])
                     except Exception:
                         # one retry, then FAIL the soak loudly: a dead
                         # counterparty makes every later pair error and
                         # the final consistency check meaningless
-                        nodes[4] = factory.launch(resolved[4]["dir"])
+                        nodes[b_idx] = factory.launch(resolved[b_idx]["dir"])
                 events.append(
                     (round(time.monotonic() - t0, 1), kind, idx)
                 )
@@ -120,14 +259,18 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
         time.sleep(10)  # heal window
         wall = time.monotonic() - t0
         driver.stop(timeout=300)
-        assert_no_loss_no_dup(driver, nodes[4])
+        assert_no_loss_no_dup(driver, nodes[b_idx])
         return {
             "metric": "chaos-soak-pairs",
+            "notary": notary,
             "pairs": len(driver.completed),
             "wall_s": round(wall, 1),
             "pairs_per_sec": round(len(driver.completed) / wall, 2),
             "disruptions": len(events),
+            "events": events,
             "degraded_members": sorted(degraded),
+            "verifier_workers": len(workers),
+            "worker_kills": worker_kills,
             "driver_errors": len(driver.errors),
             "consistent": True,
         }
@@ -137,6 +280,8 @@ def run(duration: float = 600.0, seed: int = 7, verbose: bool = False) -> dict:
                 driver.stop(timeout=5)
             except BaseException:
                 pass
+        for w in workers:
+            w.close()
         for n in nodes:
             n.close()
 
@@ -147,8 +292,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.chaos")
     ap.add_argument("--duration", type=float, default=600.0)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--notary", choices=("raft", "bft"), default="raft")
+    ap.add_argument("--verifier-workers", type=int, default=0)
     args = ap.parse_args(argv)
-    print(json.dumps(run(args.duration, args.seed, verbose=True)))
+    print(json.dumps(run(
+        args.duration, args.seed, verbose=True,
+        notary=args.notary, verifier_workers=args.verifier_workers,
+    )))
     return 0
 
 

@@ -165,6 +165,8 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
         }
         if n.get("notary"):
             conf["notary_type"] = n["notary"]
+        if n.get("verifier_type"):
+            conf["verifier_type"] = n["verifier_type"]
         if n.get("identity_entropy") is not None:
             conf["identity_entropy"] = n["identity_entropy"]
         if n.get("raft_cluster"):
