@@ -367,14 +367,32 @@ class NetworkMapClient:
     # -- startup handshake ---------------------------------------------------
 
     def register_and_fetch(self, timeout: float = 15.0,
-                           ttl: Optional[float] = None) -> int:
+                           ttl: Optional[float] = None,
+                           startup_window: float = 120.0) -> int:
         """REGISTER self + SUBSCRIBE + FETCH; apply entries; returns the
         number of peers learned. Raises on registration rejection. A
         background thread re-registers at TTL/2 so a long-running node
-        never silently expires out of the directory."""
+        never silently expires out of the directory.
+
+        The first REGISTER retries for up to `startup_window` seconds on
+        transient failures — the runnodes script (and any orchestrator)
+        launches every node concurrently, so the directory node's broker,
+        its `netmap.requests` queue, or its consumer may simply not exist
+        yet. Permanent rejections (RuntimeError) still raise immediately."""
+        from ..messaging import UnknownQueueError
+
         if ttl is not None:
             self._ttl = ttl
-        self._register(timeout, extras_force=True)
+        deadline = time.monotonic() + startup_window
+        while True:
+            try:
+                self._register(timeout, extras_force=True)
+                break
+            except (UnknownQueueError, ConnectionError, OSError,
+                    TimeoutError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(1.0)
         self._refresh_thread = threading.Thread(
             target=self._refresh_loop, name=f"netmap-refresh-{self._me.name}",
             daemon=True,

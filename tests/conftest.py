@@ -46,6 +46,7 @@ _HEAVY_FILES = frozenset({
     "test_ops_ecdsa.py",
     "test_real_disruption.py",
     "test_process.py",
+    "test_capsule_install.py",
 })
 
 
