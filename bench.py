@@ -176,11 +176,22 @@ def main() -> None:
         from corda_tpu.core.crypto.keys import SchemePublicKey
         from corda_tpu.core.crypto.schemes import EDDSA_ED25519_SHA512
 
+        from corda_tpu.core.crypto import host_batch
+
         code = EDDSA_ED25519_SHA512.scheme_code_name
         items = [
             (SchemePublicKey(code, pubs[i]), sigs[i], msgs[i])
             for i in range(batch)
         ]
+        # label what _verify_flat will ACTUALLY do for this run (an
+        # overridden DISPATCH or configured mesh routes to the device
+        # kernels even on a CPU backend — the record must say so)
+        if crypto_batch._use_device_kernels():
+            cpu_path = "device-kernel"
+        elif host_batch.available() and batch >= host_batch.MIN_BATCH:
+            cpu_path = "native-msm-batch"
+        else:
+            cpu_path = "host-openssl-pool"
         assert all(crypto_batch.verify_batch(items)), "bench batch failed"
         best = float("inf")
         for _ in range(3):
@@ -243,7 +254,7 @@ def main() -> None:
                 "provenance": {"live": False, **prov},
                 "cpu_dispatch_sigs_s": round(rate, 1),
                 "cpu_dispatch_batch": batch,
-                "cpu_dispatch_path": "host-openssl-pool",
+                "cpu_dispatch_path": cpu_path,
             }
         else:  # no TPU datapoint anywhere in the repo: report CPU honestly
             record = {
@@ -254,7 +265,7 @@ def main() -> None:
                 "batch": batch,
                 "backend": "cpu",
                 "end_to_end": True,
-                "cpu_dispatch_path": "host-openssl-pool",
+                "cpu_dispatch_path": cpu_path,
             }
     if tunnel_note:
         record["note"] = tunnel_note

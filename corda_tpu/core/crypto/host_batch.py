@@ -1,0 +1,149 @@
+"""Host-CPU batched ed25519 verification via random linear combination.
+
+The reference verifies one signature at a time through BouncyCastle
+(`core/.../crypto/Crypto.kt:535-541`, ~2-3k verifies/s/core); plain
+OpenSSL does ~7k/s/core.  This module verifies a whole batch with ONE
+Pippenger multi-scalar multiplication (native/src/ed25519_msm.cpp):
+
+    8 * [ sum z_i R_i + sum_k (sum_{i in k} z_i h_i) A_k
+          - (sum z_i s_i) B ]  ==  identity
+
+with independent random 128-bit z_i per signature, h_i = SHA-512(R_i ||
+A_i || M_i) mod L, and the A-terms aggregated per distinct public key
+(notary batches have many signatures from few signers).  Cost per
+signature falls from one full double-scalar multiplication to a few
+dozen curve additions, ~5x faster than OpenSSL at batch >= 1k.
+
+Semantics:
+  * a batch that fails splits recursively, so rejects carry exact
+    per-signature positions; LEAVES are decided by the same cofactored
+    one-row equation as full batches — ONE verification rule for every
+    signature regardless of which batch it landed in (a leaf deciding
+    by cofactorless OpenSSL instead would let the same signature
+    verify True or False depending on batch composition)
+  * that rule is the cofactored equation ZIP-215 standardises for
+    consensus use.  For adversarially crafted signatures exploiting the
+    small torsion subgroup, cofactored verification can accept where
+    cofactorless (OpenSSL/BouncyCastle) single verification rejects;
+    honestly generated signatures are never affected.  Deployments that
+    must match cofactorless OpenSSL bit-for-bit on such inputs set
+    CORDA_TPU_HOST_BATCH=0 (which also pins the small-bucket and
+    non-ed25519 paths' rule, since those always use OpenSSL).
+  * non-canonical encodings (y >= p, s >= L) and malformed shapes are
+    rejected up front, matching RFC 8032 / OpenSSL strictness.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+from typing import List, Sequence, Tuple
+
+L = 2**252 + 27742317777372353535851937790883648493
+P = 2**255 - 19
+#: compressed base point: x sign 0, y = 4/5 mod p
+B_COMPRESSED = bytes([0x58]) + b"\x66" * 31
+
+#: below this many signatures the per-signature OpenSSL loop wins (the
+#: MSM's bucket-aggregation floor does not amortise)
+MIN_BATCH = 64
+
+Row = Tuple[bytes, bytes, bytes]  # (public_key_32, signature_64, message)
+
+
+def available() -> bool:
+    if os.environ.get("CORDA_TPU_HOST_BATCH") == "0":
+        return False
+    from ... import native
+
+    return native.available()
+
+
+def verify_batch_host(rows: Sequence[Row]) -> List[bool]:
+    """Positionally-aligned verdicts for (pub, sig, msg) rows."""
+    results = [False] * len(rows)
+    good: List[int] = []
+    for i, (pub, sig, msg) in enumerate(rows):
+        if (
+            isinstance(pub, (bytes, bytearray)) and len(pub) == 32
+            and isinstance(sig, (bytes, bytearray)) and len(sig) == 64
+            and isinstance(msg, (bytes, bytearray))
+            and int.from_bytes(sig[32:], "little") < L
+            and int.from_bytes(pub, "little") & (2**255 - 1) < P
+            and int.from_bytes(sig[:32], "little") & (2**255 - 1) < P
+        ):
+            good.append(i)
+        # else: malformed/non-canonical row stays False
+    # h_i is deterministic per row: hash ONCE up front (one batched
+    # native SHA-512+reduce pass), not once per recursion level
+    hs = _hashes_mod_l(rows, good)
+    _verify_range(rows, good, hs, results)
+    return results
+
+
+def _hashes_mod_l(rows: Sequence[Row], idx: List[int]) -> dict:
+    """row index -> SHA-512(R || A || M) mod L."""
+    from ... import native
+
+    msgs = []
+    for i in idx:
+        pub, sig, msg = rows[i]
+        msgs.append(bytes(sig[:32]) + bytes(pub) + bytes(msg))
+    if native.available():
+        words = native.sha512_mod_l_many(msgs)  # (n, 8) uint32 LE
+        return {
+            i: int.from_bytes(words[j].tobytes(), "little")
+            for j, i in enumerate(idx)
+        }
+    return {
+        i: int.from_bytes(hashlib.sha512(m).digest(), "little") % L
+        for i, m in zip(idx, msgs)
+    }
+
+
+def _verify_range(rows: Sequence[Row], idx: List[int], hs: dict,
+                  results: List[bool]) -> None:
+    if not idx:
+        return
+    # leaves use the SAME cofactored one-row equation as full batches:
+    # one verification rule for every signature, regardless of which
+    # batch composition it happened to land in
+    if len(idx) == 1:
+        results[idx[0]] = _batch_equation_holds(rows, idx, hs)
+        return
+    if _batch_equation_holds(rows, idx, hs):
+        for i in idx:
+            results[i] = True
+        return
+    # some signature is bad: binary-search it out so rejects keep exact
+    # positions (and the good half still verifies at batch speed)
+    mid = len(idx) // 2
+    _verify_range(rows, idx[:mid], hs, results)
+    _verify_range(rows, idx[mid:], hs, results)
+
+
+def _batch_equation_holds(rows: Sequence[Row], idx: List[int],
+                          hs: dict) -> bool:
+    from ... import native
+
+    pts = bytearray()
+    scalars = bytearray()
+    key_terms: dict = {}  # pub bytes -> aggregated (z*h) scalar
+    b_acc = 0
+    for i in idx:
+        pub, sig, msg = rows[i]
+        pub, sig = bytes(pub), bytes(sig)
+        z = secrets.randbits(128) | 1
+        pts += sig[:32]
+        scalars += z.to_bytes(32, "little")
+        key_terms[pub] = (key_terms.get(pub, 0) + z * hs[i]) % L
+        b_acc = (b_acc + z * int.from_bytes(sig[32:], "little")) % L
+    for pub, c in key_terms.items():
+        pts += pub
+        scalars += c.to_bytes(32, "little")
+    pts += B_COMPRESSED
+    scalars += ((L - b_acc) % L).to_bytes(32, "little")
+    verdict = native.ed25519_msm_is_small(
+        bytes(pts), bytes(scalars), len(pts) // 32
+    )
+    return verdict == 1

@@ -69,6 +69,7 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
     sources = [
         os.path.join(_SRC, "sha2_batch.cpp"),
         os.path.join(_SRC, "journal.cpp"),
+        os.path.join(_SRC, "ed25519_msm.cpp"),
     ]
     so_path = os.path.join(_BUILD, "corda_native.so")
     try:
@@ -101,6 +102,14 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
             ctypes.c_int64,
+        ]
+        lib.ed25519_msm_is_small.restype = ctypes.c_longlong
+        lib.ed25519_msm_is_small.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ed25519_point_roundtrip.restype = ctypes.c_longlong
+        lib.ed25519_point_roundtrip.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
         ]
         return lib
     except Exception:
@@ -212,6 +221,31 @@ def sha512_mod_l_rows(rows) -> "np.ndarray":
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
     )
     return out
+
+
+def ed25519_msm_is_small(points: bytes, scalars: bytes, n: int) -> int:
+    """8 * sum(scalar_i * P_i) == identity over ed25519.
+
+    points: n compressed 32-byte points; scalars: n 32-byte little-endian
+    scalars already reduced mod L.  Returns 1 (yes), 0 (no), -1 (some
+    point fails to decompress).  Raises RuntimeError when the native
+    library is unavailable — callers gate on available()."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.ed25519_msm_is_small(points, scalars, n)
+
+
+def ed25519_point_roundtrip(compressed: bytes):
+    """Test hook: decompress one point, return (x_bytes, y_bytes) affine,
+    or None if the encoding is not on the curve."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = ctypes.create_string_buffer(64)
+    if lib.ed25519_point_roundtrip(compressed, out) != 0:
+        return None
+    return out.raw[:32], out.raw[32:]
 
 
 def sha256_pairs(nodes: bytes) -> bytes:
