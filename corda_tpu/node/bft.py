@@ -303,6 +303,12 @@ class BFTReplica:
             return
         if seq in self.pre_prepares and self.pre_prepares[seq] != d:
             return  # equivocation: ignore (view change will handle)
+        if _digest(msg["request"]) != d:
+            # the digest IS the commit key: accepting a body that does
+            # not hash to it would let a Byzantine primary send the SAME
+            # digest with DIFFERENT bodies to different replicas — one
+            # quorum, divergent executions
+            return
         if not self._verify_prepare_sig(
             sender, msg["view"], seq, d, msg.get("psig")
         ):

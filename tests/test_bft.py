@@ -467,3 +467,112 @@ class TestCheckpointGC:
         c.pump()  # the IMMEDIATE state_req round trips; no tick needed
         assert r3.last_executed >= 4
         assert c.uniqueness[3] == c.uniqueness[0]
+
+
+class TestByzantineBehaviors:
+    """Byzantine cases beyond signature withholding (r3 VERDICT weak #5):
+    primary equivocation, corrupt digests, forged pre-prepares."""
+
+    def test_equivocating_primary_cannot_split_commits(self):
+        """A primary sending DIFFERENT digests for the same seq to
+        different replicas must not get both committed: the 2f+1 prepare
+        quorum can only form for (at most) one of them."""
+        c = BFTCluster(4)
+        from corda_tpu.node.bft import _digest
+
+        req_a = {"client_id": "c", "request_id": "c:1",
+                 "command": {"entries": {"k": "ta"}}}
+        req_b = {"client_id": "c", "request_id": "c:2",
+                 "command": {"entries": {"k": "tb"}}}
+        da, db = _digest(req_a), _digest(req_b)
+        evil = c.replicas[0]  # view-0 primary equivocates
+        sig_a = evil._sign_prepare(0, 0, da)
+        sig_b = evil._sign_prepare(0, 0, db)
+        # replicas 1,2 see digest A; replica 3 sees digest B
+        for dst, d, req, sig in ((1, da, req_a, sig_a), (2, da, req_a, sig_a),
+                                 (3, db, req_b, sig_b)):
+            c.replicas[dst].on_message(0, serialize({
+                "kind": "pre_prepare", "view": 0, "seq": 0, "digest": d,
+                "request": req, "psig": sig,
+            }))
+        c.pump()
+        # digest A can reach quorum (1, 2 + primary's own record would be
+        # needed; here at most replicas 1,2 prepared it) — digest B never
+        # can. No replica may have EXECUTED b; and no two replicas may
+        # have executed different commands for seq 0.
+        executed = [
+            (i, c.applied[i][0]["entries"]["k"])
+            for i in range(4) if c.applied[i]
+        ]
+        assert len({v for _, v in executed}) <= 1, executed
+        assert all(v != "tb" for _, v in executed) or all(
+            v == "tb" for _, v in executed)
+
+    def test_corrupt_digest_preprepare_rejected(self):
+        """A pre-prepare whose digest does not hash its request body must
+        be DROPPED at receipt: the digest is the commit key, so accepting
+        a mismatched body would let a Byzantine primary drive one quorum
+        to divergent executions (same digest, different bodies). This
+        test found the missing check in round 4."""
+        c = BFTCluster(4)
+        from corda_tpu.node.bft import _digest
+
+        req = {"client_id": "c", "request_id": "c:1",
+               "command": {"entries": {"x": "t1"}}}
+        bogus_digest = b"\x42" * 32
+        assert bogus_digest != _digest(req)
+        evil = c.replicas[0]
+        sig = evil._sign_prepare(0, 0, bogus_digest)
+        for dst in (1, 2, 3):
+            c.replicas[dst].on_message(0, serialize({
+                "kind": "pre_prepare", "view": 0, "seq": 0,
+                "digest": bogus_digest, "request": req, "psig": sig,
+            }))
+        c.pump()
+        for i in (1, 2, 3):
+            assert c.replicas[i].pre_prepares.get(0) is None
+            assert not c.applied[i]
+
+    def test_same_digest_different_bodies_cannot_diverge(self):
+        """The concrete attack the digest check closes: same digest d,
+        body A to replicas 1-2, body B to replica 3. Without the check,
+        commits keyed on d reach one quorum while replicas hold
+        different commands for seq 0."""
+        c = BFTCluster(4)
+        from corda_tpu.node.bft import _digest
+
+        req_a = {"client_id": "c", "request_id": "c:1",
+                 "command": {"entries": {"k": "ta"}}}
+        req_b = {"client_id": "c", "request_id": "c:2",
+                 "command": {"entries": {"k": "tb"}}}
+        d = _digest(req_a)
+        sig = c.replicas[0]._sign_prepare(0, 0, d)
+        for dst, req in ((1, req_a), (2, req_a), (3, req_b)):
+            c.replicas[dst].on_message(0, serialize({
+                "kind": "pre_prepare", "view": 0, "seq": 0, "digest": d,
+                "request": req, "psig": sig,
+            }))
+        c.pump()
+        # replica 3 must have dropped the mismatched body entirely
+        assert c.replicas[3].pre_prepares.get(0) is None
+        # and nobody executed "tb"
+        for i in range(4):
+            for cmd in c.applied[i]:
+                assert cmd["entries"].get("k") != "tb"
+
+    def test_forged_preprepare_from_non_primary_ignored(self):
+        c = BFTCluster(4)
+        req = {"client_id": "c", "request_id": "c:1",
+               "command": {"entries": {"x": "t1"}}}
+        from corda_tpu.node.bft import _digest
+
+        d = _digest(req)
+        evil = c.replicas[3]  # NOT the view-0 primary
+        sig = evil._sign_prepare(0, 0, d)
+        c.replicas[1].on_message(3, serialize({
+            "kind": "pre_prepare", "view": 0, "seq": 0, "digest": d,
+            "request": req, "psig": sig,
+        }))
+        c.pump()
+        assert c.replicas[1].pre_prepares.get(0) is None
+        assert not c.applied[1]
