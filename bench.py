@@ -186,9 +186,11 @@ def main() -> None:
         # label what _verify_flat will ACTUALLY do for this run (an
         # overridden DISPATCH or configured mesh routes to the device
         # kernels even on a CPU backend — the record must say so)
-        if crypto_batch._use_device_kernels():
+        if crypto_batch._use_device_kernels() and (
+            batch >= crypto_batch.MIN_DEVICE_BATCH
+        ):
             cpu_path = "device-kernel"
-        elif host_batch.available() and batch >= host_batch.MIN_BATCH:
+        elif host_batch.available():
             cpu_path = "native-msm-batch"
         else:
             cpu_path = "host-openssl-pool"

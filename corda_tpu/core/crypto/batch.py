@@ -288,9 +288,16 @@ def _verify_flat(
     if ed_host:
         from . import host_batch
 
-        if len(ed_host) >= host_batch.MIN_BATCH and host_batch.available():
+        if host_batch.available():
             # ONE Pippenger multi-scalar multiplication for the whole
-            # bucket (~7x the per-signature OpenSSL loop at >= 1k)
+            # bucket (~7x the per-signature OpenSSL loop at >= 1k).
+            # Used for EVERY bucket size: the verification rule
+            # (cofactored) must be a deployment property, not a
+            # batch-size accident — a rule that flips at a size
+            # threshold would let an adversarial torsion signature
+            # split replicas whose batchers grouped it differently
+            # (n=1 costs 217us vs OpenSSL's 139us; n>=2 is at parity
+            # or faster, so uniformity is nearly free)
             rows = [
                 (items[i][0].encoded, items[i][1], items[i][2])
                 for i in ed_host

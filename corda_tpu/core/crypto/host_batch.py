@@ -24,11 +24,14 @@ Semantics:
   * that rule is the cofactored equation ZIP-215 standardises for
     consensus use.  For adversarially crafted signatures exploiting the
     small torsion subgroup, cofactored verification can accept where
-    cofactorless (OpenSSL/BouncyCastle) single verification rejects;
-    honestly generated signatures are never affected.  Deployments that
-    must match cofactorless OpenSSL bit-for-bit on such inputs set
-    CORDA_TPU_HOST_BATCH=0 (which also pins the small-bucket and
-    non-ed25519 paths' rule, since those always use OpenSSL).
+    cofactorless (OpenSSL/BouncyCastle) single verification rejects —
+    accepts form a strict SUPERSET, honestly generated signatures are
+    never affected.  The dispatch layer applies this rule to EVERY
+    ed25519 bucket size when the native engine is available, so the
+    acceptance set is a deployment property rather than a batch-size
+    accident.  Deployments that must match cofactorless OpenSSL
+    bit-for-bit set CORDA_TPU_HOST_BATCH=0, which routes everything to
+    the OpenSSL loop.
   * non-canonical encodings (y >= p, s >= L) and malformed shapes are
     rejected up front, matching RFC 8032 / OpenSSL strictness.
 """
@@ -43,10 +46,6 @@ L = 2**252 + 27742317777372353535851937790883648493
 P = 2**255 - 19
 #: compressed base point: x sign 0, y = 4/5 mod p
 B_COMPRESSED = bytes([0x58]) + b"\x66" * 31
-
-#: below this many signatures the per-signature OpenSSL loop wins (the
-#: MSM's bucket-aggregation floor does not amortise)
-MIN_BATCH = 64
 
 Row = Tuple[bytes, bytes, bytes]  # (public_key_32, signature_64, message)
 

@@ -163,3 +163,24 @@ def test_verdicts_independent_of_batch_composition():
     dirty = host_batch.verify_batch_host(dirty_rows)
     assert clean == [True] * 96
     assert dirty == [False] + clean[1:]
+
+
+def test_small_buckets_use_the_same_rule(monkeypatch):
+    """The cofactored rule applies to EVERY bucket size on the CPU path
+    (review finding: a rule flipping at a size threshold lets an
+    adversarial torsion signature split replicas whose batchers grouped
+    it differently)."""
+    calls = {"n": 0}
+    real = host_batch.verify_batch_host
+
+    def spy(rows):
+        calls["n"] += len(rows)
+        return real(rows)
+
+    monkeypatch.setattr(host_batch, "verify_batch_host", spy)
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "auto")
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", "cpu")
+    rows = _rows(2)
+    items = [(SchemePublicKey(ED, p), s, m) for p, s, m in rows]
+    assert crypto_batch.verify_batch(items) == [True, True]
+    assert calls["n"] == 2
