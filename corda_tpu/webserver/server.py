@@ -79,7 +79,19 @@ class WebServer:
         params = dict(
             p.split("=", 1) for p in query.split("&") if "=" in p
         )
-        if path == "/api/status":
+        if path in ("/", "/ui", "/ui/"):
+            # the web GUI tier (reference explorer/network-visualiser
+            # JavaFX shells): a self-contained dashboard over this
+            # gateway's own JSON API, shipped as package data
+            import os
+
+            page = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "static", "dashboard.html",
+            )
+            with open(page, "rb") as fh:
+                req._send(200, fh.read(), "text/html; charset=utf-8")
+        elif path == "/api/status":
             req._send(200, b"started", "text/plain")
         elif path == "/api/info":
             req._json(200, self.ops.node_info())

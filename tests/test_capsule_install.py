@@ -69,6 +69,9 @@ def test_native_sources_ship_in_the_artifact(clean_venv):
     assert (src / "codec_ext.c").exists()
     assert (src / "sha2_batch.cpp").exists()
     assert (src / "journal.cpp").exists()
+    assert (src / "ed25519_msm.cpp").exists()
+    web = site / "corda_tpu" / "webserver" / "static"
+    assert (web / "dashboard.html").exists()
 
 
 def test_cordform_deploy_and_runnodes_from_installed_package(

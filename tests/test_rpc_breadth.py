@@ -321,3 +321,45 @@ class TestWebServerPlugins:
             web.stop()
             net.stop_nodes()
             clear_web_plugins()
+
+
+class TestDashboard:
+    """The web GUI tier (reference explorer / network-visualiser JavaFX
+    shells): a self-contained dashboard page served at /, consuming the
+    gateway's own JSON API."""
+
+    def test_dashboard_served_and_api_shapes_match(self):
+        from corda_tpu.webserver import WebServer
+
+        net = MockNetwork()
+        node = net.create_node("O=Dash,L=London,C=GB")
+        ops = CordaRPCOps(node.services, node.smm)
+        web = WebServer(ops, port=0)
+        try:
+            base = f"http://127.0.0.1:{web.port}"
+            with urllib.request.urlopen(base + "/", timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith("text/html")
+                page = resp.read().decode()
+            assert "corda-tpu node dashboard" in page
+            # every endpoint the page polls must exist and return the
+            # shape its JS destructures
+            import json as _json
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    assert r.status == 200, path
+                    return _json.loads(r.read())
+
+            for path in ("/api/info", "/api/network", "/api/notaries",
+                         "/api/vault?page_size=25", "/api/metrics"):
+                assert f'j("{path}")' in page, f"page no longer polls {path}"
+            info = get("/api/info")
+            assert {"name", "key", "scheme"} <= set(info)
+            vault = get("/api/vault?page_size=25")
+            assert {"total", "states"} <= set(vault)
+            assert isinstance(get("/api/network"), list)
+            assert isinstance(get("/api/notaries"), list)
+            assert isinstance(get("/api/metrics"), dict)
+        finally:
+            web.stop()
+            net.stop_nodes()
