@@ -228,8 +228,10 @@ def ed25519_msm_is_small(points: bytes, scalars: bytes, n: int) -> int:
 
     points: n compressed 32-byte points; scalars: n 32-byte little-endian
     scalars already reduced mod L.  Returns 1 (yes), 0 (no), -1 (some
-    point fails to decompress).  Raises RuntimeError when the native
-    library is unavailable — callers gate on available()."""
+    point fails to decompress), -2 (a scalar is >= 2^253, i.e. not
+    reduced mod L — a caller bug, never a verification verdict).
+    Raises RuntimeError when the native library is unavailable — callers
+    gate on available()."""
     lib = _get_lib()
     if lib is None:
         raise RuntimeError("native library unavailable")

@@ -25,8 +25,10 @@
 //    special casing
 //  * decompression: RFC 8032 section 5.1.3 square-root candidate via
 //    the (p-5)/8 power chain
-//  * MSM: Pippenger windows sized by point count; ~253/w windows, each
-//    n bucket-inserts plus 2^w bucket aggregation adds
+//  * MSM: Pippenger with SIGNED window digits in (-2^(w-1), 2^(w-1)]
+//    (negative digits insert the negated point), halving the bucket
+//    count and its per-window aggregation cost; ~254/w windows, each
+//    n bucket-inserts plus 2^(w-1) aggregation adds
 //
 // There is no counterpart anywhere in the reference (its crypto is JVM
 // BouncyCastle one-at-a-time, Crypto.kt:535-541); this file exists to
@@ -284,39 +286,60 @@ inline unsigned scalar_window(const u8 *sc, int pos, int w) {
 
 extern "C" {
 
-// 8 * sum(scalar_i * P_i) == identity?  1 yes / 0 no / -1 bad point.
+// 8 * sum(scalar_i * P_i) == identity?
+// 1 yes / 0 no / -1 bad point / -2 scalar >= 2^253 (not reduced mod L).
 // points: n*32 bytes compressed; scalars: n*32 bytes little-endian,
-// each already reduced mod L.
+// each already reduced mod L (checked exactly, up front: the signed
+// window recoding only covers 254 bits, so an oversized scalar must be
+// an error, never a silent truncation).
 long long ed25519_msm_is_small(const u8 *points, const u8 *scalars,
                                u64 n) {
+    for (u64 i = 0; i < n; i++)
+        if (scalars[32 * i + 31] >> 5) return -2;  // scalar >= 2^253
     std::vector<ge> P(n);
     for (u64 i = 0; i < n; i++)
         if (ge_frombytes(P[i], points + 32 * i) != 0) return -1;
-    // window width minimising windows*(n + 2^(w+1)) adds
-    int w = n < 8 ? 3 : n < 32 ? 4 : n < 128 ? 5 : n < 512 ? 6
-            : n < 2048 ? 7 : n < 8192 ? 9 : 11;
-    int windows = (253 + w - 1) / w;
-    std::vector<ge> buckets(1u << w);
-    std::vector<char> used(1u << w);
+    // signed-digit windows: digits in (-2^(w-1), 2^(w-1)]; bucket by
+    // |digit| (negative digits add the negated point), halving the
+    // bucket count and its aggregation cost per window
+    int w = n < 8 ? 4 : n < 64 ? 5 : n < 256 ? 6 : n < 1024 ? 8
+            : n < 4096 ? 9 : n < 16384 ? 10 : 12;
+    int windows = (254 + w - 1) / w;  // one headroom bit for carries
+    std::vector<int16_t> alldig(n * (u64)windows);
+    for (u64 i = 0; i < n; i++) {
+        int carry = 0;
+        for (int j = 0; j < windows; j++) {
+            int d = (int)scalar_window(scalars + 32 * i, j * w, w) + carry;
+            carry = 0;
+            if (d > (1 << (w - 1))) { d -= 1 << w; carry = 1; }
+            alldig[i * windows + j] = (int16_t)d;
+        }
+        if (carry) return -2;  // unreachable: scalars < 2^253 checked above
+    }
+    std::vector<ge> buckets((1u << (w - 1)) + 1);
+    std::vector<char> used((1u << (w - 1)) + 1);
     ge acc = ge_identity();
     for (int j = windows - 1; j >= 0; j--) {
         if (j != windows - 1)
             for (int k = 0; k < w; k++) acc = ge_dbl(acc);
         std::fill(used.begin(), used.end(), 0);
         for (u64 i = 0; i < n; i++) {
-            unsigned digit = scalar_window(scalars + 32 * i, j * w, w);
+            int digit = alldig[i * windows + j];
             if (!digit) continue;
-            if (used[digit])
-                buckets[digit] = ge_add(buckets[digit], P[i]);
+            unsigned b = digit > 0 ? digit : -digit;
+            ge pt = P[i];
+            if (digit < 0) { pt.X = fe_neg(pt.X); pt.T = fe_neg(pt.T); }
+            if (used[b])
+                buckets[b] = ge_add(buckets[b], pt);
             else {
-                buckets[digit] = P[i];
-                used[digit] = 1;
+                buckets[b] = pt;
+                used[b] = 1;
             }
         }
         // sum_k k * bucket[k] via the running-sum trick, top bucket down
         ge run = ge_identity(), sum = ge_identity();
         bool run_set = false, sum_set = false;
-        for (int k = (1 << w) - 1; k >= 1; k--) {
+        for (int k = (1 << (w - 1)); k >= 1; k--) {
             if (used[k]) {
                 run = run_set ? ge_add(run, buckets[k]) : buckets[k];
                 run_set = true;

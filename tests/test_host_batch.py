@@ -184,3 +184,13 @@ def test_small_buckets_use_the_same_rule(monkeypatch):
     items = [(SchemePublicKey(ED, p), s, m) for p, s, m in rows]
     assert crypto_batch.verify_batch(items) == [True, True]
     assert calls["n"] == 2
+
+
+def test_msm_rejects_unreduced_scalar_with_error_code():
+    """An oversized scalar (>= 2^253) must return the -2 caller-bug code,
+    never silently truncate into a wrong verdict."""
+    rows = _rows(4, seed=33)
+    pts = b"".join(bytes(s[:32]) for _, s, _ in rows)
+    bad_scalar = (2**255 + 5).to_bytes(32, "little")
+    scalars = bad_scalar + b"\x01".ljust(32, b"\x00") * 3
+    assert native.ed25519_msm_is_small(pts, scalars, 4) == -2
