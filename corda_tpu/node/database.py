@@ -284,6 +284,17 @@ class TransactionStorage:
             for row in self.db.query("SELECT blob FROM transactions")
         ]
 
+    def latest(self, n: int) -> List:
+        """The newest `n` transactions (insertion order), newest first —
+        a bounded query so dashboards never materialize the whole store."""
+        return [
+            deserialize(row[0])
+            for row in self.db.query(
+                "SELECT blob FROM transactions ORDER BY rowid DESC LIMIT ?",
+                (int(n),),
+            )
+        ]
+
     def count(self) -> int:
         return self.db.query("SELECT COUNT(*) FROM transactions")[0][0]
 

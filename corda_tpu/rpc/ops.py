@@ -141,6 +141,34 @@ class CordaRPCOps:
             self._services.validated_transactions.all(), self._tx_updates
         )
 
+    def recent_transactions(self, limit: int = 25) -> List:
+        """Newest-first summaries of the newest `limit` validated txs.
+        Snapshot-only and bounded: pollers (the web dashboard) must not
+        tap a DataFeed per request — over the RPC proxy every feed call
+        leaves a live server-side subscription behind, and the snapshot
+        marshals the whole store."""
+        limit = max(1, min(int(limit), 500))
+        return [
+            {
+                "id": stx.id.bytes.hex().upper(),
+                "inputs": len(stx.tx.inputs),
+                "outputs": len(stx.tx.outputs),
+                "commands": len(stx.tx.commands),
+                "signatures": len(stx.sigs),
+                "notary": stx.notary.name if stx.notary else None,
+            }
+            for stx in self._services.validated_transactions.latest(limit)
+        ]
+
+    def state_machines_snapshot(self) -> List:
+        """In-flight flows as plain dicts; snapshot-only (see
+        recent_transactions for why pollers avoid the feed)."""
+        return [
+            {"flow_id": f.flow_id, "flow_name": f.flow.flow_name()}
+            for f in self._smm.flows.values()
+            if not f.done
+        ]
+
     def vault_query(self, contract_name: Optional[str] = None) -> List:
         return self._services.vault_service.unconsumed_states(contract_name)
 

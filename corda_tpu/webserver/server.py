@@ -14,6 +14,9 @@ Endpoints (reference servlet/resource parity):
   POST /api/flows/{flow_name}            -> start flow (JSON args), returns id
   GET  /api/flows/{flow_id}              -> flow result (blocks briefly)
   GET  /api/metrics                      -> metric registry snapshot (JSON)
+  GET  /api/transactions[?limit=N]       -> newest validated-tx summaries
+  GET  /api/statemachines                -> in-flight flow snapshot
+  GET  /                                 -> dashboard (the web GUI tier)
 """
 from __future__ import annotations
 
@@ -135,6 +138,17 @@ class WebServer:
             )
         elif path == "/api/metrics":
             req._json(200, self.ops.node_metrics())
+        elif path == "/api/transactions":
+            # newest-first summaries (explorer parity: the JavaFX
+            # explorer's transaction table). Snapshot-only ops call:
+            # tapping a DataFeed per poll would leak a server-side
+            # subscription on every dashboard refresh over RPC.
+            req._json(
+                200,
+                self.ops.recent_transactions(int(params.get("limit", 25))),
+            )
+        elif path == "/api/statemachines":
+            req._json(200, self.ops.state_machines_snapshot())
         elif m := re.fullmatch(r"/api/attachments/([0-9A-Fa-f]{64})", path):
             att_id = SecureHash(bytes.fromhex(m.group(1)))
             size = self.ops.attachment_size(att_id)

@@ -351,7 +351,8 @@ class TestDashboard:
                     return _json.loads(r.read())
 
             for path in ("/api/info", "/api/network", "/api/notaries",
-                         "/api/vault?page_size=25", "/api/metrics"):
+                         "/api/vault?page_size=25", "/api/metrics",
+                         "/api/transactions?limit=15", "/api/statemachines"):
                 assert f'j("{path}")' in page, f"page no longer polls {path}"
             info = get("/api/info")
             assert {"name", "key", "scheme"} <= set(info)
@@ -360,6 +361,13 @@ class TestDashboard:
             assert isinstance(get("/api/network"), list)
             assert isinstance(get("/api/notaries"), list)
             assert isinstance(get("/api/metrics"), dict)
+            assert isinstance(get("/api/transactions?limit=15"), list)
+            assert isinstance(get("/api/statemachines"), list)
+            # limit abuse must stay bounded (clamped to [1, 500]),
+            # never returning the whole store via -0/negative slicing
+            assert len(get("/api/transactions?limit=0")) <= 1
+            assert len(get("/api/transactions?limit=-5")) <= 1
+            assert len(get("/api/transactions?limit=999999")) <= 500
         finally:
             web.stop()
             net.stop_nodes()
