@@ -282,6 +282,90 @@ inline unsigned scalar_window(const u8 *sc, int pos, int w) {
     return (unsigned)((word >> (pos & 7)) & ((1u << w) - 1));
 }
 
+// compressed base point: x sign 0, y = 4/5 (matches host_batch.py)
+const u8 B_COMPRESSED[32] = {
+    0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+
+// Fixed-base comb table for B: COMB[j][d-1] = [d * 2^(4j)] B for
+// j in [0,64), d in [1,16).  [b]B then costs <= 64 additions and ZERO
+// doublings.  Built lazily once per process (~4k curve ops); C++ magic
+// statics make initialization thread-safe.
+struct BComb {
+    ge t[64][15];
+    BComb() {
+        ge B;
+        ge_frombytes(B, B_COMPRESSED);
+        for (int j = 0; j < 64; j++) {
+            ge base = B;
+            if (j > 0) {
+                base = t[j - 1][0];
+                for (int k = 0; k < 4; k++) base = ge_dbl(base);
+            }
+            t[j][0] = base;
+            for (int d = 2; d <= 15; d++)
+                t[j][d - 1] = ge_add(t[j][d - 2], base);
+        }
+    }
+};
+
+const BComb &b_comb() {
+    static BComb comb;
+    return comb;
+}
+
+// Straus/comb evaluation for small point counts, where Pippenger's
+// per-window bucket machinery costs more than it saves: per non-B point
+// a 15-entry multiple table (14 adds) + one add per non-zero 4-bit
+// window over 253 shared doublings; any point whose ENCODING equals B
+// skips both via the static comb (zero doublings, <= 64 adds).
+ge msm_small(const u8 *points, const std::vector<ge> &P,
+             const u8 *scalars, u64 n) {
+    ge acc = ge_identity();
+    bool acc_set = false;
+    std::vector<u64> straus;  // indices of non-B points
+    for (u64 i = 0; i < n; i++) {
+        if (memcmp(points + 32 * i, B_COMPRESSED, 32) == 0) {
+            const BComb &comb = b_comb();
+            for (int j = 0; j < 64; j++) {
+                unsigned d =
+                    (scalars[32 * i + (j >> 1)] >> ((j & 1) * 4)) & 0xf;
+                if (!d) continue;
+                acc = acc_set ? ge_add(acc, comb.t[j][d - 1])
+                              : comb.t[j][d - 1];
+                acc_set = true;
+            }
+        } else {
+            straus.push_back(i);
+        }
+    }
+    if (straus.empty()) return acc;
+    // per-point tables of 1..15 multiples
+    std::vector<std::vector<ge>> tab(straus.size(), std::vector<ge>(15));
+    for (size_t k = 0; k < straus.size(); k++) {
+        tab[k][0] = P[straus[k]];
+        for (int d = 2; d <= 15; d++)
+            tab[k][d - 1] = ge_add(tab[k][d - 2], tab[k][0]);
+    }
+    ge run = ge_identity();
+    bool run_set = false;
+    for (int j = 63; j >= 0; j--) {  // 4-bit windows, MSB first
+        if (run_set)
+            for (int k = 0; k < 4; k++) run = ge_dbl(run);
+        for (size_t k = 0; k < straus.size(); k++) {
+            u64 i = straus[k];
+            unsigned d = (scalars[32 * i + (j >> 1)] >> ((j & 1) * 4)) & 0xf;
+            if (!d) continue;
+            run = run_set ? ge_add(run, tab[k][d - 1]) : tab[k][d - 1];
+            run_set = true;
+        }
+    }
+    if (run_set) acc = acc_set ? ge_add(acc, run) : run;
+    return acc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -299,6 +383,11 @@ long long ed25519_msm_is_small(const u8 *points, const u8 *scalars,
     std::vector<ge> P(n);
     for (u64 i = 0; i < n; i++)
         if (ge_frombytes(P[i], points + 32 * i) != 0) return -1;
+    if (n <= 16) {  // Straus + fixed-base comb beats Pippenger here
+        ge acc = msm_small(points, P, scalars, n);
+        for (int k = 0; k < 3; k++) acc = ge_dbl(acc);
+        return ge_is_identity(acc) ? 1 : 0;
+    }
     // signed-digit windows: digits in (-2^(w-1), 2^(w-1)]; bucket by
     // |digit| (negative digits add the negated point), halving the
     // bucket count and its aggregation cost per window

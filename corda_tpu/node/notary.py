@@ -15,6 +15,7 @@ rather than per-signature host crypto.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -546,7 +547,10 @@ class NotaryServiceFlow(FlowLogic):
             svc = getattr(
                 self.service_hub, "transaction_verifier_service", None
             )
-            if svc is not None and stx.sigs:
+            if (
+                svc is not None and stx.sigs
+                and os.environ.get("CORDA_TPU_NOTARY_BATCHED", "1") != "0"
+            ):
                 futs = svc.verify_signatures(stx.signature_check_items())
                 bad = yield self.await_blocking(
                     lambda: [

@@ -129,7 +129,7 @@ class CordaRPCOps:
     def state_machines_feed(self) -> DataFeed:
         snapshot = [
             StateMachineInfo(f.flow_id, f.flow.flow_name(), f.done)
-            for f in self._smm.flows.values()
+            for f in list(self._smm.flows.values())
             if not f.done
         ]
         return DataFeed(snapshot, self._state_machine_updates)
@@ -148,25 +148,36 @@ class CordaRPCOps:
         leaves a live server-side subscription behind, and the snapshot
         marshals the whole store."""
         limit = max(1, min(int(limit), 500))
-        return [
-            {
+        out = []
+        for stx in self._services.validated_transactions.latest(limit):
+            def _count(attr):
+                # NotaryChangeWireTransaction has no command list and its
+                # outputs property requires chain resolution — a summary
+                # row must degrade, not 500 the whole dashboard
+                try:
+                    v = getattr(stx.tx, attr, None)
+                    return len(v) if v is not None else None
+                except Exception:
+                    return None
+
+            out.append({
                 "id": stx.id.bytes.hex().upper(),
-                "inputs": len(stx.tx.inputs),
-                "outputs": len(stx.tx.outputs),
-                "commands": len(stx.tx.commands),
+                "type": type(stx.tx).__name__,
+                "inputs": _count("inputs"),
+                "outputs": _count("outputs"),
+                "commands": _count("commands"),
                 "signatures": len(stx.sigs),
                 "notary": stx.notary.name if stx.notary else None,
-            }
-            for stx in self._services.validated_transactions.latest(limit)
-        ]
+            })
+        return out
 
     def state_machines_snapshot(self) -> List:
         """In-flight flows as plain dicts; snapshot-only (see
         recent_transactions for why pollers avoid the feed)."""
         return [
             {"flow_id": f.flow_id, "flow_name": f.flow.flow_name()}
-            for f in self._smm.flows.values()
-            if not f.done
+            for f in list(self._smm.flows.values())  # copy: other
+            if not f.done                # threads insert concurrently
         ]
 
     def vault_query(self, contract_name: Optional[str] = None) -> List:

@@ -16,9 +16,10 @@ set of shapes and recompiles rarely.
 """
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from ..core.crypto import batch as crypto_batch
 from ..core.crypto.keys import PublicKey
@@ -27,9 +28,21 @@ Item = Tuple[PublicKey, bytes, bytes]  # (key, signature, content)
 
 
 class SignatureBatcher:
-    """Thread-safe accumulate-and-flush buffer over the batch verify path."""
+    """Thread-safe accumulate-and-flush buffer over the batch verify path.
 
-    def __init__(self, max_batch: int = 4096, linger_ms: float = 2.0):
+    Defaults are env-tunable (CORDA_TPU_BATCHER_MAX /
+    CORDA_TPU_BATCHER_LINGER_MS) so deployments can trade notarise
+    latency against batch size without code changes — node OS processes
+    inherit the environment from their launcher."""
+
+    def __init__(self, max_batch: Optional[int] = None,
+                 linger_ms: Optional[float] = None):
+        if max_batch is None:
+            max_batch = int(os.environ.get("CORDA_TPU_BATCHER_MAX", 4096))
+        if linger_ms is None:
+            linger_ms = float(
+                os.environ.get("CORDA_TPU_BATCHER_LINGER_MS", 2.0)
+            )
         self.max_batch = max_batch
         self.linger_ms = linger_ms
         self._lock = threading.Lock()

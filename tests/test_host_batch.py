@@ -194,3 +194,24 @@ def test_msm_rejects_unreduced_scalar_with_error_code():
     bad_scalar = (2**255 + 5).to_bytes(32, "little")
     scalars = bad_scalar + b"\x01".ljust(32, b"\x00") * 3
     assert native.ed25519_msm_is_small(pts, scalars, 4) == -2
+
+
+def test_tiny_batches_all_sizes_differential():
+    """The Straus/comb small-batch path (n <= 16) must agree with the
+    oracle for every size and every tamper position across the
+    Pippenger crossover."""
+    rng = np.random.default_rng(55)
+    seeds = [rng.bytes(32) for _ in range(4)]
+    pubs = [em.public_from_seed(s) for s in seeds]
+    for n in (1, 2, 3, 15, 16, 17):
+        rows = []
+        for i in range(n):
+            k = i % 4
+            m = rng.bytes(40)
+            rows.append((pubs[k], em.sign(seeds[k], m), m))
+        assert host_batch.verify_batch_host(rows) == [True] * n, n
+        bad = n // 2
+        p, s, m = rows[bad]
+        rows[bad] = (p, s, m + b"!")
+        out = host_batch.verify_batch_host(rows)
+        assert out == [i != bad for i in range(n)], (n, bad)

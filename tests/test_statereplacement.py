@@ -130,6 +130,20 @@ class TestNotaryChange(_Base):
         for node in (self.alice, self.bob):
             ts = node.services.load_state(new_ref.ref)
             assert ts.notary == self.notary_b.info
+        # The explorer summary endpoint must DEGRADE on the recorded
+        # notary-change tx (no command list; outputs need resolution),
+        # never crash the dashboard (review finding).
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        ops = CordaRPCOps(self.alice.services, self.alice.smm)
+        rows = ops.recent_transactions(limit=10)
+        kinds = {r["type"] for r in rows}
+        assert "NotaryChangeWireTransaction" in kinds
+        nc = next(
+            r for r in rows if r["type"] == "NotaryChangeWireTransaction"
+        )
+        assert nc["outputs"] is None and nc["commands"] is None
+        assert nc["signatures"] >= 2
         # The new state is usable: spend it with the NEW notary.
         builder = TransactionBuilder(notary=self.notary_b.info)
         builder.add_input_state(new_ref)
