@@ -81,22 +81,19 @@ def verify_batch_host(rows: Sequence[Row]) -> List[bool]:
 
 
 def _hashes_mod_l(rows: Sequence[Row], idx: List[int]) -> dict:
-    """row index -> SHA-512(R || A || M) mod L."""
+    """row index -> SHA-512(R || A || M) mod L, hashed in one batched
+    native pass (sha512_mod_l_many carries its own pure-Python fallback,
+    so no second fallback here)."""
     from ... import native
 
     msgs = []
     for i in idx:
         pub, sig, msg = rows[i]
         msgs.append(bytes(sig[:32]) + bytes(pub) + bytes(msg))
-    if native.available():
-        words = native.sha512_mod_l_many(msgs)  # (n, 8) uint32 LE
-        return {
-            i: int.from_bytes(words[j].tobytes(), "little")
-            for j, i in enumerate(idx)
-        }
+    words = native.sha512_mod_l_many(msgs)  # (n, 8) uint32 LE
     return {
-        i: int.from_bytes(hashlib.sha512(m).digest(), "little") % L
-        for i, m in zip(idx, msgs)
+        i: int.from_bytes(words[j].tobytes(), "little")
+        for j, i in enumerate(idx)
     }
 
 

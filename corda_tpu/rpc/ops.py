@@ -148,24 +148,25 @@ class CordaRPCOps:
         leaves a live server-side subscription behind, and the snapshot
         marshals the whole store."""
         limit = max(1, min(int(limit), 500))
+
+        def _count(tx, attr):
+            # NotaryChangeWireTransaction has no command list and its
+            # outputs property requires chain resolution — a summary
+            # row must degrade, not 500 the whole dashboard
+            try:
+                v = getattr(tx, attr, None)
+                return len(v) if v is not None else None
+            except Exception:
+                return None
+
         out = []
         for stx in self._services.validated_transactions.latest(limit):
-            def _count(attr):
-                # NotaryChangeWireTransaction has no command list and its
-                # outputs property requires chain resolution — a summary
-                # row must degrade, not 500 the whole dashboard
-                try:
-                    v = getattr(stx.tx, attr, None)
-                    return len(v) if v is not None else None
-                except Exception:
-                    return None
-
             out.append({
                 "id": stx.id.bytes.hex().upper(),
                 "type": type(stx.tx).__name__,
-                "inputs": _count("inputs"),
-                "outputs": _count("outputs"),
-                "commands": _count("commands"),
+                "inputs": _count(stx.tx, "inputs"),
+                "outputs": _count(stx.tx, "outputs"),
+                "commands": _count(stx.tx, "commands"),
                 "signatures": len(stx.sigs),
                 "notary": stx.notary.name if stx.notary else None,
             })
