@@ -215,3 +215,33 @@ def test_tiny_batches_all_sizes_differential():
         rows[bad] = (p, s, m + b"!")
         out = host_batch.verify_batch_host(rows)
         assert out == [i != bad for i in range(n)], (n, bad)
+
+
+def test_fuzz_differential_random_mutations():
+    """500 random single-bit/byte mutations across pub/sig/msg, verified
+    batch-wise against the per-signature OpenSSL oracle. Random
+    corruption never produces the crafted torsion signatures where the
+    cofactored rule legitimately diverges, so exact agreement is required
+    (deterministic seed)."""
+    rng = np.random.default_rng(2026)
+    seeds = [rng.bytes(32) for _ in range(6)]
+    pubs = [em.public_from_seed(s) for s in seeds]
+    rows = []
+    for i in range(125):
+        k = i % 6
+        m = rng.bytes(56)
+        rows.append([pubs[k], em.sign(seeds[k], m), m])
+    for _ in range(4):  # 4 passes x 125 rows = 500 mutations
+        mutated = []
+        for pub, sig, m in rows:
+            field = rng.integers(0, 3)
+            blob = bytearray((pub, sig, m)[field])
+            blob[rng.integers(0, len(blob))] ^= 1 << rng.integers(0, 8)
+            row = [pub, sig, m]
+            row[field] = bytes(blob)
+            mutated.append(tuple(row))
+        got = host_batch.verify_batch_host(mutated)
+        want = _oracle(mutated)
+        assert got == want, [
+            (i, g, w) for i, (g, w) in enumerate(zip(got, want)) if g != w
+        ]
