@@ -552,11 +552,21 @@ class NotaryServiceFlow(FlowLogic):
                 and os.environ.get("CORDA_TPU_NOTARY_BATCHED", "1") != "0"
             ):
                 futs = svc.verify_signatures(stx.signature_check_items())
-                bad = yield self.await_blocking(
-                    lambda: [
+                # deterministic single-pump networks (MockNetwork) run
+                # the await INLINE: nothing else can feed the batch while
+                # we block, so waiting out the linger is pure latency
+                inline = (
+                    not self.state_machine.smm.dispatches_blocking_off_pump
+                )
+
+                def _collect():
+                    if inline:
+                        svc.flush_signatures()
+                    return [
                         i for i, f in enumerate(futs) if not f.result(120)
                     ]
-                )
+
+                bad = yield self.await_blocking(_collect)
                 if bad:
                     raise NotaryException(
                         f"invalid signature(s) at positions {bad} on {stx.id}"

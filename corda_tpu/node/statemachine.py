@@ -245,7 +245,7 @@ class FlowStateMachine:
             self.replay_pos += 1
             return deserialize(blob)
         executor = self.smm._blocking_executor
-        if executor is None:
+        if not self.smm.dispatches_blocking_off_pump:
             # deterministic in-memory network: run inline (tests pump
             # synchronously; blocking the pump is harmless in-process)
             value = req.compute()
@@ -662,6 +662,16 @@ class StateMachineManager:
     @property
     def in_flight_count(self) -> int:
         return sum(1 for f in self.flows.values() if not f.done)
+
+    @property
+    def dispatches_blocking_off_pump(self) -> bool:
+        """Whether await_blocking computations run on an executor thread
+        (real async messaging) instead of inline on the pump
+        (deterministic in-memory networks). The single source of truth
+        for callers that adapt to the dispatch mode — e.g. the notary
+        flushes the signature batcher before blocking when inline,
+        because nothing else can feed the batch while the pump waits."""
+        return self._blocking_executor is not None
 
     def track(self, observer: Callable) -> None:
         """observer(event: str, fsm) on started/finished."""

@@ -50,6 +50,12 @@ class TransactionVerifierService:
         """Offload signature checks; each future resolves to bool."""
         raise NotImplementedError
 
+    def flush_signatures(self) -> None:
+        """Force any buffered signature checks to run now. Callers that
+        are about to BLOCK on their futures in a context where no other
+        producer can feed the batch (deterministic single-pump networks)
+        use this to skip the batcher's linger wait; a no-op by default."""
+
 
 class InMemoryTransactionVerifierService(TransactionVerifierService):
     """Worker pool in the node process; signature checks go through a local
@@ -73,6 +79,9 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
 
     def verify_signatures(self, items: Sequence[Item]) -> List[Future]:
         return self._batcher.submit_many(items)
+
+    def flush_signatures(self) -> None:
+        self._batcher.flush()
 
     def stop(self) -> None:
         self._batcher.close()
