@@ -252,8 +252,14 @@ def main() -> None:
                 "vs_baseline": round(res["value"] / PER_CHIP_BASELINE, 4),
                 "batch": res.get("batch"),
                 "backend": "tpu",
-                "end_to_end": res.get("end_to_end", True),
-                "provenance": {"live": False, **prov},
+                # a replayed number carries the ORIGINAL measurement's
+                # semantics (r4 VERDICT weak #2): nothing is upgraded in
+                # replay. r01's bench measured the kernel alone, so a
+                # record without an explicit end_to_end stays False here.
+                "end_to_end": bool(res.get("end_to_end", False)),
+                # the source record rides verbatim so the replay can
+                # never misdescribe what was measured
+                "provenance": {"live": False, **prov, "source_record": res},
                 "cpu_dispatch_sigs_s": round(rate, 1),
                 "cpu_dispatch_batch": batch,
                 "cpu_dispatch_path": cpu_path,

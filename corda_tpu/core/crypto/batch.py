@@ -92,6 +92,44 @@ def _use_device_kernels() -> bool:
     return _backend() in _ACCEL_BACKENDS
 
 
+# The ed25519 ACCEPTANCE RULE is pinned at the first dispatch and never
+# changes for the process lifetime, even if the engine choice flips later
+# (e.g. a mesh failure latching _mesh_failed_once turns _use_device_kernels
+# False mid-process on a CPU backend). Device kernels + the OpenSSL loop
+# verify cofactorless; the native MSM verifies cofactored (ZIP-215). A
+# rule that flipped with the engine would accept/reject adversarial
+# torsion-component signatures depending on WHEN a fallback happened —
+# the replica-splitting hazard the per-deployment rule exists to prevent.
+_pinned_rule: str | None = None  # "cofactorless" | "cofactored"
+_RULE_LOCK = threading.Lock()
+
+
+def _ed25519_rule() -> str:
+    global _pinned_rule
+    if _pinned_rule is None:
+        # locked: verify_batch runs concurrently (batcher linger timer +
+        # direct callers) and two racing first dispatches must not pin
+        # different rules — the split this latch exists to prevent
+        with _RULE_LOCK:
+            if _pinned_rule is None:
+                if _use_device_kernels():
+                    _pinned_rule = "cofactorless"
+                else:
+                    # the cofactored rule needs the native MSM engine: a
+                    # replica whose extension failed to build (or with
+                    # CORDA_TPU_HOST_BATCH=0) verifies through the
+                    # OpenSSL loop, so its REAL rule is cofactorless —
+                    # pinning "cofactored" here would misdescribe it and
+                    # hide a rule split from its peers
+                    from . import host_batch
+
+                    _pinned_rule = (
+                        "cofactored" if host_batch.available()
+                        else "cofactorless"
+                    )
+    return _pinned_rule
+
+
 def _host_verify_rows(items, idx, results) -> None:
     """Verify `idx` rows of `items` on the host path, in parallel when the
     bucket and the machine are big enough to amortise thread handoff."""
@@ -219,27 +257,46 @@ def _verify_flat(
     n = len(items)
     results: List[bool] = [False] * n
     use_device = _use_device_kernels()
+    rule = _ed25519_rule()  # pinned for the process on first dispatch
+    # the device kernels are cofactorless: a process pinned to the
+    # cofactored rule (it started host-side) must keep ed25519 off them
+    # even if the engine choice later flips to device
+    ed_device = use_device and rule == "cofactorless"
     buckets: dict = {}  # kernel key -> [indices]
     host_rows: List[int] = []
     ed_host: List[int] = []  # ed25519 rows for the native MSM batch path
     for i, (key, sig, content) in enumerate(items):
         name = key.scheme_code_name
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
-        if use_device and not _is_composite(key) and (
-            is_ed or name in _ECDSA_CURVES
+        if not _is_composite(key) and (
+            (is_ed and ed_device) or (not is_ed and use_device
+                                      and name in _ECDSA_CURVES)
         ):
             buckets.setdefault(name, []).append(i)
         elif is_ed and not _is_composite(key):
-            ed_host.append(i)
+            if rule == "cofactored":
+                ed_host.append(i)  # native MSM, ZIP-215
+            else:
+                host_rows.append(i)  # OpenSSL loop, cofactorless
         else:
             host_rows.append(i)
 
     for name, idx in buckets.items():
         if len(idx) < MIN_DEVICE_BATCH:
-            if name == EDDSA_ED25519_SHA512.scheme_code_name:
-                ed_host.extend(idx)
-            else:
-                host_rows.extend(idx)
+            # Undersized buckets on an accelerator deployment go to the
+            # per-signature OpenSSL loop (host_rows), NOT the native MSM:
+            # the device kernels verify cofactorless ([s]B == R + [h]A,
+            # like OpenSSL) while the MSM verifies cofactored (ZIP-215).
+            # The acceptance rule must be a DEPLOYMENT property — one
+            # rule per deployment, never a batch-size accident — or an
+            # adversarial torsion-component signature would verify or
+            # fail depending on how the batcher happened to group it,
+            # splitting notary replicas. CPU deployments (use_device
+            # False) route every ed25519 row to the MSM, so they are
+            # uniformly cofactored; accelerator deployments are
+            # uniformly cofactorless. Mixed CPU/accelerator clusters
+            # must pin CORDA_TPU_DISPATCH cluster-wide (docs/perf-host.md).
+            host_rows.extend(idx)
             continue
         from ... import ops
 
@@ -291,13 +348,17 @@ def _verify_flat(
         if host_batch.available():
             # ONE Pippenger multi-scalar multiplication for the whole
             # bucket (~7x the per-signature OpenSSL loop at >= 1k).
-            # Used for EVERY bucket size: the verification rule
-            # (cofactored) must be a deployment property, not a
-            # batch-size accident — a rule that flips at a size
-            # threshold would let an adversarial torsion signature
-            # split replicas whose batchers grouped it differently
-            # (n=1 costs 217us vs OpenSSL's 139us; n>=2 is at parity
-            # or faster, so uniformity is nearly free)
+            # ed_host is populated ONLY on CPU deployments (use_device
+            # False routes every non-composite ed25519 row here), so the
+            # cofactored ZIP-215 rule applies to EVERY bucket size on
+            # such a deployment — the verification rule is a deployment
+            # property, never a batch-size accident (a rule that flips
+            # at a size threshold would let an adversarial torsion
+            # signature split replicas whose batchers grouped it
+            # differently; n=1 costs 217us vs OpenSSL's 139us, so
+            # uniformity is nearly free). Accelerator deployments use
+            # the cofactorless rule at every size instead (device
+            # kernels + OpenSSL loop for undersized buckets).
             rows = [
                 (items[i][0].encoded, items[i][1], items[i][2])
                 for i in ed_host

@@ -125,6 +125,16 @@ class BFTReplica:
                 self.last_executed = int(meta["last_executed"])
                 self.view = int(meta["view"])
                 self.stable_seq = int(meta.get("stable_seq", -1))
+                # digest + cert ride along so a restarted replica holds a
+                # USABLE stable checkpoint (digest comparison in
+                # _record_checkpoint, future checkpoint proofs), not a
+                # bare seq with empty evidence
+                self.stable_digest = bytes(meta.get("stable_digest", b""))
+                self.stable_cert = {
+                    int(k): v
+                    for k, v in dict(meta.get("stable_cert", {})).items()
+                }
+                self._diverged = bool(meta.get("diverged", False))
                 # a restarted PRIMARY must not reassign sequence numbers
                 # its peers already hold pre-prepares for (the
                 # equivocation guard would stall every request for a
@@ -136,8 +146,16 @@ class BFTReplica:
         # certificate; every log structure is truncated at/below it
         if not hasattr(self, "stable_seq"):
             self.stable_seq = -1
-        self.stable_digest = b""
-        self.stable_cert: Dict[int, bytes] = {}  # voter -> checkpoint sig
+        if not hasattr(self, "stable_digest"):
+            self.stable_digest = b""
+        if not hasattr(self, "stable_cert"):
+            self.stable_cert: Dict[int, bytes] = {}  # voter -> checkpoint sig
+        # our OWN checkpoint digests by seq (GC'd below stable): compared
+        # against certified digests so a silently corrupted replica
+        # detects its divergence instead of executing on wrong state
+        self._own_ckpt_digests: Dict[int, bytes] = {}
+        if not hasattr(self, "_diverged"):
+            self._diverged = False
         # (seq, state digest) -> {voter: signature}
         self.checkpoint_votes: Dict[Tuple[int, bytes], Dict[int, bytes]] = {}
         # seq -> state
@@ -350,6 +368,8 @@ class BFTReplica:
             self._execute_ready()
 
     def _execute_ready(self) -> None:
+        if self._diverged:
+            return  # corrupt local state: no execution until re-synced
         while self.last_executed + 1 in self.committed:
             seq = self.last_executed + 1
             d = self.committed[seq]
@@ -402,6 +422,7 @@ class BFTReplica:
         self._broadcast({
             "kind": "checkpoint", "seq": seq, "digest": d, "csig": sig,
         })
+        self._own_ckpt_digests[seq] = d
         self._record_checkpoint(seq, d, self.id, sig)
 
     def _verify_checkpoint_sig(
@@ -412,8 +433,13 @@ class BFTReplica:
         )
 
     def _on_checkpoint(self, sender: int, msg: dict) -> None:
-        seq, d = msg["seq"], msg["digest"]
+        seq, d = msg.get("seq"), msg.get("digest")
         if not isinstance(seq, int) or seq <= self.stable_seq:
+            return
+        # a Byzantine non-bytes digest would otherwise raise inside
+        # serialize() (before the sig check) or as a dict key, and the
+        # exception would escape on_message into the cluster message pump
+        if not isinstance(d, bytes) or len(d) != 32:
             return
         if seq > self.last_executed + self.MAX_INFLIGHT:
             return  # vote spray from a faulty peer: cap state growth
@@ -444,6 +470,29 @@ class BFTReplica:
             logger.debug(
                 "%s: stable checkpoint at seq %d, log truncated", self.id, seq
             )
+            # divergence detection: 2f+1 replicas certified a digest for a
+            # seq we already executed — if OUR snapshot at that seq says
+            # otherwise, our local state is silently corrupt (disk rot,
+            # bad restore). Executing further compounds the damage; halt
+            # execution and re-sync via state transfer instead.
+            own = self._own_ckpt_digests.get(seq)
+            if own is not None and own != d and self.last_executed >= seq:
+                # halt REGARDLESS of restore_fn: a halted replica is a
+                # crashed one (the cluster tolerates f of those); a
+                # corrupt replica signing wrong verdicts is worse
+                logger.error(
+                    "%s: LOCAL STATE DIVERGED at seq %d (own digest %s != "
+                    "certified %s) — halting execution%s",
+                    self.id, seq, own.hex()[:16], d.hex()[:16],
+                    ", requesting state" if self.restore_fn else
+                    " (no restore_fn: manual recovery required)",
+                )
+                self._diverged = True
+                self._save_meta()  # the halt must survive a crash
+                if self.restore_fn is not None:
+                    self._state_resps.clear()
+                    self._broadcast({"kind": "state_req", "have": -1})
+                return
             if self.stable_seq > self.last_executed:
                 # the cluster certified state BEYOND our execution, and the
                 # GC above just discarded the committed/missing-body
@@ -474,6 +523,10 @@ class BFTReplica:
         self.executed = {s for s in self.executed if s > n}
         for key in [k for k in self.checkpoint_votes if k[0] <= n]:
             del self.checkpoint_votes[key]
+        # keep the boundary digest (s == n): the divergence check in
+        # _record_checkpoint compares it right after this GC runs
+        for seq in [s for s in self._own_ckpt_digests if s < n]:
+            del self._own_ckpt_digests[seq]
 
     # -- durable meta + catch-up state transfer -------------------------------
 
@@ -482,6 +535,12 @@ class BFTReplica:
             self._meta.put(b"bft_meta", serialize({
                 "last_executed": self.last_executed, "view": self.view,
                 "next_seq": self.next_seq, "stable_seq": self.stable_seq,
+                "stable_digest": self.stable_digest,
+                "stable_cert": self.stable_cert,
+                # the divergence halt must survive a crash: a restart on
+                # corrupt state with the flag lost would execute and sign
+                # client verdicts until the NEXT checkpoint re-detected it
+                "diverged": self._diverged,
             }))
 
     #: a gap between last_executed and higher committed seqs that persists
@@ -510,7 +569,10 @@ class BFTReplica:
         # lag evidence (the commit evidence below it was GC'd): keep the
         # timer armed in case the immediate state_req was lost
         behind_ckpt = self.stable_seq > self.last_executed
-        lagging = missing_seq or missing_body or behind_view or behind_ckpt
+        lagging = (
+            missing_seq or missing_body or behind_view or behind_ckpt
+            or self._diverged
+        )
         if not lagging:
             self._gap_since = None
             return
@@ -521,12 +583,20 @@ class BFTReplica:
             return
         self._gap_since = self._now  # rate-limit re-requests
         self._state_resps.clear()
-        self._broadcast({"kind": "state_req", "have": self.last_executed})
+        self._broadcast({
+            "kind": "state_req",
+            # diverged: our execution point is untrusted — ask for
+            # everything so every peer responds
+            "have": -1 if self._diverged else self.last_executed,
+        })
 
     def _on_state_req(self, sender: int, msg: dict) -> None:
         if self.snapshot_fn is None:
             return
-        if int(msg.get("have", -1)) >= self.last_executed:
+        have = msg.get("have", -1)
+        if not isinstance(have, int):
+            return  # malformed (Byzantine) — must not raise out of pump
+        if have >= self.last_executed:
             return  # requester is not behind us
         # a faulty peer looping state_req must not make us serialize the
         # whole uniqueness map per message (O(ledger) amplification) —
@@ -552,13 +622,27 @@ class BFTReplica:
         equivalent of BFT-SMaRt's state-transfer quorum)."""
         if self.restore_fn is None:
             return
-        n = int(msg["last_executed"])
-        if n <= self.last_executed:
+        # type-validate before use: a Byzantine state_resp (and the
+        # diverged-recovery flow actively solicits one from EVERY peer)
+        # must not raise out of on_message into the message pump
+        n = msg.get("last_executed")
+        dump = msg.get("dump")
+        digest = msg.get("digest")
+        view = msg.get("view")
+        if (
+            not isinstance(n, int) or not isinstance(view, int)
+            or not isinstance(dump, bytes)
+            or not isinstance(digest, bytes) or len(digest) != 32
+        ):
             return
-        dump = msg["dump"]
-        if hashlib.sha256(dump).digest() != msg["digest"]:
+        # a DIVERGED replica accepts certified state even at or below its
+        # own (untrusted) execution point — its last_executed was reached
+        # on corrupt state and proves nothing
+        if n <= self.last_executed and not self._diverged:
+            return
+        if hashlib.sha256(dump).digest() != digest:
             return  # dump does not match its claimed digest
-        self._state_resps[sender] = (n, msg["digest"], dump, int(msg["view"]))
+        self._state_resps[sender] = (n, digest, dump, view)
         # group by (n, digest, view): the VIEW must be part of the f+1
         # agreement — taking it from an arbitrary responder would let one
         # Byzantine member wedge the recovering replica on a bogus view
@@ -566,17 +650,36 @@ class BFTReplica:
         for rid, (rn, rd, rdump, rview) in self._state_resps.items():
             groups.setdefault((rn, rd, rview), []).append((rid, rdump))
         for (rn, _rd, rview), members in groups.items():
-            if rn > self.last_executed and len(members) >= self.f + 1:
+            acceptable = rn > self.last_executed or (
+                self._diverged and rn >= self.stable_seq
+            )
+            if acceptable and len(members) >= self.f + 1:
                 _rid, rdump = members[0]
                 self.restore_fn(rdump)
+                if self._diverged:
+                    # seqs "executed" on the corrupt state must re-execute
+                    # on the restored one; anything whose body was GC'd
+                    # re-fetches via the normal gap path
+                    self.executed = {s for s in self.executed if s <= rn}
+                    self._diverged = False
                 self.last_executed = rn
                 self.next_seq = max(self.next_seq, rn + 1)
                 self.view = max(self.view, rview)
                 # the installed snapshot is f+1-agreed: treat it as our
-                # stable checkpoint and truncate the log below it
-                self.stable_seq = max(self.stable_seq, rn)
-                self.stable_digest = _rd
-                self.stable_cert = {}
+                # stable checkpoint and truncate the log below it. Only
+                # overwrite the digest/cert when the snapshot is AT or
+                # ABOVE the current stable seq — pairing a higher
+                # certified seq with a lower snapshot's digest would
+                # persist a checkpoint whose digest belongs to a
+                # different seq (review finding r5)
+                if rn >= self.stable_seq:
+                    # keep a genuine 2f+1 cert when the snapshot merely
+                    # re-confirms the existing stable point — wiping it
+                    # would persist a checkpoint with no evidence
+                    if rn > self.stable_seq or _rd != self.stable_digest:
+                        self.stable_digest = _rd
+                        self.stable_cert = {}
+                    self.stable_seq = rn
                 self._gc_log(self.stable_seq)
                 self._save_meta()
                 self._state_resps.clear()

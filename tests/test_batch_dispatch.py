@@ -216,3 +216,102 @@ def test_host_thread_pool_path(monkeypatch):
     items = _items([EDDSA_ED25519_SHA512] * 24, tamper_idx={0, 7, 23})
     out = crypto_batch.verify_batch(items)
     assert out == [i not in {0, 7, 23} for i in range(24)]
+
+
+def test_undersized_ed25519_bucket_on_device_avoids_cofactored_msm(monkeypatch):
+    """Advisor (r4, high): the verification rule must be ONE rule per
+    deployment. Device deployments verify cofactorless (device kernels +
+    OpenSSL loop); routing an undersized ed25519 bucket to the cofactored
+    native MSM would make acceptance of a torsion-component signature
+    depend on how the batcher grouped it — splitting notary replicas."""
+    from corda_tpu.core.crypto import host_batch
+
+    def msm_boom(*a, **k):  # the cofactored path must NOT run
+        raise AssertionError(
+            "cofactored MSM used on a device deployment (rule split)"
+        )
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "device")
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 32)
+    monkeypatch.setattr(host_batch, "verify_batch_host", msm_boom)
+    items = _items([EDDSA_ED25519_SHA512] * 5, tamper_idx={3})
+    out = crypto_batch.verify_batch(items)
+    assert out == [True, True, True, False, True]
+
+
+def test_cpu_deployment_routes_every_ed25519_size_to_msm(monkeypatch):
+    """The complementary invariant: CPU deployments apply the cofactored
+    ZIP-215 rule at EVERY bucket size (the MSM handles n=1 through n=4k),
+    so no size threshold flips the rule there either."""
+    from corda_tpu.core.crypto import host_batch
+
+    if not host_batch.available():
+        pytest.skip("native MSM extension unavailable")
+    calls = []
+    real = host_batch.verify_batch_host
+
+    def spy(rows):
+        calls.append(len(rows))
+        return real(rows)
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "host")
+    monkeypatch.setattr(host_batch, "verify_batch_host", spy)
+    for n in (1, 2, 5):
+        items = _items([EDDSA_ED25519_SHA512] * n)
+        assert crypto_batch.verify_batch(items) == [True] * n
+    assert calls == [1, 2, 5]
+
+
+def test_rule_stays_pinned_across_mesh_failure(monkeypatch):
+    """Code-review finding (r5): on a CPU backend with a configured mesh,
+    the first mesh failure latches _mesh_failed_once and flips
+    _use_device_kernels() False mid-process. The ACCEPTANCE RULE must not
+    flip with the engine: a process that started cofactorless must route
+    later ed25519 rows to the cofactorless OpenSSL loop, never to the
+    cofactored MSM."""
+    from corda_tpu.core.crypto import host_batch
+    from corda_tpu.parallel import mesh as mesh_mod
+
+    def msm_boom(*a, **k):
+        raise AssertionError("cofactored MSM after a cofactorless pin")
+
+    def mesh_boom(*a, **k):
+        raise RuntimeError("mesh lowering failed")
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "auto")
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", "cpu")
+    monkeypatch.setattr(crypto_batch, "_MESH", object())
+    monkeypatch.setattr(crypto_batch, "_mesh_failed_once", False)
+    monkeypatch.setattr(crypto_batch, "_pinned_rule", None)
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 4)
+    monkeypatch.setattr(crypto_batch, "MESH_MIN_BATCH", 4)
+    monkeypatch.setattr(mesh_mod, "shard_verify", mesh_boom)
+    monkeypatch.setattr(host_batch, "verify_batch_host", msm_boom)
+
+    items = _items([EDDSA_ED25519_SHA512] * 5, tamper_idx={1})
+    # first dispatch: mesh configured -> pin cofactorless; the mesh path
+    # throws, latches _mesh_failed_once, falls back to single-device
+    out = crypto_batch.verify_batch(items)
+    assert out == [True, False, True, True, True]
+    assert crypto_batch._mesh_failed_once
+    assert crypto_batch._pinned_rule == "cofactorless"
+    # second dispatch: engine flipped to host — the rule must not; the
+    # MSM boom above fails the test if the cofactored path runs
+    out2 = crypto_batch.verify_batch(items)
+    assert out2 == [True, False, True, True, True]
+
+
+def test_pin_reflects_engine_availability(monkeypatch):
+    """A replica whose native MSM is unavailable (failed build or
+    CORDA_TPU_HOST_BATCH=0) verifies through the cofactorless OpenSSL
+    loop — its pin must say so, not claim 'cofactored'."""
+    from corda_tpu.core.crypto import host_batch
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "host")
+    monkeypatch.setattr(crypto_batch, "_pinned_rule", None)
+    monkeypatch.setattr(host_batch, "available", lambda: False)
+    assert crypto_batch._ed25519_rule() == "cofactorless"
+
+    monkeypatch.setattr(crypto_batch, "_pinned_rule", None)
+    monkeypatch.setattr(host_batch, "available", lambda: True)
+    assert crypto_batch._ed25519_rule() == "cofactored"

@@ -1,0 +1,81 @@
+"""Replayed bench headlines must carry the ORIGINAL measurement's
+semantics (r4 VERDICT weak #2): BENCH_r04 stamped `end_to_end: true`
+onto r01's kernel-only figure. These tests pin the replay contract
+without running the (slow) bench itself."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_best_tpu_capture_returns_source_record():
+    cap = bench._best_tpu_capture()
+    if cap is None:
+        pytest.skip("no TPU datapoint anywhere in the repo")
+    res, prov = cap
+    assert res.get("backend") == "tpu"
+    assert "value" in res
+    assert "source" in prov
+
+
+def test_replay_does_not_upgrade_semantics():
+    """Drive bench.py's replay branch logic directly: a source record
+    WITHOUT an explicit end_to_end must replay as end_to_end False, and
+    the provenance block must reproduce the source record verbatim."""
+    # the exact shape BENCH_r01.json's parsed record has (kernel-only run)
+    res = {
+        "metric": "ed25519-sig-verifies/sec/chip",
+        "value": 26899.0,
+        "unit": "sigs/s",
+        "vs_baseline": 0.1076,
+        "batch": 16384,
+        "backend": "tpu",
+    }
+    # reproduce the replay-branch field derivation (bench.main else-arm)
+    end_to_end = bool(res.get("end_to_end", False))
+    provenance = {"live": False, "source": "BENCH_r01.json",
+                  "source_record": res}
+    assert end_to_end is False
+    assert provenance["source_record"] == res
+
+
+def test_bench_replay_branch_source_matches_headline():
+    """The real invariant, checked against bench.py's source: the replay
+    arm must not contain an optimistic end_to_end default and must embed
+    source_record. A regression reintroducing `res.get("end_to_end",
+    True)` fails here."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert 'res.get("end_to_end", True)' not in src
+    assert '"source_record": res' in src
+
+
+@pytest.mark.heavy
+def test_bench_cpu_replay_end_to_end_matches_source():
+    """Full-process check (heavy tier): run bench.py forced to the CPU
+    arm with secondaries skipped; if it replays a capture, the top-level
+    semantics must match the embedded source record."""
+    env = dict(os.environ)
+    env["CORDA_TPU_BENCH_FORCE_CPU"] = "1"
+    env["CORDA_TPU_BENCH_HEADLINE_ONLY"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    line = next(
+        (ln for ln in out.stdout.splitlines() if ln.startswith("{")), None)
+    assert line, out.stdout[-500:] + out.stderr[-500:]
+    rec = json.loads(line)
+    prov = rec.get("provenance", {})
+    if prov.get("live", True):
+        pytest.skip("live run, not a replay")
+    src = prov["source_record"]
+    assert rec["value"] == src["value"]
+    assert rec["end_to_end"] == bool(src.get("end_to_end", False))

@@ -576,3 +576,171 @@ class TestByzantineBehaviors:
         c.pump()
         assert c.replicas[1].pre_prepares.get(0) is None
         assert not c.applied[1]
+
+class TestCheckpointHardening:
+    """Round-4 advisor findings: malformed checkpoint digests must not
+    escape on_message; a silently corrupted replica must DETECT the
+    divergence at the next stable checkpoint and re-sync instead of
+    executing on wrong state; the stable checkpoint's digest+cert must
+    survive a restart alongside its seq."""
+
+    def test_nonbytes_checkpoint_digest_rejected(self, monkeypatch):
+        """A Byzantine peer sending a non-bytes digest previously raised
+        inside serialize() (before the sig check) or as an unhashable
+        dict key, escaping on_message into the message pump."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 1000)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        victim = c.replicas[0]
+        for bad in ({"x": 1}, "not-bytes", 7, None, b"short", b"\x11" * 33):
+            victim.on_message(1, serialize({
+                "kind": "checkpoint", "seq": 3, "digest": bad,
+                "csig": b"\x00" * 64,
+            }))  # must not raise
+        # a missing seq key must be dropped too, not raise KeyError
+        victim.on_message(1, serialize({
+            "kind": "checkpoint", "digest": b"\x11" * 32,
+            "csig": b"\x00" * 64,
+        }))
+        assert victim.checkpoint_votes == {}
+
+    def test_diverged_replica_detects_and_resyncs(self, monkeypatch):
+        """Corrupt replica 3's uniqueness map mid-run. At the next stable
+        checkpoint its own digest disagrees with the 2f+1-certified one:
+        it must halt execution, fetch f+1-agreed state, and converge."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        for k in range(3):
+            f = c.client.submit({"entries": {f"k{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+        # silent corruption (disk rot / bad restore) on replica 3
+        c.uniqueness[3]["k0"] = "CORRUPT"
+        for k in range(3, 8):
+            f = c.client.submit({"entries": {f"k{k}": f"t{k}"}})
+            c.pump()
+            assert f.result(timeout=0) == {"conflicts": {}}
+        # the seq-4 checkpoint certified the honest digest; replica 3's
+        # own digest differed -> divergence detected -> state transfer
+        assert c.uniqueness[3] == c.uniqueness[0]
+        assert c.uniqueness[3].get("k0") == "t0"
+        r3 = c.replicas[3]
+        assert not r3._diverged
+        # and it keeps executing new traffic on the healed state
+        f = c.client.submit({"entries": {"post": "tp"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        assert c.uniqueness[3].get("post") == "tp"
+
+    def test_diverged_replica_halts_execution_until_resync(self, monkeypatch):
+        """Between detection and snapshot install the replica must not
+        apply further commands on the corrupt state."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 2)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        r3 = c.replicas[3]
+        r3._diverged = True  # as _record_checkpoint sets on mismatch
+        applied_before = len(c.applied[3])
+        # traffic flows for the cluster but replica 3 must not execute
+        f = c.client.submit({"entries": {"b": "t1"}})
+        # drain only replica messages, skipping state transfer responses
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}  # quorum of 0,1,2
+        assert len(c.applied[3]) == applied_before
+
+    def test_restart_restores_stable_digest_and_cert(self, monkeypatch):
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        for k in range(6):
+            f = c.client.submit({"entries": {f"r{k}": f"t{k}"}})
+            c.pump()
+            f.result(timeout=0)
+        r2 = c.replicas[2]
+        assert r2.stable_seq == 4
+        digest, cert = r2.stable_digest, dict(r2.stable_cert)
+        assert len(digest) == 32 and len(cert) >= 3
+        c.restart(2)
+        assert c.replicas[2].stable_seq == 4
+        assert c.replicas[2].stable_digest == digest
+        assert c.replicas[2].stable_cert == cert
+
+    def test_diverged_halt_survives_restart(self, monkeypatch):
+        """Review finding (r5): the divergence halt must be durable — a
+        crash+restart between detection and re-sync must come back
+        halted, not executing on the corrupt state."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 1000)
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        r3 = c.replicas[3]
+        r3._diverged = True
+        r3._save_meta()
+        c.restart(3)  # fresh instance over the same durable meta
+        r3 = c.replicas[3]
+        assert r3._diverged
+        applied_before = len(c.applied[3])
+        f = c.client.submit({"entries": {"b": "t1"}})
+        c.pump()
+        assert f.result(timeout=0) == {"conflicts": {}}
+        assert len(c.applied[3]) == applied_before
+
+    def test_malformed_state_messages_do_not_raise(self, monkeypatch):
+        """Byzantine state_req/state_resp with wrong-typed fields must be
+        dropped, not raise out of on_message (the diverged-recovery flow
+        actively solicits state_resp from every peer)."""
+        c = BFTCluster(4)
+        f = c.client.submit({"entries": {"a": "t0"}})
+        c.pump()
+        f.result(timeout=0)
+        victim = c.replicas[0]
+        for bad in (
+            {"kind": "state_resp", "last_executed": "five", "view": 0,
+             "digest": b"\x00" * 32, "dump": b"x"},
+            {"kind": "state_resp", "last_executed": 5, "view": 0,
+             "digest": b"short", "dump": b"x"},
+            {"kind": "state_resp", "last_executed": 5, "view": 0,
+             "digest": b"\x00" * 32, "dump": "not-bytes"},
+            {"kind": "state_resp", "last_executed": 5, "view": "zero",
+             "digest": b"\x00" * 32, "dump": b"x"},
+            {"kind": "state_resp"},
+            {"kind": "state_req", "have": "nope"},
+            {"kind": "state_req"},
+        ):
+            victim.on_message(3, serialize(bad))  # must not raise
+        assert victim.last_executed == 0  # nothing was installed
+
+    def test_snapshot_at_stable_seq_keeps_cert(self, monkeypatch):
+        """A snapshot install that merely re-confirms the existing stable
+        point must not wipe the genuine 2f+1 cert (review finding r5)."""
+        monkeypatch.setattr(BFTReplica, "CHECKPOINT_INTERVAL", 4)
+        c = BFTCluster(4)
+        for k in range(6):
+            f = c.client.submit({"entries": {f"c{k}": f"t{k}"}})
+            c.pump()
+            f.result(timeout=0)
+        r0 = c.replicas[0]
+        assert r0.stable_seq == 4 and len(r0.stable_cert) >= 3
+        cert = dict(r0.stable_cert)
+        digest_before = r0.stable_digest
+        # fake a diverged recovery that lands exactly on the stable
+        # point: a dump whose digest REPRODUCES the stable digest (the
+        # state as of seq 4 — keys c0..c4, serialized canonically)
+        dump = serialize({f"c{k}": f"t{k}" for k in range(5)})
+        import hashlib as _h
+        assert _h.sha256(dump).digest() == digest_before  # test premise
+        r0._diverged = True
+        r0.last_executed = 4
+        for sender in (1, 2):
+            r0.on_message(sender, serialize({
+                "kind": "state_resp", "last_executed": 4, "view": 0,
+                "digest": digest_before, "dump": dump,
+            }))
+        assert not r0._diverged  # recovery completed
+        assert r0.stable_seq == 4
+        assert r0.stable_digest == digest_before
+        assert r0.stable_cert == cert  # the 2f+1 evidence survived

@@ -13,6 +13,7 @@ Run:  mkdir -p tpu_capture && \
       nohup python tools/hw_capture.py > tpu_capture/daemon.out 2>&1 &
 Stop: touch tpu_capture/STOP
 """
+import hashlib
 import json
 import os
 import subprocess
@@ -26,7 +27,50 @@ LOG = os.path.join(CAPDIR, "log.jsonl")
 STOP = os.path.join(CAPDIR, "STOP")
 
 sys.path.insert(0, REPO)
-from bench import PROBE_SNIPPET as PROBE  # noqa: E402  (shared liveness criteria)
+from bench import PROBE_SNIPPET  # noqa: E402  (shared liveness criteria)
+
+# ---------------------------------------------------------------------------
+# Tiered liveness probes.  Three variants, cheapest first, each run in its
+# own subprocess so a hang cannot poison the daemon.  Every variant arms
+# faulthandler.dump_traceback_later a few seconds BEFORE the parent's
+# timeout: on a hang the subprocess dumps the stack of every thread to
+# stderr and self-exits, so the round artifact shows WHERE init hangs
+# (libtpu init vs first transfer vs first compile) instead of a bare
+# "probe hang".  Tiering means a revival is detected at the cheapest
+# level: enum alone passing (but dput/jit hanging) is itself a diagnosis.
+# ---------------------------------------------------------------------------
+
+
+def _armed(body: str, timeout: int) -> str:
+    return (
+        "import faulthandler, sys\n"
+        f"faulthandler.dump_traceback_later({max(timeout - 4, 3)}, exit=True, "
+        "file=sys.stderr)\n" + body
+    )
+
+
+PROBE_VARIANTS = [
+    # Bare client init + device enumeration: no data transfer, no compile.
+    ("enum", 40, (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print('PROBE-OK platform=' + d[0].platform + ' n=' + str(len(d)))\n"
+    )),
+    # One-element host->device transfer and readback: exercises the data
+    # plane but not the compiler.
+    ("dput", 40, (
+        "import jax, jax.numpy as jnp\n"
+        "x = jax.device_put(jnp.ones((1,), dtype=jnp.uint32))\n"
+        "assert int(x[0]) == 1\n"
+        "print('PROBE-OK platform=' + x.devices().pop().platform)\n"
+    )),
+    # Tiny jit: first real compile + dispatch. Derived from bench.py's
+    # OWN probe so the daemon's liveness bar can never drift from the
+    # bar bench applies when the capture step actually runs.
+    ("jit", 75, PROBE_SNIPPET + (
+        "print('PROBE-OK platform=' + d[0].platform)\n"
+    )),
+]
 
 ECDSA_SMOKE = """
 import time
@@ -165,17 +209,89 @@ def save_state(st):
     os.replace(tmp, STATE)
 
 
-def probe(timeout=45):
+_last_stack_hash: dict[str, str] = {}
+_last_failed: set[str] = set()  # tiers that failed on the previous loop
+_healthy = False  # last full probe ladder passed
+
+
+def _hang_stack(stderr: str) -> tuple[str, str]:
+    """Extract the faulthandler dump (if any) and a stable signature.
+
+    The signature hashes only the code locations (file:line), not thread
+    ids or addresses, so "same hang as before" dedups across runs.
+    """
+    idx = stderr.find("Timeout (")
+    dump = stderr[idx:] if idx >= 0 else stderr
+    lines = [ln.strip() for ln in dump.splitlines()
+             if ln.strip().startswith('File "')]
+    sig = hashlib.sha256("\n".join(lines).encode()).hexdigest()[:10]
+    return dump[-3000:], sig
+
+
+def probe_variant(name, timeout, body):
+    """Run one probe tier; return a log record with hang diagnostics."""
+    rec = {"step": "probe-" + name}
+    t0 = time.time()
     try:
         out = subprocess.run(
-            [sys.executable, "-c", PROBE], capture_output=True, text=True,
-            timeout=timeout, env=bench_env(),
+            [sys.executable, "-c", _armed(body, timeout)],
+            capture_output=True, text=True, timeout=timeout, env=bench_env(),
         )
-    except subprocess.TimeoutExpired:
-        return False, "probe hang"
-    if "PLATFORM=tpu" in out.stdout:
-        return True, None
-    return False, (out.stderr or out.stdout)[-200:]
+    except subprocess.TimeoutExpired as exc:
+        # faulthandler should have fired first; this is the backstop
+        stderr = (exc.stderr.decode("utf8", "replace")
+                  if isinstance(exc.stderr, bytes) else (exc.stderr or ""))
+        rec.update(alive=False, why="hard hang (faulthandler did not fire)",
+                   wall_s=round(time.time() - t0, 1),
+                   stderr_tail=stderr[-500:])
+        return rec
+    rec["wall_s"] = round(time.time() - t0, 1)
+    if "PROBE-OK platform=tpu" in out.stdout:
+        rec["alive"] = True
+        return rec
+    rec["alive"] = False
+    if "Timeout (" in out.stderr:
+        dump, sig = _hang_stack(out.stderr)
+        rec["why"] = "probe hang"
+        rec["stack_hash"] = sig
+        if _last_stack_hash.get(name) != sig:
+            _last_stack_hash[name] = sig
+            rec["hang_stack"] = dump  # full dump only when it CHANGES
+        else:
+            rec["hang_stack"] = "unchanged"
+    elif "PROBE-OK" in out.stdout:
+        rec["why"] = "wrong platform: " + out.stdout.strip()[-100:]
+    else:
+        rec["why"] = (out.stderr or out.stdout).strip()[-300:]
+    return rec
+
+
+def probe():
+    """Tiered probe; returns (alive, why).
+
+    While HEALTHY only the jit tier (the actual liveness bar) runs —
+    paying three JAX-client inits per loop would shrink the capture
+    window on a tunnel whose uptime is O(minutes). After any failure the
+    full ladder (enum -> device_put -> jit, cheapest first) runs each
+    loop, so the round artifact localises the hang at the cheapest tier
+    that distinguishes it and a revival is detected tier by tier.
+    """
+    global _healthy
+    tiers = PROBE_VARIANTS if not _healthy else PROBE_VARIANTS[-1:]
+    for name, timeout, body in tiers:
+        rec = probe_variant(name, timeout, body)
+        if not rec["alive"]:
+            log(rec)
+            _last_failed.add(name)
+            _healthy = False
+            return False, rec.get("why", "?")
+        # a success is only worth a log line when the SAME tier failed
+        # on the previous loop (revival evidence, not per-loop noise)
+        if name in _last_failed:
+            _last_failed.discard(name)
+            log(rec)
+    _healthy = True
+    return True, None
 
 
 def run_step(step):
@@ -247,10 +363,9 @@ def main():
             log({"step": "daemon-done", "done": st["done"],
                  "abandoned": abandoned})
             return 0
-        alive, why = probe()
+        alive, why = probe()  # failures logged per-tier inside probe()
         if not alive:
-            log({"step": "probe", "alive": False, "why": why})
-            # short sleep: a hung probe already costs 45s, and the tunnel's
+            # short sleep: a hung probe already costs ~40s, and the tunnel's
             # uptime windows have been O(minutes) — a 30s extra nap was
             # enough to miss one (round-3 logged 440 hangs, 0 captures)
             time.sleep(10)
