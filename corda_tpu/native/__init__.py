@@ -111,6 +111,22 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         lib.ed25519_point_roundtrip.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p,
         ]
+        lib.ed25519_msm_is_small_mixed.restype = ctypes.c_longlong
+        lib.ed25519_msm_is_small_mixed.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.ed25519_decompress_many.restype = ctypes.c_longlong
+        lib.ed25519_decompress_many.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.ed25519_msm_prep.restype = None
+        lib.ed25519_msm_prep.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
         return lib
     except Exception:
         _load_failed = True
@@ -236,6 +252,59 @@ def ed25519_msm_is_small(points: bytes, scalars: bytes, n: int) -> int:
     if lib is None:
         raise RuntimeError("native library unavailable")
     return lib.ed25519_msm_is_small(points, scalars, n)
+
+
+def ed25519_msm_is_small_mixed(
+    pts64: bytes, mask: bytes, scalars: bytes, n: int
+) -> int:
+    """`ed25519_msm_is_small` over mixed point encodings: each 64-byte
+    slot of pts64 is a cached affine pair (x||y) when mask[i] == 1, else
+    a compressed encoding in its first 32 bytes.  Affine slots skip the
+    ~265-mul decompression chain — the per-key decompressed-A cache's
+    fast path for distinct-signer batches."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.ed25519_msm_is_small_mixed(pts64, mask, scalars, n)
+
+
+def ed25519_decompress_many(points: List[bytes]):
+    """Decompress compressed points in one native pass.
+
+    Returns a list aligned with `points`: a 64-byte affine pair (x||y)
+    per valid encoding, None for points not on the curve."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(points)
+    if n == 0:
+        return []
+    out = ctypes.create_string_buffer(64 * n)
+    status = ctypes.create_string_buffer(n)
+    lib.ed25519_decompress_many(b"".join(points), out, status, n)
+    raw, st = out.raw, status.raw
+    return [
+        raw[64 * i:64 * i + 64] if st[i] == 0 else None for i in range(n)
+    ]
+
+
+def ed25519_msm_prep(
+    sigs: bytes, h_words: bytes, z: bytes, group: bytes,
+    n: int, n_groups: int,
+):
+    """Batched MSM scalar prep: per-row z*h mod L accumulated per key
+    group and z*s mod L accumulated for the B term, in one native pass.
+    Returns (z_scalars n*32, key_accums n_groups*32, b_accum 32)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    z_out = ctypes.create_string_buffer(32 * n)
+    key_accum = ctypes.create_string_buffer(32 * max(n_groups, 1))
+    b_out = ctypes.create_string_buffer(32)
+    lib.ed25519_msm_prep(
+        sigs, h_words, z, group, n, n_groups, z_out, key_accum, b_out
+    )
+    return z_out.raw, key_accum.raw[:32 * n_groups], b_out.raw
 
 
 def ed25519_point_roundtrip(compressed: bytes):

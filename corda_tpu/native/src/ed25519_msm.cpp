@@ -117,7 +117,33 @@ inline fe fe_mul(const fe &a, const fe &b) {
     return out;
 }
 
-inline fe fe_sq(const fe &a) { return fe_mul(a, a); }
+// Dedicated squaring: the i<j cross terms collapse by symmetry, 15 limb
+// products instead of fe_mul's 25.  Squarings are ~96% of the
+// decompression power chain (fe_pow2523: 254 of 265 ops) and half of
+// ge_dbl, so this is the single hottest primitive in the MSM.
+inline fe fe_sq(const fe &a) {
+    const u64 a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3], a4 = a.v[4];
+    const u64 a0_2 = a0 * 2, a1_2 = a1 * 2, a2_2 = a2 * 2, a3_2 = a3 * 2;
+    const u64 a3_19 = a3 * 19, a4_19 = a4 * 19;
+    u128 r0 = (u128)a0 * a0 + (u128)a1_2 * a4_19 + (u128)a2_2 * a3_19;
+    u128 r1 = (u128)a0_2 * a1 + (u128)a2_2 * a4_19 + (u128)a3 * a3_19;
+    u128 r2 = (u128)a0_2 * a2 + (u128)a1 * a1 + (u128)a3_2 * a4_19;
+    u128 r3 = (u128)a0_2 * a3 + (u128)a1_2 * a2 + (u128)a4 * a4_19;
+    u128 r4 = (u128)a0_2 * a4 + (u128)a1_2 * a3 + (u128)a2 * a2;
+    fe out;
+    u64 c;
+    u64 t0 = (u64)(r0 & MASK51); r1 += (u64)(r0 >> 51);
+    u64 t1 = (u64)(r1 & MASK51); r2 += (u64)(r1 >> 51);
+    u64 t2 = (u64)(r2 & MASK51); r3 += (u64)(r2 >> 51);
+    u64 t3 = (u64)(r3 & MASK51); r4 += (u64)(r3 >> 51);
+    u64 t4 = (u64)(r4 & MASK51);
+    t0 += (u64)(r4 >> 51) * 19;
+    c = t0 >> 51; t0 &= MASK51; t1 += c;
+    c = t1 >> 51; t1 &= MASK51; t2 += c;
+    out.v[0] = t0; out.v[1] = t1; out.v[2] = t2; out.v[3] = t3;
+    out.v[4] = t4;
+    return out;
+}
 
 inline fe fe_neg(const fe &a) { return fe_carry(fe_sub(fe_zero(), a)); }
 
@@ -211,9 +237,16 @@ struct ge {  // extended coordinates (X:Y:Z:T), x = X/Z, y = Y/Z, T = XY/Z
 
 ge ge_identity() { return ge{fe_zero(), fe_one(), fe_one(), fe_zero()}; }
 
+// curve constants hoisted to namespace scope: a function-local static
+// pays a thread-safe-init guard check per call, and ge_add runs ~240k
+// times per 4k-signature batch
+const fe D2_CONST = fe_frombytes(D2_BYTES);
+const fe D_CONST = fe_frombytes(D_BYTES);
+const fe SQRTM1_CONST = fe_frombytes(SQRTM1_BYTES);
+
 // EFD add-2008-hwcd-3 (a=-1, unified/complete on this curve)
 ge ge_add(const ge &p, const ge &q) {
-    static const fe D2 = fe_frombytes(D2_BYTES);
+    const fe &D2 = D2_CONST;
     fe A = fe_mul(fe_sub(p.Y, p.X), fe_sub(q.Y, q.X));
     fe B = fe_mul(fe_add(p.Y, p.X), fe_add(q.Y, q.X));
     fe C = fe_mul(fe_mul(p.T, D2), q.T);
@@ -229,7 +262,8 @@ ge ge_add(const ge &p, const ge &q) {
 ge ge_dbl(const ge &p) {
     fe A = fe_sq(p.X);
     fe B = fe_sq(p.Y);
-    fe C = fe_add(fe_sq(p.Z), fe_sq(p.Z));
+    fe Z2 = fe_sq(p.Z);  // squared once, not twice
+    fe C = fe_add(Z2, Z2);
     fe Dv = fe_neg(A);                       // a*A, a = -1
     fe E = fe_sub(fe_sub(fe_sq(fe_add(p.X, p.Y)), A), B);
     fe G = fe_add(Dv, B);
@@ -240,8 +274,8 @@ ge ge_dbl(const ge &p) {
 
 // RFC 8032 section 5.1.3; returns 0 on success, -1 if not on the curve
 int ge_frombytes(ge &h, const u8 s[32]) {
-    static const fe Dc = fe_frombytes(D_BYTES);
-    static const fe SQRTM1 = fe_frombytes(SQRTM1_BYTES);
+    const fe &Dc = D_CONST;
+    const fe &SQRTM1 = SQRTM1_CONST;
     fe y = fe_frombytes(s);
     fe y2 = fe_sq(y);
     fe u = fe_sub(y2, fe_one());
@@ -274,11 +308,18 @@ bool ge_is_identity(const ge &p) {
 }
 
 inline unsigned scalar_window(const u8 *sc, int pos, int w) {
-    // bits [pos, pos+w) of a 32-byte little-endian scalar (pos+w <= 256+)
-    u8 padded[40] = {0};
-    memcpy(padded, sc, 32);
+    // bits [pos, pos+w) of a 32-byte little-endian scalar (pos+w <= 256+).
+    // Direct 8-byte read while it stays in-bounds; the 32-byte pad copy
+    // only for the final window (this runs n*windows times per batch)
+    int byte = pos >> 3;
     u64 word;
-    memcpy(&word, padded + (pos >> 3), 8);
+    if (byte <= 24) {
+        memcpy(&word, sc + byte, 8);
+    } else {
+        u8 padded[40] = {0};
+        memcpy(padded, sc, 32);
+        memcpy(&word, padded + byte, 8);
+    }
     return (unsigned)((word >> (pos & 7)) & ((1u << w) - 1));
 }
 
@@ -319,15 +360,16 @@ const BComb &b_comb() {
 // Straus/comb evaluation for small point counts, where Pippenger's
 // per-window bucket machinery costs more than it saves: per non-B point
 // a 15-entry multiple table (14 adds) + one add per non-zero 4-bit
-// window over 253 shared doublings; any point whose ENCODING equals B
-// skips both via the static comb (zero doublings, <= 64 adds).
-ge msm_small(const u8 *points, const std::vector<ge> &P,
+// window over 253 shared doublings; any point flagged as B (caller
+// compares encodings) skips both via the static comb (zero doublings,
+// <= 64 adds).
+ge msm_small(const std::vector<char> &isB, const std::vector<ge> &P,
              const u8 *scalars, u64 n) {
     ge acc = ge_identity();
     bool acc_set = false;
     std::vector<u64> straus;  // indices of non-B points
     for (u64 i = 0; i < n; i++) {
-        if (memcmp(points + 32 * i, B_COMPRESSED, 32) == 0) {
+        if (isB[i]) {
             const BComb &comb = b_comb();
             for (int j = 0; j < 64; j++) {
                 unsigned d =
@@ -366,25 +408,23 @@ ge msm_small(const u8 *points, const std::vector<ge> &P,
     return acc;
 }
 
-}  // namespace
+// Construct from a cached affine pair (x||y, 32+32 LE bytes) produced
+// by ed25519_decompress_many: one fe_mul instead of the ~265-mul
+// decompression power chain.  Trusted input — the cache is filled only
+// from our own decompression, which validated curve membership.
+void ge_from_affine(ge &h, const u8 a[64]) {
+    h.X = fe_frombytes(a);
+    h.Y = fe_frombytes(a + 32);
+    h.Z = fe_one();
+    h.T = fe_mul(h.X, h.Y);
+}
 
-extern "C" {
-
-// 8 * sum(scalar_i * P_i) == identity?
-// 1 yes / 0 no / -1 bad point / -2 scalar >= 2^253 (not reduced mod L).
-// points: n*32 bytes compressed; scalars: n*32 bytes little-endian,
-// each already reduced mod L (checked exactly, up front: the signed
-// window recoding only covers 254 bits, so an oversized scalar must be
-// an error, never a silent truncation).
-long long ed25519_msm_is_small(const u8 *points, const u8 *scalars,
-                               u64 n) {
-    for (u64 i = 0; i < n; i++)
-        if (scalars[32 * i + 31] >> 5) return -2;  // scalar >= 2^253
-    std::vector<ge> P(n);
-    for (u64 i = 0; i < n; i++)
-        if (ge_frombytes(P[i], points + 32 * i) != 0) return -1;
+// Shared MSM verdict once points are loaded (isB marks base-point rows
+// eligible for the fixed comb).  1 yes / 0 no / -2 oversized scalar.
+long long msm_verdict(const std::vector<ge> &P, const std::vector<char> &isB,
+                      const u8 *scalars, u64 n) {
     if (n <= 16) {  // Straus + fixed-base comb beats Pippenger here
-        ge acc = msm_small(points, P, scalars, n);
+        ge acc = msm_small(isB, P, scalars, n);
         for (int k = 0; k < 3; k++) acc = ge_dbl(acc);
         return ge_is_identity(acc) ? 1 : 0;
     }
@@ -442,6 +482,76 @@ long long ed25519_msm_is_small(const u8 *points, const u8 *scalars,
     }
     for (int k = 0; k < 3; k++) acc = ge_dbl(acc);  // cofactor 8
     return ge_is_identity(acc) ? 1 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// 8 * sum(scalar_i * P_i) == identity?
+// 1 yes / 0 no / -1 bad point / -2 scalar >= 2^253 (not reduced mod L).
+// points: n*32 bytes compressed; scalars: n*32 bytes little-endian,
+// each already reduced mod L (checked exactly, up front: the signed
+// window recoding only covers 254 bits, so an oversized scalar must be
+// an error, never a silent truncation).
+long long ed25519_msm_is_small(const u8 *points, const u8 *scalars,
+                               u64 n) {
+    for (u64 i = 0; i < n; i++)
+        if (scalars[32 * i + 31] >> 5) return -2;  // scalar >= 2^253
+    std::vector<ge> P(n);
+    std::vector<char> isB(n);
+    for (u64 i = 0; i < n; i++) {
+        if (ge_frombytes(P[i], points + 32 * i) != 0) return -1;
+        isB[i] = memcmp(points + 32 * i, B_COMPRESSED, 32) == 0;
+    }
+    return msm_verdict(P, isB, scalars, n);
+}
+
+// Mixed-input MSM: pts64 holds n 64-byte slots.  mask[i] == 1 -> the
+// slot is a cached AFFINE pair (x||y) from ed25519_decompress_many,
+// loaded with one field mul; mask[i] == 0 -> the slot's first 32 bytes
+// are a compressed encoding, decompressed here (~265 field muls).  The
+// per-key decompressed-A cache uses this to make all-distinct-key
+// batches decompression-free on the A side (r4 VERDICT weak #3).
+long long ed25519_msm_is_small_mixed(const u8 *pts64, const u8 *mask,
+                                     const u8 *scalars, u64 n) {
+    for (u64 i = 0; i < n; i++)
+        if (scalars[32 * i + 31] >> 5) return -2;
+    std::vector<ge> P(n);
+    std::vector<char> isB(n);
+    for (u64 i = 0; i < n; i++) {
+        const u8 *slot = pts64 + 64 * i;
+        if (mask[i]) {
+            ge_from_affine(P[i], slot);
+            isB[i] = 0;  // cached keys are never the base point encoding
+        } else {
+            if (ge_frombytes(P[i], slot) != 0) return -1;
+            isB[i] = memcmp(slot, B_COMPRESSED, 32) == 0;
+        }
+    }
+    return msm_verdict(P, isB, scalars, n);
+}
+
+// Decompress n compressed points to affine pairs (x||y per 64-byte out
+// slot).  status[i]: 0 ok, 1 not on the curve.  Returns the ok count.
+// Fills the host-side per-key cache in one native pass.
+long long ed25519_decompress_many(const u8 *in, u8 *out, u8 *status,
+                                  u64 n) {
+    long long ok = 0;
+    for (u64 i = 0; i < n; i++) {
+        ge p;
+        if (ge_frombytes(p, in + 32 * i) != 0) {
+            status[i] = 1;
+            memset(out + 64 * i, 0, 64);
+            continue;
+        }
+        status[i] = 0;
+        // ge_frombytes output is already affine (Z = 1)
+        fe_tobytes(out + 64 * i, p.X);
+        fe_tobytes(out + 64 * i + 32, p.Y);
+        ok++;
+    }
+    return ok;
 }
 
 // Self-check hook for tests: decompress + recompress one point.

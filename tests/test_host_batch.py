@@ -245,3 +245,95 @@ def test_fuzz_differential_random_mutations():
         assert got == want, [
             (i, g, w) for i, (g, w) in enumerate(zip(got, want)) if g != w
         ]
+
+
+def _keyed_rows(n, n_keys, rng):
+    seeds = [rng.bytes(32) for _ in range(n_keys)]
+    pubs = [em.public_from_seed(s) for s in seeds]
+    out = []
+    for i in range(n):
+        m = rng.bytes(40)
+        out.append((pubs[i % n_keys], em.sign(seeds[i % n_keys], m), m))
+    return out
+
+
+def test_decompressed_key_cache_verdicts_identical():
+    """r4 VERDICT weak #3: the per-key affine cache must change only the
+    speed, never a verdict — warm passes (cache hits) must reproduce
+    cold verdicts including exact tamper positions."""
+    rng = np.random.default_rng(11)
+    rows = _keyed_rows(64, 64, rng)  # all-distinct keys
+    host_batch._A_CACHE.clear()
+    cold = host_batch.verify_batch_host(rows)
+    assert cold == [True] * 64
+    assert len(host_batch._A_CACHE) == 64  # every key cached
+    # tamper two rows and re-verify with a WARM cache
+    bad = list(rows)
+    bad[5] = (bad[5][0], bad[5][1], b"tampered")
+    bad[41] = (bad[41][0], b"\x01" * 64, bad[41][2])
+    warm = host_batch.verify_batch_host(bad)
+    assert warm == [i not in (5, 41) for i in range(64)]
+
+
+def test_off_curve_key_with_cache_still_rejected():
+    """A pubkey encoding not on the curve never enters the cache and its
+    rows still fail cleanly through the compressed fallback path."""
+    rng = np.random.default_rng(12)
+    rows = _keyed_rows(8, 8, rng)
+    # y = 2 is not on the curve (x^2 = (y^2-1)/(dy^2+1) is non-square)
+    off = (2).to_bytes(32, "little")
+    assert native.ed25519_decompress_many([off]) == [None]
+    rows.append((off, rows[0][1], rows[0][2]))
+    host_batch._A_CACHE.clear()
+    out = host_batch.verify_batch_host(rows)
+    assert out == [True] * 8 + [False]
+    assert off not in host_batch._A_CACHE
+
+
+def test_key_cache_is_bounded(monkeypatch):
+    monkeypatch.setattr(host_batch, "_A_CACHE_MAX", 16)
+    host_batch._A_CACHE.clear()
+    rng = np.random.default_rng(13)
+    for _ in range(3):
+        rows = _keyed_rows(24, 24, rng)
+        assert host_batch.verify_batch_host(rows) == [True] * 24
+        assert len(host_batch._A_CACHE) <= 16
+    host_batch._A_CACHE.clear()
+
+
+def test_native_msm_prep_matches_python_bigints():
+    """The native z*h / z*s mulmod accumulation must agree with the
+    Python bigint reference on every output word."""
+    rng = np.random.default_rng(14)
+    n, n_groups = 37, 9
+    L = host_batch.L
+    sigs = rng.bytes(64 * n)
+    # s halves must be < L: clamp top byte
+    sigs = bytearray(sigs)
+    for i in range(n):
+        sigs[64 * i + 63] &= 0x0F
+    sigs = bytes(sigs)
+    h_words = bytearray(rng.bytes(32 * n))
+    for i in range(n):
+        h_words[32 * i + 31] &= 0x0F  # h < 2^252 <= L
+    h_words = bytes(h_words)
+    z = rng.bytes(16 * n)
+    groups = [int(rng.integers(0, n_groups)) for _ in range(n)]
+    gbuf = b"".join(g.to_bytes(4, "little") for g in groups)
+    z_out, key_accum, b_out = native.ed25519_msm_prep(
+        sigs, h_words, z, gbuf, n, n_groups
+    )
+    # Python reference
+    ref_acc = [0] * n_groups
+    ref_b = 0
+    for i in range(n):
+        zi = int.from_bytes(z[16 * i:16 * i + 16], "little") | 1
+        assert int.from_bytes(z_out[32 * i:32 * i + 32], "little") == zi
+        h = int.from_bytes(h_words[32 * i:32 * i + 32], "little")
+        s = int.from_bytes(sigs[64 * i + 32:64 * i + 64], "little")
+        ref_acc[groups[i]] = (ref_acc[groups[i]] + zi * h) % L
+        ref_b = (ref_b + zi * s) % L
+    for g in range(n_groups):
+        got = int.from_bytes(key_accum[32 * g:32 * g + 32], "little")
+        assert got == ref_acc[g], f"group {g}"
+    assert int.from_bytes(b_out, "little") == ref_b

@@ -236,6 +236,10 @@ def probe_variant(name, timeout, body):
         out = subprocess.run(
             [sys.executable, "-c", _armed(body, timeout)],
             capture_output=True, text=True, timeout=timeout, env=bench_env(),
+            # probes run every ~50s all round on a 1-core box: without
+            # a low priority they visibly skew any concurrently running
+            # benchmark (incl. the driver's end-of-round bench.py)
+            preexec_fn=lambda: os.nice(15),
         )
     except subprocess.TimeoutExpired as exc:
         # faulthandler should have fired first; this is the backstop
