@@ -49,6 +49,7 @@ MIN_DEVICE_BATCH = 32
 DISPATCH = os.environ.get("CORDA_TPU_DISPATCH", "auto")
 _ACCEL_BACKENDS = frozenset({"tpu", "gpu", "cuda", "rocm"})
 _resolved_backend: str | None = None
+_BACKEND_LOCK = threading.Lock()
 
 #: threads for the host OpenSSL path; OpenSSL verification via the
 #: `cryptography` bindings is CPU-bound C code, so a small pool scales on
@@ -65,15 +66,45 @@ def _backend() -> str:
     cannot change within a process — JAX latches the backend on first
     use — so one probe is both cheap and sound. If JAX is unavailable
     the host path is the only path.
+
+    TIME-BOUNDED: a half-dead accelerator tunnel can hang backend
+    resolution inside the PJRT client init indefinitely (observed live:
+    make_c_api_client never returns), which would freeze the first
+    verify_batch call forever. The probe runs in a daemon thread with a
+    deadline; on timeout the process latches "cpu" — a backend that
+    cannot answer a probe cannot verify signatures either, and latching
+    keeps the acceptance-rule pin stable for the process lifetime.
     """
     global _resolved_backend
     if _resolved_backend is None:
-        try:
-            import jax
+        # locked: two racing first calls must not each probe and latch
+        # different answers (a timeout-latched "cpu" overwritten by a
+        # late "tpu" would flip the acceptance-rule pin's basis)
+        with _BACKEND_LOCK:
+            if _resolved_backend is not None:
+                return _resolved_backend
+            result: dict = {}
 
-            _resolved_backend = jax.default_backend()
-        except Exception:
-            _resolved_backend = "none"
+            def probe() -> None:
+                try:
+                    import jax
+
+                    result["b"] = jax.default_backend()
+                except Exception:
+                    result["b"] = "none"
+
+            t = threading.Thread(
+                target=probe, daemon=True, name="corda-tpu-backend-probe"
+            )
+            t.start()
+            t.join(
+                timeout=float(
+                    os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
+                )
+            )
+            _resolved_backend = (
+                result.get("b", "cpu") if not t.is_alive() else "cpu"
+            )
     return _resolved_backend
 
 
@@ -262,15 +293,19 @@ def _verify_flat(
     # cofactored rule (it started host-side) must keep ed25519 off them
     # even if the engine choice later flips to device
     ed_device = use_device and rule == "cofactorless"
+    from . import ecdsa_host as ecdsa_host_mod
+
+    ec_native = ecdsa_host_mod.available()
     buckets: dict = {}  # kernel key -> [indices]
     host_rows: List[int] = []
     ed_host: List[int] = []  # ed25519 rows for the native MSM batch path
+    ec_host: dict = {}  # curve kind -> [indices] for the native engine
     for i, (key, sig, content) in enumerate(items):
         name = key.scheme_code_name
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
+        is_ec = name in _ECDSA_CURVES
         if not _is_composite(key) and (
-            (is_ed and ed_device) or (not is_ed and use_device
-                                      and name in _ECDSA_CURVES)
+            (is_ed and ed_device) or (is_ec and use_device)
         ):
             buckets.setdefault(name, []).append(i)
         elif is_ed and not _is_composite(key):
@@ -278,13 +313,26 @@ def _verify_flat(
                 ed_host.append(i)  # native MSM, ZIP-215
             else:
                 host_rows.append(i)  # OpenSSL loop, cofactorless
+        elif is_ec and not _is_composite(key) and ec_native:
+            # native batch engine (combs + batched inversions); the
+            # acceptance rule is plain per-signature ECDSA with strict
+            # DER — identical to the OpenSSL loop, so routing here at
+            # any size cannot split verdicts
+            ec_host.setdefault(_ECDSA_CURVES[name], []).append(i)
         else:
             host_rows.append(i)
 
     for name, idx in buckets.items():
         if len(idx) < MIN_DEVICE_BATCH:
-            # Undersized buckets on an accelerator deployment go to the
-            # per-signature OpenSSL loop (host_rows), NOT the native MSM:
+            # Undersized ECDSA buckets ride the native engine when
+            # available (one ECDSA rule everywhere, so this is purely a
+            # speed choice)
+            if name in _ECDSA_CURVES and ec_native:
+                ec_host.setdefault(_ECDSA_CURVES[name], []).extend(idx)
+                continue
+            # Undersized ed25519 buckets on an accelerator deployment
+            # go to the per-signature OpenSSL loop (host_rows), NOT the
+            # native MSM:
             # the device kernels verify cofactorless ([s]B == R + [h]A,
             # like OpenSSL) while the MSM verifies cofactored (ZIP-215).
             # The acceptance rule must be a DEPLOYMENT property — one
@@ -341,6 +389,16 @@ def _verify_flat(
             )
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
+
+    for kind, idx in ec_host.items():
+        out = ecdsa_host_mod.verify_batch_host(
+            kind,
+            [items[i][0].encoded for i in idx],
+            [items[i][1] for i in idx],
+            [items[i][2] for i in idx],
+        )
+        for j, i in enumerate(idx):
+            results[i] = out[j]
 
     if ed_host:
         from . import host_batch

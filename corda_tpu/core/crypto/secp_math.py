@@ -211,20 +211,31 @@ def der_encode_sig(r: int, s: int) -> bytes:
 
 
 def der_decode_sig(data: bytes) -> Tuple[int, int]:
+    """STRICT DER (r, s) decode, matching OpenSSL/BouncyCastle: minimal
+    integer encodings only, no negative values, short-form lengths.  All
+    verification paths (OpenSSL loop, device kernels, native host batch)
+    must share one parsing rule or a crafted encoding would verify on
+    one path and fail on another."""
     if len(data) < 8 or data[0] != 0x30:
         raise ValueError("bad DER signature")
-    if data[1] != len(data) - 2:
+    if data[1] > 0x7F or data[1] != len(data) - 2:
         raise ValueError("bad DER length")
     i = 2
 
     def _int() -> int:
         nonlocal i
-        if data[i] != 0x02:
+        if i + 2 > len(data) or data[i] != 0x02:
             raise ValueError("expected DER INTEGER")
         ln = data[i + 1]
-        v = int.from_bytes(data[i + 2 : i + 2 + ln], "big")
+        if ln == 0 or ln > 0x7F or i + 2 + ln > len(data):
+            raise ValueError("bad DER INTEGER length")
+        body = data[i + 2 : i + 2 + ln]
+        if body[0] & 0x80:
+            raise ValueError("negative DER INTEGER")
+        if ln > 1 and body[0] == 0 and not (body[1] & 0x80):
+            raise ValueError("non-minimal DER INTEGER")
         i += 2 + ln
-        return v
+        return int.from_bytes(body, "big")
 
     r = _int()
     s = _int()

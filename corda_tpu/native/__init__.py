@@ -70,6 +70,7 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         os.path.join(_SRC, "sha2_batch.cpp"),
         os.path.join(_SRC, "journal.cpp"),
         os.path.join(_SRC, "ed25519_msm.cpp"),
+        os.path.join(_SRC, "ecdsa_host.cpp"),
     ]
     so_path = os.path.join(_BUILD, "corda_native.so")
     try:
@@ -126,6 +127,16 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.ecdsa_verify_batch_host.restype = ctypes.c_longlong
+        lib.ecdsa_verify_batch_host.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ecdsa_decompress_many.restype = ctypes.c_longlong
+        lib.ecdsa_decompress_many.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64,
         ]
         return lib
     except Exception:
@@ -305,6 +316,39 @@ def ed25519_msm_prep(
         sigs, h_words, z, group, n, n_groups, z_out, key_accum, b_out
     )
     return z_out.raw, key_accum.raw[:32 * n_groups], b_out.raw
+
+
+def ecdsa_verify_batch_host(
+    curve_id: int, pub64: bytes, rs: bytes, digests: bytes, n: int
+):
+    """Batched short-Weierstrass ECDSA verification (0 = secp256k1,
+    1 = secp256r1).  pub64: n*64 big-endian affine X||Y; rs: n*64 r||s;
+    digests: n*32 SHA-256(message).  Returns a list of n bools."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    verdicts = ctypes.create_string_buffer(n)
+    lib.ecdsa_verify_batch_host(curve_id, pub64, rs, digests, verdicts, n)
+    return [v == 1 for v in verdicts.raw]
+
+
+def ecdsa_decompress_many(curve_id: int, compressed: List[bytes]):
+    """Decompress SEC1 compressed points (33 bytes each) in one native
+    pass.  Returns a list aligned with the input: 64-byte big-endian
+    X||Y per valid encoding, None for points not on the curve."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(compressed)
+    if n == 0:
+        return []
+    out = ctypes.create_string_buffer(64 * n)
+    status = ctypes.create_string_buffer(n)
+    lib.ecdsa_decompress_many(curve_id, b"".join(compressed), out, status, n)
+    raw, st = out.raw, status.raw
+    return [
+        raw[64 * i:64 * i + 64] if st[i] == 0 else None for i in range(n)
+    ]
 
 
 def ed25519_point_roundtrip(compressed: bytes):

@@ -1,0 +1,1073 @@
+// Batched host ECDSA verification for secp256k1 and secp256r1 (P-256).
+//
+// The reference verifies ECDSA one signature at a time through
+// BouncyCastle (core/.../crypto/Crypto.kt:91-151); plain OpenSSL on the
+// 1-core CI box peaks at ~12k P-256 verifies/s (openssl speed) and the
+// per-signature `cryptography` loop at ~7.3k/s.  This engine verifies
+// u1*G + u2*Q with:
+//   * 4x64-limb Montgomery field arithmetic (constants derived at
+//     runtime from the curve primes -- no hand-transcribed magic),
+//   * fixed-base combs with ZERO doublings on the hot path: a static
+//     width-11 comb for G (<= 24 mixed adds per [u1]G) plus a cached
+//     width-6 comb per HOT public key (<= 43 mixed adds per [u2]Q) —
+//     the ECDSA analogue of the ed25519 decompressed-A cache, built
+//     once a key has been seen COMB_THRESHOLD times;
+//   * an interleaved-wNAF ladder (width-7 static G table + width-5
+//     per-signature Q table over one shared 256-double ladder) for
+//     COLD keys, where a comb build would cost more than it saves;
+//   * batched s-inversion mod n AND batched affinization mod p (one
+//     Fermat chain per batch each, via Montgomery's trick);
+// Verification handles public data only: variable-time by design.
+//
+// ECDSA itself has no aggregate batch equation (the R points are not
+// transmitted, only r = R.x mod n), so unlike the ed25519 MSM the win
+// here is engineering, not algebra: batch-shaped amortization + a
+// faster core loop than the generic code OpenSSL uses for these curves
+// in this image.  Measured (1-core CI box): ~20.5k warm / ~6.5k cold
+// P-256 verifies/s vs OpenSSL's 12k ceiling and the reference's ~2-3k.
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+typedef uint64_t u64;
+typedef uint8_t u8;
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------------
+// 4x64 little-endian limb arithmetic mod a generic 256-bit odd modulus,
+// in the Montgomery domain (R = 2^256).
+// ---------------------------------------------------------------------------
+
+struct Mod {
+    u64 m[4];     // modulus
+    u64 n0;       // -m^-1 mod 2^64
+    u64 rr[4];    // R^2 mod m  (to enter the domain)
+    u64 one[4];   // R mod m    (1 in the domain)
+};
+
+inline int cmp4(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] != b[i]) return a[i] < b[i] ? -1 : 1;
+    }
+    return 0;
+}
+
+inline bool is_zero4(const u64 a[4]) {
+    return (a[0] | a[1] | a[2] | a[3]) == 0;
+}
+
+// Branchless conditional subtract: the taken/not-taken pattern on
+// random field elements is a coin flip, and a mispredict costs more
+// than the always-computed subtraction (these run on every field op).
+// a (with optional carry limb) -> a mod-reduced by one m.
+__attribute__((always_inline)) inline void reduce_once(u64 a[4], u64 carry, const u64 m[4]) {
+    u64 s[4];
+    u128 br = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - m[i] - br;
+        s[i] = (u64)d;
+        br = (d >> 64) ? 1 : 0;
+    }
+    // use s when (carry:a) >= m, i.e. carry set or no borrow
+    u64 use_s = (u64)0 - (u64)(carry | (u64)(br == 0));
+    for (int i = 0; i < 4; i++)
+        a[i] = (s[i] & use_s) | (a[i] & ~use_s);
+}
+
+inline void cond_sub(u64 a[4], const u64 m[4]) { reduce_once(a, 0, m); }
+
+// out = (a + b) mod m   (a, b < m)
+__attribute__((always_inline)) inline void add_mod(u64 out[4], const u64 a[4], const u64 b[4],
+                    const u64 m[4]) {
+    u128 c = 0;
+    u64 t[4];
+    for (int i = 0; i < 4; i++) {
+        c += (u128)a[i] + b[i];
+        t[i] = (u64)c;
+        c >>= 64;
+    }
+    reduce_once(t, (u64)c, m);
+    memcpy(out, t, 32);
+}
+
+// out = (a - b) mod m, branchless add-back
+__attribute__((always_inline)) inline void sub_mod(u64 out[4], const u64 a[4], const u64 b[4],
+                    const u64 m[4]) {
+    u128 br = 0;
+    u64 t[4];
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - b[i] - br;
+        t[i] = (u64)d;
+        br = (d >> 64) ? 1 : 0;
+    }
+    u64 mask = (u64)0 - (u64)br;  // add m back only on underflow
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (u128)t[i] + (m[i] & mask);
+        t[i] = (u64)c;
+        c >>= 64;
+    }
+    memcpy(out, t, 32);
+}
+
+// CIOS Montgomery multiplication: out = a*b*R^-1 mod m
+__attribute__((always_inline)) inline void mont_mul(u64 out[4], const u64 a[4], const u64 b[4], const Mod &M) {
+    u64 t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 4; j++) {
+            c += (u128)a[i] * b[j] + t[j];
+            t[j] = (u64)c;
+            c >>= 64;
+        }
+        c += t[4];
+        t[4] = (u64)c;
+        t[5] = (u64)(c >> 64);
+        u64 q = t[0] * M.n0;
+        c = (u128)q * M.m[0] + t[0];
+        c >>= 64;
+        for (int j = 1; j < 4; j++) {
+            c += (u128)q * M.m[j] + t[j];
+            t[j - 1] = (u64)c;
+            c >>= 64;
+        }
+        c += t[4];
+        t[3] = (u64)c;
+        t[4] = t[5] + (u64)(c >> 64);
+        t[5] = 0;
+    }
+    u64 r[4] = {t[0], t[1], t[2], t[3]};
+    reduce_once(r, t[4], M.m);
+    memcpy(out, r, 32);
+}
+
+// Dedicated Montgomery squaring: cross products computed once and
+// doubled (10 limb products vs mont_mul's 16 before reduction).
+// Squarings are >half the ops in doubling-heavy point arithmetic.
+__attribute__((always_inline)) inline void mont_sqr(u64 out[4], const u64 a[4], const Mod &M) {
+    // full 512-bit square into t[0..7]
+    u64 t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    // off-diagonal products (i < j), then doubled
+    u128 c = 0;
+    // row i=0
+    c = (u128)a[0] * a[1];            t[1] = (u64)c; c >>= 64;
+    c += (u128)a[0] * a[2];           t[2] = (u64)c; c >>= 64;
+    c += (u128)a[0] * a[3];           t[3] = (u64)c; t[4] = (u64)(c >> 64);
+    // row i=1
+    c = (u128)a[1] * a[2] + t[3];     t[3] = (u64)c; c >>= 64;
+    c += (u128)a[1] * a[3] + t[4];    t[4] = (u64)c; t[5] = (u64)(c >> 64);
+    // row i=2
+    c = (u128)a[2] * a[3] + t[5];     t[5] = (u64)c; t[6] = (u64)(c >> 64);
+    // double the off-diagonal part
+    u64 carry = 0;
+    for (int i = 1; i < 7; i++) {
+        u64 nv = (t[i] << 1) | carry;
+        carry = t[i] >> 63;
+        t[i] = nv;
+    }
+    t[7] = carry;
+    // add the diagonal squares
+    c = (u128)a[0] * a[0];
+    t[0] = (u64)c;
+    c = (u128)t[1] + (u64)(c >> 64);          t[1] = (u64)c; c >>= 64;
+    c += (u128)a[1] * a[1] + t[2];            t[2] = (u64)c; c >>= 64;
+    c += (u128)t[3];                          t[3] = (u64)c; c >>= 64;
+    c += (u128)a[2] * a[2] + t[4];            t[4] = (u64)c; c >>= 64;
+    c += (u128)t[5];                          t[5] = (u64)c; c >>= 64;
+    c += (u128)a[3] * a[3] + t[6];            t[6] = (u64)c; c >>= 64;
+    t[7] += (u64)c;
+    // Montgomery reduction of the 8-limb value (top carry tracked: the
+    // reduced value is < 2m, i.e. 4 limbs + 1 bit)
+    u64 t8 = 0;
+    for (int i = 0; i < 4; i++) {
+        u64 q = t[i] * M.n0;
+        u128 cc = (u128)q * M.m[0] + t[i];
+        cc >>= 64;
+        for (int j = 1; j < 4; j++) {
+            cc += (u128)q * M.m[j] + t[i + j];
+            t[i + j] = (u64)cc;
+            cc >>= 64;
+        }
+        int j = i + 4;
+        while (cc && j < 8) {
+            cc += t[j];
+            t[j] = (u64)cc;
+            cc >>= 64;
+            j++;
+        }
+        t8 += (u64)cc;
+    }
+    u64 r[4] = {t[4], t[5], t[6], t[7]};
+    reduce_once(r, t8, M.m);
+    memcpy(out, r, 32);
+}
+
+// Fermat inversion in the Montgomery domain: out = a^(m-2) (domain in,
+// domain out).  Fixed 256-bit exponent, simple square-and-multiply.
+void mont_inv(u64 out[4], const u64 a[4], const Mod &M) {
+    u64 e[4];
+    memcpy(e, M.m, 32);
+    // e = m - 2  (m is odd and > 2, no borrow past limb 0 unless m[0]<2)
+    u128 br = 0;
+    u128 d0 = (u128)e[0] - 2;
+    e[0] = (u64)d0;
+    br = (d0 >> 64) ? 1 : 0;
+    for (int i = 1; i < 4 && br; i++) {
+        u128 d = (u128)e[i] - br;
+        e[i] = (u64)d;
+        br = (d >> 64) ? 1 : 0;
+    }
+    u64 acc[4];
+    memcpy(acc, M.one, 32);
+    for (int bit = 255; bit >= 0; bit--) {
+        mont_sqr(acc, acc, M);
+        if ((e[bit >> 6] >> (bit & 63)) & 1) mont_mul(acc, acc, a, M);
+    }
+    memcpy(out, acc, 32);
+}
+
+void to_mont(u64 out[4], const u64 a[4], const Mod &M) {
+    mont_mul(out, a, M.rr, M);
+}
+
+void from_mont(u64 out[4], const u64 a[4], const Mod &M) {
+    u64 one[4] = {1, 0, 0, 0};
+    mont_mul(out, a, one, M);
+}
+
+// Build a Montgomery context from the modulus alone.
+void mod_init(Mod &M, const u64 m[4]) {
+    memcpy(M.m, m, 32);
+    // n0 = -m^-1 mod 2^64 by Newton iteration (m odd)
+    u64 inv = m[0];               // 3-bit start: x*m == 1 mod 8 for odd m
+    for (int i = 0; i < 6; i++) inv *= 2 - m[0] * inv;
+    M.n0 = (u64)(0 - inv);
+    // one = R mod m: start from 2^255 mod m reachable by shifts
+    u64 r[4] = {0, 0, 0, 0};
+    // compute 2^256 mod m by 256 doublings of 1
+    u64 acc[4] = {1, 0, 0, 0};
+    for (int i = 0; i < 256; i++) {
+        add_mod(acc, acc, acc, m);
+    }
+    memcpy(M.one, acc, 32);       // R mod m
+    // rr = R^2 mod m by 256 more doublings
+    memcpy(r, acc, 32);
+    for (int i = 0; i < 256; i++) {
+        add_mod(r, r, r, m);
+    }
+    memcpy(M.rr, r, 32);
+}
+
+// ---------------------------------------------------------------------------
+// Curves (SEC 2 constants, big-endian hex transcribed as LE limbs)
+// ---------------------------------------------------------------------------
+
+struct CurveDef {
+    u64 p[4], n[4], a[4], b[4], gx[4], gy[4];
+    bool a_is_m3;  // a == p - 3 (P-256): cheaper doubling formula
+};
+
+// secp256k1: p = 2^256 - 2^32 - 977, a = 0, b = 7
+const CurveDef K1 = {
+    {0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+     0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL},
+    {0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL,
+     0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL},
+    {0, 0, 0, 0},
+    {7, 0, 0, 0},
+    {0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+     0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL},
+    {0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+     0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL},
+    false,
+};
+
+// secp256r1 (P-256)
+const CurveDef R1 = {
+    {0xFFFFFFFFFFFFFFFFULL, 0x00000000FFFFFFFFULL,
+     0x0000000000000000ULL, 0xFFFFFFFF00000001ULL},
+    {0xF3B9CAC2FC632551ULL, 0xBCE6FAADA7179E84ULL,
+     0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFF00000000ULL},
+    {0xFFFFFFFFFFFFFFFCULL, 0x00000000FFFFFFFFULL,
+     0x0000000000000000ULL, 0xFFFFFFFF00000001ULL},
+    {0x3BCE3C3E27D2604BULL, 0x651D06B0CC53B0F6ULL,
+     0xB3EBBD55769886BCULL, 0x5AC635D8AA3A93E7ULL},
+    {0xF4A13945D898C296ULL, 0x77037D812DEB33A0ULL,
+     0xF8BCE6E563A440F2ULL, 0x6B17D1F2E12C4247ULL},
+    {0xCBB6406837BF51F5ULL, 0x2BCE33576B315ECEULL,
+     0x8EE7EB4A7C0F9E16ULL, 0x4FE342E2FE1A7F9BULL},
+    true,
+};
+
+// Jacobian point, coordinates in the Montgomery domain of p
+struct Jac {
+    u64 X[4], Y[4], Z[4];
+    bool inf;
+};
+
+struct Aff {
+    u64 x[4], y[4];  // Montgomery domain
+};
+
+// wNAF digits are odd with |d| <= 2^(w-1) - 1, so tables hold 2^(w-2)
+// odd multiples
+#define G_W 7
+#define G_TABLE (1 << (G_W - 2))  // 32 odd multiples: G, 3G, ..., 63G
+#define Q_W 5
+#define Q_TABLE (1 << (Q_W - 2))  // 8 odd multiples: Q, 3Q, ..., 15Q
+
+// Fixed-base comb: t[j][d-1] = [d * 2^(W*j)] P in affine mont(p), for
+// window position j and digit d in [1, 2^W).  Evaluating [k]P costs at
+// most ceil(256/W) mixed adds and ZERO doublings.  Two instantiations:
+//   * W=11 statically for G (3.1MB per curve, built lazily once per
+//     process, ~35ms): [u1]G in <= 24 adds;
+//   * W=6 cached per public key for repeat signers (the ECDSA analogue
+//     of the ed25519 decompressed-A cache; 173KB per key, built once
+//     per hot key in ~2.6ms and amortized across its signatures).
+template <int W>
+struct CombT {
+    static constexpr int POS = (256 + W - 1) / W;
+    static constexpr int ENT = (1 << W) - 1;
+    Aff t[POS][ENT];
+};
+
+using GComb = CombT<11>;
+using Comb = CombT<6>;
+
+struct Ctx {
+    Mod P, N;
+    u64 a[4], b[4];  // curve coefficients, mont(p) domain
+    bool a_is_m3;
+    Aff g_tab[G_TABLE];
+    GComb g_comb;
+    bool ready = false;
+};
+
+Ctx CTX[2];
+
+// -- point formulas (all coordinates mont(p)) -------------------------------
+
+void jac_dbl(Jac &r, const Jac &q, const Ctx &C) {
+    if (q.inf || is_zero4(q.Y)) {
+        r.inf = true;
+        return;
+    }
+    const Mod &P = C.P;
+    u64 XX[4], YY[4], YYYY[4], ZZ[4], S[4], M[4], T[4], t0[4], t1[4];
+    mont_sqr(XX, q.X, P);
+    mont_sqr(YY, q.Y, P);
+    mont_sqr(YYYY, YY, P);
+    mont_sqr(ZZ, q.Z, P);
+    // S = 2*((X+YY)^2 - XX - YYYY)
+    add_mod(t0, q.X, YY, P.m);
+    mont_sqr(t0, t0, P);
+    sub_mod(t0, t0, XX, P.m);
+    sub_mod(t0, t0, YYYY, P.m);
+    add_mod(S, t0, t0, P.m);
+    // M = 3*XX + a*ZZ^2
+    add_mod(M, XX, XX, P.m);
+    add_mod(M, M, XX, P.m);
+    if (C.a_is_m3) {
+        // a = -3: M = 3*(X - ZZ)*(X + ZZ)
+        sub_mod(t0, q.X, ZZ, P.m);
+        add_mod(t1, q.X, ZZ, P.m);
+        mont_mul(t0, t0, t1, P);
+        add_mod(M, t0, t0, P.m);
+        add_mod(M, M, t0, P.m);
+    } else if (!is_zero4(C.a)) {
+        mont_sqr(t0, ZZ, P);
+        mont_mul(t0, t0, C.a, P);
+        add_mod(M, M, t0, P.m);
+    }
+    // T = M^2 - 2*S ; X3 = T
+    mont_sqr(T, M, P);
+    sub_mod(T, T, S, P.m);
+    sub_mod(T, T, S, P.m);
+    // Y3 = M*(S - T) - 8*YYYY
+    sub_mod(t0, S, T, P.m);
+    mont_mul(t0, M, t0, P);
+    add_mod(t1, YYYY, YYYY, P.m);
+    add_mod(t1, t1, t1, P.m);
+    add_mod(t1, t1, t1, P.m);
+    sub_mod(r.Y, t0, t1, P.m);
+    // Z3 = 2*Y*Z  (q.Z may be one; fine)
+    mont_mul(t0, q.Y, q.Z, P);
+    add_mod(r.Z, t0, t0, P.m);
+    memcpy(r.X, T, 32);
+    r.inf = false;
+}
+
+// r = q1 + q2 (general Jacobian add, handles doubling/inverse cases)
+void jac_add(Jac &r, const Jac &q1, const Jac &q2, const Ctx &C) {
+    if (q1.inf) { r = q2; return; }
+    if (q2.inf) { r = q1; return; }
+    const Mod &P = C.P;
+    u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4];
+    mont_sqr(Z1Z1, q1.Z, P);
+    mont_sqr(Z2Z2, q2.Z, P);
+    mont_mul(U1, q1.X, Z2Z2, P);
+    mont_mul(U2, q2.X, Z1Z1, P);
+    u64 t0[4];
+    mont_mul(t0, q2.Z, Z2Z2, P);
+    mont_mul(S1, q1.Y, t0, P);
+    mont_mul(t0, q1.Z, Z1Z1, P);
+    mont_mul(S2, q2.Y, t0, P);
+    sub_mod(H, U2, U1, P.m);
+    sub_mod(Rr, S2, S1, P.m);
+    if (is_zero4(H)) {
+        if (is_zero4(Rr)) { jac_dbl(r, q1, C); return; }
+        r.inf = true;
+        return;
+    }
+    u64 HH[4], HHH[4], V[4];
+    mont_sqr(HH, H, P);
+    mont_mul(HHH, HH, H, P);
+    mont_mul(V, U1, HH, P);
+    // X3 = Rr^2 - HHH - 2V
+    mont_sqr(t0, Rr, P);
+    sub_mod(t0, t0, HHH, P.m);
+    sub_mod(t0, t0, V, P.m);
+    sub_mod(r.X, t0, V, P.m);
+    // Y3 = Rr*(V - X3) - S1*HHH
+    sub_mod(t0, V, r.X, P.m);
+    mont_mul(t0, Rr, t0, P);
+    u64 t1[4];
+    mont_mul(t1, S1, HHH, P);
+    sub_mod(r.Y, t0, t1, P.m);
+    // Z3 = Z1*Z2*H
+    mont_mul(t0, q1.Z, q2.Z, P);
+    mont_mul(r.Z, t0, H, P);
+    r.inf = false;
+}
+
+// r = q1 + (affine) q2, mixed add (Z2 = 1)
+void jac_add_aff(Jac &r, const Jac &q1, const Aff &q2, const Ctx &C) {
+    if (q1.inf) {
+        memcpy(r.X, q2.x, 32);
+        memcpy(r.Y, q2.y, 32);
+        memcpy(r.Z, C.P.one, 32);
+        r.inf = false;
+        return;
+    }
+    const Mod &P = C.P;
+    u64 Z1Z1[4], U2[4], S2[4], H[4], Rr[4], t0[4], t1[4];
+    mont_sqr(Z1Z1, q1.Z, P);
+    mont_mul(U2, q2.x, Z1Z1, P);
+    mont_mul(t0, q1.Z, Z1Z1, P);
+    mont_mul(S2, q2.y, t0, P);
+    sub_mod(H, U2, q1.X, P.m);
+    sub_mod(Rr, S2, q1.Y, P.m);
+    if (is_zero4(H)) {
+        if (is_zero4(Rr)) { jac_dbl(r, q1, C); return; }
+        r.inf = true;
+        return;
+    }
+    u64 HH[4], HHH[4], V[4];
+    mont_sqr(HH, H, P);
+    mont_mul(HHH, HH, H, P);
+    mont_mul(V, q1.X, HH, P);
+    mont_sqr(t0, Rr, P);
+    sub_mod(t0, t0, HHH, P.m);
+    sub_mod(t0, t0, V, P.m);
+    sub_mod(r.X, t0, V, P.m);
+    sub_mod(t0, V, r.X, P.m);
+    mont_mul(t0, Rr, t0, P);
+    mont_mul(t1, q1.Y, HHH, P);
+    sub_mod(r.Y, t0, t1, P.m);
+    mont_mul(r.Z, q1.Z, H, P);
+    r.inf = false;
+}
+
+// Batch-normalize m Jacobian points to affine with ONE inversion
+// (Montgomery's trick).  Skips points with inf set (their Aff slot is
+// left zeroed — callers must not read it).
+void batch_to_affine(const std::vector<Jac> &pts, Aff *out, const Ctx &C) {
+    size_t m = pts.size();
+    std::vector<std::array<u64, 4>> prefix(m);
+    u64 prod[4];
+    memcpy(prod, C.P.one, 32);
+    for (size_t i = 0; i < m; i++) {
+        if (pts[i].inf) continue;
+        memcpy(prefix[i].data(), prod, 32);
+        mont_mul(prod, prod, pts[i].Z, C.P);
+    }
+    u64 inv[4];
+    mont_inv(inv, prod, C.P);
+    for (size_t i = m; i-- > 0;) {
+        if (pts[i].inf) {
+            memset(&out[i], 0, sizeof(Aff));
+            continue;
+        }
+        u64 zi[4], zi2[4], zi3[4];
+        mont_mul(zi, inv, prefix[i].data(), C.P);
+        mont_mul(inv, inv, pts[i].Z, C.P);
+        mont_sqr(zi2, zi, C.P);
+        mont_mul(zi3, zi2, zi, C.P);
+        mont_mul(out[i].x, pts[i].X, zi2, C.P);
+        mont_mul(out[i].y, pts[i].Y, zi3, C.P);
+    }
+}
+
+// Build the comb for a point given in affine mont(p).
+template <int W>
+void comb_build(CombT<W> &comb, const Aff &base, const Ctx &C) {
+    constexpr int POS = CombT<W>::POS, ENT = CombT<W>::ENT;
+    std::vector<Jac> tab((size_t)POS * ENT);
+    Jac p;
+    memcpy(p.X, base.x, 32);
+    memcpy(p.Y, base.y, 32);
+    memcpy(p.Z, C.P.one, 32);
+    p.inf = false;
+    for (int j = 0; j < POS; j++) {
+        tab[(size_t)j * ENT + 0] = p;  // [2^(W*j)] base
+        for (int d = 2; d <= ENT; d++)
+            jac_add(tab[(size_t)j * ENT + d - 1],
+                    tab[(size_t)j * ENT + d - 2], p, C);
+        if (j < POS - 1) {
+            Jac q = p;
+            for (int k = 0; k < W; k++) {
+                Jac t;
+                jac_dbl(t, q, C);
+                q = t;
+            }
+            p = q;
+        }
+    }
+    batch_to_affine(tab, &comb.t[0][0], C);
+}
+
+// W-bit window at bit position pos of a 4-limb scalar
+inline unsigned scalar_bits(const u64 k[4], int pos, int w) {
+    int limb = pos >> 6, sh = pos & 63;
+    u64 window = k[limb] >> sh;
+    if (sh && limb + 1 < 4) window |= k[limb + 1] << (64 - sh);
+    return (unsigned)(window & ((1u << w) - 1));
+}
+
+// acc += [k] P via its comb (k as 4 LE limbs, < 2^256).  Table entries
+// live in a multi-MB working set (per-key tables + the static G comb),
+// so each load is likely L3/DRAM: digits are precomputed and entries
+// prefetched a few adds (~1.7us of work) ahead to hide that latency.
+template <int W>
+void comb_eval(Jac &acc, const CombT<W> &comb, const u64 k[4],
+               const Ctx &C) {
+    constexpr int POS = CombT<W>::POS;
+    unsigned digits[POS];
+    int live[POS];
+    int n_live = 0;
+    for (int j = 0; j < POS; j++) {
+        unsigned d = scalar_bits(k, j * W, W);
+        if (d) {
+            digits[n_live] = d;
+            live[n_live++] = j;
+        }
+    }
+    constexpr int AHEAD = 3;
+    for (int a = 0; a < n_live && a < AHEAD; a++)
+        __builtin_prefetch(&comb.t[live[a]][digits[a] - 1], 0, 1);
+    for (int a = 0; a < n_live; a++) {
+        if (a + AHEAD < n_live)
+            __builtin_prefetch(
+                &comb.t[live[a + AHEAD]][digits[a + AHEAD] - 1], 0, 1);
+        Jac t;
+        jac_add_aff(t, acc, comb.t[live[a]][digits[a] - 1], C);
+        acc = t;
+    }
+}
+
+// -- per-key comb cache ------------------------------------------------------
+//
+// Keyed on the 64-byte big-endian affine encoding.  A comb is built for
+// a key once it has been seen COMB_THRESHOLD times (across batches);
+// below that the wNAF ladder is cheaper than the table build.
+
+#define COMB_THRESHOLD 8
+#define COMB_CACHE_MAX 64    // ~11MB of tables
+#define SEEN_MAX 4096
+
+struct KeyHash {
+    size_t operator()(const std::array<u8, 64> &k) const {
+        u64 h = 1469598103934665603ULL;
+        for (u8 c : k) {
+            h ^= c;
+            h *= 1099511628211ULL;
+        }
+        return (size_t)h;
+    }
+};
+
+struct CombCache {
+    std::mutex mu;
+    // key -> (last-used tick, table); shared_ptr so an LRU eviction
+    // cannot free a table a concurrently running batch still holds
+    std::unordered_map<std::array<u8, 64>,
+                       std::pair<u64, std::shared_ptr<Comb>>, KeyHash>
+        combs;
+    std::unordered_map<std::array<u8, 64>, u64, KeyHash> seen;
+    u64 tick = 0;
+};
+
+CombCache COMB_CACHE[2];
+
+// -- context init -----------------------------------------------------------
+
+void ctx_init(Ctx &C, const CurveDef &D) {
+    mod_init(C.P, D.p);
+    mod_init(C.N, D.n);
+    to_mont(C.a, D.a, C.P);
+    to_mont(C.b, D.b, C.P);
+    C.a_is_m3 = D.a_is_m3;
+    // static G table: odd multiples G, 3G, ..., (2*G_TABLE-1)G
+    Jac g, g2, acc;
+    to_mont(g.X, D.gx, C.P);
+    to_mont(g.Y, D.gy, C.P);
+    memcpy(g.Z, C.P.one, 32);
+    g.inf = false;
+    jac_dbl(g2, g, C);
+    acc = g;
+    std::vector<Jac> tab(G_TABLE);
+    for (int i = 0; i < G_TABLE; i++) {
+        tab[i] = acc;
+        Jac next;
+        jac_add(next, acc, g2, C);
+        acc = next;
+    }
+    // batch-normalize the table to affine (one inversion)
+    u64 prod[4];
+    memcpy(prod, C.P.one, 32);
+    std::vector<std::array<u64, 4>> prefix(G_TABLE);
+    for (int i = 0; i < G_TABLE; i++) {
+        memcpy(prefix[i].data(), prod, 32);
+        mont_mul(prod, prod, tab[i].Z, C.P);
+    }
+    u64 inv[4];
+    mont_inv(inv, prod, C.P);
+    for (int i = G_TABLE - 1; i >= 0; i--) {
+        u64 zi[4];
+        mont_mul(zi, inv, prefix[i].data(), C.P);      // 1/Z_i
+        mont_mul(inv, inv, tab[i].Z, C.P);             // drop Z_i
+        u64 zi2[4], zi3[4];
+        mont_sqr(zi2, zi, C.P);
+        mont_mul(zi3, zi2, zi, C.P);
+        mont_mul(C.g_tab[i].x, tab[i].X, zi2, C.P);
+        mont_mul(C.g_tab[i].y, tab[i].Y, zi3, C.P);
+    }
+    // static comb for the fixed base (used on the cached-key fast path)
+    comb_build(C.g_comb, C.g_tab[0], C);
+    C.ready = true;
+}
+
+std::once_flag CTX_ONCE[2];
+
+Ctx &get_ctx(int curve_id) {
+    Ctx &C = CTX[curve_id];
+    std::call_once(CTX_ONCE[curve_id], [&C, curve_id] {
+        ctx_init(C, curve_id == 0 ? K1 : R1);
+    });
+    return C;
+}
+
+// -- wNAF recoding ----------------------------------------------------------
+
+// k (4 limbs) -> signed odd digits in [-(2^(w-1)-1), 2^(w-1)-1], one per
+// bit position (0 = skip).  digits must hold 257 entries.
+int wnaf_recode(int8_t *digits, const u64 k_in[4], int w) {
+    u64 k[5] = {k_in[0], k_in[1], k_in[2], k_in[3], 0};
+    int len = 0;
+    int pos = 0;
+    memset(digits, 0, 257);
+    while (pos < 257) {
+        // find lowest set bit from pos
+        bool any = false;
+        for (int i = 0; i < 5; i++)
+            if (k[i]) { any = true; break; }
+        if (!any) break;
+        if (!((k[pos >> 6] >> (pos & 63)) & 1)) {
+            pos++;
+            continue;
+        }
+        // take w bits at pos
+        int limb = pos >> 6, sh = pos & 63;
+        u64 window = k[limb] >> sh;
+        if (sh && limb + 1 < 5) window |= k[limb + 1] << (64 - sh);
+        int d = (int)(window & ((1u << w) - 1));
+        if (d > (1 << (w - 1))) d -= (1 << w);
+        digits[pos] = (int8_t)d;
+        len = pos + 1;
+        // k -= d * 2^pos  (d odd, may be negative -> add)
+        if (d > 0) {
+            u128 br = 0;
+            u64 dd = (u64)d;
+            u64 sub0 = dd << sh;
+            u64 sub1 = sh ? (dd >> (64 - sh)) : 0;
+            u128 x = (u128)k[limb] - sub0;
+            k[limb] = (u64)x;
+            br = (x >> 64) ? 1 : 0;
+            for (int i = limb + 1; i < 5; i++) {
+                u128 y = (u128)k[i] - (i == limb + 1 ? sub1 : 0) - br;
+                k[i] = (u64)y;
+                br = (y >> 64) ? 1 : 0;
+            }
+        } else if (d < 0) {
+            u64 dd = (u64)(-d);
+            u64 add0 = dd << sh;
+            u64 add1 = sh ? (dd >> (64 - sh)) : 0;
+            u128 c = (u128)k[limb] + add0;
+            k[limb] = (u64)c;
+            c >>= 64;
+            for (int i = limb + 1; i < 5; i++) {
+                c += (u128)k[i] + (i == limb + 1 ? add1 : 0);
+                k[i] = (u64)c;
+                c >>= 64;
+            }
+        }
+        pos += w;
+    }
+    return len;
+}
+
+// big-endian 32 bytes -> 4 LE limbs
+inline void be_load(u64 out[4], const u8 in[32]) {
+    for (int i = 0; i < 4; i++) {
+        u64 v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | in[8 * (3 - i) + j];
+        out[i] = v;
+    }
+}
+
+inline void be_store(u8 out[32], const u64 in[4]) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            out[8 * (3 - i) + j] = (u8)(in[i] >> (8 * (7 - j)));
+}
+
+// on-curve check, inputs in mont(p): y^2 == x^3 + a x + b
+bool on_curve(const u64 x[4], const u64 y[4], const Ctx &C) {
+    u64 lhs[4], rhs[4], t[4];
+    mont_sqr(lhs, y, C.P);
+    mont_sqr(t, x, C.P);
+    mont_mul(rhs, t, x, C.P);
+    if (!is_zero4(C.a)) {
+        mont_mul(t, C.a, x, C.P);
+        add_mod(rhs, rhs, t, C.P.m);
+    }
+    add_mod(rhs, rhs, C.b, C.P.m);
+    return cmp4(lhs, rhs) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched verify.  All big-endian byte inputs:
+//   pub64:   n*64  affine X||Y (already decompressed/validated shape)
+//   rs:      n*64  r||s
+//   digests: n*32  SHA-256(message)
+// verdicts: n bytes, 1/0.  Returns count of 1s.
+long long ecdsa_verify_batch_host(int curve_id, const u8 *pub64,
+                                  const u8 *rs, const u8 *digests,
+                                  u8 *verdicts, u64 count) {
+    Ctx &C = get_ctx(curve_id);
+    CombCache &CC = COMB_CACHE[curve_id];
+    std::vector<Jac> results(count);
+    std::vector<u64> rvals(count * 4);
+    long long ok = 0;
+
+    // Phase 1: parse + validate every row; collect s values (mont n)
+    // for ONE batched inversion instead of one Fermat chain per row.
+    struct RowState {
+        u64 e[4], r[4], qxm[4], qym[4], sm[4];
+        bool live;
+    };
+    std::vector<RowState> st(count);
+    for (u64 i = 0; i < count; i++) {
+        verdicts[i] = 0;
+        results[i].inf = true;
+        st[i].live = false;
+        u64 r[4], s[4];
+        be_load(r, rs + 64 * i);
+        be_load(s, rs + 64 * i + 32);
+        // 0 < r < n, 0 < s < n
+        if (is_zero4(r) || is_zero4(s) || cmp4(r, C.N.m) >= 0 ||
+            cmp4(s, C.N.m) >= 0)
+            continue;
+        be_load(st[i].e, digests + 32 * i);
+        cond_sub(st[i].e, C.N.m);  // digest < 2^256 < 2n for these curves
+        u64 qx[4], qy[4];
+        be_load(qx, pub64 + 64 * i);
+        be_load(qy, pub64 + 64 * i + 32);
+        if (cmp4(qx, C.P.m) >= 0 || cmp4(qy, C.P.m) >= 0) continue;
+        to_mont(st[i].qxm, qx, C.P);
+        to_mont(st[i].qym, qy, C.P);
+        if (!on_curve(st[i].qxm, st[i].qym, C)) continue;
+        to_mont(st[i].sm, s, C.N);
+        memcpy(st[i].r, r, 32);
+        st[i].live = true;
+    }
+
+    // Phase 2: batch s-inversion mod n (Montgomery's trick: ~3 muls per
+    // row + one Fermat chain per BATCH, vs ~450 ops per row)
+    {
+        std::vector<std::array<u64, 4>> prefix(count);
+        u64 prod[4];
+        memcpy(prod, C.N.one, 32);
+        for (u64 i = 0; i < count; i++) {
+            if (!st[i].live) continue;
+            memcpy(prefix[i].data(), prod, 32);
+            mont_mul(prod, prod, st[i].sm, C.N);
+        }
+        u64 inv[4];
+        mont_inv(inv, prod, C.N);
+        for (u64 ii = count; ii-- > 0;) {
+            if (!st[ii].live) continue;
+            u64 wi[4];
+            mont_mul(wi, inv, prefix[ii].data(), C.N);
+            mont_mul(inv, inv, st[ii].sm, C.N);
+            memcpy(st[ii].sm, wi, 32);  // sm now holds w = s^-1 (mont n)
+        }
+    }
+
+    // Phase 3: per-row scalar multiplication.  Keys with a cached comb
+    // take the no-doubling path (<= 67 mixed adds); cold keys take the
+    // interleaved wNAF ladder.  Key popularity is tracked so hot keys
+    // get a comb built once (~2.6ms) and amortized.
+    //
+    // The cache mutex covers ONLY the bookkeeping + builds below; the
+    // per-row multiplications run lock-free (row_comb's shared_ptrs
+    // keep any concurrently evicted table alive until this batch ends).
+    std::unordered_map<std::array<u8, 64>, std::shared_ptr<Comb>, KeyHash>
+        row_comb;
+    {
+        std::lock_guard<std::mutex> cache_lock(CC.mu);
+        CC.tick++;
+        // popularity: one bump per LIVE ROW of an uncached key (a key's
+        // in-batch multiplicity counts toward the threshold)
+        for (u64 i = 0; i < count; i++) {
+            if (!st[i].live) continue;
+            std::array<u8, 64> key;
+            memcpy(key.data(), pub64 + 64 * i, 64);
+            auto it = CC.combs.find(key);
+            if (it != CC.combs.end()) {
+                it->second.first = CC.tick;
+                row_comb[key] = it->second.second;
+                continue;
+            }
+            if (row_comb.find(key) == row_comb.end())
+                row_comb[key] = nullptr;
+            CC.seen[key]++;
+        }
+        // build tables for keys that crossed the threshold
+        for (u64 i = 0; i < count; i++) {
+            if (!st[i].live) continue;
+            std::array<u8, 64> key;
+            memcpy(key.data(), pub64 + 64 * i, 64);
+            if (row_comb[key] != nullptr) continue;
+            auto sit = CC.seen.find(key);
+            if (sit == CC.seen.end() || sit->second < COMB_THRESHOLD)
+                continue;
+            if (CC.combs.size() >= COMB_CACHE_MAX) {
+                // evict least-recently-used (linear scan; <= 64
+                // entries).  Entries touched THIS batch carry the
+                // current tick and are never the minimum unless the
+                // whole cache is current — in which case eviction is
+                // skipped rather than dropping a just-used table.
+                auto lru = CC.combs.begin();
+                for (auto jt = CC.combs.begin(); jt != CC.combs.end();
+                     ++jt)
+                    if (jt->second.first < lru->second.first) lru = jt;
+                if (lru->second.first == CC.tick) continue;
+                CC.combs.erase(lru);  // shared_ptr: users keep it alive
+            }
+            auto qcomb = std::make_shared<Comb>();
+            Aff base;
+            memcpy(base.x, st[i].qxm, 32);
+            memcpy(base.y, st[i].qym, 32);
+            comb_build(*qcomb, base, C);
+            CC.combs[key] = {CC.tick, qcomb};
+            CC.seen.erase(key);
+            row_comb[key] = qcomb;
+        }
+        if (CC.seen.size() > SEEN_MAX) CC.seen.clear();
+    }
+
+    for (u64 i = 0; i < count; i++) {
+        if (!st[i].live) continue;
+        // u1 = e*w ; u2 = r*w  (mod n, out of the domain for recoding)
+        u64 em[4], rm[4], u1m[4], u2m[4], u1[4], u2[4];
+        to_mont(em, st[i].e, C.N);
+        to_mont(rm, st[i].r, C.N);
+        mont_mul(u1m, em, st[i].sm, C.N);
+        mont_mul(u2m, rm, st[i].sm, C.N);
+        from_mont(u1, u1m, C.N);
+        from_mont(u2, u2m, C.N);
+        memcpy(&rvals[4 * i], st[i].r, 32);
+
+        std::array<u8, 64> key;
+        memcpy(key.data(), pub64 + 64 * i, 64);
+        const std::shared_ptr<Comb> &qcomb = row_comb[key];
+
+        Jac acc;
+        acc.inf = true;
+        if (qcomb != nullptr) {
+            // fast path: two comb evaluations, zero doublings
+            comb_eval(acc, C.g_comb, u1, C);
+            comb_eval(acc, *qcomb, u2, C);
+        } else {
+            // cold path: interleaved wNAF, one shared double ladder
+            Jac qtab[Q_TABLE], q, q2;
+            memcpy(q.X, st[i].qxm, 32);
+            memcpy(q.Y, st[i].qym, 32);
+            memcpy(q.Z, C.P.one, 32);
+            q.inf = false;
+            jac_dbl(q2, q, C);
+            qtab[0] = q;
+            for (int k = 1; k < Q_TABLE; k++)
+                jac_add(qtab[k], qtab[k - 1], q2, C);
+            int8_t d1[257], d2[257];
+            int l1 = wnaf_recode(d1, u1, G_W);
+            int l2 = wnaf_recode(d2, u2, Q_W);
+            int top = l1 > l2 ? l1 : l2;
+            for (int bit = top - 1; bit >= 0; bit--) {
+                if (!acc.inf) {
+                    Jac t;
+                    jac_dbl(t, acc, C);
+                    acc = t;
+                }
+                int dg = d1[bit];
+                if (dg) {
+                    Aff pt = C.g_tab[(dg > 0 ? dg : -dg) >> 1];
+                    if (dg < 0) sub_mod(pt.y, C.P.m, pt.y, C.P.m);
+                    Jac t;
+                    jac_add_aff(t, acc, pt, C);
+                    acc = t;
+                }
+                dg = d2[bit];
+                if (dg) {
+                    Jac pt = qtab[(dg > 0 ? dg : -dg) >> 1];
+                    if (dg < 0) sub_mod(pt.Y, C.P.m, pt.Y, C.P.m);
+                    Jac t;
+                    jac_add(t, acc, pt, C);
+                    acc = t;
+                }
+            }
+        }
+        if (acc.inf || is_zero4(acc.Z)) continue;
+        results[i] = acc;
+        verdicts[i] = 2;  // provisional: needs the x == r check below
+    }
+    // batch affinization: one inversion for every pending Z
+    std::vector<std::array<u64, 4>> prefix(count);
+    u64 prod[4];
+    memcpy(prod, C.P.one, 32);
+    for (u64 i = 0; i < count; i++) {
+        if (verdicts[i] != 2) continue;
+        memcpy(prefix[i].data(), prod, 32);
+        mont_mul(prod, prod, results[i].Z, C.P);
+    }
+    u64 inv[4];
+    mont_inv(inv, prod, C.P);
+    for (u64 ii = count; ii-- > 0;) {
+        if (verdicts[ii] != 2) continue;
+        u64 zi[4], zi2[4], xa[4], x_plain[4];
+        mont_mul(zi, inv, prefix[ii].data(), C.P);
+        mont_mul(inv, inv, results[ii].Z, C.P);
+        mont_sqr(zi2, zi, C.P);
+        mont_mul(xa, results[ii].X, zi2, C.P);
+        from_mont(x_plain, xa, C.P);
+        // valid iff x mod n == r: x in [0,p), r in (0,n); since
+        // n <= p < 2n the only cases are x == r or x == r + n
+        u64 r[4];
+        memcpy(r, &rvals[4 * ii], 32);
+        bool good = cmp4(x_plain, r) == 0;
+        if (!good) {
+            u64 rpn[4];
+            u128 c = 0;
+            for (int k = 0; k < 4; k++) {
+                c += (u128)r[k] + C.N.m[k];
+                rpn[k] = (u64)c;
+                c >>= 64;
+            }
+            good = !c && cmp4(rpn, C.P.m) < 0 && cmp4(x_plain, rpn) == 0;
+        }
+        verdicts[ii] = good ? 1 : 0;
+        if (good) ok++;
+    }
+    return ok;
+}
+
+// Decompress n SEC1 points (33 bytes each: 02/03 || X) to big-endian
+// X||Y pairs.  status[i]: 0 ok, 1 invalid.  Returns ok count.
+long long ecdsa_decompress_many(int curve_id, const u8 *in33, u8 *out64,
+                                u8 *status, u64 count) {
+    Ctx &C = get_ctx(curve_id);
+    long long ok = 0;
+    for (u64 i = 0; i < count; i++) {
+        const u8 *p = in33 + 33 * i;
+        status[i] = 1;
+        memset(out64 + 64 * i, 0, 64);
+        if (p[0] != 2 && p[0] != 3) continue;
+        u64 x[4];
+        be_load(x, p + 1);
+        if (cmp4(x, C.P.m) >= 0) continue;
+        u64 xm[4], rhs[4], t[4];
+        to_mont(xm, x, C.P);
+        mont_sqr(t, xm, C.P);
+        mont_mul(rhs, t, xm, C.P);
+        if (!is_zero4(C.a)) {
+            mont_mul(t, C.a, xm, C.P);
+            add_mod(rhs, rhs, t, C.P.m);
+        }
+        add_mod(rhs, rhs, C.b, C.P.m);
+        // sqrt: both primes are 3 mod 4 -> y = rhs^((p+1)/4)
+        u64 exp[4];
+        memcpy(exp, C.P.m, 32);
+        // (p+1)/4: p is 3 mod 4 so p+1 has two low zero bits
+        u128 c = (u128)exp[0] + 1;
+        exp[0] = (u64)c;
+        for (int k = 1; k < 4 && (c >>= 64); k++) {
+            c += exp[k];
+            exp[k] = (u64)c;
+        }
+        // shift right by 2
+        for (int k = 0; k < 4; k++) {
+            exp[k] >>= 2;
+            if (k < 3) exp[k] |= exp[k + 1] << 62;
+        }
+        u64 ym[4];
+        memcpy(ym, C.P.one, 32);
+        for (int bit = 255; bit >= 0; bit--) {
+            mont_sqr(ym, ym, C.P);
+            if ((exp[bit >> 6] >> (bit & 63)) & 1)
+                mont_mul(ym, ym, rhs, C.P);
+        }
+        u64 chk[4];
+        mont_sqr(chk, ym, C.P);
+        if (cmp4(chk, rhs) != 0) continue;  // not a quadratic residue
+        u64 y[4];
+        from_mont(y, ym, C.P);
+        if ((y[0] & 1) != (u64)(p[0] & 1)) {
+            // y = p - y  (y != 0 unless rhs == 0; subtraction still valid
+            // because -0 folds to p, caught below)
+            u128 br = 0;
+            for (int k = 0; k < 4; k++) {
+                u128 d = (u128)C.P.m[k] - y[k] - br;
+                y[k] = (u64)d;
+                br = (d >> 64) ? 1 : 0;
+            }
+            cond_sub(y, C.P.m);  // normalize p - 0 -> 0
+        }
+        be_store(out64 + 64 * i, x);
+        be_store(out64 + 64 * i + 32, y);
+        status[i] = 0;
+        ok++;
+    }
+    return ok;
+}
+
+}  // extern "C"
