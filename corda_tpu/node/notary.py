@@ -128,6 +128,11 @@ class RaftUniquenessProvider(UniquenessProvider):
         for k, v in deserialize(data):
             self._map.put(bytes(k), bytes(v))
 
+    def is_consumed(self, ref: StateRef) -> bool:
+        """Whether this REPLICA's applied log knows `ref` as spent —
+        a replication observability hook (cluster tests, dryrun)."""
+        return self._map.get(PersistentUniquenessProvider._key(ref)) is not None
+
     def apply(self, command: dict):
         """State-machine apply (runs on every replica, in log order)."""
         if command.get("kind") != "putall":

@@ -1,15 +1,21 @@
-"""Headless network visualiser (reference `samples/network-visualiser/` —
-the JavaFX map UI is replaced by a terminal/JSONL event renderer over the
-Simulation event stream; the *simulation engine* lives in
-`corda_tpu.testing.simulation`).
+"""Network visualiser (reference `samples/network-visualiser/`): the
+Simulation event stream rendered three ways — aligned terminal text,
+JSONL, or an ANIMATED browser map (`--web PORT`) where message pulses
+travel node-to-node on an SVG layout while flows light their nodes
+(the graphical tier the reference implements in JavaFX; the page is
+webserver/static/visualiser.html).  The *simulation engine* lives in
+`corda_tpu.testing.simulation`.
 
 Run: python -m corda_tpu.samples.visualiser [--json] [--latency SECONDS]
+     python -m corda_tpu.samples.visualiser --web 8350
 """
 from __future__ import annotations
 
 import json
 import sys
 from typing import Optional, TextIO
+
+from ..utils.miniweb import MiniWebServer
 
 
 class ConsoleVisualiser:
@@ -53,10 +59,98 @@ class ConsoleVisualiser:
         self._stream.write(line + "\n")
 
 
+class EventRecorder:
+    """Buffers the whole event stream for replay (the web map animates
+    the virtual-time run at a human-visible pace client-side)."""
+
+    def __init__(self):
+        self.events = []
+
+    def attach(self, simulation) -> None:
+        simulation.events.subscribe(
+            lambda ev: self.events.append({"kind": ev.kind, **ev.detail})
+        )
+
+
+class WebVisualiser(MiniWebServer):
+    """Serves the animated map page + the recorded event stream; POST
+    /run re-executes the simulation for a fresh stream.  Built on the
+    shared MiniWebServer scaffold (utils/miniweb.py)."""
+
+    pages = {"/": "visualiser.html", "/index.html": "visualiser.html"}
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import threading
+
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._summary = ""
+        super().__init__(host=host, port=port)
+
+    def handle(self, method, path, query, body):
+        if method == "GET" and path == "/events":
+            # snapshot under the lock, respond AFTER releasing it — a
+            # stalled client reading the response must not serialize
+            # every other request behind the lock
+            with self._lock:
+                events = list(self._events)
+                summary = self._summary
+            return 200, {"events": events, "summary": summary}
+        if method == "POST" and path == "/run":
+            self.run_simulation()
+            with self._lock:
+                n = len(self._events)
+            return 200, {"events": n}
+        return 404, {"error": f"no route {path}"}
+
+    def run_simulation(self) -> dict:
+        from ..testing.simulation import IRSSimulation
+
+        sim = IRSSimulation()
+        rec = EventRecorder()
+        rec.attach(sim)
+        try:
+            outcome = sim.run()
+        finally:
+            sim.stop()
+        with self._lock:
+            self._events = rec.events
+            self._summary = (
+                f"IRS simulation: {len(rec.events)} events — "
+                + ", ".join(f"{k}={v}" for k, v in sorted(outcome.items())
+                            if isinstance(v, (int, float, str)))
+            )
+        return outcome
+
+
 def main(argv=None) -> dict:
     from ..testing.simulation import IRSSimulation
 
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--web" in argv:
+        import argparse
+
+        ap = argparse.ArgumentParser(prog="corda_tpu.samples.visualiser")
+        ap.add_argument("--web", type=int, metavar="PORT", required=True)
+        ap.add_argument("--json", action="store_true")
+        ap.add_argument("--latency", type=float, default=None)
+        web_args = ap.parse_args(argv)
+        server = WebVisualiser(port=web_args.web)
+        print(
+            f"visualiser ready at http://127.0.0.1:{server.port}/ "
+            "(running the IRS simulation...)",
+            flush=True,
+        )
+        server.run_simulation()
+        print(f"simulation recorded: {len(server._events)} events", flush=True)
+        import time as _time
+
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return {}
     as_json = "--json" in argv
     latency = None
     if "--latency" in argv:

@@ -347,19 +347,35 @@ class MockNetwork:
             the client-side failover the reference gets from CopycatClient."""
 
             def __init__(self, providers):
-                self._providers = providers  # raft id -> RaftUniquenessProvider
+                # raft id -> RaftUniquenessProvider; public so tests and
+                # the multichip dryrun can observe per-REPLICA state
+                # (replication evidence, not just the cluster answer)
+                self.member_providers = providers
 
             def commit(self, states, tx_id, requesting_party):
                 last_exc = None
                 for _ in range(5):
                     leader = bus.elect()
-                    provider = self._providers[leader.node_id]
+                    provider = self.member_providers[leader.node_id]
                     try:
                         return provider.commit(states, tx_id, requesting_party)
                     except NotLeaderError as exc:  # lost leadership mid-commit
                         last_exc = exc
                         bus.now += 1.0
                 raise last_exc
+
+            def is_consumed(self, ref) -> bool:
+                return any(
+                    p.is_consumed(ref)
+                    for p in self.member_providers.values()
+                )
+
+            def replicas_consumed(self, ref) -> int:
+                """How many replicas' APPLIED logs know `ref` as spent."""
+                return sum(
+                    1 for p in self.member_providers.values()
+                    if p.is_consumed(ref)
+                )
 
         def provider_factory(cluster, members):
             ids = [f"r{i}" for i in range(len(members))]

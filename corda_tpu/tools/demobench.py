@@ -18,9 +18,11 @@ from __future__ import annotations
 import shlex
 import sys
 import tempfile
+import threading
 from typing import Dict, Optional
 
 from ..testing.driver import Driver, NodeHandle, free_port
+from ..utils.miniweb import MiniWebServer
 
 
 class DemoBench:
@@ -29,8 +31,16 @@ class DemoBench:
         self.driver = Driver(self.base_dir, jax_platform="cpu")
         self.nodes: Dict[str, NodeHandle] = {}
         self.webs: Dict[str, object] = {}
+        self.meta: Dict[str, dict] = {}  # name -> {notary, network_map, web_port}
         self._map_address: Optional[str] = None
         self.out = out or sys.stdout
+        #: fleet mutations come from the REPL thread OR web handler
+        #: threads (the --web panel). Two locks: _spawn_lock serializes
+        #: the seconds-long mutations (add/kill) against each other;
+        #: _state_lock guards only the dict snapshots, so status reads
+        #: never block behind a node boot.
+        self._spawn_lock = threading.RLock()
+        self._state_lock = threading.Lock()
 
     def _p(self, text: str) -> None:
         self.out.write(text + "\n")
@@ -38,52 +48,96 @@ class DemoBench:
     # -- commands ------------------------------------------------------------
 
     def add(self, name: str, notary: bool = False, web: bool = False) -> NodeHandle:
-        legal = name if name.startswith("O=") else f"O={name},L=Demo,C=GB"
-        conf = {
-            "my_legal_name": legal,
-            "broker_port": free_port(),
-            "rpc_users": [
-                {"username": "admin", "password": "admin", "permissions": ["ALL"]}
-            ],
-        }
-        if notary:
-            conf["notary_type"] = "validating"
-        if self._map_address is None:
-            conf["network_map_service"] = True
-        else:
-            conf["network_map"] = self._map_address
-        handle = self.driver.start_node(conf, name=name.replace(" ", "-"))
-        if self._map_address is None:
-            self._map_address = f"127.0.0.1:{handle.broker_port}"
-        self.nodes[name] = handle
-        self._p(f"node {name} up: broker 127.0.0.1:{handle.broker_port}"
-                + (" [notary]" if notary else "")
-                + (" [network-map]" if conf.get("network_map_service") else ""))
-        if web:
-            self.start_web(name)
-        return handle
+        with self._spawn_lock:
+            with self._state_lock:
+                if name in self.nodes:
+                    raise ValueError(f"node {name!r} already exists")
+                is_map = self._map_address is None
+            legal = name if name.startswith("O=") else f"O={name},L=Demo,C=GB"
+            conf = {
+                "my_legal_name": legal,
+                "broker_port": free_port(),
+                "rpc_users": [
+                    {"username": "admin", "password": "admin",
+                     "permissions": ["ALL"]}
+                ],
+            }
+            if notary:
+                conf["notary_type"] = "validating"
+            if is_map:
+                conf["network_map_service"] = True
+            else:
+                conf["network_map"] = self._map_address
+            # the boot itself runs WITHOUT the state lock: status reads
+            # (the panel polls every 2.5s) must not block behind it
+            handle = self.driver.start_node(conf, name=name.replace(" ", "-"))
+            with self._state_lock:
+                if is_map:
+                    self._map_address = f"127.0.0.1:{handle.broker_port}"
+                self.nodes[name] = handle
+                self.meta[name] = {
+                    "notary": notary, "network_map": is_map, "web_port": None
+                }
+            self._p(f"node {name} up: broker 127.0.0.1:{handle.broker_port}"
+                    + (" [notary]" if notary else "")
+                    + (" [network-map]" if is_map else ""))
+            if web:
+                self.start_web(name)
+            return handle
 
     def start_web(self, name: str):
-        handle = self.nodes[name]
-        web = self.driver._spawn(
-            [
-                "-m", "corda_tpu.webserver",
-                "--connect", f"127.0.0.1:{handle.broker_port}",
-                "--port", str(free_port()),
-            ],
-            name=f"web-{name}",
-        )
-        from ..testing.driver import _wait_for
+        with self._spawn_lock:
+            with self._state_lock:
+                handle = self.nodes[name]
+            web_port = free_port()
+            web = self.driver._spawn(
+                [
+                    "-m", "corda_tpu.webserver",
+                    "--connect", f"127.0.0.1:{handle.broker_port}",
+                    "--port", str(web_port),
+                ],
+                name=f"web-{name}",
+            )
+            from ..testing.driver import _wait_for
 
-        _wait_for(
-            lambda: "webserver ready" in web.log() or not web.alive(),
-            timeout=60, what=f"webserver for {name}",
-        )
-        for line in web.log().splitlines():
-            if "webserver ready" in line:
-                self._p(f"  {line.strip()}")
-        self.webs[name] = web
-        return web
+            _wait_for(
+                lambda: "webserver ready" in web.log() or not web.alive(),
+                timeout=60, what=f"webserver for {name}",
+            )
+            for line in web.log().splitlines():
+                if "webserver ready" in line:
+                    self._p(f"  {line.strip()}")
+            with self._state_lock:
+                self.webs[name] = web
+                if name in self.meta:
+                    self.meta[name]["web_port"] = web_port
+            return web
+
+    def fleet_status(self) -> dict:
+        """JSON-shaped fleet snapshot for the web panel."""
+        with self._state_lock:
+            return {
+                "base_dir": self.base_dir,
+                "nodes": [
+                    {
+                        "name": name,
+                        "alive": h.alive(),
+                        "broker_port": h.broker_port,
+                        **self.meta.get(
+                            name,
+                            {"notary": False, "network_map": False,
+                             "web_port": None},
+                        ),
+                    }
+                    for name, h in self.nodes.items()
+                ],
+            }
+
+    def node_log(self, name: str, tail: int = 200) -> str:
+        with self._state_lock:
+            handle = self.nodes[name]
+        lines = handle.log().splitlines()
+        return "\n".join(lines[-tail:])
 
     def list(self) -> None:
         for name, h in self.nodes.items():
@@ -105,13 +159,16 @@ class DemoBench:
             client.close()
 
     def kill(self, name: str) -> None:
-        handle = self.nodes.pop(name, None)
-        if handle is not None:
-            handle.terminate()
-            self._p(f"{name} stopped")
-        web = self.webs.pop(name, None)
-        if web is not None:
-            web.terminate()
+        with self._spawn_lock:
+            with self._state_lock:
+                handle = self.nodes.pop(name, None)
+                self.meta.pop(name, None)
+                web = self.webs.pop(name, None)
+            if handle is not None:
+                handle.terminate()
+                self._p(f"{name} stopped")
+            if web is not None:
+                web.terminate()
 
     def shutdown(self) -> None:
         self.driver.shutdown()
@@ -151,15 +208,82 @@ class DemoBench:
                 self._p(f"error: {exc}")
 
 
+class FleetWebServer(MiniWebServer):
+    """The demobench fleet panel (reference `tools/demobench/`'s JavaFX
+    shell as a browser page): spawn/stop nodes and tail their logs over
+    a small JSON API; the page itself is webserver/static/fleet.html.
+    Built on the shared MiniWebServer scaffold (utils/miniweb.py)."""
+
+    pages = {"/": "fleet.html", "/index.html": "fleet.html"}
+
+    def __init__(self, bench: DemoBench, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.bench = bench
+        super().__init__(host=host, port=port)
+
+    def handle(self, method, path, query, body):
+        bench = self.bench
+        if method == "GET" and path == "/fleet":
+            return 200, bench.fleet_status()
+        if method == "GET" and path == "/fleet/logs":
+            name = query.get("name", "")
+            try:
+                tail = int(query.get("tail", "200"))
+            except ValueError:
+                return 400, {"error": "tail must be an integer"}
+            try:
+                return 200, {"log": bench.node_log(name, tail)}
+            except KeyError:
+                return 404, {"error": f"no node {name!r}"}
+        if method == "POST" and path == "/fleet/add":
+            name = str(body.get("name", "")).strip()
+            if not name:
+                return 400, {"error": "name required"}
+            handle = bench.add(
+                name, notary=bool(body.get("notary")),
+                web=bool(body.get("web")),
+            )
+            return 200, {"name": name, "broker_port": handle.broker_port}
+        if method == "POST" and path == "/fleet/kill":
+            name = str(body.get("name", ""))
+            with bench._state_lock:
+                known = name in bench.nodes
+            if not known:
+                return 404, {"error": f"no node {name!r}"}
+            bench.kill(name)
+            return 200, {"stopped": name}
+        return 404, {"error": f"no route {path}"}
+
+
 def main(argv=None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(prog="corda_tpu.tools.demobench")
     ap.add_argument("--base-dir")
+    ap.add_argument(
+        "--web", type=int, metavar="PORT", default=None,
+        help="serve the fleet panel GUI on this port (0 = ephemeral) "
+             "instead of the terminal REPL",
+    )
     args = ap.parse_args(argv)
     bench = DemoBench(base_dir=args.base_dir)
     try:
-        bench.repl()
+        if args.web is not None:
+            server = FleetWebServer(bench, port=args.web)
+            print(
+                f"demobench fleet panel ready at "
+                f"http://127.0.0.1:{server.port}/",
+                flush=True,
+            )
+            try:
+                import time
+
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                server.stop()
+        else:
+            bench.repl()
     finally:
         bench.shutdown()
     return 0

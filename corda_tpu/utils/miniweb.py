@@ -1,0 +1,103 @@
+"""Shared scaffold for the small web-UI servers (demobench fleet panel,
+network visualiser): ThreadingHTTPServer + JSON/static-page helpers with
+the same conventions as the main REST gateway's handler
+(webserver/server.py) — suppressed request logging, JSON errors for
+EVERY failure (a handler exception must produce a 500 body, never a
+dropped connection), daemon serve thread, stop().
+
+Subclasses implement `handle(method, path, query, body) -> (code, obj)`
+and list their static pages in `pages` (path -> filename under
+webserver/static). Handlers run on ThreadingHTTPServer threads: the
+subclass owns its locking, and must NOT hold locks across the response
+write (a stalled client would serialize every other request) — return
+the object and let the scaffold write it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+from urllib.parse import parse_qs, urlparse
+
+_STATIC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "webserver", "static",
+)
+
+
+class MiniWebServer:
+    #: URL path -> filename under webserver/static
+    pages: Dict[str, str] = {}
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, value) -> None:
+                body = json.dumps(value).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, method: str) -> None:
+                u = urlparse(self.path)
+                page = outer.pages.get(u.path) if method == "GET" else None
+                if page is not None:
+                    with open(os.path.join(_STATIC, page), "rb") as f:
+                        body = f.read()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                body = None
+                if method == "POST":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError:
+                        self._json(400, {"error": "bad JSON body"})
+                        return
+                try:
+                    code, value = outer.handle(method, u.path, query, body)
+                except KeyError as exc:
+                    self._json(404, {"error": f"not found: {exc}"})
+                    return
+                except Exception as exc:
+                    self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+                    return
+                self._json(code, value)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=type(self).__name__,
+        )
+        self._thread.start()
+
+    def handle(
+        self, method: str, path: str, query: Dict[str, str], body
+    ) -> Tuple[int, object]:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -367,3 +367,61 @@ class TestBFTClusterExpansion:
                             "cluster_size": 3}]},
                 str(tmp_path),
             )
+
+
+class TestDemobenchFleetWeb:
+    """The fleet panel (reference tools/demobench's JavaFX shell as a
+    browser page): spawn/stop nodes and tail logs through the JSON API
+    the page uses."""
+
+    def test_fleet_api_drives_network(self, tmp_path):
+        import json
+        import urllib.request
+
+        from corda_tpu.tools.demobench import DemoBench, FleetWebServer
+
+        out = io.StringIO()
+        bench = DemoBench(base_dir=str(tmp_path), out=out)
+        server = FleetWebServer(bench)
+        base = f"http://127.0.0.1:{server.port}"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(payload).encode(),
+                headers={"content-type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                return json.loads(resp.read())
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        try:
+            # the page itself serves
+            with urllib.request.urlopen(base + "/", timeout=10) as resp:
+                assert b"demobench fleet" in resp.read()
+            # drive a notary + bank network through the API
+            r = post("/fleet/add", {"name": "Notary", "notary": True})
+            assert r["broker_port"] > 0
+            post("/fleet/add", {"name": "BankA"})
+            fleet = get("/fleet")
+            names = {n["name"]: n for n in fleet["nodes"]}
+            assert names["Notary"]["alive"] and names["Notary"]["notary"]
+            assert names["BankA"]["alive"] and not names["BankA"]["notary"]
+            assert names["Notary"]["network_map"]  # first node hosts the map
+            log = get("/fleet/logs?name=BankA&tail=50")["log"]
+            assert log  # the node wrote something on boot
+            # stop one node from the panel
+            post("/fleet/kill", {"name": "BankA"})
+            fleet = get("/fleet")
+            assert all(n["name"] != "BankA" for n in fleet["nodes"])
+            # error surfaces as JSON, not a crash
+            try:
+                post("/fleet/kill", {"name": "Nope"})
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+            bench.shutdown()
