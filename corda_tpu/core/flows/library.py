@@ -243,6 +243,13 @@ class ResolveTransactionsFlow(FlowLogic):
         # Topological order: dependencies before dependents.
         ordered = _topological_sort(fetched)
         for stx in ordered:
+            # A dependency already in validated storage was verified when
+            # it was recorded — re-verifying it (piggybacked pools often
+            # carry transactions the receiver already holds) is pure
+            # repeat work with the same trust basis as the frontier's
+            # storage check above.
+            if storage.get(stx.id) is not None:
+                continue
             # Fetch attachments referenced by the dependency if missing.
             missing_atts = [
                 h for h in stx.tx.attachments

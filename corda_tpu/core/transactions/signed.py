@@ -24,6 +24,13 @@ from ..crypto.signing import DigitalSignatureWithKey
 from ..serialization.codec import deserialize, register_adapter, serialize
 from .wire import WireTransaction
 
+from collections import OrderedDict
+
+#: (content, key bytes, signature bytes) -> True for signatures that
+#: verified; bounded LRU, per process. See check_signatures_are_valid.
+_VERIFIED_SIGS: "OrderedDict[tuple, bool]" = OrderedDict()
+_VERIFIED_SIGS_MAX = 1 << 16
+
 
 class SignaturesMissingError(SignatureError):
     def __init__(self, missing: FrozenSet[PublicKey], descriptions: List[str], tx_id):
@@ -78,18 +85,35 @@ class TransactionWithSignatures:
 
     def check_signatures_are_valid(self) -> None:
         """Batch cryptographic check of all attached signatures over id.bytes
-        (replaces the reference's per-sig loop TransactionWithSignatures.kt:58-62)."""
+        (replaces the reference's per-sig loop TransactionWithSignatures.kt:58-62).
+
+        Successful verifications enter a per-process LRU keyed on the
+        exact (content, key, signature) bytes: verification is a pure
+        function of those bytes, and the SAME signatures re-check
+        several times per transaction lifecycle (pre-notarise, post-
+        notarise, dependency resolution), so cache hits skip the crypto
+        without changing any verdict. Failures are never cached."""
         if not self.sigs:
             return
         content = self.id.bytes
-        results = crypto_batch.verify_batch(
-            [(sig.by, sig.bytes, content) for sig in self.sigs]
-        )
-        bad = [i for i, ok in enumerate(results) if not ok]
-        if bad:
-            raise SignatureError(
-                f"invalid signature(s) at positions {bad} on {self.id}"
-            )
+        rows = [(sig.by, sig.bytes, content) for sig in self.sigs]
+        todo = [
+            i for i, (key, sig, _) in enumerate(rows)
+            if (content, key.encoded, sig) not in _VERIFIED_SIGS
+        ]
+        if todo:
+            results = crypto_batch.verify_batch([rows[i] for i in todo])
+            bad = [todo[j] for j, ok in enumerate(results) if not ok]
+            if bad:
+                raise SignatureError(
+                    f"invalid signature(s) at positions {bad} on {self.id}"
+                )
+            for i in todo:
+                key, sig, _ = rows[i]
+                _VERIFIED_SIGS[(content, key.encoded, sig)] = True
+                _VERIFIED_SIGS.move_to_end((content, key.encoded, sig))
+            while len(_VERIFIED_SIGS) > _VERIFIED_SIGS_MAX:
+                _VERIFIED_SIGS.popitem(last=False)
 
     def _missing_signatures(self) -> Set[PublicKey]:
         # The signed set is exactly the keys that produced valid signatures —

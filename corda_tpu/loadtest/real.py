@@ -82,16 +82,17 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
             try:
                 for _ in range(count):
                     try:
-                        fid = conn.proxy.start_flow_dynamic(
+                        # one RPC round trip per flow (start_flow_and_wait
+                        # replies from the flow's completion callback —
+                        # reference startFlow(...).returnValue semantics)
+                        conn.proxy.start_flow_and_wait(
                             "CashIssueFlow", Amount(100, "USD"), b"\x01",
-                            me, notary,
+                            me, notary, timeout=60,
                         )
-                        conn.proxy.flow_result(fid, 60)
-                        fid = conn.proxy.start_flow_dynamic(
+                        conn.proxy.start_flow_and_wait(
                             "CashPaymentFlow", Amount(100, token), info_b,
-                            notary,
+                            notary, timeout=60,
                         )
-                        conn.proxy.flow_result(fid, 60)
                         with lock:
                             done[0] += 1
                     except Exception as exc:  # gather, don't abort the run

@@ -84,6 +84,7 @@ class _Journal:
         self._path = path
         self._fh = open(path, "wb" if truncate else "ab")
         self.acks_since_compact = 0
+        self._unflushed_acks = 0
 
     def append_enqueue(self, msg: Message) -> None:
         hdr_blob = _encode_headers(msg.headers)
@@ -95,13 +96,25 @@ class _Journal:
         )
         self._append(_REC_ENQUEUE, body)
 
-    def append_ack(self, message_id: str) -> None:
-        self._append(_REC_ACK, message_id.encode("ascii"))
-        self.acks_since_compact += 1
+    #: flush ack records to the OS at most every N appends: a crash with
+    #: unflushed acks only REDELIVERS those messages (receiver dedup
+    #: absorbs it), so per-ack flush buys no correctness — enqueue
+    #: records still flush every time (losing one loses a message)
+    ACK_FLUSH_EVERY = 64
 
-    def _append(self, rec_type: int, body: bytes) -> None:
+    def append_ack(self, message_id: str) -> None:
+        self._append(_REC_ACK, message_id.encode("ascii"), flush=False)
+        self.acks_since_compact += 1
+        self._unflushed_acks += 1
+        if self._unflushed_acks >= self.ACK_FLUSH_EVERY:
+            self._fh.flush()
+            self._unflushed_acks = 0
+
+    def _append(self, rec_type: int, body: bytes, flush: bool = True) -> None:
         self._fh.write(struct.pack(">BI", rec_type, len(body)) + body)
-        self._fh.flush()
+        if flush:
+            self._fh.flush()
+            self._unflushed_acks = 0
 
     def compact(self, pending: List[Message]) -> bool:
         """Rewrite the journal as just the pending set, crash-safely: the
@@ -131,6 +144,7 @@ class _Journal:
         os.replace(self._path + ".tmp", self._path)
         self._fh = open(self._path, "ab")
         self.acks_since_compact = 0
+        self._unflushed_acks = 0
         return True
 
     def close(self) -> None:
@@ -416,7 +430,11 @@ class Broker:
                 q.not_empty.notify()
         return len(items)
 
-    def create_consumer(self, queue_name: str) -> Consumer:
+    def create_consumer(self, queue_name: str, prefetch: int = 32) -> Consumer:
+        # prefetch is a REMOTE-consumer concern (client-side buffering);
+        # local consumers pull under the broker lock with no buffer, so
+        # the parameter exists only for interface parity with
+        # net.RemoteBroker.create_consumer
         with self._lock:
             q = self._queues.get(queue_name)
             if q is None:

@@ -26,10 +26,12 @@ Design:
 """
 from __future__ import annotations
 
+import logging
 import socket
 import socketserver
 import struct
 import threading
+from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from .broker import (
@@ -54,6 +56,8 @@ OP_ACK = 8
 OP_CLOSE = 9
 OP_QUEUE_NAMES = 10
 OP_SEND_MANY = 11
+OP_ACK_ASYNC = 12   # fire-and-forget ack: no reply frame
+OP_RECEIVE_MANY = 13  # up to N messages in one reply
 
 # Reply codes (server -> client).
 RE_OK = 0x80
@@ -134,10 +138,20 @@ class _ClientHandler(socketserver.BaseRequestHandler):
                 try:
                     reply = self._dispatch(broker, op, body, consumer)
                 except (BrokerError, ValueError) as exc:
+                    if op == OP_ACK_ASYNC:
+                        # fire-and-forget: errors (ack of unknown id) are
+                        # correctness-neutral — redelivery + receiver
+                        # dedup absorb them — so log, never reply
+                        logging.getLogger(__name__).warning(
+                            "async ack failed: %s", exc
+                        )
+                        continue
                     reply = bytes([RE_ERR]) + _pack_str(
                         type(exc).__name__
                     ) + _pack_str(str(exc))
                 else:
+                    if reply is None:
+                        continue  # one-way op: no reply frame
                     if op == OP_CONSUME and reply[0] == RE_OK:
                         consumer = self._pending_consumer
                     if op == OP_CLOSE:
@@ -224,7 +238,7 @@ class _ClientHandler(socketserver.BaseRequestHandler):
                 + _pack_bytes(_encode_headers(msg.headers))
                 + _pack_bytes(msg.payload)
             )
-        if op == OP_ACK:
+        if op == OP_ACK or op == OP_ACK_ASYNC:
             if consumer is None:
                 raise BrokerError("OP_ACK before OP_CONSUME")
             mid, pos = _unpack_str(body, 1)
@@ -232,7 +246,34 @@ class _ClientHandler(socketserver.BaseRequestHandler):
             consumer.ack(
                 Message(payload=b"", message_id=mid, delivery_count=delivery)
             )
-            return bytes([RE_OK])
+            # ACK_ASYNC is one-way: the consumer pipeline must not pay a
+            # round trip per processed message
+            return None if op == OP_ACK_ASYNC else bytes([RE_OK])
+        if op == OP_RECEIVE_MANY:
+            if consumer is None:
+                raise BrokerError("OP_RECEIVE_MANY before OP_CONSUME")
+            (timeout_ms, limit) = struct.unpack_from(">II", body, 1)
+            limit = max(1, min(limit, 256))
+            # wait (bounded slice, like OP_RECEIVE) for the FIRST message,
+            # then drain whatever else is immediately available
+            first = consumer.receive(
+                timeout=5.0 if timeout_ms == 0 else timeout_ms / 1000.0
+            )
+            msgs = []
+            if first is not None:
+                msgs.append(first)
+                while len(msgs) < limit:
+                    nxt = consumer.receive(timeout=0)
+                    if nxt is None:
+                        break
+                    msgs.append(nxt)
+            out = bytearray(bytes([RE_MSG]) + struct.pack(">I", len(msgs)))
+            for msg in msgs:
+                out += _pack_str(msg.message_id)
+                out += struct.pack(">I", msg.delivery_count)
+                out += _pack_bytes(_encode_headers(msg.headers))
+                out += _pack_bytes(msg.payload)
+            return bytes(out)
         if op == OP_CLOSE:
             if consumer is not None:
                 consumer.close()
@@ -310,21 +351,40 @@ class _Conn:
 
 class RemoteConsumer:
     """Consumer over its own connection; crash of this process (or close of
-    the socket) triggers server-side redelivery of unacked messages."""
+    the socket) triggers server-side redelivery of unacked messages.
 
-    def __init__(self, broker: "RemoteBroker", queue_name: str):
+    Pipelined wire usage (the round-trip count per processed message was
+    the system-throughput bottleneck on the hot path):
+      * receives go through OP_RECEIVE_MANY with a local buffer — one
+        round trip fetches everything the queue has ready (<= 32);
+      * acks go through OP_ACK_ASYNC, one-way — no reply frame. A lost
+        ack only means redelivery, which receiver-side dedup absorbs.
+    """
+
+    def __init__(self, broker: "RemoteBroker", queue_name: str,
+                 prefetch: int = 32):
+        # prefetch > 1 suits EXCLUSIVE queues (a node's own p2p/rpc
+        # queues). COMPETING consumers (verifier workers sharing one
+        # request queue) must pass prefetch=1: buffered messages are
+        # in-flight server-side and cannot be stolen by idle peers
+        # while this consumer is alive-but-slow.
         self._conn = _Conn(broker.host, broker.port, broker.client_wrap)
         self._conn.request(bytes([OP_CONSUME]) + _pack_str(queue_name))
         self._closed = False
+        self._prefetch = max(1, int(prefetch))
+        self._buffer: "deque[Message]" = deque()
 
     def receive(self, timeout: Optional[float] = None) -> Optional[Message]:
         if self._closed:
             raise QueueClosedError("remote consumer is closed")
+        if self._buffer:
+            return self._buffer.popleft()
         while True:
             timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
             try:
                 reply = self._conn.request(
-                    bytes([OP_RECEIVE]) + struct.pack(">I", timeout_ms)
+                    bytes([OP_RECEIVE_MANY])
+                    + struct.pack(">II", timeout_ms, self._prefetch)
                 )
             except (ConnectionError, OSError):
                 # Transport died (broker gone): behave like a closed queue —
@@ -332,28 +392,39 @@ class RemoteConsumer:
                 # subsequent receives raise QueueClosedError.
                 self._closed = True
                 return None
-            if reply[0] != RE_EMPTY:
+            (count,) = struct.unpack_from(">I", reply, 1)
+            if count:
                 break
             if timeout is not None:
                 return None
-        mid, pos = _unpack_str(reply, 1)
-        (delivery,) = struct.unpack_from(">I", reply, pos)
-        pos += 4
-        hdr_blob, pos = _unpack_bytes(reply, pos)
-        payload, _ = _unpack_bytes(reply, pos)
-        return Message(
-            payload=payload,
-            headers=_decode_headers(hdr_blob),
-            message_id=mid,
-            delivery_count=delivery,
-        )
+        pos = 5
+        for _ in range(count):
+            mid, pos = _unpack_str(reply, pos)
+            (delivery,) = struct.unpack_from(">I", reply, pos)
+            pos += 4
+            hdr_blob, pos = _unpack_bytes(reply, pos)
+            payload, pos = _unpack_bytes(reply, pos)
+            self._buffer.append(Message(
+                payload=payload,
+                headers=_decode_headers(hdr_blob),
+                message_id=mid,
+                delivery_count=delivery,
+            ))
+        return self._buffer.popleft()
 
     def ack(self, msg: Message) -> None:
-        self._conn.request(
-            bytes([OP_ACK])
+        if self._closed:
+            return  # transport gone: the broker will redeliver anyway
+        frame = (
+            bytes([OP_ACK_ASYNC])
             + _pack_str(msg.message_id)
             + struct.pack(">I", msg.delivery_count)
         )
+        try:
+            with self._conn.lock:
+                _send_frame(self._conn.sock, frame)
+        except (ConnectionError, OSError):
+            self._closed = True  # redelivery + dedup absorb the loss
 
     def close(self) -> None:
         if self._closed:
@@ -448,8 +519,10 @@ class RemoteBroker:
         reply = self._control.request(bytes(body))
         return struct.unpack_from(">I", reply, 1)[0]
 
-    def create_consumer(self, queue_name: str) -> RemoteConsumer:
-        c = RemoteConsumer(self, queue_name)
+    def create_consumer(
+        self, queue_name: str, prefetch: int = 32
+    ) -> RemoteConsumer:
+        c = RemoteConsumer(self, queue_name, prefetch=prefetch)
         self._consumers.append(c)
         return c
 

@@ -38,6 +38,17 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    # Production nodes raise the cyclic-GC thresholds: flow/session/codec
+    # churn trips CPython's default gen0 threshold (700) thousands of
+    # times per second under load, and each full collection stalls every
+    # pump thread. The JVM reference tunes its collector for the same
+    # reason. CORDA_TPU_GC_THRESHOLD=0 disables the tuning.
+    import gc
+
+    _gc_thresh = int(os.environ.get("CORDA_TPU_GC_THRESHOLD", "50000"))
+    if _gc_thresh > 0:
+        gc.set_threshold(_gc_thresh, 50, 50)
+
     import logging
 
     logging.basicConfig(

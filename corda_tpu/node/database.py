@@ -229,7 +229,16 @@ class CheckpointStorage:
 
 class TransactionStorage:
     """Validated SignedTransactions by id, with a commit-observer feed
-    (reference DBTransactionStorage + Rx updates)."""
+    (reference DBTransactionStorage + Rx updates).
+
+    Reads go through an instance LRU: `get` used to deserialize a fresh
+    SignedTransaction per call, and every fresh instance re-derives its
+    id (a full Merkle build) on first use — backchain resolution and
+    dependency checks hit the same hot transactions repeatedly, making
+    this one of the larger per-pair costs in the system profile.
+    SignedTransaction is immutable, so sharing instances is safe."""
+
+    CACHE_MAX = 1024
 
     def __init__(self, db: NodeDatabase):
         self.db = db
@@ -238,6 +247,14 @@ class TransactionStorage:
             "(tx_id BLOB PRIMARY KEY, blob BLOB NOT NULL)"
         )
         self._observers: List[Callable] = []
+        import threading
+        from collections import OrderedDict
+
+        self._cache: "OrderedDict[bytes, object]" = OrderedDict()
+        # flows run on RPC pool workers + the p2p pump + the blocking
+        # executor concurrently; an unsynchronized hit-then-move_to_end
+        # racing an eviction would raise KeyError out of storage.get
+        self._cache_lock = threading.Lock()
 
     def add(self, stx) -> bool:
         """Record; returns False if already present. Fires observers on new."""
@@ -264,15 +281,32 @@ class TransactionStorage:
                 )
                 recorded.append(stx)
         for stx in recorded:
+            self._cache_put(stx.id.bytes, stx)
             for obs in list(self._observers):
                 obs(stx)
         return recorded
 
+    def _cache_put(self, key: bytes, stx) -> None:
+        with self._cache_lock:
+            self._cache[key] = stx
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.CACHE_MAX:
+                self._cache.popitem(last=False)
+
     def get(self, tx_id: SecureHash):
+        with self._cache_lock:
+            hit = self._cache.get(tx_id.bytes)
+            if hit is not None:
+                self._cache.move_to_end(tx_id.bytes)
+                return hit
         rows = self.db.query(
             "SELECT blob FROM transactions WHERE tx_id = ?", (tx_id.bytes,)
         )
-        return deserialize(rows[0][0]) if rows else None
+        if not rows:
+            return None
+        stx = deserialize(rows[0][0])
+        self._cache_put(tx_id.bytes, stx)
+        return stx
 
     def track(self, observer: Callable) -> None:
         self._observers.append(observer)

@@ -38,12 +38,13 @@ class _Proxy:
             raise AttributeError(name)
 
         def call(*args, **kwargs):
-            # blocking waits (flow_result(fid, timeout)) must outlive the
+            # blocking waits (flow_result(fid, timeout) and
+            # start_flow_and_wait(..., timeout=)) must outlive the
             # transport's default reply timeout — positional or keyword
             timeout = None
-            if name == "flow_result":
+            if name in ("flow_result", "start_flow_and_wait"):
                 wait = kwargs.get("timeout")
-                if wait is None and len(args) >= 2:
+                if wait is None and name == "flow_result" and len(args) >= 2:
                     wait = args[1]
                 if isinstance(wait, (int, float)):
                     timeout = float(wait) + 5.0

@@ -79,6 +79,20 @@ class CordaRPCOps:
         handle = self._smm.start_flow(flow, *args, **kwargs)
         return handle.flow_id
 
+    def start_flow_and_wait(self, flow_name: str, *args, **kwargs):
+        """Start a flow and return its RESULT in one RPC round trip
+        (reference startFlow(...).returnValue semantics: the result is
+        pushed when ready, not polled with a second request). The RPC
+        server replies from the flow's completion callback, so waits
+        never pin a worker thread.
+
+        `timeout=` bounds the WAIT, not the flow — it is consumed here
+        (and by the server's fast path), never passed to the flow
+        constructor."""
+        timeout = kwargs.pop("timeout", None)
+        fid = self.start_flow_dynamic(flow_name, *args, **kwargs)
+        return self.flow_result(fid, timeout)
+
     def registered_flows(self) -> List[str]:
         """Names startable over RPC (reference CordaRPCOps.registeredFlows)."""
         return sorted(

@@ -56,10 +56,14 @@ class RPCServer:
         # behind it on the single consume thread.
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..utils.profiling import maybe_profiled, try_claim_thread_profile
+
         self._pool = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="rpc-worker"
+            max_workers=8, thread_name_prefix="rpc-worker",
+            # CORDA_TPU_PROFILE_THREAD=rpcpool profiles ONE worker as a
+            # stand-in for the pool (flow bodies run here)
+            initializer=lambda: try_claim_thread_profile("rpcpool"),
         )
-        from ..utils.profiling import maybe_profiled
 
         self._thread = threading.Thread(
             target=maybe_profiled(self._serve, "rpc"),
@@ -167,7 +171,9 @@ class RPCServer:
     def _permitted(self, user: RPCUser, method: str, args: tuple) -> bool:
         if "ALL" in user.permissions:
             return True
-        if method == "start_flow_dynamic":
+        if method in ("start_flow_dynamic", "start_flow_and_wait"):
+            # one-round-trip start+wait carries the same flow-scoped
+            # permission semantics as a plain start
             flow_name = args[0] if args else ""
             return (
                 f"StartFlow.{flow_name}" in user.permissions
@@ -208,6 +214,28 @@ class RPCServer:
             # starve other clients (head-of-line blocking)
             if self._handle_flow_result_async(req_id, reply_to, args, kwargs):
                 return
+        if method_name == "start_flow_and_wait" and hasattr(
+            self.ops, "flow_result_future"
+        ):
+            # one-round-trip start+result: start synchronously (fast,
+            # surfaces bad-flow errors immediately), then reply from the
+            # completion callback like flow_result
+            wait_timeout = kwargs.pop("timeout", None)  # not a flow arg
+            try:
+                fid = self.ops.start_flow_dynamic(*args, **kwargs)
+            except Exception as exc:
+                self._reply(reply_to, {
+                    "kind": "reply", "id": req_id,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+                return
+            if self._handle_flow_result_async(
+                req_id, reply_to, (fid,), {"timeout": wait_timeout}
+            ):
+                return
+            # future unavailable (already-done edge): fall through to a
+            # synchronous result fetch
+            args, kwargs, method_name = (fid,), {}, "flow_result"
         smm = getattr(self.ops, "_smm", None)
         timer = (
             smm.metrics.timer(f"RPC.{method_name}") if smm is not None else None
@@ -258,12 +286,14 @@ class RPCServer:
                 return
             reply_once({"ok": self._marshal(result, "", reply_to)})
 
-        timer = threading.Timer(
+        # shared timer wheel, NOT threading.Timer: a Timer spawns an OS
+        # thread per call, i.e. one thread per flow wait under load
+        from ..utils.timerwheel import call_later
+
+        timer = call_later(
             float(timeout) if timeout is not None else 3600.0,
             lambda: reply_once({"error": "TimeoutError: flow result wait"}),
         )
-        timer.daemon = True
-        timer.start()
         fut.add_done_callback(on_done)
         return True
 

@@ -49,6 +49,23 @@ def maybe_profiled(fn: Callable, name: str) -> Callable:
     return wrapper
 
 
+def try_claim_thread_profile(name: str) -> None:
+    """Enable cProfile on the CURRENT thread when it is the chosen one.
+
+    For thread POOLS: pass as the pool initializer — the first worker
+    claims the single sys.monitoring slot and its profile stands in for
+    its siblings (same workload distribution); later workers fail the
+    enable and run unprofiled."""
+    if not _DIR or name != _THREAD:
+        return
+    prof = cProfile.Profile()
+    try:
+        prof.enable()
+    except ValueError:
+        return  # slot already claimed (another pool worker won)
+    _PROFILES.append((name, prof))
+
+
 def _dump() -> None:
     if not _DIR or not _PROFILES:
         return

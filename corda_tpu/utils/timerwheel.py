@@ -1,0 +1,132 @@
+"""A shared timeout thread.
+
+`threading.Timer` spawns a WHOLE OS THREAD per call; the RPC server
+armed one per flow-result wait, which profiled as hundreds of
+thread-creations per loadtest run (thread spawn + scheduler churn on
+every flow). This module serves every timeout from one daemon thread
+and a heap — the asyncio timer-wheel idea without an event loop.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+
+class TimerHandle:
+    __slots__ = ("_cancelled", "_wheel")
+
+    def __init__(self, wheel: "SharedTimer" = None):
+        self._cancelled = False
+        self._wheel = wheel
+
+    def cancel(self) -> None:
+        if not self._cancelled:
+            self._cancelled = True
+            if self._wheel is not None:
+                self._wheel.note_cancel()
+
+
+class SharedTimer:
+    """Deadlines on one thread, CALLBACKS on a small pool: a fired
+    callback can be heavy (a batcher flush runs crypto; a timeout reply
+    serializes and touches the network), and running it inline would
+    stall every other timeout in the process behind it.  Most timers are
+    cancelled before firing, which costs nothing but a flag."""
+
+    #: rebuild the heap when at least this many cancelled entries linger
+    #: (long-deadline cancelled timers would otherwise retain their
+    #: callback closures until the original deadline)
+    COMPACT_AT = 512
+
+    def __init__(self, name: str = "shared-timer"):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._heap: list = []  # (deadline, seq, fn, handle)
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._cancelled = 0
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix=name + "-cb"
+        )
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def call_later(self, delay: float, fn: Callable[[], None]) -> TimerHandle:
+        handle = TimerHandle(self)
+        deadline = time.monotonic() + max(0.0, delay)
+        with self._cv:
+            heapq.heappush(
+                self._heap, (deadline, next(self._seq), fn, handle)
+            )
+            self._cv.notify()
+        return handle
+
+    def note_cancel(self) -> None:
+        with self._cv:
+            self._cancelled += 1
+            if (
+                self._cancelled >= self.COMPACT_AT
+                and self._cancelled * 2 >= len(self._heap)
+            ):
+                self._heap = [
+                    e for e in self._heap if not e[3]._cancelled
+                ]
+                heapq.heapify(self._heap)
+                self._cancelled = 0
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped:
+                    if not self._heap:
+                        self._cv.wait()
+                        continue
+                    now = time.monotonic()
+                    deadline = self._heap[0][0]
+                    if deadline <= now:
+                        break
+                    self._cv.wait(timeout=deadline - now)
+                if self._stopped:
+                    return
+                _, _, fn, handle = heapq.heappop(self._heap)
+            if handle._cancelled:
+                with self._cv:
+                    self._cancelled = max(0, self._cancelled - 1)
+                continue
+            try:
+                self._pool.submit(_guarded, fn)
+            except RuntimeError:
+                return  # pool shut down with the process
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._pool.shutdown(wait=False)
+
+
+def _guarded(fn: Callable[[], None]) -> None:
+    try:
+        fn()
+    except Exception:
+        pass  # a timeout callback must not kill a pool worker
+
+
+_default: Optional[SharedTimer] = None
+_default_lock = threading.Lock()
+
+
+def call_later(delay: float, fn: Callable[[], None]) -> TimerHandle:
+    """Module-level convenience over one process-wide wheel."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = SharedTimer("corda-tpu-timerwheel")
+    return _default.call_later(delay, fn)

@@ -47,7 +47,7 @@ class SignatureBatcher:
         self.linger_ms = linger_ms
         self._lock = threading.Lock()
         self._pending: List[Tuple[Item, Future]] = []
-        self._timer: threading.Timer | None = None
+        self._timer = None  # TimerHandle from the shared wheel
         self._closed = False
         # telemetry
         self.flushes = 0
@@ -68,11 +68,11 @@ class SignatureBatcher:
             if len(self._pending) >= self.max_batch:
                 run_now = True
             elif self._timer is None:
-                self._timer = threading.Timer(
-                    self.linger_ms / 1000.0, self.flush
-                )
-                self._timer.daemon = True
-                self._timer.start()
+                # shared timer wheel (one process-wide thread), not a
+                # threading.Timer thread per linger window
+                from ..utils.timerwheel import call_later
+
+                self._timer = call_later(self.linger_ms / 1000.0, self.flush)
         if run_now:
             self.flush()
         return futures

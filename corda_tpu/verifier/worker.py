@@ -38,7 +38,13 @@ class VerifierWorker:
         broker.create_queue(VERIFICATION_REQUESTS_QUEUE_NAME)
         self._batcher = batcher or SignatureBatcher()
         self._stop = threading.Event()
-        self._consumer = broker.create_consumer(VERIFICATION_REQUESTS_QUEUE_NAME)
+        # prefetch=1: workers COMPETE on this queue — client-side
+        # buffering would pin requests to an alive-but-slow worker that
+        # an idle peer could otherwise steal (reference VerifierTests
+        # rebalancing contract)
+        self._consumer = broker.create_consumer(
+            VERIFICATION_REQUESTS_QUEUE_NAME, prefetch=1
+        )
         self._thread: Optional[threading.Thread] = None
         self.verified_count = 0
 

@@ -1,0 +1,46 @@
+"""Shared timer wheel (utils/timerwheel.py): one thread serves every
+timeout instead of threading.Timer's thread-per-call."""
+import threading
+import time
+
+from corda_tpu.utils.timerwheel import SharedTimer
+
+
+def test_fires_in_order_and_cancel_suppresses():
+    w = SharedTimer("test-wheel")
+    fired = []
+    ev = threading.Event()
+    w.call_later(0.01, lambda: fired.append("a"))
+    h = w.call_later(0.02, lambda: fired.append("cancelled"))
+    w.call_later(0.03, lambda: (fired.append("b"), ev.set()))
+    h.cancel()
+    assert ev.wait(5)
+    time.sleep(0.05)
+    assert fired == ["a", "b"]
+    w.stop()
+
+
+def test_slow_callback_does_not_stall_other_timers():
+    """Callbacks run on a pool, not the deadline thread: a heavy flush
+    must not delay an unrelated timeout (review finding r5)."""
+    w = SharedTimer("test-wheel-2")
+    order = []
+    done = threading.Event()
+    w.call_later(0.01, lambda: time.sleep(0.5))  # heavy callback
+    w.call_later(0.05, lambda: (order.append("fast"), done.set()))
+    assert done.wait(5)
+    # the fast timer fired while the heavy one was still sleeping
+    assert order == ["fast"]
+    w.stop()
+
+
+def test_cancelled_entries_are_compacted():
+    w = SharedTimer("test-wheel-3")
+    w.COMPACT_AT = 8
+    handles = [w.call_later(3600, lambda: None) for _ in range(20)]
+    for h in handles:
+        h.cancel()
+    time.sleep(0.05)
+    with w._cv:
+        assert len(w._heap) < 20  # long-deadline closures were released
+    w.stop()
