@@ -222,3 +222,127 @@ class NetworkIdentityModel:
     def refresh(self) -> None:
         self.parties.set_all(self.ops.network_map_snapshot())
         self.notaries.set_all(self.ops.notary_identities())
+
+
+class ExchangeRateModel:
+    """Observable FX conversion (reference `ExchangeRateModel.kt`): a
+    pluggable rate source, identity by default, with amount conversion
+    for display models."""
+
+    def __init__(self):
+        self.exchange_rate = ObservableValue(lambda currency: 1.0)
+
+    def set_rates(self, usd_per_unit: Dict[str, float]) -> None:
+        """Install a rate table (currency -> USD per minor unit scale)."""
+        table = dict(usd_per_unit)
+        self.exchange_rate.set(lambda currency: table.get(currency, 1.0))
+
+    def exchange_amount(self, quantity: int, from_currency: str,
+                        to_currency: str) -> int:
+        """Convert minor units via the current rate source."""
+        rate = self.exchange_rate.value
+        usd = quantity * rate(from_currency)
+        to_rate = rate(to_currency)
+        return int(round(usd / to_rate)) if to_rate else 0
+
+
+class InputResolution:
+    """reference TransactionDataModel.kt:23-31 — one transaction input,
+    either still unresolved (its source tx not yet seen) or resolved to
+    the producing StateAndRef."""
+
+    __slots__ = ("state_ref", "state_and_ref")
+
+    def __init__(self, state_ref, state_and_ref=None):
+        self.state_ref = state_ref
+        self.state_and_ref = state_and_ref
+
+    @property
+    def resolved(self) -> bool:
+        return self.state_and_ref is not None
+
+
+class PartiallyResolvedTransaction:
+    """A verified transaction whose inputs resolve INCREMENTALLY as their
+    producing transactions arrive over the feed (reference
+    `PartiallyResolvedTransaction`): the explorer can render a tx the
+    moment it lands and fill input details later."""
+
+    def __init__(self, stx, inputs: List[InputResolution]):
+        self.transaction = stx
+        self.id = stx.id
+        self.inputs = inputs
+
+    @property
+    def fully_resolved(self) -> bool:
+        return all(r.resolved for r in self.inputs)
+
+
+class TransactionDataModel:
+    """Folds the verified-transactions feed into PartiallyResolved
+    transactions (reference `TransactionDataModel.kt`): keeps a tx map
+    by id and re-resolves open inputs whenever a new tx supplies them."""
+
+    def __init__(self, ops):
+        self.ops = ops
+        self.partially_resolved = ObservableList()
+        self._by_id: Dict[Any, Any] = {}
+        #: (resolution, owning entry) pairs still awaiting a source tx
+        self._open: List[tuple] = []
+        feed = ops.verified_transactions_feed()
+        # subscribe BEFORE folding the snapshot: a tx committed in the
+        # gap would otherwise be missed forever (no replay on the
+        # Observable); _on_tx dedups by id, so overlap is harmless
+        self._sub = feed.updates.subscribe(self._on_tx)
+        for stx in feed.snapshot:
+            self._on_tx(stx)
+
+    def _resolve(self, res: InputResolution) -> bool:
+        src = self._by_id.get(res.state_ref.txhash)
+        if src is None:
+            return False
+        try:
+            res.state_and_ref = src.tx.out_ref(res.state_ref.index)
+        except (IndexError, AttributeError):
+            return False
+        return True
+
+    def _on_tx(self, stx) -> None:
+        if stx.id in self._by_id:
+            return
+        self._by_id[stx.id] = stx
+        # late resolutions FIRST, and with a visible list event per
+        # affected entry: subscribers must learn that an EARLIER
+        # transaction's inputs just resolved, not only that a new one
+        # appended (an out-of-order arrival would otherwise leave its
+        # dependents rendered unresolved forever)
+        still_open = []
+        touched = []
+        for res, owner in self._open:
+            if self._resolve(res):
+                touched.append(owner)
+            else:
+                still_open.append((res, owner))
+        self._open = still_open
+        # one event per AFFECTED ENTRY, not per resolved input (one tx
+        # can supply several inputs of the same spender)
+        for owner in dict.fromkeys(touched):
+            self.partially_resolved.replace_where(
+                lambda x, o=owner: x.id == o.id, owner
+            )
+        inputs = []
+        entry = None
+        for ref in stx.tx.inputs:
+            res = InputResolution(ref)
+            inputs.append(res)
+        entry = PartiallyResolvedTransaction(stx, inputs)
+        for res in inputs:
+            if not self._resolve(res):
+                self._open.append((res, entry))
+        self.partially_resolved.append(entry)
+
+    def lookup(self, tx_id):
+        return self._by_id.get(tx_id)
+
+    def close(self) -> None:
+        self._sub.unsubscribe()

@@ -81,31 +81,48 @@ def _backend() -> str:
         # different answers (a timeout-latched "cpu" overwritten by a
         # late "tpu" would flip the acceptance-rule pin's basis)
         with _BACKEND_LOCK:
-            if _resolved_backend is not None:
-                return _resolved_backend
-            result: dict = {}
-
-            def probe() -> None:
-                try:
-                    import jax
-
-                    result["b"] = jax.default_backend()
-                except Exception:
-                    result["b"] = "none"
-
-            t = threading.Thread(
-                target=probe, daemon=True, name="corda-tpu-backend-probe"
-            )
-            t.start()
-            t.join(
-                timeout=float(
-                    os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
-                )
-            )
-            _resolved_backend = (
-                result.get("b", "cpu") if not t.is_alive() else "cpu"
-            )
+            if _resolved_backend is None:
+                _resolved_backend = _resolve_backend_without_hanging()
     return _resolved_backend
+
+
+def _resolve_backend_without_hanging() -> str:
+    """Resolve the backend without risking THIS process's JAX state.
+
+    A tunnel-backed platform can hang PJRT client creation forever
+    (observed live: make_c_api_client never returns). Crucially, even a
+    probe THREAD is unsafe: the hung thread holds JAX's backend-init
+    lock, so every later array op in the process deadlocks behind it.
+    When the process is pinned to CPU (tests, --jax-platform cpu nodes)
+    resolution is hang-free and runs inline; otherwise the probe runs in
+    a SUBPROCESS whose hang cannot poison us, and a timeout latches the
+    host paths."""
+    try:
+        import jax
+
+        platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+    except Exception:
+        return "none"
+    if platforms and all(
+        p.strip() == "cpu" for p in platforms.split(",") if p.strip()
+    ):
+        return jax.default_backend()
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True,
+            timeout=float(
+                os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
+            ),
+        )
+        lines = (out.stdout or "").strip().splitlines()
+        return lines[-1].strip() if lines else "cpu"
+    except Exception:
+        return "cpu"  # hung or failed probe: the host paths always work
 
 
 def _use_device_kernels() -> bool:

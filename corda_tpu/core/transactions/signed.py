@@ -31,6 +31,10 @@ from collections import OrderedDict
 _VERIFIED_SIGS: "OrderedDict[tuple, bool]" = OrderedDict()
 _VERIFIED_SIGS_MAX = 1 << 16
 
+import threading as _threading
+
+_VERIFIED_SIGS_LOCK = _threading.Lock()
+
 
 class SignaturesMissingError(SignatureError):
     def __init__(self, missing: FrozenSet[PublicKey], descriptions: List[str], tx_id):
@@ -97,10 +101,14 @@ class TransactionWithSignatures:
             return
         content = self.id.bytes
         rows = [(sig.by, sig.bytes, content) for sig in self.sigs]
-        todo = [
-            i for i, (key, sig, _) in enumerate(rows)
-            if (content, key.encoded, sig) not in _VERIFIED_SIGS
-        ]
+        todo = []
+        with _VERIFIED_SIGS_LOCK:
+            for i, (key, sig, _) in enumerate(rows):
+                k = (content, key.encoded, sig)
+                if k in _VERIFIED_SIGS:
+                    _VERIFIED_SIGS.move_to_end(k)  # true LRU recency
+                else:
+                    todo.append(i)
         if todo:
             results = crypto_batch.verify_batch([rows[i] for i in todo])
             bad = [todo[j] for j, ok in enumerate(results) if not ok]
@@ -108,12 +116,12 @@ class TransactionWithSignatures:
                 raise SignatureError(
                     f"invalid signature(s) at positions {bad} on {self.id}"
                 )
-            for i in todo:
-                key, sig, _ = rows[i]
-                _VERIFIED_SIGS[(content, key.encoded, sig)] = True
-                _VERIFIED_SIGS.move_to_end((content, key.encoded, sig))
-            while len(_VERIFIED_SIGS) > _VERIFIED_SIGS_MAX:
-                _VERIFIED_SIGS.popitem(last=False)
+            with _VERIFIED_SIGS_LOCK:
+                for i in todo:
+                    key, sig, _ = rows[i]
+                    _VERIFIED_SIGS[(content, key.encoded, sig)] = True
+                while len(_VERIFIED_SIGS) > _VERIFIED_SIGS_MAX:
+                    _VERIFIED_SIGS.popitem(last=False)
 
     def _missing_signatures(self) -> Set[PublicKey]:
         # The signed set is exactly the keys that produced valid signatures —

@@ -128,31 +128,51 @@ def _trade_arrays(trades) -> dict:
     }
 
 
-def _pv_fn(arrs):
-    """Returns pv(zero_rates) -> scalar portfolio PV; pure JAX, so both
-    the value and its curve jacobian compile to one program each."""
+def _swap_pricing_core(zero_rates, maturity):
+    """THE pricing model, shared by valuation AND calibration (one
+    definition of payment schedule, discounting, annuity and par — if
+    the model changes, both change together by construction).
+
+    zero_rates: (K,) pillar zeros at TENORS; maturity: (M,) maturities.
+    Returns (df_T, annuity, par) each (M,): yearly payments, linear
+    zero interpolation, par = (1 - df_T) / annuity."""
     import jax.numpy as jnp
 
     tenors = jnp.asarray(TENORS)
+    years = jnp.arange(1.0, 31.0)                      # (Y,)
+    r = jnp.interp(years, tenors, zero_rates)          # (Y,)
+    df = jnp.exp(-r * years)                           # (Y,)
+    alive = (years[None, :] <= maturity[:, None])      # (M, Y)
+    annuity = jnp.sum(df[None, :] * alive, axis=1)     # (M,)
+    df_T = jnp.exp(-jnp.interp(maturity, tenors, zero_rates) * maturity)
+    par = (1.0 - df_T) / jnp.maximum(annuity, 1e-9)
+    return df_T, annuity, par
+
+
+def _pv_vector_fn(arrs):
+    """Returns pv_vec(zero_rates) -> (T,) per-trade PVs; pure JAX, so
+    values, jacobians, and masked aggregations all compile."""
+    import jax.numpy as jnp
+
     notional = jnp.asarray(arrs["notional"])
     fixed = jnp.asarray(arrs["fixed_rate"])
     maturity = jnp.asarray(arrs["maturity"])
     direction = jnp.asarray(arrs["direction"])
 
-    def pv(zero_rates):
-        # linear interpolation of the zero curve at yearly payment times
-        years = jnp.arange(1.0, 31.0)                      # (Y,)
-        r = jnp.interp(years, tenors, zero_rates)          # (Y,)
-        df = jnp.exp(-r * years)                           # (Y,)
-        alive = (years[None, :] <= maturity[:, None])      # (T, Y)
-        annuity = jnp.sum(df[None, :] * alive, axis=1)     # (T,)
-        # par swap rate from the curve: (1 - df_T) / annuity
-        df_T = jnp.exp(-jnp.interp(maturity, tenors, zero_rates) * maturity)
-        par = (1.0 - df_T) / jnp.maximum(annuity, 1e-9)
+    def pv_vec(zero_rates):
+        _, annuity, par = _swap_pricing_core(zero_rates, maturity)
         # payer-fixed swap PV = notional * (par - fixed) * annuity
-        return jnp.sum(direction * notional * (par - fixed) * annuity)
+        return direction * notional * (par - fixed) * annuity
 
-    return pv
+    return pv_vec
+
+
+def _pv_fn(arrs):
+    """Scalar portfolio PV over the per-trade vector."""
+    import jax.numpy as jnp
+
+    pv_vec = _pv_vector_fn(arrs)
+    return lambda zero_rates: jnp.sum(pv_vec(zero_rates))
 
 
 def portfolio_pv(trades, zero_rates) -> float:
@@ -178,6 +198,114 @@ def simm_initial_margin(trades, zero_rates) -> float:
     s = deltas * np.asarray(RISK_WEIGHTS)
     c = _correlation_matrix()
     return float(np.sqrt(np.maximum(s @ c @ s, 0.0)))
+
+
+def per_trade_pvs(trades, zero_rates) -> np.ndarray:
+    """(T,) present values, one vectorised evaluation (the reference
+    prices per trade in a Java loop, AnalyticsEngine.kt:83-91)."""
+    return portfolio_analytics(trades, zero_rates)["per_trade_pvs"]
+
+
+def portfolio_analytics(trades, zero_rates) -> dict:
+    """EVERY analytic from one compiled evaluation: per-trade PVs and
+    the per-trade delta matrix D come from a single (value, jacobian)
+    program; portfolio PV, the delta ladder, total IM and every
+    leave-one-out marginal IM are numpy aggregations of those.
+
+    The reference re-runs the whole OpenGamma pipeline once per omitted
+    trade for the marginal margins (AnalyticsEngine.kt:139,
+    `trades.omit(it)` in a loop); here T portfolio revaluations
+    collapse into row-wise weighted quadratic forms over
+    (D_total - D_i)."""
+    import jax
+
+    arrs = _trade_arrays(trades)
+    pv_vec = _pv_vector_fn(arrs)
+
+    @jax.jit
+    def value_and_jac(r):
+        return pv_vec(r), jax.jacrev(pv_vec)(r)
+
+    pvs, D = value_and_jac(np.asarray(zero_rates, np.float64))
+    pvs = np.asarray(pvs)
+    D = np.asarray(D)                                        # (T, K)
+    deltas = D.sum(axis=0)                                   # dPV/dr
+    Dbp = D / 10_000.0                                       # per bp
+    w = np.asarray(RISK_WEIGHTS)
+    c = _correlation_matrix()
+    s_total = Dbp.sum(axis=0) * w                            # (K,)
+    im_all = float(np.sqrt(np.maximum(s_total @ c @ s_total, 0.0)))
+    s_without = s_total[None, :] - Dbp * w[None, :]          # (T, K)
+    im_without = np.sqrt(
+        np.maximum(np.einsum("tk,kj,tj->t", s_without, c, s_without), 0.0)
+    )
+    return {
+        "per_trade_pvs": pvs,
+        "pv": float(pvs.sum()),
+        "delta_ladder": deltas,
+        "initial_margin": im_all,
+        "marginal_im": im_all - im_without,
+    }
+
+
+def marginal_im(trades, zero_rates) -> np.ndarray:
+    """(T,) leave-one-out margin contributions: IM(all) - IM(all \\ i)."""
+    return portfolio_analytics(trades, zero_rates)["marginal_im"]
+
+
+def calibrate_curve(par_rates, n_iter: int = 30) -> np.ndarray:
+    """Bootstrap the zero curve from par swap quotes at TENORS.
+
+    The reference calibrates its rates provider from market-quote CSVs
+    through OpenGamma's RatesCalibrationCsvLoader
+    (AnalyticsEngine.kt:114-126). Here calibration is root-finding on
+    the SAME pricing function the valuations use: find zero rates r
+    such that par(T_i; r) == quote_i, by damped Newton with the
+    jacobian from autodiff — one jittable program, no bump-and-reprice,
+    and perfectly consistent with the PV/delta analytics by
+    construction."""
+    import jax
+    import jax.numpy as jnp
+
+    quotes = jnp.asarray(par_rates, jnp.float64)
+    tenors = jnp.asarray(TENORS)
+
+    def par_curve(zero_rates):
+        # the SHARED pricing core — calibration literally prices the
+        # same instruments the valuations do
+        df_T, _, swap_par = _swap_pricing_core(zero_rates, tenors)
+        # sub-1y pillars have no coupon in the annual-payment swap
+        # model: quote them as money-market deposits,
+        # rate = (1/df - 1)/T (simple accrual), like the short end of
+        # the reference's calibration instrument set
+        depo = (1.0 / df_T - 1.0) / tenors
+        return jnp.where(tenors < 1.0, depo, swap_par)
+
+    def newton_step(r, _):
+        resid = par_curve(r) - quotes
+        J = jax.jacfwd(par_curve)(r)
+        # damped: levenberg-style ridge keeps early steps stable
+        delta = jnp.linalg.solve(
+            J.T @ J + 1e-10 * jnp.eye(len(TENORS)), J.T @ resid
+        )
+        return r - delta, None
+
+    @jax.jit
+    def solve(start):
+        final, _ = jax.lax.scan(newton_step, start, None, length=n_iter)
+        return final
+
+    zero = np.asarray(solve(quotes))  # par quotes are a good start
+    resid = np.asarray(par_curve(jnp.asarray(zero))) - np.asarray(par_rates)
+    # JAX default precision is float32 (x64 is off framework-wide): a
+    # 5e-7 absolute residual is ~0.005bp on the par rate — calibration
+    # noise far below the demo's cent-rounding of PV/IM
+    if float(np.max(np.abs(resid))) > 5e-7:
+        raise ValueError(
+            f"curve calibration did not converge (max residual "
+            f"{float(np.max(np.abs(resid))):.2e})"
+        )
+    return zero
 
 
 @corda_serializable(name="simm.Valuation")
@@ -284,6 +412,133 @@ class RespondValuationFlow(FlowLogic):
 
 DEMO_CURVE = (0.031, 0.032, 0.034, 0.035, 0.037, 0.040, 0.042, 0.043)
 
+
+# --- web API (reference PortfolioApi.kt: the demo's REST surface) -----------
+
+class SimmApiPlugin:
+    """`/api/simmvaluationdemo/...` over the webserver plugin registry
+    (reference PortfolioApi.kt mounts the same surface via JAX-RS from
+    the CorDapp jar). Portfolio-scoped where the reference is
+    counterparty-scoped — one portfolio per counterparty pair in both.
+
+    Routes:
+      GET business-date
+      GET portfolios
+      GET <portfolio-id>/trades
+      GET <portfolio-id>/trades/<trade-id>
+      GET <portfolio-id>/valuation[?curve=r1,r2,...]   (full analytics)
+    """
+
+    @staticmethod
+    def _trade_json(t: IRSTrade) -> dict:
+        return {
+            "id": t.trade_id,
+            "notional": t.notional,
+            "fixedRate": t.fixed_rate,
+            "maturityYears": t.maturity_years,
+            "payFixed": t.pay_fixed,
+        }
+
+    def _portfolios(self, ops):
+        out = {}
+        for sar in ops.vault_query(PortfolioState.contract_name):
+            state = sar.state.data
+            out[state.portfolio_id] = state
+        return out
+
+    def handle(self, ops, method, subpath, params, body):
+        if method != "GET":
+            return 405, {"error": "read-only API"}
+        if subpath in ("", "business-date"):
+            import time as _time
+
+            return 200, {"businessDate": _time.strftime("%Y-%m-%d")}
+        if subpath == "portfolios":
+            return 200, {
+                "portfolios": [
+                    {
+                        "id": pid,
+                        "parties": [s.party_a.name, s.party_b.name],
+                        "trades": len(s.trades),
+                    }
+                    for pid, s in sorted(self._portfolios(ops).items())
+                ]
+            }
+        parts = subpath.split("/")
+        state = self._portfolios(ops).get(parts[0])
+        if state is None:
+            return 404, {"error": f"no portfolio {parts[0]!r}"}
+        if len(parts) == 2 and parts[1] == "trades":
+            return 200, {
+                "trades": [self._trade_json(t) for t in state.trades]
+            }
+        if len(parts) == 3 and parts[1] == "trades":
+            t = next(
+                (t for t in state.trades if t.trade_id == parts[2]), None
+            )
+            if t is None:
+                return 404, {"error": f"no trade {parts[2]!r}"}
+            return 200, self._trade_json(t)
+        if len(parts) == 2 and parts[1] == "valuation":
+            curve = DEMO_CURVE
+            if params.get("curve"):
+                try:
+                    curve = tuple(
+                        float(x) for x in params["curve"].split(",")
+                    )
+                except ValueError:
+                    return 400, {"error": "curve must be comma floats"}
+                if len(curve) != len(TENORS):
+                    return 400, {
+                        "error": f"curve needs {len(TENORS)} tenors"
+                    }
+            trades = state.trades
+            # one compiled (value, jacobian) evaluation serves the whole
+            # response — PVs, ladder, IM and marginals are aggregations
+            a = portfolio_analytics(trades, curve)
+            return 200, {
+                "portfolio": state.portfolio_id,
+                "curve": list(curve),
+                "presentValue": a["pv"],
+                "perTradePV": {
+                    t.trade_id: float(pv)
+                    for t, pv in zip(trades, a["per_trade_pvs"])
+                },
+                "deltaLadder": dict(
+                    zip(
+                        (str(x) for x in TENORS),
+                        (float(d) for d in a["delta_ladder"]),
+                    )
+                ),
+                "initialMargin": a["initial_margin"],
+                "marginalIM": {
+                    t.trade_id: float(m)
+                    for t, m in zip(trades, a["marginal_im"])
+                },
+            }
+        return 404, {"error": f"no route {subpath!r}"}
+
+    def web_apis(self):
+        return {
+            "simmvaluationdemo": lambda ops, method, subpath, params, body:
+                self.handle(ops, method, subpath, params, body)
+        }
+
+    def static_serve_dirs(self):
+        return {}
+
+
+def register_simm_web_api() -> None:
+    """Idempotent plugin registration (reference: SimmPlugin discovered
+    via ServiceLoader; here nodes list this module in `cordapps`)."""
+    from ..webserver.plugins import register_web_plugin, registered_plugins
+
+    if not any(isinstance(p, SimmApiPlugin) for p in registered_plugins()):
+        register_web_plugin(SimmApiPlugin())
+
+
+register_simm_web_api()
+
 DEMO_TRADES = (
     IRSTrade("T1", 10_000_000_00, 0.030, 5.0, True),
     IRSTrade("T2", 25_000_000_00, 0.041, 10.0, False),
@@ -295,10 +550,17 @@ DEMO_TRADES = (
 def main(verbose: bool = True) -> dict:
     import jax
 
-    try:  # accelerator if reachable, else CPU (demo must run anywhere)
-        jax.devices()
-    except RuntimeError:
-        jax.config.update("jax_platforms", "cpu")
+    # accelerator if reachable, else CPU (demo must run anywhere). The
+    # probe is TIME-BOUNDED via the dispatch layer's backend resolver: a
+    # half-dead tunnel hangs jax.devices() forever (observed live), and
+    # a demo that hangs before printing anything is worse than one on CPU.
+    from ..core.crypto import batch as crypto_batch
+
+    if crypto_batch._backend() not in crypto_batch._ACCEL_BACKENDS:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized
 
     from ..core.flows.library import FinalityFlow
     from ..core.transactions.builder import TransactionBuilder

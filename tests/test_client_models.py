@@ -144,3 +144,119 @@ class TestNetworkIdentityModel:
         model.refresh()
         assert model.lookup(b.info.name) is not None
         net.stop_nodes()
+
+
+class TestExchangeRateModel:
+    def test_identity_default_and_rate_table(self):
+        from corda_tpu.client.models import ExchangeRateModel
+
+        m = ExchangeRateModel()
+        assert m.exchange_amount(12_345, "USD", "EUR") == 12_345  # identity
+        m.set_rates({"USD": 1.0, "EUR": 1.25, "GBP": 1.5})
+        assert m.exchange_amount(100, "GBP", "USD") == 150
+        assert m.exchange_amount(125, "EUR", "GBP") == 104  # 156.25/1.5
+        seen = []
+        m.exchange_rate.updates.subscribe(lambda fn: seen.append(fn("EUR")))
+        m.set_rates({"EUR": 2.0})
+        assert seen and seen[-1] == 2.0
+
+
+class TestTransactionDataModel:
+    def setup_method(self):
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.bank = self.net.create_node("O=TxD,L=London,C=GB")
+        self.peer = self.net.create_node("O=TxDPeer,L=Paris,C=FR")
+        self.ops = CordaRPCOps(self.bank.services, self.bank.smm)
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+
+    def test_inputs_resolve_incrementally(self):
+        from corda_tpu.client.models import TransactionDataModel
+        from corda_tpu.finance.flows import CashPaymentFlow
+        from corda_tpu.core.contracts.amount import Issued
+
+        model = TransactionDataModel(self.ops)
+        usd = Amount(50_000, "USD")
+        h = self.bank.start_flow(
+            CashIssueFlow(usd, b"\x01", self.bank.info, self.notary.info)
+        )
+        self.net.run_network()
+        h.result.result(timeout=10)
+        assert len(model.partially_resolved) == 1
+        issue = model.partially_resolved.items[0]
+        assert issue.fully_resolved  # no inputs at all
+        token = Issued(self.bank.info.ref(1), "USD")
+        h = self.bank.start_flow(
+            CashPaymentFlow(
+                Amount(50_000, token), self.peer.info, self.notary.info
+            ),
+            Amount(50_000, token), self.peer.info, self.notary.info,
+        )
+        self.net.run_network()
+        h.result.result(timeout=10)
+        assert len(model.partially_resolved) == 2
+        pay = model.partially_resolved.items[1]
+        # the payment's input resolves against the issue tx in the map
+        assert pay.inputs and pay.fully_resolved
+        resolved = pay.inputs[0].state_and_ref
+        assert resolved is not None
+        assert resolved.ref.txhash == issue.id
+        assert model.lookup(pay.id) is not None
+        model.close()
+
+    def test_out_of_order_arrival_notifies_late_resolution(self):
+        """Review finding (r5): when a dependency arrives AFTER its
+        spender, subscribers must see an update event for the earlier
+        entry, not just the new append."""
+        from types import SimpleNamespace
+
+        from corda_tpu.client.models import TransactionDataModel
+
+        # build issue + spend via a private mock feed so we control order
+        class _Feed:
+            def __init__(self):
+                self.snapshot = []
+                from corda_tpu.utils.observable import Observable
+                self.updates = Observable()
+
+        feed = _Feed()
+        ops = SimpleNamespace(verified_transactions_feed=lambda: feed)
+        model = TransactionDataModel(ops)
+
+        # craft real issue + spend txs with the mocknetwork machinery
+        net = MockNetwork()
+        notary = net.create_notary_node(validating=True)
+        bank = net.create_node("O=OO,L=London,C=GB")
+        peer = net.create_node("O=OOP,L=Paris,C=FR")
+        ops_real = CordaRPCOps(bank.services, bank.smm)
+        usd = Amount(10_000, "USD")
+        h = bank.start_flow(
+            CashIssueFlow(usd, b"\x01", bank.info, notary.info)
+        )
+        net.run_network(); h.result.result(timeout=10)
+        from corda_tpu.finance.flows import CashPaymentFlow
+        from corda_tpu.core.contracts.amount import Issued
+        token = Issued(bank.info.ref(1), "USD")
+        h = bank.start_flow(
+            CashPaymentFlow(Amount(10_000, token), peer.info, notary.info),
+            Amount(10_000, token), peer.info, notary.info,
+        )
+        net.run_network(); h.result.result(timeout=10)
+        txs = [sar for sar in ops_real.verified_transactions_feed().snapshot]
+        net.stop_nodes()
+        assert len(txs) >= 2
+        issue, spend = txs[0], txs[1]
+        events = []
+        model.partially_resolved.updates.subscribe(events.append)
+        # deliver OUT OF ORDER: spender first
+        feed.updates.on_next(spend)
+        entry = model.partially_resolved.items[0]
+        assert not entry.fully_resolved
+        n_before = len(events)
+        feed.updates.on_next(issue)
+        # the earlier entry resolved AND an event announced it
+        assert entry.fully_resolved
+        assert len(events) > n_before + 1  # replace event + append event
+        model.close()

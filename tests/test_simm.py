@@ -200,3 +200,100 @@ class TestValuationFlows:
         self.net.run_network()
         with pytest.raises(ValuationMismatch):
             h.result.result(timeout=60)
+
+
+class TestRound5Analytics:
+    """Round-5 widening toward the reference's analytic surface
+    (AnalyticsEngine.kt): per-trade PVs, leave-one-out margin, curve
+    calibration, and the PortfolioApi-equivalent web routes."""
+
+    def test_per_trade_pvs_sum_to_portfolio(self):
+        from corda_tpu.samples import simm_demo as sd
+
+        pvs = sd.per_trade_pvs(sd.DEMO_TRADES, sd.DEMO_CURVE)
+        assert len(pvs) == len(sd.DEMO_TRADES)
+        total = sd.portfolio_pv(sd.DEMO_TRADES, sd.DEMO_CURVE)
+        assert abs(pvs.sum() - total) < max(16.0, abs(total) * 1e-5)
+
+    def test_marginal_im_matches_leave_one_out(self):
+        """The vmapped formula must agree with literally re-running the
+        margin without each trade (the reference's omit-loop)."""
+        from corda_tpu.samples import simm_demo as sd
+
+        trades, curve = sd.DEMO_TRADES, sd.DEMO_CURVE
+        fast = sd.marginal_im(trades, curve)
+        im_all = sd.simm_initial_margin(trades, curve)
+        for i in range(len(trades)):
+            without = [t for j, t in enumerate(trades) if j != i]
+            slow = im_all - sd.simm_initial_margin(without, curve)
+            assert abs(fast[i] - slow) < max(1.0, abs(slow) * 1e-4), i
+
+    def test_calibration_reprices_par_quotes(self):
+        """Bootstrapped zero curve must reprice the input par quotes
+        through the SAME pricing model (consistency by construction)."""
+        import numpy as np
+
+        from corda_tpu.samples import simm_demo as sd
+
+        quotes = (0.030, 0.031, 0.033, 0.0345, 0.036, 0.039, 0.041, 0.042)
+        zero = sd.calibrate_curve(quotes)
+        assert zero.shape == (len(sd.TENORS),)
+        # a par-rate swap struck at its quote has ~zero PV on this curve
+        for tenor, q in zip(sd.TENORS, quotes):
+            if tenor < 1.0:
+                continue  # the yearly-payment model has no sub-1y flows
+            t = sd.IRSTrade("X", 1_000_000_00, q, tenor, True)
+            pv = sd.portfolio_pv([t], zero)
+            assert abs(pv) < 200, (tenor, pv)  # < 2.00 per 1m notional
+
+    def test_web_api_routes(self):
+        """The PortfolioApi-equivalent surface through the webserver
+        plugin registry, against a real node's ops."""
+        from corda_tpu.samples import simm_demo as sd
+        from corda_tpu.webserver.plugins import registered_plugins
+
+        plugin = next(
+            p for p in registered_plugins()
+            if isinstance(p, sd.SimmApiPlugin)
+        )
+
+        class FakeOps:  # vault surface only
+            @staticmethod
+            def vault_query(contract_name=None):
+                from types import SimpleNamespace
+
+                state = sd.PortfolioState(
+                    SimpleNamespace(name="O=A"), SimpleNamespace(name="O=B"),
+                    sd.DEMO_TRADES, "P-1",
+                )
+                return [SimpleNamespace(
+                    state=SimpleNamespace(data=state)
+                )]
+
+        code, out = plugin.handle(FakeOps, "GET", "business-date", {}, None)
+        assert code == 200 and "businessDate" in out
+        code, out = plugin.handle(FakeOps, "GET", "portfolios", {}, None)
+        assert code == 200 and out["portfolios"][0]["id"] == "P-1"
+        code, out = plugin.handle(FakeOps, "GET", "P-1/trades", {}, None)
+        assert code == 200 and len(out["trades"]) == len(sd.DEMO_TRADES)
+        tid = out["trades"][0]["id"]
+        code, out = plugin.handle(
+            FakeOps, "GET", f"P-1/trades/{tid}", {}, None
+        )
+        assert code == 200 and out["id"] == tid
+        code, out = plugin.handle(FakeOps, "GET", "P-1/valuation", {}, None)
+        assert code == 200
+        assert set(out) >= {
+            "presentValue", "perTradePV", "deltaLadder",
+            "initialMargin", "marginalIM",
+        }
+        # float32 summation tolerance at 1.7e8 scale
+        assert abs(
+            sum(out["perTradePV"].values()) - out["presentValue"]
+        ) < abs(out["presentValue"]) * 1e-6 + 1.0
+        code, out = plugin.handle(
+            FakeOps, "GET", "P-1/valuation", {"curve": "bad"}, None
+        )
+        assert code == 400
+        code, _ = plugin.handle(FakeOps, "GET", "NOPE/trades", {}, None)
+        assert code == 404
