@@ -330,11 +330,7 @@ class TransactionDataModel:
             self.partially_resolved.replace_where(
                 lambda x, o=owner: x.id == o.id, owner
             )
-        inputs = []
-        entry = None
-        for ref in stx.tx.inputs:
-            res = InputResolution(ref)
-            inputs.append(res)
+        inputs = [InputResolution(ref) for ref in stx.tx.inputs]
         entry = PartiallyResolvedTransaction(stx, inputs)
         for res in inputs:
             if not self._resolve(res):

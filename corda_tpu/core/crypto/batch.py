@@ -110,17 +110,27 @@ def _resolve_backend_without_hanging() -> str:
     import subprocess
     import sys
 
+    env = dict(os.environ)
+    if platforms:
+        # the parent's IN-PROCESS pin (jax.config.update) is invisible
+        # to a child; propagate it so the probe answers for the
+        # configuration the parent actually runs
+        env["JAX_PLATFORMS"] = platforms
     try:
         out = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True,
+            capture_output=True, text=True, env=env,
             timeout=float(
                 os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
             ),
         )
         lines = (out.stdout or "").strip().splitlines()
-        return lines[-1].strip() if lines else "cpu"
+        backend = lines[-1].strip() if lines else ""
+        # runtimes print banners; accept only a plausible backend name
+        if backend in _ACCEL_BACKENDS or backend in ("cpu", "axon"):
+            return backend
+        return "cpu"
     except Exception:
         return "cpu"  # hung or failed probe: the host paths always work
 

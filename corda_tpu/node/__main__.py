@@ -194,8 +194,12 @@ def main(argv=None) -> int:
     node.start()
     # The port file doubles as the readiness signal (written only once RPC
     # and the state machine are serving), so external tooling can poll it.
-    with open(os.path.join(cfg.base_directory, "broker.port"), "w") as fh:
+    # ATOMIC rename: pollers must never observe a created-but-empty file
+    # (a launcher reading the instant the file exists raced exactly that).
+    port_path = os.path.join(cfg.base_directory, "broker.port")
+    with open(port_path + ".tmp", "w") as fh:
         fh.write(str(server.port))
+    os.replace(port_path + ".tmp", port_path)
     print(
         f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}",
         flush=True,

@@ -206,27 +206,44 @@ def per_trade_pvs(trades, zero_rates) -> np.ndarray:
     return portfolio_analytics(trades, zero_rates)["per_trade_pvs"]
 
 
+_VALUE_AND_JAC = None  # module-level jit: cached across calls/requests
+
+
+def _value_and_jac_fn():
+    global _VALUE_AND_JAC
+    if _VALUE_AND_JAC is None:
+        import jax
+
+        @jax.jit
+        def value_and_jac(notional, fixed, maturity, direction, r):
+            def pv_vec(rr):
+                _, annuity, par = _swap_pricing_core(rr, maturity)
+                return direction * notional * (par - fixed) * annuity
+
+            return pv_vec(r), jax.jacrev(pv_vec)(r)
+
+        _VALUE_AND_JAC = value_and_jac
+    return _VALUE_AND_JAC
+
+
 def portfolio_analytics(trades, zero_rates) -> dict:
     """EVERY analytic from one compiled evaluation: per-trade PVs and
     the per-trade delta matrix D come from a single (value, jacobian)
-    program; portfolio PV, the delta ladder, total IM and every
-    leave-one-out marginal IM are numpy aggregations of those.
+    program — a MODULE-LEVEL jit, so repeat calls (the web valuation
+    route serves one per request) reuse the compiled executable for
+    each portfolio shape; portfolio PV, the delta ladder, total IM and
+    every leave-one-out marginal IM are numpy aggregations of those.
 
     The reference re-runs the whole OpenGamma pipeline once per omitted
     trade for the marginal margins (AnalyticsEngine.kt:139,
     `trades.omit(it)` in a loop); here T portfolio revaluations
     collapse into row-wise weighted quadratic forms over
     (D_total - D_i)."""
-    import jax
-
     arrs = _trade_arrays(trades)
-    pv_vec = _pv_vector_fn(arrs)
-
-    @jax.jit
-    def value_and_jac(r):
-        return pv_vec(r), jax.jacrev(pv_vec)(r)
-
-    pvs, D = value_and_jac(np.asarray(zero_rates, np.float64))
+    pvs, D = _value_and_jac_fn()(
+        arrs["notional"], arrs["fixed_rate"], arrs["maturity"],
+        arrs["direction"], np.asarray(zero_rates),
+    )
     pvs = np.asarray(pvs)
     D = np.asarray(D)                                        # (T, K)
     deltas = D.sum(axis=0)                                   # dPV/dr
@@ -267,7 +284,7 @@ def calibrate_curve(par_rates, n_iter: int = 30) -> np.ndarray:
     import jax
     import jax.numpy as jnp
 
-    quotes = jnp.asarray(par_rates, jnp.float64)
+    quotes = jnp.asarray(par_rates)  # framework default precision
     tenors = jnp.asarray(TENORS)
 
     def par_curve(zero_rates):
@@ -284,9 +301,12 @@ def calibrate_curve(par_rates, n_iter: int = 30) -> np.ndarray:
     def newton_step(r, _):
         resid = par_curve(r) - quotes
         J = jax.jacfwd(par_curve)(r)
-        # damped: levenberg-style ridge keeps early steps stable
+        # levenberg-style ridge, SCALED so it is meaningful at float32
+        # (an absolute 1e-10 vanishes against O(1) diagonal entries)
+        JtJ = J.T @ J
+        ridge = 1e-6 * jnp.trace(JtJ) / len(TENORS)
         delta = jnp.linalg.solve(
-            J.T @ J + 1e-10 * jnp.eye(len(TENORS)), J.T @ resid
+            JtJ + ridge * jnp.eye(len(TENORS)), J.T @ resid
         )
         return r - delta, None
 

@@ -260,7 +260,16 @@ class Driver:
         if not h.alive():
             raise DriverError(f"node {name} died on startup:\n{h.log()}")
         port_file = os.path.join(node_dir, "broker.port")
-        _wait_for(lambda: os.path.exists(port_file), 10, "broker.port file")
+
+        def _port_ready() -> bool:
+            # tolerate a created-but-unflushed file (the node now writes
+            # atomically, but old artifacts may predate that)
+            if not os.path.exists(port_file):
+                return False
+            with open(port_file) as fh:
+                return bool(fh.read().strip())
+
+        _wait_for(_port_ready, 10, "broker.port file")
         with open(port_file) as fh:
             h.broker_port = int(fh.read().strip())
         _wait_for(

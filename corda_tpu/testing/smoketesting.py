@@ -155,8 +155,10 @@ class Factory:
                 raise SmokeTestError(f"node died on startup:\n{node.log()}")
             if os.path.exists(port_file):
                 with open(port_file) as fh:
-                    node.broker_port = int(fh.read().strip())
-                return node
+                    content = fh.read().strip()
+                if content:  # empty = writer mid-flight; keep polling
+                    node.broker_port = int(content)
+                    return node
             time.sleep(0.1)
         node.close()
         raise SmokeTestError(f"node did not start in {timeout}s:\n{node.log()}")

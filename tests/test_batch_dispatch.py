@@ -315,3 +315,66 @@ def test_pin_reflects_engine_availability(monkeypatch):
     monkeypatch.setattr(crypto_batch, "_pinned_rule", None)
     monkeypatch.setattr(host_batch, "available", lambda: True)
     assert crypto_batch._ed25519_rule() == "cofactored"
+
+
+def test_backend_probe_uses_subprocess_when_unpinned(monkeypatch):
+    """The hang-proofing path itself (review finding r5): when the
+    process is NOT cpu-pinned, resolution must go through a subprocess
+    (whose hang cannot poison this process's JAX state), propagate the
+    parent's platform pin, and accept only plausible backend names."""
+    import subprocess as sp
+    import types
+
+    calls = {}
+
+    def fake_run(argv, capture_output, text, env, timeout):
+        calls["env_platforms"] = env.get("JAX_PLATFORMS")
+        calls["timeout"] = timeout
+        return types.SimpleNamespace(
+            stdout="some runtime banner line\ntpu\n", returncode=0
+        )
+
+    class _Cfg:
+        jax_platforms = "axon,cpu"  # tunnel-backed: NOT pure cpu
+
+    class _FakeJax:
+        config = _Cfg()
+
+    import sys as _sys
+
+    monkeypatch.setitem(_sys.modules, "jax", _FakeJax())
+    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(crypto_batch, "_resolved_backend", None)
+    assert crypto_batch._resolve_backend_without_hanging() == "tpu"
+    assert calls["env_platforms"] == "axon,cpu"  # pin propagated
+
+    # a hung probe (TimeoutExpired) latches the host paths
+    def hang_run(*a, **k):
+        raise sp.TimeoutExpired(cmd="jax", timeout=k.get("timeout", 0))
+
+    monkeypatch.setattr(sp, "run", hang_run)
+    assert crypto_batch._resolve_backend_without_hanging() == "cpu"
+
+    # banner-only stdout (no plausible backend name) must not be
+    # mistaken for a backend
+    def garbage_run(argv, capture_output, text, env, timeout):
+        return types.SimpleNamespace(
+            stdout="W0000 something experimental!\n", returncode=0
+        )
+
+    monkeypatch.setattr(sp, "run", garbage_run)
+    assert crypto_batch._resolve_backend_without_hanging() == "cpu"
+
+
+def test_backend_probe_inline_when_cpu_pinned():
+    """The suite runs cpu-pinned (conftest), so the inline path must
+    resolve without any subprocess."""
+    import subprocess as sp
+
+    def boom(*a, **k):
+        raise AssertionError("subprocess probe used on a cpu-pinned process")
+
+    import unittest.mock as mock
+
+    with mock.patch.object(sp, "run", boom):
+        assert crypto_batch._resolve_backend_without_hanging() == "cpu"

@@ -252,6 +252,9 @@ class TestRound5Analytics:
         from corda_tpu.samples import simm_demo as sd
         from corda_tpu.webserver.plugins import registered_plugins
 
+        # another test may have wiped the registry (clear_web_plugins
+        # test hook); registration is idempotent, so restore it
+        sd.register_simm_web_api()
         plugin = next(
             p for p in registered_plugins()
             if isinstance(p, sd.SimmApiPlugin)
