@@ -287,6 +287,41 @@ def _kernel_flag(name: str) -> bool:
     return getattr(ed25519_pallas, name)
 
 
+def _codec_encode_us(n: int = 2000) -> float:
+    """Microbench the codec encode seam on the hot wire shape: one
+    serialize() of a realistic SignedTransaction (tx bytes + sigs)
+    through the production codec (native if built, else the pure-Python
+    fast path). Returns mean us per encode."""
+    from corda_tpu.core.contracts import Amount
+    from corda_tpu.core.contracts.amount import Issued
+    from corda_tpu.core.crypto import crypto
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.serialization.codec import serialize
+    from corda_tpu.core.transactions.builder import TransactionBuilder
+    from corda_tpu.finance.cash import CashCommand, CashState
+
+    kp = crypto.entropy_to_keypair(12)
+    me = Party("O=CodecBench,L=London,C=GB", kp.public)
+    token = Issued(me.ref(1), "USD")
+    b = TransactionBuilder(notary=me)
+    b.add_output_state(CashState(amount=Amount(100, token), owner=me))
+    b.add_command(CashCommand.Issue(), kp.public)
+    wtx = b.to_wire_transaction()
+    from corda_tpu.core.crypto.signing import DigitalSignatureWithKey
+    from corda_tpu.core.transactions.signed import SignedTransaction
+
+    stx = SignedTransaction.of(wtx, [
+        DigitalSignatureWithKey(
+            bytes=crypto.do_sign(kp.private, wtx.id.bytes), by=kp.public
+        )
+    ])
+    serialize(stx)  # warm the per-type encoder caches
+    t0 = time.perf_counter()
+    for _ in range(n):
+        serialize(stx)
+    return (time.perf_counter() - t0) / n * 1e6
+
+
 def _secondary_rates(on_tpu: bool, rng) -> dict:
     """ECDSA-P256 and mixed-scheme throughput via the production
     `core.crypto.batch.verify_batch` dispatch (scheme bucketing)."""
@@ -381,12 +416,33 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     from corda_tpu.loadtest.latency import measure_uniqueness_batch
 
     uniq = measure_uniqueness_batch(n_tx=10_000)
+
+    # Per-stage seam timings (VERDICT open item 2): each pipeline stage
+    # reports its own number so a system-path regression can be
+    # attributed to a stage instead of guessed at. Codec from the encode
+    # microbench; uniqueness from the commit coalescer's telemetry;
+    # batcher flush wall time from the settlement burst's batcher.
+    try:
+        codec_us = round(_codec_encode_us(), 2)
+    except Exception:
+        codec_us = None
+    stage_timings = {
+        "codec_encode_us_per_tx": codec_us,
+        "uniq_commit_batch_mean": uniq["raft_commit_batch_mean"],
+        "uniq_commit_batches": uniq["raft_commit_batches"],
+        "uniq_commit_batch_max": uniq["raft_commit_batch_max"],
+        "batcher_flush_wall_s": burst.get("batcher_flush_wall_s"),
+        "batcher_handoffs": burst.get("batcher_handoffs"),
+    }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
         "uniq_raft_p50_ms": uniq["raft_p50_ms"],
         "uniq_raft_commits_s": uniq["raft_commits_s"],
         "uniq_single_p50_ms": uniq["single_p50_ms"],
         "uniq_single_commits_s": uniq["single_commits_s"],
+        "uniq_commit_batch_mean": uniq["raft_commit_batch_mean"],
+        "codec_encode_us_per_tx": codec_us,
+        "stage_timings": stage_timings,
         "ecdsa_p256_sigs_s": round(ecdsa_rate, 1),
         "composite_items_s": round(composite_rate, 1),
         "composite_batch": comp_n,

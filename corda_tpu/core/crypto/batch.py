@@ -107,6 +107,20 @@ def _resolve_backend_without_hanging() -> str:
         p.strip() == "cpu" for p in platforms.split(",") if p.strip()
     ):
         return jax.default_backend()
+    # JAX already initialized IN-PROCESS (simm JIT, ops warm-up, mesh
+    # code ran first): the hang hazard only exists before first backend
+    # init, and a subprocess probe would CONTEND with this process for
+    # the accelerator (libtpu holds an exclusive lock), fail or time
+    # out, and silently latch "cpu" despite a healthy accelerator —
+    # the round-5 31.4k vs 60.2k cpu-dispatch regression. Read the live
+    # answer inline instead.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            return jax.default_backend()
+    except Exception:
+        pass  # private surface moved: fall through to the subprocess
     import subprocess
     import sys
 

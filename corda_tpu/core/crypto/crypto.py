@@ -16,9 +16,27 @@ import hmac as hmac_mod
 import os
 from typing import Iterable, Tuple
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import (
+        ec, ed25519, padding, rsa,
+    )
+
+    OPENSSL_AVAILABLE = True
+except ImportError:  # pragma: no cover - depends on the host image
+    # Degrade to the in-repo pure-Python math (ed25519_math / secp_math —
+    # the same modules that serve as the kernels' correctness oracles).
+    # ed25519 verification here is cofactorless like OpenSSL's, so the
+    # acceptance-rule pinning in core.crypto.batch is unaffected. RSA and
+    # X.509 (pki.py) genuinely need OpenSSL and stay gated: their entry
+    # points raise UnsupportedSchemeError with a clear message instead of
+    # the whole package failing at import.
+    OPENSSL_AVAILABLE = False
+    ec = ed25519 = padding = rsa = hashes = serialization = None
+
+    class InvalidSignature(Exception):
+        pass
 
 from . import ed25519_math, secp_math
 from .keys import KeyPair, PublicKey, SchemePrivateKey, SchemePublicKey
@@ -36,8 +54,12 @@ from .schemes import (
 )
 
 _EC_CURVES = {
-    ECDSA_SECP256K1_SHA256.scheme_code_name: (ec.SECP256K1(), secp_math.SECP256K1),
-    ECDSA_SECP256R1_SHA256.scheme_code_name: (ec.SECP256R1(), secp_math.SECP256R1),
+    ECDSA_SECP256K1_SHA256.scheme_code_name: (
+        ec.SECP256K1() if OPENSSL_AVAILABLE else None, secp_math.SECP256K1,
+    ),
+    ECDSA_SECP256R1_SHA256.scheme_code_name: (
+        ec.SECP256R1() if OPENSSL_AVAILABLE else None, secp_math.SECP256R1,
+    ),
 }
 
 
@@ -100,6 +122,7 @@ def generate_keypair(scheme: SignatureScheme = DEFAULT_SIGNATURE_SCHEME) -> KeyP
         d = (int.from_bytes(os.urandom(40), "big") % (curve.n - 1)) + 1
         return _ec_keypair_from_scalar(name, d)
     if name == RSA_SHA256.scheme_code_name:
+        _require_openssl("RSA key generation")
         priv = rsa.generate_private_key(public_exponent=65537, key_size=3072)
         return _rsa_keypair(priv)
     if name == SPHINCS256_SHA256.scheme_code_name:
@@ -109,21 +132,36 @@ def generate_keypair(scheme: SignatureScheme = DEFAULT_SIGNATURE_SCHEME) -> KeyP
     raise UnsupportedSchemeError(f"cannot generate keys for {name}")
 
 
+def _require_openssl(what: str) -> None:
+    if not OPENSSL_AVAILABLE:
+        raise UnsupportedSchemeError(
+            f"{what} requires the 'cryptography' package (OpenSSL), "
+            "which is not installed on this host"
+        )
+
+
 def _ed25519_keypair_from_seed(seed: bytes) -> KeyPair:
     name = EDDSA_ED25519_SHA512.scheme_code_name
-    pub = ed25519.Ed25519PrivateKey.from_private_bytes(seed).public_key()
-    pub_raw = pub.public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    if OPENSSL_AVAILABLE:
+        pub = ed25519.Ed25519PrivateKey.from_private_bytes(seed).public_key()
+        pub_raw = pub.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+    else:
+        pub_raw = ed25519_math.public_from_seed(seed)
     return KeyPair(SchemePublicKey(name, pub_raw), SchemePrivateKey(name, seed))
 
 
 def _ec_keypair_from_scalar(name: str, d: int) -> KeyPair:
-    jca_curve, _ = _EC_CURVES[name]
-    priv = ec.derive_private_key(d, jca_curve)
-    pub_raw = priv.public_key().public_bytes(
-        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
-    )
+    jca_curve, curve = _EC_CURVES[name]
+    if OPENSSL_AVAILABLE:
+        priv = ec.derive_private_key(d, jca_curve)
+        pub_raw = priv.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+    else:
+        pub_raw = curve.encode_point(curve.mul(d, curve.g), compressed=True)
     return KeyPair(
         SchemePublicKey(name, pub_raw),
         SchemePrivateKey(name, d.to_bytes(32, "big")),
@@ -179,12 +217,19 @@ def do_sign(private: SchemePrivateKey, clear_data: bytes) -> bytes:
         raise CryptoError("signing of an empty array is not permitted")
     name = private.scheme_code_name
     if name == EDDSA_ED25519_SHA512.scheme_code_name:
+        if not OPENSSL_AVAILABLE:
+            return ed25519_math.sign(private.encoded, clear_data)
         return ed25519.Ed25519PrivateKey.from_private_bytes(private.encoded).sign(clear_data)
     if name in _EC_CURVES:
-        jca_curve, _ = _EC_CURVES[name]
+        jca_curve, curve = _EC_CURVES[name]
         d = int.from_bytes(private.encoded, "big")
+        if not OPENSSL_AVAILABLE:
+            return secp_math.der_encode_sig(
+                *secp_math.ecdsa_sign(curve, d, clear_data)
+            )
         return ec.derive_private_key(d, jca_curve).sign(clear_data, ec.ECDSA(hashes.SHA256()))
     if name == RSA_SHA256.scheme_code_name:
+        _require_openssl("RSA signing")
         priv = serialization.load_der_private_key(private.encoded, password=None)
         return priv.sign(clear_data, padding.PKCS1v15(), hashes.SHA256())
     if name == SPHINCS256_SHA256.scheme_code_name:
@@ -214,16 +259,29 @@ def is_valid(public: PublicKey, signature: bytes, clear_data: bytes) -> bool:
     name = public.scheme_code_name
     try:
         if name == EDDSA_ED25519_SHA512.scheme_code_name:
+            if not OPENSSL_AVAILABLE:
+                # cofactorless, like OpenSSL: the deployment's pinned
+                # ed25519 acceptance rule does not shift with this path
+                return ed25519_math.verify(
+                    public.encoded, clear_data, signature
+                )
             ed25519.Ed25519PublicKey.from_public_bytes(public.encoded).verify(
                 signature, clear_data
             )
             return True
         if name in _EC_CURVES:
-            jca_curve, _ = _EC_CURVES[name]
+            jca_curve, curve = _EC_CURVES[name]
+            if not OPENSSL_AVAILABLE:
+                r, s = secp_math.der_decode_sig(signature)
+                return secp_math.ecdsa_verify(
+                    curve, curve.decode_point(public.encoded),
+                    clear_data, r, s,
+                )
             pub = ec.EllipticCurvePublicKey.from_encoded_point(jca_curve, public.encoded)
             pub.verify(signature, clear_data, ec.ECDSA(hashes.SHA256()))
             return True
         if name == RSA_SHA256.scheme_code_name:
+            _require_openssl("RSA verification")
             pub = serialization.load_der_public_key(public.encoded)
             pub.verify(signature, clear_data, padding.PKCS1v15(), hashes.SHA256())
             return True

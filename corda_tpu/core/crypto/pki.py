@@ -31,10 +31,41 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    OPENSSL_AVAILABLE = True
+except ImportError:  # pragma: no cover - depends on the host image
+    # X.509 genuinely needs OpenSSL; there is no pure-Python fallback.
+    # Importing this module stays safe (node/services import it lazily);
+    # the first actual PKI operation raises with a clear message.
+    OPENSSL_AVAILABLE = False
+
+    class _MissingOpenSSL:
+        def __init__(self, label: str):
+            self._label = label
+
+        def __getattr__(self, name):
+            raise ImportError(
+                f"{self._label}.{name}: X.509 PKI requires the "
+                "'cryptography' package (OpenSSL), which is not "
+                "installed on this host"
+            )
+
+        def __call__(self, *a, **kw):
+            raise ImportError(
+                f"{self._label}: X.509 PKI requires the 'cryptography' "
+                "package (OpenSSL), which is not installed on this host"
+            )
+
+    x509 = _MissingOpenSSL("x509")
+    hashes = _MissingOpenSSL("hashes")
+    serialization = _MissingOpenSSL("serialization")
+    ec = _MissingOpenSSL("ec")
+    NameOID = _MissingOpenSSL("NameOID")
 
 CORDA_ROOT_CA = "cordarootca"
 CORDA_INTERMEDIATE_CA = "cordaintermediateca"

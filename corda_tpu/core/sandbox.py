@@ -161,9 +161,11 @@ def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
             # (co_names carries LOAD_ATTR names, co_freevars closures)
             names = set(code.co_names) | set(code.co_freevars)
             bad = names & forbidden
+            # co_qualname arrived in 3.11; co_name is the 3.10 spelling
+            label = getattr(code, "co_qualname", None) or code.co_name
             if bad:
                 raise SandboxViolation(
-                    f"{code.co_qualname or code.co_name} references "
+                    f"{label} references "
                     f"forbidden name(s) {sorted(bad)}"
                 )
             # module blocklist: only names in module position (imports,
@@ -173,7 +175,7 @@ def check_code(obj: Any, extra_forbidden: Iterable[str] = ()) -> None:
                 root = name.split(".", 1)[0]
                 if root in FORBIDDEN_MODULES:
                     raise SandboxViolation(
-                        f"{code.co_qualname or code.co_name} touches "
+                        f"{label} touches "
                         f"forbidden module {root!r}"
                     )
 

@@ -26,8 +26,13 @@ from .wire import WireTransaction
 
 from collections import OrderedDict
 
-#: (content, key bytes, signature bytes) -> True for signatures that
-#: verified; bounded LRU, per process. See check_signatures_are_valid.
+#: (content, scheme, key bytes, signature bytes) -> True for signatures
+#: that verified; bounded LRU, per process. The SCHEME is part of the
+#: key: two keys with identical encoded bytes under different schemes
+#: verify through different engines, and a cache hit across them would
+#: make acceptance process-history-dependent (warm-cache replicas accept
+#: what cold-cache replicas reject — the replica split the rule-pinning
+#: work exists to prevent). See check_signatures_are_valid.
 _VERIFIED_SIGS: "OrderedDict[tuple, bool]" = OrderedDict()
 _VERIFIED_SIGS_MAX = 1 << 16
 
@@ -104,7 +109,7 @@ class TransactionWithSignatures:
         todo = []
         with _VERIFIED_SIGS_LOCK:
             for i, (key, sig, _) in enumerate(rows):
-                k = (content, key.encoded, sig)
+                k = (content, key.scheme_code_name, key.encoded, sig)
                 if k in _VERIFIED_SIGS:
                     _VERIFIED_SIGS.move_to_end(k)  # true LRU recency
                 else:
@@ -119,7 +124,9 @@ class TransactionWithSignatures:
             with _VERIFIED_SIGS_LOCK:
                 for i in todo:
                     key, sig, _ = rows[i]
-                    _VERIFIED_SIGS[(content, key.encoded, sig)] = True
+                    _VERIFIED_SIGS[
+                        (content, key.scheme_code_name, key.encoded, sig)
+                    ] = True
                 while len(_VERIFIED_SIGS) > _VERIFIED_SIGS_MAX:
                     _VERIFIED_SIGS.popitem(last=False)
 

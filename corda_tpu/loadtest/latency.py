@@ -94,7 +94,8 @@ def measure_notarise_latency(
 
 
 def measure_uniqueness_batch(
-    n_tx: int = 10_000, inputs_per_tx: int = 2, verbose: bool = False
+    n_tx: int = 10_000, inputs_per_tx: int = 2, verbose: bool = False,
+    threads: int = 16,
 ) -> Dict[str, float]:
     """BASELINE.md notary-demo config: p50 commit latency at an N-tx
     uniqueness batch, against BOTH the single-node commit log and a
@@ -104,55 +105,114 @@ def measure_uniqueness_batch(
 
     Drives the uniqueness providers directly — no flows — so the number
     isolates the commit log the way the reference's DistributedImmutableMap
-    benchmark surface would. Returns p50/p95 per-commit latency and
-    commits/s for each provider.
+    benchmark surface would. `threads` concurrent submitters model the
+    notary's flow-blocking pool, which is what lets the commit-coalescing
+    layer fold concurrent commits into one consensus round / one DB
+    transaction (one Raft log entry per BATCH, not per tx). Returns
+    p50/p95 per-commit latency, commits/s, and the coalescer's batch
+    telemetry for each provider.
     """
     import hashlib
+    import threading as _threading
 
     from ..core.crypto.secure_hash import SecureHash
     from ..core.contracts.structures import StateRef
     from ..node.database import NodeDatabase
-    from ..node.notary import PersistentUniquenessProvider
+    from ..node.notary import PersistentUniquenessProvider, maybe_coalesced
     from ..testing.mocknetwork import MockNetwork
 
-    def burst(provider, party):
-        lat: List[float] = []
-        t_start = time.perf_counter()
-        for i in range(n_tx):
-            h = hashlib.sha256(i.to_bytes(8, "big")).digest()
-            tx_id = SecureHash(h)
-            states = [
-                StateRef(SecureHash(hashlib.sha256(h + bytes([j])).digest()), j)
+    # pre-build every (states, tx_id) OUTSIDE the timed region: the
+    # number isolates the commit log, not sha256 fixture construction
+    work_items = []
+    for i in range(n_tx):
+        h = hashlib.sha256(i.to_bytes(8, "big")).digest()
+        work_items.append((
+            [
+                StateRef(
+                    SecureHash(hashlib.sha256(h + bytes([j])).digest()), j
+                )
                 for j in range(inputs_per_tx)
-            ]
-            t0 = time.perf_counter()
-            provider.commit(states, tx_id, party)
-            lat.append(time.perf_counter() - t0)
+            ],
+            SecureHash(h),
+        ))
+
+    def burst(provider, party, n_threads):
+        lat: List[float] = []
+        errors: List[BaseException] = []
+
+        def work(lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                states, tx_id = work_items[i]
+                t0 = time.perf_counter()
+                try:
+                    provider.commit(states, tx_id, party)
+                except BaseException as exc:  # surfaced after the join
+                    errors.append(exc)
+                    return
+                lat.append(time.perf_counter() - t0)
+
+        per = n_tx // n_threads
+        bounds = [
+            (k * per, (k + 1) * per if k < n_threads - 1 else n_tx)
+            for k in range(n_threads)
+        ]
+        t_start = time.perf_counter()
+        ts = [
+            _threading.Thread(target=work, args=b) for b in bounds if b[0] < b[1]
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
         wall = time.perf_counter() - t_start
-        return {
+        if errors:
+            raise errors[0]
+        out = {
             **_percentiles_ms(lat),
             "commits_per_sec": round(n_tx / wall, 1),
+            # coalescing seam (present when the provider batches)
+            "commit_batches": getattr(provider, "batches", n_tx),
+            "commit_batch_mean": round(getattr(provider, "mean_batch", 1.0), 2),
+            "commit_batch_max": getattr(provider, "largest_batch", 1),
         }
+        return out
 
     net = MockNetwork()
     try:
         _, members, _ = net.create_raft_notary_cluster(n_members=3)
         party = members[0].info
-        raft = burst(members[0].notary_service.uniqueness_provider, party)
+        # the notary service's provider IS the coalescing layer in
+        # production; drive the same object the flows would. The raft
+        # burst runs `threads` concurrent submitters (the shape that
+        # lets coalescing fold commits into one consensus round); the
+        # single-node commit log stays single-threaded — its per-commit
+        # cost is so low that submitter threads only measure the GIL,
+        # and one thread keeps the number comparable with prior rounds.
+        raft = burst(
+            members[0].notary_service.uniqueness_provider, party, threads
+        )
         single = burst(
-            PersistentUniquenessProvider(NodeDatabase(":memory:")), party
+            maybe_coalesced(
+                PersistentUniquenessProvider(NodeDatabase(":memory:"))
+            ),
+            party, 1,
         )
     finally:
         net.stop_nodes()
     out = {
         "n_tx": n_tx,
         "inputs_per_tx": inputs_per_tx,
+        "commit_threads": threads,
         "raft_p50_ms": raft["p50_ms"],
         "raft_p95_ms": raft["p95_ms"],
         "raft_commits_s": raft["commits_per_sec"],
+        "raft_commit_batches": raft["commit_batches"],
+        "raft_commit_batch_mean": raft["commit_batch_mean"],
+        "raft_commit_batch_max": raft["commit_batch_max"],
         "single_p50_ms": single["p50_ms"],
         "single_p95_ms": single["p95_ms"],
         "single_commits_s": single["commits_per_sec"],
+        "single_commit_batch_mean": single["commit_batch_mean"],
     }
     if verbose:
         print(out)
@@ -240,6 +300,8 @@ def measure_notarise_burst(
         "batcher_flushes": batcher.flushes,
         "batcher_items": batcher.items_verified,
         "batcher_largest_batch": batcher.largest_batch,
+        "batcher_handoffs": batcher.handoffs,
+        "batcher_flush_wall_s": round(batcher.flush_wall_s, 3),
     }
     net.stop_nodes()
     if verbose:

@@ -258,26 +258,63 @@ class Consumer:
                         return None
                     q.not_empty.wait(timeout=remaining)
 
-    def ack(self, msg: Message) -> None:
+    def receive_many(
+        self, max_messages: int, timeout: Optional[float] = None
+    ) -> List[Message]:
+        """Up to `max_messages` in ONE lock acquisition: blocks like
+        `receive` for the first message, then drains whatever else is
+        immediately queued. The p2p pump's per-message lock round trips
+        were pure context-switch tax on the 1-core system path."""
+        q = self._queue
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._broker._lock:
-            taken = self._unacked.pop(msg.message_id, None)
-            if taken is None:
-                raise BrokerError(
-                    f"ack of unknown/already-acked {msg.message_id}"
-                )
-            journal = self._queue.journal
-            if journal is not None:
-                journal.append_ack(msg.message_id)
-                if journal.acks_since_compact >= journal.COMPACT_ACK_THRESHOLD:
-                    pending = self._queue.pending_messages()
-                    # only compact when at least half the journal's records
-                    # are dead (Artemis min-compact-percent semantics): a
-                    # large standing backlog would otherwise be rewritten
-                    # in full, under the broker lock, for ~no space gain
-                    if journal.acks_since_compact >= len(pending):
-                        journal.compact(pending)
-                    else:
-                        journal.acks_since_compact = 0  # re-arm the window
+            if self._closed:
+                raise QueueClosedError(f"consumer on {q.name} is closed")
+            while True:
+                if self._closed or q.closed:
+                    return []
+                if q.messages:
+                    batch = []
+                    while q.messages and len(batch) < max_messages:
+                        msg = q.messages.popleft()
+                        self._unacked[msg.message_id] = msg
+                        batch.append(msg)
+                    return batch
+                if deadline is None:
+                    q.not_empty.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    q.not_empty.wait(timeout=remaining)
+
+    def ack(self, msg: Message) -> None:
+        self.ack_many([msg])
+
+    def ack_many(self, msgs: List[Message]) -> None:
+        """Acknowledge a batch under one lock acquisition (journal acks
+        are already group-flushed, so this only saves lock churn)."""
+        with self._broker._lock:
+            for msg in msgs:
+                taken = self._unacked.pop(msg.message_id, None)
+                if taken is None:
+                    raise BrokerError(
+                        f"ack of unknown/already-acked {msg.message_id}"
+                    )
+                journal = self._queue.journal
+                if journal is not None:
+                    journal.append_ack(msg.message_id)
+                    if journal.acks_since_compact >= journal.COMPACT_ACK_THRESHOLD:
+                        pending = self._queue.pending_messages()
+                        # only compact when at least half the journal's
+                        # records are dead (Artemis min-compact-percent
+                        # semantics): a large standing backlog would
+                        # otherwise be rewritten in full, under the broker
+                        # lock, for ~no space gain
+                        if journal.acks_since_compact >= len(pending):
+                            journal.compact(pending)
+                        else:
+                            journal.acks_since_compact = 0  # re-arm
 
     def close(self) -> None:
         q = self._queue

@@ -15,6 +15,13 @@
 #include <Python.h>
 #include <string.h>
 
+/* PyFloat_Pack8/Unpack8 became public API in 3.11; 3.10 ships the same
+ * functions under their historical private names. */
+#if PY_VERSION_HEX < 0x030B0000
+#define PyFloat_Pack8(x, p, le) _PyFloat_Pack8((x), (unsigned char *)(p), (le))
+#define PyFloat_Unpack8(p, le) _PyFloat_Unpack8((const unsigned char *)(p), (le))
+#endif
+
 enum {
     TAG_NULL, TAG_TRUE, TAG_FALSE, TAG_INT, TAG_BYTES,
     TAG_STR, TAG_LIST, TAG_MAP, TAG_OBJ, TAG_F64

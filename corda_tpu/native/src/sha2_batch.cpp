@@ -235,10 +235,23 @@ static void sha256_compress_ni(uint32_t state[8], const uint8_t* block) {
                      _mm_alignr_epi8(st1, tmp, 8));             // HGFE
 }
 
+#include <cpuid.h>
+static bool sha256_ni_probe() {
+    // direct CPUID: __builtin_cpu_supports("sha") only parses on
+    // GCC >= 11, and this file must build with the distro toolchains
+    // node hosts actually carry (observed: GCC 10 rejects the "sha"
+    // feature name at compile time)
+    unsigned a, b, c, d;
+    if (!__get_cpuid(1, &a, &b, &c, &d)) return false;
+    const bool sse41 = (c >> 19) & 1u;
+    const bool ssse3 = (c >> 9) & 1u;
+    if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return false;
+    const bool sha = (b >> 29) & 1u;
+    return sha && sse41 && ssse3;
+}
+
 static bool sha256_ni_available() {
-    static const bool ok = __builtin_cpu_supports("sha") &&
-                           __builtin_cpu_supports("sse4.1") &&
-                           __builtin_cpu_supports("ssse3");
+    static const bool ok = sha256_ni_probe();
     return ok;
 }
 #else

@@ -425,6 +425,34 @@ class KVStore:
         )
         return rows[0][0] if rows else None
 
+    #: sqlite's default SQLITE_MAX_VARIABLE_NUMBER floor; chunking keeps
+    #: get_many safe for arbitrarily large merged batches
+    _IN_CHUNK = 500
+
+    def get_many(self, keys) -> Dict[bytes, bytes]:
+        """Present subset of `keys` in one SELECT per chunk — the batch
+        read under a merged uniqueness commit (one pass over the merged
+        StateRef set instead of one query per ref)."""
+        keys = [bytes(k) for k in keys]
+        found: Dict[bytes, bytes] = {}
+        for i in range(0, len(keys), self._IN_CHUNK):
+            chunk = keys[i:i + self._IN_CHUNK]
+            marks = ",".join("?" * len(chunk))
+            for k, v in self.db.query(
+                f"SELECT k, v FROM {self.table} WHERE k IN ({marks})",
+                tuple(chunk),
+            ):
+                found[bytes(k)] = bytes(v)
+        return found
+
+    def put_many(self, pairs) -> None:
+        """Batch upsert via one executemany (one commit cycle)."""
+        self.db.executemany(
+            f"INSERT INTO {self.table}(k, v) VALUES(?, ?) "
+            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+            [(k, v) for k, v in pairs],
+        )
+
     def delete(self, key: bytes) -> None:
         self.db.execute(f"DELETE FROM {self.table} WHERE k = ?", (key,))
 

@@ -269,35 +269,50 @@ class BrokerMessagingService:
     def _consume(self) -> None:
         self._consume_from(self._consumer)
 
+    #: max messages drained into one lock acquisition by the pump
+    PUMP_BATCH = 32
+
     def _consume_from(self, consumer) -> None:
         from ..core.crypto.keys import SchemePublicKey
 
+        # local consumers batch under one broker-lock acquisition; remote
+        # consumers (RemoteConsumer) pipeline on the wire already and
+        # keep the one-at-a-time surface
+        batched = hasattr(consumer, "receive_many")
         while not self._stop.is_set():
-            msg = consumer.receive(timeout=0.2)
-            if msg is None:
+            if batched:
+                batch = consumer.receive_many(self.PUMP_BATCH, timeout=0.2)
+            else:
+                msg = consumer.receive(timeout=0.2)
+                batch = [msg] if msg is not None else []
+            if not batch:
                 continue
-            topic = msg.headers.get("topic", "")
-            sender = Party(
-                msg.headers.get("sender", "?"),
-                SchemePublicKey(
-                    "EDDSA_ED25519_SHA512",
-                    bytes.fromhex(msg.headers.get("sender_key", "")),
+            for msg in batch:
+                topic = msg.headers.get("topic", "")
+                sender = Party(
+                    msg.headers.get("sender", "?"),
+                    SchemePublicKey(
+                        "EDDSA_ED25519_SHA512",
+                        bytes.fromhex(msg.headers.get("sender_key", "")),
+                    )
+                    if msg.headers.get("sender_key")
+                    else None,
                 )
-                if msg.headers.get("sender_key")
-                else None,
-            )
-            metrics = self.metrics
-            t0 = time.perf_counter() if metrics is not None else 0.0
-            for fn in self._handlers.get(topic, []):
-                try:
-                    fn(sender, msg.payload)
-                except Exception:
-                    pass  # handler errors must not kill the pump
-            if metrics is not None:
-                metrics.timer(f"P2P.Handle.{topic}").update(
-                    time.perf_counter() - t0
-                )
-            consumer.ack(msg)
+                metrics = self.metrics
+                t0 = time.perf_counter() if metrics is not None else 0.0
+                for fn in self._handlers.get(topic, []):
+                    try:
+                        fn(sender, msg.payload)
+                    except Exception:
+                        pass  # handler errors must not kill the pump
+                if metrics is not None:
+                    metrics.timer(f"P2P.Handle.{topic}").update(
+                        time.perf_counter() - t0
+                    )
+            if batched:
+                consumer.ack_many(batch)
+            else:
+                consumer.ack(batch[0])
 
     def stop(self) -> None:
         self._stop.set()

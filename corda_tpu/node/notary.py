@@ -16,8 +16,11 @@ rather than per-signature host crypto.
 from __future__ import annotations
 
 import os
+import threading
+import time
+from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.contracts.structures import StateRef, TimeWindow
 from ..core.flows.api import FlowException, FlowLogic, initiated_by, initiating_flow
@@ -63,6 +66,15 @@ class UniquenessProvider:
         the f+1 replica signatures); None otherwise."""
         raise NotImplementedError
 
+    # Providers that can fold MANY transactions' input sets into one
+    # consensus round / one DB transaction additionally implement
+    #   commit_many(requests: [(states, tx_id, party)]) -> [per-tx result]
+    # where each result is None (committed) or a Conflict (rejected).
+    # Semantics are sequential: a request earlier in the batch that
+    # claims a ref makes a later conflicting request fail, exactly as if
+    # the commits had run one at a time. CoalescingUniquenessProvider
+    # fronts such providers on the notary hot path.
+
 
 class PersistentUniquenessProvider(UniquenessProvider):
     """Single-node commit log in the node DB. All-or-nothing batch commit
@@ -77,19 +89,51 @@ class PersistentUniquenessProvider(UniquenessProvider):
         return ref.txhash.bytes + ref.index.to_bytes(4, "big")
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
-        with self._db.lock:
-            conflicts: Dict[str, object] = {}
-            for ref in states:
-                existing = self._map.get(self._key(ref))
-                if existing is not None:
-                    consuming = deserialize(existing)
-                    if consuming["tx_id"] != tx_id:
-                        conflicts[repr(ref)] = consuming["tx_id"]
-            if conflicts:
-                raise UniquenessException(Conflict(tx_id, conflicts))
-            blob = serialize({"tx_id": tx_id, "by": requesting_party.name})
-            for ref in states:
-                self._map.put(self._key(ref), blob)
+        result = self.commit_many([(states, tx_id, requesting_party)])[0]
+        if result is not None:
+            raise UniquenessException(result)
+
+    def commit_many(self, requests: Sequence[Tuple]) -> List[Optional[Conflict]]:
+        """One DB transaction for the whole batch: the merged StateRef set
+        is fetched in one pass, conflicts are resolved per-tx against the
+        map plus earlier requests in the same batch, and all accepted
+        rows land via one executemany."""
+        out: List[Optional[Conflict]] = []
+        with self._db.transaction():
+            merged = {
+                self._key(ref)
+                for states, _, _ in requests
+                for ref in states
+            }
+            existing = self._map.get_many(merged)
+            staged: Dict[bytes, object] = {}  # key -> tx claimed this batch
+            writes: List[Tuple[bytes, bytes]] = []
+            for states, tx_id, party in requests:
+                conflicts: Dict[str, object] = {}
+                for ref in states:
+                    key = self._key(ref)
+                    prior = staged.get(key)
+                    if prior is not None:
+                        if prior != tx_id:
+                            conflicts[repr(ref)] = prior
+                        continue
+                    blob = existing.get(key)
+                    if blob is not None:
+                        consuming = deserialize(blob)
+                        if consuming["tx_id"] != tx_id:
+                            conflicts[repr(ref)] = consuming["tx_id"]
+                if conflicts:
+                    out.append(Conflict(tx_id, conflicts))
+                    continue
+                blob = serialize({"tx_id": tx_id, "by": party.name})
+                for ref in states:
+                    key = self._key(ref)
+                    staged[key] = tx_id
+                    writes.append((key, blob))
+                out.append(None)
+            if writes:
+                self._map.put_many(writes)
+        return out
 
 
 class RaftUniquenessProvider(UniquenessProvider):
@@ -135,67 +179,103 @@ class RaftUniquenessProvider(UniquenessProvider):
 
     def apply(self, command: dict):
         """State-machine apply (runs on every replica, in log order)."""
-        if command.get("kind") != "putall":
+        kind = command.get("kind")
+        if kind == "putall":
+            # single-tx command; kept for logs persisted before the
+            # batched protocol (replayed verbatim after a restart)
+            return self._apply_entries([command["entries"]])[0]
+        if kind != "putall_multi":
             return None
-        conflicts = {}
-        for key_hex, consuming_blob in command["entries"].items():
-            existing = self._map.get(bytes.fromhex(key_hex))
-            if existing is not None:
-                mine = deserialize(consuming_blob)["tx_id"]
-                theirs = deserialize(existing)["tx_id"]
-                if mine != theirs:
-                    conflicts[key_hex] = theirs
-        if not conflicts:
-            for key_hex, consuming_blob in command["entries"].items():
-                self._map.put(bytes.fromhex(key_hex), consuming_blob)
-        return {"conflicts": {k: v for k, v in conflicts.items()}}
+        return {"results": self._apply_entries(command["txs"])}
 
-    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
-        import time as _time
+    def _apply_entries(self, txs: Sequence[dict]) -> List[dict]:
+        """Apply each tx's entry set in order; per-tx all-or-nothing.
+        A tx later in the batch that collides with an EARLIER accepted
+        tx sees that tx's rows already in the map, so merged batches
+        keep exact sequential-commit semantics. One DB transaction for
+        the whole command keeps a 10k-row burst off sqlite's
+        per-statement commit path."""
+        results = []
+        with self._map.db.transaction():
+            for entries in txs:
+                conflicts = {}
+                for key_hex, consuming_blob in entries.items():
+                    existing = self._map.get(bytes.fromhex(key_hex))
+                    if existing is not None:
+                        mine = deserialize(consuming_blob)["tx_id"]
+                        theirs = deserialize(existing)["tx_id"]
+                        if mine != theirs:
+                            conflicts[key_hex] = theirs
+                if not conflicts:
+                    self._map.put_many(
+                        (bytes.fromhex(k), blob)
+                        for k, blob in entries.items()
+                    )
+                results.append({"conflicts": conflicts})
+        return results
+
+    def _submit(self, command: dict) -> dict:
         from concurrent.futures import TimeoutError as _FuturesTimeout
 
         from .raft import NotLeaderError
 
-        blob = serialize({"tx_id": tx_id, "by": requesting_party.name})
-        entries = {
-            PersistentUniquenessProvider._key(ref).hex(): blob for ref in states
-        }
-        command = {"kind": "putall", "entries": entries}
         if not self.forwarding_retry:
-            result = self.raft.submit(command).result(timeout=30)
-        else:
-            # Any member accepts the commit: leaders apply locally,
-            # followers forward (raft.submit_anywhere); NotLeaderError
-            # during elections retries until the cluster converges
-            # (reference CopycatClient). putall is idempotent for the
-            # same tx_id, so a retried commit cannot double-spend itself.
-            deadline = _time.monotonic() + 30
-            while True:
-                fut = self.raft.submit_anywhere(command)
-                try:
-                    result = fut.result(timeout=5)
-                    break
-                except NotLeaderError:
-                    if _time.monotonic() > deadline:
-                        raise
-                    _time.sleep(0.2)
-                except (TimeoutError, _FuturesTimeout):
-                    # distinct classes on 3.10; aliases from 3.11 on
-                    if _time.monotonic() > deadline:
-                        raise
-        if result["conflicts"]:
+            return self.raft.submit(command).result(timeout=30)
+        # Any member accepts the commit: leaders apply locally,
+        # followers forward (raft.submit_anywhere); NotLeaderError
+        # during elections retries until the cluster converges
+        # (reference CopycatClient). putall is idempotent for the
+        # same tx_id, so a retried commit cannot double-spend itself.
+        deadline = time.monotonic() + 30
+        while True:
+            fut = self.raft.submit_anywhere(command)
+            try:
+                return fut.result(timeout=5)
+            except NotLeaderError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+            except (TimeoutError, _FuturesTimeout):
+                # distinct classes on 3.10; aliases from 3.11 on
+                if time.monotonic() > deadline:
+                    raise
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
+        result = self.commit_many([(states, tx_id, requesting_party)])[0]
+        if result is not None:
+            raise UniquenessException(result)
+
+    def commit_many(self, requests: Sequence[Tuple]) -> List[Optional[Conflict]]:
+        """ONE Raft log entry for the whole batch: a 10k-tx uniqueness
+        burst costs O(batches) consensus rounds instead of O(tx). Per-tx
+        verdicts come back positionally and demultiplex to Conflicts."""
+        txs = []
+        for states, tx_id, party in requests:
+            blob = serialize({"tx_id": tx_id, "by": party.name})
+            txs.append({
+                PersistentUniquenessProvider._key(ref).hex(): blob
+                for ref in states
+            })
+        result = self._submit({"kind": "putall_multi", "txs": txs})
+        out: List[Optional[Conflict]] = []
+        for (states, tx_id, _), verdict in zip(requests, result["results"]):
+            conflicts = verdict["conflicts"]
+            if not conflicts:
+                out.append(None)
+                continue
             by_key = {
                 PersistentUniquenessProvider._key(ref).hex(): ref
                 for ref in states
             }
-            raise UniquenessException(Conflict(
+            out.append(Conflict(
                 tx_id,
                 {
                     repr(by_key[k]): v
-                    for k, v in result["conflicts"].items()
+                    for k, v in conflicts.items()
                     if k in by_key
                 },
             ))
+        return out
 
 
 class BFTUniquenessProvider(UniquenessProvider):
@@ -300,6 +380,126 @@ class BFTUniquenessProvider(UniquenessProvider):
 
 
 # ---------------------------------------------------------------------------
+# Commit coalescing (group commit)
+# ---------------------------------------------------------------------------
+
+class CoalescingUniquenessProvider(UniquenessProvider):
+    """Group-commit front for providers that implement `commit_many`.
+
+    Concurrent `commit` calls (the notary's flow-blocking executor runs
+    one per in-flight notarise flow) coalesce into ONE consensus round /
+    ONE DB transaction: the first caller in becomes the drainer and
+    keeps folding whatever arrives while a round is in flight; everyone
+    else waits on a per-request future. Uncontended commits drain
+    immediately as a batch of 1, so the layer adds no linger latency —
+    batching emerges exactly when there is load to batch (the
+    committee-consensus lesson from PAPERS.md: once verification is
+    batched, the coordination path must batch too).
+
+    Seam telemetry: `batches`, `commits`, `largest_batch`,
+    `commit_wall_s` feed bench.py's `uniq_commit_batch_mean` stage
+    timing."""
+
+    def __init__(self, delegate, max_batch: Optional[int] = None):
+        if max_batch is None:
+            max_batch = int(
+                os.environ.get("CORDA_TPU_UNIQ_COALESCE_MAX", 512)
+            )
+        self.delegate = delegate
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._pending: List[Tuple] = []  # (states, tx_id, party, Future)
+        self._draining = False
+        # seam telemetry
+        self.batches = 0
+        self.commits = 0
+        self.largest_batch = 0
+        self.commit_wall_s = 0.0
+
+    @property
+    def mean_batch(self) -> float:
+        return self.commits / self.batches if self.batches else 0.0
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party: Party):
+        fut: Optional[Future] = None
+        with self._lock:
+            if self._draining:
+                fut = Future()
+                self._pending.append(
+                    (list(states), tx_id, requesting_party, fut)
+                )
+            else:
+                self._draining = True
+        if fut is not None:
+            # a round is in flight: the drainer commits for us.
+            # generous bound: the delegate's own consensus deadline
+            # (30 s/round) plus queued rounds ahead of this one
+            result = fut.result(timeout=120)
+        else:
+            # uncontended leader fast path: commit directly (no Future,
+            # no handoff — a lone commit costs what the delegate costs),
+            # then serve anything that queued behind us
+            try:
+                t0 = time.perf_counter()
+                result = self.delegate.commit_many(
+                    [(list(states), tx_id, requesting_party)]
+                )[0]
+                self.commit_wall_s += time.perf_counter() - t0
+                self.batches += 1
+                self.commits += 1
+                self.largest_batch = max(self.largest_batch, 1)
+            finally:
+                self._drain()
+        if isinstance(result, Conflict):
+            raise UniquenessException(result)
+        return result
+
+    def _drain(self) -> None:
+        """Serve queued requests in max_batch rounds; caller must hold
+        the drainer role (self._draining True). Releases it on exit."""
+        while True:
+            with self._lock:
+                batch = self._pending[: self.max_batch]
+                self._pending = self._pending[self.max_batch:]
+                if not batch:
+                    self._draining = False
+                    return
+            t0 = time.perf_counter()
+            try:
+                results = self.delegate.commit_many(
+                    [(s, t, p) for s, t, p, _ in batch]
+                )
+            except BaseException as exc:
+                # fail this round's waiters; later arrivals get a fresh
+                # consensus attempt instead of inheriting the error
+                for *_, fut in batch:
+                    fut.set_exception(exc)
+                continue
+            self.commit_wall_s += time.perf_counter() - t0
+            self.batches += 1
+            self.commits += len(batch)
+            self.largest_batch = max(self.largest_batch, len(batch))
+            for (*_, fut), result in zip(batch, results):
+                fut.set_result(result)
+
+    def __getattr__(self, name):
+        # observability passthrough (is_consumed, member_providers, _map…)
+        return getattr(self.delegate, name)
+
+
+def maybe_coalesced(provider: UniquenessProvider) -> UniquenessProvider:
+    """Front `provider` with the group-commit layer when it supports
+    batched commits (CORDA_TPU_NOTARY_COALESCE=0 disables)."""
+    if (
+        hasattr(provider, "commit_many")
+        and not isinstance(provider, CoalescingUniquenessProvider)
+        and os.environ.get("CORDA_TPU_NOTARY_COALESCE", "1") != "0"
+    ):
+        return CoalescingUniquenessProvider(provider)
+    return provider
+
+
+# ---------------------------------------------------------------------------
 # Notary services
 # ---------------------------------------------------------------------------
 
@@ -312,7 +512,7 @@ class NotaryService:
                  uniqueness_provider: Optional[UniquenessProvider] = None):
         self.services = services
         self.identity = identity
-        self.uniqueness_provider = (
+        self.uniqueness_provider = maybe_coalesced(
             uniqueness_provider or PersistentUniquenessProvider(services.db)
         )
 

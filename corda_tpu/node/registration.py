@@ -19,8 +19,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib import request as _urlreq
 
-from cryptography import x509
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+except ImportError:  # pragma: no cover - registration needs real X.509
+    from ..core.crypto.pki import serialization, x509  # lazy-failing stubs
 
 from ..core.crypto import pki
 

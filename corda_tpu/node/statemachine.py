@@ -619,10 +619,18 @@ class StateMachineManager:
         # runs these computations inline so tests stay pump-synchronous.
         self._blocking_executor = None
         if getattr(messaging, "ASYNC_FLOW_DISPATCH", False):
+            import os as _os
             from concurrent.futures import ThreadPoolExecutor
 
+            # env-tunable: these threads mostly BLOCK (cluster commits,
+            # batcher futures) so they are cheap, but the count also
+            # bounds how many concurrent commits the notary's coalescing
+            # uniqueness layer can fold into one consensus round
             self._blocking_executor = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="flow-blocking"
+                max_workers=int(
+                    _os.environ.get("CORDA_TPU_FLOW_BLOCKING_THREADS", 4)
+                ),
+                thread_name_prefix="flow-blocking",
             )
         self.checkpoints_written = 0
         # Key metric names mirror the reference (StateMachineManager.kt:127-133)

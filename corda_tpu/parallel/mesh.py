@@ -150,7 +150,11 @@ _SHARDED_STEP_CACHE: dict = {}
 def _sharded_step(mesh, scheme: str):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+
+    try:
+        from jax import shard_map  # jax >= 0.5
+    except ImportError:  # the pre-0.5 experimental home
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     # Content-based key: id(mesh) could be reused by a new mesh after the
@@ -178,12 +182,18 @@ def _sharded_step(mesh, scheme: str):
     # check_vma off: the kernels' fori_loop carries start from unvarying
     # constant points (identity / generator), which the varying-manual-axes
     # checker rejects even though the per-shard computation is correct.
-    fn = jax.jit(
-        shard_map(
+    # (jax < 0.5 spells the same knob check_rep.)
+    try:
+        sharded = shard_map(
             step, mesh=mesh, in_specs=specs, out_specs=(P(axis), P()),
             check_vma=False,
         )
-    )
+    except TypeError:
+        sharded = shard_map(
+            step, mesh=mesh, in_specs=specs, out_specs=(P(axis), P()),
+            check_rep=False,
+        )
+    fn = jax.jit(sharded)
     cached = (prepare, fn, specs, blk)
     _SHARDED_STEP_CACHE[key] = cached
     return cached

@@ -53,16 +53,37 @@ class RPCServer:
         self._consumer = broker.create_consumer(RPC_SERVER_QUEUE)
         # Calls run on a pool: a blocking op (flow_result waiting a minute
         # on a stalled notary) must not wedge every other client's RPCs
-        # behind it on the single consume thread.
+        # behind it on the single consume thread. CPU-aware size: 8
+        # runnable workers on a 1-core loadtest box were pure
+        # context-switch tax (GIL scheduling profiled as a top system-
+        # path cost); most call volume now dispatches inline anyway.
+        import os as _os
         from concurrent.futures import ThreadPoolExecutor
 
         from ..utils.profiling import maybe_profiled, try_claim_thread_profile
 
+        workers = int(
+            _os.environ.get(
+                "CORDA_TPU_RPC_WORKERS",
+                max(2, min(8, 2 * (_os.cpu_count() or 1))),
+            )
+        )
         self._pool = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="rpc-worker",
+            max_workers=workers, thread_name_prefix="rpc-worker",
             # CORDA_TPU_PROFILE_THREAD=rpcpool profiles ONE worker as a
             # stand-in for the pool (flow bodies run here)
             initializer=lambda: try_claim_thread_profile("rpcpool"),
+        )
+        # Direct dispatch instead of re-enqueue: methods that reply from
+        # the flow future's done-callback never block, so funnelling them
+        # through the pool cost a thread handoff per call on the notary
+        # round trip (start_flow_and_wait is 2 of the 2 RPCs per loadtest
+        # pair). They run inline on the consume thread.
+        self._inline_methods = (
+            frozenset({"start_flow_and_wait", "flow_result"})
+            if _os.environ.get("CORDA_TPU_RPC_INLINE", "1") != "0"
+            and hasattr(ops, "flow_result_future")
+            else frozenset()
         )
 
         self._thread = threading.Thread(
@@ -96,10 +117,16 @@ class RPCServer:
                 except Exception:
                     pass  # a bad request must not kill the server
 
-            try:
-                self._pool.submit(run)
-            except RuntimeError:
-                pass  # pool shut down: server stopping
+            if (
+                request.get("kind") == "call"
+                and request.get("method") in self._inline_methods
+            ):
+                run()  # replies via the flow future's done-callback
+            else:
+                try:
+                    self._pool.submit(run)
+                except RuntimeError:
+                    pass  # pool shut down: server stopping
             self._consumer.ack(msg)
 
     def _reply(self, reply_to: str, payload: dict) -> None:
@@ -234,8 +261,10 @@ class RPCServer:
             ):
                 return
             # future unavailable (already-done edge): fall through to a
-            # synchronous result fetch
-            args, kwargs, method_name = (fid,), {}, "flow_result"
+            # synchronous result fetch — KEEPING the caller's wait bound,
+            # so this edge can never pin an RPC worker forever
+            args, method_name = (fid,), "flow_result"
+            kwargs = {} if wait_timeout is None else {"timeout": wait_timeout}
         smm = getattr(self.ops, "_smm", None)
         timer = (
             smm.metrics.timer(f"RPC.{method_name}") if smm is not None else None

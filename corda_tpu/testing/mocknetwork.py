@@ -364,6 +364,20 @@ class MockNetwork:
                         bus.now += 1.0
                 raise last_exc
 
+            def commit_many(self, requests):
+                """Batched commits ride ONE Raft log entry on the current
+                leader (same failover-retry loop as commit)."""
+                last_exc = None
+                for _ in range(5):
+                    leader = bus.elect()
+                    provider = self.member_providers[leader.node_id]
+                    try:
+                        return provider.commit_many(requests)
+                    except NotLeaderError as exc:
+                        last_exc = exc
+                        bus.now += 1.0
+                raise last_exc
+
             def is_consumed(self, ref) -> bool:
                 return any(
                     p.is_consumed(ref)

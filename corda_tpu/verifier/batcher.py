@@ -10,6 +10,15 @@ device kernels) when either
   * `linger_ms` elapses after the first pending item (latency bound), or
   * a caller forces `flush()`.
 
+DOUBLE-BUFFERED: full/lingered buffers hand off to a dedicated flush
+thread that drains them while `submit` keeps filling the next buffer —
+a submitter never pays a flush it didn't force, and the verify body
+never runs on the shared timer wheel's 2-thread callback pool (where a
+minutes-long first XLA compile would stall every other timeout in the
+process — the round-5 advisor finding).  The linger callback only moves
+the buffer onto the flush queue, which is exactly the "strictly
+lightweight wheel callback" contract.
+
 Padding to the next power of two happens inside the device kernel wrapper
 (`ops.ed25519_batch.prepare_batch(pad_to=...)`), so XLA sees a small fixed
 set of shapes and recompiles rarely.
@@ -18,8 +27,10 @@ from __future__ import annotations
 
 import os
 import threading
+import time
+from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Sequence, Tuple
+from typing import Deque, List, Optional, Sequence, Tuple
 
 from ..core.crypto import batch as crypto_batch
 from ..core.crypto.keys import PublicKey
@@ -45,14 +56,22 @@ class SignatureBatcher:
             )
         self.max_batch = max_batch
         self.linger_ms = linger_ms
+        # one lock: guards the fill buffer AND (as the condition's lock)
+        # the flush queue / in-flight count
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._pending: List[Tuple[Item, Future]] = []
+        self._flush_queue: Deque[List[Tuple[Item, Future]]] = deque()
+        self._in_flight = 0  # batches being verified right now
+        self._flush_thread: Optional[threading.Thread] = None
         self._timer = None  # TimerHandle from the shared wheel
         self._closed = False
-        # telemetry
+        # telemetry (seam timers for bench.py stage attribution)
         self.flushes = 0
         self.items_verified = 0
         self.largest_batch = 0
+        self.handoffs = 0  # buffers drained by the flush thread
+        self.flush_wall_s = 0.0  # cumulative wall time inside verify
 
     def submit(self, item: Item) -> Future:
         """Queue one signature check; resolves to bool."""
@@ -60,43 +79,115 @@ class SignatureBatcher:
 
     def submit_many(self, items: Sequence[Item]) -> List[Future]:
         futures = [Future() for _ in items]
-        run_now = False
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             self._pending.extend(zip(items, futures))
             if len(self._pending) >= self.max_batch:
-                run_now = True
+                # full buffer -> flush thread; submit keeps filling the
+                # next buffer without waiting for the verify
+                self._hand_off_locked()
             elif self._timer is None:
                 # shared timer wheel (one process-wide thread), not a
                 # threading.Timer thread per linger window
                 from ..utils.timerwheel import call_later
 
-                self._timer = call_later(self.linger_ms / 1000.0, self.flush)
-        if run_now:
-            self.flush()
+                self._timer = call_later(
+                    self.linger_ms / 1000.0, self._linger_fired
+                )
         return futures
 
-    def flush(self) -> None:
+    # -- double-buffer plumbing -------------------------------------------
+
+    def _linger_fired(self) -> None:
+        # runs on the wheel's callback pool: MUST stay lightweight — it
+        # only moves the buffer across and wakes the flush thread
         with self._lock:
-            if self._timer is not None:
-                self._timer.cancel()
-                self._timer = None
-            batch, self._pending = self._pending, []
+            self._timer = None
+            if self._pending:
+                self._hand_off_locked()
+
+    def _hand_off_locked(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        batch, self._pending = self._pending, []
         if not batch:
             return
+        self._flush_queue.append(batch)
+        self.handoffs += 1
+        if self._flush_thread is None or not self._flush_thread.is_alive():
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name="sig-batcher-flush",
+            )
+            self._flush_thread.start()
+        self._cv.notify_all()
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._flush_queue and not self._closed:
+                    self._cv.wait()
+                if not self._flush_queue:
+                    return  # closed and drained
+                batch = self._flush_queue.popleft()
+                self._in_flight += 1
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._cv:
+                    self._in_flight -= 1
+                    self._cv.notify_all()
+
+    def _run_batch(self, batch: List[Tuple[Item, Future]]) -> None:
         items = [it for it, _ in batch]
+        t0 = time.perf_counter()
         try:
             results = crypto_batch.verify_batch(items)
         except Exception as exc:  # propagate to every waiter
             for _, fut in batch:
                 fut.set_exception(exc)
             return
+        self.flush_wall_s += time.perf_counter() - t0
         self.flushes += 1
         self.items_verified += len(batch)
         self.largest_batch = max(self.largest_batch, len(batch))
         for (_, fut), ok in zip(batch, results):
             fut.set_result(bool(ok))
+
+    # -- synchronous edges -------------------------------------------------
+
+    def flush(self) -> None:
+        """Run the fill buffer NOW on the caller's thread, then wait for
+        any batches already handed to the flush thread — after flush()
+        returns, every previously submitted future is resolved (the
+        contract deterministic single-pump callers rely on)."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            batch, self._pending = self._pending, []
+        if batch:
+            self._run_batch(batch)
+        while True:
+            with self._cv:
+                if not self._flush_queue and not self._in_flight:
+                    return
+                # defensive: a dead flush thread must not strand queued
+                # batches (and hang this wait) — drain them inline
+                thread_dead = (
+                    self._flush_thread is None
+                    or not self._flush_thread.is_alive()
+                )
+                stranded = (
+                    self._flush_queue.popleft()
+                    if self._flush_queue and thread_dead else None
+                )
+                if stranded is None:
+                    self._cv.wait(timeout=0.05)
+                    continue
+            self._run_batch(stranded)
 
     def close(self) -> None:
         # Refuse new work first, then drain: a submit racing with close
@@ -104,7 +195,5 @@ class SignatureBatcher:
         # never a silently-stranded future.
         with self._lock:
             self._closed = True
-            if self._timer is not None:
-                self._timer.cancel()
-                self._timer = None
+            self._cv.notify_all()  # wake the flush thread to exit
         self.flush()

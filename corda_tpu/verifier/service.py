@@ -61,7 +61,19 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
     """Worker pool in the node process; signature checks go through a local
     SignatureBatcher so device batching still happens."""
 
-    def __init__(self, worker_count: int = 4, batcher: Optional[SignatureBatcher] = None):
+    def __init__(self, worker_count: Optional[int] = None,
+                 batcher: Optional[SignatureBatcher] = None):
+        if worker_count is None:
+            import os
+
+            # CPU-aware: 4 runnable verify workers on a 1-core box only
+            # context-thrash; multi-core hosts keep the full pool
+            worker_count = int(
+                os.environ.get(
+                    "CORDA_TPU_VERIFIER_WORKERS",
+                    max(2, min(4, os.cpu_count() or 1)),
+                )
+            )
         self._pool = ThreadPoolExecutor(
             max_workers=worker_count, thread_name_prefix="verifier"
         )

@@ -25,6 +25,10 @@ def _items(schemes, tamper_idx=()):
     return items
 
 
+@pytest.mark.skipif(
+    not crypto.OPENSSL_AVAILABLE,
+    reason="RSA needs the 'cryptography' package",
+)
 def test_mixed_scheme_host_path():
     schemes = [
         EDDSA_ED25519_SHA512, ECDSA_SECP256K1_SHA256,

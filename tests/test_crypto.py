@@ -50,6 +50,10 @@ def test_wrong_key_rejected(scheme):
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(
+    not c.crypto.OPENSSL_AVAILABLE,
+    reason="RSA needs the 'cryptography' package",
+)
 def test_rsa_sign_verify():
     kp = c.generate_keypair(c.RSA_SHA256)
     sig = c.do_sign(kp.private, b"rsa message")

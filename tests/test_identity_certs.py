@@ -10,6 +10,11 @@ from corda_tpu.core.crypto.schemes import (
 from corda_tpu.core.identity import Party, PartyAndCertificate
 from corda_tpu.node.services import IdentityService
 
+pytestmark = pytest.mark.skipif(
+    not pki.OPENSSL_AVAILABLE,
+    reason="X.509 PKI requires the 'cryptography' package",
+)
+
 
 @pytest.fixture(scope="module")
 def hierarchy():

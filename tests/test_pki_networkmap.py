@@ -22,6 +22,11 @@ from corda_tpu.node.networkmap import (
     sign_registration,
 )
 
+pytestmark = pytest.mark.skipif(
+    not pki.OPENSSL_AVAILABLE,
+    reason="X.509 PKI requires the 'cryptography' package",
+)
+
 ALICE_KP = crypto.entropy_to_keypair(301)
 BOB_KP = crypto.entropy_to_keypair(302)
 ALICE = Party("O=Alice,L=London,C=GB", ALICE_KP.public)

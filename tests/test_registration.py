@@ -11,6 +11,11 @@ from corda_tpu.node.registration import (
     RegistrationError,
 )
 
+pytestmark = pytest.mark.skipif(
+    not pki.OPENSSL_AVAILABLE,
+    reason="X.509 PKI requires the 'cryptography' package",
+)
+
 
 class TestRegistration:
     def test_auto_approved_registration(self, tmp_path):
