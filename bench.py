@@ -433,6 +433,10 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "uniq_commit_batch_max": uniq["raft_commit_batch_max"],
         "batcher_flush_wall_s": burst.get("batcher_flush_wall_s"),
         "batcher_handoffs": burst.get("batcher_handoffs"),
+        # per-hop critical path from the tracing spine (p50/p99 per span
+        # name over the notarise-latency run): the per-REQUEST view next
+        # to the aggregate stage numbers, so a regression names its hop
+        "critical_path": lat.get("span_summary"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],

@@ -35,10 +35,16 @@ def _percentiles_ms(latencies: List[float]) -> Dict[str, float]:
 def measure_notarise_latency(
     n_tx: int = 512, validating: bool = True, verbose: bool = False
 ) -> Dict[str, float]:
-    """Returns {"p50_ms", "p95_ms", "mean_ms", "n_tx", "wall_s"}."""
+    """Returns {"p50_ms", "p95_ms", "mean_ms", "n_tx", "wall_s"} plus
+    `span_summary`: per-span-name p50/p99 from the tracing spine, so a
+    latency regression is attributable per-HOP (flow step, P2P delivery,
+    verifier batch, notary commit) instead of only per-stage."""
     from ..node.notary import NotaryClientFlow
     from ..testing.mocknetwork import MockNetwork
+    from ..utils.tracing import get_tracer
 
+    tracer = get_tracer()
+    tracer.reset()  # the summary must cover exactly this run
     net = MockNetwork()
     notary = net.create_notary_node(validating=validating)
     bank = net.create_node("O=LatencyBank,L=London,C=GB")
@@ -87,6 +93,9 @@ def measure_notarise_latency(
         "n_tx": n_tx,
         "wall_s": round(wall, 3),
         "notarisations_per_sec": round(n_tx / wall, 1),
+        # per-hop critical path: {span name: {count, p50_ms, p99_ms,
+        # total_ms}} across every trace of the run
+        "span_summary": tracer.summary(),
     }
     if verbose:
         print(out)

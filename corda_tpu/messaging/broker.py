@@ -30,6 +30,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ..utils.tracing import TRACEPARENT_HEADER, current_traceparent
+
 
 class BrokerError(Exception):
     pass
@@ -425,6 +427,7 @@ class Broker:
         payload: bytes,
         headers: Optional[Dict[str, str]] = None,
     ) -> str:
+        headers = self._with_trace(headers)
         with self._lock:
             q = self._queues.get(queue_name)
             if q is None or q.closed:
@@ -432,7 +435,7 @@ class Broker:
             self._id_seq += 1
             msg = Message(
                 payload=payload,
-                headers=dict(headers or {}),
+                headers=headers,
                 message_id=f"{self._id_prefix}-{self._id_seq:019d}",
             )
             if q.journal is not None:
@@ -441,12 +444,31 @@ class Broker:
             q.not_empty.notify()
         return msg.message_id
 
+    @staticmethod
+    def _with_trace(
+        headers: Optional[Dict[str, str]], tp: Optional[str] = None
+    ) -> Dict[str, str]:
+        """Stamp the thread-local trace context onto outbound headers
+        (the tracing spine's transport seam): callers that already set a
+        traceparent — relays, bridges — win. `tp` lets batch senders
+        compute the (call-invariant) context string once."""
+        out = dict(headers or {})
+        if TRACEPARENT_HEADER not in out:
+            if tp is None:
+                tp = current_traceparent()
+            if tp is not None:
+                out[TRACEPARENT_HEADER] = tp
+        return out
+
     def send_many(self, items) -> int:
         """[(queue_name, payload, headers), ...] — duck-type parity with
         RemoteBroker.send_many (one lock acquisition for the batch).
         All-or-nothing: every queue name is validated before anything is
         enqueued or journalled, so a retry after UnknownQueueError cannot
         duplicate a partially-applied prefix."""
+        # one thread-local read + format for the whole batch, outside
+        # the lock (the current context cannot change mid-call)
+        tp = current_traceparent()
         with self._lock:
             queues = []
             for queue_name, _payload, _headers in items:
@@ -458,7 +480,7 @@ class Broker:
                 self._id_seq += 1
                 msg = Message(
                     payload=payload,
-                    headers=dict(headers or {}),
+                    headers=self._with_trace(headers, tp),
                     message_id=f"{self._id_prefix}-{self._id_seq:019d}",
                 )
                 if q.journal is not None:

@@ -43,6 +43,9 @@ DEFAULTS = {
     # import at startup so their @startable_by_rpc / @initiated_by flows
     # register.
     "cordapps": ["corda_tpu.finance.flows"],
+    # observability endpoint (GET /metrics Prometheus + GET /traces/*):
+    # null = off, 0 = ephemeral port, N = fixed port
+    "ops_port": None,
 }
 
 
@@ -89,6 +92,9 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         dev_checkpoint_check=bool(cfg.get("dev_checkpoint_check", False)),
         raft_cluster=cfg.get("raft_cluster"),
         bft_cluster=cfg.get("bft_cluster"),
+        ops_port=(
+            int(cfg["ops_port"]) if cfg.get("ops_port") is not None else None
+        ),
     )
     return FullNodeConfiguration(
         node=node_cfg,

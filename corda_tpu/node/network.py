@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..core.identity import Party
+from ..utils import tracing
 
 
 @dataclass(frozen=True)
@@ -30,6 +31,10 @@ class _InFlight:
     topic: str
     payload: bytes
     due_at: float = 0.0  # clock seconds; 0 = deliverable immediately
+    # trace context captured at send time (tracing spine): delivered
+    # handlers run with this as the current context, so a responder
+    # flow's spans chain onto the sender's trace
+    traceparent: Optional[str] = None
 
 
 class InMemoryMessagingNetwork:
@@ -77,6 +82,7 @@ class InMemoryMessagingNetwork:
                 msg = _InFlight(
                     msg.sender, msg.recipient, msg.topic, msg.payload,
                     due_at=self.clock() + delay,
+                    traceparent=msg.traceparent,
                 )
         with self._lock:
             self._queue.append(msg)
@@ -131,7 +137,8 @@ class InMemoryMessagingNetwork:
                 return True  # dropped by the injector; work was done
             ep = self._resolve_recipient(msg.recipient)
         if ep is not None:
-            ep._deliver(msg.sender, msg.topic, msg.payload)
+            ep._deliver(msg.sender, msg.topic, msg.payload,
+                        traceparent=msg.traceparent)
             if self.observer is not None:
                 self.observer(msg)
         with self._lock:
@@ -159,17 +166,34 @@ class InMemoryMessaging:
 
     def send(self, peer: Party, topic: str, payload: bytes) -> None:
         self.network._enqueue(
-            _InFlight(self.me, peer.name, topic, payload)
+            _InFlight(self.me, peer.name, topic, payload,
+                      traceparent=tracing.current_traceparent())
         )
 
     def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
         self._handlers.setdefault(topic, []).append(fn)
 
-    def _deliver(self, sender: Party, topic: str, payload: bytes) -> None:
+    def _deliver(self, sender: Party, topic: str, payload: bytes,
+                 traceparent: Optional[str] = None) -> None:
         if not self.running:
             return
-        for fn in self._handlers.get(topic, []):
-            fn(sender, payload)
+        ctx = tracing.SpanContext.from_traceparent(traceparent)
+        if ctx is None:
+            for fn in self._handlers.get(topic, []):
+                fn(sender, payload)
+            return
+        # traced message: one delivery span per hop, active around the
+        # handlers so responder flow spans chain under it
+        tracer = tracing.get_tracer()
+        sp = tracer.start_span(
+            "p2p.deliver", parent=ctx, topic=topic, to=self.me.name,
+        )
+        with tracing.activate(sp.context):
+            try:
+                for fn in self._handlers.get(topic, []):
+                    fn(sender, payload)
+            finally:
+                sp.finish()
 
     def stop(self) -> None:
         self.running = False
@@ -250,6 +274,9 @@ class BrokerMessagingService:
     def send(self, peer: Party, topic: str, payload: bytes) -> None:
         headers = {"topic": topic, "sender": self.me.name,
                    "sender_key": self.me.owning_key.encoded.hex()}
+        traceparent = tracing.current_traceparent()
+        if traceparent is not None:
+            headers[tracing.TRACEPARENT_HEADER] = traceparent
         if (
             self.bridges is not None
             and peer.name != self.me.name
@@ -300,11 +327,23 @@ class BrokerMessagingService:
                 )
                 metrics = self.metrics
                 t0 = time.perf_counter() if metrics is not None else 0.0
-                for fn in self._handlers.get(topic, []):
-                    try:
-                        fn(sender, msg.payload)
-                    except Exception:
-                        pass  # handler errors must not kill the pump
+                ctx = tracing.SpanContext.from_traceparent(
+                    msg.headers.get(tracing.TRACEPARENT_HEADER)
+                )
+                sp = (
+                    tracing.get_tracer().start_span(
+                        "p2p.deliver", parent=ctx, topic=topic,
+                        to=self.me.name,
+                    )
+                    if ctx is not None else tracing.NOOP_SPAN
+                )
+                with tracing.activate(sp.context):
+                    for fn in self._handlers.get(topic, []):
+                        try:
+                            fn(sender, msg.payload)
+                        except Exception:
+                            pass  # handler errors must not kill the pump
+                    sp.finish()
                 if metrics is not None:
                     metrics.timer(f"P2P.Handle.{topic}").update(
                         time.perf_counter() - t0

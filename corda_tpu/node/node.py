@@ -14,6 +14,7 @@ from typing import Iterable, List, Optional
 from ..core.crypto import crypto
 from ..core.crypto.keys import KeyPair
 from ..core.identity import Party
+from ..utils.metrics import MetricRegistry, MonitoringService
 from ..verifier.batcher import SignatureBatcher
 from ..verifier.service import (
     InMemoryTransactionVerifierService,
@@ -50,6 +51,10 @@ class NodeConfiguration:
     # PBFT notary cluster membership (notary_type "bft"): same block
     # shape as raft_cluster; needs >= 4 members (n >= 3f+1, f >= 1).
     bft_cluster: Optional[dict] = None
+    # Operations endpoint (GET /metrics Prometheus exposition,
+    # GET /traces/<id>, GET /traces/slow): None = off, 0 = ephemeral
+    # port (read it back from node.ops_server.port), N = fixed port.
+    ops_port: Optional[int] = None
 
 
 class AbstractNode:
@@ -70,10 +75,15 @@ class AbstractNode:
         self.checkpoint_storage = CheckpointStorage(self.database)
         self._broker = broker
         self.network = messaging_factory(self.info)
+        # ONE registry for the whole node (SMM flow metrics, P2P handler
+        # timers, RPC timers, verifier Verification.* families) so the
+        # ops endpoint's /metrics is a single coherent snapshot
+        self.metrics = MetricRegistry()
         verifier = self._make_transaction_verifier_service()
         self.services = ServiceHub(
             self.info, self.database, verifier, self._identity_key, clock=clock
         )
+        self.services.monitoring = MonitoringService(self.metrics)
         self.smm = StateMachineManager(
             self.services, self.network, self.checkpoint_storage, self.info,
             dev_checkpoint_check=config.dev_checkpoint_check,
@@ -98,7 +108,8 @@ class AbstractNode:
             if self._broker is None:
                 raise ValueError("OutOfProcess verifier requires a broker")
             return OutOfProcessTransactionVerifierService(
-                self._broker, self.config.my_legal_name
+                self._broker, self.config.my_legal_name,
+                metrics=self.metrics,
             )
         return InMemoryTransactionVerifierService(batcher=SignatureBatcher())
 
@@ -444,6 +455,14 @@ class AbstractNode:
             self._start_raft_ticker()
         if getattr(self, "bft_replica", None) is not None:
             self._start_bft_ticker()
+        if self.config.ops_port is not None:
+            from .opsserver import OpsServer
+
+            # tracer deliberately unpinned: the endpoint resolves the
+            # process tracer per request, like the span producers do
+            self.ops_server = OpsServer(
+                self.smm.metrics, port=self.config.ops_port
+            )
         self.started = True
         return self
 
@@ -496,6 +515,9 @@ class AbstractNode:
         self._bft_ticker.start()
 
     def stop(self) -> None:
+        if getattr(self, "ops_server", None) is not None:
+            self.ops_server.stop()
+            self.ops_server = None
         if getattr(self, "_raft_stop", None) is not None:
             self._raft_stop.set()
             self._raft_ticker.join(timeout=2)

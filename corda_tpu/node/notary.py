@@ -28,6 +28,7 @@ from ..core.identity import Party
 from ..core.serialization.codec import deserialize, register_adapter, serialize
 from ..core.transactions.filtered import FilteredTransaction
 from ..core.transactions.signed import SignedTransaction
+from ..utils import tracing
 from .database import KVStore, NodeDatabase
 
 
@@ -408,7 +409,9 @@ class CoalescingUniquenessProvider(UniquenessProvider):
         self.delegate = delegate
         self.max_batch = max_batch
         self._lock = threading.Lock()
-        self._pending: List[Tuple] = []  # (states, tx_id, party, Future)
+        # (states, tx_id, party, trace ctx, Future) — the ctx is what lets
+        # one group commit emit a fan-in span linking every waiting flow
+        self._pending: List[Tuple] = []
         self._draining = False
         # seam telemetry
         self.batches = 0
@@ -420,13 +423,20 @@ class CoalescingUniquenessProvider(UniquenessProvider):
     def mean_batch(self) -> float:
         return self.commits / self.batches if self.batches else 0.0
 
+    @staticmethod
+    def _batch_span(ctxs):
+        """Fan-in span for one group-commit round: links every waiting
+        flow's trace (untraced rounds emit no span)."""
+        return tracing.get_tracer().fan_in_span("notary.commit_batch", ctxs)
+
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party):
         fut: Optional[Future] = None
+        ctx = tracing.current_context()  # the committing flow's trace
         with self._lock:
             if self._draining:
                 fut = Future()
                 self._pending.append(
-                    (list(states), tx_id, requesting_party, fut)
+                    (list(states), tx_id, requesting_party, ctx, fut)
                 )
             else:
                 self._draining = True
@@ -440,10 +450,16 @@ class CoalescingUniquenessProvider(UniquenessProvider):
             # no handoff — a lone commit costs what the delegate costs),
             # then serve anything that queued behind us
             try:
+                sp = self._batch_span((ctx,))
                 t0 = time.perf_counter()
-                result = self.delegate.commit_many(
-                    [(list(states), tx_id, requesting_party)]
-                )[0]
+                try:
+                    result = self.delegate.commit_many(
+                        [(list(states), tx_id, requesting_party)]
+                    )[0]
+                except BaseException as exc:
+                    sp.finish(error=exc)
+                    raise
+                sp.finish()
                 self.commit_wall_s += time.perf_counter() - t0
                 self.batches += 1
                 self.commits += 1
@@ -464,17 +480,20 @@ class CoalescingUniquenessProvider(UniquenessProvider):
                 if not batch:
                     self._draining = False
                     return
+            sp = self._batch_span([c for _, _, _, c, _ in batch])
             t0 = time.perf_counter()
             try:
                 results = self.delegate.commit_many(
-                    [(s, t, p) for s, t, p, _ in batch]
+                    [(s, t, p) for s, t, p, _, _ in batch]
                 )
             except BaseException as exc:
                 # fail this round's waiters; later arrivals get a fresh
                 # consensus attempt instead of inheriting the error
+                sp.finish(error=exc)
                 for *_, fut in batch:
                     fut.set_exception(exc)
                 continue
+            sp.finish()
             self.commit_wall_s += time.perf_counter() - t0
             self.batches += 1
             self.commits += len(batch)
@@ -528,9 +547,17 @@ class NotaryService:
         produced them (BFT: f+1 replica signatures), else None."""
         audit = getattr(self.services, "audit_service", None)
         try:
-            sigs = self.uniqueness_provider.commit(
-                inputs, tx_id, self.identity
-            )
+            # child span of the serving notary flow (whose context is
+            # current — inline on the pump or re-activated by the
+            # blocking executor); the coalescer's group-commit span
+            # links onto it
+            with tracing.get_tracer().span(
+                "notary.commit",
+                tx_id=tx_id.bytes.hex()[:16], inputs=len(inputs),
+            ):
+                sigs = self.uniqueness_provider.commit(
+                    inputs, tx_id, self.identity
+                )
         except UniquenessException as e:
             if audit is not None:
                 audit.record_event(

@@ -1,0 +1,164 @@
+"""Per-node operations endpoint: metrics + traces exposition.
+
+The reference exports node metrics over JMX/Jolokia (`Node.kt:305-310`);
+here a MiniWebServer scaffold serves the same registry as Prometheus
+text exposition plus the tracing spine's span trees:
+
+    GET /metrics                      Prometheus text format 0.0.4
+                                      (rendered from MetricRegistry.snapshot())
+    GET /traces/<trace_id>            span tree as JSON (404 when unknown)
+    GET /traces/slow?threshold_ms=N   bounded ring of slowest root spans
+    GET /traces                       known trace ids + tracer stats
+
+Wired into node startup via NodeConfiguration.ops_port (None = off,
+0 = ephemeral port) and into MockNetwork the same way.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+from ..utils.metrics import MetricRegistry
+from ..utils.miniweb import MiniWebServer, RawResponse
+from ..utils.tracing import Tracer, get_tracer
+
+# -- Prometheus text rendering ----------------------------------------------
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+#: summary quantiles exported per timer (keys match Timer.snapshot())
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus family name: camel boundaries and any
+    non-[a-zA-Z0-9_:] become underscores, lower-cased, `corda_tpu_`
+    prefixed (which also guarantees a legal leading character)."""
+    s = _CAMEL.sub("_", name)
+    s = _INVALID.sub("_", s).lower()
+    s = re.sub(r"_+", "_", s).strip("_")
+    return f"corda_tpu_{s}"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_prometheus(snapshot: Dict[str, Dict]) -> str:
+    """MetricRegistry.snapshot() -> Prometheus exposition text. Counters
+    export as `<name>_total`, gauges as `<name>`, meters as a counter
+    plus rate gauges, timers as a `<name>_seconds` summary. Every family
+    gets exactly one HELP/TYPE pair; a sanitisation collision keeps the
+    first family and drops the latecomer (duplicate families are a
+    protocol violation scrapers reject outright)."""
+    lines = []
+    seen = set()
+
+    def family(base: str, mtype: str, source: str, samples) -> None:
+        if base in seen:
+            return
+        seen.add(base)
+        lines.append(f"# HELP {base} {_escape_help(source)}")
+        lines.append(f"# TYPE {base} {mtype}")
+        for suffix, labels, value in samples:
+            if value is None:
+                continue
+            label_s = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                if labels else ""
+            )
+            lines.append(f"{base}{suffix}{label_s} {value}")
+
+    for name in sorted(snapshot):
+        snap = snapshot[name]
+        base = prom_name(name)
+        mtype = snap.get("type")
+        src = f"corda-tpu metric {name!r} ({mtype})"
+        if mtype == "counter":
+            family(base + "_total", "counter", src,
+                   [("", (), snap.get("count", 0))])
+        elif mtype == "gauge":
+            value = snap.get("value")
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                family(base, "gauge", src, [("", (), value)])
+            # dead gauges ({"error": ...}) and non-numeric readings are
+            # skipped: an unparseable sample poisons the whole scrape
+        elif mtype == "meter":
+            family(base + "_total", "counter", src,
+                   [("", (), snap.get("count", 0))])
+            family(base + "_rate", "gauge", src, [
+                ("", (("window", "mean"),), snap.get("mean_rate")),
+                ("", (("window", "1m"),), snap.get("m1_rate")),
+                ("", (("window", "5m"),), snap.get("m5_rate")),
+            ])
+        elif mtype == "timer":
+            samples = [
+                ("", (("quantile", q),), snap.get(key))
+                for q, key in _QUANTILES
+            ]
+            samples.append(("_sum", (), snap.get("total", 0.0)))
+            samples.append(("_count", (), snap.get("count", 0)))
+            family(base + "_seconds", "summary", src, samples)
+        else:  # unknown/legacy blob: expose numeric fields as one gauge
+            samples = [
+                ("", (("field", k),), v)
+                for k, v in sorted(snap.items())
+                if k != "type" and isinstance(v, (int, float))
+                and not isinstance(v, bool)
+            ]
+            if samples:
+                family(base, "gauge", src, samples)
+    return "\n".join(lines) + "\n"
+
+
+# -- the endpoint ------------------------------------------------------------
+
+class OpsServer(MiniWebServer):
+    """Metrics + traces for ONE node's registry (the tracer defaults to
+    the process-global one — per-node in OS-process deployments)."""
+
+    def __init__(self, registry: MetricRegistry,
+                 tracer: Optional[Tracer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self._tracer = tracer
+        super().__init__(host=host, port=port)
+
+    @property
+    def tracer(self) -> Tracer:
+        """Resolved per request when not pinned at construction, matching
+        the span producers (smm.tracer / get_tracer() are dynamic too) —
+        a test swapping the process tracer must not leave this endpoint
+        serving the stale one."""
+        return self._tracer or get_tracer()
+
+    def handle(self, method: str, path: str, query: Dict[str, str],
+               body) -> Tuple[int, object]:
+        if method != "GET":
+            raise KeyError(path)
+        if path == "/metrics":
+            return 200, RawResponse(
+                render_prometheus(self.registry.snapshot()),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        if path == "/traces":
+            return 200, {
+                "traces": self.tracer.trace_ids(),
+                **self.tracer.stats(),
+            }
+        if path == "/traces/slow":
+            threshold = query.get("threshold_ms")
+            return 200, self.tracer.slow_roots(
+                float(threshold) if threshold is not None else None
+            )
+        if path.startswith("/traces/"):
+            trace_id = path[len("/traces/"):]
+            tree = self.tracer.span_tree(trace_id)
+            if tree is None:
+                raise KeyError(f"trace {trace_id}")
+            return 200, tree
+        if path == "/spans/summary":
+            return 200, self.tracer.summary()
+        raise KeyError(path)

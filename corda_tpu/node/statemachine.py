@@ -43,6 +43,7 @@ from ..core.flows.api import (
 )
 from ..core.identity import Party
 from ..core.serialization.codec import deserialize, serialize
+from ..utils import tracing
 from ..utils.metrics import MetricRegistry
 from .session import (
     SESSION_TOPIC,
@@ -127,6 +128,12 @@ class FlowStateMachine:
         # (in-memory only; a flow restored from a checkpoint loses pending
         # retries and surfaces the peer error instead — safe, just louder)
         self._failover_retries: Dict[str, dict] = {}
+        # tracing spine: one root-or-child span for the whole flow run
+        # (created in start(); parented on whatever context is current —
+        # the RPC span for started flows, the delivering P2P span for
+        # responders) plus a child span per park/suspend window
+        self._span = None
+        self._wait_span = None
         # Serializes generator stepping + park/deliver decisions between
         # the messaging pump and the blocking executor (await_blocking
         # resumes on an executor thread; an unlocked check-then-park
@@ -156,18 +163,45 @@ class FlowStateMachine:
 
     def start(self) -> None:
         self.flow.state_machine = self
+        self._span = self.smm.tracer.start_span(
+            f"flow.{self.flow.flow_name()}",
+            parent=tracing.current_context(),
+            flow_id=self.flow_id,
+            node=self.smm.our_identity.name,
+            responder=self.is_responder,
+        )
         self._gen = _as_generator(self.flow)
         self._run(feed=None, first=True)
+
+    # -- tracing helpers ----------------------------------------------------
+
+    @property
+    def _trace_ctx(self):
+        return self._span.context if self._span is not None else None
+
+    def _park_span(self, kind: str, **tags) -> None:
+        """Open a child span covering the upcoming park window (finished
+        by _unpark_span when the flow resumes or dies parked)."""
+        if self._span is not None and self._wait_span is None:
+            self._wait_span = self.smm.tracer.start_span(
+                "flow.suspend", parent=self._span.context, kind=kind, **tags
+            )
+
+    def _unpark_span(self) -> None:
+        ws, self._wait_span = self._wait_span, None
+        if ws is not None:
+            ws.finish()
 
     def _run(self, feed=None, first=False, throw: Optional[BaseException] = None):
         """Drive the generator until it completes or parks. Holds the
         step lock for the whole step so a concurrent delivery (pump
         thread) cannot interleave with a check-then-park (executor
-        thread)."""
+        thread). The flow's trace context is current for the step, so
+        every send/submit/commit a step performs joins the flow's trace."""
         from ..utils.flowcontext import running_flow
 
         with self._step_lock:
-            with running_flow(self.flow_id):
+            with running_flow(self.flow_id, trace=self._trace_ctx):
                 self._run_inner(feed, first, throw)
 
     def _run_inner(self, feed, first, throw) -> None:
@@ -253,15 +287,24 @@ class FlowStateMachine:
             self._checkpoint()
             return value
 
+        from ..utils.flowcontext import running_flow
+
+        ctx = self._trace_ctx
+
         def work():
-            try:
-                value = req.compute()
-            except BaseException as exc:
-                self.smm._resume_from_blocking(self, error=exc)
-            else:
-                self.smm._resume_from_blocking(self, value=value)
+            # executor thread: re-establish the flow's identity + trace
+            # context so the blocking body (notary commits, batcher
+            # waits) attributes to this flow's trace
+            with running_flow(self.flow_id, trace=ctx):
+                try:
+                    value = req.compute()
+                except BaseException as exc:
+                    self.smm._resume_from_blocking(self, error=exc)
+                else:
+                    self.smm._resume_from_blocking(self, value=value)
 
         self.waiting_blocking = True
+        self._park_span("blocking")
         self._checkpoint()
         try:
             executor.submit(work)
@@ -383,6 +426,7 @@ class FlowStateMachine:
         # park
         self.waiting_session = sess.local_id
         self.waiting_expected_type = expected_type
+        self._park_span("receive", peer=party.name)
         self._checkpoint()
         raise _Suspended()
 
@@ -399,6 +443,7 @@ class FlowStateMachine:
             return stx
         self.waiting_tx = tx_id
         self.smm._register_ledger_waiter(tx_id, self)
+        self._park_span("ledger_commit")
         self._checkpoint()
         raise _Suspended()
 
@@ -424,6 +469,7 @@ class FlowStateMachine:
         blob = sess.inbox.pop(sess.recv_seq)
         sess.recv_seq += 1
         self.waiting_session = None
+        self._unpark_span()
         # reply arrived: a later session end must not replay the request
         self._failover_retries.pop(sess.local_id, None)
         try:
@@ -471,6 +517,7 @@ class FlowStateMachine:
             self._checkpoint()
             return
         self.waiting_session = None
+        self._unpark_span()
         self._run(throw=self._peer_end_exception(sess))
 
     def _peer_end_exception(self, sess: FlowSession) -> FlowException:
@@ -491,6 +538,7 @@ class FlowStateMachine:
         if self.done or self.waiting_tx is None:
             return
         self.waiting_tx = None
+        self._unpark_span()
         blob = serialize(stx)
         self.io_log.append(blob)
         self._checkpoint()
@@ -511,6 +559,9 @@ class FlowStateMachine:
         self.logger.info(
             "flow %s completed", self.flow.flow_name(),
         )
+        self._unpark_span()
+        if self._span is not None:
+            self._span.finish()
         self._end_sessions(None)
         self.smm._flow_finished(self)
         self.result.set_result(value)
@@ -520,6 +571,9 @@ class FlowStateMachine:
         self.logger.warning(
             "flow %s failed: %s", self.flow.flow_name(), exc,
         )
+        self._unpark_span()
+        if self._span is not None:
+            self._span.finish(error=exc)
         # Only FlowExceptions propagate their type+message to peers (reference
         # FlowException model); anything else is an opaque counter-flow error.
         msg = (
@@ -588,6 +642,9 @@ class FlowStateMachine:
             self._cp_io_written = len(self.io_log)
         self.smm.checkpoints_written += 1
         self.smm.metrics.meter("Flows.CheckpointingRate").mark()
+        if self._span is not None:
+            # point-in-time trail on the flow's root span (bounded)
+            self._span.add_event("checkpoint", io=len(self.io_log))
 
 
 class StateMachineManager:
@@ -670,6 +727,14 @@ class StateMachineManager:
     @property
     def in_flight_count(self) -> int:
         return sum(1 for f in self.flows.values() if not f.done)
+
+    @property
+    def tracer(self) -> tracing.Tracer:
+        """The tracing spine's span sink: the process tracer (per node in
+        OS-process deployments; shared across MockNetwork's in-process
+        nodes so cross-node traces assemble). Resolved dynamically so
+        tests installing a fresh tracer take effect immediately."""
+        return tracing.get_tracer()
 
     @property
     def dispatches_blocking_off_pump(self) -> bool:
@@ -902,6 +967,7 @@ class StateMachineManager:
             if fsm.done or not fsm.waiting_blocking:
                 return
             fsm.waiting_blocking = False
+            fsm._unpark_span()
             if error is not None:
                 fsm._run(throw=error)
                 return

@@ -73,10 +73,27 @@ class CordaRPCOps:
 
     def start_flow_dynamic(self, flow_name: str, *args, **kwargs):
         """Start a registered @startable_by_rpc flow by name; returns the
-        flow id (result retrieved via flow_result / state machine feed)."""
+        flow id (result retrieved via flow_result / state machine feed).
+
+        The RPC start is the trace ROOT for flows entering through this
+        surface: the flow span (and everything downstream — P2P hops,
+        verifier batches, the notary commit) chains under it. When a
+        span is already active (the socket RPC server wraps each call in
+        `rpc.<method>`), the flow chains under THAT instead of stacking
+        a second, redundant RPC span."""
+        from ..utils.tracing import current_context, get_tracer
+
         cls = self._resolve_rpc_flow(flow_name)
         flow = cls(*args, **kwargs)
-        handle = self._smm.start_flow(flow, *args, **kwargs)
+        if current_context() is not None:
+            handle = self._smm.start_flow(flow, *args, **kwargs)
+        else:
+            with get_tracer().span(
+                "rpc.start_flow", flow=flow_name,
+                node=self._services.my_info.name,
+            ) as sp:
+                handle = self._smm.start_flow(flow, *args, **kwargs)
+                sp.set_tag("flow_id", handle.flow_id)
         return handle.flow_id
 
     def start_flow_and_wait(self, flow_name: str, *args, **kwargs):
@@ -389,35 +406,42 @@ class CordaRPCOps:
     # -- observability --------------------------------------------------------
 
     def node_metrics(self) -> Dict[str, Any]:
-        """Snapshot of the node's metric registry plus the verifier
-        service's counters (reference: JMX export, `Node.kt:305-310`;
-        verifier metrics `OutOfProcessTransactionVerifierService.kt:33-45`)."""
+        """Snapshot of the node's metric registry (reference: JMX export,
+        `Node.kt:305-310`). Verifier metrics live in the shared registry
+        as Verification.* families (`OutOfProcessTransactionVerifier
+        Service.kt:33-45` names); a verifier constructed standalone with
+        its own registry has its families merged in, and the legacy
+        `Verification` summary block is kept for existing dashboards."""
         out = dict(self._smm.metrics.snapshot())
         svc = self._services.transaction_verifier_service
         m = getattr(svc, "metrics", None)
-        if m is not None:
-            # snapshot under the service's lock: the response-consumer thread
-            # appends to the durations deque concurrently
-            lock = getattr(svc, "_lock", None)
-            if lock is not None:
-                with lock:
-                    durations = sorted(m.durations)
-                    success, failure, in_flight = m.success, m.failure, m.in_flight
-            else:
-                durations = sorted(m.durations)
-                success, failure, in_flight = m.success, m.failure, m.in_flight
+        registry = getattr(m, "registry", None)
+        if registry is not None and registry is not self._smm.metrics:
+            for name, snap in registry.snapshot().items():
+                out.setdefault(name, snap)
+        if m is not None and hasattr(m, "record"):
+            duration = m._duration.snapshot()
             verifier: Dict[str, Any] = {
                 "type": "verifier",
-                "success": success,
-                "failure": failure,
-                "in_flight": in_flight,
+                "success": m.success,
+                "failure": m.failure,
+                "in_flight": m.in_flight,
             }
-            if durations:
-                verifier["p50"] = round(
-                    durations[len(durations) // 2], 6
-                )
-                verifier["p95"] = round(
-                    durations[min(len(durations) - 1, int(0.95 * len(durations)))], 6
-                )
+            for q in ("p50", "p95"):
+                if q in duration:
+                    verifier[q] = duration[q]
             out["Verification"] = verifier
         return out
+
+    def node_trace(self, trace_id: str) -> Optional[Dict]:
+        """Span tree for one trace from the node's tracer (the RPC twin
+        of the ops endpoint's GET /traces/<id>)."""
+        from ..utils.tracing import get_tracer
+
+        return get_tracer().span_tree(trace_id)
+
+    def slow_traces(self, threshold_ms: Optional[float] = None) -> List:
+        """Slowest recorded root spans (GET /traces/slow over RPC)."""
+        from ..utils.tracing import get_tracer
+
+        return get_tracer().slow_roots(threshold_ms)

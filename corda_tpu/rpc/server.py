@@ -265,13 +265,18 @@ class RPCServer:
             # so this edge can never pin an RPC worker forever
             args, method_name = (fid,), "flow_result"
             kwargs = {} if wait_timeout is None else {"timeout": wait_timeout}
+        from ..utils.tracing import get_tracer
+
         smm = getattr(self.ops, "_smm", None)
         timer = (
             smm.metrics.timer(f"RPC.{method_name}") if smm is not None else None
         )
         t0 = time.perf_counter()
         try:
-            result = getattr(self.ops, method_name)(*args, **kwargs)
+            # trace root for this RPC: anything the op does (starting a
+            # flow included) chains under it
+            with get_tracer().span(f"rpc.{method_name}"):
+                result = getattr(self.ops, method_name)(*args, **kwargs)
         except Exception as exc:
             self._reply(reply_to, {
                 "kind": "reply", "id": req_id,

@@ -45,13 +45,17 @@ class MockNetwork:
         entropy: Optional[int] = None,
         clock=None,
         dev_checkpoint_check: bool = True,
+        ops_port: Optional[int] = None,
     ) -> MockNode:
+        """`ops_port`: pass 0 to serve this node's /metrics + /traces on
+        an ephemeral port (node.ops_server.port); None = no endpoint."""
         config = NodeConfiguration(
             my_legal_name=legal_name,
             db_path=db_path,
             notary_type=notary_type,
             identity_entropy=entropy if entropy is not None else self._next_entropy(),
             dev_checkpoint_check=dev_checkpoint_check,
+            ops_port=ops_port,
         )
         node = MockNode(
             config, self.messaging_network.create_endpoint,
@@ -422,6 +426,16 @@ class MockNetwork:
             threshold=1, provider_factory=provider_factory,
         )
         return cluster, members, bus
+
+    @property
+    def tracer(self):
+        """The tracing spine every in-process node records into: one
+        process-global tracer, so a trace started on one mock node and
+        continued on another assembles in a single span store (what a
+        per-node tracer would need a collector for)."""
+        from ..utils.tracing import get_tracer
+
+        return get_tracer()
 
     def run_network(self, max_messages: int = 100_000) -> int:
         """Pump messages until the network is quiescent."""

@@ -1,10 +1,16 @@
 """Thread-local current-flow context (reference: the fiber-local state the
 node uses to attribute service calls — e.g. recorded transactions — to the
-flow performing them, `StateMachineRecordedTransactionMappingStorage`)."""
+flow performing them, `StateMachineRecordedTransactionMappingStorage`).
+
+Also the seam the tracing spine rides: `running_flow` optionally activates
+the flow's span context alongside the flow id, so anything a flow step
+calls into (vault, verifier submission, notary commit, P2P send) sees the
+flow's trace as the thread-local current context (utils/tracing.py).
+"""
 from __future__ import annotations
 
 import threading
-from contextlib import contextmanager
+from contextlib import ExitStack, contextmanager
 from typing import Iterator, Optional
 
 _local = threading.local()
@@ -15,10 +21,17 @@ def current_flow_id() -> Optional[str]:
 
 
 @contextmanager
-def running_flow(flow_id: str) -> Iterator[None]:
+def running_flow(flow_id: str, trace=None) -> Iterator[None]:
+    """`trace`: an optional tracing.SpanContext made current for the block
+    (None leaves whatever context is already active untouched)."""
     prev = getattr(_local, "flow_id", None)
     _local.flow_id = flow_id
-    try:
-        yield
-    finally:
-        _local.flow_id = prev
+    with ExitStack() as stack:
+        if trace is not None:
+            from .tracing import activate
+
+            stack.enter_context(activate(trace))
+        try:
+            yield
+        finally:
+            _local.flow_id = prev

@@ -45,6 +45,11 @@ class Gauge:
     def __init__(self, fn: Callable[[], float]) -> None:
         self._fn = fn
 
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        """Rebind the reading callable (re-registration: a restarted
+        service must not leave /metrics reading a dead object's closure)."""
+        self._fn = fn
+
     @property
     def value(self):
         return self._fn()
@@ -228,7 +233,20 @@ class MetricRegistry:
             if not isinstance(m, Gauge):
                 raise KeyError(f"gauge {name!r} not registered")
             return m
-        return self._get_or_create(name, Gauge, lambda: Gauge(fn))
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(fn)
+            elif isinstance(m, Gauge):
+                # re-registration REPLACES the callable: a recreated
+                # service (node restart in-process, test fixtures) must
+                # not leave the snapshot reading the stale closure
+                m.set_fn(fn)
+            else:
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
 
     def names(self):
         with self._lock:

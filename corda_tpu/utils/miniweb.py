@@ -27,6 +27,16 @@ _STATIC = os.path.join(
 )
 
 
+class RawResponse:
+    """Non-JSON handler result: `handle` may return (code, RawResponse)
+    to serve an arbitrary body/content-type (e.g. Prometheus text
+    exposition, which must NOT be JSON-encoded)."""
+
+    def __init__(self, body, content_type: str = "text/plain; charset=utf-8"):
+        self.body = body.encode() if isinstance(body, str) else bytes(body)
+        self.content_type = content_type
+
+
 class MiniWebServer:
     #: URL path -> filename under webserver/static
     pages: Dict[str, str] = {}
@@ -46,19 +56,29 @@ class MiniWebServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _raw(self, code: int, body: bytes, content_type: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _dispatch(self, method: str) -> None:
                 u = urlparse(self.path)
                 page = outer.pages.get(u.path) if method == "GET" else None
                 if page is not None:
-                    with open(os.path.join(_STATIC, page), "rb") as f:
-                        body = f.read()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/html; charset=utf-8"
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    try:
+                        with open(os.path.join(_STATIC, page), "rb") as f:
+                            body = f.read()
+                    except OSError as exc:
+                        # the module contract: EVERY failure is a JSON
+                        # error body, never a dropped connection — a
+                        # missing/unreadable static file included
+                        self._json(500, {
+                            "error": f"static page unavailable: {exc}",
+                        })
+                        return
+                    self._raw(200, body, "text/html; charset=utf-8")
                     return
                 query = {k: v[0] for k, v in parse_qs(u.query).items()}
                 body = None
@@ -76,6 +96,9 @@ class MiniWebServer:
                     return
                 except Exception as exc:
                     self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+                    return
+                if isinstance(value, RawResponse):
+                    self._raw(code, value.body, value.content_type)
                     return
                 self._json(code, value)
 

@@ -1,0 +1,542 @@
+"""Distributed tracing spine: spans across RPC → flow → P2P → verifier →
+notary.
+
+The reference attributes node time with JMX metrics only; per-REQUEST
+attribution (which hop ate the time for one slow transaction) needs a
+trace. The design here is deliberately small:
+
+  * `SpanContext` is a W3C-traceparent-style (trace_id, span_id) pair that
+    rides existing seams — broker message headers, the in-memory network's
+    in-flight records — as a single `traceparent` header string.
+  * A thread-local *current* context (sibling of `flowcontext`'s flow id)
+    is what `send` paths read and what message pumps activate around
+    handler dispatch, so propagation needs no plumbing through call
+    signatures.
+  * `Tracer` keeps bounded in-memory span storage per node (one tracer per
+    OS process; MockNetwork's in-process nodes share the process-global
+    tracer, which is what lets a cross-node trace assemble in tests).
+  * Fan-in: batch spans (one verifier flush serving N transactions, one
+    coalesced notary commit serving N flows) carry `links` — the contexts
+    of every parent trace they served — and are indexed under each linked
+    trace, so `GET /traces/<id>` shows the shared batch in every
+    participating trace's tree.
+  * A slow-span watchdog logs any finished root span over a configurable
+    threshold with its critical-path breakdown, and a bounded ring of the
+    slowest roots backs `GET /traces/slow`.
+
+Env knobs: CORDA_TPU_TRACING=0 disables span recording AND propagation
+(the fast path is then one thread-local read per send);
+CORDA_TPU_TRACE_SLOW_MS sets the watchdog threshold (default 1000);
+CORDA_TPU_TRACE_MAX_TRACES bounds retained traces (default 512).
+
+`CORDA_TPU_PROFILE_DUMP` (utils/profiling.py) remains the complement:
+spans say WHICH hop was slow for one request, the profiler says WHY,
+function by function, inside that hop.
+"""
+from __future__ import annotations
+
+import heapq
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger("corda_tpu.tracing")
+
+#: header key under which the context rides broker messages / P2P records
+TRACEPARENT_HEADER = "traceparent"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """W3C trace-context ids: 16-byte trace id, 8-byte span id (hex)."""
+
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @staticmethod
+    def from_traceparent(value: Optional[str]) -> Optional["SpanContext"]:
+        """Parse `00-<trace>-<span>-<flags>`; None for anything malformed
+        (a bad header must degrade to 'untraced', never raise in a pump)."""
+        if not value:
+            return None
+        parts = value.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        try:
+            int(parts[1], 16), int(parts[2], 16)
+        except ValueError:
+            return None
+        return SpanContext(parts[1], parts[2])
+
+
+# -- id generation -----------------------------------------------------------
+# uuid4-per-span would be ~2 urandom syscalls per span (the broker learned
+# this lesson for message ids): one random per-process prefix + a counter
+# keeps ids unique across processes and cheap within one.
+
+_id_lock = threading.Lock()
+_id_prefix = uuid.uuid4().hex[:16]
+_id_counter = 0
+
+
+def _next_id() -> int:
+    global _id_counter
+    with _id_lock:
+        _id_counter += 1
+        return _id_counter
+
+
+def _new_trace_id() -> str:
+    return _id_prefix + format(_next_id(), "016x")[-16:]
+
+
+def _new_span_id() -> str:
+    return format(_next_id(), "016x")[-16:]
+
+
+# -- thread-local current context -------------------------------------------
+
+_local = threading.local()
+
+
+def current_context() -> Optional[SpanContext]:
+    return getattr(_local, "trace_ctx", None)
+
+
+def current_traceparent() -> Optional[str]:
+    ctx = getattr(_local, "trace_ctx", None)
+    return ctx.to_traceparent() if ctx is not None else None
+
+
+@contextmanager
+def activate(ctx: Optional[SpanContext]):
+    """Make `ctx` the current context for the block (None = no-op, so
+    pumps can unconditionally `with activate(parsed):`)."""
+    if ctx is None:
+        yield
+        return
+    prev = getattr(_local, "trace_ctx", None)
+    _local.trace_ctx = ctx
+    try:
+        yield
+    finally:
+        _local.trace_ctx = prev
+
+
+# -- spans -------------------------------------------------------------------
+
+class Span:
+    """One timed operation. Finish-once; recorded into the tracer's store
+    on finish (children finish before parents, so trees assemble)."""
+
+    MAX_EVENTS = 64
+
+    __slots__ = (
+        "name", "context", "parent_id", "links", "tags", "events",
+        "start_wall", "_t0", "duration_s", "error", "_tracer", "_finished",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional[SpanContext], links: Tuple[SpanContext, ...],
+                 tags: Dict):
+        if parent is not None:
+            trace_id = parent.trace_id
+            self.parent_id: Optional[str] = parent.span_id
+        else:
+            trace_id = _new_trace_id()
+            self.parent_id = None
+        self.context = SpanContext(trace_id, _new_span_id())
+        self.name = name
+        self.links = links
+        self.tags = tags
+        self.events: List[Dict] = []
+        self.start_wall = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+        self.error: Optional[str] = None
+        self._tracer = tracer
+        self._finished = False
+
+    @property
+    def is_root(self) -> bool:
+        return self.parent_id is None and not self.links
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Point-in-time annotation (bounded; beyond MAX_EVENTS the
+        oldest are dropped — checkpoints on a long flow must not grow
+        the span without limit)."""
+        if len(self.events) >= self.MAX_EVENTS:
+            self.events.pop(0)
+        ev = {"name": name, "t_ms": round(
+            (time.perf_counter() - self._t0) * 1000, 3)}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self.duration_s = time.perf_counter() - self._t0
+        if error is not None:
+            self.error = f"{type(error).__name__}: {error}"
+        self._tracer._record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.finish(error=exc)
+        return False
+
+    def to_dict(self) -> Dict:
+        out = {
+            "name": self.name,
+            "trace_id": self.context.trace_id,
+            "span_id": self.context.span_id,
+            "parent_id": self.parent_id,
+            "start": round(self.start_wall, 6),
+            "duration_ms": round((self.duration_s or 0.0) * 1000, 3),
+            "tags": dict(self.tags),
+        }
+        if self.links:
+            out["links"] = [
+                {"trace_id": c.trace_id, "span_id": c.span_id}
+                for c in self.links
+            ]
+        if self.events:
+            out["events"] = list(self.events)
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class _NoopSpan:
+    """Returned when tracing is disabled: no context, no cost."""
+
+    context: Optional[SpanContext] = None
+    is_root = False
+
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs) -> None:
+        pass
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+# -- tracer ------------------------------------------------------------------
+
+class Tracer:
+    """Bounded in-memory span storage + span factory for one node/process.
+
+    Storage model: finished spans index under their own trace id AND
+    under every linked trace id (fan-in), in an insertion-ordered map
+    evicted oldest-trace-first. A per-name duration reservoir (survives
+    trace eviction) backs `summary()`, and a bounded min-heap of the
+    slowest finished root spans backs `slow_roots()`.
+    """
+
+    MAX_SPANS_PER_TRACE = 512
+    #: fan-in spans link at most this many distinct parent traces (a
+    #: 4096-item verifier flush must not carry 4096 links)
+    MAX_LINKS = 128
+    SLOW_RING = 64
+    NAME_RESERVOIR = 2048
+
+    def __init__(self, node: str = "", enabled: Optional[bool] = None,
+                 slow_threshold_ms: Optional[float] = None,
+                 max_traces: Optional[int] = None):
+        if enabled is None:
+            enabled = os.environ.get("CORDA_TPU_TRACING", "1") != "0"
+        if slow_threshold_ms is None:
+            slow_threshold_ms = float(
+                os.environ.get("CORDA_TPU_TRACE_SLOW_MS", 1000.0)
+            )
+        if max_traces is None:
+            max_traces = int(
+                os.environ.get("CORDA_TPU_TRACE_MAX_TRACES", 512)
+            )
+        self.node = node
+        self.enabled = enabled
+        self.slow_threshold_ms = slow_threshold_ms
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, List[Span]]" = OrderedDict()
+        self._dropped_spans = 0
+        self._slow: List[Tuple[float, int, Dict]] = []  # min-heap
+        self._slow_seq = 0
+        self._name_stats: Dict[str, deque] = {}
+        self._name_counts: Dict[str, int] = {}
+
+    # -- span factory -------------------------------------------------------
+
+    def start_span(self, name: str, parent: Optional[SpanContext] = None,
+                   links: Iterable[SpanContext] = (), **tags):
+        """Manual-lifecycle span (caller must `finish()`); parent defaults
+        to NO parent — pass `current_context()` explicitly to chain."""
+        if not self.enabled:
+            return NOOP_SPAN
+        links = tuple(c for c in links if c is not None)
+        if len(links) > self.MAX_LINKS:
+            tags["links_truncated"] = len(links) - self.MAX_LINKS
+            links = links[: self.MAX_LINKS]
+        if self.node and "node" not in tags:
+            tags["node"] = self.node
+        return Span(self, name, parent, links, tags)
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        """Child span of the thread-local current context, active (as the
+        current context) for the duration of the block."""
+        if not self.enabled:
+            yield NOOP_SPAN
+            return
+        sp = self.start_span(name, parent=current_context(), **tags)
+        with activate(sp.context):
+            try:
+                yield sp
+            except BaseException as exc:
+                sp.finish(error=exc)
+                raise
+            else:
+                sp.finish()
+
+    def fan_in_span(self, name: str, ctxs: Iterable[Optional[SpanContext]],
+                    **tags):
+        """Span for ONE operation serving MANY parent traces (a verifier
+        flush, a coalesced notary commit): links the distinct non-None
+        contexts; NOOP when none are traced (no orphan roots). Caller
+        finishes it. Tags `batch` (total served) and `traces` (distinct
+        linked) on top of the given tags."""
+        if not self.enabled:
+            return NOOP_SPAN
+        ctxs = list(ctxs)
+        links, seen = [], set()
+        for ctx in ctxs:
+            if ctx is not None and ctx.span_id not in seen:
+                seen.add(ctx.span_id)
+                links.append(ctx)
+        if not links:
+            return NOOP_SPAN
+        return self.start_span(
+            name, links=links, batch=len(ctxs), traces=len(links), **tags
+        )
+
+    def record_span(self, name: str, duration_s: float,
+                    parent: Optional[SpanContext] = None,
+                    links: Iterable[SpanContext] = (), **tags):
+        """Retro-record an already-measured operation (e.g. the requester
+        side of an out-of-process verify knows t0..t1 only at reply
+        time)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        sp = self.start_span(name, parent=parent, links=links, **tags)
+        sp.start_wall = time.time() - duration_s
+        sp._t0 = time.perf_counter() - duration_s
+        sp.finish()
+        return sp
+
+    # -- storage ------------------------------------------------------------
+
+    def _record(self, span: Span) -> None:
+        name = span.name
+        dur_ms = (span.duration_s or 0.0) * 1000
+        with self._lock:
+            res = self._name_stats.get(name)
+            if res is None:
+                res = self._name_stats[name] = deque(
+                    maxlen=self.NAME_RESERVOIR
+                )
+            res.append(span.duration_s or 0.0)
+            self._name_counts[name] = self._name_counts.get(name, 0) + 1
+            trace_ids = {span.context.trace_id}
+            trace_ids.update(c.trace_id for c in span.links)
+            for tid in trace_ids:
+                bucket = self._traces.get(tid)
+                if bucket is None:
+                    bucket = self._traces[tid] = []
+                    while len(self._traces) > self.max_traces:
+                        self._traces.popitem(last=False)
+                if len(bucket) < self.MAX_SPANS_PER_TRACE:
+                    bucket.append(span)
+                else:
+                    self._dropped_spans += 1
+            is_slow_root = (
+                span.is_root and dur_ms >= self.slow_threshold_ms > 0
+            )
+            if span.is_root:
+                self._slow_seq += 1
+                entry = (dur_ms, self._slow_seq, {
+                    "trace_id": span.context.trace_id,
+                    "span_id": span.context.span_id,
+                    "name": name,
+                    "duration_ms": round(dur_ms, 3),
+                    "start": round(span.start_wall, 6),
+                    "tags": dict(span.tags),
+                    "error": span.error,
+                })
+                if len(self._slow) < self.SLOW_RING:
+                    heapq.heappush(self._slow, entry)
+                elif entry[0] > self._slow[0][0]:
+                    heapq.heapreplace(self._slow, entry)
+            breakdown = (
+                self._critical_path_locked(span.context.trace_id)
+                if is_slow_root else None
+            )
+        if is_slow_root:
+            logger.warning(
+                "slow root span %s took %.1f ms (trace %s); critical path: %s",
+                name, dur_ms, span.context.trace_id,
+                "; ".join(breakdown) if breakdown else "<no child spans>",
+            )
+
+    def _critical_path_locked(self, trace_id: str, top: int = 6) -> List[str]:
+        spans = self._traces.get(trace_id, ())
+        children = sorted(
+            (s for s in spans if not s.is_root),
+            key=lambda s: -(s.duration_s or 0.0),
+        )[:top]
+        return [
+            f"{s.name}={round((s.duration_s or 0.0) * 1000, 1)}ms"
+            for s in children
+        ]
+
+    # -- queries ------------------------------------------------------------
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def get_trace(self, trace_id: str) -> Optional[List[Dict]]:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                return None
+            return [s.to_dict() for s in spans]
+
+    def span_tree(self, trace_id: str) -> Optional[Dict]:
+        """Span tree as nested JSON. Fan-in spans recorded into this trace
+        via a link hang under the linked span; spans whose parent was
+        never recorded (evicted, or living in another process) float to
+        the root list rather than vanish."""
+        spans = self.get_trace(trace_id)
+        if spans is None:
+            return None
+        nodes = {s["span_id"]: dict(s, children=[]) for s in spans}
+        roots: List[Dict] = []
+        for s in spans:
+            node = nodes[s["span_id"]]
+            parent_id = s["parent_id"]
+            if s["trace_id"] != trace_id:
+                # fan-in span indexed here through a link: attach to the
+                # linked span in THIS trace
+                parent_id = next(
+                    (l["span_id"] for l in s.get("links", ())
+                     if l["trace_id"] == trace_id),
+                    None,
+                )
+            parent = nodes.get(parent_id) if parent_id else None
+            if parent is not None and parent is not node:
+                parent["children"].append(node)
+            else:
+                roots.append(node)
+        for node in nodes.values():
+            node["children"].sort(key=lambda n: n["start"])
+        roots.sort(key=lambda n: n["start"])
+        return {"trace_id": trace_id, "span_count": len(spans),
+                "roots": roots}
+
+    def slow_roots(self, threshold_ms: Optional[float] = None) -> List[Dict]:
+        """Slowest finished root spans, slowest first, optionally filtered
+        to >= threshold_ms."""
+        with self._lock:
+            entries = sorted(self._slow, reverse=True)
+        out = [e[2] for e in entries]
+        if threshold_ms is not None:
+            out = [e for e in out if e["duration_ms"] >= threshold_ms]
+        return out
+
+    def summary(self) -> Dict[str, Dict]:
+        """Per-span-name latency summary {name: {count, p50_ms, p99_ms,
+        total_ms}} over the bounded per-name reservoirs (survives trace
+        eviction — the bench's per-stage critical-path view)."""
+        with self._lock:
+            items = [
+                (name, self._name_counts.get(name, 0), sorted(res))
+                for name, res in self._name_stats.items()
+            ]
+        out: Dict[str, Dict] = {}
+        for name, count, xs in items:
+            if not xs:
+                continue
+
+            def pct(q: float) -> float:
+                return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+            out[name] = {
+                "count": count,
+                "p50_ms": round(pct(0.50) * 1000, 3),
+                "p99_ms": round(pct(0.99) * 1000, 3),
+                "total_ms": round(sum(xs) * 1000, 3),
+            }
+        return out
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "traces": len(self._traces),
+                "spans": sum(len(v) for v in self._traces.values()),
+                "dropped_spans": self._dropped_spans,
+                "enabled": self.enabled,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._slow.clear()
+            self._name_stats.clear()
+            self._name_counts.clear()
+            self._dropped_spans = 0
+
+
+# -- process-global default tracer ------------------------------------------
+# One tracer per OS process = "per node" in real deployments (each node is
+# a process); MockNetwork's many-nodes-one-process tests share it, which
+# is what lets a cross-node trace assemble without a collector.
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Install a fresh tracer (tests); returns the previous one."""
+    global _default_tracer
+    prev, _default_tracer = _default_tracer, tracer
+    return prev

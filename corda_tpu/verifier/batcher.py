@@ -34,8 +34,14 @@ from typing import Deque, List, Optional, Sequence, Tuple
 
 from ..core.crypto import batch as crypto_batch
 from ..core.crypto.keys import PublicKey
+from ..utils import tracing
 
 Item = Tuple[PublicKey, bytes, bytes]  # (key, signature, content)
+
+#: a pending entry: (item, its future, the submitter's trace context) —
+#: the context is what lets one flushed batch emit a fan-in span linking
+#: every trace it served
+_Entry = Tuple[Item, Future, Optional[tracing.SpanContext]]
 
 
 class SignatureBatcher:
@@ -60,8 +66,8 @@ class SignatureBatcher:
         # the flush queue / in-flight count
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending: List[Tuple[Item, Future]] = []
-        self._flush_queue: Deque[List[Tuple[Item, Future]]] = deque()
+        self._pending: List[_Entry] = []
+        self._flush_queue: Deque[List[_Entry]] = deque()
         self._in_flight = 0  # batches being verified right now
         self._flush_thread: Optional[threading.Thread] = None
         self._timer = None  # TimerHandle from the shared wheel
@@ -79,10 +85,13 @@ class SignatureBatcher:
 
     def submit_many(self, items: Sequence[Item]) -> List[Future]:
         futures = [Future() for _ in items]
+        ctx = tracing.current_context()  # the submitting flow's trace
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.extend(zip(items, futures))
+            self._pending.extend(
+                (item, fut, ctx) for item, fut in zip(items, futures)
+            )
             if len(self._pending) >= self.max_batch:
                 # full buffer -> flush thread; submit keeps filling the
                 # next buffer without waiting for the verify
@@ -140,20 +149,28 @@ class SignatureBatcher:
                     self._in_flight -= 1
                     self._cv.notify_all()
 
-    def _run_batch(self, batch: List[Tuple[Item, Future]]) -> None:
-        items = [it for it, _ in batch]
+    def _run_batch(self, batch: List[_Entry]) -> None:
+        items = [it for it, _, _ in batch]
+        # fan-in span: ONE batch served N parent traces — link them all
+        # so each trace's tree shows the shared flush (untraced batches
+        # emit no span at all)
+        sp = tracing.get_tracer().fan_in_span(
+            "verifier.batch", (ctx for _, _, ctx in batch)
+        )
         t0 = time.perf_counter()
         try:
             results = crypto_batch.verify_batch(items)
         except Exception as exc:  # propagate to every waiter
-            for _, fut in batch:
+            sp.finish(error=exc)
+            for _, fut, _ in batch:
                 fut.set_exception(exc)
             return
+        sp.finish()
         self.flush_wall_s += time.perf_counter() - t0
         self.flushes += 1
         self.items_verified += len(batch)
         self.largest_batch = max(self.largest_batch, len(batch))
-        for (_, fut), ok in zip(batch, results):
+        for (_, fut, _), ok in zip(batch, results):
             fut.set_result(bool(ok))
 
     # -- synchronous edges -------------------------------------------------

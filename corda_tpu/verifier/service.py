@@ -19,6 +19,8 @@ from ..core.crypto.secure_hash import random_63_bit_value
 from ..core.serialization.codec import deserialize, serialize
 from ..core.transactions.ledger import LedgerTransaction
 from ..messaging import Broker
+from ..utils import tracing
+from ..utils.metrics import MetricRegistry
 from .api import (
     VERIFICATION_REQUESTS_QUEUE_NAME,
     VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX,
@@ -101,19 +103,48 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
 
 
 class _Metrics:
-    """Counters plus a bounded reservoir of recent durations: the loadtest
-    firehose would grow an unbounded list without limit (a slow leak under
-    sustained load), and percentile reporting only needs a recent window."""
+    """Verifier stats on the shared MetricRegistry (reference metric names
+    `OutOfProcessTransactionVerifierService.kt:33-45`): Verification.Success
+    / .Failure counters, a Verification.InFlight gauge and a
+    Verification.Duration timer whose reservoir is bounded like every
+    other registry timer — so verifier stats land in the same /metrics
+    snapshot as everything else instead of a hand-rolled side channel.
+    The legacy read surface (success/failure/in_flight/durations) is kept
+    as properties for existing callers."""
 
-    MAX_DURATIONS = 4096
+    def __init__(self, registry: MetricRegistry, in_flight_fn):
+        self.registry = registry
+        self._success = registry.counter("Verification.Success")
+        self._failure = registry.counter("Verification.Failure")
+        self._duration = registry.timer("Verification.Duration")
+        registry.gauge("Verification.InFlight", in_flight_fn)
 
-    def __init__(self):
-        from collections import deque
+    def record(self, ok: bool, seconds: Optional[float]) -> None:
+        (self._success if ok else self._failure).inc()
+        if seconds is not None:
+            self._duration.update(seconds)
 
-        self.success = 0
-        self.failure = 0
-        self.in_flight = 0
-        self.durations: "deque[float]" = deque(maxlen=self.MAX_DURATIONS)
+    @property
+    def success(self) -> int:
+        return self._success.value
+
+    @property
+    def failure(self) -> int:
+        return self._failure.value
+
+    @property
+    def in_flight(self) -> int:
+        return int(self.registry.gauge("Verification.InFlight").value)
+
+    @property
+    def durations(self):
+        """Snapshot of the recent-duration window (the timer's bounded
+        reservoir), copied under the timer's lock — the consumer thread
+        appends concurrently, so handing out the live deque would let
+        callers iterate into a RuntimeError."""
+        timer = self._duration
+        with timer._lock:
+            return list(timer._durations)
 
 
 class OutOfProcessTransactionVerifierService(TransactionVerifierService):
@@ -124,7 +155,10 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     the shared request queue give worker elasticity for free.
     """
 
-    def __init__(self, broker: Broker, node_name: str):
+    def __init__(self, broker: Broker, node_name: str,
+                 metrics: Optional[MetricRegistry] = None):
+        """`metrics`: the node's shared MetricRegistry (a private one is
+        created when standalone, so the read surface always works)."""
         self._broker = broker
         self._response_queue = (
             VERIFICATION_RESPONSES_QUEUE_NAME_PREFIX + node_name
@@ -134,8 +168,14 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         self._pending: Dict[int, Future] = {}
         self._started: Dict[int, float] = {}
         self._sig_pending: Dict[int, List[Future]] = {}
+        # nonce -> requester trace context (requester-side spans for the
+        # out-of-process hop: the worker lives in another process, so the
+        # round trip is recorded here, at reply time)
+        self._trace_ctxs: Dict[int, Optional[tracing.SpanContext]] = {}
         self._lock = threading.Lock()
-        self.metrics = _Metrics()
+        self.metrics = _Metrics(
+            metrics or MetricRegistry(), lambda: len(self._pending)
+        )
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(self._response_queue)
         self._thread = threading.Thread(
@@ -152,7 +192,7 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         with self._lock:
             self._pending[nonce] = fut
             self._started[nonce] = time.monotonic()
-            self.metrics.in_flight += 1
+            self._trace_ctxs[nonce] = tracing.current_context()
         req = VerificationRequest(nonce, ltx, self._response_queue)
         self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
         return fut
@@ -162,6 +202,8 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         futures = [Future() for _ in items]
         with self._lock:
             self._sig_pending[nonce] = futures
+            self._started[nonce] = time.monotonic()
+            self._trace_ctxs[nonce] = tracing.current_context()
         req = SignatureBatchRequest(nonce, tuple(items), self._response_queue)
         self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
         return futures
@@ -192,15 +234,15 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         with self._lock:
             fut = self._pending.pop(resp.verification_id, None)
             t0 = self._started.pop(resp.verification_id, None)
+            ctx = self._trace_ctxs.pop(resp.verification_id, None)
             if fut is None:
                 return
-            self.metrics.in_flight -= 1
-            if t0 is not None:
-                self.metrics.durations.append(time.monotonic() - t0)
-            if resp.error is None:
-                self.metrics.success += 1
-            else:
-                self.metrics.failure += 1
+        elapsed = time.monotonic() - t0 if t0 is not None else None
+        self.metrics.record(resp.error is None, elapsed)
+        if ctx is not None and elapsed is not None:
+            tracing.get_tracer().record_span(
+                "verifier.verify", elapsed, parent=ctx, remote=True,
+            )
         fut.set_result(
             None if resp.error is None else VerificationError(resp.error)
         )
@@ -208,8 +250,18 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     def _complete_sigs(self, resp: SignatureBatchResponse) -> None:
         with self._lock:
             futures = self._sig_pending.pop(resp.verification_id, None)
+            t0 = self._started.pop(resp.verification_id, None)
+            ctx = self._trace_ctxs.pop(resp.verification_id, None)
         if futures is None:
             return
+        if ctx is not None and t0 is not None:
+            # the worker process batches OUR items with other nodes' —
+            # its own tracer has the true fan-in; this span records the
+            # round trip as seen from the requesting trace
+            tracing.get_tracer().record_span(
+                "verifier.batch", time.monotonic() - t0, links=(ctx,),
+                items=len(futures), remote=True,
+            )
         if resp.error is not None or len(resp.valid) != len(futures):
             exc = VerificationError(resp.error or "verdict count mismatch")
             for fut in futures:

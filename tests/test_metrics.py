@@ -73,6 +73,42 @@ class TestRegistry:
         reg.gauge("g", lambda: 1.0)
         json.dumps(reg.snapshot())
 
+    def test_gauge_reregistration_replaces_callable(self):
+        # A recreated service re-registering its gauge must not leave the
+        # snapshot reading the stale (dead) closure.
+        reg = MetricRegistry()
+        g1 = reg.gauge("g", lambda: 1)
+        g2 = reg.gauge("g", lambda: 2)
+        assert g1 is g2  # same metric object, rebound callable
+        assert reg.gauge("g").value == 2
+        assert reg.snapshot()["g"]["value"] == 2
+
+    def test_gauge_name_collision_with_other_type_raises(self):
+        import pytest
+
+        reg = MetricRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x", lambda: 1)
+        reg.gauge("g", lambda: 1)
+        with pytest.raises(TypeError):
+            reg.counter("g")
+
+    def test_timer_meter_collision_semantics(self):
+        # Same-type re-access returns the SAME instance; cross-type is a
+        # consistent TypeError in both directions.
+        import pytest
+
+        reg = MetricRegistry()
+        t = reg.timer("dur")
+        assert reg.timer("dur") is t
+        m = reg.meter("rate")
+        assert reg.meter("rate") is m
+        with pytest.raises(TypeError):
+            reg.meter("dur")
+        with pytest.raises(TypeError):
+            reg.timer("rate")
+
 
 @startable_by_rpc
 class _NapFlow(FlowLogic):

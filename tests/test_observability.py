@@ -1,0 +1,322 @@
+"""The tracing spine + ops endpoint (docs/observability.md).
+
+Covers: W3C-style context propagation through the broker headers and
+the in-memory network, one trace crossing all four pipeline stages
+(flow → P2P → verifier batch → notary commit) in a two-party
+MockNetwork run, fan-in links on batch spans, bounded span storage,
+the slow-span watchdog, the /metrics Prometheus exposition contract,
+/traces retrieval, and the MiniWebServer static-page 500 regression.
+"""
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from corda_tpu.utils import tracing
+from corda_tpu.utils.tracing import SpanContext, Tracer
+
+
+@pytest.fixture()
+def tracer():
+    """Fresh process tracer per test (nodes resolve it dynamically)."""
+    prev = tracing.set_tracer(Tracer())
+    yield tracing.get_tracer()
+    tracing.set_tracer(prev)
+
+
+# ---------------------------------------------------------------------------
+# Context + span mechanics
+# ---------------------------------------------------------------------------
+
+class TestSpanContext:
+    def test_traceparent_round_trip(self):
+        ctx = SpanContext("ab" * 16, "cd" * 8)
+        parsed = SpanContext.from_traceparent(ctx.to_traceparent())
+        assert parsed == ctx
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "00-short-cdcdcdcdcdcdcdcd-01",
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",
+        "no-dashes-here", "00-" + "ab" * 16 + "-" + "cd" * 8,
+    ])
+    def test_malformed_traceparent_is_none(self, bad):
+        assert SpanContext.from_traceparent(bad) is None
+
+
+class TestTracer:
+    def test_nested_spans_build_a_tree(self, tracer):
+        with tracer.span("root") as root:
+            assert tracing.current_context() == root.context
+            with tracer.span("child") as child:
+                assert child.context.trace_id == root.context.trace_id
+        tree = tracer.span_tree(root.context.trace_id)
+        assert tree["roots"][0]["name"] == "root"
+        assert tree["roots"][0]["children"][0]["name"] == "child"
+        json.dumps(tree)  # the endpoint serves this verbatim
+
+    def test_fan_in_span_indexed_under_every_linked_trace(self, tracer):
+        with tracer.span("flow-a") as a:
+            pass
+        with tracer.span("flow-b") as b:
+            pass
+        batch = tracer.start_span("batch", links=[a.context, b.context])
+        batch.finish()
+        for parent in (a, b):
+            tree = tracer.span_tree(parent.context.trace_id)
+            # the batch hangs under the linked span in EACH trace
+            root = tree["roots"][0]
+            assert [c["name"] for c in root["children"]] == ["batch"]
+
+    def test_trace_storage_is_bounded(self):
+        t = Tracer(max_traces=8)
+        for i in range(32):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.trace_ids()) <= 8
+        assert t.stats()["traces"] <= 8
+
+    def test_slow_watchdog_logs_and_rings(self, caplog):
+        t = Tracer(slow_threshold_ms=0.0001)
+        with caplog.at_level(logging.WARNING, logger="corda_tpu.tracing"):
+            with t.span("slow-root"):
+                with t.span("slow-child"):
+                    pass
+        assert any("slow root span" in r.message for r in caplog.records)
+        slow = t.slow_roots()
+        assert slow and slow[0]["name"] == "slow-root"
+        # threshold filter
+        assert t.slow_roots(threshold_ms=1e9) == []
+
+    def test_disabled_tracer_records_nothing_and_propagates_nothing(self):
+        t = Tracer(enabled=False)
+        with t.span("x") as sp:
+            assert sp.context is None
+            assert tracing.current_context() is None
+        assert t.trace_ids() == []
+
+    def test_summary_percentiles(self, tracer):
+        for _ in range(10):
+            with tracer.span("hop"):
+                pass
+        summary = tracer.summary()
+        assert summary["hop"]["count"] == 10
+        assert summary["hop"]["p50_ms"] <= summary["hop"]["p99_ms"]
+
+
+class TestBrokerPropagation:
+    def test_traceparent_rides_broker_headers(self, tracer):
+        from corda_tpu.messaging import Broker
+
+        broker = Broker()
+        broker.create_queue("q")
+        consumer = broker.create_consumer("q")
+        with tracer.span("sender") as sp:
+            broker.send("q", b"payload")
+            expected = sp.context.to_traceparent()
+        msg = consumer.receive(timeout=1)
+        assert msg.headers["traceparent"] == expected
+        # untraced sends stay header-free
+        broker.send("q", b"payload2")
+        assert "traceparent" not in consumer.receive(timeout=1).headers
+        broker.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: one trace across RPC → flow → P2P → verifier → notary
+# ---------------------------------------------------------------------------
+
+class TestMockNetworkTracePropagation:
+    def setup_method(self):
+        self._prev = tracing.set_tracer(Tracer())
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.alice = self.net.create_node(
+            "O=TraceAlice,L=London,C=GB", ops_port=0
+        )
+        self.bob = self.net.create_node("O=TraceBob,L=Paris,C=FR")
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+        tracing.set_tracer(self._prev)
+
+    def _run_payment(self):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.rpc import CordaRPCOps
+
+        ops = CordaRPCOps(self.alice.services, self.alice.smm)
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashIssueFlow",
+            Amount(1000, "USD"), (1,), self.alice.info, self.notary.info,
+        )
+        self.net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+        token = Issued(self.alice.info.ref(1), "USD")
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashPaymentFlow",
+            Amount(400, token), self.bob.info, self.notary.info,
+        )
+        self.net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+
+    def _payment_trace_id(self, tracer):
+        for tid in tracer.trace_ids():
+            spans = tracer.get_trace(tid)
+            if any(
+                "CashPaymentFlow" in str(s["tags"].get("flow", ""))
+                for s in spans
+            ):
+                return tid
+        raise AssertionError("no trace contains the payment flow")
+
+    def test_one_trace_crosses_all_four_stages(self):
+        self._run_payment()
+        tracer = self.net.tracer
+        tid = self._payment_trace_id(tracer)
+        spans = tracer.get_trace(tid)
+        names = {s["name"] for s in spans}
+        # RPC start + P2P hops + verifier batch + notary commit
+        assert "rpc.start_flow" in names
+        assert "p2p.deliver" in names
+        assert "verifier.batch" in names
+        assert "notary.commit" in names
+        assert "notary.commit_batch" in names
+        # BOTH parties' flow spans (plus the notary's serving flow)
+        flow_nodes = {
+            s["tags"].get("node")
+            for s in spans if s["name"].startswith("flow.")
+        }
+        assert self.alice.info.name in flow_nodes
+        assert self.bob.info.name in flow_nodes
+        assert self.notary.info.name in flow_nodes
+        # fan-in: the verifier batch span links parent trace(s)
+        batch = next(s for s in spans if s["name"] == "verifier.batch")
+        assert any(l["trace_id"] == tid for l in batch["links"])
+        # and it is ONE tree rooted at the RPC start
+        tree = tracer.span_tree(tid)
+        assert tree["roots"][0]["name"] == "rpc.start_flow"
+
+    def test_trace_retrievable_over_ops_endpoint(self):
+        self._run_payment()
+        tid = self._payment_trace_id(self.net.tracer)
+        port = self.alice.ops_server.port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/traces/{tid}", timeout=5
+        ) as resp:
+            tree = json.loads(resp.read())
+        assert tree["trace_id"] == tid
+        assert tree["span_count"] >= 4
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/traces/slow?threshold_ms=0", timeout=5
+        ) as resp:
+            slow = json.loads(resp.read())
+        assert any(e["name"] == "rpc.start_flow" for e in slow)
+        # unknown trace -> JSON 404, not a dropped connection
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces/{'0' * 32}", timeout=5
+            )
+        assert err.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# /metrics Prometheus exposition contract (CI satellite: the format must
+# not silently rot — name charset, HELP/TYPE lines, no duplicate families)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusExposition:
+    def test_scraped_metrics_are_valid_prometheus_text(self, tracer):
+        import re
+
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        try:
+            node = net.create_node("O=Prom,L=London,C=GB", ops_port=0)
+            # populate a few families: a flow + a timer + the gauge
+            from corda_tpu.core.flows import FlowLogic
+
+            class _Noop(FlowLogic):
+                def call(self):
+                    return 1
+
+            node.start_flow(_Noop())
+            net.run_network()
+            node.smm.metrics.timer("RPC.demo").update(0.01)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{node.ops_server.port}/metrics", timeout=5
+            ) as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+        finally:
+            net.stop_nodes()
+
+        name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+        sample_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+            r'(\{[a-zA-Z0-9_]+="[^"]*"(,[a-zA-Z0-9_]+="[^"]*")*\})?'
+            r" -?[0-9.eE+-]+(\n|$)"                  # value
+        )
+        families = []
+        helped = set()
+        for line in body.splitlines():
+            if line.startswith("# HELP "):
+                helped.add(line.split()[2])
+                continue
+            if line.startswith("# TYPE "):
+                _, _, fam, mtype = line.split()
+                assert name_re.fullmatch(fam), fam
+                assert mtype in {"counter", "gauge", "summary", "histogram",
+                                 "untyped"}
+                families.append(fam)
+                continue
+            assert not line.startswith("#"), f"unknown comment: {line}"
+            assert sample_re.match(line + "\n"), f"bad sample line: {line}"
+        # no duplicate families, every family carries a HELP line
+        assert len(families) == len(set(families)), "duplicate TYPE family"
+        assert set(families) <= helped
+        # the node's core families made it out
+        assert "corda_tpu_flows_started_total" in families
+        assert "corda_tpu_flows_in_flight" in families
+        assert "corda_tpu_rpc_demo_seconds" in families
+        # every sample belongs to a declared family (allowing the summary
+        # _sum/_count children)
+        fam_set = set(families)
+        for line in body.splitlines():
+            if line.startswith("#"):
+                continue
+            name = re.match(r"[a-zA-Z0-9_:]+", line).group(0)
+            base = re.sub(r"_(sum|count)$", "", name)
+            assert name in fam_set or base in fam_set, name
+
+
+# ---------------------------------------------------------------------------
+# MiniWebServer regression: a missing static page must produce a JSON
+# 500 body (the module's own contract), never a dropped connection.
+# ---------------------------------------------------------------------------
+
+class TestMiniWebStaticPages:
+    def test_missing_static_file_returns_json_500(self):
+        from corda_tpu.utils.miniweb import MiniWebServer
+
+        class Server(MiniWebServer):
+            pages = {"/": "this-file-does-not-exist.html"}
+
+            def handle(self, method, path, query, body):
+                raise KeyError(path)
+
+        srv = Server(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/", timeout=5
+                )
+            assert err.value.code == 500
+            payload = json.loads(err.value.read())
+            assert "static page unavailable" in payload["error"]
+        finally:
+            srv.stop()
