@@ -280,6 +280,20 @@ def main() -> None:
     record.update(extras)
     print(json.dumps(record))
 
+    if "--gate" in sys.argv:
+        # regression gate: hand this run's record to tools/bench_gate.py,
+        # which compares it against the newest BENCH_r*.json round
+        # artifact; a >20% stage-timing regression fails the bench run
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "bench_gate.py"),
+             "--current", "-", "--repo", here],
+            input=json.dumps(record), text=True,
+            stdout=subprocess.DEVNULL,  # gate detail goes to stderr; the
+        )                               # record stays this run's only stdout
+        if proc.returncode != 0:
+            raise SystemExit(proc.returncode)
+
 
 def _kernel_flag(name: str) -> bool:
     from corda_tpu.ops import ed25519_pallas
@@ -426,6 +440,10 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         codec_us = round(_codec_encode_us(), 2)
     except Exception:
         codec_us = None
+    # device-dispatch telemetry accumulated across the whole secondary
+    # run (the same recorder the ops endpoint's Jax.* gauges read)
+    from corda_tpu.utils import profiling
+
     stage_timings = {
         "codec_encode_us_per_tx": codec_us,
         "uniq_commit_batch_mean": uniq["raft_commit_batch_mean"],
@@ -437,6 +455,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         # name over the notarise-latency run): the per-REQUEST view next
         # to the aggregate stage numbers, so a regression names its hop
         "critical_path": lat.get("span_summary"),
+        "jax_dispatch": profiling.dispatch_snapshot(),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
@@ -454,6 +473,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "mixed_batch": len(mixed),
         "p50_notarise_ms": lat["p50_ms"],
         "p95_notarise_ms": lat["p95_ms"],
+        "p99_notarise_ms": lat["p99_ms"],
         "notarise_burst": lat["n_tx"],
         "settlement_burst_sigs_s": burst["sigs_per_sec"],
         "batcher_flushes": burst["batcher_flushes"],
@@ -517,5 +537,7 @@ if __name__ == "__main__":
         print("bench: retrying on CPU after mid-run failure", file=sys.stderr)
         env = dict(os.environ, CORDA_TPU_BENCH_FORCE_CPU="1")
         raise SystemExit(
-            subprocess.run([sys.executable, __file__], env=env).returncode
+            subprocess.run(
+                [sys.executable, __file__, *sys.argv[1:]], env=env
+            ).returncode
         )

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 from typing import List, Sequence, Tuple
 
 from . import crypto
@@ -423,11 +424,21 @@ def _verify_flat(
                     "is disabled until reconfigured", scheme_kind
                 )
         if mask is None:
+            from ...utils import profiling
+
+            kernel = (
+                "ed25519.verify_batch" if is_ed
+                else f"ecdsa.{_ECDSA_CURVES[name]}.verify_batch"
+            )
+            t0 = _time.perf_counter()
             mask = (
                 ops.ed25519_verify_batch(pubs, sigs, msgs)
                 if is_ed
                 else ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
             )
+            # backpressure telemetry seam: one record per DISPATCH (not
+            # per signature) feeds the ops endpoint's Jax.* gauges
+            profiling.record_dispatch(kernel, _time.perf_counter() - t0)
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
 

@@ -1,0 +1,210 @@
+"""Bench regression gate: compare a bench.py record against the previous
+round's artifact and fail loudly on stage-timing regressions.
+
+The driver records each round's bench output as `BENCH_r<NN>.json`
+(`{"parsed": {...bench record...}}`). This module is the comparison
+engine behind `tools/bench_gate.py` (the CLI) and `bench.py --gate`:
+
+  * `compare_records(prev, cur)` walks the flat record plus the nested
+    `stage_timings` block (including the per-span `critical_path`
+    summaries), classifies each numeric key as lower-is-better (timings:
+    `*_ms`, `*_us`, `*_s`, latency/lag keys) or higher-is-better
+    (throughputs: `*_sigs_s`, `*_commits_s`, `*_pairs_s`, rates) and
+    flags any key that moved more than `threshold` (default 20%) in the
+    bad direction. Unclassifiable keys (batch sizes, counts, provenance)
+    are never compared — a workload-shape change is not a regression.
+  * `check_slos(record, slos)` asserts absolute service-level bounds
+    (p99 notarise latency, verify throughput); the loadtest harness
+    reuses it for post-run assertions.
+
+Both return violation lists instead of raising, so callers choose the
+exit-code policy; only the CLI turns them into process exit status.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+#: default tolerated relative move in the bad direction
+DEFAULT_THRESHOLD = 0.20
+#: values this small in BOTH rounds are noise, not signal (a 0.01 ms ->
+#: 0.013 ms "30% regression" must not fail a round)
+MIN_COMPARABLE = 1e-6
+
+_HIGHER = re.compile(
+    r"(_sigs_s|_commits_s|_pairs_s|_items_s|_per_sec|_rate|throughput)$"
+)
+_LOWER = re.compile(r"(_ms|_us|_s)$")
+_LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")
+
+
+def direction(key: str) -> Optional[str]:
+    """'lower' / 'higher' (= which way is better) or None (not gated)."""
+    k = key.rsplit(".", 1)[-1].lower()
+    if _HIGHER.search(k):
+        return "higher"
+    if _LOWER.search(k) or _LOWER_HINT.search(k):
+        return "lower"
+    return None
+
+
+def _numeric_leaves(record: Dict, prefix: str = "") -> Dict[str, float]:
+    """Flatten nested dicts to {dotted.key: float}; booleans and strings
+    drop out (they carry provenance, not performance)."""
+    out: Dict[str, float] = {}
+    for key, value in (record or {}).items():
+        path = f"{prefix}{key}"
+        if isinstance(value, dict):
+            out.update(_numeric_leaves(value, prefix=path + "."))
+        elif isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[path] = float(value)
+    return out
+
+
+def compare_records(prev: Dict, cur: Dict,
+                    threshold: float = DEFAULT_THRESHOLD,
+                    min_value: float = MIN_COMPARABLE) -> List[Dict]:
+    """Regressions of `cur` vs `prev`, worst first. Each entry:
+    {key, prev, cur, change (signed relative move in the bad direction),
+    direction}. Keys present in only one record are skipped — a new
+    stage is not a regression, and an old baseline without
+    `stage_timings` simply gates nothing."""
+    prev_leaves = _numeric_leaves(prev)
+    cur_leaves = _numeric_leaves(cur)
+    regressions: List[Dict] = []
+    for key, prev_v in prev_leaves.items():
+        cur_v = cur_leaves.get(key)
+        if cur_v is None:
+            continue
+        sense = direction(key)
+        if sense is None:
+            continue
+        if abs(prev_v) < min_value and abs(cur_v) < min_value:
+            continue
+        if prev_v <= 0:
+            continue  # no meaningful base to take a ratio against
+        if sense == "lower":
+            change = (cur_v - prev_v) / prev_v
+        else:
+            change = (prev_v - cur_v) / prev_v
+        if change > threshold:
+            regressions.append({
+                "key": key,
+                "prev": prev_v,
+                "cur": cur_v,
+                "change": round(change, 4),
+                "direction": sense,
+            })
+    regressions.sort(key=lambda r: -r["change"])
+    return regressions
+
+
+# -- SLO assertions -----------------------------------------------------------
+
+#: an SLO spec: {metric key: {"max": bound}} (lower-is-better, e.g. p99
+#: notarise latency) or {"min": bound} (higher-is-better, e.g. verify
+#: throughput). Keys use the same dotted paths compare_records flattens to.
+SloSpec = Dict[str, Dict[str, float]]
+
+#: the system-path SLOs the ROADMAP's production posture implies —
+#: OPT-IN: applied only by `check_slos(record)` with no spec, or via the
+#: CLI's --slo-defaults flag; a bare gate run compares timings only
+#: (these bounds are deliberately loose — a 1-core CI box sharing the
+#: capture daemon must pass them)
+DEFAULT_SLOS: SloSpec = {
+    "p99_notarise_ms": {"max": 500.0},
+    "settlement_burst_sigs_s": {"min": 100.0},
+}
+
+
+def check_slos(record: Dict, slos: Optional[SloSpec] = None) -> List[Dict]:
+    """Absolute-bound violations, one entry per broken SLO:
+    {key, value, bound, kind}. A metric missing from the record is a
+    violation too (kind "missing") — a gate that silently skips what it
+    was asked to assert is not a gate."""
+    if slos is None:
+        slos = DEFAULT_SLOS
+    leaves = _numeric_leaves(record)
+    violations: List[Dict] = []
+    for key, spec in sorted(slos.items()):
+        value = leaves.get(key)
+        if value is None:
+            violations.append({"key": key, "value": None,
+                               "bound": spec, "kind": "missing"})
+            continue
+        hi = spec.get("max")
+        lo = spec.get("min")
+        if hi is not None and value > hi:
+            violations.append({"key": key, "value": value,
+                               "bound": hi, "kind": "max"})
+        if lo is not None and value < lo:
+            violations.append({"key": key, "value": value,
+                               "bound": lo, "kind": "min"})
+    return violations
+
+
+def parse_slo_args(specs) -> SloSpec:
+    """CLI sugar: ["p99_notarise_ms<=500", "verify_sigs_s>=1000"] ->
+    SloSpec. Raises ValueError on anything else."""
+    out: SloSpec = {}
+    for spec in specs or ():
+        if "<=" in spec:
+            key, _, bound = spec.partition("<=")
+            out.setdefault(key.strip(), {})["max"] = float(bound)
+        elif ">=" in spec:
+            key, _, bound = spec.partition(">=")
+            out.setdefault(key.strip(), {})["min"] = float(bound)
+        else:
+            raise ValueError(f"SLO spec must use <= or >=: {spec!r}")
+    return out
+
+
+# -- artifact loading ---------------------------------------------------------
+
+def load_bench_record(path: str) -> Dict:
+    """A bench record from either shape: the driver's round artifact
+    ({"parsed": {...}}) or bench.py's raw JSON line."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a bench record")
+    return data
+
+
+_ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def latest_baseline(repo_dir: str) -> Optional[Tuple[str, Dict]]:
+    """(path, record) of the newest BENCH_r<NN>.json, or None."""
+    best: Optional[Tuple[int, str]] = None
+    try:
+        names = os.listdir(repo_dir)
+    except OSError:
+        return None
+    for name in names:
+        m = _ROUND.match(name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), name)
+    if best is None:
+        return None
+    path = os.path.join(repo_dir, best[1])
+    return path, load_bench_record(path)
+
+
+def run_gate(cur: Dict, prev: Optional[Dict],
+             threshold: float = DEFAULT_THRESHOLD,
+             slos: Optional[SloSpec] = None) -> Dict:
+    """One-call policy: {"ok", "regressions", "slo_violations"}. With no
+    baseline (`prev` None) only SLOs gate; with no SLO spec only the
+    comparison gates."""
+    regressions = compare_records(prev, cur, threshold) if prev else []
+    violations = check_slos(cur, slos) if slos else []
+    return {
+        "ok": not regressions and not violations,
+        "regressions": regressions,
+        "slo_violations": violations,
+    }

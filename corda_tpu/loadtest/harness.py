@@ -37,10 +37,17 @@ class LoadTestResult:
     errors: List[str]
     consistent: bool
     metrics: Dict[str, float] = field(default_factory=dict)
+    #: broken SLO bounds (tools/bench_gate.py's check_slos shape); empty
+    #: when no SLOs were given or all held
+    slo_violations: List[Dict] = field(default_factory=list)
 
     @property
     def commands_per_sec(self) -> float:
         return self.commands_executed / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.consistent and not self.errors and not self.slo_violations
 
 
 class LoadTest:
@@ -67,6 +74,13 @@ class LoadTest:
     def compare(self, predicted: Any, observed: Any) -> bool:
         return predicted == observed
 
+    def collect_metrics(self, nodes: Nodes) -> Dict[str, float]:
+        """Numeric metrics for the result (and the SLO check): override
+        to surface test-specific readings — e.g. a notarise-latency p99
+        pulled from a node's tracer summary. Runs after the final
+        gather, before SLOs are evaluated."""
+        return {}
+
     # -- driver --------------------------------------------------------------
 
     def run(
@@ -77,7 +91,14 @@ class LoadTest:
         seed: int = 0,
         disruptions: Optional[list] = None,
         gather_frequency: int = 5,
+        slos: Optional[Dict[str, Dict[str, float]]] = None,
     ) -> LoadTestResult:
+        """`slos`: optional absolute bounds checked against the run's
+        metrics — commands_per_sec, duration_s, and whatever
+        `collect_metrics` surfaces — in the regression gate's spec
+        shape, e.g. {"commands_per_sec": {"min": 50.0}}
+        (gate.check_slos semantics: a bound on a metric the run did not
+        produce is a violation, so only bound keys the test emits)."""
         rng = random.Random(seed)
         state = self.setup(nodes)
         errors: List[str] = []
@@ -113,9 +134,22 @@ class LoadTest:
             errors.append(
                 f"final divergence predicted={state!r} observed={observed!r}"
             )
-        return LoadTestResult(
-            self.name, executed, duration, errors, consistent
+        result = LoadTestResult(
+            self.name, executed, duration, errors, consistent,
+            metrics=dict(self.collect_metrics(nodes)),
         )
+        if slos:
+            from .gate import check_slos
+
+            result.slo_violations = check_slos(
+                {
+                    **result.metrics,
+                    "commands_per_sec": result.commands_per_sec,
+                    "duration_s": duration,
+                },
+                slos,
+            )
+        return result
 
 
 def run_load_tests(

@@ -20,7 +20,8 @@ from ..core.contracts.amount import Issued
 
 
 def _percentiles_ms(latencies: List[float]) -> Dict[str, float]:
-    """Nearest-rank p50/p95 of a latency list, in milliseconds."""
+    """Nearest-rank p50/p95/p99 of a latency list, in milliseconds (p99
+    is the bench gate's notarise-latency SLO key)."""
     lat = sorted(latencies)
 
     def pct(q: float) -> float:
@@ -29,6 +30,7 @@ def _percentiles_ms(latencies: List[float]) -> Dict[str, float]:
     return {
         "p50_ms": round(pct(0.50) * 1000, 3),
         "p95_ms": round(pct(0.95) * 1000, 3),
+        "p99_ms": round(pct(0.99) * 1000, 3),
     }
 
 

@@ -51,13 +51,32 @@ def main(argv=None) -> int:
 
     import logging
 
+    console_level = getattr(
+        logging, os.environ.get("CORDA_TPU_LOG", "WARNING").upper(),
+        logging.WARNING,
+    )
     logging.basicConfig(
-        level=getattr(
-            logging, os.environ.get("CORDA_TPU_LOG", "WARNING").upper(),
-            logging.WARNING,
-        ),
+        level=console_level,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # Console verbosity lives on the HANDLERS, not the logger tree: the
+    # flight-recorder bridge below lowers the corda_tpu logger to INFO
+    # (capture_info) so its ring gets the INFO stream, and these handler
+    # levels are what keep the console at CORDA_TPU_LOG regardless.
+    for _h in logging.getLogger().handlers:
+        _h.setLevel(console_level)
+
+    from ..utils import eventlog
+
+    eventlog.install_stdlib_bridge(capture_info=True)
+
+    def announce(msg: str, level: str = "info") -> None:
+        """Startup lines are BOTH a launcher protocol (the driver greps
+        stdout for them) and operational events: print for the former,
+        emit into the flight recorder for the latter — nothing bypasses
+        the recorder."""
+        print(msg, flush=True)
+        eventlog.emit(level, "node", msg)
 
     from .config import load_config
 
@@ -76,8 +95,10 @@ def main(argv=None) -> int:
                 raw = json.load(fh)
         doorman_url = raw.get("doorman_url")
         if not doorman_url:
-            print("error: --initial-registration requires doorman_url in node.conf",
-                  flush=True)
+            announce(
+                "error: --initial-registration requires doorman_url in "
+                "node.conf", level="error",
+            )
             return 2
         from .registration import NetworkRegistrationHelper
 
@@ -88,10 +109,9 @@ def main(argv=None) -> int:
             expected_root=raw.get("doorman_root_fingerprint"),
         )
         chain = helper.register()
-        print(
+        announce(
             f"registered {cfg.node.my_legal_name}: chain of {len(chain)} "
-            f"certificates installed in {cfg.certificates_dir}",
-            flush=True,
+            f"certificates installed in {cfg.certificates_dir}"
         )
         return 0
 
@@ -200,9 +220,8 @@ def main(argv=None) -> int:
     with open(port_path + ".tmp", "w") as fh:
         fh.write(str(server.port))
     os.replace(port_path + ".tmp", port_path)
-    print(
-        f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}",
-        flush=True,
+    announce(
+        f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}"
     )
 
     stop = threading.Event()
@@ -218,8 +237,8 @@ def main(argv=None) -> int:
     try:
         while not stop.wait(0.5):
             if exit_on_orphan and os.getppid() != parent:
-                print("launcher died; shutting down (exit-on-orphan)",
-                      flush=True)
+                announce("launcher died; shutting down (exit-on-orphan)",
+                         level="warning")
                 break
     finally:
         if netmap_client is not None:

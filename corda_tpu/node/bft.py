@@ -764,6 +764,13 @@ class BFTReplica:
         if len(votes) >= 2 * self.f + 1 and new_view > self.view:
             self.view = new_view
             self._pending_since = None
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "info", "bft", "entered view",
+                replica=self.id, view=new_view,
+                primary=new_view % self.n,
+            )
             if self.is_primary:
                 self.next_seq = max(self.pre_prepares, default=self.last_executed) + 1
                 # re-propose carried-over uncommitted work, then fresh queue

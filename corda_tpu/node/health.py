@@ -1,0 +1,134 @@
+"""Node health and readiness aggregation (the /healthz + /readyz model).
+
+Kubernetes-style split: **liveness** (`/healthz`) answers "is the process
+sound enough to keep sending traffic to" — 200 whenever the node is
+serving and every registered component check passes, 503 with a JSON
+cause while starting or draining; **readiness** (`/readyz`) answers "may
+traffic start" — 503 until the node's start sequence completed AND every
+component marked `readiness=True` passes (broker reachable, verifier
+backend initialized, notary/raft leader known, thread pools not
+saturated).
+
+Checks are zero-arg callables returning a detail dict (truthy `ok` key
+optional — a plain dict means healthy); raising marks the component
+unhealthy with the exception as the cause. Check bodies run on the ops
+server's request threads: they must be cheap reads (queue lengths,
+flags), never blocking probes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: lifecycle states, in order
+STARTING, SERVING, DRAINING, STOPPED = (
+    "starting", "serving", "draining", "stopped",
+)
+
+
+class HealthTracker:
+    """Per-node lifecycle state + named component checks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._state = STARTING
+        self._state_since = time.time()
+        #: name -> (check fn, counts toward readiness)
+        self._checks: Dict[str, Tuple[Callable[[], Optional[dict]], bool]] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            if state != self._state:
+                self._state = state
+                self._state_since = time.time()
+
+    def mark_serving(self) -> None:
+        self.set_state(SERVING)
+
+    def mark_draining(self) -> None:
+        self.set_state(DRAINING)
+
+    def mark_stopped(self) -> None:
+        self.set_state(STOPPED)
+
+    # -- checks -------------------------------------------------------------
+
+    def register(self, name: str, check: Callable[[], Optional[dict]],
+                 readiness: bool = True) -> None:
+        """Idempotent by name: a restarted service re-registering its
+        check replaces the stale closure (same rule as gauge
+        re-registration in MetricRegistry)."""
+        with self._lock:
+            self._checks[name] = (check, readiness)
+
+    def _run_checks(self, readiness_only: bool) -> Tuple[bool, Dict]:
+        with self._lock:
+            checks = sorted(self._checks.items())
+        all_ok = True
+        details: Dict[str, dict] = {}
+        for name, (fn, for_readiness) in checks:
+            if readiness_only and not for_readiness:
+                continue
+            try:
+                detail = fn() or {}
+                ok = bool(detail.pop("ok", True))
+            except Exception as exc:  # a broken check IS an unhealthy component
+                detail, ok = {"error": f"{type(exc).__name__}: {exc}"}, False
+            details[name] = {"ok": ok, **detail}
+            all_ok = all_ok and ok
+        return all_ok, details
+
+    # -- the two probe views ------------------------------------------------
+
+    def _base(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "state_age_s": round(time.time() - self._state_since, 3),
+            }
+
+    def healthz(self) -> Tuple[int, Dict]:
+        """(http status, body): 200 only while SERVING with all
+        component checks passing; starting/draining/stopped are 503 with
+        the lifecycle state as the cause."""
+        body = self._base()
+        ok, details = self._run_checks(readiness_only=False)
+        body["checks"] = details
+        if self._state != SERVING:
+            body["status"] = "unavailable"
+            body["cause"] = f"node is {self._state}"
+            return 503, body
+        if not ok:
+            failing = sorted(n for n, d in details.items() if not d["ok"])
+            body["status"] = "unhealthy"
+            body["cause"] = "failing checks: " + ", ".join(failing)
+            return 503, body
+        body["status"] = "ok"
+        return 200, body
+
+    def readyz(self) -> Tuple[int, Dict]:
+        """(http status, body): 200 once serving and every readiness
+        check passes — the gate a load balancer / driver polls before
+        routing traffic."""
+        body = self._base()
+        ok, details = self._run_checks(readiness_only=True)
+        body["checks"] = details
+        if self._state != SERVING or not ok:
+            not_ready: List[str] = sorted(
+                n for n, d in details.items() if not d["ok"]
+            )
+            body["status"] = "not-ready"
+            body["cause"] = (
+                f"node is {self._state}" if self._state != SERVING
+                else "failing checks: " + ", ".join(not_ready)
+            )
+            return 503, body
+        body["status"] = "ready"
+        return 200, body

@@ -111,6 +111,14 @@ class InMemoryMessagingNetwork:
                 return ep
         return None
 
+    def queue_depth(self, recipient: Optional[str] = None) -> int:
+        """Undelivered messages queued network-wide, or for ONE recipient
+        (a node's inbound backlog — the per-node backpressure gauge)."""
+        with self._lock:
+            if recipient is None:
+                return len(self._queue)
+            return sum(1 for m in self._queue if m.recipient == recipient)
+
     def next_due(self) -> Optional[float]:
         """Earliest due_at among undeliverable queued messages (simulation
         drivers advance their TestClock to this when the network idles)."""
@@ -172,6 +180,10 @@ class InMemoryMessaging:
 
     def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
         self._handlers.setdefault(topic, []).append(fn)
+
+    def queue_depth(self) -> int:
+        """This endpoint's inbound backlog on the shared network queue."""
+        return self.network.queue_depth(self.me.name)
 
     def _deliver(self, sender: Party, topic: str, payload: bytes,
                  traceparent: Optional[str] = None) -> None:
@@ -292,6 +304,17 @@ class BrokerMessagingService:
 
     def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
         self._handlers.setdefault(topic, []).append(fn)
+
+    def queue_depth(self) -> int:
+        """Messages waiting in this node's inbound broker queue(s) —
+        pump-thread backpressure in one number (a depth that climbs while
+        consumers are live means the handlers can't keep up)."""
+        depth = self.broker.message_count(self.queue_name)
+        for c in self._extra_consumers:
+            q = getattr(c, "_queue", None)
+            if q is not None:
+                depth += self.broker.message_count(q.name)
+        return depth
 
     def _consume(self) -> None:
         self._consume_from(self._consumer)

@@ -14,6 +14,7 @@ from typing import Iterable, List, Optional
 from ..core.crypto import crypto
 from ..core.crypto.keys import KeyPair
 from ..core.identity import Party
+from ..utils import eventlog
 from ..utils.metrics import MetricRegistry, MonitoringService
 from ..verifier.batcher import SignatureBatcher
 from ..verifier.service import (
@@ -21,6 +22,7 @@ from ..verifier.service import (
     OutOfProcessTransactionVerifierService,
 )
 from .database import CheckpointStorage, NodeDatabase
+from .health import HealthTracker
 from .services import NetworkMapCache, ServiceHub
 from .statemachine import StateMachineManager
 
@@ -66,6 +68,12 @@ class AbstractNode:
         zero-arg callable returning unix seconds (default time.time);
         simulations pass a utils.clocks.TestClock (reference TestClock)."""
         self.config = config
+        # flight recorder: bridge every corda_tpu.* stdlib log record into
+        # the process event log (idempotent), so component warnings that
+        # predate the recorder still land in /logs
+        eventlog.install_stdlib_bridge()
+        # lifecycle + component health (served at /healthz and /readyz)
+        self.health = HealthTracker()
         if config.identity_entropy is not None:
             self._identity_key = crypto.entropy_to_keypair(config.identity_entropy)
         else:
@@ -84,6 +92,9 @@ class AbstractNode:
             self.info, self.database, verifier, self._identity_key, clock=clock
         )
         self.services.monitoring = MonitoringService(self.metrics)
+        # RPC reachability: node_health() resolves the tracker through
+        # the service hub (the RPC layer never sees the node object)
+        self.services.health = self.health
         self.smm = StateMachineManager(
             self.services, self.network, self.checkpoint_storage, self.info,
             dev_checkpoint_check=config.dev_checkpoint_check,
@@ -100,8 +111,111 @@ class AbstractNode:
         if config.notary_type is not None:
             self._make_notary_service()
         self.started = False
+        self._register_health_checks()
+        self._register_backpressure_metrics()
 
     # -- assembly ------------------------------------------------------------
+
+    def _register_health_checks(self) -> None:
+        """Component checks behind /healthz and /readyz. Check bodies are
+        cheap reads only — they run on ops-server request threads."""
+
+        def check_messaging():
+            net = self.network
+            detail = {}
+            if hasattr(net, "queue_depth"):
+                detail["queue_depth"] = net.queue_depth()
+            broker = getattr(net, "broker", None)
+            if broker is not None:
+                # broker reachability: this node's inbound queue must exist
+                detail["ok"] = broker.queue_exists(net.queue_name)
+            elif hasattr(net, "running"):
+                detail["ok"] = bool(net.running)
+            return detail
+
+        def check_verifier():
+            svc = self.services.transaction_verifier_service
+            if hasattr(svc, "healthcheck"):
+                return svc.healthcheck()
+            return {"backend": type(svc).__name__}
+
+        def check_statemachine():
+            detail = {"flows_in_flight": self.smm.in_flight_count}
+            executor = self.smm._blocking_executor
+            if executor is not None:
+                # saturation = a backlog several times the worker count
+                # (the threads mostly block on cluster commits; a deep
+                # queue here is the upstream sign of a commit stall)
+                backlog = executor._work_queue.qsize()
+                workers = executor._max_workers
+                detail["blocking_backlog"] = backlog
+                detail["blocking_workers"] = workers
+                detail["ok"] = backlog < workers * 8
+            return detail
+
+        self.health.register("messaging", check_messaging)
+        self.health.register("verifier", check_verifier)
+        self.health.register("statemachine", check_statemachine)
+
+        if self.notary_service is not None:
+            def check_notary():
+                detail = {"type": self.config.notary_type}
+                raft = getattr(self, "raft_node", None)
+                if raft is not None:
+                    detail["role"] = raft.role
+                    detail["leader"] = raft.leader_id
+                    # a member that knows no leader cannot serve commits
+                    detail["ok"] = (
+                        raft.role == "leader" or raft.leader_id is not None
+                    )
+                replica = getattr(self, "bft_replica", None)
+                if replica is not None:
+                    detail["view"] = replica.view
+                    detail["primary"] = replica.primary
+                return detail
+
+            self.health.register("notary", check_notary)
+
+    def _register_backpressure_metrics(self) -> None:
+        """Queue-depth / occupancy / device gauges on the node registry —
+        the "which queue is backing up" half of the flight recorder."""
+        net = self.network
+        if hasattr(net, "queue_depth"):
+            self.metrics.gauge("P2P.QueueDepth", net.queue_depth)
+        svc = self.services.transaction_verifier_service
+        batcher = getattr(svc, "_batcher", None)
+        if batcher is not None:
+            batcher.bind_metrics(self.metrics)
+        self.metrics.gauge("Flows.BlockingBacklog", lambda: (
+            self.smm._blocking_executor._work_queue.qsize()
+            if self.smm._blocking_executor is not None else 0
+        ))
+        # JAX device telemetry: resolved lazily and WITHOUT importing jax
+        # (a gauge read must never trigger backend initialization)
+        import sys as _sys
+
+        from ..utils import profiling as _profiling
+
+        def jax_backend():
+            jax = _sys.modules.get("jax")
+            if jax is None:
+                return "uninitialized"
+            try:
+                return jax.default_backend()
+            except Exception:
+                return "uninitialized"
+
+        self.metrics.gauge("Jax.Backend", jax_backend)
+        self.metrics.gauge(
+            "Jax.CompileCount", lambda: _profiling.dispatch_totals()[1]
+        )
+        self.metrics.gauge(
+            "Jax.DispatchCount", lambda: _profiling.dispatch_totals()[0]
+        )
+        self.metrics.gauge(
+            "Jax.DispatchWallSeconds",
+            lambda: round(_profiling.dispatch_totals()[2], 6),
+        )
 
     def _make_transaction_verifier_service(self):
         if self.config.verifier_type == "OutOfProcess":
@@ -461,9 +575,15 @@ class AbstractNode:
             # tracer deliberately unpinned: the endpoint resolves the
             # process tracer per request, like the span producers do
             self.ops_server = OpsServer(
-                self.smm.metrics, port=self.config.ops_port
+                self.smm.metrics, health=self.health,
+                port=self.config.ops_port,
             )
         self.started = True
+        self.health.mark_serving()
+        eventlog.emit(
+            "info", "node", "node started", node=self.info.name,
+            notary=self.config.notary_type or "none",
+        )
         return self
 
     #: Raft abstract time units per wall-clock second: the RaftNode's
@@ -514,7 +634,18 @@ class AbstractNode:
         )
         self._bft_ticker.start()
 
+    def drain(self) -> None:
+        """Flip /healthz and /readyz to 503 (the load balancer's cue to
+        stop routing here) WITHOUT tearing anything down — callable ahead
+        of stop() for graceful rollouts; stop() calls it implicitly."""
+        self.health.mark_draining()
+        eventlog.emit("info", "node", "node draining", node=self.info.name)
+
     def stop(self) -> None:
+        # drain FIRST: while components shut down below, any /healthz
+        # probe that still lands answers 503 instead of a half-true 200
+        if self.health.state not in ("draining", "stopped"):
+            self.drain()
         if getattr(self, "ops_server", None) is not None:
             self.ops_server.stop()
             self.ops_server = None
@@ -534,6 +665,8 @@ class AbstractNode:
         if hasattr(svc, "stop"):
             svc.stop()
         self.database.close()
+        self.health.mark_stopped()
+        eventlog.emit("info", "node", "node stopped", node=self.info.name)
 
     # -- conveniences --------------------------------------------------------
 

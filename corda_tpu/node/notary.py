@@ -28,7 +28,7 @@ from ..core.identity import Party
 from ..core.serialization.codec import deserialize, register_adapter, serialize
 from ..core.transactions.filtered import FilteredTransaction
 from ..core.transactions.signed import SignedTransaction
-from ..utils import tracing
+from ..utils import eventlog, tracing
 from .database import KVStore, NodeDatabase
 
 
@@ -498,6 +498,16 @@ class CoalescingUniquenessProvider(UniquenessProvider):
             self.batches += 1
             self.commits += len(batch)
             self.largest_batch = max(self.largest_batch, len(batch))
+            # fan-in event mirroring the fan-in span: visible under every
+            # waiting flow's trace in /logs?trace=<id>
+            eventlog.emit(
+                "info", "notary", "group commit",
+                trace_ids={
+                    c.trace_id for _, _, _, c, _ in batch if c is not None
+                },
+                batch=len(batch),
+                wall_ms=round((time.perf_counter() - t0) * 1000, 3),
+            )
             for (*_, fut), result in zip(batch, results):
                 fut.set_result(result)
 
@@ -564,12 +574,24 @@ class NotaryService:
                     self.identity.name, "notary.conflict",
                     tx_id=tx_id.bytes.hex(), inputs=len(inputs),
                 )
+            eventlog.emit(
+                "warning", "notary", "double-spend conflict",
+                tx_id=tx_id.bytes.hex()[:16], inputs=len(inputs),
+                node=self.identity.name,
+            )
             raise NotaryException(e.conflict)
         if audit is not None:
             audit.record_event(
                 self.identity.name, "notary.commit",
                 tx_id=tx_id.bytes.hex(), inputs=len(inputs),
             )
+        # flight recorder: the serving flow's trace context is current
+        # here, so /logs?trace=<id> joins the commit against its trace
+        eventlog.emit(
+            "info", "notary", "transaction committed",
+            tx_id=tx_id.bytes.hex()[:16], inputs=len(inputs),
+            node=self.identity.name,
+        )
         return sigs
 
     def sign(self, tx_id) -> object:

@@ -1,14 +1,23 @@
-"""Per-node operations endpoint: metrics + traces exposition.
+"""Per-node operations endpoint: metrics, traces, flight recorder, health.
 
 The reference exports node metrics over JMX/Jolokia (`Node.kt:305-310`);
 here a MiniWebServer scaffold serves the same registry as Prometheus
-text exposition plus the tracing spine's span trees:
+text exposition plus the tracing spine's span trees and the flight
+recorder's structured event log:
 
     GET /metrics                      Prometheus text format 0.0.4
                                       (rendered from MetricRegistry.snapshot())
     GET /traces/<trace_id>            span tree as JSON (404 when unknown)
     GET /traces/slow?threshold_ms=N   bounded ring of slowest root spans
     GET /traces                       known trace ids + tracer stats
+    GET /logs?level=&component=&trace=&limit=&format=jsonl
+                                      flight-recorder events (filterable;
+                                      `trace=` joins a /traces/<id> trace
+                                      against what the node logged)
+    GET /healthz                      200 while serving + checks pass;
+                                      503 with a JSON cause when
+                                      starting/draining/unhealthy
+    GET /readyz                       200 once traffic may start
 
 Wired into node startup via NodeConfiguration.ops_port (None = off,
 0 = ephemeral port) and into MockNetwork the same way.
@@ -18,9 +27,11 @@ from __future__ import annotations
 import re
 from typing import Dict, Optional, Tuple
 
+from ..utils.eventlog import EventLog, get_event_log
 from ..utils.metrics import MetricRegistry
 from ..utils.miniweb import MiniWebServer, RawResponse
 from ..utils.tracing import Tracer, get_tracer
+from .health import HealthTracker
 
 # -- Prometheus text rendering ----------------------------------------------
 
@@ -101,6 +112,16 @@ def render_prometheus(snapshot: Dict[str, Dict]) -> str:
             samples.append(("_sum", (), snap.get("total", 0.0)))
             samples.append(("_count", (), snap.get("count", 0)))
             family(base + "_seconds", "summary", src, samples)
+        elif mtype == "histogram":
+            # unitless distribution (batch sizes, occupancies): same
+            # quantile-summary shape as timers, no _seconds suffix
+            samples = [
+                ("", (("quantile", q),), snap.get(key))
+                for q, key in _QUANTILES
+            ]
+            samples.append(("_sum", (), snap.get("total", 0.0)))
+            samples.append(("_count", (), snap.get("count", 0)))
+            family(base, "summary", src, samples)
         else:  # unknown/legacy blob: expose numeric fields as one gauge
             samples = [
                 ("", (("field", k),), v)
@@ -116,14 +137,19 @@ def render_prometheus(snapshot: Dict[str, Dict]) -> str:
 # -- the endpoint ------------------------------------------------------------
 
 class OpsServer(MiniWebServer):
-    """Metrics + traces for ONE node's registry (the tracer defaults to
-    the process-global one — per-node in OS-process deployments)."""
+    """Metrics + traces + flight recorder + health for ONE node's
+    registry (tracer and event log default to the process-global ones —
+    per-node in OS-process deployments)."""
 
     def __init__(self, registry: MetricRegistry,
                  tracer: Optional[Tracer] = None,
+                 health: Optional[HealthTracker] = None,
+                 event_log: Optional[EventLog] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
         self._tracer = tracer
+        self.health = health
+        self._event_log = event_log
         super().__init__(host=host, port=port)
 
     @property
@@ -134,10 +160,45 @@ class OpsServer(MiniWebServer):
         serving the stale one."""
         return self._tracer or get_tracer()
 
+    @property
+    def event_log(self) -> EventLog:
+        """Same dynamic-resolution rule as the tracer."""
+        return self._event_log or get_event_log()
+
     def handle(self, method: str, path: str, query: Dict[str, str],
                body) -> Tuple[int, object]:
         if method != "GET":
             raise KeyError(path)
+        if path == "/healthz":
+            if self.health is None:
+                return 200, {"status": "ok", "checks": {}}
+            return self.health.healthz()
+        if path == "/readyz":
+            if self.health is None:
+                return 200, {"status": "ready", "checks": {}}
+            return self.health.readyz()
+        if path == "/logs":
+            limit = query.get("limit")
+            try:
+                limit = int(limit) if limit is not None else None
+            except ValueError:
+                # client error, not a server fault: 400, never a 500
+                return 400, {"error": f"limit must be an integer: {limit!r}"}
+            filters = {
+                "level": query.get("level"),
+                "component": query.get("component"),
+                "trace": query.get("trace"),
+                "limit": limit,
+            }
+            if query.get("format") == "jsonl":
+                return 200, RawResponse(
+                    self.event_log.to_jsonl(**filters),
+                    "application/jsonl; charset=utf-8",
+                )
+            return 200, {
+                "events": self.event_log.records(**filters),
+                **self.event_log.stats(),
+            }
         if path == "/metrics":
             return 200, RawResponse(
                 render_prometheus(self.registry.snapshot()),

@@ -532,6 +532,12 @@ class RaftNode:
             logger.info(
                 "%s became leader (term %d)", self.node_id, self.current_term
             )
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "info", "raft", "became leader",
+                member=self.node_id, term=self.current_term,
+            )
             self.role = LEADER
             self.leader_id = self.node_id
             self.next_index = {p: self.last_index() + 1 for p in self.peer_ids}

@@ -88,4 +88,11 @@ class SchedulerService:
                 flow = cls(*args)
                 handle = self._smm.start_flow(flow, *args)
                 started.append(handle.flow_id)
+        if started:
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "info", "scheduler", "scheduled activities fired",
+                fired=len(started),
+            )
         return started

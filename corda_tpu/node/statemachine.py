@@ -43,7 +43,7 @@ from ..core.flows.api import (
 )
 from ..core.identity import Party
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import tracing
+from ..utils import eventlog, tracing
 from ..utils.metrics import MetricRegistry
 from .session import (
     SESSION_TOPIC,
@@ -170,6 +170,16 @@ class FlowStateMachine:
             node=self.smm.our_identity.name,
             responder=self.is_responder,
         )
+        # flight recorder: the start event carries the flow's own trace
+        # context (activated explicitly — _run establishes it only for
+        # the generator steps)
+        with tracing.activate(self._trace_ctx):
+            eventlog.emit(
+                "info", "statemachine", "flow started",
+                flow=self.flow.flow_name(), flow_id=self.flow_id,
+                node=self.smm.our_identity.name,
+                responder=self.is_responder,
+            )
         self._gen = _as_generator(self.flow)
         self._run(feed=None, first=True)
 
@@ -559,6 +569,11 @@ class FlowStateMachine:
         self.logger.info(
             "flow %s completed", self.flow.flow_name(),
         )
+        eventlog.emit(
+            "info", "statemachine", "flow completed",
+            flow=self.flow.flow_name(), flow_id=self.flow_id,
+            node=self.smm.our_identity.name,
+        )
         self._unpark_span()
         if self._span is not None:
             self._span.finish()
@@ -570,6 +585,12 @@ class FlowStateMachine:
         self.done = True
         self.logger.warning(
             "flow %s failed: %s", self.flow.flow_name(), exc,
+        )
+        eventlog.emit(
+            "warning", "statemachine", "flow failed",
+            flow=self.flow.flow_name(), flow_id=self.flow_id,
+            node=self.smm.our_identity.name,
+            error=f"{type(exc).__name__}: {exc}",
         )
         self._unpark_span()
         if self._span is not None:

@@ -199,6 +199,9 @@ def _bucket(n: int) -> int:
 
 _L_WORDS = np.frombuffer(F.L_INT.to_bytes(32, "little"), np.uint32)
 
+#: padded batch shapes already seen (each new one = one XLA compile)
+_SEEN_SHAPES: set = set()
+
 
 def prepare_batch(
     public_keys: Sequence[bytes],
@@ -215,6 +218,13 @@ def prepare_batch(
     """
     n = len(public_keys)
     size = pad_to if pad_to is not None else _bucket(max(n, 1))
+    if size not in _SEEN_SHAPES:
+        # each distinct padded shape costs one XLA compile downstream;
+        # the ops endpoint exports the count as Jax.CompileCount
+        _SEEN_SHAPES.add(size)
+        from ..utils import profiling
+
+        profiling.record_compile("ed25519.batch_shape")
     y_a = np.zeros((size, F.NLIMB), np.uint32)
     y_r = np.zeros((size, F.NLIMB), np.uint32)
     sign_a = np.zeros(size, np.uint32)

@@ -445,3 +445,31 @@ class CordaRPCOps:
         from ..utils.tracing import get_tracer
 
         return get_tracer().slow_roots(threshold_ms)
+
+    def node_logs(self, level: Optional[str] = None,
+                  component: Optional[str] = None,
+                  trace: Optional[str] = None,
+                  limit: Optional[int] = 200) -> Dict:
+        """Flight-recorder events (the RPC twin of GET /logs): filter by
+        minimum level, component, or trace id — `trace` is what joins a
+        node_trace() tree against what the node logged while it ran."""
+        from ..utils.eventlog import get_event_log
+
+        log = get_event_log()
+        return {
+            "events": log.records(
+                level=level, component=component, trace=trace, limit=limit
+            ),
+            **log.stats(),
+        }
+
+    def node_health(self) -> Dict:
+        """The /healthz view over RPC: lifecycle state + per-component
+        checks ({"status": "ok" | "unavailable" | "unhealthy", ...})."""
+        # AbstractNode hangs its HealthTracker off the service hub so
+        # the RPC layer (which never sees the node object) can reach it
+        health = getattr(self._services, "health", None)
+        if health is None:
+            return {"status": "unknown", "checks": {}}
+        _, body = health.healthz()
+        return body

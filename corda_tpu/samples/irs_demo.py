@@ -395,6 +395,11 @@ def main(verbose: bool = True) -> dict:
     from ..testing.mocknetwork import MockNetwork
 
     def log(msg):
+        # demo progress is console UX AND an operational event: the
+        # print is the UI, the emit keeps the flight recorder complete
+        from ..utils import eventlog
+
+        eventlog.emit("info", "irs_demo", msg)
         if verbose:
             print(f"[irs-demo] {msg}")
 

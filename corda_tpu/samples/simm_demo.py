@@ -587,6 +587,11 @@ def main(verbose: bool = True) -> dict:
     from ..testing.mocknetwork import MockNetwork
 
     def log(msg):
+        # print is the demo's UI; the emit keeps the flight recorder
+        # complete (nothing bypasses it, verbose or not)
+        from ..utils import eventlog
+
+        eventlog.emit("info", "simm_demo", msg)
         if verbose:
             print(f"[simm-demo] {msg}")
 

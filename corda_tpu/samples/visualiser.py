@@ -135,14 +135,19 @@ def main(argv=None) -> dict:
         ap.add_argument("--json", action="store_true")
         ap.add_argument("--latency", type=float, default=None)
         web_args = ap.parse_args(argv)
+        from ..utils import eventlog
+
         server = WebVisualiser(port=web_args.web)
-        print(
+        ready = (
             f"visualiser ready at http://127.0.0.1:{server.port}/ "
-            "(running the IRS simulation...)",
-            flush=True,
+            "(running the IRS simulation...)"
         )
+        print(ready, flush=True)  # launcher protocol line
+        eventlog.emit("info", "visualiser", ready)
         server.run_simulation()
-        print(f"simulation recorded: {len(server._events)} events", flush=True)
+        recorded = f"simulation recorded: {len(server._events)} events"
+        print(recorded, flush=True)
+        eventlog.emit("info", "visualiser", recorded)
         import time as _time
 
         try:

@@ -1,0 +1,252 @@
+"""Per-node flight recorder: a bounded structured event log.
+
+The tracing spine (utils/tracing.py) answers "which hop ate the time for
+THIS request"; metrics answer "what are the aggregate rates". Neither
+answers "what was the node DOING while that slow trace ran" — the
+question an operator asks first when a node misbehaves under load. This
+module keeps the answer in-process: JSON-lines-shaped records
+{ts, level, component, message, trace_id, span_id, ...fields} in a
+bounded ring buffer, served at `GET /logs` on the ops endpoint and
+filterable by level / component / trace id, so a trace retrieved from
+`/traces/<id>` joins against what the node logged while it ran.
+
+Two producer paths feed one buffer:
+
+  * `emit(level, component, message, **fields)` — the structured API the
+    node's own components call on the events that matter operationally
+    (flow start/finish, batch flushes, group commits, leader changes).
+    The current tracing context is captured at emit time, which is what
+    makes `/logs?trace=<id>` correlation work with zero plumbing.
+  * a stdlib `logging` bridge (`install_stdlib_bridge`) on the
+    `corda_tpu` logger hierarchy, so every existing `logger.warning(...)`
+    in raft/bft/networkmap/registration/flows lands in the recorder too
+    — nothing bypasses the flight recorder just because it predates it.
+
+Like the tracer, the default log is process-global: one per OS process
+IS "per node" in real deployments, and MockNetwork's in-process nodes
+share it (their events still separate by `component` and `node` field).
+
+Env knobs: CORDA_TPU_EVENTLOG_MAX bounds the ring (default 4096);
+CORDA_TPU_EVENTLOG_LEVEL sets the minimum recorded severity (default
+"info" — raft/bft debug chatter stays out of the ring unless asked for);
+CORDA_TPU_EVENTLOG=0 disables recording entirely.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+from . import tracing
+
+#: severity order for minimum-level filtering
+LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40,
+          "critical": 50}
+
+#: cap on per-event extra trace ids (a 4096-item verifier flush must not
+#: fan an event out under 4096 traces, mirroring Tracer.MAX_LINKS)
+MAX_EVENT_LINKS = 64
+
+
+def _level_no(level: str) -> int:
+    return LEVELS.get(level, LEVELS["info"])
+
+
+class EventLog:
+    """Thread-safe bounded ring of structured events for one node."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 min_level: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("CORDA_TPU_EVENTLOG_MAX", 4096))
+        if min_level is None:
+            min_level = os.environ.get(
+                "CORDA_TPU_EVENTLOG_LEVEL", "info"
+            ).lower()
+        if enabled is None:
+            enabled = os.environ.get("CORDA_TPU_EVENTLOG", "1") != "0"
+        self.capacity = capacity
+        self.min_level = min_level
+        self.enabled = enabled
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._emitted = 0
+        self._by_level: Dict[str, int] = {}
+
+    # -- producer side ------------------------------------------------------
+
+    def emit(self, level: str, component: str, message: str,
+             trace_ids: Iterable[str] = (), **fields) -> None:
+        """Record one event. The thread-local tracing context (if any) is
+        stamped on as trace_id/span_id; `trace_ids` adds EXTRA trace ids
+        for fan-in events (one batch flush serving many traces), bounded
+        at MAX_EVENT_LINKS."""
+        if not self.enabled:
+            return
+        level = level.lower()
+        if _level_no(level) < _level_no(self.min_level):
+            return
+        event: Dict = {
+            "ts": round(time.time(), 6),
+            "level": level,
+            "component": component,
+            "message": message,
+        }
+        ctx = tracing.current_context()
+        if ctx is not None:
+            event["trace_id"] = ctx.trace_id
+            event["span_id"] = ctx.span_id
+        links = [t for t in trace_ids if t][:MAX_EVENT_LINKS]
+        if links:
+            event["trace_ids"] = links
+        if fields:
+            event.update(fields)
+        with self._lock:
+            self._ring.append(event)
+            self._emitted += 1
+            self._by_level[level] = self._by_level.get(level, 0) + 1
+
+    # -- consumer side ------------------------------------------------------
+
+    def records(self, level: Optional[str] = None,
+                component: Optional[str] = None,
+                trace: Optional[str] = None,
+                limit: Optional[int] = None) -> List[Dict]:
+        """Filtered view, oldest first. `level` is a MINIMUM severity;
+        `trace` matches the event's own trace_id or any fan-in trace id;
+        `limit` keeps the newest N after filtering."""
+        with self._lock:
+            events = list(self._ring)
+        if level is not None:
+            floor = _level_no(level.lower())
+            events = [e for e in events if _level_no(e["level"]) >= floor]
+        if component is not None:
+            events = [e for e in events if e["component"] == component]
+        if trace is not None:
+            events = [
+                e for e in events
+                if e.get("trace_id") == trace
+                or trace in e.get("trace_ids", ())
+            ]
+        if limit is not None and limit >= 0:
+            events = events[-limit:]
+        return events
+
+    def to_jsonl(self, **filters) -> str:
+        """The ring (after `records(**filters)`) as JSON-lines text."""
+        return "\n".join(
+            json.dumps(e, default=str) for e in self.records(**filters)
+        ) + "\n"
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "size": len(self._ring),
+                "capacity": self.capacity,
+                "emitted": self._emitted,
+                "dropped": max(0, self._emitted - len(self._ring)),
+                "by_level": dict(self._by_level),
+                "enabled": self.enabled,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._emitted = 0
+            self._by_level.clear()
+
+
+# -- stdlib logging bridge ----------------------------------------------------
+
+class EventLogHandler(logging.Handler):
+    """Bridges `corda_tpu.*` stdlib log records into the flight recorder.
+
+    Component = the logger-name segment after `corda_tpu.` (per-flow
+    loggers `corda_tpu.flow.<uuid>` collapse to component `flow`, the
+    flow id rides as a field instead — per-uuid components would make
+    the component filter useless). Resolves the event log dynamically so
+    a test installing a fresh log (set_event_log) takes effect without
+    re-installing the handler."""
+
+    #: package-layer segments collapsed through to the module name
+    _LAYERS = frozenset(
+        ("node", "utils", "core", "verifier", "messaging", "rpc", "loadtest",
+         "samples", "testing")
+    )
+
+    def emit(self, record: logging.LogRecord) -> None:  # noqa: A003
+        try:
+            parts = record.name.split(".")
+            if parts and parts[0] == "corda_tpu":
+                parts = parts[1:]
+            fields = {}
+            if not parts:
+                component = record.name
+            elif parts[0] == "flow":
+                component = "flow"
+                if len(parts) > 1:
+                    fields["flow_id"] = parts[1]
+            elif parts[0] in self._LAYERS and len(parts) > 1:
+                component = parts[1]
+            else:
+                component = parts[0]
+            get_event_log().emit(
+                record.levelname.lower(), component, record.getMessage(),
+                **fields,
+            )
+        except Exception:
+            pass  # a log record must never take the producer down
+
+
+_install_lock = threading.Lock()
+_bridge_handler: Optional[EventLogHandler] = None
+
+
+def install_stdlib_bridge(capture_info: bool = False) -> None:
+    """Attach the bridge to the `corda_tpu` logger hierarchy (idempotent).
+
+    By default the bridge sees exactly what the host's logging config
+    lets through — it never changes logger levels, so embedding a node
+    in a WARNING-configured application cannot start leaking INFO lines
+    to that application's console (the structured `emit()` calls carry
+    the INFO-grade flight-recorder stream regardless). The standalone
+    node binary passes `capture_info=True` to ALSO pull log-only INFO
+    records into the ring; it compensates by pinning its console
+    handler levels to CORDA_TPU_LOG first (node __main__)."""
+    global _bridge_handler
+    if os.environ.get("CORDA_TPU_EVENTLOG", "1") == "0":
+        return
+    with _install_lock:
+        if _bridge_handler is None:
+            _bridge_handler = EventLogHandler(level=logging.DEBUG)
+            logging.getLogger("corda_tpu").addHandler(_bridge_handler)
+        if capture_info:
+            root = logging.getLogger("corda_tpu")
+            if root.getEffectiveLevel() > logging.INFO:
+                root.setLevel(logging.INFO)
+
+
+# -- process-global default log ----------------------------------------------
+
+_default_log = EventLog()
+
+
+def get_event_log() -> EventLog:
+    return _default_log
+
+
+def set_event_log(log: EventLog) -> EventLog:
+    """Install a fresh event log (tests); returns the previous one."""
+    global _default_log
+    prev, _default_log = _default_log, log
+    return prev
+
+
+def emit(level: str, component: str, message: str, **kwargs) -> None:
+    """Convenience: emit on the process event log."""
+    _default_log.emit(level, component, message, **kwargs)

@@ -197,6 +197,50 @@ class Timer:
         return out
 
 
+class Histogram:
+    """Value distribution over a bounded reservoir (a Timer without the
+    clock/rate machinery): batch sizes, queue depths, occupancies —
+    anything whose shape matters but isn't a duration."""
+
+    RESERVOIR = 1024
+
+    def __init__(self) -> None:
+        self._values: deque = deque(maxlen=self.RESERVOIR)
+        self._count = 0
+        self._total = 0.0  # exact lifetime sum (the reservoir is windowed)
+        self._lock = threading.Lock()
+
+    def update(self, value: float) -> None:
+        with self._lock:
+            self._values.append(value)
+            self._count += 1
+            self._total += value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            xs = sorted(self._values)
+            count, total = self._count, self._total
+        out: Dict = {"type": "histogram", "count": count,
+                     "total": round(total, 6)}
+        if xs:
+            def pct(q: float) -> float:
+                return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+            out.update(
+                min=round(xs[0], 6),
+                max=round(xs[-1], 6),
+                mean=round(sum(xs) / len(xs), 6),
+                p50=round(pct(0.50), 6),
+                p95=round(pct(0.95), 6),
+                p99=round(pct(0.99), 6),
+            )
+        return out
+
+
 class MetricRegistry:
     """Name -> metric map with get-or-create accessors and a JSON-able
     snapshot (the export seam: RPC `node_metrics` + webserver /metrics)."""
@@ -226,6 +270,9 @@ class MetricRegistry:
     def timer(self, name: str) -> Timer:
         return self._get_or_create(name, Timer, Timer)
 
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram, Histogram)
+
     def gauge(self, name: str, fn: Optional[Callable[[], float]] = None) -> Gauge:
         if fn is None:
             with self._lock:
@@ -253,8 +300,12 @@ class MetricRegistry:
             return sorted(self._metrics)
 
     def snapshot(self) -> Dict[str, Dict]:
+        """Sorted by metric name: registration order varies per node
+        lifecycle (gauges re-register, services start lazily), and the
+        snapshot feeds Prometheus exposition + JSON diffs that must be
+        deterministic across calls and across nodes."""
         with self._lock:
-            items = list(self._metrics.items())
+            items = sorted(self._metrics.items())
         return {name: m.snapshot() for name, m in items}
 
 

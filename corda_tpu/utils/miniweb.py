@@ -48,6 +48,20 @@ class MiniWebServer:
             def log_message(self, fmt, *args):
                 pass
 
+            def send_error(self, code, message=None, explain=None):
+                # stdlib-generated failures (unsupported method, bad
+                # request line) default to an HTML error page; the module
+                # contract is a JSON body with a JSON Content-Type on
+                # EVERY error, whoever raised it
+                try:
+                    self._json(code, {
+                        "error": message or self.responses.get(
+                            code, ("error",)
+                        )[0],
+                    })
+                except Exception:
+                    pass  # client already gone: nothing to tell it
+
             def _json(self, code: int, value) -> None:
                 body = json.dumps(value).encode()
                 self.send_response(code)

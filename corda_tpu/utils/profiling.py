@@ -18,7 +18,8 @@ import cProfile
 import io
 import os
 import pstats
-from typing import Callable, List, Tuple
+import threading
+from typing import Callable, Dict, List, Tuple
 
 _DIR = os.environ.get("CORDA_TPU_PROFILE_DUMP")
 #: CPython 3.12 cProfile claims the process-wide sys.monitoring profiler
@@ -64,6 +65,65 @@ def try_claim_thread_profile(name: str) -> None:
     except ValueError:
         return  # slot already claimed (another pool worker won)
     _PROFILES.append((name, prof))
+
+
+# -- device-dispatch telemetry -----------------------------------------------
+# Always-on (unlike cProfile, the cost is one dict update per BATCH, not
+# per call): the batch-kernel seams record every device/host dispatch and
+# every shape compile here, and the ops endpoint's /metrics exports the
+# aggregate — the "is the accelerator the bottleneck" health signal.
+
+_dispatch_lock = threading.Lock()
+_dispatch_stats: Dict[str, Dict[str, float]] = {}
+_compile_counts: Dict[str, int] = {}
+
+
+def record_dispatch(name: str, seconds: float) -> None:
+    """One batch-kernel dispatch of `name` took `seconds` wall time."""
+    with _dispatch_lock:
+        s = _dispatch_stats.get(name)
+        if s is None:
+            s = _dispatch_stats[name] = {
+                "count": 0, "total_s": 0.0, "max_s": 0.0,
+            }
+        s["count"] += 1
+        s["total_s"] += seconds
+        s["max_s"] = max(s["max_s"], seconds)
+
+
+def record_compile(name: str) -> None:
+    """A kernel shape for `name` was (re)compiled — each distinct padded
+    batch shape costs one XLA compile; a climbing count under steady load
+    means the shape bucketing is broken."""
+    with _dispatch_lock:
+        _compile_counts[name] = _compile_counts.get(name, 0) + 1
+
+
+def dispatch_snapshot() -> Dict[str, Dict]:
+    """{kernel: {count, total_s, max_s, mean_ms}} plus compile counts."""
+    with _dispatch_lock:
+        out = {
+            name: {
+                "count": int(s["count"]),
+                "total_s": round(s["total_s"], 6),
+                "max_s": round(s["max_s"], 6),
+                "mean_ms": round(s["total_s"] / s["count"] * 1000, 3)
+                if s["count"] else 0.0,
+            }
+            for name, s in _dispatch_stats.items()
+        }
+        compiles = dict(_compile_counts)
+    return {"dispatch": out, "compiles": compiles}
+
+
+def dispatch_totals() -> Tuple[int, int, float]:
+    """(total dispatches, total compiles, total dispatch wall seconds) —
+    the gauge-friendly scalars."""
+    with _dispatch_lock:
+        n = sum(int(s["count"]) for s in _dispatch_stats.values())
+        wall = sum(s["total_s"] for s in _dispatch_stats.values())
+        c = sum(_compile_counts.values())
+    return n, c, wall
 
 
 def _dump() -> None:

@@ -78,6 +78,57 @@ class SignatureBatcher:
         self.largest_batch = 0
         self.handoffs = 0  # buffers drained by the flush thread
         self.flush_wall_s = 0.0  # cumulative wall time inside verify
+        # backpressure telemetry: cumulative time handed-off buffers
+        # waited before the flush thread picked them up (flush-thread
+        # lag — the queueing signal the committee-consensus measurements
+        # say precedes a throughput collapse), plus an optional registry
+        # binding for the gauges/histograms
+        self.flush_lag_s = 0.0
+        self._registry = None
+
+    def bind_metrics(self, registry) -> None:
+        """Register this batcher's occupancy/lag instruments on a node's
+        MetricRegistry (gauge re-registration replaces stale closures, so
+        a recreated batcher can bind to the same names)."""
+        self._registry = registry
+        registry.gauge("Verifier.BatcherOccupancy",
+                       lambda: self.pending_count)
+        registry.gauge("Verifier.BatcherQueuedBatches",
+                       lambda: self.queued_batches)
+        registry.gauge("Verifier.BatcherInFlight", lambda: self.in_flight)
+        registry.gauge("Verifier.BatcherFlushLagSeconds",
+                       lambda: round(self.oldest_queued_age_s, 6))
+        registry.histogram("Verifier.BatchSize")
+
+    # -- backpressure read surface -----------------------------------------
+
+    @property
+    def pending_count(self) -> int:
+        """Items in the fill buffer (not yet handed to the flush thread)."""
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def queued_batches(self) -> int:
+        """Buffers handed off but not yet picked up by the flush thread."""
+        with self._lock:
+            return len(self._flush_queue)
+
+    @property
+    def in_flight(self) -> int:
+        """Batches being verified right now."""
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def oldest_queued_age_s(self) -> float:
+        """Age of the oldest handed-off buffer still waiting for the
+        flush thread — the live flush-thread-lag reading (0 when the
+        queue is empty)."""
+        with self._lock:
+            if not self._flush_queue:
+                return 0.0
+            return time.monotonic() - self._flush_queue[0][0]
 
     def submit(self, item: Item) -> Future:
         """Queue one signature check; resolves to bool."""
@@ -123,7 +174,9 @@ class SignatureBatcher:
         batch, self._pending = self._pending, []
         if not batch:
             return
-        self._flush_queue.append(batch)
+        # enqueue timestamp rides along: the flush thread's pickup delay
+        # is the flush-lag backpressure signal
+        self._flush_queue.append((time.monotonic(), batch))
         self.handoffs += 1
         if self._flush_thread is None or not self._flush_thread.is_alive():
             self._flush_thread = threading.Thread(
@@ -140,7 +193,8 @@ class SignatureBatcher:
                     self._cv.wait()
                 if not self._flush_queue:
                     return  # closed and drained
-                batch = self._flush_queue.popleft()
+                t_queued, batch = self._flush_queue.popleft()
+                self.flush_lag_s += time.monotonic() - t_queued
                 self._in_flight += 1
             try:
                 self._run_batch(batch)
@@ -162,14 +216,33 @@ class SignatureBatcher:
             results = crypto_batch.verify_batch(items)
         except Exception as exc:  # propagate to every waiter
             sp.finish(error=exc)
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "error", "verifier", "signature batch failed",
+                trace_ids={c.trace_id for _, _, c in batch if c is not None},
+                items=len(batch), error=f"{type(exc).__name__}: {exc}",
+            )
             for _, fut, _ in batch:
                 fut.set_exception(exc)
             return
         sp.finish()
-        self.flush_wall_s += time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        self.flush_wall_s += wall
         self.flushes += 1
         self.items_verified += len(batch)
         self.largest_batch = max(self.largest_batch, len(batch))
+        if self._registry is not None:
+            self._registry.histogram("Verifier.BatchSize").update(len(batch))
+        # flight recorder: one event per flush, fanned under every trace
+        # the batch served so /logs?trace=<id> shows the shared flush
+        from ..utils import eventlog
+
+        eventlog.emit(
+            "info", "verifier", "signature batch verified",
+            trace_ids={c.trace_id for _, _, c in batch if c is not None},
+            items=len(batch), wall_ms=round(wall * 1000, 3),
+        )
         for (_, fut, _), ok in zip(batch, results):
             fut.set_result(bool(ok))
 
@@ -204,7 +277,9 @@ class SignatureBatcher:
                 if stranded is None:
                     self._cv.wait(timeout=0.05)
                     continue
-            self._run_batch(stranded)
+                t_queued, stranded_batch = stranded
+                self.flush_lag_s += time.monotonic() - t_queued
+            self._run_batch(stranded_batch)
 
     def close(self) -> None:
         # Refuse new work first, then drain: a submit racing with close

@@ -58,6 +58,12 @@ class TransactionVerifierService:
         producer can feed the batch (deterministic single-pump networks)
         use this to skip the batcher's linger wait; a no-op by default."""
 
+    def healthcheck(self) -> dict:
+        """Cheap readiness detail for the node's /healthz//readyz
+        aggregation: `ok` False means the verifier backend cannot accept
+        work right now."""
+        return {"ok": True, "backend": type(self).__name__}
+
 
 class InMemoryTransactionVerifierService(TransactionVerifierService):
     """Worker pool in the node process; signature checks go through a local
@@ -96,6 +102,14 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
 
     def flush_signatures(self) -> None:
         self._batcher.flush()
+
+    def healthcheck(self) -> dict:
+        return {
+            "ok": not self._batcher._closed,
+            "backend": "in-memory",
+            "batcher_occupancy": self._batcher.pending_count,
+            "batcher_queued_batches": self._batcher.queued_batches,
+        }
 
     def stop(self) -> None:
         self._batcher.close()
@@ -269,6 +283,14 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             return
         for fut, ok in zip(futures, resp.valid):
             fut.set_result(bool(ok))
+
+    def healthcheck(self) -> dict:
+        return {
+            "ok": not self._stop.is_set() and self._thread.is_alive(),
+            "backend": "out-of-process",
+            "workers": self.worker_count(),
+            "in_flight": len(self._pending),
+        }
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,638 @@
+"""Node flight recorder: structured event log, /logs trace correlation,
+health/readiness probes, backpressure telemetry, and the bench
+regression gate (docs/observability.md).
+
+Covers: the bounded event-log ring + filters + stdlib-logging bridge;
+trace-id correlation between /traces/<id> and /logs?trace=<id> on a
+MockNetwork notarised transaction (events from >= 3 components);
+/healthz per-component detail and the 503 drain flip; /readyz before
+and after the verifier backend is up; the broker queue-depth gauge
+under a paused consumer; batcher occupancy/lag instruments; and
+tools/bench_gate.py failing on a synthetic stage-timing regression.
+"""
+import json
+import logging
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from corda_tpu.utils import eventlog, tracing
+from corda_tpu.utils.eventlog import EventLog
+from corda_tpu.utils.tracing import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fresh_log():
+    prev = eventlog.set_event_log(EventLog())
+    yield eventlog.get_event_log()
+    eventlog.set_event_log(prev)
+
+
+@pytest.fixture()
+def tracer():
+    prev = tracing.set_tracer(Tracer())
+    yield tracing.get_tracer()
+    tracing.set_tracer(prev)
+
+
+# ---------------------------------------------------------------------------
+# EventLog mechanics
+# ---------------------------------------------------------------------------
+
+class TestEventLog:
+    def test_ring_is_bounded_and_counts_drops(self):
+        log = EventLog(capacity=8)
+        for i in range(20):
+            log.emit("info", "test", f"m{i}")
+        assert len(log.records()) == 8
+        stats = log.stats()
+        assert stats["emitted"] == 20
+        assert stats["dropped"] == 12
+        # oldest dropped: the ring keeps the newest
+        assert log.records()[0]["message"] == "m12"
+
+    def test_level_floor_and_filters(self):
+        log = EventLog(capacity=64, min_level="info")
+        log.emit("debug", "a", "below the floor")
+        log.emit("info", "a", "hello")
+        log.emit("warning", "b", "uh oh")
+        assert len(log.records()) == 2  # debug never recorded
+        assert [e["message"] for e in log.records(level="warning")] == ["uh oh"]
+        assert [e["component"] for e in log.records(component="a")] == ["a"]
+        assert log.records(limit=1)[0]["message"] == "uh oh"
+
+    def test_trace_context_captured_and_fan_in_matchable(self, tracer):
+        log = EventLog(capacity=64)
+        with tracer.span("op") as sp:
+            log.emit("info", "test", "inside the span")
+        tid = sp.context.trace_id
+        [event] = log.records(trace=tid)
+        assert event["trace_id"] == tid
+        assert event["span_id"] == sp.context.span_id
+        # fan-in events match through trace_ids too
+        log.emit("info", "batch", "served many", trace_ids=[tid, "f" * 32])
+        assert len(log.records(trace=tid)) == 2
+        assert len(log.records(trace="f" * 32)) == 1
+
+    def test_jsonl_rendering(self):
+        log = EventLog(capacity=8)
+        log.emit("info", "test", "one", n=1)
+        lines = [
+            json.loads(line) for line in log.to_jsonl().strip().splitlines()
+        ]
+        assert lines[0]["message"] == "one" and lines[0]["n"] == 1
+
+    def test_stdlib_bridge_components(self, fresh_log):
+        eventlog.install_stdlib_bridge()
+        logging.getLogger("corda_tpu.raft").warning("lost leader")
+        logging.getLogger("corda_tpu.node.scheduler").warning("dropped")
+        logging.getLogger("corda_tpu.flow.abc123").warning("flow warn")
+        logging.getLogger("corda_tpu.raft").critical("meltdown")
+        comps = {e["component"]: e for e in fresh_log.records()}
+        assert "raft" in comps
+        assert "scheduler" in comps
+        assert comps["flow"]["flow_id"] == "abc123"
+        # CRITICAL outranks error in the minimum-severity filter
+        [worst] = fresh_log.records(level="critical")
+        assert worst["message"] == "meltdown"
+        assert fresh_log.records(level="error") == [worst]
+
+    def test_stdlib_bridge_does_not_change_library_log_levels(self, fresh_log):
+        # embedding a node must not start leaking INFO to a
+        # WARNING-configured console: the bridge leaves logger levels
+        # alone unless capture_info (the node binary) asks
+        root = logging.getLogger("corda_tpu")
+        prev = root.level
+        try:
+            root.setLevel(logging.WARNING)
+            eventlog.install_stdlib_bridge()
+            assert root.level == logging.WARNING
+            eventlog.install_stdlib_bridge(capture_info=True)
+            assert root.getEffectiveLevel() == logging.INFO
+        finally:
+            root.setLevel(prev)
+
+    def test_disabled_log_records_nothing(self):
+        log = EventLog(capacity=8, enabled=False)
+        log.emit("error", "test", "nope")
+        assert log.records() == []
+
+
+# ---------------------------------------------------------------------------
+# MetricRegistry histogram family + deterministic snapshots
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    def test_histogram_bounded_percentiles(self):
+        from corda_tpu.utils.metrics import Histogram, MetricRegistry
+
+        reg = MetricRegistry()
+        h = reg.histogram("batch.sizes")
+        assert reg.histogram("batch.sizes") is h
+        for i in range(Histogram.RESERVOIR + 100):
+            h.update(i)
+        snap = h.snapshot()
+        assert snap["type"] == "histogram"
+        assert snap["count"] == Histogram.RESERVOIR + 100
+        assert len(h._values) == Histogram.RESERVOIR
+        assert snap["min"] <= snap["p50"] <= snap["p95"] <= snap["max"]
+        with pytest.raises(TypeError):
+            reg.timer("batch.sizes")
+
+    def test_snapshot_order_is_deterministic(self):
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        a, b = MetricRegistry(), MetricRegistry()
+        a.counter("zz").inc()
+        a.histogram("aa").update(1)
+        b.histogram("aa").update(1)
+        b.counter("zz").inc()  # reverse registration order
+        assert list(a.snapshot()) == list(b.snapshot()) == ["aa", "zz"]
+
+    def test_histogram_renders_as_prometheus_summary(self):
+        from corda_tpu.node.opsserver import render_prometheus
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        reg = MetricRegistry()
+        reg.histogram("Verifier.BatchSize").update(17)
+        text = render_prometheus(reg.snapshot())
+        assert "# TYPE corda_tpu_verifier_batch_size summary" in text
+        assert 'corda_tpu_verifier_batch_size{quantile="0.5"} 17' in text
+        assert "corda_tpu_verifier_batch_size_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: trace <-> log correlation + health on a MockNetwork node
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestFlightRecorderEndToEnd:
+    def setup_method(self):
+        self._prev_tracer = tracing.set_tracer(Tracer())
+        self._prev_log = eventlog.set_event_log(EventLog())
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        self.net = MockNetwork()
+        self.notary = self.net.create_notary_node(validating=True)
+        self.alice = self.net.create_node(
+            "O=RecAlice,L=London,C=GB", ops_port=0
+        )
+        self.bob = self.net.create_node("O=RecBob,L=Paris,C=FR")
+
+    def teardown_method(self):
+        self.net.stop_nodes()
+        tracing.set_tracer(self._prev_tracer)
+        eventlog.set_event_log(self._prev_log)
+
+    def _run_payment(self) -> str:
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.rpc import CordaRPCOps
+
+        ops = CordaRPCOps(self.alice.services, self.alice.smm)
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashIssueFlow",
+            Amount(1000, "USD"), (1,), self.alice.info, self.notary.info,
+        )
+        self.net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+        token = Issued(self.alice.info.ref(1), "USD")
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashPaymentFlow",
+            Amount(400, token), self.bob.info, self.notary.info,
+        )
+        self.net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+        tracer = self.net.tracer
+        for tid in tracer.trace_ids():
+            if any(
+                "CashPaymentFlow" in str(s["tags"].get("flow", ""))
+                for s in tracer.get_trace(tid)
+            ):
+                return tid
+        raise AssertionError("no trace contains the payment flow")
+
+    def test_logs_correlate_with_trace_across_components(self):
+        tid = self._run_payment()
+        port = self.alice.ops_server.port
+        # the trace exists...
+        status, tree = _get(port, f"/traces/{tid}")
+        assert tree["span_count"] >= 4
+        # ...and /logs?trace= joins >= 3 components against it
+        status, logs = _get(port, f"/logs?trace={tid}")
+        components = {e["component"] for e in logs["events"]}
+        assert len(components) >= 3, components
+        assert {"statemachine", "verifier", "notary"} <= components
+        # every returned event really references the trace
+        for e in logs["events"]:
+            assert e.get("trace_id") == tid or tid in e.get("trace_ids", ())
+        # component + level filters narrow the same view
+        status, only_notary = _get(
+            port, f"/logs?trace={tid}&component=notary"
+        )
+        assert only_notary["events"]
+        assert all(
+            e["component"] == "notary" for e in only_notary["events"]
+        )
+        # a malformed limit is the CLIENT's error: 400, not 500
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/logs?limit=abc")
+        assert err.value.code == 400
+        # jsonl rendering serves raw lines
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/logs?format=jsonl&limit=5", timeout=5
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith("application/jsonl")
+            lines = resp.read().decode().strip().splitlines()
+        assert 0 < len(lines) <= 5
+        json.loads(lines[0])
+
+    def test_healthz_detail_and_drain_flip(self):
+        self._run_payment()
+        port = self.alice.ops_server.port
+        status, body = _get(port, "/healthz")
+        assert status == 200 and body["status"] == "ok"
+        # per-component detail is present
+        assert {"messaging", "verifier", "statemachine"} <= set(body["checks"])
+        assert body["checks"]["verifier"]["ok"] is True
+        assert "flows_in_flight" in body["checks"]["statemachine"]
+        # draining (without teardown) flips both probes to 503
+        self.alice.drain()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/healthz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert payload["cause"] == "node is draining"
+        assert err.value.headers["Content-Type"] == "application/json"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/readyz")
+        assert err.value.code == 503
+
+    def test_readyz_before_and_after_verifier_backend(self):
+        from corda_tpu.node.opsserver import OpsServer
+
+        port = self.alice.ops_server.port
+        status, body = _get(port, "/readyz")
+        assert status == 200 and body["status"] == "ready"
+        assert body["checks"]["verifier"]["ok"] is True
+        # kill the verifier backend: readiness must drop with the cause
+        self.alice.services.transaction_verifier_service.stop()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/readyz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert payload["checks"]["verifier"]["ok"] is False
+        assert "verifier" in payload["cause"]
+        # a node still STARTING (never marked serving) is not ready even
+        # with healthy components: probe a fresh tracker via OpsServer
+        from corda_tpu.node.health import HealthTracker
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        starting = OpsServer(MetricRegistry(), health=HealthTracker())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(starting.port, "/readyz")
+            assert err.value.code == 503
+            assert json.loads(err.value.read())["cause"] == "node is starting"
+        finally:
+            starting.stop()
+
+    def test_backpressure_gauges_in_metrics_snapshot(self):
+        self._run_payment()
+        snap = self.alice.smm.metrics.snapshot()
+        assert snap["P2P.QueueDepth"]["value"] == 0  # quiescent network
+        assert snap["Verifier.BatcherOccupancy"]["value"] == 0
+        assert snap["Flows.InFlight"]["value"] == 0
+        assert "Jax.Backend" in snap and "Jax.CompileCount" in snap
+        # at least one node's batcher flushed a real batch (whichever
+        # party performed the signature checks)
+        flushed = sum(
+            n.metrics.snapshot().get("Verifier.BatchSize", {}).get("count", 0)
+            for n in self.net.nodes
+        )
+        assert flushed >= 1
+
+
+# ---------------------------------------------------------------------------
+# Broker queue depth under a paused consumer
+# ---------------------------------------------------------------------------
+
+class TestBrokerQueueDepth:
+    def test_gauge_climbs_while_consumer_paused_and_drains_on_start(self):
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.network import BrokerMessagingService
+        from corda_tpu.node.node import AbstractNode, NodeConfiguration
+
+        broker = Broker()
+        node = AbstractNode(
+            NodeConfiguration(
+                my_legal_name="O=Depth,L=London,C=GB", identity_entropy=77,
+            ),
+            messaging_factory=lambda me: BrokerMessagingService(broker, me),
+            broker=broker,
+        )
+        try:
+            # the node is constructed but NOT started: its p2p pump (the
+            # queue's only consumer) is paused, so sends pile up
+            for _ in range(5):
+                broker.send(
+                    f"p2p.inbound.{node.info.name}", b"x",
+                    {"topic": "noop"},
+                )
+            snap = node.metrics.snapshot()
+            assert snap["P2P.QueueDepth"]["value"] == 5
+            # health check surfaces the same backlog
+            _, body = node.health.healthz()
+            assert body["checks"]["messaging"]["queue_depth"] == 5
+            # starting the pump drains it
+            node.start()
+            import time
+
+            for _ in range(100):
+                if node.network.queue_depth() == 0:
+                    break
+                time.sleep(0.05)
+            assert node.metrics.snapshot()["P2P.QueueDepth"]["value"] == 0
+        finally:
+            node.stop()
+            broker.close()
+
+
+# ---------------------------------------------------------------------------
+# Batcher occupancy / flush-lag instruments
+# ---------------------------------------------------------------------------
+
+class TestBatcherBackpressure:
+    def test_occupancy_and_lag_telemetry(self):
+        from corda_tpu.core.crypto import crypto
+        from corda_tpu.utils.metrics import MetricRegistry
+        from corda_tpu.verifier.batcher import SignatureBatcher
+
+        reg = MetricRegistry()
+        batcher = SignatureBatcher(max_batch=1000, linger_ms=10_000)
+        batcher.bind_metrics(reg)
+        kp = crypto.generate_keypair()
+        sig = crypto.do_sign(kp.private, b"m")
+        batcher.submit((kp.public, sig, b"m"))
+        assert reg.gauge("Verifier.BatcherOccupancy").value == 1
+        assert batcher.oldest_queued_age_s == 0.0  # nothing handed off
+        batcher.flush()
+        assert reg.gauge("Verifier.BatcherOccupancy").value == 0
+        assert reg.histogram("Verifier.BatchSize").count == 1
+        assert batcher.flush_lag_s >= 0.0
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# MiniWebServer error bodies are JSON with the JSON content type
+# ---------------------------------------------------------------------------
+
+class TestMiniWebErrorBodies:
+    def test_404_500_and_unsupported_method_are_json(self):
+        from corda_tpu.utils.miniweb import MiniWebServer
+
+        class Server(MiniWebServer):
+            def handle(self, method, path, query, body):
+                if path == "/boom":
+                    raise RuntimeError("kapow")
+                raise KeyError(path)
+
+        srv = Server(port=0)
+        try:
+            for path, code in (("/nope", 404), ("/boom", 500)):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}", timeout=5
+                    )
+                assert err.value.code == code
+                assert err.value.headers["Content-Type"] == "application/json"
+                json.loads(err.value.read())
+            # stdlib-dispatched failure (unsupported method) included
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/x", method="DELETE"
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5)
+            assert err.value.headers["Content-Type"] == "application/json"
+            assert "error" in json.loads(err.value.read())
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bench regression gate
+# ---------------------------------------------------------------------------
+
+def _bench_record():
+    return {
+        "metric": "ed25519-sig-verifies/sec/chip",
+        "value": 26899.0,
+        "backend": "cpu",
+        "p50_notarise_ms": 2.7,
+        "p95_notarise_ms": 3.3,
+        "p99_notarise_ms": 4.0,
+        "settlement_burst_sigs_s": 8062.1,
+        "batcher_largest_batch": 1025,
+        "stage_timings": {
+            "codec_encode_us_per_tx": 6.1,
+            "batcher_flush_wall_s": 0.5,
+            "uniq_commit_batch_mean": 12.0,
+            "critical_path": {
+                "notary.commit": {"count": 64, "p50_ms": 1.0, "p99_ms": 2.0},
+            },
+        },
+    }
+
+
+class TestBenchGateLibrary:
+    def test_identical_records_pass(self):
+        from corda_tpu.loadtest import gate
+
+        rec = _bench_record()
+        assert gate.compare_records(rec, rec) == []
+        assert gate.run_gate(rec, rec)["ok"]
+
+    def test_synthetic_2x_stage_regression_fails(self):
+        from corda_tpu.loadtest import gate
+
+        prev, cur = _bench_record(), _bench_record()
+        cur["stage_timings"]["codec_encode_us_per_tx"] *= 2  # 2x slower
+        regs = gate.compare_records(prev, cur)
+        assert [r["key"] for r in regs] == [
+            "stage_timings.codec_encode_us_per_tx"
+        ]
+        assert regs[0]["change"] == pytest.approx(1.0)
+        assert not gate.run_gate(cur, prev)["ok"]
+
+    def test_throughput_drop_and_latency_rise_both_flag(self):
+        from corda_tpu.loadtest import gate
+
+        prev, cur = _bench_record(), _bench_record()
+        cur["settlement_burst_sigs_s"] = prev["settlement_burst_sigs_s"] / 2
+        cur["stage_timings"]["critical_path"]["notary.commit"]["p99_ms"] = 10.0
+        keys = {r["key"] for r in gate.compare_records(prev, cur)}
+        assert "settlement_burst_sigs_s" in keys
+        assert "stage_timings.critical_path.notary.commit.p99_ms" in keys
+
+    def test_improvements_and_unclassified_keys_do_not_flag(self):
+        from corda_tpu.loadtest import gate
+
+        prev, cur = _bench_record(), _bench_record()
+        cur["p99_notarise_ms"] = 1.0  # faster: fine
+        cur["settlement_burst_sigs_s"] *= 3  # faster: fine
+        cur["batcher_largest_batch"] = 1  # workload shape: not gated
+        cur["stage_timings"]["uniq_commit_batch_mean"] = 1.0  # not gated
+        assert gate.compare_records(prev, cur) == []
+
+    def test_old_baseline_without_stage_timings_gates_nothing(self):
+        from corda_tpu.loadtest import gate
+
+        prev = {"metric": "x", "value": 1.0}  # r01-era artifact shape
+        assert gate.compare_records(prev, _bench_record()) == []
+
+    def test_slo_assertions(self):
+        from corda_tpu.loadtest import gate
+
+        rec = _bench_record()
+        ok = gate.check_slos(rec, {"p99_notarise_ms": {"max": 500.0}})
+        assert ok == []
+        bad = gate.check_slos(rec, {
+            "p99_notarise_ms": {"max": 1.0},
+            "settlement_burst_sigs_s": {"min": 1e9},
+            "not_measured": {"max": 1.0},
+        })
+        kinds = {v["key"]: v["kind"] for v in bad}
+        assert kinds == {
+            "p99_notarise_ms": "max",
+            "settlement_burst_sigs_s": "min",
+            "not_measured": "missing",
+        }
+
+    def test_harness_slo_integration(self):
+        from corda_tpu.loadtest.harness import LoadTest, Nodes
+
+        class _Null(LoadTest):
+            name = "null-test"
+
+            def setup(self, nodes):
+                return 0
+
+            def generate(self, state, parallelism):
+                from corda_tpu.testing.generator import Generator
+
+                return Generator.pure([None] * parallelism)
+
+            def interpret(self, state, command):
+                return state + 1
+
+            def execute(self, nodes, command):
+                pass
+
+            def gather(self, nodes):
+                return self._state_now
+
+            def compare(self, predicted, observed):
+                return True
+
+            def collect_metrics(self, nodes):
+                return {"widgets_per_run": 7.0}
+
+            _state_now = 0
+
+        class _StillNodes(Nodes):
+            def pump(self):
+                pass
+
+        nodes = _StillNodes(network=None, notary=None, nodes=[])
+        # collect_metrics lands on the result AND feeds the SLO check
+        result = _Null().run(
+            nodes, iterations=2, parallelism=3,
+            slos={"widgets_per_run": {"min": 10.0},
+                  "commands_per_sec": {"min": 0.0}},
+        )
+        assert result.metrics == {"widgets_per_run": 7.0}
+        assert [v["key"] for v in result.slo_violations] == [
+            "widgets_per_run"
+        ]
+        assert not result.ok
+        # bounds that hold leave the result ok
+        ok = _Null().run(
+            nodes, iterations=1, parallelism=1,
+            slos={"widgets_per_run": {"min": 1.0}},
+        )
+        assert ok.ok and ok.slo_violations == []
+
+
+class TestBenchGateCLI:
+    """The tier-1 CI satellite: inject a synthetic regression into a
+    copied bench JSON and assert the gate process fails."""
+
+    def _run(self, tmp_path, cur, prev):
+        cur_p, prev_p = tmp_path / "cur.json", tmp_path / "prev.json"
+        cur_p.write_text(json.dumps(cur))
+        # baseline rides the driver artifact shape ({"parsed": ...})
+        prev_p.write_text(json.dumps({"parsed": prev, "rc": 0}))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+             "--current", str(cur_p), "--baseline", str(prev_p)],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_gate_exits_nonzero_on_synthetic_regression(self, tmp_path):
+        prev, cur = _bench_record(), _bench_record()
+        cur["stage_timings"]["batcher_flush_wall_s"] *= 2
+        proc = self._run(tmp_path, cur, prev)
+        assert proc.returncode == 1, proc.stderr
+        assert "REGRESSION" in proc.stderr
+        summary = json.loads(proc.stdout)
+        assert not summary["ok"]
+        assert summary["regressions"][0]["key"] == (
+            "stage_timings.batcher_flush_wall_s"
+        )
+
+    def test_gate_exits_zero_on_clean_run(self, tmp_path):
+        proc = self._run(tmp_path, _bench_record(), _bench_record())
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["ok"]
+
+    def test_gate_slo_defaults_flag(self, tmp_path):
+        # without --slo-defaults the built-in bounds are NOT applied...
+        cur = _bench_record()
+        cur["p99_notarise_ms"] = 10_000.0  # way past DEFAULT_SLOS' 500ms
+        proc = self._run(tmp_path, cur, cur)
+        assert proc.returncode == 0, proc.stderr
+        # ...with the flag, the same record fails on the default bound
+        cur_p = tmp_path / "cur.json"
+        cur_p.write_text(json.dumps(cur))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+             "--current", str(cur_p), "--baseline", str(cur_p),
+             "--slo-defaults"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "p99_notarise_ms" in proc.stderr
+
+    def test_gate_slo_flag(self, tmp_path):
+        cur_p = tmp_path / "cur.json"
+        cur_p.write_text(json.dumps(_bench_record()))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+             "--current", str(cur_p), "--baseline", str(cur_p),
+             "--slo", "p99_notarise_ms<=0.5"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "SLO VIOLATION" in proc.stderr

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Bench regression gate CLI.
+
+Compares a bench.py record (file or stdin) against the previous round's
+`BENCH_r*.json` artifact and exits nonzero on a >20% regression in any
+stage timing, or on a broken SLO bound:
+
+    python tools/bench_gate.py --current out.json
+    python bench.py | python tools/bench_gate.py --current -
+    python tools/bench_gate.py --current out.json --baseline BENCH_r05.json
+    python tools/bench_gate.py --current out.json \
+        --slo "p99_notarise_ms<=500" --slo "settlement_burst_sigs_s>=100"
+
+Exit status: 0 = pass, 1 = regression / SLO violation, 2 = usage error.
+The comparison engine lives in `corda_tpu.loadtest.gate` so the loadtest
+harness and tests reuse it without shelling out.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, _REPO)
+
+from corda_tpu.loadtest import gate  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_gate")
+    ap.add_argument(
+        "--current", required=True,
+        help="bench record to gate: a JSON file, or '-' for stdin",
+    )
+    ap.add_argument(
+        "--baseline",
+        help="previous record (default: newest BENCH_r*.json in --repo)",
+    )
+    ap.add_argument(
+        "--repo", default=_REPO,
+        help="directory holding the BENCH_r*.json round artifacts",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=gate.DEFAULT_THRESHOLD,
+        help="tolerated relative regression (default 0.2 = 20%%)",
+    )
+    ap.add_argument(
+        "--slo", action="append", metavar="KEY<=V | KEY>=V",
+        help="absolute bound to assert on the current record (repeatable)",
+    )
+    ap.add_argument(
+        "--slo-defaults", action="store_true",
+        help="also assert the built-in system-path bounds "
+             "(gate.DEFAULT_SLOS: p99 notarise latency, verify throughput)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        if args.current == "-":
+            cur = json.load(sys.stdin)
+            if isinstance(cur.get("parsed"), dict):
+                cur = cur["parsed"]
+        else:
+            cur = gate.load_bench_record(args.current)
+    except (OSError, ValueError) as exc:
+        print(f"bench_gate: cannot read current record: {exc}",
+              file=sys.stderr)
+        return 2
+
+    prev = None
+    baseline_path = None
+    if args.baseline:
+        try:
+            prev = gate.load_bench_record(args.baseline)
+            baseline_path = args.baseline
+        except (OSError, ValueError) as exc:
+            print(f"bench_gate: cannot read baseline: {exc}", file=sys.stderr)
+            return 2
+    else:
+        found = gate.latest_baseline(args.repo)
+        if found is not None:
+            baseline_path, prev = found
+
+    try:
+        slos = gate.parse_slo_args(args.slo)
+    except ValueError as exc:
+        print(f"bench_gate: {exc}", file=sys.stderr)
+        return 2
+    if args.slo_defaults:
+        slos = {**gate.DEFAULT_SLOS, **slos}
+
+    result = gate.run_gate(cur, prev, threshold=args.threshold,
+                           slos=slos or None)
+    result["baseline"] = baseline_path
+    result["threshold"] = args.threshold
+
+    for r in result["regressions"]:
+        print(
+            f"REGRESSION {r['key']}: {r['prev']} -> {r['cur']} "
+            f"({r['change'] * 100:+.1f}% worse, {r['direction']}-is-better)",
+            file=sys.stderr,
+        )
+    for v in result["slo_violations"]:
+        print(
+            f"SLO VIOLATION {v['key']}: value={v['value']} "
+            f"bound={v['bound']} ({v['kind']})",
+            file=sys.stderr,
+        )
+    if result["ok"]:
+        compared = "no baseline found" if prev is None else baseline_path
+        print(f"bench_gate: PASS (baseline: {compared})", file=sys.stderr)
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
