@@ -440,6 +440,17 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         codec_us = round(_codec_encode_us(), 2)
     except Exception:
         codec_us = None
+    # Failover time-to-recovery (docs/robustness.md): kill the sole
+    # verifier worker after ack, measure how long in-flight signature
+    # futures take to complete via redispatch/fallback — the gate then
+    # guards recovery latency like any other stage.
+    from corda_tpu.loadtest.latency import measure_failover_recovery
+
+    try:
+        failover = measure_failover_recovery()
+    except Exception as exc:
+        failover = {"error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -456,6 +467,8 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         # to the aggregate stage numbers, so a regression names its hop
         "critical_path": lat.get("span_summary"),
         "jax_dispatch": profiling.dispatch_snapshot(),
+        "failover_recovery_ms": failover.get("failover_recovery_ms"),
+        "failover_recovered_via": failover.get("recovered_via"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],

@@ -8,7 +8,10 @@ pairs continuously, and fires random disruptions — member SIGSTOP/resume,
 member SIGKILL + relaunch, counterparty-bank SIGKILL + relaunch, and
 (with --verifier-workers N) SIGKILL of one competing out-of-process
 verifier worker (reference VerifierTests.kt:73-101 elasticity, at system
-scale) — every 12-25 s for the requested duration. Never more than one
+scale) plus a broker-partition mode that SIGSTOPs EVERY worker at once —
+consumers stay registered but the queue stalls, which only the
+requester-side deadline supervisor (redispatch/breaker/fallback,
+docs/robustness.md) recovers — every 12-25 s for the requested duration. Never more than one
 cluster member is disrupted at a time (f = 1), and bank A is never
 touched (its RPC connection is the measurement instrument).
 
@@ -88,6 +91,21 @@ class _Worker:
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
+
+    def suspend(self) -> None:
+        """SIGSTOP: the worker holds its queue consumer but answers
+        nothing — the 'queue stalls' failure mode (vs kill, where the
+        consumer count drops and the pool is visibly gone)."""
+        import signal
+
+        if self.alive():
+            self.proc.send_signal(signal.SIGSTOP)
+
+    def resume(self) -> None:
+        import signal
+
+        if self.alive():
+            self.proc.send_signal(signal.SIGCONT)
 
     def kill(self) -> None:
         if self.proc is not None:
@@ -178,7 +196,12 @@ def run(
         kinds = ["suspend", "member_restart", "bankb_restart"]
         if workers:
             kinds.append("worker_kill")
+            # freeze EVERY worker at once: consumers stay registered but
+            # the queue stalls — the failure mode only the requester-side
+            # deadline supervisor (redispatch/breaker/fallback) recovers
+            kinds.append("broker_partition")
         worker_kills = 0
+        partitions = 0
         while time.monotonic() < t_end:
             time.sleep(rng.uniform(12, 25))
             kind = rng.choice(kinds)
@@ -189,6 +212,10 @@ def run(
                 alive = [w for w in workers if w.alive()]
                 if len(alive) < 2:
                     kind = "bankb_restart"
+            if kind == "broker_partition" and not any(
+                w.alive() for w in workers
+            ):
+                kind = "bankb_restart"
             if kind in ("suspend", "member_restart"):
                 candidates = [
                     i for i in range(n_members) if i not in degraded
@@ -220,6 +247,26 @@ def run(
                                 print("member", idx, "failed to relaunch; "
                                       "excluded from rotation", flush=True)
                             continue
+                elif kind == "broker_partition":
+                    frozen = [w for w in workers if w.alive()]
+                    for w in frozen:
+                        w.suspend()
+                    partitions += 1
+                    before = len(driver.completed)
+                    stall = rng.uniform(2, 6)
+                    time.sleep(stall)
+                    for w in frozen:
+                        w.resume()
+                    # recovery evidence: pairs must resume completing
+                    # after the stall window (redispatch catches up)
+                    redeadline = time.monotonic() + 120
+                    while len(driver.completed) < before + 2:
+                        assert time.monotonic() < redeadline, (
+                            "no pairs completed after a verifier stall — "
+                            "the deadline supervisor did not recover"
+                        )
+                        time.sleep(0.3)
+                    idx = f"stall:{len(frozen)}x{round(stall, 1)}s"
                 elif kind == "worker_kill":
                     victim = rng.choice([w for w in workers if w.alive()])
                     before = len(driver.completed)
@@ -271,6 +318,7 @@ def run(
             "degraded_members": sorted(degraded),
             "verifier_workers": len(workers),
             "worker_kills": worker_kills,
+            "broker_partitions": partitions,
             "driver_errors": len(driver.errors),
             "consistent": True,
         }

@@ -74,6 +74,63 @@ def kill_flow_storm(probability: float = 0.1) -> Disruption:
     return Disruption("message-drop", fire, probability=probability)
 
 
+def verifier_worker_kill(workers, broker, probability: float = 0.2) -> Disruption:
+    """Crash one in-process verifier worker mid-run (non-graceful stop:
+    unacked requests redeliver to the survivors — the reference
+    VerifierTests elasticity contract) and heal by launching a
+    replacement onto the same broker. With only one worker left, the
+    kill exercises the requester-side deadline supervisor instead: the
+    pool goes empty, the breaker trips, and the in-process fallback
+    serves until the heal brings a consumer back."""
+    from ..verifier.worker import VerifierWorker
+
+    state = {"n": 0}
+
+    def fire(rng, nodes):
+        alive = [w for w in workers if not w._stop.is_set()]
+        if not alive:
+            return
+        victim = rng.choice(alive)
+        victim.stop(graceful=False)
+
+    def heal(rng, nodes):
+        state["n"] += 1
+        replacement = VerifierWorker(
+            broker, name=f"disruption-respawn-{state['n']}"
+        ).start()
+        workers.append(replacement)
+
+    return Disruption(
+        "verifier-worker-kill", fire, heal, probability=probability
+    )
+
+
+def broker_partition(match: str = "verifier.",
+                     probability: float = 0.2) -> Disruption:
+    """Partition broker queues matching `match`: every send into
+    them is silently dropped (lost on the wire) until the heal. Built on
+    the deterministic fault-injection seam, so it composes with — and is
+    scoped exactly like — the tier-1 fault tests; the verification
+    path's deadline/redispatch/fallback machinery is what keeps flows
+    completing through the window."""
+    from ..testing.faults import FaultInjector
+    from ..utils import faultpoints
+
+    state = {}
+
+    def fire(rng, nodes):
+        fi = FaultInjector(seed=rng.randrange(2**31))
+        fi.rule("broker.send", "drop", match=match, times=None)
+        state["prev"] = faultpoints.set_hook(fi)
+        state["armed"] = True
+
+    def heal(rng, nodes):
+        if state.pop("armed", False):
+            faultpoints.set_hook(state.pop("prev", None))
+
+    return Disruption("broker-partition", fire, heal, probability=probability)
+
+
 def clock_skew(delta_s: float = 3600.0) -> Disruption:
     """Skew a node's clock forward (time-window failures downstream)."""
     state = {}

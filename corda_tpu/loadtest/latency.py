@@ -318,3 +318,70 @@ def measure_notarise_burst(
     if verbose:
         print(out)
     return out
+
+
+def measure_failover_recovery(
+    n_items: int = 64, deadline_s: float = 0.25, verbose: bool = False
+) -> Dict[str, float]:
+    """Time-to-recovery of the verification failover path: kill the SOLE
+    out-of-process verifier worker mid-run — a deterministic
+    crash-after-ack fault, the lost-response mode only a deadline can
+    catch — and measure how long the in-flight `verify_signatures`
+    futures take to complete anyway (redispatch onto the respawned pool
+    or the in-process fallback; docs/robustness.md). Reported as
+    `failover_recovery_ms` in bench stage_timings so tools/bench_gate.py
+    guards recovery latency like any other stage."""
+    from ..core.crypto import crypto
+    from ..messaging import Broker
+    from ..testing.faults import inject
+    from ..verifier.service import OutOfProcessTransactionVerifierService
+    from ..verifier.worker import VerifierWorker
+
+    items = []
+    for i in range(n_items):
+        kp = crypto.entropy_to_keypair(9000 + i)
+        content = b"failover-%d" % i
+        items.append((kp.public, crypto.do_sign(kp.private, content), content))
+
+    broker = Broker()
+    svc = OutOfProcessTransactionVerifierService(
+        broker, "bench-failover", deadline_s=deadline_s, max_retries=1,
+    )
+    worker = VerifierWorker(broker, name="bench-failover-worker").start()
+    try:
+        # warm the path (and the fallback's first flush is excluded from
+        # the clean-path baseline below, not from the recovery number —
+        # a cold fallback IS part of real recovery cost)
+        warm = svc.verify_signatures(items[:4])
+        assert all(f.result(timeout=30) for f in warm)
+        t0 = time.perf_counter()
+        clean = svc.verify_signatures(items)
+        assert all(f.result(timeout=30) for f in clean)
+        clean_ms = (time.perf_counter() - t0) * 1000
+
+        with inject(seed=7) as fi:
+            rule = fi.rule("verifier.worker", "crash_after_ack", times=1)
+            t0 = time.perf_counter()
+            futures = svc.verify_signatures(items)
+            results = [f.result(timeout=60) for f in futures]
+            recovery_ms = (time.perf_counter() - t0) * 1000
+        assert rule.fired == 1, "the crash fault never fired"
+        assert all(results), "recovered futures must still verify"
+        out = {
+            "failover_recovery_ms": round(recovery_ms, 3),
+            "clean_batch_ms": round(clean_ms, 3),
+            "n_items": n_items,
+            "deadline_s": deadline_s,
+            "recovered_via": (
+                "fallback" if svc.metrics.fallback_served.value else
+                "redispatch"
+            ),
+            "breaker_trips": svc.breaker.trips,
+        }
+    finally:
+        worker.stop(graceful=False)
+        svc.stop()
+        broker.close()
+    if verbose:
+        print(out)
+    return out

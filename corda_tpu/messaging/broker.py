@@ -30,6 +30,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ..utils import faultpoints
 from ..utils.tracing import TRACEPARENT_HEADER, current_traceparent
 
 
@@ -250,6 +251,17 @@ class Consumer:
                     return None
                 if q.messages:
                     msg = q.messages.popleft()
+                    if faultpoints.hook is not None and faultpoints.fire(
+                        "broker.receive", queue=q.name,
+                        message_id=msg.message_id,
+                    ) == "drop":
+                        # consume-and-lose: the message is gone as if the
+                        # consumer crashed right after taking it off the
+                        # wire post-ack — journal-acked so it never
+                        # redelivers, invisible to the caller
+                        if q.journal is not None:
+                            q.journal.append_ack(msg.message_id)
+                        continue
                     self._unacked[msg.message_id] = msg
                     return msg
                 if deadline is None:
@@ -279,9 +291,19 @@ class Consumer:
                     batch = []
                     while q.messages and len(batch) < max_messages:
                         msg = q.messages.popleft()
+                        if faultpoints.hook is not None and faultpoints.fire(
+                            "broker.receive", queue=q.name,
+                            message_id=msg.message_id,
+                        ) == "drop":
+                            # same consume-and-lose semantics as receive()
+                            if q.journal is not None:
+                                q.journal.append_ack(msg.message_id)
+                            continue
                         self._unacked[msg.message_id] = msg
                         batch.append(msg)
-                    return batch
+                    if batch:
+                        return batch
+                    continue  # every queued message was fault-dropped
                 if deadline is None:
                     q.not_empty.wait()
                 else:
@@ -428,20 +450,64 @@ class Broker:
         headers: Optional[Dict[str, str]] = None,
     ) -> str:
         headers = self._with_trace(headers)
+        copies = 1
+        if faultpoints.hook is not None:
+            action = faultpoints.fire("broker.send", queue=queue_name)
+            if action == "drop":
+                # lost in transit: the caller's contract (queue must
+                # exist) still holds, but nothing is enqueued
+                return self._fabricate_id(queue_name)
+            elif action == "duplicate":
+                copies = 2
+            elif isinstance(action, tuple) and action[:1] == ("delay",):
+                from ..utils.timerwheel import call_later
+
+                call_later(
+                    float(action[1]),
+                    lambda: self._enqueue_guarded(
+                        queue_name, payload, headers
+                    ),
+                )
+                return self._fabricate_id(queue_name)
+        return self._enqueue(queue_name, payload, headers, copies=copies)
+
+    def _fabricate_id(self, queue_name: str) -> str:
+        """A message id for a send the fault layer kept off the queue:
+        the queue-must-exist contract and the id format stay identical
+        to a real enqueue."""
         with self._lock:
             q = self._queues.get(queue_name)
             if q is None or q.closed:
                 raise UnknownQueueError(queue_name)
             self._id_seq += 1
-            msg = Message(
-                payload=payload,
-                headers=headers,
-                message_id=f"{self._id_prefix}-{self._id_seq:019d}",
-            )
-            if q.journal is not None:
-                q.journal.append_enqueue(msg)
-            q.messages.append(msg)
-            q.not_empty.notify()
+            return f"{self._id_prefix}-{self._id_seq:019d}"
+
+    def _enqueue_guarded(self, queue_name: str, payload: bytes,
+                         headers: Dict[str, str]) -> None:
+        """Delayed-delivery completion: the queue may have been deleted
+        or the broker closed while the message sat 'on the wire'."""
+        try:
+            self._enqueue(queue_name, payload, headers)
+        except BrokerError:
+            pass
+
+    def _enqueue(self, queue_name: str, payload: bytes,
+                 headers: Dict[str, str], copies: int = 1) -> str:
+        with self._lock:
+            q = self._queues.get(queue_name)
+            if q is None or q.closed:
+                raise UnknownQueueError(queue_name)
+            for _ in range(copies):
+                self._id_seq += 1
+                msg = Message(
+                    payload=payload,
+                    headers=headers,
+                    message_id=f"{self._id_prefix}-{self._id_seq:019d}",
+                )
+                if q.journal is not None:
+                    q.journal.append_enqueue(msg)
+                q.messages.append(msg)
+                q.not_empty.notify()
         return msg.message_id
 
     @staticmethod

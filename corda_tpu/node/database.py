@@ -204,6 +204,21 @@ class CheckpointStorage:
         )
         return serialize(state)
 
+    def get(self, flow_id: str) -> Optional[bytes]:
+        """ONE flow's full checkpoint blob (either write path), or None.
+        The flow hospital's replay-retry reads this at readmission time."""
+        rows = self.db.query(
+            "SELECT blob FROM checkpoints WHERE flow_id = ?", (flow_id,)
+        )
+        if rows:
+            return rows[0][0]
+        rows = self.db.query(
+            "SELECT blob FROM cp_header WHERE flow_id = ?", (flow_id,)
+        )
+        if rows:
+            return self._assemble(flow_id, rows[0][0])
+        return None
+
     def all_checkpoints(self) -> List[Tuple[str, bytes]]:
         out = [
             (row[0], row[1])

@@ -1,0 +1,446 @@
+"""Flow hospital: automatic checkpoint-replay retry for transient flow
+failures, plus a bounded dead-letter ward for fatal ones.
+
+Reference inspiration: the staff/diagnosis model of Corda's
+`StaffedFlowHospital` (flows that error are "admitted", diagnosed, and
+either scheduled for a retry from their last checkpoint or kept for the
+operator), rebuilt on this repo's deterministic-replay checkpoints:
+
+  * A flow failing with a TRANSIENT error (verifier deadline exhaustion,
+    an explicit `TransientFlowError`, a notary reporting itself
+    unavailable) is re-admitted automatically: after a capped
+    exponential backoff its checkpoint is replayed into a fresh
+    FlowStateMachine that reuses the SAME flow id and — crucially — the
+    SAME result Future the original caller holds, so an RPC client
+    blocked on `flow_result` simply sees the retry succeed.
+  * A flow failing FATALLY (contract violation, any FlowException, an
+    unclassified bug) keeps today's behavior — the caller's future gets
+    the exception immediately — and additionally lands in the ward with
+    its checkpoint blob captured, visible via `node_hospital()` and
+    `GET /hospital`, retryable via `retry_flow()` and dischargeable via
+    `kill_flow()`. Kills are never retried or warded.
+
+The transient set is deliberately NARROW by default: retrying an error
+that is actually deterministic turns one failure into max_retries
+failures plus latency, and retrying session errors can leave a flow
+parked on a peer that will never answer. Deployments widen it via
+`FlowHospital.transient_predicates`.
+
+Knobs: CORDA_TPU_HOSPITAL=0 disables auto-retry (the ward still
+records), CORDA_TPU_HOSPITAL_MAX_RETRIES (default 3),
+CORDA_TPU_HOSPITAL_BACKOFF_S (base, default 0.1),
+CORDA_TPU_HOSPITAL_BACKOFF_CAP_S (default 5), CORDA_TPU_HOSPITAL_WARD_MAX
+(default 256).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional
+
+from ..core.flows.api import (
+    FlowException,
+    FlowKilledException,
+    encode_flow_exception,
+)
+from ..utils import eventlog, timerwheel
+from ..verifier.failover import backoff_delay
+from ..verifier.service import VerificationTimeoutError
+
+
+class TransientFlowError(Exception):
+    """Marker: a failure the raiser KNOWS is worth a checkpoint-replay
+    retry (an infrastructure hiccup, not a logic error). Flow bodies and
+    service seams raise it (or a subclass) to opt into hospital
+    re-admission."""
+
+
+def _notary_unavailable(exc: BaseException) -> bool:
+    """NotaryException whose error text reports an infrastructure outage
+    (not a conflict / validation verdict, which must stay final)."""
+    from .notary import NotaryException
+
+    if not isinstance(exc, NotaryException):
+        return False
+    text = str(getattr(exc, "error", "") or exc).lower()
+    return "unavailable" in text or "timed out" in text
+
+
+class FlowHospital:
+    """Per-node failure triage attached to one StateMachineManager."""
+
+    def __init__(self, smm, enabled: Optional[bool] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 backoff_cap_s: Optional[float] = None,
+                 ward_max: Optional[int] = None):
+        env = os.environ
+        self.smm = smm
+        self.enabled = (
+            enabled if enabled is not None
+            else env.get("CORDA_TPU_HOSPITAL", "1") != "0"
+        )
+        self.max_retries = (
+            max_retries if max_retries is not None
+            else int(env.get("CORDA_TPU_HOSPITAL_MAX_RETRIES", 3))
+        )
+        self.backoff_s = (
+            backoff_s if backoff_s is not None
+            else float(env.get("CORDA_TPU_HOSPITAL_BACKOFF_S", 0.1))
+        )
+        self.backoff_cap_s = (
+            backoff_cap_s if backoff_cap_s is not None
+            else float(env.get("CORDA_TPU_HOSPITAL_BACKOFF_CAP_S", 5.0))
+        )
+        self.ward_max = (
+            ward_max if ward_max is not None
+            else int(env.get("CORDA_TPU_HOSPITAL_WARD_MAX", 256))
+        )
+        #: extra classifiers: any predicate saying True makes an error
+        #: transient (checked before the default fatal verdict)
+        self.transient_predicates: List[Callable[[BaseException], bool]] = [
+            _notary_unavailable,
+        ]
+        self._lock = threading.RLock()
+        self._closed = False
+        #: flow_id -> recovery record for flows awaiting / mid re-admission
+        self._recovering: Dict[str, dict] = {}
+        #: flow_id -> ward record (bounded, insertion-ordered for eviction)
+        self._ward: "OrderedDict[str, dict]" = OrderedDict()
+        self._executor = None  # lazy single-thread readmission executor
+        m = smm.metrics
+        self.retries = m.counter("Hospital.Retries")
+        self.recovered = m.counter("Hospital.Recovered")
+        self.warded = m.counter("Hospital.Warded")
+        m.gauge("Hospital.Recovering", lambda: len(self._recovering))
+        m.gauge("Hospital.WardSize", lambda: len(self._ward))
+
+    # -- classification ------------------------------------------------------
+
+    def classify(self, exc: BaseException) -> str:
+        """'transient' (retry from checkpoint) or 'fatal' (ward)."""
+        if isinstance(exc, FlowKilledException):
+            return "fatal"  # a kill is a decision, not a failure
+        if isinstance(exc, (TransientFlowError, VerificationTimeoutError)):
+            return "transient"
+        for pred in self.transient_predicates:
+            try:
+                if pred(exc):
+                    return "transient"
+            except Exception:
+                pass
+        return "fatal"
+
+    # -- admission (called from FlowStateMachine._fail) ----------------------
+
+    def consider(self, fsm, exc: BaseException) -> Optional[float]:
+        """Admission decision for a failing flow: a backoff delay when
+        the hospital will re-admit it (the fail path then STOPS — the
+        caller's future stays pending), or None to let it fail."""
+        if not self.enabled or self._closed:
+            # after close() (node stopping) a late transient failure must
+            # fail normally — re-admitting would strand the caller's
+            # future and replay the flow against torn-down services
+            return None
+        if self.classify(exc) != "transient":
+            return None
+        with self._lock:
+            rec = self._recovering.get(fsm.flow_id)
+            attempts = rec["attempts"] if rec else 0
+            if attempts >= self.max_retries:
+                # exhausted: release the record; the fail path wards it
+                self._recovering.pop(fsm.flow_id, None)
+                return None
+            attempts += 1
+            delay = backoff_delay(
+                attempts, base_s=self.backoff_s, cap_s=self.backoff_cap_s
+            )
+            self._recovering[fsm.flow_id] = {
+                "flow_id": fsm.flow_id,
+                "flow_name": fsm.flow.flow_name(),
+                "attempts": attempts,
+                "error": f"{type(exc).__name__}: {exc}",
+                "future": fsm.result,
+                "old_fsm": fsm,
+                "is_responder": fsm.is_responder,
+                "next_retry_at": time.time() + delay,
+                "timer": None,
+                "killed": False,
+            }
+            self._recovering[fsm.flow_id]["timer"] = timerwheel.call_later(
+                delay, lambda: self._on_retry_timer(fsm.flow_id)
+            )
+        self.retries.inc()
+        eventlog.emit(
+            "warning", "hospital", "flow admitted for retry",
+            flow=fsm.flow.flow_name(), flow_id=fsm.flow_id,
+            attempt=attempts, backoff_s=round(delay, 3),
+            error=f"{type(exc).__name__}: {exc}",
+        )
+        return delay
+
+    def record_fatal(self, fsm, exc: BaseException) -> None:
+        """Ward a fatally-failing flow (called BEFORE the checkpoint is
+        dropped so the blob can be captured for retry_flow)."""
+        if isinstance(exc, FlowKilledException):
+            # kills are never warded, but a killed RETRY ATTEMPT must
+            # still drop its recovery record — otherwise discharge()
+            # later reports the kill as "flow recovered"
+            with self._lock:
+                self._recovering.pop(fsm.flow_id, None)
+            return
+        blob = None
+        try:
+            blob = self.smm.checkpoint_storage.get(fsm.flow_id)
+        except Exception:
+            pass
+        with self._lock:
+            self._recovering.pop(fsm.flow_id, None)
+            self._ward[fsm.flow_id] = {
+                "flow_id": fsm.flow_id,
+                "flow_name": fsm.flow.flow_name(),
+                "error": f"{type(exc).__name__}: {exc}",
+                "error_type": type(exc).__name__,
+                "ts": time.time(),
+                "is_responder": fsm.is_responder,
+                "checkpoint": blob,
+                "flow_cls": type(fsm.flow),
+                "args": fsm.args,
+                "kwargs": dict(fsm.kwargs),
+                "retries_spent": 0,
+            }
+            while len(self._ward) > self.ward_max:
+                self._ward.popitem(last=False)  # evict oldest
+        self.warded.inc()
+        eventlog.emit(
+            "warning", "hospital", "flow dead-lettered to ward",
+            flow=fsm.flow.flow_name(), flow_id=fsm.flow_id,
+            error=f"{type(exc).__name__}: {exc}",
+        )
+
+    # -- readmission ---------------------------------------------------------
+
+    def _executor_submit(self, fn) -> None:
+        """Readmissions replay flow bodies (arbitrary user code + crypto)
+        — too heavy for the timer wheel's shared 2-thread callback pool,
+        so they run on the hospital's own single worker."""
+        with self._lock:
+            if self._closed:
+                return  # never recreate the executor close() tore down
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="flow-hospital"
+                )
+            executor = self._executor
+        try:
+            executor.submit(fn)
+        except RuntimeError:
+            pass  # node stopping
+
+    def _on_retry_timer(self, flow_id: str) -> None:
+        self._executor_submit(lambda: self._readmit(flow_id))
+
+    def _readmit(self, flow_id: str) -> None:
+        with self._lock:
+            rec = self._recovering.get(flow_id)
+            if rec is None or rec["killed"]:
+                return
+        eventlog.emit(
+            "info", "hospital", "replaying flow from checkpoint",
+            flow=rec["flow_name"], flow_id=flow_id, attempt=rec["attempts"],
+        )
+        try:
+            blob = self.smm.checkpoint_storage.get(flow_id)
+            with self._lock:
+                # re-check after the storage read: a kill (or close) that
+                # landed since the first check popped the record, removed
+                # the checkpoint, and already failed the caller future —
+                # re-running the flow now would execute a killed flow's
+                # side effects
+                if self._recovering.get(flow_id) is not rec or rec["killed"]:
+                    return
+            if blob is not None:
+                self.smm._restore(
+                    flow_id, blob, result_future=rec["future"],
+                    merge_inbox_from=rec.get("old_fsm"),
+                )
+            else:
+                # failed before its first checkpoint: re-run from scratch
+                # with the original constructor args — but ONLY when no
+                # sessions were opened (a fresh machine has no session
+                # state and the peer's routes/dedup still point at the
+                # old ids: re-running would hang or spawn duplicate
+                # responders; failing loudly into the ward is safer)
+                old = rec["old_fsm"]
+                if old.sessions:
+                    raise RuntimeError(
+                        "flow opened sessions before its first "
+                        "checkpoint; not fresh-retryable"
+                    )
+                self.smm._start_fresh_retry(
+                    flow_id, type(old.flow), old.args, old.kwargs,
+                    old.is_responder, rec["future"],
+                )
+        except BaseException as exc:
+            # the RETRY ITSELF failed to launch — final: ward + fail
+            fut = rec["future"]
+            with self._lock:
+                self._recovering.pop(flow_id, None)
+            old = rec["old_fsm"]
+            self.record_fatal(old, exc)
+            self.smm.checkpoint_storage.remove(flow_id)
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def discharge(self, flow_id: str) -> None:
+        """A re-admitted flow finished (either way): drop its record."""
+        with self._lock:
+            rec = self._recovering.pop(flow_id, None)
+        if rec is not None:
+            self.recovered.inc()
+            eventlog.emit(
+                "info", "hospital", "flow recovered",
+                flow=rec["flow_name"], flow_id=flow_id,
+                attempts=rec["attempts"],
+            )
+
+    def recovering_attempts(self, flow_id: str) -> int:
+        with self._lock:
+            rec = self._recovering.get(flow_id)
+            return rec["attempts"] if rec else 0
+
+    # -- operator surface (RPC node_hospital / retry_flow / kill_flow) -------
+
+    def kill(self, flow_id: str) -> bool:
+        """Kill a flow the hospital holds: cancels a scheduled retry
+        (failing the preserved caller future with FlowKilledException)
+        or discharges a ward record. False when unknown here."""
+        with self._lock:
+            rec = self._recovering.pop(flow_id, None)
+            if rec is not None:
+                rec["killed"] = True
+                if rec["timer"] is not None:
+                    rec["timer"].cancel()
+            warded = self._ward.pop(flow_id, None) is not None
+        if rec is not None:
+            try:
+                self.smm.checkpoint_storage.remove(flow_id)
+            except Exception:
+                pass
+            exc = FlowKilledException(f"flow {flow_id} killed via RPC")
+            # honour kill_flow's contract even for hospital-held flows:
+            # peers get a SessionEnd (sessions were deliberately left
+            # open for the retry; without this the counterparty responder
+            # parks forever)
+            old = rec.get("old_fsm")
+            if old is not None:
+                try:
+                    old._end_sessions(encode_flow_exception(exc))
+                except Exception:
+                    pass  # messaging may already be down
+            fut: Future = rec["future"]
+            if not fut.done():
+                fut.set_exception(exc)
+            # every other terminal path runs _flow_finished: the finished
+            # notification, audit record, and Flows.Finished meter must
+            # not silently skip RPC-killed recovering flows
+            if old is not None:
+                try:
+                    self.smm._flow_finished(old)
+                except Exception:
+                    pass
+            return True
+        return warded
+
+    def retry_from_ward(self, flow_id: str) -> bool:
+        """Re-run a warded flow NOW from its captured checkpoint (or from
+        scratch when it never checkpointed). The re-run gets a fresh
+        result future reachable via `flow_result(flow_id)`; a re-FAILURE
+        of the flow simply re-wards it. Returns False when the id is not
+        in the ward OR the relaunch itself failed (the record stays
+        warded). Runs synchronously on the caller's thread."""
+        with self._lock:
+            rec = self._ward.pop(flow_id, None)
+        if rec is None:
+            return False
+        eventlog.emit(
+            "info", "hospital", "operator retry from ward",
+            flow=rec["flow_name"], flow_id=flow_id,
+        )
+        try:
+            if rec["checkpoint"] is not None:
+                self.smm._restore(flow_id, rec["checkpoint"])
+            else:
+                self.smm._start_fresh_retry(
+                    flow_id, rec["flow_cls"], rec["args"], rec["kwargs"],
+                    rec["is_responder"], Future(),
+                )
+        except BaseException as exc:
+            eventlog.emit(
+                "warning", "hospital", "ward retry failed to launch",
+                flow_id=flow_id, error=f"{type(exc).__name__}: {exc}",
+            )
+            with self._lock:
+                self._ward[flow_id] = rec  # put it back
+            return False  # never report a relaunch that did not happen
+        return True
+
+    def snapshot(self) -> dict:
+        """The operator view: who is recovering, who is dead-lettered."""
+        with self._lock:
+            recovering = [
+                {
+                    k: rec[k]
+                    for k in ("flow_id", "flow_name", "attempts", "error",
+                              "next_retry_at")
+                }
+                for rec in self._recovering.values()
+            ]
+            ward = [
+                {
+                    k: rec[k]
+                    for k in ("flow_id", "flow_name", "error", "error_type",
+                              "ts", "is_responder")
+                }
+                for rec in self._ward.values()
+            ]
+        return {
+            "enabled": self.enabled,
+            "max_retries": self.max_retries,
+            "recovering": recovering,
+            "ward": ward,
+            "ward_max": self.ward_max,
+            "retries": self.retries.value,
+            "recovered": self.recovered.value,
+            "warded": self.warded.value,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pending = list(self._recovering.values())
+            self._recovering.clear()
+            for rec in pending:
+                rec["killed"] = True
+                if rec["timer"] is not None:
+                    rec["timer"].cancel()
+            executor, self._executor = self._executor, None
+        # Callers blocked on a recovering flow's result must fail fast,
+        # not hang past shutdown (the checkpoint survives — a restarted
+        # node restores and re-runs the flow).
+        for rec in pending:
+            fut: Future = rec["future"]
+            if not fut.done():
+                fut.set_exception(
+                    FlowException(
+                        "node stopped before flow "
+                        f"{rec['flow_id']} finished recovery"
+                    )
+                )
+        if executor is not None:
+            executor.shutdown(wait=False)

@@ -153,9 +153,22 @@ class AbstractNode:
                 detail["ok"] = backlog < workers * 8
             return detail
 
+        def check_hospital():
+            # informational (never fails the probe): recovery activity
+            # and ward pressure belong in the same operator view as the
+            # component checks
+            snap = self.smm.hospital.snapshot()
+            return {
+                "ok": True,
+                "recovering": len(snap["recovering"]),
+                "ward": len(snap["ward"]),
+                "retries": snap["retries"],
+            }
+
         self.health.register("messaging", check_messaging)
         self.health.register("verifier", check_verifier)
         self.health.register("statemachine", check_statemachine)
+        self.health.register("hospital", check_hospital, readiness=False)
 
         if self.notary_service is not None:
             def check_notary():
@@ -576,6 +589,7 @@ class AbstractNode:
             # process tracer per request, like the span producers do
             self.ops_server = OpsServer(
                 self.smm.metrics, health=self.health,
+                hospital=self.smm.hospital,
                 port=self.config.ops_port,
             )
         self.started = True
@@ -661,6 +675,9 @@ class AbstractNode:
             self.smm._blocking_executor.shutdown(
                 wait=False, cancel_futures=True
             )
+        # cancel scheduled hospital retries: a readmission firing into a
+        # torn-down node would replay flows against closed services
+        self.smm.hospital.close()
         svc = self.services.transaction_verifier_service
         if hasattr(svc, "stop"):
             svc.stop()

@@ -28,7 +28,7 @@ from ..core.identity import Party
 from ..core.serialization.codec import deserialize, register_adapter, serialize
 from ..core.transactions.filtered import FilteredTransaction
 from ..core.transactions.signed import SignedTransaction
-from ..utils import eventlog, tracing
+from ..utils import eventlog, faultpoints, tracing
 from .database import KVStore, NodeDatabase
 
 
@@ -556,6 +556,15 @@ class NotaryService:
         """Commit; returns the commit protocol's notary signatures when it
         produced them (BFT: f+1 replica signatures), else None."""
         audit = getattr(self.services, "audit_service", None)
+        if faultpoints.hook is not None:
+            action = faultpoints.fire(
+                "notary.commit", tx_id=tx_id.bytes.hex(),
+                notary=self.identity.name,
+            )
+            if action == "unavailable":
+                raise NotaryException("notary unavailable (injected fault)")
+            if isinstance(action, tuple) and action[:1] == ("delay",):
+                time.sleep(float(action[1]))
         try:
             # child span of the serving notary flow (whose context is
             # current — inline on the pump or re-activated by the

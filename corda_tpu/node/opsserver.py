@@ -14,6 +14,9 @@ recorder's structured event log:
                                       flight-recorder events (filterable;
                                       `trace=` joins a /traces/<id> trace
                                       against what the node logged)
+    GET /hospital                     flow-hospital view: flows awaiting
+                                      checkpoint-replay retry + the
+                                      dead-letter ward (docs/robustness.md)
     GET /healthz                      200 while serving + checks pass;
                                       503 with a JSON cause when
                                       starting/draining/unhealthy
@@ -145,11 +148,13 @@ class OpsServer(MiniWebServer):
                  tracer: Optional[Tracer] = None,
                  health: Optional[HealthTracker] = None,
                  event_log: Optional[EventLog] = None,
+                 hospital=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
         self._tracer = tracer
         self.health = health
         self._event_log = event_log
+        self.hospital = hospital  # node.hospital.FlowHospital (optional)
         super().__init__(host=host, port=port)
 
     @property
@@ -199,6 +204,10 @@ class OpsServer(MiniWebServer):
                 "events": self.event_log.records(**filters),
                 **self.event_log.stats(),
             }
+        if path == "/hospital":
+            if self.hospital is None:
+                return 200, {"enabled": False, "recovering": [], "ward": []}
+            return 200, self.hospital.snapshot()
         if path == "/metrics":
             return 200, RawResponse(
                 render_prometheus(self.registry.snapshot()),

@@ -579,9 +579,20 @@ class FlowStateMachine:
             self._span.finish()
         self._end_sessions(None)
         self.smm._flow_finished(self)
-        self.result.set_result(value)
+        # the future may already be failed by a racing kill of a
+        # hospital-readmitted flow (same preserved future) — a done
+        # future must win, not raise InvalidStateError into the runner
+        if not self.result.done():
+            self.result.set_result(value)
 
     def _fail(self, exc: BaseException) -> None:
+        # flow hospital triage first: a transient failure is re-admitted
+        # (checkpoint replayed after backoff, the caller's future kept
+        # pending) instead of failing
+        hospital = getattr(self.smm, "hospital", None)
+        if hospital is not None and hospital.consider(self, exc) is not None:
+            self._hospitalize(exc)
+            return
         self.done = True
         self.logger.warning(
             "flow %s failed: %s", self.flow.flow_name(), exc,
@@ -603,8 +614,28 @@ class FlowStateMachine:
             else "counter-flow error"
         )
         self._end_sessions(msg)
+        if hospital is not None:
+            # ward BEFORE _flow_finished drops the checkpoint, so the
+            # blob is still readable for retry_flow()
+            hospital.record_fatal(self, exc)
         self.smm._flow_finished(self)
-        self.result.set_exception(exc)
+        if not self.result.done():  # see _complete: a racing kill wins
+            self.result.set_exception(exc)
+
+    def _hospitalize(self, exc: BaseException) -> None:
+        """Transient failure: this attempt's machine is retired (done,
+        span closed) but sessions stay open, the checkpoint stays
+        written, and the result future stays pending — the hospital's
+        readmission timer replays a fresh machine under the same flow id
+        and the same future."""
+        self.done = True
+        self.logger.warning(
+            "flow %s hospitalized after transient failure: %s",
+            self.flow.flow_name(), exc,
+        )
+        self._unpark_span()
+        if self._span is not None:
+            self._span.finish(error=exc)
 
     # -- checkpointing ------------------------------------------------------
 
@@ -721,6 +752,10 @@ class StateMachineManager:
         # Node-local responder registrations override the global registry
         # (reference: registerInitiatedFlows is per-node, AbstractNode.kt:291)
         self._initiated_overrides: Dict[str, type] = {}
+        # failure triage: transient-failure auto-retry + dead-letter ward
+        from .hospital import FlowHospital
+
+        self.hospital = FlowHospital(self)
         messaging.add_handler(SESSION_TOPIC, self._on_session_message)
 
     # -- public API ---------------------------------------------------------
@@ -793,12 +828,15 @@ class StateMachineManager:
     def kill_flow(self, flow_id: str) -> bool:
         """Forcibly fail a live flow (reference CordaRPCOps.killFlow):
         peers get a SessionEnd carrying the error, the checkpoint is
-        dropped, and the caller's future raises FlowKilledException."""
+        dropped, and the caller's future raises FlowKilledException.
+        Also reaches flows the hospital holds: a scheduled retry is
+        cancelled (the preserved future raises), a ward record is
+        discharged."""
         fsm = self.flows.get(flow_id)
-        if fsm is None or fsm.done:
-            return False
-        fsm._fail(FlowKilledException(f"flow {flow_id} killed via RPC"))
-        return True
+        if fsm is not None and not fsm.done:
+            fsm._fail(FlowKilledException(f"flow {flow_id} killed via RPC"))
+            return True
+        return self.hospital.kill(flow_id)
 
     def register_initiated_flow(self, initiator_cls, responder_cls) -> None:
         """Node-local responder for an initiating flow (overrides the global
@@ -807,7 +845,15 @@ class StateMachineManager:
 
     # -- restore ------------------------------------------------------------
 
-    def _restore(self, flow_id: str, blob: bytes) -> None:
+    def _restore(self, flow_id: str, blob: bytes,
+                 result_future: Optional[Future] = None,
+                 merge_inbox_from: Optional[FlowStateMachine] = None) -> None:
+        """`result_future`: reuse an existing Future as the restored
+        flow's result (hospital readmission — the original caller keeps
+        its handle). `merge_inbox_from`: a retired machine for the same
+        flow whose sessions may have received data AFTER the checkpoint
+        was written; that data lives only on the old session objects, so
+        it is merged into the restored ones (the peer will not re-send)."""
         state = deserialize(blob)
         flow_cls = flow_registry.get(state["flow_name"])
         if flow_cls is None:
@@ -827,9 +873,25 @@ class StateMachineManager:
             session_keys=dict(state["session_keys"]),
             session_owner_flows=dict(state["session_owner_flows"]),
         )
+        if result_future is not None:
+            fsm.result = result_future
         self.flows[flow_id] = fsm
         for local_id, sess in sessions.items():
             self._register_session(local_id, fsm)
+            if merge_inbox_from is not None:
+                # AFTER re-pointing the route: anything the pump wrote to
+                # the retired machine's session up to this instant is
+                # caught here, and anything later lands on the new one
+                # directly (list() snapshot: the pump may still be
+                # appending to the old inbox mid-copy)
+                old = merge_inbox_from.sessions.get(local_id)
+                if old is not None:
+                    for seq, payload in list(old.inbox.items()):
+                        if seq >= sess.recv_seq:
+                            sess.inbox.setdefault(seq, payload)
+                    if old.ended_by_peer:
+                        sess.ended_by_peer = True
+                        sess.end_error = old.end_error
             if sess.is_initiated_side and sess.peer_id is not None:
                 # Rebuild init-dedup so a re-delivered SessionInit does not
                 # spawn a duplicate responder after restart.
@@ -849,6 +911,21 @@ class StateMachineManager:
                         first_payload=sess.init_payload,
                     )),
                 )
+        self._notify("restored", fsm)
+        fsm.start()
+
+    def _start_fresh_retry(self, flow_id: str, flow_cls, args, kwargs,
+                           is_responder: bool, result_future: Future) -> None:
+        """Hospital readmission of a flow that failed BEFORE its first
+        checkpoint: rebuild it from its constructor args under the SAME
+        flow id and result future and run it from the top."""
+        flow = flow_cls(*args, **(kwargs or {}))
+        fsm = FlowStateMachine(
+            flow_id, flow, self, args=tuple(args), kwargs=dict(kwargs or {}),
+            is_responder=is_responder,
+        )
+        fsm.result = result_future
+        self.flows[flow_id] = fsm
         self._notify("restored", fsm)
         fsm.start()
 
@@ -1012,6 +1089,7 @@ class StateMachineManager:
 
     def _flow_finished(self, fsm: FlowStateMachine) -> None:
         self.checkpoint_storage.remove(fsm.flow_id)
+        self.hospital.discharge(fsm.flow_id)
         self._notify("finished", fsm)
 
     def _notify(self, event: str, fsm: FlowStateMachine) -> None:

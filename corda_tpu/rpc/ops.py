@@ -400,8 +400,26 @@ class CordaRPCOps:
     def kill_flow(self, flow_id: str) -> bool:
         """Best-effort flow termination (reference CordaRPCOps.killFlow):
         fails the flow's future with a FlowException and drops its
-        sessions/checkpoint so no counterparty re-delivery revives it."""
+        sessions/checkpoint so no counterparty re-delivery revives it.
+        Also reaches hospital-held flows: a pending checkpoint-replay
+        retry is cancelled, a dead-letter ward record is discharged."""
         return self._smm.kill_flow(flow_id)
+
+    def node_hospital(self) -> Dict:
+        """The flow hospital's operator view (the RPC twin of GET
+        /hospital): flows awaiting automatic checkpoint-replay retry
+        (`recovering`, with attempt counts and next retry time) and the
+        bounded dead-letter ward of fatally-failed flows (`ward`)."""
+        return self._smm.hospital.snapshot()
+
+    def retry_flow(self, flow_id: str) -> bool:
+        """Re-admit a dead-lettered flow from the hospital ward NOW,
+        replaying it from its captured checkpoint (or from its
+        constructor args when it failed before ever checkpointing).
+        Returns False when the id is not in the ward or the relaunch
+        itself failed (the record stays warded). The re-run is
+        reachable via flow_result(flow_id); a re-failure re-wards it."""
+        return self._smm.hospital.retry_from_ward(flow_id)
 
     # -- observability --------------------------------------------------------
 

@@ -1,5 +1,7 @@
 """corda_tpu.testing: test infrastructure (reference `test-utils/`)."""
+from . import faults
 from .expect import ExpectRecorder
+from .faults import FaultInjector
 from .generated_ledger import GeneratedLedger, generate_ledger, ledger_generator
 from .generator import Generator
 from .ledger_dsl import LedgerDSL, TransactionDSL, ledger
@@ -7,6 +9,7 @@ from .mocknetwork import MockNetwork, MockNode
 
 __all__ = [
     "ExpectRecorder",
+    "FaultInjector", "faults",
     "GeneratedLedger", "generate_ledger", "ledger_generator",
     "Generator",
     "LedgerDSL", "TransactionDSL", "ledger",

@@ -1,0 +1,125 @@
+"""Deterministic fault injection for recovery tests.
+
+The process-level chaos harness (`loadtest/chaos.py`) proves recovery by
+killing real OS processes, but a 10-minute soak cannot run in tier-1.
+This module makes the SAME failure modes provable in fast deterministic
+tests: seeded, scoped injection points on broker send/receive (drop /
+delay / duplicate), the verifier worker (crash before/after ack,
+corrupt response), and the notary commit path.
+
+    from corda_tpu.testing import faults
+
+    with faults.inject(seed=7) as fi:
+        fi.rule("verifier.worker", "crash_after_ack", times=1)
+        fi.rule("broker.send", "drop", match="verifier.requests",
+                probability=0.5)
+        ... drive the system; assert recovery invariants ...
+
+Rules are consulted in registration order; the first armed rule whose
+point, match and (seeded) probability agree supplies the action and
+consumes one of its `times`. Everything random comes from ONE
+`random.Random(seed)`, so a failing run replays exactly.
+
+`fire(point)` lets test code place ITS OWN injection points (e.g. a flow
+body raising a transient error on the first attempt only) through the
+same seeded rule machinery as the built-in seams.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, List, Optional
+
+from ..utils import faultpoints
+
+
+class Rule:
+    """One armed fault: point + action, optionally scoped and bounded."""
+
+    def __init__(self, point: str, action: Any, match: Optional[str] = None,
+                 times: Optional[int] = 1, probability: float = 1.0):
+        self.point = point
+        self.action = action
+        self.match = match
+        self.times = times  # None = unlimited
+        self.probability = probability
+        self.fired = 0
+
+    def _matches_detail(self, detail: dict) -> bool:
+        if self.match is None:
+            return True
+        return any(
+            self.match in str(v) for v in detail.values() if v is not None
+        )
+
+    def consider(self, rng: random.Random, point: str, detail: dict):
+        """The action if this rule fires for the crossing, else None."""
+        if point != self.point:
+            return None
+        if self.times is not None and self.fired >= self.times:
+            return None
+        if not self._matches_detail(detail):
+            return None
+        if self.probability < 1.0 and rng.random() >= self.probability:
+            return None
+        self.fired += 1
+        return self.action
+
+
+class FaultInjector:
+    """A seeded rule set implementing the faultpoints hook protocol."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.rules: List[Rule] = []
+        self._lock = threading.Lock()
+        self.log: List[tuple] = []  # (point, action) of every fired fault
+
+    def rule(self, point: str, action: Any, match: Optional[str] = None,
+             times: Optional[int] = 1, probability: float = 1.0) -> Rule:
+        """Arm one fault; returns the Rule (its `.fired` count is the
+        assertion surface for "the fault actually happened")."""
+        r = Rule(point, action, match=match, times=times,
+                 probability=probability)
+        with self._lock:
+            self.rules.append(r)
+        return r
+
+    def __call__(self, point: str, **detail):
+        with self._lock:
+            for r in self.rules:
+                action = r.consider(self.rng, point, detail)
+                if action is not None:
+                    self.log.append((point, action))
+                    return action
+        return None
+
+    def fire(self, point: str, **detail):
+        """Explicit injection point for test code (flow bodies, stubs):
+        raises the rule's action if it is an exception instance/class,
+        otherwise returns it (None when nothing fires)."""
+        action = self(point, **detail)
+        if isinstance(action, BaseException):
+            raise action
+        if isinstance(action, type) and issubclass(action, BaseException):
+            raise action(f"injected fault at {point}")
+        return action
+
+
+class inject:
+    """Scoped installation: `with faults.inject(seed=7) as fi:` arms `fi`
+    as the process fault hook and restores the previous hook on exit —
+    nestable, exception-safe, and never leaks into later tests."""
+
+    def __init__(self, seed: int = 0,
+                 injector: Optional[FaultInjector] = None):
+        self.injector = injector or FaultInjector(seed)
+        self._prev = None
+
+    def __enter__(self) -> FaultInjector:
+        self._prev = faultpoints.set_hook(self.injector)
+        return self.injector
+
+    def __exit__(self, *exc_info):
+        faultpoints.set_hook(self._prev)
+        return False

@@ -1,0 +1,51 @@
+"""Fault-injection seam registry (the production side of testing/faults).
+
+Subsystems with injectable failure points (broker send/receive, the
+verifier worker loop, the notary commit path) consult ONE process-global
+hook before acting. The hook is None in production — the per-call cost
+is a module-attribute read and a None check — and is installed only by
+`corda_tpu.testing.faults.inject(...)` (deterministic, seeded, scoped)
+or by a loadtest disruption. This module holds nothing but the registry
+so that messaging/verifier/node never import the testing package.
+
+Hook protocol: `hook(point, **detail) -> action | None`. Points and the
+actions each seam honours:
+
+  broker.send      queue=   -> "drop" | "duplicate" | ("delay", seconds)
+  broker.receive   queue=   -> "drop"   (consume-and-lose after delivery)
+  verifier.worker  request= -> "crash_before_ack" | "crash_after_ack"
+                               | "corrupt_response"
+  notary.commit    tx_id=   -> "unavailable" (seam raises) | ("delay", s)
+
+Unknown actions are ignored by every seam (forward compatibility: an
+injector aimed at a newer build must not crash an older one).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+#: the installed hook; seams read this attribute directly so the
+#: production fast path is one global load + None check
+hook: Optional[Callable[..., Any]] = None
+
+
+def set_hook(new_hook: Optional[Callable[..., Any]]):
+    """Install (or clear, with None) the process fault hook; returns the
+    previous one so scoped installers can restore it."""
+    global hook
+    prev, hook = hook, new_hook
+    return prev
+
+
+def fire(point: str, **detail) -> Any:
+    """Consult the hook for one seam crossing; None = act normally.
+    A hook that raises is a test bug, but it must surface as the fault
+    action "none" rather than corrupting the seam's own error handling —
+    the seam call sites sit on broker/worker hot loops."""
+    h = hook
+    if h is None:
+        return None
+    try:
+        return h(point, **detail)
+    except Exception:
+        return None

@@ -16,11 +16,13 @@ from .api import (
     VerificationResponse,
 )
 from .batcher import SignatureBatcher
+from .failover import CircuitBreaker, backoff_delay
 from .service import (
     InMemoryTransactionVerifierService,
     OutOfProcessTransactionVerifierService,
     TransactionVerifierService,
     VerificationError,
+    VerificationTimeoutError,
 )
 from .worker import VerifierWorker
 
@@ -30,8 +32,10 @@ __all__ = [
     "SignatureBatchRequest", "SignatureBatchResponse",
     "VerificationRequest", "VerificationResponse",
     "SignatureBatcher",
+    "CircuitBreaker", "backoff_delay",
     "InMemoryTransactionVerifierService",
     "OutOfProcessTransactionVerifierService",
     "TransactionVerifierService", "VerificationError",
+    "VerificationTimeoutError",
     "VerifierWorker",
 ]

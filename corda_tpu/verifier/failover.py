@@ -1,0 +1,136 @@
+"""Failover primitives for the out-of-process verification path.
+
+The committee-consensus measurements (PAPERS.md, arXiv:2302.00418) treat
+verifier failure and recomputation as a first-class cost; the
+permissioned-ledger engines (arXiv:2112.02229) assume the host can
+redispatch work around a failed accelerator. This module supplies the
+two mechanisms the service layer builds that on:
+
+  * `backoff_delay` — capped exponential backoff with full jitter for
+    redispatch pacing (jitter keeps N requesters that timed out together
+    from re-stampeding the queue in lockstep);
+  * `CircuitBreaker` — the classic closed → open → half-open machine.
+    Closed counts consecutive failures and trips at a threshold (or
+    immediately via `trip()` when the caller KNOWS the backend is gone,
+    e.g. a zero-consumer queue). Open fails fast for a cooldown window,
+    then half-open admits exactly one probe: its success closes the
+    breaker, its failure re-opens it for another cooldown.
+
+Both are deliberately dependency-free (stdlib only) so the worker
+process and the node import the same code.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+#: numeric encoding for the Prometheus gauge (strings cannot ride a
+#: gauge sample): closed=0, half-open=1, open=2
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+def backoff_delay(attempt: int, base_s: float = 0.2, cap_s: float = 5.0,
+                  rng: Optional[random.Random] = None) -> float:
+    """Delay before redispatch `attempt` (1-based): exponential growth
+    capped at `cap_s`, scaled by full jitter in [0.5, 1.0)."""
+    raw = min(cap_s, base_s * (2 ** max(0, attempt - 1)))
+    r = rng.random() if rng is not None else random.random()
+    return raw * (0.5 + r / 2)
+
+
+class CircuitBreaker:
+    """Thread-safe three-state breaker guarding one backend."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_outstanding = False
+        self.trips = 0  # lifetime open transitions (telemetry)
+        self.last_trip_reason: Optional[str] = None
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        return STATE_CODES[self.state]
+
+    def _maybe_half_open_locked(self) -> None:
+        if (
+            self._state == OPEN
+            and self._clock() - self._opened_at >= self.cooldown_s
+        ):
+            self._state = HALF_OPEN
+            self._probe_outstanding = False
+
+    def allow_request(self) -> bool:
+        """May the next request go to the guarded backend? Closed: yes.
+        Open: no (fail over) until the cooldown elapses. Half-open: yes
+        for exactly ONE in-flight probe; concurrent requests keep failing
+        over until the probe settles."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_outstanding:
+                self._probe_outstanding = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_outstanding = False
+            self._state = CLOSED
+
+    def record_failure(self, reason: str = "failure") -> None:
+        """One backend failure; trips to open at the threshold (a
+        half-open probe failure re-opens immediately)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN or (
+                self._consecutive_failures >= self.failure_threshold
+            ):
+                self._trip_locked(reason)
+
+    def trip(self, reason: str) -> None:
+        """Open NOW, bypassing the threshold — for callers with direct
+        evidence the backend is gone (empty worker pool)."""
+        with self._lock:
+            self._trip_locked(reason)
+
+    def _trip_locked(self, reason: str) -> None:
+        if self._state != OPEN:
+            # stamp the cooldown clock only on the TRANSITION into open:
+            # trailing timeouts of requests already in flight when the
+            # pool died would otherwise keep sliding the half-open probe
+            # past the configured cooldown
+            self.trips += 1
+            self._opened_at = self._clock()
+        self._state = OPEN
+        self._probe_outstanding = False
+        self.last_trip_reason = reason
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "trips": self.trips,
+                "last_trip_reason": self.last_trip_reason,
+            }

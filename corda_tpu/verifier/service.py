@@ -10,6 +10,8 @@ Reference parity:
 """
 from __future__ import annotations
 
+import os
+import random
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -19,7 +21,7 @@ from ..core.crypto.secure_hash import random_63_bit_value
 from ..core.serialization.codec import deserialize, serialize
 from ..core.transactions.ledger import LedgerTransaction
 from ..messaging import Broker
-from ..utils import tracing
+from ..utils import eventlog, timerwheel, tracing
 from ..utils.metrics import MetricRegistry
 from .api import (
     VERIFICATION_REQUESTS_QUEUE_NAME,
@@ -30,10 +32,17 @@ from .api import (
     VerificationResponse,
 )
 from .batcher import Item, SignatureBatcher
+from .failover import CircuitBreaker, backoff_delay
 
 
 class VerificationError(Exception):
     """A transaction failed verification on the verifier side."""
+
+
+class VerificationTimeoutError(VerificationError):
+    """An out-of-process verification request exceeded its deadline
+    budget and was dead-lettered (no worker answered after every
+    redispatch attempt, and no fallback backend was available)."""
 
 
 class TransactionVerifierService:
@@ -132,6 +141,11 @@ class _Metrics:
         self._failure = registry.counter("Verification.Failure")
         self._duration = registry.timer("Verification.Duration")
         registry.gauge("Verification.InFlight", in_flight_fn)
+        # failover telemetry (this PR's failure-handling layer)
+        self.redispatched = registry.counter("Verification.Redispatched")
+        self.dead_lettered = registry.counter("Verification.DeadLettered")
+        self.fallback_served = registry.counter("Verification.FallbackServed")
+        self.malformed = registry.counter("Verification.MalformedResponses")
 
     def record(self, ok: bool, seconds: Optional[float]) -> None:
         (self._success if ok else self._failure).inc()
@@ -161,16 +175,58 @@ class _Metrics:
             return list(timer._durations)
 
 
+class _Inflight:
+    """One supervised out-of-process request: everything the deadline
+    supervisor needs to redispatch it (the serialized request bytes),
+    fail it over (the original payload objects), or dead-letter it."""
+
+    __slots__ = (
+        "nonce", "kind", "blob", "futures", "payload", "t0", "attempts",
+        "timer", "ctx",
+    )
+
+    def __init__(self, nonce: int, kind: str, blob: bytes, futures: List[Future],
+                 payload, ctx):
+        self.nonce = nonce
+        self.kind = kind  # "tx" | "sigs"
+        self.blob = blob
+        self.futures = futures
+        self.payload = payload  # LedgerTransaction | list of Items
+        self.t0 = time.monotonic()
+        self.attempts = 1  # dispatch attempts so far (first send included)
+        self.timer = None  # TimerHandle of the armed deadline/redispatch
+        self.ctx = ctx
+
+
 class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     """Fans verification out over the broker to external verifier workers.
 
     A nonce keys each request to its future; a consumer thread on this
     node's private response queue completes them.  Competing consumers on
     the shared request queue give worker elasticity for free.
+
+    Failure handling (the robustness layer): every request carries a
+    deadline served off the shared timer wheel. A request that times out
+    is REDISPATCHED (same nonce — a late reply from the first attempt
+    completes it and the second reply is ignored) with exponential
+    backoff + jitter, up to `max_retries` extra attempts, after which it
+    is dead-lettered into a `VerificationTimeoutError`. A circuit
+    breaker trips when the worker pool is observed empty at a deadline
+    or when failures stack up; while open (and until a half-open probe
+    succeeds), requests are served by a lazily-constructed IN-PROCESS
+    fallback backend so flows keep completing through a total worker
+    outage. Knobs: CORDA_TPU_VERIFY_DEADLINE (s, <=0 disables
+    supervision), CORDA_TPU_VERIFY_RETRIES, CORDA_TPU_VERIFY_BACKOFF_S,
+    CORDA_TPU_VERIFY_BREAKER_THRESHOLD / _COOLDOWN,
+    CORDA_TPU_VERIFY_FALLBACK=0 (dead-letter instead of falling back).
     """
 
     def __init__(self, broker: Broker, node_name: str,
-                 metrics: Optional[MetricRegistry] = None):
+                 metrics: Optional[MetricRegistry] = None,
+                 deadline_s: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 fallback: Optional[bool] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         """`metrics`: the node's shared MetricRegistry (a private one is
         created when standalone, so the read surface always works)."""
         self._broker = broker
@@ -179,17 +235,38 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         )
         broker.create_queue(VERIFICATION_REQUESTS_QUEUE_NAME)
         broker.create_queue(self._response_queue)
-        self._pending: Dict[int, Future] = {}
-        self._started: Dict[int, float] = {}
-        self._sig_pending: Dict[int, List[Future]] = {}
-        # nonce -> requester trace context (requester-side spans for the
-        # out-of-process hop: the worker lives in another process, so the
-        # round trip is recorded here, at reply time)
-        self._trace_ctxs: Dict[int, Optional[tracing.SpanContext]] = {}
+        self._inflight: Dict[int, _Inflight] = {}
         self._lock = threading.Lock()
         self.metrics = _Metrics(
-            metrics or MetricRegistry(), lambda: len(self._pending)
+            metrics or MetricRegistry(), lambda: len(self._inflight)
         )
+        env = os.environ
+        self._deadline = (
+            deadline_s if deadline_s is not None
+            else float(env.get("CORDA_TPU_VERIFY_DEADLINE", 10.0))
+        )
+        self._max_retries = (
+            max_retries if max_retries is not None
+            else int(env.get("CORDA_TPU_VERIFY_RETRIES", 2))
+        )
+        self._backoff_base = float(env.get("CORDA_TPU_VERIFY_BACKOFF_S", 0.2))
+        self._fallback_enabled = (
+            fallback if fallback is not None
+            else env.get("CORDA_TPU_VERIFY_FALLBACK", "1") != "0"
+        )
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=int(
+                env.get("CORDA_TPU_VERIFY_BREAKER_THRESHOLD", 3)
+            ),
+            cooldown_s=float(
+                env.get("CORDA_TPU_VERIFY_BREAKER_COOLDOWN", 5.0)
+            ),
+        )
+        self.metrics.registry.gauge(
+            "Verification.BreakerState", lambda: self.breaker.state_code
+        )
+        self._rng = random.Random()  # jitter only; no determinism contract
+        self._fallback: Optional[InMemoryTransactionVerifierService] = None
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(self._response_queue)
         self._thread = threading.Thread(
@@ -200,30 +277,221 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
     # -- request side ------------------------------------------------------
 
-    def verify(self, ltx: LedgerTransaction) -> Future:
+    def _submit(self, kind: str, payload, futures: List[Future],
+                make_request) -> None:
+        """Register + dispatch one supervised request. When the breaker
+        is open (and fallback is on), skip the broker entirely — the
+        worker pool is known-dead and the deadline would only add
+        latency to the inevitable failover."""
+        if self._fallback_enabled and not self.breaker.allow_request():
+            entry = _Inflight(0, kind, b"", futures, payload,
+                              tracing.current_context())
+            self._serve_via_fallback(entry, cause="breaker open")
+            return
         nonce = random_63_bit_value()
-        fut: Future = Future()
+        blob = serialize(make_request(nonce))
+        entry = _Inflight(nonce, kind, blob, futures, payload,
+                          tracing.current_context())
         with self._lock:
-            self._pending[nonce] = fut
-            self._started[nonce] = time.monotonic()
-            self._trace_ctxs[nonce] = tracing.current_context()
-        req = VerificationRequest(nonce, ltx, self._response_queue)
-        self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
+            self._inflight[nonce] = entry
+            if self._deadline > 0:
+                entry.timer = timerwheel.call_later(
+                    self._deadline, lambda: self._on_deadline(nonce)
+                )
+        try:
+            self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, blob)
+        except Exception as exc:
+            # broker gone at submit time: resolve NOW, never strand
+            self._finish_undeliverable(nonce, f"broker send failed: {exc}")
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        fut: Future = Future()
+        self._submit(
+            "tx", ltx, [fut],
+            lambda nonce: VerificationRequest(nonce, ltx, self._response_queue),
+        )
         return fut
 
     def verify_signatures(self, items: Sequence[Item]) -> List[Future]:
-        nonce = random_63_bit_value()
+        items = list(items)
         futures = [Future() for _ in items]
-        with self._lock:
-            self._sig_pending[nonce] = futures
-            self._started[nonce] = time.monotonic()
-            self._trace_ctxs[nonce] = tracing.current_context()
-        req = SignatureBatchRequest(nonce, tuple(items), self._response_queue)
-        self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, serialize(req))
+        self._submit(
+            "sigs", items, futures,
+            lambda nonce: SignatureBatchRequest(
+                nonce, tuple(items), self._response_queue
+            ),
+        )
         return futures
 
     def worker_count(self) -> int:
         return self._broker.consumer_count(VERIFICATION_REQUESTS_QUEUE_NAME)
+
+    # -- deadline supervision ----------------------------------------------
+
+    def _pop(self, nonce: int) -> Optional[_Inflight]:
+        with self._lock:
+            entry = self._inflight.pop(nonce, None)
+        if entry is not None and entry.timer is not None:
+            entry.timer.cancel()
+        return entry
+
+    def _on_deadline(self, nonce: int) -> None:
+        """Timer-wheel callback: the request's current attempt exceeded
+        its deadline. Decide redispatch vs failover vs dead-letter."""
+        with self._lock:
+            entry = self._inflight.get(nonce)
+            if entry is None:
+                return  # completed while the timer fired
+            attempts = entry.attempts
+        workers = self.worker_count()
+        exhausted = attempts > self._max_retries
+        if workers == 0:
+            # direct evidence the pool is gone: trip so NEW requests skip
+            # the broker while the outage lasts
+            self.breaker.trip("worker pool empty at deadline")
+        elif exhausted:
+            self.breaker.record_failure("deadline exhausted")
+        # With the fallback ON, an empty pool fails over immediately —
+        # waiting out the retry budget only adds latency to the
+        # inevitable. With it OFF, an empty pool still gets the full
+        # redispatch budget: a respawning worker (the chaos worker_kill
+        # heal pattern) can pick the retry up, and dead-letter is final.
+        fail_over_now = exhausted or (workers == 0 and self._fallback_enabled)
+        breaker_gating = (
+            self._fallback_enabled and not self.breaker.allow_request()
+        )
+        if breaker_gating and not fail_over_now:
+            # this request timed out while the breaker gates the pool —
+            # including the half-open PROBE itself: count the failure so
+            # a timed-out probe re-opens the breaker (and frees the probe
+            # slot) instead of wedging half-open forever
+            self.breaker.record_failure("timeout while breaker gating")
+        if fail_over_now or breaker_gating:
+            entry = self._pop(nonce)
+            if entry is None:
+                return
+            cause = (
+                "worker pool empty" if workers == 0
+                else f"no response after {attempts} attempts"
+            )
+            if self._fallback_enabled:
+                self._serve_via_fallback(entry, cause=cause)
+            else:
+                self._dead_letter(entry, cause=cause)
+            return
+        # redispatch: same nonce (a late first-attempt reply still
+        # completes; the duplicate reply is dropped by the nonce pop)
+        with self._lock:
+            entry = self._inflight.get(nonce)
+            if entry is None:
+                return
+            entry.attempts += 1
+            delay = backoff_delay(
+                entry.attempts - 1, base_s=self._backoff_base, rng=self._rng
+            )
+            entry.timer = timerwheel.call_later(
+                delay, lambda: self._redispatch(nonce)
+            )
+        self.metrics.redispatched.inc()
+        eventlog.emit(
+            "warning", "verifier", "verification request redispatched",
+            nonce=nonce, attempt=entry.attempts, backoff_s=round(delay, 3),
+            workers=workers, kind=entry.kind,
+        )
+
+    def _redispatch(self, nonce: int) -> None:
+        with self._lock:
+            entry = self._inflight.get(nonce)
+            if entry is None:
+                return
+            blob = entry.blob
+            if self._deadline > 0:
+                entry.timer = timerwheel.call_later(
+                    self._deadline, lambda: self._on_deadline(nonce)
+                )
+        try:
+            self._broker.send(VERIFICATION_REQUESTS_QUEUE_NAME, blob)
+        except Exception as exc:
+            self._finish_undeliverable(nonce, f"broker send failed: {exc}")
+
+    def _finish_undeliverable(self, nonce: int, cause: str) -> None:
+        entry = self._pop(nonce)
+        if entry is None:
+            return
+        if self._fallback_enabled:
+            self._serve_via_fallback(entry, cause=cause)
+        else:
+            self._dead_letter(entry, cause=cause)
+
+    # -- failover endpoints --------------------------------------------------
+
+    def _fallback_backend(self) -> InMemoryTransactionVerifierService:
+        with self._lock:
+            if self._stop.is_set():
+                # a deadline callback racing stop() must not lazily
+                # re-create a backend nobody will ever stop
+                raise RuntimeError("verifier service stopped")
+            if self._fallback is None:
+                self._fallback = InMemoryTransactionVerifierService(
+                    batcher=SignatureBatcher()
+                )
+            return self._fallback
+
+    def _serve_via_fallback(self, entry: _Inflight, cause: str) -> None:
+        """Complete the request on the in-process backend, chaining its
+        futures onto the ones callers already hold."""
+        self.metrics.fallback_served.inc()
+        eventlog.emit(
+            "warning", "verifier", "request served by in-process fallback",
+            cause=cause, kind=entry.kind, items=len(entry.futures),
+            breaker=self.breaker.state,
+        )
+
+        def chain(src: Future, dst: Future) -> None:
+            def done(s: Future) -> None:
+                if dst.done():
+                    return
+                exc = s.exception()
+                if exc is not None:
+                    dst.set_exception(exc)
+                else:
+                    dst.set_result(s.result())
+            src.add_done_callback(done)
+
+        try:
+            fb = self._fallback_backend()
+            if entry.kind == "tx":
+                chain(fb.verify(entry.payload), entry.futures[0])
+            else:
+                for src, dst in zip(
+                    fb.verify_signatures(entry.payload), entry.futures
+                ):
+                    chain(src, dst)
+        except Exception as exc:  # fallback refused (e.g. closed mid-stop)
+            self._dead_letter(entry, cause=f"{cause}; fallback failed: {exc}")
+
+    @staticmethod
+    def _resolve_with_error(entry: _Inflight, exc: VerificationError) -> None:
+        """THE error contract, encoded once: a tx verify() future
+        RESOLVES to the error (verify_sync raises it), signature futures
+        raise it; already-done futures are left alone."""
+        if entry.kind == "tx":
+            if not entry.futures[0].done():
+                entry.futures[0].set_result(exc)
+        else:
+            for fut in entry.futures:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    def _dead_letter(self, entry: _Inflight, cause: str) -> None:
+        self.metrics.dead_lettered.inc()
+        eventlog.emit(
+            "error", "verifier", "verification request dead-lettered",
+            cause=cause, kind=entry.kind, items=len(entry.futures),
+        )
+        self._resolve_with_error(entry, VerificationTimeoutError(
+            f"verification gave up after {entry.attempts} attempts: {cause}"
+        ))
 
     # -- response side -----------------------------------------------------
 
@@ -234,47 +502,71 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
                 continue
             try:
                 resp = deserialize(msg.payload)
+                known = isinstance(
+                    resp, (VerificationResponse, SignatureBatchResponse)
+                )
+            except Exception as exc:
+                resp, known, decode_error = None, False, exc
+            else:
+                decode_error = None
+            if not known:
+                # malformed (undecodable or unexpected type): count it
+                # and say WHICH queue carried it — silence here cost a
+                # debugging session per occurrence
+                self.metrics.malformed.inc()
+                eventlog.emit(
+                    "warning", "verifier", "malformed verification response",
+                    queue=self._response_queue,
+                    error=(
+                        f"{type(decode_error).__name__}: {decode_error}"
+                        if decode_error is not None
+                        else f"unexpected type {type(resp).__name__}"
+                    ),
+                )
+                try:
+                    self._consumer.ack(msg)
+                except Exception:
+                    pass
+                continue
+            try:
                 if isinstance(resp, VerificationResponse):
                     self._complete_tx(resp)
-                elif isinstance(resp, SignatureBatchResponse):
+                else:
                     self._complete_sigs(resp)
                 self._consumer.ack(msg)
             except Exception:
-                # A malformed response — or an ack racing stop()'s consumer
-                # close — must not kill the completer thread.
+                # An ack racing stop()'s consumer close must not kill
+                # the completer thread.
                 pass
 
     def _complete_tx(self, resp: VerificationResponse) -> None:
-        with self._lock:
-            fut = self._pending.pop(resp.verification_id, None)
-            t0 = self._started.pop(resp.verification_id, None)
-            ctx = self._trace_ctxs.pop(resp.verification_id, None)
-            if fut is None:
-                return
-        elapsed = time.monotonic() - t0 if t0 is not None else None
+        entry = self._pop(resp.verification_id)
+        if entry is None:
+            return  # duplicate reply after redispatch/failover
+        elapsed = time.monotonic() - entry.t0
         self.metrics.record(resp.error is None, elapsed)
-        if ctx is not None and elapsed is not None:
+        self.breaker.record_success()
+        if entry.ctx is not None:
             tracing.get_tracer().record_span(
-                "verifier.verify", elapsed, parent=ctx, remote=True,
+                "verifier.verify", elapsed, parent=entry.ctx, remote=True,
             )
-        fut.set_result(
+        entry.futures[0].set_result(
             None if resp.error is None else VerificationError(resp.error)
         )
 
     def _complete_sigs(self, resp: SignatureBatchResponse) -> None:
-        with self._lock:
-            futures = self._sig_pending.pop(resp.verification_id, None)
-            t0 = self._started.pop(resp.verification_id, None)
-            ctx = self._trace_ctxs.pop(resp.verification_id, None)
-        if futures is None:
+        entry = self._pop(resp.verification_id)
+        if entry is None:
             return
-        if ctx is not None and t0 is not None:
+        futures = entry.futures
+        self.breaker.record_success()
+        if entry.ctx is not None:
             # the worker process batches OUR items with other nodes' —
             # its own tracer has the true fan-in; this span records the
             # round trip as seen from the requesting trace
             tracing.get_tracer().record_span(
-                "verifier.batch", time.monotonic() - t0, links=(ctx,),
-                items=len(futures), remote=True,
+                "verifier.batch", time.monotonic() - entry.t0,
+                links=(entry.ctx,), items=len(futures), remote=True,
             )
         if resp.error is not None or len(resp.valid) != len(futures):
             exc = VerificationError(resp.error or "verdict count mismatch")
@@ -285,14 +577,34 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             fut.set_result(bool(ok))
 
     def healthcheck(self) -> dict:
-        return {
+        detail = {
             "ok": not self._stop.is_set() and self._thread.is_alive(),
             "backend": "out-of-process",
             "workers": self.worker_count(),
-            "in_flight": len(self._pending),
+            "in_flight": len(self._inflight),
+            "breaker": self.breaker.state,
+            "breaker_trips": self.breaker.trips,
+            "fallback_active": self._fallback is not None,
         }
+        return detail
 
     def stop(self) -> None:
         self._stop.set()
         self._consumer.close()
         self._thread.join(timeout=2)
+        # Drain every still-pending future: a caller blocked on a reply
+        # that can now never arrive must fail fast, not hang past
+        # shutdown.
+        with self._lock:
+            entries = list(self._inflight.values())
+            self._inflight.clear()
+        for entry in entries:
+            if entry.timer is not None:
+                entry.timer.cancel()
+            self._resolve_with_error(
+                entry, VerificationError("verifier service stopped")
+            )
+        with self._lock:
+            fallback, self._fallback = self._fallback, None
+        if fallback is not None:
+            fallback.stop()

@@ -20,6 +20,7 @@ from typing import Optional
 
 from ..core.serialization.codec import deserialize, serialize
 from ..messaging import Broker
+from ..utils import faultpoints
 from .api import (
     VERIFICATION_REQUESTS_QUEUE_NAME,
     SignatureBatchRequest,
@@ -47,6 +48,7 @@ class VerifierWorker:
         )
         self._thread: Optional[threading.Thread] = None
         self.verified_count = 0
+        self.crashed = False  # set when a fault injection killed the loop
 
     def start(self) -> "VerifierWorker":
         self._thread = threading.Thread(
@@ -67,6 +69,32 @@ class VerifierWorker:
                 # recoverable): ack it away rather than redeliver forever.
                 self._consumer.ack(msg)
                 continue
+            if faultpoints.hook is not None:
+                action = faultpoints.fire(
+                    "verifier.worker", request=type(request).__name__,
+                    worker=self.name,
+                )
+                if action == "crash_before_ack":
+                    # hard death mid-verify: the unacked request returns
+                    # to the queue for a surviving worker
+                    self._die()
+                    return
+                if action == "crash_after_ack":
+                    # the NASTY mode: the broker thinks the request was
+                    # handled, but the response is lost forever — only a
+                    # requester-side deadline can recover this
+                    self._consumer.ack(msg)
+                    self._die()
+                    return
+                if action == "corrupt_response":
+                    reply_to = getattr(request, "response_address", None)
+                    if reply_to is not None:
+                        try:
+                            self._broker.send(reply_to, b"\xde\xad\xbe\xef")
+                        except Exception:
+                            pass
+                    self._consumer.ack(msg)
+                    continue
             response = self._handle(request)
             if response is not None:
                 reply_to, payload = response
@@ -100,6 +128,14 @@ class VerifierWorker:
                 )
             return request.response_address, serialize(resp)
         return None
+
+    def _die(self) -> None:
+        """Simulated crash from inside the consume loop: stop consuming
+        and release the consumer session exactly as a dead process would
+        (the broker requeues whatever was left unacked)."""
+        self.crashed = True
+        self._stop.set()
+        self._consumer.close()
 
     def stop(self, graceful: bool = True) -> None:
         """graceful=False mimics a crash: in-flight work is NOT acked, so the

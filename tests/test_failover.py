@@ -1,0 +1,594 @@
+"""Verifier failover: deadlines, redispatch, circuit breaker, in-process
+fallback, and the deterministic fault-injection seams that prove them
+(docs/robustness.md).
+
+The headline invariant (ISSUE 4 acceptance): with fault injection
+crashing the SOLE verifier worker after ack, every in-flight
+verify_signatures future still completes — zero hung futures — and the
+health surface reflects the tripped (then recovered) breaker.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from corda_tpu.core.crypto import crypto
+from corda_tpu.messaging import Broker
+from corda_tpu.messaging.broker import UnknownQueueError
+from corda_tpu.testing import faults
+from corda_tpu.utils import faultpoints
+from corda_tpu.verifier import (
+    CircuitBreaker,
+    OutOfProcessTransactionVerifierService,
+    VerificationError,
+    VerificationTimeoutError,
+    VerifierWorker,
+    backoff_delay,
+)
+
+
+def _items(n, entropy0=7000):
+    items = []
+    for i in range(n):
+        kp = crypto.entropy_to_keypair(entropy0 + i)
+        content = b"failover-msg-%d" % i
+        items.append((kp.public, crypto.do_sign(kp.private, content), content))
+    return items
+
+
+def _ltx():
+    """A minimal valid LedgerTransaction (local contract/state types:
+    importing another test module's helpers would re-register its codec
+    adapters under a second module name in full-suite runs)."""
+    from dataclasses import dataclass
+    from typing import List
+
+    from corda_tpu.core.contracts import (
+        Contract, ContractState, TypeOnlyCommandData, contract,
+    )
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.serialization.codec import corda_serializable
+    from corda_tpu.core.transactions import TransactionBuilder
+
+    global _FO_TYPES
+    try:
+        _FO_TYPES
+    except NameError:
+        @corda_serializable
+        @dataclass(frozen=True)
+        class FailoverState(ContractState):
+            magic: int = 7
+            contract_name = "FailoverContract"
+
+            @property
+            def participants(self) -> List:
+                return []
+
+        @contract(name="FailoverContract")
+        class FailoverContract(Contract):
+            def verify(self, tx) -> None:
+                pass
+
+        @corda_serializable
+        @dataclass(frozen=True)
+        class FailoverCommand(TypeOnlyCommandData):
+            pass
+
+        _FO_TYPES = (FailoverState, FailoverCommand)
+    state_cls, cmd_cls = _FO_TYPES
+    kp = crypto.entropy_to_keypair(88)
+    notary_kp = crypto.entropy_to_keypair(89)
+    notary = Party("O=FailoverNotary,L=Zurich,C=CH", notary_kp.public)
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(state_cls())
+    b.add_command(cmd_cls(), kp.public)
+    wtx = b.to_wire_transaction()
+    return wtx.to_ledger_transaction(
+        resolve_state=lambda ref: (_ for _ in ()).throw(AssertionError),
+        resolve_attachment=lambda h: (_ for _ in ()).throw(AssertionError),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault injector mechanics
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_rules_are_scoped_bounded_and_seeded(self):
+        fi = faults.FaultInjector(seed=42)
+        r1 = fi.rule("broker.send", "drop", match="verifier.", times=2)
+        fi.rule("broker.send", "duplicate", times=None)
+        # scoped: non-matching queue falls through to the unlimited rule
+        assert fi("broker.send", queue="p2p.inbound") == "duplicate"
+        # matching queue consumes the bounded rule first
+        assert fi("broker.send", queue="verifier.requests") == "drop"
+        assert fi("broker.send", queue="verifier.requests") == "drop"
+        assert r1.fired == 2
+        # exhausted: falls through
+        assert fi("broker.send", queue="verifier.requests") == "duplicate"
+        # same seed -> same probabilistic decisions
+        a = faults.FaultInjector(seed=9)
+        b = faults.FaultInjector(seed=9)
+        a.rule("p", "x", times=None, probability=0.5)
+        b.rule("p", "x", times=None, probability=0.5)
+        seq_a = [a("p") for _ in range(32)]
+        seq_b = [b("p") for _ in range(32)]
+        assert seq_a == seq_b
+
+    def test_inject_scopes_and_restores_the_hook(self):
+        assert faultpoints.hook is None
+        with faults.inject(seed=1) as fi:
+            assert faultpoints.hook is fi
+            with faults.inject(seed=2) as inner:
+                assert faultpoints.hook is inner
+            assert faultpoints.hook is fi
+        assert faultpoints.hook is None
+
+    def test_fire_raises_exception_actions(self):
+        fi = faults.FaultInjector()
+        fi.rule("custom.point", ValueError("boom"), times=1)
+        with pytest.raises(ValueError):
+            fi.fire("custom.point")
+        assert fi.fire("custom.point") is None  # consumed
+
+
+# ---------------------------------------------------------------------------
+# Broker seams
+# ---------------------------------------------------------------------------
+
+class TestBrokerFaults:
+    def test_send_drop_and_duplicate(self):
+        broker = Broker()
+        broker.create_queue("q")
+        with faults.inject() as fi:
+            fi.rule("broker.send", "drop", times=1)
+            fi.rule("broker.send", "duplicate", times=1)
+            broker.send("q", b"lost")       # dropped
+            broker.send("q", b"twice")      # duplicated
+        assert broker.message_count("q") == 2
+        c = broker.create_consumer("q")
+        m1, m2 = c.receive(timeout=1), c.receive(timeout=1)
+        assert m1.payload == m2.payload == b"twice"
+        assert m1.message_id != m2.message_id
+        # dropped sends still honour the queue-must-exist contract
+        with faults.inject() as fi:
+            fi.rule("broker.send", "drop", times=1)
+            with pytest.raises(UnknownQueueError):
+                broker.send("nope", b"x")
+
+    def test_send_delay_delivers_later(self):
+        broker = Broker()
+        broker.create_queue("q")
+        with faults.inject() as fi:
+            fi.rule("broker.send", ("delay", 0.15), times=1)
+            broker.send("q", b"slow")
+        assert broker.message_count("q") == 0
+        c = broker.create_consumer("q")
+        msg = c.receive(timeout=5)
+        assert msg is not None and msg.payload == b"slow"
+
+    def test_receive_drop_consumes_and_loses(self):
+        broker = Broker()
+        broker.create_queue("q")
+        broker.send("q", b"a")
+        broker.send("q", b"b")
+        c = broker.create_consumer("q")
+        with faults.inject() as fi:
+            fi.rule("broker.receive", "drop", times=1)
+            msg = c.receive(timeout=1)
+        # the first message vanished; the second arrived normally
+        assert msg.payload == b"b"
+        assert broker.message_count("q") == 0
+
+    def test_receive_many_honours_the_drop_seam(self):
+        """The P2P pump prefers receive_many: the seam must cover it too,
+        or pump-path loss injection would silently never fire."""
+        broker = Broker()
+        broker.create_queue("q")
+        for i in range(4):
+            broker.send("q", b"m%d" % i)
+        c = broker.create_consumer("q")
+        with faults.inject() as fi:
+            rule = fi.rule("broker.receive", "drop", times=2)
+            batch = c.receive_many(10, timeout=1)
+        assert rule.fired == 2
+        assert [m.payload for m in batch] == [b"m2", b"m3"]
+
+
+# ---------------------------------------------------------------------------
+# Failover primitives
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_trip_cooldown_halfopen_probe_cycle(self):
+        now = [0.0]
+        cb = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                            clock=lambda: now[0])
+        assert cb.state == "closed" and cb.allow_request()
+        cb.record_failure()
+        assert cb.state == "closed"
+        cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow_request()  # failing fast
+        now[0] = 1.5
+        assert cb.state == "half-open"
+        assert cb.allow_request()       # the single probe
+        assert not cb.allow_request()   # concurrent requests keep failing over
+        cb.record_failure()             # probe failed -> reopen
+        assert cb.state == "open"
+        now[0] = 3.0
+        assert cb.allow_request()
+        cb.record_success()
+        assert cb.state == "closed"
+        assert cb.trips == 2
+
+    def test_direct_trip_and_backoff_shape(self):
+        cb = CircuitBreaker(failure_threshold=99)
+        cb.trip("worker pool empty")
+        assert cb.state == "open"
+        assert cb.last_trip_reason == "worker pool empty"
+        import random
+
+        rng = random.Random(3)
+        delays = [backoff_delay(a, base_s=0.1, cap_s=1.0, rng=rng)
+                  for a in range(1, 8)]
+        assert all(0.05 <= d <= 1.0 for d in delays)
+        # exponential up to the cap (jitter keeps them within [raw/2, raw])
+        assert delays[6] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# The failover service itself
+# ---------------------------------------------------------------------------
+
+class TestVerifierFailover:
+    def test_kill_sole_worker_after_ack_zero_hung_futures(self):
+        """THE acceptance invariant: the nasty crash-after-ack mode (the
+        broker believes the request was handled; the response is lost
+        forever) on a one-worker pool. Every future must still complete
+        within the deadline budget, and the breaker must show the trip."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeFailover", deadline_s=0.25, max_retries=1,
+        )
+        worker = VerifierWorker(broker, name="sole").start()
+        try:
+            with faults.inject(seed=7) as fi:
+                rule = fi.rule("verifier.worker", "crash_after_ack", times=1)
+                futures = svc.verify_signatures(_items(8))
+                results = [f.result(timeout=10) for f in futures]
+            assert rule.fired == 1
+            assert worker.crashed
+            assert results == [True] * 8
+            assert svc.metrics.fallback_served.value >= 1
+            hc = svc.healthcheck()
+            assert hc["breaker"] in ("open", "half-open")
+            assert hc["breaker_trips"] >= 1
+            assert hc["fallback_active"] is True
+            assert hc["workers"] == 0
+            # nothing left supervised
+            assert len(svc._inflight) == 0
+        finally:
+            worker.stop(graceful=False)
+            svc.stop()
+
+    def test_crash_before_ack_redelivers_to_survivor(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeRedeliver", deadline_s=5.0,
+        )
+        doomed = VerifierWorker(broker, name="doomed").start()
+        survivor = VerifierWorker(broker, name="survivor").start()
+        try:
+            with faults.inject() as fi:
+                fi.rule("verifier.worker", "crash_before_ack", times=1,
+                        match="doomed")
+                futures = svc.verify_signatures(_items(4, entropy0=7200))
+                assert all(f.result(timeout=10) for f in futures)
+            # broker-level redelivery, no deadline needed
+            assert svc.metrics.redispatched.value == 0
+            assert survivor.verified_count >= 1
+        finally:
+            doomed.stop(graceful=False)
+            survivor.stop()
+            svc.stop()
+
+    def test_lost_response_redispatches_to_live_pool(self):
+        """crash_after_ack with a SECOND worker alive: the deadline
+        supervisor redispatches (same nonce) instead of falling back."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeRedispatch", deadline_s=0.25, max_retries=2,
+        )
+        w1 = VerifierWorker(broker, name="victim").start()
+        w2 = VerifierWorker(broker, name="backup").start()
+        try:
+            with faults.inject() as fi:
+                rule = fi.rule("verifier.worker", "crash_after_ack",
+                               times=1, match="victim")
+                futures = svc.verify_signatures(_items(4, entropy0=7300))
+                assert all(f.result(timeout=15) for f in futures)
+            assert rule.fired == 1
+            assert svc.metrics.redispatched.value >= 1
+            assert svc.metrics.fallback_served.value == 0
+            assert svc.breaker.state == "closed"  # success closed it
+        finally:
+            w1.stop(graceful=False)
+            w2.stop()
+            svc.stop()
+
+    def test_empty_pool_with_fallback_off_still_spends_retry_budget(self):
+        """Without a fallback, a momentarily-empty pool must NOT skip
+        straight to dead-letter: a worker respawning during the backoff
+        window (the chaos worker_kill heal pattern) picks up the retry."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeRespawn", deadline_s=0.2, max_retries=3,
+            fallback=False,
+        )
+        try:
+            futures = svc.verify_signatures(_items(2, entropy0=7450))
+            # wait for the first deadline to fire with zero workers
+            deadline = time.monotonic() + 5
+            while svc.metrics.redispatched.value == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            worker = VerifierWorker(broker, name="respawned").start()
+            assert all(f.result(timeout=15) for f in futures)
+            assert svc.metrics.redispatched.value >= 1
+            assert svc.metrics.dead_lettered.value == 0
+            worker.stop()
+        finally:
+            svc.stop()
+
+    def test_dead_letter_when_fallback_disabled(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeDeadLetter", deadline_s=0.1, max_retries=1,
+            fallback=False,
+        )
+        try:
+            # no workers at all: exhaust the budget, then dead-letter
+            futures = svc.verify_signatures(_items(2, entropy0=7400))
+            for fut in futures:
+                with pytest.raises(VerificationTimeoutError):
+                    fut.result(timeout=10)
+            assert svc.metrics.dead_lettered.value == 1
+            # tx verify: the future RESOLVES to the error (verify contract)
+            err = svc.verify(_ltx()).result(timeout=10)
+            assert isinstance(err, VerificationTimeoutError)
+        finally:
+            svc.stop()
+
+    def test_breaker_open_routes_straight_to_fallback_then_recovers(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeRecover", deadline_s=0.2, max_retries=0,
+        )
+        svc.breaker.cooldown_s = 30.0  # hold open for the assertions below
+        try:
+            # trip via an empty pool
+            futures = svc.verify_signatures(_items(2, entropy0=7500))
+            assert all(f.result(timeout=10) for f in futures)
+            assert svc.breaker.state == "open"
+            served = svc.metrics.fallback_served.value
+            # while open: no broker round trip, straight to fallback
+            # (queue depth unchanged by the new request)
+            qdepth = broker.message_count("verifier.requests")
+            futures = svc.verify_signatures(_items(2, entropy0=7500))
+            assert all(f.result(timeout=10) for f in futures)
+            assert svc.metrics.fallback_served.value == served + 1
+            assert broker.message_count("verifier.requests") == qdepth
+            # recovery: a worker appears, the cooldown elapses, the next
+            # request is the half-open probe and closes the breaker
+            worker = VerifierWorker(broker, name="revived").start()
+            svc.breaker.cooldown_s = 0.2
+            time.sleep(0.25)
+            futures = svc.verify_signatures(_items(2, entropy0=7500))
+            assert all(f.result(timeout=10) for f in futures)
+            assert svc.breaker.state == "closed"
+            worker.stop()
+        finally:
+            svc.stop()
+
+    def test_timed_out_halfopen_probe_reopens_breaker(self):
+        """A half-open probe that never gets answered (consumers
+        registered but stalled — the broker_partition shape) must
+        RE-OPEN the breaker, not wedge it half-open with the probe slot
+        consumed forever."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeProbe", deadline_s=0.15, max_retries=5,
+        )
+        svc.breaker.cooldown_s = 0.1
+        # a consumer that never consumes: worker_count() > 0, queue stalls
+        stalled = VerifierWorker(broker, name="stalled")  # never started
+        try:
+            svc.breaker.trip("test setup")
+            time.sleep(0.12)  # cooldown elapses -> half-open
+            assert svc.breaker.state == "half-open"
+            futures = svc.verify_signatures(_items(2, entropy0=7650))
+            # the probe times out; it must fail over AND count as a
+            # breaker failure so the state machine keeps moving
+            assert all(f.result(timeout=10) for f in futures)
+            assert svc.breaker.state in ("open", "half-open")
+            assert svc.breaker.trips >= 2  # the probe timeout re-tripped
+            # recovery still possible: a real worker + the next probe
+            worker = VerifierWorker(broker, name="real").start()
+            time.sleep(0.12)
+            futures = svc.verify_signatures(_items(2, entropy0=7650))
+            assert all(f.result(timeout=10) for f in futures)
+            assert svc.breaker.state == "closed"
+            worker.stop()
+        finally:
+            stalled.stop(graceful=False)
+            svc.stop()
+
+    def test_corrupt_response_counted_not_fatal(self):
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeCorrupt", deadline_s=0.3, max_retries=2,
+        )
+        worker = VerifierWorker(broker, name="corruptor").start()
+        try:
+            with faults.inject() as fi:
+                fi.rule("verifier.worker", "corrupt_response", times=1)
+                futures = svc.verify_signatures(_items(3, entropy0=7600))
+                # garbage reply is counted; the deadline redispatch (or
+                # fallback) still completes the request
+                assert all(f.result(timeout=15) for f in futures)
+            assert svc.metrics.malformed.value == 1
+        finally:
+            worker.stop(graceful=False)
+            svc.stop()
+
+    def test_stop_drains_pending_futures(self):
+        """Satellite: stop() must resolve every registered future so no
+        caller blocks past shutdown."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeStop", deadline_s=30.0, fallback=False,
+        )
+        try:
+            sig_futures = svc.verify_signatures(_items(2, entropy0=7700))
+            tx_future = svc.verify(_ltx())
+        finally:
+            svc.stop()
+        for fut in sig_futures:
+            with pytest.raises(VerificationError, match="stopped"):
+                fut.result(timeout=1)
+        err = tx_future.result(timeout=1)
+        assert isinstance(err, VerificationError)
+        assert "stopped" in str(err)
+
+    def test_late_duplicate_reply_is_ignored(self):
+        """Redispatch reuses the nonce: when BOTH attempts eventually
+        answer, the second reply must be dropped, not double-complete."""
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeDup", deadline_s=0.2, max_retries=2,
+        )
+        try:
+            # no worker yet: first deadline fires and redispatches while
+            # the request queue holds both copies; then a worker drains
+            # both and sends two replies for one nonce
+            futures = svc.verify_signatures(_items(2, entropy0=7800))
+            time.sleep(0.45)  # one deadline + backoff window
+            worker = VerifierWorker(broker, name="late").start()
+            assert all(f.result(timeout=15) for f in futures)
+            time.sleep(0.3)  # let any duplicate reply arrive
+            assert svc.metrics.malformed.value == 0
+            assert len(svc._inflight) == 0
+            worker.stop()
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Health surface end-to-end (node + ops endpoint)
+# ---------------------------------------------------------------------------
+
+class TestHealthzReflectsBreaker:
+    def test_healthz_breaker_detail(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_VERIFY_DEADLINE", "0.25")
+        monkeypatch.setenv("CORDA_TPU_VERIFY_RETRIES", "0")
+        from corda_tpu.node.network import InMemoryMessagingNetwork
+        from corda_tpu.node.node import AbstractNode, NodeConfiguration
+
+        broker = Broker()
+        net = InMemoryMessagingNetwork()
+        node = AbstractNode(
+            NodeConfiguration(
+                my_legal_name="O=Failover,L=London,C=GB",
+                verifier_type="OutOfProcess",
+                identity_entropy=4242,
+                ops_port=0,
+            ),
+            net.create_endpoint, broker=broker,
+        )
+        node.start()
+        try:
+            port = node.ops_server.port
+
+            def healthz():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5
+                ) as resp:
+                    return json.loads(resp.read())
+
+            body = healthz()
+            assert body["checks"]["verifier"]["breaker"] == "closed"
+            svc = node.services.transaction_verifier_service
+            # sole worker dies after ack -> pool empty -> breaker trips,
+            # futures complete via fallback
+            worker = VerifierWorker(broker, name="node-sole").start()
+            with faults.inject(seed=11) as fi:
+                fi.rule("verifier.worker", "crash_after_ack", times=1)
+                futures = svc.verify_signatures(_items(4, entropy0=7900))
+                assert all(f.result(timeout=10) for f in futures)
+            body = healthz()
+            assert body["checks"]["verifier"]["breaker"] in (
+                "open", "half-open"
+            )
+            assert body["checks"]["verifier"]["fallback_active"] is True
+            # recovery: new worker + cooldown + probe -> closed again
+            svc.breaker.cooldown_s = 0.2
+            worker2 = VerifierWorker(broker, name="node-revived").start()
+            time.sleep(0.25)
+            futures = svc.verify_signatures(_items(2, entropy0=7900))
+            assert all(f.result(timeout=10) for f in futures)
+            assert healthz()["checks"]["verifier"]["breaker"] == "closed"
+            worker.stop(graceful=False)
+            worker2.stop()
+        finally:
+            node.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loadtest catalog disruptions (in-process)
+# ---------------------------------------------------------------------------
+
+class TestDisruptionCatalog:
+    def test_verifier_worker_kill_and_heal(self):
+        import random
+
+        from corda_tpu.loadtest.disruption import verifier_worker_kill
+
+        broker = Broker()
+        svc = OutOfProcessTransactionVerifierService(
+            broker, "nodeDisrupt", deadline_s=1.0,
+        )
+        workers = [VerifierWorker(broker, name="w0").start()]
+        d = verifier_worker_kill(workers, broker, probability=1.0)
+        rng = random.Random(0)
+        try:
+            d.maybe_fire(rng, None, 0)
+            assert workers[0]._stop.is_set()
+            d.maybe_heal(rng, None, 5)
+            assert len(workers) == 2
+            futures = svc.verify_signatures(_items(2, entropy0=8000))
+            assert all(f.result(timeout=10) for f in futures)
+        finally:
+            for w in workers:
+                w.stop(graceful=False)
+            svc.stop()
+
+    def test_broker_partition_drops_until_healed(self):
+        import random
+
+        from corda_tpu.loadtest.disruption import broker_partition
+
+        broker = Broker()
+        broker.create_queue("verifier.requests")
+        d = broker_partition(match="verifier.", probability=1.0)
+        rng = random.Random(0)
+        d.maybe_fire(rng, None, 0)
+        try:
+            broker.send("verifier.requests", b"lost")
+            assert broker.message_count("verifier.requests") == 0
+        finally:
+            d.maybe_heal(rng, None, 5)
+        assert faultpoints.hook is None
+        broker.send("verifier.requests", b"delivered")
+        assert broker.message_count("verifier.requests") == 1
