@@ -451,6 +451,19 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         failover = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Overload protection (docs/robustness.md): saturate the admission
+    # cap with a 5x flow-start burst, verify the excess sheds (typed
+    # rejection + /readyz 503), then measure time-to-recover after the
+    # load drops plus the goodput the node sustained through the event —
+    # both guarded by the regression gate (_ms is auto-classified
+    # lower-is-better, _per_sec higher-is-better).
+    from corda_tpu.loadtest.latency import measure_overload_shed_recovery
+
+    try:
+        overload = measure_overload_shed_recovery()
+    except Exception as exc:
+        overload = {"error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -469,6 +482,10 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "jax_dispatch": profiling.dispatch_snapshot(),
         "failover_recovery_ms": failover.get("failover_recovery_ms"),
         "failover_recovered_via": failover.get("recovered_via"),
+        "overload_shed_recovery_ms": overload.get(
+            "overload_shed_recovery_ms"
+        ),
+        "overload_goodput_per_sec": overload.get("overload_goodput_per_sec"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
@@ -491,6 +508,9 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "settlement_burst_sigs_s": burst["sigs_per_sec"],
         "batcher_flushes": burst["batcher_flushes"],
         "batcher_largest_batch": burst["batcher_largest_batch"],
+        "overload_burst": overload.get("burst"),
+        "overload_shed": overload.get("shed"),
+        "overload_admitted": overload.get("admitted"),
     }
 
     # Full-system throughput: issue+pay pairs through REAL node processes

@@ -131,6 +131,41 @@ def broker_partition(match: str = "verifier.",
     return Disruption("broker-partition", fire, heal, probability=probability)
 
 
+def overload_burst(burst: int = 64, probability: float = 0.2,
+                   pick=lambda rng, nodes: nodes.nodes[0]) -> Disruption:
+    """Slam one node's flow-start seam with a burst far past its
+    admission caps (the 5x-ingest shape from the committee-consensus
+    measurements). The node must SHED the excess — NodeOverloadedError
+    with a retry hint — never queue or hang it; the heal pumps the
+    network so the admitted slice drains and the overload state machine
+    can walk back to normal. Composes with any LoadTest scenario: the
+    scenario's own commands keep running through the shed window."""
+    from ..loadtest.latency import _HoldFlow  # registers the responder
+    from ..node.admission import NodeOverloadedError
+
+    state = {"shed": 0, "admitted": 0}
+
+    def fire(rng, nodes):
+        node = pick(rng, nodes)
+        peer = nodes.nodes[-1] if len(nodes.nodes) > 1 else node
+        for _ in range(burst):
+            try:
+                # the handle is deliberately NOT kept: a long chaos run
+                # fires this repeatedly and must not accumulate every
+                # admitted flow's future for the life of the soak
+                node.start_flow(_HoldFlow(peer.info), peer.info)
+                state["admitted"] += 1
+            except NodeOverloadedError:
+                state["shed"] += 1
+
+    def heal(rng, nodes):
+        nodes.pump()  # drain the admitted slice; recovery follows
+
+    d = Disruption("overload-burst", fire, heal, probability=probability)
+    d.state = state  # observable by tests: shed/admitted split
+    return d
+
+
 def clock_skew(delta_s: float = 3600.0) -> Disruption:
     """Skew a node's clock forward (time-window failures downstream)."""
     state = {}

@@ -320,6 +320,112 @@ def measure_notarise_burst(
     return out
 
 
+from ..core.flows.api import FlowLogic, initiated_by, initiating_flow
+
+
+@initiating_flow
+class _HoldFlow(FlowLogic):
+    """Parks on a counterparty reply until the network pumps — the
+    overload measurement's unit of 'live work': started flows stay
+    in-flight (holding admission slots) until the driver drains them."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        ack = yield self.send_and_receive(self.peer, b"hold", bytes)
+        return ack
+
+
+@initiated_by(_HoldFlow)
+class _HoldResponder(FlowLogic):
+    def __init__(self, counterparty):
+        self.counterparty = counterparty
+
+    def call(self):
+        _ = yield self.receive(self.counterparty, bytes)
+        yield self.send(self.counterparty, b"ok")
+
+
+def measure_overload_shed_recovery(
+    burst: int = 40, max_flows: int = 8, hold_s: float = 0.2,
+    verbose: bool = False,
+) -> Dict[str, float]:
+    """Time-to-recover of the overload-protection path: saturate a
+    MockNetwork node's live-flow admission cap with a ~5x flow-start
+    burst (without pumping, every admitted flow parks and holds its
+    slot), prove the excess is SHED as NodeOverloadedError with a
+    retry_after_ms hint while /readyz serves 503 — then drain the load
+    and measure how long until /readyz serves 200 again (overload state
+    machine: shedding -> recovering -> normal after the quiet dwell).
+
+    Reported as `overload_shed_recovery_ms` (+ `overload_goodput_per_sec`,
+    the admitted-work completion rate) in bench stage_timings so
+    tools/bench_gate.py guards degradation/recovery latency like any
+    other stage (docs/robustness.md)."""
+    import os
+
+    from ..node.admission import NodeOverloadedError
+    from ..testing.mocknetwork import MockNetwork
+
+    prev_hold = os.environ.get("CORDA_TPU_OVERLOAD_HOLD_S")
+    os.environ["CORDA_TPU_OVERLOAD_HOLD_S"] = str(hold_s)
+    try:
+        net = MockNetwork()
+        a = net.create_node(
+            "O=OverloadA,L=London,C=GB", admission_max_flows=max_flows,
+        )
+        b = net.create_node("O=OverloadB,L=Paris,C=FR")
+    finally:
+        if prev_hold is None:
+            os.environ.pop("CORDA_TPU_OVERLOAD_HOLD_S", None)
+        else:
+            os.environ["CORDA_TPU_OVERLOAD_HOLD_S"] = prev_hold
+
+    t_start = time.perf_counter()
+    handles, shed, hints = [], 0, []
+    try:
+        for _ in range(burst):
+            try:
+                handles.append(a.start_flow(_HoldFlow(b.info), b.info))
+            except NodeOverloadedError as exc:
+                shed += 1
+                hints.append(exc.retry_after_ms)
+        assert shed > 0, "burst never hit the admission cap"
+        assert all(h >= 0 for h in hints)
+        status, _ = a.health.readyz()
+        assert status == 503, f"readyz served {status} while shedding"
+        # drain: the admitted flows complete, load drops, and the
+        # machine walks shedding -> recovering -> normal
+        t_drop = time.perf_counter()
+        net.run_network()
+        deadline = time.monotonic() + 30
+        while True:
+            status, _ = a.health.readyz()
+            if status == 200:
+                break
+            assert time.monotonic() < deadline, "readyz never recovered"
+            time.sleep(0.01)
+        recovery_ms = (time.perf_counter() - t_drop) * 1000
+        completed = sum(1 for h in handles if h.result.result(timeout=10))
+        wall = time.perf_counter() - t_start
+        out = {
+            "overload_shed_recovery_ms": round(recovery_ms, 3),
+            "overload_goodput_per_sec": round(completed / wall, 1),
+            "burst": burst,
+            "max_flows": max_flows,
+            "admitted": len(handles),
+            "completed": completed,
+            "shed": shed,
+            "retry_after_ms_p50": sorted(hints)[len(hints) // 2],
+        }
+    finally:
+        net.stop_nodes()
+    if verbose:
+        print(out)
+    return out
+
+
 def measure_failover_recovery(
     n_items: int = 64, deadline_s: float = 0.25, verbose: bool = False
 ) -> Dict[str, float]:

@@ -115,6 +115,108 @@ class NotaryLoadTest(LoadTest):
         return True  # throughput test; consistency covered by SelfIssue
 
 
+class SustainedOverloadLoadTest(LoadTest):
+    """Sustained 5x overload against an admission-capped node: every
+    iteration fires `burst_factor` x the node's live-flow cap in flow
+    starts WITHOUT waiting for completions, so ingest persistently
+    outruns the pipeline (the committee-consensus collapse shape).
+
+    What must hold (the overload-protection contract, docs/robustness.md):
+      * live flows and queue depths stay bounded by their caps — excess
+        is rejected as NodeOverloadedError with a retry_after_ms hint,
+        never queued without bound or hung;
+      * goodput (admitted work completing) stays within budget of the
+        configured capacity instead of collapsing;
+      * after the final iteration drains, the node recovers (/readyz 200).
+
+    Metrics surface shed_rate / goodput / max_live_flows / recovered for
+    SLO bounds (e.g. {"shed_rate": {"max": 0.95}}, {"recovered": {"min": 1}})
+    via the same check_slos machinery as the bench gate."""
+
+    name = "sustained-overload"
+
+    def __init__(self, burst_factor: int = 5):
+        self.burst_factor = burst_factor
+
+    def setup(self, nodes: Nodes):
+        from ..loadtest.latency import _HoldFlow  # registers the responder
+
+        self._flow_cls = _HoldFlow
+        self._target = nodes.nodes[0]
+        self._peer = nodes.nodes[1 if len(nodes.nodes) > 1 else 0]
+        self._cap = (
+            self._target.admission.max_flows
+            if self._target.admission is not None else 0
+        )
+        self._attempted = 0
+        self._shed = 0
+        self._handles = []
+        self._max_live = 0
+        self._bad_rejections = 0  # rejections without a retry hint
+        import time as _time
+
+        self._t0 = _time.perf_counter()
+        return 0
+
+    def generate(self, state, parallelism) -> Generator:
+        burst = max(1, self._cap * self.burst_factor or parallelism)
+        return Generator.pure(list(range(burst)))
+
+    def interpret(self, state, command):
+        return state + 1
+
+    def execute(self, nodes: Nodes, command) -> None:
+        from ..node.admission import NodeOverloadedError
+
+        self._attempted += 1
+        try:
+            self._handles.append(self._target.start_flow(
+                self._flow_cls(self._peer.info), self._peer.info
+            ))
+        except NodeOverloadedError as exc:  # shed IS the expected outcome
+            self._shed += 1
+            if exc.retry_after_ms < 0:
+                self._bad_rejections += 1
+        self._max_live = max(
+            self._max_live, self._target.smm.in_flight_count
+        )
+
+    def gather(self, nodes: Nodes):
+        return sum(1 for h in self._handles if h.result.done())
+
+    def compare(self, predicted, observed) -> bool:
+        # bounded-ness is the invariant, not a balance: live flows must
+        # never have exceeded the configured cap
+        return self._cap == 0 or self._max_live <= self._cap
+
+    def collect_metrics(self, nodes: Nodes):
+        import time as _time
+
+        completed = sum(1 for h in self._handles if h.result.done())
+        elapsed = max(1e-9, _time.perf_counter() - self._t0)
+        # recovery poll: the overload machine's quiet dwell
+        # (CORDA_TPU_OVERLOAD_HOLD_S) runs AFTER the last drain, so give
+        # /readyz a bounded window to walk recovering -> normal
+        deadline = _time.monotonic() + 5.0
+        while True:
+            status, _ = self._target.health.readyz()
+            if status == 200 or _time.monotonic() > deadline:
+                break
+            _time.sleep(0.02)
+        return {
+            "attempted": float(self._attempted),
+            "admitted": float(len(self._handles)),
+            "completed": float(completed),
+            "shed_rate": (
+                self._shed / self._attempted if self._attempted else 0.0
+            ),
+            "goodput_per_sec": completed / elapsed,
+            "max_live_flows": float(self._max_live),
+            "bad_rejections": float(self._bad_rejections),
+            "recovered": 1.0 if status == 200 else 0.0,
+        }
+
+
 class StabilityLoadTest(SelfIssueLoadTest):
     """SelfIssue under disruptions, checking the ledger converges once the
     network heals (reference StabilityTest: parallelism 10, crash+restart)."""

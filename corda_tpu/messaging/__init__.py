@@ -11,16 +11,19 @@ distribution does NOT go through here — that rides ICI via jax.shard_map
 collectives (corda_tpu.parallel).
 """
 from .broker import (
+    DEAD_LETTER_QUEUE,
     Broker,
     BrokerError,
     Consumer,
     Message,
     QueueClosedError,
     QueueExistsError,
+    QueueFullError,
     UnknownQueueError,
 )
 
 __all__ = [
     "Broker", "BrokerError", "Consumer", "Message",
-    "QueueClosedError", "QueueExistsError", "UnknownQueueError",
+    "QueueClosedError", "QueueExistsError", "QueueFullError",
+    "UnknownQueueError", "DEAD_LETTER_QUEUE",
 ]

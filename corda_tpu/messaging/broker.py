@@ -50,6 +50,18 @@ class QueueClosedError(BrokerError):
     pass
 
 
+class QueueFullError(BrokerError):
+    """A bounded queue with the reject-new shed policy refused the send —
+    synchronous backpressure on the producer (overload protection)."""
+
+
+#: where drop-oldest sheds land (bounded itself; never journalled) so an
+#: operator can inspect what overload cost — the broker-side twin of the
+#: verifier's dead-letter semantics
+DEAD_LETTER_QUEUE = "dead.letter"
+DEAD_LETTER_MAX = 1024
+
+
 @dataclass(frozen=True)
 class Message:
     """A broker message: opaque payload plus string headers.
@@ -205,7 +217,8 @@ def _decode_headers(blob: bytes) -> Dict[str, str]:
 
 
 class _BrokerQueue:
-    def __init__(self, name: str, broker: "Broker", journal: Optional[_Journal]):
+    def __init__(self, name: str, broker: "Broker", journal: Optional[_Journal],
+                 max_depth: Optional[int] = None, shed_policy: str = "reject"):
         self.name = name
         self.broker = broker
         self.messages: Deque[Message] = deque()
@@ -213,6 +226,13 @@ class _BrokerQueue:
         self.not_empty = threading.Condition(broker._lock)
         self.journal = journal
         self.closed = False
+        # overload protection: depth cap + what to do at the cap.
+        # "reject" raises QueueFullError at the producer (ingest queues:
+        # the sender must feel backpressure); "drop_oldest" sheds the
+        # head into the dead-letter queue (stream/egress queues: a slow
+        # consumer must not grow the broker without bound).
+        self.max_depth = max_depth
+        self.shed_policy = shed_policy
 
     def pending_messages(self) -> List[Message]:
         """Authoritative not-yet-acked set: in-flight (delivered, unacked)
@@ -376,6 +396,13 @@ class Broker:
         self._lock = threading.RLock()
         self._journal_dir = journal_dir
         self._queues: Dict[str, _BrokerQueue] = {}
+        # overload-shed telemetry: per-queue shed counts plus an optional
+        # observer fn(queue_name, policy, message_or_None) the owning
+        # node binds to its Shed.* counters / flight recorder. Runs
+        # under the broker lock — must stay cheap and must not call back
+        # into the broker.
+        self.shed_counts: Dict[str, int] = {}
+        self.on_shed: Optional[Callable[[str, str, Optional[Message]], None]] = None
         # message ids: unique random prefix per broker instance + counter —
         # uuid4-per-message was ~30 urandom syscalls per notarised tx pair
         # in the round-3 system profile; uniqueness across restarts (journal
@@ -409,8 +436,11 @@ class Broker:
         self._queues[name] = q
 
     def create_queue(
-        self, name: str, durable: bool = False, fail_if_exists: bool = False
+        self, name: str, durable: bool = False, fail_if_exists: bool = False,
+        max_depth: Optional[int] = None, shed_policy: str = "reject",
     ) -> None:
+        if shed_policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"unknown shed policy {shed_policy!r}")
         with self._lock:
             if name in self._queues:
                 if fail_if_exists:
@@ -421,7 +451,31 @@ class Broker:
                 if self._journal_dir is None:
                     raise BrokerError("durable queue requires journal_dir")
                 journal = _Journal(self._journal_path(name))
-            self._queues[name] = _BrokerQueue(name, self, journal)
+            self._queues[name] = _BrokerQueue(
+                name, self, journal, max_depth=max_depth,
+                shed_policy=shed_policy,
+            )
+
+    def set_queue_bound(self, name: str, max_depth: Optional[int],
+                        shed_policy: str = "reject") -> None:
+        """(Re)bound an existing queue — recovered durable queues and the
+        transport-owned inbound queues get their caps here, after
+        creation. max_depth None/0 removes the bound."""
+        if shed_policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"unknown shed policy {shed_policy!r}")
+        with self._lock:
+            q = self._queues.get(name)
+            if q is None:
+                raise UnknownQueueError(name)
+            q.max_depth = max_depth if max_depth else None
+            q.shed_policy = shed_policy
+
+    def queue_bound(self, name: str) -> Tuple[Optional[int], str]:
+        with self._lock:
+            q = self._queues.get(name)
+            if q is None:
+                raise UnknownQueueError(name)
+            return q.max_depth, q.shed_policy
 
     def delete_queue(self, name: str) -> None:
         with self._lock:
@@ -491,12 +545,69 @@ class Broker:
         except BrokerError:
             pass
 
+    def _shed_locked(self, q: _BrokerQueue, policy: str,
+                     msg: Optional[Message]) -> None:
+        """Telemetry for one shed decision; caller holds the lock."""
+        self.shed_counts[q.name] = self.shed_counts.get(q.name, 0) + 1
+        if self.on_shed is not None:
+            try:
+                self.on_shed(q.name, policy, msg)
+            except Exception:
+                pass  # a telemetry observer must not break the send path
+
+    def _dead_letter_locked(self, from_queue: str, victim: Message) -> None:
+        """Move a shed message into the (bounded, in-memory) dead-letter
+        queue, stamped with its origin; caller holds the lock. The DLQ
+        itself drops ITS oldest at capacity — dead letters must never
+        become the unbounded queue they exist to prevent."""
+        dlq = self._queues.get(DEAD_LETTER_QUEUE)
+        if dlq is None:
+            dlq = _BrokerQueue(
+                DEAD_LETTER_QUEUE, self, None, max_depth=DEAD_LETTER_MAX,
+            )
+            self._queues[DEAD_LETTER_QUEUE] = dlq
+        if len(dlq.messages) >= (dlq.max_depth or DEAD_LETTER_MAX):
+            dlq.messages.popleft()
+        dlq.messages.append(Message(
+            payload=victim.payload,
+            headers={**victim.headers, "x-dead-from": from_queue},
+            message_id=victim.message_id,
+            delivery_count=victim.delivery_count,
+        ))
+        dlq.not_empty.notify()
+
+    def _make_room_locked(self, q: _BrokerQueue, incoming: int = 1) -> None:
+        """Enforce q's depth cap for `incoming` new messages; caller
+        holds the lock. reject -> QueueFullError (producer backpressure);
+        drop_oldest -> head messages shed to the dead-letter queue
+        (journal-acked on durable queues so a restart cannot resurrect
+        what overload already shed)."""
+        if q.max_depth is None or q.name == DEAD_LETTER_QUEUE:
+            return
+        while len(q.messages) + incoming > q.max_depth:
+            if q.shed_policy == "reject":
+                self._shed_locked(q, "reject", None)
+                raise QueueFullError(
+                    f"queue {q.name} is full "
+                    f"({len(q.messages)}/{q.max_depth}); send rejected"
+                )
+            if not q.messages:
+                # the incoming batch alone exceeds the cap: nothing left
+                # to shed — let it through rather than drop fresh work
+                return
+            victim = q.messages.popleft()
+            if q.journal is not None:
+                q.journal.append_ack(victim.message_id)
+            self._dead_letter_locked(q.name, victim)
+            self._shed_locked(q, "drop_oldest", victim)
+
     def _enqueue(self, queue_name: str, payload: bytes,
                  headers: Dict[str, str], copies: int = 1) -> str:
         with self._lock:
             q = self._queues.get(queue_name)
             if q is None or q.closed:
                 raise UnknownQueueError(queue_name)
+            self._make_room_locked(q, copies)
             for _ in range(copies):
                 self._id_seq += 1
                 msg = Message(
@@ -537,12 +648,30 @@ class Broker:
         tp = current_traceparent()
         with self._lock:
             queues = []
+            per_queue: Dict[str, int] = {}
             for queue_name, _payload, _headers in items:
                 q = self._queues.get(queue_name)
                 if q is None or q.closed:
                     raise UnknownQueueError(queue_name)
                 queues.append(q)
+                per_queue[queue_name] = per_queue.get(queue_name, 0) + 1
+            # all-or-nothing extends to capacity: a reject-policy queue
+            # that cannot take its whole share refuses the batch BEFORE
+            # anything is enqueued or journalled (drop-oldest queues
+            # shed inline below instead)
+            for name, count in per_queue.items():
+                q = self._queues[name]
+                if (
+                    q.max_depth is not None and q.shed_policy == "reject"
+                    and len(q.messages) + count > q.max_depth
+                ):
+                    self._shed_locked(q, "reject", None)
+                    raise QueueFullError(
+                        f"queue {name} cannot take {count} more "
+                        f"({len(q.messages)}/{q.max_depth}); batch rejected"
+                    )
             for q, (queue_name, payload, headers) in zip(queues, items):
+                self._make_room_locked(q)
                 self._id_seq += 1
                 msg = Message(
                     payload=payload,

@@ -39,6 +39,7 @@ from .broker import (
     BrokerError,
     Message,
     QueueClosedError,
+    QueueFullError,
     UnknownQueueError,
     _decode_headers,
     _encode_headers,
@@ -338,6 +339,10 @@ class _Conn:
             exc_type = {
                 "UnknownQueueError": UnknownQueueError,
                 "QueueClosedError": QueueClosedError,
+                # bounded-queue backpressure crosses the wire as itself,
+                # so a remote producer can distinguish "back off" from
+                # a protocol fault
+                "QueueFullError": QueueFullError,
             }.get(cls, BrokerError)
             raise exc_type(message)
         return reply

@@ -1,0 +1,390 @@
+"""Overload protection: admission control at the flow-start seam plus the
+node-wide overload state machine.
+
+The committee-consensus measurements (PAPERS: EdDSA/BLS in committee-based
+consensus) show sustained throughput COLLAPSING — not plateauing — once
+ingest outruns the signature/consensus pipeline: queues grow without
+bound, latency blows through every SLO, and goodput falls below what the
+node could serve had it simply refused the excess. PR 3 gave the node the
+gauges (queue depth, batcher occupancy, blocking backlog); this module
+makes it ACT on them:
+
+  * `AdmissionController` gates NEW top-level client flows at
+    `StateMachineManager.start_flow`: a token bucket (steady-state rate +
+    burst) plus a live-flow concurrency cap. Rejections raise
+    `NodeOverloadedError` carrying a computed `retry_after_ms` hint that
+    propagates through the RPC layer so `CordaRPCClient` callers can back
+    off instead of hammering.
+  * PRIORITY traffic is classified and never shed before new client
+    work: responder flows (session replies for already-admitted flows —
+    notary commits arrive this way), hospital checkpoint-replay retries
+    (they re-enter via `_restore`, below the admission seam), and flows
+    whose class sets `_system_flow = True`.
+  * `OverloadStateMachine` tracks normal -> shedding -> recovering with
+    hysteresis (enter on a high-threshold breach of any registered
+    signal, leave for `recovering` once every signal is back under its
+    low threshold, return to `normal` after a quiet dwell). While
+    shedding, admission rejects all new client work; `/readyz` serves
+    503 until the machine is back to `normal` (the dwell prevents
+    load-balancer flapping).
+
+Knobs: CORDA_TPU_ADMISSION_RATE (flow starts/s; 0/unset = no rate gate),
+CORDA_TPU_ADMISSION_BURST (bucket size, default 2x rate),
+CORDA_TPU_ADMISSION_MAX_FLOWS (live-flow cap; 0/unset = no cap),
+CORDA_TPU_ADMISSION_RETRY_MS (hint floor when shedding, default 250),
+CORDA_TPU_OVERLOAD_HOLD_S (recovering -> normal dwell, default 2).
+NodeConfiguration's admission_rate / admission_burst / admission_max_flows
+override the environment per node.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.serialization.codec import register_adapter
+from ..utils import eventlog
+
+
+class NodeOverloadedError(Exception):
+    """The node refused new work to protect work already in flight.
+
+    `retry_after_ms` is the node's own estimate of when capacity frees
+    up (token-bucket refill time, or the shed-state hint) — clients
+    should back off at least that long before retrying."""
+
+    def __init__(self, message: str, retry_after_ms: int = 0):
+        super().__init__(message)
+        self.retry_after_ms = max(0, int(retry_after_ms))
+
+
+register_adapter(
+    NodeOverloadedError, "NodeOverloadedError",
+    lambda e: {"msg": str(e), "retry_after_ms": e.retry_after_ms},
+    lambda d: NodeOverloadedError(
+        d["msg"], retry_after_ms=d.get("retry_after_ms", 0)
+    ),
+)
+
+
+class TokenBucket:
+    """Thread-safe token bucket: `rate` tokens/s refill up to `burst`.
+
+    `try_acquire` never blocks — on failure it returns the refill wait,
+    which becomes the client-facing retry_after hint."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst if burst is not None else 2 * rate))
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> Tuple[bool, float]:
+        """(acquired, seconds_until_available_if_not)."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            if self.rate <= 0:
+                return False, 60.0  # bucket can never refill: park the caller
+            return False, (n - self._tokens) / self.rate
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            now = self._clock()
+            return min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+
+
+# -- overload state machine ---------------------------------------------------
+
+NORMAL, SHEDDING, RECOVERING = "normal", "shedding", "recovering"
+_STATE_CODE = {NORMAL: 0, RECOVERING: 1, SHEDDING: 2}
+
+
+class OverloadStateMachine:
+    """normal -> shedding -> recovering -> normal, with hysteresis.
+
+    Signals are cheap zero-arg reads (the PR 3 backpressure gauges: P2P
+    queue depth, verifier batcher occupancy, blocking backlog, live
+    flows). The machine enters SHEDDING the moment ANY signal reaches
+    its high threshold, moves to RECOVERING once EVERY signal is back
+    at-or-under its low threshold, and returns to NORMAL after
+    `hold_s` of continuous quiet (a breach during the dwell restarts
+    it; a high breach re-enters SHEDDING).
+
+    Evaluation is pull-based: `evaluate()` runs on every admission
+    attempt and every health probe, so there is no sampler thread to
+    manage and deterministic tests drive it with an injected clock."""
+
+    def __init__(self, hold_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None, node_name: str = ""):
+        if hold_s is None:
+            hold_s = float(os.environ.get("CORDA_TPU_OVERLOAD_HOLD_S", 2.0))
+        self.hold_s = hold_s
+        self._clock = clock
+        self._node = node_name
+        self._lock = threading.Lock()
+        #: (name, read fn, high, low)
+        self._signals: List[Tuple[str, Callable[[], float], float, float]] = []
+        self._state = NORMAL
+        self._since = clock()
+        self._quiet_since: Optional[float] = None
+        self._last_breach: Optional[str] = None
+        self.transitions = 0
+        self._metrics = metrics
+        if metrics is not None:
+            metrics.gauge(
+                "Overload.State", lambda: _STATE_CODE.get(self._state, 0)
+            )
+            self._entered = metrics.counter("Overload.SheddingEntered")
+        else:
+            self._entered = None
+
+    def add_signal(self, name: str, read: Callable[[], float],
+                   high: float, low: Optional[float] = None) -> None:
+        """Register a load signal. `low` defaults to high/4 — the
+        hysteresis gap that keeps a queue hovering at the threshold from
+        flapping the state (and /readyz) on every probe."""
+        if low is None:
+            low = high / 4.0
+        with self._lock:
+            self._signals.append((name, read, float(high), float(low)))
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def shedding(self) -> bool:
+        return self._state == SHEDDING
+
+    def evaluate(self, now: Optional[float] = None) -> str:
+        now = self._clock() if now is None else now
+        breach_high: Optional[str] = None
+        breach_low = False
+        with self._lock:
+            signals = list(self._signals)
+        for name, read, high, low in signals:
+            try:
+                v = float(read())
+            except Exception:
+                continue  # a dead signal must not wedge admission
+            if v >= high and breach_high is None:
+                breach_high = f"{name}={v:g} >= {high:g}"
+            if v > low:
+                breach_low = True
+        with self._lock:
+            prev = self._state
+            if breach_high is not None:
+                self._last_breach = breach_high
+                self._quiet_since = None
+                if prev != SHEDDING:
+                    self._transition_locked(SHEDDING, now)
+            elif prev == SHEDDING:
+                if not breach_low:
+                    self._quiet_since = now
+                    self._transition_locked(RECOVERING, now)
+            elif prev == RECOVERING:
+                if breach_low:
+                    self._quiet_since = None  # dwell restarts on noise
+                elif self._quiet_since is None:
+                    self._quiet_since = now
+                elif now - self._quiet_since >= self.hold_s:
+                    self._transition_locked(NORMAL, now)
+            return self._state
+
+    def _transition_locked(self, state: str, now: float) -> None:
+        prev, self._state, self._since = self._state, state, now
+        self.transitions += 1
+        if state == SHEDDING and self._entered is not None:
+            self._entered.inc()
+        eventlog.emit(
+            "warning" if state == SHEDDING else "info",
+            "overload", f"overload state {prev} -> {state}",
+            node=self._node, cause=self._last_breach,
+        )
+
+    def snapshot(self, evaluate: bool = True) -> Dict:
+        if evaluate:
+            self.evaluate()
+        # signal reads run OUTSIDE the lock (they take other locks —
+        # queue_depth takes the network's; holding ours across them
+        # would block concurrent admit()/evaluate() for the probe)
+        with self._lock:
+            signals = list(self._signals)
+        readings = {}
+        for name, read, high, low in signals:
+            try:
+                readings[name] = {
+                    "value": float(read()), "high": high, "low": low,
+                }
+            except Exception as exc:
+                readings[name] = {"error": repr(exc)}
+        with self._lock:
+            return {
+                "state": self._state,
+                "since_s": round(self._clock() - self._since, 3),
+                "hold_s": self.hold_s,
+                "transitions": self.transitions,
+                "last_breach": self._last_breach,
+                "signals": readings,
+            }
+
+
+# -- admission control --------------------------------------------------------
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return None
+    v = float(raw)
+    return v if v > 0 else None
+
+
+class AdmissionController:
+    """Gate for NEW top-level client flows (the RPC start_flow seam).
+
+    Decision order, cheapest-reject first and priority always through:
+      1. priority traffic (responder flows, `_system_flow` classes) is
+         admitted unconditionally — it completes work already admitted
+         somewhere, so shedding it would only grow the backlog;
+      2. while the overload machine sheds, every new client flow is
+         rejected (degradation mode);
+      3. the live-flow concurrency cap;
+      4. the token-bucket rate limit.
+
+    Every admit/reject lands in the `Admission.*` counter families and
+    (rejections) the flight recorder."""
+
+    def __init__(self, rate: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 max_flows: Optional[int] = None,
+                 live_flows: Optional[Callable[[], int]] = None,
+                 overload: Optional[OverloadStateMachine] = None,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 node_name: str = ""):
+        if rate is None:
+            rate = _env_float("CORDA_TPU_ADMISSION_RATE")
+        if burst is None:
+            burst = _env_float("CORDA_TPU_ADMISSION_BURST")
+        if max_flows is None:
+            mf = _env_float("CORDA_TPU_ADMISSION_MAX_FLOWS")
+            max_flows = int(mf) if mf is not None else None
+        self.bucket = (
+            TokenBucket(rate, burst, clock=clock)
+            if rate is not None and rate > 0 else None
+        )
+        self.max_flows = (
+            int(max_flows) if max_flows is not None and max_flows > 0
+            else None
+        )
+        self.live_flows = live_flows or (lambda: 0)
+        self.overload = overload
+        self._clock = clock
+        self._node = node_name
+        self.shed_retry_ms = int(
+            float(os.environ.get("CORDA_TPU_ADMISSION_RETRY_MS", 250))
+        )
+        from ..utils.metrics import MetricRegistry
+
+        m = metrics or MetricRegistry()
+        # eager creation: the Admission.* families must render on
+        # /metrics from the first scrape, not from the first rejection
+        self.admitted = m.counter("Admission.Admitted")
+        self.priority = m.counter("Admission.Priority")
+        self.rejected = m.counter("Admission.Rejected")
+        self.rejected_rate = m.counter("Admission.RejectedByRate")
+        self.rejected_cap = m.counter("Admission.RejectedByCap")
+        self.rejected_shedding = m.counter("Admission.RejectedShedding")
+
+    @staticmethod
+    def is_priority(flow=None, is_responder: bool = False) -> bool:
+        """System/priority classification: responder flows (session
+        replies for work already admitted on SOME node — the notary's
+        commit-serving flows arrive this way) and classes marked
+        `_system_flow = True`. Hospital retries never reach the
+        admission seam at all (`_restore` re-enters below it)."""
+        if is_responder:
+            return True
+        return flow is not None and getattr(
+            type(flow), "_system_flow", False
+        )
+
+    def admit(self, flow=None, is_responder: bool = False) -> None:
+        """Admit or raise NodeOverloadedError. Priority traffic NEVER
+        raises (and is not charged against the bucket/cap) — the
+        priority short-circuit runs FIRST so the dominant traffic class
+        (every responder session message) skips the O(signals) overload
+        sweep entirely."""
+        if self.is_priority(flow, is_responder):
+            self.priority.inc()
+            return
+        if self.overload is not None:
+            self.overload.evaluate()
+        if self.overload is not None and self.overload.shedding:
+            self._reject(
+                self.rejected_shedding, "node is shedding load",
+                self.shed_retry_ms, flow,
+            )
+        if self.max_flows is not None and self.live_flows() >= self.max_flows:
+            self._reject(
+                self.rejected_cap,
+                f"live-flow cap reached ({self.max_flows})",
+                self.shed_retry_ms, flow,
+            )
+        if self.bucket is not None:
+            ok, wait_s = self.bucket.try_acquire()
+            if not ok:
+                self._reject(
+                    self.rejected_rate, "flow-start rate limit",
+                    max(1, math.ceil(wait_s * 1000)), flow,
+                )
+        self.admitted.inc()
+
+    def _reject(self, reason_counter, cause: str, retry_after_ms: int,
+                flow) -> None:
+        self.rejected.inc()
+        reason_counter.inc()
+        eventlog.emit(
+            "warning", "admission", "flow start rejected",
+            node=self._node, cause=cause,
+            flow=type(flow).__name__ if flow is not None else None,
+            retry_after_ms=retry_after_ms,
+        )
+        raise NodeOverloadedError(
+            f"node overloaded: {cause}; retry after {retry_after_ms} ms",
+            retry_after_ms=retry_after_ms,
+        )
+
+    def snapshot(self) -> Dict:
+        out = {
+            "max_flows": self.max_flows,
+            "live_flows": self.live_flows(),
+            "shed_retry_ms": self.shed_retry_ms,
+            "admitted": self.admitted.value,
+            "priority": self.priority.value,
+            "rejected": self.rejected.value,
+            "rejected_by_rate": self.rejected_rate.value,
+            "rejected_by_cap": self.rejected_cap.value,
+            "rejected_shedding": self.rejected_shedding.value,
+        }
+        if self.bucket is not None:
+            out["rate"] = self.bucket.rate
+            out["burst"] = self.bucket.burst
+            out["tokens"] = round(self.bucket.tokens, 3)
+        return out

@@ -46,6 +46,12 @@ DEFAULTS = {
     # observability endpoint (GET /metrics Prometheus + GET /traces/*):
     # null = off, 0 = ephemeral port, N = fixed port
     "ops_port": None,
+    # overload protection (docs/robustness.md): token-bucket rate limit
+    # on new client flow starts (flows/s; null = CORDA_TPU_ADMISSION_RATE
+    # or no gate), bucket burst, live-flow concurrency cap
+    "admission_rate": None,
+    "admission_burst": None,
+    "admission_max_flows": None,
 }
 
 
@@ -94,6 +100,18 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         bft_cluster=cfg.get("bft_cluster"),
         ops_port=(
             int(cfg["ops_port"]) if cfg.get("ops_port") is not None else None
+        ),
+        admission_rate=(
+            float(cfg["admission_rate"])
+            if cfg.get("admission_rate") is not None else None
+        ),
+        admission_burst=(
+            float(cfg["admission_burst"])
+            if cfg.get("admission_burst") is not None else None
+        ),
+        admission_max_flows=(
+            int(cfg["admission_max_flows"])
+            if cfg.get("admission_max_flows") is not None else None
         ),
     )
     return FullNodeConfiguration(

@@ -27,6 +27,37 @@ STARTING, SERVING, DRAINING, STOPPED = (
 )
 
 
+class SustainedBreach:
+    """Debounce for degradation checks: a condition only counts as
+    degraded after it has held continuously for `for_s` seconds.
+
+    One queue-depth spike at the instant a probe lands must not flip
+    /readyz (the load balancer would yank a healthy node); a backlog
+    that STAYS saturated across the window is real degradation. Recovery
+    clears immediately — the hysteresis lives in the overload state
+    machine, not here."""
+
+    def __init__(self, for_s: float, clock: Callable[[], float] = time.time):
+        self.for_s = float(for_s)
+        self._clock = clock
+        self._since: Optional[float] = None
+
+    def observe(self, breached: bool) -> bool:
+        """Feed one reading; returns True once the breach is sustained."""
+        if not breached:
+            self._since = None
+            return False
+        now = self._clock()
+        if self._since is None:
+            self._since = now
+        return (now - self._since) >= self.for_s
+
+    @property
+    def breached_for_s(self) -> float:
+        """How long the current breach has held (0 when clear)."""
+        return 0.0 if self._since is None else self._clock() - self._since
+
+
 class HealthTracker:
     """Per-node lifecycle state + named component checks."""
 
@@ -34,8 +65,10 @@ class HealthTracker:
         self._lock = threading.Lock()
         self._state = STARTING
         self._state_since = time.time()
-        #: name -> (check fn, counts toward readiness)
-        self._checks: Dict[str, Tuple[Callable[[], Optional[dict]], bool]] = {}
+        #: name -> (check fn, counts toward readiness, counts toward liveness)
+        self._checks: Dict[
+            str, Tuple[Callable[[], Optional[dict]], bool, bool]
+        ] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -61,19 +94,31 @@ class HealthTracker:
     # -- checks -------------------------------------------------------------
 
     def register(self, name: str, check: Callable[[], Optional[dict]],
-                 readiness: bool = True) -> None:
+                 readiness: bool = True, liveness: bool = True) -> None:
         """Idempotent by name: a restarted service re-registering its
         check replaces the stale closure (same rule as gauge
-        re-registration in MetricRegistry)."""
+        re-registration in MetricRegistry).
+
+        `readiness`: failing flips /readyz to 503. `liveness`: failing
+        flips /healthz to 503. A check with liveness=False is an
+        OVERLOAD-class signal: the node stops ADMITTING (/readyz 503,
+        the load balancer's cue) while /healthz stays 200 with the
+        component detail — shedding load is the process working, not the
+        process sick, and a liveness-triggered restart would throw away
+        exactly the in-flight work the shed protects."""
         with self._lock:
-            self._checks[name] = (check, readiness)
+            self._checks[name] = (check, readiness, liveness)
 
     def _run_checks(self, readiness_only: bool) -> Tuple[bool, Dict]:
+        """Runs every relevant check. In readiness mode only
+        readiness-scoped checks run and all of them aggregate; in
+        liveness mode ALL checks run for detail, but only liveness-scoped
+        ones aggregate into the ok verdict."""
         with self._lock:
             checks = sorted(self._checks.items())
         all_ok = True
         details: Dict[str, dict] = {}
-        for name, (fn, for_readiness) in checks:
+        for name, (fn, for_readiness, for_liveness) in checks:
             if readiness_only and not for_readiness:
                 continue
             try:
@@ -82,7 +127,8 @@ class HealthTracker:
             except Exception as exc:  # a broken check IS an unhealthy component
                 detail, ok = {"error": f"{type(exc).__name__}: {exc}"}, False
             details[name] = {"ok": ok, **detail}
-            all_ok = all_ok and ok
+            if readiness_only or for_liveness:
+                all_ok = all_ok and ok
         return all_ok, details
 
     # -- the two probe views ------------------------------------------------

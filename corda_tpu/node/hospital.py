@@ -76,8 +76,18 @@ class FlowHospital:
                  max_retries: Optional[int] = None,
                  backoff_s: Optional[float] = None,
                  backoff_cap_s: Optional[float] = None,
-                 ward_max: Optional[int] = None):
+                 ward_max: Optional[int] = None,
+                 rng=None):
         env = os.environ
+        # jitter source for the retry backoff: a SHARED outage (notary
+        # unavailable across hundreds of flows at once) admits the whole
+        # herd in the same instant, and un-jittered exponential backoff
+        # would re-release it in the same instant too — re-creating the
+        # overload the retry was meant to ride out. backoff_delay scales
+        # each delay by [0.5, 1.0) from this rng (seedable for tests).
+        import random as _random
+
+        self.rng = rng if rng is not None else _random.Random()
         self.smm = smm
         self.enabled = (
             enabled if enabled is not None
@@ -126,6 +136,11 @@ class FlowHospital:
             return "fatal"  # a kill is a decision, not a failure
         if isinstance(exc, (TransientFlowError, VerificationTimeoutError)):
             return "transient"
+        if getattr(exc, "transient", False):
+            # typed opt-in (NotaryUnavailableError and friends): the
+            # raiser KNOWS this is an infrastructure verdict, so
+            # retryability does not hang on message wording
+            return "transient"
         for pred in self.transient_predicates:
             try:
                 if pred(exc):
@@ -156,7 +171,8 @@ class FlowHospital:
                 return None
             attempts += 1
             delay = backoff_delay(
-                attempts, base_s=self.backoff_s, cap_s=self.backoff_cap_s
+                attempts, base_s=self.backoff_s, cap_s=self.backoff_cap_s,
+                rng=self.rng,
             )
             self._recovering[fsm.flow_id] = {
                 "flow_id": fsm.flow_id,

@@ -14,6 +14,7 @@ Interface (NodeMessagingClient equivalent, reference `Messaging.kt`):
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -64,6 +65,13 @@ class InMemoryMessagingNetwork:
         # relies on (FlowLogic.kt:98-110).
         self._service_members: Dict[str, List[str]] = {}
         self._service_rr: Dict[str, int] = {}
+        # Overload protection: optional per-recipient inbound caps.
+        # recipient -> (max_depth, policy); "reject" raises QueueFullError
+        # at the sender (backpressure), "drop_oldest" sheds that
+        # recipient's oldest undelivered message into `dead_letters`.
+        self._caps: Dict[str, Tuple[int, str]] = {}
+        self.shed_counts: Dict[str, int] = {}
+        self.dead_letters: Deque[_InFlight] = deque(maxlen=256)
 
     def create_endpoint(self, me: Party) -> "InMemoryMessaging":
         ep = InMemoryMessaging(self, me)
@@ -75,7 +83,21 @@ class InMemoryMessagingNetwork:
         with self._lock:
             self._endpoints.pop(name, None)
 
+    def set_recipient_cap(self, recipient: str, max_depth: Optional[int],
+                          policy: str = "reject") -> None:
+        """Bound one endpoint's undelivered inbound backlog (the in-memory
+        twin of a broker queue depth cap). None/0 removes the bound."""
+        if policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"unknown shed policy {policy!r}")
+        with self._lock:
+            if not max_depth:
+                self._caps.pop(recipient, None)
+            else:
+                self._caps[recipient] = (int(max_depth), policy)
+
     def _enqueue(self, msg: _InFlight) -> None:
+        from ..messaging.broker import QueueFullError
+
         if self.latency is not None and self.clock is not None:
             delay = self.latency(msg.sender, msg.recipient)
             if delay > 0:
@@ -85,6 +107,28 @@ class InMemoryMessagingNetwork:
                     traceparent=msg.traceparent,
                 )
         with self._lock:
+            cap = self._caps.get(msg.recipient)
+            if cap is not None:
+                # depth is recomputed on demand: disruptions mutate
+                # _queue directly, so a counter would drift
+                max_depth, policy = cap
+                depth = sum(
+                    1 for m in self._queue if m.recipient == msg.recipient
+                )
+                if depth >= max_depth:
+                    self.shed_counts[msg.recipient] = (
+                        self.shed_counts.get(msg.recipient, 0) + 1
+                    )
+                    if policy == "reject":
+                        raise QueueFullError(
+                            f"inbound queue for {msg.recipient} is full "
+                            f"({depth}/{max_depth}); send rejected"
+                        )
+                    for i, m in enumerate(self._queue):
+                        if m.recipient == msg.recipient:
+                            self.dead_letters.append(m)
+                            del self._queue[i]
+                            break
             self._queue.append(msg)
             self.sent_count += 1
 
@@ -234,6 +278,7 @@ class BrokerMessagingService:
         self.bridges = bridges
         self.queue_name = f"p2p.inbound.{me.name}"
         broker.create_queue(self.queue_name, durable=broker._journal_dir is not None)
+        self._bound_queue(self.queue_name)
         self._handlers: Dict[str, List[Callable]] = {}
         # Set by AbstractNode to the SMM registry: per-topic handler
         # timers (P2P.Handle.<topic>) locate where node wall-time goes —
@@ -256,6 +301,24 @@ class BrokerMessagingService:
         # restarts while peers' bridges are retrying. Inbound messages
         # wait safely in the (durable) queue until start().
 
+    #: default inbound-queue depth cap (overload protection): a 5x burst
+    #: that outruns the pump parks in a BOUNDED queue and overflow
+    #: rejects the sender (bridges retry; local senders see
+    #: QueueFullError) instead of growing RSS without bound.
+    #: CORDA_TPU_P2P_QUEUE_MAX=0 removes the bound.
+    P2P_QUEUE_MAX = 10_000
+
+    def _bound_queue(self, queue: str) -> None:
+        max_depth = int(
+            os.environ.get("CORDA_TPU_P2P_QUEUE_MAX", self.P2P_QUEUE_MAX)
+        )
+        # ingest queues use reject-new: P2P session traffic must never be
+        # silently dropped mid-conversation (the sender's bridge holds it
+        # durably and retries); RemoteBroker transports have no bound API
+        # — the owning broker process bounds server-side
+        if max_depth > 0 and hasattr(self.broker, "set_queue_bound"):
+            self.broker.set_queue_bound(queue, max_depth, "reject")
+
     def start(self) -> None:
         if not self._thread.is_alive():
             self._thread.start()
@@ -273,6 +336,7 @@ class BrokerMessagingService:
         self.broker.create_queue(
             queue, durable=self.broker._journal_dir is not None
         )
+        self._bound_queue(queue)
         consumer = self.broker.create_consumer(queue)
         self._extra_consumers.append(consumer)
         thread = threading.Thread(

@@ -57,6 +57,15 @@ class NodeConfiguration:
     # GET /traces/<id>, GET /traces/slow): None = off, 0 = ephemeral
     # port (read it back from node.ops_server.port), N = fixed port.
     ops_port: Optional[int] = None
+    # Overload protection / admission control (docs/robustness.md):
+    # token-bucket rate limit on NEW client flow starts (flows/s; None
+    # falls back to CORDA_TPU_ADMISSION_RATE, unset = no rate gate),
+    # bucket burst (default 2x rate), and the live-flow concurrency cap
+    # (None -> CORDA_TPU_ADMISSION_MAX_FLOWS, unset = no cap). With
+    # neither rate nor cap configured the admission seam is inert.
+    admission_rate: Optional[float] = None
+    admission_burst: Optional[float] = None
+    admission_max_flows: Optional[int] = None
 
 
 class AbstractNode:
@@ -111,14 +120,131 @@ class AbstractNode:
         if config.notary_type is not None:
             self._make_notary_service()
         self.started = False
+        self._setup_overload_protection()
         self._register_health_checks()
         self._register_backpressure_metrics()
 
     # -- assembly ------------------------------------------------------------
 
+    def _setup_overload_protection(self) -> None:
+        """The act-on-backpressure layer (docs/robustness.md): an
+        overload state machine fed by the PR-3 gauges, plus — when
+        admission is configured — an AdmissionController on the SMM's
+        flow-start seam. The state machine always exists (it backs the
+        `overload` health component and the Overload.State gauge); with
+        default thresholds it only trips under real saturation."""
+        import os as _os
+
+        from .admission import AdmissionController, OverloadStateMachine
+
+        self.overload = OverloadStateMachine(
+            metrics=self.metrics, node_name=self.info.name,
+        )
+        net = self.network
+        if hasattr(net, "queue_depth"):
+            self.overload.add_signal(
+                "p2p_queue_depth", net.queue_depth,
+                high=float(_os.environ.get(
+                    "CORDA_TPU_OVERLOAD_QDEPTH_HIGH", 5000
+                )),
+            )
+        self.overload.add_signal(
+            "blocking_backlog",
+            lambda: (
+                self.smm._blocking_executor._work_queue.qsize()
+                if self.smm._blocking_executor is not None else 0
+            ),
+            high=float(_os.environ.get(
+                "CORDA_TPU_OVERLOAD_BACKLOG_HIGH", 256
+            )),
+        )
+        batcher = getattr(
+            self.services.transaction_verifier_service, "_batcher", None
+        )
+        if batcher is not None:
+            self.overload.add_signal(
+                "batcher_queued_batches", lambda: batcher.queued_batches,
+                high=float(_os.environ.get(
+                    "CORDA_TPU_OVERLOAD_BATCHER_HIGH", 64
+                )),
+            )
+        cfg = self.config
+        env = _os.environ
+        rate = (
+            cfg.admission_rate if cfg.admission_rate is not None
+            else (float(env["CORDA_TPU_ADMISSION_RATE"])
+                  if env.get("CORDA_TPU_ADMISSION_RATE") else None)
+        )
+        max_flows = (
+            cfg.admission_max_flows if cfg.admission_max_flows is not None
+            else (int(float(env["CORDA_TPU_ADMISSION_MAX_FLOWS"]))
+                  if env.get("CORDA_TPU_ADMISSION_MAX_FLOWS") else None)
+        )
+        self.admission = None
+        if (rate and rate > 0) or (max_flows and max_flows > 0):
+            self.admission = AdmissionController(
+                rate=rate, burst=cfg.admission_burst, max_flows=max_flows,
+                live_flows=lambda: self.smm.in_flight_count,
+                overload=self.overload, metrics=self.metrics,
+                node_name=self.info.name,
+            )
+            self.smm.admission = self.admission
+            if max_flows and max_flows > 0:
+                # live flows at the cap IS saturation: sustained bursts
+                # flip the machine to shedding, and recovery (flows
+                # draining under the low-water mark + the quiet dwell)
+                # flips /readyz back to 200
+                self.overload.add_signal(
+                    "live_flows", lambda: self.smm.in_flight_count,
+                    high=float(max_flows),
+                )
+        # shed telemetry: broker sheds land in Shed.* counters + the
+        # flight recorder; the in-memory test transport exposes its
+        # drop count as a gauge on the same family
+        shed_dead = self.metrics.counter("Shed.DeadLettered")
+        shed_rej = self.metrics.counter("Shed.RejectedSends")
+        broker = getattr(net, "broker", None)
+        if broker is not None and hasattr(broker, "on_shed"):
+            def on_shed(queue: str, policy: str, _msg) -> None:
+                (shed_dead if policy == "drop_oldest" else shed_rej).inc()
+                eventlog.emit(
+                    "warning", "messaging", "queue shed",
+                    queue=queue, policy=policy, node=self.info.name,
+                )
+
+            broker.on_shed = on_shed
+        inmem = getattr(net, "network", None)
+        if inmem is not None and hasattr(inmem, "shed_counts"):
+            self.metrics.gauge(
+                "Shed.NetworkDropped",
+                lambda: inmem.shed_counts.get(self.info.name, 0),
+            )
+        if self.notary_service is not None:
+            provider = self.notary_service.uniqueness_provider
+            if hasattr(provider, "sheds"):
+                self.metrics.gauge(
+                    "Shed.NotaryQueue", lambda: provider.sheds
+                )
+
     def _register_health_checks(self) -> None:
         """Component checks behind /healthz and /readyz. Check bodies are
-        cheap reads only — they run on ops-server request threads."""
+        cheap reads only — they run on ops-server request threads.
+
+        Degradation checks (queue depth, blocking backlog) are
+        DEBOUNCED: a breach must hold for CORDA_TPU_HEALTH_SUSTAIN_S
+        (default 5 s) of continuous probing before readiness degrades —
+        one spike at probe time must not make the load balancer yank a
+        healthy node."""
+        import os as _os
+
+        from .health import SustainedBreach
+
+        sustain_s = float(_os.environ.get("CORDA_TPU_HEALTH_SUSTAIN_S", 5.0))
+        qdepth_degrade = float(
+            _os.environ.get("CORDA_TPU_HEALTH_QDEPTH_DEGRADE", 5000)
+        )
+        msg_breach = SustainedBreach(sustain_s)
+        sm_breach = SustainedBreach(sustain_s)
 
         def check_messaging():
             net = self.network
@@ -143,15 +269,55 @@ class AbstractNode:
             detail = {"flows_in_flight": self.smm.in_flight_count}
             executor = self.smm._blocking_executor
             if executor is not None:
+                detail["blocking_backlog"] = executor._work_queue.qsize()
+                detail["blocking_workers"] = executor._max_workers
+            return detail
+
+        def check_backpressure():
+            # READINESS-only (liveness=False): sustained inbound-queue
+            # saturation or blocking-backlog saturation means this node
+            # should stop receiving new routing — but it is overload,
+            # not sickness: failing /healthz would invite an
+            # orchestrator restart that destroys exactly the in-flight
+            # work the backpressure is protecting
+            detail = {}
+            degraded = []
+            net = self.network
+            if hasattr(net, "queue_depth"):
+                depth = net.queue_depth()
+                detail["queue_depth"] = depth
+                if msg_breach.observe(depth > qdepth_degrade):
+                    degraded.append(
+                        f"queue depth > {qdepth_degrade:g} for "
+                        f"{msg_breach.breached_for_s:.1f}s"
+                    )
+            executor = self.smm._blocking_executor
+            if executor is not None:
                 # saturation = a backlog several times the worker count
                 # (the threads mostly block on cluster commits; a deep
-                # queue here is the upstream sign of a commit stall)
+                # queue here is the upstream sign of a commit stall) —
+                # sustained, so one probe-time burst cannot flip /readyz
                 backlog = executor._work_queue.qsize()
                 workers = executor._max_workers
                 detail["blocking_backlog"] = backlog
-                detail["blocking_workers"] = workers
-                detail["ok"] = backlog < workers * 8
+                if sm_breach.observe(backlog >= workers * 8):
+                    degraded.append(
+                        "blocking backlog saturated for "
+                        f"{sm_breach.breached_for_s:.1f}s"
+                    )
+            detail["ok"] = not degraded
+            if degraded:
+                detail["degraded"] = "; ".join(degraded)
             return detail
+
+        def check_overload():
+            # overload is an ADMISSION verdict, not a liveness one:
+            # shedding flips /readyz 503 (stop routing new work here)
+            # while /healthz stays 200 with this component's detail —
+            # recovery (back to "normal" after the quiet dwell) flips
+            # /readyz 200 again
+            snap = self.overload.snapshot()
+            return {"ok": snap["state"] == "normal", **snap}
 
         def check_hospital():
             # informational (never fails the probe): recovery activity
@@ -169,6 +335,9 @@ class AbstractNode:
         self.health.register("verifier", check_verifier)
         self.health.register("statemachine", check_statemachine)
         self.health.register("hospital", check_hospital, readiness=False)
+        self.health.register("overload", check_overload, liveness=False)
+        self.health.register("backpressure", check_backpressure,
+                             liveness=False)
 
         if self.notary_service is not None:
             def check_notary():
@@ -590,6 +759,7 @@ class AbstractNode:
             self.ops_server = OpsServer(
                 self.smm.metrics, health=self.health,
                 hospital=self.smm.hospital,
+                admission=self.admission, overload=self.overload,
                 port=self.config.ops_port,
             )
         self.started = True

@@ -17,6 +17,9 @@ recorder's structured event log:
     GET /hospital                     flow-hospital view: flows awaiting
                                       checkpoint-replay retry + the
                                       dead-letter ward (docs/robustness.md)
+    GET /overload                     overload protection: admission
+                                      counters/token state + the overload
+                                      state machine's signal readings
     GET /healthz                      200 while serving + checks pass;
                                       503 with a JSON cause when
                                       starting/draining/unhealthy
@@ -148,13 +151,15 @@ class OpsServer(MiniWebServer):
                  tracer: Optional[Tracer] = None,
                  health: Optional[HealthTracker] = None,
                  event_log: Optional[EventLog] = None,
-                 hospital=None,
+                 hospital=None, admission=None, overload=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
         self._tracer = tracer
         self.health = health
         self._event_log = event_log
         self.hospital = hospital  # node.hospital.FlowHospital (optional)
+        self.admission = admission  # node.admission.AdmissionController
+        self.overload = overload  # node.admission.OverloadStateMachine
         super().__init__(host=host, port=port)
 
     @property
@@ -208,6 +213,19 @@ class OpsServer(MiniWebServer):
             if self.hospital is None:
                 return 200, {"enabled": False, "recovering": [], "ward": []}
             return 200, self.hospital.snapshot()
+        if path == "/overload":
+            # the overload-protection operator view: admission counters
+            # + token state, and the overload state machine's signals
+            return 200, {
+                "admission": (
+                    self.admission.snapshot()
+                    if self.admission is not None else None
+                ),
+                "overload": (
+                    self.overload.snapshot()
+                    if self.overload is not None else None
+                ),
+            }
         if path == "/metrics":
             return 200, RawResponse(
                 render_prometheus(self.registry.snapshot()),

@@ -74,7 +74,7 @@ class SchedulerService:
                 # Remove first: if the flow crashes we do not re-fire forever
                 # (the reference relies on the flow consuming the state).
                 self._store.delete(k)
-            for _, entry in due:
+            for k, entry in due:
                 cls = flow_registry.get(entry["flow_name"])
                 if cls is None:
                     import logging as _logging
@@ -86,7 +86,26 @@ class SchedulerService:
                     continue
                 args = tuple(entry["flow_args"])
                 flow = cls(*args)
-                handle = self._smm.start_flow(flow, *args)
+                try:
+                    handle = self._smm.start_flow(flow, *args)
+                except Exception as exc:
+                    # admission shed (NodeOverloadedError) or any other
+                    # start failure: a time-triggered activity must be
+                    # DEFERRED, never silently lost — put the entry back
+                    # so the next wake retries it once load drops
+                    from ..utils import eventlog
+                    from .admission import NodeOverloadedError
+
+                    self._store.put(k, serialize(entry))
+                    eventlog.emit(
+                        "warning", "scheduler",
+                        "scheduled activity deferred",
+                        flow=entry["flow_name"],
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+                    if not isinstance(exc, NodeOverloadedError):
+                        raise
+                    continue
                 started.append(handle.flow_id)
         if started:
             from ..utils import eventlog

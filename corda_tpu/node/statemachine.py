@@ -756,6 +756,15 @@ class StateMachineManager:
         from .hospital import FlowHospital
 
         self.hospital = FlowHospital(self)
+        # overload protection: AbstractNode installs an AdmissionController
+        # here when admission is configured; None = every start admitted.
+        # The gate covers NEW top-level flows only — responders, hospital
+        # readmissions (_restore) and checkpoint restores are priority
+        # traffic and enter below this seam. _start_gate makes the
+        # cap-check + flows-registration atomic: two RPC pool threads
+        # racing start_flow must not both pass a max_flows-1 reading.
+        self.admission = None
+        self._start_gate = threading.Lock()
         messaging.add_handler(SESSION_TOPIC, self._on_session_message)
 
     # -- public API ---------------------------------------------------------
@@ -764,12 +773,22 @@ class StateMachineManager:
         """Run a new top-level flow.  For checkpoint-restorability pass the
         flow's constructor args via args_for_restore (they must be
         codec-serializable); flows started without them still run but
-        restore will fail loudly."""
+        restore will fail loudly.
+
+        Raises NodeOverloadedError (with a retry_after_ms hint) when an
+        installed AdmissionController sheds the start — system flows
+        (`_system_flow = True` classes) are priority and never shed."""
         flow_id = str(uuid.uuid4())
         fsm = FlowStateMachine(
             flow_id, flow, self, args=tuple(args_for_restore), kwargs=kw
         )
-        self.flows[flow_id] = fsm
+        with self._start_gate:
+            # admit + register atomically: the live-flow cap reads
+            # in_flight_count, so the admitted flow must be visible
+            # before the next admission decision runs
+            if self.admission is not None:
+                self.admission.admit(flow=flow)
+            self.flows[flow_id] = fsm
         self._notify("started", fsm)
         fsm.start()
         return FlowHandle(flow_id, fsm.result)
@@ -971,6 +990,11 @@ class StateMachineManager:
             )
             return
         flow = responder_cls(sender)
+        # responder flows are PRIORITY traffic: they complete work a peer
+        # already admitted (notary commits arrive exactly this way), so
+        # admission counts them but can never shed them
+        if self.admission is not None:
+            self.admission.admit(flow=flow, is_responder=True)
         flow_id = str(uuid.uuid4())
         fsm = FlowStateMachine(
             flow_id, flow, self, args=(sender,), is_responder=True

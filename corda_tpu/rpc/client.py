@@ -88,6 +88,21 @@ class CordaRPCClient:
         self.timeout = timeout
         self._reply_queue = f"rpc.client.{uuid.uuid4()}"
         broker.create_queue(self._reply_queue)
+        # overload protection, egress class: a slow client must not grow
+        # its reply/observation queue without bound on the broker —
+        # drop-oldest sheds stale observations into dead.letter (call
+        # replies are request/response; a dropped one surfaces as the
+        # caller's timeout, same as a lost reply today).
+        # CORDA_TPU_RPC_CLIENT_QUEUE_MAX=0 removes the bound.
+        import os as _os
+
+        client_queue_max = int(
+            _os.environ.get("CORDA_TPU_RPC_CLIENT_QUEUE_MAX", 10_000)
+        )
+        if client_queue_max > 0 and hasattr(broker, "set_queue_bound"):
+            broker.set_queue_bound(
+                self._reply_queue, client_queue_max, "drop_oldest"
+            )
         self._pending: Dict[str, Future] = {}
         self._observables: Dict[str, Observable] = {}
         self._early_observations: Dict[str, list] = {}
@@ -133,6 +148,15 @@ class CordaRPCClient:
         )
         if "error" in reply:
             err = reply["error"]
+            if reply.get("overloaded"):
+                # the node shed this call (admission control): re-raise
+                # the TYPED error so callers can honour retry_after_ms
+                # instead of string-matching
+                from ..node.admission import NodeOverloadedError
+
+                raise NodeOverloadedError(
+                    err, retry_after_ms=reply.get("retry_after_ms", 0)
+                )
             if isinstance(err, str) and err.startswith("PERMISSION:"):
                 raise RPCPermissionError(err[len("PERMISSION:"):])
             raise RPCException(err)

@@ -318,6 +318,13 @@ class CordaRPCOps:
             raise ValueError(f"unknown upload {upload_id}")
         return self._services.attachments.import_attachment(bytes(entry[0]))
 
+    def upload_attachment_abort(self, upload_id: str) -> bool:
+        """Abandon a chunked upload mid-stream, releasing its concurrency
+        slot immediately (the TTL purge is the backstop for clients that
+        die without aborting; this is the polite path). Idempotent:
+        returns False when the id is unknown or already finished."""
+        return self._uploads.pop(upload_id, None) is not None
+
     # -- network / identity --------------------------------------------------
 
     def network_map_snapshot(self) -> List:

@@ -15,6 +15,7 @@ Permissions (reference RPC users in node.conf): a user has a set like
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -49,6 +50,17 @@ class RPCServer:
         # and silently leak the session's observables)
         self._state_lock = threading.Lock()
         broker.create_queue(RPC_SERVER_QUEUE)
+        # overload protection: the RPC ingest queue is bounded with the
+        # reject-new policy — a client flooding requests sees
+        # QueueFullError at send() (synchronous backpressure) instead of
+        # growing the broker without bound. CORDA_TPU_RPC_QUEUE_MAX=0
+        # removes the bound; RemoteBroker clients rely on the owning
+        # broker process applying it server-side.
+        rpc_queue_max = int(
+            os.environ.get("CORDA_TPU_RPC_QUEUE_MAX", 10_000)
+        )
+        if rpc_queue_max > 0 and hasattr(broker, "set_queue_bound"):
+            broker.set_queue_bound(RPC_SERVER_QUEUE, rpc_queue_max, "reject")
         self._stop = threading.Event()
         self._consumer = broker.create_consumer(RPC_SERVER_QUEUE)
         # Calls run on a pool: a blocking op (flow_result waiting a minute
@@ -128,6 +140,19 @@ class RPCServer:
                 except RuntimeError:
                     pass  # pool shut down: server stopping
             self._consumer.ack(msg)
+
+    @staticmethod
+    def _error_fields(exc: BaseException) -> dict:
+        """Reply fields for a failed call. NodeOverloadedError carries
+        its retry_after_ms hint as structured fields so CordaRPCClient
+        re-raises the typed error and callers can back off."""
+        from ..node.admission import NodeOverloadedError
+
+        fields = {"error": f"{type(exc).__name__}: {exc}"}
+        if isinstance(exc, NodeOverloadedError):
+            fields["overloaded"] = True
+            fields["retry_after_ms"] = exc.retry_after_ms
+        return fields
 
     def _reply(self, reply_to: str, payload: dict) -> None:
         # Serialize and send are distinct failure classes: a result that
@@ -253,7 +278,7 @@ class RPCServer:
             except Exception as exc:
                 self._reply(reply_to, {
                     "kind": "reply", "id": req_id,
-                    "error": f"{type(exc).__name__}: {exc}",
+                    **self._error_fields(exc),
                 })
                 return
             if self._handle_flow_result_async(
@@ -280,7 +305,7 @@ class RPCServer:
         except Exception as exc:
             self._reply(reply_to, {
                 "kind": "reply", "id": req_id,
-                "error": f"{type(exc).__name__}: {exc}",
+                **self._error_fields(exc),
             })
             return
         finally:
@@ -316,7 +341,7 @@ class RPCServer:
             try:
                 result = f.result()
             except Exception as exc:
-                reply_once({"error": f"{type(exc).__name__}: {exc}"})
+                reply_once(self._error_fields(exc))
                 return
             reply_once({"ok": self._marshal(result, "", reply_to)})
 

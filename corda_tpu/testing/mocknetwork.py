@@ -46,9 +46,14 @@ class MockNetwork:
         clock=None,
         dev_checkpoint_check: bool = True,
         ops_port: Optional[int] = None,
+        admission_rate: Optional[float] = None,
+        admission_burst: Optional[float] = None,
+        admission_max_flows: Optional[int] = None,
     ) -> MockNode:
         """`ops_port`: pass 0 to serve this node's /metrics + /traces on
-        an ephemeral port (node.ops_server.port); None = no endpoint."""
+        an ephemeral port (node.ops_server.port); None = no endpoint.
+        `admission_*`: overload-protection knobs (docs/robustness.md) —
+        with neither rate nor max_flows set, admission is inert."""
         config = NodeConfiguration(
             my_legal_name=legal_name,
             db_path=db_path,
@@ -56,6 +61,9 @@ class MockNetwork:
             identity_entropy=entropy if entropy is not None else self._next_entropy(),
             dev_checkpoint_check=dev_checkpoint_check,
             ops_port=ops_port,
+            admission_rate=admission_rate,
+            admission_burst=admission_burst,
+            admission_max_flows=admission_max_flows,
         )
         node = MockNode(
             config, self.messaging_network.create_endpoint,

@@ -53,15 +53,25 @@ class SignatureBatcher:
     inherit the environment from their launcher."""
 
     def __init__(self, max_batch: Optional[int] = None,
-                 linger_ms: Optional[float] = None):
+                 linger_ms: Optional[float] = None,
+                 max_queued_batches: Optional[int] = None):
         if max_batch is None:
             max_batch = int(os.environ.get("CORDA_TPU_BATCHER_MAX", 4096))
         if linger_ms is None:
             linger_ms = float(
                 os.environ.get("CORDA_TPU_BATCHER_LINGER_MS", 2.0)
             )
+        if max_queued_batches is None:
+            max_queued_batches = int(
+                os.environ.get("CORDA_TPU_BATCHER_MAX_QUEUED", 16)
+            )
         self.max_batch = max_batch
         self.linger_ms = linger_ms
+        # overload protection: with the flush queue at this many waiting
+        # buffers, submit_many BLOCKS the submitter until the flush
+        # thread catches up — overflow becomes synchronous backpressure
+        # on producers instead of unbounded queued batches. 0 = unbounded.
+        self.max_queued_batches = max_queued_batches
         # one lock: guards the fill buffer AND (as the condition's lock)
         # the flush queue / in-flight count
         self._lock = threading.Lock()
@@ -84,6 +94,7 @@ class SignatureBatcher:
         # say precedes a throughput collapse), plus an optional registry
         # binding for the gauges/histograms
         self.flush_lag_s = 0.0
+        self.backpressure_waits = 0  # submits that blocked on the cap
         self._registry = None
 
     def bind_metrics(self, registry) -> None:
@@ -98,6 +109,8 @@ class SignatureBatcher:
         registry.gauge("Verifier.BatcherInFlight", lambda: self.in_flight)
         registry.gauge("Verifier.BatcherFlushLagSeconds",
                        lambda: round(self.oldest_queued_age_s, 6))
+        registry.gauge("Verifier.BatcherBackpressureWaits",
+                       lambda: self.backpressure_waits)
         registry.histogram("Verifier.BatchSize")
 
     # -- backpressure read surface -----------------------------------------
@@ -140,6 +153,25 @@ class SignatureBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if (
+                self.max_queued_batches
+                and len(self._flush_queue) >= self.max_queued_batches
+            ):
+                # flush queue at capacity: block the SUBMITTER until the
+                # flush thread drains (synchronous backpressure — the
+                # overload stops here instead of growing the queue).
+                # Bounded wait: a dead flush thread must degrade to the
+                # old unbounded behavior, never deadlock a submitter.
+                self.backpressure_waits += 1
+                deadline = time.monotonic() + 30.0
+                while (
+                    len(self._flush_queue) >= self.max_queued_batches
+                    and not self._closed
+                    and time.monotonic() < deadline
+                ):
+                    self._cv.wait(timeout=0.05)
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
             self._pending.extend(
                 (item, fut, ctx) for item, fut in zip(items, futures)
             )
@@ -168,6 +200,10 @@ class SignatureBatcher:
                 self._hand_off_locked()
 
     def _hand_off_locked(self) -> None:
+        # NOTE: hands off even when the flush queue is at its cap — the
+        # linger callback runs on the timer wheel's shared pool and must
+        # never block; only submit_many (caller threads) absorbs the
+        # backpressure, so the queue can exceed the cap by one buffer.
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
@@ -196,6 +232,9 @@ class SignatureBatcher:
                 t_queued, batch = self._flush_queue.popleft()
                 self.flush_lag_s += time.monotonic() - t_queued
                 self._in_flight += 1
+                # wake submitters blocked on the flush-queue cap: the
+                # queue just shrank
+                self._cv.notify_all()
             try:
                 self._run_batch(batch)
             finally:
