@@ -198,13 +198,35 @@ def latest_baseline(repo_dir: str) -> Optional[Tuple[str, Dict]]:
 def run_gate(cur: Dict, prev: Optional[Dict],
              threshold: float = DEFAULT_THRESHOLD,
              slos: Optional[SloSpec] = None) -> Dict:
-    """One-call policy: {"ok", "regressions", "slo_violations"}. With no
-    baseline (`prev` None) only SLOs gate; with no SLO spec only the
-    comparison gates."""
+    """One-call policy: {"ok", "regressions", "warnings",
+    "slo_violations", "fingerprint_mismatch"}. With no baseline (`prev`
+    None) only SLOs gate; with no SLO spec only the comparison gates.
+
+    When both records carry an `env_fingerprint`
+    (utils/quiesce.env_fingerprint, stamped by bench.py) and the
+    fingerprints DIFFER — different backend, device, interpreter, core
+    count — the stage-timing comparison is demoted from failures to
+    `warnings`: a CPU-fallback round "regressing" against a TPU round
+    is a provenance change, not a performance change, and silently
+    hard-comparing across environments is exactly how the round-5
+    host-number confusion happened. Records without fingerprints (old
+    artifacts) keep the gate's full teeth. Absolute SLO bounds stay
+    hard either way — a bound the operator asserted is a bound."""
+    from ..utils.quiesce import fingerprint_mismatch
+
     regressions = compare_records(prev, cur, threshold) if prev else []
     violations = check_slos(cur, slos) if slos else []
+    mismatch = fingerprint_mismatch(
+        (prev or {}).get("env_fingerprint"),
+        (cur or {}).get("env_fingerprint"),
+    )
+    warnings: List[Dict] = []
+    if mismatch and regressions:
+        warnings, regressions = regressions, []
     return {
         "ok": not regressions and not violations,
         "regressions": regressions,
+        "warnings": warnings,
         "slo_violations": violations,
+        "fingerprint_mismatch": mismatch,
     }

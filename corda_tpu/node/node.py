@@ -391,6 +391,17 @@ class AbstractNode:
         self.metrics.gauge(
             "Jax.CompileCount", lambda: _profiling.dispatch_totals()[1]
         )
+        # per-shape-bucket ed25519 compile counts, always-on: a
+        # recompile storm in production names the churning bucket here
+        # instead of only in a bench run's stage_timings (the label
+        # suffix renders as Prometheus labels on the same family)
+        for bucket in _profiling.ED25519_BUCKET_LABELS:
+            self.metrics.gauge(
+                f"Jax.CompileCount{{bucket={bucket}}}",
+                lambda b=bucket: _profiling.compile_count(
+                    "ed25519.batch_shape", b
+                ),
+            )
         self.metrics.gauge(
             "Jax.DispatchCount", lambda: _profiling.dispatch_totals()[0]
         )
@@ -398,6 +409,37 @@ class AbstractNode:
             "Jax.DispatchWallSeconds",
             lambda: round(_profiling.dispatch_totals()[2], 6),
         )
+
+        # kernel op-budget attestation (ops/opbudget.py): −1 until this
+        # process traced the kernels (bench --gate, tier-1 gate, or
+        # GET /opbudget?compute=1) — read via sys.modules so a scrape
+        # can never trigger the jax import, let alone the trace
+        def opbudget_gauge(kernel: str, metric: str):
+            def read():
+                mod = _sys.modules.get("corda_tpu.ops.opbudget")
+                return -1.0 if mod is None else mod.gauge_value(
+                    kernel, metric
+                )
+
+            return read
+
+        for kernel in _profiling.OPBUDGET_KERNELS:
+            self.metrics.gauge(
+                f"Kernel.OpBudget.U32MulElemsPerSig{{kernel={kernel}}}",
+                opbudget_gauge(kernel, "u32_mul_elems_per_sig"),
+            )
+            self.metrics.gauge(
+                f"Kernel.OpBudget.FieldMulsPerSig{{kernel={kernel}}}",
+                opbudget_gauge(kernel, "field_mul_equiv_per_sig"),
+            )
+
+        # sampling profiler (utils/sampler.py): capture activity for the
+        # /profile endpoint and RPC node_profile
+        from ..utils import sampler as _sampler
+
+        self.metrics.gauge("Profiler.Captures", _sampler.captures_total)
+        self.metrics.gauge("Profiler.Samples", _sampler.samples_total)
+        self.metrics.gauge("Profiler.Active", _sampler.active_captures)
 
     def _make_transaction_verifier_service(self):
         if self.config.verifier_type == "OutOfProcess":

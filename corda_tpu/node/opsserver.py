@@ -20,6 +20,15 @@ recorder's structured event log:
     GET /overload                     overload protection: admission
                                       counters/token state + the overload
                                       state machine's signal readings
+    GET /profile?seconds=N            sampling profiler capture (collapsed
+                                      stacks + per-thread CPU-share table,
+                                      utils/sampler.py); format=collapsed
+                                      for flamegraph.pl text; 409 while
+                                      another capture runs
+    GET /opbudget                     kernel op-budget attestation: cached
+                                      traced counts vs the pinned manifest
+                                      (ops/opbudget.py); compute=1 traces
+                                      now (seconds of CPU, explicit only)
     GET /healthz                      200 while serving + checks pass;
                                       503 with a JSON cause when
                                       starting/draining/unhealthy
@@ -45,6 +54,9 @@ _INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 _CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
 #: summary quantiles exported per timer (keys match Timer.snapshot())
 _QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+#: registry names may carry a label suffix — `Jax.CompileCount{bucket=64}`
+#: — rendered as Prometheus labels on samples of the base family
+_LABELLED = re.compile(r"^(?P<base>[^{]+)\{(?P<labels>[^{}]*)\}$")
 
 
 def prom_name(name: str) -> str:
@@ -57,6 +69,19 @@ def prom_name(name: str) -> str:
     return f"corda_tpu_{s}"
 
 
+def split_labels(name: str):
+    """`Base{k=v,k2=v2}` -> ("Base", ((k, v), (k2, v2))); plain names
+    pass through with no labels. Values may be bare or double-quoted."""
+    m = _LABELLED.match(name)
+    if not m:
+        return name, ()
+    labels = []
+    for part in m.group("labels").split(","):
+        key, _, value = part.partition("=")
+        labels.append((key.strip(), value.strip().strip('"')))
+    return m.group("base"), tuple(labels)
+
+
 def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
@@ -64,10 +89,20 @@ def _escape_help(text: str) -> str:
 def render_prometheus(snapshot: Dict[str, Dict]) -> str:
     """MetricRegistry.snapshot() -> Prometheus exposition text. Counters
     export as `<name>_total`, gauges as `<name>`, meters as a counter
-    plus rate gauges, timers as a `<name>_seconds` summary. Every family
-    gets exactly one HELP/TYPE pair; a sanitisation collision keeps the
-    first family and drops the latecomer (duplicate families are a
-    protocol violation scrapers reject outright)."""
+    plus rate gauges, timers as a `<name>_seconds` summary. Registry
+    names carrying a `{label=value}` suffix group with their base into
+    ONE family, the labels riding each sample — which is what lets
+    `Jax.CompileCount` and `Jax.CompileCount{bucket=…}` share a family
+    instead of violating the one-TYPE-per-family rule. Every family gets
+    exactly one HELP/TYPE pair; a sanitisation collision keeps the first
+    family and drops the latecomer (duplicate families are a protocol
+    violation scrapers reject outright)."""
+    # group label variants under their base, preserving sorted order
+    groups: Dict[str, list] = {}
+    for name in sorted(snapshot):
+        base, labels = split_labels(name)
+        groups.setdefault(base, []).append((labels, snapshot[name]))
+
     lines = []
     seen = set()
 
@@ -86,51 +121,64 @@ def render_prometheus(snapshot: Dict[str, Dict]) -> str:
             )
             lines.append(f"{base}{suffix}{label_s} {value}")
 
-    for name in sorted(snapshot):
-        snap = snapshot[name]
-        base = prom_name(name)
-        mtype = snap.get("type")
-        src = f"corda-tpu metric {name!r} ({mtype})"
+    for base_name in sorted(groups):
+        members = groups[base_name]
+        base = prom_name(base_name)
+        # all members must agree on type; a mismatched latecomer is
+        # dropped under the same first-wins collision rule
+        mtype = members[0][1].get("type")
+        members = [m for m in members if m[1].get("type") == mtype]
+        src = f"corda-tpu metric {base_name!r} ({mtype})"
         if mtype == "counter":
-            family(base + "_total", "counter", src,
-                   [("", (), snap.get("count", 0))])
-        elif mtype == "gauge":
-            value = snap.get("value")
-            if isinstance(value, bool):
-                value = int(value)
-            if isinstance(value, (int, float)):
-                family(base, "gauge", src, [("", (), value)])
-            # dead gauges ({"error": ...}) and non-numeric readings are
-            # skipped: an unparseable sample poisons the whole scrape
-        elif mtype == "meter":
-            family(base + "_total", "counter", src,
-                   [("", (), snap.get("count", 0))])
-            family(base + "_rate", "gauge", src, [
-                ("", (("window", "mean"),), snap.get("mean_rate")),
-                ("", (("window", "1m"),), snap.get("m1_rate")),
-                ("", (("window", "5m"),), snap.get("m5_rate")),
+            family(base + "_total", "counter", src, [
+                ("", labels, snap.get("count", 0))
+                for labels, snap in members
             ])
-        elif mtype == "timer":
-            samples = [
-                ("", (("quantile", q),), snap.get(key))
-                for q, key in _QUANTILES
-            ]
-            samples.append(("_sum", (), snap.get("total", 0.0)))
-            samples.append(("_count", (), snap.get("count", 0)))
-            family(base + "_seconds", "summary", src, samples)
-        elif mtype == "histogram":
-            # unitless distribution (batch sizes, occupancies): same
-            # quantile-summary shape as timers, no _seconds suffix
-            samples = [
-                ("", (("quantile", q),), snap.get(key))
-                for q, key in _QUANTILES
-            ]
-            samples.append(("_sum", (), snap.get("total", 0.0)))
-            samples.append(("_count", (), snap.get("count", 0)))
-            family(base, "summary", src, samples)
+        elif mtype == "gauge":
+            samples = []
+            for labels, snap in members:
+                value = snap.get("value")
+                if isinstance(value, bool):
+                    value = int(value)
+                if isinstance(value, (int, float)):
+                    samples.append(("", labels, value))
+                # dead gauges ({"error": ...}) and non-numeric readings
+                # are skipped: an unparseable sample poisons the scrape
+            if samples:
+                family(base, "gauge", src, samples)
+        elif mtype == "meter":
+            family(base + "_total", "counter", src, [
+                ("", labels, snap.get("count", 0))
+                for labels, snap in members
+            ])
+            family(base + "_rate", "gauge", src, [
+                ("", (*labels, ("window", window)), snap.get(key))
+                for labels, snap in members
+                for window, key in (
+                    ("mean", "mean_rate"), ("1m", "m1_rate"),
+                    ("5m", "m5_rate"),
+                )
+            ])
+        elif mtype in ("timer", "histogram"):
+            # histograms are unitless distributions (batch sizes,
+            # occupancies): same quantile-summary shape as timers,
+            # without the _seconds suffix
+            samples = []
+            for labels, snap in members:
+                samples.extend(
+                    ("", (*labels, ("quantile", q)), snap.get(key))
+                    for q, key in _QUANTILES
+                )
+                samples.append(("_sum", labels, snap.get("total", 0.0)))
+                samples.append(("_count", labels, snap.get("count", 0)))
+            family(
+                base + ("_seconds" if mtype == "timer" else ""),
+                "summary", src, samples,
+            )
         else:  # unknown/legacy blob: expose numeric fields as one gauge
             samples = [
-                ("", (("field", k),), v)
+                ("", (*labels, ("field", k)), v)
+                for labels, snap in members
                 for k, v in sorted(snap.items())
                 if k != "type" and isinstance(v, (int, float))
                 and not isinstance(v, bool)
@@ -226,6 +274,10 @@ class OpsServer(MiniWebServer):
                     if self.overload is not None else None
                 ),
             }
+        if path == "/profile":
+            return self._profile(query)
+        if path == "/opbudget":
+            return self._opbudget(query)
         if path == "/metrics":
             return 200, RawResponse(
                 render_prometheus(self.registry.snapshot()),
@@ -250,3 +302,64 @@ class OpsServer(MiniWebServer):
         if path == "/spans/summary":
             return 200, self.tracer.summary()
         raise KeyError(path)
+
+    def _profile(self, query: Dict[str, str]) -> Tuple[int, object]:
+        """One sampling-profiler capture on THIS request thread (the
+        response is the capture — a profile endpoint that returned
+        early would have nothing to say)."""
+        from ..utils import sampler
+
+        try:
+            seconds = float(query.get("seconds", 1.0))
+            interval = float(query.get("interval_ms", 10.0)) / 1000.0
+        except ValueError:
+            return 400, {
+                "error": "seconds and interval_ms must be numbers"
+            }
+        if not 0 < seconds <= sampler.MAX_SECONDS:
+            return 400, {
+                "error": f"seconds must be in (0, {sampler.MAX_SECONDS}]"
+            }
+        try:
+            result = sampler.capture(seconds=seconds, interval=interval)
+        except sampler.CaptureBusyError as exc:
+            return 409, {"error": str(exc)}
+        if query.get("format") == "collapsed":
+            return 200, RawResponse(
+                sampler.collapsed_text(result),
+                "text/plain; charset=utf-8",
+            )
+        return 200, result
+
+    def _opbudget(self, query: Dict[str, str]) -> Tuple[int, object]:
+        """Cached kernel op-budget view; `compute=1` traces every
+        registered kernel NOW (explicitly requested CPU-seconds) and
+        also returns the gate verdict against the pinned manifest.
+        The cached view never imports jax."""
+        import sys as _sys
+
+        if query.get("compute") == "1":
+            from ..ops import opbudget
+        else:
+            opbudget = _sys.modules.get("corda_tpu.ops.opbudget")
+        if opbudget is None:
+            return 200, {
+                "computed": False, "kernels": {}, "violations": None,
+                "hint": "GET /opbudget?compute=1 to trace the kernels",
+            }
+        violations = None
+        if query.get("compute") == "1":
+            try:
+                violations = opbudget.check_all()
+            except OSError as exc:  # manifest unreadable
+                violations = [{"kernel": None, "kind": "error",
+                               "error": repr(exc)}]
+        kernels = {
+            name: opbudget.cached_counts(name)
+            for name in opbudget.KERNEL_NAMES
+        }
+        return 200, {
+            "computed": all(v is not None for v in kernels.values()),
+            "kernels": kernels,
+            "violations": violations,
+        }

@@ -186,6 +186,12 @@ def _scalar_to_words(x: int) -> np.ndarray:
     return np.array([(x >> (32 * k)) & 0xFFFFFFFF for k in range(8)], np.uint32)
 
 
+#: padded batch shapes already seen (each new one = one XLA compile),
+#: keyed per curve — feeds the same Jax.CompileCount telemetry as the
+#: ed25519 buckets (utils/profiling.py)
+_SEEN_SHAPES: set = set()
+
+
 def prepare_batch(
     curve_name: str,
     public_keys: Sequence[bytes],  # X962 (compressed or uncompressed)
@@ -199,6 +205,13 @@ def prepare_batch(
     size = pad_to if pad_to is not None else max(
         8, 1 << (max(n, 1) - 1).bit_length()
     )
+    if (curve_name, size) not in _SEEN_SHAPES:
+        _SEEN_SHAPES.add((curve_name, size))
+        from ..utils import profiling
+
+        profiling.record_compile(
+            f"ecdsa.{curve_name}.batch_shape", bucket=str(size)
+        )
     qx = np.zeros((size, NLIMB), np.uint32)
     qy = np.zeros((size, NLIMB), np.uint32)
     u1 = np.zeros((size, 8), np.uint32)

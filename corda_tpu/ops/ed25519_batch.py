@@ -187,7 +187,7 @@ def verify_kernel(
 
 # --- host-side batch preparation --------------------------------------------
 
-_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+from ..utils.profiling import ED25519_SHAPE_BUCKETS as _BUCKETS
 
 
 def _bucket(n: int) -> int:
@@ -220,11 +220,15 @@ def prepare_batch(
     size = pad_to if pad_to is not None else _bucket(max(n, 1))
     if size not in _SEEN_SHAPES:
         # each distinct padded shape costs one XLA compile downstream;
-        # the ops endpoint exports the count as Jax.CompileCount
+        # the ops endpoint exports the count as Jax.CompileCount, with a
+        # per-bucket label so a recompile storm names its shape
         _SEEN_SHAPES.add(size)
         from ..utils import profiling
 
-        profiling.record_compile("ed25519.batch_shape")
+        profiling.record_compile(
+            "ed25519.batch_shape",
+            bucket=str(size) if size in _BUCKETS else "other",
+        )
     y_a = np.zeros((size, F.NLIMB), np.uint32)
     y_r = np.zeros((size, F.NLIMB), np.uint32)
     sign_a = np.zeros(size, np.uint32)

@@ -1,0 +1,421 @@
+"""Kernel op-budget attestation: trace-and-count the verify kernels.
+
+docs/perf-roofline.md derives the ed25519 ladder's cost budget by hand
+(≈3,300 field muls per signature for the Pallas kernel) and the round-3
+levers were all justified by op counts — but nothing MEASURED the counts,
+so a regression that quietly doubles the ladder's multiply work (a lost
+`_square` special case, a broadcast that re-runs a chain per limb, an
+accidental extra canonicalization) would ship invisibly and only surface
+months later as a halved hardware rate. This module closes that hole
+off-hardware, the same move as the Mosaic lowering gate:
+
+  * `count_kernel(name)` traces a registered verify kernel to its jaxpr
+    (abstract inputs — no compile, no device, works on the CPU-only CI
+    box) and walks it, multiplying through `scan` trip counts
+    (lax.fori_loop with static bounds lowers to scan), counting integer
+    `mul` element-ops and total integer element-ops, normalized per
+    signature.
+  * Each kernel family is self-calibrated: its own field multiply is
+    traced the same way, so `field_mul_equiv_per_sig` =
+    kernel-mul-elems / field-mul-elems stays meaningful across radix or
+    formulation changes to the field core itself.
+  * `opbudget_manifest.json` pins the counts (`python -m
+    corda_tpu.ops.opbudget --pin` regenerates it after a DELIBERATE
+    kernel change); `check_budget`/`check_all` fail when a kernel's
+    multiply count grows more than `tolerance` (default 5%) over its
+    pin — the tier-1 gate (tests/test_opbudget.py) and `bench.py --gate
+    → tools/bench_gate.py --opbudget` both call it.
+  * Counts are cached per process and exported as
+    `Kernel.OpBudget.*{kernel=…}` gauges on /metrics (−1 until counted:
+    a metrics scrape must never pay a multi-second trace, so the gauges
+    go live after the first gate run or `GET /opbudget?compute=1`).
+
+The module deliberately imports jax only inside functions: the node
+registers the gauges (and the ops endpoint serves the cached view)
+without touching the backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.profiling import OPBUDGET_KERNELS
+
+MANIFEST_PATH = os.path.join(os.path.dirname(__file__), "opbudget_manifest.json")
+
+#: tolerated relative growth of a pinned count before the gate fails
+DEFAULT_TOLERANCE = 0.05
+
+#: the counts a pin records and the gate compares (growth-gated ones
+#: first; the rest ride along as context)
+GATED_METRICS = ("u32_mul_elems_per_sig",)
+PINNED_METRICS = (
+    "u32_mul_elems_per_sig",
+    "field_mul_equiv_per_sig",
+    "int_elems_per_sig",
+    "mul_eqns",
+)
+
+#: TEST HOOK — extra dummy field multiplies folded into the traced
+#: kernel, per trace (tests/test_opbudget.py uses it to prove the gate
+#: fails on synthetic ladder growth; production never sets it)
+_TEST_EXTRA_MULS = 0
+
+_cache: Dict[str, Dict] = {}
+_cache_lock = threading.Lock()
+
+
+# -- jaxpr walking -----------------------------------------------------------
+
+def _walk(jaxpr, mult: int, stats: Dict[str, int]) -> Dict[str, int]:
+    """Accumulate integer element-op counts over a jaxpr, recursing into
+    nested jaxprs and multiplying through static loop trip counts."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub = None
+        m = mult
+        if name == "scan":
+            sub = eqn.params["jaxpr"]
+            m = mult * int(eqn.params["length"])
+        elif name == "while":
+            # dynamic trip count: body counted ONCE and flagged — a
+            # gated kernel growing a while loop must fail review, not
+            # silently under-count
+            sub = eqn.params["body_jaxpr"]
+            stats["dynamic_loops"] += 1
+        elif name == "cond":
+            for branch in eqn.params["branches"]:
+                _walk(branch.jaxpr, mult, stats)
+            continue
+        elif "jaxpr" in eqn.params:  # pjit / closed_call / pallas grid
+            sub = eqn.params["jaxpr"]
+        elif "call_jaxpr" in eqn.params:  # custom_jvp/vjp, core.call
+            sub = eqn.params["call_jaxpr"]
+        if sub is not None:
+            _walk(sub.jaxpr if hasattr(sub, "jaxpr") else sub, m, stats)
+            continue
+        out = eqn.outvars[0].aval
+        dtype = getattr(out, "dtype", None)
+        if dtype is None or not jnp.issubdtype(dtype, jnp.integer):
+            continue
+        elems = m * int(np.prod(out.shape)) if out.shape else m
+        stats["int_elems"] += elems
+        if name == "mul":
+            stats["mul_eqns"] += m
+            stats["mul_elems"] += elems
+    return stats
+
+
+def _count_fn(fn: Callable, args: Tuple, kwargs: Dict) -> Dict[str, int]:
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _walk(jaxpr.jaxpr, 1, {
+        "mul_eqns": 0, "mul_elems": 0, "int_elems": 0, "dynamic_loops": 0,
+    })
+
+
+def _inflate(mask, arr, field_mul: Callable):
+    """Fold `_TEST_EXTRA_MULS` dummy field multiplies into the traced
+    graph, keeping them live in the output so tracing cannot drop them."""
+    if not _TEST_EXTRA_MULS:
+        return mask
+    x = arr
+    for _ in range(_TEST_EXTRA_MULS):
+        x = field_mul(x, x)
+    return mask & (x[..., 0] >= 0)
+
+
+# -- kernel registry ---------------------------------------------------------
+# Each spec returns (traced_fn, args, kwargs, batch, calibrate) where
+# `calibrate` is (field_mul_fn, cal_args) traced separately to get the
+# family's per-field-mul element cost.
+
+def _spec_ed25519_xla():
+    import jax
+    import jax.numpy as jnp
+
+    from . import ed25519_batch
+    from . import field25519 as F
+
+    B = 16
+    s = jax.ShapeDtypeStruct
+    kwargs = dict(
+        y_a=s((B, 16), jnp.uint32), sign_a=s((B,), jnp.uint32),
+        y_r=s((B, 16), jnp.uint32), sign_r=s((B,), jnp.uint32),
+        s_words=s((B, 8), jnp.uint32), h_words=s((B, 8), jnp.uint32),
+        s_ok=s((B,), jnp.bool_),
+    )
+
+    def fn(**kw):
+        mask = ed25519_batch.verify_kernel(**kw)
+        return _inflate(mask, kw["y_a"], F.mul)
+
+    cal = (F.mul, (s((1, 16), jnp.uint32), s((1, 16), jnp.uint32)), 1)
+    return fn, (), kwargs, B, cal
+
+
+def _spec_ed25519_pallas():
+    import jax
+    import jax.numpy as jnp
+
+    from . import ed25519_pallas as _pl
+    from . import field25519 as F
+
+    B = _pl.BLK
+    s = jax.ShapeDtypeStruct
+    args = (
+        s((16, B), jnp.uint32), s((1, B), jnp.uint32),
+        s((16, B), jnp.uint32), s((1, B), jnp.uint32),
+        s((8, B), jnp.uint32), s((8, B), jnp.uint32),
+        s((1, B), jnp.uint32),
+    )
+
+    def fn(y_a, sign_a, y_r, sign_r, s_words, h_words, s_ok):
+        mask = _pl.verify_kernel_pallas(
+            y_a, sign_a, y_r, sign_r, s_words, h_words, s_ok
+        )
+        # rows-first layout: inflate over the batch width like the
+        # kernel does; F.mul's limb axis lands on the batch dim, which
+        # is irrelevant for COUNTING the synthetic growth
+        return _inflate(mask, y_a.T, F.mul)
+
+    # rows-first field core: the batch is the WIDTH (last axis), so the
+    # calibration normalizes per lane (cal batch = 8)
+    if _pl._RADIX13_ENABLED:
+        def cal_mul(a, b):
+            with _pl._radix13_trace(True):
+                return _pl._mul13(a, b)
+
+        cal = (cal_mul, (s((_pl.ROWS13, 8), jnp.uint32),
+                         s((_pl.ROWS13, 8), jnp.uint32)), 8)
+    else:
+        cal = (_pl._mul, (s((16, 8), jnp.uint32), s((16, 8), jnp.uint32)), 8)
+    return fn, args, {}, B, cal
+
+
+def _spec_ecdsa_secp256r1_xla():
+    import jax
+    import jax.numpy as jnp
+
+    from . import ecdsa_batch
+    from .field_secp import FIELD_R1
+
+    B = 8
+    s = jax.ShapeDtypeStruct
+    kwargs = dict(
+        qx=s((B, 16), jnp.uint32), qy=s((B, 16), jnp.uint32),
+        u1_words=s((B, 8), jnp.uint32), u2_words=s((B, 8), jnp.uint32),
+        r_cmp=s((B, 16), jnp.uint32), ok=s((B,), jnp.bool_),
+    )
+
+    def fn(**kw):
+        mask = ecdsa_batch._verify_kernel("secp256r1", **kw)
+        return _inflate(mask, kw["qx"], FIELD_R1.mul)
+
+    cal = (FIELD_R1.mul, (s((1, 16), jnp.uint32), s((1, 16), jnp.uint32)), 1)
+    return fn, (), kwargs, B, cal
+
+
+_SPECS: Dict[str, Callable] = {
+    "ed25519_xla": _spec_ed25519_xla,
+    "ed25519_pallas": _spec_ed25519_pallas,
+    "ecdsa_secp256r1_xla": _spec_ecdsa_secp256r1_xla,
+}
+KERNEL_NAMES: Tuple[str, ...] = tuple(_SPECS)
+assert KERNEL_NAMES == OPBUDGET_KERNELS, (
+    "utils/profiling.OPBUDGET_KERNELS (the jax-free gauge name source) "
+    "must list exactly the registered kernels"
+)
+
+
+# -- counting ----------------------------------------------------------------
+
+def count_kernel(name: str, use_cache: bool = True) -> Dict:
+    """Trace + count one kernel. Cached per process (the counts are
+    static for a given kernel config); `use_cache=False` re-traces —
+    the test-inflation path needs a fresh trace."""
+    if name not in _SPECS:
+        raise KeyError(f"unknown kernel {name!r}; have {KERNEL_NAMES}")
+    with _cache_lock:
+        if use_cache and name in _cache:
+            return dict(_cache[name])
+    import jax
+
+    fn, args, kwargs, batch, (cal_fn, cal_args, cal_batch) = _SPECS[name]()
+    stats = _count_fn(fn, args, kwargs)
+    cal_stats = _count_fn(cal_fn, cal_args, {})
+    cal_elems = max(cal_stats["mul_elems"] / cal_batch, 1)
+    counts = {
+        "kernel": name,
+        "batch": batch,
+        "mul_eqns": stats["mul_eqns"],
+        "u32_mul_elems_per_sig": round(stats["mul_elems"] / batch, 1),
+        "int_elems_per_sig": round(stats["int_elems"] / batch, 1),
+        "field_mul_equiv_per_sig": round(
+            stats["mul_elems"] / batch / cal_elems, 1
+        ),
+        "field_mul_elems": round(cal_elems, 1),
+        "dynamic_loops": stats["dynamic_loops"],
+        "jax_version": jax.__version__,
+    }
+    with _cache_lock:
+        if use_cache:
+            _cache[name] = dict(counts)
+    return counts
+
+
+def cached_counts(name: str) -> Optional[Dict]:
+    with _cache_lock:
+        counts = _cache.get(name)
+    return dict(counts) if counts else None
+
+
+def gauge_value(name: str, metric: str) -> float:
+    """Cache-only read for the Kernel.OpBudget.* gauges: −1 until this
+    process has traced the kernel (gate run or /opbudget?compute=1) —
+    a /metrics scrape must never pay the trace."""
+    counts = cached_counts(name)
+    if counts is None:
+        return -1.0
+    return float(counts.get(metric, -1.0))
+
+
+def _clear_cache(name: Optional[str] = None) -> None:
+    with _cache_lock:
+        if name is None:
+            _cache.clear()
+        else:
+            _cache.pop(name, None)
+
+
+# -- manifest + gate ---------------------------------------------------------
+
+def load_manifest(path: Optional[str] = None) -> Dict:
+    with open(path or MANIFEST_PATH) as fh:
+        return json.load(fh)
+
+
+def pin_manifest(path: Optional[str] = None,
+                 names: Optional[List[str]] = None) -> Dict:
+    """Re-measure and pin the named kernels (default: all). Run after a
+    DELIBERATE kernel cost change; the diff is the review artifact.
+    A partial pin (`--kernel X`) MERGES into the existing manifest —
+    re-pinning one kernel must never delete the others' pins."""
+    import jax
+
+    existing: Dict = {}
+    try:
+        existing = load_manifest(path)
+    except (OSError, ValueError):
+        pass  # no manifest yet (first pin) — start fresh
+    manifest = {
+        "comment": (
+            "Pinned kernel op budgets (docs/perf-roofline.md). Regenerate "
+            "with `python -m corda_tpu.ops.opbudget --pin` after a "
+            "deliberate kernel change; the tier-1 gate fails when a "
+            "kernel's multiply count grows >tolerance over its pin."
+        ),
+        "tolerance": DEFAULT_TOLERANCE,
+        "jax_version": jax.__version__,
+        "roofline_reference": {
+            "ed25519_pallas_field_muls_per_sig": 3300,
+            "doc": "docs/perf-roofline.md",
+        },
+        "kernels": dict(existing.get("kernels", {})),
+    }
+    for name in names or KERNEL_NAMES:
+        counts = count_kernel(name)
+        manifest["kernels"][name] = {
+            k: counts[k] for k in PINNED_METRICS
+        }
+    with open(path or MANIFEST_PATH, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return manifest
+
+
+def check_budget(name: str, manifest: Optional[Dict] = None,
+                 tolerance: Optional[float] = None) -> List[Dict]:
+    """Violations of one kernel's pinned budget (empty list = pass).
+
+    Growth beyond `tolerance` in a gated metric fails; shrink beyond
+    tolerance is reported as kind="improved" (non-fatal — re-pin to
+    keep the manifest honest). A kernel missing from the manifest is a
+    violation: a gate that skips what it was asked to pin is not a gate.
+    """
+    if manifest is None:
+        manifest = load_manifest()
+    if tolerance is None:
+        tolerance = float(manifest.get("tolerance", DEFAULT_TOLERANCE))
+    pinned = manifest.get("kernels", {}).get(name)
+    if pinned is None:
+        return [{"kernel": name, "metric": None, "kind": "unpinned",
+                 "pinned": None, "measured": None, "change": None}]
+    counts = count_kernel(name)
+    out: List[Dict] = []
+    for metric in GATED_METRICS:
+        ref = pinned.get(metric)
+        cur = counts.get(metric)
+        if ref is None or cur is None or ref <= 0:
+            continue
+        change = (cur - ref) / ref
+        if change > tolerance:
+            out.append({
+                "kernel": name, "metric": metric, "kind": "grew",
+                "pinned": ref, "measured": cur,
+                "change": round(change, 4),
+            })
+        elif change < -tolerance:
+            out.append({
+                "kernel": name, "metric": metric, "kind": "improved",
+                "pinned": ref, "measured": cur,
+                "change": round(change, 4),
+            })
+    return out
+
+
+def check_all(manifest: Optional[Dict] = None,
+              tolerance: Optional[float] = None,
+              names: Optional[List[str]] = None) -> List[Dict]:
+    """Gate every registered kernel; only kind="grew"/"unpinned" entries
+    should fail a caller (kind="improved" is advisory)."""
+    out: List[Dict] = []
+    for name in names or KERNEL_NAMES:
+        out.extend(check_budget(name, manifest=manifest, tolerance=tolerance))
+    return out
+
+
+def fatal_violations(violations: List[Dict]) -> List[Dict]:
+    return [v for v in violations if v["kind"] in ("grew", "unpinned")]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="opbudget")
+    ap.add_argument("--pin", action="store_true",
+                    help="re-measure and rewrite the manifest")
+    ap.add_argument("--kernel", action="append",
+                    help="restrict to specific kernels (repeatable)")
+    ap.add_argument("--tolerance", type=float, default=None)
+    args = ap.parse_args(argv)
+    if args.pin:
+        manifest = pin_manifest(names=args.kernel)
+        print(json.dumps(manifest["kernels"], indent=1, sort_keys=True))
+        return 0
+    violations = check_all(tolerance=args.tolerance, names=args.kernel)
+    for name in args.kernel or KERNEL_NAMES:
+        print(json.dumps(count_kernel(name), sort_keys=True))
+    for v in violations:
+        print(json.dumps({"violation": v}, sort_keys=True))
+    return 1 if fatal_violations(violations) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,6 +48,11 @@ class _Proxy:
                     wait = args[1]
                 if isinstance(wait, (int, float)):
                     timeout = float(wait) + 5.0
+            elif name == "node_profile":
+                # the server blocks for the whole capture window
+                wait = kwargs.get("seconds", args[0] if args else 1.0)
+                if isinstance(wait, (int, float)):
+                    timeout = float(wait) + 10.0
             return self._connection._call(
                 name, args, kwargs=kwargs, timeout=timeout
             )

@@ -488,6 +488,20 @@ class CordaRPCOps:
             **log.stats(),
         }
 
+    def node_profile(self, seconds: float = 1.0,
+                     interval_ms: float = 10.0) -> Dict:
+        """One sampling-profiler capture (the RPC twin of GET /profile):
+        collapsed stacks plus the per-thread CPU-share /
+        runnable-vs-waiting table (utils/sampler.py). Blocks for
+        `seconds` (clamped to the sampler's bound) — the CLIENT extends
+        its reply timeout to cover the wait. Raises CaptureBusyError
+        when a capture is already running."""
+        from ..utils import sampler
+
+        seconds = max(0.05, min(float(seconds), sampler.MAX_SECONDS))
+        interval = max(0.001, min(float(interval_ms) / 1000.0, 1.0))
+        return sampler.capture(seconds=seconds, interval=interval)
+
     def node_health(self) -> Dict:
         """The /healthz view over RPC: lifecycle state + per-component
         checks ({"status": "ok" | "unavailable" | "unhealthy", ...})."""

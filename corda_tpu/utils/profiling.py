@@ -73,6 +73,21 @@ def try_claim_thread_profile(name: str) -> None:
 # every shape compile here, and the ops endpoint's /metrics exports the
 # aggregate — the "is the accelerator the bottleneck" health signal.
 
+#: the ed25519 padded-batch buckets (single source of truth — the kernel
+#: imports it; it lives HERE so the node can register per-bucket
+#: Jax.CompileCount{bucket=…} gauges without importing jax)
+ED25519_SHAPE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+#: gauge label values: one per bucket plus "other" for off-bucket pads
+#: (the Pallas path's BLK floor, overflow multiples)
+ED25519_BUCKET_LABELS = tuple(
+    str(b) for b in ED25519_SHAPE_BUCKETS
+) + ("other",)
+
+#: the op-budget kernel registry names (mirrored by ops/opbudget.py,
+#: which asserts the two stay in sync; HERE so gauge registration stays
+#: jax-free)
+OPBUDGET_KERNELS = ("ed25519_xla", "ed25519_pallas", "ecdsa_secp256r1_xla")
+
 _dispatch_lock = threading.Lock()
 _dispatch_stats: Dict[str, Dict[str, float]] = {}
 _compile_counts: Dict[str, int] = {}
@@ -91,12 +106,23 @@ def record_dispatch(name: str, seconds: float) -> None:
         s["max_s"] = max(s["max_s"], seconds)
 
 
-def record_compile(name: str) -> None:
+def record_compile(name: str, bucket: Optional[str] = None) -> None:
     """A kernel shape for `name` was (re)compiled — each distinct padded
     batch shape costs one XLA compile; a climbing count under steady load
-    means the shape bucketing is broken."""
+    means the shape bucketing is broken. `bucket` (a shape-bucket label)
+    keys the count per padded shape so the always-on
+    Jax.CompileCount{bucket=…} gauges can say WHICH bucket is churning,
+    not just that something recompiled."""
+    key = name if bucket is None else f"{name}[{bucket}]"
     with _dispatch_lock:
-        _compile_counts[name] = _compile_counts.get(name, 0) + 1
+        _compile_counts[key] = _compile_counts.get(key, 0) + 1
+
+
+def compile_count(name: str, bucket: Optional[str] = None) -> int:
+    """One (name, bucket) compile count — the per-bucket gauge read."""
+    key = name if bucket is None else f"{name}[{bucket}]"
+    with _dispatch_lock:
+        return _compile_counts.get(key, 0)
 
 
 def dispatch_snapshot() -> Dict[str, Dict]:

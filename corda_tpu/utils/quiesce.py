@@ -1,0 +1,239 @@
+"""Quiesced, attested measurement windows.
+
+The round-5 driver bench regressed `system_notarised_pairs_s` 75.3 →
+50.3 with nothing in the record saying why — and the prime suspect was
+never the code: the opportunistic TPU capture daemon
+(tools/hw_capture.py) probes the accelerator tunnel every ~50 s, each
+probe a fresh `import jax` subprocess that burns seconds of CPU on the
+same 1-core box the measurement window runs on. A number taken in an
+environment you can't describe is not a number you can compare. This
+module gives every measurement window two properties:
+
+  * **quiesced**: `with quiesce():` pauses the interference this repo
+    itself generates — a cross-PROCESS handshake (the `QUIESCE` file
+    under `tpu_capture/`, carrying an expiry so a crashed bench can
+    never wedge the daemon) that hw_capture honours between steps, plus
+    an in-process registry (`register(name, pause, resume)`) for
+    background pollers. Re-entrant; pause/resume failures are
+    swallowed (a bench must run even when the quiesce plumbing can't).
+  * **attested**: `env_fingerprint()` stamps backend, device kind,
+    interpreter/library versions, core count, and the quiesced/profiler
+    state into the bench record, and the regression gate
+    (corda_tpu/loadtest/gate.py) refuses to hard-compare records whose
+    fingerprints differ — a CPU-fallback round "regressing" against a
+    TPU round is a provenance change, not a performance change.
+
+The fingerprint never imports jax (reading it must not initialize a
+backend); it reports what the process has already decided.
+"""
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: default lifetime of the cross-process QUIESCE marker; hw_capture
+#: ignores an expired file, so a SIGKILLed bench stalls probing for at
+#: most this long
+DEFAULT_TTL_S = 3600.0
+
+#: fingerprint keys the gate compares (mutable state — quiesced,
+#: profiler — deliberately excluded: it describes the window, not the
+#: environment)
+FINGERPRINT_KEYS = (
+    "backend", "device", "python", "jax", "numpy", "platform", "cpus",
+)
+
+_lock = threading.RLock()
+_depth = 0
+_registry: List[Tuple[str, Callable[[], None], Callable[[], None]]] = []
+
+
+def quiesce_file_path() -> str:
+    """The cross-process marker: env override, else
+    `<repo>/tpu_capture/QUIESCE` (the directory hw_capture already
+    owns)."""
+    env = os.environ.get("CORDA_TPU_QUIESCE_FILE")
+    if env:
+        return env
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(repo, "tpu_capture", "QUIESCE")
+
+
+def register(name: str, pause: Callable[[], None],
+             resume: Callable[[], None]) -> None:
+    """Register an in-process background poller to pause during
+    measurement windows. Re-registering a name replaces it."""
+    with _lock:
+        _registry[:] = [r for r in _registry if r[0] != name]
+        _registry.append((name, pause, resume))
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _registry[:] = [r for r in _registry if r[0] != name]
+
+
+def is_quiesced() -> bool:
+    return _depth > 0
+
+
+def file_quiesced(path: Optional[str] = None,
+                  now: Optional[float] = None) -> bool:
+    """Another process (or this one) holds an unexpired QUIESCE marker —
+    the check hw_capture runs between probe loops."""
+    try:
+        with open(path or quiesce_file_path()) as fh:
+            rec = json.load(fh)
+        return (now if now is not None else time.time()) < float(
+            rec.get("expires", 0)
+        )
+    except (OSError, ValueError, TypeError):
+        return False
+
+
+class _Quiesce:
+    def __init__(self, expected_s: Optional[float], path: Optional[str]):
+        self._ttl = float(expected_s) if expected_s else DEFAULT_TTL_S
+        self._path = path or quiesce_file_path()
+        self._token: Optional[str] = None
+
+    def __enter__(self) -> "_Quiesce":
+        global _depth
+        with _lock:
+            _depth += 1
+            if _depth > 1:
+                return self
+            for _name, pause, _resume in _registry:
+                try:
+                    pause()
+                except Exception:
+                    pass
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            tmp = f"{self._path}.{os.getpid()}.tmp"
+            token = f"{os.getpid()}-{time.time_ns()}"
+            with open(tmp, "w") as fh:
+                json.dump({
+                    "pid": os.getpid(),
+                    "token": token,
+                    "ts": time.time(),
+                    "expires": time.time() + self._ttl,
+                }, fh)
+            os.replace(tmp, self._path)
+            self._token = token
+        except OSError:
+            pass  # read-only checkout: in-process quiesce still holds
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _depth
+        with _lock:
+            _depth -= 1
+            if _depth > 0:
+                return False
+            for _name, _pause, resume in _registry:
+                try:
+                    resume()
+                except Exception:
+                    pass
+        if self._token is not None:
+            # remove only OUR marker: a second quiescing process may
+            # have replaced it mid-window (two benches overlapping),
+            # and deleting theirs would resume the daemon inside their
+            # still-open measurement; an orphaned marker dies by expiry.
+            # The last-writer-exits-first ordering still un-quiesces the
+            # earlier holder (full multi-holder coordination would need
+            # a refcount protocol) — accepted: two concurrent benches on
+            # one box already invalidate each other's numbers far beyond
+            # anything the daemon's probes could add, and the expiry
+            # bounds every leak direction.
+            try:
+                with open(self._path) as fh:
+                    current = json.load(fh)
+                if current.get("token") == self._token:
+                    os.remove(self._path)
+            except (OSError, ValueError):
+                pass
+        return False
+
+
+def quiesce(expected_s: Optional[float] = None,
+            path: Optional[str] = None) -> _Quiesce:
+    """Context manager: pause registered pollers + post the
+    cross-process QUIESCE marker for the duration (expiry
+    `expected_s`, default DEFAULT_TTL_S, bounds a crashed holder)."""
+    return _Quiesce(expected_s, path)
+
+
+# -- environment fingerprint --------------------------------------------------
+
+def env_fingerprint() -> Dict:
+    """What kind of box/backend produced this measurement, without
+    initializing anything: backend/device are read only when jax is
+    imported AND its backend is already initialized (the xla_bridge
+    probe core/crypto/batch.py uses) — `jax.default_backend()` on an
+    uninitialized process would pay multi-second client setup, or hang
+    through a dead accelerator tunnel, for a read that is supposed to
+    REPORT state, not create it."""
+    backend = "uninitialized"
+    device = None
+    jax_version = None
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        jax_version = getattr(jax, "__version__", None)
+        try:
+            from jax._src import xla_bridge as _xb
+
+            initialized = bool(getattr(_xb, "_backends", None))
+        except Exception:  # private surface moved: stay uninitialized
+            initialized = False
+        if initialized:
+            try:
+                backend = jax.default_backend()
+                device = jax.devices()[0].device_kind
+            except Exception:
+                backend = "uninitialized"
+    np_mod = sys.modules.get("numpy")
+    fp = {
+        "backend": backend,
+        "device": device,
+        "python": platform.python_version(),
+        "jax": jax_version,
+        "numpy": getattr(np_mod, "__version__", None),
+        "platform": f"{platform.system()}-{platform.machine()}",
+        "cpus": os.cpu_count(),
+        "quiesced": is_quiesced(),
+        "profiler_active": _profiler_active(),
+    }
+    return fp
+
+
+def _profiler_active() -> bool:
+    try:
+        from . import sampler
+
+        return sampler.active_captures() > 0
+    except Exception:  # pragma: no cover
+        return False
+
+
+def fingerprint_mismatch(prev: Optional[Dict],
+                         cur: Optional[Dict]) -> List[Dict]:
+    """Keys (FINGERPRINT_KEYS) on which two fingerprints disagree.
+    Either side missing/not-a-dict compares as unknown: [] — an old
+    artifact without a fingerprint keeps its full gate teeth."""
+    if not isinstance(prev, dict) or not isinstance(cur, dict):
+        return []
+    out = []
+    for key in FINGERPRINT_KEYS:
+        if key in prev and key in cur and prev.get(key) != cur.get(key):
+            out.append({"key": key, "prev": prev.get(key),
+                        "cur": cur.get(key)})
+    return out

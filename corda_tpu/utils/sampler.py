@@ -1,0 +1,314 @@
+"""Sampling wall-clock profiler with per-thread CPU/GIL attribution.
+
+The cProfile hook in utils/profiling.py owns the single sys.monitoring
+slot, costs ~2x on pure-Python code, and profiles ONE pre-chosen thread
+— useless for the question the round-5 regression actually poses: *which
+of the node's ~25 threads is getting the core, and what is everyone else
+waiting on?* This module answers it with a sampler that needs no
+sys.setprofile hook at all:
+
+  * every `interval` seconds it snapshots `sys._current_frames()` —
+    one stack per live thread, captured under the GIL so the view is
+    coherent — and aggregates them into collapsed stacks
+    (`thread;file:func;file:func… count`, flamegraph.pl-compatible);
+  * per-thread CPU time comes from `/proc/self/task/<tid>/stat`
+    (utime+stime delta over the capture window) keyed by
+    `Thread.native_id`, plus the kernel's run state per sample (R =
+    on-core/runnable vs S/D = waiting) — the runnable-vs-waiting table
+    that makes a GIL convoy legible: many threads runnable, one core's
+    worth of CPU-seconds to share. The sampler measures its OWN cost
+    with `time.thread_time_ns()` and reports it as `profiler_cpu_s`.
+  * zero cost when idle: no thread exists outside `capture()`, so the
+    <5% idle-overhead bound of docs/observability.md holds trivially.
+
+One capture at a time per process (`CaptureBusyError` otherwise — the
+sampler observing another sampler is noise, and the ops endpoint must
+not stack captures under request retries). Each capture marks the
+`Profiler.*` module counters (exported as gauges on /metrics) and emits
+a flight-recorder event.
+
+Served at `GET /profile?seconds=N` on the ops endpoint and
+`node_profile()` over RPC; `tools/profile_report.py` renders a saved
+capture as a per-thread report.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, Optional
+
+#: stacks deeper than this truncate at the root end (the leaf frames
+#: are the signal; an 80-frame flow re-entry prefix is not)
+MAX_STACK_DEPTH = 48
+#: hard bounds on a capture (the ops endpoint clamps into these)
+MAX_SECONDS = 60.0
+MIN_INTERVAL = 0.001
+#: collapsed-stack table cap: pathological frame churn must not grow an
+#: unbounded dict inside a node process
+MAX_COLLAPSED = 10_000
+
+try:
+    _CLK_TCK = os.sysconf("SC_CLK_TCK")
+except (AttributeError, ValueError, OSError):  # pragma: no cover
+    _CLK_TCK = 100
+
+
+class CaptureBusyError(RuntimeError):
+    """Another capture is already running in this process."""
+
+
+_capture_lock = threading.Lock()
+_captures_total = 0
+_samples_total = 0
+_active = 0
+
+
+def captures_total() -> int:
+    return _captures_total
+
+
+def samples_total() -> int:
+    return _samples_total
+
+
+def active_captures() -> int:
+    return _active
+
+
+def _thread_stat(native_id: int):
+    """(cpu_seconds, run_state) of one native thread from /proc, or
+    (None, None) off-Linux / after the thread died."""
+    try:
+        with open(f"/proc/self/task/{native_id}/stat", "rb") as fh:
+            data = fh.read().decode("ascii", "replace")
+    except (OSError, ValueError):
+        return None, None
+    # comm may contain spaces/parens: fields resume after the last ')'
+    try:
+        rest = data[data.rindex(")") + 2:].split()
+        state = rest[0]
+        cpu = (int(rest[11]) + int(rest[12])) / _CLK_TCK  # utime + stime
+    except (ValueError, IndexError):
+        return None, None
+    return cpu, state
+
+
+def _stack_string(frame) -> str:
+    parts = []
+    depth = 0
+    while frame is not None and depth < MAX_STACK_DEPTH:
+        code = frame.f_code
+        parts.append(
+            f"{os.path.basename(code.co_filename)}:{code.co_name}"
+        )
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()  # root first, leaf last (collapsed-stack convention)
+    return ";".join(parts)
+
+
+def capture(seconds: float = 1.0, interval: float = 0.01) -> Dict:
+    """Sample every live thread for `seconds`; returns
+    {"meta", "collapsed", "threads"}.
+
+    The CALLING thread is the sampler (no extra thread to exclude from
+    scheduling): it appears in the per-thread table flagged
+    `sampler=true` with its measured self-cost, and is excluded from the
+    collapsed stacks — its frames would only ever show this loop.
+    """
+    global _captures_total, _samples_total, _active
+    seconds = max(0.01, min(float(seconds), MAX_SECONDS))
+    interval = max(MIN_INTERVAL, min(float(interval), 1.0))
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusyError("a profile capture is already running")
+    self_ident = threading.get_ident()
+    collapsed: Counter = Counter()
+    per_thread: Dict[int, Dict] = {}
+    ticks = 0
+    prev_switch = sys.getswitchinterval()
+    try:
+        _active += 1
+        # under a GIL convoy the sampler's wakeups queue behind the
+        # busy thread's 5 ms switch interval and the effective sample
+        # rate collapses; a tighter interval during the capture window
+        # restores fidelity at a small, bounded perturbation (recorded
+        # in meta as switch_interval_s)
+        sys.setswitchinterval(min(prev_switch, 0.002))
+        t_wall0 = time.monotonic()
+        t_self0 = time.thread_time_ns()
+        deadline = t_wall0 + seconds
+
+        def thread_row(ident: int) -> Dict:
+            row = per_thread.get(ident)
+            if row is None:
+                row = per_thread[ident] = {
+                    "ident": ident, "name": f"tid-{ident}",
+                    "native_id": None, "samples": 0, "running": 0,
+                    "waiting": 0, "cpu0": None, "cpu1": None,
+                    "states": Counter(), "top": Counter(),
+                    "sampler": ident == self_ident,
+                }
+            return row
+
+        while True:
+            # refresh the ident -> Thread map each tick: threads appear
+            # and die mid-capture (verifier pools, flush threads)
+            live = {t.ident: t for t in threading.enumerate()}
+            frames = sys._current_frames()
+            for ident, frame in frames.items():
+                thread = live.get(ident)
+                live_nid = (
+                    getattr(thread, "native_id", None)
+                    if thread is not None else None
+                )
+                row = per_thread.get(ident)
+                if (
+                    row is not None and live_nid is not None
+                    and row["native_id"] is not None
+                    and row["native_id"] != live_nid
+                ):
+                    # CPython reused a dead thread's ident for a new
+                    # thread mid-capture: retire the old row (its /proc
+                    # tid is gone) instead of merging two threads' stats
+                    per_thread[f"{ident}#retired-{row['native_id']}"] = (
+                        per_thread.pop(ident)
+                    )
+                row = thread_row(ident)
+                if thread is not None:
+                    row["name"] = thread.name
+                    if row["native_id"] is None:
+                        row["native_id"] = live_nid
+                row["samples"] += 1
+                if row["native_id"] is not None:
+                    cpu, state = _thread_stat(row["native_id"])
+                    if cpu is not None:
+                        if row["cpu0"] is None:
+                            row["cpu0"] = cpu
+                        row["cpu1"] = cpu
+                        row["states"][state] += 1
+                        if state == "R":
+                            row["running"] += 1
+                        else:
+                            row["waiting"] += 1
+                if ident == self_ident:
+                    continue
+                stack = _stack_string(frame)
+                leaf = stack.rsplit(";", 1)[-1]
+                row["top"][leaf] += 1
+                if (
+                    len(collapsed) < MAX_COLLAPSED
+                    or (row["name"] + ";" + stack) in collapsed
+                ):
+                    collapsed[row["name"] + ";" + stack] += 1
+            del frames  # frame objects pin their whole stacks
+            ticks += 1
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            time.sleep(min(interval, deadline - now))
+
+        wall = time.monotonic() - t_wall0
+        self_cpu = (time.thread_time_ns() - t_self0) / 1e9
+    finally:
+        sys.setswitchinterval(prev_switch)
+        _active -= 1
+        _capture_lock.release()
+
+    total_cpu = 0.0
+    rows = []
+    for row in per_thread.values():
+        cpu_s = (
+            row["cpu1"] - row["cpu0"]
+            if row["cpu0"] is not None and row["cpu1"] is not None
+            else None
+        )
+        if cpu_s is not None and not row["sampler"]:
+            total_cpu += cpu_s
+        rows.append(row)
+    threads = []
+    for row in sorted(
+        rows, key=lambda r: -(r["cpu1"] - r["cpu0"]
+                              if r["cpu0"] is not None else -1)
+    ):
+        cpu_s = (
+            round(row["cpu1"] - row["cpu0"], 4)
+            if row["cpu0"] is not None else None
+        )
+        threads.append({
+            "name": row["name"],
+            "ident": row["ident"],
+            "native_id": row["native_id"],
+            "samples": row["samples"],
+            "running": row["running"],
+            "waiting": row["waiting"],
+            "states": dict(row["states"]),
+            "cpu_s": cpu_s,
+            # share of the PROCESS's sampled CPU burn (the GIL-convoy
+            # table: who actually got the core)
+            "cpu_share": (
+                round(cpu_s / total_cpu, 4)
+                if cpu_s is not None and total_cpu > 0 and not row["sampler"]
+                else (0.0 if cpu_s is not None else None)
+            ),
+            "cpu_utilization": (
+                round(cpu_s / wall, 4) if cpu_s is not None and wall > 0
+                else None
+            ),
+            "top_frames": row["top"].most_common(5),
+            "sampler": row["sampler"],
+        })
+
+    result = {
+        "meta": {
+            "seconds": seconds,
+            "interval_s": interval,
+            "ticks": ticks,
+            "wall_s": round(wall, 4),
+            "n_threads": len(threads),
+            "total_cpu_s": round(total_cpu, 4),
+            "profiler_cpu_s": round(self_cpu, 4),
+            "switch_interval_s": min(prev_switch, 0.002),
+            "clock_tick_hz": _CLK_TCK,
+            "quiesced": _is_quiesced(),
+            "truncated": len(collapsed) >= MAX_COLLAPSED,
+        },
+        "collapsed": dict(collapsed.most_common()),
+        "threads": threads,
+    }
+
+    # capture totals surface as the Profiler.* gauges node.py registers
+    # (module-level so MockNetwork's per-node registries agree)
+    _captures_total += 1
+    _samples_total += ticks
+    try:
+        from .eventlog import emit
+
+        emit(
+            "info", "profiler", "profile capture complete",
+            seconds=seconds, ticks=ticks, n_threads=len(threads),
+            total_cpu_s=result["meta"]["total_cpu_s"],
+            profiler_cpu_s=result["meta"]["profiler_cpu_s"],
+        )
+    except Exception:
+        pass  # profiling must never fail because logging did
+    return result
+
+
+def _is_quiesced() -> bool:
+    try:
+        from . import quiesce
+
+        return quiesce.is_quiesced()
+    except Exception:  # pragma: no cover
+        return False
+
+
+def collapsed_text(result: Dict) -> str:
+    """flamegraph.pl-compatible lines: `stack count` per line."""
+    return "\n".join(
+        f"{stack} {count}"
+        for stack, count in result.get("collapsed", {}).items()
+    ) + "\n"

@@ -56,6 +56,12 @@ def main(argv=None) -> int:
         help="also assert the built-in system-path bounds "
              "(gate.DEFAULT_SLOS: p99 notarise latency, verify throughput)",
     )
+    ap.add_argument(
+        "--opbudget", action="store_true",
+        help="also run the kernel op-budget gate (corda_tpu/ops/"
+             "opbudget.py): trace the verify kernels and fail when a "
+             "multiply count grew >5%% over the pinned manifest",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -97,6 +103,47 @@ def main(argv=None) -> int:
     result["baseline"] = baseline_path
     result["threshold"] = args.threshold
 
+    if args.opbudget:
+        from corda_tpu.ops import opbudget
+
+        try:
+            violations = opbudget.check_all()
+        except OSError as exc:
+            print(f"bench_gate: cannot run op-budget gate: {exc}",
+                  file=sys.stderr)
+            return 2
+        result["opbudget_violations"] = violations
+        for v in violations:
+            if v["kind"] == "improved":
+                print(
+                    f"OP-BUDGET improved {v['kernel']}.{v['metric']}: "
+                    f"{v['pinned']} -> {v['measured']} "
+                    f"({v['change'] * 100:+.1f}%) — re-pin the manifest",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"OP-BUDGET VIOLATION {v['kernel']}"
+                    f".{v.get('metric')}: pinned={v['pinned']} "
+                    f"measured={v['measured']} ({v['kind']})",
+                    file=sys.stderr,
+                )
+        if opbudget.fatal_violations(violations):
+            result["ok"] = False
+
+    for m in result.get("fingerprint_mismatch", ()):
+        print(
+            f"ENV MISMATCH {m['key']}: baseline={m['prev']!r} "
+            f"current={m['cur']!r}",
+            file=sys.stderr,
+        )
+    for r in result.get("warnings", ()):
+        print(
+            f"CROSS-ENV WARNING (not gated) {r['key']}: {r['prev']} -> "
+            f"{r['cur']} ({r['change'] * 100:+.1f}% worse, "
+            f"{r['direction']}-is-better)",
+            file=sys.stderr,
+        )
     for r in result["regressions"]:
         print(
             f"REGRESSION {r['key']}: {r['prev']} -> {r['cur']} "

@@ -29,6 +29,18 @@ STOP = os.path.join(CAPDIR, "STOP")
 sys.path.insert(0, REPO)
 from bench import PROBE_SNIPPET  # noqa: E402  (shared liveness criteria)
 
+# Quiesce handshake, the WRITER's module as single source of truth
+# (path resolution incl. the CORDA_TPU_QUIESCE_FILE override, marker
+# schema, expiry semantics — a drifted re-implementation here would
+# silently void the handshake): bench.py posts the marker around its
+# measurement window; while it is unexpired the daemon neither probes
+# nor launches steps — a probe subprocess landing inside a bench window
+# halves that reading on the 1-core box (the round-5 host regression).
+# corda_tpu.utils.quiesce is stdlib-only: importing it cannot pull jax
+# into the daemon parent (probes are subprocesses precisely to keep the
+# parent's JAX state clean).
+from corda_tpu.utils.quiesce import file_quiesced as quiesced  # noqa: E402
+
 # ---------------------------------------------------------------------------
 # Tiered liveness probes.  Three variants, cheapest first, each run in its
 # own subprocess so a hang cannot poison the daemon.  Every variant arms
@@ -354,10 +366,20 @@ def main():
     st = load_state()
     log({"step": "daemon-start", "done": st["done"]})
     deadline = time.time() + 11.5 * 3600
+    was_quiesced = False
     while time.time() < deadline:
         if os.path.exists(STOP):
             log({"step": "daemon-stop", "reason": "STOP file"})
             return 0
+        if quiesced():
+            if not was_quiesced:  # one line per transition, not per nap
+                log({"step": "quiesce-pause"})
+                was_quiesced = True
+            time.sleep(5)
+            continue
+        if was_quiesced:
+            log({"step": "quiesce-resume"})
+            was_quiesced = False
         todo = [s for s in steps()
                 if s["name"] not in st["done"]
                 and st["fail_counts"].get(s["name"], 0) < 4]

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Render a saved sampling-profiler capture as a per-thread text report.
+
+Input: the JSON body of `GET /profile?seconds=N` (ops endpoint) or
+`node_profile()` (RPC), saved to a file — or `-` for stdin:
+
+    curl "127.0.0.1:9100/profile?seconds=5" > cap.json
+    python tools/profile_report.py cap.json
+    python tools/profile_report.py cap.json --top 30
+    python tools/profile_report.py cap.json --collapsed out.folded
+        # out.folded feeds flamegraph.pl / speedscope directly
+
+The report has three sections: the capture metadata (window, tick
+count, total CPU burn vs wall — on a 1-core GIL-bound node the ratio
+IS the ceiling), the per-thread table (CPU-share + runnable-vs-waiting
+sample split: many runnable threads sharing one core's worth of CPU
+seconds is the GIL-convoy signature docs/perf-system.md tracks), and
+the top-N hottest sampled stacks.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_share(value) -> str:
+    return f"{value * 100:5.1f}%" if isinstance(value, (int, float)) else "    -"
+
+
+def render(capture: dict, top: int = 20) -> str:
+    meta = capture.get("meta", {})
+    threads = capture.get("threads", [])
+    collapsed = capture.get("collapsed", {})
+    out = []
+    wall = meta.get("wall_s", 0)
+    total_cpu = meta.get("total_cpu_s", 0)
+    out.append(
+        f"capture: {meta.get('ticks', '?')} ticks over {wall}s wall "
+        f"(interval {meta.get('interval_s', '?')}s), "
+        f"{meta.get('n_threads', len(threads))} threads, "
+        f"quiesced={meta.get('quiesced')}"
+    )
+    if wall:
+        out.append(
+            f"process CPU: {total_cpu}s over {wall}s wall "
+            f"({total_cpu / wall:.2f} cores) + sampler self-cost "
+            f"{meta.get('profiler_cpu_s', 0)}s"
+        )
+    out.append("")
+    out.append(
+        f"{'thread':<32} {'samples':>7} {'run':>5} {'wait':>5} "
+        f"{'cpu_s':>8} {'share':>6}  top frame"
+    )
+    for row in threads:
+        top_frames = row.get("top_frames") or []
+        leaf = top_frames[0][0] if top_frames else "-"
+        name = row.get("name", "?")
+        if row.get("sampler"):
+            name += " [sampler]"
+        cpu = row.get("cpu_s")
+        out.append(
+            f"{name:<32.32} {row.get('samples', 0):>7} "
+            f"{row.get('running', 0):>5} {row.get('waiting', 0):>5} "
+            f"{cpu if cpu is not None else '-':>8} "
+            f"{_fmt_share(row.get('cpu_share'))}  {leaf}"
+        )
+    out.append("")
+    total_samples = sum(collapsed.values()) or 1
+    out.append(f"top {min(top, len(collapsed))} sampled stacks:")
+    ranked = sorted(collapsed.items(), key=lambda kv: -kv[1])[:top]
+    for stack, count in ranked:
+        frames = stack.split(";")
+        head = frames[0]
+        tail = ";".join(frames[-3:]) if len(frames) > 3 else stack
+        out.append(
+            f"  {count:>6} ({count / total_samples * 100:4.1f}%) "
+            f"[{head}] …{tail}"
+        )
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="profile_report")
+    ap.add_argument("capture", help="saved /profile JSON, or '-' for stdin")
+    ap.add_argument("--top", type=int, default=20,
+                    help="stacks to show (default 20)")
+    ap.add_argument("--collapsed", metavar="PATH",
+                    help="also write flamegraph.pl-format collapsed "
+                         "stacks ('stack count' lines) to PATH")
+    args = ap.parse_args(argv)
+    try:
+        if args.capture == "-":
+            capture = json.load(sys.stdin)
+        else:
+            with open(args.capture) as fh:
+                capture = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"profile_report: cannot read capture: {exc}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(capture, dict) or "collapsed" not in capture:
+        print("profile_report: not a /profile capture "
+              "(expected keys: meta, collapsed, threads)", file=sys.stderr)
+        return 2
+    sys.stdout.write(render(capture, top=args.top))
+    if args.collapsed:
+        with open(args.collapsed, "w") as fh:
+            for stack, count in capture["collapsed"].items():
+                fh.write(f"{stack} {count}\n")
+        print(f"collapsed stacks -> {args.collapsed}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
