@@ -354,6 +354,15 @@ def _codec_encode_us(n: int = 2000) -> float:
     return (time.perf_counter() - t0) / n * 1e6
 
 
+def _bls_aggregate_stage(n: int = 64) -> dict:
+    """Committee aggregate-vs-naive verification A/B: n per-vote
+    verifies vs aggregation + ONE 2-pairing check, measured by the
+    shared loadtest helper (docs/bls-aggregation.md)."""
+    from corda_tpu.loadtest.latency import measure_bls_aggregate_ab
+
+    return measure_bls_aggregate_ab(n=n)
+
+
 def _secondary_rates(on_tpu: bool, rng) -> dict:
     """ECDSA-P256 and mixed-scheme throughput via the production
     `core.crypto.batch.verify_batch` dispatch (scheme bucketing)."""
@@ -482,6 +491,14 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         overload = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # BLS committee aggregation A/B (docs/bls-aggregation.md): the
+    # n=64 aggregate-vs-naive stage rides the regression gate through
+    # its _ms keys (lower-is-better auto-classification)
+    try:
+        bls = _bls_aggregate_stage(n=64)
+    except Exception as exc:
+        bls = {"bls_stage_error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -509,6 +526,8 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
             "overload_shed_recovery_ms"
         ),
         "overload_goodput_per_sec": overload.get("overload_goodput_per_sec"),
+        "bls_naive_wall_ms": bls.get("bls_naive_wall_ms"),
+        "bls_aggregate_verify_ms": bls.get("bls_aggregate_verify_ms"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
@@ -535,6 +554,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "overload_shed": overload.get("shed"),
         "overload_admitted": overload.get("admitted"),
     }
+    out.update(bls)
 
     # Full-system throughput: issue+pay pairs through REAL node processes
     # (cordform network, TCP brokers, bridges, validating notary) — the

@@ -8,6 +8,11 @@ from .crypto import (
     CryptoError,
     SignatureError,
     UnsupportedSchemeError,
+    aggregate,
+    aggregate_verify,
+    bls_key_registered,
+    bls_prove_possession,
+    bls_register_key,
     derive_keypair,
     derive_keypair_from_entropy,
     do_sign,
@@ -23,6 +28,7 @@ from .crypto import (
 from .keys import KeyPair, PublicKey, SchemePrivateKey, SchemePublicKey
 from .merkle import MerkleTree, MerkleTreeError, PartialMerkleTree
 from .schemes import (
+    BLS_BLS12381,
     COMPOSITE_KEY,
     DEFAULT_SIGNATURE_SCHEME,
     ECDSA_SECP256K1_SHA256,
@@ -47,11 +53,14 @@ from .signing import (
 __all__ = [
     "CompositeKey", "CompositeSignaturesWithKeys", "NodeAndWeight",
     "CryptoError", "SignatureError", "UnsupportedSchemeError",
+    "aggregate", "aggregate_verify", "bls_key_registered",
+    "bls_prove_possession", "bls_register_key",
     "derive_keypair", "derive_keypair_from_entropy", "do_sign", "do_verify",
     "entropy_to_keypair", "find_signature_scheme", "generate_keypair",
     "is_operational", "is_supported", "is_valid", "public_key_on_curve",
     "KeyPair", "PublicKey", "SchemePrivateKey", "SchemePublicKey",
     "MerkleTree", "MerkleTreeError", "PartialMerkleTree",
+    "BLS_BLS12381",
     "COMPOSITE_KEY", "DEFAULT_SIGNATURE_SCHEME", "ECDSA_SECP256K1_SHA256",
     "ECDSA_SECP256R1_SHA256", "EDDSA_ED25519_SHA512", "RSA_SHA256",
     "SPHINCS256_SHA256", "SUPPORTED_SIGNATURE_SCHEMES", "SignatureScheme",

@@ -204,8 +204,47 @@ def _ed25519_rule() -> str:
 
 
 def _host_verify_rows(items, idx, results) -> None:
-    """Verify `idx` rows of `items` on the host path, in parallel when the
-    bucket and the machine are big enough to amortise thread handoff."""
+    """Verify `idx` rows on the host path, GROUPED by scheme_number_id.
+
+    A scheme the host path cannot serve — an id registered by a newer
+    peer but not this build, a half-landed scheme whose verify raises —
+    must cost ITS group a False verdict, never poison the whole
+    submitted batch with an exception (the failure mode before this
+    grouping: one unregistered-scheme row in a 4k-row flush threw out of
+    verify_batch and failed every co-batched signature). Groups whose
+    scheme resolves still ride the pooled path below."""
+    groups: dict = {}
+    for i in idx:
+        name = getattr(items[i][0], "scheme_code_name", None)
+        try:
+            key = crypto.find_signature_scheme(name).scheme_number_id
+        except crypto.UnsupportedSchemeError:
+            key = ("unregistered", name)  # its own degraded group
+        groups.setdefault(key, []).append(i)
+    for key, rows in groups.items():
+        if isinstance(key, tuple):  # unregistered id: no host path exists
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "unregistered scheme %r: %d rows verdict False "
+                "(rest of the batch unaffected)", key[1], len(rows)
+            )
+            continue
+        try:
+            _host_verify_group(items, rows, results)
+        except Exception:
+            # group-scoped degradation: these rows stay False
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "host verification failed for scheme group %r "
+                "(%d rows degraded to False)", key, len(rows)
+            )
+
+
+def _host_verify_group(items, idx, results) -> None:
+    """Verify one scheme group's rows, in parallel when the group and
+    the machine are big enough to amortise thread handoff."""
     global _HOST_POOL
     if len(idx) < _HOST_POOL_MIN or (os.cpu_count() or 1) < 2:
         for i in idx:

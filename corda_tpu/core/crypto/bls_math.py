@@ -1,0 +1,958 @@
+"""Pure-Python BLS12-381: field tower, pairing, hash-to-curve, signatures.
+
+The jax-free reference mirror for the batched pairing kernels in
+corda_tpu.ops (field_bls12 / bls12_batch) AND the host sign/verify path
+for the BLS_BLS12381 SignatureScheme — the same dual role ed25519_math
+and secp_math play for their kernels (the container has no
+`cryptography` package, and OpenSSL has no BLS anyway).
+
+Scheme: the CFRG BLS signature draft's minimal-pubkey-size,
+proof-of-possession ciphersuite
+    BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_
+(public keys 48-byte compressed G1, signatures 96-byte compressed G2,
+messages hashed to G2 per RFC 9380 suite BLS12381G2_XMD:SHA-256_SSWU_RO_).
+Aggregation is the committee-consensus lever (PAPERS' EdDSA-vs-BLS
+committee study, arXiv 2302.00418): n same-message votes verify as ONE
+product-of-2-Miller-loops check instead of n, after PoP registration
+rules out rogue-key attacks.
+
+Implementation notes:
+  * Field elements are plain ints (Fp) and nested tuples (Fp2 = (c0, c1)
+    meaning c0 + c1*u with u^2 = -1; Fp6 = 3 x Fp2 over v^3 = xi = 1+u;
+    Fp12 = 2 x Fp6 over w^2 = v). Module-level functions, no classes —
+    the per-op overhead dominates pure-Python pairing cost.
+  * Every curve/field constant that CAN be derived from the BLS
+    parameter x is derived at import (p, r, cofactors, Frobenius
+    coefficients) rather than transcribed, and the module asserts the
+    derivations against the published values — a transcription error
+    dies at import, not in a signature.
+  * Final exponentiation hard part uses the Hayashida-Hayasaka-Teruya
+    identity  3*(p^4-p^2+1)/r = (x-1)^2*(x+p)*(x^2+p^2-1) + 3
+    (asserted at import): the computed pairing is e(P,Q)^3 for the
+    textbook reduced ate pairing e. A fixed cube is still bilinear and
+    non-degenerate (gcd(3, r) = 1), and GT values are never serialized,
+    so every product-equality check below is exact.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+from functools import lru_cache as _lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+# --- parameters --------------------------------------------------------------
+
+X = -0xD201000000010000  # the BLS12-381 curve parameter (negative, low weight)
+
+P = (X - 1) ** 2 * (X**4 - X**2 + 1) // 3 + X  # base field prime
+R = X**4 - X**2 + 1  # subgroup order (the scalar field)
+H1 = (X - 1) ** 2 // 3  # G1 cofactor
+H2 = (X**8 - 4 * X**7 + 5 * X**6 - 4 * X**4 + 6 * X**3 - 4 * X**2 - 4 * X + 13) // 9
+
+assert P == 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+assert R == 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+assert H1 == 0x396C8C005555E1568C00AAAB0000AAAB
+
+# RFC 9380 8.8.2 effective G2 cofactor (Budroni-Pintore). Asserted to be
+# an exact multiple of the formula-derived h2, so h_eff*P provably lands
+# in the r-torsion for every P in E2(Fp2).
+H_EFF_G2 = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+assert H_EFF_G2 % H2 == 0 and H_EFF_G2 % R != 0
+
+# hard-part identity the final exponentiation is built on
+assert 3 * ((P**4 - P**2 + 1) // R) == (X - 1) ** 2 * (X + P) * (X**2 + P**2 - 1) + 3
+
+# generators (standard, on E1: y^2 = x^3 + 4 and E2: y^2 = x^3 + 4(1+u))
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+DST_SIG = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+Fp2 = Tuple[int, int]
+Fp6 = Tuple[Fp2, Fp2, Fp2]
+Fp12 = Tuple[Fp6, Fp6]
+
+# --- Fp2 ---------------------------------------------------------------------
+
+FP2_ZERO: Fp2 = (0, 0)
+FP2_ONE: Fp2 = (1, 0)
+XI: Fp2 = (1, 1)  # the Fp6 non-residue v^3 = 1 + u
+
+
+def fp2_add(a: Fp2, b: Fp2) -> Fp2:
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fp2_sub(a: Fp2, b: Fp2) -> Fp2:
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fp2_neg(a: Fp2) -> Fp2:
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def fp2_mul(a: Fp2, b: Fp2) -> Fp2:
+    a0, a1 = a
+    b0, b1 = b
+    t0 = a0 * b0
+    t1 = a1 * b1
+    # Karatsuba: (a0+a1)(b0+b1) - t0 - t1 = a0b1 + a1b0
+    return ((t0 - t1) % P, ((a0 + a1) * (b0 + b1) - t0 - t1) % P)
+
+
+def fp2_sq(a: Fp2) -> Fp2:
+    a0, a1 = a
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    return ((a0 + a1) * (a0 - a1) % P, 2 * a0 * a1 % P)
+
+
+def fp2_scale(a: Fp2, k: int) -> Fp2:
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fp2_conj(a: Fp2) -> Fp2:
+    return (a[0], (-a[1]) % P)
+
+
+def fp2_mul_xi(a: Fp2) -> Fp2:
+    # (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u
+    return ((a[0] - a[1]) % P, (a[0] + a[1]) % P)
+
+
+def fp2_inv(a: Fp2) -> Fp2:
+    a0, a1 = a
+    norm_inv = pow(a0 * a0 + a1 * a1, -1, P)
+    return (a0 * norm_inv % P, (-a1) * norm_inv % P)
+
+
+def fp2_is_zero(a: Fp2) -> bool:
+    return a[0] % P == 0 and a[1] % P == 0
+
+
+def fp2_legendre_norm(a: Fp2) -> int:
+    """Legendre symbol of norm(a) in Fp: a is a square in Fp2 iff this
+    is not -1 (a^((p^2-1)/2) = norm(a)^((p-1)/2))."""
+    n = (a[0] * a[0] + a[1] * a[1]) % P
+    if n == 0:
+        return 0
+    return 1 if pow(n, (P - 1) // 2, P) == 1 else -1
+
+
+def fp_sqrt(a: int) -> Optional[int]:
+    """Square root in Fp (p ≡ 3 mod 4); None when a is a non-residue."""
+    a %= P
+    c = pow(a, (P + 1) // 4, P)
+    return c if c * c % P == a else None
+
+
+def fp2_sqrt(a: Fp2) -> Optional[Fp2]:
+    """Square root in Fp2, self-verified (returns None for non-squares)."""
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        c = fp_sqrt(a0)
+        if c is not None:
+            return (c, 0)
+        c = fp_sqrt((-a0) % P)  # a0 = -(c^2) -> sqrt = c*u
+        return None if c is None else (0, c)
+    lam = fp_sqrt((a0 * a0 + a1 * a1) % P)
+    if lam is None:
+        return None
+    inv2 = (P + 1) // 2  # 1/2 mod p
+    delta = (a0 + lam) * inv2 % P
+    c0 = fp_sqrt(delta)
+    if c0 is None:
+        delta = (a0 - lam) * inv2 % P
+        c0 = fp_sqrt(delta)
+        if c0 is None:
+            return None
+    c1 = a1 * pow(2 * c0, -1, P) % P
+    cand = (c0, c1)
+    return cand if fp2_sq(cand) == (a0, a1) else None
+
+
+# --- Fp6 / Fp12 --------------------------------------------------------------
+
+FP6_ZERO: Fp6 = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE: Fp6 = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+def fp6_add(a: Fp6, b: Fp6) -> Fp6:
+    return (fp2_add(a[0], b[0]), fp2_add(a[1], b[1]), fp2_add(a[2], b[2]))
+
+
+def fp6_sub(a: Fp6, b: Fp6) -> Fp6:
+    return (fp2_sub(a[0], b[0]), fp2_sub(a[1], b[1]), fp2_sub(a[2], b[2]))
+
+
+def fp6_neg(a: Fp6) -> Fp6:
+    return (fp2_neg(a[0]), fp2_neg(a[1]), fp2_neg(a[2]))
+
+
+def fp6_mul(a: Fp6, b: Fp6) -> Fp6:
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(
+        fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), fp2_add(t1, t2))))
+    c1 = fp2_add(fp2_sub(
+        fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), fp2_add(t0, t1)),
+        fp2_mul_xi(t2))
+    c2 = fp2_add(fp2_sub(
+        fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), fp2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def fp6_sq(a: Fp6) -> Fp6:
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a: Fp6) -> Fp6:
+    """a * v (the Fp12 w^2): (a0, a1, a2) -> (xi*a2, a0, a1)."""
+    return (fp2_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_scale_fp2(a: Fp6, k: Fp2) -> Fp6:
+    return (fp2_mul(a[0], k), fp2_mul(a[1], k), fp2_mul(a[2], k))
+
+
+def fp6_inv(a: Fp6) -> Fp6:
+    a0, a1, a2 = a
+    c0 = fp2_sub(fp2_sq(a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(fp2_mul_xi(fp2_sq(a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_sq(a1), fp2_mul(a0, a2))
+    t = fp2_add(fp2_mul(a0, c0),
+                fp2_mul_xi(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2))))
+    ti = fp2_inv(t)
+    return (fp2_mul(c0, ti), fp2_mul(c1, ti), fp2_mul(c2, ti))
+
+
+FP12_ONE: Fp12 = (FP6_ONE, FP6_ZERO)
+
+
+def fp12_mul(a: Fp12, b: Fp12) -> Fp12:
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c1 = fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), fp6_add(t0, t1))
+    return (fp6_add(t0, fp6_mul_by_v(t1)), c1)
+
+
+def fp12_sq(a: Fp12) -> Fp12:
+    a0, a1 = a
+    t = fp6_mul(a0, a1)
+    c0 = fp6_sub(
+        fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))),
+        fp6_add(t, fp6_mul_by_v(t)),
+    )
+    return (c0, fp6_add(t, t))
+
+
+def fp12_conj(a: Fp12) -> Fp12:
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a: Fp12) -> Fp12:
+    a0, a1 = a
+    t = fp6_inv(fp6_sub(fp6_sq(a0), fp6_mul_by_v(fp6_sq(a1))))
+    return (fp6_mul(a0, t), fp6_neg(fp6_mul(a1, t)))
+
+
+# Frobenius coefficients, derived (not transcribed): gamma = xi^((p-1)/6)
+# and its square/cube power the v- and w-coefficient twists.
+def _fp2_pow(a: Fp2, e: int) -> Fp2:
+    out = FP2_ONE
+    while e:
+        if e & 1:
+            out = fp2_mul(out, a)
+        a = fp2_sq(a)
+        e >>= 1
+    return out
+
+
+_G_W = _fp2_pow(XI, (P - 1) // 6)  # w^(p-1)
+_G_V = _fp2_pow(XI, (P - 1) // 3)  # v^(p-1)
+_G_V2 = fp2_sq(_G_V)  # v^2(p-1)
+
+
+def fp6_frob(a: Fp6) -> Fp6:
+    return (
+        fp2_conj(a[0]),
+        fp2_mul(fp2_conj(a[1]), _G_V),
+        fp2_mul(fp2_conj(a[2]), _G_V2),
+    )
+
+
+def fp12_frob(a: Fp12) -> Fp12:
+    a0, a1 = a
+    return (fp6_frob(a0), fp6_scale_fp2(fp6_frob(a1), _G_W))
+
+
+def fp12_pow_x_abs(a: Fp12) -> Fp12:
+    """a^|x| by square-and-multiply (|x| has weight 6)."""
+    bits = bin(-X)[2:]
+    out = a
+    for bit in bits[1:]:
+        out = fp12_sq(out)
+        if bit == "1":
+            out = fp12_mul(out, a)
+    return out
+
+
+# --- curves ------------------------------------------------------------------
+# Affine points; None is the point at infinity. G1 coordinates are ints,
+# G2 coordinates Fp2 tuples. One generic implementation per coordinate
+# field keeps the twist (b' = 4*xi) and the SSWU isogeny domain
+# (y^2 = x^3 + A'x + B') on the same code path.
+
+B1 = 4
+B2 = fp2_scale(XI, 4)  # 4(1+u) on the twist
+
+
+def g1_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = 3 * x1 * x1 * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return (x3, (lam * (x1 - x3) - y1) % P)
+
+
+def g1_neg(p1):
+    return None if p1 is None else (p1[0], (-p1[1]) % P)
+
+
+def g1_mul(p1, k: int):
+    return _jac_mul(p1, k % R, _FP_OPS)
+
+
+def g1_on_curve(p1) -> bool:
+    if p1 is None:
+        return True
+    x, y = p1
+    return (y * y - (x * x * x + B1)) % P == 0
+
+
+# -- Jacobian scalar multiplication (shared G1/G2 core) -----------------------
+# Affine add/double above are the semantic primitives (and the kernels'
+# oracle); scalar multiplication routes through a=0 Jacobian formulas
+# (dbl-2009-l / madd-2007-bl) to drop the per-step field inversion —
+# ~10x on the 636-bit G2 cofactor clear. tests/test_bls.py cross-checks
+# the two paths on random scalars.
+
+_FP_OPS = (
+    lambda a, b: a * b % P,          # mul
+    lambda a: a * a % P,             # sq
+    lambda a, b: (a + b) % P,        # add
+    lambda a, b: (a - b) % P,        # sub
+    lambda a, k: a * k % P,          # scale
+    lambda a: a % P == 0,            # is_zero
+    lambda a: pow(a, -1, P),         # inv
+    0,                               # zero
+)
+_FP2_OPS = (
+    fp2_mul, fp2_sq, fp2_add, fp2_sub, fp2_scale, fp2_is_zero, fp2_inv,
+    (0, 0),
+)
+
+
+def _jac_dbl(X, Y, Z, ops):
+    mul, sq, add, sub, scale = ops[:5]
+    A = sq(X)
+    Bv = sq(Y)
+    C = sq(Bv)
+    D = scale(sub(sub(sq(add(X, Bv)), A), C), 2)
+    E = scale(A, 3)
+    X3 = sub(sq(E), scale(D, 2))
+    Y3 = sub(mul(E, sub(D, X3)), scale(C, 8))
+    return X3, Y3, scale(mul(Y, Z), 2)
+
+
+def _jac_mul(pt, k: int, ops):
+    """k * pt for affine pt on an a=0 short-Weierstrass curve over the
+    field described by `ops`; returns affine (or None)."""
+    if pt is None or k == 0:
+        return None
+    mul, sq, add, sub, scale, is_zero, inv, _zero = ops
+    one = 1 if ops is _FP_OPS else FP2_ONE
+    x2, y2 = pt  # the fixed affine addend
+    acc = None  # Jacobian accumulator (X, Y, Z), None = infinity
+    for bit in bin(k)[2:]:
+        if acc is not None:
+            acc = _jac_dbl(*acc, ops)
+        if bit == "1":
+            if acc is None:
+                acc = (x2, y2, one)
+                continue
+            X, Y, Z = acc
+            # madd-2007-bl (mixed add, Z2 = 1)
+            ZZ = sq(Z)
+            U2 = mul(x2, ZZ)
+            S2 = mul(mul(y2, Z), ZZ)
+            H = sub(U2, X)
+            if is_zero(H):
+                if is_zero(sub(S2, Y)):
+                    acc = _jac_dbl(X, Y, Z, ops)
+                else:
+                    acc = None  # P + (-P)
+                continue
+            HH = sq(H)
+            I = scale(HH, 4)
+            J = mul(H, I)
+            rr = scale(sub(S2, Y), 2)
+            V = mul(X, I)
+            X3 = sub(sub(sq(rr), J), scale(V, 2))
+            Y3 = sub(mul(rr, sub(V, X3)), scale(mul(Y, J), 2))
+            Z3 = sub(sub(sq(add(Z, H)), ZZ), HH)
+            acc = (X3, Y3, Z3)
+    if acc is None:
+        return None
+    X, Y, Z = acc
+    if is_zero(Z):
+        return None
+    zi = inv(Z)
+    zi2 = sq(zi)
+    return (mul(X, zi2), mul(Y, mul(zi2, zi)))
+
+
+def _fp2_curve_add(p1, p2, a_coef: Fp2, scalar_bits=None):
+    """Affine add on y^2 = x^3 + a*x + b over Fp2 (b implicit)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if fp2_is_zero(fp2_add(y1, y2)):
+            return None
+        num = fp2_add(fp2_scale(fp2_sq(x1), 3), a_coef)
+        lam = fp2_mul(num, fp2_inv(fp2_scale(y1, 2)))
+    else:
+        lam = fp2_mul(fp2_sub(y2, y1), fp2_inv(fp2_sub(x2, x1)))
+    x3 = fp2_sub(fp2_sub(fp2_sq(lam), x1), x2)
+    return (x3, fp2_sub(fp2_mul(lam, fp2_sub(x1, x3)), y1))
+
+
+def g2_add(p1, p2):
+    return _fp2_curve_add(p1, p2, FP2_ZERO)
+
+
+def g2_neg(p1):
+    return None if p1 is None else (p1[0], fp2_neg(p1[1]))
+
+
+def g2_mul(p1, k: int, modr: bool = True):
+    if modr:
+        k %= R
+    return _jac_mul(p1, k, _FP2_OPS)
+
+
+def g2_on_curve(p1) -> bool:
+    if p1 is None:
+        return True
+    x, y = p1
+    return fp2_is_zero(fp2_sub(
+        fp2_sq(y), fp2_add(fp2_mul(fp2_sq(x), x), B2)))
+
+
+def g1_in_subgroup(p1) -> bool:
+    if p1 is None:
+        return True
+    # NOT g1_mul: that reduces the scalar mod r, which would turn this
+    # check into 0*P == infinity — vacuously true for every on-curve
+    # point (the small-subgroup hole; g2_in_subgroup avoids it the same
+    # way via modr=False)
+    return g1_on_curve(p1) and _jac_mul(p1, R, _FP_OPS) is None
+
+
+def g2_in_subgroup(p1) -> bool:
+    if p1 is None:
+        return True
+    return g2_on_curve(p1) and g2_mul(p1, R, modr=False) is None
+
+
+# --- pairing -----------------------------------------------------------------
+
+def _line(g0_scalar: Fp2, h1: Fp2, h2: Fp2) -> Fp12:
+    """Sparse line element: g0 + h1*w^3 + h2*w^5 in the (1, v, v^2,
+    w, vw, v^2 w) basis (w^3 = v*w, w^5 = v^2*w)."""
+    return ((g0_scalar, FP2_ZERO, FP2_ZERO), (FP2_ZERO, h1, h2))
+
+
+def _miller_loop(pairs) -> Fp12:
+    """Product of optimal-ate Miller functions f_{|x|,Q_i}(P_i).
+
+    pairs: [(P affine G1, Q affine G2 on the twist)]; pairs with either
+    point at infinity contribute 1. Line functions are evaluated via the
+    M-twist untwist (x/w^2, y/w^3) and scaled per-line by xi and the
+    affine denominators — Fp2 constants, killed by the final
+    exponentiation. x < 0 is handled by conjugating the loop output.
+    """
+    live = [(pp, qq) for pp, qq in pairs if pp is not None and qq is not None]
+    f = FP12_ONE
+    if not live:
+        return f
+    ts = [q for _, q in live]
+    bits = bin(-X)[3:]  # MSB consumed by the initial T = Q
+    for bit in bits:
+        f = fp12_sq(f)
+        for i, (pt, q) in enumerate(live):
+            xp, yp = pt
+            tx, ty = ts[i]
+            # doubling line at T, evaluated at P (scaled by 2*ty*xi)
+            lam = fp2_mul(fp2_scale(fp2_sq(tx), 3),
+                          fp2_inv(fp2_scale(ty, 2)))
+            h1 = fp2_sub(fp2_mul(lam, tx), ty)
+            h2 = fp2_scale(lam, (-xp) % P)
+            f = fp12_mul(f, _line(fp2_scale(fp2_mul_xi(FP2_ONE), yp), h1, h2))
+            x3 = fp2_sub(fp2_sq(lam), fp2_scale(tx, 2))
+            ts[i] = (x3, fp2_sub(fp2_mul(lam, fp2_sub(tx, x3)), ty))
+            if bit == "1":
+                tx, ty = ts[i]
+                qx, qy = q
+                # T != +-Q always here: T = k*Q with 0 < k < |x| << r
+                lam = fp2_mul(fp2_sub(ty, qy), fp2_inv(fp2_sub(tx, qx)))
+                h1 = fp2_sub(fp2_mul(lam, qx), qy)
+                h2 = fp2_scale(lam, (-xp) % P)
+                f = fp12_mul(
+                    f, _line(fp2_scale(fp2_mul_xi(FP2_ONE), yp), h1, h2))
+                x3 = fp2_sub(fp2_sub(fp2_sq(lam), tx), qx)
+                ts[i] = (x3, fp2_sub(fp2_mul(lam, fp2_sub(tx, x3)), ty))
+    return fp12_conj(f)  # x < 0
+
+
+def final_exponentiation(f: Fp12) -> Fp12:
+    """f^(3*(p^12-1)/r): the reduced ate pairing cubed (see module doc).
+
+    Easy part f^((p^6-1)(p^2+1)) puts f in the cyclotomic subgroup
+    (inverse = conjugate); hard part via the asserted HHT identity."""
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))  # ^(p^6 - 1)
+    f = fp12_mul(fp12_frob(fp12_frob(f)), f)  # ^(p^2 + 1)
+
+    def pow_x(a: Fp12) -> Fp12:  # a^x (x < 0: conjugate in cyclotomic)
+        return fp12_conj(fp12_pow_x_abs(a))
+
+    a = fp12_mul(pow_x(f), fp12_conj(f))  # f^(x-1)
+    a = fp12_mul(pow_x(a), fp12_conj(a))  # f^((x-1)^2)
+    b = fp12_mul(pow_x(a), fp12_frob(a))  # ^(x+p)
+    c = fp12_mul(
+        fp12_mul(pow_x(pow_x(b)), fp12_frob(fp12_frob(b))),  # ^(x^2+p^2)
+        fp12_conj(b),  # ^(-1)
+    )
+    f3 = fp12_mul(fp12_sq(f), f)
+    return fp12_mul(c, f3)
+
+
+def pairing(p1, q2) -> Fp12:
+    """e(P, Q)^3 for P in G1, Q in G2 (cubed pairing; see module doc)."""
+    return final_exponentiation(_miller_loop([(p1, q2)]))
+
+
+def pairings_equal_one(pairs) -> bool:
+    """Whether the product of pairings over `pairs` is the identity —
+    ONE shared Miller loop product and ONE final exponentiation (the
+    verification shape: 2 loops + 1 exp per check, aggregate or not)."""
+    return final_exponentiation(_miller_loop(pairs)) == FP12_ONE
+
+
+# --- RFC 9380 hash-to-curve (suite BLS12381G2_XMD:SHA-256_SSWU_RO_) ----------
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 with SHA-256."""
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = (len_in_bytes + 31) // 32
+    if ell > 255 or len_in_bytes > 65535:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * 64  # SHA-256 block size
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    b_prev = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = b_prev
+    for i in range(2, ell + 1):
+        b_prev = hashlib.sha256(
+            bytes(x ^ y for x, y in zip(b0, b_prev))
+            + bytes([i]) + dst_prime
+        ).digest()
+        out += b_prev
+    return out[:len_in_bytes]
+
+
+def hash_to_field_fp2(msg: bytes, dst: bytes, count: int) -> List[Fp2]:
+    """RFC 9380 §5.2 for Fp2 (m = 2, L = 64)."""
+    L = 64
+    uniform = expand_message_xmd(msg, dst, count * 2 * L)
+    out = []
+    for i in range(count):
+        elems = []
+        for j in range(2):
+            off = L * (j + i * 2)
+            elems.append(int.from_bytes(uniform[off:off + L], "big") % P)
+        out.append((elems[0], elems[1]))
+    return out
+
+
+# SSWU isogenous curve E2': y^2 = x^3 + A'x + B' (RFC 9380 §8.8.2)
+SSWU_A: Fp2 = (0, 240)
+SSWU_B: Fp2 = (1012, 1012)
+SSWU_Z: Fp2 = ((-2) % P, (-1) % P)  # -(2 + u)
+
+# 3-isogeny map E2' -> E2 coefficients (RFC 9380 Appendix E.3). These
+# are the one transcribed constant block; tests/test_bls.py validates
+# them by checking hash-to-curve outputs land ON E2 (a wrong rational-map
+# coefficient lands off-curve with overwhelming probability) and in the
+# r-torsion.
+_K = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+ISO_X_NUM = (
+    (_K, _K),
+    (0, 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D),
+    (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0),
+)
+# x_den = x'^2 + k_(2,1) x' + k_(2,0) (monic quadratic)
+ISO_X_DEN = (
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    (0xC, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    (1, 0),
+)
+ISO_Y_NUM = (
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+     0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0, 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0),
+)
+ISO_Y_DEN = (
+    (0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3),
+    (0x12, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99),
+    (1, 0),
+)
+
+
+def _sgn0_fp2(a: Fp2) -> int:
+    """RFC 9380 §4.1 sgn0 for m = 2."""
+    sign_0 = a[0] % 2
+    zero_0 = a[0] % P == 0
+    sign_1 = a[1] % 2
+    return sign_0 or (zero_0 and sign_1)
+
+
+def _sswu_fp2(u: Fp2):
+    """RFC 9380 §6.6.2 simplified SWU onto E2' (non-uniform branches are
+    fine off-device; the kernels re-derive a batch-uniform version)."""
+    u2 = fp2_sq(u)
+    zu2 = fp2_mul(SSWU_Z, u2)
+    tv1 = fp2_add(fp2_sq(zu2), zu2)  # Z^2 u^4 + Z u^2
+    neg_b_over_a = fp2_mul(fp2_neg(SSWU_B), fp2_inv(SSWU_A))
+    if fp2_is_zero(tv1):
+        x1 = fp2_mul(SSWU_B, fp2_inv(fp2_mul(SSWU_Z, SSWU_A)))
+    else:
+        x1 = fp2_mul(neg_b_over_a, fp2_add(FP2_ONE, fp2_inv(tv1)))
+    gx1 = fp2_add(fp2_mul(fp2_add(fp2_sq(x1), SSWU_A), x1), SSWU_B)
+    if fp2_legendre_norm(gx1) != -1:
+        x, y = x1, fp2_sqrt(gx1)
+    else:
+        x2 = fp2_mul(zu2, x1)
+        gx2 = fp2_add(fp2_mul(fp2_add(fp2_sq(x2), SSWU_A), x2), SSWU_B)
+        x, y = x2, fp2_sqrt(gx2)
+    assert y is not None, "SSWU: g(x) must be square on one branch"
+    if _sgn0_fp2(u) != _sgn0_fp2(y):
+        y = fp2_neg(y)
+    return (x, y)
+
+
+def _eval_poly(ks, x: Fp2) -> Fp2:
+    out = FP2_ZERO
+    for k in reversed(ks):
+        out = fp2_add(fp2_mul(out, x), k)
+    return out
+
+
+def _iso_map_g2(pt):
+    """3-isogeny E2' -> E2 (RFC 9380 §4.3 / E.3)."""
+    if pt is None:
+        return None
+    x, y = pt
+    x_den = _eval_poly(ISO_X_DEN, x)
+    y_den = _eval_poly(ISO_Y_DEN, x)
+    if fp2_is_zero(x_den) or fp2_is_zero(y_den):
+        return None  # exceptional point maps to infinity
+    xo = fp2_mul(_eval_poly(ISO_X_NUM, x), fp2_inv(x_den))
+    yo = fp2_mul(fp2_mul(y, _eval_poly(ISO_Y_NUM, x)), fp2_inv(y_den))
+    return (xo, yo)
+
+
+def _sswu_curve_add(p1, p2):
+    return _fp2_curve_add(p1, p2, SSWU_A)
+
+
+def clear_cofactor_g2(pt):
+    """h_eff * P (RFC 9380 §8.8.2): lands in the r-torsion (asserted at
+    import: h_eff is a multiple of the formula-derived h2)."""
+    return _jac_mul(pt, H_EFF_G2, _FP2_OPS)
+
+
+def hash_to_curve_g2(msg: bytes, dst: bytes = DST_SIG):
+    """RFC 9380 hash_to_curve for the G2 suite: two field elements, two
+    SSWU maps added on E2', one isogeny evaluation, cofactor cleared.
+
+    LRU-cached: a committee signs (and its verifier re-hashes) the SAME
+    vote statement n times — one curve hash serves all of them. The
+    function is deterministic, so the cache is semantics-free."""
+    return _hash_to_curve_g2_cached(bytes(msg), bytes(dst))
+
+
+@_lru_cache(maxsize=256)
+def _hash_to_curve_g2_cached(msg: bytes, dst: bytes):
+    u0, u1 = hash_to_field_fp2(msg, dst, 2)
+    q = _sswu_curve_add(_sswu_fp2(u0), _sswu_fp2(u1))
+    return clear_cofactor_g2(_iso_map_g2(q))
+
+
+# --- serialization (ZCash BLS12-381 format) ----------------------------------
+
+_FLAG_COMPRESSED = 0x80
+_FLAG_INFINITY = 0x40
+_FLAG_SIGN = 0x20
+
+
+def _y_is_large(y: int) -> bool:
+    return 2 * y > P
+
+
+def g1_compress(pt) -> bytes:
+    if pt is None:
+        return bytes([_FLAG_COMPRESSED | _FLAG_INFINITY]) + b"\x00" * 47
+    x, y = pt
+    flags = _FLAG_COMPRESSED | (_FLAG_SIGN if _y_is_large(y) else 0)
+    b = x.to_bytes(48, "big")
+    return bytes([b[0] | flags]) + b[1:]
+
+
+def g1_decompress(data: bytes):
+    """48-byte compressed G1 -> affine point; raises ValueError on any
+    malformed/off-curve/non-subgroup encoding. LRU-cached: committee
+    keys recur every block, and the r-torsion check is the expensive
+    part (the function is deterministic; exceptions are never cached)."""
+    return _g1_decompress_cached(bytes(data))
+
+
+@_lru_cache(maxsize=1024)
+def _g1_decompress_cached(data: bytes):
+    if len(data) != 48:
+        raise ValueError("G1 point must be 48 bytes")
+    flags = data[0]
+    if not flags & _FLAG_COMPRESSED:
+        raise ValueError("uncompressed G1 encoding unsupported")
+    if flags & _FLAG_INFINITY:
+        if flags & _FLAG_SIGN or any(data[1:]) or data[0] & 0x3F:
+            raise ValueError("malformed infinity encoding")
+        return None
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("G1 x out of range")
+    y = fp_sqrt((x * x * x + B1) % P)
+    if y is None:
+        raise ValueError("G1 x not on curve")
+    if _y_is_large(y) != bool(flags & _FLAG_SIGN):
+        y = P - y
+    pt = (x, y)
+    if not g1_in_subgroup(pt):
+        raise ValueError("G1 point not in the r-torsion subgroup")
+    return pt
+
+
+def g2_compress(pt) -> bytes:
+    if pt is None:
+        return (bytes([_FLAG_COMPRESSED | _FLAG_INFINITY])
+                + b"\x00" * 95)
+    (x0, x1), (y0, y1) = pt
+    large = _y_is_large(y1) if y1 != 0 else _y_is_large(y0)
+    flags = _FLAG_COMPRESSED | (_FLAG_SIGN if large else 0)
+    b = x1.to_bytes(48, "big")
+    return bytes([b[0] | flags]) + b[1:] + x0.to_bytes(48, "big")
+
+
+def g2_decompress(data: bytes, subgroup_check: bool = True):
+    """96-byte compressed G2 -> affine point (ValueError on malformed/
+    off-curve/out-of-subgroup encodings). `subgroup_check=False` skips
+    the r-torsion check — ONLY sound where the caller's verification
+    equation covers the result anyway (signature aggregation: the
+    aggregate point gets the full check inside aggregate_verify, so
+    checking each component would re-pay exactly the per-signature cost
+    aggregation exists to remove). Both variants LRU-cached."""
+    return _g2_decompress_cached(bytes(data), bool(subgroup_check))
+
+
+@_lru_cache(maxsize=1024)
+def _g2_decompress_cached(data: bytes, subgroup_check: bool):
+    if len(data) != 96:
+        raise ValueError("G2 point must be 96 bytes")
+    flags = data[0]
+    if not flags & _FLAG_COMPRESSED:
+        raise ValueError("uncompressed G2 encoding unsupported")
+    if flags & _FLAG_INFINITY:
+        if flags & _FLAG_SIGN or any(data[1:]) or data[0] & 0x3F:
+            raise ValueError("malformed infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x out of range")
+    x = (x0, x1)
+    y = fp2_sqrt(fp2_add(fp2_mul(fp2_sq(x), x), B2))
+    if y is None:
+        raise ValueError("G2 x not on curve")
+    y0c, y1c = y
+    large = _y_is_large(y1c) if y1c != 0 else _y_is_large(y0c)
+    if large != bool(flags & _FLAG_SIGN):
+        y = fp2_neg(y)
+    pt = (x, y)
+    if subgroup_check and not g2_in_subgroup(pt):
+        raise ValueError("G2 point not in the r-torsion subgroup")
+    return pt
+
+
+# --- the signature scheme (CFRG BLS draft, min-pubkey-size, PoP) -------------
+
+def keygen(ikm: bytes, key_info: bytes = b"") -> int:
+    """CFRG KeyGen: HKDF-SHA256 with the BLS salt, looped until nonzero."""
+    if len(ikm) < 32:
+        raise ValueError("IKM must be at least 32 bytes")
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    L = 48
+    info = key_info + L.to_bytes(2, "big")
+    sk = 0
+    while sk == 0:
+        prk = _hmac.new(salt, ikm + b"\x00", hashlib.sha256).digest()
+        okm, t = b"", b""
+        for i in range(1, (L + 31) // 32 + 1):
+            t = _hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+            okm += t
+        sk = int.from_bytes(okm[:L], "big") % R
+        salt = hashlib.sha256(salt).digest()
+    return sk
+
+
+def sk_to_pk(sk: int) -> bytes:
+    return g1_compress(g1_mul(G1_GEN, sk))
+
+
+def sign(sk: int, message: bytes, dst: bytes = DST_SIG) -> bytes:
+    return g2_compress(g2_mul(hash_to_curve_g2(message, dst), sk))
+
+
+def verify(pk: bytes, signature: bytes, message: bytes,
+           dst: bytes = DST_SIG) -> bool:
+    """One signature: e(g1, sig) == e(pk, H(m)), checked as a product of
+    two Miller loops sharing one final exponentiation."""
+    try:
+        pk_pt = g1_decompress(pk)
+        sig_pt = g2_decompress(signature)
+    except ValueError:
+        return False
+    if pk_pt is None:
+        return False  # the identity public key signs everything
+    h = hash_to_curve_g2(message, dst)
+    return pairings_equal_one([(g1_neg(G1_GEN), sig_pt), (pk_pt, h)])
+
+
+def aggregate(signatures: Sequence[bytes]) -> bytes:
+    """Sum the signature points: n committee votes -> one 96-byte sig.
+
+    Components are decoded WITHOUT per-point subgroup checks (on-curve
+    only): the aggregate itself is fully validated inside
+    aggregate_verify, and re-checking every component would re-pay the
+    exact per-signature cost aggregation removes (CFRG Aggregate does
+    the same — subgroup checking happens at verification)."""
+    if not signatures:
+        raise ValueError("cannot aggregate zero signatures")
+    acc = None
+    for sig in signatures:
+        acc = g2_add(acc, g2_decompress(sig, subgroup_check=False))
+    return g2_compress(acc)
+
+
+def aggregate_pubkeys(pubkeys: Sequence[bytes]):
+    acc = None
+    for pk in pubkeys:
+        acc = g1_add(acc, g1_decompress(pk))
+    return acc
+
+
+def aggregate_verify(pubkeys: Sequence[bytes], message: bytes,
+                     agg_signature: bytes, dst: bytes = DST_SIG) -> bool:
+    """Same-message aggregate check (CFRG FastAggregateVerify): ONE
+    e(g1, agg_sig) == e(sum pk_i, H(m)) — 2 Miller loops + 1 final exp
+    regardless of committee size. ONLY sound under proof-of-possession
+    registration (rogue-key attacks otherwise; docs/bls-aggregation.md)."""
+    if not pubkeys:
+        return False
+    try:
+        agg_pk = aggregate_pubkeys(pubkeys)
+        sig_pt = g2_decompress(agg_signature)
+    except ValueError:
+        return False
+    if agg_pk is None:
+        return False
+    h = hash_to_curve_g2(message, dst)
+    return pairings_equal_one([(g1_neg(G1_GEN), sig_pt), (agg_pk, h)])
+
+
+def aggregate_verify_distinct(pubkeys: Sequence[bytes],
+                              messages: Sequence[bytes],
+                              agg_signature: bytes,
+                              dst: bytes = DST_SIG) -> bool:
+    """CFRG AggregateVerify for distinct messages: product of n+1
+    pairings, one shared final exponentiation."""
+    if not pubkeys or len(pubkeys) != len(messages):
+        return False
+    try:
+        pairs = [(g1_decompress(pk), hash_to_curve_g2(m, dst))
+                 for pk, m in zip(pubkeys, messages)]
+        sig_pt = g2_decompress(agg_signature)
+    except ValueError:
+        return False
+    if any(pk is None for pk, _ in pairs):
+        return False
+    pairs.append((g1_neg(G1_GEN), sig_pt))
+    return pairings_equal_one(pairs)
+
+
+def pop_prove(sk: int) -> bytes:
+    """Proof of possession: a signature over the pubkey bytes under the
+    POP domain separation tag."""
+    return sign(sk, sk_to_pk(sk), dst=DST_POP)
+
+
+def pop_verify(pk: bytes, proof: bytes) -> bool:
+    return verify(pk, proof, pk, dst=DST_POP)

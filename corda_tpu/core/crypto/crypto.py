@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import hmac as hmac_mod
 import os
+import threading
 from typing import Iterable, Tuple
 
 try:
@@ -41,6 +42,7 @@ except ImportError:  # pragma: no cover - depends on the host image
 from . import ed25519_math, secp_math
 from .keys import KeyPair, PublicKey, SchemePrivateKey, SchemePublicKey
 from .schemes import (
+    BLS_BLS12381,
     COMPOSITE_KEY,
     DEFAULT_SIGNATURE_SCHEME,
     ECDSA_SECP256K1_SHA256,
@@ -129,7 +131,21 @@ def generate_keypair(scheme: SignatureScheme = DEFAULT_SIGNATURE_SCHEME) -> KeyP
         from . import sphincs
 
         return sphincs.generate_keypair()
+    if name == BLS_BLS12381.scheme_code_name:
+        from . import bls_math
+
+        return _bls_keypair(bls_math.keygen(os.urandom(32)))
     raise UnsupportedSchemeError(f"cannot generate keys for {name}")
+
+
+def _bls_keypair(sk: int) -> KeyPair:
+    from . import bls_math
+
+    name = BLS_BLS12381.scheme_code_name
+    return KeyPair(
+        SchemePublicKey(name, bls_math.sk_to_pk(sk)),
+        SchemePrivateKey(name, sk.to_bytes(32, "big")),
+    )
 
 
 def _require_openssl(what: str) -> None:
@@ -200,6 +216,10 @@ def derive_keypair_from_entropy(
         curve = _EC_CURVES[name][1]
         d = (int.from_bytes(material, "big") % (curve.n - 1)) + 1
         return _ec_keypair_from_scalar(name, d)
+    if name == BLS_BLS12381.scheme_code_name:
+        from . import bls_math
+
+        return _bls_keypair(bls_math.keygen(material))
     raise UnsupportedSchemeError(f"deterministic derivation unsupported for {name}")
 
 
@@ -236,6 +256,12 @@ def do_sign(private: SchemePrivateKey, clear_data: bytes) -> bytes:
         from . import sphincs
 
         return sphincs.sign(private, clear_data)
+    if name == BLS_BLS12381.scheme_code_name:
+        from . import bls_math
+
+        return bls_math.sign(
+            int.from_bytes(private.encoded, "big"), clear_data
+        )
     raise UnsupportedSchemeError(f"cannot sign with {name}")
 
 
@@ -289,6 +315,10 @@ def is_valid(public: PublicKey, signature: bytes, clear_data: bytes) -> bool:
             from . import sphincs
 
             return sphincs.verify(public, signature, clear_data)
+        if name == BLS_BLS12381.scheme_code_name:
+            from . import bls_math
+
+            return bls_math.verify(public.encoded, signature, clear_data)
         if name == COMPOSITE_KEY.scheme_code_name:
             from .composite import CompositeKey, CompositeSignaturesWithKeys
 
@@ -316,9 +346,108 @@ def public_key_on_curve(public: PublicKey) -> bool:
         except ValueError:
             return False
         return pt is not None and curve.contains(pt)
+    if name == BLS_BLS12381.scheme_code_name:
+        from . import bls_math
+
+        try:
+            return bls_math.g1_decompress(public.encoded) is not None
+        except ValueError:
+            return False
     return True  # not a curve-based key
 
 
 def entropy_to_keypair(entropy: int) -> KeyPair:
     """Fixed-entropy test identities (reference TestConstants.entropyToKeyPair)."""
     return derive_keypair_from_entropy(EDDSA_ED25519_SHA512, entropy)
+
+
+# --- BLS aggregation + proof-of-possession registry --------------------------
+# Same-message aggregation (the committee-consensus shape, PAPERS
+# arXiv 2302.00418) is only sound when every participating public key has
+# proven knowledge of its secret key: without that, a rogue member
+# registers pk' = pk_evil - sum(other pks) and forges the aggregate alone.
+# The registry below is the SPI-level gate: committee wiring registers
+# each member key WITH its proof of possession, and aggregate_verify
+# refuses unregistered keys unless the caller explicitly opts out
+# (require_pop=False, for callers enforcing possession out of band).
+
+_POP_REGISTRY: set = set()  # 48-byte compressed G1 pubkeys with valid PoP
+_POP_LOCK = threading.Lock()
+
+
+def _bls_public_bytes(public) -> bytes:
+    if isinstance(public, (bytes, bytearray)):
+        return bytes(public)
+    if getattr(public, "scheme_code_name", None) != BLS_BLS12381.scheme_code_name:
+        raise UnsupportedSchemeError(
+            f"aggregation requires {BLS_BLS12381.scheme_code_name} keys, "
+            f"got {getattr(public, 'scheme_code_name', type(public).__name__)}"
+        )
+    return public.encoded
+
+
+def bls_prove_possession(private: SchemePrivateKey) -> bytes:
+    """Proof of possession for a BLS private key (sign the pubkey bytes
+    under the PoP domain-separation tag)."""
+    if private.scheme_code_name != BLS_BLS12381.scheme_code_name:
+        raise UnsupportedSchemeError("proof of possession is BLS-only")
+    from . import bls_math
+
+    return bls_math.pop_prove(int.from_bytes(private.encoded, "big"))
+
+
+def bls_register_key(public, proof: bytes) -> bool:
+    """Verify `proof` of possession for `public` and admit the key to the
+    aggregation registry. Returns False (and does not register) on an
+    invalid proof. Idempotent AND cheap on re-registration: a key
+    already in the registry passed a full PoP check once, so the
+    2-pairing verification is skipped (every replica of an in-process
+    committee registers the same n keys — n^2 pairings otherwise)."""
+    from . import bls_math
+
+    pk = _bls_public_bytes(public)
+    with _POP_LOCK:
+        if pk in _POP_REGISTRY:
+            return True
+    if not bls_math.pop_verify(pk, proof):
+        return False
+    with _POP_LOCK:
+        _POP_REGISTRY.add(pk)
+    return True
+
+
+def bls_key_registered(public) -> bool:
+    with _POP_LOCK:
+        return _bls_public_bytes(public) in _POP_REGISTRY
+
+
+def aggregate(signatures) -> bytes:
+    """Aggregate BLS signatures (over one message) into one 96-byte
+    signature: the n-votes -> one-check committee lever."""
+    from . import bls_math
+
+    try:
+        return bls_math.aggregate(list(signatures))
+    except ValueError as exc:
+        raise CryptoError(str(exc))
+
+
+def aggregate_verify(pubkeys, message: bytes, agg_signature: bytes,
+                     require_pop: bool = True) -> bool:
+    """Verify an aggregate of same-message signatures: ONE 2-pairing
+    check regardless of committee size (vs n checks naively).
+
+    `require_pop=True` (default) refuses public keys that never proved
+    possession via bls_register_key — the rogue-key gate. Callers that
+    enforce possession elsewhere (e.g. a cluster deploy tool validating
+    PoPs at key ceremony) may pass False."""
+    from . import bls_math
+
+    pks = [_bls_public_bytes(pk) for pk in pubkeys]
+    if not pks:
+        return False
+    if require_pop:
+        with _POP_LOCK:
+            if any(pk not in _POP_REGISTRY for pk in pks):
+                return False
+    return bls_math.aggregate_verify(pks, message, agg_signature)

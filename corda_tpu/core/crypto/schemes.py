@@ -46,6 +46,12 @@ COMPOSITE_KEY = SignatureScheme(
     "Composite keys composed from multiple signature schemes, to enable a "
     "flexible fusion of different signature schemes.", None,
 )
+BLS_BLS12381 = SignatureScheme(
+    7, "BLS_BLS12381", "BLS",
+    "BLS aggregate signature scheme over the BLS12-381 pairing curve "
+    "(minimal-pubkey-size, proof-of-possession ciphersuite): n committee "
+    "signatures over one message verify as a single 2-pairing check.", 256,
+)
 
 SUPPORTED_SIGNATURE_SCHEMES: Dict[str, SignatureScheme] = {
     s.scheme_code_name: s
@@ -56,6 +62,7 @@ SUPPORTED_SIGNATURE_SCHEMES: Dict[str, SignatureScheme] = {
         EDDSA_ED25519_SHA512,
         SPHINCS256_SHA256,
         COMPOSITE_KEY,
+        BLS_BLS12381,
     )
 }
 

@@ -491,3 +491,56 @@ def measure_failover_recovery(
     if verbose:
         print(out)
     return out
+
+
+def measure_bls_aggregate_ab(n: int = 64,
+                             message: bytes = b"committee block statement"):
+    """Committee aggregate-vs-naive verification A/B
+    (docs/bls-aggregation.md) — THE shared implementation behind
+    bench.py's `bls_aggregate_verify` stage and
+    CommitteeConsensusLoadTest's metrics, so the two can never drift.
+
+    n committee members BLS-sign `message`; `naive` is n per-vote
+    verifies (what a non-aggregating notary pays per block), `aggregate`
+    is signature aggregation + ONE 2-pairing check. Both run the host
+    path (the CPU backend's production route for BLS) and both see the
+    same cached hash-to-curve of the shared statement, so the comparison
+    isolates verification work."""
+    import time
+
+    from ..core.crypto import bls_math
+
+    sks = [bls_math.keygen(bytes([i % 251 + 1]) * 32) for i in range(n)]
+    pks = [bls_math.sk_to_pk(sk) for sk in sks]
+    sigs = [bls_math.sign(sk, message) for sk in sks]
+
+    # steady-state committee: long-lived (PoP-registered) pubkeys are
+    # decompression-cache-warm for BOTH legs — without this the leg
+    # that happens to run first pays all n cold pubkey validations and
+    # the comparison stops isolating verification work
+    for pk in pks:
+        bls_math.g1_decompress(pk)
+
+    t0 = time.perf_counter()
+    ok = all(
+        bls_math.verify(pk, sig, message) for pk, sig in zip(pks, sigs)
+    )
+    naive_wall = time.perf_counter() - t0
+    assert ok, "committee signatures failed naive verification"
+
+    t0 = time.perf_counter()
+    agg = bls_math.aggregate(sigs)
+    assert bls_math.aggregate_verify(pks, message, agg), (
+        "committee aggregate failed verification"
+    )
+    agg_wall = time.perf_counter() - t0
+
+    return {
+        "bls_committee_n": n,
+        "bls_naive_verifies_s": round(n / naive_wall, 2),
+        "bls_naive_wall_ms": round(naive_wall * 1000, 2),
+        "bls_aggregate_verify_ms": round(agg_wall * 1000, 2),
+        "bls_aggregate_speedup_x": round(
+            naive_wall / max(agg_wall, 1e-9), 1
+        ),
+    }

@@ -51,6 +51,24 @@ def dev_signing_seed(replica_id: int) -> bytes:
     return hashlib.sha256(b"corda-tpu-bft-dev-key:%d" % replica_id).digest()
 
 
+def dev_bls_committee(n_replicas: int):
+    """Deterministic DEV-ONLY BLS vote-key material for an aggregating
+    committee: ({id: secret scalar}, {id: 48B pubkey}, {id: PoP bytes}).
+    Same caveat as dev_signing_seed — production clusters distribute
+    their own keys and proofs of possession at the key ceremony."""
+    from ..core.crypto import bls_math
+
+    sks = {
+        i: bls_math.keygen(
+            hashlib.sha256(b"corda-tpu-bft-dev-bls:%d" % i).digest()
+        )
+        for i in range(n_replicas)
+    }
+    pubs = {i: bls_math.sk_to_pk(sk) for i, sk in sks.items()}
+    pops = {i: bls_math.pop_prove(sk) for i, sk in sks.items()}
+    return sks, pubs, pops
+
+
 def _prepare_statement(view: int, seq: int, digest: bytes) -> bytes:
     """Canonical byte statement a prepare signature covers."""
     return b"bft-prepare\x00" + serialize({"v": view, "s": seq, "d": digest})
@@ -85,13 +103,28 @@ class BFTReplica:
         snapshot_fn: Optional[Callable[[], bytes]] = None,
         restore_fn: Optional[Callable[[bytes], None]] = None,
         meta_store=None,
+        bls_signing_key: Optional[int] = None,
+        replica_bls_pubs: Optional[Dict[int, bytes]] = None,
+        replica_bls_pops: Optional[Dict[int, bytes]] = None,
     ):
         """snapshot_fn/restore_fn: dump/load the applied state machine
         (the uniqueness map) for catch-up state transfer; meta_store: a
         KVStore persisting (last_executed, view) so a RESTARTED replica
         resumes from its own durable state instead of seq 0 (reference
         BFTSMaRt.Replica's DefaultRecoverable snapshot get/install,
-        `BFTSMaRt.kt:150-276`)."""
+        `BFTSMaRt.kt:150-276`).
+
+        bls_signing_key / replica_bls_pubs / replica_bls_pops: the
+        AGGREGATING vote mode (PAPERS arXiv 2302.00418). When this
+        replica holds a BLS secret AND every committee member has a BLS
+        pubkey with a VALID proof of possession, prepare votes are
+        BLS-signed, per-vote verification is deferred, and commit
+        certification becomes ONE aggregate check per block (plus one
+        aggregated certificate per view-change claim) instead of 2f+1
+        per-vote verifies. Any member lacking a key — or shipping a bad
+        PoP — drops the whole committee back to per-vote Ed25519: a
+        split committee signing under two schemes could never assemble
+        either certificate."""
         assert n_replicas >= 4, "BFT needs n >= 3f+1 with f >= 1"
         from ..core.crypto import ed25519_math
 
@@ -109,6 +142,41 @@ class BFTReplica:
             i: ed25519_math.public_from_seed(dev_signing_seed(i))
             for i in range(n_replicas)
         }
+        # -- aggregating vote mode ------------------------------------------
+        self.vote_scheme = "ed25519"
+        self._bls_sk = None
+        self.replica_bls_pubs = dict(replica_bls_pubs or {})
+        if bls_signing_key is not None:
+            missing = [
+                i for i in range(n_replicas)
+                if i not in self.replica_bls_pubs
+            ]
+            bad_pops = []
+            if not missing:
+                from ..core.crypto import crypto as _crypto
+
+                for i, pub in self.replica_bls_pubs.items():
+                    pop = (replica_bls_pops or {}).get(i)
+                    # rogue-key gate: a vote key joins the aggregate
+                    # committee only with a valid proof of possession
+                    if pop is None or not _crypto.bls_register_key(pub, pop):
+                        bad_pops.append(i)
+            if missing or bad_pops:
+                logger.warning(
+                    "%s: BLS vote keys incomplete (missing=%s bad_pop=%s); "
+                    "falling back to per-vote ed25519", self.id,
+                    missing, bad_pops,
+                )
+            else:
+                self.vote_scheme = "bls"
+                self._bls_sk = bls_signing_key
+        # telemetry for the aggregate-vs-naive claim: how many aggregate
+        # checks and how many individual vote verifies this replica ran
+        self.agg_checks = 0
+        self.vote_verifies = 0
+        # (view, seq, digest) quorums whose vote set already passed an
+        # aggregate check (no re-check when trailing votes arrive)
+        self._certified: Set[Tuple[int, int, bytes]] = set()
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self._meta = meta_store
@@ -199,11 +267,14 @@ class BFTReplica:
     # -- prepare-vote signatures ---------------------------------------------
 
     def _sign_prepare(self, view: int, seq: int, d: bytes) -> bytes:
+        stmt = _prepare_statement(view, seq, d)
+        if self.vote_scheme == "bls":
+            from ..core.crypto import bls_math
+
+            return bls_math.sign(self._bls_sk, stmt)
         from ..core.crypto import ed25519_math
 
-        return ed25519_math.sign(
-            self._signing_seed, _prepare_statement(view, seq, d)
-        )
+        return ed25519_math.sign(self._signing_seed, stmt)
 
     def _verify_replica_sig(
         self, voter: int, statement: bytes, sig: object
@@ -218,12 +289,77 @@ class BFTReplica:
         except Exception:
             return False
 
+    def _verify_vote(self, voter: int, statement: bytes, sig: object) -> bool:
+        """Individually verify ONE prepare vote (the per-vote path: the
+        only path in ed25519 mode, the FALLBACK path in bls mode)."""
+        self.vote_verifies += 1
+        if self.vote_scheme == "bls":
+            from ..core.crypto import bls_math
+
+            pub = self.replica_bls_pubs.get(voter)
+            if pub is None or not isinstance(sig, (bytes, bytearray)):
+                return False
+            try:
+                return bls_math.verify(pub, bytes(sig), statement)
+            except Exception:
+                return False
+        return self._verify_replica_sig(voter, statement, sig)
+
     def _verify_prepare_sig(
         self, voter: int, view: int, seq: int, d: bytes, sig: object
     ) -> bool:
-        return self._verify_replica_sig(
+        return self._verify_vote(
             voter, _prepare_statement(view, seq, d), sig
         )
+
+    def _accept_prepare_sig(
+        self, voter: int, view: int, seq: int, d: bytes, sig: object
+    ) -> bool:
+        """Receipt-time vote admission. Ed25519 mode verifies eagerly
+        (the classic per-vote cost); bls mode only shape-checks and
+        DEFERS cryptographic verification to the one aggregate check at
+        quorum (_certify_quorum) — the aggregation win."""
+        if self.vote_scheme == "bls":
+            return isinstance(sig, (bytes, bytearray)) and len(sig) == 96
+        return self._verify_prepare_sig(voter, view, seq, d, sig)
+
+    def _certify_quorum(self, view: int, seq: int, d: bytes) -> bool:
+        """ONE aggregate check certifying a 2f+1 prepare quorum (bls
+        mode). On failure — some Byzantine vote in the set — fall back
+        to individual verification, drop the bad votes, and certify only
+        if an honest 2f+1 remains."""
+        ckey = (view, seq, d)
+        if ckey in self._certified:
+            return True
+        from ..core.crypto import crypto as _crypto
+
+        sigs = self.prepare_sigs.get(ckey, {})
+        stmt = _prepare_statement(view, seq, d)
+        voters = sorted(sigs)
+        self.agg_checks += 1
+        try:
+            agg = _crypto.aggregate([sigs[v] for v in voters])
+            ok = _crypto.aggregate_verify(
+                [self.replica_bls_pubs[v] for v in voters], stmt, agg
+            )
+        except Exception:
+            ok = False
+        if ok:
+            self._certified.add(ckey)
+            return True
+        good = {v: s for v, s in sigs.items()
+                if self._verify_vote(v, stmt, s)}
+        dropped = set(sigs) - set(good)
+        logger.warning(
+            "%s: aggregate vote check failed at seq %d; dropped invalid "
+            "votes from %s", self.id, seq, sorted(dropped),
+        )
+        self.prepare_sigs[ckey] = good
+        self.prepares[ckey] = set(good)
+        if len(good) >= 2 * self.f + 1:
+            self._certified.add(ckey)
+            return True
+        return False
 
     # -- client request entry ------------------------------------------------
 
@@ -268,7 +404,7 @@ class BFTReplica:
             if (
                 msg["view"] == self.view
                 and self._seq_in_window(msg["seq"])
-                and self._verify_prepare_sig(
+                and self._accept_prepare_sig(
                     sender, msg["view"], msg["seq"], msg["digest"],
                     msg.get("psig"),
                 )
@@ -327,7 +463,7 @@ class BFTReplica:
             # digest with DIFFERENT bodies to different replicas — one
             # quorum, divergent executions
             return
-        if not self._verify_prepare_sig(
+        if not self._accept_prepare_sig(
             sender, msg["view"], seq, d, msg.get("psig")
         ):
             return  # unsigned/forged pre-prepare
@@ -350,6 +486,10 @@ class BFTReplica:
         self.prepare_sigs.setdefault((self.view, seq, d), {})[voter] = sig
         # prepared: pre-prepare + 2f prepares (incl. our own vote counting)
         if len(votes) >= 2 * self.f + 1 and self.pre_prepares.get(seq) == d:
+            if self.vote_scheme == "bls" and not self._certify_quorum(
+                self.view, seq, d
+            ):
+                return  # bad votes dropped; quorum must refill
             ckey = (self.view, seq, d)
             if self.id not in self.commits.get(ckey, set()):
                 self._broadcast({
@@ -518,6 +658,7 @@ class BFTReplica:
         for store in (self.prepares, self.commits, self.prepare_sigs):
             for key in [k for k in store if k[1] <= n]:
                 del store[key]
+        self._certified = {k for k in self._certified if k[1] > n}
         for seq in [s for s in self.committed if s <= n]:
             del self.committed[seq]
         self.executed = {s for s in self.executed if s > n}
@@ -706,19 +847,90 @@ class BFTReplica:
 
     def _prepared_certificates(self) -> List[list]:
         """Self-certifying prepared entries: [seq, digest, request,
-        prepared_view, [[voter, sig], ...]] with >= 2f+1 verifiable prepare
-        signatures each — a single view-change message proves preparedness
-        (PBFT's P set), so a committed request can never be dropped just
-        because few members of the new-view quorum saw it prepare."""
+        prepared_view, cert] with >= 2f+1 verifiable prepare signatures
+        each — a single view-change message proves preparedness (PBFT's
+        P set), so a committed request can never be dropped just because
+        few members of the new-view quorum saw it prepare.
+
+        cert is [[voter, sig], ...] in ed25519 mode; in bls mode it is
+        ["bls", [voters...], agg_sig] — ONE aggregated signature whose
+        verification costs the receiver one aggregate check instead of
+        2f+1 per-vote verifies."""
         out = []
         for (view, seq, d), voters in self.prepares.items():
             if len(voters) < 2 * self.f + 1 or self.pre_prepares.get(seq) != d:
                 continue
             sigs = self.prepare_sigs.get((view, seq, d), {})
+            if self.vote_scheme == "bls":
+                cert = self._assemble_bls_cert(view, seq, d, sigs)
+                if cert is not None and d in self.requests:
+                    out.append([seq, d, self.requests[d], view, cert])
+                continue
             cert = [[v, sigs[v]] for v in sorted(sigs)][: 2 * self.f + 1]
             if len(cert) >= 2 * self.f + 1 and d in self.requests:
                 out.append([seq, d, self.requests[d], view, cert])
         return out
+
+    def _assemble_bls_cert(self, view: int, seq: int, d: bytes, sigs):
+        """["bls", voters, agg_sig] over 2f+1 stored votes, aggregate-
+        checked locally before emission (trailing votes are stored
+        unverified once a quorum certified, so emission re-validates);
+        on failure, filters individually and retries once."""
+        from ..core.crypto import crypto as _crypto
+
+        stmt = _prepare_statement(view, seq, d)
+        pool = dict(sigs)
+        for _ in range(2):
+            voters = sorted(pool)[: 2 * self.f + 1]
+            if len(voters) < 2 * self.f + 1:
+                return None
+            try:
+                agg = _crypto.aggregate([pool[v] for v in voters])
+                self.agg_checks += 1
+                if _crypto.aggregate_verify(
+                    [self.replica_bls_pubs[v] for v in voters], stmt, agg
+                ):
+                    return ["bls", voters, agg]
+            except Exception:
+                pass
+            pool = {v: s for v, s in pool.items()
+                    if self._verify_vote(v, stmt, s)}
+        return None
+
+    def _cert_voters(self, prep_view: int, seq: int, d: bytes, cert) -> Set[int]:
+        """The distinct replicas whose signatures in a prepared-
+        certificate claim verify. Aggregated ["bls", voters, agg_sig]
+        certs cost ONE aggregate check; the legacy per-vote list costs
+        one verify per entry. Raises TypeError/ValueError on malformed
+        shapes (the caller treats that as a bad claim)."""
+        if (
+            isinstance(cert, (list, tuple)) and len(cert) == 3
+            and cert[0] == "bls"
+        ):
+            if self.vote_scheme != "bls":
+                return set()  # an ed25519 committee never signed this
+            from ..core.crypto import crypto as _crypto
+
+            voters = {int(v) for v in cert[1]}
+            if len(voters) != len(list(cert[1])) or not all(
+                v in self.replica_bls_pubs for v in voters
+            ):
+                return set()
+            stmt = _prepare_statement(prep_view, seq, d)
+            self.agg_checks += 1
+            try:
+                ok = _crypto.aggregate_verify(
+                    [self.replica_bls_pubs[v] for v in sorted(voters)],
+                    stmt, cert[2],
+                )
+            except Exception:
+                ok = False
+            return voters if ok else set()
+        return {
+            voter
+            for voter, sig in cert
+            if self._verify_prepare_sig(voter, prep_view, seq, d, sig)
+        }
 
     def _start_view_change(self, new_view: int) -> None:
         votes = self.view_change_votes.setdefault(new_view, set())
@@ -744,11 +956,7 @@ class BFTReplica:
                     continue
                 # verify the prepared certificate: 2f+1 distinct replicas'
                 # signatures over the prepare statement (prep_view, seq, d)
-                valid_voters = {
-                    voter
-                    for voter, sig in cert
-                    if self._verify_prepare_sig(voter, prep_view, seq, d, sig)
-                }
+                valid_voters = self._cert_voters(prep_view, seq, d, cert)
             except (TypeError, ValueError):
                 continue  # malformed claim
             if len(valid_voters) >= 2 * self.f + 1:

@@ -579,6 +579,64 @@ class AbstractNode:
             )
             for i, m in enumerate(members)
         }
+        # Aggregating vote mode (docs/bls-aggregation.md): opt in with
+        # bft_cluster {"vote_scheme": "bls"}. Per-member "bls_pub" +
+        # "bls_pop" (hex) ride the shared members list and this member's
+        # own "bls_secret" its private config. Dev keys are derived ONLY
+        # when the whole cluster block carries no BLS key material at
+        # all (a pure dev deployment, same trust caveat as the dev
+        # ed25519 seeds) — a PARTIALLY keyed block (one member's pub
+        # missing mid-rollout) must reach BFTReplica incomplete so its
+        # documented ed25519 fallback fires, never be silently filled
+        # with publicly-derivable dev keys that would weaken the
+        # Byzantine threshold.
+        bls_kwargs = {}
+        if cfg.get("vote_scheme") == "bls":
+            from ..core.crypto import bls_math as _bls_math
+
+            any_explicit = bool(cfg.get("bls_secret")) or any(
+                m.get("bls_pub") or m.get("bls_pop") for m in members
+            )
+            if not any_explicit:
+                from .bft import dev_bls_committee
+
+                dev_sks, dev_pubs, dev_pops = dev_bls_committee(n)
+                bls_kwargs = {
+                    "bls_signing_key": dev_sks[my_index],
+                    "replica_bls_pubs": dev_pubs,
+                    "replica_bls_pops": dev_pops,
+                }
+            else:
+                my_sk = (
+                    int(cfg["bls_secret"], 16)
+                    if cfg.get("bls_secret") else None
+                )
+                pubs = {
+                    i: bytes.fromhex(m["bls_pub"])
+                    for i, m in enumerate(members) if m.get("bls_pub")
+                }
+                my_pub = pubs.get(my_index)
+                if (
+                    my_sk is not None and my_pub is not None
+                    and _bls_math.sk_to_pk(my_sk) != my_pub
+                ):
+                    # same fail-fast as the ed25519 signing_seed check
+                    # above: signing votes every peer drops (via the
+                    # aggregate-failure fallback) silently degrades
+                    # fault tolerance with no error anywhere
+                    raise ValueError(
+                        "bft_cluster bls_secret does not match this "
+                        "member's bls_pub in the members list (stale "
+                        "config after a redeploy?)"
+                    )
+                bls_kwargs = {
+                    "bls_signing_key": my_sk,
+                    "replica_bls_pubs": pubs,
+                    "replica_bls_pops": {
+                        i: bytes.fromhex(m["bls_pop"])
+                        for i, m in enumerate(members) if m.get("bls_pop")
+                    },
+                }
         apply_fn, snapshot_fn, restore_fn, meta_store = (
             BFTUniquenessProvider.make_replica_state(
                 self.database, sign_tx_fn=sign_tx
@@ -591,6 +649,7 @@ class AbstractNode:
             snapshot_fn=snapshot_fn,
             restore_fn=restore_fn,
             meta_store=meta_store,
+            **bls_kwargs,
         )
         if cfg.get("view_timeout") is not None:
             # per-deployment view-change timer (tests use a short one so

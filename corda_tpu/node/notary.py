@@ -293,10 +293,37 @@ class BFTUniquenessProvider(UniquenessProvider):
     (reference `BFTSMaRt.kt` Client/Replica wrapping the BFT-SMaRt library;
     see corda_tpu.node.bft for the replica protocol).  The provider is the
     client side: it submits the putall and accepts the verdict once f+1
-    replicas agree."""
+    replicas agree.
 
-    def __init__(self, bft_client):
+    With a BLS vote committee (BFTReplica vote_scheme "bls"), the
+    replicas behind this provider certify each block's prepare quorum
+    with ONE aggregate signature check instead of 2f+1 per-vote
+    verifies; `vote_stats()` surfaces the measured split so the
+    committee-consensus loadtest and bench stage can report
+    aggregate-vs-naive verification work (docs/bls-aggregation.md)."""
+
+    def __init__(self, bft_client, replicas=None):
         self.client = bft_client
+        # in-process replicas, when the caller hosts them (MockNetwork
+        # clusters, loadtests); real deployments read per-node metrics
+        self._replicas = list(replicas or [])
+
+    def vote_stats(self) -> dict:
+        """{vote_scheme, agg_checks, vote_verifies} summed over the
+        replicas this process hosts (zeros when they live elsewhere).
+        vote_scheme is "mixed" when hosted replicas disagree — a split
+        committee is a degraded deployment and must never masquerade as
+        a healthy "bls" one to the loadtest SLOs."""
+        out = {"vote_scheme": None, "agg_checks": 0, "vote_verifies": 0}
+        schemes = {r.vote_scheme for r in self._replicas}
+        if schemes:
+            out["vote_scheme"] = (
+                schemes.pop() if len(schemes) == 1 else "mixed"
+            )
+        for r in self._replicas:
+            out["agg_checks"] += r.agg_checks
+            out["vote_verifies"] += r.vote_verifies
+        return out
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
         entries = {

@@ -10,6 +10,11 @@ from .ecdsa_batch import verify_batch as ecdsa_verify_batch
 from .ed25519_batch import verify_batch as ed25519_verify_batch
 from .ed25519_batch import verify_kernel as ed25519_verify_kernel
 from .ed25519_batch import prepare_batch as ed25519_prepare_batch
+from .bls12_batch import pairing_batch as bls12_pairing_batch
+from .bls12_batch import verify_pairs_batch as bls12_verify_pairs_batch
+from .bls12_batch import (
+    aggregate_verify_device as bls12_aggregate_verify_device,
+)
 
 __all__ = [
     "ecdsa_prepare_batch",
@@ -17,6 +22,9 @@ __all__ = [
     "ed25519_verify_batch",
     "ed25519_verify_kernel",
     "ed25519_prepare_batch",
+    "bls12_pairing_batch",
+    "bls12_verify_pairs_batch",
+    "bls12_aggregate_verify_device",
 ]
 
 

@@ -1,0 +1,576 @@
+"""Batched BLS12-381 extension-field tower for the TPU pairing kernels.
+
+Fp here is a **Montgomery-domain** field over 24 little-endian
+radix-2^16 uint32 limbs (384 bits >= the 381-bit prime).
+
+Unlike field_secp's CIOS (whose interleaved reduction scatters into the
+accumulator with `.at[].add` — dynamic-update-slice chains that XLA CPU
+compiles pathologically slowly once a pairing's ~10^4 field muls stack
+up), the multiplier here is **separated-operand Montgomery (SOS)** built
+ONLY from broadcast multiplies, static pads/shifts, and sequential carry
+chains: t = a*b via anti-diagonal pad-and-sum, m = t*(-p^-1) mod 2^384
+the same way, result = (t + m*p) >> 384. Same math, DUS-free graph.
+
+Bounds (all exact in uint32, no int64 emulation): each anti-diagonal
+accumulates <= 48 lo + 48 hi halfword terms < 96*2^16 < 2^22.6; the
+final t + m*p sum doubles that to < 2^23.6; carry chains keep carries
+< 2^8 above the masked limb.
+
+On top of Fp the module builds the pairing tower as FUNCTIONS over
+STACKED-COEFFICIENT arrays (see the tower section below): coefficient
+axes ride ahead of the limb axis, so add/sub/select at any tower level
+are ONE base-field op and a tower multiply gathers its whole karatsuba
+tree into one stacked base multiply — the structure that keeps XLA CPU
+compile time sane at pairing op counts. Formulas mirror
+corda_tpu.core.crypto.bls_math one-for-one — the jax-free reference the
+kernels are differentially tested against (tests/test_bls.py). Batch
+dims leading, limb dim last, as everywhere in ops/.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.crypto import bls_math
+
+NLIMB = 24
+MASK16 = jnp.uint32(0xFFFF)
+
+P_INT = bls_math.P
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    if not 0 <= x < 2**384:
+        raise ValueError("out of range")
+    return np.array(
+        [(x >> (16 * k)) & 0xFFFF for k in range(NLIMB)], np.uint32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(limbs[..., k]) << (16 * k) for k in range(NLIMB))
+
+
+def _carry_chain(acc, n: int):
+    """Sequential carry propagation over n limbs (inputs < 2^31 so limb
+    + carry stays exact in uint32); returns strict limbs, drops the
+    final carry-out (callers arrange that it is provably zero). A
+    lax.scan so every chain in a pairing shares ONE tiny compiled body
+    instead of unrolling n x 3 ops at each of ~10^4 call sites."""
+    x = jnp.moveaxis(acc, -1, 0)
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> 16, v & MASK16
+
+    _, outs = lax.scan(step, jnp.zeros_like(x[0]), x)
+    return jnp.moveaxis(outs, 0, -1)
+
+
+# Anti-diagonal gather matrices: flat halfword product (i*24+j) -> limb
+# position i+j (lo) / i+j+1 (hi). One u32 dot against a constant 0/1
+# matrix replaces 96 pad+add ops — the whole schoolbook accumulation is
+# a single XLA dot, which both compiles and fuses well.
+def _diag_matrix(offset: int, out_n: int) -> np.ndarray:
+    t = np.zeros((NLIMB * NLIMB, out_n), np.uint32)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            k = i + j + offset
+            if k < out_n:
+                t[i * NLIMB + j, k] = 1
+    return t
+
+
+_DIAG = {
+    out_n: (_diag_matrix(0, out_n), _diag_matrix(1, out_n))
+    for out_n in (NLIMB, 2 * NLIMB)
+}
+
+
+def _raw_mul(a, b, out_n: int):
+    """Anti-diagonal schoolbook product of two strict (..., 24) limb
+    arrays, truncated to out_n limbs, WITHOUT carry propagation
+    (coefficients < 2^22.6)."""
+    prod = a[..., :, None] * b[..., None, :]  # (..., 24, 24) exact u32
+    lo = (prod & MASK16).reshape(*prod.shape[:-2], NLIMB * NLIMB)
+    hi = (prod >> 16).reshape(*prod.shape[:-2], NLIMB * NLIMB)
+    t_lo, t_hi = _DIAG[out_n]
+    return lax.dot_general(
+        lo, jnp.asarray(t_lo), (((lo.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.uint32,
+    ) + lax.dot_general(
+        hi, jnp.asarray(t_hi), (((hi.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.uint32,
+    )
+
+
+class BLSFp:
+    """Montgomery field mod the 381-bit BLS12-381 prime, radix-2^16 SOS
+    over 24 limbs (see module doc for why not CIOS)."""
+
+    def __init__(self, p: int):
+        self.p_int = p
+        self.p_limbs = int_to_limbs(p)
+        self._p_i32 = self.p_limbs.astype(np.int32)
+        # -p^-1 mod 2^384: the full-width Montgomery m-multiplier
+        self.n0inv_limbs = np.array(
+            [((-pow(p, -1, 1 << 384)) >> (16 * k)) & 0xFFFF
+             for k in range(NLIMB)], np.uint32,
+        )
+        self.r_int = (1 << (16 * NLIMB)) % p
+        self.one_mont = int_to_limbs(self.r_int)
+        self.zero = int_to_limbs(0)
+
+    # -- host-side helpers ---------------------------------------------------
+
+    def to_mont_int(self, x: int) -> np.ndarray:
+        return int_to_limbs((x % self.p_int) * self.r_int % self.p_int)
+
+    def from_mont_limbs(self, limbs) -> int:
+        return (
+            limbs_to_int(limbs) * pow(self.r_int, -1, self.p_int)
+        ) % self.p_int
+
+    def const(self, limbs, batch_shape=()) -> jnp.ndarray:
+        return jnp.broadcast_to(
+            jnp.asarray(limbs, jnp.uint32), (*batch_shape, NLIMB)
+        )
+
+    # -- device ops (shapes/bounds as in field_secp, NLIMB=24) ---------------
+
+    def _cond_sub_p(self, a, force=None):
+        """a - p where (a >= p or force); borrow chain as a scan."""
+        x = jnp.moveaxis(a.astype(jnp.int32), -1, 0)
+        pv = jnp.asarray(self._p_i32)
+
+        def step(carry, xs):
+            limb, pk = xs
+            v = limb - pk + carry
+            return v >> 16, (v & 0xFFFF).astype(jnp.uint32)
+
+        carry, outs = lax.scan(step, jnp.zeros_like(x[0]), (x, pv))
+        t = jnp.moveaxis(outs, 0, -1)
+        geq = carry == 0
+        take = geq if force is None else (geq | force)
+        return jnp.where(take[..., None], t, a)
+
+    def add(self, a, b):
+        """(a + b) mod p for canonical inputs (sum < 2p < 2^384, so no
+        2^384 overflow exists). ONE scan computes the sum chain AND the
+        sum-minus-p chain in lockstep; the final borrow selects."""
+        pv = jnp.asarray(self._p_i32)
+        x = jnp.moveaxis(a, -1, 0)
+        y = jnp.moveaxis(b, -1, 0)
+
+        def step(carrys, xs):
+            c1, c2 = carrys
+            la, lb, pk = xs
+            v = la + lb + c1  # < 2^17: exact
+            s = v & MASK16
+            w = s.astype(jnp.int32) - pk + c2
+            return (v >> 16, w >> 16), (s, (w & 0xFFFF).astype(jnp.uint32))
+
+        (_, borrow), (s, t) = lax.scan(
+            step,
+            (jnp.zeros_like(x[0]), jnp.zeros_like(x[0], jnp.int32)),
+            (x, y, pv),
+        )
+        s = jnp.moveaxis(s, 0, -1)
+        t = jnp.moveaxis(t, 0, -1)
+        return jnp.where((borrow == 0)[..., None], t, s)
+
+    def sub(self, a, b):
+        """(a - b) mod p: the borrow chain and the +p repair chain run
+        in ONE scan; the final borrow selects."""
+        pv = jnp.asarray(self.p_limbs, jnp.int32)
+        x = jnp.moveaxis(a.astype(jnp.int32), -1, 0)
+        y = jnp.moveaxis(b.astype(jnp.int32), -1, 0)
+
+        def step(carrys, xs):
+            c1, c2 = carrys
+            la, lb, pk = xs
+            v = la - lb + c1
+            d = v & 0xFFFF
+            w = d + pk + c2
+            return (v >> 16, w >> 16), (
+                d.astype(jnp.uint32), (w & 0xFFFF).astype(jnp.uint32)
+            )
+
+        (borrow, _), (d, t) = lax.scan(
+            step, (jnp.zeros_like(x[0]), jnp.zeros_like(x[0])), (x, y, pv)
+        )
+        d = jnp.moveaxis(d, 0, -1)
+        t = jnp.moveaxis(t, 0, -1)
+        return jnp.where((borrow < 0)[..., None], t, d)
+
+    def neg(self, a):
+        return self.sub(self.const(self.zero, a.shape[:-1]), a)
+
+    def mul(self, a, b):
+        """Montgomery product a*b*R^-1 mod p, separated-operand form:
+
+            t = a*b                      (768-bit, one carry chain)
+            m = (t mod R) * n0inv mod R  (one truncated product + chain)
+            r = (t + m*p) >> 384         (raw products summed, one chain)
+
+        t + m*p is divisible by R by construction, < R*(p + p^2/R)
+        < 2pR, so the high half after one carry chain is < 2p and one
+        conditional subtraction canonicalizes. The final chain's input
+        sums two raw products (< 2^23.6) plus strict t (< 2^16) —
+        comfortably exact in uint32."""
+        a = jnp.broadcast_to(
+            a, (*jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), NLIMB)
+        )
+        b = jnp.broadcast_to(b, a.shape)
+        t = _carry_chain(_raw_mul(a, b, 2 * NLIMB), 2 * NLIMB)
+        n0 = jnp.asarray(self.n0inv_limbs, jnp.uint32)
+        m = _carry_chain(_raw_mul(t[..., :NLIMB], n0, NLIMB), NLIMB)
+        s = t + _raw_mul(m, jnp.asarray(self.p_limbs, jnp.uint32),
+                         2 * NLIMB)
+        return self._mont_finish(s)
+
+    def _mont_finish(self, s):
+        """Final Montgomery step in ONE 48-limb scan: strictify s, and
+        for the high half simultaneously run the minus-p borrow chain
+        (pk padded with zeros below limb 24, so the borrow carry enters
+        the high half clean); the final borrow selects."""
+        pv = jnp.asarray(
+            np.concatenate([np.zeros(NLIMB, np.int32), self._p_i32])
+        )
+        x = jnp.moveaxis(s, -1, 0)
+
+        def step(carrys, xs):
+            c1, c2 = carrys
+            limb, pk = xs
+            v = limb + c1
+            r = v & MASK16
+            w = r.astype(jnp.int32) - pk + c2
+            return (v >> 16, w >> 16), (r, (w & 0xFFFF).astype(jnp.uint32))
+
+        (_, borrow), (r, t) = lax.scan(
+            step,
+            (jnp.zeros_like(x[0]), jnp.zeros_like(x[0], jnp.int32)),
+            (x, pv),
+        )
+        r = jnp.moveaxis(r, 0, -1)[..., NLIMB:]
+        t = jnp.moveaxis(t, 0, -1)[..., NLIMB:]
+        return jnp.where((borrow == 0)[..., None], t, r)
+
+    def square(self, a):
+        return self.mul(a, a)
+
+    def pow_const(self, x, exponent: int):
+        nbits = exponent.bit_length()
+        bits = jnp.asarray(
+            [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+            jnp.uint32,
+        )
+        acc0 = self.const(self.one_mont, x.shape[:-1])
+
+        def body(i, acc):
+            acc = self.square(acc)
+            return jnp.where(bits[i] == 1, self.mul(acc, x), acc)
+
+        return lax.fori_loop(0, nbits, body, acc0)
+
+    def inv(self, x):
+        """x^-1 via Fermat; 0 -> 0 (batch-uniform)."""
+        return self.pow_const(x, self.p_int - 2)
+
+    def is_zero(self, a):
+        return jnp.all(a == 0, axis=-1)
+
+    def eq(self, a, b):
+        return jnp.all(a == b, axis=-1)
+
+
+F = BLSFp(P_INT)
+
+# Montgomery-domain tower constants (host numpy, broadcastable)
+ONE_M = F.one_mont
+ZERO_M = F.zero
+
+
+def fp_const(v: int, batch_shape=()):
+    return F.const(F.to_mont_int(v), batch_shape)
+
+
+# --- the tower, stacked-coefficient representation ---------------------------
+# Tower elements are SINGLE arrays whose coefficient axes ride ahead of
+# the limb axis:
+#
+#     Fp2  : (..., 2, 24)          c0 + c1*u,  u^2 = -1
+#     Fp6  : (..., 3, 2, 24)       over v^3 = xi = 1 + u
+#     Fp12 : (..., 2, 3, 2, 24)    over w^2 = v
+#
+# Because every BLSFp op is batch-agnostic over leading axes, add/sub/
+# neg/select at ANY tower level are one base-field op (one scan pass),
+# and a tower multiply gathers its whole karatsuba tree of independent
+# base products into ONE stacked F.mul call (54 base muls per fp12_mul
+# through a single pair of anti-diagonal dots). That stacking is what
+# makes the pairing kernel compile tractably on XLA CPU — the naive
+# tuple-of-arrays tower was ~160 tiny scans per fp12 multiply.
+# Formulas mirror core.crypto.bls_math one-for-one.
+
+def fp2_add(a, b):
+    return F.add(a, b)
+
+
+def fp2_sub(a, b):
+    return F.sub(a, b)
+
+
+def fp2_neg(a):
+    return F.neg(a)
+
+
+# add/sub/neg are representation-blind; aliases keep call sites honest
+fp6_add = fp12_add = fp2_add
+fp6_sub = fp12_sub = fp2_sub
+fp6_neg = fp12_neg = fp2_neg
+
+
+def fp2_mul(a, b):
+    """Karatsuba over one stacked base multiply; works with any number
+    of leading stack axes (fp6/fp12 muls pass (..., k, 2, 24))."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    ops_a = jnp.stack([a0, a1, F.add(a0, a1)], axis=-2)
+    ops_b = jnp.stack([b0, b1, F.add(b0, b1)], axis=-2)
+    t = F.mul(ops_a, ops_b)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    return jnp.stack(
+        [F.sub(t0, t1), F.sub(t2, F.add(t0, t1))], axis=-2
+    )
+
+
+def fp2_sq(a):
+    return fp2_mul(a, a)
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :], F.neg(a[..., 1, :])], axis=-2)
+
+
+def fp2_mul_xi(a):
+    # (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([F.sub(a0, a1), F.add(a0, a1)], axis=-2)
+
+
+def fp2_scale_small(a, k: int):
+    """a * k for tiny non-negative k via a doubling chain."""
+    out = None
+    add = a
+    while k:
+        if k & 1:
+            out = add if out is None else F.add(out, add)
+        add = F.add(add, add)
+        k >>= 1
+    return out if out is not None else jnp.zeros_like(a)
+
+
+def fp2_inv(a):
+    # (a0 - a1 u) / (a0^2 + a1^2); 0 -> 0 (F.inv is Fermat)
+    sq = F.mul(a, a)
+    ni = F.inv(F.add(sq[..., 0, :], sq[..., 1, :]))
+    return jnp.stack(
+        [F.mul(a[..., 0, :], ni), F.mul(F.neg(a[..., 1, :]), ni)], axis=-2
+    )
+
+
+def fp2_mul_fp(a, s):
+    """Fp2 (..., 2, 24) times Fp (..., 24)."""
+    return F.mul(a, s[..., None, :])
+
+
+def fp6_mul(a, b):
+    """Toom/karatsuba Fp6: SIX independent fp2 products in one stacked
+    call (a/b may carry further leading stack axes)."""
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    ops_a = jnp.stack(
+        [a0, a1, a2, F.add(a1, a2), F.add(a0, a1), F.add(a0, a2)], axis=-3
+    )
+    ops_b = jnp.stack(
+        [b0, b1, b2, F.add(b1, b2), F.add(b0, b1), F.add(b0, b2)], axis=-3
+    )
+    t = fp2_mul(ops_a, ops_b)
+    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    u12, u01, u02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c0 = F.add(t0, fp2_mul_xi(F.sub(u12, F.add(t1, t2))))
+    c1 = F.add(F.sub(u01, F.add(t0, t1)), fp2_mul_xi(t2))
+    c2 = F.add(F.sub(u02, F.add(t0, t2)), t1)
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_sq(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    """a * v: (a0, a1, a2) -> (xi*a2, a0, a1)."""
+    return jnp.stack(
+        [fp2_mul_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :]],
+        axis=-3,
+    )
+
+
+def fp6_scale_fp2(a, k):
+    """Fp6 (..., 3, 2, 24) times Fp2 (..., 2, 24)."""
+    return fp2_mul(a, k[..., None, :, :])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    sqs = fp2_mul(a, a)  # a0^2, a1^2, a2^2 in one call
+    cross = fp2_mul(
+        jnp.stack([a1, a0, a0], axis=-3),
+        jnp.stack([a2, a1, a2], axis=-3),
+    )  # a1a2, a0a1, a0a2
+    c0 = F.sub(sqs[..., 0, :, :], fp2_mul_xi(cross[..., 0, :, :]))
+    c1 = F.sub(fp2_mul_xi(sqs[..., 2, :, :]), cross[..., 1, :, :])
+    c2 = F.sub(sqs[..., 1, :, :], cross[..., 2, :, :])
+    terms = fp2_mul(
+        jnp.stack([a0, a2, a1], axis=-3),
+        jnp.stack([c0, c1, c2], axis=-3),
+    )
+    t = F.add(
+        terms[..., 0, :, :],
+        fp2_mul_xi(F.add(terms[..., 1, :, :], terms[..., 2, :, :])),
+    )
+    ti = fp2_inv(t)
+    return fp2_mul(jnp.stack([c0, c1, c2], axis=-3), ti[..., None, :, :])
+
+
+def fp12_mul(a, b):
+    """ONE stacked fp6 multiply (= 54 base products through one pair of
+    dots) plus the karatsuba recombination."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    ops_a = jnp.stack([a0, a1, F.add(a0, a1)], axis=-4)
+    ops_b = jnp.stack([b0, b1, F.add(b0, b1)], axis=-4)
+    t = fp6_mul(ops_a, ops_b)
+    t0, t1, t2 = (
+        t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
+    )
+    return jnp.stack(
+        [
+            F.add(t0, fp6_mul_by_v(t1)),
+            F.sub(t2, F.add(t0, t1)),
+        ],
+        axis=-4,
+    )
+
+
+def fp12_sq(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    t = fp6_mul(
+        jnp.stack([a0, F.add(a0, a1)], axis=-4),
+        jnp.stack([a1, F.add(a0, fp6_mul_by_v(a1))], axis=-4),
+    )
+    t01 = t[..., 0, :, :, :]  # a0*a1
+    big = t[..., 1, :, :, :]  # (a0+a1)(a0 + v a1)
+    c0 = F.sub(big, F.add(t01, fp6_mul_by_v(t01)))
+    return jnp.stack([c0, F.add(t01, t01)], axis=-4)
+
+
+def fp12_conj(a):
+    return jnp.stack(
+        [a[..., 0, :, :, :], F.neg(a[..., 1, :, :, :])], axis=-4
+    )
+
+
+def fp12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    sq = fp6_mul(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
+    t = fp6_inv(F.sub(sq[..., 0, :, :, :],
+                      fp6_mul_by_v(sq[..., 1, :, :, :])))
+    prod = fp6_mul(
+        jnp.stack([a0, a1], axis=-4),
+        jnp.broadcast_to(t[..., None, :, :, :],
+                         (*t.shape[:-3], 2, *t.shape[-3:])),
+    )
+    return jnp.stack(
+        [prod[..., 0, :, :, :], F.neg(prod[..., 1, :, :, :])], axis=-4
+    )
+
+
+def fp12_select(mask, a, b):
+    return jnp.where(mask[..., None, None, None, None], a, b)
+
+
+def fp2_select(mask, a, b):
+    return jnp.where(mask[..., None, None], a, b)
+
+
+# Frobenius: conjugate every Fp2 coefficient, then ONE stacked fp2
+# multiply against the constant gamma tableau (derived via the pure-
+# Python mirror — nothing transcribed).
+def _fp2_mont(c: bls_math.Fp2) -> np.ndarray:
+    return np.stack([F.to_mont_int(c[0]), F.to_mont_int(c[1])])
+
+
+_FROB12_TABLEAU = np.stack([
+    np.stack([
+        _fp2_mont((1, 0)),
+        _fp2_mont(bls_math._G_V),
+        _fp2_mont(bls_math._G_V2),
+    ]),
+    np.stack([
+        _fp2_mont(bls_math._G_W),
+        _fp2_mont(bls_math.fp2_mul(bls_math._G_W, bls_math._G_V)),
+        _fp2_mont(bls_math.fp2_mul(bls_math._G_W, bls_math._G_V2)),
+    ]),
+])  # (2, 3, 2, 24)
+
+
+def fp12_frob(a):
+    conj = jnp.stack(
+        [a[..., 0, :], F.neg(a[..., 1, :])], axis=-2
+    )
+    return fp2_mul(conj, jnp.asarray(_FROB12_TABLEAU))
+
+
+def fp12_one(batch_shape=()):
+    one = np.zeros((2, 3, 2, NLIMB), np.uint32)
+    one[0, 0, 0] = F.one_mont
+    return jnp.broadcast_to(
+        jnp.asarray(one), (*batch_shape, 2, 3, 2, NLIMB)
+    )
+
+
+def fp12_eq_one(a):
+    """Batch mask: a == 1 (Montgomery canonical form is unique)."""
+    one = fp12_one(a.shape[:-4])
+    return jnp.all(a == one, axis=(-1, -2, -3, -4))
+
+
+# --- host conversions for the kernels ---------------------------------------
+
+def fp2_to_mont(c: bls_math.Fp2) -> np.ndarray:
+    """Host: Fp2 int tuple -> (2, 24) Montgomery limbs."""
+    return _fp2_mont(c)
+
+
+def fp2_from_mont(arr) -> bls_math.Fp2:
+    arr = np.asarray(arr)
+    return (F.from_mont_limbs(arr[..., 0, :]), F.from_mont_limbs(arr[..., 1, :]))
+
+
+def fp12_from_mont(arr) -> bls_math.Fp12:
+    """Device fp12 (single row, (2, 3, 2, 24)) -> bls_math int tower."""
+    arr = np.asarray(arr)
+    return tuple(
+        tuple(fp2_from_mont(arr[i6, i2]) for i2 in range(3))
+        for i6 in range(2)
+    )
+
+
+def fp12_to_mont(f: bls_math.Fp12) -> np.ndarray:
+    return np.stack([
+        np.stack([_fp2_mont(f[i6][i2]) for i2 in range(3)])
+        for i6 in range(2)
+    ])

@@ -222,10 +222,58 @@ def _spec_ecdsa_secp256r1_xla():
     return fn, (), kwargs, B, cal
 
 
+def _spec_bls12_miller_loop():
+    import jax
+    import jax.numpy as jnp
+
+    from . import bls12_batch
+    from . import field_bls12 as FB
+
+    B = 2
+    s = jax.ShapeDtypeStruct
+    args = (
+        s((B, 24), jnp.uint32), s((B, 24), jnp.uint32),
+        s((B, 2, 24), jnp.uint32), s((B, 2, 24), jnp.uint32),
+    )
+
+    def fn(xp, yp, qx, qy):
+        f = bls12_batch.miller_loop(xp, yp, qx, qy)
+        mask = _inflate(jnp.all(f >= 0, axis=(-1, -2, -3, -4)), xp, FB.F.mul)
+        return f, mask
+
+    cal = (FB.F.mul, (s((1, 24), jnp.uint32), s((1, 24), jnp.uint32)), 1)
+    return fn, (), dict(zip(("xp", "yp", "qx", "qy"), args)), B, cal
+
+
+def _spec_bls12_final_exp():
+    import jax
+    import jax.numpy as jnp
+
+    from . import bls12_batch
+    from . import field_bls12 as FB
+
+    B = 2
+    s = jax.ShapeDtypeStruct
+    f_in = s((B, 2, 3, 2, 24), jnp.uint32)
+
+    def fn(f):
+        out = bls12_batch.final_exponentiation(f)
+        mask = _inflate(
+            jnp.all(out >= 0, axis=(-1, -2, -3, -4)), f[..., 0, 0, 0, :],
+            FB.F.mul,
+        )
+        return out, mask
+
+    cal = (FB.F.mul, (s((1, 24), jnp.uint32), s((1, 24), jnp.uint32)), 1)
+    return fn, (), {"f": f_in}, B, cal
+
+
 _SPECS: Dict[str, Callable] = {
     "ed25519_xla": _spec_ed25519_xla,
     "ed25519_pallas": _spec_ed25519_pallas,
     "ecdsa_secp256r1_xla": _spec_ecdsa_secp256r1_xla,
+    "bls12_miller_loop": _spec_bls12_miller_loop,
+    "bls12_final_exp": _spec_bls12_final_exp,
 }
 KERNEL_NAMES: Tuple[str, ...] = tuple(_SPECS)
 assert KERNEL_NAMES == OPBUDGET_KERNELS, (

@@ -161,6 +161,7 @@ class MockNetwork:
         self,
         n_members: int = 4,
         cluster_name: str = "O=BFT Notary,L=Zurich,C=CH",
+        vote_scheme: str = "ed25519",
     ):
         """Byzantine notary cluster: every member runs a PBFT replica of
         the commit log; commits carry f+1 replica signatures over the tx
@@ -168,11 +169,16 @@ class MockNetwork:
         client validates (reference BFTNonValidatingNotaryService +
         BFTSMaRt response extractor).
 
+        vote_scheme="bls" runs the AGGREGATING committee (dev BLS keys +
+        proofs of possession distributed to every replica): prepare votes
+        are BLS-signed and commit certification is one aggregate check
+        per block instead of per-vote verifies (docs/bls-aggregation.md).
+
         Returns (cluster_party, [member_nodes], bft_bus).
         """
         from collections import deque
 
-        from ..node.bft import BFTClient, BFTReplica
+        from ..node.bft import BFTClient, BFTReplica, dev_bls_committee
         from ..node.database import NodeDatabase
         from ..node.notary import BFTUniquenessProvider
 
@@ -259,6 +265,9 @@ class MockNetwork:
                     )
                 return sign_tx
 
+            bls_sks = bls_pubs = bls_pops = None
+            if vote_scheme == "bls":
+                bls_sks, bls_pubs, bls_pops = dev_bls_committee(len(members))
             for i, m in enumerate(members):
                 apply_fn, snap_fn, rest_fn, meta = (
                     BFTUniquenessProvider.make_replica_state(
@@ -270,9 +279,14 @@ class MockNetwork:
                         i, len(members), make_transport(i), apply_fn,
                         make_reply(i), snapshot_fn=snap_fn,
                         restore_fn=rest_fn, meta_store=meta,
+                        bls_signing_key=(
+                            bls_sks[i] if bls_sks is not None else None
+                        ),
+                        replica_bls_pubs=bls_pubs,
+                        replica_bls_pops=bls_pops,
                     )
                 )
-            return BFTUniquenessProvider(bus.client)
+            return BFTUniquenessProvider(bus.client, replicas=bus.replicas)
 
         f = (n_members - 1) // 3
         cluster, members = self._assemble_cluster(

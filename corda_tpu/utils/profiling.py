@@ -86,7 +86,10 @@ ED25519_BUCKET_LABELS = tuple(
 #: the op-budget kernel registry names (mirrored by ops/opbudget.py,
 #: which asserts the two stay in sync; HERE so gauge registration stays
 #: jax-free)
-OPBUDGET_KERNELS = ("ed25519_xla", "ed25519_pallas", "ecdsa_secp256r1_xla")
+OPBUDGET_KERNELS = (
+    "ed25519_xla", "ed25519_pallas", "ecdsa_secp256r1_xla",
+    "bls12_miller_loop", "bls12_final_exp",
+)
 
 _dispatch_lock = threading.Lock()
 _dispatch_stats: Dict[str, Dict[str, float]] = {}

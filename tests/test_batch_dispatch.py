@@ -321,6 +321,59 @@ def test_pin_reflects_engine_availability(monkeypatch):
     assert crypto_batch._ed25519_rule() == "cofactored"
 
 
+def test_mixed_ed25519_bls_batch_groups_by_scheme_id():
+    """Satellite (round 12): submitted items are grouped by
+    scheme_number_id before dispatch — a BLS group rides the host path
+    next to the ed25519 bucket and every verdict stays positional."""
+    from corda_tpu.core.crypto.schemes import BLS_BLS12381
+
+    ed = _items([EDDSA_ED25519_SHA512] * 3, tamper_idx={1})
+    bls_kp = crypto.generate_keypair(BLS_BLS12381)
+    bls_sig = crypto.do_sign(bls_kp.private, b"bls vote")
+    items = [
+        ed[0],
+        (bls_kp.public, bls_sig, b"bls vote"),
+        ed[1],
+        (bls_kp.public, bls_sig, b"tampered vote"),
+        ed[2],
+    ]
+    assert crypto_batch.verify_batch(items) == [
+        True, True, False, False, True,
+    ]
+
+
+def test_unregistered_scheme_degrades_per_group_not_per_batch():
+    """An id this build has never heard of (a NEWER peer's scheme) must
+    cost its OWN group a False verdict — before the scheme grouping one
+    such row raised out of verify_batch and poisoned the whole batch."""
+    from corda_tpu.core.crypto.keys import SchemePublicKey
+
+    good = _items([EDDSA_ED25519_SHA512] * 2)
+    future = (SchemePublicKey("SCHEME_FROM_THE_FUTURE", b"\x01" * 48),
+              b"\x00" * 64, b"payload")
+    out = crypto_batch.verify_batch([good[0], future, good[1]])
+    assert out == [True, False, True]
+
+
+def test_scheme_group_exception_degrades_only_that_group(monkeypatch):
+    """A scheme whose host verify RAISES (half-landed implementation,
+    broken native lib) fails its group closed; co-batched schemes keep
+    their verdicts."""
+    from corda_tpu.core.crypto import bls_math
+    from corda_tpu.core.crypto.schemes import BLS_BLS12381
+
+    ed = _items([EDDSA_ED25519_SHA512] * 2, tamper_idx={1})
+    kp = crypto.generate_keypair(BLS_BLS12381)
+    sig = crypto.do_sign(kp.private, b"m")
+
+    def boom(*a, **k):
+        raise RuntimeError("BLS backend exploded")
+
+    monkeypatch.setattr(bls_math, "verify", boom)
+    out = crypto_batch.verify_batch([ed[0], (kp.public, sig, b"m"), ed[1]])
+    assert out == [True, False, False]
+
+
 def test_backend_probe_uses_subprocess_when_unpinned(monkeypatch):
     """The hang-proofing path itself (review finding r5): when the
     process is NOT cpu-pinned, resolution must go through a subprocess

@@ -97,10 +97,16 @@ def test_find_signature_scheme():
 
 
 def test_scheme_registry_matches_reference_ids():
-    # ids 1-6 with identical code names (reference Crypto.kt:176-183)
-    assert {s.scheme_number_id for s in c.SUPPORTED_SIGNATURE_SCHEMES.values()} == set(range(1, 7))
+    # ids 1-6 with identical code names (reference Crypto.kt:176-183);
+    # ids ABOVE 6 are framework extensions (7 = BLS_BLS12381, the
+    # aggregate scheme — the reference has no BLS) and must never
+    # collide with or renumber the reference block
+    ids = {s.scheme_number_id for s in c.SUPPORTED_SIGNATURE_SCHEMES.values()}
+    assert set(range(1, 7)) <= ids
+    assert ids - set(range(1, 7)) == {7}
     assert c.SUPPORTED_SIGNATURE_SCHEMES["EDDSA_ED25519_SHA512"].scheme_number_id == 4
     assert c.SUPPORTED_SIGNATURE_SCHEMES["SPHINCS-256_SHA512"].scheme_number_id == 5
+    assert c.SUPPORTED_SIGNATURE_SCHEMES["BLS_BLS12381"].scheme_number_id == 7
     assert c.DEFAULT_SIGNATURE_SCHEME is c.EDDSA_ED25519_SHA512
 
 

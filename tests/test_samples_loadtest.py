@@ -68,6 +68,30 @@ class TestLoadtest:
         assert result.commands_per_sec > 0
         nodes.network.stop_nodes()
 
+    def test_committee_consensus_aggregate_path(self):
+        """The round-12 committee scenario: a BLS notary committee
+        serves blocks with ONE aggregate check each, proven through the
+        scenario's own SLO machinery (docs/bls-aggregation.md)."""
+        from corda_tpu.loadtest.tests import CommitteeConsensusLoadTest
+
+        nodes = self._nodes(n=1)
+        result = CommitteeConsensusLoadTest(n_members=4).run(
+            nodes, iterations=2, parallelism=2,
+            slos={
+                "vote_scheme_bls": {"min": 1},
+                "vote_verifies": {"max": 0},
+                "agg_checks": {"min": 1},
+                "aggregate_speedup": {"min": 1.5},
+            },
+        )
+        assert result.consistent, result.errors
+        assert not result.errors, result.errors
+        assert result.slo_violations == [], result.slo_violations
+        m = result.metrics
+        assert m["blocks_notarised"] >= 2
+        assert m["naive_votes_avoided"] >= m["agg_checks"] * 3
+        nodes.network.stop_nodes()
+
     def test_stability_under_message_drop(self):
         nodes = self._nodes()
         result = StabilityLoadTest().run(

@@ -46,6 +46,29 @@ def run_one(blk: int, chunk: int, timeout: float, ecdsa_blk: int = 0,
     return rec
 
 
+def run_bls(blk: int, timeout: float) -> dict:
+    """One pairing-batch-size config: the bls12_batch microbench in a
+    fresh subprocess (BLK is read at import)."""
+    env = dict(os.environ)
+    env["CORDA_TPU_BLS12_BLK"] = str(blk)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "corda_tpu.ops.bls12_batch",
+             "--bench", "--blk", str(blk)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"blk": blk, "error": "timeout"}
+    line = next(
+        (ln for ln in out.stdout.splitlines() if ln.startswith("{")), None
+    )
+    if line is None:
+        return {"blk": blk, "error": (out.stderr or out.stdout)[-400:]}
+    return json.loads(line)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--blks", default="256,512,1024")
@@ -58,7 +81,30 @@ def main() -> int:
         "off: its scatter-add cannot lower on current Mosaic "
         "(docs/perf-roofline.md).",
     )
+    ap.add_argument(
+        "--bls-blks", default="",
+        help="comma-separated BLS12-381 pairing batch sizes to sweep "
+        "(CORDA_TPU_BLS12_BLK; e.g. 4,8,16,32). When given, the sweep "
+        "runs the bls12_batch aggregate-verify microbench INSTEAD of "
+        "the ed25519 bench matrix.",
+    )
     args = ap.parse_args()
+
+    if args.bls_blks:
+        results = []
+        for blk in (int(b) for b in args.bls_blks.split(",")):
+            rec = run_bls(blk, args.timeout)
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+        ok = [r for r in results if "value" in r]
+        if ok:
+            best = max(ok, key=lambda r: r["value"])
+            print(
+                f"# best: BLS12_BLK={best['blk']} -> "
+                f"{best['value']:,.1f} aggregate-verify rows/s "
+                f"({best['row_ms']} ms/row)"
+            )
+        return 0
 
     results = []
     for blk in (int(b) for b in args.blks.split(",")):
