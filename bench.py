@@ -23,6 +23,10 @@ import numpy as np
 
 BATCH = 131072  # two pipeline chunks
 PER_CHIP_BASELINE = 250_000.0  # 1M/s on 4 chips
+# notary shard count the system stage runs (and the fingerprint default
+# when the stage failed) — ONE knob so the stage, the failed-stage
+# fingerprint, and the policy string cannot drift apart
+SYSTEM_SHARDS = 4
 
 
 # One real dispatch proves the backend works end-to-end; shared with
@@ -294,8 +298,18 @@ def _measured_main(_quiesce) -> None:
     # attestation: what kind of window produced these numbers (the gate
     # refuses to hard-compare records whose fingerprints differ)
     record["quiesced"] = _quiesce.is_quiesced()
-    record["env_fingerprint"] = _quiesce.env_fingerprint()
     record.update(extras)
+    # fingerprint AFTER the stage keys merge: the system stage enables
+    # sharding by parameter (not env), and the topology it actually ran
+    # is part of what makes two records comparable. When the stage
+    # FAILED (no system_* keys) stamp the CONFIGURED topology — a
+    # missing/zero stamp would mismatch the baseline's and demote every
+    # unrelated regression to a warning, disarming the gate in exactly
+    # the rounds where a flaky system stage co-occurs with a real one.
+    record["env_fingerprint"] = _quiesce.env_fingerprint(
+        shards=record.get("system_shards", SYSTEM_SHARDS),
+        node_workers=record.get("system_node_workers", 0),
+    )
     print(json.dumps(record))
 
     if "--gate" in sys.argv:
@@ -560,6 +574,9 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     # (cordform network, TCP brokers, bridges, validating notary) — the
     # kernel->system gap metric (round-2 VERDICT #4). Saturation config
     # measured round 3; see docs/perf-system.md for the breakdown.
+    # SHARDING ENABLED from round 13 (docs/sharding.md): the notary runs
+    # the 4-shard partitioned uniqueness provider — `system_policy`
+    # records the config change so rounds compare like with like.
     # BEST OF TWO runs: the measurement window is seconds long on a
     # 1-core box that also hosts the capture daemon's periodic probes —
     # a probe landing inside one window halves that reading (observed:
@@ -571,20 +588,33 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         runs, failures = [], []
         for _ in range(2):
             try:
-                runs.append(loadtest_run(pairs=120, parallelism=8))
+                runs.append(loadtest_run(
+                    pairs=120, parallelism=8, shards=SYSTEM_SHARDS,
+                ))
             except Exception as exc:  # one failed launch must not sink
                 failures.append(f"{type(exc).__name__}: {exc}")
         if runs:
             best = max(
                 runs, key=lambda r: (r["errors"] == 0, r["pairs_per_sec"])
             )
+            # TWO names, ONE reading, on purpose: the trajectory key the
+            # driver has captured since round 2 (the stage now runs
+            # sharded), and the r13 stage name that pairs with
+            # `system_unsharded_pairs_s` below for the same-window A/B
             out["system_notarised_pairs_s"] = best["pairs_per_sec"]
+            out["system_sharded_pairs_s"] = best["pairs_per_sec"]
+            out["system_shards"] = best.get("shards", SYSTEM_SHARDS)
+            # the fingerprint stamps the topology the stage ACTUALLY ran
+            # (env_fingerprint reads this key, not the env var)
+            out["system_node_workers"] = best.get("node_workers", 0)
             # errors SUM across runs: a flaky window must stay visible
             # even when the clean window supplies the rate
             out["system_pairs_errors"] = sum(r["errors"] for r in runs)
-            # methodology changed in r5 (was ONE window at pairs=80);
-            # record it so rounds compare like with like
-            out["system_policy"] = "best-of-2 x 120 pairs"
+            # methodology changed in r5 (was ONE window at pairs=80) and
+            # again in r13 (notary shards=4)
+            out["system_policy"] = (
+                f"best-of-2 x 120 pairs, notary shards={SYSTEM_SHARDS}"
+            )
             out["system_runs_pairs_s"] = [
                 round(r["pairs_per_sec"], 2) for r in runs
             ]
@@ -592,8 +622,27 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
             out["system_run_failures"] = failures
         if not runs:
             out["system_error"] = failures[0]
+        # 1-shard comparator for the A/B (same box, same window): the
+        # unsharded notary config the rounds before r13 measured
+        try:
+            unsharded = loadtest_run(pairs=120, parallelism=8)
+            out["system_unsharded_pairs_s"] = unsharded["pairs_per_sec"]
+        except Exception as exc:
+            out["system_unsharded_error"] = f"{type(exc).__name__}: {exc}"
     except Exception as exc:
         out["system_error"] = f"{type(exc).__name__}: {exc}"
+
+    # Partitioned-commit A/B (docs/sharding.md §scale): 1 shard vs 4
+    # shards under 4 OS worker processes on the two-phase provider
+    # itself — what the partition structurally buys, isolated from the
+    # bank-side flow machinery that dominates the full-system number on
+    # a small box. Keys auto-gate (higher-is-better _commits_s).
+    try:
+        from corda_tpu.loadtest.shard_ab import measure_sharded_commit_ab
+
+        out.update(measure_sharded_commit_ab())
+    except Exception as exc:
+        out["sharded_ab_error"] = f"{type(exc).__name__}: {exc}"
     return out
 
 

@@ -120,6 +120,27 @@ class _Worker:
             pass
 
 
+def _find_raft_leader(nodes, n_members: int, degraded) -> int | None:
+    """The member index currently holding Raft leadership, read from
+    each live member's node_health() RPC (the notary health component
+    reports role/leader — PR-3)."""
+    for i in range(n_members):
+        if i in degraded:
+            continue
+        try:
+            conn = nodes[i].connect()
+            try:
+                health = conn.proxy.node_health()
+                detail = (health.get("checks") or {}).get("notary") or {}
+                if detail.get("role") == "leader":
+                    return i
+            finally:
+                conn.close()
+        except Exception:
+            continue
+    return None
+
+
 def run(
     duration: float = 600.0,
     seed: int = 7,
@@ -194,6 +215,14 @@ def run(
         events = []
         degraded = set()  # members whose relaunch failed: exclude (f=1!)
         kinds = ["suspend", "member_restart", "bankb_restart"]
+        if notary == "raft":
+            # the targeted worst case of member_restart: kill the member
+            # holding LEADERSHIP (a shard's consensus head in a sharded
+            # deployment — docs/sharding.md failure matrix), then assert
+            # the quorum re-elects and commits RESUME; the end-of-soak
+            # no-loss/no-dup check proves no double-spend was admitted
+            # through the election window
+            kinds.append("shard_leader_kill")
         if workers:
             kinds.append("worker_kill")
             # freeze EVERY worker at once: consumers stay registered but
@@ -202,6 +231,25 @@ def run(
             kinds.append("broker_partition")
         worker_kills = 0
         partitions = 0
+        leader_kills = 0
+
+        def relaunch(idx: int, role: str) -> bool:
+            """Launch-with-one-retry; a member that cannot come back
+            stays OUT of the rotation — a second concurrent member fault
+            would exceed f=1 and misattribute the resulting stall to the
+            system under test."""
+            for _ in range(2):
+                try:
+                    nodes[idx] = factory.launch(resolved[idx]["dir"])
+                    return True
+                except Exception:
+                    continue
+            degraded.add(idx)
+            if verbose:
+                print(role, idx, "failed to relaunch; "
+                      "excluded from rotation", flush=True)
+            return False
+
         while time.monotonic() < t_end:
             time.sleep(rng.uniform(12, 25))
             kind = rng.choice(kinds)
@@ -216,6 +264,10 @@ def run(
                 w.alive() for w in workers
             ):
                 kind = "bankb_restart"
+            if kind == "shard_leader_kill":
+                idx = _find_raft_leader(nodes, n_members, degraded)
+                if idx is None:  # election in flight: plain member kill
+                    kind = "member_restart"
             if kind in ("suspend", "member_restart"):
                 candidates = [
                     i for i in range(n_members) if i not in degraded
@@ -232,21 +284,27 @@ def run(
                 elif kind == "member_restart":
                     nodes[idx].kill()
                     time.sleep(rng.uniform(0.5, 3))
-                    try:
-                        nodes[idx] = factory.launch(resolved[idx]["dir"])
-                    except Exception:
-                        # one retry; a member that cannot come back stays
-                        # OUT of the rotation — a second concurrent member
-                        # fault would exceed f=1 and misattribute the
-                        # resulting stall to the system under test
-                        try:
-                            nodes[idx] = factory.launch(resolved[idx]["dir"])
-                        except Exception:
-                            degraded.add(idx)
-                            if verbose:
-                                print("member", idx, "failed to relaunch; "
-                                      "excluded from rotation", flush=True)
-                            continue
+                    if not relaunch(idx, "member"):
+                        continue
+                elif kind == "shard_leader_kill":
+                    before = len(driver.completed)
+                    nodes[idx].kill()
+                    leader_kills += 1
+                    time.sleep(rng.uniform(0.5, 2))
+                    # a failed relaunch does NOT skip the recovery
+                    # assertion: the remaining quorum must still serve
+                    relaunch(idx, "leader")
+                    # recovery assertion: the quorum re-elected and
+                    # commits RESUMED through the new leader (no-dup is
+                    # proven by the end-of-soak consistency check)
+                    redeadline = time.monotonic() + 180
+                    while len(driver.completed) < before + 2:
+                        assert time.monotonic() < redeadline, (
+                            "no pairs completed after a leader kill — "
+                            "the quorum did not re-elect"
+                        )
+                        time.sleep(0.3)
+                    idx = f"leader:{idx}+{len(driver.completed) - before}"
                 elif kind == "broker_partition":
                     frozen = [w for w in workers if w.alive()]
                     for w in frozen:
@@ -300,6 +358,12 @@ def run(
                     print("event:", events[-1], "completed:",
                           len(driver.completed), "errors:",
                           len(driver.errors), flush=True)
+            except AssertionError:
+                # a recovery assertion IS the soak's verdict (quorum
+                # re-elected / queue redistributed / supervisor caught
+                # up) — it must fail the run, never be logged away as a
+                # "failed disruption"
+                raise
             except Exception as exc:
                 if verbose:
                     print("disruption failed:", kind, idx, exc, flush=True)
@@ -319,6 +383,7 @@ def run(
             "verifier_workers": len(workers),
             "worker_kills": worker_kills,
             "broker_partitions": partitions,
+            "leader_kills": leader_kills,
             "driver_errors": len(driver.errors),
             "consistent": True,
         }

@@ -166,6 +166,58 @@ def overload_burst(burst: int = 64, probability: float = 0.2,
     return d
 
 
+def shard_leader_kill(buses, probability: float = 0.2) -> Disruption:
+    """Kill the CURRENT LEADER of one shard's consensus group (a sharded
+    notary runs one Raft group per shard — docs/sharding.md). The
+    targeted worst case of a member kill: the shard can serve nothing
+    until its quorum re-elects, while every OTHER shard keeps committing
+    (the partition's whole point). Heal revives the member; the provider
+    retries across the election, so commits resume with no double-spend
+    window (the dead leader's log prefix is what the new leader serves
+    from)."""
+    state = {}
+
+    def fire(rng, nodes):
+        bus = rng.choice(buses)
+        leader = bus.elect()
+        bus.kill(leader.node_id)
+        state["bus"], state["victim"] = bus, leader.node_id
+
+    def heal(rng, nodes):
+        bus = state.pop("bus", None)
+        if bus is not None:
+            bus.revive(state.pop("victim"))
+            bus.elect()
+
+    return Disruption("shard-leader-kill", fire, heal,
+                      probability=probability)
+
+
+def worker_process_kill(supervisor, probability: float = 0.2) -> Disruption:
+    """SIGKILL one OS worker process of a sharded node (shardhost). A
+    worker death is a TRANSIENT, not a loss: its unacked queue messages
+    redeliver to the respawn, whose state machine restores the dead
+    worker's checkpoint partition. The heal just waits for the
+    supervisor's monitor to bring the fleet back to strength."""
+    import time as _time
+
+    def fire(rng, nodes):
+        alive = [w for w in supervisor.workers if w.alive()]
+        if not alive:
+            return
+        rng.choice(alive).proc.kill()
+
+    def heal(rng, nodes):
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if all(w.alive() for w in supervisor.workers):
+                return
+            _time.sleep(0.2)
+
+    return Disruption("worker-process-kill", fire, heal,
+                      probability=probability)
+
+
 def clock_skew(delta_s: float = 3600.0) -> Disruption:
     """Skew a node's clock forward (time-window failures downstream)."""
     state = {}

@@ -45,19 +45,28 @@ def _hot_timers(metrics: dict, top: int = 12) -> dict:
 
 
 def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
-        profile: bool = False) -> dict:
+        profile: bool = False, shards: int = 0,
+        node_workers: int = 0) -> dict:
+    """`shards`: partition the notary's uniqueness provider into N
+    state-ref-keyed shards (docs/sharding.md; 0/1 = the unsharded
+    default). `node_workers`: run each BANK's flow/verify hot path in M
+    OS worker processes behind its broker (0 = single-process)."""
     from ..testing.smoketesting import Factory
     from ..tools.cordform import deploy_nodes
 
     base = tempfile.mkdtemp(prefix="loadtest-real-")
-    spec = {
-        "nodes": [
-            {"name": "O=LoadNotary,L=Zurich,C=CH", "notary": "validating",
-             "network_map_service": True},
-            {"name": "O=LoadBankA,L=London,C=GB"},
-            {"name": "O=LoadBankB,L=Paris,C=FR"},
-        ]
+    notary_entry = {
+        "name": "O=LoadNotary,L=Zurich,C=CH", "notary": "validating",
+        "network_map_service": True,
     }
+    bank_a = {"name": "O=LoadBankA,L=London,C=GB"}
+    bank_b = {"name": "O=LoadBankB,L=Paris,C=FR"}
+    if shards and int(shards) > 1:
+        notary_entry["shards"] = int(shards)
+    if node_workers and int(node_workers) > 0:
+        bank_a["node_workers"] = int(node_workers)
+        bank_b["node_workers"] = int(node_workers)
+    spec = {"nodes": [notary_entry, bank_a, bank_b]}
     resolved = deploy_nodes(spec, base)
     factory = Factory(base)
     nodes: List = []
@@ -132,6 +141,8 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
             "wall_s": round(wall, 2),
             "pairs_per_sec": round(done[0] / wall, 2) if wall else 0.0,
             "parallelism": parallelism,
+            "shards": int(shards) or 1,
+            "node_workers": int(node_workers),
         }
         if verbose and errors:
             result["first_error"] = errors[0]
@@ -163,9 +174,14 @@ def main(argv=None) -> int:
         help="attach the busiest per-topic P2P / RPC timers from bank A "
         "and the notary to the result",
     )
+    ap.add_argument("--shards", type=int, default=0,
+                    help="notary uniqueness shard count (docs/sharding.md)")
+    ap.add_argument("--node-workers", type=int, default=0,
+                    help="bank worker processes behind each broker")
     args = ap.parse_args(argv)
     print(json.dumps(run(
         args.pairs, args.parallelism, verbose=True, profile=args.profile,
+        shards=args.shards, node_workers=args.node_workers,
     )))
     return 0
 

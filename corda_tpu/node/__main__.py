@@ -36,6 +36,17 @@ def main(argv=None) -> int:
              "install the returned certificate chain, then exit "
              "(reference NodeStartup --initial-registration)",
     )
+    # multi-process sharding (docs/sharding.md): a supervisor process
+    # spawns `python -m corda_tpu.node <dir> --shard-worker K` children
+    # that attach to ITS broker over TCP and serve the flow/verify hot
+    # path with their own GIL each
+    ap.add_argument("--shard-worker", type=int, default=None,
+                    help="run as worker K of a sharded node (internal; "
+                         "spawned by the supervisor)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="total worker count (with --shard-worker)")
+    ap.add_argument("--broker-port", type=int, default=None,
+                    help="the supervisor's broker port (with --shard-worker)")
     args = ap.parse_args(argv)
 
     # Production nodes raise the cyclic-GC thresholds: flow/session/codec
@@ -69,6 +80,24 @@ def main(argv=None) -> int:
     from ..utils import eventlog
 
     eventlog.install_stdlib_bridge(capture_info=True)
+
+    if args.shard_worker is not None:
+        if args.broker_port is None:
+            print("error: --shard-worker requires --broker-port", flush=True)
+            return 2
+        if args.jax_platform:
+            os.environ.setdefault(
+                "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+            )
+            import jax
+
+            jax.config.update("jax_platforms", args.jax_platform)
+        from .shardhost import run_worker
+
+        return run_worker(
+            args.config_dir, args.shard_worker, args.workers or 1,
+            args.broker_port,
+        )
 
     def announce(msg: str, level: str = "info") -> None:
         """Startup lines are BOTH a launcher protocol (the driver greps
@@ -161,16 +190,66 @@ def main(argv=None) -> int:
         broker,
         remote_broker_factory=lambda h, p: RemoteBroker(h, p, client_wrap=client_wrap),
     )
+    # Sharded host (docs/sharding.md): node_workers > 0 turns this process
+    # into the SUPERVISOR — the flow/verify hot path runs in spawned
+    # worker processes behind this broker, and this node consumes only the
+    # ".sup" leg of its inbound queue (the router owns the bare one).
+    # Cluster members stay single-process: the Raft/BFT replica state
+    # machines are not multi-process safe.
+    n_workers = int(cfg.node.node_workers or 0)
+    sharded_host = (
+        n_workers > 0
+        and cfg.node.raft_cluster is None
+        and cfg.node.bft_cluster is None
+    )
+    if sharded_host:
+        # pin the node identity so every worker derives the SAME keypair
+        # (and a supervisor restart keeps it across runs)
+        ent_path = os.path.join(cfg.base_directory, "identity.entropy")
+        if cfg.node.identity_entropy is None:
+            if os.path.exists(ent_path):
+                with open(ent_path) as fh:
+                    cfg.node.identity_entropy = int(fh.read().strip())
+            else:
+                cfg.node.identity_entropy = int.from_bytes(
+                    os.urandom(24), "big"
+                )
+        with open(ent_path + ".tmp", "w") as fh:
+            fh.write(str(cfg.node.identity_entropy))
+        os.replace(ent_path + ".tmp", ent_path)
+    queue_suffix = ".sup" if sharded_host else ""
     node = AbstractNode(
         cfg.node,
-        messaging_factory=lambda me: BrokerMessagingService(broker, me, bridges),
+        messaging_factory=lambda me: BrokerMessagingService(
+            broker, me, bridges, queue_suffix=queue_suffix
+        ),
         broker=broker,
     )
+    supervisor = None
+    if sharded_host:
+        from .shardhost import ShardSupervisor, worker_tag_of
+
+        # the supervisor restores only UNTAGGED checkpoints from the
+        # shared db — a worker's live flows belong to its respawn
+        node.smm.checkpoint_filter = lambda fid: worker_tag_of(fid) is None
+        supervisor = ShardSupervisor(
+            broker, node, args.config_dir, n_workers, server.port,
+            bridges=bridges, jax_platform=cfg.jax_platform,
+            base_directory=cfg.base_directory,
+        )
     users = [
         RPCUser(u["username"], u["password"], set(u.get("permissions", ["ALL"])))
         for u in cfg.rpc_users
     ] or None
-    rpc = RPCServer(broker, CordaRPCOps(node.services, node.smm), users=users)
+    rpc_secret = None
+    if sharded_host:
+        from .shardhost import rpc_session_secret
+
+        # worker RPC servers compete on the same request queue: session
+        # tokens must verify on every sibling (rpc/server.py)
+        rpc_secret = rpc_session_secret(cfg.node.identity_entropy)
+    rpc = RPCServer(broker, CordaRPCOps(node.services, node.smm), users=users,
+                    session_secret=rpc_secret)
 
     netmap_service = None
     if cfg.network_map_service:
@@ -194,6 +273,10 @@ def main(argv=None) -> int:
             # service, a flow may immediately send to it.
             bridges.set_route(reg.party.name, reg.broker_address)
             node.register_peer(reg.party, reg.advertised_services)
+            if supervisor is not None:
+                # workers resolve peers through their control queues (the
+                # supervisor's egress pump owns the bridge routing)
+                supervisor.broadcast_peer(reg.party, reg.advertised_services)
 
         extra_identities = []
         if getattr(node, "cluster_party", None) is not None:
@@ -212,6 +295,15 @@ def main(argv=None) -> int:
         netmap_client.register_and_fetch()
 
     node.start()
+    if supervisor is not None:
+        supervisor.start()
+        if getattr(node, "ops_server", None) is not None:
+            # GET /workers: per-worker process state + aggregated healthz
+            node.ops_server.workers_view = supervisor.snapshot
+        announce(
+            f"shard supervisor: {n_workers} workers behind "
+            f"{cfg.broker_host}:{server.port}"
+        )
     # The port file doubles as the readiness signal (written only once RPC
     # and the state machine are serving), so external tooling can poll it.
     # ATOMIC rename: pollers must never observe a created-but-empty file
@@ -245,6 +337,8 @@ def main(argv=None) -> int:
             netmap_client.stop()
         if netmap_service is not None:
             netmap_service.stop()
+        if supervisor is not None:
+            supervisor.stop()
         bridges.stop()
         rpc.stop()
         node.stop()

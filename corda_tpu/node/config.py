@@ -52,6 +52,12 @@ DEFAULTS = {
     "admission_rate": None,
     "admission_burst": None,
     "admission_max_flows": None,
+    # horizontal scale (docs/sharding.md): notary uniqueness partition
+    # count (null = CORDA_TPU_SHARDS or unsharded) and the number of OS
+    # worker processes serving this node's flow/verify hot path behind
+    # its broker (null = CORDA_TPU_NODE_WORKERS or single-process)
+    "shards": None,
+    "node_workers": None,
 }
 
 
@@ -112,6 +118,16 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         admission_max_flows=(
             int(cfg["admission_max_flows"])
             if cfg.get("admission_max_flows") is not None else None
+        ),
+        shards=(
+            int(cfg["shards"]) if cfg.get("shards") is not None
+            else (int(os.environ["CORDA_TPU_SHARDS"])
+                  if os.environ.get("CORDA_TPU_SHARDS") else None)
+        ),
+        node_workers=(
+            int(cfg["node_workers"]) if cfg.get("node_workers") is not None
+            else (int(os.environ["CORDA_TPU_NODE_WORKERS"])
+                  if os.environ.get("CORDA_TPU_NODE_WORKERS") else None)
         ),
     )
     return FullNodeConfiguration(

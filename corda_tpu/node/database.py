@@ -26,11 +26,32 @@ from ..core.serialization.codec import deserialize, serialize
 class NodeDatabase:
     """Shared sqlite connection. path=':memory:' for tests/MockNetwork."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", synchronous: str = "NORMAL"):
+        """`synchronous`: sqlite durability level. "NORMAL" (default) is
+        the node-db setting of every prior round; the sharded notary's
+        per-shard COMMIT LOGS use "FULL" — a uniqueness commit that can
+        vanish on power loss is a double-spend waiting to be admitted
+        (docs/sharding.md §durability)."""
         self.path = path
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn = sqlite3.connect(path, check_same_thread=False,
+                                     timeout=30.0)
+        # busy-wait instead of instant OperationalError under contention:
+        # a sharded node's WORKER PROCESSES share this file (shardhost)
+        self._conn.execute("PRAGMA busy_timeout=30000")
+        # the journal-mode switch needs an exclusive lock that concurrent
+        # initialisers race for, and sqlite returns SQLITE_BUSY from it
+        # WITHOUT consulting the busy handler — retry explicitly
+        import time as _time
+
+        for attempt in range(200):
+            try:
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                break
+            except sqlite3.OperationalError:
+                if attempt == 199:
+                    raise
+                _time.sleep(0.01)
+        self._conn.execute(f"PRAGMA synchronous={synchronous}")
         self.lock = threading.RLock()
         # depth of open transaction() contexts on the holding thread:
         # per-statement autocommit is suppressed inside, so a batch

@@ -216,7 +216,10 @@ class InMemoryMessaging:
         self._handlers: Dict[str, List[Callable]] = {}
         self.running = True
 
-    def send(self, peer: Party, topic: str, payload: bytes) -> None:
+    def send(self, peer: Party, topic: str, payload: bytes,
+             headers: Optional[dict] = None) -> None:
+        # `headers` (e.g. the session route hint) only matter to a
+        # broker-side shard router; this in-memory transport has none
         self.network._enqueue(
             _InFlight(self.me, peer.name, topic, payload,
                       traceparent=tracing.current_traceparent())
@@ -265,10 +268,16 @@ class BrokerMessagingService:
     #: block (notary cluster commits) and must not wedge the pump thread
     ASYNC_FLOW_DISPATCH = True
 
-    def __init__(self, broker, me: Party, bridges=None):
+    def __init__(self, broker, me: Party, bridges=None,
+                 queue_suffix: str = ""):
         """`bridges`: optional BridgeManager — when it has a route for a
         peer, outbound messages go to its store-and-forward queue instead
-        of a local inbound queue (cross-process P2P)."""
+        of a local inbound queue (cross-process P2P).
+
+        `queue_suffix`: consume `p2p.inbound.<name><suffix>` instead of
+        the bare inbound queue — the shard supervisor (node/shardhost.py)
+        takes the bare queue for its router and hands this service the
+        ".sup" leg; workers consume their ".w<k>" legs the same way."""
         from ..core.serialization.codec import deserialize, serialize
 
         self._serialize = serialize
@@ -276,8 +285,13 @@ class BrokerMessagingService:
         self.broker = broker
         self.me = me
         self.bridges = bridges
-        self.queue_name = f"p2p.inbound.{me.name}"
-        broker.create_queue(self.queue_name, durable=broker._journal_dir is not None)
+        self.queue_name = f"p2p.inbound.{me.name}{queue_suffix}"
+        # RemoteBroker (worker processes) has no journal attribute: the
+        # owning broker process decides durability server-side
+        broker.create_queue(
+            self.queue_name,
+            durable=getattr(broker, "_journal_dir", None) is not None,
+        )
         self._bound_queue(self.queue_name)
         self._handlers: Dict[str, List[Callable]] = {}
         # Set by AbstractNode to the SMM registry: per-topic handler
@@ -334,7 +348,8 @@ class BrokerMessagingService:
         start()."""
         queue = f"p2p.inbound.{service_name}"
         self.broker.create_queue(
-            queue, durable=self.broker._journal_dir is not None
+            queue,
+            durable=getattr(self.broker, "_journal_dir", None) is not None,
         )
         self._bound_queue(queue)
         consumer = self.broker.create_consumer(queue)
@@ -347,9 +362,13 @@ class BrokerMessagingService:
         if self._thread.is_alive():  # started already: bring it up now
             thread.start()
 
-    def send(self, peer: Party, topic: str, payload: bytes) -> None:
+    def send(self, peer: Party, topic: str, payload: bytes,
+             headers: Optional[dict] = None) -> None:
+        extra = headers
         headers = {"topic": topic, "sender": self.me.name,
                    "sender_key": self.me.owning_key.encoded.hex()}
+        if extra:
+            headers.update(extra)
         traceparent = tracing.current_traceparent()
         if traceparent is not None:
             headers[tracing.TRACEPARENT_HEADER] = traceparent

@@ -66,6 +66,15 @@ class NodeConfiguration:
     admission_rate: Optional[float] = None
     admission_burst: Optional[float] = None
     admission_max_flows: Optional[int] = None
+    # Horizontal scale (docs/sharding.md): `shards` partitions the
+    # notary's uniqueness provider into N state-ref-keyed shards (one
+    # consensus group each, two-phase cross-shard commits); None/0/1 =
+    # the unsharded provider, byte-identical to every prior round.
+    # `node_workers` runs the flow/verify hot path in M OS worker
+    # processes behind this node's broker (standalone nodes only —
+    # MockNetwork ignores it; wired by node/__main__.py + shardhost.py).
+    shards: Optional[int] = None
+    node_workers: Optional[int] = None
 
 
 class AbstractNode:
@@ -452,7 +461,11 @@ class AbstractNode:
         return InMemoryTransactionVerifierService(batcher=SignatureBatcher())
 
     def _make_notary_service(self):
-        from .notary import SimpleNotaryService, ValidatingNotaryService
+        from .notary import (
+            SimpleNotaryService,
+            ValidatingNotaryService,
+            default_uniqueness_provider,
+        )
 
         if (self.config.notary_type or "").startswith("raft"):
             self._make_raft_notary_service()
@@ -460,14 +473,23 @@ class AbstractNode:
         if self.config.notary_type == "bft":
             self._make_bft_notary_service()
             return
+        # partitioned commit log when configured (node.conf "shards" /
+        # create_node(shards=) beats CORDA_TPU_SHARDS; None defers to it)
+        provider = default_uniqueness_provider(
+            self.database, shards=self.config.shards
+        )
         if self.config.notary_type == "validating":
-            self.notary_service = ValidatingNotaryService(self.services, self.info)
+            self.notary_service = ValidatingNotaryService(
+                self.services, self.info, uniqueness_provider=provider
+            )
             if NetworkMapCache.VALIDATING_NOTARY_SERVICE not in self.config.advertised_services:
                 self.config.advertised_services.append(
                     NetworkMapCache.VALIDATING_NOTARY_SERVICE
                 )
         else:
-            self.notary_service = SimpleNotaryService(self.services, self.info)
+            self.notary_service = SimpleNotaryService(
+                self.services, self.info, uniqueness_provider=provider
+            )
         self.services.notary_service = self.notary_service
         if NetworkMapCache.NOTARY_SERVICE not in self.config.advertised_services:
             self.config.advertised_services.append(NetworkMapCache.NOTARY_SERVICE)

@@ -88,15 +88,26 @@ class UniquenessProvider:
 
 class PersistentUniquenessProvider(UniquenessProvider):
     """Single-node commit log in the node DB. All-or-nothing batch commit
-    with conflict reporting (reference PersistentUniquenessProvider)."""
+    with conflict reporting (reference PersistentUniquenessProvider).
 
-    def __init__(self, db: NodeDatabase):
-        self._map = KVStore(db, "uniqueness")
+    `table` namespaces the commit log so a partitioned notary can run one
+    provider per shard over ONE database (sharded_notary.py)."""
+
+    def __init__(self, db: NodeDatabase, table: str = "uniqueness"):
+        self._map = KVStore(db, table)
         self._db = db
 
     @staticmethod
     def _key(ref: StateRef) -> bytes:
         return ref.txhash.bytes + ref.index.to_bytes(4, "big")
+
+    def probe_commits(self, keys) -> Dict[bytes, object]:
+        """{key: consuming tx id} for already-spent keys — the committed-
+        state read the sharded provider's cross-shard prepare runs."""
+        return {
+            k: deserialize(blob)["tx_id"]
+            for k, blob in self._map.get_many(keys).items()
+        }
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
         result = self.commit_many([(states, tx_id, requesting_party)])[0]
@@ -186,6 +197,16 @@ class RaftUniquenessProvider(UniquenessProvider):
         """Whether this REPLICA's applied log knows `ref` as spent —
         a replication observability hook (cluster tests, dryrun)."""
         return self._map.get(PersistentUniquenessProvider._key(ref)) is not None
+
+    def probe_commits(self, keys) -> Dict[bytes, object]:
+        """{key: consuming tx id} from this replica's APPLIED log — the
+        committed-state read behind a cross-shard prepare. Submit the
+        probe against the shard leader (the sharded provider routes
+        commits there anyway) for a linearizable-enough read."""
+        return {
+            k: deserialize(blob)["tx_id"]
+            for k, blob in self._map.get_many(keys).items()
+        }
 
     def apply(self, command: dict):
         """State-machine apply (runs on every replica, in log order)."""
@@ -539,20 +560,33 @@ class CoalescingUniquenessProvider(UniquenessProvider):
 
     def _drain(self) -> None:
         """Serve queued requests in max_batch rounds; caller must hold
-        the drainer role (self._draining True). Releases it on exit."""
+        the drainer role (self._draining True). Releases it on exit.
+
+        Shard-aware delegates (`shard_of`, e.g. ShardedUniquenessProvider)
+        get the batch pre-grouped by shard and one commit_many PER SHARD,
+        dispatched concurrently: the whole point of partitioned
+        uniqueness is that shards are independent consensus groups, so a
+        mixed coalesced batch must cost max-over-shards wall time, not
+        sum — and never serialise one round per REQUEST. The per-round
+        budget scales to max_batch PER SHARD for the same reason."""
+        sharded = getattr(self.delegate, "shard_of", None) is not None
+        n_shards = getattr(self.delegate, "n_shards", 1) if sharded else 1
+        per_round = self.max_batch * max(1, n_shards)
         while True:
             with self._lock:
-                batch = self._pending[: self.max_batch]
-                self._pending = self._pending[self.max_batch:]
+                batch = self._pending[:per_round]
+                self._pending = self._pending[per_round:]
                 if not batch:
                     self._draining = False
                     return
             sp = self._batch_span([c for _, _, _, c, _ in batch])
             t0 = time.perf_counter()
             try:
-                results = self.delegate.commit_many(
-                    [(s, t, p) for s, t, p, _, _ in batch]
-                )
+                requests = [(s, t, p) for s, t, p, _, _ in batch]
+                if sharded and len(batch) > 1:
+                    results = self._commit_many_by_shard(requests)
+                else:
+                    results = self.delegate.commit_many(requests)
             except BaseException as exc:
                 # fail this round's waiters; later arrivals get a fresh
                 # consensus attempt instead of inheriting the error
@@ -576,11 +610,107 @@ class CoalescingUniquenessProvider(UniquenessProvider):
                 wall_ms=round((time.perf_counter() - t0) * 1000, 3),
             )
             for (*_, fut), result in zip(batch, results):
-                fut.set_result(result)
+                if isinstance(result, BaseException):
+                    # a failed chunk's slots carry their error (other
+                    # chunks in the round may have committed durably)
+                    fut.set_exception(result)
+                else:
+                    fut.set_result(result)
+
+    def _commit_many_by_shard(self, requests):
+        """Partition one drained batch by the sharded delegate's routing
+        (cross-shard requests form their own group — they run the
+        two-phase protocol and must not ride a single-shard round) and
+        commit the groups CONCURRENTLY, demultiplexing positionally."""
+        groups: Dict[object, List[int]] = {}
+        for i, (states, _tx, _p) in enumerate(requests):
+            shards = self.delegate.shards_of(states)
+            key = shards[0] if len(shards) == 1 else "cross"
+            groups.setdefault(key, []).append(i)
+        if len(groups) == 1:
+            return self._commit_chunked(requests)
+        results: List = [None] * len(requests)
+
+        def run(indices: List[int]) -> None:
+            # the drain budget is max_batch PER SHARD: under skewed
+            # routing one group can hold most of the round, so chunk it
+            # back to max_batch per delegate round — one hot issuer must
+            # not inflate a single consensus round n_shards-fold
+            for j in range(0, len(indices), self.max_batch):
+                chunk = indices[j:j + self.max_batch]
+                try:
+                    for i, res in zip(chunk, self.delegate.commit_many(
+                        [requests[i] for i in chunk]
+                    )):
+                        results[i] = res
+                except BaseException as exc:
+                    # a delegate round is all-or-nothing per CALL (one
+                    # transaction / consensus round): only this chunk's
+                    # waiters inherit the error. Raising for the whole
+                    # drained batch would hand other groups' waiters an
+                    # error for commits that already landed DURABLY —
+                    # a flow treating that as final would abandon a tx
+                    # whose inputs are permanently consumed.
+                    for i in chunk:
+                        results[i] = exc
+
+        threads = [
+            threading.Thread(
+                target=run, args=(indices,), daemon=True,
+                name=f"uniq-shard-{key}",
+            )
+            for key, indices in groups.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
+    def _commit_chunked(self, requests) -> List:
+        """Delegate rounds of at most max_batch (a skewed drain that
+        landed on one shard still honours the per-round bound). A chunk
+        that raises poisons only ITS slots — earlier chunks' durable
+        commits keep their results (see _commit_many_by_shard)."""
+        if len(requests) <= self.max_batch:
+            return self.delegate.commit_many(requests)
+        out: List = []
+        for j in range(0, len(requests), self.max_batch):
+            chunk = requests[j:j + self.max_batch]
+            try:
+                out.extend(self.delegate.commit_many(chunk))
+            except BaseException as exc:
+                out.extend([exc] * len(chunk))
+        return out
 
     def __getattr__(self, name):
         # observability passthrough (is_consumed, member_providers, _map…)
         return getattr(self.delegate, name)
+
+
+def default_uniqueness_provider(db: NodeDatabase,
+                                shards: Optional[int] = None) -> UniquenessProvider:
+    """The notary's default commit log: partitioned across `shards`
+    independent per-shard providers when sharding is configured
+    (node.conf `shards`, `MockNetwork.create_node(shards=)`, or
+    `CORDA_TPU_SHARDS` — docs/sharding.md), else exactly the unsharded
+    PersistentUniquenessProvider of every round before this one.
+    shards None/0/1 keeps the default path byte-identical."""
+    if shards is None:
+        shards = int(os.environ.get("CORDA_TPU_SHARDS", "0") or 0)
+    if shards and int(shards) > 1:
+        from .sharded_notary import ShardedUniquenessProvider
+
+        if db.path != ":memory:":
+            # file-backed node: one sqlite file per shard so commits
+            # parallelise across OS workers (per-database write locks),
+            # coordination state in the shared node db
+            return ShardedUniquenessProvider.over_directory(
+                db, os.path.join(os.path.dirname(db.path), "shards"),
+                int(shards),
+            )
+        return ShardedUniquenessProvider.over_database(db, int(shards))
+    return PersistentUniquenessProvider(db)
 
 
 def maybe_coalesced(provider: UniquenessProvider) -> UniquenessProvider:
@@ -609,7 +739,7 @@ class NotaryService:
         self.services = services
         self.identity = identity
         self.uniqueness_provider = maybe_coalesced(
-            uniqueness_provider or PersistentUniquenessProvider(services.db)
+            uniqueness_provider or default_uniqueness_provider(services.db)
         )
 
     def validate_time_window(self, time_window: Optional[TimeWindow]) -> None:

@@ -208,6 +208,9 @@ class OpsServer(MiniWebServer):
         self.hospital = hospital  # node.hospital.FlowHospital (optional)
         self.admission = admission  # node.admission.AdmissionController
         self.overload = overload  # node.admission.OverloadStateMachine
+        # sharded hosts attach their supervisor's snapshot() here so
+        # GET /workers aggregates per-worker state (node/shardhost.py)
+        self.workers_view = None
         super().__init__(host=host, port=port)
 
     @property
@@ -274,6 +277,12 @@ class OpsServer(MiniWebServer):
                     if self.overload is not None else None
                 ),
             }
+        if path == "/workers":
+            if self.workers_view is None:
+                raise KeyError(path)  # not a sharded host: 404
+            return 200, self.workers_view(
+                probe_workers=query.get("probe") != "0"
+            )
         if path == "/profile":
             return self._profile(query)
         if path == "/opbudget":

@@ -549,23 +549,64 @@ class VaultService:
         ]
 
     def soft_lock_reserve(self, lock_id: str, refs: List[StateRef]) -> None:
+        """All-or-nothing reservation. The guard rides INSIDE each UPDATE
+        (compare-and-swap on lock_id + consumed) so the reserve is atomic
+        per sqlite statement — a sharded node's worker PROCESSES share
+        this table, and a check-then-update under the in-process db.lock
+        let two workers double-select the same cash state."""
         with self.db.lock:
+            taken: List[StateRef] = []
             for ref in refs:
-                rows = self.db.query(
-                    "SELECT lock_id FROM vault_states "
-                    "WHERE tx_id = ? AND output_index = ? AND consumed = 0",
-                    (ref.txhash.bytes, ref.index),
-                )
-                if not rows:
+                won, rows = False, None
+                for retry in (True, False):
+                    cur = self.db.execute(
+                        "UPDATE vault_states SET lock_id = ? "
+                        "WHERE tx_id = ? AND output_index = ? "
+                        "AND consumed = 0 AND lock_id IS NULL",
+                        (lock_id, ref.txhash.bytes, ref.index),
+                    )
+                    if cur.rowcount == 1:
+                        taken.append(ref)
+                        won = True
+                        break
+                    rows = self.db.query(
+                        "SELECT lock_id, consumed FROM vault_states "
+                        "WHERE tx_id = ? AND output_index = ?",
+                        (ref.txhash.bytes, ref.index),
+                    )
+                    if rows and not rows[0][1] and rows[0][0] == lock_id:
+                        # already ours from an earlier reserve under this
+                        # lock_id: a success, but NOT ours to roll back —
+                        # a failed widening must leave the original
+                        # holding
+                        won = True
+                        break
+                    if not (retry and rows and not rows[0][1]
+                            and rows[0][0] is None):
+                        break
+                    # CAS missed yet the diagnostic re-read shows the
+                    # state free: the holder (a sibling worker PROCESS —
+                    # db.lock covers only this process) released between
+                    # the two statements. Retry the CAS instead of
+                    # failing the flow with a spurious "locked by None".
+                if won:
+                    continue
+                # failed: roll back what THIS call acquired, then name
+                # the reason (consumed / missing / locked by another)
+                for prev in taken:
+                    self.db.execute(
+                        "UPDATE vault_states SET lock_id = NULL "
+                        "WHERE tx_id = ? AND output_index = ? AND lock_id = ?",
+                        (prev.txhash.bytes, prev.index, lock_id),
+                    )
+                if not rows or rows[0][1]:
                     raise StatesNotAvailableError(f"{ref} not unconsumed")
-                if rows[0][0] is not None and rows[0][0] != lock_id:
-                    raise StatesNotAvailableError(f"{ref} locked by {rows[0][0]}")
-            for ref in refs:
-                self.db.execute(
-                    "UPDATE vault_states SET lock_id = ? "
-                    "WHERE tx_id = ? AND output_index = ?",
-                    (lock_id, ref.txhash.bytes, ref.index),
-                )
+                if rows[0][0] is None:
+                    raise StatesNotAvailableError(
+                        f"{ref} contended (reservation raced sibling "
+                        "workers)"
+                    )
+                raise StatesNotAvailableError(f"{ref} locked by {rows[0][0]}")
 
     def soft_lock_release(self, lock_id: str, refs: Optional[List[StateRef]] = None) -> None:
         with self.db.lock:

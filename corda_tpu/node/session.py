@@ -70,6 +70,28 @@ for cls, name, fields in [
     )
 
 
+#: broker header carrying the session-routing hint (below); a sharded
+#: node's router dispatches on it without deserializing the payload
+ROUTE_HINT_HEADER = "x-session-route"
+
+
+def route_hint(msg) -> Optional[str]:
+    """Routing hint the SENDER stamps into broker headers so a sharded
+    receiver's router (shardhost.ShardRouter) can pick the worker
+    without codec-deserializing every payload on its one thread:
+    "h:<sid>" = stable-hash this id across workers (SessionInit — no
+    local owner yet), "t:<sid>" = the id carries the owning worker's
+    tag (`w<k>-` prefix, or none ⇒ supervisor). Messages without the
+    header (older senders) fall back to payload decode."""
+    if isinstance(msg, SessionInit):
+        return "h:" + msg.initiator_session_id
+    if isinstance(msg, (SessionData, SessionEnd)):
+        return "t:" + msg.recipient_session_id
+    if isinstance(msg, (SessionConfirm, SessionReject)):
+        return "t:" + msg.initiator_session_id
+    return None
+
+
 class SessionState(enum.Enum):
     INITIATING = "initiating"  # init sent, awaiting confirm
     INITIATED = "initiated"

@@ -1,0 +1,1220 @@
+"""Partitioned uniqueness provider: state-ref-keyed shards + two-phase
+cross-shard notarisation (docs/sharding.md).
+
+The round-11 profile pinned the system ceiling on one core: ~25 threads
+convoy behind one GIL, and every uniqueness commit — however batched —
+funnels through ONE consensus group. This module partitions uniqueness
+consensus itself (ROADMAP item 2; PAPERS' "Scalable Multi-domain Trust
+Infrastructures for Segmented Networks" motivates the segmented
+topology):
+
+  * each consumed StateRef routes to one of N shards by a STABLE hash of
+    its commit-log key (sha256 — `hash()` is salted per process and the
+    routing must agree across OS workers and restarts);
+  * every shard is one independent consensus group — any existing
+    provider implementing `commit_many` (Persistent, Raft, BFT) serves
+    as the per-shard delegate, so a shard can be a replicated cluster;
+  * a transaction whose inputs all land on one shard commits in ONE
+    round via that shard's `commit_many` batch seam — the common case
+    (issue+pay pairs spend freshly-issued refs, which hash together only
+    by accident 1/N of the time);
+  * a cross-shard transaction runs a TWO-PHASE protocol: prepare
+    reserves its refs on every touched shard (tx-scoped lock + expiry),
+    then a second round finalises — or releases, because a conflict or
+    prepare-timeout on ANY shard aborts ALL of them. The prepare journal
+    makes the coordinator crash-safe: recovery re-drives a commit that
+    had passed its prepare point and releases anything that hadn't, so a
+    dead coordinator never wedges a state-ref (its reservations also die
+    by expiry even with no recovery pass).
+
+Reservations are PER-SHARD state: a key routes to exactly one shard, so
+each shard's lock table lives in that shard's own database (falling back
+to the shared coordination db, then process memory, when a delegate has
+no database of its own). That placement is what lets M worker PROCESSES
+(node/shardhost) serve one notary identity WITHOUT serialising every
+commit round through one coordination-db write lock: a shard's
+reservation screen, conflict check and delegate commit run as ONE write
+transaction on that shard's file, atomic against any other process's
+round or prepare on the same shard — and fully parallel across shards.
+The coordination db keeps only the prepare journal (cross-shard rounds,
+~2% of the production spend shape).
+
+The unsharded path is untouched: nothing here is imported unless
+`CORDA_TPU_SHARDS` / node.conf `shards` / `create_node(shards=)` asks
+for more than one shard.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.contracts.structures import StateRef
+from ..core.crypto.secure_hash import SecureHash
+from ..core.serialization.codec import deserialize, serialize
+from ..utils import eventlog, faultpoints
+from .notary import (
+    Conflict,
+    PersistentUniquenessProvider,
+    UniquenessException,
+    UniquenessProvider,
+)
+
+#: default reservation lifetime: a crashed coordinator's locks release
+#: themselves after this long even if no recovery pass ever runs
+DEFAULT_PREPARE_TTL_S = 30.0
+
+
+class CoordinatorCrashError(RuntimeError):
+    """Raised by the `sharded.prepare` / `sharded.finalise` fault points'
+    "crash" action: simulates the coordinator dying mid-protocol with its
+    reservations and journal record left behind (recovery-test seam)."""
+
+
+def _key_of(ref: StateRef) -> bytes:
+    return PersistentUniquenessProvider._key(ref)
+
+
+def shard_of_key(key: bytes, n_shards: int) -> int:
+    """Stable shard routing: sha256, not `hash()` (which is salted per
+    process — OS workers and restarts must agree on the partition).
+
+    Routes on the SOURCE TXHASH (key[:32]), not the full txhash+index
+    key: every spend of a given ref still lands on one shard (conflict
+    detection is per-ref and both spenders hash the same 32 bytes), but
+    all outputs of one source transaction CO-LOCATE — so the common
+    spend shape (inputs gathered from one issuing/previous tx) commits
+    in ONE single-shard round, and the two-phase protocol is reserved
+    for genuinely scattered input sets (docs/sharding.md §routing)."""
+    return int.from_bytes(
+        hashlib.sha256(key[:32]).digest()[:8], "big"
+    ) % n_shards
+
+
+# ---------------------------------------------------------------------------
+# Reservation store (the tx-scoped lock table)
+# ---------------------------------------------------------------------------
+
+class ReservationStore:
+    """key -> (holding tx, expiry). One per SHARD: in-memory for
+    single-process shards; sqlite-backed — in the shard delegate's own
+    database — for multi-process workers, where `INSERT OR IGNORE`'s
+    per-statement atomicity (and, on the fused round path, the shard
+    file's single write lock) arbitrates races."""
+
+    def __init__(self, db=None, table: str = "shard_reservations"):
+        self._db = db
+        self._table = table
+        self._mem: Dict[bytes, Tuple[str, float]] = {}
+        # guards _mem: callers race (the coalescing layer drains shard
+        # groups in concurrent threads, and abort/recovery releases run
+        # outside the provider's per-shard commit lock); sqlite
+        # serialises the db path itself
+        self._mem_lock = threading.Lock()
+        if db is not None:
+            db.execute(
+                f"CREATE TABLE IF NOT EXISTS {table} "
+                "(key BLOB PRIMARY KEY, tx TEXT NOT NULL, "
+                "expires REAL NOT NULL)"
+            )
+
+    def holders(self, keys: Sequence[bytes], now: float) -> Dict[bytes, str]:
+        """{key: holding tx hex} for unexpired reservations on `keys`.
+        One IN-clause query per 500 keys, not one per key — this screen
+        runs inside EVERY single-shard commit round."""
+        out: Dict[bytes, str] = {}
+        if self._db is not None:
+            keys = list(keys)
+            for i in range(0, len(keys), 500):
+                chunk = keys[i:i + 500]
+                marks = ",".join("?" * len(chunk))
+                for key, tx, expires in self._db.query(
+                    f"SELECT key, tx, expires FROM {self._table} "
+                    f"WHERE key IN ({marks})",
+                    tuple(chunk),
+                ):
+                    if expires > now:
+                        out[bytes(key)] = tx
+            return out
+        with self._mem_lock:
+            for key in keys:
+                held = self._mem.get(key)
+                if held is not None and held[1] > now:
+                    out[key] = held[0]
+        return out
+
+    def reserve(self, keys: Sequence[bytes], tx_hex: str, expires: float,
+                now: float) -> Dict[bytes, str]:
+        """Atomically try to reserve every key for `tx_hex`. Returns the
+        conflicts ({key: other tx}); on ANY conflict nothing stays
+        reserved (all-or-nothing, so a failed prepare leaves no locks).
+        Expired rows are evicted, never counted as conflicts."""
+        lost = self.reserve_many({tx_hex: list(keys)}, expires, now)
+        return lost.get(tx_hex, {})
+
+    def reserve_many(self, tx_keys: Dict[str, Sequence[bytes]],
+                     expires: float, now: float,
+                     ) -> Dict[str, Dict[bytes, str]]:
+        """Reserve every tx's keys in ONE storage transaction (the
+        two-phase prepare runs per-ROUND, not per-tx — a drained batch of
+        cross-shard commits pays one coordination-db write per shard).
+        Returns {tx_hex: {key: holding tx}} for the txs that LOST —
+        losers keep nothing on this shard; within-batch contention on a
+        key is decided by insert order. Expired rows are evicted first,
+        never counted as conflicts."""
+        lost: Dict[str, Dict[bytes, str]] = {}
+        if self._db is not None:
+            with self._db.transaction():
+                self._db.execute(
+                    f"DELETE FROM {self._table} WHERE expires <= ?", (now,)
+                )
+                self._db.executemany(
+                    f"INSERT OR IGNORE INTO {self._table} "
+                    "(key, tx, expires) VALUES (?, ?, ?)",
+                    [(k, tx, expires)
+                     for tx, keys in tx_keys.items() for k in keys],
+                )
+                all_keys = [
+                    k for keys in tx_keys.values() for k in keys
+                ]
+                held = self.holders(all_keys, now)
+                victims = []
+                for tx, keys in tx_keys.items():
+                    bad = {
+                        k: held[k] for k in keys
+                        if k in held and held[k] != tx
+                    }
+                    if bad:
+                        lost[tx] = bad
+                        victims.extend((k, tx) for k in keys)
+                if victims:
+                    self._db.executemany(
+                        f"DELETE FROM {self._table} WHERE key=? AND tx=?",
+                        victims,
+                    )
+            return lost
+        with self._mem_lock:
+            for tx, keys in tx_keys.items():
+                bad = {}
+                for k in keys:
+                    held = self._mem.get(k)
+                    if held is not None and held[1] > now and held[0] != tx:
+                        bad[k] = held[0]
+                if bad:
+                    lost[tx] = bad
+                else:
+                    for k in keys:
+                        self._mem[k] = (tx, expires)
+        return lost
+
+    def extend(self, keys: Sequence[bytes], tx_hex: str,
+               new_expires: float) -> int:
+        """Push `tx_hex`'s reservations ON `keys` to a later expiry and
+        return HOW MANY rows moved. The cross-shard decision point calls
+        this per shard before flipping the journal to "committing": a
+        shortfall against the key count means expiry already released a
+        key (a sibling's purge may have let a competitor in), so the
+        caller must abort that tx instead of finalising a torn commit.
+        Scoped to `keys` — NOT a bare WHERE tx=? — because over_database
+        mode backs every shard's store with the same table, where a
+        tx-wide UPDATE would count its OTHER shards' rows and mask a
+        loss. The UPDATE races sibling purges safely: sqlite serialises
+        the writers, so either the purge ran first (we count the loss)
+        or the extension ran first (the purge no longer matches)."""
+        if self._db is not None:
+            keys = list(keys)
+            n = 0
+            for i in range(0, len(keys), 500):
+                chunk = keys[i:i + 500]
+                marks = ",".join("?" * len(chunk))
+                n += self._db.execute(
+                    f"UPDATE {self._table} SET expires=? "
+                    f"WHERE tx=? AND key IN ({marks})",
+                    (new_expires, tx_hex, *chunk),
+                ).rowcount
+            return n
+        n = 0
+        with self._mem_lock:
+            for k in keys:
+                held = self._mem.get(k)
+                if held is not None and held[0] == tx_hex:
+                    self._mem[k] = (tx_hex, new_expires)
+                    n += 1
+        return n
+
+    def release(self, keys: Sequence[bytes], tx_hex: str) -> None:
+        """Release `tx_hex`'s reservations on `keys` (others' are never
+        touched — a slow abort must not unlock a successor's prepare)."""
+        if self._db is not None:
+            self._db.executemany(
+                f"DELETE FROM {self._table} WHERE key=? AND tx=?",
+                [(k, tx_hex) for k in keys],
+            )
+            return
+        with self._mem_lock:
+            for k in keys:
+                held = self._mem.get(k)
+                if held is not None and held[0] == tx_hex:
+                    del self._mem[k]
+
+    def release_pairs(self, pairs: Sequence[Tuple[bytes, str]]) -> None:
+        """Release many (key, holding tx) reservations in one statement
+        (the per-round finalise)."""
+        if self._db is not None:
+            self._db.executemany(
+                f"DELETE FROM {self._table} WHERE key=? AND tx=?",
+                list(pairs),
+            )
+            return
+        with self._mem_lock:
+            for k, tx in pairs:
+                held = self._mem.get(k)
+                if held is not None and held[0] == tx:
+                    del self._mem[k]
+
+    def release_tx(self, tx_hex: str) -> int:
+        """Release EVERY reservation held by `tx_hex` (recovery path)."""
+        if self._db is not None:
+            cur = self._db.execute(
+                f"DELETE FROM {self._table} WHERE tx=?", (tx_hex,)
+            )
+            return cur.rowcount
+        with self._mem_lock:
+            victims = [k for k, (t, _) in self._mem.items() if t == tx_hex]
+            for k in victims:
+                del self._mem[k]
+        return len(victims)
+
+    def purge_expired(self, now: float) -> int:
+        if self._db is not None:
+            return self._db.execute(
+                f"DELETE FROM {self._table} WHERE expires <= ?", (now,)
+            ).rowcount
+        with self._mem_lock:
+            victims = [
+                k for k, (_, exp) in self._mem.items() if exp <= now
+            ]
+            for k in victims:
+                del self._mem[k]
+        return len(victims)
+
+
+class _ReservationsView:
+    """Maintenance/observability facade over the per-shard lock tables
+    (tests, recovery): `holders` merges across shards, release/purge fan
+    out to every store. Routing stays with the provider — this view
+    never decides which shard a key belongs to."""
+
+    def __init__(self, stores: Sequence[ReservationStore]):
+        self._stores = list(stores)
+
+    def holders(self, keys: Sequence[bytes], now: float) -> Dict[bytes, str]:
+        out: Dict[bytes, str] = {}
+        for s in self._stores:
+            out.update(s.holders(keys, now))
+        return out
+
+    def release(self, keys: Sequence[bytes], tx_hex: str) -> None:
+        for s in self._stores:
+            s.release(keys, tx_hex)
+
+    def release_tx(self, tx_hex: str) -> int:
+        # stores sharing one db handle (over_database) dedupe naturally:
+        # the first DELETE empties the shared table, the rest count 0
+        return sum(s.release_tx(tx_hex) for s in self._stores)
+
+    def purge_expired(self, now: float) -> int:
+        return sum(s.purge_expired(now) for s in self._stores)
+
+
+# ---------------------------------------------------------------------------
+# Prepare journal (coordinator crash recovery)
+# ---------------------------------------------------------------------------
+
+class PrepareJournal:
+    """tx -> {phase, keys per shard, expiry}. The write ORDER is the
+    protocol: the record exists before any reservation is taken (so
+    recovery can always find what to release), flips to "committing"
+    only once every shard prepared (so recovery knows the commit is
+    decided and must be re-driven, never rolled back), and is removed
+    only after every shard finalised."""
+
+    def __init__(self, db=None, table: str = "shard_prepare_journal"):
+        self._db = db
+        self._mem: Dict[str, dict] = {}
+        if db is not None:
+            from .database import KVStore
+
+            self._kv = KVStore(db, table)
+            # the db's resting durability level (0=OFF 1=NORMAL 2=FULL
+            # 3=EXTRA): put() raises it around the "committing" flip
+            row = db.query("PRAGMA synchronous")
+            self._sync_level = int(row[0][0]) if row else 1
+
+    def put(self, tx_hex: str, record: dict) -> None:
+        if self._db is not None:
+            if record.get("phase") == "committing" and self._sync_level < 2:
+                # The DECISION record. The per-shard commit logs run
+                # synchronous=FULL while the coordination db keeps the
+                # node default (NORMAL), whose WAL commits can vanish on
+                # power loss — recovery would then read the stale
+                # "prepare" record and abort a round one shard already
+                # durably finalised (a torn commit). Make exactly this
+                # write as durable as the commits it orders.
+                with self._db.lock:
+                    self._db.execute("PRAGMA synchronous=FULL")
+                    try:
+                        self._kv.put(tx_hex.encode(), serialize(record))
+                    finally:
+                        self._db.execute(
+                            f"PRAGMA synchronous={self._sync_level}"
+                        )
+            else:
+                self._kv.put(tx_hex.encode(), serialize(record))
+        else:
+            self._mem[tx_hex] = dict(record)
+
+    def get(self, tx_hex: str) -> Optional[dict]:
+        if self._db is not None:
+            blob = self._kv.get(tx_hex.encode())
+            return None if blob is None else deserialize(blob)
+        rec = self._mem.get(tx_hex)
+        return dict(rec) if rec is not None else None
+
+    def remove(self, tx_hex: str) -> None:
+        if self._db is not None:
+            self._kv.delete(tx_hex.encode())
+        else:
+            self._mem.pop(tx_hex, None)
+
+    def items(self) -> List[Tuple[str, dict]]:
+        if self._db is not None:
+            return [
+                (bytes(k).decode(), deserialize(v))
+                for k, v in self._kv.items()
+            ]
+        # list() snapshots first: recovery may scan while a drain
+        # thread puts (single-key ops are GIL-atomic; iteration is not)
+        return [(k, dict(v)) for k, v in list(self._mem.items())]
+
+
+# ---------------------------------------------------------------------------
+# The provider
+# ---------------------------------------------------------------------------
+
+class ShardedUniquenessProvider(UniquenessProvider):
+    """Routes each consumed state-ref to one of N shard delegates; commits
+    single-shard transactions in one round and cross-shard transactions
+    via prepare/commit with abort-on-any-conflict (module docstring)."""
+
+    def __init__(self, delegates: Sequence[UniquenessProvider], db=None,
+                 prepare_ttl_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        if not delegates:
+            raise ValueError("at least one shard delegate required")
+        for d in delegates:
+            if not hasattr(d, "commit_many"):
+                raise ValueError(
+                    f"shard delegate {type(d).__name__} lacks commit_many"
+                )
+        self.delegates = list(delegates)
+        self.n_shards = len(self.delegates)
+        self._locks = [threading.Lock() for _ in self.delegates]
+        self._probes = [self._probe_fn(d) for d in self.delegates]
+        self._db = db
+        self.clock = clock
+        self.prepare_ttl_s = (
+            float(prepare_ttl_s) if prepare_ttl_s is not None
+            else float(os.environ.get(
+                "CORDA_TPU_SHARD_PREPARE_TTL", DEFAULT_PREPARE_TTL_S
+            ))
+        )
+        # per-shard lock tables (module docstring): a key routes to
+        # exactly one shard, so its reservation lives in that shard's
+        # OWN database when the delegate exposes one — fused rounds
+        # (screen + delegate commit in one write transaction, parallel
+        # across shard files). Delegates without a database (Raft/BFT
+        # cluster objects) write-arbitrate through the coordination db,
+        # or process memory when there is none.
+        self._stores: List[ReservationStore] = []
+        self._fused: List[bool] = []
+        for d in self.delegates:
+            ddb = getattr(d, "_db", None)
+            self._stores.append(
+                ReservationStore(ddb if ddb is not None else db)
+            )
+            self._fused.append(ddb is not None)
+        self.reservations = _ReservationsView(self._stores)
+        self.journal = PrepareJournal(db)
+        # telemetry (bench stage + /workers operator view); increments
+        # come from CONCURRENT per-shard drain threads (the coalescing
+        # layer runs shard groups in parallel), so they serialise on one
+        # lock — unsynchronized '+=' would drop updates
+        self._stats_lock = threading.Lock()
+        self.single_commits = 0
+        self.cross_commits = 0
+        self.cross_aborts = 0
+        self.reservation_conflicts = 0
+        self.recovered_commits = 0
+        self.recovered_aborts = 0
+        self.shard_rounds: Dict[int, int] = {
+            i: 0 for i in range(self.n_shards)
+        }
+        if db is not None:
+            # a restarted coordinator drains what its predecessor left
+            self.recover()
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def over_database(cls, db, n_shards: int,
+                      **kw) -> "ShardedUniquenessProvider":
+        """N PersistentUniquenessProvider shards over one node database —
+        the in-memory/MockNetwork configuration (every table lives in
+        the shared sqlite handle; no cross-process story needed)."""
+        return cls(
+            [
+                PersistentUniquenessProvider(db, table=f"uniqueness_s{i}")
+                for i in range(int(n_shards))
+            ],
+            db=db, **kw,
+        )
+
+    @classmethod
+    def over_directory(cls, coord_db, directory: str, n_shards: int,
+                       synchronous: str = "FULL",
+                       **kw) -> "ShardedUniquenessProvider":
+        """N shards with ONE SQLITE FILE EACH under `directory` (each
+        holding that shard's commit log AND its reservation lock table),
+        plus the shared coordination db (prepare journal only) — the
+        file-backed/worker-process configuration. The per-shard files
+        are the whole point: sqlite serialises WRITERS (and their
+        durability fsyncs — `synchronous=FULL`, because a commit log
+        that loses a commit on power-off later admits the double-spend)
+        per DATABASE, so four shards in one file would still commit one
+        at a time across OS workers, while four files commit four-wide
+        (docs/sharding.md §scale)."""
+        from .database import NodeDatabase
+
+        os.makedirs(directory, exist_ok=True)
+        prov = cls(
+            [
+                PersistentUniquenessProvider(
+                    NodeDatabase(os.path.join(directory, f"shard{i}.db"),
+                                 synchronous=synchronous)
+                )
+                for i in range(int(n_shards))
+            ],
+            db=coord_db, **kw,
+        )
+        # Hot-path pragmas, applied AFTER construction (table creation
+        # and recovery above race sibling workers and want the patient
+        # 30s busy handler):
+        #   * wal_autocheckpoint=0 — a mid-round auto-checkpoint stalls
+        #     the round for two extra fsyncs and N workers' checkpoints
+        #     collide on the device (measured: the 4-shard A/B loses
+        #     ~25% throughput to them); the sweeper thread below runs
+        #     PASSIVE checkpoints off the commit path instead, which
+        #     never block writers.
+        #   * busy_timeout=5 — sqlite's default busy handler backs off
+        #     to 25-100ms sleeps per attempt, so a cross-shard prepare
+        #     against a hot sibling shard file paid tens of ms per lock
+        #     acquisition; with a 5ms timeout the blocked writer raises
+        #     and `_retry_locked` polls at millisecond granularity.
+        for d in prov.delegates:
+            d._db.execute("PRAGMA busy_timeout=5")
+            d._db.execute("PRAGMA wal_autocheckpoint=0")
+        prov._start_wal_sweeper()
+        return prov
+
+    @staticmethod
+    def _probe_fn(delegate) -> Optional[Callable]:
+        """Committed-state read for prepare-time conflict detection:
+        {key: consuming tx id} for already-spent keys. Required for
+        cross-shard safety — without it a conflict could surface only at
+        finalise time, AFTER an earlier shard finalised."""
+        probe = getattr(delegate, "probe_commits", None)
+        if probe is not None:
+            return probe
+        kv = getattr(delegate, "_map", None)
+        if kv is not None:  # Persistent / Raft applied map
+
+            def probe_map(keys):
+                out = {}
+                for k in keys:
+                    blob = kv.get(k)
+                    if blob is not None:
+                        out[k] = deserialize(blob)["tx_id"]
+                return out
+
+            return probe_map
+        return None
+
+    # -- shard-db scheduling (lock polling + WAL maintenance) ----------------
+
+    def _retry_locked(self, fn, deadline_s: float = 30.0):
+        """Run `fn`, retrying on SQLITE_BUSY. The shard dbs run with a
+        ~5ms busy_timeout (over_directory) so a blocked writer raises
+        quickly and THIS loop polls at millisecond granularity — sqlite's
+        default busy handler backs off to 25-100ms sleeps per attempt,
+        which starved cross-shard rounds acquiring a hot sibling shard
+        file. Every retried body is idempotent: reservation writes are
+        INSERT OR IGNORE / tx-scoped DELETEs, delegate commits are
+        idempotent per tx id, and the failed transaction rolled back
+        before we re-enter."""
+        import sqlite3
+
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                return fn()
+            except sqlite3.OperationalError as exc:
+                msg = str(exc)
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.0005)
+
+    def checkpoint_shards(self) -> None:
+        """PASSIVE WAL checkpoint on every file-backed shard. Commit-path
+        writers never checkpoint (over_directory sets
+        wal_autocheckpoint=0): a mid-round auto-checkpoint stalls the
+        round for extra fsyncs and N workers' checkpoints collide on the
+        device. PASSIVE never blocks writers; a contended call simply
+        checkpoints less of the WAL and the next sweep catches up."""
+        for d in self.delegates:
+            sdb = getattr(d, "_db", None)
+            if sdb is None:
+                continue
+            try:
+                sdb.execute("PRAGMA wal_checkpoint(PASSIVE)")
+            except Exception:
+                pass  # busy/locked: the WAL survives until the next pass
+
+    def _start_wal_sweeper(self, interval_s: Optional[float] = None) -> None:
+        interval = (
+            float(interval_s) if interval_s is not None
+            else float(os.environ.get("CORDA_TPU_SHARD_WAL_SWEEP", "5"))
+        )
+        if interval <= 0:
+            return
+        self._sweep_stop = threading.Event()
+
+        def sweep():
+            while not self._sweep_stop.wait(interval):
+                self.checkpoint_shards()
+
+        threading.Thread(
+            target=sweep, name="shard-wal-sweeper", daemon=True
+        ).start()
+
+    def close(self) -> None:
+        stop = getattr(self, "_sweep_stop", None)
+        if stop is not None:
+            stop.set()
+
+    # -- routing -------------------------------------------------------------
+
+    def shard_of(self, ref: StateRef) -> int:
+        return shard_of_key(_key_of(ref), self.n_shards)
+
+    def shards_of(self, states: Sequence[StateRef]) -> List[int]:
+        """Sorted distinct shards a transaction's inputs touch (an empty
+        input set — issuance — is shard 0: it consumes nothing, but the
+        delegate round still records the commit idempotently)."""
+        return sorted({self.shard_of(r) for r in states}) or [0]
+
+    # -- UniquenessProvider --------------------------------------------------
+
+    def commit(self, states: List[StateRef], tx_id, requesting_party):
+        result = self.commit_many([(states, tx_id, requesting_party)])[0]
+        if result is not None:
+            raise UniquenessException(result)
+
+    def commit_many(self, requests: Sequence[Tuple]) -> List[Optional[Conflict]]:
+        """Group single-shard requests per shard (ONE delegate round per
+        shard, never one per request) and run each cross-shard request
+        through the two-phase protocol. Shard groups are independent by
+        construction; the coalescing layer dispatches them in parallel
+        (CoalescingUniquenessProvider shard-awareness)."""
+        results: List[Optional[Conflict]] = [None] * len(requests)
+        per_shard: Dict[int, List[Tuple[int, Tuple]]] = {}
+        cross: List[Tuple[int, Tuple, List[int]]] = []
+        for i, req in enumerate(requests):
+            shards = self.shards_of(req[0])
+            if len(shards) == 1:
+                per_shard.setdefault(shards[0], []).append((i, req))
+            else:
+                cross.append((i, req, shards))
+        for shard, items in per_shard.items():
+            for (idx, _), res in zip(
+                items, self._commit_shard_batch(shard, [r for _, r in items])
+            ):
+                results[idx] = res
+        if cross:
+            for (idx, _, _), res in zip(
+                cross, self._commit_cross_batch(cross)
+            ):
+                results[idx] = res
+        return results
+
+    # -- single-shard path ---------------------------------------------------
+
+    def _commit_shard_batch(
+        self, shard: int, reqs: Sequence[Tuple]
+    ) -> List[Optional[Conflict]]:
+        """One delegate round for a batch of same-shard requests,
+        screened against the shard's reservation table (live cross-shard
+        prepares' locks).
+
+        FUSED path (the store lives in the shard delegate's own db —
+        over_database / over_directory): the whole round is ONE write
+        transaction on that database. purge_expired runs first because
+        python sqlite3 defers BEGIN past SELECTs — the round's first
+        statement must be a WRITE for the screen to happen inside the
+        transaction, which is what makes screen + conflict check +
+        delegate commit atomic against any other OS worker's round or
+        prepare on this shard's file. A sibling's prepare either
+        serialises before us (its reservation shows in our screen → we
+        lose) or after (its post-reserve probe sees our committed rows →
+        it aborts). Zero hot-path writes touch the shared coordination
+        db, so N shards commit N-wide — the write-arbitration variant
+        (reserve_many/release on the coordination db per round)
+        serialised every worker on one write lock and collapsed the
+        4-shard A/B speedup to ~1.1×.
+
+        Delegates without their own db write-arbitrate through the
+        shared store instead: `INSERT OR IGNORE`'s per-statement
+        atomicity is the only lock a sibling process shares with us
+        there, so a read-only screen would leave a prepare/commit
+        interleaving window."""
+        now = self.clock()
+        out: List[Optional[Conflict]] = [None] * len(reqs)
+        store = self._stores[shard]
+        with self._locks[shard]:
+            if self._fused[shard]:
+                def attempt():
+                    with store._db.transaction():
+                        store.purge_expired(now)  # WRITE-first: opens txn
+                        return self._screened_round(store, shard, reqs, now)
+                out, rounds, commits, res_conflicts = \
+                    self._retry_locked(attempt)
+                # telemetry applied AFTER the retry loop settles, so an
+                # attempt that lost the write lock mid-round is not
+                # double-counted
+                with self._stats_lock:
+                    self.shard_rounds[shard] += rounds
+                    self.single_commits += commits
+                    self.reservation_conflicts += res_conflicts
+            else:
+                self._arbitrated_round(shard, store, reqs, now, out)
+        return out
+
+    def _screened_round(self, store: ReservationStore, shard: int,
+                        reqs: Sequence[Tuple], now: float):
+        """Read-screen + delegate commit (caller holds the fused write
+        transaction, so the screen cannot interleave with a sibling's
+        reserve). Within-batch double-spends are the delegate's job —
+        commit_many stages earlier requests against later ones. Returns
+        (results, rounds, commits, reservation_conflicts) — counters,
+        not self-mutations, because the caller may retry the whole
+        transaction after a lost lock race."""
+        out: List[Optional[Conflict]] = [None] * len(reqs)
+        rounds = commits = res_conflicts = 0
+        key_lists = [[_key_of(r) for r in states] for states, _, _ in reqs]
+        held = store.holders(
+            [k for ks in key_lists for k in ks], now
+        )
+        forward: List[Tuple[int, Tuple]] = []
+        for i, (states, tx_id, party) in enumerate(reqs):
+            tx_hex = tx_id.bytes.hex()
+            bad = {
+                k: held[k] for k in key_lists[i]
+                if k in held and held[k] != tx_hex
+            }
+            if bad:
+                # a live cross-shard prepare holds these refs: the
+                # competing spend loses, attributed to the reserver
+                key_to_ref = dict(zip(key_lists[i], states))
+                res_conflicts += 1
+                out[i] = Conflict(tx_id, {
+                    repr(key_to_ref[k]): SecureHash(bytes.fromhex(other))
+                    for k, other in bad.items()
+                })
+            else:
+                forward.append((i, (states, tx_id, party)))
+        if forward:
+            rounds += 1
+            delegate_res = self.delegates[shard].commit_many(
+                [r for _, r in forward]
+            )
+            for (i, _), res in zip(forward, delegate_res):
+                out[i] = res
+                if res is None:
+                    commits += 1
+        return out, rounds, commits, res_conflicts
+
+    def _arbitrated_round(self, shard: int, store: ReservationStore,
+                          reqs: Sequence[Tuple], now: float,
+                          out: List[Optional[Conflict]]) -> None:
+        """Write-arbitrated round for shards whose store cannot share the
+        delegate's transaction (in-memory, or the coordination-db
+        fallback): reserve_many is the lock acquire, release_pairs the
+        unlock around the delegate commit."""
+        lost = store.reserve_many(
+            {
+                tx_id.bytes.hex(): [_key_of(r) for r in states]
+                for states, tx_id, _ in reqs
+            },
+            now + self.prepare_ttl_s, now,
+        )
+        forward: List[Tuple[int, Tuple]] = []
+        for i, (states, tx_id, party) in enumerate(reqs):
+            bad = lost.get(tx_id.bytes.hex())
+            if bad:
+                key_to_ref = {_key_of(r): r for r in states}
+                with self._stats_lock:
+                    self.reservation_conflicts += 1
+                out[i] = Conflict(tx_id, {
+                    repr(key_to_ref[k]): SecureHash(bytes.fromhex(other))
+                    for k, other in bad.items()
+                })
+            else:
+                forward.append((i, (states, tx_id, party)))
+        if forward:
+            try:
+                with self._stats_lock:
+                    self.shard_rounds[shard] += 1
+                delegate_res = self.delegates[shard].commit_many(
+                    [r for _, r in forward]
+                )
+                for (i, _), res in zip(forward, delegate_res):
+                    out[i] = res
+                    if res is None:
+                        with self._stats_lock:
+                            self.single_commits += 1
+            finally:
+                store.release_pairs([
+                    (_key_of(r), tx_id.bytes.hex())
+                    for _, (states, tx_id, _) in forward
+                    for r in states
+                ])
+
+    # -- cross-shard two-phase path ------------------------------------------
+
+    def _fire(self, point: str, **detail):
+        if faultpoints.hook is not None:
+            action = faultpoints.fire(point, **detail)
+            if action == "crash":
+                raise CoordinatorCrashError(
+                    f"injected coordinator crash at {point} "
+                    f"(shard {detail.get('shard')})"
+                )
+            if isinstance(action, tuple) and action[:1] == ("delay",):
+                time.sleep(float(action[1]))
+
+    def _commit_cross_batch(self, cross) -> List[Optional[Conflict]]:
+        """One two-phase ROUND for every cross-shard request in a drained
+        batch (2112.02229's no-stage-blocks-another discipline at the
+        commit path): ONE journal record and ONE reservation transaction
+        per shard cover the whole group, instead of ~7 coordination-db
+        writes per transaction. Conflicts stay per-transaction — a loser
+        is dropped from the round (its reservations released everywhere)
+        without aborting its batch-mates."""
+        txs: List[dict] = []
+        for _idx, (states, tx_id, party), shards in cross:
+            keys_by_shard: Dict[int, List[bytes]] = {s: [] for s in shards}
+            ref_of_key: Dict[bytes, StateRef] = {}
+            for ref in states:
+                key = _key_of(ref)
+                keys_by_shard[shard_of_key(key, self.n_shards)].append(key)
+                ref_of_key[key] = ref
+            txs.append({
+                "tx_hex": tx_id.bytes.hex(), "tx_id": tx_id, "party": party,
+                "keys_by_shard": keys_by_shard, "ref_of_key": ref_of_key,
+                "shards": shards,
+            })
+        union = sorted({s for t in txs for s in t["shards"]})
+        now = self.clock()
+        expires = now + self.prepare_ttl_s
+        round_id = txs[0]["tx_hex"]
+        # journal FIRST: recovery must be able to find (and release) any
+        # reservation this round takes from here on
+        self.journal.put(round_id, self._journal_record(
+            "prepare", union, txs, expires
+        ))
+        results: Dict[str, Optional[Conflict]] = {
+            t["tx_hex"]: None for t in txs
+        }
+        alive = list(txs)
+        try:
+            for s in union:  # ascending order: no lock-cycle livelock
+                todo = [t for t in alive if t["keys_by_shard"].get(s)]
+                if not todo:
+                    continue
+                self._fire("sharded.prepare", shard=f"s{s}",
+                           tx_id=round_id)
+                conflicts = self._prepare_shard_batch(s, todo, expires)
+                for t in todo:
+                    c = conflicts.get(t["tx_hex"])
+                    if c is not None:
+                        # loser: drop from the round, release whatever
+                        # it reserved on earlier shards
+                        results[t["tx_hex"]] = c
+                        for rs in t["shards"]:
+                            self._retry_locked(
+                                lambda rs=rs:
+                                self._stores[rs].release_tx(t["tx_hex"])
+                            )
+                        with self._stats_lock:
+                            self.cross_aborts += 1
+                        alive.remove(t)
+        except CoordinatorCrashError:
+            # the simulated death: reservations + journal stay behind —
+            # expiry (or a recovery pass) is what must clean them up
+            raise
+        except BaseException:
+            for t in alive:
+                for rs in t["shards"]:
+                    self._retry_locked(
+                        lambda rs=rs, t=t:
+                        self._stores[rs].release_tx(t["tx_hex"])
+                    )
+            self.journal.remove(round_id)
+            raise
+        if not alive:
+            self.journal.remove(round_id)
+            return [results[t["tx_hex"]] for t in txs]
+        # decision point: every surviving tx is reserved on every shard.
+        # The reservations still carry the PREPARE-phase expiry — if the
+        # prepares ate most of the TTL, a sibling's purge could free the
+        # keys mid-finalise and admit a competitor (a torn commit). So
+        # extend every survivor's locks into a fresh window sized for
+        # finalise + a coordinator respawn, and VERIFY the extension
+        # moved every row: a shortfall means expiry already released a
+        # key, and that tx must abort HERE, before any shard finalises.
+        now = self.clock()
+        finalise_expires = now + 10 * self.prepare_ttl_s
+        for t in list(alive):
+            expected = sum(len(t["keys_by_shard"][s]) for s in t["shards"])
+            moved = sum(
+                self._retry_locked(
+                    lambda s=s, t=t: self._stores[s].extend(
+                        t["keys_by_shard"][s], t["tx_hex"],
+                        finalise_expires
+                    )
+                )
+                for s in t["shards"]
+            )
+            if moved < expected:
+                results[t["tx_hex"]] = self._expiry_conflict(t)
+                for rs in t["shards"]:
+                    self._retry_locked(
+                        lambda rs=rs, t=t:
+                        self._stores[rs].release_tx(t["tx_hex"])
+                    )
+                with self._stats_lock:
+                    self.cross_aborts += 1
+                alive.remove(t)
+                eventlog.emit(
+                    "warning", "notary",
+                    "cross-shard prepare outlived its TTL; aborted before "
+                    "finalise", tx_id=t["tx_hex"][:16],
+                )
+        if not alive:
+            self.journal.remove(round_id)
+            return [results[t["tx_hex"]] for t in txs]
+        # every survivor is re-locked past the finalise window — flip the
+        # journal so a crash from here on RE-DRIVES the commit instead of
+        # aborting
+        self.journal.put(round_id, self._journal_record(
+            "committing", union, alive, finalise_expires
+        ))
+        for s in union:
+            items = [t for t in alive if t["keys_by_shard"].get(s)]
+            if not items:
+                continue
+            self._fire("sharded.finalise", shard=f"s{s}", tx_id=round_id)
+            self._finalise_shard_batch(s, items)
+        self.journal.remove(round_id)
+        with self._stats_lock:
+            self.cross_commits += len(alive)
+        eventlog.emit(
+            "info", "notary", "cross-shard round committed",
+            round=round_id[:16], shards=list(union), txs=len(alive),
+            aborted=len(txs) - len(alive),
+        )
+        return [results[t["tx_hex"]] for t in txs]
+
+    def _expiry_conflict(self, t: dict) -> Conflict:
+        """Attribution for a tx whose reservation expired before the
+        decision point: name the committed competitor where a shard's
+        probe can see one; keys with no visible winner (purged but not
+        yet re-taken) report the zero hash — the caller can safely
+        retry, which re-screens against the live commit logs."""
+        detail = {}
+        for s in t["shards"]:
+            keys = t["keys_by_shard"][s]
+            probe = self._probes[s]
+            committed = probe(keys) if probe is not None else {}
+            for k in keys:
+                winner = committed.get(k)
+                if winner is not None and winner != t["tx_id"]:
+                    detail[repr(t["ref_of_key"][k])] = winner
+        if not detail:
+            detail = {
+                repr(t["ref_of_key"][k]): SecureHash(bytes(32))
+                for s in t["shards"] for k in t["keys_by_shard"][s]
+            }
+        return Conflict(t["tx_id"], detail)
+
+    @staticmethod
+    def _journal_record(phase: str, union, txs, expires: float) -> dict:
+        return {
+            "phase": phase,
+            "shards": list(union),
+            "txs": {
+                t["tx_hex"]: {
+                    "keys": {
+                        str(s): [k.hex() for k in ks]
+                        for s, ks in t["keys_by_shard"].items()
+                    },
+                    "by": getattr(t["party"], "name", str(t["party"])),
+                }
+                for t in txs
+            },
+            "expires": expires,
+        }
+
+    def _prepare_shard_batch(self, shard: int, todo: List[dict],
+                             expires: float) -> Dict[str, Conflict]:
+        """Reserve every tx's keys on one shard; returns per-tx conflicts
+        ({tx_hex: Conflict}) for the losers. Conflicts come from (a)
+        another transaction's live reservation — including a
+        batch-mate's, decided by insert order — or (b) the shard's
+        committed log, probed AFTER our reservation landed: once we hold
+        the key, a competing single-shard commit in another OS worker
+        must lose at ITS reservation step, so any commit the post-reserve
+        probe can't see is one that cannot happen. (Probe-first would
+        leave a window: probe clean, sibling reserves+commits+releases,
+        our reserve then succeeds — and the conflict would surface only
+        at finalise, after earlier shards finalised.) Same-tx
+        idempotency: our own rows and commits never conflict (a re-driven
+        prepare after a retry). Losers keep their reservations here; the
+        caller releases everything via release_tx on the spot."""
+        probe = self._probes[shard]
+        if probe is None:
+            raise UniquenessException(Conflict(todo[0]["tx_id"], {
+                "<config>": f"shard {shard} delegate "
+                f"{type(self.delegates[shard]).__name__} supports no "
+                "committed-state probe; cross-shard transactions require "
+                "probeable delegates (docs/sharding.md)",
+            }))
+        now = self.clock()
+        out: Dict[str, Conflict] = {}
+        with self._locks[shard]:
+            lost = self._retry_locked(
+                lambda: self._stores[shard].reserve_many(
+                    {t["tx_hex"]: t["keys_by_shard"][shard] for t in todo},
+                    expires, now,
+                )
+            )
+            held = []
+            for t in todo:
+                bad = lost.get(t["tx_hex"])
+                if bad:
+                    with self._stats_lock:
+                        self.reservation_conflicts += 1
+                    out[t["tx_hex"]] = Conflict(t["tx_id"], {
+                        repr(t["ref_of_key"][k]):
+                            SecureHash(bytes.fromhex(other))
+                        for k, other in bad.items()
+                    })
+                else:
+                    held.append(t)
+            if held:
+                committed = probe(
+                    [k for t in held for k in t["keys_by_shard"][shard]]
+                )
+                for t in held:
+                    bad = {
+                        repr(t["ref_of_key"][k]): committed[k]
+                        for k in t["keys_by_shard"][shard]
+                        if k in committed and committed[k] != t["tx_id"]
+                    }
+                    if bad:
+                        out[t["tx_hex"]] = Conflict(t["tx_id"], bad)
+        return out
+
+    def _finalise_shard_batch(self, shard: int, items: List[dict]) -> None:
+        """Second round on one shard: ONE delegate commit_many for the
+        group (idempotent by tx id) then one reservation release — one
+        write transaction on a fused shard, so a sibling's screen sees
+        either (reservation held, rows absent) or (released, rows
+        present), never a torn middle. A conflict here is an INVARIANT
+        BREACH — something committed these refs without going through
+        this provider — surfaced loudly, never swallowed."""
+        store = self._stores[shard]
+
+        def _round():
+            res = self.delegates[shard].commit_many([
+                (
+                    [t["ref_of_key"][k] for k in t["keys_by_shard"][shard]],
+                    t["tx_id"], t["party"],
+                )
+                for t in items
+            ])
+            store.release_pairs([
+                (k, t["tx_hex"])
+                for t in items for k in t["keys_by_shard"][shard]
+            ])
+            return res
+
+        def _fused_round():
+            with store._db.transaction():
+                return _round()
+
+        with self._locks[shard]:
+            if self._fused[shard]:
+                res = self._retry_locked(_fused_round)
+            else:
+                res = _round()
+            with self._stats_lock:
+                self.shard_rounds[shard] += 1
+        for t, r in zip(items, res):
+            if r is not None:
+                eventlog.emit(
+                    "error", "notary",
+                    "cross-shard finalise conflict (partition invariant "
+                    "breached: a commit bypassed the sharded provider)",
+                    tx_id=t["tx_hex"][:16], shard=shard,
+                )
+                raise UniquenessException(r)
+
+    # -- recovery ------------------------------------------------------------
+
+    def recover(self) -> Dict[str, int]:
+        """Replay the prepare journal (restart / takeover): "committing"
+        round records re-drive every member tx's finalise on every shard
+        (delegate commits are idempotent per tx id), anything earlier
+        aborts ONCE EXPIRED — releasing its reservations so no state-ref
+        stays wedged, while an unexpired prepare round is presumed to
+        belong to a live sibling coordinator and left alone.
+        Expired reservations with no journal record die here too."""
+        recovered = {"committed": 0, "aborted": 0, "expired": 0,
+                     "conflicted": 0}
+        now = self.clock()
+        for round_id, rec in self.journal.items():
+            txs = rec.get("txs", {})
+            if (
+                rec.get("phase") != "committing"
+                and rec.get("expires", 0) > now
+            ):
+                # a LIVE sibling coordinator's prepare round (shared-db
+                # mode spawns/respawns workers while rounds are in
+                # flight): aborting it would release reservations its
+                # owner is about to finalise against. Not ours until it
+                # expires — a genuinely dead coordinator's round becomes
+                # abortable then, and its reservations die by expiry
+                # even sooner. "committing" rounds re-drive regardless:
+                # past the decision point the commit is idempotent per
+                # tx id, live owner or not.
+                continue
+            if rec.get("phase") == "committing":
+                for tx_hex, info in txs.items():
+                    tx_id = SecureHash(bytes.fromhex(tx_hex))
+                    party = type("_Recovered", (), {
+                        "name": info.get("by", "recovered"),
+                    })()
+                    conflicted_shard = None
+                    for s_str, key_hexes in info.get("keys", {}).items():
+                        s = int(s_str)
+                        keys = [bytes.fromhex(k) for k in key_hexes]
+                        # the commit-log key is txhash(32) + index(4):
+                        # the StateRefs rebuild exactly, so the
+                        # re-driven delegate round writes the same rows
+                        refs = [
+                            StateRef(SecureHash(k[:32]),
+                                     int.from_bytes(k[32:], "big"))
+                            for k in keys
+                        ]
+                        with self._locks[s]:
+                            with self._stats_lock:
+                                self.shard_rounds[s] += 1
+
+                            def redrive(s=s, refs=refs, keys=keys,
+                                        tx_id=tx_id, party=party,
+                                        tx_hex=tx_hex):
+                                res = self.delegates[s].commit_many(
+                                    [(refs, tx_id, party)]
+                                )
+                                self._stores[s].release(keys, tx_hex)
+                                return res
+
+                            res = self._retry_locked(redrive)
+                        if res and res[0] is not None:
+                            conflicted_shard = s
+                    if conflicted_shard is None:
+                        with self._stats_lock:
+                            self.recovered_commits += 1
+                        recovered["committed"] += 1
+                    else:
+                        # a competitor consumed the refs during the
+                        # outage window (the reservation expired before
+                        # this recovery ran): the decided round is now
+                        # torn — count and log it LOUDLY, never as a
+                        # recovered commit
+                        recovered["conflicted"] += 1
+                        eventlog.emit(
+                            "error", "notary",
+                            "re-driven cross-shard commit conflicted: "
+                            "refs consumed by a competitor during the "
+                            "outage window",
+                            tx_id=tx_hex[:16], shard=conflicted_shard,
+                        )
+                self.journal.remove(round_id)
+            else:
+                for tx_hex in txs or {round_id: None}:
+                    released = self._retry_locked(
+                        lambda tx_hex=tx_hex:
+                        self.reservations.release_tx(tx_hex)
+                    )
+                    with self._stats_lock:
+                        self.recovered_aborts += 1
+                    recovered["aborted"] += 1
+                    recovered["expired"] += released
+                self.journal.remove(round_id)
+        recovered["expired"] += self._retry_locked(
+            lambda: self.reservations.purge_expired(self.clock())
+        )
+        if any(recovered.values()):
+            eventlog.emit(
+                "warning", "notary", "sharded prepare-journal recovery",
+                **recovered,
+            )
+        return recovered
+
+    # -- observability -------------------------------------------------------
+
+    def is_consumed(self, ref: StateRef) -> bool:
+        d = self.delegates[self.shard_of(ref)]
+        if hasattr(d, "is_consumed"):
+            return d.is_consumed(ref)
+        probe = self._probes[self.shard_of(ref)]
+        return bool(probe and probe([_key_of(ref)]))
+
+    def stats(self) -> dict:
+        with self._stats_lock:  # one consistent snapshot
+            return {
+                "n_shards": self.n_shards,
+                "single_commits": self.single_commits,
+                "cross_commits": self.cross_commits,
+                "cross_aborts": self.cross_aborts,
+                "reservation_conflicts": self.reservation_conflicts,
+                "recovered_commits": self.recovered_commits,
+                "recovered_aborts": self.recovered_aborts,
+                "shard_rounds": dict(self.shard_rounds),
+            }

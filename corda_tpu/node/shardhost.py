@@ -1,0 +1,812 @@
+"""Multi-process node workers behind one broker (docs/sharding.md).
+
+The round-11 profiler put a number on the ceiling: one CPython process
+runs the whole node — ~25 threads convoy behind one GIL on one core
+(docs/perf-system.md round-11 addendum). This module splits the
+flow/verify hot path across M OS worker processes, each with its OWN
+GIL, behind the ONE existing broker (`messaging/net.py` BrokerServer):
+
+    peers/bridges ──> p2p.inbound.<name> ──ShardRouter──┬─> …<name>.w0 ─ worker 0
+                                                        ├─> …<name>.w1 ─ worker 1
+                                                        └─> …<name>.sup ─ supervisor node
+    workers ──> p2p.egress ──EgressPump──> bridges / local inbound
+
+  * **Routing** pins a SESSION to the worker that owns its flow: worker
+    flow ids carry a `w<k>-` tag (StateMachineManager.flow_id_tag), and
+    every session id is `<flow id>:<n>`, so SessionData/End route by
+    their recipient id's tag and SessionConfirm/Reject by the initiator
+    id's tag. A SessionInit has no local owner yet — it routes by a
+    STABLE hash of the initiator's session id, which also sends every
+    re-transmitted init to the same worker so init-dedup keeps working.
+    Non-session topics (raft, bft, network map) and untagged session ids
+    (supervisor-started flows) go to the supervisor's `.sup` leg.
+  * **Workers** are real `python -m corda_tpu.node <dir> --shard-worker
+    k` processes: RemoteBroker to the supervisor's socket, the SHARED
+    node database (WAL sqlite; flow checkpoints partition by the id
+    tag), the same legal identity (entropy pinned in
+    `<base>/identity.entropy`), their own InMemory verifier (the verify
+    hot path scales with them), their own RPC server as a COMPETING
+    consumer on `rpc.server.requests`, and an OpsServer each.
+  * **Supervisor** spawns/monitors/respawns workers, registers every
+    shard-addressed queue EAGERLY (so the PR-3 `P2P.QueueDepth` gauges
+    and PR-5 bounded-queue caps cover worker queues from the first
+    message — no unbounded window before the first consumer attaches),
+    replays peer registrations to (re)spawned workers over per-worker
+    control queues, aggregates worker /healthz + key metrics behind
+    `GET /workers`, and reports a `workers` health component.
+  * **A worker death is a transient**, not a loss: its unacked queue
+    messages redeliver to the respawned process, whose state machine
+    restores the dead worker's checkpoints (same `w<k>-` partition) and
+    whose hospital readmits transient failures exactly as on a
+    single-process node. Admission caps apply per worker.
+
+Notary nodes compose with this through the PARTITIONED uniqueness
+provider (sharded_notary.py) in shared-database mode: reservations and
+the prepare journal live in sqlite, so any worker can coordinate a
+cross-shard commit and any other can recover it. Raft/BFT cluster
+membership stays single-process (the replica state machines are not
+multi-process safe); a cluster member node ignores `node_workers`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..core.serialization.codec import deserialize
+from ..utils import eventlog
+from .session import (
+    ROUTE_HINT_HEADER,
+    SESSION_TOPIC,
+    SessionConfirm,
+    SessionData,
+    SessionEnd,
+    SessionInit,
+    SessionReject,
+)
+
+#: workers' outbound funnel: one queue the supervisor's egress pump
+#: drains into bridges / local inbound queues
+EGRESS_QUEUE = "p2p.egress"
+
+
+def rpc_session_secret(identity_entropy: int) -> bytes:
+    """The shared HMAC key making RPC session tokens portable across the
+    supervisor's and every worker's RPC server (rpc/server.py
+    session_secret): all serve one identity, so they derive one secret
+    from its (never client-visible) entropy."""
+    import hashlib
+
+    return hashlib.sha256(
+        b"corda-tpu-rpc-session:" + str(int(identity_entropy)).encode()
+    ).digest()
+
+_TAG = re.compile(r"^w(\d+)-")
+
+
+def worker_queue(node_name: str, index: int) -> str:
+    return f"p2p.inbound.{node_name}.w{index}"
+
+
+def supervisor_queue(node_name: str) -> str:
+    return f"p2p.inbound.{node_name}.sup"
+
+
+def control_queue(index: int) -> str:
+    return f"shardhost.control.w{index}"
+
+
+def worker_tag_of(session_or_flow_id: str) -> Optional[int]:
+    """The owning worker index encoded in a tagged flow/session id
+    (`w3-<uuid>[:n]`), or None for supervisor/unsharded ids."""
+    m = _TAG.match(session_or_flow_id)
+    return int(m.group(1)) if m else None
+
+
+def _stable_hash(s: str) -> int:
+    import hashlib
+
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "big")
+
+
+#: route_session_hint: "no usable hint — decode the payload instead"
+_NO_HINT = object()
+
+
+def route_session_hint(hint: Optional[str], n_workers: int):
+    """Worker index (int) or None (supervisor) from a sender-stamped
+    `x-session-route` header (session.route_hint: "h:<sid>" = stable
+    hash, "t:<sid>" = the id's `w<k>-` tag), or the `_NO_HINT` sentinel
+    when the header is absent/malformed (older sender) — the caller
+    then falls back to payload decode. Pure function like
+    route_session_payload, and MUST agree with it on every hint the
+    current senders emit (a retransmit may arrive once with and once
+    without the header; both copies have to land on the same worker
+    for session dedup to absorb the duplicate)."""
+    if not hint or len(hint) < 3 or hint[1] != ":":
+        return _NO_HINT
+    kind, sid = hint[0], hint[2:]
+    if kind == "h":
+        return _stable_hash(sid) % n_workers
+    if kind == "t":
+        tag = worker_tag_of(sid)
+        if tag is not None and 0 <= tag < n_workers:
+            return tag
+        return None
+    return _NO_HINT
+
+
+def route_session_payload(payload: bytes, n_workers: int) -> Optional[int]:
+    """Worker index a session message belongs to, or None (supervisor).
+    Pure function — the router's whole policy, unit-testable without
+    processes. Undecodable payloads fall to the supervisor, whose pump
+    already tolerates junk."""
+    try:
+        msg = deserialize(payload)
+    except Exception:
+        return None
+    if isinstance(msg, SessionInit):
+        # no local owner yet: stable hash keeps retransmits (and their
+        # dedup) on one worker
+        return _stable_hash(msg.initiator_session_id) % n_workers
+    if isinstance(msg, (SessionData, SessionEnd)):
+        sid = msg.recipient_session_id
+    elif isinstance(msg, (SessionConfirm, SessionReject)):
+        sid = msg.initiator_session_id
+    else:
+        return None
+    tag = worker_tag_of(sid)
+    if tag is not None and 0 <= tag < n_workers:
+        return tag
+    return None
+
+
+class ShardRouter:
+    """Consumes the node's bare inbound queue and forwards each message
+    to its shard-addressed leg (worker k or the supervisor). At-least-
+    once: forward THEN ack — a router crash redelivers, and session
+    seq-dedup absorbs the duplicate downstream."""
+
+    def __init__(self, broker, node_name: str, n_workers: int):
+        self.broker = broker
+        self.node_name = node_name
+        self.n_workers = n_workers
+        self.routed = 0
+        self.to_supervisor = 0
+        self._stop = threading.Event()
+        self._consumer = broker.create_consumer(f"p2p.inbound.{node_name}")
+        self._thread = threading.Thread(
+            target=self._run, name=f"shard-router-{node_name}", daemon=True
+        )
+
+    def target_of(self, msg) -> str:
+        if msg.headers.get("topic") != SESSION_TOPIC:
+            return supervisor_queue(self.node_name)
+        # fast path: route on the sender-stamped hint header alone —
+        # no codec deserialize of the payload on this one thread
+        k = route_session_hint(
+            msg.headers.get(ROUTE_HINT_HEADER), self.n_workers
+        )
+        if k is _NO_HINT:
+            k = route_session_payload(msg.payload, self.n_workers)
+        if k is None:
+            return supervisor_queue(self.node_name)
+        return worker_queue(self.node_name, k)
+
+    def start(self) -> "ShardRouter":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        from ..messaging.broker import QueueFullError
+
+        while not self._stop.is_set():
+            batch = self._consumer.receive_many(64, timeout=0.2)
+            if not batch:
+                continue
+            items = []
+            for msg in batch:
+                target = self.target_of(msg)
+                if target.endswith(".sup"):
+                    self.to_supervisor += 1
+                items.append((target, msg.payload, msg.headers))
+            try:
+                self.broker.send_many(items)
+            except QueueFullError:
+                # a bounded worker queue is full: BLOCK here per message
+                # until it drains — the router propagating backpressure
+                # upstream (its own inbound queue fills, whose reject
+                # policy then pushes back on the senders) is the design
+                aborted = False
+                for target, payload, headers in items:
+                    sent = False
+                    while not self._stop.is_set():
+                        try:
+                            self.broker.send(target, payload, headers)
+                            sent = True
+                            break
+                        except QueueFullError:
+                            time.sleep(0.02)
+                    if not sent:
+                        aborted = True
+                        break
+                if aborted:
+                    # stop() mid-backpressure: ack NOTHING — the whole
+                    # unacked batch redelivers after restart ("forward
+                    # THEN ack"; session dedup absorbs duplicates of the
+                    # items that did go out before the abort)
+                    continue
+            self._consumer.ack_many(batch)
+            self.routed += len(batch)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2)
+
+
+class EgressPump:
+    """Drains workers' outbound messages (EGRESS_QUEUE, `x-dest` header)
+    into the supervisor's bridge outbound queues — or straight back into
+    a local inbound queue for loopback/same-broker peers."""
+
+    def __init__(self, broker, bridges=None):
+        self.broker = broker
+        self.bridges = bridges
+        self.forwarded = 0
+        self.dropped = 0
+        self._stop = threading.Event()
+        broker.create_queue(
+            EGRESS_QUEUE,
+            durable=getattr(broker, "_journal_dir", None) is not None,
+        )
+        self._consumer = broker.create_consumer(EGRESS_QUEUE)
+        self._thread = threading.Thread(
+            target=self._run, name="shard-egress", daemon=True
+        )
+
+    def start(self) -> "EgressPump":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        from ..messaging.broker import QueueFullError
+
+        while not self._stop.is_set():
+            batch = self._consumer.receive_many(64, timeout=0.2)
+            if not batch:
+                continue
+            aborted = False
+            for msg in batch:
+                headers = dict(msg.headers)
+                dest = headers.pop("x-dest", None)
+                try:
+                    if dest is None:
+                        raise ValueError("egress message without x-dest")
+                    if (
+                        self.bridges is not None
+                        and self.bridges.route_for(dest) is not None
+                    ):
+                        target = self.bridges.outbound_queue(dest)
+                    else:
+                        target = f"p2p.inbound.{dest}"
+                    while True:
+                        try:
+                            self.broker.send(target, msg.payload, headers)
+                            break
+                        except QueueFullError:
+                            # a bounded destination queue is full: BLOCK
+                            # until it drains, like ShardRouter — a
+                            # session message dropped here has no
+                            # retransmit, the flow would hang to timeout
+                            if self._stop.is_set():
+                                aborted = True
+                                break
+                            time.sleep(0.02)
+                    if aborted:
+                        break
+                    self.forwarded += 1
+                except Exception as exc:
+                    # an unroutable peer is an operational fact, not a
+                    # pump-killing one
+                    self.dropped += 1
+                    eventlog.emit(
+                        "warning", "messaging", "egress drop",
+                        dest=dest, error=type(exc).__name__,
+                    )
+            if aborted:
+                # stop() mid-backpressure: not a drop — ack NOTHING so
+                # the durable egress queue redelivers the batch after
+                # restart (duplicates of already-forwarded items are
+                # absorbed by session seq-dedup downstream)
+                continue
+            self._consumer.ack_many(batch)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2)
+
+
+class _WorkerProc:
+    """One spawned worker process + its lifecycle counters."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc: Optional[subprocess.Popen] = None
+        self.respawns = 0
+        self.last_exit: Optional[int] = None
+        self.started_at: Optional[float] = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ShardSupervisor:
+    """Spawns, monitors and respawns the M worker processes; owns the
+    router + egress pump; aggregates worker health/metrics (module
+    docstring). Construct AFTER the node object (it registers gauges and
+    a health component on it) and start() after node.start()."""
+
+    #: respawn backoff: a worker that dies instantly must not spin-fork
+    RESPAWN_DELAY_S = 0.5
+
+    def __init__(self, broker, node, config_dir: str, n_workers: int,
+                 broker_port: int, bridges=None,
+                 jax_platform: Optional[str] = "cpu",
+                 base_directory: Optional[str] = None):
+        self.broker = broker
+        self.node = node
+        self.config_dir = config_dir
+        self.n_workers = int(n_workers)
+        self.broker_port = broker_port
+        self.bridges = bridges
+        self.jax_platform = jax_platform
+        self.base_directory = base_directory or config_dir
+        self.name = node.info.name
+        self.workers = [_WorkerProc(i) for i in range(self.n_workers)]
+        self._peers: Dict[str, tuple] = {}  # name -> (party, services)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.router: Optional[ShardRouter] = None
+        self.egress: Optional[EgressPump] = None
+        self._register_queues()
+        self._register_telemetry()
+
+    # -- queue registration (eager: gauges + caps from message one) ----------
+
+    def _register_queues(self) -> None:
+        """EVERY shard-addressed queue exists — created, bounded, gauged
+        — before any worker attaches or any message arrives. Without
+        this, a queue created lazily by its first producer would sit
+        uncapped and uncounted until its consumer showed up."""
+        durable = getattr(self.broker, "_journal_dir", None) is not None
+        max_depth = int(os.environ.get("CORDA_TPU_P2P_QUEUE_MAX", 10_000))
+        # the bare inbound queue (what peers' bridges address) feeds the
+        # router; it must exist before the first bridge delivery
+        self.broker.create_queue(f"p2p.inbound.{self.name}", durable=durable)
+        if max_depth > 0:
+            self.broker.set_queue_bound(
+                f"p2p.inbound.{self.name}", max_depth, "reject"
+            )
+        # ALL THREE shard-addressed legs: every worker's ".w<k>" AND the
+        # supervisor's ".sup" (created here before BrokerMessagingService
+        # attaches to it — otherwise it would sit uncapped, the one leg
+        # the CORDA_TPU_P2P_QUEUE_MAX cap silently missed)
+        legs = [worker_queue(self.name, k) for k in range(self.n_workers)]
+        legs.append(supervisor_queue(self.name))
+        for q in legs:
+            self.broker.create_queue(q, durable=durable)
+            if max_depth > 0:
+                self.broker.set_queue_bound(q, max_depth, "reject")
+        for k in range(self.n_workers):
+            # control traffic is tiny and replayable: bounded drop-oldest
+            self.broker.create_queue(control_queue(k))
+            self.broker.set_queue_bound(control_queue(k), 1024, "drop_oldest")
+        self.broker.create_queue(EGRESS_QUEUE, durable=durable)
+        if max_depth > 0:
+            self.broker.set_queue_bound(EGRESS_QUEUE, max_depth, "reject")
+
+    def _register_telemetry(self) -> None:
+        metrics = self.node.metrics
+        metrics.gauge(
+            "Shard.Workers.Alive",
+            lambda: sum(1 for w in self.workers if w.alive()),
+        )
+        metrics.gauge(
+            "Shard.Workers.Respawns",
+            lambda: sum(w.respawns for w in self.workers),
+        )
+        metrics.gauge(
+            "Shard.Router.Routed",
+            lambda: self.router.routed if self.router else 0,
+        )
+        metrics.gauge(
+            "Shard.Egress.Forwarded",
+            lambda: self.egress.forwarded if self.egress else 0,
+        )
+        for k in range(self.n_workers):
+            metrics.gauge(
+                f"Shard.QueueDepth{{worker={k}}}",
+                lambda q=worker_queue(self.name, k): (
+                    self.broker.message_count(q)
+                ),
+            )
+        self.node.health.register("workers", self._check_workers)
+
+    def _check_workers(self) -> dict:
+        detail = {
+            f"w{w.index}": {
+                "alive": w.alive(), "respawns": w.respawns,
+                "queue_depth": self.broker.message_count(
+                    worker_queue(self.name, w.index)
+                ),
+            }
+            for w in self.workers
+        }
+        # a dead worker mid-respawn is degraded, not down: readiness
+        # holds as long as at least one worker serves
+        detail["ok"] = any(w.alive() for w in self.workers)
+        return detail
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ShardSupervisor":
+        self.router = ShardRouter(
+            self.broker, self.name, self.n_workers
+        ).start()
+        self.egress = EgressPump(self.broker, self.bridges).start()
+        for w in self.workers:
+            self._spawn(w)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="shard-monitor", daemon=True
+        )
+        self._monitor.start()
+        eventlog.emit(
+            "info", "shardhost", "supervisor started",
+            workers=self.n_workers, node=self.name,
+        )
+        return self
+
+    def _spawn(self, w: _WorkerProc) -> None:
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # supervisor death must reap the fleet
+        env["CORDA_TPU_EXIT_ON_ORPHAN"] = "1"
+        log_path = os.path.join(
+            self.base_directory, f"worker{w.index}.log"
+        )
+        args = [
+            sys.executable, "-m", "corda_tpu.node", self.config_dir,
+            "--shard-worker", str(w.index),
+            "--workers", str(self.n_workers),
+            "--broker-port", str(self.broker_port),
+        ]
+        if self.jax_platform:
+            args += ["--jax-platform", self.jax_platform]
+        with open(log_path, "a") as log_file:  # Popen dups the fd
+            w.proc = subprocess.Popen(
+                args, stdout=log_file, stderr=subprocess.STDOUT, env=env,
+            )
+        w.started_at = time.monotonic()
+        # the worker's control queue replays every peer it missed
+        with self._lock:
+            peers = list(self._peers.values())
+        for party, services in peers:
+            self._send_control(w.index, {
+                "kind": "peer", "party": party, "services": list(services),
+            })
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.5):
+            for w in self.workers:
+                if w.proc is not None and not w.alive():
+                    w.last_exit = w.proc.returncode
+                    w.respawns += 1
+                    eventlog.emit(
+                        "warning", "shardhost", "worker died; respawning",
+                        worker=w.index, exit=w.last_exit,
+                    )
+                    # transient, not a loss: unacked messages already
+                    # redelivered broker-side; checkpoints restore in
+                    # the respawn; hospital readmits in-flight retries
+                    time.sleep(self.RESPAWN_DELAY_S)
+                    if not self._stop.is_set():
+                        self._spawn(w)
+
+    def broadcast_peer(self, party, services) -> None:
+        """Forward a network-map registration to every worker (and
+        remember it for respawn replay)."""
+        with self._lock:
+            self._peers[party.name] = (party, tuple(services))
+        for w in self.workers:
+            self._send_control(w.index, {
+                "kind": "peer", "party": party, "services": list(services),
+            })
+
+    def _send_control(self, index: int, record: dict) -> None:
+        from ..core.serialization.codec import serialize
+
+        try:
+            self.broker.send(control_queue(index), serialize(record))
+        except Exception:
+            pass  # bounded drop-oldest queue; respawn replays anyway
+
+    # -- aggregation ---------------------------------------------------------
+
+    def _worker_ops_port(self, index: int) -> Optional[int]:
+        try:
+            with open(os.path.join(
+                self.base_directory, f"worker{index}.ops_port"
+            )) as fh:
+                return int(fh.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _fetch_json(self, port: int, path: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=2
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            return None
+
+    def snapshot(self, probe_workers: bool = True) -> dict:
+        """The `GET /workers` operator view: per-worker process state,
+        queue depth, and (when probe_workers) each worker's own /healthz
+        verdict + flow counts aggregated over HTTP."""
+        out = {
+            "workers": self.n_workers,
+            "router_routed": self.router.routed if self.router else 0,
+            "router_to_supervisor": (
+                self.router.to_supervisor if self.router else 0
+            ),
+            "egress_forwarded": self.egress.forwarded if self.egress else 0,
+            "egress_dropped": self.egress.dropped if self.egress else 0,
+            "detail": {},
+        }
+        for w in self.workers:
+            entry = {
+                "alive": w.alive(),
+                "pid": w.proc.pid if w.proc is not None else None,
+                "respawns": w.respawns,
+                "last_exit": w.last_exit,
+                "queue_depth": self.broker.message_count(
+                    worker_queue(self.name, w.index)
+                ),
+                "ops_port": self._worker_ops_port(w.index),
+            }
+            out["detail"][f"w{w.index}"] = entry
+        if probe_workers:
+            # probe concurrently: one wedged worker costs ONE probe
+            # timeout for the whole /workers request, not one per worker
+            def _probe(entry: dict) -> None:
+                health = self._fetch_json(entry["ops_port"], "/healthz")
+                if health is not None:
+                    entry["healthz"] = health.get("status", health)
+
+            probes = [
+                threading.Thread(target=_probe, args=(e,), daemon=True)
+                for e in out["detail"].values()
+                if e["alive"] and e["ops_port"]
+            ]
+            for t in probes:
+                t.start()
+            deadline = time.monotonic() + 3
+            for t in probes:
+                t.join(timeout=max(0.1, deadline - time.monotonic()))
+        return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2)
+        for w in self.workers:
+            self._send_control(w.index, {"kind": "stop"})
+        deadline = time.monotonic() + 5
+        for w in self.workers:
+            if w.proc is None:
+                continue
+            try:
+                w.proc.terminate()
+                w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        if self.router is not None:
+            self.router.stop()
+        if self.egress is not None:
+            self.egress.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker-process side
+# ---------------------------------------------------------------------------
+
+class _PrefetchOneBroker:
+    """RemoteBroker facade whose consumers take prefetch=1: COMPETING
+    consumers (worker RPC servers sharing rpc.server.requests) must not
+    buffer requests an idle sibling could serve (net.RemoteConsumer
+    docstring)."""
+
+    def __init__(self, broker):
+        self._broker = broker
+
+    def __getattr__(self, name):
+        return getattr(self._broker, name)
+
+    def create_consumer(self, queue_name: str, prefetch: int = 1):
+        return self._broker.create_consumer(queue_name, prefetch=1)
+
+
+def make_worker_messaging(broker, me, worker_index: int):
+    """The worker's messaging service: a BrokerMessagingService that
+    consumes the worker's shard-addressed inbound leg and funnels every
+    outbound send through the shared egress queue (the supervisor's pump
+    owns bridge routing) — the pump, handlers, metrics and health
+    surface stay stock."""
+    from ..utils import tracing
+    from .network import BrokerMessagingService
+
+    class WorkerMessaging(BrokerMessagingService):
+        def send(self, peer, topic, payload, headers=None):
+            extra = headers
+            headers = {
+                "topic": topic, "sender": self.me.name,
+                "sender_key": self.me.owning_key.encoded.hex(),
+                "x-dest": peer.name,
+            }
+            if extra:
+                # e.g. the session route hint — rides through the
+                # egress pump so the PEER's router gets the fast path
+                headers.update(extra)
+            tp = tracing.current_traceparent()
+            if tp is not None:
+                headers[tracing.TRACEPARENT_HEADER] = tp
+            self.broker.send(EGRESS_QUEUE, payload, headers)
+
+    svc = WorkerMessaging(
+        broker, me, bridges=None, queue_suffix=f".w{worker_index}"
+    )
+    svc.worker_index = worker_index
+    return svc
+
+
+def run_worker(config_dir: str, index: int, n_workers: int,
+               broker_port: int) -> int:
+    """`python -m corda_tpu.node <dir> --shard-worker K` entry: one
+    worker process of a sharded node (module docstring)."""
+    from ..messaging.net import RemoteBroker
+    from ..rpc.ops import CordaRPCOps
+    from ..rpc.server import RPCServer, RPCUser
+    from .config import load_config
+    from .node import AbstractNode
+
+    cfg = load_config(config_dir, {})
+    base = cfg.base_directory
+    import importlib
+
+    for mod in cfg.cordapps:  # same CorDapp scan as the supervisor
+        importlib.import_module(mod)
+    if cfg.node.identity_entropy is None:
+        # the supervisor pinned the shared identity before spawning us
+        with open(os.path.join(base, "identity.entropy")) as fh:
+            cfg.node.identity_entropy = int(fh.read().strip())
+    # each worker serves its own ops endpoint on an ephemeral port; the
+    # supervisor discovers it through the port file for /workers
+    cfg.node.ops_port = 0
+    # worker verification is in-process BY DESIGN: the verify hot path
+    # scales with worker count (an OutOfProcess config would funnel all
+    # workers back into one shared pool — still possible, but opt-in by
+    # running the workers' node.conf unsharded)
+    cfg.node.verifier_type = "InMemory"
+
+    # TLS nodes wrap the supervisor's broker socket (pki.server_wrap in
+    # __main__); the worker must speak the same mutual TLS or its
+    # handshake fails and the supervisor respawn-loops it forever
+    client_wrap = None
+    if cfg.tls:
+        from ..core.crypto import pki
+
+        entries = pki.dev_certificates(
+            cfg.certificates_dir, cfg.node.my_legal_name
+        )
+        client_wrap = pki.client_wrap(
+            pki.client_ssl_context(cfg.certificates_dir, entries)
+        )
+
+    broker = RemoteBroker("127.0.0.1", broker_port, client_wrap=client_wrap)
+    node = AbstractNode(
+        cfg.node,
+        messaging_factory=lambda me: make_worker_messaging(broker, me, index),
+        broker=None,
+    )
+    node.smm.flow_id_tag = f"w{index}"
+    tag = f"w{index}-"
+    node.smm.checkpoint_filter = lambda fid: fid.startswith(tag)
+
+    users = [
+        RPCUser(u["username"], u["password"], set(u.get("permissions", ["ALL"])))
+        for u in cfg.rpc_users
+    ] or None
+    # competing consumer on the shared rpc.server.requests queue:
+    # prefetch=1 so an idle sibling can serve what this worker hasn't
+    # started yet (net.RemoteConsumer competing-consumer contract), and
+    # the shared session secret so a login any sibling served
+    # authenticates here too
+    rpc = RPCServer(
+        _PrefetchOneBroker(broker),
+        CordaRPCOps(node.services, node.smm), users=users,
+        session_secret=rpc_session_secret(cfg.node.identity_entropy),
+    )
+
+    stop = threading.Event()
+
+    def control_loop() -> None:
+        consumer = broker.create_consumer(control_queue(index))
+        while not stop.is_set():
+            msg = consumer.receive(timeout=0.5)
+            if msg is None:
+                continue
+            try:
+                record = deserialize(msg.payload)
+                if record.get("kind") == "peer":
+                    node.register_peer(
+                        record["party"], record.get("services", ())
+                    )
+                elif record.get("kind") == "stop":
+                    stop.set()
+            except Exception:
+                pass
+            finally:
+                try:
+                    consumer.ack(msg)
+                except Exception:
+                    pass
+
+    control = threading.Thread(
+        target=control_loop, name=f"shard-control-w{index}", daemon=True
+    )
+    control.start()
+    node.start()
+    if getattr(node, "ops_server", None) is not None:
+        tmp = os.path.join(base, f"worker{index}.ops_port.tmp")
+        with open(tmp, "w") as fh:
+            fh.write(str(node.ops_server.port))
+        os.replace(tmp, os.path.join(base, f"worker{index}.ops_port"))
+    print(f"worker ready: {cfg.node.my_legal_name} w{index}/{n_workers}",
+          flush=True)
+
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    exit_on_orphan = os.environ.get("CORDA_TPU_EXIT_ON_ORPHAN") == "1"
+    parent = os.getppid()
+    try:
+        while not stop.wait(0.5):
+            if exit_on_orphan and os.getppid() != parent:
+                break
+    finally:
+        rpc.stop()
+        node.stop()
+        try:
+            broker.close()
+        except Exception:
+            pass
+    return 0

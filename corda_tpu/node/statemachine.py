@@ -46,6 +46,7 @@ from ..core.serialization.codec import deserialize, serialize
 from ..utils import eventlog, tracing
 from ..utils.metrics import MetricRegistry
 from .session import (
+    ROUTE_HINT_HEADER,
     SESSION_TOPIC,
     FlowSession,
     SessionConfirm,
@@ -54,6 +55,7 @@ from .session import (
     SessionInit,
     SessionReject,
     SessionState,
+    route_hint,
 )
 
 
@@ -765,7 +767,21 @@ class StateMachineManager:
         # racing start_flow must not both pass a max_flows-1 reading.
         self.admission = None
         self._start_gate = threading.Lock()
+        # Multi-process sharding (node/shardhost.py): workers set a tag
+        # ("w0", "w1", …) that prefixes every flow id — and therefore
+        # every session id ("<flow id>:<n>") — so the supervisor's
+        # router can pin a session's messages to the worker that owns
+        # the flow without any shared session table. checkpoint_filter
+        # partitions restore the same way: a respawned worker must
+        # resume ITS flows from the shared db, never its siblings' live
+        # ones. Both default to the single-process behaviour.
+        self.flow_id_tag = ""
+        self.checkpoint_filter: Optional[Callable[[str], bool]] = None
         messaging.add_handler(SESSION_TOPIC, self._on_session_message)
+
+    def _new_flow_id(self) -> str:
+        fid = str(uuid.uuid4())
+        return f"{self.flow_id_tag}-{fid}" if self.flow_id_tag else fid
 
     # -- public API ---------------------------------------------------------
 
@@ -778,7 +794,7 @@ class StateMachineManager:
         Raises NodeOverloadedError (with a retry_after_ms hint) when an
         installed AdmissionController sheds the start — system flows
         (`_system_flow = True` classes) are priority and never shed."""
-        flow_id = str(uuid.uuid4())
+        flow_id = self._new_flow_id()
         fsm = FlowStateMachine(
             flow_id, flow, self, args=tuple(args_for_restore), kwargs=kw
         )
@@ -795,8 +811,15 @@ class StateMachineManager:
 
     def start(self) -> None:
         """Restore checkpointed flows and resume them (reference
-        restoreFibersFromCheckpoints, `StateMachineManager.kt:227-241`)."""
+        restoreFibersFromCheckpoints, `StateMachineManager.kt:227-241`).
+        With a checkpoint_filter (shardhost workers over a shared db)
+        only this manager's own partition restores."""
         for flow_id, blob in self.checkpoint_storage.all_checkpoints():
+            if (
+                self.checkpoint_filter is not None
+                and not self.checkpoint_filter(flow_id)
+            ):
+                continue
             self._restore(flow_id, blob)
 
     @property
@@ -921,14 +944,15 @@ class StateMachineManager:
                 # (seq 0) rides again from its persisted copy.
                 owner = fsm.session_owner_flows[local_id].split("#", 1)[0]
                 owner_cls = flow_registry.get(owner)
+                init = SessionInit(
+                    initiator_session_id=local_id,
+                    flow_name=owner,
+                    flow_version=getattr(owner_cls, "_flow_version", 1),
+                    first_payload=sess.init_payload,
+                )
                 self.messaging.send(
-                    sess.peer, SESSION_TOPIC,
-                    serialize(SessionInit(
-                        initiator_session_id=local_id,
-                        flow_name=owner,
-                        flow_version=getattr(owner_cls, "_flow_version", 1),
-                        first_payload=sess.init_payload,
-                    )),
+                    sess.peer, SESSION_TOPIC, serialize(init),
+                    headers={ROUTE_HINT_HEADER: route_hint(init)},
                 )
         self._notify("restored", fsm)
         fsm.start()
@@ -995,7 +1019,7 @@ class StateMachineManager:
         # admission counts them but can never shed them
         if self.admission is not None:
             self.admission.admit(flow=flow, is_responder=True)
-        flow_id = str(uuid.uuid4())
+        flow_id = self._new_flow_id()
         fsm = FlowStateMachine(
             flow_id, flow, self, args=(sender,), is_responder=True
         )
@@ -1109,7 +1133,13 @@ class StateMachineManager:
             fsm.deliver_ledger_commit(stx)
 
     def _send_session_message(self, party: Party, msg) -> None:
-        self.messaging.send(party, SESSION_TOPIC, serialize(msg))
+        # the route hint lets a sharded receiver's router pick the
+        # worker from headers alone (no payload decode on its thread)
+        hint = route_hint(msg)
+        self.messaging.send(
+            party, SESSION_TOPIC, serialize(msg),
+            headers={ROUTE_HINT_HEADER: hint} if hint else None,
+        )
 
     def _flow_finished(self, fsm: FlowStateMachine) -> None:
         self.checkpoint_storage.remove(fsm.flow_id)

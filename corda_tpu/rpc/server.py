@@ -15,6 +15,7 @@ Permissions (reference RPC users in node.conf): a user has a set like
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -37,13 +38,29 @@ class RPCUser:
 
 
 class RPCServer:
-    def __init__(self, broker: Broker, ops, users: Optional[list] = None):
+    def __init__(self, broker: Broker, ops, users: Optional[list] = None,
+                 session_secret: Optional[bytes] = None):
+        """`session_secret`: sharded nodes (node/shardhost.py) run M
+        worker RPC servers as COMPETING consumers on one request queue —
+        a login served by worker 2 must authenticate calls served by
+        worker 5, so with a shared secret the session token becomes
+        self-authenticating (HMAC over the username) instead of an entry
+        in one server's in-memory map. None keeps the classic per-server
+        uuid sessions."""
         self.broker = broker
         self.ops = ops
         self.users: Dict[str, RPCUser] = {
             u.username: u for u in (users or [RPCUser("admin", "admin")])
         }
+        self._session_secret = session_secret
         self._sessions: Dict[str, RPCUser] = {}
+        # logged-out HMAC tokens: without this, _session_user would
+        # happily re-verify (and re-cache) a popped token — logout must
+        # stick on the worker that served it, even though a stateless
+        # sibling can still honour the token (documented limitation of
+        # portable sessions; bounded so a logout storm can't grow it)
+        self._revoked: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
         self._subscriptions: Dict[str, Subscription] = {}
         # _handle runs on pool threads: session/subscription maps need a
         # lock (logout's iteration vs a concurrent subscribe would raise
@@ -191,7 +208,12 @@ class RPCServer:
                 sub.unsubscribe()
         elif kind == "logout":
             with self._state_lock:
-                self._sessions.pop(request.get("session", ""), None)
+                session = request.get("session", "")
+                self._sessions.pop(session, None)
+                if session.startswith("tok."):
+                    self._revoked[session] = None
+                    while len(self._revoked) > 4096:
+                        self._revoked.popitem(last=False)
                 # Drop this session's subscriptions (observable GC on
                 # disconnect).
                 prefix = request.get("session", "") + "/"
@@ -213,12 +235,61 @@ class RPCServer:
                 "error": "invalid credentials",
             })
             return
-        session = str(uuid.uuid4())
+        if self._session_secret is not None:
+            session = self._make_token(user.username)
+        else:
+            session = str(uuid.uuid4())
         with self._state_lock:
             self._sessions[session] = user
         self._reply(request["reply_to"], {
             "kind": "reply", "id": request["id"], "ok": session,
         })
+
+    def _make_token(self, username: str) -> str:
+        import hashlib
+        import hmac as _hmac
+
+        nonce = uuid.uuid4().hex
+        mac = _hmac.new(
+            self._session_secret, f"{username}.{nonce}".encode(),
+            hashlib.sha256,
+        ).hexdigest()
+        return f"tok.{username}.{nonce}.{mac}"
+
+    def _session_user(self, session: str) -> Optional[RPCUser]:
+        """The logged-in user for a session id: this server's own map
+        first, then (shared-secret mode) token verification — a sibling
+        worker issued it, this one honours it."""
+        with self._state_lock:
+            user = self._sessions.get(session)
+        if user is not None or self._session_secret is None:
+            return user
+        if not session.startswith("tok."):
+            return None
+        with self._state_lock:
+            if session in self._revoked:
+                return None
+        # split from the RIGHT: nonce and mac are hex (never contain a
+        # dot), the username may — 'tok.ops.admin.<nonce>.<mac>' must
+        # verify on every sibling worker
+        parts = session[len("tok."):].rsplit(".", 2)
+        if len(parts) != 3:
+            return None
+        import hashlib
+        import hmac as _hmac
+
+        username, nonce, mac = parts
+        expect = _hmac.new(
+            self._session_secret, f"{username}.{nonce}".encode(),
+            hashlib.sha256,
+        ).hexdigest()
+        if not _hmac.compare_digest(mac, expect):
+            return None
+        user = self.users.get(username)
+        if user is not None:
+            with self._state_lock:  # cache: subscriptions key off it
+                self._sessions[session] = user
+        return user
 
     def _permitted(self, user: RPCUser, method: str, args: tuple) -> bool:
         if "ALL" in user.permissions:
@@ -237,7 +308,7 @@ class RPCServer:
     def _handle_call(self, request: dict) -> None:
         reply_to = request["reply_to"]
         req_id = request["id"]
-        user = self._sessions.get(request.get("session", ""))
+        user = self._session_user(request.get("session", ""))
         if user is None:
             self._reply(reply_to, {
                 "kind": "reply", "id": req_id, "error": "not logged in",

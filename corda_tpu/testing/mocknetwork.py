@@ -22,6 +22,164 @@ class MockNode(AbstractNode):
     pass
 
 
+class _RaftBus:
+    """Deterministic in-process transport for one Raft consensus group
+    (virtual time advances only through elect()); `kill(i)` + `elect()`
+    drive leader-failover tests."""
+
+    def __init__(self):
+        from collections import deque
+
+        self.queue = deque()
+        self.nodes = {}        # raft id -> RaftNode
+        self.dead = set()
+        self._draining = False
+        self.now = 0.0
+
+    def send(self, src, dst, payload):
+        self.queue.append((src, dst, payload))
+        self.drain()
+
+    def drain(self):
+        if self._draining:
+            return
+        self._draining = True
+        try:
+            while self.queue:
+                src, dst, payload = self.queue.popleft()
+                if src in self.dead or dst in self.dead:
+                    continue
+                node = self.nodes.get(dst)
+                if node is not None:
+                    node.on_message(src, payload)
+        finally:
+            self._draining = False
+
+    def kill(self, raft_id: str) -> None:
+        self.dead.add(raft_id)
+
+    def revive(self, raft_id: str) -> None:
+        self.dead.discard(raft_id)
+
+    def leader(self):
+        from ..node.raft import LEADER
+
+        for rid, node in self.nodes.items():
+            if rid not in self.dead and node.role == LEADER:
+                return node
+        return None
+
+    def elect(self, max_ticks: int = 600):
+        """Advance virtual time until a live leader exists."""
+        for _ in range(max_ticks):
+            ldr = self.leader()
+            if ldr is not None:
+                return ldr
+            self.now += 0.05
+            for rid, node in self.nodes.items():
+                if rid not in self.dead:
+                    node.tick(self.now)
+            self.drain()
+        raise RuntimeError("no raft leader elected")
+
+
+class _RaftClusterProvider:
+    """Commit via the current leader, retrying across elections —
+    the client-side failover the reference gets from CopycatClient."""
+
+    def __init__(self, providers, bus):
+        # raft id -> RaftUniquenessProvider; public so tests and
+        # the multichip dryrun can observe per-REPLICA state
+        # (replication evidence, not just the cluster answer)
+        self.member_providers = providers
+        self.bus = bus
+
+    def commit(self, states, tx_id, requesting_party):
+        from ..node.raft import NotLeaderError
+
+        last_exc = None
+        for _ in range(5):
+            leader = self.bus.elect()
+            provider = self.member_providers[leader.node_id]
+            try:
+                return provider.commit(states, tx_id, requesting_party)
+            except NotLeaderError as exc:  # lost leadership mid-commit
+                last_exc = exc
+                self.bus.now += 1.0
+        raise last_exc
+
+    def commit_many(self, requests):
+        """Batched commits ride ONE Raft log entry on the current
+        leader (same failover-retry loop as commit)."""
+        from ..node.raft import NotLeaderError
+
+        last_exc = None
+        for _ in range(5):
+            leader = self.bus.elect()
+            provider = self.member_providers[leader.node_id]
+            try:
+                return provider.commit_many(requests)
+            except NotLeaderError as exc:
+                last_exc = exc
+                self.bus.now += 1.0
+        raise last_exc
+
+    def probe_commits(self, keys):
+        """Committed-state read (sharded cross-shard prepare) from the
+        current leader's APPLIED log."""
+        leader = self.bus.elect()
+        return self.member_providers[leader.node_id].probe_commits(keys)
+
+    def is_consumed(self, ref) -> bool:
+        return any(
+            p.is_consumed(ref)
+            for p in self.member_providers.values()
+        )
+
+    def replicas_consumed(self, ref) -> int:
+        """How many replicas' APPLIED logs know `ref` as spent."""
+        return sum(
+            1 for p in self.member_providers.values()
+            if p.is_consumed(ref)
+        )
+
+
+def make_raft_commit_group(n_replicas: int = 3, seed_base: int = 0):
+    """One standalone Raft consensus group over the commit log: the
+    building block a sharded notary runs PER SHARD (docs/sharding.md —
+    `MockNetwork.create_sharded_notary_node`). Returns (provider, bus);
+    `bus.kill(bus.elect().node_id)` is the shard-leader-kill seam."""
+    from ..node.database import NodeDatabase
+    from ..node.notary import RaftUniquenessProvider
+    from ..node.raft import RaftNode
+
+    bus = _RaftBus()
+    ids = [f"r{i}" for i in range(n_replicas)]
+    providers = {}
+
+    def make_transport(src):
+        def transport(dst, payload):
+            bus.send(src, dst, payload)
+        return transport
+
+    def make_apply(rid):
+        def apply(cmd):
+            return providers[rid].apply(cmd)
+        return apply
+
+    for i, rid in enumerate(ids):
+        node = RaftNode(
+            rid, ids, make_transport(rid), make_apply(rid),
+            db=NodeDatabase(":memory:"), seed=seed_base + i,
+        )
+        bus.nodes[rid] = node
+        providers[rid] = RaftUniquenessProvider(
+            node, NodeDatabase(":memory:")
+        )
+    bus.elect()
+    return _RaftClusterProvider(providers, bus), bus
+
+
 class MockNetwork:
     def __init__(self, default_clock=None):
         """default_clock: shared zero-arg clock for all nodes (a TestClock
@@ -49,11 +207,15 @@ class MockNetwork:
         admission_rate: Optional[float] = None,
         admission_burst: Optional[float] = None,
         admission_max_flows: Optional[int] = None,
+        shards: Optional[int] = None,
     ) -> MockNode:
         """`ops_port`: pass 0 to serve this node's /metrics + /traces on
         an ephemeral port (node.ops_server.port); None = no endpoint.
         `admission_*`: overload-protection knobs (docs/robustness.md) —
-        with neither rate nor max_flows set, admission is inert."""
+        with neither rate nor max_flows set, admission is inert.
+        `shards`: partition a notary node's uniqueness provider into N
+        state-ref-keyed shards with two-phase cross-shard commits
+        (docs/sharding.md); None keeps the unsharded default."""
         config = NodeConfiguration(
             my_legal_name=legal_name,
             db_path=db_path,
@@ -64,6 +226,7 @@ class MockNetwork:
             admission_rate=admission_rate,
             admission_burst=admission_burst,
             admission_max_flows=admission_max_flows,
+            shards=shards,
         )
         node = MockNode(
             config, self.messaging_network.create_endpoint,
@@ -83,9 +246,11 @@ class MockNetwork:
 
     def create_notary_node(
         self, legal_name: str = "O=Notary,L=Zurich,C=CH", validating: bool = True,
+        shards: Optional[int] = None,
     ) -> MockNode:
         return self.create_node(
-            legal_name, notary_type="validating" if validating else "simple"
+            legal_name, notary_type="validating" if validating else "simple",
+            shards=shards,
         )
 
     def _assemble_cluster(
@@ -311,111 +476,11 @@ class MockNetwork:
         Returns (cluster_party, [member_nodes], raft_bus). The bus
         supports `bus.kill(i)` + `bus.elect()` for leader-failover tests.
         """
-        from collections import deque
-
         from ..node.database import NodeDatabase
         from ..node.notary import RaftUniquenessProvider
-        from ..node.raft import LEADER, NotLeaderError, RaftNode
-
-        class _RaftBus:
-            def __init__(self):
-                self.queue = deque()
-                self.nodes = {}        # raft id -> RaftNode
-                self.dead = set()
-                self._draining = False
-                self.now = 0.0
-
-            def send(self, src, dst, payload):
-                self.queue.append((src, dst, payload))
-                self.drain()
-
-            def drain(self):
-                if self._draining:
-                    return
-                self._draining = True
-                try:
-                    while self.queue:
-                        src, dst, payload = self.queue.popleft()
-                        if src in self.dead or dst in self.dead:
-                            continue
-                        node = self.nodes.get(dst)
-                        if node is not None:
-                            node.on_message(src, payload)
-                finally:
-                    self._draining = False
-
-            def kill(self, raft_id: str) -> None:
-                self.dead.add(raft_id)
-
-            def leader(self):
-                for rid, node in self.nodes.items():
-                    if rid not in self.dead and node.role == LEADER:
-                        return node
-                return None
-
-            def elect(self, max_ticks: int = 600):
-                """Advance virtual time until a live leader exists."""
-                for _ in range(max_ticks):
-                    ldr = self.leader()
-                    if ldr is not None:
-                        return ldr
-                    self.now += 0.05
-                    for rid, node in self.nodes.items():
-                        if rid not in self.dead:
-                            node.tick(self.now)
-                    self.drain()
-                raise RuntimeError("no raft leader elected")
+        from ..node.raft import RaftNode
 
         bus = _RaftBus()
-
-        class _RaftClusterProvider:
-            """Commit via the current leader, retrying across elections —
-            the client-side failover the reference gets from CopycatClient."""
-
-            def __init__(self, providers):
-                # raft id -> RaftUniquenessProvider; public so tests and
-                # the multichip dryrun can observe per-REPLICA state
-                # (replication evidence, not just the cluster answer)
-                self.member_providers = providers
-
-            def commit(self, states, tx_id, requesting_party):
-                last_exc = None
-                for _ in range(5):
-                    leader = bus.elect()
-                    provider = self.member_providers[leader.node_id]
-                    try:
-                        return provider.commit(states, tx_id, requesting_party)
-                    except NotLeaderError as exc:  # lost leadership mid-commit
-                        last_exc = exc
-                        bus.now += 1.0
-                raise last_exc
-
-            def commit_many(self, requests):
-                """Batched commits ride ONE Raft log entry on the current
-                leader (same failover-retry loop as commit)."""
-                last_exc = None
-                for _ in range(5):
-                    leader = bus.elect()
-                    provider = self.member_providers[leader.node_id]
-                    try:
-                        return provider.commit_many(requests)
-                    except NotLeaderError as exc:
-                        last_exc = exc
-                        bus.now += 1.0
-                raise last_exc
-
-            def is_consumed(self, ref) -> bool:
-                return any(
-                    p.is_consumed(ref)
-                    for p in self.member_providers.values()
-                )
-
-            def replicas_consumed(self, ref) -> int:
-                """How many replicas' APPLIED logs know `ref` as spent."""
-                return sum(
-                    1 for p in self.member_providers.values()
-                    if p.is_consumed(ref)
-                )
 
         def provider_factory(cluster, members):
             ids = [f"r{i}" for i in range(len(members))]
@@ -441,13 +506,40 @@ class MockNetwork:
                     node, NodeDatabase(":memory:")
                 )
             bus.elect()
-            return _RaftClusterProvider(providers)
+            return _RaftClusterProvider(providers, bus)
 
         cluster, members = self._assemble_cluster(
             n_members, cluster_name, "Raft Member", validating=validating,
             threshold=1, provider_factory=provider_factory,
         )
         return cluster, members, bus
+
+    def create_sharded_notary_node(
+        self,
+        n_shards: int = 2,
+        legal_name: str = "O=Sharded Notary,L=Zurich,C=CH",
+        validating: bool = True,
+        raft_members: int = 3,
+    ):
+        """ONE notary node whose uniqueness provider partitions the
+        commit log across `n_shards` INDEPENDENT Raft consensus groups
+        (one group per shard — the segmented multi-domain topology,
+        docs/sharding.md). Returns (node, sharded_provider, [bus per
+        shard]); `buses[k].kill(buses[k].elect().node_id)` is the
+        shard-leader-kill seam, quorum re-election included."""
+        from ..node.notary import maybe_coalesced
+        from ..node.sharded_notary import ShardedUniquenessProvider
+
+        node = self.create_node(
+            legal_name, notary_type="validating" if validating else "simple",
+        )
+        groups = [
+            make_raft_commit_group(raft_members, seed_base=100 * i)
+            for i in range(n_shards)
+        ]
+        provider = ShardedUniquenessProvider([g for g, _ in groups])
+        node.notary_service.uniqueness_provider = maybe_coalesced(provider)
+        return node, provider, [bus for _, bus in groups]
 
     @property
     def tracer(self):

@@ -167,6 +167,12 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
             conf["notary_type"] = n["notary"]
         if n.get("verifier_type"):
             conf["verifier_type"] = n["verifier_type"]
+        if n.get("shards") is not None:
+            conf["shards"] = int(n["shards"])
+        if n.get("node_workers") is not None:
+            conf["node_workers"] = int(n["node_workers"])
+        if n.get("ops_port") is not None:
+            conf["ops_port"] = int(n["ops_port"])
         if n.get("identity_entropy") is not None:
             conf["identity_entropy"] = n["identity_entropy"]
         if n.get("raft_cluster"):

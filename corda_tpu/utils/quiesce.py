@@ -46,6 +46,9 @@ DEFAULT_TTL_S = 3600.0
 #: environment)
 FINGERPRINT_KEYS = (
     "backend", "device", "python", "jax", "numpy", "platform", "cpus",
+    # sharding topology (docs/sharding.md): readings taken at different
+    # shard/worker counts must not hard-compare
+    "shards", "node_workers",
 )
 
 _lock = threading.RLock()
@@ -174,14 +177,21 @@ def quiesce(expected_s: Optional[float] = None,
 
 # -- environment fingerprint --------------------------------------------------
 
-def env_fingerprint() -> Dict:
+def env_fingerprint(shards: Optional[int] = None,
+                    node_workers: Optional[int] = None) -> Dict:
     """What kind of box/backend produced this measurement, without
     initializing anything: backend/device are read only when jax is
     imported AND its backend is already initialized (the xla_bridge
     probe core/crypto/batch.py uses) — `jax.default_backend()` on an
     uninitialized process would pay multi-second client setup, or hang
     through a dead accelerator tunnel, for a read that is supposed to
-    REPORT state, not create it."""
+    REPORT state, not create it.
+
+    `shards` / `node_workers` override the CORDA_TPU_* env reads: a
+    harness that enables the topology by PARAMETER (bench.py passes
+    `shards=4` into the loadtest, never the env var) must stamp what it
+    actually ran, or every record fingerprints as unsharded and the
+    gate's different-topology-⇒-no-hard-compare guard never fires."""
     backend = "uninitialized"
     device = None
     jax_version = None
@@ -211,6 +221,17 @@ def env_fingerprint() -> Dict:
         "cpus": os.cpu_count(),
         "quiesced": is_quiesced(),
         "profiler_active": _profiler_active(),
+        # horizontal-scale knobs (docs/sharding.md): a reading taken
+        # with a different shard/worker topology is a different machine
+        # as far as cross-round comparison goes
+        "shards": int(
+            shards if shards is not None
+            else os.environ.get("CORDA_TPU_SHARDS", "0") or 0
+        ),
+        "node_workers": int(
+            node_workers if node_workers is not None
+            else os.environ.get("CORDA_TPU_NODE_WORKERS", "0") or 0
+        ),
     }
     return fp
 
@@ -233,7 +254,16 @@ def fingerprint_mismatch(prev: Optional[Dict],
         return []
     out = []
     for key in FINGERPRINT_KEYS:
-        if key in prev and key in cur and prev.get(key) != cur.get(key):
+        if key in ("shards", "node_workers"):
+            # topology keys default to 0 (unsharded/single-process) when
+            # a side predates them: a pre-r13 baseline without "shards"
+            # WAS an unsharded run, and hard-comparing it against a
+            # shards=4 reading is exactly the cross-topology comparison
+            # this guard demotes to a warning
+            a, b = prev.get(key, 0), cur.get(key, 0)
+            if a != b:
+                out.append({"key": key, "prev": a, "cur": b})
+        elif key in prev and key in cur and prev.get(key) != cur.get(key):
             out.append({"key": key, "prev": prev.get(key),
                         "cur": cur.get(key)})
     return out

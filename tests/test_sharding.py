@@ -1,0 +1,1433 @@
+"""Sharded node + partitioned uniqueness provider (docs/sharding.md).
+
+Tier-1 coverage for PR 8, all in-process (MockNetwork / in-process
+Broker — no real OS workers; the real-process path is exercised by
+loadtest/real.py --node-workers and the shard_ab bench harness):
+
+  * stable shard routing (txhash-prefix locality) + session routing
+  * single-shard grouping, cross-shard two-phase commit, per-tx
+    conflict attribution across shards (rejected exactly once)
+  * prepare-expiry after coordinator death; journal recovery re-drives
+    a decided commit and releases an undecided prepare
+  * concurrent cross-shard commits over overlapping refs linearise
+  * CoalescingUniquenessProvider shard-awareness (one round per shard)
+  * MockNetwork `shards=` end-to-end + sharded-raft notary with a
+    shard-leader kill
+  * ShardRouter / EgressPump over an in-process Broker, with the eager
+    queue registration the PR-3 gauges / PR-5 caps rely on
+  * portable RPC session tokens (competing worker RPC servers)
+"""
+import hashlib
+import threading
+import time
+
+import pytest
+
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.node.database import NodeDatabase
+from corda_tpu.node.notary import (
+    CoalescingUniquenessProvider,
+    Conflict,
+    PersistentUniquenessProvider,
+    UniquenessException,
+    default_uniqueness_provider,
+)
+from corda_tpu.node.sharded_notary import (
+    CoordinatorCrashError,
+    ShardedUniquenessProvider,
+    shard_of_key,
+)
+from corda_tpu.testing import faults
+
+
+class _Party:
+    name = "O=Test,L=London,C=GB"
+
+
+PARTY = _Party()
+
+
+def tx_id_of(tag: str) -> SecureHash:
+    return SecureHash(hashlib.sha256(tag.encode()).digest())
+
+
+def ref_on_shard(shard: int, n_shards: int, tag: str = "r",
+                 index: int = 0) -> StateRef:
+    """A StateRef routing to `shard` (brute-forced nonce)."""
+    for nonce in range(100_000):
+        h = hashlib.sha256(f"{tag}-{nonce}".encode()).digest()
+        ref = StateRef(SecureHash(h), index)
+        key = h + index.to_bytes(4, "big")
+        if shard_of_key(key, n_shards) == shard:
+            return ref
+    raise AssertionError("no nonce found")
+
+
+def make_provider(n_shards: int = 4, db=None, **kw):
+    if db is not None:
+        return ShardedUniquenessProvider.over_database(db, n_shards, **kw)
+    return ShardedUniquenessProvider(
+        [PersistentUniquenessProvider(NodeDatabase(":memory:"))
+         for _ in range(n_shards)],
+        **kw,
+    )
+
+
+class TestRouting:
+    def test_stable_and_in_range(self):
+        key = hashlib.sha256(b"k").digest() + (0).to_bytes(4, "big")
+        assert shard_of_key(key, 4) == shard_of_key(key, 4)
+        for n in (1, 2, 4, 7):
+            assert 0 <= shard_of_key(key, n) < n
+
+    def test_txhash_prefix_locality(self):
+        """All outputs of one source tx co-locate (the common spend
+        commits single-shard); conflict detection still holds because
+        both spenders of a ref hash the same 32 bytes."""
+        h = hashlib.sha256(b"src").digest()
+        shards = {
+            shard_of_key(h + i.to_bytes(4, "big"), 4) for i in range(16)
+        }
+        assert len(shards) == 1
+
+    def test_shards_of_empty_is_shard0(self):
+        p = make_provider(4)
+        assert p.shards_of([]) == [0]
+
+    def test_session_routing(self):
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.session import (
+            SessionConfirm,
+            SessionData,
+            SessionInit,
+        )
+        from corda_tpu.node.shardhost import (
+            route_session_payload,
+            worker_tag_of,
+        )
+
+        assert worker_tag_of("w3-abc:2") == 3
+        assert worker_tag_of("abc") is None
+        # data routes by the recipient id's worker tag
+        data = serialize(SessionData("w1-f:0", 0, b"x"))
+        assert route_session_payload(data, 4) == 1
+        # confirm routes by the initiator id's tag
+        conf = serialize(SessionConfirm("w2-f:0", "peer:1"))
+        assert route_session_payload(conf, 4) == 2
+        # untagged ids (supervisor-started flows) fall to the supervisor
+        assert route_session_payload(
+            serialize(SessionData("plain:0", 0, b"x")), 4
+        ) is None
+        # init has no owner: stable hash, same worker on retransmit
+        init = serialize(SessionInit("sess-1", "Flow", 1, b""))
+        k = route_session_payload(init, 4)
+        assert k is not None and route_session_payload(init, 4) == k
+        # junk falls to the supervisor instead of raising
+        assert route_session_payload(b"\xff\xfe junk", 4) is None
+
+
+class TestShardedProvider:
+    def test_single_shard_groups_one_round_per_shard(self):
+        p = make_provider(4)
+        reqs = []
+        for shard in (0, 0, 1, 1, 1, 3):
+            ref = ref_on_shard(shard, 4, tag=f"g{len(reqs)}")
+            reqs.append(([ref], tx_id_of(f"tx{len(reqs)}"), PARTY))
+        results = p.commit_many(reqs)
+        assert results == [None] * 6
+        assert p.single_commits == 6
+        assert p.cross_commits == 0
+        # one delegate round per touched shard, never one per request
+        assert p.shard_rounds[0] == 1
+        assert p.shard_rounds[1] == 1
+        assert p.shard_rounds[3] == 1
+        assert p.shard_rounds[2] == 0
+
+    def test_cross_shard_commit_and_consumed(self):
+        p = make_provider(4)
+        a = ref_on_shard(0, 4, tag="xa")
+        b = ref_on_shard(2, 4, tag="xb")
+        p.commit([a, b], tx_id_of("cross"), PARTY)
+        assert p.cross_commits == 1
+        assert p.is_consumed(a) and p.is_consumed(b)
+        # the journal drained: nothing left to recover
+        assert p.journal.items() == []
+
+    def test_double_spend_across_shards_rejected_once(self):
+        """A double-spend whose two spends land on DIFFERENT shards is
+        rejected exactly once, attributed to the committed tx."""
+        p = make_provider(4)
+        a = ref_on_shard(0, 4, tag="da")
+        b = ref_on_shard(1, 4, tag="db")
+        c = ref_on_shard(1, 4, tag="dc")
+        p.commit([a, b], tx_id_of("winner"), PARTY)
+        with pytest.raises(UniquenessException) as exc:
+            p.commit([a, c], tx_id_of("loser"), PARTY)
+        conflict = exc.value.conflict
+        assert isinstance(conflict, Conflict)
+        assert conflict.tx_id == tx_id_of("loser")
+        # attribution names the spent ref and the consuming tx
+        assert repr(a) in conflict.consumed
+        assert conflict.consumed[repr(a)] == tx_id_of("winner")
+        # the loser's OTHER input was never committed anywhere
+        assert not p.is_consumed(c)
+        # and retrying the loser reports the SAME verdict (no wedge)
+        with pytest.raises(UniquenessException):
+            p.commit([a, c], tx_id_of("loser"), PARTY)
+
+    def test_batchmate_contention_one_winner(self):
+        """Two cross-shard txs in ONE drained round contending for one
+        ref: exactly one commits, the other gets a Conflict."""
+        p = make_provider(4)
+        shared = ref_on_shard(0, 4, tag="shared")
+        b = ref_on_shard(1, 4, tag="mb")
+        c = ref_on_shard(2, 4, tag="mc")
+        results = p.commit_many([
+            ([shared, b], tx_id_of("m1"), PARTY),
+            ([shared, c], tx_id_of("m2"), PARTY),
+        ])
+        winners = [r for r in results if r is None]
+        losers = [r for r in results if r is not None]
+        assert len(winners) == 1 and len(losers) == 1
+        assert repr(shared) in losers[0].consumed
+        assert p.cross_commits == 1 and p.cross_aborts == 1
+
+    def test_reservation_blocks_single_shard_spend(self):
+        """A live cross-shard prepare holds its refs against competing
+        single-shard spends (attributed to the reserving tx)."""
+        clock = [1000.0]
+        p = make_provider(4, clock=lambda: clock[0])
+        a = ref_on_shard(0, 4, tag="ra")
+        b = ref_on_shard(1, 4, tag="rb")
+        with faults.inject(seed=1) as fi:
+            fi.rule("sharded.finalise", "crash", match="s0", times=1)
+            with pytest.raises(CoordinatorCrashError):
+                p.commit([a, b], tx_id_of("crosser"), PARTY)
+        # reservations survive the coordinator death; a single-shard
+        # spend of a reserved ref loses, attributed to the reserver
+        res = p.commit_many([([a], tx_id_of("single"), PARTY)])[0]
+        assert res is not None
+        assert res.consumed[repr(a)] == tx_id_of("crosser")
+        assert p.reservation_conflicts >= 1
+
+    def test_prepare_expiry_releases_after_coordinator_death(self):
+        """Coordinator dies mid-prepare; its reservations release by
+        EXPIRY — the competing spend succeeds once the TTL passes even
+        with no recovery pass."""
+        clock = [1000.0]
+        p = make_provider(4, clock=lambda: clock[0], prepare_ttl_s=5.0)
+        a = ref_on_shard(0, 4, tag="ea")
+        b = ref_on_shard(3, 4, tag="eb")
+        with faults.inject(seed=2) as fi:
+            # crash AFTER shard 0 reserved, before shard 3
+            fi.rule("sharded.prepare", "crash", match="s3", times=1)
+            with pytest.raises(CoordinatorCrashError):
+                p.commit([a, b], tx_id_of("dead"), PARTY)
+        # inside the TTL the ref is held
+        res = p.commit_many([([a], tx_id_of("early"), PARTY)])[0]
+        assert res is not None
+        clock[0] += 6.0  # past the TTL: the lock has died
+        p.commit([a], tx_id_of("late"), PARTY)
+        assert p.is_consumed(a)
+
+    def test_recovery_redrives_decided_commit(self):
+        """Crash AFTER the journal flipped to "committing": a restarted
+        provider re-drives the finalise on every shard — the commit is
+        decided, never rolled back."""
+        db = NodeDatabase(":memory:")
+        p = make_provider(4, db=db)
+        a = ref_on_shard(0, 4, tag="ca")
+        b = ref_on_shard(1, 4, tag="cb")
+        with faults.inject(seed=3) as fi:
+            fi.rule("sharded.finalise", "crash", match="s1", times=1)
+            with pytest.raises(CoordinatorCrashError):
+                p.commit([a, b], tx_id_of("decided"), PARTY)
+        # shard 0 finalised, shard 1 did not: the ref set is torn until
+        # recovery; a successor provider over the same db heals it
+        p2 = ShardedUniquenessProvider.over_database(db, 4)
+        assert p2.recovered_commits == 1
+        assert p2.is_consumed(a) and p2.is_consumed(b)
+        assert p2.journal.items() == []
+        # the re-driven commit is idempotent: same tx commits clean
+        p2.commit([a, b], tx_id_of("decided"), PARTY)
+        # and a double-spend still loses with the right attribution
+        with pytest.raises(UniquenessException) as exc:
+            p2.commit([a], tx_id_of("thief"), PARTY)
+        assert repr(a) in exc.value.conflict.consumed
+
+    def test_recovery_releases_undecided_prepare(self):
+        """Crash BEFORE every shard prepared: recovery aborts the round
+        ONCE EXPIRED — the reservations release and the journal drains,
+        so the refs are spendable again. Before the TTL passes the round
+        is presumed to belong to a LIVE sibling coordinator (shared-db
+        mode runs many workers): a takeover provider must leave it
+        alone, or it would release reservations the owner is about to
+        finalise against."""
+        clock = [1000.0]
+        db = NodeDatabase(":memory:")
+        p = make_provider(4, db=db, clock=lambda: clock[0],
+                          prepare_ttl_s=5.0)
+        a = ref_on_shard(0, 4, tag="ua")
+        b = ref_on_shard(2, 4, tag="ub")
+        with faults.inject(seed=4) as fi:
+            fi.rule("sharded.prepare", "crash", match="s2", times=1)
+            with pytest.raises(CoordinatorCrashError):
+                p.commit([a, b], tx_id_of("undecided"), PARTY)
+        # inside the TTL: presumed live, untouched (reservations held)
+        p_live = ShardedUniquenessProvider.over_database(
+            db, 4, clock=lambda: clock[0]
+        )
+        assert p_live.recovered_aborts == 0
+        assert len(p_live.journal.items()) == 1
+        # past the TTL: genuinely dead — abort, release, drain
+        clock[0] += 6.0
+        p2 = ShardedUniquenessProvider.over_database(
+            db, 4, clock=lambda: clock[0]
+        )
+        assert p2.recovered_aborts >= 1
+        assert p2.journal.items() == []
+        assert not p2.is_consumed(a) and not p2.is_consumed(b)
+        p2.commit([a, b], tx_id_of("successor"), PARTY)  # no wedge
+
+    def test_concurrent_overlapping_cross_commits_linearise(self):
+        """N threads race cross-shard commits over overlapping refs:
+        exactly one winner per contended ref, every loser gets a
+        Conflict, nobody deadlocks."""
+        p = make_provider(4)
+        shared = ref_on_shard(1, 4, tag="hot")
+        outcomes = {}
+        lock = threading.Lock()
+
+        def spend(i):
+            other = ref_on_shard((i % 3) + 1 if (i % 3) + 1 != 1 else 3, 4,
+                                 tag=f"t{i}")
+            try:
+                p.commit([shared, other], tx_id_of(f"racer{i}"), PARTY)
+                with lock:
+                    outcomes[i] = "won"
+            except UniquenessException as exc:
+                assert repr(shared) in exc.conflict.consumed
+                with lock:
+                    outcomes[i] = "lost"
+
+        threads = [
+            threading.Thread(target=spend, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "cross-shard commit deadlocked"
+        assert sum(1 for v in outcomes.values() if v == "won") == 1
+        assert sum(1 for v in outcomes.values() if v == "lost") == 7
+        # no reservations left dangling after the storm
+        assert p.reservations.holders(
+            [PersistentUniquenessProvider._key(shared)], p.clock()
+        ) == {}
+
+    def test_issuance_empty_inputs_commits(self):
+        p = make_provider(4)
+        p.commit([], tx_id_of("issue"), PARTY)
+        assert p.single_commits == 1
+
+
+class TestDefaults:
+    def test_unsharded_default_unchanged(self, monkeypatch):
+        monkeypatch.delenv("CORDA_TPU_SHARDS", raising=False)
+        p = default_uniqueness_provider(NodeDatabase(":memory:"))
+        assert isinstance(p, PersistentUniquenessProvider)
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_SHARDS", "3")
+        p = default_uniqueness_provider(NodeDatabase(":memory:"))
+        assert isinstance(p, ShardedUniquenessProvider)
+        assert p.n_shards == 3
+
+    def test_explicit_beats_env(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_SHARDS", "3")
+        p = default_uniqueness_provider(NodeDatabase(":memory:"), shards=1)
+        assert isinstance(p, PersistentUniquenessProvider)
+
+    def test_file_backed_uses_per_shard_files(self, tmp_path):
+        import os
+
+        db = NodeDatabase(str(tmp_path / "node.db"))
+        p = default_uniqueness_provider(db, shards=2)
+        assert isinstance(p, ShardedUniquenessProvider)
+        assert os.path.exists(str(tmp_path / "shards" / "shard0.db"))
+        assert os.path.exists(str(tmp_path / "shards" / "shard1.db"))
+        # cross-process-safe coordination state lives in the node db
+        a = ref_on_shard(0, 2, tag="fa")
+        b = ref_on_shard(1, 2, tag="fb")
+        p.commit([a, b], tx_id_of("filecross"), PARTY)
+        p2 = default_uniqueness_provider(db, shards=2)
+        assert p2.is_consumed(a) and p2.is_consumed(b)
+
+
+class TestCoalescingShardAwareness:
+    class _SpyShardedDelegate:
+        """A shard-routing delegate recording every commit_many round."""
+
+        def __init__(self, n_shards=4):
+            self.n_shards = n_shards
+            self.rounds = []  # (thread name, n requests)
+
+        def shard_of(self, ref):
+            return shard_of_key(
+                PersistentUniquenessProvider._key(ref), self.n_shards
+            )
+
+        def shards_of(self, states):
+            return sorted({self.shard_of(r) for r in states}) or [0]
+
+        def commit_many(self, requests):
+            self.rounds.append(
+                (threading.current_thread().name, len(requests))
+            )
+            return [None] * len(requests)
+
+    def test_mixed_batch_groups_by_shard(self):
+        """A mixed coalesced batch dispatches ONE commit_many PER SHARD
+        GROUP (cross-shard requests form their own group), concurrently —
+        never one round per request."""
+        spy = self._SpyShardedDelegate(4)
+        c = CoalescingUniquenessProvider(spy)
+        reqs = []
+        for shard in (0, 0, 1):
+            ref = ref_on_shard(shard, 4, tag=f"cg{len(reqs)}")
+            reqs.append(([ref], tx_id_of(f"ct{len(reqs)}"), PARTY))
+        # one cross-shard request rides the same batch
+        reqs.append((
+            [ref_on_shard(2, 4, tag="cgx"), ref_on_shard(3, 4, tag="cgy")],
+            tx_id_of("ctx"), PARTY,
+        ))
+        results = c._commit_many_by_shard(reqs)
+        assert results == [None] * 4
+        # 3 groups: shard 0 (2 reqs), shard 1 (1 req), cross (1 req)
+        assert sorted(n for _, n in spy.rounds) == [1, 1, 2]
+        # groups ran on dedicated threads (concurrent dispatch)
+        assert all(
+            name.startswith("uniq-shard-") for name, _ in spy.rounds
+        )
+
+    def test_single_group_skips_threads(self):
+        spy = self._SpyShardedDelegate(4)
+        c = CoalescingUniquenessProvider(spy)
+        ref = ref_on_shard(1, 4, tag="sg")
+        results = c._commit_many_by_shard(
+            [([ref], tx_id_of("sg1"), PARTY)]
+        )
+        assert results == [None]
+        # no thread fan-out for a single group
+        assert spy.rounds[0][0] == threading.current_thread().name
+
+    def test_coalesced_end_to_end_over_sharded(self):
+        """The production stack: Coalescing over Sharded — concurrent
+        commits from many threads all land, conflicts attributed."""
+        p = make_provider(4)
+        c = CoalescingUniquenessProvider(p)
+        refs = [ref_on_shard(i % 4, 4, tag=f"e{i}") for i in range(12)]
+        errs = []
+
+        def commit(i):
+            try:
+                c.commit([refs[i]], tx_id_of(f"e{i}"), PARTY)
+            except BaseException as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [
+            threading.Thread(target=commit, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        assert all(p.is_consumed(r) for r in refs)
+
+
+class TestMockNetworkSharded:
+    def _pay_pairs(self, net, notary, bank, n):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.finance.flows import CashIssueFlow, CashPaymentFlow
+
+        for i in range(n):
+            h = bank.start_flow(CashIssueFlow(
+                Amount(100, "USD"), bytes([i + 1]), bank.info, notary.info
+            ))
+            net.run_network()
+            h.result.result(timeout=5)
+            token = Issued(bank.info.ref(i + 1), "USD")
+            h2 = bank.start_flow(CashPaymentFlow(
+                Amount(100, token), bank.info, notary.info
+            ))
+            net.run_network()
+            h2.result.result(timeout=5)
+
+    def test_create_node_shards_end_to_end(self):
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        notary = net.create_notary_node(shards=4)
+        bank = net.create_node("O=SA,L=London,C=GB")
+        provider = notary.notary_service.uniqueness_provider
+        sharded = provider.delegate  # coalescing wraps the sharded one
+        assert isinstance(sharded, ShardedUniquenessProvider)
+        self._pay_pairs(net, notary, bank, 3)
+        stats = sharded.stats()
+        assert stats["single_commits"] + stats["cross_commits"] >= 3
+        net.stop_nodes()
+
+    def test_sharded_raft_notary_leader_kill(self):
+        """One notary, 2 shards, one Raft consensus group each: kill a
+        shard's LEADER mid-run — the quorum re-elects and commits
+        resume; no double-spend is admitted through the window."""
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        notary, provider, buses = net.create_sharded_notary_node(
+            n_shards=2
+        )
+        bank = net.create_node("O=SR,L=London,C=GB")
+        self._pay_pairs(net, notary, bank, 2)
+        # kill shard 0's current leader
+        victim = buses[0].elect()
+        buses[0].kill(victim.node_id)
+        # commits resume through the re-elected quorum
+        self._pay_pairs(net, notary, bank, 2)
+        new_leader = buses[0].elect()
+        assert new_leader.node_id != victim.node_id
+        # double-spend probe through the provider during the window:
+        # spend an already-spent ref, expect exactly a Conflict
+        a = ref_on_shard(0, 2, tag="lk")
+        provider.commit([a], tx_id_of("first"), PARTY)
+        with pytest.raises(UniquenessException):
+            provider.commit([a], tx_id_of("second"), PARTY)
+        net.stop_nodes()
+
+    def test_disruption_catalog_entries(self):
+        from corda_tpu.loadtest.disruption import (
+            shard_leader_kill,
+            worker_process_kill,
+        )
+        from corda_tpu.testing.mocknetwork import make_raft_commit_group
+
+        provider, bus = make_raft_commit_group(3)
+        d = shard_leader_kill([bus], probability=1.0)
+        import random
+
+        leader_before = bus.elect().node_id
+        d.maybe_fire(random.Random(1), None, 0)
+        assert leader_before in bus.dead
+        # the group still serves (re-election inside elect())
+        ref = ref_on_shard(0, 1, tag="dk")
+        provider.commit([ref], tx_id_of("dk"), PARTY)
+        d.maybe_heal(random.Random(1), None, 5)
+        assert leader_before not in bus.dead
+        # worker_process_kill is constructible against a supervisor-like
+        # object (real-process wiring is exercised in the chaos runner)
+        sup = type("S", (), {"workers": []})()
+        worker_process_kill(sup, probability=1.0)
+
+
+class TestShardHostRouting:
+    def _broker(self):
+        from corda_tpu.messaging import Broker
+
+        return Broker()
+
+    def test_eager_queue_registration(self):
+        """Every shard-addressed queue exists — created, bounded — at
+        supervisor construction, BEFORE any worker attaches: no
+        unbounded window before the first consumer (PR-5 caps, PR-3
+        depth gauges)."""
+        from corda_tpu.node.shardhost import ShardSupervisor
+
+        broker = self._broker()
+
+        class _Health:
+            def register(self, *a, **k):
+                pass
+
+        class _Metrics:
+            def gauge(self, *a, **k):
+                pass
+
+        node = type("N", (), {
+            "info": type("P", (), {"name": "O=Shard,L=L,C=GB"})(),
+            "metrics": _Metrics(), "health": _Health(),
+        })()
+        sup = ShardSupervisor(broker, node, ".", 2, broker_port=0)
+        for q in (
+            "p2p.inbound.O=Shard,L=L,C=GB",
+            "p2p.inbound.O=Shard,L=L,C=GB.w0",
+            "p2p.inbound.O=Shard,L=L,C=GB.w1",
+            "shardhost.control.w0",
+            "shardhost.control.w1",
+            "p2p.egress",
+        ):
+            assert broker.queue_exists(q), q
+        # worker queues are bounded from birth (reject policy)
+        max_depth, policy = broker.queue_bound(
+            "p2p.inbound.O=Shard,L=L,C=GB.w0"
+        )
+        assert max_depth == 10_000 and policy == "reject"
+
+    def test_router_routes_session_messages(self):
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.session import SESSION_TOPIC, SessionData
+        from corda_tpu.node.shardhost import (
+            ShardRouter,
+            supervisor_queue,
+            worker_queue,
+        )
+
+        broker = self._broker()
+        name = "O=R,L=L,C=GB"
+        broker.create_queue(f"p2p.inbound.{name}")
+        broker.create_queue(worker_queue(name, 0))
+        broker.create_queue(worker_queue(name, 1))
+        broker.create_queue(supervisor_queue(name))
+        router = ShardRouter(broker, name, 2).start()
+        try:
+            # worker-tagged session data -> that worker's leg
+            broker.send(
+                f"p2p.inbound.{name}",
+                serialize(SessionData("w1-flow:0", 0, b"p")),
+                {"topic": SESSION_TOPIC},
+            )
+            # non-session -> supervisor leg
+            broker.send(
+                f"p2p.inbound.{name}", b"raft-bytes", {"topic": "raft"}
+            )
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and router.routed < 2:
+                time.sleep(0.01)
+            assert broker.message_count(worker_queue(name, 1)) == 1
+            assert broker.message_count(supervisor_queue(name)) == 1
+            assert broker.message_count(worker_queue(name, 0)) == 0
+            assert router.to_supervisor == 1
+        finally:
+            router.stop()
+
+    def test_egress_pump_delivers_by_dest(self):
+        from corda_tpu.node.shardhost import EGRESS_QUEUE, EgressPump
+
+        broker = self._broker()
+        broker.create_queue("p2p.inbound.O=Peer,L=P,C=FR")
+        pump = EgressPump(broker).start()
+        try:
+            broker.send(
+                EGRESS_QUEUE, b"hello",
+                {"topic": "t", "x-dest": "O=Peer,L=P,C=FR"},
+            )
+            broker.send(EGRESS_QUEUE, b"lost", {"topic": "t"})  # no dest
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (
+                pump.forwarded + pump.dropped
+            ) < 2:
+                time.sleep(0.01)
+            assert pump.forwarded == 1
+            assert pump.dropped == 1
+            assert broker.message_count("p2p.inbound.O=Peer,L=P,C=FR") == 1
+        finally:
+            pump.stop()
+
+
+class TestPortableRpcSessions:
+    def test_token_verifies_on_sibling_server(self):
+        """A login token minted by one worker's RPC server authenticates
+        on a sibling sharing the session secret (competing consumers on
+        one request queue) — and not on a server with a different
+        secret."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.rpc.server import RPCServer, RPCUser
+
+        users = [RPCUser("admin", "admin")]
+        s1 = RPCServer(Broker(), object(), users=users,
+                       session_secret=b"s" * 32)
+        s2 = RPCServer(Broker(), object(), users=users,
+                       session_secret=b"s" * 32)
+        s3 = RPCServer(Broker(), object(), users=users,
+                       session_secret=b"x" * 32)
+        s4 = RPCServer(Broker(), object(), users=users)  # classic mode
+        try:
+            token = s1._make_token("admin")
+            assert s2._session_user(token) is not None
+            assert s2._session_user(token).username == "admin"
+            assert s3._session_user(token) is None
+            assert s4._session_user(token) is None
+            # tampered token fails
+            assert s2._session_user(token[:-2] + "ff") is None
+            # unknown user fails even with a valid-shape token
+            bad = s1._make_token("ghost")
+            assert s2._session_user(bad) is None
+        finally:
+            for s in (s1, s2, s3, s4):
+                s.stop()
+
+    def test_secret_derivation_stable(self):
+        from corda_tpu.node.shardhost import rpc_session_secret
+
+        assert rpc_session_secret(42) == rpc_session_secret(42)
+        assert rpc_session_secret(42) != rpc_session_secret(43)
+
+
+class TestWorkerTagging:
+    def test_flow_id_tag_prefixes_and_checkpoint_filter(self):
+        from corda_tpu.core.flows.api import FlowLogic
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        class _Noop(FlowLogic):
+            def call(self):
+                return 7
+
+        net = MockNetwork()
+        node = net.create_node("O=W,L=L,C=GB")
+        node.smm.flow_id_tag = "w2"
+        h = node.start_flow(_Noop())
+        net.run_network()
+        assert h.result.result(timeout=5) == 7
+        assert h.flow_id.startswith("w2-")
+        # checkpoint_filter partitions restore: a filter that excludes
+        # everything restores nothing (no raise)
+        node.smm.checkpoint_filter = lambda fid: False
+        node.smm.start()
+        net.stop_nodes()
+
+
+class TestShardAbFixture:
+    def test_work_slice_deterministic_and_shaped(self):
+        from corda_tpu.loadtest.shard_ab import _work_slice
+
+        a = _work_slice(0, 100, 2, cross_pct=10)
+        b = _work_slice(0, 100, 2, cross_pct=10)
+        assert [(tuple(map(repr, s)), t) for s, t in a] == \
+               [(tuple(map(repr, s)), t) for s, t in b]
+        # cross share: txs drawing from two source txhashes
+        crossers = sum(
+            1 for states, _ in a
+            if len({r.txhash for r in states}) > 1
+        )
+        assert crossers == 10  # 10% of 100
+
+class TestReviewHardening:
+    """Regression pins for the PR-8 review findings (each test names the
+    hole it closes)."""
+
+    def test_prepare_probes_after_reserve(self):
+        """The committed-log probe runs AFTER our reservation landed.
+        Probe-first left a cross-process window: probe clean, a sibling
+        worker reserves+commits+releases the same ref, our reserve then
+        succeeds — and the conflict would surface only at finalise,
+        after earlier shards finalised."""
+        p = make_provider(4)
+        a = ref_on_shard(0, 4, tag="pra")
+        b = ref_on_shard(1, 4, tag="prb")
+        seen = {}
+        orig = p._probes[0]
+
+        def probe(keys):
+            seen["held"] = p.reservations.holders(list(keys), p.clock())
+            return orig(keys)
+
+        p._probes[0] = probe
+        p.commit([a, b], tx_id_of("orderer"), PARTY)
+        key_a = PersistentUniquenessProvider._key(a)
+        assert seen["held"].get(key_a) == tx_id_of("orderer").bytes.hex()
+
+    def test_token_with_dotted_username(self):
+        """Session tokens rsplit from the right: a username containing
+        dots ('ops.admin') still verifies on a sibling worker (nonce
+        and mac are hex and never contain a dot; the username may)."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.rpc.server import RPCServer, RPCUser
+
+        users = [RPCUser("ops.admin", "pw")]
+        s1 = RPCServer(Broker(), object(), users=users,
+                       session_secret=b"s" * 32)
+        s2 = RPCServer(Broker(), object(), users=users,
+                       session_secret=b"s" * 32)
+        try:
+            token = s1._make_token("ops.admin")
+            user = s2._session_user(token)
+            assert user is not None and user.username == "ops.admin"
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_env_fingerprint_topology_override(self, monkeypatch):
+        """bench.py enables sharding by PARAMETER, never the env var:
+        the fingerprint must stamp what actually ran or every record
+        reads as unsharded and the gate's different-topology guard
+        never fires."""
+        from corda_tpu.utils.quiesce import env_fingerprint
+
+        monkeypatch.delenv("CORDA_TPU_SHARDS", raising=False)
+        monkeypatch.delenv("CORDA_TPU_NODE_WORKERS", raising=False)
+        fp = env_fingerprint()
+        assert fp["shards"] == 0 and fp["node_workers"] == 0
+        fp = env_fingerprint(shards=4, node_workers=2)
+        assert fp["shards"] == 4 and fp["node_workers"] == 2
+        monkeypatch.setenv("CORDA_TPU_SHARDS", "8")
+        assert env_fingerprint()["shards"] == 8
+        assert env_fingerprint(shards=4)["shards"] == 4
+
+    def test_soft_lock_reserve_reentrant_widening(self):
+        """Re-reserving a ref already held under the SAME lock_id is a
+        success; a FAILED widening rolls back only what that call
+        acquired — the original holdings stay locked (two worker
+        processes share the vault table; the coin-selection retry loop
+        re-reserves under one lock_id)."""
+        from corda_tpu.node.services import (
+            StatesNotAvailableError,
+            VaultService,
+        )
+
+        db = NodeDatabase(":memory:")
+        vault = VaultService(db, is_relevant=lambda *a: True)
+        refs = []
+        for i in range(2):
+            txid = tx_id_of(f"vault{i}")
+            db.execute(
+                "INSERT INTO vault_states(tx_id, output_index, state_blob,"
+                " contract_name, consumed) VALUES (?, 0, ?, 'C', 0)",
+                (txid.bytes, b"s"),
+            )
+            refs.append(StateRef(txid, 0))
+        a, b = refs
+        vault.soft_lock_reserve("L1", [a])
+        vault.soft_lock_reserve("L1", [a])  # re-entrant: no raise
+        vault.soft_lock_reserve("L2", [b])
+        with pytest.raises(StatesNotAvailableError):
+            vault.soft_lock_reserve("L1", [a, b])  # b is L2's
+        rows = db.query(
+            "SELECT lock_id FROM vault_states WHERE tx_id=?",
+            (a.txhash.bytes,),
+        )
+        assert rows[0][0] == "L1"  # failed widening kept the original
+
+    def test_egress_pump_blocks_until_dest_drains(self):
+        """A bounded destination queue that is FULL blocks the pump (a
+        session message dropped here has no retransmit — the flow would
+        hang to timeout); the blocked send lands once the queue drains,
+        and nothing is counted dropped."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.shardhost import EGRESS_QUEUE, EgressPump
+
+        broker = Broker()
+        dest = "O=Full,L=P,C=FR"
+        broker.create_queue(f"p2p.inbound.{dest}", max_depth=1)
+        broker.send(f"p2p.inbound.{dest}", b"occupier", {})
+        pump = EgressPump(broker).start()
+        try:
+            broker.send(
+                EGRESS_QUEUE, b"payload", {"topic": "t", "x-dest": dest}
+            )
+            time.sleep(0.3)
+            assert pump.dropped == 0 and pump.forwarded == 0
+            consumer = broker.create_consumer(f"p2p.inbound.{dest}")
+            msg = consumer.receive(timeout=1)
+            consumer.ack(msg)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and pump.forwarded < 1:
+                time.sleep(0.01)
+            assert pump.forwarded == 1 and pump.dropped == 0
+            assert broker.message_count(f"p2p.inbound.{dest}") == 1
+        finally:
+            pump.stop()
+
+
+class TestPerShardReservations:
+    """The r13 perf fix: reservation lock tables live in each shard's
+    OWN database, the hot path never writes the coordination db, and
+    blocked writers poll instead of sleeping through sqlite's backoff
+    (docs/sharding.md §storage-modes)."""
+
+    def _dir_provider(self, tmp_path, n_shards=2):
+        from corda_tpu.node.sharded_notary import ShardedUniquenessProvider
+
+        coord = NodeDatabase(str(tmp_path / "coord.db"))
+        p = ShardedUniquenessProvider.over_directory(
+            coord, str(tmp_path / "shards"), n_shards
+        )
+        return p, coord
+
+    def test_reservations_live_in_shard_db(self, tmp_path):
+        p, coord = self._dir_provider(tmp_path)
+        try:
+            a = ref_on_shard(0, 2, tag="psa")
+            b = ref_on_shard(1, 2, tag="psb")
+            # a cross-shard prepare reserves on both shards
+            lost = p._stores[0].reserve_many(
+                {"aa" * 32: [PersistentUniquenessProvider._key(a)]},
+                p.clock() + 30, p.clock(),
+            )
+            assert lost == {}
+            rows = p.delegates[0]._db.query(
+                "SELECT COUNT(*) FROM shard_reservations"
+            )
+            assert rows[0][0] == 1
+            # ...and the coordination db holds NO reservation table rows
+            coord_rows = coord.query(
+                "SELECT name FROM sqlite_master WHERE name='shard_reservations'"
+            )
+            if coord_rows:
+                assert coord.query(
+                    "SELECT COUNT(*) FROM shard_reservations"
+                )[0][0] == 0
+            # shard 1's file is untouched by shard 0's reservation
+            assert p.delegates[1]._db.query(
+                "SELECT COUNT(*) FROM shard_reservations"
+            )[0][0] == 0
+            assert b is not None
+        finally:
+            p.close()
+
+    def test_hot_path_never_writes_coordination_db(self, tmp_path):
+        p, coord = self._dir_provider(tmp_path)
+        try:
+            refs = [ref_on_shard(0, 2, tag=f"hp{i}") for i in range(6)]
+            for i, r in enumerate(refs):
+                p.commit([r], tx_id_of(f"hp{i}"), PARTY)
+            assert p.single_commits == 6
+            # single-shard rounds leave zero rows anywhere in coord:
+            # no journal record, no reservations
+            names = {
+                r[0] for r in coord.query(
+                    "SELECT name FROM sqlite_master WHERE type='table'"
+                )
+            }
+            for t in names:
+                assert coord.query(f"SELECT COUNT(*) FROM {t}")[0][0] == 0, t
+        finally:
+            p.close()
+
+    def test_sibling_instance_reservation_blocks_commit(self, tmp_path):
+        """Two provider INSTANCES over the same directory (the OS-worker
+        shape, minus fork): a reservation taken through instance A's
+        shard file screens instance B's fused commit round — the
+        arbitration lives in sqlite, not in-process state."""
+        p1, _ = self._dir_provider(tmp_path)
+        from corda_tpu.node.sharded_notary import ShardedUniquenessProvider
+
+        p2 = ShardedUniquenessProvider.over_directory(
+            NodeDatabase(str(tmp_path / "coord.db")),
+            str(tmp_path / "shards"), 2,
+        )
+        try:
+            shared = ref_on_shard(0, 2, tag="sib")
+            holder = tx_id_of("holder")
+            lost = p1._stores[0].reserve_many(
+                {holder.bytes.hex():
+                 [PersistentUniquenessProvider._key(shared)]},
+                p1.clock() + 30, p1.clock(),
+            )
+            assert lost == {}
+            with pytest.raises(UniquenessException) as exc:
+                p2.commit([shared], tx_id_of("rival"), PARTY)
+            assert repr(shared) in exc.value.conflict.consumed
+            assert exc.value.conflict.consumed[repr(shared)] == holder
+        finally:
+            p1.close()
+            p2.close()
+
+    def test_shard_db_pragmas(self, tmp_path):
+        p, _ = self._dir_provider(tmp_path)
+        try:
+            for d in p.delegates:
+                assert d._db.query("PRAGMA busy_timeout")[0][0] == 5
+                assert d._db.query("PRAGMA wal_autocheckpoint")[0][0] == 0
+        finally:
+            p.close()
+
+    def test_retry_locked_polls_through_busy(self, tmp_path):
+        import sqlite3 as sq
+
+        p, _ = self._dir_provider(tmp_path)
+        try:
+            attempts = []
+
+            def flaky():
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise sq.OperationalError("database is locked")
+                return "done"
+
+            assert p._retry_locked(flaky) == "done"
+            assert len(attempts) == 3
+            # non-lock errors propagate untouched
+            def broken():
+                raise sq.OperationalError("no such table: nope")
+
+            with pytest.raises(sq.OperationalError):
+                p._retry_locked(broken)
+        finally:
+            p.close()
+
+    def test_checkpoint_shards_and_close(self, tmp_path):
+        p, _ = self._dir_provider(tmp_path)
+        try:
+            r = ref_on_shard(0, 2, tag="ck")
+            p.commit([r], tx_id_of("ck"), PARTY)
+            p.checkpoint_shards()  # PASSIVE sweep runs clean under load
+            assert p.is_consumed(r)
+        finally:
+            p.close()
+        assert p._sweep_stop.is_set()
+
+
+class TestReviewHardening2:
+    """Regression pins for the second review pass."""
+
+    def test_logout_revokes_portable_token(self):
+        """A logged-out HMAC token must stay dead on the worker that
+        served the logout — stateless re-verification used to resurrect
+        (and re-cache) it."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.rpc.server import RPCServer, RPCUser
+
+        s = RPCServer(Broker(), object(), users=[RPCUser("ops", "pw")],
+                      session_secret=b"s" * 32)
+        try:
+            token = s._make_token("ops")
+            assert s._session_user(token) is not None
+            s._handle({"kind": "logout", "session": token,
+                       "id": "x", "reply_to": None})
+            assert s._session_user(token) is None
+        finally:
+            s.stop()
+
+    def test_fingerprint_topology_mismatch_vs_pre_shard_baseline(self):
+        """A pre-r13 fingerprint (no 'shards' key) vs a shards=4 reading
+        is a topology mismatch (gate warns instead of hard-comparing);
+        identical topologies still compare clean."""
+        from corda_tpu.utils.quiesce import fingerprint_mismatch
+
+        old = {"backend": "cpu", "python": "3.10"}
+        new = dict(old, shards=4, node_workers=0)
+        keys = {m["key"] for m in fingerprint_mismatch(old, new)}
+        assert keys == {"shards"}
+        assert fingerprint_mismatch(new, dict(new)) == []
+
+    def test_skewed_drain_respects_max_batch_per_round(self):
+        """One hot shard must not inflate a delegate round past
+        max_batch: a drained batch of 3x max_batch same-shard requests
+        commits in >= 3 delegate rounds."""
+        from corda_tpu.node.notary import CoalescingUniquenessProvider
+
+        p = make_provider(4)
+        seen = []
+        orig = p.commit_many
+
+        def spy(reqs):
+            seen.append(len(reqs))
+            return orig(reqs)
+
+        p.commit_many = spy
+        c = CoalescingUniquenessProvider(p, max_batch=4)
+        reqs = [([ref_on_shard(1, 4, tag=f"sk{i}")], tx_id_of(f"sk{i}"),
+                 PARTY) for i in range(12)]
+        assert c._commit_many_by_shard(reqs) == [None] * 12
+        assert max(seen) <= 4 and len(seen) >= 3
+
+
+class TestReviewHardening3:
+    """Regression pins for the third review pass: the two-phase decision
+    point must survive (or detect) prepare-TTL expiry, recovery must
+    surface a conflicted re-drive, the "committing" flip must be as
+    durable as the commits it orders, and a CAS-miss soft-lock
+    diagnostic must not fail a flow over a racing sibling release."""
+
+    def test_expired_prepare_aborts_at_decision_point(self):
+        """Prepares that eat the whole TTL: a sibling purges the locks
+        and commits a competitor — the decision point must detect the
+        lost reservation and abort with the competitor's attribution,
+        never finalise a torn commit."""
+        clock = [1000.0]
+        p = make_provider(4, clock=lambda: clock[0], prepare_ttl_s=5.0)
+        a = ref_on_shard(0, 4, tag="xa")
+        b = ref_on_shard(1, 4, tag="xb")
+        victim, competitor = tx_id_of("slow-crosser"), tx_id_of("sibling")
+        orig = p._prepare_shard_batch
+
+        def slow_prepare(shard, todo, expires):
+            out = orig(shard, todo, expires)
+            if shard == 1:  # last shard prepared; TTL now expires
+                clock[0] += 6.0
+                p._stores[0].purge_expired(clock[0])
+                assert p.delegates[0].commit_many(
+                    [([a], competitor, PARTY)]
+                ) == [None]
+            return out
+
+        p._prepare_shard_batch = slow_prepare
+        res = p.commit_many([([a, b], victim, PARTY)])[0]
+        assert res is not None
+        assert res.consumed[repr(a)] == competitor
+        # nothing torn: b stays free, the journal drained
+        assert not p.is_consumed(b)
+        assert p.journal.items() == []
+        p.commit([b], tx_id_of("later"), PARTY)
+
+    def test_slow_finalise_keeps_locks_alive(self):
+        """Past the decision point the survivors' locks are extended:
+        a sibling purge + competing spend mid-finalise must lose, and
+        the cross-shard commit completes untorn."""
+        clock = [1000.0]
+        p = make_provider(4, clock=lambda: clock[0], prepare_ttl_s=5.0)
+        a = ref_on_shard(0, 4, tag="fa")
+        b = ref_on_shard(1, 4, tag="fb")
+        crosser = tx_id_of("crosser")
+        orig = p._finalise_shard_batch
+        stolen = []
+
+        def slow_finalise(shard, items):
+            if shard == 0 and not stolen:
+                stolen.append(True)
+                clock[0] += 6.0  # past the PREPARE-phase expiry
+                p.reservations.purge_expired(clock[0])
+                r = p.commit_many([([b], tx_id_of("thief"), PARTY)])[0]
+                assert r is not None
+                assert r.consumed[repr(b)] == crosser
+            return orig(shard, items)
+
+        p._finalise_shard_batch = slow_finalise
+        assert p.commit_many([([a, b], crosser, PARTY)]) == [None]
+        assert p.is_consumed(a) and p.is_consumed(b)
+
+    def test_recover_surfaces_conflicted_redrive(self):
+        """A "committing" round whose refs a competitor consumed during
+        the outage window: recovery must count it `conflicted`, not
+        paper it over as a recovered commit."""
+        db = NodeDatabase(":memory:")
+        p = make_provider(4, db=db)
+        a = ref_on_shard(0, 4, tag="rc")
+        victim = tx_id_of("victim")
+        key = a.txhash.bytes + (0).to_bytes(4, "big")
+        t = {
+            "tx_hex": victim.bytes.hex(), "tx_id": victim, "party": PARTY,
+            "keys_by_shard": {0: [key]}, "ref_of_key": {key: a},
+            "shards": [0],
+        }
+        p.journal.put(t["tx_hex"], p._journal_record(
+            "committing", [0], [t], p.clock() + 30
+        ))
+        assert p.delegates[0].commit_many(
+            [([a], tx_id_of("competitor"), PARTY)]
+        ) == [None]
+        rep = p.recover()
+        assert rep["conflicted"] == 1 and rep["committed"] == 0
+        assert p.recovered_commits == 0
+        assert p.journal.items() == []
+
+    def test_committing_flip_raises_durability(self):
+        """On a synchronous=NORMAL coordination db the "committing" put
+        (and only it) brackets itself in PRAGMA synchronous=FULL."""
+        from corda_tpu.node.sharded_notary import PrepareJournal
+
+        db = NodeDatabase(":memory:")
+        pragmas = []
+        orig = db.execute
+
+        def spy(sql, params=()):
+            if isinstance(sql, str) and sql.startswith(
+                "PRAGMA synchronous="
+            ):
+                pragmas.append(sql)
+            return orig(sql, params)
+
+        db.execute = spy
+        j = PrepareJournal(db)
+        j.put("aa", {"phase": "prepare", "txs": {}})
+        assert pragmas == []
+        j.put("aa", {"phase": "committing", "txs": {}})
+        assert pragmas == [
+            "PRAGMA synchronous=FULL", "PRAGMA synchronous=1",
+        ]
+
+    def test_soft_lock_cas_miss_retries_when_free(self):
+        """CAS misses, the diagnostic re-read finds the state FREE (the
+        holder — a sibling worker process — released between the two
+        statements): the reserve must retry the CAS and win, not raise
+        a spurious "locked by None"."""
+        from corda_tpu.node.services import VaultService
+
+        db = NodeDatabase(":memory:")
+        v = VaultService(db, is_relevant=lambda *a: True)
+        db.execute(
+            "INSERT INTO vault_states "
+            "(tx_id, output_index, state_blob, contract_name) "
+            "VALUES (?, ?, ?, ?)", (b"t" * 32, 0, b"x", "C"),
+        )
+        ref = StateRef(SecureHash(b"t" * 32), 0)
+        v.soft_lock_reserve("other", [ref])
+        orig_q = db.query
+
+        def q(sql, params=()):
+            if "SELECT lock_id" in sql:
+                db.query = orig_q  # interpose exactly once
+                v.soft_lock_release("other")
+            return orig_q(sql, params)
+
+        db.query = q
+        v.soft_lock_reserve("mine", [ref])
+        rows = orig_q(
+            "SELECT lock_id FROM vault_states WHERE tx_id = ?",
+            (b"t" * 32,),
+        )
+        assert rows[0][0] == "mine"
+
+
+class TestReviewHardening4:
+    """Regression pins for the fourth review pass: the router must
+    dispatch on the sender-stamped route-hint header without codec-
+    decoding payloads on its one thread, worker messaging must carry
+    the hint through egress, and /workers probes run concurrently."""
+
+    def test_route_hint_agrees_with_payload_routing(self):
+        """Every hint the senders emit must land on the SAME worker as
+        payload decode (a retransmit can arrive once with and once
+        without the header; session dedup needs both on one worker)."""
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.session import (
+            SessionConfirm,
+            SessionData,
+            SessionEnd,
+            SessionInit,
+            SessionReject,
+            route_hint,
+        )
+        from corda_tpu.node.shardhost import (
+            route_session_hint,
+            route_session_payload,
+        )
+
+        msgs = [
+            SessionInit("sess-1", "Flow", 1, b""),
+            SessionData("w1-f:0", 0, b"x"),
+            SessionEnd("w2-f:0", None),
+            SessionConfirm("w3-f:0", "peer:1"),
+            SessionReject("plain:0", "no"),
+        ]
+        for m in msgs:
+            hint = route_hint(m)
+            assert hint is not None
+            assert route_session_hint(hint, 4) == route_session_payload(
+                serialize(m), 4
+            ), type(m).__name__
+
+    def test_route_hint_malformed_falls_back(self):
+        from corda_tpu.node.shardhost import _NO_HINT, route_session_hint
+
+        for bad in (None, "", "x", "t:", "z:w1-f:0", "th", "h:"):
+            assert route_session_hint(bad, 4) is _NO_HINT, bad
+        # well-formed tag hint for an untagged id: supervisor, no decode
+        assert route_session_hint("t:plain:0", 4) is None
+        # tag beyond the worker count: supervisor
+        assert route_session_hint("t:w9-f:0", 4) is None
+
+    def test_router_routes_on_hint_without_decoding(self):
+        """Junk payloads (undecodable — payload routing would fall to
+        the supervisor) route to the hinted worker on headers alone."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.session import ROUTE_HINT_HEADER, SESSION_TOPIC
+        from corda_tpu.node.shardhost import (
+            ShardRouter,
+            route_session_hint,
+            supervisor_queue,
+            worker_queue,
+        )
+
+        broker = Broker()
+        name = "O=Hint,L=L,C=GB"
+        broker.create_queue(f"p2p.inbound.{name}")
+        broker.create_queue(worker_queue(name, 0))
+        broker.create_queue(worker_queue(name, 1))
+        broker.create_queue(supervisor_queue(name))
+        router = ShardRouter(broker, name, 2).start()
+        try:
+            broker.send(
+                f"p2p.inbound.{name}", b"\xff\xfe junk",
+                {"topic": SESSION_TOPIC, ROUTE_HINT_HEADER: "t:w1-f:0"},
+            )
+            hashed = route_session_hint("h:sess-9", 2)
+            broker.send(
+                f"p2p.inbound.{name}", b"\xff junk2",
+                {"topic": SESSION_TOPIC, ROUTE_HINT_HEADER: "h:sess-9"},
+            )
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and router.routed < 2:
+                time.sleep(0.01)
+            counts = {
+                k: broker.message_count(worker_queue(name, k))
+                for k in (0, 1)
+            }
+            expected = {0: 0, 1: 1}
+            expected[hashed] += 1
+            assert counts == expected
+            assert broker.message_count(supervisor_queue(name)) == 0
+        finally:
+            router.stop()
+
+    def test_worker_messaging_send_carries_route_hint(self):
+        """A worker flow's session send (statemachine passes headers=)
+        must not TypeError, and the hint must ride the egress envelope
+        so the PEER's router keeps its fast path."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.session import ROUTE_HINT_HEADER
+        from corda_tpu.node.shardhost import (
+            EGRESS_QUEUE,
+            make_worker_messaging,
+        )
+
+        broker = Broker()
+        broker.create_queue(EGRESS_QUEUE)
+        key = type("K", (), {"encoded": b"\x01\x02"})()
+        me = type("P", (), {"name": "O=W,L=L,C=GB", "owning_key": key})()
+        peer = type("P", (), {"name": "O=Peer,L=L,C=GB"})()
+        svc = make_worker_messaging(broker, me, worker_index=1)
+        svc.send(peer, "p2p.session", b"payload",
+                 headers={ROUTE_HINT_HEADER: "t:w1-f:0"})
+        consumer = broker.create_consumer(EGRESS_QUEUE)
+        msg = consumer.receive(timeout=2)
+        assert msg is not None
+        assert msg.headers["x-dest"] == "O=Peer,L=L,C=GB"
+        assert msg.headers[ROUTE_HINT_HEADER] == "t:w1-f:0"
+
+    def test_workers_probe_concurrently(self):
+        """/workers with M wedged workers costs ~ONE probe timeout, not
+        M sequential ones."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.shardhost import ShardSupervisor
+
+        class _Health:
+            def register(self, *a, **k):
+                pass
+
+        class _Metrics:
+            def gauge(self, *a, **k):
+                pass
+
+        node = type("N", (), {
+            "info": type("P", (), {"name": "O=Probe,L=L,C=GB"})(),
+            "metrics": _Metrics(), "health": _Health(),
+        })()
+        sup = ShardSupervisor(Broker(), node, ".", 4, broker_port=0)
+
+        class _Proc:
+            pid = 4242
+
+            def poll(self):
+                return None
+
+        for w in sup.workers:
+            w.proc = _Proc()
+        sup._worker_ops_port = lambda i: 1
+
+        def slow_fetch(port, path):
+            time.sleep(0.5)
+            return {"status": "ok"}
+
+        sup._fetch_json = slow_fetch
+        t0 = time.monotonic()
+        snap = sup.snapshot()
+        elapsed = time.monotonic() - t0
+        assert all(
+            e["healthz"] == "ok" for e in snap["detail"].values()
+        )
+        assert elapsed < 1.5, elapsed  # sequential would be >= 2.0s
+
+    def test_mem_reservation_store_thread_safe(self):
+        """Concurrent reserve_many (drain threads) vs release_tx/
+        purge_expired (abort/recovery) on the in-memory store: no
+        'dictionary changed size during iteration'."""
+        from corda_tpu.node.sharded_notary import ReservationStore
+
+        rs = ReservationStore()
+        stop = threading.Event()
+        errors = []
+
+        def churn_reserve():
+            i = 0
+            while not stop.is_set():
+                try:
+                    rs.reserve_many(
+                        {f"tx{i % 7}": [f"k{i % 97}".encode()]}, 10.0, 0.0
+                    )
+                except Exception as exc:
+                    errors.append(exc)
+                    stop.set()
+                i += 1
+
+        def churn_release():
+            i = 0
+            while not stop.is_set():
+                try:
+                    rs.release_tx(f"tx{i % 7}")
+                    rs.purge_expired(0.0)
+                    rs.holders([f"k{i % 97}".encode()], 0.0)
+                except Exception as exc:
+                    errors.append(exc)
+                    stop.set()
+                i += 1
+
+        threads = [
+            threading.Thread(target=churn_reserve),
+            threading.Thread(target=churn_reserve),
+            threading.Thread(target=churn_release),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+
+    def test_router_stop_mid_backpressure_loses_nothing(self):
+        """stop() during the QueueFullError wait must NOT ack the
+        unforwarded batch: consumer close requeues it, so every message
+        survives on some queue (at-least-once, never silently consumed)."""
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.messaging import Broker
+        from corda_tpu.node.session import SESSION_TOPIC, SessionData
+        from corda_tpu.node.shardhost import (
+            ShardRouter,
+            supervisor_queue,
+            worker_queue,
+        )
+
+        broker = Broker()
+        name = "O=Stop,L=L,C=GB"
+        broker.create_queue(f"p2p.inbound.{name}")
+        broker.create_queue(worker_queue(name, 0))
+        broker.create_queue(supervisor_queue(name))
+        # worker queue full at depth 1: the router's fallback loop blocks
+        broker.set_queue_bound(worker_queue(name, 0), 1, "reject")
+        broker.send(worker_queue(name, 0), b"filler", {})
+        n = 4
+        for i in range(n):
+            broker.send(
+                f"p2p.inbound.{name}",
+                serialize(SessionData("w0-f:0", i, b"p")),
+                {"topic": SESSION_TOPIC},
+            )
+        router = ShardRouter(broker, name, 1).start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and broker.message_count(
+                f"p2p.inbound.{name}"
+            ) >= n:
+                time.sleep(0.01)  # wait for the router to pick the batch up
+        finally:
+            router.stop()
+        remaining = (
+            broker.message_count(f"p2p.inbound.{name}")
+            + broker.message_count(worker_queue(name, 0))
+            - 1  # the filler
+        )
+        assert remaining == n, remaining
+        assert router.routed == 0  # nothing was acked as routed
