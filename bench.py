@@ -319,7 +319,7 @@ def _measured_main(_quiesce) -> None:
         here = os.path.dirname(os.path.abspath(__file__))
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "tools", "bench_gate.py"),
-             "--current", "-", "--repo", here, "--opbudget"],
+             "--current", "-", "--repo", here, "--opbudget", "--lint"],
             input=json.dumps(record), text=True,
             stdout=subprocess.DEVNULL,  # gate detail goes to stderr; the
         )                               # record stays this run's only stdout

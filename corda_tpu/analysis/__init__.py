@@ -1,0 +1,17 @@
+"""Static concurrency-correctness suite (docs/static-analysis.md).
+
+`python -m corda_tpu.analysis` lints the whole package with the passes
+in :mod:`.astlint`, checks the findings against the pinned baseline in
+``analysis_manifest.json`` (:mod:`.manifest`), and — unless asked not
+to — runs the kernel-jaxpr lint (:mod:`.kernel_lint`).  A NEW finding
+(one not in the baseline) fails tier-1 and `tools/lint.py`; the
+baseline shrinks by fixing findings and re-pinning (`--pin`), never by
+hand-editing.
+"""
+from .astlint import Finding, PASS_IDS, run_passes, lint_paths  # noqa: F401
+from .manifest import (  # noqa: F401
+    MANIFEST_PATH,
+    check_findings,
+    load_manifest,
+    pin_manifest,
+)

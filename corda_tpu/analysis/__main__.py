@@ -1,0 +1,122 @@
+"""CLI for the concurrency correctness suite.
+
+    python -m corda_tpu.analysis                # lint + kernel gate
+    python -m corda_tpu.analysis --no-kernel    # static passes only
+    python -m corda_tpu.analysis --pin          # rewrite the baseline
+    python -m corda_tpu.analysis --list         # dump current findings
+    python -m corda_tpu.analysis path/to/file.py  # restrict (no gate)
+
+Exit status: 0 = clean vs the pinned analysis_manifest.json, 1 = new
+finding / kernel-lint violation, 2 = usage error.  `tools/lint.py` is
+the same entry point runnable from any cwd.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import kernel_lint, manifest
+from .astlint import PASS_IDS, run_passes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint", description="concurrency correctness suite "
+        "(docs/static-analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="restrict to these files (skips the baseline "
+                    "gate and registry-level checks; prints findings)")
+    ap.add_argument("--pin", action="store_true",
+                    help="re-run everything and rewrite the baseline "
+                    "manifest (the diff is the review artifact)")
+    ap.add_argument("--baseline", action="store_true",
+                    help="check against the pinned baseline (the "
+                    "default; spelled out for CI wiring)")
+    ap.add_argument("--list", action="store_true",
+                    help="print every current finding, accepted or not")
+    ap.add_argument("--pass", dest="only_passes", action="append",
+                    choices=PASS_IDS, metavar="PASS",
+                    help="restrict to specific passes (repeatable)")
+    ap.add_argument("--no-kernel", action="store_true",
+                    help="skip the kernel-jaxpr lint (no jax import; "
+                    "static passes only)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    ap.add_argument("--root",
+                    help="lint an alternate repo root against THIS "
+                    "package's pinned baseline (test/dev aid; "
+                    "incompatible with --pin)")
+    args = ap.parse_args(argv)
+
+    if args.root and args.pin:
+        print("lint: --pin cannot target an alternate --root (the "
+              "baseline belongs to this package)", file=sys.stderr)
+        return 2
+
+    if args.paths:
+        findings = run_passes(paths=args.paths, passes=args.only_passes)
+        for f in findings:
+            print(f"{f.path}:{f.line}: [{f.pass_id}] {f.message}")
+        print(f"{len(findings)} finding(s) in {len(args.paths)} file(s)",
+              file=sys.stderr)
+        return 0
+
+    if args.pin:
+        findings = run_passes(passes=args.only_passes)
+        kernels = None
+        if not args.no_kernel:
+            kernels = kernel_lint.kernel_counts()
+        m = manifest.pin_manifest(findings=findings, kernels=kernels,
+                                  passes=args.only_passes)
+        counts = {p: len(keys) for p, keys in m["passes"].items()}
+        print(f"pinned {sum(counts.values())} finding(s): "
+              f"{json.dumps(counts, sort_keys=True)}", file=sys.stderr)
+        if kernels is not None:
+            print(f"pinned kernels: {json.dumps(kernels, sort_keys=True)}",
+                  file=sys.stderr)
+        return 0
+
+    findings = run_passes(passes=args.only_passes, root=args.root)
+    if args.list:
+        for f in findings:
+            print(f"{f.path}:{f.line}: [{f.pass_id}] {f.message}")
+    result = manifest.check_findings(findings)
+    kviol: List[dict] = []
+    if not args.no_kernel:
+        kviol = kernel_lint.check_all()
+        result["kernel_violations"] = kviol
+    for f in result["new"]:
+        print(f"NEW FINDING {f['key']}\n  {f['path']}:{f['line']}: "
+              f"{f['message']}", file=sys.stderr)
+    for k in result["stale"]:
+        print(f"stale baseline entry (fixed — re-pin to shrink): {k}",
+              file=sys.stderr)
+    for v in kviol:
+        label = ("KERNEL-LINT improved" if v["kind"] == "improved"
+                 else "KERNEL-LINT VIOLATION")
+        print(f"{label} {v['kernel']}.{v.get('metric')}: "
+              f"pinned={v['pinned']} measured={v['measured']} "
+              f"({v['kind']})", file=sys.stderr)
+    fatal = bool(result["new"]) or bool(
+        manifest.fatal_kernel_violations(kviol)
+    )
+    ok = not fatal
+    if args.json:
+        print(json.dumps({"ok": ok, **result}, sort_keys=True))
+    else:
+        print(
+            f"lint: {'PASS' if ok else 'FAIL'} — "
+            f"{result['accepted']} accepted, {len(result['new'])} new, "
+            f"{len(result['stale'])} stale"
+            + ("" if args.no_kernel else f", {len(kviol)} kernel-lint "
+               f"violation(s)"),
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

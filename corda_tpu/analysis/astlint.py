@@ -1,0 +1,684 @@
+"""Stdlib-``ast`` lint passes for the concurrency invariants reviews
+hand-checked in PRs 1–8 (docs/static-analysis.md).
+
+Five passes, each cheap enough to run on every tier-1 run:
+
+``guarded_by``
+    A field declared ``# guarded-by: _lock`` (trailing comment on the
+    statement that initialises it; comma-separated alternatives allowed,
+    e.g. ``# guarded-by: _lock, _cv``) may only be WRITTEN lexically
+    inside a ``with`` over a matching lock.  Works for ``self.x`` class
+    fields and module-level globals; ``__init__``/``__new__`` writes and
+    module-level (re)initialisation are exempt — no thread exists yet.
+
+``blocking_under_lock``
+    Calls that can block for unbounded time flagged lexically inside a
+    held lock: ``.result()`` on futures, ``.get`` on queue-named
+    receivers, ``sleep``, broker ``send``/``receive`` families, sqlite
+    ``.commit`` on connection-named receivers, ``.join`` on
+    thread-named receivers, and ``.wait``/``.wait_for`` on anything
+    that is not the condition actually held (a cv wait on its OWN lock
+    releases it; a wait on some other primitive holds the lock across
+    the park).
+
+``thread_daemon``
+    Every ``threading.Thread(...)`` must pass explicit ``daemon=`` and
+    ``name=`` — anonymous non-daemon threads are what wedge interpreter
+    shutdown and make stack dumps unreadable.
+
+``swallow``
+    A bare/broad ``except`` that neither re-raises, nor references the
+    bound exception, nor calls anything log-shaped silently destroys
+    the only evidence of a concurrency bug.
+
+``env_registry``
+    Every ``CORDA_TPU_*`` literal read anywhere must be registered in
+    :mod:`corda_tpu.analysis.envknobs` (default + doc reference) and
+    documented in the docs/running-nodes.md knob table; stale registry
+    entries (never read) are findings too.
+
+Suppression: ``# lint: allow(pass_id)`` trailing the flagged line (or
+on the line above), with a reason after the paren —
+``# lint: allow(swallow) — probe failure is the signal itself``.
+Findings carry a stable key (pass, path, symbol — no line numbers, so
+unrelated edits don't churn the baseline) pinned in
+``analysis_manifest.json``; see :mod:`corda_tpu.analysis.manifest`.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PASS_IDS = (
+    "guarded_by",
+    "blocking_under_lock",
+    "thread_daemon",
+    "swallow",
+    "env_registry",
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\(\s*([a-z_,\s]+?)\s*\)")
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][\w.]*(?:\s*,\s*[A-Za-z_][\w.]*)*)"
+)
+_KNOB_RE = re.compile(r"^CORDA_TPU_[A-Z0-9_]+$")
+
+#: mutating container methods treated as writes by `guarded_by`
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "extend", "insert", "pop",
+    "popleft", "remove", "discard", "update", "setdefault",
+}
+
+#: call names that count as "the exception was reported" for `swallow`
+_LOG_NAMES = {
+    "log", "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "emit", "announce", "print_exc", "print",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str  # stable identity within the file (no line numbers)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_id}:{self.path}:{self.symbol}"
+
+    def as_dict(self) -> Dict:
+        return {
+            "pass": self.pass_id, "path": self.path, "line": self.line,
+            "symbol": self.symbol, "message": self.message,
+            "key": self.key,
+        }
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+
+
+def lint_paths(root: Optional[str] = None) -> List[str]:
+    """The lint target set: the whole corda_tpu package plus the
+    top-level tools/ CLIs and bench.py (tests lint themselves by
+    failing)."""
+    root = root or _repo_root()
+    out: List[str] = []
+    for base in ("corda_tpu", "tools"):
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, base)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return sorted(out)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as 'a.b.c' (None when the chain
+    bottoms out in a call/subscript — those aren't stable names)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _strip_self(dotted: str) -> str:
+    return dotted[5:] if dotted.startswith("self.") else dotted
+
+
+def _suffix_match(expr: str, annotation: str) -> bool:
+    """`with self._broker._lock` matches annotations `_lock` and
+    `_broker._lock` — segment-aligned suffix match, self-insensitive."""
+    e = _strip_self(expr).split(".")
+    a = _strip_self(annotation).split(".")
+    return len(e) >= len(a) and e[-len(a):] == a
+
+
+def _lockish(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    last = dotted.split(".")[-1].lower().lstrip("_")
+    return (
+        "lock" in last or "mutex" in last
+        or last in ("cv", "cond", "condition", "not_empty", "guard")
+    )
+
+
+class _FileCtx:
+    """Parsed file + parent links + comment-derived tables."""
+
+    def __init__(self, path: str, relpath: str, src: str):
+        self.relpath = relpath
+        self.tree = ast.parse(src, filename=path)
+        self.lines = src.splitlines()
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.suppress: Dict[int, Set[str]] = {}
+        self.guard_ann: Dict[int, List[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "#" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppress[i] = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                }
+            m = _GUARDED_RE.search(line)
+            if m:
+                self.guard_ann[i] = [
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                ]
+
+    def suppressed(self, pass_id: str, node: ast.AST) -> bool:
+        for ln in (getattr(node, "lineno", 0), getattr(node, "lineno", 0) - 1):
+            ids = self.suppress.get(ln)
+            if ids and (pass_id in ids or "all" in ids):
+                return True
+        return False
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(anc.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def lock_withs(self, node: ast.AST) -> List[str]:
+        """Dotted names of lock-ish `with` items lexically holding
+        `node`, stopping at the enclosing function boundary (a nested
+        def under a with runs later, not under the lock)."""
+        out: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(
+                anc,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                break
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    d = _dotted(item.context_expr)
+                    if _lockish(d):
+                        out.append(d)
+        return out
+
+    def annotation_for(self, node: ast.AST) -> Optional[List[str]]:
+        """guarded-by annotation attached to any line of `node`."""
+        start = getattr(node, "lineno", None)
+        if start is None:
+            return None
+        end = getattr(node, "end_lineno", None) or start
+        for ln in range(start, end + 1):
+            if ln in self.guard_ann:
+                return self.guard_ann[ln]
+        return None
+
+
+# -- pass: guarded_by ---------------------------------------------------------
+
+def _write_targets(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(dotted-target, node) pairs for assignment-like statements."""
+    out: List[Tuple[str, ast.AST]] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return out
+    for t in targets:
+        base = t
+        while isinstance(base, (ast.Subscript, ast.Starred)):
+            base = base.value
+        d = _dotted(base)
+        if d:
+            out.append((d, node))
+    return out
+
+
+def _pass_guarded_by(ctx: _FileCtx) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.guard_ann:
+        return findings
+
+    def check_scope(scope: ast.AST, guarded: Dict[str, List[str]],
+                    owner: str, is_field: bool) -> None:
+        """Flag unguarded writes to `guarded` names inside `scope`."""
+        declared_nodes = set()
+        for node in ast.walk(scope):
+            if ctx.annotation_for(node) and isinstance(
+                node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+            ):
+                declared_nodes.add(node)
+        for node in ast.walk(scope):
+            hits: List[str] = []
+            for d, stmt in _write_targets(node):
+                name = _strip_self(d) if is_field else d
+                if (is_field == d.startswith("self.")) and name in guarded:
+                    hits.append(name)
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in _MUTATORS):
+                    d = _dotted(fn.value)
+                    if d:
+                        name = _strip_self(d) if is_field else d
+                        if ((is_field == d.startswith("self."))
+                                and name in guarded):
+                            hits.append(name)
+            if not hits or node in declared_nodes:
+                continue
+            func = ctx.enclosing_function(node)
+            if func is None:
+                continue  # module-level (re)init: no threads yet
+            if is_field and func.name in ("__init__", "__new__"):
+                continue
+            if ctx.suppressed("guarded_by", node):
+                continue
+            held = ctx.lock_withs(node)
+            for name in hits:
+                locks = guarded[name]
+                if any(_suffix_match(h, lk) for h in held for lk in locks):
+                    continue
+                findings.append(Finding(
+                    "guarded_by", ctx.relpath, node.lineno,
+                    f"{owner}.{name}@{ctx.qualname(node)}",
+                    f"write to {owner}.{name} (guarded-by: "
+                    f"{', '.join(locks)}) outside `with "
+                    f"{locks[0]}` in {ctx.qualname(node)}",
+                ))
+    # class fields
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded: Dict[str, List[str]] = {}
+        for node in ast.walk(cls):
+            ann = ctx.annotation_for(node)
+            if not ann:
+                continue
+            for d, _stmt in _write_targets(node):
+                if d.startswith("self."):
+                    guarded[_strip_self(d)] = ann
+        if guarded:
+            check_scope(cls, guarded, cls.name, is_field=True)
+    # module globals
+    guarded_globals: Dict[str, List[str]] = {}
+    for node in ctx.tree.body:
+        ann = ctx.annotation_for(node)
+        if not ann:
+            continue
+        for d, _stmt in _write_targets(node):
+            if "." not in d:
+                guarded_globals[d] = ann
+    if guarded_globals:
+        check_scope(ctx.tree, guarded_globals, ctx.relpath.rsplit("/", 1)[-1],
+                    is_field=False)
+    return findings
+
+
+# -- pass: blocking_under_lock ------------------------------------------------
+
+def _contains(dotted: Optional[str], *needles: str) -> bool:
+    if not dotted:
+        return False
+    segs = dotted.lower().split(".")
+    return any(n in seg for seg in segs for n in needles)
+
+
+def _classify_blocking(node: ast.Call, held: List[str]) -> Optional[str]:
+    fn = node.func
+    d = _dotted(fn)
+    if d in ("time.sleep", "sleep") or (d and d.endswith(".sleep")):
+        return "sleep() under a held lock"
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = _dotted(fn.value)
+    attr = fn.attr
+    if attr == "result":
+        return "future .result() under a held lock"
+    last = (recv or "").split(".")[-1].lower().lstrip("_")
+    if (
+        attr == "get" and (last.endswith("queue") or last == "q")
+        and not node.args
+        and all(k.arg in ("block", "timeout") for k in node.keywords)
+    ):
+        # the blocking Queue.get signature only — `d.get(key, default)`
+        # on a queue-named dict is a registry lookup
+        return "queue .get() under a held lock"
+    if attr in ("send", "send_many", "receive", "receive_many") and _contains(
+        recv, "broker"
+    ):
+        return f"broker .{attr}() under a held lock"
+    if attr == "commit" and _contains(recv, "conn", "db", "sql"):
+        return "db .commit() under a held lock"
+    if attr == "join" and _contains(recv, "thread", "worker", "monitor",
+                                    "proc"):
+        return "thread .join() under a held lock"
+    if attr in ("wait", "wait_for") and recv is not None:
+        if any(_suffix_match(h, _strip_self(recv))
+               or _suffix_match(recv, _strip_self(h)) for h in held):
+            return None  # cv wait on the lock actually held: it releases
+        if last in ("cv", "cond", "condition", "not_empty"):
+            # a condition owned by the same object as a held lock almost
+            # certainly WRAPS that lock (`Condition(self._lock)`), and
+            # waiting releases it; a cv owned by a DIFFERENT object parks
+            # while the held lock stays held
+            owner = ".".join(recv.split(".")[:-1])
+            if any(owner == ".".join(h.split(".")[:-1]) for h in held):
+                return None
+        return (f"{recv}.{attr}() parks while holding an unrelated lock "
+                f"({', '.join(held)})")
+    return None
+
+
+def _pass_blocking(ctx: _FileCtx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        held = ctx.lock_withs(node)
+        if not held:
+            continue
+        msg = _classify_blocking(node, held)
+        if msg is None or ctx.suppressed("blocking_under_lock", node):
+            continue
+        d = _dotted(node.func) or getattr(node.func, "attr", "?")
+        findings.append(Finding(
+            "blocking_under_lock", ctx.relpath, node.lineno,
+            f"{ctx.qualname(node)}:{d}",
+            f"{msg} (in {ctx.qualname(node)}, holding {', '.join(held)})",
+        ))
+    return findings
+
+
+# -- pass: thread_daemon ------------------------------------------------------
+
+def _pass_thread_daemon(ctx: _FileCtx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None or not (d == "Thread" or d.endswith(".Thread")):
+            continue
+        kw = {k.arg for k in node.keywords}
+        if None in kw:  # **kwargs splat: can't see inside
+            continue
+        missing = [k for k in ("daemon", "name") if k not in kw]
+        if not missing or ctx.suppressed("thread_daemon", node):
+            continue
+        findings.append(Finding(
+            "thread_daemon", ctx.relpath, node.lineno,
+            f"{ctx.qualname(node)}",
+            f"threading.Thread without explicit {' and '.join(missing)}= "
+            f"in {ctx.qualname(node)}",
+        ))
+    return findings
+
+
+# -- pass: swallow ------------------------------------------------------------
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [_dotted(e) for e in t.elts]
+    else:
+        names = [_dotted(t)]
+    return any(
+        n and n.split(".")[-1] in ("Exception", "BaseException")
+        for n in names
+    )
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    exc_name = handler.name
+    for node in handler.body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if (exc_name and isinstance(sub, ast.Name)
+                    and sub.id == exc_name
+                    and isinstance(sub.ctx, ast.Load)):
+                return True
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if name in _LOG_NAMES:
+                    return True
+            if isinstance(sub, ast.Attribute) and sub.attr == "exc_info":
+                return True
+    return False
+
+
+def _pass_swallow(ctx: _FileCtx) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_handler(node) or _handler_reports(node):
+            continue
+        if ctx.suppressed("swallow", node):
+            continue
+        what = _dotted(node.type) if node.type is not None else "bare"
+        findings.append(Finding(
+            "swallow", ctx.relpath, node.lineno,
+            f"{ctx.qualname(node)}:{what}",
+            f"broad `except {what}` swallows the exception silently "
+            f"(no re-raise, no log/emit, exception unused) in "
+            f"{ctx.qualname(node)}",
+        ))
+    return findings
+
+
+# -- pass: env_registry -------------------------------------------------------
+
+def _knob_literals(ctx: _FileCtx) -> List[Tuple[str, int]]:
+    """CORDA_TPU_* literals used in read/write positions: call args,
+    keyword values AND names, subscripts, comparisons — but not
+    docstrings/comments."""
+    out: List[Tuple[str, int]] = []
+
+    def lit(node) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _KNOB_RE.match(node.value):
+            return node.value
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            for arg in node.args:
+                v = lit(arg)
+                if v:
+                    out.append((v, arg.lineno))
+            for kw in node.keywords:
+                v = lit(kw.value)
+                if v:
+                    out.append((v, kw.value.lineno))
+                if kw.arg and _KNOB_RE.match(kw.arg):
+                    out.append((kw.arg, node.lineno))
+        elif isinstance(node, ast.Subscript):
+            v = lit(node.slice)
+            if v:
+                out.append((v, node.lineno))
+        elif isinstance(node, ast.Compare):
+            for cmp_node in [node.left, *node.comparators]:
+                v = lit(cmp_node)
+                if v:
+                    out.append((v, cmp_node.lineno))
+    return out
+
+
+def _pass_env_registry(
+    ctx: _FileCtx, reads: Dict[str, List[Tuple[str, int]]]
+) -> List[Finding]:
+    """Per-file half: record reads, flag unregistered knobs. The
+    registry-level half (stale/undocumented) runs in run_passes."""
+    from . import envknobs
+
+    findings: List[Finding] = []
+    if ctx.relpath == "corda_tpu/analysis/envknobs.py":
+        # the registry's own registration literals are not READS — if
+        # they counted, the stale-entry check could never fire
+        return findings
+    flagged: Set[str] = set()
+    for knob, line in _knob_literals(ctx):
+        reads.setdefault(knob, []).append((ctx.relpath, line))
+        if knob in envknobs.KNOBS or knob in flagged:
+            continue
+        node_like = type("L", (), {"lineno": line})()
+        if ctx.suppressed("env_registry", node_like):
+            continue
+        flagged.add(knob)
+        findings.append(Finding(
+            "env_registry", ctx.relpath, line, knob,
+            f"env knob {knob} read here but not registered in "
+            f"corda_tpu/analysis/envknobs.py (register with default + "
+            f"doc reference)",
+        ))
+    return findings
+
+
+def _env_registry_finalize(
+    reads: Dict[str, List[Tuple[str, int]]], root: str
+) -> List[Finding]:
+    from . import envknobs
+
+    findings: List[Finding] = []
+    reg_path = "corda_tpu/analysis/envknobs.py"
+    doc_cache: Dict[str, str] = {}
+
+    def doc_text(rel: str) -> Optional[str]:
+        if rel not in doc_cache:
+            try:
+                with open(os.path.join(root, rel)) as fh:
+                    doc_cache[rel] = fh.read()
+            except OSError:
+                doc_cache[rel] = ""
+        return doc_cache[rel]
+
+    table = doc_text(envknobs.KNOB_TABLE_DOC)
+    for name, knob in sorted(envknobs.KNOBS.items()):
+        if name not in reads:
+            findings.append(Finding(
+                "env_registry", reg_path, 1, f"{name}:stale",
+                f"registered env knob {name} is never read anywhere — "
+                f"remove it or the dead code grew back",
+            ))
+        if f"`{name}`" not in table:
+            # delimited match: a bare substring test would let
+            # CORDA_TPU_LOCKCHECK ride on CORDA_TPU_LOCKCHECK_HOLD_MS's
+            # row after its own was deleted
+            findings.append(Finding(
+                "env_registry", reg_path, 1, f"{name}:undocumented",
+                f"env knob {name} missing from the "
+                f"{envknobs.KNOB_TABLE_DOC} knob table",
+            ))
+        if not doc_text(knob.doc):
+            findings.append(Finding(
+                "env_registry", reg_path, 1, f"{name}:badref",
+                f"env knob {name} doc reference {knob.doc!r} does not "
+                f"exist",
+            ))
+    return findings
+
+
+# -- driver -------------------------------------------------------------------
+
+_PASS_FNS = {
+    "guarded_by": _pass_guarded_by,
+    "blocking_under_lock": _pass_blocking,
+    "thread_daemon": _pass_thread_daemon,
+    "swallow": _pass_swallow,
+}
+
+
+def run_passes(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run the static passes over `paths` (default: the whole lint
+    target set) and return findings with de-duplicated stable keys."""
+    root = root or _repo_root()
+    # registry-level env checks (stale/undocumented) only make sense on
+    # a full run — an explicit path list would mark every unseen knob
+    # stale
+    full_run = paths is None
+    paths = list(paths) if paths is not None else lint_paths(root)
+    passes = list(passes) if passes is not None else list(PASS_IDS)
+    findings: List[Finding] = []
+    env_reads: Dict[str, List[Tuple[str, int]]] = {}
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path) as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        try:
+            ctx = _FileCtx(path, rel, src)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "swallow", rel, exc.lineno or 1, "syntax-error",
+                f"file does not parse: {exc.msg}",
+            ))
+            continue
+        for pid in passes:
+            fn = _PASS_FNS.get(pid)
+            if fn is not None:
+                findings.extend(fn(ctx))
+        if "env_registry" in passes:
+            findings.extend(_pass_env_registry(ctx, env_reads))
+    if "env_registry" in passes and full_run:
+        findings.extend(_env_registry_finalize(env_reads, root))
+    return _dedup(findings)
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    """Identical keys (two findings on the same symbol) get #2, #3 …
+    suffixes in (path, line) order so the baseline stays exact."""
+    by_key: Dict[str, int] = {}
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.pass_id,
+                                             f.symbol)):
+        n = by_key.get(f.key, 0)
+        by_key[f.key] = n + 1
+        if n:
+            f = Finding(f.pass_id, f.path, f.line, f"{f.symbol}#{n + 1}",
+                        f.message)
+        out.append(f)
+    return out

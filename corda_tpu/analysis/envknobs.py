@@ -1,0 +1,189 @@
+"""Central registry of every ``CORDA_TPU_*`` environment knob.
+
+The ``env_registry`` lint pass (corda_tpu/analysis/astlint.py) enforces
+three invariants tier-1:
+
+* every knob READ anywhere in the package/tools/bench is registered
+  here with its default and a doc reference;
+* every registered knob appears in the docs/running-nodes.md knob
+  table (``KNOB_TABLE_DOC``);
+* every registered knob is actually read somewhere (stale entries are
+  findings — the registry cannot drift into fiction).
+
+Adding a knob therefore takes three edits (read site, this registry,
+the doc table) and the lint names whichever one you forgot.  Defaults
+here are DOCUMENTATION of the read-site defaults, not a second source
+of truth the code consults — keep them in sync with the read site (the
+doc table is the operator-facing copy).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+#: the operator-facing table every knob must appear in
+KNOB_TABLE_DOC = "docs/running-nodes.md"
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str  # rendered default ("unset" when absence is meaningful)
+    doc: str  # doc file covering this knob's subsystem
+    description: str
+
+
+def _k(name: str, default: str, doc: str, description: str) -> Knob:
+    return Knob(name, default, doc, description)
+
+
+_ENTRIES = [
+    # -- admission / overload (PR 5) -----------------------------------------
+    _k("CORDA_TPU_ADMISSION_RATE", "unset", "docs/robustness.md",
+       "token-bucket rate for new client flow starts (flows/s)"),
+    _k("CORDA_TPU_ADMISSION_BURST", "2x rate", "docs/robustness.md",
+       "token-bucket size (burst absorbed before shedding)"),
+    _k("CORDA_TPU_ADMISSION_MAX_FLOWS", "unset", "docs/robustness.md",
+       "live-flow concurrency cap"),
+    _k("CORDA_TPU_ADMISSION_RETRY_MS", "250", "docs/robustness.md",
+       "retry_after_ms hint floor on shed rejections"),
+    _k("CORDA_TPU_OVERLOAD_QDEPTH_HIGH", "5000", "docs/robustness.md",
+       "P2P queue depth that flips the overload machine to shedding"),
+    _k("CORDA_TPU_OVERLOAD_BACKLOG_HIGH", "256", "docs/robustness.md",
+       "blocking-executor backlog shed threshold"),
+    _k("CORDA_TPU_OVERLOAD_BATCHER_HIGH", "64", "docs/robustness.md",
+       "batcher queued-batches shed threshold"),
+    _k("CORDA_TPU_OVERLOAD_HOLD_S", "2", "docs/robustness.md",
+       "quiet dwell before overload recovering -> normal"),
+    _k("CORDA_TPU_HEALTH_SUSTAIN_S", "5", "docs/robustness.md",
+       "how long a breach must hold before readiness degrades"),
+    _k("CORDA_TPU_HEALTH_QDEPTH_DEGRADE", "5000", "docs/robustness.md",
+       "sustained inbound-depth threshold that degrades /readyz"),
+    # -- queues / backpressure ----------------------------------------------
+    _k("CORDA_TPU_P2P_QUEUE_MAX", "10000", "docs/robustness.md",
+       "p2p.inbound.* depth cap, reject-new policy (0 = unbounded)"),
+    _k("CORDA_TPU_RPC_QUEUE_MAX", "10000", "docs/robustness.md",
+       "rpc.server.requests depth cap, reject-new (0 = unbounded)"),
+    _k("CORDA_TPU_RPC_CLIENT_QUEUE_MAX", "10000", "docs/robustness.md",
+       "per-client reply queue cap, drop-oldest to dead.letter"),
+    _k("CORDA_TPU_BATCHER_MAX_QUEUED", "16", "docs/robustness.md",
+       "verifier batcher flush-queue cap; overflow blocks submitters"),
+    _k("CORDA_TPU_NOTARY_QUEUE_MAX", "4096", "docs/robustness.md",
+       "notary coalescer pending cap; overflow sheds retryably"),
+    # -- verifier / failover (PR 4) -----------------------------------------
+    _k("CORDA_TPU_VERIFIER_WORKERS", "max(2, min(4, cpus))",
+       "docs/out-of-process-verification.md",
+       "out-of-process verifier worker pool size"),
+    _k("CORDA_TPU_VERIFY_DEADLINE", "10.0", "docs/robustness.md",
+       "per-attempt verification deadline (seconds)"),
+    _k("CORDA_TPU_VERIFY_RETRIES", "2", "docs/robustness.md",
+       "redispatch attempts before dead-letter"),
+    _k("CORDA_TPU_VERIFY_BACKOFF_S", "0.2", "docs/robustness.md",
+       "redispatch backoff base (capped exponential + jitter)"),
+    _k("CORDA_TPU_VERIFY_FALLBACK", "1", "docs/robustness.md",
+       "0 = dead-letter instead of in-process fallback on breaker open"),
+    _k("CORDA_TPU_VERIFY_BREAKER_THRESHOLD", "3", "docs/robustness.md",
+       "stacked failures that trip the verifier circuit breaker"),
+    _k("CORDA_TPU_VERIFY_BREAKER_COOLDOWN", "5.0", "docs/robustness.md",
+       "seconds the open breaker waits before a half-open probe"),
+    # -- hospital (PR 4) ----------------------------------------------------
+    _k("CORDA_TPU_HOSPITAL", "1", "docs/robustness.md",
+       "0 disables checkpoint-replay retry of transient flow failures"),
+    _k("CORDA_TPU_HOSPITAL_MAX_RETRIES", "3", "docs/robustness.md",
+       "transient-failure retries before the dead-letter ward"),
+    _k("CORDA_TPU_HOSPITAL_BACKOFF_S", "0.1", "docs/robustness.md",
+       "hospital retry backoff base (seconds)"),
+    _k("CORDA_TPU_HOSPITAL_BACKOFF_CAP_S", "5.0", "docs/robustness.md",
+       "hospital retry backoff cap (seconds)"),
+    _k("CORDA_TPU_HOSPITAL_WARD_MAX", "256", "docs/robustness.md",
+       "bounded dead-letter ward size"),
+    # -- node / flows -------------------------------------------------------
+    _k("CORDA_TPU_FLOW_BLOCKING_THREADS", "4", "docs/writing-flows.md",
+       "executor threads serving await_blocking flow sections"),
+    _k("CORDA_TPU_GC_THRESHOLD", "50000", "docs/running-nodes.md",
+       "gen-0 GC threshold set at node start (allocation-heavy path)"),
+    _k("CORDA_TPU_LOG", "WARNING", "docs/running-nodes.md",
+       "console log level for `python -m corda_tpu.node`"),
+    _k("CORDA_TPU_EXIT_ON_ORPHAN", "unset", "docs/running-nodes.md",
+       "1 = node/worker exits when its parent process dies"),
+    _k("CORDA_TPU_HOST_BATCH", "1", "docs/perf-host.md",
+       "0 disables the native SHA-512 host prehash batch path"),
+    _k("CORDA_TPU_ECDSA_HOST", "1", "docs/perf-host.md",
+       "0 disables the native ECDSA host-dispatch path"),
+    _k("CORDA_TPU_NATIVE_CODEC", "1", "docs/perf-host.md",
+       "0 disables the native codec fast path"),
+    # -- notary / sharding (PR 8) -------------------------------------------
+    _k("CORDA_TPU_NOTARY_COALESCE", "1", "docs/perf-system.md",
+       "0 disables notary commit coalescing"),
+    _k("CORDA_TPU_NOTARY_BATCHED", "1", "docs/perf-system.md",
+       "0 disables batched notary signature verification"),
+    _k("CORDA_TPU_UNIQ_COALESCE_MAX", "512", "docs/perf-system.md",
+       "max transactions folded into one coalesced commit round"),
+    _k("CORDA_TPU_SHARDS", "unset", "docs/sharding.md",
+       "partition the uniqueness provider into N shards"),
+    _k("CORDA_TPU_NODE_WORKERS", "unset", "docs/sharding.md",
+       "spawn M shard-worker OS processes behind the broker"),
+    _k("CORDA_TPU_SHARD_PREPARE_TTL", "30.0", "docs/sharding.md",
+       "cross-shard prepare reservation TTL (seconds)"),
+    _k("CORDA_TPU_SHARD_WAL_SWEEP", "5", "docs/sharding.md",
+       "per-shard sqlite WAL checkpoint sweep interval (seconds)"),
+    # -- rpc ----------------------------------------------------------------
+    _k("CORDA_TPU_RPC_WORKERS", "max(2, min(8, 2*cpus))",
+       "docs/running-nodes.md", "RPC server dispatch pool size"),
+    _k("CORDA_TPU_RPC_INLINE", "1", "docs/perf-system.md",
+       "0 disables inline dispatch of async-reply flow methods"),
+    # -- observability (PRs 2-3, 6) -----------------------------------------
+    _k("CORDA_TPU_TRACING", "1", "docs/observability.md",
+       "0 disables the tracing spine"),
+    _k("CORDA_TPU_TRACE_SLOW_MS", "1000.0", "docs/observability.md",
+       "slow-span watchdog threshold (ms)"),
+    _k("CORDA_TPU_TRACE_MAX_TRACES", "512", "docs/observability.md",
+       "bounded trace store size (LRU)"),
+    _k("CORDA_TPU_EVENTLOG", "1", "docs/observability.md",
+       "0 disables the structured event log"),
+    _k("CORDA_TPU_EVENTLOG_MAX", "4096", "docs/observability.md",
+       "event-log ring capacity"),
+    _k("CORDA_TPU_EVENTLOG_LEVEL", "info", "docs/observability.md",
+       "minimum recorded event severity"),
+    _k("CORDA_TPU_PROFILE_DUMP", "unset", "docs/observability.md",
+       "directory for legacy cProfile dumps (unset = off)"),
+    _k("CORDA_TPU_PROFILE_THREAD", "p2p", "docs/observability.md",
+       "which thread the legacy cProfile hook claims"),
+    _k("CORDA_TPU_QUIESCE_FILE", "tpu_capture/QUIESCE",
+       "docs/observability.md",
+       "cross-process quiesce marker path override"),
+    # -- lockcheck (this PR) -------------------------------------------------
+    _k("CORDA_TPU_LOCKCHECK", "0", "docs/static-analysis.md",
+       "1 arms the runtime lock-order deadlock detector"),
+    _k("CORDA_TPU_LOCKCHECK_HOLD_MS", "1000", "docs/static-analysis.md",
+       "hold-time watchdog threshold for instrumented locks (ms)"),
+    # -- kernels / jax dispatch ---------------------------------------------
+    _k("CORDA_TPU_DISPATCH", "auto", "docs/perf-roofline.md",
+       "device dispatch mode: auto | jax | host"),
+    _k("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20", "docs/hardware-runbook.md",
+       "seconds the subprocess jax backend probe may take"),
+    _k("CORDA_TPU_FAST_MUL", "0", "docs/perf-roofline.md",
+       "1 enables the experimental fast multiply path (Pallas)"),
+    _k("CORDA_TPU_ED25519_RADIX", "13", "docs/perf-roofline.md",
+       "ed25519 Pallas limb radix (13 or 16)"),
+    _k("CORDA_TPU_ED25519_BLK", "512", "docs/perf-roofline.md",
+       "ed25519 Pallas kernel block width"),
+    _k("CORDA_TPU_ECDSA_BLK", "256", "docs/perf-roofline.md",
+       "ECDSA Pallas kernel block width"),
+    _k("CORDA_TPU_BLS12_BLK", "8", "docs/bls-aggregation.md",
+       "BLS12-381 pairing kernel batch width"),
+    _k("CORDA_TPU_PIPE_CHUNK", "65536", "docs/perf-roofline.md",
+       "ed25519 dispatch pipeline chunk size"),
+    _k("CORDA_TPU_BATCHER_MAX", "4096", "docs/perf-system.md",
+       "verifier signature batcher max batch size"),
+    _k("CORDA_TPU_BATCHER_LINGER_MS", "2.0", "docs/perf-system.md",
+       "batcher linger before a partial flush (ms)"),
+    # -- bench --------------------------------------------------------------
+    _k("CORDA_TPU_BENCH_FORCE_CPU", "unset", "docs/hardware-runbook.md",
+       "1 = bench.py skips the TPU probe and runs CPU-only"),
+    _k("CORDA_TPU_BENCH_HEADLINE_ONLY", "unset", "docs/hardware-runbook.md",
+       "1 = bench.py prints the headline record only"),
+]
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _ENTRIES}
+assert len(KNOBS) == len(_ENTRIES), "duplicate knob registration"

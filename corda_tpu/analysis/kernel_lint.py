@@ -1,0 +1,64 @@
+"""Kernel-jaxpr lint: structural pathologies in the pinned verify
+kernels, gated like the op budget.
+
+PR 7's field tower rewrite exists because the CIOS pattern's
+``dynamic-update-slice`` chains compiled pathologically on XLA CPU
+(fp12_mul 306s → 5.5s after moving to gathered anti-diagonal products);
+``while`` primitives make op counts un-gateable (trip count unknown) and
+block the scan-based pipelining every perf item relies on.  Nothing
+stopped either from creeping back in.  This pass walks the SAME traces
+:mod:`corda_tpu.ops.opbudget` already builds (cached per process — the
+tier-1 op-budget tests and this lint share one trace per kernel) and
+pins, per kernel:
+
+* ``dynamic_update_slice`` — trip-count-weighted dynamic-update-slice
+  equation count (today: 0 everywhere);
+* ``dynamic_loops`` — unbounded ``while`` primitives (today: 0).
+
+Counts live in the ``kernels`` section of ``analysis_manifest.json``
+under the same >5% tolerance mechanism as the op budget; a count pinned
+at 0 fails on ANY growth.  ``tools/lint.py --pin`` re-pins after a
+deliberate change; the diff is the review artifact.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from . import manifest as _manifest
+
+
+def kernel_names() -> Sequence[str]:
+    from ..utils.profiling import OPBUDGET_KERNELS
+
+    return OPBUDGET_KERNELS
+
+
+def kernel_counts(
+    names: Optional[Sequence[str]] = None, use_cache: bool = True
+) -> Dict[str, Dict[str, int]]:
+    """Trace each pinned kernel (through the opbudget cache) and pull
+    out the structural-lint counts."""
+    from ..ops import opbudget
+
+    out: Dict[str, Dict[str, int]] = {}
+    for name in names or kernel_names():
+        counts = opbudget.count_kernel(name, use_cache=use_cache)
+        out[name] = {
+            "dynamic_update_slice": int(
+                counts.get("dynamic_update_slice", 0)
+            ),
+            "dynamic_loops": int(counts.get("dynamic_loops", 0)),
+        }
+    return out
+
+
+def check_all(
+    manifest: Optional[Dict] = None,
+    tolerance: Optional[float] = None,
+    names: Optional[Sequence[str]] = None,
+    use_cache: bool = True,
+) -> List[Dict]:
+    return _manifest.check_kernels(
+        kernel_counts(names, use_cache=use_cache),
+        manifest=manifest, tolerance=tolerance,
+    )

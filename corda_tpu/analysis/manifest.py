@@ -1,0 +1,156 @@
+"""Pinned baseline for the static lint + kernel-jaxpr lint — the same
+pin-and-gate pattern as ops/opbudget_manifest.json.
+
+``analysis_manifest.json`` records, per pass, the KEYS of the findings
+that existed (and were reviewed/accepted) when the baseline was pinned.
+The gate fails on any NEW key: existing debt is visible but frozen, and
+the only way to add a finding is to fix it or suppress it with an
+in-source ``# lint: allow(...)`` carrying a reason — both of which show
+up in review.  Keys present in the baseline but no longer found are
+"stale" (advisory, like op-budget "improved"): re-pin so the baseline
+shrinks and stays honest.  ``python -m corda_tpu.analysis --pin``
+regenerates; the manifest diff is the review artifact.
+
+The ``kernels`` section pins the kernel-jaxpr lint counts
+(dynamic-update-slice eqns and unbounded ``while`` loops per pinned
+verify kernel — see :mod:`.kernel_lint`) under the same >5% tolerance
+as the op budget (integer counts pinned at 0 fail on ANY growth).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .astlint import Finding, PASS_IDS, run_passes
+
+MANIFEST_PATH = os.path.join(
+    os.path.dirname(__file__), "analysis_manifest.json"
+)
+
+DEFAULT_TOLERANCE = 0.05
+
+#: the kernel-jaxpr lint metrics the manifest pins and gates
+KERNEL_METRICS = ("dynamic_update_slice", "dynamic_loops")
+
+
+def load_manifest(path: Optional[str] = None) -> Dict:
+    with open(path or MANIFEST_PATH) as fh:
+        return json.load(fh)
+
+
+def pin_manifest(
+    path: Optional[str] = None,
+    findings: Optional[Sequence[Finding]] = None,
+    kernels: Optional[Dict[str, Dict[str, int]]] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> Dict:
+    """Re-run the passes and rewrite the baseline. A partial pin MERGES:
+    `kernels=None` preserves the existing kernel pins (pinning those
+    requires jax — tools/lint.py --pin traces them; a static-only pin
+    must not drop them), and `passes=<subset>` re-pins only those
+    passes, keeping every other pass's accepted baseline (re-pinning
+    one pass must never resurrect the others' findings as NEW)."""
+    if findings is None:
+        findings = run_passes(passes=passes)
+    existing: Dict = {}
+    try:
+        existing = load_manifest(path)
+    except (OSError, ValueError):
+        pass  # first pin
+    repinned = set(passes) if passes is not None else set(PASS_IDS)
+    baseline: Dict[str, List[str]] = {
+        p: ([] if p in repinned
+            else list(existing.get("passes", {}).get(p, [])))
+        for p in PASS_IDS
+    }
+    for f in findings:
+        baseline.setdefault(f.pass_id, []).append(f.key)
+    for p in baseline:
+        baseline[p] = sorted(set(baseline[p]))
+    manifest = {
+        "comment": (
+            "Accepted-findings baseline for the concurrency lint "
+            "(docs/static-analysis.md). Regenerate with `python -m "
+            "corda_tpu.analysis --pin` (or tools/lint.py --pin) after "
+            "fixing findings; any NEW finding fails tier-1. Never "
+            "hand-edit: the pin diff is the review artifact."
+        ),
+        "tolerance": DEFAULT_TOLERANCE,
+        "passes": baseline,
+        "kernels": (
+            kernels if kernels is not None
+            else dict(existing.get("kernels", {}))
+        ),
+    }
+    with open(path or MANIFEST_PATH, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return manifest
+
+
+def check_findings(
+    findings: Optional[Sequence[Finding]] = None,
+    manifest: Optional[Dict] = None,
+) -> Dict:
+    """Compare current findings to the baseline.
+
+    Returns {"new": [finding dicts], "stale": [keys], "accepted": n}.
+    `new` non-empty = gate failure.
+    """
+    if findings is None:
+        findings = run_passes()
+    if manifest is None:
+        manifest = load_manifest()
+    baseline: Dict[str, List[str]] = manifest.get("passes", {})
+    pinned = {k for keys in baseline.values() for k in keys}
+    current = {f.key for f in findings}
+    new = [f.as_dict() for f in findings if f.key not in pinned]
+    stale = sorted(pinned - current)
+    return {
+        "new": new,
+        "stale": stale,
+        "accepted": len(current & pinned),
+        "total": len(findings),
+    }
+
+
+def check_kernels(
+    counts_by_kernel: Dict[str, Dict],
+    manifest: Optional[Dict] = None,
+    tolerance: Optional[float] = None,
+) -> List[Dict]:
+    """Gate the kernel-jaxpr lint counts against the pinned section.
+    A kernel missing from the manifest is a violation (a gate that
+    skips what it was asked to pin is not a gate); counts pinned at 0
+    fail on any growth; nonzero pins tolerate `tolerance` growth and
+    report shrink as kind="improved"."""
+    if manifest is None:
+        manifest = load_manifest()
+    if tolerance is None:
+        tolerance = float(manifest.get("tolerance", DEFAULT_TOLERANCE))
+    pinned_all = manifest.get("kernels", {})
+    out: List[Dict] = []
+    for name, counts in sorted(counts_by_kernel.items()):
+        pinned = pinned_all.get(name)
+        if pinned is None:
+            out.append({"kernel": name, "metric": None, "kind": "unpinned",
+                        "pinned": None, "measured": None})
+            continue
+        for metric in KERNEL_METRICS:
+            ref = pinned.get(metric)
+            cur = counts.get(metric)
+            if ref is None or cur is None:
+                continue
+            if cur > ref * (1 + tolerance):
+                out.append({"kernel": name, "metric": metric,
+                            "kind": "grew", "pinned": ref, "measured": cur})
+            elif cur < ref * (1 - tolerance):
+                out.append({"kernel": name, "metric": metric,
+                            "kind": "improved", "pinned": ref,
+                            "measured": cur})
+    return out
+
+
+def fatal_kernel_violations(violations: List[Dict]) -> List[Dict]:
+    return [v for v in violations if v["kind"] in ("grew", "unpinned")]

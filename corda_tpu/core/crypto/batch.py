@@ -22,6 +22,7 @@ import time as _time
 from typing import List, Sequence, Tuple
 
 from . import crypto
+from ...utils import lockorder
 from .keys import PublicKey
 from .schemes import (
     ECDSA_SECP256K1_SHA256,
@@ -50,13 +51,13 @@ MIN_DEVICE_BATCH = 32
 DISPATCH = os.environ.get("CORDA_TPU_DISPATCH", "auto")
 _ACCEL_BACKENDS = frozenset({"tpu", "gpu", "cuda", "rocm"})
 _resolved_backend: str | None = None
-_BACKEND_LOCK = threading.Lock()
+_BACKEND_LOCK = lockorder.make_lock("batch._BACKEND_LOCK")
 
 #: threads for the host OpenSSL path; OpenSSL verification via the
 #: `cryptography` bindings is CPU-bound C code, so a small pool scales on
 #: multi-core hosts and degrades to a plain loop on 1-core boxes
 _HOST_POOL = None
-_HOST_POOL_LOCK = threading.Lock()
+_HOST_POOL_LOCK = lockorder.make_lock("batch._HOST_POOL_LOCK")
 _HOST_POOL_MIN = 256  # below this a pool's overhead beats its speedup
 
 
@@ -174,7 +175,7 @@ def _use_device_kernels() -> bool:
 # torsion-component signatures depending on WHEN a fallback happened —
 # the replica-splitting hazard the per-deployment rule exists to prevent.
 _pinned_rule: str | None = None  # "cofactorless" | "cofactored"
-_RULE_LOCK = threading.Lock()
+_RULE_LOCK = lockorder.make_lock("batch._RULE_LOCK")
 
 
 def _ed25519_rule() -> str:

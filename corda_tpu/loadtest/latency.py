@@ -169,7 +169,11 @@ def measure_uniqueness_batch(
         ]
         t_start = time.perf_counter()
         ts = [
-            _threading.Thread(target=work, args=b) for b in bounds if b[0] < b[1]
+            _threading.Thread(
+                target=work, args=b, daemon=True,
+                name=f"uniq-burst-{b[0]}",
+            )
+            for b in bounds if b[0] < b[1]
         ]
         for t in ts:
             t.start()

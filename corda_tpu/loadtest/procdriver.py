@@ -26,7 +26,9 @@ class PairDriver:
         self.completed = []          # payment stx ids
         self.errors = []
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pair-driver"
+        )
 
     def start(self):
         self._thread.start()

@@ -115,7 +115,11 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
             per[i] += 1
         t0 = time.perf_counter()
         threads = [
-            threading.Thread(target=worker, args=(n,)) for n in per if n
+            threading.Thread(
+                target=worker, args=(n,), daemon=True,
+                name=f"real-pay-{i}",
+            )
+            for i, n in enumerate(per) if n
         ]
         for t in threads:
             t.start()

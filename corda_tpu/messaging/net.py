@@ -32,6 +32,7 @@ import socketserver
 import struct
 import threading
 from collections import deque
+from ..utils import lockorder
 from typing import Callable, Dict, Optional, Tuple
 
 from .broker import (
@@ -327,7 +328,7 @@ class _Conn:
         raw = socket.create_connection((host, port), timeout=10)
         raw.settimeout(timeout)
         self.sock = client_wrap(raw) if client_wrap is not None else raw
-        self.lock = threading.Lock()
+        self.lock = lockorder.make_lock("_Conn.lock")
 
     def request(self, body: bytes) -> bytes:
         with self.lock:

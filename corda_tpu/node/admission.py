@@ -45,7 +45,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.serialization.codec import register_adapter
-from ..utils import eventlog
+from ..utils import eventlog, lockorder
 
 
 class NodeOverloadedError(Exception):
@@ -82,7 +82,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("TokenBucket._lock")
 
     def try_acquire(self, n: float = 1.0) -> Tuple[bool, float]:
         """(acquired, seconds_until_available_if_not)."""
@@ -137,7 +137,7 @@ class OverloadStateMachine:
         self.hold_s = hold_s
         self._clock = clock
         self._node = node_name
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("OverloadStateMachine._lock")
         #: (name, read fn, high, low)
         self._signals: List[Tuple[str, Callable[[], float], float, float]] = []
         self._state = NORMAL

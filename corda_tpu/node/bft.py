@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..core.serialization.codec import deserialize, serialize
+from ..utils import lockorder
 
 logger = logging.getLogger(__name__)
 
@@ -1031,7 +1032,7 @@ class BFTClient:
         # request_id -> {replica_id: result}: one vote per replica
         self._replies: Dict[str, Dict[int, object]] = {}
         self._counter = 0
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("BFTClient._lock")
 
     def submit(self, command: dict) -> Future:
         with self._lock:

@@ -21,6 +21,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..core.crypto.secure_hash import SecureHash
 from ..core.serialization.codec import deserialize, serialize
+from ..utils import lockorder
 
 
 class NodeDatabase:
@@ -52,7 +53,7 @@ class NodeDatabase:
                     raise
                 _time.sleep(0.01)
         self._conn.execute(f"PRAGMA synchronous={synchronous}")
-        self.lock = threading.RLock()
+        self.lock = lockorder.make_rlock("NodeDatabase.lock")
         # depth of open transaction() contexts on the holding thread:
         # per-statement autocommit is suppressed inside, so a batch
         # (e.g. record_transactions' tx + vault + attribute rows) pays
@@ -290,7 +291,9 @@ class TransactionStorage:
         # flows run on RPC pool workers + the p2p pump + the blocking
         # executor concurrently; an unsynchronized hit-then-move_to_end
         # racing an eviction would raise KeyError out of storage.get
-        self._cache_lock = threading.Lock()
+        self._cache_lock = lockorder.make_lock(
+            "TransactionStorage._cache_lock"
+        )
 
     def add(self, stx) -> bool:
         """Record; returns False if already present. Fires observers on new."""

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+from ..utils import lockorder
 
 #: lifecycle states, in order
 STARTING, SERVING, DRAINING, STOPPED = (
@@ -62,7 +63,7 @@ class HealthTracker:
     """Per-node lifecycle state + named component checks."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("HealthTracker._lock")
         self._state = STARTING
         self._state_since = time.time()
         #: name -> (check fn, counts toward readiness, counts toward liveness)

@@ -46,7 +46,7 @@ from ..core.flows.api import (
     FlowKilledException,
     encode_flow_exception,
 )
-from ..utils import eventlog, timerwheel
+from ..utils import eventlog, lockorder, timerwheel
 from ..verifier.failover import backoff_delay
 from ..verifier.service import VerificationTimeoutError
 
@@ -114,7 +114,7 @@ class FlowHospital:
         self.transient_predicates: List[Callable[[BaseException], bool]] = [
             _notary_unavailable,
         ]
-        self._lock = threading.RLock()
+        self._lock = lockorder.make_rlock("FlowHospital._lock")
         self._closed = False
         #: flow_id -> recovery record for flows awaiting / mid re-admission
         self._recovering: Dict[str, dict] = {}

@@ -22,7 +22,7 @@ from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..core.identity import Party
-from ..utils import tracing
+from ..utils import eventlog, lockorder, tracing
 
 
 @dataclass(frozen=True)
@@ -44,7 +44,7 @@ class InMemoryMessagingNetwork:
     def __init__(self):
         self._queue: Deque[_InFlight] = deque()
         self._endpoints: Dict[str, "InMemoryMessaging"] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("InMemoryMessagingNetwork._lock")
         self.sent_count = 0
         self.delivered_count = 0
         # Hook: fn(msg) -> bool keep (False drops the message); used for
@@ -447,8 +447,16 @@ class BrokerMessagingService:
                     for fn in self._handlers.get(topic, []):
                         try:
                             fn(sender, msg.payload)
-                        except Exception:
-                            pass  # handler errors must not kill the pump
+                        except Exception as exc:
+                            # handler errors must not kill the pump, but
+                            # a silently-dropped delivery is exactly the
+                            # evidence a flow hang investigation needs
+                            eventlog.emit(
+                                "error", "p2p",
+                                f"handler error on {topic}",
+                                error=f"{type(exc).__name__}: {exc}",
+                                sender=str(sender),
+                            )
                     sp.finish()
                 if metrics is not None:
                     metrics.timer(f"P2P.Handle.{topic}").update(

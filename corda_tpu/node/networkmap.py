@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.crypto import crypto
 from ..core.identity import Party
+from ..utils import lockorder
 from ..core.serialization.codec import (
     deserialize,
     register_adapter,
@@ -142,7 +143,7 @@ class NetworkMapService:
         #: last re-attempted registration (incl. "unchanged" fast-path)
         self._last_seen: Dict[str, float] = {}
         self._subscribers: Dict[str, None] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("NetworkMapService._lock")
         self._persist_path = persist_path
         if persist_path and os.path.exists(persist_path):
             try:
@@ -358,7 +359,7 @@ class NetworkMapClient:
         self._stop = threading.Event()
         self._extra_refresh_interval = float(extra_refresh_interval)
         # serializes reply-queue conversations across the refresh threads
-        self._reg_lock = threading.Lock()
+        self._reg_lock = lockorder.make_lock("NetworkMapClient._reg_lock")
         self._push_thread = threading.Thread(
             target=self._consume_pushes, name=f"netmap-push-{me.name}",
             daemon=True,
@@ -619,7 +620,7 @@ class BridgeManager:
         self._local = local_broker
         self._addresses: Dict[str, str] = {}
         self._threads: Dict[str, threading.Thread] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("BridgeManager._lock")
         self._stop = threading.Event()
         self._factory = remote_broker_factory or (
             lambda host, port: RemoteBroker(host, port)

@@ -14,7 +14,7 @@ from typing import Iterable, List, Optional
 from ..core.crypto import crypto
 from ..core.crypto.keys import KeyPair
 from ..core.identity import Party
-from ..utils import eventlog
+from ..utils import eventlog, lockorder
 from ..utils.metrics import MetricRegistry, MonitoringService
 from ..verifier.batcher import SignatureBatcher
 from ..verifier.service import (
@@ -688,7 +688,7 @@ class AbstractNode:
         # the replica state machine is single-threaded by design (unlike
         # RaftNode, which locks internally): the pump handler and the
         # view-change ticker serialize through this lock
-        self._bft_lock = _threading.RLock()
+        self._bft_lock = lockorder.make_rlock("AbstractNode._bft_lock")
 
         def validate_reply(command, result) -> bool:
             # conflict-free verdicts count toward the f+1 quorum only

@@ -28,7 +28,7 @@ from ..core.identity import Party
 from ..core.serialization.codec import deserialize, register_adapter, serialize
 from ..core.transactions.filtered import FilteredTransaction
 from ..core.transactions.signed import SignedTransaction
-from ..utils import eventlog, faultpoints, tracing
+from ..utils import eventlog, faultpoints, lockorder, tracing
 from .database import KVStore, NodeDatabase
 
 
@@ -477,7 +477,7 @@ class CoalescingUniquenessProvider(UniquenessProvider):
         # classifies it transient, so admitted flows retry with backoff
         # + jitter instead of dying). 0 = unbounded.
         self.max_queue = max_queue
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("CoalescingUniquenessProvider._lock")
         # (states, tx_id, party, trace ctx, Future) — the ctx is what lets
         # one group commit emit a fan-in span linking every waiting flow
         self._pending: List[Tuple] = []

@@ -31,6 +31,7 @@ from ..core.serialization.codec import deserialize, serialize
 from .database import KVStore, NodeDatabase
 
 import logging as _logging
+from ..utils import lockorder
 
 logger = _logging.getLogger("corda_tpu.raft")
 
@@ -81,7 +82,7 @@ class RaftNode:
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self._rand = random.Random(seed if seed is not None else node_id)
-        self._lock = threading.RLock()
+        self._lock = lockorder.make_rlock("RaftNode._lock")
         # persistent state: meta (term/vote/snapshot) + one KV row per log
         # entry, so heartbeats cost nothing and appends are O(1), not O(log).
         self._meta = KVStore(db, "raft_meta") if db is not None else None

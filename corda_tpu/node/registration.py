@@ -26,6 +26,7 @@ except ImportError:  # pragma: no cover - registration needs real X.509
     from ..core.crypto.pki import serialization, x509  # lazy-failing stubs
 
 from ..core.crypto import pki
+from ..utils import lockorder
 
 
 class RegistrationError(Exception):
@@ -170,7 +171,7 @@ class DoormanServer:
         self.intermediate = pki.create_intermediate_ca(self.root)
         self.auto_approve = auto_approve
         self._requests: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("DoormanServer._lock")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

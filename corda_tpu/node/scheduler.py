@@ -17,6 +17,7 @@ from ..core.contracts.structures import SchedulableState, StateRef
 from ..core.flows.api import flow_registry
 from ..core.serialization.codec import deserialize, serialize
 from .database import KVStore, NodeDatabase
+from ..utils import lockorder
 
 
 class SchedulerService:
@@ -24,7 +25,7 @@ class SchedulerService:
         self._store = KVStore(db, "scheduled_states")
         self._services = services
         self._smm = smm
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("SchedulerService._lock")
         services.vault_service.track(self._on_vault_update)
 
     @staticmethod

@@ -21,6 +21,7 @@ from ..core.crypto.keys import KeyPair, PublicKey
 from ..core.crypto.secure_hash import SecureHash
 from ..core.identity import AnonymousParty, Party
 from ..core.serialization.codec import deserialize, serialize
+from ..utils import lockorder
 from ..utils.metrics import MonitoringService
 from . import vault_query as _vault_query  # noqa: F401 — registers codec adapters
 from .database import (
@@ -45,7 +46,7 @@ class IdentityService:
         self._by_name: Dict[str, Party] = {}
         self._certs: Dict[str, object] = {}  # name -> leaf certificate
         self.trust_root = trust_root
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("IdentityService._lock")
 
     def register_identity(self, party: Party) -> None:
         with self._lock:
@@ -136,7 +137,7 @@ class ContractUpgradeService:
 
     def __init__(self):
         self._authorised: Dict[Tuple[bytes, int], str] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("ContractUpgradeService._lock")
 
     @staticmethod
     def _key(state_ref) -> Tuple[bytes, int]:
@@ -206,7 +207,7 @@ class NetworkMapCache:
         self._nodes: Dict[str, Party] = {}
         self._services: Dict[str, List[Party]] = {}
         self._node_services: Dict[str, Set[str]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("NetworkMapCache._lock")
         self._observers: List[Callable] = []  # fn(change: str, party)
 
     def track(self, observer: Callable) -> None:

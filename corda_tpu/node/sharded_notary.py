@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..core.contracts.structures import StateRef
 from ..core.crypto.secure_hash import SecureHash
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import eventlog, faultpoints
+from ..utils import eventlog, faultpoints, lockorder
 from .notary import (
     Conflict,
     PersistentUniquenessProvider,
@@ -112,7 +112,7 @@ class ReservationStore:
         # groups in concurrent threads, and abort/recovery releases run
         # outside the provider's per-shard commit lock); sqlite
         # serialises the db path itself
-        self._mem_lock = threading.Lock()
+        self._mem_lock = lockorder.make_lock("ReservationStore._mem_lock")
         if db is not None:
             db.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "
@@ -421,7 +421,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 )
         self.delegates = list(delegates)
         self.n_shards = len(self.delegates)
-        self._locks = [threading.Lock() for _ in self.delegates]
+        self._locks = [
+            lockorder.make_lock(f"ShardedUniquenessProvider.shard{i}")
+            for i in range(len(self.delegates))
+        ]
         self._probes = [self._probe_fn(d) for d in self.delegates]
         self._db = db
         self.clock = clock
@@ -452,7 +455,9 @@ class ShardedUniquenessProvider(UniquenessProvider):
         # come from CONCURRENT per-shard drain threads (the coalescing
         # layer runs shard groups in parallel), so they serialise on one
         # lock — unsynchronized '+=' would drop updates
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lockorder.make_lock(
+            "ShardedUniquenessProvider._stats_lock"
+        )
         self.single_commits = 0
         self.cross_commits = 0
         self.cross_aborts = 0

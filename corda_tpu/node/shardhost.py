@@ -60,7 +60,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..core.serialization.codec import deserialize
-from ..utils import eventlog
+from ..utils import eventlog, lockorder
 from .session import (
     ROUTE_HINT_HEADER,
     SESSION_TOPIC,
@@ -374,7 +374,7 @@ class ShardSupervisor:
         self.name = node.info.name
         self.workers = [_WorkerProc(i) for i in range(self.n_workers)]
         self._peers: Dict[str, tuple] = {}  # name -> (party, services)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("ShardSupervisor._lock")
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self.router: Optional[ShardRouter] = None
@@ -599,7 +599,10 @@ class ShardSupervisor:
                     entry["healthz"] = health.get("status", health)
 
             probes = [
-                threading.Thread(target=_probe, args=(e,), daemon=True)
+                threading.Thread(
+                    target=_probe, args=(e,), daemon=True,
+                    name=f"worker-probe-{e['ops_port']}",
+                )
                 for e in out["detail"].values()
                 if e["alive"] and e["ops_port"]
             ]

@@ -43,7 +43,7 @@ from ..core.flows.api import (
 )
 from ..core.identity import Party
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import eventlog, tracing
+from ..utils import eventlog, lockorder, tracing
 from ..utils.metrics import MetricRegistry
 from .session import (
     ROUTE_HINT_HEADER,
@@ -141,7 +141,7 @@ class FlowStateMachine:
         # resumes on an executor thread; an unlocked check-then-park
         # against deliver_data loses wakeups). RLock: deliveries cascade
         # into _run on the same thread.
-        self._step_lock = threading.RLock()
+        self._step_lock = lockorder.make_rlock("FlowStateMachine._step_lock")
 
     def next_subflow_ordinal(self) -> int:
         self._subflow_counter += 1
@@ -766,7 +766,9 @@ class StateMachineManager:
         # cap-check + flows-registration atomic: two RPC pool threads
         # racing start_flow must not both pass a max_flows-1 reading.
         self.admission = None
-        self._start_gate = threading.Lock()
+        self._start_gate = lockorder.make_lock(
+            "StateMachineManager._start_gate"
+        )
         # Multi-process sharding (node/shardhost.py): workers set a tag
         # ("w0", "w1", …) that prefixes every flow id — and therefore
         # every session id ("<flow id>:<n>") — so the supervisor's

@@ -42,6 +42,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.profiling import OPBUDGET_KERNELS
+from ..utils import lockorder
 
 MANIFEST_PATH = os.path.join(os.path.dirname(__file__), "opbudget_manifest.json")
 
@@ -63,8 +64,12 @@ PINNED_METRICS = (
 #: fails on synthetic ladder growth; production never sets it)
 _TEST_EXTRA_MULS = 0
 
+#: TEST HOOK — extra dynamic-update-slice ops folded into the traced
+#: kernel (the kernel-jaxpr lint proves its gate trips on them)
+_TEST_EXTRA_DUS = 0
+
 _cache: Dict[str, Dict] = {}
-_cache_lock = threading.Lock()
+_cache_lock = lockorder.make_lock("opbudget._cache_lock")
 
 
 # -- jaxpr walking -----------------------------------------------------------
@@ -99,6 +104,11 @@ def _walk(jaxpr, mult: int, stats: Dict[str, int]) -> Dict[str, int]:
         if sub is not None:
             _walk(sub.jaxpr if hasattr(sub, "jaxpr") else sub, m, stats)
             continue
+        if name == "dynamic_update_slice":
+            # the kernel-jaxpr lint (corda_tpu/analysis/kernel_lint.py)
+            # pins this at 0: d-u-s chains are the exact shape that
+            # compiled pathologically on XLA CPU (fp12_mul 306s → 5.5s)
+            stats["dus_eqns"] += m
         out = eqn.outvars[0].aval
         dtype = getattr(out, "dtype", None)
         if dtype is None or not jnp.issubdtype(dtype, jnp.integer):
@@ -117,17 +127,25 @@ def _count_fn(fn: Callable, args: Tuple, kwargs: Dict) -> Dict[str, int]:
     jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
     return _walk(jaxpr.jaxpr, 1, {
         "mul_eqns": 0, "mul_elems": 0, "int_elems": 0, "dynamic_loops": 0,
+        "dus_eqns": 0,
     })
 
 
 def _inflate(mask, arr, field_mul: Callable):
-    """Fold `_TEST_EXTRA_MULS` dummy field multiplies into the traced
-    graph, keeping them live in the output so tracing cannot drop them."""
-    if not _TEST_EXTRA_MULS:
+    """Fold `_TEST_EXTRA_MULS` dummy field multiplies (and
+    `_TEST_EXTRA_DUS` dynamic-update-slices) into the traced graph,
+    keeping them live in the output so tracing cannot drop them."""
+    if not (_TEST_EXTRA_MULS or _TEST_EXTRA_DUS):
         return mask
     x = arr
     for _ in range(_TEST_EXTRA_MULS):
         x = field_mul(x, x)
+    if _TEST_EXTRA_DUS:
+        from jax import lax
+
+        for _ in range(_TEST_EXTRA_DUS):
+            update = x[(slice(0, 1),) * x.ndim]
+            x = lax.dynamic_update_slice(x, update, (0,) * x.ndim)
     return mask & (x[..., 0] >= 0)
 
 
@@ -310,6 +328,7 @@ def count_kernel(name: str, use_cache: bool = True) -> Dict:
         ),
         "field_mul_elems": round(cal_elems, 1),
         "dynamic_loops": stats["dynamic_loops"],
+        "dynamic_update_slice": stats["dus_eqns"],
         "jax_version": jax.__version__,
     }
     with _cache_lock:

@@ -41,7 +41,7 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional
 
-from . import tracing
+from . import lockorder, tracing
 
 #: severity order for minimum-level filtering
 LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40,
@@ -74,7 +74,7 @@ class EventLog:
         self.min_level = min_level
         self.enabled = enabled
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("EventLog._lock")
         self._emitted = 0
         self._by_level: Dict[str, int] = {}
 
@@ -203,7 +203,7 @@ class EventLogHandler(logging.Handler):
             pass  # a log record must never take the producer down
 
 
-_install_lock = threading.Lock()
+_install_lock = lockorder.make_lock("eventlog._install_lock")
 _bridge_handler: Optional[EventLogHandler] = None
 
 

@@ -1,0 +1,601 @@
+"""Runtime lock-order deadlock detector (CORDA_TPU_LOCKCHECK=1).
+
+PR 8 left 47 modules holding locks and a two-phase commit protocol whose
+locking discipline was hand-reasoned in review (4 passes found lock/ack
+races).  This module machine-checks the part reviews are worst at:
+*ordering*.  Concurrent modules create their locks through the factory
+seam here —
+
+    self._lock = lockorder.make_lock("Broker._lock")
+    self._cv   = lockorder.make_condition(self._lock, name="Broker.not_empty")
+
+— which returns plain ``threading`` primitives when the detector is off
+(the default: zero overhead, byte-identical behaviour) and instrumented
+wrappers when ``CORDA_TPU_LOCKCHECK=1`` (or ``enable(True)`` in tests):
+
+  * every thread keeps a **held stack** (which instrumented locks it
+    holds, with the acquire stack trace and acquire time);
+  * every acquire records **acquisition-order edges** held → target in a
+    process-global graph *before* blocking, so an actual deadlock still
+    gets reported by the second thread on its way into the wait;
+  * a new edge that closes a **cycle** (the ABBA shape) produces a
+    report carrying BOTH acquisition stacks for every edge on the cycle;
+  * releasing a lock held longer than ``CORDA_TPU_LOCKCHECK_HOLD_MS``
+    (default 1000) produces a **hold-time** report with the holder's
+    acquire stack — the convoy signal that precedes a deadlock in
+    practice;
+  * reports land in :func:`reports` (bounded) and the node event log
+    (component ``lockcheck``).
+
+Locks are graph nodes **per instance** (a cycle means these exact locks
+can deadlock), but every report also names the creation site so a
+finding maps back to code.  Reentrant acquires (RLock, Condition re-entry)
+count per-thread and add no self-edges.  ``Condition.wait`` releases the
+underlying lock, so the held stack pops for the duration of the wait and
+re-pushes when it returns — a wait never holds its edge open.
+
+The detector is deliberately stdlib-only and jax-free: the tier-1
+scenario (tests/test_lockorder.py) runs a MockNetwork notarise plus a
+sharded cross-shard commit under it and asserts zero cycles.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+#: bound the graph so dynamically-created locks (per-tx reservation
+#: locks and the like) cannot grow it without limit; locks created past
+#: the cap stay correct but stop recording (noted in meta()).
+MAX_NODES = 4096
+MAX_EDGES = 65536
+MAX_REPORTS = 256
+_STACK_LIMIT = 24
+
+_enabled_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Detector armed? Checked at lock CREATION time — flipping it later
+    affects new locks only (tests enable() before building the node)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("CORDA_TPU_LOCKCHECK", "0") not in ("", "0")
+
+
+def enable(flag: Optional[bool]) -> None:
+    """Programmatic override for tests (None = back to the env knob)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def hold_ms() -> float:
+    try:
+        return float(os.environ.get("CORDA_TPU_LOCKCHECK_HOLD_MS", 1000.0))
+    except ValueError:
+        return 1000.0
+
+
+# -- global state -------------------------------------------------------------
+# The bookkeeping lock is a PLAIN threading.Lock (never instrumented —
+# instrumenting it would recurse) and is only ever taken while the
+# caller holds no other bookkeeping state, so it cannot itself deadlock.
+
+_glock = threading.Lock()
+_lids = itertools.count(1)
+_nodes: Dict[int, "_Node"] = {}  # guarded-by: _glock
+_edges: Dict[int, Set[int]] = {}  # guarded-by: _glock
+_edge_info: Dict[Tuple[int, int], Dict] = {}  # guarded-by: _glock
+_reports: List[Dict] = []  # guarded-by: _glock
+_seen_cycles: Set[frozenset] = set()  # guarded-by: _glock
+_seen_holds: Set[int] = set()  # guarded-by: _glock
+_dropped = {"nodes": 0, "edges": 0, "reports": 0}  # guarded-by: _glock
+
+_tls = threading.local()
+
+
+class _Node:
+    __slots__ = ("lid", "name", "site")
+
+    def __init__(self, lid: int, name: str, site: str):
+        self.lid = lid
+        self.name = name
+        self.site = site
+
+
+def _held() -> List["_HeldEntry"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class _HeldEntry:
+    __slots__ = ("lid", "count", "stack", "t0")
+
+    def __init__(self, lid: int, stack, t0: float):
+        self.lid = lid
+        self.count = 1
+        self.stack = stack
+        self.t0 = t0
+
+
+def _creation_site() -> str:
+    # the first frame outside this module is the factory caller
+    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+        if not frame.filename.endswith("lockorder.py"):
+            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "?"
+
+
+def _register(name: Optional[str]) -> Optional[_Node]:
+    site = _creation_site()
+    with _glock:
+        if len(_nodes) >= MAX_NODES:
+            _dropped["nodes"] += 1
+            return None
+        lid = next(_lids)
+        node = _Node(lid, name or f"lock@{site}", site)
+        _nodes[lid] = node
+        return node
+
+
+def _fmt_stack(stack) -> List[str]:
+    return [f"{os.path.basename(f.filename)}:{f.lineno} {f.name}"
+            for f in stack]
+
+
+def _emit_report(report: Dict) -> None:
+    # _glock held by callers; the event-log emit happens outside it
+    # lint: allow(guarded_by) — every caller holds _glock
+    _reports.append(report)
+    if len(_reports) > MAX_REPORTS:
+        del _reports[0]
+        # lint: allow(guarded_by) — every caller holds _glock
+        _dropped["reports"] += 1
+
+
+def _eventlog_emit(kind: str, message: str) -> None:
+    try:
+        from . import eventlog
+
+        eventlog.emit("warning", "lockcheck", message, kind=kind)
+    # lint: allow(swallow) — the detector must never take a node down
+    except Exception:
+        pass
+
+
+def _find_cycle(start: int, goal_set: Set[int]) -> Optional[List[int]]:
+    """DFS from `start` along recorded edges; a path into any currently
+    held lock closes a cycle (we are about to add held→start edges).
+    Iterative — this runs inside acquire() and must never blow the
+    recursion limit on a deep graph."""
+    if start in goal_set:
+        return None  # reentrant, not a cycle
+    seen: Set[int] = set()
+    path: List[int] = [start]
+    iters: List = [iter(_edges.get(start, ()))]
+    seen.add(start)
+    while iters:
+        nxt = next(iters[-1], None)
+        if nxt is None:
+            iters.pop()
+            path.pop()
+            continue
+        if nxt in seen:
+            continue
+        seen.add(nxt)
+        if nxt in goal_set:
+            path.append(nxt)
+            return path
+        path.append(nxt)
+        iters.append(iter(_edges.get(nxt, ())))
+    return None
+
+
+def _before_acquire(node: Optional[_Node]) -> bool:
+    """Record edges held→target and test for a cycle. Returns True when
+    the acquire is reentrant (caller must not push a new held entry)."""
+    if node is None:
+        return False
+    held = _held()
+    for entry in held:
+        if entry.lid == node.lid:
+            entry.count += 1
+            return True
+    if not held:
+        return False
+    # steady-state fast path: once every held→target edge is recorded
+    # there is nothing to insert and no new cycle can have formed —
+    # skip the stack capture and the DFS (the dominant per-acquire
+    # costs) entirely
+    with _glock:
+        if all(node.lid in _edges.get(e.lid, ()) for e in held):
+            return False
+    stack = traceback.extract_stack(limit=_STACK_LIMIT)
+    cycle_report = None
+    with _glock:
+        held_lids = {e.lid for e in held}
+        for entry in held:
+            edge = (entry.lid, node.lid)
+            dsts = _edges.setdefault(entry.lid, set())
+            if node.lid not in dsts:
+                if len(_edge_info) >= MAX_EDGES:
+                    _dropped["edges"] += 1
+                    continue
+                dsts.add(node.lid)
+                _edge_info[edge] = {
+                    "src": entry.lid,
+                    "dst": node.lid,
+                    "thread": threading.current_thread().name,
+                    "src_stack": _fmt_stack(entry.stack),
+                    "dst_stack": _fmt_stack(stack),
+                }
+        cycle = _find_cycle(node.lid, held_lids)
+        if cycle is not None:
+            closing = cycle[-1]  # the held lock the path reached
+            full = cycle + [cycle[0]]  # close the ring for edge listing
+            key = frozenset(cycle)
+            if key not in _seen_cycles:
+                _seen_cycles.add(key)
+                edges_out = []
+                for a, b in zip(full, full[1:]):
+                    info = _edge_info.get((a, b))
+                    edges_out.append({
+                        "from": _nodes[a].name, "from_site": _nodes[a].site,
+                        "to": _nodes[b].name, "to_site": _nodes[b].site,
+                        "held_stack": (info or {}).get("src_stack"),
+                        "acquire_stack": (info or {}).get("dst_stack"),
+                        "thread": (info or {}).get("thread"),
+                    })
+                cycle_report = {
+                    "kind": "cycle",
+                    "locks": [_nodes[l].name for l in cycle],
+                    "sites": [_nodes[l].site for l in cycle],
+                    "closing_thread": threading.current_thread().name,
+                    "closing_lock": _nodes[closing].name,
+                    "edges": edges_out,
+                }
+                _emit_report(cycle_report)
+    if cycle_report is not None:
+        _eventlog_emit(
+            "cycle",
+            "potential deadlock: lock-order cycle "
+            + " -> ".join(cycle_report["locks"]),
+        )
+    return False
+
+
+def _after_acquire(node: Optional[_Node], reentrant: bool) -> None:
+    if node is None or reentrant:
+        return
+    _held().append(_HeldEntry(
+        node.lid, traceback.extract_stack(limit=_STACK_LIMIT),
+        time.monotonic(),
+    ))
+
+
+def _on_release(node: Optional[_Node]) -> bool:
+    """Pop the held entry (outermost release only); returns whether an
+    entry was actually popped — Condition.wait uses that to avoid
+    pushing a phantom entry when the wait itself raised on misuse."""
+    if node is None:
+        return False
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        entry = held[i]
+        if entry.lid == node.lid:
+            entry.count -= 1
+            if entry.count <= 0:
+                del held[i]
+                dt_ms = (time.monotonic() - entry.t0) * 1000.0
+                if dt_ms > hold_ms():
+                    hold_report = None
+                    with _glock:
+                        if node.lid not in _seen_holds:
+                            _seen_holds.add(node.lid)
+                            hold_report = {
+                                "kind": "hold",
+                                "lock": node.name,
+                                "site": node.site,
+                                "held_ms": round(dt_ms, 1),
+                                "limit_ms": hold_ms(),
+                                "thread":
+                                    threading.current_thread().name,
+                                "acquire_stack": _fmt_stack(entry.stack),
+                            }
+                            _emit_report(hold_report)
+                    if hold_report is not None:
+                        _eventlog_emit(
+                            "hold",
+                            f"lock {node.name} held "
+                            f"{dt_ms:.0f}ms (> {hold_ms():.0f}ms)",
+                        )
+                return True
+            return False  # inner release of a reentrant hold
+    # releasing a lock this thread never recorded (acquired before
+    # instrumentation or handed across threads) — nothing to pop
+    return False
+
+
+# -- instrumented primitives --------------------------------------------------
+
+class _InstrumentedLock:
+    """Wraps a threading.Lock/RLock. Presents the full lock protocol
+    (including the private Condition hooks) so it can back a
+    threading.Condition or be passed anywhere a lock is expected."""
+
+    _reentrant_ok = False  # make_rlock's wrapper overrides
+
+    def __init__(self, inner, node: Optional[_Node]):
+        self._inner = inner
+        self._node = node
+
+    @property
+    def name(self) -> str:
+        return self._node.name if self._node else "lock@capped"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        reentrant = _before_acquire(self._node)
+        if reentrant and not self._reentrant_ok and blocking:
+            # a blocking re-acquire of a plain Lock on the same thread
+            # is the simplest deadlock there is — report BEFORE we hang
+            # (timeout acquires escape; the report is the evidence)
+            self._report_self_deadlock()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _after_acquire(self._node, reentrant)
+        elif reentrant:
+            # failed reentrant attempt: undo the count bump
+            for entry in _held():
+                if self._node and entry.lid == self._node.lid:
+                    entry.count -= 1
+                    break
+        return ok
+
+    def release(self) -> None:
+        _on_release(self._node)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _report_self_deadlock(self) -> None:
+        node = self._node
+        entry = next(
+            (e for e in _held() if e.lid == node.lid), None
+        )
+        report = {
+            "kind": "self_deadlock",
+            "lock": node.name,
+            "site": node.site,
+            "thread": threading.current_thread().name,
+            "held_stack": _fmt_stack(entry.stack) if entry else None,
+            "acquire_stack": _fmt_stack(
+                traceback.extract_stack(limit=_STACK_LIMIT)
+            ),
+        }
+        with _glock:
+            if node.lid not in _seen_holds:  # once per lock, like holds
+                _seen_holds.add(node.lid)
+                _emit_report(report)
+                emitted = True
+            else:
+                emitted = False
+        if emitted:
+            _eventlog_emit(
+                "self_deadlock",
+                f"same-thread blocking re-acquire of non-reentrant "
+                f"lock {node.name} — this thread is about to deadlock",
+            )
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<InstrumentedLock {self.name} {self._inner!r}>"
+
+    # Condition protocol (delegated so threading.Condition can use a
+    # wrapper directly if one is ever passed in raw; plain Locks get
+    # the same fallbacks the stdlib Condition uses)
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        _on_release(self._node)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+
+    def _acquire_restore(self, state):
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        _after_acquire(self._node, False)
+
+
+class _InstrumentedRLock(_InstrumentedLock):
+    _reentrant_ok = True
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+
+class _InstrumentedCondition:
+    """A Condition whose lock traffic is tracked through the detector.
+    wait() pops the held entry for the duration (the lock really is
+    released) and re-pushes on wakeup."""
+
+    def __init__(self, lockw: _InstrumentedLock, name: Optional[str]):
+        self._lockw = lockw
+        self._name = name or (lockw.name + ".cv")
+        self._inner = threading.Condition(lockw._inner)
+
+    def acquire(self, *args):
+        return self._lockw.acquire(*args)
+
+    def release(self) -> None:
+        self._lockw.release()
+
+    def __enter__(self):
+        return self._lockw.__enter__()
+
+    def __exit__(self, *exc) -> None:
+        self._lockw.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None):
+        # Condition._release_save releases EVERY recursion level of an
+        # RLock, so pop the whole held entry (count included) and
+        # restore it verbatim on wakeup — decrementing one level would
+        # desync the stack and lose this lock's future ordering edges
+        node = self._lockw._node
+        entry = None
+        if node is not None:
+            held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i].lid == node.lid:
+                    entry = held[i]
+                    del held[i]
+                    break
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if entry is not None:
+                entry.t0 = time.monotonic()  # hold clock restarts
+                _held().append(entry)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # re-implemented over self.wait so the held-stack pop/push and
+        # edge bookkeeping run per wakeup like the stdlib's loop
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<InstrumentedCondition {self._name}>"
+
+
+# -- factories (the seam modules use) ----------------------------------------
+
+def make_lock(name: Optional[str] = None):
+    """A mutex: plain threading.Lock when the detector is off."""
+    if not enabled():
+        return threading.Lock()
+    return _InstrumentedLock(threading.Lock(), _register(name))
+
+
+def make_rlock(name: Optional[str] = None):
+    if not enabled():
+        return threading.RLock()
+    return _InstrumentedRLock(threading.RLock(), _register(name))
+
+
+def make_condition(lock=None, name: Optional[str] = None):
+    """A condition variable, optionally sharing an existing lock made by
+    make_lock/make_rlock (the common `Condition(self._lock)` shape)."""
+    if isinstance(lock, _InstrumentedLock):
+        return _InstrumentedCondition(lock, name)
+    if not enabled():
+        return threading.Condition(lock)
+    if lock is None:
+        lockw = _InstrumentedRLock(
+            threading.RLock(), _register((name or "cv") + ".lock")
+        )
+        return _InstrumentedCondition(lockw, name)
+    # a plain pre-existing lock under an armed detector: wrap it so the
+    # condition's traffic is still tracked (RLocks keep reentrancy)
+    cls = (_InstrumentedRLock
+           if isinstance(lock, type(threading.RLock())) else
+           _InstrumentedLock)
+    lockw = cls(lock, _register((name or "cv") + ".lock"))
+    return _InstrumentedCondition(lockw, name)
+
+
+# -- inspection ---------------------------------------------------------------
+
+def reports(kind: Optional[str] = None) -> List[Dict]:
+    with _glock:
+        out = list(_reports)
+    return [r for r in out if kind is None or r["kind"] == kind]
+
+
+def cycles() -> List[Dict]:
+    return reports("cycle")
+
+
+def graph_snapshot() -> Dict:
+    with _glock:
+        return {
+            "nodes": {lid: {"name": n.name, "site": n.site}
+                      for lid, n in _nodes.items()},
+            "edges": sorted(
+                (_nodes[a].name, _nodes[b].name)
+                for a, dsts in _edges.items() for b in dsts
+                if a in _nodes and b in _nodes
+            ),
+        }
+
+
+def meta() -> Dict:
+    with _glock:
+        return {
+            "enabled": enabled(),
+            "nodes": len(_nodes),
+            "edges": len(_edge_info),
+            "reports": len(_reports),
+            "dropped": dict(_dropped),
+        }
+
+
+def held_now() -> List[str]:
+    """Names of locks the CURRENT thread holds (test/debug aid)."""
+    with _glock:
+        return [_nodes[e.lid].name for e in _held() if e.lid in _nodes]
+
+
+def reset() -> None:
+    """Drop all graph state and reports (tests; the per-thread held
+    stacks of OTHER threads are intentionally left alone)."""
+    with _glock:
+        _nodes.clear()
+        _edges.clear()
+        _edge_info.clear()
+        _reports.clear()
+        _seen_cycles.clear()
+        _seen_holds.clear()
+        for k in _dropped:
+            _dropped[k] = 0
+    _tls.held = []

@@ -15,6 +15,7 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Optional
+from . import lockorder
 
 
 class Counter:
@@ -22,7 +23,7 @@ class Counter:
 
     def __init__(self) -> None:
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("Counter._lock")
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -111,7 +112,7 @@ class Meter:
         self._count = 0
         self._m1 = _EWMA(60.0, clock)
         self._m5 = _EWMA(300.0, clock)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("Meter._lock")
 
     def mark(self, n: int = 1) -> None:
         with self._lock:
@@ -148,7 +149,7 @@ class Timer:
         self._durations: deque = deque(maxlen=self.RESERVOIR)
         self._total = 0.0  # exact lifetime sum (the reservoir is windowed)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("Timer._lock")
 
     def update(self, seconds: float) -> None:
         self._meter.mark()
@@ -208,7 +209,7 @@ class Histogram:
         self._values: deque = deque(maxlen=self.RESERVOIR)
         self._count = 0
         self._total = 0.0  # exact lifetime sum (the reservoir is windowed)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("Histogram._lock")
 
     def update(self, value: float) -> None:
         with self._lock:
@@ -247,7 +248,7 @@ class MetricRegistry:
 
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("MetricRegistry._lock")
 
     def _get_or_create(self, name: str, cls, factory):
         with self._lock:

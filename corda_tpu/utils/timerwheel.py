@@ -13,6 +13,7 @@ import itertools
 import threading
 import time
 from typing import Callable, Optional
+from . import lockorder
 
 
 class TimerHandle:
@@ -46,7 +47,7 @@ class SharedTimer:
 
         self._heap: list = []  # (deadline, seq, fn, handle)
         self._seq = itertools.count()
-        self._cv = threading.Condition()
+        self._cv = lockorder.make_condition(name="SharedTimer._cv")
         self._stopped = False
         self._cancelled = 0
         self._pool = ThreadPoolExecutor(
@@ -114,12 +115,19 @@ class SharedTimer:
 def _guarded(fn: Callable[[], None]) -> None:
     try:
         fn()
-    except Exception:
-        pass  # a timeout callback must not kill a pool worker
+    except Exception as exc:
+        # a timeout callback must not kill a pool worker — but a dead
+        # deadline handler (a redispatch that never fired, a flush that
+        # never ran) has to leave evidence somewhere
+        from .eventlog import emit
+
+        emit("error", "timer", "timeout callback raised",
+             callback=getattr(fn, "__qualname__", repr(fn)),
+             error=f"{type(exc).__name__}: {exc}")
 
 
 _default: Optional[SharedTimer] = None
-_default_lock = threading.Lock()
+_default_lock = lockorder.make_lock("timerwheel._default_lock")
 
 
 def call_later(delay: float, fn: Callable[[], None]) -> TimerHandle:

@@ -45,6 +45,7 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
+from . import lockorder
 
 logger = logging.getLogger("corda_tpu.tracing")
 
@@ -83,7 +84,7 @@ class SpanContext:
 # this lesson for message ids): one random per-process prefix + a counter
 # keeps ids unique across processes and cheap within one.
 
-_id_lock = threading.Lock()
+_id_lock = lockorder.make_lock("tracing._id_lock")
 _id_prefix = uuid.uuid4().hex[:16]
 _id_counter = 0
 
@@ -284,7 +285,7 @@ class Tracer:
         self.enabled = enabled
         self.slow_threshold_ms = slow_threshold_ms
         self.max_traces = max_traces
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("Tracer._lock")
         self._traces: "OrderedDict[str, List[Span]]" = OrderedDict()
         self._dropped_spans = 0
         self._slow: List[Tuple[float, int, Dict]] = []  # min-heap

@@ -34,7 +34,7 @@ from typing import Deque, List, Optional, Sequence, Tuple
 
 from ..core.crypto import batch as crypto_batch
 from ..core.crypto.keys import PublicKey
-from ..utils import tracing
+from ..utils import lockorder, tracing
 
 Item = Tuple[PublicKey, bytes, bytes]  # (key, signature, content)
 
@@ -74,27 +74,31 @@ class SignatureBatcher:
         self.max_queued_batches = max_queued_batches
         # one lock: guards the fill buffer AND (as the condition's lock)
         # the flush queue / in-flight count
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = lockorder.make_lock("SignatureBatcher._lock")
+        self._cv = lockorder.make_condition(
+            self._lock, name="SignatureBatcher._cv"
+        )
         self._pending: List[_Entry] = []
         self._flush_queue: Deque[List[_Entry]] = deque()
         self._in_flight = 0  # batches being verified right now
         self._flush_thread: Optional[threading.Thread] = None
         self._timer = None  # TimerHandle from the shared wheel
         self._closed = False
-        # telemetry (seam timers for bench.py stage attribution)
-        self.flushes = 0
-        self.items_verified = 0
-        self.largest_batch = 0
+        # telemetry (seam timers for bench.py stage attribution).
+        # flush() runs batches on CALLER threads concurrently with the
+        # flush thread, so these are multi-writer counters.
+        self.flushes = 0  # guarded-by: _lock
+        self.items_verified = 0  # guarded-by: _lock
+        self.largest_batch = 0  # guarded-by: _lock
         self.handoffs = 0  # buffers drained by the flush thread
-        self.flush_wall_s = 0.0  # cumulative wall time inside verify
+        self.flush_wall_s = 0.0  # guarded-by: _lock
         # backpressure telemetry: cumulative time handed-off buffers
         # waited before the flush thread picked them up (flush-thread
         # lag — the queueing signal the committee-consensus measurements
         # say precedes a throughput collapse), plus an optional registry
         # binding for the gauges/histograms
-        self.flush_lag_s = 0.0
-        self.backpressure_waits = 0  # submits that blocked on the cap
+        self.flush_lag_s = 0.0  # guarded-by: _cv
+        self.backpressure_waits = 0  # guarded-by: _lock
         self._registry = None
 
     def bind_metrics(self, registry) -> None:
@@ -267,10 +271,11 @@ class SignatureBatcher:
             return
         sp.finish()
         wall = time.perf_counter() - t0
-        self.flush_wall_s += wall
-        self.flushes += 1
-        self.items_verified += len(batch)
-        self.largest_batch = max(self.largest_batch, len(batch))
+        with self._lock:
+            self.flush_wall_s += wall
+            self.flushes += 1
+            self.items_verified += len(batch)
+            self.largest_batch = max(self.largest_batch, len(batch))
         if self._registry is not None:
             self._registry.histogram("Verifier.BatchSize").update(len(batch))
         # flight recorder: one event per flush, fanned under every trace

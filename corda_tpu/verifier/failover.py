@@ -25,6 +25,7 @@ import random
 import threading
 import time
 from typing import Callable, Optional
+from ..utils import lockorder
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
@@ -50,7 +51,7 @@ class CircuitBreaker:
         self.failure_threshold = max(1, failure_threshold)
         self.cooldown_s = cooldown_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("CircuitBreaker._lock")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0

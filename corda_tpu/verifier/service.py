@@ -21,7 +21,7 @@ from ..core.crypto.secure_hash import random_63_bit_value
 from ..core.serialization.codec import deserialize, serialize
 from ..core.transactions.ledger import LedgerTransaction
 from ..messaging import Broker
-from ..utils import eventlog, timerwheel, tracing
+from ..utils import eventlog, lockorder, timerwheel, tracing
 from ..utils.metrics import MetricRegistry
 from .api import (
     VERIFICATION_REQUESTS_QUEUE_NAME,
@@ -236,7 +236,9 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         broker.create_queue(VERIFICATION_REQUESTS_QUEUE_NAME)
         broker.create_queue(self._response_queue)
         self._inflight: Dict[int, _Inflight] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock(
+            "OutOfProcessTransactionVerifierService._lock"
+        )
         self.metrics = _Metrics(
             metrics or MetricRegistry(), lambda: len(self._inflight)
         )

@@ -1,0 +1,582 @@
+"""Concurrency correctness suite (corda_tpu/analysis, docs/static-analysis.md).
+
+Tier-1 gates:
+  * the whole package lints CLEAN against the pinned
+    analysis_manifest.json (any new finding fails here first);
+  * a synthetic violation of EACH static pass produces a named finding
+    and fails `tools/lint.py`;
+  * the kernel-jaxpr lint matches its pinned counts (0 dynamic-update-
+    slice / 0 unbounded while in every verify kernel) and a synthetic
+    d-u-s injection trips the gate;
+  * the true positives this suite surfaced and fixed (unguarded batcher
+    counters, anonymous threads, silent handler/timer swallows) are
+    pinned as regressions — the baseline must shrink, not grow.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from corda_tpu.analysis import (
+    astlint,
+    check_findings,
+    envknobs,
+    kernel_lint,
+    load_manifest,
+    manifest as manifest_mod,
+    run_passes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "tools", "lint.py")
+
+
+def _lint_file(tmp_path, name, source, passes=None):
+    """Run the static passes over one synthetic file."""
+    pkg = tmp_path / "corda_tpu"
+    pkg.mkdir(exist_ok=True)
+    f = pkg / name
+    f.write_text(textwrap.dedent(source))
+    return run_passes(paths=[str(f)], root=str(tmp_path), passes=passes)
+
+
+# -- per-pass behaviour -------------------------------------------------------
+
+class TestGuardedBy:
+    def test_unguarded_write_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "g.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded-by: _lock
+
+                def bump(self):
+                    self.count += 1
+        """, passes=["guarded_by"])
+        assert len(fs) == 1
+        assert fs[0].pass_id == "guarded_by"
+        assert "C.count@C.bump" in fs[0].symbol
+
+    def test_locked_write_and_init_exempt(self, tmp_path):
+        fs = _lint_file(tmp_path, "g.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded-by: _lock
+                    self.count = 1  # __init__ re-write: exempt
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+        """, passes=["guarded_by"])
+        assert fs == []
+
+    def test_mutating_container_call_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "g.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []  # guarded-by: _lock
+
+                def push(self, x):
+                    self.items.append(x)
+        """, passes=["guarded_by"])
+        assert len(fs) == 1
+
+    def test_alternative_locks_and_module_globals(self, tmp_path):
+        fs = _lint_file(tmp_path, "g.py", """
+            import threading
+
+            _lock = threading.Lock()
+            _cv = threading.Condition(_lock)
+            _state = {}  # guarded-by: _lock, _cv
+
+            def ok():
+                with _cv:
+                    _state["a"] = 1
+
+            def bad():
+                _state["b"] = 2
+        """, passes=["guarded_by"])
+        assert len(fs) == 1
+        assert "@bad" in fs[0].symbol
+
+    def test_suppression(self, tmp_path):
+        fs = _lint_file(tmp_path, "g.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded-by: _lock
+
+                def bump_caller_holds(self):
+                    # lint: allow(guarded_by) — caller holds _lock
+                    self.count += 1
+        """, passes=["guarded_by"])
+        assert fs == []
+
+
+class TestBlockingUnderLock:
+    SRC = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def naps(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def waits_future(self, fut):
+                with self._lock:
+                    return fut.result()
+
+            def sends(self, broker):
+                with self._lock:
+                    broker.send("q", b"x")
+
+            def commits(self, conn):
+                with self._lock:
+                    conn.commit()
+
+            def foreign_wait(self, event):
+                with self._lock:
+                    event.wait_for(lambda: True)
+    """
+
+    def test_blocking_calls_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "b.py", self.SRC,
+                        passes=["blocking_under_lock"])
+        kinds = sorted(f.symbol.split(":")[1] for f in fs)
+        assert kinds == sorted([
+            "time.sleep", "fut.result", "broker.send", "conn.commit",
+            "event.wait_for",
+        ])
+
+    def test_own_cv_wait_not_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "b.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+
+                def park(self):
+                    with self._cv:
+                        self._cv.wait()
+
+                def park_under_lock(self):
+                    with self._lock:
+                        self._cv.wait()  # same owner: cv wraps _lock
+        """, passes=["blocking_under_lock"])
+        assert fs == []
+
+    def test_nested_def_not_under_lock(self, tmp_path):
+        fs = _lint_file(tmp_path, "b.py", """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def deferred(self):
+                    with self._lock:
+                        def later():
+                            time.sleep(1)  # runs AFTER the with
+                        return later
+        """, passes=["blocking_under_lock"])
+        assert fs == []
+
+    def test_dict_get_not_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "b.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queues = {}
+
+                def look(self, q):
+                    with self._lock:
+                        a = self._queues.get("name")
+                        b = self._queues.get("name", None)
+                        return a, b, q.get(timeout=1)
+        """, passes=["blocking_under_lock"])
+        # only the real Queue.get (kwargs-only signature) is flagged
+        assert len(fs) == 1 and "q.get" in fs[0].symbol
+
+
+class TestThreadDaemonAndSwallow:
+    def test_thread_missing_kwargs(self, tmp_path):
+        fs = _lint_file(tmp_path, "t.py", """
+            import threading
+
+            def spawn():
+                threading.Thread(target=print).start()
+
+            def ok():
+                threading.Thread(target=print, daemon=True,
+                                 name="x").start()
+        """, passes=["thread_daemon"])
+        assert len(fs) == 1
+        assert "daemon and name" in fs[0].message
+
+    def test_swallow_variants(self, tmp_path):
+        fs = _lint_file(tmp_path, "s.py", """
+            def silent():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def bare_silent():
+                try:
+                    work()
+                except:
+                    return None
+
+            def reraises():
+                try:
+                    work()
+                except Exception:
+                    raise
+
+            def logs(log):
+                try:
+                    work()
+                except Exception as exc:
+                    log.warning("boom %s", exc)
+
+            def uses_exc(out):
+                try:
+                    work()
+                except Exception as exc:
+                    out.set_exception(exc)
+
+            def narrow():
+                try:
+                    work()
+                except ValueError:
+                    pass
+        """, passes=["swallow"])
+        assert sorted(f.symbol for f in fs) == [
+            "bare_silent:bare", "silent:Exception",
+        ]
+
+
+class TestEnvRegistry:
+    def test_unregistered_knob_flagged(self, tmp_path):
+        fs = _lint_file(tmp_path, "e.py", """
+            import os
+
+            A = os.environ.get("CORDA_TPU_BOGUS_KNOB", "1")
+            B = os.environ.get("CORDA_TPU_TRACING", "1")  # registered
+        """, passes=["guarded_by", "env_registry"])
+        assert [f.symbol for f in fs] == ["CORDA_TPU_BOGUS_KNOB"]
+
+    def test_registry_is_complete_and_documented(self):
+        """The three-way invariant on the real tree: every read
+        registered, every entry documented + actually read."""
+        findings = [f for f in run_passes(passes=["env_registry"])]
+        assert findings == [], [f.message for f in findings]
+
+    def test_registry_docs_exist(self):
+        for knob in envknobs.KNOBS.values():
+            assert os.path.exists(os.path.join(REPO, knob.doc)), knob
+
+    def test_stale_registry_entry_flagged(self, monkeypatch):
+        """A registered-but-never-read knob must fire (the registry's
+        own registration literals don't count as reads)."""
+        fake = dict(envknobs.KNOBS)
+        fake["CORDA_TPU_NEVER_READ"] = envknobs.Knob(
+            "CORDA_TPU_NEVER_READ", "0", "docs/running-nodes.md", "x"
+        )
+        monkeypatch.setattr(envknobs, "KNOBS", fake)
+        findings = run_passes(passes=["env_registry"])
+        symbols = {f.symbol for f in findings}
+        assert "CORDA_TPU_NEVER_READ:stale" in symbols
+        assert "CORDA_TPU_NEVER_READ:undocumented" in symbols
+
+    def test_doc_check_is_delimited_not_substring(self, tmp_path,
+                                                  monkeypatch):
+        """CORDA_TPU_LOCKCHECK's missing row must not ride on the
+        CORDA_TPU_LOCKCHECK_HOLD_MS row."""
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "running-nodes.md").write_text(
+            "| `CORDA_TPU_LOCKCHECK_HOLD_MS` | 1000 | x |\n"
+        )
+        fake = {
+            n: envknobs.KNOBS[n]
+            for n in ("CORDA_TPU_LOCKCHECK", "CORDA_TPU_LOCKCHECK_HOLD_MS")
+        }
+        monkeypatch.setattr(envknobs, "KNOBS", fake)
+        reads = {n: [("f.py", 1)] for n in fake}
+        findings = astlint._env_registry_finalize(reads, str(tmp_path))
+        symbols = {f.symbol for f in findings}
+        assert "CORDA_TPU_LOCKCHECK:undocumented" in symbols
+        assert "CORDA_TPU_LOCKCHECK_HOLD_MS:undocumented" not in symbols
+
+
+# -- manifest baseline mechanics ---------------------------------------------
+
+class TestManifest:
+    def test_pin_roundtrip_and_new_finding_fails(self, tmp_path):
+        f1 = astlint.Finding("swallow", "corda_tpu/x.py", 3, "f:Exception",
+                             "m")
+        f2 = astlint.Finding("swallow", "corda_tpu/x.py", 9, "g:bare", "m")
+        path = str(tmp_path / "m.json")
+        manifest_mod.pin_manifest(path=path, findings=[f1], kernels={})
+        m = manifest_mod.load_manifest(path)
+        res = manifest_mod.check_findings([f1], m)
+        assert res["new"] == [] and res["stale"] == []
+        res = manifest_mod.check_findings([f1, f2], m)
+        assert [n["key"] for n in res["new"]] == [f2.key]
+        res = manifest_mod.check_findings([], m)
+        assert res["new"] == [] and res["stale"] == [f1.key]
+
+    def test_partial_pin_preserves_kernels(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        manifest_mod.pin_manifest(
+            path=path, findings=[], kernels={"k": {"dynamic_loops": 0}}
+        )
+        manifest_mod.pin_manifest(path=path, findings=[], kernels=None)
+        assert manifest_mod.load_manifest(path)["kernels"] == {
+            "k": {"dynamic_loops": 0}
+        }
+
+    def test_partial_pass_pin_preserves_other_passes(self, tmp_path):
+        """`--pin --pass thread_daemon` must not wipe the swallow
+        baseline (re-pinning one pass never resurrects the others'
+        accepted findings as NEW)."""
+        path = str(tmp_path / "m.json")
+        f_swallow = astlint.Finding("swallow", "corda_tpu/x.py", 1,
+                                    "f:Exception", "m")
+        manifest_mod.pin_manifest(path=path, findings=[f_swallow],
+                                  kernels={})
+        manifest_mod.pin_manifest(path=path, findings=[],
+                                  passes=["thread_daemon"])
+        m = manifest_mod.load_manifest(path)
+        assert m["passes"]["swallow"] == [f_swallow.key]
+        assert m["passes"]["thread_daemon"] == []
+
+    def test_kernel_gate_zero_pin_fails_any_growth(self):
+        m = {"tolerance": 0.05, "kernels": {
+            "k": {"dynamic_update_slice": 0, "dynamic_loops": 0},
+        }}
+        ok = manifest_mod.check_kernels(
+            {"k": {"dynamic_update_slice": 0, "dynamic_loops": 0}}, m
+        )
+        assert ok == []
+        grew = manifest_mod.check_kernels(
+            {"k": {"dynamic_update_slice": 2, "dynamic_loops": 0}}, m
+        )
+        assert [v["kind"] for v in grew] == ["grew"]
+        unpinned = manifest_mod.check_kernels({"other": {}}, m)
+        assert [v["kind"] for v in unpinned] == ["unpinned"]
+        assert manifest_mod.fatal_kernel_violations(grew + unpinned)
+
+
+# -- THE tier-1 gate ----------------------------------------------------------
+
+class TestPackageGate:
+    def test_whole_package_clean_vs_pinned_baseline(self):
+        result = check_findings()
+        assert result["new"] == [], (
+            "NEW lint finding(s) — fix them or suppress with a reasoned "
+            "`# lint: allow(...)`; do not re-pin to absorb them silently: "
+            + json.dumps(result["new"], indent=1)
+        )
+        assert result["stale"] == [], (
+            "baseline entries fixed — run `python tools/lint.py --pin` "
+            "so the baseline shrinks: " + json.dumps(result["stale"])
+        )
+
+    def test_fixed_true_positives_stay_fixed(self):
+        """Regression pins for the findings this PR fixed: the keys must
+        be absent from both the current findings and the baseline."""
+        current = {f.key for f in run_passes()}
+        pinned = {
+            k for keys in load_manifest()["passes"].values() for k in keys
+        }
+        fixed = [
+            # unguarded multi-writer batcher counters (now annotated +
+            # written under _lock in _run_batch)
+            "guarded_by:corda_tpu/verifier/batcher.py:"
+            "SignatureBatcher.flushes@SignatureBatcher._run_batch",
+            "guarded_by:corda_tpu/verifier/batcher.py:"
+            "SignatureBatcher.items_verified@SignatureBatcher._run_batch",
+            # silently-swallowed p2p handler / timer-callback exceptions
+            # (now eventlogged)
+            "swallow:corda_tpu/node/network.py:"
+            "BrokerMessagingService._consume_from:Exception",
+            "swallow:corda_tpu/utils/timerwheel.py:_guarded:Exception",
+            # anonymous threads (now daemon= + name=)
+            "thread_daemon:corda_tpu/loadtest/procdriver.py:"
+            "PairDriver.__init__",
+            "thread_daemon:corda_tpu/loadtest/latency.py:"
+            "measure_uniqueness_batch.burst",
+            "thread_daemon:corda_tpu/loadtest/real.py:run",
+            "thread_daemon:corda_tpu/node/shardhost.py:"
+            "ShardSupervisor.snapshot",
+        ]
+        for key in fixed:
+            assert key not in current, f"regressed: {key}"
+            assert key not in pinned, f"crept back into baseline: {key}"
+
+    def test_no_accepted_debt_in_strict_passes(self):
+        """guarded_by / thread_daemon / env_registry start (and must
+        stay) at ZERO accepted findings — new debt in these passes is
+        never baselined, only fixed."""
+        baseline = load_manifest()["passes"]
+        for strict in ("guarded_by", "thread_daemon", "env_registry"):
+            assert baseline[strict] == [], baseline[strict]
+
+
+# -- tools/lint.py CLI --------------------------------------------------------
+
+VIOLATIONS = {
+    "guarded_by": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # guarded-by: _lock
+
+            def bump(self):
+                self.n += 1
+    """,
+    "blocking_under_lock": """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def nap():
+            with _lock:
+                time.sleep(1)
+    """,
+    "thread_daemon": """
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """,
+    "swallow": """
+        def silent():
+            try:
+                pass
+            except Exception:
+                pass
+    """,
+    "env_registry": """
+        import os
+
+        V = os.environ.get("CORDA_TPU_BOGUS_KNOB")
+    """,
+}
+
+
+class TestLintCLI:
+    @pytest.mark.parametrize("pass_id", sorted(VIOLATIONS))
+    def test_synthetic_violation_fails_cli_with_named_finding(
+        self, tmp_path, pass_id
+    ):
+        root = tmp_path / "minirepo"
+        (root / "corda_tpu").mkdir(parents=True)
+        (root / "tools").mkdir()
+        (root / "docs").mkdir()
+        # real knob table so the env pass's doc check sees its entries
+        shutil.copy(os.path.join(REPO, "docs", "running-nodes.md"),
+                    root / "docs" / "running-nodes.md")
+        bad = root / "corda_tpu" / f"bad_{pass_id}.py"
+        bad.write_text(textwrap.dedent(VIOLATIONS[pass_id]))
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--baseline", "--no-kernel",
+             "--root", str(root), "--pass", pass_id],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1, proc.stderr
+        expected = f"NEW FINDING {pass_id}:corda_tpu/bad_{pass_id}.py:"
+        assert expected in proc.stderr, proc.stderr
+
+    def test_clean_repo_passes_cli_static_only(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--baseline", "--no-kernel",
+             "--json"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout.splitlines()[-1])
+        assert out["ok"] and out["accepted"] > 0
+
+    def test_pin_refuses_foreign_root(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--pin", "--root", str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 2
+
+
+# -- kernel-jaxpr lint --------------------------------------------------------
+
+class TestKernelLint:
+    def test_pinned_kernels_clean(self):
+        """Every verify kernel matches its pin: 0 dynamic-update-slice,
+        0 unbounded while. Shares the opbudget per-process trace cache
+        with tests/test_opbudget.py."""
+        violations = kernel_lint.check_all()
+        assert violations == [], violations
+
+    def test_synthetic_dus_trips_gate(self):
+        from corda_tpu.ops import opbudget
+
+        opbudget._TEST_EXTRA_DUS = 3
+        try:
+            violations = kernel_lint.check_all(
+                names=["ed25519_xla"], use_cache=False
+            )
+        finally:
+            opbudget._TEST_EXTRA_DUS = 0
+            opbudget._clear_cache("ed25519_xla")
+        assert [(v["kind"], v["metric"]) for v in violations] == [
+            ("grew", "dynamic_update_slice")
+        ]
+        assert violations[0]["measured"] >= 3
+
+    def test_walker_counts_dus_and_while(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from corda_tpu.ops.opbudget import _count_fn
+
+        def with_dus(x):
+            return lax.dynamic_update_slice(x, x[0:1], (0,))
+
+        def with_while(x):
+            return lax.while_loop(
+                lambda v: v[0] < 100, lambda v: v + 1, x
+            )
+
+        s = jax.ShapeDtypeStruct((8,), jnp.uint32)
+        dus = _count_fn(with_dus, (s,), {})
+        assert dus["dus_eqns"] == 1 and dus["dynamic_loops"] == 0
+        wl = _count_fn(with_while, (s,), {})
+        assert wl["dynamic_loops"] == 1 and wl["dus_eqns"] == 0

@@ -1,0 +1,392 @@
+"""Runtime lock-order deadlock detector (corda_tpu/utils/lockorder.py).
+
+The tier-1 concurrency deliverable of the analysis suite: a synthetic
+ABBA acquisition must be reported as a cycle carrying BOTH acquisition
+stacks, the hold-time watchdog must fire, Condition waits must not hold
+their edges open — and a representative MockNetwork notarise plus a
+sharded cross-shard commit must run under the armed detector with ZERO
+cycles (docs/static-analysis.md).
+"""
+import threading
+import time
+
+import pytest
+
+from corda_tpu.utils import lockorder
+
+
+@pytest.fixture
+def armed():
+    lockorder.enable(True)
+    lockorder.reset()
+    yield
+    lockorder.enable(None)
+    lockorder.reset()
+
+
+def _run(fn, name):
+    t = threading.Thread(target=fn, name=name, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+class TestCycleDetection:
+    def test_abba_reported_with_both_stacks(self, armed):
+        a = lockorder.make_lock("A")
+        b = lockorder.make_lock("B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        _run(t1, "abba-1")
+        _run(t2, "abba-2")
+        cycles = lockorder.cycles()
+        assert len(cycles) == 1
+        report = cycles[0]
+        assert sorted(report["locks"]) == ["A", "B"]
+        assert report["closing_thread"] == "abba-2"
+        # BOTH acquisition stacks on every edge of the cycle, resolving
+        # to this test's frames
+        assert len(report["edges"]) == 2
+        for edge in report["edges"]:
+            assert edge["held_stack"], edge
+            assert edge["acquire_stack"], edge
+            assert any("test_lockorder" in fr for fr in edge["acquire_stack"])
+        threads = {e["thread"] for e in report["edges"]}
+        assert threads == {"abba-1", "abba-2"}
+
+    def test_cycle_reported_once(self, armed):
+        a = lockorder.make_lock("A")
+        b = lockorder.make_lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        for _ in range(3):
+            _run(ab, "w1")
+            _run(ba, "w2")
+        assert len(lockorder.cycles()) == 1
+
+    def test_three_lock_ring(self, armed):
+        locks = [lockorder.make_lock(n) for n in "XYZ"]
+
+        def grab(i, j):
+            with locks[i]:
+                with locks[j]:
+                    pass
+
+        _run(lambda: grab(0, 1), "r1")
+        _run(lambda: grab(1, 2), "r2")
+        _run(lambda: grab(2, 0), "r3")
+        cycles = lockorder.cycles()
+        assert len(cycles) == 1
+        assert sorted(cycles[0]["locks"]) == ["X", "Y", "Z"]
+
+    def test_consistent_order_no_cycle(self, armed):
+        a = lockorder.make_lock("A")
+        b = lockorder.make_lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        for name in ("c1", "c2"):
+            _run(ab, name)
+        assert lockorder.cycles() == []
+
+    def test_rlock_reentry_no_self_cycle(self, armed):
+        r = lockorder.make_rlock("R")
+        a = lockorder.make_lock("A")
+
+        def t():
+            with r:
+                with r:
+                    with a:
+                        pass
+
+        _run(t, "re")
+        assert lockorder.cycles() == []
+        assert lockorder.held_now() == []
+
+    def test_self_deadlock_reported_before_blocking(self, armed):
+        """A same-thread blocking re-acquire of a plain Lock is the
+        simplest deadlock there is — the detector must leave evidence
+        BEFORE the thread hangs."""
+        lk = lockorder.make_lock("SelfDead")
+        with lk:
+            # timeout keeps the test alive; blocking=True still takes
+            # the reporting path
+            assert not lk.acquire(True, 0.05)
+        reports = lockorder.reports("self_deadlock")
+        assert len(reports) == 1
+        r = reports[0]
+        assert r["lock"] == "SelfDead"
+        assert r["held_stack"] and r["acquire_stack"]
+        # rlocks are reentrant: no such report
+        rl = lockorder.make_rlock("FineReentry")
+        with rl:
+            with rl:
+                pass
+        assert len(lockorder.reports("self_deadlock")) == 1
+
+    def test_cv_wait_restores_reentrant_count(self, armed):
+        """Condition._release_save drops EVERY RLock recursion level;
+        the held-stack must restore the full count on wakeup, or the
+        lock silently stops contributing ordering edges."""
+        cv = lockorder.make_condition(name="ReCv")
+        lockw = cv._lockw
+        other = lockorder.make_lock("ReOther")
+        observed = []
+
+        def waiter():
+            with lockw:
+                with lockw:  # recursion depth 2
+                    with cv:  # depth 3, same lock
+                        cv.wait(timeout=5)
+                        observed.append(list(lockorder.held_now()))
+                    # edges from this lock must still record
+                    with other:
+                        pass
+                observed.append(list(lockorder.held_now()))
+            observed.append(list(lockorder.held_now()))
+
+        t = threading.Thread(target=waiter, name="recv", daemon=True)
+        t.start()
+        time.sleep(0.1)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        # after wakeup the entry is back; releases unwind it exactly
+        assert observed[0] == ["ReCv.lock"]
+        assert observed[1] == ["ReCv.lock"]
+        assert observed[2] == []
+        assert ("ReCv.lock", "ReOther") in \
+            lockorder.graph_snapshot()["edges"]
+
+    def test_failed_nonblocking_acquire_keeps_stack_clean(self, armed):
+        a = lockorder.make_lock("A")
+        assert a.acquire(False)
+        assert not a.acquire(False)  # same-thread retry fails on a Lock
+        a.release()
+        assert lockorder.held_now() == []
+
+
+class TestConditionAndHold:
+    def test_condition_wait_releases_bookkeeping(self, armed):
+        lock = lockorder.make_lock("CvLock")
+        cv = lockorder.make_condition(lock, name="Cv")
+        other = lockorder.make_lock("Other")
+        entered = threading.Event()
+
+        def waiter():
+            with cv:
+                entered.set()
+                cv.wait(timeout=5)
+                # woken: lock re-acquired, bookkeeping restored
+                assert lockorder.held_now() == ["CvLock"]
+
+        t = threading.Thread(target=waiter, name="cv-wait", daemon=True)
+        t.start()
+        assert entered.wait(timeout=5)
+        time.sleep(0.05)
+        # while the waiter is parked it does NOT hold CvLock: taking
+        # CvLock then Other from here must not build a cycle with
+        # anything the waiter holds
+        with cv:
+            with other:
+                cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert lockorder.cycles() == []
+
+    def test_wait_for_predicate(self, armed):
+        cv = lockorder.make_condition(name="WF")
+        done = []
+
+        def waiter():
+            with cv:
+                assert cv.wait_for(lambda: done, timeout=5)
+
+        t = threading.Thread(target=waiter, name="wf", daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            done.append(1)
+            cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_hold_time_watchdog(self, armed, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_LOCKCHECK_HOLD_MS", "10")
+        h = lockorder.make_lock("Slow")
+        with h:
+            time.sleep(0.05)
+        reports = lockorder.reports("hold")
+        assert len(reports) == 1
+        r = reports[0]
+        assert r["lock"] == "Slow"
+        assert r["held_ms"] >= 10
+        assert any("test_lockorder" in fr for fr in r["acquire_stack"])
+        # once per lock: a second slow hold does not duplicate
+        with h:
+            time.sleep(0.05)
+        assert len(lockorder.reports("hold")) == 1
+
+
+class TestPlumbing:
+    def test_disabled_returns_plain_primitives(self):
+        lockorder.enable(False)
+        try:
+            assert isinstance(lockorder.make_lock("x"),
+                              type(threading.Lock()))
+            rl = lockorder.make_rlock("y")
+            assert not isinstance(rl, lockorder._InstrumentedLock)
+            cv = lockorder.make_condition(name="z")
+            assert isinstance(cv, threading.Condition)
+        finally:
+            lockorder.enable(None)
+
+    def test_env_knob_arms(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_LOCKCHECK", "1")
+        lockorder.enable(None)
+        assert lockorder.enabled()
+        lk = lockorder.make_lock("armed-by-env")
+        assert isinstance(lk, lockorder._InstrumentedLock)
+        monkeypatch.setenv("CORDA_TPU_LOCKCHECK", "0")
+        assert not lockorder.enabled()
+
+    def test_meta_and_graph_snapshot(self, armed):
+        a = lockorder.make_lock("MA")
+        b = lockorder.make_lock("MB")
+        with a:
+            with b:
+                pass
+        snap = lockorder.graph_snapshot()
+        assert ("MA", "MB") in snap["edges"]
+        meta = lockorder.meta()
+        assert meta["enabled"] and meta["nodes"] >= 2
+        assert meta["dropped"] == {"nodes": 0, "edges": 0, "reports": 0}
+
+    def test_instrumented_lock_backs_condition_protocol(self, armed):
+        # an RLock wrapper passed raw to threading.Condition still works
+        rl = lockorder.make_rlock("CondBack")
+        cv = threading.Condition(rl)
+        with cv:
+            assert not cv.wait(timeout=0.01)
+
+    def test_node_eviction_cap(self, armed, monkeypatch):
+        monkeypatch.setattr(lockorder, "MAX_NODES", 4)
+        locks = [lockorder.make_lock(f"cap{i}") for i in range(8)]
+        # capped locks stay functional, just unrecorded
+        for lk in locks:
+            with lk:
+                pass
+        assert lockorder.meta()["dropped"]["nodes"] > 0
+
+
+class TestScenario:
+    """The tier-1 acceptance scenario: MockNetwork notarise + sharded
+    cross-shard commit under CORDA_TPU_LOCKCHECK semantics — zero
+    cycles."""
+
+    def test_mocknetwork_notarise_and_sharded_commit_no_cycles(self, armed):
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.finance.flows import CashIssueFlow, CashPaymentFlow
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        try:
+            notary = net.create_notary_node(shards=4)
+            bank = net.create_node("O=LockBank,L=London,C=GB")
+            for i in range(3):
+                h = bank.start_flow(CashIssueFlow(
+                    Amount(100, "USD"), bytes([i + 1]), bank.info,
+                    notary.info,
+                ))
+                net.run_network()
+                h.result.result(timeout=10)
+                token = Issued(bank.info.ref(i + 1), "USD")
+                h2 = bank.start_flow(CashPaymentFlow(
+                    Amount(100, token), bank.info, notary.info
+                ))
+                net.run_network()
+                h2.result.result(timeout=10)
+            # instrumented locks really were exercised: the node stack
+            # built its locks through the factory while armed
+            assert lockorder.meta()["nodes"] > 10
+            assert lockorder.meta()["edges"] > 0
+            assert lockorder.cycles() == [], lockorder.cycles()
+        finally:
+            net.stop_nodes()
+
+    def test_cross_shard_commit_under_detector(self, armed):
+        import hashlib
+
+        from corda_tpu.core.contracts.structures import StateRef
+        from corda_tpu.core.crypto.secure_hash import SecureHash
+        from corda_tpu.node.database import NodeDatabase
+        from corda_tpu.node.notary import PersistentUniquenessProvider
+        from corda_tpu.node.sharded_notary import (
+            ShardedUniquenessProvider,
+            shard_of_key,
+        )
+
+        provider = ShardedUniquenessProvider(
+            [PersistentUniquenessProvider(NodeDatabase(":memory:"))
+             for _ in range(4)],
+        )
+
+        def ref_on(shard, tag):
+            for nonce in range(100_000):
+                h = hashlib.sha256(
+                    f"lc-{tag}-{shard}-{nonce}".encode()
+                ).digest()
+                ref = StateRef(SecureHash(h), 0)
+                if shard_of_key(h + (0).to_bytes(4, "big"), 4) == shard:
+                    return ref
+            raise AssertionError("no nonce")
+
+        class _Party:
+            name = "O=LockCheck,L=London,C=GB"
+
+        # cross-shard: refs on three different shards in one commit,
+        # driven from two threads to exercise the per-shard lock order
+        refs_a = [ref_on(0, "a"), ref_on(1, "a"), ref_on(2, "a")]
+        refs_b = [ref_on(1, "b"), ref_on(2, "b"), ref_on(3, "b")]
+        tx_a = SecureHash(hashlib.sha256(b"lock-a").digest())
+        tx_b = SecureHash(hashlib.sha256(b"lock-b").digest())
+        errs = []
+
+        def commit(refs, txid):
+            try:
+                provider.commit(refs, txid, _Party())
+            except Exception as exc:  # pragma: no cover - surfaced below
+                errs.append(exc)
+
+        t1 = threading.Thread(target=commit, args=(refs_a, tx_a),
+                              name="xshard-1", daemon=True)
+        t2 = threading.Thread(target=commit, args=(refs_b, tx_b),
+                              name="xshard-2", daemon=True)
+        t1.start(), t2.start()
+        t1.join(timeout=30), t2.join(timeout=30)
+        assert not errs, errs
+        assert provider.stats()["cross_commits"] >= 2
+        assert lockorder.cycles() == [], lockorder.cycles()

@@ -62,6 +62,12 @@ def main(argv=None) -> int:
              "opbudget.py): trace the verify kernels and fail when a "
              "multiply count grew >5%% over the pinned manifest",
     )
+    ap.add_argument(
+        "--lint", action="store_true",
+        help="also run the concurrency lint gate (corda_tpu/analysis): "
+             "static passes + kernel-jaxpr lint vs the pinned "
+             "analysis_manifest.json (docs/static-analysis.md)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -129,6 +135,30 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
         if opbudget.fatal_violations(violations):
+            result["ok"] = False
+
+    if args.lint:
+        from corda_tpu.analysis import check_findings
+        from corda_tpu.analysis import kernel_lint, manifest as _lint_manifest
+
+        try:
+            lint_result = check_findings()
+            lint_kviol = kernel_lint.check_all()
+        except (OSError, ValueError) as exc:  # missing OR corrupt manifest
+            print(f"bench_gate: cannot run lint gate: {exc}",
+                  file=sys.stderr)
+            return 2
+        result["lint"] = {**lint_result, "kernel_violations": lint_kviol}
+        for f in lint_result["new"]:
+            print(f"LINT NEW FINDING {f['key']}: {f['path']}:{f['line']} "
+                  f"{f['message']}", file=sys.stderr)
+        for v in lint_kviol:
+            print(f"KERNEL-LINT {v['kind'].upper()} {v['kernel']}"
+                  f".{v.get('metric')}: pinned={v['pinned']} "
+                  f"measured={v['measured']}", file=sys.stderr)
+        if lint_result["new"] or _lint_manifest.fatal_kernel_violations(
+            lint_kviol
+        ):
             result["ok"] = False
 
     for m in result.get("fingerprint_mismatch", ()):

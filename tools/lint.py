@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Concurrency correctness suite CLI (docs/static-analysis.md).
+
+    python tools/lint.py --baseline     # gate vs analysis_manifest.json
+    python tools/lint.py --pin          # re-pin after fixing findings
+    python tools/lint.py --list         # dump all findings
+    python tools/lint.py path/to/x.py   # findings for one file, no gate
+    python tools/lint.py --no-kernel    # static passes only (no jax)
+
+Exit status: 0 = clean, 1 = new finding / kernel-lint violation,
+2 = usage error. Thin wrapper over `python -m corda_tpu.analysis` so
+the suite runs from any cwd without installation; `bench.py --gate`
+wires it in via `tools/bench_gate.py --lint`.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, _REPO)
+
+from corda_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
