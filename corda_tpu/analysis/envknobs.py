@@ -174,6 +174,13 @@ _ENTRIES = [
        "BLS12-381 pairing kernel batch width"),
     _k("CORDA_TPU_PIPE_CHUNK", "65536", "docs/perf-roofline.md",
        "ed25519 dispatch pipeline chunk size"),
+    # -- overlapped verification pipeline (this PR) ---------------------------
+    _k("CORDA_TPU_PIPELINE", "1", "docs/perf-pipeline.md",
+       "0 restores the synchronous verify path (no staged overlap)"),
+    _k("CORDA_TPU_PIPELINE_DEPTH", "4", "docs/perf-pipeline.md",
+       "pipeline ring size: batches in flight across all stages"),
+    _k("CORDA_TPU_PIPELINE_DONATE", "1", "docs/perf-pipeline.md",
+       "0 disables device input-buffer donation on the split dispatch"),
     _k("CORDA_TPU_BATCHER_MAX", "4096", "docs/perf-system.md",
        "verifier signature batcher max batch size"),
     _k("CORDA_TPU_BATCHER_LINGER_MS", "2.0", "docs/perf-system.md",

@@ -324,12 +324,70 @@ def verify_batch(
     `CompositeKey.verify_composite` — every constituent must verify AND
     the tree's weighted thresholds must be met). Nested-composite
     constituents keep the host path.
+
+    Implemented as the back-to-back composition of the four staged
+    phases below (plan → prehash → dispatch → collect) — the same
+    functions the overlapped verification pipeline
+    (corda_tpu.verifier.pipeline, docs/perf-pipeline.md) runs on
+    separate stage threads. Run sequentially on one thread they ARE the
+    synchronous path: CORDA_TPU_PIPELINE=0 changes nothing but which
+    thread calls them.
     """
+    return collect_plan(dispatch_plan(prehash_plan(plan_batch(items))))
+
+
+class BatchPlan:
+    """One verify batch flowing through the staged phases.
+
+    Built by :func:`plan_batch` (decode/parse: composite flattening +
+    scheme bucketing), advanced by :func:`prehash_plan` (the SHA-512
+    host prehash, GIL-releasing native code) and :func:`dispatch_plan`
+    (device kernel launches — asynchronous, nothing blocks on device
+    results — plus the host verify engines), finished by
+    :func:`collect_plan` (materialise device masks, evaluate composite
+    threshold trees). The phases communicate ONLY through this object,
+    which is what lets the pipeline engine hand it thread-to-thread."""
+
+    __slots__ = (
+        "items",          # the submitted (key, sig, content) triples
+        "results",        # per-item verdicts (filled by collect)
+        "flat",           # composite-flattened rows
+        "flat_of_item",   # item idx -> flat row (None for composites)
+        "composites",     # (item idx, CompositeKey, [rows], [leaf keys])
+        "flat_results",   # per-flat-row verdicts
+        "use_device", "rule", "ec_native",
+        "buckets",        # scheme name -> [flat rows] (device-sized)
+        "host_rows",      # flat rows for the OpenSSL loop
+        "ed_host",        # flat rows for the native MSM engine
+        "ec_host",        # curve kind -> [flat rows] for native ECDSA
+        "split_device",   # opt-in: pipeline splits the device route
+        "prepared",       # scheme name -> (kernel kwargs, n) [split route]
+        "ed_prehash",     # (rows, (good, hs)) from host_batch.prehash_rows
+        "pending",        # (kernel, idx, device mask, t0) to materialise
+    )
+
+
+def plan_batch(
+    items: Sequence[Tuple[PublicKey, bytes, bytes]],
+    split_device: bool = False,
+) -> BatchPlan:
+    """Phase 1 — decode/parse: flatten composites and bucket every flat
+    row by scheme and engine. Pure host work, no hashing, no device.
+
+    ``split_device``: opt-in to the SPLIT device route (prepare on the
+    prehash phase, asynchronous donated-buffer launch on dispatch,
+    deferred materialisation on collect). Only the pipeline engine sets
+    it — the sequential composition keeps today's exact call graph
+    (ops.ed25519_verify_batch whole in the dispatch phase), so
+    CORDA_TPU_PIPELINE=0 is byte-identical to the pre-pipeline path."""
+    plan = BatchPlan()
+    plan.items = items
+    plan.split_device = split_device
     n = len(items)
-    results: List[bool] = [False] * n
-    flat: List[Tuple[PublicKey, bytes, bytes]] = []
-    flat_of_item: List[int | None] = []  # item idx -> flat row (1:1 items)
-    composites = []  # (item idx, CompositeKey, [flat rows], [leaf keys])
+    plan.results = [False] * n
+    plan.flat = []
+    plan.flat_of_item = []
+    plan.composites = []
     for i, (key, sig, content) in enumerate(items):
         if USE_DEVICE_KERNELS and _is_composite(key):
             from .composite import CompositeSignaturesWithKeys
@@ -337,115 +395,175 @@ def verify_batch(
             try:
                 csigs = CompositeSignaturesWithKeys.deserialize(sig)
             except Exception:
-                flat_of_item.append(None)  # malformed blob -> False
+                plan.flat_of_item.append(None)  # malformed blob -> False
                 continue
             rows, leaf_keys = [], []
             for leaf_pub, leaf_sig in csigs.sigs:
-                rows.append(len(flat))
+                rows.append(len(plan.flat))
                 leaf_keys.append(leaf_pub)
-                flat.append((leaf_pub, leaf_sig, content))
-            composites.append((i, key, rows, leaf_keys))
-            flat_of_item.append(None)
+                plan.flat.append((leaf_pub, leaf_sig, content))
+            plan.composites.append((i, key, rows, leaf_keys))
+            plan.flat_of_item.append(None)
         else:
-            flat_of_item.append(len(flat))
-            flat.append((key, sig, content))
+            plan.flat_of_item.append(len(plan.flat))
+            plan.flat.append((key, sig, content))
 
-    flat_results = _verify_flat(flat)
-
-    for i in range(n):
-        row = flat_of_item[i]
-        if row is not None:
-            results[i] = flat_results[row]
-    for i, ckey, rows, leaf_keys in composites:
-        ok = all(flat_results[r] for r in rows)
-        results[i] = ok and ckey.is_fulfilled_by(set(leaf_keys))
-    return results
-
-
-def _verify_flat(
-    items: Sequence[Tuple[PublicKey, bytes, bytes]],
-) -> List[bool]:
-    """Scheme-bucketed dispatch over plain (non-composite) rows."""
-    global _mesh_failed_once
-    n = len(items)
-    results: List[bool] = [False] * n
-    use_device = _use_device_kernels()
-    rule = _ed25519_rule()  # pinned for the process on first dispatch
+    flat = plan.flat
+    plan.flat_results = [False] * len(flat)
+    plan.use_device = _use_device_kernels()
+    plan.rule = _ed25519_rule()  # pinned for the process on first dispatch
     # the device kernels are cofactorless: a process pinned to the
     # cofactored rule (it started host-side) must keep ed25519 off them
     # even if the engine choice later flips to device
-    ed_device = use_device and rule == "cofactorless"
+    ed_device = plan.use_device and plan.rule == "cofactorless"
     from . import ecdsa_host as ecdsa_host_mod
 
-    ec_native = ecdsa_host_mod.available()
-    buckets: dict = {}  # kernel key -> [indices]
-    host_rows: List[int] = []
-    ed_host: List[int] = []  # ed25519 rows for the native MSM batch path
-    ec_host: dict = {}  # curve kind -> [indices] for the native engine
-    for i, (key, sig, content) in enumerate(items):
+    plan.ec_native = ecdsa_host_mod.available()
+    plan.buckets = {}
+    plan.host_rows = []
+    plan.ed_host = []  # ed25519 rows for the native MSM batch path
+    plan.ec_host = {}  # curve kind -> [indices] for the native engine
+    for i, (key, sig, content) in enumerate(flat):
         name = key.scheme_code_name
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
         is_ec = name in _ECDSA_CURVES
         if not _is_composite(key) and (
-            (is_ed and ed_device) or (is_ec and use_device)
+            (is_ed and ed_device) or (is_ec and plan.use_device)
         ):
-            buckets.setdefault(name, []).append(i)
+            plan.buckets.setdefault(name, []).append(i)
         elif is_ed and not _is_composite(key):
-            if rule == "cofactored":
-                ed_host.append(i)  # native MSM, ZIP-215
+            if plan.rule == "cofactored":
+                plan.ed_host.append(i)  # native MSM, ZIP-215
             else:
-                host_rows.append(i)  # OpenSSL loop, cofactorless
-        elif is_ec and not _is_composite(key) and ec_native:
+                plan.host_rows.append(i)  # OpenSSL loop, cofactorless
+        elif is_ec and not _is_composite(key) and plan.ec_native:
             # native batch engine (combs + batched inversions); the
             # acceptance rule is plain per-signature ECDSA with strict
             # DER — identical to the OpenSSL loop, so routing here at
             # any size cannot split verdicts
-            ec_host.setdefault(_ECDSA_CURVES[name], []).append(i)
+            plan.ec_host.setdefault(_ECDSA_CURVES[name], []).append(i)
         else:
-            host_rows.append(i)
+            plan.host_rows.append(i)
 
-    for name, idx in buckets.items():
-        if len(idx) < MIN_DEVICE_BATCH:
-            # Undersized ECDSA buckets ride the native engine when
-            # available (one ECDSA rule everywhere, so this is purely a
-            # speed choice)
-            if name in _ECDSA_CURVES and ec_native:
-                ec_host.setdefault(_ECDSA_CURVES[name], []).extend(idx)
-                continue
-            # Undersized ed25519 buckets on an accelerator deployment
-            # go to the per-signature OpenSSL loop (host_rows), NOT the
-            # native MSM:
-            # the device kernels verify cofactorless ([s]B == R + [h]A,
-            # like OpenSSL) while the MSM verifies cofactored (ZIP-215).
-            # The acceptance rule must be a DEPLOYMENT property — one
-            # rule per deployment, never a batch-size accident — or an
-            # adversarial torsion-component signature would verify or
-            # fail depending on how the batcher happened to group it,
-            # splitting notary replicas. CPU deployments (use_device
-            # False) route every ed25519 row to the MSM, so they are
-            # uniformly cofactored; accelerator deployments are
-            # uniformly cofactorless. Mixed CPU/accelerator clusters
-            # must pin CORDA_TPU_DISPATCH cluster-wide (docs/perf-host.md).
-            host_rows.extend(idx)
+    for name in list(plan.buckets):
+        idx = plan.buckets[name]
+        if len(idx) >= MIN_DEVICE_BATCH:
             continue
+        del plan.buckets[name]
+        # Undersized ECDSA buckets ride the native engine when
+        # available (one ECDSA rule everywhere, so this is purely a
+        # speed choice)
+        if name in _ECDSA_CURVES and plan.ec_native:
+            plan.ec_host.setdefault(_ECDSA_CURVES[name], []).extend(idx)
+            continue
+        # Undersized ed25519 buckets on an accelerator deployment
+        # go to the per-signature OpenSSL loop (host_rows), NOT the
+        # native MSM:
+        # the device kernels verify cofactorless ([s]B == R + [h]A,
+        # like OpenSSL) while the MSM verifies cofactored (ZIP-215).
+        # The acceptance rule must be a DEPLOYMENT property — one
+        # rule per deployment, never a batch-size accident — or an
+        # adversarial torsion-component signature would verify or
+        # fail depending on how the batcher happened to group it,
+        # splitting notary replicas. CPU deployments (use_device
+        # False) route every ed25519 row to the MSM, so they are
+        # uniformly cofactored; accelerator deployments are
+        # uniformly cofactorless. Mixed CPU/accelerator clusters
+        # must pin CORDA_TPU_DISPATCH cluster-wide (docs/perf-host.md).
+        plan.host_rows.extend(idx)
+
+    plan.prepared = {}
+    plan.ed_prehash = None
+    plan.pending = []
+    return plan
+
+
+def _mesh_would_serve(idx) -> bool:
+    """Mirror of the dispatch-phase mesh routing condition, consulted at
+    prehash time so the split host prep isn't wasted on a bucket the
+    mesh will shard itself (shard_verify runs its own prepare)."""
+    return (
+        _MESH is not None
+        and not _mesh_failed_once
+        and len(idx) >= MESH_MIN_BATCH
+    )
+
+
+def _ed25519_split_route() -> bool:
+    """Whether the ed25519 device bucket takes the SPLIT prehash/launch
+    route (portable XLA kernel): prepare_batch on the prehash stage,
+    an asynchronous donated-buffer kernel launch on the dispatch stage,
+    materialisation on the collect stage. On the TPU backend the Pallas
+    wrapper (ops.ed25519_batch._verify_batch_pallas) stays WHOLE in the
+    dispatch phase: it owns its own chunked host/device overlap, the
+    known-answer self-check, and the fast-mul/radix degradation ladder —
+    splitting it here would bypass all three."""
+    try:
+        import jax
+
+        return jax.default_backend() != "tpu"
+    # lint: allow(swallow) — jax absent means no device route; bucket stays whole
+    except Exception:
+        return False
+
+
+def prehash_plan(plan: BatchPlan) -> BatchPlan:
+    """Phase 2 — SHA-512 host prehash. Every hash here is a native
+    batched pass (corda_tpu.native) that releases the GIL, which is what
+    lets the pipeline hash batch N+1 while batch N occupies the device
+    (or the MSM engine). Covers the split ed25519 device route
+    (prepare_batch: parse + SHA-512(R||A||M) mod L) and the native MSM
+    engine's prehash (host_batch.prehash_rows)."""
+    flat = plan.flat
+    ed_name = EDDSA_ED25519_SHA512.scheme_code_name
+    idx = plan.buckets.get(ed_name)
+    if (
+        idx is not None and plan.split_device
+        and not _mesh_would_serve(idx) and _ed25519_split_route()
+    ):
         from ... import ops
 
-        pubs = [items[i][0].encoded for i in idx]
-        sigs = [items[i][1] for i in idx]
-        msgs = [items[i][2] for i in idx]
-        # mesh routing applies to every scheme with a device kernel —
-        # uniform scale-out, like the reference's competing consumers
-        # (VerifierTests.kt:54-71); below the threshold the single-device
-        # kernels keep dispatch overhead down
+        kwargs, n_real = ops.ed25519_prepare_batch(
+            [flat[i][0].encoded for i in idx],
+            [flat[i][1] for i in idx],
+            [flat[i][2] for i in idx],
+        )
+        plan.prepared[ed_name] = (kwargs, n_real)
+    if plan.ed_host and plan.split_device:
+        from . import host_batch
+
+        if host_batch.available():
+            rows = [
+                (flat[i][0].encoded, flat[i][1], flat[i][2])
+                for i in plan.ed_host
+            ]
+            plan.ed_prehash = (rows, host_batch.prehash_rows(rows))
+    return plan
+
+
+def dispatch_plan(plan: BatchPlan) -> BatchPlan:
+    """Phase 3 — launch device work, run the host engines.
+
+    Device buckets with prepared inputs are LAUNCHED asynchronously
+    (JAX dispatch returns before the computation finishes; the donated
+    s_ok buffer lets XLA alias the result) and recorded in
+    `plan.pending` for the collect phase — nothing here blocks on a
+    device result. Unprepared buckets (TPU Pallas ladder, mesh shards,
+    ECDSA) and the host engines (native MSM, native ECDSA, the OpenSSL
+    pool) run inside this phase; the native engines release the GIL, so
+    they still overlap the next batch's prehash under the pipeline."""
+    global _mesh_failed_once
+    flat = plan.flat
+    results = plan.flat_results
+    for name, idx in plan.buckets.items():
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
         mask = None
-        if (
-            _MESH is not None
-            and not _mesh_failed_once
-            and len(idx) >= MESH_MIN_BATCH
-        ):
+        if _mesh_would_serve(idx):
             from ...parallel.mesh import shard_verify
 
+            pubs = [flat[i][0].encoded for i in idx]
+            sigs = [flat[i][1] for i in idx]
+            msgs = [flat[i][2] for i in idx]
             scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
             try:
                 mask = shard_verify(_MESH, scheme_kind, pubs, sigs, msgs)
@@ -463,63 +581,141 @@ def _verify_flat(
                     "mesh-sharded %s verification failed; the mesh path "
                     "is disabled until reconfigured", scheme_kind
                 )
-        if mask is None:
-            from ...utils import profiling
+        if mask is not None:
+            for j, i in enumerate(idx):
+                results[i] = bool(mask[j])
+            continue
+        kernel = (
+            "ed25519.verify_batch" if is_ed
+            else f"ecdsa.{_ECDSA_CURVES[name]}.verify_batch"
+        )
+        prepared = plan.prepared.get(name)
+        if prepared is not None:
+            # split route: asynchronous launch, deferred materialisation
+            from ...ops import ed25519_batch as _ed
 
-            kernel = (
-                "ed25519.verify_batch" if is_ed
-                else f"ecdsa.{_ECDSA_CURVES[name]}.verify_batch"
-            )
+            kwargs, _n = prepared
             t0 = _time.perf_counter()
-            mask = (
-                ops.ed25519_verify_batch(pubs, sigs, msgs)
-                if is_ed
-                else ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
+            launch = (
+                _ed.verify_kernel_donated if _pipeline_donate()
+                else _ed.verify_kernel
             )
-            # backpressure telemetry seam: one record per DISPATCH (not
-            # per signature) feeds the ops endpoint's Jax.* gauges
-            profiling.record_dispatch(kernel, _time.perf_counter() - t0)
+            mask = launch(**kwargs)
+            # carry the LAUNCH wall only: collect adds its blocking
+            # materialisation wall. Recording launch→materialise wall
+            # clock instead would count time the batch merely queued
+            # between pipeline stages as device time and make the Jax.*
+            # gauges report phantom slowdown under the pipeline.
+            plan.pending.append(
+                (kernel, idx, mask, _time.perf_counter() - t0)
+            )
+            continue
+        from ... import ops
+        from ...utils import profiling
+
+        pubs = [flat[i][0].encoded for i in idx]
+        sigs = [flat[i][1] for i in idx]
+        msgs = [flat[i][2] for i in idx]
+        t0 = _time.perf_counter()
+        mask = (
+            ops.ed25519_verify_batch(pubs, sigs, msgs)
+            if is_ed
+            else ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
+        )
+        # backpressure telemetry seam: one record per DISPATCH (not
+        # per signature) feeds the ops endpoint's Jax.* gauges
+        profiling.record_dispatch(kernel, _time.perf_counter() - t0)
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
 
-    for kind, idx in ec_host.items():
+    from . import ecdsa_host as ecdsa_host_mod
+
+    for kind, idx in plan.ec_host.items():
         out = ecdsa_host_mod.verify_batch_host(
             kind,
-            [items[i][0].encoded for i in idx],
-            [items[i][1] for i in idx],
-            [items[i][2] for i in idx],
+            [flat[i][0].encoded for i in idx],
+            [flat[i][1] for i in idx],
+            [flat[i][2] for i in idx],
         )
         for j, i in enumerate(idx):
             results[i] = out[j]
 
-    if ed_host:
+    if plan.ed_host:
         from . import host_batch
 
-        if host_batch.available():
+        if plan.ed_prehash is not None:
             # ONE Pippenger multi-scalar multiplication for the whole
-            # bucket (~7x the per-signature OpenSSL loop at >= 1k).
-            # ed_host is populated ONLY on CPU deployments (use_device
-            # False routes every non-composite ed25519 row here), so the
-            # cofactored ZIP-215 rule applies to EVERY bucket size on
-            # such a deployment — the verification rule is a deployment
+            # bucket (~7x the per-signature OpenSSL loop at >= 1k),
+            # consuming the prehash phase's hashes. ed_host is populated
+            # ONLY on CPU deployments (use_device False routes every
+            # non-composite ed25519 row here), so the cofactored
+            # ZIP-215 rule applies to EVERY bucket size on such a
+            # deployment — the verification rule is a deployment
             # property, never a batch-size accident (a rule that flips
             # at a size threshold would let an adversarial torsion
             # signature split replicas whose batchers grouped it
-            # differently; n=1 costs 217us vs OpenSSL's 139us, so
-            # uniformity is nearly free). Accelerator deployments use
-            # the cofactorless rule at every size instead (device
-            # kernels + OpenSSL loop for undersized buckets).
+            # differently). Accelerator deployments use the
+            # cofactorless rule at every size instead (device kernels +
+            # OpenSSL loop for undersized buckets).
+            rows, prehashed = plan.ed_prehash
+            verdicts = host_batch.verify_batch_host(rows, prehashed=prehashed)
+            for j, ok in enumerate(verdicts):
+                results[plan.ed_host[j]] = ok
+        elif host_batch.available():
+            # synchronous composition (split_device off): both MSM
+            # phases run here, exactly the pre-pipeline call graph
             rows = [
-                (items[i][0].encoded, items[i][1], items[i][2])
-                for i in ed_host
+                (flat[i][0].encoded, flat[i][1], flat[i][2])
+                for i in plan.ed_host
             ]
             for j, ok in enumerate(host_batch.verify_batch_host(rows)):
-                results[ed_host[j]] = ok
+                results[plan.ed_host[j]] = ok
         else:
-            host_rows.extend(ed_host)
+            plan.host_rows.extend(plan.ed_host)
 
-    _host_verify_rows(items, host_rows, results)
-    return results
+    _host_verify_rows(flat, plan.host_rows, results)
+    return plan
+
+
+def collect_plan(plan: BatchPlan) -> List[bool]:
+    """Phase 4 — materialise deferred device results (the only blocking
+    read of the device), then fold flat verdicts back to items and
+    evaluate composite threshold trees."""
+    import numpy as _np
+
+    from ...utils import profiling
+
+    results = plan.flat_results
+    for kernel, idx, mask, launch_wall in plan.pending:
+        t0 = _time.perf_counter()
+        arr = _np.asarray(mask)  # the deferred block_until_ready
+        # launch wall + the blocking wait for THIS batch's result: the
+        # asarray only blocks while the device is still computing, so
+        # inter-stage queue time never inflates the dispatch gauges (a
+        # batch whose device work finished while queued records ~launch
+        # cost alone — a lower bound, never a phantom slowdown)
+        profiling.record_dispatch(
+            kernel, launch_wall + (_time.perf_counter() - t0)
+        )
+        for j, i in enumerate(idx):
+            results[i] = bool(arr[j])
+    plan.pending = []
+
+    for i in range(len(plan.items)):
+        row = plan.flat_of_item[i]
+        if row is not None:
+            plan.results[i] = results[row]
+    for i, ckey, rows, leaf_keys in plan.composites:
+        ok = all(results[r] for r in rows)
+        plan.results[i] = ok and ckey.is_fulfilled_by(set(leaf_keys))
+    return plan.results
+
+
+def _pipeline_donate() -> bool:
+    """CORDA_TPU_PIPELINE_DONATE=0 opts the split dispatch route out of
+    buffer donation (debugging aid: donation invalidates the input
+    arrays after launch)."""
+    return os.environ.get("CORDA_TPU_PIPELINE_DONATE", "1") != "0"
 
 
 def _is_composite(key: PublicKey) -> bool:

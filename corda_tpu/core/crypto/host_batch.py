@@ -60,9 +60,14 @@ def available() -> bool:
     return native.available()
 
 
-def verify_batch_host(rows: Sequence[Row]) -> List[bool]:
-    """Positionally-aligned verdicts for (pub, sig, msg) rows."""
-    results = [False] * len(rows)
+def prehash_rows(rows: Sequence[Row]):
+    """The splittable PREHASH phase: canonicality filter plus ONE batched
+    native SHA-512+reduce pass over the well-formed rows.
+
+    Returns ``(good, hs)`` ready to hand to :func:`verify_batch_host` as
+    ``prehashed=``.  The verification pipeline (verifier/pipeline.py)
+    runs this on its prehash stage thread — the native hashing releases
+    the GIL, so batch N+1 hashes while the MSM verifies batch N."""
     good: List[int] = []
     for i, (pub, sig, msg) in enumerate(rows):
         if (
@@ -78,6 +83,19 @@ def verify_batch_host(rows: Sequence[Row]) -> List[bool]:
     # h_i is deterministic per row: hash ONCE up front (one batched
     # native SHA-512+reduce pass), not once per recursion level
     hs = _hashes_mod_l(rows, good)
+    return good, hs
+
+
+def verify_batch_host(rows: Sequence[Row], prehashed=None) -> List[bool]:
+    """Positionally-aligned verdicts for (pub, sig, msg) rows.
+
+    ``prehashed``: an optional ``(good, hs)`` pair from
+    :func:`prehash_rows` over the SAME rows — the staged pipeline hashes
+    on its own stage thread and hands the result here; omitted, both
+    phases run back-to-back (the synchronous path, byte-identical to the
+    pre-pipeline behaviour)."""
+    results = [False] * len(rows)
+    good, hs = prehashed if prehashed is not None else prehash_rows(rows)
     _verify_range(rows, good, hs, results)
     return results
 

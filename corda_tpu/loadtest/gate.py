@@ -34,7 +34,11 @@ DEFAULT_THRESHOLD = 0.20
 MIN_COMPARABLE = 1e-6
 
 _HIGHER = re.compile(
-    r"(_sigs_s|_commits_s|_pairs_s|_items_s|_per_sec|_rate|throughput)$"
+    r"(_sigs_s|_commits_s|_pairs_s|_items_s|_per_sec|_rate|throughput"
+    # the pipeline A/B's overlap keys (docs/perf-pipeline.md): more
+    # prehash hidden behind dispatch is better, so a shrinking ratio is
+    # the regression direction
+    r"|_overlap_ratio|_hidden_pct)$"
 )
 _LOWER = re.compile(r"(_ms|_us|_s)$")
 _LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")

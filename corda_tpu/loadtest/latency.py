@@ -497,6 +497,185 @@ def measure_failover_recovery(
     return out
 
 
+def measure_pipeline_overlap(
+    n_batches: int = 4, batch: int = 1024, msg_len: int = 8192,
+    depth: int = None, verbose: bool = False,
+):
+    """Sync-vs-pipelined A/B of the overlapped verification pipeline
+    (docs/perf-pipeline.md) on the SAME workload and the SAME staged
+    phase functions: the synchronous leg runs decode → prehash →
+    dispatch → collect back-to-back per batch on one thread; the
+    pipelined leg feeds the identical batches through
+    verifier.pipeline's staged engine, where the prehash of batch N+1
+    runs while batch N occupies the dispatch engine. The delta
+    therefore isolates STAGE OVERLAP — the 2112.02229
+    fully-pipelined-engine property — not code differences.
+
+    The workload carries `msg_len`-byte messages (settlement payloads
+    with attachments, not 64-byte toy digests) so the SHA-512 prehash is
+    a comparable fraction of the verify work on the CPU backend; both
+    the prehash (native batched SHA-512) and the CPU dispatch engine
+    (native MSM / OpenSSL) release the GIL, so the overlap is real
+    thread parallelism on a multi-core host. Reported keys ride
+    bench.py's gated stage_timings: `pipeline_overlap_ratio` (1 −
+    pipelined/sync; higher is better) and the `pipeline_*_wall_ms`
+    family (lower is better)."""
+    import os
+
+    from ..core.crypto import batch as crypto_batch
+    from ..core.crypto import crypto
+    from ..core.crypto.schemes import EDDSA_ED25519_SHA512
+    from ..verifier.pipeline import VerificationPipeline, default_depth
+
+    rng_keys = [
+        crypto.generate_keypair(EDDSA_ED25519_SHA512) for _ in range(32)
+    ]
+    batches = []
+    for k in range(n_batches):
+        items = []
+        for i in range(batch):
+            kp = rng_keys[(k * batch + i) % len(rng_keys)]
+            msg = (b"pipeline-ab-%08d|" % (k * batch + i)).ljust(
+                msg_len, b"\xa5"
+            )
+            items.append((kp.public, crypto.do_sign(kp.private, msg), msg))
+        batches.append(items)
+
+    # warm every engine OUTSIDE the measured windows and pin the
+    # process acceptance rule before either leg runs. The second pass
+    # runs the EXACT measured route at the measured shape — staged
+    # phases with split_device, so when the device route engages this
+    # warms verify_kernel_donated's own jit cache at bucket(batch);
+    # warming only verify_batch would leave the sync leg (run first)
+    # paying that one-time XLA compile and inflate the gated
+    # pipeline_overlap_ratio with compile caching instead of overlap.
+    crypto_batch.verify_batch(batches[0][:32])
+    warm = crypto_batch.collect_plan(crypto_batch.dispatch_plan(
+        crypto_batch.prehash_plan(
+            crypto_batch.plan_batch(batches[0], split_device=True)
+        )
+    ))
+    assert all(warm), "warm-up batch failed verification"
+    from ..core.crypto import host_batch
+
+    route = (
+        "native-msm"
+        if crypto_batch._ed25519_rule() == "cofactored"
+        and host_batch.available()
+        else ("device-kernel" if crypto_batch._use_device_kernels()
+              else "host-openssl")
+    )
+
+    # -- synchronous leg: same staged functions, one thread ---------------
+    phase_walls = {"decode": 0.0, "prehash": 0.0, "dispatch": 0.0,
+                   "collect": 0.0}
+    sync_results = []
+    t_sync = time.perf_counter()
+    for items in batches:
+        t0 = time.perf_counter()
+        plan = crypto_batch.plan_batch(items, split_device=True)
+        t1 = time.perf_counter()
+        phase_walls["decode"] += t1 - t0
+        crypto_batch.prehash_plan(plan)
+        t2 = time.perf_counter()
+        phase_walls["prehash"] += t2 - t1
+        crypto_batch.dispatch_plan(plan)
+        t3 = time.perf_counter()
+        phase_walls["dispatch"] += t3 - t2
+        sync_results.append(crypto_batch.collect_plan(plan))
+        phase_walls["collect"] += time.perf_counter() - t3
+    sync_wall = time.perf_counter() - t_sync
+
+    # -- pipelined leg: same batches through the staged engine ------------
+    pipe = VerificationPipeline(
+        depth=depth if depth is not None else default_depth(),
+        name="overlap-ab",
+    )
+    try:
+        t_pipe = time.perf_counter()
+        futures = [pipe.submit(items) for items in batches]
+        pipe_results = [f.result(timeout=600) for f in futures]
+        pipe_wall = time.perf_counter() - t_pipe
+        engine_ratio = pipe.overlap_ratio
+        # per-stage busy walls from the engine's own accounting: the
+        # attribution view next to the A/B delta (a wall delta produced
+        # by decode/collect overlap instead of prehash overlap shows up
+        # as engine prehash wall << sync prehash wall here)
+        engine_stage_walls = {
+            stage: round(pipe.stage_wall_s(stage) * 1000, 3)
+            for stage, _fn in pipe.stages
+        }
+    finally:
+        pipe.stop()
+
+    assert pipe_results == sync_results, (
+        "pipelined verdicts diverged from the synchronous leg"
+    )
+    assert all(all(r) for r in sync_results), (
+        "A/B workload failed verification"
+    )
+
+    prehash_wall = phase_walls["prehash"]
+    hidden = max(0.0, sync_wall - pipe_wall)
+    # noise floor: on a low-core host the A/B delta is scheduler jitter
+    # (the 1-core container measures ±3%); a jittering 0.027-vs-0.012
+    # "ratio" would flap the >20% regression gate despite both readings
+    # meaning "no overlap". Below the floor both gated ratios report
+    # 0.0 — compare_records skips ratios with a 0 base, so noise never
+    # arms the gate, while a real prior overlap (>= the 0.15 acceptance
+    # bound) collapsing to 0.0 still fails it.
+    overlap_ratio = hidden / sync_wall if sync_wall > 0 else 0.0
+    if overlap_ratio < 0.05:
+        overlap_ratio = 0.0
+    hidden_pct = (
+        min(100.0, 100.0 * hidden / prehash_wall) if prehash_wall > 0
+        else 0.0
+    )
+    if hidden_pct < 5.0 or overlap_ratio == 0.0:
+        hidden_pct = 0.0
+    out = {
+        "pipeline_batches": n_batches,
+        "pipeline_batch_rows": batch,
+        "pipeline_msg_len": msg_len,
+        "pipeline_depth": pipe.depth,
+        "pipeline_route": route,
+        "pipeline_cpus": os.cpu_count() or 1,
+        "pipeline_sync_wall_ms": round(sync_wall * 1000, 3),
+        "pipeline_pipelined_wall_ms": round(pipe_wall * 1000, 3),
+        "pipeline_decode_wall_ms": round(phase_walls["decode"] * 1000, 3),
+        "pipeline_prehash_wall_ms": round(prehash_wall * 1000, 3),
+        "pipeline_dispatch_wall_ms": round(phase_walls["dispatch"] * 1000, 3),
+        "pipeline_collect_wall_ms": round(phase_walls["collect"] * 1000, 3),
+        # A/B overlap: the fraction of the synchronous sum the pipeline
+        # eliminated (acceptance: pipelined < 0.85x sync = ratio > 0.15;
+        # noise-floored above)
+        "pipeline_overlap_ratio": round(overlap_ratio, 4),
+        # how much of the prehash was hidden behind the other stages
+        # (acceptance: >= 50). This is the ISSUE's wall-delta
+        # attribution — the A/B delta capped by the prehash wall — an
+        # upper bound on prehash-specific hiding; cross-check it
+        # against the engine's per-stage walls below (all four stages
+        # ran concurrently only if their busy sum exceeds the
+        # pipelined wall)
+        "pipeline_prehash_hidden_pct": round(hidden_pct, 1),
+        # the engine's own live interleave accounting (the
+        # Pipeline.OverlapRatio gauge). Deliberately NOT named with a
+        # gated suffix: it measures thread interleaving, which is
+        # scheduler-dependent even when wall clock is unchanged
+        "pipeline_engine_interleave": round(engine_ratio, 4),
+        # per-stage busy walls inside the pipelined leg (attribution)
+        "pipeline_engine_decode_wall_ms": engine_stage_walls.get("decode"),
+        "pipeline_engine_prehash_wall_ms": engine_stage_walls.get("prehash"),
+        "pipeline_engine_dispatch_wall_ms": engine_stage_walls.get(
+            "dispatch"
+        ),
+        "pipeline_engine_collect_wall_ms": engine_stage_walls.get("collect"),
+    }
+    if verbose:
+        print(out)
+    return out
+
+
 def measure_bls_aggregate_ab(n: int = 64,
                              message: bytes = b"committee block statement"):
     """Committee aggregate-vs-naive verification A/B

@@ -20,28 +20,68 @@ from ..core.contracts import Amount
 from ..core.contracts.amount import Issued
 
 
+def _ms(seconds: float) -> float:
+    """One rounding rule for every millisecond field this module
+    reports (mean/p95/p50): two decimals, never a mixed precision."""
+    return round(seconds * 1e3, 2)
+
+
+def _timer_total_s(snap: dict) -> float:
+    """Best available estimate of a timer's lifetime wall seconds.
+
+    node_metrics snapshots can come over RPC from nodes of any build:
+    older Timers lack `total`, an empty reservoir omits `mean`/`p50`/
+    `p95` entirely. The fallback ladder (total → count×mean → count×p50
+    → count×p95) keeps the ranking honest for every shape instead of
+    collapsing a busy-but-key-poor timer to 0 and misranking it below
+    trivial ones."""
+    count = snap.get("count", 0)
+    total = snap.get("total")
+    if isinstance(total, (int, float)):
+        return float(total)
+    for est in ("mean", "p50", "p95"):
+        v = snap.get(est)
+        if isinstance(v, (int, float)):
+            return count * float(v)
+    return 0.0
+
+
 def _hot_timers(metrics: dict, top: int = 12) -> dict:
     """The busiest P2P.Handle.* / RPC.* timers from a node_metrics
-    snapshot: where the node's wall-clock actually goes (total =
-    count x mean), for the kernel->system chasm hunt."""
+    snapshot: where the node's wall-clock actually goes, for the
+    kernel->system chasm hunt. Ranked by the exact lifetime sum
+    (Timer.total) when present — windowed count x mean would misrank
+    timers whose per-event cost drifted — with the _timer_total_s
+    fallback ladder for snapshots missing keys."""
     rows = []
     for name, snap in metrics.items():
+        if not isinstance(snap, dict):
+            continue
         if snap.get("type") != "timer" or "count" not in snap:
             continue
-        # exact lifetime sum (Timer.total); windowed count x mean would
-        # misrank timers whose per-event cost drifted
-        total = snap.get("total", snap["count"] * snap.get("mean", 0.0))
-        rows.append((total, name, snap))
-    rows.sort(reverse=True)
-    return {
-        name: {
-            "count": snap["count"],
-            "mean_ms": round(snap.get("mean", 0.0) * 1e3, 2),
-            "p95_ms": round(snap.get("p95", 0.0) * 1e3, 2),
+        rows.append((_timer_total_s(snap), name, snap))
+    # (total, name) is a unique sort key: snap dicts are never compared
+    rows.sort(key=lambda r: (r[0], r[1]), reverse=True)
+    out = {}
+    for total, name, snap in rows[:top]:
+        count = snap.get("count", 0)
+        mean = snap.get("mean")
+        if not isinstance(mean, (int, float)):
+            # derive the display mean from the ranked total so the row
+            # is self-consistent even on a mean-less snapshot
+            mean = (total / count) if count else 0.0
+        p95 = snap.get("p95")
+        if not isinstance(p95, (int, float)):
+            p95 = snap.get("max")
+            if not isinstance(p95, (int, float)):
+                p95 = mean
+        out[name] = {
+            "count": count,
+            "mean_ms": _ms(mean),
+            "p95_ms": _ms(p95),
             "total_s": round(total, 2),
         }
-        for total, name, snap in rows[:top]
-    }
+    return out
 
 
 def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,

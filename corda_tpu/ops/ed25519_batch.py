@@ -136,8 +136,7 @@ def _bit_at(words: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
     return (w >> (i & 31)) & 1
 
 
-@jax.jit
-def verify_kernel(
+def _verify_kernel_impl(
     y_a: jnp.ndarray,
     sign_a: jnp.ndarray,
     y_r: jnp.ndarray,
@@ -183,6 +182,18 @@ def verify_kernel(
     eq_x = F.eq(q[0], F.mul(r_pt[0], q[2]))
     eq_y = F.eq(q[1], F.mul(r_pt[1], q[2]))
     return s_ok & ok_a & ok_r & eq_x & eq_y
+
+
+verify_kernel = jax.jit(_verify_kernel_impl)
+
+#: Donated variant for the staged verification pipeline's dispatch stage
+#: (verifier/pipeline.py): s_ok (bool[B]) matches the returned mask's
+#: shape/dtype so XLA can alias its buffer into the output. Safe because
+#: prepare_batch builds fresh arrays per batch and the staged dispatch
+#: never rereads its kernel inputs after launch. Separate jit cache from
+#: verify_kernel — the pipelined and synchronous paths each compile
+#: their own executable once per shape.
+verify_kernel_donated = jax.jit(_verify_kernel_impl, donate_argnums=(6,))
 
 
 # --- host-side batch preparation --------------------------------------------

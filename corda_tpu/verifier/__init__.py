@@ -17,6 +17,7 @@ from .api import (
 )
 from .batcher import SignatureBatcher
 from .failover import CircuitBreaker, backoff_delay
+from .pipeline import PipelineStoppedError, VerificationPipeline
 from .service import (
     InMemoryTransactionVerifierService,
     OutOfProcessTransactionVerifierService,
@@ -32,6 +33,7 @@ __all__ = [
     "SignatureBatchRequest", "SignatureBatchResponse",
     "VerificationRequest", "VerificationResponse",
     "SignatureBatcher",
+    "PipelineStoppedError", "VerificationPipeline",
     "CircuitBreaker", "backoff_delay",
     "InMemoryTransactionVerifierService",
     "OutOfProcessTransactionVerifierService",

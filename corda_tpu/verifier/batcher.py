@@ -35,6 +35,7 @@ from typing import Deque, List, Optional, Sequence, Tuple
 from ..core.crypto import batch as crypto_batch
 from ..core.crypto.keys import PublicKey
 from ..utils import lockorder, tracing
+from . import pipeline as pipeline_mod
 
 Item = Tuple[PublicKey, bytes, bytes]  # (key, signature, content)
 
@@ -54,7 +55,14 @@ class SignatureBatcher:
 
     def __init__(self, max_batch: Optional[int] = None,
                  linger_ms: Optional[float] = None,
-                 max_queued_batches: Optional[int] = None):
+                 max_queued_batches: Optional[int] = None,
+                 pipeline: Optional[bool] = None):
+        """``pipeline``: route flushed batches through the overlapped
+        verification pipeline (verifier/pipeline.py) instead of a
+        synchronous ``verify_batch`` call — the host prehashes batch N+1
+        while the device/native engine verifies batch N. ``None``
+        follows the CORDA_TPU_PIPELINE env gate (on by default;
+        ``0`` keeps today's synchronous path byte-identical)."""
         if max_batch is None:
             max_batch = int(os.environ.get("CORDA_TPU_BATCHER_MAX", 4096))
         if linger_ms is None:
@@ -100,12 +108,26 @@ class SignatureBatcher:
         self.flush_lag_s = 0.0  # guarded-by: _cv
         self.backpressure_waits = 0  # guarded-by: _lock
         self._registry = None
+        # overlapped-pipeline routing (docs/perf-pipeline.md): decided
+        # once at construction so the env gate cannot flip a live
+        # batcher's semantics mid-stream; the engine itself is built
+        # lazily on the first flush (no threads for batchers that never
+        # verify anything)
+        self._use_pipeline = (
+            pipeline_mod.pipeline_enabled() if pipeline is None
+            else bool(pipeline)
+        )
+        self._pipeline: Optional[pipeline_mod.VerificationPipeline] = None
 
     def bind_metrics(self, registry) -> None:
         """Register this batcher's occupancy/lag instruments on a node's
         MetricRegistry (gauge re-registration replaces stale closures, so
         a recreated batcher can bind to the same names)."""
         self._registry = registry
+        with self._lock:
+            pipe = self._pipeline
+        if pipe is not None:
+            pipe.bind_metrics(registry)
         registry.gauge("Verifier.BatcherOccupancy",
                        lambda: self.pending_count)
         registry.gauge("Verifier.BatcherQueuedBatches",
@@ -247,6 +269,13 @@ class SignatureBatcher:
                     self._cv.notify_all()
 
     def _run_batch(self, batch: List[_Entry]) -> None:
+        if self._use_pipeline:
+            pipe = self._ensure_pipeline()
+            if pipe is not None and self._run_batch_pipelined(pipe, batch):
+                return
+        self._run_batch_sync(batch)
+
+    def _run_batch_sync(self, batch: List[_Entry]) -> None:
         items = [it for it, _, _ in batch]
         # fan-in span: ONE batch served N parent traces — link them all
         # so each trace's tree shows the shared flush (untraced batches
@@ -259,18 +288,96 @@ class SignatureBatcher:
             results = crypto_batch.verify_batch(items)
         except Exception as exc:  # propagate to every waiter
             sp.finish(error=exc)
+            self._fail_batch(batch, exc)
+            return
+        sp.finish()
+        self._complete_batch(batch, results, time.perf_counter() - t0)
+
+    # -- pipelined route (docs/perf-pipeline.md) ---------------------------
+
+    def _ensure_pipeline(self):
+        with self._lock:
+            if self._pipeline is None and not self._closed:
+                self._pipeline = pipeline_mod.VerificationPipeline(
+                    name="batcher"
+                )
+                if self._registry is not None:
+                    self._pipeline.bind_metrics(self._registry)
+            return self._pipeline
+
+    def _run_batch_pipelined(self, pipe, batch: List[_Entry]) -> bool:
+        """Hand the batch to the staged engine; False = the engine
+        refused (stopping mid-close race) and the caller must run the
+        synchronous path instead. submit() BLOCKING on a full ring is
+        the designed backpressure: it parks the flush thread, the flush
+        queue fills to its cap, and submit_many converts that to
+        producer backpressure (PR-5 composition)."""
+        items = [it for it, _, _ in batch]
+        ctxs = [ctx for _, _, ctx in batch]
+        t0 = time.perf_counter()
+        try:
+            fut = pipe.submit(items, ctxs=ctxs)
+        except pipeline_mod.PipelineStoppedError:
+            return False
+        except Exception as exc:
+            # ANY submit failure (e.g. thread exhaustion starting the
+            # stage threads) must degrade to the synchronous path, not
+            # kill the flush thread with this popped batch's futures
+            # stranded unresolved
             from ..utils import eventlog
 
             eventlog.emit(
-                "error", "verifier", "signature batch failed",
-                trace_ids={c.trace_id for _, _, c in batch if c is not None},
-                items=len(batch), error=f"{type(exc).__name__}: {exc}",
+                "warning", "verifier",
+                "pipeline submit failed; batch served synchronously",
+                error=f"{type(exc).__name__}: {exc}", items=len(batch),
             )
-            for _, fut, _ in batch:
+            return False
+
+        def done(f) -> None:
+            exc = f.exception()
+            # the batch's own busy time (sum of its stage walls), NOT
+            # submit→completion elapsed: under a loaded ring the latter
+            # counts queueing behind other batches as verify work and
+            # inflates flush_wall_s up to depth-fold vs the sync path
+            # (queueing pressure is flush_lag_s' job)
+            walls = getattr(f, "pipeline_stage_walls", None)
+            wall = (
+                sum(walls.values()) if walls
+                else time.perf_counter() - t0
+            )
+            if exc is not None:
+                self._fail_batch(batch, exc)
+                return
+            # the fan-in span the sync path emits inline: recorded at
+            # completion with the measured wall so /traces shows the
+            # shared flush identically in both modes (per-stage
+            # pipeline.* spans ride alongside, emitted by the engine)
+            tracing.get_tracer().record_span(
+                "verifier.batch", wall,
+                links=[c for c in ctxs if c is not None],
+                items=len(batch), pipelined=True,
+            )
+            self._complete_batch(batch, f.result(), wall)
+
+        fut.add_done_callback(done)
+        return True
+
+    # -- shared completion (one source of truth for both modes) ------------
+
+    def _fail_batch(self, batch: List[_Entry], exc: BaseException) -> None:
+        from ..utils import eventlog
+
+        eventlog.emit(
+            "error", "verifier", "signature batch failed",
+            trace_ids={c.trace_id for _, _, c in batch if c is not None},
+            items=len(batch), error=f"{type(exc).__name__}: {exc}",
+        )
+        for _, fut, _ in batch:
+            if not fut.done():
                 fut.set_exception(exc)
-            return
-        sp.finish()
-        wall = time.perf_counter() - t0
+
+    def _complete_batch(self, batch: List[_Entry], results,
+                        wall: float) -> None:
         with self._lock:
             self.flush_wall_s += wall
             self.flushes += 1
@@ -288,7 +395,8 @@ class SignatureBatcher:
             items=len(batch), wall_ms=round(wall * 1000, 3),
         )
         for (_, fut, _), ok in zip(batch, results):
-            fut.set_result(bool(ok))
+            if not fut.done():
+                fut.set_result(bool(ok))
 
     # -- synchronous edges -------------------------------------------------
 
@@ -307,7 +415,7 @@ class SignatureBatcher:
         while True:
             with self._cv:
                 if not self._flush_queue and not self._in_flight:
-                    return
+                    break
                 # defensive: a dead flush thread must not strand queued
                 # batches (and hang this wait) — drain them inline
                 thread_dead = (
@@ -324,6 +432,15 @@ class SignatureBatcher:
                 t_queued, stranded_batch = stranded
                 self.flush_lag_s += time.monotonic() - t_queued
             self._run_batch(stranded_batch)
+        # pipelined mode hands batches to the staged engine and returns
+        # before they verify: the flush() contract ("every previously
+        # submitted future is resolved on return") extends to the ring.
+        # Unbounded, like the sync loop above — a slow batch must delay
+        # flush(), never let it return with unresolved futures.
+        with self._lock:
+            pipe = self._pipeline
+        if pipe is not None:
+            pipe.drain(timeout=None)
 
     def close(self) -> None:
         # Refuse new work first, then drain: a submit racing with close
@@ -333,3 +450,7 @@ class SignatureBatcher:
             self._closed = True
             self._cv.notify_all()  # wake the flush thread to exit
         self.flush()
+        with self._lock:
+            pipe, self._pipeline = self._pipeline, None
+        if pipe is not None:
+            pipe.stop()

@@ -1,0 +1,451 @@
+"""Overlapped host/device verification pipeline (ROADMAP item 3).
+
+The round-11 profile showed the flow thread at ~96% CPU share while the
+device path waited: the device ladder idles while the host parses and
+SHA-512-prehashes the next batch.  This module is the structural fix —
+a staged engine in the shape of the FPGA ECDSA verification engine of
+arXiv:2112.02229 (PAPERS.md), where parse, hash and verify each run
+continuously on *different* data and no stage ever blocks another:
+
+    submit ──> [decode] ──> [prehash] ──> [dispatch] ──> [collect] ──> futures
+                 parse        SHA-512       async           deferred
+                 bucket       (native,      launch /        block_until_ready
+                 schemes      GIL-free)     host engines    + composites
+
+Each stage runs on its own daemon thread; batches flow through per-stage
+handoff queues; a bounded ring of K batches in flight
+(CORDA_TPU_PIPELINE_DEPTH) double-buffers the stages — the host hashes
+batch N+1 while the device (or the GIL-releasing native MSM engine)
+verifies batch N.  A full ring converts to SYNCHRONOUS ``submit()``
+backpressure, which composes with the PR-5 batcher caps: the blocked
+flush thread fills the batcher's flush queue, whose cap in turn blocks
+producers in ``submit_many`` — overload propagates to the submitters,
+never into unbounded queueing.
+
+The stage functions default to the staged phase API of
+``core.crypto.batch`` (plan → prehash → dispatch → collect, with the
+split device route opted in), but are injectable: a mesh-backed dispatch
+stage drops in for 8-chip scale-out (``parallel/mesh.shard_verify`` has
+the same batch-in/mask-out shape), and tests substitute gated stubs.
+
+Failure containment: a stage function that raises fails ONLY its own
+batch (the batch's future carries the exception; the stage thread and
+every other in-flight batch continue).  The ``pipeline.stage`` fault
+point (utils/faultpoints) injects exactly that, plus per-stage delays,
+under the seeded testing/faults machinery.
+
+Telemetry: ``Pipeline.InFlightBatches`` / ``Pipeline.OverlapRatio``
+gauges, per-stage ``Pipeline.StageOccupancy{stage=…}`` /
+``Pipeline.StageWallSeconds{stage=…}``, one tracing span per stage
+(``pipeline.<stage>``) linked to every trace the batch serves, and
+eventlog records for stage failures.  See docs/perf-pipeline.md for the
+ring-sizing and overlap math.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
+
+from ..utils import faultpoints, lockorder, tracing
+
+#: default bound on batches in flight across ALL stages (the ring):
+#: one per stage double-buffers every handoff; deeper only adds memory
+DEFAULT_DEPTH = 4
+
+Stage = Tuple[str, Callable]
+
+
+class PipelineStoppedError(RuntimeError):
+    """The pipeline refused or abandoned a batch because it is stopping."""
+
+
+def pipeline_enabled() -> bool:
+    """The CORDA_TPU_PIPELINE gate: on by default; ``0`` restores the
+    synchronous verify path byte-identically (the batcher never
+    constructs an engine)."""
+    return os.environ.get("CORDA_TPU_PIPELINE", "1") not in ("0", "")
+
+
+def default_depth() -> int:
+    try:
+        depth = int(os.environ.get("CORDA_TPU_PIPELINE_DEPTH", DEFAULT_DEPTH))
+    except ValueError:
+        return DEFAULT_DEPTH
+    return max(1, depth)
+
+
+def default_stages() -> Sequence[Stage]:
+    """The production stage functions: the staged phase API of
+    core.crypto.batch with the split device route opted in (async
+    donated-buffer kernel launches, deferred materialisation)."""
+    from ..core.crypto import batch as crypto_batch
+
+    return (
+        ("decode", lambda items: crypto_batch.plan_batch(
+            items, split_device=True
+        )),
+        ("prehash", lambda plan: crypto_batch.prehash_plan(plan)),
+        ("dispatch", lambda plan: crypto_batch.dispatch_plan(plan)),
+        ("collect", lambda plan: crypto_batch.collect_plan(plan)),
+    )
+
+
+class _Job:
+    """One batch in flight: the evolving stage value, the caller's
+    future, and the trace contexts of every submitter it serves."""
+
+    __slots__ = ("value", "future", "ctxs", "error", "walls")
+
+    def __init__(self, value, future: Future, ctxs):
+        self.value = value
+        self.future = future
+        self.ctxs = tuple(ctxs)
+        self.error: Optional[BaseException] = None
+        self.walls = {}
+
+
+class VerificationPipeline:
+    """A staged, double-buffered batch engine with a bounded in-flight
+    ring.  ``submit()`` returns a Future resolving to the last stage's
+    return value; stage threads are created lazily on first submit and
+    torn down by ``stop()``."""
+
+    def __init__(self, stages: Optional[Sequence[Stage]] = None,
+                 depth: Optional[int] = None, name: str = "verifier",
+                 registry=None):
+        self.name = name
+        self.stages: List[Stage] = list(
+            stages if stages is not None else default_stages()
+        )
+        if not self.stages:
+            raise ValueError("a pipeline needs at least one stage")
+        self.depth = depth if depth is not None else default_depth()
+        self._lock = lockorder.make_lock("VerificationPipeline._lock")
+        self._cv = lockorder.make_condition(
+            self._lock, name="VerificationPipeline._cv"
+        )
+        #: one handoff queue per stage (jobs waiting for that stage)
+        self._queues: List[Deque[_Job]] = [deque() for _ in self.stages]
+        #: jobs popped by a stage thread and not yet finished/forwarded —
+        #: what stop() must fail when a wedged stage outlives its timeout
+        self._running: List[_Job] = []  # guarded-by: _cv
+        self._in_flight = 0  # guarded-by: _cv
+        self._threads: List[threading.Thread] = []
+        self._stopping = False  # guarded-by: _cv
+        self._stopped = False  # guarded-by: _cv
+        self._poisoned = False  # thread creation failed; engine unusable
+        # telemetry (all guarded by _cv): cumulative per-stage busy
+        # seconds, live per-stage occupancy (queued + running), and the
+        # engine-active wall needed for the overlap ratio
+        self._stage_wall = {s: 0.0 for s, _ in self.stages}
+        self._stage_occupancy = {s: 0 for s, _ in self.stages}
+        self._busy_total = 0.0  # sum of all stage walls
+        self._active_wall = 0.0  # wall time with >= 1 batch in flight
+        self._busy_since: Optional[float] = None
+        self.batches = 0  # completed (ok or failed)
+        self.failures = 0  # batches whose stage raised
+        if registry is not None:
+            self.bind_metrics(registry)
+
+    # -- read surface ------------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def stage_wall_s(self, stage: str) -> float:
+        with self._lock:
+            return self._stage_wall.get(stage, 0.0)
+
+    def stage_occupancy(self, stage: str) -> int:
+        with self._lock:
+            return self._stage_occupancy.get(stage, 0)
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Fraction of cumulative stage work hidden under other stages'
+        work: (sum of stage walls − engine-active wall) / sum of stage
+        walls. 0 = fully serial (or idle); → (S−1)/S for S perfectly
+        overlapped stages. The live counterpart of the bench A/B's
+        ``pipeline_overlap_ratio`` (docs/perf-pipeline.md)."""
+        with self._lock:
+            busy = self._busy_total
+            active = self._active_wall
+            if self._busy_since is not None:
+                active += time.monotonic() - self._busy_since
+        if busy <= 0.0:
+            return 0.0
+        return max(0.0, (busy - active) / busy)
+
+    def bind_metrics(self, registry) -> None:
+        """Register the Pipeline.* instruments (labelled-name convention,
+        docs/observability.md); gauge re-registration replaces stale
+        closures so a recreated engine can rebind the same names."""
+        registry.gauge("Pipeline.InFlightBatches", lambda: self.in_flight)
+        registry.gauge(
+            "Pipeline.OverlapRatio", lambda: round(self.overlap_ratio, 4)
+        )
+        for stage, _fn in self.stages:
+            registry.gauge(
+                f"Pipeline.StageOccupancy{{stage={stage}}}",
+                lambda s=stage: self.stage_occupancy(s),
+            )
+            registry.gauge(
+                f"Pipeline.StageWallSeconds{{stage={stage}}}",
+                lambda s=stage: round(self.stage_wall_s(s), 6),
+            )
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, value, ctxs=()) -> Future:
+        """Enqueue one batch; returns a Future of the final stage's
+        return value.  BLOCKS while the ring is full — the synchronous
+        backpressure that composes with the batcher's flush-queue cap —
+        and raises :class:`PipelineStoppedError` once stop() began."""
+        fut: Future = Future()
+        job = _Job(value, fut, ctxs)
+        with self._cv:
+            while (
+                self._in_flight >= self.depth
+                and not self._stopping
+            ):
+                self._cv.wait(timeout=0.1)
+            if self._stopping:
+                raise PipelineStoppedError(f"pipeline {self.name} stopped")
+            self._in_flight += 1
+            if self._in_flight == 1 and self._busy_since is None:
+                self._busy_since = time.monotonic()
+            try:
+                self._ensure_threads_locked()
+            except BaseException:
+                # thread exhaustion (the overload regime this engine
+                # targets): release the ring slot this submit took —
+                # a leaked slot would eventually wedge every later
+                # submit against the depth cap — and let the caller
+                # fall back to the synchronous path
+                self._in_flight -= 1
+                if self._in_flight == 0 and self._busy_since is not None:
+                    self._busy_since = None
+                raise
+            self._queues[0].append(job)
+            self._stage_occupancy[self.stages[0][0]] += 1
+            self._cv.notify_all()
+        return fut
+
+    def _ensure_threads_locked(self) -> None:
+        if self._poisoned:
+            # a previous thread-creation failure: refuse rather than
+            # queue onto missing stages
+            raise PipelineStoppedError(
+                f"pipeline {self.name} unusable: stage threads "
+                "failed to start"
+            )
+        if self._threads:
+            return
+        started = []
+        try:
+            for i, (stage, _fn) in enumerate(self.stages):
+                t = threading.Thread(
+                    target=self._stage_loop, args=(i,),
+                    name=f"pipeline-{self.name}-{stage}", daemon=True,
+                )
+                t.start()
+                started.append(t)
+        except BaseException:
+            # thread exhaustion mid-creation: partial stage coverage
+            # would wedge every batch at the missing stage, so poison
+            # the engine — the started threads see _stopped and exit;
+            # later submits raise and callers fall back to the
+            # synchronous path
+            self._poisoned = True
+            # lint: allow(guarded_by) — _ensure_threads_locked runs under _cv (submit holds it)
+            self._stopping = True
+            # lint: allow(guarded_by) — same: the caller holds _cv
+            self._stopped = True
+            self._threads = started
+            self._cv.notify_all()
+            raise
+        self._threads = started
+
+    # -- stage machinery ---------------------------------------------------
+
+    def _stage_loop(self, i: int) -> None:
+        stage, fn = self.stages[i]
+        q = self._queues[i]
+        while True:
+            with self._cv:
+                while not q and not self._stopped:
+                    self._cv.wait()
+                if not q:
+                    return  # stopped; leftovers were failed by stop()
+                job = q.popleft()
+                self._running.append(job)
+            self._run_stage(i, stage, fn, job)
+
+    def _run_stage(self, i: int, stage: str, fn, job: _Job) -> None:
+        # fan-in span per stage: ONE stage execution serves every trace
+        # the batch carries (NOOP when the batch is untraced)
+        sp = tracing.get_tracer().fan_in_span(
+            f"pipeline.{stage}", job.ctxs, pipeline=self.name
+        )
+        t0 = time.monotonic()
+        err: Optional[BaseException] = None
+        try:
+            if faultpoints.hook is not None:
+                action = faultpoints.fire(
+                    "pipeline.stage", stage=stage, pipeline=self.name
+                )
+                if action == "crash":
+                    raise RuntimeError(
+                        f"injected pipeline fault at stage {stage}"
+                    )
+                if isinstance(action, tuple) and action and \
+                        action[0] == "delay":
+                    time.sleep(action[1])
+            job.value = fn(job.value)
+        except BaseException as exc:
+            err = exc
+        wall = time.monotonic() - t0
+        sp.finish(error=err)
+        last = i + 1 >= len(self.stages)
+        if err is not None:
+            job.error = err
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "error", "pipeline", "pipeline stage failed",
+                trace_ids={c.trace_id for c in job.ctxs if c is not None},
+                stage=stage, name=self.name,
+                error=f"{type(err).__name__}: {err}",
+            )
+        with self._cv:
+            self._stage_occupancy[stage] -= 1
+            self._stage_wall[stage] += wall
+            self._busy_total += wall
+            job.walls[stage] = wall
+            if job in self._running:
+                self._running.remove(job)
+            if err is None and not last and not self._stopped:
+                self._queues[i + 1].append(job)
+                self._stage_occupancy[self.stages[i + 1][0]] += 1
+                self._cv.notify_all()
+                return
+            if err is None and not last:
+                # stopped while this stage ran: the next stage's thread
+                # is gone, so terminate the batch here instead of
+                # parking it on a dead queue (stop() already failed the
+                # future; _resolve below is done()-guarded)
+                job.error = PipelineStoppedError(
+                    f"pipeline {self.name} stopped mid-batch"
+                )
+        # terminal (finished or failed): resolve the future FIRST, so a
+        # caller woken by drain()/flush() can never observe an
+        # unresolved future for a batch the ring no longer counts
+        self._resolve(job)
+        with self._cv:
+            self.batches += 1
+            if job.error is not None:
+                self.failures += 1
+            self._in_flight -= 1
+            if self._in_flight == 0 and self._busy_since is not None:
+                self._active_wall += time.monotonic() - self._busy_since
+                self._busy_since = None
+            self._cv.notify_all()
+
+    @staticmethod
+    def _resolve(job: _Job) -> None:
+        if job.future.done():
+            return
+        # the batch's own per-stage busy walls ride the future (read by
+        # done callbacks, e.g. the batcher's flush_wall_s accounting):
+        # elapsed submit→resolve time would count ring blocking and
+        # inter-stage queueing as verify work
+        job.future.pipeline_stage_walls = dict(job.walls)
+        try:
+            if job.error is not None:
+                job.future.set_exception(job.error)
+            else:
+                job.future.set_result(job.value)
+        except InvalidStateError:
+            # lost the race against stop()'s wedged-batch failover
+            # (done() checks are not atomic with the set): the loser
+            # must never kill a stage thread — the terminal accounting
+            # after this call still has to run
+            pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        """Block until no batch is in flight (True) or `timeout` elapsed
+        (False). Completion order guarantees every drained batch's
+        future is already resolved when this returns."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._in_flight > 0:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(timeout=0.5 if remaining is None else
+                              min(0.5, remaining))
+            return True
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Refuse new submissions, drain in-flight batches, then tear the
+        stage threads down.  Batches still unfinished after `timeout`
+        (e.g. a stage wedged by fault injection) are failed with
+        :class:`PipelineStoppedError` — zero hung futures, ever."""
+        with self._cv:
+            if self._stopped:
+                return
+            self._stopping = True
+            self._cv.notify_all()  # wake blocked submitters to raise
+        self.drain(timeout=timeout)
+        leftovers: List[_Job] = []
+        with self._cv:
+            self._stopped = True
+            for i, q in enumerate(self._queues):
+                while q:
+                    job = q.popleft()
+                    self._stage_occupancy[self.stages[i][0]] -= 1
+                    job.error = PipelineStoppedError(
+                        f"pipeline {self.name} stopped with the batch "
+                        "still queued"
+                    )
+                    leftovers.append(job)
+                    self._in_flight -= 1
+            # a batch RUNNING inside a wedged stage still holds its
+            # caller's future: fail it now rather than strand the
+            # caller; the stage thread's eventual completion finds the
+            # future already done (_resolve is done()-guarded) and only
+            # updates telemetry
+            wedged = list(self._running)
+            if self._in_flight <= 0 and self._busy_since is not None:
+                self._active_wall += time.monotonic() - self._busy_since
+                self._busy_since = None
+            self._cv.notify_all()
+        for job in leftovers:
+            self._resolve(job)
+        for job in wedged:
+            if not job.future.done():
+                try:
+                    job.future.set_exception(PipelineStoppedError(
+                        f"pipeline {self.name} stopped with the batch "
+                        "wedged in a stage"
+                    ))
+                except InvalidStateError:
+                    pass  # the stage completed between check and set
+        if wedged:
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "warning", "pipeline", "pipeline stopped with wedged batches",
+                name=self.name, batches=len(wedged),
+            )
+        for t in self._threads:
+            t.join(timeout=5)

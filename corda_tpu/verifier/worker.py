@@ -12,6 +12,13 @@ Reference parity: `verifier/src/main/kotlin/net/corda/verifier/Verifier.kt:50-90
 Elasticity comes from broker competing-consumer semantics: start N workers
 for scale-out, kill one mid-run and its unacked requests are redelivered
 (mirrors `VerifierTests.kt:73-101`).
+
+The worker's batcher drains into the overlapped verification pipeline
+(verifier/pipeline.py, CORDA_TPU_PIPELINE): each SignatureBatchRequest's
+flush hands the batch to the staged engine, so with several workers (or
+several requests flushed by one) the host prehash of one batch overlaps
+the device/native dispatch of another; replies still follow the
+ack-after-result discipline, so redelivery semantics are unchanged.
 """
 from __future__ import annotations
 

@@ -337,6 +337,38 @@ class TestScenario:
         finally:
             net.stop_nodes()
 
+    def test_pipelined_flush_no_cycles(self, armed):
+        """The overlapped verification pipeline (docs/perf-pipeline.md)
+        under the armed detector: a batcher flush drains through the
+        staged engine — four stage threads, the ring condition, the
+        batcher lock, the metric locks — with ZERO ordering cycles, and
+        the engine's own locks were really instrumented (built through
+        the lockorder factories while armed)."""
+        from corda_tpu.core.crypto import crypto
+        from corda_tpu.verifier.batcher import SignatureBatcher
+
+        items = []
+        for i in range(12):
+            kp = crypto.entropy_to_keypair(7100 + i)
+            content = b"lockcheck-pipe-%d" % i
+            items.append(
+                (kp.public, crypto.do_sign(kp.private, content), content)
+            )
+        b = SignatureBatcher(max_batch=4, linger_ms=10_000, pipeline=True)
+        try:
+            futures = []
+            for k in range(3):  # 3 max_batch handoffs -> 3 ring batches
+                futures += b.submit_many(items[4 * k:4 * (k + 1)])
+            b.flush()
+            assert all(f.result(timeout=10) for f in futures)
+            assert b.flushes == 3
+        finally:
+            b.close()
+        # the engine's locks were really instrumented while armed, and
+        # the pipelined flush produced zero ordering cycles
+        assert lockorder.meta()["nodes"] > 0
+        assert lockorder.cycles() == [], lockorder.cycles()
+
     def test_cross_shard_commit_under_detector(self, armed):
         import hashlib
 

@@ -306,8 +306,11 @@ class TestBatcherBackpressure:
         monkeypatch.setattr(
             batcher_mod.crypto_batch, "verify_batch", slow_verify
         )
+        # pipeline=False: this pins the SYNC path's flush-queue-cap
+        # backpressure by stubbing verify_batch (the staged pipeline's
+        # ring backpressure is pinned in tests/test_pipeline.py)
         b = SignatureBatcher(max_batch=1, linger_ms=10_000,
-                             max_queued_batches=1)
+                             max_queued_batches=1, pipeline=False)
         item = (None, b"sig", b"content")
         f1 = b.submit(item)  # hands off; flush thread blocks in verify
         # wait until the first batch is actually in flight so the next

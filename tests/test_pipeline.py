@@ -1,0 +1,678 @@
+"""Overlapped verification pipeline tests (docs/perf-pipeline.md).
+
+Covers the staged engine (ring backpressure, clean drain on stop with
+zero hung futures, per-batch fault containment via the testing/faults
+seams), the SignatureBatcher wiring (flush contract, PR-5 backpressure
+composition, close teardown), parity of the staged phase API with the
+synchronous verify path, the sync-vs-pipelined A/B harness, the bench
+gate's direction classification of the new stage keys, and the
+`loadtest/real._hot_timers` snapshot-tolerance fix.
+"""
+import threading
+import time
+
+import pytest
+
+from corda_tpu.core.crypto import crypto
+from corda_tpu.core.crypto import batch as crypto_batch
+from corda_tpu.testing import faults
+from corda_tpu.verifier.batcher import SignatureBatcher
+from corda_tpu.verifier.pipeline import (
+    PipelineStoppedError,
+    VerificationPipeline,
+    pipeline_enabled,
+)
+
+
+def _items(n, entropy0=6000, tamper_idx=()):
+    items = []
+    for i in range(n):
+        kp = crypto.entropy_to_keypair(entropy0 + i)
+        content = b"pipe-msg-%d" % i
+        sig = crypto.do_sign(kp.private, content)
+        if i in tamper_idx:
+            content = b"tampered-%d" % i
+        items.append((kp.public, sig, content))
+    return items
+
+
+def _ident(v):
+    return v
+
+
+# ---------------------------------------------------------------------------
+# The staged engine
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_jobs_flow_through_stages_in_order(self):
+        seen = []
+        p = VerificationPipeline(
+            stages=[
+                ("a", lambda v: (seen.append(("a", v)), v + 1)[-1]),
+                ("b", lambda v: (seen.append(("b", v)), v * 10)[-1]),
+            ],
+            depth=2, name="order",
+        )
+        try:
+            futs = [p.submit(i) for i in range(4)]
+            assert [f.result(timeout=5) for f in futs] == [10, 20, 30, 40]
+            # per-stage FIFO: stage a saw 0..3 in order, so did b (+1)
+            assert [v for s, v in seen if s == "a"] == [0, 1, 2, 3]
+            assert [v for s, v in seen if s == "b"] == [1, 2, 3, 4]
+            assert p.batches == 4 and p.failures == 0
+            assert p.in_flight == 0
+        finally:
+            p.stop()
+
+    def test_full_ring_converts_to_submit_backpressure(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def gated(v):
+            entered.set()
+            assert gate.wait(timeout=10)
+            return v
+
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", gated)],
+            depth=2, name="bp",
+        )
+        try:
+            f1 = p.submit(1)
+            assert entered.wait(5)
+            f2 = p.submit(2)  # fills the ring (1 running, 1 queued)
+            unblocked = threading.Event()
+            extra = {}
+
+            def third():
+                extra["f3"] = p.submit(3)
+                unblocked.set()
+
+            t = threading.Thread(target=third, daemon=True, name="bp-sub")
+            t.start()
+            # the ring is full: the third submit must BLOCK, not queue
+            assert not unblocked.wait(timeout=0.3)
+            assert p.in_flight == 2
+            gate.set()
+            assert unblocked.wait(timeout=10)
+            assert f1.result(5) == 1 and f2.result(5) == 2
+            assert extra["f3"].result(5) == 3
+        finally:
+            gate.set()
+            p.stop()
+
+    def test_stop_with_wedged_stage_zero_hung_futures(self):
+        gate = threading.Event()
+
+        def wedged(v):
+            assert gate.wait(timeout=30)
+            return v
+
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", wedged)],
+            depth=3, name="wedge",
+        )
+        futs = [p.submit(i) for i in range(3)]  # 1 wedged, 2 queued
+        t0 = time.monotonic()
+        p.stop(timeout=0.3)  # must NOT wait for the wedge to clear
+        assert time.monotonic() - t0 < 10
+        # zero hung futures: every one resolved, queued ones with the
+        # typed stop error
+        done = [f.done() for f in futs]
+        assert all(done), done
+        errors = sum(
+            1 for f in futs if f.exception() is not None
+        )
+        assert errors == 3
+        assert all(
+            isinstance(f.exception(), PipelineStoppedError) for f in futs
+        )
+        with pytest.raises(PipelineStoppedError):
+            p.submit(99)
+        gate.set()  # let the wedged thread exit
+
+    def test_clean_stop_drains_in_flight_batches(self):
+        p = VerificationPipeline(
+            stages=[("a", lambda v: v + 1)], depth=2, name="drain",
+        )
+        futs = [p.submit(i) for i in range(5)]
+        p.stop()  # default timeout: drains, then tears down
+        assert [f.result(0) for f in futs] == [1, 2, 3, 4, 5]
+
+    def test_stage_crash_fails_only_its_batch(self):
+        def picky(v):
+            if v == "boom":
+                raise ValueError("stage exploded")
+            return v
+
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", picky)],
+            depth=2, name="crash",
+        )
+        try:
+            f1 = p.submit("ok-1")
+            f2 = p.submit("boom")
+            f3 = p.submit("ok-2")
+            assert f1.result(5) == "ok-1"
+            with pytest.raises(ValueError):
+                f2.result(5)
+            assert f3.result(5) == "ok-2"  # the stage thread survived
+            assert p.failures == 1 and p.batches == 3
+        finally:
+            p.stop()
+
+    def test_fault_injection_crashes_one_batch(self):
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", _ident)],
+            depth=2, name="faulted",
+        )
+        try:
+            with faults.inject(seed=3) as fi:
+                rule = fi.rule(
+                    "pipeline.stage", "crash", match="dispatch", times=1
+                )
+                f1 = p.submit("a")
+                with pytest.raises(RuntimeError, match="injected"):
+                    f1.result(5)
+                f2 = p.submit("b")
+                assert f2.result(5) == "b"
+            assert rule.fired == 1
+        finally:
+            p.stop()
+
+    def test_fault_injection_delay(self):
+        p = VerificationPipeline(
+            stages=[("dispatch", _ident)], depth=2, name="delayed",
+        )
+        try:
+            with faults.inject(seed=3) as fi:
+                fi.rule("pipeline.stage", ("delay", 0.15), times=1)
+                t0 = time.monotonic()
+                assert p.submit("x").result(5) == "x"
+                assert time.monotonic() - t0 >= 0.15
+        finally:
+            p.stop()
+
+    def test_overlap_ratio_accounts_concurrent_stages(self):
+        def slow(v):
+            time.sleep(0.05)
+            return v
+
+        p = VerificationPipeline(
+            stages=[("a", slow), ("b", slow)], depth=4, name="ratio",
+        )
+        try:
+            futs = [p.submit(i) for i in range(4)]
+            for f in futs:
+                f.result(10)
+            # 8 stage executions x 50ms = 400ms busy; with stage a of
+            # job N+1 overlapping stage b of job N the active wall is
+            # well under the busy sum
+            assert p.overlap_ratio > 0.1, p.overlap_ratio
+            assert p.stage_wall_s("a") >= 0.15
+            assert p.stage_wall_s("b") >= 0.15
+        finally:
+            p.stop()
+
+    def test_thread_start_failure_poisons_engine(self):
+        p = VerificationPipeline(
+            stages=[("a", _ident)], depth=2, name="exhausted",
+        )
+        with pytest.MonkeyPatch.context() as mp:
+            def failing_start(self_t):
+                raise RuntimeError("can't start new thread")
+
+            mp.setattr(threading.Thread, "start", failing_start)
+            with pytest.raises(RuntimeError, match="can't start"):
+                p.submit(1)
+        # the ring slot was rolled back, and the engine is poisoned:
+        # later submits refuse (callers fall back to the sync path)
+        # instead of queueing onto missing stage threads
+        assert p.in_flight == 0
+        with pytest.raises(PipelineStoppedError):
+            p.submit(2)
+
+    def test_metrics_bound(self):
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        reg = MetricRegistry()
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", _ident)],
+            depth=2, name="metered", registry=reg,
+        )
+        try:
+            assert reg.gauge("Pipeline.InFlightBatches").value == 0
+            p.submit("x").result(5)
+            assert reg.gauge("Pipeline.InFlightBatches").value == 0
+            assert reg.gauge(
+                "Pipeline.StageOccupancy{stage=decode}"
+            ).value == 0
+            assert reg.gauge(
+                "Pipeline.StageWallSeconds{stage=dispatch}"
+            ).value >= 0.0
+            assert 0.0 <= reg.gauge("Pipeline.OverlapRatio").value <= 1.0
+        finally:
+            p.stop()
+
+    def test_stage_spans_link_served_traces(self):
+        from corda_tpu.utils import tracing
+
+        tracer = tracing.get_tracer()
+        tracer.reset()
+        ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+        p = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", _ident)],
+            depth=2, name="traced",
+        )
+        try:
+            p.submit("x", ctxs=[ctx]).result(5)
+        finally:
+            p.stop()
+        spans = tracer.get_trace(ctx.trace_id) or []
+        names = {s["name"] for s in spans}
+        assert "pipeline.decode" in names, names
+        assert "pipeline.dispatch" in names, names
+
+
+# ---------------------------------------------------------------------------
+# SignatureBatcher wiring
+# ---------------------------------------------------------------------------
+
+class TestBatcherPipelined:
+    def test_pipelined_flush_resolves_and_counts(self):
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        reg = MetricRegistry()
+        b = SignatureBatcher(max_batch=8, linger_ms=10_000, pipeline=True)
+        b.bind_metrics(reg)
+        try:
+            futures = b.submit_many(_items(8))
+            assert all(f.result(timeout=10) for f in futures)
+            assert b.flushes == 1
+            assert b.items_verified == 8
+            assert b.largest_batch == 8
+            assert b.flush_wall_s > 0.0
+            assert reg.histogram("Verifier.BatchSize").count == 1
+            # the engine exists and its instruments are registered
+            assert b._pipeline is not None
+            assert reg.gauge("Pipeline.InFlightBatches").value == 0
+        finally:
+            b.close()
+
+    def test_pipeline_false_never_builds_engine(self):
+        b = SignatureBatcher(max_batch=4, linger_ms=10_000, pipeline=False)
+        try:
+            futures = b.submit_many(_items(4, entropy0=6200))
+            assert all(f.result(timeout=10) for f in futures)
+            assert b._pipeline is None
+        finally:
+            b.close()
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_PIPELINE", "0")
+        assert not pipeline_enabled()
+        b = SignatureBatcher(max_batch=2, linger_ms=10_000)
+        assert b._use_pipeline is False
+        b.close()
+        monkeypatch.setenv("CORDA_TPU_PIPELINE", "1")
+        assert pipeline_enabled()
+
+    def test_flush_waits_for_ring(self):
+        """flush() contract in pipelined mode: every previously
+        submitted future is resolved when it returns, even while the
+        engine holds the batch behind a gated dispatch stage."""
+        gate = threading.Event()
+
+        def gated_verify(items):
+            assert gate.wait(timeout=10)
+            return crypto_batch.verify_batch(items)
+
+        b = SignatureBatcher(max_batch=2, linger_ms=10_000, pipeline=True)
+        b._pipeline = VerificationPipeline(
+            stages=[("decode", _ident), ("dispatch", gated_verify)],
+            depth=2, name="flushwait",
+        )
+        try:
+            futures = b.submit_many(_items(2, entropy0=6300))
+            timer = threading.Timer(0.2, gate.set)
+            timer.start()
+            b.flush()  # must block until the engine drained
+            assert all(f.done() for f in futures)
+            assert all(f.result(0) for f in futures)
+            timer.cancel()
+        finally:
+            gate.set()
+            b.close()
+
+    def test_ring_backpressure_composes_with_flush_queue_cap(self):
+        """ISSUE acceptance: a full ring under a paused dispatch stage
+        converts to synchronous submit backpressure — ring full parks
+        the flush thread, the flush queue hits its cap, and
+        submit_many blocks the producer (the PR-5 composition)."""
+        gate = threading.Event()
+
+        def gated_verify(items):
+            assert gate.wait(timeout=15)
+            return crypto_batch.verify_batch(items)
+
+        b = SignatureBatcher(max_batch=1, linger_ms=10_000,
+                             max_queued_batches=1, pipeline=True)
+        b._pipeline = VerificationPipeline(
+            stages=[("dispatch", gated_verify)], depth=1, name="compose",
+        )
+        items = _items(4, entropy0=6400)
+        try:
+            futures = [b.submit(items[0])]  # ring slot: paused dispatch
+            deadline = time.monotonic() + 5
+            while b._pipeline.in_flight == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert b._pipeline.in_flight == 1
+            futures.append(b.submit(items[1]))  # flush thread blocks in
+            # pipe.submit; this buffer sits in the flush queue (cap 1)
+            deadline = time.monotonic() + 5
+            while not b.queued_batches and time.monotonic() < deadline:
+                time.sleep(0.01)
+            futures.append(b.submit(items[2]))  # queue at cap after pop?
+            blocked = threading.Event()
+            extra = {}
+
+            def producer():
+                extra["f"] = b.submit(items[3])
+                blocked.set()
+
+            t = threading.Thread(
+                target=producer, daemon=True, name="compose-producer"
+            )
+            t.start()
+            assert not blocked.wait(timeout=0.4), (
+                "producer must BLOCK while the ring+flush queue are full"
+            )
+            assert b.backpressure_waits >= 1
+            gate.set()
+            assert blocked.wait(timeout=15)
+            futures.append(extra["f"])
+            assert all(f.result(timeout=15) for f in futures)
+        finally:
+            gate.set()
+            b.close()
+
+    def test_stage_crash_fails_only_that_flush(self):
+        """A fault-injected stage crash (testing/faults seam) fails its
+        own batch's futures; the next flush through the same engine
+        verifies clean."""
+        b = SignatureBatcher(max_batch=3, linger_ms=10_000, pipeline=True)
+        try:
+            with faults.inject(seed=11) as fi:
+                rule = fi.rule(
+                    "pipeline.stage", "crash", match="dispatch", times=1
+                )
+                first = b.submit_many(_items(3, entropy0=6500))
+                for f in first:
+                    with pytest.raises(RuntimeError, match="injected"):
+                        f.result(timeout=10)
+                second = b.submit_many(_items(3, entropy0=6600))
+                assert all(f.result(timeout=10) for f in second)
+            assert rule.fired == 1
+            assert b._pipeline.failures == 1
+        finally:
+            b.close()
+
+    def test_submit_failure_falls_back_to_sync(self, monkeypatch):
+        """A non-stopped submit failure (e.g. thread exhaustion) must
+        serve the batch synchronously, never kill the flush thread with
+        the popped batch's futures stranded."""
+        b = SignatureBatcher(max_batch=4, linger_ms=10_000, pipeline=True)
+        try:
+            pipe = b._ensure_pipeline()
+
+            def boom():
+                raise RuntimeError("can't start new thread")
+
+            monkeypatch.setattr(pipe, "_ensure_threads_locked", boom)
+            futures = b.submit_many(_items(4, entropy0=7300))
+            assert all(f.result(timeout=10) for f in futures)
+            assert b.flushes == 1  # the sync path served it
+            assert pipe.in_flight == 0  # no leaked ring slot
+        finally:
+            b.close()
+
+    def test_close_stops_engine_threads(self):
+        b = SignatureBatcher(max_batch=2, linger_ms=10_000, pipeline=True)
+        futures = b.submit_many(_items(2, entropy0=6700))
+        assert all(f.result(timeout=10) for f in futures)
+        engine = b._pipeline
+        assert engine is not None
+        b.close()
+        assert b._pipeline is None
+        for t in engine._threads:
+            t.join(timeout=5)
+            assert not t.is_alive()
+
+    def test_worker_drains_through_pipeline(self):
+        """The out-of-process verifier worker's batcher rides the same
+        engine: a SignatureBatchRequest flush goes through the staged
+        pipeline and replies correctly."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.verifier.service import (
+            OutOfProcessTransactionVerifierService,
+        )
+        from corda_tpu.verifier.worker import VerifierWorker
+
+        broker = Broker()
+        batcher = SignatureBatcher(
+            max_batch=64, linger_ms=10_000, pipeline=True
+        )
+        svc = OutOfProcessTransactionVerifierService(broker, "pipe-node")
+        worker = VerifierWorker(
+            broker, name="pipe-worker", batcher=batcher
+        ).start()
+        try:
+            items = _items(6, entropy0=6800, tamper_idx={2})
+            futures = svc.verify_signatures(items)
+            results = [f.result(timeout=30) for f in futures]
+            assert results == [True, True, False, True, True, True]
+            assert batcher._pipeline is not None  # the engine really ran
+            assert batcher.flushes >= 1
+        finally:
+            worker.stop()
+            svc.stop()
+            broker.close()
+
+
+# ---------------------------------------------------------------------------
+# Staged phase API parity
+# ---------------------------------------------------------------------------
+
+class TestStagedParity:
+    def test_staged_composition_matches_verify_batch(self):
+        items = _items(10, entropy0=6900, tamper_idx={1, 7})
+        # malformed rows: wrong-length key and signature stay False
+        kp = crypto.entropy_to_keypair(6999)
+        items.append((kp.public, b"\x00" * 10, b"short sig"))
+        expected = crypto_batch.verify_batch(items)
+        plan = crypto_batch.plan_batch(items, split_device=True)
+        crypto_batch.prehash_plan(plan)
+        crypto_batch.dispatch_plan(plan)
+        staged = crypto_batch.collect_plan(plan)
+        assert staged == expected
+        assert expected[1] is False and expected[7] is False
+        assert expected[0] is True and expected[-1] is False
+
+    def test_default_stages_verify_correctly(self):
+        p = VerificationPipeline(name="prod-stages")
+        try:
+            items = _items(6, entropy0=7000, tamper_idx={4})
+            out = p.submit(items).result(timeout=30)
+            assert out == [True, True, True, True, False, True]
+        finally:
+            p.stop()
+
+    def test_host_batch_prehash_split_parity(self):
+        from corda_tpu.core.crypto import host_batch
+
+        if not host_batch.available():
+            pytest.skip("native host batch engine unavailable")
+        rows = []
+        for i in range(6):
+            kp = crypto.entropy_to_keypair(7100 + i)
+            content = b"split-%d" % i
+            rows.append((kp.public.encoded,
+                         crypto.do_sign(kp.private, content), content))
+        # one tampered row + one malformed row
+        pub, sig, _ = rows[2]
+        rows[2] = (pub, sig, b"tampered")
+        rows.append((b"\x01" * 31, b"\x02" * 64, b"bad key length"))
+        whole = host_batch.verify_batch_host(rows)
+        split = host_batch.verify_batch_host(
+            rows, prehashed=host_batch.prehash_rows(rows)
+        )
+        assert whole == split
+        assert whole[2] is False and whole[-1] is False
+        assert whole[0] is True
+
+
+# ---------------------------------------------------------------------------
+# The A/B harness + gate wiring
+# ---------------------------------------------------------------------------
+
+class TestOverlapHarness:
+    def test_measure_pipeline_overlap_smoke(self):
+        from corda_tpu.loadtest.latency import measure_pipeline_overlap
+
+        out = measure_pipeline_overlap(n_batches=2, batch=48, msg_len=512)
+        for key in (
+            "pipeline_sync_wall_ms", "pipeline_pipelined_wall_ms",
+            "pipeline_prehash_wall_ms", "pipeline_dispatch_wall_ms",
+            "pipeline_overlap_ratio", "pipeline_prehash_hidden_pct",
+            "pipeline_engine_interleave", "pipeline_route",
+            "pipeline_cpus",
+        ):
+            assert key in out, key
+        assert out["pipeline_sync_wall_ms"] > 0
+        assert out["pipeline_pipelined_wall_ms"] > 0
+        assert 0.0 <= out["pipeline_overlap_ratio"] <= 1.0
+        assert 0.0 <= out["pipeline_prehash_hidden_pct"] <= 100.0
+        # the noise floor: scheduler-jitter ratios report exactly 0.0
+        # (compare_records skips 0-base ratios, so noise cannot arm the
+        # regression gate on low-core hosts)
+        assert (
+            out["pipeline_overlap_ratio"] == 0.0
+            or out["pipeline_overlap_ratio"] >= 0.05
+        )
+
+    def test_gate_directions_for_pipeline_keys(self):
+        from corda_tpu.loadtest.gate import direction
+
+        assert direction("pipeline_overlap_ratio") == "higher"
+        assert direction("pipeline_prehash_hidden_pct") == "higher"
+        assert direction("pipeline_sync_wall_ms") == "lower"
+        assert direction("pipeline_pipelined_wall_ms") == "lower"
+        assert direction("pipeline_prehash_wall_ms") == "lower"
+        assert direction(
+            "stage_timings.pipeline_overlap_ratio"
+        ) == "higher"
+        # shape keys stay ungated: a workload change is not a regression
+        assert direction("pipeline_batch_rows") is None
+        assert direction("pipeline_cpus") is None
+
+    def test_gate_flags_overlap_ratio_shrink(self):
+        from corda_tpu.loadtest.gate import compare_records
+
+        prev = {"stage_timings": {"pipeline_overlap_ratio": 0.40}}
+        cur = {"stage_timings": {"pipeline_overlap_ratio": 0.10}}
+        regs = compare_records(prev, cur)
+        assert any(
+            r["key"].endswith("pipeline_overlap_ratio") for r in regs
+        ), regs
+        # and the good direction passes
+        assert compare_records(cur, prev) == []
+
+
+# ---------------------------------------------------------------------------
+# loadtest/real._hot_timers snapshot tolerance (satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestHotTimers:
+    def test_ranks_by_total_and_rounds_consistently(self):
+        from corda_tpu.loadtest.real import _hot_timers
+
+        metrics = {
+            "RPC.big": {"type": "timer", "count": 100, "total": 5.0,
+                        "mean": 0.05, "p95": 0.2},
+            "RPC.small": {"type": "timer", "count": 10, "total": 0.1,
+                          "mean": 0.01, "p95": 0.02},
+            "Flows.InFlight": {"type": "gauge", "value": 3},
+        }
+        out = _hot_timers(metrics, top=5)
+        assert list(out) == ["RPC.big", "RPC.small"]
+        assert out["RPC.big"]["total_s"] == 5.0
+        assert out["RPC.big"]["p95_ms"] == 200.0
+        assert out["RPC.small"]["mean_ms"] == 10.0
+
+    def test_missing_total_falls_back_to_count_x_mean(self):
+        from corda_tpu.loadtest.real import _hot_timers
+
+        metrics = {
+            "P2P.Handle.old-build": {"type": "timer", "count": 1000,
+                                     "mean": 0.004, "p95": 0.01},
+            "P2P.Handle.trivial": {"type": "timer", "count": 2,
+                                   "total": 0.001, "mean": 0.0005,
+                                   "p95": 0.001},
+        }
+        out = _hot_timers(metrics, top=5)
+        # 1000 x 4ms = 4s ranks FIRST despite the missing total key
+        assert list(out)[0] == "P2P.Handle.old-build"
+        assert out["P2P.Handle.old-build"]["total_s"] == 4.0
+
+    def test_missing_total_and_mean_does_not_misrank(self):
+        from corda_tpu.loadtest.real import _hot_timers
+
+        metrics = {
+            # a busy timer from a snapshot with neither total nor mean:
+            # the p50 fallback must keep it ranked above the trivial one
+            "RPC.keyPoor": {"type": "timer", "count": 500, "p50": 0.01,
+                            "p95": 0.05},
+            "RPC.tiny": {"type": "timer", "count": 3, "total": 0.003,
+                         "mean": 0.001, "p95": 0.002},
+        }
+        out = _hot_timers(metrics, top=5)
+        assert list(out)[0] == "RPC.keyPoor"
+        row = out["RPC.keyPoor"]
+        assert row["total_s"] == 5.0  # 500 x p50
+        assert row["mean_ms"] == 10.0  # derived total/count
+        assert row["p95_ms"] == 50.0
+
+    def test_empty_reservoir_snapshot_survives(self):
+        from corda_tpu.loadtest.real import _hot_timers
+
+        metrics = {
+            # Timer.snapshot() with an empty reservoir: count/total only
+            "RPC.neverFired": {"type": "timer", "count": 0, "total": 0.0},
+            "RPC.active": {"type": "timer", "count": 5, "total": 0.5,
+                           "mean": 0.1, "p95": 0.3},
+            "weird": "not-a-dict",
+        }
+        out = _hot_timers(metrics, top=5)
+        assert list(out)[0] == "RPC.active"
+        assert out["RPC.neverFired"] == {
+            "count": 0, "mean_ms": 0.0, "p95_ms": 0.0, "total_s": 0.0,
+        }
+
+    def test_p95_falls_back_to_max_then_mean(self):
+        from corda_tpu.loadtest.real import _hot_timers
+
+        metrics = {
+            "RPC.noP95": {"type": "timer", "count": 4, "total": 0.4,
+                          "mean": 0.1, "max": 0.25},
+            "RPC.meanOnly": {"type": "timer", "count": 4, "total": 0.2,
+                             "mean": 0.05},
+            # present-but-null max (foreign build's empty-reservoir
+            # serialisation) must fall through to mean, not crash
+            "RPC.nullMax": {"type": "timer", "count": 2, "total": 0.1,
+                            "mean": 0.05, "max": None},
+        }
+        out = _hot_timers(metrics, top=5)
+        assert out["RPC.noP95"]["p95_ms"] == 250.0
+        assert out["RPC.meanOnly"]["p95_ms"] == 50.0
+        assert out["RPC.nullMax"]["p95_ms"] == 50.0

@@ -241,7 +241,13 @@ class TestDoubleBufferedBatcher:
         monkeypatch.setattr(
             batcher_mod.crypto_batch, "verify_batch", slow_verify
         )
-        b = batcher_mod.SignatureBatcher(max_batch=2, linger_ms=10_000)
+        # pipeline=False: this test pins the SYNCHRONOUS double-buffer
+        # machinery (the CORDA_TPU_PIPELINE=0 path) by stubbing
+        # verify_batch; the staged-pipeline equivalents live in
+        # tests/test_pipeline.py
+        b = batcher_mod.SignatureBatcher(
+            max_batch=2, linger_ms=10_000, pipeline=False
+        )
         items = self._items(4)
         f01 = b.submit_many(items[:2])  # hits max_batch -> flush thread
         assert started.wait(5)
@@ -265,7 +271,10 @@ class TestDoubleBufferedBatcher:
             return real(items)
 
         monkeypatch.setattr(batcher_mod.crypto_batch, "verify_batch", spy)
-        b = batcher_mod.SignatureBatcher(max_batch=1000, linger_ms=20)
+        # pipeline=False: pins the sync-path wheel-callback contract
+        b = batcher_mod.SignatureBatcher(
+            max_batch=1000, linger_ms=20, pipeline=False
+        )
         fut = b.submit(self._items(1)[0])
         assert fut.result(timeout=10) is True
         # the verify body ran on the batcher's own flush thread, never on
@@ -285,7 +294,10 @@ class TestDoubleBufferedBatcher:
         monkeypatch.setattr(
             batcher_mod.crypto_batch, "verify_batch", slow_verify
         )
-        b = batcher_mod.SignatureBatcher(max_batch=1, linger_ms=10_000)
+        # pipeline=False: pins the sync-path flush-waits contract
+        b = batcher_mod.SignatureBatcher(
+            max_batch=1, linger_ms=10_000, pipeline=False
+        )
         futs = b.submit_many(self._items(1))
         timer = threading.Timer(0.2, release.set)
         timer.start()
