@@ -560,6 +560,28 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         pipe_ab = {"pipeline_stage_error": f"{type(exc).__name__}: {exc}"}
 
+    # GIL-escaped message plane (ISSUE 12, docs/perf-system.md round
+    # 16): the native batch codec vs the pure-Python fast path (the
+    # ≥3x acceptance A/B — byte parity asserted inside), and the
+    # end-to-end wire-layer drain rate through BrokerServer +
+    # RemoteBroker (`pump_drain_msgs_s`, higher-is-better gated). Like
+    # the pipeline overlap, the PARALLELISM win needs ≥2 cores — on a
+    # 1-core box native≈python for the drain, and cpus rides the env
+    # fingerprint the gate compares.
+    from corda_tpu.loadtest.latency import measure_codec_batch
+    from corda_tpu.loadtest.latency import measure_pump_drain
+
+    try:
+        codec_batch = measure_codec_batch()
+    except Exception as exc:
+        codec_batch = {
+            "codec_batch_error": f"{type(exc).__name__}: {exc}"
+        }
+    try:
+        pump_drain = measure_pump_drain()
+    except Exception as exc:
+        pump_drain = {"pump_drain_error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -598,6 +620,14 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "pipeline_prehash_hidden_pct": pipe_ab.get(
             "pipeline_prehash_hidden_pct"
         ),
+        "codec_batch_native_us_per_obj": codec_batch.get(
+            "codec_batch_native_us_per_obj"
+        ),
+        "codec_batch_python_us_per_obj": codec_batch.get(
+            "codec_batch_python_us_per_obj"
+        ),
+        "codec_batch_speedup_x": codec_batch.get("codec_batch_speedup_x"),
+        "pump_drain_msgs_s": pump_drain.get("pump_drain_msgs_s"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
@@ -626,6 +656,8 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     }
     out.update(bls)
     out.update(pipe_ab)
+    out.update(codec_batch)
+    out.update(pump_drain)
 
     # Full-system throughput: issue+pay pairs through REAL node processes
     # (cordform network, TCP brokers, bridges, validating notary) — the

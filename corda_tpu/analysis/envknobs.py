@@ -112,6 +112,9 @@ _ENTRIES = [
        "0 disables the native ECDSA host-dispatch path"),
     _k("CORDA_TPU_NATIVE_CODEC", "1", "docs/perf-host.md",
        "0 disables the native codec fast path"),
+    _k("CORDA_TPU_PUMP_NATIVE", "1", "docs/perf-system.md",
+       "0 disables the GIL-releasing native pump core (batch wire "
+       "framing/parsing, header-only routing)"),
     # -- notary / sharding (PR 8) -------------------------------------------
     _k("CORDA_TPU_NOTARY_COALESCE", "1", "docs/perf-system.md",
        "0 disables notary commit coalescing"),

@@ -34,11 +34,15 @@ DEFAULT_THRESHOLD = 0.20
 MIN_COMPARABLE = 1e-6
 
 _HIGHER = re.compile(
-    r"(_sigs_s|_commits_s|_pairs_s|_items_s|_per_sec|_rate|throughput"
+    r"(_sigs_s|_commits_s|_pairs_s|_items_s|_msgs_s|_per_sec|_rate"
+    r"|throughput"
     # the pipeline A/B's overlap keys (docs/perf-pipeline.md): more
     # prehash hidden behind dispatch is better, so a shrinking ratio is
     # the regression direction
-    r"|_overlap_ratio|_hidden_pct)$"
+    r"|_overlap_ratio|_hidden_pct"
+    # the codec/pump batch A/B (docs/perf-system.md round 16): a
+    # shrinking native-vs-python speedup is the regression direction
+    r"|_speedup_x)$"
 )
 _LOWER = re.compile(r"(_ms|_us|_s)$")
 _LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")

@@ -727,3 +727,142 @@ def measure_bls_aggregate_ab(n: int = 64,
             naive_wall / max(agg_wall, 1e-9), 1
         ),
     }
+
+
+def measure_codec_batch(n: int = 2000):
+    """Native batch codec vs pure-Python fast path A/B (ISSUE 12, the
+    round-11 GIL-convoy lever): encode n hot-wire-shape objects through
+    serialize_many (ONE native call, GIL released around the framing)
+    and through the pure-Python per-object fast path, asserting byte
+    parity. `codec_batch_native_us_per_obj` and the speedup ride
+    bench.py's regression gate; the ≥3x acceptance line in ISSUE 12
+    compares these two keys."""
+    import time
+
+    from ..core.crypto import crypto
+    from ..core.identity import Party
+    from ..core.serialization import codec
+
+    kp = crypto.entropy_to_keypair(12)
+    me = Party("O=CodecBench,L=London,C=GB", kp.public)
+    sig = crypto.do_sign(kp.private, b"codec batch probe")
+    from ..core.crypto.signing import DigitalSignatureWithKey
+
+    objs = [
+        {
+            "seq": i,
+            "route": f"w{i % 4}-session-{i}:1",
+            "sig": DigitalSignatureWithKey(bytes=sig, by=kp.public),
+            "body": bytes(96),
+            "tags": [1, 2, "x", None],
+        }
+        for i in range(n)
+    ]
+    codec.serialize(objs[0])  # warm the per-type encoder caches
+
+    def best_of(fn, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+
+    batch_frames, batch_wall = best_of(lambda: codec.serialize_many(objs))
+
+    saved = codec._native_codec
+    codec._native_codec = None  # force the pure-Python fast path
+    try:
+        py_frames, py_wall = best_of(
+            lambda: [codec.serialize(o) for o in objs]
+        )
+    finally:
+        codec._native_codec = saved
+    assert [bytes(f) for f in batch_frames] == py_frames, (
+        "batch codec output diverged from the pure-Python fast path"
+    )
+
+    frames = [bytes(f) for f in batch_frames]
+    _, dec_wall = best_of(lambda: codec.deserialize_many(frames))
+
+    native = codec._native_codec is not None and hasattr(
+        codec._native_codec, "encode_many"
+    )
+    return {
+        "codec_batch_n": n,
+        "codec_batch_native": native,
+        "codec_batch_native_us_per_obj": round(batch_wall / n * 1e6, 3),
+        "codec_batch_python_us_per_obj": round(py_wall / n * 1e6, 3),
+        "codec_batch_speedup_x": round(py_wall / max(batch_wall, 1e-9), 2),
+        "codec_batch_decode_us_per_obj": round(dec_wall / n * 1e6, 3),
+    }
+
+
+def measure_pump_drain(n_msgs: int = 2000, payload_len: int = 1024,
+                       batch: int = 64):
+    """End-to-end message-plane drain rate over the REAL wire layer
+    (ISSUE 12): a Broker behind a BrokerServer socket, a RemoteBroker
+    producer pushing send_many batches, and a RemoteConsumer draining
+    receive_many/ack — the exact pump hot path of a sharded node's
+    workers. One drain cycle is one native frame/parse call when the
+    pump core is built (pumpcore.stats deltas prove O(1) calls/drain);
+    `pump_drain_msgs_s` rides the regression gate higher-is-better."""
+    import threading
+    import time
+
+    from ..messaging import pumpcore
+    from ..messaging.broker import Broker
+    from ..messaging.net import BrokerServer, RemoteBroker
+
+    broker = Broker()
+    broker.create_queue("pump.bench")
+    server = BrokerServer(broker).start()
+    payload = bytes(payload_len)
+    try:
+        remote = RemoteBroker("127.0.0.1", server.port)
+        consumer = remote.create_consumer("pump.bench", prefetch=batch)
+        done = threading.Event()
+        drained = 0
+
+        def drain() -> None:
+            nonlocal drained
+            while drained < n_msgs:
+                msg = consumer.receive(timeout=2.0)
+                if msg is None:
+                    break
+                consumer.ack(msg)
+                drained += 1
+            done.set()
+
+        t = threading.Thread(target=drain, name="pump-bench-drain",
+                             daemon=True)
+        stats0 = pumpcore.stats()
+        t0 = time.perf_counter()
+        t.start()
+        for start in range(0, n_msgs, batch):
+            items = [
+                ("pump.bench", payload, {"topic": "bench", "seq": str(i)})
+                for i in range(start, min(start + batch, n_msgs))
+            ]
+            remote.send_many(items)
+        done.wait(timeout=30)
+        wall = time.perf_counter() - t0
+        stats1 = pumpcore.stats()
+        consumer.close()
+        remote.close()
+    finally:
+        server.stop()
+        broker.close()
+    assert drained == n_msgs, f"pump drain lost messages: {drained}/{n_msgs}"
+    native_calls = sum(
+        stats1.get(k, 0) - stats0.get(k, 0)
+        for k in stats1
+        if k.endswith("_native")
+    )
+    return {
+        "pump_drain_n": n_msgs,
+        "pump_drain_payload": payload_len,
+        "pump_drain_native": pumpcore.native_active(),
+        "pump_drain_msgs_s": round(n_msgs / wall, 1),
+        "pump_drain_native_calls": native_calls,
+    }

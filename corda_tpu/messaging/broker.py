@@ -69,6 +69,14 @@ class Message:
     `message_id` is assigned by the broker and is the dedup key
     (reference: `processedMessages` dedup, `NodeMessagingClient.kt:146-157`).
     `delivery_count` > 1 marks a redelivery after a consumer died.
+
+    `payload` is BYTES-LIKE, not necessarily bytes: the zero-copy
+    framing plane (messaging/pumpcore.py) delivers memoryview slices
+    over a per-drain wire arena, which the codec decodes through the
+    buffer protocol without an intermediate copy. Consumers that need
+    real bytes (hash keys, concatenation) snapshot with ``bytes()``;
+    the durable journal snapshots at its append — the one durability
+    boundary where a copy is taken.
     """
     payload: bytes
     headers: Dict[str, str] = field(default_factory=dict)
@@ -103,11 +111,17 @@ class _Journal:
 
     def append_enqueue(self, msg: Message) -> None:
         hdr_blob = _encode_headers(msg.headers)
+        payload = msg.payload
+        if not isinstance(payload, bytes):
+            # the durability boundary: a zero-copy arena view must be
+            # snapshotted here — the arena dies with its drain cycle,
+            # the journal record must not
+            payload = bytes(payload)
         body = (
             msg.message_id.encode("ascii")
             + struct.pack(">I", len(hdr_blob))
             + hdr_blob
-            + msg.payload
+            + payload
         )
         self._append(_REC_ENQUEUE, body)
 

@@ -35,6 +35,7 @@ from collections import deque
 from ..utils import lockorder
 from typing import Callable, Dict, Optional, Tuple
 
+from . import pumpcore
 from .broker import (
     Broker,
     BrokerError,
@@ -107,6 +108,8 @@ def _unpack_str(body: bytes, pos: int) -> Tuple[str, int]:
 
 
 def _pack_bytes(b: bytes) -> bytes:
+    if not isinstance(b, bytes):
+        b = bytes(b)  # zero-copy payload views snapshot at the wire
     return struct.pack(">I", len(b)) + b
 
 
@@ -188,17 +191,20 @@ class _ClientHandler(socketserver.BaseRequestHandler):
             # One round trip for a whole batch: the store-and-forward
             # bridge's throughput is bounded by round trips per message
             # (~2-4 ms each under load, profiled round 3), so it drains
-            # its queue into one of these frames.
-            (count,) = struct.unpack_from(">I", body, 1)
-            pos = 5
-            items = []
-            for _ in range(count):
-                name, pos = _unpack_str(body, pos)
-                hdr_blob, pos = _unpack_bytes(body, pos)
-                payload, pos = _unpack_bytes(body, pos)
-                items.append((name, payload, _decode_headers(hdr_blob)))
+            # its queue into one of these frames. The parse is ONE
+            # GIL-releasing native call. Payloads are SNAPSHOTTED at
+            # the enqueue boundary: a queued message's residence is
+            # unbounded (backlog, dead worker), and a view would pin
+            # its whole multi-message request arena for that long — a
+            # 64x RSS amplification under exactly the overload that
+            # makes memory scarce. The receive path keeps its arena
+            # views: their lifetime is one pump drain cycle.
+            items = [
+                (q, bytes(p), h)
+                for q, p, h in pumpcore.parse_send_many(body)
+            ]
             broker.send_many(items)  # one lock acquisition, all-or-nothing
-            return bytes([RE_OK]) + struct.pack(">I", count)
+            return bytes([RE_OK]) + struct.pack(">I", len(items))
         if op == OP_QUEUE_EXISTS:
             name, _ = _unpack_str(body, 1)
             return bytes([RE_OK, 1 if broker.queue_exists(name) else 0])
@@ -269,13 +275,12 @@ class _ClientHandler(socketserver.BaseRequestHandler):
                     if nxt is None:
                         break
                     msgs.append(nxt)
-            out = bytearray(bytes([RE_MSG]) + struct.pack(">I", len(msgs)))
-            for msg in msgs:
-                out += _pack_str(msg.message_id)
-                out += struct.pack(">I", msg.delivery_count)
-                out += _pack_bytes(_encode_headers(msg.headers))
-                out += _pack_bytes(msg.payload)
-            return bytes(out)
+            # one GIL-releasing native call frames the whole drain
+            return pumpcore.frame_msgs(
+                [(m.message_id, m.delivery_count, m.headers, m.payload)
+                 for m in msgs],
+                RE_MSG,
+            )
         if op == OP_CLOSE:
             if consumer is not None:
                 consumer.close()
@@ -403,16 +408,15 @@ class RemoteConsumer:
                 break
             if timeout is not None:
                 return None
-        pos = 5
-        for _ in range(count):
-            mid, pos = _unpack_str(reply, pos)
-            (delivery,) = struct.unpack_from(">I", reply, pos)
-            pos += 4
-            hdr_blob, pos = _unpack_bytes(reply, pos)
-            payload, pos = _unpack_bytes(reply, pos)
+        # one GIL-releasing native call parses the whole drain; payloads
+        # are memoryview slices over `reply` — the per-drain arena — so
+        # no per-message bytes copy happens between wire and codec (the
+        # views keep the arena alive; durable re-journal and re-framing
+        # boundaries snapshot when they must)
+        for mid, delivery, headers, payload in pumpcore.parse_msgs(reply):
             self._buffer.append(Message(
                 payload=payload,
-                headers=_decode_headers(hdr_blob),
+                headers=headers,
                 message_id=mid,
                 delivery_count=delivery,
             ))
@@ -517,12 +521,8 @@ class RemoteBroker:
         applied part of the batch and before the reply means the caller
         retries the whole batch (receiver-side dedup absorbs replays,
         exactly as with a lost single-send reply)."""
-        body = bytearray(bytes([OP_SEND_MANY]) + struct.pack(">I", len(items)))
-        for queue_name, payload, headers in items:
-            body += _pack_str(queue_name)
-            body += _pack_bytes(_encode_headers(dict(headers or {})))
-            body += _pack_bytes(payload)
-        reply = self._control.request(bytes(body))
+        body = pumpcore.frame_send_many(list(items), OP_SEND_MANY)
+        reply = self._control.request(body)
         return struct.unpack_from(">I", reply, 1)[0]
 
     def create_consumer(

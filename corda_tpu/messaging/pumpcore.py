@@ -1,0 +1,252 @@
+"""GIL-escaping message-plane primitives for the broker pumps.
+
+The round-11 profile showed the whole node convoying behind one GIL with
+the broker pump and codec hot path serialising everything else
+(docs/perf-system.md round 11/13). This module is the Python face of
+the native pump core in native/src/codec_ext.c: one drain cycle of the
+p2p pump / EgressPump / ShardRouter / wire layer makes ONE
+GIL-releasing native call for an N-message batch instead of N
+Python-level per-message iterations —
+
+  * ``frame_msgs`` / ``frame_send_many``: build a whole batch frame
+    (the OP_RECEIVE_MANY reply / OP_SEND_MANY request bodies of
+    messaging/net.py) in one call, byte-identical to the Python code
+    they replace;
+  * ``parse_msgs`` / ``parse_send_many``: scan a whole batch frame with
+    the GIL released; payloads come back as MEMORYVIEW SLICES over the
+    input arena (zero-copy framing — the per-drain reply frame IS the
+    arena, and the views keep it alive);
+  * ``parse_headers_many``: extract selected header values
+    (x-session-route / x-dest / traceparent...) from many encoded
+    header blobs without building full dicts or touching payloads;
+  * ``route_hints_many``: the ShardRouter's x-session-route policy
+    (stable-hash + worker-tag) for a whole batch off-GIL.
+
+Every primitive has a pure-Python fallback that is byte-identical (the
+differential suite in tests/test_pumpcore.py pins it), so
+``CORDA_TPU_PUMP_NATIVE=0`` — or a container without a compiler —
+reproduces today's behavior exactly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .broker import _decode_headers, _encode_headers
+
+#: call counters per entry point, split native vs fallback — the
+#: O(1)-native-calls-per-drain tests read deltas of these (GIL-atomic
+#: int adds, the codec._STATS idiom)
+_STATS: Dict[str, int] = {}
+
+
+def stats() -> Dict[str, int]:
+    return dict(_STATS)
+
+
+def _count(key: str) -> None:
+    _STATS[key] = _STATS.get(key, 0) + 1
+
+
+def _load_native():
+    """The codec extension module, or None. The pump primitives ride
+    the codec extension .so (same grammar family, one build surface);
+    CORDA_TPU_PUMP_NATIVE=0 is the pump-plane kill switch, independent
+    of CORDA_TPU_NATIVE_CODEC (which gates object encode/decode)."""
+    if os.environ.get("CORDA_TPU_PUMP_NATIVE", "1") == "0":
+        return None
+    try:
+        from .. import native as _native_pkg
+
+        mod = _native_pkg.codec_extension()
+    except Exception:
+        import logging
+
+        # native/__init__ already eventlogs the classified reason; this
+        # guard only covers an import cycle / torn install
+        logging.getLogger(__name__).warning(
+            "native pump core unavailable", exc_info=True
+        )
+        return None
+    if mod is None or not hasattr(mod, "frame_msgs"):
+        return None
+    return mod
+
+
+_native = _load_native()
+
+
+def native_active() -> bool:
+    return _native is not None
+
+
+def _coerce(b) -> bytes:
+    return b if isinstance(b, bytes) else bytes(b)
+
+
+# --- batch frame building ---------------------------------------------------
+
+def frame_msgs(msgs: Sequence[tuple], lead: int) -> bytes:
+    """``u8 lead | u32 count | per msg: str mid | u32 delivery |
+    bytes hdrblob | bytes payload`` — the OP_RECEIVE_MANY reply body.
+    msgs: [(message_id, delivery_count, headers_dict, payload), ...]."""
+    if _native is not None:
+        _count("frame_msgs_native")
+        return _native.frame_msgs(msgs, lead)
+    _count("frame_msgs_fallback")
+    out = bytearray(bytes([lead]) + struct.pack(">I", len(msgs)))
+    for mid, delivery, headers, payload in msgs:
+        raw = mid.encode()
+        out += struct.pack(">I", len(raw)) + raw
+        out += struct.pack(">I", delivery)
+        blob = _encode_headers(headers or {})
+        out += struct.pack(">I", len(blob)) + blob
+        payload = _coerce(payload)
+        out += struct.pack(">I", len(payload)) + payload
+    return bytes(out)
+
+
+def frame_send_many(items: Sequence[tuple], lead: int) -> bytes:
+    """``u8 lead | u32 count | per item: str queue | bytes hdrblob |
+    bytes payload`` — the OP_SEND_MANY request body. items is the
+    broker.send_many shape: [(queue, payload, headers), ...]."""
+    if _native is not None:
+        _count("frame_send_many_native")
+        return _native.frame_send_many(
+            [(q, p, h if h is None or isinstance(h, dict) else dict(h))
+             for q, p, h in items],
+            lead,
+        )
+    _count("frame_send_many_fallback")
+    out = bytearray(bytes([lead]) + struct.pack(">I", len(items)))
+    for queue_name, payload, headers in items:
+        raw = queue_name.encode()
+        out += struct.pack(">I", len(raw)) + raw
+        blob = _encode_headers(dict(headers or {}))
+        out += struct.pack(">I", len(blob)) + blob
+        payload = _coerce(payload)
+        out += struct.pack(">I", len(payload)) + payload
+    return bytes(out)
+
+
+# --- batch frame parsing (zero-copy payload views) --------------------------
+
+def parse_msgs(reply: bytes) -> List[Tuple[str, int, dict, memoryview]]:
+    """Parse an OP_RECEIVE_MANY reply body into
+    [(message_id, delivery, headers, payload)]. Native path: ONE
+    GIL-released span scan; payloads are memoryviews over `reply` (the
+    per-drain arena — no per-message bytes copies). Fallback payloads
+    are memoryview slices too, so downstream type handling is identical
+    on both paths."""
+    if _native is not None:
+        _count("parse_msgs_native")
+        return _native.parse_msgs(reply)
+    _count("parse_msgs_fallback")
+    mv = memoryview(reply)
+    (count,) = struct.unpack_from(">I", reply, 1)
+    pos, out = 5, []
+    for _ in range(count):
+        (n,) = struct.unpack_from(">I", reply, pos)
+        pos += 4
+        mid = bytes(mv[pos:pos + n]).decode()
+        pos += n
+        (delivery,) = struct.unpack_from(">I", reply, pos)
+        pos += 4
+        (n,) = struct.unpack_from(">I", reply, pos)
+        pos += 4
+        headers = _decode_headers(bytes(mv[pos:pos + n]))
+        pos += n
+        (n,) = struct.unpack_from(">I", reply, pos)
+        pos += 4
+        out.append((mid, delivery, headers, mv[pos:pos + n]))
+        pos += n
+    return out
+
+
+def parse_send_many(body: bytes) -> List[Tuple[str, memoryview, dict]]:
+    """Parse an OP_SEND_MANY request body into the broker.send_many
+    item shape [(queue, payload, headers)], payloads as views over
+    `body` (zero-copy into the queue; the durable journal snapshots at
+    its append — the durability boundary)."""
+    if _native is not None:
+        _count("parse_send_many_native")
+        return _native.parse_send_many(body)
+    _count("parse_send_many_fallback")
+    mv = memoryview(body)
+    (count,) = struct.unpack_from(">I", body, 1)
+    pos, out = 5, []
+    for _ in range(count):
+        (n,) = struct.unpack_from(">I", body, pos)
+        pos += 4
+        queue = bytes(mv[pos:pos + n]).decode()
+        pos += n
+        (n,) = struct.unpack_from(">I", body, pos)
+        pos += 4
+        headers = _decode_headers(bytes(mv[pos:pos + n]))
+        pos += n
+        (n,) = struct.unpack_from(">I", body, pos)
+        pos += 4
+        out.append((queue, mv[pos:pos + n], headers))
+        pos += n
+    return out
+
+
+# --- header-only batch extraction -------------------------------------------
+
+def parse_headers_many(
+    blobs: Sequence[bytes], wanted: Tuple[str, ...]
+) -> List[Tuple[Optional[str], ...]]:
+    """Per blob, the values of `wanted` header names (None = absent) —
+    the header-only routing primitive: no full dicts, no payloads.
+
+    No in-process pump calls this today (the local router/egress drain
+    Messages whose headers are already dicts; the wire layer needs the
+    full dicts it materialises in parse_msgs/parse_send_many). It is
+    the ISSUE-12 seam for a router that consumes RAW wire frames — a
+    remote/bridged shard router extracting x-session-route/x-dest
+    without ever building dicts — kept byte-compatible with
+    broker._encode_headers by the differential suite."""
+    if _native is not None:
+        _count("parse_headers_many_native")
+        return _native.parse_headers_many(list(blobs), tuple(wanted))
+    _count("parse_headers_many_fallback")
+    out = []
+    for blob in blobs:
+        headers = _decode_headers(_coerce(blob))
+        out.append(tuple(headers.get(w) for w in wanted))
+    return out
+
+
+# --- batch session routing ---------------------------------------------------
+
+#: route_hints_many sentinels, mirroring shardhost.route_session_hint:
+#: >=0 worker index; SUPERVISOR = route to the .sup leg; NO_HINT =
+#: absent/malformed hint, caller falls back to payload decode
+SUPERVISOR = -1
+NO_HINT = -2
+
+
+def route_hints_many(
+    hints: Sequence[Optional[str]], n_workers: int
+) -> List[int]:
+    """The x-session-route policy for a whole drain batch in one
+    GIL-releasing call. MUST agree with shardhost.route_session_hint
+    on every input (differentially tested): a retransmit routed by the
+    fallback and the native path must land on the same worker."""
+    if _native is not None:
+        _count("route_hints_many_native")
+        return _native.route_hints_many(list(hints), n_workers)
+    _count("route_hints_many_fallback")
+    from ..node.shardhost import _NO_HINT, route_session_hint
+
+    out = []
+    for hint in hints:
+        k = route_session_hint(hint, n_workers)
+        if k is _NO_HINT:
+            out.append(NO_HINT)
+        elif k is None:
+            out.append(SUPERVISOR)
+        else:
+            out.append(k)
+    return out

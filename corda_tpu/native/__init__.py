@@ -15,9 +15,10 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
@@ -26,6 +27,80 @@ _BUILD = os.path.join(_DIR, "build")
 _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
+
+#: the five native extensions an operator can ask about: the four
+#: ctypes entry-point families linked into corda_native.so plus the
+#: CPython codec extension module
+EXTENSIONS = (
+    "sha2_batch", "journal", "ed25519_msm", "ecdsa_host", "codec_ext",
+)
+
+# ext -> {"available": bool, "reason": Optional[str]}; absent = load
+# not yet attempted (availability() never forces a compile)
+_STATUS: Dict[str, Dict] = {}
+_status_lock = threading.Lock()
+
+
+class BuildError(Exception):
+    """A native build failed with a CLASSIFIED reason (`.reason` is one
+    of no_compiler / compile_error / build_timeout)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+        self.detail = detail
+
+
+def _record_status(ext: str, available: bool, reason: Optional[str]) -> None:
+    """Remember (and report, once) why an extension is or is not
+    usable: silent fallback made 'the node is slow' undiagnosable —
+    now the flight recorder names the missing compiler / compile error
+    / ABI mismatch and Native.Available{ext=...} gauges it."""
+    with _status_lock:
+        prev = _STATUS.get(ext)
+        _STATUS[ext] = {"available": available, "reason": reason}
+        if prev is not None and prev["available"] == available:
+            return  # only the first determination (or a flip) reports
+    try:
+        from ..utils import eventlog
+
+        if available:
+            eventlog.emit(
+                "debug", "native", "native extension loaded", ext=ext,
+            )
+        else:
+            eventlog.emit(
+                "warning", "native",
+                "native extension unavailable; pure-Python fallback",
+                ext=ext, reason=reason or "unknown",
+            )
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "native status emit failed for %s", ext, exc_info=True
+        )
+
+
+def availability() -> Dict[str, Dict]:
+    """Per-extension load status WITHOUT forcing a build: {ext:
+    {"available": bool, "reason": str|None}} for every extension whose
+    load has been attempted; extensions never touched are absent. The
+    Native.Available{ext=...} gauges read this (1/0/-1 untried)."""
+    with _status_lock:
+        return {k: dict(v) for k, v in _STATUS.items()}
+
+
+def _classify_build_exc(exc: Exception, compilers: List[str]) -> BuildError:
+    for c in compilers:
+        if shutil.which(c) is None:
+            return BuildError("no_compiler", f"{c} not found on PATH")
+    if isinstance(exc, subprocess.TimeoutExpired):
+        return BuildError("build_timeout", str(exc))
+    if isinstance(exc, subprocess.CalledProcessError):
+        tail = (exc.stderr or b"")[-800:].decode("utf-8", "replace")
+        return BuildError("compile_error", tail.strip() or str(exc))
+    return BuildError("compile_error", f"{type(exc).__name__}: {exc}")
 
 
 def _build_if_stale(sources, so_path, cmd_prefix) -> None:
@@ -64,6 +139,14 @@ def _build_if_stale(sources, so_path, cmd_prefix) -> None:
             os.unlink(tmp)
 
 
+_LIB_EXTS = ("sha2_batch", "journal", "ed25519_msm", "ecdsa_host")
+
+
+def _mark_lib_exts(available: bool, reason: Optional[str]) -> None:
+    for ext in _LIB_EXTS:
+        _record_status(ext, available, reason)
+
+
 def _compile_and_load() -> Optional[ctypes.CDLL]:
     global _load_failed
     sources = [
@@ -78,6 +161,11 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             sources, so_path,
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"],
         )
+    except Exception as exc:
+        _load_failed = True
+        _mark_lib_exts(False, _classify_build_exc(exc, ["g++"]).reason)
+        return None
+    try:
         lib = ctypes.CDLL(so_path)
         lib.sha256_batch.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
@@ -138,9 +226,18 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_uint64,
         ]
+        _mark_lib_exts(True, None)
         return lib
-    except Exception:
+    except AttributeError as exc:
+        # the .so built but lacks an expected entry point: a stale or
+        # foreign binary (srchash normally prevents this) — report it
+        # as the ABI problem it is, not a generic failure
         _load_failed = True
+        _mark_lib_exts(False, f"missing_symbol: {exc}")
+        return None
+    except Exception as exc:
+        _load_failed = True
+        _mark_lib_exts(False, f"load_error: {type(exc).__name__}: {exc}")
         return None
 
 
@@ -459,13 +556,33 @@ def _compile_and_import_codec():
             ["gcc", "-O2", "-shared", "-fPIC",
              f"-I{sysconfig.get_path('include')}"],
         )
+    except Exception as exc:
+        _codec_failed = True
+        be = _classify_build_exc(exc, ["gcc"])
+        if shutil.which("gcc") is not None and not os.path.exists(
+            os.path.join(sysconfig.get_path("include"), "Python.h")
+        ):
+            be = BuildError("no_python_headers",
+                            "Python.h missing (dev headers not installed)")
+        _record_status("codec_ext", False, be.reason)
+        return None
+    try:
         spec = importlib.util.spec_from_file_location("codec_ext", so_path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        return mod
-    except Exception:
+    except ImportError as exc:
+        # built against a different CPython: undefined PyXxx symbols or
+        # a module-init mismatch surface here as ImportError
         _codec_failed = True
+        _record_status("codec_ext", False, f"abi_mismatch: {exc}")
         return None
+    except Exception as exc:
+        _codec_failed = True
+        _record_status("codec_ext", False,
+                       f"load_error: {type(exc).__name__}: {exc}")
+        return None
+    _record_status("codec_ext", True, None)
+    return mod
 
 
 def codec_extension():
@@ -477,3 +594,34 @@ def codec_extension():
         if _codec_mod is None and not _codec_failed:
             _codec_mod = _compile_and_import_codec()
     return _codec_mod
+
+
+# --- rebuild CLI seam (`python -m corda_tpu.native --build`) ----------------
+
+def build_all(force: bool = False) -> Dict[str, Dict]:
+    """Compile/load every extension NOW and return the per-extension
+    status map (EXTENSIONS keys, availability() values). `force` drops
+    the srchash stamps and binaries first so a clean rebuild runs even
+    when the sources are unchanged."""
+    global _lib, _load_failed, _codec_mod, _codec_failed
+    with _lib_lock:
+        if force and os.path.isdir(_BUILD):
+            for fname in os.listdir(_BUILD):
+                if fname.endswith((".so", ".srchash", ".tmp")):
+                    try:
+                        os.unlink(os.path.join(_BUILD, fname))
+                    except OSError:
+                        pass  # a live .so may be mapped; rebuild replaces it
+        _lib = None
+        _load_failed = False
+        _codec_mod = None
+        _codec_failed = False
+        with _status_lock:
+            _STATUS.clear()
+        _lib = _compile_and_load()
+        _codec_mod = _compile_and_import_codec()
+        _load_failed = _lib is None
+        _codec_failed = _codec_mod is None
+    status = availability()
+    return {ext: status.get(ext, {"available": False, "reason": "untried"})
+            for ext in EXTENSIONS}

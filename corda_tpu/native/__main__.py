@@ -1,0 +1,67 @@
+"""Rebuild CLI for the native extensions: `python -m corda_tpu.native
+--build [--force]`.
+
+Compiles all five extensions (the four ctypes families in
+corda_native.so plus the codec_ext CPython module), prints one status
+line per extension, and exits non-zero when a compiler IS present but a
+compile failed — CI can assert the toolchain image actually builds.
+When no compiler is on PATH the skip is a NOTICE, not an error: the
+no-compiler container is a supported deployment (pure-Python
+fallbacks), so exit stays 0.
+"""
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+
+from . import EXTENSIONS, build_all
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m corda_tpu.native",
+        description="build / report the native extensions",
+    )
+    parser.add_argument(
+        "--build", action="store_true",
+        help="compile all extensions now (default action)",
+    )
+    parser.add_argument(
+        "--force", action="store_true",
+        help="drop srchash stamps and binaries first (clean rebuild)",
+    )
+    args = parser.parse_args(argv)
+    if args.force and not args.build:
+        parser.error("--force requires --build")
+
+    status = build_all(force=args.force)
+    compiler_present = (
+        shutil.which("g++") is not None or shutil.which("gcc") is not None
+    )
+    failed = []
+    for ext in EXTENSIONS:
+        entry = status[ext]
+        if entry["available"]:
+            print(f"{ext}: OK")
+            continue
+        reason = entry.get("reason") or "unknown"
+        print(f"{ext}: UNAVAILABLE ({reason})")
+        if not reason.startswith("no_compiler"):
+            failed.append(ext)
+    if failed and compiler_present:
+        print(
+            f"build FAILED for: {', '.join(failed)} (compiler present)",
+            file=sys.stderr,
+        )
+        return 1
+    if not compiler_present:
+        print(
+            "notice: no compiler on PATH; pure-Python fallbacks active",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

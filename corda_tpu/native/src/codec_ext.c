@@ -13,6 +13,8 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 
 /* PyFloat_Pack8/Unpack8 became public API in 3.11; 3.10 ships the same
@@ -661,6 +663,1586 @@ static PyObject *py_decode(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* ======================================================================
+ * Batch entry points: GIL-escaping codec + message-plane primitives.
+ *
+ * Two-phase design shared by every batch function here:
+ *   phase 1 (GIL held)  — a brief reflection pass flattens PyObjects
+ *                         into a write plan (type tags, varint values,
+ *                         borrowed buffer spans) or scans raw frames
+ *                         into a token stream;
+ *   phase 2             — the byte-level framing/parsing runs inside
+ *                         Py_BEGIN_ALLOW_THREADS into/over one arena,
+ *                         so flow, pump, batcher and pipeline threads
+ *                         genuinely overlap on multi-core boxes.
+ * Byte output is pinned identical to the single-shot paths (and the
+ * pure-Python fallbacks) by the differential suites in
+ * tests/test_serialization.py and tests/test_pumpcore.py.
+ * ====================================================================== */
+
+/* ---------------- write plan (encode / framing) ---------------- */
+
+enum { OPK_INL, OPK_MEM };
+
+#define WOP_INL_CAP 22
+
+typedef struct {
+    uint8_t kind;
+    uint8_t ilen;            /* OPK_INL: bytes used in inl[] */
+    char inl[WOP_INL_CAP];   /* small writes coalesce here at plan time */
+    const char *mem;         /* OPK_MEM source (borrowed or plan-owned) */
+    Py_ssize_t len;
+} WOp;
+
+typedef struct {
+    WOp *ops;
+    Py_ssize_t n, cap;
+    int sealed;            /* next small write must start a fresh op */
+    PyObject **keep;       /* owned refs pinning borrowed buffers */
+    Py_ssize_t nkeep, keepcap;
+    char **blobs;          /* PyMem-owned scratch encodings */
+    Py_ssize_t nblobs, blobcap;
+    Py_buffer *views;      /* buffer-protocol views released at the end */
+    Py_ssize_t nviews, viewcap;
+} Plan;
+
+static void plan_init(Plan *p) { memset(p, 0, sizeof(*p)); }
+
+static void plan_clear(Plan *p) {
+    Py_ssize_t i;
+    for (i = 0; i < p->nkeep; i++) Py_DECREF(p->keep[i]);
+    for (i = 0; i < p->nblobs; i++) PyMem_Free(p->blobs[i]);
+    for (i = 0; i < p->nviews; i++) PyBuffer_Release(&p->views[i]);
+    PyMem_Free(p->ops);
+    PyMem_Free(p->keep);
+    PyMem_Free(p->blobs);
+    PyMem_Free(p->views);
+    plan_init(p);
+}
+
+static WOp *plan_op(Plan *p) {
+    if (p->n == p->cap) {
+        Py_ssize_t cap = p->cap ? p->cap * 2 : 64;
+        WOp *ops = PyMem_Realloc(p->ops, (size_t)cap * sizeof(WOp));
+        if (!ops) { PyErr_NoMemory(); return NULL; }
+        p->ops = ops;
+        p->cap = cap;
+    }
+    WOp *op = &p->ops[p->n++];
+    op->ilen = 0; op->mem = NULL; op->len = 0;
+    return op;
+}
+
+/* append small bytes, coalescing into the trailing inline op (one op
+   per ~22 bytes of tags/varints/short names instead of one per write) */
+static int plan_raw(Plan *p, const char *src, int n) {
+    WOp *op = NULL;
+    if (!p->sealed && p->n > 0) {
+        op = &p->ops[p->n - 1];
+        if (op->kind != OPK_INL || op->ilen + n > WOP_INL_CAP) op = NULL;
+    }
+    if (op == NULL) {
+        op = plan_op(p);
+        if (!op) return -1;
+        op->kind = OPK_INL;
+        p->sealed = 0;
+    }
+    memcpy(op->inl + op->ilen, src, (size_t)n);
+    op->ilen = (uint8_t)(op->ilen + n);
+    return 0;
+}
+
+static int plan_byte(Plan *p, unsigned char c) {
+    return plan_raw(p, (const char *)&c, 1);
+}
+
+static int plan_uv(Plan *p, unsigned long long v) {
+    char tmp[10];
+    int n = 0;
+    for (;;) {
+        unsigned char byte = v & 0x7F;
+        v >>= 7;
+        if (v) tmp[n++] = (char)(byte | 0x80);
+        else { tmp[n++] = (char)byte; break; }
+    }
+    return plan_raw(p, tmp, n);
+}
+
+static int plan_u32(Plan *p, unsigned long v) {
+    char tmp[4];
+    tmp[0] = (char)(v >> 24); tmp[1] = (char)(v >> 16);
+    tmp[2] = (char)(v >> 8); tmp[3] = (char)v;
+    return plan_raw(p, tmp, 4);
+}
+
+static int plan_mem(Plan *p, const char *mem, Py_ssize_t len) {
+    if (len <= WOP_INL_CAP) return len ? plan_raw(p, mem, (int)len) : 0;
+    WOp *op = plan_op(p);
+    if (!op) return -1;
+    op->kind = OPK_MEM; op->mem = mem; op->len = len;
+    return 0;
+}
+
+/* force the next small write into a fresh op (value boundaries: the
+   per-value offsets in encode_many index ops, so ops must not span) */
+static void plan_seal(Plan *p) { p->sealed = 1; }
+
+static int plan_keep(Plan *p, PyObject *obj) {
+    if (p->nkeep == p->keepcap) {
+        Py_ssize_t cap = p->keepcap ? p->keepcap * 2 : 16;
+        PyObject **keep = PyMem_Realloc(
+            p->keep, (size_t)cap * sizeof(PyObject *));
+        if (!keep) { PyErr_NoMemory(); return -1; }
+        p->keep = keep;
+        p->keepcap = cap;
+    }
+    Py_INCREF(obj);
+    p->keep[p->nkeep++] = obj;
+    return 0;
+}
+
+/* take ownership of a PyMem buffer and emit it as one MEM op (small
+   blobs copy inline and are freed immediately) */
+static int plan_blob_mem(Plan *p, char *blob, Py_ssize_t len) {
+    if (len <= WOP_INL_CAP) {
+        int rc = len ? plan_raw(p, blob, (int)len) : 0;
+        PyMem_Free(blob);
+        return rc;
+    }
+    if (p->nblobs == p->blobcap) {
+        Py_ssize_t cap = p->blobcap ? p->blobcap * 2 : 16;
+        char **blobs = PyMem_Realloc(p->blobs, (size_t)cap * sizeof(char *));
+        if (!blobs) { PyErr_NoMemory(); PyMem_Free(blob); return -1; }
+        p->blobs = blobs;
+        p->blobcap = cap;
+    }
+    p->blobs[p->nblobs++] = blob;
+    return plan_mem(p, blob, len);
+}
+
+/* borrow a buffer-protocol view (kept open until plan_clear) */
+static int plan_buffer(Plan *p, PyObject *obj,
+                       const char **ptr, Py_ssize_t *len) {
+    if (p->nviews == p->viewcap) {
+        Py_ssize_t cap = p->viewcap ? p->viewcap * 2 : 16;
+        Py_buffer *views = PyMem_Realloc(
+            p->views, (size_t)cap * sizeof(Py_buffer));
+        if (!views) { PyErr_NoMemory(); return -1; }
+        p->views = views;
+        p->viewcap = cap;
+    }
+    Py_buffer *view = &p->views[p->nviews];
+    if (PyObject_GetBuffer(obj, view, PyBUF_SIMPLE) < 0) return -1;
+    p->nviews++;
+    *ptr = view->buf;
+    *len = view->len;
+    return 0;
+}
+
+static Py_ssize_t wop_size(const WOp *op) {
+    return op->kind == OPK_INL ? (Py_ssize_t)op->ilen : op->len;
+}
+
+static Py_ssize_t plan_total(const Plan *p) {
+    Py_ssize_t total = 0, i;
+    for (i = 0; i < p->n; i++) total += wop_size(&p->ops[i]);
+    return total;
+}
+
+/* phase 2: pure byte work — safe without the GIL */
+static void plan_write(const Plan *p, char *dst) {
+    Py_ssize_t i;
+    for (i = 0; i < p->n; i++) {
+        const WOp *op = &p->ops[i];
+        if (op->kind == OPK_INL) {
+            memcpy(dst, op->inl, op->ilen);
+            dst += op->ilen;
+        } else {
+            memcpy(dst, op->mem, (size_t)op->len);
+            dst += op->len;
+        }
+    }
+}
+
+/* ---------------- encode_many: plan one value ---------------- */
+
+static int plan_value(Plan *p, PyObject *value, PyObject *lookup, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_Format(SerializationError, "nesting deeper than %d", MAX_DEPTH);
+        return -1;
+    }
+    if (value == Py_None) return plan_byte(p, TAG_NULL);
+    if (value == Py_True) return plan_byte(p, TAG_TRUE);
+    if (value == Py_False) return plan_byte(p, TAG_FALSE);
+    if (PyLong_Check(value)) {
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(value, &overflow);
+        if (!overflow && v != -1) {
+            unsigned long long zz = v >= 0
+                ? ((unsigned long long)v) << 1
+                : (((unsigned long long)(-(v + 1))) << 1) + 1;
+            if (plan_byte(p, TAG_INT) < 0) return -1;
+            return plan_uv(p, zz);
+        }
+        if (!overflow && PyErr_Occurred()) return -1;
+        if (!overflow) { /* v == -1 genuinely */
+            if (plan_byte(p, TAG_INT) < 0) return -1;
+            return plan_uv(p, 1ULL);
+        }
+        /* bigint: rare — encode GIL-held into a plan-owned blob */
+        Buf tmp;
+        if (buf_init(&tmp, 32) < 0) return -1;
+        if (buf_byte(&tmp, TAG_INT) < 0 || encode_bigint(&tmp, value) < 0) {
+            buf_free(&tmp);
+            return -1;
+        }
+        return plan_blob_mem(p, tmp.data, tmp.len);
+    }
+    if (PyBytes_Check(value)) {
+        if (plan_byte(p, TAG_BYTES) < 0
+            || plan_uv(p, (unsigned long long)PyBytes_GET_SIZE(value)) < 0)
+            return -1;
+        return plan_mem(p, PyBytes_AS_STRING(value), PyBytes_GET_SIZE(value));
+    }
+    if (PyByteArray_Check(value) || PyMemoryView_Check(value)) {
+        const char *ptr; Py_ssize_t n;
+        if (plan_buffer(p, value, &ptr, &n) < 0) {
+            /* non-contiguous view: fall back to a snapshot copy, like
+               the single-shot path's bytes(value) */
+            PyErr_Clear();
+            PyObject *raw = PyBytes_FromObject(value);
+            if (!raw) return -1;
+            if (plan_keep(p, raw) < 0) { Py_DECREF(raw); return -1; }
+            Py_DECREF(raw);
+            ptr = PyBytes_AS_STRING(raw);
+            n = PyBytes_GET_SIZE(raw);
+        }
+        if (plan_byte(p, TAG_BYTES) < 0
+            || plan_uv(p, (unsigned long long)n) < 0)
+            return -1;
+        return plan_mem(p, ptr, n);
+    }
+    if (PyUnicode_Check(value)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(value, &n);
+        if (!s) return -1;
+        if (plan_byte(p, TAG_STR) < 0
+            || plan_uv(p, (unsigned long long)n) < 0)
+            return -1;
+        return plan_mem(p, s, n);
+    }
+    if (PyFloat_Check(value)) {
+        double d = PyFloat_AS_DOUBLE(value);
+        if (d != d || (d == 0.0 && copysign(1.0, d) < 0)) {
+            PyErr_SetString(SerializationError,
+                            "NaN and -0.0 are not canonical");
+            return -1;
+        }
+        char be[8];
+        if (PyFloat_Pack8(d, be, 0) < 0) return -1;
+        if (plan_byte(p, TAG_F64) < 0) return -1;
+        return plan_raw(p, be, 8);
+    }
+    if (PyList_Check(value) || PyTuple_Check(value)) {
+        PyObject *fast = PySequence_Fast(value, "list");
+        if (!fast) return -1;
+        if (plan_keep(p, fast) < 0) { Py_DECREF(fast); return -1; }
+        Py_DECREF(fast);
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+        if (plan_byte(p, TAG_LIST) < 0
+            || plan_uv(p, (unsigned long long)n) < 0)
+            return -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (plan_value(p, PySequence_Fast_GET_ITEM(fast, i), lookup,
+                           depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (PyDict_Check(value)) {
+        /* map entries sort by ENCODED bytes, so they are encoded
+           GIL-held (the existing recursive encoder) and ride the plan
+           as owned blobs — the hot wire shapes are OBJ/LIST heavy and
+           never hit this */
+        Py_ssize_t n = PyDict_Size(value);
+        if (plan_byte(p, TAG_MAP) < 0
+            || plan_uv(p, (unsigned long long)n) < 0)
+            return -1;
+        Pair *pairs = PyMem_Calloc(n ? (size_t)n : 1, sizeof(Pair));
+        if (!pairs) { PyErr_NoMemory(); return -1; }
+        Py_ssize_t i = 0, pos = 0;
+        PyObject *k, *v;
+        int rc = 0;
+        while (PyDict_Next(value, &pos, &k, &v)) {
+            if (encode_to_blob(k, lookup, depth + 1, &pairs[i].kb,
+                               &pairs[i].klen) < 0
+                || encode_to_blob(v, lookup, depth + 1, &pairs[i].vb,
+                                  &pairs[i].vlen) < 0) {
+                rc = -1;
+                break;
+            }
+            i++;
+        }
+        if (rc == 0) {
+            qsort(pairs, (size_t)i, sizeof(Pair), pair_cmp);
+            for (Py_ssize_t j = 0; j < i && rc == 0; j++) {
+                if (plan_blob_mem(p, pairs[j].kb, pairs[j].klen) < 0) {
+                    pairs[j].kb = NULL;  /* ownership attempt consumed it */
+                    rc = -1;
+                    break;
+                }
+                pairs[j].kb = NULL;  /* plan owns it now */
+                if (plan_blob_mem(p, pairs[j].vb, pairs[j].vlen) < 0) {
+                    pairs[j].vb = NULL;
+                    rc = -1;
+                    break;
+                }
+                pairs[j].vb = NULL;
+            }
+        }
+        for (Py_ssize_t j = 0; j < n; j++) {
+            PyMem_Free(pairs[j].kb);
+            PyMem_Free(pairs[j].vb);
+        }
+        PyMem_Free(pairs);
+        return rc;
+    }
+    if (PySet_Check(value) || PyFrozenSet_Check(value)) {
+        Py_ssize_t n = PySet_Size(value);
+        if (plan_byte(p, TAG_LIST) < 0
+            || plan_uv(p, (unsigned long long)n) < 0)
+            return -1;
+        Blob *blobs = PyMem_Malloc(sizeof(Blob) * (n ? n : 1));
+        if (!blobs) { PyErr_NoMemory(); return -1; }
+        PyObject *it = PyObject_GetIter(value);
+        if (!it) { PyMem_Free(blobs); return -1; }
+        Py_ssize_t i = 0;
+        int rc = 0;
+        PyObject *item;
+        while ((item = PyIter_Next(it)) != NULL) {
+            rc = encode_to_blob(item, lookup, depth + 1, &blobs[i].data,
+                                &blobs[i].len);
+            Py_DECREF(item);
+            if (rc < 0) break;
+            i++;
+        }
+        Py_DECREF(it);
+        if (rc == 0 && PyErr_Occurred()) rc = -1;
+        if (rc == 0) {
+            qsort(blobs, (size_t)i, sizeof(Blob), blob_cmp);
+            for (Py_ssize_t j = 0; j < i && rc == 0; j++) {
+                if (plan_blob_mem(p, blobs[j].data, blobs[j].len) < 0) rc = -1;
+                blobs[j].data = NULL;
+            }
+        }
+        for (Py_ssize_t j = 0; j < i; j++) PyMem_Free(blobs[j].data);
+        PyMem_Free(blobs);
+        return rc;
+    }
+    /* registered type: one Python round trip for (name, fields) */
+    {
+        PyObject *res = PyObject_CallFunctionObjArgs(lookup, value, NULL);
+        if (!res) return -1;
+        if (res == Py_None) {
+            Py_DECREF(res);
+            PyErr_Format(SerializationError,
+                         "type %.200s is not @corda_serializable/registered",
+                         Py_TYPE(value)->tp_name);
+            return -1;
+        }
+        if (plan_keep(p, res) < 0) { Py_DECREF(res); return -1; }
+        Py_DECREF(res);  /* plan holds it */
+        PyObject *name = PyTuple_GetItem(res, 0);   /* borrowed */
+        PyObject *fields = PyTuple_GetItem(res, 1); /* borrowed */
+        if (!name || !fields || !PyUnicode_Check(name)
+            || !PyDict_Check(fields)) {
+            PyErr_SetString(SerializationError, "bad lookup result");
+            return -1;
+        }
+        Py_ssize_t nlen;
+        const char *nraw = PyUnicode_AsUTF8AndSize(name, &nlen);
+        if (!nraw) return -1;
+        if (plan_byte(p, TAG_OBJ) < 0
+            || plan_uv(p, (unsigned long long)nlen) < 0
+            || plan_mem(p, nraw, nlen) < 0
+            || plan_uv(p, (unsigned long long)PyDict_Size(fields)) < 0)
+            return -1;
+        PyObject *keys = PyDict_Keys(fields);
+        if (!keys || PyList_Sort(keys) < 0) {
+            Py_XDECREF(keys);
+            return -1;
+        }
+        if (plan_keep(p, keys) < 0) { Py_DECREF(keys); return -1; }
+        Py_DECREF(keys);
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(keys); i++) {
+            PyObject *fn = PyList_GET_ITEM(keys, i);
+            Py_ssize_t fl;
+            const char *fraw = PyUnicode_AsUTF8AndSize(fn, &fl);
+            if (!fraw) return -1;
+            PyObject *fv = PyDict_GetItem(fields, fn); /* borrowed */
+            if (!fv) return -1;
+            if (plan_uv(p, (unsigned long long)fl) < 0
+                || plan_mem(p, fraw, fl) < 0
+                || plan_value(p, fv, lookup, depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+}
+
+static PyObject *py_encode_many(PyObject *self, PyObject *args) {
+    PyObject *values, *lookup, *magic;
+    if (!PyArg_ParseTuple(args, "OOO", &values, &lookup, &magic)) return NULL;
+    char *mp; Py_ssize_t mn;
+    if (PyBytes_AsStringAndSize(magic, &mp, &mn) < 0) return NULL;
+    PyObject *fast = PySequence_Fast(values, "encode_many expects a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Plan plan;
+    plan_init(&plan);
+    if (plan_keep(&plan, fast) < 0 || plan_keep(&plan, magic) < 0) {
+        Py_DECREF(fast);
+        plan_clear(&plan);
+        return NULL;
+    }
+    Py_DECREF(fast);
+    Py_ssize_t *bounds = PyMem_Malloc((size_t)(n + 1) * sizeof(Py_ssize_t));
+    if (!bounds) {
+        plan_clear(&plan);
+        return PyErr_NoMemory();
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        plan_seal(&plan);  /* ops must not span value boundaries */
+        bounds[i] = plan.n;
+        if (plan_mem(&plan, mp, mn) < 0
+            || plan_value(&plan, PySequence_Fast_GET_ITEM(fast, i),
+                          lookup, 0) < 0) {
+            PyMem_Free(bounds);
+            plan_clear(&plan);
+            return NULL;
+        }
+    }
+    bounds[n] = plan.n;
+    /* byte offset of each value's first op */
+    PyObject *offsets = PyTuple_New(n + 1);
+    if (!offsets) {
+        PyMem_Free(bounds);
+        plan_clear(&plan);
+        return NULL;
+    }
+    Py_ssize_t acc = 0, vi = 0;
+    for (Py_ssize_t i = 0; i <= plan.n; i++) {
+        while (vi <= n && bounds[vi] == i) {
+            PyObject *num = PyLong_FromSsize_t(acc);
+            if (!num) {
+                Py_DECREF(offsets);
+                PyMem_Free(bounds);
+                plan_clear(&plan);
+                return NULL;
+            }
+            PyTuple_SET_ITEM(offsets, vi, num);
+            vi++;
+        }
+        if (i == plan.n) break;
+        acc += wop_size(&plan.ops[i]);
+    }
+    PyMem_Free(bounds);
+    PyObject *arena = PyBytes_FromStringAndSize(NULL, acc);
+    if (!arena) {
+        Py_DECREF(offsets);
+        plan_clear(&plan);
+        return NULL;
+    }
+    char *dst = PyBytes_AS_STRING(arena);
+    Py_BEGIN_ALLOW_THREADS
+    plan_write(&plan, dst);
+    Py_END_ALLOW_THREADS
+    plan_clear(&plan);
+    return Py_BuildValue("(NN)", arena, offsets);
+}
+
+/* ---------------- decode_many: token scan + materialize ---------------- */
+
+enum {
+    DERR_OK = 0, DERR_TRUNC_VARINT, DERR_VARINT_LONG, DERR_LEN_LARGE,
+    DERR_TRUNC_VALUE, DERR_TRUNC_BYTES, DERR_TRUNC_STR, DERR_TRUNC_FLOAT,
+    DERR_TRUNC_NAME, DERR_TRUNC_FIELD, DERR_DEPTH, DERR_UNKNOWN_TAG,
+    DERR_BAD_MAGIC, DERR_TRAILING, DERR_NOMEM
+};
+
+#define T_FNAME 100
+#define T_FCOUNT 101
+#define DF_BIG 1
+
+typedef struct {
+    uint8_t tag;
+    uint8_t flags;
+    uint64_t num;    /* zigzag int / length / count */
+    Py_ssize_t off;  /* span start for STR/BYTES/F64/OBJ-name/bigint */
+} DTok;
+
+typedef struct {
+    DTok *toks;          /* raw malloc: grows without the GIL */
+    Py_ssize_t n, cap;
+    Py_ssize_t err_extra;
+} Scan;
+
+static DTok *scan_tok(Scan *sc) {
+    if (sc->n == sc->cap) {
+        Py_ssize_t cap = sc->cap ? sc->cap * 2 : 256;
+        DTok *toks = realloc(sc->toks, (size_t)cap * sizeof(DTok));
+        if (!toks) return NULL;
+        sc->toks = toks;
+        sc->cap = cap;
+    }
+    DTok *t = &sc->toks[sc->n++];
+    t->flags = 0; t->num = 0; t->off = 0;
+    return t;
+}
+
+/* GIL-free uvarint: exact for values < 2^64, flags larger ones for a
+   GIL-held PyLong re-parse (zero-padded SMALL varints stay exact, so
+   the padded-varint consensus semantics match the Python decoder) */
+static int scan_uvarint(const unsigned char *d, Py_ssize_t len,
+                        Py_ssize_t *pos, uint64_t *out, int *big,
+                        Py_ssize_t *span) {
+    uint64_t result = 0;
+    int shift = 0, overflow = 0;
+    Py_ssize_t start = *pos;
+    for (;;) {
+        if (*pos >= len) return DERR_TRUNC_VARINT;
+        unsigned char byte = d[(*pos)++];
+        uint64_t bits = byte & 0x7F;
+        if (bits) {
+            if (shift >= 64) overflow = 1;
+            else if (shift > 57 && (bits >> (64 - shift)) != 0) overflow = 1;
+            else result |= bits << shift;
+        }
+        if (!(byte & 0x80)) break;
+        shift += 7;
+        if (shift > 640) return DERR_VARINT_LONG;
+    }
+    *out = result;
+    *big = overflow;
+    if (span) *span = *pos - start;
+    return 0;
+}
+
+static int scan_len(const unsigned char *d, Py_ssize_t len, Py_ssize_t *pos,
+                    Py_ssize_t *out) {
+    uint64_t v;
+    int big;
+    int rc = scan_uvarint(d, len, pos, &v, &big, NULL);
+    if (rc) return rc;
+    if (big || v > (uint64_t)PY_SSIZE_T_MAX) return DERR_LEN_LARGE;
+    *out = (Py_ssize_t)v;
+    return 0;
+}
+
+static int scan_value(Scan *sc, const unsigned char *d, Py_ssize_t len,
+                      Py_ssize_t *pos, int depth) {
+    if (depth > MAX_DEPTH) return DERR_DEPTH;
+    if (*pos >= len) return DERR_TRUNC_VALUE;
+    unsigned char tag = d[(*pos)++];
+    DTok *t;
+    switch (tag) {
+    case TAG_NULL: case TAG_TRUE: case TAG_FALSE:
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = tag;
+        return 0;
+    case TAG_INT: {
+        uint64_t v;
+        int big;
+        Py_ssize_t start = *pos, span;
+        int rc = scan_uvarint(d, len, pos, &v, &big, &span);
+        if (rc) return rc;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = TAG_INT;
+        if (big) { t->flags = DF_BIG; t->off = start; t->num = (uint64_t)span; }
+        else t->num = v;
+        return 0;
+    }
+    case TAG_BYTES: case TAG_STR: {
+        Py_ssize_t n;
+        int rc = scan_len(d, len, pos, &n);
+        if (rc) return rc;
+        if (n > len - *pos)
+            return tag == TAG_BYTES ? DERR_TRUNC_BYTES : DERR_TRUNC_STR;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = tag; t->num = (uint64_t)n; t->off = *pos;
+        *pos += n;
+        return 0;
+    }
+    case TAG_F64:
+        if (*pos + 8 > len) return DERR_TRUNC_FLOAT;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = TAG_F64; t->off = *pos;
+        *pos += 8;
+        return 0;
+    case TAG_LIST: {
+        Py_ssize_t n;
+        int rc = scan_len(d, len, pos, &n);
+        if (rc) return rc;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = TAG_LIST; t->num = (uint64_t)n;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            rc = scan_value(sc, d, len, pos, depth + 1);
+            if (rc) return rc;
+        }
+        return 0;
+    }
+    case TAG_MAP: {
+        Py_ssize_t n;
+        int rc = scan_len(d, len, pos, &n);
+        if (rc) return rc;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = TAG_MAP; t->num = (uint64_t)n;
+        for (Py_ssize_t i = 0; i < 2 * n; i++) {
+            rc = scan_value(sc, d, len, pos, depth + 1);
+            if (rc) return rc;
+        }
+        return 0;
+    }
+    case TAG_OBJ: {
+        Py_ssize_t nlen;
+        int rc = scan_len(d, len, pos, &nlen);
+        if (rc) return rc;
+        if (nlen > len - *pos) return DERR_TRUNC_NAME;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = TAG_OBJ; t->num = (uint64_t)nlen; t->off = *pos;
+        *pos += nlen;
+        Py_ssize_t fcount;
+        rc = scan_len(d, len, pos, &fcount);
+        if (rc) return rc;
+        t = scan_tok(sc);
+        if (!t) return DERR_NOMEM;
+        t->tag = T_FCOUNT; t->num = (uint64_t)fcount;
+        for (Py_ssize_t i = 0; i < fcount; i++) {
+            Py_ssize_t fl;
+            rc = scan_len(d, len, pos, &fl);
+            if (rc) return rc;
+            if (fl > len - *pos) return DERR_TRUNC_FIELD;
+            t = scan_tok(sc);
+            if (!t) return DERR_NOMEM;
+            t->tag = T_FNAME; t->num = (uint64_t)fl; t->off = *pos;
+            *pos += fl;
+            rc = scan_value(sc, d, len, pos, depth + 1);
+            if (rc) return rc;
+        }
+        return 0;
+    }
+    default:
+        sc->err_extra = tag;
+        return DERR_UNKNOWN_TAG;
+    }
+}
+
+static void derr_raise(int err, Py_ssize_t extra) {
+    switch (err) {
+    case DERR_TRUNC_VARINT:
+        PyErr_SetString(SerializationError, "truncated varint"); break;
+    case DERR_VARINT_LONG:
+        PyErr_SetString(SerializationError, "varint too long"); break;
+    case DERR_LEN_LARGE:
+        PyErr_SetString(SerializationError, "length varint too large"); break;
+    case DERR_TRUNC_VALUE:
+        PyErr_SetString(SerializationError, "truncated value"); break;
+    case DERR_TRUNC_BYTES:
+        PyErr_SetString(SerializationError, "truncated bytes"); break;
+    case DERR_TRUNC_STR:
+        PyErr_SetString(SerializationError, "truncated string"); break;
+    case DERR_TRUNC_FLOAT:
+        PyErr_SetString(SerializationError, "truncated float"); break;
+    case DERR_TRUNC_NAME:
+        PyErr_SetString(SerializationError, "truncated type name"); break;
+    case DERR_TRUNC_FIELD:
+        PyErr_SetString(SerializationError, "truncated field name"); break;
+    case DERR_DEPTH:
+        PyErr_Format(SerializationError, "nesting deeper than %d", MAX_DEPTH);
+        break;
+    case DERR_UNKNOWN_TAG:
+        PyErr_Format(SerializationError, "unknown tag %d", (int)extra);
+        break;
+    case DERR_BAD_MAGIC:
+        PyErr_SetString(SerializationError,
+                        "bad magic / unsupported format version");
+        break;
+    case DERR_TRAILING:
+        PyErr_Format(SerializationError, "%zd trailing bytes", extra);
+        break;
+    case DERR_NOMEM:
+        PyErr_NoMemory();
+        break;
+    default:
+        PyErr_SetString(SerializationError, "decode failed");
+    }
+}
+
+static PyObject *mat_value(const DTok *toks, Py_ssize_t *idx,
+                           const unsigned char *d, PyObject *construct) {
+    const DTok *t = &toks[(*idx)++];
+    switch (t->tag) {
+    case TAG_NULL: Py_RETURN_NONE;
+    case TAG_TRUE: Py_RETURN_TRUE;
+    case TAG_FALSE: Py_RETURN_FALSE;
+    case TAG_INT: {
+        if (t->flags & DF_BIG) {
+            /* > 64-bit varint: re-parse the recorded span with PyLong
+               arithmetic (identical to the single-shot slow path) */
+            Reader r = { d + t->off, (Py_ssize_t)t->num, 0 };
+            unsigned long long v;
+            PyObject *big;
+            if (rd_uvarint(&r, &v, &big) < 0) return NULL;
+            if (!big) {
+                big = PyLong_FromUnsignedLongLong(v);
+                if (!big) return NULL;
+            }
+            PyObject *one = PyLong_FromLong(1);
+            PyObject *half = one ? PyNumber_Rshift(big, one) : NULL;
+            PyObject *lsb = one ? PyNumber_And(big, one) : NULL;
+            PyObject *neg = lsb ? PyNumber_Negative(lsb) : NULL;
+            PyObject *out = (half && neg) ? PyNumber_Xor(half, neg) : NULL;
+            Py_XDECREF(one); Py_XDECREF(half); Py_XDECREF(lsb);
+            Py_XDECREF(neg); Py_DECREF(big);
+            return out;
+        }
+        unsigned long long v = t->num;
+        unsigned long long half = v >> 1;
+        if (v & 1) return PyLong_FromLongLong(-(long long)(half + 1));
+        return PyLong_FromUnsignedLongLong(half);
+    }
+    case TAG_BYTES:
+        return PyBytes_FromStringAndSize(
+            (const char *)d + t->off, (Py_ssize_t)t->num);
+    case TAG_STR:
+        return PyUnicode_DecodeUTF8(
+            (const char *)d + t->off, (Py_ssize_t)t->num, NULL);
+    case TAG_F64: {
+        double v = PyFloat_Unpack8((const char *)d + t->off, 0);
+        if (v == -1.0 && PyErr_Occurred()) return NULL;
+        return PyFloat_FromDouble(v);
+    }
+    case TAG_LIST: {
+        Py_ssize_t n = (Py_ssize_t)t->num;
+        PyObject *out = PyList_New(n);
+        if (!out) return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *item = mat_value(toks, idx, d, construct);
+            if (!item) { Py_DECREF(out); return NULL; }
+            PyList_SET_ITEM(out, i, item);
+        }
+        return out;
+    }
+    case TAG_MAP: {
+        Py_ssize_t n = (Py_ssize_t)t->num;
+        PyObject *out = PyDict_New();
+        if (!out) return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *k = mat_value(toks, idx, d, construct);
+            if (!k) { Py_DECREF(out); return NULL; }
+            if (PyList_Check(k)) {
+                PyObject *tpl = PyList_AsTuple(k);
+                Py_DECREF(k);
+                if (!tpl) { Py_DECREF(out); return NULL; }
+                k = tpl;
+            }
+            PyObject *v = mat_value(toks, idx, d, construct);
+            if (!v || PyDict_SetItem(out, k, v) < 0) {
+                Py_DECREF(k);
+                Py_XDECREF(v);
+                Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v);
+        }
+        return out;
+    }
+    case TAG_OBJ: {
+        PyObject *name = PyUnicode_DecodeUTF8(
+            (const char *)d + t->off, (Py_ssize_t)t->num, NULL);
+        if (!name) return NULL;
+        Py_ssize_t fcount = (Py_ssize_t)toks[(*idx)++].num;  /* T_FCOUNT */
+        PyObject *fields = PyDict_New();
+        if (!fields) { Py_DECREF(name); return NULL; }
+        for (Py_ssize_t i = 0; i < fcount; i++) {
+            const DTok *ft = &toks[(*idx)++];  /* T_FNAME */
+            PyObject *fn = PyUnicode_DecodeUTF8(
+                (const char *)d + ft->off, (Py_ssize_t)ft->num, NULL);
+            if (!fn) { Py_DECREF(name); Py_DECREF(fields); return NULL; }
+            PyObject *fv = mat_value(toks, idx, d, construct);
+            if (!fv || PyDict_SetItem(fields, fn, fv) < 0) {
+                Py_DECREF(fn);
+                Py_XDECREF(fv);
+                Py_DECREF(name);
+                Py_DECREF(fields);
+                return NULL;
+            }
+            Py_DECREF(fn);
+            Py_DECREF(fv);
+        }
+        PyObject *out = PyObject_CallFunctionObjArgs(
+            construct, name, fields, NULL);
+        Py_DECREF(name);
+        Py_DECREF(fields);
+        return out;
+    }
+    default:
+        PyErr_Format(SerializationError, "unknown tag %d", (int)t->tag);
+        return NULL;
+    }
+}
+
+static PyObject *py_decode_many(PyObject *self, PyObject *args) {
+    PyObject *frames, *construct, *magic;
+    if (!PyArg_ParseTuple(args, "OOO", &frames, &construct, &magic)) return NULL;
+    char *mp; Py_ssize_t mn;
+    if (PyBytes_AsStringAndSize(magic, &mp, &mn) < 0) return NULL;
+    PyObject *fast = PySequence_Fast(frames, "decode_many expects a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Py_buffer *views = PyMem_Calloc(n ? (size_t)n : 1, sizeof(Py_buffer));
+    Py_ssize_t *starts = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(Py_ssize_t));
+    if (!views || !starts) {
+        PyMem_Free(views);
+        PyMem_Free(starts);
+        Py_DECREF(fast);
+        return PyErr_NoMemory();
+    }
+    Py_ssize_t got = 0;
+    for (; got < n; got++) {
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(fast, got),
+                               &views[got], PyBUF_SIMPLE) < 0)
+            break;
+    }
+    Scan sc = { NULL, 0, 0, 0 };
+    int err = 0;
+    if (got < n) {
+        err = -1;  /* buffer error already set */
+    } else {
+        Py_BEGIN_ALLOW_THREADS
+        for (Py_ssize_t i = 0; i < n; i++) {
+            starts[i] = sc.n;
+            const unsigned char *d = views[i].buf;
+            Py_ssize_t len = views[i].len;
+            if (len < mn || memcmp(d, mp, (size_t)mn) != 0) {
+                err = DERR_BAD_MAGIC;
+                break;
+            }
+            Py_ssize_t pos = mn;
+            int rc = scan_value(&sc, d, len, &pos, 0);
+            if (!rc && pos != len) {
+                rc = DERR_TRAILING;
+                sc.err_extra = len - pos;
+            }
+            if (rc) { err = rc; break; }
+        }
+        Py_END_ALLOW_THREADS
+        if (err > 0) derr_raise(err, sc.err_extra);
+    }
+    PyObject *result = NULL;
+    if (!err) {
+        result = PyList_New(n);
+        for (Py_ssize_t i = 0; result != NULL && i < n; i++) {
+            Py_ssize_t idx = starts[i];
+            PyObject *obj = mat_value(
+                sc.toks, &idx, views[i].buf, construct);
+            if (!obj) { Py_CLEAR(result); break; }
+            PyList_SET_ITEM(result, i, obj);
+        }
+    }
+    for (Py_ssize_t i = 0; i < got; i++) PyBuffer_Release(&views[i]);
+    PyMem_Free(views);
+    PyMem_Free(starts);
+    free(sc.toks);
+    Py_DECREF(fast);
+    return result;
+}
+
+/* ======================================================================
+ * Native pump core: header-only wire framing/parsing for the broker's
+ * batch protocol (messaging/net.py).  Wire format is pinned identical
+ * to the Python code it replaces:
+ *   send-many body:   u8 op | u32 count | per item:
+ *                     u32 qlen | queue | u32 bloblen | hdrblob
+ *                     | u32 paylen | payload
+ *   receive reply:    u8 re | u32 count | per msg:
+ *                     u32 midlen | mid | u32 delivery | u32 bloblen
+ *                     | hdrblob | u32 paylen | payload
+ *   header blob:      u32 n | per sorted key: u32 klen | key
+ *                     | u32 vlen | value            (broker._encode_headers)
+ * ====================================================================== */
+
+typedef struct {
+    const char *k; Py_ssize_t kl;
+    const char *v; Py_ssize_t vl;
+} HdrPair;
+
+static int hdrpair_cmp(const void *pa, const void *pb) {
+    const HdrPair *a = (const HdrPair *)pa, *b = (const HdrPair *)pb;
+    Py_ssize_t n = a->kl < b->kl ? a->kl : b->kl;
+    int r = memcmp(a->k, b->k, (size_t)n);
+    if (r) return r;
+    if (a->kl != b->kl) return a->kl < b->kl ? -1 : 1;
+    return 0;
+}
+
+/* plan `u32 bloblen | header blob` for one headers dict (or None) */
+static int plan_headers(Plan *p, PyObject *headers) {
+    Py_ssize_t n = 0;
+    HdrPair *pairs = NULL;
+    if (headers != Py_None && headers != NULL) {
+        if (!PyDict_Check(headers)) {
+            PyErr_SetString(PyExc_TypeError, "headers must be a dict or None");
+            return -1;
+        }
+        n = PyDict_Size(headers);
+    }
+    if (n) {
+        pairs = PyMem_Malloc((size_t)n * sizeof(HdrPair));
+        if (!pairs) { PyErr_NoMemory(); return -1; }
+        Py_ssize_t i = 0, pos = 0;
+        PyObject *k, *v;
+        while (PyDict_Next(headers, &pos, &k, &v)) {
+            if (!PyUnicode_Check(k) || !PyUnicode_Check(v)) {
+                PyMem_Free(pairs);
+                PyErr_SetString(PyExc_TypeError,
+                                "header keys and values must be str");
+                return -1;
+            }
+            pairs[i].k = PyUnicode_AsUTF8AndSize(k, &pairs[i].kl);
+            pairs[i].v = PyUnicode_AsUTF8AndSize(v, &pairs[i].vl);
+            if (!pairs[i].k || !pairs[i].v) { PyMem_Free(pairs); return -1; }
+            i++;
+        }
+        /* UTF-8 memcmp == code-point order == Python sorted(headers) */
+        qsort(pairs, (size_t)n, sizeof(HdrPair), hdrpair_cmp);
+    }
+    unsigned long long blob_len = 4;
+    for (Py_ssize_t i = 0; i < n; i++)
+        blob_len += 8 + (unsigned long long)(pairs[i].kl + pairs[i].vl);
+    int rc = 0;
+    if (plan_u32(p, (unsigned long)blob_len) < 0
+        || plan_u32(p, (unsigned long)n) < 0)
+        rc = -1;
+    for (Py_ssize_t i = 0; rc == 0 && i < n; i++) {
+        if (plan_u32(p, (unsigned long)pairs[i].kl) < 0
+            || plan_mem(p, pairs[i].k, pairs[i].kl) < 0
+            || plan_u32(p, (unsigned long)pairs[i].vl) < 0
+            || plan_mem(p, pairs[i].v, pairs[i].vl) < 0)
+            rc = -1;
+    }
+    PyMem_Free(pairs);
+    return rc;
+}
+
+static int plan_str32(Plan *p, PyObject *s) {
+    Py_ssize_t n;
+    const char *raw = PyUnicode_AsUTF8AndSize(s, &n);
+    if (!raw) return -1;
+    if (plan_u32(p, (unsigned long)n) < 0) return -1;
+    return plan_mem(p, raw, n);
+}
+
+static int plan_payload32(Plan *p, PyObject *payload) {
+    const char *ptr; Py_ssize_t n;
+    if (plan_buffer(p, payload, &ptr, &n) < 0) return -1;
+    if (plan_u32(p, (unsigned long)n) < 0) return -1;
+    return plan_mem(p, ptr, n);
+}
+
+static PyObject *plan_to_bytes(Plan *p) {
+    Py_ssize_t total = plan_total(p);
+    PyObject *out = PyBytes_FromStringAndSize(NULL, total);
+    if (!out) return NULL;
+    char *dst = PyBytes_AS_STRING(out);
+    Py_BEGIN_ALLOW_THREADS
+    plan_write(p, dst);
+    Py_END_ALLOW_THREADS
+    return out;
+}
+
+/* frame_msgs(msgs, lead) -> bytes: the OP_RECEIVE_MANY reply body.
+   msgs: sequence of (message_id: str, delivery: int, headers: dict|None,
+   payload: buffer). */
+static PyObject *py_frame_msgs(PyObject *self, PyObject *args) {
+    PyObject *msgs;
+    int lead;
+    if (!PyArg_ParseTuple(args, "Oi", &msgs, &lead)) return NULL;
+    PyObject *fast = PySequence_Fast(msgs, "frame_msgs expects a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Plan plan;
+    plan_init(&plan);
+    if (plan_keep(&plan, fast) < 0) {
+        Py_DECREF(fast);
+        plan_clear(&plan);
+        return NULL;
+    }
+    Py_DECREF(fast);
+    if (plan_byte(&plan, (unsigned char)lead) < 0
+        || plan_u32(&plan, (unsigned long)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 4) {
+            PyErr_SetString(PyExc_TypeError,
+                            "frame_msgs items must be "
+                            "(mid, delivery, headers, payload) tuples");
+            goto fail;
+        }
+        PyObject *mid = PyTuple_GET_ITEM(item, 0);
+        PyObject *delivery = PyTuple_GET_ITEM(item, 1);
+        PyObject *headers = PyTuple_GET_ITEM(item, 2);
+        PyObject *payload = PyTuple_GET_ITEM(item, 3);
+        if (!PyUnicode_Check(mid)) {
+            PyErr_SetString(PyExc_TypeError, "message_id must be str");
+            goto fail;
+        }
+        unsigned long dc = PyLong_AsUnsignedLong(delivery);
+        if (dc == (unsigned long)-1 && PyErr_Occurred()) goto fail;
+        if (plan_str32(&plan, mid) < 0
+            || plan_u32(&plan, dc) < 0
+            || plan_headers(&plan, headers) < 0
+            || plan_payload32(&plan, payload) < 0)
+            goto fail;
+    }
+    {
+        PyObject *out = plan_to_bytes(&plan);
+        plan_clear(&plan);
+        return out;
+    }
+fail:
+    plan_clear(&plan);
+    return NULL;
+}
+
+/* frame_send_many(items, lead) -> bytes: the OP_SEND_MANY request body.
+   items: sequence of (queue: str, payload: buffer, headers: dict|None) —
+   the broker.send_many item shape. */
+static PyObject *py_frame_send_many(PyObject *self, PyObject *args) {
+    PyObject *items;
+    int lead;
+    if (!PyArg_ParseTuple(args, "Oi", &items, &lead)) return NULL;
+    PyObject *fast = PySequence_Fast(
+        items, "frame_send_many expects a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    Plan plan;
+    plan_init(&plan);
+    if (plan_keep(&plan, fast) < 0) {
+        Py_DECREF(fast);
+        plan_clear(&plan);
+        return NULL;
+    }
+    Py_DECREF(fast);
+    if (plan_byte(&plan, (unsigned char)lead) < 0
+        || plan_u32(&plan, (unsigned long)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+            PyErr_SetString(PyExc_TypeError,
+                            "frame_send_many items must be "
+                            "(queue, payload, headers) tuples");
+            goto fail;
+        }
+        PyObject *queue = PyTuple_GET_ITEM(item, 0);
+        PyObject *payload = PyTuple_GET_ITEM(item, 1);
+        PyObject *headers = PyTuple_GET_ITEM(item, 2);
+        if (!PyUnicode_Check(queue)) {
+            PyErr_SetString(PyExc_TypeError, "queue name must be str");
+            goto fail;
+        }
+        if (plan_str32(&plan, queue) < 0
+            || plan_headers(&plan, headers) < 0
+            || plan_payload32(&plan, payload) < 0)
+            goto fail;
+    }
+    {
+        PyObject *out = plan_to_bytes(&plan);
+        plan_clear(&plan);
+        return out;
+    }
+fail:
+    plan_clear(&plan);
+    return NULL;
+}
+
+/* ---------------- batch frame parsing (GIL-released scan) ---------------- */
+
+typedef struct { Py_ssize_t off, len; } Span;
+
+typedef struct {
+    Span mid;            /* or queue name */
+    uint32_t delivery;
+    Span payload;
+    Py_ssize_t hdr_first, hdr_n;   /* indices into the HdrSpan array */
+} MsgSpan;
+
+typedef struct { Span k, v; } HdrSpan;
+
+typedef struct {
+    MsgSpan *msgs; Py_ssize_t nmsgs, msgcap;
+    HdrSpan *hdrs; Py_ssize_t nhdrs, hdrcap;
+} FrameScan;
+
+static int fs_msg(FrameScan *fs) {
+    if (fs->nmsgs == fs->msgcap) {
+        Py_ssize_t cap = fs->msgcap ? fs->msgcap * 2 : 64;
+        MsgSpan *m = realloc(fs->msgs, (size_t)cap * sizeof(MsgSpan));
+        if (!m) return -1;
+        fs->msgs = m; fs->msgcap = cap;
+    }
+    memset(&fs->msgs[fs->nmsgs], 0, sizeof(MsgSpan));
+    fs->nmsgs++;
+    return 0;
+}
+
+static int fs_hdr(FrameScan *fs) {
+    if (fs->nhdrs == fs->hdrcap) {
+        Py_ssize_t cap = fs->hdrcap ? fs->hdrcap * 2 : 256;
+        HdrSpan *h = realloc(fs->hdrs, (size_t)cap * sizeof(HdrSpan));
+        if (!h) return -1;
+        fs->hdrs = h; fs->hdrcap = cap;
+    }
+    fs->nhdrs++;
+    return 0;
+}
+
+static int rd_u32(const unsigned char *d, Py_ssize_t len, Py_ssize_t *pos,
+                  uint32_t *out) {
+    if (*pos + 4 > len) return -1;
+    *out = ((uint32_t)d[*pos] << 24) | ((uint32_t)d[*pos + 1] << 16)
+         | ((uint32_t)d[*pos + 2] << 8) | (uint32_t)d[*pos + 3];
+    *pos += 4;
+    return 0;
+}
+
+static int rd_span(const unsigned char *d, Py_ssize_t len, Py_ssize_t *pos,
+                   Span *out) {
+    uint32_t n;
+    if (rd_u32(d, len, pos, &n) < 0) return -1;
+    if ((Py_ssize_t)n > len - *pos) return -1;
+    out->off = *pos;
+    out->len = (Py_ssize_t)n;
+    *pos += (Py_ssize_t)n;
+    return 0;
+}
+
+/* scan one `u32 bloblen | hdrblob` section into HdrSpans */
+static int scan_hdr_blob(FrameScan *fs, const unsigned char *d,
+                         Py_ssize_t len, Py_ssize_t *pos, MsgSpan *m) {
+    Span blob;
+    if (rd_span(d, len, pos, &blob) < 0) return -1;
+    Py_ssize_t bpos = blob.off, bend = blob.off + blob.len;
+    uint32_t count;
+    if (rd_u32(d, bend, &bpos, &count) < 0) return -1;
+    if ((Py_ssize_t)count > blob.len / 8) return -1;  /* 8 bytes/pair min */
+    m->hdr_first = fs->nhdrs;
+    m->hdr_n = (Py_ssize_t)count;
+    for (uint32_t i = 0; i < count; i++) {
+        if (fs_hdr(fs) < 0) return -2;
+        HdrSpan *h = &fs->hdrs[fs->nhdrs - 1];
+        if (rd_span(d, bend, &bpos, &h->k) < 0
+            || rd_span(d, bend, &bpos, &h->v) < 0)
+            return -1;
+    }
+    return bpos == bend ? 0 : -1;
+}
+
+/* scan the whole batch body; with_mid selects reply (mid+delivery) vs
+   send-many (queue only) framing */
+static int scan_frames(FrameScan *fs, const unsigned char *d, Py_ssize_t len,
+                       int with_mid) {
+    Py_ssize_t pos = 1;  /* skip the op/reply lead byte */
+    uint32_t count;
+    if (len < 5 || rd_u32(d, len, &pos, &count) < 0) return -1;
+    if ((Py_ssize_t)count > len / 12) return -1;  /* 12 bytes/msg min */
+    for (uint32_t i = 0; i < count; i++) {
+        if (fs_msg(fs) < 0) return -2;
+        MsgSpan *m = &fs->msgs[fs->nmsgs - 1];
+        if (rd_span(d, len, &pos, &m->mid) < 0) return -1;
+        if (with_mid) {
+            if (rd_u32(d, len, &pos, &m->delivery) < 0) return -1;
+        }
+        int rc = scan_hdr_blob(fs, d, len, &pos, m);
+        if (rc) return rc;
+        if (rd_span(d, len, &pos, &m->payload) < 0) return -1;
+    }
+    return pos == len ? 0 : -1;
+}
+
+static PyObject *mv_slice(PyObject *mv, Py_ssize_t off, Py_ssize_t len) {
+    PyObject *start = PyLong_FromSsize_t(off);
+    PyObject *stop = PyLong_FromSsize_t(off + len);
+    PyObject *slice = (start && stop) ? PySlice_New(start, stop, NULL) : NULL;
+    Py_XDECREF(start);
+    Py_XDECREF(stop);
+    if (!slice) return NULL;
+    PyObject *out = PyObject_GetItem(mv, slice);
+    Py_DECREF(slice);
+    return out;
+}
+
+static PyObject *hdr_dict(const FrameScan *fs, const MsgSpan *m,
+                          const unsigned char *d) {
+    PyObject *out = PyDict_New();
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < m->hdr_n; i++) {
+        const HdrSpan *h = &fs->hdrs[m->hdr_first + i];
+        PyObject *k = PyUnicode_DecodeUTF8(
+            (const char *)d + h->k.off, h->k.len, NULL);
+        PyObject *v = k ? PyUnicode_DecodeUTF8(
+            (const char *)d + h->v.off, h->v.len, NULL) : NULL;
+        if (!v || PyDict_SetItem(out, k, v) < 0) {
+            Py_XDECREF(k);
+            Py_XDECREF(v);
+            Py_DECREF(out);
+            return NULL;
+        }
+        Py_DECREF(k);
+        Py_DECREF(v);
+    }
+    return out;
+}
+
+/* parse_msgs(reply) / parse_send_many(body): one GIL-released span scan
+   for the whole batch, then minimal materialization — payloads come
+   back as MEMORYVIEW SLICES over the input arena (zero-copy framing;
+   the views keep the arena alive). */
+static PyObject *parse_batch(PyObject *args, int with_mid, const char *who) {
+    PyObject *src;
+    if (!PyArg_ParseTuple(args, "O", &src)) return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(src, &view, PyBUF_SIMPLE) < 0) return NULL;
+    FrameScan fs = { NULL, 0, 0, NULL, 0, 0 };
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = scan_frames(&fs, (const unsigned char *)view.buf, view.len, with_mid);
+    Py_END_ALLOW_THREADS
+    PyObject *result = NULL, *mv = NULL;
+    if (rc == -2) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    if (rc != 0) {
+        PyErr_Format(PyExc_ValueError, "%s: malformed batch frame", who);
+        goto done;
+    }
+    mv = PyMemoryView_FromObject(src);
+    if (!mv) goto done;
+    result = PyList_New(fs.nmsgs);
+    if (!result) goto done;
+    for (Py_ssize_t i = 0; i < fs.nmsgs; i++) {
+        const MsgSpan *m = &fs.msgs[i];
+        const unsigned char *d = view.buf;
+        PyObject *name = PyUnicode_DecodeUTF8(
+            (const char *)d + m->mid.off, m->mid.len, NULL);
+        PyObject *headers = name ? hdr_dict(&fs, m, d) : NULL;
+        PyObject *payload = headers
+            ? mv_slice(mv, m->payload.off, m->payload.len) : NULL;
+        PyObject *tuple = NULL;
+        if (payload) {
+            tuple = with_mid
+                ? Py_BuildValue("(NkNN)", name, (unsigned long)m->delivery,
+                                headers, payload)
+                : Py_BuildValue("(NNN)", name, payload, headers);
+        }
+        if (!tuple) {
+            if (!payload) {  /* Py_BuildValue consumed refs on success */
+                Py_XDECREF(name);
+                Py_XDECREF(headers);
+            }
+            Py_XDECREF(payload);
+            Py_CLEAR(result);
+            break;
+        }
+        PyList_SET_ITEM(result, i, tuple);
+    }
+done:
+    Py_XDECREF(mv);
+    free(fs.msgs);
+    free(fs.hdrs);
+    PyBuffer_Release(&view);
+    return result;
+}
+
+static PyObject *py_parse_msgs(PyObject *self, PyObject *args) {
+    return parse_batch(args, 1, "parse_msgs");
+}
+
+static PyObject *py_parse_send_many(PyObject *self, PyObject *args) {
+    return parse_batch(args, 0, "parse_send_many");
+}
+
+/* parse_headers_many(blobs, wanted) -> list[tuple[str|None, ...]]:
+   extract ONLY the wanted header values from many encoded header blobs
+   in one GIL-released scan — the router/egress fast path never builds
+   full dicts or touches payloads. */
+static PyObject *py_parse_headers_many(PyObject *self, PyObject *args) {
+    PyObject *blobs, *wanted;
+    if (!PyArg_ParseTuple(args, "OO", &blobs, &wanted)) return NULL;
+    PyObject *bfast = PySequence_Fast(blobs, "blobs must be a sequence");
+    if (!bfast) return NULL;
+    PyObject *wfast = PySequence_Fast(wanted, "wanted must be a sequence");
+    if (!wfast) { Py_DECREF(bfast); return NULL; }
+    Py_ssize_t nb = PySequence_Fast_GET_SIZE(bfast);
+    Py_ssize_t nw = PySequence_Fast_GET_SIZE(wfast);
+    const char **wptr = PyMem_Malloc((size_t)(nw ? nw : 1) * sizeof(char *));
+    Py_ssize_t *wlen = PyMem_Malloc(
+        (size_t)(nw ? nw : 1) * sizeof(Py_ssize_t));
+    Py_buffer *views = PyMem_Calloc(nb ? (size_t)nb : 1, sizeof(Py_buffer));
+    /* found[i*nw + j] = value span of wanted[j] in blob i (len -1 = absent) */
+    Span *found = PyMem_Malloc(
+        (size_t)((nb && nw) ? nb * nw : 1) * sizeof(Span));
+    PyObject *result = NULL;
+    Py_ssize_t got = 0;
+    int rc = 0;
+    if (!wptr || !wlen || !views || !found) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t j = 0; j < nw; j++) {
+        PyObject *w = PySequence_Fast_GET_ITEM(wfast, j);
+        if (!PyUnicode_Check(w)) {
+            PyErr_SetString(PyExc_TypeError, "wanted names must be str");
+            goto done;
+        }
+        wptr[j] = PyUnicode_AsUTF8AndSize(w, &wlen[j]);
+        if (!wptr[j]) goto done;
+    }
+    for (; got < nb; got++) {
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(bfast, got),
+                               &views[got], PyBUF_SIMPLE) < 0)
+            goto done;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < nb && rc == 0; i++) {
+        const unsigned char *d = views[i].buf;
+        Py_ssize_t len = views[i].len, pos = 0;
+        for (Py_ssize_t j = 0; j < nw; j++) found[i * nw + j].len = -1;
+        uint32_t count;
+        if (rd_u32(d, len, &pos, &count) < 0
+            || (Py_ssize_t)count > len / 8) {
+            rc = -1;
+            break;
+        }
+        for (uint32_t h = 0; h < count; h++) {
+            Span k, v;
+            if (rd_span(d, len, &pos, &k) < 0
+                || rd_span(d, len, &pos, &v) < 0) {
+                rc = -1;
+                break;
+            }
+            for (Py_ssize_t j = 0; j < nw; j++) {
+                if (k.len == wlen[j]
+                    && memcmp(d + k.off, wptr[j], (size_t)k.len) == 0) {
+                    found[i * nw + j] = v;
+                    break;
+                }
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    if (rc != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "parse_headers_many: malformed header blob");
+        goto done;
+    }
+    result = PyList_New(nb);
+    if (!result) goto done;
+    for (Py_ssize_t i = 0; i < nb; i++) {
+        PyObject *row = PyTuple_New(nw);
+        if (!row) { Py_CLEAR(result); break; }
+        int ok = 1;
+        for (Py_ssize_t j = 0; j < nw; j++) {
+            const Span *v = &found[i * nw + j];
+            PyObject *val;
+            if (v->len < 0) {
+                val = Py_None;
+                Py_INCREF(val);
+            } else {
+                val = PyUnicode_DecodeUTF8(
+                    (const char *)views[i].buf + v->off, v->len, NULL);
+                if (!val) { ok = 0; break; }
+            }
+            PyTuple_SET_ITEM(row, j, val);
+        }
+        if (!ok) { Py_DECREF(row); Py_CLEAR(result); break; }
+        PyList_SET_ITEM(result, i, row);
+    }
+done:
+    for (Py_ssize_t i = 0; i < got; i++) PyBuffer_Release(&views[i]);
+    PyMem_Free(wptr);
+    PyMem_Free(wlen);
+    PyMem_Free(views);
+    PyMem_Free(found);
+    Py_DECREF(bfast);
+    Py_DECREF(wfast);
+    return result;
+}
+
+/* ---------------- route_hints_many: off-GIL session routing ------------- */
+
+/* compact SHA-256 (FIPS 180-4) — must agree bit-for-bit with
+   hashlib.sha256 in shardhost._stable_hash */
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2
+};
+
+#define ROTR32(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha256_block(uint32_t st[8], const unsigned char *blk) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)blk[4 * i] << 24) | ((uint32_t)blk[4 * i + 1] << 16)
+             | ((uint32_t)blk[4 * i + 2] << 8) | (uint32_t)blk[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROTR32(w[i - 15], 7) ^ ROTR32(w[i - 15], 18)
+                    ^ (w[i - 15] >> 3);
+        uint32_t s1 = ROTR32(w[i - 2], 17) ^ ROTR32(w[i - 2], 19)
+                    ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+    uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t s1 = ROTR32(e, 6) ^ ROTR32(e, 11) ^ ROTR32(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + s1 + ch + K256[i] + w[i];
+        uint32_t s0 = ROTR32(a, 2) ^ ROTR32(a, 13) ^ ROTR32(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = s0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+static uint64_t sha256_first8_be(const unsigned char *data, size_t len) {
+    uint32_t st[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19
+    };
+    size_t pos = 0;
+    while (len - pos >= 64) { sha256_block(st, data + pos); pos += 64; }
+    unsigned char tail[128];
+    size_t rem = len - pos;
+    memcpy(tail, data + pos, rem);
+    tail[rem++] = 0x80;
+    size_t blocks = rem <= 56 ? 64 : 128;
+    memset(tail + rem, 0, blocks - 8 - rem);
+    uint64_t bits = (uint64_t)len * 8;
+    for (int i = 0; i < 8; i++)
+        tail[blocks - 1 - i] = (unsigned char)(bits >> (8 * i));
+    sha256_block(st, tail);
+    if (blocks == 128) sha256_block(st, tail + 64);
+    return ((uint64_t)st[0] << 32) | (uint64_t)st[1];
+}
+
+/* route_hints_many(hints, n_workers) -> list[int]: the x-session-route
+   policy of shardhost.route_session_hint for a whole drain batch in one
+   GIL-releasing call.  >=0 worker index, -1 supervisor, -2 no usable
+   hint (caller falls back to payload decode). */
+static PyObject *py_route_hints_many(PyObject *self, PyObject *args) {
+    PyObject *hints;
+    long n_workers;
+    if (!PyArg_ParseTuple(args, "Ol", &hints, &n_workers)) return NULL;
+    if (n_workers <= 0) {
+        PyErr_SetString(PyExc_ValueError, "n_workers must be positive");
+        return NULL;
+    }
+    PyObject *fast = PySequence_Fast(hints, "hints must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    const char **ptrs = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(char *));
+    Py_ssize_t *lens = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(Py_ssize_t));
+    long *out = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(long));
+    if (!ptrs || !lens || !out) {
+        PyMem_Free(ptrs); PyMem_Free(lens); PyMem_Free(out);
+        Py_DECREF(fast);
+        return PyErr_NoMemory();
+    }
+    int fail = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *h = PySequence_Fast_GET_ITEM(fast, i);
+        if (PyUnicode_Check(h)) {
+            ptrs[i] = PyUnicode_AsUTF8AndSize(h, &lens[i]);
+            if (!ptrs[i]) { fail = 1; break; }
+        } else {
+            ptrs[i] = NULL;  /* None / non-str: no usable hint */
+            lens[i] = 0;
+        }
+    }
+    if (fail) {
+        PyMem_Free(ptrs); PyMem_Free(lens); PyMem_Free(out);
+        Py_DECREF(fast);
+        return NULL;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < n; i++) {
+        const char *s = ptrs[i];
+        Py_ssize_t len = lens[i];
+        if (!s || len < 3 || s[1] != ':') { out[i] = -2; continue; }
+        char kind = s[0];
+        const char *sid = s + 2;
+        Py_ssize_t slen = len - 2;
+        if (kind == 'h') {
+            out[i] = (long)(sha256_first8_be(
+                (const unsigned char *)sid, (size_t)slen)
+                % (uint64_t)n_workers);
+        } else if (kind == 't') {
+            /* worker_tag_of: ^w(\d+)- */
+            long tag = -1;
+            if (slen >= 3 && sid[0] == 'w') {
+                uint64_t v = 0;
+                Py_ssize_t j = 1;
+                while (j < slen && sid[j] >= '0' && sid[j] <= '9') {
+                    if (v < (uint64_t)1 << 40) v = v * 10 + (sid[j] - '0');
+                    j++;
+                }
+                if (j > 1 && j < slen && sid[j] == '-') tag = (long)v;
+            }
+            out[i] = (tag >= 0 && tag < n_workers) ? tag : -1;
+        } else {
+            out[i] = -2;
+        }
+    }
+    Py_END_ALLOW_THREADS
+    PyObject *result = PyList_New(n);
+    if (result) {
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *v = PyLong_FromLong(out[i]);
+            if (!v) { Py_CLEAR(result); break; }
+            PyList_SET_ITEM(result, i, v);
+        }
+    }
+    PyMem_Free(ptrs);
+    PyMem_Free(lens);
+    PyMem_Free(out);
+    Py_DECREF(fast);
+    return result;
+}
+
 static PyObject *py_set_error(PyObject *self, PyObject *args) {
     PyObject *exc;
     if (!PyArg_ParseTuple(args, "O", &exc)) return NULL;
@@ -677,6 +2259,25 @@ static PyMethodDef methods[] = {
      "decode(data, construct, magic) -> value"},
     {"set_error", py_set_error, METH_VARARGS,
      "install the SerializationError class raised on failures"},
+    {"encode_many", py_encode_many, METH_VARARGS,
+     "encode_many(values, lookup, magic) -> (arena: bytes, offsets: tuple); "
+     "GIL released around the byte-level framing"},
+    {"decode_many", py_decode_many, METH_VARARGS,
+     "decode_many(frames, construct, magic) -> list; GIL released around "
+     "the byte-level parse"},
+    {"frame_msgs", py_frame_msgs, METH_VARARGS,
+     "frame_msgs([(mid, delivery, headers, payload)], lead) -> bytes"},
+    {"frame_send_many", py_frame_send_many, METH_VARARGS,
+     "frame_send_many([(queue, payload, headers)], lead) -> bytes"},
+    {"parse_msgs", py_parse_msgs, METH_VARARGS,
+     "parse_msgs(reply) -> [(mid, delivery, headers, payload_view)]"},
+    {"parse_send_many", py_parse_send_many, METH_VARARGS,
+     "parse_send_many(body) -> [(queue, payload_view, headers)]"},
+    {"parse_headers_many", py_parse_headers_many, METH_VARARGS,
+     "parse_headers_many(blobs, wanted) -> [tuple[str|None, ...]]"},
+    {"route_hints_many", py_route_hints_many, METH_VARARGS,
+     "route_hints_many(hints, n_workers) -> [int] "
+     "(>=0 worker, -1 supervisor, -2 no hint)"},
     {NULL, NULL, 0, NULL}
 };
 

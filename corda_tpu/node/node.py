@@ -450,6 +450,28 @@ class AbstractNode:
         self.metrics.gauge("Profiler.Samples", _sampler.samples_total)
         self.metrics.gauge("Profiler.Active", _sampler.active_captures)
 
+        # native-extension availability (corda_tpu.native): 1 loaded,
+        # 0 fell back to pure Python (the eventlog names why), -1 load
+        # never attempted in this process — the gauge read must not
+        # trigger a compile, so it only reflects recorded status
+        def native_gauge(ext: str):
+            def read():
+                from .. import native as _native_pkg
+
+                entry = _native_pkg.availability().get(ext)
+                if entry is None:
+                    return -1.0
+                return 1.0 if entry["available"] else 0.0
+
+            return read
+
+        from .. import native as _native_pkg
+
+        for _ext in _native_pkg.EXTENSIONS:
+            self.metrics.gauge(
+                f"Native.Available{{ext={_ext}}}", native_gauge(_ext)
+            )
+
     def _make_transaction_verifier_service(self):
         if self.config.verifier_type == "OutOfProcess":
             if self._broker is None:

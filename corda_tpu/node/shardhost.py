@@ -87,7 +87,12 @@ def rpc_session_secret(identity_entropy: int) -> bytes:
         b"corda-tpu-rpc-session:" + str(int(identity_entropy)).encode()
     ).digest()
 
-_TAG = re.compile(r"^w(\d+)-")
+# ASCII digits ONLY (not \d): tags are generated as f"w{index}-" so
+# only ASCII ever appears, and the native route_hints_many parser is
+# ASCII-only — \d's Unicode-digit acceptance would let a hostile hint
+# like "t:w٣-…" route differently on the native vs fallback path,
+# splitting a session across workers
+_TAG = re.compile(r"^w([0-9]+)-")
 
 
 def worker_queue(node_name: str, index: int) -> str:
@@ -199,6 +204,42 @@ class ShardRouter:
             return supervisor_queue(self.node_name)
         return worker_queue(self.node_name, k)
 
+    def targets_of(self, batch) -> List[str]:
+        """Route a whole drain batch: ONE GIL-releasing native call
+        resolves every hint-carrying session message
+        (pumpcore.route_hints_many — header-only, payloads untouched);
+        only hint-less messages (older senders) fall back to the
+        per-message payload decode. Differentially pinned against
+        target_of: both paths must send a retransmit to the same
+        worker or session dedup breaks."""
+        from ..messaging import pumpcore
+
+        sup = supervisor_queue(self.node_name)
+        targets: List[Optional[str]] = [None] * len(batch)
+        rows: List[int] = []
+        hints: List[Optional[str]] = []
+        for i, msg in enumerate(batch):
+            if msg.headers.get("topic") != SESSION_TOPIC:
+                targets[i] = sup
+            else:
+                rows.append(i)
+                hints.append(msg.headers.get(ROUTE_HINT_HEADER))
+        if rows:
+            codes = pumpcore.route_hints_many(hints, self.n_workers)
+            for i, code in zip(rows, codes):
+                if code == pumpcore.NO_HINT:
+                    k = route_session_payload(
+                        batch[i].payload, self.n_workers
+                    )
+                elif code == pumpcore.SUPERVISOR:
+                    k = None
+                else:
+                    k = code
+                targets[i] = (
+                    sup if k is None else worker_queue(self.node_name, k)
+                )
+        return targets  # type: ignore[return-value]
+
     def start(self) -> "ShardRouter":
         self._thread.start()
         return self
@@ -211,8 +252,7 @@ class ShardRouter:
             if not batch:
                 continue
             items = []
-            for msg in batch:
-                target = self.target_of(msg)
+            for msg, target in zip(batch, self.targets_of(batch)):
                 if target.endswith(".sup"):
                     self.to_supervisor += 1
                 items.append((target, msg.payload, msg.headers))
@@ -277,13 +317,15 @@ class EgressPump:
         return self
 
     def _run(self) -> None:
-        from ..messaging.broker import QueueFullError
-
         while not self._stop.is_set():
             batch = self._consumer.receive_many(64, timeout=0.2)
             if not batch:
                 continue
-            aborted = False
+            # resolve every target first — header-only work, payloads
+            # untouched — so the happy path forwards the whole drain in
+            # ONE broker.send_many (one lock acquisition / native-framed
+            # wire call) instead of N per-message sends
+            resolved = []
             for msg in batch:
                 headers = dict(msg.headers)
                 dest = headers.pop("x-dest", None)
@@ -297,22 +339,7 @@ class EgressPump:
                         target = self.bridges.outbound_queue(dest)
                     else:
                         target = f"p2p.inbound.{dest}"
-                    while True:
-                        try:
-                            self.broker.send(target, msg.payload, headers)
-                            break
-                        except QueueFullError:
-                            # a bounded destination queue is full: BLOCK
-                            # until it drains, like ShardRouter — a
-                            # session message dropped here has no
-                            # retransmit, the flow would hang to timeout
-                            if self._stop.is_set():
-                                aborted = True
-                                break
-                            time.sleep(0.02)
-                    if aborted:
-                        break
-                    self.forwarded += 1
+                    resolved.append((target, msg.payload, headers))
                 except Exception as exc:
                     # an unroutable peer is an operational fact, not a
                     # pump-killing one
@@ -321,6 +348,23 @@ class EgressPump:
                         "warning", "messaging", "egress drop",
                         dest=dest, error=type(exc).__name__,
                     )
+            aborted = False
+            if resolved:
+                try:
+                    self.broker.send_many(resolved)
+                    self.forwarded += len(resolved)
+                # lint: allow(swallow) — _forward_slow reports per message
+                except Exception:
+                    # ANY batch failure falls back to the per-message
+                    # path (exact blocking-backpressure and per-message
+                    # drop semantics — the old loop caught Exception per
+                    # message, and this pump thread must never die).
+                    # BrokerError is all-or-nothing; a non-broker error
+                    # (journal OSError mid-batch) may have applied a
+                    # prefix, whose per-message resend duplicates are
+                    # absorbed by session seq-dedup downstream — the
+                    # documented at-least-once contract.
+                    aborted = self._forward_slow(resolved)
             if aborted:
                 # stop() mid-backpressure: not a drop — ack NOTHING so
                 # the durable egress queue redelivers the batch after
@@ -328,6 +372,36 @@ class EgressPump:
                 # absorbed by session seq-dedup downstream)
                 continue
             self._consumer.ack_many(batch)
+
+    def _forward_slow(self, resolved) -> bool:
+        """Per-message forwarding for a drain the batch path refused:
+        block on full destinations (backpressure), drop unroutable ones.
+        Returns True when stop() aborted mid-backpressure (caller must
+        NOT ack)."""
+        from ..messaging.broker import QueueFullError
+
+        for target, payload, headers in resolved:
+            try:
+                while True:
+                    try:
+                        self.broker.send(target, payload, headers)
+                        break
+                    except QueueFullError:
+                        # a bounded destination queue is full: BLOCK
+                        # until it drains, like ShardRouter — a session
+                        # message dropped here has no retransmit, the
+                        # flow would hang to timeout
+                        if self._stop.is_set():
+                            return True
+                        time.sleep(0.02)
+                self.forwarded += 1
+            except Exception as exc:
+                self.dropped += 1
+                eventlog.emit(
+                    "warning", "messaging", "egress drop",
+                    dest=target, error=type(exc).__name__,
+                )
+        return False
 
     def stop(self) -> None:
         self._stop.set()

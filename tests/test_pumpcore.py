@@ -1,0 +1,815 @@
+"""GIL-escaped message plane (ISSUE 12): differential parity + overlap.
+
+Pins the consensus-critical contracts of the native batch codec and the
+native pump core against their pure-Python fallbacks:
+
+  * serialize_many / deserialize_many are byte-identical to the
+    single-shot codec on randomized whitelisted object graphs, and
+    malformed frames raise the same SerializationError taxonomy on both
+    paths;
+  * the wire framing primitives (frame_msgs / frame_send_many /
+    parse_msgs / parse_send_many / parse_headers_many) are
+    byte-identical to the messaging/net.py code they replace, in both
+    directions (native-framed -> python-parsed and vice versa);
+  * route_hints_many agrees with shardhost.route_session_hint on every
+    hint shape — a retransmit must land on the same worker either way;
+  * one wire drain cycle makes O(1) native calls for an N-message
+    batch, payloads arrive as zero-copy views over the per-drain arena,
+    and ack/redelivery/journal semantics survive the view payloads;
+  * the no-native run (kill switches AND a no-compiler build) exercises
+    the fallback path with identical bytes, and the native loader
+    reports WHY a build was skipped (classified reason + eventlog +
+    Native.Available gauges);
+  * on a >=2-core box, a pump-heavy burst under utils/sampler.py shows
+    the pump thread's runnable share rising once the framing releases
+    the GIL (skipped with a named reason on 1-core boxes).
+"""
+import os
+import random
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.core.serialization import codec
+from corda_tpu.core.serialization.codec import SerializationError
+from corda_tpu.messaging import pumpcore
+from corda_tpu.messaging.broker import Broker, _encode_headers
+from corda_tpu.messaging.net import OP_SEND_MANY, RE_MSG, BrokerServer, RemoteBroker
+
+HAVE_NATIVE = pumpcore.native_active()
+
+
+def _gen_value(rng, depth=0):
+    from corda_tpu.core.crypto.secure_hash import SecureHash
+
+    kinds = ["int", "bigint", "str", "bytes", "bool", "none", "float"]
+    if depth < 4:
+        kinds += ["list", "dict", "set", "obj"] * 2
+    k = rng.choice(kinds)
+    if k == "int":
+        return rng.randint(-2**62, 2**62)
+    if k == "bigint":
+        return rng.randint(-2**300, 2**300)
+    if k == "str":
+        return "".join(
+            rng.choice("abcXYZ漢字🎉 _:") for _ in range(rng.randint(0, 20))
+        )
+    if k == "bytes":
+        return rng.randbytes(rng.randint(0, 40))
+    if k == "bool":
+        return rng.choice([True, False])
+    if k == "none":
+        return None
+    if k == "float":
+        return rng.choice([0.0, 1.5, -2.25, 1e300, 123.456])
+    if k == "list":
+        return [_gen_value(rng, depth + 1) for _ in range(rng.randint(0, 5))]
+    if k == "dict":
+        return {
+            rng.choice(["a", "bb", "z", "k1", "漢"]) + str(i):
+                _gen_value(rng, depth + 1)
+            for i in range(rng.randint(0, 5))
+        }
+    if k == "set":
+        return frozenset(
+            rng.randint(0, 1000) for _ in range(rng.randint(0, 5))
+        )
+    return SecureHash(rng.randbytes(32))
+
+
+def _python_serialize(value):
+    out = bytearray(codec._MAGIC)
+    codec._encode(out, value)
+    return bytes(out)
+
+
+def _python_deserialize(data):
+    value, pos = codec._decode(bytes(data), len(codec._MAGIC))
+    assert pos == len(data)
+    return value
+
+
+class TestCodecBatchParity:
+    def test_batch_entry_points_active(self):
+        assert codec._native_codec is not None, (
+            "native codec failed to build — the toolchain is in the image"
+        )
+        assert hasattr(codec._native_codec, "encode_many")
+        assert HAVE_NATIVE, "native pump core failed to build"
+
+    def test_fuzz_differential(self):
+        rng = random.Random(4321)
+        values = [_gen_value(rng) for _ in range(300)]
+        frames = codec.serialize_many(values)
+        assert len(frames) == len(values)
+        for v, frame in zip(values, frames):
+            assert bytes(frame) == _python_serialize(v), v
+        decoded = codec.deserialize_many([bytes(f) for f in frames])
+        singles = [_python_deserialize(bytes(f)) for f in frames]
+        assert decoded == singles
+
+    def test_serialize_many_shares_one_arena(self):
+        frames = codec.serialize_many([1, "two", b"three"])
+        assert all(isinstance(f, memoryview) for f in frames)
+        owners = {id(f.obj) for f in frames}
+        assert len(owners) == 1, "batch encode must write ONE arena"
+
+    def test_decode_many_accepts_views(self):
+        values = [{"k": [1, 2]}, b"payload", "s"]
+        frames = [memoryview(codec.serialize(v)) for v in values]
+        assert codec.deserialize_many(frames) == values
+
+    def test_deserialize_coerces_views_on_python_path(self, monkeypatch):
+        frame = codec.serialize({"k": b"v"})
+        monkeypatch.setattr(codec, "_native_codec", None)
+        assert codec.deserialize(memoryview(frame)) == {"k": b"v"}
+        assert codec.deserialize_many([memoryview(frame)]) == [{"k": b"v"}]
+
+    #: malformed frames, each a distinct failure mode of the grammar
+    MALFORMED = [
+        b"XX\x01\x00",                                   # bad magic
+        b"CT\x01",                                       # empty value
+        b"CT\x01\x63",                                   # unknown tag
+        b"CT\x01\x04\x05abc",                            # truncated bytes
+        b"CT\x01\x05\x03ab",                             # truncated string
+        b"CT\x01\x09\x04",                               # truncated float
+        b"CT\x01\x03" + b"\x80" * 95,                    # truncated varint
+        b"CT\x01\x03" + b"\x80" * 95 + b"\x01",          # varint too long
+        b"CT\x01\x04" + b"\xff" * 8 + b"\x7f",           # hostile length
+        b"CT\x01\x08\x03abc",                            # truncated OBJ
+        b"CT\x01\x06\x02\x00",                           # truncated list
+    ]
+
+    def test_malformed_taxonomy_parity(self, monkeypatch):
+        good = codec.serialize([1, "x"])
+        for bad in self.MALFORMED + [good + b"\x00"]:  # + trailing bytes
+            with pytest.raises(SerializationError):
+                codec.deserialize_many([good, bad])
+            with pytest.raises(SerializationError):
+                codec.deserialize(bad)
+            with monkeypatch.context() as m:
+                m.setattr(codec, "_native_codec", None)
+                with pytest.raises(SerializationError):
+                    codec.deserialize_many([good, bad])
+
+    def test_unknown_type_rejected(self):
+        frame = b"CT\x01\x08\x05NoSuc\x00"
+        with pytest.raises(SerializationError, match="whitelist"):
+            codec.deserialize_many([frame])
+
+    def test_deep_nesting_capped_both_paths(self, monkeypatch):
+        deep = b"CT\x01" + bytes([6, 1]) * 150 + b"\x00"
+        with pytest.raises(SerializationError, match="nesting"):
+            codec.deserialize_many([deep])
+        with monkeypatch.context() as m:
+            m.setattr(codec, "_native_codec", None)
+            with pytest.raises(SerializationError, match="nesting"):
+                codec.deserialize_many([deep])
+        v = []
+        for _ in range(150):
+            v = [v]
+        with pytest.raises(SerializationError, match="nesting"):
+            codec.serialize_many([v])
+
+    def test_padded_varint_parity(self):
+        padded = (
+            b"CT\x01" + bytes([4]) + b"\x82" + b"\x80" * 8 + b"\x00" + b"ab"
+        )
+        assert codec.deserialize_many([padded]) == [b"ab"]
+        assert _python_deserialize(padded) == b"ab"
+
+    def test_bigint_roundtrip(self):
+        values = [2**64, -2**64, 2**300, -2**300 + 7, 2**63, -2**63]
+        frames = codec.serialize_many(values)
+        for v, f in zip(values, frames):
+            assert bytes(f) == _python_serialize(v)
+        assert codec.deserialize_many(frames) == values
+
+    def test_fallback_counters(self, monkeypatch):
+        before = codec.batch_stats()
+        codec.serialize_many([1])
+        codec.deserialize_many([codec.serialize(1)])
+        mid = codec.batch_stats()
+        assert mid["encode_many_native"] == before["encode_many_native"] + 1
+        assert mid["decode_many_native"] == before["decode_many_native"] + 1
+        monkeypatch.setattr(codec, "_native_codec", None)
+        codec.serialize_many([1])
+        codec.deserialize_many([codec.serialize(1)])
+        after = codec.batch_stats()
+        assert after["encode_many_fallback"] == (
+            mid["encode_many_fallback"] + 1
+        )
+        assert after["decode_many_fallback"] == (
+            mid["decode_many_fallback"] + 1
+        )
+
+
+class TestWireParity:
+    def _rand_msgs(self, rng, n=16):
+        out = []
+        for i in range(n):
+            headers = {
+                rng.choice(["topic", "x-dest", "x-session-route",
+                            "traceparent", "k%d" % i, "漢字"]):
+                    "".join(rng.choice("abz0-:漢") for _ in range(
+                        rng.randint(0, 12)))
+                for _ in range(rng.randint(0, 5))
+            }
+            out.append((
+                f"prefix-{i:019d}",
+                rng.randint(1, 5),
+                headers,
+                rng.randbytes(rng.randint(0, 200)),
+            ))
+        return out
+
+    def test_frame_and_parse_msgs_differential(self, monkeypatch):
+        rng = random.Random(99)
+        for _ in range(10):
+            msgs = self._rand_msgs(rng)
+            native = pumpcore.frame_msgs(msgs, RE_MSG)
+            with monkeypatch.context() as m:
+                m.setattr(pumpcore, "_native", None)
+                fallback = pumpcore.frame_msgs(msgs, RE_MSG)
+                parsed_py = pumpcore.parse_msgs(native)
+            assert native == fallback
+            parsed_native = pumpcore.parse_msgs(fallback)
+            norm = lambda rows: [
+                (mid, dc, h, bytes(p)) for mid, dc, h, p in rows
+            ]
+            assert norm(parsed_native) == msgs
+            assert norm(parsed_py) == msgs
+
+    def test_frame_and_parse_send_many_differential(self, monkeypatch):
+        rng = random.Random(7)
+        for _ in range(10):
+            items = [
+                (f"queue.{i}.漢", rng.randbytes(rng.randint(0, 100)),
+                 {"h%d" % j: str(j) for j in range(rng.randint(0, 4))})
+                for i in range(rng.randint(0, 12))
+            ]
+            native = pumpcore.frame_send_many(items, OP_SEND_MANY)
+            with monkeypatch.context() as m:
+                m.setattr(pumpcore, "_native", None)
+                fallback = pumpcore.frame_send_many(items, OP_SEND_MANY)
+                parsed_py = pumpcore.parse_send_many(native)
+            assert native == fallback
+            parsed_native = pumpcore.parse_send_many(fallback)
+            norm = lambda rows: [(q, bytes(p), h) for q, p, h in rows]
+            assert norm(parsed_native) == items
+            assert norm(parsed_py) == items
+
+    def test_parse_msgs_payloads_are_arena_views(self):
+        if not HAVE_NATIVE:
+            pytest.skip("native pump core unavailable")
+        msgs = [("m-%019d" % i, 1, {"topic": "t"}, bytes([i]) * 50)
+                for i in range(8)]
+        reply = pumpcore.frame_msgs(msgs, RE_MSG)
+        parsed = pumpcore.parse_msgs(reply)
+        for _, _, _, payload in parsed:
+            assert isinstance(payload, memoryview)
+            assert payload.obj is reply  # zero-copy: views over ONE arena
+
+    def test_parse_headers_many(self, monkeypatch):
+        blobs = [
+            _encode_headers({"x-session-route": "h:abc", "topic": "s",
+                             "x-dest": "Bank A"}),
+            _encode_headers({}),
+            _encode_headers({"traceparent": "00-ab-cd-01"}),
+        ]
+        wanted = ("x-session-route", "x-dest", "traceparent")
+        expected = [
+            ("h:abc", "Bank A", None),
+            (None, None, None),
+            (None, None, "00-ab-cd-01"),
+        ]
+        assert pumpcore.parse_headers_many(blobs, wanted) == expected
+        with monkeypatch.context() as m:
+            m.setattr(pumpcore, "_native", None)
+            assert pumpcore.parse_headers_many(blobs, wanted) == expected
+
+    def test_malformed_batch_frame_rejected(self):
+        if not HAVE_NATIVE:
+            pytest.skip("native pump core unavailable")
+        good = pumpcore.frame_msgs(
+            [("m-%019d" % 0, 1, {}, b"x")], RE_MSG
+        )
+        for bad in (b"", b"\x81\x00\x00", good[:-1],
+                    b"\x81" + struct.pack(">I", 3) + b"\x00" * 4):
+            with pytest.raises(ValueError):
+                pumpcore.parse_msgs(bad)
+        with pytest.raises(ValueError):
+            pumpcore.parse_headers_many([b"\x00\x00\x00\x09"], ("x",))
+
+
+class TestRouteHints:
+    def _hint_corpus(self):
+        rng = random.Random(17)
+        hints = ["h:w2-abc:1", "t:w3-xyz:9", "t:w9-x", "t:wx-", "bogus",
+                 "", None, "h:", "t:w0-a", "x:abc", "t:w12345678901234-a",
+                 "h:漢字-session",
+                 # Unicode decimal digits must NOT parse as a tag on
+                 # either path (\d would have accepted them in Python
+                 # while the native parser is ASCII-only — a divergence
+                 # that splits a session across workers)
+                 "t:w٣-abc", "t:w１-abc"]
+        hints += ["h:" + "".join(rng.choice("abcdef0123456789:-w")
+                                 for _ in range(rng.randint(0, 80)))
+                  for _ in range(150)]
+        hints += ["t:" + "".join(rng.choice("w0123456789-x:")
+                                 for _ in range(rng.randint(0, 20)))
+                  for _ in range(150)]
+        return hints
+
+    def test_differential_vs_route_session_hint(self, monkeypatch):
+        from corda_tpu.node.shardhost import _NO_HINT, route_session_hint
+
+        hints = self._hint_corpus()
+        for n_workers in (1, 2, 4, 7):
+            native = pumpcore.route_hints_many(hints, n_workers)
+            with monkeypatch.context() as m:
+                m.setattr(pumpcore, "_native", None)
+                fallback = pumpcore.route_hints_many(hints, n_workers)
+            assert native == fallback
+            for hint, code in zip(hints, native):
+                py = route_session_hint(hint, n_workers)
+                expect = (
+                    pumpcore.NO_HINT if py is _NO_HINT
+                    else pumpcore.SUPERVISOR if py is None
+                    else py
+                )
+                assert code == expect, (hint, n_workers)
+
+    def test_router_targets_of_agrees_with_target_of(self):
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.messaging.broker import Message
+        from corda_tpu.node.session import ROUTE_HINT_HEADER, SESSION_TOPIC
+        from corda_tpu.node.shardhost import ShardRouter
+
+        broker = Broker()
+        broker.create_queue("p2p.inbound.RouteNode")
+        router = ShardRouter(broker, "RouteNode", 3)  # never start()ed
+        rng = random.Random(5)
+        batch = []
+        for hint in self._hint_corpus()[:60]:
+            headers = {"topic": rng.choice([SESSION_TOPIC, "other"])}
+            if hint is not None and rng.random() < 0.8:
+                headers[ROUTE_HINT_HEADER] = hint
+            batch.append(Message(
+                payload=serialize({"junk": True}), headers=headers,
+                message_id="m%d" % len(batch),
+            ))
+        assert router.targets_of(batch) == [
+            router.target_of(m) for m in batch
+        ]
+        router._consumer.close()
+        broker.close()
+
+
+class TestDrainSemantics:
+    """End-to-end over the real wire layer: O(1) native calls per drain
+    cycle, zero-copy arena payloads, ack/redelivery/journal discipline
+    intact."""
+
+    def test_one_drain_is_o1_native_calls(self):
+        if not HAVE_NATIVE:
+            pytest.skip("native pump core unavailable")
+        broker = Broker()
+        broker.create_queue("drain.test")
+        server = BrokerServer(broker).start()
+        n_msgs, batch = 256, 64
+        try:
+            remote = RemoteBroker("127.0.0.1", server.port)
+            consumer = remote.create_consumer("drain.test", prefetch=batch)
+            before = pumpcore.stats()
+            for start in range(0, n_msgs, batch):
+                remote.send_many([
+                    ("drain.test", b"p%d" % i, {"seq": str(i)})
+                    for i in range(start, start + batch)
+                ])
+            got = []
+            while len(got) < n_msgs:
+                msg = consumer.receive(timeout=5)
+                assert msg is not None
+                got.append(msg)
+                consumer.ack(msg)
+            after = pumpcore.stats()
+            # contents survived the native plane
+            assert [bytes(m.payload) for m in got] == [
+                b"p%d" % i for i in range(n_msgs)
+            ]
+            assert [m.headers["seq"] for m in got] == [
+                str(i) for i in range(n_msgs)
+            ]
+            # zero-copy arena views on the client side
+            assert all(isinstance(m.payload, memoryview) for m in got)
+            # O(1) calls per drain cycle: 4 send batches cost 4 frame +
+            # 4 parse calls; receives cost one frame+parse per wire
+            # drain — far below one call per MESSAGE. Bound generously
+            # (scheduling can split wire drains) but well under n_msgs.
+            delta = sum(
+                after.get(k, 0) - before.get(k, 0)
+                for k in after if k.endswith("_native")
+            )
+            assert delta <= n_msgs // 2, delta
+            fallback_delta = sum(
+                after.get(k, 0) - before.get(k, 0)
+                for k in after if k.endswith("_fallback")
+            )
+            assert fallback_delta == 0
+            consumer.close()
+            remote.close()
+        finally:
+            server.stop()
+            broker.close()
+
+    def test_redelivery_preserves_view_payloads(self):
+        broker = Broker()
+        broker.create_queue("redeliver.test")
+        server = BrokerServer(broker).start()
+        try:
+            remote = RemoteBroker("127.0.0.1", server.port)
+            remote.send_many([
+                ("redeliver.test", b"keep-me", {"k": "v"}),
+            ])
+            # consumer takes the message and dies without acking
+            c1 = remote.create_consumer("redeliver.test")
+            msg = c1.receive(timeout=5)
+            assert bytes(msg.payload) == b"keep-me"
+            c1.close()
+            c2 = remote.create_consumer("redeliver.test")
+            again = c2.receive(timeout=5)
+            assert again is not None
+            assert bytes(again.payload) == b"keep-me"
+            assert again.delivery_count == 2
+            assert again.headers["k"] == "v"
+            c2.ack(again)
+            c2.close()
+            remote.close()
+        finally:
+            server.stop()
+            broker.close()
+
+    def test_durable_journal_snapshots_arena_views(self, tmp_path):
+        # the durability boundary: messages enqueued as views over a
+        # wire arena must journal as REAL bytes — a restart replays
+        # them intact long after the arena died. The wire server
+        # snapshots at enqueue already (arena-retention rule), so ALSO
+        # enqueue view payloads locally to pin the journal's own
+        # coercion.
+        jdir = str(tmp_path / "journal")
+        broker = Broker(journal_dir=jdir)
+        broker.create_queue("durable.q", durable=True)
+        server = BrokerServer(broker).start()
+        try:
+            remote = RemoteBroker("127.0.0.1", server.port)
+            remote.send_many([
+                ("durable.q", bytes([i]) * 64, {"i": str(i)})
+                for i in range(3)
+            ])
+            arena = bytes([3]) * 64 + bytes([4]) * 64
+            mv = memoryview(arena)
+            broker.send("durable.q", mv[:64], {"i": "3"})
+            broker.send_many([("durable.q", mv[64:], {"i": "4"})])
+            del mv, arena  # the journal record must have its own bytes
+            remote.close()
+        finally:
+            server.stop()
+            broker.close()
+        revived = Broker(journal_dir=jdir)
+        try:
+            consumer = revived.create_consumer("durable.q")
+            for i in range(5):
+                msg = consumer.receive(timeout=2)
+                assert msg is not None
+                assert bytes(msg.payload) == bytes([i]) * 64
+                assert msg.headers["i"] == str(i)
+                assert msg.delivery_count == 2  # journal replay
+                consumer.ack(msg)
+        finally:
+            revived.close()
+
+
+_FALLBACK_SNIPPET = r"""
+import os, sys
+from corda_tpu.core.serialization import codec
+from corda_tpu.messaging import pumpcore
+from corda_tpu.messaging.net import RE_MSG
+
+assert codec._native_codec is None, "kill switch ignored by codec"
+assert not pumpcore.native_active(), "kill switch ignored by pumpcore"
+
+values = [1, "two", b"three", {"k": [None, True]}, 2**100]
+frames = codec.serialize_many(values)
+assert [f.hex() for f in frames] == sys.argv[1].split(","), "frame bytes diverged"
+assert codec.deserialize_many(frames) == values
+stats = codec.batch_stats()
+assert stats["encode_many_fallback"] >= 1 and stats["encode_many_native"] == 0
+assert stats["decode_many_fallback"] >= 1 and stats["decode_many_native"] == 0
+
+msgs = [("m-%019d" % i, 1, {"topic": "t"}, b"x" * i) for i in range(4)]
+body = pumpcore.frame_msgs(msgs, RE_MSG)
+assert body.hex() == sys.argv[2], "wire bytes diverged"
+parsed = [(m, d, h, bytes(p)) for m, d, h, p in pumpcore.parse_msgs(body)]
+assert parsed == msgs
+pstats = pumpcore.stats()
+assert all(k.endswith("_fallback") for k, v in pstats.items() if v), pstats
+print("FALLBACK-OK")
+"""
+
+
+class TestFallbackPath:
+    def test_kill_switches_reproduce_native_bytes(self):
+        """CORDA_TPU_NATIVE_CODEC=0 / CORDA_TPU_PUMP_NATIVE=0 must
+        reproduce the native plane byte-identically — proven by handing
+        the fallback subprocess the NATIVE-path bytes to match."""
+        values = [1, "two", b"three", {"k": [None, True]}, 2**100]
+        native_frames = ",".join(
+            bytes(f).hex() for f in codec.serialize_many(values)
+        )
+        msgs = [("m-%019d" % i, 1, {"topic": "t"}, b"x" * i)
+                for i in range(4)]
+        native_body = pumpcore.frame_msgs(msgs, RE_MSG).hex()
+        env = dict(
+            os.environ, CORDA_TPU_NATIVE_CODEC="0",
+            CORDA_TPU_PUMP_NATIVE="0", JAX_PLATFORMS="cpu",
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", _FALLBACK_SNIPPET,
+             native_frames, native_body],
+            capture_output=True, text=True, env=env, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "FALLBACK-OK" in proc.stdout
+
+    def test_no_compiler_build_classified_and_reported(self, tmp_path):
+        """A box without a compiler must fall back with a CLASSIFIED
+        reason (no_compiler), an eventlog record, and a working pure-
+        Python plane — the no-native tier-1 story in one subprocess."""
+        snippet = r"""
+import os, sys
+import corda_tpu.native as native
+native._BUILD = sys.argv[1]  # fresh build dir: force a compile attempt
+from corda_tpu.core.serialization import codec
+assert codec._native_codec is None, "built without a compiler?"
+status = native.availability()
+assert status["codec_ext"]["available"] is False
+assert status["codec_ext"]["reason"] == "no_compiler", status
+assert native._get_lib() is None
+assert status != native.availability() or True
+for ext in ("sha2_batch", "journal", "ed25519_msm", "ecdsa_host"):
+    entry = native.availability()[ext]
+    assert entry["available"] is False and entry["reason"] == "no_compiler"
+from corda_tpu.utils import eventlog
+recs = eventlog.get_event_log().records(component="native")
+assert any(r.get("reason") == "no_compiler" for r in recs), recs
+assert codec.deserialize(codec.serialize({"x": 1})) == {"x": 1}
+print("NOCOMPILER-OK")
+"""
+        # a PATH with python but no gcc/g++ (symlink the interpreter in)
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        (bindir / "python").symlink_to(sys.executable)
+        env = dict(os.environ, PATH=str(bindir), JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet, str(tmp_path / "build")],
+            capture_output=True, text=True, env=env, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "NOCOMPILER-OK" in proc.stdout
+
+
+class TestNativeStatusAndCLI:
+    def test_availability_reports_all_five(self):
+        import corda_tpu.native as native
+
+        native._get_lib()
+        native.codec_extension()
+        status = native.availability()
+        for ext in native.EXTENSIONS:
+            assert status[ext]["available"] is True, status
+
+    def test_build_cli_ok(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "corda_tpu.native", "--build"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        for ext in ("sha2_batch", "journal", "ed25519_msm", "ecdsa_host",
+                    "codec_ext"):
+            assert f"{ext}: OK" in proc.stdout, proc.stdout
+
+    def test_build_cli_fails_on_compile_error_with_compiler(self, tmp_path):
+        """CI contract: when a compiler IS present and a source is
+        broken, the CLI exits non-zero naming the extension."""
+        snippet = r"""
+import os, shutil, sys
+import corda_tpu.native as native
+srcdir, builddir = sys.argv[1], sys.argv[2]
+os.makedirs(srcdir, exist_ok=True)
+for fname in os.listdir(native._SRC):
+    shutil.copy(os.path.join(native._SRC, fname), srcdir)
+with open(os.path.join(srcdir, "codec_ext.c"), "a") as fh:
+    fh.write("\n#error deliberately broken\n")
+native._SRC = srcdir
+native._BUILD = builddir
+from corda_tpu.native.__main__ import main
+rc = main(["--build"])
+status = native.availability()
+assert status["codec_ext"]["available"] is False
+assert status["codec_ext"]["reason"].startswith("compile_error"), status
+assert status["sha2_batch"]["available"] is True  # the C++ lib still builds
+print("RC=%d" % rc)
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet, str(tmp_path / "src"),
+             str(tmp_path / "build")],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "RC=1" in proc.stdout, proc.stdout
+
+    def test_native_available_gauges_on_node(self):
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        node = net.create_node("O=NativeGauge,L=London,C=GB")
+        try:
+            snap = node.metrics.snapshot()
+            import corda_tpu.native as native
+
+            for ext in native.EXTENSIONS:
+                entry = snap.get(f"Native.Available{{ext={ext}}}")
+                assert entry is not None, sorted(snap)[:5]
+                assert entry["value"] in (-1.0, 0.0, 1.0)
+            # this container HAS the toolchain and the codec loaded at
+            # import time, so at least codec_ext must read 1
+            assert snap["Native.Available{ext=codec_ext}"]["value"] == 1.0
+        finally:
+            net.stop_nodes()
+
+
+class TestRetentionAndResilience:
+    def test_server_enqueue_snapshots_payloads(self):
+        """Arena-retention rule: broker-RESIDENT payloads must be real
+        bytes — a queued view would pin its whole multi-message request
+        arena for the (unbounded) queue residence."""
+        broker = Broker()
+        broker.create_queue("resident.q")
+        server = BrokerServer(broker).start()
+        try:
+            remote = RemoteBroker("127.0.0.1", server.port)
+            remote.send_many([
+                ("resident.q", b"x" * 32, {"i": str(i)}) for i in range(8)
+            ])
+            with broker._lock:
+                payloads = [
+                    m.payload for m in broker._queues["resident.q"].messages
+                ]
+            assert len(payloads) == 8
+            assert all(isinstance(p, bytes) for p in payloads)
+            remote.close()
+        finally:
+            server.stop()
+            broker.close()
+
+    def test_egress_pump_survives_non_broker_error(self):
+        """A non-BrokerError from the batch send (journal OSError, …)
+        must fall back to per-message forwarding, not kill the pump
+        thread — the old per-message loop never died on one."""
+        from corda_tpu.node.shardhost import EGRESS_QUEUE, EgressPump
+
+        broker = Broker()
+        broker.create_queue("p2p.inbound.EgressDest")
+        fails = [0]
+        real_send_many = broker.send_many
+
+        def flaky_send_many(items):
+            if fails[0] == 0:
+                fails[0] += 1
+                raise OSError("disk full mid-batch")
+            return real_send_many(items)
+
+        broker.send_many = flaky_send_many
+        pump = EgressPump(broker).start()
+        try:
+            broker.send(EGRESS_QUEUE, b"hello",
+                        {"x-dest": "EgressDest", "topic": "t"})
+            deadline = time.monotonic() + 5
+            while (broker.message_count("p2p.inbound.EgressDest") < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert broker.message_count("p2p.inbound.EgressDest") == 1
+            assert fails[0] == 1  # the batch path DID fail first
+            assert pump._thread.is_alive()
+            assert pump.forwarded == 1 and pump.dropped == 0
+        finally:
+            pump.stop()
+            broker.close()
+
+
+class TestBenchStage:
+    def test_gate_directions_for_new_keys(self):
+        from corda_tpu.loadtest.gate import direction
+
+        assert direction("pump_drain_msgs_s") == "higher"
+        assert direction("codec_batch_speedup_x") == "higher"
+        assert direction("codec_batch_native_us_per_obj") == "lower"
+        assert direction("codec_batch_python_us_per_obj") == "lower"
+        assert direction("codec_batch_decode_us_per_obj") == "lower"
+        # provenance keys must NOT gate
+        assert direction("pump_drain_native_calls") is None
+        assert direction("codec_batch_n") is None
+
+    def test_measure_codec_batch_meets_acceptance(self):
+        from corda_tpu.loadtest.latency import measure_codec_batch
+
+        out = measure_codec_batch(n=400)
+        assert out["codec_batch_native"] is True
+        # parity is asserted INSIDE the helper; the >=3x acceptance
+        # line is enforced by bench on the build box — here we pin a
+        # lenient floor so a silent fallback can't pass as a win
+        assert out["codec_batch_speedup_x"] >= 2.0, out
+
+    def test_measure_pump_drain_smoke(self):
+        from corda_tpu.loadtest.latency import measure_pump_drain
+
+        out = measure_pump_drain(n_msgs=200, payload_len=256, batch=32)
+        assert out["pump_drain_msgs_s"] > 0
+        assert out["pump_drain_native"] is HAVE_NATIVE
+        if HAVE_NATIVE:
+            # O(1) native calls per drain cycle, not per message
+            assert 0 < out["pump_drain_native_calls"] <= 200 // 2
+
+
+class TestSamplerOverlap:
+    """Satellite: a pump-heavy burst under the sampling profiler shows
+    the pump thread overlapping a busy Python thread once the framing
+    releases the GIL."""
+
+    @pytest.mark.skipif(
+        (os.cpu_count() or 1) < 2,
+        reason="pump off-GIL overlap needs >=2 cores (1-core box: the "
+               "GIL release cannot buy parallelism to observe)",
+    )
+    @pytest.mark.skipif(not HAVE_NATIVE, reason="native pump core missing")
+    def test_pump_thread_runnable_share_rises_off_gil(self, monkeypatch):
+        from corda_tpu.utils import sampler
+
+        payload = bytes(256 * 1024)
+        msgs = [("m-%019d" % i, 1, {"topic": "x"}, payload)
+                for i in range(32)]
+
+        def measure(native_on):
+            with monkeypatch.context() as m:
+                if not native_on:
+                    m.setattr(pumpcore, "_native", None)
+                stop = threading.Event()
+                spins = [0]
+
+                def busy():
+                    # pure-Python GIL-holding competitor
+                    x = 0
+                    while not stop.is_set():
+                        x += 1
+                    spins[0] = x
+
+                frames = [0]
+
+                def pump():
+                    while not stop.is_set():
+                        pumpcore.frame_msgs(msgs, RE_MSG)
+                        frames[0] += 1
+
+                tb = threading.Thread(target=busy, name="overlap-busy",
+                                      daemon=True)
+                tp = threading.Thread(target=pump, name="overlap-pump",
+                                      daemon=True)
+                tb.start()
+                tp.start()
+                time.sleep(0.1)  # settle
+                cap = sampler.capture(seconds=0.6, interval=0.005)
+                stop.set()
+                tb.join(timeout=5)
+                tp.join(timeout=5)
+            row = next(
+                t for t in cap["threads"] if t["name"] == "overlap-pump"
+            )
+            share = row["running"] / max(1, row["running"] + row["waiting"])
+            return cap["meta"]["total_cpu_s"], share, frames[0]
+
+        gil_cpu, gil_share, gil_frames = measure(native_on=False)
+        nat_cpu, nat_share, nat_frames = measure(native_on=True)
+        assert nat_frames > 0 and gil_frames > 0
+        # off-GIL framing lets BOTH threads burn a core: total CPU in
+        # the window rises, and the pump thread is runnable more often
+        assert nat_cpu > gil_cpu * 1.15, (nat_cpu, gil_cpu)
+        assert nat_share > gil_share, (nat_share, gil_share)
