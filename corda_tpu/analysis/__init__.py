@@ -9,9 +9,12 @@ baseline shrinks by fixing findings and re-pinning (`--pin`), never by
 hand-editing.
 """
 from .astlint import Finding, PASS_IDS, run_passes, lint_paths  # noqa: F401
+from .clint import PASS_IDS as C_PASS_IDS  # noqa: F401
 from .manifest import (  # noqa: F401
+    ALL_PASS_IDS,
     MANIFEST_PATH,
     check_findings,
     load_manifest,
     pin_manifest,
+    run_all_passes,
 )

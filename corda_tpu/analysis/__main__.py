@@ -18,7 +18,7 @@ import sys
 from typing import List, Optional
 
 from . import kernel_lint, manifest
-from .astlint import PASS_IDS, run_passes
+from .manifest import ALL_PASS_IDS, run_all_passes
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -38,7 +38,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list", action="store_true",
                     help="print every current finding, accepted or not")
     ap.add_argument("--pass", dest="only_passes", action="append",
-                    choices=PASS_IDS, metavar="PASS",
+                    choices=ALL_PASS_IDS, metavar="PASS",
                     help="restrict to specific passes (repeatable)")
     ap.add_argument("--no-kernel", action="store_true",
                     help="skip the kernel-jaxpr lint (no jax import; "
@@ -57,7 +57,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     if args.paths:
-        findings = run_passes(paths=args.paths, passes=args.only_passes)
+        findings = run_all_passes(paths=args.paths, passes=args.only_passes)
         for f in findings:
             print(f"{f.path}:{f.line}: [{f.pass_id}] {f.message}")
         print(f"{len(findings)} finding(s) in {len(args.paths)} file(s)",
@@ -65,7 +65,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.pin:
-        findings = run_passes(passes=args.only_passes)
+        findings = run_all_passes(passes=args.only_passes)
         kernels = None
         if not args.no_kernel:
             kernels = kernel_lint.kernel_counts()
@@ -79,7 +79,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
         return 0
 
-    findings = run_passes(passes=args.only_passes, root=args.root)
+    findings = run_all_passes(passes=args.only_passes, root=args.root)
     if args.list:
         for f in findings:
             print(f"{f.path}:{f.line}: [{f.pass_id}] {f.message}")

@@ -1,0 +1,731 @@
+"""Tokenizer-based lint passes for the native C/C++ sources (the
+native-plane analogue of :mod:`.astlint` — docs/static-analysis.md).
+
+PR 11 grew ``native/src/`` to ~4.7k LoC of CPython-API C with
+GIL-released regions, borrowed buffer-protocol spans and zero-copy
+arenas; its review passes caught arena-pinning and buffer-lifetime bugs
+by hand.  These passes catch the same bug SHAPES structurally, cheap
+enough for every tier-1 run, with no libclang dependency:
+
+``gil_region``
+    No CPython C-API identifier may appear lexically between
+    ``Py_BEGIN_ALLOW_THREADS`` and ``Py_END_ALLOW_THREADS`` beyond an
+    explicit allowlist of GIL-free names (types/constants like
+    ``Py_ssize_t``/``PyBUF_SIMPLE``, and the block/unblock macros).
+    The scan is lexical: helpers CALLED from a region must themselves
+    be GIL-free by construction (the codec's scan/plan helpers use raw
+    ``realloc``/``memcpy`` for exactly this reason).
+
+``buffer_release``
+    Every buffer acquisition — ``PyObject_GetBuffer(obj, &view, ...)``
+    or a ``PyArg_ParseTuple`` format containing ``y*``/``s*``/``w*``
+    filling a declared ``Py_buffer`` — must pair with a
+    ``PyBuffer_Release`` on every early ``return`` and every
+    ``goto``-fail epilogue.  Acquisition-failure guards (the ``return``
+    inside ``if (PyObject_GetBuffer(...) < 0)``) are exempt: the view
+    was never filled.
+
+``refcount_escape``
+    An owning allocation (``PyMem_Malloc``/``malloc``/``fopen``/a
+    new-reference constructor like ``PyList_New``) must be released,
+    transferred (``PyTuple_SET_ITEM``/``Py_BuildValue``/returned), or
+    covered by the ``goto``-fail epilogue before any early-error
+    ``return``.  Also flags an unguarded ``new`` expression (no
+    ``std::nothrow``) in the C++ sources: a ``bad_alloc`` thrown across
+    the ctypes C ABI aborts the process instead of failing the call.
+
+The release/transfer tracking is LEXICAL (any release between the
+acquisition and the return disarms it, whatever branch it sits in) —
+deliberate: zero false positives on reviewed code, with the dynamic
+half of the story (ASan/UBSan, the arena checker) covering what a
+tokenizer cannot.  Suppression mirrors astlint:
+``/* lint: allow(pass_id) — reason */`` (or ``//``-style) on the
+flagged line or the line above.  Findings carry the same stable
+``pass:path:symbol`` keys and pin into ``analysis_manifest.json``.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .astlint import Finding, _dedup, _repo_root
+
+PASS_IDS = ("gil_region", "buffer_release", "refcount_escape")
+
+#: identifiers that LOOK like CPython API but are safe without the GIL
+#: (types, constants, the region macros themselves)
+GIL_FREE_ALLOWLIST = {
+    "Py_BEGIN_ALLOW_THREADS", "Py_END_ALLOW_THREADS",
+    "Py_BLOCK_THREADS", "Py_UNBLOCK_THREADS",
+    "Py_ssize_t", "Py_buffer", "Py_uhash_t", "Py_UCS4", "Py_uintptr_t",
+    "PY_SSIZE_T_MAX", "PY_SSIZE_T_MIN", "PY_VERSION_HEX",
+    "PyObject",  # the TYPE in declarations; calls are PyObject_* and match
+    "PyBUF_SIMPLE", "PyBUF_WRITABLE", "PyBUF_FORMAT", "PyBUF_ND",
+}
+
+_PYAPI_RE = re.compile(r"^_?Py[A-Z_0-9]")
+
+#: calls that free/close/decref an owned resource
+RELEASE_FNS = {
+    "Py_DECREF", "Py_XDECREF", "Py_CLEAR",
+    "PyMem_Free", "PyMem_Del", "free", "fclose", "PyBuffer_Release",
+}
+
+#: calls returning a resource the caller owns
+ALLOC_FNS = {
+    "PyMem_Malloc", "PyMem_Calloc", "malloc", "calloc", "fopen",
+    "PySequence_Fast", "PyObject_GetIter", "PyBytes_FromStringAndSize",
+    "PyBytes_FromObject", "PyList_New", "PyDict_New", "PyTuple_New",
+    "PyUnicode_DecodeUTF8", "PyDict_Keys", "PyObject_CallFunctionObjArgs",
+    "PyMemoryView_FromObject", "PyList_AsTuple",
+}
+
+#: calls that STEAL a reference passed to them (ownership transferred)
+TRANSFER_FNS = {
+    "PyTuple_SET_ITEM", "PyList_SET_ITEM", "PyTuple_SetItem",
+    "PyList_SetItem", "PyModule_AddObject", "Py_BuildValue",
+}
+
+_SUPPRESS_RE = re.compile(r"c?lint:\s*allow\(\s*([a-z_,\s]+?)\s*\)")
+
+
+def native_paths(root: Optional[str] = None) -> List[str]:
+    """The C lint target set: every native extension source."""
+    root = root or _repo_root()
+    src = os.path.join(root, "corda_tpu", "native", "src")
+    out: List[str] = []
+    if os.path.isdir(src):
+        for fn in sorted(os.listdir(src)):
+            if fn.endswith((".c", ".cc", ".cpp")):
+                out.append(os.path.join(src, fn))
+    return out
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+class Tok:
+    __slots__ = ("text", "line")
+
+    def __init__(self, text: str, line: int):
+        self.text = text
+        self.line = line
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"Tok({self.text!r}@{self.line})"
+
+
+_TOKEN_RE = re.compile(
+    r'[A-Za-z_][A-Za-z0-9_]*'            # identifier / keyword
+    r'|"(?:[^"\\\n]|\\.)*"'              # string literal (kept: formats)
+    r"|'(?:[^'\\\n]|\\.)*'"              # char literal
+    r'|0[xX][0-9a-fA-F]+|\d+\.?\d*'      # numbers
+    r'|::|->|\S'                         # punctuation (1 char + :: ->)
+)
+
+
+def _strip_comments(src: str) -> str:
+    """Replace comments with spaces, preserving line structure.  String
+    literals survive (PyArg formats are needed); preprocessor lines are
+    blanked (macro bodies would confuse the function scanner)."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    state = "code"  # code | block | line | str | chr
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "*":
+                state = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "/":
+                state = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                state = "str"
+            elif c == "'":
+                state = "chr"
+            out.append(c)
+        elif state == "block":
+            if c == "*" and nxt == "/":
+                state = "code"
+                out.append("  ")
+                i += 2
+                continue
+            out.append(c if c == "\n" else " ")
+        elif state == "line":
+            if c == "\n":
+                state = "code"
+                out.append(c)
+            else:
+                out.append(" ")
+        elif state == "str":
+            if c == "\\":
+                out.append(c + nxt)
+                i += 2
+                continue
+            if c == '"' or c == "\n":
+                state = "code"
+            out.append(c)
+        elif state == "chr":
+            if c == "\\":
+                out.append(c + nxt)
+                i += 2
+                continue
+            if c == "'" or c == "\n":
+                state = "code"
+            out.append(c)
+        i += 1
+    # blank preprocessor directives (with backslash continuations)
+    lines = "".join(out).split("\n")
+    blank_next = False
+    for j, ln in enumerate(lines):
+        if blank_next or ln.lstrip().startswith("#"):
+            blank_next = ln.rstrip().endswith("\\")
+            lines[j] = ""
+    return "\n".join(lines)
+
+
+def _tokenize(cleaned: str) -> List[Tok]:
+    toks: List[Tok] = []
+    for lineno, ln in enumerate(cleaned.split("\n"), start=1):
+        for m in _TOKEN_RE.finditer(ln):
+            toks.append(Tok(m.group(0), lineno))
+    return toks
+
+
+class _CFile:
+    """Tokenized C source + structural indexes (paren/brace matching,
+    function spans, suppression table)."""
+
+    def __init__(self, path: str, relpath: str, src: str):
+        self.relpath = relpath
+        self.raw_lines = src.split("\n")
+        self.toks = _tokenize(_strip_comments(src))
+        self.suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.raw_lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppress[i] = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                }
+        self.match = self._match_pairs()
+        self.functions = self._find_functions()
+
+    def suppressed(self, pass_id: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            ids = self.suppress.get(ln)
+            if ids and (pass_id in ids or "all" in ids):
+                return True
+        return False
+
+    def _match_pairs(self) -> Dict[int, int]:
+        """open-index -> close-index for () and {} (and the reverse)."""
+        match: Dict[int, int] = {}
+        stack: List[Tuple[str, int]] = []
+        for i, t in enumerate(self.toks):
+            if t.text in "({":
+                stack.append((t.text, i))
+            elif t.text in ")}":
+                want = "(" if t.text == ")" else "{"
+                # tolerate imbalance (macro remnants): pop to the match
+                while stack and stack[-1][0] != want:
+                    stack.pop()
+                if stack:
+                    _, j = stack.pop()
+                    match[j] = i
+                    match[i] = j
+        return match
+
+    _NOT_FN = {"if", "for", "while", "switch", "catch", "return", "sizeof",
+               "defined"}
+
+    def _find_functions(self) -> List[Tuple[str, int, int, int]]:
+        """[(name, body_open_idx, body_close_idx, def_line)] for every
+        function definition: ``ident ( ... ) [const...] {``."""
+        out = []
+        toks = self.toks
+        i = 0
+        inside_until = -1
+        while i < len(toks):
+            if toks[i].text == "{" and i > inside_until:
+                j = i - 1
+                while j >= 0 and toks[j].text in ("const", "noexcept",
+                                                  "override", "final"):
+                    j -= 1
+                if j >= 0 and toks[j].text == ")" and toks[j] is not None \
+                        and j in self.match:
+                    op = self.match[j]
+                    k = op - 1
+                    if k >= 0 and re.match(r"^[A-Za-z_]\w*$", toks[k].text) \
+                            and toks[k].text not in self._NOT_FN:
+                        close = self.match.get(i)
+                        if close is not None:
+                            out.append((toks[k].text, i, close, toks[k].line))
+                            inside_until = close
+            i += 1
+        return out
+
+    def func_at(self, idx: int) -> str:
+        for name, op, close, _ln in self.functions:
+            if op <= idx <= close:
+                return name
+        return "<toplevel>"
+
+
+# -- pass: gil_region ---------------------------------------------------------
+
+def _pass_gil_region(cf: _CFile) -> List[Finding]:
+    findings: List[Finding] = []
+    toks = cf.toks
+    in_region_since: Optional[int] = None
+    seen_in_region: Set[str] = set()
+    for i, t in enumerate(toks):
+        if t.text == "Py_BEGIN_ALLOW_THREADS":
+            in_region_since = i
+            seen_in_region = set()
+            continue
+        if t.text in ("Py_END_ALLOW_THREADS", "Py_BLOCK_THREADS"):
+            in_region_since = None
+            continue
+        if t.text == "Py_UNBLOCK_THREADS":
+            in_region_since = i
+            seen_in_region = set()
+            continue
+        if in_region_since is None:
+            continue
+        name = t.text
+        if not _PYAPI_RE.match(name) or name in GIL_FREE_ALLOWLIST:
+            continue
+        if name in seen_in_region:
+            continue  # one finding per API name per region
+        seen_in_region.add(name)
+        if cf.suppressed("gil_region", t.line):
+            continue
+        func = cf.func_at(i)
+        findings.append(Finding(
+            "gil_region", cf.relpath, t.line, f"{func}:{name}",
+            f"CPython API {name} used inside a Py_BEGIN_ALLOW_THREADS "
+            f"region in {func} (the GIL is NOT held there; allowlist or "
+            f"re-acquire with Py_BLOCK_THREADS)",
+        ))
+    return findings
+
+
+# -- shared leak engine (buffer_release / refcount_escape) --------------------
+
+class _Tracked:
+    __slots__ = ("var", "kind", "line", "exempt_span", "origin")
+
+    def __init__(self, var, kind, line, exempt_span, origin):
+        self.var = var
+        self.kind = kind            # "buffer" | "alloc"
+        self.line = line
+        self.exempt_span = exempt_span  # (lo, hi) token idx or None
+        self.origin = origin        # allocator name
+
+
+def _call_args(cf: _CFile, open_idx: int) -> List[List[Tok]]:
+    """Top-level comma-split argument token lists of a call."""
+    close = cf.match.get(open_idx)
+    if close is None:
+        return []
+    args: List[List[Tok]] = [[]]
+    depth = 0
+    for i in range(open_idx + 1, close):
+        t = cf.toks[i]
+        if t.text in "([{":
+            depth += 1
+        elif t.text in ")]}":
+            depth -= 1
+        if t.text == "," and depth == 0:
+            args.append([])
+        else:
+            args[-1].append(t)
+    return [a for a in args if a]
+
+
+def _amp_base(arg: List[Tok]) -> Optional[str]:
+    """``&ident`` or ``&ident[...]`` -> ident (an lvalue the caller owns)."""
+    if len(arg) >= 2 and arg[0].text == "&" \
+            and re.match(r"^[A-Za-z_]\w*$", arg[1].text):
+        if len(arg) == 2 or arg[2].text == "[":
+            return arg[1].text
+    return None
+
+
+def _enclosing_if_guard(cf: _CFile, idx: int,
+                        fstart: int) -> Optional[Tuple[int, int]]:
+    """If token idx sits inside an ``if (...)`` condition, return the
+    span of that if's BODY (guard block: acquisition-failure returns in
+    there are exempt)."""
+    toks = cf.toks
+    # walk back over enclosing open parens
+    depth = 0
+    j = idx
+    while j > fstart:
+        t = toks[j].text
+        if t == ")":
+            depth += 1
+        elif t == "(":
+            if depth == 0:
+                if j - 1 >= 0 and toks[j - 1].text == "if":
+                    close = cf.match.get(j)
+                    if close is None:
+                        return None
+                    body_start = close + 1
+                    if body_start < len(toks) \
+                            and toks[body_start].text == "{":
+                        return (body_start, cf.match.get(body_start,
+                                                         body_start))
+                    # single statement body: to the next ';'
+                    k = body_start
+                    while k < len(toks) and toks[k].text != ";":
+                        k += 1
+                    return (body_start, k)
+                return None
+            depth -= 1
+        j -= 1
+    return None
+
+
+def _null_guard_span(cf: _CFile, semi_idx: int,
+                     var: str) -> Optional[Tuple[int, int]]:
+    """``X = alloc(...); if (!X ...) { ... }`` -> the guard body span.
+    Up to three simple statements may sit between the allocation and
+    its guard (`t = PyList_AsTuple(k); Py_DECREF(k); if (!t) ...`)."""
+    toks = cf.toks
+    i = semi_idx + 1
+    for _ in range(3):
+        if i < len(toks) and toks[i].text == "if":
+            break
+        # skip one simple statement (no control flow)
+        j = i
+        while j < len(toks) and toks[j].text not in (";", "{", "}"):
+            j += 1
+        if j >= len(toks) or toks[j].text != ";":
+            return None
+        i = j + 1
+    if i + 1 >= len(toks) or toks[i].text != "if" or toks[i + 1].text != "(":
+        return None
+    cond_close = cf.match.get(i + 1)
+    if cond_close is None:
+        return None
+    cond = [t.text for t in toks[i + 2:cond_close]]
+    negated = any(
+        cond[k] == "!" and k + 1 < len(cond) and cond[k + 1] == var
+        for k in range(len(cond))
+    ) or any(
+        cond[k] == var and k + 1 < len(cond) and cond[k + 1] == "=="
+        and k + 2 < len(cond) and cond[k + 2] in ("NULL", "nullptr", "0")
+        for k in range(len(cond))
+    )
+    if not negated:
+        return None
+    body_start = cond_close + 1
+    if body_start < len(toks) and toks[body_start].text == "{":
+        return (body_start, cf.match.get(body_start, body_start))
+    k = body_start
+    while k < len(toks) and toks[k].text != ";":
+        k += 1
+    return (body_start, k)
+
+
+def _label_sections(cf: _CFile, fstart: int, fend: int) -> Dict[str, int]:
+    """goto-label name -> token index of the label, within a function."""
+    out: Dict[str, int] = {}
+    toks = cf.toks
+    for i in range(fstart, fend):
+        if toks[i].text == ":" and i > fstart \
+                and re.match(r"^[A-Za-z_]\w*$", toks[i - 1].text):
+            # a label is `ident :` at statement start: previous
+            # significant token is ; { } or a label's own colon
+            prev = toks[i - 2].text if i - 2 >= fstart else "{"
+            if prev in (";", "{", "}", ":"):
+                # exclude ternary `? x :` and case labels
+                if toks[i - 1].text not in ("default", "case", "public",
+                                            "private", "protected"):
+                    out[toks[i - 1].text] = i
+    return out
+
+
+def _section_releases(cf: _CFile, start: int, fend: int, var: str,
+                      release_fns: Set[str]) -> bool:
+    toks = cf.toks
+    i = start
+    while i < fend:
+        if toks[i].text in release_fns and i + 1 < fend \
+                and toks[i + 1].text == "(":
+            close = cf.match.get(i + 1, i + 1)
+            if any(toks[k].text == var for k in range(i + 2, close)):
+                return True
+        if toks[i].text == "delete" and i + 1 < fend \
+                and toks[i + 1].text == var:
+            return True
+        i += 1
+    return False
+
+
+def _leak_engine(
+    cf: _CFile, pass_id: str,
+    acquire, release_fns: Set[str], what: str,
+) -> List[Finding]:
+    """Linear lexical scan per function: acquisitions must meet a
+    release/transfer before any early return, or ride a goto whose
+    label section releases them.  `acquire(cf, i)` returns
+    (var, origin) when token i starts an acquisition."""
+    findings: List[Finding] = []
+    toks = cf.toks
+    for fname, fopen, fclose, _defline in cf.functions:
+        labels = _label_sections(cf, fopen, fclose)
+        tracked: Dict[str, _Tracked] = {}
+        i = fopen
+        while i < fclose:
+            t = toks[i]
+            acq = acquire(cf, i, fopen)
+            if acq is not None:
+                var, origin, exempt = acq
+                tracked[var] = _Tracked(var, pass_id, t.line, exempt, origin)
+                i += 1
+                continue
+            # releases
+            if t.text in release_fns and i + 1 < fclose \
+                    and toks[i + 1].text == "(":
+                close = cf.match.get(i + 1, i + 1)
+                inner = {toks[k].text for k in range(i + 2, close)}
+                for var in list(tracked):
+                    if var in inner:
+                        del tracked[var]
+                i = close
+                continue
+            if t.text == "delete" and i + 1 < fclose \
+                    and toks[i + 1].text in tracked:
+                del tracked[toks[i + 1].text]
+                i += 2
+                continue
+            # ownership transfers
+            if t.text in TRANSFER_FNS and i + 1 < fclose \
+                    and toks[i + 1].text == "(":
+                close = cf.match.get(i + 1, i + 1)
+                inner = {toks[k].text for k in range(i + 2, close)}
+                for var in list(tracked):
+                    if var in inner:
+                        del tracked[var]
+                i = close
+                continue
+            # plain move: `y = x ;`
+            if t.text == "=" and i + 2 < fclose \
+                    and toks[i + 1].text in tracked \
+                    and toks[i + 2].text == ";":
+                del tracked[toks[i + 1].text]
+                i += 3
+                continue
+            if t.text == "goto" and i + 1 < fclose:
+                label = toks[i + 1].text
+                sec = labels.get(label)
+                for var in list(tracked):
+                    rec = tracked.pop(var)
+                    if rec.exempt_span and \
+                            rec.exempt_span[0] <= i <= rec.exempt_span[1]:
+                        continue
+                    if sec is not None and _section_releases(
+                        cf, sec, fclose, var, release_fns
+                    ):
+                        continue
+                    if cf.suppressed(pass_id, t.line):
+                        continue
+                    findings.append(Finding(
+                        pass_id, cf.relpath, t.line, f"{fname}:{var}",
+                        f"{what} `{var}` (from {rec.origin}, line "
+                        f"{rec.line}) leaks on `goto {label}` in {fname}: "
+                        f"the epilogue never releases it",
+                    ))
+                i += 2
+                continue
+            if t.text == "return":
+                # everything mentioned in the return expression is
+                # returned or transferred (`return Py_BuildValue("(NN)",
+                # arena, offsets)`), not leaked
+                k = i + 1
+                ret_idents: Set[str] = set()
+                while k < fclose and toks[k].text != ";":
+                    ret_idents.add(toks[k].text)
+                    k += 1
+                for var in list(tracked):
+                    rec = tracked[var]
+                    if var in ret_idents:
+                        del tracked[var]
+                        continue
+                    if rec.exempt_span and \
+                            rec.exempt_span[0] <= i <= rec.exempt_span[1]:
+                        continue
+                    del tracked[var]
+                    if cf.suppressed(pass_id, t.line):
+                        continue
+                    findings.append(Finding(
+                        pass_id, cf.relpath, t.line, f"{fname}:{var}",
+                        f"{what} `{var}` (from {rec.origin}, line "
+                        f"{rec.line}) leaks on this early return in "
+                        f"{fname}: no release on the path",
+                    ))
+                i += 1
+                continue
+            i += 1
+    return findings
+
+
+# -- pass: buffer_release -----------------------------------------------------
+
+def _py_buffer_decls(cf: _CFile, fopen: int, fclose: int) -> Set[str]:
+    """Names declared ``Py_buffer NAME`` (values, not pointers) in a
+    function body; the scan starts a little before the body brace so
+    parameter-list declarations count too."""
+    out: Set[str] = set()
+    for i in range(max(0, fopen - 40), fclose):
+        if cf.toks[i].text == "Py_buffer" and i + 1 < fclose \
+                and re.match(r"^[A-Za-z_]\w*$", cf.toks[i + 1].text):
+            out.add(cf.toks[i + 1].text)
+    return out
+
+
+def _pass_buffer_release(cf: _CFile) -> List[Finding]:
+    decls_cache: Dict[int, Set[str]] = {}
+
+    def decls_for(fopen: int, fclose: int) -> Set[str]:
+        if fopen not in decls_cache:
+            decls_cache[fopen] = _py_buffer_decls(cf, fopen, fclose)
+        return decls_cache[fopen]
+
+    def acquire(cfile: _CFile, i: int, fstart: int):
+        toks = cfile.toks
+        t = toks[i]
+        fspan = next(
+            ((op, cl) for _n, op, cl, _l in cfile.functions
+             if op <= i <= cl), None,
+        )
+        if fspan is None:
+            return None
+        if t.text == "PyObject_GetBuffer" and i + 1 < len(toks) \
+                and toks[i + 1].text == "(":
+            args = _call_args(cfile, i + 1)
+            if len(args) >= 2:
+                base = _amp_base(args[1])
+                if base:
+                    exempt = _enclosing_if_guard(cfile, i, fstart)
+                    return (base, "PyObject_GetBuffer", exempt)
+            return None
+        if t.text in ("PyArg_ParseTuple", "PyArg_ParseTupleAndKeywords") \
+                and i + 1 < len(toks) and toks[i + 1].text == "(":
+            args = _call_args(cfile, i + 1)
+            fmt = next(
+                (a[0].text for a in args
+                 if len(a) == 1 and a[0].text.startswith('"')), "",
+            )
+            if not any(code in fmt for code in ("y*", "s*", "w*", "z*")):
+                return None
+            declared = decls_for(*fspan)
+            for a in args[1:]:
+                base = _amp_base(a)
+                if base and base in declared:
+                    exempt = _enclosing_if_guard(cfile, i, fstart)
+                    return (base, f"{t.text}(\"{fmt.strip(chr(34))}\")",
+                            exempt)
+        return None
+
+    return _leak_engine(
+        cf, "buffer_release", acquire, {"PyBuffer_Release"},
+        "buffer-protocol view",
+    )
+
+
+# -- pass: refcount_escape ----------------------------------------------------
+
+def _pass_refcount_escape(cf: _CFile) -> List[Finding]:
+    def acquire(cfile: _CFile, i: int, fstart: int):
+        toks = cfile.toks
+        t = toks[i]
+        # `var = ALLOC (` — plain local lvalue only (members excluded:
+        # their ownership usually lives in a container with its own
+        # cleanup, e.g. the codec Plan)
+        if t.text in ALLOC_FNS and i >= 2 and i + 1 < len(toks) \
+                and toks[i + 1].text == "(" and toks[i - 1].text == "=" \
+                and re.match(r"^[A-Za-z_]\w*$", toks[i - 2].text) \
+                and (i - 3 < 0 or toks[i - 3].text not in (".", "->")):
+            var = toks[i - 2].text
+            exempt = _enclosing_if_guard(cfile, i, fstart)
+            if exempt is None:
+                semi = i
+                close = cfile.match.get(i + 1, i + 1)
+                k = close
+                while k < len(toks) and toks[k].text != ";":
+                    k += 1
+                semi = k
+                exempt = _null_guard_span(cfile, semi, var)
+            return (var, t.text, exempt)
+        return None
+
+    findings = _leak_engine(
+        cf, "refcount_escape", acquire, RELEASE_FNS, "owned allocation",
+    )
+    # unguarded `new`: bad_alloc across the ctypes C ABI aborts the
+    # process — native code must use std::nothrow and fail the call
+    if cf.relpath.endswith((".cc", ".cpp")):
+        toks = cf.toks
+        for i, t in enumerate(toks):
+            if t.text != "new":
+                continue
+            if i + 1 < len(toks) and toks[i + 1].text == "(":
+                close = cf.match.get(i + 1, i + 1)
+                inner = [toks[k].text for k in range(i + 2, close)]
+                if "nothrow" in inner:
+                    continue
+            if cf.suppressed("refcount_escape", t.line):
+                continue
+            func = cf.func_at(i)
+            tname = toks[i + 1].text if i + 1 < len(toks) else "?"
+            findings.append(Finding(
+                "refcount_escape", cf.relpath, t.line, f"{func}:new",
+                f"unguarded `new {tname}` in {func}: a thrown bad_alloc "
+                f"crosses the ctypes C ABI and aborts the process — use "
+                f"`new (std::nothrow)` and fail the call",
+            ))
+    return findings
+
+
+# -- driver -------------------------------------------------------------------
+
+_PASS_FNS = {
+    "gil_region": _pass_gil_region,
+    "buffer_release": _pass_buffer_release,
+    "refcount_escape": _pass_refcount_escape,
+}
+
+
+def run_passes(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run the C-source passes over `paths` (default: every file under
+    native/src/) and return findings with stable, de-duplicated keys."""
+    root = root or _repo_root()
+    paths = list(paths) if paths is not None else native_paths(root)
+    passes = list(passes) if passes is not None else list(PASS_IDS)
+    findings: List[Finding] = []
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        cf = _CFile(path, rel, src)
+        for pid in passes:
+            fn = _PASS_FNS.get(pid)
+            if fn is not None:
+                findings.extend(fn(cf))
+    return _dedup(findings)

@@ -188,6 +188,13 @@ _ENTRIES = [
        "verifier signature batcher max batch size"),
     _k("CORDA_TPU_BATCHER_LINGER_MS", "2.0", "docs/perf-system.md",
        "batcher linger before a partial flush (ms)"),
+    # -- native-plane sanitizers / arena checker (this PR) --------------------
+    _k("CORDA_TPU_ARENA_CHECK", "0", "docs/static-analysis.md",
+       "1 arms the zero-copy arena lifetime checker (poisoned arenas, "
+       "typed use-after-drain errors with creation stacks)"),
+    _k("CORDA_TPU_SANITIZE", "unset", "docs/static-analysis.md",
+       "asan|ubsan: native loader builds/loads instrumented extension "
+       "variants (set by the corda_tpu.analysis.sanitize runner)"),
     # -- bench --------------------------------------------------------------
     _k("CORDA_TPU_BENCH_FORCE_CPU", "unset", "docs/hardware-runbook.md",
        "1 = bench.py skips the TPU probe and runs CPU-only"),

@@ -22,6 +22,7 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence
 
+from . import clint
 from .astlint import Finding, PASS_IDS, run_passes
 
 MANIFEST_PATH = os.path.join(
@@ -32,6 +33,42 @@ DEFAULT_TOLERANCE = 0.05
 
 #: the kernel-jaxpr lint metrics the manifest pins and gates
 KERNEL_METRICS = ("dynamic_update_slice", "dynamic_loops")
+
+#: every pinnable pass: the Python-plane ast passes plus the
+#: native-plane C-source passes (clint)
+ALL_PASS_IDS = tuple(PASS_IDS) + tuple(clint.PASS_IDS)
+
+
+def run_all_passes(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run the Python ast passes AND the native C-source passes, split
+    by file extension when explicit paths are given.  This is what the
+    baseline pin/check and the CLI gate against."""
+    wanted = list(passes) if passes is not None else list(ALL_PASS_IDS)
+    py_passes = [p for p in wanted if p in PASS_IDS]
+    c_passes = [p for p in wanted if p in clint.PASS_IDS]
+    findings: List[Finding] = []
+    if paths is not None:
+        # everything that is not a C/C++ source goes to the ast suite:
+        # an unparsable explicit path must surface as a 'file does not
+        # parse' finding, never count as linted-clean unexamined
+        c_paths = [p for p in paths if p.endswith((".c", ".cc", ".cpp"))]
+        py_paths = [p for p in paths if p not in c_paths]
+        if py_passes and py_paths:
+            findings.extend(run_passes(paths=py_paths, root=root,
+                                       passes=py_passes))
+        if c_passes and c_paths:
+            findings.extend(clint.run_passes(paths=c_paths, root=root,
+                                             passes=c_passes))
+        return findings
+    if py_passes:
+        findings.extend(run_passes(root=root, passes=py_passes))
+    if c_passes:
+        findings.extend(clint.run_passes(root=root, passes=c_passes))
+    return findings
 
 
 def load_manifest(path: Optional[str] = None) -> Dict:
@@ -52,17 +89,17 @@ def pin_manifest(
     passes, keeping every other pass's accepted baseline (re-pinning
     one pass must never resurrect the others' findings as NEW)."""
     if findings is None:
-        findings = run_passes(passes=passes)
+        findings = run_all_passes(passes=passes)
     existing: Dict = {}
     try:
         existing = load_manifest(path)
     except (OSError, ValueError):
         pass  # first pin
-    repinned = set(passes) if passes is not None else set(PASS_IDS)
+    repinned = set(passes) if passes is not None else set(ALL_PASS_IDS)
     baseline: Dict[str, List[str]] = {
         p: ([] if p in repinned
             else list(existing.get("passes", {}).get(p, [])))
-        for p in PASS_IDS
+        for p in ALL_PASS_IDS
     }
     for f in findings:
         baseline.setdefault(f.pass_id, []).append(f.key)
@@ -99,7 +136,7 @@ def check_findings(
     `new` non-empty = gate failure.
     """
     if findings is None:
-        findings = run_passes()
+        findings = run_all_passes()
     if manifest is None:
         manifest = load_manifest()
     baseline: Dict[str, List[str]] = manifest.get("passes", {})

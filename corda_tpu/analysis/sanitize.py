@@ -1,0 +1,635 @@
+"""ASan/UBSan build-and-run gate for the native extensions
+(docs/static-analysis.md).
+
+The C-source lint (:mod:`.clint`) is lexical; this is the dynamic half:
+build every native extension with ``-fsanitize=address`` or
+``-fsanitize=undefined`` and execute the codec/pump differential parity
+and fuzz suites — the same contracts tests/test_pumpcore.py pins —
+under the instrumented binaries, with leak checking, so buffer
+overflows, use-after-free, UB and native leaks surface as NAMED
+findings instead of latent corruption.
+
+Process shape: the instrumented .so cannot load into THIS process (an
+ASan library requires the asan runtime to be the first loaded object),
+so the runner spawns one CHILD python per sanitizer with
+``CORDA_TPU_SANITIZE=<mode>`` (the native loader then builds/loads
+``build/<name>.<mode>.so``) and, for asan, ``LD_PRELOAD=libasan``.
+The child builds, runs the suites, triggers a recoverable leak check,
+and writes a JSON report; the parent parses the sanitizer log files
+into findings.
+
+Exit codes (the CI contract):
+  0  clean, OR classified skip (no compiler / no sanitizer runtime —
+     a NOTICE, since the no-toolchain container is supported)
+  1  sanitizer report / suite failure under the sanitizer
+  2  usage / infrastructure error
+
+Child exit codes: 0 ok, 2 suite assertion failed, 3 classified skip,
+97 sanitizer hard error (ASAN_OPTIONS exitcode).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+from .astlint import _repo_root
+
+SANITIZERS = ("asan", "ubsan")
+
+_CHILD_TIMEOUT = 240
+_HARD_ERROR_EXIT = 97
+
+#: sanitizer-report classifiers -> finding kind
+_REPORT_RES = (
+    (re.compile(r"ERROR: AddressSanitizer:?\s+([-\w]+)"), "{0}"),
+    (re.compile(r"ERROR: LeakSanitizer: detected memory leaks"), "leak"),
+    (re.compile(r"runtime error:\s+(.+)"), "ub: {0}"),
+    (re.compile(r"AddressSanitizer:?\s*DEADLYSIGNAL"), "deadly-signal"),
+)
+
+
+def _runtime_lib(mode: str) -> Optional[str]:
+    """Resolve the sanitizer runtime shared object (ELF, not a linker
+    script) for LD_PRELOAD, or None when the toolchain lacks it."""
+    name = {"asan": "libasan.so", "ubsan": "libubsan.so"}[mode]
+    for compiler in ("gcc", "g++"):
+        if shutil.which(compiler) is None:
+            continue
+        try:
+            out = subprocess.run(
+                [compiler, f"-print-file-name={name}"],
+                capture_output=True, text=True, timeout=30,
+            ).stdout.strip()
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if not out or out == name:
+            continue
+        candidates = [out]
+        d = os.path.dirname(out)
+        if os.path.isdir(d):
+            candidates += sorted(
+                os.path.join(d, fn) for fn in os.listdir(d)
+                if fn.startswith(name + ".")
+            )
+        for cand in candidates:
+            try:
+                with open(cand, "rb") as fh:
+                    if fh.read(4) == b"\x7fELF":
+                        return os.path.abspath(cand)
+            except OSError:
+                continue
+    return None
+
+
+def classify_skip(mode: str) -> Optional[str]:
+    """Why this box cannot run `mode`, or None when it can."""
+    if shutil.which("gcc") is None or shutil.which("g++") is None:
+        return "no_compiler"
+    if _runtime_lib(mode) is None:
+        return f"no_{mode}_runtime"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Child: build + run the suites under the instrumented extensions
+# ---------------------------------------------------------------------------
+
+#: built-in malformed decode corpus (mirrors test_pumpcore.MALFORMED);
+#: tests/corpus/decode/*.bin extends it when present
+BUILTIN_MALFORMED = [
+    b"XX\x01\x00",
+    b"CT\x01",
+    b"CT\x01\x63",
+    b"CT\x01\x04\x05abc",
+    b"CT\x01\x05\x03ab",
+    b"CT\x01\x09\x04",
+    b"CT\x01\x03" + b"\x80" * 95,
+    b"CT\x01\x03" + b"\x80" * 95 + b"\x01",
+    b"CT\x01\x04" + b"\xff" * 8 + b"\x7f",
+    b"CT\x01\x08\x03abc",
+    b"CT\x01\x06\x02\x00",
+    b"CT\x01" + bytes([6, 1]) * 150 + b"\x00",
+]
+
+
+def corpus_frames(root: Optional[str] = None) -> List[bytes]:
+    """The committed malformed-frame regression corpus
+    (tests/corpus/decode/*.bin), empty when absent."""
+    root = root or _repo_root()
+    d = os.path.join(root, "tests", "corpus", "decode")
+    out: List[bytes] = []
+    if os.path.isdir(d):
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".bin"):
+                with open(os.path.join(d, fn), "rb") as fh:
+                    out.append(fh.read())
+    return out
+
+
+def _suite_codec(counts: Dict[str, int]) -> None:
+    """Differential fuzz: batch + single-shot codec vs the pure-Python
+    reference, byte-for-byte, under the sanitizer."""
+    import random
+
+    from ..core.serialization import codec
+
+    sys.path.insert(0, os.path.join(_repo_root(), "tests"))
+    try:
+        from test_pumpcore import _gen_value  # the shared generator
+    except ImportError:  # corpus-only environments
+        def _gen_value(rng, depth=0):
+            return {"k": rng.randbytes(8), "n": rng.randint(-2**70, 2**70),
+                    "l": [rng.random() > 0.5, None, "s" * rng.randint(0, 9)]}
+    rng = random.Random(20260804)
+    values = [_gen_value(rng) for _ in range(150)]
+    frames = codec.serialize_many(values)
+    for v, frame in zip(values, frames):
+        ref = bytearray(codec._MAGIC)
+        codec._encode(ref, v)
+        assert bytes(frame) == bytes(ref), f"encode parity broke: {v!r}"
+        assert codec.deserialize(bytes(frame)) == codec.deserialize_many(
+            [bytes(frame)]
+        )[0]
+    counts["codec_roundtrips"] = len(values)
+
+
+def _suite_malformed(counts: Dict[str, int]) -> None:
+    """Replay the malformed-frame corpus against BOTH codec paths with
+    error-taxonomy parity — under the sanitizer, a hostile frame must
+    fail typed (with the SAME message the pure-Python decoder gives) or
+    decode to the same value, never corrupt."""
+    from ..core.serialization import codec
+    from ..core.serialization.codec import SerializationError
+
+    def native_outcome(frame):
+        try:
+            return ("ok", codec.deserialize(frame))
+        except SerializationError as exc:
+            return ("err", str(exc))
+
+    def python_outcome(frame):
+        data = bytes(frame)
+        try:
+            if data[: len(codec._MAGIC)] != codec._MAGIC:
+                raise SerializationError(
+                    "bad magic / unsupported format version"
+                )
+            value, pos = codec._decode(data, len(codec._MAGIC))
+            if pos != len(data):
+                raise SerializationError(
+                    f"{len(data) - pos} trailing bytes"
+                )
+            return ("ok", value)
+        except SerializationError as exc:
+            return ("err", str(exc))
+
+    frames = BUILTIN_MALFORMED + corpus_frames()
+    for frame in frames:
+        native = native_outcome(frame)
+        python = python_outcome(frame)
+        assert native == python, (
+            f"taxonomy divergence on {frame!r}: {native!r} vs {python!r}"
+        )
+        try:
+            many = ("ok", codec.deserialize_many([frame])[0])
+        except SerializationError as exc:
+            many = ("err", str(exc))
+        assert many == native, f"batch divergence on {frame!r}"
+    counts["malformed_frames"] = len(frames)
+
+
+def _suite_pump(counts: Dict[str, int]) -> None:
+    """Wire framing fuzz through the native pump primitives."""
+    import random
+
+    from ..messaging import pumpcore
+
+    rng = random.Random(97)
+    msgs = []
+    for i in range(64):
+        headers = {
+            f"k{j}": "".join(rng.choice("abz0-:漢") for _ in range(
+                rng.randint(0, 12)))
+            for j in range(rng.randint(0, 5))
+        }
+        msgs.append((f"mid-{i}", rng.randint(0, 9), headers,
+                     rng.randbytes(rng.randint(0, 512))))
+    reply = pumpcore.frame_msgs(msgs, 0x81)
+    parsed = pumpcore.parse_msgs(reply)
+    assert [(m[0], m[1], m[2], bytes(m[3])) for m in parsed] == [
+        (m[0], m[1], m[2], m[3]) for m in msgs
+    ]
+    items = [(f"q{i}", rng.randbytes(rng.randint(0, 256)),
+              {"x-dest": f"d{i}"}) for i in range(64)]
+    body = pumpcore.frame_send_many(items, 11)
+    parsed_items = pumpcore.parse_send_many(body)
+    assert [(q, bytes(p), h) for q, p, h in parsed_items] == items
+    # header-only extraction over real + empty blobs (the bounds checks)
+    from ..messaging.broker import _encode_headers
+
+    blobs = [
+        _encode_headers({"x-dest": "d1", "traceparent": "00-ab"}),
+        _encode_headers({}),
+        _encode_headers({"k": "v" * 64}),
+    ]
+    rows = pumpcore.parse_headers_many(blobs, ("x-dest", "traceparent"))
+    assert rows[0] == ("d1", "00-ab") and rows[1] == (None, None)
+    hints = ["h:sess-%d" % i for i in range(32)] + ["t:w3-x", None, "bad"]
+    pumpcore.route_hints_many(hints, 4)
+    # malformed wire frames must raise, not crash, under the sanitizer
+    for bad in (b"", b"\x81", b"\x81\x00\x00\x00\x02\x00\x00",
+                reply[:-3], body[:-1], b"\x81" + b"\xff" * 12):
+        for fn in (pumpcore.parse_msgs, pumpcore.parse_send_many):
+            try:
+                fn(bad)
+            except Exception:  # lint: allow(swallow) — any typed raise is the PASS verdict; a crash is what the sanitizer reports
+                pass
+    counts["pump_msgs"] = len(msgs) + len(items)
+
+
+def _suite_native_misc(counts: Dict[str, int]) -> None:
+    """Journal + batch hashing under the sanitizer (the other ctypes
+    entry-point families in corda_native.so)."""
+    import hashlib
+
+    from .. import native
+
+    msgs = [b"x" * n for n in (0, 1, 63, 64, 65, 127, 128, 1000)]
+    assert native.sha256_many(msgs) == [
+        hashlib.sha256(m).digest() for m in msgs
+    ]
+    assert native.sha512_many(msgs) == [
+        hashlib.sha512(m).digest() for m in msgs
+    ]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "j.log")
+        j = native.NativeJournal(path, truncate=True)
+        recs = [(1, b"alpha"), (2, b""), (1, b"b" * 300)]
+        for t, b in recs:
+            j.append(t, b)
+        j.close()
+        assert native.NativeJournal.scan(path) == recs
+    counts["native_misc"] = len(msgs)
+
+
+def _leak_check(report: Dict) -> None:
+    """Trigger LeakSanitizer's recoverable check NOW (leak_check_at_exit
+    is off: at interpreter exit every live Python object would count).
+    Memory still reachable at this point is not a leak — only native
+    allocations the extensions dropped without freeing report."""
+    import ctypes
+
+    try:
+        fn = ctypes.CDLL(None).__lsan_do_recoverable_leak_check
+    except (OSError, AttributeError):
+        report["leak_check"] = "unavailable"
+        return
+    fn.restype = ctypes.c_int
+    report["leak_check"] = "leaks" if fn() else "clean"
+
+
+def run_child(mode: str, report_path: str) -> int:
+    from .. import native
+
+    report: Dict = {"mode": mode, "ok": False}
+    status = native.build_all(sanitize=mode)
+    report["build"] = status
+    bad = [e for e, s in status.items() if not s["available"]]
+    if bad:
+        reason = status[bad[0]].get("reason") or "unknown"
+        if reason.startswith(("no_compiler", "no_python_headers")):
+            # genuinely-absent toolchain: the classified 0-with-notice
+            # skip.  Anything else (compile_error under the sanitize
+            # flags, load_error, missing_symbol) is a FAILURE — the
+            # parent already proved compiler+runtime exist, so a gate
+            # that skipped here would go silently green with no
+            # sanitized code ever run
+            report["skip"] = reason
+            with open(report_path, "w") as fh:
+                json.dump(report, fh)
+            return 3
+        report["error"] = (
+            f"instrumented build failed: {bad[0]}: {reason}"
+        )
+        with open(report_path, "w") as fh:
+            json.dump(report, fh)
+        return 2
+    counts: Dict[str, int] = {}
+    try:
+        _suite_codec(counts)
+        _suite_malformed(counts)
+        _suite_pump(counts)
+        _suite_native_misc(counts)
+    except AssertionError as exc:
+        report["error"] = str(exc)
+        with open(report_path, "w") as fh:
+            json.dump(report, fh)
+        return 2
+    if mode == "asan":
+        _leak_check(report)
+    report["ok"] = True
+    report["suites"] = counts
+    with open(report_path, "w") as fh:
+        json.dump(report, fh)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Detection canary: prove the harness catches a REAL bug end-to-end
+# ---------------------------------------------------------------------------
+
+_CANARY_SRC = {
+    # one-past-the-end heap write: ASan's bread and butter
+    "asan": """
+#include <stdlib.h>
+void corda_tpu_canary(void) {
+    char *p = malloc(8);
+    p[8] = 1;
+    free(p);
+}
+""",
+    # signed-integer overflow: UBSan's bread and butter
+    "ubsan": """
+int corda_tpu_canary_v = 2147483647;
+void corda_tpu_canary(void) {
+    corda_tpu_canary_v += 1;
+}
+""",
+}
+
+
+def self_test(mode: str, timeout: int = 120) -> Dict:
+    """Compile a deliberately buggy snippet under `mode` and run it
+    through the same child/report plumbing — the gate's own
+    new-finding detection proof (the sanitizer analogue of the lint
+    suite's synthetic violations).  status: detected | missed | skip."""
+    skip = classify_skip(mode)
+    if skip is not None:
+        return {"mode": mode, "status": "skip", "skip_reason": skip}
+    with tempfile.TemporaryDirectory(prefix="corda-tpu-canary-") as tmp:
+        src = os.path.join(tmp, "canary.c")
+        so = os.path.join(tmp, "canary.so")
+        with open(src, "w") as fh:
+            fh.write(_CANARY_SRC[mode])
+        flags = {"asan": ["-fsanitize=address"],
+                 "ubsan": ["-fsanitize=undefined"]}[mode]
+        try:
+            subprocess.run(
+                ["gcc", "-shared", "-fPIC", "-g", "-O1", *flags,
+                 "-o", so, src],
+                check=True, capture_output=True, timeout=60,
+            )
+        except (OSError, subprocess.SubprocessError) as exc:
+            return {"mode": mode, "status": "skip",
+                    "skip_reason": f"canary_build_failed: {exc}"}
+        log_base = os.path.join(tmp, mode)
+        env = dict(os.environ)
+        if mode == "asan":
+            env["LD_PRELOAD"] = _runtime_lib("asan") or ""
+            env["ASAN_OPTIONS"] = (
+                f"exitcode={_HARD_ERROR_EXIT}:abort_on_error=0:"
+                f"log_path={log_base}"
+            )
+        else:
+            env["UBSAN_OPTIONS"] = (
+                f"print_stacktrace=1:halt_on_error=0:log_path={log_base}"
+            )
+        code = (
+            "import ctypes; "
+            f"ctypes.CDLL({so!r}).corda_tpu_canary()"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=env, timeout=timeout,
+                capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return {"mode": mode, "status": "skip",
+                    "skip_reason": "canary_timeout"}
+        findings = _parse_logs(tmp, mode)
+        for rx, kind_fmt in _REPORT_RES:
+            m = rx.search(proc.stderr or "")
+            if m and not findings:
+                findings.append({"sanitizer": mode, "kind": "stderr",
+                                 "log": "stderr",
+                                 "line": m.group(0)[:200]})
+        detected = bool(findings) or proc.returncode == _HARD_ERROR_EXIT
+        return {"mode": mode, "status": "detected" if detected else
+                "missed", "findings": findings,
+                "child_exit": proc.returncode}
+
+
+# ---------------------------------------------------------------------------
+# Parent: orchestrate children, parse reports into findings
+# ---------------------------------------------------------------------------
+
+def _parse_logs(log_dir: str, mode: str) -> List[Dict]:
+    findings: List[Dict] = []
+    if not os.path.isdir(log_dir):
+        return findings
+    for fn in sorted(os.listdir(log_dir)):
+        if not fn.startswith(mode + "."):
+            continue
+        try:
+            with open(os.path.join(log_dir, fn), errors="replace") as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        seen: set = set()
+        summary = ""
+        for line in text.splitlines():
+            m = re.search(r"SUMMARY:\s*(.+)", line)
+            if m:
+                summary = m.group(1)[:200]
+            for rx, kind_fmt in _REPORT_RES:
+                m = rx.search(line)
+                if m:
+                    kind = kind_fmt.format(*m.groups()) if m.groups() \
+                        else kind_fmt
+                    if kind not in seen:
+                        seen.add(kind)
+                        findings.append({
+                            "sanitizer": mode, "kind": kind,
+                            "log": fn, "line": line.strip()[:200],
+                        })
+        for f in findings:
+            f.setdefault("summary", summary)
+    return findings
+
+
+def run_one(mode: str, repo_root: Optional[str] = None,
+            timeout: int = _CHILD_TIMEOUT) -> Dict:
+    """Build + run one sanitizer mode in a child process.  Returns
+    {"mode", "status": clean|findings|skip|error, "findings": [...],
+    "skip_reason", "report": child json}."""
+    repo_root = repo_root or _repo_root()
+    skip = classify_skip(mode)
+    if skip is not None:
+        return {"mode": mode, "status": "skip", "skip_reason": skip,
+                "findings": []}
+    with tempfile.TemporaryDirectory(prefix=f"corda-tpu-{mode}-") as tmp:
+        report_path = os.path.join(tmp, "report.json")
+        log_base = os.path.join(tmp, mode)
+        env = dict(os.environ)
+        env["CORDA_TPU_SANITIZE"] = mode
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("CORDA_TPU_NATIVE_CODEC", None)
+        env.pop("CORDA_TPU_PUMP_NATIVE", None)
+        if mode == "asan":
+            env["LD_PRELOAD"] = _runtime_lib("asan") or ""
+            # pymalloc arenas hide object pointers from LeakSanitizer
+            # (every interned string would report as a leak) and mask
+            # small overflows from ASan's redzones — route CPython's
+            # allocations through raw malloc under the sanitizer
+            env["PYTHONMALLOC"] = "malloc"
+            env["ASAN_OPTIONS"] = (
+                f"detect_leaks=1:leak_check_at_exit=0:"
+                f"exitcode={_HARD_ERROR_EXIT}:abort_on_error=0:"
+                f"log_path={log_base}"
+            )
+            supp = os.path.join(tmp, "lsan.supp")
+            with open(supp, "w") as fh:
+                # interpreter-lifetime allocations (interned strings,
+                # import machinery) are deliberately never freed
+                fh.write("leak:_PyObject_\nleak:PyObject_Malloc\n"
+                         "leak:libpython\nleak:python3\n")
+            env["LSAN_OPTIONS"] = (
+                f"suppressions={supp}:print_suppressions=0"
+            )
+        else:
+            env["UBSAN_OPTIONS"] = (
+                f"print_stacktrace=1:halt_on_error=0:log_path={log_base}"
+            )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "corda_tpu.analysis.sanitize",
+                 "--child", mode, "--report", report_path],
+                capture_output=True, text=True, timeout=timeout,
+                env=env, cwd=repo_root,
+            )
+        except subprocess.TimeoutExpired:
+            return {"mode": mode, "status": "error",
+                    "skip_reason": "child_timeout", "findings": []}
+        report = {}
+        try:
+            with open(report_path) as fh:
+                report = json.load(fh)
+        except (OSError, ValueError):
+            pass
+        findings = _parse_logs(tmp, mode)
+        # stderr also carries reports when log_path misfires
+        for rx, kind_fmt in _REPORT_RES:
+            m = rx.search(proc.stderr or "")
+            if m:
+                kind = kind_fmt.format(*m.groups()) if m.groups() \
+                    else kind_fmt
+                if not any(f["kind"] == kind for f in findings):
+                    findings.append({"sanitizer": mode, "kind": kind,
+                                     "log": "stderr",
+                                     "line": m.group(0)[:200]})
+        if report.get("leak_check") == "leaks" and not any(
+            f["kind"] == "leak" for f in findings
+        ):
+            findings.append({"sanitizer": mode, "kind": "leak",
+                             "log": "lsan", "line": "recoverable leak "
+                             "check reported leaks"})
+        if proc.returncode == 3:
+            return {"mode": mode, "status": "skip",
+                    "skip_reason": report.get("skip", "unknown"),
+                    "findings": findings, "report": report}
+        if findings:
+            return {"mode": mode, "status": "findings",
+                    "findings": findings, "report": report,
+                    "child_exit": proc.returncode}
+        if proc.returncode != 0:
+            return {"mode": mode, "status": "error",
+                    "skip_reason": f"child_exit_{proc.returncode}",
+                    "findings": [],
+                    "report": report,
+                    "stderr_tail": (proc.stderr or "")[-800:]}
+        return {"mode": mode, "status": "clean", "findings": [],
+                "report": report}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m corda_tpu.analysis.sanitize",
+        description="build + run the native codec/pump parity suites "
+                    "under ASan/UBSan (docs/static-analysis.md)",
+    )
+    ap.add_argument("--sanitizer", choices=(*SANITIZERS, "both"),
+                    default="both")
+    ap.add_argument("--timeout", type=int, default=_CHILD_TIMEOUT)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--self-test", action="store_true",
+                    help="prove detection: compile a known-buggy snippet "
+                         "and require the sanitizer to report it")
+    ap.add_argument("--child", choices=SANITIZERS, help=argparse.SUPPRESS)
+    ap.add_argument("--report", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        modes = SANITIZERS if args.sanitizer == "both" \
+            else (args.sanitizer,)
+        rc = 0
+        results = []
+        for m in modes:
+            r = self_test(m, timeout=args.timeout)
+            results.append(r)
+            if r["status"] == "missed":
+                print(f"sanitize[{m}] SELF-TEST FAILED: planted bug not "
+                      f"reported", file=sys.stderr)
+                rc = 1
+            else:
+                print(f"sanitize[{m}] self-test: {r['status']}"
+                      + (f" ({r.get('skip_reason')})"
+                         if r["status"] == "skip" else ""),
+                      file=sys.stderr)
+        if args.json:
+            print(json.dumps({"ok": rc == 0, "results": results},
+                             sort_keys=True, default=str))
+        return rc
+
+    if args.child:
+        if not args.report:
+            print("--child requires --report", file=sys.stderr)
+            return 2
+        return run_child(args.child, args.report)
+
+    modes = SANITIZERS if args.sanitizer == "both" else (args.sanitizer,)
+    results = [run_one(m, timeout=args.timeout) for m in modes]
+    rc = 0
+    for r in results:
+        if r["status"] == "skip":
+            print(f"sanitize[{r['mode']}]: SKIP ({r['skip_reason']}) — "
+                  f"toolchain absent, not a failure", file=sys.stderr)
+        elif r["status"] == "clean":
+            print(f"sanitize[{r['mode']}]: PASS "
+                  f"{json.dumps(r.get('report', {}).get('suites', {}))}",
+                  file=sys.stderr)
+        elif r["status"] == "findings":
+            for f in r["findings"]:
+                print(f"SANITIZER FINDING {r['mode']}:{f['kind']} "
+                      f"[{f['log']}] {f['line']}", file=sys.stderr)
+            rc = 1
+        else:
+            detail = r.get("report", {}).get("error") \
+                or r.get("stderr_tail", "")[-400:]
+            print(f"sanitize[{r['mode']}]: ERROR ({r.get('skip_reason')})"
+                  f" {detail}", file=sys.stderr)
+            rc = 1
+    if args.json:
+        print(json.dumps({"ok": rc == 0, "results": results},
+                         sort_keys=True, default=str))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -386,23 +386,31 @@ def _decode(
         return d, pos
     if tag == _OBJ:
         ln, pos = _read_uvarint(data, pos)
+        if pos + ln > len(data):
+            raise SerializationError("truncated type name")
         type_name = data[pos : pos + ln].decode("utf-8")
         pos += ln
-        if obj_hook is None:
-            entry = _BY_NAME.get(type_name)
-            if entry is None:
-                raise SerializationError(
-                    f"type {type_name!r} not in deserialization whitelist"
-                )
+        # structural errors surface BEFORE the whitelist check, matching
+        # the native decoder (both its single-shot and batch-scan paths
+        # fully parse the frame, then construct): a truncated
+        # unknown-type frame must classify identically on every path —
+        # pinned by the tests/corpus/decode replay
         n, pos = _read_uvarint(data, pos)
         fields = {}
         for _ in range(n):
             fl, pos = _read_uvarint(data, pos)
+            if pos + fl > len(data):
+                raise SerializationError("truncated field name")
             fn = data[pos : pos + fl].decode("utf-8")
             pos += fl
             fields[fn], pos = _decode(data, pos, depth + 1, obj_hook)
         if obj_hook is not None:
             return obj_hook(type_name, fields), pos
+        entry = _BY_NAME.get(type_name)
+        if entry is None:
+            raise SerializationError(
+                f"type {type_name!r} not in deserialization whitelist"
+            )
         try:
             return entry[2](fields), pos
         except TypeError as e:
@@ -462,14 +470,26 @@ def serialize(value: Any) -> bytes:
     return bytes(out)
 
 
+def _arena_unwrap(data):
+    """CORDA_TPU_ARENA_CHECK seam: an armed-mode ArenaView payload
+    (messaging/arenacheck.py) validates its drain-cycle lifetime and
+    hands over the real memoryview; everything else passes through
+    (one getattr miss on the normal plane)."""
+    u = getattr(data, "_arena_unwrap", None)
+    return u() if u is not None else data
+
+
 def deserialize(data: bytes) -> Any:
     if _native_codec is not None:
         # y*-buffer entry point: memoryview payloads (the broker's
         # zero-copy framing plane) decode without an intermediate copy
-        return _native_codec.decode(data, _native_construct, _MAGIC)
+        return _native_codec.decode(
+            _arena_unwrap(data), _native_construct, _MAGIC
+        )
     if not isinstance(data, bytes):
         # the pure-Python decoder slices with .decode(): snapshot
-        # buffer-protocol inputs once here instead
+        # buffer-protocol inputs once here instead (bytes() also
+        # validates an armed-mode ArenaView)
         data = bytes(data)
     if data[: len(_MAGIC)] != _MAGIC:
         raise SerializationError("bad magic / unsupported format version")
@@ -516,7 +536,7 @@ def deserialize_many(frames) -> list:
     then objects materialize in a single GIL-held pass. Error taxonomy
     is identical to a sequential [deserialize(f) for f in frames] — the
     first malformed frame raises SerializationError either way."""
-    frames = list(frames)
+    frames = [_arena_unwrap(f) for f in frames]
     if _native_codec is not None and hasattr(_native_codec, "decode_many"):
         _BATCH_STATS["decode_many_native"] += 1
         return _native_codec.decode_many(frames, _native_construct, _MAGIC)

@@ -1,0 +1,255 @@
+"""Runtime arena-lifetime checker for the zero-copy receive plane
+(``CORDA_TPU_ARENA_CHECK=1``; docs/static-analysis.md).
+
+The wire layer hands out MEMORYVIEW SLICES over a per-drain reply arena
+(messaging/pumpcore.py): zero copies between socket and codec, with the
+contract that a view's lifetime is ONE pump drain cycle — anything that
+must outlive the drain (journal append, re-framing, queue residence)
+snapshots with ``bytes()``.  Today the arena is an immutable bytes
+object, so violating the contract does not corrupt memory — it silently
+PINS the whole arena (the RSS-amplification bug PR 11's review caught
+by hand in OP_SEND_MANY) and would become a real use-after-free the day
+the arena is a recycled native ring.  This checker makes the contract
+mechanical:
+
+* armed (``CORDA_TPU_ARENA_CHECK=1`` or :func:`enable`), each
+  ``RemoteConsumer`` drain copies the reply into a mutable arena and
+  hands out :class:`ArenaView` proxies that record their creation
+  stack;
+* at the next drain the tracker RECYCLES: the old arena is poisoned
+  (overwritten with 0xDD so any raw escaped view reads garbage, never
+  silently-valid stale data) and every outstanding view is expired;
+* touching an expired view raises :class:`ArenaUseAfterDrainError`
+  carrying the view's creation stack, and emits an eventlog ``arena``
+  record — the flight recorder names the offending drain site;
+* off (the default), nothing here is instantiated: the receive path
+  keeps its plain memoryviews and pays zero overhead.
+
+The proxy quacks bytes-like (``bytes()``, ``len``, indexing,
+iteration, equality); true buffer-protocol consumers (the native codec
+and framing entry points) unwrap via the ``_arena_unwrap`` seam, which
+re-validates first.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+_ENABLED = os.environ.get("CORDA_TPU_ARENA_CHECK", "0") == "1"
+
+#: counters for tests/meta (GIL-atomic int adds)
+_STATS = {"cycles": 0, "views": 0, "violations": 0, "poisoned_bytes": 0}
+_stats_lock = threading.Lock()
+
+POISON = 0xDD
+_STACK_LIMIT = 16
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(flag: bool = True) -> None:
+    """Arm/disarm for tests.  Only consumers created AFTER arming are
+    tracked (the zero-overhead contract: existing consumers carry no
+    checker state at all)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def meta() -> Dict[str, int]:
+    with _stats_lock:
+        return dict(_STATS)
+
+
+class ArenaUseAfterDrainError(RuntimeError):
+    """A zero-copy arena view was touched after its drain cycle was
+    recycled.  ``created_stack`` is where the view was handed out."""
+
+    def __init__(self, tracker_name: str, created_stack: str,
+                 cycle: int, current_cycle: int):
+        super().__init__(
+            f"arena view from drain cycle {cycle} of {tracker_name} used "
+            f"after recycle (current cycle {current_cycle}); snapshot "
+            f"with bytes() before the next drain.  View created at:\n"
+            f"{created_stack}"
+        )
+        self.tracker_name = tracker_name
+        self.created_stack = created_stack
+        self.cycle = cycle
+
+
+class _ArenaState:
+    """One drain cycle's arena + expiry flag, shared by its views."""
+
+    __slots__ = ("arena", "expired", "cycle", "tracker", "nviews")
+
+    def __init__(self, arena: bytearray, cycle: int,
+                 tracker: "ArenaTracker"):
+        self.arena = arena
+        self.expired = False
+        self.cycle = cycle
+        self.tracker = tracker
+        self.nviews = 0
+
+    @property
+    def tracker_name(self) -> str:
+        return self.tracker.name
+
+
+class ArenaView:
+    """Expiry-checked bytes-like proxy over one payload slice."""
+
+    __slots__ = ("_mv", "_state", "_stack")
+
+    def __init__(self, mv: memoryview, state: _ArenaState):
+        self._mv = mv
+        self._state = state
+        self._stack = "".join(
+            traceback.format_stack(limit=_STACK_LIMIT)[:-2]
+        )
+        state.nviews += 1
+
+    # -- the contract check ---------------------------------------------
+    def _check(self) -> None:
+        st = self._state
+        if not st.expired:
+            return
+        with _stats_lock:
+            _STATS["violations"] += 1
+        err = ArenaUseAfterDrainError(
+            st.tracker_name, self._stack, st.cycle, st.tracker.cycle
+        )
+        try:
+            from ..utils import eventlog
+
+            eventlog.emit(
+                "error", "arena", "use-after-drain on a zero-copy view",
+                tracker=st.tracker_name, cycle=st.cycle,
+                created_at=self._stack.splitlines()[-1].strip()
+                if self._stack else "?",
+            )
+        except Exception:  # lint: allow(swallow) — the raise below IS the report
+            pass
+        raise err
+
+    def _arena_unwrap(self) -> memoryview:
+        """The buffer-protocol seam (native codec / framing): validate,
+        then hand the real view over."""
+        self._check()
+        return self._mv
+
+    # -- bytes-like surface ---------------------------------------------
+    def __bytes__(self) -> bytes:
+        self._check()
+        return bytes(self._mv)
+
+    def tobytes(self) -> bytes:
+        return self.__bytes__()
+
+    def __len__(self) -> int:
+        self._check()
+        return len(self._mv)
+
+    def __getitem__(self, item):
+        self._check()
+        out = self._mv[item]
+        if isinstance(out, memoryview):  # sub-slices stay checked
+            return ArenaView(out, self._state)
+        return out
+
+    def __iter__(self):
+        self._check()
+        return iter(self._mv)
+
+    def __eq__(self, other) -> bool:
+        self._check()
+        if isinstance(other, ArenaView):
+            other = other.__bytes__()
+        try:
+            return bytes(self._mv) == bytes(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other) -> bool:
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    __hash__ = None  # mutable-backed, like memoryview-over-bytearray
+
+    def hex(self) -> str:
+        self._check()
+        return self._mv.hex()
+
+    @property
+    def nbytes(self) -> int:
+        self._check()
+        return self._mv.nbytes
+
+    @property
+    def obj(self):
+        self._check()
+        return self._mv.obj
+
+    def release(self) -> None:
+        self._mv.release()
+
+    def __repr__(self) -> str:
+        st = self._state
+        return (f"<ArenaView cycle={st.cycle} of {st.tracker_name}"
+                f"{' EXPIRED' if st.expired else ''}>")
+
+
+class ArenaTracker:
+    """Per-consumer drain-cycle bookkeeping (one per RemoteConsumer
+    when armed)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._state: Optional[_ArenaState] = None
+        self._cycle = 0
+
+    def new_cycle(self, reply: bytes) -> bytearray:
+        """Recycle the previous arena (poison + expire its views) and
+        open a new cycle over a MUTABLE copy of `reply` (mutability is
+        what makes poisoning possible)."""
+        self.recycle()
+        self._cycle += 1
+        with _stats_lock:
+            _STATS["cycles"] += 1
+        arena = bytearray(reply)
+        self._state = _ArenaState(arena, self._cycle, self)
+        return arena
+
+    def track(self, payload) -> ArenaView:
+        """Wrap one parsed payload view for the current cycle."""
+        assert self._state is not None, "track() before new_cycle()"
+        mv = payload if isinstance(payload, memoryview) \
+            else memoryview(payload)
+        with _stats_lock:
+            _STATS["views"] += 1
+        return ArenaView(mv, self._state)
+
+    def recycle(self) -> None:
+        """Poison the current arena and expire outstanding views."""
+        st = self._state
+        if st is None:
+            return
+        st.expired = True
+        n = len(st.arena)
+        # same-length overwrite is legal with exported buffers (only
+        # RESIZING is blocked); escaped raw views now read 0xDD
+        st.arena[:] = bytes([POISON]) * n
+        with _stats_lock:
+            _STATS["poisoned_bytes"] += n
+        self._state = None
+
+    @property
+    def cycle(self) -> int:
+        return self._cycle
+
+
+def tracker(name: str) -> ArenaTracker:
+    return ArenaTracker(name)

@@ -35,7 +35,7 @@ from collections import deque
 from ..utils import lockorder
 from typing import Callable, Dict, Optional, Tuple
 
-from . import pumpcore
+from . import arenacheck, pumpcore
 from .broker import (
     Broker,
     BrokerError,
@@ -384,6 +384,13 @@ class RemoteConsumer:
         self._closed = False
         self._prefetch = max(1, int(prefetch))
         self._buffer: "deque[Message]" = deque()
+        # CORDA_TPU_ARENA_CHECK=1: expiry-checked payload views with
+        # poisoned arenas (docs/static-analysis.md); None = the normal
+        # zero-overhead plain-memoryview plane
+        self._arena = (
+            arenacheck.tracker(f"RemoteConsumer:{queue_name}")
+            if arenacheck.enabled() else None
+        )
 
     def receive(self, timeout: Optional[float] = None) -> Optional[Message]:
         if self._closed:
@@ -413,7 +420,13 @@ class RemoteConsumer:
         # no per-message bytes copy happens between wire and codec (the
         # views keep the arena alive; durable re-journal and re-framing
         # boundaries snapshot when they must)
+        if self._arena is not None:
+            # armed: previous cycle poisoned + expired; this drain's
+            # views are expiry-checked proxies
+            reply = self._arena.new_cycle(reply)
         for mid, delivery, headers, payload in pumpcore.parse_msgs(reply):
+            if self._arena is not None:
+                payload = self._arena.track(payload)
             self._buffer.append(Message(
                 payload=payload,
                 headers=headers,

@@ -85,6 +85,14 @@ def _coerce(b) -> bytes:
     return b if isinstance(b, bytes) else bytes(b)
 
 
+def _unwrap(p):
+    """The arena-checker seam: an armed-mode ArenaView payload must be
+    validated and unwrapped before the native buffer-protocol entry
+    points see it (one getattr miss on the normal plane)."""
+    u = getattr(p, "_arena_unwrap", None)
+    return u() if u is not None else p
+
+
 # --- batch frame building ---------------------------------------------------
 
 def frame_msgs(msgs: Sequence[tuple], lead: int) -> bytes:
@@ -93,7 +101,9 @@ def frame_msgs(msgs: Sequence[tuple], lead: int) -> bytes:
     msgs: [(message_id, delivery_count, headers_dict, payload), ...]."""
     if _native is not None:
         _count("frame_msgs_native")
-        return _native.frame_msgs(msgs, lead)
+        return _native.frame_msgs(
+            [(m, d, h, _unwrap(p)) for m, d, h, p in msgs], lead
+        )
     _count("frame_msgs_fallback")
     out = bytearray(bytes([lead]) + struct.pack(">I", len(msgs)))
     for mid, delivery, headers, payload in msgs:
@@ -114,7 +124,8 @@ def frame_send_many(items: Sequence[tuple], lead: int) -> bytes:
     if _native is not None:
         _count("frame_send_many_native")
         return _native.frame_send_many(
-            [(q, p, h if h is None or isinstance(h, dict) else dict(h))
+            [(q, _unwrap(p),
+              h if h is None or isinstance(h, dict) else dict(h))
              for q, p, h in items],
             lead,
         )

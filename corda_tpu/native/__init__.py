@@ -28,6 +28,46 @@ _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
 
+#: sanitizer build mode: None (normal), "asan" or "ubsan".  Set from the
+#: environment so a child process (the corda_tpu.analysis.sanitize
+#: runner) builds AND loads instrumented variants of every extension
+#: (build/<name>.<mode>.so) without touching the normal artifacts.
+#: ASan-built extensions additionally require the asan runtime to be
+#: LD_PRELOADed into the host python — the runner arranges that.
+_SANITIZE = os.environ.get("CORDA_TPU_SANITIZE") or None
+if _SANITIZE not in (None, "asan", "ubsan"):
+    # fail LOUD: a typo ("ASAN", "address", "1") would otherwise build
+    # uninstrumented artifacts under a sanitizer-looking name and run
+    # the whole suite green with no sanitizer active
+    raise RuntimeError(
+        f"CORDA_TPU_SANITIZE={_SANITIZE!r} is not a known mode "
+        f"(use 'asan' or 'ubsan', or unset)"
+    )
+
+_SAN_FLAGS = {
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g", "-O1"],
+    "ubsan": ["-fsanitize=undefined", "-fno-omit-frame-pointer", "-g",
+              "-O1"],
+}
+
+
+def _san_suffix() -> str:
+    return f".{_SANITIZE}" if _SANITIZE else ""
+
+
+def _san_flags():
+    return list(_SAN_FLAGS.get(_SANITIZE or "", []))
+
+
+def _san_load_blocked() -> Optional[str]:
+    """An ASan-instrumented .so must not even be ATTEMPTED without the
+    preloaded runtime: asan's init hard-exits the whole process (it
+    does not raise).  Returns a classified reason, or None when loading
+    is safe."""
+    if _SANITIZE == "asan" and "asan" not in os.environ.get("LD_PRELOAD", ""):
+        return "asan_needs_preload"
+    return None
+
 #: the five native extensions an operator can ask about: the four
 #: ctypes entry-point families linked into corda_native.so plus the
 #: CPython codec extension module
@@ -103,6 +143,41 @@ def _classify_build_exc(exc: Exception, compilers: List[str]) -> BuildError:
     return BuildError("compile_error", f"{type(exc).__name__}: {exc}")
 
 
+def _source_hash(sources) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
+def _artifact_sources(ext: str):
+    names = ["codec_ext.c"] if ext == "codec_ext" else [
+        "sha2_batch.cpp", "journal.cpp", "ed25519_msm.cpp",
+        "ecdsa_host.cpp",
+    ]
+    return [os.path.join(_SRC, n) for n in names]
+
+
+def artifact_fresh(ext: str) -> bool:
+    """True when the CURRENT sanitize-mode artifact for `ext`
+    ("codec_ext" or "corda_native") exists AND its srchash stamp
+    matches the sources — a stale .so left by an earlier successful
+    build does not count as built."""
+    so = artifact_paths()["codec_ext" if ext == "codec_ext"
+                         else "corda_native"]
+    if not os.path.exists(so):
+        return False
+    try:
+        with open(so + ".srchash") as fh:
+            stamp = fh.read().strip()
+        return stamp == _source_hash(_artifact_sources(ext))
+    except OSError:
+        return False
+
+
 def _build_if_stale(sources, so_path, cmd_prefix) -> None:
     """Compile `sources` into so_path when missing or stale.
 
@@ -112,15 +187,9 @@ def _build_if_stale(sources, so_path, cmd_prefix) -> None:
     target is per-PID and atomically renamed: many node processes cold-
     starting at once (cordform networks) must not interleave writes into
     one tmp file and install a corrupt ELF."""
-    import hashlib
-
     stamp_path = so_path + ".srchash"
     os.makedirs(_BUILD, exist_ok=True)
-    h = hashlib.sha256()
-    for s in sources:
-        with open(s, "rb") as fh:
-            h.update(fh.read())
-    src_hash = h.hexdigest()
+    src_hash = _source_hash(sources)
     stamp = None
     if os.path.exists(stamp_path):
         with open(stamp_path) as fh:
@@ -155,15 +224,20 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         os.path.join(_SRC, "ed25519_msm.cpp"),
         os.path.join(_SRC, "ecdsa_host.cpp"),
     ]
-    so_path = os.path.join(_BUILD, "corda_native.so")
+    so_path = os.path.join(_BUILD, f"corda_native{_san_suffix()}.so")
     try:
         _build_if_stale(
             sources, so_path,
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_san_flags()],
         )
     except Exception as exc:
         _load_failed = True
         _mark_lib_exts(False, _classify_build_exc(exc, ["g++"]).reason)
+        return None
+    blocked = _san_load_blocked()
+    if blocked is not None:
+        _load_failed = True
+        _mark_lib_exts(False, blocked)
         return None
     try:
         lib = ctypes.CDLL(so_path)
@@ -549,12 +623,12 @@ def _compile_and_import_codec():
     import sysconfig
 
     src = os.path.join(_SRC, "codec_ext.c")
-    so_path = os.path.join(_BUILD, "codec_ext.so")
+    so_path = os.path.join(_BUILD, f"codec_ext{_san_suffix()}.so")
     try:
         _build_if_stale(
             [src], so_path,
             ["gcc", "-O2", "-shared", "-fPIC",
-             f"-I{sysconfig.get_path('include')}"],
+             f"-I{sysconfig.get_path('include')}", *_san_flags()],
         )
     except Exception as exc:
         _codec_failed = True
@@ -565,6 +639,11 @@ def _compile_and_import_codec():
             be = BuildError("no_python_headers",
                             "Python.h missing (dev headers not installed)")
         _record_status("codec_ext", False, be.reason)
+        return None
+    blocked = _san_load_blocked()
+    if blocked is not None:
+        _codec_failed = True
+        _record_status("codec_ext", False, blocked)
         return None
     try:
         spec = importlib.util.spec_from_file_location("codec_ext", so_path)
@@ -598,20 +677,39 @@ def codec_extension():
 
 # --- rebuild CLI seam (`python -m corda_tpu.native --build`) ----------------
 
-def build_all(force: bool = False) -> Dict[str, Dict]:
+def artifact_paths() -> Dict[str, str]:
+    """The on-disk build artifacts for the CURRENT sanitize mode."""
+    return {
+        "corda_native": os.path.join(_BUILD, f"corda_native{_san_suffix()}.so"),
+        "codec_ext": os.path.join(_BUILD, f"codec_ext{_san_suffix()}.so"),
+    }
+
+
+def build_all(force: bool = False,
+              sanitize: Optional[str] = None) -> Dict[str, Dict]:
     """Compile/load every extension NOW and return the per-extension
     status map (EXTENSIONS keys, availability() values). `force` drops
     the srchash stamps and binaries first so a clean rebuild runs even
-    when the sources are unchanged."""
-    global _lib, _load_failed, _codec_mod, _codec_failed
+    when the sources are unchanged.  `sanitize` ("asan"/"ubsan") builds
+    the instrumented variants instead — note an ASan .so only LOADS
+    when the asan runtime is preloaded into this python (the
+    corda_tpu.analysis.sanitize runner's job); the compile itself is
+    judged by the artifact, not the load."""
+    global _lib, _load_failed, _codec_mod, _codec_failed, _SANITIZE
     with _lib_lock:
-        if force and os.path.isdir(_BUILD):
-            for fname in os.listdir(_BUILD):
-                if fname.endswith((".so", ".srchash", ".tmp")):
+        if sanitize is not None:
+            if sanitize not in ("", "asan", "ubsan"):
+                raise ValueError(f"unknown sanitizer {sanitize!r}")
+            _SANITIZE = sanitize or None
+        if force:
+            # only this mode's artifacts: a sanitized rebuild must not
+            # clobber the production .so (and vice versa)
+            for so in artifact_paths().values():
+                for path in (so, so + ".srchash"):
                     try:
-                        os.unlink(os.path.join(_BUILD, fname))
+                        os.unlink(path)
                     except OSError:
-                        pass  # a live .so may be mapped; rebuild replaces it
+                        pass  # absent, or a live .so; rebuild replaces it
         _lib = None
         _load_failed = False
         _codec_mod = None

@@ -15,7 +15,7 @@ import argparse
 import shutil
 import sys
 
-from . import EXTENSIONS, build_all
+from . import EXTENSIONS, artifact_fresh, build_all
 
 
 def main(argv=None) -> int:
@@ -31,14 +31,26 @@ def main(argv=None) -> int:
         "--force", action="store_true",
         help="drop srchash stamps and binaries first (clean rebuild)",
     )
+    parser.add_argument(
+        "--sanitize", choices=("asan", "ubsan"),
+        help="build instrumented variants (build/<name>.<mode>.so) for "
+             "the sanitizer runner (corda_tpu.analysis.sanitize); the "
+             "normal artifacts are untouched",
+    )
     args = parser.parse_args(argv)
     if args.force and not args.build:
         parser.error("--force requires --build")
+    if args.sanitize and not args.build:
+        parser.error("--sanitize requires --build")
 
-    status = build_all(force=args.force)
+    status = build_all(force=args.force, sanitize=args.sanitize)
     compiler_present = (
         shutil.which("g++") is not None or shutil.which("gcc") is not None
     )
+    # an ASan .so cannot LOAD without the preloaded runtime — for a
+    # sanitized build, judge the COMPILE by artifact FRESHNESS (srchash
+    # stamp vs sources: a stale .so from an earlier successful build
+    # must not mask a compile error)
     failed = []
     for ext in EXTENSIONS:
         entry = status[ext]
@@ -46,6 +58,10 @@ def main(argv=None) -> int:
             print(f"{ext}: OK")
             continue
         reason = entry.get("reason") or "unknown"
+        if args.sanitize and artifact_fresh(ext):
+            print(f"{ext}: BUILT (load deferred to the sanitizer "
+                  f"runner: {reason})")
+            continue
         print(f"{ext}: UNAVAILABLE ({reason})")
         if not reason.startswith("no_compiler"):
             failed.append(ext)

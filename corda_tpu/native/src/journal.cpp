@@ -10,6 +10,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <new>
 #include <string>
 
 extern "C" {
@@ -21,7 +22,13 @@ struct Journal {
 void* journal_open(const char* path) {
     FILE* fh = fopen(path, "ab");
     if (!fh) return nullptr;
-    Journal* j = new Journal{fh};
+    // nothrow: a bad_alloc thrown across the ctypes C ABI would abort
+    // the whole node process instead of failing this one open
+    Journal* j = new (std::nothrow) Journal{fh};
+    if (!j) {
+        fclose(fh);
+        return nullptr;
+    }
     return j;
 }
 

@@ -1,0 +1,244 @@
+"""Runtime arena-lifetime checker (CORDA_TPU_ARENA_CHECK; ISSUE 13).
+
+Pins the checker's contract (docs/static-analysis.md):
+
+  * disabled (the default): the receive plane is untouched — plain
+    memoryview payloads, no tracker state, zero overhead;
+  * armed: payloads are expiry-checked ArenaView proxies that decode,
+    snapshot and compare normally WITHIN their drain cycle;
+  * the next drain recycles: outstanding views expire, the arena is
+    poisoned (0xDD), and any later touch raises a typed
+    ArenaUseAfterDrainError carrying the view's creation stack plus an
+    eventlog `arena` record;
+  * the armed plane stays green across a realistic multi-cycle broker
+    round trip (the false-positive guard the acceptance criteria name).
+"""
+import pytest
+
+from corda_tpu.core.serialization import codec
+from corda_tpu.messaging import arenacheck, pumpcore
+from corda_tpu.messaging.arenacheck import (
+    POISON,
+    ArenaUseAfterDrainError,
+    ArenaView,
+)
+from corda_tpu.messaging.broker import Broker
+from corda_tpu.messaging.net import BrokerServer, RemoteBroker
+from corda_tpu.utils import eventlog
+
+
+@pytest.fixture
+def armed():
+    arenacheck.enable(True)
+    try:
+        yield
+    finally:
+        arenacheck.enable(False)
+
+
+@pytest.fixture
+def rig():
+    broker = Broker()
+    broker.create_queue("q")
+    server = BrokerServer(broker).start()
+    remote = RemoteBroker(server.host, server.port)
+    try:
+        yield broker, remote
+    finally:
+        remote.close()
+        server.stop()
+
+
+def _drain(consumer, broker, n, tag):
+    for i in range(n):
+        broker.send("q", codec.serialize({"tag": tag, "i": i}), {"h": tag})
+    return [consumer.receive(timeout=2) for _ in range(n)]
+
+
+class TestDisabled:
+    def test_zero_state_when_off(self, rig):
+        broker, remote = rig
+        assert not arenacheck.enabled()
+        consumer = remote.create_consumer("q")
+        assert consumer._arena is None
+        (msg,) = _drain(consumer, broker, 1, "off")
+        assert isinstance(msg.payload, memoryview)
+        assert not isinstance(msg.payload, ArenaView)
+        assert codec.deserialize(msg.payload) == {"tag": "off", "i": 0}
+        consumer.close()
+
+    def test_arming_is_per_consumer_creation(self, rig):
+        """The zero-overhead contract: a consumer created BEFORE arming
+        carries no checker state at all."""
+        broker, remote = rig
+        before = remote.create_consumer("q")
+        arenacheck.enable(True)
+        try:
+            after = RemoteBroker(
+                remote.host, remote.port
+            ).create_consumer("q")
+            assert before._arena is None
+            assert after._arena is not None
+        finally:
+            arenacheck.enable(False)
+            after.close()
+            before.close()
+
+
+class TestArmedWithinCycle:
+    def test_views_behave_bytes_like(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        msgs = _drain(consumer, broker, 3, "a")
+        payload = msgs[0].payload
+        assert isinstance(payload, ArenaView)
+        raw = bytes(payload)
+        assert raw.startswith(codec._MAGIC)
+        assert len(payload) == len(raw)
+        assert payload == raw and payload != raw + b"x"
+        assert payload[0] == raw[0]
+        assert bytes(payload[1:4]) == raw[1:4]
+        assert list(iter(payload)) == list(raw)
+        assert payload.hex() == raw.hex()
+        assert payload.tobytes() == raw
+        # codec decodes through the unwrap seam, single and batch
+        assert codec.deserialize(payload) == {"tag": "a", "i": 0}
+        assert codec.deserialize_many(
+            [m.payload for m in msgs]
+        ) == [{"tag": "a", "i": i} for i in range(3)]
+        consumer.close()
+
+    def test_reframe_through_pump_within_cycle(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (msg,) = _drain(consumer, broker, 1, "rf")
+        body = pumpcore.frame_send_many(
+            [("q2", msg.payload, dict(msg.headers))], 11
+        )
+        (queue, payload, headers) = pumpcore.parse_send_many(body)[0]
+        assert queue == "q2" and bytes(payload) == bytes(msg.payload)
+        consumer.close()
+
+
+class TestUseAfterDrain:
+    def test_typed_error_with_creation_stack(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (held,) = _drain(consumer, broker, 1, "old")
+        stale = held.payload
+        assert codec.deserialize(stale) == {"tag": "old", "i": 0}
+        # the next drain recycles the arena
+        (fresh,) = _drain(consumer, broker, 1, "new")
+        assert codec.deserialize(fresh.payload) == {"tag": "new", "i": 0}
+        before = arenacheck.meta()["violations"]
+        with pytest.raises(ArenaUseAfterDrainError) as ei:
+            codec.deserialize(stale)
+        assert "use" in str(ei.value) and "drain" in str(ei.value)
+        assert ei.value.created_stack.strip(), "creation stack missing"
+        assert "receive" in ei.value.created_stack or "track" in \
+            ei.value.created_stack
+        assert arenacheck.meta()["violations"] == before + 1
+        # every bytes-like touch is checked, not just the codec seam
+        for op in (lambda: bytes(stale), lambda: len(stale),
+                   lambda: stale[0], lambda: stale == b"x",
+                   lambda: list(iter(stale)), lambda: stale.hex()):
+            with pytest.raises(ArenaUseAfterDrainError):
+                op()
+        # and the re-framing seam refuses the stale view too
+        with pytest.raises(ArenaUseAfterDrainError):
+            pumpcore.frame_send_many([("q2", stale, {})], 11)
+        consumer.close()
+
+    def test_eventlog_arena_record(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (held,) = _drain(consumer, broker, 1, "ev")
+        stale = held.payload
+        _drain(consumer, broker, 1, "ev2")
+        log = eventlog.get_event_log()
+        base = len(log.records(component="arena"))
+        with pytest.raises(ArenaUseAfterDrainError):
+            bytes(stale)
+        recs = log.records(component="arena")
+        assert len(recs) == base + 1
+        assert recs[-1]["level"] == "error"
+        assert "use-after-drain" in recs[-1]["message"]
+        consumer.close()
+
+    def test_arena_poisoned_on_recycle(self, armed, rig):
+        """A raw memoryview that ESCAPED the proxy (via the unwrap seam)
+        must read poison after recycle, never silently-valid stale
+        bytes."""
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (held,) = _drain(consumer, broker, 1, "p")
+        raw = held.payload._arena_unwrap()  # within-cycle: legal
+        assert bytes(raw).startswith(codec._MAGIC)
+        _drain(consumer, broker, 1, "p2")
+        assert set(bytes(raw)) == {POISON}
+        consumer.close()
+
+    def test_snapshot_before_drain_survives(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (held,) = _drain(consumer, broker, 1, "s")
+        snapshot = bytes(held.payload)  # the documented discipline
+        _drain(consumer, broker, 1, "s2")
+        assert codec.deserialize(snapshot) == {"tag": "s", "i": 0}
+        consumer.close()
+
+    def test_subslice_expires_with_parent(self, armed, rig):
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        (held,) = _drain(consumer, broker, 1, "sub")
+        sub = held.payload[1:5]
+        assert isinstance(sub, ArenaView)
+        _drain(consumer, broker, 1, "sub2")
+        with pytest.raises(ArenaUseAfterDrainError):
+            bytes(sub)
+        consumer.close()
+
+
+class TestArmedSuiteGreen:
+    def test_multi_cycle_traffic_stays_green(self, armed, rig):
+        """The false-positive guard: drain -> decode -> (snapshot where
+        the contract says so) across many cycles never trips the
+        checker, and the counters show it was actually armed."""
+        broker, remote = rig
+        consumer = remote.create_consumer("q")
+        before = arenacheck.meta()
+        for cycle in range(8):
+            msgs = _drain(consumer, broker, 4, f"c{cycle}")
+            decoded = codec.deserialize_many([m.payload for m in msgs])
+            assert [d["i"] for d in decoded] == list(range(4))
+            for m in msgs:
+                consumer.ack(m)
+        after = arenacheck.meta()
+        assert after["violations"] == before["violations"]
+        assert after["cycles"] >= before["cycles"] + 8
+        assert after["views"] >= before["views"] + 32
+        assert after["poisoned_bytes"] > before["poisoned_bytes"]
+        consumer.close()
+
+
+class TestTrackerUnit:
+    def test_cycle_mechanics_without_sockets(self, armed):
+        tr = arenacheck.tracker("unit")
+        arena = tr.new_cycle(b"hello world")
+        assert isinstance(arena, bytearray)
+        view = tr.track(memoryview(arena)[0:5])
+        assert bytes(view) == b"hello"
+        arena2 = tr.new_cycle(b"second")
+        assert set(arena) == {POISON}  # old arena poisoned
+        with pytest.raises(ArenaUseAfterDrainError):
+            bytes(view)
+        v2 = tr.track(memoryview(arena2)[0:3])
+        assert bytes(v2) == b"sec"
+        assert tr.cycle == 2
+
+    def test_repr_marks_expired(self, armed):
+        tr = arenacheck.tracker("r")
+        v = tr.track(memoryview(tr.new_cycle(b"x")))
+        assert "EXPIRED" not in repr(v)
+        tr.recycle()
+        assert "EXPIRED" in repr(v)  # repr itself must not raise

@@ -1,0 +1,372 @@
+"""Native-plane C-source lint (corda_tpu/analysis/clint.py; ISSUE 13).
+
+Pins the three tokenizer passes (gil_region / buffer_release /
+refcount_escape): clean on the real native sources, each detects its
+synthetic violation (in-process AND through the tools/lint.py CLI with
+a --root minirepo, failing with a named NEW FINDING), suppressions
+work, the fixed journal.cpp true positives stay fixed, and the native
+passes ride the same pinned analysis_manifest.json as the PR-9 suite.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from corda_tpu.analysis import clint, manifest
+from corda_tpu.analysis.manifest import ALL_PASS_IDS, load_manifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_CLI = os.path.join(REPO, "tools", "lint.py")
+
+
+def _lint_src(tmp_path, name, src, passes=None):
+    """Run clint over one synthetic source file."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return clint.run_passes(paths=[str(p)], root=str(tmp_path),
+                            passes=passes)
+
+
+# -- tokenizer / structure ----------------------------------------------------
+
+class TestTokenizer:
+    def test_functions_found_in_real_codec(self):
+        src = os.path.join(REPO, "corda_tpu", "native", "src", "codec_ext.c")
+        with open(src) as fh:
+            cf = clint._CFile(src, "codec_ext.c", fh.read())
+        names = {f[0] for f in cf.functions}
+        for expected in ("py_encode", "py_decode", "encode_value",
+                         "decode_value", "py_decode_many", "parse_batch",
+                         "py_parse_headers_many", "py_route_hints_many"):
+            assert expected in names, sorted(names)
+
+    def test_comments_and_strings_are_not_code(self, tmp_path):
+        findings = _lint_src(tmp_path, "c.c", """
+            /* Py_BEGIN_ALLOW_THREADS then PyList_New in a comment */
+            // Py_BEGIN_ALLOW_THREADS PyDict_New
+            static const char *s = "Py_BEGIN_ALLOW_THREADS PyList_New";
+            int f(int x) { return x; }
+        """)
+        assert findings == []
+
+
+# -- pass: gil_region ---------------------------------------------------------
+
+GIL_BAD = """
+    #include <Python.h>
+    static PyObject *bad_region(PyObject *self, PyObject *args) {
+        PyObject *out = NULL;
+        Py_ssize_t n = 0;
+        Py_BEGIN_ALLOW_THREADS
+        out = PyList_New(n);
+        Py_END_ALLOW_THREADS
+        return out;
+    }
+"""
+
+
+class TestGilRegion:
+    def test_api_call_in_region_flagged(self, tmp_path):
+        findings = _lint_src(tmp_path, "g.c", GIL_BAD, ["gil_region"])
+        assert [f.key for f in findings] == [
+            "gil_region:g.c:bad_region:PyList_New"
+        ]
+        assert "Py_BEGIN_ALLOW_THREADS" in findings[0].message
+
+    def test_allowlisted_names_pass(self, tmp_path):
+        findings = _lint_src(tmp_path, "g.c", """
+            #include <Python.h>
+            static void ok_region(char *d, Py_ssize_t len) {
+                Py_BEGIN_ALLOW_THREADS
+                Py_ssize_t i;
+                for (i = 0; i < len && i < PY_SSIZE_T_MAX; i++) d[i] = 0;
+                Py_END_ALLOW_THREADS
+            }
+        """, ["gil_region"])
+        assert findings == []
+
+    def test_block_threads_reacquires(self, tmp_path):
+        findings = _lint_src(tmp_path, "g.c", """
+            #include <Python.h>
+            static void mixed(char *d) {
+                Py_BEGIN_ALLOW_THREADS
+                d[0] = 0;
+                Py_BLOCK_THREADS
+                PyErr_SetString(PyExc_ValueError, "x");
+                Py_UNBLOCK_THREADS
+                d[1] = 0;
+                Py_END_ALLOW_THREADS
+            }
+        """, ["gil_region"])
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        src = GIL_BAD.replace(
+            "out = PyList_New(n);",
+            "out = PyList_New(n);  /* lint: allow(gil_region) — test */",
+        )
+        assert _lint_src(tmp_path, "g.c", src, ["gil_region"]) == []
+
+
+# -- pass: buffer_release -----------------------------------------------------
+
+BUF_BAD = """
+    #include <Python.h>
+    static PyObject *bad_buffer(PyObject *self, PyObject *obj) {
+        Py_buffer view;
+        if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0) return NULL;
+        if (((char *)view.buf)[0] == 'x') return NULL;
+        PyBuffer_Release(&view);
+        Py_RETURN_NONE;
+    }
+"""
+
+
+class TestBufferRelease:
+    def test_early_return_without_release_flagged(self, tmp_path):
+        findings = _lint_src(tmp_path, "b.c", BUF_BAD, ["buffer_release"])
+        assert [f.key for f in findings] == [
+            "buffer_release:b.c:bad_buffer:view"
+        ]
+
+    def test_acquisition_failure_guard_exempt_and_pairing_clean(
+        self, tmp_path
+    ):
+        findings = _lint_src(tmp_path, "b.c", """
+            #include <Python.h>
+            static PyObject *ok_buffer(PyObject *self, PyObject *obj) {
+                Py_buffer view;
+                if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0)
+                    return NULL;
+                if (view.len == 0) {
+                    PyBuffer_Release(&view);
+                    return NULL;
+                }
+                PyBuffer_Release(&view);
+                Py_RETURN_NONE;
+            }
+        """, ["buffer_release"])
+        assert findings == []
+
+    def test_parse_tuple_y_star_acquisition(self, tmp_path):
+        findings = _lint_src(tmp_path, "b.c", """
+            #include <Python.h>
+            static PyObject *bad_ystar(PyObject *self, PyObject *args) {
+                Py_buffer view;
+                PyObject *other;
+                if (!PyArg_ParseTuple(args, "y*O", &view, &other))
+                    return NULL;
+                if (other == Py_None) return NULL;
+                PyBuffer_Release(&view);
+                Py_RETURN_NONE;
+            }
+        """, ["buffer_release"])
+        assert [f.key for f in findings] == [
+            "buffer_release:b.c:bad_ystar:view"
+        ]
+
+    def test_goto_fail_epilogue_with_release_clean(self, tmp_path):
+        findings = _lint_src(tmp_path, "b.c", """
+            #include <Python.h>
+            static PyObject *ok_goto(PyObject *self, PyObject *obj) {
+                Py_buffer view;
+                PyObject *out = NULL;
+                if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0)
+                    return NULL;
+                if (view.len == 0) goto done;
+                out = PyBytes_FromStringAndSize(view.buf, view.len);
+            done:
+                PyBuffer_Release(&view);
+                return out;
+            }
+        """, ["buffer_release"])
+        assert findings == []
+
+    def test_goto_fail_epilogue_without_release_flagged(self, tmp_path):
+        findings = _lint_src(tmp_path, "b.c", """
+            #include <Python.h>
+            static PyObject *bad_goto(PyObject *self, PyObject *obj) {
+                Py_buffer view;
+                PyObject *out = NULL;
+                if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0)
+                    return NULL;
+                if (view.len == 0) goto done;
+                out = PyBytes_FromStringAndSize(view.buf, view.len);
+            done:
+                return out;
+            }
+        """, ["buffer_release"])
+        assert [f.key for f in findings] == [
+            "buffer_release:b.c:bad_goto:view"
+        ]
+        assert "goto" in findings[0].message
+
+
+# -- pass: refcount_escape ----------------------------------------------------
+
+REF_BAD = """
+    #include <Python.h>
+    static int bad_leak(int x) {
+        PyObject *tmp = PyList_New(0);
+        if (!tmp) return -1;
+        if (x) return -1;
+        Py_DECREF(tmp);
+        return 0;
+    }
+"""
+
+
+class TestRefcountEscape:
+    def test_early_error_leak_flagged(self, tmp_path):
+        findings = _lint_src(tmp_path, "r.c", REF_BAD, ["refcount_escape"])
+        assert [f.key for f in findings] == [
+            "refcount_escape:r.c:bad_leak:tmp"
+        ]
+
+    def test_release_and_transfer_paths_clean(self, tmp_path):
+        findings = _lint_src(tmp_path, "r.c", """
+            #include <Python.h>
+            static PyObject *ok_paths(int x) {
+                PyObject *a = PyList_New(0);
+                if (!a) return NULL;
+                if (x == 1) { Py_DECREF(a); return NULL; }
+                if (x == 2) return a;
+                PyObject *t = PyTuple_New(1);
+                if (!t) { Py_DECREF(a); return NULL; }
+                PyTuple_SET_ITEM(t, 0, a);
+                return t;
+            }
+        """, ["refcount_escape"])
+        assert findings == []
+
+    def test_unguarded_new_flagged_cpp_only(self, tmp_path):
+        src = """
+            extern "C" {
+            void *bad_new(int n) {
+                int *p = new int[4];
+                return p;
+            }
+            }
+        """
+        findings = _lint_src(tmp_path, "n.cpp", src, ["refcount_escape"])
+        assert any(f.symbol == "bad_new:new" for f in findings), findings
+        assert "nothrow" in findings[0].message
+
+    def test_nothrow_new_clean(self, tmp_path):
+        findings = _lint_src(tmp_path, "n.cpp", """
+            #include <new>
+            extern "C" {
+            void *ok_new(void) {
+                int *p = new (std::nothrow) int;
+                if (!p) return 0;
+                return p;
+            }
+            }
+        """, ["refcount_escape"])
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        src = REF_BAD.replace(
+            "if (x) return -1;",
+            "if (x) return -1;  /* lint: allow(refcount_escape) — test */",
+        )
+        assert _lint_src(tmp_path, "r.c", src,
+                         ["refcount_escape"]) == []
+
+
+# -- the real sources + the pinned baseline -----------------------------------
+
+class TestRealSources:
+    def test_native_sources_clean(self):
+        """All five native extension sources pass all three passes —
+        the accepted baseline for the native plane is ZERO."""
+        findings = clint.run_passes()
+        assert findings == [], [f.key for f in findings]
+
+    def test_native_paths_cover_all_five(self):
+        names = {os.path.basename(p) for p in clint.native_paths()}
+        assert names == {"codec_ext.c", "ecdsa_host.cpp",
+                         "ed25519_msm.cpp", "journal.cpp",
+                         "sha2_batch.cpp"}
+
+    def test_fixed_true_positives_stay_fixed(self):
+        """The journal.cpp findings this suite surfaced (unguarded
+        `new` across the C ABI; the fopen handle leaking when the
+        alloc-failure path was added) are FIXED — the keys must stay
+        absent from findings AND from the accepted baseline."""
+        current = {f.key for f in clint.run_passes()}
+        pinned = {
+            k for keys in load_manifest()["passes"].values() for k in keys
+        }
+        for key in (
+            "refcount_escape:corda_tpu/native/src/journal.cpp:"
+            "journal_open:new",
+            "refcount_escape:corda_tpu/native/src/journal.cpp:"
+            "journal_open:fh",
+        ):
+            assert key not in current, f"regressed: {key}"
+            assert key not in pinned, f"crept back into baseline: {key}"
+
+    def test_native_passes_pinned_at_zero(self):
+        baseline = load_manifest()["passes"]
+        for pid in clint.PASS_IDS:
+            assert baseline[pid] == [], baseline[pid]
+
+    def test_manifest_covers_both_planes(self):
+        baseline = load_manifest()["passes"]
+        assert set(ALL_PASS_IDS) <= set(baseline)
+        result = manifest.check_findings()
+        assert result["new"] == [], result["new"]
+
+
+# -- tools/lint.py CLI over a --root minirepo ---------------------------------
+
+C_VIOLATIONS = {
+    "gil_region": GIL_BAD,
+    "buffer_release": BUF_BAD,
+    "refcount_escape": REF_BAD,
+}
+
+
+class TestCLintCLI:
+    @pytest.mark.parametrize("pass_id", sorted(C_VIOLATIONS))
+    def test_synthetic_violation_fails_cli_with_named_finding(
+        self, tmp_path, pass_id
+    ):
+        root = tmp_path / "minirepo"
+        src_dir = root / "corda_tpu" / "native" / "src"
+        src_dir.mkdir(parents=True)
+        bad = src_dir / f"bad_{pass_id}.c"
+        bad.write_text(textwrap.dedent(C_VIOLATIONS[pass_id]))
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--baseline", "--no-kernel",
+             "--root", str(root), "--pass", pass_id],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1, proc.stderr
+        expected = (f"NEW FINDING {pass_id}:"
+                    f"corda_tpu/native/src/bad_{pass_id}.c:")
+        assert expected in proc.stderr, proc.stderr
+
+    def test_explicit_c_path_lints_without_gate(self, tmp_path):
+        bad = tmp_path / "x.c"
+        bad.write_text(textwrap.dedent(REF_BAD))
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, str(bad)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        assert "[refcount_escape]" in proc.stdout
+
+    def test_clean_repo_includes_native_passes(self):
+        proc = subprocess.run(
+            [sys.executable, LINT_CLI, "--baseline", "--no-kernel",
+             "--json"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout.splitlines()[-1])
+        assert out["ok"]

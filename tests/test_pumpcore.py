@@ -208,6 +208,81 @@ class TestCodecBatchParity:
         )
 
 
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus", "decode")
+
+
+class TestCorpusReplay:
+    """Committed malformed-frame regression corpus (tests/corpus/decode)
+    replayed against BOTH codec paths with error-taxonomy parity: the
+    native and pure-Python decoders must raise the SAME
+    SerializationError message — or accept with the same value (the
+    padded-varint consensus-compatibility case)."""
+
+    def _corpus(self):
+        assert os.path.isdir(CORPUS_DIR), "corpus directory missing"
+        out = []
+        for fn in sorted(os.listdir(CORPUS_DIR)):
+            if fn.endswith(".bin"):
+                with open(os.path.join(CORPUS_DIR, fn), "rb") as fh:
+                    out.append((fn, fh.read()))
+        assert len(out) >= 15, "corpus shrank"
+        return out
+
+    @staticmethod
+    def _outcome(frame):
+        """(value, None) on accept, (None, error message) on reject."""
+        try:
+            return codec.deserialize(frame), None
+        except SerializationError as exc:
+            return None, str(exc)
+
+    def test_corpus_taxonomy_parity_both_paths(self, monkeypatch):
+        assert codec._native_codec is not None
+        for fn, frame in self._corpus():
+            native = self._outcome(frame)
+            with monkeypatch.context() as m:
+                m.setattr(codec, "_native_codec", None)
+                python = self._outcome(frame)
+            assert native == python, (
+                f"{fn}: native={native!r} python={python!r}"
+            )
+
+    def test_corpus_through_decode_many(self, monkeypatch):
+        """The batch scan path classifies each corpus frame identically
+        to the single-shot path, on both codec planes."""
+        good = codec.serialize([1, "x"])
+        for fn, frame in self._corpus():
+            single_value, single_err = self._outcome(frame)
+            for use_native in (True, False):
+                with monkeypatch.context() as m:
+                    if not use_native:
+                        m.setattr(codec, "_native_codec", None)
+                    try:
+                        many_value = codec.deserialize_many(
+                            [good, frame]
+                        )[1]
+                        many_err = None
+                    except SerializationError as exc:
+                        many_value, many_err = None, str(exc)
+                assert (many_err is None) == (single_err is None), (
+                    fn, use_native,
+                )
+                if single_err is not None:
+                    assert many_err == single_err, (fn, use_native)
+                else:
+                    # accept parity includes the VALUE, not just
+                    # no-error (the padded-varint case)
+                    assert many_value == single_value, (fn, use_native)
+
+    def test_corpus_has_an_accept_case(self):
+        """At least one corpus file is the WELL-FORMED non-canonical
+        shape (padded varint): parity must hold for accepts too, or the
+        corpus only ever proves the reject half."""
+        accepted = [fn for fn, frame in self._corpus()
+                    if self._outcome(frame)[1] is None]
+        assert any("padded" in fn for fn in accepted), accepted
+
+
 class TestWireParity:
     def _rand_msgs(self, rng, n=16):
         out = []
