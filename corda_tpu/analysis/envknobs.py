@@ -195,6 +195,10 @@ _ENTRIES = [
     _k("CORDA_TPU_SANITIZE", "unset", "docs/static-analysis.md",
        "asan|ubsan: native loader builds/loads instrumented extension "
        "variants (set by the corda_tpu.analysis.sanitize runner)"),
+    # -- remote soak / loadtest (this PR) -------------------------------------
+    _k("CORDA_TPU_LOADTEST_DEADLINE_S", "unset", "docs/robustness.md",
+       "scales every procdriver wait (driver stop join, counterparty "
+       "vault poll) for loaded soak boxes / slow ssh rigs"),
     # -- bench --------------------------------------------------------------
     _k("CORDA_TPU_BENCH_FORCE_CPU", "unset", "docs/hardware-runbook.md",
        "1 = bench.py skips the TPU probe and runs CPU-only"),

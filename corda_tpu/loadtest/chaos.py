@@ -147,7 +147,14 @@ def run(
     verbose: bool = False,
     notary: str = "raft",
     verifier_workers: int = 0,
+    proxy_partition: bool = False,
 ) -> dict:
+    """`proxy_partition`: interpose a controllable TCP proxy
+    (loadtest/netproxy.py) in front of bank B's broker — the deployment
+    ADVERTISES the proxy address, so every peer byte to B crosses a
+    link the rotation can stall (the transport-partition disruption,
+    with its heal-time recovery assertion from the catalog)."""
+    from ..testing.driver import free_port
     from ..testing.smoketesting import Factory
     from ..tools.cordform import deploy_nodes
     from .procdriver import PairDriver, assert_no_loss_no_dup, resolve_identities
@@ -175,10 +182,15 @@ def run(
         # bank A farms transaction verification out to competing consumer
         # workers on its broker — the reference's elasticity contract
         bank_a["verifier_type"] = "OutOfProcess"
+    bank_b = {"name": "O=ChaosB,L=Paris,C=FR"}
+    proxy_port = None
+    if proxy_partition:
+        proxy_port = free_port()
+        bank_b["advertised_address"] = f"127.0.0.1:{proxy_port}"
     spec = {"nodes": [
         notary_entry,
         bank_a,
-        {"name": "O=ChaosB,L=Paris,C=FR"},
+        bank_b,
     ]}
     resolved = deploy_nodes(spec, base)
     a_idx, b_idx = n_members, n_members + 1
@@ -186,9 +198,17 @@ def run(
     nodes: List = []
     workers: List[_Worker] = []
     driver = None
+    proxy = None
     try:
         for conf in resolved:
             nodes.append(factory.launch(conf["dir"]))
+        if proxy_partition:
+            from .netproxy import NetProxy
+
+            proxy = NetProxy(
+                "127.0.0.1", resolved[b_idx]["broker_port"],
+                listen_port=proxy_port,
+            ).start()
         broker_a = (
             f"{resolved[a_idx]['broker_host']}:{resolved[a_idx]['broker_port']}"
         )
@@ -229,8 +249,20 @@ def run(
             # the queue stalls — the failure mode only the requester-side
             # deadline supervisor (redispatch/breaker/fallback) recovers
             kinds.append("broker_partition")
+        partition_disruption = None
+        if proxy is not None:
+            # the catalog's transport-partition entry: stall the wire in
+            # front of bank B's broker, heal asserts pairs RESUMED
+            from .disruption import transport_partition
+
+            partition_disruption = transport_partition(
+                proxy, lambda: len(driver.completed), mode="stall",
+                recovery_deadline_s=120.0,
+            )
+            kinds.append("bankb_partition")
         worker_kills = 0
         partitions = 0
+        wire_partitions = 0
         leader_kills = 0
 
         def relaunch(idx: int, role: str) -> bool:
@@ -325,6 +357,19 @@ def run(
                         )
                         time.sleep(0.3)
                     idx = f"stall:{len(frozen)}x{round(stall, 1)}s"
+                elif kind == "bankb_partition":
+                    before = len(driver.completed)
+                    stall = rng.uniform(2, 6)
+                    partition_disruption.fire(rng)
+                    wire_partitions += 1
+                    time.sleep(stall)
+                    # heal() carries the recovery assertion: pairs must
+                    # resume through the restored wire
+                    partition_disruption.heal(rng)
+                    idx = (
+                        f"wire:{round(stall, 1)}s"
+                        f"+{len(driver.completed) - before}"
+                    )
                 elif kind == "worker_kill":
                     victim = rng.choice([w for w in workers if w.alive()])
                     before = len(driver.completed)
@@ -383,6 +428,7 @@ def run(
             "verifier_workers": len(workers),
             "worker_kills": worker_kills,
             "broker_partitions": partitions,
+            "wire_partitions": wire_partitions,
             "leader_kills": leader_kills,
             "driver_errors": len(driver.errors),
             "consistent": True,
@@ -393,6 +439,8 @@ def run(
                 driver.stop(timeout=5)
             except BaseException:
                 pass
+        if proxy is not None:
+            proxy.stop()
         for w in workers:
             w.close()
         for n in nodes:
@@ -407,10 +455,16 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--notary", choices=("raft", "bft"), default="raft")
     ap.add_argument("--verifier-workers", type=int, default=0)
+    ap.add_argument(
+        "--proxy-partition", action="store_true",
+        help="run bank B behind the controllable TCP partition proxy "
+             "and add wire-stall disruptions to the rotation",
+    )
     args = ap.parse_args(argv)
     print(json.dumps(run(
         args.duration, args.seed, verbose=True,
         notary=args.notary, verifier_workers=args.verifier_workers,
+        proxy_partition=args.proxy_partition,
     )))
     return 0
 

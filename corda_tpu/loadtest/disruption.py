@@ -22,6 +22,19 @@ class Disruption:
         self.heal_after = heal_after
         self._fired_at: Optional[int] = None
 
+    def fire(self, rng: random.Random, nodes=None, iteration: int = 0) -> None:
+        """Deterministic fire (the composed-soak driver's path: every
+        catalog entry fires on SCHEDULE there, not probabilistically)."""
+        self._fire(rng, nodes)
+        self._fired_at = iteration
+
+    def heal(self, rng: random.Random, nodes=None) -> None:
+        """Deterministic heal; recovery-asserting entries raise
+        AssertionError here when the system failed to make progress."""
+        if self._fired_at is not None and self._heal is not None:
+            self._heal(rng, nodes)
+        self._fired_at = None
+
     def maybe_fire(self, rng: random.Random, nodes, iteration: int) -> None:
         if self._fired_at is None and rng.random() < self.probability:
             self._fire(rng, nodes)
@@ -216,6 +229,157 @@ def worker_process_kill(supervisor, probability: float = 0.2) -> Disruption:
 
     return Disruption("worker-process-kill", fire, heal,
                       probability=probability)
+
+
+# -- process/transport-granular entries (the remote-soak catalog) -------------
+#
+# These fire at OS-process / wire level instead of in-process seams, and
+# their HEAL carries the recovery assertion: healing is not "the signal
+# was sent" but "the system demonstrably made progress afterwards" —
+# an AssertionError out of a heal is the soak's verdict, exactly like
+# the chaos runner's inline recovery checks. They are transport-agnostic
+# (`victim`/`proxy` duck types), so the same catalog entry drives a
+# local subprocess, an ssh-managed remote process (loadtest/remote.py),
+# or a fake in a deterministic unit test.
+
+def assert_recovers(probe: Callable[[], int], before: int, what: str,
+                    min_progress: int = 2,
+                    deadline_s: float = 120.0) -> int:
+    """Block until `probe()` (a monotonically-increasing completion
+    count) advances `min_progress` past `before`; AssertionError
+    otherwise — recovery proven by PROGRESS, not by survival."""
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    while True:
+        now = probe()
+        if now >= before + min_progress:
+            return now
+        assert _time.monotonic() < deadline, (
+            f"no recovery after {what}: {now - before} completions in "
+            f"{deadline_s:.0f}s (needed {min_progress})"
+        )
+        _time.sleep(0.2)
+
+
+def process_restart(victim, probe: Callable[[], int],
+                    min_progress: int = 2,
+                    recovery_deadline_s: float = 120.0,
+                    probability: float = 0.2,
+                    heal_after: int = 2) -> Disruption:
+    """SIGKILL a real node process and relaunch it from its directory
+    (Disruption.kt nodeRestart at process level). `victim` needs
+    `kill()` and `relaunch()`; the heal relaunches then asserts the
+    workload resumed (durable journal + checkpoint restore)."""
+    state = {}
+
+    def fire(rng, nodes):
+        state["before"] = probe()
+        victim.kill()
+
+    def heal(rng, nodes):
+        victim.relaunch()
+        assert_recovers(
+            probe, state.pop("before", 0), "process restart",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+
+    return Disruption("process-restart", fire, heal,
+                      probability=probability, heal_after=heal_after)
+
+
+def process_hang(victim, probe: Callable[[], int],
+                 min_progress: int = 2,
+                 recovery_deadline_s: float = 120.0,
+                 probability: float = 0.2,
+                 heal_after: int = 1) -> Disruption:
+    """SIGSTOP/SIGCONT a real process (the reference 'hang': sockets
+    stay open, nothing answers — the gray failure only deadline/
+    circuit-breaker paths survive). `victim` needs `suspend()` and
+    `resume()`; the heal resumes then asserts progress."""
+    state = {}
+
+    def fire(rng, nodes):
+        state["before"] = probe()
+        victim.suspend()
+
+    def heal(rng, nodes):
+        victim.resume()
+        assert_recovers(
+            probe, state.pop("before", 0), "process hang (SIGSTOP)",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+
+    return Disruption("process-hang", fire, heal,
+                      probability=probability, heal_after=heal_after)
+
+
+def transport_partition(proxy, probe: Callable[[], int],
+                        mode: str = "stall", direction: str = "both",
+                        min_progress: int = 2,
+                        recovery_deadline_s: float = 120.0,
+                        probability: float = 0.2,
+                        heal_after: int = 1) -> Disruption:
+    """Partition the wire through a controllable TCP proxy
+    (loadtest/netproxy.py — no root/iptables): `mode` is `stall`
+    (backpressure gray failure), `blackhole` (silent loss) or `drop`
+    (connection resets), per `direction`. `proxy` needs
+    `set_mode(mode, direction)` and `heal()` — the in-process NetProxy
+    or a remote control-file handle. The heal restores the wire then
+    asserts traffic resumed through it."""
+    state = {}
+
+    def fire(rng, nodes):
+        state["before"] = probe()
+        proxy.set_mode(mode, direction)
+
+    def heal(rng, nodes):
+        proxy.heal()
+        assert_recovers(
+            probe, state.pop("before", 0),
+            f"transport partition ({mode}/{direction})",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+
+    return Disruption("transport-partition", fire, heal,
+                      probability=probability, heal_after=heal_after)
+
+
+def shard_worker_process_kill(pick_pid, kill_pid, probe: Callable[[], int],
+                              min_progress: int = 2,
+                              recovery_deadline_s: float = 120.0,
+                              probability: float = 0.2,
+                              heal_after: int = 2) -> Disruption:
+    """SIGKILL one `--shard-worker` OS process of a sharded node found
+    by PID (works over ssh: `pick_pid()` greps the remote process
+    table). A worker death is a transient — the supervisor respawns it,
+    unacked messages redeliver — so the heal asserts pairs RESUMED, not
+    merely that a replacement exists."""
+    state = {}
+
+    def fire(rng, nodes):
+        pid = pick_pid(rng)
+        if pid is None:
+            return  # no worker visible right now; fire again later
+        state["before"] = probe()
+        state["fired"] = True
+        kill_pid(pid)
+
+    def heal(rng, nodes):
+        if not state.pop("fired", False):
+            return
+        assert_recovers(
+            probe, state.pop("before", 0), "shard-worker kill",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+
+    d = Disruption("shard-worker-kill", fire, heal,
+                   probability=probability, heal_after=heal_after)
+    # observable by the composed-soak driver: a fire that found no
+    # worker to kill must NOT be counted as a fired+recovered
+    # disruption in the gated record
+    d.state = state
+    return d
 
 
 def clock_skew(delta_s: float = 3600.0) -> Disruption:

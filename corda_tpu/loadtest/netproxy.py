@@ -1,0 +1,390 @@
+"""Controllable TCP partition proxy (reference `tools/loadtest/`'s
+network disruptions, without root/iptables: the proxy sits in front of a
+broker port and the *deployment* advertises the proxy's address, so every
+peer connection crosses a link the soak can degrade per direction).
+
+Modes, settable per direction (client->server "c2s", server->client
+"s2c", or "both"):
+
+  * ``pass``      — forward transparently (the healthy wire);
+  * ``delay``     — forward each chunk after ``delay_s`` (a slow WAN);
+  * ``stall``     — stop reading entirely: TCP backpressure propagates
+                    to the sender exactly like a SIGSTOPped peer — the
+                    "gray failure" where the connection looks alive but
+                    nothing moves. Stream bytes are preserved, so a heal
+                    resumes mid-stream with framing intact;
+  * ``blackhole`` — read and DISCARD: silent loss on the wire. The
+                    stream is corrupted from the peer's view, so healed
+                    connections that lost bytes are CLOSED (clients
+                    reconnect through the now-healthy proxy — the same
+                    observable behaviour as a healed real partition);
+  * ``drop``      — refuse new connections (accept+close) and reset the
+                    existing ones: the hard partition.
+
+``heal()`` restores ``pass`` in both directions and closes any
+connection whose stream was tainted by ``blackhole``/``drop``.
+
+Used in-process by tests and the chaos soak; the CLI form
+(``python -m corda_tpu.loadtest.netproxy``) runs on a REMOTE host under
+the ssh soak driver (loadtest/remote.py), controlled through a polled
+command file — file-based control works over any exec transport, where
+a control socket would need its own reachability story.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils import lockorder
+
+MODES = ("pass", "delay", "stall", "blackhole", "drop")
+DIRECTIONS = ("c2s", "s2c")
+
+#: how long a pump waits on recv before re-reading policy (mode flips
+#: apply within this window)
+_POLL_S = 0.1
+_CHUNK = 65536
+
+
+class _Policy:
+    """One direction's forwarding policy; version bumps wake stalled
+    pumps."""
+
+    def __init__(self) -> None:
+        self.mode = "pass"
+        self.delay_s = 0.0
+        self.version = 0
+
+
+class _Link:
+    """One accepted client connection + its upstream socket."""
+
+    def __init__(self, client: socket.socket, upstream: socket.socket):
+        self.client = client
+        self.upstream = upstream
+        self.tainted = False  # bytes discarded: stream framing is gone
+        self.closed = False
+
+    def close(self) -> None:
+        self.closed = True
+        for s in (self.client, self.upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class NetProxy:
+    """A per-direction controllable TCP forwarder in front of one
+    target port. Thread-safe; all control methods return immediately
+    (pumps apply the new policy within ``_POLL_S``)."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0):
+        self.target_host = target_host
+        self.target_port = target_port
+        self.listen_host = listen_host
+        self._requested_port = listen_port
+        self.port: Optional[int] = None
+        self._policies: Dict[str, _Policy] = {
+            d: _Policy() for d in DIRECTIONS
+        }
+        self._lock = lockorder.make_lock("NetProxy._lock")
+        self._cv = lockorder.make_condition(self._lock)
+        self._links: List[_Link] = []
+        self._stats = {
+            "conns_accepted": 0, "conns_refused": 0,
+            "bytes_c2s": 0, "bytes_s2c": 0, "bytes_discarded": 0,
+        }
+        self._server: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "NetProxy":
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.listen_host, self._requested_port))
+        srv.listen(64)
+        srv.settimeout(_POLL_S)
+        self._server = srv
+        self.port = srv.getsockname()[1]
+        t = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"netproxy-accept-{self.port}",
+        )
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._cv.notify_all()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._lock:
+            links = list(self._links)
+        for link in links:
+            link.close()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- control -----------------------------------------------------------
+
+    def set_mode(self, mode: str, direction: str = "both",
+                 delay_s: float = 0.0) -> None:
+        if mode not in MODES:
+            raise ValueError(f"unknown mode {mode!r} (one of {MODES})")
+        dirs = DIRECTIONS if direction == "both" else (direction,)
+        for d in dirs:
+            if d not in DIRECTIONS:
+                raise ValueError(
+                    f"unknown direction {d!r} (c2s | s2c | both)"
+                )
+        with self._lock:
+            for d in dirs:
+                pol = self._policies[d]
+                pol.mode = mode
+                pol.delay_s = float(delay_s)
+                pol.version += 1
+            self._cv.notify_all()
+        if mode == "drop":
+            # the hard partition resets live connections too
+            self._close_links(only_tainted=False)
+
+    def heal(self) -> None:
+        """Back to ``pass`` both ways; tainted (byte-losing) connections
+        are closed so clients reconnect over an intact stream."""
+        with self._lock:
+            for pol in self._policies.values():
+                pol.mode = "pass"
+                pol.delay_s = 0.0
+                pol.version += 1
+            self._cv.notify_all()
+        self._close_links(only_tainted=True)
+
+    def mode(self, direction: str) -> str:
+        with self._lock:
+            return self._policies[direction].mode
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+            out["live_links"] = sum(
+                1 for link in self._links if not link.closed
+            )
+        return out
+
+    def _close_links(self, only_tainted: bool) -> None:
+        with self._lock:
+            victims = [
+                link for link in self._links
+                if not link.closed and (link.tainted or not only_tainted)
+            ]
+        for link in victims:
+            link.close()
+
+    # -- data plane --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by stop()
+            with self._lock:
+                dropping = any(
+                    p.mode == "drop" for p in self._policies.values()
+                )
+                if dropping:
+                    self._stats["conns_refused"] += 1
+            if dropping:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                upstream = socket.create_connection(
+                    (self.target_host, self.target_port), timeout=10
+                )
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            link = _Link(client, upstream)
+            with self._lock:
+                self._links.append(link)
+                self._stats["conns_accepted"] += 1
+                # bounded bookkeeping: forget fully-closed links
+                if len(self._links) > 256:
+                    self._links = [
+                        ln for ln in self._links if not ln.closed
+                    ]
+            for direction, src, dst in (
+                ("c2s", client, upstream), ("s2c", upstream, client),
+            ):
+                t = threading.Thread(
+                    target=self._pump, args=(link, direction, src, dst),
+                    daemon=True, name=f"netproxy-{direction}-{self.port}",
+                )
+                t.start()
+
+    def _pump(self, link: _Link, direction: str, src: socket.socket,
+              dst: socket.socket) -> None:
+        src.settimeout(_POLL_S)
+        bytes_key = f"bytes_{direction}"
+        while not self._stop.is_set() and not link.closed:
+            with self._lock:
+                if self._policies[direction].mode == "stall":
+                    # stop READING: kernel buffers fill and the sender
+                    # blocks — stream bytes are preserved for the heal
+                    self._cv.wait(timeout=_POLL_S)
+                    continue
+            try:
+                chunk = src.recv(_CHUNK)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            # policy re-read at the FORWARDING decision (not before the
+            # recv): a mode flipped while this pump was parked in recv
+            # must govern the chunk that wake-up delivered
+            with self._lock:
+                pol = self._policies[direction]
+                mode, delay_s = pol.mode, pol.delay_s
+            if mode == "blackhole":
+                link.tainted = True
+                with self._lock:
+                    self._stats["bytes_discarded"] += len(chunk)
+                continue
+            if mode == "delay" and delay_s > 0:
+                # bounded nap slices so a heal mid-delay still applies
+                # promptly to the NEXT chunk (this one pays the latency)
+                end = time.monotonic() + delay_s
+                while (time.monotonic() < end
+                       and not self._stop.is_set() and not link.closed):
+                    time.sleep(min(_POLL_S, max(0.0, end - time.monotonic())))
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                break
+            with self._lock:
+                self._stats[bytes_key] += len(chunk)
+        link.close()
+
+
+# -- CLI: the remote-host form -------------------------------------------------
+
+def _apply_command(proxy: NetProxy, line: str) -> None:
+    """``mode <mode> <direction> [delay_s]`` | ``heal``."""
+    parts = line.split()
+    if not parts:
+        return
+    if parts[0] == "heal":
+        proxy.heal()
+    elif parts[0] == "mode" and len(parts) >= 3:
+        delay = float(parts[3]) if len(parts) > 3 else 0.0
+        proxy.set_mode(parts[1], parts[2], delay_s=delay)
+    else:
+        raise ValueError(f"bad proxy command: {line!r}")
+
+
+def _write_state(path: str, proxy: NetProxy, seq: int,
+                 error: Optional[str] = None) -> None:
+    state = {
+        "port": proxy.port,
+        "seq": seq,
+        "modes": {d: proxy.mode(d) for d in DIRECTIONS},
+        "stats": proxy.stats(),
+    }
+    if error:
+        state["error"] = error
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.netproxy")
+    ap.add_argument("--listen-port", type=int, default=0)
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--target", required=True, metavar="HOST:PORT")
+    ap.add_argument(
+        "--control", help="command file polled for `<seq> <command>` "
+        "lines (last line wins; applied once per seq)",
+    )
+    ap.add_argument(
+        "--state", help="where to write the JSON state file (defaults "
+        "to <control>.state, or stdout-once without --control)",
+    )
+    args = ap.parse_args(argv)
+    host, _, port_s = args.target.rpartition(":")
+    proxy = NetProxy(
+        host or "127.0.0.1", int(port_s),
+        listen_host=args.listen_host, listen_port=args.listen_port,
+    ).start()
+    state_path = args.state or (
+        args.control + ".state" if args.control else None
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    applied_seq = -1
+    if state_path:
+        _write_state(state_path, proxy, applied_seq)
+    else:
+        print(json.dumps({"port": proxy.port}), flush=True)
+    try:
+        while not stop.wait(_POLL_S):
+            if not args.control:
+                continue
+            try:
+                with open(args.control) as fh:
+                    lines = [l.strip() for l in fh if l.strip()]
+            except OSError:
+                continue
+            if not lines:
+                continue
+            try:
+                seq_s, _, command = lines[-1].partition(" ")
+                seq = int(seq_s)
+            except ValueError:
+                continue  # writer mid-flight; re-read next poll
+            if seq <= applied_seq:
+                continue
+            error = None
+            try:
+                _apply_command(proxy, command)
+            except ValueError as exc:
+                error = str(exc)
+            applied_seq = seq
+            _write_state(state_path, proxy, applied_seq, error=error)
+    finally:
+        proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

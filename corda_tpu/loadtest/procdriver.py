@@ -7,11 +7,27 @@ splits the same roles between `tools/loadtest/.../LoadTest.kt`
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from ..core.contracts import Amount
 from ..core.contracts.amount import Issued
+
+
+def _deadline_s(default: float) -> float:
+    """Driver-side wait budget. Knob-driven: a loaded soak box (or a
+    slow ssh rig) legitimately needs more than the laptop default, and
+    editing call sites per environment is how deadlines rot —
+    CORDA_TPU_LOADTEST_DEADLINE_S scales every procdriver wait at
+    once (unset = the call site's default)."""
+    raw = os.environ.get("CORDA_TPU_LOADTEST_DEADLINE_S")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 
 class PairDriver:
@@ -24,6 +40,7 @@ class PairDriver:
         self.me = me
         self.peer = peer
         self.completed = []          # payment stx ids
+        self.spent_refs = set()      # input refs of completed payments
         self.errors = []
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -46,29 +63,36 @@ class PairDriver:
         try:
             while not self._stop.is_set():
                 try:
+                    wait = _deadline_s(90.0)
                     fid = conn.proxy.start_flow_dynamic(
                         "CashIssueFlow", Amount(100, "USD"), b"\x01",
                         self.me, self.notary,
                     )
-                    conn.proxy.flow_result(fid, 90)
+                    conn.proxy.flow_result(fid, wait)
                     fid = conn.proxy.start_flow_dynamic(
                         "CashPaymentFlow", Amount(100, token), self.peer,
                         self.notary,
                     )
-                    stx = conn.proxy.flow_result(fid, 90)
+                    stx = conn.proxy.flow_result(fid, wait)
+                    # inputs first: the cross-host reconciliation reads
+                    # spent_refs for every id in completed, so an id must
+                    # never be visible before its refs
+                    self.spent_refs.update(stx.tx.inputs)
                     self.completed.append(stx.id)
                 except Exception as exc:
                     self.errors.append(f"{type(exc).__name__}: {exc}")
         finally:
             conn.close()
 
-    def stop(self, timeout=180):
+    def stop(self, timeout=None):
         self._stop.set()
-        self._thread.join(timeout=timeout)
+        self._thread.join(
+            timeout=timeout if timeout is not None else _deadline_s(180.0)
+        )
         assert not self._thread.is_alive(), "driver wedged"
 
 
-def payment_txids(bank_b, deadline_s=60, want=None):
+def payment_txids(bank_b, deadline_s=None, want=None):
     """(tx ids, total state count) of cash states in B's vault, polled
     until `want` is a subset of the ids or the deadline passes.
 
@@ -78,6 +102,8 @@ def payment_txids(bank_b, deadline_s=60, want=None):
     of 5,000 keep each reply bounded."""
     from ..node.vault_query import PageSpecification
 
+    if deadline_s is None:
+        deadline_s = _deadline_s(60.0)
     conn = bank_b.connect()
     try:
         deadline = time.monotonic() + deadline_s

@@ -176,6 +176,8 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
             if received >= done[0]:
                 break
             time.sleep(0.3)
+        from ..utils.quiesce import env_fingerprint
+
         result = {
             "metric": "real-process-notarised-pairs/sec",
             "pairs": pairs,
@@ -187,6 +189,20 @@ def run(pairs: int = 50, parallelism: int = 4, verbose: bool = False,
             "parallelism": parallelism,
             "shards": int(shards) or 1,
             "node_workers": int(node_workers),
+            # the same provenance block bench records carry: without it
+            # a soak/bench artifact pair from different boxes would
+            # hard-compare in the gate (the round-5 confusion), and the
+            # host/worker topology is part of what "the same
+            # environment" means for a multi-process run
+            "env_fingerprint": env_fingerprint(
+                shards=int(shards) or None,
+                node_workers=int(node_workers) or None,
+            ),
+            "host_topology": {
+                "nodes": 3,
+                "shards": int(shards) or 1,
+                "node_workers_per_bank": int(node_workers),
+            },
         }
         if verbose and errors:
             result["first_error"] = errors[0]

@@ -1,0 +1,1165 @@
+"""Remote multi-node soak: ssh-driven loadtest with process/host-level
+disruptions (reference `tools/loadtest/` — `LoadTest.kt` generate/
+execute/gather driven at an SSH-managed cluster of real nodes with
+`Disruption.kt` restart/hang/partition faults).
+
+    python -m corda_tpu.loadtest.remote --hosts hosts.conf
+
+``hosts.conf`` — one host per line, ``#`` comments::
+
+    # target            [key=value ...]
+    local                                  # exec on this machine
+    localhost                              # ssh to the local sshd rig
+    loadtest@10.1.2.3    workdir=/tmp/soak python=python3.10
+
+Keys: ``workdir=`` (deploy root, default a per-run temp dir),
+``python=`` (interpreter, default this one), ``repo=`` (PYTHONPATH root
+holding ``corda_tpu`` on that host, default this repo), ``name=``.
+
+The driver deploys a cordform network across the hosts (notary+netmap
+on the first, bank A on the second, bank B on the last — all on one
+host for the single-entry localhost rig), starts REAL node processes
+through each host's session (``python -m corda_tpu.node <dir>
+--ready-file``: one atomic JSON read hands back port+pid, the driver
+never polls stdout blind), runs the issue+pay pair workload over real
+TCP brokers, mixes in the explorer GUI path (dashboard POST
+``/action/issue``/``/action/pay`` against a local gateway), and fires
+the process-granular disruption catalog (loadtest/disruption.py):
+
+  * ``process_restart`` — SIGKILL the notary, relaunch, assert pairs
+    resume (durable uniqueness log + checkpoint restore);
+  * ``process_hang`` — SIGSTOP/SIGCONT (the gray failure only the
+    deadline/circuit-breaker paths survive);
+  * ``transport_partition`` — a controllable TCP proxy
+    (loadtest/netproxy.py) in front of bank B's broker port: the
+    deployment ADVERTISES the proxy address so every peer byte crosses
+    the degradable link — no root/iptables;
+  * ``shard_worker_process_kill`` — SIGKILL one ``--shard-worker`` OS
+    process on sharded hosts (``--node-workers N``).
+
+Every heal asserts RECOVERY (progress after the fault), the
+sustained-overload scenario runs as a typed-shed burst against bank A's
+admission caps (the SustainedOverloadLoadTest contract — shed_rate /
+goodput / recovered — over RPC instead of in-process handles), and the
+end of the soak re-checks the `assert_no_loss_no_dup` contract plus a
+cross-host ledger reconciliation. One JSON result line rides the same
+SLO machinery as the bench gate (`slo_violations`, env_fingerprint with
+host topology); `tools/soak_gate.py` turns it into CI exit status.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import shlex
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _q(s: str) -> str:
+    return shlex.quote(str(s))
+
+
+# ---------------------------------------------------------------------------
+# hosts.conf
+# ---------------------------------------------------------------------------
+
+class HostSpec:
+    """One parsed hosts.conf line."""
+
+    def __init__(self, target: str, options: Optional[Dict[str, str]] = None):
+        options = dict(options or {})
+        self.target = target
+        self.is_local = target in ("local", "local-exec")
+        self.name = options.pop("name", None) or (
+            "local" if self.is_local else target
+        )
+        #: the address the DRIVER (and peers on other hosts) dial
+        self.addr = options.pop("addr", None) or (
+            "127.0.0.1" if self.is_local
+            else target.rsplit("@", 1)[-1]
+        )
+        self.workdir = options.pop("workdir", None)
+        self.python = options.pop("python", None) or sys.executable
+        self.repo = options.pop("repo", None) or _REPO_ROOT
+        self.options = options
+
+    def __repr__(self) -> str:
+        return f"HostSpec({self.target!r}, addr={self.addr!r})"
+
+
+def parse_hosts(text: str) -> List[HostSpec]:
+    """hosts.conf text -> HostSpecs. Raises ValueError on an empty or
+    malformed file — a soak that silently ran on zero hosts proved
+    nothing."""
+    specs: List[HostSpec] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        options: Dict[str, str] = {}
+        for part in parts[1:]:
+            key, sep, value = part.partition("=")
+            if not sep or not key:
+                raise ValueError(
+                    f"hosts.conf line {lineno}: expected key=value, "
+                    f"got {part!r}"
+                )
+            options[key] = value
+        specs.append(HostSpec(parts[0], options))
+    if not specs:
+        raise ValueError("hosts.conf names no hosts")
+    return specs
+
+
+def load_hosts(path: str) -> List[HostSpec]:
+    with open(path) as fh:
+        return parse_hosts(fh.read())
+
+
+# ---------------------------------------------------------------------------
+# host sessions: bounded-timeout exec over local sh or ssh
+# ---------------------------------------------------------------------------
+
+class SessionError(Exception):
+    pass
+
+
+class HostSession:
+    """Run shell commands on one host with BOUNDED timeouts. The ssh
+    flavour retries transport failures with capped backoff (a flaky
+    link must degrade to slow, never to hung); every method is also
+    implementable by a test fake, which is how the disruption-catalog
+    unit tests stay deterministic."""
+
+    #: capped-backoff schedule for transport-level retries (seconds)
+    BACKOFF = (0.5, 1.0, 2.0, 4.0, 5.0)
+
+    def __init__(self, spec: HostSpec, connect_timeout_s: float = 10.0,
+                 exec_timeout_s: float = 60.0):
+        self.spec = spec
+        self.connect_timeout_s = connect_timeout_s
+        self.exec_timeout_s = exec_timeout_s
+
+    # subclass surface -----------------------------------------------------
+
+    def _argv(self, command: str) -> List[str]:
+        raise NotImplementedError
+
+    def _is_transport_failure(self, rc: int) -> bool:
+        return False
+
+    # shared exec ----------------------------------------------------------
+
+    def run(self, command: str, timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str]:
+        """(rc, combined output). Transport failures retry with capped
+        backoff inside one reconnect budget; command failures do not
+        (the caller asked the command, it answered)."""
+        timeout = timeout or self.exec_timeout_s
+        last: Tuple[int, str] = (255, "")
+        for i, backoff in enumerate((0.0,) + self.BACKOFF):
+            if backoff:
+                time.sleep(backoff)
+            try:
+                proc = subprocess.run(
+                    self._argv(command), capture_output=True, text=True,
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                # the command ran and overran its budget — that is its
+                # answer, not a transport failure; retrying would
+                # multiply the wait by the whole backoff schedule
+                last = (124, f"timeout after {timeout}s: {command}")
+                break
+            out = (proc.stdout or "") + (proc.stderr or "")
+            last = (proc.returncode, out)
+            if not self._is_transport_failure(proc.returncode):
+                break
+        rc, out = last
+        if check and rc != 0:
+            raise SessionError(
+                f"[{self.spec.name}] command failed rc={rc}: {command}\n"
+                f"{out[-2000:]}"
+            )
+        return rc, out
+
+    # conveniences ---------------------------------------------------------
+
+    def spawn(self, command: str, log_path: str,
+              env: Optional[Dict[str, str]] = None,
+              cwd: Optional[str] = None) -> int:
+        """Start a long-lived background process; returns its PID. The
+        process survives this exec returning (nohup + detach), logs to
+        `log_path` on the host."""
+        env_prefix = " ".join(
+            f"{k}={_q(v)}" for k, v in sorted((env or {}).items())
+        )
+        cd = f"cd {_q(cwd)} && " if cwd else ""
+        line = (
+            f"{cd}nohup env {env_prefix} {command} "
+            f"> {_q(log_path)} 2>&1 < /dev/null & echo $!"
+        )
+        _, out = self.run(line, check=True)
+        try:
+            return int(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            raise SessionError(
+                f"[{self.spec.name}] spawn returned no pid: {out[-500:]}"
+            )
+
+    def signal(self, pid: int, sig: str) -> bool:
+        rc, _ = self.run(f"kill -{sig} {int(pid)}")
+        return rc == 0
+
+    def alive(self, pid: int) -> bool:
+        rc, _ = self.run(f"kill -0 {int(pid)} 2>/dev/null")
+        return rc == 0
+
+    def read_file(self, path: str) -> Optional[str]:
+        rc, out = self.run(f"cat {_q(path)} 2>/dev/null")
+        return out if rc == 0 else None
+
+    def write_file(self, path: str, content: str) -> None:
+        self.run(
+            f"printf %s {_q(content)} > {_q(path)}.tmp && "
+            f"mv {_q(path)}.tmp {_q(path)}",
+            check=True,
+        )
+
+    def free_port(self) -> int:
+        rc, out = self.run(
+            f"{_q(self.spec.python)} -c "
+            + _q("import socket; s=socket.socket(); s.bind(('127.0.0.1',0));"
+                 "print(s.getsockname()[1])"),
+            check=True,
+        )
+        return int(out.strip().splitlines()[-1])
+
+    def find_pids(self, pattern: str) -> List[int]:
+        """PIDs whose /proc cmdline contains `pattern` (portable over
+        any exec transport, no pgrep dependency). The scan pipeline's
+        own sh/grep processes carry the pattern in THEIR cmdlines too —
+        filtered out by comm, or a disruption would kill the scanner
+        instead of the target."""
+        script = (
+            "for p in /proc/[0-9]*; do "
+            "case $(cat \"$p\"/comm 2>/dev/null) in "
+            "sh|bash|dash|grep|tr|cat|sshd) continue;; esac; "
+            f"tr '\\0' ' ' < \"$p\"/cmdline 2>/dev/null | "
+            f"grep -q -- {_q(pattern)} && basename \"$p\"; done; true"
+        )
+        _, out = self.run(script)
+        pids = []
+        for line in out.split():
+            try:
+                pids.append(int(line))
+            except ValueError:
+                continue
+        return pids
+
+    def put_dir(self, local_dir: str, remote_parent: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalSession(HostSession):
+    """Exec on this machine through sh — the `local` hosts.conf entry,
+    and the CI-reproducible floor the ssh flavour shares every code
+    path above with."""
+
+    def _argv(self, command: str) -> List[str]:
+        return ["sh", "-c", command]
+
+    def put_dir(self, local_dir: str, remote_parent: str) -> None:
+        dest = os.path.join(remote_parent, os.path.basename(local_dir))
+        os.makedirs(remote_parent, exist_ok=True)
+        if os.path.abspath(dest) != os.path.abspath(local_dir):
+            shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+
+
+class SshSession(HostSession):
+    """Exec over `ssh` with BatchMode (never an interactive prompt),
+    bounded connect timeout, a shared control-master connection (one
+    TCP+auth handshake amortised over the whole soak) and capped-backoff
+    retry of transport failures (rc 255)."""
+
+    def __init__(self, spec: HostSpec, connect_timeout_s: float = 10.0,
+                 exec_timeout_s: float = 60.0,
+                 control_dir: Optional[str] = None):
+        super().__init__(spec, connect_timeout_s, exec_timeout_s)
+        self._control_dir = control_dir or tempfile.mkdtemp(prefix="soak-cm-")
+
+    def _ssh_base(self) -> List[str]:
+        return [
+            "ssh",
+            "-o", "BatchMode=yes",
+            "-o", f"ConnectTimeout={int(self.connect_timeout_s)}",
+            "-o", "ServerAliveInterval=5",
+            "-o", "ServerAliveCountMax=2",
+            "-o", "StrictHostKeyChecking=accept-new",
+            "-o", "ControlMaster=auto",
+            "-o", f"ControlPath={self._control_dir}/cm-%C",
+            "-o", "ControlPersist=60",
+            self.spec.target,
+        ]
+
+    def _argv(self, command: str) -> List[str]:
+        return self._ssh_base() + ["--", command]
+
+    def _is_transport_failure(self, rc: int) -> bool:
+        return rc == 255  # ssh's own exit code for connection problems
+
+    def put_dir(self, local_dir: str, remote_parent: str) -> None:
+        tar = subprocess.Popen(
+            ["tar", "-C", os.path.dirname(local_dir), "-cf", "-",
+             os.path.basename(local_dir)],
+            stdout=subprocess.PIPE,
+        )
+        try:
+            unpack = subprocess.run(
+                self._argv(
+                    f"mkdir -p {_q(remote_parent)} && "
+                    f"tar -C {_q(remote_parent)} -xf -"
+                ),
+                stdin=tar.stdout, capture_output=True,
+                timeout=self.exec_timeout_s * 4,
+            )
+        finally:
+            if tar.stdout is not None:
+                tar.stdout.close()
+            tar.wait(timeout=30)
+        if unpack.returncode != 0 or tar.returncode != 0:
+            raise SessionError(
+                f"[{self.spec.name}] put_dir failed: "
+                f"{unpack.stderr.decode(errors='replace')[-1000:]}"
+            )
+
+    def close(self) -> None:
+        # tear down the control master so nothing lingers past the soak
+        subprocess.run(
+            self._ssh_base() + ["-O", "exit"],
+            capture_output=True, timeout=10,
+        )
+
+
+def open_session(spec: HostSpec, connect_timeout_s: float = 10.0,
+                 exec_timeout_s: float = 60.0) -> HostSession:
+    cls = LocalSession if spec.is_local else SshSession
+    session = cls(spec, connect_timeout_s, exec_timeout_s)
+    rc, out = session.run("echo soak-probe-ok", timeout=connect_timeout_s * 3)
+    if rc != 0 or "soak-probe-ok" not in out:
+        raise SessionError(
+            f"cannot reach host {spec.name!r} ({spec.target}): rc={rc} "
+            f"{out[-500:]}"
+        )
+    return session
+
+
+# ---------------------------------------------------------------------------
+# remote process handles
+# ---------------------------------------------------------------------------
+
+class RemoteNode:
+    """One node process on a host: launch through the session, learn
+    port+pid from the atomic --ready-file handshake, signal it, RPC
+    into it. Duck-types what PairDriver / assert_no_loss_no_dup /
+    the disruption catalog need."""
+
+    def __init__(self, session: HostSession, node_dir: str, name: str,
+                 jax_platform: Optional[str] = "cpu"):
+        self.session = session
+        self.node_dir = node_dir  # path ON THE HOST
+        self.name = name
+        self.jax_platform = jax_platform
+        self.pid: Optional[int] = None
+        self.broker_port: Optional[int] = None
+        self.ops_port: Optional[int] = None
+        self._clients: List = []
+
+    @property
+    def ready_file(self) -> str:
+        return os.path.join(self.node_dir, "ready.json")
+
+    @property
+    def log_path(self) -> str:
+        return os.path.join(self.node_dir, "node.log")
+
+    def launch(self, timeout: float = 180.0) -> "RemoteNode":
+        spec = self.session.spec
+        # stale handshake files from a previous (killed) run would make
+        # the readiness poll below return before the new process binds
+        self.session.run(
+            f"rm -f {_q(self.ready_file)} "
+            f"{_q(os.path.join(self.node_dir, 'broker.port'))}"
+        )
+        platform_arg = (
+            f" --jax-platform {_q(self.jax_platform)}"
+            if self.jax_platform else ""
+        )
+        self.pid = self.session.spawn(
+            f"{_q(spec.python)} -m corda_tpu.node {_q(self.node_dir)}"
+            f"{platform_arg} --ready-file {_q(self.ready_file)}",
+            self.log_path,
+            env={"PYTHONPATH": spec.repo},
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self.session.read_file(self.ready_file)
+            if raw:
+                try:
+                    ready = json.loads(raw)
+                except ValueError:
+                    ready = None  # writer mid-flight; poll again
+                if ready:
+                    self.broker_port = int(ready["broker_port"])
+                    self.ops_port = ready.get("ops_port")
+                    self.pid = int(ready.get("pid") or self.pid)
+                    return self
+            if not self.session.alive(self.pid):
+                raise SessionError(
+                    f"node {self.name} died on startup on "
+                    f"{spec.name}:\n{self.log_tail()}"
+                )
+            time.sleep(0.2)
+        raise SessionError(
+            f"node {self.name} not ready in {timeout}s on {spec.name}:\n"
+            f"{self.log_tail()}"
+        )
+
+    def log_tail(self, lines: int = 40) -> str:
+        _, out = self.session.run(
+            f"tail -n {int(lines)} {_q(self.log_path)} 2>/dev/null"
+        )
+        return out
+
+    # -- disruption surface (Disruption.kt signals over the session) ------
+
+    def kill(self) -> None:
+        if self.pid is not None:
+            self.session.signal(self.pid, "KILL")
+            deadline = time.monotonic() + 10
+            while (self.session.alive(self.pid)
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+
+    def suspend(self) -> None:
+        if self.pid is not None:
+            self.session.signal(self.pid, "STOP")
+
+    def resume(self) -> None:
+        if self.pid is not None:
+            self.session.signal(self.pid, "CONT")
+
+    def relaunch(self, timeout: float = 180.0) -> "RemoteNode":
+        return self.launch(timeout=timeout)
+
+    def alive(self) -> bool:
+        return self.pid is not None and self.session.alive(self.pid)
+
+    # -- RPC --------------------------------------------------------------
+
+    def connect(self, username: str = "admin", password: str = "admin",
+                cordapps=("corda_tpu.finance.flows",)):
+        import importlib
+
+        for mod in cordapps:
+            importlib.import_module(mod)
+        from ..messaging.net import RemoteBroker
+        from ..rpc.client import CordaRPCClient
+
+        client = CordaRPCClient(
+            RemoteBroker(self.session.spec.addr, self.broker_port)
+        )
+        self._clients.append(client)
+        return client.start(username, password)
+
+    def close(self) -> None:
+        for c in self._clients:
+            try:
+                c.close()
+            # lint: allow(swallow) — teardown of an already-dead client
+            except Exception:
+                pass
+        self._clients.clear()
+        if self.pid is not None:
+            self.session.signal(self.pid, "TERM")
+            deadline = time.monotonic() + 10
+            while (self.session.alive(self.pid)
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            if self.session.alive(self.pid):
+                self.session.signal(self.pid, "KILL")
+
+
+class RemoteProxy:
+    """The partition proxy as a process on a HOST, controlled through
+    the polled command file (works over any exec transport). Duck-types
+    NetProxy's set_mode/heal for the transport_partition catalog
+    entry."""
+
+    def __init__(self, session: HostSession, workdir: str,
+                 listen_port: int, target_port: int,
+                 listen_host: Optional[str] = None):
+        self.session = session
+        self.workdir = workdir
+        self.listen_port = listen_port
+        self.target_port = target_port
+        # a REMOTE host advertises the proxy to peers on OTHER machines,
+        # so it must listen on every interface; the local rig stays
+        # loopback-only
+        self.listen_host = listen_host or (
+            "127.0.0.1" if session.spec.is_local else "0.0.0.0"
+        )
+        self.control = os.path.join(workdir, "proxy.ctl")
+        self.state_path = self.control + ".state"
+        self.pid: Optional[int] = None
+        self._seq = 0
+
+    def launch(self, timeout: float = 30.0) -> "RemoteProxy":
+        spec = self.session.spec
+        self.session.run(
+            f"rm -f {_q(self.control)} {_q(self.state_path)}"
+        )
+        self.pid = self.session.spawn(
+            f"{_q(spec.python)} -m corda_tpu.loadtest.netproxy "
+            f"--listen-host {_q(self.listen_host)} "
+            f"--listen-port {self.listen_port} "
+            f"--target 127.0.0.1:{self.target_port} "
+            f"--control {_q(self.control)}",
+            os.path.join(self.workdir, "proxy.log"),
+            env={"PYTHONPATH": spec.repo},
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self.session.read_file(self.state_path)
+            if raw:
+                try:
+                    state = json.loads(raw)
+                except ValueError:
+                    state = None
+                if state and state.get("port") == self.listen_port:
+                    return self
+            if not self.session.alive(self.pid):
+                raise SessionError(
+                    f"partition proxy died on startup on {spec.name}"
+                )
+            time.sleep(0.1)
+        raise SessionError(f"partition proxy not ready in {timeout}s")
+
+    def _command(self, command: str, timeout: float = 15.0) -> None:
+        self._seq += 1
+        self.session.write_file(self.control, f"{self._seq} {command}\n")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self.session.read_file(self.state_path)
+            if raw:
+                try:
+                    state = json.loads(raw)
+                except ValueError:
+                    state = None
+                if state and state.get("seq", -1) >= self._seq:
+                    if state.get("error"):
+                        raise SessionError(
+                            f"proxy rejected {command!r}: {state['error']}"
+                        )
+                    return
+            time.sleep(0.05)
+        raise SessionError(f"proxy never acked {command!r}")
+
+    def set_mode(self, mode: str, direction: str = "both",
+                 delay_s: float = 0.0) -> None:
+        suffix = f" {delay_s}" if mode == "delay" else ""
+        self._command(f"mode {mode} {direction}{suffix}")
+
+    def heal(self) -> None:
+        self._command("heal")
+
+    def stop(self) -> None:
+        if self.pid is not None:
+            self.session.signal(self.pid, "TERM")
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+def _patch_conf(node_dir: str, **updates) -> None:
+    path = os.path.join(node_dir, "node.conf")
+    with open(path) as fh:
+        conf = json.load(fh)
+    conf.update({k: v for k, v in updates.items() if v is not None})
+    with open(path, "w") as fh:
+        json.dump(conf, fh, indent=2)
+
+
+class _WebActionMixer:
+    """The explorer GUI path as soak traffic: POSTs the dashboard's
+    /action/issue and /action/pay forms against a local gateway bridging
+    to bank A's RPC, recording typed overload rejections (retry_after_ms
+    honoured with a bounded nap) separately from hard errors."""
+
+    def __init__(self, ops, peer_name: str, period_s: float = 1.0):
+        from ..webserver.server import WebServer
+
+        self.server = WebServer(ops)
+        self.peer_name = peer_name
+        self.period_s = period_s
+        self.stats = {"issued": 0, "paid": 0, "overloaded": 0, "errors": 0}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="soak-web-mixer"
+        )
+
+    def start(self) -> "_WebActionMixer":
+        self._thread.start()
+        return self
+
+    def _post(self, path: str, form: Dict[str, str]) -> None:
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.server.port}{path}"
+        data = urllib.parse.urlencode(form).encode()
+        try:
+            with urllib.request.urlopen(url, data=data, timeout=30) as resp:
+                json.loads(resp.read().decode())
+            self.stats["issued" if path.endswith("issue") else "paid"] += 1
+        except urllib.error.HTTPError as exc:
+            body = exc.read().decode(errors="replace")
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                payload = {}
+            if exc.code == 429 or payload.get("error") == "overloaded":
+                self.stats["overloaded"] += 1
+                retry_ms = payload.get("retry_after_ms") or 0
+                self._stop.wait(min(2.0, retry_ms / 1000.0))
+            else:
+                self.stats["errors"] += 1
+                self.stats["last_error"] = body[-200:]
+        except Exception as exc:
+            # node mid-disruption: the GUI keeps trying, like a human,
+            # and the last failure stays visible in the result record
+            self.stats["errors"] += 1
+            self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self._post("/action/issue", {"amount": "100", "currency": "USD"})
+            if self._stop.is_set():
+                break
+            self._post(
+                "/action/pay",
+                {"amount": "100", "currency": "USD",
+                 "peer": self.peer_name},
+            )
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        self._thread.join(timeout=60)
+        self.server.stop()
+        return dict(self.stats)
+
+
+def _overload_burst(bank_a: RemoteNode, probe, burst: int,
+                    recovery_deadline_s: float = 120.0) -> Dict[str, float]:
+    """The SustainedOverloadLoadTest contract against the REMOTE
+    cluster: slam bank A's admission caps over RPC, require typed
+    NodeOverloadedError sheds with retry hints, then assert the node
+    recovered (pairs resume). Same metric names as the in-process
+    scenario so the SLO machinery reads both."""
+    from ..node.admission import NodeOverloadedError
+    from .disruption import assert_recovers
+
+    conn = bank_a.connect()
+    counts = {"attempted": 0, "shed": 0, "admitted": 0, "bad": 0,
+              "errors": 0}
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    try:
+        me = conn.proxy.node_info()
+        notary = conn.proxy.notary_identities()[0]
+        from ..core.contracts import Amount
+
+        before = probe()
+
+        def slam(n: int) -> None:
+            # own connection per thread (own reply queue); CONCURRENT
+            # senders so the attempt rate genuinely outruns the token
+            # refill — a single RPC-paced loop never fills the bucket
+            c = bank_a.connect()
+            try:
+                for _ in range(n):
+                    with lock:
+                        counts["attempted"] += 1
+                    try:
+                        c.proxy.start_flow_dynamic(
+                            "CashIssueFlow", Amount(1, "USD"), b"\x01",
+                            me, notary,
+                        )
+                        with lock:
+                            counts["admitted"] += 1
+                    except NodeOverloadedError as exc:
+                        with lock:
+                            counts["shed"] += 1
+                            if exc.retry_after_ms < 0:
+                                counts["bad"] += 1
+                    except Exception as exc:
+                        # any OTHER rejection under burst (bounded RPC
+                        # queue, transport hiccup) is counted, never a
+                        # silently-dead thread skewing the gated metrics
+                        with lock:
+                            counts["errors"] += 1
+                            counts.setdefault(
+                                "last_error",
+                                f"{type(exc).__name__}: {exc}"[:200],
+                            )
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(
+                target=slam, args=(burst // 4 or 1,), daemon=True,
+                name=f"soak-burst-{i}",
+            )
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        recovered = 1.0
+        try:
+            assert_recovers(
+                probe, before, "overload burst",
+                min_progress=2, deadline_s=recovery_deadline_s,
+            )
+        except AssertionError:
+            recovered = 0.0
+    finally:
+        conn.close()
+    elapsed = max(1e-9, time.perf_counter() - t0)
+    attempted, shed = counts["attempted"], counts["shed"]
+    admitted, bad_rejections = counts["admitted"], counts["bad"]
+    out = {
+        "attempted": float(attempted),
+        "admitted": float(admitted),
+        "shed": float(shed),
+        "shed_rate": shed / attempted if attempted else 0.0,
+        "bad_rejections": float(bad_rejections),
+        "errors": float(counts["errors"]),
+        "goodput_per_sec": admitted / elapsed,
+        "recovered": recovered,
+    }
+    if counts.get("last_error"):
+        out["last_error"] = counts["last_error"]
+    return out
+
+
+def reconcile_ledgers(driver, bank_a: RemoteNode) -> Dict[str, float]:
+    """Cross-HOST ledger reconciliation beyond the counterparty
+    no-loss/no-dup check (which already audits bank B): every INPUT a
+    completed payment consumed must be consumed in the PAYER's vault
+    too (the spend side committed on A's host exactly as the receive
+    side did on B's). Payment txids themselves may legitimately appear
+    in A's vault — change outputs belong to the payer."""
+    from ..node.vault_query import PageSpecification
+
+    spent_refs = set(driver.spent_refs)
+    conn = bank_a.connect()
+    try:
+        a_unconsumed_refs = set()
+        page_number = 1
+        while True:
+            page = conn.proxy.vault_query_by(
+                paging=PageSpecification(page_number, 5000)
+            )
+            a_unconsumed_refs.update(s.ref for s in page.states)
+            if len(page.states) < 5000:
+                break
+            page_number += 1
+    finally:
+        conn.close()
+    resurrected = spent_refs & a_unconsumed_refs
+    assert not resurrected, (
+        f"payer still holds inputs of completed payments unconsumed "
+        f"(torn spend across hosts): {sorted(map(repr, resurrected))[:3]}"
+    )
+    return {
+        "payments_checked": float(len(driver.completed)),
+        "spent_refs_checked": float(len(spent_refs)),
+        "payer_unconsumed_states": float(len(a_unconsumed_refs)),
+        "torn_spends": 0.0,
+    }
+
+
+#: SLO defaults for the soak record (gate.check_slos shape) — loose
+#: enough for a 1-core CI rig, hard on the invariants
+DEFAULT_SOAK_SLOS = {
+    "pairs": {"min": 1.0},
+    "disruptions_fired": {"min": 3.0},
+    "disruptions_recovered": {"min": 3.0},
+    # a SIGKILLed notary legitimately fails the in-flight pair (and one
+    # conflict-reconciliation pair) per restart — bounded as a RATE; a
+    # wedge (every pair failing) still breaches hard
+    "hard_error_rate": {"max": 0.2},
+    "overload.recovered": {"min": 1.0},
+    "overload.shed": {"min": 1.0},
+    "overload.bad_rejections": {"max": 0.0},
+    "reconciliation.torn_spends": {"max": 0.0},
+}
+
+
+def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
+        node_workers: int = 0, verbose: bool = False,
+        overload_burst: int = 0, slos: Optional[Dict] = None,
+        connect_timeout_s: float = 10.0, exec_timeout_s: float = 60.0,
+        recovery_deadline_s: float = 180.0,
+        jax_platform: Optional[str] = "cpu") -> dict:
+    from ..tools.cordform import deploy_nodes
+    from ..utils.quiesce import env_fingerprint
+    from .disruption import (
+        process_hang,
+        process_restart,
+        shard_worker_process_kill,
+        transport_partition,
+    )
+    from .gate import check_slos
+    from .procdriver import PairDriver, assert_no_loss_no_dup, \
+        resolve_identities
+
+    rng = random.Random(seed)
+    staging = tempfile.mkdtemp(prefix="remote-soak-")
+
+    def say(*parts) -> None:
+        if verbose:
+            print("[soak]", *parts, flush=True)
+
+    sessions = [
+        open_session(h, connect_timeout_s, exec_timeout_s) for h in hosts
+    ]
+    for hspec, session in zip(hosts, sessions):
+        if hspec.workdir is None:
+            hspec.workdir = staging if hspec.is_local else (
+                f"/tmp/corda-soak-{os.getpid()}"
+            )
+        session.run(f"mkdir -p {_q(hspec.workdir)}", check=True)
+    # role placement: notary+netmap / bank A / bank B spread over the
+    # hosts; a single-entry rig stacks all three on it
+    h_notary = hosts[0]
+    h_bank_a = hosts[1 % len(hosts)]
+    h_bank_b = hosts[-1]
+    s_notary, s_bank_a, s_bank_b = (
+        sessions[hosts.index(h)] for h in (h_notary, h_bank_a, h_bank_b)
+    )
+
+    # With the burst phase on, bank A gets REAL admission caps: a
+    # token-bucket rate the burst provably outruns (a live-flow cap
+    # alone never fills — RPC-paced issues complete faster than they
+    # arrive) plus a flow cap as the second bound the contract names.
+    bank_a_spec = {"name": "O=SoakBankA,L=London,C=GB"}
+    if overload_burst:
+        bank_a_spec["admission_rate"] = 30
+        bank_a_spec["admission_burst"] = 60
+        bank_a_spec["admission_max_flows"] = 256
+    spec = {"nodes": [
+        {"name": "O=SoakNotary,L=Zurich,C=CH", "notary": "validating",
+         "network_map_service": True},
+        bank_a_spec,
+        {"name": "O=SoakBankB,L=Paris,C=FR"},
+    ]}
+    if node_workers:
+        spec["nodes"][1]["node_workers"] = int(node_workers)
+    resolved = deploy_nodes(spec, staging)
+
+    # transport partition: bank B's broker hides behind a TCP proxy on
+    # ITS host — the deployment advertises the proxy address, so every
+    # peer byte to B crosses the degradable link. Port allocated on the
+    # host (the driver's free_port would race a remote port space).
+    proxy_port = s_bank_b.free_port()
+    _patch_conf(
+        resolved[2]["dir"],
+        advertised_address=f"{h_bank_b.addr}:{proxy_port}",
+    )
+    map_addr = f"{h_notary.addr}:{resolved[0]['broker_port']}"
+    for i, (host, conf) in enumerate(
+        zip((h_notary, h_bank_a, h_bank_b), resolved)
+    ):
+        updates = {}
+        if not host.is_local:
+            # remote host: bind every interface, advertise the routable
+            # address (the proxied node already advertises its proxy)
+            updates["broker_host"] = "0.0.0.0"
+            if i != 2:
+                updates["advertised_address"] = (
+                    f"{host.addr}:{conf['broker_port']}"
+                )
+        if i != 0:
+            updates["network_map"] = map_addr
+        if updates:
+            _patch_conf(conf["dir"], **updates)
+
+    nodes: List[RemoteNode] = []
+    proxy: Optional[RemoteProxy] = None
+    driver = None
+    mixer = None
+    events: List[Tuple[float, str, str]] = []
+    try:
+        for host, session, conf in zip(
+            (h_notary, h_bank_a, h_bank_b), (s_notary, s_bank_a, s_bank_b),
+            resolved,
+        ):
+            remote_dir = os.path.join(
+                host.workdir, os.path.basename(conf["dir"])
+            )
+            session.put_dir(conf["dir"], host.workdir)
+            node = RemoteNode(
+                session, remote_dir, conf["my_legal_name"],
+                jax_platform=jax_platform,
+            )
+            say("launching", conf["my_legal_name"], "on", host.name)
+            node.launch()
+            nodes.append(node)
+        notary_node, bank_a, bank_b = nodes
+        proxy = RemoteProxy(
+            s_bank_b, os.path.dirname(bank_b.node_dir) or h_bank_b.workdir,
+            proxy_port, bank_b.broker_port,
+        ).launch()
+        say("partition proxy", f"{h_bank_b.addr}:{proxy_port}",
+            "->", bank_b.broker_port)
+
+        me, cluster, peer = resolve_identities(bank_a, bank_b)
+        driver = PairDriver(bank_a, cluster, me, peer).start()
+
+        def probe() -> int:
+            return len(driver.completed)
+
+        conn_web = bank_a.connect()
+        mixer = _WebActionMixer(conn_web.proxy, peer.name).start()
+
+        warm_deadline = time.monotonic() + 240
+        while probe() < 2:
+            assert driver._thread.is_alive(), (
+                f"driver died during warm-up: {driver.errors[-3:]}"
+            )
+            assert time.monotonic() < warm_deadline, (
+                f"warm-up stalled: {driver.errors[-3:]}"
+            )
+            time.sleep(0.3)
+        say("warm; composing disruptions")
+
+        catalog = [
+            ("restart", process_restart(
+                notary_node, probe,
+                recovery_deadline_s=recovery_deadline_s)),
+            ("hang", process_hang(
+                notary_node, probe,
+                recovery_deadline_s=recovery_deadline_s)),
+            ("partition", transport_partition(
+                proxy, probe, mode="stall",
+                recovery_deadline_s=recovery_deadline_s)),
+        ]
+        if node_workers:
+            worker_pattern = f"{bank_a.node_dir} --shard-worker"
+
+            def pick_pid(rng_):
+                pids = s_bank_a.find_pids(worker_pattern)
+                return rng_.choice(pids) if pids else None
+
+            catalog.append(("worker_kill", shard_worker_process_kill(
+                pick_pid, lambda pid: s_bank_a.signal(pid, "KILL"), probe,
+                recovery_deadline_s=recovery_deadline_s)))
+
+        t0 = time.monotonic()
+        t_end = t0 + duration
+        fired = recovered = 0
+        rounds = 0
+        while True:
+            rounds += 1
+            for kind, disruption in catalog:
+                before = probe()
+                say("fire", kind, "completed:", before)
+                disruption.fire(rng)
+                # a conditional entry (worker kill with no worker
+                # visible) reports whether it ACTUALLY fired — a no-op
+                # must not fabricate disruption coverage in the record
+                state = getattr(disruption, "state", None)
+                effective = (
+                    state.get("fired", True) if state is not None else True
+                )
+                if not effective:
+                    disruption.heal(rng)  # clears _fired_at; no-op heal
+                    events.append((round(time.monotonic() - t0, 1),
+                                   kind, "skipped: no target visible"))
+                    continue
+                fired += 1
+                events.append((round(time.monotonic() - t0, 1), kind,
+                               "fired"))
+                time.sleep(rng.uniform(1.5, 4.0))
+                disruption.heal(rng)  # asserts recovery or raises
+                recovered += 1
+                events.append((round(time.monotonic() - t0, 1), kind,
+                               f"recovered+{probe() - before}"))
+            # at least one FULL rotation even on a tiny duration: the
+            # soak's verdict is "every disruption kind recovered", not
+            # "we waited N seconds"
+            if time.monotonic() >= t_end:
+                break
+            time.sleep(min(5.0, max(0.0, t_end - time.monotonic())))
+
+        overload = (
+            _overload_burst(
+                bank_a, probe, overload_burst,
+                recovery_deadline_s=recovery_deadline_s,
+            )
+            if overload_burst else {}
+        )
+
+        time.sleep(3)  # heal window
+        wall = time.monotonic() - t0
+        web_stats = mixer.stop()
+        mixer = None
+        driver.stop()
+        assert_no_loss_no_dup(driver, bank_b)
+        reconciliation = reconcile_ledgers(driver, bank_a)
+
+        shed_errors = sum(
+            1 for e in driver.errors if "NodeOverloadedError" in e
+        )
+        result = {
+            "metric": "remote-soak-pairs",
+            "hosts": [
+                {"name": h.name, "target": h.target,
+                 "transport": "local" if h.is_local else "ssh",
+                 "addr": h.addr}
+                for h in hosts
+            ],
+            "pairs": len(driver.completed),
+            "wall_s": round(wall, 1),
+            "pairs_per_sec": round(len(driver.completed) / wall, 2),
+            "rounds": rounds,
+            "disruptions_fired": fired,
+            "disruptions_recovered": recovered,
+            "events": events,
+            "driver_errors": len(driver.errors),
+            "shed_driver_errors": shed_errors,
+            "hard_driver_errors": len(driver.errors) - shed_errors,
+            "hard_error_rate": round(
+                (len(driver.errors) - shed_errors)
+                / max(1, len(driver.completed)
+                      + len(driver.errors) - shed_errors),
+                4,
+            ),
+            "web_actions": web_stats,
+            "overload": overload,
+            "reconciliation": reconciliation,
+            "node_workers": int(node_workers),
+            "consistent": True,
+            # SAME shape + location as loadtest/real.py's record, so
+            # soak and bench artifacts stay gate-comparable across
+            # boxes (plus the per-host transports this rig adds)
+            "host_topology": {
+                "nodes": 3,
+                "shards": 1,
+                "node_workers_per_bank": int(node_workers),
+                "transports": [
+                    ("local" if h.is_local else "ssh") for h in hosts
+                ],
+            },
+            "env_fingerprint": env_fingerprint(
+                node_workers=node_workers or None
+            ),
+        }
+        active_slos = dict(DEFAULT_SOAK_SLOS)
+        if not overload_burst:
+            for key in list(active_slos):
+                if key.startswith("overload."):
+                    active_slos.pop(key)
+        active_slos.update(slos or {})
+        result["slo_violations"] = check_slos(result, active_slos)
+        return result
+    finally:
+        if driver is not None and not driver._stop.is_set():
+            try:
+                driver.stop(timeout=10)
+            # lint: allow(swallow) — emergency teardown must reach every node
+            except BaseException:
+                pass
+        if mixer is not None:
+            try:
+                mixer.stop()
+            # lint: allow(swallow) — teardown best-effort; nodes close next
+            except Exception:
+                pass
+        if proxy is not None:
+            proxy.stop()
+        for node in nodes:
+            # capture the tail of every host's log before teardown: the
+            # post-mortem of a red soak must not die with the processes
+            tail = node.log_tail()
+            if tail:
+                local_log = os.path.join(
+                    staging, f"{os.path.basename(node.node_dir)}.tail.log"
+                )
+                with open(local_log, "w") as fh:
+                    fh.write(tail)
+            node.close()
+        for session in sessions:
+            session.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from .gate import parse_slo_args
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.remote")
+    ap.add_argument("--hosts", required=True,
+                    help="hosts.conf (see module docstring)")
+    ap.add_argument("--duration", type=float, default=90.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--node-workers", type=int, default=0,
+                    help="shard-worker processes behind bank A's broker "
+                         "(adds the worker-kill disruption)")
+    ap.add_argument("--overload-burst", type=int, default=320,
+                    help="flow starts slammed at bank A's admission cap "
+                         "after the disruption rounds (0 disables)")
+    ap.add_argument("--slo", action="append", metavar="KEY<=V | KEY>=V",
+                    help="extra SLO bound on the result record")
+    ap.add_argument("--connect-timeout", type=float, default=10.0)
+    ap.add_argument("--exec-timeout", type=float, default=60.0)
+    ap.add_argument("--recovery-deadline", type=float, default=180.0)
+    args = ap.parse_args(argv)
+    try:
+        hosts = load_hosts(args.hosts)
+    except (OSError, ValueError) as exc:
+        print(f"remote: cannot load {args.hosts}: {exc}", file=sys.stderr)
+        return 2
+    result = run(
+        hosts, duration=args.duration, seed=args.seed,
+        node_workers=args.node_workers, verbose=True,
+        overload_burst=args.overload_burst,
+        slos=parse_slo_args(args.slo),
+        connect_timeout_s=args.connect_timeout,
+        exec_timeout_s=args.exec_timeout,
+        recovery_deadline_s=args.recovery_deadline,
+    )
+    print(json.dumps(result))
+    return 0 if not result["slo_violations"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,14 @@ def main(argv=None) -> int:
                     help="total worker count (with --shard-worker)")
     ap.add_argument("--broker-port", type=int, default=None,
                     help="the supervisor's broker port (with --shard-worker)")
+    ap.add_argument(
+        "--ready-file", default=None,
+        help="write a JSON readiness record (broker port, pid, ops "
+             "port, legal name) to this path — atomically, only once "
+             "RPC and the state machine are serving. A remote driver "
+             "(loadtest/remote.py over ssh) reads ONE file for the "
+             "whole port/pid handshake instead of polling stdout blind",
+    )
     args = ap.parse_args(argv)
 
     # Production nodes raise the cyclic-GC thresholds: flow/session/codec
@@ -202,9 +210,15 @@ def main(argv=None) -> int:
         and cfg.node.raft_cluster is None
         and cfg.node.bft_cluster is None
     )
-    if sharded_host:
-        # pin the node identity so every worker derives the SAME keypair
-        # (and a supervisor restart keeps it across runs)
+    # Pin the node identity ACROSS RESTARTS (reference: the node keystore
+    # persists the legal-identity key). Without this a relaunched process
+    # generated a fresh random keypair — peers' in-flight transactions
+    # named the OLD Party, so every notarisation after a notary restart
+    # failed "signature is not the notary's" (the remote soak's restart
+    # disruption caught it). Sharded hosts additionally need the pin so
+    # every worker derives the SAME keypair. Cluster members keep their
+    # deterministic per-member entropies from the deploy descriptor.
+    if (cfg.node.raft_cluster is None and cfg.node.bft_cluster is None):
         ent_path = os.path.join(cfg.base_directory, "identity.entropy")
         if cfg.node.identity_entropy is None:
             if os.path.exists(ent_path):
@@ -249,7 +263,8 @@ def main(argv=None) -> int:
         # tokens must verify on every sibling (rpc/server.py)
         rpc_secret = rpc_session_secret(cfg.node.identity_entropy)
     rpc = RPCServer(broker, CordaRPCOps(node.services, node.smm), users=users,
-                    session_secret=rpc_secret)
+                    session_secret=rpc_secret,
+                    shard_role="supervisor" if sharded_host else None)
 
     netmap_service = None
     if cfg.network_map_service:
@@ -285,7 +300,10 @@ def main(argv=None) -> int:
             extra_identities.append(node.cluster_registration_signer())
         netmap_client = NetworkMapClient(
             map_broker, node.info,
-            f"{cfg.broker_host}:{server.port}",
+            # advertised_address routes peers through an interposed hop
+            # (port forward / the soak's partition proxy); the broker
+            # itself still binds broker_host:port
+            cfg.advertised_address or f"{cfg.broker_host}:{server.port}",
             cfg.node.advertised_services,
             node._identity_key.private,
             on_entry,
@@ -312,6 +330,26 @@ def main(argv=None) -> int:
     with open(port_path + ".tmp", "w") as fh:
         fh.write(str(server.port))
     os.replace(port_path + ".tmp", port_path)
+    if args.ready_file:
+        # the remote-driver handshake: one atomic JSON read yields
+        # everything the launcher needs (port for RPC, pid for signals)
+        import json as _json
+
+        ready = {
+            "name": cfg.node.my_legal_name,
+            "broker_host": cfg.broker_host,
+            "broker_port": server.port,
+            "advertised_address": cfg.advertised_address,
+            "pid": os.getpid(),
+            "ops_port": (
+                node.ops_server.port
+                if getattr(node, "ops_server", None) is not None else None
+            ),
+            "workers": n_workers,
+        }
+        with open(args.ready_file + ".tmp", "w") as fh:
+            _json.dump(ready, fh)
+        os.replace(args.ready_file + ".tmp", args.ready_file)
     announce(
         f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}"
     )

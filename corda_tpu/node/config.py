@@ -29,6 +29,12 @@ DEFAULTS = {
     "identity_entropy": None,
     "broker_host": "127.0.0.1",
     "broker_port": 0,               # 0 = pick a free port, written to port file
+    # what this node REGISTERS as its reachable address ("HOST:PORT");
+    # null = broker_host:actual_port. Set it when peers must reach the
+    # node through an interposed hop — a NAT'd/forwarded port, or the
+    # soak's partition proxy (loadtest/netproxy.py) in front of the
+    # broker.
+    "advertised_address": None,
     "rpc_users": [],                # [{"username","password","permissions":[...]}]
     "jax_platform": None,
     "network_map": None,            # "HOST:PORT" of the directory node, or None
@@ -70,6 +76,7 @@ class FullNodeConfiguration:
     journal_dir: str
     broker_host: str
     broker_port: int
+    advertised_address: Optional[str] = None
     rpc_users: List[dict] = field(default_factory=list)
     jax_platform: Optional[str] = None
     network_map: Optional[str] = None
@@ -136,6 +143,7 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
         journal_dir=os.path.join(base, cfg["journal_dir"]),
         broker_host=cfg["broker_host"],
         broker_port=int(cfg["broker_port"]),
+        advertised_address=cfg.get("advertised_address"),
         rpc_users=list(cfg["rpc_users"]),
         jax_platform=cfg["jax_platform"],
         network_map=cfg.get("network_map"),

@@ -16,6 +16,7 @@ rather than per-signature host crypto.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from concurrent.futures import Future
@@ -46,6 +47,34 @@ class NotaryException(FlowException):
     def __init__(self, error):
         super().__init__(f"notary error: {error}")
         self.error = error
+
+
+#: `repr(StateRef)` is "<64-hex txhash>(<index>)"; the Conflict's
+#: consumed map renders as `'<ref repr>': SecureHash(<64-hex>)`. The
+#: pattern recovers (ref, consuming tx) pairs from BOTH the structured
+#: Conflict and its string form — a propagated NotaryException crosses
+#: the session wire as "TypeName|message" (rebuild_flow_exception), so
+#: a remote client only ever holds the text.
+_CONFLICT_PAIR = re.compile(
+    r"([0-9A-Fa-f]{64})\((\d+)\)'?\s*:\s*(?:SecureHash\()?([0-9A-Fa-f]{64})"
+)
+
+
+def conflict_consumed_refs(error) -> List[Tuple[StateRef, object]]:
+    """(consumed StateRef, consuming tx id) pairs named by a notary
+    conflict — from a Conflict object, a NotaryException (either the
+    in-process structured form or the text a rebuilt remote exception
+    carries), or raw text. The structured form renders to the same
+    `'<ref repr>': SecureHash(<hex>)` pairs the wire text holds, so ONE
+    parse covers both. Empty when the error names no conflict."""
+    from ..core.crypto.secure_hash import SecureHash
+
+    text = str(getattr(error, "error", None) or error)
+    return [
+        (StateRef(SecureHash(bytes.fromhex(h)), int(idx)),
+         SecureHash(bytes.fromhex(consumer)))
+        for h, idx, consumer in _CONFLICT_PAIR.findall(text)
+    ]
 
 
 class NotaryUnavailableError(NotaryException):
@@ -927,9 +956,13 @@ class NotaryClientFlow(FlowLogic):
             wtx = stx.tx
             ftx = wtx.build_filtered_transaction(notary_tearoff_filter)
             payload = NotarisationPayload(None, ftx)
-        response = yield self.send_and_receive_with_retry(
-            notary, payload, NotarisationResponse
-        )
+        try:
+            response = yield self.send_and_receive_with_retry(
+                notary, payload, NotarisationResponse
+            )
+        except NotaryException as exc:
+            self._reconcile_conflict(exc, stx)
+            raise
         sigs = list(response.signatures)
         if not sigs:
             raise NotaryException("notary returned no signatures")
@@ -955,6 +988,34 @@ class NotaryClientFlow(FlowLogic):
                 "notary signatures do not fulfil the cluster identity"
             )
         return sigs
+
+    def _reconcile_conflict(self, exc: NotaryException, stx) -> None:
+        """A conflict verdict is AUTHORITATIVE evidence our inputs are
+        spent by a transaction we may not hold (a notary crash between
+        commit and reply fails the spender without the vault ever
+        recording the spend — the remote soak's notary-kill wedge).
+        Flip exactly OUR transaction's conflicted inputs consumed so
+        coin selection stops picking provably-dead states; states the
+        conflict names that are not our inputs (another party's) are
+        left alone."""
+        pairs = conflict_consumed_refs(exc)
+        if not pairs:
+            return
+        our_inputs = set(stx.tx.inputs)
+        refs = [
+            ref for ref, consumer in pairs
+            if ref in our_inputs and consumer != stx.id
+        ]
+        vault = getattr(self.service_hub, "vault_service", None)
+        if not refs or vault is None:
+            return
+        flipped = vault.mark_notary_consumed(refs)
+        if flipped:
+            eventlog.emit(
+                "warning", "notary",
+                "vault reconciled notary-conflict spends",
+                refs=[repr(r) for r in flipped],
+            )
 
 
 @initiated_by(NotaryClientFlow)

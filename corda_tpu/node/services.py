@@ -609,6 +609,36 @@ class VaultService:
                     )
                 raise StatesNotAvailableError(f"{ref} locked by {rows[0][0]}")
 
+    def mark_notary_consumed(self, refs: List[StateRef]) -> List[StateRef]:
+        """Reconcile states the NOTARY (the authority on spends) reported
+        consumed by a transaction this vault does not hold.
+
+        The wedge this heals (surfaced by the remote soak's notary-kill
+        disruption): a notary crash between commit and reply fails the
+        spending flow, the vault never records the spend, and the ref
+        stays unconsumed-LOOKING — coin selection keeps picking the
+        provably-dead state and every later spend conflicts forever.
+        Flipping it consumed on the notary's own verdict restores
+        liveness; the consuming transaction's outputs were never ours to
+        record. Returns the refs actually flipped (already-consumed rows
+        are idempotent no-ops)."""
+        flipped: List[StateRef] = []
+        with self.db.transaction():  # holds db.lock (reentrant)
+            for ref in refs:
+                cur = self.db.execute(
+                    "UPDATE vault_states SET consumed = 1, "
+                    "lock_id = NULL "
+                    "WHERE tx_id = ? AND output_index = ? "
+                    "AND consumed = 0",
+                    (ref.txhash.bytes, ref.index),
+                )
+                if cur.rowcount == 1:
+                    flipped.append(ref)
+        if flipped:
+            for obs in list(self._observers):
+                obs([], list(flipped))
+        return flipped
+
     def soft_lock_release(self, lock_id: str, refs: Optional[List[StateRef]] = None) -> None:
         with self.db.lock:
             if refs is None:

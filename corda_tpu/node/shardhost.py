@@ -830,6 +830,7 @@ def run_worker(config_dir: str, index: int, n_workers: int,
         _PrefetchOneBroker(broker),
         CordaRPCOps(node.services, node.smm), users=users,
         session_secret=rpc_session_secret(cfg.node.identity_entropy),
+        shard_role="worker",
     )
 
     stop = threading.Event()

@@ -39,16 +39,24 @@ class RPCUser:
 
 class RPCServer:
     def __init__(self, broker: Broker, ops, users: Optional[list] = None,
-                 session_secret: Optional[bytes] = None):
+                 session_secret: Optional[bytes] = None,
+                 shard_role: Optional[str] = None):
         """`session_secret`: sharded nodes (node/shardhost.py) run M
         worker RPC servers as COMPETING consumers on one request queue —
         a login served by worker 2 must authenticate calls served by
         worker 5, so with a shared secret the session token becomes
         self-authenticating (HMAC over the username) instead of an entry
         in one server's in-memory map. None keeps the classic per-server
-        uuid sessions."""
+        uuid sessions.
+
+        `shard_role` ("supervisor"/"worker", None = unsharded): marks
+        this server as ONE competing consumer among sibling PROCESSES,
+        which arms the flow_result reroute — a flow this process does
+        not host may live on a sibling, so an unknown id is re-queued
+        (bounded) instead of answered with a spurious error."""
         self.broker = broker
         self.ops = ops
+        self.shard_role = shard_role
         self.users: Dict[str, RPCUser] = {
             u.username: u for u in (users or [RPCUser("admin", "admin")])
         }
@@ -329,6 +337,14 @@ class RPCServer:
             })
             return
         kwargs = dict(request.get("kwargs") or {})
+        if method_name == "flow_result" and args:
+            # the wait bound arrives positionally (flow_result(fid, 90))
+            # as often as by keyword — same fallback as the async path
+            wait = kwargs.get("timeout")
+            if wait is None and len(args) >= 2:
+                wait = args[1]
+            if self._reroute_foreign(request, args[0], wait):
+                return  # the owning worker replies; nothing to do here
         if method_name == "flow_result" and hasattr(
             self.ops, "flow_result_future"
         ):
@@ -386,6 +402,73 @@ class RPCServer:
             "kind": "reply", "id": req_id,
             "ok": self._marshal(result, request.get("session", ""), reply_to),
         })
+
+    def _reroute_foreign_deadline(self, request, timeout) -> float:
+        # malformed deadline/timeout values must degrade to the default
+        # budget, never raise — an exception here would silently drop
+        # the request before any reply machinery runs
+        try:
+            deadline = float(request.get("_reroute_deadline"))
+        except (TypeError, ValueError):
+            deadline = None
+        if deadline is not None:
+            return deadline
+        # ceiling 30 s: a respawning worker restores its checkpoint
+        # partition well inside it, while a flow LOST in the death
+        # window (killed before its first checkpoint — no checkpoint,
+        # no restore) is indistinguishable from a slow respawn, so the
+        # budget also bounds how long a caller's thread can be pinned
+        # behind a flow that will never answer
+        try:
+            budget = min(float(timeout), 30.0)
+        except (TypeError, ValueError):
+            budget = 30.0
+        return time.time() + budget
+
+    def _reroute_foreign(self, request, fid, timeout) -> bool:
+        """Sharded-host RPC: request queues are COMPETING-CONSUMER across
+        the supervisor and every worker process, so a `flow_result` for
+        a worker-TAGGED flow id routinely lands on a server that does
+        not host the flow — which used to reply a spurious "unknown flow
+        id" (the remote soak's shard-worker-kill disruption surfaced
+        it). Re-publish the request onto the shared queue (short nap via
+        the timer wheel, never blocking the consume thread) until the
+        owning sibling — which restores the flow even across a respawn —
+        picks it up, bounded by a wall-clock deadline derived from the
+        caller's own wait. The same applies on a WORKER for untagged ids
+        (the supervisor's flows). Inert off the sharded path
+        (shard_role None): a plain node owns every flow it ever started,
+        so an unknown id there is a client error, answered immediately.
+        Returns True when the request was re-queued."""
+        from ..node.shardhost import worker_tag_of
+
+        smm = getattr(self.ops, "_smm", None)
+        if smm is None or not isinstance(fid, str):
+            return False
+        if fid in smm.flows:
+            return False
+        if self.shard_role is None and worker_tag_of(fid) is None:
+            return False
+        deadline = self._reroute_foreign_deadline(request, timeout)
+        if time.time() >= deadline:
+            return False  # budget spent: the sync path names the error
+        blob = serialize({**request, "_reroute_deadline": deadline})
+
+        def republish() -> None:
+            try:
+                self.broker.send(RPC_SERVER_QUEUE, blob)
+            except Exception as exc:
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "flow_result reroute republish failed for %s: %s",
+                    fid, exc,
+                )
+
+        from ..utils.timerwheel import call_later
+
+        call_later(0.05, republish)
+        return True
 
     def _handle_flow_result_async(self, req_id, reply_to, args, kwargs) -> bool:
         """Wire flow_result onto the flow future's done-callback plus a

@@ -105,10 +105,12 @@ def _expand_raft_clusters(nodes: List[Dict]) -> List[Dict]:
             entry = {
                 k: v for k, v in n.items()
                 # per-node resources must NOT be cloned across members: a
-                # pinned broker_port would collide on every member but one
+                # pinned broker_port would collide on every member but
+                # one, and a shared advertised_address would route every
+                # member's traffic through one interposed hop
                 if k not in (
                     "name", "cluster_size", "cluster_entropy_base",
-                    "broker_port", "web",
+                    "broker_port", "web", "advertised_address",
                 )
             }
             entry["name"] = member["name"]
@@ -167,6 +169,15 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
             conf["notary_type"] = n["notary"]
         if n.get("verifier_type"):
             conf["verifier_type"] = n["verifier_type"]
+        if n.get("advertised_address"):
+            # peers reach this node through an interposed hop (port
+            # forward / the soak's partition proxy) instead of the bind
+            # address
+            conf["advertised_address"] = str(n["advertised_address"])
+        for adm_key in ("admission_rate", "admission_burst",
+                        "admission_max_flows"):
+            if n.get(adm_key) is not None:
+                conf[adm_key] = n[adm_key]
         if n.get("shards") is not None:
             conf["shards"] = int(n["shards"])
         if n.get("node_workers") is not None:

@@ -13,6 +13,10 @@ Endpoints (reference servlet/resource parity):
   POST /api/attachments                  -> upload, returns hash
   POST /api/flows/{flow_name}            -> start flow (JSON args), returns id
   GET  /api/flows/{flow_id}              -> flow result (blocks briefly)
+  POST /action/issue                     -> CashIssueFlow from the dashboard
+                                            form (amount, currency)
+  POST /action/pay                       -> CashPaymentFlow (amount,
+                                            currency, peer name)
   GET  /api/metrics                      -> metric registry snapshot (JSON)
   GET  /api/transactions[?limit=N]       -> newest validated-tx summaries
   GET  /api/statemachines                -> in-flight flow snapshot
@@ -231,11 +235,103 @@ class WebServer:
                 return True
         return False
 
+    # -- dashboard actions ---------------------------------------------------
+
+    def _form(self, body: bytes) -> dict:
+        """application/x-www-form-urlencoded (the dashboard's POST
+        forms) or a JSON object body — one parser for both, so curl and
+        fetch() drive the same route."""
+        text = body.decode(errors="replace")
+        if text.lstrip().startswith("{"):
+            return json.loads(text)
+        from urllib.parse import parse_qsl
+
+        return dict(parse_qsl(text))
+
+    def _action(self, req, flow_name: str, build_args) -> None:
+        """Run one dashboard action flow synchronously with TYPED error
+        rendering: an admission shed comes back as HTTP 429 with the
+        node's own retry_after_ms hint (the overload contract,
+        docs/robustness.md) so the GUI can back off instead of
+        hammering; everything else is a named-exception 4xx/5xx."""
+        from ..node.admission import NodeOverloadedError
+
+        try:
+            args = build_args()
+            # ONE round trip (start_flow_and_wait): on a sharded node the
+            # request queue is competing-consumer across worker
+            # processes, and start+wait is served wholly by whichever
+            # worker starts the flow
+            result = self.ops.start_flow_and_wait(
+                flow_name, *args, timeout=60
+            )
+            tx_id = getattr(result, "id", None)
+            req._json(200, {
+                "flow": flow_name,
+                "tx_id": str(tx_id) if tx_id is not None else None,
+            })
+        except NodeOverloadedError as exc:
+            req._json(429, {
+                "error": "overloaded",
+                "message": str(exc),
+                "retry_after_ms": exc.retry_after_ms,
+            })
+        except (ValueError, KeyError) as exc:
+            req._json(400, {
+                "error": type(exc).__name__, "message": str(exc),
+            })
+        except Exception as exc:
+            req._json(500, {
+                "error": type(exc).__name__, "message": str(exc),
+            })
+
+    def _resolve_peer(self, name: str):
+        """A network-map party by exact X.500 name or unique O= match —
+        the dashboard sends whatever its peer dropdown held."""
+        peers = self.ops.network_map_snapshot()
+        exact = [p for p in peers if p.name == name]
+        if exact:
+            return exact[0]
+        loose = [p for p in peers if name in p.name]
+        if len(loose) == 1:
+            return loose[0]
+        raise ValueError(
+            f"peer {name!r} is {'ambiguous' if loose else 'unknown'} "
+            f"in the network map"
+        )
+
     def _post(self, req) -> None:
         length = int(req.headers.get("Content-Length", 0))
         body = req.rfile.read(length) if length else b""
         path = req.path
-        if path == "/api/attachments":
+        if path == "/action/issue":
+            from ..core.contracts import Amount
+
+            def build_issue():
+                form = self._form(body)
+                amount = Amount(
+                    int(form["amount"]), form.get("currency", "USD")
+                )
+                me = self.ops.node_info()
+                notary = self.ops.notary_identities()[0]
+                return amount, b"\x01", me, notary
+
+            self._action(req, "CashIssueFlow", build_issue)
+        elif path == "/action/pay":
+            from ..core.contracts import Amount
+            from ..core.contracts.amount import Issued
+
+            def build_pay():
+                form = self._form(body)
+                me = self.ops.node_info()
+                token = Issued(me.ref(1), form.get("currency", "USD"))
+                amount = Amount(int(form["amount"]), token)
+                peer = self._resolve_peer(form["peer"])
+                notary = self.ops.notary_identities()[0]
+                return amount, peer, notary
+
+            self._action(req, "CashPaymentFlow", build_pay)
+        elif path == "/api/attachments":
             # class constant, NOT getattr on self.ops: an RPC proxy
             # fabricates a callable for any attribute name
             from ..rpc.ops import CordaRPCOps

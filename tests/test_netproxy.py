@@ -1,0 +1,420 @@
+"""The controllable TCP partition proxy (loadtest/netproxy.py) and its
+composition with the verifier failover path (docs/robustness.md):
+
+  * per-direction drop / black-hole / delay / stall semantics plus the
+    heal contract (tainted streams closed, intact streams resumed);
+  * the command-file CLI the ssh soak driver controls remote proxies
+    through;
+  * a proxy-partitioned RemoteBroker worker link tripping the circuit
+    breaker (fallback serves — zero hung futures) and RECOVERING after
+    the heal;
+  * a SIGSTOPped real verifier process surviving the deadline path.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.core.crypto import crypto
+from corda_tpu.loadtest.netproxy import DIRECTIONS, MODES, NetProxy
+
+
+# ---------------------------------------------------------------------------
+# plumbing: a tiny echo server to proxy
+# ---------------------------------------------------------------------------
+
+class _Echo:
+    def __init__(self):
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self._threads = []
+        t = threading.Thread(
+            target=self._accept, daemon=True, name="echo-accept"
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._pump, args=(conn,), daemon=True,
+                name="echo-pump",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, conn):
+        while True:
+            try:
+                data = conn.recv(4096)
+            except OSError:
+                return
+            if not data:
+                return
+            try:
+                conn.sendall(data.upper())
+            except OSError:
+                return
+
+    def close(self):
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def echo_proxy():
+    echo = _Echo()
+    proxy = NetProxy("127.0.0.1", echo.port).start()
+    yield echo, proxy
+    proxy.stop()
+    echo.close()
+
+
+def _client(port, timeout=5.0):
+    c = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    c.settimeout(timeout)
+    return c
+
+
+def _recv_or_none(c, timeout=0.5):
+    c.settimeout(timeout)
+    try:
+        return c.recv(4096)
+    except socket.timeout:
+        return None
+    except OSError:
+        return b""
+
+
+class TestNetProxyModes:
+    def test_pass_forwards_both_directions(self, echo_proxy):
+        _, proxy = echo_proxy
+        c = _client(proxy.port)
+        c.sendall(b"hello")
+        assert c.recv(100) == b"HELLO"
+        # stats increment after the forward; poll briefly
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            stats = proxy.stats()
+            if stats["bytes_c2s"] >= 5 and stats["bytes_s2c"] >= 5:
+                break
+            time.sleep(0.02)
+        assert stats["bytes_c2s"] == 5 and stats["bytes_s2c"] == 5
+        c.close()
+
+    def test_stall_blocks_then_heal_resumes_stream_intact(self, echo_proxy):
+        _, proxy = echo_proxy
+        c = _client(proxy.port)
+        c.sendall(b"a")
+        assert c.recv(10) == b"A"
+        proxy.set_mode("stall", "both")
+        time.sleep(0.25)
+        c.sendall(b"later")
+        assert _recv_or_none(c) is None, "stalled wire delivered data"
+        proxy.heal()
+        c.settimeout(5)
+        # the SAME connection resumes with framing intact: stall
+        # buffers in kernel queues, it never discards
+        assert c.recv(100) == b"LATER"
+        c.close()
+
+    def test_blackhole_discards_and_heal_closes_tainted(self, echo_proxy):
+        _, proxy = echo_proxy
+        c = _client(proxy.port)
+        c.sendall(b"a")
+        assert c.recv(10) == b"A"
+        proxy.set_mode("blackhole", "c2s")
+        time.sleep(0.25)
+        c.sendall(b"lost")
+        time.sleep(0.4)
+        assert proxy.stats()["bytes_discarded"] >= 4
+        proxy.heal()
+        # bytes were discarded mid-stream: the heal CLOSES the tainted
+        # connection (a resumed corrupt stream would be worse than a
+        # reset); a fresh connection works
+        time.sleep(0.3)
+        data = _recv_or_none(c, timeout=2.0)
+        assert data == b"", f"tainted conn survived heal: {data!r}"
+        c2 = _client(proxy.port)
+        c2.sendall(b"again")
+        assert c2.recv(100) == b"AGAIN"
+        c2.close()
+
+    def test_blackhole_is_per_direction(self, echo_proxy):
+        _, proxy = echo_proxy
+        c = _client(proxy.port)
+        c.sendall(b"a")
+        assert c.recv(10) == b"A"
+        # discard only server->client: the send still REACHES the echo
+        proxy.set_mode("blackhole", "s2c")
+        time.sleep(0.25)
+        c.sendall(b"gone")
+        time.sleep(0.4)
+        stats = proxy.stats()
+        assert stats["bytes_c2s"] >= 5  # request forwarded
+        assert stats["bytes_discarded"] >= 4  # reply eaten
+        assert _recv_or_none(c) is None
+        c.close()
+
+    def test_delay_adds_latency_but_delivers(self, echo_proxy):
+        _, proxy = echo_proxy
+        proxy.set_mode("delay", "c2s", delay_s=0.4)
+        time.sleep(0.25)
+        c = _client(proxy.port)
+        t0 = time.monotonic()
+        c.sendall(b"slow")
+        assert c.recv(100) == b"SLOW"
+        assert time.monotonic() - t0 >= 0.3
+        c.close()
+
+    def test_drop_refuses_new_and_resets_existing(self, echo_proxy):
+        _, proxy = echo_proxy
+        c = _client(proxy.port)
+        c.sendall(b"a")
+        assert c.recv(10) == b"A"
+        proxy.set_mode("drop", "both")
+        time.sleep(0.3)
+        # existing connection reset
+        assert _recv_or_none(c, timeout=2.0) == b""
+        # new connections refused (accept+close or connect failure)
+        try:
+            c2 = _client(proxy.port, timeout=2.0)
+            assert c2.recv(10) == b""
+            c2.close()
+        except OSError:
+            pass  # connection reset at connect: equally refused
+        proxy.heal()
+        time.sleep(0.3)
+        c3 = _client(proxy.port)
+        c3.sendall(b"back")
+        assert c3.recv(100) == b"BACK"
+        c3.close()
+
+    def test_bad_mode_and_direction_rejected(self, echo_proxy):
+        _, proxy = echo_proxy
+        with pytest.raises(ValueError, match="unknown mode"):
+            proxy.set_mode("nonsense")
+        with pytest.raises(ValueError, match="unknown direction"):
+            proxy.set_mode("stall", "upwards")
+        assert set(DIRECTIONS) == {"c2s", "s2c"}
+        assert "stall" in MODES and "blackhole" in MODES
+
+
+class TestNetProxyCli:
+    def test_control_file_protocol(self, tmp_path):
+        """The remote-rig form: command file polled, state file acked
+        with seq + applied modes; bad commands surface in state.error
+        instead of killing the proxy."""
+        echo = _Echo()
+        control = str(tmp_path / "proxy.ctl")
+        state_path = control + ".state"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "corda_tpu.loadtest.netproxy",
+             "--target", f"127.0.0.1:{echo.port}", "--control", control],
+            env=env,
+        )
+        try:
+            deadline = time.monotonic() + 30
+            state = None
+            while time.monotonic() < deadline:
+                if os.path.exists(state_path):
+                    with open(state_path) as fh:
+                        state = json.load(fh)
+                    break
+                time.sleep(0.05)
+            assert state and state["port"], "proxy never wrote its state"
+            port = state["port"]
+
+            c = _client(port, timeout=10)
+            c.sendall(b"one")
+            assert c.recv(100) == b"ONE"
+
+            def command(seq, text):
+                with open(control + ".tmp", "w") as fh:
+                    fh.write(f"{seq} {text}\n")
+                os.replace(control + ".tmp", control)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    with open(state_path) as fh:
+                        s = json.load(fh)
+                    if s.get("seq", -1) >= seq:
+                        return s
+                    time.sleep(0.05)
+                raise AssertionError(f"proxy never acked seq {seq}")
+
+            s = command(1, "mode stall both")
+            assert s["modes"] == {"c2s": "stall", "s2c": "stall"}
+            c.sendall(b"two")
+            assert _recv_or_none(c) is None
+            s = command(2, "heal")
+            assert s["modes"] == {"c2s": "pass", "s2c": "pass"}
+            c.settimeout(5)
+            assert c.recv(100) == b"TWO"
+            s = command(3, "mode sideways both")
+            assert "bad proxy command" in s.get("error", "") or \
+                "unknown mode" in s.get("error", "")
+            # proxy still alive and serving after the bad command
+            c.sendall(b"three")
+            assert c.recv(100) == b"THREE"
+            c.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            echo.close()
+
+
+# ---------------------------------------------------------------------------
+# composition with the verifier failover path
+# ---------------------------------------------------------------------------
+
+def _sig_items(n, entropy0=41000):
+    items = []
+    for i in range(n):
+        kp = crypto.entropy_to_keypair(entropy0 + i)
+        content = b"netproxy-msg-%d" % i
+        items.append(
+            (kp.public, crypto.do_sign(kp.private, content), content)
+        )
+    return items
+
+
+class TestProxyPartitionedVerifier:
+    def test_stalled_worker_link_trips_breaker_then_recovers(self):
+        """An in-process verifier service + a worker connected through
+        the proxy over a REAL BrokerServer socket. Stalling the wire is
+        the gray failure: the consumer stays registered but answers
+        nothing — the deadline supervisor redispatches, failures stack,
+        the breaker opens and the FALLBACK serves (zero hung futures).
+        After the heal the half-open probe closes the breaker on the
+        live worker again."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.messaging.net import BrokerServer, RemoteBroker
+        from corda_tpu.verifier import (
+            OutOfProcessTransactionVerifierService,
+            VerifierWorker,
+        )
+
+        broker = Broker()
+        server = BrokerServer(broker, port=0)
+        server.start()
+        proxy = NetProxy("127.0.0.1", server.port).start()
+        remote = RemoteBroker("127.0.0.1", proxy.port)
+        worker = None
+        svc = None
+        try:
+            worker = VerifierWorker(remote, name="proxied").start()
+            svc = OutOfProcessTransactionVerifierService(
+                broker, "proxy-test", deadline_s=0.4, max_retries=1,
+            )
+            svc.breaker.cooldown_s = 0.4
+            items = _sig_items(4)
+            futures = svc.verify_signatures(items)
+            assert all(f.result(timeout=30) for f in futures)
+            assert svc.breaker.state == "closed"
+
+            # partition: stall BOTH directions of the worker's link.
+            # Each stalled call exhausts its deadline budget and records
+            # a breaker failure; at the threshold (3) the breaker OPENS.
+            # Every future still completes — the fallback serves.
+            proxy.set_mode("stall", "both")
+            time.sleep(0.2)
+            for _ in range(3):
+                futures = svc.verify_signatures(items)
+                assert all(f.result(timeout=30) for f in futures), (
+                    "futures hung behind the stalled wire"
+                )
+            assert svc.breaker.trips >= 1
+            assert svc.breaker.state in ("open", "half-open")
+
+            # heal: the worker drains its backlog; after the cooldown a
+            # probe lands on it and the breaker closes again
+            proxy.heal()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                futures = svc.verify_signatures(_sig_items(2, 42000))
+                assert all(f.result(timeout=30) for f in futures)
+                if svc.breaker.state == "closed":
+                    break
+                time.sleep(0.3)
+            assert svc.breaker.state == "closed", (
+                f"breaker never recovered: {svc.breaker.state}"
+            )
+        finally:
+            # heal FIRST: worker/remote teardown over a still-stalled
+            # wire blocks on the dead socket
+            proxy.heal()
+            if svc is not None:
+                svc.stop()
+            if worker is not None:
+                worker.stop(graceful=False)
+            remote.close()
+            proxy.stop()
+            server.stop()
+            broker.close()
+
+
+class TestSigstopRealProcess:
+    def test_sigstopped_worker_process_survives_deadline_path(self, tmp_path):
+        """SIGSTOP a REAL out-of-process verifier worker mid-service:
+        the process keeps its socket (consumer registered, queue
+        stalls) — the requester-side deadline/redispatch/fallback path
+        must complete every future; SIGCONT restores it and the breaker
+        recovers."""
+        from corda_tpu.loadtest.chaos import _Worker
+        from corda_tpu.messaging import Broker
+        from corda_tpu.messaging.net import BrokerServer
+        from corda_tpu.verifier import OutOfProcessTransactionVerifierService
+
+        broker = Broker()
+        server = BrokerServer(broker, port=0)
+        server.start()
+        worker = _Worker(
+            str(tmp_path), f"127.0.0.1:{server.port}", "sigstop-w0"
+        )
+        svc = None
+        try:
+            worker.launch(timeout=120)
+            svc = OutOfProcessTransactionVerifierService(
+                broker, "sigstop-test", deadline_s=0.5, max_retries=1,
+            )
+            svc.breaker.cooldown_s = 0.5
+            items = _sig_items(3, 43000)
+            futures = svc.verify_signatures(items)
+            assert all(f.result(timeout=60) for f in futures)
+
+            worker.suspend()  # the hang: socket alive, nothing answers
+            futures = svc.verify_signatures(items)
+            assert all(f.result(timeout=60) for f in futures), (
+                "futures hung behind a SIGSTOPped worker"
+            )
+
+            worker.resume()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                futures = svc.verify_signatures(_sig_items(2, 44000))
+                assert all(f.result(timeout=60) for f in futures)
+                if svc.breaker.state == "closed":
+                    break
+                time.sleep(0.3)
+            assert svc.breaker.state == "closed"
+        finally:
+            if svc is not None:
+                svc.stop()
+            worker.close()
+            server.stop()
+            broker.close()

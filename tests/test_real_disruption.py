@@ -435,6 +435,42 @@ class TestRaftNotaryClusterProcesses:
 
 
 @pytest.mark.slow
+def test_chaos_harness_with_proxy_partition():
+    """The chaos rotation's wire-partition kind: bank B deployed behind
+    the controllable TCP proxy (advertised_address wiring), the stall
+    fired mid-soak, the catalog heal asserting pairs RESUME, and the
+    end-of-soak no-loss/no-dup contract holding through it."""
+    from corda_tpu.loadtest.chaos import run
+
+    out = run(duration=35.0, seed=13, proxy_partition=True)
+    assert out["consistent"] and out["pairs"] > 0
+    assert out["disruptions"] >= 1
+
+
+@pytest.mark.slow
+def test_remote_soak_localhost_rig():
+    """The full `python -m corda_tpu.loadtest.remote` soak on the
+    committed localhost rig: 3 composed disruption kinds (restart,
+    SIGSTOP hang, proxy partition) each RECOVERED, the typed-shed
+    overload burst, the explorer action mix, end-of-soak
+    no-loss/no-dup + cross-host reconciliation, slo_violations == []."""
+    from corda_tpu.loadtest.remote import parse_hosts, run
+
+    out = run(
+        parse_hosts("local"), duration=15.0, seed=7,
+        overload_burst=240,
+    )
+    assert out["consistent"] is True
+    assert out["disruptions_fired"] >= 3
+    assert out["disruptions_recovered"] == out["disruptions_fired"]
+    kinds = {k for _, k, state in out["events"] if "recovered" in state}
+    assert {"restart", "hang", "partition"} <= kinds
+    assert out["overload"]["shed"] >= 1
+    assert out["overload"]["recovered"] == 1.0
+    assert out["slo_violations"] == [], out["slo_violations"]
+
+
+@pytest.mark.slow
 def test_chaos_harness_short_soak():
     """The packaged chaos harness (loadtest.chaos) runs end-to-end at a
     short duration: pairs complete, at least one disruption fires, and

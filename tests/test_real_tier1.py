@@ -1,0 +1,183 @@
+"""The DEFAULT-tier real-process slice (ISSUE 14 acceptance): a budgeted
+2-process issue+pay over real TCP brokers with a mid-run shard-worker
+SIGKILL.
+
+Everything else that boots OS processes lives in the nightly heavy tier
+(conftest._HEAVY_FILES) — the driver's default run used to see zero real
+processes (61 skips). This file is deliberately NOT in the heavy set:
+one small, tightly budgeted scenario keeps process-separation fidelity
+(fork/exec, TCP broker wire, durable journals, supervisor respawn,
+cross-process RPC rerouting) in every tier-1 run.
+
+Budget: the whole scenario must finish inside ``_BUDGET_S`` (60 s) on a
+1-core CI box — measured ~8 s warm. Skips are NAMED and narrow: no free
+TCP port, or no fork support. Anything else that goes wrong is a
+FAILURE, never a silent skip.
+"""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+#: hard wall for the whole scenario (the ISSUE's <60 s acceptance)
+_BUDGET_S = 60.0
+
+
+def _skip_reason():
+    """Only the two legitimate environmental skips, by name."""
+    if not hasattr(os, "fork"):
+        return "os.fork unavailable on this platform"
+    try:
+        probe = socket.socket()
+        try:
+            probe.bind(("127.0.0.1", 0))
+        finally:
+            probe.close()
+    except OSError as exc:
+        return f"no free TCP port on 127.0.0.1: {exc}"
+    return None
+
+
+def _find_worker_pids(node_dir: str):
+    """PIDs of `--shard-worker` processes spawned for node_dir, via the
+    same /proc scan the remote soak driver uses."""
+    from corda_tpu.loadtest.remote import LocalSession, parse_hosts
+
+    session = LocalSession(parse_hosts("local")[0])
+    return session.find_pids(f"{node_dir} --shard-worker")
+
+
+def test_two_node_tcp_issue_pay_with_worker_kill(monkeypatch):
+    """Boot a 2-process network (validating notary + network map, and a
+    bank running its flow path in ONE shard-worker OS process), drive
+    issue+pay pairs over real TCP, SIGKILL the bank's worker mid-run,
+    and require: pairs RESUME after the supervisor respawns it (unacked
+    redelivery + checkpoint restore + the flow_result reroute), and the
+    end state is no-loss/no-dup on the counterparty ledger."""
+    reason = _skip_reason()
+    if reason:
+        pytest.skip(reason)
+    # the kill can land before the in-flight flow's FIRST checkpoint —
+    # that flow is legitimately lost and its flow_result wait only ends
+    # at the driver's deadline. Scale every procdriver wait down so the
+    # worst-case single stall fits the tier-1 budget with room.
+    monkeypatch.setenv("CORDA_TPU_LOADTEST_DEADLINE_S", "15")
+
+    from corda_tpu.loadtest.procdriver import (
+        PairDriver,
+        assert_no_loss_no_dup,
+        resolve_identities,
+    )
+    from corda_tpu.testing.smoketesting import Factory
+    from corda_tpu.tools.cordform import deploy_nodes
+
+    t0 = time.monotonic()
+
+    def budget_left(phase: str) -> float:
+        left = _BUDGET_S - (time.monotonic() - t0)
+        assert left > 0, (
+            f"tier-1 real-process budget ({_BUDGET_S}s) exhausted "
+            f"during {phase}"
+        )
+        return left
+
+    base = tempfile.mkdtemp(prefix="t1-real-")
+    spec = {"nodes": [
+        {"name": "O=T1Notary,L=Zurich,C=CH", "notary": "validating",
+         "network_map_service": True},
+        {"name": "O=T1Bank,L=London,C=GB", "node_workers": 1},
+    ]}
+    resolved = deploy_nodes(spec, base)
+    factory = Factory(base)
+    nodes = []
+    driver = None
+    try:
+        for conf in resolved:
+            nodes.append(
+                factory.launch(conf["dir"], timeout=budget_left("boot"))
+            )
+        # the bank node pays the notary-host node's own identity: two
+        # processes give the full wire (bank worker -> supervisor broker
+        # -> bridge -> notary broker) without a third boot on the budget
+        me, notary, peer = resolve_identities(nodes[1], nodes[0])
+        driver = PairDriver(nodes[1], notary, me, peer).start()
+        while len(driver.completed) < 3:
+            budget_left("warm-up")
+            assert driver._thread.is_alive(), (
+                f"driver died during warm-up: {driver.errors[-3:]}"
+            )
+            time.sleep(0.2)
+
+        # mid-run disruption: SIGKILL the bank's ONLY shard worker
+        pids = _find_worker_pids(resolved[1]["dir"])
+        assert pids, "no shard-worker process visible in /proc"
+        os.kill(pids[0], 9)
+        before = len(driver.completed)
+
+        # recovery, not survival: pairs must RESUME through the respawn
+        while len(driver.completed) < before + 3:
+            budget_left("post-kill recovery")
+            time.sleep(0.2)
+
+        # the supervisor respawned the worker (new pid, same duty)
+        deadline = time.monotonic() + min(20.0, budget_left("respawn"))
+        while time.monotonic() < deadline:
+            fresh = _find_worker_pids(resolved[1]["dir"])
+            if fresh and fresh != pids:
+                break
+            time.sleep(0.3)
+        fresh = _find_worker_pids(resolved[1]["dir"])
+        assert fresh and fresh != pids, (
+            f"worker never respawned: before={pids} after={fresh}"
+        )
+
+        driver.stop(timeout=budget_left("driver stop"))
+        assert_no_loss_no_dup(driver, nodes[0])
+        assert len(driver.completed) >= before + 3
+    finally:
+        if driver is not None and not driver._stop.is_set():
+            try:
+                driver.stop(timeout=5)
+            except BaseException:
+                pass  # lint: allow(swallow) — teardown must close the nodes
+        for n in nodes:
+            n.close()
+
+
+def test_budget_guard_never_skips_silently():
+    """The skip guard names exactly two environmental reasons; on a
+    healthy box it returns None (the scenario RUNS — the whole point of
+    promoting it out of the 61-skip dead zone)."""
+    reason = _skip_reason()
+    assert reason is None or (
+        "fork" in reason or "TCP port" in reason
+    ), f"unnamed skip reason: {reason!r}"
+
+
+def test_worker_pid_scan_excludes_the_scanner():
+    """find_pids must not match its own sh/grep pipeline (killing the
+    scanner instead of the worker silently voided the disruption)."""
+    from corda_tpu.loadtest.remote import LocalSession, parse_hosts
+
+    session = LocalSession(parse_hosts("local")[0])
+    marker = "tier1-scan-marker-%d" % os.getpid()
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         f"import time  # {marker}\ntime.sleep(30)"],
+    )
+    try:
+        deadline = time.monotonic() + 10
+        pids = []
+        while time.monotonic() < deadline:
+            pids = session.find_pids(marker)
+            if pids:
+                break
+            time.sleep(0.1)
+        assert pids == [proc.pid], pids
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

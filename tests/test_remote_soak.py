@@ -1,0 +1,562 @@
+"""Remote-soak machinery (loadtest/remote.py, tools/soak_gate.py, the
+process-granular disruption catalog, the explorer action surface):
+deterministic units — the composed end-to-end soak itself is the
+`python -m corda_tpu.loadtest.remote --hosts hosts.conf` heavy-tier run
+(docs/robustness.md "Remote soak")."""
+import json
+import os
+import random
+import subprocess
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from corda_tpu.loadtest import remote
+from corda_tpu.loadtest.disruption import (
+    assert_recovers,
+    process_hang,
+    process_restart,
+    shard_worker_process_kill,
+    transport_partition,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# hosts.conf
+# ---------------------------------------------------------------------------
+
+class TestHostsConf:
+    def test_parse_local_and_ssh_entries(self):
+        specs = remote.parse_hosts(
+            "# comment\n"
+            "local\n"
+            "loadtest@10.1.2.3 workdir=/tmp/soak python=python3.9\n"
+            "db-host addr=192.0.2.7 name=db\n"
+        )
+        assert len(specs) == 3
+        assert specs[0].is_local and specs[0].addr == "127.0.0.1"
+        assert not specs[1].is_local
+        assert specs[1].addr == "10.1.2.3"
+        assert specs[1].workdir == "/tmp/soak"
+        assert specs[1].python == "python3.9"
+        assert specs[2].addr == "192.0.2.7" and specs[2].name == "db"
+
+    def test_empty_and_malformed_rejected(self):
+        with pytest.raises(ValueError, match="no hosts"):
+            remote.parse_hosts("# only comments\n\n")
+        with pytest.raises(ValueError, match="key=value"):
+            remote.parse_hosts("host1 not-an-option\n")
+
+    def test_repo_example_parses_as_local_rig(self):
+        specs = remote.load_hosts(os.path.join(_REPO, "hosts.conf"))
+        assert specs and specs[0].is_local
+
+
+# ---------------------------------------------------------------------------
+# sessions (local transport shares every code path with ssh but the argv)
+# ---------------------------------------------------------------------------
+
+class TestLocalSession:
+    @pytest.fixture()
+    def session(self):
+        return remote.LocalSession(remote.parse_hosts("local")[0])
+
+    def test_run_and_check(self, session):
+        rc, out = session.run("echo hi")
+        assert rc == 0 and "hi" in out
+        rc, _ = session.run("exit 3")
+        assert rc == 3
+        with pytest.raises(remote.SessionError, match="rc=4"):
+            session.run("exit 4", check=True)
+
+    def test_run_timeout_is_bounded(self, session):
+        rc, out = session.run("sleep 30", timeout=1.0)
+        assert rc == 124 and "timeout" in out
+
+    def test_spawn_signal_alive(self, session, tmp_path):
+        log = str(tmp_path / "spawn.log")
+        pid = session.spawn("sleep 30", log)
+        try:
+            assert session.alive(pid)
+            assert session.signal(pid, "STOP")
+            assert session.signal(pid, "CONT")
+        finally:
+            session.signal(pid, "KILL")
+        import time
+
+        deadline = time.monotonic() + 10
+        while session.alive(pid) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not session.alive(pid)
+
+    def test_read_write_file(self, session, tmp_path):
+        path = str(tmp_path / "x.txt")
+        session.write_file(path, "line1\nline2'with quote\n")
+        assert session.read_file(path) == "line1\nline2'with quote\n"
+        assert session.read_file(str(tmp_path / "missing")) is None
+
+    def test_free_port_binds(self, session):
+        import socket
+
+        port = session.free_port()
+        s = socket.socket()
+        s.bind(("127.0.0.1", port))  # free means bindable right now
+        s.close()
+
+    def test_put_dir(self, session, tmp_path):
+        src = tmp_path / "src" / "nodeX"
+        src.mkdir(parents=True)
+        (src / "node.conf").write_text("{}")
+        dest_parent = tmp_path / "dst"
+        session.put_dir(str(src), str(dest_parent))
+        assert (dest_parent / "nodeX" / "node.conf").read_text() == "{}"
+
+    def test_open_session_probe_failure_names_host(self):
+        spec = remote.HostSpec("local")
+        spec.python = "/nonexistent"  # probe is a shell echo; still ok
+        session = remote.open_session(spec)
+        assert isinstance(session, remote.LocalSession)
+
+    def test_ssh_spec_builds_ssh_session(self):
+        spec = remote.parse_hosts("user@host1")[0]
+        session = remote.SshSession(spec)
+        argv = session._argv("echo ok")
+        assert argv[0] == "ssh" and "BatchMode=yes" in argv
+        assert session._is_transport_failure(255)
+        assert not session._is_transport_failure(1)
+
+
+# ---------------------------------------------------------------------------
+# disruption catalog: deterministic fire/heal with recovery assertions
+# ---------------------------------------------------------------------------
+
+class _FakeVictim:
+    def __init__(self):
+        self.calls = []
+
+    def kill(self):
+        self.calls.append("kill")
+
+    def relaunch(self):
+        self.calls.append("relaunch")
+
+    def suspend(self):
+        self.calls.append("suspend")
+
+    def resume(self):
+        self.calls.append("resume")
+
+
+class _FakeProxy:
+    def __init__(self):
+        self.calls = []
+
+    def set_mode(self, mode, direction="both", delay_s=0.0):
+        self.calls.append(("set_mode", mode, direction))
+
+    def heal(self):
+        self.calls.append(("heal",))
+
+
+class _Counter:
+    """A probe that advances by `step` each read after fire."""
+
+    def __init__(self, step=1):
+        self.value = 0
+        self.step = step
+
+    def __call__(self):
+        self.value += self.step
+        return self.value
+
+
+class TestDisruptionCatalog:
+    def test_process_restart_fire_heal_asserts_recovery(self):
+        victim, probe = _FakeVictim(), _Counter()
+        d = process_restart(victim, probe, recovery_deadline_s=5.0)
+        rng = random.Random(1)
+        d.fire(rng)
+        assert victim.calls == ["kill"]
+        d.heal(rng)  # probe advances: recovery proven
+        assert victim.calls == ["kill", "relaunch"]
+
+    def test_process_restart_heal_raises_without_progress(self):
+        victim = _FakeVictim()
+        d = process_restart(
+            victim, lambda: 7, recovery_deadline_s=0.6,
+        )
+        rng = random.Random(1)
+        d.fire(rng)
+        with pytest.raises(AssertionError, match="no recovery"):
+            d.heal(rng)
+        assert victim.calls == ["kill", "relaunch"]
+
+    def test_process_hang_fire_heal(self):
+        victim, probe = _FakeVictim(), _Counter()
+        d = process_hang(victim, probe, recovery_deadline_s=5.0)
+        rng = random.Random(2)
+        d.fire(rng)
+        assert victim.calls == ["suspend"]
+        d.heal(rng)
+        assert victim.calls == ["suspend", "resume"]
+
+    def test_process_hang_heal_raises_without_progress(self):
+        victim = _FakeVictim()
+        d = process_hang(victim, lambda: 0, recovery_deadline_s=0.6)
+        rng = random.Random(2)
+        d.fire(rng)
+        with pytest.raises(AssertionError, match="SIGSTOP"):
+            d.heal(rng)
+
+    def test_transport_partition_fire_heal(self):
+        proxy, probe = _FakeProxy(), _Counter()
+        d = transport_partition(
+            proxy, probe, mode="blackhole", direction="c2s",
+            recovery_deadline_s=5.0,
+        )
+        rng = random.Random(3)
+        d.fire(rng)
+        assert proxy.calls == [("set_mode", "blackhole", "c2s")]
+        d.heal(rng)
+        assert proxy.calls[-1] == ("heal",)
+
+    def test_transport_partition_heal_raises_without_progress(self):
+        proxy = _FakeProxy()
+        d = transport_partition(
+            proxy, lambda: 3, recovery_deadline_s=0.6,
+        )
+        rng = random.Random(3)
+        d.fire(rng)
+        with pytest.raises(AssertionError, match="transport partition"):
+            d.heal(rng)
+        assert proxy.calls[-1] == ("heal",)  # wire restored BEFORE verdict
+
+    def test_shard_worker_kill_fire_heal_and_no_worker_noop(self):
+        killed = []
+        probe = _Counter()
+        d = shard_worker_process_kill(
+            lambda rng: 4242, killed.append, probe,
+            recovery_deadline_s=5.0,
+        )
+        rng = random.Random(4)
+        d.fire(rng)
+        assert killed == [4242]
+        d.heal(rng)
+        # no worker visible: fire is a no-op and the heal must not
+        # demand recovery for a disruption that never happened
+        d2 = shard_worker_process_kill(
+            lambda rng: None, killed.append, lambda: 0,
+            recovery_deadline_s=0.5,
+        )
+        d2.fire(rng)
+        d2.heal(rng)  # no raise
+        assert killed == [4242]
+
+    def test_assert_recovers_reports_counts(self):
+        with pytest.raises(AssertionError, match="0 completions"):
+            assert_recovers(lambda: 5, 5, "unit", deadline_s=0.4)
+        assert assert_recovers(
+            _Counter(step=3), 0, "unit", deadline_s=5.0
+        ) >= 2
+
+    def test_probabilistic_interface_still_works(self):
+        # the deterministic fire()/heal() surface must not break the
+        # existing maybe_fire/maybe_heal probabilistic contract
+        victim, probe = _FakeVictim(), _Counter()
+        d = process_restart(victim, probe, probability=1.0,
+                            heal_after=0, recovery_deadline_s=5.0)
+        rng = random.Random(5)
+        d.maybe_fire(rng, None, 0)
+        assert victim.calls == ["kill"]
+        d.maybe_heal(rng, None, 1)
+        assert victim.calls == ["kill", "relaunch"]
+
+
+# ---------------------------------------------------------------------------
+# soak gate CLI
+# ---------------------------------------------------------------------------
+
+def _run_gate(record, *args):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "soak_gate.py"),
+         "--current", "-", *args],
+        input=json.dumps(record), capture_output=True, text=True,
+        timeout=60,
+    )
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+_GREEN = {
+    "metric": "remote-soak-pairs",
+    "pairs": 120,
+    "hard_error_rate": 0.01,
+    "disruptions_fired": 4,
+    "disruptions_recovered": 4,
+    "consistent": True,
+    "slo_violations": [],
+    "overload": {"recovered": 1.0, "shed": 12.0},
+}
+
+
+class TestSoakGate:
+    def test_green_record_passes(self):
+        rc, out, err = _run_gate(_GREEN)
+        assert rc == 0, err
+        assert json.loads(out.splitlines()[-1])["ok"] is True
+
+    def test_recorded_slo_violation_fails(self):
+        record = {**_GREEN, "slo_violations": [
+            {"key": "pairs", "value": 0, "bound": 1, "kind": "min"},
+        ]}
+        rc, _, err = _run_gate(record)
+        assert rc == 1 and "SOAK VIOLATION pairs" in err
+
+    def test_loss_dup_inconsistency_fails(self):
+        rc, _, err = _run_gate({**_GREEN, "consistent": False})
+        assert rc == 1 and "loss-or-dup" in err
+
+    def test_hard_error_rate_bound_is_baseline(self):
+        rc, _, err = _run_gate({**_GREEN, "hard_error_rate": 0.9})
+        assert rc == 1 and "hard_error_rate" in err
+
+    def test_extra_slo_bound_asserted_and_missing_is_violation(self):
+        rc, _, err = _run_gate(_GREEN, "--slo", "pairs>=1000")
+        assert rc == 1 and "pairs" in err
+        # a bound on a metric the record lacks is a violation, not a skip
+        rc, _, err = _run_gate(_GREEN, "--slo", "no_such_metric>=1")
+        assert rc == 1 and "missing" in err
+        # dotted keys reach nested blocks
+        rc, _, _ = _run_gate(_GREEN, "--slo", "overload.shed>=1")
+        assert rc == 0
+
+    def test_usage_errors_exit_2(self):
+        rc, _, err = _run_gate(_GREEN, "--slo", "pairs=10")
+        assert rc == 2 and "<=" in err
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "soak_gate.py"),
+             "--current", "/nonexistent.json"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# explorer action surface (dashboard POST forms over the RPC bridge)
+# ---------------------------------------------------------------------------
+
+class TestExplorerActions:
+    @pytest.fixture()
+    def web(self):
+        import threading
+
+        from corda_tpu.rpc.ops import CordaRPCOps
+        from corda_tpu.testing import MockNetwork
+        from corda_tpu.webserver import WebServer
+
+        net = MockNetwork()
+        net.create_notary_node(validating=True)
+        node = net.create_node("O=ActBank,L=London,C=GB")
+        net.create_node("O=ActPeer,L=Paris,C=FR")
+        ops = CordaRPCOps(node.services, node.smm)
+        server = WebServer(ops)
+        stop = threading.Event()
+
+        def pump():
+            while not stop.wait(0.05):
+                net.run_network()
+
+        t = threading.Thread(target=pump, daemon=True, name="act-pump")
+        t.start()
+        yield ops, f"http://127.0.0.1:{server.port}"
+        stop.set()
+        t.join(timeout=5)
+        server.stop()
+        net.stop_nodes()
+
+    @staticmethod
+    def _post(base, path, form, timeout=30):
+        data = urllib.parse.urlencode(form).encode()
+        with urllib.request.urlopen(
+            base + path, data=data, timeout=timeout
+        ) as resp:
+            return resp.status, json.loads(resp.read().decode())
+
+    def test_issue_and_pay_forms(self, web):
+        _, base = web
+        status, body = self._post(
+            base, "/action/issue", {"amount": "500", "currency": "USD"}
+        )
+        assert status == 200 and body["flow"] == "CashIssueFlow"
+        assert body["tx_id"]
+        status, body = self._post(
+            base, "/action/pay",
+            {"amount": "500", "currency": "USD", "peer": "ActPeer"},
+        )
+        assert status == 200 and body["flow"] == "CashPaymentFlow"
+        assert body["tx_id"]
+
+    def test_json_body_accepted_too(self, web):
+        _, base = web
+        req = urllib.request.Request(
+            base + "/action/issue",
+            data=json.dumps({"amount": 100, "currency": "USD"}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+
+    def test_unknown_and_ambiguous_peer_are_400(self, web):
+        _, base = web
+        self._post(base, "/action/issue", {"amount": "100"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._post(base, "/action/pay",
+                       {"amount": "100", "peer": "NoSuchBank"})
+        assert err.value.code == 400
+        body = json.loads(err.value.read())
+        assert body["error"] == "ValueError"
+        assert "unknown" in body["message"]
+
+    def test_overload_renders_typed_429_with_retry_hint(self, web):
+        ops, base = web
+        from corda_tpu.node.admission import NodeOverloadedError
+
+        def shed(*a, **k):
+            raise NodeOverloadedError(
+                "node overloaded: unit", retry_after_ms=321
+            )
+
+        original = ops.start_flow_and_wait
+        ops.start_flow_and_wait = shed
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._post(base, "/action/issue", {"amount": "100"})
+            assert err.value.code == 429
+            body = json.loads(err.value.read())
+            assert body["error"] == "overloaded"
+            assert body["retry_after_ms"] == 321
+        finally:
+            ops.start_flow_and_wait = original
+
+    def test_bad_amount_is_400_not_500(self, web):
+        _, base = web
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._post(base, "/action/issue", {"amount": "not-a-number"})
+        assert err.value.code == 400
+
+    def test_dashboard_ships_the_forms(self, web):
+        _, base = web
+        with urllib.request.urlopen(base + "/", timeout=30) as resp:
+            page = resp.read().decode()
+        assert '/action/issue' in page and '/action/pay' in page
+        assert "retry_after_ms" in page  # typed overload rendering
+
+
+# ---------------------------------------------------------------------------
+# procdriver deadline knob + real.py fingerprint satellites
+# ---------------------------------------------------------------------------
+
+class TestSatellites:
+    def test_loadtest_deadline_knob(self, monkeypatch):
+        from corda_tpu.loadtest.procdriver import _deadline_s
+
+        monkeypatch.delenv("CORDA_TPU_LOADTEST_DEADLINE_S", raising=False)
+        assert _deadline_s(60.0) == 60.0
+        monkeypatch.setenv("CORDA_TPU_LOADTEST_DEADLINE_S", "240")
+        assert _deadline_s(60.0) == 240.0
+        monkeypatch.setenv("CORDA_TPU_LOADTEST_DEADLINE_S", "garbage")
+        assert _deadline_s(60.0) == 60.0
+
+    def test_conflict_reconciliation_marks_vault_states(self):
+        """The notary-conflict wedge fix: a conflict naming OUR inputs
+        consumed by a foreign tx flips them consumed in the vault, so
+        coin selection stops picking provably-dead states."""
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.finance.cash import CashState
+        from corda_tpu.node.notary import (
+            NotaryException,
+            conflict_consumed_refs,
+        )
+        from corda_tpu.testing import MockNetwork
+
+        net = MockNetwork()
+        net.create_notary_node(validating=True)
+        bank = net.create_node("O=WedgeBank,L=London,C=GB")
+        from corda_tpu.core.transactions.builder import TransactionBuilder
+        from corda_tpu.finance.cash import CashCommand
+
+        token = Issued(bank.info.ref(1), "USD")
+        b = TransactionBuilder(notary=bank.info)
+        b.add_output_state(
+            CashState(amount=Amount(100, token), owner=bank.info)
+        )
+        b.add_command(CashCommand.Issue(), bank.info.owning_key)
+        issue = bank.services.sign_initial_transaction(b)
+        bank.services.record_transactions([issue])
+        ref = issue.tx.out_ref(0).ref
+        vault = bank.services.vault_service
+        assert any(
+            sr.ref == ref for sr in vault.unconsumed_states()
+        )
+        consuming = "AB" * 32
+        exc = NotaryException(
+            f"notary error: Conflict(tx_id=SecureHash(CD), "
+            f"consumed={{'{ref!r}': SecureHash({consuming})}})"
+        )
+        pairs = conflict_consumed_refs(exc)
+        assert pairs and pairs[0][0] == ref
+        flipped = vault.mark_notary_consumed([p[0] for p in pairs])
+        assert flipped == [ref]
+        assert not any(
+            sr.ref == ref for sr in vault.unconsumed_states()
+        )
+        # idempotent: a second reconciliation flips nothing
+        assert vault.mark_notary_consumed([ref]) == []
+        net.stop_nodes()
+
+    def test_real_result_carries_fingerprint_and_topology(self):
+        """loadtest/real.py records must be gate-comparable across
+        boxes: env_fingerprint + host topology ride the result line
+        (the same provenance block bench records carry)."""
+        import inspect
+
+        from corda_tpu.loadtest import real
+
+        src = inspect.getsource(real.run)
+        assert "env_fingerprint" in src and "host_topology" in src
+
+    def test_rpc_reroute_inert_for_unsharded_unknown_ids(self):
+        """A plain node owns every flow it started: unknown ids answer
+        immediately (no reroute), tagged ids reroute only when a shard
+        role is set."""
+        from corda_tpu.messaging import Broker
+        from corda_tpu.rpc.server import RPCServer
+
+        class _Smm:
+            flows = {}
+
+        class _Ops:
+            _smm = _Smm()
+
+            def flow_result_future(self, fid):
+                raise ValueError(f"unknown flow id {fid}")
+
+        server = RPCServer.__new__(RPCServer)
+        server.ops = _Ops()
+        server.broker = Broker()
+        server.shard_role = None
+        assert not server._reroute_foreign({}, "plain-uuid", None)
+        # a worker-tagged id reroutes even on role-less servers (the
+        # tag itself proves a sharded sibling exists)
+        assert server._reroute_foreign({}, "w2-abcd", None)
+        server.shard_role = "worker"
+        assert server._reroute_foreign({}, "plain-uuid", None)
+        # spent budget: answered instead of bounced forever
+        assert not server._reroute_foreign(
+            {"_reroute_deadline": 1.0}, "w2-abcd", None
+        )

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Soak gate CLI: turn a remote-soak result record into CI exit status.
+
+    python -m corda_tpu.loadtest.remote --hosts hosts.conf > soak.json
+    python tools/soak_gate.py --current soak.json
+    python tools/soak_gate.py --current - --slo "pairs>=100"
+
+Fails (exit 1) on:
+  * any `slo_violations` the soak itself recorded (the run's own SLO
+    spec — disruption recovery, typed-shed hygiene, reconciliation);
+  * `consistent` false, or loss/dup evidence (`hard_driver_errors`,
+    `reconciliation.torn_spends`);
+  * any extra `--slo` bound asserted here (gate.check_slos semantics:
+    a bound on a metric the record lacks is a violation, not a skip).
+
+Exit status: 0 = pass, 1 = breach, 2 = usage error — the same contract
+as tools/bench_gate.py, sharing its comparison engine
+(corda_tpu.loadtest.gate).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, _REPO)
+
+from corda_tpu.loadtest import gate  # noqa: E402
+
+#: invariants asserted on EVERY soak record, beyond what the run chose
+#: to check — a gate that trusts the record's own verdict alone can be
+#: defeated by a run that never evaluated SLOs at all
+BASELINE_SLOS = {
+    "pairs": {"min": 1.0},
+    "hard_error_rate": {"max": 0.25},
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="soak_gate")
+    ap.add_argument(
+        "--current", required=True,
+        help="soak record to gate: a JSON file, or '-' for stdin",
+    )
+    ap.add_argument(
+        "--slo", action="append", metavar="KEY<=V | KEY>=V",
+        help="extra absolute bound to assert (repeatable; dotted keys "
+             "reach nested blocks, e.g. overload.recovered>=1)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        if args.current == "-":
+            record = json.load(sys.stdin)
+        else:
+            with open(args.current) as fh:
+                record = json.load(fh)
+        if not isinstance(record, dict):
+            raise ValueError("not a soak record")
+    except (OSError, ValueError) as exc:
+        print(f"soak_gate: cannot read record: {exc}", file=sys.stderr)
+        return 2
+
+    try:
+        slos = {**BASELINE_SLOS, **gate.parse_slo_args(args.slo)}
+    except ValueError as exc:
+        print(f"soak_gate: {exc}", file=sys.stderr)
+        return 2
+
+    violations = list(record.get("slo_violations") or [])
+    violations.extend(gate.check_slos(record, slos))
+    if record.get("consistent") is not True:
+        violations.append({
+            "key": "consistent", "value": record.get("consistent"),
+            "bound": True, "kind": "loss-or-dup",
+        })
+
+    for v in violations:
+        print(
+            f"SOAK VIOLATION {v.get('key')}: value={v.get('value')} "
+            f"bound={v.get('bound')} ({v.get('kind')})",
+            file=sys.stderr,
+        )
+    ok = not violations
+    if ok:
+        print(
+            f"soak_gate: PASS ({record.get('pairs')} pairs, "
+            f"{record.get('disruptions_recovered')} disruptions "
+            f"recovered)",
+            file=sys.stderr,
+        )
+    print(json.dumps({"ok": ok, "violations": violations}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
