@@ -582,6 +582,35 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         pump_drain = {"pump_drain_error": f"{type(exc).__name__}: {exc}"}
 
+    # Bank-side flow hot path (ISSUE 15, docs/perf-system.md round 20):
+    # (1) coin selection must stay FLAT as the vault grows (the decoded
+    # cache + availability buckets vs the old per-query full-vault
+    # deserialize — `coin_select_us_per_pick` gates lower-is-better);
+    # (2) checkpoint group commit at FULL durability — concurrent flows'
+    # step commits coalescing into one fsync per drain window
+    # (`checkpoint_*_flows_s` gate higher-is-better); (3) laned vs
+    # on-pump flow execution over an in-process broker rig (the
+    # multi-lane executor A/B; like the r15/r16 stages, the wall-clock
+    # win needs >= 2 cores — cpus rides the env fingerprint).
+    from corda_tpu.loadtest.latency import (
+        measure_checkpoint_group_commit,
+        measure_coin_selection,
+        measure_flow_lane_ab,
+    )
+
+    try:
+        coin_select = measure_coin_selection()
+    except Exception as exc:
+        coin_select = {"coin_select_error": f"{type(exc).__name__}: {exc}"}
+    try:
+        cp_group = measure_checkpoint_group_commit()
+    except Exception as exc:
+        cp_group = {"checkpoint_gc_error": f"{type(exc).__name__}: {exc}"}
+    try:
+        lane_ab = measure_flow_lane_ab()
+    except Exception as exc:
+        lane_ab = {"flow_lane_error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -628,6 +657,18 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         ),
         "codec_batch_speedup_x": codec_batch.get("codec_batch_speedup_x"),
         "pump_drain_msgs_s": pump_drain.get("pump_drain_msgs_s"),
+        "coin_select_us_per_pick": coin_select.get("coin_select_us_per_pick"),
+        "checkpoint_group_commit_flows_s": cp_group.get(
+            "checkpoint_group_commit_flows_s"
+        ),
+        "checkpoint_per_step_flows_s": cp_group.get(
+            "checkpoint_per_step_flows_s"
+        ),
+        "checkpoint_group_commit_speedup_x": cp_group.get(
+            "checkpoint_group_commit_speedup_x"
+        ),
+        "flow_lane_pairs_s": lane_ab.get("flow_lane_pairs_s"),
+        "flow_lane_sync_pairs_s": lane_ab.get("flow_lane_sync_pairs_s"),
     }
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
@@ -658,6 +699,9 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     out.update(pipe_ab)
     out.update(codec_batch)
     out.update(pump_drain)
+    out.update(coin_select)
+    out.update(cp_group)
+    out.update(lane_ab)
 
     # Full-system throughput: issue+pay pairs through REAL node processes
     # (cordform network, TCP brokers, bridges, validating notary) — the
@@ -718,6 +762,35 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
             out["system_unsharded_pairs_s"] = unsharded["pairs_per_sec"]
         except Exception as exc:
             out["system_unsharded_error"] = f"{type(exc).__name__}: {exc}"
+        # Flow-hot-path comparator (ISSUE 15, docs/perf-system.md round
+        # 20): the SAME sharded topology with every bank-side lever
+        # killed — on-pump dispatch, full-scan coin selection, per-step
+        # checkpoint commits. The node processes inherit the env, so
+        # this IS the driver-capturable A/B on system_notarised_pairs_s.
+        _kill = {
+            "CORDA_TPU_FLOW_LANES": "0",
+            "CORDA_TPU_VAULT_CACHE": "0",
+            "CORDA_TPU_CP_GROUP_COMMIT": "0",
+        }
+        _saved = {k: os.environ.get(k) for k in _kill}
+        try:
+            os.environ.update(_kill)
+            baseline = loadtest_run(
+                pairs=120, parallelism=8, shards=SYSTEM_SHARDS
+            )
+            out["system_flowpath_baseline_pairs_s"] = (
+                baseline["pairs_per_sec"]
+            )
+        except Exception as exc:
+            out["system_flowpath_baseline_error"] = (
+                f"{type(exc).__name__}: {exc}"
+            )
+        finally:
+            for k, v in _saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
     except Exception as exc:
         out["system_error"] = f"{type(exc).__name__}: {exc}"
 

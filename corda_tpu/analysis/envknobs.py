@@ -100,6 +100,20 @@ _ENTRIES = [
     # -- node / flows -------------------------------------------------------
     _k("CORDA_TPU_FLOW_BLOCKING_THREADS", "4", "docs/writing-flows.md",
        "executor threads serving await_blocking flow sections"),
+    # -- bank-side flow hot path (this PR) ------------------------------------
+    _k("CORDA_TPU_FLOW_LANES", "cpus (0 on a 1-CPU host)",
+       "docs/perf-system.md",
+       "flow-continuation lane threads on the broker transport "
+       "(0 = on-pump dispatch; MockNetwork stays inline unless opted in)"),
+    _k("CORDA_TPU_VAULT_CACHE", "65536", "docs/perf-system.md",
+       "decoded vault-state cache capacity backing O(selected) coin "
+       "selection (0 = full-scan legacy path)"),
+    _k("CORDA_TPU_CP_GROUP_COMMIT", "1", "docs/perf-system.md",
+       "0 = per-step checkpoint commits instead of group-committed "
+       "drain windows on async transports"),
+    _k("CORDA_TPU_CP_LINGER_MS", "0", "docs/perf-system.md",
+       "bounded linger a checkpoint group-commit leader waits for more "
+       "writers (0 = drain-window coalescing only)"),
     _k("CORDA_TPU_GC_THRESHOLD", "50000", "docs/running-nodes.md",
        "gen-0 GC threshold set at node start (allocation-heavy path)"),
     _k("CORDA_TPU_LOG", "WARNING", "docs/running-nodes.md",

@@ -53,20 +53,21 @@ def generate_spend(
     lock_id = lock_id or str(uuid.uuid4())
     # select-then-reserve races concurrent spenders (the query and the
     # lock are not atomic); retry with backoff like the reference's
-    # AbstractCashSelection (spendLock + retrySleep)
+    # AbstractCashSelection (spendLock + retrySleep). Selection walks
+    # the vault's lazy availability iterator and stops at the target,
+    # so a pick touches (and deserializes) O(selected) states, not
+    # O(vault) — docs/perf-system.md round 20.
     for attempt in range(5):
-        candidates = [
-            sr for sr in vault.unlocked_unconsumed_states(
-                CashState.contract_name, lock_id=lock_id
-            )
-            if sr.state.data.amount.token == amount.token
-        ]
         selected, gathered = [], 0
-        for sr in candidates:
-            if gathered >= amount.quantity:
-                break
+        for sr in vault.iter_unlocked_unconsumed(
+            CashState.contract_name, lock_id=lock_id
+        ):
+            if sr.state.data.amount.token != amount.token:
+                continue
             selected.append(sr)
             gathered += sr.state.data.amount.quantity
+            if gathered >= amount.quantity:
+                break
         if gathered < amount.quantity:
             raise InsufficientBalanceError(
                 Amount(amount.quantity - gathered, amount.token)
@@ -168,19 +169,17 @@ class CashExitFlow(FlowLogic):
         hub = self.service_hub
         me = hub.my_info
         vault = hub.vault_service
-        candidates = [
-            sr for sr in vault.unlocked_unconsumed_states(
-                CashState.contract_name, lock_id=lock_id
-            )
-            if sr.state.data.amount.token == self.amount.token
-            and sr.state.data.owner == me
-        ]
         selected, gathered = [], 0
-        for sr in candidates:
-            if gathered >= self.amount.quantity:
-                break
+        for sr in vault.iter_unlocked_unconsumed(
+            CashState.contract_name, lock_id=lock_id
+        ):
+            if (sr.state.data.amount.token != self.amount.token
+                    or sr.state.data.owner != me):
+                continue
             selected.append(sr)
             gathered += sr.state.data.amount.quantity
+            if gathered >= self.amount.quantity:
+                break
         if gathered < self.amount.quantity:
             raise InsufficientBalanceError(
                 Amount(self.amount.quantity - gathered, self.amount.token)

@@ -42,7 +42,9 @@ _HIGHER = re.compile(
     r"|_overlap_ratio|_hidden_pct"
     # the codec/pump batch A/B (docs/perf-system.md round 16): a
     # shrinking native-vs-python speedup is the regression direction
-    r"|_speedup_x)$"
+    r"|_speedup_x"
+    # checkpoint group-commit throughput (docs/perf-system.md round 20)
+    r"|_flows_s)$"
 )
 _LOWER = re.compile(r"(_ms|_us|_s)$")
 _LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")

@@ -17,11 +17,12 @@ from __future__ import annotations
 
 import sqlite3
 import threading
+import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..core.crypto.secure_hash import SecureHash
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import lockorder
+from ..utils import eventlog, lockorder
 
 
 class NodeDatabase:
@@ -125,6 +126,111 @@ class _Tx:
         return False
 
 
+class _GroupCommitter:
+    """Leader/follower sqlite group commit (the coalescing shape the
+    notary commit path proved in PR 1, one layer down): concurrent
+    writers enqueue their statement closures; the first becomes the
+    LEADER and executes everything pending in ONE transaction (one
+    commit cycle — one WAL append, one fsync at FULL durability) while
+    followers park on an event that only sets after THEIR batch
+    committed. Durability semantics are therefore unchanged: every
+    `run()` returns with its writes committed, exactly like the direct
+    per-op transaction it replaces — concurrency is what buys the win
+    (the batch grows with the arrivals during the previous commit
+    cycle, plus an optional bounded linger).
+
+    A writer already inside a db transaction (reentrant db.lock holder)
+    bypasses the group — becoming a follower there would deadlock the
+    leader against the held lock, and its statements already ride the
+    outer batch's single commit."""
+
+    def __init__(self, db: NodeDatabase, linger_s: float = 0.0):
+        self.db = db
+        self.linger_s = linger_s
+        self._lock = lockorder.make_lock("_GroupCommitter._lock")
+        # guarded-by: _lock
+        self._pending: List[Tuple[Callable, threading.Event, dict]] = []
+        self._leader_active = False
+        self.stats = {"batches": 0, "ops": 0, "max_batch": 0}
+
+    def run(self, op: Callable) -> None:
+        """Execute `op(conn)` durably: coalesced into the current drain
+        window's shared commit, or directly when re-entrant."""
+        owned = getattr(self.db.lock, "_is_owned", None)
+        if owned is not None and owned():
+            with self.db.transaction() as tx:
+                op(tx)
+            return
+        ev = threading.Event()
+        box: dict = {}
+        with self._lock:
+            self._pending.append((op, ev, box))
+            leader = not self._leader_active
+            if leader:
+                self._leader_active = True
+        if not leader:
+            ev.wait()  # the leader always drains the batch it saw us in
+            if "err" in box:
+                raise box["err"]
+            return
+        try:
+            while True:
+                if self.linger_s > 0:
+                    time.sleep(self.linger_s)  # bounded accumulation
+                with self._lock:
+                    batch, self._pending = self._pending, []
+                if batch:
+                    self._commit_batch(batch)
+                with self._lock:
+                    if not self._pending:
+                        self._leader_active = False
+                        break
+            # the leader's OWN op rode its first batch: surface its
+            # error exactly like a follower's
+            if "err" in box:
+                raise box["err"]
+            return
+        except BaseException:
+            # a leader must never die holding the flag: fail whatever is
+            # still queued loudly instead of wedging future writers
+            with self._lock:
+                orphans, self._pending = self._pending, []
+                self._leader_active = False
+            for _op, oev, obox in orphans:
+                obox["err"] = RuntimeError("group-commit leader died")
+                oev.set()
+            raise
+
+    def _commit_batch(self, batch) -> None:
+        try:
+            with self.db.transaction() as tx:
+                for op, _ev, _box in batch:
+                    op(tx)
+        except BaseException as exc:
+            # shared transaction poisoned: one bad op must not fail its
+            # innocent batch-mates — re-run each alone, surfacing each
+            # op's own error to its own caller
+            eventlog.emit(
+                "warning", "checkpoint",
+                "group-commit batch poisoned; re-running ops individually",
+                error=f"{type(exc).__name__}: {exc}", batch=len(batch),
+            )
+            for op, ev, box in batch:
+                try:
+                    with self.db.transaction() as tx:
+                        op(tx)
+                except BaseException as exc:
+                    box["err"] = exc
+                finally:
+                    ev.set()
+        else:
+            for _op, ev, _box in batch:
+                ev.set()
+        self.stats["batches"] += 1
+        self.stats["ops"] += len(batch)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+
+
 class CheckpointStorage:
     """flow_id -> checkpoint (replay state, not a serialized stack).
 
@@ -137,10 +243,22 @@ class CheckpointStorage:
         one of the biggest CPU items in the round-3 system profile.
     `all_checkpoints()` returns full blobs for both paths (incremental
     rows are assembled at read time — restores are rare, steps are not).
-    """
+
+    GROUP COMMIT (docs/perf-system.md round 20): with concurrent flows
+    (multi-lane executor + blocking pool + RPC threads) every per-step
+    write paid its own sqlite commit under the db lock. AbstractNode
+    arms `enable_group_commit()` on async transports so concurrent
+    step-checkpoints coalesce into one commit cycle per drain window —
+    writers still block until THEIR write is durably committed, so a
+    flow that parks has its checkpoint on disk exactly as before
+    (suspend durability unchanged; see the crash-redelivery pin in
+    tests/test_flowpath.py). CORDA_TPU_CP_GROUP_COMMIT=0 restores the
+    per-op commits; the deterministic MockNetwork transport never arms
+    it."""
 
     def __init__(self, db: NodeDatabase):
         self.db = db
+        self._group: Optional[_GroupCommitter] = None
         db.execute(
             "CREATE TABLE IF NOT EXISTS checkpoints "
             "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
@@ -159,12 +277,30 @@ class CheckpointStorage:
             "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
         )
 
+    def enable_group_commit(self, linger_ms: float = 0.0) -> None:
+        """Arm checkpoint write coalescing (idempotent). `linger_ms`
+        bounds how long a commit leader waits for more writers to
+        accumulate (0 = drain-window coalescing only)."""
+        if self._group is None:
+            self._group = _GroupCommitter(self.db, linger_s=linger_ms / 1000.0)
+
+    @property
+    def group_commit_stats(self) -> Optional[dict]:
+        return None if self._group is None else dict(self._group.stats)
+
+    def _write(self, op: Callable) -> None:
+        if self._group is not None:
+            self._group.run(op)
+        else:
+            with self.db.transaction() as tx:
+                op(tx)
+
     def put(self, flow_id: str, blob: bytes) -> None:
-        self.db.execute(
+        self._write(lambda tx: tx.execute(
             "INSERT INTO checkpoints(flow_id, blob) VALUES(?, ?) "
             "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
             (flow_id, blob),
-        )
+        ))
 
     def put_incremental(
         self,
@@ -178,7 +314,7 @@ class CheckpointStorage:
         deletes any legacy full-blob row — the incremental rows are now
         authoritative (all_checkpoints would otherwise prefer the stale
         legacy blob forever)."""
-        with self.db.transaction() as tx:
+        def op(tx):
             if header_blob is not None:
                 tx.execute(
                     "INSERT INTO cp_header(flow_id, blob) VALUES(?, ?) "
@@ -200,12 +336,16 @@ class CheckpointStorage:
                 (flow_id, sessions_blob),
             )
 
+        self._write(op)
+
     def remove(self, flow_id: str) -> None:
-        with self.db.transaction() as tx:
+        def op(tx):
             for table in ("checkpoints", "cp_header", "cp_io", "cp_sessions"):
                 tx.execute(
                     f"DELETE FROM {table} WHERE flow_id = ?", (flow_id,)
                 )
+
+        self._write(op)
 
     def _assemble(self, flow_id: str, header_blob: bytes) -> bytes:
         state = deserialize(header_blob)

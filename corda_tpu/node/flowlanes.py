@@ -1,0 +1,225 @@
+"""Multi-lane flow executor (docs/perf-system.md round 20).
+
+The round-11 profile named the bank-side convoy: ONE p2p pump thread at
+~96% CPU share stepping every flow continuation inline while ~25 threads
+park. PR 11 made the pump's wire work (frame/parse/route) GIL-releasing
+native calls, so the pump could overlap Python flow execution — except
+the flow execution still ran ON the pump. This executor is the missing
+half: session-message continuations dispatch onto N lane threads with
+per-flow affinity, so the native drain of batch N+1 overlaps the Python
+flow steps of batch N.
+
+Affinity, not locking, is the ordering story: every session message
+carries the `x-session-route` hint ("h:<sid>" / "t:<sid>", stamped by
+`statemachine._send_session_message`), the hint's `<flow id>` prefix
+picks the lane, and a lane is a FIFO — so one flow's (and one
+session's) messages process in arrival order on one thread. Cross-flow
+messages interleave freely across lanes; the per-FSM step lock
+(`FlowStateMachine._step_lock`) stays the authority on state, exactly
+as it already is for the blocking-executor and RPC threads.
+
+Each lane owns its own lock + condition + queue: a submit wakes only
+the target lane's worker (and only that lane's depth-blocked
+submitters), never the whole pool — cross-lane contention would
+serialize exactly the path this executor parallelizes.
+
+Backpressure: each lane queue is bounded (LANE_DEPTH); `submit` BLOCKS
+when the target lane is full, which parks the pump, which backs up the
+broker queue, which engages the existing `CORDA_TPU_P2P_QUEUE_MAX`
+caps — no new unbounded queue.
+
+`CORDA_TPU_FLOW_LANES` sizes the pool (default: CPU count, except 0 on
+a single-CPU host — nothing to overlap with; 0 restores today's
+on-pump dispatch byte-identically). The deterministic in-memory test
+transport stays inline unless a MockNetwork opts in explicitly
+(`MockNetwork(flow_lanes=N)`), mirroring `dispatches_blocking_off_pump`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Callable, List
+
+from ..utils import eventlog, lockorder
+
+#: bound on each lane's pending-continuation queue: overflow blocks the
+#: submitter (the pump), composing with the broker-side queue caps
+LANE_DEPTH = 512
+
+
+def default_lanes() -> int:
+    """CORDA_TPU_FLOW_LANES, defaulting to the CPU count — every core
+    can run a flow step while the pump drains natively — EXCEPT on a
+    single-CPU host, where the default is 0: there is no second core to
+    overlap with, so a lone lane is pure handoff overhead (measured
+    ~5% on the 1-core build container's system stage). Set the knob
+    explicitly to force lanes anywhere. 0 = on-pump dispatch."""
+    raw = os.environ.get("CORDA_TPU_FLOW_LANES")
+    if raw is None or raw == "":
+        cpus = os.cpu_count() or 1
+        return cpus if cpus >= 2 else 0
+    return max(0, int(raw))
+
+
+def lane_key(hint: str) -> str:
+    """Per-flow affinity key of an `x-session-route` hint: the flow-id
+    prefix of the session id ("<flow id>:<n>"), so every session of one
+    flow — and every message of one session — lands on one lane."""
+    sid = hint[2:] if hint[:2] in ("h:", "t:") else hint
+    return sid.rsplit(":", 1)[0]
+
+
+class _Lane:
+    """One FIFO worker lane: own lock, own condition, own queue — a
+    submit wakes only THIS lane."""
+
+    def __init__(self, idx: int, name: str):
+        self.lock = lockorder.make_lock(f"FlowLane[{idx}].lock")
+        self.cv = lockorder.make_condition(self.lock, f"FlowLane[{idx}].cv")
+        self.q: deque = deque()
+        # guarded-by: lock
+        self.busy = False
+        self.stopped = False
+        self.dispatched = 0
+        self.completed = 0
+        self.errors = 0
+
+
+class FlowLaneExecutor:
+    """N FIFO worker lanes with stable key -> lane assignment."""
+
+    def __init__(self, n_lanes: int, name: str = "node",
+                 depth: int = LANE_DEPTH):
+        self.n_lanes = max(1, int(n_lanes))
+        self.name = name
+        self.depth = depth
+        self._lanes: List[_Lane] = [
+            _Lane(i, name) for i in range(self.n_lanes)
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(lane,),
+                name=f"flow-lane-{i}-{name}", daemon=True,
+            )
+            for i, lane in enumerate(self._lanes)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def lane_of(self, key: str) -> int:
+        """Stable, process-deterministic lane assignment (crc32, not
+        hash(): str hashing is per-process salted)."""
+        return zlib.crc32(key.encode("utf-8", "replace")) % self.n_lanes
+
+    def submit(self, key: str, fn: Callable[[], None]) -> int:
+        """Enqueue `fn` on the lane owning `key`; blocks while that lane
+        is at depth (backpressure to the pump). Returns the lane index.
+        Raises RuntimeError after stop() — callers fall back inline."""
+        idx = self.lane_of(key)
+        lane = self._lanes[idx]
+        with lane.lock:
+            while len(lane.q) >= self.depth and not lane.stopped:
+                # lint: allow(blocking_under_lock) — cv wraps this lock
+                lane.cv.wait(timeout=0.5)
+            if lane.stopped:
+                raise RuntimeError("flow lane executor is stopped")
+            lane.q.append(fn)
+            lane.dispatched += 1
+            lane.cv.notify_all()
+        return idx
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self, lane: _Lane) -> None:
+        while True:
+            with lane.lock:
+                while not lane.q and not lane.stopped:
+                    # lint: allow(blocking_under_lock) — cv wraps this lock
+                    lane.cv.wait(timeout=0.5)
+                if not lane.q:
+                    return  # stopped and (drained or abandoned) empty
+                fn = lane.q.popleft()
+                lane.busy = True
+                lane.cv.notify_all()  # wake a depth-blocked submitter
+            try:
+                fn()
+            except BaseException as exc:
+                # a continuation error must never kill the lane; the
+                # flow's own _fail path already handled flow errors, so
+                # anything landing here is a dispatch-layer bug worth
+                # loud evidence
+                with lane.lock:
+                    lane.errors += 1
+                eventlog.emit(
+                    "error", "flowlanes",
+                    "lane continuation error",
+                    error=f"{type(exc).__name__}: {exc}", node=self.name,
+                )
+            finally:
+                with lane.lock:
+                    lane.busy = False
+                    lane.completed += 1
+                    lane.cv.notify_all()
+
+    # -- lifecycle / introspection -------------------------------------------
+
+    def depth_of(self, idx: int) -> int:
+        return len(self._lanes[idx].q)
+
+    def pending(self) -> int:
+        return sum(len(lane.q) for lane in self._lanes)
+
+    def idle(self) -> bool:
+        for lane in self._lanes:
+            with lane.lock:
+                if lane.busy or lane.q:
+                    return False
+        return True
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Wait until every lane is empty AND idle (the in-memory
+        transport's run_network barrier). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        for lane in self._lanes:  # sequential: total bounded by deadline
+            with lane.lock:
+                while lane.busy or lane.q:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    # lint: allow(blocking_under_lock) — cv wraps this lock
+                    lane.cv.wait(timeout=min(remaining, 0.2))
+        return True
+
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> bool:
+        """Stop the lanes. drain=True runs everything already queued
+        first (node stop: in-flight continuations complete and their
+        broker messages get acked); drain=False abandons the queues
+        (their messages stay unacked -> broker redelivery)."""
+        drained = True
+        if drain:
+            drained = self.quiesce(timeout)
+        for lane in self._lanes:
+            with lane.lock:
+                lane.stopped = True
+                if not drain:
+                    lane.q.clear()
+                lane.cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)
+        return drained
+
+    def stats(self) -> dict:
+        out = {"lanes": self.n_lanes, "dispatched": 0, "completed": 0,
+               "errors": 0, "pending": 0}
+        for lane in self._lanes:
+            with lane.lock:
+                out["dispatched"] += lane.dispatched
+                out["completed"] += lane.completed
+                out["errors"] += lane.errors
+                out["pending"] += len(lane.q)
+        return out

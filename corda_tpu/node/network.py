@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..core.identity import Party
+from ..messaging.broker import BrokerError
 from ..utils import eventlog, lockorder, tracing
 
 
@@ -36,6 +37,10 @@ class _InFlight:
     # handlers run with this as the current context, so a responder
     # flow's spans chain onto the sender's trace
     traceparent: Optional[str] = None
+    # broker-header twin (the session route hint rides here): only read
+    # by the OPT-IN flow-lane dispatch — the default in-memory delivery
+    # ignores headers exactly as before
+    headers: Optional[dict] = None
 
 
 class InMemoryMessagingNetwork:
@@ -72,6 +77,21 @@ class InMemoryMessagingNetwork:
         self._caps: Dict[str, Tuple[int, str]] = {}
         self.shed_counts: Dict[str, int] = {}
         self.dead_letters: Deque[_InFlight] = deque(maxlen=256)
+        # OPT-IN multi-lane continuation dispatch (docs/perf-system.md
+        # round 20): None = today's fully deterministic inline delivery.
+        # MockNetwork(flow_lanes=N) arms it for tests that want the laned
+        # concurrency shape on the in-memory transport; run() then
+        # barriers on lane quiescence so run_network keeps its contract.
+        self.lane_executor = None
+
+    def enable_flow_lanes(self, n_lanes: int) -> None:
+        """Arm laned delivery of session messages (hinted via the
+        x-session-route header) on N lane threads. Test-only opt-in —
+        the default in-memory transport stays inline/deterministic."""
+        from .flowlanes import FlowLaneExecutor
+
+        if n_lanes and self.lane_executor is None:
+            self.lane_executor = FlowLaneExecutor(n_lanes, name="inmem")
 
     def create_endpoint(self, me: Party) -> "InMemoryMessaging":
         ep = InMemoryMessaging(self, me)
@@ -105,6 +125,7 @@ class InMemoryMessagingNetwork:
                     msg.sender, msg.recipient, msg.topic, msg.payload,
                     due_at=self.clock() + delay,
                     traceparent=msg.traceparent,
+                    headers=msg.headers,
                 )
         with self._lock:
             cap = self._caps.get(msg.recipient)
@@ -190,7 +211,7 @@ class InMemoryMessagingNetwork:
             ep = self._resolve_recipient(msg.recipient)
         if ep is not None:
             ep._deliver(msg.sender, msg.topic, msg.payload,
-                        traceparent=msg.traceparent)
+                        traceparent=msg.traceparent, headers=msg.headers)
             if self.observer is not None:
                 self.observer(msg)
         with self._lock:
@@ -198,13 +219,29 @@ class InMemoryMessagingNetwork:
         return True
 
     def run(self, max_messages: int = 100_000) -> int:
-        """Pump until quiescent (reference runNetwork). Returns deliveries."""
+        """Pump until quiescent (reference runNetwork). Returns deliveries.
+        With opt-in flow lanes armed, "quiescent" additionally means every
+        lane drained and idle: laned continuations may send new messages,
+        so the pump/lane barrier loops until BOTH are empty."""
         n = 0
-        while self.pump():
-            n += 1
-            if n > max_messages:
-                raise RuntimeError("network did not quiesce (message storm?)")
-        return n
+        while True:
+            while self.pump():
+                n += 1
+                if n > max_messages:
+                    raise RuntimeError(
+                        "network did not quiesce (message storm?)"
+                    )
+            if self.lane_executor is None:
+                return n
+            if not self.lane_executor.quiesce():
+                # a wedged continuation must fail the run like the
+                # message-storm guard does, not spin here forever
+                raise RuntimeError(
+                    "flow lanes did not quiesce (wedged continuation?): "
+                    f"{self.lane_executor.stats()}"
+                )
+            if self.queue_depth() == 0 and self.lane_executor.idle():
+                return n
 
 
 class InMemoryMessaging:
@@ -218,11 +255,12 @@ class InMemoryMessaging:
 
     def send(self, peer: Party, topic: str, payload: bytes,
              headers: Optional[dict] = None) -> None:
-        # `headers` (e.g. the session route hint) only matter to a
-        # broker-side shard router; this in-memory transport has none
+        # `headers` ride along for the OPT-IN lane dispatch (the session
+        # route hint); the default inline delivery never reads them
         self.network._enqueue(
             _InFlight(self.me, peer.name, topic, payload,
-                      traceparent=tracing.current_traceparent())
+                      traceparent=tracing.current_traceparent(),
+                      headers=headers)
         )
 
     def add_handler(self, topic: str, fn: Callable[[Party, bytes], None]) -> None:
@@ -233,9 +271,33 @@ class InMemoryMessaging:
         return self.network.queue_depth(self.me.name)
 
     def _deliver(self, sender: Party, topic: str, payload: bytes,
-                 traceparent: Optional[str] = None) -> None:
+                 traceparent: Optional[str] = None,
+                 headers: Optional[dict] = None) -> None:
         if not self.running:
             return
+        lanes = self.network.lane_executor
+        if lanes is not None:
+            # opt-in laned dispatch: hinted (session) messages run their
+            # handlers on the lane owning the hint's flow id; everything
+            # else stays inline on the pumping thread
+            from .flowlanes import lane_key
+            from .session import ROUTE_HINT_HEADER
+
+            hint = (headers or {}).get(ROUTE_HINT_HEADER)
+            if hint:
+                try:
+                    lanes.submit(
+                        lane_key(hint),
+                        lambda: self._dispatch(sender, topic, payload,
+                                               traceparent),
+                    )
+                    return
+                except RuntimeError:
+                    pass  # lanes stopped mid-teardown: dispatch inline
+        self._dispatch(sender, topic, payload, traceparent)
+
+    def _dispatch(self, sender: Party, topic: str, payload: bytes,
+                  traceparent: Optional[str] = None) -> None:
         ctx = tracing.SpanContext.from_traceparent(traceparent)
         if ctx is None:
             for fn in self._handlers.get(topic, []):
@@ -299,6 +361,23 @@ class BrokerMessagingService:
         # the kernel->system profiling seam (round-2 VERDICT weak #3).
         self.metrics = None
         self._stop = threading.Event()
+        # Multi-lane flow executor (docs/perf-system.md round 20):
+        # session messages — identified header-only by the x-session-route
+        # hint every session sender stamps — dispatch their handler chain
+        # onto a lane thread keyed by flow id, so the pump's next
+        # GIL-releasing native drain overlaps Python flow execution.
+        # A laned message is acked only AFTER its handlers ran (the lane
+        # reports completions back to the pump thread, which acks them on
+        # its next cycle): the at-least-once contract of the inline path
+        # is unchanged — a crash mid-continuation leaves the message
+        # unacked and the broker redelivers. CORDA_TPU_FLOW_LANES=0
+        # restores today's fully-inline dispatch byte-identically.
+        from .flowlanes import FlowLaneExecutor, default_lanes
+
+        n_lanes = default_lanes()
+        self._lanes = (
+            FlowLaneExecutor(n_lanes, name=me.name) if n_lanes > 0 else None
+        )
         self._consumer = broker.create_consumer(self.queue_name)
         self._extra_threads: List[threading.Thread] = []
         self._extra_consumers: List = []
@@ -405,14 +484,100 @@ class BrokerMessagingService:
     #: max messages drained into one lock acquisition by the pump
     PUMP_BATCH = 32
 
-    def _consume_from(self, consumer) -> None:
+    def _handle_msg(self, msg, payload=None) -> None:
+        """Dispatch ONE broker message through the topic handlers —
+        runs inline on the pump (default) or on a flow lane (hinted
+        session messages when CORDA_TPU_FLOW_LANES > 0). `payload`
+        overrides msg.payload for laned dispatch, whose bytes were
+        snapshotted at handoff (the zero-copy drain arena only lives
+        until the pump's next cycle)."""
         from ..core.crypto.keys import SchemePublicKey
+
+        topic = msg.headers.get("topic", "")
+        sender = Party(
+            msg.headers.get("sender", "?"),
+            SchemePublicKey(
+                "EDDSA_ED25519_SHA512",
+                bytes.fromhex(msg.headers.get("sender_key", "")),
+            )
+            if msg.headers.get("sender_key")
+            else None,
+        )
+        body = msg.payload if payload is None else payload
+        metrics = self.metrics
+        t0 = time.perf_counter() if metrics is not None else 0.0
+        ctx = tracing.SpanContext.from_traceparent(
+            msg.headers.get(tracing.TRACEPARENT_HEADER)
+        )
+        sp = (
+            tracing.get_tracer().start_span(
+                "p2p.deliver", parent=ctx, topic=topic,
+                to=self.me.name,
+            )
+            if ctx is not None else tracing.NOOP_SPAN
+        )
+        with tracing.activate(sp.context):
+            for fn in self._handlers.get(topic, []):
+                try:
+                    fn(sender, body)
+                except Exception as exc:
+                    # handler errors must not kill the pump, but
+                    # a silently-dropped delivery is exactly the
+                    # evidence a flow hang investigation needs
+                    eventlog.emit(
+                        "error", "p2p",
+                        f"handler error on {topic}",
+                        error=f"{type(exc).__name__}: {exc}",
+                        sender=str(sender),
+                    )
+            sp.finish()
+        if metrics is not None:
+            metrics.timer(f"P2P.Handle.{topic}").update(
+                time.perf_counter() - t0
+            )
+
+    @staticmethod
+    def _drain_completions(consumer, lane_done, in_lanes) -> None:
+        """Ack every lane-completed message (pump thread only: consumers
+        are single-threaded objects — RemoteConsumer shares one socket —
+        so lanes report completions here instead of acking directly)."""
+        done = []
+        while True:
+            try:
+                done.append(lane_done.popleft())
+            except IndexError:
+                break
+        if not done:
+            return
+        in_lanes[0] -= len(done)
+        try:
+            if hasattr(consumer, "ack_many"):
+                consumer.ack_many(done)
+            else:  # RemoteConsumer: per-message one-way acks
+                for m in done:
+                    consumer.ack(m)
+        except BrokerError as exc:
+            # consumer closed mid-shutdown: the broker requeued these
+            # unacked — redelivery + receiver dedup absorb the overlap
+            eventlog.emit(
+                "info", "p2p", "lane completions acked after close",
+                error=str(exc), count=len(done),
+            )
+
+    def _consume_from(self, consumer) -> None:
+        from .flowlanes import lane_key
+        from .session import ROUTE_HINT_HEADER
 
         # local consumers batch under one broker-lock acquisition; remote
         # consumers (RemoteConsumer) pipeline on the wire already and
         # keep the one-at-a-time surface
         batched = hasattr(consumer, "receive_many")
+        lanes = self._lanes
+        lane_done: Deque = deque()  # lane threads append; pump pops
+        in_lanes = [0]  # dispatched-not-yet-acked, pump-thread-local
         while not self._stop.is_set():
+            if lanes is not None:
+                self._drain_completions(consumer, lane_done, in_lanes)
             if batched:
                 batch = consumer.receive_many(self.PUMP_BATCH, timeout=0.2)
             else:
@@ -420,55 +585,74 @@ class BrokerMessagingService:
                 batch = [msg] if msg is not None else []
             if not batch:
                 continue
+            inline_done = []
             for msg in batch:
-                topic = msg.headers.get("topic", "")
-                sender = Party(
-                    msg.headers.get("sender", "?"),
-                    SchemePublicKey(
-                        "EDDSA_ED25519_SHA512",
-                        bytes.fromhex(msg.headers.get("sender_key", "")),
+                hint = (
+                    msg.headers.get(ROUTE_HINT_HEADER)
+                    if lanes is not None else None
+                )
+                if hint:
+                    # snapshot: a zero-copy arena view must not escape
+                    # this drain cycle (PR 11 arena lifetime rules)
+                    payload = (
+                        msg.payload if type(msg.payload) is bytes
+                        else bytes(msg.payload)
                     )
-                    if msg.headers.get("sender_key")
-                    else None,
-                )
-                metrics = self.metrics
-                t0 = time.perf_counter() if metrics is not None else 0.0
-                ctx = tracing.SpanContext.from_traceparent(
-                    msg.headers.get(tracing.TRACEPARENT_HEADER)
-                )
-                sp = (
-                    tracing.get_tracer().start_span(
-                        "p2p.deliver", parent=ctx, topic=topic,
-                        to=self.me.name,
-                    )
-                    if ctx is not None else tracing.NOOP_SPAN
-                )
-                with tracing.activate(sp.context):
-                    for fn in self._handlers.get(topic, []):
+
+                    def task(msg=msg, payload=payload):
                         try:
-                            fn(sender, msg.payload)
-                        except Exception as exc:
-                            # handler errors must not kill the pump, but
-                            # a silently-dropped delivery is exactly the
-                            # evidence a flow hang investigation needs
-                            eventlog.emit(
-                                "error", "p2p",
-                                f"handler error on {topic}",
-                                error=f"{type(exc).__name__}: {exc}",
-                                sender=str(sender),
-                            )
-                    sp.finish()
-                if metrics is not None:
-                    metrics.timer(f"P2P.Handle.{topic}").update(
-                        time.perf_counter() - t0
-                    )
-            if batched:
-                consumer.ack_many(batch)
-            else:
-                consumer.ack(batch[0])
+                            self._handle_msg(msg, payload)
+                        finally:
+                            lane_done.append(msg)
+
+                    try:
+                        lanes.submit(lane_key(hint), task)
+                        in_lanes[0] += 1
+                        continue
+                    except RuntimeError:
+                        pass  # lanes stopped: dispatch inline below
+                self._handle_msg(msg)
+                inline_done.append(msg)
+            try:
+                if inline_done and batched:
+                    consumer.ack_many(inline_done)
+                else:
+                    for m in inline_done:
+                        consumer.ack(m)
+            except BrokerError as exc:
+                if not self._stop.is_set():
+                    raise
+                # shutdown race: stop() closed the consumer between the
+                # receive and this ack — close() already requeued the
+                # batch, redelivery + dedup absorb it
+                eventlog.emit(
+                    "info", "p2p", "ack raced shutdown close",
+                    error=str(exc), count=len(inline_done),
+                )
+        # stopping: in-flight laned continuations get a bounded window to
+        # complete so their messages ack; whatever stays unacked is
+        # requeued by consumer.close() and redelivered (at-least-once)
+        if lanes is not None:
+            deadline = time.monotonic() + 5.0
+            while in_lanes[0] > 0 and time.monotonic() < deadline:
+                self._drain_completions(consumer, lane_done, in_lanes)
+                if in_lanes[0] > 0:
+                    time.sleep(0.01)
+            self._drain_completions(consumer, lane_done, in_lanes)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._lanes is not None:
+            # drain first: in-flight continuations complete and their
+            # messages ack through the pump's exit path; anything the
+            # timeout abandons stays unacked and redelivers after the
+            # consumer close below requeues it
+            self._lanes.stop(drain=True, timeout=10)
+            if self._thread.ident is not None:
+                self._thread.join(timeout=6)
+            for t in self._extra_threads:
+                if t.ident is not None:
+                    t.join(timeout=6)
         self._consumer.close()
         for c in self._extra_consumers:
             c.close()

@@ -118,6 +118,24 @@ class AbstractNode:
             dev_checkpoint_check=config.dev_checkpoint_check,
         )
         self.services._smm = self.smm
+        # Group-committed checkpoints (docs/perf-system.md round 20):
+        # on async transports concurrent flows (lane threads + blocking
+        # pool + RPC workers) write step-checkpoints concurrently, so
+        # their sqlite commits coalesce into one commit cycle per drain
+        # window — each writer still blocks until ITS write committed
+        # (suspend durability unchanged). The deterministic in-memory
+        # transport has no concurrency to coalesce and stays per-op.
+        import os as _os
+
+        if (
+            getattr(self.network, "ASYNC_FLOW_DISPATCH", False)
+            and _os.environ.get("CORDA_TPU_CP_GROUP_COMMIT", "1") != "0"
+        ):
+            self.checkpoint_storage.enable_group_commit(
+                linger_ms=float(
+                    _os.environ.get("CORDA_TPU_CP_LINGER_MS", 0.0)
+                )
+            )
         if hasattr(self.network, "metrics"):
             # per-topic P2P handler timers land in the node's registry
             self.network.metrics = self.smm.metrics
@@ -441,6 +459,55 @@ class AbstractNode:
                 f"Kernel.OpBudget.FieldMulsPerSig{{kernel={kernel}}}",
                 opbudget_gauge(kernel, "field_mul_equiv_per_sig"),
             )
+
+        # bank-side flow hot path (docs/perf-system.md round 20): lane
+        # executor occupancy, vault selection-cache effectiveness, and
+        # checkpoint group-commit coalescing — the three families a
+        # flow-throughput regression triages by
+        lanes = getattr(net, "_lanes", None) or getattr(
+            getattr(net, "network", None), "lane_executor", None
+        )
+        if lanes is not None:
+            self.metrics.gauge("Flows.Lanes", lambda: lanes.n_lanes)
+            self.metrics.gauge(
+                "Flows.LaneDispatched",
+                lambda: lanes.stats()["dispatched"],
+            )
+            self.metrics.gauge(
+                "Flows.LanePending", lambda: lanes.pending()
+            )
+            self.metrics.gauge(
+                "Flows.LaneErrors", lambda: lanes.stats()["errors"]
+            )
+        vault = self.services.vault_service
+        self.metrics.gauge(
+            "Vault.CacheSize", lambda: len(vault._decoded)
+        )
+        self.metrics.gauge(
+            "Vault.CacheHits", lambda: vault.stats["cache_hits"]
+        )
+        self.metrics.gauge(
+            "Vault.CacheDecodes", lambda: vault.stats["decodes"]
+        )
+        self.metrics.gauge(
+            "Vault.CacheGenerationFlushes",
+            lambda: vault.stats["generation_flushes"],
+        )
+
+        def _cp_stat(key: str):
+            def read():
+                snap = self.checkpoint_storage.group_commit_stats
+                return -1.0 if snap is None else snap[key]
+
+            return read
+
+        self.metrics.gauge(
+            "Checkpoint.GroupCommitBatches", _cp_stat("batches")
+        )
+        self.metrics.gauge("Checkpoint.GroupCommitOps", _cp_stat("ops"))
+        self.metrics.gauge(
+            "Checkpoint.GroupCommitMaxBatch", _cp_stat("max_batch")
+        )
 
         # sampling profiler (utils/sampler.py): capture activity for the
         # /profile endpoint and RPC node_profile

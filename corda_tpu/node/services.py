@@ -270,15 +270,61 @@ class VaultService:
     """Unconsumed-state tracker with soft-locking (reference
     NodeVaultService, `node/.../services/vault/NodeVaultService.kt` —
     notifyAll :194, soft locks :321-349). Query DSL lives in
-    corda_tpu.node.vault_query (widened in a later slice)."""
+    corda_tpu.node.vault_query (widened in a later slice).
+
+    Indexed selection (docs/perf-system.md round 20): the original
+    `unconsumed_states`/`unlocked_unconsumed_states` SELECTed and
+    DESERIALIZED every unconsumed blob per query, so coin selection was
+    O(total vault) per payment and degraded quadratically over a soak.
+    Two layers fix it, both bounded and both killable with
+    CORDA_TPU_VAULT_CACHE=0 (the byte-identical legacy path):
+
+      * a decoded `StateAndRef` LRU keyed by (tx_id, index) — state
+        blobs are immutable, so entries never go stale; consumption
+        only evicts them to free memory. notify_all warms it for free
+        (it already holds the decoded TransactionState).
+      * per-contract availability buckets: an insertion-ordered map of
+        unconsumed ref -> soft-lock id, maintained at every consume/
+        lock/release seam in this process and REBUILT from a blob-free
+        SQL scan (ref + lock columns only) whenever `PRAGMA
+        data_version` shows another connection — a sibling worker
+        PROCESS on the shared vault file — wrote the database.
+
+    `iter_unlocked_unconsumed` walks a bucket lazily, so coin selection
+    touches O(selected + in-flight-locked) states instead of O(vault).
+    """
+
+    #: decoded-cache capacity default (CORDA_TPU_VAULT_CACHE overrides;
+    #: 0 disables the cache AND the buckets)
+    CACHE_MAX = 65536
 
     def __init__(self, db: NodeDatabase, is_relevant: Callable,
                  resolve_state: Optional[Callable] = None):
+        import os as _os
+        from collections import OrderedDict as _OrderedDict
+
         self.db = db
         self._is_relevant = is_relevant
         # StateRef -> TransactionState; needed to derive notary-change
         # outputs (wired to ServiceHub.load_state).
         self._resolve_state = resolve_state
+        self._cache_max = int(
+            _os.environ.get("CORDA_TPU_VAULT_CACHE", self.CACHE_MAX)
+        )
+        # all cache/bucket state is guarded by db.lock (reentrant), the
+        # same lock every SQL mutation below already holds — readers
+        # snapshot under it, writers mutate under it post-commit
+        self._decoded: "_OrderedDict[Tuple[bytes, int], StateAndRef]" = (
+            _OrderedDict()
+        )
+        self._avail: Dict[str, dict] = {}  # contract -> {refkey: lock_id}
+        self._data_version: Optional[int] = None
+        # counters for the Vault.Cache* gauges AND the O(selected)
+        # tier-1 proof (decodes must not scale with vault size)
+        self.stats = {
+            "decodes": 0, "cache_hits": 0, "bucket_builds": 0,
+            "generation_flushes": 0,
+        }
         db.execute(
             "CREATE TABLE IF NOT EXISTS vault_states ("
             " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
@@ -297,6 +343,13 @@ class VaultService:
                 db.execute(alter)  # older vaults predate these columns
             except Exception:
                 pass
+        # SQL-side pruning for the cold path: availability scans (bucket
+        # rebuilds, legacy listings) hit this index instead of walking
+        # every row including consumed history
+        db.execute(
+            "CREATE INDEX IF NOT EXISTS vault_states_avail"
+            " ON vault_states(contract_name, consumed)"
+        )
         db.execute(
             "CREATE TABLE IF NOT EXISTS vault_participants ("
             " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
@@ -368,19 +421,127 @@ class VaultService:
             attrs.update(custom())
         return attrs
 
+    # -- decoded cache + availability buckets (guarded by db.lock) ----------
+
+    @property
+    def _indexed(self) -> bool:
+        return self._cache_max > 0
+
+    @staticmethod
+    def _refkey(ref: StateRef) -> Tuple[bytes, int]:
+        return (ref.txhash.bytes, ref.index)
+
+    def _check_generation_locked(self) -> None:
+        """Flush the buckets when ANOTHER connection (a sibling worker
+        process sharing the vault file) wrote the database: sqlite's
+        data_version changes exactly then, and never for our own
+        writes. The decoded cache survives — blobs are immutable."""
+        dv = self.db.query("PRAGMA data_version")[0][0]
+        if self._data_version is None:
+            self._data_version = dv
+        elif dv != self._data_version:
+            self._data_version = dv
+            self._avail.clear()
+            self.stats["generation_flushes"] += 1
+
+    def _bucket_locked(self, contract_name: str) -> dict:
+        bucket = self._avail.get(contract_name)
+        if bucket is None:
+            # blob-free rebuild: refs + lock ids only (the index above
+            # prunes consumed rows server-side); decode stays on-demand
+            bucket = {
+                (bytes(tx_id), idx): lid
+                for tx_id, idx, lid in self.db.query(
+                    "SELECT tx_id, output_index, lock_id FROM vault_states"
+                    " WHERE consumed = 0 AND contract_name = ?"
+                    " ORDER BY rowid",
+                    (contract_name,),
+                )
+            }
+            self._avail[contract_name] = bucket
+            self.stats["bucket_builds"] += 1
+        return bucket
+
+    def _decoded_get_locked(self, key: Tuple[bytes, int]):
+        """Decoded StateAndRef for one ref: LRU hit, or a single-row
+        SELECT + decode (the cold path pays O(1) per TOUCHED state, not
+        a full-vault scan). None when the row vanished."""
+        hit = self._decoded.get(key)
+        if hit is not None:
+            self._decoded.move_to_end(key)
+            self.stats["cache_hits"] += 1
+            return hit
+        rows = self.db.query(
+            "SELECT state_blob FROM vault_states"
+            " WHERE tx_id = ? AND output_index = ?",
+            key,
+        )
+        if not rows:
+            return None
+        sar = StateAndRef(
+            self._decode_blob(rows[0][0]),
+            StateRef(SecureHash(key[0]), key[1]),
+        )
+        self._decoded_put_locked(key, sar)
+        return sar
+
+    def _decode_blob(self, blob):
+        self.stats["decodes"] += 1
+        return deserialize(blob)
+
+    def _decoded_put_locked(self, key, sar) -> None:
+        self._decoded[key] = sar
+        self._decoded.move_to_end(key)
+        while len(self._decoded) > self._cache_max:
+            self._decoded.popitem(last=False)
+
+    def _evict_locked(self, key: Tuple[bytes, int]) -> None:
+        """A ref left the available set (consumed): drop it from every
+        bucket and free its decoded entry."""
+        for bucket in self._avail.values():
+            bucket.pop(key, None)
+        self._decoded.pop(key, None)
+
+    def _bucket_add_locked(self, contract_name: str, key, sar) -> None:
+        """A relevant output committed: warm the decoded cache (the
+        ingest already holds the decoded state) and append to the
+        contract's bucket IF it is materialized (an unbuilt bucket
+        rebuilds lazily from SQL and picks the row up then)."""
+        self._decoded_put_locked(key, sar)
+        bucket = self._avail.get(contract_name)
+        if bucket is not None:
+            bucket[key] = None
+
+    def _bucket_set_lock_locked(self, key, lock_id: Optional[str]) -> None:
+        for bucket in self._avail.values():
+            if key in bucket:
+                bucket[key] = lock_id
+                return
+
     # -- updates from committed transactions --------------------------------
 
     def notify_all(self, txs) -> None:
         """Ingest committed transactions: consume inputs, add relevant
         outputs (reference notifyAll)."""
+        produced, consumed = [], []
+        # one commit for the whole ingest (consume updates + state +
+        # participant + attribute rows across all txs); observers fire
+        # after the batch commits, outside the lock. The outer db.lock
+        # (reentrant) keeps the post-commit cache maintenance atomic
+        # with the commit w.r.t. every bucket reader: no window where a
+        # committed state is invisible to coin selection.
+        with self.db.lock:
+            self._notify_all_locked(txs, produced, consumed)
+        if produced or consumed:
+            for obs in list(self._observers):
+                obs(produced, consumed)
+
+    def _notify_all_locked(self, txs, produced, consumed) -> None:
         from ..core.transactions.notary_change import (
             NotaryChangeWireTransaction,
         )
 
-        produced, consumed = [], []
-        # one commit for the whole ingest (consume updates + state +
-        # participant + attribute rows across all txs); observers fire
-        # after the batch commits, outside the lock
+        cache_ops: List[Tuple] = []  # ordered: consumes/produces interleave
         with self.db.transaction():
             for stx in txs:
                 wtx = stx.tx
@@ -391,6 +552,7 @@ class VaultService:
                         (ref.txhash.bytes, ref.index),
                     )
                     consumed.append(ref)
+                    cache_ops.append(("consume", self._refkey(ref), None, None))
                 if isinstance(wtx, NotaryChangeWireTransaction):
                     outputs = wtx.resolve_outputs(self._resolve_state)
                 else:
@@ -432,10 +594,21 @@ class VaultService:
                                 value if is_num else None,
                             ),
                         )
-                    produced.append(StateAndRef(ts, ref))
-        if produced or consumed:
-            for obs in list(self._observers):
-                obs(produced, consumed)
+                    sar = StateAndRef(ts, ref)
+                    produced.append(sar)
+                    cache_ops.append((
+                        "produce", self._refkey(ref),
+                        ts.data.contract_name, sar,
+                    ))
+        # post-commit, still under db.lock: apply the ordered bucket/
+        # cache ops (an output produced then consumed by a later tx in
+        # the SAME batch must end up evicted, so order matters)
+        if self._indexed:
+            for op, key, contract, sar in cache_ops:
+                if op == "consume":
+                    self._evict_locked(key)
+                else:
+                    self._bucket_add_locked(contract, key, sar)
 
     def track(self, observer: Callable) -> None:
         """observer(produced: [StateAndRef], consumed: [StateRef])."""
@@ -455,11 +628,31 @@ class VaultService:
             sql += " AND contract_name = ?"
             params = (contract_name,)
         out = []
+        # decodes run OUTSIDE the db lock (a cold-cache full listing
+        # must not convoy checkpoint writers / vault ingest behind a
+        # whole-vault deserialize pass); only the cache probe/insert
+        # takes it, briefly per row
         for tx_id, idx, blob in self.db.query(sql, params):
-            ts = deserialize(blob)
-            if state_type is not None and not isinstance(ts.data, state_type):
+            key = (bytes(tx_id), idx)
+            sar = None
+            if self._indexed:
+                with self.db.lock:
+                    sar = self._decoded.get(key)
+                    if sar is not None:
+                        self._decoded.move_to_end(key)
+                        self.stats["cache_hits"] += 1
+            if sar is None:
+                ts = deserialize(blob)
+                sar = StateAndRef(ts, StateRef(SecureHash(tx_id), idx))
+                with self.db.lock:
+                    self.stats["decodes"] += 1
+                    if self._indexed:
+                        self._decoded_put_locked(key, sar)
+            if state_type is not None and not isinstance(
+                sar.state.data, state_type
+            ):
                 continue
-            out.append(StateAndRef(ts, StateRef(SecureHash(tx_id), idx)))
+            out.append(sar)
         return out
 
     def query(self, criteria=None, paging=None, sort=None):
@@ -515,12 +708,20 @@ class VaultService:
         return page, matches
 
     def load_state(self, ref: StateRef) -> Optional[TransactionState]:
-        rows = self.db.query(
-            "SELECT state_blob FROM vault_states "
-            "WHERE tx_id = ? AND output_index = ?",
-            (ref.txhash.bytes, ref.index),
-        )
-        return deserialize(rows[0][0]) if rows else None
+        key = self._refkey(ref)
+        with self.db.lock:
+            if self._indexed:
+                hit = self._decoded.get(key)
+                if hit is not None:
+                    self._decoded.move_to_end(key)
+                    self.stats["cache_hits"] += 1
+                    return hit.state
+            rows = self.db.query(
+                "SELECT state_blob FROM vault_states "
+                "WHERE tx_id = ? AND output_index = ?",
+                key,
+            )
+            return self._decode_blob(rows[0][0]) if rows else None
 
     # -- soft locking (in-flight spend reservation) --------------------------
 
@@ -569,6 +770,10 @@ class VaultService:
                     if cur.rowcount == 1:
                         taken.append(ref)
                         won = True
+                        if self._indexed:
+                            self._bucket_set_lock_locked(
+                                self._refkey(ref), lock_id
+                            )
                         break
                     rows = self.db.query(
                         "SELECT lock_id, consumed FROM vault_states "
@@ -600,6 +805,10 @@ class VaultService:
                         "WHERE tx_id = ? AND output_index = ? AND lock_id = ?",
                         (prev.txhash.bytes, prev.index, lock_id),
                     )
+                    if self._indexed:
+                        self._bucket_set_lock_locked(
+                            self._refkey(prev), None
+                        )
                 if not rows or rows[0][1]:
                     raise StatesNotAvailableError(f"{ref} not unconsumed")
                 if rows[0][0] is None:
@@ -623,17 +832,21 @@ class VaultService:
         record. Returns the refs actually flipped (already-consumed rows
         are idempotent no-ops)."""
         flipped: List[StateRef] = []
-        with self.db.transaction():  # holds db.lock (reentrant)
-            for ref in refs:
-                cur = self.db.execute(
-                    "UPDATE vault_states SET consumed = 1, "
-                    "lock_id = NULL "
-                    "WHERE tx_id = ? AND output_index = ? "
-                    "AND consumed = 0",
-                    (ref.txhash.bytes, ref.index),
-                )
-                if cur.rowcount == 1:
-                    flipped.append(ref)
+        with self.db.lock:
+            with self.db.transaction():  # holds db.lock (reentrant)
+                for ref in refs:
+                    cur = self.db.execute(
+                        "UPDATE vault_states SET consumed = 1, "
+                        "lock_id = NULL "
+                        "WHERE tx_id = ? AND output_index = ? "
+                        "AND consumed = 0",
+                        (ref.txhash.bytes, ref.index),
+                    )
+                    if cur.rowcount == 1:
+                        flipped.append(ref)
+            if self._indexed:  # post-commit, still under db.lock
+                for ref in flipped:
+                    self._evict_locked(self._refkey(ref))
         if flipped:
             for obs in list(self._observers):
                 obs([], list(flipped))
@@ -646,35 +859,97 @@ class VaultService:
                     "UPDATE vault_states SET lock_id = NULL WHERE lock_id = ?",
                     (lock_id,),
                 )
+                if self._indexed:
+                    # exception-path-only full clear: every bucket entry
+                    # held under this lock id becomes available again
+                    for bucket in self._avail.values():
+                        for key, lid in bucket.items():
+                            if lid == lock_id:
+                                bucket[key] = None
             else:
                 for ref in refs:
-                    self.db.execute(
+                    cur = self.db.execute(
                         "UPDATE vault_states SET lock_id = NULL "
                         "WHERE tx_id = ? AND output_index = ? AND lock_id = ?",
                         (ref.txhash.bytes, ref.index, lock_id),
                     )
+                    if self._indexed and cur.rowcount == 1:
+                        self._bucket_set_lock_locked(self._refkey(ref), None)
 
     def unlocked_unconsumed_states(
         self, contract_name: Optional[str] = None, lock_id: Optional[str] = None,
     ) -> List[StateAndRef]:
         """States available for spending: unconsumed and not soft-locked by
         another flow."""
-        sql = (
-            "SELECT tx_id, output_index, state_blob, lock_id FROM vault_states"
-            " WHERE consumed = 0"
-        )
-        params: Tuple = ()
-        if contract_name is not None:
-            sql += " AND contract_name = ?"
-            params = (contract_name,)
-        out = []
-        for tx_id, idx, blob, lid in self.db.query(sql, params):
-            if lid is not None and lid != lock_id:
-                continue
-            out.append(
-                StateAndRef(deserialize(blob), StateRef(SecureHash(tx_id), idx))
+        return list(self.iter_unlocked_unconsumed(contract_name, lock_id))
+
+    #: availability-bucket walk width: candidates snapshotted per lock
+    #: acquisition (a partial pick holds the lock O(chunk), not O(vault))
+    ITER_CHUNK = 64
+
+    def iter_unlocked_unconsumed(
+        self, contract_name: Optional[str] = None,
+        lock_id: Optional[str] = None,
+    ) -> "Iterable[StateAndRef]":
+        """Lazily yield spendable states (unconsumed, not soft-locked by
+        another flow) in recorded order. Coin selection consumes this
+        generator until the target is gathered, touching O(selected +
+        in-flight-locked) states — the subsequent `soft_lock_reserve`
+        CAS stays the authority, so a stale candidate costs a retry,
+        never a double-spend. Falls back to the legacy full-scan when
+        the cache is disabled or no contract filter is given."""
+        if not self._indexed or contract_name is None:
+            sql = (
+                "SELECT tx_id, output_index, state_blob, lock_id"
+                " FROM vault_states WHERE consumed = 0"
             )
-        return out
+            params: Tuple = ()
+            if contract_name is not None:
+                sql += " AND contract_name = ?"
+                params = (contract_name,)
+            for tx_id, idx, blob, lid in self.db.query(sql, params):
+                if lid is not None and lid != lock_id:
+                    continue
+                yield StateAndRef(
+                    self._decode_blob(blob), StateRef(SecureHash(tx_id), idx)
+                )
+            return
+        # Cursorless chunking: each round re-scans the bucket FROM THE
+        # START, skipping keys already handed out — a positional cursor
+        # would silently skip still-available states whenever a
+        # concurrent consume evicted entries behind it (the dict shifts
+        # left). Cost per round is O(|seen| + chunk), so an early-exit
+        # caller (coin selection) stays O(selected + in-flight-locked);
+        # the chunk doubles per round so a full exhaustion costs
+        # O(V log V) dict steps, not O(V^2).
+        seen = set()
+        chunk_size = self.ITER_CHUNK
+        while True:
+            with self.db.lock:
+                self._check_generation_locked()
+                bucket = self._bucket_locked(contract_name)
+                fresh = []
+                for key, lid in bucket.items():
+                    if key in seen:
+                        continue
+                    fresh.append((key, lid))
+                    if len(fresh) >= chunk_size:
+                        break
+            if not fresh:
+                return
+            chunk_size = min(chunk_size * 2, 4096)
+            for key, lid in fresh:
+                # mark even the filtered-out keys: a later round must
+                # not re-visit a still-locked entry
+                seen.add(key)
+                if lid is not None and lid != lock_id:
+                    continue
+                # decode PER CONSUMED ITEM, not per chunk: a caller that
+                # stops after one state pays one decode
+                with self.db.lock:
+                    sar = self._decoded_get_locked(key)
+                if sar is not None:
+                    yield sar
 
 
 class StatesNotAvailableError(Exception):

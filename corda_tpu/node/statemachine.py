@@ -977,12 +977,22 @@ class StateMachineManager:
     # -- session message routing --------------------------------------------
 
     def _on_session_message(self, sender: Party, payload: bytes) -> None:
-        """Runs INLINE on the messaging pump: the broker acks a message
-        only after its handler returns, so processing must complete here
-        for at-least-once delivery (an executor hand-off acked messages
-        before flows ran — lost on crash). Long blocking work inside a
-        flow goes through `FlowLogic.await_blocking`, which parks the
-        flow and runs the work off-pump instead."""
+        """Runs on the delivering transport thread: the p2p pump itself,
+        or — with CORDA_TPU_FLOW_LANES > 0 — a flow-lane thread with
+        per-flow affinity (node/flowlanes.py). Either way the broker
+        acks a message only after its handler chain returns (the lane
+        path defers the ack to completion), so processing completing
+        here preserves at-least-once delivery — an ack-then-process
+        hand-off would lose messages on crash. Long blocking work inside
+        a flow goes through `FlowLogic.await_blocking`, which parks the
+        flow and runs the work off-pump instead.
+
+        Lane concurrency: messages of one session (and one flow) always
+        land on one lane (affinity on the route hint's flow id), so the
+        per-session mutations below stay ordered; cross-thread state
+        against the flow's OWN steps (blocking executor, RPC threads) is
+        serialized by each FSM's step lock, which the _on_* handlers
+        take before touching session state."""
         msg = deserialize(payload)
         if isinstance(msg, SessionInit):
             self._on_init(sender, msg)
@@ -1050,59 +1060,66 @@ class StateMachineManager:
         fsm = self._sessions.get(msg.initiator_session_id)
         if fsm is None:
             return
-        sess = fsm.sessions.get(msg.initiator_session_id)
-        if sess is None or sess.state is not SessionState.INITIATING:
-            return  # duplicate confirm
-        sess.state = SessionState.INITIATED
-        sess.peer_id = msg.initiated_session_id
-        # Flush sends buffered while the handshake was in flight.  seq 0 may
-        # have ridden the init itself (send_seq started at 1).
-        start_seq = sess.send_seq - len(sess.outbox)
-        for i, blob in enumerate(sess.outbox):
-            self._send_session_message(
-                sess.peer, SessionData(sess.peer_id, start_seq + i, blob)
-            )
-        # Keep outbox[0] around only while INITIATING for init re-sends; once
-        # confirmed, the data is delivered and the buffer can go.
-        sess.outbox.clear()
-        fsm._checkpoint()
+        # step lock: the confirm mutates session state and checkpoints,
+        # racing the flow's own steps on the blocking executor (and, with
+        # lanes, running off the single pump thread)
+        with fsm._step_lock:
+            sess = fsm.sessions.get(msg.initiator_session_id)
+            if sess is None or sess.state is not SessionState.INITIATING:
+                return  # duplicate confirm
+            sess.state = SessionState.INITIATED
+            sess.peer_id = msg.initiated_session_id
+            # Flush sends buffered while the handshake was in flight.  seq 0
+            # may have ridden the init itself (send_seq started at 1).
+            start_seq = sess.send_seq - len(sess.outbox)
+            for i, blob in enumerate(sess.outbox):
+                self._send_session_message(
+                    sess.peer, SessionData(sess.peer_id, start_seq + i, blob)
+                )
+            # Keep outbox[0] around only while INITIATING for init re-sends;
+            # once confirmed, the data is delivered and the buffer can go.
+            sess.outbox.clear()
+            fsm._checkpoint()
 
     def _on_reject(self, sender: Party, msg: SessionReject) -> None:
         fsm = self._sessions.get(msg.initiator_session_id)
         if fsm is None:
             return
-        sess = fsm.sessions.get(msg.initiator_session_id)
-        if sess is None:
-            return
-        sess.state = SessionState.ENDED
-        sess.ended_by_peer = True
-        sess.end_error = msg.error
-        fsm.deliver_session_end(sess)
+        with fsm._step_lock:
+            sess = fsm.sessions.get(msg.initiator_session_id)
+            if sess is None:
+                return
+            sess.state = SessionState.ENDED
+            sess.ended_by_peer = True
+            sess.end_error = msg.error
+            fsm._deliver_session_end_locked(sess)
 
     def _on_data(self, sender: Party, msg: SessionData) -> None:
         fsm = self._sessions.get(msg.recipient_session_id)
         if fsm is None:
             return
-        sess = fsm.sessions.get(msg.recipient_session_id)
-        if sess is None:
-            return
-        if msg.seq < sess.recv_seq or msg.seq in sess.inbox:
-            return  # duplicate (re-send after restore)
-        sess.inbox[msg.seq] = msg.payload
-        fsm.deliver_data(sess)
+        with fsm._step_lock:
+            sess = fsm.sessions.get(msg.recipient_session_id)
+            if sess is None:
+                return
+            if msg.seq < sess.recv_seq or msg.seq in sess.inbox:
+                return  # duplicate (re-send after restore)
+            sess.inbox[msg.seq] = msg.payload
+            fsm._deliver_data_locked(sess)
 
     def _on_end(self, sender: Party, msg: SessionEnd) -> None:
         fsm = self._sessions.get(msg.recipient_session_id)
         if fsm is None:
             return
-        sess = fsm.sessions.get(msg.recipient_session_id)
-        if sess is None:
-            return
-        sess.ended_by_peer = True
-        sess.end_error = msg.error
-        if sess.recv_seq not in sess.inbox:
-            sess.state = SessionState.ENDED
-        fsm.deliver_session_end(sess)
+        with fsm._step_lock:
+            sess = fsm.sessions.get(msg.recipient_session_id)
+            if sess is None:
+                return
+            sess.ended_by_peer = True
+            sess.end_error = msg.error
+            if sess.recv_seq not in sess.inbox:
+                sess.state = SessionState.ENDED
+            fsm._deliver_session_end_locked(sess)
 
     # -- internals ----------------------------------------------------------
 

@@ -181,11 +181,20 @@ def make_raft_commit_group(n_replicas: int = 3, seed_base: int = 0):
 
 
 class MockNetwork:
-    def __init__(self, default_clock=None):
+    def __init__(self, default_clock=None, flow_lanes: int = 0):
         """default_clock: shared zero-arg clock for all nodes (a TestClock
         makes the whole network deterministic, reference Simulation style);
-        None -> real time per node."""
+        None -> real time per node.
+
+        flow_lanes: OPT-IN multi-lane continuation dispatch on the
+        in-memory transport (node/flowlanes.py) — session messages run
+        their handlers on N lane threads with per-flow affinity, and
+        run_network() barriers on lane quiescence. The default (0) keeps
+        the transport fully inline/deterministic, like
+        `dispatches_blocking_off_pump` defaults off in-memory."""
         self.messaging_network = InMemoryMessagingNetwork()
+        if flow_lanes:
+            self.messaging_network.enable_flow_lanes(flow_lanes)
         self.nodes: List[MockNode] = []
         self._entropy = 1000
         self.default_clock = default_clock
@@ -562,3 +571,6 @@ class MockNetwork:
         for node in self.nodes:
             node.stop()
         self.nodes.clear()
+        lanes = self.messaging_network.lane_executor
+        if lanes is not None:
+            lanes.stop(drain=False, timeout=2)
