@@ -409,6 +409,55 @@ def _bls_aggregate_stage(n: int = 64) -> dict:
     return measure_bls_aggregate_ab(n=n)
 
 
+def _mesh_scaling_stage(on_tpu: bool, ns=(0, 1, 2, 4, 8),
+                        rows: int = 256) -> dict:
+    """The mesh scaling curve: `mesh_sigs_s{n=N}` for each point, one
+    SUBPROCESS per N (docs/perf-pipeline.md mesh stage).
+
+    A subprocess per point is structural, not caution: the forced host
+    device count (--xla_force_host_platform_device_count) binds when the
+    CPU backend first initializes, so one process cannot measure n=2 and
+    n=8 — the same reason tools/tune_kernel.py sweeps configs out of
+    process. n=0 is the all-off comparator (CORDA_TPU_MESH_DEVICES=0):
+    the same rows through today's single-device ops path, beside the
+    sharded points so the curve reads against the kill switch. Points
+    ride stage_timings, so the regression gate direction-classifies them
+    (higher-is-better, the `{n=...}` label stripped by gate.direction)."""
+    import re as _re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    for n in ns:
+        env = dict(os.environ)
+        env["CORDA_TPU_MESH_DEVICES"] = str(n)
+        if not on_tpu:
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", ""),
+            ).strip()
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={max(n, 1)}"
+            ).strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        key = f"mesh_sigs_s{{n={n}}}"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "corda_tpu.parallel.mesh",
+                 "--bench", "--devices", str(n), "--rows", str(rows),
+                 "--repeats", "2"],
+                capture_output=True, text=True, timeout=600,
+                env=env, cwd=here,
+            )
+            rec = json.loads(proc.stdout.splitlines()[-1])
+            out[key] = rec["sigs_s"]
+        except Exception as exc:  # one dead point must not sink the curve
+            out[f"mesh_stage_error{{n={n}}}"] = (
+                f"{type(exc).__name__}: {exc}"
+            )
+    return out
+
+
 def _secondary_rates(on_tpu: bool, rng) -> dict:
     """ECDSA-P256 and mixed-scheme throughput via the production
     `core.crypto.batch.verify_batch` dispatch (scheme bucketing)."""
@@ -611,6 +660,14 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         lane_ab = {"flow_lane_error": f"{type(exc).__name__}: {exc}"}
 
+    # Mesh-sharded dispatch scaling curve (docs/perf-pipeline.md): the
+    # `mesh_sigs_s{n=...}` points, one virtual-device subprocess per N,
+    # with the CORDA_TPU_MESH_DEVICES=0 comparator at n=0.
+    try:
+        mesh_curve = _mesh_scaling_stage(on_tpu)
+    except Exception as exc:
+        mesh_curve = {"mesh_stage_error": f"{type(exc).__name__}: {exc}"}
+
     # device-dispatch telemetry accumulated across the whole secondary
     # run (the same recorder the ops endpoint's Jax.* gauges read)
     from corda_tpu.utils import profiling
@@ -670,6 +727,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "flow_lane_pairs_s": lane_ab.get("flow_lane_pairs_s"),
         "flow_lane_sync_pairs_s": lane_ab.get("flow_lane_sync_pairs_s"),
     }
+    stage_timings.update(mesh_curve)
     out = {
         "uniq_batch_n_tx": uniq["n_tx"],
         "uniq_raft_p50_ms": uniq["raft_p50_ms"],

@@ -198,6 +198,13 @@ _ENTRIES = [
        "pipeline ring size: batches in flight across all stages"),
     _k("CORDA_TPU_PIPELINE_DONATE", "1", "docs/perf-pipeline.md",
        "0 disables device input-buffer donation on the split dispatch"),
+    # -- mesh-sharded dispatch (this PR) --------------------------------------
+    _k("CORDA_TPU_MESH_DEVICES", "0", "docs/perf-pipeline.md",
+       ">0 swaps the pipeline's dispatch stage for the mesh dispatcher: "
+       "each batch is sharded across this many local devices"),
+    _k("CORDA_TPU_MESH_WORKER_SLOT", "unset", "docs/perf-pipeline.md",
+       "slot k of M co-located verifier workers pins the disjoint device "
+       "slice [k*n, (k+1)*n) (unset = first n local devices)"),
     _k("CORDA_TPU_BATCHER_MAX", "4096", "docs/perf-system.md",
        "verifier signature batcher max batch size"),
     _k("CORDA_TPU_BATCHER_LINGER_MS", "2.0", "docs/perf-system.md",

@@ -178,7 +178,7 @@ _pinned_rule: str | None = None  # "cofactorless" | "cofactored"
 _RULE_LOCK = lockorder.make_lock("batch._RULE_LOCK")
 
 
-def _ed25519_rule() -> str:
+def _ed25519_rule(use_device: bool | None = None) -> str:
     global _pinned_rule
     if _pinned_rule is None:
         # locked: verify_batch runs concurrently (batcher linger timer +
@@ -186,7 +186,8 @@ def _ed25519_rule() -> str:
         # different rules — the split this latch exists to prevent
         with _RULE_LOCK:
             if _pinned_rule is None:
-                if _use_device_kernels():
+                if use_device if use_device is not None \
+                        else _use_device_kernels():
                     _pinned_rule = "cofactorless"
                 else:
                     # the cofactored rule needs the native MSM engine: a
@@ -364,12 +365,18 @@ class BatchPlan:
         "prepared",       # scheme name -> (kernel kwargs, n) [split route]
         "ed_prehash",     # (rows, (good, hs)) from host_batch.prehash_rows
         "pending",        # (kernel, idx, device mask, t0) to materialise
+        "mesh",           # per-plan mesh override (MeshDispatcher stage)
+        "mesh_min_batch",  # per-plan mesh threshold override
+        "mesh_totals",    # scheme kind -> psum'd mesh-wide valid count
+        "mesh_failed",    # an explicit plan mesh failed this dispatch
     )
 
 
 def plan_batch(
     items: Sequence[Tuple[PublicKey, bytes, bytes]],
     split_device: bool = False,
+    mesh=None,
+    mesh_min_batch: int | None = None,
 ) -> BatchPlan:
     """Phase 1 — decode/parse: flatten composites and bucket every flat
     row by scheme and engine. Pure host work, no hashing, no device.
@@ -379,10 +386,21 @@ def plan_batch(
     deferred materialisation on collect). Only the pipeline engine sets
     it — the sequential composition keeps today's exact call graph
     (ops.ed25519_verify_batch whole in the dispatch phase), so
-    CORDA_TPU_PIPELINE=0 is byte-identical to the pre-pipeline path."""
+    CORDA_TPU_PIPELINE=0 is byte-identical to the pre-pipeline path.
+
+    ``mesh``: per-plan device-mesh override for the dispatch phase (the
+    pipeline's MeshDispatcher stage sets it; see docs/perf-pipeline.md).
+    Unlike the process-global `configure_mesh`, the override routes ONLY
+    this plan's buckets, with its own `mesh_min_batch` threshold
+    (default MESH_MIN_BATCH). With both left None the plan is bit-for-bit
+    the pre-mesh plan — the kill switch reproduces today's call graph."""
     plan = BatchPlan()
     plan.items = items
     plan.split_device = split_device
+    plan.mesh = mesh
+    plan.mesh_min_batch = mesh_min_batch
+    plan.mesh_totals = {}
+    plan.mesh_failed = False
     n = len(items)
     plan.results = [False] * n
     plan.flat = []
@@ -410,8 +428,14 @@ def plan_batch(
 
     flat = plan.flat
     plan.flat_results = [False] * len(flat)
-    plan.use_device = _use_device_kernels()
-    plan.rule = _ed25519_rule()  # pinned for the process on first dispatch
+    # an explicit per-plan mesh is the same deliberate opt-in as a
+    # configured global mesh: it routes this plan's buckets to device
+    # kernels even on a CPU backend (the fake-device bit-identity runs)
+    plan.use_device = _use_device_kernels() or mesh is not None
+    # pinned for the process on first dispatch; the plan's own engine
+    # choice is the hint so a mesh-dispatching pipeline pins the same
+    # cofactorless rule configure_mesh would
+    plan.rule = _ed25519_rule(plan.use_device)
     # the device kernels are cofactorless: a process pinned to the
     # cofactored rule (it started host-side) must keep ed25519 off them
     # even if the engine choice later flips to device
@@ -449,6 +473,13 @@ def plan_batch(
         idx = plan.buckets[name]
         if len(idx) >= MIN_DEVICE_BATCH:
             continue
+        if _mesh_would_serve(idx, mesh, mesh_min_batch):
+            # the mesh shards this bucket itself at dispatch: its own
+            # threshold (mesh_min_batch / MESH_MIN_BATCH) is the floor,
+            # not the single-device MIN_DEVICE_BATCH — pruning here
+            # would silently unroute a bucket the dispatcher promised
+            # to shard
+            continue
         del plan.buckets[name]
         # Undersized ECDSA buckets ride the native engine when
         # available (one ECDSA rule everywhere, so this is purely a
@@ -478,10 +509,17 @@ def plan_batch(
     return plan
 
 
-def _mesh_would_serve(idx) -> bool:
+def _mesh_would_serve(idx, mesh=None, min_batch: int | None = None) -> bool:
     """Mirror of the dispatch-phase mesh routing condition, consulted at
     prehash time so the split host prep isn't wasted on a bucket the
-    mesh will shard itself (shard_verify runs its own prepare)."""
+    mesh will shard itself (shard_verify runs its own prepare).
+
+    With an explicit per-plan `mesh` (the MeshDispatcher stage) the
+    process-global mesh and its failure latch are irrelevant: the
+    dispatcher owns its own latch and threshold."""
+    if mesh is not None:
+        floor = MESH_MIN_BATCH if min_batch is None else min_batch
+        return len(idx) >= floor
     return (
         _MESH is not None
         and not _mesh_failed_once
@@ -519,7 +557,11 @@ def prehash_plan(plan: BatchPlan) -> BatchPlan:
     idx = plan.buckets.get(ed_name)
     if (
         idx is not None and plan.split_device
-        and not _mesh_would_serve(idx) and _ed25519_split_route()
+        and not _mesh_would_serve(
+            idx, getattr(plan, "mesh", None),
+            getattr(plan, "mesh_min_batch", None),
+        )
+        and _ed25519_split_route()
     ):
         from ... import ops
 
@@ -555,26 +597,41 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
     global _mesh_failed_once
     flat = plan.flat
     results = plan.flat_results
+    plan_mesh = getattr(plan, "mesh", None)
+    plan_min = getattr(plan, "mesh_min_batch", None)
     for name, idx in plan.buckets.items():
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
         mask = None
-        if _mesh_would_serve(idx):
+        if _mesh_would_serve(idx, plan_mesh, plan_min):
             from ...parallel.mesh import shard_verify
 
             pubs = [flat[i][0].encoded for i in idx]
             sigs = [flat[i][1] for i in idx]
             msgs = [flat[i][2] for i in idx]
             scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
+            mesh = plan_mesh if plan_mesh is not None else _MESH
             try:
-                mask = shard_verify(_MESH, scheme_kind, pubs, sigs, msgs)
+                mask, total = shard_verify(
+                    mesh, scheme_kind, pubs, sigs, msgs, return_total=True
+                )
+                # the psum'd mesh-wide valid count, preserved for the
+                # notary's uniqueness pre-check (docs/perf-pipeline.md)
+                plan.mesh_totals[scheme_kind] = (
+                    plan.mesh_totals.get(scheme_kind, 0) + total
+                )
             except Exception:
                 # a mesh-path failure (e.g. Pallas-under-shard_map
                 # lowering) must not sink verification: fall through to
                 # the single-device path, which has its own degradation
                 # ladder down to the portable XLA kernel. Latched so a
                 # deterministic failure costs one attempt, not one per
-                # bucket (configure_mesh resets the latch).
-                _mesh_failed_once = True
+                # bucket (configure_mesh resets the latch; an explicit
+                # per-plan mesh latches its OWN dispatcher via
+                # plan.mesh_failed, never the process-global flag).
+                if plan_mesh is not None:
+                    plan.mesh_failed = True
+                else:
+                    _mesh_failed_once = True
                 import logging
 
                 logging.getLogger(__name__).exception(

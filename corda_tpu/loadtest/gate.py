@@ -51,8 +51,14 @@ _LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")
 
 
 def direction(key: str) -> Optional[str]:
-    """'lower' / 'higher' (= which way is better) or None (not gated)."""
+    """'lower' / 'higher' (= which way is better) or None (not gated).
+
+    A trailing ``{label=value}`` suffix (the labelled-gauge convention,
+    docs/observability.md) is stripped before classification, so the
+    mesh scaling-curve keys ``mesh_sigs_s{n=4}`` gate exactly like
+    ``mesh_sigs_s``."""
     k = key.rsplit(".", 1)[-1].lower()
+    k = re.sub(r"\{[^{}]*\}$", "", k)
     if _HIGHER.search(k):
         return "higher"
     if _LOWER.search(k) or _LOWER_HINT.search(k):
@@ -183,6 +189,56 @@ def load_bench_record(path: str) -> Dict:
     if not isinstance(data, dict):
         raise ValueError(f"{path}: not a bench record")
     return data
+
+
+#: structured provenance line dryrun_multichip prints into the tail the
+#: driver captures (see __graft_entry__.py)
+_MULTICHIP_JSON = re.compile(r"^MULTICHIP_JSON: (\{.*\})\s*$", re.M)
+#: legacy prose-only tails: "(8192 sigs = 1024/device in 104s on the
+#: virtual CPU mesh, ...)" — enough to recover the scale throughput
+_MULTICHIP_PROSE = re.compile(
+    r"\((\d+) sigs = \d+/device in (\d+(?:\.\d+)?)s on the virtual CPU"
+)
+
+
+def load_multichip_record(path: str) -> Dict:
+    """A MULTICHIP_r<NN>.json round artifact as a gate-comparable record.
+
+    Three shapes, newest first: a normalized artifact with a ``parsed``
+    block (like BENCH records); a driver capture whose ``tail`` carries
+    the ``MULTICHIP_JSON:`` provenance line (n_devices, parsed backend,
+    env_fingerprint, ``mesh_sigs_s``); or a legacy prose-only tail, from
+    which the production-shape throughput and the virtual-CPU backend
+    are recovered. Either way the result feeds `run_gate` directly, so
+    ``mesh_sigs_s`` direction-classifies (higher-is-better) and
+    cross-box comparisons demote to warnings on fingerprint mismatch."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a multichip record")
+    if isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    record: Dict = {"n_devices": data.get("n_devices"),
+                    "ok": data.get("ok")}
+    tail = data.get("tail") or ""
+    m = _MULTICHIP_JSON.search(tail)
+    if m:
+        try:
+            record.update(json.loads(m.group(1)))
+        except ValueError:
+            pass
+        return record
+    m = _MULTICHIP_PROSE.search(tail)
+    if m:
+        sigs, wall = int(m.group(1)), float(m.group(2))
+        if wall > 0:
+            record["mesh_sigs_s"] = round(sigs / wall, 3)
+    if "virtual CPU" in tail or "host machine features" in tail:
+        # the "... vs host machine features" warning is XLA's CPU
+        # backend talking; a real accelerator round never prints it
+        record["backend"] = "cpu"
+        record["env_fingerprint"] = {"backend": "cpu"}
+    return record
 
 
 _ROUND = re.compile(r"^BENCH_r(\d+)\.json$")

@@ -462,6 +462,109 @@ def fatal_violations(violations: List[Dict]) -> List[Dict]:
     return [v for v in violations if v["kind"] in ("grew", "unpinned")]
 
 
+# -- mesh-wrapped kernel ------------------------------------------------------
+#
+# The mesh dispatch stage (parallel/mesh.py, docs/perf-pipeline.md) wraps
+# the SAME verify kernel in shard_map + a psum — sharding must divide the
+# work, never add to it. Deliberately NOT a _SPECS entry: the registry's
+# names must stay exactly utils/profiling.OPBUDGET_KERNELS (the jax-free
+# gauge source), and the mesh wrapper has no budget of its own — its pin
+# IS the single-device ed25519_xla pin.
+
+def count_mesh_kernel(n_devices: int = 2, per_device: int = 16,
+                      use_cache: bool = True) -> Dict:
+    """Trace the shard_map-wrapped ed25519 verify step and count
+    per-signature costs exactly like `count_kernel`.
+
+    The shard body appears ONCE in the traced jaxpr (shard_map traces
+    per-shard shapes), so normalizing by the PER-SHARD batch gives the
+    cost each device pays per signature — 1:1 comparable with the
+    single-device `ed25519_xla` pin (whose spec traces the same kernel
+    at batch 16)."""
+    cache_key = f"mesh_ed25519_xla:{n_devices}:{per_device}"
+    with _cache_lock:
+        if use_cache and cache_key in _cache:
+            return dict(_cache[cache_key])
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import mesh as mesh_mod
+    from . import field25519 as F
+
+    mesh = mesh_mod.data_mesh(n_devices)
+    _prepare, fn, _specs, _blk = mesh_mod._sharded_step(mesh, "ed25519")
+    B = per_device * n_devices  # global batch: per_device rows per shard
+    s = jax.ShapeDtypeStruct
+    args = (
+        s((B, 16), jnp.uint32), s((B,), jnp.uint32),
+        s((B, 16), jnp.uint32), s((B,), jnp.uint32),
+        s((B, 8), jnp.uint32), s((B, 8), jnp.uint32),
+        s((B,), jnp.bool_),
+    )
+    stats = _count_fn(fn, args, {})
+    cal_stats = _count_fn(
+        F.mul, (s((1, 16), jnp.uint32), s((1, 16), jnp.uint32)), {}
+    )
+    cal_elems = max(cal_stats["mul_elems"] / 1, 1)
+    counts = {
+        "kernel": f"mesh_ed25519_xla{{n={n_devices}}}",
+        "batch": per_device,
+        "n_devices": n_devices,
+        "mul_eqns": stats["mul_eqns"],
+        "u32_mul_elems_per_sig": round(stats["mul_elems"] / per_device, 1),
+        "int_elems_per_sig": round(stats["int_elems"] / per_device, 1),
+        "field_mul_equiv_per_sig": round(
+            stats["mul_elems"] / per_device / cal_elems, 1
+        ),
+        "field_mul_elems": round(cal_elems, 1),
+        "dynamic_loops": stats["dynamic_loops"],
+        "dynamic_update_slice": stats["dus_eqns"],
+        "jax_version": jax.__version__,
+    }
+    with _cache_lock:
+        if use_cache:
+            _cache[cache_key] = dict(counts)
+    return counts
+
+
+def check_mesh_budget(n_devices: int = 2, manifest: Optional[Dict] = None,
+                      tolerance: Optional[float] = None) -> List[Dict]:
+    """Gate the mesh-wrapped kernel against the SINGLE-DEVICE pin: a
+    shard_map wrapping that grows the per-signature multiply count has
+    changed the kernel, not just sharded it. Same violation shape and
+    `fatal_violations` policy as `check_budget`."""
+    if manifest is None:
+        manifest = load_manifest()
+    if tolerance is None:
+        tolerance = float(manifest.get("tolerance", DEFAULT_TOLERANCE))
+    pinned = manifest.get("kernels", {}).get("ed25519_xla")
+    name = f"mesh_ed25519_xla{{n={n_devices}}}"
+    if pinned is None:
+        return [{"kernel": name, "metric": None, "kind": "unpinned",
+                 "pinned": None, "measured": None, "change": None}]
+    counts = count_mesh_kernel(n_devices)
+    out: List[Dict] = []
+    for metric in GATED_METRICS:
+        ref = pinned.get(metric)
+        cur = counts.get(metric)
+        if ref is None or cur is None or ref <= 0:
+            continue
+        change = (cur - ref) / ref
+        if change > tolerance:
+            out.append({
+                "kernel": name, "metric": metric, "kind": "grew",
+                "pinned": ref, "measured": cur,
+                "change": round(change, 4),
+            })
+        elif change < -tolerance:
+            out.append({
+                "kernel": name, "metric": metric, "kind": "improved",
+                "pinned": ref, "measured": cur,
+                "change": round(change, 4),
+            })
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 

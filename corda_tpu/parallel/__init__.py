@@ -11,7 +11,17 @@ data parallelism lives here.
 from .mesh import (
     DistributedVerifier,
     data_mesh,
+    shard_layout,
+    shard_verify,
     shard_verify_ed25519,
+    worker_slot_mesh,
 )
 
-__all__ = ["DistributedVerifier", "data_mesh", "shard_verify_ed25519"]
+__all__ = [
+    "DistributedVerifier",
+    "data_mesh",
+    "shard_layout",
+    "shard_verify",
+    "shard_verify_ed25519",
+    "worker_slot_mesh",
+]

@@ -35,6 +35,28 @@ def data_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     return Mesh(np.array(devices), axis_names=(axis,))
 
 
+def worker_slot_mesh(n_devices: int, slot: int, axis: str = "data"):
+    """A 1-D mesh over worker slot `slot`'s disjoint device slice.
+
+    M co-located workers each pin devices [slot*n, (slot+1)*n) of the
+    local device set, so their kernels never contend for a chip
+    (CORDA_TPU_MESH_WORKER_SLOT in docs/perf-pipeline.md).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if n_devices < 1 or slot < 0:
+        raise ValueError(f"bad worker slot ({slot}) x devices ({n_devices})")
+    devices = jax.devices()
+    lo, hi = slot * n_devices, (slot + 1) * n_devices
+    if len(devices) < hi:
+        raise ValueError(
+            f"worker slot {slot} needs devices [{lo}, {hi}), have "
+            f"{len(devices)}"
+        )
+    return Mesh(np.array(devices[lo:hi]), axis_names=(axis,))
+
+
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
@@ -199,40 +221,63 @@ def _sharded_step(mesh, scheme: str):
     return cached
 
 
+def shard_layout(mesh, scheme: str, n: int):
+    """(per_device, padded, occupancy) for an n-row batch on `mesh`.
+
+    The padding math in one place: each shard gets the same power-of-two
+    bucket (`_bucket_per_device`), the batch pads to `per_device * n_dev`,
+    and `occupancy[k]` is the count of REAL rows shard k carries (the
+    ragged tail leaves trailing shards partially — or fully — padding).
+    """
+    n_dev = mesh.devices.size
+    _, _, _, blk = _sharded_step(mesh, scheme)
+    per_device = _bucket_per_device(_round_up(max(n, 1), n_dev) // n_dev)
+    if _mesh_on_tpu(mesh):
+        per_device = max(per_device, blk)
+    padded = per_device * n_dev
+    occupancy = [
+        max(0, min(per_device, n - k * per_device)) for k in range(n_dev)
+    ]
+    return per_device, padded, occupancy
+
+
 def shard_verify(
     mesh,
     scheme: str,
     public_keys: Sequence[bytes],
     signatures: Sequence[bytes],
     messages: Sequence[bytes],
-) -> np.ndarray:
+    return_total: bool = False,
+):
     """Verify a batch of one scheme sharded across `mesh`; returns bool[n].
 
     `scheme` is a kernel-table key: "ed25519", "secp256k1" or "secp256r1".
     The verdict mask comes back per-shard (P("data")); the psum'd global
-    count stays on device as a cheap all-reduce the caller can block on.
-    The compiled executable is cached per (scheme, mesh, padded shape) —
-    repeated bursts pay zero compilation.
+    count stays on device as a cheap all-reduce the caller can block on —
+    `return_total=True` reads it back as `(mask, total)` so the notary
+    gets the mesh-wide valid count without a host-side re-reduction.
+    Padding rows verify as invalid (prepare_batch's `*_ok` flags are zero
+    off the real batch), so the psum total counts REAL valid rows only
+    and a padding row can never flip a verdict.  The compiled executable
+    is cached per (scheme, mesh, padded shape) — repeated bursts pay zero
+    compilation.
     """
     import jax
     from jax.sharding import NamedSharding
 
     n = len(public_keys)
-    n_dev = mesh.devices.size
-    prepare, fn, specs, blk = _sharded_step(mesh, scheme)
-    per_device = _bucket_per_device(_round_up(max(n, 1), n_dev) // n_dev)
-    if _mesh_on_tpu(mesh):
-        # round each shard up to the Pallas block size so every shard
-        # takes the fast kernel (padding lanes are masked-out work)
-        per_device = max(per_device, blk)
-    padded = per_device * n_dev
+    prepare, fn, specs, _blk = _sharded_step(mesh, scheme)
+    _, padded, _ = shard_layout(mesh, scheme, n)
 
     args, _ = prepare(public_keys, signatures, messages, padded)
     device_args = tuple(
         jax.device_put(a, NamedSharding(mesh, s)) for a, s in zip(args, specs)
     )
-    mask, _total = fn(*device_args)
-    return np.asarray(mask)[:n]
+    mask, total = fn(*device_args)
+    mask = np.asarray(mask)[:n]
+    if return_total:
+        return mask, int(total)
+    return mask
 
 
 def shard_verify_ed25519(
@@ -243,6 +288,88 @@ def shard_verify_ed25519(
 ) -> np.ndarray:
     """Back-compat wrapper: ed25519 via the scheme-generic `shard_verify`."""
     return shard_verify(mesh, "ed25519", public_keys, signatures, messages)
+
+
+# -- scaling-curve microbench -------------------------------------------------
+#
+# `python -m corda_tpu.parallel.mesh --bench --devices N` prints one JSON
+# point of the mesh_sigs_s scaling curve.  bench.py's mesh stage and
+# `tools/tune_kernel.py --mesh-ns` both spawn this in a SUBPROCESS per N:
+# the forced host device count (--xla_force_host_platform_device_count)
+# must be set before the CPU backend first initializes, so the parent
+# sets XLA_FLAGS in the child's env rather than re-initializing its own.
+
+
+def _bench_items(rows: int):
+    from ..core.crypto import ed25519_math
+
+    rng = np.random.default_rng(11)
+    pubs, sigs, msgs = [], [], []
+    for i in range(rows):
+        seed = rng.bytes(32)
+        msg = rng.bytes(48)
+        sig = ed25519_math.sign(seed, msg)
+        if i % 7 == 3:  # a few invalid rows keep the verdict path honest
+            sig = bytes([sig[0] ^ 0xFF]) + sig[1:]
+        pubs.append(ed25519_math.public_from_seed(seed))
+        sigs.append(sig)
+        msgs.append(msg)
+    return pubs, sigs, msgs
+
+
+def microbench(n_devices: int, rows: int = 256, repeats: int = 3) -> dict:
+    """One point of the mesh scaling curve: ed25519 verify throughput at
+    `n_devices` (0 = the all-off comparator, i.e. today's single-device
+    ops path — exactly what CORDA_TPU_MESH_DEVICES=0 dispatches).  The
+    first run pays the XLA compile (excluded); `wall_s` is the best of
+    `repeats` steady-state runs."""
+    import time
+
+    import jax
+
+    pubs, sigs, msgs = _bench_items(rows)
+    if n_devices <= 0:
+        from ..ops import ed25519_batch
+
+        def run():
+            return np.asarray(ed25519_batch.verify_batch(pubs, sigs, msgs))
+    else:
+        mesh = data_mesh(n_devices)
+
+        def run():
+            return shard_verify(mesh, "ed25519", pubs, sigs, msgs)
+
+    mask = run()  # warmup: compile + first dispatch
+    valid = int(np.asarray(mask).sum())
+    best = None
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return {
+        "n_devices": int(max(0, n_devices)),
+        "rows": int(rows),
+        "valid": valid,
+        "backend": jax.default_backend(),
+        "wall_s": round(best, 6),
+        "sigs_s": round(rows / best, 3) if best > 0 else 0.0,
+    }
+
+
+def _bench_main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.parallel.mesh")
+    ap.add_argument("--bench", action="store_true", required=True)
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--rows", type=int, default=256)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+    print(json.dumps(microbench(args.devices, args.rows, args.repeats),
+                     sort_keys=True), flush=True)
+    return 0
 
 
 class DistributedVerifier:
@@ -279,3 +406,9 @@ class DistributedVerifier:
         messages: Sequence[bytes],
     ) -> List[bool]:
         return self.verify("ed25519", public_keys, signatures, messages)
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    import sys
+
+    sys.exit(_bench_main())

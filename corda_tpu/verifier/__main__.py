@@ -74,10 +74,13 @@ def main(argv=None) -> int:
     if int(cfg.get("mesh_devices") or 0) > 0:
         # Shard large signature batches across a device mesh
         # (SURVEY §2.10: pmap/shard_map across the chips of a pod slice).
+        # With CORDA_TPU_MESH_WORKER_SLOT set, slot k of M co-located
+        # verifier processes pins devices [k*n, (k+1)*n) — disjoint
+        # slices, so workers never contend for a chip.
         from ..core.crypto import batch as crypto_batch
-        from ..parallel.mesh import data_mesh
+        from .worker import placement_mesh
 
-        crypto_batch.configure_mesh(data_mesh(int(cfg["mesh_devices"])))
+        crypto_batch.configure_mesh(placement_mesh(int(cfg["mesh_devices"])))
 
     from ..messaging.net import RemoteBroker
     from .worker import VerifierWorker

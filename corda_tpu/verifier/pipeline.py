@@ -78,12 +78,33 @@ def default_depth() -> int:
     return max(1, depth)
 
 
+def mesh_devices() -> int:
+    """The CORDA_TPU_MESH_DEVICES knob: shard the pipeline's dispatch
+    stage across an N-device mesh (0/unset = single-device dispatch,
+    byte-identical to the pre-mesh call graph)."""
+    try:
+        return max(0, int(
+            os.environ.get("CORDA_TPU_MESH_DEVICES", "0") or "0"
+        ))
+    except ValueError:
+        return 0
+
+
 def default_stages() -> Sequence[Stage]:
     """The production stage functions: the staged phase API of
     core.crypto.batch with the split device route opted in (async
-    donated-buffer kernel launches, deferred materialisation)."""
+    donated-buffer kernel launches, deferred materialisation).
+
+    With CORDA_TPU_MESH_DEVICES=N (N > 0) the decode and dispatch stage
+    functions come from a :class:`MeshDispatcher` instead: each plan's
+    dispatch phase shards device buckets across an N-device 1-D data
+    mesh (parallel/mesh.shard_verify), decode/prehash/collect unchanged.
+    The knob at 0 keeps today's exact call graph — the kill switch."""
     from ..core.crypto import batch as crypto_batch
 
+    n = mesh_devices()
+    if n > 0:
+        return MeshDispatcher(n_devices=n).stages()
     return (
         ("decode", lambda items: crypto_batch.plan_batch(
             items, split_device=True
@@ -92,6 +113,185 @@ def default_stages() -> Sequence[Stage]:
         ("dispatch", lambda plan: crypto_batch.dispatch_plan(plan)),
         ("collect", lambda plan: crypto_batch.collect_plan(plan)),
     )
+
+
+class MeshDispatcher:
+    """The mesh-sharded dispatch stage the pipeline was designed for
+    (docs/perf-pipeline.md "Scale-out: the same ring feeds the mesh").
+
+    Owns a 1-D N-device data mesh (built lazily so constructing the
+    stage table never initialises a backend) and injects it per-plan
+    through ``plan_batch(mesh=...)``: the dispatch phase shards each
+    device bucket across the mesh via ``parallel/mesh.shard_verify`` —
+    per-shard donated buffers, ragged tails masked so a padding row can
+    never flip a verdict, and the psum'd mesh-wide valid count
+    preserved on the plan (``plan.mesh_totals``) for the notary.
+    Decode/prehash stay host work feeding all shards; collect gathers
+    exactly as in the single-device pipeline.
+
+    Failure containment is two-level: a shard raising fails only its
+    own batch (the pipeline's stage-isolation contract), and the
+    dispatcher latches ``_failed`` off ``plan.mesh_failed`` so a
+    deterministically broken mesh lowering costs one batch's retry —
+    every later plan routes single-device, like the process-global
+    latch in core.crypto.batch but scoped to this engine.
+
+    Telemetry: ``Mesh.Devices`` (configured width; 0 once latched
+    failed) and ``Mesh.ShardOccupancy{n=k}`` (REAL rows shard k carried
+    in the most recent mesh-routed dispatch — the ragged-tail imbalance
+    view), plus ``valid_total``, the cumulative psum'd valid count.
+    """
+
+    def __init__(self, n_devices: Optional[int] = None,
+                 min_batch: Optional[int] = None, axis: str = "data"):
+        n = n_devices if n_devices is not None else mesh_devices()
+        if n < 1:
+            raise ValueError(f"MeshDispatcher needs >= 1 device, got {n}")
+        self.n_devices = n
+        self.axis = axis
+        if min_batch is None:
+            from ..core.crypto import batch as crypto_batch
+
+            # an explicitly mesh-enabled pipeline shards every
+            # device-sized bucket; the global-mesh default (2048) exists
+            # for opportunistic routing, not for a dedicated stage
+            min_batch = crypto_batch.MIN_DEVICE_BATCH
+        self.min_batch = min_batch
+        self._mesh = None
+        self._failed = False
+        self._lock = lockorder.make_lock("MeshDispatcher._lock")
+        self._shard_occupancy = {}  # shard idx -> real rows, last dispatch
+        self.valid_total = 0  # cumulative psum'd mesh-wide valid count
+        self.dispatches = 0  # mesh-routed dispatch-phase executions
+
+    def _mesh_or_none(self):
+        """The mesh, built on first use; None once latched failed (so
+        plans fall back to the single-device route) or when the local
+        device set cannot satisfy the requested width."""
+        with self._lock:
+            if self._failed:
+                return None
+            if self._mesh is None:
+                from ..parallel import mesh as mesh_mod
+
+                try:
+                    self._mesh = mesh_mod.data_mesh(
+                        self.n_devices, axis=self.axis
+                    )
+                except Exception:
+                    self._failed = True
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "MeshDispatcher: cannot build a %d-device mesh; "
+                        "dispatch stays single-device", self.n_devices,
+                    )
+                    return None
+            return self._mesh
+
+    # -- stage functions ---------------------------------------------------
+
+    def plan(self, items):
+        from ..core.crypto import batch as crypto_batch
+
+        return crypto_batch.plan_batch(
+            items, split_device=True, mesh=self._mesh_or_none(),
+            mesh_min_batch=self.min_batch,
+        )
+
+    def dispatch(self, plan):
+        from ..core.crypto import batch as crypto_batch
+
+        plan = crypto_batch.dispatch_plan(plan)
+        if getattr(plan, "mesh_failed", False):
+            with self._lock:
+                if not self._failed:
+                    self._failed = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "MeshDispatcher: mesh dispatch failed (batch "
+                        "fell back single-device); the mesh stage is "
+                        "latched off for this engine"
+                    )
+        totals = getattr(plan, "mesh_totals", None)
+        if totals:
+            self._record_occupancy(plan)
+        return plan
+
+    def stages(self) -> Sequence[Stage]:
+        """The injectable stage table: decode and dispatch bound to this
+        dispatcher, prehash/collect the stock phase functions."""
+        from ..core.crypto import batch as crypto_batch
+
+        return (
+            ("decode", self.plan),
+            ("prehash", lambda plan: crypto_batch.prehash_plan(plan)),
+            ("dispatch", self.dispatch),
+            ("collect", lambda plan: crypto_batch.collect_plan(plan)),
+        )
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _record_occupancy(self, plan) -> None:
+        from ..core.crypto import batch as crypto_batch
+        from ..core.crypto.schemes import EDDSA_ED25519_SHA512
+        from ..parallel import mesh as mesh_mod
+
+        mesh = self._mesh
+        if mesh is None:
+            return
+        occ: dict = {}
+        for name, idx in plan.buckets.items():
+            kind = (
+                "ed25519"
+                if name == EDDSA_ED25519_SHA512.scheme_code_name
+                else crypto_batch._ECDSA_CURVES.get(name)
+            )
+            if kind not in plan.mesh_totals:
+                continue  # this bucket rode the single-device path
+            try:
+                _, _, per_shard = mesh_mod.shard_layout(
+                    mesh, kind, len(idx)
+                )
+            except Exception:
+                import logging
+
+                # telemetry must never fail a dispatch
+                logging.getLogger(__name__).debug(
+                    "mesh occupancy layout failed for bucket %r",
+                    name, exc_info=True,
+                )
+                continue
+            for k, rows in enumerate(per_shard):
+                occ[k] = occ.get(k, 0) + rows
+        with self._lock:
+            self._shard_occupancy = occ
+            self.valid_total += sum(plan.mesh_totals.values())
+            self.dispatches += 1
+
+    def shard_occupancy(self, shard: int) -> int:
+        with self._lock:
+            return self._shard_occupancy.get(shard, 0)
+
+    @property
+    def devices(self) -> int:
+        """Mesh width for the Mesh.Devices gauge: the configured N, or 0
+        once the dispatcher latched failed (the operator's signal that
+        the mesh stage degraded to single-device dispatch)."""
+        with self._lock:
+            return 0 if self._failed else self.n_devices
+
+    def bind_metrics(self, registry) -> None:
+        """Register the Mesh.* instruments (labelled-name convention,
+        docs/observability.md)."""
+        registry.gauge("Mesh.Devices", lambda: self.devices)
+        registry.gauge("Mesh.ValidTotal", lambda: self.valid_total)
+        for k in range(self.n_devices):
+            registry.gauge(
+                f"Mesh.ShardOccupancy{{n={k}}}",
+                lambda s=k: self.shard_occupancy(s),
+            )
 
 
 class _Job:
@@ -199,6 +399,19 @@ class VerificationPipeline:
                 f"Pipeline.StageWallSeconds{{stage={stage}}}",
                 lambda s=stage: round(self.stage_wall_s(s), 6),
             )
+        dispatcher = self.mesh_dispatcher
+        if dispatcher is not None:
+            dispatcher.bind_metrics(registry)
+
+    @property
+    def mesh_dispatcher(self) -> Optional["MeshDispatcher"]:
+        """The MeshDispatcher owning this engine's dispatch stage, when
+        one was injected (CORDA_TPU_MESH_DEVICES > 0); None otherwise."""
+        for _stage, fn in self.stages:
+            owner = getattr(fn, "__self__", None)
+            if isinstance(owner, MeshDispatcher):
+                return owner
+        return None
 
     # -- submission --------------------------------------------------------
 

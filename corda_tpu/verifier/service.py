@@ -113,11 +113,14 @@ class InMemoryTransactionVerifierService(TransactionVerifierService):
         self._batcher.flush()
 
     def healthcheck(self) -> dict:
+        from .worker import mesh_placement
+
         return {
             "ok": not self._batcher._closed,
             "backend": "in-memory",
             "batcher_occupancy": self._batcher.pending_count,
             "batcher_queued_batches": self._batcher.queued_batches,
+            "mesh": mesh_placement(),
         }
 
     def stop(self) -> None:
@@ -612,6 +615,8 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             fut.set_result(bool(ok))
 
     def healthcheck(self) -> dict:
+        from .worker import mesh_placement
+
         detail = {
             "ok": not self._stop.is_set() and self._thread.is_alive(),
             "backend": "out-of-process",
@@ -620,6 +625,10 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             "breaker": self.breaker.state,
             "breaker_trips": self.breaker.trips,
             "fallback_active": self._fallback is not None,
+            # THIS process's device placement (the in-process fallback
+            # path); each remote worker reports its own slot/slice via
+            # its own healthcheck surface
+            "mesh": mesh_placement(),
         }
         return detail
 

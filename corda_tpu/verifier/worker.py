@@ -22,6 +22,7 @@ ack-after-result discipline, so redelivery semantics are unchanged.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -36,6 +37,55 @@ from .api import (
     VerificationResponse,
 )
 from .batcher import SignatureBatcher
+
+
+def worker_slot() -> Optional[int]:
+    """This process's device-placement slot, or None.
+
+    CORDA_TPU_MESH_WORKER_SLOT is set by whatever spawns M co-located
+    verifier processes (one value per process): slot k of M pins the
+    disjoint device slice [k*n, (k+1)*n) of the local device set, so
+    workers scale across chips without contending for one
+    (docs/perf-pipeline.md, worker placement). Unset/invalid = no slot:
+    the whole local device set, today's behaviour."""
+    raw = os.environ.get("CORDA_TPU_MESH_WORKER_SLOT", "")
+    if not raw:
+        return None
+    try:
+        slot = int(raw)
+    except ValueError:
+        return None
+    return slot if slot >= 0 else None
+
+
+def placement_mesh(n_devices: int):
+    """The n-device mesh this worker process should verify on: its
+    slot's disjoint slice when CORDA_TPU_MESH_WORKER_SLOT is set, the
+    first n local devices otherwise. Raises when the local device set
+    cannot satisfy the slice — a misplaced worker must fail loudly at
+    startup, not silently share devices with its neighbour."""
+    from ..parallel.mesh import data_mesh, worker_slot_mesh
+
+    slot = worker_slot()
+    if slot is None:
+        return data_mesh(n_devices)
+    return worker_slot_mesh(n_devices, slot)
+
+
+def mesh_placement() -> dict:
+    """The healthcheck/ops view of this process's device placement: the
+    configured mesh width, the device ids it pinned, and the slot."""
+    from ..core.crypto import batch as crypto_batch
+
+    mesh = crypto_batch.configured_mesh()
+    return {
+        "devices": 0 if mesh is None else int(mesh.devices.size),
+        "device_ids": (
+            [] if mesh is None
+            else [int(d.id) for d in mesh.devices.flat]
+        ),
+        "worker_slot": worker_slot(),
+    }
 
 
 class VerifierWorker:

@@ -1,0 +1,467 @@
+"""Mesh-sharded dispatch stage tests (docs/perf-pipeline.md, scale-out).
+
+Differential guarantees this file pins:
+
+* mesh-vs-single-device BIT-IDENTITY over a fuzz corpus at every mesh
+  width the 8-virtual-CPU-device conftest can build (n = 1, 2, 4, 8) —
+  the kill-switch contract: CORDA_TPU_MESH_DEVICES must never change a
+  verdict, only where it is computed;
+* ragged-tail masking: batches below / equal to / above the mesh width
+  pad per shard, and a padding row can never flip a verdict or leak
+  into the psum'd valid count;
+* MeshDispatcher stage semantics: telemetry, failure latch + fallback,
+  and the pipeline's stage-isolation contract (one poisoned batch fails
+  alone);
+* worker device placement (CORDA_TPU_MESH_WORKER_SLOT) and the
+  regression-gate / provenance plumbing for mesh_sigs_s.
+"""
+import numpy as np
+import pytest
+
+from corda_tpu.core.crypto import batch as crypto_batch
+from corda_tpu.core.crypto import crypto, ed25519_math
+from corda_tpu.ops import ed25519_batch
+from corda_tpu.parallel import data_mesh, shard_layout, worker_slot_mesh
+from corda_tpu.parallel import mesh as mesh_mod
+from corda_tpu.verifier.pipeline import MeshDispatcher, VerificationPipeline
+
+
+def _fuzz_corpus(n=24, seed=42):
+    """The ops-level fuzz corpus (same mutation ladder as
+    test_ops_ed25519.test_agrees_with_host_oracle_fuzz): one in four
+    rows valid, the rest tampered sig / extended msg / garbage key."""
+    rng = np.random.default_rng(seed)
+    pubs, sigs, msgs, expect = [], [], [], []
+    for i in range(n):
+        sk = rng.bytes(32)
+        pub = ed25519_math.public_from_seed(sk)
+        msg = rng.bytes(int(rng.integers(1, 200)))
+        sig = ed25519_math.sign(sk, msg)
+        kind = i % 4
+        if kind == 1:
+            sig = bytes([sig[0] ^ 0xFF]) + sig[1:]
+        elif kind == 2:
+            msg = msg + b"!"
+        elif kind == 3:
+            pub = rng.bytes(32)
+        pubs.append(pub)
+        sigs.append(sig)
+        msgs.append(msg)
+        expect.append(ed25519_math.verify(pub, msg, sig))
+    return pubs, sigs, msgs, expect
+
+
+def _items(n, entropy0=7000, tamper_idx=()):
+    """Production-shape (public_key, signature, content) rows."""
+    out = []
+    for i in range(n):
+        kp = crypto.entropy_to_keypair(entropy0 + i)
+        content = b"mesh dispatch row %d" % i
+        sig = crypto.do_sign(kp.private, content)
+        if i in tamper_idx:
+            content = b"forged"
+        out.append((kp.public, sig, content))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: the mesh must agree with the single-device kernel exactly
+# ---------------------------------------------------------------------------
+
+class TestMeshBitIdentity:
+    @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+    def test_fuzz_corpus_identical_at_every_width(self, n_dev):
+        """Verdict vector at mesh width n == the unsharded kernel's ==
+        the host oracle's, bit for bit. n=1 is the degenerate mesh: one
+        shard must reproduce the CORDA_TPU_MESH_DEVICES=0 path."""
+        pubs, sigs, msgs, expect = _fuzz_corpus()
+        single = [bool(b) for b in ed25519_batch.verify_batch(pubs, sigs, msgs)]
+        mask, total = mesh_mod.shard_verify(
+            data_mesh(n_dev), "ed25519", pubs, sigs, msgs, return_total=True
+        )
+        assert [bool(b) for b in mask] == single == expect
+        assert total == sum(expect)
+
+    @pytest.mark.parametrize("n", [3, 8, 11, 29])
+    def test_ragged_tails_below_equal_above_mesh_width(self, n):
+        """Batch sizes below (3), equal to (8) and above (11, 29) the
+        8-device mesh width: the trailing shards carry padding rows,
+        which must neither appear in the mask nor inflate the psum."""
+        pubs, sigs, msgs, expect = _fuzz_corpus(n, seed=100 + n)
+        mask, total = mesh_mod.shard_verify(
+            data_mesh(8), "ed25519", pubs, sigs, msgs, return_total=True
+        )
+        assert mask.shape == (n,)
+        assert [bool(b) for b in mask] == expect
+        assert total == sum(expect)
+
+    def test_shard_layout_padding_math(self):
+        """The documented padding math: per-shard bucket is the next
+        power of two (min 8), the batch pads to per_device * n_dev, and
+        occupancy counts REAL rows only."""
+        mesh = data_mesh(4)
+        per_device, padded, occ = shard_layout(mesh, "ed25519", 10)
+        assert per_device == 8
+        assert padded == 32
+        assert occ == [8, 2, 0, 0]
+        assert sum(occ) == 10
+        # a full batch leaves no padding anywhere
+        _, _, occ_full = shard_layout(mesh, "ed25519", 32)
+        assert occ_full == [8, 8, 8, 8]
+
+
+# ---------------------------------------------------------------------------
+# MeshDispatcher: the injectable pipeline stage
+# ---------------------------------------------------------------------------
+
+class TestMeshDispatcher:
+    def test_stages_verify_and_record_telemetry(self):
+        md = MeshDispatcher(n_devices=4, min_batch=8)
+        items = _items(12, tamper_idx={2, 7})
+        plan = md.plan(items)
+        plan = crypto_batch.prehash_plan(plan)
+        plan = md.dispatch(plan)
+        out = crypto_batch.collect_plan(plan)
+        host = [crypto.is_valid(k, s, c) for k, s, c in items]
+        assert out == host == [i not in {2, 7} for i in range(12)]
+        # the psum'd mesh-wide valid count reached the dispatcher
+        assert plan.mesh_totals == {"ed25519": 10}
+        assert md.valid_total == 10
+        assert md.dispatches == 1
+        assert md.devices == 4
+        # occupancy counts REAL rows per shard (12 rows, bucket 8)
+        occ = [md.shard_occupancy(k) for k in range(4)]
+        assert occ == [8, 4, 0, 0]
+
+    def test_below_min_batch_stays_single_device(self):
+        md = MeshDispatcher(n_devices=2, min_batch=64)
+        items = _items(6, entropy0=7100, tamper_idx={1})
+        plan = md.plan(items)
+        plan = crypto_batch.prehash_plan(plan)
+        plan = md.dispatch(plan)
+        out = crypto_batch.collect_plan(plan)
+        assert out == [True, False, True, True, True, True]
+        assert plan.mesh_totals == {}
+        assert md.dispatches == 0
+
+    def test_shard_failure_latches_and_falls_back(self, monkeypatch):
+        """A broken mesh lowering costs one batch's mesh attempt: the
+        verdicts still come back (single-device fallback), the
+        dispatcher latches off, and shard_verify is never tried again."""
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise RuntimeError("mesh lowering failed (simulated)")
+
+        monkeypatch.setattr(mesh_mod, "shard_verify", boom)
+        md = MeshDispatcher(n_devices=2, min_batch=4)
+        items = _items(8, entropy0=7200, tamper_idx={5})
+        plan = md.plan(items)
+        plan = crypto_batch.prehash_plan(plan)
+        plan = md.dispatch(plan)
+        out = crypto_batch.collect_plan(plan)
+        assert out == [i != 5 for i in range(8)]
+        assert plan.mesh_failed
+        assert md.devices == 0  # the Mesh.Devices gauge signal
+        assert calls == [1]
+        # the process-global latch must NOT have been poisoned by this
+        # engine-scoped failure
+        assert not crypto_batch._mesh_failed_once
+        # second batch: latched dispatcher plans without a mesh
+        plan2 = md.plan(items)
+        plan2 = crypto_batch.prehash_plan(plan2)
+        plan2 = md.dispatch(plan2)
+        assert crypto_batch.collect_plan(plan2) == out
+        assert calls == [1]
+
+    def test_pipeline_stage_isolation_one_poisoned_batch(self, monkeypatch):
+        """The pipeline's stage-isolation contract with the mesh stage
+        injected: a batch whose dispatch raises fails ONLY its own
+        future; batches before and after verify normally."""
+        md = MeshDispatcher(n_devices=2, min_batch=4)
+        real_dispatch = crypto_batch.dispatch_plan
+
+        def flaky(plan):
+            if any(c == b"poison" for _, _, c in plan.flat):
+                raise RuntimeError("injected shard failure")
+            return real_dispatch(plan)
+
+        monkeypatch.setattr(crypto_batch, "dispatch_plan", flaky)
+        good = _items(8, entropy0=7300, tamper_idx={3})
+        kp = crypto.entropy_to_keypair(7399)
+        poison = [(kp.public, crypto.do_sign(kp.private, b"poison"),
+                   b"poison")] * 8
+        p = VerificationPipeline(stages=md.stages(), depth=2, name="mesh-iso")
+        try:
+            f1 = p.submit(good)
+            f2 = p.submit(poison)
+            f3 = p.submit(good)
+            assert f1.result(60) == [i != 3 for i in range(8)]
+            with pytest.raises(RuntimeError, match="injected shard failure"):
+                f2.result(60)
+            assert f3.result(60) == [i != 3 for i in range(8)]
+            assert p.failures == 1
+            assert p.batches == 3
+        finally:
+            p.stop()
+
+    def test_mesh_gauges_bound_through_pipeline(self):
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        reg = MetricRegistry()
+        md = MeshDispatcher(n_devices=2, min_batch=4)
+        p = VerificationPipeline(
+            stages=md.stages(), depth=2, name="mesh-metered", registry=reg,
+        )
+        try:
+            assert p.mesh_dispatcher is md
+            assert reg.gauge("Mesh.Devices").value == 2
+            assert reg.gauge("Mesh.ValidTotal").value == 0
+            out = p.submit(_items(8, entropy0=7400)).result(60)
+            assert out == [True] * 8
+            assert reg.gauge("Mesh.ValidTotal").value == 8
+            assert (
+                reg.gauge("Mesh.ShardOccupancy{n=0}").value
+                + reg.gauge("Mesh.ShardOccupancy{n=1}").value
+            ) == 8
+        finally:
+            p.stop()
+
+    def test_rejects_zero_devices(self):
+        with pytest.raises(ValueError):
+            MeshDispatcher(n_devices=0)
+
+
+class TestMeshKnob:
+    def test_mesh_devices_parsing(self, monkeypatch):
+        from corda_tpu.verifier import pipeline as pl
+
+        monkeypatch.delenv("CORDA_TPU_MESH_DEVICES", raising=False)
+        assert pl.mesh_devices() == 0
+        monkeypatch.setenv("CORDA_TPU_MESH_DEVICES", "4")
+        assert pl.mesh_devices() == 4
+        monkeypatch.setenv("CORDA_TPU_MESH_DEVICES", "junk")
+        assert pl.mesh_devices() == 0
+        monkeypatch.setenv("CORDA_TPU_MESH_DEVICES", "-2")
+        assert pl.mesh_devices() == 0
+        monkeypatch.setenv("CORDA_TPU_MESH_DEVICES", "")
+        assert pl.mesh_devices() == 0
+
+    def test_default_stages_swap_behind_knob(self, monkeypatch):
+        """CORDA_TPU_MESH_DEVICES>0 swaps decode/dispatch for the
+        dispatcher's bound methods; 0 keeps the stock stage functions —
+        the stage GRAPH (names, order) is identical either way."""
+        from corda_tpu.verifier import pipeline as pl
+
+        monkeypatch.delenv("CORDA_TPU_MESH_DEVICES", raising=False)
+        stock = pl.default_stages()
+        names = [n for n, _ in stock]
+        assert names == ["decode", "prehash", "dispatch", "collect"]
+        assert all(
+            not isinstance(getattr(fn, "__self__", None), pl.MeshDispatcher)
+            for _, fn in stock
+        )
+        monkeypatch.setenv("CORDA_TPU_MESH_DEVICES", "4")
+        meshed = pl.default_stages()
+        assert [n for n, _ in meshed] == names
+        owner = dict(meshed)["dispatch"].__self__
+        assert isinstance(owner, pl.MeshDispatcher)
+        assert owner.n_devices == 4
+
+
+# ---------------------------------------------------------------------------
+# worker device placement
+# ---------------------------------------------------------------------------
+
+class TestWorkerPlacement:
+    def test_worker_slot_mesh_disjoint_slices(self):
+        ids0 = [int(d.id) for d in worker_slot_mesh(2, 0).devices.flat]
+        ids1 = [int(d.id) for d in worker_slot_mesh(2, 1).devices.flat]
+        ids3 = [int(d.id) for d in worker_slot_mesh(2, 3).devices.flat]
+        assert ids0 == [0, 1]
+        assert ids1 == [2, 3]
+        assert ids3 == [6, 7]
+        assert not (set(ids0) & set(ids1))
+
+    def test_worker_slot_mesh_bounds(self):
+        # slot 2 of width 4 needs devices [8, 12); conftest pins 8
+        with pytest.raises(ValueError):
+            worker_slot_mesh(4, 2)
+        with pytest.raises(ValueError):
+            worker_slot_mesh(0, 0)
+        with pytest.raises(ValueError):
+            worker_slot_mesh(2, -1)
+
+    def test_worker_slot_env_parsing(self, monkeypatch):
+        from corda_tpu.verifier import worker
+
+        monkeypatch.delenv("CORDA_TPU_MESH_WORKER_SLOT", raising=False)
+        assert worker.worker_slot() is None
+        monkeypatch.setenv("CORDA_TPU_MESH_WORKER_SLOT", "3")
+        assert worker.worker_slot() == 3
+        monkeypatch.setenv("CORDA_TPU_MESH_WORKER_SLOT", "junk")
+        assert worker.worker_slot() is None
+        monkeypatch.setenv("CORDA_TPU_MESH_WORKER_SLOT", "-1")
+        assert worker.worker_slot() is None
+
+    def test_placement_mesh_follows_slot(self, monkeypatch):
+        from corda_tpu.verifier import worker
+
+        monkeypatch.delenv("CORDA_TPU_MESH_WORKER_SLOT", raising=False)
+        assert [
+            int(d.id) for d in worker.placement_mesh(2).devices.flat
+        ] == [0, 1]
+        monkeypatch.setenv("CORDA_TPU_MESH_WORKER_SLOT", "3")
+        assert [
+            int(d.id) for d in worker.placement_mesh(2).devices.flat
+        ] == [6, 7]
+        # a misplaced worker fails loudly at startup
+        monkeypatch.setenv("CORDA_TPU_MESH_WORKER_SLOT", "4")
+        with pytest.raises(ValueError):
+            worker.placement_mesh(2)
+
+    def test_mesh_placement_healthcheck_view(self, monkeypatch):
+        from corda_tpu.verifier import worker
+
+        monkeypatch.delenv("CORDA_TPU_MESH_WORKER_SLOT", raising=False)
+        assert worker.mesh_placement() == {
+            "devices": 0, "device_ids": [], "worker_slot": None,
+        }
+        crypto_batch.configure_mesh(data_mesh(2))
+        try:
+            view = worker.mesh_placement()
+        finally:
+            crypto_batch.configure_mesh(None)
+        assert view["devices"] == 2
+        assert view["device_ids"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# regression gate + provenance plumbing
+# ---------------------------------------------------------------------------
+
+class TestMeshGate:
+    def test_direction_classifies_labelled_mesh_keys(self):
+        from corda_tpu.loadtest import gate
+
+        assert gate.direction("mesh_sigs_s") == "higher"
+        assert gate.direction("mesh_sigs_s{n=4}") == "higher"
+        assert gate.direction("stage_timings.mesh_sigs_s{n=8}") == "higher"
+        assert gate.direction("mesh_stage_error{n=4}") is None
+
+    def test_gate_trips_on_mesh_scaling_regression(self):
+        """A synthetic 50% collapse of one mesh scaling point must trip
+        the gate (same-env records, so no cross-env demotion); the
+        mirror-image improvement must pass."""
+        from corda_tpu.loadtest import gate
+
+        fp = {"backend": "cpu", "shards": 0, "node_workers": 0}
+        fast = {
+            "stage_timings": {
+                "mesh_sigs_s{n=1}": 300.0, "mesh_sigs_s{n=4}": 1000.0,
+            },
+            "env_fingerprint": fp,
+        }
+        slow = {
+            "stage_timings": {
+                "mesh_sigs_s{n=1}": 300.0, "mesh_sigs_s{n=4}": 500.0,
+            },
+            "env_fingerprint": fp,
+        }
+        tripped = gate.run_gate(slow, fast)
+        assert not tripped["ok"]
+        assert [r["key"] for r in tripped["regressions"]] == [
+            "stage_timings.mesh_sigs_s{n=4}"
+        ]
+        assert tripped["regressions"][0]["direction"] == "higher"
+        improved = gate.run_gate(fast, slow)
+        assert improved["ok"]
+        assert improved["regressions"] == []
+
+    def test_load_multichip_record_shapes(self, tmp_path):
+        """All three MULTICHIP artifact generations load into a
+        gate-comparable record: parsed block, MULTICHIP_JSON tail line,
+        legacy prose-only tail."""
+        import json
+
+        from corda_tpu.loadtest import gate
+
+        parsed = tmp_path / "MULTICHIP_r90.json"
+        parsed.write_text(json.dumps({
+            "n_devices": 8, "ok": True,
+            "parsed": {"n_devices": 8, "mesh_sigs_s": 123.4,
+                       "env_fingerprint": {"backend": "cpu"}},
+        }))
+        rec = gate.load_multichip_record(str(parsed))
+        assert rec["mesh_sigs_s"] == 123.4
+
+        structured = tmp_path / "MULTICHIP_r91.json"
+        structured.write_text(json.dumps({
+            "n_devices": 8, "ok": True,
+            "tail": 'MULTICHIP_JSON: {"backend": "cpu", "mesh_sigs_s": '
+                    '78.7, "n_devices": 8}\ndryrun_multichip OK: ...',
+        }))
+        rec = gate.load_multichip_record(str(structured))
+        assert rec["mesh_sigs_s"] == 78.7
+        assert rec["backend"] == "cpu"
+
+        legacy = tmp_path / "MULTICHIP_r92.json"
+        legacy.write_text(json.dumps({
+            "n_devices": 8, "ok": True,
+            "tail": "dryrun_multichip OK: psum total 2048 "
+                    "(2048 sigs = 256/device in 26.0s on the virtual CPU "
+                    "mesh; real chips retire this in microseconds)",
+        }))
+        rec = gate.load_multichip_record(str(legacy))
+        assert rec["mesh_sigs_s"] == round(2048 / 26.0, 3)
+        assert rec["env_fingerprint"] == {"backend": "cpu"}
+        # throughput-free legacy tails still classify the backend
+        no_rate = tmp_path / "MULTICHIP_r93.json"
+        no_rate.write_text(json.dumps({
+            "n_devices": 8, "ok": False,
+            "tail": "... vs host machine features ...",
+        }))
+        rec = gate.load_multichip_record(str(no_rate))
+        assert "mesh_sigs_s" not in rec
+        assert rec["backend"] == "cpu"
+
+    def test_in_repo_multichip_artifacts_load(self):
+        """Every committed MULTICHIP_r*.json must parse into a record
+        the gate can consume (the provenance satellite)."""
+        import glob
+        import os
+
+        from corda_tpu.loadtest import gate
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")))
+        assert paths, "no MULTICHIP round artifacts in the repo"
+        for p in paths:
+            rec = gate.load_multichip_record(p)
+            assert rec.get("n_devices") == 8, p
+            assert "ok" in rec, p
+
+
+# ---------------------------------------------------------------------------
+# op-budget: sharding must not add per-signature field work
+# ---------------------------------------------------------------------------
+
+class TestMeshOpBudget:
+    def test_mesh_kernel_matches_single_device_pin(self):
+        """Tracing the mesh-wrapped ed25519 kernel per shard must count
+        exactly the single-device pin's field multiplies per signature —
+        shard_map distributes the work, it must never duplicate it."""
+        from corda_tpu.ops import opbudget
+
+        pinned = opbudget.load_manifest()["kernels"]["ed25519_xla"]
+        counted = opbudget.count_mesh_kernel(n_devices=2)
+        assert counted["u32_mul_elems_per_sig"] == (
+            pinned["u32_mul_elems_per_sig"]
+        )
+        assert opbudget.fatal_violations(opbudget.check_mesh_budget(2)) == []
+        # width must not change the per-sig count either
+        counted4 = opbudget.count_mesh_kernel(n_devices=4)
+        assert counted4["u32_mul_elems_per_sig"] == (
+            counted["u32_mul_elems_per_sig"]
+        )

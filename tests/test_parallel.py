@@ -68,9 +68,13 @@ def test_ecdsa_bucket_routes_through_mesh(monkeypatch):
 
     calls = []
 
-    def fake_shard_verify(mesh, scheme, pubs, sigs, msgs):
+    def fake_shard_verify(mesh, scheme, pubs, sigs, msgs,
+                          return_total=False):
         calls.append((scheme, len(pubs)))
-        return np.ones(len(pubs), bool)
+        mask = np.ones(len(pubs), bool)
+        if return_total:
+            return mask, int(mask.sum())
+        return mask
 
     monkeypatch.setattr(mesh_mod, "shard_verify", fake_shard_verify)
     kp = crypto.generate_keypair(ECDSA_SECP256K1_SHA256)

@@ -118,6 +118,14 @@ def main(argv=None) -> int:
             print(f"bench_gate: cannot run op-budget gate: {exc}",
                   file=sys.stderr)
             return 2
+        # the mesh-wrapped kernel gates against the SAME single-device
+        # pin (sharding must not add work); skipped when this process
+        # has fewer than 2 devices to build a mesh from
+        try:
+            violations.extend(opbudget.check_mesh_budget(2))
+        except ValueError as exc:
+            print(f"bench_gate: mesh op-budget skipped: {exc}",
+                  file=sys.stderr)
         result["opbudget_violations"] = violations
         for v in violations:
             if v["kind"] == "improved":

@@ -69,6 +69,41 @@ def run_bls(blk: int, timeout: float) -> dict:
     return json.loads(line)
 
 
+def run_mesh(n: int, rows: int, timeout: float) -> dict:
+    """One mesh scaling point: the parallel.mesh microbench in a fresh
+    subprocess (the forced host device count binds at CPU backend init,
+    so every N needs its own process; n=0 = the single-device
+    comparator, exactly CORDA_TPU_MESH_DEVICES=0)."""
+    import re
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CORDA_TPU_MESH_DEVICES"] = str(n)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
+    ).strip()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "corda_tpu.parallel.mesh", "--bench",
+             "--devices", str(n), "--rows", str(rows), "--repeats", "2"],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"n_devices": n, "error": "timeout"}
+    line = next(
+        (ln for ln in out.stdout.splitlines() if ln.startswith("{")), None
+    )
+    if line is None:
+        return {"n_devices": n, "error": (out.stderr or out.stdout)[-400:]}
+    return json.loads(line)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--blks", default="256,512,1024")
@@ -88,7 +123,45 @@ def main() -> int:
         "runs the bls12_batch aggregate-verify microbench INSTEAD of "
         "the ed25519 bench matrix.",
     )
+    ap.add_argument(
+        "--mesh-ns", default="",
+        help="comma-separated mesh widths to sweep (e.g. 1,2,4,8; 0 is "
+        "always prepended as the single-device comparator). Runs the "
+        "corda_tpu.parallel.mesh scaling microbench INSTEAD of the "
+        "ed25519 bench matrix, one virtual-device subprocess per point "
+        "(docs/perf-pipeline.md).",
+    )
+    ap.add_argument(
+        "--mesh-rows", type=int, default=256,
+        help="batch size per mesh scaling point (--mesh-ns)",
+    )
     args = ap.parse_args()
+
+    if args.mesh_ns:
+        ns = [int(n) for n in args.mesh_ns.split(",")]
+        if 0 not in ns:
+            ns = [0] + ns  # the all-off comparator anchors the curve
+        results = []
+        for n in ns:
+            rec = run_mesh(n, args.mesh_rows, args.timeout)
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+        ok = [r for r in results if "sigs_s" in r]
+        if ok:
+            base = next(
+                (r for r in ok if r["n_devices"] == 0), None
+            )
+            best = max(ok, key=lambda r: r["sigs_s"])
+            vs = (
+                f" ({best['sigs_s'] / base['sigs_s']:.2f}x the n=0 "
+                "single-device comparator)"
+                if base and base["sigs_s"] else ""
+            )
+            print(
+                f"# best: n={best['n_devices']} -> "
+                f"{best['sigs_s']:,.1f} sigs/s{vs}"
+            )
+        return 0
 
     if args.bls_blks:
         results = []
