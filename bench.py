@@ -328,9 +328,22 @@ def _measured_main(_quiesce) -> None:
         # which compares it against the newest BENCH_r*.json round
         # artifact; a >20% stage-timing regression fails the bench run
         here = os.path.dirname(os.path.abspath(__file__))
+        gate_cmd = [
+            sys.executable, os.path.join(here, "tools", "bench_gate.py"),
+            "--current", "-", "--repo", here, "--opbudget", "--lint",
+        ]
+        # the fleet-observatory A/B asserts an ABSOLUTE ceiling too (the
+        # relative gate would pass a 0%->huge jump on a fresh baseline):
+        # observation overhead above the noise floor fails the round
+        if isinstance(
+            record.get("stage_timings", {}).get("fleet_observe_overhead_pct"),
+            (int, float),
+        ):
+            gate_cmd += [
+                "--slo", "stage_timings.fleet_observe_overhead_pct<=25",
+            ]
         proc = subprocess.run(
-            [sys.executable, os.path.join(here, "tools", "bench_gate.py"),
-             "--current", "-", "--repo", here, "--opbudget", "--lint"],
+            gate_cmd,
             input=json.dumps(record), text=True,
             stdout=subprocess.DEVNULL,  # gate detail goes to stderr; the
         )                               # record stays this run's only stdout
@@ -660,6 +673,16 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         lane_ab = {"flow_lane_error": f"{type(exc).__name__}: {exc}"}
 
+    # Fleet-observatory A/B (docs/observability.md): the same notarise
+    # workload bare vs under a live OpsServer + FleetCollector poll loop
+    # — observation must stay within run-to-run noise of the hot path.
+    from corda_tpu.loadtest.observatory import measure_fleet_observe_overhead
+
+    try:
+        fleet_ab = measure_fleet_observe_overhead()
+    except Exception as exc:
+        fleet_ab = {"fleet_observe_error": f"{type(exc).__name__}: {exc}"}
+
     # Mesh-sharded dispatch scaling curve (docs/perf-pipeline.md): the
     # `mesh_sigs_s{n=...}` points, one virtual-device subprocess per N,
     # with the CORDA_TPU_MESH_DEVICES=0 comparator at n=0.
@@ -726,6 +749,13 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         ),
         "flow_lane_pairs_s": lane_ab.get("flow_lane_pairs_s"),
         "flow_lane_sync_pairs_s": lane_ab.get("flow_lane_sync_pairs_s"),
+        "fleet_observe_off_per_sec": fleet_ab.get(
+            "fleet_observe_off_per_sec"
+        ),
+        "fleet_observe_on_per_sec": fleet_ab.get("fleet_observe_on_per_sec"),
+        "fleet_observe_overhead_pct": fleet_ab.get(
+            "fleet_observe_overhead_pct"
+        ),
     }
     stage_timings.update(mesh_curve)
     out = {
@@ -760,6 +790,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     out.update(coin_select)
     out.update(cp_group)
     out.update(lane_ab)
+    out.update(fleet_ab)
 
     # Full-system throughput: issue+pay pairs through REAL node processes
     # (cordform network, TCP brokers, bridges, validating notary) — the

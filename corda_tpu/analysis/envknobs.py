@@ -169,6 +169,18 @@ _ENTRIES = [
     _k("CORDA_TPU_QUIESCE_FILE", "tpu_capture/QUIESCE",
        "docs/observability.md",
        "cross-process quiesce marker path override"),
+    # -- fleet observatory (this PR) ----------------------------------------
+    _k("CORDA_TPU_METRICS_HISTORY", "1", "docs/observability.md",
+       "0 disables the in-process metric time-series ring"),
+    _k("CORDA_TPU_METRICS_HISTORY_INTERVAL_S", "1.0",
+       "docs/observability.md",
+       "metric history sampling interval (seconds)"),
+    _k("CORDA_TPU_METRICS_HISTORY_MAX", "512", "docs/observability.md",
+       "metric history ring capacity (samples)"),
+    _k("CORDA_TPU_TRACE_EXPORT_MAX", "4096", "docs/observability.md",
+       "finished-span export ring capacity (/traces/export)"),
+    _k("CORDA_TPU_FLEET_POLL_S", "2.0", "docs/observability.md",
+       "fleet collector poll interval over the node probes (seconds)"),
     # -- lockcheck (this PR) -------------------------------------------------
     _k("CORDA_TPU_LOCKCHECK", "0", "docs/static-analysis.md",
        "1 arms the runtime lock-order deadlock detector"),

@@ -46,7 +46,9 @@ _HIGHER = re.compile(
     # checkpoint group-commit throughput (docs/perf-system.md round 20)
     r"|_flows_s)$"
 )
-_LOWER = re.compile(r"(_ms|_us|_s)$")
+#: _overhead_pct: the observatory A/B (fleet_observe_overhead_pct) and
+#: kin — a growing observation tax is the regression direction
+_LOWER = re.compile(r"(_ms|_us|_s|_overhead_pct)$")
 _LOWER_HINT = re.compile(r"(latency|_lag|_wall|_us_per_|_ms_per_|_s_per_)")
 
 

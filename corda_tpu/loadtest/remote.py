@@ -838,6 +838,12 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
         transport_partition,
     )
     from .gate import check_slos
+    from .observatory import (
+        FleetCollector,
+        NodeProbe,
+        build_timeline,
+        disruption_mttr,
+    )
     from .procdriver import PairDriver, assert_no_loss_no_dup, \
         resolve_identities
 
@@ -870,16 +876,18 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
     # token-bucket rate the burst provably outruns (a live-flow cap
     # alone never fills — RPC-paced issues complete faster than they
     # arrive) plus a flow cap as the second bound the contract names.
-    bank_a_spec = {"name": "O=SoakBankA,L=London,C=GB"}
+    bank_a_spec = {"name": "O=SoakBankA,L=London,C=GB", "ops_port": 0}
     if overload_burst:
         bank_a_spec["admission_rate"] = 30
         bank_a_spec["admission_burst"] = 60
         bank_a_spec["admission_max_flows"] = 256
+    # every node serves an ops endpoint (ephemeral port, rides the ready
+    # file) so the fleet observatory can stitch traces across them
     spec = {"nodes": [
         {"name": "O=SoakNotary,L=Zurich,C=CH", "notary": "validating",
-         "network_map_service": True},
+         "network_map_service": True, "ops_port": 0},
         bank_a_spec,
-        {"name": "O=SoakBankB,L=Paris,C=FR"},
+        {"name": "O=SoakBankB,L=Paris,C=FR", "ops_port": 0},
     ]}
     if node_workers:
         spec["nodes"][1]["node_workers"] = int(node_workers)
@@ -916,6 +924,7 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
     proxy: Optional[RemoteProxy] = None
     driver = None
     mixer = None
+    collector: Optional[FleetCollector] = None
     events: List[Tuple[float, str, str]] = []
     try:
         for host, session, conf in zip(
@@ -934,6 +943,20 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
             node.launch()
             nodes.append(node)
         notary_node, bank_a, bank_b = nodes
+        # fleet observatory: poll every node's ops endpoint over the
+        # SAME exec transports the rig already holds. ops_port resolves
+        # per poll — a restarted node relaunches on a fresh ephemeral
+        # port and a probe pinning the old one would read it as wedged
+        collector = FleetCollector([
+            NodeProbe(
+                short, session, (lambda n=node: n.ops_port),
+                timeout_s=min(10.0, exec_timeout_s),
+            )
+            for short, session, node in zip(
+                ("notary", "bank_a", "bank_b"),
+                (s_notary, s_bank_a, s_bank_b), nodes,
+            )
+        ]).start()
         proxy = RemoteProxy(
             s_bank_b, os.path.dirname(bank_b.node_dir) or h_bank_b.workdir,
             proxy_port, bank_b.broker_port,
@@ -984,6 +1007,7 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
                 recovery_deadline_s=recovery_deadline_s)))
 
         t0 = time.monotonic()
+        t0_wall = time.time()  # disruption marks ↔ node records join here
         t_end = t0 + duration
         fired = recovered = 0
         rounds = 0
@@ -1036,6 +1060,20 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
         assert_no_loss_no_dup(driver, bank_b)
         reconciliation = reconcile_ledgers(driver, bank_a)
 
+        # fleet observatory verdicts: stop with a final drain, then
+        # stitch + correlate. MTTR comes from the rig's own fire/heal
+        # marks (ground truth even if every probe was wedged); the
+        # collector's logs/samples only ANNOTATE the timeline.
+        collector.stop()
+        fleet = collector.capture()
+        mttr = disruption_mttr(events)
+        timeline = build_timeline(
+            events, t0_wall,
+            node_logs=collector.node_logs(),
+            node_samples=collector.node_samples(),
+        )
+        collector = None
+
         shed_errors = sum(
             1 for e in driver.errors if "NodeOverloadedError" in e
         )
@@ -1054,6 +1092,13 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
             "disruptions_fired": fired,
             "disruptions_recovered": recovered,
             "events": events,
+            # disruption-annotated observability: mean repair time per
+            # catalog kind (labelled keys gate lower-is-better via the
+            # _ms suffix), the annotated timeline, and the stitched
+            # cross-node fleet capture (top critical paths, bounded)
+            "mttr": mttr,
+            "timeline": timeline,
+            "fleet": fleet,
             "driver_errors": len(driver.errors),
             "shed_driver_errors": shed_errors,
             "hard_driver_errors": len(driver.errors) - shed_errors,
@@ -1092,6 +1137,12 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
         result["slo_violations"] = check_slos(result, active_slos)
         return result
     finally:
+        if collector is not None:
+            try:
+                collector.stop(final_poll=False)
+            # lint: allow(swallow) — teardown best-effort; nodes close next
+            except Exception:
+                pass
         if driver is not None and not driver._stop.is_set():
             try:
                 driver.stop(timeout=10)

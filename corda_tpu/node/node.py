@@ -964,14 +964,27 @@ class AbstractNode:
         if getattr(self, "bft_replica", None) is not None:
             self._start_bft_ticker()
         if self.config.ops_port is not None:
+            from ..utils.timeseries import MetricsHistory, history_enabled
             from .opsserver import OpsServer
 
+            # metric time-series ride along with the ops endpoint (a
+            # node nobody can scrape has nobody to keep history for);
+            # CORDA_TPU_METRICS_HISTORY=0 keeps the node poller-free
+            if history_enabled():
+                self.metrics_history = MetricsHistory(
+                    self.smm.metrics, name=self.info.name
+                ).start()
+                # the RPC layer never sees the node object; hang the
+                # history off the smm like hospital/metrics so
+                # node_metrics_history() can reach it
+                self.smm.metrics_history = self.metrics_history
             # tracer deliberately unpinned: the endpoint resolves the
             # process tracer per request, like the span producers do
             self.ops_server = OpsServer(
                 self.smm.metrics, health=self.health,
                 hospital=self.smm.hospital,
                 admission=self.admission, overload=self.overload,
+                history=getattr(self, "metrics_history", None),
                 port=self.config.ops_port,
             )
         self.started = True
@@ -1045,6 +1058,9 @@ class AbstractNode:
         if getattr(self, "ops_server", None) is not None:
             self.ops_server.stop()
             self.ops_server = None
+        if getattr(self, "metrics_history", None) is not None:
+            self.metrics_history.stop()
+            self.metrics_history = None
         if getattr(self, "_raft_stop", None) is not None:
             self._raft_stop.set()
             self._raft_ticker.join(timeout=2)

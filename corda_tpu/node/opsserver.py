@@ -7,13 +7,23 @@ recorder's structured event log:
 
     GET /metrics                      Prometheus text format 0.0.4
                                       (rendered from MetricRegistry.snapshot())
+    GET /metrics/history?since=&limit=
+                                      cursor-paginated metric time-series
+                                      (utils/timeseries.py ring; counters
+                                      as windowed rates) — repeat pollers
+                                      resume from the reply's `next`
     GET /traces/<trace_id>            span tree as JSON (404 when unknown)
     GET /traces/slow?threshold_ms=N   bounded ring of slowest root spans
+    GET /traces/export?since=&limit=  cursor-paginated drain of finished
+                                      spans (the fleet observatory's
+                                      stitching feed; same `next` contract)
     GET /traces                       known trace ids + tracer stats
-    GET /logs?level=&component=&trace=&limit=&format=jsonl
+    GET /logs?level=&component=&trace=&limit=&since_seq=&format=jsonl
                                       flight-recorder events (filterable;
                                       `trace=` joins a /traces/<id> trace
-                                      against what the node logged)
+                                      against what the node logged;
+                                      `since_seq=` resumes after the last
+                                      drained record's seq)
     GET /hospital                     flow-hospital view: flows awaiting
                                       checkpoint-replay retry + the
                                       dead-letter ward (docs/robustness.md)
@@ -188,6 +198,20 @@ def render_prometheus(snapshot: Dict[str, Dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _cursor_args(query: Dict[str, str]):
+    """(since, limit, error) for the cursor-paginated endpoints; a
+    non-integer cursor is the CLIENT's fault (400, never a 500)."""
+    since, limit = query.get("since"), query.get("limit")
+    try:
+        return (
+            int(since) if since is not None else 0,
+            int(limit) if limit is not None else None,
+            None,
+        )
+    except ValueError:
+        return 0, None, "since and limit must be integers"
+
+
 # -- the endpoint ------------------------------------------------------------
 
 class OpsServer(MiniWebServer):
@@ -200,6 +224,7 @@ class OpsServer(MiniWebServer):
                  health: Optional[HealthTracker] = None,
                  event_log: Optional[EventLog] = None,
                  hospital=None, admission=None, overload=None,
+                 history=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
         self._tracer = tracer
@@ -208,6 +233,7 @@ class OpsServer(MiniWebServer):
         self.hospital = hospital  # node.hospital.FlowHospital (optional)
         self.admission = admission  # node.admission.AdmissionController
         self.overload = overload  # node.admission.OverloadStateMachine
+        self.history = history  # utils.timeseries.MetricsHistory (optional)
         # sharded hosts attach their supervisor's snapshot() here so
         # GET /workers aggregates per-worker state (node/shardhost.py)
         self.workers_view = None
@@ -240,16 +266,21 @@ class OpsServer(MiniWebServer):
             return self.health.readyz()
         if path == "/logs":
             limit = query.get("limit")
+            since_seq = query.get("since_seq")
             try:
                 limit = int(limit) if limit is not None else None
+                since_seq = int(since_seq) if since_seq is not None else None
             except ValueError:
                 # client error, not a server fault: 400, never a 500
-                return 400, {"error": f"limit must be an integer: {limit!r}"}
+                return 400, {
+                    "error": "limit and since_seq must be integers",
+                }
             filters = {
                 "level": query.get("level"),
                 "component": query.get("component"),
                 "trace": query.get("trace"),
                 "limit": limit,
+                "since_seq": since_seq,
             }
             if query.get("format") == "jsonl":
                 return 200, RawResponse(
@@ -292,6 +323,18 @@ class OpsServer(MiniWebServer):
                 render_prometheus(self.registry.snapshot()),
                 "text/plain; version=0.0.4; charset=utf-8",
             )
+        if path == "/metrics/history":
+            since, limit, err = _cursor_args(query)
+            if err is not None:
+                return 400, {"error": err}
+            if self.history is None:
+                # a fleet collector probing a history-less node must get
+                # a well-formed empty page, not an error to chew on
+                return 200, {"enabled": False, "samples": [],
+                             "next": since, "newest": 0}
+            return 200, {
+                "enabled": True, **self.history.since(since, limit),
+            }
         if path == "/traces":
             return 200, {
                 "traces": self.tracer.trace_ids(),
@@ -302,6 +345,11 @@ class OpsServer(MiniWebServer):
             return 200, self.tracer.slow_roots(
                 float(threshold) if threshold is not None else None
             )
+        if path == "/traces/export":
+            since, limit, err = _cursor_args(query)
+            if err is not None:
+                return 400, {"error": err}
+            return 200, self.tracer.export_spans(since, limit)
         if path.startswith("/traces/"):
             trace_id = path[len("/traces/"):]
             tree = self.tracer.span_tree(trace_id)

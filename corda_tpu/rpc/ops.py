@@ -458,6 +458,19 @@ class CordaRPCOps:
             out["Verification"] = verifier
         return out
 
+    def node_metrics_history(self, since: int = 0,
+                             limit: Optional[int] = None) -> Dict[str, Any]:
+        """Cursor-paginated metric time-series (the RPC twin of
+        GET /metrics/history, utils/timeseries.py): samples STRICTLY
+        after `since`, the reply's `next` feeding the following poll.
+        A node without a history (CORDA_TPU_METRICS_HISTORY=0, or no
+        ops endpoint) answers a well-formed empty page."""
+        history = getattr(self._smm, "metrics_history", None)
+        if history is None:
+            return {"enabled": False, "samples": [],
+                    "next": int(since), "newest": 0}
+        return {"enabled": True, **history.since(int(since), limit)}
+
     def node_trace(self, trace_id: str) -> Optional[Dict]:
         """Span tree for one trace from the node's tracer (the RPC twin
         of the ops endpoint's GET /traces/<id>)."""
@@ -474,16 +487,20 @@ class CordaRPCOps:
     def node_logs(self, level: Optional[str] = None,
                   component: Optional[str] = None,
                   trace: Optional[str] = None,
-                  limit: Optional[int] = 200) -> Dict:
+                  limit: Optional[int] = 200,
+                  since_seq: Optional[int] = None) -> Dict:
         """Flight-recorder events (the RPC twin of GET /logs): filter by
         minimum level, component, or trace id — `trace` is what joins a
-        node_trace() tree against what the node logged while it ran."""
+        node_trace() tree against what the node logged while it ran;
+        `since_seq` resumes strictly after an already-drained record's
+        monotonic seq (collectors never re-read)."""
         from ..utils.eventlog import get_event_log
 
         log = get_event_log()
         return {
             "events": log.records(
-                level=level, component=component, trace=trace, limit=limit
+                level=level, component=component, trace=trace, limit=limit,
+                since_seq=since_seq,
             ),
             **log.stats(),
         }

@@ -26,6 +26,9 @@ class NodeProcess:
         self.node_dir = node_dir
         self.log_path = log_path
         self.broker_port: Optional[int] = None
+        #: ops endpoint port, when the node.conf asked for one (the
+        #: fleet observatory's probe target); None otherwise
+        self.ops_port: Optional[int] = None
         self._clients = []
 
     def log(self) -> str:
@@ -128,11 +131,12 @@ class Factory:
         """Boot an EXISTING node directory (e.g. one materialised by
         tools/cordform.deploy_nodes) as a black box."""
         log_path = os.path.join(node_dir, "node.log")
-        # a stale port file from a previous (killed) run would make the
-        # readiness poll below return before the new process binds
-        port_file_stale = os.path.join(node_dir, "broker.port")
-        if os.path.exists(port_file_stale):
-            os.unlink(port_file_stale)
+        # stale handshake files from a previous (killed) run would make
+        # the readiness poll below return before the new process binds
+        ready_file = os.path.join(node_dir, "ready.json")
+        for stale in (os.path.join(node_dir, "broker.port"), ready_file):
+            if os.path.exists(stale):
+                os.unlink(stale)
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)
@@ -141,7 +145,8 @@ class Factory:
         # launcher death (SIGKILL, test timeout) must not leak node
         # processes that contend with the rest of the session
         env["CORDA_TPU_EXIT_ON_ORPHAN"] = "1"
-        args = [sys.executable, "-m", "corda_tpu.node", node_dir]
+        args = [sys.executable, "-m", "corda_tpu.node", node_dir,
+                "--ready-file", ready_file]
         if self.jax_platform:
             args += ["--jax-platform", self.jax_platform]
         proc = subprocess.Popen(
@@ -149,16 +154,18 @@ class Factory:
         )
         node = NodeProcess(proc, node_dir, log_path)
         deadline = time.monotonic() + timeout
-        port_file = os.path.join(node_dir, "broker.port")
         while time.monotonic() < deadline:
             if not node.alive():
                 raise SmokeTestError(f"node died on startup:\n{node.log()}")
-            if os.path.exists(port_file):
-                with open(port_file) as fh:
-                    content = fh.read().strip()
-                if content:  # empty = writer mid-flight; keep polling
-                    node.broker_port = int(content)
-                    return node
+            # the ready file carries everything (broker + ops port) in
+            # one atomic JSON, and lands AFTER broker.port — waiting on
+            # it alone avoids racing the window between the two writes
+            if os.path.exists(ready_file):
+                with open(ready_file) as fh:
+                    ready = json.load(fh)
+                node.broker_port = int(ready["broker_port"])
+                node.ops_port = ready.get("ops_port")
+                return node
             time.sleep(0.1)
         node.close()
         raise SmokeTestError(f"node did not start in {timeout}s:\n{node.log()}")

@@ -5,10 +5,16 @@ THIS request"; metrics answer "what are the aggregate rates". Neither
 answers "what was the node DOING while that slow trace ran" — the
 question an operator asks first when a node misbehaves under load. This
 module keeps the answer in-process: JSON-lines-shaped records
-{ts, level, component, message, trace_id, span_id, ...fields} in a
+{seq, ts, level, component, message, trace_id, span_id, ...fields} in a
 bounded ring buffer, served at `GET /logs` on the ops endpoint and
 filterable by level / component / trace id, so a trace retrieved from
 `/traces/<id>` joins against what the node logged while it ran.
+
+Every record carries a monotonic `seq` (stamped under the ring lock, so
+it stays ordered and survives ring eviction): a collector polling
+`/logs?since_seq=<last>` never re-reads the window it already drained —
+repeat pollers used to re-serve the whole ring every time
+(docs/observability.md, fleet observatory).
 
 Two producer paths feed one buffer:
 
@@ -107,8 +113,12 @@ class EventLog:
         if fields:
             event.update(fields)
         with self._lock:
-            self._ring.append(event)
+            # seq is assigned under the SAME lock that orders the ring,
+            # so it is monotonic in ring order — the /logs?since_seq=
+            # cursor contract depends on exactly that
             self._emitted += 1
+            event["seq"] = self._emitted
+            self._ring.append(event)
             self._by_level[level] = self._by_level.get(level, 0) + 1
 
     # -- consumer side ------------------------------------------------------
@@ -116,12 +126,17 @@ class EventLog:
     def records(self, level: Optional[str] = None,
                 component: Optional[str] = None,
                 trace: Optional[str] = None,
-                limit: Optional[int] = None) -> List[Dict]:
+                limit: Optional[int] = None,
+                since_seq: Optional[int] = None) -> List[Dict]:
         """Filtered view, oldest first. `level` is a MINIMUM severity;
         `trace` matches the event's own trace_id or any fan-in trace id;
-        `limit` keeps the newest N after filtering."""
+        `limit` keeps the newest N after filtering; `since_seq` keeps
+        only records STRICTLY after that cursor (pass the largest `seq`
+        already seen — a repeat poller then never re-reads the ring)."""
         with self._lock:
             events = list(self._ring)
+        if since_seq is not None:
+            events = [e for e in events if e.get("seq", 0) > since_seq]
         if level is not None:
             floor = _level_no(level.lower())
             events = [e for e in events if _level_no(e["level"]) >= floor]

@@ -1,0 +1,233 @@
+"""Metric time-series: a bounded in-process history ring over
+MetricRegistry.snapshot().
+
+`GET /metrics` (node/opsserver.py) is a point-in-time snapshot — fine
+for a scraper that keeps its own history, useless for the fleet
+observatory's "what happened to this node AROUND the disruption"
+question when no scraper is running. This module keeps a small history
+in-process: a quiesce-registered poller samples the registry every
+`interval_s` and appends ONE derived sample per tick to a bounded ring,
+cursor-paginated at `GET /metrics/history?since=<cursor>` and via the
+`node_metrics_history()` RPC.
+
+Derivation per metric type (raw snapshots would make every sample huge
+and push rate computation onto every reader):
+
+  * counters / meters -> windowed rate (delta-count over the tick) plus
+    the absolute count;
+  * gauges            -> last numeric reading;
+  * timers            -> windowed call rate, windowed mean, and the
+    reservoir p50/p95 at sample time;
+  * histograms        -> p50/p95 at sample time.
+
+Zero cost when off: with CORDA_TPU_METRICS_HISTORY=0 the node never
+constructs a history (no thread, no ring, endpoint reports disabled).
+The poller registers with utils/quiesce so measurement windows pause it
+like every other background prober.
+
+Env knobs: CORDA_TPU_METRICS_HISTORY (1 = on wherever an ops endpoint
+exists), CORDA_TPU_METRICS_HISTORY_INTERVAL_S (default 1.0),
+CORDA_TPU_METRICS_HISTORY_MAX (ring size, default 512).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import lockorder, quiesce
+
+
+def history_enabled() -> bool:
+    """Whether nodes should grow a history next to their ops endpoint."""
+    return os.environ.get("CORDA_TPU_METRICS_HISTORY", "1") != "0"
+
+
+class MetricsHistory:
+    """Bounded sampled history of ONE MetricRegistry."""
+
+    def __init__(self, registry, interval_s: Optional[float] = None,
+                 maxlen: Optional[int] = None, name: str = ""):
+        if interval_s is None:
+            interval_s = float(
+                os.environ.get("CORDA_TPU_METRICS_HISTORY_INTERVAL_S", 1.0)
+            )
+        if maxlen is None:
+            maxlen = int(
+                os.environ.get("CORDA_TPU_METRICS_HISTORY_MAX", 512)
+            )
+        self.registry = registry
+        self.interval_s = max(0.05, interval_s)
+        self.name = name
+        self._ring: deque = deque(maxlen=max(1, maxlen))
+        self._lock = lockorder.make_lock("MetricsHistory._lock")
+        self._seq = 0
+        #: (monotonic t, {metric name: (count, total)}) of the previous
+        #: sample — what turns cumulative counts into windowed rates
+        self._prev: Optional[Tuple[float, Dict[str, Tuple[float, float]]]] \
+            = None
+        self._paused = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- poller lifecycle ---------------------------------------------------
+
+    @property
+    def _quiesce_name(self) -> str:
+        return f"metrics-history:{self.name or id(self)}"
+
+    def start(self) -> "MetricsHistory":
+        """Spawn the sampling thread (idempotent) and register it as a
+        quiesce-pausable prober."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        quiesce.register(self._quiesce_name, self.pause, self.resume)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"metrics-history-{self.name or 'node'}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        quiesce.unregister(self._quiesce_name)
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self._paused:
+                continue
+            try:
+                self.sample_once()
+            # one bad gauge read must not kill the history poller
+            # lint: allow(swallow) — next tick retries every metric
+            except Exception:
+                pass
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample_once(self, now: Optional[float] = None) -> Dict:
+        """Take one derived sample and append it to the ring. `now` is a
+        monotonic-clock override for tests; wall time is stamped
+        separately (collectors correlate against disruption marks in
+        wall time)."""
+        t = time.monotonic() if now is None else now
+        snapshot = self.registry.snapshot()
+        with self._lock:
+            prev = self._prev
+            cum: Dict[str, Tuple[float, float]] = {}
+            dt = (t - prev[0]) if prev is not None else None
+            metrics: Dict[str, Dict] = {}
+            for mname, snap in snapshot.items():
+                mtype = snap.get("type")
+                derived = self._derive(mname, mtype, snap, prev, dt, cum)
+                if derived:
+                    metrics[mname] = derived
+            self._prev = (t, cum)
+            self._seq += 1
+            sample = {
+                "seq": self._seq,
+                "ts": round(time.time(), 3),
+                "dt_s": round(dt, 3) if dt is not None else None,
+                "metrics": metrics,
+            }
+            self._ring.append(sample)
+            return sample
+
+    @staticmethod
+    def _derive(mname: str, mtype: Optional[str], snap: Dict,
+                prev, dt: Optional[float],
+                cum: Dict[str, Tuple[float, float]]) -> Optional[Dict]:
+        def rate(count: float, total: float = 0.0) -> Optional[float]:
+            cum[mname] = (count, total)
+            if prev is None or dt is None or dt <= 0:
+                return None
+            pc, _ = prev[1].get(mname, (None, None))
+            if pc is None:
+                return None
+            return round(max(0.0, count - pc) / dt, 3)
+
+        if mtype in ("counter", "meter"):
+            count = float(snap.get("count", 0))
+            return {"count": count, "rate": rate(count)}
+        if mtype == "gauge":
+            value = snap.get("value")
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                return {"value": value}
+            return None  # dead gauge ({"error": ...}): skip the sample
+        if mtype == "timer":
+            count = float(snap.get("count", 0))
+            total = float(snap.get("total", 0.0))
+            out = {"count": count, "rate": rate(count, total)}
+            if prev is not None and dt:
+                pc, pt = prev[1].get(mname, (None, None))
+                if pc is not None and count > pc:
+                    out["window_mean"] = round(
+                        (total - (pt or 0.0)) / (count - pc), 6
+                    )
+            for q in ("p50", "p95"):
+                if isinstance(snap.get(q), (int, float)):
+                    out[q] = snap[q]
+            return out
+        if mtype == "histogram":
+            out = {"count": float(snap.get("count", 0))}
+            for q in ("p50", "p95"):
+                if isinstance(snap.get(q), (int, float)):
+                    out[q] = snap[q]
+            return out
+        return None  # unknown/legacy blob: history carries typed families
+
+    # -- consumer side ------------------------------------------------------
+
+    def since(self, cursor: int = 0, limit: Optional[int] = None) -> Dict:
+        """Samples STRICTLY after `cursor`, oldest first (same contract
+        as the tracer's export ring): the reply's `next` feeds the
+        following poll, so repeat pollers never re-read."""
+        if limit is None:
+            limit = 1000
+        with self._lock:
+            samples = [s for s in self._ring if s["seq"] > cursor]
+            newest = self._seq
+        samples = samples[: max(0, int(limit))]
+        return {
+            "samples": samples,
+            "next": samples[-1]["seq"] if samples else max(0, int(cursor)),
+            "newest": newest,
+            "interval_s": self.interval_s,
+        }
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "size": len(self._ring),
+                "capacity": self._ring.maxlen,
+                "sampled": self._seq,
+                "interval_s": self.interval_s,
+                "running": self._thread is not None,
+            }
+
+
+def latest_rates(samples: List[Dict], metric: str) -> List[Tuple[float, float]]:
+    """(ts, rate) series for one counter/meter/timer family out of a
+    sample list — the shape the observatory's inflection detector and
+    tools/fleet_report.py plot from."""
+    out: List[Tuple[float, float]] = []
+    for s in samples:
+        m = (s.get("metrics") or {}).get(metric)
+        if m and isinstance(m.get("rate"), (int, float)):
+            out.append((s.get("ts"), m["rate"]))
+    return out

@@ -24,10 +24,17 @@ trace. The design here is deliberately small:
     threshold with its critical-path breakdown, and a bounded ring of the
     slowest roots backs `GET /traces/slow`.
 
+  * An export ring (bounded, cursor-paginated) records every finished
+    span once in finish order, so a fleet collector draining
+    `GET /traces/export?since=<cursor>` streams the node's spans
+    without ever re-reading — the seam cross-node trace stitching
+    (loadtest/observatory.py) is built on.
+
 Env knobs: CORDA_TPU_TRACING=0 disables span recording AND propagation
 (the fast path is then one thread-local read per send);
 CORDA_TPU_TRACE_SLOW_MS sets the watchdog threshold (default 1000);
-CORDA_TPU_TRACE_MAX_TRACES bounds retained traces (default 512).
+CORDA_TPU_TRACE_MAX_TRACES bounds retained traces (default 512);
+CORDA_TPU_TRACE_EXPORT_MAX bounds the export ring (default 4096).
 
 `CORDA_TPU_PROFILE_DUMP` (utils/profiling.py) remains the complement:
 spans say WHICH hop was slow for one request, the profiler says WHY,
@@ -281,6 +288,9 @@ class Tracer:
             max_traces = int(
                 os.environ.get("CORDA_TPU_TRACE_MAX_TRACES", 512)
             )
+        export_max = int(
+            os.environ.get("CORDA_TPU_TRACE_EXPORT_MAX", 4096)
+        )
         self.node = node
         self.enabled = enabled
         self.slow_threshold_ms = slow_threshold_ms
@@ -292,6 +302,12 @@ class Tracer:
         self._slow_seq = 0
         self._name_stats: Dict[str, deque] = {}
         self._name_counts: Dict[str, int] = {}
+        # export ring: every finished span ONCE, in finish order, under
+        # a monotonic cursor (GET /traces/export?since=). Bounded: a
+        # collector that falls too far behind loses the oldest spans,
+        # never the node's memory.
+        self._export: deque = deque(maxlen=export_max)
+        self._export_seq = 0
 
     # -- span factory -------------------------------------------------------
 
@@ -374,6 +390,8 @@ class Tracer:
                 )
             res.append(span.duration_s or 0.0)
             self._name_counts[name] = self._name_counts.get(name, 0) + 1
+            self._export_seq += 1
+            self._export.append((self._export_seq, span))
             trace_ids = {span.context.trace_id}
             trace_ids.update(c.trace_id for c in span.links)
             for tid in trace_ids:
@@ -471,6 +489,35 @@ class Tracer:
         return {"trace_id": trace_id, "span_count": len(spans),
                 "roots": roots}
 
+    def export_spans(self, since: int = 0,
+                     limit: Optional[int] = None) -> Dict:
+        """Cursor-paginated drain of the export ring: finished spans
+        whose export seq is STRICTLY after `since`, oldest first, at
+        most `limit` (default 1000). The reply's `next` is the cursor
+        for the following poll; `dropped` counts spans that aged out of
+        the ring before this cursor reached them (a collector seeing it
+        grow knows to poll faster, not that the node lied)."""
+        if limit is None:
+            limit = 1000
+        with self._lock:
+            entries = [
+                (seq, span) for seq, span in self._export if seq > since
+            ][: max(0, int(limit))]
+            newest = self._export_seq
+            oldest = self._export[0][0] if self._export else newest + 1
+        spans = []
+        for seq, span in entries:
+            d = span.to_dict()
+            d["seq"] = seq
+            spans.append(d)
+        return {
+            "spans": spans,
+            "next": entries[-1][0] if entries else max(since, 0),
+            "newest": newest,
+            # spans this cursor can never see any more (ring eviction)
+            "dropped": max(0, oldest - 1 - max(0, int(since))),
+        }
+
     def slow_roots(self, threshold_ms: Optional[float] = None) -> List[Dict]:
         """Slowest finished root spans, slowest first, optionally filtered
         to >= threshold_ms."""
@@ -522,6 +569,8 @@ class Tracer:
             self._name_stats.clear()
             self._name_counts.clear()
             self._dropped_spans = 0
+            self._export.clear()
+            self._export_seq = 0
 
 
 # -- process-global default tracer ------------------------------------------

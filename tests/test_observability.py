@@ -320,3 +320,134 @@ class TestMiniWebStaticPages:
             assert "static page unavailable" in payload["error"]
         finally:
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-observatory feeds: the three cursor-paginated drains on the ops
+# endpoint (docs/observability.md). The contract under test everywhere:
+# samples/spans/records STRICTLY after `since`, and a second poll from
+# the reply's `next` re-reads NOTHING.
+# ---------------------------------------------------------------------------
+
+class TestFleetFeeds:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as resp:
+            return json.loads(resp.read())
+
+    def test_metrics_history_cursor_never_rereads(self):
+        from corda_tpu.node.opsserver import OpsServer
+        from corda_tpu.utils.metrics import MetricRegistry
+        from corda_tpu.utils.timeseries import MetricsHistory
+
+        registry = MetricRegistry()
+        counter = registry.counter("Fleet.TestCount")
+        history = MetricsHistory(registry, interval_s=60.0)  # manual ticks
+        counter.inc(3)
+        history.sample_once(now=100.0)
+        counter.inc(6)
+        history.sample_once(now=101.0)
+        srv = OpsServer(registry, history=history)
+        try:
+            page = self._get(srv.port, "/metrics/history?since=0")
+            assert page["enabled"] is True
+            assert [s["seq"] for s in page["samples"]] == [1, 2]
+            # counter derived as a windowed rate: 6 incs over 1s
+            second = page["samples"][1]["metrics"]["Fleet.TestCount"]
+            assert second == {"count": 9.0, "rate": 6.0}
+            # the resumed poll sees only what happened since
+            counter.inc(1)
+            history.sample_once(now=102.0)
+            page2 = self._get(
+                srv.port, f"/metrics/history?since={page['next']}"
+            )
+            assert [s["seq"] for s in page2["samples"]] == [3]
+            assert self._get(
+                srv.port, f"/metrics/history?since={page2['next']}"
+            )["samples"] == []
+            # a node without a history serves a well-formed empty page
+            bare = OpsServer(MetricRegistry())
+            try:
+                off = self._get(bare.port, "/metrics/history")
+                assert off == {"enabled": False, "samples": [],
+                               "next": 0, "newest": 0}
+            finally:
+                bare.stop()
+            # a garbage cursor is the client's fault: 400, never a 500
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics/history?since=x",
+                    timeout=5,
+                )
+            assert err.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_traces_export_cursor_drain(self, tracer):
+        from corda_tpu.node.opsserver import OpsServer
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        with tracer.span("first"):
+            pass
+        srv = OpsServer(MetricRegistry())
+        try:
+            page = self._get(srv.port, "/traces/export?since=0")
+            assert [s["name"] for s in page["spans"]] == ["first"]
+            assert page["spans"][0]["seq"] == page["next"] == 1
+            assert page["dropped"] == 0
+            with tracer.span("second"):
+                pass
+            page2 = self._get(
+                srv.port, f"/traces/export?since={page['next']}"
+            )
+            assert [s["name"] for s in page2["spans"]] == ["second"]
+            assert self._get(
+                srv.port, f"/traces/export?since={page2['next']}"
+            )["spans"] == []
+        finally:
+            srv.stop()
+
+    def test_logs_since_seq_two_polls_no_duplicates(self):
+        from corda_tpu.node.opsserver import OpsServer
+        from corda_tpu.utils.eventlog import EventLog
+        from corda_tpu.utils.metrics import MetricRegistry
+
+        log = EventLog()
+        for i in range(3):
+            log.emit("info", "fleet", f"before-{i}")
+        srv = OpsServer(MetricRegistry(), event_log=log)
+        try:
+            first = self._get(srv.port, "/logs")["events"]
+            assert [e["seq"] for e in first] == [1, 2, 3]
+            cursor = max(e["seq"] for e in first)
+            for i in range(2):
+                log.emit("info", "fleet", f"after-{i}")
+            second = self._get(
+                srv.port, f"/logs?since_seq={cursor}"
+            )["events"]
+            # the second poll re-reads NOTHING and misses nothing
+            assert [e["seq"] for e in second] == [4, 5]
+            assert [e["message"] for e in second] == ["after-0", "after-1"]
+            assert self._get(srv.port, "/logs?since_seq=5")["events"] == []
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/logs?since_seq=x",
+                    timeout=5,
+                )
+            assert err.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_seq_survives_ring_eviction(self):
+        from corda_tpu.utils.eventlog import EventLog
+
+        log = EventLog(capacity=4)
+        for i in range(10):
+            log.emit("info", "fleet", f"m{i}")
+        records = log.records()
+        # eviction dropped the oldest but seq stays monotonic + global,
+        # so a collector's since_seq cursor remains valid across drops
+        assert [e["seq"] for e in records] == [7, 8, 9, 10]
+        assert log.records(since_seq=8) == records[2:]
+        assert log.stats()["emitted"] == 10

@@ -1,0 +1,373 @@
+"""Fleet observatory (corda_tpu/loadtest/observatory.py).
+
+Covers: cross-node trace stitching (trace-id join, fan-in link join,
+cursor-replay dedupe), the notarised-pair critical-path decomposition,
+disruption MTTR + the annotated timeline (detect records, metric
+inflections), the FleetCollector's cursor-draining poll loop against a
+REAL ops endpoint over a LocalSession (wedged node = counted, not
+fatal), the gate direction pins for the new keys, soak_gate's --mttr
+ceiling, and the fleet_report renderer.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from corda_tpu.loadtest import observatory as obs
+from corda_tpu.loadtest.gate import direction
+
+
+def _span(node=None, name="rpc.start_flow", trace="t" * 32, span_id="s1",
+          start=1.0, dur=5.0, tags=None, links=None):
+    d = {"trace_id": trace, "span_id": span_id, "name": name,
+         "start": start, "duration_ms": dur, "tags": tags or {}}
+    if links:
+        d["links"] = links
+    return d
+
+
+# ---------------------------------------------------------------------------
+# stitching + critical path
+# ---------------------------------------------------------------------------
+
+class TestStitching:
+    def test_joins_by_trace_id_across_nodes(self):
+        traces = obs.stitch_traces([
+            ("bank_a", [_span(span_id="a1")]),
+            ("notary", [_span(span_id="n1", name="notary.commit_batch",
+                              start=1.004, dur=2.0)]),
+        ])
+        t = traces["t" * 32]
+        assert t["nodes"] == ["bank_a", "notary"]
+        assert t["span_count"] == 2
+        assert [s["fleet_node"] for s in t["spans"]] == ["bank_a", "notary"]
+        assert t["wall_ms"] == pytest.approx(6.0)
+
+    def test_fan_in_span_joins_every_linked_trace(self):
+        batch = _span(
+            span_id="v1", name="verifier.batch", trace="c" * 32,
+            links=[{"trace_id": "a" * 32}, {"trace_id": "b" * 32}],
+        )
+        traces = obs.stitch_traces([
+            ("a", [_span(trace="a" * 32, span_id="a1")]),
+            ("b", [_span(trace="b" * 32, span_id="b1")]),
+            ("v", [batch]),
+        ])
+        # the shared batch shows up in BOTH pairs' trees (and its own)
+        for tid in ("a" * 32, "b" * 32, "c" * 32):
+            names = {s["name"] for s in traces[tid]["spans"]}
+            assert "verifier.batch" in names
+
+    def test_cursor_replay_does_not_double_count(self):
+        s = _span(span_id="dup")
+        traces = obs.stitch_traces([("n", [s, dict(s)])])
+        assert traces["t" * 32]["span_count"] == 1
+
+    def test_critical_path_hops_in_pair_order(self):
+        tid = "p" * 32
+        spans = [
+            _span(trace=tid, span_id="1", name="rpc.start_flow",
+                  start=1.000, dur=40.0),
+            _span(trace=tid, span_id="2", name="flow.CashPaymentFlow",
+                  start=1.001, dur=38.0, tags={"responder": False}),
+            _span(trace=tid, span_id="3", name="p2p.deliver",
+                  start=1.005, dur=1.0),
+            _span(trace=tid, span_id="4", name="flow.CashPaymentResponder",
+                  start=1.007, dur=20.0, tags={"responder": True}),
+            _span(trace=tid, span_id="5", name="verifier.batch",
+                  start=1.010, dur=8.0),
+            _span(trace=tid, span_id="6", name="notary.commit_batch",
+                  start=1.020, dur=5.0),
+            # a second, SLOWER p2p hop: the critical path reports it
+            _span(trace=tid, span_id="7", name="p2p.deliver",
+                  start=1.030, dur=3.0),
+        ]
+        nodes = ["a", "a", "a", "b", "n", "n", "b"]
+        traces = obs.stitch_traces([
+            (n, [s]) for n, s in zip(nodes, spans)
+        ])
+        cp = obs.critical_path(traces[tid])
+        assert cp["complete"] is True
+        assert [h["hop"] for h in cp["hops"]] == [
+            "rpc", "initiator_flow", "p2p", "responder_flow",
+            "verifier_batch", "notary_commit",
+        ]
+        p2p = next(h for h in cp["hops"] if h["hop"] == "p2p")
+        assert p2p["duration_ms"] == 3.0 and p2p["node"] == "b"
+        resp = next(h for h in cp["hops"] if h["hop"] == "responder_flow")
+        assert resp["node"] == "b"
+
+    def test_top_paths_only_notarised_sorted_by_wall(self):
+        fast = [_span(trace="f" * 32, span_id="1", dur=2.0),
+                _span(trace="f" * 32, span_id="2", name="notary.commit",
+                      start=1.001, dur=1.0)]
+        slow = [_span(trace="d" * 32, span_id="3", dur=50.0),
+                _span(trace="d" * 32, span_id="4", name="notary.commit",
+                      start=1.010, dur=30.0)]
+        unnotarised = [_span(trace="e" * 32, span_id="5", dur=999.0)]
+        traces = obs.stitch_traces(
+            [("n", fast + slow + unnotarised)]
+        )
+        top = obs.top_critical_paths(traces, n=5)
+        assert [cp["trace_id"] for cp in top] == ["d" * 32, "f" * 32]
+        assert obs.top_critical_paths(traces, n=1)[0]["trace_id"] == "d" * 32
+
+
+# ---------------------------------------------------------------------------
+# MTTR + timeline
+# ---------------------------------------------------------------------------
+
+class TestMttrAndTimeline:
+    EVENTS = [
+        (10.0, "restart", "fired"),
+        (13.0, "restart", "recovered+2"),
+        (20.0, "hang", "fired"),
+        (21.5, "hang", "recovered+1"),
+        (30.0, "worker_kill", "skipped: no target visible"),
+        (40.0, "restart", "fired"),
+        (45.0, "restart", "recovered+3"),
+    ]
+
+    def test_mttr_means_per_kind(self):
+        mttr = obs.disruption_mttr(self.EVENTS)
+        assert mttr == {
+            "mttr_ms{kind=hang}": 1500.0,
+            "mttr_ms{kind=restart}": 4000.0,  # mean of 3s and 5s
+        }
+
+    def test_timeline_annotates_detect_and_inflections(self):
+        t0_wall = 1000.0
+        node_logs = {
+            "bank_a": [
+                {"ts": 1011.0, "level": "warning", "component": "rpc",
+                 "message": "connection lost", "seq": 1},
+                {"ts": 1011.5, "level": "info", "component": "flow",
+                 "message": "below the warning floor", "seq": 2},
+                {"ts": 1500.0, "level": "error", "component": "rpc",
+                 "message": "outside every window", "seq": 3},
+            ],
+        }
+        node_samples = {
+            "bank_a": [
+                {"seq": 1, "ts": 1009.0,
+                 "metrics": {"Pay.Count": {"count": 50, "rate": 10.0}}},
+                {"seq": 2, "ts": 1011.0,
+                 "metrics": {"Pay.Count": {"count": 51, "rate": 1.0}}},
+            ],
+        }
+        timeline = obs.build_timeline(
+            self.EVENTS, t0_wall,
+            node_logs=node_logs, node_samples=node_samples,
+        )
+        first = timeline[0]
+        assert first["kind"] == "restart"
+        assert first["mttr_ms"] == 3000.0
+        # detect: fire at t=10, first warning+ at wall 1011 -> t=11
+        assert first["detect_ms"] == 1000.0
+        assert [e["message"] for e in first["node_events"]] == [
+            "connection lost"
+        ]
+        assert first["metric_inflections"] == [{
+            "node": "bank_a", "metric": "Pay.Count",
+            "before_rate": 10.0, "during_min_rate": 1.0,
+        }]
+        # the skipped mark rides through verbatim
+        skipped = next(e for e in timeline if "skipped" in str(e.get("what")))
+        assert skipped["kind"] == "worker_kill"
+        # windows without correlated data annotate nothing but still
+        # carry the ground-truth mttr
+        assert timeline[1]["mttr_ms"] == 1500.0
+        assert timeline[1]["node_events"] == []
+
+    def test_inflection_floor_ignores_idle_families(self):
+        samples = [
+            {"ts": 1.0, "metrics": {"Idle": {"rate": 0.1},
+                                    "Busy": {"rate": 8.0}}},
+            {"ts": 5.0, "metrics": {"Idle": {"rate": 0.0},
+                                    "Busy": {"rate": 8.1}}},
+        ]
+        # Idle sits under the floor; Busy never collapsed
+        assert obs.metric_inflections(samples, 4.0, 6.0) == []
+
+
+# ---------------------------------------------------------------------------
+# the collector against a real ops endpoint over a LocalSession
+# ---------------------------------------------------------------------------
+
+class TestFleetCollector:
+    def test_poll_drains_all_feeds_and_cursors_stick(self):
+        from corda_tpu.loadtest.remote import LocalSession, parse_hosts
+        from corda_tpu.node.opsserver import OpsServer
+        from corda_tpu.utils import tracing
+        from corda_tpu.utils.eventlog import EventLog
+        from corda_tpu.utils.metrics import MetricRegistry
+        from corda_tpu.utils.timeseries import MetricsHistory
+        from corda_tpu.utils.tracing import Tracer
+
+        prev = tracing.set_tracer(Tracer())
+        registry = MetricRegistry()
+        history = MetricsHistory(registry, interval_s=60.0)
+        log = EventLog()
+        srv = OpsServer(registry, history=history, event_log=log)
+        try:
+            registry.counter("Fleet.C").inc(5)
+            history.sample_once(now=1.0)
+            with tracing.get_tracer().span("rpc.start_flow"):
+                pass
+            log.emit("warning", "fleet", "first record")
+            session = LocalSession(parse_hosts("local")[0])
+            wedged = obs.NodeProbe(
+                "ghost", session, 1, timeout_s=4.0  # port 1: unreachable
+            )
+            collector = obs.FleetCollector(
+                [obs.NodeProbe("alpha", session, srv.port, timeout_s=8.0),
+                 wedged],
+            )
+            ok = collector.poll_once()
+            assert ok == {"alpha": True, "ghost": False}
+            stats = collector.stats()
+            assert stats["spans"] == 1
+            assert stats["samples"] == 1
+            assert stats["log_records"] == 1
+            assert stats["wedged_polls"] == 1
+            # second poll: cursors advanced, nothing re-read, new data in
+            log.emit("error", "fleet", "second record")
+            with tracing.get_tracer().span("notary.commit_batch"):
+                pass
+            collector.poll_once()
+            stats = collector.stats()
+            assert stats["spans"] == 2
+            assert stats["log_records"] == 2
+            logs = collector.node_logs()["alpha"]
+            assert [e["message"] for e in logs] == [
+                "first record", "second record",
+            ]
+            traces = collector.stitched()
+            assert len(traces) == 2
+            capture = collector.capture()
+            assert capture["nodes"]["alpha"]["ok"] is True
+            assert capture["nodes"]["ghost"]["ok"] is False
+            assert capture["traces_stitched"] == 2
+            json.dumps(capture)  # the soak record embeds this verbatim
+        finally:
+            srv.stop()
+            tracing.set_tracer(prev)
+
+    def test_callable_ops_port_and_no_port_is_unreachable(self):
+        from corda_tpu.loadtest.remote import LocalSession, parse_hosts
+
+        session = LocalSession(parse_hosts("local")[0])
+        probe = obs.NodeProbe("n", session, lambda: None)
+        assert probe.ops_port is None
+        assert probe.fetch({"health": "/healthz"}) is None
+
+
+# ---------------------------------------------------------------------------
+# gate direction pins + the CLIs
+# ---------------------------------------------------------------------------
+
+class TestGateAndTools:
+    @pytest.mark.parametrize("key,expected", [
+        ("mttr_ms{kind=restart}", "lower"),
+        ("mttr.mttr_ms{kind=hang}", "lower"),
+        ("fleet_observe_overhead_pct", "lower"),
+        ("fleet_observe_on_per_sec", "higher"),
+        ("fleet_observe_off_per_sec", "higher"),
+    ])
+    def test_direction_pins(self, key, expected):
+        assert direction(key) == expected
+
+    def _record(self, mttr):
+        return {
+            "pairs": 10, "hard_error_rate": 0.0, "consistent": True,
+            "disruptions_fired": 3, "disruptions_recovered": 3,
+            "mttr": mttr, "slo_violations": [],
+        }
+
+    def _soak_gate(self, record, *extra):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "soak_gate.py"),
+             "--current", "-", *extra],
+            input=json.dumps(record), capture_output=True, text=True,
+        )
+
+    def test_soak_gate_mttr_breach_fails(self):
+        record = self._record({"mttr_ms{kind=restart}": 3000.0,
+                               "mttr_ms{kind=hang}": 90000.0})
+        proc = self._soak_gate(record, "--mttr", "60000")
+        assert proc.returncode == 1
+        verdict = json.loads(proc.stdout)
+        assert any(
+            v["key"] == "mttr.mttr_ms{kind=hang}" and v["kind"] == "max"
+            for v in verdict["violations"]
+        )
+        # under the ceiling: passes
+        assert self._soak_gate(record, "--mttr", "120000").returncode == 0
+
+    def test_soak_gate_missing_mttr_on_disrupted_run_breaches(self):
+        proc = self._soak_gate(self._record({}), "--mttr", "60000")
+        assert proc.returncode == 1
+        verdict = json.loads(proc.stdout)
+        assert any(
+            v["key"] == "mttr" and v["kind"] == "missing"
+            for v in verdict["violations"]
+        )
+        # without --mttr the same record still passes (opt-in ceiling)
+        assert self._soak_gate(self._record({})).returncode == 0
+
+    def test_fleet_report_renders_all_sections(self, tmp_path):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        record = {
+            "fleet": {
+                "nodes": {"bank_a": {"ok": True, "health": "ok",
+                                     "wedged_polls": 0, "spans": 12,
+                                     "log_records": 3, "samples": 9}},
+                "polls": 4, "wedged_polls": 0, "traces_stitched": 2,
+                "cross_node_traces": 1,
+                "critical_paths": [{
+                    "trace_id": "a" * 32, "wall_ms": 42.0,
+                    "nodes": ["bank_a", "notary"], "complete": True,
+                    "hops": [{"hop": "rpc", "name": "rpc.start_flow",
+                              "node": "bank_a", "t_offset_ms": 0.0,
+                              "duration_ms": 40.0}],
+                }],
+            },
+            "timeline": [{"kind": "restart", "what": "recovered+2",
+                          "fired_t": 10.0, "recovered_t": 13.0,
+                          "mttr_ms": 3000.0, "detect_ms": 1000.0,
+                          "node_events": [{"node": "bank_a", "t": 11.0,
+                                           "level": "warning",
+                                           "component": "rpc",
+                                           "message": "connection lost"}],
+                          "metric_inflections": []}],
+            "mttr": {"mttr_ms{kind=restart}": 3000.0},
+        }
+        path = tmp_path / "soak.json"
+        path.write_text(json.dumps(record))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "fleet_report.py"),
+             "--current", str(path)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0
+        out = proc.stdout
+        assert "== fleet ==" in out and "bank_a" in out
+        assert "mttr=3000.0ms" in out and "detect=1000.0ms" in out
+        assert "connection lost" in out
+        assert "rpc.start_flow on bank_a" in out
+        # an empty record renders placeholders, exit 0 (report != gate)
+        empty = tmp_path / "empty.json"
+        empty.write_text("{}")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "fleet_report.py"),
+             "--current", str(empty)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0
+        assert "(no fleet capture in record)" in proc.stdout

@@ -1,6 +1,8 @@
-"""The DEFAULT-tier real-process slice (ISSUE 14 acceptance): a budgeted
-2-process issue+pay over real TCP brokers with a mid-run shard-worker
-SIGKILL.
+"""The DEFAULT-tier real-process slice: a budgeted 2-process issue+pay
+over real TCP brokers with a mid-run shard-worker SIGKILL (ISSUE 14
+acceptance), plus the fleet-observatory stitch check — one trace joined
+across >= 2 OS processes from their /traces/export feeds (ISSUE 17
+acceptance).
 
 Everything else that boots OS processes lives in the nightly heavy tier
 (conftest._HEAVY_FILES) — the driver's default run used to see zero real
@@ -138,6 +140,109 @@ def test_two_node_tcp_issue_pay_with_worker_kill(monkeypatch):
         driver.stop(timeout=budget_left("driver stop"))
         assert_no_loss_no_dup(driver, nodes[0])
         assert len(driver.completed) >= before + 3
+    finally:
+        if driver is not None and not driver._stop.is_set():
+            try:
+                driver.stop(timeout=5)
+            except BaseException:
+                pass  # lint: allow(swallow) — teardown must close the nodes
+        for n in nodes:
+            n.close()
+
+
+def test_fleet_observatory_stitches_one_trace_across_processes():
+    """Boot the 2-process network with an ops endpoint on BOTH nodes,
+    drive issue+pay pairs over real TCP, then run the fleet collector
+    over them and require ONE stitched trace whose spans came from >= 2
+    OS processes — including the verifier batch and the notary commit —
+    i.e. the W3C traceparent really rode the broker wire between
+    processes and the observatory really joined the stores
+    (docs/observability.md, fleet observatory)."""
+    reason = _skip_reason()
+    if reason:
+        pytest.skip(reason)
+
+    from corda_tpu.loadtest.observatory import FleetCollector, NodeProbe
+    from corda_tpu.loadtest.procdriver import PairDriver, resolve_identities
+    from corda_tpu.loadtest.remote import LocalSession, parse_hosts
+    from corda_tpu.testing.smoketesting import Factory
+    from corda_tpu.tools.cordform import deploy_nodes
+
+    t0 = time.monotonic()
+
+    def budget_left(phase: str) -> float:
+        left = _BUDGET_S - (time.monotonic() - t0)
+        assert left > 0, (
+            f"tier-1 fleet-stitch budget ({_BUDGET_S}s) exhausted "
+            f"during {phase}"
+        )
+        return left
+
+    base = tempfile.mkdtemp(prefix="t1-fleet-")
+    spec = {"nodes": [
+        {"name": "O=T1FleetNotary,L=Zurich,C=CH", "notary": "validating",
+         "network_map_service": True, "ops_port": 0},
+        {"name": "O=T1FleetBank,L=London,C=GB", "ops_port": 0},
+    ]}
+    resolved = deploy_nodes(spec, base)
+    factory = Factory(base)
+    nodes = []
+    driver = None
+    try:
+        for conf in resolved:
+            nodes.append(
+                factory.launch(conf["dir"], timeout=budget_left("boot"))
+            )
+        for node in nodes:
+            assert node.ops_port, (
+                "ready.json carried no ops_port despite ops_port:0 in "
+                "the node spec"
+            )
+        me, notary, peer = resolve_identities(nodes[1], nodes[0])
+        driver = PairDriver(nodes[1], notary, me, peer).start()
+        while len(driver.completed) < 2:
+            budget_left("pairs")
+            assert driver._thread.is_alive(), (
+                f"driver died: {driver.errors[-3:]}"
+            )
+            time.sleep(0.2)
+        driver.stop(timeout=budget_left("driver stop"))
+
+        session = LocalSession(parse_hosts("local")[0])
+        collector = FleetCollector([
+            NodeProbe("notary", session, nodes[0].ops_port,
+                      timeout_s=budget_left("collect")),
+            NodeProbe("bank", session, nodes[1].ops_port,
+                      timeout_s=budget_left("collect")),
+        ])
+        ok = collector.poll_once()
+        assert ok == {"notary": True, "bank": True}, ok
+
+        traces = collector.stitched()
+        cross = [
+            t for t in traces.values() if len(t.get("nodes", ())) >= 2
+        ]
+        assert cross, (
+            "no stitched trace spans >= 2 OS processes; "
+            f"stitched={len(traces)}"
+        )
+        # the notarised pair's tree: bank-side flow + notary-side
+        # verifier batch and commit, joined under ONE trace id
+        def names(t):
+            return {s["name"] for s in t["spans"]}
+
+        full = [
+            t for t in cross
+            if any(n.startswith("notary.") for n in names(t))
+            and "verifier.batch" in names(t)
+        ]
+        assert full, (
+            "no cross-process trace reached verifier batch + notary "
+            f"commit; cross-node names={[sorted(names(t)) for t in cross]}"
+        )
+        span_nodes = {s["fleet_node"] for s in full[0]["spans"]}
+        assert {"notary", "bank"} <= span_nodes
+        assert collector.capture()["cross_node_traces"] >= 1
     finally:
         if driver is not None and not driver._stop.is_set():
             try:

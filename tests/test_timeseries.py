@@ -1,0 +1,136 @@
+"""Metric time-series ring (corda_tpu/utils/timeseries.py).
+
+Covers: per-type derivation (counters/meters to windowed rates, gauges
+to last numeric readings, timers to window rate/mean + reservoir
+quantiles), the strictly-after `since()` cursor contract, the bounded
+ring, the quiesce-registered poller lifecycle, and the
+CORDA_TPU_METRICS_HISTORY kill switch.
+"""
+import time
+
+from corda_tpu.utils import quiesce
+from corda_tpu.utils.metrics import MetricRegistry
+from corda_tpu.utils.timeseries import (
+    MetricsHistory,
+    history_enabled,
+    latest_rates,
+)
+
+
+def _history(registry=None, **kw):
+    kw.setdefault("interval_s", 60.0)  # ticks driven manually by tests
+    return MetricsHistory(registry or MetricRegistry(), **kw)
+
+
+class TestDerivation:
+    def test_counter_becomes_windowed_rate(self):
+        registry = MetricRegistry()
+        history = _history(registry)
+        counter = registry.counter("Pay.Count")
+        counter.inc(4)
+        first = history.sample_once(now=10.0)
+        # no previous sample -> no window to rate over
+        assert first["metrics"]["Pay.Count"] == {"count": 4.0, "rate": None}
+        counter.inc(10)
+        second = history.sample_once(now=12.0)
+        assert second["metrics"]["Pay.Count"] == {"count": 14.0, "rate": 5.0}
+        assert second["dt_s"] == 2.0
+        # a counter that went quiet rates 0.0, not None (the inflection
+        # detector needs "stopped" to be a reading, not a gap)
+        third = history.sample_once(now=13.0)
+        assert third["metrics"]["Pay.Count"]["rate"] == 0.0
+
+    def test_gauge_keeps_last_numeric_reading_and_skips_dead(self):
+        registry = MetricRegistry()
+        history = _history(registry)
+        registry.gauge("Live.Depth", lambda: 7)
+        registry.gauge("Live.Flag", lambda: True)
+        registry.gauge("Dead.Gauge", lambda: 1 / 0)
+        sample = history.sample_once(now=1.0)
+        assert sample["metrics"]["Live.Depth"] == {"value": 7}
+        assert sample["metrics"]["Live.Flag"] == {"value": 1}
+        assert "Dead.Gauge" not in sample["metrics"]
+
+    def test_timer_window_mean_and_quantiles(self):
+        registry = MetricRegistry()
+        history = _history(registry)
+        timer = registry.timer("Verify.Wall")
+        timer.update(0.2)
+        history.sample_once(now=1.0)
+        timer.update(0.4)
+        timer.update(0.6)
+        sample = history.sample_once(now=2.0)
+        derived = sample["metrics"]["Verify.Wall"]
+        assert derived["count"] == 3.0
+        assert derived["rate"] == 2.0
+        assert abs(derived["window_mean"] - 0.5) < 1e-9
+        assert "p50" in derived and "p95" in derived
+
+    def test_latest_rates_helper(self):
+        registry = MetricRegistry()
+        history = _history(registry)
+        counter = registry.counter("C")
+        counter.inc()
+        history.sample_once(now=1.0)
+        counter.inc(3)
+        history.sample_once(now=2.0)
+        samples = history.since()["samples"]
+        series = latest_rates(samples, "C")
+        assert len(series) == 1 and series[0][1] == 3.0
+
+
+class TestCursorAndBounds:
+    def test_since_is_strictly_after_and_resumable(self):
+        history = _history()
+        for i in range(5):
+            history.sample_once(now=float(i))
+        page = history.since(cursor=0, limit=3)
+        assert [s["seq"] for s in page["samples"]] == [1, 2, 3]
+        assert page["next"] == 3 and page["newest"] == 5
+        page2 = history.since(cursor=page["next"])
+        assert [s["seq"] for s in page2["samples"]] == [4, 5]
+        # drained: next holds position instead of rewinding
+        assert history.since(cursor=5)["samples"] == []
+        assert history.since(cursor=5)["next"] == 5
+
+    def test_ring_is_bounded_but_seq_is_global(self):
+        history = _history(maxlen=3)
+        for i in range(10):
+            history.sample_once(now=float(i))
+        page = history.since()
+        assert [s["seq"] for s in page["samples"]] == [8, 9, 10]
+        assert history.stats()["sampled"] == 10
+
+
+class TestPollerLifecycle:
+    def test_start_registers_quiesce_and_pause_skips_sampling(self):
+        history = _history(name="t-lifecycle", interval_s=0.02)
+        try:
+            history.start()
+            assert history.start() is history  # idempotent
+            assert any(
+                name == history._quiesce_name
+                for name, _, _ in quiesce._registry
+            )
+            deadline = time.monotonic() + 5
+            while history.stats()["sampled"] == 0:
+                assert time.monotonic() < deadline, "poller never sampled"
+                time.sleep(0.01)
+            history.pause()
+            time.sleep(0.06)
+            frozen = history.stats()["sampled"]
+            time.sleep(0.06)
+            assert history.stats()["sampled"] == frozen
+        finally:
+            history.stop()
+        assert not any(
+            name == history._quiesce_name
+            for name, _, _ in quiesce._registry
+        )
+        assert history.stats()["running"] is False
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_METRICS_HISTORY", "0")
+        assert history_enabled() is False
+        monkeypatch.delenv("CORDA_TPU_METRICS_HISTORY")
+        assert history_enabled() is True

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Fleet report CLI: render a soak record's observatory blocks for humans.
+
+    python -m corda_tpu.loadtest.remote --hosts hosts.conf > soak.json
+    python tools/fleet_report.py --current soak.json
+    python tools/fleet_report.py --current - --paths 3
+
+Three sections, all read from the record the soak already saved (this
+tool never talks to a live rig — post-mortems outlive their processes):
+
+  * the fleet table: one row per node — reachability, health, wedged
+    polls, and how many spans / log records / samples it contributed;
+  * the disruption timeline: fire→heal per catalog kind with mttr_ms,
+    detect_ms, the correlated warning+ node events, and the metric rate
+    inflections around each window;
+  * the top-N stitched cross-node critical paths: per-hop walls down
+    the rpc → initiator flow → p2p → responder flow → verifier batch →
+    notary commit chain, each hop labelled with the node it ran on.
+
+Exit status: 0 = rendered, 2 = unreadable record — a report tool has
+no pass/fail opinion (that's tools/soak_gate.py's job).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, _REPO)
+
+
+def _fmt_ms(value) -> str:
+    return f"{value:.1f}ms" if isinstance(value, (int, float)) else "-"
+
+
+def render(record: dict, paths: int = 5) -> str:
+    lines = []
+    out = lines.append
+
+    fleet = record.get("fleet") or {}
+    nodes = fleet.get("nodes") or {}
+    out("== fleet ==")
+    if nodes:
+        out(f"{'node':<10} {'ok':<4} {'health':<10} {'wedged':>6} "
+            f"{'spans':>7} {'logs':>6} {'samples':>8}")
+        for name in sorted(nodes):
+            st = nodes[name] or {}
+            out(f"{name:<10} {str(st.get('ok', '-')):<4} "
+                f"{str(st.get('health', '-')):<10} "
+                f"{st.get('wedged_polls', 0):>6} "
+                f"{st.get('spans', 0):>7} {st.get('log_records', 0):>6} "
+                f"{st.get('samples', 0):>8}")
+        out(f"polls={fleet.get('polls', 0)} "
+            f"wedged_polls={fleet.get('wedged_polls', 0)} "
+            f"traces_stitched={fleet.get('traces_stitched', 0)} "
+            f"cross_node={fleet.get('cross_node_traces', 0)}")
+    else:
+        out("(no fleet capture in record)")
+
+    out("")
+    out("== disruption timeline ==")
+    timeline = record.get("timeline") or []
+    mttr = record.get("mttr") or {}
+    if not timeline:
+        out("(no timeline in record)")
+    for entry in timeline:
+        kind = entry.get("kind", "?")
+        if "mttr_ms" not in entry:
+            out(f"  t={entry.get('t', entry.get('recovered_t', '-'))} "
+                f"{kind}: {entry.get('what', '?')}")
+            continue
+        out(f"  {kind}: fired t={entry.get('fired_t')}s healed "
+            f"t={entry.get('recovered_t')}s "
+            f"mttr={_fmt_ms(entry.get('mttr_ms'))} "
+            f"detect={_fmt_ms(entry.get('detect_ms'))}")
+        for rec in entry.get("node_events") or []:
+            out(f"      [{rec.get('node')}] t={rec.get('t')}s "
+                f"{rec.get('level')}/{rec.get('component')}: "
+                f"{rec.get('message')}")
+        for inf in entry.get("metric_inflections") or []:
+            out(f"      [{inf.get('node')}] {inf.get('metric')}: "
+                f"{inf.get('before_rate')}/s -> "
+                f"{inf.get('during_min_rate')}/s")
+    if mttr:
+        out("  mean per kind: " + "  ".join(
+            f"{k}={_fmt_ms(v)}" for k, v in sorted(mttr.items())
+        ))
+
+    out("")
+    out("== critical paths ==")
+    cps = (fleet.get("critical_paths") or [])[: max(0, paths)]
+    if not cps:
+        out("(no stitched critical paths in record)")
+    for cp in cps:
+        nodes_s = ",".join(cp.get("nodes") or [])
+        flag = "" if cp.get("complete") else "  [incomplete]"
+        out(f"  trace {cp.get('trace_id')} wall={_fmt_ms(cp.get('wall_ms'))} "
+            f"nodes=[{nodes_s}]{flag}")
+        for hop in cp.get("hops") or []:
+            out(f"      {hop.get('hop'):<16} {_fmt_ms(hop.get('duration_ms')):>10} "
+                f"@+{hop.get('t_offset_ms', 0):.1f}ms  "
+                f"{hop.get('name')} on {hop.get('node')}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_report")
+    ap.add_argument(
+        "--current", required=True,
+        help="soak record to render: a JSON file, or '-' for stdin",
+    )
+    ap.add_argument(
+        "--paths", type=int, default=5,
+        help="how many stitched critical paths to show (default 5)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        if args.current == "-":
+            record = json.load(sys.stdin)
+        else:
+            with open(args.current) as fh:
+                record = json.load(fh)
+        if not isinstance(record, dict):
+            raise ValueError("not a soak record")
+    except (OSError, ValueError) as exc:
+        print(f"fleet_report: cannot read record: {exc}", file=sys.stderr)
+        return 2
+
+    sys.stdout.write(render(record, paths=args.paths))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

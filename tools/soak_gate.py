@@ -11,7 +11,11 @@ Fails (exit 1) on:
   * `consistent` false, or loss/dup evidence (`hard_driver_errors`,
     `reconciliation.torn_spends`);
   * any extra `--slo` bound asserted here (gate.check_slos semantics:
-    a bound on a metric the record lacks is a violation, not a skip).
+    a bound on a metric the record lacks is a violation, not a skip);
+  * any `--mttr MS` repair-time ceiling: every `mttr_ms{kind=…}` key in
+    the record's `mttr` block must sit under the bound, and a record
+    that fired disruptions but carries NO mttr block breaches too (an
+    observatory that silently stopped reporting must not read as green).
 
 Exit status: 0 = pass, 1 = breach, 2 = usage error — the same contract
 as tools/bench_gate.py, sharing its comparison engine
@@ -50,6 +54,11 @@ def main(argv=None) -> int:
         help="extra absolute bound to assert (repeatable; dotted keys "
              "reach nested blocks, e.g. overload.recovered>=1)",
     )
+    ap.add_argument(
+        "--mttr", type=float, metavar="MS",
+        help="ceiling (ms) asserted on EVERY mttr_ms{kind=…} the record "
+             "reports; missing mttr block on a disrupted run = breach",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -72,6 +81,22 @@ def main(argv=None) -> int:
 
     violations = list(record.get("slo_violations") or [])
     violations.extend(gate.check_slos(record, slos))
+    if args.mttr is not None:
+        mttr = record.get("mttr") or {}
+        kinds = {
+            k: v for k, v in mttr.items() if k.startswith("mttr_ms{")
+        }
+        if not kinds and record.get("disruptions_recovered"):
+            violations.append({
+                "key": "mttr", "value": None, "bound": args.mttr,
+                "kind": "missing",
+            })
+        for key, value in sorted(kinds.items()):
+            if not isinstance(value, (int, float)) or value > args.mttr:
+                violations.append({
+                    "key": f"mttr.{key}", "value": value,
+                    "bound": args.mttr, "kind": "max",
+                })
     if record.get("consistent") is not True:
         violations.append({
             "key": "consistent", "value": record.get("consistent"),
