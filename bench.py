@@ -342,6 +342,15 @@ def _measured_main(_quiesce) -> None:
             gate_cmd += [
                 "--slo", "stage_timings.fleet_observe_overhead_pct<=25",
             ]
+        if isinstance(
+            record.get("stage_timings", {}).get(
+                "kernel_observe_overhead_pct"
+            ),
+            (int, float),
+        ):
+            gate_cmd += [
+                "--slo", "stage_timings.kernel_observe_overhead_pct<=25",
+            ]
         proc = subprocess.run(
             gate_cmd,
             input=json.dumps(record), text=True,
@@ -358,6 +367,13 @@ def _persist_inline_capture(record: dict) -> None:
     CPU-pinned) replays THIS round's kernel number via
     _best_tpu_capture() instead of an older artifact."""
     here = os.path.dirname(os.path.abspath(__file__))
+    # tpu_capture join: stamp the kernel flight ledger so every record
+    # the live run produced (and produces) carries provenance.live —
+    # a /kernels drain or kernel_report of this run is attributable to
+    # the same capture event the headline cites
+    from corda_tpu.utils import profiling as _profiling
+
+    _profiling.annotate_provenance({"live": True, "step": "bench-inline"})
     try:
         os.makedirs(os.path.join(here, "tpu_capture"), exist_ok=True)
         with open(os.path.join(here, "tpu_capture", "log.jsonl"), "a") as f:
@@ -683,6 +699,18 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         fleet_ab = {"fleet_observe_error": f"{type(exc).__name__}: {exc}"}
 
+    # Device-plane kernel-ledger A/B (docs/observability.md "Device
+    # plane"): ledger killed vs ledger + a collector draining /kernels
+    # — per-dispatch recording must stay within run-to-run noise too.
+    from corda_tpu.loadtest.observatory import (
+        measure_kernel_observe_overhead,
+    )
+
+    try:
+        kernel_ab = measure_kernel_observe_overhead()
+    except Exception as exc:
+        kernel_ab = {"kernel_observe_error": f"{type(exc).__name__}: {exc}"}
+
     # Mesh-sharded dispatch scaling curve (docs/perf-pipeline.md): the
     # `mesh_sigs_s{n=...}` points, one virtual-device subprocess per N,
     # with the CORDA_TPU_MESH_DEVICES=0 comparator at n=0.
@@ -756,6 +784,19 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "fleet_observe_overhead_pct": fleet_ab.get(
             "fleet_observe_overhead_pct"
         ),
+        "kernel_observe_off_per_sec": kernel_ab.get(
+            "kernel_observe_off_per_sec"
+        ),
+        "kernel_observe_on_per_sec": kernel_ab.get(
+            "kernel_observe_on_per_sec"
+        ),
+        "kernel_observe_overhead_pct": kernel_ab.get(
+            "kernel_observe_overhead_pct"
+        ),
+        # the flight ledger's derived roofline view for THIS run: what
+        # the engaged kernels actually achieved vs the per-backend peak
+        # (docs/perf-roofline.md "attainment is MEASURED")
+        "kernel_attainment": profiling.attainment(),
     }
     stage_timings.update(mesh_curve)
     out = {
@@ -791,6 +832,7 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     out.update(cp_group)
     out.update(lane_ab)
     out.update(fleet_ab)
+    out.update(kernel_ab)
 
     # Full-system throughput: issue+pay pairs through REAL node processes
     # (cordform network, TCP brokers, bridges, validating notary) — the

@@ -181,6 +181,14 @@ _ENTRIES = [
        "finished-span export ring capacity (/traces/export)"),
     _k("CORDA_TPU_FLEET_POLL_S", "2.0", "docs/observability.md",
        "fleet collector poll interval over the node probes (seconds)"),
+    # -- device-plane kernel ledger (this PR) --------------------------------
+    _k("CORDA_TPU_KERNEL_LEDGER", "1", "docs/observability.md",
+       "0 kills the per-dispatch kernel flight ledger (aggregate "
+       "dispatch stats keep recording)"),
+    _k("CORDA_TPU_KERNEL_LEDGER_MAX", "1024", "docs/observability.md",
+       "kernel flight ledger ring capacity (records)"),
+    _k("CORDA_TPU_KERNEL_LEDGER_COST", "1", "docs/observability.md",
+       "0 skips XLA cost-analysis capture at kernel lowering time"),
     # -- lockcheck (this PR) -------------------------------------------------
     _k("CORDA_TPU_LOCKCHECK", "0", "docs/static-analysis.md",
        "1 arms the runtime lock-order deadlock detector"),

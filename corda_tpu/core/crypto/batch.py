@@ -601,15 +601,21 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
     plan_min = getattr(plan, "mesh_min_batch", None)
     for name, idx in plan.buckets.items():
         is_ed = name == EDDSA_ED25519_SHA512.scheme_code_name
+        kernel = (
+            "ed25519.verify_batch" if is_ed
+            else f"ecdsa.{_ECDSA_CURVES[name]}.verify_batch"
+        )
         mask = None
         if _mesh_would_serve(idx, plan_mesh, plan_min):
-            from ...parallel.mesh import shard_verify
+            from ...parallel.mesh import shard_layout, shard_verify
+            from ...utils import profiling
 
             pubs = [flat[i][0].encoded for i in idx]
             sigs = [flat[i][1] for i in idx]
             msgs = [flat[i][2] for i in idx]
             scheme_kind = "ed25519" if is_ed else _ECDSA_CURVES[name]
             mesh = plan_mesh if plan_mesh is not None else _MESH
+            t0 = _time.perf_counter()
             try:
                 mask, total = shard_verify(
                     mesh, scheme_kind, pubs, sigs, msgs, return_total=True
@@ -618,6 +624,19 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
                 # notary's uniqueness pre-check (docs/perf-pipeline.md)
                 plan.mesh_totals[scheme_kind] = (
                     plan.mesh_totals.get(scheme_kind, 0) + total
+                )
+                try:
+                    _, mesh_rows, _ = shard_layout(
+                        mesh, scheme_kind, len(idx)
+                    )
+                # the ledger row still lands without its padding math
+                # lint: allow(swallow) — telemetry must not fail dispatch
+                except Exception:
+                    mesh_rows = None
+                profiling.record_dispatch(
+                    kernel, _time.perf_counter() - t0,
+                    scheme=name, rows=mesh_rows, real_rows=len(idx),
+                    mesh_n=int(mesh.devices.size), stage="mesh",
                 )
             except Exception:
                 # a mesh-path failure (e.g. Pallas-under-shard_map
@@ -642,10 +661,6 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
             for j, i in enumerate(idx):
                 results[i] = bool(mask[j])
             continue
-        kernel = (
-            "ed25519.verify_batch" if is_ed
-            else f"ecdsa.{_ECDSA_CURVES[name]}.verify_batch"
-        )
         prepared = plan.prepared.get(name)
         if prepared is not None:
             # split route: asynchronous launch, deferred materialisation
@@ -653,8 +668,9 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
 
             kwargs, _n = prepared
             t0 = _time.perf_counter()
+            donate = _pipeline_donate()
             launch = (
-                _ed.verify_kernel_donated if _pipeline_donate()
+                _ed.verify_kernel_donated if donate
                 else _ed.verify_kernel
             )
             mask = launch(**kwargs)
@@ -663,8 +679,11 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
             # clock instead would count time the batch merely queued
             # between pipeline stages as device time and make the Jax.*
             # gauges report phantom slowdown under the pipeline.
+            rows, bucket = _shape_bucket(True, _n)
             plan.pending.append(
-                (kernel, idx, mask, _time.perf_counter() - t0)
+                (kernel, idx, mask, _time.perf_counter() - t0,
+                 {"scheme": name, "bucket": bucket, "rows": rows,
+                  "real_rows": _n, "donated": donate})
             )
             continue
         from ... import ops
@@ -680,8 +699,13 @@ def dispatch_plan(plan: BatchPlan) -> BatchPlan:
             else ops.ecdsa_verify_batch(_ECDSA_CURVES[name], pubs, sigs, msgs)
         )
         # backpressure telemetry seam: one record per DISPATCH (not
-        # per signature) feeds the ops endpoint's Jax.* gauges
-        profiling.record_dispatch(kernel, _time.perf_counter() - t0)
+        # per signature) feeds the ops endpoint's Jax.* gauges and the
+        # kernel flight ledger (rows vs real_rows = padding occupancy)
+        rows, bucket = _shape_bucket(is_ed, len(idx))
+        profiling.record_dispatch(
+            kernel, _time.perf_counter() - t0,
+            scheme=name, bucket=bucket, rows=rows, real_rows=len(idx),
+        )
         for j, i in enumerate(idx):
             results[i] = bool(mask[j])
 
@@ -743,7 +767,7 @@ def collect_plan(plan: BatchPlan) -> List[bool]:
     from ...utils import profiling
 
     results = plan.flat_results
-    for kernel, idx, mask, launch_wall in plan.pending:
+    for kernel, idx, mask, launch_wall, meta in plan.pending:
         t0 = _time.perf_counter()
         arr = _np.asarray(mask)  # the deferred block_until_ready
         # launch wall + the blocking wait for THIS batch's result: the
@@ -752,7 +776,10 @@ def collect_plan(plan: BatchPlan) -> List[bool]:
         # batch whose device work finished while queued records ~launch
         # cost alone — a lower bound, never a phantom slowdown)
         profiling.record_dispatch(
-            kernel, launch_wall + (_time.perf_counter() - t0)
+            kernel, launch_wall + (_time.perf_counter() - t0),
+            scheme=meta["scheme"], bucket=meta["bucket"],
+            rows=meta["rows"], real_rows=meta["real_rows"],
+            donated=meta["donated"],
         )
         for j, i in enumerate(idx):
             results[i] = bool(arr[j])
@@ -766,6 +793,26 @@ def collect_plan(plan: BatchPlan) -> List[bool]:
         ok = all(results[r] for r in rows)
         plan.results[i] = ok and ckey.is_fulfilled_by(set(leaf_keys))
     return plan.results
+
+
+def _shape_bucket(is_ed: bool, n: int) -> tuple:
+    """(padded rows, bucket label) for an n-row single-device device
+    batch — the kernels' padding rules mirrored jax-free so the kernel
+    flight ledger can label every record. Ed25519 uses the shared shape
+    buckets (off-bucket overflow pads to a 65536 multiple, label
+    "other"); ECDSA pads to the next power of two with a floor of 8.
+    The TPU Pallas BLK floor can pad higher than this estimate; the
+    label still names the bucket family the compile counters use."""
+    from ...utils import profiling as _prof
+
+    if is_ed:
+        for b in _prof.ED25519_SHAPE_BUCKETS:
+            if n <= b:
+                return b, str(b)
+        last = _prof.ED25519_SHAPE_BUCKETS[-1]
+        return ((n + last - 1) // last) * last, "other"
+    padded = max(8, 1 << (max(n, 1) - 1).bit_length())
+    return padded, str(padded)
 
 
 def _pipeline_donate() -> bool:

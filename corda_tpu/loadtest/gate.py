@@ -44,7 +44,13 @@ _HIGHER = re.compile(
     # shrinking native-vs-python speedup is the regression direction
     r"|_speedup_x"
     # checkpoint group-commit throughput (docs/perf-system.md round 20)
-    r"|_flows_s)$"
+    r"|_flows_s"
+    # roofline attainment (docs/perf-roofline.md "attainment is
+    # MEASURED"): a kernel drifting away from its peak is the
+    # regression direction. No leading underscore: the flattened
+    # stage_timings.kernel_attainment.<kernel> leaf is bare
+    # "attainment_pct" after the dotted-prefix strip.
+    r"|attainment_pct)$"
 )
 #: _overhead_pct: the observatory A/B (fleet_observe_overhead_pct) and
 #: kin — a growing observation tax is the regression direction
@@ -59,8 +65,11 @@ def direction(key: str) -> Optional[str]:
     docs/observability.md) is stripped before classification, so the
     mesh scaling-curve keys ``mesh_sigs_s{n=4}`` gate exactly like
     ``mesh_sigs_s``."""
-    k = key.rsplit(".", 1)[-1].lower()
-    k = re.sub(r"\{[^{}]*\}$", "", k)
+    # label strip FIRST: label values may contain dots
+    # (kernel_attainment_pct{kernel=ed25519.verify_batch}), which would
+    # otherwise confuse the dotted-prefix strip
+    k = re.sub(r"\{[^{}]*\}$", "", key.lower())
+    k = k.rsplit(".", 1)[-1]
     if _HIGHER.search(k):
         return "higher"
     if _LOWER.search(k) or _LOWER_HINT.search(k):

@@ -138,6 +138,7 @@ class FleetCollector:
     SPAN_CAP = 20000
     LOG_CAP = 4000
     SAMPLE_CAP = 2048
+    KERNEL_CAP = 2048
 
     def __init__(self, probes: Iterable[NodeProbe],
                  poll_interval_s: Optional[float] = None):
@@ -149,13 +150,21 @@ class FleetCollector:
         self.poll_interval_s = max(0.1, poll_interval_s)
         self._lock = lockorder.make_lock("FleetCollector._lock")
         self._cursors: Dict[str, Dict[str, int]] = {
-            p.name: {"history": 0, "spans": 0, "logs": 0}
+            p.name: {"history": 0, "spans": 0, "logs": 0, "kernels": 0}
             for p in self.probes
         }
         self._spans: Dict[str, List[Dict]] = {p.name: [] for p in self.probes}
         self._logs: Dict[str, List[Dict]] = {p.name: [] for p in self.probes}
         self._samples: Dict[str, List[Dict]] = {
             p.name: [] for p in self.probes
+        }
+        self._kernels: Dict[str, List[Dict]] = {
+            p.name: [] for p in self.probes
+        }
+        #: latest /kernels attainment view per node (the derived table
+        #: rides every page, so keep only the newest)
+        self._kernel_attainment: Dict[str, Dict] = {
+            p.name: {} for p in self.probes
         }
         self._status: Dict[str, Dict] = {p.name: {} for p in self.probes}
         self._wedged_by_node: Dict[str, int] = {p.name: 0 for p in self.probes}
@@ -213,6 +222,9 @@ class FleetCollector:
                 # records, and a busy node's info/debug volume would
                 # dominate every poll's payload for nothing
                 "logs": f"/logs?since_seq={cur['logs']}&level=warning",
+                # device-plane kernel ledger: same strictly-after drain,
+                # same single session.run budget as the other feeds
+                "kernels": f"/kernels?since={cur['kernels']}",
                 "health": "/healthz",
             })
 
@@ -296,6 +308,17 @@ class FleetCollector:
                     cur["logs"],
                     max(e.get("seq", 0) for e in logs["events"]),
                 )
+        kernels = payload.get("kernels") or {}
+        if isinstance(kernels.get("records"), list):
+            newest = kernels.get("newest")
+            if isinstance(newest, (int, float)) and newest < cur["kernels"]:
+                cur["kernels"] = 0  # process restarted: fresh ledger
+            else:
+                self._kernels[name].extend(kernels["records"])
+                del self._kernels[name][: -self.KERNEL_CAP]
+                cur["kernels"] = int(kernels.get("next", cur["kernels"]))
+            if isinstance(kernels.get("attainment"), dict):
+                self._kernel_attainment[name] = kernels["attainment"]
         self._status[name] = {
             "ok": True,
             "ts": round(time.time(), 3),
@@ -316,6 +339,10 @@ class FleetCollector:
         with self._lock:
             return {n: list(v) for n, v in self._samples.items()}
 
+    def node_kernels(self) -> Dict[str, List[Dict]]:
+        with self._lock:
+            return {n: list(v) for n, v in self._kernels.items()}
+
     def stats(self) -> Dict:
         with self._lock:
             return {
@@ -325,6 +352,9 @@ class FleetCollector:
                 "spans": sum(len(v) for v in self._spans.values()),
                 "log_records": sum(len(v) for v in self._logs.values()),
                 "samples": sum(len(v) for v in self._samples.values()),
+                "kernel_records": sum(
+                    len(v) for v in self._kernels.values()
+                ),
             }
 
     def stitched(self) -> Dict[str, Dict]:
@@ -343,6 +373,10 @@ class FleetCollector:
                     "spans": len(self._spans[p.name]),
                     "log_records": len(self._logs[p.name]),
                     "samples": len(self._samples[p.name]),
+                    "kernel_records": len(self._kernels[p.name]),
+                    "kernel_attainment": dict(
+                        self._kernel_attainment.get(p.name) or {}
+                    ),
                 }
                 for p in self.probes
             }
@@ -656,4 +690,66 @@ def measure_fleet_observe_overhead(n_tx: int = 256,
         "fleet_observe_polls": stats["polls"],
         "fleet_observe_spans": stats["spans"],
         "fleet_observe_n_tx": n_tx,
+    }
+
+
+def measure_kernel_observe_overhead(n_tx: int = 256,
+                                    poll_interval_s: Optional[float] = None,
+                                    ) -> Dict:
+    """A/B the notarise-latency workload with the kernel flight ledger
+    killed (CORDA_TPU_KERNEL_LEDGER=0 — aggregate dispatch stats only,
+    today's pre-ledger cost) vs fully observed: ledger on AND a live
+    OpsServer with a FleetCollector draining `/kernels?since=` through
+    a LocalSession at the SHIPPED cadence — the whole device-plane
+    observation path, subprocess probes included. Same discipline as
+    `measure_fleet_observe_overhead`: warmup first, min-of-2 per arm,
+    and a 5% noise floor so sub-noise jitter on a shared box reads 0.0
+    while a real per-dispatch recording tax trips the gate
+    (`kernel_observe_overhead_pct`, lower-is-better, absolute <=25 SLO
+    on gated runs)."""
+    from ..node.opsserver import OpsServer
+    from ..utils.metrics import MetricRegistry
+    from .latency import measure_notarise_latency
+    from .remote import LocalSession, parse_hosts
+
+    measure_notarise_latency(n_tx=max(16, n_tx // 8))
+    prior = os.environ.get("CORDA_TPU_KERNEL_LEDGER")
+    os.environ["CORDA_TPU_KERNEL_LEDGER"] = "0"
+    try:
+        offs = [measure_notarise_latency(n_tx=n_tx) for _ in range(2)]
+    finally:
+        if prior is None:
+            os.environ.pop("CORDA_TPU_KERNEL_LEDGER", None)
+        else:
+            os.environ["CORDA_TPU_KERNEL_LEDGER"] = prior
+
+    registry = MetricRegistry()
+    ops = OpsServer(registry)
+    session = LocalSession(parse_hosts("local")[0])
+    collector = FleetCollector(
+        [NodeProbe("kernel-ab", session, ops.port, timeout_s=6.0)],
+        poll_interval_s=poll_interval_s,
+    ).start()
+    try:
+        ons = [measure_notarise_latency(n_tx=n_tx) for _ in range(2)]
+    finally:
+        collector.stop()
+        ops.stop()
+    stats = collector.stats()
+    off = min(offs, key=lambda r: r.get("wall_s") or 0.0)
+    on = min(ons, key=lambda r: r.get("wall_s") or 0.0)
+    overhead_pct = 0.0
+    if off.get("wall_s"):
+        overhead_pct = (
+            (on["wall_s"] - off["wall_s"]) / off["wall_s"] * 100.0
+        )
+    if overhead_pct < 5.0:
+        overhead_pct = 0.0  # within the rig's run-to-run noise
+    return {
+        "kernel_observe_off_per_sec": off.get("notarisations_per_sec"),
+        "kernel_observe_on_per_sec": on.get("notarisations_per_sec"),
+        "kernel_observe_overhead_pct": round(overhead_pct, 2),
+        "kernel_observe_polls": stats["polls"],
+        "kernel_observe_records": stats["kernel_records"],
+        "kernel_observe_n_tx": n_tx,
     }

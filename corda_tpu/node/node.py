@@ -460,6 +460,34 @@ class AbstractNode:
                 opbudget_gauge(kernel, "field_mul_equiv_per_sig"),
             )
 
+        # device-plane kernel flight ledger (docs/observability.md
+        # "Device plane"): ring depth, cumulative padded vs REAL rows,
+        # overall padding occupancy, and per-kernel roofline attainment
+        # (-1 until that kernel dispatched — attainment is MEASURED).
+        # All reads are jax-free plain-python (utils/profiling), and by
+        # riding the registry they flow into the /metrics/history ring.
+        self.metrics.gauge(
+            "Kernel.Ledger.Records",
+            lambda: _profiling.ledger_gauges()["records"],
+        )
+        self.metrics.gauge(
+            "Kernel.Ledger.Rows",
+            lambda: _profiling.ledger_gauges()["rows"],
+        )
+        self.metrics.gauge(
+            "Kernel.Ledger.RealRows",
+            lambda: _profiling.ledger_gauges()["real_rows"],
+        )
+        self.metrics.gauge(
+            "Kernel.Ledger.OccupancyPct",
+            lambda: _profiling.ledger_gauges()["occupancy_pct"],
+        )
+        for kernel in _profiling.LEDGER_KERNELS:
+            self.metrics.gauge(
+                f"Kernel.Attainment{{kernel={kernel}}}",
+                lambda k=kernel: _profiling.attainment_value(k),
+            )
+
         # bank-side flow hot path (docs/perf-system.md round 20): lane
         # executor occupancy, vault selection-cache effectiveness, and
         # checkpoint group-commit coalescing — the three families a

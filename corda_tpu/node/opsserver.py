@@ -358,6 +358,18 @@ class OpsServer(MiniWebServer):
             return 200, tree
         if path == "/spans/summary":
             return 200, self.tracer.summary()
+        if path == "/kernels":
+            # the device-plane kernel flight ledger (utils/profiling):
+            # per-dispatch records under the same strictly-after cursor
+            # contract as /metrics/history, plus the derived attainment
+            # and cached cost-analysis views. Jax-free by construction —
+            # this handler can never import jax or trigger a compile.
+            since, limit, err = _cursor_args(query)
+            if err is not None:
+                return 400, {"error": err}
+            from ..utils import profiling
+
+            return 200, profiling.ledger_since(since, limit)
         raise KeyError(path)
 
     def _profile(self, query: Dict[str, str]) -> Tuple[int, object]:

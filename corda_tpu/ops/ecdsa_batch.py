@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import time as _time
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -205,13 +206,9 @@ def prepare_batch(
     size = pad_to if pad_to is not None else max(
         8, 1 << (max(n, 1) - 1).bit_length()
     )
-    if (curve_name, size) not in _SEEN_SHAPES:
+    new_shape = (curve_name, size) not in _SEEN_SHAPES
+    if new_shape:
         _SEEN_SHAPES.add((curve_name, size))
-        from ..utils import profiling
-
-        profiling.record_compile(
-            f"ecdsa.{curve_name}.batch_shape", bucket=str(size)
-        )
     qx = np.zeros((size, NLIMB), np.uint32)
     qy = np.zeros((size, NLIMB), np.uint32)
     u1 = np.zeros((size, 8), np.uint32)
@@ -240,11 +237,37 @@ def prepare_batch(
             ok[i] = True
         except Exception:
             continue
-    return {
+    kwargs = {
         "qx": jnp.asarray(qx), "qy": jnp.asarray(qy),
         "u1_words": jnp.asarray(u1), "u2_words": jnp.asarray(u2),
         "r_cmp": jnp.asarray(r_cmp), "ok": jnp.asarray(ok),
-    }, n
+    }
+    if new_shape:
+        from ..utils import profiling
+
+        lower_s = None
+        if profiling.cost_analysis_enabled():
+            # one .lower() per new (curve, padded shape) while jax is
+            # live; flops/bytes go to the jax-free cost cache so a
+            # /kernels scrape never traces (ed25519_batch has the twin)
+            t0 = _time.perf_counter()
+            try:
+                analysis = _verify_kernel.lower(
+                    curve_name, **kwargs
+                ).cost_analysis()
+                lower_s = _time.perf_counter() - t0
+                profiling.record_cost_analysis(
+                    f"ecdsa.{curve_name}.verify_batch", str(size), size,
+                    analysis, backend=jax.default_backend(),
+                )
+            # lint: allow(swallow) — cost capture must never fail a verify
+            except Exception:
+                pass
+        profiling.record_compile(
+            f"ecdsa.{curve_name}.batch_shape", bucket=str(size),
+            seconds=lower_s,
+        )
+    return kwargs, n
 
 
 _pallas_failed_once = False

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time as _time
 from functools import partial
 from typing import Sequence, Tuple
 
@@ -229,17 +230,14 @@ def prepare_batch(
     """
     n = len(public_keys)
     size = pad_to if pad_to is not None else _bucket(max(n, 1))
-    if size not in _SEEN_SHAPES:
-        # each distinct padded shape costs one XLA compile downstream;
-        # the ops endpoint exports the count as Jax.CompileCount, with a
-        # per-bucket label so a recompile storm names its shape
+    # each distinct padded shape costs one XLA compile downstream; the
+    # ops endpoint exports the count as Jax.CompileCount, with a
+    # per-bucket label so a recompile storm names its shape (the event
+    # itself is recorded after the kwargs exist, so it can carry the
+    # lowering duration + cost analysis of the new shape)
+    new_shape = size not in _SEEN_SHAPES
+    if new_shape:
         _SEEN_SHAPES.add(size)
-        from ..utils import profiling
-
-        profiling.record_compile(
-            "ed25519.batch_shape",
-            bucket=str(size) if size in _BUCKETS else "other",
-        )
     y_a = np.zeros((size, F.NLIMB), np.uint32)
     y_r = np.zeros((size, F.NLIMB), np.uint32)
     sign_a = np.zeros(size, np.uint32)
@@ -312,6 +310,32 @@ def prepare_batch(
         h_words=jnp.asarray(h_words),
         s_ok=jnp.asarray(s_ok),
     )
+    if new_shape:
+        from ..utils import profiling
+
+        bucket = str(size) if size in _BUCKETS else "other"
+        lower_s = None
+        if profiling.cost_analysis_enabled():
+            # ONE .lower() per new padded shape, HERE where jax is
+            # already live: the flops/bytes land in the jax-free cost
+            # cache so a /kernels scrape never triggers tracing. The
+            # lowering wall doubles as the compile event's duration
+            # (the closest honest stand-in for the compile this shape
+            # is about to pay).
+            t0 = _time.perf_counter()
+            try:
+                analysis = verify_kernel.lower(**kwargs).cost_analysis()
+                lower_s = _time.perf_counter() - t0
+                profiling.record_cost_analysis(
+                    "ed25519.verify_batch", bucket, size, analysis,
+                    backend=jax.default_backend(),
+                )
+            # lint: allow(swallow) — cost capture must never fail a verify
+            except Exception:
+                pass
+        profiling.record_compile(
+            "ed25519.batch_shape", bucket=bucket, seconds=lower_s
+        )
     return kwargs, n
 
 

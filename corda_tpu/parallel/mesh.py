@@ -218,6 +218,14 @@ def _sharded_step(mesh, scheme: str):
     fn = jax.jit(sharded)
     cached = (prepare, fn, specs, blk)
     _SHARDED_STEP_CACHE[key] = cached
+    # each new (scheme, mesh layout) closure compiles its own sharded
+    # executable downstream — a compile event the flight ledger links
+    # mesh-routed dispatch records against (utils/profiling)
+    from ..utils import profiling
+
+    profiling.record_compile(
+        f"mesh.{scheme}.step", bucket=str(mesh.devices.size)
+    )
     return cached
 
 

@@ -471,6 +471,17 @@ class CordaRPCOps:
                     "next": int(since), "newest": 0}
         return {"enabled": True, **history.since(int(since), limit)}
 
+    def node_kernels(self, since: int = 0,
+                     limit: Optional[int] = None) -> Dict[str, Any]:
+        """The device-plane kernel flight ledger (the RPC twin of
+        GET /kernels, utils/profiling.py): per-dispatch records
+        STRICTLY after `since` plus the derived roofline-attainment and
+        cached XLA cost-analysis views. The ledger is process-global
+        (one device plane per process), jax-free to read."""
+        from ..utils import profiling
+
+        return profiling.ledger_since(int(since), limit)
+
     def node_trace(self, trace_id: str) -> Optional[Dict]:
         """Span tree for one trace from the node's tracer (the RPC twin
         of the ops endpoint's GET /traces/<id>)."""

@@ -16,10 +16,13 @@ from __future__ import annotations
 import atexit
 import cProfile
 import io
+import json
 import os
 import pstats
 import threading
-from typing import Callable, Dict, List, Tuple
+import time as _time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
 
 _DIR = os.environ.get("CORDA_TPU_PROFILE_DUMP")
 #: CPython 3.12 cProfile claims the process-wide sys.monitoring profiler
@@ -95,9 +98,104 @@ _dispatch_lock = threading.Lock()
 _dispatch_stats: Dict[str, Dict[str, float]] = {}
 _compile_counts: Dict[str, int] = {}
 
+# -- kernel flight ledger (device-plane observatory) --------------------------
+# ISSUE 18 / docs/observability.md "Device plane": a bounded ring of
+# per-dispatch records fed from the record_dispatch seams, XLA cost
+# analysis cached jax-free at lowering time, compile events with
+# durations, and roofline attainment derived against the op-budget pins.
+# Every read here (gauges, GET /kernels, node_kernels()) touches ONLY
+# this module's plain-python state — a scrape can never import jax or
+# trigger a compile (pinned by a fresh-subprocess test).
 
-def record_dispatch(name: str, seconds: float) -> None:
-    """One batch-kernel dispatch of `name` took `seconds` wall time."""
+#: the device verify kernels the ledger tracks by name — the vocabulary
+#: of core/crypto/batch.py's dispatch seams (node gauge registration
+#: iterates this, so it lives here, jax-free, like OPBUDGET_KERNELS)
+LEDGER_KERNELS = (
+    "ed25519.verify_batch",
+    "ecdsa.secp256k1.verify_batch",
+    "ecdsa.secp256r1.verify_batch",
+)
+
+#: ledger kernel -> opbudget_manifest.json pin. Both ECDSA curves run
+#: the SAME jitted kernel body (static curve constants only), so the
+#: secp256r1 field-mul pin stands for secp256k1 too.
+_MANIFEST_KERNEL = {
+    "ed25519.verify_batch": "ed25519_xla",
+    "ecdsa.secp256k1.verify_batch": "ecdsa_secp256r1_xla",
+    "ecdsa.secp256r1.verify_batch": "ecdsa_secp256r1_xla",
+}
+
+#: per-backend peak sigs/s for attainment: `tpu` is the 250k/chip
+#: baseline the roofline targets (bench.py PER_CHIP_BASELINE); `cpu` is
+#: an honest best-effort pin — the order of the native host engine on
+#: the 1-core dev box, NOT a vendor spec — so CPU attainment is a smoke
+#: signal, not a roofline (docs/perf-roofline.md "attainment is
+#: MEASURED").
+PEAK_SIGS_S = {"tpu": 250_000.0, "cpu": 20_000.0}
+
+_COMPILE_EVENT_CAP = 256
+
+_ledger: Optional[deque] = None  # built lazily at current ring max
+_ledger_seq = 0
+_kernel_totals: Dict[str, Dict[str, float]] = {}
+_cost_cache: Dict[str, Dict[str, Dict]] = {}  # kernel -> bucket -> cost
+_compile_events: deque = deque(maxlen=_COMPILE_EVENT_CAP)
+_compile_event_seq = 0
+_ledger_provenance: Optional[Dict] = None
+_backend_label: Optional[str] = None
+_manifest_pins: Optional[Dict[str, float]] = None
+_stage_local = threading.local()
+
+
+def ledger_enabled() -> bool:
+    """The CORDA_TPU_KERNEL_LEDGER kill switch (on by default; the
+    aggregate _dispatch_stats keep recording either way)."""
+    return os.environ.get("CORDA_TPU_KERNEL_LEDGER", "1") != "0"
+
+
+def cost_analysis_enabled() -> bool:
+    """CORDA_TPU_KERNEL_LEDGER_COST: whether kernel call sites capture
+    XLA cost analysis at lowering time (one `.lower()` per compiled
+    shape, at the site where jax is already live)."""
+    return ledger_enabled() and \
+        os.environ.get("CORDA_TPU_KERNEL_LEDGER_COST", "1") != "0"
+
+
+def _ledger_max() -> int:
+    try:
+        return max(16, int(
+            os.environ.get("CORDA_TPU_KERNEL_LEDGER_MAX", "1024")
+        ))
+    except ValueError:
+        return 1024
+
+
+def set_stage(stage: Optional[str]) -> None:
+    """Thread-local pipeline-stage context: the stage runner labels its
+    thread so dispatch records can say WHICH stage ran them."""
+    _stage_local.value = stage
+
+
+def current_stage() -> Optional[str]:
+    return getattr(_stage_local, "value", None)
+
+
+def record_dispatch(name: str, seconds: float, *,
+                    scheme: Optional[str] = None,
+                    bucket: Optional[str] = None,
+                    rows: Optional[int] = None,
+                    real_rows: Optional[int] = None,
+                    donated: bool = False,
+                    mesh_n: int = 0,
+                    stage: Optional[str] = None) -> None:
+    """One batch-kernel dispatch of `name` took `seconds` wall time.
+
+    The keyword fields feed the kernel flight ledger: padded `rows` vs
+    `real_rows` make padding occupancy visible per dispatch, `donated`
+    / `mesh_n` / `stage` say which route ran it, `bucket` links the
+    record to its compile-count family. Bare two-argument calls keep
+    their old meaning (aggregate stats only get richer, never gated)."""
+    global _ledger, _ledger_seq
     with _dispatch_lock:
         s = _dispatch_stats.get(name)
         if s is None:
@@ -107,18 +205,268 @@ def record_dispatch(name: str, seconds: float) -> None:
         s["count"] += 1
         s["total_s"] += seconds
         s["max_s"] = max(s["max_s"], seconds)
+        if not ledger_enabled():
+            return
+        t = _kernel_totals.get(name)
+        if t is None:
+            t = _kernel_totals[name] = {
+                "dispatches": 0, "rows": 0, "real_rows": 0, "wall_s": 0.0,
+            }
+        t["dispatches"] += 1
+        t["wall_s"] += seconds
+        if rows:
+            t["rows"] += int(rows)
+        if real_rows:
+            t["real_rows"] += int(real_rows)
+        if _ledger is None:
+            _ledger = deque(maxlen=_ledger_max())
+        _ledger_seq += 1
+        occupancy = round(100.0 * real_rows / rows, 2) \
+            if rows and real_rows is not None else None
+        rec = {
+            "seq": _ledger_seq,
+            "ts": round(_time.time(), 3),
+            "kernel": name,
+            "scheme": scheme,
+            "bucket": bucket,
+            "rows": rows,
+            "real_rows": real_rows,
+            "occupancy_pct": occupancy,
+            "wall_s": round(seconds, 6),
+            "donated": bool(donated),
+            "mesh_n": int(mesh_n),
+            "stage": stage if stage is not None else current_stage(),
+            "compile_seq": _compile_event_seq,
+        }
+        if _ledger_provenance is not None:
+            rec["provenance"] = dict(_ledger_provenance)
+        _ledger.append(rec)
 
 
-def record_compile(name: str, bucket: Optional[str] = None) -> None:
+def record_compile(name: str, bucket: Optional[str] = None,
+                   seconds: Optional[float] = None) -> None:
     """A kernel shape for `name` was (re)compiled — each distinct padded
     batch shape costs one XLA compile; a climbing count under steady load
     means the shape bucketing is broken. `bucket` (a shape-bucket label)
     keys the count per padded shape so the always-on
     Jax.CompileCount{bucket=…} gauges can say WHICH bucket is churning,
-    not just that something recompiled."""
+    not just that something recompiled. `seconds` (when the call site
+    timed the compile/lowering) rides into the ledger's bounded
+    compile-event list, linked from dispatch records via compile_seq."""
+    global _compile_event_seq
     key = name if bucket is None else f"{name}[{bucket}]"
     with _dispatch_lock:
         _compile_counts[key] = _compile_counts.get(key, 0) + 1
+        if ledger_enabled():
+            _compile_event_seq += 1
+            _compile_events.append({
+                "seq": _compile_event_seq,
+                "ts": round(_time.time(), 3),
+                "name": name,
+                "bucket": bucket,
+                "seconds": round(seconds, 6) if seconds is not None
+                else None,
+            })
+
+
+def record_cost_analysis(name: str, bucket: Optional[str],
+                         rows: int, analysis,
+                         backend: Optional[str] = None) -> None:
+    """Cache one compiled shape's XLA cost analysis, jax-free, so later
+    reads (gauges, /kernels) never touch jax. `analysis` is whatever
+    `lowered.cost_analysis()` returned — a dict in current jax, a list
+    of dicts in some versions; both are normalised here. Computed ONCE
+    per (kernel, bucket) at the call site where jax is already live."""
+    global _backend_label
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return
+    flops = analysis.get("flops")
+    nbytes = analysis.get("bytes accessed")
+    entry = {
+        "rows": int(rows),
+        "flops": float(flops) if isinstance(flops, (int, float)) else None,
+        "bytes_accessed": float(nbytes)
+        if isinstance(nbytes, (int, float)) else None,
+    }
+    if entry["flops"] is not None and rows:
+        entry["flops_per_row"] = round(entry["flops"] / rows, 1)
+    with _dispatch_lock:
+        _cost_cache.setdefault(name, {})[bucket or "default"] = entry
+        if backend:
+            _backend_label = str(backend)
+
+
+def cost_analysis() -> Dict[str, Dict[str, Dict]]:
+    """{kernel: {bucket: {rows, flops, bytes_accessed, flops_per_row}}}
+    — the cached XLA cost model, plain data."""
+    with _dispatch_lock:
+        return {k: {b: dict(e) for b, e in v.items()}
+                for k, v in _cost_cache.items()}
+
+
+def annotate_provenance(info: Dict) -> None:
+    """Stamp `info` (e.g. ``{"live": True, "step": "bench-inline"}``)
+    onto every ledger record already in the ring AND every future one —
+    the tpu_capture join: a bench-inline live capture marks the ledger
+    rows that produced its number."""
+    global _ledger_provenance
+    with _dispatch_lock:
+        _ledger_provenance = dict(info)
+        if _ledger is not None:
+            for rec in _ledger:
+                rec["provenance"] = dict(info)
+
+
+def ledger_backend() -> str:
+    """The backend label attainment divides by: latched at cost-capture
+    time (where jax was already live) — a read here NEVER imports jax
+    or initialises a backend, so unlatched defaults to "cpu"."""
+    with _dispatch_lock:
+        return _backend_label or "cpu"
+
+
+def _budget_pin(manifest_kernel: str) -> Optional[float]:
+    """field_mul_equiv_per_sig pin from ops/opbudget_manifest.json,
+    read ONCE with plain json (the manifest is the jax-free artifact
+    ops/opbudget.py maintains)."""
+    global _manifest_pins
+    if _manifest_pins is None:
+        pins: Dict[str, float] = {}
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir, "ops", "opbudget_manifest.json",
+        )
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+            for k, v in (data.get("kernels") or {}).items():
+                pin = v.get("field_mul_equiv_per_sig")
+                if isinstance(pin, (int, float)):
+                    pins[k] = float(pin)
+        # a missing/rewritten manifest must not break a metrics scrape
+        # lint: allow(swallow) — attainment just omits the budget pin
+        except Exception:
+            pass
+        _manifest_pins = pins
+    return _manifest_pins.get(manifest_kernel)
+
+
+def attainment() -> Dict[str, Dict]:
+    """Per-kernel roofline attainment out of the ledger totals:
+    achieved sigs/s (REAL rows / wall), attainment_pct vs the
+    per-backend peak, achieved flops/s vs the cached cost model, and
+    the op-budget pin the roofline was derived from. Empty until a
+    device kernel dispatched — attainment is MEASURED, never assumed."""
+    backend = ledger_backend()
+    peak = PEAK_SIGS_S.get(backend, PEAK_SIGS_S["cpu"])
+    with _dispatch_lock:
+        totals = {k: dict(v) for k, v in _kernel_totals.items()}
+        cost = {k: dict(v) for k, v in _cost_cache.items()}
+    out: Dict[str, Dict] = {}
+    for kernel, t in totals.items():
+        wall = t["wall_s"]
+        if wall <= 0.0 or t["dispatches"] <= 0:
+            continue
+        real = t["real_rows"]
+        rows = t["rows"]
+        achieved = real / wall if real else 0.0
+        entry = {
+            "dispatches": int(t["dispatches"]),
+            "rows": int(rows),
+            "real_rows": int(real),
+            "wall_s": round(wall, 6),
+            "occupancy_pct": round(100.0 * real / rows, 2)
+            if rows else None,
+            "achieved_sigs_s": round(achieved, 1),
+            "backend": backend,
+            "peak_sigs_s": peak,
+            "attainment_pct": round(100.0 * achieved / peak, 2)
+            if peak else None,
+        }
+        buckets = cost.get(kernel) or {}
+        fpr = [e["flops_per_row"] for e in buckets.values()
+               if isinstance(e.get("flops_per_row"), (int, float))]
+        if fpr and rows:
+            # padded rows do the flops whether or not they carry a sig
+            entry["flops_per_row"] = max(fpr)
+            entry["achieved_flops_s"] = round(max(fpr) * rows / wall, 1)
+        pin = _budget_pin(_MANIFEST_KERNEL.get(kernel, ""))
+        if pin is not None:
+            entry["budget_field_mul_equiv_per_sig"] = pin
+        out[kernel] = entry
+    return out
+
+
+def attainment_value(kernel: str) -> float:
+    """One kernel's attainment_pct for the Kernel.Attainment{kernel=…}
+    gauge: -1.0 until that kernel has measured data."""
+    entry = attainment().get(kernel)
+    if entry is None:
+        return -1.0
+    pct = entry.get("attainment_pct")
+    return float(pct) if isinstance(pct, (int, float)) else -1.0
+
+
+def ledger_gauges() -> Dict[str, float]:
+    """The jax-free scalars the Kernel.Ledger.* gauges read: ring size,
+    cumulative padded/real rows, and overall padding occupancy (-1
+    until a rows-carrying dispatch landed)."""
+    with _dispatch_lock:
+        records = len(_ledger) if _ledger is not None else 0
+        rows = sum(t["rows"] for t in _kernel_totals.values())
+        real = sum(t["real_rows"] for t in _kernel_totals.values())
+    return {
+        "records": float(records),
+        "rows": float(rows),
+        "real_rows": float(real),
+        "occupancy_pct": round(100.0 * real / rows, 2) if rows else -1.0,
+    }
+
+
+def ledger_since(cursor: int = 0, limit: Optional[int] = None) -> Dict:
+    """Ledger records STRICTLY after `cursor`, oldest first — the same
+    cursor contract as /metrics/history and /traces/export (the reply's
+    `next` feeds the following poll; `newest` < cursor tells a
+    collector the node restarted). Rides with the derived views a
+    scraper wants in the same page: per-kernel attainment, the cached
+    cost model, and compile events."""
+    if limit is None:
+        limit = 500
+    with _dispatch_lock:
+        enabled = ledger_enabled()
+        records = [dict(r) for r in (_ledger or ())
+                   if r["seq"] > cursor][: max(0, int(limit))]
+        newest = _ledger_seq
+        compiles = [dict(e) for e in _compile_events]
+    return {
+        "enabled": enabled,
+        "records": records,
+        "next": records[-1]["seq"] if records else max(0, int(cursor)),
+        "newest": newest,
+        "attainment": attainment(),
+        "cost": cost_analysis(),
+        "compile_events": compiles,
+        "backend": ledger_backend(),
+    }
+
+
+def ledger_reset() -> None:
+    """Drop every ledger structure (ring, totals, cost cache, compile
+    events, provenance) — restart simulation for tests, and the hook a
+    fresh measurement window uses to start from zero."""
+    global _ledger, _ledger_seq, _kernel_totals, _cost_cache, \
+        _compile_event_seq, _ledger_provenance, _manifest_pins
+    with _dispatch_lock:
+        _ledger = None
+        _ledger_seq = 0
+        _kernel_totals = {}
+        _cost_cache = {}
+        _compile_events.clear()
+        _compile_event_seq = 0
+        _ledger_provenance = None
+        _manifest_pins = None
 
 
 def compile_count(name: str, bucket: Optional[str] = None) -> int:

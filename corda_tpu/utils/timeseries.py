@@ -221,6 +221,18 @@ class MetricsHistory:
             }
 
 
+def latest_values(samples: List[Dict], metric: str) -> List[Tuple[float, float]]:
+    """(ts, value) series for one GAUGE family out of a sample list —
+    the companion of latest_rates for value-typed metrics (e.g. the
+    Kernel.Attainment{kernel=…} families tools/kernel_report.py plots)."""
+    out: List[Tuple[float, float]] = []
+    for s in samples:
+        m = (s.get("metrics") or {}).get(metric)
+        if m and isinstance(m.get("value"), (int, float)):
+            out.append((s.get("ts"), m["value"]))
+    return out
+
+
 def latest_rates(samples: List[Dict], metric: str) -> List[Tuple[float, float]]:
     """(ts, rate) series for one counter/meter/timer family out of a
     sample list — the shape the observatory's inflection detector and

@@ -50,7 +50,7 @@ from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
-from ..utils import faultpoints, lockorder, tracing
+from ..utils import faultpoints, lockorder, profiling, tracing
 
 #: default bound on batches in flight across ALL stages (the ring):
 #: one per stage double-buffers every handoff; deeper only adds memory
@@ -520,7 +520,14 @@ class VerificationPipeline:
                 if isinstance(action, tuple) and action and \
                         action[0] == "delay":
                     time.sleep(action[1])
-            job.value = fn(job.value)
+            # thread-local stage context: dispatch records the stage
+            # functions produce land in the kernel flight ledger
+            # labelled with the stage that ran them (utils/profiling)
+            profiling.set_stage(stage)
+            try:
+                job.value = fn(job.value)
+            finally:
+                profiling.set_stage(None)
         except BaseException as exc:
             err = exc
         wall = time.monotonic() - t0

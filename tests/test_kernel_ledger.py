@@ -1,0 +1,490 @@
+"""Device-plane kernel flight ledger (utils/profiling.py, ISSUE 18).
+
+Covers: the bounded per-dispatch ring and its strictly-after cursor
+contract (including the restart-reset signal), the env kill switch and
+ring-size knob, padding-occupancy and pipeline-stage labelling, the
+jax-free XLA cost cache and roofline attainment math against the
+op-budget pins, compile-event linkage, the tpu_capture provenance
+stamp, the /kernels endpoint + node_kernels() RPC, Prometheus validity
+of the Kernel.Ledger.* / Kernel.Attainment{...} families, the
+fresh-subprocess proof that a scrape never imports jax, the gate
+direction pins, the kernel_report CLI, and the acceptance proof: one
+notarised MockNetwork transaction leaves ledger records with
+scheme/bucket labels and populated cost-analysis flops on the CPU
+backend.
+"""
+import json
+import re
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from corda_tpu.utils import profiling
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    profiling.ledger_reset()
+    yield
+    profiling.ledger_reset()
+    profiling.set_stage(None)
+
+
+def _dispatch(kernel="ed25519.verify_batch", seconds=0.01, **kw):
+    kw.setdefault("scheme", "EDDSA_ED25519_SHA512")
+    kw.setdefault("bucket", "64")
+    kw.setdefault("rows", 64)
+    kw.setdefault("real_rows", 50)
+    profiling.record_dispatch(kernel, seconds, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the ring + cursor contract
+# ---------------------------------------------------------------------------
+
+class TestLedgerRing:
+    def test_records_carry_the_dispatch_facts(self):
+        _dispatch(donated=True, mesh_n=4, stage="mesh")
+        page = profiling.ledger_since(0)
+        assert page["enabled"] is True
+        (rec,) = page["records"]
+        assert rec["kernel"] == "ed25519.verify_batch"
+        assert rec["scheme"] == "EDDSA_ED25519_SHA512"
+        assert rec["bucket"] == "64"
+        assert rec["rows"] == 64 and rec["real_rows"] == 50
+        assert rec["occupancy_pct"] == pytest.approx(78.12)
+        assert rec["donated"] is True
+        assert rec["mesh_n"] == 4
+        assert rec["stage"] == "mesh"
+        assert rec["wall_s"] == pytest.approx(0.01)
+
+    def test_cursor_is_strictly_after(self):
+        for _ in range(3):
+            _dispatch()
+        page = profiling.ledger_since(0)
+        assert [r["seq"] for r in page["records"]] == [1, 2, 3]
+        assert page["next"] == 3 and page["newest"] == 3
+        again = profiling.ledger_since(page["next"])
+        assert again["records"] == []
+        assert again["next"] == 3  # cursor holds position when drained
+        _dispatch()
+        fresh = profiling.ledger_since(3)
+        assert [r["seq"] for r in fresh["records"]] == [4]
+
+    def test_limit_pages_oldest_first(self):
+        for _ in range(5):
+            _dispatch()
+        page = profiling.ledger_since(0, limit=2)
+        assert [r["seq"] for r in page["records"]] == [1, 2]
+        page = profiling.ledger_since(page["next"], limit=2)
+        assert [r["seq"] for r in page["records"]] == [3, 4]
+
+    def test_restart_reset_signal(self):
+        for _ in range(4):
+            _dispatch()
+        cursor = profiling.ledger_since(0)["next"]
+        profiling.ledger_reset()
+        page = profiling.ledger_since(cursor)
+        assert page["newest"] < cursor  # the collector's reset signal
+        assert page["records"] == []
+
+    def test_ring_bounded_by_env_knob(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_KERNEL_LEDGER_MAX", "16")
+        profiling.ledger_reset()  # ring is built lazily at current max
+        for _ in range(40):
+            _dispatch()
+        page = profiling.ledger_since(0, limit=1000)
+        assert len(page["records"]) == 16
+        assert page["records"][0]["seq"] == 25  # oldest were evicted
+        assert page["newest"] == 40
+        # totals keep counting past the ring: the ring bounds MEMORY,
+        # not the attainment math
+        att = profiling.attainment()["ed25519.verify_batch"]
+        assert att["dispatches"] == 40
+
+    def test_kill_switch_disables_ledger_not_aggregates(self, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_KERNEL_LEDGER", "0")
+        before = profiling.dispatch_snapshot()["dispatch"].get(
+            "ed25519.verify_batch", {}
+        ).get("count", 0)
+        _dispatch()
+        page = profiling.ledger_since(0)
+        assert page["enabled"] is False
+        assert page["records"] == [] and page["attainment"] == {}
+        # the pre-existing aggregate recorder is NOT gated
+        after = profiling.dispatch_snapshot()["dispatch"][
+            "ed25519.verify_batch"]
+        assert after["count"] == before + 1
+        assert not profiling.cost_analysis_enabled()
+
+    def test_stage_comes_from_thread_local_unless_explicit(self):
+        profiling.set_stage("dispatch")
+        _dispatch()
+        profiling.set_stage(None)
+        _dispatch(stage="mesh")
+        _dispatch()
+        stages = [r["stage"] for r in profiling.ledger_since(0)["records"]]
+        assert stages == ["dispatch", "mesh", None]
+
+
+# ---------------------------------------------------------------------------
+# cost analysis + attainment + compile events + provenance
+# ---------------------------------------------------------------------------
+
+class TestAttainment:
+    def test_attainment_math_against_the_budget_pin(self):
+        profiling.record_cost_analysis(
+            "ed25519.verify_batch", "64", 64,
+            {"flops": 64_000.0, "bytes accessed": 2_048.0},
+            backend="cpu",
+        )
+        _dispatch(seconds=0.005)
+        _dispatch(seconds=0.005)
+        att = profiling.attainment()["ed25519.verify_batch"]
+        assert att["dispatches"] == 2
+        assert att["rows"] == 128 and att["real_rows"] == 100
+        assert att["occupancy_pct"] == pytest.approx(78.12)
+        assert att["achieved_sigs_s"] == pytest.approx(100 / 0.01)
+        assert att["backend"] == "cpu"
+        assert att["peak_sigs_s"] == profiling.PEAK_SIGS_S["cpu"]
+        assert att["attainment_pct"] == pytest.approx(
+            100.0 * (100 / 0.01) / profiling.PEAK_SIGS_S["cpu"], rel=1e-6
+        )
+        # flops: padded rows do the work (1000 flops/row x 128 rows)
+        assert att["flops_per_row"] == pytest.approx(1000.0)
+        assert att["achieved_flops_s"] == pytest.approx(1000.0 * 128 / 0.01)
+        # the roofline's op-budget pin rides along (ops/opbudget_manifest)
+        assert att["budget_field_mul_equiv_per_sig"] == pytest.approx(
+            5665.3, abs=500
+        )
+
+    def test_attainment_gauge_is_minus_one_until_measured(self):
+        assert profiling.attainment_value("ed25519.verify_batch") == -1.0
+        _dispatch(seconds=0.01)
+        assert profiling.attainment_value("ed25519.verify_batch") > 0.0
+        assert profiling.attainment_value(
+            "ecdsa.secp256r1.verify_batch"
+        ) == -1.0
+
+    def test_cost_analysis_list_shape_normalised(self):
+        # some jax versions return [dict]; both shapes must cache
+        profiling.record_cost_analysis(
+            "ecdsa.secp256r1.verify_batch", "8", 8,
+            [{"flops": 80.0, "bytes accessed": 16.0}],
+        )
+        entry = profiling.cost_analysis()[
+            "ecdsa.secp256r1.verify_batch"]["8"]
+        assert entry["flops"] == 80.0
+        assert entry["bytes_accessed"] == 16.0
+        assert entry["flops_per_row"] == pytest.approx(10.0)
+
+    def test_compile_events_link_into_records(self):
+        _dispatch()
+        profiling.record_compile(
+            "ed25519.batch_shape", bucket="64", seconds=0.25
+        )
+        _dispatch()
+        page = profiling.ledger_since(0)
+        (event,) = [e for e in page["compile_events"]
+                    if e["seconds"] is not None]
+        assert event["name"] == "ed25519.batch_shape"
+        assert event["bucket"] == "64"
+        before, after = page["records"]
+        assert before["compile_seq"] < event["seq"]
+        assert after["compile_seq"] == event["seq"]
+
+    def test_provenance_stamps_ring_and_future(self):
+        _dispatch()
+        profiling.annotate_provenance({"live": True, "step": "bench-inline"})
+        _dispatch()
+        recs = profiling.ledger_since(0)["records"]
+        assert all(
+            r["provenance"] == {"live": True, "step": "bench-inline"}
+            for r in recs
+        )
+
+    def test_ledger_gauges_shape(self):
+        g = profiling.ledger_gauges()
+        assert g["records"] == 0.0 and g["occupancy_pct"] == -1.0
+        _dispatch()
+        g = profiling.ledger_gauges()
+        assert g["records"] == 1.0
+        assert g["rows"] == 64.0 and g["real_rows"] == 50.0
+        assert g["occupancy_pct"] == pytest.approx(78.12)
+
+
+# ---------------------------------------------------------------------------
+# /kernels endpoint + RPC + Prometheus families
+# ---------------------------------------------------------------------------
+
+class TestKernelsEndpoint:
+    @pytest.fixture()
+    def node_port(self):
+        from corda_tpu.testing.mocknetwork import MockNetwork
+
+        net = MockNetwork()
+        try:
+            node = net.create_node(
+                "O=KernelObs,L=London,C=GB", ops_port=0
+            )
+            yield node, node.ops_server.port
+        finally:
+            net.stop_nodes()
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as resp:
+            return json.loads(resp.read())
+
+    def test_kernels_page_and_cursor_drain(self, node_port):
+        _node, port = node_port
+        for _ in range(3):
+            _dispatch()
+        page = self._get(port, "/kernels")
+        assert page["enabled"] is True
+        assert [r["seq"] for r in page["records"]] == [1, 2, 3]
+        assert "ed25519.verify_batch" in page["attainment"]
+        assert page["backend"] == "cpu"
+        drained = self._get(port, f"/kernels?since={page['next']}")
+        assert drained["records"] == []
+        _dispatch()
+        assert [
+            r["seq"] for r in
+            self._get(port, f"/kernels?since={page['next']}")["records"]
+        ] == [4]
+
+    def test_malformed_cursor_is_client_fault(self, node_port):
+        _node, port = node_port
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/kernels?since=bogus", timeout=5
+            )
+        assert err.value.code == 400
+
+    def test_restart_reset_over_the_endpoint(self, node_port):
+        _node, port = node_port
+        for _ in range(2):
+            _dispatch()
+        cursor = self._get(port, "/kernels")["next"]
+        profiling.ledger_reset()
+        page = self._get(port, f"/kernels?since={cursor}")
+        assert page["newest"] < cursor
+
+    def test_rpc_node_kernels(self, node_port):
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        node, _port = node_port
+        _dispatch()
+        ops = CordaRPCOps(node.services, node.smm)
+        page = ops.node_kernels()
+        assert len(page["records"]) == 1
+        assert page["records"][0]["kernel"] == "ed25519.verify_batch"
+
+    def test_ledger_families_render_valid_prometheus(self, node_port):
+        _node, port = node_port
+        _dispatch()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        for family in (
+            "corda_tpu_kernel_ledger_records",
+            "corda_tpu_kernel_ledger_rows",
+            "corda_tpu_kernel_ledger_real_rows",
+            "corda_tpu_kernel_ledger_occupancy_pct",
+            "corda_tpu_kernel_attainment",
+        ):
+            assert f"\n{family}" in body or body.startswith(family), family
+        # the labelled attainment family carries the kernel label
+        assert 'kernel="ed25519.verify_batch"' in body
+        # strict exposition validity over the whole scrape (same
+        # contract test_profiler pins for the profiler families)
+        sample_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+            r'(\{[a-zA-Z0-9_]+="[^"]*"(,[a-zA-Z0-9_]+="[^"]*")*\})?'
+            r" [^ ]+( [0-9.e+-]+)?$"
+        )
+        families = []
+        for line in body.splitlines():
+            if line.startswith("# TYPE "):
+                families.append(line.split()[2])
+                continue
+            if not line or line.startswith("#"):
+                continue
+            assert sample_re.match(line), f"bad sample line: {line}"
+        assert len(families) == len(set(families)), "duplicate TYPE family"
+
+
+def test_kernels_scrape_never_imports_jax(tmp_path):
+    """The jax-free read discipline, pinned end-to-end: a fresh process
+    that records, serves and scrapes /kernels (attainment, cost cache,
+    budget pins and all) must never import jax — a metrics scrape can
+    never trigger a backend init or a compile."""
+    script = """
+import json, sys, urllib.request
+from corda_tpu.node.opsserver import OpsServer
+from corda_tpu.utils import profiling
+from corda_tpu.utils.metrics import MetricRegistry
+
+profiling.record_dispatch(
+    "ed25519.verify_batch", 0.01, scheme="EDDSA_ED25519_SHA512",
+    bucket="64", rows=64, real_rows=50,
+)
+ops = OpsServer(MetricRegistry())
+try:
+    with urllib.request.urlopen(
+        "http://127.0.0.1:%d/kernels" % ops.port, timeout=5
+    ) as resp:
+        page = json.loads(resp.read())
+finally:
+    ops.stop()
+assert page["records"], page
+assert page["attainment"]["ed25519.verify_batch"]["attainment_pct"] > 0
+assert page["backend"] == "cpu"
+assert "jax" not in sys.modules, "scrape imported jax"
+print("OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# gate direction pins + the report CLI
+# ---------------------------------------------------------------------------
+
+class TestGateAndReport:
+    @pytest.mark.parametrize("key,expected", [
+        ("kernel_observe_overhead_pct", "lower"),
+        ("stage_timings.kernel_observe_overhead_pct", "lower"),
+        ("kernel_observe_on_per_sec", "higher"),
+        ("kernel_attainment.attainment_pct", "higher"),
+        ("kernel_attainment_pct{kernel=ed25519.verify_batch}", "higher"),
+    ])
+    def test_direction_pins(self, key, expected):
+        from corda_tpu.loadtest.gate import direction
+
+        assert direction(key) == expected
+
+    def test_kernel_report_renders_a_kernels_page(self, tmp_path):
+        _dispatch()
+        profiling.record_cost_analysis(
+            "ed25519.verify_batch", "64", 64,
+            {"flops": 64_000.0, "bytes accessed": 2_048.0},
+        )
+        path = tmp_path / "kernels.json"
+        path.write_text(json.dumps(profiling.ledger_since(0)))
+        proc = subprocess.run(
+            [sys.executable, "tools/kernel_report.py",
+             "--current", str(path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "ed25519.verify_batch" in proc.stdout
+        assert "kernel attainment" in proc.stdout
+        assert "xla cost model" in proc.stdout
+
+    def test_kernel_report_renders_a_bench_record(self, tmp_path):
+        _dispatch()
+        record = {"stage_timings": {
+            "kernel_attainment": profiling.attainment(),
+        }}
+        path = tmp_path / "bench.json"
+        path.write_text(json.dumps(record))
+        proc = subprocess.run(
+            [sys.executable, "tools/kernel_report.py",
+             "--current", str(path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "ed25519.verify_batch" in proc.stdout
+
+    def test_kernel_report_unreadable_is_exit_2(self):
+        proc = subprocess.run(
+            [sys.executable, "tools/kernel_report.py",
+             "--current", "/nonexistent/kernels.json"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# the acceptance proof: a notarised MockNetwork tx lands in the ledger
+# ---------------------------------------------------------------------------
+
+def test_notarised_tx_leaves_ledger_records_with_cost(monkeypatch):
+    """One notarised MockNetwork payment, forced onto the device verify
+    path (the suite's CPU backend would normally take the host pool),
+    must leave >=1 ledger record per engaged verify kernel with the
+    scheme/bucket labels, REAL-row occupancy, populated cost-analysis
+    flops, and a computed attainment entry."""
+    from corda_tpu.core.crypto import EDDSA_ED25519_SHA512
+    from corda_tpu.core.crypto import batch as crypto_batch
+    from corda_tpu.ops import ed25519_batch
+    from corda_tpu.testing.mocknetwork import MockNetwork
+
+    monkeypatch.setattr(crypto_batch, "DISPATCH", "device")
+    monkeypatch.setattr(crypto_batch, "MIN_DEVICE_BATCH", 1)
+    # force the one-per-shape cost capture even when an earlier test in
+    # this process already compiled the padded shape
+    monkeypatch.setattr(ed25519_batch, "_SEEN_SHAPES", set())
+
+    net = MockNetwork()
+    try:
+        notary = net.create_notary_node(validating=True)
+        alice = net.create_node("O=LedgerAlice,L=London,C=GB")
+        bob = net.create_node("O=LedgerBob,L=Paris,C=FR")
+
+        from corda_tpu.core.contracts import Amount
+        from corda_tpu.core.contracts.amount import Issued
+        from corda_tpu.rpc import CordaRPCOps
+
+        ops = CordaRPCOps(alice.services, alice.smm)
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashIssueFlow",
+            Amount(1000, "USD"), (1,), alice.info, notary.info,
+        )
+        net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+        token = Issued(alice.info.ref(1), "USD")
+        fid = ops.start_flow_dynamic(
+            "corda_tpu.finance.flows.CashPaymentFlow",
+            Amount(400, token), bob.info, notary.info,
+        )
+        net.run_network()
+        assert ops.flow_result(fid, timeout=10) is not None
+    finally:
+        net.stop_nodes()
+
+    page = profiling.ledger_since(0, limit=1000)
+    recs = [r for r in page["records"]
+            if r["kernel"] == "ed25519.verify_batch"]
+    assert recs, "no device dispatch reached the ledger"
+    scheme = EDDSA_ED25519_SHA512.scheme_code_name
+    for rec in recs:
+        assert rec["scheme"] == scheme
+        assert rec["bucket"] in profiling.ED25519_BUCKET_LABELS
+        assert rec["rows"] >= rec["real_rows"] >= 1
+        assert 0.0 < rec["occupancy_pct"] <= 100.0
+
+    # the XLA cost model was captured at compile time, on this process's
+    # CPU backend, and is readable jax-free
+    cost = page["cost"]["ed25519.verify_batch"]
+    assert any(
+        isinstance(e.get("flops"), float) and e["flops"] > 0
+        for e in cost.values()
+    ), cost
+    assert page["backend"] == "cpu"
+
+    att = page["attainment"]["ed25519.verify_batch"]
+    assert att["dispatches"] >= 1
+    assert att["achieved_sigs_s"] > 0
+    assert isinstance(att["attainment_pct"], float)
+    assert att["flops_per_row"] > 0

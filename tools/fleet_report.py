@@ -10,6 +10,9 @@ tool never talks to a live rig — post-mortems outlive their processes):
 
   * the fleet table: one row per node — reachability, health, wedged
     polls, and how many spans / log records / samples it contributed;
+  * the device-plane kernel table (when nodes drained /kernels): per
+    node+kernel ledger records, padding occupancy, achieved sigs/s,
+    and roofline attainment% — tools/kernel_report.py drills deeper;
   * the disruption timeline: fire→heal per catalog kind with mttr_ms,
     detect_ms, the correlated warning+ node events, and the metric rate
     inflections around each window;
@@ -59,6 +62,33 @@ def render(record: dict, paths: int = 5) -> str:
             f"cross_node={fleet.get('cross_node_traces', 0)}")
     else:
         out("(no fleet capture in record)")
+
+    kernel_rows = []
+    for name in sorted(nodes):
+        st = nodes[name] or {}
+        att = st.get("kernel_attainment") or {}
+        if not att and not st.get("kernel_records"):
+            continue
+        if not att:
+            kernel_rows.append((name, "-", st.get("kernel_records", 0),
+                                None, None, None))
+        for kernel in sorted(att):
+            e = att[kernel] or {}
+            kernel_rows.append((
+                name, kernel, st.get("kernel_records", 0),
+                e.get("occupancy_pct"), e.get("achieved_sigs_s"),
+                e.get("attainment_pct"),
+            ))
+    if kernel_rows:
+        out("")
+        out("== device-plane kernels ==")
+        out(f"{'node':<10} {'kernel':<34} {'records':>7} "
+            f"{'occ%':>6} {'sigs/s':>9} {'attain%':>8}")
+        def _n(v, fmt="{:.1f}"):
+            return fmt.format(v) if isinstance(v, (int, float)) else "-"
+        for name, kernel, recs, occ, sigs, att_pct in kernel_rows:
+            out(f"{name:<10} {kernel:<34} {recs:>7} {_n(occ):>6} "
+                f"{_n(sigs):>9} {_n(att_pct, '{:.2f}'):>8}")
 
     out("")
     out("== disruption timeline ==")

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Kernel report CLI: render the device-plane kernel ledger for humans.
+
+    python bench.py --json > bench.json
+    python tools/kernel_report.py --current bench.json
+
+    curl -s localhost:9999/kernels > kernels.json
+    python tools/kernel_report.py --current kernels.json
+    python tools/kernel_report.py --current - < kernels.json
+
+Accepts either shape and renders the same sections:
+
+  * a bench record — attainment lives at stage_timings.kernel_attainment
+    (what bench.py computes from the in-process ledger after its run);
+  * a saved `GET /kernels` page — attainment/cost/compile_events ride at
+    the top level next to the raw per-dispatch records.
+
+Sections: the per-kernel attainment table (dispatches, padded vs REAL
+rows, padding occupancy, achieved sigs/s vs the per-backend peak,
+flops/row from the XLA cost model, attainment%), the cached cost model
+per shape bucket, compile events, and — when the record carries raw
+ledger rows — the most recent dispatches with their provenance stamp.
+
+Exit status: 0 = rendered, 2 = unreadable record — a report tool has
+no pass/fail opinion (that's bench.py --gate / tools/bench_gate.py's
+job, which already understands `_attainment_pct` as higher-is-better).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd without installation
+    sys.path.insert(0, _REPO)
+
+
+def _num(value, fmt: str = "{:.1f}") -> str:
+    return fmt.format(value) if isinstance(value, (int, float)) else "-"
+
+
+def _views(record: dict) -> dict:
+    """Normalise the two accepted shapes into one view dict."""
+    stage = record.get("stage_timings") or {}
+    if "kernel_attainment" in stage or "kernel_attainment" in record:
+        # bench record: attainment computed by bench.py after its run
+        return {
+            "attainment": stage.get("kernel_attainment")
+            or record.get("kernel_attainment") or {},
+            "cost": record.get("kernel_cost") or {},
+            "compile_events": record.get("kernel_compile_events") or [],
+            "records": record.get("kernel_records") or [],
+            "backend": record.get("backend"),
+            "enabled": True,
+        }
+    # /kernels page: everything rides at the top level
+    return {
+        "attainment": record.get("attainment") or {},
+        "cost": record.get("cost") or {},
+        "compile_events": record.get("compile_events") or [],
+        "records": record.get("records") or [],
+        "backend": record.get("backend"),
+        "enabled": record.get("enabled", True),
+    }
+
+
+def render(record: dict, tail: int = 8) -> str:
+    lines = []
+    out = lines.append
+    v = _views(record)
+
+    out("== kernel attainment ==")
+    if not v["enabled"]:
+        out("(kernel ledger disabled — CORDA_TPU_KERNEL_LEDGER=0)")
+    att = v["attainment"]
+    if att:
+        out(f"{'kernel':<34} {'disp':>5} {'rows':>8} {'real':>8} "
+            f"{'occ%':>6} {'mean ms':>8} {'sigs/s':>9} "
+            f"{'flops/row':>10} {'attain%':>8}")
+        for kernel in sorted(att):
+            e = att[kernel] or {}
+            disp = e.get("dispatches") or 0
+            wall = e.get("wall_s")
+            mean_ms = (1000.0 * wall / disp) \
+                if isinstance(wall, (int, float)) and disp else None
+            out(f"{kernel:<34} {disp:>5} {e.get('rows', 0):>8} "
+                f"{e.get('real_rows', 0):>8} "
+                f"{_num(e.get('occupancy_pct')):>6} "
+                f"{_num(mean_ms, '{:.2f}'):>8} "
+                f"{_num(e.get('achieved_sigs_s')):>9} "
+                f"{_num(e.get('flops_per_row')):>10} "
+                f"{_num(e.get('attainment_pct'), '{:.2f}'):>8}")
+        first = next(iter(att.values())) or {}
+        out(f"backend={v['backend'] or first.get('backend', '-')} "
+            f"peak_sigs_s={_num(first.get('peak_sigs_s'), '{:.0f}')}")
+    else:
+        out("(no measured dispatches — attainment is MEASURED, "
+            "never assumed)")
+
+    cost = v["cost"]
+    if cost:
+        out("")
+        out("== xla cost model (per shape bucket) ==")
+        out(f"{'kernel':<34} {'bucket':>8} {'rows':>8} "
+            f"{'flops':>14} {'bytes':>12} {'flops/row':>10}")
+        for kernel in sorted(cost):
+            for bucket in sorted(cost[kernel]):
+                e = cost[kernel][bucket] or {}
+                out(f"{kernel:<34} {bucket:>8} {e.get('rows', 0):>8} "
+                    f"{_num(e.get('flops'), '{:.0f}'):>14} "
+                    f"{_num(e.get('bytes_accessed'), '{:.0f}'):>12} "
+                    f"{_num(e.get('flops_per_row')):>10}")
+
+    events = v["compile_events"]
+    if events:
+        out("")
+        out("== compile events ==")
+        for e in events:
+            dur = e.get("seconds")
+            dur_s = f" {dur * 1000.0:.1f}ms" \
+                if isinstance(dur, (int, float)) else ""
+            out(f"  #{e.get('seq')} {e.get('name')}"
+                f"[{e.get('bucket', '-')}]{dur_s}")
+
+    recs = v["records"]
+    if recs:
+        out("")
+        out(f"== last {min(tail, len(recs))} of {len(recs)} "
+            f"ledger records ==")
+        for r in recs[-max(0, tail):]:
+            prov = r.get("provenance")
+            prov_s = f" prov={json.dumps(prov, sort_keys=True)}" \
+                if prov else ""
+            out(f"  #{r.get('seq')} {r.get('kernel')} "
+                f"scheme={r.get('scheme')} bucket={r.get('bucket')} "
+                f"rows={r.get('rows')} real={r.get('real_rows')} "
+                f"occ={_num(r.get('occupancy_pct'))}% "
+                f"wall={_num((r.get('wall_s') or 0) * 1000.0, '{:.2f}')}ms "
+                f"donated={r.get('donated')} mesh_n={r.get('mesh_n')} "
+                f"stage={r.get('stage')}{prov_s}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kernel_report")
+    ap.add_argument(
+        "--current", required=True,
+        help="record to render: a bench JSON / saved /kernels page, "
+             "or '-' for stdin",
+    )
+    ap.add_argument(
+        "--tail", type=int, default=8,
+        help="how many raw ledger records to show (default 8)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        if args.current == "-":
+            record = json.load(sys.stdin)
+        else:
+            with open(args.current) as fh:
+                record = json.load(fh)
+        if not isinstance(record, dict):
+            raise ValueError("not a kernel record")
+    except (OSError, ValueError) as exc:
+        print(f"kernel_report: cannot read record: {exc}", file=sys.stderr)
+        return 2
+
+    sys.stdout.write(render(record, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
