@@ -198,7 +198,13 @@ _ENTRIES = [
     _k("CORDA_TPU_DISPATCH", "auto", "docs/perf-roofline.md",
        "device dispatch mode: auto | jax | host"),
     _k("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20", "docs/hardware-runbook.md",
-       "seconds the subprocess jax backend probe may take"),
+       "seconds ONE subprocess jax backend probe attempt may take"),
+    _k("CORDA_TPU_BACKEND_PROBE_RETRIES", "2", "docs/hardware-runbook.md",
+       "probe attempts before falling back to cpu (alternate init "
+       "scripts rotate per attempt, capped exponential backoff between)"),
+    _k("CORDA_TPU_BACKEND_PROBE_BUDGET_S", "45", "docs/hardware-runbook.md",
+       "wall-clock budget across ALL probe attempts; exhausted = "
+       "classified skip to cpu (backend_probe_status() shows why)"),
     _k("CORDA_TPU_FAST_MUL", "0", "docs/perf-roofline.md",
        "1 enables the experimental fast multiply path (Pallas)"),
     _k("CORDA_TPU_ED25519_RADIX", "13", "docs/perf-roofline.md",
@@ -240,6 +246,9 @@ _ENTRIES = [
     _k("CORDA_TPU_LOADTEST_DEADLINE_S", "unset", "docs/robustness.md",
        "scales every procdriver wait (driver stop join, counterparty "
        "vault poll) for loaded soak boxes / slow ssh rigs"),
+    _k("CORDA_TPU_DOMAIN_DARK_S", "12", "docs/robustness.md",
+       "multi-domain soak dark-window seconds for the domain_partition "
+       "disruption (floor 10 — the acceptance's minimum dark window)"),
     # -- bench --------------------------------------------------------------
     _k("CORDA_TPU_BENCH_FORCE_CPU", "unset", "docs/hardware-runbook.md",
        "1 = bench.py skips the TPU probe and runs CPU-only"),

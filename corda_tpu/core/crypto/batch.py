@@ -17,6 +17,8 @@ exact per-signature accept/reject semantics.
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 import threading
 import time as _time
 from typing import List, Sequence, Tuple
@@ -88,6 +90,35 @@ def _backend() -> str:
     return _resolved_backend
 
 
+#: last backend-probe outcome, for classified reporting (bench harness,
+#: node startup): how the backend was resolved, not just what it is.
+#: classification: "unresolved" (no probe yet) | "inline" (hang-free
+#: in-process read) | "ok" (subprocess probe answered) | "timeout"
+#: (attempt(s) hung until the per-attempt deadline) | "error" (probe
+#: subprocess failed) | "budget-exhausted" (retry budget ran out).
+_probe_status: dict = {
+    "classification": "unresolved", "attempts": 0, "backend": None,
+    "elapsed_s": 0.0,
+}
+
+
+def backend_probe_status() -> dict:
+    """A snapshot of how (and whether) the JAX backend probe resolved —
+    lets bench/node startup degrade a wedged accelerator tunnel to a
+    CLASSIFIED skip ("timeout after 2 attempts / 40 s") instead of a
+    silent cpu fallback or an indefinite hang."""
+    return dict(_probe_status)
+
+
+#: alternate PJRT init paths, tried round-robin across retry attempts: a
+#: tunnel that wedges `default_backend()`'s client-cache path sometimes
+#:  still answers a direct device enumeration (and vice versa)
+_PROBE_SCRIPTS = (
+    "import jax; print(jax.default_backend())",
+    "import jax; print(jax.devices()[0].platform)",
+)
+
+
 def _resolve_backend_without_hanging() -> str:
     """Resolve the backend without risking THIS process's JAX state.
 
@@ -97,18 +128,29 @@ def _resolve_backend_without_hanging() -> str:
     lock, so every later array op in the process deadlocks behind it.
     When the process is pinned to CPU (tests, --jax-platform cpu nodes)
     resolution is hang-free and runs inline; otherwise the probe runs in
-    a SUBPROCESS whose hang cannot poison us, and a timeout latches the
-    host paths."""
+    a SUBPROCESS whose hang cannot poison us.
+
+    BUDGETED (ROADMAP item 1): one hung attempt used to latch "cpu"
+    outright, so a transiently wedged tunnel (libtpu still tearing down
+    a previous owner's lock) permanently demoted a healthy accelerator.
+    The probe now retries up to CORDA_TPU_BACKEND_PROBE_RETRIES attempts
+    with capped backoff, alternating init paths, under a total
+    CORDA_TPU_BACKEND_PROBE_BUDGET_S wall budget — and records a
+    classification (see backend_probe_status) either way, so startup
+    reports a classified skip instead of hanging or guessing."""
     try:
         import jax
 
         platforms = str(getattr(jax.config, "jax_platforms", "") or "")
     except Exception:
+        _probe_status.update(classification="inline", backend="none")
         return "none"
     if platforms and all(
         p.strip() == "cpu" for p in platforms.split(",") if p.strip()
     ):
-        return jax.default_backend()
+        backend = jax.default_backend()
+        _probe_status.update(classification="inline", backend=backend)
+        return backend
     # JAX already initialized IN-PROCESS (simm JIT, ops warm-up, mesh
     # code ran first): the hang hazard only exists before first backend
     # init, and a subprocess probe would CONTEND with this process for
@@ -120,35 +162,78 @@ def _resolve_backend_without_hanging() -> str:
         from jax._src import xla_bridge as _xb
 
         if getattr(_xb, "_backends", None):
-            return jax.default_backend()
+            backend = jax.default_backend()
+            _probe_status.update(classification="inline", backend=backend)
+            return backend
     except Exception:
         pass  # private surface moved: fall through to the subprocess
-    import subprocess
-    import sys
-
     env = dict(os.environ)
     if platforms:
         # the parent's IN-PROCESS pin (jax.config.update) is invisible
         # to a child; propagate it so the probe answers for the
         # configuration the parent actually runs
         env["JAX_PLATFORMS"] = platforms
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, env=env,
-            timeout=float(
-                os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
-            ),
-        )
-        lines = (out.stdout or "").strip().splitlines()
-        backend = lines[-1].strip() if lines else ""
-        # runtimes print banners; accept only a plausible backend name
-        if backend in _ACCEL_BACKENDS or backend in ("cpu", "axon"):
-            return backend
-        return "cpu"
-    except Exception:
-        return "cpu"  # hung or failed probe: the host paths always work
+    return _probe_backend_subprocess(env)
+
+
+def _probe_backend_subprocess(env: dict) -> str:
+    """The budgeted subprocess probe loop (split out so the retry/
+    backoff/classification contract is directly testable): up to
+    CORDA_TPU_BACKEND_PROBE_RETRIES attempts, alternating init scripts,
+    each bounded by CORDA_TPU_BACKEND_PROBE_TIMEOUT, all under the
+    CORDA_TPU_BACKEND_PROBE_BUDGET_S wall budget; always returns a
+    backend name and leaves a classification in _probe_status."""
+    attempt_timeout = float(
+        os.environ.get("CORDA_TPU_BACKEND_PROBE_TIMEOUT", "20")
+    )
+    max_attempts = max(
+        1, int(os.environ.get("CORDA_TPU_BACKEND_PROBE_RETRIES", "2"))
+    )
+    budget_s = float(
+        os.environ.get("CORDA_TPU_BACKEND_PROBE_BUDGET_S", "45")
+    )
+    started = _time.monotonic()
+    classification = "budget-exhausted"
+    for attempt in range(max_attempts):
+        remaining = budget_s - (_time.monotonic() - started)
+        if remaining <= 0:
+            classification = "budget-exhausted"
+            break
+        _probe_status["attempts"] = attempt + 1
+        script = _PROBE_SCRIPTS[attempt % len(_PROBE_SCRIPTS)]
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", script],
+                capture_output=True, text=True, env=env,
+                timeout=min(attempt_timeout, remaining),
+            )
+            lines = (out.stdout or "").strip().splitlines()
+            backend = lines[-1].strip() if lines else ""
+            # runtimes print banners; accept only a plausible backend name
+            if backend in _ACCEL_BACKENDS or backend in ("cpu", "axon"):
+                _probe_status.update(
+                    classification="ok", backend=backend,
+                    elapsed_s=_time.monotonic() - started,
+                )
+                return backend
+            classification = "error"  # probe ran but answered nonsense
+        except subprocess.TimeoutExpired:
+            classification = "timeout"  # wedged tunnel: try the alt path
+        # probe failure is ITSELF the signal: it is classified, surfaced
+        # via backend_probe_status(), and answered with the cpu fallback
+        except Exception:  # lint: allow(swallow)
+            classification = "error"
+        # capped backoff before the alternate init path — a tunnel mid-
+        # teardown often frees within seconds, and anything longer is the
+        # next attempt's timeout's problem
+        if attempt + 1 < max_attempts:
+            _time.sleep(min(5.0, 1.0 * (2 ** attempt)))
+    _probe_status.update(
+        classification=classification, backend="cpu",
+        elapsed_s=_time.monotonic() - started,
+    )
+    # hung/failed/over-budget probe: the host paths always work
+    return "cpu"
 
 
 def _use_device_kernels() -> bool:

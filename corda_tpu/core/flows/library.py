@@ -436,11 +436,13 @@ class FinalityFlow(FlowLogic):
         return stx
 
 
-def NotaryClientFlowRef(stx):
-    """Late import to avoid core->node cycle at module load."""
+def NotaryClientFlowRef(stx, notary=None):
+    """Late import to avoid core->node cycle at module load. `notary`
+    overrides the routing target (the notary-change ASSUME leg sends the
+    old-notary-signed tx to the NEW notary); None routes to stx.notary."""
     from ...node.notary import NotaryClientFlow
 
-    return NotaryClientFlow(stx)
+    return NotaryClientFlow(stx, notary=notary)
 
 
 # ---------------------------------------------------------------------------

@@ -31,7 +31,13 @@ from ..serialization.codec import register_adapter
 from ..transactions.builder import TransactionBuilder
 from ..transactions.notary_change import NotaryChangeWireTransaction
 from ..transactions.signed import SignedTransaction
-from .api import FlowException, FlowLogic, initiated_by, initiating_flow
+from .api import (
+    FlowException,
+    FlowLogic,
+    initiated_by,
+    initiating_flow,
+    startable_by_rpc,
+)
 from .library import NotaryClientFlowRef
 
 
@@ -118,7 +124,7 @@ class AbstractStateReplacementInstigator(FlowLogic):
             stx = stx.with_additional_signature(sig)
 
         try:
-            notary_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+            notary_sigs = yield from self._notarise(stx)
         except Exception as exc:
             raise StateReplacementException(
                 f"unable to notarise state change: {exc}"
@@ -130,6 +136,13 @@ class AbstractStateReplacementInstigator(FlowLogic):
             )
         _record_replacement(hub, final)
         return self._replacement_output(final)
+
+    def _notarise(self, stx: SignedTransaction):
+        """Notarisation hook: subclasses with multi-notary protocols (the
+        cross-domain notary change) override this; the default is a plain
+        single-notary commit."""
+        notary_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+        return notary_sigs
 
     def _replacement_output(self, final: SignedTransaction) -> StateAndRef:
         wtx = final.tx
@@ -197,9 +210,66 @@ class AbstractStateReplacementAcceptor(FlowLogic):
 # Notary change (reference NotaryChangeFlow.kt)
 # ---------------------------------------------------------------------------
 
+@startable_by_rpc
 @initiating_flow
 class NotaryChangeFlow(AbstractStateReplacementInstigator):
-    """Migrate a state (and its encumbrance chain) to a new notary."""
+    """Migrate a state (and its encumbrance chain) to a new notary.
+
+    Cross-notary moves run a journaled two-phase commit (`_notarise`):
+    the OLD notary durably consumes the inputs, then the NEW notary
+    durably assumes them, with the in-flight decision journaled in the
+    instigator's database so a crash at any point re-drives forward to
+    exactly one owning notary (see node/notary_change.py)."""
+
+    def _notarise(self, stx: SignedTransaction):
+        from ...node.notary_change import change_journal, fire_crash_point
+
+        wtx = stx.tx
+        cross_notary = (
+            isinstance(wtx, NotaryChangeWireTransaction)
+            and wtx.new_notary.owning_key.encoded
+            != wtx.notary.owning_key.encoded
+        )
+        if not cross_notary:
+            # Same-notary re-pin (or non-notary-change subclass use):
+            # single commit, no journal — byte-identical to the old path.
+            notary_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+            return notary_sigs
+
+        journal = change_journal(self.service_hub)
+        tx_hex = stx.id.bytes.hex()
+        fire_crash_point(
+            "notary_change.before_prepare", tx_id=tx_hex,
+            old=wtx.notary.name, new=wtx.new_notary.name,
+        )
+        # Durable intent: recovery can always learn what was in flight.
+        journal.put(tx_hex, {
+            "phase": "prepare", "stx": stx,
+            "old": wtx.notary.name, "new": wtx.new_notary.name,
+        })
+        fire_crash_point("notary_change.after_prepare", tx_id=tx_hex)
+
+        # CONSUME: the old notary (which governs the inputs) commits.
+        old_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+        signed = stx.with_additional_signatures(old_sigs)
+        # Durable decision flip: the consume is irreversible, so from
+        # here recovery must drive the assume — never roll back.
+        journal.put(tx_hex, {
+            "phase": "assume", "stx": signed,
+            "old": wtx.notary.name, "new": wtx.new_notary.name,
+        })
+        fire_crash_point(
+            "notary_change.between_consume_and_assume", tx_id=tx_hex
+        )
+
+        # ASSUME: the new notary records the migrated refs in its own
+        # log (gated server-side on the old notary's commit signature).
+        new_sigs = yield from self.sub_flow(
+            NotaryClientFlowRef(signed, notary=wtx.new_notary)
+        )
+        fire_crash_point("notary_change.after_commit", tx_id=tx_hex)
+        journal.remove(tx_hex)
+        return tuple(old_sigs) + tuple(new_sigs)
 
     def assemble_tx(self):
         hub = self.service_hub

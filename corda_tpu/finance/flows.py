@@ -57,12 +57,21 @@ def generate_spend(
     # the vault's lazy availability iterator and stops at the target,
     # so a pick touches (and deserializes) O(selected) states, not
     # O(vault) — docs/perf-system.md round 20.
+    # Notary pinning (docs/robustness.md §6): only coins governed by the
+    # builder's notary are eligible — mixing notaries in one input set is
+    # unnotarisable (NotaryClientFlow rejects it with WrongNotaryError),
+    # so a vault holding multi-domain cash must never assemble one.
+    pinned = getattr(builder, "notary", None)
+    pinned_key = pinned.owning_key.encoded if pinned is not None else None
     for attempt in range(5):
         selected, gathered = [], 0
         for sr in vault.iter_unlocked_unconsumed(
             CashState.contract_name, lock_id=lock_id
         ):
             if sr.state.data.amount.token != amount.token:
+                continue
+            if (pinned_key is not None and sr.state.notary is not None
+                    and sr.state.notary.owning_key.encoded != pinned_key):
                 continue
             selected.append(sr)
             gathered += sr.state.data.amount.quantity
@@ -169,12 +178,20 @@ class CashExitFlow(FlowLogic):
         hub = self.service_hub
         me = hub.my_info
         vault = hub.vault_service
+        pinned_key = (
+            self.notary.owning_key.encoded if self.notary is not None else None
+        )
         selected, gathered = [], 0
         for sr in vault.iter_unlocked_unconsumed(
             CashState.contract_name, lock_id=lock_id
         ):
             if (sr.state.data.amount.token != self.amount.token
                     or sr.state.data.owner != me):
+                continue
+            # same notary-pinning rule as generate_spend: never mix
+            # notaries in one exit's input set
+            if (pinned_key is not None and sr.state.notary is not None
+                    and sr.state.notary.owning_key.encoded != pinned_key):
                 continue
             selected.append(sr)
             gathered += sr.state.data.amount.quantity

@@ -382,6 +382,106 @@ def shard_worker_process_kill(pick_pid, kill_pid, probe: Callable[[], int],
     return d
 
 
+def domain_partition(victims, foreign_probe: Callable[[], int],
+                     dark_probe: Optional[Callable[[], int]] = None,
+                     min_progress: int = 2,
+                     recovery_deadline_s: float = 120.0,
+                     probability: float = 0.2,
+                     heal_after: int = 2) -> Disruption:
+    """Darken an ENTIRE domain's notary cluster (docs/robustness.md §6):
+    SIGSTOP every process in `victims` (each needs suspend()/resume() —
+    RemoteNode or a netproxy-blackhole wrapper duck-types in). The heal
+    carries the federation's core claim and asserts it in two parts, in
+    order: FIRST, while the domain is still dark, `foreign_probe`
+    (completions in OTHER domains / cross-domain-to-healthy) must
+    advance — traffic outside the blast radius CONTINUED, not merely
+    resumed; only THEN are the victims resumed and `dark_probe` (the
+    dark domain's own completions) must advance too — the partitioned
+    segment recovers with its hospital-parked retries draining."""
+    state = {}
+
+    def fire(rng, nodes):
+        state["before_foreign"] = foreign_probe()
+        if dark_probe is not None:
+            state["before_dark"] = dark_probe()
+        for v in victims:
+            v.suspend()
+        state["fired"] = True
+
+    def heal(rng, nodes):
+        if not state.pop("fired", False):
+            return
+        # asserted BEFORE resume: progress observed here happened with
+        # the domain dark, which is the whole point of segmented trust
+        state["during_progress"] = assert_recovers(
+            foreign_probe, state.pop("before_foreign", 0),
+            "domain partition (foreign traffic during dark window)",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+        for v in victims:
+            v.resume()
+        if dark_probe is not None:
+            assert_recovers(
+                dark_probe, state.pop("before_dark", 0),
+                "domain partition (dark domain post-heal)",
+                min_progress=min_progress, deadline_s=recovery_deadline_s,
+            )
+
+    d = Disruption("domain-partition", fire, heal,
+                   probability=probability, heal_after=heal_after)
+    d.state = state  # observable: during-dark progress for goodput math
+    return d
+
+
+def notary_change_storm(launch, probe: Callable[[], int],
+                        changes: int = 4,
+                        min_progress: int = 1,
+                        recovery_deadline_s: float = 120.0,
+                        probability: float = 0.2,
+                        heal_after: int = 2) -> Disruption:
+    """Fire a burst of notary changes ping-ponging states between
+    domains (docs/robustness.md §6) while the workload runs: `launch(rng)`
+    starts ONE re-pin and returns a zero-arg waiter that raises if that
+    change failed to land (or None when nothing was eligible). The heal
+    drains every waiter — each change must have completed to exactly one
+    owning notary, the 2PC journal empty behind it — then asserts the
+    surrounding workload still made progress through the storm."""
+    state = {}
+
+    def fire(rng, nodes):
+        state["before"] = probe()
+        handles = []
+        for _ in range(changes):
+            h = launch(rng)
+            if h is not None:
+                handles.append(h)
+        state["handles"] = handles
+        state["fired"] = bool(handles)
+
+    def heal(rng, nodes):
+        if not state.pop("fired", False):
+            return
+        failures = []
+        for waiter in state.pop("handles", []):
+            try:
+                waiter()
+            except Exception as exc:
+                failures.append(exc)
+        assert not failures, (
+            f"notary-change storm: {len(failures)} changes failed to "
+            f"land: {failures[:3]}"
+        )
+        assert_recovers(
+            probe, state.pop("before", 0), "notary-change storm",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+
+    d = Disruption("notary-change-storm", fire, heal,
+                   probability=probability, heal_after=heal_after)
+    d.state = state
+    return d
+
+
 def clock_skew(delta_s: float = 3600.0) -> Disruption:
     """Skew a node's clock forward (time-window failures downstream)."""
     state = {}

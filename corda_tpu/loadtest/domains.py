@@ -1,0 +1,407 @@
+"""Multi-domain notary federation soak (docs/robustness.md §6) against
+a REAL OS-process network: N independent notary domains, each a trust
+segment with its own validating notary and domain-scoped network-map
+view, driven concurrently while the rotation darkens one domain and
+ping-pongs a state between two others with atomic notary changes.
+
+Topology (9 processes, local spawns): for each domain in DOMAINS
+  * a validating notary pinned to the domain and advertised as a
+    cross-domain GATEWAY — the fleet-visible anchor the notary-change
+    ASSUME leg routes through; the first one also hosts the network
+    map directory;
+  * bank A + bank B pinned to the domain, driving issue+pay pairs
+    strictly inside it (their map fetches are domain-scoped, so the
+    federation's segmentation is exercised on every RPC resolve).
+
+Rotation (deterministic order, catalog entries from
+loadtest/disruption.py — the chaos-runner contract where heal()
+carries the recovery assertion):
+  * notary_change_storm — bursts of RPC NotaryChangeFlow round-trips
+    re-pinning a dedicated cash state from the first domain's notary
+    to the second's and back (the 2PC consume→assume protocol, twice
+    per change, mid-traffic);
+  * domain_partition — SIGSTOP the LAST domain's notary for the dark
+    window (>= 10 s); foreign goodput is measured WHILE dark, the heal
+    asserts foreign traffic advanced before resuming the victim, and
+    dark-window sheds must classify typed-transient.
+
+End-of-run: per-domain no-loss/no-dup against each counterparty vault,
+`multi_domain_pairs_s` (gate direction: higher is better via the
+`_pairs_s` suffix), `domain_goodput_pct`, and
+`mttr_ms{kind=domain_partition}` for the soak gate's --mttr ceiling.
+
+Run: python -m corda_tpu.loadtest.domains [--duration 90] [--seed 7]
+     python tools/soak_gate.py --current - --domain-goodput 50
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: the federation's segments; the last one is the partition victim and
+#: the first two are the notary-change ping-pong endpoints
+DOMAINS: Tuple[str, ...] = ("alpha", "beta", "gamma")
+
+#: substrings that mark a dark-window shed as TYPED-TRANSIENT (hospital
+#: vocabulary: notary unavailability / deadline supervision) — anything
+#: else shed while a domain is dark is a misclassified failure
+TRANSIENT_MARKERS = ("unavailable", "timed out", "timeout", "transient")
+
+
+def default_dark_window_s() -> float:
+    """Dark-window length for the domain partition. Knob-driven
+    (CORDA_TPU_DOMAIN_DARK_S, docs/running-nodes.md) because a loaded
+    soak box needs a longer window for the foreign-progress claim to be
+    meaningful; the floor keeps the window >= the acceptance's 10 s."""
+    raw = os.environ.get("CORDA_TPU_DOMAIN_DARK_S")
+    try:
+        return max(10.0, float(raw)) if raw else 12.0
+    except ValueError:
+        return 12.0
+
+
+def is_typed_transient_shed(error: str) -> bool:
+    """True when a driver error string carries a transient marker the
+    hospital would retry (NotaryException unavailability / deadline
+    text) — the only acceptable shed while the shedding domain's
+    notary is dark."""
+    low = error.lower()
+    return any(marker in low for marker in TRANSIENT_MARKERS)
+
+
+def domain_spec(domains: Tuple[str, ...] = DOMAINS) -> Dict:
+    """Cordform descriptor for the federation: per domain one gateway
+    validating notary (first hosts the map directory) + two banks."""
+    nodes: List[Dict] = []
+    for i, dom in enumerate(domains):
+        notary = {
+            "name": f"O=Notary {dom.capitalize()},L=Zurich,C=CH",
+            "notary": "validating", "domain": dom, "gateway": True,
+        }
+        if i == 0:
+            notary["network_map_service"] = True
+        nodes.append(notary)
+        nodes.append({
+            "name": f"O=Bank {dom.capitalize()} A,L=London,C=GB",
+            "domain": dom,
+        })
+        nodes.append({
+            "name": f"O=Bank {dom.capitalize()} B,L=Paris,C=FR",
+            "domain": dom,
+        })
+    return {"nodes": nodes}
+
+
+def _domain_identities(bank_a, bank_b, domain: str):
+    """(me, own-domain notary, peer) over the banks' RPC. Unlike
+    procdriver.resolve_identities the notary is picked BY DOMAIN: a
+    scoped map still lists every foreign GATEWAY notary, so
+    notary_identities()[0] could silently pin the driver to the wrong
+    trust segment."""
+    conn = bank_a.connect()
+    try:
+        me = conn.proxy.node_info()
+        notaries = conn.proxy.notary_identities()
+        own = [n for n in notaries if domain in n.name.lower()]
+        assert own, (
+            f"no notary advertised for domain {domain!r}: "
+            f"{[n.name for n in notaries]}"
+        )
+        notary = own[0]
+    finally:
+        conn.close()
+    conn = bank_b.connect()
+    try:
+        peer = conn.proxy.node_info()
+    finally:
+        conn.close()
+    return me, notary, peer
+
+
+def make_storm_launch(conn, me, own_notary, other_notary,
+                      wait_s: float,
+                      counter: Optional[Dict[str, int]] = None
+                      ) -> Callable:
+    """Builds the notary_change_storm catalog entry's `launch(rng)`:
+    issue a DEDICATED 7-USD state (issuer ref 2 — the pair drivers
+    select strictly by their ref-1 token, so the ping-pong state is
+    never raced by a concurrent spend), start the cross-domain
+    NotaryChangeFlow over RPC, and return a waiter that drains the
+    round trip: own -> other -> own, asserting the re-pin landed on
+    each leg. A launch failure propagates — an ineligible state is the
+    caller's bug here, not a skippable round."""
+    from ..core.contracts import Amount, StateAndRef, StateRef
+
+    def launch(rng):
+        fid = conn.proxy.start_flow_dynamic(
+            "CashIssueFlow", Amount(7, "USD"), b"\x02", me, own_notary,
+        )
+        stx = conn.proxy.flow_result(fid, wait_s)
+        sar = StateAndRef(stx.tx.outputs[0], StateRef(stx.id, 0))
+        out_fid = conn.proxy.start_flow_dynamic(
+            "NotaryChangeFlow", sar, other_notary,
+        )
+
+        def waiter():
+            moved = conn.proxy.flow_result(out_fid, wait_s)
+            assert moved.state.notary.name == other_notary.name, (
+                f"outbound re-pin landed on {moved.state.notary.name}, "
+                f"wanted {other_notary.name}"
+            )
+            back_fid = conn.proxy.start_flow_dynamic(
+                "NotaryChangeFlow", moved, own_notary,
+            )
+            back = conn.proxy.flow_result(back_fid, wait_s)
+            assert back.state.notary.name == own_notary.name, (
+                f"return re-pin landed on {back.state.notary.name}, "
+                f"wanted {own_notary.name}"
+            )
+            if counter is not None:
+                counter["changes"] = counter.get("changes", 0) + 2
+
+        return waiter
+
+    return launch
+
+
+def run(duration: float = 90.0, seed: int = 7, verbose: bool = False,
+        dark_s: Optional[float] = None) -> dict:
+    from ..testing.smoketesting import Factory
+    from ..tools.cordform import deploy_nodes
+    from .disruption import domain_partition, notary_change_storm
+    from .observatory import disruption_mttr
+    from .procdriver import PairDriver, _deadline_s, assert_no_loss_no_dup
+
+    if dark_s is None:
+        dark_s = default_dark_window_s()
+    rng = random.Random(seed)
+    base = tempfile.mkdtemp(prefix="domains-")
+    resolved = deploy_nodes(domain_spec(), base)
+    factory = Factory(base)
+    nodes: List = []
+    drivers: Dict[str, PairDriver] = {}
+    storm_conn = None
+    try:
+        for conf in resolved:
+            nodes.append(factory.launch(conf["dir"]))
+        # layout: domain i -> notary 3i, bank A 3i+1, bank B 3i+2
+        idents = {}
+        for i, dom in enumerate(DOMAINS):
+            me, notary, peer = _domain_identities(
+                nodes[3 * i + 1], nodes[3 * i + 2], dom,
+            )
+            idents[dom] = (me, notary, peer)
+            drivers[dom] = PairDriver(
+                nodes[3 * i + 1], notary, me, peer,
+            ).start()
+        # warm-up gate per domain: booting 9 processes is slow on a
+        # loaded box; disrupting before every segment completes a pair
+        # turns the soak into a spurious "no pairs completed" failure
+        warmup_deadline = time.monotonic() + _deadline_s(300.0)
+        for dom in DOMAINS:
+            drv = drivers[dom]
+            while len(drv.completed) < 2:
+                assert drv._thread.is_alive(), (
+                    f"driver {dom} died during warm-up: {drv.errors[-3:]}"
+                )
+                assert time.monotonic() < warmup_deadline, (
+                    f"warm-up stalled in domain {dom}: {drv.errors[-3:]}"
+                )
+                time.sleep(0.3)
+
+        t0 = time.monotonic()
+        dark_domain = DOMAINS[-1]
+
+        def foreign() -> int:
+            return sum(
+                len(drivers[d].completed) for d in DOMAINS[:-1]
+            )
+
+        def dark() -> int:
+            return len(drivers[dark_domain].completed)
+
+        # baseline window: the undisrupted foreign rate the dark-window
+        # goodput ratio is judged against
+        baseline_s = min(8.0, max(4.0, duration / 8.0))
+        before_baseline = foreign()
+        time.sleep(baseline_s)
+        baseline_rate = (foreign() - before_baseline) / baseline_s
+
+        dom_a, dom_b = DOMAINS[0], DOMAINS[1]
+        storm_conn = nodes[1].connect()
+        storm_counter: Dict[str, int] = {}
+        launch = make_storm_launch(
+            storm_conn, idents[dom_a][0], idents[dom_a][1],
+            idents[dom_b][1], _deadline_s(90.0), storm_counter,
+        )
+        catalog = [
+            ("notary_change_storm", notary_change_storm(
+                launch, foreign, changes=2,
+                recovery_deadline_s=_deadline_s(180.0),
+            )),
+            ("domain_partition", domain_partition(
+                [nodes[3 * (len(DOMAINS) - 1)]], foreign, dark,
+                recovery_deadline_s=_deadline_s(180.0),
+            )),
+        ]
+
+        events: List[Tuple[float, str, str]] = []
+        dark_sheds: List[str] = []
+        goodput_samples: List[float] = []
+        disruptions_recovered = 0
+        t_end = t0 + duration
+        done = False
+        while not done:
+            for kind, disruption in catalog:
+                mark = time.monotonic()
+                if kind == "domain_partition":
+                    errs_before = len(drivers[dark_domain].errors)
+                    fb = foreign()
+                    disruption.fire(rng)
+                    events.append(
+                        (round(mark - t0, 1), kind, "fired")
+                    )
+                    time.sleep(dark_s)  # the dark window (>= 10 s)
+                    # goodput measured WHILE the domain is still dark —
+                    # after heal() any progress could be post-resume
+                    during = foreign() - fb
+                    dark_sheds.extend(
+                        drivers[dark_domain].errors[errs_before:]
+                    )
+                    disruption.heal(rng)
+                    if baseline_rate > 0:
+                        goodput_samples.append(
+                            100.0 * (during / dark_s) / baseline_rate
+                        )
+                else:
+                    disruption.fire(rng)
+                    events.append(
+                        (round(mark - t0, 1), kind, "fired")
+                    )
+                    # let the changes fly mid-traffic before draining
+                    time.sleep(min(4.0, dark_s / 3.0))
+                    disruption.heal(rng)
+                progressed = foreign()
+                events.append((
+                    round(time.monotonic() - t0, 1), kind,
+                    f"recovered+{progressed}",
+                ))
+                disruptions_recovered += 1
+                if verbose:
+                    # progress goes to stderr: stdout is the JSON record
+                    # the soak gate reads (`--current -`)
+                    print("event:", events[-1], "foreign:", progressed,
+                          "dark:", dark(), flush=True, file=sys.stderr)
+                if time.monotonic() >= t_end:
+                    done = True
+                    break
+
+        wall = time.monotonic() - t0
+        for dom in DOMAINS:
+            drivers[dom].stop(timeout=_deadline_s(300.0))
+        # per-domain reconciliation: every pair the client saw complete
+        # is on that domain's counterparty ledger, exactly once
+        for i, dom in enumerate(DOMAINS):
+            assert_no_loss_no_dup(drivers[dom], nodes[3 * i + 2])
+
+        total_pairs = sum(len(d.completed) for d in drivers.values())
+        transient_sheds = [
+            e for e in dark_sheds if is_typed_transient_shed(e)
+        ]
+        goodput_pct = (
+            round(min(goodput_samples), 1) if goodput_samples else None
+        )
+        all_errors = [
+            str(e) for d in drivers.values() for e in d.errors
+        ]
+        hard_errors = [
+            e for e in all_errors if not is_typed_transient_shed(e)
+        ]
+        slo_violations = []
+        if len(transient_sheds) != len(dark_sheds):
+            slo_violations.append({
+                "key": "dark_sheds_typed_transient",
+                "value": len(dark_sheds) - len(transient_sheds),
+                "bound": 0, "kind": "untyped-shed",
+            })
+        return {
+            "metric": "multi-domain-soak",
+            "domains": list(DOMAINS),
+            "dark_domain": dark_domain,
+            "pairs": total_pairs,
+            "pairs_by_domain": {
+                dom: len(drivers[dom].completed) for dom in DOMAINS
+            },
+            "wall_s": round(wall, 1),
+            "multi_domain_pairs_s": round(total_pairs / wall, 2),
+            "baseline_pairs_s": round(baseline_rate, 2),
+            "dark_window_s": dark_s,
+            "domain_goodput_pct": goodput_pct,
+            "notary_changes": storm_counter.get("changes", 0),
+            "dark_sheds": len(dark_sheds),
+            "dark_sheds_transient": len(transient_sheds),
+            "disruptions": len(
+                [e for e in events if e[2] == "fired"]
+            ),
+            "disruptions_recovered": disruptions_recovered,
+            "events": events,
+            "mttr": disruption_mttr(events),
+            "driver_errors": len(all_errors),
+            "shed_driver_errors": len(all_errors) - len(hard_errors),
+            "hard_driver_errors": len(hard_errors),
+            # the gate's universal bound (soak_gate BOUNDS): untyped
+            # errors per attempted pair — typed-transient sheds during
+            # the dark window are the design, not a defect
+            "hard_error_rate": round(
+                len(hard_errors)
+                / max(1, total_pairs + len(hard_errors)), 4,
+            ),
+            "slo_violations": slo_violations,
+            "consistent": True,
+        }
+    finally:
+        for drv in drivers.values():
+            if not drv._stop.is_set():
+                try:
+                    drv.stop(timeout=5)
+                # teardown must still close the nodes below
+                except BaseException:  # lint: allow(swallow)
+                    pass
+        if storm_conn is not None:
+            try:
+                storm_conn.close()
+                # closing an already-dead connection is fine in teardown
+            except Exception:  # lint: allow(swallow)
+                pass
+        for n in nodes:
+            n.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda_tpu.loadtest.domains")
+    ap.add_argument("--duration", type=float, default=90.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--dark-window", type=float, default=None,
+        help="domain-partition dark window seconds "
+             "(default CORDA_TPU_DOMAIN_DARK_S or 12; floor 10)",
+    )
+    args = ap.parse_args(argv)
+    print(json.dumps(run(
+        args.duration, args.seed, verbose=True,
+        dark_s=args.dark_window,
+    )))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

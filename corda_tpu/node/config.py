@@ -64,6 +64,12 @@ DEFAULTS = {
     # its broker (null = CORDA_TPU_NODE_WORKERS or single-process)
     "shards": None,
     "node_workers": None,
+    # multi-domain federation (docs/robustness.md §6): the named trust
+    # segment this node belongs to (null = domainless, visible
+    # fleet-wide — byte-identical to a single-domain network) and
+    # whether it advertises as a cross-domain gateway
+    "domain": None,
+    "gateway": False,
 }
 
 
@@ -136,6 +142,8 @@ def load_config(config_dir: str, overrides: Optional[dict] = None) -> FullNodeCo
             else (int(os.environ["CORDA_TPU_NODE_WORKERS"])
                   if os.environ.get("CORDA_TPU_NODE_WORKERS") else None)
         ),
+        domain=cfg.get("domain"),
+        gateway=bool(cfg.get("gateway", False)),
     )
     return FullNodeConfiguration(
         node=node_cfg,

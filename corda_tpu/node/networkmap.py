@@ -124,6 +124,26 @@ def sign_registration(reg: NodeRegistration, private_key) -> SignedRegistration:
     return SignedRegistration(reg, crypto.do_sign(private_key, reg.signable_bytes()))
 
 
+def _entry_visible(domain: Optional[str], services) -> bool:
+    """Is a map entry advertising `services` visible from `domain`'s
+    scoped view?  `domain=None` means an UNSCOPED requester (no "domain"
+    field in its fetch/subscribe — every pre-federation client), which
+    sees the full map: the kill switch that keeps single-domain networks
+    byte-identical.  A scoped requester sees its own domain, domainless
+    entries, and advertised cross-domain gateways."""
+    if domain is None:
+        return True
+    from .services import NetworkMapCache as _cache
+
+    svc = tuple(services)
+    entry_domain = _cache.domain_of_services(svc)
+    return (
+        entry_domain is None
+        or entry_domain == domain
+        or _cache.GATEWAY_SERVICE in svc
+    )
+
+
 class NetworkMapService:
     """The directory service (runs in the map node's process, serves over
     its broker).  Thread-per-service pull loop, mirroring the verifier
@@ -208,11 +228,15 @@ class NetworkMapService:
                 self._push({"kind": "push", "registration": signed})
         elif kind == "fetch":
             now = time.time()
+            domain = request.get("domain")  # absent = unscoped full map
             with self._lock:
                 entries = [
                     s for s in self._entries.values()
                     if s.registration.reg_type == ADD
                     and s.registration.expires_at > now
+                    and _entry_visible(
+                        domain, s.registration.advertised_services
+                    )
                 ]
             if reply_to:
                 self._reply(reply_to, {"kind": "fetch-reply", "entries": entries})
@@ -220,7 +244,9 @@ class NetworkMapService:
             queue = request.get("queue")
             if queue:
                 with self._lock:
-                    self._subscribers[queue] = None
+                    # value = the subscriber's domain scope (None =
+                    # unscoped: receives every push, pre-federation shape)
+                    self._subscribers[queue] = request.get("domain")
                 if reply_to:
                     self._reply(reply_to, {"kind": "subscribe-ack", "ok": True})
         elif kind == "query":
@@ -296,9 +322,16 @@ class NetworkMapService:
 
     def _push(self, payload: dict) -> None:
         blob = serialize(payload)
+        signed = payload.get("registration")
+        services = (
+            signed.registration.advertised_services
+            if isinstance(signed, SignedRegistration) else ()
+        )
         with self._lock:
-            subscribers = list(self._subscribers)
-        for queue in subscribers:
+            subscribers = list(self._subscribers.items())
+        for queue, domain in subscribers:
+            if not _entry_visible(domain, services):
+                continue  # outside the subscriber's domain scope
             try:
                 self._broker.send(queue, blob)
             except Exception:
@@ -343,6 +376,20 @@ class NetworkMapClient:
         self._me = me
         self._my_address = my_address
         self._advertised = tuple(advertised_services)
+        # domain scope, derived from our own advertised tags: a node in a
+        # domain asks the directory only for its own segment (+ gateways);
+        # a domainless node sends NO domain field — the exact
+        # pre-federation request bytes (kill switch). A GATEWAY asks
+        # unscoped too: it anchors cross-domain protocol legs (the
+        # notary-change ASSUME resolves its back-chain from a
+        # foreign-domain client), so a scoped view would strand the
+        # sessions it must serve.
+        from .services import NetworkMapCache as _cache
+
+        self._domain = (
+            None if _cache.GATEWAY_SERVICE in self._advertised
+            else _cache.domain_of_services(self._advertised)
+        )
         self._key = identity_private_key
         self._extra_identities = list(extra_identities or [])
         self._on_entry = on_entry
@@ -399,10 +446,15 @@ class NetworkMapClient:
             daemon=True,
         )
         self._refresh_thread.start()
-        self._request({"kind": "subscribe", "queue": self._push_queue,
-                       "reply_to": self._reply_queue})
+        subscribe = {"kind": "subscribe", "queue": self._push_queue,
+                     "reply_to": self._reply_queue}
+        fetch = {"kind": "fetch", "reply_to": self._reply_queue}
+        if self._domain is not None:
+            subscribe["domain"] = self._domain
+            fetch["domain"] = self._domain
+        self._request(subscribe)
         self._await_reply("subscribe-ack", timeout)
-        self._request({"kind": "fetch", "reply_to": self._reply_queue})
+        self._request(fetch)
         reply = self._await_reply("fetch-reply", timeout)
         count = 0
         for signed in reply.get("entries", []):

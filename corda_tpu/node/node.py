@@ -75,6 +75,17 @@ class NodeConfiguration:
     # MockNetwork ignores it; wired by node/__main__.py + shardhost.py).
     shards: Optional[int] = None
     node_workers: Optional[int] = None
+    # Multi-domain federation (docs/robustness.md §6): `domain` pins this
+    # node to one named trust segment — it advertises the
+    # `corda.domain.<name>` tag and its network-map fetches/subscriptions
+    # are scoped to that domain plus domainless nodes and advertised
+    # cross-domain gateways. `gateway` additionally advertises
+    # `corda.gateway`, making the node visible from EVERY domain's
+    # scoped map. Both default off: an unconfigured network advertises
+    # no domain bytes and behaves byte-identically to a single-domain
+    # deployment (kill switch).
+    domain: Optional[str] = None
+    gateway: bool = False
 
 
 class AbstractNode:
@@ -86,6 +97,19 @@ class AbstractNode:
         zero-arg callable returning unix seconds (default time.time);
         simulations pass a utils.clocks.TestClock (reference TestClock)."""
         self.config = config
+        # Multi-domain federation: fold the domain/gateway config into
+        # the advertised service tags ONCE, before anything registers —
+        # the tags then ride every existing registration path (network
+        # map, MockNetwork fan-out, cluster identities) unchanged. An
+        # unconfigured node appends nothing (kill switch).
+        if config.domain is not None:
+            tag = NetworkMapCache.DOMAIN_SERVICE_PREFIX + config.domain
+            if tag not in config.advertised_services:
+                config.advertised_services.append(tag)
+        if (config.gateway
+                and NetworkMapCache.GATEWAY_SERVICE
+                not in config.advertised_services):
+            config.advertised_services.append(NetworkMapCache.GATEWAY_SERVICE)
         # flight recorder: bridge every corda_tpu.* stdlib log record into
         # the process event log (idempotent), so component warnings that
         # predate the recorder still land in /logs
@@ -949,6 +973,12 @@ class AbstractNode:
             self._cluster_services.insert(
                 0, NetworkMapCache.VALIDATING_NOTARY_SERVICE
             )
+        if self.config.domain is not None:
+            # the CLUSTER identity carries the member's domain so the
+            # scoped map and notaries_in_domain() route to it
+            self._cluster_services.append(
+                NetworkMapCache.DOMAIN_SERVICE_PREFIX + self.config.domain
+            )
         self.services.network_map_cache.add_node(
             self.cluster_party, list(self._cluster_services)
         )
@@ -983,6 +1013,24 @@ class AbstractNode:
             self.info, self.config.advertised_services
         )
         self.smm.start()
+        # Surface crash-interrupted notary changes (journal entries left
+        # by a coordinator death mid-2PC). The checkpointed flow itself
+        # resumes through the SMM; this is the operator-visible signal
+        # that NotaryChangeRecoveryFlow has work if the flow is gone.
+        try:
+            from .notary_change import pending_notary_changes
+
+            pending = pending_notary_changes(self.services)
+            if pending:
+                eventlog.emit(
+                    "warn", "notary", "incomplete notary changes found",
+                    node=self.info.name, count=len(pending),
+                    tx_ids=[tx[:16] for tx, _ in pending],
+                )
+        # a corrupt journal must not block node start; recovery re-reads
+        # it on demand
+        except Exception:  # lint: allow(swallow)
+            pass
         if hasattr(self.network, "start"):
             # Open the P2P pump only now that handlers are installed (a
             # message consumed before this point would be dropped).

@@ -86,6 +86,24 @@ class NotaryUnavailableError(NotaryException):
     transient = True
 
 
+class WrongNotaryError(NotaryException):
+    """Notary-pinning violation verdict: the transaction's inputs are
+    governed by a different notary than the one asked to commit them, or
+    the named notary cannot be resolved on this network. FINAL by
+    construction — retrying cannot change which notary a state is pinned
+    to — so there is deliberately no `transient` attr and the message
+    wording must never match the hospital's unavailable/timed-out
+    predicate: the hospital wards it fatal instead of re-admitting a
+    flow that can only fail the same way again. `pinned_notary` carries
+    the governing notary of the offending input (None for the
+    unresolvable-notary case) so callers can re-route or instigate a
+    notary change."""
+
+    def __init__(self, error, pinned_notary: Optional[Party] = None):
+        super().__init__(error)
+        self.pinned_notary = pinned_notary
+
+
 class UniquenessException(Exception):
     def __init__(self, conflict: Conflict):
         super().__init__(f"input state conflict: {conflict}")
@@ -900,11 +918,17 @@ def notary_tearoff_filter(obj: object) -> bool:
 class NotaryClientFlow(FlowLogic):
     """Client side (reference NotaryFlow.Client, NotaryFlow.kt:33-95)."""
 
-    def __init__(self, stx: SignedTransaction, notary_validating: Optional[bool] = None):
+    def __init__(self, stx: SignedTransaction, notary_validating: Optional[bool] = None,
+                 notary: Optional[Party] = None):
         self.stx = stx
         # None -> ask the network map (single-notary networks); explicit for
         # multi-notary setups.
         self.notary_validating = notary_validating
+        # target override: None routes to stx.notary (every pre-existing
+        # call site). The cross-domain notary-change ASSUME leg passes
+        # the NEW notary here — the wire tx's `notary` field must keep
+        # naming the OLD notary (it is what the consume leg validates).
+        self.notary = notary
 
     def call(self):
         from ..core.transactions.notary_change import (
@@ -912,10 +936,11 @@ class NotaryClientFlow(FlowLogic):
         )
 
         stx = self.stx
-        notary = stx.notary
+        notary = self.notary if self.notary is not None else stx.notary
         if notary is None:
             raise FlowException("transaction has no notary set")
         is_notary_change = isinstance(stx.tx, NotaryChangeWireTransaction)
+        self._check_notary_pinning(stx, notary, is_notary_change)
         if is_notary_change:
             # The instigator holds the input states; full pre-notarisation
             # check (signers resolved from input participants).
@@ -988,6 +1013,56 @@ class NotaryClientFlow(FlowLogic):
                 "notary signatures do not fulfil the cluster identity"
             )
         return sigs
+
+    def _check_notary_pinning(self, stx, notary: Party,
+                              is_notary_change: bool) -> None:
+        """Per-state notary pinning, enforced before anything crosses the
+        wire (multi-domain federation: the data model's `notary` field is
+        load-bearing). Two violations, both typed WrongNotaryError so the
+        hospital wards them fatal instead of retrying a routing decision
+        that cannot change:
+
+          * the target notary is not resolvable as a notary on this
+            node's (domain-scoped) network map;
+          * an input state we hold is pinned to a different notary than
+            the one asked to commit it (mixed-notary input set).
+
+        A notary-change tx is the sanctioned exception: its inputs are
+        pinned to the OLD notary while the assume leg targets the NEW
+        one, so both of the wire tx's notaries are legitimate."""
+        cache = getattr(self.service_hub, "network_map_cache", None)
+        if cache is not None:
+            known = {
+                n.owning_key.encoded for n in cache.notary_identities
+            }
+            if known and notary.owning_key.encoded not in known:
+                raise WrongNotaryError(
+                    f"{notary.name} does not resolve to a notary on this "
+                    "network map"
+                )
+        load_state = getattr(self.service_hub, "load_state", None)
+        if load_state is None:
+            return
+        allowed = {notary.owning_key.encoded}
+        if is_notary_change:
+            allowed.add(stx.tx.notary.owning_key.encoded)
+            allowed.add(stx.tx.new_notary.owning_key.encoded)
+        for ref in stx.tx.inputs:
+            try:
+                ts = load_state(ref)
+            # inputs we don't hold locally: the notary's own server-side
+            # check rules on those
+            except Exception:  # lint: allow(swallow)
+                continue
+            pinned = getattr(ts, "notary", None)
+            if pinned is None:
+                continue
+            if pinned.owning_key.encoded not in allowed:
+                raise WrongNotaryError(
+                    f"input {ref} is pinned to notary {pinned.name}; "
+                    f"it cannot be committed by {notary.name}",
+                    pinned_notary=pinned,
+                )
 
     def _reconcile_conflict(self, exc: NotaryException, stx) -> None:
         """A conflict verdict is AUTHORITATIVE evidence our inputs are
@@ -1133,10 +1208,31 @@ class NotaryServiceFlow(FlowLogic):
         cryptographic signature validity and commits.
         """
         wtx = stx.tx
-        # This service must BE the old notary, or a rogue client could have
-        # a different notary commit inputs it does not govern (ledger fork).
+        # This service must BE the old notary (the CONSUME leg) or the new
+        # notary (the cross-domain ASSUME leg) — anything else is a rogue
+        # client having an unrelated notary commit inputs it does not
+        # govern (ledger fork).
         me = self.service_hub.my_info
-        if wtx.notary.owning_key.encoded != me.owning_key.encoded:
+        my_keys = {me.owning_key.encoded, service.identity.owning_key.encoded}
+        if wtx.notary.owning_key.encoded in my_keys:
+            pass  # consume leg: we are the old notary the inputs pin
+        elif wtx.new_notary.owning_key.encoded in my_keys:
+            # ASSUME leg of the two-phase cross-domain notary change: we
+            # (the NEW notary) durably record the migrated inputs in OUR
+            # commit log, so a later double-spend probe of the old refs
+            # in THIS domain conflicts instead of silently succeeding.
+            # Gate on evidence the old notary already consumed — its
+            # cluster identity must fulfil a signature over this tx —
+            # or a client could assume-before-consume and tear the
+            # exactly-one-owner invariant the protocol exists for.
+            if not wtx.notary.owning_key.is_fulfilled_by(
+                {s.by for s in stx.sigs}
+            ):
+                raise NotaryException(
+                    f"notary-change assume for {wtx.new_notary.name} lacks "
+                    f"the old notary's ({wtx.notary.name}) commit signature"
+                )
+        else:
             raise NotaryException(
                 f"notary change names {wtx.notary.name}, not this notary"
             )

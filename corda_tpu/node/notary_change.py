@@ -1,0 +1,166 @@
+"""Crash-safe cross-domain notary change: journal, crash seams, recovery.
+
+The multi-domain federation (docs/robustness.md §6) re-pins a state from
+domain A's notary to domain B's with a TWO-PHASE protocol that reuses the
+sharded provider's `PrepareJournal` machinery (node/sharded_notary.py):
+
+  1. journal `{phase: "prepare", stx}`  — durable intent, BEFORE any
+     notary sees the tx, so recovery always knows what was in flight;
+  2. CONSUME: notarise the NotaryChangeWireTransaction at the OLD notary
+     (it alone governs the inputs) — durable in the old domain's log;
+  3. flip the journal to `{phase: "assume", stx+old sigs}` — the
+     decision record, written with the same raised-durability semantics
+     the sharded journal uses for its "committing" flip;
+  4. ASSUME: send the old-notary-signed tx to the NEW notary, which
+     durably records the migrated refs in ITS commit log (gated on the
+     old notary's signature — see NotaryServiceFlow._verify_notary_change);
+  5. remove the journal entry.
+
+Both notary commits are idempotent (re-committing the same refs for the
+same tx id is success, not conflict), so a crash at ANY point recovers by
+re-driving forward: `NotaryChangeRecoveryFlow` replays "prepare" entries
+from step 2 and "assume" entries from step 4, landing every state with
+exactly one owning notary — never torn, never doubly-spendable. The
+four crash seams (`notary_change.before_prepare` / `.after_prepare` /
+`.between_consume_and_assume` / `.after_commit`) ride the process fault
+hook (utils/faultpoints.py) and honour the action "crash".
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..core.flows.api import FlowLogic, initiating_flow
+from ..core.flows.library import NotaryClientFlowRef
+from ..utils import eventlog, faultpoints
+
+#: journal table in the instigator's node database
+JOURNAL_TABLE = "notary_change_journal"
+
+#: the four injectable coordinator-crash seams, in protocol order
+CRASH_POINTS = (
+    "notary_change.before_prepare",
+    "notary_change.after_prepare",
+    "notary_change.between_consume_and_assume",
+    "notary_change.after_commit",
+)
+
+
+class NotaryChangeCrashError(RuntimeError):
+    """Injected coordinator crash (faultpoints action "crash"): the
+    instigating flow dies at a protocol seam exactly as a process kill
+    would leave it, and recovery must re-drive from the journal."""
+
+
+def fire_crash_point(point: str, **detail) -> None:
+    """Consult the process fault hook at one protocol seam. Production
+    fast path: one global load + None check (like every other seam)."""
+    if faultpoints.hook is None:
+        return
+    if faultpoints.fire(point, **detail) == "crash":
+        raise NotaryChangeCrashError(
+            f"injected coordinator crash at {point}"
+        )
+
+
+def change_journal(hub):
+    """The hub's notary-change journal (lazily created, one per node
+    database). Reuses PrepareJournal: same durable-phase-flip semantics
+    — the "assume" record is the decision and gets the raised-durability
+    write path the sharded journal applies to "committing"."""
+    journal = getattr(hub, "_notary_change_journal", None)
+    if journal is None:
+        from .sharded_notary import PrepareJournal
+
+        journal = _ChangeJournal(getattr(hub, "db", None))
+        hub._notary_change_journal = journal
+    return journal
+
+
+def pending_notary_changes(hub) -> List[Tuple[str, dict]]:
+    """Incomplete (crash-interrupted) notary changes awaiting recovery."""
+    return change_journal(hub).items()
+
+
+class _ChangeJournal:
+    """PrepareJournal specialised to the notary-change table, mapping
+    this protocol's decision phase ("assume") onto the raised-durability
+    write the base class applies to "committing"."""
+
+    def __init__(self, db):
+        from .sharded_notary import PrepareJournal
+
+        self._inner = PrepareJournal(db, table=JOURNAL_TABLE)
+
+    def put(self, tx_hex: str, record: dict) -> None:
+        if record.get("phase") == "assume":
+            # borrow the base journal's durable-decision write path
+            record = dict(record)
+            record["phase"] = "committing"
+            self._inner.put(tx_hex, record)
+            return
+        self._inner.put(tx_hex, record)
+
+    def get(self, tx_hex: str):
+        rec = self._inner.get(tx_hex)
+        if rec is not None and rec.get("phase") == "committing":
+            rec = dict(rec)
+            rec["phase"] = "assume"
+        return rec
+
+    def remove(self, tx_hex: str) -> None:
+        self._inner.remove(tx_hex)
+
+    def items(self) -> List[Tuple[str, dict]]:
+        out = []
+        for tx_hex, rec in self._inner.items():
+            if rec.get("phase") == "committing":
+                rec = dict(rec)
+                rec["phase"] = "assume"
+            out.append((tx_hex, rec))
+        return out
+
+
+@initiating_flow
+class NotaryChangeRecoveryFlow(FlowLogic):
+    """Re-drive every incomplete notary change forward to completion.
+
+    Safe to run any time (idempotent: both notary commits accept a
+    replay of the same tx), and after any crash point:
+
+      * no journal entry (crash before prepare): nothing happened; the
+        state still has exactly its old owner — nothing to do;
+      * phase "prepare": the old notary may or may not have committed —
+        re-drive the consume (idempotent either way), then the assume;
+      * phase "assume": the consume is durable; re-drive the assume
+        (idempotent if it already landed) and finish.
+    """
+
+    def call(self):
+        hub = self.service_hub
+        journal = change_journal(hub)
+        recovered = []
+        for tx_hex, rec in journal.items():
+            stx = rec["stx"]
+            wtx = stx.tx
+            if rec.get("phase") == "prepare":
+                old_sigs = yield from self.sub_flow(NotaryClientFlowRef(stx))
+                stx = stx.with_additional_signatures(old_sigs)
+                journal.put(tx_hex, dict(rec, phase="assume", stx=stx))
+            cross_domain = (
+                wtx.new_notary.owning_key.encoded
+                != wtx.notary.owning_key.encoded
+            )
+            if cross_domain:
+                new_sigs = yield from self.sub_flow(
+                    NotaryClientFlowRef(stx, notary=wtx.new_notary)
+                )
+                stx = stx.with_additional_signatures(new_sigs)
+            hub.record_transactions([stx])
+            journal.remove(tx_hex)
+            eventlog.emit(
+                "info", "notary", "notary change recovered",
+                tx_id=tx_hex[:16], old=wtx.notary.name,
+                new=wtx.new_notary.name,
+            )
+            recovered.append(tx_hex)
+        return recovered

@@ -202,6 +202,11 @@ class NetworkMapCache:
 
     NOTARY_SERVICE = "corda.notary"
     VALIDATING_NOTARY_SERVICE = "corda.notary.validating"
+    #: multi-domain federation tags (docs/robustness.md §6) — pseudo
+    #: services riding the existing advertised_services wire format so an
+    #: unconfigured network carries no domain bytes at all (kill switch).
+    DOMAIN_SERVICE_PREFIX = "corda.domain."
+    GATEWAY_SERVICE = "corda.gateway"
 
     def __init__(self):
         self._nodes: Dict[str, Party] = {}
@@ -255,8 +260,12 @@ class NetworkMapCache:
     def notary_identities(self) -> List[Party]:
         return list(self._services.get(self.NOTARY_SERVICE, []))
 
-    def get_notary(self, name: Optional[str] = None) -> Optional[Party]:
-        notaries = self.notary_identities
+    def get_notary(self, name: Optional[str] = None,
+                   domain: Optional[str] = None) -> Optional[Party]:
+        notaries = (
+            self.notaries_in_domain(domain) if domain is not None
+            else self.notary_identities
+        )
         if name is not None:
             return next((n for n in notaries if n.name == name), None)
         return notaries[0] if notaries else None
@@ -264,6 +273,49 @@ class NetworkMapCache:
     @property
     def all_nodes(self) -> List[Party]:
         return list(self._nodes.values())
+
+    # -- multi-domain federation ------------------------------------------
+
+    @staticmethod
+    def domain_of_services(services: Iterable[str]) -> Optional[str]:
+        """The domain a service list advertises, or None (domainless)."""
+        prefix = NetworkMapCache.DOMAIN_SERVICE_PREFIX
+        for svc in services:
+            if svc.startswith(prefix):
+                return svc[len(prefix):]
+        return None
+
+    def node_domain(self, party: Party) -> Optional[str]:
+        """The domain `party` advertised at registration (None if it
+        registered without one — a domainless node is visible fleet-wide)."""
+        return self.domain_of_services(
+            self._node_services.get(party.name, ())
+        )
+
+    def is_gateway(self, party: Party) -> bool:
+        """True when `party` advertises itself as a cross-domain gateway
+        (visible from every domain's scoped map)."""
+        return self.GATEWAY_SERVICE in self._node_services.get(
+            party.name, set()
+        )
+
+    def notaries_in_domain(self, domain: Optional[str]) -> List[Party]:
+        """Notaries pinned to `domain` (None = domainless notaries)."""
+        return [
+            n for n in self.notary_identities
+            if self.node_domain(n) == domain
+        ]
+
+    @property
+    def domains(self) -> List[str]:
+        """Every domain any known node advertises, sorted."""
+        found = set()
+        with self._lock:
+            for services in self._node_services.values():
+                d = self.domain_of_services(services)
+                if d is not None:
+                    found.add(d)
+        return sorted(found)
 
 
 class VaultService:

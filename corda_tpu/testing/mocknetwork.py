@@ -217,6 +217,8 @@ class MockNetwork:
         admission_burst: Optional[float] = None,
         admission_max_flows: Optional[int] = None,
         shards: Optional[int] = None,
+        domain: Optional[str] = None,
+        gateway: bool = False,
     ) -> MockNode:
         """`ops_port`: pass 0 to serve this node's /metrics + /traces on
         an ephemeral port (node.ops_server.port); None = no endpoint.
@@ -224,7 +226,12 @@ class MockNetwork:
         with neither rate nor max_flows set, admission is inert.
         `shards`: partition a notary node's uniqueness provider into N
         state-ref-keyed shards with two-phase cross-shard commits
-        (docs/sharding.md); None keeps the unsharded default."""
+        (docs/sharding.md); None keeps the unsharded default.
+        `domain`/`gateway`: multi-domain federation (docs/robustness.md
+        §6) — a domained node registers only with same-domain peers,
+        domainless peers, and gateways, mirroring the directory node's
+        scoped map; both default off, keeping the everyone-sees-everyone
+        fan-out byte-identical for every existing test."""
         config = NodeConfiguration(
             my_legal_name=legal_name,
             db_path=db_path,
@@ -236,35 +243,91 @@ class MockNetwork:
             admission_burst=admission_burst,
             admission_max_flows=admission_max_flows,
             shards=shards,
+            domain=domain,
+            gateway=gateway,
         )
         node = MockNode(
             config, self.messaging_network.create_endpoint,
             clock=clock or self.default_clock,
         )
         node.start()
-        # Everyone learns about everyone (the reference MockNetwork shares a
-        # network map): register the new node with existing ones and vice versa.
+        # Everyone IN SCOPE learns about everyone in scope (the reference
+        # MockNetwork shares a network map; with domains configured the
+        # map is domain-scoped): register the new node with existing ones
+        # and vice versa, each direction under the viewer's scope.
         for other in self.nodes:
-            other.register_peer(node.info, node.config.advertised_services)
-            node.register_peer(other.info, other.config.advertised_services)
+            if self._visible(other.config.advertised_services,
+                             node.config.advertised_services):
+                other.register_peer(node.info, node.config.advertised_services)
+            if self._visible(node.config.advertised_services,
+                             other.config.advertised_services):
+                node.register_peer(other.info, other.config.advertised_services)
         for cluster, advertised in self._clusters:
-            node.services.network_map_cache.add_node(cluster, advertised)
-            node.services.identity_service.register_identity(cluster)
+            if self._visible(node.config.advertised_services, advertised):
+                node.services.network_map_cache.add_node(cluster, advertised)
+                node.services.identity_service.register_identity(cluster)
         self.nodes.append(node)
         return node
 
+    @staticmethod
+    def _visible(viewer_services, target_services) -> bool:
+        """Mirror of the directory node's scoped-map rule: a viewer sees
+        its own domain, domainless entries, and advertised gateways; a
+        domainless viewer sees everything (kill switch). A GATEWAY
+        viewer also sees everything — it is the federation's routing
+        anchor, serving cross-domain protocol legs (the notary-change
+        ASSUME resolves its back-chain from a foreign-domain client), so
+        a scoped view would strand its replies."""
+        from ..node.services import NetworkMapCache as _cache
+
+        viewer = tuple(viewer_services)
+        viewer_domain = _cache.domain_of_services(viewer)
+        if viewer_domain is None or _cache.GATEWAY_SERVICE in viewer:
+            return True
+        target = tuple(target_services)
+        target_domain = _cache.domain_of_services(target)
+        return (
+            target_domain is None
+            or target_domain == viewer_domain
+            or _cache.GATEWAY_SERVICE in target
+        )
+
     def create_notary_node(
         self, legal_name: str = "O=Notary,L=Zurich,C=CH", validating: bool = True,
-        shards: Optional[int] = None,
+        shards: Optional[int] = None, domain: Optional[str] = None,
+        gateway: bool = False,
     ) -> MockNode:
         return self.create_node(
             legal_name, notary_type="validating" if validating else "simple",
-            shards=shards,
+            shards=shards, domain=domain, gateway=gateway,
         )
+
+    def create_domain(
+        self, name: str, n_nodes: int = 1, validating: bool = True,
+        gateway: bool = False,
+    ):
+        """One federation domain: a GATEWAY notary pinned to `name` plus
+        `n_nodes` member nodes (docs/robustness.md §6). Returns
+        (notary_node, [member_nodes]). The notary is always a gateway —
+        the fleet-visible anchor cross-domain notary changes route
+        through; `gateway=True` additionally makes the members
+        cross-domain gateways, visible from every other domain."""
+        notary = self.create_notary_node(
+            f"O=Notary {name},L=Zurich,C=CH", validating=validating,
+            domain=name, gateway=True,
+        )
+        members = [
+            self.create_node(
+                f"O=Node {name} {i},L=London,C=GB", domain=name,
+                gateway=gateway,
+            )
+            for i in range(n_nodes)
+        ]
+        return notary, members
 
     def _assemble_cluster(
         self, n_members, cluster_name, member_prefix, validating,
-        threshold, provider_factory,
+        threshold, provider_factory, domain=None,
     ):
         """Shared cluster assembly: spawn members, mint the composite
         identity, install per-member notary services on the given
@@ -279,6 +342,7 @@ class MockNetwork:
             self.create_node(
                 f"O={member_prefix} {i},L=Zurich,C=CH",
                 notary_type="validating" if validating else "simple",
+                domain=domain,
             )
             for i in range(n_members)
         ]
@@ -290,6 +354,8 @@ class MockNetwork:
         advertised = [NetworkMapCache.NOTARY_SERVICE] + (
             [NetworkMapCache.VALIDATING_NOTARY_SERVICE] if validating else []
         )
+        if domain is not None:
+            advertised.append(NetworkMapCache.DOMAIN_SERVICE_PREFIX + domain)
         for m in members:
             m.notary_service = svc_cls(
                 m.services, m.info, uniqueness_provider=provider
@@ -299,8 +365,9 @@ class MockNetwork:
                 cluster.name, m.info.name
             )
         for node in self.nodes:
-            node.services.network_map_cache.add_node(cluster, advertised)
-            node.services.identity_service.register_identity(cluster)
+            if self._visible(node.config.advertised_services, advertised):
+                node.services.network_map_cache.add_node(cluster, advertised)
+                node.services.identity_service.register_identity(cluster)
         self._clusters.append((cluster, advertised))
         return cluster, members
 
@@ -310,6 +377,7 @@ class MockNetwork:
         cluster_name: str = "O=Notary Cluster,L=Zurich,C=CH",
         validating: bool = True,
         threshold: int = 1,
+        domain: Optional[str] = None,
     ):
         """A distributed notary presenting ONE composite identity
         (reference: Raft/BFT notary clusters + ServiceIdentityGenerator).
@@ -329,6 +397,7 @@ class MockNetwork:
             lambda cluster, members: PersistentUniquenessProvider(
                 NodeDatabase(":memory:")
             ),
+            domain=domain,
         )
 
     def create_bft_notary_cluster(

@@ -178,6 +178,12 @@ def deploy_nodes(spec: Dict, out_dir: str) -> List[Dict]:
                         "admission_max_flows"):
             if n.get(adm_key) is not None:
                 conf[adm_key] = n[adm_key]
+        if n.get("domain") is not None:
+            # multi-domain federation (docs/robustness.md §6): pins the
+            # node's trust segment; its map fetches become domain-scoped
+            conf["domain"] = str(n["domain"])
+        if n.get("gateway"):
+            conf["gateway"] = True
         if n.get("shards") is not None:
             conf["shards"] = int(n["shards"])
         if n.get("node_workers") is not None:

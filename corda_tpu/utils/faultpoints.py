@@ -16,6 +16,10 @@ actions each seam honours:
   verifier.worker  request= -> "crash_before_ack" | "crash_after_ack"
                                | "corrupt_response"
   notary.commit    tx_id=   -> "unavailable" (seam raises) | ("delay", s)
+  notary_change.before_prepare / .after_prepare
+  / .between_consume_and_assume / .after_commit
+                   tx_id=   -> "crash" (injected coordinator death at
+                               that two-phase seam; node/notary_change.py)
 
 Unknown actions are ignored by every seam (forward compatibility: an
 injector aimed at a newer build must not crash an older one).

@@ -253,6 +253,152 @@ def test_fleet_observatory_stitches_one_trace_across_processes():
             n.close()
 
 
+def test_two_domain_notary_change_survives_old_notary_kill():
+    """ISSUE 19 acceptance: a cross-domain payment via notary-change on
+    a REAL 3-process, 2-domain TCP network survives the SIGKILL of the
+    OLD domain's notary mid-protocol. The change is parked at CONSUME
+    (old notary SIGSTOPped) with the durable journal at phase "prepare"
+    — verified by reading the instigator's sqlite from outside the
+    process — then the notary is SIGKILLed and relaunched; unacked
+    redelivery + the idempotent notary commits land the re-pin on
+    EXACTLY one owning notary: the coin is invisible to domain A's coin
+    selection, pays out once under domain B, and the stale ref draws a
+    conflict at notary B."""
+    reason = _skip_reason()
+    if reason:
+        pytest.skip(reason)
+
+    import sqlite3
+
+    from corda_tpu.core.contracts import Amount, StateAndRef, StateRef
+    from corda_tpu.core.contracts.amount import Issued
+    from corda_tpu.core.serialization.codec import deserialize
+    from corda_tpu.testing.smoketesting import Factory
+    from corda_tpu.tools.cordform import deploy_nodes
+
+    t0 = time.monotonic()
+
+    def budget_left(phase: str) -> float:
+        left = _BUDGET_S - (time.monotonic() - t0)
+        assert left > 0, (
+            f"tier-1 two-domain budget ({_BUDGET_S}s) exhausted "
+            f"during {phase}"
+        )
+        return left
+
+    base = tempfile.mkdtemp(prefix="t1-domains-")
+    # the BANK hosts the map directory so killing domain alpha's notary
+    # never takes the network map down with it
+    spec = {"nodes": [
+        {"name": "O=T1DomBank,L=London,C=GB", "domain": "alpha",
+         "network_map_service": True},
+        {"name": "O=T1DomNotaryA,L=Zurich,C=CH", "notary": "validating",
+         "domain": "alpha", "gateway": True},
+        {"name": "O=T1DomNotaryB,L=Geneva,C=CH", "notary": "validating",
+         "domain": "beta", "gateway": True},
+    ]}
+    resolved = deploy_nodes(spec, base)
+    db_path = os.path.join(resolved[0]["dir"], "node.db")
+
+    def journal_rows():
+        """The instigator's notary-change journal, read OUTSIDE the node
+        process — proof the intent is durable, not an in-memory map."""
+        try:
+            con = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+            try:
+                rows = con.execute(
+                    "SELECT v FROM kv_notary_change_journal"
+                ).fetchall()
+            finally:
+                con.close()
+        except sqlite3.OperationalError:
+            return []  # table not created yet
+        return [deserialize(v) for (v,) in rows]
+
+    factory = Factory(base)
+    nodes = []
+    try:
+        for conf in resolved:
+            nodes.append(
+                factory.launch(conf["dir"], timeout=budget_left("boot"))
+            )
+        bank = nodes[0]
+        conn = bank.connect()
+        me = conn.proxy.node_info()
+        notaries = conn.proxy.notary_identities()
+
+        def notary_named(tag):
+            hit = [n for n in notaries
+                   if tag in n.name.replace(" ", "").lower()]
+            assert hit, f"no notary matching {tag!r}: {notaries}"
+            return hit[0]
+
+        notary_a = notary_named("notarya")
+        notary_b = notary_named("notaryb")
+
+        stx = conn.proxy.start_flow_and_wait(
+            "CashIssueFlow", Amount(9, "USD"), b"\x03", me, notary_a,
+            timeout=budget_left("issue"),
+        )
+        original = StateAndRef(stx.tx.outputs[0], StateRef(stx.id, 0))
+
+        # park the change at CONSUME: the old notary keeps its sockets
+        # but stops responding, so the journal's "prepare" record is
+        # written and the protocol can go no further
+        nodes[1].suspend()
+        fid = conn.proxy.start_flow_dynamic(
+            "NotaryChangeFlow", original, notary_b,
+        )
+        rows = journal_rows()
+        while not rows:
+            budget_left("journal write")
+            time.sleep(0.1)
+            rows = journal_rows()
+        assert [r["phase"] for r in rows] == ["prepare"], rows
+        assert rows[0]["old"] == notary_a.name
+        assert rows[0]["new"] == notary_b.name
+
+        # the acceptance's disruption: SIGKILL the OLD domain's notary
+        # after prepare, then bring a fresh process up on the same port
+        nodes[1].kill()
+        nodes[1] = factory.launch(
+            resolved[1]["dir"], timeout=budget_left("notary relaunch")
+        )
+        moved = conn.proxy.flow_result(
+            fid, budget_left("change completion")
+        )
+        assert moved.state.notary.name == notary_b.name, (
+            f"re-pin landed on {moved.state.notary.name}"
+        )
+        assert journal_rows() == [], "journal must not outlive the change"
+
+        # exactly-one-owner probes. Domain A: the migrated coin must be
+        # ineligible to a builder pinned to the OLD notary
+        token = Issued(me.ref(3), "USD")
+        with pytest.raises(Exception, match="[Ii]nsufficient"):
+            conn.proxy.start_flow_and_wait(
+                "CashPaymentFlow", Amount(9, token), me, notary_a,
+                timeout=budget_left("domain A probe"),
+            )
+        # Domain B: the SAME coin pays out exactly once under the new
+        # notary (the cross-domain payment the change was for)
+        conn.proxy.start_flow_and_wait(
+            "CashPaymentFlow", Amount(9, token), me, notary_b,
+            timeout=budget_left("domain B payment"),
+        )
+        # ...and the stale pre-payment ref draws a conflict at notary B
+        # (a DIFFERENT consuming tx id, so idempotent replay can't mask
+        # a fork)
+        fid2 = conn.proxy.start_flow_dynamic(
+            "NotaryChangeFlow", moved, notary_a,
+        )
+        with pytest.raises(Exception, match="[Cc]onflict|consumed"):
+            conn.proxy.flow_result(fid2, budget_left("stale-ref probe"))
+    finally:
+        for n in nodes:
+            n.close()
+
+
 def test_budget_guard_never_skips_silently():
     """The skip guard names exactly two environmental reasons; on a
     healthy box it returns None (the scenario RUNS — the whole point of

@@ -15,7 +15,12 @@ Fails (exit 1) on:
   * any `--mttr MS` repair-time ceiling: every `mttr_ms{kind=…}` key in
     the record's `mttr` block must sit under the bound, and a record
     that fired disruptions but carries NO mttr block breaches too (an
-    observatory that silently stopped reporting must not read as green).
+    observatory that silently stopped reporting must not read as green);
+  * any `--domain-goodput PCT` floor: the multi-domain soak's
+    `domain_goodput_pct` (foreign-traffic rate while one domain was
+    dark, as a % of the undisrupted baseline) must be >= PCT — and a
+    record MISSING the key breaches, same missing-block hygiene as
+    --mttr (a soak that never measured goodput must not read as green).
 
 Exit status: 0 = pass, 1 = breach, 2 = usage error — the same contract
 as tools/bench_gate.py, sharing its comparison engine
@@ -59,6 +64,12 @@ def main(argv=None) -> int:
         help="ceiling (ms) asserted on EVERY mttr_ms{kind=…} the record "
              "reports; missing mttr block on a disrupted run = breach",
     )
+    ap.add_argument(
+        "--domain-goodput", type=float, metavar="PCT",
+        help="floor (%%) asserted on the record's domain_goodput_pct "
+             "(multi-domain soak: foreign traffic while one domain was "
+             "dark vs baseline); a missing/None value = breach",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -97,6 +108,18 @@ def main(argv=None) -> int:
                     "key": f"mttr.{key}", "value": value,
                     "bound": args.mttr, "kind": "max",
                 })
+    if args.domain_goodput is not None:
+        goodput = record.get("domain_goodput_pct")
+        if not isinstance(goodput, (int, float)):
+            violations.append({
+                "key": "domain_goodput_pct", "value": goodput,
+                "bound": args.domain_goodput, "kind": "missing",
+            })
+        elif goodput < args.domain_goodput:
+            violations.append({
+                "key": "domain_goodput_pct", "value": goodput,
+                "bound": args.domain_goodput, "kind": "min",
+            })
     if record.get("consistent") is not True:
         violations.append({
             "key": "consistent", "value": record.get("consistent"),
