@@ -602,6 +602,17 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
     except Exception as exc:
         failover = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Cold restart-to-serving over a loaded durable state (docs/
+    # robustness.md §7): 10k-enqueued/5k-acked journal replay + 200
+    # checkpoint restores, one lower-is-better number the gate guards
+    # so a recovery-path regression trips CI before a real crash does.
+    from corda_tpu.loadtest.latency import measure_recovery_replay
+
+    try:
+        recovery = measure_recovery_replay()
+    except Exception as exc:
+        recovery = {"error": f"{type(exc).__name__}: {exc}"}
+
     # Overload protection (docs/robustness.md): saturate the admission
     # cap with a 5x flow-start burst, verify the excess sheds (typed
     # rejection + /readyz 503), then measure time-to-recover after the
@@ -742,6 +753,8 @@ def _secondary_rates(on_tpu: bool, rng) -> dict:
         "jax_dispatch": profiling.dispatch_snapshot(),
         "failover_recovery_ms": failover.get("failover_recovery_ms"),
         "failover_recovered_via": failover.get("recovered_via"),
+        "recovery_replay_ms": recovery.get("recovery_replay_ms"),
+        "recovery_pending_msgs": recovery.get("recovery_pending_msgs"),
         "overload_shed_recovery_ms": overload.get(
             "overload_shed_recovery_ms"
         ),

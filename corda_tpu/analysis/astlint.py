@@ -58,6 +58,7 @@ PASS_IDS = (
     "thread_daemon",
     "swallow",
     "env_registry",
+    "atomic_write",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\(\s*([a-z_,\s]+?)\s*\)")
@@ -616,6 +617,43 @@ def _env_registry_finalize(
     return findings
 
 
+# -- pass: atomic_write -------------------------------------------------------
+
+#: the one module allowed to touch os.replace/os.rename directly: every
+#: other durable-write site must route through its fsync-before-rename
+#: helpers (docs/robustness.md §7 "The durability contract")
+_ATOMIC_HOME = "corda_tpu/utils/atomicfile.py"
+
+
+def _pass_atomic_write(ctx: _FileCtx) -> List[Finding]:
+    """Flag direct `os.replace`/`os.rename` usage outside
+    utils/atomicfile.py. A bare rename publishes a file whose DATA may
+    still be unwritten after a power cut (rename is metadata; the
+    payload needs fsync first) — the torn-state class crashmc exists to
+    catch. Deliberate low-level sites (e.g. an injectable io seam that
+    carries its own fsync discipline) suppress with
+    ``# lint: allow(atomic_write)`` and a reason."""
+    if ctx.relpath == _ATOMIC_HOME:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        d = _dotted(node)
+        if d not in ("os.replace", "os.rename"):
+            continue
+        if ctx.suppressed("atomic_write", node):
+            continue
+        findings.append(Finding(
+            "atomic_write", ctx.relpath, node.lineno,
+            f"{ctx.qualname(node)}:{d}",
+            f"direct {d} in {ctx.qualname(node)} — route durable "
+            f"writes through corda_tpu.utils.atomicfile "
+            f"(fsync-before-rename), or suppress with a reason",
+        ))
+    return findings
+
+
 # -- driver -------------------------------------------------------------------
 
 _PASS_FNS = {
@@ -623,6 +661,7 @@ _PASS_FNS = {
     "blocking_under_lock": _pass_blocking,
     "thread_daemon": _pass_thread_daemon,
     "swallow": _pass_swallow,
+    "atomic_write": _pass_atomic_write,
 }
 
 

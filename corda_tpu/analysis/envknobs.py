@@ -249,6 +249,18 @@ _ENTRIES = [
     _k("CORDA_TPU_DOMAIN_DARK_S", "12", "docs/robustness.md",
        "multi-domain soak dark-window seconds for the domain_partition "
        "disruption (floor 10 — the acceptance's minimum dark window)"),
+    # -- crash consistency (docs/robustness.md §7) ---------------------------
+    _k("CORDA_TPU_CRASH_AT", "unset", "docs/robustness.md",
+       "point[:nth] — SIGKILL the process the nth time the named "
+       "durability barrier fires (install_env_crash_hook; real-process "
+       "crash tests)"),
+    _k("CORDA_TPU_JOURNAL_FSYNC", "0", "docs/robustness.md",
+       "1 = broker journal fsyncs every enqueue append and compaction "
+       "(power-cut-proof enqueues; acks stay batched — loss is "
+       "absorbed by redelivery dedup)"),
+    _k("CORDA_TPU_ATOMIC_FSYNC", "1", "docs/robustness.md",
+       "0 = atomicfile skips fsync-before-rename (fast, crash-unsafe "
+       "mode for throwaway rigs; crashmc proves why the default is 1)"),
     # -- bench --------------------------------------------------------------
     _k("CORDA_TPU_BENCH_FORCE_CPU", "unset", "docs/hardware-runbook.md",
        "1 = bench.py skips the TPU probe and runs CPU-only"),

@@ -332,11 +332,12 @@ def verify_chain(leaf: x509.Certificate, chain: List[x509.Certificate],
 # --- keystore-on-disk (JKS analogue: PEM files in a directory) --------------
 
 def _atomic_write(path: str, data: bytes) -> None:
-    """Write-then-rename so concurrent readers never see a torn file."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-    os.replace(tmp, path)
+    """Write-then-rename so concurrent readers never see a torn file —
+    AND fsync-before-rename so a power cut cannot leave an empty
+    keystore behind (delegates to the one helper, utils/atomicfile)."""
+    from ...utils import atomicfile
+
+    atomicfile.write_atomic(path, data)
 
 
 def write_cert_store(directory: str, **entries: CertAndKey) -> None:

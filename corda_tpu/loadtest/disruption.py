@@ -288,6 +288,66 @@ def process_restart(victim, probe: Callable[[], int],
                       probability=probability, heal_after=heal_after)
 
 
+def restart_storm(victim, probe: Callable[[], int],
+                  relaunches: int = 5,
+                  verify: Optional[Callable[[], list]] = None,
+                  min_progress: int = 2,
+                  recovery_deadline_s: float = 120.0,
+                  probability: float = 0.2,
+                  heal_after: int = 2) -> Disruption:
+    """Kill-and-relaunch the SAME node `relaunches` times in rapid
+    succession (docs/robustness.md §7): each relaunch is followed by a
+    SIGKILL after a short random gap (50–300ms) — far less than a
+    recovery replay takes — so every restart after the first interrupts
+    the PREVIOUS restart's journal/checkpoint recovery midway. The
+    classic crash-during-recovery-from-crash loop: recovery itself must
+    be idempotent and re-enterable, never a one-shot.
+
+    The heal leaves the LAST relaunch running, asserts the workload
+    resumed (progress, not survival), then runs `verify()` — a zero-arg
+    invariant probe returning a list of problems (e.g. a
+    `node/recovery.verify_node_state` closure: no lost acked message,
+    no duplicated flow result) — and raises on any. `victim` needs
+    `kill()` and `relaunch()`."""
+    import time as _time
+
+    state = {"relaunches": 0}
+
+    def fire(rng, nodes):
+        state["before"] = probe()
+        victim.kill()
+        for _ in range(relaunches - 1):
+            victim.relaunch()
+            state["relaunches"] += 1
+            # shorter than any recovery replay: the next kill lands
+            # while the journal/checkpoint restore is still running
+            _time.sleep(rng.uniform(0.05, 0.3))
+            victim.kill()
+        state["fired"] = True
+
+    def heal(rng, nodes):
+        if not state.pop("fired", False):
+            return
+        victim.relaunch()
+        state["relaunches"] += 1
+        assert_recovers(
+            probe, state.pop("before", 0),
+            f"restart storm ({relaunches} rapid relaunches)",
+            min_progress=min_progress, deadline_s=recovery_deadline_s,
+        )
+        if verify is not None:
+            problems = verify()
+            assert not problems, (
+                f"restart storm broke durability invariants: "
+                f"{problems[:5]}"
+            )
+
+    d = Disruption("restart-storm", fire, heal,
+                   probability=probability, heal_after=heal_after)
+    d.state = state  # observable: relaunch count + fired flag
+    return d
+
+
 def process_hang(victim, probe: Callable[[], int],
                  min_progress: int = 2,
                  recovery_deadline_s: float = 120.0,

@@ -497,6 +497,83 @@ def measure_failover_recovery(
     return out
 
 
+def measure_recovery_replay(
+    n_enqueued: int = 10_000, n_acked: int = 5_000,
+    n_checkpoints: int = 200, verbose: bool = False
+) -> Dict[str, float]:
+    """Cold restart-to-serving time over a realistically loaded durable
+    state (docs/robustness.md §7): a broker journal carrying
+    `n_enqueued` enqueues of which `n_acked` are acked (the survivor set
+    a crashed node replays), plus `n_checkpoints` parked flow
+    checkpoints. Measures ONE number — wall time from "process has the
+    files" to "pending messages replayed + every checkpoint
+    deserialized and ready to resume" — reported as `recovery_replay_ms`
+    in bench stage_timings (auto-classified lower-is-better), so a
+    recovery-path regression (an O(n^2) replay, a lost index, a
+    per-record fsync) trips tools/bench_gate.py like any other stage."""
+    import os as _os
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import uuid as _uuid
+
+    from ..core.serialization.codec import serialize
+    from ..messaging.broker import Message, _Journal
+    from ..node.database import CheckpointStorage, NodeDatabase
+
+    wd = _tempfile.mkdtemp(prefix="bench-recovery-")
+    try:
+        # -- build the pre-crash durable state (not timed) ------------
+        jpath = _os.path.join(wd, "inbound.journal")
+        journal = _Journal(jpath)
+        ids = []
+        for i in range(n_enqueued):
+            msg = Message(
+                payload=(b"bench-%06d" % i) * 8,
+                headers={"seq": str(i)},
+                message_id=str(_uuid.uuid4()),
+            )
+            journal.append_enqueue(msg)
+            ids.append(msg.message_id)
+        for mid in ids[:n_acked]:
+            journal.append_ack(mid)
+        journal.close()
+        dbpath = _os.path.join(wd, "node.db")
+        db = NodeDatabase(dbpath)
+        store = CheckpointStorage(db)
+        for i in range(n_checkpoints):
+            store.put(
+                f"flow-{i}",
+                serialize({"flow_name": f"BenchFlow{i}", "step": i,
+                           "stack": ["a"] * 16}),
+            )
+        db.close()
+
+        # -- the timed cold restart -----------------------------------
+        t0 = time.perf_counter()
+        pending = _Journal.replay(jpath)
+        db2 = NodeDatabase(dbpath)
+        store2 = CheckpointStorage(db2)
+        restored = list(store2.all_checkpoints())
+        replay_ms = (time.perf_counter() - t0) * 1000
+        db2.close()
+
+        assert len(pending) == n_enqueued - n_acked, (
+            f"replay returned {len(pending)} pending "
+            f"(expected {n_enqueued - n_acked})"
+        )
+        assert len(restored) == n_checkpoints
+        out = {
+            "recovery_replay_ms": round(replay_ms, 3),
+            "recovery_pending_msgs": len(pending),
+            "recovery_checkpoints": len(restored),
+        }
+    finally:
+        _shutil.rmtree(wd, ignore_errors=True)
+    if verbose:
+        print(out)
+    return out
+
+
 def measure_pipeline_overlap(
     n_batches: int = 4, batch: int = 1024, msg_len: int = 8192,
     depth: int = None, verbose: bool = False,

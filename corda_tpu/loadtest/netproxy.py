@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..utils import lockorder
+from ..utils import atomicfile, lockorder
 
 MODES = ("pass", "delay", "stall", "blackhole", "drop")
 DIRECTIONS = ("c2s", "s2c")
@@ -315,10 +315,9 @@ def _write_state(path: str, proxy: NetProxy, seq: int,
     }
     if error:
         state["error"] = error
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(state, fh)
-    os.replace(tmp, path)
+    # fsync=False: the state file is an IPC handshake, not durable data —
+    # a power cut takes the proxy process with it anyway
+    atomicfile.write_json_atomic(path, state, fsync=False)
 
 
 def main(argv=None) -> int:

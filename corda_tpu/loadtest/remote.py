@@ -34,6 +34,10 @@ the process-granular disruption catalog (loadtest/disruption.py):
     (loadtest/netproxy.py) in front of bank B's broker port: the
     deployment ADVERTISES the proxy address so every peer byte crosses
     the degradable link — no root/iptables;
+  * ``restart_storm`` — kill->relaunch the notary 5x in rapid
+    succession, each kill landing before the previous relaunch's
+    recovery replay finishes (crash-during-recovery-from-crash —
+    docs/robustness.md §7);
   * ``shard_worker_process_kill`` — SIGKILL one ``--shard-worker`` OS
     process on sharded hosts (``--node-workers N``).
 
@@ -834,6 +838,7 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
     from .disruption import (
         process_hang,
         process_restart,
+        restart_storm,
         shard_worker_process_kill,
         transport_partition,
     )
@@ -993,6 +998,15 @@ def run(hosts: List[HostSpec], duration: float = 90.0, seed: int = 7,
                 recovery_deadline_s=recovery_deadline_s)),
             ("partition", transport_partition(
                 proxy, probe, mode="stall",
+                recovery_deadline_s=recovery_deadline_s)),
+            # kill->relaunch the notary 5x in rapid succession, each
+            # kill landing BEFORE the previous relaunch's recovery
+            # replay finishes: crash-during-recovery-from-crash
+            # (docs/robustness.md §7). The end-of-soak
+            # assert_no_loss_no_dup carries the no-loss/no-dup verdict
+            # across the storm window.
+            ("restart_storm", restart_storm(
+                notary_node, probe,
                 recovery_deadline_s=recovery_deadline_s)),
         ]
         if node_workers:

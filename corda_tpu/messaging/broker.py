@@ -26,6 +26,7 @@ import threading
 import time
 import urllib.parse
 import uuid
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
@@ -88,6 +89,46 @@ class Message:
 _REC_ENQUEUE = 1
 _REC_ACK = 2
 
+#: v2 journal file preamble: files starting with this carry a u32
+#: crc32 prepended INSIDE every record body (the outer u8|u32|body
+#: frame is unchanged, so torn-tail truncation still works the same
+#: way). Files without it are legacy journals and parse as before —
+#: and keep being appended to in legacy format, so one file never
+#: mixes framings.
+JOURNAL_MAGIC = b"CTJ2"
+
+#: durability barriers of the broker journal (store "broker_journal");
+#: tools/crashmc.py kills-and-replays at each (docs/robustness.md §7)
+_P_J_ENQUEUE = faultpoints.register_crash_point(
+    "journal.append_enqueue", "broker_journal")
+_P_J_ACK = faultpoints.register_crash_point(
+    "journal.append_ack", "broker_journal")
+_P_J_COMPACT_BEGIN = faultpoints.register_crash_point(
+    "journal.compact.begin", "broker_journal")
+_P_J_COMPACT_PRE = faultpoints.register_crash_point(
+    "journal.compact.pre_rename", "broker_journal")
+_P_J_COMPACT_POST = faultpoints.register_crash_point(
+    "journal.compact.post_rename", "broker_journal")
+
+
+class _JournalIO:
+    """The OS, as the journal sees it. testing/crashstore.py swaps the
+    module-level `jio` for a simulated power-cut disk, so every byte the
+    journal believes durable is a byte the model actually persisted."""
+
+    open = staticmethod(open)
+    # lint: allow(atomic_write) — the io seam itself; compact() drives
+    replace = staticmethod(os.replace)  # fsync-before-replace through it
+    remove = staticmethod(os.remove)
+
+    @staticmethod
+    def fsync_fh(fh) -> None:
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+jio = _JournalIO()
+
 
 class _Journal:
     """Append-only durable log of enqueue/ack records for one queue.
@@ -95,7 +136,18 @@ class _Journal:
     Record wire format: u8 type | u32 len | payload. ENQUEUE payload is
     message_id(36 ascii) + u32 header-blob-len + header blob + body; ACK
     payload is message_id.  Torn tails (crash mid-append) are truncated on
-    replay.  The C++ journal (corda_tpu.native) writes the identical format.
+    replay.  The C++ journal (corda_tpu.native) writes the identical
+    LEGACY format; fresh files written here start with JOURNAL_MAGIC and
+    add a per-record crc32 (corrupt records quarantine on replay instead
+    of feeding garbage into dispatch).
+
+    Durability: appends flush() to the OS — surviving PROCESS death. A
+    power cut can still eat the page cache; `CORDA_TPU_JOURNAL_FSYNC=1`
+    upgrades enqueue appends + compaction renames to fsync (survives the
+    plug being pulled, at the cost of one fsync per send). The default
+    stays flush-only because the p2p layer already retries unacked sends
+    end-to-end; the knob exists for brokers that are themselves the
+    system of record. docs/robustness.md §7 has the full table.
     """
 
     #: acks appended since the last compaction before an online compaction
@@ -105,11 +157,28 @@ class _Journal:
 
     def __init__(self, path: str, truncate: bool = False):
         self._path = path
-        self._fh = open(path, "wb" if truncate else "ab")
+        self._fsync = (
+            os.environ.get("CORDA_TPU_JOURNAL_FSYNC", "0") == "1"
+        )
+        preexisting = (
+            not truncate
+            and os.path.exists(path)
+            and os.path.getsize(path) > 0
+        )
+        if preexisting:
+            with jio.open(path, "rb") as fh:
+                self._v2 = fh.read(len(JOURNAL_MAGIC)) == JOURNAL_MAGIC
+        else:
+            self._v2 = True
+        self._fh = jio.open(path, "wb" if truncate else "ab")
+        if not preexisting:
+            self._fh.write(JOURNAL_MAGIC)
+            self._fh.flush()
         self.acks_since_compact = 0
         self._unflushed_acks = 0
 
     def append_enqueue(self, msg: Message) -> None:
+        faultpoints.crash_fire(_P_J_ENQUEUE, message_id=msg.message_id)
         hdr_blob = _encode_headers(msg.headers)
         payload = msg.payload
         if not isinstance(payload, bytes):
@@ -132,6 +201,7 @@ class _Journal:
     ACK_FLUSH_EVERY = 64
 
     def append_ack(self, message_id: str) -> None:
+        faultpoints.crash_fire(_P_J_ACK, message_id=message_id)
         self._append(_REC_ACK, message_id.encode("ascii"), flush=False)
         self.acks_since_compact += 1
         self._unflushed_acks += 1
@@ -140,9 +210,14 @@ class _Journal:
             self._unflushed_acks = 0
 
     def _append(self, rec_type: int, body: bytes, flush: bool = True) -> None:
+        if self._v2:
+            body = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
         self._fh.write(struct.pack(">BI", rec_type, len(body)) + body)
         if flush:
-            self._fh.flush()
+            if self._fsync:
+                jio.fsync_fh(self._fh)
+            else:
+                self._fh.flush()
             self._unflushed_acks = 0
 
     def compact(self, pending: List[Message]) -> bool:
@@ -153,6 +228,7 @@ class _Journal:
         Caller must hold the broker lock and pass the authoritative
         pending set (queued + in-flight). Returns False if the rewrite
         failed (the queue keeps appending to the old journal)."""
+        faultpoints.crash_fire(_P_J_COMPACT_BEGIN, path=self._path)
         tmp = _Journal(self._path + ".tmp", truncate=True)
         try:
             for msg in pending:
@@ -160,7 +236,7 @@ class _Journal:
         except Exception:
             tmp.close()
             try:
-                os.remove(self._path + ".tmp")
+                jio.remove(self._path + ".tmp")
             except OSError:
                 pass
             # back off a full threshold before retrying, don't hot-loop
@@ -168,10 +244,17 @@ class _Journal:
             return False
         finally:
             if not tmp._fh.closed:
+                if self._fsync:
+                    # the rename below makes tmp THE journal: its bytes
+                    # must be on the platter before the name flips
+                    jio.fsync_fh(tmp._fh)
                 tmp.close()
         self._fh.close()
-        os.replace(self._path + ".tmp", self._path)
-        self._fh = open(self._path, "ab")
+        faultpoints.crash_fire(_P_J_COMPACT_PRE, path=self._path)
+        jio.replace(self._path + ".tmp", self._path)
+        faultpoints.crash_fire(_P_J_COMPACT_POST, path=self._path)
+        self._fh = jio.open(self._path, "ab")
+        self._v2 = True  # compaction rewrites in current format
         self.acks_since_compact = 0
         self._unflushed_acks = 0
         return True
@@ -181,12 +264,18 @@ class _Journal:
 
     @staticmethod
     def replay(path: str) -> List[Message]:
-        """Rebuild pending (enqueued, never acked) messages in order."""
+        """Rebuild pending (enqueued, never acked) messages in order.
+        v2 files verify each record's crc32; a failing record and
+        everything after it is quarantined (counted + eventlogged via
+        node/recovery) — never fed into dispatch, never a startup wedge."""
         pending: Dict[str, Message] = {}
         order: List[str] = []
-        with open(path, "rb") as fh:
+        with jio.open(path, "rb") as fh:
             data = fh.read()
         pos = 0
+        v2 = data.startswith(JOURNAL_MAGIC)
+        if v2:
+            pos = len(JOURNAL_MAGIC)
         while pos + 5 <= len(data):
             rec_type, length = struct.unpack_from(">BI", data, pos)
             pos += 5
@@ -194,19 +283,49 @@ class _Journal:
                 break  # torn tail from a crash mid-append
             body = data[pos:pos + length]
             pos += length
-            if rec_type == _REC_ENQUEUE:
-                mid = body[:36].decode("ascii")
-                (hlen,) = struct.unpack_from(">I", body, 36)
-                headers = _decode_headers(body[40:40 + hlen])
-                payload = body[40 + hlen:]
-                if mid not in pending:
-                    order.append(mid)
-                pending[mid] = Message(
-                    payload=payload, headers=headers, message_id=mid,
-                    delivery_count=2,  # redelivery after restart
+            if v2:
+                if length < 4:
+                    break  # torn tail: not even a whole crc
+                (crc,) = struct.unpack_from(">I", body, 0)
+                body = body[4:]
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    # a record the disk tore INSIDE the length frame
+                    # (reordered unsynced blocks): everything from here
+                    # on is untrustworthy — set it aside and stop
+                    from ..node import recovery
+
+                    recovery.quarantine_record(
+                        "broker_journal", path,
+                        f"crc32 mismatch at offset {pos - length - 5}",
+                    )
+                    break
+            try:
+                if rec_type == _REC_ENQUEUE:
+                    mid = body[:36].decode("ascii")
+                    (hlen,) = struct.unpack_from(">I", body, 36)
+                    headers = _decode_headers(body[40:40 + hlen])
+                    payload = body[40 + hlen:]
+                    if mid not in pending:
+                        order.append(mid)
+                    pending[mid] = Message(
+                        payload=payload, headers=headers, message_id=mid,
+                        delivery_count=2,  # redelivery after restart
+                    )
+                elif rec_type == _REC_ACK:
+                    pending.pop(body.decode("ascii"), None)
+            except (UnicodeDecodeError, struct.error, ValueError) as exc:
+                # legacy (crc-less) files have no integrity check inside
+                # the length frame, so a torn record can still FRAME
+                # correctly and decode to garbage — same rule as a crc
+                # miss: set the tail aside, never wedge startup
+                from ..node import recovery
+
+                recovery.quarantine_record(
+                    "broker_journal", path,
+                    f"undecodable record at offset {pos - length - 5}: "
+                    f"{type(exc).__name__}",
                 )
-            elif rec_type == _REC_ACK:
-                pending.pop(body.decode("ascii"), None)
+                break
         return [pending[m] for m in order if m in pending]
 
 

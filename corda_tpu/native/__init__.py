@@ -200,9 +200,12 @@ def _build_if_stale(sources, so_path, cmd_prefix) -> None:
     try:
         cmd = [*cmd_prefix, "-o", tmp, *sources]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, so_path)
-        with open(stamp_path, "w") as fh:
-            fh.write(src_hash)
+        # fsync-then-rename: a power cut must not install a torn ELF
+        # under the final name (utils/atomicfile durability contract)
+        from ..utils import atomicfile
+
+        atomicfile.rename_durable(tmp, so_path)
+        atomicfile.write_atomic(stamp_path, src_hash)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -85,9 +85,14 @@ def main(argv=None) -> int:
     for _h in logging.getLogger().handlers:
         _h.setLevel(console_level)
 
-    from ..utils import eventlog
+    from ..utils import atomicfile, eventlog, faultpoints
 
     eventlog.install_stdlib_bridge(capture_info=True)
+
+    # CORDA_TPU_CRASH_AT=point[:nth]: arm a real self-SIGKILL at a
+    # registered durability barrier — the OS-process slice of the
+    # crash-consistency matrix (tests/test_real_tier1.py rides this)
+    faultpoints.install_env_crash_hook()
 
     if args.shard_worker is not None:
         if args.broker_port is None:
@@ -228,9 +233,9 @@ def main(argv=None) -> int:
                 cfg.node.identity_entropy = int.from_bytes(
                     os.urandom(24), "big"
                 )
-        with open(ent_path + ".tmp", "w") as fh:
-            fh.write(str(cfg.node.identity_entropy))
-        os.replace(ent_path + ".tmp", ent_path)
+        # fsync'd: losing the entropy pin to a power cut would respawn
+        # the node under a fresh identity (utils/atomicfile.py)
+        atomicfile.write_atomic(ent_path, str(cfg.node.identity_entropy))
     queue_suffix = ".sup" if sharded_host else ""
     node = AbstractNode(
         cfg.node,
@@ -327,14 +332,10 @@ def main(argv=None) -> int:
     # ATOMIC rename: pollers must never observe a created-but-empty file
     # (a launcher reading the instant the file exists raced exactly that).
     port_path = os.path.join(cfg.base_directory, "broker.port")
-    with open(port_path + ".tmp", "w") as fh:
-        fh.write(str(server.port))
-    os.replace(port_path + ".tmp", port_path)
+    atomicfile.write_atomic(port_path, str(server.port))
     if args.ready_file:
         # the remote-driver handshake: one atomic JSON read yields
         # everything the launcher needs (port for RPC, pid for signals)
-        import json as _json
-
         ready = {
             "name": cfg.node.my_legal_name,
             "broker_host": cfg.broker_host,
@@ -347,9 +348,7 @@ def main(argv=None) -> int:
             ),
             "workers": n_workers,
         }
-        with open(args.ready_file + ".tmp", "w") as fh:
-            _json.dump(ready, fh)
-        os.replace(args.ready_file + ".tmp", args.ready_file)
+        atomicfile.write_json_atomic(args.ready_file, ready)
     announce(
         f"node ready: {cfg.node.my_legal_name} broker={server.host}:{server.port}"
     )

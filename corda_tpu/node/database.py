@@ -22,7 +22,32 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..core.crypto.secure_hash import SecureHash
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import eventlog, lockorder
+from ..utils import eventlog, faultpoints, lockorder
+from . import recovery
+
+#: injectable sqlite connection factory (the VFS seam of ISSUE 20):
+#: testing/crashstore.py swaps this to RECORD which database files a
+#: node opens, so a simulated power cut can tear their unsynced WAL
+#: tails before a relaunch reopens them. Read at call time — rebinding
+#: the module attribute is the whole protocol.
+connect_factory: Callable[..., sqlite3.Connection] = sqlite3.connect
+
+#: durability barriers of the checkpoint store (store "checkpoints"):
+#: each op fires `<point>` before its write reaches sqlite and
+#: `<point>.committed` once the commit returned — a crash between the
+#: two is the torn window tools/crashmc.py explores.
+_P_CP_PUT = faultpoints.register_crash_point(
+    "checkpoint.put", "checkpoints")
+_P_CP_PUT_INC = faultpoints.register_crash_point(
+    "checkpoint.put_incremental", "checkpoints")
+_P_CP_REMOVE = faultpoints.register_crash_point(
+    "checkpoint.remove", "checkpoints")
+for _p in (_P_CP_PUT, _P_CP_PUT_INC, _P_CP_REMOVE):
+    faultpoints.register_crash_point(_p + ".committed", "checkpoints")
+_P_GC_DRAIN = faultpoints.register_crash_point(
+    "checkpoint.group_commit.drain", "checkpoints")
+_P_GC_COMMITTED = faultpoints.register_crash_point(
+    "checkpoint.group_commit.committed", "checkpoints")
 
 
 class NodeDatabase:
@@ -35,7 +60,7 @@ class NodeDatabase:
         vanish on power loss is a double-spend waiting to be admitted
         (docs/sharding.md §durability)."""
         self.path = path
-        self._conn = sqlite3.connect(path, check_same_thread=False,
+        self._conn = connect_factory(path, check_same_thread=False,
                                      timeout=30.0)
         # busy-wait instead of instant OperationalError under contention:
         # a sharded node's WORKER PROCESSES share this file (shardhost)
@@ -202,6 +227,10 @@ class _GroupCommitter:
             raise
 
     def _commit_batch(self, batch) -> None:
+        # BEFORE the try: a crash injected at the drain barrier must be
+        # the leader dying, not a poisoned batch the individual re-run
+        # below would quietly absorb
+        faultpoints.crash_fire(_P_GC_DRAIN, batch=len(batch))
         try:
             with self.db.transaction() as tx:
                 for op, _ev, _box in batch:
@@ -229,6 +258,8 @@ class _GroupCommitter:
         self.stats["batches"] += 1
         self.stats["ops"] += len(batch)
         self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+        # post-barrier: the batch is durable, followers already released
+        faultpoints.crash_fire(_P_GC_COMMITTED, batch=len(batch))
 
 
 class CheckpointStorage:
@@ -276,6 +307,14 @@ class CheckpointStorage:
             "CREATE TABLE IF NOT EXISTS cp_sessions "
             "(flow_id TEXT PRIMARY KEY, blob BLOB NOT NULL)"
         )
+        # corrupt rows are MOVED here (never silently destroyed, never
+        # re-deserialized at the next restart) — the quarantine half of
+        # the CRC frame contract (node/recovery.py)
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS cp_quarantine "
+            "(flow_id TEXT NOT NULL, src TEXT NOT NULL, "
+            "blob BLOB NOT NULL, reason TEXT NOT NULL)"
+        )
 
     def enable_group_commit(self, linger_ms: float = 0.0) -> None:
         """Arm checkpoint write coalescing (idempotent). `linger_ms`
@@ -288,19 +327,24 @@ class CheckpointStorage:
     def group_commit_stats(self) -> Optional[dict]:
         return None if self._group is None else dict(self._group.stats)
 
-    def _write(self, op: Callable) -> None:
+    def _write(self, op: Callable, point: Optional[str] = None) -> None:
+        if point is not None:
+            faultpoints.crash_fire(point)
         if self._group is not None:
             self._group.run(op)
         else:
             with self.db.transaction() as tx:
                 op(tx)
+        if point is not None:
+            faultpoints.crash_fire(point + ".committed")
 
     def put(self, flow_id: str, blob: bytes) -> None:
+        framed = recovery.frame(blob)
         self._write(lambda tx: tx.execute(
             "INSERT INTO checkpoints(flow_id, blob) VALUES(?, ?) "
             "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
-            (flow_id, blob),
-        ))
+            (flow_id, framed),
+        ), point=_P_CP_PUT)
 
     def put_incremental(
         self,
@@ -319,7 +363,7 @@ class CheckpointStorage:
                 tx.execute(
                     "INSERT INTO cp_header(flow_id, blob) VALUES(?, ?) "
                     "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
-                    (flow_id, header_blob),
+                    (flow_id, recovery.frame(header_blob)),
                 )
                 tx.execute(
                     "DELETE FROM checkpoints WHERE flow_id = ?", (flow_id,)
@@ -328,15 +372,15 @@ class CheckpointStorage:
                 tx.execute(
                     "INSERT OR REPLACE INTO cp_io(flow_id, pos, blob)"
                     " VALUES(?, ?, ?)",
-                    (flow_id, pos, blob),
+                    (flow_id, pos, recovery.frame(blob)),
                 )
             tx.execute(
                 "INSERT INTO cp_sessions(flow_id, blob) VALUES(?, ?) "
                 "ON CONFLICT(flow_id) DO UPDATE SET blob = excluded.blob",
-                (flow_id, sessions_blob),
+                (flow_id, recovery.frame(sessions_blob)),
             )
 
-        self._write(op)
+        self._write(op, point=_P_CP_PUT_INC)
 
     def remove(self, flow_id: str) -> None:
         def op(tx):
@@ -345,12 +389,39 @@ class CheckpointStorage:
                     f"DELETE FROM {table} WHERE flow_id = ?", (flow_id,)
                 )
 
-        self._write(op)
+        self._write(op, point=_P_CP_REMOVE)
+
+    def _quarantine(self, flow_id: str, src: str, blob: bytes,
+                    reason: str) -> None:
+        """Move one corrupt row aside (keep the evidence, drop the wedge):
+        the flow's rows are removed from the live tables so the NEXT
+        restart does not re-trip on them, and the torn blob is parked in
+        cp_quarantine for the operator."""
+        recovery.quarantine_record("checkpoints", f"{src}:{flow_id}", reason)
+        with self.db.transaction() as tx:
+            tx.execute(
+                "INSERT INTO cp_quarantine(flow_id, src, blob, reason)"
+                " VALUES(?, ?, ?, ?)",
+                (flow_id, src, blob, reason),
+            )
+            for table in ("checkpoints", "cp_header", "cp_io", "cp_sessions"):
+                tx.execute(
+                    f"DELETE FROM {table} WHERE flow_id = ?", (flow_id,)
+                )
+
+    def quarantined(self) -> List[Tuple[str, str, str]]:
+        """(flow_id, src table, reason) of every parked corrupt record."""
+        return [
+            (r[0], r[1], r[2])
+            for r in self.db.query(
+                "SELECT flow_id, src, reason FROM cp_quarantine"
+            )
+        ]
 
     def _assemble(self, flow_id: str, header_blob: bytes) -> bytes:
-        state = deserialize(header_blob)
+        state = deserialize(recovery.unframe(header_blob))
         state["io_log"] = [
-            row[0]
+            recovery.unframe(row[0])
             for row in self.db.query(
                 "SELECT blob FROM cp_io WHERE flow_id = ? ORDER BY pos",
                 (flow_id,),
@@ -360,7 +431,7 @@ class CheckpointStorage:
             "SELECT blob FROM cp_sessions WHERE flow_id = ?", (flow_id,)
         )
         state.update(
-            deserialize(rows[0][0])
+            deserialize(recovery.unframe(rows[0][0]))
             if rows
             else {"sessions": [], "session_keys": {}, "session_owner_flows": {}}
         )
@@ -368,30 +439,48 @@ class CheckpointStorage:
 
     def get(self, flow_id: str) -> Optional[bytes]:
         """ONE flow's full checkpoint blob (either write path), or None.
-        The flow hospital's replay-retry reads this at readmission time."""
+        The flow hospital's replay-retry reads this at readmission time.
+        A CRC-corrupt record quarantines (= None) instead of raising."""
         rows = self.db.query(
             "SELECT blob FROM checkpoints WHERE flow_id = ?", (flow_id,)
         )
         if rows:
-            return rows[0][0]
+            try:
+                return recovery.unframe(rows[0][0])
+            except recovery.CorruptRecordError as exc:
+                self._quarantine(flow_id, "checkpoints", rows[0][0], str(exc))
+                return None
         rows = self.db.query(
             "SELECT blob FROM cp_header WHERE flow_id = ?", (flow_id,)
         )
         if rows:
-            return self._assemble(flow_id, rows[0][0])
+            try:
+                return self._assemble(flow_id, rows[0][0])
+            except recovery.CorruptRecordError as exc:
+                self._quarantine(flow_id, "cp_header", rows[0][0], str(exc))
+                return None
         return None
 
     def all_checkpoints(self) -> List[Tuple[str, bytes]]:
-        out = [
-            (row[0], row[1])
-            for row in self.db.query("SELECT flow_id, blob FROM checkpoints")
-        ]
-        legacy = {flow_id for flow_id, _ in out}
+        out: List[Tuple[str, bytes]] = []
+        legacy = set()
+        for flow_id, blob in self.db.query(
+            "SELECT flow_id, blob FROM checkpoints"
+        ):
+            legacy.add(flow_id)
+            try:
+                out.append((flow_id, recovery.unframe(blob)))
+            except recovery.CorruptRecordError as exc:
+                self._quarantine(flow_id, "checkpoints", blob, str(exc))
         for flow_id, blob in self.db.query(
             "SELECT flow_id, blob FROM cp_header"
         ):
-            if flow_id not in legacy:
+            if flow_id in legacy:
+                continue
+            try:
                 out.append((flow_id, self._assemble(flow_id, blob)))
+            except recovery.CorruptRecordError as exc:
+                self._quarantine(flow_id, "cp_header", blob, str(exc))
         return out
 
     def count(self) -> int:

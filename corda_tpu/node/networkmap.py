@@ -29,7 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.crypto import crypto
 from ..core.identity import Party
-from ..utils import lockorder
+from ..utils import atomicfile, lockorder
 from ..core.serialization.codec import (
     deserialize,
     register_adapter,
@@ -185,10 +185,7 @@ class NetworkMapService:
             return
         try:
             blob = serialize(list(self._entries.values()))
-            tmp = self._persist_path + ".tmp"
-            with open(tmp, "wb") as fh:
-                fh.write(blob)
-            os.replace(tmp, self._persist_path)
+            atomicfile.write_atomic(self._persist_path, blob)
         except Exception:
             pass  # persistence is best-effort; the live map still serves
 

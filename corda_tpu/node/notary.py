@@ -114,6 +114,12 @@ class UniquenessException(Exception):
 # Uniqueness providers
 # ---------------------------------------------------------------------------
 
+#: the uniqueness log's one durability barrier (store "uniqueness_log"):
+#: fired by NotaryServiceFlow.commit_input_states before the commit-log
+#: write — a crash here must lose the whole commit, never tear it
+faultpoints.register_crash_point("notary.commit", "uniqueness_log")
+
+
 class UniquenessProvider:
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party):
         """Consume `states` for `tx_id` or raise UniquenessException.
@@ -155,6 +161,19 @@ class PersistentUniquenessProvider(UniquenessProvider):
             k: deserialize(blob)["tx_id"]
             for k, blob in self._map.get_many(keys).items()
         }
+
+    def consumed_keys(self) -> List[Tuple[bytes, str]]:
+        """Full commit-log dump as (state key, consuming tx hex) pairs —
+        recovery's cross-shard double-spend check (node/recovery.py
+        verify_consumption) scans EVERY shard's log with this."""
+        out: List[Tuple[bytes, str]] = []
+        for k, blob in self._map.items():
+            tx_id = deserialize(blob)["tx_id"]
+            tx_hex = (
+                tx_id.bytes.hex() if hasattr(tx_id, "bytes") else str(tx_id)
+            )
+            out.append((bytes(k), tx_hex))
+        return out
 
     def commit(self, states: List[StateRef], tx_id, requesting_party: Party) -> None:
         result = self.commit_many([(states, tx_id, requesting_party)])[0]
@@ -807,6 +826,13 @@ class NotaryService:
             )
             if action == "unavailable":
                 raise NotaryException("notary unavailable (injected fault)")
+            if action == "crash":
+                # the durability barrier: the uniqueness write below has
+                # not happened yet — a crash here must lose the commit
+                # cleanly, never half-record it
+                raise faultpoints.InjectedCrashError(
+                    "injected crash at notary.commit"
+                )
             if isinstance(action, tuple) and action[:1] == ("delay",):
                 time.sleep(float(action[1]))
         try:

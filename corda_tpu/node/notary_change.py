@@ -36,12 +36,17 @@ from ..utils import eventlog, faultpoints
 #: journal table in the instigator's node database
 JOURNAL_TABLE = "notary_change_journal"
 
-#: the four injectable coordinator-crash seams, in protocol order
-CRASH_POINTS = (
-    "notary_change.before_prepare",
-    "notary_change.after_prepare",
-    "notary_change.between_consume_and_assume",
-    "notary_change.after_commit",
+#: the four injectable coordinator-crash seams, in protocol order —
+#: registered as durability barriers of the change journal so the
+#: crash-point explorer (tools/crashmc.py) enumerates the whole ladder
+CRASH_POINTS = tuple(
+    faultpoints.register_crash_point(p, "notary_change_journal")
+    for p in (
+        "notary_change.before_prepare",
+        "notary_change.after_prepare",
+        "notary_change.between_consume_and_assume",
+        "notary_change.after_commit",
+    )
 )
 
 

@@ -1,0 +1,362 @@
+"""Crash-recovery invariants + per-record CRC framing (the durability
+contract, docs/robustness.md §7).
+
+Two jobs:
+
+* **Record framing.** Checkpoint blobs and broker-journal record bodies
+  are wrapped in a ``magic | u32 len | u32 crc32 | payload`` frame on
+  write. A loader that hits a corrupt or truncated record QUARANTINES
+  it (eventlog ``recovery`` record + the ``Recovery.QuarantinedRecords``
+  counter) and keeps going, instead of wedging startup on the one torn
+  row a power cut left behind. Legacy unframed blobs pass through
+  unchanged (``unframe`` detects the magic), so old stores keep
+  working.
+
+* **`verify_node_state`** — the ONE invariant checker every crash-point
+  run in tools/crashmc.py asserts after recovery: no lost acked
+  message, no duplicated flow result, no half-consumed state ref, every
+  journaled 2PC round fully re-driven or fully released, checkpoint
+  store parseable. Each `verify_*` helper returns a list of problem
+  strings (empty = clean) so the checker composes per-store and a
+  failure names its store.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils import eventlog, metrics
+
+#: frame magic for CRC-framed records. Chosen to be impossible as the
+#: first 4 bytes of this repo's serialization codec output AND of a
+#: legacy raw journal body (which starts with a hex message id).
+FRAME_MAGIC = b"\xc5\xcfR1"
+
+_FRAME_HDR = struct.Struct(">II")  # payload length, crc32(payload)
+
+#: process-wide: how many corrupt records loaders skipped-and-kept-going
+#: past instead of raising mid-restore (exposed as
+#: Recovery.QuarantinedRecords via node_metrics wiring or read directly)
+quarantined_records = metrics.Counter()
+
+#: the metric name the counter rides under when a registry exports it
+QUARANTINE_METRIC = "Recovery.QuarantinedRecords"
+
+
+class CorruptRecordError(ValueError):
+    """A CRC-framed record failed its checksum or length check."""
+
+
+def frame(payload: bytes) -> bytes:
+    """Wrap `payload` in the per-record CRC32 + length frame."""
+    return FRAME_MAGIC + _FRAME_HDR.pack(
+        len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+    ) + payload
+
+
+def unframe(blob: bytes) -> bytes:
+    """Verify-and-strip the frame; legacy (unframed) blobs pass through
+    unchanged. Raises CorruptRecordError on truncation or CRC mismatch —
+    callers quarantine via `quarantine_record` instead of crashing."""
+    if not blob.startswith(FRAME_MAGIC):
+        return blob
+    hdr_end = len(FRAME_MAGIC) + _FRAME_HDR.size
+    if len(blob) < hdr_end:
+        raise CorruptRecordError("frame header truncated")
+    length, crc = _FRAME_HDR.unpack_from(blob, len(FRAME_MAGIC))
+    payload = blob[hdr_end:]
+    if len(payload) != length:
+        raise CorruptRecordError(
+            f"frame length mismatch: header says {length}, "
+            f"got {len(payload)} bytes"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptRecordError("frame crc32 mismatch (torn write)")
+    return payload
+
+
+def quarantine_record(store: str, ident: str, reason: str) -> None:
+    """Count + announce one skipped corrupt record. The eventlog record
+    (component "recovery") is the operator's evidence that data was set
+    aside, not silently destroyed."""
+    quarantined_records.inc()
+    eventlog.emit(
+        "warning", "recovery",
+        "corrupt record quarantined instead of wedging startup",
+        store=store, ident=ident, reason=reason,
+    )
+
+
+# -- invariant checkers -------------------------------------------------------
+
+@dataclass
+class RecoveryReport:
+    """verify_node_state's verdict: empty problems = the recovery
+    invariants held."""
+    problems: List[str] = field(default_factory=list)
+    quarantined: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def extend(self, label: str, probs: List[str]) -> None:
+        self.problems.extend(f"{label}: {p}" for p in probs)
+
+
+def verify_broker_journal(
+    journal_dir: str,
+    sent: Optional[Set[str]] = None,
+    acked: Optional[Set[str]] = None,
+    durable_sent: Optional[Set[str]] = None,
+) -> List[str]:
+    """Replay every queue journal under `journal_dir` and check:
+    journals parse (torn tails truncate, corrupt records quarantine —
+    never raise); recovered pending ids are unique per queue and ⊆
+    `sent` (no fabricated message); no ACKED message redelivery is
+    REQUIRED (pending ∩ acked is allowed — ack-flush batching means a
+    crash legally forgets recent acks and dedup absorbs the replay);
+    and every id in `durable_sent` (enqueues known fsync-durable) that
+    was never acked IS recovered — the no-lost-message half."""
+    import os
+
+    from ..messaging.broker import _Journal
+
+    problems: List[str] = []
+    recovered: Set[str] = set()
+    if not os.path.isdir(journal_dir):
+        return [f"journal dir missing: {journal_dir}"]
+    for fn in sorted(os.listdir(journal_dir)):
+        if not fn.endswith(".journal"):
+            continue
+        path = os.path.join(journal_dir, fn)
+        try:
+            pending = _Journal.replay(path)
+        except Exception as exc:
+            problems.append(f"{fn}: replay raised {type(exc).__name__}: "
+                            f"{exc} (must truncate/quarantine, not wedge)")
+            continue
+        ids = [m.message_id for m in pending]
+        if len(ids) != len(set(ids)):
+            problems.append(f"{fn}: duplicate pending message ids")
+        recovered.update(ids)
+    if sent is not None:
+        ghosts = recovered - sent
+        if ghosts:
+            problems.append(
+                f"recovered messages never sent: {sorted(ghosts)[:3]}"
+            )
+    if durable_sent is not None:
+        lost = durable_sent - (acked or set()) - recovered
+        if lost:
+            problems.append(
+                f"durably-enqueued unacked messages lost: "
+                f"{sorted(lost)[:3]} (+{max(0, len(lost) - 3)} more)"
+            )
+    return problems
+
+
+def verify_checkpoints(checkpoint_storage) -> List[str]:
+    """The checkpoint store must be PARSEABLE end to end: every surviving
+    blob unframes and deserializes. Corrupt rows were already quarantined
+    by the storage layer (all_checkpoints never raises on them)."""
+    from ..core.serialization.codec import deserialize
+
+    problems: List[str] = []
+    try:
+        rows = checkpoint_storage.all_checkpoints()
+    except Exception as exc:
+        return [f"all_checkpoints raised {type(exc).__name__}: {exc} "
+                f"(corrupt records must quarantine, not wedge startup)"]
+    seen: Set[str] = set()
+    for flow_id, blob in rows:
+        if flow_id in seen:
+            problems.append(f"duplicate checkpoint for flow {flow_id}")
+        seen.add(flow_id)
+        try:
+            state = deserialize(blob)
+        except Exception as exc:
+            problems.append(
+                f"checkpoint {flow_id} not deserializable after "
+                f"recovery: {type(exc).__name__}: {exc}"
+            )
+            continue
+        if not isinstance(state, dict) or "flow_name" not in state:
+            problems.append(f"checkpoint {flow_id} missing flow_name")
+    return problems
+
+
+def verify_vault(db) -> List[str]:
+    """No half-consumed state ref: vault ingest (notify_all) is one
+    sqlite transaction per batch, so for every transaction the node
+    recorded, either its outputs are present AND its inputs consumed,
+    or neither — a tx with consumed inputs but missing outputs (or the
+    reverse) is a torn ingest. Also: no state both consumed and still
+    soft-locked (a consumed row must not pin a lock forever)."""
+    from ..core.serialization.codec import deserialize
+
+    problems: List[str] = []
+    vault_rows = db.query(
+        "SELECT tx_id, output_index, consumed, lock_id FROM vault_states"
+    )
+    by_ref: Dict[Tuple[bytes, int], Tuple[int, Optional[str]]] = {
+        (bytes(r[0]), r[1]): (r[2], r[3]) for r in vault_rows
+    }
+    for (txid, idx), (consumed, lock_id) in by_ref.items():
+        if consumed and lock_id:
+            problems.append(
+                f"state {txid.hex()[:16]}:{idx} consumed but still "
+                f"soft-locked by {lock_id}"
+            )
+    try:
+        tx_rows = db.query("SELECT tx_id, blob FROM transactions")
+    # lint: allow(swallow) — node without a tx store (bare vault rigs)
+    except Exception:
+        return problems
+    for txid_raw, blob in tx_rows:
+        try:
+            stx = deserialize(blob)
+            wtx = stx.tx
+        # lint: allow(swallow) — undeserializable row is not this
+        except Exception:
+            continue  # checker's store; verify_checkpoints owns blobs
+        inputs_here = [
+            (ref.txhash.bytes, ref.index) for ref in wtx.inputs
+            if (ref.txhash.bytes, ref.index) in by_ref
+        ]
+        outputs_here = [
+            i for i in range(len(wtx.outputs))
+            if (wtx.id.bytes, i) in by_ref
+        ]
+        consumed_flags = [by_ref[k][0] for k in inputs_here]
+        if outputs_here and consumed_flags and not all(consumed_flags):
+            problems.append(
+                f"tx {wtx.id.bytes.hex()[:16]} half-ingested: outputs "
+                f"recorded but {consumed_flags.count(0)} of "
+                f"{len(consumed_flags)} inputs unconsumed"
+            )
+    return problems
+
+
+def verify_sharded_journal(provider) -> List[str]:
+    """After `provider.recover()`: every journaled round is fully
+    re-driven or fully released — no 'committing' round may remain (the
+    decision was durable; recovery must drive it to completion), and no
+    reservation may outlive its round's journal entry."""
+    problems: List[str] = []
+    rounds = provider.journal.items()
+    for round_id, rec in rounds:
+        if rec.get("phase") == "committing":
+            problems.append(
+                f"round {round_id[:16]} still journaled 'committing' "
+                f"after recovery (must be re-driven to completion)"
+            )
+    live_rounds = {round_id for round_id, _ in rounds}
+    for s, store in enumerate(getattr(provider, "_stores", [])):
+        try:
+            held = store.held_tx_ids()
+        except AttributeError:
+            continue
+        for tx_hex in held:
+            if tx_hex not in live_rounds:
+                problems.append(
+                    f"shard s{s}: reservation for {tx_hex[:16]} outlives "
+                    f"its journal entry (leaked lock)"
+                )
+    return problems
+
+
+def verify_consumption(providers, expected: Dict[bytes, str]) -> List[str]:
+    """Cross-store double-spend check for a recovery scenario: each key
+    in `expected` (state key -> consuming tx hex) must be consumed by
+    EXACTLY that tx in exactly one provider — and a re-commit probe of a
+    DIFFERENT tx against the same key must conflict, which callers do
+    via the provider API. Here: no key consumed twice under different
+    txs across `providers`."""
+    problems: List[str] = []
+    owners: Dict[bytes, Set[str]] = {}
+    for p in providers:
+        for key, tx_hex in p.consumed_keys():
+            owners.setdefault(key, set()).add(tx_hex)
+    for key, txs in owners.items():
+        if len(txs) > 1:
+            problems.append(
+                f"state key {key.hex()[:16]} consumed by {len(txs)} "
+                f"different txs: {sorted(t[:16] for t in txs)}"
+            )
+    for key, tx_hex in expected.items():
+        got = owners.get(key, set())
+        if got and got != {tx_hex}:
+            problems.append(
+                f"state key {key.hex()[:16]} consumed by "
+                f"{sorted(got)[0][:16]}, expected {tx_hex[:16]}"
+            )
+    return problems
+
+
+def verify_notary_change(journal) -> List[str]:
+    """Notary-change journal entries after recovery must be gone (the
+    recovery flow re-drives each to completion and removes it) — any
+    survivor means a change is neither re-driven nor released."""
+    return [
+        f"notary-change {tx_hex[:16]} parked at phase "
+        f"{rec.get('phase')!r} after recovery"
+        for tx_hex, rec in journal.items()
+    ]
+
+
+def verify_flow_results(results: Dict[str, List]) -> List[str]:
+    """No duplicated flow result: a flow id observed completing more
+    than once (e.g. replayed checkpoint AND live run both delivering)
+    is a duplicated side effect."""
+    return [
+        f"flow {fid} delivered {len(rs)} results (exactly-once violated)"
+        for fid, rs in results.items() if len(rs) > 1
+    ]
+
+
+def verify_node_state(
+    node=None,
+    *,
+    journal_dir: Optional[str] = None,
+    checkpoint_storage=None,
+    db=None,
+    sharded_provider=None,
+    notary_change_journal=None,
+    flow_results: Optional[Dict[str, List]] = None,
+    sent: Optional[Set[str]] = None,
+    acked: Optional[Set[str]] = None,
+    durable_sent: Optional[Set[str]] = None,
+) -> RecoveryReport:
+    """THE recovery invariant checker (ISSUE 20): run every per-store
+    verifier that applies to what the caller hands in. Pass a live
+    `node` (AbstractNode duck type) to derive the stores, or pass the
+    stores individually (the crashmc scenarios build them bare)."""
+    report = RecoveryReport(quarantined=quarantined_records.value)
+    if node is not None:
+        checkpoint_storage = checkpoint_storage or getattr(
+            node, "checkpoint_storage", None)
+        db = db or getattr(node, "db", None)
+        broker = getattr(node, "broker", None)
+        if journal_dir is None and broker is not None:
+            journal_dir = getattr(broker, "journal_dir", None)
+    if journal_dir is not None:
+        report.extend("broker_journal", verify_broker_journal(
+            journal_dir, sent=sent, acked=acked,
+            durable_sent=durable_sent,
+        ))
+    if checkpoint_storage is not None:
+        report.extend("checkpoints", verify_checkpoints(checkpoint_storage))
+    if db is not None:
+        report.extend("vault", verify_vault(db))
+    if sharded_provider is not None:
+        report.extend("sharded_2pc",
+                      verify_sharded_journal(sharded_provider))
+    if notary_change_journal is not None:
+        report.extend("notary_change",
+                      verify_notary_change(notary_change_journal))
+    if flow_results is not None:
+        report.extend("flows", verify_flow_results(flow_results))
+    return report

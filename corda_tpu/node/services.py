@@ -21,8 +21,20 @@ from ..core.crypto.keys import KeyPair, PublicKey
 from ..core.crypto.secure_hash import SecureHash
 from ..core.identity import AnonymousParty, Party
 from ..core.serialization.codec import deserialize, serialize
-from ..utils import lockorder
+from ..utils import faultpoints, lockorder
 from ..utils.metrics import MonitoringService
+
+#: durability barriers of the vault (store "vault"): each fires before
+#: its one-transaction write, `.committed` after — a crash between them
+#: must leave either the whole ingest/reconcile or none of it
+_P_VAULT_NOTIFY = faultpoints.register_crash_point(
+    "vault.notify", "vault")
+_P_VAULT_NOTIFY_DONE = faultpoints.register_crash_point(
+    "vault.notify.committed", "vault")
+_P_VAULT_MARK = faultpoints.register_crash_point(
+    "vault.mark_notary_consumed", "vault")
+_P_VAULT_MARK_DONE = faultpoints.register_crash_point(
+    "vault.mark_notary_consumed.committed", "vault")
 from . import vault_query as _vault_query  # noqa: F401 — registers codec adapters
 from .database import (
     AttachmentStorage,
@@ -582,8 +594,10 @@ class VaultService:
         # (reentrant) keeps the post-commit cache maintenance atomic
         # with the commit w.r.t. every bucket reader: no window where a
         # committed state is invisible to coin selection.
+        faultpoints.crash_fire(_P_VAULT_NOTIFY, txs=len(txs))
         with self.db.lock:
             self._notify_all_locked(txs, produced, consumed)
+        faultpoints.crash_fire(_P_VAULT_NOTIFY_DONE, txs=len(txs))
         if produced or consumed:
             for obs in list(self._observers):
                 obs(produced, consumed)
@@ -883,6 +897,7 @@ class VaultService:
         liveness; the consuming transaction's outputs were never ours to
         record. Returns the refs actually flipped (already-consumed rows
         are idempotent no-ops)."""
+        faultpoints.crash_fire(_P_VAULT_MARK, refs=len(refs))
         flipped: List[StateRef] = []
         with self.db.lock:
             with self.db.transaction():  # holds db.lock (reentrant)
@@ -899,6 +914,7 @@ class VaultService:
             if self._indexed:  # post-commit, still under db.lock
                 for ref in flipped:
                     self._evict_locked(self._refkey(ref))
+        faultpoints.crash_fire(_P_VAULT_MARK_DONE, flipped=len(flipped))
         if flipped:
             for obs in list(self._observers):
                 obs([], list(flipped))

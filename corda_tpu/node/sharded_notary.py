@@ -49,7 +49,7 @@ import hashlib
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..core.contracts.structures import StateRef
 from ..core.crypto.secure_hash import SecureHash
@@ -68,9 +68,25 @@ DEFAULT_PREPARE_TTL_S = 30.0
 
 
 class CoordinatorCrashError(RuntimeError):
-    """Raised by the `sharded.prepare` / `sharded.finalise` fault points'
-    "crash" action: simulates the coordinator dying mid-protocol with its
-    reservations and journal record left behind (recovery-test seam)."""
+    """Raised by the `sharded.*` fault points' "crash" action: simulates
+    the coordinator dying mid-protocol with its reservations and journal
+    record left behind (recovery-test seam)."""
+
+
+#: the 2PC ladder's durability barriers (store "sharded_2pc"), in rung
+#: order: journal_prepare → per-shard prepare → journal_committing
+#: (the decision record) → per-shard finalise → journal_remove.
+#: tools/crashmc.py kills the coordinator at each and asserts recover()
+#: either re-drives the round to completion or releases every lock.
+for _p in (
+    "sharded.journal_prepare",
+    "sharded.prepare",
+    "sharded.journal_committing",
+    "sharded.finalise",
+    "sharded.journal_remove",
+):
+    faultpoints.register_crash_point(_p, "sharded_2pc")
+del _p
 
 
 def _key_of(ref: StateRef) -> bytes:
@@ -299,6 +315,21 @@ class ReservationStore:
             for k in victims:
                 del self._mem[k]
         return len(victims)
+
+    def held_tx_ids(self) -> Set[str]:
+        """Every tx currently holding at least one reservation —
+        recovery's leaked-lock check (node/recovery.py): after the
+        journal drains, a holder with no journal entry is a lock that
+        nothing will ever release before its TTL."""
+        if self._db is not None:
+            return {
+                row[0]
+                for row in self._db.query(
+                    f"SELECT DISTINCT tx FROM {self._table}"
+                )
+            }
+        with self._mem_lock:
+            return {tx for tx, _ in self._mem.values()}
 
 
 class _ReservationsView:
@@ -848,6 +879,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
         round_id = txs[0]["tx_hex"]
         # journal FIRST: recovery must be able to find (and release) any
         # reservation this round takes from here on
+        self._fire("sharded.journal_prepare", tx_id=round_id)
         self.journal.put(round_id, self._journal_record(
             "prepare", union, txs, expires
         ))
@@ -935,6 +967,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
         # every survivor is re-locked past the finalise window — flip the
         # journal so a crash from here on RE-DRIVES the commit instead of
         # aborting
+        self._fire("sharded.journal_committing", tx_id=round_id)
         self.journal.put(round_id, self._journal_record(
             "committing", union, alive, finalise_expires
         ))
@@ -944,6 +977,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 continue
             self._fire("sharded.finalise", shard=f"s{s}", tx_id=round_id)
             self._finalise_shard_batch(s, items)
+        self._fire("sharded.journal_remove", tx_id=round_id)
         self.journal.remove(round_id)
         with self._stats_lock:
             self.cross_commits += len(alive)

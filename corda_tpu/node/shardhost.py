@@ -60,7 +60,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..core.serialization.codec import deserialize
-from ..utils import eventlog, lockorder
+from ..utils import atomicfile, eventlog, lockorder
 from .session import (
     ROUTE_HINT_HEADER,
     SESSION_TOPIC,
@@ -863,10 +863,10 @@ def run_worker(config_dir: str, index: int, n_workers: int,
     control.start()
     node.start()
     if getattr(node, "ops_server", None) is not None:
-        tmp = os.path.join(base, f"worker{index}.ops_port.tmp")
-        with open(tmp, "w") as fh:
-            fh.write(str(node.ops_server.port))
-        os.replace(tmp, os.path.join(base, f"worker{index}.ops_port"))
+        atomicfile.write_atomic(
+            os.path.join(base, f"worker{index}.ops_port"),
+            str(node.ops_server.port),
+        )
     print(f"worker ready: {cfg.node.my_legal_name} w{index}/{n_workers}",
           flush=True)
 

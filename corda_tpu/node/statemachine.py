@@ -45,6 +45,7 @@ from ..core.identity import Party
 from ..core.serialization.codec import deserialize, serialize
 from ..utils import eventlog, lockorder, tracing
 from ..utils.metrics import MetricRegistry
+from . import recovery
 from .session import (
     ROUTE_HINT_HEADER,
     SESSION_TOPIC,
@@ -822,7 +823,27 @@ class StateMachineManager:
                 and not self.checkpoint_filter(flow_id)
             ):
                 continue
-            self._restore(flow_id, blob)
+            try:
+                self._restore(flow_id, blob)
+            except Exception as exc:
+                # ONE unrestorable checkpoint (torn write the CRC frame
+                # could not catch, flow class gone after an upgrade) must
+                # not wedge the whole node out of serving: park it and
+                # keep restoring the rest (node/recovery.py contract)
+                park = getattr(self.checkpoint_storage, "_quarantine", None)
+                if park is not None:
+                    # moves the blob into cp_quarantine (keeps evidence)
+                    # and already counts + eventlogs the quarantine
+                    park(flow_id, "restore", blob,
+                         f"{type(exc).__name__}: {exc}")
+                else:
+                    recovery.quarantine_record(
+                        "checkpoints", f"restore:{flow_id}",
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                    remove = getattr(self.checkpoint_storage, "remove", None)
+                    if remove is not None:
+                        remove(flow_id)
 
     @property
     def in_flight_count(self) -> int:

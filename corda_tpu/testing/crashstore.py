@@ -1,0 +1,410 @@
+"""Simulated power-cut storage (ISSUE 20's tentpole, docs/robustness.md §7).
+
+A `CrashDisk` interposes on every durable write path that goes through a
+swappable IO namespace — the broker journal (`messaging.broker.jio`),
+atomic-JSON files (`utils.atomicfile.io`) — and RECORDS which sqlite
+databases a node opens (`node.database.connect_factory`). Writes live in
+memory while interposed; `power_cut()` then decides, seeded, what a real
+disk would have kept:
+
+  * buffered writes VANISH unless fsync'd — `flush()` only moves bytes
+    from the app buffer to the simulated OS cache, exactly the page
+    cache a power cut eats; `fsync_fh` is what makes data durable;
+  * torn writes — an unsynced write survives per 512-byte page, and a
+    surviving page can be CUT at an arbitrary byte boundary;
+  * reordered unsynced blocks — each page survives independently, so a
+    LATER page can persist while an earlier one does not (the write
+    reordering disk schedulers actually do);
+  * metadata (create/rename/remove) journals PER DIRECTORY in order: an
+    unsynced tail survives only as a prefix, `fsync_dir` pins it. A
+    rename that survives while its target's data did not yields the
+    classic zero-length/torn destination file — the exact bug
+    utils/atomicfile.py exists to prevent.
+
+`proc_crash()` models plain process death instead: the OS cache
+survives, only app-buffered (unflushed) bytes are lost.
+
+Both calls MATERIALIZE the surviving filesystem onto the real disk, so
+recovery code (journal replay, node restart) runs against genuine files
+with no simulation in the loop. sqlite tearing is applied to the real
+files afterwards via `tear_sqlite_wal()` (sqlite's own WAL checksums
+must cope — that is the assertion).
+
+Driven by the seeded `testing/faults.py` machinery: the workload runs
+under `faults.inject(seed=...)` with "crash" rules on registered
+durability barriers (utils/faultpoints.CRASH_POINTS), and this module's
+randomness comes from one `random.Random` the caller seeds — a failing
+crash-matrix cell replays exactly. tools/crashmc.py is the driver.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+#: survival granularity: disks commit caches in pages; 512 is the
+#: traditional sector size (torn boundaries inside a page come from the
+#: additional byte-level tear below)
+PAGE = 512
+
+
+class CrashFile:
+    """One open handle on the simulated disk. Writes buffer in the app
+    until `flush()` (close flushes, like CPython file objects); reads
+    see the handle's snapshot at open."""
+
+    def __init__(self, disk: "CrashDisk", path: str, mode: str):
+        self._disk = disk
+        self._path = path
+        self._text = "b" not in mode
+        self._reading = "r" in mode and "+" not in mode
+        self._buf: List[bytes] = []
+        self.closed = False
+        if self._reading:
+            self._data = disk._read_now(path)
+            self._pos = 0
+        else:
+            disk._open_for_write(path, truncate="w" in mode)
+
+    # -- writer side ---------------------------------------------------------
+
+    def write(self, data) -> int:
+        if self._text and isinstance(data, str):
+            data = data.encode("utf-8")
+        self._buf.append(bytes(data))
+        return len(data)
+
+    def flush(self) -> None:
+        """App buffer -> simulated OS cache (still NOT power-cut safe)."""
+        for chunk in self._buf:
+            self._disk._write(self._path, chunk)
+        self._buf.clear()
+
+    # -- reader side ---------------------------------------------------------
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            out = self._data[self._pos:]
+            self._pos = len(self._data)
+        else:
+            out = self._data[self._pos:self._pos + n]
+            self._pos += len(out)
+        out = bytes(out)
+        return out.decode("utf-8") if self._text else out
+
+    # -- common --------------------------------------------------------------
+
+    def close(self) -> None:
+        if not self.closed:
+            if not self._reading:
+                self.flush()
+            self.closed = True
+
+    def __enter__(self) -> "CrashFile":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+# op kinds in the disk log (see power_cut's replay)
+_CREATE, _WRITE, _REPLACE, _REMOVE, _FSYNC, _FSYNC_DIR = range(6)
+
+
+class CrashDisk:
+    """The simulated disk: duck-types `utils.atomicfile.io` (open /
+    replace / fsync_fh / fsync_dir) and `messaging.broker.jio` (open /
+    replace / remove / fsync_fh)."""
+
+    def __init__(self, rng: Optional[random.Random] = None,
+                 survive_p: float = 0.5, tear_p: float = 0.5):
+        self.rng = rng or random.Random(0)
+        self.survive_p = survive_p
+        self.tear_p = tear_p
+        self._log: List[tuple] = []
+        self._base: Dict[str, bytes] = {}   # durable-at-first-touch
+        self._fs: Dict[str, bytearray] = {}  # the live (pre-cut) view
+        self._gone: set = set()              # removed since first touch
+        self.sqlite_paths: List[str] = []    # recorded by interpose()
+        #: power_cut() fills this: what the cut actually did, per path —
+        #: tests assert "at least one demonstrably-injected torn write"
+        self.last_cut: Dict[str, Dict[str, int]] = {}
+
+    # -- live filesystem view ------------------------------------------------
+
+    def _seed(self, path: str) -> None:
+        if path in self._fs or path in self._gone:
+            return
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            self._base[path] = blob
+            self._fs[path] = bytearray(blob)
+
+    def _read_now(self, path: str) -> bytes:
+        self._seed(path)
+        if path not in self._fs:
+            raise FileNotFoundError(path)
+        return bytes(self._fs[path])
+
+    def _open_for_write(self, path: str, truncate: bool) -> None:
+        self._seed(path)
+        if truncate or path not in self._fs:
+            self._log.append((_CREATE, path))
+            self._fs[path] = bytearray()
+            self._gone.discard(path)
+
+    def _write(self, path: str, data: bytes) -> None:
+        buf = self._fs[path]
+        self._log.append((_WRITE, path, len(buf), data))
+        buf += data
+
+    # -- the atomicfile/jio protocol -----------------------------------------
+
+    def open(self, path: str, mode: str = "r") -> CrashFile:
+        return CrashFile(self, path, mode)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._seed(src)
+        self._seed(dst)
+        if src not in self._fs:
+            raise FileNotFoundError(src)
+        self._log.append((_REPLACE, src, dst))
+        self._fs[dst] = self._fs.pop(src)
+        self._gone.add(src)
+        self._gone.discard(dst)
+
+    def remove(self, path: str) -> None:
+        self._seed(path)
+        if path not in self._fs:
+            raise FileNotFoundError(path)
+        self._log.append((_REMOVE, path))
+        del self._fs[path]
+        self._gone.add(path)
+
+    def fsync_fh(self, fh) -> None:
+        if isinstance(fh, CrashFile):
+            if not fh._reading:
+                fh.flush()
+            self._log.append((_FSYNC, fh._path))
+        else:  # a real handle that predates interposition
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def fsync_dir(self, path: str) -> None:
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        self._log.append((_FSYNC_DIR, d))
+
+    # -- crash semantics -----------------------------------------------------
+
+    def settle(self) -> None:
+        """Clean shutdown: everything the OS saw reaches the real disk."""
+        self._materialize(self._fs)
+        self._reset()
+
+    def proc_crash(self) -> None:
+        """Process death, disk fine: the OS cache (every flushed write)
+        survives; only app buffers on open CrashFiles are lost — and
+        those never reached `_write`, so the live view IS the outcome."""
+        self._materialize(self._fs)
+        self._reset()
+
+    def power_cut(self) -> Dict[str, Dict[str, int]]:
+        """The plug is pulled. Replays the op log deciding survival per
+        op (module docstring), materializes the surviving filesystem
+        onto the real disk, and returns per-path damage stats
+        ({path: {"dropped_pages": n, "torn": n, "lost_meta": n}})."""
+        rng = self.rng
+        stats: Dict[str, Dict[str, int]] = {}
+
+        def stat(path: str) -> Dict[str, int]:
+            return stats.setdefault(
+                path, {"dropped_pages": 0, "torn": 0, "lost_meta": 0}
+            )
+
+        # 1. data durability horizon: writes to `path` before its LAST
+        # fsync survive fully
+        fsync_after: Dict[str, int] = {}
+        for i, op in enumerate(self._log):
+            if op[0] == _FSYNC:
+                fsync_after[op[1]] = i
+        # 2. metadata: per-directory ordered journal; everything up to
+        # the last fsync_dir is pinned, the tail survives as a prefix
+        dir_ops: Dict[str, List[int]] = {}
+        dir_pinned: Dict[str, int] = {}
+        for i, op in enumerate(self._log):
+            if op[0] in (_CREATE, _REMOVE):
+                d = os.path.dirname(os.path.abspath(op[1])) or "."
+                dir_ops.setdefault(d, []).append(i)
+            elif op[0] == _REPLACE:
+                d = os.path.dirname(os.path.abspath(op[2])) or "."
+                dir_ops.setdefault(d, []).append(i)
+            elif op[0] == _FSYNC_DIR:
+                dir_pinned[op[1]] = i
+        meta_ok: set = set()
+        for d, idxs in dir_ops.items():
+            pinned = dir_pinned.get(d, -1)
+            tail = [i for i in idxs if i > pinned]
+            keep = rng.randint(0, len(tail))
+            meta_ok.update(i for i in idxs if i <= pinned)
+            meta_ok.update(tail[:keep])
+            for i in tail[keep:]:
+                op = self._log[i]
+                # journaled filesystems order data-fsync behind the
+                # creating dirent (ext4 auto_da_alloc et al.): a CREATE
+                # whose file was later fsync'd is pinned even without
+                # fsync_dir — renames get no such mercy
+                if op[0] == _CREATE and fsync_after.get(op[1], -1) > i:
+                    meta_ok.add(i)
+                    continue
+                stat(op[2] if op[0] == _REPLACE else op[1])["lost_meta"] += 1
+
+        # 3. replay with survival decisions
+        fs: Dict[str, bytearray] = {
+            p: bytearray(b) for p, b in self._base.items()
+        }
+        for i, op in enumerate(self._log):
+            kind = op[0]
+            if kind == _CREATE:
+                if i in meta_ok:
+                    fs[op[1]] = bytearray()
+            elif kind == _REMOVE:
+                if i in meta_ok:
+                    fs.pop(op[1], None)
+            elif kind == _REPLACE:
+                if i in meta_ok and op[1] in fs:
+                    fs[op[2]] = fs.pop(op[1])
+            elif kind == _WRITE:
+                _, path, off, data = op
+                if path not in fs:
+                    continue  # its create never survived
+                buf = fs[path]
+                if i < fsync_after.get(path, -1) + 1:
+                    _apply(buf, off, data)
+                    continue
+                # unsynced: page-granular i.i.d. survival + byte tears
+                for poff in range(0, len(data), PAGE):
+                    piece = data[poff:poff + PAGE]
+                    if rng.random() >= self.survive_p:
+                        stat(path)["dropped_pages"] += 1
+                        continue
+                    if len(piece) > 1 and rng.random() < self.tear_p:
+                        cut = rng.randrange(1, len(piece))
+                        piece = piece[:cut]
+                        stat(path)["torn"] += 1
+                    _apply(buf, off + poff, piece)
+        self._materialize(fs)
+        self._reset()
+        self.last_cut = stats
+        return stats
+
+    # -- real-disk IO --------------------------------------------------------
+
+    def _materialize(self, fs: Dict[str, "bytearray"]) -> None:
+        for path in set(self._base) | set(self._fs) | self._gone:
+            if path not in fs and os.path.exists(path):
+                os.remove(path)
+        for path, buf in fs.items():
+            os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                        exist_ok=True)
+            with open(path, "wb") as fh:
+                fh.write(bytes(buf))
+
+    def _reset(self) -> None:
+        self._log.clear()
+        self._base.clear()
+        self._fs.clear()
+        self._gone.clear()
+
+    # -- sqlite (real files; the connection factory only records) ------------
+
+    def snapshot_sqlite(self, dst_dir: str) -> Dict[str, str]:
+        """Freeze each recorded database as a power-cut image: copy the
+        main file and -wal byte-for-byte WHILE the owning connection is
+        still live — exactly what the platter holds when the plug is
+        pulled mid-flight (sqlite is built to recover such an image; the
+        -shm is deliberately not copied, it is rebuilt). Returns
+        {original_db_path: snapshot_db_path}; tear the snapshots with
+        tear_sqlite_wal(out.values())."""
+        import shutil
+
+        os.makedirs(dst_dir, exist_ok=True)
+        out: Dict[str, str] = {}
+        for db_path in dict.fromkeys(self.sqlite_paths):
+            if not os.path.exists(db_path):
+                continue
+            dst = os.path.join(dst_dir, os.path.basename(db_path))
+            shutil.copyfile(db_path, dst)
+            if os.path.exists(db_path + "-wal"):
+                shutil.copyfile(db_path + "-wal", dst + "-wal")
+            out[db_path] = dst
+        return out
+
+    def tear_sqlite_wal(self, db_paths=None) -> List[str]:
+        """Truncate each database's -wal file at a seeded arbitrary
+        offset — the torn tail a power cut leaves when sqlite ran
+        synchronous=NORMAL (WAL fsync deferred to checkpoint). sqlite's
+        per-frame checksums must absorb it: recovery opens the db and
+        silently drops the tail; a node that WEDGES instead fails the
+        matrix. Operates on `db_paths` (usually snapshot_sqlite output)
+        or, by default, every recorded path — the files must not have a
+        live writer."""
+        torn: List[str] = []
+        for db_path in dict.fromkeys(db_paths or self.sqlite_paths):
+            wal = db_path + "-wal"
+            try:
+                size = os.path.getsize(wal)
+            except OSError:
+                continue
+            if size <= 32:  # nothing beyond the WAL header
+                continue
+            cut = self.rng.randrange(32, size)
+            with open(wal, "r+b") as fh:
+                fh.truncate(cut)
+            torn.append(wal)
+        return torn
+
+
+def _apply(buf: bytearray, off: int, data: bytes) -> None:
+    """Write `data` at `off`, zero-filling any gap (a surviving block
+    past holes reads back zeros, like allocated-but-unwritten extents)."""
+    if off > len(buf):
+        buf += b"\x00" * (off - len(buf))
+    buf[off:off + len(data)] = data
+
+
+@contextlib.contextmanager
+def interpose(disk: Optional[CrashDisk] = None,
+              rng: Optional[random.Random] = None):
+    """Swap the process's durable-write seams for `disk` (or a fresh
+    seeded one): atomicfile's IO, the broker journal's IO, and the
+    sqlite connection factory (record-only — sqlite keeps writing real
+    files; tearing happens post-cut via tear_sqlite_wal). Restores every
+    seam on exit. The caller must end the simulation with one of
+    power_cut() / proc_crash() / settle() — usually inside the block —
+    or in-memory writes are dropped on the floor."""
+    from ..messaging import broker
+    from ..node import database
+    from ..utils import atomicfile
+
+    d = disk or CrashDisk(rng=rng)
+    prev_io = atomicfile.io
+    prev_jio = broker.jio
+    prev_cf = database.connect_factory
+
+    def recording_connect(path, *args, **kw):
+        if isinstance(path, str) and path != ":memory:":
+            d.sqlite_paths.append(path)
+        return prev_cf(path, *args, **kw)
+
+    atomicfile.io = d
+    broker.jio = d
+    database.connect_factory = recording_connect
+    try:
+        yield d
+    finally:
+        atomicfile.io = prev_io
+        broker.jio = prev_jio
+        database.connect_factory = prev_cf

@@ -1,0 +1,122 @@
+"""The ONE atomic-file-write helper (docs/robustness.md §7).
+
+Every "write tmp then os.replace" site in the tree used to skip the
+fsync before the rename — after a power cut that sequence can legally
+leave the DESTINATION pointing at a zero-length or torn file (the
+rename is journaled by the filesystem before the data blocks ever hit
+the platter). This module is the single implementation: write tmp,
+flush, fsync(tmp), rename, fsync(directory). The `atomic_write` lint
+pass (corda_tpu/analysis/astlint.py) pins every direct `os.replace`/
+`os.rename` call outside this file, so new sites cannot quietly
+reintroduce the bug.
+
+`CORDA_TPU_ATOMIC_FSYNC=0` drops the fsyncs (process-crash durability
+only — the rename stays atomic against concurrent READERS, which is
+what most tooling sites actually need) for benches on slow disks.
+
+All file I/O goes through the swappable `io` namespace so the simulated
+power-cut storage (testing/crashstore.py) can interpose and model what
+each fsync actually buys.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Union
+
+from . import faultpoints
+
+#: durability barriers of the atomic-file store (identity entropy,
+#: ready-file, quiesce marker, broker.port, bench artifacts, ...)
+_P_WRITE = faultpoints.register_crash_point(
+    "atomicfile.write", "atomic_file")
+_P_PRE_RENAME = faultpoints.register_crash_point(
+    "atomicfile.pre_rename", "atomic_file")
+_P_POST_RENAME = faultpoints.register_crash_point(
+    "atomicfile.post_rename", "atomic_file")
+
+
+class _RealIO:
+    """The OS: testing/crashstore.py swaps this for a simulated disk."""
+
+    open = staticmethod(open)
+    replace = staticmethod(os.replace)
+
+    @staticmethod
+    def fsync_fh(fh) -> None:
+        fh.flush()
+        os.fsync(fh.fileno())
+
+    @staticmethod
+    def fsync_dir(path: str) -> None:
+        """Persist the rename itself: the directory entry is data too."""
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        try:
+            fd = os.open(d, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+io = _RealIO()
+
+
+def _fsync_enabled(fsync: Optional[bool]) -> bool:
+    if fsync is not None:
+        return fsync
+    return os.environ.get("CORDA_TPU_ATOMIC_FSYNC", "1") != "0"
+
+
+def write_atomic(path: str, data: Union[bytes, str],
+                 fsync: Optional[bool] = None) -> None:
+    """Replace `path` with `data` so that readers never observe a torn
+    or empty file AND (with fsync, the default) a power cut never
+    leaves one behind either. tmp name carries the pid: concurrent
+    writers (cordform fleets cold-starting) must not interleave into
+    one tmp file."""
+    faultpoints.crash_fire(_P_WRITE, path=path)
+    durable = _fsync_enabled(fsync)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    mode = "wb" if isinstance(data, bytes) else "w"
+    fh = io.open(tmp, mode)
+    try:
+        fh.write(data)
+        if durable:
+            io.fsync_fh(fh)
+    finally:
+        fh.close()
+    faultpoints.crash_fire(_P_PRE_RENAME, path=path)
+    io.replace(tmp, path)
+    faultpoints.crash_fire(_P_POST_RENAME, path=path)
+    if durable:
+        io.fsync_dir(path)
+
+
+def write_json_atomic(path: str, obj: Any,
+                      fsync: Optional[bool] = None, **dump_kw) -> None:
+    write_atomic(path, json.dumps(obj, **dump_kw), fsync=fsync)
+
+
+def rename_durable(tmp: str, path: str,
+                   fsync: Optional[bool] = None) -> None:
+    """Atomic install of an ALREADY-written tmp file (e.g. a compiler
+    output): fsync the content this process did not write itself, then
+    rename + directory fsync — same durability contract as
+    write_atomic."""
+    durable = _fsync_enabled(fsync)
+    if durable:
+        fh = io.open(tmp, "rb")
+        try:
+            io.fsync_fh(fh)
+        except OSError:
+            pass  # lint: allow(swallow) — read-only fs: rename still atomic
+        finally:
+            fh.close()
+    faultpoints.crash_fire(_P_PRE_RENAME, path=path)
+    io.replace(tmp, path)
+    faultpoints.crash_fire(_P_POST_RENAME, path=path)
+    if durable:
+        io.fsync_dir(path)

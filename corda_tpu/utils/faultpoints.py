@@ -21,16 +21,80 @@ actions each seam honours:
                    tx_id=   -> "crash" (injected coordinator death at
                                that two-phase seam; node/notary_change.py)
 
+DURABILITY BARRIERS (docs/robustness.md §7): every seam that sits
+between two durable writes registers itself in ``CRASH_POINTS`` via
+``register_crash_point(point, store)`` at module import, so the
+crash-point explorer (tools/crashmc.py) can ENUMERATE the whole
+durability surface instead of trusting a hand-kept list. These seams
+honour the action "crash" by raising ``InjectedCrashError`` (or a
+subsystem-specific subclass-alike), which the explorer treats as the
+process dying at exactly that instant.
+
+``CORDA_TPU_CRASH_AT=point[:nth]`` arms a REAL process kill at a seam:
+``install_env_crash_hook()`` (called from node boot) SIGKILLs the
+process the nth time that point fires — the real-process slice of the
+crash matrix (tests/test_real_tier1.py).
+
 Unknown actions are ignored by every seam (forward compatibility: an
 injector aimed at a newer build must not crash an older one).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import os
+from typing import Any, Callable, Dict, Optional
 
 #: the installed hook; seams read this attribute directly so the
 #: production fast path is one global load + None check
 hook: Optional[Callable[..., Any]] = None
+
+#: every registered durability barrier: point name -> durable store it
+#: guards (e.g. "journal.append_enqueue" -> "broker_journal"). Filled at
+#: import time by the modules owning the seams; read by tools/crashmc.py.
+CRASH_POINTS: Dict[str, str] = {}
+
+
+class InjectedCrashError(RuntimeError):
+    """A faultpoints seam honoured the action "crash": the process is
+    considered dead at that barrier. Only test harnesses catch this."""
+
+
+def register_crash_point(point: str, store: str) -> str:
+    """Declare `point` a durability barrier of `store` (idempotent).
+    Returns the point name so seams can register-and-use in one line."""
+    CRASH_POINTS[point] = store
+    return point
+
+
+def crash_fire(point: str, **detail) -> None:
+    """Seam helper for plain barriers: consult the hook and die (raise
+    InjectedCrashError) when told to. Same fast path as fire()."""
+    if hook is not None and fire(point, **detail) == "crash":
+        raise InjectedCrashError(f"injected crash at {point}")
+
+
+def install_env_crash_hook() -> bool:
+    """Arm a REAL self-SIGKILL from ``CORDA_TPU_CRASH_AT=point[:nth]``
+    (nth defaults to 1: die the first time the point fires). Returns
+    True when armed. Installed at node boot so OS-process crash tests
+    can kill a node at an exact durability barrier instead of at a
+    random instant."""
+    spec = os.environ.get("CORDA_TPU_CRASH_AT", "")
+    if not spec:
+        return False
+    point, _, nth_s = spec.partition(":")
+    nth = int(nth_s) if nth_s else 1
+    seen = {"n": 0}
+    prev = hook
+
+    def env_hook(p: str, **detail):
+        if p == point:
+            seen["n"] += 1
+            if seen["n"] >= nth:
+                os.kill(os.getpid(), 9)  # SIGKILL: no teardown, no flush
+        return prev(p, **detail) if prev is not None else None
+
+    set_hook(env_hook)
+    return True
 
 
 def set_hook(new_hook: Optional[Callable[..., Any]]):

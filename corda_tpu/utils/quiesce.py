@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import atomicfile
+
 #: default lifetime of the cross-process QUIESCE marker; hw_capture
 #: ignores an expired file, so a SIGKILLed bench stalls probing for at
 #: most this long
@@ -120,16 +122,13 @@ class _Quiesce:
                     pass
         try:
             os.makedirs(os.path.dirname(self._path), exist_ok=True)
-            tmp = f"{self._path}.{os.getpid()}.tmp"
             token = f"{os.getpid()}-{time.time_ns()}"
-            with open(tmp, "w") as fh:
-                json.dump({
-                    "pid": os.getpid(),
-                    "token": token,
-                    "ts": time.time(),
-                    "expires": time.time() + self._ttl,
-                }, fh)
-            os.replace(tmp, self._path)
+            atomicfile.write_json_atomic(self._path, {
+                "pid": os.getpid(),
+                "token": token,
+                "ts": time.time(),
+                "expires": time.time() + self._ttl,
+            })
             self._token = token
         except OSError:
             pass  # read-only checkout: in-process quiesce still holds

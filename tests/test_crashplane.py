@@ -1,0 +1,554 @@
+"""Crash-consistency plane (ISSUE 20, docs/robustness.md §7): the
+simulated power-cut storage semantics, the CRC frame + quarantine
+contract, the crash-point recovery matrix (including the pinned
+--break-recovery RED verdict — proof the matrix has teeth), the
+restart-storm disruption, and the gate/bench wiring.
+"""
+import json
+import os
+import random
+import struct
+import tempfile
+import uuid
+
+import pytest
+
+from corda_tpu.node import recovery
+from corda_tpu.testing import crashstore
+from corda_tpu.utils import atomicfile, faultpoints
+
+
+# ---------------------------------------------------------------------------
+# crashstore: the power-cut model itself
+
+
+class TestCrashDiskSemantics:
+    def setup_method(self):
+        self.wd = tempfile.mkdtemp(prefix="crashplane-")
+
+    def p(self, name):
+        return os.path.join(self.wd, name)
+
+    def test_unsynced_writes_can_vanish_fsynced_cannot(self):
+        lost_any = False
+        for seed in range(20):
+            disk = crashstore.CrashDisk(rng=random.Random(seed))
+            with disk.open(self.p(f"durable-{seed}"), "wb") as fh:
+                fh.write(b"D" * 2048)
+                disk.fsync_fh(fh)
+            disk.fsync_dir(self.wd)
+            with disk.open(self.p(f"loose-{seed}"), "wb") as fh:
+                fh.write(b"L" * 2048)
+            disk.power_cut()
+            with open(self.p(f"durable-{seed}"), "rb") as fh:
+                assert fh.read() == b"D" * 2048, "fsync'd data damaged"
+            loose = self.p(f"loose-{seed}")
+            if not os.path.exists(loose):
+                lost_any = True
+            else:
+                with open(loose, "rb") as fh:
+                    if fh.read() != b"L" * 2048:
+                        lost_any = True
+        assert lost_any, "20 seeds never lost an unsynced write"
+
+    def test_unsynced_pages_tear_at_byte_boundaries(self):
+        torn = False
+        for seed in range(30):
+            disk = crashstore.CrashDisk(rng=random.Random(seed))
+            with disk.open(self.p(f"t-{seed}"), "wb") as fh:
+                fh.write(bytes(range(256)) * 16)  # 4 KiB, 8 pages
+            stats = disk.power_cut()
+            if any(s["torn"] for s in stats.values()):
+                torn = True
+                break
+        assert torn, "30 seeds never produced a torn page"
+
+    def test_app_buffer_lost_on_proc_crash_unless_flushed(self):
+        disk = crashstore.CrashDisk(rng=random.Random(0))
+        f1 = disk.open(self.p("flushed"), "wb")
+        f1.write(b"F" * 100)
+        f1.flush()
+        f2 = disk.open(self.p("buffered"), "wb")
+        f2.write(b"B" * 100)
+        # no flush: the bytes live in the app buffer only
+        disk.proc_crash()
+        with open(self.p("flushed"), "rb") as fh:
+            assert fh.read() == b"F" * 100
+        assert (not os.path.exists(self.p("buffered"))
+                or open(self.p("buffered"), "rb").read() == b"")
+
+    def test_fsynced_file_pins_its_own_create(self):
+        """ext4 auto_da_alloc rule: a CREATE whose file data was later
+        fsync'd survives the cut even without fsync(dir) — the journal
+        orders the dirent before the data commit."""
+        for seed in range(10):
+            disk = crashstore.CrashDisk(rng=random.Random(seed))
+            path = self.p(f"pinned-{seed}")
+            with disk.open(path, "wb") as fh:
+                fh.write(b"P" * 512)
+                disk.fsync_fh(fh)
+            disk.power_cut()
+            assert os.path.exists(path), (
+                f"seed {seed}: fsync'd file's create vanished"
+            )
+            with open(path, "rb") as fh:
+                assert fh.read() == b"P" * 512
+
+    def test_atomic_write_with_fsync_survives_every_seed(self):
+        for seed in range(25):
+            target = self.p(f"atomic-{seed}.json")
+            disk = crashstore.CrashDisk(rng=random.Random(seed))
+            with crashstore.interpose(disk):
+                atomicfile.write_json_atomic(target, {"v": 1})
+                atomicfile.write_json_atomic(target, {"v": 2})
+                disk.power_cut()
+            with open(target) as fh:
+                assert json.load(fh)["v"] in (1, 2)
+
+    def test_snapshot_sqlite_images_the_live_wal(self):
+        from corda_tpu.node.database import NodeDatabase
+
+        dbp = self.p("live.db")
+        db = NodeDatabase(dbp)
+        db.execute("CREATE TABLE t (n INTEGER)")
+        for i in range(300):
+            db.execute("INSERT INTO t VALUES (?)", (i,))
+        disk = crashstore.CrashDisk(rng=random.Random(1))
+        disk.sqlite_paths.append(dbp)
+        snap = disk.snapshot_sqlite(self.p("img"))
+        torn = disk.tear_sqlite_wal(snap.values())
+        db.close()
+        assert torn, "no WAL to tear — snapshot missed the live image"
+        db2 = NodeDatabase(snap[dbp])
+        rows = db2.query("SELECT COUNT(*) FROM t")
+        db2.close()
+        # sqlite's per-frame WAL checksums absorb the torn tail: SOME
+        # prefix of the rows is recovered, never an error, never more
+        assert 0 <= rows[0][0] <= 300
+
+
+# ---------------------------------------------------------------------------
+# CRC frame + quarantine (satellite 2)
+
+
+class TestFrameQuarantine:
+    def test_frame_round_trip_and_legacy_passthrough(self):
+        payload = b"checkpoint-blob" * 10
+        assert recovery.unframe(recovery.frame(payload)) == payload
+        legacy = b"not-framed-legacy-blob"
+        assert recovery.unframe(legacy) == legacy
+
+    def test_truncated_and_corrupt_frames_raise_typed(self):
+        framed = recovery.frame(b"x" * 100)
+        with pytest.raises(recovery.CorruptRecordError):
+            recovery.unframe(framed[: len(framed) // 2])
+        flipped = bytearray(framed)
+        flipped[-1] ^= 0xFF
+        with pytest.raises(recovery.CorruptRecordError):
+            recovery.unframe(bytes(flipped))
+
+    def test_hand_truncated_checkpoint_blob_quarantines_not_wedges(self):
+        """The regression pin: a checkpoint row whose framed blob was
+        torn mid-payload must be skipped-and-quarantined by
+        all_checkpoints/get — never an exception out of startup."""
+        from corda_tpu.core.serialization.codec import serialize
+        from corda_tpu.node.database import CheckpointStorage, NodeDatabase
+
+        db = NodeDatabase(":memory:")
+        store = CheckpointStorage(db)
+        store.put("good", serialize({"flow_name": "G", "step": 1}))
+        store.put("torn", serialize({"flow_name": "T", "step": 2}))
+        row = db.query(
+            "SELECT blob FROM checkpoints WHERE flow_id='torn'"
+        )[0][0]
+        with db.transaction() as cur:
+            cur.execute(
+                "UPDATE checkpoints SET blob=? WHERE flow_id='torn'",
+                (row[: len(row) - 7],),
+            )
+        before = recovery.quarantined_records.value
+        cps = dict(store.all_checkpoints())
+        assert "good" in cps and "torn" not in cps
+        assert store.get("torn") is None
+        assert recovery.quarantined_records.value > before
+        quarantined = store.quarantined()
+        assert any(fid == "torn" for fid, _, _ in quarantined)
+        db.close()
+
+    def test_hand_truncated_journal_tail_replays_prefix(self):
+        from corda_tpu.messaging.broker import Message, _Journal
+
+        wd = tempfile.mkdtemp(prefix="crashplane-j-")
+        jp = os.path.join(wd, "q.journal")
+        j = _Journal(jp)
+        ids = []
+        for i in range(10):
+            m = Message(payload=b"p%d" % i, headers={},
+                        message_id=str(uuid.uuid4()))
+            j.append_enqueue(m)
+            ids.append(m.message_id)
+        j.close()
+        size = os.path.getsize(jp)
+        with open(jp, "r+b") as fh:
+            fh.truncate(size - 11)  # tear the last record mid-body
+        pending = _Journal.replay(jp)
+        got = [m.message_id for m in pending]
+        assert got == ids[:9], "prefix replay broke on a torn tail"
+
+    def test_corrupt_mid_journal_record_quarantines_the_tail(self):
+        from corda_tpu.messaging.broker import (
+            JOURNAL_MAGIC,
+            Message,
+            _Journal,
+        )
+
+        wd = tempfile.mkdtemp(prefix="crashplane-j2-")
+        jp = os.path.join(wd, "q.journal")
+        j = _Journal(jp)
+        ids = []
+        for i in range(6):
+            m = Message(payload=b"payload-%d" % i, headers={},
+                        message_id=str(uuid.uuid4()))
+            j.append_enqueue(m)
+            ids.append(m.message_id)
+        j.close()
+        with open(jp, "rb") as fh:
+            data = bytearray(fh.read())
+        assert data.startswith(JOURNAL_MAGIC)
+        # flip one byte INSIDE record 4's body (after its crc) — frames
+        # still parse, the crc catches it, the tail is set aside
+        pos = len(JOURNAL_MAGIC)
+        for _ in range(3):
+            _, length = struct.unpack_from(">BI", data, pos)
+            pos += 5 + length
+        _, length = struct.unpack_from(">BI", data, pos)
+        data[pos + 5 + 4 + 2] ^= 0xFF
+        with open(jp, "wb") as fh:
+            fh.write(bytes(data))
+        before = recovery.quarantined_records.value
+        pending = _Journal.replay(jp)
+        assert [m.message_id for m in pending] == ids[:3]
+        assert recovery.quarantined_records.value > before
+
+
+# ---------------------------------------------------------------------------
+# the verify_* detectors must actually detect (seeded violations)
+
+
+class TestVerifyDetectors:
+    def test_broker_verifier_catches_loss_and_ghost(self):
+        wd = tempfile.mkdtemp(prefix="crashplane-v-")
+        from corda_tpu.messaging.broker import Message, _Journal
+
+        jp = os.path.join(wd, "q.journal")
+        j = _Journal(jp)
+        m = Message(payload=b"x", headers={}, message_id=str(uuid.uuid4()))
+        j.append_enqueue(m)
+        j.close()
+        lost_id = str(uuid.uuid4())
+        probs = recovery.verify_broker_journal(
+            wd, sent={m.message_id, lost_id}, acked=set(),
+            durable_sent={m.message_id, lost_id},
+        )
+        assert any("lost" in p for p in probs), probs
+        probs = recovery.verify_broker_journal(
+            wd, sent=set(), acked=set(), durable_sent=set(),
+        )
+        assert any("ghost" in p or "never sent" in p for p in probs), probs
+
+    def test_consumption_verifier_catches_wrong_tx_owner(self):
+        import hashlib
+
+        from corda_tpu.core.contracts.structures import StateRef
+        from corda_tpu.core.crypto.secure_hash import SecureHash
+        from corda_tpu.node.database import NodeDatabase
+        from corda_tpu.node.notary import PersistentUniquenessProvider
+
+        class _P:
+            name = "O=CrashPlane,L=Testland,C=ZZ"
+
+        p = PersistentUniquenessProvider(NodeDatabase(":memory:"))
+        h = hashlib.sha256(b"crashplane-state").digest()
+        tx_a = SecureHash(hashlib.sha256(b"tx-a").digest())
+        p.commit([StateRef(SecureHash(h), 0)], tx_a, _P())
+        key = h + (0).to_bytes(4, "big")
+        expect_b = hashlib.sha256(b"tx-b").digest().hex()
+        probs = recovery.verify_consumption([p], {key: expect_b})
+        assert any("expected" in p for p in probs), probs
+        # and the matching expectation is clean
+        assert recovery.verify_consumption(
+            [p], {key: tx_a.bytes.hex()}
+        ) == []
+
+    def test_flow_results_verifier_catches_duplicates(self):
+        probs = recovery.verify_flow_results(
+            {"f-1": ["tx-a"], "f-2": ["tx-b", "tx-b2"]}
+        )
+        assert any("exactly-once" in p for p in probs), probs
+
+
+# ---------------------------------------------------------------------------
+# crashmc: the matrix (subset in-process) + the pinned RED self-test
+
+
+def _crashmc():
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    return importlib.import_module("crashmc")
+
+
+class TestCrashMatrix:
+    def test_registry_meets_coverage_floor(self):
+        mc = _crashmc()
+        mc._import_stores()
+        assert len(faultpoints.CRASH_POINTS) >= mc.MIN_POINTS
+        assert len(set(faultpoints.CRASH_POINTS.values())) >= mc.MIN_STORES
+
+    def test_atomic_and_journal_points_recover_clean(self):
+        mc = _crashmc()
+        report = mc.run_matrix(
+            points=["atomicfile.*", "journal.append_*"],
+            seeds=2, require_coverage=False,
+        )
+        assert report.ok, report.failed_cells
+        assert report.torn_stores.get("broker_journal", 0) > 0
+
+    def test_checkpoint_point_recovers_clean(self):
+        mc = _crashmc()
+        report = mc.run_matrix(
+            points=["checkpoint.put", "checkpoint.group_commit.drain"],
+            seeds=2, require_coverage=False,
+        )
+        assert report.ok, report.failed_cells
+
+    def test_break_recovery_turns_the_matrix_red(self):
+        """The acceptance pin: a deliberately broken recovery path MUST
+        fail the matrix. A matrix that stays green under sabotage is a
+        rubber stamp, not a check."""
+        mc = _crashmc()
+        # crash at the first ACK append: all 30 enqueues are already
+        # fsync-durable, so a replay sabotaged to return [] loses them
+        report = mc.run_matrix(
+            points=["journal.append_ack"], seeds=1,
+            require_coverage=False, break_recovery="broker_journal",
+        )
+        assert not report.ok, (
+            "sabotaged broker replay still passed the matrix"
+        )
+        assert any(
+            "lost" in p for probs in report.failed_cells.values()
+            for p in probs
+        )
+
+    def test_break_recovery_checkpoints_turns_red(self):
+        mc = _crashmc()
+        report = mc.run_matrix(
+            points=["checkpoint.put"], seeds=1,
+            require_coverage=False, break_recovery="checkpoints",
+        )
+        assert not report.ok
+
+    def test_scenario_exception_is_a_red_cell_not_a_crash(self):
+        mc = _crashmc()
+        res = mc.run_cell("no.such.point", "broker_journal", 0)
+        assert res["problems"], "a never-firing point must be red"
+
+
+# ---------------------------------------------------------------------------
+# restart_storm (satellite 1) with a deterministic fake victim
+
+
+class _StormVictim:
+    def __init__(self):
+        self.kills = 0
+        self.relaunches = 0
+        self.alive = False
+        self.completions = 0
+
+    def kill(self):
+        assert self.alive or self.kills == 0, "kill on a dead victim"
+        self.kills += 1
+        self.alive = False
+
+    def relaunch(self):
+        self.relaunches += 1
+        self.alive = True
+        self.completions += 3  # recovery makes progress
+
+
+class TestRestartStorm:
+    def test_storm_fires_n_relaunches_and_heal_asserts_progress(self):
+        from corda_tpu.loadtest.disruption import restart_storm
+
+        v = _StormVictim()
+        v.alive = True
+        d = restart_storm(
+            v, probe=lambda: v.completions, relaunches=5,
+            recovery_deadline_s=5,
+        )
+        rng = random.Random(0)
+        d.fire(rng)
+        assert d.state["fired"]
+        assert v.kills == 5, "storm must kill 5 times"
+        assert v.relaunches == 4, "4 mid-storm relaunches before heal"
+        assert not v.alive, "last kill lands before the heal"
+        d.heal(rng)
+        assert v.alive, "heal leaves the final relaunch running"
+        assert v.relaunches == 5
+
+    def test_storm_heal_runs_the_invariant_verify(self):
+        from corda_tpu.loadtest.disruption import restart_storm
+
+        v = _StormVictim()
+        v.alive = True
+        d = restart_storm(
+            v, probe=lambda: v.completions, relaunches=3,
+            verify=lambda: ["seeded durability violation"],
+            recovery_deadline_s=5,
+        )
+        rng = random.Random(1)
+        d.fire(rng)
+        with pytest.raises(AssertionError, match="durability"):
+            d.heal(rng)
+
+    def test_storm_heal_fails_on_no_progress(self):
+        from corda_tpu.loadtest.disruption import restart_storm
+
+        v = _StormVictim()
+        v.alive = True
+        d = restart_storm(
+            v, probe=lambda: 0, relaunches=2, recovery_deadline_s=0.5,
+        )
+        rng = random.Random(2)
+        d.fire(rng)
+        with pytest.raises(AssertionError, match="no recovery"):
+            d.heal(rng)
+
+
+# ---------------------------------------------------------------------------
+# soak gate --require (satellite 1) + bench direction (satellite 4)
+
+
+class TestGateWiring:
+    def _gate(self, record, argv):
+        import importlib
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools"))
+        soak_gate = importlib.import_module("soak_gate")
+        wd = tempfile.mkdtemp(prefix="crashplane-g-")
+        path = os.path.join(wd, "rec.json")
+        with open(path, "w") as fh:
+            json.dump(record, fh)
+        return soak_gate.main(["--current", path] + argv)
+
+    def _record(self, events):
+        return {
+            "pairs": 10, "hard_error_rate": 0.0, "consistent": True,
+            "events": events,
+        }
+
+    def test_require_passes_when_kind_fired_and_recovered(self):
+        rec = self._record([
+            [1.0, "restart_storm", "fired"],
+            [4.0, "restart_storm", "recovered+5"],
+        ])
+        assert self._gate(rec, ["--require", "restart_storm"]) == 0
+
+    def test_require_breaches_when_kind_absent(self):
+        rec = self._record([[1.0, "restart", "fired"],
+                            [2.0, "restart", "recovered+2"]])
+        assert self._gate(rec, ["--require", "restart_storm"]) == 1
+
+    def test_require_breaches_on_fired_without_recovery(self):
+        rec = self._record([[1.0, "restart_storm", "fired"]])
+        assert self._gate(rec, ["--require", "restart_storm"]) == 1
+
+    def test_recovery_replay_gates_lower_is_better(self):
+        from corda_tpu.loadtest.gate import direction
+
+        assert direction("recovery_replay_ms") == "lower"
+
+    def test_recovery_replay_stage_measures(self):
+        from corda_tpu.loadtest.latency import measure_recovery_replay
+
+        out = measure_recovery_replay(
+            n_enqueued=300, n_acked=100, n_checkpoints=10,
+        )
+        assert out["recovery_replay_ms"] > 0
+        assert out["recovery_pending_msgs"] == 200
+        assert out["recovery_checkpoints"] == 10
+
+
+# ---------------------------------------------------------------------------
+# the env crash hook (the real-process slice's trigger)
+
+
+class TestEnvCrashHook:
+    def test_unset_env_does_not_arm(self, monkeypatch):
+        monkeypatch.delenv("CORDA_TPU_CRASH_AT", raising=False)
+        prev = faultpoints.hook
+        assert faultpoints.install_env_crash_hook() is False
+        assert faultpoints.hook is prev
+
+    def test_armed_hook_ignores_other_points(self, monkeypatch):
+        """The hook must pass every NON-matching point through — firing
+        the matching point would SIGKILL this test process, which is
+        exactly what tests/test_real_tier1.py exercises for real."""
+        monkeypatch.setenv(
+            "CORDA_TPU_CRASH_AT", "crashplane.never.fired:1"
+        )
+        prev = faultpoints.hook
+        try:
+            assert faultpoints.install_env_crash_hook() is True
+            assert faultpoints.hook is not prev
+            # any OTHER point is a no-op passthrough
+            assert faultpoints.fire("some.other.point") is None
+        finally:
+            faultpoints.set_hook(prev)
+
+
+# ---------------------------------------------------------------------------
+# the atomic_write lint pass (satellite 3)
+
+
+class TestAtomicWriteLint:
+    def _run(self, src):
+        from corda_tpu.analysis import astlint
+
+        wd = tempfile.mkdtemp(prefix="crashplane-l-")
+        path = os.path.join(wd, "mod.py")
+        with open(path, "w") as fh:
+            fh.write(src)
+        return astlint.run_passes(
+            paths=[path], root=wd, passes=["atomic_write"]
+        )
+
+    def test_direct_os_replace_is_flagged(self):
+        findings = self._run(
+            "import os\n\ndef f(a, b):\n    os.replace(a, b)\n"
+        )
+        assert len(findings) == 1
+        assert findings[0].pass_id == "atomic_write"
+
+    def test_suppression_with_reason_is_honoured(self):
+        findings = self._run(
+            "import os\n\ndef f(a, b):\n"
+            "    os.replace(a, b)  # lint: allow(atomic_write) — seam\n"
+        )
+        assert findings == []
+
+    def test_atomicfile_itself_is_exempt_in_repo_scan(self):
+        from corda_tpu.analysis import astlint
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        findings = astlint.run_passes(
+            paths=[os.path.join(repo, "corda_tpu/utils/atomicfile.py"),
+                   os.path.join(repo, "corda_tpu/messaging/broker.py")],
+            root=repo, passes=["atomic_write"],
+        )
+        assert findings == [], [f.message for f in findings]

@@ -214,11 +214,10 @@ def load_state():
 
 
 def save_state(st):
-    # atomic: a crash mid-write must not destroy the resume state
-    tmp = STATE + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(st, f, indent=1)
-    os.replace(tmp, STATE)
+    # atomic + fsync'd: a crash mid-write must not destroy the resume state
+    from corda_tpu.utils import atomicfile
+
+    atomicfile.write_json_atomic(STATE, st, indent=1)
 
 
 _last_stack_hash: dict[str, str] = {}

@@ -20,7 +20,12 @@ Fails (exit 1) on:
     `domain_goodput_pct` (foreign-traffic rate while one domain was
     dark, as a % of the undisrupted baseline) must be >= PCT — and a
     record MISSING the key breaches, same missing-block hygiene as
-    --mttr (a soak that never measured goodput must not read as green).
+    --mttr (a soak that never measured goodput must not read as green);
+  * any `--require KIND` (repeatable): the record's event timeline must
+    show that disruption kind FIRED and RECOVERED at least once — a
+    soak whose catalog silently skipped the kind (or whose run ended
+    before the rotation reached it) must not read as coverage. E.g.
+    `--require restart_storm` pins the crash-consistency rotation.
 
 Exit status: 0 = pass, 1 = breach, 2 = usage error — the same contract
 as tools/bench_gate.py, sharing its comparison engine
@@ -63,6 +68,11 @@ def main(argv=None) -> int:
         "--mttr", type=float, metavar="MS",
         help="ceiling (ms) asserted on EVERY mttr_ms{kind=…} the record "
              "reports; missing mttr block on a disrupted run = breach",
+    )
+    ap.add_argument(
+        "--require", action="append", metavar="KIND",
+        help="disruption kind that must appear in the record's events "
+             "as fired AND recovered (repeatable); absence = breach",
     )
     ap.add_argument(
         "--domain-goodput", type=float, metavar="PCT",
@@ -119,6 +129,20 @@ def main(argv=None) -> int:
             violations.append({
                 "key": "domain_goodput_pct", "value": goodput,
                 "bound": args.domain_goodput, "kind": "min",
+            })
+    for kind in args.require or []:
+        statuses = {
+            str(ev[2]) for ev in (record.get("events") or [])
+            if isinstance(ev, (list, tuple)) and len(ev) >= 3
+            and ev[1] == kind
+        }
+        fired_ev = any(s == "fired" for s in statuses)
+        recovered_ev = any(s.startswith("recovered") for s in statuses)
+        if not (fired_ev and recovered_ev):
+            violations.append({
+                "key": f"require.{kind}",
+                "value": sorted(statuses) or None,
+                "bound": "fired+recovered", "kind": "missing",
             })
     if record.get("consistent") is not True:
         violations.append({
